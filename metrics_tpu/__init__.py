@@ -1,0 +1,42 @@
+"""metrics_tpu — TPU-native machine-learning metrics (JAX/XLA/pjit/Pallas).
+
+A brand-new framework with the capabilities of TorchMetrics v0.7 (reference:
+``getgaurav2/metrics``), redesigned TPU-first: metrics are pytree states + pure
+``init/update/merge/compute`` functions, distributed sync lowers to XLA collectives
+(psum/all_gather) over named mesh axes, and a MetricCollection syncs in one fused
+collective bundle inside the training step.
+"""
+import logging
+
+_logger = logging.getLogger("metrics_tpu")
+_logger.addHandler(logging.StreamHandler())
+_logger.setLevel(logging.INFO)
+
+__version__ = "0.1.0"
+
+from metrics_tpu.aggregation import (  # noqa: E402
+    BaseAggregator,
+    CatMetric,
+    MaxMetric,
+    MeanMetric,
+    MinMetric,
+    SumMetric,
+)
+from metrics_tpu.collections import MetricCollection  # noqa: E402
+from metrics_tpu.metric import CompositionalMetric, Metric  # noqa: E402
+from metrics_tpu.parallel import MeshConfig, metric_axis  # noqa: E402
+
+__all__ = [
+    "BaseAggregator",
+    "CatMetric",
+    "CompositionalMetric",
+    "MaxMetric",
+    "MeanMetric",
+    "MeshConfig",
+    "Metric",
+    "MetricCollection",
+    "MinMetric",
+    "SumMetric",
+    "metric_axis",
+    "__version__",
+]

@@ -1,0 +1,157 @@
+"""Aggregation metrics: generic reducers usable as standalone metrics.
+
+Parity: reference ``torchmetrics/aggregation.py:24-439`` (BaseAggregator, MaxMetric,
+MinMetric, SumMetric, CatMetric, MeanMetric) including the nan_strategy
+(error/warn/ignore/<float impute>) contract.
+
+TPU note: nan handling is done with ``jnp.where`` masks (branch-free, trace-safe);
+the 'error'/'warn' strategies need a host-side value check and therefore only run
+eagerly — inside jit they degrade to 'ignore' with a one-time warning.
+"""
+from typing import Any, Callable, List, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.data import dim_zero_cat
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+from metrics_tpu.utils.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+class BaseAggregator(Metric):
+    """Base for aggregation metrics. Parity: reference ``aggregation.py:24-109``."""
+
+    value: Union[Array, List[Array]]
+    is_differentiable = None
+    higher_is_better = None
+
+    def __init__(
+        self,
+        fn: Union[Callable, str],
+        default_value: Union[Array, List],
+        nan_strategy: Union[str, float] = "error",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        allowed_nan_strategy = ("error", "warn", "ignore")
+        if nan_strategy not in allowed_nan_strategy and not isinstance(nan_strategy, (int, float)):
+            raise ValueError(
+                f"Arg `nan_strategy` should either be a float or one of {allowed_nan_strategy} but got {nan_strategy}."
+            )
+        self.nan_strategy = nan_strategy
+        self.add_state("value", default=default_value, dist_reduce_fx=fn)
+
+    def _cast_and_nan_check_input(self, x: Union[float, Array]) -> Array:
+        """Convert input to float array and apply the NaN strategy."""
+        x = jnp.asarray(x, dtype=jnp.float32) if not isinstance(x, jax.Array) else x.astype(jnp.float32)
+        if self.nan_strategy in ("error", "warn"):
+            if isinstance(jnp.sum(x), jax.core.Tracer):
+                rank_zero_warn(
+                    "nan_strategy='error'/'warn' cannot run inside jit; treating as 'ignore'.",
+                    UserWarning,
+                )
+            else:
+                contains_nan = bool(jnp.any(jnp.isnan(x)))
+                if contains_nan and self.nan_strategy == "error":
+                    raise RuntimeError("Encountered `nan` values in tensor")
+                if contains_nan and self.nan_strategy == "warn":
+                    rank_zero_warn("Encountered `nan` values in tensor. Will be removed.", UserWarning)
+        return x
+
+    def _nan_mask_or_impute(self, x: Array, neutral: float) -> Array:
+        """Replace NaNs with the impute value or a reduction-neutral element."""
+        fill = float(self.nan_strategy) if isinstance(self.nan_strategy, (int, float)) and not isinstance(
+            self.nan_strategy, bool
+        ) else neutral
+        return jnp.where(jnp.isnan(x), jnp.asarray(fill, dtype=x.dtype), x)
+
+    def update(self, value: Union[float, Array]) -> None:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def compute(self) -> Array:
+        return self.value
+
+
+class MaxMetric(BaseAggregator):
+    """Running max. Parity: reference ``aggregation.py:112-174``."""
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("max", jnp.asarray(-jnp.inf), nan_strategy, **kwargs)
+
+    def update(self, value: Union[float, Array]) -> None:
+        value = self._cast_and_nan_check_input(value)
+        value = self._nan_mask_or_impute(value, -jnp.inf)
+        if value.size:
+            self.value = jnp.maximum(self.value, jnp.max(value))
+
+
+class MinMetric(BaseAggregator):
+    """Running min. Parity: reference ``aggregation.py:177-239``."""
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("min", jnp.asarray(jnp.inf), nan_strategy, **kwargs)
+
+    def update(self, value: Union[float, Array]) -> None:
+        value = self._cast_and_nan_check_input(value)
+        value = self._nan_mask_or_impute(value, jnp.inf)
+        if value.size:
+            self.value = jnp.minimum(self.value, jnp.min(value))
+
+
+class SumMetric(BaseAggregator):
+    """Running sum. Parity: reference ``aggregation.py:242-297``."""
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("sum", jnp.asarray(0.0), nan_strategy, **kwargs)
+
+    def update(self, value: Union[float, Array]) -> None:
+        value = self._cast_and_nan_check_input(value)
+        value = self._nan_mask_or_impute(value, 0.0)
+        if value.size:
+            self.value = self.value + jnp.sum(value)
+
+
+class CatMetric(BaseAggregator):
+    """Concatenate all seen values. Parity: reference ``aggregation.py:300-360``."""
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("cat", [], nan_strategy, **kwargs)
+
+    def update(self, value: Union[float, Array]) -> None:
+        value = self._cast_and_nan_check_input(value)
+        if isinstance(self.nan_strategy, (int, float)) and not isinstance(self.nan_strategy, str):
+            value = self._nan_mask_or_impute(value, 0.0)
+        elif not isinstance(jnp.sum(value), jax.core.Tracer):
+            value = value[~jnp.isnan(jnp.atleast_1d(value))]
+        if value.size:
+            self.value.append(value)
+
+    def compute(self) -> Array:
+        return dim_zero_cat(self.value) if self.value else jnp.zeros(0)
+
+
+class MeanMetric(BaseAggregator):
+    """Running (weighted) mean. Parity: reference ``aggregation.py:363-439``."""
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("sum", jnp.asarray(0.0), nan_strategy, **kwargs)
+        self.add_state("weight", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, value: Union[float, Array], weight: Union[float, Array] = 1.0) -> None:
+        value = self._cast_and_nan_check_input(value)
+        weight = self._cast_and_nan_check_input(weight)
+        if value.size == 0:
+            return
+        weight = jnp.broadcast_to(weight, value.shape)
+        nan = jnp.isnan(value)
+        value = self._nan_mask_or_impute(value, 0.0)
+        if not isinstance(self.nan_strategy, (int, float)) or isinstance(self.nan_strategy, bool):
+            weight = jnp.where(nan, 0.0, weight)
+        self.value = self.value + jnp.sum(value * weight)
+        self.weight = self.weight + jnp.sum(weight)
+
+    def compute(self) -> Array:
+        return self.value / self.weight

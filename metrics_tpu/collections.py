@@ -1,0 +1,204 @@
+"""MetricCollection: dict-of-metrics with shared call signature and fused sync.
+
+Parity: reference ``torchmetrics/metric_collections.py:26-235`` (forward :103,
+update :112, add_metrics :149, items/keys(keep_base) :205-221, prefix/postfix, clone).
+
+Beyond parity (the headline TPU win): the functional path
+``init_state / update_state / compute_synced`` carries ALL member metrics' states as
+one pytree and syncs them in a single fused collective bundle
+(``parallel.collectives.fused_axis_sync``) — one psum for every counter state of every
+member, where the reference issues O(metrics x states) sequential all_gathers
+(``metric.py:240-245``).
+"""
+from copy import deepcopy
+from typing import Any, Dict, Hashable, Iterable, List, Optional, Sequence, Tuple, Union
+
+import jax
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.parallel.collectives import fused_axis_sync, in_mapped_context
+from metrics_tpu.parallel.mesh import current_metric_axis
+from metrics_tpu.utils.data import dim_zero_cat
+
+
+class MetricCollection(dict):
+    """An ordered dict of metrics sharing one call signature.
+
+    Args:
+        metrics: a Metric, a sequence of Metrics, or a dict name->Metric.
+        prefix/postfix: added to every key in the output dict.
+    """
+
+    def __init__(
+        self,
+        metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]],
+        *additional_metrics: Metric,
+        prefix: Optional[str] = None,
+        postfix: Optional[str] = None,
+    ) -> None:
+        super().__init__()
+        self.prefix = self._check_arg(prefix, "prefix")
+        self.postfix = self._check_arg(postfix, "postfix")
+        self.add_metrics(metrics, *additional_metrics)
+
+    @staticmethod
+    def _check_arg(arg: Optional[str], name: str) -> Optional[str]:
+        if arg is None or isinstance(arg, str):
+            return arg
+        raise ValueError(f"Expected input `{name}` to be a string, but got {type(arg)}")
+
+    def add_metrics(
+        self, metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]], *additional_metrics: Metric
+    ) -> None:
+        """Parity: reference ``metric_collections.py:149-203``."""
+        if isinstance(metrics, Metric):
+            metrics = [metrics]
+        if isinstance(metrics, Sequence):
+            remain: list = []
+            for m in additional_metrics:
+                (metrics if isinstance(m, Metric) else remain).append(m)
+            if remain:
+                raise ValueError(
+                    f"You have passes extra arguments {remain} which are not `Metric` so they will be ignored."
+                )
+        elif additional_metrics:
+            raise ValueError(
+                f"You have passes extra arguments {additional_metrics} which are not compatible with first passed dictionary."
+            )
+        if isinstance(metrics, dict):
+            for name in sorted(metrics.keys()):
+                metric = metrics[name]
+                if not isinstance(metric, Metric):
+                    raise ValueError(
+                        f"Value {metric} belonging to key {name} is not an instance of `metrics_tpu.Metric`"
+                    )
+                self[name] = metric
+        elif isinstance(metrics, Sequence):
+            for metric in metrics:
+                if not isinstance(metric, Metric):
+                    raise ValueError(f"Input {metric} to `MetricCollection` is not a instance of `metrics_tpu.Metric`")
+                name = type(metric).__name__
+                if name in self:
+                    raise ValueError(f"Encountered two metrics both named {name}")
+                self[name] = metric
+        else:
+            raise ValueError("Unknown input to MetricCollection.")
+
+    # ------------------------------------------------------------------- eager facade
+
+    def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        """Call every member; returns dict of per-batch values. Parity: ``:103-110``."""
+        return {self._set_name(k): m(*args, **m._filter_kwargs(**kwargs)) for k, m in self.items(keep_base=True)}
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        return self.forward(*args, **kwargs)
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        for _, m in self.items(keep_base=True):
+            m.update(*args, **m._filter_kwargs(**kwargs))
+
+    def compute(self) -> Dict[str, Any]:
+        return {self._set_name(k): m.compute() for k, m in self.items(keep_base=True)}
+
+    def reset(self) -> None:
+        for _, m in self.items(keep_base=True):
+            m.reset()
+
+    def clone(self, prefix: Optional[str] = None, postfix: Optional[str] = None) -> "MetricCollection":
+        mc = deepcopy(self)
+        if prefix:
+            mc.prefix = self._check_arg(prefix, "prefix")
+        if postfix:
+            mc.postfix = self._check_arg(postfix, "postfix")
+        return mc
+
+    def persistent(self, mode: bool = True) -> None:
+        for _, m in self.items(keep_base=True):
+            m.persistent(mode)
+
+    def state_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for k, m in self.items(keep_base=True):
+            out.update(m.state_dict(prefix=f"{k}."))
+        return out
+
+    def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
+        for k, m in self.items(keep_base=True):
+            m.load_state_dict(state_dict, prefix=f"{k}.")
+
+    # -------------------------------------------------------- functional / fused path
+
+    def init_state(self) -> Dict[str, Dict[str, Any]]:
+        """One pytree holding all member states: {metric_name: state_dict}."""
+        return {k: m.init_state() for k, m in self.items(keep_base=True)}
+
+    def update_state(self, state: Dict[str, Dict[str, Any]], *args: Any, **kwargs: Any) -> Dict[str, Dict[str, Any]]:
+        """Pure fan-out update of all members. Safe inside jit/scan/shard_map."""
+        return {
+            k: m.update_state(state[k], *args, **m._filter_kwargs(**kwargs))
+            for k, m in self.items(keep_base=True)
+        }
+
+    def sync_states(
+        self, state: Dict[str, Dict[str, Any]], axis_name: Optional[str] = None
+    ) -> Dict[str, Dict[str, Any]]:
+        """Fused cross-axis sync of ALL member states in one collective bundle."""
+        axis = axis_name or current_metric_axis()
+        if axis is None or not in_mapped_context(axis):
+            return state
+        leaves: List[Tuple[Any, Any]] = []
+        slots: List[Tuple[str, str]] = []
+        for k, m in self.items(keep_base=True):
+            for sname in m._defaults:
+                v = state[k][sname]
+                v = dim_zero_cat(v) if isinstance(v, list) else v
+                leaves.append((m._reductions[sname], v))
+                slots.append((k, sname))
+        synced = fused_axis_sync(leaves, axis)
+        out: Dict[str, Dict[str, Any]] = {k: {} for k, _ in self.items(keep_base=True)}
+        for (k, sname), v in zip(slots, synced):
+            out[k][sname] = v
+        return out
+
+    def compute_from(self, state: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
+        return {self._set_name(k): m.compute_from(state[k]) for k, m in self.items(keep_base=True)}
+
+    def compute_synced(self, state: Dict[str, Dict[str, Any]], axis_name: Optional[str] = None) -> Dict[str, Any]:
+        return self.compute_from(self.sync_states(state, axis_name))
+
+    # ------------------------------------------------------------------------- naming
+
+    def _set_name(self, base: str) -> str:
+        name = base if self.prefix is None else self.prefix + base
+        name = name if self.postfix is None else name + self.postfix
+        return name
+
+    def _to_renamed_dict(self) -> Dict[str, Metric]:
+        return {self._set_name(k): v for k, v in super().items()}
+
+    def items(self, keep_base: bool = False) -> Iterable[Tuple[str, Metric]]:
+        """Parity: reference ``metric_collections.py:205-213``."""
+        if keep_base:
+            return super().items()
+        return self._to_renamed_dict().items()
+
+    def keys(self, keep_base: bool = False) -> Iterable[str]:
+        if keep_base:
+            return super().keys()
+        return self._to_renamed_dict().keys()
+
+    def values(self) -> Iterable[Metric]:
+        return super().values()
+
+    def __getitem__(self, key: str) -> Metric:
+        return dict.__getitem__(self, key)
+
+    def __repr__(self) -> str:
+        repr_str = self.__class__.__name__ + "("
+        for k, v in self.items(keep_base=True):
+            repr_str += f"\n  {k}: {repr(v)}"
+        if self.prefix:
+            repr_str += f"\n  prefix={self.prefix}"
+        if self.postfix:
+            repr_str += f"\n  postfix={self.postfix}"
+        return repr_str + "\n)" if len(self) else repr_str + ")"

@@ -1,0 +1,698 @@
+"""Metric runtime (L1): state registry, update/compute/reset protocol, axis sync.
+
+Parity: reference ``torchmetrics/metric.py`` (Metric ABC: add_state :123, forward :192,
+_sync_dist :232, sync/unsync/sync_context :268-358, _wrap_compute :360, reset :397,
+state_dict :514, _filter_kwargs :554, operator overloads :595-698; CompositionalMetric
+:705-815).
+
+TPU-native redesign (SURVEY.md §7.1): a metric is fundamentally a **pytree state plus
+pure functions** —
+
+    state = m.init_state()                       # dict pytree of jnp arrays
+    state = m.update_state(state, preds, target) # pure, jit/scan-safe
+    value = m.compute_synced(state)              # pure; psum/all_gather over mesh axis
+    state = m.merge_states(a, b)                 # pure pairwise merge
+
+The familiar stateful facade (``m.update(...)``, ``m.compute()``, ``m.reset()``) is a
+thin shell over those functions, so the same subclass definition (attribute-mutating
+``update`` + ``compute``, exactly like the reference) serves both the eager API and the
+compiled path. ``update_state`` works by temporarily loading the state pytree into the
+instance attributes, running the subclass ``update`` under the current trace, and
+snapshotting the attributes back — the stateful-looking subclass code *is* the pure
+function body.
+
+Key differences from the reference, by design:
+  * ``forward`` computes the batch value from the **state delta** (one ``update`` per
+    step, not two — reference ``metric.py:206,218`` runs update twice).
+  * sync needs no barrier and no shape-gather (static shapes under XLA) — reference
+    ``utilities/distributed.py:116-145``.
+  * sync/unsync exist for API parity and the eager multi-host path, but in-trace sync
+    is just a pure function application; local state is never overwritten.
+"""
+import functools
+import inspect
+from copy import deepcopy
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.parallel.collectives import (
+    axis_size_or_one,
+    fused_axis_sync,
+    in_mapped_context,
+    sync_axis_state,
+)
+from metrics_tpu.parallel.mesh import current_metric_axis
+from metrics_tpu.utils.data import apply_to_collection, dim_zero_cat
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+from metrics_tpu.utils.prints import rank_zero_warn
+
+Array = jax.Array
+
+_MERGEABLE_FX = ("sum", "min", "max", "cat")
+
+
+def _squeeze_if_scalar(x: Any) -> Any:
+    """0-d-ify single-element arrays, mirroring reference ``metric.py:382``."""
+
+    def _sq(v):
+        if isinstance(v, jax.Array) and v.size == 1 and v.ndim > 0:
+            return jnp.squeeze(v)
+        return v
+
+    return apply_to_collection(x, jax.Array, _sq)
+
+
+def distributed_available() -> bool:
+    """True when metric state can differ across participants.
+
+    Parity: reference ``metric.py:42-43``. In JAX this means either a bound mesh axis
+    (in-trace) or a multi-process runtime (eager).
+    """
+    return jax.process_count() > 1
+
+
+class Metric:
+    """Base class for all metrics.
+
+    Subclasses implement ``update(self, ...)`` (mutating registered state attributes)
+    and ``compute(self)`` (reading them), exactly like the reference. States are
+    registered with :meth:`add_state`.
+
+    Args:
+        compute_on_step: return the metric value for the current batch from ``forward``.
+        dist_sync_on_step: synchronise state across the mesh axis every ``forward``.
+        sync_axis: named mesh axis to reduce over when called inside
+            ``shard_map``/``pmap`` (the ``process_group`` analogue). If None, the
+            ambient axis from ``metrics_tpu.parallel.metric_axis`` is used.
+        dist_sync_fn: override for the leaf-sync function, signature
+            ``(reduce_fx, value, axis_name) -> value``. Defaults to XLA collectives.
+    """
+
+    __jit_unsafe_attributes__ = ()
+    is_differentiable: Optional[bool] = None
+    higher_is_better: Optional[bool] = None
+    full_state_update: Optional[bool] = None
+
+    def __init__(
+        self,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        sync_axis: Optional[str] = None,
+        dist_sync_fn: Optional[Callable] = None,
+        process_group: Optional[str] = None,
+        **kwargs: Any,
+    ) -> None:
+        if kwargs:
+            raise ValueError(f"Unexpected keyword arguments: {sorted(kwargs)}")
+        if sync_axis is None and isinstance(process_group, str):
+            sync_axis = process_group  # reference's process_group ≙ a named mesh axis
+        self.compute_on_step = compute_on_step
+        self.dist_sync_on_step = dist_sync_on_step
+        self.sync_axis = sync_axis
+        self.dist_sync_fn = dist_sync_fn
+
+        self._defaults: Dict[str, Any] = {}
+        self._persistent: Dict[str, bool] = {}
+        self._reductions: Dict[str, Any] = {}
+
+        self._update_called = False
+        self._computed: Any = None
+        self._forward_cache: Any = None
+        self._is_synced = False
+        self._cache: Optional[Dict[str, Any]] = None
+        self._to_sync = True
+        self._should_unsync = True
+
+        # wrap the subclass methods once per instance (reference metric.py:102-103)
+        self.update: Callable = self._wrap_update(self.update)  # type: ignore[method-assign]
+        self.compute: Callable = self._wrap_compute(self.compute)  # type: ignore[method-assign]
+
+    # ------------------------------------------------------------------ state registry
+
+    def add_state(
+        self,
+        name: str,
+        default: Any,
+        dist_reduce_fx: Optional[Union[str, Callable]] = None,
+        persistent: bool = False,
+    ) -> None:
+        """Register a named state. Parity: reference ``metric.py:123-190``.
+
+        ``default`` is a jnp array (fixed-shape state) or an empty list (list state,
+        the "cat"/gather pattern). ``dist_reduce_fx`` in {"sum","mean","min","max",
+        "cat", None, callable}.
+        """
+        if not isinstance(default, (jax.Array, np.ndarray, list)) or (
+            isinstance(default, list) and default
+        ):
+            raise ValueError("state variable must be an array or an empty list (where you can append arrays)")
+        if isinstance(default, str) or not (
+            dist_reduce_fx in ("sum", "mean", "min", "max", "cat", None) or callable(dist_reduce_fx)
+        ):
+            raise ValueError("`dist_reduce_fx` must be callable or one of ['mean', 'sum', 'cat', 'min', 'max', None]")
+        if isinstance(default, np.ndarray):
+            default = jnp.asarray(default)
+        self._defaults[name] = default if isinstance(default, jax.Array) else list(default)
+        self._persistent[name] = persistent
+        self._reductions[name] = dist_reduce_fx
+        setattr(self, name, default if isinstance(default, jax.Array) else list(default))
+
+    # ------------------------------------------------------------- functional core API
+
+    def init_state(self) -> Dict[str, Any]:
+        """Fresh state pytree (a dict: name -> array or list of arrays)."""
+        return {
+            k: (v if isinstance(v, jax.Array) else list(v)) for k, v in self._defaults.items()
+        }
+
+    def _pack_state(self) -> Dict[str, Any]:
+        return {k: getattr(self, k) for k in self._defaults}
+
+    def _load_state(self, state: Dict[str, Any]) -> None:
+        for k, v in state.items():
+            setattr(self, k, v if isinstance(v, jax.Array) else list(v))
+
+    def update_state(self, state: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        """Pure update: ``new_state = f(state, batch)``. Safe inside jit/scan/shard_map.
+
+        Runs the subclass ``update`` body with ``state`` loaded into the instance, then
+        snapshots the result; instance state is restored afterwards, so this never
+        mutates the facade.
+        """
+        saved = self._pack_state()
+        self._load_state(state)
+        try:
+            self._inner_update(*args, **kwargs)
+            return self._pack_state()
+        finally:
+            self._load_state(saved)
+
+    def compute_from(self, state: Dict[str, Any]) -> Any:
+        """Pure compute on an explicit (already-merged) state pytree."""
+        saved = self._pack_state()
+        self._load_state(state)
+        try:
+            return _squeeze_if_scalar(self._inner_compute())
+        finally:
+            self._load_state(saved)
+
+    def compute_synced(self, state: Dict[str, Any], axis_name: Optional[str] = None) -> Any:
+        """Pure sync+compute for use inside ``shard_map``/``pmap`` regions."""
+        axis = axis_name or self.sync_axis or current_metric_axis()
+        return self.compute_from(self.sync_states(state, axis))
+
+    def sync_states(self, state: Dict[str, Any], axis_name: Optional[str]) -> Dict[str, Any]:
+        """Apply each state's dist_reduce_fx as an XLA collective over ``axis_name``.
+
+        List states are pre-concatenated (reference ``metric.py:236-238``) then
+        all_gathered. Uses one fused collective bundle for all counter states.
+        """
+        if axis_name is None or not in_mapped_context(axis_name):
+            return state
+        # pre-cat list states
+        prepped: Dict[str, Any] = {}
+        for k, v in state.items():
+            prepped[k] = dim_zero_cat(v) if isinstance(v, list) else v
+        if self.dist_sync_fn is not None:
+            return {k: self.dist_sync_fn(self._reductions[k], v, axis_name) for k, v in prepped.items()}
+        keys = list(prepped)
+        synced = fused_axis_sync([(self._reductions[k], prepped[k]) for k in keys], axis_name)
+        out = dict(zip(keys, synced))
+        # reference metric.py:249-252: gathered list states stay flattened (cat'd);
+        # tensor states under None arrive stacked (world, ...) — handled by all_gather_stack
+        return out
+
+    def merge_states(self, a: Dict[str, Any], b: Dict[str, Any]) -> Dict[str, Any]:
+        """Pairwise merge of two state pytrees (pure). Sum/min/max/cat are canned;
+        metrics with custom merge semantics override ``_merge_state`` per state."""
+        out: Dict[str, Any] = {}
+        for k in self._defaults:
+            fx = self._reductions[k]
+            va, vb = a[k], b[k]
+            if isinstance(self._defaults[k], list):
+                out[k] = list(va) + list(vb)
+            elif fx == "sum":
+                out[k] = va + vb
+            elif fx == "min":
+                out[k] = jnp.minimum(va, vb)
+            elif fx == "max":
+                out[k] = jnp.maximum(va, vb)
+            elif fx == "cat":
+                out[k] = jnp.concatenate([jnp.atleast_1d(va), jnp.atleast_1d(vb)], axis=0)
+            else:
+                out[k] = self._merge_state(k, va, vb)
+        return out
+
+    def _merge_state(self, name: str, a: Any, b: Any) -> Any:
+        raise MetricsTPUUserError(
+            f"State '{name}' of {type(self).__name__} has a custom/None dist_reduce_fx and no "
+            "_merge_state override; cannot merge pairwise."
+        )
+
+    @property
+    def _states_mergeable(self) -> bool:
+        if self.full_state_update is not None:
+            return not self.full_state_update
+        for k, fx in self._reductions.items():
+            if isinstance(self._defaults[k], list):
+                continue  # lists always merge by extension
+            if fx not in _MERGEABLE_FX and not self._overrides_merge_state():
+                return False
+        return True
+
+    def _overrides_merge_state(self) -> bool:
+        return type(self)._merge_state is not Metric._merge_state
+
+    # ------------------------------------------------------------------ stateful facade
+
+    def _inner_update(self, *args: Any, **kwargs: Any) -> None:
+        """The unwrapped subclass update."""
+        type(self).update(self, *args, **kwargs)
+
+    def _inner_compute(self) -> Any:
+        return type(self).compute(self)
+
+    def _wrap_update(self, update: Callable) -> Callable:
+        @functools.wraps(update)
+        def wrapped_func(*args: Any, **kwargs: Any) -> None:
+            if self._is_synced:
+                raise MetricsTPUUserError(
+                    "The Metric has already been synced. HINT: call unsync() before modifying state."
+                )
+            self._computed = None
+            self._update_called = True
+            update(*args, **kwargs)
+
+        return wrapped_func
+
+    def _wrap_compute(self, compute: Callable) -> Callable:
+        @functools.wraps(compute)
+        def wrapped_func(*args: Any, **kwargs: Any) -> Any:
+            if not self._update_called:
+                rank_zero_warn(
+                    f"The ``compute`` method of metric {type(self).__name__} was called before "
+                    "the ``update`` method which may lead to errors, as metric states have not "
+                    "yet been updated.",
+                    UserWarning,
+                )
+            if self._computed is not None:
+                return self._computed
+            with self.sync_context(
+                dist_sync_fn=self.dist_sync_fn,
+                should_sync=self._to_sync,
+                should_unsync=self._should_unsync,
+            ):
+                value = compute(*args, **kwargs)
+                self._computed = _squeeze_if_scalar(value)
+            return self._computed
+
+        return wrapped_func
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        """Accumulate global state and (optionally) return the batch-local value.
+
+        One ``update`` per call when states merge pairwise (the common case) — the
+        batch value is computed from the fresh state *delta* and the delta merged into
+        the global state (SURVEY.md §7.1; beats reference ``metric.py:206,218`` which
+        runs update twice). Metrics with non-mergeable custom states fall back to the
+        reference's snapshot/restore path.
+        """
+        if self._is_synced:
+            raise MetricsTPUUserError("The Metric shouldn't be synced when performing ``forward``.")
+        if self._states_mergeable:
+            delta = self.update_state(self.init_state(), *args, **kwargs)
+            merged = self.merge_states(self._pack_state(), delta)
+            self._load_state(merged)
+            self._computed = None
+            self._update_called = True
+            if not self.compute_on_step:
+                self._forward_cache = None
+                return None
+            if self.dist_sync_on_step:
+                axis = self.sync_axis or current_metric_axis()
+                delta = self.sync_states(delta, axis)
+            self._forward_cache = self.compute_from(delta)
+            return self._forward_cache
+        # fallback: snapshot global state, compute batch value with a second update
+        self.update(*args, **kwargs)
+        if not self.compute_on_step:
+            self._forward_cache = None
+            return None
+        cache = self._pack_state()
+        in_sync = self.dist_sync_on_step
+        self._to_sync = in_sync
+        self._should_unsync = False
+        self._load_state(self.init_state())
+        self.update(*args, **kwargs)
+        self._forward_cache = self.compute()
+        self._load_state(cache)
+        self._should_unsync = True
+        self._to_sync = True
+        self._computed = None
+        self._is_synced = False
+        return self._forward_cache
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        return self.forward(*args, **kwargs)
+
+    def reset(self) -> None:
+        """Reset state to defaults. Parity: reference ``metric.py:397-418``."""
+        self._update_called = False
+        self._forward_cache = None
+        self._computed = None
+        self._load_state(self.init_state())
+        self._is_synced = False
+        self._cache = None
+
+    # ----------------------------------------------------------------------- eager sync
+
+    def sync(
+        self,
+        dist_sync_fn: Optional[Callable] = None,
+        should_sync: bool = True,
+        distributed_available_fn: Optional[Callable] = None,
+    ) -> None:
+        """Eagerly replace local state with the cross-process merged state.
+
+        Parity: reference ``metric.py:268-302``. In-trace (inside shard_map) this is a
+        no-op here — sync happens functionally in ``compute_synced``. Eager multi-host
+        sync uses ``jax.experimental.multihost_utils.process_allgather``.
+        """
+        if self._is_synced and should_sync:
+            raise MetricsTPUUserError("The Metric has already been synced.")
+        is_distributed = (
+            distributed_available_fn() if distributed_available_fn is not None else distributed_available()
+        )
+        axis = self.sync_axis or current_metric_axis()
+        in_trace = in_mapped_context(axis)
+        if not should_sync or (not is_distributed and not in_trace):
+            return
+        self._cache = self._pack_state()
+        if in_trace:
+            self._load_state(self.sync_states(self._pack_state(), axis))
+        else:
+            self._load_state(self._multihost_sync(self._pack_state(), dist_sync_fn))
+        self._is_synced = True
+
+    def _multihost_sync(self, state: Dict[str, Any], dist_sync_fn: Optional[Callable]) -> Dict[str, Any]:
+        from jax.experimental import multihost_utils
+
+        out: Dict[str, Any] = {}
+        for k, v in state.items():
+            fx = self._reductions[k]
+            was_list = isinstance(v, list)
+            v = dim_zero_cat(v) if was_list else v
+            gathered = multihost_utils.process_allgather(v)  # (procs, ...)
+            if fx == "sum":
+                merged = jnp.sum(gathered, axis=0)
+            elif fx == "mean":
+                merged = jnp.mean(gathered, axis=0)
+            elif fx == "min":
+                merged = jnp.min(gathered, axis=0)
+            elif fx == "max":
+                merged = jnp.max(gathered, axis=0)
+            elif fx == "cat":
+                merged = jnp.reshape(gathered, (-1,) + gathered.shape[2:])
+            elif fx is None:
+                merged = jnp.reshape(gathered, (-1,) + gathered.shape[2:]) if was_list else gathered
+            elif callable(fx):
+                merged = gathered[0]
+                for i in range(1, gathered.shape[0]):
+                    merged = fx(merged, gathered[i])
+            else:
+                merged = gathered
+            out[k] = [merged] if was_list else merged
+        return out
+
+    def unsync(self, should_unsync: bool = True) -> None:
+        """Restore rank-local state after :meth:`sync`. Parity: ``metric.py:304-324``."""
+        if not should_unsync:
+            return
+        if not self._is_synced:
+            raise MetricsTPUUserError("The Metric has already been un-synced.")
+        if self._cache is None:
+            raise MetricsTPUUserError("The internal cache should exist to unsync the Metric.")
+        self._load_state(self._cache)
+        self._is_synced = False
+        self._cache = None
+
+    def sync_context(
+        self,
+        dist_sync_fn: Optional[Callable] = None,
+        should_sync: bool = True,
+        should_unsync: bool = True,
+        distributed_available_fn: Optional[Callable] = None,
+    ):
+        """Context manager: synced state inside, local state restored on exit."""
+        metric = self
+
+        class _Ctx:
+            def __enter__(self):
+                metric.sync(
+                    dist_sync_fn=dist_sync_fn,
+                    should_sync=should_sync,
+                    distributed_available_fn=distributed_available_fn,
+                )
+                return metric
+
+            def __exit__(self, *exc):
+                metric.unsync(should_unsync=metric._is_synced and should_unsync)
+                return False
+
+        return _Ctx()
+
+    # ---------------------------------------------------------------- misc protocol bits
+
+    def persistent(self, mode: bool = False) -> None:
+        for k in self._persistent:
+            self._persistent[k] = mode
+
+    def state_dict(self, prefix: str = "") -> Dict[str, Any]:
+        """Serializable snapshot of persistent states (as numpy). Parity: metric.py:514."""
+        out = {}
+        for k in self._defaults:
+            if not self._persistent[k]:
+                continue
+            v = getattr(self, k)
+            if isinstance(v, list):
+                out[prefix + k] = [np.asarray(x) for x in v]
+            else:
+                out[prefix + k] = np.asarray(v)
+        return out
+
+    def load_state_dict(self, state_dict: Dict[str, Any], prefix: str = "") -> None:
+        for k in self._defaults:
+            key = prefix + k
+            if key in state_dict:
+                v = state_dict[key]
+                if isinstance(v, list):
+                    setattr(self, k, [jnp.asarray(x) for x in v])
+                else:
+                    setattr(self, k, jnp.asarray(v))
+
+    def clone(self) -> "Metric":
+        return deepcopy(self)
+
+    def to_device(self, device) -> "Metric":
+        """Move all states to ``device`` (or apply a ``Sharding``)."""
+        for k in self._defaults:
+            v = getattr(self, k)
+            if isinstance(v, list):
+                setattr(self, k, [jax.device_put(x, device) for x in v])
+            else:
+                setattr(self, k, jax.device_put(v, device))
+        return self
+
+    def astype(self, dtype) -> "Metric":
+        """Cast floating-point states. Analogue of reference half()/float()/double()."""
+        for k in self._defaults:
+            v = getattr(self, k)
+            if isinstance(v, list):
+                setattr(self, k, [x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x for x in v])
+            elif jnp.issubdtype(v.dtype, jnp.floating):
+                setattr(self, k, v.astype(dtype))
+        return self
+
+    def _filter_kwargs(self, **kwargs: Any) -> Dict[str, Any]:
+        """Keep only kwargs the (unwrapped) update accepts. Parity: metric.py:554-574."""
+        sig = inspect.signature(type(self).update)
+        params = sig.parameters
+        has_var_kw = any(p.kind == inspect.Parameter.VAR_KEYWORD for p in params.values())
+        if has_var_kw:
+            return kwargs
+        return {
+            k: v
+            for k, v in kwargs.items()
+            if k in params and params[k].kind in (inspect.Parameter.POSITIONAL_OR_KEYWORD, inspect.Parameter.KEYWORD_ONLY)
+        }
+
+    def __getstate__(self) -> Dict[str, Any]:
+        # drop wrapped bound methods (reference metric.py:420-429); numpy-ify states
+        state = self.__dict__.copy()
+        state.pop("update", None)
+        state.pop("compute", None)
+        for k in self._defaults:
+            v = state.get(k)
+            if isinstance(v, jax.Array):
+                state[k] = np.asarray(v)
+            elif isinstance(v, list):
+                state[k] = [np.asarray(x) if isinstance(x, jax.Array) else x for x in v]
+        return state
+
+    def __setstate__(self, state: Dict[str, Any]) -> None:
+        self.__dict__.update(state)
+        for k in self._defaults:
+            v = getattr(self, k, None)
+            if isinstance(v, np.ndarray):
+                setattr(self, k, jnp.asarray(v))
+            elif isinstance(v, list):
+                setattr(self, k, [jnp.asarray(x) if isinstance(x, np.ndarray) else x for x in v])
+        self.update = self._wrap_update(type(self).update.__get__(self))
+        self.compute = self._wrap_compute(type(self).compute.__get__(self))
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        if name in ("higher_is_better", "is_differentiable"):
+            raise RuntimeError(f"Can't change const `{name}`.")
+        object.__setattr__(self, name, value)
+
+    def __hash__(self) -> int:
+        hash_vals = [type(self).__name__, id(self)]
+        return hash(tuple(hash_vals))
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}()"
+
+    # subclass contract ---------------------------------------------------------------
+
+    def update(self, *args: Any, **kwargs: Any) -> None:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def compute(self) -> Any:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    # operator overloads -> CompositionalMetric (reference metric.py:595-698) ----------
+
+    def __add__(self, other): return CompositionalMetric(jnp.add, self, other)
+    def __radd__(self, other): return CompositionalMetric(jnp.add, other, self)
+    def __sub__(self, other): return CompositionalMetric(jnp.subtract, self, other)
+    def __rsub__(self, other): return CompositionalMetric(jnp.subtract, other, self)
+    def __mul__(self, other): return CompositionalMetric(jnp.multiply, self, other)
+    def __rmul__(self, other): return CompositionalMetric(jnp.multiply, other, self)
+    def __truediv__(self, other): return CompositionalMetric(jnp.true_divide, self, other)
+    def __rtruediv__(self, other): return CompositionalMetric(jnp.true_divide, other, self)
+    def __floordiv__(self, other): return CompositionalMetric(jnp.floor_divide, self, other)
+    def __rfloordiv__(self, other): return CompositionalMetric(jnp.floor_divide, other, self)
+    def __mod__(self, other): return CompositionalMetric(jnp.mod, self, other)
+    def __rmod__(self, other): return CompositionalMetric(jnp.mod, other, self)
+    def __pow__(self, other): return CompositionalMetric(jnp.power, self, other)
+    def __rpow__(self, other): return CompositionalMetric(jnp.power, other, self)
+    def __matmul__(self, other): return CompositionalMetric(jnp.matmul, self, other)
+    def __rmatmul__(self, other): return CompositionalMetric(jnp.matmul, other, self)
+    def __and__(self, other): return CompositionalMetric(jnp.bitwise_and, self, other)
+    def __rand__(self, other): return CompositionalMetric(jnp.bitwise_and, other, self)
+    def __or__(self, other): return CompositionalMetric(jnp.bitwise_or, self, other)
+    def __ror__(self, other): return CompositionalMetric(jnp.bitwise_or, other, self)
+    def __xor__(self, other): return CompositionalMetric(jnp.bitwise_xor, self, other)
+    def __rxor__(self, other): return CompositionalMetric(jnp.bitwise_xor, other, self)
+    def __eq__(self, other): return CompositionalMetric(jnp.equal, self, other)
+    def __ne__(self, other): return CompositionalMetric(jnp.not_equal, self, other)
+    def __lt__(self, other): return CompositionalMetric(jnp.less, self, other)
+    def __le__(self, other): return CompositionalMetric(jnp.less_equal, self, other)
+    def __gt__(self, other): return CompositionalMetric(jnp.greater, self, other)
+    def __ge__(self, other): return CompositionalMetric(jnp.greater_equal, self, other)
+    def __abs__(self): return CompositionalMetric(jnp.abs, self, None)
+    def __neg__(self): return CompositionalMetric(_neg, self, None)
+    def __pos__(self): return CompositionalMetric(jnp.abs, self, None)
+    def __invert__(self): return CompositionalMetric(jnp.logical_not, self, None)
+    def __getitem__(self, idx): return CompositionalMetric(lambda x: x[idx], self, None)
+
+
+def _neg(x: Array) -> Array:
+    return -jnp.abs(x)
+
+
+class CompositionalMetric(Metric):
+    """Lazy arithmetic composition of metrics. Parity: reference ``metric.py:705-815``.
+
+    Delegates update/reset to operand metrics; compute applies ``operator`` to operand
+    computes. Has no state of its own, hence no sync (reference ``:737``).
+    """
+
+    def __init__(
+        self,
+        operator: Callable,
+        metric_a: Union[Metric, int, float, Array],
+        metric_b: Union[Metric, int, float, Array, None],
+    ) -> None:
+        super().__init__()
+        self.op = operator
+        self.metric_a = metric_a if isinstance(metric_a, Metric) else (
+            jnp.asarray(metric_a) if metric_a is not None else None)
+        self.metric_b = metric_b if isinstance(metric_b, Metric) else (
+            jnp.asarray(metric_b) if metric_b is not None else None)
+
+    def _sync_dist(self, *args: Any, **kwargs: Any) -> None:
+        pass  # No syncing required here. syncing will be done in metric_a and metric_b
+
+    def sync(self, *args: Any, **kwargs: Any) -> None:
+        pass
+
+    def unsync(self, *args: Any, **kwargs: Any) -> None:
+        pass
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        if isinstance(self.metric_a, Metric):
+            self.metric_a.update(*args, **self.metric_a._filter_kwargs(**kwargs))
+        if isinstance(self.metric_b, Metric):
+            self.metric_b.update(*args, **self.metric_b._filter_kwargs(**kwargs))
+
+    def compute(self) -> Any:
+        val_a = self.metric_a.compute() if isinstance(self.metric_a, Metric) else self.metric_a
+        val_b = self.metric_b.compute() if isinstance(self.metric_b, Metric) else self.metric_b
+        if val_b is None:
+            return self.op(val_a)
+        return self.op(val_a, val_b)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        val_a = (
+            self.metric_a(*args, **self.metric_a._filter_kwargs(**kwargs))
+            if isinstance(self.metric_a, Metric)
+            else self.metric_a
+        )
+        val_b = (
+            self.metric_b(*args, **self.metric_b._filter_kwargs(**kwargs))
+            if isinstance(self.metric_b, Metric)
+            else self.metric_b
+        )
+        if val_a is None:
+            self._forward_cache = None
+        elif val_b is None:
+            if isinstance(self.metric_b, Metric):
+                self._forward_cache = None
+            else:
+                self._forward_cache = self.op(val_a)
+        else:
+            self._forward_cache = self.op(val_a, val_b)
+        return self._forward_cache
+
+    def reset(self) -> None:
+        if isinstance(self.metric_a, Metric):
+            self.metric_a.reset()
+        if isinstance(self.metric_b, Metric):
+            self.metric_b.reset()
+        self._update_called = False
+        self._forward_cache = None
+        self._computed = None
+
+    def persistent(self, mode: bool = False) -> None:
+        if isinstance(self.metric_a, Metric):
+            self.metric_a.persistent(mode=mode)
+        if isinstance(self.metric_b, Metric):
+            self.metric_b.persistent(mode=mode)
+
+    def __repr__(self) -> str:
+        _op_metrics = f"(\n  {self.op.__name__ if hasattr(self.op, '__name__') else 'fn'}(\n    {repr(self.metric_a)},\n    {repr(self.metric_b)}\n  )\n)"
+        return self.__class__.__name__ + _op_metrics

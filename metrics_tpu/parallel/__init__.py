@@ -1,0 +1,39 @@
+"""Distributed communication backend (L0) — JAX collectives over ICI/DCN mesh axes.
+
+Replaces the reference's ``torch.distributed`` layer
+(``torchmetrics/utilities/distributed.py``): instead of NCCL all_gather + barrier per
+state tensor, state merge lowers to ``jax.lax.psum``/``pmin``/``pmax``/``all_gather``
+inside the caller's ``shard_map``/``pjit`` region, and a MetricCollection syncs all its
+counter states in ONE fused bundle.
+"""
+from metrics_tpu.parallel.collectives import (
+    all_gather_cat,
+    all_gather_stack,
+    axis_size_or_one,
+    fused_axis_sync,
+    in_mapped_context,
+    reduce,
+    class_reduce,
+    sync_axis_state,
+)
+from metrics_tpu.parallel.mesh import (
+    MeshConfig,
+    current_metric_axis,
+    metric_axis,
+    set_metric_axis,
+)
+
+__all__ = [
+    "MeshConfig",
+    "all_gather_cat",
+    "all_gather_stack",
+    "axis_size_or_one",
+    "class_reduce",
+    "current_metric_axis",
+    "fused_axis_sync",
+    "in_mapped_context",
+    "metric_axis",
+    "reduce",
+    "set_metric_axis",
+    "sync_axis_state",
+]

@@ -1,0 +1,67 @@
+"""Mesh/axis configuration — the ``process_group`` analogue.
+
+The reference passes a ``process_group`` handle down to ``gather_all_tensors``
+(``torchmetrics/metric.py:88``, ``utilities/distributed.py:96``). On TPU the analogue
+is a *named mesh axis*: metrics synchronise over one axis of a ``jax.sharding.Mesh``
+(usually the data-parallel axis), and "subgroups" are sub-axes of the same mesh.
+
+Two ways to tell a metric its axis:
+  1. explicitly: ``Accuracy(sync_axis="dp")``
+  2. ambiently: ``with metric_axis("dp"): ...`` around the shard_map'd step.
+"""
+import contextlib
+import threading
+from dataclasses import dataclass, field
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+_LOCAL = threading.local()
+
+
+def current_metric_axis() -> Optional[str]:
+    """The ambient sync axis name, if one was set via ``metric_axis``/``set_metric_axis``."""
+    return getattr(_LOCAL, "axis", None)
+
+
+def set_metric_axis(axis: Optional[str]) -> None:
+    _LOCAL.axis = axis
+
+
+@contextlib.contextmanager
+def metric_axis(axis: Optional[str]):
+    """Context manager: all metric syncs inside use collectives over ``axis``."""
+    prev = current_metric_axis()
+    set_metric_axis(axis)
+    try:
+        yield
+    finally:
+        set_metric_axis(prev)
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Declarative mesh description for the metrics runtime.
+
+    ``axis_names``/``shape`` describe the full device mesh; ``sync_axis`` names the
+    axis metric states are reduced over (the DP axis). Build with ``.make_mesh()``.
+    """
+
+    shape: Tuple[int, ...] = (1,)
+    axis_names: Tuple[str, ...] = ("dp",)
+    sync_axis: str = "dp"
+    devices: Optional[Sequence] = field(default=None, compare=False)
+
+    def make_mesh(self) -> jax.sharding.Mesh:
+        devs = self.devices if self.devices is not None else jax.devices()
+        n = int(np.prod(self.shape))
+        if len(devs) < n:
+            raise ValueError(f"mesh shape {self.shape} needs {n} devices, have {len(devs)}")
+        arr = np.asarray(devs[:n]).reshape(self.shape)
+        return jax.sharding.Mesh(arr, self.axis_names)
+
+    @classmethod
+    def data_parallel(cls, n_devices: Optional[int] = None, axis: str = "dp") -> "MeshConfig":
+        n = n_devices if n_devices is not None else len(jax.devices())
+        return cls(shape=(n,), axis_names=(axis,), sync_axis=axis)

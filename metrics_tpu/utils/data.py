@@ -1,0 +1,131 @@
+"""Tensor utilities: dim-0 reductions, onehot/topk conversion, collection mapping.
+
+Parity: reference ``torchmetrics/utilities/data.py:24-248`` (dim_zero_*, to_onehot,
+select_topk, to_categorical, apply_to_collection, get_group_indexes, METRIC_EPS).
+TPU-native notes: everything here is pure jnp and trace-safe except
+``apply_to_collection`` (host-side pytree walk) and ``get_group_indexes`` (returns
+host lists; the traced alternative is segment ops — see
+``metrics_tpu/functional/retrieval``).
+"""
+from typing import Any, Callable, List, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+METRIC_EPS = 1e-6
+
+Array = jax.Array
+
+
+def dim_zero_cat(x: Union[Array, List[Array]]) -> Array:
+    """Concatenate a (possibly list of) array(s) along dim 0."""
+    if isinstance(x, (list, tuple)):
+        if len(x) == 0:
+            return jnp.zeros((0,))
+        x = [jnp.atleast_1d(v) for v in x]
+        return jnp.concatenate(x, axis=0)
+    return x
+
+
+def dim_zero_sum(x: Array) -> Array:
+    return jnp.sum(x, axis=0)
+
+
+def dim_zero_mean(x: Array) -> Array:
+    return jnp.mean(x, axis=0)
+
+
+def dim_zero_max(x: Array) -> Array:
+    return jnp.max(x, axis=0)
+
+
+def dim_zero_min(x: Array) -> Array:
+    return jnp.min(x, axis=0)
+
+
+def to_onehot(label_tensor: Array, num_classes: int) -> Array:
+    """Convert integer labels ``(N, ...)`` to one-hot ``(N, C, ...)``.
+
+    Parity: reference ``utilities/data.py:57-88``. Uses jax.nn.one_hot (lowered to a
+    compare-iota on TPU, no scatter needed).
+    """
+    oh = jax.nn.one_hot(label_tensor, num_classes, dtype=jnp.int32)
+    # one_hot puts the class dim last; reference wants it at dim 1
+    return jnp.moveaxis(oh, -1, 1)
+
+
+def select_topk(prob_tensor: Array, topk: int = 1, dim: int = 1) -> Array:
+    """Binary mask of the top-k entries along ``dim``.
+
+    Parity: reference ``utilities/data.py:91-114``. Implemented with
+    ``jax.lax.top_k`` (TPU-native sort network) + one-hot scatter-free mask.
+    """
+    if topk == 1:  # cheap argmax path
+        idx = jnp.argmax(prob_tensor, axis=dim, keepdims=True)
+        mask = jnp.zeros_like(prob_tensor, dtype=jnp.int32)
+        return jnp.put_along_axis(mask, idx, 1, axis=dim, inplace=False)
+    moved = jnp.moveaxis(prob_tensor, dim, -1)
+    _, idx = jax.lax.top_k(moved, topk)
+    mask = jnp.sum(jax.nn.one_hot(idx, moved.shape[-1], dtype=jnp.int32), axis=-2)
+    return jnp.moveaxis(mask, -1, dim)
+
+
+def to_categorical(tensor: Array, argmax_dim: int = 1) -> Array:
+    """Probabilities/one-hot ``(N, C, ...)`` -> integer labels ``(N, ...)``.
+
+    Parity: reference ``utilities/data.py:117-132``.
+    """
+    return jnp.argmax(tensor, axis=argmax_dim)
+
+
+def apply_to_collection(
+    data: Any,
+    dtype: Union[type, tuple],
+    function: Callable,
+    *args: Any,
+    **kwargs: Any,
+) -> Any:
+    """Recursively apply ``function`` to all ``dtype`` leaves of a collection.
+
+    Parity: reference ``utilities/data.py:166-213``. Host-side only.
+    """
+    if isinstance(data, dtype):
+        return function(data, *args, **kwargs)
+    if isinstance(data, (list, tuple)):
+        out = [apply_to_collection(d, dtype, function, *args, **kwargs) for d in data]
+        return type(data)(out) if isinstance(data, tuple) else out
+    if isinstance(data, dict):
+        return {k: apply_to_collection(v, dtype, function, *args, **kwargs) for k, v in data.items()}
+    return data
+
+
+def get_group_indexes(indexes: Array) -> List[Array]:
+    """Group positions by query id. Host-side; returns a list of index arrays.
+
+    Parity: reference ``utilities/data.py:216-240``. The traced/TPU equivalent used by
+    retrieval compute is ``jax.ops.segment_sum`` over ``indexes`` directly — this helper
+    exists for API parity and eager use.
+    """
+    import numpy as np
+
+    idx = np.asarray(indexes)
+    groups: dict = {}
+    for i, v in enumerate(idx.tolist()):
+        groups.setdefault(v, []).append(i)
+    return [jnp.asarray(v, dtype=jnp.int32) for v in groups.values()]
+
+
+def _flatten(x: Sequence) -> list:
+    return [item for sublist in x for item in sublist]
+
+
+def _bincount(x: Array, minlength: int) -> Array:
+    """Static-length bincount: one-hot matmul-free segment sum (TPU friendly)."""
+    return jnp.bincount(x, length=minlength)
+
+
+def _stable_1d_sort(x: Array, descending: bool = False) -> Tuple[Array, Array]:
+    """Stable sort returning (values, indices)."""
+    key = -x if descending else x
+    idx = jnp.argsort(key, stable=True)
+    return x[idx], idx

@@ -1,0 +1,12 @@
+"""Framework exceptions.
+
+Parity: reference ``torchmetrics/utilities/exceptions.py`` (TorchMetricsUserError).
+"""
+
+
+class MetricsTPUUserError(Exception):
+    """Error raised on illegal use of the metric runtime (protocol violations)."""
+
+
+# Short public alias used throughout the package.
+UserError = MetricsTPUUserError

@@ -1,0 +1,42 @@
+"""Runtime capability probing for optional dependencies.
+
+Parity: reference ``torchmetrics/utilities/imports.py:24-93`` (_module_available +
+_X_AVAILABLE flags gating optional domains). The TPU build gates on the packages baked
+into its own environment (transformers for BERTScore, nltk for ROUGE, etc.); anything
+missing degrades to a clear ImportError at metric construction, never at package import.
+"""
+import importlib.util
+from functools import lru_cache
+
+
+@lru_cache(maxsize=None)
+def _module_available(module_path: str) -> bool:
+    """True if ``module_path`` (dotted) can be imported without importing it."""
+    try:
+        parts = module_path.split(".")
+        probe = parts[0]
+        if importlib.util.find_spec(probe) is None:
+            return False
+        for part in parts[1:]:
+            probe = f"{probe}.{part}"
+            if importlib.util.find_spec(probe) is None:
+                return False
+        return True
+    except (ModuleNotFoundError, ValueError):
+        return False
+
+
+_JAX_AVAILABLE = _module_available("jax")
+_FLAX_AVAILABLE = _module_available("flax")
+_OPTAX_AVAILABLE = _module_available("optax")
+_ORBAX_AVAILABLE = _module_available("orbax.checkpoint")
+_TRANSFORMERS_AVAILABLE = _module_available("transformers")
+_TORCH_AVAILABLE = _module_available("torch")
+_SKLEARN_AVAILABLE = _module_available("sklearn")
+_SCIPY_AVAILABLE = _module_available("scipy")
+_NLTK_AVAILABLE = _module_available("nltk")
+_ROUGE_SCORE_AVAILABLE = _module_available("rouge_score")
+_REGEX_AVAILABLE = _module_available("regex")
+_PESQ_AVAILABLE = _module_available("pesq")
+_PYSTOI_AVAILABLE = _module_available("pystoi")
+_PYCOCOTOOLS_AVAILABLE = _module_available("pycocotools")

@@ -1,0 +1,54 @@
+"""Process-0-gated logging helpers.
+
+Parity: reference ``torchmetrics/utilities/prints.py:21-49`` (rank_zero_warn/info/debug,
+keyed on the LOCAL_RANK env var). TPU-native: keyed on ``jax.process_index()`` when the
+JAX runtime is initialised, falling back to the env var before init.
+"""
+import logging
+import os
+import warnings
+from functools import partial, wraps
+from typing import Any, Callable
+
+log = logging.getLogger("metrics_tpu")
+
+
+def _process_index() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return int(os.environ.get("LOCAL_RANK", os.environ.get("JAX_PROCESS_INDEX", 0)))
+
+
+def rank_zero_only(fn: Callable) -> Callable:
+    """Run ``fn`` only on process 0."""
+
+    @wraps(fn)
+    def wrapped(*args: Any, **kwargs: Any) -> Any:
+        if _process_index() == 0:
+            return fn(*args, **kwargs)
+        return None
+
+    return wrapped
+
+
+@rank_zero_only
+def _warn(*args: Any, **kwargs: Any) -> None:
+    warnings.warn(*args, **kwargs)
+
+
+@rank_zero_only
+def _info(*args: Any, **kwargs: Any) -> None:
+    log.info(*args, **kwargs)
+
+
+@rank_zero_only
+def _debug(*args: Any, **kwargs: Any) -> None:
+    log.debug(*args, **kwargs)
+
+
+rank_zero_warn = partial(_warn)
+rank_zero_info = partial(_info)
+rank_zero_debug = partial(_debug)

@@ -1,0 +1,154 @@
+"""Single-process Metric protocol tests.
+
+Parity: reference ``tests/bases/test_metric.py:30-333`` (add_state validation, reset,
+forward cache, pickling, state_dict, hashing).
+"""
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import Metric
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+from tests.helpers.testers import DummyListMetric, DummyMetric, DummyMetricSum
+
+
+def test_add_state_validation():
+    m = DummyMetric()
+    with pytest.raises(ValueError, match="state variable must be"):
+        m.add_state("bad", [1, 2], "sum")
+    with pytest.raises(ValueError, match="`dist_reduce_fx` must be"):
+        m.add_state("bad", jnp.asarray(0.0), "not-a-reduction")
+    m.add_state("ok_sum", jnp.asarray(0.0), "sum")
+    m.add_state("ok_list", [], "cat")
+    m.add_state("ok_custom", jnp.asarray(0.0), lambda a, b: a + b)
+
+
+def test_update_and_reset():
+    m = DummyMetricSum()
+    m.update(jnp.asarray(1.0))
+    m.update(jnp.asarray(2.0))
+    assert float(m.compute()) == 3.0
+    m.reset()
+    assert float(m.x) == 0.0
+    assert m._computed is None
+    assert not m._update_called
+
+
+def test_compute_cache():
+    m = DummyMetricSum()
+    m.update(jnp.asarray(2.0))
+    assert float(m.compute()) == 2.0
+    # cached until next update
+    assert float(m.compute()) == 2.0
+    m.update(jnp.asarray(1.0))
+    assert float(m.compute()) == 3.0
+
+
+def test_forward_returns_batch_value():
+    m = DummyMetricSum()
+    v1 = m(jnp.asarray(2.5))
+    assert float(v1) == 2.5
+    v2 = m(jnp.asarray(1.5))
+    assert float(v2) == 1.5  # batch-local, not accumulated
+    assert float(m.compute()) == 4.0  # global accumulated
+
+
+def test_forward_compute_on_step_false():
+    m = DummyMetricSum(compute_on_step=False)
+    out = m(jnp.asarray(2.0))
+    assert out is None
+    assert float(m.compute()) == 2.0
+
+
+def test_list_state_accumulates():
+    m = DummyListMetric()
+    m.update(jnp.asarray([1.0, 2.0]))
+    m.update(jnp.asarray([3.0]))
+    out = m.compute()
+    assert len(out) == 2
+    np.testing.assert_allclose(np.concatenate([np.atleast_1d(np.asarray(x)) for x in out]), [1, 2, 3])
+    m.reset()
+    assert m.x == []
+
+
+def test_pickle_roundtrip():
+    m = DummyMetricSum()
+    m.update(jnp.asarray(5.0))
+    m2 = pickle.loads(pickle.dumps(m))
+    assert float(m2.compute()) == 5.0
+    m2.update(jnp.asarray(1.0))
+    assert float(m2.compute()) == 6.0
+    # original untouched
+    assert float(m.compute()) == 5.0
+
+
+def test_state_dict_persistence():
+    m = DummyMetricSum()
+    assert m.state_dict() == {}  # persistent defaults False
+    m.persistent(True)
+    m.update(jnp.asarray(3.0))
+    sd = m.state_dict()
+    assert float(sd["x"]) == 3.0
+    m2 = DummyMetricSum()
+    m2.persistent(True)
+    m2.load_state_dict(sd)
+    assert float(m2.compute()) == 3.0
+
+
+def test_hash_unique_per_instance():
+    a, b = DummyMetric(), DummyMetric()
+    assert hash(a) != hash(b)
+
+
+def test_frozen_class_attrs():
+    m = DummyMetric()
+    with pytest.raises(RuntimeError, match="Can't change const"):
+        m.higher_is_better = True
+    with pytest.raises(RuntimeError, match="Can't change const"):
+        m.is_differentiable = False
+
+
+def test_update_while_synced_raises():
+    m = DummyMetricSum()
+    m.update(jnp.asarray(1.0))
+    m._is_synced = True
+    with pytest.raises(MetricsTPUUserError, match="already been synced"):
+        m.update(jnp.asarray(1.0))
+    m._is_synced = False
+
+
+def test_unsync_without_sync_raises():
+    m = DummyMetricSum()
+    with pytest.raises(MetricsTPUUserError, match="already been un-synced"):
+        m.unsync()
+
+
+def test_functional_state_api():
+    m = DummyMetricSum()
+    s0 = m.init_state()
+    s1 = m.update_state(s0, jnp.asarray(2.0))
+    s2 = m.update_state(s1, jnp.asarray(3.0))
+    assert float(m.compute_from(s2)) == 5.0
+    # facade untouched by functional use
+    assert float(m.x) == 0.0
+    # merge
+    merged = m.merge_states(s1, s2)
+    assert float(m.compute_from(merged)) == 7.0
+
+
+def test_clone_independent():
+    m = DummyMetricSum()
+    m.update(jnp.asarray(2.0))
+    c = m.clone()
+    c.update(jnp.asarray(5.0))
+    assert float(m.compute()) == 2.0
+    assert float(c.compute()) == 7.0
+
+
+def test_astype():
+    m = DummyMetricSum()
+    m.update(jnp.asarray(2.0))
+    m.astype(jnp.bfloat16)
+    assert m.x.dtype == jnp.bfloat16

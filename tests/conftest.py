@@ -1,0 +1,34 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+The TPU analogue of the reference's "Gloo process pool on localhost"
+(``tests/helpers/testers.py:47-59``): multi-device collective behavior is tested
+against 8 virtual CPU devices via ``--xla_force_host_platform_device_count`` —
+N devices on one host, no cluster needed (SURVEY.md §4). Oracles stay
+sklearn/numpy on the host.
+
+NOTE: must run before any backend is initialised. The container's sitecustomize
+registers a TPU ('axon') platform at interpreter start, so we both set the env vars
+and override jax_platforms explicitly.
+"""
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import pytest  # noqa: E402
+
+NUM_DEVICES = 8
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == NUM_DEVICES, f"expected {NUM_DEVICES} cpu devices, got {devs}"
+    return devs

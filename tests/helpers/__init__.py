@@ -1,0 +1,9 @@
+import random
+
+import numpy as np
+
+
+def seed_all(seed: int = 42) -> None:
+    """Parity: reference ``tests/helpers/__init__.py`` seed_all."""
+    random.seed(seed)
+    np.random.seed(seed)
