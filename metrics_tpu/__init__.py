@@ -22,21 +22,43 @@ from metrics_tpu.aggregation import (  # noqa: E402
     MinMetric,
     SumMetric,
 )
+from metrics_tpu.classification import (  # noqa: E402
+    F1,
+    Accuracy,
+    F1Score,
+    FBeta,
+    HammingDistance,
+    Precision,
+    Recall,
+    Specificity,
+    StatScores,
+)
 from metrics_tpu.collections import MetricCollection  # noqa: E402
 from metrics_tpu.metric import CompositionalMetric, Metric  # noqa: E402
 from metrics_tpu.parallel import MeshConfig, metric_axis  # noqa: E402
+from metrics_tpu import functional  # noqa: E402
 
 __all__ = [
+    "Accuracy",
     "BaseAggregator",
     "CatMetric",
     "CompositionalMetric",
+    "F1",
+    "F1Score",
+    "FBeta",
+    "HammingDistance",
     "MaxMetric",
     "MeanMetric",
     "MeshConfig",
     "Metric",
     "MetricCollection",
     "MinMetric",
+    "Precision",
+    "Recall",
+    "Specificity",
+    "StatScores",
     "SumMetric",
+    "functional",
     "metric_axis",
     "__version__",
 ]

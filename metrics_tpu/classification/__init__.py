@@ -1,0 +1,6 @@
+from metrics_tpu.classification.accuracy import Accuracy
+from metrics_tpu.classification.f_beta import F1, F1Score, FBeta
+from metrics_tpu.classification.hamming_distance import HammingDistance
+from metrics_tpu.classification.precision_recall import Precision, Recall
+from metrics_tpu.classification.specificity import Specificity
+from metrics_tpu.classification.stat_scores import StatScores

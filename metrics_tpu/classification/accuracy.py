@@ -1,0 +1,110 @@
+"""Accuracy module metric.
+
+Parity: reference ``torchmetrics/classification/accuracy.py:31-277`` (extends
+StatScores; adds subset-accuracy correct/total counters and per-instance mode
+tracking).
+"""
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.classification.stat_scores import StatScores
+from metrics_tpu.functional.classification.accuracy import (
+    _accuracy_compute,
+    _accuracy_update,
+    _check_subset_validity,
+    _mode,
+    _subset_accuracy_compute,
+    _subset_accuracy_update,
+)
+from metrics_tpu.utils.enums import DataType
+
+Array = jax.Array
+
+
+class Accuracy(StatScores):
+    """Accuracy (micro/macro/weighted/samples, top-k, subset accuracy)."""
+
+    is_differentiable = False
+    higher_is_better = True
+
+    def __init__(
+        self,
+        threshold: float = 0.5,
+        num_classes: Optional[int] = None,
+        average: str = "micro",
+        mdmc_average: Optional[str] = "global",
+        ignore_index: Optional[int] = None,
+        top_k: Optional[int] = None,
+        multiclass: Optional[bool] = None,
+        subset_accuracy: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        allowed_average = ["micro", "macro", "weighted", "samples", "none", None]
+        if average not in allowed_average:
+            raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")
+
+        super().__init__(
+            reduce="macro" if average in ["weighted", "none", None] else average,
+            mdmc_reduce=mdmc_average,
+            threshold=threshold,
+            top_k=top_k,
+            num_classes=num_classes,
+            multiclass=multiclass,
+            ignore_index=ignore_index,
+            **kwargs,
+        )
+        self.average = average
+        self.add_state("correct", default=jnp.asarray(0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+        self.subset_accuracy = subset_accuracy
+        self.mode: Optional[DataType] = None
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Parity: reference ``accuracy.py:218-268``."""
+        mode = _mode(preds, target, self.threshold, self.top_k, self.num_classes, self.multiclass)
+        if not self.mode:
+            self.mode = mode
+        elif self.mode != mode:
+            raise ValueError(f"You can not use {mode} inputs with {self.mode} inputs.")
+
+        if self.subset_accuracy and not _check_subset_validity(self.mode):
+            self.subset_accuracy = False
+
+        if self.subset_accuracy:
+            correct, total = _subset_accuracy_update(preds, target, threshold=self.threshold, top_k=self.top_k)
+            self.correct = self.correct + correct
+            self.total = self.total + total
+        else:
+            tp, fp, tn, fn = _accuracy_update(
+                preds,
+                target,
+                reduce=self.reduce,
+                mdmc_reduce=self.mdmc_reduce,
+                threshold=self.threshold,
+                num_classes=self.num_classes,
+                top_k=self.top_k,
+                multiclass=self.multiclass,
+                ignore_index=self.ignore_index,
+                mode=self.mode,
+            )
+            if not self._list_states:
+                self.tp = self.tp + tp
+                self.fp = self.fp + fp
+                self.tn = self.tn + tn
+                self.fn = self.fn + fn
+            else:
+                self.tp.append(tp)
+                self.fp.append(fp)
+                self.tn.append(tn)
+                self.fn.append(fn)
+
+    def compute(self) -> Array:
+        """Parity: reference ``accuracy.py:270-277``."""
+        if not self.mode:
+            raise RuntimeError("You have to have determined mode.")
+        if self.subset_accuracy:
+            return _subset_accuracy_compute(self.correct, self.total)
+        tp, fp, tn, fn = self._get_final_stats()
+        return _accuracy_compute(tp, fp, tn, fn, self.average, self.mdmc_reduce, self.mode)

@@ -1,0 +1,88 @@
+"""FBeta / F1 module metrics.
+
+Parity: reference ``torchmetrics/classification/f_beta.py:25,178,306`` (FBeta,
+F1Score, deprecated alias F1).
+"""
+from typing import Any, Optional
+
+import jax
+
+from metrics_tpu.classification.stat_scores import StatScores
+from metrics_tpu.functional.classification.f_beta import _fbeta_compute
+from metrics_tpu.utils.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+class FBeta(StatScores):
+    """F-beta score with configurable beta."""
+
+    is_differentiable = False
+    higher_is_better = True
+
+    def __init__(
+        self,
+        num_classes: Optional[int] = None,
+        beta: float = 1.0,
+        threshold: float = 0.5,
+        average: str = "micro",
+        mdmc_average: Optional[str] = None,
+        ignore_index: Optional[int] = None,
+        top_k: Optional[int] = None,
+        multiclass: Optional[bool] = None,
+        **kwargs: Any,
+    ) -> None:
+        self.beta = beta
+        allowed_average = ["micro", "macro", "weighted", "samples", "none", None]
+        if average not in allowed_average:
+            raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")
+        super().__init__(
+            reduce="macro" if average in ["weighted", "none", None] else average,
+            mdmc_reduce=mdmc_average,
+            threshold=threshold,
+            top_k=top_k,
+            num_classes=num_classes,
+            multiclass=multiclass,
+            ignore_index=ignore_index,
+            **kwargs,
+        )
+        self.average = average
+
+    def compute(self) -> Array:
+        tp, fp, tn, fn = self._get_final_stats()
+        return _fbeta_compute(tp, fp, tn, fn, self.beta, self.ignore_index, self.average, self.mdmc_reduce)
+
+
+class F1Score(FBeta):
+    """F1 = F-beta with beta=1.0."""
+
+    def __init__(
+        self,
+        num_classes: Optional[int] = None,
+        threshold: float = 0.5,
+        average: str = "micro",
+        mdmc_average: Optional[str] = None,
+        ignore_index: Optional[int] = None,
+        top_k: Optional[int] = None,
+        multiclass: Optional[bool] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            num_classes=num_classes,
+            beta=1.0,
+            threshold=threshold,
+            average=average,
+            mdmc_average=mdmc_average,
+            ignore_index=ignore_index,
+            top_k=top_k,
+            multiclass=multiclass,
+            **kwargs,
+        )
+
+
+class F1(F1Score):
+    """Deprecated alias of F1Score. Parity: reference ``f_beta.py:306``."""
+
+    def __init__(self, *args: Any, **kwargs: Any) -> None:
+        rank_zero_warn("`F1` was renamed to `F1Score` and it will be removed.", DeprecationWarning)
+        super().__init__(*args, **kwargs)

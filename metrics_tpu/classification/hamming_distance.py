@@ -1,0 +1,37 @@
+"""HammingDistance module metric.
+
+Parity: reference ``torchmetrics/classification/hamming_distance.py:23``.
+"""
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.hamming_distance import (
+    _hamming_distance_compute,
+    _hamming_distance_update,
+)
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class HammingDistance(Metric):
+    """Average Hamming distance (loss) between targets and predictions."""
+
+    is_differentiable = False
+    higher_is_better = False
+
+    def __init__(self, threshold: float = 0.5, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("correct", default=jnp.asarray(0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+        self.threshold = threshold
+
+    def update(self, preds: Array, target: Array) -> None:
+        correct, total = _hamming_distance_update(preds, target, self.threshold)
+        self.correct = self.correct + correct
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        return _hamming_distance_compute(self.correct, self.total)

@@ -1,0 +1,108 @@
+"""StatScores module metric.
+
+Parity: reference ``torchmetrics/classification/stat_scores.py:24-309`` — same
+reduce/mdmc_reduce-dependent state layout: fixed sum-counters for micro/macro with
+global mdmc (→ a single fused psum on sync), cat-lists for samplewise/samples.
+"""
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.stat_scores import _stat_scores_compute, _stat_scores_update
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.data import dim_zero_cat
+from metrics_tpu.utils.enums import AverageMethod, MDMCAverageMethod
+
+Array = jax.Array
+
+
+class StatScores(Metric):
+    """Computes [tp, fp, tn, fn, support] with configurable reduction.
+
+    Args mirror the reference (threshold, top_k, reduce, num_classes, ignore_index,
+    mdmc_reduce, multiclass) plus the runtime kwargs (sync_axis etc.).
+    """
+
+    is_differentiable = False
+    higher_is_better = None
+
+    def __init__(
+        self,
+        threshold: float = 0.5,
+        top_k: Optional[int] = None,
+        reduce: str = "micro",
+        num_classes: Optional[int] = None,
+        ignore_index: Optional[int] = None,
+        mdmc_reduce: Optional[str] = None,
+        multiclass: Optional[bool] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+
+        self.reduce = reduce
+        self.mdmc_reduce = mdmc_reduce
+        self.num_classes = num_classes
+        self.threshold = threshold
+        self.multiclass = multiclass
+        self.ignore_index = ignore_index
+        self.top_k = top_k
+
+        if reduce not in ["micro", "macro", "samples"]:
+            raise ValueError(f"The `reduce` {reduce} is not valid.")
+        if mdmc_reduce not in [None, "samplewise", "global"]:
+            raise ValueError(f"The `mdmc_reduce` {mdmc_reduce} is not valid.")
+        if reduce == "macro" and (not num_classes or num_classes < 1):
+            raise ValueError("When you set `reduce` as 'macro', you have to provide the number of classes.")
+        if num_classes and ignore_index is not None and (not 0 <= ignore_index < num_classes or num_classes == 1):
+            raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {num_classes} classes")
+
+        if mdmc_reduce != "samplewise" and reduce != "samples":
+            zeros_shape = [] if reduce == "micro" else [num_classes]
+            default: Any = jnp.zeros(zeros_shape, dtype=jnp.int32)
+            reduce_fn: Optional[str] = "sum"
+            self._list_states = False
+        else:
+            default = []
+            reduce_fn = "cat"
+            self._list_states = True
+
+        for s in ("tp", "fp", "tn", "fn"):
+            self.add_state(s, default=default if isinstance(default, list) else default, dist_reduce_fx=reduce_fn)
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Update counters from a batch. Parity: reference ``:194-227``."""
+        tp, fp, tn, fn = _stat_scores_update(
+            preds,
+            target,
+            reduce=self.reduce,
+            mdmc_reduce=self.mdmc_reduce,
+            threshold=self.threshold,
+            num_classes=self.num_classes,
+            top_k=self.top_k,
+            multiclass=self.multiclass,
+            ignore_index=self.ignore_index,
+        )
+        if not self._list_states:
+            self.tp = self.tp + tp
+            self.fp = self.fp + fp
+            self.tn = self.tn + tn
+            self.fn = self.fn + fn
+        else:
+            self.tp.append(tp)
+            self.fp.append(fp)
+            self.tn.append(tn)
+            self.fn.append(fn)
+
+    def _get_final_stats(self) -> Tuple[Array, Array, Array, Array]:
+        """Concatenate list states if needed. Parity: reference ``:229-235``."""
+        tp = dim_zero_cat(self.tp) if isinstance(self.tp, list) else self.tp
+        fp = dim_zero_cat(self.fp) if isinstance(self.fp, list) else self.fp
+        tn = dim_zero_cat(self.tn) if isinstance(self.tn, list) else self.tn
+        fn = dim_zero_cat(self.fn) if isinstance(self.fn, list) else self.fn
+        return tp, fp, tn, fn
+
+    def compute(self) -> Array:
+        """Return the [..., 5] stat-score tensor. Parity: reference ``:237-309``."""
+        tp, fp, tn, fn = self._get_final_stats()
+        return _stat_scores_compute(tp, fp, tn, fn)

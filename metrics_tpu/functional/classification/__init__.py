@@ -1,0 +1,6 @@
+from metrics_tpu.functional.classification.accuracy import accuracy
+from metrics_tpu.functional.classification.f_beta import f1, f1_score, fbeta
+from metrics_tpu.functional.classification.hamming_distance import hamming_distance
+from metrics_tpu.functional.classification.precision_recall import precision, precision_recall, recall
+from metrics_tpu.functional.classification.specificity import specificity
+from metrics_tpu.functional.classification.stat_scores import stat_scores

@@ -1,0 +1,171 @@
+"""Accuracy (incl. top-k and subset accuracy).
+
+Parity: reference ``torchmetrics/functional/classification/accuracy.py`` (_mode :29,
+_accuracy_update :64, _accuracy_compute :117, _subset_accuracy_update :207,
+accuracy :259-419). Same average/mdmc_average/subset semantics.
+
+TPU note: the reference drops absent classes with boolean-mask indexing
+(``numerator[~cond]`` — dynamic shapes, jit-hostile). Here absent classes are marked
+with a -1 denominator instead, which ``_reduce_stat_scores`` already treats as
+"ignored" (weight 0, renormalised) — numerically identical, fully static shapes.
+"""
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.stat_scores import _reduce_stat_scores, _stat_scores_update
+from metrics_tpu.utils.checks import _check_classification_inputs, _input_format_classification, _input_squeeze
+from metrics_tpu.utils.enums import AverageMethod, DataType, MDMCAverageMethod
+
+Array = jax.Array
+
+
+def _check_subset_validity(mode: DataType) -> bool:
+    return mode in (DataType.MULTILABEL, DataType.MULTIDIM_MULTICLASS)
+
+
+def _mode(
+    preds: Array,
+    target: Array,
+    threshold: float,
+    top_k: Optional[int],
+    num_classes: Optional[int],
+    multiclass: Optional[bool],
+) -> DataType:
+    return _check_classification_inputs(
+        preds, target, threshold=threshold, top_k=top_k, num_classes=num_classes, multiclass=multiclass
+    )
+
+
+def _accuracy_update(
+    preds: Array,
+    target: Array,
+    reduce: Optional[str],
+    mdmc_reduce: Optional[str],
+    threshold: float,
+    num_classes: Optional[int],
+    top_k: Optional[int],
+    multiclass: Optional[bool],
+    ignore_index: Optional[int],
+    mode: DataType,
+) -> Tuple[Array, Array, Array, Array]:
+    if mode == DataType.MULTILABEL and top_k:
+        raise ValueError("You can not use the `top_k` parameter to calculate accuracy for multi-label inputs.")
+    preds, target = _input_squeeze(jnp.asarray(preds), jnp.asarray(target))
+    return _stat_scores_update(
+        preds,
+        target,
+        reduce=reduce,
+        mdmc_reduce=mdmc_reduce,
+        threshold=threshold,
+        num_classes=num_classes,
+        top_k=top_k,
+        multiclass=multiclass,
+        ignore_index=ignore_index,
+    )
+
+
+def _accuracy_compute(
+    tp: Array,
+    fp: Array,
+    tn: Array,
+    fn: Array,
+    average: Optional[str],
+    mdmc_average: Optional[str],
+    mode: DataType,
+) -> Array:
+    simple_average = (AverageMethod.MICRO, AverageMethod.SAMPLES)
+    if (mode == DataType.BINARY and average in simple_average) or mode == DataType.MULTILABEL:
+        numerator = tp + tn
+        denominator = tp + tn + fp + fn
+    else:
+        numerator = tp
+        denominator = tp + fn
+
+    if average == AverageMethod.MACRO and mdmc_average != MDMCAverageMethod.SAMPLEWISE:
+        # absent classes (tp+fp+fn==0): mark ignored via -1 denominator (static-shape
+        # equivalent of the reference's boolean-mask drop)
+        cond = (tp + fp + fn) == 0
+        numerator = jnp.where(cond, 0, numerator)
+        denominator = jnp.where(cond, -1, denominator)
+
+    if average == AverageMethod.NONE and mdmc_average != MDMCAverageMethod.SAMPLEWISE:
+        meaningless = (tp | fn | fp) == 0
+        numerator = jnp.where(meaningless, -1, numerator)
+        denominator = jnp.where(meaningless, -1, denominator)
+
+    return _reduce_stat_scores(
+        numerator=numerator,
+        denominator=denominator,
+        weights=None if average != AverageMethod.WEIGHTED else (tp + fn),
+        average=average,
+        mdmc_average=mdmc_average,
+    )
+
+
+def _subset_accuracy_update(
+    preds: Array, target: Array, threshold: float, top_k: Optional[int]
+) -> Tuple[Array, Array]:
+    preds, target = _input_squeeze(jnp.asarray(preds), jnp.asarray(target))
+    preds, target, mode = _input_format_classification(preds, target, threshold=threshold, top_k=top_k)
+
+    if mode == DataType.MULTILABEL and top_k:
+        raise ValueError("You can not use the `top_k` parameter to calculate accuracy for multi-label inputs.")
+
+    if mode == DataType.MULTILABEL:
+        correct = jnp.sum(jnp.all(preds == target, axis=1))
+        total = jnp.asarray(target.shape[0])
+    elif mode == DataType.MULTICLASS:
+        correct = jnp.sum(preds * target)
+        total = jnp.sum(target)
+    elif mode == DataType.MULTIDIM_MULTICLASS:
+        sample_correct = jnp.sum(preds * target, axis=(1, 2))
+        correct = jnp.sum(sample_correct == target.shape[2])
+        total = jnp.asarray(target.shape[0])
+    else:
+        correct, total = jnp.asarray(0), jnp.asarray(0)
+    return correct, total
+
+
+def _subset_accuracy_compute(correct: Array, total: Array) -> Array:
+    return correct.astype(jnp.float32) / total
+
+
+def accuracy(
+    preds: Array,
+    target: Array,
+    average: str = "micro",
+    mdmc_average: Optional[str] = "global",
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    subset_accuracy: bool = False,
+    num_classes: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+    ignore_index: Optional[int] = None,
+) -> Array:
+    """Compute accuracy. Parity: reference ``accuracy:259-419``."""
+    allowed_average = ["micro", "macro", "weighted", "samples", "none", None]
+    if average not in allowed_average:
+        raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")
+    if average in ["macro", "weighted", "none", None] and (not num_classes or num_classes < 1):
+        raise ValueError(f"When you set `average` as {average}, you have to provide the number of classes.")
+    allowed_mdmc_average = [None, "samplewise", "global"]
+    if mdmc_average not in allowed_mdmc_average:
+        raise ValueError(f"The `mdmc_average` has to be one of {allowed_mdmc_average}, got {mdmc_average}.")
+    if num_classes and ignore_index is not None and (not 0 <= ignore_index < num_classes or num_classes == 1):
+        raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {num_classes} classes")
+    if top_k is not None and (not isinstance(top_k, int) or top_k <= 0):
+        raise ValueError(f"The `top_k` should be an integer larger than 0, got {top_k}")
+
+    preds, target = _input_squeeze(jnp.asarray(preds), jnp.asarray(target))
+    mode = _mode(preds, target, threshold, top_k, num_classes, multiclass)
+    reduce = "macro" if average in ["weighted", "none", None] else average
+
+    if subset_accuracy and _check_subset_validity(mode):
+        correct, total = _subset_accuracy_update(preds, target, threshold, top_k)
+        return _subset_accuracy_compute(correct, total)
+    tp, fp, tn, fn = _accuracy_update(
+        preds, target, reduce, mdmc_average, threshold, num_classes, top_k, multiclass, ignore_index, mode
+    )
+    return _accuracy_compute(tp, fp, tn, fn, average, mdmc_average, mode)

@@ -1,0 +1,128 @@
+"""F-beta / F1 scores.
+
+Parity: reference ``torchmetrics/functional/classification/f_beta.py``
+(_safe_divide :25, _fbeta_compute :31, fbeta :115, f1 :225). The reference's in-place
+``denom[denom==0]=1`` and boolean-mask drops become ``jnp.where`` masking (static
+shapes, jit-safe, numerically identical).
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.stat_scores import _reduce_stat_scores, _stat_scores_update
+from metrics_tpu.utils.enums import AverageMethod as AvgMethod
+from metrics_tpu.utils.enums import MDMCAverageMethod
+
+Array = jax.Array
+
+
+def _safe_divide(num: Array, denom: Array) -> Array:
+    """Division that treats 0/0 as 0. Parity: reference ``f_beta.py:25-28``."""
+    num = num.astype(jnp.float32) if not jnp.issubdtype(num.dtype, jnp.floating) else num
+    denom = denom.astype(num.dtype)
+    return num / jnp.where(denom == 0.0, 1.0, denom)
+
+
+def _fbeta_compute(
+    tp: Array,
+    fp: Array,
+    tn: Array,
+    fn: Array,
+    beta: float,
+    ignore_index: Optional[int],
+    average: str,
+    mdmc_average: Optional[str],
+) -> Array:
+    if average == AvgMethod.MICRO and mdmc_average != MDMCAverageMethod.SAMPLEWISE:
+        mask = tp >= 0
+        precision = _safe_divide(jnp.sum(jnp.where(mask, tp, 0)).astype(jnp.float32),
+                                 jnp.sum(jnp.where(mask, tp + fp, 0)))
+        recall = _safe_divide(jnp.sum(jnp.where(mask, tp, 0)).astype(jnp.float32),
+                              jnp.sum(jnp.where(mask, tp + fn, 0)))
+    else:
+        precision = _safe_divide(tp.astype(jnp.float32), tp + fp)
+        recall = _safe_divide(tp.astype(jnp.float32), tp + fn)
+
+    num = (1 + beta ** 2) * precision * recall
+    denom = beta ** 2 * precision + recall
+    denom = jnp.where(denom == 0.0, 1.0, denom)
+
+    if average == AvgMethod.NONE and mdmc_average != MDMCAverageMethod.SAMPLEWISE:
+        meaningless = (tp | fn | fp) == 0
+        if ignore_index is not None:
+            meaningless = meaningless | (jnp.arange(meaningless.shape[-1]) == ignore_index)
+        num = jnp.where(meaningless, -1.0, num)
+        denom = jnp.where(meaningless, -1.0, denom)
+    elif ignore_index is not None:
+        if average not in (AvgMethod.MICRO, AvgMethod.SAMPLES) and mdmc_average == MDMCAverageMethod.SAMPLEWISE:
+            num = num.at[..., ignore_index].set(-1.0)
+            denom = denom.at[..., ignore_index].set(-1.0)
+        elif average not in (AvgMethod.MICRO, AvgMethod.SAMPLES):
+            num = num.at[ignore_index].set(-1.0)
+            denom = denom.at[ignore_index].set(-1.0)
+
+    if average == AvgMethod.MACRO and mdmc_average != MDMCAverageMethod.SAMPLEWISE:
+        cond = ((tp + fp + fn) == 0) | ((tp + fp + fn) == -3)
+        num = jnp.where(cond, 0.0, num)
+        denom = jnp.where(cond, -1.0, denom)
+
+    return _reduce_stat_scores(
+        numerator=num,
+        denominator=denom,
+        weights=None if average != AvgMethod.WEIGHTED else (tp + fn),
+        average=average,
+        mdmc_average=mdmc_average,
+    )
+
+
+def fbeta(
+    preds: Array,
+    target: Array,
+    beta: float = 1.0,
+    average: str = "micro",
+    mdmc_average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+) -> Array:
+    """Compute F-beta. Parity: reference ``fbeta:115-222``."""
+    allowed_average = ("micro", "macro", "weighted", "samples", "none", None)
+    if average not in allowed_average:
+        raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")
+    if average in ("macro", "weighted", "none", None) and (not num_classes or num_classes < 1):
+        raise ValueError(f"When you set `average` as {average}, you have to provide the number of classes.")
+    allowed_mdmc_average = (None, "samplewise", "global")
+    if mdmc_average not in allowed_mdmc_average:
+        raise ValueError(f"The `mdmc_average` has to be one of {allowed_mdmc_average}, got {mdmc_average}.")
+    if num_classes and ignore_index is not None and (not 0 <= ignore_index < num_classes or num_classes == 1):
+        raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {num_classes} classes")
+
+    reduce = "macro" if average in ("weighted", "none", None) else average
+    tp, fp, tn, fn = _stat_scores_update(
+        preds, target, reduce=reduce, mdmc_reduce=mdmc_average, threshold=threshold,
+        num_classes=num_classes, top_k=top_k, multiclass=multiclass,
+        ignore_index=None if average == AvgMethod.MICRO else ignore_index,
+    )
+    return _fbeta_compute(tp, fp, tn, fn, beta, ignore_index, average, mdmc_average)
+
+
+def f1(
+    preds: Array,
+    target: Array,
+    beta: float = 1.0,
+    average: str = "micro",
+    mdmc_average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+) -> Array:
+    """F1 = F-beta with beta=1. Parity: reference ``f1:225-331``."""
+    return fbeta(preds, target, 1.0, average, mdmc_average, ignore_index, num_classes, threshold, top_k, multiclass)
+
+
+f1_score = f1

@@ -1,0 +1,30 @@
+"""Hamming distance.
+
+Parity: reference ``torchmetrics/functional/classification/hamming_distance.py``
+(_hamming_distance_update :23, _hamming_distance_compute :45, hamming_distance :63).
+"""
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _input_format_classification
+
+Array = jax.Array
+
+
+def _hamming_distance_update(preds: Array, target: Array, threshold: float = 0.5) -> Tuple[Array, int]:
+    preds, target, _ = _input_format_classification(preds, target, threshold=threshold)
+    correct = jnp.sum(preds == target)
+    total = preds.size
+    return correct, total
+
+
+def _hamming_distance_compute(correct: Array, total: Union[int, Array]) -> Array:
+    return 1 - correct.astype(jnp.float32) / total
+
+
+def hamming_distance(preds: Array, target: Array, threshold: float = 0.5) -> Array:
+    """Compute the average Hamming distance / loss. Parity: reference ``:63-107``."""
+    correct, total = _hamming_distance_update(preds, target, threshold)
+    return _hamming_distance_compute(correct, total)

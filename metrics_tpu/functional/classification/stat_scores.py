@@ -1,0 +1,176 @@
+"""True/false positive/negative counting — the backbone of classification metrics.
+
+Parity: reference ``torchmetrics/functional/classification/stat_scores.py``
+(_stat_scores :28, _stat_scores_update :76, _stat_scores_compute :148,
+_reduce_stat_scores :183, stat_scores :240). Same reduce/mdmc_reduce/ignore_index
+semantics and output shapes.
+
+TPU notes: all counting is one fused elementwise+reduce per statistic (XLA fuses the
+compare/multiply/sum chain into a single kernel); the canonical (N, C[, X]) layout
+keeps reductions along contiguous axes. ``ignore_index`` column removal uses static
+slicing (python-int index), so everything traces under jit.
+"""
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _input_format_classification
+from metrics_tpu.utils.enums import AverageMethod, MDMCAverageMethod
+
+Array = jax.Array
+
+
+def _del_column(data: Array, idx: int) -> Array:
+    return jnp.concatenate([data[:, :idx], data[:, idx + 1:]], axis=1)
+
+
+def _stat_scores(preds: Array, target: Array, reduce: Optional[str] = "micro") -> Tuple[Array, Array, Array, Array]:
+    """Count tp/fp/tn/fn over canonical (N, C[, X]) binary tensors.
+
+    Output shapes (parity with reference :48-56): (N,C): micro->(), macro->(C,),
+    samples->(N,); (N,C,X): micro->(N,), macro->(N,C), samples->(N,X).
+    """
+    if reduce == "micro":
+        dim = (0, 1) if preds.ndim == 2 else (1, 2)
+    elif reduce == "macro":
+        dim = 0 if preds.ndim == 2 else 2
+    else:  # samples
+        dim = 1
+
+    true_pred = target == preds
+    false_pred = target != preds
+    pos_pred = preds == 1
+    neg_pred = preds == 0
+
+    tp = jnp.sum(true_pred & pos_pred, axis=dim)
+    fp = jnp.sum(false_pred & pos_pred, axis=dim)
+    tn = jnp.sum(true_pred & neg_pred, axis=dim)
+    fn = jnp.sum(false_pred & neg_pred, axis=dim)
+    i64 = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+    return tp.astype(i64), fp.astype(i64), tn.astype(i64), fn.astype(i64)
+
+
+def _stat_scores_update(
+    preds: Array,
+    target: Array,
+    reduce: Optional[str] = "micro",
+    mdmc_reduce: Optional[str] = None,
+    num_classes: Optional[int] = None,
+    top_k: Optional[int] = None,
+    threshold: float = 0.5,
+    multiclass: Optional[bool] = None,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Array, Array]:
+    """Canonicalize inputs and count statistics. Parity: reference ``:76-145``."""
+    preds, target, _ = _input_format_classification(
+        preds, target, threshold=threshold, num_classes=num_classes, multiclass=multiclass, top_k=top_k
+    )
+
+    if ignore_index is not None and not 0 <= ignore_index < preds.shape[1]:
+        raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {preds.shape[1]} classes")
+    if ignore_index is not None and preds.shape[1] == 1:
+        raise ValueError("You can not use `ignore_index` with binary data.")
+
+    if preds.ndim == 3:
+        if not mdmc_reduce:
+            raise ValueError(
+                "When your inputs are multi-dimensional multi-class, you have to set the `mdmc_reduce` parameter"
+            )
+        if mdmc_reduce == "global":
+            preds = jnp.swapaxes(preds, 1, 2).reshape(-1, preds.shape[1])
+            target = jnp.swapaxes(target, 1, 2).reshape(-1, target.shape[1])
+
+    if ignore_index is not None and reduce != "macro":
+        preds = _del_column(preds, ignore_index)
+        target = _del_column(target, ignore_index)
+
+    tp, fp, tn, fn = _stat_scores(preds, target, reduce=reduce)
+
+    if ignore_index is not None and reduce == "macro":
+        tp = tp.at[..., ignore_index].set(-1)
+        fp = fp.at[..., ignore_index].set(-1)
+        tn = tn.at[..., ignore_index].set(-1)
+        fn = fn.at[..., ignore_index].set(-1)
+
+    return tp, fp, tn, fn
+
+
+def _stat_scores_compute(tp: Array, fp: Array, tn: Array, fn: Array) -> Array:
+    """Stack [tp, fp, tn, fn, support] along the last dim. Parity: ``:148-180``."""
+    outputs = jnp.stack([tp, fp, tn, fn, tp + fn], axis=-1)
+    return jnp.where(outputs < 0, -1, outputs)
+
+
+def _reduce_stat_scores(
+    numerator: Array,
+    denominator: Array,
+    weights: Optional[Array],
+    average: Optional[str],
+    mdmc_average: Optional[str],
+    zero_division: int = 0,
+) -> Array:
+    """Weighted num/denom reduction with zero-division and ignored-class (-1) masking.
+
+    Parity: reference ``:183-237``.
+    """
+    numerator = numerator.astype(jnp.float32) if not jnp.issubdtype(numerator.dtype, jnp.floating) else numerator
+    denominator = denominator.astype(numerator.dtype) if not jnp.issubdtype(denominator.dtype, jnp.floating) else denominator
+    zero_div_mask = denominator == 0
+    ignore_mask = denominator < 0
+
+    weights = jnp.ones_like(denominator) if weights is None else weights.astype(denominator.dtype)
+    numerator = jnp.where(zero_div_mask, float(zero_division), numerator)
+    denominator = jnp.where(zero_div_mask | ignore_mask, 1.0, denominator)
+    weights = jnp.where(ignore_mask, 0.0, weights)
+
+    if average not in (AverageMethod.MICRO, AverageMethod.NONE, None):
+        weights = weights / jnp.sum(weights, axis=-1, keepdims=True)
+
+    scores = weights * (numerator / denominator)
+    scores = jnp.where(jnp.isnan(scores), float(zero_division), scores)
+
+    if mdmc_average == MDMCAverageMethod.SAMPLEWISE:
+        scores = jnp.mean(scores, axis=0)
+        ignore_mask = jnp.sum(ignore_mask, axis=0).astype(bool)
+
+    if average in (AverageMethod.NONE, None):
+        scores = jnp.where(ignore_mask, jnp.nan, scores)
+    else:
+        scores = jnp.sum(scores)
+    return scores
+
+
+def stat_scores(
+    preds: Array,
+    target: Array,
+    reduce: str = "micro",
+    mdmc_reduce: Optional[str] = None,
+    num_classes: Optional[int] = None,
+    top_k: Optional[int] = None,
+    threshold: float = 0.5,
+    multiclass: Optional[bool] = None,
+    ignore_index: Optional[int] = None,
+) -> Array:
+    """Compute [tp, fp, tn, fn, support]. Parity: reference ``stat_scores:240-397``."""
+    if reduce not in ["micro", "macro", "samples"]:
+        raise ValueError(f"The `reduce` {reduce} is not valid.")
+    if mdmc_reduce not in [None, "samplewise", "global"]:
+        raise ValueError(f"The `mdmc_reduce` {mdmc_reduce} is not valid.")
+    if reduce == "macro" and (not num_classes or num_classes < 1):
+        raise ValueError("When you set `reduce` as 'macro', you have to provide the number of classes.")
+    if num_classes and ignore_index is not None and (not 0 <= ignore_index < num_classes or num_classes == 1):
+        raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {num_classes} classes")
+
+    tp, fp, tn, fn = _stat_scores_update(
+        preds,
+        target,
+        reduce=reduce,
+        mdmc_reduce=mdmc_reduce,
+        top_k=top_k,
+        threshold=threshold,
+        num_classes=num_classes,
+        multiclass=multiclass,
+        ignore_index=ignore_index,
+    )
+    return _stat_scores_compute(tp, fp, tn, fn)

@@ -1,0 +1,328 @@
+"""Input-format & validation layer (L2) for classification inputs.
+
+Parity: reference ``torchmetrics/utilities/checks.py:23-432``
+(_input_format_classification :296, _check_classification_inputs :190,
+_basic_input_validation :29, _check_shape_and_type_consistency :51, retrieval checks
+:484-562). Same 6-way case taxonomy and identical canonical output contract: binary
+``(N, C)``/``(N, C, X)`` int tensors + the inferred DataType.
+
+TPU-native split (SURVEY.md §7.1): shape/dtype-driven branching resolves at **trace
+time** (shapes are static under jit); value-dependent validation (``target.max() > 1``
+etc.) runs only eagerly — inside jit it is skipped, and anything that *needs* a value
+(inferring ``num_classes`` from ``target.max()``) raises a clear error asking for the
+static argument instead.
+"""
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.utils.data import select_topk, to_onehot
+from metrics_tpu.utils.enums import DataType
+
+
+def _is_tracer(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def _is_floating(x) -> bool:
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+
+
+def _check_same_shape(preds, target) -> None:
+    if jnp.shape(preds) != jnp.shape(target):
+        raise RuntimeError(
+            "Predictions and targets are expected to have the same shape, "
+            f"got {jnp.shape(preds)} and {jnp.shape(target)}."
+        )
+
+
+def _basic_input_validation(preds, target, threshold: float, multiclass: Optional[bool]) -> None:
+    """Value-dependent sanity checks — eager path only (skipped under trace)."""
+    if _is_floating(target):
+        raise ValueError("The `target` has to be an integer tensor.")
+    if _is_tracer(preds) or _is_tracer(target):
+        return
+    if jnp.min(target) < 0:
+        raise ValueError("The `target` has to be a non-negative tensor.")
+    preds_float = _is_floating(preds)
+    if not preds_float and jnp.min(preds) < 0:
+        raise ValueError("If `preds` are integers, they have to be non-negative.")
+    if jnp.shape(preds)[0] != jnp.shape(target)[0]:
+        raise ValueError("The `preds` and `target` should have the same first dimension.")
+    if multiclass is False and jnp.max(target) > 1:
+        raise ValueError("If you set `multiclass=False`, then `target` should not exceed 1.")
+    if multiclass is False and not preds_float and jnp.max(preds) > 1:
+        raise ValueError("If you set `multiclass=False` and `preds` are integers, then `preds` should not exceed 1.")
+
+
+def _check_shape_and_type_consistency(preds, target) -> Tuple[DataType, int]:
+    """Infer the input case from shapes/dtypes only (trace-safe)."""
+    preds_float = _is_floating(preds)
+    p_shape, t_shape = jnp.shape(preds), jnp.shape(target)
+
+    if preds.ndim == target.ndim:
+        if p_shape != t_shape:
+            raise ValueError(
+                "The `preds` and `target` should have the same shape,",
+                f" got `preds` with shape={p_shape} and `target` with shape={t_shape}.",
+            )
+        if preds_float and not _is_tracer(target) and jnp.max(target) > 1:
+            raise ValueError(
+                "If `preds` and `target` are of shape (N, ...) and `preds` are floats, `target` should be binary."
+            )
+        if preds.ndim == 1 and preds_float:
+            case = DataType.BINARY
+        elif preds.ndim == 1 and not preds_float:
+            case = DataType.MULTICLASS
+        elif preds.ndim > 1 and preds_float:
+            case = DataType.MULTILABEL
+        else:
+            case = DataType.MULTIDIM_MULTICLASS
+        implied_classes = int(np.prod(p_shape[1:])) if len(p_shape) > 1 else 1
+    elif preds.ndim == target.ndim + 1:
+        if not preds_float:
+            raise ValueError("If `preds` have one dimension more than `target`, `preds` should be a float tensor.")
+        if p_shape[2:] != t_shape[1:]:
+            raise ValueError(
+                "If `preds` have one dimension more than `target`, the shape of `preds` should be"
+                " (N, C, ...), and the shape of `target` should be (N, ...)."
+            )
+        implied_classes = p_shape[1]
+        case = DataType.MULTICLASS if preds.ndim == 2 else DataType.MULTIDIM_MULTICLASS
+    else:
+        raise ValueError(
+            "Either `preds` and `target` both should have the (same) shape (N, ...), or `target` should be (N, ...)"
+            " and `preds` should be (N, C, ...)."
+        )
+    return case, implied_classes
+
+
+def _check_num_classes_binary(num_classes: int, multiclass: Optional[bool]) -> None:
+    if num_classes > 2:
+        raise ValueError("Your data is binary, but `num_classes` is larger than 2.")
+    if num_classes == 2 and not multiclass:
+        raise ValueError(
+            "Your data is binary and `num_classes=2`, but `multiclass` is not True."
+            " Set it to True if you want to transform binary data to multi-class format."
+        )
+    if num_classes == 1 and multiclass:
+        raise ValueError(
+            "You have binary data and have set `multiclass=True`, but `num_classes` is 1."
+            " Either set `multiclass=None`(default) or set `num_classes=2`"
+            " to transform binary data to multi-class format."
+        )
+
+
+def _check_num_classes_mc(preds, target, num_classes: int, multiclass: Optional[bool], implied_classes: int) -> None:
+    if num_classes == 1 and multiclass is not False:
+        raise ValueError(
+            "You have set `num_classes=1`, but predictions are integers."
+            " If you want to convert (multi-dimensional) multi-class data with 2 classes"
+            " to binary/multi-label, set `multiclass=False`."
+        )
+    if num_classes > 1:
+        if multiclass is False and implied_classes != num_classes:
+            raise ValueError(
+                "You have set `multiclass=False`, but the implied number of classes "
+                " (from shape of inputs) does not match `num_classes`."
+            )
+        if not _is_tracer(target) and num_classes <= int(jnp.max(target)):
+            raise ValueError("The highest label in `target` should be smaller than `num_classes`.")
+        if jnp.shape(preds) != jnp.shape(target) and num_classes != implied_classes:
+            raise ValueError("The size of C dimension of `preds` does not match `num_classes`.")
+
+
+def _check_num_classes_ml(num_classes: int, multiclass: Optional[bool], implied_classes: int) -> None:
+    if multiclass and num_classes != 2:
+        raise ValueError(
+            "Your have set `multiclass=True`, but `num_classes` is not equal to 2."
+            " If you are trying to transform multi-label data to 2 class multi-dimensional"
+            " multi-class, you should set `num_classes` to either 2 or None."
+        )
+    if not multiclass and num_classes != implied_classes:
+        raise ValueError("The implied number of classes (from shape of inputs) does not match num_classes.")
+
+
+def _check_top_k(top_k: int, case: DataType, implied_classes: int, multiclass: Optional[bool], preds_float: bool) -> None:
+    if case == DataType.BINARY:
+        raise ValueError("You can not use `top_k` parameter with binary data.")
+    if not isinstance(top_k, int) or top_k <= 0:
+        raise ValueError("The `top_k` has to be an integer larger than 0.")
+    if not preds_float:
+        raise ValueError("You have set `top_k`, but you do not have probability predictions.")
+    if multiclass is False:
+        raise ValueError("If you set `multiclass=False`, you can not set `top_k`.")
+    if case == DataType.MULTILABEL and multiclass:
+        raise ValueError(
+            "If you want to transform multi-label data to 2 class multi-dimensional"
+            "multi-class data using `multiclass=True`, you can not use `top_k`."
+        )
+    if top_k >= implied_classes:
+        raise ValueError("The `top_k` has to be strictly smaller than the `C` dimension of `preds`.")
+
+
+def _check_classification_inputs(
+    preds,
+    target,
+    threshold: float,
+    num_classes: Optional[int],
+    multiclass: Optional[bool],
+    top_k: Optional[int],
+) -> DataType:
+    """Full input validation; returns the inferred case. Parity: ``checks.py:190-281``."""
+    _basic_input_validation(preds, target, threshold, multiclass)
+    case, implied_classes = _check_shape_and_type_consistency(preds, target)
+
+    if jnp.shape(preds) != jnp.shape(target):
+        if multiclass is False and implied_classes != 2:
+            raise ValueError(
+                "You have set `multiclass=False`, but have more than 2 classes in your data,"
+                " based on the C dimension of `preds`."
+            )
+        if not _is_tracer(target) and int(jnp.max(target)) >= implied_classes:
+            raise ValueError(
+                "The highest label in `target` should be smaller than the size of the `C` dimension of `preds`."
+            )
+
+    if num_classes:
+        if case == DataType.BINARY:
+            _check_num_classes_binary(num_classes, multiclass)
+        elif case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS):
+            _check_num_classes_mc(preds, target, num_classes, multiclass, implied_classes)
+        elif case == DataType.MULTILABEL:
+            _check_num_classes_ml(num_classes, multiclass, implied_classes)
+
+    if top_k is not None:
+        _check_top_k(top_k, case, implied_classes, multiclass, _is_floating(preds))
+
+    return case
+
+
+def _input_squeeze(preds, target):
+    """Remove excess size-1 dims (all but the leading N). Parity: ``checks.py:284-293``."""
+    if jnp.shape(preds)[0] == 1:
+        preds = jnp.expand_dims(jnp.squeeze(preds), 0)
+        target = jnp.expand_dims(jnp.squeeze(target), 0)
+    else:
+        preds, target = jnp.squeeze(preds), jnp.squeeze(target)
+    return preds, target
+
+
+def _input_format_classification(
+    preds,
+    target,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+) -> Tuple[jax.Array, jax.Array, DataType]:
+    """Canonicalize classification inputs to binary ``(N, C)``/``(N, C, X)`` tensors.
+
+    Parity: reference ``checks.py:296-432`` — identical case handling, thresholding,
+    topk selection and one-hot layout. Trace-safe given static ``num_classes`` (needed
+    under jit when labels must be one-hotted; eagerly it is inferred from data like the
+    reference).
+    """
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    preds, target = _input_squeeze(preds, target)
+    if preds.dtype in (jnp.float16, jnp.bfloat16):
+        preds = preds.astype(jnp.float32)
+
+    case = _check_classification_inputs(
+        preds, target, threshold=threshold, num_classes=num_classes, multiclass=multiclass, top_k=top_k
+    )
+
+    if case in (DataType.BINARY, DataType.MULTILABEL) and not top_k:
+        preds = (preds >= threshold).astype(jnp.int32) if _is_floating(preds) else preds
+        num_classes = num_classes if not multiclass else 2
+
+    if case == DataType.MULTILABEL and top_k:
+        preds = select_topk(preds, top_k)
+
+    if case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS) or multiclass:
+        if _is_floating(preds):
+            num_classes = preds.shape[1]
+            preds = select_topk(preds, top_k or 1)
+        else:
+            if not num_classes:
+                if _is_tracer(preds) or _is_tracer(target):
+                    raise ValueError(
+                        "Cannot infer `num_classes` from data inside jit; pass `num_classes` explicitly."
+                    )
+                num_classes = int(max(jnp.max(preds), jnp.max(target))) + 1
+            preds = to_onehot(preds, max(2, num_classes))
+        target = to_onehot(target, max(2, int(num_classes) if num_classes else 2))
+
+        if multiclass is False:
+            preds, target = preds[:, 1, ...], target[:, 1, ...]
+
+    if (case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS) and multiclass is not False) or multiclass:
+        target = target.reshape(target.shape[0], target.shape[1], -1)
+        preds = preds.reshape(preds.shape[0], preds.shape[1], -1)
+    else:
+        target = target.reshape(target.shape[0], -1)
+        preds = preds.reshape(preds.shape[0], -1)
+
+    if preds.ndim > 2 and preds.shape[-1] == 1:
+        preds, target = jnp.squeeze(preds, -1), jnp.squeeze(target, -1)
+
+    return preds.astype(jnp.int32), target.astype(jnp.int32), case
+
+
+def _input_format_classification_one_hot(
+    num_classes: int,
+    preds,
+    target,
+    threshold: float = 0.5,
+    multilabel: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Legacy one-hot transposed format ``(C, N*X)``. Parity: ``checks.py:435-481``."""
+    if preds.ndim == target.ndim + 1:
+        preds = jnp.argmax(preds, axis=1) if not multilabel else preds
+    if preds.ndim == target.ndim and _is_floating(preds):
+        preds = (preds >= threshold).astype(jnp.int32)
+    if preds.ndim == target.ndim and not multilabel:
+        preds = to_onehot(preds, num_classes)
+        target = to_onehot(target, num_classes)
+    elif preds.ndim == target.ndim:
+        # multilabel: (N, C, ...) already
+        pass
+    preds = jnp.moveaxis(preds, 1, 0).reshape(num_classes, -1)
+    target = jnp.moveaxis(target, 1, 0).reshape(num_classes, -1)
+    return preds, target
+
+
+def _check_retrieval_shape(preds, target) -> Tuple[jax.Array, jax.Array]:
+    """Flatten + validate retrieval (preds float, target bool/int) pairs.
+
+    Parity: reference ``checks.py:484-520`` (_check_retrieval_inputs).
+    """
+    if jnp.shape(preds) != jnp.shape(target):
+        raise ValueError("`preds` and `target` must be of the same shape")
+    if preds.ndim == 0:
+        raise ValueError("`preds` and `target` must be non-empty and non-scalar tensors")
+    preds = jnp.ravel(preds).astype(jnp.float32)
+    target = jnp.ravel(target)
+    if not (jnp.issubdtype(target.dtype, jnp.bool_) or jnp.issubdtype(target.dtype, jnp.integer)):
+        raise ValueError("`target` must be a tensor of booleans or integers")
+    if not _is_floating(preds):
+        raise ValueError("`preds` must be a tensor of floats")
+    if not _is_tracer(target) and target.size and int(jnp.max(target)) > 1:
+        raise ValueError("`target` must contain `binary` values")
+    return preds, target.astype(jnp.int32)
+
+
+def _check_retrieval_inputs(
+    indexes, preds, target, ignore_index: Optional[int] = None
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Validate (indexes, preds, target) triplets. Parity: ``checks.py:484-540``."""
+    if jnp.shape(indexes) != jnp.shape(preds) or jnp.shape(preds) != jnp.shape(target):
+        raise ValueError("`indexes`, `preds` and `target` must be of the same shape")
+    if not jnp.issubdtype(jnp.asarray(indexes).dtype, jnp.integer):
+        raise ValueError("`indexes` must be a tensor of long integers")
+    preds, target = _check_retrieval_shape(preds, target)
+    indexes = jnp.ravel(indexes).astype(jnp.int32)
+    return indexes, preds, target

@@ -1,0 +1,78 @@
+"""Pre-seeded random input fixtures covering every classification input case.
+
+Parity: reference ``tests/classification/inputs.py:20-80`` (binary/multilabel/
+multiclass/multidim x prob/logit/label, seed_all(42)).
+"""
+from collections import namedtuple
+
+import numpy as np
+
+from tests.helpers import seed_all
+from tests.helpers.testers import BATCH_SIZE, EXTRA_DIM, NUM_BATCHES, NUM_CLASSES
+
+seed_all(42)
+
+Input = namedtuple("Input", ["preds", "target"])
+
+_input_binary_prob = Input(
+    preds=np.random.rand(NUM_BATCHES, BATCH_SIZE),
+    target=np.random.randint(0, 2, (NUM_BATCHES, BATCH_SIZE)),
+)
+
+_input_binary = Input(
+    preds=np.random.randint(0, 2, (NUM_BATCHES, BATCH_SIZE)),
+    target=np.random.randint(0, 2, (NUM_BATCHES, BATCH_SIZE)),
+)
+
+_input_binary_logits = Input(
+    preds=np.random.randn(NUM_BATCHES, BATCH_SIZE),
+    target=np.random.randint(0, 2, (NUM_BATCHES, BATCH_SIZE)),
+)
+
+_input_multilabel_prob = Input(
+    preds=np.random.rand(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES),
+    target=np.random.randint(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)),
+)
+
+_input_multilabel = Input(
+    preds=np.random.randint(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)),
+    target=np.random.randint(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)),
+)
+
+_input_multilabel_multidim_prob = Input(
+    preds=np.random.rand(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM),
+    target=np.random.randint(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM)),
+)
+
+
+def _softmax(x, axis):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+__mc_prob_preds = _softmax(np.random.rand(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES), axis=-1)
+_input_multiclass_prob = Input(
+    preds=__mc_prob_preds,
+    target=np.random.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE)),
+)
+
+_input_multiclass_logits = Input(
+    preds=np.random.randn(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES),
+    target=np.random.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE)),
+)
+
+_input_multiclass = Input(
+    preds=np.random.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE)),
+    target=np.random.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE)),
+)
+
+__mdmc_prob_preds = _softmax(np.random.rand(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM), axis=2)
+_input_multidim_multiclass_prob = Input(
+    preds=__mdmc_prob_preds,
+    target=np.random.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE, EXTRA_DIM)),
+)
+
+_input_multidim_multiclass = Input(
+    preds=np.random.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE, EXTRA_DIM)),
+    target=np.random.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE, EXTRA_DIM)),
+)

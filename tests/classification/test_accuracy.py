@@ -1,0 +1,104 @@
+"""Accuracy vs sklearn oracle, single- and multi-device.
+
+Parity model: reference ``tests/classification/test_accuracy.py``.
+"""
+import numpy as np
+import pytest
+from sklearn.metrics import accuracy_score as sk_accuracy
+
+from metrics_tpu import Accuracy
+from metrics_tpu.functional import accuracy
+from metrics_tpu.utils.checks import _input_format_classification
+from metrics_tpu.utils.enums import DataType
+from tests.classification.inputs import (
+    _input_binary,
+    _input_binary_prob,
+    _input_multiclass,
+    _input_multiclass_prob,
+    _input_multidim_multiclass,
+    _input_multidim_multiclass_prob,
+    _input_multilabel,
+    _input_multilabel_prob,
+)
+from tests.helpers.testers import THRESHOLD, MetricTester
+
+
+def _sk_accuracy(preds, target, subset_accuracy=False):
+    sk_preds, sk_target, mode = _input_format_classification(preds, target, threshold=THRESHOLD)
+    sk_preds, sk_target = np.asarray(sk_preds), np.asarray(sk_target)
+
+    if mode == DataType.MULTIDIM_MULTICLASS and not subset_accuracy:
+        sk_preds, sk_target = np.transpose(sk_preds, (0, 2, 1)), np.transpose(sk_target, (0, 2, 1))
+        sk_preds = sk_preds.reshape(-1, sk_preds.shape[2])
+        sk_target = sk_target.reshape(-1, sk_target.shape[2])
+    elif mode == DataType.MULTIDIM_MULTICLASS and subset_accuracy:
+        return np.all(sk_preds == sk_target, axis=(1, 2)).mean()
+    elif mode == DataType.MULTILABEL and not subset_accuracy:
+        sk_preds, sk_target = sk_preds.reshape(-1), sk_target.reshape(-1)
+    return sk_accuracy(y_true=sk_target, y_pred=sk_preds)
+
+
+# (inputs, subset_accuracy, extra metric args). Label inputs carry a static
+# num_classes: inferring the class count from data values is impossible under jit
+# (the documented TPU contract; eager inference still works, see the fn tests).
+_cases = [
+    pytest.param(_input_binary_prob, False, {}, id="binary_prob"),
+    pytest.param(_input_binary, False, {"num_classes": 2}, id="binary"),
+    pytest.param(_input_multilabel_prob, False, {}, id="multilabel_prob"),
+    pytest.param(_input_multilabel_prob, True, {}, id="multilabel_prob_subset"),
+    pytest.param(_input_multilabel, False, {"num_classes": 2}, id="multilabel"),
+    pytest.param(_input_multiclass_prob, False, {}, id="multiclass_prob"),
+    pytest.param(_input_multiclass, False, {"num_classes": 5}, id="multiclass"),
+    pytest.param(_input_multidim_multiclass_prob, False, {}, id="mdmc_prob"),
+    pytest.param(_input_multidim_multiclass_prob, True, {}, id="mdmc_prob_subset"),
+    pytest.param(_input_multidim_multiclass, False, {"num_classes": 5}, id="mdmc"),
+]
+
+
+class TestAccuracy(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize("inputs,subset_accuracy,extra", _cases)
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_accuracy_class(self, inputs, subset_accuracy, extra, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=inputs.preds,
+            target=inputs.target,
+            metric_class=Accuracy,
+            sk_metric=lambda p, t: _sk_accuracy(p, t, subset_accuracy),
+            metric_args={"threshold": THRESHOLD, "subset_accuracy": subset_accuracy, **extra},
+        )
+
+    @pytest.mark.parametrize("inputs,subset_accuracy,extra", _cases)
+    def test_accuracy_fn(self, inputs, subset_accuracy, extra):
+        self.run_functional_metric_test(
+            preds=inputs.preds,
+            target=inputs.target,
+            metric_functional=accuracy,
+            sk_metric=lambda p, t: _sk_accuracy(p, t, subset_accuracy),
+            metric_args={"threshold": THRESHOLD, "subset_accuracy": subset_accuracy},
+        )
+
+
+def test_accuracy_topk():
+    import jax.numpy as jnp
+
+    preds = jnp.asarray(
+        [[0.35, 0.4, 0.25], [0.1, 0.5, 0.4], [0.2, 0.1, 0.7], [0.35, 0.4, 0.25], [0.1, 0.5, 0.4], [0.2, 0.1, 0.7]]
+    )
+    target = jnp.asarray([0, 0, 0, 1, 1, 1])
+    assert float(accuracy(preds, target, top_k=2)) == pytest.approx(4 / 6)
+    acc = Accuracy(top_k=2)
+    acc.update(preds, target)
+    assert float(acc.compute()) == pytest.approx(4 / 6)
+
+
+def test_accuracy_ignore_index():
+    import jax.numpy as jnp
+
+    preds = jnp.asarray([0, 1, 1, 2, 2])
+    target = jnp.asarray([0, 1, 2, 1, 2])
+    # ignoring class 2: only indices with target in {0,1} count
+    res = accuracy(preds, target, ignore_index=2, num_classes=3)
+    assert float(res) == pytest.approx(2 / 3)

@@ -1,0 +1,242 @@
+"""Precision / Recall / FBeta / Specificity / StatScores / Hamming vs sklearn.
+
+Parity model: reference ``tests/classification/test_precision_recall.py``,
+``test_f_beta.py``, ``test_specificity.py``, ``test_stat_scores.py``,
+``test_hamming_distance.py`` (condensed matrix).
+"""
+import numpy as np
+import pytest
+from sklearn.metrics import fbeta_score, multilabel_confusion_matrix, precision_score, recall_score
+
+from metrics_tpu import F1Score, FBeta, HammingDistance, Precision, Recall, Specificity, StatScores
+from metrics_tpu.functional import f1, fbeta, hamming_distance, precision, recall, specificity, stat_scores
+from metrics_tpu.utils.checks import _input_format_classification
+from metrics_tpu.utils.data import to_categorical
+from metrics_tpu.utils.enums import DataType
+from tests.classification.inputs import _input_binary_prob, _input_multiclass, _input_multiclass_prob
+from tests.helpers.testers import NUM_CLASSES, THRESHOLD, MetricTester
+
+
+def _canon(preds, target):
+    """Canonical multilabel-indicator matrices — sklearn's multilabel semantics then
+    match the reference's stat-score counting exactly (the reference tests use the
+    same adapter, ``tests/classification/test_precision_recall.py:40-56``)."""
+    p, t, mode = _input_format_classification(preds, target, threshold=THRESHOLD)
+    p, t = np.asarray(p), np.asarray(t)
+    if p.ndim == 3:  # (N, C, X) -> (N*X, C)
+        p = np.moveaxis(p, 1, 2).reshape(-1, p.shape[1])
+        t = np.moveaxis(t, 1, 2).reshape(-1, t.shape[1])
+    return p, t
+
+
+def _avg_for(p, average):
+    # single-column canonical form == the binary case: the metric scores class 1 only
+    if p.shape[1] == 1:
+        return "binary"
+    return None if average in ("none", None) else average
+
+
+def _sk_prec(preds, target, average="micro"):
+    p, t = _canon(preds, target)
+    return precision_score(t.squeeze(), p.squeeze(), average=_avg_for(p, average), zero_division=0)
+
+
+def _sk_recall(preds, target, average="micro"):
+    p, t = _canon(preds, target)
+    return recall_score(t.squeeze(), p.squeeze(), average=_avg_for(p, average), zero_division=0)
+
+
+def _sk_fbeta(preds, target, average="micro", beta=1.0):
+    p, t = _canon(preds, target)
+    return fbeta_score(t.squeeze(), p.squeeze(), beta=beta, average=_avg_for(p, average), zero_division=0)
+
+
+def _sk_specificity(preds, target, average="micro"):
+    p, t = _canon(preds, target)
+    cm = multilabel_confusion_matrix(t, p)
+    tn, fp = cm[:, 0, 0], cm[:, 0, 1]
+    if average == "micro":
+        return tn.sum() / (tn.sum() + fp.sum())
+    scores = tn / np.maximum(tn + fp, 1e-12)
+    if average == "macro":
+        return scores.mean()
+    if average == "weighted":
+        w = tn + fp
+        return (scores * w / w.sum()).sum()
+    return scores
+
+
+def _sk_stat_scores(preds, target, reduce="micro"):
+    p, t = _canon(preds, target)
+    cm = multilabel_confusion_matrix(t, p)
+    tn, fp, fn, tp = cm[:, 0, 0], cm[:, 0, 1], cm[:, 1, 0], cm[:, 1, 1]
+    stats = np.stack([tp, fp, tn, fn, tp + fn], axis=-1)
+    if reduce == "micro":
+        return stats.sum(axis=0)
+    return stats
+
+
+def _sk_hamming(preds, target):
+    p, t = _canon(preds, target)
+    return 1 - (p == t).mean()
+
+
+_inputs = [
+    pytest.param(_input_binary_prob, id="binary_prob"),
+    pytest.param(_input_multiclass_prob, id="mc_prob"),
+    pytest.param(_input_multiclass, id="mc_labels"),
+]
+
+_averages = ["micro", "macro", "weighted", "none"]
+
+
+class TestPrecisionRecallFBeta(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize("inputs", _inputs)
+    @pytest.mark.parametrize("average", _averages)
+    @pytest.mark.parametrize(
+        "metric_class,metric_fn,sk_fn",
+        [
+            (Precision, precision, _sk_prec),
+            (Recall, recall, _sk_recall),
+            (F1Score, f1, _sk_fbeta),
+        ],
+    )
+    def test_class_single(self, inputs, average, metric_class, metric_fn, sk_fn):
+        num_classes = NUM_CLASSES if np.asarray(inputs.preds).ndim > 2 or inputs.preds.dtype.kind == "i" else 1
+        self.run_class_metric_test(
+            ddp=False,
+            preds=inputs.preds,
+            target=inputs.target,
+            metric_class=metric_class,
+            sk_metric=lambda p, t: sk_fn(p, t, average),
+            metric_args={"average": average, "num_classes": num_classes if average != "micro" else num_classes,
+                         "threshold": THRESHOLD},
+            check_batch=False,
+        )
+
+    @pytest.mark.parametrize("inputs", _inputs)
+    @pytest.mark.parametrize("average", ["micro", "macro"])
+    @pytest.mark.parametrize(
+        "metric_class,metric_fn,sk_fn",
+        [
+            (Precision, precision, _sk_prec),
+            (Recall, recall, _sk_recall),
+        ],
+    )
+    def test_class_ddp(self, inputs, average, metric_class, metric_fn, sk_fn):
+        num_classes = NUM_CLASSES if np.asarray(inputs.preds).ndim > 2 or inputs.preds.dtype.kind == "i" else 1
+        extra = {"num_classes": num_classes} if (average != "micro" or inputs.preds.dtype.kind == "i") else {}
+        if inputs.preds.dtype.kind == "i":
+            extra["num_classes"] = NUM_CLASSES
+        elif average != "micro":
+            extra["num_classes"] = num_classes
+        self.run_class_metric_test(
+            ddp=True,
+            preds=inputs.preds,
+            target=inputs.target,
+            metric_class=metric_class,
+            sk_metric=lambda p, t: sk_fn(p, t, average),
+            metric_args={"average": average, "threshold": THRESHOLD, **extra},
+        )
+
+    @pytest.mark.parametrize("inputs", _inputs)
+    @pytest.mark.parametrize("average", _averages)
+    def test_fn_precision_recall(self, inputs, average):
+        num_classes = NUM_CLASSES if np.asarray(inputs.preds).ndim > 2 or inputs.preds.dtype.kind == "i" else 1
+        args = {"average": average, "threshold": THRESHOLD}
+        if average != "micro" or inputs.preds.dtype.kind == "i":
+            args["num_classes"] = num_classes
+        self.run_functional_metric_test(
+            preds=inputs.preds, target=inputs.target, metric_functional=precision,
+            sk_metric=lambda p, t: _sk_prec(p, t, average), metric_args=args,
+        )
+        self.run_functional_metric_test(
+            preds=inputs.preds, target=inputs.target, metric_functional=recall,
+            sk_metric=lambda p, t: _sk_recall(p, t, average), metric_args=args,
+        )
+
+    @pytest.mark.parametrize("average", ["micro", "macro", "weighted"])
+    @pytest.mark.parametrize("beta", [0.5, 2.0])
+    def test_fn_fbeta(self, average, beta):
+        args = {"average": average, "threshold": THRESHOLD, "beta": beta, "num_classes": NUM_CLASSES}
+        self.run_functional_metric_test(
+            preds=_input_multiclass_prob.preds, target=_input_multiclass_prob.target, metric_functional=fbeta,
+            sk_metric=lambda p, t: _sk_fbeta(p, t, average, beta), metric_args=args,
+        )
+
+
+class TestSpecificity(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize("average", ["micro", "macro", "weighted"])
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, average, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_input_multiclass_prob.preds,
+            target=_input_multiclass_prob.target,
+            metric_class=Specificity,
+            sk_metric=lambda p, t: _sk_specificity(p, t, average),
+            metric_args={"average": average, "num_classes": NUM_CLASSES},
+            check_batch=False,
+        )
+
+    def test_fn(self):
+        self.run_functional_metric_test(
+            preds=_input_multiclass_prob.preds,
+            target=_input_multiclass_prob.target,
+            metric_functional=specificity,
+            sk_metric=lambda p, t: _sk_specificity(p, t, "micro"),
+            metric_args={"average": "micro"},
+        )
+
+
+class TestStatScores(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize("reduce", ["micro", "macro"])
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, reduce, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_input_multiclass_prob.preds,
+            target=_input_multiclass_prob.target,
+            metric_class=StatScores,
+            sk_metric=lambda p, t: _sk_stat_scores(p, t, reduce),
+            metric_args={"reduce": reduce, "num_classes": NUM_CLASSES if reduce == "macro" else None},
+            check_batch=False,
+        )
+
+    def test_fn(self):
+        self.run_functional_metric_test(
+            preds=_input_multiclass_prob.preds,
+            target=_input_multiclass_prob.target,
+            metric_functional=stat_scores,
+            sk_metric=lambda p, t: _sk_stat_scores(p, t, "micro"),
+            metric_args={"reduce": "micro"},
+        )
+
+
+class TestHamming(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_input_binary_prob.preds,
+            target=_input_binary_prob.target,
+            metric_class=HammingDistance,
+            sk_metric=_sk_hamming,
+            metric_args={"threshold": THRESHOLD},
+        )
+
+    def test_fn(self):
+        self.run_functional_metric_test(
+            preds=_input_binary_prob.preds,
+            target=_input_binary_prob.target,
+            metric_functional=hamming_distance,
+            sk_metric=_sk_hamming,
+        )
