@@ -23,13 +23,31 @@ from metrics_tpu.aggregation import (  # noqa: E402
     SumMetric,
 )
 from metrics_tpu.classification import (  # noqa: E402
+    AUC,
+    AUROC,
     F1,
     Accuracy,
+    AveragePrecision,
+    BinnedAveragePrecision,
+    BinnedPrecisionRecallCurve,
+    BinnedRecallAtFixedPrecision,
+    CalibrationError,
+    CohenKappa,
+    ConfusionMatrix,
     F1Score,
     FBeta,
     HammingDistance,
+    Hinge,
+    HingeLoss,
+    IoU,
+    JaccardIndex,
+    KLDivergence,
+    MatthewsCorrcoef,
+    MatthewsCorrCoef,
     Precision,
+    PrecisionRecallCurve,
     Recall,
+    ROC,
     Specificity,
     StatScores,
 )
@@ -39,14 +57,30 @@ from metrics_tpu.parallel import MeshConfig, metric_axis  # noqa: E402
 from metrics_tpu import functional  # noqa: E402
 
 __all__ = [
+    "AUC",
+    "AUROC",
     "Accuracy",
+    "AveragePrecision",
     "BaseAggregator",
+    "BinnedAveragePrecision",
+    "BinnedPrecisionRecallCurve",
+    "BinnedRecallAtFixedPrecision",
+    "CalibrationError",
     "CatMetric",
+    "CohenKappa",
     "CompositionalMetric",
+    "ConfusionMatrix",
     "F1",
     "F1Score",
     "FBeta",
     "HammingDistance",
+    "Hinge",
+    "HingeLoss",
+    "IoU",
+    "JaccardIndex",
+    "KLDivergence",
+    "MatthewsCorrCoef",
+    "MatthewsCorrcoef",
     "MaxMetric",
     "MeanMetric",
     "MeshConfig",
@@ -54,6 +88,8 @@ __all__ = [
     "MetricCollection",
     "MinMetric",
     "Precision",
+    "PrecisionRecallCurve",
+    "ROC",
     "Recall",
     "Specificity",
     "StatScores",

@@ -1,6 +1,23 @@
 from metrics_tpu.classification.accuracy import Accuracy
+from metrics_tpu.classification.auc import AUC
+from metrics_tpu.classification.auroc import AUROC
+from metrics_tpu.classification.avg_precision import AveragePrecision
+from metrics_tpu.classification.binned_precision_recall import (
+    BinnedAveragePrecision,
+    BinnedPrecisionRecallCurve,
+    BinnedRecallAtFixedPrecision,
+)
+from metrics_tpu.classification.calibration_error import CalibrationError
+from metrics_tpu.classification.cohen_kappa import CohenKappa
+from metrics_tpu.classification.confusion_matrix import ConfusionMatrix
 from metrics_tpu.classification.f_beta import F1, F1Score, FBeta
 from metrics_tpu.classification.hamming_distance import HammingDistance
+from metrics_tpu.classification.hinge import Hinge, HingeLoss
+from metrics_tpu.classification.jaccard import IoU, JaccardIndex
+from metrics_tpu.classification.kl_divergence import KLDivergence
+from metrics_tpu.classification.matthews_corrcoef import MatthewsCorrcoef, MatthewsCorrCoef
 from metrics_tpu.classification.precision_recall import Precision, Recall
+from metrics_tpu.classification.precision_recall_curve import PrecisionRecallCurve
+from metrics_tpu.classification.roc import ROC
 from metrics_tpu.classification.specificity import Specificity
 from metrics_tpu.classification.stat_scores import StatScores

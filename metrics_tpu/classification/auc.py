@@ -1,0 +1,36 @@
+"""AUC module metric (generic trapezoidal area under x/y points).
+
+Parity: reference ``torchmetrics/classification/auc.py:24``.
+"""
+from typing import Any
+
+import jax
+
+from metrics_tpu.functional.classification.auc import _auc_compute, _auc_update
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class AUC(Metric):
+    """Area under any curve given (x, y) points."""
+
+    is_differentiable = False
+    higher_is_better = None
+
+    def __init__(self, reorder: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.reorder = reorder
+        self.add_state("x", default=[], dist_reduce_fx="cat")
+        self.add_state("y", default=[], dist_reduce_fx="cat")
+
+    def update(self, x: Array, y: Array) -> None:
+        x, y = _auc_update(x, y)
+        self.x.append(x)
+        self.y.append(y)
+
+    def compute(self) -> Array:
+        x = dim_zero_cat(self.x)
+        y = dim_zero_cat(self.y)
+        return _auc_compute(x, y, reorder=self.reorder)

@@ -1,0 +1,156 @@
+"""Binned (constant-memory, static-shape) precision-recall curve metrics.
+
+Parity: reference ``torchmetrics/classification/binned_precision_recall.py``
+(_recall_at_precision :24, BinnedPrecisionRecallCurve :45 with states :147-152,
+BinnedAveragePrecision :191, BinnedRecallAtFixedPrecision :245).
+
+This family is the **TPU-native template for curve metrics** (SURVEY.md §7.1): states
+are fixed ``(C, T)`` counters with sum-reduce, so the whole update/compute/sync path
+is jit/scan/shard_map-safe with one psum — unlike the exact curve metrics whose
+gathered cat-state has data-dependent length. The reference iterates one threshold at
+a time "to conserve memory" (``:169-174``); here the threshold comparison is one
+broadcasted ``(N, C, T)`` fused kernel — XLA fuses compare+mask+reduce, and HBM cost
+is the output ``(C, T)`` only.
+
+Deviation from the reference: ``thresholds`` defaults to 100 bins (the reference has
+no default and crashes with ``thresholds=None``).
+"""
+from typing import Any, List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.average_precision import (
+    _average_precision_compute_with_precision_recall,
+)
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.data import METRIC_EPS, to_onehot
+
+Array = jax.Array
+
+
+def _recall_at_precision(
+    precision: Array, recall: Array, thresholds: Array, min_precision: float
+) -> Tuple[Array, Array]:
+    """Max recall subject to precision >= min_precision (vectorized, static-shape).
+
+    Parity: reference ``:24-42`` (which iterates ``zip(precision, recall,
+    thresholds)`` — i.e. only the first ``len(thresholds)`` curve points count).
+    """
+    n = thresholds.shape[0]
+    p, r = precision[:n], recall[:n]
+    valid = p >= min_precision
+    masked_recall = jnp.where(valid, r, -jnp.inf)
+    # max() tie-break in the reference picks the max (r, p, t) tuple: highest recall,
+    # then highest precision, then highest threshold
+    best_r = jnp.max(masked_recall)
+    tie = masked_recall == best_r
+    masked_p = jnp.where(tie, p, -jnp.inf)
+    best_p = jnp.max(masked_p)
+    tie2 = tie & (masked_p == best_p)
+    best_t = jnp.max(jnp.where(tie2, thresholds, -jnp.inf))
+    any_valid = jnp.any(valid)
+    max_recall = jnp.where(any_valid, best_r, 0.0)
+    best_threshold = jnp.where(any_valid, best_t, 0.0)
+    best_threshold = jnp.where(max_recall == 0.0, 1e6, best_threshold)
+    return max_recall, best_threshold
+
+
+class BinnedPrecisionRecallCurve(Metric):
+    """Precision-recall pairs at T fixed thresholds; states are (C, T) sum counters."""
+
+    is_differentiable = False
+    higher_is_better = None
+
+    TPs: Array
+    FPs: Array
+    FNs: Array
+
+    def __init__(
+        self,
+        num_classes: int,
+        thresholds: Union[int, Array, List[float]] = 100,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        if isinstance(thresholds, int):
+            self.num_thresholds = thresholds
+            self.thresholds = jnp.linspace(0, 1.0, thresholds)
+        elif thresholds is not None:
+            if not isinstance(thresholds, (list, jax.Array)):
+                raise ValueError("Expected argument `thresholds` to either be an integer, list of floats or a tensor")
+            self.thresholds = jnp.asarray(thresholds)
+            self.num_thresholds = self.thresholds.size
+        else:
+            raise ValueError("Expected argument `thresholds` to either be an integer, list of floats or a tensor")
+
+        for name in ("TPs", "FPs", "FNs"):
+            self.add_state(
+                name=name,
+                default=jnp.zeros((num_classes, self.num_thresholds), dtype=jnp.float32),
+                dist_reduce_fx="sum",
+            )
+
+    def update(self, preds: Array, target: Array) -> None:
+        """preds (N,) or (N, C) probabilities; target (N,) labels or (N, C) binary."""
+        preds = jnp.asarray(preds)
+        target = jnp.asarray(target)
+        if preds.ndim == target.ndim == 1:
+            preds = preds.reshape(-1, 1)
+            target = target.reshape(-1, 1)
+        if preds.ndim == target.ndim + 1:
+            target = to_onehot(target, num_classes=self.num_classes)
+        target = (target == 1)[:, :, None]  # (N, C, 1)
+        predictions = preds[:, :, None] >= self.thresholds[None, None, :]  # (N, C, T)
+        self.TPs = self.TPs + jnp.sum(target & predictions, axis=0)
+        self.FPs = self.FPs + jnp.sum(~target & predictions, axis=0)
+        self.FNs = self.FNs + jnp.sum(target & ~predictions, axis=0)
+
+    def compute(self) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+        precisions = (self.TPs + METRIC_EPS) / (self.TPs + self.FPs + METRIC_EPS)
+        recalls = self.TPs / (self.TPs + self.FNs + METRIC_EPS)
+        t_ones = jnp.ones((self.num_classes, 1), dtype=precisions.dtype)
+        precisions = jnp.concatenate([precisions, t_ones], axis=1)
+        t_zeros = jnp.zeros((self.num_classes, 1), dtype=recalls.dtype)
+        recalls = jnp.concatenate([recalls, t_zeros], axis=1)
+        if self.num_classes == 1:
+            return precisions[0, :], recalls[0, :], self.thresholds
+        return list(precisions), list(recalls), [self.thresholds for _ in range(self.num_classes)]
+
+
+class BinnedAveragePrecision(BinnedPrecisionRecallCurve):
+    """Average precision summarised from the binned curve. Parity: reference ``:191``."""
+
+    def compute(self) -> Union[List[Array], Array]:
+        precisions, recalls, _ = super().compute()
+        return _average_precision_compute_with_precision_recall(
+            precisions, recalls, self.num_classes, average=None
+        )
+
+
+class BinnedRecallAtFixedPrecision(BinnedPrecisionRecallCurve):
+    """Highest recall subject to a minimum precision. Parity: reference ``:245``."""
+
+    def __init__(
+        self,
+        num_classes: int,
+        min_precision: float,
+        thresholds: Union[int, Array, List[float]] = 100,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(num_classes=num_classes, thresholds=thresholds, **kwargs)
+        self.min_precision = min_precision
+
+    def compute(self) -> Tuple[Array, Array]:
+        """Returns (max_recall, best_threshold) per class (scalars for binary)."""
+        precisions, recalls, thresholds = super().compute()
+        if self.num_classes == 1:
+            return _recall_at_precision(precisions, recalls, thresholds, self.min_precision)
+        recalls_at_p = jnp.zeros(self.num_classes, dtype=recalls[0].dtype)
+        thresholds_at_p = jnp.zeros(self.num_classes, dtype=thresholds[0].dtype)
+        for i in range(self.num_classes):
+            r, t = _recall_at_precision(precisions[i], recalls[i], thresholds[i], self.min_precision)
+            recalls_at_p = recalls_at_p.at[i].set(r)
+            thresholds_at_p = thresholds_at_p.at[i].set(t)
+        return recalls_at_p, thresholds_at_p

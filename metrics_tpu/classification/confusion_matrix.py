@@ -1,0 +1,54 @@
+"""ConfusionMatrix module metric.
+
+Parity: reference ``torchmetrics/classification/confusion_matrix.py:23`` (state at
+:122: a (C,C) or (C,2,2) sum counter — a single psum on sync).
+"""
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.confusion_matrix import (
+    _confusion_matrix_compute,
+    _confusion_matrix_update,
+)
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class ConfusionMatrix(Metric):
+    """Confusion matrix with optional true/pred/all normalization."""
+
+    is_differentiable = False
+    higher_is_better = None
+
+    def __init__(
+        self,
+        num_classes: int,
+        normalize: Optional[str] = None,
+        threshold: float = 0.5,
+        multilabel: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        self.normalize = normalize
+        self.threshold = threshold
+        self.multilabel = multilabel
+
+        allowed_normalize = ("true", "pred", "all", "none", None)
+        if normalize not in allowed_normalize:
+            raise ValueError(f"Argument average needs to one of the following: {allowed_normalize}")
+
+        default = jnp.zeros((num_classes, 2, 2), dtype=jnp.int32) if multilabel else jnp.zeros(
+            (num_classes, num_classes), dtype=jnp.int32
+        )
+        self.add_state("confmat", default=default, dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        confmat = _confusion_matrix_update(preds, target, self.num_classes, self.threshold, self.multilabel)
+        self.confmat = self.confmat + confmat
+
+    def compute(self) -> Array:
+        return _confusion_matrix_compute(self.confmat, self.normalize)

@@ -1,0 +1,47 @@
+"""MatthewsCorrCoef module metric (+ deprecated MatthewsCorrcoef alias).
+
+Parity: reference ``torchmetrics/classification/matthews_corrcoef.py:27,116``.
+"""
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.matthews_corrcoef import (
+    _matthews_corrcoef_compute,
+    _matthews_corrcoef_update,
+)
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+class MatthewsCorrCoef(Metric):
+    """Matthews correlation coefficient from an accumulated confusion matrix."""
+
+    is_differentiable = False
+    higher_is_better = True
+
+    def __init__(self, num_classes: int, threshold: float = 0.5, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        self.threshold = threshold
+        self.add_state("confmat", default=jnp.zeros((num_classes, num_classes), dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        confmat = _matthews_corrcoef_update(preds, target, self.num_classes, self.threshold)
+        self.confmat = self.confmat + confmat
+
+    def compute(self) -> Array:
+        return _matthews_corrcoef_compute(self.confmat)
+
+
+class MatthewsCorrcoef(MatthewsCorrCoef):
+    """Deprecated alias. Parity: reference ``matthews_corrcoef.py:116``."""
+
+    def __init__(self, *args: Any, **kwargs: Any) -> None:
+        rank_zero_warn(
+            "`MatthewsCorrcoef` was renamed to `MatthewsCorrCoef` and it will be removed.", DeprecationWarning
+        )
+        super().__init__(*args, **kwargs)

@@ -4,21 +4,48 @@ Parity: reference ``torchmetrics/functional/__init__.py`` (~76 exports; grows as
 domains land).
 """
 from metrics_tpu.functional.classification.accuracy import accuracy
+from metrics_tpu.functional.classification.auc import auc
+from metrics_tpu.functional.classification.auroc import auroc
+from metrics_tpu.functional.classification.average_precision import average_precision
+from metrics_tpu.functional.classification.calibration_error import calibration_error
+from metrics_tpu.functional.classification.cohen_kappa import cohen_kappa
+from metrics_tpu.functional.classification.confusion_matrix import confusion_matrix
 from metrics_tpu.functional.classification.f_beta import f1, f1_score, fbeta
 from metrics_tpu.functional.classification.hamming_distance import hamming_distance
+from metrics_tpu.functional.classification.hinge import hinge
+from metrics_tpu.functional.classification.jaccard import jaccard_index
+from metrics_tpu.functional.classification.kl_divergence import kl_divergence
+from metrics_tpu.functional.classification.matthews_corrcoef import matthews_corrcoef
 from metrics_tpu.functional.classification.precision_recall import precision, precision_recall, recall
+from metrics_tpu.functional.classification.precision_recall_curve import precision_recall_curve
+from metrics_tpu.functional.classification.roc import roc
 from metrics_tpu.functional.classification.specificity import specificity
 from metrics_tpu.functional.classification.stat_scores import stat_scores
 
+iou = jaccard_index  # deprecated alias (reference functional/iou.py)
+
 __all__ = [
     "accuracy",
+    "auc",
+    "auroc",
+    "average_precision",
+    "calibration_error",
+    "cohen_kappa",
+    "confusion_matrix",
     "f1",
     "f1_score",
     "fbeta",
     "hamming_distance",
+    "hinge",
+    "iou",
+    "jaccard_index",
+    "kl_divergence",
+    "matthews_corrcoef",
     "precision",
     "precision_recall",
+    "precision_recall_curve",
     "recall",
+    "roc",
     "specificity",
     "stat_scores",
 ]

@@ -1,0 +1,137 @@
+"""Area under the ROC curve.
+
+Parity: reference ``torchmetrics/functional/classification/auroc.py``
+(_auroc_update :27, _auroc_compute :51, auroc :186). Binary max_fpr uses the same
+bucketize+lerp partial-AUC with McClish correction.
+"""
+import warnings
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.auc import _auc_compute_without_check
+from metrics_tpu.functional.classification.roc import roc
+from metrics_tpu.utils.checks import _input_format_classification
+from metrics_tpu.utils.enums import AverageMethod, DataType
+
+Array = jax.Array
+
+
+def _auroc_update(preds: Array, target: Array) -> Tuple[Array, Array, DataType]:
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    _, _, mode = _input_format_classification(preds, target)
+
+    if mode == DataType.MULTIDIM_MULTICLASS:
+        n_classes = preds.shape[1]
+        preds = jnp.swapaxes(preds, 0, 1).reshape(n_classes, -1).T
+        target = jnp.ravel(target)
+    if mode == DataType.MULTILABEL and preds.ndim > 2:
+        n_classes = preds.shape[1]
+        preds = jnp.swapaxes(preds, 0, 1).reshape(n_classes, -1).T
+        target = jnp.swapaxes(target, 0, 1).reshape(n_classes, -1).T
+    return preds, target, mode
+
+
+def _auroc_compute(
+    preds: Array,
+    target: Array,
+    mode: DataType,
+    num_classes: Optional[int] = None,
+    pos_label: Optional[int] = None,
+    average: Optional[str] = "macro",
+    max_fpr: Optional[float] = None,
+    sample_weights: Optional[Sequence] = None,
+) -> Array:
+    if mode == DataType.BINARY:
+        num_classes = 1
+
+    if max_fpr is not None:
+        if not isinstance(max_fpr, float) or not 0 < max_fpr <= 1:
+            raise ValueError(f"`max_fpr` should be a float in range (0, 1], got: {max_fpr}")
+        if mode != DataType.BINARY:
+            raise ValueError(
+                f"Partial AUC computation not available in multilabel/multiclass setting,"
+                f" 'max_fpr' must be set to `None`, received `{max_fpr}`."
+            )
+
+    if mode == DataType.MULTILABEL:
+        if average == AverageMethod.MICRO:
+            fpr, tpr, _ = roc(jnp.ravel(preds), jnp.ravel(target), 1, pos_label, sample_weights)
+        elif num_classes:
+            output = [
+                roc(preds[:, i], target[:, i], num_classes=1, pos_label=1, sample_weights=sample_weights)
+                for i in range(num_classes)
+            ]
+            fpr = [o[0] for o in output]
+            tpr = [o[1] for o in output]
+        else:
+            raise ValueError("Detected input to be `multilabel` but you did not provide `num_classes` argument")
+    else:
+        if mode != DataType.BINARY:
+            if num_classes is None:
+                raise ValueError("Detected input to `multiclass` but you did not provide `num_classes` argument")
+            if average == AverageMethod.WEIGHTED and len(jnp.unique(target)) < num_classes:
+                # classes with 0 observations are dropped (weight would be 0)
+                target_bool_mat = jnp.zeros((len(target), num_classes), dtype=bool)
+                target_bool_mat = target_bool_mat.at[jnp.arange(len(target)), target.astype(jnp.int32)].set(True)
+                class_observed = jnp.sum(target_bool_mat, axis=0) > 0
+                for c in range(num_classes):
+                    if not bool(class_observed[c]):
+                        warnings.warn(f"Class {c} had 0 observations, omitted from AUROC calculation", UserWarning)
+                keep = jnp.nonzero(class_observed)[0]
+                preds = preds[:, keep]
+                target_bool_mat = target_bool_mat[:, keep]
+                target = jnp.nonzero(target_bool_mat)[1]
+                num_classes = int(len(keep))
+                if num_classes == 1:
+                    raise ValueError("Found 1 non-empty class in `multiclass` AUROC calculation")
+        fpr, tpr, _ = roc(preds, target, num_classes, pos_label, sample_weights)
+
+    if max_fpr is None or max_fpr == 1:
+        if mode == DataType.MULTILABEL and average == AverageMethod.MICRO:
+            pass
+        elif num_classes != 1:
+            auc_scores = [_auc_compute_without_check(x, y, 1.0) for x, y in zip(fpr, tpr)]
+            if average == AverageMethod.NONE:
+                return jnp.stack(auc_scores)
+            if average == AverageMethod.MACRO:
+                return jnp.mean(jnp.stack(auc_scores))
+            if average == AverageMethod.WEIGHTED:
+                if mode == DataType.MULTILABEL:
+                    support = jnp.sum(target, axis=0)
+                else:
+                    support = jnp.bincount(jnp.ravel(target), length=num_classes)
+                return jnp.sum(jnp.stack(auc_scores) * support / jnp.sum(support))
+            allowed_average = (AverageMethod.NONE.value, AverageMethod.MACRO.value, AverageMethod.WEIGHTED.value)
+            raise ValueError(
+                f"Argument `average` expected to be one of the following: {allowed_average} but got {average}"
+            )
+        return _auc_compute_without_check(fpr, tpr, 1.0)
+
+    max_area = jnp.asarray(max_fpr, dtype=fpr.dtype)
+    # add a point at max_fpr by linear interpolation
+    stop = int(jnp.searchsorted(fpr, max_area, side="right"))
+    weight = (max_area - fpr[stop - 1]) / (fpr[stop] - fpr[stop - 1])
+    interp_tpr = tpr[stop - 1] + weight * (tpr[stop] - tpr[stop - 1])
+    tpr = jnp.concatenate([tpr[:stop], interp_tpr.reshape(1)])
+    fpr = jnp.concatenate([fpr[:stop], max_area.reshape(1)])
+
+    partial_auc = _auc_compute_without_check(fpr, tpr, 1.0)
+    min_area = 0.5 * max_area ** 2
+    return 0.5 * (1 + (partial_auc - min_area) / (max_area - min_area))
+
+
+def auroc(
+    preds: Array,
+    target: Array,
+    num_classes: Optional[int] = None,
+    pos_label: Optional[int] = None,
+    average: Optional[str] = "macro",
+    max_fpr: Optional[float] = None,
+    sample_weights: Optional[Sequence] = None,
+) -> Array:
+    """Compute AUROC. Parity: reference ``auroc:186-254``."""
+    preds, target, mode = _auroc_update(preds, target)
+    return _auroc_compute(preds, target, mode, num_classes, pos_label, average, max_fpr, sample_weights)

@@ -1,0 +1,68 @@
+"""Confusion matrix (binary / multiclass / multilabel).
+
+Parity: reference ``torchmetrics/functional/classification/confusion_matrix.py``
+(_confusion_matrix_update :25, _confusion_matrix_compute :56, confusion_matrix :119).
+
+TPU note: the bincount over ``target*C + preds`` lowers to a fixed-length
+``jnp.bincount`` (scatter-add of ones — XLA turns this into an efficient sort-free
+segment sum); ``minlength`` is static so shapes stay fixed under jit.
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _input_format_classification
+from metrics_tpu.utils.enums import DataType
+from metrics_tpu.utils.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+def _confusion_matrix_update(
+    preds: Array, target: Array, num_classes: int, threshold: float = 0.5, multilabel: bool = False
+) -> Array:
+    preds, target, mode = _input_format_classification(preds, target, threshold)
+    if mode not in (DataType.BINARY, DataType.MULTILABEL):
+        preds = jnp.argmax(preds, axis=1)
+        target = jnp.argmax(target, axis=1)
+    if multilabel:
+        unique_mapping = jnp.ravel(2 * target + preds + 4 * jnp.arange(num_classes))
+        minlength = 4 * num_classes
+    else:
+        unique_mapping = jnp.ravel(target) * num_classes + jnp.ravel(preds)
+        minlength = num_classes ** 2
+
+    bins = jnp.bincount(unique_mapping, length=minlength)
+    if multilabel:
+        return bins.reshape(num_classes, 2, 2)
+    return bins.reshape(num_classes, num_classes)
+
+
+def _confusion_matrix_compute(confmat: Array, normalize: Optional[str] = None) -> Array:
+    allowed_normalize = ("true", "pred", "all", "none", None)
+    if normalize not in allowed_normalize:
+        raise ValueError(f"Argument average needs to one of the following: {allowed_normalize}")
+    if normalize is not None and normalize != "none":
+        confmat = confmat.astype(jnp.float32) if not jnp.issubdtype(confmat.dtype, jnp.floating) else confmat
+        if normalize == "true":
+            confmat = confmat / jnp.sum(confmat, axis=1, keepdims=True)
+        elif normalize == "pred":
+            confmat = confmat / jnp.sum(confmat, axis=0, keepdims=True)
+        elif normalize == "all":
+            confmat = confmat / jnp.sum(confmat)
+        confmat = jnp.where(jnp.isnan(confmat), 0.0, confmat)
+    return confmat
+
+
+def confusion_matrix(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    normalize: Optional[str] = None,
+    threshold: float = 0.5,
+    multilabel: bool = False,
+) -> Array:
+    """Compute the (C,C) (or (C,2,2) multilabel) confusion matrix. Parity: ``:119-186``."""
+    confmat = _confusion_matrix_update(preds, target, num_classes, threshold, multilabel)
+    return _confusion_matrix_compute(confmat, normalize)

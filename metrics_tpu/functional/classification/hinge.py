@@ -1,0 +1,99 @@
+"""Hinge loss (binary, Crammer-Singer, one-vs-all).
+
+Parity: reference ``torchmetrics/functional/classification/hinge.py``
+(MulticlassMode :27, _check_shape_and_type_consistency_hinge :37, _hinge_update :73,
+_hinge_compute :125, hinge :146). Boolean-mask indexing becomes take_along_axis /
+where-masking (static shapes).
+"""
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _input_squeeze
+from metrics_tpu.utils.data import to_onehot
+from metrics_tpu.utils.enums import DataType, EnumStr
+
+Array = jax.Array
+
+
+class MulticlassMode(EnumStr):
+    """Possible multiclass modes of hinge."""
+
+    CRAMMER_SINGER = "crammer-singer"
+    ONE_VS_ALL = "one-vs-all"
+
+
+def _check_shape_and_type_consistency_hinge(preds: Array, target: Array) -> DataType:
+    if target.ndim > 1:
+        raise ValueError(f"The `target` should be one dimensional, got `target` with shape={target.shape}.")
+    if preds.ndim == 1:
+        if preds.shape != target.shape:
+            raise ValueError(
+                "The `preds` and `target` should have the same shape,",
+                f" got `preds` with shape={preds.shape} and `target` with shape={target.shape}.",
+            )
+        mode = DataType.BINARY
+    elif preds.ndim == 2:
+        if preds.shape[0] != target.shape[0]:
+            raise ValueError(
+                "The `preds` and `target` should have the same shape in the first dimension,",
+                f" got `preds` with shape={preds.shape} and `target` with shape={target.shape}.",
+            )
+        mode = DataType.MULTICLASS
+    else:
+        raise ValueError(f"The `preds` should be one or two dimensional, got `preds` with shape={preds.shape}.")
+    return mode
+
+
+def _hinge_update(
+    preds: Array,
+    target: Array,
+    squared: bool = False,
+    multiclass_mode: Optional[Union[str, MulticlassMode]] = None,
+) -> Tuple[Array, Array]:
+    preds, target = _input_squeeze(jnp.asarray(preds), jnp.asarray(target))
+    mode = _check_shape_and_type_consistency_hinge(preds, target)
+
+    if mode == DataType.MULTICLASS:
+        target_oh = to_onehot(target, max(2, preds.shape[1])).astype(bool)
+
+    if mode == DataType.MULTICLASS and (multiclass_mode is None or multiclass_mode == MulticlassMode.CRAMMER_SINGER):
+        # margin = preds at the true class minus the best wrong-class score
+        true_scores = jnp.take_along_axis(preds, target.reshape(-1, 1).astype(jnp.int32), axis=1)[:, 0]
+        wrong_best = jnp.max(jnp.where(target_oh, -jnp.inf, preds), axis=1)
+        margin = true_scores - wrong_best
+    elif mode == DataType.BINARY or multiclass_mode == MulticlassMode.ONE_VS_ALL:
+        if mode == DataType.BINARY:
+            t = target.astype(bool)
+        else:
+            t = target_oh
+        margin = jnp.where(t, preds, -preds)
+    else:
+        raise ValueError(
+            "The `multiclass_mode` should be either None / 'crammer-singer' / MulticlassMode.CRAMMER_SINGER"
+            "(default) or 'one-vs-all' / MulticlassMode.ONE_VS_ALL,"
+            f" got {multiclass_mode}."
+        )
+
+    measures = jnp.clip(1 - margin, 0, None)
+    if squared:
+        measures = measures ** 2
+
+    total = jnp.asarray(target.shape[0])
+    return jnp.sum(measures, axis=0), total
+
+
+def _hinge_compute(measure: Array, total: Array) -> Array:
+    return measure / total
+
+
+def hinge(
+    preds: Array,
+    target: Array,
+    squared: bool = False,
+    multiclass_mode: Optional[Union[str, MulticlassMode]] = None,
+) -> Array:
+    """Compute mean hinge loss. Parity: reference ``hinge:146-210``."""
+    measure, total = _hinge_update(preds, target, squared=squared, multiclass_mode=multiclass_mode)
+    return _hinge_compute(measure, total)

@@ -1,0 +1,53 @@
+"""Jaccard index (IoU).
+
+Parity: reference ``torchmetrics/functional/classification/jaccard.py``
+(_jaccard_from_confmat :23, jaccard_index :69). The reference's post-hoc class
+removal for ``ignore_index`` becomes a mask + renormalised mean (static shapes).
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.confusion_matrix import _confusion_matrix_update
+from metrics_tpu.parallel.collectives import reduce
+
+Array = jax.Array
+
+
+def _jaccard_from_confmat(
+    confmat: Array,
+    num_classes: int,
+    ignore_index: Optional[int] = None,
+    absent_score: float = 0.0,
+    reduction: str = "elementwise_mean",
+) -> Array:
+    if ignore_index is not None and 0 <= ignore_index < num_classes:
+        confmat = confmat.at[ignore_index].set(0.0)
+
+    intersection = jnp.diag(confmat)
+    union = jnp.sum(confmat, axis=0) + jnp.sum(confmat, axis=1) - intersection
+
+    scores = intersection.astype(jnp.float32) / union.astype(jnp.float32)
+    scores = jnp.where(union == 0, absent_score, scores)
+
+    if ignore_index is not None and 0 <= ignore_index < num_classes:
+        scores = jnp.concatenate([scores[:ignore_index], scores[ignore_index + 1:]])
+    return reduce(scores, reduction=reduction)
+
+
+def jaccard_index(
+    preds: Array,
+    target: Array,
+    ignore_index: Optional[int] = None,
+    absent_score: float = 0.0,
+    threshold: float = 0.5,
+    num_classes: Optional[int] = None,
+    reduction: str = "elementwise_mean",
+) -> Array:
+    """Compute the Jaccard index. Parity: reference ``jaccard_index:69-151``."""
+    if num_classes is None:
+        num_classes = int(max(jnp.max(preds), jnp.max(target))) + 1 if preds.ndim == target.ndim else preds.shape[1]
+        num_classes = max(2, num_classes)
+    confmat = _confusion_matrix_update(preds, target, num_classes, threshold)
+    return _jaccard_from_confmat(confmat, num_classes, ignore_index, absent_score, reduction)

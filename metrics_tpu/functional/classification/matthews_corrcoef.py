@@ -1,0 +1,27 @@
+"""Matthews correlation coefficient.
+
+Parity: reference ``torchmetrics/functional/classification/matthews_corrcoef.py``
+(_matthews_corrcoef_compute :22, matthews_corrcoef :44).
+"""
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.confusion_matrix import _confusion_matrix_update
+
+Array = jax.Array
+
+_matthews_corrcoef_update = _confusion_matrix_update
+
+
+def _matthews_corrcoef_compute(confmat: Array) -> Array:
+    tk = jnp.sum(confmat, axis=1).astype(jnp.float32)
+    pk = jnp.sum(confmat, axis=0).astype(jnp.float32)
+    c = jnp.trace(confmat).astype(jnp.float32)
+    s = jnp.sum(confmat).astype(jnp.float32)
+    return (c * s - jnp.sum(tk * pk)) / (jnp.sqrt(s ** 2 - jnp.sum(pk * pk)) * jnp.sqrt(s ** 2 - jnp.sum(tk * tk)))
+
+
+def matthews_corrcoef(preds: Array, target: Array, num_classes: int, threshold: float = 0.5) -> Array:
+    """Compute MCC. Parity: reference ``matthews_corrcoef:44-89``."""
+    confmat = _matthews_corrcoef_update(preds, target, num_classes, threshold)
+    return _matthews_corrcoef_compute(confmat)

@@ -1,0 +1,138 @@
+"""ConfusionMatrix / Jaccard / CohenKappa / Matthews vs sklearn.
+
+Parity model: reference ``tests/classification/test_confusion_matrix.py`` etc.
+"""
+import numpy as np
+import pytest
+from sklearn.metrics import cohen_kappa_score, confusion_matrix as sk_confusion_matrix
+from sklearn.metrics import jaccard_score, matthews_corrcoef as sk_matthews
+
+from metrics_tpu import CohenKappa, ConfusionMatrix, JaccardIndex, MatthewsCorrCoef
+from metrics_tpu.functional import cohen_kappa, confusion_matrix, jaccard_index, matthews_corrcoef
+from tests.classification.inputs import _input_multiclass, _input_multiclass_prob
+from tests.helpers.testers import NUM_CLASSES, MetricTester
+
+
+def _to_labels(preds):
+    p = np.asarray(preds)
+    return p.argmax(axis=-1) if p.ndim > 1 and p.dtype.kind == "f" else p
+
+
+def _sk_cm(preds, target, normalize=None):
+    return sk_confusion_matrix(np.asarray(target).ravel(), _to_labels(preds).ravel(),
+                               labels=list(range(NUM_CLASSES)), normalize=normalize)
+
+
+def _sk_jaccard(preds, target):
+    return jaccard_score(np.asarray(target).ravel(), _to_labels(preds).ravel(),
+                         labels=list(range(NUM_CLASSES)), average="macro")
+
+
+def _sk_kappa(preds, target, weights=None):
+    return cohen_kappa_score(np.asarray(target).ravel(), _to_labels(preds).ravel(), weights=weights)
+
+
+def _sk_mcc(preds, target):
+    return sk_matthews(np.asarray(target).ravel(), _to_labels(preds).ravel())
+
+
+class TestConfusionMatrix(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize("normalize", [None, "true", "pred", "all"])
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, normalize, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_input_multiclass_prob.preds,
+            target=_input_multiclass_prob.target,
+            metric_class=ConfusionMatrix,
+            sk_metric=lambda p, t: _sk_cm(p, t, normalize),
+            metric_args={"num_classes": NUM_CLASSES, "normalize": normalize},
+            check_batch=False,
+        )
+
+    def test_fn(self):
+        self.run_functional_metric_test(
+            preds=_input_multiclass.preds,
+            target=_input_multiclass.target,
+            metric_functional=confusion_matrix,
+            sk_metric=lambda p, t: _sk_cm(p, t),
+            metric_args={"num_classes": NUM_CLASSES},
+        )
+
+
+class TestJaccard(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_input_multiclass_prob.preds,
+            target=_input_multiclass_prob.target,
+            metric_class=JaccardIndex,
+            sk_metric=_sk_jaccard,
+            metric_args={"num_classes": NUM_CLASSES},
+            check_batch=False,
+        )
+
+    def test_fn(self):
+        self.run_functional_metric_test(
+            preds=_input_multiclass.preds,
+            target=_input_multiclass.target,
+            metric_functional=jaccard_index,
+            sk_metric=_sk_jaccard,
+            metric_args={"num_classes": NUM_CLASSES},
+        )
+
+
+class TestCohenKappa(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize("weights", [None, "linear", "quadratic"])
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, weights, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_input_multiclass_prob.preds,
+            target=_input_multiclass_prob.target,
+            metric_class=CohenKappa,
+            sk_metric=lambda p, t: _sk_kappa(p, t, weights),
+            metric_args={"num_classes": NUM_CLASSES, "weights": weights},
+            check_batch=False,
+        )
+
+    def test_fn(self):
+        self.run_functional_metric_test(
+            preds=_input_multiclass.preds,
+            target=_input_multiclass.target,
+            metric_functional=cohen_kappa,
+            sk_metric=lambda p, t: _sk_kappa(p, t),
+            metric_args={"num_classes": NUM_CLASSES},
+        )
+
+
+class TestMatthews(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_input_multiclass_prob.preds,
+            target=_input_multiclass_prob.target,
+            metric_class=MatthewsCorrCoef,
+            sk_metric=_sk_mcc,
+            metric_args={"num_classes": NUM_CLASSES},
+            check_batch=False,
+        )
+
+    def test_fn(self):
+        self.run_functional_metric_test(
+            preds=_input_multiclass.preds,
+            target=_input_multiclass.target,
+            metric_functional=matthews_corrcoef,
+            sk_metric=_sk_mcc,
+            metric_args={"num_classes": NUM_CLASSES},
+        )

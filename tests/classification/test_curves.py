@@ -1,0 +1,351 @@
+"""ROC / PR-curve / AUROC / AveragePrecision / AUC / Binned* / CalibrationError /
+Hinge / KLDivergence vs sklearn.
+
+Parity model: reference ``tests/classification/test_roc.py``, ``test_auroc.py``,
+``test_precision_recall_curve.py``, ``test_average_precision.py``,
+``test_binned_precision_recall.py``, ``test_calibration_error.py``,
+``test_hinge.py``, ``test_kl_divergence.py`` (condensed).
+"""
+import numpy as np
+import pytest
+from scipy.stats import entropy
+from sklearn.metrics import (
+    average_precision_score as sk_average_precision,
+    hinge_loss as sk_hinge_loss,
+    precision_recall_curve as sk_precision_recall_curve,
+    roc_auc_score as sk_roc_auc,
+    roc_curve as sk_roc_curve,
+)
+
+from metrics_tpu import (
+    AUC,
+    AUROC,
+    AveragePrecision,
+    BinnedAveragePrecision,
+    BinnedRecallAtFixedPrecision,
+    CalibrationError,
+    HingeLoss,
+    KLDivergence,
+    PrecisionRecallCurve,
+    ROC,
+)
+from metrics_tpu.functional import (
+    auc,
+    auroc,
+    average_precision,
+    calibration_error,
+    hinge,
+    kl_divergence,
+    precision_recall_curve,
+    roc,
+)
+from tests.classification.inputs import _input_binary_prob, _input_multiclass_prob
+from tests.helpers.testers import NUM_CLASSES, MetricTester
+
+
+def _sk_auroc_binary(preds, target):
+    return sk_roc_auc(np.asarray(target).ravel(), np.asarray(preds).ravel())
+
+
+def _sk_auroc_multiclass(preds, target, average="macro"):
+    return sk_roc_auc(np.asarray(target).ravel(), np.asarray(preds).reshape(-1, NUM_CLASSES),
+                      multi_class="ovr", average=average, labels=list(range(NUM_CLASSES)))
+
+
+def _sk_avg_prec_binary(preds, target):
+    return sk_average_precision(np.asarray(target).ravel(), np.asarray(preds).ravel())
+
+
+class TestAUROC(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_binary_class(self, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_input_binary_prob.preds,
+            target=_input_binary_prob.target,
+            metric_class=AUROC,
+            sk_metric=_sk_auroc_binary,
+            check_batch=False,
+        )
+
+    @pytest.mark.parametrize("average", ["macro", "weighted"])
+    def test_multiclass_class(self, average):
+        self.run_class_metric_test(
+            ddp=False,
+            preds=_input_multiclass_prob.preds,
+            target=_input_multiclass_prob.target,
+            metric_class=AUROC,
+            sk_metric=lambda p, t: _sk_auroc_multiclass(p, t, average),
+            metric_args={"num_classes": NUM_CLASSES, "average": average},
+            check_batch=False,
+        )
+
+    def test_binary_fn(self):
+        self.run_functional_metric_test(
+            preds=_input_binary_prob.preds,
+            target=_input_binary_prob.target,
+            metric_functional=auroc,
+            sk_metric=_sk_auroc_binary,
+        )
+
+    def test_max_fpr(self):
+        import jax.numpy as jnp
+
+        p = jnp.asarray(_input_binary_prob.preds[0])
+        t = jnp.asarray(_input_binary_prob.target[0])
+        expected = sk_roc_auc(np.asarray(t), np.asarray(p), max_fpr=0.5)
+        np.testing.assert_allclose(float(auroc(p, t, max_fpr=0.5)), expected, atol=1e-6)
+
+
+class TestROCAndPRCurve(MetricTester):
+    atol = 1e-6
+
+    def test_roc_binary_fn(self):
+        p = _input_binary_prob.preds[0]
+        t = _input_binary_prob.target[0]
+        fpr, tpr, thr = roc(p, t)
+        sk_fpr, sk_tpr, sk_thr = sk_roc_curve(t, p, drop_intermediate=False)
+        np.testing.assert_allclose(np.asarray(fpr), sk_fpr, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(tpr), sk_tpr, atol=1e-6)
+
+    def test_prc_binary_fn(self):
+        p = _input_binary_prob.preds[0]
+        t = _input_binary_prob.target[0]
+        prec, rec, thr = precision_recall_curve(p, t)
+        # the reference (and this build) trims the curve once full recall is reached;
+        # sklearn >= 1.3 keeps the full curve, so compare against its tail
+        sk_prec, sk_rec, sk_thr = sk_precision_recall_curve(t, p)
+        n = len(np.asarray(prec))
+        np.testing.assert_allclose(np.asarray(prec), sk_prec[-n:], atol=1e-6)
+        np.testing.assert_allclose(np.asarray(rec), sk_rec[-n:], atol=1e-6)
+        np.testing.assert_allclose(np.asarray(thr), sk_thr[-(n - 1):], atol=1e-6)
+
+    def test_roc_class(self):
+        # curve outputs are tuples with thresholds offset by +1 vs sklearn; compare manually
+        m = ROC()
+        for i in range(4):
+            m.update(_input_binary_prob.preds[i], _input_binary_prob.target[i])
+        fpr, tpr, _ = m.compute()
+        allp = np.concatenate(_input_binary_prob.preds[:4])
+        allt = np.concatenate(_input_binary_prob.target[:4])
+        sk_fpr, sk_tpr, _ = sk_roc_curve(allt, allp, drop_intermediate=False)
+        np.testing.assert_allclose(np.asarray(fpr), sk_fpr, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(tpr), sk_tpr, atol=1e-6)
+
+    def test_prc_class(self):
+        m = PrecisionRecallCurve()
+        for i in range(4):
+            m.update(_input_binary_prob.preds[i], _input_binary_prob.target[i])
+        prec, rec, _ = m.compute()
+        allp = np.concatenate(_input_binary_prob.preds[:4])
+        allt = np.concatenate(_input_binary_prob.target[:4])
+        sk_prec, sk_rec, _ = sk_precision_recall_curve(allt, allp)
+        n = len(np.asarray(prec))
+        np.testing.assert_allclose(np.asarray(prec), sk_prec[-n:], atol=1e-6)
+        np.testing.assert_allclose(np.asarray(rec), sk_rec[-n:], atol=1e-6)
+
+
+class TestAveragePrecision(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_binary_class(self, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_input_binary_prob.preds,
+            target=_input_binary_prob.target,
+            metric_class=AveragePrecision,
+            sk_metric=_sk_avg_prec_binary,
+            check_batch=False,
+        )
+
+    def test_binary_fn(self):
+        self.run_functional_metric_test(
+            preds=_input_binary_prob.preds,
+            target=_input_binary_prob.target,
+            metric_functional=average_precision,
+            sk_metric=_sk_avg_prec_binary,
+        )
+
+    def test_multiclass_macro(self):
+        import jax.numpy as jnp
+
+        p = np.asarray(_input_multiclass_prob.preds).reshape(-1, NUM_CLASSES)
+        t = np.asarray(_input_multiclass_prob.target).ravel()
+        res = average_precision(jnp.asarray(p), jnp.asarray(t), num_classes=NUM_CLASSES, average="macro")
+        t_oh = np.eye(NUM_CLASSES)[t]
+        expected = sk_average_precision(t_oh, p, average="macro")
+        np.testing.assert_allclose(float(res), expected, atol=1e-6)
+
+
+class TestAUC(MetricTester):
+    def test_auc_fn(self):
+        x = np.asarray([0.0, 0.1, 0.3, 0.6, 1.0])
+        y = np.asarray([0.0, 0.5, 0.6, 0.8, 1.0])
+        from sklearn.metrics import auc as sk_auc
+
+        np.testing.assert_allclose(float(auc(x, y)), sk_auc(x, y), atol=1e-6)
+
+    def test_auc_class(self):
+        x = np.asarray([0.0, 0.1, 0.3, 0.6, 1.0])
+        y = np.asarray([0.0, 0.5, 0.6, 0.8, 1.0])
+        m = AUC()
+        m.update(x[:3], y[:3])
+        m.update(x[3:], y[3:])
+        from sklearn.metrics import auc as sk_auc
+
+        np.testing.assert_allclose(float(m.compute()), sk_auc(x, y), atol=1e-6)
+
+
+class TestBinned(MetricTester):
+    def test_binned_avg_precision_close_to_exact(self):
+        """With enough bins the binned AP approaches the exact AP."""
+        import jax.numpy as jnp
+
+        p = np.asarray(_input_binary_prob.preds).ravel()
+        t = np.asarray(_input_binary_prob.target).ravel()
+        m = BinnedAveragePrecision(num_classes=1, thresholds=jnp.asarray(np.linspace(0, 1, 501)))
+        m.update(jnp.asarray(p), jnp.asarray(t))
+        res = float(m.compute())
+        expected = sk_average_precision(t, p)
+        assert abs(res - expected) < 0.01
+
+    def test_binned_recall_at_precision(self):
+        import jax.numpy as jnp
+
+        p = np.asarray(_input_binary_prob.preds).ravel()
+        t = np.asarray(_input_binary_prob.target).ravel()
+        m = BinnedRecallAtFixedPrecision(num_classes=1, min_precision=0.5, thresholds=201)
+        m.update(jnp.asarray(p), jnp.asarray(t))
+        recall_res, thr_res = m.compute()
+        assert 0.0 <= float(recall_res) <= 1.0
+        assert float(thr_res) <= 1.0
+
+    def test_binned_is_jittable(self):
+        """The binned family must trace/jit end to end — the static-shape contract."""
+        import jax
+        import jax.numpy as jnp
+
+        m = BinnedAveragePrecision(num_classes=1, thresholds=101)
+
+        @jax.jit
+        def step(state, p, t):
+            return m.update_state(state, p, t)
+
+        state = m.init_state()
+        p = jnp.asarray(_input_binary_prob.preds[0])
+        t = jnp.asarray(_input_binary_prob.target[0])
+        state = step(state, p, t)
+        state = step(state, p, t)
+        val = jax.jit(m.compute_from)(state)
+        assert 0.0 <= float(val) <= 1.0
+
+
+class TestCalibrationError(MetricTester):
+    @pytest.mark.parametrize("norm", ["l1", "l2", "max"])
+    def test_ce_binary(self, norm):
+        """Compare against a hand-rolled numpy implementation of the binned ECE."""
+        p = np.asarray(_input_binary_prob.preds).ravel()
+        t = np.asarray(_input_binary_prob.target).ravel()
+        res = float(calibration_error(p, t, n_bins=15, norm=norm))
+
+        conf, acc = p, t.astype(float)
+        bins = np.linspace(0, 1, 16)
+        ce_terms, props, abs_diffs = [], [], []
+        for lo, hi in zip(bins[:-1], bins[1:]):
+            in_bin = (conf > lo) & (conf <= hi)
+            if in_bin.mean() > 0:
+                a, c, pr = acc[in_bin].mean(), conf[in_bin].mean(), in_bin.mean()
+                ce_terms.append((a, c, pr))
+        if norm == "l1":
+            expected = sum(abs(a - c) * pr for a, c, pr in ce_terms)
+        elif norm == "max":
+            expected = max(abs(a - c) for a, c, pr in ce_terms)
+        else:
+            expected = np.sqrt(sum((a - c) ** 2 * pr for a, c, pr in ce_terms))
+        np.testing.assert_allclose(res, expected, atol=1e-6)
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_ce_class(self, ddp):
+        def _np_ece(preds, target):
+            conf, acc = np.asarray(preds).ravel(), np.asarray(target).ravel().astype(float)
+            bins = np.linspace(0, 1, 16)
+            total = 0.0
+            for lo, hi in zip(bins[:-1], bins[1:]):
+                in_bin = (conf > lo) & (conf <= hi)
+                if in_bin.mean() > 0:
+                    total += abs(acc[in_bin].mean() - conf[in_bin].mean()) * in_bin.mean()
+            return total
+
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_input_binary_prob.preds,
+            target=_input_binary_prob.target,
+            metric_class=CalibrationError,
+            sk_metric=_np_ece,
+            check_batch=False,
+            atol=1e-6,
+        )
+
+
+class TestHinge(MetricTester):
+    def test_binary_vs_sklearn(self):
+        # sklearn hinge_loss expects +-1 targets and margin predictions
+        rng = np.random.RandomState(42)
+        preds = rng.randn(128)
+        target = rng.randint(0, 2, 128)
+        res = float(hinge(preds, target))
+        expected = sk_hinge_loss(np.where(target == 0, -1, 1), preds)
+        np.testing.assert_allclose(res, expected, atol=1e-6)
+
+    def test_multiclass_crammer_singer(self):
+        rng = np.random.RandomState(42)
+        preds = rng.randn(64, NUM_CLASSES)
+        target = rng.randint(0, NUM_CLASSES, 64)
+        res = float(hinge(preds, target))
+        expected = sk_hinge_loss(target, preds, labels=list(range(NUM_CLASSES)))
+        np.testing.assert_allclose(res, expected, atol=1e-6)
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, ddp):
+        rng = np.random.RandomState(7)
+        preds = rng.randn(16, 32)
+        target = rng.randint(0, 2, (16, 32))
+
+        def _sk(p, t):
+            return sk_hinge_loss(np.where(np.asarray(t).ravel() == 0, -1, 1), np.asarray(p).ravel())
+
+        self.run_class_metric_test(
+            ddp=ddp, preds=preds, target=target, metric_class=HingeLoss, sk_metric=_sk, check_batch=False,
+            atol=1e-6,
+        )
+
+
+class TestKLDivergence(MetricTester):
+    def test_fn(self):
+        rng = np.random.RandomState(42)
+        p = rng.rand(64, 8)
+        p = p / p.sum(-1, keepdims=True)
+        q = rng.rand(64, 8)
+        q = q / q.sum(-1, keepdims=True)
+        res = float(kl_divergence(p, q))
+        expected = np.mean([entropy(pi, qi) for pi, qi in zip(p, q)])
+        np.testing.assert_allclose(res, expected, atol=1e-5)
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, ddp):
+        rng = np.random.RandomState(42)
+        p = rng.rand(16, 32, 8)
+        q = rng.rand(16, 32, 8)
+
+        def _sk(pp, qq):
+            pn = pp / pp.sum(-1, keepdims=True)
+            qn = qq / qq.sum(-1, keepdims=True)
+            return np.mean([entropy(pi, qi) for pi, qi in zip(pn, qn)])
+
+        self.run_class_metric_test(
+            ddp=ddp, preds=p, target=q, metric_class=KLDivergence, sk_metric=_sk, check_batch=False,
+            atol=1e-5,
+        )

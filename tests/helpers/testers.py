@@ -178,9 +178,13 @@ class MetricTester:
             for j in range(p_shard.shape[0]):
                 e = {k: extras[i][j, 0] for i, k in enumerate(extra_arrs)}
                 state = metric.update_state(state, p_shard[j, 0], t_shard[j, 0], **e, **extra_static)
-            return metric.compute_synced(state, "dp")
+            # sync in-trace (the collective path under test); the final compute runs
+            # eagerly on the synced state — exact curve metrics have data-dependent
+            # output shapes and are eager-only by design (SURVEY.md §7.3).
+            return metric.sync_states(state, "dp")
 
-        result = run(p, t, *extra_arrs.values())
+        synced = run(p, t, *extra_arrs.values())
+        result = metric.compute_from(synced)
         nb = preds.shape[0]
         # oracle on data ordered the way the gather sees it: device-major strided order
         order = [j * NUM_DEVICES + d for d in range(NUM_DEVICES) for j in range(nb // NUM_DEVICES)]
