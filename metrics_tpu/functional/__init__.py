@@ -21,11 +21,45 @@ from metrics_tpu.functional.classification.precision_recall_curve import precisi
 from metrics_tpu.functional.classification.roc import roc
 from metrics_tpu.functional.classification.specificity import specificity
 from metrics_tpu.functional.classification.stat_scores import stat_scores
+from metrics_tpu.functional.pairwise.cosine import pairwise_cosine_similarity
+from metrics_tpu.functional.pairwise.euclidean import pairwise_euclidean_distance
+from metrics_tpu.functional.pairwise.linear import pairwise_linear_similarity
+from metrics_tpu.functional.pairwise.manhatten import pairwise_manhatten_distance
+from metrics_tpu.functional.regression.cosine_similarity import cosine_similarity
+from metrics_tpu.functional.regression.explained_variance import explained_variance
+from metrics_tpu.functional.regression.mean_absolute_error import mean_absolute_error
+from metrics_tpu.functional.regression.mean_absolute_percentage_error import (
+    mean_absolute_percentage_error,
+)
+from metrics_tpu.functional.regression.mean_squared_error import mean_squared_error
+from metrics_tpu.functional.regression.mean_squared_log_error import mean_squared_log_error
+from metrics_tpu.functional.regression.pearson import pearson_corrcoef
+from metrics_tpu.functional.regression.r2 import r2_score
+from metrics_tpu.functional.regression.spearman import spearman_corrcoef
+from metrics_tpu.functional.regression.symmetric_mean_absolute_percentage_error import (
+    symmetric_mean_absolute_percentage_error,
+)
+from metrics_tpu.functional.regression.tweedie_deviance import tweedie_deviance_score
 
 iou = jaccard_index  # deprecated alias (reference functional/iou.py)
 
 __all__ = [
     "accuracy",
+    "cosine_similarity",
+    "explained_variance",
+    "mean_absolute_error",
+    "mean_absolute_percentage_error",
+    "mean_squared_error",
+    "mean_squared_log_error",
+    "pairwise_cosine_similarity",
+    "pairwise_euclidean_distance",
+    "pairwise_linear_similarity",
+    "pairwise_manhatten_distance",
+    "pearson_corrcoef",
+    "r2_score",
+    "spearman_corrcoef",
+    "symmetric_mean_absolute_percentage_error",
+    "tweedie_deviance_score",
     "auc",
     "auroc",
     "average_precision",

@@ -1,0 +1,31 @@
+"""Pairwise cosine similarity.
+
+Parity: reference ``torchmetrics/functional/pairwise/cosine.py:45``. The row-normalised
+matmul maps straight onto the MXU.
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.pairwise.helpers import _check_input, _reduce_distance_matrix, _zero_diagonal
+
+Array = jax.Array
+
+
+def _pairwise_cosine_similarity_update(
+    x: Array, y: Optional[Array] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    x = x / jnp.linalg.norm(x, axis=1, keepdims=True)
+    y = y / jnp.linalg.norm(y, axis=1, keepdims=True)
+    distance = x @ y.T
+    return _zero_diagonal(distance, zero_diagonal)
+
+
+def pairwise_cosine_similarity(
+    x: Array, y: Optional[Array] = None, reduction: Optional[str] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    """Pairwise cosine similarity between rows of x (and y)."""
+    distance = _pairwise_cosine_similarity_update(x, y, zero_diagonal)
+    return _reduce_distance_matrix(distance, reduction)

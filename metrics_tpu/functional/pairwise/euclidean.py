@@ -1,0 +1,32 @@
+"""Pairwise euclidean distance.
+
+Parity: reference ``torchmetrics/functional/pairwise/euclidean.py:40``. Uses the
+x^2 + y^2 - 2xy expansion so the heavy term is a single MXU matmul.
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.pairwise.helpers import _check_input, _reduce_distance_matrix, _zero_diagonal
+
+Array = jax.Array
+
+
+def _pairwise_euclidean_distance_update(
+    x: Array, y: Optional[Array] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    x_norm = jnp.sum(x * x, axis=1, keepdims=True)
+    y_norm = jnp.sum(y * y, axis=1)
+    distance = x_norm + y_norm - 2 * (x @ y.T)
+    distance = jnp.sqrt(jnp.clip(distance, 0.0, None))
+    return _zero_diagonal(distance, zero_diagonal)
+
+
+def pairwise_euclidean_distance(
+    x: Array, y: Optional[Array] = None, reduction: Optional[str] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    """Pairwise euclidean distance between rows of x (and y)."""
+    distance = _pairwise_euclidean_distance_update(x, y, zero_diagonal)
+    return _reduce_distance_matrix(distance, reduction)

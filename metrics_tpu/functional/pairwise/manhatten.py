@@ -1,0 +1,29 @@
+"""Pairwise manhatten (L1) distance.
+
+Parity: reference ``torchmetrics/functional/pairwise/manhatten.py:39`` (incl. the
+reference's spelling).
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.pairwise.helpers import _check_input, _reduce_distance_matrix, _zero_diagonal
+
+Array = jax.Array
+
+
+def _pairwise_manhatten_distance_update(
+    x: Array, y: Optional[Array] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    distance = jnp.sum(jnp.abs(x[:, None, :] - y[None, :, :]), axis=-1)
+    return _zero_diagonal(distance, zero_diagonal)
+
+
+def pairwise_manhatten_distance(
+    x: Array, y: Optional[Array] = None, reduction: Optional[str] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    """Pairwise L1 distance between rows of x (and y)."""
+    distance = _pairwise_manhatten_distance_update(x, y, zero_diagonal)
+    return _reduce_distance_matrix(distance, reduction)

@@ -1,0 +1,35 @@
+"""Cosine similarity over the batch dim.
+
+Parity: reference ``torchmetrics/functional/regression/cosine_similarity.py``.
+"""
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _cosine_similarity_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    _check_same_shape(preds, target)
+    return preds.astype(jnp.float32), target.astype(jnp.float32)
+
+
+def _cosine_similarity_compute(preds: Array, target: Array, reduction: Optional[str] = "sum") -> Array:
+    dot_product = jnp.sum(preds * target, axis=-1)
+    preds_norm = jnp.linalg.norm(preds, axis=-1)
+    target_norm = jnp.linalg.norm(target, axis=-1)
+    similarity = dot_product / (preds_norm * target_norm)
+    if reduction == "sum":
+        return jnp.sum(similarity)
+    if reduction == "mean":
+        return jnp.mean(similarity)
+    return similarity
+
+
+def cosine_similarity(preds: Array, target: Array, reduction: Optional[str] = "sum") -> Array:
+    """Compute cosine similarity rowwise with sum/mean/none reduction."""
+    preds, target = _cosine_similarity_update(jnp.asarray(preds), jnp.asarray(target))
+    return _cosine_similarity_compute(preds, target, reduction)

@@ -1,0 +1,68 @@
+"""Explained variance.
+
+Parity: reference ``torchmetrics/functional/regression/explained_variance.py``
+(_explained_variance_update :20, _explained_variance_compute :41). In-place boolean
+masking becomes nested ``jnp.where`` (static shapes).
+"""
+from typing import Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _explained_variance_update(preds: Array, target: Array) -> Tuple[int, Array, Array, Array, Array]:
+    _check_same_shape(preds, target)
+    n_obs = preds.shape[0]
+    diff = target - preds
+    sum_error = jnp.sum(diff, axis=0)
+    sum_squared_error = jnp.sum(diff * diff, axis=0)
+    sum_target = jnp.sum(target, axis=0)
+    sum_squared_target = jnp.sum(target * target, axis=0)
+    return n_obs, sum_error, sum_squared_error, sum_target, sum_squared_target
+
+
+def _explained_variance_compute(
+    n_obs: Array,
+    sum_error: Array,
+    sum_squared_error: Array,
+    sum_target: Array,
+    sum_squared_target: Array,
+    multioutput: str = "uniform_average",
+) -> Array:
+    diff_avg = sum_error / n_obs
+    numerator = sum_squared_error / n_obs - diff_avg * diff_avg
+    target_avg = sum_target / n_obs
+    denominator = sum_squared_target / n_obs - target_avg * target_avg
+
+    nonzero_numerator = numerator != 0
+    nonzero_denominator = denominator != 0
+    output_scores = jnp.where(
+        nonzero_numerator & nonzero_denominator,
+        1.0 - numerator / jnp.where(nonzero_denominator, denominator, 1.0),
+        jnp.where(nonzero_numerator & ~nonzero_denominator, 0.0, jnp.ones_like(diff_avg)),
+    )
+
+    if multioutput == "raw_values":
+        return output_scores
+    if multioutput == "uniform_average":
+        return jnp.mean(output_scores)
+    if multioutput == "variance_weighted":
+        denom_sum = jnp.sum(denominator)
+        return jnp.sum(denominator / denom_sum * output_scores)
+    raise ValueError(f"Invalid input to argument `multioutput`. Choose one of the following: {multioutput}")
+
+
+def explained_variance(
+    preds: Array, target: Array, multioutput: str = "uniform_average"
+) -> Union[Array, Sequence[Array]]:
+    """Compute explained variance."""
+    n_obs, sum_error, ss_error, sum_target, ss_target = _explained_variance_update(
+        jnp.asarray(preds), jnp.asarray(target)
+    )
+    return _explained_variance_compute(
+        jnp.asarray(n_obs), sum_error, ss_error, sum_target, ss_target, multioutput
+    )

@@ -1,0 +1,35 @@
+"""MAPE.
+
+Parity: reference
+``torchmetrics/functional/regression/mean_absolute_percentage_error.py``.
+"""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+Array = jax.Array
+
+_EPSILON = 1.17e-06
+
+
+def _mean_absolute_percentage_error_update(
+    preds: Array, target: Array, epsilon: float = _EPSILON
+) -> Tuple[Array, int]:
+    _check_same_shape(preds, target)
+    abs_diff = jnp.abs(preds - target)
+    abs_per_error = abs_diff / jnp.clip(jnp.abs(target), epsilon, None)
+    sum_abs_per_error = jnp.sum(abs_per_error)
+    return sum_abs_per_error, target.size
+
+
+def _mean_absolute_percentage_error_compute(sum_abs_per_error: Array, num_obs: Array) -> Array:
+    return sum_abs_per_error / num_obs
+
+
+def mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
+    """Compute mean absolute percentage error."""
+    sum_abs_per_error, num_obs = _mean_absolute_percentage_error_update(jnp.asarray(preds), jnp.asarray(target))
+    return _mean_absolute_percentage_error_compute(sum_abs_per_error, num_obs)

@@ -1,0 +1,30 @@
+"""MSE / RMSE.
+
+Parity: reference ``torchmetrics/functional/regression/mean_squared_error.py``.
+"""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _mean_squared_error_update(preds: Array, target: Array) -> Tuple[Array, int]:
+    _check_same_shape(preds, target)
+    diff = preds - target
+    sum_squared_error = jnp.sum(diff * diff)
+    return sum_squared_error, target.size
+
+
+def _mean_squared_error_compute(sum_squared_error: Array, n_obs: Array, squared: bool = True) -> Array:
+    mse = sum_squared_error / n_obs
+    return mse if squared else jnp.sqrt(mse)
+
+
+def mean_squared_error(preds: Array, target: Array, squared: bool = True) -> Array:
+    """Compute MSE (or RMSE with squared=False)."""
+    sum_squared_error, n_obs = _mean_squared_error_update(jnp.asarray(preds), jnp.asarray(target))
+    return _mean_squared_error_compute(sum_squared_error, n_obs, squared=squared)

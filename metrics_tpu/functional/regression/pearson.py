@@ -1,0 +1,59 @@
+"""Pearson correlation coefficient (streaming Chan-style statistics).
+
+Parity: reference ``torchmetrics/functional/regression/pearson.py``
+(_pearson_corrcoef_update :22, _pearson_corrcoef_compute :64, pearson_corrcoef :85).
+"""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _pearson_corrcoef_update(
+    preds: Array,
+    target: Array,
+    mean_x: Array,
+    mean_y: Array,
+    var_x: Array,
+    var_y: Array,
+    corr_xy: Array,
+    n_prior: Array,
+) -> Tuple[Array, Array, Array, Array, Array, Array]:
+    """One streaming-statistics step over a batch."""
+    _check_same_shape(preds, target)
+    preds = jnp.squeeze(preds)
+    target = jnp.squeeze(target)
+    if preds.ndim > 1 or target.ndim > 1:
+        raise ValueError("Expected both predictions and target to be 1 dimensional tensors.")
+
+    n_obs = preds.size
+    mx_new = (n_prior * mean_x + jnp.mean(preds) * n_obs) / (n_prior + n_obs)
+    my_new = (n_prior * mean_y + jnp.mean(target) * n_obs) / (n_prior + n_obs)
+    n_new = n_prior + n_obs
+    var_x = var_x + jnp.sum((preds - mx_new) * (preds - mean_x))
+    var_y = var_y + jnp.sum((target - my_new) * (target - mean_y))
+    corr_xy = corr_xy + jnp.sum((preds - mx_new) * (target - mean_y))
+    return mx_new, my_new, var_x, var_y, corr_xy, n_new
+
+
+def _pearson_corrcoef_compute(var_x: Array, var_y: Array, corr_xy: Array, nb: Array) -> Array:
+    var_x = var_x / (nb - 1)
+    var_y = var_y / (nb - 1)
+    corr_xy = corr_xy / (nb - 1)
+    corrcoef = jnp.squeeze(corr_xy / jnp.sqrt(var_x * var_y))
+    return jnp.clip(corrcoef, -1.0, 1.0)
+
+
+def pearson_corrcoef(preds: Array, target: Array) -> Array:
+    """Compute the Pearson correlation coefficient."""
+    preds = jnp.asarray(preds, dtype=jnp.float32) if not jnp.issubdtype(jnp.asarray(preds).dtype, jnp.floating) else jnp.asarray(preds)
+    target = jnp.asarray(target, dtype=preds.dtype) if not jnp.issubdtype(jnp.asarray(target).dtype, jnp.floating) else jnp.asarray(target)
+    zero = jnp.zeros([], dtype=preds.dtype)
+    _, _, var_x, var_y, corr_xy, nb = _pearson_corrcoef_update(
+        preds, target, zero, zero, zero, zero, zero, zero
+    )
+    return _pearson_corrcoef_compute(var_x, var_y, corr_xy, nb)

@@ -1,0 +1,37 @@
+"""SMAPE.
+
+Parity: reference
+``torchmetrics/functional/regression/symmetric_mean_absolute_percentage_error.py``.
+"""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+Array = jax.Array
+
+_EPSILON = 1.17e-06
+
+
+def _symmetric_mean_absolute_percentage_error_update(
+    preds: Array, target: Array, epsilon: float = _EPSILON
+) -> Tuple[Array, int]:
+    _check_same_shape(preds, target)
+    abs_diff = jnp.abs(preds - target)
+    abs_per_error = abs_diff / jnp.clip(jnp.abs(target) + jnp.abs(preds), epsilon, None)
+    sum_abs_per_error = 2 * jnp.sum(abs_per_error)
+    return sum_abs_per_error, target.size
+
+
+def _symmetric_mean_absolute_percentage_error_compute(sum_abs_per_error: Array, num_obs: Array) -> Array:
+    return sum_abs_per_error / num_obs
+
+
+def symmetric_mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
+    """Compute symmetric mean absolute percentage error."""
+    sum_abs_per_error, num_obs = _symmetric_mean_absolute_percentage_error_update(
+        jnp.asarray(preds), jnp.asarray(target)
+    )
+    return _symmetric_mean_absolute_percentage_error_compute(sum_abs_per_error, num_obs)

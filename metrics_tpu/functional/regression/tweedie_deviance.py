@@ -1,0 +1,71 @@
+"""Tweedie deviance score.
+
+Parity: reference ``torchmetrics/functional/regression/tweedie_deviance.py``
+(_tweedie_deviance_score_update :22, _tweedie_deviance_score_compute :81,
+tweedie_deviance_score :102). Deviation: the Poisson branch uses ``xlogy`` so that
+``target == 0`` contributes 0 (the reference's ``target * log(target/preds)``
+produces NaN there; sklearn uses xlogy too).
+"""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import xlogy
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _tweedie_deviance_score_update(preds: Array, targets: Array, power: float = 0.0) -> Tuple[Array, Array]:
+    _check_same_shape(preds, targets)
+
+    if 0 < power < 1:
+        raise ValueError(f"Deviance Score is not defined for power={power}.")
+
+    eager = not isinstance(preds, jax.core.Tracer) and not isinstance(targets, jax.core.Tracer)
+    if power == 0:
+        deviance_score = (targets - preds) ** 2
+    elif power == 1:
+        if eager and (bool(jnp.any(preds <= 0)) or bool(jnp.any(targets < 0))):
+            raise ValueError(
+                f"For power={power}, 'preds' has to be strictly positive and 'targets' cannot be negative."
+            )
+        deviance_score = 2 * (xlogy(targets, targets / preds) + preds - targets)
+    elif power == 2:
+        if eager and (bool(jnp.any(preds <= 0)) or bool(jnp.any(targets <= 0))):
+            raise ValueError(f"For power={power}, both 'preds' and 'targets' have to be strictly positive.")
+        deviance_score = 2 * (jnp.log(preds / targets) + targets / preds - 1)
+    else:
+        if power < 0:
+            if eager and bool(jnp.any(preds <= 0)):
+                raise ValueError(f"For power={power}, 'preds' has to be strictly positive.")
+        elif 1 < power < 2:
+            if eager and (bool(jnp.any(preds <= 0)) or bool(jnp.any(targets < 0))):
+                raise ValueError(
+                    f"For power={power}, 'targets' has to be strictly positive and 'preds' cannot be negative."
+                )
+        else:
+            if eager and (bool(jnp.any(preds <= 0)) or bool(jnp.any(targets <= 0))):
+                raise ValueError(f"For power={power}, both 'preds' and 'targets' have to be strictly positive.")
+
+        term_1 = jnp.maximum(targets, 0.0) ** (2 - power) / ((1 - power) * (2 - power))
+        term_2 = targets * preds ** (1 - power) / (1 - power)
+        term_3 = preds ** (2 - power) / (2 - power)
+        deviance_score = 2 * (term_1 - term_2 + term_3)
+
+    sum_deviance_score = jnp.sum(deviance_score)
+    num_observations = jnp.asarray(deviance_score.size)
+    return sum_deviance_score, num_observations
+
+
+def _tweedie_deviance_score_compute(sum_deviance_score: Array, num_observations: Array) -> Array:
+    return sum_deviance_score / num_observations
+
+
+def tweedie_deviance_score(preds: Array, targets: Array, power: float = 0.0) -> Array:
+    """Compute the Tweedie deviance score for the given power."""
+    sum_deviance_score, num_observations = _tweedie_deviance_score_update(
+        jnp.asarray(preds), jnp.asarray(targets), power=power
+    )
+    return _tweedie_deviance_score_compute(sum_deviance_score, num_observations)

@@ -1,0 +1,35 @@
+"""MeanSquaredError module metric.
+
+Parity: reference ``torchmetrics/regression/mean_squared_error.py:26``.
+"""
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.regression.mean_squared_error import (
+    _mean_squared_error_compute,
+    _mean_squared_error_update,
+)
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class MeanSquaredError(Metric):
+    is_differentiable = True
+    higher_is_better = False
+
+    def __init__(self, squared: bool = True, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_squared_error", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+        self.squared = squared
+
+    def update(self, preds: Array, target: Array) -> None:
+        sum_squared_error, n_obs = _mean_squared_error_update(jnp.asarray(preds), jnp.asarray(target))
+        self.sum_squared_error = self.sum_squared_error + sum_squared_error
+        self.total = self.total + n_obs
+
+    def compute(self) -> Array:
+        return _mean_squared_error_compute(self.sum_squared_error, self.total, squared=self.squared)

@@ -1,0 +1,85 @@
+"""PearsonCorrCoef module metric — the custom-merge (dist_reduce_fx=None) archetype.
+
+Parity: reference ``torchmetrics/regression/pearson.py:56`` (states at :112-117,
+device-merge ``_final_aggregation`` at :24-53). After a mesh sync the stats arrive
+stacked ``(world, ...)`` and are folded with the Chan parallel-statistics formula at
+compute — the state-pattern-4 template from SURVEY.md §2.4.
+"""
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.regression.pearson import (
+    _pearson_corrcoef_compute,
+    _pearson_corrcoef_update,
+)
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+def _final_aggregation(
+    means_x: Array,
+    means_y: Array,
+    vars_x: Array,
+    vars_y: Array,
+    corrs_xy: Array,
+    nbs: Array,
+) -> Tuple[Array, Array, Array, Array]:
+    """Fold per-device streaming statistics with the Chan et al. parallel formula.
+
+    Parity target: reference ``pearson.py:24-53``. Deviation: the reference's merge
+    rescales var/corr sums as if they were normalised (a known upstream bug, fixed in
+    later torchmetrics releases); since the accumulated states here are exact *sums*
+    of squared deviations / cross products, the correct merge is the plain Chan
+    update: M2 = M2_1 + M2_2 + n1*n2/nb * (m1-m2)^2 (and the cross-product analogue).
+    The loop is over the (static) world size, so this traces fine under jit.
+    """
+    mx1, my1, vx1, vy1, cxy1, n1 = means_x[0], means_y[0], vars_x[0], vars_y[0], corrs_xy[0], nbs[0]
+    for i in range(1, means_x.shape[0]):
+        mx2, my2, vx2, vy2, cxy2, n2 = means_x[i], means_y[i], vars_x[i], vars_y[i], corrs_xy[i], nbs[i]
+        nb = n1 + n2
+        mean_x = (n1 * mx1 + n2 * mx2) / nb
+        mean_y = (n1 * my1 + n2 * my2) / nb
+        w = (n1 * n2) / nb
+        var_x = vx1 + vx2 + w * (mx1 - mx2) ** 2
+        var_y = vy1 + vy2 + w * (my1 - my2) ** 2
+        corr_xy = cxy1 + cxy2 + w * (mx1 - mx2) * (my1 - my2)
+        mx1, my1, vx1, vy1, cxy1, n1 = mean_x, mean_y, var_x, var_y, corr_xy, nb
+    return vx1, vy1, cxy1, n1
+
+
+class PearsonCorrCoef(Metric):
+    is_differentiable = True
+    higher_is_better = None
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("mean_x", default=jnp.asarray(0.0), dist_reduce_fx=None)
+        self.add_state("mean_y", default=jnp.asarray(0.0), dist_reduce_fx=None)
+        self.add_state("var_x", default=jnp.asarray(0.0), dist_reduce_fx=None)
+        self.add_state("var_y", default=jnp.asarray(0.0), dist_reduce_fx=None)
+        self.add_state("corr_xy", default=jnp.asarray(0.0), dist_reduce_fx=None)
+        self.add_state("n_total", default=jnp.asarray(0.0), dist_reduce_fx=None)
+
+    def update(self, preds: Array, target: Array) -> None:
+        preds = jnp.asarray(preds, dtype=jnp.float32) if not jnp.issubdtype(jnp.asarray(preds).dtype, jnp.floating) else jnp.asarray(preds)
+        target = jnp.asarray(target, dtype=preds.dtype) if not jnp.issubdtype(jnp.asarray(target).dtype, jnp.floating) else jnp.asarray(target)
+        self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total = _pearson_corrcoef_update(
+            preds, target, self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total
+        )
+
+    # forward() must snapshot/restore: the streaming stats merge jointly (Chan
+    # formula over the full state), not leaf-by-leaf
+    full_state_update = True
+
+    def compute(self) -> Array:
+        if self.mean_x.ndim > 0 and self.mean_x.shape[0] > 1:
+            # post-sync: stats stacked (world, ...) -> fold with Chan formula
+            var_x, var_y, corr_xy, n_total = _final_aggregation(
+                self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total
+            )
+        else:
+            var_x, var_y, corr_xy, n_total = self.var_x, self.var_y, self.corr_xy, self.n_total
+        return _pearson_corrcoef_compute(var_x, var_y, corr_xy, n_total)

@@ -1,0 +1,45 @@
+"""SpearmanCorrCoef module metric.
+
+Parity: reference ``torchmetrics/regression/spearman.py:26`` (cat states :80-81,
+ranking at compute).
+"""
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.regression.spearman import (
+    _spearman_corrcoef_compute,
+    _spearman_corrcoef_update,
+)
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.data import dim_zero_cat
+from metrics_tpu.utils.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+class SpearmanCorrCoef(Metric):
+    is_differentiable = False
+    higher_is_better = True
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        rank_zero_warn(
+            "Metric `SpearmanCorrcoef` will save all targets and predictions in the buffer."
+            " For large datasets, this may lead to large memory footprint."
+        )
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+        self.add_state("target", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        preds = jnp.asarray(preds, dtype=jnp.float32) if not jnp.issubdtype(jnp.asarray(preds).dtype, jnp.floating) else jnp.asarray(preds)
+        target = jnp.asarray(target, dtype=preds.dtype) if not jnp.issubdtype(jnp.asarray(target).dtype, jnp.floating) else jnp.asarray(target)
+        preds, target = _spearman_corrcoef_update(preds, target)
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def compute(self) -> Array:
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        return _spearman_corrcoef_compute(preds, target)

@@ -1,0 +1,52 @@
+"""Pairwise functionals vs sklearn.
+
+Parity model: reference ``tests/pairwise/test_pairwise_distance.py``.
+"""
+import numpy as np
+import pytest
+from sklearn.metrics.pairwise import (
+    cosine_similarity as sk_cosine,
+    euclidean_distances as sk_euclidean,
+    linear_kernel as sk_linear,
+    manhattan_distances as sk_manhattan,
+)
+
+from metrics_tpu.functional import (
+    pairwise_cosine_similarity,
+    pairwise_euclidean_distance,
+    pairwise_linear_similarity,
+    pairwise_manhatten_distance,
+)
+from tests.helpers import seed_all
+
+seed_all(42)
+_x = np.random.rand(32, 10).astype(np.float64)
+_y = np.random.rand(20, 10).astype(np.float64)
+
+
+@pytest.mark.parametrize(
+    "metric_fn,sk_fn",
+    [
+        (pairwise_cosine_similarity, sk_cosine),
+        (pairwise_euclidean_distance, sk_euclidean),
+        (pairwise_linear_similarity, sk_linear),
+        (pairwise_manhatten_distance, sk_manhattan),
+    ],
+)
+@pytest.mark.parametrize("with_y", [True, False])
+def test_pairwise(metric_fn, sk_fn, with_y):
+    if with_y:
+        res = np.asarray(metric_fn(_x, _y))
+        expected = sk_fn(_x, _y)
+    else:
+        res = np.asarray(metric_fn(_x))
+        expected = sk_fn(_x, _x)
+        np.fill_diagonal(expected, 0)
+    np.testing.assert_allclose(res, expected, atol=1e-5)
+
+
+@pytest.mark.parametrize("reduction,np_reduce", [("mean", np.mean), ("sum", np.sum)])
+def test_pairwise_reduction(reduction, np_reduce):
+    res = np.asarray(pairwise_linear_similarity(_x, _y, reduction=reduction))
+    expected = np_reduce(sk_linear(_x, _y), axis=-1)
+    np.testing.assert_allclose(res, expected, atol=1e-5)

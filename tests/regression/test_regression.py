@@ -1,0 +1,223 @@
+"""All regression metrics vs sklearn/scipy oracles.
+
+Parity model: reference ``tests/regression/*`` (condensed into one matrix).
+"""
+import numpy as np
+import pytest
+from scipy.stats import pearsonr, spearmanr
+from sklearn.metrics import (
+    explained_variance_score,
+    mean_absolute_error as sk_mae,
+    mean_absolute_percentage_error as sk_mape,
+    mean_squared_error as sk_mse,
+    mean_squared_log_error as sk_msle,
+    mean_tweedie_deviance,
+    r2_score as sk_r2,
+)
+
+from metrics_tpu import (
+    CosineSimilarity,
+    ExplainedVariance,
+    MeanAbsoluteError,
+    MeanAbsolutePercentageError,
+    MeanSquaredError,
+    MeanSquaredLogError,
+    PearsonCorrCoef,
+    R2Score,
+    SpearmanCorrCoef,
+    SymmetricMeanAbsolutePercentageError,
+    TweedieDevianceScore,
+)
+from metrics_tpu.functional import (
+    cosine_similarity,
+    explained_variance,
+    mean_absolute_error,
+    mean_absolute_percentage_error,
+    mean_squared_error,
+    mean_squared_log_error,
+    pearson_corrcoef,
+    r2_score,
+    spearman_corrcoef,
+    symmetric_mean_absolute_percentage_error,
+    tweedie_deviance_score,
+)
+from tests.helpers import seed_all
+from tests.helpers.testers import BATCH_SIZE, NUM_BATCHES, MetricTester
+
+seed_all(42)
+
+_preds = np.random.rand(NUM_BATCHES, BATCH_SIZE) + 0.1
+_target = np.random.rand(NUM_BATCHES, BATCH_SIZE) + 0.1
+
+_preds_multi = np.random.rand(NUM_BATCHES, BATCH_SIZE, 4) + 0.1
+_target_multi = np.random.rand(NUM_BATCHES, BATCH_SIZE, 4) + 0.1
+
+
+def _sk_smape(preds, target):
+    p, t = np.asarray(preds).ravel(), np.asarray(target).ravel()
+    return np.mean(2 * np.abs(p - t) / (np.abs(p) + np.abs(t)))
+
+
+def _sk_cosine_sum(preds, target):
+    p, t = np.asarray(preds), np.asarray(target)
+    sim = (p * t).sum(-1) / (np.linalg.norm(p, axis=-1) * np.linalg.norm(t, axis=-1))
+    return sim.sum()
+
+
+def _sk_pearson(preds, target):
+    return pearsonr(np.asarray(target).ravel(), np.asarray(preds).ravel())[0]
+
+
+def _sk_spearman(preds, target):
+    return spearmanr(np.asarray(target).ravel(), np.asarray(preds).ravel())[0]
+
+
+_simple_cases = [
+    pytest.param(MeanSquaredError, mean_squared_error, lambda p, t: sk_mse(t.ravel(), p.ravel()), {}, id="mse"),
+    pytest.param(
+        MeanSquaredError, mean_squared_error, lambda p, t: np.sqrt(sk_mse(t.ravel(), p.ravel())),
+        {"squared": False}, id="rmse",
+    ),
+    pytest.param(MeanAbsoluteError, mean_absolute_error, lambda p, t: sk_mae(t.ravel(), p.ravel()), {}, id="mae"),
+    pytest.param(
+        MeanAbsolutePercentageError, mean_absolute_percentage_error,
+        lambda p, t: sk_mape(t.ravel(), p.ravel()), {}, id="mape",
+    ),
+    pytest.param(
+        SymmetricMeanAbsolutePercentageError, symmetric_mean_absolute_percentage_error, _sk_smape, {}, id="smape",
+    ),
+    pytest.param(
+        MeanSquaredLogError, mean_squared_log_error, lambda p, t: sk_msle(t.ravel(), p.ravel()), {}, id="msle",
+    ),
+    pytest.param(
+        TweedieDevianceScore, tweedie_deviance_score,
+        lambda p, t: mean_tweedie_deviance(t.ravel(), p.ravel(), power=1.0), {"power": 1.0}, id="tweedie_p1",
+    ),
+]
+
+
+class TestSimpleRegression(MetricTester):
+    atol = 1e-5
+
+    @pytest.mark.parametrize("metric_class,metric_fn,sk_fn,args", _simple_cases)
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, metric_class, metric_fn, sk_fn, args, ddp):
+        self.run_class_metric_test(
+            ddp=ddp, preds=_preds, target=_target, metric_class=metric_class, sk_metric=sk_fn,
+            metric_args=args,
+        )
+
+    @pytest.mark.parametrize("metric_class,metric_fn,sk_fn,args", _simple_cases)
+    def test_fn(self, metric_class, metric_fn, sk_fn, args):
+        fn_args = {k: v for k, v in args.items()}
+        self.run_functional_metric_test(
+            preds=_preds, target=_target, metric_functional=metric_fn, sk_metric=sk_fn, metric_args=fn_args,
+        )
+
+
+class TestExplainedVariance(MetricTester):
+    atol = 1e-4  # f32 streaming sums vs sklearn f64
+
+    @pytest.mark.parametrize("multioutput", ["uniform_average", "raw_values", "variance_weighted"])
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, multioutput, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_preds_multi,
+            target=_target_multi,
+            metric_class=ExplainedVariance,
+            sk_metric=lambda p, t: explained_variance_score(
+                t.reshape(-1, 4), p.reshape(-1, 4), multioutput=multioutput
+            ),
+            metric_args={"multioutput": multioutput},
+        )
+
+    def test_fn(self):
+        self.run_functional_metric_test(
+            preds=_preds_multi,
+            target=_target_multi,
+            metric_functional=explained_variance,
+            sk_metric=lambda p, t: explained_variance_score(t.reshape(-1, 4), p.reshape(-1, 4)),
+        )
+
+
+class TestR2(MetricTester):
+    atol = 1e-5
+
+    @pytest.mark.parametrize("multioutput", ["uniform_average", "raw_values"])
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, multioutput, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_preds_multi,
+            target=_target_multi,
+            metric_class=R2Score,
+            sk_metric=lambda p, t: sk_r2(t.reshape(-1, 4), p.reshape(-1, 4), multioutput=multioutput),
+            metric_args={"num_outputs": 4, "multioutput": multioutput},
+            check_batch=False,
+        )
+
+    def test_fn_adjusted(self):
+        p, t = _preds[0], _target[0]
+        res = float(r2_score(p, t, adjusted=2))
+        n = len(p)
+        expected = 1 - (1 - sk_r2(t, p)) * (n - 1) / (n - 2 - 1)
+        np.testing.assert_allclose(res, expected, atol=1e-5)
+
+
+class TestPearson(MetricTester):
+    atol = 1e-4  # streaming f32 statistics vs scipy f64
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, ddp):
+        self.run_class_metric_test(
+            ddp=ddp, preds=_preds, target=_target, metric_class=PearsonCorrCoef, sk_metric=_sk_pearson,
+            check_batch=False,
+        )
+
+    def test_fn(self):
+        self.run_functional_metric_test(
+            preds=_preds, target=_target, metric_functional=pearson_corrcoef, sk_metric=_sk_pearson, atol=1e-4,
+        )
+
+
+class TestSpearman(MetricTester):
+    atol = 1e-5
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, ddp):
+        self.run_class_metric_test(
+            ddp=ddp, preds=_preds, target=_target, metric_class=SpearmanCorrCoef, sk_metric=_sk_spearman,
+            check_batch=False,
+        )
+
+    def test_fn_with_ties(self):
+        rng = np.random.RandomState(0)
+        p = rng.randint(0, 10, 200).astype(np.float32)
+        t = rng.randint(0, 10, 200).astype(np.float32)
+        res = float(spearman_corrcoef(p, t))
+        expected = spearmanr(t, p)[0]
+        np.testing.assert_allclose(res, expected, atol=1e-5)
+
+
+class TestCosine(MetricTester):
+    atol = 1e-5
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_preds_multi,
+            target=_target_multi,
+            metric_class=CosineSimilarity,
+            sk_metric=lambda p, t: _sk_cosine_sum(p.reshape(-1, 4), t.reshape(-1, 4)),
+            metric_args={"reduction": "sum"},
+        )
+
+    def test_fn(self):
+        self.run_functional_metric_test(
+            preds=_preds_multi,
+            target=_target_multi,
+            metric_functional=cosine_similarity,
+            sk_metric=lambda p, t: _sk_cosine_sum(p, t),
+        )
