@@ -1,0 +1,139 @@
+"""Benchmark: fused MetricCollection update throughput on one chip.
+
+Measures the headline north-star proxy (BASELINE.md): samples/sec/chip through a
+``MetricCollection(Accuracy, F1, BinnedAveragePrecision)`` multiclass metric step —
+the whole update path jit-compiled as ONE fused kernel with state carried on device.
+
+``vs_baseline``: same collection, same data, through the reference implementation
+(TorchMetrics v0.7 at /root/reference, torch CPU) — the reference has no TPU path, so
+its CPU eager throughput IS its best number on this host. Ratio > 1 means faster.
+
+Prints exactly one JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+NUM_CLASSES = 10
+BATCH = 4096
+WARMUP = 5
+ITERS = 30
+
+
+def _data():
+    rng = np.random.RandomState(0)
+    preds = rng.rand(BATCH, NUM_CLASSES).astype(np.float32)
+    preds /= preds.sum(axis=1, keepdims=True)
+    target = rng.randint(0, NUM_CLASSES, BATCH)
+    return preds, target
+
+
+def bench_tpu() -> float:
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, BinnedAveragePrecision, F1Score, MetricCollection
+
+    coll = MetricCollection(
+        {
+            "acc": Accuracy(),
+            "f1": F1Score(num_classes=NUM_CLASSES, average="macro"),
+            "binned_ap": BinnedAveragePrecision(num_classes=NUM_CLASSES, thresholds=100),
+        }
+    )
+    preds_np, target_np = _data()
+    preds = jnp.asarray(preds_np)
+    target = jnp.asarray(target_np)
+
+    @jax.jit
+    def step(state, p, t):
+        return coll.update_state(state, p, t)
+
+    state = coll.init_state()
+    for _ in range(WARMUP):
+        state = step(state, preds, target)
+    jax.block_until_ready(jax.tree.leaves(state))
+
+    state = coll.init_state()
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        state = step(state, preds, target)
+    jax.block_until_ready(jax.tree.leaves(state))
+    dt = time.perf_counter() - t0
+    # sanity: values are finite
+    vals = coll.compute_from(state)
+    assert np.isfinite(float(vals["acc"]))
+    return ITERS * BATCH / dt
+
+
+def bench_reference() -> float:
+    try:
+        sys.path.insert(0, "/root/reference")
+        # the reference imports pkg_resources (removed in py3.12 setuptools); shim it
+        if "pkg_resources" not in sys.modules:
+            import types
+
+            shim = types.ModuleType("pkg_resources")
+
+            class DistributionNotFound(Exception):
+                pass
+
+            def get_distribution(name):
+                raise DistributionNotFound(name)
+
+            shim.DistributionNotFound = DistributionNotFound
+            shim.get_distribution = get_distribution
+            sys.modules["pkg_resources"] = shim
+        import torch
+
+        from torchmetrics import Accuracy as TAccuracy, F1Score as TF1, MetricCollection as TColl
+        from torchmetrics import BinnedAveragePrecision as TBAP
+
+        torch.set_num_threads(max(1, torch.get_num_threads()))
+        coll = TColl(
+            {
+                "acc": TAccuracy(),
+                "f1": TF1(num_classes=NUM_CLASSES, average="macro"),
+                "binned_ap": TBAP(num_classes=NUM_CLASSES, thresholds=100),
+            }
+        )
+        preds_np, target_np = _data()
+        preds = torch.from_numpy(preds_np)
+        target = torch.from_numpy(target_np)
+
+        for _ in range(WARMUP):
+            coll.update(preds, target)
+        for m in coll.values():
+            m.reset()
+        t0 = time.perf_counter()
+        for _ in range(ITERS):
+            coll.update(preds, target)
+        dt = time.perf_counter() - t0
+        return ITERS * BATCH / dt
+    except Exception:
+        return float("nan")
+    finally:
+        if "/root/reference" in sys.path:
+            sys.path.remove("/root/reference")
+
+
+def main() -> None:
+    tpu_throughput = bench_tpu()
+    ref_throughput = bench_reference()
+    vs = tpu_throughput / ref_throughput if np.isfinite(ref_throughput) and ref_throughput > 0 else None
+    print(
+        json.dumps(
+            {
+                "metric": "fused_collection_update_throughput",
+                "value": round(tpu_throughput, 1),
+                "unit": "samples/s/chip",
+                "vs_baseline": round(vs, 3) if vs is not None else None,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -67,6 +67,12 @@ from metrics_tpu.regression import (  # noqa: E402
 )
 from metrics_tpu.metric import CompositionalMetric, Metric  # noqa: E402
 from metrics_tpu.parallel import MeshConfig, metric_axis  # noqa: E402
+from metrics_tpu.wrappers import (  # noqa: E402
+    BootStrapper,
+    MetricTracker,
+    MinMaxMetric,
+    MultioutputWrapper,
+)
 from metrics_tpu import functional  # noqa: E402
 
 __all__ = [
@@ -76,6 +82,10 @@ __all__ = [
     "AveragePrecision",
     "BaseAggregator",
     "BinnedAveragePrecision",
+    "BootStrapper",
+    "MetricTracker",
+    "MinMaxMetric",
+    "MultioutputWrapper",
     "BinnedPrecisionRecallCurve",
     "BinnedRecallAtFixedPrecision",
     "CalibrationError",

@@ -121,11 +121,11 @@ class CatMetric(BaseAggregator):
         super().__init__("cat", [], nan_strategy, **kwargs)
 
     def update(self, value: Union[float, Array]) -> None:
-        value = self._cast_and_nan_check_input(value)
+        value = jnp.atleast_1d(self._cast_and_nan_check_input(value))
         if isinstance(self.nan_strategy, (int, float)) and not isinstance(self.nan_strategy, str):
             value = self._nan_mask_or_impute(value, 0.0)
         elif not isinstance(jnp.sum(value), jax.core.Tracer):
-            value = value[~jnp.isnan(jnp.atleast_1d(value))]
+            value = value[~jnp.isnan(value)]
         if value.size:
             self.value.append(value)
 

@@ -1,0 +1,4 @@
+from metrics_tpu.wrappers.bootstrapping import BootStrapper
+from metrics_tpu.wrappers.minmax import MinMaxMetric
+from metrics_tpu.wrappers.multioutput import MultioutputWrapper
+from metrics_tpu.wrappers.tracker import MetricTracker

@@ -1,0 +1,115 @@
+"""BootStrapper wrapper: bootstrapped confidence estimates for any metric.
+
+Parity: reference ``torchmetrics/wrappers/bootstrapping.py:49`` (_bootstrap_sampler
+:25, per-update resampling :138-155, compute mean/std/quantile/raw :157).
+
+Sampling runs host-side with numpy (eval-time wrapper; resampling indices are data
+layout, not device compute). The resampled batch update itself is jnp on device.
+"""
+from copy import deepcopy
+from typing import Any, Dict, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.data import apply_to_collection
+
+Array = jax.Array
+
+
+def _bootstrap_sampler(
+    size: int,
+    sampling_strategy: str = "poisson",
+    rng: Optional[np.random.RandomState] = None,
+) -> Array:
+    """Resampling indices for one bootstrap draw. Parity: reference ``:25-46``."""
+    rng = rng or np.random
+    if sampling_strategy == "poisson":
+        n = rng.poisson(1, size)
+        return jnp.asarray(np.repeat(np.arange(size), n))
+    if sampling_strategy == "multinomial":
+        return jnp.asarray(rng.randint(0, size, size))
+    raise ValueError("Unknown sampling strategy")
+
+
+class BootStrapper(Metric):
+    """Computes bootstrapped mean/std/quantile of a base metric."""
+
+    def __init__(
+        self,
+        base_metric: Metric,
+        num_bootstraps: int = 10,
+        mean: bool = True,
+        std: bool = True,
+        quantile: Optional[Union[float, Array]] = None,
+        raw: bool = False,
+        sampling_strategy: str = "poisson",
+        seed: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(base_metric, Metric):
+            raise ValueError(
+                f"Expected base metric to be an instance of `metrics_tpu.Metric` but received {base_metric}"
+            )
+        self.metrics = [deepcopy(base_metric) for _ in range(num_bootstraps)]
+        self.num_bootstraps = num_bootstraps
+
+        self.mean = mean
+        self.std = std
+        self.quantile = quantile
+        self.raw = raw
+
+        allowed_sampling = ("poisson", "multinomial")
+        if sampling_strategy not in allowed_sampling:
+            raise ValueError(
+                f"Expected argument ``sampling_strategy`` to be one of {allowed_sampling}"
+                f" but received {sampling_strategy}"
+            )
+        self.sampling_strategy = sampling_strategy
+        self._rng = np.random.RandomState(seed)
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Resample the batch per bootstrap replica and update it. Parity: ``:138-155``."""
+        for idx in range(self.num_bootstraps):
+            args_sizes = apply_to_collection(args, jax.Array, lambda x: x.shape[0])
+            kwargs_sizes = apply_to_collection(kwargs, jax.Array, lambda x: x.shape[0])
+            if len(args_sizes) > 0:
+                size = args_sizes[0]
+            elif len(kwargs_sizes) > 0:
+                size = next(iter(kwargs_sizes.values()))
+            else:
+                raise ValueError("None of the input contained tensors, so could not determine the sampling size")
+            sample_idx = _bootstrap_sampler(size, self.sampling_strategy, self._rng)
+            if sample_idx.size == 0:
+                continue
+            new_args = apply_to_collection(args, jax.Array, jnp.take, sample_idx, axis=0)
+            new_kwargs = apply_to_collection(kwargs, jax.Array, jnp.take, sample_idx, axis=0)
+            self.metrics[idx].update(*new_args, **new_kwargs)
+
+    def compute(self) -> Dict[str, Array]:
+        """Mean/std/quantile/raw over the bootstrap dim. Parity: ``:157-176``."""
+        computed_vals = jnp.stack([m.compute() for m in self.metrics], axis=0)
+        output_dict = {}
+        if self.mean:
+            output_dict["mean"] = jnp.mean(computed_vals, axis=0)
+        if self.std:
+            output_dict["std"] = jnp.std(computed_vals, axis=0, ddof=1)
+        if self.quantile is not None:
+            output_dict["quantile"] = jnp.quantile(computed_vals, self.quantile, axis=0)
+        if self.raw:
+            output_dict["raw"] = computed_vals
+        return output_dict
+
+    def reset(self) -> None:
+        for m in self.metrics:
+            m.reset()
+        super().reset()
+
+    def _pack_state(self) -> Dict[str, Any]:
+        return {}
+
+    def _load_state(self, state: Dict[str, Any]) -> None:
+        pass

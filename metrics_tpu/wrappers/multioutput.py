@@ -1,0 +1,93 @@
+"""MultioutputWrapper: apply a metric independently along an output dimension.
+
+Parity: reference ``torchmetrics/wrappers/multioutput.py:23`` (N internal clones
+indexed along ``output_dim``, optional NaN-row removal :11,116).
+"""
+from copy import deepcopy
+from typing import Any, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.data import apply_to_collection
+
+Array = jax.Array
+
+
+def _get_nan_indices(*tensors: Array) -> Array:
+    """Rows where ANY of the tensors has a NaN. Parity: reference ``:11-21``."""
+    if len(tensors) == 0:
+        raise ValueError("Must pass at least one tensor as argument")
+    sentinel = tensors[0]
+    nan_idxs = jnp.zeros(len(sentinel), dtype=bool)
+    for tensor in tensors:
+        permuted = tensor.reshape(len(sentinel), -1)
+        nan_idxs = nan_idxs | jnp.any(jnp.isnan(permuted), axis=1)
+    return nan_idxs
+
+
+class MultioutputWrapper(Metric):
+    """Evaluate ``base_metric`` separately on each slice along ``output_dim``."""
+
+    is_differentiable = False
+
+    def __init__(
+        self,
+        base_metric: Metric,
+        num_outputs: int,
+        output_dim: int = -1,
+        remove_nans: bool = True,
+        squeeze_outputs: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.metrics = [deepcopy(base_metric) for _ in range(num_outputs)]
+        self.output_dim = output_dim
+        self.remove_nans = remove_nans
+        self.squeeze_outputs = squeeze_outputs
+
+    def _get_args_kwargs_by_output(self, *args: Array, **kwargs: Array) -> List[Tuple]:
+        """Slice inputs per output index. NaN rows are dropped eagerly (data-dependent
+        shape — eager-only, like the reference's boolean indexing)."""
+        args_kwargs_by_output = []
+        for i in range(len(self.metrics)):
+            selected_args = apply_to_collection(
+                args, jax.Array, jnp.take, jnp.asarray([i]), axis=self.output_dim
+            )
+            selected_kwargs = apply_to_collection(
+                kwargs, jax.Array, jnp.take, jnp.asarray([i]), axis=self.output_dim
+            )
+            if self.remove_nans:
+                tensors = list(selected_args) + list(selected_kwargs.values())
+                if tensors:
+                    nan_idxs = _get_nan_indices(*tensors)
+                    keep = ~nan_idxs
+                    selected_args = [arg[keep] for arg in selected_args]
+                    selected_kwargs = {k: v[keep] for k, v in selected_kwargs.items()}
+            if self.squeeze_outputs:
+                selected_args = [jnp.squeeze(arg, axis=self.output_dim) for arg in selected_args]
+            args_kwargs_by_output.append((selected_args, selected_kwargs))
+        return args_kwargs_by_output
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        reshaped_args_kwargs = self._get_args_kwargs_by_output(*args, **kwargs)
+        for metric, (selected_args, selected_kwargs) in zip(self.metrics, reshaped_args_kwargs):
+            metric.update(*selected_args, **selected_kwargs)
+
+    def compute(self) -> Array:
+        return jnp.stack([m.compute() for m in self.metrics], 0)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        results = []
+        reshaped_args_kwargs = self._get_args_kwargs_by_output(*args, **kwargs)
+        for metric, (selected_args, selected_kwargs) in zip(self.metrics, reshaped_args_kwargs):
+            results.append(metric(*selected_args, **selected_kwargs))
+        if results[0] is None:
+            return None
+        return jnp.stack(results, 0)
+
+    def reset(self) -> None:
+        for metric in self.metrics:
+            metric.reset()
+        Metric.reset(self)

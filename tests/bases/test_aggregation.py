@@ -1,0 +1,79 @@
+"""Aggregation metrics.
+
+Parity model: reference ``tests/bases/test_aggregation.py`` (condensed).
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import CatMetric, MaxMetric, MeanMetric, MinMetric, SumMetric
+
+
+def test_sum():
+    m = SumMetric()
+    for v in [1.0, 2.0, 3.5]:
+        m.update(v)
+    assert float(m.compute()) == 6.5
+
+
+def test_mean_weighted():
+    m = MeanMetric()
+    m.update(jnp.asarray([1.0, 2.0]), weight=jnp.asarray([1.0, 3.0]))
+    assert float(m.compute()) == pytest.approx((1 + 6) / 4)
+
+
+def test_max_min():
+    mx, mn = MaxMetric(), MinMetric()
+    for v in [2.0, -1.0, 5.0]:
+        mx.update(v)
+        mn.update(v)
+    assert float(mx.compute()) == 5.0
+    assert float(mn.compute()) == -1.0
+
+
+def test_cat():
+    m = CatMetric()
+    m.update(jnp.asarray([1.0, 2.0]))
+    m.update(3.0)
+    np.testing.assert_allclose(np.asarray(m.compute()), [1, 2, 3])
+
+
+def test_nan_error():
+    m = SumMetric(nan_strategy="error")
+    with pytest.raises(RuntimeError, match="nan"):
+        m.update(jnp.asarray([1.0, jnp.nan]))
+
+
+def test_nan_ignore():
+    m = SumMetric(nan_strategy="ignore")
+    m.update(jnp.asarray([1.0, jnp.nan, 2.0]))
+    assert float(m.compute()) == 3.0
+
+
+def test_nan_impute():
+    m = SumMetric(nan_strategy=10.0)
+    m.update(jnp.asarray([1.0, jnp.nan]))
+    assert float(m.compute()) == 11.0
+
+
+def test_mean_nan_ignore_drops_weight():
+    m = MeanMetric(nan_strategy="ignore")
+    m.update(jnp.asarray([1.0, jnp.nan, 3.0]))
+    assert float(m.compute()) == pytest.approx(2.0)
+
+
+@pytest.mark.parametrize("cls", [SumMetric, MeanMetric, MaxMetric, MinMetric])
+def test_aggregators_jittable(cls):
+    import jax
+
+    m = cls(nan_strategy="ignore")
+
+    @jax.jit
+    def step(state, x):
+        return m.update_state(state, x)
+
+    s = m.init_state()
+    s = step(s, jnp.asarray([1.0, 2.0]))
+    s = step(s, jnp.asarray([3.0]))
+    val = jax.jit(m.compute_from)(s)
+    assert np.isfinite(float(val))

@@ -1,0 +1,89 @@
+"""CompositionalMetric operator overloads.
+
+Parity model: reference ``tests/bases/test_composition.py:47-560`` (condensed).
+"""
+import jax.numpy as jnp
+import pytest
+
+from metrics_tpu import CompositionalMetric
+from tests.helpers.testers import DummyMetricSum
+
+
+def _make(x=5.0):
+    m = DummyMetricSum()
+    m.update(jnp.asarray(x))
+    return m
+
+
+@pytest.mark.parametrize(
+    "op,expected",
+    [
+        (lambda a, b: a + b, 8.0),
+        (lambda a, b: a - b, 2.0),
+        (lambda a, b: a * b, 15.0),
+        (lambda a, b: a / b, 5.0 / 3.0),
+        (lambda a, b: a // b, 1.0),
+        (lambda a, b: a % b, 2.0),
+        (lambda a, b: a ** b, 125.0),
+    ],
+)
+def test_arithmetic_two_metrics(op, expected):
+    a, b = _make(5.0), _make(3.0)
+    comp = op(a, b)
+    assert isinstance(comp, CompositionalMetric)
+    assert float(comp.compute()) == pytest.approx(expected)
+
+
+@pytest.mark.parametrize(
+    "op,expected",
+    [
+        (lambda a: a + 2.0, 7.0),
+        (lambda a: 2.0 + a, 7.0),
+        (lambda a: a * 2.0, 10.0),
+        (lambda a: 10.0 - a, 5.0),
+        (lambda a: a / 2.0, 2.5),
+        (lambda a: abs(-1.0 * a), 5.0),
+        (lambda a: -a, -5.0),
+    ],
+)
+def test_arithmetic_with_scalar(op, expected):
+    comp = op(_make(5.0))
+    assert float(comp.compute()) == pytest.approx(expected)
+
+
+@pytest.mark.parametrize(
+    "op,expected",
+    [
+        (lambda a, b: a == b, False),
+        (lambda a, b: a != b, True),
+        (lambda a, b: a < b, False),
+        (lambda a, b: a > b, True),
+        (lambda a, b: a <= b, False),
+        (lambda a, b: a >= b, True),
+    ],
+)
+def test_comparisons(op, expected):
+    comp = op(_make(5.0), _make(3.0))
+    assert bool(comp.compute()) is expected
+
+
+def test_nested_composition():
+    a, b = _make(5.0), _make(3.0)
+    comp = (a + b) * 2.0
+    assert float(comp.compute()) == 16.0
+
+
+def test_composition_forward():
+    a = DummyMetricSum()
+    b = DummyMetricSum()
+    comp = a + b
+    out = comp(jnp.asarray(2.0))
+    assert float(out) == 4.0
+
+
+def test_composition_reset():
+    a, b = _make(5.0), _make(3.0)
+    comp = a + b
+    assert float(comp.compute()) == 8.0
+    comp.reset()
+    assert float(comp.compute()) == 0.0

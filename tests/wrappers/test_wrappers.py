@@ -1,0 +1,126 @@
+"""Wrapper metrics: BootStrapper, MetricTracker, MinMaxMetric, MultioutputWrapper.
+
+Parity model: reference ``tests/wrappers/*``.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import (
+    Accuracy,
+    BootStrapper,
+    MeanSquaredError,
+    MetricCollection,
+    MetricTracker,
+    MinMaxMetric,
+    MultioutputWrapper,
+    Precision,
+    Recall,
+)
+from tests.helpers import seed_all
+
+seed_all(42)
+
+
+class TestBootStrapper:
+    def test_output_keys(self):
+        m = BootStrapper(MeanSquaredError(), num_bootstraps=5, quantile=0.5, raw=True, seed=0)
+        for _ in range(3):
+            m.update(jnp.asarray(np.random.rand(32)), jnp.asarray(np.random.rand(32)))
+        out = m.compute()
+        assert set(out) == {"mean", "std", "quantile", "raw"}
+        assert out["raw"].shape == (5,)
+        # bootstrap mean should be near the non-bootstrapped value
+        base = MeanSquaredError()
+        assert abs(float(out["mean"])) < 1.0
+
+    def test_sampling_strategies(self):
+        for strategy in ("poisson", "multinomial"):
+            m = BootStrapper(MeanSquaredError(), num_bootstraps=3, sampling_strategy=strategy, seed=1)
+            m.update(jnp.asarray(np.random.rand(16)), jnp.asarray(np.random.rand(16)))
+            out = m.compute()
+            assert "mean" in out
+
+    def test_invalid_base(self):
+        with pytest.raises(ValueError, match="Expected base metric"):
+            BootStrapper(42)
+
+
+class TestMetricTracker:
+    def test_single_metric(self):
+        tracker = MetricTracker(Accuracy(), maximize=True)
+        vals = []
+        for epoch in range(3):
+            tracker.increment()
+            preds = jnp.asarray(np.random.rand(64))
+            target = jnp.asarray((np.random.rand(64) > 0.2).astype(int))
+            tracker.update(preds, target)
+            vals.append(float(tracker.compute()))
+        all_res = tracker.compute_all()
+        assert all_res.shape == (3,)
+        np.testing.assert_allclose(np.asarray(all_res), vals, atol=1e-6)
+        best_idx, best = tracker.best_metric(return_step=True)
+        assert best == max(vals)
+        assert best_idx == int(np.argmax(vals))
+
+    def test_collection(self):
+        tracker = MetricTracker(MetricCollection([Precision(), Recall()]), maximize=[True, True])
+        for _ in range(2):
+            tracker.increment()
+            preds = jnp.asarray(np.random.rand(64))
+            target = jnp.asarray((np.random.rand(64) > 0.5).astype(int))
+            tracker.update(preds, target)
+        res = tracker.compute_all()
+        assert set(res) == {"Precision", "Recall"}
+        assert res["Precision"].shape == (2,)
+        best = tracker.best_metric()
+        assert set(best) == {"Precision", "Recall"}
+
+    def test_raises_before_increment(self):
+        tracker = MetricTracker(Accuracy())
+        with pytest.raises(ValueError, match="cannot be called before"):
+            tracker.compute()
+
+
+class TestMinMax:
+    def test_tracks_extremes(self):
+        m = MinMaxMetric(MeanSquaredError())
+        m.update(jnp.ones(4), jnp.ones(4) * 2.0)  # mse 1.0
+        out1 = m.compute()
+        assert float(out1["raw"]) == 1.0 and float(out1["min"]) == 1.0 and float(out1["max"]) == 1.0
+        m._base_metric.reset()
+        m.update(jnp.ones(4), jnp.ones(4) * 3.0)  # mse 4.0
+        m._computed = None
+        out2 = m.compute()
+        assert float(out2["raw"]) == 4.0
+        assert float(out2["max"]) == 4.0
+        assert float(out2["min"]) == 1.0
+
+    def test_reset(self):
+        m = MinMaxMetric(MeanSquaredError())
+        m.update(jnp.ones(4), jnp.ones(4) * 2.0)
+        m.compute()
+        m.reset()
+        assert float(m.min_val) == np.inf
+
+
+class TestMultioutput:
+    def test_mse_per_output(self):
+        m = MultioutputWrapper(MeanSquaredError(), num_outputs=3)
+        preds = jnp.asarray(np.random.rand(32, 3))
+        target = jnp.asarray(np.random.rand(32, 3))
+        m.update(preds, target)
+        out = np.asarray(m.compute())
+        expected = np.mean((np.asarray(preds) - np.asarray(target)) ** 2, axis=0)
+        np.testing.assert_allclose(out, expected, atol=1e-6)
+
+    def test_remove_nans(self):
+        m = MultioutputWrapper(MeanSquaredError(), num_outputs=2)
+        preds = np.random.rand(16, 2)
+        target = np.random.rand(16, 2)
+        target[3, 0] = np.nan
+        m.update(jnp.asarray(preds), jnp.asarray(target))
+        out = np.asarray(m.compute())
+        exp0 = np.mean((np.delete(preds[:, 0], 3) - np.delete(target[:, 0], 3)) ** 2)
+        exp1 = np.mean((preds[:, 1] - target[:, 1]) ** 2)
+        np.testing.assert_allclose(out, [exp0, exp1], atol=1e-6)
