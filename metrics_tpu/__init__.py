@@ -66,6 +66,18 @@ from metrics_tpu.regression import (  # noqa: E402
     TweedieDevianceScore,
 )
 from metrics_tpu.metric import CompositionalMetric, Metric  # noqa: E402
+from metrics_tpu.image import (  # noqa: E402
+    FID,
+    IS,
+    KID,
+    LPIPS,
+    PSNR,
+    SSIM,
+    FrechetInceptionDistance,
+    InceptionScore,
+    KernelInceptionDistance,
+    MultiScaleStructuralSimilarityIndexMeasure,
+)
 from metrics_tpu.parallel import MeshConfig, metric_axis  # noqa: E402
 from metrics_tpu.wrappers import (  # noqa: E402
     BootStrapper,
@@ -107,6 +119,16 @@ __all__ = [
     "F1",
     "F1Score",
     "FBeta",
+    "FID",
+    "FrechetInceptionDistance",
+    "IS",
+    "InceptionScore",
+    "KID",
+    "KernelInceptionDistance",
+    "LPIPS",
+    "MultiScaleStructuralSimilarityIndexMeasure",
+    "PSNR",
+    "SSIM",
     "HammingDistance",
     "Hinge",
     "HingeLoss",

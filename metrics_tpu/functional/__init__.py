@@ -21,6 +21,10 @@ from metrics_tpu.functional.classification.precision_recall_curve import precisi
 from metrics_tpu.functional.classification.roc import roc
 from metrics_tpu.functional.classification.specificity import specificity
 from metrics_tpu.functional.classification.stat_scores import stat_scores
+from metrics_tpu.functional.image.gradients import image_gradients
+from metrics_tpu.functional.image.ms_ssim import multiscale_structural_similarity_index_measure
+from metrics_tpu.functional.image.psnr import psnr
+from metrics_tpu.functional.image.ssim import ssim
 from metrics_tpu.functional.pairwise.cosine import pairwise_cosine_similarity
 from metrics_tpu.functional.pairwise.euclidean import pairwise_euclidean_distance
 from metrics_tpu.functional.pairwise.linear import pairwise_linear_similarity
@@ -71,7 +75,11 @@ __all__ = [
     "fbeta",
     "hamming_distance",
     "hinge",
+    "image_gradients",
     "iou",
+    "multiscale_structural_similarity_index_measure",
+    "psnr",
+    "ssim",
     "jaccard_index",
     "kl_divergence",
     "matthews_corrcoef",

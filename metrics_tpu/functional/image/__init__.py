@@ -1,0 +1,4 @@
+from metrics_tpu.functional.image.gradients import image_gradients
+from metrics_tpu.functional.image.ms_ssim import multiscale_structural_similarity_index_measure
+from metrics_tpu.functional.image.psnr import psnr
+from metrics_tpu.functional.image.ssim import ssim

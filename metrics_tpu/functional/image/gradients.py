@@ -1,0 +1,36 @@
+"""Image gradients (1-step finite differences).
+
+Parity: reference ``torchmetrics/functional/image/gradients.py`` (image_gradients :48).
+"""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _image_gradients_validate(img: Array) -> None:
+    if not isinstance(img, (jax.Array,)):
+        import numpy as np
+
+        if not isinstance(img, np.ndarray):
+            raise TypeError(f"The `img` expects a value of <Array> type but got {type(img)}")
+    if img.ndim != 4:
+        raise RuntimeError(f"The `img` expects a 4D tensor but got {img.ndim}D tensor")
+
+
+def _compute_image_gradients(img: Array) -> Tuple[Array, Array]:
+    batch_size, channels, height, width = img.shape
+    dy = img[..., 1:, :] - img[..., :-1, :]
+    dx = img[..., :, 1:] - img[..., :, :-1]
+    dy = jnp.concatenate([dy, jnp.zeros((batch_size, channels, 1, width), dtype=img.dtype)], axis=2)
+    dx = jnp.concatenate([dx, jnp.zeros((batch_size, channels, height, 1), dtype=img.dtype)], axis=3)
+    return dy, dx
+
+
+def image_gradients(img: Array) -> Tuple[Array, Array]:
+    """Compute (dy, dx) finite-difference gradients of an (N, C, H, W) image."""
+    img = jnp.asarray(img)
+    _image_gradients_validate(img)
+    return _compute_image_gradients(img)

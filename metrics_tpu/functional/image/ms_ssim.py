@@ -1,0 +1,121 @@
+"""Multi-scale SSIM.
+
+Parity: reference ``torchmetrics/functional/image/ms_ssim.py``
+(_get_normalized_sim_and_cs :25, _multiscale_ssim_compute :42,
+multiscale_structural_similarity_index_measure :133). Downsampling uses a 2x2
+average pool via ``lax.reduce_window``.
+"""
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from metrics_tpu.functional.image.ssim import _ssim_compute, _ssim_update
+
+Array = jax.Array
+
+
+def _avg_pool2d(x: Array) -> Array:
+    out = lax.reduce_window(x, 0.0, lax.add, (1, 1, 2, 2), (1, 1, 2, 2), "VALID")
+    return out / 4.0
+
+
+def _get_normalized_sim_and_cs(
+    preds: Array,
+    target: Array,
+    kernel_size: Sequence[int],
+    sigma: Sequence[float],
+    reduction: str,
+    data_range: Optional[float],
+    k1: float,
+    k2: float,
+    normalize: Optional[str] = None,
+) -> Tuple[Array, Array]:
+    sim, contrast_sensitivity = _ssim_compute(
+        preds, target, kernel_size, sigma, reduction, data_range, k1, k2, return_contrast_sensitivity=True
+    )
+    if normalize == "relu":
+        sim = jax.nn.relu(sim)
+        contrast_sensitivity = jax.nn.relu(contrast_sensitivity)
+    return sim, contrast_sensitivity
+
+
+def _multiscale_ssim_compute(
+    preds: Array,
+    target: Array,
+    kernel_size: Sequence[int] = (11, 11),
+    sigma: Sequence[float] = (1.5, 1.5),
+    reduction: str = "elementwise_mean",
+    data_range: Optional[float] = None,
+    k1: float = 0.01,
+    k2: float = 0.03,
+    betas: Tuple[float, ...] = (0.0448, 0.2856, 0.3001, 0.2363, 0.1333),
+    normalize: Optional[str] = None,
+) -> Array:
+    sim_list: List[Array] = []
+    cs_list: List[Array] = []
+
+    if preds.shape[-1] < 2 ** len(betas) or preds.shape[-2] < 2 ** len(betas):
+        raise ValueError(
+            f"For a given number of `betas` parameters {len(betas)}, the image height and width dimensions must be"
+            f" larger than or equal to {2 ** len(betas)}."
+        )
+    _betas_div = max(1, (len(betas) - 1)) ** 2
+    if preds.shape[-2] // _betas_div <= kernel_size[0] - 1:
+        raise ValueError(
+            f"For a given number of `betas` parameters {len(betas)} and kernel size {kernel_size[0]},"
+            f" the image height must be larger than {(kernel_size[0] - 1) * _betas_div}."
+        )
+    if preds.shape[-1] // _betas_div <= kernel_size[1] - 1:
+        raise ValueError(
+            f"For a given number of `betas` parameters {len(betas)} and kernel size {kernel_size[1]},"
+            f" the image width must be larger than {(kernel_size[1] - 1) * _betas_div}."
+        )
+
+    for _ in range(len(betas)):
+        sim, contrast_sensitivity = _get_normalized_sim_and_cs(
+            preds, target, kernel_size, sigma, reduction, data_range, k1, k2, normalize
+        )
+        sim_list.append(sim)
+        cs_list.append(contrast_sensitivity)
+        preds = _avg_pool2d(preds)
+        target = _avg_pool2d(target)
+
+    sim_stack = jnp.stack(sim_list)
+    cs_stack = jnp.stack(cs_list)
+
+    if normalize == "simple":
+        sim_stack = (sim_stack + 1) / 2
+        cs_stack = (cs_stack + 1) / 2
+
+    betas_arr = jnp.asarray(betas)
+    sim_stack = sim_stack ** betas_arr
+    cs_stack = cs_stack ** betas_arr
+    return jnp.prod(cs_stack[:-1]) * sim_stack[-1]
+
+
+def multiscale_structural_similarity_index_measure(
+    preds: Array,
+    target: Array,
+    kernel_size: Sequence[int] = (11, 11),
+    sigma: Sequence[float] = (1.5, 1.5),
+    reduction: str = "elementwise_mean",
+    data_range: Optional[float] = None,
+    k1: float = 0.01,
+    k2: float = 0.03,
+    betas: Tuple[float, ...] = (0.0448, 0.2856, 0.3001, 0.2363, 0.1333),
+    normalize: Optional[str] = None,
+) -> Array:
+    """Compute MS-SSIM. Parity: reference ``:133-205``."""
+    if not isinstance(betas, tuple) or not all(isinstance(beta, float) for beta in betas):
+        raise ValueError("Argument `betas` is expected to be of a type tuple of floats.")
+    if normalize and normalize not in ("relu", "simple"):
+        raise ValueError("Argument `normalize` to be expected either `None` or one of 'relu' or 'simple'")
+    preds, target = _ssim_update(jnp.asarray(preds), jnp.asarray(target))
+    return _multiscale_ssim_compute(
+        preds, target, kernel_size, sigma, reduction, data_range, k1, k2, betas, normalize
+    )
+
+
+ms_ssim = multiscale_structural_similarity_index_measure

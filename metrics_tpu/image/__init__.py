@@ -1,0 +1,6 @@
+from metrics_tpu.image.fid import FID, FrechetInceptionDistance
+from metrics_tpu.image.inception import IS, InceptionScore
+from metrics_tpu.image.kid import KID, KernelInceptionDistance
+from metrics_tpu.image.lpip_similarity import LPIPS
+from metrics_tpu.image.psnr import PSNR
+from metrics_tpu.image.ssim import SSIM, MultiScaleStructuralSimilarityIndexMeasure

@@ -1,0 +1,105 @@
+"""Frechet Inception Distance — fully on-device (no scipy/CPU escape).
+
+Parity: reference ``torchmetrics/image/fid.py:125`` (feature lists :248-249, update
+:250-262, compute :264-281, _compute_fid :95-122, MatrixSquareRoot CPU escape
+:58-92). TPU-native differences:
+  * ``trace(sqrtm(S1 S2))`` is computed with two on-device eighs
+    (``metrics_tpu/ops/sqrtm.trace_sqrtm_product``) instead of scipy's sqrtm on the
+    host — exact for PSD covariances, no device->host transfer.
+  * the inception forward is a Flax module under the caller's mesh (sharding the
+    batch shards the forward); weights load from a converted checkpoint (no egress).
+  * the reference's float64 compute (``fid.py:269``) maps to x64 when enabled,
+    otherwise the covariance accumulates in f32 with mean-subtracted features (the
+    numerically dangerous term) — tested to ~1e-3 relative against numpy f64.
+"""
+from typing import Any, Callable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.ops.sqrtm import trace_sqrtm_product
+from metrics_tpu.utils.data import dim_zero_cat
+from metrics_tpu.utils.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+def _compute_fid(mu1: Array, sigma1: Array, mu2: Array, sigma2: Array, eps: float = 1e-6) -> Array:
+    """FID between two Gaussians. Parity: reference ``fid.py:95-122``."""
+    diff = mu1 - mu2
+    tr_covmean = trace_sqrtm_product(sigma1, sigma2)
+    # singular-product fallback (reference adds eps to the diagonals)
+    offset = jnp.eye(sigma1.shape[0], dtype=sigma1.dtype) * eps
+    tr_covmean = jnp.where(
+        jnp.isfinite(tr_covmean),
+        tr_covmean,
+        trace_sqrtm_product(sigma1 + offset, sigma2 + offset),
+    )
+    return diff @ diff + jnp.trace(sigma1) + jnp.trace(sigma2) - 2 * tr_covmean
+
+
+def _mean_cov(features: Array) -> Any:
+    n = features.shape[0]
+    mean = jnp.mean(features, axis=0)
+    diff = features - mean
+    cov = (diff.T @ diff) / (n - 1)
+    return mean, cov
+
+
+class FID(Metric):
+    """Frechet Inception Distance.
+
+    Args:
+        feature: an int/str naming an inception tap (64/192/768/2048) or a callable
+            ``imgs -> (N, d)`` feature extractor.
+        params: optional flax params for the built-in InceptionV3 (converted
+            pretrained weights; random init otherwise).
+    """
+
+    is_differentiable = False
+    higher_is_better = False
+
+    def __init__(
+        self,
+        feature: Union[int, str, Callable] = 2048,
+        params: Optional[Any] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if callable(feature):
+            self.inception = feature
+        else:
+            valid_int_input = ("64", "192", "768", "2048")
+            if str(feature) not in valid_int_input:
+                raise ValueError(
+                    f"Integer input to argument `feature` must be one of {valid_int_input}, but got {feature}."
+                )
+            from metrics_tpu.models.inception import InceptionFeatureExtractor
+
+            self.inception = InceptionFeatureExtractor(feature=str(feature), params=params)
+
+        self.add_state("real_features", default=[], dist_reduce_fx=None)
+        self.add_state("fake_features", default=[], dist_reduce_fx=None)
+
+    def update(self, imgs: Array, real: bool) -> None:
+        """Extract features and append to the matching distribution's buffer."""
+        features = self.inception(imgs)
+        if real:
+            self.real_features.append(features)
+        else:
+            self.fake_features.append(features)
+
+    def compute(self) -> Array:
+        real_features = dim_zero_cat(self.real_features)
+        fake_features = dim_zero_cat(self.fake_features)
+        orig_dtype = real_features.dtype
+        if jax.config.jax_enable_x64:
+            real_features = real_features.astype(jnp.float64)
+            fake_features = fake_features.astype(jnp.float64)
+        mean1, cov1 = _mean_cov(real_features)
+        mean2, cov2 = _mean_cov(fake_features)
+        return _compute_fid(mean1, cov1, mean2, cov2).astype(orig_dtype)
+
+
+FrechetInceptionDistance = FID

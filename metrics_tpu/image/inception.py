@@ -1,0 +1,73 @@
+"""Inception Score.
+
+Parity: reference ``torchmetrics/image/inception.py:26`` (logits features, KL-based
+score over splits, compute :160-200).
+"""
+from typing import Any, Callable, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class IS(Metric):
+    """Inception Score: exp of mean split-KL between p(y|x) and p(y)."""
+
+    is_differentiable = False
+    higher_is_better = True
+
+    def __init__(
+        self,
+        feature: Union[int, str, Callable] = "logits_unbiased",
+        splits: int = 10,
+        params: Optional[Any] = None,
+        seed: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if callable(feature):
+            self.inception = feature
+        else:
+            valid_input = ("logits_unbiased", "64", "192", "768", "2048")
+            if str(feature) not in valid_input:
+                raise ValueError(
+                    f"Input to argument `feature` must be one of {valid_input}, but got {feature}."
+                )
+            from metrics_tpu.models.inception import InceptionFeatureExtractor
+
+            self.inception = InceptionFeatureExtractor(feature=str(feature), params=params)
+
+        self.splits = splits
+        self._rng = np.random.RandomState(seed)
+        self.add_state("features", default=[], dist_reduce_fx=None)
+
+    def update(self, imgs: Array) -> None:
+        features = self.inception(imgs)
+        self.features.append(features)
+
+    def compute(self) -> Tuple[Array, Array]:
+        features = dim_zero_cat(self.features)
+        idx = jnp.asarray(self._rng.permutation(features.shape[0]))
+        features = features[idx]
+
+        prob = jax.nn.softmax(features, axis=1)
+        log_prob = jax.nn.log_softmax(features, axis=1)
+
+        prob_chunks = jnp.array_split(prob, self.splits, axis=0)
+        log_prob_chunks = jnp.array_split(log_prob, self.splits, axis=0)
+
+        kl_ = []
+        for p, log_p in zip(prob_chunks, log_prob_chunks):
+            m_p = jnp.mean(p, axis=0, keepdims=True)
+            kl = p * (log_p - jnp.log(m_p))
+            kl_.append(jnp.exp(jnp.mean(jnp.sum(kl, axis=1))))
+        kl = jnp.stack(kl_)
+        return jnp.mean(kl), jnp.std(kl, ddof=1)
+
+
+InceptionScore = IS

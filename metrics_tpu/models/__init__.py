@@ -1,0 +1,6 @@
+"""Embedded models used by metric families (FID/IS/KID inception, BERTScore encoder).
+
+The reference delegates these to third-party packages (torch-fidelity, transformers);
+here they are Flax modules sharded under the caller's mesh.
+"""
+from metrics_tpu.models.inception import InceptionFeatureExtractor, InceptionV3

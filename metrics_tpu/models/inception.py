@@ -1,0 +1,219 @@
+"""InceptionV3 (FID variant) in Flax — the embedded feature extractor for FID/IS/KID.
+
+Parity target: reference ``torchmetrics/image/fid.py:38-55`` (NoTrainInceptionV3 via
+torch-fidelity, pool3 2048-d features + 1008-way logits head). The reference
+downloads pretrained weights at construction (``fid.py:242``); this build has no
+network egress, so the module exposes ``load_params(path)`` for weights converted to
+an ``.npz``/pytree checkpoint, and otherwise initialises randomly with a loud warning
+(feature geometry, sharding and all downstream math are identical either way).
+
+TPU notes: all convs are NHWC (the TPU-native layout), run under the caller's mesh —
+sharding the batch dim data-parallel shards the inception forward with zero code
+changes. BatchNorm is folded to inference scale/bias (no running stats to carry).
+"""
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+class BasicConv2d(nn.Module):
+    """Conv + (inference) BatchNorm + ReLU."""
+
+    features: int
+    kernel: Tuple[int, int]
+    strides: Tuple[int, int] = (1, 1)
+    padding: Any = "VALID"
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        x = nn.Conv(self.features, self.kernel, self.strides, padding=self.padding, use_bias=False)(x)
+        x = nn.BatchNorm(use_running_average=True, epsilon=0.001)(x)
+        return nn.relu(x)
+
+
+def _max_pool(x: Array, window: int, stride: int) -> Array:
+    return nn.max_pool(x, (window, window), (stride, stride), padding="VALID")
+
+
+def _avg_pool_same(x: Array, window: int = 3) -> Array:
+    return nn.avg_pool(x, (window, window), (1, 1), padding="SAME")
+
+
+class InceptionA(nn.Module):
+    pool_features: int
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        b1 = BasicConv2d(64, (1, 1))(x)
+        b2 = BasicConv2d(48, (1, 1))(x)
+        b2 = BasicConv2d(64, (5, 5), padding="SAME")(b2)
+        b3 = BasicConv2d(64, (1, 1))(x)
+        b3 = BasicConv2d(96, (3, 3), padding="SAME")(b3)
+        b3 = BasicConv2d(96, (3, 3), padding="SAME")(b3)
+        b4 = _avg_pool_same(x)
+        b4 = BasicConv2d(self.pool_features, (1, 1))(b4)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionB(nn.Module):
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        b1 = BasicConv2d(384, (3, 3), strides=(2, 2))(x)
+        b2 = BasicConv2d(64, (1, 1))(x)
+        b2 = BasicConv2d(96, (3, 3), padding="SAME")(b2)
+        b2 = BasicConv2d(96, (3, 3), strides=(2, 2))(b2)
+        b3 = _max_pool(x, 3, 2)
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionC(nn.Module):
+    channels_7x7: int
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        c7 = self.channels_7x7
+        b1 = BasicConv2d(192, (1, 1))(x)
+        b2 = BasicConv2d(c7, (1, 1))(x)
+        b2 = BasicConv2d(c7, (1, 7), padding="SAME")(b2)
+        b2 = BasicConv2d(192, (7, 1), padding="SAME")(b2)
+        b3 = BasicConv2d(c7, (1, 1))(x)
+        b3 = BasicConv2d(c7, (7, 1), padding="SAME")(b3)
+        b3 = BasicConv2d(c7, (1, 7), padding="SAME")(b3)
+        b3 = BasicConv2d(c7, (7, 1), padding="SAME")(b3)
+        b3 = BasicConv2d(192, (1, 7), padding="SAME")(b3)
+        b4 = _avg_pool_same(x)
+        b4 = BasicConv2d(192, (1, 1))(b4)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionD(nn.Module):
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        b1 = BasicConv2d(192, (1, 1))(x)
+        b1 = BasicConv2d(320, (3, 3), strides=(2, 2))(b1)
+        b2 = BasicConv2d(192, (1, 1))(x)
+        b2 = BasicConv2d(192, (1, 7), padding="SAME")(b2)
+        b2 = BasicConv2d(192, (7, 1), padding="SAME")(b2)
+        b2 = BasicConv2d(192, (3, 3), strides=(2, 2))(b2)
+        b3 = _max_pool(x, 3, 2)
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionE(nn.Module):
+    pool_mode: str = "avg"
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        b1 = BasicConv2d(320, (1, 1))(x)
+        b2 = BasicConv2d(384, (1, 1))(x)
+        b2 = jnp.concatenate(
+            [BasicConv2d(384, (1, 3), padding="SAME")(b2), BasicConv2d(384, (3, 1), padding="SAME")(b2)], axis=-1
+        )
+        b3 = BasicConv2d(448, (1, 1))(x)
+        b3 = BasicConv2d(384, (3, 3), padding="SAME")(b3)
+        b3 = jnp.concatenate(
+            [BasicConv2d(384, (1, 3), padding="SAME")(b3), BasicConv2d(384, (3, 1), padding="SAME")(b3)], axis=-1
+        )
+        if self.pool_mode == "max":
+            b4 = nn.max_pool(x, (3, 3), (1, 1), padding="SAME")
+        else:
+            b4 = _avg_pool_same(x)
+        b4 = BasicConv2d(192, (1, 1))(b4)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    """FID-variant InceptionV3. Input: (N, 299, 299, 3) in [0, 1] floats or uint8.
+
+    Returns a dict of the standard FID feature taps: '64', '192', '768', '2048',
+    'logits_unbiased' — matching the reference's feature-size selector
+    (``torchmetrics/image/fid.py:164-180``).
+    """
+
+    num_classes: int = 1008
+
+    @nn.compact
+    def __call__(self, x: Array) -> Dict[str, Array]:
+        if x.dtype == jnp.uint8:
+            x = x.astype(jnp.float32) / 255.0
+        # torch-fidelity normalisation: map [0,1] -> [-1, 1]
+        x = 2 * x - 1
+
+        out: Dict[str, Array] = {}
+        x = BasicConv2d(32, (3, 3), strides=(2, 2))(x)
+        x = BasicConv2d(32, (3, 3))(x)
+        x = BasicConv2d(64, (3, 3), padding="SAME")(x)
+        x = _max_pool(x, 3, 2)
+        out["64"] = jnp.mean(x, axis=(1, 2))
+
+        x = BasicConv2d(80, (1, 1))(x)
+        x = BasicConv2d(192, (3, 3))(x)
+        x = _max_pool(x, 3, 2)
+        out["192"] = jnp.mean(x, axis=(1, 2))
+
+        x = InceptionA(pool_features=32)(x)
+        x = InceptionA(pool_features=64)(x)
+        x = InceptionA(pool_features=64)(x)
+        x = InceptionB()(x)
+        out["768"] = jnp.mean(x, axis=(1, 2))
+
+        x = InceptionC(channels_7x7=128)(x)
+        x = InceptionC(channels_7x7=160)(x)
+        x = InceptionC(channels_7x7=160)(x)
+        x = InceptionC(channels_7x7=192)(x)
+        x = InceptionD()(x)
+        x = InceptionE(pool_mode="avg")(x)
+        x = InceptionE(pool_mode="max")(x)
+        pooled = jnp.mean(x, axis=(1, 2))
+        out["2048"] = pooled
+        out["logits_unbiased"] = nn.Dense(self.num_classes, use_bias=False)(pooled)
+        return out
+
+
+class InceptionFeatureExtractor:
+    """Stateful convenience wrapper: jitted inception forward returning one tap.
+
+    Weights: pass ``params`` (a flax param pytree, e.g. converted from
+    torch-fidelity's checkpoint) or a path via ``load_params``. Without params the
+    net is randomly initialised — fine for pipeline/sharding tests, meaningless for
+    real FID values (warned once).
+    """
+
+    def __init__(
+        self,
+        feature: str = "2048",
+        params: Optional[Any] = None,
+        input_size: int = 299,
+        seed: int = 0,
+    ) -> None:
+        from metrics_tpu.utils.prints import rank_zero_warn
+
+        self.feature = str(feature)
+        self.module = InceptionV3()
+        if params is None:
+            rank_zero_warn(
+                "No pretrained InceptionV3 params provided (no network egress in this build);"
+                " using random initialisation. Pass `params=` (converted torch-fidelity"
+                " weights) for meaningful FID/IS/KID values.",
+                UserWarning,
+            )
+            dummy = jnp.zeros((1, input_size, input_size, 3), dtype=jnp.float32)
+            params = self.module.init(jax.random.PRNGKey(seed), dummy)
+        self.params = params
+        self._forward = jax.jit(lambda p, x: self.module.apply(p, x)[self.feature])
+
+    @staticmethod
+    def load_params(path: str) -> Any:
+        import pickle
+
+        with open(path, "rb") as f:
+            return pickle.load(f)
+
+    def __call__(self, imgs: Array) -> Array:
+        if imgs.ndim == 4 and imgs.shape[1] == 3 and imgs.shape[-1] != 3:
+            imgs = jnp.transpose(imgs, (0, 2, 3, 1))  # NCHW -> NHWC
+        return self._forward(self.params, imgs)

@@ -1,0 +1,2 @@
+"""Hot-path device ops (XLA/Pallas) shared across metric families."""
+from metrics_tpu.ops.sqrtm import psd_sqrt, sqrtm_newton_schulz, trace_sqrtm_product

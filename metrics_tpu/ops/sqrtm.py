@@ -1,0 +1,58 @@
+"""On-device matrix square root — no CPU/scipy escape.
+
+The reference computes FID's ``sqrtm(sigma1 @ sigma2)`` by falling off the device to
+``scipy.linalg.sqrtm`` in float64 (``torchmetrics/image/fid.py:68-70``). Here the
+needed quantity — ``trace(sqrtm(sigma1 @ sigma2))`` for symmetric PSD covariances —
+is computed entirely on device via two Hermitian eigendecompositions:
+
+    trace sqrt(S1 S2) = sum sqrt(eig(S1^(1/2) S2 S1^(1/2)))
+
+which is exact for PSD inputs, maps to XLA's native eigh, and keeps every FLOP on
+the TPU. A Newton-Schulz iteration is also provided for full-matrix square roots
+(differentiable, matmul-only — MXU-friendly).
+"""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def psd_sqrt(mat: Array, eps: float = 1e-12) -> Array:
+    """Symmetric PSD matrix square root via eigh."""
+    vals, vecs = jnp.linalg.eigh(mat)
+    vals = jnp.clip(vals, 0.0, None)
+    return (vecs * jnp.sqrt(vals + eps)) @ vecs.T
+
+
+def trace_sqrtm_product(sigma1: Array, sigma2: Array) -> Array:
+    """trace(sqrtm(sigma1 @ sigma2)) for symmetric PSD sigma1, sigma2 (on device)."""
+    s1_half = psd_sqrt(sigma1)
+    m = s1_half @ sigma2 @ s1_half
+    m = (m + m.T) / 2  # re-symmetrise against fp error
+    vals = jnp.linalg.eigvalsh(m)
+    return jnp.sum(jnp.sqrt(jnp.clip(vals, 0.0, None)))
+
+
+def sqrtm_newton_schulz(mat: Array, num_iters: int = 50) -> Tuple[Array, Array]:
+    """Full matrix square root by Newton-Schulz iteration (matmul-only).
+
+    Returns (sqrt(mat), error_estimate). Converges for matrices with spectral radius
+    < 1 after normalisation; good to ~1e-5 relative in f32.
+    """
+    dim = mat.shape[0]
+    norm = jnp.linalg.norm(mat)
+    y = mat / norm
+    eye = jnp.eye(dim, dtype=mat.dtype)
+    z = eye
+
+    def body(_, carry):
+        y, z = carry
+        t = 0.5 * (3.0 * eye - z @ y)
+        return y @ t, t @ z
+
+    y, z = jax.lax.fori_loop(0, num_iters, body, (y, z))
+    sqrt_mat = y * jnp.sqrt(norm)
+    err = jnp.linalg.norm(sqrt_mat @ sqrt_mat - mat) / jnp.maximum(jnp.linalg.norm(mat), 1e-12)
+    return sqrt_mat, err

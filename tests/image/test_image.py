@@ -1,0 +1,233 @@
+"""Image metrics vs numpy/scipy oracles.
+
+Parity model: reference ``tests/image/*`` (PSNR vs skimage; SSIM vs skimage; FID/KID
+vs torch-fidelity). skimage/torch-fidelity are absent here, so the oracles are
+hand-rolled numpy/scipy implementations (the reference keeps the same pattern in
+``tests/helpers/non_sklearn_metrics.py``).
+"""
+import numpy as np
+import pytest
+from scipy import signal
+from scipy.linalg import sqrtm as scipy_sqrtm
+
+from metrics_tpu import FID, IS, KID, PSNR, SSIM, MultiScaleStructuralSimilarityIndexMeasure
+from metrics_tpu.functional import image_gradients, psnr, ssim
+from tests.helpers import seed_all
+from tests.helpers.testers import MetricTester
+
+seed_all(42)
+
+_preds_img = np.random.rand(8, 4, 3, 32, 32).astype(np.float32)
+_target_img = np.random.rand(8, 4, 3, 32, 32).astype(np.float32)
+
+
+def _np_psnr(preds, target, data_range=None):
+    p, t = np.asarray(preds, dtype=np.float64), np.asarray(target, dtype=np.float64)
+    if data_range is None:
+        data_range = t.max() - t.min()
+    mse = np.mean((p - t) ** 2)
+    return 10 * np.log10(data_range ** 2 / mse)
+
+
+def _np_gaussian_kernel(size, sigma):
+    dist = np.arange((1 - size) / 2, (1 + size) / 2)
+    g = np.exp(-((dist / sigma) ** 2) / 2)
+    g /= g.sum()
+    return np.outer(g, g)
+
+
+def _np_ssim(preds, target, kernel_size=11, sigma=1.5, data_range=None, k1=0.01, k2=0.03):
+    """Numpy SSIM matching the reference algorithm (gaussian window, reflect pad,
+    border crop)."""
+    p = np.asarray(preds, dtype=np.float64)
+    t = np.asarray(target, dtype=np.float64)
+    if data_range is None:
+        data_range = max(p.max() - p.min(), t.max() - t.min())
+    c1, c2 = (k1 * data_range) ** 2, (k2 * data_range) ** 2
+    kernel = _np_gaussian_kernel(kernel_size, sigma)
+    pad = (kernel_size - 1) // 2
+
+    vals = []
+    for b in range(p.shape[0]):
+        for c in range(p.shape[1]):
+            x = np.pad(p[b, c], pad, mode="reflect")
+            y = np.pad(t[b, c], pad, mode="reflect")
+            mu_x = signal.correlate2d(x, kernel, mode="valid")
+            mu_y = signal.correlate2d(y, kernel, mode="valid")
+            e_xx = signal.correlate2d(x * x, kernel, mode="valid")
+            e_yy = signal.correlate2d(y * y, kernel, mode="valid")
+            e_xy = signal.correlate2d(x * y, kernel, mode="valid")
+            s_xx = e_xx - mu_x ** 2
+            s_yy = e_yy - mu_y ** 2
+            s_xy = e_xy - mu_x * mu_y
+            num = (2 * mu_x * mu_y + c1) * (2 * s_xy + c2)
+            den = (mu_x ** 2 + mu_y ** 2 + c1) * (s_xx + s_yy + c2)
+            ssim_map = num / den
+            vals.append(ssim_map[pad:-pad, pad:-pad])
+    return np.mean(vals)
+
+
+class TestPSNR(MetricTester):
+    atol = 1e-4
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_preds_img,
+            target=_target_img,
+            metric_class=PSNR,
+            sk_metric=lambda p, t: _np_psnr(p, t, data_range=1.0),
+            metric_args={"data_range": 1.0},
+        )
+
+    def test_fn(self):
+        self.run_functional_metric_test(
+            preds=_preds_img,
+            target=_target_img,
+            metric_functional=psnr,
+            sk_metric=_np_psnr,
+        )
+
+
+class TestSSIM(MetricTester):
+    atol = 1e-4
+
+    def test_fn(self):
+        res = float(ssim(_preds_img[0], _target_img[0], data_range=1.0))
+        expected = _np_ssim(_preds_img[0], _target_img[0], data_range=1.0)
+        np.testing.assert_allclose(res, expected, atol=1e-4)
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_preds_img,
+            target=_target_img,
+            metric_class=SSIM,
+            sk_metric=lambda p, t: _np_ssim(p, t, data_range=1.0),
+            metric_args={"data_range": 1.0},
+        )
+
+
+class TestMSSSIM(MetricTester):
+    def test_identical_images_are_one(self):
+        m = MultiScaleStructuralSimilarityIndexMeasure(data_range=1.0)
+        img = np.random.rand(2, 1, 192, 192).astype(np.float32)
+        m.update(img, img)
+        assert float(m.compute()) == pytest.approx(1.0, abs=1e-5)
+
+    def test_degraded_less_than_clean(self):
+        img = np.random.rand(2, 1, 192, 192).astype(np.float32)
+        noisy = np.clip(img + 0.3 * np.random.randn(*img.shape), 0, 1).astype(np.float32)
+        m1 = MultiScaleStructuralSimilarityIndexMeasure(data_range=1.0)
+        m1.update(img, img)
+        m2 = MultiScaleStructuralSimilarityIndexMeasure(data_range=1.0)
+        m2.update(noisy, img)
+        assert float(m2.compute()) < float(m1.compute())
+
+
+def test_image_gradients():
+    img = np.arange(25, dtype=np.float32).reshape(1, 1, 5, 5)
+    dy, dx = image_gradients(img)
+    np.testing.assert_allclose(np.asarray(dy)[0, 0, :-1], np.full((4, 5), 5.0))
+    np.testing.assert_allclose(np.asarray(dy)[0, 0, -1], np.zeros(5))
+    np.testing.assert_allclose(np.asarray(dx)[0, 0, :, :-1], np.full((5, 4), 1.0))
+
+
+class _DummyExtractor:
+    """Feature extractor stand-in: deterministic projection of flattened images."""
+
+    def __init__(self, dim=16, in_dim=3 * 8 * 8, seed=0):
+        rng = np.random.RandomState(seed)
+        # small scale keeps the KID poly-kernel magnitudes O(1)
+        self.w = (0.05 * rng.randn(in_dim, dim)).astype(np.float32)
+
+    def __call__(self, imgs):
+        import jax.numpy as jnp
+
+        flat = jnp.reshape(jnp.asarray(imgs), (imgs.shape[0], -1))
+        return flat @ jnp.asarray(self.w)
+
+
+def _np_fid(real, fake):
+    mu1, mu2 = real.mean(0), fake.mean(0)
+    s1 = np.cov(real, rowvar=False)
+    s2 = np.cov(fake, rowvar=False)
+    covmean = scipy_sqrtm(s1 @ s2).real
+    return float(((mu1 - mu2) ** 2).sum() + np.trace(s1) + np.trace(s2) - 2 * np.trace(covmean))
+
+
+class TestFID:
+    def test_vs_scipy_sqrtm(self):
+        """On-device eigh-based FID == scipy sqrtm FID on the same features."""
+        extractor = _DummyExtractor()
+        fid = FID(feature=extractor)
+        rng = np.random.RandomState(1)
+        real = rng.rand(64, 3, 8, 8).astype(np.float32)
+        fake = (rng.rand(64, 3, 8, 8) * 0.8 + 0.1).astype(np.float32)
+        fid.update(real, real=True)
+        fid.update(fake, real=False)
+        res = float(fid.compute())
+
+        f_real = np.asarray(extractor(real))
+        f_fake = np.asarray(extractor(fake))
+        expected = _np_fid(f_real.astype(np.float64), f_fake.astype(np.float64))
+        np.testing.assert_allclose(res, expected, rtol=1e-3)
+
+    def test_identical_distributions_near_zero(self):
+        extractor = _DummyExtractor()
+        fid = FID(feature=extractor)
+        rng = np.random.RandomState(2)
+        imgs = rng.rand(128, 3, 8, 8).astype(np.float32)
+        fid.update(imgs, real=True)
+        fid.update(imgs, real=False)
+        assert abs(float(fid.compute())) < 1e-2
+
+
+class TestKID:
+    def test_mmd_identical_near_zero(self):
+        extractor = _DummyExtractor()
+        kid = KID(feature=extractor, subsets=4, subset_size=32, seed=0)
+        rng = np.random.RandomState(3)
+        imgs = rng.rand(64, 3, 8, 8).astype(np.float32)
+        kid.update(imgs, real=True)
+        kid.update(imgs, real=False)
+        mean, std = kid.compute()
+        assert abs(float(mean)) < 1e-2
+
+    def test_mmd_positive_for_different(self):
+        extractor = _DummyExtractor()
+        kid = KID(feature=extractor, subsets=4, subset_size=32, seed=0)
+        rng = np.random.RandomState(4)
+        kid.update(rng.rand(64, 3, 8, 8).astype(np.float32), real=True)
+        kid.update((rng.rand(64, 3, 8, 8) * 2).astype(np.float32), real=False)
+        mean, _ = kid.compute()
+        assert float(mean) > 0
+
+
+class TestIS:
+    def test_uniform_logits_score_one(self):
+        extractor = lambda imgs: np.zeros((imgs.shape[0], 10), dtype=np.float32)
+        m = IS(feature=extractor, splits=2, seed=0)
+        m.update(np.random.rand(32, 3, 8, 8).astype(np.float32))
+        mean, std = m.compute()
+        assert float(mean) == pytest.approx(1.0, abs=1e-5)
+
+
+def test_inception_architecture_shapes():
+    """The Flax InceptionV3 produces the canonical FID feature taps."""
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu.models.inception import InceptionV3
+
+    net = InceptionV3()
+    x = jnp.zeros((1, 299, 299, 3))
+    params = net.init(jax.random.PRNGKey(0), x)
+    out = net.apply(params, x)
+    assert out["64"].shape == (1, 64)
+    assert out["192"].shape == (1, 192)
+    assert out["768"].shape == (1, 768)
+    assert out["2048"].shape == (1, 2048)
+    assert out["logits_unbiased"].shape == (1, 1008)
