@@ -66,6 +66,16 @@ from metrics_tpu.regression import (  # noqa: E402
     TweedieDevianceScore,
 )
 from metrics_tpu.metric import CompositionalMetric, Metric  # noqa: E402
+from metrics_tpu.audio import (  # noqa: E402
+    PIT,
+    SDR,
+    SNR,
+    PermutationInvariantTraining,
+    ScaleInvariantSignalDistortionRatio,
+    ScaleInvariantSignalNoiseRatio,
+    SignalDistortionRatio,
+    SignalNoiseRatio,
+)
 from metrics_tpu.image import (  # noqa: E402
     FID,
     IS,
@@ -127,8 +137,16 @@ __all__ = [
     "KernelInceptionDistance",
     "LPIPS",
     "MultiScaleStructuralSimilarityIndexMeasure",
+    "PIT",
     "PSNR",
+    "PermutationInvariantTraining",
+    "SDR",
+    "SNR",
     "SSIM",
+    "ScaleInvariantSignalDistortionRatio",
+    "ScaleInvariantSignalNoiseRatio",
+    "SignalDistortionRatio",
+    "SignalNoiseRatio",
     "HammingDistance",
     "Hinge",
     "HingeLoss",

@@ -1,0 +1,30 @@
+from metrics_tpu.audio.pit import PIT, PermutationInvariantTraining
+from metrics_tpu.audio.sdr import (
+    SDR,
+    ScaleInvariantSignalDistortionRatio,
+    SignalDistortionRatio,
+)
+from metrics_tpu.audio.snr import SNR, ScaleInvariantSignalNoiseRatio, SignalNoiseRatio
+
+__all__ = [
+    "PIT",
+    "PermutationInvariantTraining",
+    "SDR",
+    "SNR",
+    "ScaleInvariantSignalDistortionRatio",
+    "ScaleInvariantSignalNoiseRatio",
+    "SignalDistortionRatio",
+    "SignalNoiseRatio",
+]
+
+# deprecated aliases of the scale-invariant metrics (reference audio/si_sdr.py:22,
+# si_snr.py:22)
+SI_SDR = ScaleInvariantSignalDistortionRatio
+SI_SNR = ScaleInvariantSignalNoiseRatio
+
+# optional native-DSP metrics: modules always import; construction raises a clear
+# ModuleNotFoundError when the backing package is absent (reference pattern)
+from metrics_tpu.audio.pesq import PESQ  # noqa: E402,F401
+from metrics_tpu.audio.stoi import STOI  # noqa: E402,F401
+
+__all__ += ["PESQ", "STOI"]

@@ -1,0 +1,57 @@
+"""PIT module metric.
+
+Parity: reference ``torchmetrics/audio/pit.py:22`` (states :96-97).
+"""
+from typing import Any, Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.audio.pit import pit
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+class PermutationInvariantTraining(Metric):
+    """Permutation-invariant evaluation of any sample-level audio metric."""
+
+    is_differentiable = True
+    higher_is_better = True
+
+    def __init__(
+        self,
+        metric_func: Callable,
+        eval_func: str = "max",
+        **kwargs: Any,
+    ) -> None:
+        base_kwargs: Dict[str, Any] = {
+            k: kwargs.pop(k)
+            for k in ("compute_on_step", "dist_sync_on_step", "sync_axis", "dist_sync_fn", "process_group")
+            if k in kwargs
+        }
+        super().__init__(**base_kwargs)
+        self.metric_func = metric_func
+        self.eval_func = eval_func
+        self.kwargs = kwargs
+        self.add_state("sum_pit_metric", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        pit_metric = pit(preds, target, self.metric_func, self.eval_func, **self.kwargs)[0]
+        self.sum_pit_metric = self.sum_pit_metric + jnp.sum(pit_metric)
+        self.total = self.total + pit_metric.size
+
+    def compute(self) -> Array:
+        return self.sum_pit_metric / self.total
+
+
+class PIT(PermutationInvariantTraining):
+    """Deprecated alias. Parity: reference ``audio/pit.py`` naming history."""
+
+    def __init__(self, *args: Any, **kwargs: Any) -> None:
+        rank_zero_warn(
+            "`PIT` was renamed to `PermutationInvariantTraining` and it will be removed.", DeprecationWarning
+        )
+        super().__init__(*args, **kwargs)

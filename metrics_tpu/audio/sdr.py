@@ -1,0 +1,80 @@
+"""SDR module metrics.
+
+Parity: reference ``torchmetrics/audio/sdr.py:23,150,195`` (SignalDistortionRatio,
+deprecated SDR, ScaleInvariantSignalDistortionRatio).
+"""
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.audio.sdr import (
+    scale_invariant_signal_distortion_ratio,
+    signal_distortion_ratio,
+)
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+class SignalDistortionRatio(Metric):
+    """SDR with optimal distortion filter, averaged over samples."""
+
+    is_differentiable = True
+    higher_is_better = True
+
+    def __init__(
+        self,
+        use_cg_iter: Optional[int] = None,
+        filter_length: int = 512,
+        zero_mean: bool = False,
+        load_diag: Optional[float] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.use_cg_iter = use_cg_iter
+        self.filter_length = filter_length
+        self.zero_mean = zero_mean
+        self.load_diag = load_diag
+        self.add_state("sum_sdr", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        sdr_batch = signal_distortion_ratio(
+            preds, target, self.use_cg_iter, self.filter_length, self.zero_mean, self.load_diag
+        )
+        self.sum_sdr = self.sum_sdr + jnp.sum(sdr_batch)
+        self.total = self.total + sdr_batch.size
+
+    def compute(self) -> Array:
+        return self.sum_sdr / self.total
+
+
+class SDR(SignalDistortionRatio):
+    """Deprecated alias. Parity: reference ``sdr.py:150``."""
+
+    def __init__(self, *args: Any, **kwargs: Any) -> None:
+        rank_zero_warn("`SDR` was renamed to `SignalDistortionRatio` and it will be removed.", DeprecationWarning)
+        super().__init__(*args, **kwargs)
+
+
+class ScaleInvariantSignalDistortionRatio(Metric):
+    """SI-SDR, averaged over samples."""
+
+    is_differentiable = True
+    higher_is_better = True
+
+    def __init__(self, zero_mean: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.zero_mean = zero_mean
+        self.add_state("sum_si_sdr", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        si_sdr_batch = scale_invariant_signal_distortion_ratio(preds=preds, target=target, zero_mean=self.zero_mean)
+        self.sum_si_sdr = self.sum_si_sdr + jnp.sum(si_sdr_batch)
+        self.total = self.total + si_sdr_batch.size
+
+    def compute(self) -> Array:
+        return self.sum_si_sdr / self.total

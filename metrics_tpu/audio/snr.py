@@ -1,0 +1,64 @@
+"""SNR module metrics.
+
+Parity: reference ``torchmetrics/audio/snr.py:23,114,140`` (SignalNoiseRatio,
+deprecated SNR, ScaleInvariantSignalNoiseRatio).
+"""
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.audio.snr import scale_invariant_signal_noise_ratio, signal_noise_ratio
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+class SignalNoiseRatio(Metric):
+    """Signal-to-noise ratio, averaged over samples."""
+
+    is_differentiable = True
+    higher_is_better = True
+
+    def __init__(self, zero_mean: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.zero_mean = zero_mean
+        self.add_state("sum_snr", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        snr_batch = signal_noise_ratio(preds=preds, target=target, zero_mean=self.zero_mean)
+        self.sum_snr = self.sum_snr + jnp.sum(snr_batch)
+        self.total = self.total + snr_batch.size
+
+    def compute(self) -> Array:
+        return self.sum_snr / self.total
+
+
+class SNR(SignalNoiseRatio):
+    """Deprecated alias. Parity: reference ``snr.py:114``."""
+
+    def __init__(self, *args: Any, **kwargs: Any) -> None:
+        rank_zero_warn("`SNR` was renamed to `SignalNoiseRatio` and it will be removed.", DeprecationWarning)
+        super().__init__(*args, **kwargs)
+
+
+class ScaleInvariantSignalNoiseRatio(Metric):
+    """Scale-invariant SNR, averaged over samples."""
+
+    is_differentiable = True
+    higher_is_better = True
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_si_snr", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        si_snr_batch = scale_invariant_signal_noise_ratio(preds=preds, target=target)
+        self.sum_si_snr = self.sum_si_snr + jnp.sum(si_snr_batch)
+        self.total = self.total + si_snr_batch.size
+
+    def compute(self) -> Array:
+        return self.sum_si_snr / self.total

@@ -1,0 +1,48 @@
+"""STOI module metric (wraps the native ``pystoi`` package, host-side DSP).
+
+Parity: reference ``torchmetrics/audio/stoi.py:23``.
+"""
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.imports import _PYSTOI_AVAILABLE
+
+Array = jax.Array
+
+
+class STOI(Metric):
+    """Short-time objective intelligibility."""
+
+    is_differentiable = False
+    higher_is_better = True
+
+    def __init__(self, fs: int, extended: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not _PYSTOI_AVAILABLE:
+            raise ModuleNotFoundError(
+                "STOI metric requires that pystoi is installed. Either install as `pip install pystoi`."
+            )
+        self.fs = fs
+        self.extended = extended
+        self.add_state("sum_stoi", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        from pystoi import stoi as stoi_backend
+
+        preds_np = np.asarray(preds)
+        target_np = np.asarray(target)
+        if preds_np.ndim == 1:
+            preds_np = preds_np[None]
+            target_np = target_np[None]
+        for p, t in zip(preds_np.reshape(-1, preds_np.shape[-1]), target_np.reshape(-1, target_np.shape[-1])):
+            score = stoi_backend(t, p, self.fs, extended=self.extended)
+            self.sum_stoi = self.sum_stoi + score
+            self.total = self.total + 1
+
+    def compute(self) -> Array:
+        return self.sum_stoi / self.total

@@ -20,6 +20,15 @@ from metrics_tpu.functional.classification.precision_recall import precision, pr
 from metrics_tpu.functional.classification.precision_recall_curve import precision_recall_curve
 from metrics_tpu.functional.classification.roc import roc
 from metrics_tpu.functional.classification.specificity import specificity
+from metrics_tpu.functional.audio.pit import pit, pit_permutate
+from metrics_tpu.functional.audio.sdr import (
+    scale_invariant_signal_distortion_ratio,
+    signal_distortion_ratio,
+)
+from metrics_tpu.functional.audio.snr import (
+    scale_invariant_signal_noise_ratio,
+    signal_noise_ratio,
+)
 from metrics_tpu.functional.classification.stat_scores import stat_scores
 from metrics_tpu.functional.image.gradients import image_gradients
 from metrics_tpu.functional.image.ms_ssim import multiscale_structural_similarity_index_measure
@@ -60,7 +69,13 @@ __all__ = [
     "pairwise_linear_similarity",
     "pairwise_manhatten_distance",
     "pearson_corrcoef",
+    "pit",
+    "pit_permutate",
     "r2_score",
+    "scale_invariant_signal_distortion_ratio",
+    "scale_invariant_signal_noise_ratio",
+    "signal_distortion_ratio",
+    "signal_noise_ratio",
     "spearman_corrcoef",
     "symmetric_mean_absolute_percentage_error",
     "tweedie_deviance_score",

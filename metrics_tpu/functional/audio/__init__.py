@@ -1,0 +1,13 @@
+from metrics_tpu.functional.audio.pit import pit, pit_permutate
+from metrics_tpu.functional.audio.sdr import (
+    scale_invariant_signal_distortion_ratio,
+    sdr,
+    si_sdr,
+    signal_distortion_ratio,
+)
+from metrics_tpu.functional.audio.snr import (
+    scale_invariant_signal_noise_ratio,
+    si_snr,
+    signal_noise_ratio,
+    snr,
+)

@@ -1,0 +1,119 @@
+"""Signal distortion ratio (SDR) and scale-invariant SDR.
+
+Parity: reference ``torchmetrics/functional/audio/sdr.py`` (signal_distortion_ratio
+:49, scale_invariant_signal_distortion_ratio :188). The reference delegates the
+Toeplitz filter solve to the native ``fast_bss_eval`` package; here the same
+"SDR — Medium Rare" algorithm (Scheibler 2021) is implemented natively in jnp:
+FFT auto-/cross-correlations, an explicit (L, L) Toeplitz system solved on device,
+coherence -> dB. Everything is batched/jit-safe; the solve maps to XLA's native LU.
+"""
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _normalize(x: Array) -> Array:
+    return x / jnp.clip(jnp.linalg.norm(x, axis=-1, keepdims=True), 1e-12, None)
+
+
+def _compute_autocorr_crosscorr(target: Array, preds: Array, corr_len: int) -> Tuple[Array, Array]:
+    """FFT-based autocorrelation of target and cross-correlation target->preds."""
+    import math
+
+    n = target.shape[-1]
+    n_fft = int(2 ** math.ceil(math.log2(n + corr_len)))  # shapes are static under jit
+    t_fft = jnp.fft.rfft(target, n=n_fft, axis=-1)
+    p_fft = jnp.fft.rfft(preds, n=n_fft, axis=-1)
+    acf = jnp.fft.irfft(t_fft * jnp.conj(t_fft), n=n_fft, axis=-1)[..., :corr_len]
+    xcorr = jnp.fft.irfft(jnp.conj(t_fft) * p_fft, n=n_fft, axis=-1)[..., :corr_len]
+    return acf, xcorr
+
+
+def _toeplitz(c: Array) -> Array:
+    """Symmetric Toeplitz matrix from first column ``c`` (batched over leading dims)."""
+    n = c.shape[-1]
+    idx = jnp.abs(jnp.arange(n)[:, None] - jnp.arange(n)[None, :])
+    return c[..., idx]
+
+
+def signal_distortion_ratio(
+    preds: Array,
+    target: Array,
+    use_cg_iter: Optional[int] = None,
+    filter_length: int = 512,
+    zero_mean: bool = False,
+    load_diag: Optional[float] = None,
+) -> Array:
+    """SDR with an optimal length-L distortion filter. Parity: reference ``:49-186``."""
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    _check_same_shape(preds, target)
+
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        preds = preds.astype(jnp.float32)
+    if preds.dtype == jnp.float16 or preds.dtype == jnp.bfloat16:
+        preds = preds.astype(jnp.float32)
+    target = target.astype(preds.dtype)
+
+    if zero_mean:
+        preds = preds - jnp.mean(preds, axis=-1, keepdims=True)
+        target = target - jnp.mean(target, axis=-1, keepdims=True)
+
+    preds = _normalize(preds)
+    target = _normalize(target)
+
+    acf, xcorr = _compute_autocorr_crosscorr(target, preds, corr_len=filter_length)
+
+    if load_diag is not None:
+        acf = acf.at[..., 0].add(load_diag)
+
+    # direct Toeplitz solve (use_cg_iter kept for API parity; direct LU on the MXU is
+    # already fast for L=512 and more accurate than truncated CG)
+    r_mat = _toeplitz(acf)
+    sol = jnp.linalg.solve(r_mat, xcorr[..., None])[..., 0]
+
+    coh = jnp.einsum("...l,...l->...", xcorr, sol)
+    ratio = coh / (1 - coh)
+    return 10.0 * jnp.log10(ratio)
+
+
+def sdr(preds: Array, target: Array, **kwargs) -> Array:
+    """Deprecated alias of signal_distortion_ratio."""
+    from metrics_tpu.utils.prints import rank_zero_warn
+
+    rank_zero_warn("`sdr` was renamed to `signal_distortion_ratio` and it will be removed.", DeprecationWarning)
+    return signal_distortion_ratio(preds, target, **kwargs)
+
+
+def scale_invariant_signal_distortion_ratio(preds: Array, target: Array, zero_mean: bool = False) -> Array:
+    """SI-SDR. Parity: reference ``:188-240``."""
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    _check_same_shape(preds, target)
+    eps = jnp.finfo(preds.dtype if jnp.issubdtype(preds.dtype, jnp.floating) else jnp.float32).eps
+
+    if zero_mean:
+        target = target - jnp.mean(target, axis=-1, keepdims=True)
+        preds = preds - jnp.mean(preds, axis=-1, keepdims=True)
+
+    alpha = (jnp.sum(preds * target, axis=-1, keepdims=True) + eps) / (
+        jnp.sum(target ** 2, axis=-1, keepdims=True) + eps
+    )
+    target_scaled = alpha * target
+    noise = target_scaled - preds
+    val = (jnp.sum(target_scaled ** 2, axis=-1) + eps) / (jnp.sum(noise ** 2, axis=-1) + eps)
+    return 10 * jnp.log10(val)
+
+
+def si_sdr(preds: Array, target: Array, zero_mean: bool = False) -> Array:
+    """Deprecated alias of scale_invariant_signal_distortion_ratio."""
+    from metrics_tpu.utils.prints import rank_zero_warn
+
+    rank_zero_warn(
+        "`si_sdr` was renamed to `scale_invariant_signal_distortion_ratio` and it will be removed.",
+        DeprecationWarning,
+    )
+    return scale_invariant_signal_distortion_ratio(preds, target, zero_mean)

@@ -1,0 +1,48 @@
+"""Signal-to-noise ratio family.
+
+Parity: reference ``torchmetrics/functional/audio/snr.py`` (signal_noise_ratio :24,
+scale_invariant_signal_noise_ratio :78) and deprecated ``snr``/``si_snr`` aliases.
+"""
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+def signal_noise_ratio(preds: Array, target: Array, zero_mean: bool = False) -> Array:
+    """SNR = 10 log10(P_signal / P_noise)."""
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    _check_same_shape(preds, target)
+    eps = jnp.finfo(preds.dtype).eps
+
+    if zero_mean:
+        target = target - jnp.mean(target, axis=-1, keepdims=True)
+        preds = preds - jnp.mean(preds, axis=-1, keepdims=True)
+
+    noise = target - preds
+    snr_value = (jnp.sum(target ** 2, axis=-1) + eps) / (jnp.sum(noise ** 2, axis=-1) + eps)
+    return 10 * jnp.log10(snr_value)
+
+
+def snr(preds: Array, target: Array, zero_mean: bool = False) -> Array:
+    """Deprecated alias of signal_noise_ratio."""
+    rank_zero_warn("`snr` was renamed to `signal_noise_ratio` and it will be removed.", DeprecationWarning)
+    return signal_noise_ratio(preds, target, zero_mean)
+
+
+def scale_invariant_signal_noise_ratio(preds: Array, target: Array) -> Array:
+    """SI-SNR (scale-invariant SDR with zero-mean)."""
+    from metrics_tpu.functional.audio.sdr import scale_invariant_signal_distortion_ratio
+
+    return scale_invariant_signal_distortion_ratio(preds=preds, target=target, zero_mean=True)
+
+
+def si_snr(preds: Array, target: Array) -> Array:
+    """Deprecated alias of scale_invariant_signal_noise_ratio."""
+    rank_zero_warn(
+        "`si_snr` was renamed to `scale_invariant_signal_noise_ratio` and it will be removed.", DeprecationWarning
+    )
+    return scale_invariant_signal_noise_ratio(preds, target)
