@@ -53,12 +53,36 @@ from metrics_tpu.functional.regression.symmetric_mean_absolute_percentage_error 
     symmetric_mean_absolute_percentage_error,
 )
 from metrics_tpu.functional.regression.tweedie_deviance import tweedie_deviance_score
+from metrics_tpu.functional.text.bert import bert_score
+from metrics_tpu.functional.text.bleu import bleu_score
+from metrics_tpu.functional.text.cer import char_error_rate
+from metrics_tpu.functional.text.chrf import chrf_score
+from metrics_tpu.functional.text.mer import match_error_rate
+from metrics_tpu.functional.text.rouge import rouge_score
+from metrics_tpu.functional.text.sacre_bleu import sacre_bleu_score
+from metrics_tpu.functional.text.squad import squad
+from metrics_tpu.functional.text.ter import translation_edit_rate
+from metrics_tpu.functional.text.wer import word_error_rate
+from metrics_tpu.functional.text.wil import word_information_lost
+from metrics_tpu.functional.text.wip import word_information_preserved
 
 iou = jaccard_index  # deprecated alias (reference functional/iou.py)
 
 __all__ = [
     "accuracy",
+    "bert_score",
+    "bleu_score",
+    "char_error_rate",
+    "chrf_score",
     "cosine_similarity",
+    "match_error_rate",
+    "rouge_score",
+    "sacre_bleu_score",
+    "squad",
+    "translation_edit_rate",
+    "word_error_rate",
+    "word_information_lost",
+    "word_information_preserved",
     "explained_variance",
     "mean_absolute_error",
     "mean_absolute_percentage_error",

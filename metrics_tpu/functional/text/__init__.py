@@ -1,0 +1,12 @@
+from metrics_tpu.functional.text.bert import bert_score
+from metrics_tpu.functional.text.bleu import bleu_score
+from metrics_tpu.functional.text.cer import char_error_rate
+from metrics_tpu.functional.text.chrf import chrf_score
+from metrics_tpu.functional.text.mer import match_error_rate
+from metrics_tpu.functional.text.rouge import rouge_score
+from metrics_tpu.functional.text.sacre_bleu import sacre_bleu_score
+from metrics_tpu.functional.text.squad import squad
+from metrics_tpu.functional.text.ter import translation_edit_rate
+from metrics_tpu.functional.text.wer import wer, word_error_rate
+from metrics_tpu.functional.text.wil import word_information_lost
+from metrics_tpu.functional.text.wip import word_information_preserved

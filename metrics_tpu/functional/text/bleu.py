@@ -1,0 +1,114 @@
+"""BLEU score.
+
+Parity: reference ``torchmetrics/functional/text/bleu.py`` (_count_ngram :25,
+_bleu_score_update :48, _bleu_score_compute :104, bleu_score :148). N-gram counting
+is host-side (strings); the accumulated numerator/denominator/length counters are
+device sum-states.
+"""
+from collections import Counter
+from typing import Callable, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+def _count_ngram(ngram_input_list: Sequence[str], n_gram: int) -> Counter:
+    ngram_counter: Counter = Counter()
+    for i in range(1, n_gram + 1):
+        for j in range(len(ngram_input_list) - i + 1):
+            ngram_counter[tuple(ngram_input_list[j:i + j])] += 1
+    return ngram_counter
+
+
+def _tokenize_fn(sentence: str) -> Sequence[str]:
+    return sentence.split()
+
+
+def _bleu_score_update(
+    translate_corpus: Sequence[str],
+    reference_corpus: Sequence[Sequence[str]],
+    numerator: Array,
+    denominator: Array,
+    trans_len: Array,
+    ref_len: Array,
+    n_gram: int = 4,
+    tokenizer: Callable[[str], Sequence[str]] = _tokenize_fn,
+) -> Tuple[Array, Array, Array, Array]:
+    """Accumulate clipped n-gram matches. Returns (trans_len, ref_len, numerator,
+    denominator) — the counters are returned (not mutated) for the functional style."""
+    reference_corpus_ = [[tokenizer(line) if line else [] for line in reference] for reference in reference_corpus]
+    translate_corpus_ = [tokenizer(line) if line else [] for line in translate_corpus]
+
+    num_np = np.zeros(n_gram)
+    den_np = np.zeros(n_gram)
+    t_len = 0
+    r_len = 0
+    for translation, references in zip(translate_corpus_, reference_corpus_):
+        t_len += len(translation)
+        ref_len_list = [len(ref) for ref in references]
+        ref_len_diff = [abs(len(translation) - x) for x in ref_len_list]
+        r_len += ref_len_list[ref_len_diff.index(min(ref_len_diff))]
+        translation_counter = _count_ngram(translation, n_gram)
+        reference_counter: Counter = Counter()
+        for ref in references:
+            reference_counter |= _count_ngram(ref, n_gram)
+        ngram_counter_clip = translation_counter & reference_counter
+        for counter_clip in ngram_counter_clip:
+            num_np[len(counter_clip) - 1] += ngram_counter_clip[counter_clip]
+        for counter in translation_counter:
+            den_np[len(counter) - 1] += translation_counter[counter]
+    return (
+        trans_len + t_len,
+        ref_len + r_len,
+        numerator + jnp.asarray(num_np, dtype=jnp.float32),
+        denominator + jnp.asarray(den_np, dtype=jnp.float32),
+    )
+
+
+def _bleu_score_compute(
+    trans_len: Array,
+    ref_len: Array,
+    numerator: Array,
+    denominator: Array,
+    n_gram: int = 4,
+    smooth: bool = False,
+) -> Array:
+    if float(jnp.min(numerator)) == 0.0:
+        return jnp.asarray(0.0)
+    if smooth:
+        precision_scores = (numerator + 1.0) / (denominator + 1.0)
+        precision_scores = precision_scores.at[0].set(numerator[0] / denominator[0])
+    else:
+        precision_scores = numerator / denominator
+    log_precision_scores = (1.0 / n_gram) * jnp.log(precision_scores)
+    geometric_mean = jnp.exp(jnp.sum(log_precision_scores))
+    brevity_penalty = jnp.where(trans_len > ref_len, 1.0, jnp.exp(1 - ref_len / trans_len))
+    return brevity_penalty * geometric_mean
+
+
+def bleu_score(
+    translate_corpus: Union[str, Sequence[str]],
+    reference_corpus: Sequence[Union[str, Sequence[str]]],
+    n_gram: int = 4,
+    smooth: bool = False,
+) -> Array:
+    """Corpus BLEU with uniform n-gram weights and brevity penalty."""
+    translate_corpus_ = [translate_corpus] if isinstance(translate_corpus, str) else translate_corpus
+    reference_corpus_ = [
+        [reference_text] if isinstance(reference_text, str) else reference_text
+        for reference_text in reference_corpus
+    ]
+    if len(translate_corpus_) != len(reference_corpus_):
+        raise ValueError(f"Corpus has different size {len(translate_corpus_)} != {len(reference_corpus_)}")
+
+    numerator = jnp.zeros(n_gram)
+    denominator = jnp.zeros(n_gram)
+    trans_len = jnp.asarray(0.0)
+    ref_len = jnp.asarray(0.0)
+    trans_len, ref_len, numerator, denominator = _bleu_score_update(
+        translate_corpus_, reference_corpus_, numerator, denominator, trans_len, ref_len, n_gram
+    )
+    return _bleu_score_compute(trans_len, ref_len, numerator, denominator, n_gram, smooth)

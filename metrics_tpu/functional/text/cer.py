@@ -1,0 +1,32 @@
+"""Character error rate.
+
+Parity: reference ``torchmetrics/functional/text/cer.py``.
+"""
+from typing import List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.helper import _edit_distance_batch
+
+Array = jax.Array
+
+
+def _cer_update(predictions: Union[str, List[str]], references: Union[str, List[str]]) -> Tuple[Array, Array]:
+    if isinstance(predictions, str):
+        predictions = [predictions]
+    if isinstance(references, str):
+        references = [references]
+    errors = _edit_distance_batch([list(p) for p in predictions], [list(r) for r in references]).sum()
+    total = sum(len(r) for r in references)
+    return jnp.asarray(float(errors)), jnp.asarray(float(total))
+
+
+def _cer_compute(errors: Array, total: Array) -> Array:
+    return errors / total
+
+
+def char_error_rate(predictions: Union[str, List[str]], references: Union[str, List[str]]) -> Array:
+    """CER = character edit operations / reference characters."""
+    errors, total = _cer_update(predictions, references)
+    return _cer_compute(errors, total)

@@ -1,0 +1,148 @@
+"""chrF / chrF++ score.
+
+Parity: reference ``torchmetrics/functional/text/chrf.py`` (704 LoC; the sacrebleu
+chrF algorithm: character n-grams up to ``n_char_order`` plus optional word n-grams
+up to ``n_word_order``, combined with an F-beta over averaged per-order precision and
+recall). States are per-order matching/pred/ref count tensors, all sum-reducible.
+"""
+from collections import Counter
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+_EPS_SMOOTHING = 1e-16
+
+
+def _prepare_text(text: str, lowercase: bool, whitespace: bool) -> str:
+    if lowercase:
+        text = text.lower()
+    if not whitespace:
+        text = "".join(text.split())
+    return text
+
+
+def _char_ngrams(text: str, n: int) -> Counter:
+    return Counter(text[i:i + n] for i in range(len(text) - n + 1))
+
+
+def _word_ngrams(words: List[str], n: int) -> Counter:
+    return Counter(tuple(words[i:i + n]) for i in range(len(words) - n + 1))
+
+
+def _sentence_counts(
+    text: str, n_char_order: int, n_word_order: int, lowercase: bool, whitespace: bool
+) -> Tuple[List[Counter], List[Counter]]:
+    prepared = _prepare_text(text, lowercase, whitespace)
+    char_counts = [_char_ngrams(prepared, n) for n in range(1, n_char_order + 1)]
+    words = text.lower().split() if lowercase else text.split()
+    word_counts = [_word_ngrams(words, n) for n in range(1, n_word_order + 1)]
+    return char_counts, word_counts
+
+
+def _matching(pred: Counter, ref: Counter) -> int:
+    return sum((pred & ref).values())
+
+
+def _chrf_score_from_totals(
+    matching: Array, total_pred: Array, total_ref: Array, beta: float
+) -> Array:
+    """F-beta over per-order precision/recall averages (sacrebleu semantics)."""
+    precision = jnp.where(total_pred > 0, matching / jnp.maximum(total_pred, 1), 0.0)
+    recall = jnp.where(total_ref > 0, matching / jnp.maximum(total_ref, 1), 0.0)
+    order_mask = (total_pred + total_ref) > 0
+    n_eff = jnp.maximum(jnp.sum(order_mask), 1)
+    avg_precision = jnp.sum(jnp.where(order_mask, precision, 0.0)) / n_eff
+    avg_recall = jnp.sum(jnp.where(order_mask, recall, 0.0)) / n_eff
+    beta2 = beta ** 2
+    denom = beta2 * avg_precision + avg_recall
+    f_score = jnp.where(
+        denom > 0, (1 + beta2) * avg_precision * avg_recall / jnp.maximum(denom, _EPS_SMOOTHING), 0.0
+    )
+    return f_score
+
+
+def _chrf_update(
+    preds: Sequence[str],
+    targets: Sequence[str],
+    matching: Array,
+    total_pred: Array,
+    total_ref: Array,
+    n_char_order: int = 6,
+    n_word_order: int = 2,
+    lowercase: bool = False,
+    whitespace: bool = False,
+    beta: float = 2.0,
+    sentence_scores: Optional[List[Array]] = None,
+) -> Tuple[Array, Array, Array]:
+    """Accumulate per-order n-gram statistics over a batch of sentence pairs."""
+    n_order = n_char_order + n_word_order
+    import numpy as np
+
+    m_np = np.zeros(n_order)
+    p_np = np.zeros(n_order)
+    r_np = np.zeros(n_order)
+    for pred, ref in zip(preds, targets):
+        p_char, p_word = _sentence_counts(pred, n_char_order, n_word_order, lowercase, whitespace)
+        r_char, r_word = _sentence_counts(ref, n_char_order, n_word_order, lowercase, whitespace)
+        sent_m = np.zeros(n_order)
+        sent_p = np.zeros(n_order)
+        sent_r = np.zeros(n_order)
+        for i, (pc, rc) in enumerate(list(zip(p_char, r_char)) + list(zip(p_word, r_word))):
+            sent_m[i] = _matching(pc, rc)
+            sent_p[i] = sum(pc.values())
+            sent_r[i] = sum(rc.values())
+        m_np += sent_m
+        p_np += sent_p
+        r_np += sent_r
+        if sentence_scores is not None:
+            sentence_scores.append(
+                _chrf_score_from_totals(jnp.asarray(sent_m), jnp.asarray(sent_p), jnp.asarray(sent_r), beta)
+            )
+    return (
+        matching + jnp.asarray(m_np, dtype=jnp.float32),
+        total_pred + jnp.asarray(p_np, dtype=jnp.float32),
+        total_ref + jnp.asarray(r_np, dtype=jnp.float32),
+    )
+
+
+def _chrf_compute(matching: Array, total_pred: Array, total_ref: Array, beta: float = 2.0) -> Array:
+    return _chrf_score_from_totals(matching, total_pred, total_ref, beta)
+
+
+def chrf_score(
+    preds: Union[str, Sequence[str]],
+    targets: Union[str, Sequence[str]],
+    n_char_order: int = 6,
+    n_word_order: int = 2,
+    beta: float = 2.0,
+    lowercase: bool = False,
+    whitespace: bool = False,
+    return_sentence_level_score: bool = False,
+) -> Union[Array, Tuple[Array, Array]]:
+    """Corpus chrF (chrF++ with word n-grams). Parity: reference ``chrf_score``."""
+    if not isinstance(n_char_order, int) or n_char_order < 1:
+        raise ValueError("Expected argument `n_char_order` to be an integer greater than or equal to 1.")
+    if not isinstance(n_word_order, int) or n_word_order < 0:
+        raise ValueError("Expected argument `n_word_order` to be an integer greater than or equal to 0.")
+    if beta < 0:
+        raise ValueError("Expected argument `beta` to be greater than 0.")
+
+    preds_ = [preds] if isinstance(preds, str) else list(preds)
+    targets_ = [targets] if isinstance(targets, str) else list(targets)
+
+    n_order = n_char_order + n_word_order
+    matching = jnp.zeros(n_order)
+    total_pred = jnp.zeros(n_order)
+    total_ref = jnp.zeros(n_order)
+    sentence_scores: Optional[List[Array]] = [] if return_sentence_level_score else None
+    matching, total_pred, total_ref = _chrf_update(
+        preds_, targets_, matching, total_pred, total_ref, n_char_order, n_word_order,
+        lowercase, whitespace, beta, sentence_scores,
+    )
+    score = _chrf_compute(matching, total_pred, total_ref, beta)
+    if return_sentence_level_score:
+        return score, jnp.stack(sentence_scores)
+    return score

@@ -1,0 +1,34 @@
+"""Match error rate.
+
+Parity: reference ``torchmetrics/functional/text/mer.py``.
+"""
+from typing import List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.helper import _edit_distance_batch
+
+Array = jax.Array
+
+
+def _mer_update(predictions: Union[str, List[str]], references: Union[str, List[str]]) -> Tuple[Array, Array]:
+    if isinstance(predictions, str):
+        predictions = [predictions]
+    if isinstance(references, str):
+        references = [references]
+    pred_tokens = [p.split() for p in predictions]
+    ref_tokens = [r.split() for r in references]
+    errors = _edit_distance_batch(pred_tokens, ref_tokens).sum()
+    total = sum(max(len(r), len(p)) for p, r in zip(pred_tokens, ref_tokens))
+    return jnp.asarray(float(errors)), jnp.asarray(float(total))
+
+
+def _mer_compute(errors: Array, total: Array) -> Array:
+    return errors / total
+
+
+def match_error_rate(predictions: Union[str, List[str]], references: Union[str, List[str]]) -> Array:
+    """MER = edit operations / max(reference, prediction) length."""
+    errors, total = _mer_update(predictions, references)
+    return _mer_compute(errors, total)

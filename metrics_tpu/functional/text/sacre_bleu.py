@@ -1,0 +1,118 @@
+"""SacreBLEU: BLEU with canonical tokenizers (13a / intl / char / zh / ja).
+
+Parity: reference ``torchmetrics/functional/text/sacre_bleu.py`` (361 LoC;
+_SacreBLEUTokenizer with the mteval-v13a and international tokenizers). zh/ja
+tokenizers require external segmenters (mecab) and are gated like the reference.
+"""
+import re
+from functools import partial
+from typing import Sequence, Union
+
+import jax
+
+from metrics_tpu.functional.text.bleu import _bleu_score_compute, _bleu_score_update, bleu_score
+from metrics_tpu.utils.imports import _REGEX_AVAILABLE
+
+Array = jax.Array
+
+AVAILABLE_TOKENIZERS = ("none", "13a", "zh", "intl", "char")
+
+
+class _SacreBLEUTokenizer:
+    """Canonical sacrebleu tokenizers. Parity: reference ``sacre_bleu.py:45-200``."""
+
+    _REGEX_13A = (
+        # language-independent part of mteval-v13a
+        (re.compile(r"<skipped>"), ""),
+        (re.compile(r"-\n"), ""),
+        (re.compile(r"\n"), " "),
+        (re.compile(r"&quot;"), '"'),
+        (re.compile(r"&amp;"), "&"),
+        (re.compile(r"&lt;"), "<"),
+        (re.compile(r"&gt;"), ">"),
+    )
+    _REGEX_13A_TOK = (
+        (re.compile(r"([\{-\~\[-\` -\&\(-\+\:-\@\/])"), r" \1 "),
+        (re.compile(r"([^0-9])([\.,])"), r"\1 \2 "),
+        (re.compile(r"([\.,])([^0-9])"), r" \1 \2"),
+        (re.compile(r"([0-9])(-)"), r"\1 \2 "),
+    )
+
+    def __init__(self, tokenize: str = "13a", lowercase: bool = False) -> None:
+        if tokenize not in AVAILABLE_TOKENIZERS:
+            raise ValueError(f"Argument `tokenize` expected to be one of {AVAILABLE_TOKENIZERS} but got {tokenize}.")
+        self.tokenize_name = tokenize
+        self.lowercase = lowercase
+        if tokenize == "intl" and not _REGEX_AVAILABLE:
+            raise ModuleNotFoundError(
+                "`intl` tokenization requires the `regex` package (unicode property classes)."
+            )
+        if tokenize == "zh":
+            raise ModuleNotFoundError(
+                "`zh` tokenization requires a Chinese segmenter which is not available in this build."
+            )
+
+    def __call__(self, line: str) -> Sequence[str]:
+        if self.lowercase:
+            line = line.lower()
+        if self.tokenize_name == "none":
+            return line.split()
+        if self.tokenize_name == "13a":
+            return self._tokenize_13a(line)
+        if self.tokenize_name == "char":
+            return self._tokenize_char(line)
+        if self.tokenize_name == "intl":
+            return self._tokenize_intl(line)
+        raise ValueError(f"Unsupported tokenizer {self.tokenize_name}")
+
+    @classmethod
+    def _tokenize_13a(cls, line: str) -> Sequence[str]:
+        for pattern, replacement in cls._REGEX_13A:
+            line = pattern.sub(replacement, line)
+        norm = f" {line} "
+        for pattern, replacement in cls._REGEX_13A_TOK:
+            norm = pattern.sub(replacement, norm)
+        return norm.split()
+
+    @staticmethod
+    def _tokenize_char(line: str) -> Sequence[str]:
+        # every char is a token; whitespace chars drop out (sacrebleu semantics)
+        return [ch for ch in line if not ch.isspace()]
+
+    @staticmethod
+    def _tokenize_intl(line: str) -> Sequence[str]:
+        import regex
+
+        line = regex.sub(r"(\p{P})(\P{N})", r" \1 \2", line)
+        line = regex.sub(r"(\P{N})(\p{P})", r"\1 \2 ", line)
+        return line.split()
+
+
+def sacre_bleu_score(
+    translate_corpus: Sequence[str],
+    reference_corpus: Sequence[Sequence[str]],
+    n_gram: int = 4,
+    smooth: bool = False,
+    tokenize: str = "13a",
+    lowercase: bool = False,
+) -> Array:
+    """BLEU with a sacrebleu tokenizer. Parity: reference ``sacre_bleu_score:220+``."""
+    import jax.numpy as jnp
+
+    tokenizer = _SacreBLEUTokenizer(tokenize, lowercase)
+    translate_corpus_ = [translate_corpus] if isinstance(translate_corpus, str) else list(translate_corpus)
+    reference_corpus_ = [
+        [ref] if isinstance(ref, str) else list(ref) for ref in reference_corpus
+    ]
+    if len(translate_corpus_) != len(reference_corpus_):
+        raise ValueError(f"Corpus has different size {len(translate_corpus_)} != {len(reference_corpus_)}")
+
+    numerator = jnp.zeros(n_gram)
+    denominator = jnp.zeros(n_gram)
+    trans_len = jnp.asarray(0.0)
+    ref_len = jnp.asarray(0.0)
+    trans_len, ref_len, numerator, denominator = _bleu_score_update(
+        translate_corpus_, reference_corpus_, numerator, denominator, trans_len, ref_len, n_gram,
+        tokenizer=tokenizer,
+    )
+    return _bleu_score_compute(trans_len, ref_len, numerator, denominator, n_gram, smooth)

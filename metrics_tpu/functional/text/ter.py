@@ -1,0 +1,170 @@
+"""Translation edit rate (TER).
+
+Parity: reference ``torchmetrics/functional/text/ter.py`` (626 LoC; tercom-style
+normalisation + greedy shift search over the beam of possible block moves, each
+scored by Levenshtein distance — the distance kernel runs natively, see
+``metrics_tpu/native/levenshtein.cpp``).
+"""
+import re
+import string
+import unicodedata
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.helper import _edit_distance
+
+Array = jax.Array
+
+_MAX_SHIFT_SIZE = 10
+_MAX_SHIFT_DIST = 50
+
+
+def _normalize_general_and_western(sentence: str) -> str:
+    rules = (
+        (r"\n-", ""),
+        (r"\n", " "),
+        (r"&quot;", '"'),
+        (r"&amp;", "&"),
+        (r"&lt;", "<"),
+        (r"&gt;", ">"),
+        (r"([{-~\[-` -&(-+:-@/])", r" \1 "),
+        (r"'s ", " 's "),
+        (r"'s$", " 's"),
+        (r"([^0-9])([\.,])", r"\1 \2 "),
+        (r"([\.,])([^0-9])", r" \1 \2"),
+        (r"([0-9])(-)", r"\1 \2 "),
+    )
+    for pattern, replacement in rules:
+        sentence = re.sub(pattern, replacement, sentence)
+    return sentence
+
+
+def _remove_punct(sentence: str) -> str:
+    return re.sub(f"[{re.escape(string.punctuation)}]", "", sentence)
+
+
+def _preprocess_sentence(sentence: str, lowercase: bool, normalize: bool, no_punctuation: bool) -> List[str]:
+    sentence = sentence.rstrip()
+    if lowercase:
+        sentence = sentence.lower()
+    if normalize:
+        sentence = _normalize_general_and_western(sentence)
+    if no_punctuation:
+        sentence = _remove_punct(sentence)
+    return sentence.split()
+
+
+def _find_shifted_sequences(words: List[str]) -> dict:
+    """All contiguous subsequences (up to _MAX_SHIFT_SIZE) -> start positions."""
+    seqs: dict = {}
+    for start in range(len(words)):
+        for length in range(1, min(_MAX_SHIFT_SIZE, len(words) - start) + 1):
+            seqs.setdefault(tuple(words[start:start + length]), []).append((start, length))
+    return seqs
+
+
+def _shift_words(words: List[str], start: int, length: int, dest: int) -> List[str]:
+    block = words[start:start + length]
+    rest = words[:start] + words[start + length:]
+    # dest is the index in `rest` the block is inserted before
+    return rest[:dest] + block + rest[dest:]
+
+
+def _ter_sentence(pred_words: List[str], ref_words: List[str]) -> float:
+    """Shifts + edits for one hypothesis against one reference (greedy tercom)."""
+    if len(ref_words) == 0:
+        return float(len(pred_words))
+
+    num_shifts = 0
+    current = list(pred_words)
+    current_dist = _edit_distance(current, ref_words)
+    ref_seqs = _find_shifted_sequences(ref_words)
+
+    while current_dist > 0:
+        best_dist = current_dist
+        best_words: Optional[List[str]] = None
+        # try moving every (start, length) block of the hypothesis that also occurs
+        # in the reference to each occurrence position
+        for start in range(len(current)):
+            for length in range(1, min(_MAX_SHIFT_SIZE, len(current) - start) + 1):
+                block = tuple(current[start:start + length])
+                if block not in ref_seqs:
+                    continue
+                for dest, _ in ref_seqs[block]:
+                    if abs(dest - start) > _MAX_SHIFT_DIST:
+                        continue
+                    shifted = _shift_words(current, start, length, min(dest, len(current) - length))
+                    d = _edit_distance(shifted, ref_words)
+                    if d < best_dist:
+                        best_dist = d
+                        best_words = shifted
+        if best_words is None:
+            break
+        num_shifts += 1
+        current = best_words
+        current_dist = best_dist
+
+    return float(num_shifts + current_dist)
+
+
+def _ter_update(
+    preds: Sequence[str],
+    targets: Sequence[Sequence[str]],
+    total_num_edits: Array,
+    total_ref_len: Array,
+    lowercase: bool = True,
+    normalize: bool = False,
+    no_punctuation: bool = False,
+    sentence_scores: Optional[List[Array]] = None,
+) -> Tuple[Array, Array]:
+    edits_sum = 0.0
+    ref_len_sum = 0.0
+    for pred, refs in zip(preds, targets):
+        pred_words = _preprocess_sentence(pred, lowercase, normalize, no_punctuation)
+        best_edits = None
+        best_ref_len = None
+        for ref in refs:
+            ref_words = _preprocess_sentence(ref, lowercase, normalize, no_punctuation)
+            edits = _ter_sentence(pred_words, ref_words)
+            ref_len = max(len(ref_words), 1)
+            if best_edits is None or edits / ref_len < best_edits / best_ref_len:
+                best_edits, best_ref_len = edits, ref_len
+        edits_sum += best_edits
+        ref_len_sum += best_ref_len
+        if sentence_scores is not None:
+            sentence_scores.append(jnp.asarray(best_edits / best_ref_len))
+    return total_num_edits + edits_sum, total_ref_len + ref_len_sum
+
+
+def _ter_compute(total_num_edits: Array, total_ref_len: Array) -> Array:
+    return total_num_edits / total_ref_len
+
+
+def translation_edit_rate(
+    preds: Union[str, Sequence[str]],
+    targets: Union[str, Sequence[str], Sequence[Sequence[str]]],
+    normalize: bool = False,
+    no_punctuation: bool = False,
+    lowercase: bool = True,
+    asian_support: bool = False,
+    return_sentence_level_score: bool = False,
+) -> Union[Array, Tuple[Array, Array]]:
+    """Corpus TER = (shifts + edits) / reference length. Parity: reference API."""
+    if asian_support:
+        raise ModuleNotFoundError("`asian_support` requires language segmenters not available in this build.")
+    preds_ = [preds] if isinstance(preds, str) else list(preds)
+    targets_ = [targets] if isinstance(targets, str) else list(targets)
+    targets_ = [[t] if isinstance(t, str) else list(t) for t in targets_]
+
+    total_num_edits = jnp.asarray(0.0)
+    total_ref_len = jnp.asarray(0.0)
+    sentence_scores: Optional[List[Array]] = [] if return_sentence_level_score else None
+    total_num_edits, total_ref_len = _ter_update(
+        preds_, targets_, total_num_edits, total_ref_len, lowercase, normalize, no_punctuation, sentence_scores
+    )
+    score = _ter_compute(total_num_edits, total_ref_len)
+    if return_sentence_level_score:
+        return score, jnp.stack(sentence_scores)
+    return score

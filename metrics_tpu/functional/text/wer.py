@@ -1,0 +1,43 @@
+"""Word error rate.
+
+Parity: reference ``torchmetrics/functional/text/wer.py``. Host-side tokenization +
+native batch edit distance producing device counter deltas (the host/device split
+from SURVEY.md §7.3).
+"""
+from typing import List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.helper import _edit_distance_batch
+from metrics_tpu.utils.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+def _wer_update(predictions: Union[str, List[str]], references: Union[str, List[str]]) -> Tuple[Array, Array]:
+    if isinstance(predictions, str):
+        predictions = [predictions]
+    if isinstance(references, str):
+        references = [references]
+    pred_tokens = [p.split() for p in predictions]
+    ref_tokens = [r.split() for r in references]
+    errors = _edit_distance_batch(pred_tokens, ref_tokens).sum()
+    total = sum(len(r) for r in ref_tokens)
+    return jnp.asarray(float(errors)), jnp.asarray(float(total))
+
+
+def _wer_compute(errors: Array, total: Array) -> Array:
+    return errors / total
+
+
+def word_error_rate(predictions: Union[str, List[str]], references: Union[str, List[str]]) -> Array:
+    """WER = edit operations / reference words."""
+    errors, total = _wer_update(predictions, references)
+    return _wer_compute(errors, total)
+
+
+def wer(predictions: Union[str, List[str]], references: Union[str, List[str]]) -> Array:
+    """Deprecated alias of word_error_rate."""
+    rank_zero_warn("`wer` was renamed to `word_error_rate` and it will be removed.", DeprecationWarning)
+    return word_error_rate(predictions, references)

@@ -1,0 +1,38 @@
+"""Word information preserved.
+
+Parity: reference ``torchmetrics/functional/text/wip.py``.
+"""
+from typing import List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.helper import _edit_distance_batch
+
+Array = jax.Array
+
+
+def _wip_update(
+    predictions: Union[str, List[str]], references: Union[str, List[str]]
+) -> Tuple[Array, Array, Array]:
+    if isinstance(predictions, str):
+        predictions = [predictions]
+    if isinstance(references, str):
+        references = [references]
+    pred_tokens = [p.split() for p in predictions]
+    ref_tokens = [r.split() for r in references]
+    errors = float(_edit_distance_batch(pred_tokens, ref_tokens).sum())
+    reference_total = float(sum(len(r) for r in ref_tokens))
+    prediction_total = float(sum(len(p) for p in pred_tokens))
+    total = float(sum(max(len(r), len(p)) for p, r in zip(pred_tokens, ref_tokens)))
+    return jnp.asarray(errors - total), jnp.asarray(reference_total), jnp.asarray(prediction_total)
+
+
+def _wip_compute(errors: Array, reference_total: Array, prediction_total: Array) -> Array:
+    return (errors / reference_total) * (errors / prediction_total)
+
+
+def word_information_preserved(predictions: Union[str, List[str]], references: Union[str, List[str]]) -> Array:
+    """WIP = (H/N_ref)(H/N_pred)."""
+    errors, reference_total, prediction_total = _wip_update(predictions, references)
+    return _wip_compute(errors, reference_total, prediction_total)

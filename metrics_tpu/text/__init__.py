@@ -1,0 +1,12 @@
+from metrics_tpu.text.bert import BERTScore
+from metrics_tpu.text.bleu import BLEUScore
+from metrics_tpu.text.cer import CharErrorRate
+from metrics_tpu.text.chrf import CHRFScore
+from metrics_tpu.text.mer import MatchErrorRate
+from metrics_tpu.text.rouge import ROUGEScore
+from metrics_tpu.text.sacre_bleu import SacreBLEUScore
+from metrics_tpu.text.squad import SQuAD
+from metrics_tpu.text.ter import TranslationEditRate
+from metrics_tpu.text.wer import WER, WordErrorRate
+from metrics_tpu.text.wil import WordInfoLost
+from metrics_tpu.text.wip import WordInfoPreserved

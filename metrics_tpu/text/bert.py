@@ -1,0 +1,120 @@
+"""BERTScore module metric.
+
+Parity: reference ``torchmetrics/text/bert.py:40`` (update :195 tokenizes and stores
+token tensors as cat-states; compute :226 runs the embedding pipeline). The encoder
+is pluggable (local HF Flax model / user forward fn) — see
+``functional/text/bert.py``.
+"""
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.functional.text.bert import (
+    _bert_score_from_embeddings,
+    _get_tokens_idf,
+    _idf_weights,
+    _simple_whitespace_tokenizer,
+)
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class BERTScore(Metric):
+    is_differentiable = False
+    higher_is_better = True
+
+    def __init__(
+        self,
+        model_name_or_path: Optional[str] = None,
+        num_layers: Optional[int] = None,
+        all_layers: bool = False,
+        model: Optional[Any] = None,
+        user_tokenizer: Optional[Any] = None,
+        user_forward_fn: Optional[Callable] = None,
+        verbose: bool = False,
+        idf: bool = False,
+        max_length: int = 128,
+        batch_size: int = 64,
+        num_threads: int = 4,
+        return_hash: bool = False,
+        lang: str = "en",
+        rescale_with_baseline: bool = False,
+        baseline_path: Optional[str] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.model_name_or_path = model_name_or_path
+        self.max_length = max_length
+        self.batch_size = batch_size
+        self.idf = idf
+        self.user_tokenizer = user_tokenizer
+
+        forward = user_forward_fn
+        if forward is None and model is not None:
+            forward = lambda ids, mask: model(ids, mask)
+        if forward is None and model_name_or_path is not None:
+            from transformers import FlaxAutoModel
+
+            hf_model = FlaxAutoModel.from_pretrained(model_name_or_path)
+            forward = lambda ids, mask: hf_model(input_ids=ids, attention_mask=mask).last_hidden_state
+        if forward is None:
+            raise ValueError(
+                "BERTScore needs an encoder: pass `user_forward_fn`, `model`, or a local `model_name_or_path`"
+                " (this build cannot download pretrained weights)."
+            )
+        self.forward_fn = forward
+
+        self.add_state("preds_input_ids", [], dist_reduce_fx="cat")
+        self.add_state("preds_attention_mask", [], dist_reduce_fx="cat")
+        self.add_state("target_input_ids", [], dist_reduce_fx="cat")
+        self.add_state("target_attention_mask", [], dist_reduce_fx="cat")
+
+    def _tokenize(self, sentences: List[str]) -> Dict[str, np.ndarray]:
+        if self.user_tokenizer is not None:
+            return self.user_tokenizer(sentences, self.max_length)
+        return _simple_whitespace_tokenizer(sentences, self.max_length)
+
+    def update(self, predictions: List[str], references: List[str]) -> None:
+        enc_pred = self._tokenize(predictions)
+        enc_tgt = self._tokenize(references)
+        self.preds_input_ids.append(jnp.asarray(enc_pred["input_ids"]))
+        self.preds_attention_mask.append(jnp.asarray(enc_pred["attention_mask"]))
+        self.target_input_ids.append(jnp.asarray(enc_tgt["input_ids"]))
+        self.target_attention_mask.append(jnp.asarray(enc_tgt["attention_mask"]))
+
+    def compute(self) -> Dict[str, List[float]]:
+        pred_ids = np.asarray(dim_zero_cat(self.preds_input_ids))
+        pred_mask = np.asarray(dim_zero_cat(self.preds_attention_mask))
+        tgt_ids = np.asarray(dim_zero_cat(self.target_input_ids))
+        tgt_mask = np.asarray(dim_zero_cat(self.target_attention_mask))
+
+        def _embed(ids, mask):
+            outs = []
+            for i in range(0, ids.shape[0], self.batch_size):
+                outs.append(
+                    jnp.asarray(self.forward_fn(jnp.asarray(ids[i:i + self.batch_size]),
+                                                jnp.asarray(mask[i:i + self.batch_size])))
+                )
+            return jnp.concatenate(outs, axis=0)
+
+        pred_emb = _embed(pred_ids, pred_mask)
+        tgt_emb = _embed(tgt_ids, tgt_mask)
+
+        pred_w = tgt_w = None
+        if self.idf:
+            idf_map = _get_tokens_idf(tgt_ids, tgt_mask)
+            pred_w = jnp.asarray(_idf_weights(pred_ids, pred_mask, idf_map))
+            tgt_w = jnp.asarray(_idf_weights(tgt_ids, tgt_mask, idf_map))
+
+        precision, recall, f1 = _bert_score_from_embeddings(
+            pred_emb, jnp.asarray(pred_mask), tgt_emb, jnp.asarray(tgt_mask), pred_w, tgt_w
+        )
+        return {
+            "precision": [float(x) for x in np.asarray(precision)],
+            "recall": [float(x) for x in np.asarray(recall)],
+            "f1": [float(x) for x in np.asarray(f1)],
+        }

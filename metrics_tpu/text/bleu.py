@@ -1,0 +1,43 @@
+"""BLEUScore module metric.
+
+Parity: reference ``torchmetrics/text/bleu.py:29`` (states :92-95: n-gram
+numerator/denominator + length counters, all sum-reduced — one fused psum on sync).
+"""
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.bleu import _bleu_score_compute, _bleu_score_update
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class BLEUScore(Metric):
+    is_differentiable = False
+    higher_is_better = True
+
+    def __init__(self, n_gram: int = 4, smooth: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.n_gram = n_gram
+        self.smooth = smooth
+        self.add_state("trans_len", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("ref_len", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("numerator", jnp.zeros(n_gram), dist_reduce_fx="sum")
+        self.add_state("denominator", jnp.zeros(n_gram), dist_reduce_fx="sum")
+
+    def update(self, translate_corpus: Sequence[str], reference_corpus: Sequence[Sequence[str]]) -> None:
+        translate_corpus = [translate_corpus] if isinstance(translate_corpus, str) else translate_corpus
+        reference_corpus = [
+            [ref] if isinstance(ref, str) else ref for ref in reference_corpus
+        ]
+        self.trans_len, self.ref_len, self.numerator, self.denominator = _bleu_score_update(
+            translate_corpus, reference_corpus, self.numerator, self.denominator,
+            self.trans_len, self.ref_len, self.n_gram,
+        )
+
+    def compute(self) -> Array:
+        return _bleu_score_compute(
+            self.trans_len, self.ref_len, self.numerator, self.denominator, self.n_gram, self.smooth
+        )

@@ -1,0 +1,31 @@
+"""CharErrorRate module metric.
+
+Parity: reference ``torchmetrics/text/cer.py:24``.
+"""
+from typing import Any, List, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.cer import _cer_compute, _cer_update
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class CharErrorRate(Metric):
+    is_differentiable = False
+    higher_is_better = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("errors", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, predictions: Union[str, List[str]], references: Union[str, List[str]]) -> None:
+        errors, total = _cer_update(predictions, references)
+        self.errors = self.errors + errors
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        return _cer_compute(self.errors, self.total)

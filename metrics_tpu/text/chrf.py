@@ -1,0 +1,68 @@
+"""CHRFScore module metric.
+
+Parity: reference ``torchmetrics/text/chrf.py:46`` (per-order count states, all
+sum-reduced).
+"""
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.chrf import _chrf_compute, _chrf_update
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class CHRFScore(Metric):
+    is_differentiable = False
+    higher_is_better = True
+
+    def __init__(
+        self,
+        n_char_order: int = 6,
+        n_word_order: int = 2,
+        beta: float = 2.0,
+        lowercase: bool = False,
+        whitespace: bool = False,
+        return_sentence_level_score: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(n_char_order, int) or n_char_order < 1:
+            raise ValueError("Expected argument `n_char_order` to be an integer greater than or equal to 1.")
+        if not isinstance(n_word_order, int) or n_word_order < 0:
+            raise ValueError("Expected argument `n_word_order` to be an integer greater than or equal to 0.")
+        if beta < 0:
+            raise ValueError("Expected argument `beta` to be greater than 0.")
+        self.n_char_order = n_char_order
+        self.n_word_order = n_word_order
+        self.beta = beta
+        self.lowercase = lowercase
+        self.whitespace = whitespace
+        self.return_sentence_level_score = return_sentence_level_score
+
+        n_order = n_char_order + n_word_order
+        self.add_state("matching", jnp.zeros(n_order), dist_reduce_fx="sum")
+        self.add_state("total_pred", jnp.zeros(n_order), dist_reduce_fx="sum")
+        self.add_state("total_ref", jnp.zeros(n_order), dist_reduce_fx="sum")
+        if return_sentence_level_score:
+            self.add_state("sentence_chrf", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Sequence[str], targets: Sequence[str]) -> None:
+        preds = [preds] if isinstance(preds, str) else preds
+        targets = [targets] if isinstance(targets, str) else targets
+        sentence_scores: Optional[List[Array]] = [] if self.return_sentence_level_score else None
+        self.matching, self.total_pred, self.total_ref = _chrf_update(
+            preds, targets, self.matching, self.total_pred, self.total_ref,
+            self.n_char_order, self.n_word_order, self.lowercase, self.whitespace, self.beta, sentence_scores,
+        )
+        if self.return_sentence_level_score and sentence_scores:
+            self.sentence_chrf.append(jnp.stack(sentence_scores))
+
+    def compute(self) -> Union[Array, Tuple[Array, Array]]:
+        score = _chrf_compute(self.matching, self.total_pred, self.total_ref, self.beta)
+        if self.return_sentence_level_score:
+            return score, dim_zero_cat(self.sentence_chrf)
+        return score

@@ -1,0 +1,31 @@
+"""MatchErrorRate module metric.
+
+Parity: reference ``torchmetrics/text/mer.py:24``.
+"""
+from typing import Any, List, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.mer import _mer_compute, _mer_update
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class MatchErrorRate(Metric):
+    is_differentiable = False
+    higher_is_better = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("errors", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, predictions: Union[str, List[str]], references: Union[str, List[str]]) -> None:
+        errors, total = _mer_update(predictions, references)
+        self.errors = self.errors + errors
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        return _mer_compute(self.errors, self.total)

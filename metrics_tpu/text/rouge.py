@@ -1,0 +1,75 @@
+"""ROUGEScore module metric.
+
+Parity: reference ``torchmetrics/text/rouge.py:29`` (the reference wraps
+nltk/rouge_score; this build computes ROUGE natively — see
+``functional/text/rouge.py``).
+"""
+from typing import Any, Dict, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.rouge import (
+    ALLOWED_ROUGE_KEYS,
+    _rouge_score_compute,
+    _rouge_score_update,
+)
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.data import dim_zero_cat
+from metrics_tpu.utils.imports import _NLTK_AVAILABLE
+
+Array = jax.Array
+
+
+class ROUGEScore(Metric):
+    is_differentiable = False
+    higher_is_better = True
+
+    def __init__(
+        self,
+        use_stemmer: bool = False,
+        accumulate: str = "best",
+        rouge_keys: Union[str, Tuple[str, ...]] = ("rouge1", "rouge2", "rougeL", "rougeLsum"),
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if use_stemmer and not _NLTK_AVAILABLE:
+            raise ModuleNotFoundError("Stemming requires that `nltk` is installed.")
+        self.stemmer = None
+        if use_stemmer:
+            import nltk
+
+            self.stemmer = nltk.stem.porter.PorterStemmer()
+
+        if isinstance(rouge_keys, str):
+            rouge_keys = (rouge_keys,)
+        for key in rouge_keys:
+            if key not in ALLOWED_ROUGE_KEYS:
+                raise ValueError(f"Got unknown rouge key {key}. Expected to be one of {ALLOWED_ROUGE_KEYS}")
+        self.rouge_keys = rouge_keys
+        self.rouge_keys_values = [
+            int(key[5:]) if key[5:].isdigit() else key[5:] for key in rouge_keys
+        ]
+        if accumulate not in ("best", "avg"):
+            raise ValueError(f"Got unknown accumulate method {accumulate}. Expected 'best' or 'avg'.")
+        self.accumulate = accumulate
+        for key in self.rouge_keys_values:
+            for score_type in ("fmeasure", "precision", "recall"):
+                self.add_state(f"rouge{key}_{score_type}", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Union[str, Sequence[str]], targets: Union[str, Sequence[str]]) -> None:
+        preds = [preds] if isinstance(preds, str) else list(preds)
+        targets = [targets] if isinstance(targets, str) else list(targets)
+        results = _rouge_score_update(preds, targets, self.rouge_keys_values, self.accumulate, self.stemmer)
+        for key, scores in results.items():
+            for score in scores:
+                for score_type, value in score.items():
+                    getattr(self, f"rouge{key}_{score_type}").append(jnp.reshape(value, (1,)))
+
+    def compute(self) -> Dict[str, Array]:
+        update_output = {}
+        for key in self.rouge_keys_values:
+            for score_type in ("fmeasure", "precision", "recall"):
+                vals = getattr(self, f"rouge{key}_{score_type}")
+                update_output[f"rouge{key}_{score_type}"] = [dim_zero_cat(vals)] if vals else []
+        return _rouge_score_compute(update_output)

@@ -1,0 +1,36 @@
+"""SacreBLEUScore module metric.
+
+Parity: reference ``torchmetrics/text/sacre_bleu.py:34``.
+"""
+from typing import Any, Sequence
+
+import jax
+
+from metrics_tpu.functional.text.bleu import _bleu_score_update
+from metrics_tpu.functional.text.sacre_bleu import _SacreBLEUTokenizer
+from metrics_tpu.text.bleu import BLEUScore
+
+Array = jax.Array
+
+
+class SacreBLEUScore(BLEUScore):
+    """BLEU with canonical sacrebleu tokenization."""
+
+    def __init__(
+        self,
+        n_gram: int = 4,
+        smooth: bool = False,
+        tokenize: str = "13a",
+        lowercase: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(n_gram=n_gram, smooth=smooth, **kwargs)
+        self.tokenizer = _SacreBLEUTokenizer(tokenize, lowercase)
+
+    def update(self, translate_corpus: Sequence[str], reference_corpus: Sequence[Sequence[str]]) -> None:
+        translate_corpus = [translate_corpus] if isinstance(translate_corpus, str) else translate_corpus
+        reference_corpus = [[ref] if isinstance(ref, str) else ref for ref in reference_corpus]
+        self.trans_len, self.ref_len, self.numerator, self.denominator = _bleu_score_update(
+            translate_corpus, reference_corpus, self.numerator, self.denominator,
+            self.trans_len, self.ref_len, self.n_gram, tokenizer=self.tokenizer,
+        )

@@ -1,0 +1,40 @@
+"""SQuAD module metric.
+
+Parity: reference ``torchmetrics/text/squad.py:29``.
+"""
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.squad import (
+    PREDS_TYPE,
+    TARGETS_TYPE,
+    _squad_compute,
+    _squad_input_check,
+    _squad_update,
+)
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class SQuAD(Metric):
+    is_differentiable = False
+    higher_is_better = True
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("f1_score", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("exact_match", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: PREDS_TYPE, targets: TARGETS_TYPE) -> None:
+        preds_dict, targets_list = _squad_input_check(preds, targets)
+        f1, exact_match, total = _squad_update(preds_dict, targets_list)
+        self.f1_score = self.f1_score + f1
+        self.exact_match = self.exact_match + exact_match
+        self.total = self.total + total
+
+    def compute(self) -> Dict[str, Array]:
+        return _squad_compute(self.f1_score, self.exact_match, self.total)

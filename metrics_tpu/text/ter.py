@@ -1,0 +1,59 @@
+"""TranslationEditRate module metric.
+
+Parity: reference ``torchmetrics/text/ter.py:24``.
+"""
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.ter import _ter_compute, _ter_update
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class TranslationEditRate(Metric):
+    is_differentiable = False
+    higher_is_better = False
+
+    def __init__(
+        self,
+        normalize: bool = False,
+        no_punctuation: bool = False,
+        lowercase: bool = True,
+        asian_support: bool = False,
+        return_sentence_level_score: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if asian_support:
+            raise ModuleNotFoundError("`asian_support` requires language segmenters not available in this build.")
+        self.normalize = normalize
+        self.no_punctuation = no_punctuation
+        self.lowercase = lowercase
+        self.return_sentence_level_score = return_sentence_level_score
+
+        self.add_state("total_num_edits", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total_ref_len", jnp.asarray(0.0), dist_reduce_fx="sum")
+        if return_sentence_level_score:
+            self.add_state("sentence_ter", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Sequence[str], targets: Sequence[Union[str, Sequence[str]]]) -> None:
+        preds = [preds] if isinstance(preds, str) else list(preds)
+        targets = [targets] if isinstance(targets, str) else list(targets)
+        targets = [[t] if isinstance(t, str) else list(t) for t in targets]
+        sentence_scores: Optional[List[Array]] = [] if self.return_sentence_level_score else None
+        self.total_num_edits, self.total_ref_len = _ter_update(
+            preds, targets, self.total_num_edits, self.total_ref_len,
+            self.lowercase, self.normalize, self.no_punctuation, sentence_scores,
+        )
+        if self.return_sentence_level_score and sentence_scores:
+            self.sentence_ter.append(jnp.stack(sentence_scores))
+
+    def compute(self) -> Union[Array, Tuple[Array, Array]]:
+        score = _ter_compute(self.total_num_edits, self.total_ref_len)
+        if self.return_sentence_level_score:
+            return score, dim_zero_cat(self.sentence_ter)
+        return score

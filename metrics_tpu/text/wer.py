@@ -1,0 +1,40 @@
+"""WordErrorRate module metric (+ deprecated WER alias).
+
+Parity: reference ``torchmetrics/text/wer.py:24,106`` (states :83-84).
+"""
+from typing import Any, List, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.wer import _wer_compute, _wer_update
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+class WordErrorRate(Metric):
+    is_differentiable = False
+    higher_is_better = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("errors", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, predictions: Union[str, List[str]], references: Union[str, List[str]]) -> None:
+        errors, total = _wer_update(predictions, references)
+        self.errors = self.errors + errors
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        return _wer_compute(self.errors, self.total)
+
+
+class WER(WordErrorRate):
+    """Deprecated alias. Parity: reference ``wer.py:106``."""
+
+    def __init__(self, *args: Any, **kwargs: Any) -> None:
+        rank_zero_warn("`WER` was renamed to `WordErrorRate` and it will be removed.", DeprecationWarning)
+        super().__init__(*args, **kwargs)

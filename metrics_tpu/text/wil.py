@@ -1,0 +1,33 @@
+"""WordInfoLost module metric.
+
+Parity: reference ``torchmetrics/text/wil.py:23``.
+"""
+from typing import Any, List, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.wil import _wil_compute, _wil_update
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class WordInfoLost(Metric):
+    is_differentiable = False
+    higher_is_better = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("errors", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("reference_total", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("prediction_total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, predictions: Union[str, List[str]], references: Union[str, List[str]]) -> None:
+        errors, reference_total, prediction_total = _wil_update(predictions, references)
+        self.errors = self.errors + errors
+        self.reference_total = self.reference_total + reference_total
+        self.prediction_total = self.prediction_total + prediction_total
+
+    def compute(self) -> Array:
+        return _wil_compute(self.errors, self.reference_total, self.prediction_total)

@@ -1,0 +1,33 @@
+"""WordInfoPreserved module metric.
+
+Parity: reference ``torchmetrics/text/wip.py:23``.
+"""
+from typing import Any, List, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.wip import _wip_compute, _wip_update
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class WordInfoPreserved(Metric):
+    is_differentiable = False
+    higher_is_better = True
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("errors", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("reference_total", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("prediction_total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, predictions: Union[str, List[str]], references: Union[str, List[str]]) -> None:
+        errors, reference_total, prediction_total = _wip_update(predictions, references)
+        self.errors = self.errors + errors
+        self.reference_total = self.reference_total + reference_total
+        self.prediction_total = self.prediction_total + prediction_total
+
+    def compute(self) -> Array:
+        return _wip_compute(self.errors, self.reference_total, self.prediction_total)

@@ -1,0 +1,267 @@
+"""Text metrics vs real oracles (sacrebleu, rouge_score) and hand values.
+
+Parity model: reference ``tests/text/*`` (oracles: sacrebleu, jiwer, rouge_score).
+jiwer is absent; WER-family uses hand-checked values + property tests.
+"""
+import numpy as np
+import pytest
+from sacrebleu.metrics import BLEU as SacreBLEUOracle, CHRF as ChrfOracle, TER as TerOracle
+
+from metrics_tpu import (
+    BERTScore,
+    BLEUScore,
+    CharErrorRate,
+    CHRFScore,
+    MatchErrorRate,
+    ROUGEScore,
+    SacreBLEUScore,
+    SQuAD,
+    TranslationEditRate,
+    WordErrorRate,
+    WordInfoLost,
+    WordInfoPreserved,
+)
+from metrics_tpu.functional import (
+    bleu_score,
+    char_error_rate,
+    chrf_score,
+    match_error_rate,
+    rouge_score,
+    sacre_bleu_score,
+    squad,
+    translation_edit_rate,
+    word_error_rate,
+    word_information_lost,
+    word_information_preserved,
+)
+
+PREDS = ["hello there general kenobi", "foo bar foobar"]
+TARGETS = [["hello there general kenobi", "hello there !"], ["foo bar foobar", "more bar foo"]]
+PREDS_SINGLE = ["the cat sat on the mat", "a quick brown fox"]
+REFS_SINGLE = ["the cat sat on a mat", "the quick brown fox jumps"]
+
+
+class TestWERFamily:
+    def test_wer_hand(self):
+        # "the cat sat on the mat" vs "the cat is on the mat": 1 sub / 6 words
+        assert float(word_error_rate("the cat sat on the mat", "the cat is on the mat")) == pytest.approx(1 / 6)
+
+    def test_wer_corpus(self):
+        preds = ["hello world", "foo bar baz"]
+        refs = ["hello beautiful world", "foo bar"]
+        # dist("hello world","hello beautiful world")=1; dist("foo bar baz","foo bar")=1
+        # total ref words = 3 + 2 = 5
+        assert float(word_error_rate(preds, refs)) == pytest.approx(2 / 5)
+
+    def test_cer_hand(self):
+        assert float(char_error_rate("abcd", "abcc")) == pytest.approx(1 / 4)
+
+    def test_mer_hand(self):
+        # errors=1, total=max(6,6)=6
+        assert float(match_error_rate("the cat sat on the mat", "the cat is on the mat")) == pytest.approx(1 / 6)
+
+    def test_wil_wip_complementary(self):
+        wil = float(word_information_lost(PREDS_SINGLE, REFS_SINGLE))
+        wip = float(word_information_preserved(PREDS_SINGLE, REFS_SINGLE))
+        np.testing.assert_allclose(wil, 1 - wip, atol=1e-6)
+
+    def test_perfect_prediction(self):
+        assert float(word_error_rate("same text", "same text")) == 0.0
+        assert float(char_error_rate("same", "same")) == 0.0
+
+    @pytest.mark.parametrize(
+        "metric_cls,fn",
+        [
+            (WordErrorRate, word_error_rate),
+            (CharErrorRate, char_error_rate),
+            (MatchErrorRate, match_error_rate),
+            (WordInfoLost, word_information_lost),
+            (WordInfoPreserved, word_information_preserved),
+        ],
+    )
+    def test_class_matches_functional(self, metric_cls, fn):
+        m = metric_cls()
+        m.update(PREDS_SINGLE[:1], REFS_SINGLE[:1])
+        m.update(PREDS_SINGLE[1:], REFS_SINGLE[1:])
+        expected = fn(PREDS_SINGLE, REFS_SINGLE)
+        np.testing.assert_allclose(float(m.compute()), float(expected), atol=1e-6)
+
+
+class TestBLEU:
+    def test_vs_sacrebleu_tokenized(self):
+        # with the 'none' tokenizer sacrebleu reduces to plain BLEU on split tokens
+        oracle = SacreBLEUOracle(tokenize="none", effective_order=False)
+        expected = oracle.corpus_score(PREDS, [[t[i] for t in TARGETS] for i in range(2)]).score / 100
+        res = float(bleu_score(PREDS, TARGETS))
+        np.testing.assert_allclose(res, expected, atol=1e-6)
+
+    def test_class_accumulation(self):
+        m = BLEUScore()
+        m.update(PREDS[:1], TARGETS[:1])
+        m.update(PREDS[1:], TARGETS[1:])
+        np.testing.assert_allclose(float(m.compute()), float(bleu_score(PREDS, TARGETS)), atol=1e-6)
+
+    def test_smooth(self):
+        pred, ref = ["the cat is on the mat"], [["the cat is on a mat"]]
+        plain = float(bleu_score(pred, ref))
+        smoothed = float(bleu_score(pred, ref, smooth=True))
+        assert 0 < plain < 1 and 0 < smoothed < 1
+        assert smoothed != plain
+
+
+class TestSacreBLEU:
+    @pytest.mark.parametrize("tokenize", ["13a", "char", "intl", "none"])
+    @pytest.mark.parametrize("lowercase", [False, True])
+    def test_vs_sacrebleu(self, tokenize, lowercase):
+        # sentences share 4-grams under every tokenizer, so no order has zero matches
+        # (the reference, like this build, applies no smoothing there while the
+        # sacrebleu oracle defaults to exp smoothing)
+        preds = ["The cat sat on the mat, today.", "A quick brown fox jumps over it."]
+        targets = [
+            ["The cat sat on the mat today.", "The cat was on the mat, today."],
+            ["A quick brown fox jumps over him.", "The quick brown fox jumps over it."],
+        ]
+        oracle = SacreBLEUOracle(tokenize=tokenize, lowercase=lowercase, effective_order=False)
+        expected = oracle.corpus_score(preds, [[t[i] for t in targets] for i in range(2)]).score / 100
+        res = float(sacre_bleu_score(preds, targets, tokenize=tokenize, lowercase=lowercase))
+        np.testing.assert_allclose(res, expected, atol=1e-6)
+
+    def test_class(self):
+        preds = ["Hello there, General Kenobi!"]
+        targets = [["Hello there General Kenobi!"]]
+        m = SacreBLEUScore()
+        m.update(preds, targets)
+        np.testing.assert_allclose(
+            float(m.compute()), float(sacre_bleu_score(preds, targets)), atol=1e-6
+        )
+
+
+class TestCHRF:
+    @pytest.mark.parametrize("word_order", [0, 2])
+    def test_vs_sacrebleu_chrf(self, word_order):
+        oracle = ChrfOracle(word_order=word_order)
+        preds = ["the cat sat on the mat", "a quick brown fox jumps"]
+        refs = ["the cat sat on a mat", "the quick brown fox jumps over"]
+        expected = oracle.corpus_score(preds, [refs]).score / 100
+        res = float(chrf_score(preds, refs, n_word_order=word_order))
+        np.testing.assert_allclose(res, expected, atol=1e-4)
+
+    def test_class_with_sentence_scores(self):
+        m = CHRFScore(return_sentence_level_score=True)
+        m.update(PREDS_SINGLE, REFS_SINGLE)
+        corpus, sentences = m.compute()
+        assert sentences.shape == (2,)
+        assert 0 <= float(corpus) <= 1
+
+
+class TestTER:
+    def test_vs_sacrebleu_ter(self):
+        oracle = TerOracle()
+        preds = ["the cat sat on the mat", "a fast brown fox jumps over"]
+        refs = ["the cat is on the mat", "the quick brown fox jumps"]
+        expected = oracle.corpus_score(preds, [refs]).score / 100
+        res = float(translation_edit_rate(preds, refs))
+        np.testing.assert_allclose(res, expected, atol=1e-4)
+
+    def test_shift_counted_once(self):
+        # "b c a" -> "a b c" is one shift for TER (score 1/3), not two edits
+        res = float(translation_edit_rate(["b c a"], ["a b c"]))
+        np.testing.assert_allclose(res, 1 / 3, atol=1e-6)
+
+    def test_class(self):
+        m = TranslationEditRate()
+        m.update(["the cat sat"], [["the cat is"]])
+        np.testing.assert_allclose(
+            float(m.compute()), float(translation_edit_rate(["the cat sat"], [["the cat is"]])), atol=1e-6
+        )
+
+
+class TestROUGE:
+    def test_vs_rouge_score_pkg(self):
+        from rouge_score.rouge_scorer import RougeScorer
+
+        scorer = RougeScorer(["rouge1", "rouge2", "rougeL"], use_stemmer=False)
+        pred = "the cat sat on the mat today"
+        ref = "the cat was sitting on the mat"
+        expected = scorer.score(ref, pred)
+        res = rouge_score(pred, ref, rouge_keys=("rouge1", "rouge2", "rougeL"))
+        for key in ("rouge1", "rouge2", "rougeL"):
+            np.testing.assert_allclose(
+                float(res[f"{key}_fmeasure"]), expected[key].fmeasure, atol=1e-5, err_msg=key
+            )
+            np.testing.assert_allclose(
+                float(res[f"{key}_precision"]), expected[key].precision, atol=1e-5, err_msg=key
+            )
+
+    def test_rouge_lsum(self):
+        from rouge_score.rouge_scorer import RougeScorer
+
+        scorer = RougeScorer(["rougeLsum"], use_stemmer=False)
+        pred = "the cat sat.\nit was happy."
+        ref = "the cat was sitting.\nit looked happy."
+        expected = scorer.score(ref, pred)["rougeLsum"]
+        res = rouge_score(pred, ref, rouge_keys=("rougeLsum",))
+        np.testing.assert_allclose(float(res["rougeLsum_fmeasure"]), expected.fmeasure, atol=1e-5)
+
+    def test_class(self):
+        m = ROUGEScore(rouge_keys=("rouge1",))
+        m.update("the cat sat", "the cat was sitting")
+        out = m.compute()
+        assert "rouge1_fmeasure" in out
+
+
+class TestSQuAD:
+    def test_exact_match(self):
+        preds = [{"prediction_text": "1976", "id": "56e10a3be3433e1400422b22"}]
+        target = [{"answers": {"text": ["1976"]}, "id": "56e10a3be3433e1400422b22"}]
+        out = squad(preds, target)
+        assert float(out["exact_match"]) == 100.0
+        assert float(out["f1"]) == 100.0
+
+    def test_partial_f1(self):
+        preds = [{"prediction_text": "big black cat", "id": "1"}]
+        target = [{"answers": {"text": ["big cat"]}, "id": "1"}]
+        out = squad(preds, target)
+        assert float(out["exact_match"]) == 0.0
+        # f1: common {big, cat}: p=2/3, r=1 -> f1=0.8
+        np.testing.assert_allclose(float(out["f1"]), 80.0, atol=1e-4)
+
+    def test_class(self):
+        m = SQuAD()
+        m.update(
+            [{"prediction_text": "1976", "id": "a"}],
+            [{"answers": {"text": ["1976"]}, "id": "a"}],
+        )
+        out = m.compute()
+        assert float(out["exact_match"]) == 100.0
+
+
+class TestBERTScore:
+    @staticmethod
+    def _dummy_forward(ids, mask):
+        import jax.numpy as jnp
+
+        # deterministic "embedding": token id -> 8-dim pseudo-random vector
+        d = 8
+        base = (ids[..., None] * jnp.arange(1, d + 1)) % 97
+        return jnp.sin(base.astype(jnp.float32))
+
+    def test_identical_sentences_score_one(self):
+        from metrics_tpu.functional import bert_score
+
+        out = bert_score(PREDS_SINGLE, PREDS_SINGLE, user_forward_fn=self._dummy_forward)
+        np.testing.assert_allclose(out["f1"], [1.0, 1.0], atol=1e-5)
+
+    def test_different_lower(self):
+        from metrics_tpu.functional import bert_score
+
+        same = bert_score(PREDS_SINGLE, PREDS_SINGLE, user_forward_fn=self._dummy_forward)
+        diff = bert_score(PREDS_SINGLE, REFS_SINGLE, user_forward_fn=self._dummy_forward)
+        assert np.mean(diff["f1"]) < np.mean(same["f1"])
+
+    def test_class_with_idf(self):
+        m = BERTScore(user_forward_fn=self._dummy_forward, idf=True)
+        m.update(PREDS_SINGLE, REFS_SINGLE)
+        out = m.compute()
+        assert len(out["f1"]) == 2
+        assert all(0 <= x <= 1 for x in out["f1"])
