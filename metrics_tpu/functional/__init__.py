@@ -53,6 +53,14 @@ from metrics_tpu.functional.regression.symmetric_mean_absolute_percentage_error 
     symmetric_mean_absolute_percentage_error,
 )
 from metrics_tpu.functional.regression.tweedie_deviance import tweedie_deviance_score
+from metrics_tpu.functional.retrieval.average_precision import retrieval_average_precision
+from metrics_tpu.functional.retrieval.fall_out import retrieval_fall_out
+from metrics_tpu.functional.retrieval.hit_rate import retrieval_hit_rate
+from metrics_tpu.functional.retrieval.ndcg import retrieval_normalized_dcg
+from metrics_tpu.functional.retrieval.precision import retrieval_precision
+from metrics_tpu.functional.retrieval.r_precision import retrieval_r_precision
+from metrics_tpu.functional.retrieval.recall import retrieval_recall
+from metrics_tpu.functional.retrieval.reciprocal_rank import retrieval_reciprocal_rank
 from metrics_tpu.functional.text.bert import bert_score
 from metrics_tpu.functional.text.bleu import bleu_score
 from metrics_tpu.functional.text.cer import char_error_rate
@@ -96,6 +104,14 @@ __all__ = [
     "pit",
     "pit_permutate",
     "r2_score",
+    "retrieval_average_precision",
+    "retrieval_fall_out",
+    "retrieval_hit_rate",
+    "retrieval_normalized_dcg",
+    "retrieval_precision",
+    "retrieval_r_precision",
+    "retrieval_recall",
+    "retrieval_reciprocal_rank",
     "scale_invariant_signal_distortion_ratio",
     "scale_invariant_signal_noise_ratio",
     "signal_distortion_ratio",

@@ -1,0 +1,24 @@
+"""Retrieval average precision.
+
+Parity: reference ``torchmetrics/functional/retrieval/average_precision.py``.
+"""
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _check_retrieval_functional_inputs
+
+Array = jax.Array
+
+
+def retrieval_average_precision(preds: Array, target: Array) -> Array:
+    """AP of a single query's predictions."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    if not int(jnp.sum(target)):
+        return jnp.asarray(0.0)
+    target = target[jnp.argsort(-preds, stable=True)]
+    # positions (1-based) of relevant docs; precision@pos averaged over relevant docs
+    positions = jnp.arange(1, target.shape[0] + 1, dtype=jnp.float32)
+    rel = target > 0
+    cum_rel = jnp.cumsum(rel.astype(jnp.float32))
+    prec_at_rel = jnp.where(rel, cum_rel / positions, 0.0)
+    return jnp.sum(prec_at_rel) / jnp.sum(rel)

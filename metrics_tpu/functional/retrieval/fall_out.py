@@ -1,0 +1,25 @@
+"""Retrieval fall-out@k.
+
+Parity: reference ``torchmetrics/functional/retrieval/fall_out.py``.
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _check_retrieval_functional_inputs
+
+Array = jax.Array
+
+
+def retrieval_fall_out(preds: Array, target: Array, k: Optional[int] = None) -> Array:
+    """Fraction of the non-relevant documents retrieved in the top k."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    k = preds.shape[-1] if k is None else k
+    if not (isinstance(k, int) and k > 0):
+        raise ValueError("`k` has to be a positive integer or None")
+    target = 1 - target
+    if not int(jnp.sum(target)):
+        return jnp.asarray(0.0)
+    relevant = jnp.sum(target[jnp.argsort(-preds, stable=True)][:k]).astype(jnp.float32)
+    return relevant / jnp.sum(target)

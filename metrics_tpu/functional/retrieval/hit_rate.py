@@ -1,0 +1,23 @@
+"""Retrieval hit rate@k.
+
+Parity: reference ``torchmetrics/functional/retrieval/hit_rate.py``.
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _check_retrieval_functional_inputs
+
+Array = jax.Array
+
+
+def retrieval_hit_rate(preds: Array, target: Array, k: Optional[int] = None) -> Array:
+    """1.0 if any of the top-k documents is relevant."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    if k is None:
+        k = preds.shape[-1]
+    if not (isinstance(k, int) and k > 0):
+        raise ValueError("`k` has to be a positive integer or None")
+    relevant = jnp.sum(target[jnp.argsort(-preds, stable=True)][:k])
+    return (relevant > 0).astype(jnp.float32)

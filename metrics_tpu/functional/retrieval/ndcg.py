@@ -1,0 +1,30 @@
+"""Retrieval normalized discounted cumulative gain.
+
+Parity: reference ``torchmetrics/functional/retrieval/ndcg.py``.
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _check_retrieval_functional_inputs
+
+Array = jax.Array
+
+
+def _dcg(target: Array) -> Array:
+    denom = jnp.log2(jnp.arange(target.shape[-1], dtype=jnp.float32) + 2.0)
+    return jnp.sum(target / denom, axis=-1)
+
+
+def retrieval_normalized_dcg(preds: Array, target: Array, k: Optional[int] = None) -> Array:
+    """nDCG@k with (possibly graded) relevance targets."""
+    preds, target = _check_retrieval_functional_inputs(preds, target, allow_non_binary_target=True)
+    k = preds.shape[-1] if k is None else k
+    if not (isinstance(k, int) and k > 0):
+        raise ValueError("`k` has to be a positive integer or None")
+    sorted_target = target[jnp.argsort(-preds, stable=True)][:k]
+    ideal_target = jnp.sort(target)[::-1][:k]
+    ideal_dcg = _dcg(ideal_target)
+    target_dcg = _dcg(sorted_target)
+    return jnp.where(ideal_dcg == 0, 0.0, target_dcg / jnp.where(ideal_dcg == 0, 1.0, ideal_dcg))

@@ -1,0 +1,20 @@
+"""Retrieval R-precision.
+
+Parity: reference ``torchmetrics/functional/retrieval/r_precision.py``.
+"""
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _check_retrieval_functional_inputs
+
+Array = jax.Array
+
+
+def retrieval_r_precision(preds: Array, target: Array) -> Array:
+    """Precision at R where R = number of relevant documents."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    relevant_number = int(jnp.sum(target))
+    if not relevant_number:
+        return jnp.asarray(0.0)
+    relevant = jnp.sum(target[jnp.argsort(-preds, stable=True)][:relevant_number]).astype(jnp.float32)
+    return relevant / relevant_number

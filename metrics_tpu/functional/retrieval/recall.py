@@ -1,0 +1,25 @@
+"""Retrieval recall@k.
+
+Parity: reference ``torchmetrics/functional/retrieval/recall.py``.
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _check_retrieval_functional_inputs
+
+Array = jax.Array
+
+
+def retrieval_recall(preds: Array, target: Array, k: Optional[int] = None) -> Array:
+    """Fraction of the relevant documents retrieved in the top k."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    if k is None:
+        k = preds.shape[-1]
+    if not (isinstance(k, int) and k > 0):
+        raise ValueError("`k` has to be a positive integer or None")
+    if not int(jnp.sum(target)):
+        return jnp.asarray(0.0)
+    relevant = jnp.sum(target[jnp.argsort(-preds, stable=True)][:k]).astype(jnp.float32)
+    return relevant / jnp.sum(target)

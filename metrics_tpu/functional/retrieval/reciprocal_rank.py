@@ -1,0 +1,20 @@
+"""Retrieval mean reciprocal rank.
+
+Parity: reference ``torchmetrics/functional/retrieval/reciprocal_rank.py``.
+"""
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _check_retrieval_functional_inputs
+
+Array = jax.Array
+
+
+def retrieval_reciprocal_rank(preds: Array, target: Array) -> Array:
+    """RR = 1 / rank of the first relevant document."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    if not int(jnp.sum(target)):
+        return jnp.asarray(0.0)
+    target = target[jnp.argsort(-preds, stable=True)]
+    first = jnp.argmax(target > 0)
+    return 1.0 / (first + 1.0)

@@ -1,0 +1,39 @@
+"""RetrievalFallOut.
+
+Parity: reference ``torchmetrics/retrieval/retrieval_fallout.py:24`` — lower is
+better, and "empty" means a query with no NEGATIVE targets (inverted default).
+"""
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.retrieval.fall_out import retrieval_fall_out
+from metrics_tpu.retrieval.retrieval_metric import RetrievalMetric
+
+Array = jax.Array
+
+
+class RetrievalFallOut(RetrievalMetric):
+    """Fall-out@k averaged over queries."""
+
+    higher_is_better = False
+
+    def __init__(
+        self,
+        empty_target_action: str = "pos",
+        ignore_index: Optional[int] = None,
+        k: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, **kwargs)
+        if (k is not None) and not (isinstance(k, int) and k > 0):
+            raise ValueError("`k` has to be a positive integer or None")
+        self.k = k
+
+    def _is_empty_query(self, mini_target: Array) -> bool:
+        # a query is degenerate when it has no negative targets
+        return not float(jnp.sum(1 - mini_target))
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        return retrieval_fall_out(preds, target, k=self.k)

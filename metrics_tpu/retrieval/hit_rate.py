@@ -1,0 +1,31 @@
+"""RetrievalHitRate.
+
+Parity: reference ``torchmetrics/retrieval/retrieval_hit_rate.py:22``.
+"""
+from typing import Any, Optional
+
+import jax
+
+from metrics_tpu.functional.retrieval.hit_rate import retrieval_hit_rate
+from metrics_tpu.retrieval.retrieval_metric import RetrievalMetric
+
+Array = jax.Array
+
+
+class RetrievalHitRate(RetrievalMetric):
+    """Hit rate@k averaged over queries."""
+
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        k: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, **kwargs)
+        if (k is not None) and not (isinstance(k, int) and k > 0):
+            raise ValueError("`k` has to be a positive integer or None")
+        self.k = k
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        return retrieval_hit_rate(preds, target, k=self.k)

@@ -1,0 +1,17 @@
+"""RetrievalMAP.
+
+Parity: reference ``torchmetrics/retrieval/mean_average_precision.py:20``.
+"""
+import jax
+
+from metrics_tpu.functional.retrieval.average_precision import retrieval_average_precision
+from metrics_tpu.retrieval.retrieval_metric import RetrievalMetric
+
+Array = jax.Array
+
+
+class RetrievalMAP(RetrievalMetric):
+    """Mean average precision over queries."""
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        return retrieval_average_precision(preds, target)

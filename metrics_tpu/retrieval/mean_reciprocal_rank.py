@@ -1,0 +1,17 @@
+"""RetrievalMRR.
+
+Parity: reference ``torchmetrics/retrieval/mean_reciprocal_rank.py:20``.
+"""
+import jax
+
+from metrics_tpu.functional.retrieval.reciprocal_rank import retrieval_reciprocal_rank
+from metrics_tpu.retrieval.retrieval_metric import RetrievalMetric
+
+Array = jax.Array
+
+
+class RetrievalMRR(RetrievalMetric):
+    """Mean reciprocal rank over queries."""
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        return retrieval_reciprocal_rank(preds, target)

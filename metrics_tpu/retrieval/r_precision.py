@@ -1,0 +1,17 @@
+"""RetrievalRPrecision.
+
+Parity: reference ``torchmetrics/retrieval/retrieval_r_precision.py:20``.
+"""
+import jax
+
+from metrics_tpu.functional.retrieval.r_precision import retrieval_r_precision
+from metrics_tpu.retrieval.retrieval_metric import RetrievalMetric
+
+Array = jax.Array
+
+
+class RetrievalRPrecision(RetrievalMetric):
+    """R-precision averaged over queries."""
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        return retrieval_r_precision(preds, target)

@@ -1,0 +1,87 @@
+"""RetrievalMetric template base.
+
+Parity: reference ``torchmetrics/retrieval/retrieval_metric.py:27`` (states :107-109,
+grouped compute :124-153, empty_target_action error/skip/pos/neg). Subclasses only
+override ``_metric``.
+
+TPU note: states are gathered cat-lists; per-query compute groups via a single sort
+of the query ids (``get_group_indexes``), each group's ``_metric`` is jnp on device.
+"""
+from abc import ABC, abstractmethod
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.checks import _check_retrieval_inputs
+from metrics_tpu.utils.data import dim_zero_cat, get_group_indexes
+
+Array = jax.Array
+
+
+class RetrievalMetric(Metric, ABC):
+    """Base for retrieval metrics: per-query ``_metric`` averaged over queries."""
+
+    indexes: List[Array]
+    preds: List[Array]
+    target: List[Array]
+    higher_is_better = True
+
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.allow_non_binary_target = False
+        empty_target_action_options = ("error", "skip", "neg", "pos")
+        if empty_target_action not in empty_target_action_options:
+            raise ValueError(f"Argument `empty_target_action` received a wrong value `{empty_target_action}`.")
+        self.empty_target_action = empty_target_action
+        if ignore_index is not None and not isinstance(ignore_index, int):
+            raise ValueError("Argument `ignore_index` must be an integer or None.")
+        self.ignore_index = ignore_index
+        self.add_state("indexes", default=[], dist_reduce_fx=None)
+        self.add_state("preds", default=[], dist_reduce_fx=None)
+        self.add_state("target", default=[], dist_reduce_fx=None)
+
+    def update(self, preds: Array, target: Array, indexes: Array) -> None:
+        if indexes is None:
+            raise ValueError("Argument `indexes` cannot be None")
+        indexes, preds, target = _check_retrieval_inputs(
+            indexes, preds, target,
+            allow_non_binary_target=self.allow_non_binary_target, ignore_index=self.ignore_index,
+        )
+        self.indexes.append(indexes)
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def _is_empty_query(self, mini_target: Array) -> bool:
+        return not float(jnp.sum(mini_target))
+
+    def compute(self) -> Array:
+        indexes = dim_zero_cat(self.indexes)
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+
+        res = []
+        groups = get_group_indexes(indexes)
+        for group in groups:
+            mini_preds = preds[group]
+            mini_target = target[group]
+            if self._is_empty_query(mini_target):
+                if self.empty_target_action == "error":
+                    raise ValueError("`compute` method was provided with a query with no positive target.")
+                if self.empty_target_action == "pos":
+                    res.append(jnp.asarray(1.0))
+                elif self.empty_target_action == "neg":
+                    res.append(jnp.asarray(0.0))
+            else:
+                res.append(self._metric(mini_preds, mini_target))
+        return jnp.mean(jnp.stack(res)) if res else jnp.asarray(0.0)
+
+    @abstractmethod
+    def _metric(self, preds: Array, target: Array) -> Array:
+        """Compute the metric for a single query's (preds, target)."""

@@ -1,0 +1,31 @@
+"""RetrievalPrecision.
+
+Parity: reference ``torchmetrics/retrieval/retrieval_precision.py:22``.
+"""
+from typing import Any, Optional
+
+import jax
+
+from metrics_tpu.functional.retrieval.precision import retrieval_precision
+from metrics_tpu.retrieval.retrieval_metric import RetrievalMetric
+
+Array = jax.Array
+
+
+class RetrievalPrecision(RetrievalMetric):
+    """Precision@k averaged over queries."""
+
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        k: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, **kwargs)
+        if (k is not None) and not (isinstance(k, int) and k > 0):
+            raise ValueError("`k` has to be a positive integer or None")
+        self.k = k
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        return retrieval_precision(preds, target, k=self.k)

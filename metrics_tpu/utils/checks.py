@@ -295,34 +295,51 @@ def _input_format_classification_one_hot(
     return preds, target
 
 
-def _check_retrieval_shape(preds, target) -> Tuple[jax.Array, jax.Array]:
+def _check_retrieval_functional_inputs(
+    preds, target, allow_non_binary_target: bool = False
+) -> Tuple[jax.Array, jax.Array]:
     """Flatten + validate retrieval (preds float, target bool/int) pairs.
 
-    Parity: reference ``checks.py:484-520`` (_check_retrieval_inputs).
+    Parity: reference ``checks.py:443-481`` (_check_retrieval_functional_inputs).
     """
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
     if jnp.shape(preds) != jnp.shape(target):
         raise ValueError("`preds` and `target` must be of the same shape")
-    if preds.ndim == 0:
+    if preds.ndim == 0 or preds.size == 0:
         raise ValueError("`preds` and `target` must be non-empty and non-scalar tensors")
-    preds = jnp.ravel(preds).astype(jnp.float32)
-    target = jnp.ravel(target)
-    if not (jnp.issubdtype(target.dtype, jnp.bool_) or jnp.issubdtype(target.dtype, jnp.integer)):
+    if not (jnp.issubdtype(target.dtype, jnp.bool_) or jnp.issubdtype(target.dtype, jnp.integer)
+            or (allow_non_binary_target and jnp.issubdtype(target.dtype, jnp.floating))):
         raise ValueError("`target` must be a tensor of booleans or integers")
     if not _is_floating(preds):
         raise ValueError("`preds` must be a tensor of floats")
-    if not _is_tracer(target) and target.size and int(jnp.max(target)) > 1:
+    if not allow_non_binary_target and not _is_tracer(target) and target.size and int(jnp.max(target)) > 1:
         raise ValueError("`target` must contain `binary` values")
-    return preds, target.astype(jnp.int32)
+    preds = jnp.ravel(preds).astype(jnp.float32)
+    target = jnp.ravel(target)
+    target = target.astype(jnp.float32) if allow_non_binary_target else target.astype(jnp.int32)
+    return preds, target
 
 
 def _check_retrieval_inputs(
-    indexes, preds, target, ignore_index: Optional[int] = None
+    indexes,
+    preds,
+    target,
+    allow_non_binary_target: bool = False,
+    ignore_index: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Validate (indexes, preds, target) triplets. Parity: ``checks.py:484-540``."""
-    if jnp.shape(indexes) != jnp.shape(preds) or jnp.shape(preds) != jnp.shape(target):
+    """Validate (indexes, preds, target) triplets. Parity: ``checks.py:484-540``.
+
+    ``ignore_index`` drops entries whose target equals it (eager boolean mask).
+    """
+    indexes = jnp.asarray(indexes)
+    if jnp.shape(indexes) != jnp.shape(preds) or jnp.shape(preds) != jnp.shape(jnp.asarray(target)):
         raise ValueError("`indexes`, `preds` and `target` must be of the same shape")
-    if not jnp.issubdtype(jnp.asarray(indexes).dtype, jnp.integer):
+    if not jnp.issubdtype(indexes.dtype, jnp.integer):
         raise ValueError("`indexes` must be a tensor of long integers")
-    preds, target = _check_retrieval_shape(preds, target)
+    preds, target = _check_retrieval_functional_inputs(preds, target, allow_non_binary_target)
     indexes = jnp.ravel(indexes).astype(jnp.int32)
+    if ignore_index is not None:
+        keep = target != ignore_index
+        indexes, preds, target = indexes[keep], preds[keep], target[keep]
     return indexes, preds, target

@@ -1,0 +1,162 @@
+"""Retrieval metrics vs sklearn oracles.
+
+Parity model: reference ``tests/retrieval/*`` (540-LoC helpers with sklearn-based
+oracles; condensed here).
+"""
+import numpy as np
+import pytest
+from sklearn.metrics import average_precision_score, ndcg_score
+
+from metrics_tpu import (
+    RetrievalFallOut,
+    RetrievalHitRate,
+    RetrievalMAP,
+    RetrievalMRR,
+    RetrievalNormalizedDCG,
+    RetrievalPrecision,
+    RetrievalRecall,
+    RetrievalRPrecision,
+)
+from metrics_tpu.functional import (
+    retrieval_average_precision,
+    retrieval_fall_out,
+    retrieval_hit_rate,
+    retrieval_normalized_dcg,
+    retrieval_precision,
+    retrieval_r_precision,
+    retrieval_recall,
+    retrieval_reciprocal_rank,
+)
+from tests.helpers import seed_all
+
+seed_all(42)
+
+N_QUERIES = 10
+DOCS_PER_QUERY = 20
+_indexes = np.repeat(np.arange(N_QUERIES), DOCS_PER_QUERY)
+_preds = np.random.rand(N_QUERIES * DOCS_PER_QUERY).astype(np.float32)
+_target = np.random.randint(0, 2, N_QUERIES * DOCS_PER_QUERY)
+# ensure every query has at least one positive and one negative
+for q in range(N_QUERIES):
+    _target[q * DOCS_PER_QUERY] = 1
+    _target[q * DOCS_PER_QUERY + 1] = 0
+
+
+def _group(q):
+    sl = slice(q * DOCS_PER_QUERY, (q + 1) * DOCS_PER_QUERY)
+    return _preds[sl], _target[sl]
+
+
+class TestFunctionalVsSklearn:
+    def test_average_precision(self):
+        for q in range(N_QUERIES):
+            p, t = _group(q)
+            res = float(retrieval_average_precision(p, t))
+            expected = average_precision_score(t, p)
+            np.testing.assert_allclose(res, expected, atol=1e-6)
+
+    def test_ndcg(self):
+        for q in range(N_QUERIES):
+            p, t = _group(q)
+            res = float(retrieval_normalized_dcg(p, t))
+            expected = ndcg_score(t[None], p[None])
+            np.testing.assert_allclose(res, expected, atol=1e-6)
+
+    def test_ndcg_at_k(self):
+        for q in range(N_QUERIES):
+            p, t = _group(q)
+            res = float(retrieval_normalized_dcg(p, t, k=5))
+            expected = ndcg_score(t[None], p[None], k=5)
+            np.testing.assert_allclose(res, expected, atol=1e-6)
+
+    def test_reciprocal_rank(self):
+        for q in range(N_QUERIES):
+            p, t = _group(q)
+            order = np.argsort(-p, kind="stable")
+            expected = 1.0 / (np.nonzero(t[order])[0][0] + 1)
+            np.testing.assert_allclose(float(retrieval_reciprocal_rank(p, t)), expected, atol=1e-6)
+
+    @pytest.mark.parametrize("k", [1, 3, None])
+    def test_precision_recall_hit_fallout(self, k):
+        for q in range(N_QUERIES):
+            p, t = _group(q)
+            order = np.argsort(-p, kind="stable")
+            kk = k or len(p)
+            topk = t[order][:kk]
+            np.testing.assert_allclose(float(retrieval_precision(p, t, k=k)), topk.sum() / kk, atol=1e-6)
+            np.testing.assert_allclose(float(retrieval_recall(p, t, k=k)), topk.sum() / t.sum(), atol=1e-6)
+            np.testing.assert_allclose(float(retrieval_hit_rate(p, t, k=k)), float(topk.sum() > 0), atol=1e-6)
+            neg_topk = (1 - t)[order][:kk]
+            np.testing.assert_allclose(
+                float(retrieval_fall_out(p, t, k=k)), neg_topk.sum() / (1 - t).sum(), atol=1e-6
+            )
+
+    def test_r_precision(self):
+        for q in range(N_QUERIES):
+            p, t = _group(q)
+            r = t.sum()
+            order = np.argsort(-p, kind="stable")
+            expected = t[order][:r].sum() / r
+            np.testing.assert_allclose(float(retrieval_r_precision(p, t)), expected, atol=1e-6)
+
+
+class TestClassInterface:
+    @pytest.mark.parametrize(
+        "metric_cls,oracle_fn",
+        [
+            (RetrievalMAP, lambda p, t: average_precision_score(t, p)),
+            (RetrievalNormalizedDCG, lambda p, t: ndcg_score(t[None], p[None])),
+        ],
+    )
+    def test_mean_over_queries(self, metric_cls, oracle_fn):
+        m = metric_cls()
+        # feed in two batches split across the middle
+        half = N_QUERIES * DOCS_PER_QUERY // 2
+        m.update(_preds[:half], _target[:half], indexes=_indexes[:half])
+        m.update(_preds[half:], _target[half:], indexes=_indexes[half:])
+        res = float(m.compute())
+        expected = np.mean([oracle_fn(*_group(q)) for q in range(N_QUERIES)])
+        np.testing.assert_allclose(res, expected, atol=1e-5)
+
+    def test_empty_target_actions(self):
+        preds = np.asarray([0.5, 0.3, 0.9, 0.2], dtype=np.float32)
+        target = np.asarray([0, 0, 1, 1])
+        indexes = np.asarray([0, 0, 1, 1])
+        # query 0 has no positives
+        m_neg = RetrievalMAP(empty_target_action="neg")
+        m_neg.update(preds, target, indexes=indexes)
+        np.testing.assert_allclose(float(m_neg.compute()), (0.0 + 1.0) / 2)
+        m_pos = RetrievalMAP(empty_target_action="pos")
+        m_pos.update(preds, target, indexes=indexes)
+        np.testing.assert_allclose(float(m_pos.compute()), (1.0 + 1.0) / 2)
+        m_skip = RetrievalMAP(empty_target_action="skip")
+        m_skip.update(preds, target, indexes=indexes)
+        np.testing.assert_allclose(float(m_skip.compute()), 1.0)
+        m_err = RetrievalMAP(empty_target_action="error")
+        m_err.update(preds, target, indexes=indexes)
+        with pytest.raises(ValueError, match="no positive target"):
+            m_err.compute()
+
+    def test_ignore_index(self):
+        preds = np.asarray([0.5, 0.3, 0.9, 0.2], dtype=np.float32)
+        target = np.asarray([1, -1, 1, 0])
+        indexes = np.asarray([0, 0, 1, 1])
+        m = RetrievalMAP(ignore_index=-1)
+        m.update(preds, target, indexes=indexes)
+        assert np.isfinite(float(m.compute()))
+
+    @pytest.mark.parametrize(
+        "metric_cls", [RetrievalPrecision, RetrievalRecall, RetrievalHitRate, RetrievalRPrecision, RetrievalMRR]
+    )
+    def test_runs(self, metric_cls):
+        m = metric_cls()
+        m.update(_preds, _target, indexes=_indexes)
+        assert 0 <= float(m.compute()) <= 1
+
+    def test_fallout_empty_means_no_negatives(self):
+        preds = np.asarray([0.5, 0.3], dtype=np.float32)
+        target = np.asarray([1, 1])  # no negatives -> degenerate for fallout
+        indexes = np.asarray([0, 0])
+        m = RetrievalFallOut(empty_target_action="pos")
+        m.update(preds, target, indexes=indexes)
+        np.testing.assert_allclose(float(m.compute()), 1.0)
