@@ -1,0 +1,1 @@
+from metrics_tpu.detection.map import MAP, MeanAveragePrecision

@@ -1,0 +1,402 @@
+"""Mean Average Precision / Recall for object detection (COCO semantics).
+
+Parity: reference ``torchmetrics/detection/map.py:132`` — same contract end to end:
+dict-of-tensors input validation (:82), 5 gather-list states (:269-273), per-image
+per-class IoU matrices (:343), greedy IoU-threshold matching with crowd/area-ignore
+handling (:378-491), 101-point interpolated precision (:616), ``_summarize`` (:493)
+and a ``COCOMetricResults`` dict of 12+ entries with per-class options (:683).
+
+TPU split: IoU matrices are one jnp broadcast kernel per image/class (device); the
+greedy per-detection matching and accumulation run host-side in numpy — group sizes
+are tiny and data-dependent (SURVEY.md §7.3 hard part 3). A masked
+``lax.while_loop``/Pallas matching path is the planned perf upgrade once parity is
+locked.
+"""
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class BaseMetricResults(dict):
+    """Dict with attribute access. Parity: reference ``map.py:31-46``."""
+
+    def __getattr__(self, key: str):
+        if key in self:
+            return self[key]
+        raise AttributeError(f"No such attribute: {key}")
+
+    def __setattr__(self, key: str, value) -> None:
+        self[key] = value
+
+    def __delattr__(self, key: str) -> None:
+        if key in self:
+            del self[key]
+
+
+class MAPMetricResults(BaseMetricResults):
+    __slots__ = ("map", "map_50", "map_75", "map_small", "map_medium", "map_large")
+
+
+class MARMetricResults(BaseMetricResults):
+    __slots__ = ("mar_1", "mar_10", "mar_100", "mar_small", "mar_medium", "mar_large")
+
+
+class COCOMetricResults(BaseMetricResults):
+    __slots__ = (
+        "map", "map_50", "map_75", "map_small", "map_medium", "map_large",
+        "mar_1", "mar_10", "mar_100", "mar_small", "mar_medium", "mar_large",
+        "map_per_class", "mar_100_per_class",
+    )
+
+
+def box_convert(boxes: Array, in_fmt: str, out_fmt: str = "xyxy") -> Array:
+    """Convert between xyxy / xywh / cxcywh box formats."""
+    boxes = jnp.asarray(boxes, dtype=jnp.float32).reshape(-1, 4)
+    if in_fmt == out_fmt:
+        return boxes
+    if in_fmt == "xywh":
+        x, y, w, h = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+        return jnp.stack([x, y, x + w, y + h], axis=1)
+    if in_fmt == "cxcywh":
+        cx, cy, w, h = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+        return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=1)
+    if in_fmt == "xyxy":
+        return boxes
+    raise ValueError(f"Unsupported box format {in_fmt}")
+
+
+def box_area(boxes: Array) -> Array:
+    return (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+
+
+def box_iou(boxes1: Array, boxes2: Array) -> Array:
+    """Pairwise IoU of two (N,4)/(M,4) xyxy box sets — one broadcast kernel."""
+    area1 = box_area(boxes1)
+    area2 = box_area(boxes2)
+    lt = jnp.maximum(boxes1[:, None, :2], boxes2[None, :, :2])
+    rb = jnp.minimum(boxes1[:, None, 2:], boxes2[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area1[:, None] + area2[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def _input_validator(preds: Sequence[Dict[str, Any]], targets: Sequence[Dict[str, Any]]) -> None:
+    """Parity: reference ``map.py:82-122``."""
+    if not isinstance(preds, Sequence):
+        raise ValueError("Expected argument `preds` to be of type List")
+    if not isinstance(targets, Sequence):
+        raise ValueError("Expected argument `target` to be of type List")
+    if len(preds) != len(targets):
+        raise ValueError("Expected argument `preds` and `target` to have the same length")
+
+    for k in ("boxes", "scores", "labels"):
+        if any(k not in p for p in preds):
+            raise ValueError(f"Expected all dicts in `preds` to contain the `{k}` key")
+    for k in ("boxes", "labels"):
+        if any(k not in p for p in targets):
+            raise ValueError(f"Expected all dicts in `target` to contain the `{k}` key")
+    for item in targets:
+        if np.shape(item["boxes"])[0] != np.shape(item["labels"])[0]:
+            raise ValueError("Input boxes and labels of sample in targets have a different length")
+    for item in preds:
+        if not (np.shape(item["boxes"])[0] == np.shape(item["scores"])[0] == np.shape(item["labels"])[0]):
+            raise ValueError("Input boxes, scores and labels of sample in predictions have a different length")
+
+
+def _fix_empty_tensors(boxes: Array) -> Array:
+    if boxes.size == 0 and boxes.ndim == 1:
+        return boxes.reshape(-1, 4)
+    return boxes
+
+
+class MAP(Metric):
+    """COCO mean average precision/recall for object detection."""
+
+    is_differentiable = False
+    higher_is_better = True
+
+    def __init__(
+        self,
+        box_format: str = "xyxy",
+        iou_thresholds: Optional[List[float]] = None,
+        rec_thresholds: Optional[List[float]] = None,
+        max_detection_thresholds: Optional[List[int]] = None,
+        class_metrics: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        allowed_box_formats = ("xyxy", "xywh", "cxcywh")
+        if box_format not in allowed_box_formats:
+            raise ValueError(f"Expected argument `box_format` to be one of {allowed_box_formats} but got {box_format}")
+        self.box_format = box_format
+        self.iou_thresholds = list(iou_thresholds) if iou_thresholds is not None else list(
+            np.round(np.arange(0.5, 1.0, 0.05), 2)
+        )
+        self.rec_thresholds = list(rec_thresholds) if rec_thresholds is not None else list(
+            np.round(np.linspace(0.0, 1.00, int(np.round((1.00 - 0.0) / 0.01)) + 1), 2)
+        )
+        self.max_detection_thresholds = sorted(max_detection_thresholds or [1, 10, 100])
+        self.bbox_area_ranges = OrderedDict(
+            all=(0.0, 1e10),
+            small=(0.0, 32.0 ** 2),
+            medium=(32.0 ** 2, 96.0 ** 2),
+            large=(96.0 ** 2, 1e10),
+        )
+        if not isinstance(class_metrics, bool):
+            raise ValueError("Expected argument `class_metrics` to be a boolean")
+        self.class_metrics = class_metrics
+
+        self.add_state("detection_boxes", default=[], dist_reduce_fx=None)
+        self.add_state("detection_scores", default=[], dist_reduce_fx=None)
+        self.add_state("detection_labels", default=[], dist_reduce_fx=None)
+        self.add_state("groundtruth_boxes", default=[], dist_reduce_fx=None)
+        self.add_state("groundtruth_labels", default=[], dist_reduce_fx=None)
+
+    def update(self, preds: List[Dict[str, Array]], target: List[Dict[str, Array]]) -> None:
+        """Add one batch of per-image detection/groundtruth dicts."""
+        _input_validator(preds, target)
+        for item in preds:
+            self.detection_boxes.append(
+                _fix_empty_tensors(box_convert(jnp.asarray(item["boxes"]), in_fmt=self.box_format))
+            )
+            self.detection_labels.append(jnp.ravel(jnp.asarray(item["labels"])))
+            self.detection_scores.append(jnp.ravel(jnp.asarray(item["scores"])))
+        for item in target:
+            self.groundtruth_boxes.append(
+                _fix_empty_tensors(box_convert(jnp.asarray(item["boxes"]), in_fmt=self.box_format))
+            )
+            self.groundtruth_labels.append(jnp.ravel(jnp.asarray(item["labels"])))
+
+    # ------------------------------------------------------------------ internals
+
+    def _get_classes(self) -> List[int]:
+        if len(self.detection_labels) > 0 or len(self.groundtruth_labels) > 0:
+            all_labels = np.concatenate(
+                [np.asarray(x) for x in (self.detection_labels + self.groundtruth_labels)]
+            )
+            return sorted(set(int(x) for x in all_labels))
+        return []
+
+    def _img_class_arrays(self, idx: int, class_id: int, max_det: int):
+        """Per-image per-class (gt, det, scores) sorted the COCO way (numpy)."""
+        gt = np.asarray(self.groundtruth_boxes[idx])
+        det = np.asarray(self.detection_boxes[idx])
+        gt_mask = np.asarray(self.groundtruth_labels[idx]) == class_id
+        det_mask = np.asarray(self.detection_labels[idx]) == class_id
+        gt = gt[gt_mask]
+        det = det[det_mask]
+        scores = np.asarray(self.detection_scores[idx])[det_mask]
+        dtind = np.argsort(-scores, kind="stable")[:max_det]
+        return gt, det[dtind], scores[dtind]
+
+    def _evaluate_image(
+        self, idx: int, class_id: int, area_range: Tuple[float, float], max_det: int, ious: Dict
+    ) -> Optional[Dict]:
+        """Greedy matching for one (image, class). Parity: reference ``:378-451``."""
+        gt, det, scores_sorted = self._img_class_arrays(idx, class_id, max_det)
+        if len(gt) == 0 and len(det) == 0:
+            return None
+
+        areas = np.asarray(box_area(jnp.asarray(gt.reshape(-1, 4)))) if len(gt) else np.zeros(0)
+        ignore_area = (areas < area_range[0]) | (areas > area_range[1])
+        gtind = np.argsort(ignore_area.astype(np.uint8), kind="stable")  # ignored gts last
+        gt = gt[gtind]
+        gt_ignore = ignore_area[gtind]
+
+        iou_mat = ious[(idx, class_id)]
+        iou_mat = iou_mat[:, gtind] if iou_mat.size else iou_mat
+
+        nb_iou_thrs = len(self.iou_thresholds)
+        nb_gt, nb_det = len(gt), len(det)
+        gt_matches = np.zeros((nb_iou_thrs, nb_gt), dtype=bool)
+        det_matches = np.zeros((nb_iou_thrs, nb_det), dtype=bool)
+        det_ignore = np.zeros((nb_iou_thrs, nb_det), dtype=bool)
+
+        if iou_mat.size > 0:
+            for idx_iou, thr in enumerate(self.iou_thresholds):
+                for idx_det in range(nb_det):
+                    best_iou = min(thr, 1 - 1e-10)
+                    match_id = -1
+                    for idx_gt in range(nb_gt):
+                        if gt_matches[idx_iou, idx_gt]:
+                            continue
+                        # once matched to a regular gt, never trade down to an ignored one
+                        if match_id > -1 and not gt_ignore[match_id] and gt_ignore[idx_gt]:
+                            break
+                        if iou_mat[idx_det, idx_gt] < best_iou:
+                            continue
+                        best_iou = iou_mat[idx_det, idx_gt]
+                        match_id = idx_gt
+                    if match_id != -1:
+                        det_ignore[idx_iou, idx_det] = gt_ignore[match_id]
+                        det_matches[idx_iou, idx_det] = True
+                        gt_matches[idx_iou, match_id] = True
+
+        # unmatched detections outside the area range are ignored
+        det_areas = np.asarray(box_area(jnp.asarray(det.reshape(-1, 4)))) if nb_det else np.zeros(0)
+        det_ignore_area = (det_areas < area_range[0]) | (det_areas > area_range[1])
+        det_ignore = det_ignore | (~det_matches & det_ignore_area[None, :])
+
+        return {
+            "dtMatches": det_matches,
+            "gtMatches": gt_matches,
+            "dtScores": scores_sorted,
+            "gtIgnore": gt_ignore,
+            "dtIgnore": det_ignore,
+        }
+
+    def _summarize(
+        self,
+        results: Dict,
+        avg_prec: bool = True,
+        iou_threshold: Optional[float] = None,
+        area_range: str = "all",
+        max_dets: int = 100,
+    ) -> Array:
+        area_idx = list(self.bbox_area_ranges.keys()).index(area_range)
+        mdet_idx = self.max_detection_thresholds.index(max_dets)
+        if avg_prec:
+            prec = results["precision"]
+            if iou_threshold is not None:
+                thr = self.iou_thresholds.index(iou_threshold)
+                prec = prec[thr:thr + 1]
+            prec = prec[:, :, :, area_idx, mdet_idx]
+        else:
+            prec = results["recall"]
+            if iou_threshold is not None:
+                thr = self.iou_thresholds.index(iou_threshold)
+                prec = prec[thr:thr + 1]
+            prec = prec[:, :, area_idx, mdet_idx]
+        valid = prec[prec > -1]
+        return jnp.asarray(-1.0) if valid.size == 0 else jnp.asarray(float(np.mean(valid)))
+
+    def _calculate(self, class_ids: List[int]) -> Tuple[Dict, MAPMetricResults, MARMetricResults]:
+        img_ids = list(range(len(self.groundtruth_boxes)))
+        max_detections = self.max_detection_thresholds[-1]
+        area_ranges = list(self.bbox_area_ranges.values())
+
+        # IoU matrices on device, gathered to host once
+        ious = {}
+        for idx in img_ids:
+            for class_id in class_ids:
+                gt, det, _ = self._img_class_arrays(idx, class_id, max_detections)
+                if len(gt) and len(det):
+                    ious[(idx, class_id)] = np.asarray(
+                        box_iou(jnp.asarray(det.reshape(-1, 4)), jnp.asarray(gt.reshape(-1, 4)))
+                    )
+                else:
+                    ious[(idx, class_id)] = np.zeros((len(det), len(gt)))
+
+        eval_imgs = [
+            self._evaluate_image(img_id, class_id, area, max_detections, ious)
+            for class_id in class_ids
+            for area in area_ranges
+            for img_id in img_ids
+        ]
+
+        nb_iou_thrs = len(self.iou_thresholds)
+        nb_rec_thrs = len(self.rec_thresholds)
+        nb_classes = len(class_ids)
+        nb_bbox_areas = len(self.bbox_area_ranges)
+        nb_max_det_thrs = len(self.max_detection_thresholds)
+        nb_imgs = len(img_ids)
+        precision = -np.ones((nb_iou_thrs, nb_rec_thrs, nb_classes, nb_bbox_areas, nb_max_det_thrs))
+        recall = -np.ones((nb_iou_thrs, nb_classes, nb_bbox_areas, nb_max_det_thrs))
+        scores = -np.ones((nb_iou_thrs, nb_rec_thrs, nb_classes, nb_bbox_areas, nb_max_det_thrs))
+        rec_thresholds = np.asarray(self.rec_thresholds)
+
+        for idx_cls in range(nb_classes):
+            for idx_area in range(nb_bbox_areas):
+                for idx_mdet, max_det in enumerate(self.max_detection_thresholds):
+                    base = idx_cls * nb_bbox_areas * nb_imgs + idx_area * nb_imgs
+                    evals = [eval_imgs[base + i] for i in range(nb_imgs)]
+                    evals = [e for e in evals if e is not None]
+                    if not evals:
+                        continue
+                    det_scores = np.concatenate([e["dtScores"][:max_det] for e in evals])
+                    inds = np.argsort(-det_scores, kind="mergesort")
+                    det_scores_sorted = det_scores[inds]
+                    det_matches = np.concatenate([e["dtMatches"][:, :max_det] for e in evals], axis=1)[:, inds]
+                    det_ignore = np.concatenate([e["dtIgnore"][:, :max_det] for e in evals], axis=1)[:, inds]
+                    gt_ignore = np.concatenate([e["gtIgnore"] for e in evals])
+                    npig = int(np.count_nonzero(~gt_ignore))
+                    if npig == 0:
+                        continue
+                    tps = det_matches & ~det_ignore
+                    fps = ~det_matches & ~det_ignore
+                    tp_sum = np.cumsum(tps, axis=1, dtype=np.float64)
+                    fp_sum = np.cumsum(fps, axis=1, dtype=np.float64)
+                    for idx_thr, (tp, fp) in enumerate(zip(tp_sum, fp_sum)):
+                        nd = len(tp)
+                        rc = tp / npig
+                        pr = tp / (fp + tp + np.finfo(np.float64).eps)
+                        recall[idx_thr, idx_cls, idx_area, idx_mdet] = rc[-1] if nd else 0
+                        # remove zigzags (right-to-left running max) for AUC
+                        pr = np.maximum.accumulate(pr[::-1])[::-1]
+                        inds_rc = np.searchsorted(rc, rec_thresholds, side="left")
+                        prec_at = np.zeros(nb_rec_thrs)
+                        score_at = np.zeros(nb_rec_thrs)
+                        valid = inds_rc < nd
+                        prec_at[valid] = pr[inds_rc[valid]]
+                        score_at[valid] = det_scores_sorted[inds_rc[valid]]
+                        precision[idx_thr, :, idx_cls, idx_area, idx_mdet] = prec_at
+                        scores[idx_thr, :, idx_cls, idx_area, idx_mdet] = score_at
+
+        results = {
+            "dimensions": [nb_iou_thrs, nb_rec_thrs, nb_classes, nb_bbox_areas, nb_max_det_thrs],
+            "precision": precision,
+            "recall": recall,
+            "scores": scores,
+        }
+
+        map_metrics = MAPMetricResults()
+        map_metrics.map = self._summarize(results, True)
+        last_max_det = self.max_detection_thresholds[-1]
+        map_metrics.map_50 = self._summarize(results, True, iou_threshold=0.5, max_dets=last_max_det)
+        map_metrics.map_75 = self._summarize(results, True, iou_threshold=0.75, max_dets=last_max_det)
+        map_metrics.map_small = self._summarize(results, True, area_range="small", max_dets=last_max_det)
+        map_metrics.map_medium = self._summarize(results, True, area_range="medium", max_dets=last_max_det)
+        map_metrics.map_large = self._summarize(results, True, area_range="large", max_dets=last_max_det)
+
+        mar_metrics = MARMetricResults()
+        for max_det in self.max_detection_thresholds:
+            mar_metrics[f"mar_{max_det}"] = self._summarize(results, False, max_dets=max_det)
+        mar_metrics.mar_small = self._summarize(results, False, area_range="small", max_dets=last_max_det)
+        mar_metrics.mar_medium = self._summarize(results, False, area_range="medium", max_dets=last_max_det)
+        mar_metrics.mar_large = self._summarize(results, False, area_range="large", max_dets=last_max_det)
+
+        return results, map_metrics, mar_metrics
+
+    def compute(self) -> dict:
+        """Compute the COCO metric dict (map, map_50, ..., per-class options)."""
+        overall, map_metrics, mar_metrics = self._calculate(self._get_classes())
+
+        map_per_class_values = jnp.asarray([-1.0])
+        mar_max_dets_per_class_values = jnp.asarray([-1.0])
+        if self.class_metrics:
+            map_per_class_list = []
+            mar_per_class_list = []
+            for class_id in self._get_classes():
+                _, cls_map, cls_mar = self._calculate([class_id])
+                map_per_class_list.append(cls_map.map)
+                mar_per_class_list.append(cls_mar[f"mar_{self.max_detection_thresholds[-1]}"])
+            map_per_class_values = jnp.stack(map_per_class_list)
+            mar_max_dets_per_class_values = jnp.stack(mar_per_class_list)
+
+        metrics = COCOMetricResults()
+        metrics.update(map_metrics)
+        metrics.update(mar_metrics)
+        metrics.map_per_class = map_per_class_values
+        metrics[f"mar_{self.max_detection_thresholds[-1]}_per_class"] = mar_max_dets_per_class_values
+        return metrics
+
+
+MeanAveragePrecision = MAP

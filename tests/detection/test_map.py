@@ -1,0 +1,163 @@
+"""Detection mAP tests: hand-verified COCO cases.
+
+Parity model: reference ``tests/detection/test_map.py`` (pycocotools oracle —
+unavailable here; cases below have analytically known values).
+"""
+import numpy as np
+import pytest
+
+from metrics_tpu import MAP
+from metrics_tpu.detection.map import box_convert, box_iou
+
+
+def test_box_iou():
+    b1 = np.asarray([[0, 0, 10, 10]], dtype=np.float32)
+    b2 = np.asarray([[0, 0, 10, 10], [5, 5, 15, 15], [20, 20, 30, 30]], dtype=np.float32)
+    iou = np.asarray(box_iou(b1, b2))
+    np.testing.assert_allclose(iou[0], [1.0, 25 / 175, 0.0], atol=1e-6)
+
+
+def test_box_convert():
+    xywh = np.asarray([[10.0, 20.0, 5.0, 6.0]])
+    out = np.asarray(box_convert(xywh, "xywh"))
+    np.testing.assert_allclose(out, [[10, 20, 15, 26]])
+    cxcywh = np.asarray([[10.0, 20.0, 4.0, 6.0]])
+    out = np.asarray(box_convert(cxcywh, "cxcywh"))
+    np.testing.assert_allclose(out, [[8, 17, 12, 23]])
+
+
+def _perfect_case():
+    preds = [
+        dict(
+            boxes=np.asarray([[10, 10, 50, 50], [60, 60, 100, 100]], dtype=np.float32),
+            scores=np.asarray([0.9, 0.8], dtype=np.float32),
+            labels=np.asarray([0, 1]),
+        )
+    ]
+    target = [
+        dict(
+            boxes=np.asarray([[10, 10, 50, 50], [60, 60, 100, 100]], dtype=np.float32),
+            labels=np.asarray([0, 1]),
+        )
+    ]
+    return preds, target
+
+
+def test_perfect_predictions_map_one():
+    m = MAP()
+    preds, target = _perfect_case()
+    m.update(preds, target)
+    res = m.compute()
+    assert float(res["map"]) == pytest.approx(1.0)
+    assert float(res["map_50"]) == pytest.approx(1.0)
+    assert float(res["map_75"]) == pytest.approx(1.0)
+    assert float(res["mar_100"]) == pytest.approx(1.0)
+
+
+def test_completely_wrong_predictions():
+    preds = [
+        dict(
+            boxes=np.asarray([[200, 200, 210, 210]], dtype=np.float32),
+            scores=np.asarray([0.9], dtype=np.float32),
+            labels=np.asarray([0]),
+        )
+    ]
+    target = [
+        dict(boxes=np.asarray([[10, 10, 50, 50]], dtype=np.float32), labels=np.asarray([0])),
+    ]
+    m = MAP()
+    m.update(preds, target)
+    res = m.compute()
+    assert float(res["map"]) == pytest.approx(0.0)
+
+
+def test_half_right_known_value():
+    """One TP at IoU 1.0 and one FP, single gt: AP = 1.0 at all IoU thresholds when
+    the TP ranks first (precision 1 at recall 1)."""
+    preds = [
+        dict(
+            boxes=np.asarray([[10, 10, 50, 50], [200, 200, 210, 210]], dtype=np.float32),
+            scores=np.asarray([0.9, 0.5], dtype=np.float32),
+            labels=np.asarray([0, 0]),
+        )
+    ]
+    target = [dict(boxes=np.asarray([[10, 10, 50, 50]], dtype=np.float32), labels=np.asarray([0]))]
+    m = MAP()
+    m.update(preds, target)
+    res = m.compute()
+    assert float(res["map"]) == pytest.approx(1.0)
+    # FP ranked above the TP drops interpolated precision to 1/2 at every recall point
+    preds[0]["scores"] = np.asarray([0.5, 0.9], dtype=np.float32)
+    m2 = MAP()
+    m2.update(preds, target)
+    res2 = m2.compute()
+    assert float(res2["map"]) == pytest.approx(0.5)
+
+
+def test_iou_threshold_sensitivity():
+    """A detection with IoU ~0.58 counts only for thresholds <= 0.55."""
+    preds = [
+        dict(
+            boxes=np.asarray([[0, 0, 100, 110]], dtype=np.float32),
+            scores=np.asarray([0.9], dtype=np.float32),
+            labels=np.asarray([0]),
+        )
+    ]
+    target = [dict(boxes=np.asarray([[0, 10, 100, 100]], dtype=np.float32), labels=np.asarray([0]))]
+    m = MAP()
+    m.update(preds, target)
+    res = m.compute()
+    # IoU = (100*90)/(100*110 + 100*90 - 100*90) = 9000/11000 = 0.818
+    assert float(res["map_50"]) == pytest.approx(1.0)
+    assert float(res["map_75"]) == pytest.approx(1.0)
+    # mean over 10 thresholds: matches 0.5..0.8 (7 thresholds), misses 0.85..0.95
+    assert float(res["map"]) == pytest.approx(7 / 10)
+
+
+def test_per_class_and_areas():
+    preds, target = _perfect_case()
+    m = MAP(class_metrics=True)
+    m.update(preds, target)
+    res = m.compute()
+    np.testing.assert_allclose(np.asarray(res["map_per_class"]), [1.0, 1.0])
+    # boxes are 40x40 = 1600 px -> medium
+    assert float(res["map_medium"]) == pytest.approx(1.0)
+    assert float(res["map_small"]) == -1.0
+    assert float(res["map_large"]) == -1.0
+
+
+def test_box_formats_agree():
+    target = [dict(boxes=np.asarray([[10, 10, 50, 50]], dtype=np.float32), labels=np.asarray([0]))]
+    preds_xyxy = [
+        dict(boxes=np.asarray([[10, 10, 50, 50]], dtype=np.float32), scores=np.asarray([0.9], dtype=np.float32),
+             labels=np.asarray([0]))
+    ]
+    preds_xywh = [
+        dict(boxes=np.asarray([[10, 10, 40, 40]], dtype=np.float32), scores=np.asarray([0.9], dtype=np.float32),
+             labels=np.asarray([0]))
+    ]
+    target_xywh = [dict(boxes=np.asarray([[10, 10, 40, 40]], dtype=np.float32), labels=np.asarray([0]))]
+    m1 = MAP(box_format="xyxy")
+    m1.update(preds_xyxy, target)
+    m2 = MAP(box_format="xywh")
+    m2.update(preds_xywh, target_xywh)
+    assert float(m1.compute()["map"]) == float(m2.compute()["map"])
+
+
+def test_input_validation():
+    m = MAP()
+    with pytest.raises(ValueError, match="Expected all dicts in `preds`"):
+        m.update([dict(boxes=np.zeros((0, 4)))], [dict(boxes=np.zeros((0, 4)), labels=np.zeros(0))])
+    with pytest.raises(ValueError, match="same length"):
+        m.update([], [dict(boxes=np.zeros((0, 4)), labels=np.zeros(0))])
+
+
+def test_empty_preds_image():
+    preds = [dict(boxes=np.zeros((0, 4), dtype=np.float32), scores=np.zeros(0, dtype=np.float32),
+                  labels=np.zeros(0, dtype=np.int32))]
+    target = [dict(boxes=np.asarray([[10, 10, 50, 50]], dtype=np.float32), labels=np.asarray([0]))]
+    m = MAP()
+    m.update(preds, target)
+    res = m.compute()
+    assert float(res["map"]) == pytest.approx(0.0)
+    assert float(res["mar_100"]) == pytest.approx(0.0)
