@@ -1,0 +1,16 @@
+# Parity target: reference Makefile (test = pytest with coverage).
+.PHONY: test clean native bench
+
+test:
+	python -m pytest tests/ -q
+
+native:
+	g++ -O3 -shared -fPIC metrics_tpu/native/levenshtein.cpp -o metrics_tpu/native/_levenshtein.so
+
+bench:
+	python bench.py
+
+clean:
+	rm -rf .pytest_cache build dist *.egg-info
+	find . -name __pycache__ -type d -exec rm -rf {} +
+	rm -f metrics_tpu/native/_levenshtein.so

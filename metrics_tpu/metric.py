@@ -276,6 +276,10 @@ class Metric:
         return type(self).compute(self)
 
     def _wrap_update(self, update: Callable) -> Callable:
+        # named profiler scope per metric: shows up in jax.profiler / XLA traces
+        # (the reference has no tracing at all — SURVEY.md §5)
+        scope = f"metrics_tpu.{type(self).__name__}.update"
+
         @functools.wraps(update)
         def wrapped_func(*args: Any, **kwargs: Any) -> None:
             if self._is_synced:
@@ -284,7 +288,8 @@ class Metric:
                 )
             self._computed = None
             self._update_called = True
-            update(*args, **kwargs)
+            with jax.profiler.TraceAnnotation(scope):
+                update(*args, **kwargs)
 
         return wrapped_func
 
@@ -305,7 +310,8 @@ class Metric:
                 should_sync=self._to_sync,
                 should_unsync=self._should_unsync,
             ):
-                value = compute(*args, **kwargs)
+                with jax.profiler.TraceAnnotation(f"metrics_tpu.{type(self).__name__}.compute"):
+                    value = compute(*args, **kwargs)
                 self._computed = _squeeze_if_scalar(value)
             return self._computed
 

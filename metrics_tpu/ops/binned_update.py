@@ -1,0 +1,112 @@
+"""Pallas TPU kernel for the binned PR-curve update.
+
+The binned curve metrics accumulate TP/FP/FN counts of shape (C, T) from a batch of
+probabilities (N, C) against T fixed thresholds
+(``metrics_tpu/classification/binned_precision_recall.py``). The jnp formulation
+broadcasts an (N, C, T) boolean intermediate; for corpus-scale N and fine threshold
+grids that intermediate is pure HBM traffic. This kernel streams N in blocks through
+VMEM and loops the (small) threshold axis on the VPU, so HBM sees only the (N, C)
+inputs once and the (T, C) outputs — O(N*C + T*C) instead of O(N*C*T).
+
+Grid: one dimension over N-blocks; outputs are revisited and accumulated across grid
+steps (zeroed at step 0).
+
+Measured on v5e: XLA's own fusion of the jnp formulation already avoids materialising
+the (N, C, T) intermediate at the benchmark sizes (compare+reduce fuse into one
+kernel), so the Pallas path is parity rather than a win there — it exists as the
+guaranteed-streaming fallback for extreme (N*C*T) configurations and as the template
+for fusing *multiple* metric updates into one pass (the planned collection-update
+kernel).
+"""
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def binned_counts_jnp(preds: Array, target_bool: Array, thresholds: Array) -> Tuple[Array, Array, Array]:
+    """Reference jnp path: returns (TPs, FPs, FNs) each (C, T)."""
+    t3 = target_bool[:, :, None]
+    p3 = preds[:, :, None] >= thresholds[None, None, :]
+    tps = jnp.sum(t3 & p3, axis=0).astype(jnp.float32)
+    fps = jnp.sum(~t3 & p3, axis=0).astype(jnp.float32)
+    fns = jnp.sum(t3 & ~p3, axis=0).astype(jnp.float32)
+    return tps, fps, fns
+
+
+def _binned_kernel(thr_ref, preds_ref, target_ref, tp_ref, fp_ref, fn_ref):
+    from jax.experimental import pallas as pl
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        tp_ref[:] = jnp.zeros_like(tp_ref)
+        fp_ref[:] = jnp.zeros_like(fp_ref)
+        fn_ref[:] = jnp.zeros_like(fn_ref)
+
+    preds = preds_ref[:]          # (N_blk, C) f32
+    target = target_ref[:]        # (N_blk, C) f32 in {0, 1}
+    num_t = thr_ref.shape[0]
+
+    def body(t, _):
+        thr = thr_ref[t]
+        mask = (preds >= thr).astype(jnp.float32)
+        tp = jnp.sum(target * mask, axis=0)
+        fp = jnp.sum((1.0 - target) * mask, axis=0)
+        fn = jnp.sum(target * (1.0 - mask), axis=0)
+        tp_ref[pl.ds(t, 1), :] += tp[None, :]
+        fp_ref[pl.ds(t, 1), :] += fp[None, :]
+        fn_ref[pl.ds(t, 1), :] += fn[None, :]
+        return 0
+
+    jax.lax.fori_loop(0, num_t, body, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("block_n",))
+def binned_counts_pallas(
+    preds: Array, target_bool: Array, thresholds: Array, block_n: int = 1024
+) -> Tuple[Array, Array, Array]:
+    """Pallas path: returns (TPs, FPs, FNs) each (C, T). TPU only."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n, c = preds.shape
+    t = thresholds.shape[0]
+    block_n = min(block_n, n)
+    n_pad = (-n) % block_n
+    if n_pad:
+        # padded rows carry target=0 and preds=-inf: contribute nothing
+        preds = jnp.pad(preds, ((0, n_pad), (0, 0)), constant_values=-jnp.inf)
+        target_bool = jnp.pad(target_bool, ((0, n_pad), (0, 0)))
+    target_f = target_bool.astype(jnp.float32)
+    grid = (preds.shape[0] // block_n,)
+
+    out_shape = [jax.ShapeDtypeStruct((t, c), jnp.float32)] * 3
+    tp, fp, fn = pl.pallas_call(
+        _binned_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # thresholds, full
+            pl.BlockSpec((block_n, c), lambda i: (i, 0)),
+            pl.BlockSpec((block_n, c), lambda i: (i, 0)),
+        ],
+        out_specs=[pl.BlockSpec((t, c), lambda i: (0, 0))] * 3,
+        out_shape=out_shape,
+    )(thresholds, preds.astype(jnp.float32), target_f)
+    return tp.T, fp.T, fn.T
+
+
+def binned_counts(preds: Array, target_bool: Array, thresholds: Array) -> Tuple[Array, Array, Array]:
+    """Dispatch: Pallas on TPU, jnp elsewhere (CPU tests, virtual meshes)."""
+    try:
+        on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:
+        on_tpu = False
+    if on_tpu and preds.ndim == 2 and not isinstance(preds, jax.core.Tracer):
+        try:
+            return binned_counts_pallas(preds, target_bool, thresholds)
+        except Exception:
+            pass
+    return binned_counts_jnp(preds, target_bool, thresholds)

@@ -1,0 +1,47 @@
+"""BERTScore with a user-provided encoder.
+
+Analogue of reference ``tm_examples/bert_score-own_model.py``: that example shows
+BERTScore with a custom model + tokenizer; here the encoder is any callable
+``(input_ids, attention_mask) -> (N, L, D)`` — a local HF Flax checkpoint, your own
+flax module, or (below) a toy hash-embedding for demonstration.
+"""
+from typing import Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu import BERTScore
+
+_MAX_LEN = 32
+_DIM = 16
+
+
+def own_tokenizer(sentences, max_length: int) -> Dict[str, np.ndarray]:
+    """Whitespace tokenizer with a stable hash vocab (stands in for a BPE/WordPiece)."""
+    ids = np.zeros((len(sentences), max_length), dtype=np.int32)
+    mask = np.zeros((len(sentences), max_length), dtype=np.int32)
+    for i, s in enumerate(sentences):
+        toks = s.lower().split()[:max_length]
+        for j, t in enumerate(toks):
+            ids[i, j] = (hash(t) % 20000) + 1
+        mask[i, : len(toks)] = 1
+    return {"input_ids": ids, "attention_mask": mask}
+
+
+def own_model(input_ids, attention_mask):
+    """Deterministic pseudo-embeddings (replace with your flax encoder's apply)."""
+    base = (input_ids[..., None] * jnp.arange(1, _DIM + 1)) % 211
+    return jnp.sin(base.astype(jnp.float32))
+
+
+def main() -> None:
+    preds = ["hello there general kenobi", "the cat sat on the mat"]
+    refs = ["hello there", "a cat sat on the mat"]
+
+    metric = BERTScore(user_forward_fn=own_model, user_tokenizer=own_tokenizer, idf=True, max_length=_MAX_LEN)
+    metric.update(preds, refs)
+    print(metric.compute())
+
+
+if __name__ == "__main__":
+    main()

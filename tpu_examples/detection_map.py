@@ -1,0 +1,30 @@
+"""Detection mAP example. Analogue of reference ``tm_examples/detection_map.py``."""
+import numpy as np
+
+from metrics_tpu import MAP
+
+
+def main() -> None:
+    preds = [
+        dict(
+            boxes=np.asarray([[258.0, 41.0, 606.0, 285.0]], dtype=np.float32),
+            scores=np.asarray([0.536], dtype=np.float32),
+            labels=np.asarray([0]),
+        )
+    ]
+    target = [
+        dict(
+            boxes=np.asarray([[214.0, 41.0, 562.0, 285.0]], dtype=np.float32),
+            labels=np.asarray([0]),
+        )
+    ]
+
+    metric = MAP()
+    metric.update(preds, target)
+    result = metric.compute()
+    for k, v in result.items():
+        print(f"{k}: {np.asarray(v)}")
+
+
+if __name__ == "__main__":
+    main()
