@@ -10,6 +10,8 @@ __all__ = [
     "PIT",
     "PermutationInvariantTraining",
     "SDR",
+    "SI_SDR",
+    "SI_SNR",
     "SNR",
     "ScaleInvariantSignalDistortionRatio",
     "ScaleInvariantSignalNoiseRatio",
@@ -17,10 +19,10 @@ __all__ = [
     "SignalNoiseRatio",
 ]
 
-# deprecated aliases of the scale-invariant metrics (reference audio/si_sdr.py:22,
-# si_snr.py:22)
-SI_SDR = ScaleInvariantSignalDistortionRatio
-SI_SNR = ScaleInvariantSignalNoiseRatio
+# deprecated alias classes of the scale-invariant metrics (warn on construction;
+# reference audio/si_sdr.py:22, si_snr.py:22)
+from metrics_tpu.audio.si_sdr import SI_SDR  # noqa: E402
+from metrics_tpu.audio.si_snr import SI_SNR  # noqa: E402
 
 # optional native-DSP metrics: modules always import; construction raises a clear
 # ModuleNotFoundError when the backing package is absent (reference pattern)

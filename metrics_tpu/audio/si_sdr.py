@@ -1,0 +1,18 @@
+"""Deprecated SI_SDR alias class.
+
+Parity: reference ``torchmetrics/audio/si_sdr.py:22`` (renamed to
+``ScaleInvariantSignalDistortionRatio`` in v0.7; alias warns on construction).
+"""
+from typing import Any
+
+from metrics_tpu.audio.sdr import ScaleInvariantSignalDistortionRatio
+from metrics_tpu.utils.prints import rank_zero_warn
+
+
+class SI_SDR(ScaleInvariantSignalDistortionRatio):
+    def __init__(self, zero_mean: bool = False, **kwargs: Any) -> None:
+        rank_zero_warn(
+            "`SI_SDR` was renamed to `ScaleInvariantSignalDistortionRatio` and it will be removed.",
+            DeprecationWarning,
+        )
+        super().__init__(zero_mean=zero_mean, **kwargs)

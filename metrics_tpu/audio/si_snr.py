@@ -1,0 +1,18 @@
+"""Deprecated SI_SNR alias class.
+
+Parity: reference ``torchmetrics/audio/si_snr.py:22`` (renamed to
+``ScaleInvariantSignalNoiseRatio`` in v0.7; alias warns on construction).
+"""
+from typing import Any
+
+from metrics_tpu.audio.snr import ScaleInvariantSignalNoiseRatio
+from metrics_tpu.utils.prints import rank_zero_warn
+
+
+class SI_SNR(ScaleInvariantSignalNoiseRatio):
+    def __init__(self, **kwargs: Any) -> None:
+        rank_zero_warn(
+            "`SI_SNR` was renamed to `ScaleInvariantSignalNoiseRatio` and it will be removed.",
+            DeprecationWarning,
+        )
+        super().__init__(**kwargs)

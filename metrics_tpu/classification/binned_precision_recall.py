@@ -8,9 +8,10 @@ This family is the **TPU-native template for curve metrics** (SURVEY.md §7.1): 
 are fixed ``(C, T)`` counters with sum-reduce, so the whole update/compute/sync path
 is jit/scan/shard_map-safe with one psum — unlike the exact curve metrics whose
 gathered cat-state has data-dependent length. The reference iterates one threshold at
-a time "to conserve memory" (``:169-174``); here the threshold comparison is one
-broadcasted ``(N, C, T)`` fused kernel — XLA fuses compare+mask+reduce, and HBM cost
-is the output ``(C, T)`` only.
+a time "to conserve memory" (``:169-174``); here the counting goes through
+``metrics_tpu/ops/binned_update.binned_counts`` — a streaming Pallas kernel on TPU
+(N blocked through VMEM, thresholds looped on the VPU), and the fused jnp
+compare+mask+reduce formulation elsewhere.
 
 Deviation from the reference: ``thresholds`` defaults to 100 bins (the reference has
 no default and crashes with ``thresholds=None``).
@@ -24,6 +25,7 @@ from metrics_tpu.functional.classification.average_precision import (
     _average_precision_compute_with_precision_recall,
 )
 from metrics_tpu.metric import Metric
+from metrics_tpu.ops.binned_update import binned_counts
 from metrics_tpu.utils.data import METRIC_EPS, to_onehot
 
 Array = jax.Array
@@ -101,11 +103,11 @@ class BinnedPrecisionRecallCurve(Metric):
             target = target.reshape(-1, 1)
         if preds.ndim == target.ndim + 1:
             target = to_onehot(target, num_classes=self.num_classes)
-        target = (target == 1)[:, :, None]  # (N, C, 1)
-        predictions = preds[:, :, None] >= self.thresholds[None, None, :]  # (N, C, T)
-        self.TPs = self.TPs + jnp.sum(target & predictions, axis=0)
-        self.FPs = self.FPs + jnp.sum(~target & predictions, axis=0)
-        self.FNs = self.FNs + jnp.sum(target & ~predictions, axis=0)
+        # streaming (N,C)x(T,) count kernel: Pallas on TPU, fused jnp elsewhere
+        tps, fps, fns = binned_counts(preds, target == 1, self.thresholds)
+        self.TPs = self.TPs + tps
+        self.FPs = self.FPs + fps
+        self.FNs = self.FNs + fns
 
     def compute(self) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
         precisions = (self.TPs + METRIC_EPS) / (self.TPs + self.FPs + METRIC_EPS)

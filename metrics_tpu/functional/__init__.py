@@ -11,8 +11,9 @@ from metrics_tpu.functional.classification.calibration_error import calibration_
 from metrics_tpu.functional.classification.cohen_kappa import cohen_kappa
 from metrics_tpu.functional.classification.confusion_matrix import confusion_matrix
 from metrics_tpu.functional.classification.f_beta import f1, f1_score, fbeta
+from metrics_tpu.functional.classification.dice import dice_score
 from metrics_tpu.functional.classification.hamming_distance import hamming_distance
-from metrics_tpu.functional.classification.hinge import hinge
+from metrics_tpu.functional.classification.hinge import hinge, hinge_loss
 from metrics_tpu.functional.classification.jaccard import jaccard_index
 from metrics_tpu.functional.classification.kl_divergence import kl_divergence
 from metrics_tpu.functional.classification.matthews_corrcoef import matthews_corrcoef
@@ -23,11 +24,15 @@ from metrics_tpu.functional.classification.specificity import specificity
 from metrics_tpu.functional.audio.pit import pit, pit_permutate
 from metrics_tpu.functional.audio.sdr import (
     scale_invariant_signal_distortion_ratio,
+    sdr,
+    si_sdr,
     signal_distortion_ratio,
 )
 from metrics_tpu.functional.audio.snr import (
     scale_invariant_signal_noise_ratio,
+    si_snr,
     signal_noise_ratio,
+    snr,
 )
 from metrics_tpu.functional.classification.stat_scores import stat_scores
 from metrics_tpu.functional.image.gradients import image_gradients
@@ -70,7 +75,7 @@ from metrics_tpu.functional.text.rouge import rouge_score
 from metrics_tpu.functional.text.sacre_bleu import sacre_bleu_score
 from metrics_tpu.functional.text.squad import squad
 from metrics_tpu.functional.text.ter import translation_edit_rate
-from metrics_tpu.functional.text.wer import word_error_rate
+from metrics_tpu.functional.text.wer import wer, word_error_rate
 from metrics_tpu.functional.text.wil import word_information_lost
 from metrics_tpu.functional.text.wip import word_information_preserved
 
@@ -88,6 +93,7 @@ __all__ = [
     "sacre_bleu_score",
     "squad",
     "translation_edit_rate",
+    "wer",
     "word_error_rate",
     "word_information_lost",
     "word_information_preserved",
@@ -114,6 +120,10 @@ __all__ = [
     "retrieval_reciprocal_rank",
     "scale_invariant_signal_distortion_ratio",
     "scale_invariant_signal_noise_ratio",
+    "sdr",
+    "si_sdr",
+    "si_snr",
+    "snr",
     "signal_distortion_ratio",
     "signal_noise_ratio",
     "spearman_corrcoef",
@@ -129,7 +139,9 @@ __all__ = [
     "f1_score",
     "fbeta",
     "hamming_distance",
+    "dice_score",
     "hinge",
+    "hinge_loss",
     "image_gradients",
     "iou",
     "multiscale_structural_similarity_index_measure",

@@ -88,12 +88,25 @@ def _hinge_compute(measure: Array, total: Array) -> Array:
     return measure / total
 
 
+def hinge_loss(
+    preds: Array,
+    target: Array,
+    squared: bool = False,
+    multiclass_mode: Optional[Union[str, MulticlassMode]] = None,
+) -> Array:
+    """Compute mean hinge loss. Parity: reference ``hinge_loss:158-232``."""
+    measure, total = _hinge_update(preds, target, squared=squared, multiclass_mode=multiclass_mode)
+    return _hinge_compute(measure, total)
+
+
 def hinge(
     preds: Array,
     target: Array,
     squared: bool = False,
     multiclass_mode: Optional[Union[str, MulticlassMode]] = None,
 ) -> Array:
-    """Compute mean hinge loss. Parity: reference ``hinge:146-210``."""
-    measure, total = _hinge_update(preds, target, squared=squared, multiclass_mode=multiclass_mode)
-    return _hinge_compute(measure, total)
+    """Deprecated alias of :func:`hinge_loss`. Parity: reference ``hinge:235-263``."""
+    from metrics_tpu.utils.prints import rank_zero_warn
+
+    rank_zero_warn("`hinge` was renamed to `hinge_loss` and it will be removed.", DeprecationWarning)
+    return hinge_loss(preds, target, squared=squared, multiclass_mode=multiclass_mode)

@@ -1,8 +1,11 @@
-"""SacreBLEU: BLEU with canonical tokenizers (13a / intl / char / zh / ja).
+"""SacreBLEU: BLEU with canonical tokenizers (13a / intl / char / zh).
 
 Parity: reference ``torchmetrics/functional/text/sacre_bleu.py`` (361 LoC;
-_SacreBLEUTokenizer with the mteval-v13a and international tokenizers). zh/ja
-tokenizers require external segmenters (mecab) and are gated like the reference.
+_SacreBLEUTokenizer with the mteval-v13a, international, and zh tokenizers).
+``zh`` needs no external segmenter: each CJK character (by unicode block) is
+split out as its own token and the non-Chinese remainder goes through the 13a
+regexes (reference ``sacre_bleu.py:203-229``). Only ``ja-mecab`` (which does
+need mecab) is out of scope.
 """
 import re
 from functools import partial
@@ -37,6 +40,26 @@ class _SacreBLEUTokenizer:
         (re.compile(r"([\.,])([^0-9])"), r" \1 \2"),
         (re.compile(r"([0-9])(-)"), r"\1 \2 "),
     )
+    # The EFFECTIVE char set of sacrebleu's TokenizerZh._is_chinese_char (and the
+    # reference's copy of it, sacre_bleu.py:153-164): its range table compares
+    # python strings, and the two "UTF16" entries are 5-char literals, so the
+    # real behavior is [U+2001-U+2A6D] (not CJK Ext B, which is never matched)
+    # plus the BMP blocks. Derived by brute-forcing every code point against the
+    # oracle; parity requires the quirk, not the nominal block list.
+    _ZH_CHAR = re.compile(
+        "(["
+        "\u2001-\u2a6d"  # quirk: the "\u20000"-"\u2a6d6" string-compare entry
+        "\u2e80-\u2fdf"  # CJK radicals + Kangxi radicals
+        "\u2ff0-\u303f"  # ideographic description + CJK punctuation
+        "\u3100-\u312f"  # bopomofo
+        "\u31a0-\u31ef"  # bopomofo extended + CJK strokes
+        "\u3200-\u4db5"  # enclosed CJK + compatibility + Ext A
+        "\u4e00-\u9fbb"  # CJK Unified Ideographs
+        "\uf900-\ufa2d\ufa30-\ufa6a\ufa70-\ufad9"  # compatibility ideographs
+        "\ufe10-\ufe1f\ufe30-\ufe4f"  # vertical/compatibility forms
+        "\uff00-\uffef"  # full-width forms
+        "])"
+    )
 
     def __init__(self, tokenize: str = "13a", lowercase: bool = False) -> None:
         if tokenize not in AVAILABLE_TOKENIZERS:
@@ -46,10 +69,6 @@ class _SacreBLEUTokenizer:
         if tokenize == "intl" and not _REGEX_AVAILABLE:
             raise ModuleNotFoundError(
                 "`intl` tokenization requires the `regex` package (unicode property classes)."
-            )
-        if tokenize == "zh":
-            raise ModuleNotFoundError(
-                "`zh` tokenization requires a Chinese segmenter which is not available in this build."
             )
 
     def __call__(self, line: str) -> Sequence[str]:
@@ -63,6 +82,8 @@ class _SacreBLEUTokenizer:
             return self._tokenize_char(line)
         if self.tokenize_name == "intl":
             return self._tokenize_intl(line)
+        if self.tokenize_name == "zh":
+            return self._tokenize_zh(line)
         raise ValueError(f"Unsupported tokenizer {self.tokenize_name}")
 
     @classmethod
@@ -81,11 +102,26 @@ class _SacreBLEUTokenizer:
 
     @staticmethod
     def _tokenize_intl(line: str) -> Sequence[str]:
+        # mteval-v14 international: split punctuation off non-digit neighbors,
+        # then isolate symbols — rule order follows sacrebleu's TokenizerV14
         import regex
 
-        line = regex.sub(r"(\p{P})(\P{N})", r" \1 \2", line)
         line = regex.sub(r"(\P{N})(\p{P})", r"\1 \2 ", line)
+        line = regex.sub(r"(\p{P})(\P{N})", r" \1 \2", line)
+        line = regex.sub(r"(\p{S})", r" \1 ", line)
         return line.split()
+
+    @classmethod
+    def _tokenize_zh(cls, line: str) -> Sequence[str]:
+        # Isolate every CJK character, then run the non-Chinese remainder
+        # through the 13a language-dependent regexes. No segmenter needed
+        # (reference sacre_bleu.py:203-229). Unlike 13a, zh applies NO space
+        # padding around the line (sacrebleu calls TokenizerRegexp directly),
+        # so leading ".5" stays one token here.
+        norm = cls._ZH_CHAR.sub(r" \1 ", line.strip())
+        for pattern, replacement in cls._REGEX_13A_TOK:
+            norm = pattern.sub(replacement, norm)
+        return norm.split()
 
 
 def sacre_bleu_score(

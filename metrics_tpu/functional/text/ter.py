@@ -6,7 +6,6 @@ scored by Levenshtein distance — the distance kernel runs natively, see
 ``metrics_tpu/native/levenshtein.cpp``).
 """
 import re
-import string
 import unicodedata
 from typing import List, Optional, Sequence, Tuple, Union
 
@@ -41,18 +40,52 @@ def _normalize_general_and_western(sentence: str) -> str:
     return sentence
 
 
+_ASIAN_PUNCTUATION = r"([\u3001\u3002\u3008-\u3011\u3014-\u301f\uff61-\uff65\u30fb])"
+_FULL_WIDTH_PUNCTUATION = r"([\uff0e\uff0c\uff1f\uff1a\uff1b\uff01\uff02\uff08\uff09])"
+
+
+def _normalize_asian(sentence: str) -> str:
+    """Split CJK ideographs/kana down to character level (tercom asian mode)."""
+    # CJK Unified Ideographs (+ext A), strokes/radicals, compatibility blocks
+    sentence = re.sub(r"([\u4e00-\u9fff\u3400-\u4dbf])", r" \1 ", sentence)
+    sentence = re.sub(r"([\u31c0-\u31ef\u2e80-\u2eff])", r" \1 ", sentence)
+    sentence = re.sub(r"([\u3300-\u33ff\uf900-\ufaff\ufe30-\ufe4f])", r" \1 ", sentence)
+    sentence = re.sub(r"([\u3200-\u3f22])", r" \1 ", sentence)
+    # hiragana / katakana / katakana phonetic extensions, as runs
+    sentence = re.sub(r"(^|^[\u3040-\u309f])([\u3040-\u309f]+)(?=$|^[\u3040-\u309f])", r"\1 \2 ", sentence)
+    sentence = re.sub(r"(^|^[\u30a0-\u30ff])([\u30a0-\u30ff]+)(?=$|^[\u30a0-\u30ff])", r"\1 \2 ", sentence)
+    sentence = re.sub(r"(^|^[\u31f0-\u31ff])([\u31f0-\u31ff]+)(?=$|^[\u31f0-\u31ff])", r"\1 \2 ", sentence)
+    sentence = re.sub(_ASIAN_PUNCTUATION, r" \1 ", sentence)
+    sentence = re.sub(_FULL_WIDTH_PUNCTUATION, r" \1 ", sentence)
+    return sentence
+
+
 def _remove_punct(sentence: str) -> str:
-    return re.sub(f"[{re.escape(string.punctuation)}]", "", sentence)
+    # tercom removes only this specific set — NOT all of string.punctuation
+    # (hyphens/apostrophes stay; sacrebleu tokenizer_ter._remove_punct)
+    return re.sub(r"[\.,\?:;!\"\(\)]", "", sentence)
 
 
-def _preprocess_sentence(sentence: str, lowercase: bool, normalize: bool, no_punctuation: bool) -> List[str]:
+def _remove_asian_punct(sentence: str) -> str:
+    sentence = re.sub(_ASIAN_PUNCTUATION, "", sentence)
+    sentence = re.sub(_FULL_WIDTH_PUNCTUATION, "", sentence)
+    return sentence
+
+
+def _preprocess_sentence(
+    sentence: str, lowercase: bool, normalize: bool, no_punctuation: bool, asian_support: bool = False
+) -> List[str]:
     sentence = sentence.rstrip()
     if lowercase:
         sentence = sentence.lower()
     if normalize:
         sentence = _normalize_general_and_western(sentence)
+        if asian_support:
+            sentence = _normalize_asian(sentence)
     if no_punctuation:
         sentence = _remove_punct(sentence)
+        if asian_support:
+            sentence = _remove_asian_punct(sentence)
     return sentence.split()
 
 
@@ -118,15 +151,16 @@ def _ter_update(
     normalize: bool = False,
     no_punctuation: bool = False,
     sentence_scores: Optional[List[Array]] = None,
+    asian_support: bool = False,
 ) -> Tuple[Array, Array]:
     edits_sum = 0.0
     ref_len_sum = 0.0
     for pred, refs in zip(preds, targets):
-        pred_words = _preprocess_sentence(pred, lowercase, normalize, no_punctuation)
+        pred_words = _preprocess_sentence(pred, lowercase, normalize, no_punctuation, asian_support)
         best_edits = None
         best_ref_len = None
         for ref in refs:
-            ref_words = _preprocess_sentence(ref, lowercase, normalize, no_punctuation)
+            ref_words = _preprocess_sentence(ref, lowercase, normalize, no_punctuation, asian_support)
             edits = _ter_sentence(pred_words, ref_words)
             ref_len = max(len(ref_words), 1)
             if best_edits is None or edits / ref_len < best_edits / best_ref_len:
@@ -152,8 +186,6 @@ def translation_edit_rate(
     return_sentence_level_score: bool = False,
 ) -> Union[Array, Tuple[Array, Array]]:
     """Corpus TER = (shifts + edits) / reference length. Parity: reference API."""
-    if asian_support:
-        raise ModuleNotFoundError("`asian_support` requires language segmenters not available in this build.")
     preds_ = [preds] if isinstance(preds, str) else list(preds)
     targets_ = [targets] if isinstance(targets, str) else list(targets)
     targets_ = [[t] if isinstance(t, str) else list(t) for t in targets_]
@@ -162,7 +194,8 @@ def translation_edit_rate(
     total_ref_len = jnp.asarray(0.0)
     sentence_scores: Optional[List[Array]] = [] if return_sentence_level_score else None
     total_num_edits, total_ref_len = _ter_update(
-        preds_, targets_, total_num_edits, total_ref_len, lowercase, normalize, no_punctuation, sentence_scores
+        preds_, targets_, total_num_edits, total_ref_len, lowercase, normalize, no_punctuation, sentence_scores,
+        asian_support,
     )
     score = _ter_compute(total_num_edits, total_ref_len)
     if return_sentence_level_score:

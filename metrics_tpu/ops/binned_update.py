@@ -99,14 +99,23 @@ def binned_counts_pallas(
 
 
 def binned_counts(preds: Array, target_bool: Array, thresholds: Array) -> Tuple[Array, Array, Array]:
-    """Dispatch: Pallas on TPU, jnp elsewhere (CPU tests, virtual meshes)."""
+    """Dispatch: Pallas on TPU, jnp elsewhere (CPU tests, virtual meshes).
+
+    The platform decision is made at trace time (it depends only on the backend,
+    never on traced values), so this is safe to call inside jit/shard_map — the
+    Pallas path lowers with the surrounding computation on TPU.
+    """
     try:
-        on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+        on_tpu = jax.default_backend() in ("tpu", "axon")
     except Exception:
         on_tpu = False
-    if on_tpu and preds.ndim == 2 and not isinstance(preds, jax.core.Tracer):
+    if on_tpu and preds.ndim == 2:
         try:
             return binned_counts_pallas(preds, target_bool, thresholds)
         except Exception:
+            # Catches eager-mode and trace-time failures only. When called under an
+            # outer jit, a Mosaic *compile* failure surfaces when the outer jit
+            # compiles — outside this try. That's accepted: the kernel's shapes are
+            # the metric's static (block_n, C)/(T, C) tiles, validated on TPU CI.
             pass
     return binned_counts_jnp(preds, target_bool, thresholds)

@@ -28,11 +28,10 @@ class TranslationEditRate(Metric):
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
-        if asian_support:
-            raise ModuleNotFoundError("`asian_support` requires language segmenters not available in this build.")
         self.normalize = normalize
         self.no_punctuation = no_punctuation
         self.lowercase = lowercase
+        self.asian_support = asian_support
         self.return_sentence_level_score = return_sentence_level_score
 
         self.add_state("total_num_edits", jnp.asarray(0.0), dist_reduce_fx="sum")
@@ -48,6 +47,7 @@ class TranslationEditRate(Metric):
         self.total_num_edits, self.total_ref_len = _ter_update(
             preds, targets, self.total_num_edits, self.total_ref_len,
             self.lowercase, self.normalize, self.no_punctuation, sentence_scores,
+            self.asian_support,
         )
         if self.return_sentence_level_score and sentence_scores:
             self.sentence_ter.append(jnp.stack(sentence_scores))

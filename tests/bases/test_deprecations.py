@@ -1,0 +1,57 @@
+"""Deprecated alias surface: functional aliases + class aliases exist and agree.
+
+Parity: reference keeps v0.6 names importable in v0.7 with DeprecationWarnings
+(``functional/__init__.py``, ``audio/si_sdr.py:22``, ``audio/si_snr.py:22``).
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu
+from metrics_tpu import functional as F
+
+
+@pytest.fixture
+def audio_pair():
+    rng = np.random.RandomState(0)
+    return jnp.asarray(rng.randn(32).astype(np.float32)), jnp.asarray(rng.randn(32).astype(np.float32))
+
+
+def test_functional_audio_aliases(audio_pair):
+    preds, target = audio_pair
+    np.testing.assert_allclose(float(F.snr(preds, target)), float(F.signal_noise_ratio(preds, target)))
+    np.testing.assert_allclose(float(F.si_snr(preds, target)), float(F.scale_invariant_signal_noise_ratio(preds, target)))
+    np.testing.assert_allclose(float(F.si_sdr(preds, target)), float(F.scale_invariant_signal_distortion_ratio(preds, target)))
+    np.testing.assert_allclose(float(F.sdr(preds, target)), float(F.signal_distortion_ratio(preds, target)), rtol=1e-4)
+
+
+def test_functional_wer_alias():
+    np.testing.assert_allclose(
+        float(F.wer(["hello there"], ["hello where"])),
+        float(F.word_error_rate(["hello there"], ["hello where"])),
+    )
+
+
+def test_functional_hinge_alias():
+    preds = jnp.asarray([0.25, 0.25, 0.55, 0.75, 0.75])
+    target = jnp.asarray([0, 0, 1, 1, 1])
+    np.testing.assert_allclose(float(F.hinge(preds, target)), float(F.hinge_loss(preds, target)))
+
+
+def test_si_sdr_si_snr_classes(audio_pair):
+    preds, target = audio_pair
+    m_old, m_new = metrics_tpu.SI_SDR(), metrics_tpu.ScaleInvariantSignalDistortionRatio()
+    m_old.update(preds, target)
+    m_new.update(preds, target)
+    np.testing.assert_allclose(float(m_old.compute()), float(m_new.compute()))
+
+    s_old, s_new = metrics_tpu.SI_SNR(), metrics_tpu.ScaleInvariantSignalNoiseRatio()
+    s_old.update(preds, target)
+    s_new.update(preds, target)
+    np.testing.assert_allclose(float(s_old.compute()), float(s_new.compute()))
+
+
+def test_top_level_exports():
+    for name in ["PESQ", "STOI", "SI_SDR", "SI_SNR"]:
+        assert hasattr(metrics_tpu, name), name
+        assert name in metrics_tpu.__all__, name

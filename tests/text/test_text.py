@@ -110,7 +110,7 @@ class TestBLEU:
 
 
 class TestSacreBLEU:
-    @pytest.mark.parametrize("tokenize", ["13a", "char", "intl", "none"])
+    @pytest.mark.parametrize("tokenize", ["13a", "char", "intl", "none", "zh"])
     @pytest.mark.parametrize("lowercase", [False, True])
     def test_vs_sacrebleu(self, tokenize, lowercase):
         # sentences share 4-grams under every tokenizer, so no order has zero matches
@@ -134,6 +134,36 @@ class TestSacreBLEU:
         np.testing.assert_allclose(
             float(m.compute()), float(sacre_bleu_score(preds, targets)), atol=1e-6
         )
+
+    def test_zh_quirk_charset(self):
+        # sacrebleu's _is_chinese_char compares python strings, so its effective
+        # set isolates U+2001-U+2A6D (curly quotes, em dashes) and NOT CJK Ext B;
+        # parity requires replicating the quirk
+        from metrics_tpu.functional.text.sacre_bleu import _SacreBLEUTokenizer
+
+        preds = ["他说“你好”——然后离开了"]
+        targets = [["他说“你好”然后离开了"]]
+        oracle = SacreBLEUOracle(tokenize="zh", effective_order=False)
+        expected = oracle.corpus_score(preds, [[t[0] for t in targets]]).score / 100
+        res = float(sacre_bleu_score(preds, targets, tokenize="zh"))
+        np.testing.assert_allclose(res, expected, atol=1e-6)
+        # zh applies no 13a-style space padding: leading ".5" stays one token
+        assert _SacreBLEUTokenizer("zh")(".5只猫") == [".5", "只", "猫"]
+        # astral CJK Ext B chars are NOT isolated (the oracle never matches them)
+        assert _SacreBLEUTokenizer("zh")("\U00020000\U00020001") == ["\U00020000\U00020001"]
+
+    def test_zh_chinese_text(self):
+        # native zh tokenizer on real CJK input: per-character splitting with the
+        # non-Chinese remainder (latin words, digits) through the 13a regexes
+        preds = ["猫坐在垫子上，今天。", "你好，世界！这是 test 123。"]
+        targets = [
+            ["猫坐在垫子上今天。", "猫今天坐在垫子上。"],
+            ["你好世界！这是 test 123。", "你好，世界。这是 test 123!"],
+        ]
+        oracle = SacreBLEUOracle(tokenize="zh", effective_order=False)
+        expected = oracle.corpus_score(preds, [[t[i] for t in targets] for i in range(2)]).score / 100
+        res = float(sacre_bleu_score(preds, targets, tokenize="zh"))
+        np.testing.assert_allclose(res, expected, atol=1e-6)
 
 
 class TestCHRF:
@@ -167,6 +197,33 @@ class TestTER:
         # "b c a" -> "a b c" is one shift for TER (score 1/3), not two edits
         res = float(translation_edit_rate(["b c a"], ["a b c"]))
         np.testing.assert_allclose(res, 1 / 3, atol=1e-6)
+
+    def test_no_punctuation_keeps_hyphens_apostrophes(self):
+        # tercom removes only [.,?:;!"()] — hyphens/apostrophes survive
+        preds = ["it's a well-known fact"]
+        targets = [["its a wellknown fact"]]
+        oracle = TerOracle(no_punct=True, case_sensitive=False)
+        expected = oracle.corpus_score(preds, list(zip(*targets))).score / 100
+        res = float(translation_edit_rate(preds, targets, no_punctuation=True))
+        np.testing.assert_allclose(res, expected, atol=1e-9)
+        assert expected == 0.5  # ' and - kept -> 2 of 4 words differ
+
+    @pytest.mark.parametrize("normalized", [False, True])
+    @pytest.mark.parametrize("no_punct", [False, True])
+    def test_asian_support(self, normalized, no_punct):
+        preds = ["今日は晴れです、散歩に行きます。", "猫がマットの上に座った today。"]
+        targets = [["今日は晴れだ、散歩する。"], ["猫が today マットに座った。"]]
+        oracle = TerOracle(
+            normalized=normalized, no_punct=no_punct, asian_support=True, case_sensitive=False
+        )
+        expected = oracle.corpus_score(preds, list(zip(*targets))).score / 100
+        res = float(
+            translation_edit_rate(
+                preds, targets, normalize=normalized, no_punctuation=no_punct,
+                lowercase=True, asian_support=True,
+            )
+        )
+        np.testing.assert_allclose(res, expected, atol=1e-6)
 
     def test_class(self):
         m = TranslationEditRate()
