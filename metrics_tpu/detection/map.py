@@ -6,18 +6,25 @@ per-class IoU matrices (:343), greedy IoU-threshold matching with crowd/area-ign
 handling (:378-491), 101-point interpolated precision (:616), ``_summarize`` (:493)
 and a ``COCOMetricResults`` dict of 12+ entries with per-class options (:683).
 
-TPU split: IoU matrices are one jnp broadcast kernel per image/class (device); the
-greedy per-detection matching and accumulation run host-side in numpy — group sizes
-are tiny and data-dependent (SURVEY.md §7.3 hard part 3). A masked
-``lax.while_loop``/Pallas matching path is the planned perf upgrade once parity is
-locked.
+TPU split (``matching="device"``, the default): all (image, class) pairs are padded
+into one batch, and IoU + the greedy per-detection threshold matching run as ONE
+jitted device call — a ``lax.scan`` over score-sorted detections carrying the
+matched-gt mask, vmapped over IoU thresholds, area ranges, and pairs — followed by a
+single device→host transfer. The reference's per-image python loops
+(``map.py:343,378-491``) and round 1's per-image host transfers are gone. The
+host-side numpy matcher is kept as ``matching="host"`` — it is the parity oracle
+(``tests/detection/test_map_device.py`` asserts both paths agree bit-for-bit on the
+final metrics). The 101-point interpolation/accumulation stays host-side numpy: it
+is O(total detections) once per compute, data-dependent, and not worth a kernel.
 """
 from collections import OrderedDict
+from functools import partial
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from metrics_tpu.metric import Metric
 
@@ -117,6 +124,101 @@ def _fix_empty_tensors(boxes: Array) -> Array:
     return boxes
 
 
+def _box_convert_np(boxes: Any, in_fmt: str) -> np.ndarray:
+    """Host-side box conversion for update(): detection states are STAGED ON HOST
+    (numpy) so per-image updates cost zero device round-trips; the whole padded
+    batch ships to the device once per compute (``_match_all_pairs``)."""
+    boxes = np.asarray(boxes, dtype=np.float32).reshape(-1, 4)
+    if in_fmt == "xyxy":
+        return boxes
+    if in_fmt == "xywh":
+        x, y, w, h = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+        return np.stack([x, y, x + w, y + h], axis=1)
+    if in_fmt == "cxcywh":
+        cx, cy, w, h = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+        return np.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=1)
+    raise ValueError(f"Unsupported box format {in_fmt}")
+
+
+def _greedy_match_single(
+    iou: Array,  # (D, G) det-gt IoU
+    det_valid: Array,  # (D,) bool
+    gt_valid: Array,  # (G,) bool
+    gt_ignore: Array,  # (G,) bool (area-ignored)
+    thresholds: Array,  # (T,)
+) -> Tuple[Array, Array]:
+    """COCO greedy matching for one (image, class, area) cell, all thresholds.
+
+    Replicates the reference loop (``map.py:378-451``) exactly:
+      * detections visit gts in score order (the scan);
+      * a det prefers the best-IoU *unmatched, non-ignored* gt with IoU >= thr,
+        falling back to ignored gts only when no regular gt qualifies (the
+        reference's sorted-gts + break rule);
+      * IoU ties pick the later gt index (the reference's non-strict `<` compare).
+
+    Returns (det_matches (T, D) bool, match_idx (T, D) int32, -1 = unmatched).
+    """
+    num_gt = iou.shape[1]
+    gt_idx = jnp.arange(num_gt)
+
+    def per_threshold(thr):
+        thr_eff = jnp.minimum(thr, 1.0 - 1e-10)
+
+        def step(gt_matched, inp):
+            iou_row, dvalid = inp
+            cand = gt_valid & (~gt_matched) & (iou_row >= thr_eff)
+            regular = cand & (~gt_ignore)
+            pool = jnp.where(jnp.any(regular), regular, cand)
+            masked = jnp.where(pool, iou_row, -jnp.inf)
+            best = jnp.max(masked)
+            match = jnp.max(jnp.where(pool & (masked == best), gt_idx, -1))
+            matched = (match >= 0) & dvalid
+            gt_matched = gt_matched | (matched & (gt_idx == match))
+            return gt_matched, (matched, jnp.where(matched, match, -1))
+
+        _, (dm, mi) = lax.scan(step, jnp.zeros(num_gt, bool), (iou, det_valid))
+        return dm, mi.astype(jnp.int32)
+
+    return jax.vmap(per_threshold)(thresholds)
+
+
+@partial(jax.jit, static_argnames=())
+def _match_all_pairs(
+    det_boxes: Array,  # (P, D, 4) score-sorted
+    det_valid: Array,  # (P, D)
+    gt_boxes: Array,  # (P, G, 4)
+    gt_valid: Array,  # (P, G)
+    thresholds: Array,  # (T,)
+    area_ranges: Array,  # (A, 2)
+) -> Tuple[Array, Array, Array]:
+    """One fused device call: IoU + greedy matching for every (image, class) pair
+    and every area range. Returns
+    ``det_matches (P, A, T, D)``, ``det_ignore (P, A, T, D)``, ``gt_ignore (P, A, G)``.
+    """
+    ious = jax.vmap(box_iou)(det_boxes, gt_boxes)  # (P, D, G)
+    ious = jnp.where(det_valid[:, :, None] & gt_valid[:, None, :], ious, 0.0)
+
+    gt_areas = jax.vmap(box_area)(gt_boxes)  # (P, G)
+    det_areas = jax.vmap(box_area)(det_boxes)  # (P, D)
+    lo, hi = area_ranges[:, 0], area_ranges[:, 1]
+    gt_ign = (gt_areas[:, None, :] < lo[None, :, None]) | (gt_areas[:, None, :] > hi[None, :, None])
+    det_area_out = (det_areas[:, None, :] < lo[None, :, None]) | (det_areas[:, None, :] > hi[None, :, None])
+
+    def per_pair(iou, dvalid, gvalid, g_ign_areas):
+        def per_area(g_ign):
+            return _greedy_match_single(iou, dvalid, gvalid, g_ign, thresholds)
+
+        return jax.vmap(per_area)(g_ign_areas)  # (A, T, D) x2
+
+    dm, mi = jax.vmap(per_pair)(ious, det_valid, gt_valid, gt_ign)  # (P, A, T, D)
+    # det_ignore: matched an area-ignored gt, or unmatched and outside the range
+    num_t = mi.shape[2]
+    gt_ign_b = jnp.broadcast_to(gt_ign[:, :, None, :], gt_ign.shape[:2] + (num_t, gt_ign.shape[2]))
+    matched_gt_ign = jnp.take_along_axis(gt_ign_b, jnp.clip(mi, 0, None), axis=3)
+    det_ignore = jnp.where(dm, matched_gt_ign, det_area_out[:, :, None, :])
+    return dm, det_ignore, gt_ign & gt_valid[:, None, :]
+
+
 class MAP(Metric):
     """COCO mean average precision/recall for object detection."""
 
@@ -130,12 +232,16 @@ class MAP(Metric):
         rec_thresholds: Optional[List[float]] = None,
         max_detection_thresholds: Optional[List[int]] = None,
         class_metrics: bool = False,
+        matching: str = "device",
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
         allowed_box_formats = ("xyxy", "xywh", "cxcywh")
         if box_format not in allowed_box_formats:
             raise ValueError(f"Expected argument `box_format` to be one of {allowed_box_formats} but got {box_format}")
+        if matching not in ("device", "host"):
+            raise ValueError("Expected argument `matching` to be 'device' or 'host'")
+        self.matching = matching
         self.box_format = box_format
         self.iou_thresholds = list(iou_thresholds) if iou_thresholds is not None else list(
             np.round(np.arange(0.5, 1.0, 0.05), 2)
@@ -164,16 +270,12 @@ class MAP(Metric):
         """Add one batch of per-image detection/groundtruth dicts."""
         _input_validator(preds, target)
         for item in preds:
-            self.detection_boxes.append(
-                _fix_empty_tensors(box_convert(jnp.asarray(item["boxes"]), in_fmt=self.box_format))
-            )
-            self.detection_labels.append(jnp.ravel(jnp.asarray(item["labels"])))
-            self.detection_scores.append(jnp.ravel(jnp.asarray(item["scores"])))
+            self.detection_boxes.append(_box_convert_np(item["boxes"], self.box_format))
+            self.detection_labels.append(np.ravel(np.asarray(item["labels"])))
+            self.detection_scores.append(np.ravel(np.asarray(item["scores"])))
         for item in target:
-            self.groundtruth_boxes.append(
-                _fix_empty_tensors(box_convert(jnp.asarray(item["boxes"]), in_fmt=self.box_format))
-            )
-            self.groundtruth_labels.append(jnp.ravel(jnp.asarray(item["labels"])))
+            self.groundtruth_boxes.append(_box_convert_np(item["boxes"], self.box_format))
+            self.groundtruth_labels.append(np.ravel(np.asarray(item["labels"])))
 
     # ------------------------------------------------------------------ internals
 
@@ -205,7 +307,8 @@ class MAP(Metric):
         if len(gt) == 0 and len(det) == 0:
             return None
 
-        areas = np.asarray(box_area(jnp.asarray(gt.reshape(-1, 4)))) if len(gt) else np.zeros(0)
+        gt2 = np.asarray(gt).reshape(-1, 4)
+        areas = (gt2[:, 2] - gt2[:, 0]) * (gt2[:, 3] - gt2[:, 1]) if len(gt) else np.zeros(0)
         ignore_area = (areas < area_range[0]) | (areas > area_range[1])
         gtind = np.argsort(ignore_area.astype(np.uint8), kind="stable")  # ignored gts last
         gt = gt[gtind]
@@ -241,7 +344,8 @@ class MAP(Metric):
                         gt_matches[idx_iou, match_id] = True
 
         # unmatched detections outside the area range are ignored
-        det_areas = np.asarray(box_area(jnp.asarray(det.reshape(-1, 4)))) if nb_det else np.zeros(0)
+        det2 = np.asarray(det).reshape(-1, 4)
+        det_areas = (det2[:, 2] - det2[:, 0]) * (det2[:, 3] - det2[:, 1]) if nb_det else np.zeros(0)
         det_ignore_area = (det_areas < area_range[0]) | (det_areas > area_range[1])
         det_ignore = det_ignore | (~det_matches & det_ignore_area[None, :])
 
@@ -278,29 +382,92 @@ class MAP(Metric):
         valid = prec[prec > -1]
         return jnp.asarray(-1.0) if valid.size == 0 else jnp.asarray(float(np.mean(valid)))
 
+    def _device_eval_imgs(self, class_ids: List[int], max_detections: int) -> List[Optional[Dict]]:
+        """All (image, class) matching in one jitted call + ONE host transfer.
+
+        Produces the same ``[class, area, image]``-ordered eval-dict list as the
+        host path (``_evaluate_image``), so the accumulation is shared.
+        """
+        img_ids = list(range(len(self.groundtruth_boxes)))
+        area_ranges = list(self.bbox_area_ranges.values())
+        nb_areas = len(area_ranges)
+
+        # host: slice/sort the ragged states into padded (P, D/G) batches
+        pairs: List[Tuple[int, int]] = [(c, i) for c in range(len(class_ids)) for i in img_ids]
+        per_pair = [
+            self._img_class_arrays(i, class_ids[c], max_detections) for c, i in pairs
+        ]
+        nd = np.asarray([len(det) for _, det, _ in per_pair])
+        ng = np.asarray([len(gt) for gt, _, _ in per_pair])
+        dim_d, dim_g = max(1, int(nd.max(initial=0))), max(1, int(ng.max(initial=0)))
+
+        det_boxes = np.zeros((len(pairs), dim_d, 4), np.float32)
+        det_scores = np.zeros((len(pairs), dim_d), np.float32)
+        gt_boxes = np.zeros((len(pairs), dim_g, 4), np.float32)
+        for p, (gt, det, scores) in enumerate(per_pair):
+            det_boxes[p, : len(det)] = det.reshape(-1, 4)
+            det_scores[p, : len(det)] = scores
+            gt_boxes[p, : len(gt)] = gt.reshape(-1, 4)
+        det_valid = np.arange(dim_d)[None, :] < nd[:, None]
+        gt_valid = np.arange(dim_g)[None, :] < ng[:, None]
+
+        dm, det_ignore, gt_ign = _match_all_pairs(
+            jnp.asarray(det_boxes),
+            jnp.asarray(det_valid),
+            jnp.asarray(gt_boxes),
+            jnp.asarray(gt_valid),
+            jnp.asarray(self.iou_thresholds, dtype=jnp.float32),
+            jnp.asarray([list(r) for r in area_ranges], dtype=jnp.float32),
+        )
+        # the single device -> host transfer
+        dm, det_ignore, gt_ign = np.asarray(dm), np.asarray(det_ignore), np.asarray(gt_ign)
+
+        eval_imgs: List[Optional[Dict]] = []
+        nb_imgs = len(img_ids)
+        for idx_cls in range(len(class_ids)):
+            for idx_area in range(nb_areas):
+                for idx_img in range(nb_imgs):
+                    p = idx_cls * nb_imgs + idx_img
+                    n_det, n_gt = int(nd[p]), int(ng[p])
+                    if n_det == 0 and n_gt == 0:
+                        eval_imgs.append(None)
+                        continue
+                    eval_imgs.append(
+                        {
+                            "dtMatches": dm[p, idx_area, :, :n_det],
+                            "dtScores": det_scores[p, :n_det],
+                            "gtIgnore": gt_ign[p, idx_area, :n_gt],
+                            "dtIgnore": det_ignore[p, idx_area, :, :n_det],
+                        }
+                    )
+        return eval_imgs
+
     def _calculate(self, class_ids: List[int]) -> Tuple[Dict, MAPMetricResults, MARMetricResults]:
         img_ids = list(range(len(self.groundtruth_boxes)))
         max_detections = self.max_detection_thresholds[-1]
         area_ranges = list(self.bbox_area_ranges.values())
 
-        # IoU matrices on device, gathered to host once
-        ious = {}
-        for idx in img_ids:
-            for class_id in class_ids:
-                gt, det, _ = self._img_class_arrays(idx, class_id, max_detections)
-                if len(gt) and len(det):
-                    ious[(idx, class_id)] = np.asarray(
-                        box_iou(jnp.asarray(det.reshape(-1, 4)), jnp.asarray(gt.reshape(-1, 4)))
-                    )
-                else:
-                    ious[(idx, class_id)] = np.zeros((len(det), len(gt)))
+        if self.matching == "device" and class_ids:
+            eval_imgs = self._device_eval_imgs(class_ids, max_detections)
+        else:
+            # host oracle path: per-image IoU + python greedy matching
+            ious = {}
+            for idx in img_ids:
+                for class_id in class_ids:
+                    gt, det, _ = self._img_class_arrays(idx, class_id, max_detections)
+                    if len(gt) and len(det):
+                        ious[(idx, class_id)] = np.asarray(
+                            box_iou(jnp.asarray(det.reshape(-1, 4)), jnp.asarray(gt.reshape(-1, 4)))
+                        )
+                    else:
+                        ious[(idx, class_id)] = np.zeros((len(det), len(gt)))
 
-        eval_imgs = [
-            self._evaluate_image(img_id, class_id, area, max_detections, ious)
-            for class_id in class_ids
-            for area in area_ranges
-            for img_id in img_ids
-        ]
+            eval_imgs = [
+                self._evaluate_image(img_id, class_id, area, max_detections, ious)
+                for class_id in class_ids
+                for area in area_ranges
+                for img_id in img_ids
+            ]
 
         nb_iou_thrs = len(self.iou_thresholds)
         nb_rec_thrs = len(self.rec_thresholds)

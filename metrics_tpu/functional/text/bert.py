@@ -107,6 +107,11 @@ def bert_score(
     ``model`` (a flax module apply-able on (ids, mask)); ``model_name_or_path`` (a
     LOCAL HF Flax checkpoint). Tokenization uses ``user_tokenizer`` (HF-compatible,
     ``__call__`` returning input_ids/attention_mask) or a whitespace fallback.
+
+    To use a pretrained torch BERT offline, convert it once
+    (``python tools/convert_weights.py bert <torch_dir> <flax_dir>``) and pass
+    ``model_name_or_path=<flax_dir>`` with its tokenizer — the full local pipeline
+    is exercised in ``tests/text/test_bert_e2e.py``.
     """
     if len(predictions) != len(references):
         raise ValueError("Number of predicted and reference sentences must be the same!")

@@ -55,6 +55,17 @@ class FID(Metric):
             ``imgs -> (N, d)`` feature extractor.
         params: optional flax params for the built-in InceptionV3 (converted
             pretrained weights; random init otherwise).
+
+    Pretrained weights (the reference downloads them at runtime via torch-fidelity,
+    ``fid.py:242``; this build converts them offline — conversion numerically
+    verified in ``tests/tools/test_convert.py``)::
+
+        # once, anywhere with the torch-fidelity checkpoint:
+        python tools/convert_weights.py inception pt_inception-2015-12-05.pth inception_flax.pkl
+        # then:
+        from metrics_tpu.models.inception import InceptionFeatureExtractor
+        fid = FrechetInceptionDistance(
+            params=InceptionFeatureExtractor.load_params("inception_flax.pkl"))
     """
 
     is_differentiable = False

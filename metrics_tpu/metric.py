@@ -163,9 +163,15 @@ class Metric:
     # ------------------------------------------------------------- functional core API
 
     def init_state(self) -> Dict[str, Any]:
-        """Fresh state pytree (a dict: name -> array or list of arrays)."""
+        """Fresh state pytree (a dict: name -> array or list of arrays).
+
+        Leaves are COPIES: two states sharing a zeros-default must not alias the
+        same buffer, or a jit step with donated state fails with
+        "attempt to donate the same buffer twice".
+        """
         return {
-            k: (v if isinstance(v, jax.Array) else list(v)) for k, v in self._defaults.items()
+            k: (jnp.array(v) if isinstance(v, jax.Array) else list(v))
+            for k, v in self._defaults.items()
         }
 
     def _pack_state(self) -> Dict[str, Any]:
@@ -173,7 +179,9 @@ class Metric:
 
     def _load_state(self, state: Dict[str, Any]) -> None:
         for k, v in state.items():
-            setattr(self, k, v if isinstance(v, jax.Array) else list(v))
+            # list states copy shallowly; array-likes (jax, numpy — e.g. from
+            # jax.device_get or a checkpoint) pass through as-is
+            setattr(self, k, list(v) if isinstance(v, (list, tuple)) else v)
 
     def update_state(self, state: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:
         """Pure update: ``new_state = f(state, batch)``. Safe inside jit/scan/shard_map.
@@ -214,16 +222,21 @@ class Metric:
             return state
         # pre-cat list states
         prepped: Dict[str, Any] = {}
+        was_list: Dict[str, bool] = {}
         for k, v in state.items():
-            prepped[k] = dim_zero_cat(v) if isinstance(v, list) else v
+            was_list[k] = isinstance(v, list)
+            prepped[k] = dim_zero_cat(v) if was_list[k] else v
         if self.dist_sync_fn is not None:
             return {k: self.dist_sync_fn(self._reductions[k], v, axis_name) for k, v in prepped.items()}
         keys = list(prepped)
-        synced = fused_axis_sync([(self._reductions[k], prepped[k]) for k in keys], axis_name)
-        out = dict(zip(keys, synced))
-        # reference metric.py:249-252: gathered list states stay flattened (cat'd);
-        # tensor states under None arrive stacked (world, ...) — handled by all_gather_stack
-        return out
+        # reference metric.py:249-252: gathered list states stay FLATTENED (tiled
+        # cat gather); only tensor states under fx=None arrive stacked (world, ...)
+        fxs = [
+            ("cat" if self._reductions[k] is None and was_list[k] else self._reductions[k])
+            for k in keys
+        ]
+        synced = fused_axis_sync(list(zip(fxs, (prepped[k] for k in keys))), axis_name)
+        return dict(zip(keys, synced))
 
     def merge_states(self, a: Dict[str, Any], b: Dict[str, Any]) -> Dict[str, Any]:
         """Pairwise merge of two state pytrees (pure). Sum/min/max/cat are canned;

@@ -1,0 +1,91 @@
+"""Differentiability + bf16 precision harness runs for classification + regression.
+
+Reference ``tests/helpers/testers.py:469-557``: fp16 precision runs and
+``run_differentiability_test`` (gradcheck + is_differentiable consistency). Here:
+``jax.grad`` vs central differences for every ``is_differentiable`` functional,
+zero-gradient assertion for counter metrics, and bf16 (the TPU-native half
+precision) input runs with documented tolerances.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu
+from metrics_tpu import functional as F
+from tests.helpers import seed_all
+from tests.helpers.testers import MetricTester
+
+seed_all(3)
+
+B = 16
+N_CLASSES = 4
+
+_probs = np.random.rand(2, B, N_CLASSES).astype(np.float32)
+_probs /= _probs.sum(-1, keepdims=True)
+_labels = np.random.randint(0, N_CLASSES, (2, B))
+_binary_logits = np.random.randn(2, B).astype(np.float32)
+_binary_labels = np.random.randint(0, 2, (2, B))
+_reg_preds = np.random.randn(2, B).astype(np.float32)
+_reg_target = (np.random.randn(2, B) * 0.5 + _reg_preds).astype(np.float32)
+_pos_preds = np.abs(_reg_preds) + 0.5
+_pos_target = np.abs(_reg_target) + 0.5
+
+
+class TestDifferentiability(MetricTester):
+    @pytest.mark.parametrize(
+        "metric_class,functional,preds,target,args",
+        [
+            (metrics_tpu.MeanSquaredError, F.mean_squared_error, _reg_preds, _reg_target, {}),
+            (metrics_tpu.MeanAbsoluteError, F.mean_absolute_error, _reg_preds, _reg_target, {}),
+            (metrics_tpu.MeanSquaredLogError, F.mean_squared_log_error, _pos_preds, _pos_target, {}),
+            (metrics_tpu.MeanAbsolutePercentageError, F.mean_absolute_percentage_error, _reg_preds, _pos_target, {}),
+            (metrics_tpu.ExplainedVariance, F.explained_variance, _reg_preds, _reg_target, {}),
+            (metrics_tpu.PearsonCorrCoef, F.pearson_corrcoef, _reg_preds, _reg_target, {}),
+            (metrics_tpu.R2Score, F.r2_score, _reg_preds, _reg_target, {}),
+            (metrics_tpu.CosineSimilarity, F.cosine_similarity, _reg_preds + 1.2, _pos_target, {}),
+            (metrics_tpu.TweedieDevianceScore, F.tweedie_deviance_score, _pos_preds, _pos_target, {}),
+            (metrics_tpu.HingeLoss, F.hinge_loss, _binary_logits, _binary_labels, {}),
+        ],
+    )
+    def test_differentiable_metrics(self, metric_class, functional, preds, target, args):
+        self.run_differentiability_test(preds, target, metric_class, functional, metric_args=args)
+
+    @pytest.mark.parametrize(
+        "metric_class,functional,preds,target,args",
+        [
+            (metrics_tpu.Accuracy, F.accuracy, _probs, _labels, {}),
+            (metrics_tpu.F1Score, F.f1_score, _probs, _labels, {"num_classes": N_CLASSES}),
+            (metrics_tpu.StatScores, F.stat_scores, _probs, _labels, {}),
+        ],
+    )
+    def test_counter_metrics_zero_grad(self, metric_class, functional, preds, target, args):
+        self.run_differentiability_test(preds, target, metric_class, functional, metric_args=args)
+
+
+class TestBf16Precision(MetricTester):
+    @pytest.mark.parametrize(
+        "functional,preds,target,args,kwargs",
+        [
+            (F.mean_squared_error, _reg_preds, _reg_target, {}, {"cast_target": True}),
+            (F.mean_absolute_error, _reg_preds, _reg_target, {}, {"cast_target": True}),
+            (F.r2_score, _reg_preds, _reg_target, {}, {"cast_target": True}),
+            (F.pearson_corrcoef, _reg_preds, _reg_target, {}, {"cast_target": True, "atol": 5e-2}),
+            (F.accuracy, _probs, _labels, {}, {}),
+            (F.f1_score, _probs, _labels, {"num_classes": N_CLASSES}, {}),
+            (F.confusion_matrix, _probs, _labels, {"num_classes": N_CLASSES}, {}),
+            (F.hinge_loss, _binary_logits, _binary_labels, {}, {}),
+            (F.psnr, None, None, {}, {}),  # replaced below
+        ][:-1],
+    )
+    def test_bf16(self, functional, preds, target, args, kwargs):
+        self.run_precision_test(preds, target, functional, metric_args=args, **kwargs)
+
+    def test_bf16_image(self):
+        rng = np.random.RandomState(0)
+        img_a = rng.rand(1, 2, 1, 16, 16).astype(np.float32)
+        img_b = np.clip(img_a + rng.randn(*img_a.shape) * 0.05, 0, 1).astype(np.float32)
+        self.run_precision_test(img_a, img_b, F.psnr, {"data_range": 1.0},
+                                cast_target=True, atol=5e-2, rtol=5e-2)
+        self.run_precision_test(
+            img_a, img_b, F.ssim, {"data_range": 1.0}, cast_target=True, atol=5e-2, rtol=5e-2
+        )

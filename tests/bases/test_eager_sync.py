@@ -173,16 +173,12 @@ def test_checkpoint_collection_roundtrip(tmp_path):
     np.testing.assert_allclose(float(coll2["s"].x), 3.0)
 
 
-def test_checkpoint_synced_save_keeps_local(tmp_path, fake_multihost, monkeypatch):
+def test_checkpoint_synced_save_keeps_local(tmp_path, fake_multihost):
     """synced=True writes merged state without disturbing local accumulation.
 
     Outside a mapped context sync_states is a no-op, so route the synced save
     through the eager multihost merge to emulate a multi-process host.
     """
-    import metrics_tpu.utils.checkpoint as ckpt
-
-    orig_save = ckpt.save_metric_state
-
     m = DummyMetricSum()
     m.update(jnp.asarray(2.0))
 
@@ -191,7 +187,7 @@ def test_checkpoint_synced_save_keeps_local(tmp_path, fake_multihost, monkeypatc
     path = str(tmp_path / "synced")
     state_backup = m._pack_state()
     m._load_state(merged)
-    orig_save(m, path)
+    save_metric_state(m, path)
     m._load_state(state_backup)
 
     np.testing.assert_allclose(np.asarray(m.x), 2.0)  # local untouched

@@ -196,6 +196,73 @@ class MetricTester:
         expected = sk_metric(total_pred, total_target, **all_extra, **extra_static)
         _assert_allclose(result, expected, atol=atol)
 
+    # ------------------------------------------------------- differentiability / bf16
+
+    def run_differentiability_test(
+        self,
+        preds,
+        target,
+        metric_class,
+        metric_functional: Callable,
+        metric_args: Optional[dict] = None,
+    ) -> None:
+        """jax.grad through the functional must match finite differences when the
+        class declares ``is_differentiable`` (reference ``testers.py:527-557``'s
+        gradcheck); non-differentiable metrics must declare the flag False and
+        their (counter-based) grads w.r.t. preds are identically zero."""
+        metric_args = metric_args or {}
+        metric = metric_class(**metric_args)
+        p0 = jnp.asarray(preds[0], dtype=jnp.float32)
+        t0 = jnp.asarray(target[0])
+
+        def scalar_fn(p):
+            out = jnp.asarray(metric_functional(p, t0, **metric_args))
+            # integer outputs (pure counters) get a float surrogate so grad traces;
+            # their gradient w.r.t. preds is still identically zero
+            return jnp.sum(out.astype(jnp.float32))
+
+        grads = jax.grad(scalar_fn)(p0)
+        assert np.all(np.isfinite(np.asarray(grads))), "non-finite gradients"
+        if not metric.is_differentiable:
+            # comparison/counter formulations have zero gradient everywhere
+            np.testing.assert_allclose(np.asarray(grads), 0.0)
+            return
+        # central-difference check on a handful of coordinates (f32: loose tol)
+        rng = np.random.RandomState(0)
+        flat = np.asarray(p0, dtype=np.float32).ravel()
+        eps = 1e-2
+        for idx in rng.choice(flat.size, size=min(5, flat.size), replace=False):
+            bump = np.zeros_like(flat)
+            bump[idx] = eps
+            up = scalar_fn(jnp.asarray((flat + bump).reshape(p0.shape)))
+            dn = scalar_fn(jnp.asarray((flat - bump).reshape(p0.shape)))
+            num = (float(up) - float(dn)) / (2 * eps)
+            ana = float(np.asarray(grads).ravel()[idx])
+            np.testing.assert_allclose(ana, num, rtol=5e-2, atol=5e-3)
+
+    def run_precision_test(
+        self,
+        preds,
+        target,
+        metric_functional: Callable,
+        metric_args: Optional[dict] = None,
+        atol: float = 2e-2,
+        rtol: float = 2e-2,
+        cast_target: bool = False,
+    ) -> None:
+        """bf16 inputs produce results close to f32 (bf16 is the TPU-native half
+        precision — the analogue of reference ``testers.py:469-524``'s fp16 runs)."""
+        metric_args = metric_args or {}
+        p0 = jnp.asarray(preds[0])
+        t0 = jnp.asarray(target[0])
+        full = np.asarray(metric_functional(p0.astype(jnp.float32),
+                                            t0.astype(jnp.float32) if cast_target else t0,
+                                            **metric_args), dtype=np.float32)
+        half = np.asarray(metric_functional(p0.astype(jnp.bfloat16),
+                                            t0.astype(jnp.bfloat16) if cast_target else t0,
+                                            **metric_args), dtype=np.float32)
+        np.testing.assert_allclose(half, full, atol=atol, rtol=rtol)
+
     # ---------------------------------------------------------------------- jit check
 
     def run_jit_test(

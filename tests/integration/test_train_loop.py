@@ -1,0 +1,141 @@
+"""Training-loop integration: pjit/optax + MetricCollection fused sync + resume.
+
+VERDICT r1 next #10 — the TPU analogue of the reference's Lightning interop proof
+(``integrations/test_lightning.py:51``): a real train-eval loop where
+
+  * the model trains data-parallel over the 8-device mesh under ``jax.jit`` with
+    sharding constraints (pjit-style),
+  * metric state lives INSIDE the compiled step — update + fused collective sync
+    compile into the same XLA program as the optimizer step,
+  * metric values match a single-device run on the same data exactly,
+  * checkpoint/resume of metric state mid-epoch reproduces the uninterrupted run.
+"""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from metrics_tpu import Accuracy, F1Score, MeanMetric, MetricCollection
+from metrics_tpu.utils.checkpoint import load_metric_state, save_metric_state
+
+N_DEV = 8
+BATCH = 64  # global batch, 8 per device
+DIM = 16
+N_CLASSES = 4
+STEPS = 6
+
+
+def _data(steps=STEPS, seed=0):
+    rng = np.random.RandomState(seed)
+    w_true = rng.randn(DIM, N_CLASSES).astype(np.float32)
+    xs = rng.randn(steps, BATCH, DIM).astype(np.float32)
+    logits = xs @ w_true + rng.randn(steps, BATCH, N_CLASSES) * 0.1
+    ys = logits.argmax(-1)
+    return xs, ys.astype(np.int32)
+
+
+def _make_collection():
+    # positional (preds, target) metrics share the collection; the loss MeanMetric
+    # updates separately (different signature — same split the reference makes)
+    return MetricCollection(
+        {
+            "acc": Accuracy(),
+            "f1": F1Score(num_classes=N_CLASSES, average="macro"),
+        }
+    )
+
+
+def _loss_fn(params, x, y):
+    logits = x @ params["w"] + params["b"]
+    one_hot = jax.nn.one_hot(y, N_CLASSES)
+    loss = optax.softmax_cross_entropy(logits, one_hot).mean()
+    return loss, jax.nn.softmax(logits)
+
+
+def _run_loop(mesh, xs, ys, resume_at=None, ckpt_path=None):
+    """Train on a mesh; metric update+sync inside the jitted step. Returns
+    (metric values dict, final params)."""
+    coll = _make_collection()
+    loss_metric = MeanMetric()
+    tx = optax.sgd(0.1)
+    params = {"w": jnp.zeros((DIM, N_CLASSES)), "b": jnp.zeros(N_CLASSES)}
+    opt_state = tx.init(params)
+
+    data_sharding = NamedSharding(mesh, P("dp"))
+    rep = NamedSharding(mesh, P())
+
+    @partial(jax.jit, donate_argnums=(0, 1, 2))
+    def step(params, opt_state, mstate, x, y):
+        (loss, probs), grads = jax.value_and_grad(_loss_fn, has_aux=True)(params, x, y)
+        updates, opt_state = tx.update(grads, opt_state)
+        params = optax.apply_updates(params, updates)
+        # metric update compiles into the SAME program as the optimizer step;
+        # states are replicated, batch is dp-sharded — XLA inserts the reductions
+        mstate = dict(mstate)
+        lstate = mstate.pop("loss")
+        mstate = coll.update_state(mstate, probs, y)
+        mstate["loss"] = loss_metric.update_state(lstate, loss)
+        return params, opt_state, mstate
+
+    mstate = coll.init_state()
+    mstate["loss"] = loss_metric.init_state()
+    for i in range(xs.shape[0]):
+        if resume_at is not None and i == resume_at:
+            # simulate preemption: metric state restored from the checkpoint
+            coll2 = _make_collection()
+            load_metric_state(coll2, ckpt_path)
+            mstate = {k: m._pack_state() for k, m in coll2.items(keep_base=True)}
+            lm2 = MeanMetric()
+            load_metric_state(lm2, ckpt_path + ".loss")
+            mstate["loss"] = lm2._pack_state()
+            mstate = jax.device_put(mstate, rep)
+        x = jax.device_put(jnp.asarray(xs[i]), data_sharding)
+        y = jax.device_put(jnp.asarray(ys[i]), data_sharding)
+        params, opt_state, mstate = step(params, opt_state, mstate, x, y)
+        if ckpt_path is not None and resume_at is not None and i == resume_at - 1:
+            # save via the collection facade (states loaded from the live pytree)
+            for k, m in coll.items(keep_base=True):
+                m._load_state(jax.device_get(mstate[k]))
+            save_metric_state(coll, ckpt_path)
+            loss_metric._load_state(jax.device_get(mstate["loss"]))
+            save_metric_state(loss_metric, ckpt_path + ".loss")
+    values = {k: coll[k].compute_from(jax.device_get(mstate[k])) for k in mstate if k != "loss"}
+    values["loss"] = loss_metric.compute_from(jax.device_get(mstate["loss"]))
+    return values, jax.device_get(params)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return Mesh(np.asarray(jax.devices()), ("dp",))
+
+
+def test_mesh_loop_matches_single_device(mesh, devices):
+    xs, ys = _data()
+    mesh_vals, mesh_params = _run_loop(mesh, xs, ys)
+
+    # single-device oracle: identical loop, trivial mesh
+    solo_mesh = Mesh(np.asarray(jax.devices()[:1]), ("dp",))
+    solo_vals, solo_params = _run_loop(solo_mesh, xs, ys)
+
+    np.testing.assert_allclose(np.asarray(mesh_params["w"]), np.asarray(solo_params["w"]), atol=1e-5)
+    for k in ("acc", "f1", "loss"):
+        np.testing.assert_allclose(
+            float(mesh_vals[k]), float(solo_vals[k]), atol=1e-6, err_msg=k
+        )
+    # trained model should actually have learned something
+    assert float(mesh_vals["acc"]) > 0.5
+
+
+def test_checkpoint_resume_reproduces_run(mesh, devices, tmp_path):
+    xs, ys = _data(seed=1)
+    base_vals, _ = _run_loop(mesh, xs, ys)
+    ckpt = str(tmp_path / "mstate")
+    resumed_vals, _ = _run_loop(mesh, xs, ys, resume_at=3, ckpt_path=ckpt)
+    for k in ("acc", "f1", "loss"):
+        np.testing.assert_allclose(
+            float(resumed_vals[k]), float(base_vals[k]), atol=1e-6, err_msg=k
+        )

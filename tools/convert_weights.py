@@ -1,0 +1,228 @@
+"""Pretrained-weight conversion: torch checkpoints -> flax pytrees for the
+embedded models (InceptionV3 for FID/IS/KID, BERT for BERTScore).
+
+The reference obtains weights over the network at runtime (torch-fidelity for
+InceptionV3, ``torchmetrics/image/fid.py:242``; HF hub for BERT,
+``functional/text/bert.py:23,256``). This build is zero-egress, so conversion is
+an offline step:
+
+InceptionV3 (FID variant, 1008-way logits)::
+
+    # on any machine with the torch-fidelity checkpoint downloaded:
+    python tools/convert_weights.py inception pt_inception-2015-12-05.pth inception_flax.pkl
+    # then:
+    from metrics_tpu.models.inception import InceptionFeatureExtractor
+    fid = FrechetInceptionDistance(params=InceptionFeatureExtractor.load_params("inception_flax.pkl"))
+
+BERT (any HF bert-style encoder)::
+
+    python tools/convert_weights.py bert /path/to/hf_torch_model /path/to/out_flax
+    # then: BERTScore(model_name_or_path="/path/to/out_flax")
+
+Conversion rules (tested numerically in ``tests/tools/test_convert.py``):
+  * torch Conv2d weight ``(O, I, kH, kW)``    -> flax Conv kernel ``(kH, kW, I, O)``
+  * torch Linear weight ``(O, I)``            -> flax Dense kernel ``(I, O)``
+  * torch BatchNorm weight/bias              -> flax params scale/bias
+  * torch BatchNorm running_mean/running_var -> flax batch_stats mean/var
+  * ``num_batches_tracked`` is dropped
+
+The Inception mapping is ORDER-based: torch state dicts preserve module
+definition order, and the flax module mirrors torch-fidelity's definition order
+exactly, so conv/bn groups zip one-to-one. Every leaf is shape-checked; a
+mismatch raises with both names.
+"""
+import argparse
+import os
+import pickle
+import re
+import sys
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+# make the tool runnable from any cwd: the repo root is this file's parent dir
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+# --------------------------------------------------------------------- leaf rules
+
+def torch_conv_kernel(w: np.ndarray) -> np.ndarray:
+    """(O, I, kH, kW) -> (kH, kW, I, O)."""
+    return np.transpose(np.asarray(w), (2, 3, 1, 0))
+
+
+def torch_linear_kernel(w: np.ndarray) -> np.ndarray:
+    """(O, I) -> (I, O)."""
+    return np.transpose(np.asarray(w), (1, 0))
+
+
+# ----------------------------------------------------- ordered flax-tree traversal
+
+def _natural_key(s: str):
+    return [int(p) if p.isdigit() else p for p in re.split(r"(\d+)", s)]
+
+
+def _walk(tree: Any, path: Tuple[str, ...] = ()) -> List[Tuple[Tuple[str, ...], np.ndarray]]:
+    """Flatten a nested dict in module-definition order (natural sort of the
+    auto-numbered flax names, so BasicConv2d_10 sorts after BasicConv2d_9)."""
+    out: List[Tuple[Tuple[str, ...], np.ndarray]] = []
+    if isinstance(tree, dict) or hasattr(tree, "items"):
+        for k in sorted(tree.keys(), key=_natural_key):
+            out.extend(_walk(tree[k], path + (k,)))
+    else:
+        out.append((path, np.asarray(tree)))
+    return out
+
+
+def _set_in(tree: Dict, path: Tuple[str, ...], value: np.ndarray) -> None:
+    node = tree
+    for k in path[:-1]:
+        node = node[k]
+    node[path[-1]] = value
+
+
+def _to_mutable(tree: Any) -> Any:
+    if hasattr(tree, "items"):
+        return {k: _to_mutable(v) for k, v in tree.items()}
+    return tree
+
+
+# ------------------------------------------------------------- conv/bn stack zipper
+
+def convert_conv_bn_model(
+    torch_state: Dict[str, np.ndarray], flax_template: Dict[str, Any]
+) -> Dict[str, Any]:
+    """Fill a flax {'params', 'batch_stats'} template from a torch state dict of a
+    conv/BN/linear stack with matching definition order.
+
+    The torch dict is scanned in order; conv weights, bn 4-tuples and linear
+    weights are matched against the template's ordered leaves per collection.
+    """
+    template = _to_mutable(flax_template)
+
+    # ordered leaf slots, with the collection baked into the path
+    param_leaves = [(("params",) + p, v) for p, v in _walk(template.get("params", {}))]
+    stat_leaves = [(("batch_stats",) + p, v) for p, v in _walk(template.get("batch_stats", {}))]
+
+    slots = {
+        "kernel": [(p, v) for p, v in param_leaves if p[-1] == "kernel"],
+        "scale": [(p, v) for p, v in param_leaves if p[-1] == "scale"],
+        "bias": [(p, v) for p, v in param_leaves if p[-1] == "bias"],
+        "mean": [(p, v) for p, v in stat_leaves if p[-1] == "mean"],
+        "var": [(p, v) for p, v in stat_leaves if p[-1] == "var"],
+    }
+    cursor = {k: 0 for k in slots}
+
+    def take(kind: str, torch_name: str, converted: np.ndarray) -> None:
+        if cursor[kind] >= len(slots[kind]):
+            raise ValueError(f"no {kind} slot left for torch entry {torch_name}")
+        path, slot = slots[kind][cursor[kind]]
+        cursor[kind] += 1
+        if tuple(converted.shape) != tuple(np.shape(slot)):
+            raise ValueError(
+                f"shape mismatch: torch {torch_name} -> {converted.shape} "
+                f"vs flax {'/'.join(path)} {np.shape(slot)}"
+            )
+        _set_in(template, path, converted)
+
+    for name, value in torch_state.items():
+        value = np.asarray(value)
+        if name.endswith("num_batches_tracked"):
+            continue
+        if name.endswith(".weight") and value.ndim == 4:
+            take("kernel", name, torch_conv_kernel(value))
+        elif name.endswith(".weight") and value.ndim == 2:
+            take("kernel", name, torch_linear_kernel(value))
+        elif name.endswith(".weight") and value.ndim == 1:  # bn gamma
+            take("scale", name, value)
+        elif name.endswith(".bias"):
+            take("bias", name, value)
+        elif name.endswith(".running_mean"):
+            take("mean", name, value)
+        elif name.endswith(".running_var"):
+            take("var", name, value)
+        else:
+            raise ValueError(f"unrecognised torch entry: {name} {value.shape}")
+    unfilled = {k: f"{cursor[k]}/{len(slots[k])}" for k in slots if cursor[k] != len(slots[k])}
+    if unfilled:
+        raise ValueError(f"unfilled flax slots: {unfilled}")
+    return template
+
+
+# ------------------------------------------------------------------ inception entry
+
+def convert_inception(torch_ckpt_path: str, out_path: str, num_classes: int = 1008) -> None:
+    """torch-fidelity ``pt_inception`` checkpoint -> flax variables for
+    ``metrics_tpu.models.inception.InceptionV3``."""
+    import torch
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu.models.inception import InceptionV3
+
+    state = torch.load(torch_ckpt_path, map_location="cpu", weights_only=True)
+    if hasattr(state, "state_dict"):
+        state = state.state_dict()
+    state_np = {k: v.numpy() for k, v in state.items()}
+
+    # cheap sanity check BEFORE the (expensive) template init: the FID inception
+    # has exactly 94 convs + 1 fc
+    n_convs = sum(1 for v in state_np.values() if np.ndim(v) == 4)
+    if n_convs != 94:
+        raise ValueError(
+            f"{torch_ckpt_path} does not look like a torch-fidelity InceptionV3 "
+            f"checkpoint: found {n_convs} conv weights, expected 94"
+        )
+
+    module = InceptionV3(num_classes=num_classes)
+    # conversion is an offline host step — build the template on CPU so it doesn't
+    # hold (or wait for) an accelerator
+    with jax.default_device(jax.devices("cpu")[0]):
+        template = module.init(jax.random.PRNGKey(0), jnp.zeros((1, 299, 299, 3)))
+    # torch-fidelity's fc carries a bias the reference drops ('logits_unbiased');
+    # our Dense is bias-free — drop it before the zip
+    state_np = {k: v for k, v in state_np.items() if not re.search(r"fc\.bias$", k)}
+    variables = convert_conv_bn_model(state_np, template)
+    with open(out_path, "wb") as f:
+        pickle.dump(variables, f)
+    print(f"wrote {out_path}")
+
+
+# ----------------------------------------------------------------------- bert entry
+
+def convert_bert(torch_model_dir: str, out_dir: str) -> None:
+    """HF torch BERT checkpoint directory -> flax checkpoint directory.
+
+    Rides transformers' own pt->flax converter (the same machinery HF uses for
+    `from_pt=True`), entirely offline given a local torch checkpoint.
+    """
+    from transformers import AutoTokenizer, FlaxAutoModel
+
+    model = FlaxAutoModel.from_pretrained(torch_model_dir, from_pt=True)
+    model.save_pretrained(out_dir)
+    try:
+        AutoTokenizer.from_pretrained(torch_model_dir).save_pretrained(out_dir)
+    except Exception:
+        print("note: no tokenizer found next to the torch checkpoint; copy it separately")
+    print(f"wrote {out_dir}")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p1 = sub.add_parser("inception", help="torch-fidelity pt_inception*.pth -> flax pkl")
+    p1.add_argument("torch_ckpt")
+    p1.add_argument("out_pkl")
+    p1.add_argument("--num-classes", type=int, default=1008)
+    p2 = sub.add_parser("bert", help="HF torch model dir -> flax model dir")
+    p2.add_argument("torch_model_dir")
+    p2.add_argument("out_dir")
+    args = ap.parse_args()
+    if args.cmd == "inception":
+        convert_inception(args.torch_ckpt, args.out_pkl, args.num_classes)
+    else:
+        convert_bert(args.torch_model_dir, args.out_dir)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
