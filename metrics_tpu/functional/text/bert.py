@@ -10,14 +10,78 @@ bert_score :452). TPU-native differences:
   * matching is one batched einsum (L_p x L_r similarity per pair) + masked max —
     MXU work, no python token loops.
 """
-from collections import Counter
+from collections import Counter, OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from metrics_tpu.utils.prints import rank_zero_warn
+
 Array = jax.Array
+
+# jitted-forward cache keyed on the user's encoder object so repeated
+# bert_score calls reuse the compiled forward instead of re-tracing (or worse,
+# running the flax encoder op-by-op). The cached closure necessarily keeps its
+# encoder alive, so the cache is a bounded LRU (a WeakKeyDictionary could never
+# evict: value -> fn -> key is a strong cycle).
+_JIT_FORWARD_CACHE_MAX = 8
+_JIT_FORWARD_CACHE: "OrderedDict[int, Tuple[Any, Callable]]" = OrderedDict()
+# loaded-from-path flax models: bounded the same way (a checkpoint sweep would
+# otherwise pin every model in memory forever)
+_LOADED_MODEL_CACHE_MAX = 4
+_LOADED_MODEL_CACHE: "OrderedDict[str, Any]" = OrderedDict()
+
+# failures that mean "this callable cannot run under jit" (numpy/torch inside);
+# anything else (OOM, bad shapes, ...) propagates to the caller
+_TRACE_ERRORS = (jax.errors.JAXTypeError, TypeError, AttributeError)
+
+
+def _jit_with_eager_fallback(fn: Callable) -> Callable:
+    """jit ``fn``; if it is not traceable (an encoder computing in numpy/torch),
+    warn once and permanently fall back to the eager callable.
+
+    The warning fires only after the eager retry SUCCEEDS — a genuine bug in
+    the encoder (typo -> AttributeError, bad signature -> TypeError) raises the
+    same exception eagerly, which then propagates without a misleading
+    "not jit-traceable" message."""
+    jfn = jax.jit(fn)
+    state = {"jit_ok": True, "warn_pending": False}
+
+    def wrapped(ids, mask):
+        if state["jit_ok"]:
+            try:
+                return jfn(ids, mask)
+            except _TRACE_ERRORS:
+                state["jit_ok"] = False
+                state["warn_pending"] = True
+        out = fn(ids, mask)
+        if state["warn_pending"]:
+            state["warn_pending"] = False
+            rank_zero_warn(
+                "BERTScore encoder is not jit-traceable; running it eagerly. "
+                "Pass a jnp-based forward for compiled execution."
+            )
+        return out
+
+    return wrapped
+
+
+def _jitted_forward(key_obj: Any, fn: Callable) -> Callable:
+    """Bounded-LRU lookup of the compiled forward for this encoder object."""
+    key = id(key_obj)
+    hit = _JIT_FORWARD_CACHE.get(key)
+    # the (key_obj, ...) tuple pins the object so its id can't be recycled
+    if hit is not None and hit[0] is key_obj:
+        _JIT_FORWARD_CACHE.move_to_end(key)
+        return hit[1]
+    compiled = _jit_with_eager_fallback(fn)
+    _JIT_FORWARD_CACHE[key] = (key_obj, compiled)
+    _JIT_FORWARD_CACHE.move_to_end(key)
+    while len(_JIT_FORWARD_CACHE) > _JIT_FORWARD_CACHE_MAX:
+        _JIT_FORWARD_CACHE.popitem(last=False)
+    return compiled
 
 
 def _simple_whitespace_tokenizer(sentences: List[str], max_length: int) -> Dict[str, np.ndarray]:
@@ -81,6 +145,120 @@ def _bert_score_from_embeddings(
     return precision, recall, f1
 
 
+def _resolve_forward(
+    user_forward_fn: Optional[Callable],
+    model: Optional[Any],
+    model_name_or_path: Optional[str],
+) -> Callable:
+    """Resolve the encoder callable (priority: fn > model > local path) and
+    return its jit-compiled, cached form. Shared by the functional and the
+    module APIs."""
+    if user_forward_fn is not None:
+        return _jitted_forward(user_forward_fn, user_forward_fn)
+    if model is not None:
+        return _jitted_forward(model, lambda ids, mask: model(ids, mask))
+    if model_name_or_path is not None:
+        from transformers import FlaxAutoModel
+
+        hit = _LOADED_MODEL_CACHE.get(model_name_or_path)
+        if hit is None:
+            hit = FlaxAutoModel.from_pretrained(model_name_or_path)
+            _LOADED_MODEL_CACHE[model_name_or_path] = hit
+            _LOADED_MODEL_CACHE.move_to_end(model_name_or_path)
+            while len(_LOADED_MODEL_CACHE) > _LOADED_MODEL_CACHE_MAX:
+                _LOADED_MODEL_CACHE.popitem(last=False)
+        hf_model = hit
+        return _jitted_forward(
+            hf_model,
+            lambda ids, mask: hf_model(input_ids=ids, attention_mask=mask).last_hidden_state,
+        )
+    raise ValueError(
+        "BERTScore needs an encoder: pass `user_forward_fn`, `model`, or a local `model_name_or_path`"
+        " (this build cannot download pretrained weights)."
+    )
+
+
+def _score_tokenized(
+    forward: Callable,
+    pred_ids: np.ndarray,
+    pred_mask: np.ndarray,
+    tgt_ids: np.ndarray,
+    tgt_mask: np.ndarray,
+    idf: bool,
+    batch_size: int,
+) -> np.ndarray:
+    """Embed + match pre-tokenized pred/ref batches; returns (3, N) numpy P/R/F1.
+
+    When preds and refs share padding geometry (max_length padding — the
+    default), one fused pass over the concatenation keeps the encoder batches
+    full; a tokenizer padding each side to its own longest length falls back to
+    per-side embedding (the matching einsum handles L_pred != L_ref). Either
+    way the post-encoder concat/split/matching runs as ONE compiled call whose
+    (3, N) stack crosses to the host in ONE transfer — eagerly that path costs
+    ~10 dispatch round-trips.
+    """
+    def _embed(ids: np.ndarray, mask: np.ndarray) -> List[Array]:
+        outs = []
+        for i in range(0, ids.shape[0], batch_size):
+            out = forward(jnp.asarray(ids[i:i + batch_size]), jnp.asarray(mask[i:i + batch_size]))
+            # eager-fallback encoders may hand back numpy/torch buffers
+            outs.append(out if isinstance(out, jax.Array) else jnp.asarray(np.asarray(out)))
+        return outs
+
+    pred_w = tgt_w = None
+    if idf:
+        idf_map = _get_tokens_idf(tgt_ids, tgt_mask)
+        pred_w = jnp.asarray(_idf_weights(pred_ids, pred_mask, idf_map))
+        tgt_w = jnp.asarray(_idf_weights(tgt_ids, tgt_mask, idf_map))
+
+    if pred_ids.shape[1] == tgt_ids.shape[1]:
+        outs = _embed(np.concatenate([pred_ids, tgt_ids], axis=0),
+                      np.concatenate([pred_mask, tgt_mask], axis=0))
+        prf = _score_embeddings_packed(
+            tuple(outs), jnp.asarray(pred_mask), jnp.asarray(tgt_mask), pred_w, tgt_w
+        )
+    else:
+        pred_emb = jnp.concatenate(_embed(pred_ids, pred_mask), axis=0)
+        tgt_emb = jnp.concatenate(_embed(tgt_ids, tgt_mask), axis=0)
+        prf = _score_embeddings_unfused(
+            pred_emb, jnp.asarray(pred_mask), tgt_emb, jnp.asarray(tgt_mask), pred_w, tgt_w
+        )
+    return np.asarray(prf)
+
+
+@jax.jit
+def _score_embeddings_unfused(
+    pred_emb: Array,
+    pred_mask: Array,
+    target_emb: Array,
+    target_mask: Array,
+    pred_weights: Optional[Array],
+    target_weights: Optional[Array],
+) -> Array:
+    """Matching + result stacking for per-side embeddings (L_pred != L_ref)."""
+    p, r, f1 = _bert_score_from_embeddings(
+        pred_emb, pred_mask, target_emb, target_mask, pred_weights, target_weights
+    )
+    return jnp.stack([p, r, f1])
+
+
+@jax.jit
+def _score_embeddings_packed(
+    emb_batches: Tuple[Array, ...],
+    pred_mask: Array,
+    target_mask: Array,
+    pred_weights: Optional[Array],
+    target_weights: Optional[Array],
+) -> Array:
+    """Fuse concat/split/matching into one compiled call returning (3, N)."""
+    all_emb = jnp.concatenate(emb_batches, axis=0) if len(emb_batches) > 1 else emb_batches[0]
+    n_pred = pred_mask.shape[0]
+    p, r, f1 = _bert_score_from_embeddings(
+        all_emb[:n_pred], pred_mask, all_emb[n_pred:], target_mask, pred_weights, target_weights
+    )
+    return jnp.stack([p, r, f1])
+
+
 def bert_score(
     predictions: List[str],
     references: List[str],
@@ -128,39 +306,9 @@ def bert_score(
     pred_ids, pred_mask = np.asarray(enc_pred["input_ids"]), np.asarray(enc_pred["attention_mask"])
     tgt_ids, tgt_mask = np.asarray(enc_tgt["input_ids"]), np.asarray(enc_tgt["attention_mask"])
 
-    # ---- resolve encoder
-    forward = user_forward_fn
-    if forward is None and model is not None:
-        forward = lambda ids, mask: model(ids, mask)
-    if forward is None and model_name_or_path is not None:
-        from transformers import FlaxAutoModel
-
-        hf_model = FlaxAutoModel.from_pretrained(model_name_or_path)
-        forward = lambda ids, mask: hf_model(input_ids=ids, attention_mask=mask).last_hidden_state
-    if forward is None:
-        raise ValueError(
-            "BERTScore needs an encoder: pass `user_forward_fn`, `model`, or a local `model_name_or_path`"
-            " (this build cannot download pretrained weights)."
-        )
-
-    # ---- embed in batches (device)
-    def _embed(ids: np.ndarray, mask: np.ndarray) -> Array:
-        outs = []
-        for i in range(0, ids.shape[0], batch_size):
-            outs.append(jnp.asarray(forward(jnp.asarray(ids[i:i + batch_size]), jnp.asarray(mask[i:i + batch_size]))))
-        return jnp.concatenate(outs, axis=0)
-
-    pred_emb = _embed(pred_ids, pred_mask)
-    tgt_emb = _embed(tgt_ids, tgt_mask)
-
-    pred_w = tgt_w = None
-    if idf:
-        idf_map = _get_tokens_idf(tgt_ids, tgt_mask)
-        pred_w = jnp.asarray(_idf_weights(pred_ids, pred_mask, idf_map))
-        tgt_w = jnp.asarray(_idf_weights(tgt_ids, tgt_mask, idf_map))
-
-    precision, recall, f1 = _bert_score_from_embeddings(
-        pred_emb, jnp.asarray(pred_mask), tgt_emb, jnp.asarray(tgt_mask), pred_w, tgt_w
+    forward = _resolve_forward(user_forward_fn, model, model_name_or_path)
+    precision, recall, f1 = _score_tokenized(
+        forward, pred_ids, pred_mask, tgt_ids, tgt_mask, idf=idf, batch_size=batch_size
     )
 
     if rescale_with_baseline:
@@ -170,9 +318,9 @@ def bert_score(
         f1 = (f1 - baseline[2]) / (1 - baseline[2])
 
     output: Dict[str, Union[List[float], str]] = {
-        "precision": [float(x) for x in np.asarray(precision)],
-        "recall": [float(x) for x in np.asarray(recall)],
-        "f1": [float(x) for x in np.asarray(f1)],
+        "precision": [float(x) for x in precision],
+        "recall": [float(x) for x in recall],
+        "f1": [float(x) for x in f1],
     }
     if return_hash:
         output["hash"] = f"metrics_tpu-bert_score-{model_name_or_path}"

@@ -226,8 +226,6 @@ class Metric:
         for k, v in state.items():
             was_list[k] = isinstance(v, list)
             prepped[k] = dim_zero_cat(v) if was_list[k] else v
-        if self.dist_sync_fn is not None:
-            return {k: self.dist_sync_fn(self._reductions[k], v, axis_name) for k, v in prepped.items()}
         keys = list(prepped)
         # reference metric.py:249-252: gathered list states stay FLATTENED (tiled
         # cat gather); only tensor states under fx=None arrive stacked (world, ...)
@@ -235,6 +233,8 @@ class Metric:
             ("cat" if self._reductions[k] is None and was_list[k] else self._reductions[k])
             for k in keys
         ]
+        if self.dist_sync_fn is not None:
+            return {k: self.dist_sync_fn(fx, prepped[k], axis_name) for k, fx in zip(keys, fxs)}
         synced = fused_axis_sync(list(zip(fxs, (prepped[k] for k in keys))), axis_name)
         return dict(zip(keys, synced))
 

@@ -15,6 +15,7 @@ from metrics_tpu.functional.regression.pearson import (
     _pearson_corrcoef_update,
 )
 from metrics_tpu.metric import Metric
+from metrics_tpu.utils.prints import rank_zero_warn
 
 Array = jax.Array
 
@@ -83,3 +84,15 @@ class PearsonCorrCoef(Metric):
         else:
             var_x, var_y, corr_xy, n_total = self.var_x, self.var_y, self.corr_xy, self.n_total
         return _pearson_corrcoef_compute(var_x, var_y, corr_xy, n_total)
+
+
+class PearsonCorrcoef(PearsonCorrCoef):
+    """Deprecated alias. Parity: reference ``regression/pearson.py:145-168``
+    (renamed to ``PearsonCorrCoef`` in v0.7, removal scheduled for v0.8)."""
+
+    def __init__(self, **kwargs: Any) -> None:
+        rank_zero_warn(
+            "`PearsonCorrcoef` was renamed to `PearsonCorrCoef` and it will be removed.",
+            DeprecationWarning,
+        )
+        super().__init__(**kwargs)

@@ -43,3 +43,15 @@ class SpearmanCorrCoef(Metric):
         preds = dim_zero_cat(self.preds)
         target = dim_zero_cat(self.target)
         return _spearman_corrcoef_compute(preds, target)
+
+
+class SpearmanCorrcoef(SpearmanCorrCoef):
+    """Deprecated alias. Parity: reference ``regression/spearman.py`` (renamed
+    to ``SpearmanCorrCoef`` in v0.7, removal scheduled for v0.8)."""
+
+    def __init__(self, **kwargs: Any) -> None:
+        rank_zero_warn(
+            "`SpearmanCorrcoef` was renamed to `SpearmanCorrCoef` and it will be removed.",
+            DeprecationWarning,
+        )
+        super().__init__(**kwargs)

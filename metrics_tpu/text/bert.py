@@ -2,19 +2,18 @@
 
 Parity: reference ``torchmetrics/text/bert.py:40`` (update :195 tokenizes and stores
 token tensors as cat-states; compute :226 runs the embedding pipeline). The encoder
-is pluggable (local HF Flax model / user forward fn) — see
-``functional/text/bert.py``.
+is pluggable (local HF Flax model / user forward fn) and shares the functional
+path's jit-compiled, cached forward + fused scoring (``functional/text/bert.py``).
 """
-from typing import Any, Callable, Dict, List, Optional, Union
+from typing import Any, Callable, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu.functional.text.bert import (
-    _bert_score_from_embeddings,
-    _get_tokens_idf,
-    _idf_weights,
+    _resolve_forward,
+    _score_tokenized,
     _simple_whitespace_tokenizer,
 )
 from metrics_tpu.metric import Metric
@@ -52,21 +51,8 @@ class BERTScore(Metric):
         self.batch_size = batch_size
         self.idf = idf
         self.user_tokenizer = user_tokenizer
-
-        forward = user_forward_fn
-        if forward is None and model is not None:
-            forward = lambda ids, mask: model(ids, mask)
-        if forward is None and model_name_or_path is not None:
-            from transformers import FlaxAutoModel
-
-            hf_model = FlaxAutoModel.from_pretrained(model_name_or_path)
-            forward = lambda ids, mask: hf_model(input_ids=ids, attention_mask=mask).last_hidden_state
-        if forward is None:
-            raise ValueError(
-                "BERTScore needs an encoder: pass `user_forward_fn`, `model`, or a local `model_name_or_path`"
-                " (this build cannot download pretrained weights)."
-            )
-        self.forward_fn = forward
+        # resolve eagerly: a missing encoder should fail at construction
+        self.forward_fn = _resolve_forward(user_forward_fn, model, model_name_or_path)
 
         self.add_state("preds_input_ids", [], dist_reduce_fx="cat")
         self.add_state("preds_attention_mask", [], dist_reduce_fx="cat")
@@ -87,34 +73,17 @@ class BERTScore(Metric):
         self.target_attention_mask.append(jnp.asarray(enc_tgt["attention_mask"]))
 
     def compute(self) -> Dict[str, List[float]]:
-        pred_ids = np.asarray(dim_zero_cat(self.preds_input_ids))
-        pred_mask = np.asarray(dim_zero_cat(self.preds_attention_mask))
-        tgt_ids = np.asarray(dim_zero_cat(self.target_input_ids))
-        tgt_mask = np.asarray(dim_zero_cat(self.target_attention_mask))
-
-        def _embed(ids, mask):
-            outs = []
-            for i in range(0, ids.shape[0], self.batch_size):
-                outs.append(
-                    jnp.asarray(self.forward_fn(jnp.asarray(ids[i:i + self.batch_size]),
-                                                jnp.asarray(mask[i:i + self.batch_size])))
-                )
-            return jnp.concatenate(outs, axis=0)
-
-        pred_emb = _embed(pred_ids, pred_mask)
-        tgt_emb = _embed(tgt_ids, tgt_mask)
-
-        pred_w = tgt_w = None
-        if self.idf:
-            idf_map = _get_tokens_idf(tgt_ids, tgt_mask)
-            pred_w = jnp.asarray(_idf_weights(pred_ids, pred_mask, idf_map))
-            tgt_w = jnp.asarray(_idf_weights(tgt_ids, tgt_mask, idf_map))
-
-        precision, recall, f1 = _bert_score_from_embeddings(
-            pred_emb, jnp.asarray(pred_mask), tgt_emb, jnp.asarray(tgt_mask), pred_w, tgt_w
+        precision, recall, f1 = _score_tokenized(
+            self.forward_fn,
+            np.asarray(dim_zero_cat(self.preds_input_ids)),
+            np.asarray(dim_zero_cat(self.preds_attention_mask)),
+            np.asarray(dim_zero_cat(self.target_input_ids)),
+            np.asarray(dim_zero_cat(self.target_attention_mask)),
+            idf=self.idf,
+            batch_size=self.batch_size,
         )
         return {
-            "precision": [float(x) for x in np.asarray(precision)],
-            "recall": [float(x) for x in np.asarray(recall)],
-            "f1": [float(x) for x in np.asarray(f1)],
+            "precision": [float(x) for x in precision],
+            "recall": [float(x) for x in recall],
+            "f1": [float(x) for x in f1],
         }

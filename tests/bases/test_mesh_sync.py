@@ -121,3 +121,34 @@ def test_uneven_cat_sync(devices):
     valid = out[~np.isnan(out)]
     expected = np.concatenate([np.full(d % 3 + 1, d) for d in range(8)]).astype(float)
     np.testing.assert_allclose(valid, expected)
+
+
+def test_custom_dist_sync_fn_list_state_flattened(devices):
+    """A custom ``dist_sync_fn`` must see fx='cat' for fx=None LIST states so the
+    gathered result is flattened — matching the default fused path (reference
+    ``metric.py:249-252``: gathered list states are flattened, not stacked)."""
+    from metrics_tpu import Metric
+    from metrics_tpu.parallel.collectives import sync_axis_state
+
+    class ListNone(Metric):
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            self.add_state("feats", [], dist_reduce_fx=None)
+
+        def update(self, x):
+            self.feats.append(jnp.asarray(x))
+
+        def compute(self):
+            return self.feats
+
+    m = ListNone(dist_sync_fn=sync_axis_state)
+
+    @partial(jax.shard_map, mesh=_mesh(), in_specs=P("dp"), out_specs=P(None), check_vma=False)
+    def run(x):
+        state = m.update_state(m.init_state(), x[0] * jnp.ones((2, 3)))
+        return m.sync_states(state, "dp")["feats"]
+
+    out = run(jnp.arange(8.0))
+    # 8 devices x 2 rows each, flattened — NOT (8, 2, 3)-stacked
+    assert out.shape == (16, 3)
+    np.testing.assert_allclose(np.asarray(out)[:, 0], np.repeat(np.arange(8.0), 2))

@@ -1,0 +1,73 @@
+"""Shims that make the reference (/root/reference, TorchMetrics v0.7.0dev)
+importable in this environment, shared by ``bench.py`` baselines and the
+detection oracle tests.
+
+Two gaps are bridged: ``pkg_resources`` (removed from setuptools on py3.12)
+and ``torchvision`` (absent; the reference MAP needs exactly three box ops,
+re-derived here from the standard formulas).
+"""
+import sys
+import types
+
+REFERENCE_ROOT = "/root/reference"
+
+
+def shim_pkg_resources() -> None:
+    if "pkg_resources" in sys.modules:
+        return
+    shim = types.ModuleType("pkg_resources")
+
+    class DistributionNotFound(Exception):
+        pass
+
+    def get_distribution(name):
+        raise DistributionNotFound(name)
+
+    shim.DistributionNotFound = DistributionNotFound
+    shim.get_distribution = get_distribution
+    sys.modules["pkg_resources"] = shim
+
+
+def shim_torchvision() -> None:
+    """Provide torchvision.ops.{box_area, box_convert, box_iou} over torch."""
+    if "torchvision" in sys.modules:
+        return
+    import importlib.machinery as mach
+
+    import torch
+
+    tv = types.ModuleType("torchvision")
+    tv.__version__ = "0.11.0"
+    ops = types.ModuleType("torchvision.ops")
+
+    def box_area(b):
+        return (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+
+    def box_convert(boxes, in_fmt, out_fmt):
+        if in_fmt == out_fmt or boxes.numel() == 0:
+            return boxes
+        if in_fmt == "xywh" and out_fmt == "xyxy":
+            x, y, w, h = boxes.unbind(-1)
+            return torch.stack([x, y, x + w, y + h], dim=-1)
+        if in_fmt == "cxcywh" and out_fmt == "xyxy":
+            cx, cy, w, h = boxes.unbind(-1)
+            return torch.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], dim=-1)
+        raise ValueError(f"unsupported {in_fmt}->{out_fmt}")
+
+    def box_iou(b1, b2):
+        a1, a2 = box_area(b1), box_area(b2)
+        lt = torch.max(b1[:, None, :2], b2[None, :, :2])
+        rb = torch.min(b1[:, None, 2:], b2[None, :, 2:])
+        wh = (rb - lt).clamp(min=0)
+        inter = wh[..., 0] * wh[..., 1]
+        union = a1[:, None] + a2[None, :] - inter
+        return torch.where(union > 0, inter / union, torch.zeros_like(union))
+
+    ops.box_area, ops.box_convert, ops.box_iou = box_area, box_convert, box_iou
+    tv.ops = ops
+    # importlib.util.find_spec (the reference's availability probe) rejects
+    # modules with __spec__ None; give the shims real-looking specs
+    tv.__spec__ = mach.ModuleSpec("torchvision", loader=None)
+    ops.__spec__ = mach.ModuleSpec("torchvision.ops", loader=None)
+    sys.modules["torchvision"] = tv
+    sys.modules["torchvision.ops"] = ops

@@ -109,3 +109,24 @@ def test_idf_weighting_changes_scores(local_bert):
                    user_tokenizer=_hf_tokenizer(tokenizer), max_length=16, idf=True)["f1"]
     )
     assert not np.allclose(plain, idf)
+
+
+def test_longest_padding_tokenizer(local_bert):
+    """A tokenizer padding each side to its own longest length produces
+    different L_pred/L_ref — must route through the per-side embed path and
+    agree with the max_length-padded scores."""
+    from metrics_tpu.functional import bert_score
+
+    flax_dir, tokenizer = local_bert
+    preds = ["the cat sat", "hello there general kenobi"]
+    refs = ["the cat sat on a mat in the park", "a dog ran in the park"]
+
+    def longest_tok(texts, max_length):
+        return tokenizer(texts, padding="longest", truncation=True,
+                         max_length=max_length, return_tensors="np")
+
+    out = bert_score(preds, refs, model_name_or_path=flax_dir,
+                     user_tokenizer=longest_tok, max_length=16)
+    ref_out = bert_score(preds, refs, model_name_or_path=flax_dir,
+                         user_tokenizer=_hf_tokenizer(tokenizer), max_length=16)
+    np.testing.assert_allclose(np.asarray(out["f1"]), np.asarray(ref_out["f1"]), atol=1e-5)
