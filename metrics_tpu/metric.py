@@ -145,6 +145,8 @@ class Metric:
         the "cat"/gather pattern). ``dist_reduce_fx`` in {"sum","mean","min","max",
         "cat", None, callable}.
         """
+        if name == self._CHILD_KEY:
+            raise ValueError(f"state name {self._CHILD_KEY!r} is reserved for nested metric states")
         if not isinstance(default, (jax.Array, np.ndarray, list)) or (
             isinstance(default, list) and default
         ):
@@ -162,50 +164,128 @@ class Metric:
 
     # ------------------------------------------------------------- functional core API
 
+    _CHILD_KEY = "_children"
+
+    def _child_metrics(self) -> Dict[str, Any]:
+        """Child Metric instances held as attributes (wrapper/compositional
+        metrics): name -> Metric, or name -> list of Metrics. The functional
+        core recurses through these so ``init_state``/``update_state``/
+        ``sync_states`` cover the FULL state of nested metrics — a MinMax or
+        Multioutput wrapper's data lives in its inner metrics."""
+        out: Dict[str, Any] = {}
+        for name in sorted(self.__dict__):
+            if name in self._defaults or name.startswith("__"):
+                continue
+            v = self.__dict__[name]
+            if isinstance(v, Metric):
+                out[name] = v
+            elif isinstance(v, (list, tuple)) and v and all(isinstance(x, Metric) for x in v):
+                out[name] = list(v)
+        return out
+
     def init_state(self) -> Dict[str, Any]:
-        """Fresh state pytree (a dict: name -> array or list of arrays).
+        """Fresh state pytree (a dict: name -> array or list of arrays; nested
+        metrics appear under the reserved '_children' key).
 
         Leaves are COPIES: two states sharing a zeros-default must not alias the
         same buffer, or a jit step with donated state fails with
         "attempt to donate the same buffer twice".
         """
-        return {
+        state = {
             k: (jnp.array(v) if isinstance(v, jax.Array) else list(v))
             for k, v in self._defaults.items()
         }
+        children = self._child_metrics()
+        if children:
+            state[self._CHILD_KEY] = {
+                name: ([c.init_state() for c in child] if isinstance(child, list) else child.init_state())
+                for name, child in children.items()
+            }
+        return state
 
     def _pack_state(self) -> Dict[str, Any]:
-        return {k: getattr(self, k) for k in self._defaults}
+        state = {k: getattr(self, k) for k in self._defaults}
+        children = self._child_metrics()
+        if children:
+            state[self._CHILD_KEY] = {
+                name: ([c._pack_state() for c in child] if isinstance(child, list) else child._pack_state())
+                for name, child in children.items()
+            }
+        return state
 
     def _load_state(self, state: Dict[str, Any]) -> None:
+        children = self._child_metrics()
         for k, v in state.items():
+            if k == self._CHILD_KEY:
+                for name, child_state in v.items():
+                    child = children.get(name)
+                    if child is None:
+                        continue
+                    if isinstance(child, list):
+                        for c, cs in zip(child, child_state):
+                            c._load_state(cs)
+                    else:
+                        child._load_state(child_state)
+                continue
             # list states copy shallowly; array-likes (jax, numpy — e.g. from
             # jax.device_get or a checkpoint) pass through as-is
             setattr(self, k, list(v) if isinstance(v, (list, tuple)) else v)
+
+    _BOOKKEEPING_ATTRS = ("_computed", "_update_called", "_forward_cache")
+
+    def _snapshot_bookkeeping(self) -> Dict[int, Dict[str, Any]]:
+        """Snapshot host-side caches of self + all descendants so the pure API
+        can restore them: a child's WRAPPED ``compute`` caches ``_computed``,
+        and under a trace that cache would be a leaked tracer."""
+        snap: Dict[int, Dict[str, Any]] = {}
+
+        def visit(m: "Metric") -> None:
+            snap[id(m)] = {a: getattr(m, a, None) for a in self._BOOKKEEPING_ATTRS}
+            # unregistered mutable extras (e.g. MinMax's running extremes if a
+            # subclass keeps any) are the subclass's responsibility: register
+            # them with add_state so they travel/restore with the state pytree
+            m._for_each_child(visit)
+
+        visit(self)
+        return snap
+
+    def _restore_bookkeeping(self, snap: Dict[int, Dict[str, Any]]) -> None:
+        def visit(m: "Metric") -> None:
+            vals = snap.get(id(m))
+            if vals is not None:
+                for a, v in vals.items():
+                    object.__setattr__(m, a, v)
+            m._for_each_child(visit)
+
+        visit(self)
 
     def update_state(self, state: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:
         """Pure update: ``new_state = f(state, batch)``. Safe inside jit/scan/shard_map.
 
         Runs the subclass ``update`` body with ``state`` loaded into the instance, then
-        snapshots the result; instance state is restored afterwards, so this never
-        mutates the facade.
+        snapshots the result; instance state (incl. nested metrics' and host-side
+        caches) is restored afterwards, so this never mutates the facade.
         """
         saved = self._pack_state()
+        book = self._snapshot_bookkeeping()
         self._load_state(state)
         try:
             self._inner_update(*args, **kwargs)
             return self._pack_state()
         finally:
             self._load_state(saved)
+            self._restore_bookkeeping(book)
 
     def compute_from(self, state: Dict[str, Any]) -> Any:
         """Pure compute on an explicit (already-merged) state pytree."""
         saved = self._pack_state()
+        book = self._snapshot_bookkeeping()
         self._load_state(state)
         try:
             return _squeeze_if_scalar(self._inner_compute())
         finally:
             self._load_state(saved)
+            self._restore_bookkeeping(book)
 
     def compute_synced(self, state: Dict[str, Any], axis_name: Optional[str] = None) -> Any:
         """Pure sync+compute for use inside ``shard_map``/``pmap`` regions."""
@@ -220,10 +300,27 @@ class Metric:
         """
         if axis_name is None or not in_mapped_context(axis_name):
             return state
+        # nested metric states sync recursively with their own reductions
+        synced_children: Optional[Dict[str, Any]] = None
+        if self._CHILD_KEY in state:
+            children = self._child_metrics()
+            synced_children = {}
+            for name, child_state in state[self._CHILD_KEY].items():
+                child = children.get(name)
+                if child is None:
+                    synced_children[name] = child_state
+                elif isinstance(child, list):
+                    synced_children[name] = [
+                        c.sync_states(cs, axis_name) for c, cs in zip(child, child_state)
+                    ]
+                else:
+                    synced_children[name] = child.sync_states(child_state, axis_name)
         # pre-cat list states
         prepped: Dict[str, Any] = {}
         was_list: Dict[str, bool] = {}
         for k, v in state.items():
+            if k == self._CHILD_KEY:
+                continue
             was_list[k] = isinstance(v, list)
             prepped[k] = dim_zero_cat(v) if was_list[k] else v
         keys = list(prepped)
@@ -234,14 +331,35 @@ class Metric:
             for k in keys
         ]
         if self.dist_sync_fn is not None:
-            return {k: self.dist_sync_fn(fx, prepped[k], axis_name) for k, fx in zip(keys, fxs)}
-        synced = fused_axis_sync(list(zip(fxs, (prepped[k] for k in keys))), axis_name)
-        return dict(zip(keys, synced))
+            out = {k: self.dist_sync_fn(fx, prepped[k], axis_name) for k, fx in zip(keys, fxs)}
+        else:
+            synced = fused_axis_sync(list(zip(fxs, (prepped[k] for k in keys))), axis_name)
+            out = dict(zip(keys, synced))
+        if synced_children is not None:
+            out[self._CHILD_KEY] = synced_children
+        return out
 
     def merge_states(self, a: Dict[str, Any], b: Dict[str, Any]) -> Dict[str, Any]:
         """Pairwise merge of two state pytrees (pure). Sum/min/max/cat are canned;
         metrics with custom merge semantics override ``_merge_state`` per state."""
         out: Dict[str, Any] = {}
+        if self._CHILD_KEY in a or self._CHILD_KEY in b:
+            children = self._child_metrics()
+            a_children = a.get(self._CHILD_KEY, {})
+            b_children = b.get(self._CHILD_KEY, {})
+            merged_children: Dict[str, Any] = {}
+            for name in {**a_children, **b_children}:
+                ca, cb = a_children.get(name), b_children.get(name)
+                child = children.get(name)
+                if child is None or ca is None or cb is None:
+                    merged_children[name] = ca if ca is not None else cb
+                elif isinstance(child, list):
+                    merged_children[name] = [
+                        c.merge_states(x, y) for c, x, y in zip(child, ca, cb)
+                    ]
+                else:
+                    merged_children[name] = child.merge_states(ca, cb)
+            out[self._CHILD_KEY] = merged_children
         for k in self._defaults:
             fx = self._reductions[k]
             va, vb = a[k], b[k]
@@ -273,6 +391,11 @@ class Metric:
             if isinstance(self._defaults[k], list):
                 continue  # lists always merge by extension
             if fx not in _MERGEABLE_FX and not self._overrides_merge_state():
+                return False
+        # a wrapper is only delta-mergeable if every nested metric is
+        for child in self._child_metrics().values():
+            children = child if isinstance(child, list) else [child]
+            if not all(c._states_mergeable for c in children):
                 return False
         return True
 
@@ -421,6 +544,13 @@ class Metric:
 
         out: Dict[str, Any] = {}
         for k, v in state.items():
+            if k == self._CHILD_KEY:
+                # child states pass through UNSYNCED: in the eager path each
+                # nested metric syncs itself when its own wrapped compute runs
+                # (reference semantics — the wrapper never gathers for its
+                # children; recursing here would double-sync sums/counts)
+                out[k] = v
+                continue
             fx = self._reductions[k]
             was_list = isinstance(v, list)
             v = dim_zero_cat(v) if was_list else v
@@ -485,12 +615,23 @@ class Metric:
 
     # ---------------------------------------------------------------- misc protocol bits
 
+    def _for_each_child(self, fn: Callable[["Metric"], Any]) -> None:
+        for child in self._child_metrics().values():
+            if isinstance(child, list):
+                for c in child:
+                    fn(c)
+            else:
+                fn(child)
+
     def persistent(self, mode: bool = False) -> None:
         for k in self._persistent:
             self._persistent[k] = mode
+        self._for_each_child(lambda c: c.persistent(mode=mode))
 
     def state_dict(self, prefix: str = "") -> Dict[str, Any]:
-        """Serializable snapshot of persistent states (as numpy). Parity: metric.py:514."""
+        """Serializable snapshot of persistent states (as numpy), recursing into
+        nested metrics with dotted prefixes (the reference gets this via
+        nn.Module recursion). Parity: metric.py:514."""
         out = {}
         for k in self._defaults:
             if not self._persistent[k]:
@@ -500,6 +641,12 @@ class Metric:
                 out[prefix + k] = [np.asarray(x) for x in v]
             else:
                 out[prefix + k] = np.asarray(v)
+        for name, child in self._child_metrics().items():
+            if isinstance(child, list):
+                for i, c in enumerate(child):
+                    out.update(c.state_dict(prefix=f"{prefix}{name}.{i}."))
+            else:
+                out.update(child.state_dict(prefix=f"{prefix}{name}."))
         return out
 
     def load_state_dict(self, state_dict: Dict[str, Any], prefix: str = "") -> None:
@@ -511,28 +658,37 @@ class Metric:
                     setattr(self, k, [jnp.asarray(x) for x in v])
                 else:
                     setattr(self, k, jnp.asarray(v))
+        for name, child in self._child_metrics().items():
+            if isinstance(child, list):
+                for i, c in enumerate(child):
+                    c.load_state_dict(state_dict, prefix=f"{prefix}{name}.{i}.")
+            else:
+                child.load_state_dict(state_dict, prefix=f"{prefix}{name}.")
 
     def clone(self) -> "Metric":
         return deepcopy(self)
 
     def to_device(self, device) -> "Metric":
-        """Move all states to ``device`` (or apply a ``Sharding``)."""
+        """Move all states (incl. nested metrics') to ``device``."""
         for k in self._defaults:
             v = getattr(self, k)
             if isinstance(v, list):
                 setattr(self, k, [jax.device_put(x, device) for x in v])
             else:
                 setattr(self, k, jax.device_put(v, device))
+        self._for_each_child(lambda c: c.to_device(device))
         return self
 
     def astype(self, dtype) -> "Metric":
-        """Cast floating-point states. Analogue of reference half()/float()/double()."""
+        """Cast floating-point states (incl. nested metrics'). Analogue of
+        reference half()/float()/double()."""
         for k in self._defaults:
             v = getattr(self, k)
             if isinstance(v, list):
                 setattr(self, k, [x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x for x in v])
             elif jnp.issubdtype(v.dtype, jnp.floating):
                 setattr(self, k, v.astype(dtype))
+        self._for_each_child(lambda c: c.astype(dtype))
         return self
 
     def _filter_kwargs(self, **kwargs: Any) -> Dict[str, Any]:

@@ -107,9 +107,3 @@ class BootStrapper(Metric):
         for m in self.metrics:
             m.reset()
         super().reset()
-
-    def _pack_state(self) -> Dict[str, Any]:
-        return {}
-
-    def _load_state(self, state: Dict[str, Any]) -> None:
-        pass

@@ -22,8 +22,11 @@ class MinMaxMetric(Metric):
                 f"Expected base metric to be an instance of `metrics_tpu.Metric` but received {base_metric}"
             )
         self._base_metric = base_metric
-        self.min_val = jnp.asarray(jnp.inf)
-        self.max_val = jnp.asarray(-jnp.inf)
+        # registered states (not plain attrs): the pure update/compute API
+        # snapshots+restores registered state only, and min/max ARE the right
+        # cross-device reductions for these
+        self.add_state("min_val", jnp.asarray(jnp.inf), dist_reduce_fx="min")
+        self.add_state("max_val", jnp.asarray(-jnp.inf), dist_reduce_fx="max")
 
     def update(self, *args: Any, **kwargs: Any) -> None:
         self._base_metric.update(*args, **kwargs)
@@ -39,8 +42,6 @@ class MinMaxMetric(Metric):
     def reset(self) -> None:
         super().reset()
         self._base_metric.reset()
-        self.min_val = jnp.asarray(jnp.inf)
-        self.max_val = jnp.asarray(-jnp.inf)
 
     @staticmethod
     def _is_suitable_val(val: Any) -> bool:

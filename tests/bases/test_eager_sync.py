@@ -222,3 +222,20 @@ def test_forward_without_dist_sync_on_step_in_shard_map(devices):
 
     out = step(jnp.arange(8.0))
     np.testing.assert_allclose(np.asarray(out), np.arange(8.0))
+
+
+def test_multihost_wrapper_children_sync_once(fake_multihost, monkeypatch):
+    """Eager multihost semantics for wrappers (reference parity): the wrapper
+    does NOT gather for its children — each nested metric syncs itself when its
+    own wrapped compute runs, so sums are merged exactly once."""
+    from metrics_tpu import MinMaxMetric, SumMetric
+
+    m = MinMaxMetric(SumMetric())
+    m.update(jnp.asarray(2.0))  # inner sum = 2
+
+    monkeypatch.setattr("metrics_tpu.metric.distributed_available", lambda: True)
+    out = m.compute()
+    # fake gather: rank r contributes (v + r) -> (2+0)+(2+1)+(2+2) = 9, ONCE
+    assert float(out["raw"]) == 9.0
+    # inner local state restored by its own unsync after compute
+    assert float(m._base_metric.value) == 2.0

@@ -152,3 +152,20 @@ def test_custom_dist_sync_fn_list_state_flattened(devices):
     # 8 devices x 2 rows each, flattened — NOT (8, 2, 3)-stacked
     assert out.shape == (16, 3)
     np.testing.assert_allclose(np.asarray(out)[:, 0], np.repeat(np.arange(8.0), 2))
+
+
+def test_compositional_metric_mesh_sync(devices):
+    """Compositional metrics under shard_map (reference test_ddp.py:84-91):
+    operand states live in the composition's child metrics and sync with the
+    operands' own reductions."""
+    a, b = DummyMetricSum(), DummyMetricSum()
+    comp = a + b
+
+    @partial(jax.shard_map, mesh=_mesh(), in_specs=P("dp"), out_specs=P(), check_vma=False)
+    def run(x):
+        state = comp.update_state(comp.init_state(), x[0])
+        return comp.compute_synced(state, "dp")
+
+    out = run(jnp.arange(8.0))
+    # each operand accumulates its device's shard; psum -> sum(0..7); a+b doubles it
+    assert float(out) == 2 * sum(range(8))

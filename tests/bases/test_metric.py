@@ -152,3 +152,35 @@ def test_astype():
     m.update(jnp.asarray(2.0))
     m.astype(jnp.bfloat16)
     assert m.x.dtype == jnp.bfloat16
+
+
+def test_add_state_reserved_child_key_raises():
+    from metrics_tpu.metric import Metric
+
+    class Bad(Metric):
+        def __init__(self):
+            super().__init__()
+            self.add_state("_children", jnp.zeros(()), dist_reduce_fx="sum")
+
+        def update(self):
+            pass
+
+        def compute(self):
+            pass
+
+    with pytest.raises(ValueError, match="reserved"):
+        Bad()
+
+
+def test_merge_states_union_of_children():
+    """merge_states must keep child states present only on one side."""
+    from metrics_tpu import MeanSquaredError, MinMaxMetric
+
+    m = MinMaxMetric(MeanSquaredError())
+    a = m.init_state()
+    b = m.update_state(m.init_state(), jnp.asarray([1.0, 2.0]), jnp.asarray([2.0, 3.0]))
+    a_no_children = {k: v for k, v in a.items() if k != "_children"}
+    merged = m.merge_states(a_no_children, b)
+    assert "_children" in merged
+    out = m.compute_from(merged)
+    assert float(out["raw"]) == 1.0

@@ -1,0 +1,36 @@
+"""Smoke-run every shipped example script on the virtual 8-device CPU mesh.
+
+The reference executes its examples in CI (``tm_examples/`` are import-run by
+doc tests); these are subprocess runs so each example's own mesh setup and
+``__main__`` path is exercised exactly as documented in its header.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+EXAMPLES_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "tpu_examples")
+
+
+def _run_example(name: str, timeout: int = 420) -> subprocess.CompletedProcess:
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES_DIR, name)],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        cwd=os.path.join(EXAMPLES_DIR, ".."),
+    )
+
+
+@pytest.mark.parametrize(
+    "script", ["data_parallel_metrics.py", "detection_map.py", "bert_score_own_model.py"]
+)
+def test_example_runs(script):
+    proc = _run_example(script)
+    assert proc.returncode == 0, f"{script} failed:\n{proc.stdout}\n{proc.stderr}"
+    assert proc.stdout.strip(), f"{script} produced no output"

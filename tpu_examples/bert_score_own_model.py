@@ -5,6 +5,12 @@ BERTScore with a custom model + tokenizer; here the encoder is any callable
 ``(input_ids, attention_mask) -> (N, L, D)`` — a local HF Flax checkpoint, your own
 flax module, or (below) a toy hash-embedding for demonstration.
 """
+import os
+import sys
+
+# allow running as `python tpu_examples/<name>.py` from the repo root checkout
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 from typing import Dict
 
 import jax.numpy as jnp

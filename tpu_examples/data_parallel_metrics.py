@@ -9,6 +9,12 @@ Run (any host):
     XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
         python tpu_examples/data_parallel_metrics.py
 """
+import os
+import sys
+
+# allow running as `python tpu_examples/<name>.py` from the repo root checkout
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 from functools import partial
 
 import jax

@@ -1,4 +1,10 @@
 """Detection mAP example. Analogue of reference ``tm_examples/detection_map.py``."""
+import os
+import sys
+
+# allow running as `python tpu_examples/<name>.py` from the repo root checkout
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import numpy as np
 
 from metrics_tpu import MAP
