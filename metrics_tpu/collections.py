@@ -151,13 +151,21 @@ class MetricCollection(dict):
         for k, m in self.items(keep_base=True):
             for sname in m._defaults:
                 v = state[k][sname]
-                v = dim_zero_cat(v) if isinstance(v, list) else v
-                leaves.append((m._reductions[sname], v))
+                was_list = isinstance(v, list)
+                v = dim_zero_cat(v) if was_list else v
+                fx = m._reductions[sname]
+                # gathered list states stay FLATTENED (reference metric.py:249-252)
+                leaves.append(("cat" if fx is None and was_list else fx, v))
                 slots.append((k, sname))
         synced = fused_axis_sync(leaves, axis)
         out: Dict[str, Dict[str, Any]] = {k: {} for k, _ in self.items(keep_base=True)}
         for (k, sname), v in zip(slots, synced):
             out[k][sname] = v
+        # wrapper/compositional members: their nested metrics' states sync
+        # recursively with the children's own reductions
+        for k, m in self.items(keep_base=True):
+            if m._CHILD_KEY in state[k]:
+                out[k][m._CHILD_KEY] = m._sync_child_states(state[k][m._CHILD_KEY], axis)
         return out
 
     def compute_from(self, state: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:

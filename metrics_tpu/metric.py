@@ -303,18 +303,7 @@ class Metric:
         # nested metric states sync recursively with their own reductions
         synced_children: Optional[Dict[str, Any]] = None
         if self._CHILD_KEY in state:
-            children = self._child_metrics()
-            synced_children = {}
-            for name, child_state in state[self._CHILD_KEY].items():
-                child = children.get(name)
-                if child is None:
-                    synced_children[name] = child_state
-                elif isinstance(child, list):
-                    synced_children[name] = [
-                        c.sync_states(cs, axis_name) for c, cs in zip(child, child_state)
-                    ]
-                else:
-                    synced_children[name] = child.sync_states(child_state, axis_name)
+            synced_children = self._sync_child_states(state[self._CHILD_KEY], axis_name)
         # pre-cat list states
         prepped: Dict[str, Any] = {}
         was_list: Dict[str, bool] = {}
@@ -337,6 +326,22 @@ class Metric:
             out = dict(zip(keys, synced))
         if synced_children is not None:
             out[self._CHILD_KEY] = synced_children
+        return out
+
+    def _sync_child_states(self, children_state: Dict[str, Any], axis_name: str) -> Dict[str, Any]:
+        """Sync a '_children' subtree: each nested metric applies its own
+        reductions (shared by Metric.sync_states and MetricCollection's fused
+        path, which fuses member leaves but must still recurse here)."""
+        children = self._child_metrics()
+        out: Dict[str, Any] = {}
+        for name, child_state in children_state.items():
+            child = children.get(name)
+            if child is None:
+                out[name] = child_state
+            elif isinstance(child, list):
+                out[name] = [c.sync_states(cs, axis_name) for c, cs in zip(child, child_state)]
+            else:
+                out[name] = child.sync_states(child_state, axis_name)
         return out
 
     def merge_states(self, a: Dict[str, Any], b: Dict[str, Any]) -> Dict[str, Any]:

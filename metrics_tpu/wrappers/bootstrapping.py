@@ -3,8 +3,12 @@
 Parity: reference ``torchmetrics/wrappers/bootstrapping.py:49`` (_bootstrap_sampler
 :25, per-update resampling :138-155, compute mean/std/quantile/raw :157).
 
-Sampling runs host-side with numpy (eval-time wrapper; resampling indices are data
-layout, not device compute). The resampled batch update itself is jnp on device.
+TPU-native difference: ``multinomial`` resampling draws its indices with the jax
+PRNG from a key derived from a REGISTERED draw counter — static shapes + pure
+functions, so a multinomial BootStrapper works inside jit/shard_map (each device
+decorrelates by folding in its mesh position). ``poisson`` keeps the reference's
+repeat-interleave semantics, whose output length is data-dependent — host-side
+and eager-only, exactly like upstream.
 """
 from copy import deepcopy
 from typing import Any, Dict, Optional, Sequence, Union
@@ -14,6 +18,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu.metric import Metric
+from metrics_tpu.parallel.collectives import in_mapped_context
+from metrics_tpu.parallel.mesh import current_metric_axis
 from metrics_tpu.utils.data import apply_to_collection
 
 Array = jax.Array
@@ -24,7 +30,7 @@ def _bootstrap_sampler(
     sampling_strategy: str = "poisson",
     rng: Optional[np.random.RandomState] = None,
 ) -> Array:
-    """Resampling indices for one bootstrap draw. Parity: reference ``:25-46``."""
+    """Host resampling indices for one bootstrap draw. Parity: reference ``:25-46``."""
     rng = rng or np.random
     if sampling_strategy == "poisson":
         n = rng.poisson(1, size)
@@ -70,18 +76,53 @@ class BootStrapper(Metric):
             )
         self.sampling_strategy = sampling_strategy
         self._rng = np.random.RandomState(seed)
+        # seed=None draws OS entropy (matching RandomState(None)); a fixed
+        # default would make unseeded runs identical replays
+        self._base_key = jax.random.PRNGKey(
+            np.random.RandomState().randint(0, 2**31) if seed is None else seed
+        )
+        # registered counter: advances the PRNG stream across explicit
+        # functional updates (state carried by the caller), travels with the
+        # state pytree (trace-safe; psum on sync is harmless bookkeeping)
+        self.add_state("draw_count", jnp.asarray(0, dtype=jnp.uint32), dist_reduce_fx="sum")
+
+    def _batch_size(self, args, kwargs) -> int:
+        args_sizes = apply_to_collection(args, jax.Array, lambda x: x.shape[0])
+        kwargs_sizes = apply_to_collection(kwargs, jax.Array, lambda x: x.shape[0])
+        if len(args_sizes) > 0:
+            return args_sizes[0]
+        if len(kwargs_sizes) > 0:
+            return next(iter(kwargs_sizes.values()))
+        raise ValueError("None of the input contained tensors, so could not determine the sampling size")
 
     def update(self, *args: Any, **kwargs: Any) -> None:
         """Resample the batch per bootstrap replica and update it. Parity: ``:138-155``."""
+        size = self._batch_size(args, kwargs)
+        if self.sampling_strategy == "multinomial":
+            # jax-PRNG path: static shapes, works under jit/shard_map.
+            # The key folds in (a) the registered draw counter — advances when
+            # the caller carries state functionally — and (b) a hash of the
+            # batch content, which decorrelates consecutive batches on paths
+            # that rebuild a fresh delta state per step (Metric.forward);
+            # identical (batch, counter) pairs resample identically — the
+            # deterministic-by-content semantics of a functional framework.
+            key = jax.random.fold_in(self._base_key, self.draw_count)
+            first = args[0] if args else next(iter(kwargs.values()))
+            batch_hash = jax.lax.bitcast_convert_type(
+                jnp.sum(jnp.asarray(first)).astype(jnp.float32), jnp.int32
+            )
+            key = jax.random.fold_in(key, batch_hash)
+            axis = current_metric_axis()
+            if axis is not None and in_mapped_context(axis):
+                key = jax.random.fold_in(key, jax.lax.axis_index(axis))
+            self.draw_count = self.draw_count + 1
+            for idx in range(self.num_bootstraps):
+                sample_idx = jax.random.randint(jax.random.fold_in(key, idx), (size,), 0, size)
+                new_args = apply_to_collection(args, jax.Array, jnp.take, sample_idx, axis=0)
+                new_kwargs = apply_to_collection(kwargs, jax.Array, jnp.take, sample_idx, axis=0)
+                self.metrics[idx].update(*new_args, **new_kwargs)
+            return
         for idx in range(self.num_bootstraps):
-            args_sizes = apply_to_collection(args, jax.Array, lambda x: x.shape[0])
-            kwargs_sizes = apply_to_collection(kwargs, jax.Array, lambda x: x.shape[0])
-            if len(args_sizes) > 0:
-                size = args_sizes[0]
-            elif len(kwargs_sizes) > 0:
-                size = next(iter(kwargs_sizes.values()))
-            else:
-                raise ValueError("None of the input contained tensors, so could not determine the sampling size")
             sample_idx = _bootstrap_sampler(size, self.sampling_strategy, self._rng)
             if sample_idx.size == 0:
                 continue

@@ -169,3 +169,29 @@ def test_compositional_metric_mesh_sync(devices):
     out = run(jnp.arange(8.0))
     # each operand accumulates its device's shard; psum -> sum(0..7); a+b doubles it
     assert float(out) == 2 * sum(range(8))
+
+
+def test_collection_with_wrapper_member_fused_sync(devices):
+    """A MetricCollection containing a wrapper metric: the fused bundle syncs
+    the member leaves AND the wrapper's nested-metric states (which would
+    otherwise be silently dropped from the synced pytree)."""
+    from metrics_tpu import MeanSquaredError, MinMaxMetric
+
+    coll = MetricCollection({"sum": DummyMetricSum(), "minmax": MinMaxMetric(MeanSquaredError())})
+
+    rng = np.random.RandomState(0)
+    preds = rng.rand(8, 4).astype(np.float32)
+    target = rng.rand(8, 4).astype(np.float32)
+
+    @partial(jax.shard_map, mesh=_mesh(), in_specs=(P("dp"), P("dp")), out_specs=P(), check_vma=False)
+    def run(p, t):
+        state = coll.init_state()
+        state["sum"] = coll["sum"].update_state(state["sum"], p[0, 0])
+        state["minmax"] = coll["minmax"].update_state(state["minmax"], p[0], t[0])
+        vals = coll.compute_synced(state, "dp")
+        return jnp.stack([vals["sum"], vals["minmax"]["raw"]])
+
+    out = np.asarray(run(jnp.asarray(preds), jnp.asarray(target)))
+    np.testing.assert_allclose(out[0], preds[:, 0].sum(), rtol=1e-5)
+    expected_mse = float(np.mean((preds - target) ** 2))
+    np.testing.assert_allclose(out[1], expected_mse, rtol=1e-5)
