@@ -389,6 +389,74 @@ def bench_bertscore() -> dict:
     }
 
 
+# --------------------------------------------- config 1: README Accuracy (CPU, 1 proc)
+
+_README_ACC_CODE = r"""
+import json, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+from metrics_tpu import Accuracy
+
+rng = np.random.RandomState(0)
+preds = jnp.asarray(rng.rand(4096, 10).astype(np.float32))
+target = jnp.asarray(rng.randint(0, 10, 4096))
+acc = Accuracy()
+for _ in range(5):
+    acc(preds, target)
+acc.reset()
+t0 = time.perf_counter()
+for _ in range(30):
+    acc(preds, target)
+v = float(acc.compute())
+dt = time.perf_counter() - t0
+assert 0 <= v <= 1
+print(json.dumps({"sps": 30 * 4096 / dt}))
+"""
+
+
+def bench_readme_accuracy_cpu() -> dict:
+    """BASELINE config 1: the README ``Accuracy()`` forward loop, CPU, single
+    process — ours (stateful facade, delta-merge forward) vs the reference's
+    double-update forward on torch CPU."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _README_ACC_CODE], env=env, capture_output=True,
+            text=True, timeout=600, cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        ours = json.loads(proc.stdout.strip().splitlines()[-1])["sps"] if proc.returncode == 0 else float("nan")
+    except subprocess.TimeoutExpired:
+        ours = float("nan")
+
+    def run_ref():
+        import torch
+
+        from torchmetrics import Accuracy as TAccuracy
+
+        rng = np.random.RandomState(0)
+        preds = torch.from_numpy(rng.rand(4096, 10).astype(np.float32))
+        target = torch.from_numpy(rng.randint(0, 10, 4096))
+        acc = TAccuracy()
+        for _ in range(5):
+            acc(preds, target)
+        acc.reset()
+        t0 = time.perf_counter()
+        for _ in range(30):
+            acc(preds, target)
+        acc.compute()
+        return 30 * 4096 / (time.perf_counter() - t0)
+
+    ref = _with_reference(run_ref)
+    return {
+        "value": round(ours, 1) if np.isfinite(ours) else None,
+        "unit": "samples/s (CPU, forward loop)",
+        "vs_baseline": round(ours / ref, 3) if np.isfinite(ours) and np.isfinite(ref) and ref > 0 else None,
+    }
+
+
 # -------------------------------------------------------------------- config 5: FID
 
 def bench_fid() -> dict:
@@ -432,7 +500,12 @@ def main() -> None:
             extras["sync_latency_us"] = sync
     except Exception as e:  # never lose the primary line
         extras["sync_latency_us"] = {"error": str(e)[:200]}
-    for name, fn in (("detection_map", bench_map), ("bertscore", bench_bertscore), ("fid_update", bench_fid)):
+    for name, fn in (
+        ("readme_accuracy_cpu", bench_readme_accuracy_cpu),
+        ("detection_map", bench_map),
+        ("bertscore", bench_bertscore),
+        ("fid_update", bench_fid),
+    ):
         try:
             extras[name] = fn()
         except Exception as e:

@@ -44,6 +44,11 @@ class BaseAggregator(Metric):
         self.nan_strategy = nan_strategy
         self.add_state("value", default=default_value, dist_reduce_fx=fn)
 
+    def _forward_jit_safe(self) -> bool:
+        # 'error'/'warn' must see concrete values on EVERY batch (raise/warn on
+        # nan) — the compiled forward path would silently degrade them to 'ignore'
+        return self.nan_strategy not in ("error", "warn") and super()._forward_jit_safe()
+
     def _cast_and_nan_check_input(self, x: Union[float, Array]) -> Array:
         """Convert input to float array and apply the NaN strategy."""
         x = jnp.asarray(x, dtype=jnp.float32) if not isinstance(x, jax.Array) else x.astype(jnp.float32)

@@ -12,9 +12,13 @@ import jax
 import jax.numpy as jnp
 from jax.scipy.special import xlogy
 
-from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.checks import _check_same_shape, defer_value_check, register_deferred_message
 
 Array = jax.Array
+
+_CODE_DOMAIN = register_deferred_message(
+    "Tweedie deviance inputs violate the positivity domain for the chosen `power`."
+)
 
 
 def _tweedie_deviance_score_update(preds: Array, targets: Array, power: float = 0.0) -> Tuple[Array, Array]:
@@ -24,6 +28,17 @@ def _tweedie_deviance_score_update(preds: Array, targets: Array, power: float = 
         raise ValueError(f"Deviance Score is not defined for power={power}.")
 
     eager = not isinstance(preds, jax.core.Tracer) and not isinstance(targets, jax.core.Tracer)
+    if not eager and power != 0:
+        # traced under a compiled forward step: emit the domain check in-graph
+        # (single conservative predicate; the eager branches below carry the
+        # precise per-power messages)
+        if power == 1 or 1 < power < 2:
+            bad = jnp.any(preds <= 0) | jnp.any(targets < 0)
+        elif power < 0:
+            bad = jnp.any(preds <= 0)
+        else:
+            bad = jnp.any(preds <= 0) | jnp.any(targets <= 0)
+        defer_value_check(bad, _CODE_DOMAIN)
     if power == 0:
         deviance_score = (targets - preds) ** 2
     elif power == 1:

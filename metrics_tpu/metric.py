@@ -31,6 +31,7 @@ Key differences from the reference, by design:
 """
 import functools
 import inspect
+import weakref
 from copy import deepcopy
 from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
@@ -45,6 +46,7 @@ from metrics_tpu.parallel.collectives import (
     sync_axis_state,
 )
 from metrics_tpu.parallel.mesh import current_metric_axis
+from metrics_tpu.utils.checks import deferred_message, deferred_value_checks
 from metrics_tpu.utils.data import apply_to_collection, dim_zero_cat
 from metrics_tpu.utils.exceptions import MetricsTPUUserError
 from metrics_tpu.utils.prints import rank_zero_warn
@@ -52,6 +54,14 @@ from metrics_tpu.utils.prints import rank_zero_warn
 Array = jax.Array
 
 _MERGEABLE_FX = ("sum", "min", "max", "cat")
+
+# forward() auto-jit cache: instance -> {signature: compiled step | _EAGER_ONLY}.
+# Keyed by weakref so compiled handles never interfere with pickling, deepcopy
+# (clone()) or garbage collection of the metric itself.
+_FORWARD_JIT_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_EAGER_ONLY = object()  # sentinel: this signature can't trace — stay eager forever
+_PENDING = object()  # sentinel: first call seen eagerly; compile on the next one
+_MISS = object()  # sentinel: fast path not taken this call
 
 
 def _squeeze_if_scalar(x: Any) -> Any:
@@ -125,6 +135,7 @@ class Metric:
         self._cache: Optional[Dict[str, Any]] = None
         self._to_sync = True
         self._should_unsync = True
+        self._deferred_errcode: Any = None  # in-graph validation code from compiled forward
 
         # wrap the subclass methods once per instance (reference metric.py:102-103)
         self.update: Callable = self._wrap_update(self.update)  # type: ignore[method-assign]
@@ -446,6 +457,7 @@ class Metric:
                 )
             if self._computed is not None:
                 return self._computed
+            self._raise_if_invalid()
             with self.sync_context(
                 dist_sync_fn=self.dist_sync_fn,
                 should_sync=self._to_sync,
@@ -470,6 +482,14 @@ class Metric:
         if self._is_synced:
             raise MetricsTPUUserError("The Metric shouldn't be synced when performing ``forward``.")
         if self._states_mergeable:
+            fast = self._forward_fast(args, kwargs)
+            if fast is not _MISS:
+                merged, value = fast
+                self._load_state(merged)
+                self._computed = None
+                self._update_called = True
+                self._forward_cache = value if self.compute_on_step else None
+                return self._forward_cache
             delta = self.update_state(self.init_state(), *args, **kwargs)
             merged = self.merge_states(self._pack_state(), delta)
             self._load_state(merged)
@@ -505,6 +525,163 @@ class Metric:
     def __call__(self, *args: Any, **kwargs: Any) -> Any:
         return self.forward(*args, **kwargs)
 
+    # ---------------------------------------------------------- forward auto-jit path
+
+    _FORWARD_JIT_MAX_SIGNATURES = 64
+
+    def _raise_if_invalid(self) -> None:
+        """Raise any validation error recorded by a compiled forward step.
+
+        The compiled path can't raise mid-graph; value checks run IN-graph and
+        their error code accumulates on-device. This is the (deferred) raise
+        point — called from compute() and sync(), CUDA-style."""
+        code_arr = self._deferred_errcode
+        if code_arr is None:
+            return
+        code = int(code_arr)
+        if code:
+            # sticky: the merged state contains the invalid batch, so every
+            # compute()/sync() until reset() must keep raising — a caught-and-
+            # retried compute must not return a corrupted value
+            self._deferred_errcode = code
+            raise ValueError(
+                deferred_message(code) + " (detected by a compiled forward step; raised deferred)"
+            )
+        self._deferred_errcode = None
+
+    def _forward_jit_safe(self) -> bool:
+        """Override to opt a metric out of the compiled forward path when its
+        eager semantics depend on concrete VALUES beyond input validation (e.g.
+        aggregators with ``nan_strategy='error'`` must raise on every batch)."""
+        for child in self._child_metrics().values():
+            children = child if isinstance(child, list) else [child]
+            if not all(c._forward_jit_safe() for c in children):
+                return False
+        return True
+
+    def _has_list_state(self) -> bool:
+        if any(isinstance(v, list) for v in self._defaults.values()):
+            return True
+        for child in self._child_metrics().values():
+            children = child if isinstance(child, list) else [child]
+            if any(c._has_list_state() for c in children):
+                return True
+        return False
+
+    @staticmethod
+    def _forward_signature(args: Any, kwargs: Any):
+        """Hashable call signature, or None if the call can't use the jit path.
+
+        Array leaves are keyed by (shape, dtype) and passed as jit arguments;
+        every other hashable leaf (python scalars, None) is keyed by VALUE and
+        baked into the trace as a constant. Strings (text metrics) and tracers
+        (forward already inside a user trace) opt out.
+        """
+        leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+        sig: List[Any] = []
+        array_idx: List[int] = []
+        for i, leaf in enumerate(leaves):
+            if isinstance(leaf, jax.core.Tracer) or isinstance(leaf, str):
+                return None
+            if isinstance(leaf, (jax.Array, np.ndarray)):
+                sig.append((leaf.shape, str(leaf.dtype)))
+                array_idx.append(i)
+            elif isinstance(leaf, float) and not isinstance(leaf, bool):
+                # data-like scalar (per-step loss values etc.): pass as a traced
+                # argument, NOT a baked constant — one compile covers all values
+                sig.append(float)
+                array_idx.append(i)
+            elif isinstance(leaf, (bool, int, type(None))):
+                sig.append((type(leaf), leaf))
+            else:
+                return None
+        return (treedef, tuple(sig)), tuple(array_idx), leaves
+
+    def _forward_fast(self, args: Any, kwargs: Any):
+        """Compiled whole-step forward: one XLA executable instead of dozens of
+        eager op dispatches (the reference pays TWO eager updates per forward —
+        ``metric.py:206,218``; we pay one compiled call).
+
+        Protocol per input signature: 1st call runs the eager path (so eager
+        value validation fires at least once per shape/dtype pattern), 2nd call
+        traces + compiles ``update→merge→compute(delta)``, later calls reuse the
+        executable. Updates that can't trace (host-side text/detection work,
+        data-dependent branching) permanently fall back to eager. Returns
+        ``(merged_state, batch_value)`` or ``_MISS``.
+        """
+        if self.dist_sync_on_step or self.dist_sync_fn is not None or not self._defaults:
+            return _MISS
+        # static per instance configuration — computed once, not per batch
+        path_ok = getattr(self, "_fwd_path_ok", None)
+        if path_ok is None:
+            path_ok = self._forward_jit_safe() and not self._has_list_state()
+            self._fwd_path_ok = path_ok
+        if not path_ok:
+            return _MISS
+        parsed = self._forward_signature(args, kwargs)
+        if parsed is None:
+            return _MISS
+        sig, array_idx, leaves = parsed
+        sig = (sig, bool(self.compute_on_step))  # compute_on_step is baked into the step
+        cache = _FORWARD_JIT_CACHE.get(self)
+        if cache is None:
+            cache = {}
+            try:
+                _FORWARD_JIT_CACHE[self] = cache
+            except TypeError:  # instance not weakref-able
+                return _MISS
+        entry = cache.get(sig)
+        if entry is _EAGER_ONLY:
+            return _MISS
+        if entry is None:
+            if len(cache) >= self._FORWARD_JIT_MAX_SIGNATURES:
+                return _MISS  # signature churn (e.g. varying shapes): stay eager
+            cache[sig] = _PENDING
+            return _MISS
+        if entry is _PENDING:
+            entry = self._build_forward_step(sig, array_idx, leaves)
+            cache[sig] = entry
+        try:
+            merged, value, errcode = entry(self._pack_state(), [leaves[i] for i in array_idx])
+        except Exception:
+            # untraceable update (host-side work, data-dependent branching) or a
+            # genuine input error: stay eager — the eager path re-raises real
+            # user errors with their proper message
+            cache[sig] = _EAGER_ONLY
+            return _MISS
+        # accumulate the in-graph validation code on-device (async, no transfer);
+        # checked + raised at the next compute()/sync() — see _raise_if_invalid
+        self._deferred_errcode = (
+            errcode if self._deferred_errcode is None else jnp.maximum(self._deferred_errcode, errcode)
+        )
+        return merged, value
+
+    def _build_forward_step(self, sig: Any, array_idx: Sequence[int], leaves: Sequence[Any]):
+        treedef = sig[0][0]  # sig = ((treedef, leaf_sig), compute_on_step)
+        n_leaves = len(leaves)
+        consts = {i: leaf for i, leaf in enumerate(leaves) if i not in array_idx}
+        compute_on_step = self.compute_on_step
+        # weak binding: the compiled step must NOT strongly reference self, or
+        # the _FORWARD_JIT_CACHE value would pin its own key alive forever
+        wself = weakref.ref(self)
+
+        def step(state: Dict[str, Any], arrays: Sequence[Any]):
+            m = wself()
+            assert m is not None  # caller holds a strong ref for the call's duration
+            merged_leaves: List[Any] = [None] * n_leaves
+            for i, arr in zip(array_idx, arrays):
+                merged_leaves[i] = arr
+            for i, c in consts.items():
+                merged_leaves[i] = c
+            a, kw = jax.tree_util.tree_unflatten(treedef, merged_leaves)
+            with deferred_value_checks() as checks:
+                delta = m.update_state(m.init_state(), *a, **kw)
+            merged = m.merge_states(state, delta)
+            value = m.compute_from(delta) if compute_on_step else None
+            return merged, value, checks.combined()
+
+        return jax.jit(step)
+
     def reset(self) -> None:
         """Reset state to defaults. Parity: reference ``metric.py:397-418``."""
         self._update_called = False
@@ -513,6 +690,7 @@ class Metric:
         self._load_state(self.init_state())
         self._is_synced = False
         self._cache = None
+        self._deferred_errcode = None
 
     # ----------------------------------------------------------------------- eager sync
 
@@ -530,6 +708,7 @@ class Metric:
         """
         if self._is_synced and should_sync:
             raise MetricsTPUUserError("The Metric has already been synced.")
+        self._raise_if_invalid()
         is_distributed = (
             distributed_available_fn() if distributed_available_fn is not None else distributed_available()
         )
@@ -714,6 +893,7 @@ class Metric:
         state = self.__dict__.copy()
         state.pop("update", None)
         state.pop("compute", None)
+        state["_deferred_errcode"] = None  # device array; validation status is session-local
         for k in self._defaults:
             v = state.get(k)
             if isinstance(v, jax.Array):

@@ -12,7 +12,7 @@ etc.) runs only eagerly — inside jit it is skipped, and anything that *needs* 
 (inferring ``num_classes`` from ``target.max()``) raises a clear error asking for the
 static argument instead.
 """
-from typing import Optional, Tuple
+from typing import Any, List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -24,6 +24,110 @@ from metrics_tpu.utils.enums import DataType
 
 def _is_tracer(x) -> bool:
     return isinstance(x, jax.core.Tracer)
+
+
+class _ValueStats(NamedTuple):
+    """Min/max of preds+target, fetched from device in ONE transfer.
+
+    The eager validation path needs up to five value-dependent facts
+    (target bounds twice, preds bounds, num_classes inference); issuing each as
+    its own ``jnp.min``/``jnp.max`` + ``int(...)`` forces a separate blocking
+    device→host round-trip — over a TPU tunnel that is ~4 RTTs per update. One
+    fused reduction + one transfer replaces them all.
+    """
+
+    target_min: float
+    target_max: float
+    preds_min: float
+    preds_max: float
+
+
+@jax.jit
+def _minmax_bundle(preds, target) -> jax.Array:
+    pf = jnp.ravel(preds).astype(jnp.float32)
+    tf = jnp.ravel(target).astype(jnp.float32)
+    return jnp.stack([jnp.min(tf), jnp.max(tf), jnp.min(pf), jnp.max(pf)])
+
+
+def _compute_value_stats(preds, target) -> Optional[_ValueStats]:
+    """None under trace (checks are skipped there); else one fused device fetch."""
+    if _is_tracer(preds) or _is_tracer(target):
+        return None
+    vals = np.asarray(_minmax_bundle(preds, target))
+    return _ValueStats(float(vals[0]), float(vals[1]), float(vals[2]), float(vals[3]))
+
+
+# --------------------------------------------------------- deferred (in-graph) checks
+#
+# Eager value checks can't raise inside a trace. When the metric runtime compiles
+# a whole forward step (metric.py _build_forward_step), it opens a
+# ``deferred_value_checks`` context: the check sites below then EMIT int32 error
+# codes as part of the graph instead of being skipped. The compiled step returns
+# max(codes); the facade accumulates it on-device (async, no transfer) and raises
+# the corresponding message at the next compute()/sync() — CUDA-style deferred
+# error reporting, with zero steady-state host round-trips.
+
+_DEFERRED_MESSAGES: dict = {}
+_DEFERRED_ACTIVE: List[Any] = []  # stack of code-collector lists
+
+
+def register_deferred_message(message: str) -> int:
+    """Allocate a stable error code for a deferred-check message."""
+    code = len(_DEFERRED_MESSAGES) + 1
+    _DEFERRED_MESSAGES[code] = message
+    return code
+
+
+def deferred_message(code: int) -> str:
+    return _DEFERRED_MESSAGES.get(code, f"invalid input detected (code {code})")
+
+
+class deferred_value_checks:
+    """Context manager: collect traced error codes from value-check sites."""
+
+    def __init__(self) -> None:
+        self.codes: List[Any] = []
+
+    def __enter__(self) -> "deferred_value_checks":
+        _DEFERRED_ACTIVE.append(self.codes)
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        _DEFERRED_ACTIVE.pop()
+
+    def combined(self):
+        """Fold collected codes into one int32 scalar (0 = all inputs valid)."""
+        out = jnp.int32(0)
+        for c in self.codes:
+            out = jnp.maximum(out, c)
+        return out
+
+
+def defer_value_check(bad, code: int) -> None:
+    """Emit ``code`` when the traced predicate ``bad`` holds (no-op outside the
+    deferred-checks context)."""
+    if _DEFERRED_ACTIVE:
+        _DEFERRED_ACTIVE[-1].append(jnp.where(bad, jnp.int32(code), jnp.int32(0)))
+
+
+_CODE_TARGET_NEG = register_deferred_message("The `target` has to be a non-negative tensor.")
+_CODE_PREDS_NEG = register_deferred_message("If `preds` are integers, they have to be non-negative.")
+_CODE_TARGET_GT1_MC_FALSE = register_deferred_message(
+    "If you set `multiclass=False`, then `target` should not exceed 1."
+)
+_CODE_PREDS_GT1_MC_FALSE = register_deferred_message(
+    "If you set `multiclass=False` and `preds` are integers, then `preds` should not exceed 1."
+)
+_CODE_TARGET_NOT_BINARY = register_deferred_message(
+    "If `preds` and `target` are of shape (N, ...) and `preds` are floats, `target` should be binary."
+)
+_CODE_TARGET_GE_IMPLIED = register_deferred_message(
+    "The highest label in `target` should be smaller than the size of the `C` dimension of `preds`."
+)
+_CODE_TARGET_GE_NUM_CLASSES = register_deferred_message(
+    "The highest label in `target` should be smaller than `num_classes`."
+)
+_CODE_TARGET_NOT_BINARY_RETRIEVAL = register_deferred_message("`target` must contain `binary` values")
 
 
 def _is_floating(x) -> bool:
@@ -38,26 +142,38 @@ def _check_same_shape(preds, target) -> None:
         )
 
 
-def _basic_input_validation(preds, target, threshold: float, multiclass: Optional[bool]) -> None:
+def _basic_input_validation(
+    preds, target, threshold: float, multiclass: Optional[bool], stats: Optional[_ValueStats] = None
+) -> None:
     """Value-dependent sanity checks — eager path only (skipped under trace)."""
     if _is_floating(target):
         raise ValueError("The `target` has to be an integer tensor.")
-    if _is_tracer(preds) or _is_tracer(target):
-        return
-    if jnp.min(target) < 0:
-        raise ValueError("The `target` has to be a non-negative tensor.")
+    if stats is None:
+        stats = _compute_value_stats(preds, target)
     preds_float = _is_floating(preds)
-    if not preds_float and jnp.min(preds) < 0:
+    if stats is None:
+        # traced: emit deferred in-graph codes instead (no-op outside the context)
+        defer_value_check(jnp.min(target) < 0, _CODE_TARGET_NEG)
+        if not preds_float:
+            defer_value_check(jnp.min(preds) < 0, _CODE_PREDS_NEG)
+        if multiclass is False:
+            defer_value_check(jnp.max(target) > 1, _CODE_TARGET_GT1_MC_FALSE)
+            if not preds_float:
+                defer_value_check(jnp.max(preds) > 1, _CODE_PREDS_GT1_MC_FALSE)
+        return
+    if stats.target_min < 0:
+        raise ValueError("The `target` has to be a non-negative tensor.")
+    if not preds_float and stats.preds_min < 0:
         raise ValueError("If `preds` are integers, they have to be non-negative.")
     if jnp.shape(preds)[0] != jnp.shape(target)[0]:
         raise ValueError("The `preds` and `target` should have the same first dimension.")
-    if multiclass is False and jnp.max(target) > 1:
+    if multiclass is False and stats.target_max > 1:
         raise ValueError("If you set `multiclass=False`, then `target` should not exceed 1.")
-    if multiclass is False and not preds_float and jnp.max(preds) > 1:
+    if multiclass is False and not preds_float and stats.preds_max > 1:
         raise ValueError("If you set `multiclass=False` and `preds` are integers, then `preds` should not exceed 1.")
 
 
-def _check_shape_and_type_consistency(preds, target) -> Tuple[DataType, int]:
+def _check_shape_and_type_consistency(preds, target, stats: Optional[_ValueStats] = None) -> Tuple[DataType, int]:
     """Infer the input case from shapes/dtypes only (trace-safe)."""
     preds_float = _is_floating(preds)
     p_shape, t_shape = jnp.shape(preds), jnp.shape(target)
@@ -68,10 +184,14 @@ def _check_shape_and_type_consistency(preds, target) -> Tuple[DataType, int]:
                 "The `preds` and `target` should have the same shape,",
                 f" got `preds` with shape={p_shape} and `target` with shape={t_shape}.",
             )
-        if preds_float and not _is_tracer(target) and jnp.max(target) > 1:
+        if preds_float and stats is None and not (_is_tracer(preds) or _is_tracer(target)):
+            stats = _compute_value_stats(preds, target)
+        if preds_float and stats is not None and stats.target_max > 1:
             raise ValueError(
                 "If `preds` and `target` are of shape (N, ...) and `preds` are floats, `target` should be binary."
             )
+        if preds_float and stats is None:
+            defer_value_check(jnp.max(target) > 1, _CODE_TARGET_NOT_BINARY)
         if preds.ndim == 1 and preds_float:
             case = DataType.BINARY
         elif preds.ndim == 1 and not preds_float:
@@ -115,7 +235,10 @@ def _check_num_classes_binary(num_classes: int, multiclass: Optional[bool]) -> N
         )
 
 
-def _check_num_classes_mc(preds, target, num_classes: int, multiclass: Optional[bool], implied_classes: int) -> None:
+def _check_num_classes_mc(
+    preds, target, num_classes: int, multiclass: Optional[bool], implied_classes: int,
+    stats: Optional[_ValueStats] = None,
+) -> None:
     if num_classes == 1 and multiclass is not False:
         raise ValueError(
             "You have set `num_classes=1`, but predictions are integers."
@@ -128,8 +251,12 @@ def _check_num_classes_mc(preds, target, num_classes: int, multiclass: Optional[
                 "You have set `multiclass=False`, but the implied number of classes "
                 " (from shape of inputs) does not match `num_classes`."
             )
-        if not _is_tracer(target) and num_classes <= int(jnp.max(target)):
+        if stats is None and not (_is_tracer(preds) or _is_tracer(target)):
+            stats = _compute_value_stats(preds, target)
+        if stats is not None and num_classes <= int(stats.target_max):
             raise ValueError("The highest label in `target` should be smaller than `num_classes`.")
+        if stats is None:
+            defer_value_check(jnp.max(target) >= num_classes, _CODE_TARGET_GE_NUM_CLASSES)
         if jnp.shape(preds) != jnp.shape(target) and num_classes != implied_classes:
             raise ValueError("The size of C dimension of `preds` does not match `num_classes`.")
 
@@ -170,10 +297,13 @@ def _check_classification_inputs(
     num_classes: Optional[int],
     multiclass: Optional[bool],
     top_k: Optional[int],
+    stats: Optional[_ValueStats] = None,
 ) -> DataType:
     """Full input validation; returns the inferred case. Parity: ``checks.py:190-281``."""
-    _basic_input_validation(preds, target, threshold, multiclass)
-    case, implied_classes = _check_shape_and_type_consistency(preds, target)
+    if stats is None:
+        stats = _compute_value_stats(preds, target)
+    _basic_input_validation(preds, target, threshold, multiclass, stats=stats)
+    case, implied_classes = _check_shape_and_type_consistency(preds, target, stats=stats)
 
     if jnp.shape(preds) != jnp.shape(target):
         if multiclass is False and implied_classes != 2:
@@ -181,16 +311,18 @@ def _check_classification_inputs(
                 "You have set `multiclass=False`, but have more than 2 classes in your data,"
                 " based on the C dimension of `preds`."
             )
-        if not _is_tracer(target) and int(jnp.max(target)) >= implied_classes:
+        if stats is not None and int(stats.target_max) >= implied_classes:
             raise ValueError(
                 "The highest label in `target` should be smaller than the size of the `C` dimension of `preds`."
             )
+        if stats is None:
+            defer_value_check(jnp.max(target) >= implied_classes, _CODE_TARGET_GE_IMPLIED)
 
     if num_classes:
         if case == DataType.BINARY:
             _check_num_classes_binary(num_classes, multiclass)
         elif case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS):
-            _check_num_classes_mc(preds, target, num_classes, multiclass, implied_classes)
+            _check_num_classes_mc(preds, target, num_classes, multiclass, implied_classes, stats=stats)
         elif case == DataType.MULTILABEL:
             _check_num_classes_ml(num_classes, multiclass, implied_classes)
 
@@ -231,8 +363,10 @@ def _input_format_classification(
     if preds.dtype in (jnp.float16, jnp.bfloat16):
         preds = preds.astype(jnp.float32)
 
+    stats = _compute_value_stats(preds, target)
     case = _check_classification_inputs(
-        preds, target, threshold=threshold, num_classes=num_classes, multiclass=multiclass, top_k=top_k
+        preds, target, threshold=threshold, num_classes=num_classes, multiclass=multiclass, top_k=top_k,
+        stats=stats,
     )
 
     if case in (DataType.BINARY, DataType.MULTILABEL) and not top_k:
@@ -248,11 +382,11 @@ def _input_format_classification(
             preds = select_topk(preds, top_k or 1)
         else:
             if not num_classes:
-                if _is_tracer(preds) or _is_tracer(target):
+                if stats is None:
                     raise ValueError(
                         "Cannot infer `num_classes` from data inside jit; pass `num_classes` explicitly."
                     )
-                num_classes = int(max(jnp.max(preds), jnp.max(target))) + 1
+                num_classes = int(max(stats.preds_max, stats.target_max)) + 1
             preds = to_onehot(preds, max(2, num_classes))
         target = to_onehot(target, max(2, int(num_classes) if num_classes else 2))
 
@@ -315,6 +449,8 @@ def _check_retrieval_functional_inputs(
         raise ValueError("`preds` must be a tensor of floats")
     if not allow_non_binary_target and not _is_tracer(target) and target.size and int(jnp.max(target)) > 1:
         raise ValueError("`target` must contain `binary` values")
+    if not allow_non_binary_target and _is_tracer(target) and target.size:
+        defer_value_check(jnp.max(target) > 1, _CODE_TARGET_NOT_BINARY_RETRIEVAL)
     preds = jnp.ravel(preds).astype(jnp.float32)
     target = jnp.ravel(target)
     target = target.astype(jnp.float32) if allow_non_binary_target else target.astype(jnp.int32)

@@ -30,13 +30,13 @@ def _bootstrap_sampler(
     sampling_strategy: str = "poisson",
     rng: Optional[np.random.RandomState] = None,
 ) -> Array:
-    """Host resampling indices for one bootstrap draw. Parity: reference ``:25-46``."""
+    """Host resampling indices for one poisson bootstrap draw. Parity: reference
+    ``:25-46``. Only the poisson strategy routes here — multinomial draws with
+    the jax PRNG inside ``BootStrapper.update`` so it stays trace-safe."""
     rng = rng or np.random
     if sampling_strategy == "poisson":
         n = rng.poisson(1, size)
         return jnp.asarray(np.repeat(np.arange(size), n))
-    if sampling_strategy == "multinomial":
-        return jnp.asarray(rng.randint(0, size, size))
     raise ValueError("Unknown sampling strategy")
 
 
@@ -85,6 +85,12 @@ class BootStrapper(Metric):
         # functional updates (state carried by the caller), travels with the
         # state pytree (trace-safe; psum on sync is harmless bookkeeping)
         self.add_state("draw_count", jnp.asarray(0, dtype=jnp.uint32), dist_reduce_fx="sum")
+
+    def _forward_jit_safe(self) -> bool:
+        # poisson resamples with the host numpy RNG per update; a compiled
+        # forward would bake ONE draw into the executable and replay it every
+        # batch (and its repeat-interleave output length is data-dependent)
+        return self.sampling_strategy != "poisson" and super()._forward_jit_safe()
 
     def _batch_size(self, args, kwargs) -> int:
         args_sizes = apply_to_collection(args, jax.Array, lambda x: x.shape[0])

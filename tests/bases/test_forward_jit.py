@@ -1,0 +1,191 @@
+"""The compiled (auto-jit) forward fast path.
+
+``Metric.forward`` compiles the whole update→merge→compute(delta) step per input
+signature after one eager warm-up call (metric.py ``_forward_fast``), beating the
+reference's TWO eager updates per forward (``metric.py:206,218``). These tests pin
+the contract: numerical parity with eager, first-call eager validation, deferred
+in-graph validation afterwards, no instance leaks, bounded signature cache, and
+clean fallback for untraceable updates.
+"""
+import gc
+import weakref
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu.metric as metric_mod
+from metrics_tpu import (
+    Accuracy,
+    BootStrapper,
+    MeanMetric,
+    MeanSquaredError,
+    MetricCollection,
+    WordErrorRate,
+)
+
+RNG = np.random.RandomState(7)
+
+
+def _batch(n=64, c=5):
+    return (
+        jnp.asarray(RNG.rand(n, c).astype(np.float32)),
+        jnp.asarray(RNG.randint(0, c, n)),
+    )
+
+
+def _jit_entries(m):
+    cache = metric_mod._FORWARD_JIT_CACHE.get(m)
+    return [] if not cache else [v for v in cache.values() if callable(v)]
+
+
+def test_fast_path_matches_eager_values():
+    preds, target = _batch()
+    eager_vals, fast_vals = [], []
+    m_fast = Accuracy(num_classes=5)
+    for _ in range(5):
+        fast_vals.append(float(m_fast(preds, target)))
+    # per-call fresh metric never reaches the 2nd (compiled) call
+    for _ in range(5):
+        m = Accuracy(num_classes=5)
+        eager_vals.append(float(m(preds, target)))
+    assert _jit_entries(m_fast), "fast path never compiled"
+    assert np.allclose(fast_vals, [eager_vals[0]] * 5)
+    assert np.isclose(float(m_fast.compute()), eager_vals[0])
+
+
+def test_first_call_validates_eagerly():
+    m = Accuracy()
+    with pytest.raises(ValueError, match="non-negative"):
+        m(jnp.asarray([[0.2, 0.8]]), jnp.asarray([-1]))
+
+
+def test_deferred_validation_after_warmup():
+    preds, target = _batch()
+    m = Accuracy(num_classes=5)
+    for _ in range(3):
+        m(preds, target)
+    assert _jit_entries(m)
+    m(preds, jnp.asarray(np.full(64, 99)))  # bad labels on the COMPILED path
+    with pytest.raises(ValueError, match="smaller than `num_classes`"):
+        m.compute()
+    # reset clears the deferred code and the metric is usable again
+    m.reset()
+    m(preds, target)
+    assert 0.0 <= float(m.compute()) <= 1.0
+
+
+def test_deferred_error_is_sticky_until_reset():
+    preds, target = _batch()
+    m = Accuracy(num_classes=5)
+    for _ in range(3):
+        m(preds, target)
+    m(preds, jnp.asarray(np.full(64, 99)))
+    # the merged state is corrupted: EVERY compute must keep raising, not just
+    # the first (a caught-and-retried compute must not return a garbage value)
+    for _ in range(3):
+        with pytest.raises(ValueError, match="num_classes"):
+            m.compute()
+    m.reset()
+    m(preds, target)
+    assert 0.0 <= float(m.compute()) <= 1.0
+
+
+def test_compute_on_step_toggle_not_baked_into_cache():
+    preds, target = _batch()
+    m = Accuracy(num_classes=5, compute_on_step=False)
+    assert m(preds, target) is None
+    assert m(preds, target) is None  # compiled with value suppressed
+    m.compute_on_step = True
+    assert m(preds, target) is not None  # new cache key, value computed
+
+
+def test_no_instance_leak_through_jit_cache():
+    m = Accuracy(num_classes=5)
+    preds, target = _batch()
+    for _ in range(3):
+        m(preds, target)
+    assert _jit_entries(m)
+    ref = weakref.ref(m)
+    del m
+    gc.collect()
+    assert ref() is None, "compiled step closure pinned the metric alive"
+
+
+def test_python_float_args_share_one_signature():
+    m = MeanMetric(nan_strategy="ignore")
+    for i in range(40):
+        m(0.25 * i)
+    cache = metric_mod._FORWARD_JIT_CACHE.get(m)
+    assert cache is not None and len(cache) == 1
+    assert np.isclose(float(m.compute()), np.mean([0.25 * i for i in range(40)]))
+
+
+def test_signature_cache_is_bounded():
+    m = MeanSquaredError()
+    for n in range(1, metric_mod.Metric._FORWARD_JIT_MAX_SIGNATURES + 20):
+        x = jnp.zeros(n)
+        m(x, x)
+    cache = metric_mod._FORWARD_JIT_CACHE.get(m)
+    assert cache is not None
+    assert len(cache) <= metric_mod.Metric._FORWARD_JIT_MAX_SIGNATURES
+
+
+def test_text_metric_stays_eager():
+    m = WordErrorRate()
+    for _ in range(3):
+        m(["hello there world"], ["hello there word"])
+    assert not _jit_entries(m)
+    assert float(m.compute()) > 0
+
+
+def test_nan_error_aggregator_stays_eager_and_raises_every_batch():
+    m = MeanMetric(nan_strategy="error")
+    for _ in range(3):
+        m(jnp.asarray([1.0, 2.0]))
+    with pytest.raises(RuntimeError, match="nan"):
+        m(jnp.asarray([1.0, float("nan")]))
+
+
+def test_poisson_bootstrapper_decorrelates_batches():
+    bs = BootStrapper(
+        MeanSquaredError(), num_bootstraps=6, sampling_strategy="poisson", seed=3,
+        raw=True, mean=False, std=False,
+    )
+    rng = np.random.RandomState(11)
+    raws = []
+    for _ in range(4):
+        x = jnp.asarray(rng.randn(96).astype(np.float32))
+        y = jnp.asarray(rng.randn(96).astype(np.float32))
+        raws.append(np.asarray(bs(x, y)["raw"]))
+    assert not _jit_entries(bs), "poisson must stay on the eager path (host RNG)"
+    # bootstrap replicas within a batch must differ (fresh draws, not a frozen one)
+    assert all(np.std(r) > 0 for r in raws)
+
+
+def test_collection_members_compile_independently():
+    from metrics_tpu import F1Score
+
+    mc = MetricCollection([Accuracy(num_classes=5), F1Score(num_classes=5)])
+    preds, target = _batch()
+    vals = [mc(preds, target) for _ in range(4)]
+    for m in mc.values():
+        assert _jit_entries(m), f"{type(m).__name__} did not compile"
+    for k in vals[0]:
+        assert np.isclose(float(vals[0][k]), float(vals[-1][k]))
+
+
+def test_forward_inside_user_jit_falls_back():
+    import jax
+
+    m = MeanSquaredError()
+    x = jnp.asarray(RNG.rand(32).astype(np.float32))
+    for _ in range(3):
+        m(x, x * 1.1)  # warm compiled path
+
+    @jax.jit
+    def user_step(p, t):
+        return m.update_state(m.init_state(), p, t)
+
+    delta = user_step(x, x * 0.9)
+    assert float(m.compute_from(delta)) >= 0
