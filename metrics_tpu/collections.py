@@ -10,14 +10,17 @@ one pytree and syncs them in a single fused collective bundle
 member, where the reference issues O(metrics x states) sequential all_gathers
 (``metric.py:240-245``).
 """
+import weakref
 from copy import deepcopy
 from typing import Any, Dict, Hashable, Iterable, List, Optional, Sequence, Tuple, Union
 
 import jax
+import jax.numpy as jnp
 
-from metrics_tpu.metric import Metric
+from metrics_tpu.metric import _EAGER_ONLY, _FORWARD_JIT_CACHE, _MISS, Metric, _jit_cache_lookup
 from metrics_tpu.parallel.collectives import fused_axis_sync, in_mapped_context
 from metrics_tpu.parallel.mesh import current_metric_axis
+from metrics_tpu.utils.checks import deferred_value_checks
 from metrics_tpu.utils.data import dim_zero_cat
 
 
@@ -87,11 +90,133 @@ class MetricCollection(dict):
     # ------------------------------------------------------------------- eager facade
 
     def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
-        """Call every member; returns dict of per-batch values. Parity: ``:103-110``."""
+        """Call every member; returns dict of per-batch values. Parity: ``:103-110``.
+
+        When every member is trace-safe, the whole collection compiles into ONE
+        XLA executable (all members' update→merge→compute(delta) fused — the
+        eager-facade twin of the fused ``update_state``/``sync_states`` path);
+        otherwise falls back to the per-member loop, where each member still
+        uses its own compiled forward if it can.
+        """
+        fast = self._forward_fused(args, kwargs)
+        if fast is not _MISS:
+            return fast
         return {self._set_name(k): m(*args, **m._filter_kwargs(**kwargs)) for k, m in self.items(keep_base=True)}
 
     def __call__(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
         return self.forward(*args, **kwargs)
+
+    def _forward_fused(self, args: Any, kwargs: Any):
+        """Fused compiled forward (same per-signature protocol as
+        ``Metric._forward_fast``: 1st call eager for validation, 2nd compiles,
+        untraceable collections permanently fall back). Returns the renamed
+        value dict or ``_MISS``."""
+        members = list(self.items(keep_base=True))
+        if not members:
+            return _MISS
+        for _, m in members:
+            if m.dist_sync_on_step or m.dist_sync_fn is not None or not m._defaults or m._is_synced:
+                return _MISS
+            if not m._states_mergeable:
+                # full_state_update members need the snapshot/double-update path
+                # (Metric.forward gates on this BEFORE its fast path — so must we)
+                return _MISS
+            path_ok = getattr(m, "_fwd_path_ok", None)
+            if path_ok is None:
+                path_ok = m._forward_jit_safe() and not m._has_list_state()
+                m._fwd_path_ok = path_ok
+            if not path_ok:
+                return _MISS
+        parsed = Metric._forward_signature(args, kwargs)
+        if parsed is None:
+            return _MISS
+        inner_sig, array_idx, leaves = parsed
+        # membership identity + each member's baked compute_on_step key the trace
+        sig = (inner_sig, tuple((k, id(m), bool(m.compute_on_step)) for k, m in members))
+        entry, cache = _jit_cache_lookup(self, sig, lambda: self._build_fused_step(inner_sig, array_idx, leaves))
+        if entry is None:
+            return _MISS
+        try:
+            states = {k: m._pack_state() for k, m in members}
+            merged, values, codes = entry(states, [leaves[i] for i in array_idx])
+        except Exception:
+            cache[sig] = _EAGER_ONLY
+            return _MISS
+        out: Dict[str, Any] = {}
+        for k, m in members:
+            m._load_state(merged[k])
+            m._computed = None
+            m._update_called = True
+            val = values[k] if m.compute_on_step else None
+            m._forward_cache = val
+            m._deferred_errcode = (
+                codes[k] if m._deferred_errcode is None else jnp.maximum(m._deferred_errcode, codes[k])
+            )
+            out[self._set_name(k)] = val
+        return out
+
+    def _build_fused_step(self, inner_sig: Any, array_idx: Sequence[int], leaves: Sequence[Any]):
+        treedef = inner_sig[0]
+        n_leaves = len(leaves)
+        consts = {i: leaf for i, leaf in enumerate(leaves) if i not in array_idx}
+        compute_on_step = {k: bool(m.compute_on_step) for k, m in self.items(keep_base=True)}
+        # weak binding: the compiled step must not pin the collection (or its
+        # members, reachable through it) in the jit cache
+        wself = weakref.ref(self)
+
+        def step(states: Dict[str, Dict[str, Any]], arrays: Sequence[Any]):
+            coll = wself()
+            assert coll is not None  # caller holds a strong ref for the call
+            merged_leaves: List[Any] = [None] * n_leaves
+            for i, arr in zip(array_idx, arrays):
+                merged_leaves[i] = arr
+            for i, c in consts.items():
+                merged_leaves[i] = c
+            a, kw = jax.tree_util.tree_unflatten(treedef, merged_leaves)
+            merged: Dict[str, Any] = {}
+            values: Dict[str, Any] = {}
+            codes: Dict[str, Any] = {}
+            for k, m in coll.items(keep_base=True):
+                with deferred_value_checks() as checks:
+                    delta = m.update_state(m.init_state(), *a, **m._filter_kwargs(**kw))
+                merged[k] = m.merge_states(states[k], delta)
+                values[k] = m.compute_from(delta) if compute_on_step[k] else None
+                codes[k] = checks.combined()
+            return merged, values, codes
+
+        return jax.jit(step)
+
+    # identity hash AND identity eq (dict itself is unhashable; pinning only
+    # hash would break the hash/eq invariant for value-equal collections):
+    # needed to key the weak jit cache, and matches the reference where
+    # MetricCollection is an nn.ModuleDict (identity semantics)
+    __hash__ = object.__hash__
+    __eq__ = object.__eq__
+    __ne__ = object.__ne__
+
+    def _invalidate_fused(self) -> None:
+        """Membership changed: drop all fused traces (and their cache-budget slots)."""
+        _FORWARD_JIT_CACHE.pop(self, None)
+
+    def __setitem__(self, key: str, value: Metric) -> None:
+        self._invalidate_fused()
+        super().__setitem__(key, value)
+
+    def __delitem__(self, key: str) -> None:
+        self._invalidate_fused()
+        super().__delitem__(key)
+
+    def pop(self, *args: Any) -> Metric:
+        self._invalidate_fused()
+        return super().pop(*args)
+
+    def popitem(self) -> Tuple[str, Metric]:
+        self._invalidate_fused()
+        return super().popitem()
+
+    def clear(self) -> None:
+        self._invalidate_fused()
+        super().clear()
 
     def update(self, *args: Any, **kwargs: Any) -> None:
         for _, m in self.items(keep_base=True):

@@ -64,6 +64,34 @@ _PENDING = object()  # sentinel: first call seen eagerly; compile on the next on
 _MISS = object()  # sentinel: fast path not taken this call
 
 
+def _jit_cache_lookup(owner: Any, sig: Any, builder: Callable):
+    """The per-signature compile protocol shared by ``Metric._forward_fast`` and
+    ``MetricCollection._forward_fused``: 1st call registers _PENDING (caller runs
+    eager validation), 2nd call invokes ``builder`` to compile, later calls reuse.
+
+    Returns ``(entry, cache)`` — entry is None when the caller must stay eager
+    this call (miss, pending-just-registered, eager-only, or cache full).
+    """
+    cache = _FORWARD_JIT_CACHE.get(owner)
+    if cache is None:
+        cache = {}
+        try:
+            _FORWARD_JIT_CACHE[owner] = cache
+        except TypeError:  # owner not weakref-able
+            return None, None
+    entry = cache.get(sig)
+    if entry is _EAGER_ONLY:
+        return None, cache
+    if entry is None:
+        if len(cache) < Metric._FORWARD_JIT_MAX_SIGNATURES:
+            cache[sig] = _PENDING
+        return None, cache
+    if entry is _PENDING:
+        entry = builder()
+        cache[sig] = entry
+    return entry, cache
+
+
 def _squeeze_if_scalar(x: Any) -> Any:
     """0-d-ify single-element arrays, mirroring reference ``metric.py:382``."""
 
@@ -623,24 +651,9 @@ class Metric:
             return _MISS
         sig, array_idx, leaves = parsed
         sig = (sig, bool(self.compute_on_step))  # compute_on_step is baked into the step
-        cache = _FORWARD_JIT_CACHE.get(self)
-        if cache is None:
-            cache = {}
-            try:
-                _FORWARD_JIT_CACHE[self] = cache
-            except TypeError:  # instance not weakref-able
-                return _MISS
-        entry = cache.get(sig)
-        if entry is _EAGER_ONLY:
-            return _MISS
+        entry, cache = _jit_cache_lookup(self, sig, lambda: self._build_forward_step(sig, array_idx, leaves))
         if entry is None:
-            if len(cache) >= self._FORWARD_JIT_MAX_SIGNATURES:
-                return _MISS  # signature churn (e.g. varying shapes): stay eager
-            cache[sig] = _PENDING
             return _MISS
-        if entry is _PENDING:
-            entry = self._build_forward_step(sig, array_idx, leaves)
-            cache[sig] = entry
         try:
             merged, value, errcode = entry(self._pack_state(), [leaves[i] for i in array_idx])
         except Exception:

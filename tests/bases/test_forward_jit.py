@@ -163,16 +163,126 @@ def test_poisson_bootstrapper_decorrelates_batches():
     assert all(np.std(r) > 0 for r in raws)
 
 
-def test_collection_members_compile_independently():
+def test_collection_forward_compiles_fused():
     from metrics_tpu import F1Score
 
-    mc = MetricCollection([Accuracy(num_classes=5), F1Score(num_classes=5)])
+    mc = MetricCollection([Accuracy(num_classes=5), F1Score(num_classes=5)], prefix="v_")
     preds, target = _batch()
     vals = [mc(preds, target) for _ in range(4)]
-    for m in mc.values():
-        assert _jit_entries(m), f"{type(m).__name__} did not compile"
+    cache = metric_mod._FORWARD_JIT_CACHE.get(mc)
+    assert cache and any(callable(v) for v in cache.values()), "fused step did not compile"
+    assert set(vals[0]) == {"v_Accuracy", "v_F1Score"}
     for k in vals[0]:
         assert np.isclose(float(vals[0][k]), float(vals[-1][k]))
+    comp = mc.compute()
+    assert np.isclose(float(comp["v_Accuracy"]), float(vals[0]["v_Accuracy"]))
+
+
+def test_collection_fused_matches_eager_loop():
+    from metrics_tpu import F1Score, Precision
+
+    preds, target = _batch()
+    mc = MetricCollection([Accuracy(num_classes=5), F1Score(num_classes=5), Precision(num_classes=5)])
+    for _ in range(4):
+        fused_vals = mc(preds, target)
+    ref = MetricCollection([Accuracy(num_classes=5), F1Score(num_classes=5), Precision(num_classes=5)])
+    eager_vals = ref(preds, target)  # first call: always the eager loop
+    for k in eager_vals:
+        assert np.isclose(float(fused_vals[k]), float(eager_vals[k])), k
+    assert np.isclose(float(mc.compute()["Accuracy"]), float(ref.compute()["Accuracy"]))
+
+
+def test_collection_mutation_invalidates_fused_trace():
+    from metrics_tpu import F1Score
+
+    preds, target = _batch()
+    mc = MetricCollection([Accuracy(num_classes=5)])
+    for _ in range(3):
+        mc(preds, target)
+    assert any(callable(v) for v in (metric_mod._FORWARD_JIT_CACHE.get(mc) or {}).values())
+    mc["F1Score"] = F1Score(num_classes=5)
+    assert not metric_mod._FORWARD_JIT_CACHE.get(mc), "stale fused trace survived membership change"
+    out = [mc(preds, target) for _ in range(3)][-1]
+    assert set(out) == {"Accuracy", "F1Score"}
+
+
+def test_collection_fused_deferred_validation():
+    preds, target = _batch()
+    mc = MetricCollection([Accuracy(num_classes=5)])
+    for _ in range(3):
+        mc(preds, target)
+    mc(preds, jnp.asarray(np.full(64, 77)))
+    with pytest.raises(ValueError, match="num_classes"):
+        mc.compute()
+    mc.reset()
+    mc(preds, target)
+    assert 0.0 <= float(mc.compute()["Accuracy"]) <= 1.0
+
+
+def test_collection_full_state_update_member_uses_snapshot_path():
+    """A full_state_update member must keep the snapshot/double-update path even
+    inside a collection — the fused delta-merge would compute wrong values."""
+    from metrics_tpu.metric import Metric
+
+    class RunningMeanMax(Metric):
+        # update reads accumulated state: delta-merge is NOT equivalent
+        full_state_update = True
+
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+            self.add_state("n", jnp.asarray(0.0), dist_reduce_fx="sum")
+            self.add_state("peak_mean", jnp.asarray(-jnp.inf), dist_reduce_fx="max")
+
+        def update(self, x):
+            self.total = self.total + jnp.sum(x)
+            self.n = self.n + x.size
+            self.peak_mean = jnp.maximum(self.peak_mean, self.total / self.n)
+
+        def compute(self):
+            return self.peak_mean
+
+    batches = [jnp.zeros(2), jnp.zeros(2), jnp.zeros(2), jnp.full(2, 20.0)]
+    solo = RunningMeanMax()
+    for b in batches:
+        solo(b)
+    expected = float(solo.compute())
+
+    mc = MetricCollection({"rmm": RunningMeanMax()})
+    for b in batches:
+        mc(b)
+    assert np.isclose(float(mc.compute()["rmm"]), expected), (
+        float(mc.compute()["rmm"]),
+        expected,
+    )
+    assert not (metric_mod._FORWARD_JIT_CACHE.get(mc) or {}) or not any(
+        callable(v) for v in metric_mod._FORWARD_JIT_CACHE[mc].values()
+    ), "full_state_update member must not take the fused path"
+
+
+def test_collection_removal_invalidates_fused_trace():
+    from metrics_tpu import F1Score
+
+    preds, target = _batch()
+    mc = MetricCollection([Accuracy(num_classes=5), F1Score(num_classes=5)])
+    for _ in range(3):
+        mc(preds, target)
+    assert any(callable(v) for v in (metric_mod._FORWARD_JIT_CACHE.get(mc) or {}).values())
+    del mc["F1Score"]
+    assert not metric_mod._FORWARD_JIT_CACHE.get(mc)
+    out = [mc(preds, target) for _ in range(3)][-1]
+    assert set(out) == {"Accuracy"}
+
+
+def test_collection_no_leak_through_fused_cache():
+    preds, target = _batch()
+    mc = MetricCollection([Accuracy(num_classes=5)])
+    for _ in range(3):
+        mc(preds, target)
+    ref = weakref.ref(mc)
+    del mc
+    gc.collect()
+    assert ref() is None, "fused step closure pinned the collection alive"
 
 
 def test_forward_inside_user_jit_falls_back():
