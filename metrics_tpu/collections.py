@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 
 from metrics_tpu.metric import _EAGER_ONLY, _FORWARD_JIT_CACHE, _MISS, Metric, _jit_cache_lookup
-from metrics_tpu.parallel.collectives import fused_axis_sync, in_mapped_context
+from metrics_tpu.parallel.collectives import AxisSpec, fused_axis_sync, in_mapped_context
 from metrics_tpu.parallel.mesh import current_metric_axis
 from metrics_tpu.utils.checks import deferred_value_checks
 from metrics_tpu.utils.data import dim_zero_cat
@@ -265,7 +265,7 @@ class MetricCollection(dict):
         }
 
     def sync_states(
-        self, state: Dict[str, Dict[str, Any]], axis_name: Optional[str] = None
+        self, state: Dict[str, Dict[str, Any]], axis_name: Optional[AxisSpec] = None
     ) -> Dict[str, Dict[str, Any]]:
         """Fused cross-axis sync of ALL member states in one collective bundle."""
         axis = axis_name or current_metric_axis()
@@ -296,7 +296,7 @@ class MetricCollection(dict):
     def compute_from(self, state: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
         return {self._set_name(k): m.compute_from(state[k]) for k, m in self.items(keep_base=True)}
 
-    def compute_synced(self, state: Dict[str, Dict[str, Any]], axis_name: Optional[str] = None) -> Dict[str, Any]:
+    def compute_synced(self, state: Dict[str, Dict[str, Any]], axis_name: Optional[AxisSpec] = None) -> Dict[str, Any]:
         return self.compute_from(self.sync_states(state, axis_name))
 
     # ------------------------------------------------------------------------- naming

@@ -40,6 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu.parallel.collectives import (
+    AxisSpec,
     axis_size_or_one,
     fused_axis_sync,
     in_mapped_context,
@@ -326,12 +327,12 @@ class Metric:
             self._load_state(saved)
             self._restore_bookkeeping(book)
 
-    def compute_synced(self, state: Dict[str, Any], axis_name: Optional[str] = None) -> Any:
+    def compute_synced(self, state: Dict[str, Any], axis_name: Optional[AxisSpec] = None) -> Any:
         """Pure sync+compute for use inside ``shard_map``/``pmap`` regions."""
         axis = axis_name or self.sync_axis or current_metric_axis()
         return self.compute_from(self.sync_states(state, axis))
 
-    def sync_states(self, state: Dict[str, Any], axis_name: Optional[str]) -> Dict[str, Any]:
+    def sync_states(self, state: Dict[str, Any], axis_name: Optional[AxisSpec]) -> Dict[str, Any]:
         """Apply each state's dist_reduce_fx as an XLA collective over ``axis_name``.
 
         List states are pre-concatenated (reference ``metric.py:236-238``) then
@@ -367,7 +368,7 @@ class Metric:
             out[self._CHILD_KEY] = synced_children
         return out
 
-    def _sync_child_states(self, children_state: Dict[str, Any], axis_name: str) -> Dict[str, Any]:
+    def _sync_child_states(self, children_state: Dict[str, Any], axis_name: AxisSpec) -> Dict[str, Any]:
         """Sync a '_children' subtree: each nested metric applies its own
         reductions (shared by Metric.sync_states and MetricCollection's fused
         path, which fuses member leaves but must still recurse here)."""

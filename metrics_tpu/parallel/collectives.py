@@ -12,7 +12,7 @@ MetricCollection into one flat buffer per reduction and issues a single ``psum``
 bundle — O(1) collectives where the reference issues O(metrics x states)
 (``metric.py:240-245``).
 """
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -22,33 +22,50 @@ from metrics_tpu.utils.data import METRIC_EPS
 
 Array = jax.Array
 
+# an axis spec: one mesh-axis name or a tuple of names (multi-axis collectives)
+AxisSpec = Union[str, Tuple[str, ...]]
 
-def in_mapped_context(axis_name: Optional[str]) -> bool:
-    """True if ``axis_name`` is bound by an enclosing shard_map/pmap trace."""
+
+def _axis_names(axis_name: Any) -> Tuple[Any, ...]:
+    """Normalize an axis spec (single name or tuple of names — multi-axis
+    collectives like ``("dp", "grp")`` are first-class in XLA) to a tuple."""
+    return tuple(axis_name) if isinstance(axis_name, (tuple, list)) else (axis_name,)
+
+
+def in_mapped_context(axis_name: Optional[AxisSpec]) -> bool:
+    """True if every axis in ``axis_name`` is bound by an enclosing shard_map/pmap."""
     if axis_name is None:
+        return False
+    names = _axis_names(axis_name)
+    if not names:
         return False
     try:
         from jax._src.core import get_axis_env
 
-        return bool(get_axis_env().axis_exists(axis_name))
+        env = get_axis_env()
+        return all(bool(env.axis_exists(n)) for n in names)
     except Exception:
         return False
 
 
-def axis_size_or_one(axis_name: Optional[str]) -> int:
+def axis_size_or_one(axis_name: Optional[AxisSpec]) -> int:
     if not in_mapped_context(axis_name):
         return 1
     from jax._src.core import get_axis_env
 
-    return int(get_axis_env().axis_size(axis_name))
+    env = get_axis_env()
+    size = 1
+    for n in _axis_names(axis_name):
+        size *= int(env.axis_size(n))
+    return size
 
 
-def all_gather_cat(x: Array, axis_name: str) -> Array:
+def all_gather_cat(x: Array, axis_name: AxisSpec) -> Array:
     """Gather shards along dim 0 (the "cat" reduction): (n,...) -> (world*n, ...)."""
     return lax.all_gather(x, axis_name, tiled=True)
 
 
-def all_gather_stack(x: Array, axis_name: str) -> Array:
+def all_gather_stack(x: Array, axis_name: AxisSpec) -> Array:
     """Gather shards stacked on a new leading dim: (...,) -> (world, ...).
 
     Matches the reference's post-sync layout for ``dist_reduce_fx=None`` tensor states
@@ -65,7 +82,7 @@ _REDUCE_COLLECTIVES: Dict[str, Callable] = {
 }
 
 
-def sync_axis_state(reduce_fx: Any, value: Array, axis_name: str) -> Array:
+def sync_axis_state(reduce_fx: Any, value: Array, axis_name: AxisSpec) -> Array:
     """Lower one state's ``dist_reduce_fx`` to the matching XLA collective."""
     if reduce_fx in _REDUCE_COLLECTIVES:
         return _REDUCE_COLLECTIVES[reduce_fx](value, axis_name)
@@ -84,7 +101,7 @@ def sync_axis_state(reduce_fx: Any, value: Array, axis_name: str) -> Array:
 
 
 def fused_axis_sync(
-    leaves: List[Tuple[Any, Array]], axis_name: str
+    leaves: List[Tuple[Any, Array]], axis_name: AxisSpec
 ) -> List[Array]:
     """Sync many (reduce_fx, value) state leaves with a minimal collective bundle.
 

@@ -195,3 +195,42 @@ def test_collection_with_wrapper_member_fused_sync(devices):
     np.testing.assert_allclose(out[0], preds[:, 0].sum(), rtol=1e-5)
     expected_mse = float(np.mean((preds - target) ** 2))
     np.testing.assert_allclose(out[1], expected_mse, rtol=1e-5)
+
+
+def test_tuple_axis_sync(devices):
+    """Multi-axis sync over a 2D mesh: axis_name=("dp","grp") must psum over the
+    WHOLE mesh, not silently no-op (in_mapped_context must handle tuples —
+    regression for the dryrun_multichip parity bug)."""
+    from metrics_tpu.parallel.collectives import axis_size_or_one, in_mapped_context
+
+    m = DummyMetricSum()
+    mesh2d = Mesh(np.asarray(jax.devices()).reshape(4, 2), ("dp", "grp"))
+
+    @partial(jax.shard_map, mesh=mesh2d, in_specs=P(("dp", "grp")), out_specs=P(), check_vma=False)
+    def run(x):
+        assert in_mapped_context(("dp", "grp")) and in_mapped_context("dp")
+        assert not in_mapped_context(("dp", "nope"))
+        assert axis_size_or_one(("dp", "grp")) == 8
+        state = m.init_state()
+        state = m.update_state(state, x[0])
+        return m.compute_synced(state, ("dp", "grp"))
+
+    out = run(jnp.arange(8.0))
+    assert float(out) == sum(range(8))
+
+
+def test_tuple_axis_subaxis_sync(devices):
+    """Sub-axis sync on a 2D mesh: syncing over 'dp' only must reduce within
+    each dp-column, leaving grp-groups independent."""
+    m = DummyMetricSum()
+    mesh2d = Mesh(np.asarray(jax.devices()).reshape(4, 2), ("dp", "grp"))
+
+    @partial(jax.shard_map, mesh=mesh2d, in_specs=P(("dp", "grp")), out_specs=P("grp"), check_vma=False)
+    def run(x):
+        state = m.init_state()
+        state = m.update_state(state, x[0])
+        return jnp.reshape(m.compute_synced(state, "dp"), (1,))
+
+    out = np.asarray(run(jnp.arange(8.0)))
+    # device order: (dp, grp) row-major — grp-col 0 holds x[0,2,4,6], col 1 x[1,3,5,7]
+    assert out.tolist() == [0 + 2 + 4 + 6, 1 + 3 + 5 + 7]
