@@ -145,8 +145,7 @@ class MetricCollection(dict):
         out: Dict[str, Any] = {}
         for k, m in members:
             m._load_state(merged[k])
-            m._computed = None
-            m._update_called = True
+            m._mark_updated()
             val = values[k] if m.compute_on_step else None
             m._forward_cache = val
             m._deferred_errcode = (

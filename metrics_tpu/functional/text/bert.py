@@ -10,6 +10,7 @@ bert_score :452). TPU-native differences:
   * matching is one batched einsum (L_p x L_r similarity per pair) + masked max —
     MXU work, no python token loops.
 """
+import zlib
 from collections import Counter, OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
@@ -85,13 +86,18 @@ def _jitted_forward(key_obj: Any, fn: Callable) -> Callable:
 
 
 def _simple_whitespace_tokenizer(sentences: List[str], max_length: int) -> Dict[str, np.ndarray]:
-    """Fallback host tokenizer: whitespace tokens hashed into ids (no vocab file)."""
+    """Fallback host tokenizer: whitespace tokens hashed into ids (no vocab file).
+
+    Uses crc32, NOT the builtin ``hash`` — python string hashing is salted per
+    process, which would give the same text different ids on different hosts
+    (inconsistent metric values under multi-host sync) and on every rerun.
+    """
     ids = np.zeros((len(sentences), max_length), dtype=np.int32)
     mask = np.zeros((len(sentences), max_length), dtype=np.int32)
     for i, s in enumerate(sentences):
         toks = s.split()[:max_length]
         for j, t in enumerate(toks):
-            ids[i, j] = (hash(t) % 30000) + 1
+            ids[i, j] = (zlib.crc32(t.encode("utf-8")) % 30000) + 1
         mask[i, : len(toks)] = 1
     return {"input_ids": ids, "attention_mask": mask}
 

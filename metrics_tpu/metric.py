@@ -120,6 +120,16 @@ class Metric:
     and ``compute(self)`` (reading them), exactly like the reference. States are
     registered with :meth:`add_state`.
 
+    Compiled forward: after one eager warm-up call per input signature,
+    ``forward`` runs the whole update→merge→compute(delta) step as a single XLA
+    executable. The warm-up call validates input VALUES eagerly; afterwards the
+    same checks run in-graph and raise deferred — at the next ``compute()``/
+    ``sync()``, stickily until ``reset()``. Updates that cannot trace (host-side
+    string/detection work, data-dependent control flow) fall back to the eager
+    path permanently for that signature; metrics whose eager semantics must see
+    every concrete batch (e.g. aggregators with ``nan_strategy='error'``)
+    opt out via ``_forward_jit_safe``.
+
     Args:
         compute_on_step: return the metric value for the current batch from ``forward``.
         dist_sync_on_step: synchronise state across the mesh axis every ``forward``.
@@ -298,6 +308,14 @@ class Metric:
             m._for_each_child(visit)
 
         visit(self)
+
+    def _mark_updated(self) -> None:
+        """Set post-update bookkeeping on self AND nested metrics — a wrapper's
+        forward accumulates its children's state too, so their compute() must
+        not warn about a missing update."""
+        self._computed = None
+        self._update_called = True
+        self._for_each_child(lambda c: c._mark_updated())
 
     def update_state(self, state: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:
         """Pure update: ``new_state = f(state, batch)``. Safe inside jit/scan/shard_map.
@@ -515,15 +533,13 @@ class Metric:
             if fast is not _MISS:
                 merged, value = fast
                 self._load_state(merged)
-                self._computed = None
-                self._update_called = True
+                self._mark_updated()
                 self._forward_cache = value if self.compute_on_step else None
                 return self._forward_cache
             delta = self.update_state(self.init_state(), *args, **kwargs)
             merged = self.merge_states(self._pack_state(), delta)
             self._load_state(merged)
-            self._computed = None
-            self._update_called = True
+            self._mark_updated()
             if not self.compute_on_step:
                 self._forward_cache = None
                 return None

@@ -285,6 +285,23 @@ def test_collection_no_leak_through_fused_cache():
     assert ref() is None, "fused step closure pinned the collection alive"
 
 
+def test_minmax_wrapper_compiles_and_children_marked_updated():
+    import warnings
+
+    from metrics_tpu import MinMaxMetric
+
+    preds, target = _batch()
+    mm = MinMaxMetric(Accuracy(num_classes=5))
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # spurious "compute before update" fails
+        for _ in range(4):
+            mm(preds, target)
+        vals = mm.compute()
+    assert _jit_entries(mm), "MinMax wrapper did not compile"
+    assert np.isclose(float(vals["min"]), float(vals["max"]))
+    assert 0.0 <= float(vals["raw"]) <= 1.0
+
+
 def test_forward_inside_user_jit_falls_back():
     import jax
 

@@ -298,10 +298,12 @@ class TestBERTScore:
     def _dummy_forward(ids, mask):
         import jax.numpy as jnp
 
-        # deterministic "embedding": token id -> 8-dim pseudo-random vector
+        # deterministic "embedding": token id -> 8-dim pseudo-random vector.
+        # +0.5 keeps every vector nonzero (an id divisible by 97 would otherwise
+        # map to sin(0)=0 in all dims — a zero-norm cosine degenerate)
         d = 8
         base = (ids[..., None] * jnp.arange(1, d + 1)) % 97
-        return jnp.sin(base.astype(jnp.float32))
+        return jnp.sin(base.astype(jnp.float32) + 0.5)
 
     def test_identical_sentences_score_one(self):
         from metrics_tpu.functional import bert_score
@@ -321,4 +323,6 @@ class TestBERTScore:
         m.update(PREDS_SINGLE, REFS_SINGLE)
         out = m.compute()
         assert len(out["f1"]) == 2
-        assert all(0 <= x <= 1 for x in out["f1"])
+        # 1e-6 slack: greedy-cosine f1 of identical texts is exactly 1.0, which
+        # threaded CPU reductions intermittently round to 1 + O(1e-7)
+        assert all(-1e-6 <= x <= 1 + 1e-6 for x in out["f1"])
