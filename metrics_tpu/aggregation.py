@@ -81,7 +81,16 @@ class BaseAggregator(Metric):
 
 
 class MaxMetric(BaseAggregator):
-    """Running max. Parity: reference ``aggregation.py:112-174``."""
+    """Running max. Parity: reference ``aggregation.py:112-174``.
+
+    Example:
+        >>> from metrics_tpu import MaxMetric
+        >>> metric = MaxMetric()
+        >>> for v in [1.0, 5.0, 3.0]:
+        ...     metric.update(v)
+        >>> print(f"{float(metric.compute()):.4f}")
+        5.0000
+    """
 
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
         super().__init__("max", jnp.asarray(-jnp.inf), nan_strategy, **kwargs)
@@ -94,7 +103,16 @@ class MaxMetric(BaseAggregator):
 
 
 class MinMetric(BaseAggregator):
-    """Running min. Parity: reference ``aggregation.py:177-239``."""
+    """Running min. Parity: reference ``aggregation.py:177-239``.
+
+    Example:
+        >>> from metrics_tpu import MinMetric
+        >>> metric = MinMetric()
+        >>> for v in [4.0, 2.0, 3.0]:
+        ...     metric.update(v)
+        >>> print(f"{float(metric.compute()):.4f}")
+        2.0000
+    """
 
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
         super().__init__("min", jnp.asarray(jnp.inf), nan_strategy, **kwargs)
@@ -107,7 +125,16 @@ class MinMetric(BaseAggregator):
 
 
 class SumMetric(BaseAggregator):
-    """Running sum. Parity: reference ``aggregation.py:242-297``."""
+    """Running sum. Parity: reference ``aggregation.py:242-297``.
+
+    Example:
+        >>> from metrics_tpu import SumMetric
+        >>> metric = SumMetric()
+        >>> for v in [1.0, 2.0, 3.0]:
+        ...     metric.update(v)
+        >>> print(f"{float(metric.compute()):.4f}")
+        6.0000
+    """
 
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
         super().__init__("sum", jnp.asarray(0.0), nan_strategy, **kwargs)
@@ -120,7 +147,17 @@ class SumMetric(BaseAggregator):
 
 
 class CatMetric(BaseAggregator):
-    """Concatenate all seen values. Parity: reference ``aggregation.py:300-360``."""
+    """Concatenate all seen values. Parity: reference ``aggregation.py:300-360``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import CatMetric
+        >>> metric = CatMetric()
+        >>> metric.update(jnp.asarray([1.0]))
+        >>> metric.update(jnp.asarray([2.0]))
+        >>> metric.compute().tolist()
+        [1.0, 2.0]
+    """
 
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
         super().__init__("cat", [], nan_strategy, **kwargs)
@@ -139,7 +176,16 @@ class CatMetric(BaseAggregator):
 
 
 class MeanMetric(BaseAggregator):
-    """Running (weighted) mean. Parity: reference ``aggregation.py:363-439``."""
+    """Running (weighted) mean. Parity: reference ``aggregation.py:363-439``.
+
+    Example:
+        >>> from metrics_tpu import MeanMetric
+        >>> metric = MeanMetric()
+        >>> for v in [1.0, 2.0, 3.0]:
+        ...     metric.update(v)
+        >>> print(f"{float(metric.compute()):.4f}")
+        2.0000
+    """
 
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
         super().__init__("sum", jnp.asarray(0.0), nan_strategy, **kwargs)

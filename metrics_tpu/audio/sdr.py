@@ -19,7 +19,18 @@ Array = jax.Array
 
 
 class SignalDistortionRatio(Metric):
-    """SDR with optimal distortion filter, averaged over samples."""
+    """SDR with optimal distortion filter, averaged over samples.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import SignalDistortionRatio
+        >>> n = jnp.arange(64.0)
+        >>> target = jnp.sin(n / 4)[None]
+        >>> preds = target + 0.1 * jnp.cos(n / 3)[None]
+        >>> sdr = SignalDistortionRatio()
+        >>> print(f"{float(sdr(preds, target)):.4f}")
+        28.5336
+    """
 
     is_differentiable = True
     higher_is_better = True

@@ -37,7 +37,17 @@ class SignalNoiseRatio(Metric):
 
 
 class SNR(SignalNoiseRatio):
-    """Deprecated alias. Parity: reference ``snr.py:114``."""
+    """Deprecated alias. Parity: reference ``snr.py:114``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import SNR
+        >>> target = jnp.asarray([1.0, 2.0, 3.0, 4.0])
+        >>> preds = jnp.asarray([1.1, 2.1, 2.9, 4.2])
+        >>> snr = SNR()
+        >>> print(f"{float(snr(preds, target)):.4f}")
+        26.3202
+    """
 
     def __init__(self, *args: Any, **kwargs: Any) -> None:
         rank_zero_warn("`SNR` was renamed to `SignalNoiseRatio` and it will be removed.", DeprecationWarning)
@@ -45,7 +55,17 @@ class SNR(SignalNoiseRatio):
 
 
 class ScaleInvariantSignalNoiseRatio(Metric):
-    """Scale-invariant SNR, averaged over samples."""
+    """Scale-invariant SNR, averaged over samples.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import ScaleInvariantSignalNoiseRatio
+        >>> target = jnp.asarray([1.0, 2.0, 3.0, 4.0])
+        >>> preds = jnp.asarray([1.1, 2.1, 2.9, 4.2])
+        >>> si_snr = ScaleInvariantSignalNoiseRatio()
+        >>> print(f"{float(si_snr(preds, target)):.4f}")
+        20.3551
+    """
 
     is_differentiable = True
     higher_is_better = True

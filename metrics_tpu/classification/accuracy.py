@@ -24,7 +24,17 @@ Array = jax.Array
 
 
 class Accuracy(StatScores):
-    """Accuracy (micro/macro/weighted/samples, top-k, subset accuracy)."""
+    """Accuracy (micro/macro/weighted/samples, top-k, subset accuracy).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy
+        >>> target = jnp.asarray([1, 1, 0, 0])
+        >>> preds = jnp.asarray([0, 1, 0, 0])
+        >>> accuracy = Accuracy()
+        >>> print(f"{float(accuracy(preds, target)):.4f}")
+        0.7500
+    """
 
     is_differentiable = False
     higher_is_better = True

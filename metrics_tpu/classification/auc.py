@@ -14,7 +14,17 @@ Array = jax.Array
 
 
 class AUC(Metric):
-    """Area under any curve given (x, y) points."""
+    """Area under any curve given (x, y) points.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import AUC
+        >>> x = jnp.asarray([0.0, 1.0, 2.0, 3.0])
+        >>> y = jnp.asarray([0.0, 1.0, 2.0, 2.0])
+        >>> auc = AUC()
+        >>> print(f"{float(auc(x, y)):.4f}")
+        4.0000
+    """
 
     is_differentiable = False
     higher_is_better = None

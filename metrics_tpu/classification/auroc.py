@@ -18,7 +18,17 @@ Array = jax.Array
 
 
 class AUROC(Metric):
-    """Area under the ROC curve (binary, multiclass ovr, multilabel)."""
+    """Area under the ROC curve (binary, multiclass ovr, multilabel).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import AUROC
+        >>> preds = jnp.asarray([0.1, 0.4, 0.35, 0.8])
+        >>> target = jnp.asarray([0, 0, 1, 1])
+        >>> auroc = AUROC()
+        >>> print(f"{float(auroc(preds, target)):.4f}")
+        0.7500
+    """
 
     is_differentiable = False
     higher_is_better = True

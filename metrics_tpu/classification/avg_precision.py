@@ -17,7 +17,17 @@ Array = jax.Array
 
 
 class AveragePrecision(Metric):
-    """Average precision (area under the PR curve by step integration)."""
+    """Average precision (area under the PR curve by step integration).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import AveragePrecision
+        >>> preds = jnp.asarray([0.1, 0.4, 0.35, 0.8])
+        >>> target = jnp.asarray([0, 0, 1, 1])
+        >>> average_precision = AveragePrecision()
+        >>> print(f"{float(average_precision(preds, target)):.4f}")
+        0.8333
+    """
 
     is_differentiable = False
     higher_is_better = True

@@ -122,7 +122,17 @@ class BinnedPrecisionRecallCurve(Metric):
 
 
 class BinnedAveragePrecision(BinnedPrecisionRecallCurve):
-    """Average precision summarised from the binned curve. Parity: reference ``:191``."""
+    """Average precision summarised from the binned curve. Parity: reference ``:191``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import BinnedAveragePrecision
+        >>> preds = jnp.asarray([0.1, 0.4, 0.35, 0.8])
+        >>> target = jnp.asarray([0, 0, 1, 1])
+        >>> binned_ap = BinnedAveragePrecision(num_classes=1, thresholds=5)
+        >>> print(f"{float(binned_ap(preds, target)):.4f}")
+        0.8333
+    """
 
     def compute(self) -> Union[List[Array], Array]:
         precisions, recalls, _ = super().compute()

@@ -15,7 +15,17 @@ Array = jax.Array
 
 
 class CalibrationError(Metric):
-    """Top-label calibration error with l1 (ECE) / l2 / max norms."""
+    """Top-label calibration error with l1 (ECE) / l2 / max norms.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import CalibrationError
+        >>> preds = jnp.asarray([0.1, 0.4, 0.35, 0.8])
+        >>> target = jnp.asarray([0, 0, 1, 1])
+        >>> ece = CalibrationError(n_bins=3)
+        >>> print(f"{float(ece(preds, target)):.4f}")
+        0.1375
+    """
 
     is_differentiable = False
     higher_is_better = False

@@ -14,7 +14,17 @@ Array = jax.Array
 
 
 class CohenKappa(Metric):
-    """Cohen's kappa with optional linear/quadratic weighting."""
+    """Cohen's kappa with optional linear/quadratic weighting.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import CohenKappa
+        >>> target = jnp.asarray([1, 1, 0, 0])
+        >>> preds = jnp.asarray([0, 1, 0, 0])
+        >>> kappa = CohenKappa(num_classes=2)
+        >>> print(f"{float(kappa(preds, target)):.4f}")
+        0.5000
+    """
 
     is_differentiable = False
     higher_is_better = True

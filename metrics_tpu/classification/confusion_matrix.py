@@ -18,7 +18,17 @@ Array = jax.Array
 
 
 class ConfusionMatrix(Metric):
-    """Confusion matrix with optional true/pred/all normalization."""
+    """Confusion matrix with optional true/pred/all normalization.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import ConfusionMatrix
+        >>> target = jnp.asarray([1, 1, 0, 0])
+        >>> preds = jnp.asarray([0, 1, 0, 0])
+        >>> confmat = ConfusionMatrix(num_classes=2)
+        >>> confmat(preds, target).tolist()
+        [[2, 0], [1, 1]]
+    """
 
     is_differentiable = False
     higher_is_better = None

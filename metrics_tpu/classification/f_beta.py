@@ -15,7 +15,17 @@ Array = jax.Array
 
 
 class FBeta(StatScores):
-    """F-beta score with configurable beta."""
+    """F-beta score with configurable beta.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import FBeta
+        >>> target = jnp.asarray([1, 1, 0, 0])
+        >>> preds = jnp.asarray([0, 1, 0, 0])
+        >>> fbeta = FBeta(beta=0.5)
+        >>> print(f"{float(fbeta(preds, target)):.4f}")
+        0.7500
+    """
 
     is_differentiable = False
     higher_is_better = True
@@ -54,7 +64,17 @@ class FBeta(StatScores):
 
 
 class F1Score(FBeta):
-    """F1 = F-beta with beta=1.0."""
+    """F1 = F-beta with beta=1.0.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import F1Score
+        >>> target = jnp.asarray([1, 1, 0, 0])
+        >>> preds = jnp.asarray([0, 1, 0, 0])
+        >>> f1 = F1Score()
+        >>> print(f"{float(f1(preds, target)):.4f}")
+        0.7500
+    """
 
     def __init__(
         self,

@@ -17,7 +17,17 @@ Array = jax.Array
 
 
 class HammingDistance(Metric):
-    """Average Hamming distance (loss) between targets and predictions."""
+    """Average Hamming distance (loss) between targets and predictions.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import HammingDistance
+        >>> target = jnp.asarray([1, 1, 0, 0])
+        >>> preds = jnp.asarray([0, 1, 0, 0])
+        >>> hamming = HammingDistance()
+        >>> print(f"{float(hamming(preds, target)):.4f}")
+        0.2500
+    """
 
     is_differentiable = False
     higher_is_better = False

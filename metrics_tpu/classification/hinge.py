@@ -15,7 +15,17 @@ Array = jax.Array
 
 
 class HingeLoss(Metric):
-    """Mean hinge loss, with Crammer-Singer or one-vs-all multiclass modes."""
+    """Mean hinge loss, with Crammer-Singer or one-vs-all multiclass modes.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import HingeLoss
+        >>> preds = jnp.asarray([-2.0, 1.5, 2.2])
+        >>> target = jnp.asarray([0, 1, 1])
+        >>> hinge = HingeLoss()
+        >>> print(f"{float(hinge(preds, target)):.4f}")
+        0.0000
+    """
 
     is_differentiable = True
     higher_is_better = False

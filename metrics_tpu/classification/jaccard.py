@@ -14,7 +14,17 @@ Array = jax.Array
 
 
 class JaccardIndex(ConfusionMatrix):
-    """Jaccard index (intersection-over-union) from an accumulated confusion matrix."""
+    """Jaccard index (intersection-over-union) from an accumulated confusion matrix.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import JaccardIndex
+        >>> target = jnp.asarray([1, 1, 0, 0])
+        >>> preds = jnp.asarray([0, 1, 0, 0])
+        >>> jaccard = JaccardIndex(num_classes=2)
+        >>> print(f"{float(jaccard(preds, target)):.4f}")
+        0.5833
+    """
 
     is_differentiable = False
     higher_is_better = True

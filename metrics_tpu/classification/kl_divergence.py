@@ -15,7 +15,17 @@ Array = jax.Array
 
 
 class KLDivergence(Metric):
-    """KL divergence D_KL(P||Q) with mean/sum/none reduction."""
+    """KL divergence D_KL(P||Q) with mean/sum/none reduction.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import KLDivergence
+        >>> p = jnp.asarray([[0.3, 0.7], [0.6, 0.4]])
+        >>> q = jnp.asarray([[0.5, 0.5], [0.5, 0.5]])
+        >>> kl = KLDivergence()
+        >>> print(f"{float(kl(p, q)):.4f}")
+        0.0512
+    """
 
     is_differentiable = True
     higher_is_better = False

@@ -18,7 +18,17 @@ Array = jax.Array
 
 
 class MatthewsCorrCoef(Metric):
-    """Matthews correlation coefficient from an accumulated confusion matrix."""
+    """Matthews correlation coefficient from an accumulated confusion matrix.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MatthewsCorrCoef
+        >>> target = jnp.asarray([1, 1, 0, 0])
+        >>> preds = jnp.asarray([0, 1, 0, 0])
+        >>> mcc = MatthewsCorrCoef(num_classes=2)
+        >>> print(f"{float(mcc(preds, target)):.4f}")
+        0.5774
+    """
 
     is_differentiable = False
     higher_is_better = True

@@ -13,7 +13,17 @@ Array = jax.Array
 
 
 class Precision(StatScores):
-    """Precision = TP / (TP + FP)."""
+    """Precision = TP / (TP + FP).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Precision
+        >>> target = jnp.asarray([1, 1, 0, 0])
+        >>> preds = jnp.asarray([0, 1, 0, 0])
+        >>> precision = Precision()
+        >>> print(f"{float(precision(preds, target)):.4f}")
+        0.7500
+    """
 
     is_differentiable = False
     higher_is_better = True
@@ -50,7 +60,17 @@ class Precision(StatScores):
 
 
 class Recall(StatScores):
-    """Recall = TP / (TP + FN)."""
+    """Recall = TP / (TP + FN).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Recall
+        >>> target = jnp.asarray([1, 1, 0, 0])
+        >>> preds = jnp.asarray([0, 1, 0, 0])
+        >>> recall = Recall()
+        >>> print(f"{float(recall(preds, target)):.4f}")
+        0.7500
+    """
 
     is_differentiable = False
     higher_is_better = True

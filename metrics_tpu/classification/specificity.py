@@ -13,7 +13,17 @@ Array = jax.Array
 
 
 class Specificity(StatScores):
-    """Specificity = TN / (TN + FP)."""
+    """Specificity = TN / (TN + FP).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Specificity
+        >>> target = jnp.asarray([1, 1, 0, 0])
+        >>> preds = jnp.asarray([0, 1, 0, 0])
+        >>> specificity = Specificity()
+        >>> print(f"{float(specificity(preds, target)):.4f}")
+        0.7500
+    """
 
     is_differentiable = False
     higher_is_better = True

@@ -22,6 +22,15 @@ class StatScores(Metric):
 
     Args mirror the reference (threshold, top_k, reduce, num_classes, ignore_index,
     mdmc_reduce, multiclass) plus the runtime kwargs (sync_axis etc.).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import StatScores
+        >>> target = jnp.asarray([1, 1, 0, 0])
+        >>> preds = jnp.asarray([0, 1, 0, 0])
+        >>> stat_scores = StatScores()
+        >>> stat_scores(preds, target).tolist()  # [tp, fp, tn, fn, support]
+        [3, 1, 3, 1, 4]
     """
 
     is_differentiable = False

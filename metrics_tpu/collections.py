@@ -30,6 +30,15 @@ class MetricCollection(dict):
     Args:
         metrics: a Metric, a sequence of Metrics, or a dict name->Metric.
         prefix/postfix: added to every key in the output dict.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy, MeanSquaredError, MetricCollection
+        >>> metrics = MetricCollection([Accuracy(), MeanSquaredError()])
+        >>> preds = jnp.asarray([0.0, 1.0, 0.0, 0.0])
+        >>> target = jnp.asarray([1, 1, 0, 0])
+        >>> {k: f"{float(v):.4f}" for k, v in metrics(preds, target).items()}
+        {'Accuracy': '0.7500', 'MeanSquaredError': '0.2500'}
     """
 
     def __init__(

@@ -26,6 +26,15 @@ def _pairwise_cosine_similarity_update(
 def pairwise_cosine_similarity(
     x: Array, y: Optional[Array] = None, reduction: Optional[str] = None, zero_diagonal: Optional[bool] = None
 ) -> Array:
-    """Pairwise cosine similarity between rows of x (and y)."""
+    """Pairwise cosine similarity between rows of x (and y).
+
+    Example:
+        >>> from metrics_tpu.functional import pairwise_cosine_similarity
+        >>> import jax.numpy as jnp
+        >>> x = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+        >>> y = jnp.asarray([[1.0, 0.0]])
+        >>> [[f"{float(v):.4f}" for v in row] for row in pairwise_cosine_similarity(x, y)]
+        [['0.4472'], ['0.6000']]
+    """
     distance = _pairwise_cosine_similarity_update(x, y, zero_diagonal)
     return _reduce_distance_matrix(distance, reduction)

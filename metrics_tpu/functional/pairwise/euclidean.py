@@ -27,6 +27,15 @@ def _pairwise_euclidean_distance_update(
 def pairwise_euclidean_distance(
     x: Array, y: Optional[Array] = None, reduction: Optional[str] = None, zero_diagonal: Optional[bool] = None
 ) -> Array:
-    """Pairwise euclidean distance between rows of x (and y)."""
+    """Pairwise euclidean distance between rows of x (and y).
+
+    Example:
+        >>> from metrics_tpu.functional import pairwise_euclidean_distance
+        >>> import jax.numpy as jnp
+        >>> x = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+        >>> y = jnp.asarray([[1.0, 0.0]])
+        >>> [[f"{float(v):.4f}" for v in row] for row in pairwise_euclidean_distance(x, y)]
+        [['2.0000'], ['4.4721']]
+    """
     distance = _pairwise_euclidean_distance_update(x, y, zero_diagonal)
     return _reduce_distance_matrix(distance, reduction)

@@ -22,6 +22,15 @@ def _pairwise_linear_similarity_update(
 def pairwise_linear_similarity(
     x: Array, y: Optional[Array] = None, reduction: Optional[str] = None, zero_diagonal: Optional[bool] = None
 ) -> Array:
-    """Pairwise dot-product similarity between rows of x (and y)."""
+    """Pairwise dot-product similarity between rows of x (and y).
+
+    Example:
+        >>> from metrics_tpu.functional import pairwise_linear_similarity
+        >>> import jax.numpy as jnp
+        >>> x = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+        >>> y = jnp.asarray([[1.0, 0.0]])
+        >>> [[f"{float(v):.4f}" for v in row] for row in pairwise_linear_similarity(x, y)]
+        [['1.0000'], ['3.0000']]
+    """
     distance = _pairwise_linear_similarity_update(x, y, zero_diagonal)
     return _reduce_distance_matrix(distance, reduction)

@@ -24,6 +24,15 @@ def _pairwise_manhatten_distance_update(
 def pairwise_manhatten_distance(
     x: Array, y: Optional[Array] = None, reduction: Optional[str] = None, zero_diagonal: Optional[bool] = None
 ) -> Array:
-    """Pairwise L1 distance between rows of x (and y)."""
+    """Pairwise L1 distance between rows of x (and y).
+
+    Example:
+        >>> from metrics_tpu.functional import pairwise_manhatten_distance
+        >>> import jax.numpy as jnp
+        >>> x = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+        >>> y = jnp.asarray([[1.0, 0.0]])
+        >>> [[f"{float(v):.4f}" for v in row] for row in pairwise_manhatten_distance(x, y)]
+        [['2.0000'], ['6.0000']]
+    """
     distance = _pairwise_manhatten_distance_update(x, y, zero_diagonal)
     return _reduce_distance_matrix(distance, reduction)

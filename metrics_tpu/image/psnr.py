@@ -17,7 +17,17 @@ Array = jax.Array
 
 
 class PSNR(Metric):
-    """Peak signal-to-noise ratio."""
+    """Peak signal-to-noise ratio.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import PSNR
+        >>> preds = jnp.asarray([[0.0, 1.0], [1.0, 0.0]])
+        >>> target = jnp.asarray([[0.0, 1.0], [1.0, 1.0]])
+        >>> psnr = PSNR(data_range=1.0)
+        >>> print(f"{float(psnr(preds, target)):.4f}")
+        6.0206
+    """
 
     is_differentiable = True
     higher_is_better = True

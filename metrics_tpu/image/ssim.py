@@ -17,7 +17,17 @@ Array = jax.Array
 
 
 class SSIM(Metric):
-    """Structural similarity index measure."""
+    """Structural similarity index measure.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import SSIM
+        >>> preds = jnp.arange(256.0).reshape(1, 1, 16, 16) / 255.0
+        >>> target = preds * 0.9
+        >>> ssim = SSIM()
+        >>> print(f"{float(ssim(preds, target)):.4f}")
+        0.9893
+    """
 
     is_differentiable = True
     higher_is_better = True

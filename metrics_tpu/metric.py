@@ -563,7 +563,10 @@ class Metric:
         self._load_state(cache)
         self._should_unsync = True
         self._to_sync = True
-        self._computed = None
+        # recursive: the batch-local compute cached _computed on self AND any
+        # nested metrics — all of those caches describe the discarded batch
+        # state, not the restored accumulated state
+        self._mark_updated()
         self._is_synced = False
         return self._forward_cache
 

@@ -17,6 +17,17 @@ Array = jax.Array
 
 
 class CosineSimilarity(Metric):
+    """Cosine similarity between prediction and target vectors.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import CosineSimilarity
+        >>> preds = jnp.asarray([[1.0, 0.0], [0.0, 1.0]])
+        >>> target = jnp.asarray([[1.0, 0.0], [1.0, 1.0]])
+        >>> cosine = CosineSimilarity(reduction="mean")
+        >>> print(f"{float(cosine(preds, target)):.4f}")
+        0.8536
+    """
     is_differentiable = True
     higher_is_better = True
 

@@ -17,6 +17,17 @@ Array = jax.Array
 
 
 class MeanAbsolutePercentageError(Metric):
+    """Mean absolute percentage error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanAbsolutePercentageError
+        >>> target = jnp.asarray([2.5, 5.0, 4.0, 8.0])
+        >>> preds = jnp.asarray([3.0, 5.0, 2.5, 7.0])
+        >>> metric = MeanAbsolutePercentageError()
+        >>> print(f"{float(metric(preds, target)):.4f}")
+        0.1750
+    """
     is_differentiable = True
     higher_is_better = False
 

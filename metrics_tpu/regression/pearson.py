@@ -52,6 +52,17 @@ def _final_aggregation(
 
 
 class PearsonCorrCoef(Metric):
+    """Pearson correlation coefficient via streaming mean/var/cov statistics with the Chan parallel merge across devices.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import PearsonCorrCoef
+        >>> target = jnp.asarray([2.5, 5.0, 4.0, 8.0])
+        >>> preds = jnp.asarray([3.0, 5.0, 2.5, 7.0])
+        >>> metric = PearsonCorrCoef()
+        >>> print(f"{float(metric(preds, target)):.4f}")
+        0.9202
+    """
     is_differentiable = True
     higher_is_better = None
 

@@ -14,6 +14,17 @@ Array = jax.Array
 
 
 class R2Score(Metric):
+    """R² coefficient of determination (with adjusted/multioutput options).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import R2Score
+        >>> target = jnp.asarray([2.5, 5.0, 4.0, 8.0])
+        >>> preds = jnp.asarray([3.0, 5.0, 2.5, 7.0])
+        >>> metric = R2Score()
+        >>> print(f"{float(metric(preds, target)):.4f}")
+        0.7838
+    """
     is_differentiable = True
     higher_is_better = True
 

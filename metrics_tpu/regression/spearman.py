@@ -20,6 +20,17 @@ Array = jax.Array
 
 
 class SpearmanCorrCoef(Metric):
+    """Spearman rank correlation (tie-aware ranking at compute).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import SpearmanCorrCoef
+        >>> target = jnp.asarray([2.5, 5.0, 4.0, 8.0])
+        >>> preds = jnp.asarray([3.0, 5.0, 2.5, 7.0])
+        >>> metric = SpearmanCorrCoef()
+        >>> print(f"{float(metric(preds, target)):.4f}")
+        0.8000
+    """
     is_differentiable = False
     higher_is_better = True
 

@@ -18,6 +18,17 @@ Array = jax.Array
 
 
 class SymmetricMeanAbsolutePercentageError(Metric):
+    """Symmetric mean absolute percentage error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import SymmetricMeanAbsolutePercentageError
+        >>> target = jnp.asarray([2.5, 5.0, 4.0, 8.0])
+        >>> preds = jnp.asarray([3.0, 5.0, 2.5, 7.0])
+        >>> metric = SymmetricMeanAbsolutePercentageError()
+        >>> print(f"{float(metric(preds, target)):.4f}")
+        0.1942
+    """
     is_differentiable = True
     higher_is_better = False
 

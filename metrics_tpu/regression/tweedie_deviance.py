@@ -17,6 +17,17 @@ Array = jax.Array
 
 
 class TweedieDevianceScore(Metric):
+    """Tweedie deviance score for the given ``power`` (0=normal, 1=poisson, 2=gamma).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import TweedieDevianceScore
+        >>> target = jnp.asarray([2.5, 5.0, 4.0, 8.0])
+        >>> preds = jnp.asarray([3.0, 5.0, 2.5, 7.0])
+        >>> deviance = TweedieDevianceScore(power=1.0)
+        >>> print(f"{float(deviance(preds, target)):.4f}")
+        0.2462
+    """
     is_differentiable = True
     higher_is_better = False
 

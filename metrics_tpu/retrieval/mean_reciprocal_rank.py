@@ -11,7 +11,18 @@ Array = jax.Array
 
 
 class RetrievalMRR(RetrievalMetric):
-    """Mean reciprocal rank over queries."""
+    """Mean reciprocal rank over queries.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalMRR
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.9, 0.7, 0.6, 0.1, 0.8])
+        >>> target = jnp.asarray([1, 0, 1, 0, 0, 1])
+        >>> metric = RetrievalMRR()
+        >>> print(f"{float(metric(preds, target, indexes=indexes)):.4f}")
+        0.7500
+    """
 
     def _metric(self, preds: Array, target: Array) -> Array:
         return retrieval_reciprocal_rank(preds, target)

@@ -13,7 +13,18 @@ Array = jax.Array
 
 
 class RetrievalRecall(RetrievalMetric):
-    """Recall@k averaged over queries."""
+    """Recall@k averaged over queries.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalRecall
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.9, 0.7, 0.6, 0.1, 0.8])
+        >>> target = jnp.asarray([1, 0, 1, 0, 0, 1])
+        >>> metric = RetrievalRecall(k=2)
+        >>> print(f"{float(metric(preds, target, indexes=indexes)):.4f}")
+        0.7500
+    """
 
     def __init__(
         self,

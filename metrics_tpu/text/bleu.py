@@ -15,6 +15,16 @@ Array = jax.Array
 
 
 class BLEUScore(Metric):
+    """BLEU score (n-gram precision with brevity penalty) over a translation corpus.
+
+    Example:
+        >>> from metrics_tpu import BLEUScore
+        >>> preds = ["the cat sat on the mat"]
+        >>> refs = [["a cat sat on the mat", "the cat sits on the mat"]]
+        >>> bleu = BLEUScore()
+        >>> print(f"{float(bleu(preds, refs)):.4f}")
+        0.8409
+    """
     is_differentiable = False
     higher_is_better = True
 

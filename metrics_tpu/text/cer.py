@@ -14,6 +14,15 @@ Array = jax.Array
 
 
 class CharErrorRate(Metric):
+    """Character error rate (Levenshtein character edits / reference characters).
+
+    Example:
+        >>> from metrics_tpu import CharErrorRate
+        >>> metric = CharErrorRate()
+        >>> score = metric(['hello there world'], ['hello there word'])
+        >>> print(f"{float(score):.4f}")
+        0.0625
+    """
     is_differentiable = False
     higher_is_better = False
 

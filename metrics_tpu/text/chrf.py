@@ -16,6 +16,15 @@ Array = jax.Array
 
 
 class CHRFScore(Metric):
+    """chrF / chrF++ score (character n-gram F-score).
+
+    Example:
+        >>> from metrics_tpu import CHRFScore
+        >>> chrf = CHRFScore()
+        >>> score = chrf(['the cat sat on the mat'], ['a cat sat on the mat'])
+        >>> print(f"{float(score):.4f}")
+        0.8719
+    """
     is_differentiable = False
     higher_is_better = True
 

@@ -14,6 +14,15 @@ Array = jax.Array
 
 
 class MatchErrorRate(Metric):
+    """Match error rate (word edits / (edits + hits)).
+
+    Example:
+        >>> from metrics_tpu import MatchErrorRate
+        >>> metric = MatchErrorRate()
+        >>> score = metric(['hello there world'], ['hello there word'])
+        >>> print(f"{float(score):.4f}")
+        0.3333
+    """
     is_differentiable = False
     higher_is_better = False
 

@@ -22,6 +22,15 @@ Array = jax.Array
 
 
 class ROUGEScore(Metric):
+    """ROUGE-N / ROUGE-L scores (native n-gram + LCS implementation, no external deps).
+
+    Example:
+        >>> from metrics_tpu import ROUGEScore
+        >>> rouge = ROUGEScore()
+        >>> scores = rouge(["the cat sat"], ["the cat sat on the mat"])
+        >>> print(f"{float(scores['rouge1_fmeasure']):.4f}")
+        0.6667
+    """
     is_differentiable = False
     higher_is_better = True
 

@@ -14,7 +14,16 @@ Array = jax.Array
 
 
 class SacreBLEUScore(BLEUScore):
-    """BLEU with canonical sacrebleu tokenization."""
+    """BLEU with canonical sacrebleu tokenization.
+
+    Example:
+        >>> from metrics_tpu import SacreBLEUScore
+        >>> preds = ["the cat sat on the mat"]
+        >>> refs = [["a cat sat on the mat", "the cat sits on the mat"]]
+        >>> sacre_bleu = SacreBLEUScore(tokenize="13a")
+        >>> print(f"{float(sacre_bleu(preds, refs)):.4f}")
+        0.8409
+    """
 
     def __init__(
         self,

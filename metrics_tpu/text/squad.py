@@ -20,6 +20,16 @@ Array = jax.Array
 
 
 class SQuAD(Metric):
+    """SQuAD v1.1 exact-match and token-F1 over prediction/answer dicts.
+
+    Example:
+        >>> from metrics_tpu import SQuAD
+        >>> squad = SQuAD()
+        >>> preds = [{"prediction_text": "berlin", "id": "q1"}]
+        >>> refs = [{"answers": {"text": ["berlin"], "answer_start": [0]}, "id": "q1"}]
+        >>> {k: float(v) for k, v in squad(preds, refs).items()}
+        {'exact_match': 100.0, 'f1': 100.0}
+    """
     is_differentiable = False
     higher_is_better = True
 

@@ -15,6 +15,15 @@ Array = jax.Array
 
 
 class TranslationEditRate(Metric):
+    """Translation edit rate (edits / average reference length).
+
+    Example:
+        >>> from metrics_tpu import TranslationEditRate
+        >>> ter = TranslationEditRate()
+        >>> score = ter(['the cat sat on the mat'], [['a cat sat on the mat']])
+        >>> print(f"{float(score):.4f}")
+        0.1667
+    """
     is_differentiable = False
     higher_is_better = False
 

@@ -15,6 +15,15 @@ Array = jax.Array
 
 
 class WordErrorRate(Metric):
+    """Word error rate (Levenshtein word edits / reference words; native C++ kernel).
+
+    Example:
+        >>> from metrics_tpu import WordErrorRate
+        >>> metric = WordErrorRate()
+        >>> score = metric(['hello there world'], ['hello there word'])
+        >>> print(f"{float(score):.4f}")
+        0.3333
+    """
     is_differentiable = False
     higher_is_better = False
 

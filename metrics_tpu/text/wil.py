@@ -14,6 +14,15 @@ Array = jax.Array
 
 
 class WordInfoLost(Metric):
+    """Word information lost (1 - hits²/(pred words × ref words)).
+
+    Example:
+        >>> from metrics_tpu import WordInfoLost
+        >>> metric = WordInfoLost()
+        >>> score = metric(['hello there world'], ['hello there word'])
+        >>> print(f"{float(score):.4f}")
+        0.5556
+    """
     is_differentiable = False
     higher_is_better = False
 

@@ -14,6 +14,15 @@ Array = jax.Array
 
 
 class WordInfoPreserved(Metric):
+    """Word information preserved (hits²/(pred words × ref words)).
+
+    Example:
+        >>> from metrics_tpu import WordInfoPreserved
+        >>> metric = WordInfoPreserved()
+        >>> score = metric(['hello there world'], ['hello there word'])
+        >>> print(f"{float(score):.4f}")
+        0.4444
+    """
     is_differentiable = False
     higher_is_better = True
 

@@ -41,7 +41,17 @@ def _bootstrap_sampler(
 
 
 class BootStrapper(Metric):
-    """Computes bootstrapped mean/std/quantile of a base metric."""
+    """Computes bootstrapped mean/std/quantile of a base metric.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import BootStrapper, MeanSquaredError
+        >>> boot = BootStrapper(MeanSquaredError(), num_bootstraps=4,
+        ...                     sampling_strategy="multinomial", seed=0)
+        >>> _ = boot(jnp.asarray([1.0, 2.0, 3.0, 4.0]), jnp.asarray([1.1, 2.1, 2.9, 4.2]))
+        >>> sorted(boot.compute().keys())
+        ['mean', 'std']
+    """
 
     def __init__(
         self,

@@ -13,7 +13,27 @@ Array = jax.Array
 
 
 class MinMaxMetric(Metric):
-    """Wraps a metric and additionally reports the min and max value seen so far."""
+    """Wraps a metric and additionally reports the min and max value seen so far.
+
+    The extremes track the RUNNING accumulated value after every update — the
+    contract pinned by the reference's ``tests/wrappers/test_minmax.py:28-36``
+    (compare_fn evaluates the base metric on each growing prefix). Reading
+    accumulated state inside ``update`` makes this a ``full_state_update``
+    metric: forward keeps the snapshot path instead of delta-merging (a
+    batch-local delta would fold per-batch values, not prefix values).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy, MinMaxMetric
+        >>> minmax = MinMaxMetric(Accuracy())
+        >>> target = jnp.asarray([1, 1, 0, 0])
+        >>> _ = minmax(jnp.asarray([0, 1, 0, 0]), target)  # running acc 0.75
+        >>> _ = minmax(jnp.asarray([1, 1, 0, 0]), target)  # running acc 0.875
+        >>> {k: f"{float(v):.4f}" for k, v in minmax.compute().items()}
+        {'raw': '0.8750', 'max': '0.8750', 'min': '0.7500'}
+    """
+
+    full_state_update = True
 
     def __init__(self, base_metric: Metric, **kwargs: Any) -> None:
         super().__init__(**kwargs)
@@ -28,15 +48,22 @@ class MinMaxMetric(Metric):
         self.add_state("min_val", jnp.asarray(jnp.inf), dist_reduce_fx="min")
         self.add_state("max_val", jnp.asarray(-jnp.inf), dist_reduce_fx="max")
 
-    def update(self, *args: Any, **kwargs: Any) -> None:
-        self._base_metric.update(*args, **kwargs)
-
-    def compute(self) -> Dict[str, Array]:
-        val = self._base_metric.compute()
+    def _fold_extremes(self, val: Array) -> None:
         if not self._is_suitable_val(val):
             raise RuntimeError(f"Returned value from base metric should be a float or scalar tensor, but got {val}.")
         self.max_val = jnp.where(self.max_val < val, val, self.max_val)
         self.min_val = jnp.where(self.min_val > val, val, self.min_val)
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        self._base_metric.update(*args, **kwargs)
+        self._fold_extremes(self._base_metric._inner_compute())
+
+    def compute(self) -> Dict[str, Array]:
+        # the WRAPPED compute: under eager multihost it merges the child across
+        # processes — the merged value folds into the extremes too (reference
+        # minmax.py:103-104), while update() folds local running values
+        val = self._base_metric.compute()
+        self._fold_extremes(val)
         return {"raw": val, "max": self.max_val, "min": self.min_val}
 
     def reset(self) -> None:

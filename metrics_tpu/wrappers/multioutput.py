@@ -28,7 +28,18 @@ def _get_nan_indices(*tensors: Array) -> Array:
 
 
 class MultioutputWrapper(Metric):
-    """Evaluate ``base_metric`` separately on each slice along ``output_dim``."""
+    """Evaluate ``base_metric`` separately on each slice along ``output_dim``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanSquaredError, MultioutputWrapper
+        >>> mse2 = MultioutputWrapper(MeanSquaredError(), num_outputs=2)
+        >>> preds = jnp.asarray([[1.0, 2.0], [2.0, 3.0]])
+        >>> target = jnp.asarray([[1.0, 2.5], [2.0, 2.5]])
+        >>> _ = mse2(preds, target)
+        >>> [f"{float(v):.4f}" for v in mse2.compute()]
+        ['0.0000', '0.2500']
+    """
 
     is_differentiable = False
 
@@ -83,6 +94,7 @@ class MultioutputWrapper(Metric):
         reshaped_args_kwargs = self._get_args_kwargs_by_output(*args, **kwargs)
         for metric, (selected_args, selected_kwargs) in zip(self.metrics, reshaped_args_kwargs):
             results.append(metric(*selected_args, **selected_kwargs))
+        self._mark_updated()  # per-output children updated through their own forwards
         if results[0] is None:
             return None
         return jnp.stack(results, 0)

@@ -16,7 +16,20 @@ Array = jax.Array
 
 
 class MetricTracker:
-    """A list of metric snapshots, one per ``increment()`` call."""
+    """A list of metric snapshots, one per ``increment()`` call.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy, MetricTracker
+        >>> tracker = MetricTracker(Accuracy())
+        >>> target = jnp.asarray([1, 1, 0, 0])
+        >>> for epoch_preds in [jnp.asarray([0, 1, 0, 0]), jnp.asarray([1, 1, 0, 0])]:
+        ...     tracker.increment()
+        ...     _ = tracker(epoch_preds, target)
+        >>> best, step = tracker.best_metric(return_step=True)
+        >>> print(f"{float(best):.4f} at step {int(step)}")
+        1.0000 at step 1
+    """
 
     def __init__(self, metric: Union[Metric, MetricCollection], maximize: Union[bool, List[bool]] = True) -> None:
         if not isinstance(metric, (Metric, MetricCollection)):

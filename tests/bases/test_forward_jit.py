@@ -285,21 +285,26 @@ def test_collection_no_leak_through_fused_cache():
     assert ref() is None, "fused step closure pinned the collection alive"
 
 
-def test_minmax_wrapper_compiles_and_children_marked_updated():
+def test_minmax_wrapper_tracks_prefix_extremes_without_compiling():
+    """MinMax reads accumulated state in update (full_state_update): it must
+    stay on the snapshot forward path and track extremes of the RUNNING value
+    (reference compare_fn contract), with no spurious compute-before-update
+    warnings."""
     import warnings
 
     from metrics_tpu import MinMaxMetric
 
-    preds, target = _batch()
-    mm = MinMaxMetric(Accuracy(num_classes=5))
+    target = jnp.asarray([1, 1, 0, 0])
+    mm = MinMaxMetric(Accuracy())
     with warnings.catch_warnings():
-        warnings.simplefilter("error")  # spurious "compute before update" fails
-        for _ in range(4):
-            mm(preds, target)
+        warnings.simplefilter("error")
+        mm(jnp.asarray([0, 1, 0, 0]), target)  # running acc 0.75
+        mm(jnp.asarray([1, 1, 0, 0]), target)  # running acc 0.875
         vals = mm.compute()
-    assert _jit_entries(mm), "MinMax wrapper did not compile"
-    assert np.isclose(float(vals["min"]), float(vals["max"]))
-    assert 0.0 <= float(vals["raw"]) <= 1.0
+    assert not _jit_entries(mm), "full_state_update wrapper must not delta-compile"
+    assert np.isclose(float(vals["min"]), 0.75)
+    assert np.isclose(float(vals["max"]), 0.875)
+    assert np.isclose(float(vals["raw"]), 0.875)
 
 
 def test_forward_inside_user_jit_falls_back():

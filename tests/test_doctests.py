@@ -1,0 +1,43 @@
+"""Execute every docstring example in the package.
+
+Parity with the reference test strategy (SURVEY.md §4: doctests in all
+docstrings are executed via phmdoctest) — here with the stdlib doctest module,
+one pytest case per module so failures point at the file.
+"""
+import doctest
+import importlib
+import pkgutil
+
+import pytest
+
+import metrics_tpu
+
+
+def _walk_modules():
+    names = ["metrics_tpu"]
+    for info in pkgutil.walk_packages(metrics_tpu.__path__, prefix="metrics_tpu."):
+        names.append(info.name)
+    return sorted(names)
+
+
+MODULES = _walk_modules()
+
+
+@pytest.mark.parametrize("module_name", MODULES)
+def test_module_doctests(module_name):
+    mod = importlib.import_module(module_name)
+    result = doctest.testmod(mod, verbose=False, optionflags=doctest.NORMALIZE_WHITESPACE)
+    assert result.failed == 0, f"{result.failed} doctest failure(s) in {module_name}"
+
+
+def test_doctest_examples_are_collected():
+    """Guard against vacuous passes: a collection regression (e.g. __module__
+    mismatch hiding examples from testmod) must not silently stop the examples
+    from being executed."""
+    total = 0
+    for module_name in MODULES:
+        mod = importlib.import_module(module_name)
+        for test in doctest.DocTestFinder().find(mod):
+            if test.examples and test.name.startswith(module_name):
+                total += len(test.examples)
+    assert total >= 300, f"expected the package's doctest examples to be collected, found {total}"
