@@ -13,7 +13,16 @@ Array = jax.Array
 
 
 def signal_noise_ratio(preds: Array, target: Array, zero_mean: bool = False) -> Array:
-    """SNR = 10 log10(P_signal / P_noise)."""
+    """SNR = 10 log10(P_signal / P_noise).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import signal_noise_ratio
+        >>> target = jnp.asarray([1.0, 2.0, 3.0, 4.0])
+        >>> preds = jnp.asarray([1.1, 2.1, 2.9, 4.2])
+        >>> print(f"{float(signal_noise_ratio(preds, target)):.4f}")
+        26.3202
+    """
     preds, target = jnp.asarray(preds), jnp.asarray(target)
     _check_same_shape(preds, target)
     eps = jnp.finfo(preds.dtype).eps
@@ -34,7 +43,16 @@ def snr(preds: Array, target: Array, zero_mean: bool = False) -> Array:
 
 
 def scale_invariant_signal_noise_ratio(preds: Array, target: Array) -> Array:
-    """SI-SNR (scale-invariant SDR with zero-mean)."""
+    """SI-SNR (scale-invariant SDR with zero-mean).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import scale_invariant_signal_noise_ratio
+        >>> target = jnp.asarray([1.0, 2.0, 3.0, 4.0])
+        >>> preds = jnp.asarray([1.1, 2.1, 2.9, 4.2])
+        >>> print(f"{float(scale_invariant_signal_noise_ratio(preds, target)):.4f}")
+        20.3551
+    """
     from metrics_tpu.functional.audio.sdr import scale_invariant_signal_distortion_ratio
 
     return scale_invariant_signal_distortion_ratio(preds=preds, target=target, zero_mean=True)

@@ -132,6 +132,15 @@ def auroc(
     max_fpr: Optional[float] = None,
     sample_weights: Optional[Sequence] = None,
 ) -> Array:
-    """Compute AUROC. Parity: reference ``auroc:186-254``."""
+    """Compute AUROC. Parity: reference ``auroc:186-254``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import auroc
+        >>> preds = jnp.asarray([0.1, 0.4, 0.35, 0.8])
+        >>> target = jnp.asarray([0, 0, 1, 1])
+        >>> print(f"{float(auroc(preds, target)):.4f}")
+        0.7500
+    """
     preds, target, mode = _auroc_update(preds, target)
     return _auroc_compute(preds, target, mode, num_classes, pos_label, average, max_fpr, sample_weights)

@@ -63,6 +63,15 @@ def confusion_matrix(
     threshold: float = 0.5,
     multilabel: bool = False,
 ) -> Array:
-    """Compute the (C,C) (or (C,2,2) multilabel) confusion matrix. Parity: ``:119-186``."""
+    """Compute the (C,C) (or (C,2,2) multilabel) confusion matrix. Parity: ``:119-186``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import confusion_matrix
+        >>> target = jnp.asarray([1, 1, 0, 0])
+        >>> preds = jnp.asarray([0, 1, 0, 0])
+        >>> confusion_matrix(preds, target, num_classes=2).tolist()
+        [[2, 0], [1, 1]]
+    """
     confmat = _confusion_matrix_update(preds, target, num_classes, threshold, multilabel)
     return _confusion_matrix_compute(confmat, normalize)

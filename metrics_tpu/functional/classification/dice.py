@@ -32,6 +32,14 @@ def dice_score(
         nan_score: score to return when the denominator (2*tp+fp+fn) is zero
         no_fg_score: score to return for a class absent from ``target``
         reduction: ``'elementwise_mean'`` | ``'sum'`` | ``'none'``
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import dice_score
+        >>> preds = jnp.asarray([[0.1, 0.9], [0.8, 0.2]])
+        >>> target = jnp.asarray([1, 0])
+        >>> print(f"{float(dice_score(preds, target)):.4f}")
+        1.0000
     """
     if preds.ndim < 2:
         raise ValueError(

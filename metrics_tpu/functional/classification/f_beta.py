@@ -121,7 +121,16 @@ def f1(
     top_k: Optional[int] = None,
     multiclass: Optional[bool] = None,
 ) -> Array:
-    """F1 = F-beta with beta=1. Parity: reference ``f1:225-331``."""
+    """F1 = F-beta with beta=1. Parity: reference ``f1:225-331``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import f1_score
+        >>> target = jnp.asarray([1, 1, 0, 0])
+        >>> preds = jnp.asarray([0, 1, 0, 0])
+        >>> print(f"{float(f1_score(preds, target)):.4f}")
+        0.7500
+    """
     return fbeta(preds, target, 1.0, average, mdmc_average, ignore_index, num_classes, threshold, top_k, multiclass)
 
 

@@ -30,7 +30,16 @@ def _compute_image_gradients(img: Array) -> Tuple[Array, Array]:
 
 
 def image_gradients(img: Array) -> Tuple[Array, Array]:
-    """Compute (dy, dx) finite-difference gradients of an (N, C, H, W) image."""
+    """Compute (dy, dx) finite-difference gradients of an (N, C, H, W) image.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import image_gradients
+        >>> img = jnp.arange(16.0).reshape(1, 1, 4, 4)
+        >>> dy, dx = image_gradients(img)
+        >>> dy[0, 0, 0].tolist()
+        [4.0, 4.0, 4.0, 4.0]
+    """
     img = jnp.asarray(img)
     _image_gradients_validate(img)
     return _compute_image_gradients(img)

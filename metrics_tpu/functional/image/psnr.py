@@ -57,7 +57,16 @@ def psnr(
     reduction: str = "elementwise_mean",
     dim: Optional[Union[int, Tuple[int, ...]]] = None,
 ) -> Array:
-    """Compute PSNR. Parity: reference ``psnr:86-141``."""
+    """Compute PSNR. Parity: reference ``psnr:86-141``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import psnr
+        >>> preds = jnp.asarray([[0.0, 1.0], [1.0, 0.0]])
+        >>> target = jnp.asarray([[0.0, 1.0], [1.0, 1.0]])
+        >>> print(f"{float(psnr(preds, target, data_range=1.0)):.4f}")
+        6.0206
+    """
     preds, target = jnp.asarray(preds), jnp.asarray(target)
     if dim is None and reduction != "elementwise_mean":
         rank_zero_warn(f"The `reduction={reduction}` will not have any effect when `dim` is None.")

@@ -25,6 +25,15 @@ def _mean_squared_error_compute(sum_squared_error: Array, n_obs: Array, squared:
 
 
 def mean_squared_error(preds: Array, target: Array, squared: bool = True) -> Array:
-    """Compute MSE (or RMSE with squared=False)."""
+    """Compute MSE (or RMSE with squared=False).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import mean_squared_error
+        >>> target = jnp.asarray([2.5, 5.0, 4.0, 8.0])
+        >>> preds = jnp.asarray([3.0, 5.0, 2.5, 7.0])
+        >>> print(f"{float(mean_squared_error(preds, target)):.4f}")
+        0.8750
+    """
     sum_squared_error, n_obs = _mean_squared_error_update(jnp.asarray(preds), jnp.asarray(target))
     return _mean_squared_error_compute(sum_squared_error, n_obs, squared=squared)

@@ -49,7 +49,16 @@ def _pearson_corrcoef_compute(var_x: Array, var_y: Array, corr_xy: Array, nb: Ar
 
 
 def pearson_corrcoef(preds: Array, target: Array) -> Array:
-    """Compute the Pearson correlation coefficient."""
+    """Compute the Pearson correlation coefficient.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import pearson_corrcoef
+        >>> target = jnp.asarray([2.5, 5.0, 4.0, 8.0])
+        >>> preds = jnp.asarray([3.0, 5.0, 2.5, 7.0])
+        >>> print(f"{float(pearson_corrcoef(preds, target)):.4f}")
+        0.9202
+    """
     preds = jnp.asarray(preds, dtype=jnp.float32) if not jnp.issubdtype(jnp.asarray(preds).dtype, jnp.floating) else jnp.asarray(preds)
     target = jnp.asarray(target, dtype=preds.dtype) if not jnp.issubdtype(jnp.asarray(target).dtype, jnp.floating) else jnp.asarray(target)
     zero = jnp.zeros([], dtype=preds.dtype)

@@ -78,6 +78,15 @@ def r2_score(
     adjusted: int = 0,
     multioutput: str = "uniform_average",
 ) -> Array:
-    """Compute the R2 (coefficient of determination) score."""
+    """Compute the R2 (coefficient of determination) score.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import r2_score
+        >>> target = jnp.asarray([2.5, 5.0, 4.0, 8.0])
+        >>> preds = jnp.asarray([3.0, 5.0, 2.5, 7.0])
+        >>> print(f"{float(r2_score(preds, target)):.4f}")
+        0.7838
+    """
     sum_squared_obs, sum_obs, rss, n_obs = _r2_score_update(jnp.asarray(preds), jnp.asarray(target))
     return _r2_score_compute(sum_squared_obs, sum_obs, rss, jnp.asarray(n_obs), adjusted, multioutput)

@@ -11,7 +11,16 @@ Array = jax.Array
 
 
 def retrieval_average_precision(preds: Array, target: Array) -> Array:
-    """AP of a single query's predictions."""
+    """AP of a single query's predictions.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import retrieval_average_precision
+        >>> preds = jnp.asarray([0.2, 0.9, 0.7])
+        >>> target = jnp.asarray([1, 0, 1])
+        >>> print(f"{float(retrieval_average_precision(preds, target)):.4f}")
+        0.5833
+    """
     preds, target = _check_retrieval_functional_inputs(preds, target)
     if not int(jnp.sum(target)):
         return jnp.asarray(0.0)

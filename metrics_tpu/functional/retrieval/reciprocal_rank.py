@@ -11,7 +11,16 @@ Array = jax.Array
 
 
 def retrieval_reciprocal_rank(preds: Array, target: Array) -> Array:
-    """RR = 1 / rank of the first relevant document."""
+    """RR = 1 / rank of the first relevant document.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import retrieval_reciprocal_rank
+        >>> preds = jnp.asarray([0.2, 0.9, 0.7])
+        >>> target = jnp.asarray([1, 0, 1])
+        >>> print(f"{float(retrieval_reciprocal_rank(preds, target)):.4f}")
+        0.5000
+    """
     preds, target = _check_retrieval_functional_inputs(preds, target)
     if not int(jnp.sum(target)):
         return jnp.asarray(0.0)

@@ -95,7 +95,14 @@ def bleu_score(
     n_gram: int = 4,
     smooth: bool = False,
 ) -> Array:
-    """Corpus BLEU with uniform n-gram weights and brevity penalty."""
+    """Corpus BLEU with uniform n-gram weights and brevity penalty.
+
+    Example:
+        >>> from metrics_tpu.functional import bleu_score
+        >>> score = bleu_score(['the cat sat on the mat'], [['a cat sat on the mat']])
+        >>> print(f"{float(score):.4f}")
+        0.7598
+    """
     translate_corpus_ = [translate_corpus] if isinstance(translate_corpus, str) else translate_corpus
     reference_corpus_ = [
         [reference_text] if isinstance(reference_text, str) else reference_text

@@ -32,7 +32,14 @@ def _wer_compute(errors: Array, total: Array) -> Array:
 
 
 def word_error_rate(predictions: Union[str, List[str]], references: Union[str, List[str]]) -> Array:
-    """WER = edit operations / reference words."""
+    """WER = edit operations / reference words.
+
+    Example:
+        >>> from metrics_tpu.functional import word_error_rate
+        >>> score = word_error_rate(['hello there world'], ['hello there word'])
+        >>> print(f"{float(score):.4f}")
+        0.3333
+    """
     errors, total = _wer_update(predictions, references)
     return _wer_compute(errors, total)
 
