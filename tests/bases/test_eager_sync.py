@@ -23,7 +23,7 @@ from metrics_tpu import Accuracy, MetricCollection
 from metrics_tpu.metric import Metric
 from metrics_tpu.utils.checkpoint import load_metric_state, save_metric_state
 from metrics_tpu.utils.exceptions import MetricsTPUUserError
-from tests.helpers.testers import DummyMetricSum
+from tests.helpers.testers import mesh_devices, DummyMetricSum
 
 
 class EveryReduceMetric(Metric):
@@ -200,7 +200,7 @@ def test_dist_sync_on_step_in_shard_map(devices):
     """forward() with dist_sync_on_step=True inside shard_map returns the
     cross-device batch value on every device (reference metric.py:69-70,209 made
     cheap: the sync is one fused psum in the same compiled step)."""
-    mesh = Mesh(np.asarray(jax.devices()), ("dp",))
+    mesh = Mesh(np.asarray(mesh_devices()), ("dp",))
 
     @partial(jax.shard_map, mesh=mesh, in_specs=P("dp"), out_specs=P(), check_vma=False)
     def step(x):
@@ -213,7 +213,7 @@ def test_dist_sync_on_step_in_shard_map(devices):
 
 def test_forward_without_dist_sync_on_step_in_shard_map(devices):
     """Without dist_sync_on_step the step value stays device-local."""
-    mesh = Mesh(np.asarray(jax.devices()), ("dp",))
+    mesh = Mesh(np.asarray(mesh_devices()), ("dp",))
 
     @partial(jax.shard_map, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"), check_vma=False)
     def step(x):

@@ -14,11 +14,11 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from metrics_tpu import MetricCollection, metric_axis
 from metrics_tpu.parallel.collectives import fused_axis_sync
-from tests.helpers.testers import DummyListMetric, DummyMetricSum
+from tests.helpers.testers import mesh_devices, DummyListMetric, DummyMetricSum
 
 
 def _mesh():
-    return Mesh(np.asarray(jax.devices()), ("dp",))
+    return Mesh(np.asarray(mesh_devices()), ("dp",))
 
 
 def test_sum_sync(devices):
@@ -204,7 +204,7 @@ def test_tuple_axis_sync(devices):
     from metrics_tpu.parallel.collectives import axis_size_or_one, in_mapped_context
 
     m = DummyMetricSum()
-    mesh2d = Mesh(np.asarray(jax.devices()).reshape(4, 2), ("dp", "grp"))
+    mesh2d = Mesh(np.asarray(mesh_devices()).reshape(4, 2), ("dp", "grp"))
 
     @partial(jax.shard_map, mesh=mesh2d, in_specs=P(("dp", "grp")), out_specs=P(), check_vma=False)
     def run(x):
@@ -223,7 +223,7 @@ def test_tuple_axis_subaxis_sync(devices):
     """Sub-axis sync on a 2D mesh: syncing over 'dp' only must reduce within
     each dp-column, leaving grp-groups independent."""
     m = DummyMetricSum()
-    mesh2d = Mesh(np.asarray(jax.devices()).reshape(4, 2), ("dp", "grp"))
+    mesh2d = Mesh(np.asarray(mesh_devices()).reshape(4, 2), ("dp", "grp"))
 
     @partial(jax.shard_map, mesh=mesh2d, in_specs=P(("dp", "grp")), out_specs=P("grp"), check_vma=False)
     def run(x):

@@ -12,14 +12,20 @@ and override jax_platforms explicitly.
 """
 import os
 
-os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
-)
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Opt-in real-hardware run: METRICS_TPU_TEST_PLATFORM=axon (or tpu) runs the
+# suite on the actual chip(s) instead of the virtual CPU mesh. Tests that need
+# the 8-device mesh skip when the hardware has fewer.
+_PLATFORM = os.environ.get("METRICS_TPU_TEST_PLATFORM", "cpu")
+
+if _PLATFORM == "cpu":
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    )
+os.environ.setdefault("JAX_PLATFORMS", _PLATFORM)
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_platforms", _PLATFORM)
 jax.config.update("jax_default_matmul_precision", "highest")
 
 import pytest  # noqa: E402
@@ -29,6 +35,6 @@ NUM_DEVICES = 8
 
 @pytest.fixture(scope="session")
 def devices():
-    devs = jax.devices()
-    assert len(devs) == NUM_DEVICES, f"expected {NUM_DEVICES} cpu devices, got {devs}"
-    return devs
+    from tests.helpers.testers import mesh_devices
+
+    return mesh_devices()

@@ -21,6 +21,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from metrics_tpu import Accuracy, F1Score, MeanMetric, MetricCollection
 from metrics_tpu.utils.checkpoint import load_metric_state, save_metric_state
+from tests.helpers.testers import mesh_devices
 
 N_DEV = 8
 BATCH = 64  # global batch, 8 per device
@@ -110,7 +111,7 @@ def _run_loop(mesh, xs, ys, resume_at=None, ckpt_path=None):
 
 @pytest.fixture(scope="module")
 def mesh():
-    return Mesh(np.asarray(jax.devices()), ("dp",))
+    return Mesh(np.asarray(mesh_devices()), ("dp",))
 
 
 def test_mesh_loop_matches_single_device(mesh, devices):

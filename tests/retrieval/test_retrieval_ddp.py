@@ -18,6 +18,7 @@ from sklearn.metrics import average_precision_score, ndcg_score
 
 from metrics_tpu import RetrievalMAP, RetrievalMRR, RetrievalNormalizedDCG, RetrievalPrecision
 from tests.helpers import seed_all
+from tests.helpers.testers import mesh_devices
 
 seed_all(7)
 
@@ -38,7 +39,7 @@ _indexes = np.stack(
 
 
 def _mesh():
-    return Mesh(np.asarray(jax.devices()), ("dp",))
+    return Mesh(np.asarray(mesh_devices()), ("dp",))
 
 
 def _synced_state(metric):

@@ -17,6 +17,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from metrics_tpu import BLEUScore, CHRFScore, CharErrorRate, WordErrorRate
 from metrics_tpu.functional import bleu_score, char_error_rate, chrf_score, word_error_rate
+from tests.helpers.testers import mesh_devices
 
 PREDS = [
     "the cat sat on the mat",
@@ -42,7 +43,7 @@ N_DEV = 8
 
 
 def _mesh():
-    return Mesh(np.asarray(jax.devices()), ("dp",))
+    return Mesh(np.asarray(mesh_devices()), ("dp",))
 
 
 def _device_states(metric, update_args_per_device):

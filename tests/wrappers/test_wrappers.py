@@ -18,6 +18,7 @@ from metrics_tpu import (
     Recall,
 )
 from tests.helpers import seed_all
+from tests.helpers.testers import mesh_devices
 
 seed_all(42)
 
@@ -136,7 +137,7 @@ class TestWrappersOnMesh:
         from jax.sharding import Mesh, PartitionSpec as P
 
         m = MinMaxMetric(MeanSquaredError())
-        mesh = Mesh(np.asarray(jax.devices()), ("dp",))
+        mesh = Mesh(np.asarray(mesh_devices()), ("dp",))
 
         rng = np.random.RandomState(0)
         preds = rng.rand(8, 4).astype(np.float32)
@@ -163,7 +164,7 @@ class TestWrappersOnMesh:
         # remove_nans does data-dependent boolean indexing (eager-only, like the
         # reference's boolean masking) — off inside a compiled region
         m = MultioutputWrapper(MeanSquaredError(), num_outputs=2, remove_nans=False)
-        mesh = Mesh(np.asarray(jax.devices()), ("dp",))
+        mesh = Mesh(np.asarray(mesh_devices()), ("dp",))
 
         rng = np.random.RandomState(1)
         preds = rng.rand(8, 3, 2).astype(np.float32)
@@ -225,7 +226,7 @@ def test_bootstrapper_multinomial_in_trace(devices):
 
     b = BootStrapper(MeanSquaredError(), num_bootstraps=4,
                      sampling_strategy="multinomial", seed=0, raw=True)
-    mesh = Mesh(np.asarray(jax.devices()), ("dp",))
+    mesh = Mesh(np.asarray(mesh_devices()), ("dp",))
 
     rng = np.random.RandomState(2)
     preds = rng.rand(8, 16).astype(np.float32)
