@@ -35,8 +35,10 @@ class AUC(Metric):
         self.add_state("x", default=[], dist_reduce_fx="cat")
         self.add_state("y", default=[], dist_reduce_fx="cat")
 
-    def update(self, x: Array, y: Array) -> None:
-        x, y = _auc_update(x, y)
+    def update(self, preds: Array, target: Array) -> None:
+        # arg names match the reference (``classification/auc.py:75``) for
+        # kwarg-routing parity; semantically these are the curve's x/y points
+        x, y = _auc_update(preds, target)
         self.x.append(x)
         self.y.append(y)
 

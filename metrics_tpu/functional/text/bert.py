@@ -10,6 +10,7 @@ bert_score :452). TPU-native differences:
   * matching is one batched einsum (L_p x L_r similarity per pair) + masked max —
     MXU work, no python token loops.
 """
+import os
 import zlib
 from collections import Counter, OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Tuple, Union
@@ -265,6 +266,47 @@ def _score_embeddings_packed(
     return jnp.stack([p, r, f1])
 
 
+def _resolve_baseline_path(
+    rescale_with_baseline: bool, baseline_path: Optional[str], baseline_url: Optional[str]
+) -> Optional[str]:
+    """Reference API parity (``bert.py:384-411`` fetches the CSV from a url):
+    this build has no network egress, so ``baseline_url`` is honored only when
+    it is a local file (optionally ``file://``-prefixed). Like the reference,
+    both knobs are ignored entirely unless rescaling is enabled."""
+    if not rescale_with_baseline:
+        return None
+    if baseline_url is not None and baseline_path is None:
+        local = baseline_url[7:] if baseline_url.startswith("file://") else baseline_url
+        if not os.path.exists(local):
+            raise ValueError(
+                "`baseline_url` cannot be downloaded in this build; pass a local csv via "
+                "`baseline_path` (or a file:// url)."
+            )
+        baseline_path = local
+    if baseline_path is None:
+        raise ValueError("Baseline rescaling requires a local `baseline_path` csv (no downloads in this build).")
+    if not os.path.exists(baseline_path):
+        raise ValueError(f"Baseline csv not found: {baseline_path!r}")
+    return baseline_path
+
+
+def _load_baseline_row(baseline_path: str, num_layers: Optional[int]) -> np.ndarray:
+    table = np.atleast_2d(np.loadtxt(baseline_path, delimiter=",", skiprows=1))
+    row = num_layers if num_layers is not None else -1
+    if row >= table.shape[0]:
+        raise ValueError(
+            f"Baseline csv {baseline_path!r} has {table.shape[0]} rows; no row for num_layers={num_layers}."
+        )
+    return table[row][1:]
+
+
+def _apply_baseline(precision, recall, f1, baseline: np.ndarray):
+    precision = (precision - baseline[0]) / (1 - baseline[0])
+    recall = (recall - baseline[1]) / (1 - baseline[1])
+    f1 = (f1 - baseline[2]) / (1 - baseline[2])
+    return precision, recall, f1
+
+
 def bert_score(
     predictions: List[str],
     references: List[str],
@@ -284,6 +326,7 @@ def bert_score(
     lang: str = "en",
     rescale_with_baseline: bool = False,
     baseline_path: Optional[str] = None,
+    baseline_url: Optional[str] = None,
 ) -> Dict[str, Union[List[float], str]]:
     """Compute BERTScore P/R/F1 per sentence pair.
 
@@ -299,8 +342,7 @@ def bert_score(
     """
     if len(predictions) != len(references):
         raise ValueError("Number of predicted and reference sentences must be the same!")
-    if rescale_with_baseline and baseline_path is None:
-        raise ValueError("Baseline rescaling requires a local `baseline_path` csv (no downloads in this build).")
+    baseline_path = _resolve_baseline_path(rescale_with_baseline, baseline_path, baseline_url)
 
     # ---- tokenize (host)
     if user_tokenizer is not None:
@@ -318,10 +360,9 @@ def bert_score(
     )
 
     if rescale_with_baseline:
-        baseline = np.loadtxt(baseline_path, delimiter=",", skiprows=1)[num_layers or -1][1:]
-        precision = (precision - baseline[0]) / (1 - baseline[0])
-        recall = (recall - baseline[1]) / (1 - baseline[1])
-        f1 = (f1 - baseline[2]) / (1 - baseline[2])
+        precision, recall, f1 = _apply_baseline(
+            precision, recall, f1, _load_baseline_row(baseline_path, num_layers)
+        )
 
     output: Dict[str, Union[List[float], str]] = {
         "precision": [float(x) for x in precision],

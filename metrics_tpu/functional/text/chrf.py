@@ -11,6 +11,8 @@ from typing import Dict, List, Optional, Sequence, Tuple, Union
 import jax
 import jax.numpy as jnp
 
+from metrics_tpu.functional.text.helper import _canonicalize_corpora, _resolve_corpus_aliases
+
 Array = jax.Array
 
 _EPS_SMOOTHING = 1e-16
@@ -52,7 +54,9 @@ def _chrf_score_from_totals(
     """F-beta over per-order precision/recall averages (sacrebleu semantics)."""
     precision = jnp.where(total_pred > 0, matching / jnp.maximum(total_pred, 1), 0.0)
     recall = jnp.where(total_ref > 0, matching / jnp.maximum(total_ref, 1), 0.0)
-    order_mask = (total_pred + total_ref) > 0
+    # sacrebleu effective-order smoothing: an order counts only when BOTH sides
+    # produced n-grams of that order (short references drop the high orders)
+    order_mask = (total_pred > 0) & (total_ref > 0)
     n_eff = jnp.maximum(jnp.sum(order_mask), 1)
     avg_precision = jnp.sum(jnp.where(order_mask, precision, 0.0)) / n_eff
     avg_recall = jnp.sum(jnp.where(order_mask, recall, 0.0)) / n_eff
@@ -62,6 +66,24 @@ def _chrf_score_from_totals(
         denom > 0, (1 + beta2) * avg_precision * avg_recall / jnp.maximum(denom, _EPS_SMOOTHING), 0.0
     )
     return f_score
+
+
+def _chrf_score_np(matching, total_pred, total_ref, beta: float) -> float:
+    """Host-side twin of :func:`_chrf_score_from_totals` for best-reference
+    selection — plain numpy, no device dispatch in the corpus hot loop."""
+    import numpy as np
+
+    precision = np.where(total_pred > 0, matching / np.maximum(total_pred, 1), 0.0)
+    recall = np.where(total_ref > 0, matching / np.maximum(total_ref, 1), 0.0)
+    order_mask = (total_pred > 0) & (total_ref > 0)
+    n_eff = max(int(order_mask.sum()), 1)
+    avg_precision = float(precision[order_mask].sum()) / n_eff
+    avg_recall = float(recall[order_mask].sum()) / n_eff
+    beta2 = beta ** 2
+    denom = beta2 * avg_precision + avg_recall
+    if denom <= 0:
+        return 0.0
+    return (1 + beta2) * avg_precision * avg_recall / max(denom, _EPS_SMOOTHING)
 
 
 def _chrf_update(
@@ -84,23 +106,33 @@ def _chrf_update(
     m_np = np.zeros(n_order)
     p_np = np.zeros(n_order)
     r_np = np.zeros(n_order)
-    for pred, ref in zip(preds, targets):
+    for pred, refs in zip(preds, targets):
         p_char, p_word = _sentence_counts(pred, n_char_order, n_word_order, lowercase, whitespace)
-        r_char, r_word = _sentence_counts(ref, n_char_order, n_word_order, lowercase, whitespace)
-        sent_m = np.zeros(n_order)
-        sent_p = np.zeros(n_order)
-        sent_r = np.zeros(n_order)
-        for i, (pc, rc) in enumerate(list(zip(p_char, r_char)) + list(zip(p_word, r_word))):
-            sent_m[i] = _matching(pc, rc)
-            sent_p[i] = sum(pc.values())
-            sent_r[i] = sum(rc.values())
+        # multi-reference: evaluate every reference and keep the statistics of
+        # the best-matching one (reference ``chrf.py:313-375``); the common
+        # single-reference case skips the selection scoring entirely
+        cands = []
+        for ref in ([refs] if isinstance(refs, str) else list(refs)):
+            r_char, r_word = _sentence_counts(ref, n_char_order, n_word_order, lowercase, whitespace)
+            cand_m = np.zeros(n_order)
+            cand_p = np.zeros(n_order)
+            cand_r = np.zeros(n_order)
+            for i, (pc, rc) in enumerate(list(zip(p_char, r_char)) + list(zip(p_word, r_word))):
+                cand_m[i] = _matching(pc, rc)
+                # sacrebleu: a hypothesis n-gram count only stands when the
+                # reference produced ANY n-gram of that order
+                cand_p[i] = sum(pc.values()) if rc else 0
+                cand_r[i] = sum(rc.values())
+            cands.append((cand_m, cand_p, cand_r))
+        if len(cands) == 1:
+            sent_m, sent_p, sent_r = cands[0]
+        else:  # first-wins ties, like sacrebleu's strict > comparison
+            sent_m, sent_p, sent_r = max(cands, key=lambda c: _chrf_score_np(c[0], c[1], c[2], beta))
         m_np += sent_m
         p_np += sent_p
         r_np += sent_r
         if sentence_scores is not None:
-            sentence_scores.append(
-                _chrf_score_from_totals(jnp.asarray(sent_m), jnp.asarray(sent_p), jnp.asarray(sent_r), beta)
-            )
+            sentence_scores.append(jnp.asarray(_chrf_score_np(sent_m, sent_p, sent_r, beta)))
     return (
         matching + jnp.asarray(m_np, dtype=jnp.float32),
         total_pred + jnp.asarray(p_np, dtype=jnp.float32),
@@ -113,16 +145,23 @@ def _chrf_compute(matching: Array, total_pred: Array, total_ref: Array, beta: fl
 
 
 def chrf_score(
-    preds: Union[str, Sequence[str]],
-    targets: Union[str, Sequence[str]],
+    preds: Union[str, Sequence[str], None] = None,
+    targets: Union[str, Sequence[str], Sequence[Sequence[str]], None] = None,
     n_char_order: int = 6,
     n_word_order: int = 2,
     beta: float = 2.0,
     lowercase: bool = False,
     whitespace: bool = False,
     return_sentence_level_score: bool = False,
+    *,
+    hypothesis_corpus: Union[str, Sequence[str], None] = None,
+    reference_corpus: Union[str, Sequence[str], Sequence[Sequence[str]], None] = None,
 ) -> Union[Array, Tuple[Array, Array]]:
-    """Corpus chrF (chrF++ with word n-grams). Parity: reference ``chrf_score``."""
+    """Corpus chrF (chrF++ with word n-grams). Parity: reference ``chrf_score``
+    (``chrf.py:588``) — its keyword names ``hypothesis_corpus``/``reference_corpus``
+    are accepted as aliases of ``preds``/``targets`` (same positional order), and
+    multi-reference corpora follow the reference's ``_validate_inputs`` shapes."""
+    preds, targets = _resolve_corpus_aliases("chrf_score", preds, targets, hypothesis_corpus, reference_corpus)
     if not isinstance(n_char_order, int) or n_char_order < 1:
         raise ValueError("Expected argument `n_char_order` to be an integer greater than or equal to 1.")
     if not isinstance(n_word_order, int) or n_word_order < 0:
@@ -130,8 +169,7 @@ def chrf_score(
     if beta < 0:
         raise ValueError("Expected argument `beta` to be greater than 0.")
 
-    preds_ = [preds] if isinstance(preds, str) else list(preds)
-    targets_ = [targets] if isinstance(targets, str) else list(targets)
+    preds_, targets_ = _canonicalize_corpora(preds, targets)
 
     n_order = n_char_order + n_word_order
     matching = jnp.zeros(n_order)

@@ -136,3 +136,47 @@ def _ngram_counts(tokens: Sequence, n_gram: int) -> Counter:
         for i in range(len(tokens) - n + 1):
             counts[tuple(tokens[i:i + n])] += 1
     return counts
+
+
+def _resolve_corpus_aliases(fn_name, preds, targets, hypothesis_corpus, reference_corpus):
+    """Accept the reference's keyword names (``hypothesis_corpus``/
+    ``reference_corpus``) as aliases of ``preds``/``targets``; double
+    specification raises like an ordinary duplicate keyword would."""
+    if hypothesis_corpus is not None:
+        if preds is not None:
+            raise TypeError(f"{fn_name}() got multiple values for the hypothesis corpus (`preds` and `hypothesis_corpus`).")
+        preds = hypothesis_corpus
+    if reference_corpus is not None:
+        if targets is not None:
+            raise TypeError(f"{fn_name}() got multiple values for the reference corpus (`targets` and `reference_corpus`).")
+        targets = reference_corpus
+    if preds is None or targets is None:
+        raise ValueError(f"{fn_name} requires both a hypothesis (`preds`) and a reference (`targets`) corpus.")
+    return preds, targets
+
+
+def _canonicalize_corpora(preds, targets):
+    """Canonicalize to (hypotheses: List[str], references: List[List[str]]).
+
+    Parity: reference ``helper.py:_validate_inputs`` — a flat reference list
+    with a SINGLE hypothesis means several references for that hypothesis;
+    with many hypotheses it means one reference each; mismatched corpus sizes
+    raise. An empty reference set scores against the empty string (zero
+    matches) instead of crashing.
+    """
+    hyps = [preds] if isinstance(preds, str) else list(preds)
+    if isinstance(targets, str):
+        refs = [[targets]]
+    else:
+        targets = list(targets)  # materialize once — generators must not be consumed twice
+        if all(isinstance(r, str) for r in targets):
+            refs = [targets] if len(hyps) == 1 else [[r] for r in targets]
+        else:
+            refs = [[t] if isinstance(t, str) else list(t) for t in targets]
+    # stricter than the reference guard (``helper.py:350`` skips the check when a
+    # reference group is empty — silently zip-truncating mismatched corpora);
+    # matched corpora behave identically
+    if len(refs) != len(hyps):
+        raise ValueError(f"Corpus has different size {len(refs)} != {len(hyps)}")
+    refs = [r if r else [""] for r in refs]
+    return hyps, refs

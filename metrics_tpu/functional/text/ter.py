@@ -12,7 +12,7 @@ from typing import List, Optional, Sequence, Tuple, Union
 import jax
 import jax.numpy as jnp
 
-from metrics_tpu.functional.text.helper import _edit_distance
+from metrics_tpu.functional.text.helper import _canonicalize_corpora, _edit_distance, _resolve_corpus_aliases
 
 Array = jax.Array
 
@@ -157,38 +157,59 @@ def _ter_update(
     ref_len_sum = 0.0
     for pred, refs in zip(preds, targets):
         pred_words = _preprocess_sentence(pred, lowercase, normalize, no_punctuation, asian_support)
+        # multi-reference (reference ``ter.py:448-475``): the BEST (lowest) edit
+        # count over all references, normalized by the AVERAGE reference length
         best_edits = None
-        best_ref_len = None
+        ref_len_total = 0.0
         for ref in refs:
             ref_words = _preprocess_sentence(ref, lowercase, normalize, no_punctuation, asian_support)
             edits = _ter_sentence(pred_words, ref_words)
-            ref_len = max(len(ref_words), 1)
-            if best_edits is None or edits / ref_len < best_edits / best_ref_len:
-                best_edits, best_ref_len = edits, ref_len
+            ref_len_total += len(ref_words)
+            if best_edits is None or edits < best_edits:
+                best_edits = edits
+        avg_ref_len = ref_len_total / len(refs)
         edits_sum += best_edits
-        ref_len_sum += best_ref_len
+        ref_len_sum += avg_ref_len
         if sentence_scores is not None:
-            sentence_scores.append(jnp.asarray(best_edits / best_ref_len))
+            # reference ``ter.py:488-495`` zero-length rule
+            if avg_ref_len > 0 and best_edits > 0:
+                s = best_edits / avg_ref_len
+            elif avg_ref_len == 0 and best_edits > 0:
+                s = 1.0
+            else:
+                s = 0.0
+            sentence_scores.append(jnp.asarray(s))
     return total_num_edits + edits_sum, total_ref_len + ref_len_sum
 
 
 def _ter_compute(total_num_edits: Array, total_ref_len: Array) -> Array:
-    return total_num_edits / total_ref_len
+    # reference ``ter.py:488-495``: zero reference length scores 1 when edits
+    # remain, 0 when the hypothesis is empty too
+    return jnp.where(
+        total_ref_len > 0,
+        total_num_edits / jnp.maximum(total_ref_len, 1e-38),
+        jnp.where(total_num_edits > 0, 1.0, 0.0),
+    )
 
 
 def translation_edit_rate(
-    preds: Union[str, Sequence[str]],
-    targets: Union[str, Sequence[str], Sequence[Sequence[str]]],
+    preds: Union[str, Sequence[str], None] = None,
+    targets: Union[str, Sequence[str], Sequence[Sequence[str]], None] = None,
     normalize: bool = False,
     no_punctuation: bool = False,
     lowercase: bool = True,
     asian_support: bool = False,
     return_sentence_level_score: bool = False,
+    *,
+    hypothesis_corpus: Union[str, Sequence[str], None] = None,
+    reference_corpus: Union[str, Sequence[str], Sequence[Sequence[str]], None] = None,
 ) -> Union[Array, Tuple[Array, Array]]:
-    """Corpus TER = (shifts + edits) / reference length. Parity: reference API."""
-    preds_ = [preds] if isinstance(preds, str) else list(preds)
-    targets_ = [targets] if isinstance(targets, str) else list(targets)
-    targets_ = [[t] if isinstance(t, str) else list(t) for t in targets_]
+    """Corpus TER = (shifts + edits) / reference length. Parity: reference API
+    (``ter.py:560``) — its keyword names ``hypothesis_corpus``/``reference_corpus``
+    are accepted as aliases of ``preds``/``targets`` (same positional order), and
+    multi-reference corpora follow the reference's ``_validate_inputs`` shapes."""
+    preds, targets = _resolve_corpus_aliases("translation_edit_rate", preds, targets, hypothesis_corpus, reference_corpus)
+    preds_, targets_ = _canonicalize_corpora(preds, targets)
 
     total_num_edits = jnp.asarray(0.0)
     total_ref_len = jnp.asarray(0.0)

@@ -12,6 +12,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu.functional.text.bert import (
+    _apply_baseline,
+    _load_baseline_row,
+    _resolve_baseline_path,
     _resolve_forward,
     _score_tokenized,
     _simple_whitespace_tokenizer,
@@ -43,6 +46,7 @@ class BERTScore(Metric):
         lang: str = "en",
         rescale_with_baseline: bool = False,
         baseline_path: Optional[str] = None,
+        baseline_url: Optional[str] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -51,6 +55,11 @@ class BERTScore(Metric):
         self.batch_size = batch_size
         self.idf = idf
         self.user_tokenizer = user_tokenizer
+        self.rescale_with_baseline = rescale_with_baseline
+        # load at construction so a bad baseline config (missing/malformed csv,
+        # out-of-range num_layers) fails fast, and compute() does no file IO
+        path = _resolve_baseline_path(rescale_with_baseline, baseline_path, baseline_url)
+        self.baseline = _load_baseline_row(path, num_layers) if path is not None else None
         # resolve eagerly: a missing encoder should fail at construction
         self.forward_fn = _resolve_forward(user_forward_fn, model, model_name_or_path)
 
@@ -82,6 +91,8 @@ class BERTScore(Metric):
             idf=self.idf,
             batch_size=self.batch_size,
         )
+        if self.rescale_with_baseline:
+            precision, recall, f1 = _apply_baseline(precision, recall, f1, self.baseline)
         return {
             "precision": [float(x) for x in precision],
             "recall": [float(x) for x in recall],

@@ -9,6 +9,7 @@ import jax
 import jax.numpy as jnp
 
 from metrics_tpu.functional.text.chrf import _chrf_compute, _chrf_update
+from metrics_tpu.functional.text.helper import _canonicalize_corpora
 from metrics_tpu.metric import Metric
 from metrics_tpu.utils.data import dim_zero_cat
 
@@ -59,9 +60,9 @@ class CHRFScore(Metric):
         if return_sentence_level_score:
             self.add_state("sentence_chrf", [], dist_reduce_fx="cat")
 
-    def update(self, preds: Sequence[str], targets: Sequence[str]) -> None:
-        preds = [preds] if isinstance(preds, str) else preds
-        targets = [targets] if isinstance(targets, str) else targets
+    def update(self, hypothesis_corpus: Sequence[str], reference_corpus: Union[Sequence[str], Sequence[Sequence[str]]]) -> None:
+        # arg names match the reference (``text/chrf.py:145``) for kwarg-routing parity
+        preds, targets = _canonicalize_corpora(hypothesis_corpus, reference_corpus)
         sentence_scores: Optional[List[Array]] = [] if self.return_sentence_level_score else None
         self.matching, self.total_pred, self.total_ref = _chrf_update(
             preds, targets, self.matching, self.total_pred, self.total_ref,

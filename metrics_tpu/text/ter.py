@@ -7,6 +7,7 @@ from typing import Any, List, Optional, Sequence, Tuple, Union
 import jax
 import jax.numpy as jnp
 
+from metrics_tpu.functional.text.helper import _canonicalize_corpora
 from metrics_tpu.functional.text.ter import _ter_compute, _ter_update
 from metrics_tpu.metric import Metric
 from metrics_tpu.utils.data import dim_zero_cat
@@ -48,10 +49,9 @@ class TranslationEditRate(Metric):
         if return_sentence_level_score:
             self.add_state("sentence_ter", [], dist_reduce_fx="cat")
 
-    def update(self, preds: Sequence[str], targets: Sequence[Union[str, Sequence[str]]]) -> None:
-        preds = [preds] if isinstance(preds, str) else list(preds)
-        targets = [targets] if isinstance(targets, str) else list(targets)
-        targets = [[t] if isinstance(t, str) else list(t) for t in targets]
+    def update(self, hypothesis_corpus: Sequence[str], reference_corpus: Sequence[Union[str, Sequence[str]]]) -> None:
+        # arg names match the reference (``text/ter.py:105``) for kwarg-routing parity
+        preds, targets = _canonicalize_corpora(hypothesis_corpus, reference_corpus)
         sentence_scores: Optional[List[Array]] = [] if self.return_sentence_level_score else None
         self.total_num_edits, self.total_ref_len = _ter_update(
             preds, targets, self.total_num_edits, self.total_ref_len,

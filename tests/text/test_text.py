@@ -176,6 +176,45 @@ class TestCHRF:
         res = float(chrf_score(preds, refs, n_word_order=word_order))
         np.testing.assert_allclose(res, expected, atol=1e-4)
 
+    @pytest.mark.parametrize("word_order", [0, 2])
+    def test_vs_sacrebleu_chrf_multi_reference(self, word_order):
+        # per-hypothesis best-matching reference (reference chrf.py:313-375)
+        oracle = ChrfOracle(word_order=word_order)
+        preds = ["the cat sat on the mat", "a quick brown fox jumps"]
+        refs_a = ["the cat sat on a mat", "the quick brown fox jumps over"]
+        refs_b = ["a cat was sitting on the mat", "quick brown foxes jump"]
+        expected = oracle.corpus_score(preds, [refs_a, refs_b]).score / 100
+        res = float(chrf_score(preds, [[a, b] for a, b in zip(refs_a, refs_b)], n_word_order=word_order))
+        np.testing.assert_allclose(res, expected, atol=1e-4)
+
+    @pytest.mark.parametrize("word_order", [0, 2])
+    def test_vs_sacrebleu_chrf_short_references(self, word_order):
+        # references shorter than n_char_order exercise sacrebleu's two subtle
+        # rules: hyp counts are zeroed for orders the reference lacks, and the
+        # effective order requires BOTH sides to have n-grams of that order
+        oracle = ChrfOracle(word_order=word_order)
+        preds = ["the jumps dog ran", "a x brown fox fast", "a ran"]
+        refs = ["jumps", "ran on", "cat ran cat brown"]
+        expected = oracle.corpus_score(preds, [refs]).score / 100
+        res = float(chrf_score(preds, refs, n_word_order=word_order))
+        np.testing.assert_allclose(res, expected, atol=1e-4)
+
+    def test_vs_sacrebleu_chrf_fuzz(self):
+        # randomized corpora (short/degenerate sentences, 1-3 reference streams)
+        import random
+
+        rng = random.Random(7)
+        vocab = ["the", "cat", "sat", "on", "a", "mat", "yz", "x", "quick", "brown", "fox", "jumps", "ran"]
+        for _ in range(25):
+            n = rng.randint(1, 4)
+            preds = [" ".join(rng.choices(vocab, k=rng.randint(1, 6))) for _ in range(n)]
+            streams = [[" ".join(rng.choices(vocab, k=rng.randint(1, 6))) for _ in range(n)]
+                       for _ in range(rng.randint(1, 3))]
+            for wo in (0, 2):
+                expected = ChrfOracle(word_order=wo).corpus_score(preds, streams).score / 100
+                res = float(chrf_score(preds, [[s[i] for s in streams] for i in range(n)], n_word_order=wo))
+                np.testing.assert_allclose(res, expected, atol=1e-4, err_msg=f"{preds} vs {streams}")
+
     def test_class_with_sentence_scores(self):
         m = CHRFScore(return_sentence_level_score=True)
         m.update(PREDS_SINGLE, REFS_SINGLE)
@@ -192,6 +231,29 @@ class TestTER:
         expected = oracle.corpus_score(preds, [refs]).score / 100
         res = float(translation_edit_rate(preds, refs))
         np.testing.assert_allclose(res, expected, atol=1e-4)
+
+    def test_vs_sacrebleu_ter_multi_reference(self):
+        # per-hypothesis best (lowest-TER) reference
+        oracle = TerOracle()
+        preds = ["the cat sat on the mat", "a fast brown fox jumps over"]
+        refs_a = ["the cat is on the mat", "the quick brown fox jumps"]
+        refs_b = ["a cat sat on the mat", "a fast brown fox jumps over it"]
+        expected = oracle.corpus_score(preds, [refs_a, refs_b]).score / 100
+        res = float(translation_edit_rate(preds, [[a, b] for a, b in zip(refs_a, refs_b)]))
+        np.testing.assert_allclose(res, expected, atol=1e-4)
+
+    def test_empty_reference_set_scores_against_empty(self):
+        from metrics_tpu.functional import chrf_score
+
+        # no references: zero matches, not a crash (TER zero-ref-length rule -> 1)
+        np.testing.assert_allclose(float(translation_edit_rate(["a b c"], [[]])), 1.0)
+        assert float(chrf_score(["a b c"], [[]])) == 0.0
+
+    def test_flat_refs_single_hypothesis_are_multi_reference(self):
+        # reference helper.py:_validate_inputs — a flat list with ONE hypothesis
+        # means several references for it
+        multi = float(translation_edit_rate(["the cat sat"], ["the cat sat", "something else"]))
+        np.testing.assert_allclose(multi, 0.0, atol=1e-6)
 
     def test_shift_counted_once(self):
         # "b c a" -> "a b c" is one shift for TER (score 1/3), not two edits
@@ -326,3 +388,67 @@ class TestBERTScore:
         # 1e-6 slack: greedy-cosine f1 of identical texts is exactly 1.0, which
         # threaded CPU reductions intermittently round to 1 + O(1e-7)
         assert all(-1e-6 <= x <= 1 + 1e-6 for x in out["f1"])
+
+
+class TestReferenceKeywordParity:
+    """Reference users call text functionals/classes with the reference's own
+    keyword names (``hypothesis_corpus``/``reference_corpus``); both spellings
+    must hit the same code path."""
+
+    def test_chrf_keyword_aliases(self):
+        from metrics_tpu.functional import chrf_score
+
+        pos = chrf_score(["the cat sat"], ["the cat sat on a mat"])
+        kw = chrf_score(hypothesis_corpus=["the cat sat"], reference_corpus=["the cat sat on a mat"])
+        np.testing.assert_allclose(np.asarray(pos), np.asarray(kw))
+
+    def test_ter_keyword_aliases(self):
+        from metrics_tpu.functional import translation_edit_rate
+
+        pos = translation_edit_rate(["the cat sat"], [["the cat sat on a mat"]])
+        kw = translation_edit_rate(
+            hypothesis_corpus=["the cat sat"], reference_corpus=[["the cat sat on a mat"]]
+        )
+        np.testing.assert_allclose(np.asarray(pos), np.asarray(kw))
+
+    def test_missing_corpus_raises(self):
+        from metrics_tpu.functional import chrf_score, translation_edit_rate
+
+        with pytest.raises(ValueError, match="requires both"):
+            chrf_score(["only one side"])
+        with pytest.raises(ValueError, match="requires both"):
+            translation_edit_rate(hypothesis_corpus=["only one side"])
+
+    def test_class_keyword_names(self):
+        from metrics_tpu import CHRFScore, TranslationEditRate
+
+        c = CHRFScore()
+        c.update(hypothesis_corpus=["the cat sat"], reference_corpus=["the cat sat on a mat"])
+        assert float(c.compute()) > 0
+        t = TranslationEditRate()
+        t.update(hypothesis_corpus=["the cat sat"], reference_corpus=[["the cat sat on a mat"]])
+        assert float(t.compute()) > 0
+
+    def test_bert_baseline_url_local_only(self, tmp_path):
+        from metrics_tpu import BERTScore
+        from metrics_tpu.functional import bert_score
+
+        # without rescaling the url is ignored entirely (reference bert.py:607)
+        out = bert_score(["a b"], ["a b"], user_forward_fn=TestBERTScore._dummy_forward,
+                         baseline_url="https://example.com/b.csv")
+        assert len(out["f1"]) == 1
+        with pytest.raises(ValueError, match="cannot be downloaded"):
+            bert_score(["a"], ["a"], user_forward_fn=TestBERTScore._dummy_forward,
+                       rescale_with_baseline=True, baseline_url="https://example.com/b.csv")
+        csv = tmp_path / "baseline.csv"
+        # rows: layer index col + P/R/F1 baselines; loadtxt picks row [num_layers or -1]
+        csv.write_text("layer,P,R,F1\n0,0.1,0.1,0.1\n1,0.2,0.2,0.2\n")
+        raw = bert_score(["a b"], ["a b"], user_forward_fn=TestBERTScore._dummy_forward)
+        out = bert_score(["a b"], ["a b"], user_forward_fn=TestBERTScore._dummy_forward,
+                         rescale_with_baseline=True, baseline_url=str(csv))
+        np.testing.assert_allclose(out["f1"][0], (raw["f1"][0] - 0.2) / (1 - 0.2), atol=1e-6)
+        # the module class applies the same rescale at compute
+        m = BERTScore(user_forward_fn=TestBERTScore._dummy_forward,
+                      rescale_with_baseline=True, baseline_path=str(csv))
+        m.update(["a b"], ["a b"])
+        np.testing.assert_allclose(m.compute()["f1"][0], out["f1"][0], atol=1e-6)
