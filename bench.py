@@ -506,10 +506,16 @@ def main() -> None:
         ("bertscore", bench_bertscore),
         ("fid_update", bench_fid),
     ):
-        try:
-            extras[name] = fn()
-        except Exception as e:
-            extras[name] = {"error": str(e)[:200]}
+        # one retry: the tunnelled TPU occasionally drops a remote_compile
+        # mid-stream; a transient reset must not cost the config its number
+        errors = []
+        for _ in (0, 1):
+            try:
+                extras[name] = fn()
+                break
+            except Exception as e:
+                errors.append(str(e)[:200])
+                extras[name] = {"error": errors[0], "retry_error": errors[-1]} if len(errors) > 1 else {"error": errors[0]}
 
     print(
         json.dumps(
