@@ -6,7 +6,7 @@ import jax.numpy as jnp
 import pytest
 
 from metrics_tpu import CompositionalMetric, Metric
-from tests.helpers.testers import DummyMetricSum
+from tests.helpers.testers import oracle_rtol, DummyMetricSum
 
 
 def _make(x=5.0):
@@ -31,7 +31,7 @@ def test_arithmetic_two_metrics(op, expected):
     a, b = _make(5.0), _make(3.0)
     comp = op(a, b)
     assert isinstance(comp, CompositionalMetric)
-    assert float(comp.compute()) == pytest.approx(expected)
+    assert float(comp.compute()) == pytest.approx(expected, rel=oracle_rtol())
 
 
 @pytest.mark.parametrize(
@@ -48,7 +48,7 @@ def test_arithmetic_two_metrics(op, expected):
 )
 def test_arithmetic_with_scalar(op, expected):
     comp = op(_make(5.0))
-    assert float(comp.compute()) == pytest.approx(expected)
+    assert float(comp.compute()) == pytest.approx(expected, rel=oracle_rtol())
 
 
 @pytest.mark.parametrize(
@@ -81,7 +81,7 @@ def test_comparisons(op, expected):
 )
 def test_reflected_arithmetic_with_scalar(op, expected):
     comp = op(_make(5.0))
-    assert float(comp.compute()) == pytest.approx(expected)
+    assert float(comp.compute()) == pytest.approx(expected, rel=oracle_rtol())
 
 
 class _IntSum(Metric):

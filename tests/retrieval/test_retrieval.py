@@ -28,6 +28,7 @@ from metrics_tpu.functional import (
     retrieval_reciprocal_rank,
 )
 from tests.helpers import seed_all
+from tests.helpers.testers import oracle_atol
 
 seed_all(42)
 
@@ -53,28 +54,28 @@ class TestFunctionalVsSklearn:
             p, t = _group(q)
             res = float(retrieval_average_precision(p, t))
             expected = average_precision_score(t, p)
-            np.testing.assert_allclose(res, expected, atol=1e-6)
+            np.testing.assert_allclose(res, expected, atol=oracle_atol())
 
     def test_ndcg(self):
         for q in range(N_QUERIES):
             p, t = _group(q)
             res = float(retrieval_normalized_dcg(p, t))
             expected = ndcg_score(t[None], p[None])
-            np.testing.assert_allclose(res, expected, atol=1e-6)
+            np.testing.assert_allclose(res, expected, atol=oracle_atol())
 
     def test_ndcg_at_k(self):
         for q in range(N_QUERIES):
             p, t = _group(q)
             res = float(retrieval_normalized_dcg(p, t, k=5))
             expected = ndcg_score(t[None], p[None], k=5)
-            np.testing.assert_allclose(res, expected, atol=1e-6)
+            np.testing.assert_allclose(res, expected, atol=oracle_atol())
 
     def test_reciprocal_rank(self):
         for q in range(N_QUERIES):
             p, t = _group(q)
             order = np.argsort(-p, kind="stable")
             expected = 1.0 / (np.nonzero(t[order])[0][0] + 1)
-            np.testing.assert_allclose(float(retrieval_reciprocal_rank(p, t)), expected, atol=1e-6)
+            np.testing.assert_allclose(float(retrieval_reciprocal_rank(p, t)), expected, atol=oracle_atol())
 
     @pytest.mark.parametrize("k", [1, 3, None])
     def test_precision_recall_hit_fallout(self, k):
@@ -83,12 +84,12 @@ class TestFunctionalVsSklearn:
             order = np.argsort(-p, kind="stable")
             kk = k or len(p)
             topk = t[order][:kk]
-            np.testing.assert_allclose(float(retrieval_precision(p, t, k=k)), topk.sum() / kk, atol=1e-6)
-            np.testing.assert_allclose(float(retrieval_recall(p, t, k=k)), topk.sum() / t.sum(), atol=1e-6)
-            np.testing.assert_allclose(float(retrieval_hit_rate(p, t, k=k)), float(topk.sum() > 0), atol=1e-6)
+            np.testing.assert_allclose(float(retrieval_precision(p, t, k=k)), topk.sum() / kk, atol=oracle_atol())
+            np.testing.assert_allclose(float(retrieval_recall(p, t, k=k)), topk.sum() / t.sum(), atol=oracle_atol())
+            np.testing.assert_allclose(float(retrieval_hit_rate(p, t, k=k)), float(topk.sum() > 0), atol=oracle_atol())
             neg_topk = (1 - t)[order][:kk]
             np.testing.assert_allclose(
-                float(retrieval_fall_out(p, t, k=k)), neg_topk.sum() / (1 - t).sum(), atol=1e-6
+                float(retrieval_fall_out(p, t, k=k)), neg_topk.sum() / (1 - t).sum(), atol=oracle_atol()
             )
 
     def test_r_precision(self):
@@ -97,7 +98,7 @@ class TestFunctionalVsSklearn:
             r = t.sum()
             order = np.argsort(-p, kind="stable")
             expected = t[order][:r].sum() / r
-            np.testing.assert_allclose(float(retrieval_r_precision(p, t)), expected, atol=1e-6)
+            np.testing.assert_allclose(float(retrieval_r_precision(p, t)), expected, atol=oracle_atol())
 
 
 class TestClassInterface:
