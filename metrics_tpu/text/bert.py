@@ -26,6 +26,12 @@ Array = jax.Array
 
 
 class BERTScore(Metric):
+    """BERTScore: greedy cosine matching of contextual embeddings (P/R/F1 per pair).
+
+    Parity: reference ``text/bert.py:40``. Encoder is pluggable (local HF Flax
+    checkpoint, flax module, or a user forward fn) — see ``functional.bert_score``.
+    """
+
     is_differentiable = False
     higher_is_better = True
 

@@ -1,0 +1,77 @@
+"""Regenerate docs/API.md: every public export with its first docstring line.
+
+Usage: python tools/gen_api_docs.py
+"""
+import inspect
+import os
+import sys
+import warnings
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+warnings.filterwarnings("ignore")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import metrics_tpu  # noqa: E402
+import metrics_tpu.functional as F  # noqa: E402
+
+ORDER = [
+    "metric", "collections", "aggregation", "classification", "regression",
+    "image", "audio", "text", "retrieval", "detection", "wrappers", "parallel", "utils",
+]
+TITLES = {
+    "metric": "Core runtime", "collections": "Collections", "aggregation": "Aggregation",
+    "classification": "Classification", "regression": "Regression", "image": "Image",
+    "audio": "Audio", "text": "Text", "retrieval": "Retrieval", "detection": "Detection",
+    "wrappers": "Wrappers", "parallel": "Parallel / distributed", "utils": "Utilities",
+}
+
+
+def first_line(obj) -> str:
+    # own docstring only — inspect.getdoc would inherit the base class's
+    doc = obj.__dict__.get("__doc__") if isinstance(obj, type) else getattr(obj, "__doc__", None)
+    line = inspect.cleandoc(doc).split("\n")[0].strip() if doc else ""
+    line = line.replace("|", "\\|")  # keep markdown table cells intact
+    if len(line) > 110:
+        line = line[:110].rsplit(" ", 1)[0] + " …"
+    return line
+
+
+def main() -> None:
+    lines = [
+        "# API inventory", "",
+        "*Every public export, with its first docstring line. Generated from the package*",
+        "*(`python tools/gen_api_docs.py` regenerates; `tests/test_docs_examples.py` keeps docs executable).*", "",
+    ]
+    groups = {}
+    for name in sorted(metrics_tpu.__all__):
+        obj = getattr(metrics_tpu, name, None)
+        if obj is None or name.startswith("__") or not (inspect.isclass(obj) or inspect.isfunction(obj) or callable(obj) and hasattr(obj, "__module__")):
+            continue
+        mod = getattr(obj, "__module__", "") or ""
+        parts = mod.split(".")
+        dom = parts[1] if mod.startswith("metrics_tpu.") and len(parts) > 1 else "core"
+        groups.setdefault(dom, []).append((name, first_line(obj)))
+
+    for dom in ORDER + sorted(set(groups) - set(ORDER)):
+        if dom not in groups:
+            continue
+        lines += [f"## {TITLES.get(dom, dom)}", "", "| export | summary |", "|---|---|"]
+        lines += [f"| `{name}` | {doc} |" for name, doc in groups[dom]]
+        lines.append("")
+
+    lines += ["## Functional API (`metrics_tpu.functional`)", "", "| function | summary |", "|---|---|"]
+    for name in sorted(getattr(F, "__all__", dir(F))):
+        obj = getattr(F, name, None)
+        if callable(obj):
+            lines.append(f"| `{name}` | {first_line(obj)} |")
+    lines.append("")
+
+    out = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "docs", "API.md")
+    with open(out, "w") as f:
+        f.write("\n".join(lines))
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()
