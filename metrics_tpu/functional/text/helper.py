@@ -111,6 +111,23 @@ def _edit_distance(prediction_tokens: List, reference_tokens: List) -> int:
     )
 
 
+def _edit_distance_ids(a_ids: "np.ndarray", b_ids: "np.ndarray") -> int:
+    """Edit distance on pre-mapped int32 id arrays — the zero-allocation hot
+    path for search loops (TER shift scoring) that evaluate many candidate
+    sequences against one reference."""
+    lib = _load_native()
+    if lib is None:
+        return _edit_distance_py(list(a_ids), list(b_ids))
+    return int(
+        lib.edit_distance_i32(
+            a_ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            len(a_ids),
+            b_ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            len(b_ids),
+        )
+    )
+
+
 def _edit_distance_batch(preds: Sequence[Sequence], refs: Sequence[Sequence]) -> np.ndarray:
     """Edit distances for a whole corpus in one native call."""
     lib = _load_native()

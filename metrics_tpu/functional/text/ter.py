@@ -5,6 +5,7 @@ normalisation + greedy shift search over the beam of possible block moves, each
 scored by Levenshtein distance — the distance kernel runs natively, see
 ``metrics_tpu/native/levenshtein.cpp``).
 """
+import math
 import re
 import unicodedata
 from typing import List, Optional, Sequence, Tuple, Union
@@ -12,7 +13,7 @@ from typing import List, Optional, Sequence, Tuple, Union
 import jax
 import jax.numpy as jnp
 
-from metrics_tpu.functional.text.helper import _canonicalize_corpora, _edit_distance, _resolve_corpus_aliases
+from metrics_tpu.functional.text.helper import _canonicalize_corpora, _edit_distance_ids, _resolve_corpus_aliases
 
 Array = jax.Array
 
@@ -89,57 +90,177 @@ def _preprocess_sentence(
     return sentence.split()
 
 
-def _find_shifted_sequences(words: List[str]) -> dict:
-    """All contiguous subsequences (up to _MAX_SHIFT_SIZE) -> start positions."""
-    seqs: dict = {}
-    for start in range(len(words)):
-        for length in range(1, min(_MAX_SHIFT_SIZE, len(words) - start) + 1):
-            seqs.setdefault(tuple(words[start:start + length]), []).append((start, length))
-    return seqs
+_MAX_SHIFT_CANDIDATES = 1000
+_BEAM_WIDTH = 25
 
 
-def _shift_words(words: List[str], start: int, length: int, dest: int) -> List[str]:
+def _align_hyp_to_ref(hyp: List[str], ref: List[str]):
+    """Beam-limited Levenshtein DP with an op trace, using tercom's
+    tie-preference (match/substitute, then consume-hypothesis, then
+    consume-reference). Returns ``(alignment, hyp_errors, ref_errors)`` where
+    ``alignment[ref_pos] = hyp_pos`` for every reference position (the position
+    a deleted reference word maps to is the last consumed hypothesis index) and
+    the error lists flag non-match positions. Tercom's shift destinations are
+    defined in terms of this alignment (reference ``ter.py:343-375`` /
+    ``helper.py:398-446``); the beam matches tercom's pruning for very long
+    sentences (``helper.py:131-137``)."""
+    H, R = len(hyp), len(ref)
+    INF = 1 << 30
+    # dp[i][j] = (cost, op): '=' match / 'S' substitute (both advance both),
+    # 'H' consume hypothesis word only, 'R' consume reference word only
+    dp = [[(INF, " ")] * (R + 1) for _ in range(H + 1)]
+    dp[0][0] = (0, " ")
+    for j in range(1, R + 1):
+        dp[0][j] = (j, "R")
+    ratio = R / H if H else 1.0
+    beam = math.ceil(ratio / 2 + _BEAM_WIDTH) if _BEAM_WIDTH < ratio / 2 else _BEAM_WIDTH
+    for i in range(1, H + 1):
+        diag = math.floor(i * ratio)
+        lo = max(0, diag - beam)
+        hi = R + 1 if i == H else min(R + 1, diag + beam)
+        for j in range(lo, hi):
+            if j == 0:
+                dp[i][0] = (dp[i - 1][0][0] + 1, "H")
+                continue
+            if hyp[i - 1] == ref[j - 1]:
+                best = (dp[i - 1][j - 1][0], "=")
+            else:
+                best = (dp[i - 1][j - 1][0] + 1, "S")
+            cand_h = dp[i - 1][j][0] + 1
+            if cand_h < best[0]:
+                best = (cand_h, "H")
+            cand_r = dp[i][j - 1][0] + 1
+            if cand_r < best[0]:
+                best = (cand_r, "R")
+            dp[i][j] = best
+    ops: List[str] = []
+    i, j = H, R
+    while i > 0 or j > 0:
+        op = dp[i][j][1]
+        ops.append(op)
+        if op in ("=", "S"):
+            i, j = i - 1, j - 1
+        elif op == "H":
+            i -= 1
+        else:
+            j -= 1
+    ops.reverse()
+    alignment = {}
+    hyp_errors: List[int] = []
+    ref_errors: List[int] = []
+    hp = rp = -1
+    for op in ops:
+        if op in ("=", "S"):
+            hp += 1
+            rp += 1
+            alignment[rp] = hp
+            err = 0 if op == "=" else 1
+            hyp_errors.append(err)
+            ref_errors.append(err)
+        elif op == "H":
+            hp += 1
+            hyp_errors.append(1)
+        else:  # R: reference word with no hypothesis counterpart
+            rp += 1
+            alignment[rp] = hp
+            ref_errors.append(1)
+    return alignment, hyp_errors, ref_errors
+
+
+def _apply_shift(words: List[str], start: int, length: int, target: int) -> List[str]:
+    """Move ``words[start:start+length]`` so it lands at ``target`` using
+    tercom's three placement cases (before / after / within the moved region —
+    reference ``ter.py:285-320``)."""
     block = words[start:start + length]
-    rest = words[:start] + words[start + length:]
-    # dest is the index in `rest` the block is inserted before
-    return rest[:dest] + block + rest[dest:]
+    if target < start:
+        return words[:target] + block + words[target:start] + words[start + length:]
+    if target > start + length:
+        return words[:start] + words[start + length:target] + block + words[target:]
+    return words[:start] + words[start + length:length + target] + block + words[length + target:]
 
 
 def _ter_sentence(pred_words: List[str], ref_words: List[str]) -> float:
-    """Shifts + edits for one hypothesis against one reference (greedy tercom)."""
+    """Shifts + edits for one hypothesis against one reference (tercom
+    semantics — reference ``ter.py:323-446``, itself following sacrebleu's
+    lib_ter). Candidate blocks are equal word spans (length 1..10, start
+    offset ≤ 50) where both sides contain an error and the block is not
+    already aligned in place; destinations come from the current alignment;
+    candidates rank by (edit gain, block length, earliest start, earliest
+    target); the search stops after 1000 candidates or when no shift helps."""
     if len(ref_words) == 0:
-        return float(len(pred_words))
+        return 0.0  # reference ``ter.py:419-420``: empty reference scores 0 edits
+
+    # map words to int ids once — the shift loop scores up to 1000 candidate
+    # sequences per round, so per-candidate token hashing would dominate
+    import numpy as np
+
+    vocab: dict = {}
+    current: List[int] = [vocab.setdefault(w, len(vocab)) for w in pred_words]
+    ref_words = [vocab.setdefault(w, len(vocab)) for w in ref_words]
+    ref_arr = np.asarray(ref_words, dtype=np.int32)
+
+    def _dist(words: List[int]) -> int:
+        return _edit_distance_ids(np.asarray(words, dtype=np.int32), ref_arr)
 
     num_shifts = 0
-    current = list(pred_words)
-    current_dist = _edit_distance(current, ref_words)
-    ref_seqs = _find_shifted_sequences(ref_words)
+    checked = 0
 
-    while current_dist > 0:
-        best_dist = current_dist
-        best_words: Optional[List[str]] = None
-        # try moving every (start, length) block of the hypothesis that also occurs
-        # in the reference to each occurrence position
-        for start in range(len(current)):
-            for length in range(1, min(_MAX_SHIFT_SIZE, len(current) - start) + 1):
-                block = tuple(current[start:start + length])
-                if block not in ref_seqs:
+    while True:
+        base_dist = _dist(current)
+        alignment, hyp_errors, ref_errors = _align_hyp_to_ref(current, ref_words)
+        best = None  # (gain, length, -hyp_start, -target, shifted_words)
+        stop = False
+        for hyp_start in range(len(current)):
+            if stop:
+                break
+            for ref_start in range(len(ref_words)):
+                if abs(ref_start - hyp_start) > _MAX_SHIFT_DIST:
                     continue
-                for dest, _ in ref_seqs[block]:
-                    if abs(dest - start) > _MAX_SHIFT_DIST:
-                        continue
-                    shifted = _shift_words(current, start, length, min(dest, len(current) - length))
-                    d = _edit_distance(shifted, ref_words)
-                    if d < best_dist:
-                        best_dist = d
-                        best_words = shifted
-        if best_words is None:
+                for length in range(1, _MAX_SHIFT_SIZE + 1):  # sacrebleu allows 10-word blocks
+                    if (hyp_start + length > len(current) or ref_start + length > len(ref_words)
+                            or current[hyp_start + length - 1] != ref_words[ref_start + length - 1]):
+                        break
+                    # corner cases (reference ``ter.py:245-283``): the block must
+                    # contain an error on both sides and not already sit where
+                    # the alignment puts it
+                    if (sum(hyp_errors[hyp_start:hyp_start + length]) != 0
+                            and sum(ref_errors[ref_start:ref_start + length]) != 0
+                            and not (hyp_start <= alignment[ref_start] < hyp_start + length)):
+                        prev_target = -1
+                        for offset in range(-1, length):
+                            if ref_start + offset == -1:
+                                target = 0
+                            elif ref_start + offset in alignment:
+                                target = alignment[ref_start + offset] + 1
+                            else:
+                                break  # past the end of the reference
+                            if target == prev_target:
+                                continue
+                            prev_target = target
+                            shifted = _apply_shift(current, hyp_start, length, target)
+                            candidate = (
+                                base_dist - _dist(shifted),  # biggest gain
+                                length,                                          # longest block
+                                -hyp_start,                                      # earliest start
+                                -target,                                         # earliest target
+                                shifted,
+                            )
+                            checked += 1
+                            if best is None or candidate > best:
+                                best = candidate
+                    if checked >= _MAX_SHIFT_CANDIDATES:
+                        stop = True
+                        break
+                    if hyp_start + length == len(current) or ref_start + length == len(ref_words):
+                        break
+                if stop:
+                    break
+        if best is None or checked >= _MAX_SHIFT_CANDIDATES or best[0] <= 0:
             break
         num_shifts += 1
-        current = best_words
-        current_dist = best_dist
+        current = best[4]
 
-    return float(num_shifts + current_dist)
+    return float(num_shifts + _dist(current))
 
 
 def _ter_update(

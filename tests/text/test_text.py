@@ -246,8 +246,9 @@ class TestTER:
     def test_empty_reference_set_scores_against_empty(self):
         from metrics_tpu.functional import chrf_score
 
-        # no references: zero matches, not a crash (TER zero-ref-length rule -> 1)
-        np.testing.assert_allclose(float(translation_edit_rate(["a b c"], [[]])), 1.0)
+        # no references: zero matches, not a crash. TER follows the reference's
+        # empty-reference rule (``ter.py:419-420``): zero edits, zero length -> 0
+        np.testing.assert_allclose(float(translation_edit_rate(["a b c"], [[]])), 0.0)
         assert float(chrf_score(["a b c"], [[]])) == 0.0
 
     def test_flat_refs_single_hypothesis_are_multi_reference(self):
@@ -255,6 +256,33 @@ class TestTER:
         # means several references for it
         multi = float(translation_edit_rate(["the cat sat"], ["the cat sat", "something else"]))
         np.testing.assert_allclose(multi, 0.0, atol=oracle_atol())
+
+    def test_vs_sacrebleu_ter_fuzz(self):
+        # randomized corpora: the shift search must be tercom-exact (alignment-
+        # guided destinations, corner-case filters, tercom candidate ranking)
+        import random
+
+        rng = random.Random(11)
+        vocab = ["the", "cat", "sat", "on", "a", "mat", "dog", "ran", "fast", "fox", "jumps", "over"]
+        for _ in range(40):
+            n = rng.randint(1, 3)
+            preds = [" ".join(rng.choices(vocab, k=rng.randint(1, 9))) for _ in range(n)]
+            refs = [" ".join(rng.choices(vocab, k=rng.randint(1, 9))) for _ in range(n)]
+            expected = TerOracle().corpus_score(preds, [refs]).score / 100
+            res = float(translation_edit_rate(preds, refs))
+            np.testing.assert_allclose(res, expected, atol=1e-4, err_msg=f"{preds} vs {refs}")
+        # long sentences exercise big-block shifts (up to the 10-word cap)
+        wide = [f"w{i}" for i in range(40)]
+        for _ in range(15):
+            preds = [" ".join(rng.choices(wide, k=rng.randint(5, 24)))]
+            refs = [" ".join(rng.choices(wide, k=rng.randint(5, 24)))]
+            expected = TerOracle().corpus_score(preds, [refs]).score / 100
+            res = float(translation_edit_rate(preds, refs))
+            np.testing.assert_allclose(res, expected, atol=1e-4, err_msg=f"{preds} vs {refs}")
+        # the canonical 10-word block move: one shift, not two
+        pred = " ".join([f"a{i}" for i in range(10)] + [f"b{i}" for i in range(10)])
+        ref = " ".join([f"b{i}" for i in range(10)] + [f"a{i}" for i in range(10)])
+        np.testing.assert_allclose(float(translation_edit_rate([pred], [ref])), 0.05, atol=1e-6)
 
     def test_shift_counted_once(self):
         # "b c a" -> "a b c" is one shift for TER (score 1/3), not two edits
