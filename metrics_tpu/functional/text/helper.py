@@ -1,9 +1,11 @@
 """Text helpers: edit distance (native C++ fast path) and n-gram counting.
 
 Parity: reference ``torchmetrics/functional/text/helper.py`` (_edit_distance; the
-446-LoC `_LevenshteinEditDistance` cache/trace machinery exists there to serve TER —
-here TER uses the same plain DP distance, and the hot corpus loop runs natively, see
-``metrics_tpu/native/levenshtein.cpp``).
+446-LoC `_LevenshteinEditDistance` cache/trace machinery exists there to serve TER).
+WER/CER/MER use the plain DP distance; TER scores shift candidates with the
+beam-limited tercom variant (``edit_distance_beam_i32`` — the distance sacrebleu
+actually uses, required for oracle parity). Both hot loops run natively, see
+``metrics_tpu/native/levenshtein.cpp``.
 """
 import ctypes
 import os
@@ -39,6 +41,14 @@ def _load_native() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_int32),
             ctypes.c_int64,
             ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int64,
+        ]
+        lib.edit_distance_beam_i32.restype = ctypes.c_int64
+        lib.edit_distance_beam_i32.argtypes = [
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int64,
             ctypes.c_int64,
         ]
         lib.edit_distance_batch_i32.restype = None
@@ -111,21 +121,50 @@ def _edit_distance(prediction_tokens: List, reference_tokens: List) -> int:
     )
 
 
-def _edit_distance_ids(a_ids: "np.ndarray", b_ids: "np.ndarray") -> int:
+def _edit_distance_ids(a_ids: "np.ndarray", b_ids: "np.ndarray", beam: Optional[int] = None) -> int:
     """Edit distance on pre-mapped int32 id arrays — the zero-allocation hot
     path for search loops (TER shift scoring) that evaluate many candidate
-    sequences against one reference."""
+    sequences against one reference. ``beam`` enables tercom's beam-limited
+    variant (pruned to the pseudo-diagonal; the distance sacrebleu actually
+    scores with — parity requires it, exactness doesn't)."""
     lib = _load_native()
     if lib is None:
-        return _edit_distance_py(list(a_ids), list(b_ids))
-    return int(
-        lib.edit_distance_i32(
-            a_ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-            len(a_ids),
-            b_ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-            len(b_ids),
-        )
-    )
+        if beam is None:
+            return _edit_distance_py(list(a_ids), list(b_ids))
+        return _edit_distance_beam_py(list(a_ids), list(b_ids), beam)
+    a = a_ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+    b = b_ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+    if beam is None:
+        return int(lib.edit_distance_i32(a, len(a_ids), b, len(b_ids)))
+    return int(lib.edit_distance_beam_i32(a, len(a_ids), b, len(b_ids), beam))
+
+
+def _edit_distance_beam_py(a: List, b: List, beam_width: int) -> int:
+    """Python fallback twin of the native beam-limited distance."""
+    import math
+
+    n, m = len(a), len(b)
+    if n == 0:
+        return m
+    if m == 0:
+        return n
+    ratio = m / n
+    beam = math.ceil(ratio / 2 + beam_width) if beam_width < ratio / 2 else beam_width
+    INF = 1 << 40
+    prev = list(range(m + 1))
+    for i in range(1, n + 1):
+        cur = [INF] * (m + 1)
+        diag = math.floor(i * ratio)
+        lo = max(0, diag - beam)
+        hi = m + 1 if i == n else min(m + 1, diag + beam)
+        ai = a[i - 1]
+        for j in range(lo, hi):
+            if j == 0:
+                cur[0] = prev[0] + 1
+                continue
+            cur[j] = min(prev[j - 1] + (ai != b[j - 1]), prev[j] + 1, cur[j - 1] + 1)
+        prev = cur
+    return prev[m]
 
 
 def _edit_distance_batch(preds: Sequence[Sequence], refs: Sequence[Sequence]) -> np.ndarray:

@@ -106,37 +106,43 @@ def _align_hyp_to_ref(hyp: List[str], ref: List[str]):
     sentences (``helper.py:131-137``)."""
     H, R = len(hyp), len(ref)
     INF = 1 << 30
-    # dp[i][j] = (cost, op): '=' match / 'S' substitute (both advance both),
-    # 'H' consume hypothesis word only, 'R' consume reference word only
-    dp = [[(INF, " ")] * (R + 1) for _ in range(H + 1)]
-    dp[0][0] = (0, " ")
-    for j in range(1, R + 1):
-        dp[0][j] = (j, "R")
+    # rolling cost rows + one op byte-row per i: the beam visits only a narrow
+    # band per row, so a full (H+1)x(R+1) tuple table would waste quadratic
+    # memory on exactly the long sentences the beam exists for.
+    # op codes: '=' match / 'S' substitute (both advance both), 'H' consume
+    # hypothesis word only, 'R' consume reference word only
+    prev = list(range(R + 1))
+    op_rows = [bytearray(b"R" * (R + 1))]
+    op_rows[0][0] = ord(" ")
     ratio = R / H if H else 1.0
     beam = math.ceil(ratio / 2 + _BEAM_WIDTH) if _BEAM_WIDTH < ratio / 2 else _BEAM_WIDTH
     for i in range(1, H + 1):
+        cur = [INF] * (R + 1)
+        ops_row = bytearray(b" " * (R + 1))
         diag = math.floor(i * ratio)
         lo = max(0, diag - beam)
         hi = R + 1 if i == H else min(R + 1, diag + beam)
         for j in range(lo, hi):
             if j == 0:
-                dp[i][0] = (dp[i - 1][0][0] + 1, "H")
+                cur[0] = prev[0] + 1
+                ops_row[0] = ord("H")
                 continue
             if hyp[i - 1] == ref[j - 1]:
-                best = (dp[i - 1][j - 1][0], "=")
+                cost, op = prev[j - 1], ord("=")
             else:
-                best = (dp[i - 1][j - 1][0] + 1, "S")
-            cand_h = dp[i - 1][j][0] + 1
-            if cand_h < best[0]:
-                best = (cand_h, "H")
-            cand_r = dp[i][j - 1][0] + 1
-            if cand_r < best[0]:
-                best = (cand_r, "R")
-            dp[i][j] = best
+                cost, op = prev[j - 1] + 1, ord("S")
+            if prev[j] + 1 < cost:
+                cost, op = prev[j] + 1, ord("H")
+            if cur[j - 1] + 1 < cost:
+                cost, op = cur[j - 1] + 1, ord("R")
+            cur[j] = cost
+            ops_row[j] = op
+        prev = cur
+        op_rows.append(ops_row)
     ops: List[str] = []
     i, j = H, R
     while i > 0 or j > 0:
-        op = dp[i][j][1]
+        op = chr(op_rows[i][j])
         ops.append(op)
         if op in ("=", "S"):
             i, j = i - 1, j - 1
@@ -200,7 +206,9 @@ def _ter_sentence(pred_words: List[str], ref_words: List[str]) -> float:
     ref_arr = np.asarray(ref_words, dtype=np.int32)
 
     def _dist(words: List[int]) -> int:
-        return _edit_distance_ids(np.asarray(words, dtype=np.int32), ref_arr)
+        # the beamed distance is what tercom/sacrebleu score with — parity
+        # over exactness (the beam binds only on far-offset degenerate pairs)
+        return _edit_distance_ids(np.asarray(words, dtype=np.int32), ref_arr, beam=_BEAM_WIDTH)
 
     num_shifts = 0
     checked = 0
@@ -260,7 +268,8 @@ def _ter_sentence(pred_words: List[str], ref_words: List[str]) -> float:
         num_shifts += 1
         current = best[4]
 
-    return float(num_shifts + _dist(current))
+    # every break path leaves `current` unchanged since base_dist was computed
+    return float(num_shifts + base_dist)
 
 
 def _ter_update(

@@ -9,6 +9,7 @@
 //
 // Built with: g++ -O3 -shared -fPIC levenshtein.cpp -o _levenshtein.so
 #include <algorithm>
+#include <cmath>
 #include <cstdint>
 #include <vector>
 
@@ -25,6 +26,44 @@ int64_t edit_distance_i32(const int32_t* a, int64_t n, const int32_t* b, int64_t
         cur[0] = i;
         const int32_t ai = a[i - 1];
         for (int64_t j = 1; j <= m; ++j) {
+            const int64_t sub = prev[j - 1] + (ai != b[j - 1]);
+            cur[j] = std::min(sub, std::min(prev[j], cur[j - 1]) + 1);
+        }
+        std::swap(prev, cur);
+    }
+    return prev[m];
+}
+
+// Beam-limited edit distance between hypothesis a[0..n) and reference b[0..m),
+// replicating tercom's pruning (sacrebleu lib_ter; reference helper.py:131-137):
+// row i only evaluates columns within `beam` of the pseudo-diagonal
+// floor(i * m/n), with the beam widened to ceil(ratio/2 + W) when the length
+// ratio m/n exceeds 2W. The last row is evaluated to the end. Cells outside
+// the beam stay at "infinity". NOTE: asymmetric (no operand swap) — the beam
+// is defined relative to the hypothesis axis, exactly as tercom does it.
+int64_t edit_distance_beam_i32(const int32_t* a, int64_t n, const int32_t* b, int64_t m,
+                               int64_t beam_width) {
+    if (n == 0) return m;
+    if (m == 0) return n;
+    const double ratio = static_cast<double>(m) / static_cast<double>(n);
+    int64_t beam = beam_width;
+    if (static_cast<double>(beam_width) < ratio / 2.0) {
+        beam = static_cast<int64_t>(std::ceil(ratio / 2.0 + beam_width));
+    }
+    const int64_t INF = INT64_C(1) << 40;
+    std::vector<int64_t> prev(m + 1, INF), cur(m + 1, INF);
+    for (int64_t j = 0; j <= m; ++j) prev[j] = j;
+    for (int64_t i = 1; i <= n; ++i) {
+        std::fill(cur.begin(), cur.end(), INF);
+        const int64_t diag = static_cast<int64_t>(std::floor(static_cast<double>(i) * ratio));
+        const int64_t lo = std::max(INT64_C(0), diag - beam);
+        const int64_t hi = (i == n) ? m + 1 : std::min(m + 1, diag + beam);
+        const int32_t ai = a[i - 1];
+        for (int64_t j = lo; j < hi; ++j) {
+            if (j == 0) {
+                cur[0] = prev[0] + 1;
+                continue;
+            }
             const int64_t sub = prev[j - 1] + (ai != b[j - 1]);
             cur[j] = std::min(sub, std::min(prev[j], cur[j - 1]) + 1);
         }

@@ -283,6 +283,12 @@ class TestTER:
         pred = " ".join([f"a{i}" for i in range(10)] + [f"b{i}" for i in range(10)])
         ref = " ".join([f"b{i}" for i in range(10)] + [f"a{i}" for i in range(10)])
         np.testing.assert_allclose(float(translation_edit_rate([pred], [ref])), 0.05, atol=1e-6)
+        # far-offset suffix match: the tercom BEAM binds here — sacrebleu scores
+        # with the beam-limited distance, and parity requires using it too
+        hyp = " ".join(f"u{i}" for i in range(31))
+        ref2 = " ".join([f"j{i}" for i in range(60)] + [f"u{i}" for i in range(31)])
+        expected = TerOracle().corpus_score([hyp], [[ref2]]).score / 100
+        np.testing.assert_allclose(float(translation_edit_rate([hyp], [ref2])), expected, atol=1e-4)
 
     def test_shift_counted_once(self):
         # "b c a" -> "a b c" is one shift for TER (score 1/3), not two edits
