@@ -11,6 +11,7 @@ from typing import Any, Callable, List, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 METRIC_EPS = 1e-6
 
@@ -76,6 +77,11 @@ def to_categorical(tensor: Array, argmax_dim: int = 1) -> Array:
     Parity: reference ``utilities/data.py:117-132``.
     """
     return jnp.argmax(tensor, axis=argmax_dim)
+
+
+# array leaf types accepted everywhere metric inputs flow: jax arrays and
+# host numpy arrays are interchangeable at every update() in the package
+ARRAY_TYPES = (jax.Array, np.ndarray)
 
 
 def apply_to_collection(

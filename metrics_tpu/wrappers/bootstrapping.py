@@ -20,7 +20,7 @@ import numpy as np
 from metrics_tpu.metric import Metric
 from metrics_tpu.parallel.collectives import in_mapped_context
 from metrics_tpu.parallel.mesh import current_metric_axis
-from metrics_tpu.utils.data import apply_to_collection
+from metrics_tpu.utils.data import ARRAY_TYPES, apply_to_collection
 
 Array = jax.Array
 
@@ -103,8 +103,8 @@ class BootStrapper(Metric):
         return self.sampling_strategy != "poisson" and super()._forward_jit_safe()
 
     def _batch_size(self, args, kwargs) -> int:
-        args_sizes = apply_to_collection(args, jax.Array, lambda x: x.shape[0])
-        kwargs_sizes = apply_to_collection(kwargs, jax.Array, lambda x: x.shape[0])
+        args_sizes = apply_to_collection(args, ARRAY_TYPES, lambda x: x.shape[0])
+        kwargs_sizes = apply_to_collection(kwargs, ARRAY_TYPES, lambda x: x.shape[0])
         if len(args_sizes) > 0:
             return args_sizes[0]
         if len(kwargs_sizes) > 0:
@@ -134,16 +134,16 @@ class BootStrapper(Metric):
             self.draw_count = self.draw_count + 1
             for idx in range(self.num_bootstraps):
                 sample_idx = jax.random.randint(jax.random.fold_in(key, idx), (size,), 0, size)
-                new_args = apply_to_collection(args, jax.Array, jnp.take, sample_idx, axis=0)
-                new_kwargs = apply_to_collection(kwargs, jax.Array, jnp.take, sample_idx, axis=0)
+                new_args = apply_to_collection(args, ARRAY_TYPES, jnp.take, sample_idx, axis=0)
+                new_kwargs = apply_to_collection(kwargs, ARRAY_TYPES, jnp.take, sample_idx, axis=0)
                 self.metrics[idx].update(*new_args, **new_kwargs)
             return
         for idx in range(self.num_bootstraps):
             sample_idx = _bootstrap_sampler(size, self.sampling_strategy, self._rng)
             if sample_idx.size == 0:
                 continue
-            new_args = apply_to_collection(args, jax.Array, jnp.take, sample_idx, axis=0)
-            new_kwargs = apply_to_collection(kwargs, jax.Array, jnp.take, sample_idx, axis=0)
+            new_args = apply_to_collection(args, ARRAY_TYPES, jnp.take, sample_idx, axis=0)
+            new_kwargs = apply_to_collection(kwargs, ARRAY_TYPES, jnp.take, sample_idx, axis=0)
             self.metrics[idx].update(*new_args, **new_kwargs)
 
     def compute(self) -> Dict[str, Array]:

@@ -8,9 +8,10 @@ from typing import Any, List, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from metrics_tpu.metric import Metric
-from metrics_tpu.utils.data import apply_to_collection
+from metrics_tpu.utils.data import ARRAY_TYPES, apply_to_collection
 
 Array = jax.Array
 
@@ -63,11 +64,14 @@ class MultioutputWrapper(Metric):
         shape — eager-only, like the reference's boolean indexing)."""
         args_kwargs_by_output = []
         for i in range(len(self.metrics)):
+            # numpy arrays are first-class inputs everywhere else in the
+            # package, so slice them here too (they would otherwise pass
+            # through unsliced and fail at the squeeze below)
             selected_args = apply_to_collection(
-                args, jax.Array, jnp.take, jnp.asarray([i]), axis=self.output_dim
+                args, ARRAY_TYPES, jnp.take, jnp.asarray([i]), axis=self.output_dim
             )
             selected_kwargs = apply_to_collection(
-                kwargs, jax.Array, jnp.take, jnp.asarray([i]), axis=self.output_dim
+                kwargs, ARRAY_TYPES, jnp.take, jnp.asarray([i]), axis=self.output_dim
             )
             if self.remove_nans:
                 tensors = list(selected_args) + list(selected_kwargs.values())

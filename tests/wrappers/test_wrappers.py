@@ -289,3 +289,19 @@ def test_bootstrapper_multinomial_forward_decorrelates_batches(devices):
     idx1 = np.searchsorted(np.sort(np.asarray(batch1)), np.sort(captured[0]))
     idx2 = np.searchsorted(np.sort(np.asarray(batch2)), np.sort(captured[-1]))
     assert not np.array_equal(idx1, idx2)
+
+
+def test_multioutput_accepts_numpy_inputs():
+    """numpy arrays are first-class inputs across the package; the wrapper's
+    per-output slicing must handle them (regression: they passed through
+    unsliced and crashed at the squeeze)."""
+    m = MultioutputWrapper(MeanSquaredError(), num_outputs=3)
+    rng = np.random.RandomState(0)
+    p = rng.randn(8, 3).astype(np.float32)
+    t = rng.randn(8, 3).astype(np.float32)
+    m.update(p, t)
+    np.testing.assert_allclose(np.asarray(m.compute()), ((p - t) ** 2).mean(0), atol=1e-6)
+    # BootStrapper shares the slicing path: numpy batches must resample, not crash
+    b = BootStrapper(MeanSquaredError(), num_bootstraps=4, seed=0)
+    b.update(rng.randn(16).astype(np.float32), rng.randn(16).astype(np.float32))
+    assert np.isfinite(float(np.asarray(b.compute()["mean"]).ravel()[0]))
