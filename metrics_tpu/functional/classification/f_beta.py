@@ -104,7 +104,7 @@ def fbeta(
     tp, fp, tn, fn = _stat_scores_update(
         preds, target, reduce=reduce, mdmc_reduce=mdmc_average, threshold=threshold,
         num_classes=num_classes, top_k=top_k, multiclass=multiclass,
-        ignore_index=None if average == AvgMethod.MICRO else ignore_index,
+        ignore_index=ignore_index,
     )
     return _fbeta_compute(tp, fp, tn, fn, beta, ignore_index, average, mdmc_average)
 

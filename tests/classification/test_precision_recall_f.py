@@ -240,3 +240,18 @@ class TestHamming(MetricTester):
             metric_functional=hamming_distance,
             sk_metric=_sk_hamming,
         )
+
+
+def test_micro_fbeta_respects_ignore_index():
+    """Regression: the micro path dropped ignore_index before the stat-scores
+    update, so the ignored class's tp/fp/fn still entered the micro sums
+    (reference forwards ignore_index unconditionally, f_beta.py:248-258)."""
+    from sklearn.metrics import f1_score as sk_f1
+
+    rng = np.random.RandomState(37)
+    probs = rng.rand(40, 4).astype(np.float32)
+    probs /= probs.sum(1, keepdims=True)
+    target = rng.randint(0, 4, 40)
+    res = float(f1(probs, target, average="micro", num_classes=4, ignore_index=0))
+    expected = sk_f1(target, probs.argmax(1), labels=[1, 2, 3], average="micro")
+    np.testing.assert_allclose(res, expected, atol=1e-6)
