@@ -194,7 +194,13 @@ def _ter_sentence(pred_words: List[str], ref_words: List[str]) -> float:
     candidates rank by (edit gain, block length, earliest start, earliest
     target); the search stops after 1000 candidates or when no shift helps."""
     if len(ref_words) == 0:
-        return 0.0  # reference ``ter.py:419-420``: empty reference scores 0 edits
+        # an empty reference costs one deletion per hypothesis word — the
+        # reference reaches the same number because its 0-edit shortcut
+        # (``ter.py:419-420``) keys on the empty HYPOTHESIS (its caller swaps
+        # arguments at ``ter.py:469``); sacrebleu agrees. Returning 0 here
+        # would let an empty string in a multi-reference group win best-of-min
+        # and silently score the pair perfect.
+        return float(len(pred_words))
 
     # map words to int ids once — the shift loop scores up to 1000 candidate
     # sequences per round, so per-candidate token hashing would dominate

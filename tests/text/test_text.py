@@ -246,10 +246,29 @@ class TestTER:
     def test_empty_reference_set_scores_against_empty(self):
         from metrics_tpu.functional import chrf_score
 
-        # no references: zero matches, not a crash. TER follows the reference's
-        # empty-reference rule (``ter.py:419-420``): zero edits, zero length -> 0
-        np.testing.assert_allclose(float(translation_edit_rate(["a b c"], [[]])), 0.0)
+        # no references: score against the empty string, not a crash. The empty
+        # reference costs len(hyp) deletions over zero reference length, which
+        # the zero-length rule (reference ``ter.py:488-495``) maps to TER 1.0.
+        # (The reference's 0-edit shortcut at ``ter.py:419-420`` concerns empty
+        # HYPOTHESES — its caller swaps arguments at ``ter.py:469``.)
+        np.testing.assert_allclose(float(translation_edit_rate(["a b c"], [[]])), 1.0)
+        # an empty hypothesis against no references is a perfect 0
+        np.testing.assert_allclose(float(translation_edit_rate([""], [[]])), 0.0)
         assert float(chrf_score(["a b c"], [[]])) == 0.0
+
+    def test_empty_reference_string_in_multi_reference_group(self):
+        # regression: an empty string among real references must NOT win the
+        # best-of-min with 0 edits — it costs len(hyp) deletions, so the real
+        # reference wins. Pinned against sacrebleu.
+        oracle = TerOracle()
+        preds = ["a b"]
+        expected = oracle.corpus_score(preds, [[""], ["a b x"]]).score / 100
+        res = float(translation_edit_rate(preds, [["", "a b x"]]))
+        np.testing.assert_allclose(res, expected, atol=1e-4)
+        np.testing.assert_allclose(res, 2.0 / 3.0, atol=1e-4)
+        # and a lone empty reference scores 1.0, as sacrebleu does
+        expected_lone = oracle.corpus_score(["a b"], [[""]]).score / 100
+        np.testing.assert_allclose(float(translation_edit_rate(["a b"], [""])), expected_lone, atol=1e-4)
 
     def test_flat_refs_single_hypothesis_are_multi_reference(self):
         # reference helper.py:_validate_inputs — a flat list with ONE hypothesis

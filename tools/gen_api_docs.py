@@ -1,6 +1,6 @@
 """Regenerate docs/API.md: every public export with its first docstring line.
 
-Usage: python tools/gen_api_docs.py
+Usage: python tools/gen_api_docs.py [--out PATH]
 """
 import inspect
 import os
@@ -68,6 +68,11 @@ def main() -> None:
     lines.append("")
 
     out = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "docs", "API.md")
+    if "--out" in sys.argv:
+        idx = sys.argv.index("--out")
+        if idx + 1 >= len(sys.argv):
+            sys.exit("usage: gen_api_docs.py [--out PATH]")
+        out = sys.argv[idx + 1]
     with open(out, "w") as f:
         f.write("\n".join(lines))
     print(f"wrote {out}")
