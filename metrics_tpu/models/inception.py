@@ -40,7 +40,11 @@ def _max_pool(x: Array, window: int, stride: int) -> Array:
 
 
 def _avg_pool_same(x: Array, window: int = 3) -> Array:
-    return nn.avg_pool(x, (window, window), (1, 1), padding="SAME")
+    # torch-fidelity's FID variant patches the branch poolings to
+    # avg_pool2d(..., count_include_pad=False): border windows divide by the
+    # number of REAL pixels, not the full window area. Without this every
+    # pooled border pixel deviates from the reference features.
+    return nn.avg_pool(x, (window, window), (1, 1), padding="SAME", count_include_pad=False)
 
 
 class InceptionA(nn.Module):
@@ -138,10 +142,16 @@ class InceptionV3(nn.Module):
 
     @nn.compact
     def __call__(self, x: Array) -> Dict[str, Array]:
+        # torch-fidelity normalisation is (x - 128) / 128 on the 0..255 scale
+        # (NOT the symmetric 2x/255 - 1): uint8 255 maps to 0.9921875. Floats
+        # are taken as [0, 1] and quantised by truncation — the same
+        # `(imgs * 255).byte()` rule torchmetrics applies before this graph —
+        # so both input kinds produce identical features.
         if x.dtype == jnp.uint8:
-            x = x.astype(jnp.float32) / 255.0
-        # torch-fidelity normalisation: map [0,1] -> [-1, 1]
-        x = 2 * x - 1
+            x = x.astype(jnp.float32)
+        else:
+            x = jnp.floor(x * 255.0)
+        x = (x - 128.0) / 128.0
 
         out: Dict[str, Array] = {}
         x = BasicConv2d(32, (3, 3), strides=(2, 2))(x)

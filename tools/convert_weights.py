@@ -104,8 +104,12 @@ def convert_conv_bn_model(
     param_leaves = [(("params",) + p, v) for p, v in _walk(template.get("params", {}))]
     stat_leaves = [(("batch_stats",) + p, v) for p, v in _walk(template.get("batch_stats", {}))]
 
+    # conv and linear kernels zip as SEPARATE ordered streams: the flax walk is
+    # name-sorted (Dense_0 sorts before InceptionA_0) while torch checkpoints
+    # put the fc last — rank disambiguates where position cannot
     slots = {
-        "kernel": [(p, v) for p, v in param_leaves if p[-1] == "kernel"],
+        "conv_kernel": [(p, v) for p, v in param_leaves if p[-1] == "kernel" and np.ndim(v) == 4],
+        "linear_kernel": [(p, v) for p, v in param_leaves if p[-1] == "kernel" and np.ndim(v) == 2],
         "scale": [(p, v) for p, v in param_leaves if p[-1] == "scale"],
         "bias": [(p, v) for p, v in param_leaves if p[-1] == "bias"],
         "mean": [(p, v) for p, v in stat_leaves if p[-1] == "mean"],
@@ -130,9 +134,9 @@ def convert_conv_bn_model(
         if name.endswith("num_batches_tracked"):
             continue
         if name.endswith(".weight") and value.ndim == 4:
-            take("kernel", name, torch_conv_kernel(value))
+            take("conv_kernel", name, torch_conv_kernel(value))
         elif name.endswith(".weight") and value.ndim == 2:
-            take("kernel", name, torch_linear_kernel(value))
+            take("linear_kernel", name, torch_linear_kernel(value))
         elif name.endswith(".weight") and value.ndim == 1:  # bn gamma
             take("scale", name, value)
         elif name.endswith(".bias"):
