@@ -1,0 +1,138 @@
+"""Device-native grouped retrieval compute.
+
+The reference computes retrieval metrics with a Python loop over query groups
+(``torchmetrics/retrieval/retrieval_metric.py:124-153``) — one host iteration
+and one device sync per query. Here the whole corpus is handled on device:
+
+  1. ONE stable lexsort puts every query's documents contiguous, best-first
+     (key: query index, then descending prediction);
+  2. per-query metrics are ``jax.ops.segment_*`` reductions over rank/cumsum
+     arrays — no data-dependent shapes, everything jit-compatible;
+  3. ONE device->host transfer returns the per-query values.
+
+SURVEY §7.2(7): ``get_group_indexes``' python dict loop becomes segment ops.
+At 10k queries this removes 10k round-trips over the TPU tunnel.
+"""
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+KINDS = ("map", "mrr", "precision", "recall", "r_precision", "hit_rate", "fall_out", "ndcg")
+
+
+@partial(jax.jit, static_argnames=("kind", "k"))
+def _segment_scores(
+    preds: Array, target: Array, indexes: Array, *, kind: str, k: Optional[int]
+) -> Tuple[Array, Array, Array]:
+    """Per-query scores for the whole corpus in one fused device computation.
+
+    Returns ``(values, empty, valid)``, each of shape ``(N,)`` (the static
+    query-capacity bound = number of documents): ``valid[q]`` flags segments
+    that exist, ``empty[q]`` flags degenerate queries (no positive target —
+    no NEGATIVE for fall-out), ``values[q]`` is the metric for valid,
+    non-degenerate queries.
+    """
+    n = preds.shape[0]
+    f32 = jnp.float32
+
+    order = jnp.lexsort((-preds, indexes))
+    t = target[order].astype(f32)
+    idx = indexes[order]
+
+    start = jnp.concatenate([jnp.ones((1,), bool), idx[1:] != idx[:-1]])
+    seg = jnp.cumsum(start) - 1  # dense 0-based query id, in sorted order
+    pos = jnp.arange(n)
+    seg_start = jax.lax.cummax(jnp.where(start, pos, 0))
+    rank = (pos - seg_start + 1).astype(f32)  # 1-based rank within the query
+
+    rel = (t > 0).astype(f32)
+    sum_seg = partial(jax.ops.segment_sum, segment_ids=seg, num_segments=n)
+    n_docs = sum_seg(jnp.ones_like(rel))
+    n_rel = sum_seg(rel)
+    valid = n_docs > 0
+    if kind == "fall_out":
+        empty = valid & (n_docs - n_rel == 0)
+    else:
+        empty = valid & (n_rel == 0)
+
+    # effective cutoff: explicit k, else the query's own document count
+    # (the reference's per-group ``preds.shape[-1]`` default)
+    kk = jnp.full((n,), float(k), f32) if k is not None else n_docs
+    in_k = rank <= kk[seg]
+
+    if kind in ("precision", "recall", "hit_rate"):
+        hits = sum_seg(rel * in_k)
+        if kind == "precision":
+            values = hits / jnp.maximum(kk, 1.0)
+        elif kind == "recall":
+            values = hits / jnp.maximum(n_rel, 1.0)
+        else:
+            values = (hits > 0).astype(f32)
+    elif kind == "fall_out":
+        neg = 1.0 - rel
+        values = sum_seg(neg * in_k) / jnp.maximum(sum_seg(neg), 1.0)
+    elif kind == "r_precision":
+        in_r = rank <= n_rel[seg]
+        values = sum_seg(rel * in_r) / jnp.maximum(n_rel, 1.0)
+    elif kind == "map":
+        # within-query cumulative relevant count: global cumsum minus the
+        # cumsum carried in from before this query's first document
+        c = jnp.cumsum(rel)
+        carried = c[seg_start] - rel[seg_start]
+        cum_rel = c - carried
+        values = sum_seg(jnp.where(rel > 0, cum_rel / rank, 0.0)) / jnp.maximum(n_rel, 1.0)
+    elif kind == "mrr":
+        first = jax.ops.segment_min(
+            jnp.where(rel > 0, rank, jnp.inf), seg, num_segments=n
+        )
+        values = jnp.where(jnp.isfinite(first), 1.0 / jnp.maximum(first, 1.0), 0.0)
+    elif kind == "ndcg":
+        from metrics_tpu.functional.retrieval.ndcg import log2_position_discounts
+
+        discount = log2_position_discounts(n)
+        dcg = sum_seg(jnp.where(in_k, t * discount[pos - seg_start], 0.0))
+        # ideal ordering: same segments, documents by descending relevance
+        order2 = jnp.lexsort((-target.astype(f32), indexes))
+        t2 = target[order2].astype(f32)
+        idcg = sum_seg(jnp.where(in_k, t2 * discount[pos - seg_start], 0.0))
+        values = jnp.where(idcg > 0, dcg / jnp.maximum(idcg, 1e-38), 0.0)
+    else:
+        raise ValueError(f"unknown retrieval kind: {kind}")
+
+    return values, empty, valid
+
+
+def segment_retrieval_mean(
+    preds: Array,
+    target: Array,
+    indexes: Array,
+    *,
+    kind: str,
+    k: Optional[int] = None,
+    empty_target_action: str = "neg",
+) -> Array:
+    """Mean-over-queries retrieval score, fully on device.
+
+    ``empty_target_action`` follows the reference: degenerate queries raise
+    (``error``), score 1 (``pos``), score 0 (``neg``), or drop out of the mean
+    (``skip``).
+    """
+    values, empty, valid = _segment_scores(preds, target, indexes, kind=kind, k=k)
+    if empty_target_action == "error":
+        if bool(jnp.any(empty)):
+            raise ValueError("`compute` method was provided with a query with no positive target.")
+        keep, fill = valid, 0.0
+    elif empty_target_action == "skip":
+        keep, fill = valid & ~empty, 0.0
+    elif empty_target_action == "pos":
+        keep, fill = valid, 1.0
+    else:  # "neg"
+        keep, fill = valid, 0.0
+    values = jnp.where(empty, fill, values)
+    count = jnp.sum(keep)
+    total = jnp.sum(jnp.where(keep, values, 0.0))
+    return jnp.where(count > 0, total / jnp.maximum(count, 1), 0.0)

@@ -13,12 +13,19 @@ from metrics_tpu.utils.checks import _check_retrieval_functional_inputs
 Array = jax.Array
 
 
+def log2_position_discounts(n: int) -> Array:
+    """``1 / log2(rank + 1)`` for 1-based ranks ``1..n``.
+
+    Position discounts are a static-shape constant: computing them in f64
+    numpy at trace time gives exactly-rounded values, where XLA's f32 log2
+    approximation costs ~1e-5 absolute in the final nDCG. Shared by the
+    per-query functional below and the fused segment engine (``_segment.py``).
+    """
+    return jnp.asarray(1.0 / np.log2(np.arange(n) + 2.0), dtype=jnp.float32)
+
+
 def _dcg(target: Array) -> Array:
-    # position discounts are a static-shape constant: computing them in f64
-    # numpy at trace time gives exactly-rounded values, where XLA's f32 log2
-    # approximation costs ~1e-5 absolute in the final nDCG
-    denom = jnp.asarray(np.log2(np.arange(target.shape[-1]) + 2.0), dtype=jnp.float32)
-    return jnp.sum(target / denom, axis=-1)
+    return jnp.sum(target * log2_position_discounts(target.shape[-1]), axis=-1)
 
 
 def retrieval_normalized_dcg(preds: Array, target: Array, k: Optional[int] = None) -> Array:

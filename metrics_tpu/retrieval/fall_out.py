@@ -35,5 +35,7 @@ class RetrievalFallOut(RetrievalMetric):
         # a query is degenerate when it has no negative targets
         return not float(jnp.sum(1 - mini_target))
 
+    _segment_kind = "fall_out"
+
     def _metric(self, preds: Array, target: Array) -> Array:
         return retrieval_fall_out(preds, target, k=self.k)

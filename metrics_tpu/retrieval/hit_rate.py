@@ -38,5 +38,7 @@ class RetrievalHitRate(RetrievalMetric):
             raise ValueError("`k` has to be a positive integer or None")
         self.k = k
 
+    _segment_kind = "hit_rate"
+
     def _metric(self, preds: Array, target: Array) -> Array:
         return retrieval_hit_rate(preds, target, k=self.k)

@@ -24,5 +24,7 @@ class RetrievalMAP(RetrievalMetric):
         0.7917
     """
 
+    _segment_kind = "map"
+
     def _metric(self, preds: Array, target: Array) -> Array:
         return retrieval_average_precision(preds, target)

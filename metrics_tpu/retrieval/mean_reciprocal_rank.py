@@ -24,5 +24,7 @@ class RetrievalMRR(RetrievalMetric):
         0.7500
     """
 
+    _segment_kind = "mrr"
+
     def _metric(self, preds: Array, target: Array) -> Array:
         return retrieval_reciprocal_rank(preds, target)

@@ -40,5 +40,7 @@ class RetrievalNormalizedDCG(RetrievalMetric):
         self.k = k
         self.allow_non_binary_target = True
 
+    _segment_kind = "ndcg"
+
     def _metric(self, preds: Array, target: Array) -> Array:
         return retrieval_normalized_dcg(preds, target, k=self.k)

@@ -13,5 +13,7 @@ Array = jax.Array
 class RetrievalRPrecision(RetrievalMetric):
     """R-precision averaged over queries."""
 
+    _segment_kind = "r_precision"
+
     def _metric(self, preds: Array, target: Array) -> Array:
         return retrieval_r_precision(preds, target)

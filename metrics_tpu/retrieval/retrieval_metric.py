@@ -4,8 +4,14 @@ Parity: reference ``torchmetrics/retrieval/retrieval_metric.py:27`` (states :107
 grouped compute :124-153, empty_target_action error/skip/pos/neg). Subclasses only
 override ``_metric``.
 
-TPU note: states are gathered cat-lists; per-query compute groups via a single sort
-of the query ids (``get_group_indexes``), each group's ``_metric`` is jnp on device.
+TPU note: the built-in subclasses compute DEVICE-NATIVE — one stable lexsort
+groups every query's documents, per-query metrics are ``jax.ops.segment_*``
+reductions, and a single scalar crosses back to the host
+(``functional/retrieval/_segment.py``; the reference loops Python over query
+groups with one device sync each, ``retrieval_metric.py:124-153``). Subclasses
+that override ``_metric`` with custom logic transparently fall back to the
+same per-group host loop the reference uses (``_compute_host``), which also
+serves as the tested oracle for the segment path.
 """
 from abc import ABC, abstractmethod
 from typing import Any, List, Optional
@@ -61,11 +67,46 @@ class RetrievalMetric(Metric, ABC):
     def _is_empty_query(self, mini_target: Array) -> bool:
         return not float(jnp.sum(mini_target))
 
+    # set on built-in subclasses to route compute through the fused
+    # sort+segment device path; None (or a user override of _metric /
+    # _is_empty_query) selects the reference-style host loop
+    _segment_kind: Optional[str] = None
+
+    def _segment_dispatch(self) -> Optional[str]:
+        """The segment-engine kind to use, or None for the host loop.
+
+        A subclass that overrides ``_metric`` (or ``_is_empty_query``) without
+        declaring its own ``_segment_kind`` must get the host loop — the class
+        that OWNS the override decides, not an inherited kind.
+        """
+        mro = type(self).__mro__
+        metric_owner = next(c for c in mro if "_metric" in c.__dict__)
+        kind = metric_owner.__dict__.get("_segment_kind")
+        if kind is None:
+            return None
+        empty_owner = next(c for c in mro if "_is_empty_query" in c.__dict__)
+        if empty_owner is not RetrievalMetric and "_segment_kind" not in empty_owner.__dict__:
+            return None
+        return kind
+
     def compute(self) -> Array:
         indexes = dim_zero_cat(self.indexes)
         preds = dim_zero_cat(self.preds)
         target = dim_zero_cat(self.target)
 
+        kind = self._segment_dispatch()
+        if kind is not None and indexes.shape[0] > 0:
+            from metrics_tpu.functional.retrieval._segment import segment_retrieval_mean
+
+            return segment_retrieval_mean(
+                preds, target, indexes,
+                kind=kind, k=getattr(self, "k", None),
+                empty_target_action=self.empty_target_action,
+            )
+        return self._compute_host(indexes, preds, target)
+
+    def _compute_host(self, indexes: Array, preds: Array, target: Array) -> Array:
+        """Reference-parity per-group host loop (oracle + custom-subclass path)."""
         res = []
         groups = get_group_indexes(indexes)
         for group in groups:

@@ -38,5 +38,7 @@ class RetrievalPrecision(RetrievalMetric):
             raise ValueError("`k` has to be a positive integer or None")
         self.k = k
 
+    _segment_kind = "precision"
+
     def _metric(self, preds: Array, target: Array) -> Array:
         return retrieval_precision(preds, target, k=self.k)

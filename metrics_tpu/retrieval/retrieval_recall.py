@@ -38,5 +38,7 @@ class RetrievalRecall(RetrievalMetric):
             raise ValueError("`k` has to be a positive integer or None")
         self.k = k
 
+    _segment_kind = "recall"
+
     def _metric(self, preds: Array, target: Array) -> Array:
         return retrieval_recall(preds, target, k=self.k)

@@ -1,0 +1,138 @@
+"""The fused sort+segment retrieval engine must agree with the reference-style
+per-group host loop (kept as ``RetrievalMetric._compute_host``) on every metric
+kind, uneven group sizes, shuffled/non-contiguous query ids, degenerate queries
+and all four ``empty_target_action`` modes."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu import (
+    RetrievalFallOut,
+    RetrievalHitRate,
+    RetrievalMAP,
+    RetrievalMRR,
+    RetrievalNormalizedDCG,
+    RetrievalPrecision,
+    RetrievalRecall,
+    RetrievalRPrecision,
+)
+from metrics_tpu.retrieval.retrieval_metric import RetrievalMetric
+from tests.helpers import seed_all
+
+seed_all(7)
+
+ALL_CLASSES = [
+    (RetrievalMAP, {}),
+    (RetrievalMRR, {}),
+    (RetrievalPrecision, {}),
+    (RetrievalPrecision, {"k": 3}),
+    (RetrievalRecall, {}),
+    (RetrievalRecall, {"k": 2}),
+    (RetrievalRPrecision, {}),
+    (RetrievalHitRate, {}),
+    (RetrievalHitRate, {"k": 1}),
+    (RetrievalFallOut, {}),
+    (RetrievalFallOut, {"k": 4}),
+    (RetrievalNormalizedDCG, {}),
+    (RetrievalNormalizedDCG, {"k": 5}),
+]
+
+
+def _random_corpus(rng, n_queries, with_empty=False, graded=False, shuffle=True):
+    """Uneven groups, non-contiguous ids, optionally degenerate queries."""
+    idx_pool = rng.choice(np.arange(0, 10 * n_queries), size=n_queries, replace=False)
+    indexes, preds, target = [], [], []
+    for q in range(n_queries):
+        n_docs = rng.randint(1, 12)
+        indexes += [idx_pool[q]] * n_docs
+        preds += list(rng.rand(n_docs))
+        if graded:
+            t = rng.randint(0, 4, n_docs)
+        else:
+            t = rng.randint(0, 2, n_docs)
+        if with_empty and q % 3 == 0:
+            t[:] = 0  # no positives
+        if with_empty and q % 3 == 1:
+            t[:] = 1  # no negatives (degenerate for fall-out)
+        target += list(t)
+    indexes = np.asarray(indexes)
+    preds = np.asarray(preds, dtype=np.float32)
+    target = np.asarray(target)
+    if shuffle:
+        perm = rng.permutation(len(indexes))
+        indexes, preds, target = indexes[perm], preds[perm], target[perm]
+    return indexes, preds, target
+
+
+def _host_result(metric, indexes, preds, target):
+    return float(
+        metric._compute_host(jnp.asarray(indexes), jnp.asarray(preds), jnp.asarray(target))
+    )
+
+
+@pytest.mark.parametrize("cls,kwargs", ALL_CLASSES, ids=lambda v: getattr(v, "__name__", str(v)))
+def test_segment_matches_host_loop(cls, kwargs):
+    rng = np.random.RandomState(0)
+    for trial in range(3):
+        graded = cls is RetrievalNormalizedDCG
+        indexes, preds, target = _random_corpus(rng, n_queries=9, graded=graded)
+        m = cls(**kwargs)
+        m.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(indexes))
+        assert m._segment_dispatch() is not None
+        device = float(m.compute())
+        host = _host_result(m, indexes, preds, target)
+        np.testing.assert_allclose(device, host, atol=1e-5, err_msg=f"trial {trial}")
+
+
+@pytest.mark.parametrize("action", ["neg", "pos", "skip"])
+@pytest.mark.parametrize("cls,kwargs", [(RetrievalMAP, {}), (RetrievalFallOut, {}), (RetrievalNormalizedDCG, {})],
+                         ids=lambda v: getattr(v, "__name__", str(v)))
+def test_segment_empty_target_actions(cls, kwargs, action):
+    rng = np.random.RandomState(1)
+    graded = cls is RetrievalNormalizedDCG
+    indexes, preds, target = _random_corpus(rng, n_queries=9, with_empty=True, graded=graded)
+    m = cls(empty_target_action=action, **kwargs)
+    m.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(indexes))
+    device = float(m.compute())
+    host = _host_result(m, indexes, preds, target)
+    np.testing.assert_allclose(device, host, atol=1e-5)
+
+
+def test_segment_empty_action_error_raises():
+    m = RetrievalMAP(empty_target_action="error")
+    m.update(jnp.asarray([0.5, 0.4]), jnp.asarray([0, 0]), indexes=jnp.asarray([0, 0]))
+    with pytest.raises(ValueError, match="no positive target"):
+        m.compute()
+
+
+def test_custom_metric_subclass_falls_back_to_host_loop():
+    class Weird(RetrievalMAP):
+        def _metric(self, preds, target):  # custom logic: constant
+            return jnp.asarray(0.25)
+
+    m = Weird()
+    assert m._segment_dispatch() is None
+    m.update(jnp.asarray([0.5, 0.4]), jnp.asarray([1, 0]), indexes=jnp.asarray([0, 0]))
+    np.testing.assert_allclose(float(m.compute()), 0.25)
+
+
+def test_custom_empty_query_subclass_falls_back():
+    class WeirdEmpty(RetrievalMAP):
+        def _is_empty_query(self, mini_target):
+            return False
+
+    assert WeirdEmpty()._segment_dispatch() is None
+
+
+def test_single_query_and_singleton_docs():
+    # 1 query of 1 doc, and many 1-doc queries
+    m = RetrievalMAP()
+    m.update(jnp.asarray([0.9]), jnp.asarray([1]), indexes=jnp.asarray([5]))
+    np.testing.assert_allclose(float(m.compute()), 1.0)
+    m2 = RetrievalMRR()
+    m2.update(
+        jnp.asarray([0.9, 0.1, 0.5]), jnp.asarray([1, 0, 1]), indexes=jnp.asarray([3, 1, 2])
+    )
+    host = _host_result(m2, np.array([3, 1, 2]), np.array([0.9, 0.1, 0.5], np.float32), np.array([1, 0, 1]))
+    np.testing.assert_allclose(float(m2.compute()), host, atol=1e-6)
