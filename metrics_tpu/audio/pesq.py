@@ -8,7 +8,6 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from metrics_tpu.metric import Metric
 from metrics_tpu.utils.imports import _PESQ_AVAILABLE
@@ -38,19 +37,11 @@ class PESQ(Metric):
         self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
-        import pesq as pesq_backend
+        from metrics_tpu.functional.audio.pesq import pesq as pesq_fn
 
-        preds_np = np.asarray(preds)
-        target_np = np.asarray(target)
-        if preds_np.ndim == 1:
-            score = pesq_backend.pesq(self.fs, target_np, preds_np, self.mode)
-            self.sum_pesq = self.sum_pesq + score
-            self.total = self.total + 1
-        else:
-            for p, t in zip(preds_np.reshape(-1, preds_np.shape[-1]), target_np.reshape(-1, target_np.shape[-1])):
-                score = pesq_backend.pesq(self.fs, t, p, self.mode)
-                self.sum_pesq = self.sum_pesq + score
-                self.total = self.total + 1
+        scores = pesq_fn(preds, target, self.fs, self.mode)
+        self.sum_pesq = self.sum_pesq + jnp.sum(scores)
+        self.total = self.total + scores.size
 
     def compute(self) -> Array:
         return self.sum_pesq / self.total

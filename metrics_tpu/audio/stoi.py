@@ -6,7 +6,6 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from metrics_tpu.metric import Metric
 from metrics_tpu.utils.imports import _PYSTOI_AVAILABLE
@@ -32,17 +31,11 @@ class STOI(Metric):
         self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
-        from pystoi import stoi as stoi_backend
+        from metrics_tpu.functional.audio.stoi import stoi as stoi_fn
 
-        preds_np = np.asarray(preds)
-        target_np = np.asarray(target)
-        if preds_np.ndim == 1:
-            preds_np = preds_np[None]
-            target_np = target_np[None]
-        for p, t in zip(preds_np.reshape(-1, preds_np.shape[-1]), target_np.reshape(-1, target_np.shape[-1])):
-            score = stoi_backend(t, p, self.fs, extended=self.extended)
-            self.sum_stoi = self.sum_stoi + score
-            self.total = self.total + 1
+        scores = stoi_fn(preds, target, self.fs, extended=self.extended)
+        self.sum_stoi = self.sum_stoi + jnp.sum(scores)
+        self.total = self.total + scores.size
 
     def compute(self) -> Array:
         return self.sum_stoi / self.total

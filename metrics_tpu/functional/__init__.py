@@ -21,6 +21,7 @@ from metrics_tpu.functional.classification.precision_recall import precision, pr
 from metrics_tpu.functional.classification.precision_recall_curve import precision_recall_curve
 from metrics_tpu.functional.classification.roc import roc
 from metrics_tpu.functional.classification.specificity import specificity
+from metrics_tpu.functional.audio.pesq import pesq
 from metrics_tpu.functional.audio.pit import pit, pit_permutate
 from metrics_tpu.functional.audio.sdr import (
     scale_invariant_signal_distortion_ratio,
@@ -34,6 +35,7 @@ from metrics_tpu.functional.audio.snr import (
     signal_noise_ratio,
     snr,
 )
+from metrics_tpu.functional.audio.stoi import stoi
 from metrics_tpu.functional.classification.stat_scores import stat_scores
 from metrics_tpu.functional.image.gradients import image_gradients
 from metrics_tpu.functional.image.ms_ssim import multiscale_structural_similarity_index_measure
@@ -92,6 +94,7 @@ __all__ = [
     "rouge_score",
     "sacre_bleu_score",
     "squad",
+    "stoi",
     "translation_edit_rate",
     "wer",
     "word_error_rate",
@@ -107,6 +110,7 @@ __all__ = [
     "pairwise_linear_similarity",
     "pairwise_manhatten_distance",
     "pearson_corrcoef",
+    "pesq",
     "pit",
     "pit_permutate",
     "r2_score",

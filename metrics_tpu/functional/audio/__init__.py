@@ -1,3 +1,4 @@
+from metrics_tpu.functional.audio.pesq import pesq
 from metrics_tpu.functional.audio.pit import pit, pit_permutate
 from metrics_tpu.functional.audio.sdr import (
     scale_invariant_signal_distortion_ratio,
@@ -11,3 +12,4 @@ from metrics_tpu.functional.audio.snr import (
     signal_noise_ratio,
     snr,
 )
+from metrics_tpu.functional.audio.stoi import stoi

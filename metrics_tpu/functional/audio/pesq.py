@@ -1,0 +1,52 @@
+"""Stateless functional PESQ.
+
+Parity: reference ``torchmetrics/functional/audio/pesq.py:28`` — the ITU P.862
+DSP runs in the native ``pesq`` package on the host (it is a standardized C
+implementation, same as the reference uses); only the resulting scores live on
+device. Input ``[..., time]`` -> scores of shape ``[...]``.
+"""
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.imports import _PESQ_AVAILABLE
+
+Array = jax.Array
+
+
+def pesq(preds: Any, target: Any, fs: int, mode: str, keep_same_device: bool = False) -> Array:
+    """Perceptual evaluation of speech quality.
+
+    Args:
+        preds: estimated signal, shape ``[..., time]``.
+        target: reference signal, shape ``[..., time]``.
+        fs: sampling frequency (8000 or 16000 Hz).
+        mode: ``'wb'`` (wide-band) or ``'nb'`` (narrow-band).
+        keep_same_device: accepted for reference API compatibility; scores are
+            returned as device arrays either way.
+    """
+    if not _PESQ_AVAILABLE:
+        raise ModuleNotFoundError(
+            "PESQ metric requires that pesq is installed. Either install as `pip install pesq`."
+        )
+    import pesq as pesq_backend
+
+    if fs not in (8000, 16000):
+        raise ValueError(f"Expected argument `fs` to either be 8000 or 16000 but got {fs}")
+    if mode not in ("wb", "nb"):
+        raise ValueError(f"Expected argument `mode` to either be 'wb' or 'nb' but got {mode}")
+    preds_np = np.asarray(preds)
+    target_np = np.asarray(target)
+    _check_same_shape(preds_np, target_np)
+
+    if preds_np.ndim == 1:
+        return jnp.asarray(pesq_backend.pesq(fs, target_np, preds_np, mode), dtype=jnp.float32)
+    flat_p = preds_np.reshape(-1, preds_np.shape[-1])
+    flat_t = target_np.reshape(-1, target_np.shape[-1])
+    scores = np.empty(flat_p.shape[0], dtype=np.float32)
+    for b in range(flat_p.shape[0]):
+        scores[b] = pesq_backend.pesq(fs, flat_t[b], flat_p[b], mode)
+    return jnp.asarray(scores.reshape(preds_np.shape[:-1]))

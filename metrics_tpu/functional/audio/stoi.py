@@ -1,0 +1,47 @@
+"""Stateless functional STOI.
+
+Parity: reference ``torchmetrics/functional/audio/stoi.py:28`` — the DSP runs
+in the native ``pystoi`` package on the host (same backend the reference
+wraps); scores return as device arrays. Input ``[..., time]`` -> ``[...]``.
+"""
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.imports import _PYSTOI_AVAILABLE
+
+Array = jax.Array
+
+
+def stoi(preds: Any, target: Any, fs: int, extended: bool = False, keep_same_device: bool = False) -> Array:
+    """Short-time objective intelligibility.
+
+    Args:
+        preds: estimated signal, shape ``[..., time]``.
+        target: reference signal, shape ``[..., time]``.
+        fs: sampling frequency in Hz.
+        extended: use the extended (ESTOI) variant.
+        keep_same_device: accepted for reference API compatibility; scores are
+            returned as device arrays either way.
+    """
+    if not _PYSTOI_AVAILABLE:
+        raise ModuleNotFoundError(
+            "STOI metric requires that pystoi is installed. Either install as `pip install pystoi`."
+        )
+    from pystoi import stoi as stoi_backend
+
+    preds_np = np.asarray(preds)
+    target_np = np.asarray(target)
+    _check_same_shape(preds_np, target_np)
+
+    if preds_np.ndim == 1:
+        return jnp.asarray(stoi_backend(target_np, preds_np, fs, extended=extended), dtype=jnp.float32)
+    flat_p = preds_np.reshape(-1, preds_np.shape[-1])
+    flat_t = target_np.reshape(-1, target_np.shape[-1])
+    scores = np.empty(flat_p.shape[0], dtype=np.float32)
+    for b in range(flat_p.shape[0]):
+        scores[b] = stoi_backend(flat_t[b], flat_p[b], fs, extended=extended)
+    return jnp.asarray(scores.reshape(preds_np.shape[:-1]))

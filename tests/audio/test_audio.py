@@ -192,12 +192,58 @@ class TestPIT(MetricTester):
 
 
 def test_pesq_stoi_gated():
+    """Without the native backends, modules AND functional twins raise cleanly."""
     from metrics_tpu.audio import PESQ, STOI
+    from metrics_tpu.functional import pesq as pesq_fn
+    from metrics_tpu.functional import stoi as stoi_fn
     from metrics_tpu.utils.imports import _PESQ_AVAILABLE, _PYSTOI_AVAILABLE
 
+    sig = np.random.RandomState(0).randn(8000).astype(np.float32)
     if not _PESQ_AVAILABLE:
         with pytest.raises(ModuleNotFoundError):
             PESQ(fs=16000, mode="wb")
+        with pytest.raises(ModuleNotFoundError):
+            pesq_fn(sig, sig, 8000, "nb")
     if not _PYSTOI_AVAILABLE:
         with pytest.raises(ModuleNotFoundError):
             STOI(fs=16000)
+        with pytest.raises(ModuleNotFoundError):
+            stoi_fn(sig, sig, 16000)
+
+
+def _available(flag_name):
+    import metrics_tpu.utils.imports as imports
+
+    return getattr(imports, flag_name)
+
+
+@pytest.mark.skipif(not _available("_PESQ_AVAILABLE"), reason="pesq backend not installed")
+def test_pesq_functional_matches_module():
+    from metrics_tpu.audio import PESQ
+    from metrics_tpu.functional import pesq as pesq_fn
+
+    batch = np.random.RandomState(1).randn(3, 8000).astype(np.float32)
+    ref = np.random.RandomState(2).randn(3, 8000).astype(np.float32)
+    scores = pesq_fn(batch, ref, 8000, "nb")
+    assert scores.shape == (3,)
+    m = PESQ(fs=8000, mode="nb")
+    m.update(batch, ref)
+    np.testing.assert_allclose(float(m.compute()), float(np.mean(np.asarray(scores))), atol=1e-6)
+    with pytest.raises(ValueError, match="fs"):
+        pesq_fn(batch, ref, 44100, "wb")
+    with pytest.raises(ValueError, match="mode"):
+        pesq_fn(batch, ref, 8000, "xx")
+
+
+@pytest.mark.skipif(not _available("_PYSTOI_AVAILABLE"), reason="pystoi backend not installed")
+def test_stoi_functional_matches_module():
+    from metrics_tpu.audio import STOI
+    from metrics_tpu.functional import stoi as stoi_fn
+
+    batch = np.random.RandomState(1).randn(3, 8000).astype(np.float32)
+    ref = np.random.RandomState(2).randn(3, 8000).astype(np.float32)
+    scores = stoi_fn(batch, ref, 8000)
+    assert scores.shape == (3,)
+    m = STOI(fs=8000)
+    m.update(batch, ref)
+    np.testing.assert_allclose(float(m.compute()), float(np.mean(np.asarray(scores))), atol=1e-6)
