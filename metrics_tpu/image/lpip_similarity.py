@@ -1,11 +1,14 @@
 """LPIPS — learned perceptual image patch similarity.
 
 Parity: reference ``torchmetrics/image/lpip_similarity.py:41`` (wraps the ``lpips``
-package's pretrained AlexNet/VGG nets :30). No pretrained perceptual net is shippable
-in this zero-egress build, so the metric takes a pluggable ``net`` callable:
-``net(imgs) -> list of (N, Hi, Wi, Ci) feature maps`` (e.g. a Flax VGG with converted
-LPIPS weights). The LPIPS math on top — per-layer unit-normalisation, squared
-difference, spatial mean, layer sum — is implemented here and is the on-device part.
+package's pretrained AlexNet/VGG nets :30). The backbone lives in
+``metrics_tpu/models/perceptual.py`` as Flax VGG16/AlexNet graphs mirroring the
+``lpips`` package's slicing (scaling layer, five relu taps, learned per-channel
+linear weights); pretrained weights arrive offline via
+``python tools/convert_weights.py lpips`` (this build has no egress). The LPIPS
+math on top — per-layer unit-normalisation, squared difference, linear
+weighting, spatial mean, layer sum — runs fully on device. A raw ``net``
+callable remains pluggable for custom feature stacks.
 """
 from typing import Any, Callable, List, Optional
 
@@ -35,7 +38,25 @@ def _lpips_from_features(feats_a: List[Array], feats_b: List[Array], weights: Op
 
 
 class LPIPS(Metric):
-    """Learned perceptual image patch similarity over a pluggable feature net."""
+    """Learned perceptual image patch similarity (built-in VGG16/AlexNet backbones).
+
+    Args:
+        net: optional custom callable ``imgs -> list of (N, Hi, Wi, Ci) feature
+            maps``; overrides the built-in backbones.
+        net_type: ``'vgg'`` or ``'alex'`` selects the built-in Flax backbone
+            (``'squeeze'`` needs a custom ``net``).
+        reduction: ``'mean'`` or ``'sum'`` over the batch.
+        weights: optional per-layer channel weight vectors (the learned LPIPS
+            linear heads); defaults to the converted checkpoint's.
+        params: converted checkpoint for the built-in backbone — a path or the
+            loaded payload from ``python tools/convert_weights.py lpips``.
+
+    Example::
+
+        # offline, with the lpips package: torch.save(lpips.LPIPS(net="vgg").state_dict(), "l.pth")
+        # python tools/convert_weights.py lpips l.pth lpips_vgg.pkl --net-type vgg
+        metric = LPIPS(net_type="vgg", params="lpips_vgg.pkl")
+    """
 
     is_differentiable = True
     higher_is_better = False
@@ -46,18 +67,26 @@ class LPIPS(Metric):
         net_type: str = "alex",
         reduction: str = "mean",
         weights: Optional[List[Array]] = None,
+        params: Optional[Any] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
         valid_net_type = ("vgg", "alex", "squeeze")
         if net is None and net_type not in valid_net_type:
             raise ValueError(f"Argument `net_type` must be one of {valid_net_type}, but got {net_type}.")
+        self._builtin_net = net is None
         if net is None:
-            raise ModuleNotFoundError(
-                "LPIPS requires a pretrained perceptual network. This build has no network egress;"
-                " pass `net=` a callable mapping images (N,H,W,C) to a list of feature maps"
-                " (e.g. a Flax VGG16 with converted LPIPS weights)."
-            )
+            if net_type == "squeeze":
+                raise ModuleNotFoundError(
+                    "The built-in LPIPS backbones are 'vgg' and 'alex'; for 'squeeze' pass"
+                    " `net=` a callable mapping images (N,H,W,C) to a list of feature maps."
+                )
+            from metrics_tpu.models.perceptual import LPIPSFeatureNet
+
+            feature_net = LPIPSFeatureNet(net_type=net_type, params=params)
+            net = feature_net
+            if weights is None:
+                weights = feature_net.weights
         self.net = net
         self.weights = weights
         valid_reduction = ("mean", "sum")
@@ -68,7 +97,39 @@ class LPIPS(Metric):
         self.add_state("sum_scores", jnp.asarray(0.0), dist_reduce_fx="sum")
         self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
 
+    @staticmethod
+    def _validate_imgs(img1: Array, img2: Array) -> None:
+        """Reference contract (``lpip_similarity.py:36-38,140-146``): 4-d image
+        batches with a 3-wide channel axis, values in [-1, 1]. Shape checks run
+        always; the value check is eager-only (skipped under trace, matching the
+        input layer's convention) and costs one fused device fetch."""
+        from metrics_tpu.utils.checks import _is_tracer
+
+        for name, img in (("img1", img1), ("img2", img2)):
+            shape = jnp.shape(img)
+            if len(shape) != 4 or (shape[1] != 3 and shape[-1] != 3):
+                raise ValueError(
+                    f"Expected `{name}` to be a 4-d batch with a 3-channel axis, got shape {shape}"
+                )
+        if not (_is_tracer(img1) or _is_tracer(img2)):
+            import numpy as np
+
+            bounds = np.asarray(
+                jnp.stack([jnp.min(img1), jnp.max(img1), jnp.min(img2), jnp.max(img2)])
+            )
+            lo1, hi1, lo2, hi2 = (float(v) for v in bounds)
+            if lo1 < -1.0 or hi1 > 1.0 or lo2 < -1.0 or hi2 > 1.0:
+                raise ValueError(
+                    "Expected both input arguments to be normalized tensors (all values in"
+                    f" range [-1,1]), but `img1` spans [{lo1}, {hi1}] and `img2` spans"
+                    f" [{lo2}, {hi2}]"
+                )
+
     def update(self, img1: Array, img2: Array) -> None:
+        if self._builtin_net:
+            # the [-1, 1] 3-channel contract belongs to the built-in
+            # scaling-layer backbones; custom nets keep their own conventions
+            self._validate_imgs(img1, img2)
         feats_a = self.net(img1)
         feats_b = self.net(img2)
         loss = _lpips_from_features(feats_a, feats_b, self.weights)

@@ -1,0 +1,135 @@
+"""Perceptual feature backbones for LPIPS — Flax VGG16 and AlexNet.
+
+Parity target: the ``lpips`` package nets the reference embeds
+(``torchmetrics/image/lpip_similarity.py:30-41,123`` — ``lpips.LPIPS(net=...)``
+wraps torchvision VGG16/AlexNet feature stacks sliced at the standard
+perceptual taps, plus learned per-channel linear weights). This build has no
+egress, so weights arrive via ``tools/convert_weights.py lpips`` (offline
+conversion of a torch ``lpips.LPIPS`` state dict); the graphs here mirror the
+torch definitions exactly and are parity-tested tap-by-tap in
+``tests/tools/test_lpips_graph_parity.py``.
+
+TPU notes: NHWC layout, plain conv/relu/maxpool stacks — XLA fuses these well;
+batch-dim sharding under the caller's mesh shards the whole forward.
+"""
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+# the lpips ScalingLayer constants: images in [-1, 1] are shifted/scaled
+# per-channel (RGB) before the backbone
+_LPIPS_SHIFT = (-0.030, -0.088, -0.188)
+_LPIPS_SCALE = (0.458, 0.448, 0.450)
+
+
+def _scale_input(x: Array) -> Array:
+    shift = jnp.asarray(_LPIPS_SHIFT, dtype=x.dtype)
+    scale = jnp.asarray(_LPIPS_SCALE, dtype=x.dtype)
+    return (x - shift) / scale
+
+
+class VGG16Features(nn.Module):
+    """VGG16 feature stack, returning the five LPIPS taps.
+
+    Taps: relu1_2 (64ch), relu2_2 (128), relu3_3 (256), relu4_3 (512),
+    relu5_3 (512) — the slices the ``lpips`` package cuts torchvision's
+    ``vgg16().features`` into.
+    """
+
+    @nn.compact
+    def __call__(self, x: Array) -> List[Array]:
+        x = _scale_input(x)
+        taps: List[Array] = []
+        # (convs per block, channels); tap after each block's last relu
+        for n_convs, ch in ((2, 64), (2, 128), (3, 256), (3, 512), (3, 512)):
+            if taps:  # pool between blocks, not before the first
+                x = nn.max_pool(x, (2, 2), (2, 2), padding="VALID")
+            for _ in range(n_convs):
+                x = nn.relu(nn.Conv(ch, (3, 3), padding="SAME")(x))
+            taps.append(x)
+        return taps
+
+
+class AlexNetFeatures(nn.Module):
+    """AlexNet feature stack, returning the five LPIPS taps (relu1..relu5)."""
+
+    @nn.compact
+    def __call__(self, x: Array) -> List[Array]:
+        x = _scale_input(x)
+        taps: List[Array] = []
+        x = nn.relu(nn.Conv(64, (11, 11), strides=(4, 4), padding=((2, 2), (2, 2)))(x))
+        taps.append(x)
+        x = nn.max_pool(x, (3, 3), (2, 2), padding="VALID")
+        x = nn.relu(nn.Conv(192, (5, 5), padding=((2, 2), (2, 2)))(x))
+        taps.append(x)
+        x = nn.max_pool(x, (3, 3), (2, 2), padding="VALID")
+        x = nn.relu(nn.Conv(384, (3, 3), padding=((1, 1), (1, 1)))(x))
+        taps.append(x)
+        x = nn.relu(nn.Conv(256, (3, 3), padding=((1, 1), (1, 1)))(x))
+        taps.append(x)
+        x = nn.relu(nn.Conv(256, (3, 3), padding=((1, 1), (1, 1)))(x))
+        taps.append(x)
+        return taps
+
+
+_BACKBONES: Dict[str, Any] = {"vgg": VGG16Features, "alex": AlexNetFeatures}
+
+
+class LPIPSFeatureNet:
+    """Jitted LPIPS backbone: ``imgs (N,H,W,3) or (N,3,H,W) -> list of taps``.
+
+    Carries the converted per-layer linear weights (``.weights``) alongside the
+    backbone params; ``metrics_tpu.image.LPIPS`` consumes both.
+    """
+
+    def __init__(
+        self,
+        net_type: str = "alex",
+        params: Optional[Any] = None,
+        seed: int = 0,
+        input_size: int = 64,
+    ) -> None:
+        from metrics_tpu.utils.prints import rank_zero_warn
+
+        if net_type not in _BACKBONES:
+            raise ValueError(f"Argument `net_type` must be one of {tuple(_BACKBONES)}, but got {net_type}.")
+        self.net_type = net_type
+        self.module = _BACKBONES[net_type]()
+        self.weights: Optional[List[Array]] = None
+        if isinstance(params, (str, bytes)):
+            params = self.load_params(params)
+        if isinstance(params, dict) and "variables" in params:
+            if params.get("net_type") not in (None, net_type):
+                raise ValueError(
+                    f"Converted LPIPS checkpoint is for net_type={params.get('net_type')!r},"
+                    f" but this net is {net_type!r}."
+                )
+            self.weights = [jnp.asarray(w) for w in params.get("weights", [])] or None
+            params = params["variables"]
+        if params is None:
+            rank_zero_warn(
+                "No pretrained LPIPS params provided (no network egress in this build);"
+                " using random initialisation. Convert the `lpips` package weights with"
+                " `python tools/convert_weights.py lpips ...` for meaningful values.",
+                UserWarning,
+            )
+            dummy = jnp.zeros((1, input_size, input_size, 3), dtype=jnp.float32)
+            params = self.module.init(jax.random.PRNGKey(seed), dummy)
+        self.params = params
+        self._forward = jax.jit(lambda p, x: self.module.apply(p, x))
+
+    @staticmethod
+    def load_params(path: Any) -> Any:
+        import pickle
+
+        with open(path, "rb") as f:
+            return pickle.load(f)
+
+    def __call__(self, imgs: Array) -> List[Array]:
+        if imgs.ndim == 4 and imgs.shape[1] == 3 and imgs.shape[-1] != 3:
+            imgs = jnp.transpose(imgs, (0, 2, 3, 1))  # NCHW -> NHWC
+        return self._forward(self.params, imgs)

@@ -1,0 +1,203 @@
+"""LPIPS end-to-end parity: flax VGG16/AlexNet backbones + LPIPS math vs an
+equivalent torch graph, weights shared through the real converter path.
+
+Mirrors the inception graph-parity pattern: the torch side reproduces what the
+``lpips`` package computes (torchvision feature stacks, scaling layer, unit
+normalisation, learned 1x1 linear heads, spatial average, layer sum — the net
+the reference metric embeds at ``torchmetrics/image/lpip_similarity.py:123``),
+with random weights saved in the lpips state-dict naming so
+``convert_weights.py lpips`` exercises its real parsing.
+"""
+import os
+import pickle
+import sys
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as TF
+from torch import nn as tnn
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..", "tools"))
+
+import jax.numpy as jnp
+
+from convert_weights import convert_lpips
+
+_SHIFT = torch.tensor([-0.030, -0.088, -0.188]).view(1, 3, 1, 1)
+_SCALE = torch.tensor([0.458, 0.448, 0.450]).view(1, 3, 1, 1)
+
+
+class TorchVggLpips(tnn.Module):
+    """VGG16 LPIPS: five relu taps + per-channel linear heads."""
+
+    CHANNELS = (64, 128, 256, 512, 512)
+
+    def __init__(self):
+        super().__init__()
+        convs = []
+        cin = 3
+        for n_convs, ch in ((2, 64), (2, 128), (3, 256), (3, 512), (3, 512)):
+            block = []
+            for _ in range(n_convs):
+                block.append(tnn.Conv2d(cin, ch, 3, padding=1))
+                cin = ch
+            convs.append(tnn.ModuleList(block))
+        self.blocks = tnn.ModuleList(convs)
+        self.lins = tnn.ModuleList([tnn.Conv2d(c, 1, 1, bias=False) for c in self.CHANNELS])
+
+    def taps(self, x):
+        x = (x - _SHIFT) / _SCALE
+        out = []
+        for i, block in enumerate(self.blocks):
+            if i:
+                x = TF.max_pool2d(x, 2, stride=2)
+            for conv in block:
+                x = torch.relu(conv(x))
+            out.append(x)
+        return out
+
+    def forward(self, a, b):
+        return _lpips_torch(self.taps(a), self.taps(b), self.lins)
+
+
+class TorchAlexLpips(tnn.Module):
+    CHANNELS = (64, 192, 384, 256, 256)
+
+    def __init__(self):
+        super().__init__()
+        self.c1 = tnn.Conv2d(3, 64, 11, stride=4, padding=2)
+        self.c2 = tnn.Conv2d(64, 192, 5, padding=2)
+        self.c3 = tnn.Conv2d(192, 384, 3, padding=1)
+        self.c4 = tnn.Conv2d(384, 256, 3, padding=1)
+        self.c5 = tnn.Conv2d(256, 256, 3, padding=1)
+        self.lins = tnn.ModuleList([tnn.Conv2d(c, 1, 1, bias=False) for c in self.CHANNELS])
+
+    def taps(self, x):
+        x = (x - _SHIFT) / _SCALE
+        t1 = torch.relu(self.c1(x))
+        t2 = torch.relu(self.c2(TF.max_pool2d(t1, 3, stride=2)))
+        t3 = torch.relu(self.c3(TF.max_pool2d(t2, 3, stride=2)))
+        t4 = torch.relu(self.c4(t3))
+        t5 = torch.relu(self.c5(t4))
+        return [t1, t2, t3, t4, t5]
+
+    def forward(self, a, b):
+        return _lpips_torch(self.taps(a), self.taps(b), self.lins)
+
+
+def _unit_normalize(t, eps=1e-10):
+    return t / (torch.sqrt(torch.sum(t ** 2, dim=1, keepdim=True)) + eps)
+
+
+def _lpips_torch(feats_a, feats_b, lins):
+    total = 0.0
+    for fa, fb, lin in zip(feats_a, feats_b, lins):
+        diff = (_unit_normalize(fa) - _unit_normalize(fb)) ** 2
+        total = total + lin(diff).mean(dim=(2, 3)).squeeze(1)
+    return total
+
+
+def _save_lpips_style_state(tmodel, path):
+    """Write the torch weights under the lpips package's state-dict names,
+    including the ScalingLayer buffers a real ``lpips.LPIPS`` state dict
+    carries (the converter must drop them)."""
+    state = {"scaling_layer.shift": _SHIFT.clone(), "scaling_layer.scale": _SCALE.clone()}
+    i = 0
+    if isinstance(tmodel, TorchVggLpips):
+        for block in tmodel.blocks:
+            for conv in block:
+                state[f"net.slice.conv{i}.weight"] = conv.weight.detach()
+                state[f"net.slice.conv{i}.bias"] = conv.bias.detach()
+                i += 1
+    else:
+        for conv in (tmodel.c1, tmodel.c2, tmodel.c3, tmodel.c4, tmodel.c5):
+            state[f"net.slice.conv{i}.weight"] = conv.weight.detach()
+            state[f"net.slice.conv{i}.bias"] = conv.bias.detach()
+            i += 1
+    for j, lin in enumerate(tmodel.lins):
+        state[f"lin{j}.model.1.weight"] = lin.weight.detach()
+    torch.save(state, path)
+
+
+@pytest.mark.parametrize("net_type,tcls", [("vgg", TorchVggLpips), ("alex", TorchAlexLpips)])
+def test_lpips_full_graph_parity(tmp_path, net_type, tcls):
+    from metrics_tpu.models.perceptual import LPIPSFeatureNet
+
+    torch.manual_seed(11)
+    tmodel = tcls().eval()
+    # non-negative lin weights, as lpips learns them
+    with torch.no_grad():
+        for lin in tmodel.lins:
+            lin.weight.abs_()
+    ckpt = tmp_path / f"lpips_{net_type}.pth"
+    _save_lpips_style_state(tmodel, ckpt)
+    out = tmp_path / f"lpips_{net_type}.pkl"
+    convert_lpips(str(ckpt), str(out), net_type=net_type)
+
+    net = LPIPSFeatureNet(net_type=net_type, params=str(out))
+    assert net.weights is not None and len(net.weights) == 5
+
+    size = 64 if net_type == "vgg" else 96  # alex needs >= 63 px through 3 pools
+    rng = np.random.RandomState(0)
+    a = (rng.rand(2, size, size, 3) * 2 - 1).astype(np.float32)
+    b = (rng.rand(2, size, size, 3) * 2 - 1).astype(np.float32)
+
+    # tap-by-tap feature parity
+    taps_flax = net(jnp.asarray(a))
+    with torch.no_grad():
+        taps_torch = tmodel.taps(torch.from_numpy(np.transpose(a, (0, 3, 1, 2))))
+    assert len(taps_flax) == 5
+    for i, (g, e) in enumerate(zip(taps_flax, taps_torch)):
+        e = np.transpose(e.numpy(), (0, 2, 3, 1))
+        tol = 1e-4 * max(1.0, float(np.abs(e).max()))
+        np.testing.assert_allclose(np.asarray(g), e, atol=tol, err_msg=f"tap {i}")
+
+    # end-to-end metric parity through the public LPIPS class
+    from metrics_tpu import LPIPS
+
+    m = LPIPS(net_type=net_type, params=str(out))
+    m.update(jnp.asarray(a), jnp.asarray(b))
+    got = float(m.compute())
+    with torch.no_grad():
+        expected = float(
+            tmodel(
+                torch.from_numpy(np.transpose(a, (0, 3, 1, 2))),
+                torch.from_numpy(np.transpose(b, (0, 3, 1, 2))),
+            ).mean()
+        )
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_lpips_net_type_checkpoint_mismatch(tmp_path):
+    from metrics_tpu.models.perceptual import LPIPSFeatureNet
+
+    torch.manual_seed(0)
+    tmodel = TorchAlexLpips().eval()
+    ckpt = tmp_path / "alex.pth"
+    _save_lpips_style_state(tmodel, ckpt)
+    out = tmp_path / "alex.pkl"
+    convert_lpips(str(ckpt), str(out), net_type="alex")
+    with pytest.raises(ValueError, match="net_type"):
+        LPIPSFeatureNet(net_type="vgg", params=str(out))
+
+
+def test_lpips_input_validation():
+    from metrics_tpu import LPIPS
+
+    m = LPIPS(net_type="alex")  # random init (warned), validation still applies
+    bad = jnp.ones((2, 96, 96, 3)) * 2.0  # out of [-1, 1]
+    with pytest.raises(ValueError, match="normalized"):
+        m.update(bad, bad)
+    with pytest.raises(ValueError, match="4-d"):
+        m.update(jnp.ones((96, 96, 3)), jnp.ones((96, 96, 3)))
+
+
+def test_lpips_custom_net_skips_builtin_validation():
+    """A pluggable net keeps its own input convention — no [-1,1] contract."""
+    from metrics_tpu import LPIPS
+
+    m = LPIPS(net=lambda imgs: [imgs / 255.0])
+    imgs = jnp.ones((2, 8, 8, 3)) * 200.0  # [0, 255] images, fine for this net
+    m.update(imgs, imgs * 0.5)
+    assert np.isfinite(float(m.compute()))

@@ -192,6 +192,60 @@ def convert_inception(torch_ckpt_path: str, out_path: str, num_classes: int = 10
     print(f"wrote {out_path}")
 
 
+# ---------------------------------------------------------------------- lpips entry
+
+def convert_lpips(torch_ckpt_path: str, out_path: str, net_type: str = "vgg") -> None:
+    """``lpips.LPIPS(net=...)`` full state dict -> flax backbone variables plus
+    per-layer linear weights.
+
+    Produce the input offline on any machine with the ``lpips`` package::
+
+        torch.save(lpips.LPIPS(net="vgg").state_dict(), "lpips_vgg.pth")
+
+    The state dict carries the torchvision backbone under ``net.slice*`` and the
+    learned per-channel 1x1 convs under ``lin*``/``lins.*``; the backbone convs
+    zip order-based like the inception path, the lin weights are stored as five
+    ``(C,)`` vectors (they multiply the normalized squared feature difference).
+    """
+    import torch
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu.models.perceptual import _BACKBONES
+
+    state = torch.load(torch_ckpt_path, map_location="cpu", weights_only=True)
+    if hasattr(state, "state_dict"):
+        state = state.state_dict()
+    state_np = {k: v.numpy() for k, v in state.items()}
+
+    # split out the linear heads: lpips names them `lin0.model.1.weight` ..
+    # (or `lins.0...` in some versions); everything else is the backbone
+    # the ScalingLayer's shift/scale buffers are fixed constants baked into the
+    # flax graph (perceptual.py _LPIPS_SHIFT/_LPIPS_SCALE) — drop them, like the
+    # inception path drops fc.bias
+    state_np = {k: v for k, v in state_np.items() if "scaling_layer" not in k}
+    lin_items = sorted(
+        ((k, v) for k, v in state_np.items() if re.search(r"\blins?[._]?\d", k)),
+        key=lambda kv: _natural_key(kv[0]),
+    )
+    backbone = {k: v for k, v in state_np.items() if not re.search(r"\blins?[._]?\d", k)}
+    if len(lin_items) != 5:
+        raise ValueError(
+            f"{torch_ckpt_path} does not look like a full lpips.LPIPS state dict: "
+            f"found {len(lin_items)} linear-head tensors, expected 5"
+        )
+    weights = [np.asarray(v).reshape(-1) for _, v in lin_items]
+
+    module = _BACKBONES[net_type]()
+    with jax.default_device(jax.devices("cpu")[0]):
+        template = module.init(jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 3)))
+    variables = convert_conv_bn_model(backbone, template)
+    payload = {"net_type": net_type, "variables": variables, "weights": weights}
+    with open(out_path, "wb") as f:
+        pickle.dump(payload, f)
+    print(f"wrote {out_path}")
+
+
 # ----------------------------------------------------------------------- bert entry
 
 def convert_bert(torch_model_dir: str, out_dir: str) -> None:
@@ -221,9 +275,15 @@ def main() -> None:
     p2 = sub.add_parser("bert", help="HF torch model dir -> flax model dir")
     p2.add_argument("torch_model_dir")
     p2.add_argument("out_dir")
+    p3 = sub.add_parser("lpips", help="lpips.LPIPS state dict -> flax pkl (backbone + lin weights)")
+    p3.add_argument("torch_ckpt")
+    p3.add_argument("out_pkl")
+    p3.add_argument("--net-type", choices=("vgg", "alex"), default="vgg")
     args = ap.parse_args()
     if args.cmd == "inception":
         convert_inception(args.torch_ckpt, args.out_pkl, args.num_classes)
+    elif args.cmd == "lpips":
+        convert_lpips(args.torch_ckpt, args.out_pkl, args.net_type)
     else:
         convert_bert(args.torch_model_dir, args.out_dir)
 
