@@ -1,0 +1,77 @@
+"""Shared static-capacity buffer machinery for exact curve metrics.
+
+``AUROC(capacity=N)`` / ``AveragePrecision(capacity=N)`` keep identical
+``(preds_buf, target_buf, valid_buf, count, overflow)`` states; this mixin owns
+the registration, the masked buffer writes and the overflow→NaN contract so the
+two metrics cannot drift (they briefly did — one-hot condition and averaging
+semantics diverged in the first cut).
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+class CapacityCurveStateMixin:
+    """Mixin for metrics with a static ``(capacity, ...)`` score buffer."""
+
+    capacity: Optional[int]
+    num_classes: Optional[int]
+
+    def _capacity_num_columns(self) -> Optional[int]:
+        return self.num_classes if (self.num_classes or 0) > 1 else None
+
+    def _init_capacity_states(self) -> None:
+        c = self._capacity_num_columns()
+        capacity = self.capacity
+        if not isinstance(capacity, int) or capacity <= 0:
+            raise ValueError(f"`capacity` must be a positive int, got {capacity}")
+        score_shape = (capacity, c) if c else (capacity,)
+        # multiclass labels are stored one-hot: the per-column kernels then read
+        # the same layout multilabel targets arrive in
+        self.add_state("preds_buf", default=jnp.zeros(score_shape, jnp.float32), dist_reduce_fx="cat")
+        self.add_state("target_buf", default=jnp.zeros(score_shape, jnp.int32), dist_reduce_fx="cat")
+        self.add_state("valid_buf", default=jnp.zeros((capacity,), bool), dist_reduce_fx="cat")
+        self.add_state("count", default=jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")
+        self.add_state("overflow", default=jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")
+
+    def _capacity_write(self, preds: Array, target: Array) -> None:
+        """Write one canonicalized batch (binary ``(N,)`` or per-column
+        ``(N, C)`` with one-hot/multilabel targets) at the current fill point.
+
+        A single batch larger than the whole buffer is a static-shape error —
+        raised at trace time with a clear message rather than crashing inside
+        ``dynamic_update_slice``. Cumulative overflow across batches sets the
+        flag (in-trace code cannot raise) and compute returns NaN.
+        """
+        c = self._capacity_num_columns()
+        n = preds.shape[0]
+        if n > self.capacity:
+            raise ValueError(
+                f"A single batch of {n} samples cannot fit the capacity-{self.capacity} buffer of"
+                f" {type(self).__name__}; raise `capacity` to at least the largest batch size."
+            )
+        start = self.count
+        if c:
+            self.preds_buf = jax.lax.dynamic_update_slice(self.preds_buf, preds.astype(jnp.float32), (start, 0))
+            self.target_buf = jax.lax.dynamic_update_slice(self.target_buf, target.astype(jnp.int32), (start, 0))
+        else:
+            self.preds_buf = jax.lax.dynamic_update_slice(self.preds_buf, preds.astype(jnp.float32), (start,))
+            self.target_buf = jax.lax.dynamic_update_slice(self.target_buf, target.astype(jnp.int32), (start,))
+        self.valid_buf = jax.lax.dynamic_update_slice(self.valid_buf, jnp.ones((n,), bool), (start,))
+        self.overflow = self.overflow + (start + n > self.capacity).astype(jnp.int32)
+        self.count = jnp.minimum(start + n, self.capacity)
+
+    def _capacity_guard_nan(self, value: Array) -> Array:
+        """Warn eagerly on overflow; mask the result to NaN either way."""
+        from metrics_tpu.utils.checks import _is_tracer
+        from metrics_tpu.utils.prints import rank_zero_warn
+
+        if not _is_tracer(self.overflow) and int(self.overflow) > 0:
+            rank_zero_warn(
+                f"{type(self).__name__}(capacity={self.capacity}) overflowed — more samples were"
+                " updated than the buffer holds; returning NaN. Raise `capacity`.", UserWarning,
+            )
+        return jnp.where(self.overflow > 0, jnp.nan, value)

@@ -1,23 +1,31 @@
 """AUROC module metric.
 
 Parity: reference ``torchmetrics/classification/auroc.py:27`` (cat-list states of
-preds/target at :152-153; mode check at compute). List states gather by all_gather at
-sync; the exact sort-based compute runs eagerly on the gathered state (the jit-static
-alternative is BinnedAveragePrecision / binned curves).
+preds/target at :152-153; mode check at compute). Two state layouts:
+
+* default — cat-list states exactly like the reference; the exact sort-based
+  compute runs eagerly on the gathered state (data-dependent length);
+* ``capacity=N`` — SURVEY §7.1's static-capacity mode: a ``(capacity, ...)``
+  buffer + valid mask + count, so update, mesh sync (fixed-shape cat
+  all_gather) and the EXACT tie-aware compute (``ops/masked_curves.py``) all
+  run inside jit/shard_map. Overflowing the capacity yields NaN (in-trace code
+  cannot raise; an eager compute also warns). Values match sklearn to f32
+  rounding — tested in ``tests/classification/test_capacity_curves.py``.
 """
 from typing import Any, Optional
 
 import jax
 
+from metrics_tpu.classification._capacity import CapacityCurveStateMixin
 from metrics_tpu.functional.classification.auroc import _auroc_compute, _auroc_update
 from metrics_tpu.metric import Metric
-from metrics_tpu.utils.data import dim_zero_cat
+from metrics_tpu.utils.data import dim_zero_cat, to_onehot
 from metrics_tpu.utils.enums import DataType
 
 Array = jax.Array
 
 
-class AUROC(Metric):
+class AUROC(CapacityCurveStateMixin, Metric):
     """Area under the ROC curve (binary, multiclass ovr, multilabel).
 
     Example:
@@ -39,6 +47,7 @@ class AUROC(Metric):
         pos_label: Optional[int] = None,
         average: Optional[str] = "macro",
         max_fpr: Optional[float] = None,
+        capacity: Optional[int] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -46,6 +55,7 @@ class AUROC(Metric):
         self.pos_label = pos_label
         self.average = average
         self.max_fpr = max_fpr
+        self.capacity = capacity
 
         allowed_average = (None, "macro", "weighted", "micro")
         if average not in allowed_average:
@@ -56,25 +66,66 @@ class AUROC(Metric):
             raise ValueError(f"`max_fpr` should be a float in range (0, 1], got: {max_fpr}")
 
         self.mode: Optional[DataType] = None
-        self.add_state("preds", default=[], dist_reduce_fx="cat")
-        self.add_state("target", default=[], dist_reduce_fx="cat")
+        if capacity is None:
+            self.add_state("preds", default=[], dist_reduce_fx="cat")
+            self.add_state("target", default=[], dist_reduce_fx="cat")
+        else:
+            if max_fpr is not None:
+                raise ValueError("`max_fpr` is not supported in static-capacity mode (use the default eager mode)")
+            if average == "micro":
+                raise ValueError("`average='micro'` is not supported in static-capacity mode")
+            if pos_label not in (None, 1):
+                raise ValueError(
+                    "`pos_label` is not supported in static-capacity mode (positives are `target > 0`);"
+                    " use the default eager mode"
+                )
+            self._init_capacity_states()
 
     def update(self, preds: Array, target: Array) -> None:
         preds, target, mode = _auroc_update(preds, target)
-        self.preds.append(preds)
-        self.target.append(target)
         if self.mode and self.mode != mode:
             raise ValueError(
                 "The mode of data (binary, multi-label, multi-class) should be constant, but changed"
                 f" between batches from {self.mode} to {mode}"
             )
         self.mode = mode
+        if self.capacity is None:
+            self.preds.append(preds)
+            self.target.append(target)
+            return
+
+        c = self._capacity_num_columns()
+        if (mode == DataType.BINARY) != (c is None):
+            raise ValueError(
+                "Static-capacity AUROC needs `num_classes` matching the data: leave it unset/1 for"
+                f" binary inputs, set it to C for multiclass/multilabel — got num_classes={self.num_classes}"
+                f" with {mode} data"
+            )
+        if c and target.ndim == 1:
+            # multiclass (and multidim-multiclass, already flattened by
+            # _auroc_update) labels become one-hot columns
+            target = to_onehot(target, c)
+        self._capacity_write(preds, target)
 
     def compute(self) -> Array:
         if not self.mode:
             raise RuntimeError("You have to have determined mode.")
+        if self.capacity is not None:
+            return self._compute_capacity()
         preds = dim_zero_cat(self.preds)
         target = dim_zero_cat(self.target)
         return _auroc_compute(
             preds, target, self.mode, self.num_classes, self.pos_label, self.average, self.max_fpr
         )
+
+    def _compute_capacity(self) -> Array:
+        from metrics_tpu.ops.masked_curves import masked_binary_auroc, masked_multilabel_auroc
+
+        if self._capacity_num_columns():
+            value = masked_multilabel_auroc(
+                self.preds_buf, self.target_buf, self.valid_buf,
+                average=self.average if self.average in ("macro", "weighted") else "none",
+            )
+        else:
+            value = masked_binary_auroc(self.preds_buf, self.target_buf, self.valid_buf)
+        return self._capacity_guard_nan(value)

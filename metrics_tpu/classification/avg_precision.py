@@ -1,22 +1,26 @@
 """AveragePrecision module metric.
 
-Parity: reference ``torchmetrics/classification/avg_precision.py:28``.
+Parity: reference ``torchmetrics/classification/avg_precision.py:28``. Like
+``AUROC``, an opt-in ``capacity=N`` switches to SURVEY §7.1's static-capacity
+state (buffer + valid mask) so the exact step-integrated AP runs inside
+jit/shard_map (``ops/masked_curves.py``); overflow yields NaN.
 """
 from typing import Any, List, Optional, Union
 
 import jax
 
+from metrics_tpu.classification._capacity import CapacityCurveStateMixin
 from metrics_tpu.functional.classification.average_precision import (
     _average_precision_compute,
     _average_precision_update,
 )
 from metrics_tpu.metric import Metric
-from metrics_tpu.utils.data import dim_zero_cat
+from metrics_tpu.utils.data import dim_zero_cat, to_onehot
 
 Array = jax.Array
 
 
-class AveragePrecision(Metric):
+class AveragePrecision(CapacityCurveStateMixin, Metric):
     """Average precision (area under the PR curve by step integration).
 
     Example:
@@ -37,6 +41,7 @@ class AveragePrecision(Metric):
         num_classes: Optional[int] = None,
         pos_label: Optional[int] = None,
         average: Optional[str] = "macro",
+        capacity: Optional[int] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -46,21 +51,62 @@ class AveragePrecision(Metric):
         if average not in allowed_average:
             raise ValueError(f"Expected argument `average` to be one of {allowed_average} but got {average}")
         self.average = average
-        self.add_state("preds", default=[], dist_reduce_fx="cat")
-        self.add_state("target", default=[], dist_reduce_fx="cat")
+        self.capacity = capacity
+        if capacity is None:
+            self.add_state("preds", default=[], dist_reduce_fx="cat")
+            self.add_state("target", default=[], dist_reduce_fx="cat")
+        else:
+            if average == "micro":
+                raise ValueError("`average='micro'` is not supported in static-capacity mode")
+            if pos_label not in (None, 1):
+                raise ValueError(
+                    "`pos_label` is not supported in static-capacity mode (positives are `target > 0`);"
+                    " use the default eager mode"
+                )
+            self._init_capacity_states()
 
     def update(self, preds: Array, target: Array) -> None:
         preds, target, num_classes, pos_label = _average_precision_update(
             preds, target, self.num_classes, self.pos_label, self.average
         )
-        self.preds.append(preds)
-        self.target.append(target)
-        self.num_classes = num_classes
-        self.pos_label = pos_label
+        if self.capacity is None:
+            self.preds.append(preds)
+            self.target.append(target)
+            self.num_classes = num_classes
+            self.pos_label = pos_label
+            return
+
+        c = self._capacity_num_columns()
+        if (preds.ndim == 1) != (c is None):
+            raise ValueError(
+                "Static-capacity AveragePrecision needs `num_classes` matching the data: leave it"
+                f" unset/1 for binary inputs, set it to C for multiclass — got num_classes="
+                f"{self.num_classes} with preds of shape {preds.shape}"
+            )
+        if c and target.ndim == 1:
+            target = to_onehot(target, c)
+        self._capacity_write(preds, target)
 
     def compute(self) -> Union[Array, List[Array]]:
+        if self.capacity is not None:
+            return self._compute_capacity()
         preds = dim_zero_cat(self.preds)
         target = dim_zero_cat(self.target)
         if not self.num_classes:
             raise ValueError(f"`num_classes` bas to be positive number, but got {self.num_classes}")
         return _average_precision_compute(preds, target, self.num_classes, self.pos_label, self.average)
+
+    def _compute_capacity(self) -> Array:
+        from metrics_tpu.ops.masked_curves import (
+            masked_binary_average_precision,
+            masked_multilabel_average_precision,
+        )
+
+        if self._capacity_num_columns():
+            value = masked_multilabel_average_precision(
+                self.preds_buf, self.target_buf, self.valid_buf,
+                average=self.average if self.average in ("macro", "weighted") else "none",
+            )
+        else:
+            value = masked_binary_average_precision(self.preds_buf, self.target_buf, self.valid_buf)
+        return self._capacity_guard_nan(value)

@@ -1,0 +1,121 @@
+"""Masked exact curve-metric kernels for static-capacity states.
+
+SURVEY §7.1: exact AUROC/AP keep ``(buffer[capacity], count)`` states so the
+whole metric — update, mesh sync (fixed-shape cat all_gather), compute — runs
+inside one jit/shard_map region. The kernels here compute EXACT (sort-based,
+tie-aware) values over a buffer where only ``valid`` entries are real:
+
+* ``masked_binary_auroc`` — Mann-Whitney U with average-rank tie handling,
+  algebraically identical to trapezoidal ROC integration (what sklearn's
+  ``roc_auc_score`` and the eager path compute);
+* ``masked_binary_average_precision`` — step integration at distinct
+  thresholds (sklearn's ``average_precision_score`` definition).
+
+Everything is static-shape: one sort + segment reductions, no host round-trip.
+Degenerate inputs (single-class) return NaN — in-trace code cannot raise, and
+NaN is the documented sentinel the eager path's error maps to.
+"""
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _masked_average_ranks(scores: Array, valid: Array) -> Array:
+    """1-based average ranks (ascending) among valid entries; 0 for invalid.
+
+    Ties (equal scores among valid entries) receive the mean of the positions
+    they span — the correction ``roc_auc_score`` applies.
+    """
+    n = scores.shape[0]
+    keys = jnp.where(valid, scores, jnp.inf)  # invalid sort last
+    order = jnp.argsort(keys, stable=True)
+    s = keys[order]
+    v = valid[order]
+    pos = jnp.arange(1, n + 1, dtype=jnp.float32)
+    start = jnp.concatenate([jnp.ones((1,), bool), s[1:] != s[:-1]])
+    seg = jnp.cumsum(start) - 1
+    sum_pos = jax.ops.segment_sum(jnp.where(v, pos, 0.0), seg, num_segments=n)
+    cnt = jax.ops.segment_sum(v.astype(jnp.float32), seg, num_segments=n)
+    avg = sum_pos / jnp.maximum(cnt, 1.0)
+    ranks_sorted = jnp.where(v, avg[seg], 0.0)
+    return jnp.zeros(n, jnp.float32).at[order].set(ranks_sorted)
+
+
+def masked_binary_auroc(scores: Array, labels: Array, valid: Array) -> Array:
+    """Exact binary AUROC over the valid entries of a capacity buffer.
+
+    ``AUROC = (sum of positive ranks - P(P+1)/2) / (P * N)`` — the Mann-Whitney
+    statistic; NaN when either class is absent.
+    """
+    valid = valid.astype(bool)
+    pos = valid & (labels > 0)
+    ranks = _masked_average_ranks(scores.astype(jnp.float32), valid)
+    p = jnp.sum(pos.astype(jnp.float32))
+    nn = jnp.sum(valid.astype(jnp.float32)) - p
+    s_pos = jnp.sum(jnp.where(pos, ranks, 0.0))
+    denom = p * nn
+    return jnp.where(denom > 0, (s_pos - p * (p + 1) / 2) / jnp.maximum(denom, 1.0), jnp.nan)
+
+
+def masked_binary_average_precision(scores: Array, labels: Array, valid: Array) -> Array:
+    """Exact binary average precision (step integration at distinct thresholds)
+    over the valid entries of a capacity buffer. NaN when no positives."""
+    n = scores.shape[0]
+    valid = valid.astype(bool)
+    keys = jnp.where(valid, scores.astype(jnp.float32), -jnp.inf)  # invalid last
+    order = jnp.argsort(-keys, stable=True)
+    s = keys[order]
+    v = valid[order]
+    t = jnp.where(v, (labels[order] > 0).astype(jnp.float32), 0.0)
+    tp = jnp.cumsum(t)
+    fp = jnp.cumsum(jnp.where(v, 1.0 - t, 0.0))
+    # distinct-threshold runs; evaluate precision at each run END
+    start = jnp.concatenate([jnp.ones((1,), bool), s[1:] != s[:-1]])
+    seg = jnp.cumsum(start) - 1
+    run_tp = jax.ops.segment_sum(t, seg, num_segments=n)[seg]  # per-position: its run's TP
+    end = jnp.concatenate([s[1:] != s[:-1], jnp.ones((1,), bool)])
+    prec = tp / jnp.maximum(tp + fp, 1.0)
+    contrib = jnp.where(end & v, run_tp * prec, 0.0)
+    p_total = jnp.sum(t)
+    return jnp.where(p_total > 0, jnp.sum(contrib) / jnp.maximum(p_total, 1.0), jnp.nan)
+
+
+def average_per_class(per_class: Array, support: Array, average: Optional[str]) -> Array:
+    """Average a per-class metric vector, ignoring NaN (unobserved) classes —
+    the same tolerance the eager path applies (nanmean / NaN-zeroed weights)."""
+    if average in ("none", None):
+        return per_class
+    if average == "macro":
+        return jnp.nanmean(per_class)
+    if average != "weighted":
+        raise ValueError(f"unknown average for capacity mode: {average}")
+    w = jnp.where(jnp.isnan(per_class), 0.0, support.astype(jnp.float32))
+    vals = jnp.where(jnp.isnan(per_class), 0.0, per_class)
+    return jnp.sum(vals * w) / jnp.maximum(jnp.sum(w), 1.0)
+
+
+@partial(jax.jit, static_argnames=("average",))
+def masked_multilabel_auroc(probs: Array, labels: Array, valid: Array, average: Optional[str] = "macro") -> Array:
+    """Per-column AUROC over (capacity, C) probabilities and binary labels
+    (one-hot for multiclass OVR — identical layout)."""
+    per_class = jax.vmap(
+        lambda p_col, t_col: masked_binary_auroc(p_col, t_col, valid), in_axes=(1, 1)
+    )(probs, labels)
+    support = jnp.sum(jnp.where(valid[:, None], labels, 0), axis=0)
+    return average_per_class(per_class, support, average)
+
+
+@partial(jax.jit, static_argnames=("average",))
+def masked_multilabel_average_precision(
+    probs: Array, labels: Array, valid: Array, average: Optional[str] = "macro"
+) -> Array:
+    """Per-column AP over (capacity, C) probabilities and binary labels."""
+    per_class = jax.vmap(
+        lambda p_col, t_col: masked_binary_average_precision(p_col, t_col, valid), in_axes=(1, 1)
+    )(probs, labels)
+    support = jnp.sum(jnp.where(valid[:, None], labels, 0), axis=0)
+    return average_per_class(per_class, support, average)
