@@ -23,6 +23,28 @@ class CapacityCurveStateMixin:
     def _capacity_num_columns(self) -> Optional[int]:
         return self.num_classes if (self.num_classes or 0) > 1 else None
 
+    def _validate_capacity_kwargs(self, pos_label, average) -> None:
+        """Shared up-front rejections for eager-only options."""
+        if average == "micro":
+            raise ValueError("`average='micro'` is not supported in static-capacity mode")
+        if pos_label not in (None, 1):
+            raise ValueError(
+                "`pos_label` is not supported in static-capacity mode (positives are `target > 0`);"
+                " use the default eager mode"
+            )
+
+    def _compute_capacity_with(self, binary_kernel, multilabel_kernel):
+        """Dispatch compute over the shared buffer layout: per-column kernel for
+        declared multiclass/multilabel, binary kernel otherwise; NaN on overflow."""
+        if self._capacity_num_columns():
+            value = multilabel_kernel(
+                self.preds_buf, self.target_buf, self.valid_buf,
+                average=self.average if self.average in ("macro", "weighted") else "none",
+            )
+        else:
+            value = binary_kernel(self.preds_buf, self.target_buf, self.valid_buf)
+        return self._capacity_guard_nan(value)
+
     def _init_capacity_states(self) -> None:
         c = self._capacity_num_columns()
         capacity = self.capacity

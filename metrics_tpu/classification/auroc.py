@@ -72,13 +72,7 @@ class AUROC(CapacityCurveStateMixin, Metric):
         else:
             if max_fpr is not None:
                 raise ValueError("`max_fpr` is not supported in static-capacity mode (use the default eager mode)")
-            if average == "micro":
-                raise ValueError("`average='micro'` is not supported in static-capacity mode")
-            if pos_label not in (None, 1):
-                raise ValueError(
-                    "`pos_label` is not supported in static-capacity mode (positives are `target > 0`);"
-                    " use the default eager mode"
-                )
+            self._validate_capacity_kwargs(pos_label, average)
             self._init_capacity_states()
 
     def update(self, preds: Array, target: Array) -> None:
@@ -121,11 +115,4 @@ class AUROC(CapacityCurveStateMixin, Metric):
     def _compute_capacity(self) -> Array:
         from metrics_tpu.ops.masked_curves import masked_binary_auroc, masked_multilabel_auroc
 
-        if self._capacity_num_columns():
-            value = masked_multilabel_auroc(
-                self.preds_buf, self.target_buf, self.valid_buf,
-                average=self.average if self.average in ("macro", "weighted") else "none",
-            )
-        else:
-            value = masked_binary_auroc(self.preds_buf, self.target_buf, self.valid_buf)
-        return self._capacity_guard_nan(value)
+        return self._compute_capacity_with(masked_binary_auroc, masked_multilabel_auroc)

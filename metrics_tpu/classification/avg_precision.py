@@ -56,13 +56,7 @@ class AveragePrecision(CapacityCurveStateMixin, Metric):
             self.add_state("preds", default=[], dist_reduce_fx="cat")
             self.add_state("target", default=[], dist_reduce_fx="cat")
         else:
-            if average == "micro":
-                raise ValueError("`average='micro'` is not supported in static-capacity mode")
-            if pos_label not in (None, 1):
-                raise ValueError(
-                    "`pos_label` is not supported in static-capacity mode (positives are `target > 0`);"
-                    " use the default eager mode"
-                )
+            self._validate_capacity_kwargs(pos_label, average)
             self._init_capacity_states()
 
     def update(self, preds: Array, target: Array) -> None:
@@ -102,11 +96,6 @@ class AveragePrecision(CapacityCurveStateMixin, Metric):
             masked_multilabel_average_precision,
         )
 
-        if self._capacity_num_columns():
-            value = masked_multilabel_average_precision(
-                self.preds_buf, self.target_buf, self.valid_buf,
-                average=self.average if self.average in ("macro", "weighted") else "none",
-            )
-        else:
-            value = masked_binary_average_precision(self.preds_buf, self.target_buf, self.valid_buf)
-        return self._capacity_guard_nan(value)
+        return self._compute_capacity_with(
+            masked_binary_average_precision, masked_multilabel_average_precision
+        )

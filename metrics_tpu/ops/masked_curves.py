@@ -95,7 +95,10 @@ def average_per_class(per_class: Array, support: Array, average: Optional[str]) 
         raise ValueError(f"unknown average for capacity mode: {average}")
     w = jnp.where(jnp.isnan(per_class), 0.0, support.astype(jnp.float32))
     vals = jnp.where(jnp.isnan(per_class), 0.0, per_class)
-    return jnp.sum(vals * w) / jnp.maximum(jnp.sum(w), 1.0)
+    total_w = jnp.sum(w)
+    # all classes degenerate -> NaN sentinel (like macro's nanmean), not a
+    # confident-looking 0.0
+    return jnp.where(total_w > 0, jnp.sum(vals * w) / jnp.maximum(total_w, 1.0), jnp.nan)
 
 
 @partial(jax.jit, static_argnames=("average",))

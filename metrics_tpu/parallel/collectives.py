@@ -105,20 +105,32 @@ def fused_axis_sync(
 ) -> List[Array]:
     """Sync many (reduce_fx, value) state leaves with a minimal collective bundle.
 
-    All 'sum'/'mean'/'min'/'max' leaves of a given dtype are raveled into ONE flat
-    buffer and reduced with a single psum/pmin/pmax; 'cat'/None/custom leaves fall back
-    to per-leaf gathers (heterogeneous shapes can't share a buffer).
+    Exactly ONE collective per bucket:
 
-    Returns synced values in input order.
+    * 'sum'/'mean'/'min'/'max' leaves bucket per (reduction, dtype) — a psum
+      does arithmetic, so dtypes cannot mix — raveled into one flat buffer and
+      reduced with a single psum/pmean/pmin/pmax;
+    * 'cat'/None/custom leaves bucket per BIT-WIDTH across dtypes (f32 and
+      i32 share one uint32 carrier via a free bitcast): one stacked
+      ``all_gather`` per width, then per-leaf views are reassembled locally —
+      (world, n, ...) -> (world*n, ...) for 'cat', (world, ...) for None, and
+      a pairwise fold for callables. Shapes and dtypes of one width share the
+      buffer because gather is layout-agnostic over raveled bits.
+
+    Returns synced values in input order. A MetricCollection of K metrics with
+    S states issues O(reduce-dtype + gather-width buckets) collectives, not
+    O(K*S) (the reference's pattern, ``metric.py:240-245``).
     """
     out: List[Optional[Array]] = [None] * len(leaves)
-    buckets: Dict[Tuple[str, Any], List[int]] = {}
+    reduce_buckets: Dict[Tuple[str, Any], List[int]] = {}
+    gather_buckets: Dict[int, List[int]] = {}
     for i, (fx, v) in enumerate(leaves):
         if fx in _REDUCE_COLLECTIVES:
-            buckets.setdefault((fx, jnp.asarray(v).dtype), []).append(i)
+            reduce_buckets.setdefault((fx, jnp.asarray(v).dtype), []).append(i)
         else:
-            out[i] = sync_axis_state(fx, v, axis_name)
-    for (fx, _dtype), idxs in buckets.items():
+            gather_buckets.setdefault(_gather_width(jnp.asarray(v).dtype), []).append(i)
+
+    for (fx, _dtype), idxs in reduce_buckets.items():
         vals = [jnp.ravel(jnp.asarray(leaves[i][1])) for i in idxs]
         sizes = [v.size for v in vals]
         flat = jnp.concatenate(vals) if len(vals) > 1 else vals[0]
@@ -128,7 +140,63 @@ def fused_axis_sync(
             piece = lax.slice(synced, (off,), (off + n,))
             out[i] = piece.reshape(jnp.shape(leaves[i][1]))
             off += n
+
+    for width, idxs in gather_buckets.items():
+        # gathers are layout-agnostic: leaves of one bit-width bitcast (free —
+        # no copy, no value change) to a common unsigned carrier and move as
+        # ONE all_gather; a psum needs arithmetic and stays per-dtype
+        payloads = [_to_carrier(leaves[i][1]) for i in idxs]
+        sizes = [p.size for p in payloads]
+        flat = jnp.concatenate(payloads) if len(payloads) > 1 else payloads[0]
+        gathered = lax.all_gather(flat, axis_name, tiled=False)  # (world, total)
+        world = gathered.shape[0]
+        off = 0
+        for i, n in zip(idxs, sizes):
+            fx, v = leaves[i]
+            v = jnp.asarray(v)
+            shape = v.shape
+            raw = lax.slice(gathered, (0, off), (world, off + n))
+            piece = _from_carrier(raw.reshape((world,) + shape), v.dtype)
+            off += n
+            if fx == "cat":
+                out[i] = piece.reshape((world * shape[0],) + shape[1:])
+            elif fx is None:
+                out[i] = piece
+            elif callable(fx):
+                acc = piece[0]
+                for w in range(1, world):
+                    acc = fx(acc, piece[w])
+                out[i] = acc
+            else:
+                raise ValueError(f"unknown dist_reduce_fx: {fx!r}")
     return out  # type: ignore[return-value]
+
+
+_CARRIERS = {1: jnp.uint8, 2: jnp.uint16, 4: jnp.uint32, 8: jnp.uint64}
+
+
+def _gather_width(dtype: Any) -> int:
+    return 1 if dtype == jnp.bool_ else jnp.dtype(dtype).itemsize
+
+
+def _to_carrier(v: Array) -> Array:
+    """Ravel a leaf to the flat unsigned carrier of its own bit-width."""
+    v = jnp.asarray(v)
+    if v.dtype == jnp.bool_:
+        return jnp.ravel(v.astype(jnp.uint8))
+    carrier = _CARRIERS[jnp.dtype(v.dtype).itemsize]
+    if v.dtype == carrier:
+        return jnp.ravel(v)
+    return jnp.ravel(lax.bitcast_convert_type(v, carrier))
+
+
+def _from_carrier(raw: Array, dtype: Any) -> Array:
+    """Inverse of ``_to_carrier`` (shape already restored by the caller)."""
+    if dtype == jnp.bool_:
+        return raw.astype(jnp.bool_)
+    if raw.dtype == dtype:
+        return raw
+    return lax.bitcast_convert_type(raw, dtype)
 
 
 def reduce(x: Array, reduction: str) -> Array:

@@ -44,13 +44,14 @@ def metric_axis(axis: Optional[str]):
 class MeshConfig:
     """Declarative mesh description for the metrics runtime.
 
-    ``axis_names``/``shape`` describe the full device mesh; ``sync_axis`` names the
-    axis metric states are reduced over (the DP axis). Build with ``.make_mesh()``.
+    ``axis_names``/``shape`` describe the full device mesh; ``sync_axis`` names
+    the axis (or tuple of axes) metric states are reduced over. Build with
+    ``.make_mesh()``.
     """
 
     shape: Tuple[int, ...] = (1,)
     axis_names: Tuple[str, ...] = ("dp",)
-    sync_axis: str = "dp"
+    sync_axis: "str | Tuple[str, ...]" = "dp"
     devices: Optional[Sequence] = field(default=None, compare=False)
 
     def make_mesh(self) -> jax.sharding.Mesh:
@@ -65,3 +66,39 @@ class MeshConfig:
     def data_parallel(cls, n_devices: Optional[int] = None, axis: str = "dp") -> "MeshConfig":
         n = n_devices if n_devices is not None else len(jax.devices())
         return cls(shape=(n,), axis_names=(axis,), sync_axis=axis)
+
+    @classmethod
+    def multi_slice(
+        cls,
+        n_slices: int,
+        chips_per_slice: Optional[int] = None,
+        *,
+        slice_axis: str = "dcn",
+        chip_axis: str = "ici",
+    ) -> "MeshConfig":
+        """Two-level (DCN, ICI) layout for multi-slice TPU deployments.
+
+        The outer axis spans slices connected over the data-center network,
+        the inner axis spans chips within a slice on ICI. Metric sync uses the
+        TUPLE axis — XLA lowers one logical collective over both levels and
+        schedules the slice-local reduction on ICI before crossing DCN, so the
+        slow network carries one already-reduced buffer per slice. This is the
+        reference's multi-node ``process_group`` analogue
+        (``SURVEY.md`` §2.2/§5: "mesh (ICI, and DCN for multi-slice)").
+
+        On real hardware pass device order grouped by slice (the default
+        ``jax.devices()`` order already is); on a virtual mesh any order
+        models the topology.
+        """
+        if chips_per_slice is None:
+            if len(jax.devices()) % n_slices:
+                raise ValueError(
+                    f"{len(jax.devices())} devices do not split into {n_slices} equal slices;"
+                    " pass chips_per_slice explicitly"
+                )
+            chips_per_slice = len(jax.devices()) // n_slices
+        return cls(
+            shape=(n_slices, chips_per_slice),
+            axis_names=(slice_axis, chip_axis),
+            sync_axis=(slice_axis, chip_axis),
+        )

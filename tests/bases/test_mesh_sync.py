@@ -234,3 +234,37 @@ def test_tuple_axis_subaxis_sync(devices):
     out = np.asarray(run(jnp.arange(8.0)))
     # device order: (dp, grp) row-major — grp-col 0 holds x[0,2,4,6], col 1 x[1,3,5,7]
     assert out.tolist() == [0 + 2 + 4 + 6, 1 + 3 + 5 + 7]
+
+
+def test_multi_slice_mesh_config(devices):
+    """MeshConfig.multi_slice models a (DCN, ICI) two-level deployment: tuple
+    sync crosses both levels, ICI-only sync scopes to the slice, and the
+    hierarchical two-stage reduce equals the tuple-axis reduce."""
+    from metrics_tpu.parallel.mesh import MeshConfig
+
+    cfg = MeshConfig.multi_slice(2, 4)
+    assert cfg.shape == (2, 4) and cfg.axis_names == ("dcn", "ici")
+    assert cfg.sync_axis == ("dcn", "ici")
+    mesh = cfg.make_mesh()
+    m = DummyMetricSum()
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=P(("dcn", "ici")), out_specs=(P(), P("dcn")), check_vma=False)
+    def run(x):
+        state = m.update_state(m.init_state(), x[0])
+        global_sum = m.compute_synced(state, cfg.sync_axis)
+        slice_sum = jnp.reshape(m.compute_synced(state, "ici"), (1,))
+        staged = jax.lax.psum(jax.lax.psum(x[0], "ici"), "dcn")
+        return jnp.stack([global_sum, staged]), slice_sum
+
+    g, per_slice = run(jnp.arange(8.0))
+    assert float(g[0]) == sum(range(8))
+    # hierarchical (ici then dcn) reduce == tuple-axis reduce
+    assert float(g[1]) == float(g[0])
+    assert np.asarray(per_slice).tolist() == [0 + 1 + 2 + 3, 4 + 5 + 6 + 7]
+
+
+def test_multi_slice_chips_inferred(devices):
+    from metrics_tpu.parallel.mesh import MeshConfig
+
+    cfg = MeshConfig.multi_slice(4)  # 8 devices / 4 slices = 2 chips each
+    assert cfg.shape == (4, 2)
