@@ -83,7 +83,10 @@ class Accuracy(StatScores):
             self.subset_accuracy = False
 
         if self.subset_accuracy:
-            correct, total = _subset_accuracy_update(preds, target, threshold=self.threshold, top_k=self.top_k)
+            correct, total = _subset_accuracy_update(
+                preds, target, threshold=self.threshold, top_k=self.top_k,
+                num_classes=self.num_classes, multiclass=self.multiclass,
+            )
             self.correct = self.correct + correct
             self.total = self.total + total
         else:

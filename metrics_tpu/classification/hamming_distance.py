@@ -2,7 +2,7 @@
 
 Parity: reference ``torchmetrics/classification/hamming_distance.py:23``.
 """
-from typing import Any
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -32,14 +32,25 @@ class HammingDistance(Metric):
     is_differentiable = False
     higher_is_better = False
 
-    def __init__(self, threshold: float = 0.5, **kwargs: Any) -> None:
+    def __init__(
+        self,
+        threshold: float = 0.5,
+        num_classes: Optional[int] = None,
+        multiclass: Optional[bool] = None,
+        **kwargs: Any,
+    ) -> None:
         super().__init__(**kwargs)
         self.add_state("correct", default=jnp.asarray(0), dist_reduce_fx="sum")
         self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
         self.threshold = threshold
+        # static-shape hints (this build's jit contract); not in the reference
+        self.num_classes = num_classes
+        self.multiclass = multiclass
 
     def update(self, preds: Array, target: Array) -> None:
-        correct, total = _hamming_distance_update(preds, target, self.threshold)
+        correct, total = _hamming_distance_update(
+            preds, target, self.threshold, self.num_classes, self.multiclass
+        )
         self.correct = self.correct + correct
         self.total = self.total + total
 

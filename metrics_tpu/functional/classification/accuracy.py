@@ -105,10 +105,20 @@ def _accuracy_compute(
 
 
 def _subset_accuracy_update(
-    preds: Array, target: Array, threshold: float, top_k: Optional[int]
+    preds: Array,
+    target: Array,
+    threshold: float,
+    top_k: Optional[int],
+    num_classes: Optional[int] = None,
+    multiclass: Optional[bool] = None,
 ) -> Tuple[Array, Array]:
+    # num_classes/multiclass forward to the input layer: inferring the class
+    # count from data values is impossible under jit (the TPU contract), so
+    # subset accuracy must accept the same static hints as the stat-score path
     preds, target = _input_squeeze(jnp.asarray(preds), jnp.asarray(target))
-    preds, target, mode = _input_format_classification(preds, target, threshold=threshold, top_k=top_k)
+    preds, target, mode = _input_format_classification(
+        preds, target, threshold=threshold, top_k=top_k, num_classes=num_classes, multiclass=multiclass
+    )
 
     if mode == DataType.MULTILABEL and top_k:
         raise ValueError("You can not use the `top_k` parameter to calculate accuracy for multi-label inputs.")
@@ -172,7 +182,7 @@ def accuracy(
     reduce = "macro" if average in ["weighted", "none", None] else average
 
     if subset_accuracy and _check_subset_validity(mode):
-        correct, total = _subset_accuracy_update(preds, target, threshold, top_k)
+        correct, total = _subset_accuracy_update(preds, target, threshold, top_k, num_classes, multiclass)
         return _subset_accuracy_compute(correct, total)
     tp, fp, tn, fn = _accuracy_update(
         preds, target, reduce, mdmc_average, threshold, num_classes, top_k, multiclass, ignore_index, mode

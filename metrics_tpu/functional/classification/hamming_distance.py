@@ -3,7 +3,7 @@
 Parity: reference ``torchmetrics/functional/classification/hamming_distance.py``
 (_hamming_distance_update :23, _hamming_distance_compute :45, hamming_distance :63).
 """
-from typing import Tuple, Union
+from typing import Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -13,8 +13,19 @@ from metrics_tpu.utils.checks import _input_format_classification
 Array = jax.Array
 
 
-def _hamming_distance_update(preds: Array, target: Array, threshold: float = 0.5) -> Tuple[Array, int]:
-    preds, target, _ = _input_format_classification(preds, target, threshold=threshold)
+def _hamming_distance_update(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    num_classes: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+) -> Tuple[Array, int]:
+    # num_classes/multiclass are this build's static-shape hints (not in the
+    # reference signature): integer label inputs under jit cannot infer the
+    # class count from data values
+    preds, target, _ = _input_format_classification(
+        preds, target, threshold=threshold, num_classes=num_classes, multiclass=multiclass
+    )
     correct = jnp.sum(preds == target)
     total = preds.size
     return correct, total
@@ -24,7 +35,14 @@ def _hamming_distance_compute(correct: Array, total: Union[int, Array]) -> Array
     return 1 - correct.astype(jnp.float32) / total
 
 
-def hamming_distance(preds: Array, target: Array, threshold: float = 0.5) -> Array:
-    """Compute the average Hamming distance / loss. Parity: reference ``:63-107``."""
-    correct, total = _hamming_distance_update(preds, target, threshold)
+def hamming_distance(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    num_classes: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+) -> Array:
+    """Compute the average Hamming distance / loss. Parity: reference ``:63-107``
+    (plus this build's optional static num_classes/multiclass hints for jit)."""
+    correct, total = _hamming_distance_update(preds, target, threshold, num_classes, multiclass)
     return _hamming_distance_compute(correct, total)

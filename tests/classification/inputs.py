@@ -76,3 +76,18 @@ _input_multidim_multiclass = Input(
     preds=np.random.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE, EXTRA_DIM)),
     target=np.random.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE, EXTRA_DIM)),
 )
+
+_input_multilabel_logits = Input(
+    preds=np.random.randn(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES),
+    target=np.random.randint(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)),
+)
+
+_input_multilabel_multidim = Input(
+    preds=np.random.randint(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM)),
+    target=np.random.randint(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM)),
+)
+
+# multilabel edge case where nothing matches (per-class scores are undefined) —
+# reference ``inputs.py:61-65``
+__no_match_preds = np.random.randint(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES))
+_input_multilabel_no_match = Input(preds=__no_match_preds, target=np.abs(__no_match_preds - 1))

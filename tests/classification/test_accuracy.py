@@ -12,12 +12,17 @@ from metrics_tpu.utils.checks import _input_format_classification
 from metrics_tpu.utils.enums import DataType
 from tests.classification.inputs import (
     _input_binary,
+    _input_binary_logits,
     _input_binary_prob,
     _input_multiclass,
+    _input_multiclass_logits,
     _input_multiclass_prob,
     _input_multidim_multiclass,
     _input_multidim_multiclass_prob,
     _input_multilabel,
+    _input_multilabel_logits,
+    _input_multilabel_multidim,
+    _input_multilabel_multidim_prob,
     _input_multilabel_prob,
 )
 from tests.helpers.testers import THRESHOLD, MetricTester
@@ -38,20 +43,32 @@ def _sk_accuracy(preds, target, subset_accuracy=False):
     return sk_accuracy(y_true=sk_target, y_pred=sk_preds)
 
 
-# (inputs, subset_accuracy, extra metric args). Label inputs carry a static
-# num_classes: inferring the class count from data values is impossible under jit
-# (the documented TPU contract; eager inference still works, see the fn tests).
+# (inputs, subset_accuracy, extra metric args) — the reference's full named
+# case matrix (``tests/classification/test_accuracy.py:59-80``): every
+# prob/logit/label x binary/multilabel/multiclass/multidim combination,
+# subset-accuracy variants included. Label inputs carry a static num_classes:
+# inferring the class count from data values is impossible under jit (the
+# documented TPU contract; eager inference still works, see the fn tests).
 _cases = [
+    pytest.param(_input_binary_logits, False, {}, id="binary_logits"),
     pytest.param(_input_binary_prob, False, {}, id="binary_prob"),
     pytest.param(_input_binary, False, {"num_classes": 2}, id="binary"),
-    pytest.param(_input_multilabel_prob, False, {}, id="multilabel_prob"),
     pytest.param(_input_multilabel_prob, True, {}, id="multilabel_prob_subset"),
+    pytest.param(_input_multilabel_logits, False, {}, id="multilabel_logits"),
+    pytest.param(_input_multilabel_prob, False, {}, id="multilabel_prob"),
+    pytest.param(_input_multilabel, True, {"num_classes": 2}, id="multilabel_subset"),
     pytest.param(_input_multilabel, False, {"num_classes": 2}, id="multilabel"),
     pytest.param(_input_multiclass_prob, False, {}, id="multiclass_prob"),
+    pytest.param(_input_multiclass_logits, False, {}, id="multiclass_logits"),
     pytest.param(_input_multiclass, False, {"num_classes": 5}, id="multiclass"),
     pytest.param(_input_multidim_multiclass_prob, False, {}, id="mdmc_prob"),
     pytest.param(_input_multidim_multiclass_prob, True, {}, id="mdmc_prob_subset"),
     pytest.param(_input_multidim_multiclass, False, {"num_classes": 5}, id="mdmc"),
+    pytest.param(_input_multidim_multiclass, True, {"num_classes": 5}, id="mdmc_subset"),
+    pytest.param(_input_multilabel_multidim_prob, True, {}, id="mlmd_prob_subset"),
+    pytest.param(_input_multilabel_multidim_prob, False, {}, id="mlmd_prob"),
+    pytest.param(_input_multilabel_multidim, True, {"num_classes": 2}, id="mlmd_subset"),
+    pytest.param(_input_multilabel_multidim, False, {"num_classes": 2}, id="mlmd"),
 ]
 
 

@@ -6,24 +6,43 @@ Parity model: reference ``tests/classification/test_precision_recall.py``,
 """
 import numpy as np
 import pytest
-from sklearn.metrics import fbeta_score, multilabel_confusion_matrix, precision_score, recall_score
+from sklearn.metrics import fbeta_score, precision_score, recall_score
 
 from metrics_tpu import F1Score, FBeta, HammingDistance, Precision, Recall, Specificity, StatScores
 from metrics_tpu.functional import f1, fbeta, hamming_distance, precision, recall, specificity, stat_scores
 from metrics_tpu.utils.checks import _input_format_classification
 from metrics_tpu.utils.data import to_categorical
 from metrics_tpu.utils.enums import DataType
-from tests.classification.inputs import _input_binary_prob, _input_multiclass, _input_multiclass_prob
+from tests.classification.inputs import (
+    _input_binary,
+    _input_binary_logits,
+    _input_binary_prob,
+    _input_multiclass,
+    _input_multiclass_logits,
+    _input_multiclass_prob,
+    _input_multidim_multiclass,
+    _input_multidim_multiclass_prob,
+    _input_multilabel,
+    _input_multilabel_logits,
+    _input_multilabel_no_match,
+    _input_multilabel_prob,
+)
 from tests.helpers.testers import NUM_CLASSES, THRESHOLD, MetricTester
 
 
-def _canon(preds, target):
+def _canon(preds, target, fmt=None):
     """Canonical multilabel-indicator matrices — sklearn's multilabel semantics then
     match the reference's stat-score counting exactly (the reference tests use the
-    same adapter, ``tests/classification/test_precision_recall.py:40-56``)."""
-    p, t, mode = _input_format_classification(preds, target, threshold=THRESHOLD)
+    same adapter, ``tests/classification/test_precision_recall.py:40-56``). ``fmt``
+    carries the same num_classes/multiclass hints the metric gets, so ambiguous
+    label inputs canonicalize identically on both sides."""
+    fmt = fmt or {}
+    p, t, mode = _input_format_classification(
+        preds, target, threshold=THRESHOLD,
+        num_classes=fmt.get("num_classes"), multiclass=fmt.get("multiclass"),
+    )
     p, t = np.asarray(p), np.asarray(t)
-    if p.ndim == 3:  # (N, C, X) -> (N*X, C)
+    if p.ndim == 3:  # (N, C, X) -> (N*X, C)  (the mdmc_average="global" layout)
         p = np.moveaxis(p, 1, 2).reshape(-1, p.shape[1])
         t = np.moveaxis(t, 1, 2).reshape(-1, t.shape[1])
     return p, t
@@ -36,25 +55,26 @@ def _avg_for(p, average):
     return None if average in ("none", None) else average
 
 
-def _sk_prec(preds, target, average="micro"):
-    p, t = _canon(preds, target)
+def _sk_prec(preds, target, average="micro", fmt=None):
+    p, t = _canon(preds, target, fmt)
     return precision_score(t.squeeze(), p.squeeze(), average=_avg_for(p, average), zero_division=0)
 
 
-def _sk_recall(preds, target, average="micro"):
-    p, t = _canon(preds, target)
+def _sk_recall(preds, target, average="micro", fmt=None):
+    p, t = _canon(preds, target, fmt)
     return recall_score(t.squeeze(), p.squeeze(), average=_avg_for(p, average), zero_division=0)
 
 
-def _sk_fbeta(preds, target, average="micro", beta=1.0):
-    p, t = _canon(preds, target)
+def _sk_fbeta(preds, target, average="micro", beta=1.0, fmt=None):
+    p, t = _canon(preds, target, fmt)
     return fbeta_score(t.squeeze(), p.squeeze(), beta=beta, average=_avg_for(p, average), zero_division=0)
 
 
-def _sk_specificity(preds, target, average="micro"):
-    p, t = _canon(preds, target)
-    cm = multilabel_confusion_matrix(t, p)
-    tn, fp = cm[:, 0, 0], cm[:, 0, 1]
+def _sk_specificity(preds, target, average="micro", fmt=None):
+    p, t = _canon(preds, target, fmt)
+    # per canonical column (avoids sklearn's 1-column/1-d binary ambiguity)
+    tn = ((p == 0) & (t == 0)).sum(0)
+    fp = ((p == 1) & (t == 0)).sum(0)
     if average == "micro":
         return tn.sum() / (tn.sum() + fp.sum())
     scores = tn / np.maximum(tn + fp, 1e-12)
@@ -66,26 +86,56 @@ def _sk_specificity(preds, target, average="micro"):
     return scores
 
 
-def _sk_stat_scores(preds, target, reduce="micro"):
-    p, t = _canon(preds, target)
-    cm = multilabel_confusion_matrix(t, p)
-    tn, fp, fn, tp = cm[:, 0, 0], cm[:, 0, 1], cm[:, 1, 0], cm[:, 1, 1]
+def _sk_stat_scores(preds, target, reduce="micro", fmt=None):
+    p, t = _canon(preds, target, fmt)
+    # per canonical column (avoids sklearn's 1-column/1-d binary ambiguity)
+    tp = ((p == 1) & (t == 1)).sum(0)
+    fp = ((p == 1) & (t == 0)).sum(0)
+    tn = ((p == 0) & (t == 0)).sum(0)
+    fn = ((p == 0) & (t == 1)).sum(0)
     stats = np.stack([tp, fp, tn, fn, tp + fn], axis=-1)
     if reduce == "micro":
         return stats.sum(axis=0)
     return stats
 
 
-def _sk_hamming(preds, target):
-    p, t = _canon(preds, target)
+def _sk_hamming(preds, target, fmt=None):
+    p, t = _canon(preds, target, fmt)
     return 1 - (p == t).mean()
 
 
+# the reference's named prob/logit/label x binary/multilabel/multiclass/mdmc
+# matrix (``tests/classification/inputs.py:20-80`` fixtures, exercised across
+# ``test_stat_scores.py``/``test_precision_recall.py``/``test_f_beta.py``).
+# Each case carries the input-format hints the reference passes per fixture:
+# num_classes (static — the jit contract), multiclass=False to disambiguate
+# 0/1 label tensors, mdmc_average="global" for the multidim layouts.
 _inputs = [
-    pytest.param(_input_binary_prob, id="binary_prob"),
-    pytest.param(_input_multiclass_prob, id="mc_prob"),
-    pytest.param(_input_multiclass, id="mc_labels"),
+    pytest.param(_input_binary_prob, {"num_classes": 1}, id="binary_prob"),
+    pytest.param(_input_binary_logits, {"num_classes": 1}, id="binary_logits"),
+    pytest.param(_input_binary, {"num_classes": 1, "multiclass": False}, id="binary_labels"),
+    pytest.param(_input_multilabel_prob, {"num_classes": NUM_CLASSES}, id="ml_prob"),
+    pytest.param(_input_multilabel_logits, {"num_classes": NUM_CLASSES}, id="ml_logits"),
+    pytest.param(_input_multilabel, {"num_classes": NUM_CLASSES, "multiclass": False}, id="ml_labels"),
+    pytest.param(_input_multiclass_prob, {"num_classes": NUM_CLASSES}, id="mc_prob"),
+    pytest.param(_input_multiclass_logits, {"num_classes": NUM_CLASSES}, id="mc_logits"),
+    pytest.param(_input_multiclass, {"num_classes": NUM_CLASSES}, id="mc_labels"),
+    pytest.param(
+        _input_multidim_multiclass_prob,
+        {"num_classes": NUM_CLASSES, "mdmc_average": "global"},
+        id="mdmc_prob",
+    ),
+    pytest.param(
+        _input_multidim_multiclass,
+        {"num_classes": NUM_CLASSES, "mdmc_average": "global"},
+        id="mdmc_labels",
+    ),
 ]
+
+
+def _canon_fmt(fmt):
+    """The subset of the metric hints the input canonicalizer understands."""
+    return {k: fmt[k] for k in ("num_classes", "multiclass") if k in fmt}
 
 _averages = ["micro", "macro", "weighted", "none"]
 
@@ -93,7 +143,7 @@ _averages = ["micro", "macro", "weighted", "none"]
 class TestPrecisionRecallFBeta(MetricTester):
     atol = 1e-6
 
-    @pytest.mark.parametrize("inputs", _inputs)
+    @pytest.mark.parametrize("inputs,fmt", _inputs)
     @pytest.mark.parametrize("average", _averages)
     @pytest.mark.parametrize(
         "metric_class,metric_fn,sk_fn",
@@ -103,20 +153,18 @@ class TestPrecisionRecallFBeta(MetricTester):
             (F1Score, f1, _sk_fbeta),
         ],
     )
-    def test_class_single(self, inputs, average, metric_class, metric_fn, sk_fn):
-        num_classes = NUM_CLASSES if np.asarray(inputs.preds).ndim > 2 or inputs.preds.dtype.kind == "i" else 1
+    def test_class_single(self, inputs, fmt, average, metric_class, metric_fn, sk_fn):
         self.run_class_metric_test(
             ddp=False,
             preds=inputs.preds,
             target=inputs.target,
             metric_class=metric_class,
-            sk_metric=lambda p, t: sk_fn(p, t, average),
-            metric_args={"average": average, "num_classes": num_classes if average != "micro" else num_classes,
-                         "threshold": THRESHOLD},
+            sk_metric=lambda p, t: sk_fn(p, t, average, fmt=_canon_fmt(fmt)),
+            metric_args={"average": average, "threshold": THRESHOLD, **fmt},
             check_batch=False,
         )
 
-    @pytest.mark.parametrize("inputs", _inputs)
+    @pytest.mark.parametrize("inputs,fmt", _inputs)
     @pytest.mark.parametrize("average", ["micro", "macro"])
     @pytest.mark.parametrize(
         "metric_class,metric_fn,sk_fn",
@@ -125,36 +173,27 @@ class TestPrecisionRecallFBeta(MetricTester):
             (Recall, recall, _sk_recall),
         ],
     )
-    def test_class_ddp(self, inputs, average, metric_class, metric_fn, sk_fn):
-        num_classes = NUM_CLASSES if np.asarray(inputs.preds).ndim > 2 or inputs.preds.dtype.kind == "i" else 1
-        extra = {"num_classes": num_classes} if (average != "micro" or inputs.preds.dtype.kind == "i") else {}
-        if inputs.preds.dtype.kind == "i":
-            extra["num_classes"] = NUM_CLASSES
-        elif average != "micro":
-            extra["num_classes"] = num_classes
+    def test_class_ddp(self, inputs, fmt, average, metric_class, metric_fn, sk_fn):
         self.run_class_metric_test(
             ddp=True,
             preds=inputs.preds,
             target=inputs.target,
             metric_class=metric_class,
-            sk_metric=lambda p, t: sk_fn(p, t, average),
-            metric_args={"average": average, "threshold": THRESHOLD, **extra},
+            sk_metric=lambda p, t: sk_fn(p, t, average, fmt=_canon_fmt(fmt)),
+            metric_args={"average": average, "threshold": THRESHOLD, **fmt},
         )
 
-    @pytest.mark.parametrize("inputs", _inputs)
+    @pytest.mark.parametrize("inputs,fmt", _inputs)
     @pytest.mark.parametrize("average", _averages)
-    def test_fn_precision_recall(self, inputs, average):
-        num_classes = NUM_CLASSES if np.asarray(inputs.preds).ndim > 2 or inputs.preds.dtype.kind == "i" else 1
-        args = {"average": average, "threshold": THRESHOLD}
-        if average != "micro" or inputs.preds.dtype.kind == "i":
-            args["num_classes"] = num_classes
+    def test_fn_precision_recall(self, inputs, fmt, average):
+        args = {"average": average, "threshold": THRESHOLD, **fmt}
         self.run_functional_metric_test(
             preds=inputs.preds, target=inputs.target, metric_functional=precision,
-            sk_metric=lambda p, t: _sk_prec(p, t, average), metric_args=args,
+            sk_metric=lambda p, t: _sk_prec(p, t, average, fmt=_canon_fmt(fmt)), metric_args=args,
         )
         self.run_functional_metric_test(
             preds=inputs.preds, target=inputs.target, metric_functional=recall,
-            sk_metric=lambda p, t: _sk_recall(p, t, average), metric_args=args,
+            sk_metric=lambda p, t: _sk_recall(p, t, average, fmt=_canon_fmt(fmt)), metric_args=args,
         )
 
     @pytest.mark.parametrize("average", ["micro", "macro", "weighted"])
@@ -170,16 +209,29 @@ class TestPrecisionRecallFBeta(MetricTester):
 class TestSpecificity(MetricTester):
     atol = 1e-6
 
+    @pytest.mark.parametrize(
+        "inputs,fmt",
+        [
+            pytest.param(_input_binary_prob, {"num_classes": 1}, id="binary_prob"),
+            pytest.param(_input_multilabel_prob, {"num_classes": NUM_CLASSES}, id="ml_prob"),
+            pytest.param(_input_multiclass_prob, {"num_classes": NUM_CLASSES}, id="mc_prob"),
+            pytest.param(
+                _input_multidim_multiclass_prob,
+                {"num_classes": NUM_CLASSES, "mdmc_average": "global"},
+                id="mdmc_prob",
+            ),
+        ],
+    )
     @pytest.mark.parametrize("average", ["micro", "macro", "weighted"])
     @pytest.mark.parametrize("ddp", [False, True])
-    def test_class(self, average, ddp):
+    def test_class(self, inputs, fmt, average, ddp):
         self.run_class_metric_test(
             ddp=ddp,
-            preds=_input_multiclass_prob.preds,
-            target=_input_multiclass_prob.target,
+            preds=inputs.preds,
+            target=inputs.target,
             metric_class=Specificity,
-            sk_metric=lambda p, t: _sk_specificity(p, t, average),
-            metric_args={"average": average, "num_classes": NUM_CLASSES},
+            sk_metric=lambda p, t: _sk_specificity(p, t, average, fmt=_canon_fmt(fmt)),
+            metric_args={"average": average, "threshold": THRESHOLD, **fmt},
             check_batch=False,
         )
 
@@ -196,16 +248,20 @@ class TestSpecificity(MetricTester):
 class TestStatScores(MetricTester):
     atol = 1e-6
 
+    @pytest.mark.parametrize("inputs,fmt", _inputs)
     @pytest.mark.parametrize("reduce", ["micro", "macro"])
     @pytest.mark.parametrize("ddp", [False, True])
-    def test_class(self, reduce, ddp):
+    def test_class(self, inputs, fmt, reduce, ddp):
+        args = dict(fmt)
+        if "mdmc_average" in args:  # StatScores names the knob mdmc_reduce
+            args["mdmc_reduce"] = args.pop("mdmc_average")
         self.run_class_metric_test(
             ddp=ddp,
-            preds=_input_multiclass_prob.preds,
-            target=_input_multiclass_prob.target,
+            preds=inputs.preds,
+            target=inputs.target,
             metric_class=StatScores,
-            sk_metric=lambda p, t: _sk_stat_scores(p, t, reduce),
-            metric_args={"reduce": reduce, "num_classes": NUM_CLASSES if reduce == "macro" else None},
+            sk_metric=lambda p, t: _sk_stat_scores(p, t, reduce, fmt=_canon_fmt(fmt)),
+            metric_args={"reduce": reduce, "threshold": THRESHOLD, **args},
             check_batch=False,
         )
 
@@ -222,15 +278,30 @@ class TestStatScores(MetricTester):
 class TestHamming(MetricTester):
     atol = 1e-6
 
+    @pytest.mark.parametrize(
+        "inputs",
+        [
+            pytest.param(_input_binary_prob, id="binary_prob"),
+            pytest.param(_input_binary_logits, id="binary_logits"),
+            pytest.param(_input_multilabel_prob, id="ml_prob"),
+            pytest.param(_input_multilabel, id="ml_labels"),
+            pytest.param(_input_multidim_multiclass, id="mdmc_labels"),
+        ],
+    )
     @pytest.mark.parametrize("ddp", [False, True])
-    def test_class(self, ddp):
+    def test_class(self, inputs, ddp):
+        # label fixtures need the static num_classes hint under jit (ddp)
+        fmt = {}
+        if np.asarray(inputs.preds).dtype.kind == "i":
+            nc = 2 if np.asarray(inputs.preds).max() <= 1 else NUM_CLASSES
+            fmt = {"num_classes": nc}
         self.run_class_metric_test(
             ddp=ddp,
-            preds=_input_binary_prob.preds,
-            target=_input_binary_prob.target,
+            preds=inputs.preds,
+            target=inputs.target,
             metric_class=HammingDistance,
-            sk_metric=_sk_hamming,
-            metric_args={"threshold": THRESHOLD},
+            sk_metric=lambda p, t: _sk_hamming(p, t, fmt=_canon_fmt(fmt)),
+            metric_args={"threshold": THRESHOLD, **fmt},
         )
 
     def test_fn(self):
@@ -240,6 +311,24 @@ class TestHamming(MetricTester):
             metric_functional=hamming_distance,
             sk_metric=_sk_hamming,
         )
+
+
+def test_multilabel_no_match_edge_case():
+    """The reference's no-match fixture (``inputs.py:61-65``): every prediction
+    wrong, per-class scores undefined — zero_division maps them to 0, never NaN."""
+    for average in ("micro", "macro", "weighted"):
+        m = Precision(average=average, num_classes=NUM_CLASSES, multiclass=False)
+        for b in range(_input_multilabel_no_match.preds.shape[0]):
+            m.update(_input_multilabel_no_match.preds[b], _input_multilabel_no_match.target[b])
+        val = np.asarray(m.compute())
+        assert np.all(np.isfinite(val)) and np.all(val == 0.0), (average, val)
+        expected = _sk_prec(
+            np.concatenate(_input_multilabel_no_match.preds),
+            np.concatenate(_input_multilabel_no_match.target),
+            average,
+            fmt={"num_classes": NUM_CLASSES, "multiclass": False},
+        )
+        np.testing.assert_allclose(val, expected, atol=1e-6)
 
 
 def test_micro_fbeta_respects_ignore_index():
