@@ -439,10 +439,19 @@ def bench_bertscore() -> dict:
 
     from transformers import BertTokenizerFast
 
-    # enough pairs to saturate the device: per-call cost on the TPU is one
-    # dispatch round-trip + compute, so throughput is measured at batch scale
-    preds = ["the cat sat on the mat", "a dog ran in the park"] * 256
-    refs = ["the cat sat on a mat", "the dog sat in the park"] * 256
+    # corpus-scale throughput: per-call cost on the tunnelled TPU is ONE
+    # blocking round-trip (~130ms) + compute, so small corpora measure tunnel
+    # latency, not throughput. 2048 pairs with 256 distinct sentences per side
+    # (8 copies each — the shared-reference shape of real MT eval, which the
+    # pipeline's dedup encoding exploits; the reference gets the same corpus).
+    # Distinguishing words come from the tiny vocab's tokN entries so the
+    # sentences stay DISTINCT after tokenization (out-of-vocab words would all
+    # collapse to [UNK] and fake a fully-duplicated corpus).
+    def _sentence(prefix, i):
+        return f"{prefix} tok{i % 60} tok{(i // 60) % 60} sat on the mat"
+
+    preds = [_sentence("the cat", i) for i in range(256)] * 8
+    refs = [_sentence("a dog", i) for i in range(256)] * 8
 
     with tempfile.TemporaryDirectory() as tmp:
         pt_dir = _tiny_bert(tmp)

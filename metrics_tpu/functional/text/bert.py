@@ -193,6 +193,7 @@ def _score_tokenized(
     tgt_mask: np.ndarray,
     idf: bool,
     batch_size: int,
+    dedup: Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]] = None,
 ) -> np.ndarray:
     """Embed + match pre-tokenized pred/ref batches; returns (3, N) numpy P/R/F1.
 
@@ -200,9 +201,17 @@ def _score_tokenized(
     default), one fused pass over the concatenation keeps the encoder batches
     full; a tokenizer padding each side to its own longest length falls back to
     per-side embedding (the matching einsum handles L_pred != L_ref). Either
-    way the post-encoder concat/split/matching runs as ONE compiled call whose
+    way the post-encoder gather/split/matching runs as ONE compiled call whose
     (3, N) stack crosses to the host in ONE transfer — eagerly that path costs
     ~10 dispatch round-trips.
+
+    Duplicate token rows (shared references, repeated candidates — the norm in
+    MT eval where K systems score against one reference set) are encoded ONCE
+    when fewer than half the rows are distinct. ``bert_score`` passes its
+    text-level structure as ``dedup=(u_ids, u_mask, inverse)``; the
+    pre-tokenized module path discovers row duplicates itself via
+    ``np.unique``. Encoder dispatches are async — they pipeline behind the
+    host prep of later chunks without blocking.
     """
     def _embed(ids: np.ndarray, mask: np.ndarray) -> List[Array]:
         outs = []
@@ -219,10 +228,33 @@ def _score_tokenized(
         tgt_w = jnp.asarray(_idf_weights(tgt_ids, tgt_mask, idf_map))
 
     if pred_ids.shape[1] == tgt_ids.shape[1]:
-        outs = _embed(np.concatenate([pred_ids, tgt_ids], axis=0),
-                      np.concatenate([pred_mask, tgt_mask], axis=0))
+        n_rows = pred_ids.shape[0] + tgt_ids.shape[0]
+        length = pred_ids.shape[1]
+        if dedup is None:
+            # pre-tokenized entry (the module path): discover row duplicates
+            all_ids = np.concatenate([pred_ids, tgt_ids], axis=0)
+            all_mask = np.concatenate([pred_mask, tgt_mask], axis=0)
+            key = np.concatenate([all_ids, all_mask], axis=1)
+            uniq, inverse = np.unique(key, axis=0, return_inverse=True)
+            u_ids, u_mask = uniq[:, :length], uniq[:, length:]
+        else:
+            u_ids, u_mask, inverse = dedup
+        if u_ids.shape[0] <= n_rows // 2:
+            # pad the unique set to whole encoder chunks: every chunk shares
+            # one compiled shape (the pad rows are never gathered back)
+            pad = (-u_ids.shape[0]) % min(batch_size, max(u_ids.shape[0], 1))
+            if pad:
+                u_ids = np.concatenate([u_ids, np.zeros((pad, length), u_ids.dtype)])
+                u_mask = np.concatenate([u_mask, np.zeros((pad, length), u_mask.dtype)])
+            outs = _embed(u_ids, u_mask)
+            inverse = np.asarray(inverse, dtype=np.int32)
+        else:
+            outs = _embed(np.concatenate([pred_ids, tgt_ids], axis=0),
+                          np.concatenate([pred_mask, tgt_mask], axis=0))
+            inverse = np.arange(n_rows, dtype=np.int32)
         prf = _score_embeddings_packed(
-            tuple(outs), jnp.asarray(pred_mask), jnp.asarray(tgt_mask), pred_w, tgt_w
+            tuple(outs), jnp.asarray(inverse),
+            jnp.asarray(pred_mask), jnp.asarray(tgt_mask), pred_w, tgt_w,
         )
     else:
         pred_emb = jnp.concatenate(_embed(pred_ids, pred_mask), axis=0)
@@ -252,13 +284,20 @@ def _score_embeddings_unfused(
 @jax.jit
 def _score_embeddings_packed(
     emb_batches: Tuple[Array, ...],
+    inverse: Array,
     pred_mask: Array,
     target_mask: Array,
     pred_weights: Optional[Array],
     target_weights: Optional[Array],
 ) -> Array:
-    """Fuse concat/split/matching into one compiled call returning (3, N)."""
-    all_emb = jnp.concatenate(emb_batches, axis=0) if len(emb_batches) > 1 else emb_batches[0]
+    """Fuse gather/split/matching into one compiled call returning (3, N).
+
+    ``inverse`` maps each pred/ref row to its embedding row — an identity
+    arange for a fully-unique corpus (XLA folds the identity gather away), or
+    the dedup mapping when distinct rows were encoded once.
+    """
+    emb_u = jnp.concatenate(emb_batches, axis=0) if len(emb_batches) > 1 else emb_batches[0]
+    all_emb = emb_u[inverse]
     n_pred = pred_mask.shape[0]
     p, r, f1 = _bert_score_from_embeddings(
         all_emb[:n_pred], pred_mask, all_emb[n_pred:], target_mask, pred_weights, target_weights
@@ -344,19 +383,31 @@ def bert_score(
         raise ValueError("Number of predicted and reference sentences must be the same!")
     baseline_path = _resolve_baseline_path(rescale_with_baseline, baseline_path, baseline_url)
 
-    # ---- tokenize (host)
+    # ---- tokenize (host): each DISTINCT sentence once — corpora with shared
+    # references / repeated candidates pay the tokenizer per unique text, and
+    # one pooled call gives both sides a common padded geometry (fused path)
+    texts = list(predictions) + list(references)
+    uniq_of: Dict[str, int] = {}
+    inverse = np.empty(len(texts), dtype=np.int64)
+    uniq_texts: List[str] = []
+    for i, s in enumerate(texts):
+        j = uniq_of.setdefault(s, len(uniq_texts))
+        if j == len(uniq_texts):
+            uniq_texts.append(s)
+        inverse[i] = j
     if user_tokenizer is not None:
-        enc_pred = user_tokenizer(predictions, max_length)
-        enc_tgt = user_tokenizer(references, max_length)
+        enc = user_tokenizer(uniq_texts, max_length)
     else:
-        enc_pred = _simple_whitespace_tokenizer(predictions, max_length)
-        enc_tgt = _simple_whitespace_tokenizer(references, max_length)
-    pred_ids, pred_mask = np.asarray(enc_pred["input_ids"]), np.asarray(enc_pred["attention_mask"])
-    tgt_ids, tgt_mask = np.asarray(enc_tgt["input_ids"]), np.asarray(enc_tgt["attention_mask"])
+        enc = _simple_whitespace_tokenizer(uniq_texts, max_length)
+    ids_u, mask_u = np.asarray(enc["input_ids"]), np.asarray(enc["attention_mask"])
+    n = len(predictions)
+    pred_ids, pred_mask = ids_u[inverse[:n]], mask_u[inverse[:n]]
+    tgt_ids, tgt_mask = ids_u[inverse[n:]], mask_u[inverse[n:]]
 
     forward = _resolve_forward(user_forward_fn, model, model_name_or_path)
     precision, recall, f1 = _score_tokenized(
-        forward, pred_ids, pred_mask, tgt_ids, tgt_mask, idf=idf, batch_size=batch_size
+        forward, pred_ids, pred_mask, tgt_ids, tgt_mask, idf=idf, batch_size=batch_size,
+        dedup=(ids_u, mask_u, inverse),  # text-level structure, computed above
     )
 
     if rescale_with_baseline:
