@@ -22,14 +22,27 @@ Array = jax.Array
 def _confusion_matrix_update(
     preds: Array, target: Array, num_classes: int, threshold: float = 0.5, multilabel: bool = False
 ) -> Array:
-    preds, target, mode = _input_format_classification(preds, target, threshold)
-    if mode not in (DataType.BINARY, DataType.MULTILABEL):
-        preds = jnp.argmax(preds, axis=1)
-        target = jnp.argmax(target, axis=1)
+    preds = jnp.asarray(preds)
+    # integer label inputs cannot infer the class count from data under jit —
+    # forward the ctor's num_classes as the formatter hint. Float inputs must
+    # NOT get it: the CM meaning of num_classes=2 is a 2x2 matrix over BINARY
+    # data, which the formatter would reject as a 2-class hint. The one-hot
+    # detour integer hints take yields identical bincounts.
+    is_int = not jnp.issubdtype(preds.dtype, jnp.floating)
+    preds, target, mode = _input_format_classification(
+        preds, target, threshold,
+        num_classes=num_classes if is_int else None,
+        multiclass=False if (multilabel and is_int) else None,
+    )
     if multilabel:
+        # user-declared multilabel layout: the canonical (N, C) indicators ARE
+        # the per-label predictions — argmax would collapse them to one class
         unique_mapping = jnp.ravel(2 * target + preds + 4 * jnp.arange(num_classes))
         minlength = 4 * num_classes
     else:
+        if mode not in (DataType.BINARY, DataType.MULTILABEL):
+            preds = jnp.argmax(preds, axis=1)
+            target = jnp.argmax(target, axis=1)
         unique_mapping = jnp.ravel(target) * num_classes + jnp.ravel(preds)
         minlength = num_classes ** 2
 

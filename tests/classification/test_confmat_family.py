@@ -9,46 +9,85 @@ from sklearn.metrics import jaccard_score, matthews_corrcoef as sk_matthews
 
 from metrics_tpu import CohenKappa, ConfusionMatrix, JaccardIndex, MatthewsCorrCoef
 from metrics_tpu.functional import cohen_kappa, confusion_matrix, jaccard_index, matthews_corrcoef
-from tests.classification.inputs import _input_multiclass, _input_multiclass_prob
-from tests.helpers.testers import NUM_CLASSES, MetricTester
+from tests.classification.inputs import (
+    _input_binary,
+    _input_binary_prob,
+    _input_multiclass,
+    _input_multiclass_logits,
+    _input_multiclass_prob,
+    _input_multidim_multiclass,
+    _input_multidim_multiclass_prob,
+    _input_multilabel,
+    _input_multilabel_prob,
+)
+from tests.helpers.testers import NUM_CLASSES, THRESHOLD, MetricTester
 
 
 def _to_labels(preds):
+    """Canonical hard labels for any fixture layout: binary probs threshold,
+    (N, C[, X]) probs/logits argmax over the class axis, ints pass through."""
     p = np.asarray(preds)
-    return p.argmax(axis=-1) if p.ndim > 1 and p.dtype.kind == "f" else p
+    if p.dtype.kind != "f":
+        return p
+    if p.ndim == 1:
+        return (p >= THRESHOLD).astype(np.int64)
+    axis = 1 if p.ndim == 3 else -1
+    return p.argmax(axis=axis)
 
 
-def _sk_cm(preds, target, normalize=None):
-    return sk_confusion_matrix(np.asarray(target).ravel(), _to_labels(preds).ravel(),
-                               labels=list(range(NUM_CLASSES)), normalize=normalize)
+def _family_nc(inputs):
+    p = np.asarray(inputs.preds)
+    if p.ndim == 2 and (p.dtype.kind == "f" or p.max() <= 1):
+        return 2  # binary: 2x2 confusion matrix
+    return NUM_CLASSES
 
 
-def _sk_jaccard(preds, target):
-    return jaccard_score(np.asarray(target).ravel(), _to_labels(preds).ravel(),
-                         labels=list(range(NUM_CLASSES)), average="macro")
+# binary / multiclass prob+logit+label / multidim-multiclass — the reference's
+# confusion-matrix-family case breadth (``tests/classification/test_confusion_matrix.py``)
+_family_inputs = [
+    pytest.param(_input_binary_prob, id="binary_prob"),
+    pytest.param(_input_binary, id="binary_labels"),
+    pytest.param(_input_multiclass_prob, id="mc_prob"),
+    pytest.param(_input_multiclass_logits, id="mc_logits"),
+    pytest.param(_input_multiclass, id="mc_labels"),
+    pytest.param(_input_multidim_multiclass_prob, id="mdmc_prob"),
+    pytest.param(_input_multidim_multiclass, id="mdmc_labels"),
+]
+
+
+def _sk_cm(preds, target, normalize=None, nc=NUM_CLASSES):
+    return sk_confusion_matrix(np.asarray(target).ravel(), np.asarray(_to_labels(preds)).ravel(),
+                               labels=list(range(nc)), normalize=normalize)
+
+
+def _sk_jaccard(preds, target, nc=NUM_CLASSES):
+    return jaccard_score(np.asarray(target).ravel(), np.asarray(_to_labels(preds)).ravel(),
+                         labels=list(range(nc)), average="macro")
 
 
 def _sk_kappa(preds, target, weights=None):
-    return cohen_kappa_score(np.asarray(target).ravel(), _to_labels(preds).ravel(), weights=weights)
+    return cohen_kappa_score(np.asarray(target).ravel(), np.asarray(_to_labels(preds)).ravel(), weights=weights)
 
 
 def _sk_mcc(preds, target):
-    return sk_matthews(np.asarray(target).ravel(), _to_labels(preds).ravel())
+    return sk_matthews(np.asarray(target).ravel(), np.asarray(_to_labels(preds)).ravel())
 
 
 class TestConfusionMatrix(MetricTester):
     atol = 1e-6
 
+    @pytest.mark.parametrize("inputs", _family_inputs)
     @pytest.mark.parametrize("normalize", [None, "true", "pred", "all"])
     @pytest.mark.parametrize("ddp", [False, True])
-    def test_class(self, normalize, ddp):
+    def test_class(self, inputs, normalize, ddp):
+        nc = _family_nc(inputs)
         self.run_class_metric_test(
             ddp=ddp,
-            preds=_input_multiclass_prob.preds,
-            target=_input_multiclass_prob.target,
+            preds=inputs.preds,
+            target=inputs.target,
             metric_class=ConfusionMatrix,
-            sk_metric=lambda p, t: _sk_cm(p, t, normalize),
-            metric_args={"num_classes": NUM_CLASSES, "normalize": normalize},
+            sk_metric=lambda p, t: _sk_cm(p, t, normalize, nc),
+            metric_args={"num_classes": nc, "normalize": normalize, "threshold": THRESHOLD},
             check_batch=False,
         )
 
@@ -65,15 +104,17 @@ class TestConfusionMatrix(MetricTester):
 class TestJaccard(MetricTester):
     atol = 1e-6
 
+    @pytest.mark.parametrize("inputs", _family_inputs)
     @pytest.mark.parametrize("ddp", [False, True])
-    def test_class(self, ddp):
+    def test_class(self, inputs, ddp):
+        nc = _family_nc(inputs)
         self.run_class_metric_test(
             ddp=ddp,
-            preds=_input_multiclass_prob.preds,
-            target=_input_multiclass_prob.target,
+            preds=inputs.preds,
+            target=inputs.target,
             metric_class=JaccardIndex,
-            sk_metric=_sk_jaccard,
-            metric_args={"num_classes": NUM_CLASSES},
+            sk_metric=lambda p, t: _sk_jaccard(p, t, nc),
+            metric_args={"num_classes": nc, "threshold": THRESHOLD},
             check_batch=False,
         )
 
@@ -90,16 +131,17 @@ class TestJaccard(MetricTester):
 class TestCohenKappa(MetricTester):
     atol = 1e-6
 
+    @pytest.mark.parametrize("inputs", _family_inputs)
     @pytest.mark.parametrize("weights", [None, "linear", "quadratic"])
     @pytest.mark.parametrize("ddp", [False, True])
-    def test_class(self, weights, ddp):
+    def test_class(self, inputs, weights, ddp):
         self.run_class_metric_test(
             ddp=ddp,
-            preds=_input_multiclass_prob.preds,
-            target=_input_multiclass_prob.target,
+            preds=inputs.preds,
+            target=inputs.target,
             metric_class=CohenKappa,
             sk_metric=lambda p, t: _sk_kappa(p, t, weights),
-            metric_args={"num_classes": NUM_CLASSES, "weights": weights},
+            metric_args={"num_classes": _family_nc(inputs), "weights": weights, "threshold": THRESHOLD},
             check_batch=False,
         )
 
@@ -116,15 +158,16 @@ class TestCohenKappa(MetricTester):
 class TestMatthews(MetricTester):
     atol = 1e-6
 
+    @pytest.mark.parametrize("inputs", _family_inputs)
     @pytest.mark.parametrize("ddp", [False, True])
-    def test_class(self, ddp):
+    def test_class(self, inputs, ddp):
         self.run_class_metric_test(
             ddp=ddp,
-            preds=_input_multiclass_prob.preds,
-            target=_input_multiclass_prob.target,
+            preds=inputs.preds,
+            target=inputs.target,
             metric_class=MatthewsCorrCoef,
             sk_metric=_sk_mcc,
-            metric_args={"num_classes": NUM_CLASSES},
+            metric_args={"num_classes": _family_nc(inputs), "threshold": THRESHOLD},
             check_batch=False,
         )
 
@@ -135,4 +178,37 @@ class TestMatthews(MetricTester):
             metric_functional=matthews_corrcoef,
             sk_metric=_sk_mcc,
             metric_args={"num_classes": NUM_CLASSES},
+        )
+
+
+class TestConfusionMatrixMultilabel(MetricTester):
+    """multilabel=True returns (C, 2, 2) per-label matrices — sklearn's
+    multilabel_confusion_matrix layout (previously untested)."""
+
+    atol = 1e-6
+
+    @pytest.mark.parametrize(
+        "inputs",
+        [
+            pytest.param(_input_multilabel_prob, id="ml_prob"),
+            pytest.param(_input_multilabel, id="ml_labels"),
+        ],
+    )
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, inputs, ddp):
+        from sklearn.metrics import multilabel_confusion_matrix
+
+        def sk(p, t):
+            p = np.asarray(p)
+            hard = (p >= THRESHOLD).astype(np.int64) if p.dtype.kind == "f" else p
+            return multilabel_confusion_matrix(np.asarray(t), hard)
+
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=inputs.preds,
+            target=inputs.target,
+            metric_class=ConfusionMatrix,
+            sk_metric=sk,
+            metric_args={"num_classes": NUM_CLASSES, "multilabel": True, "threshold": THRESHOLD},
+            check_batch=False,
         )
