@@ -86,6 +86,33 @@ class CapacityCurveStateMixin:
         self.overflow = self.overflow + (start + n > self.capacity).astype(jnp.int32)
         self.count = jnp.minimum(start + n, self.capacity)
 
+    def _capacity_curve_write(self, preds: Array, target: Array) -> None:
+        """Shared update path for curve metrics: validate the declared layout
+        against the canonicalized batch, one-hot multiclass labels, write."""
+        from metrics_tpu.utils.data import to_onehot
+
+        c = self._capacity_num_columns()
+        if (preds.ndim == 1) != (c is None):
+            raise ValueError(
+                f"Static-capacity {type(self).__name__} needs `num_classes` matching the data:"
+                f" leave it unset/1 for binary inputs, set it to C for multiclass — got"
+                f" num_classes={self.num_classes} with preds of shape {preds.shape}"
+            )
+        if c and target.ndim == 1:
+            target = to_onehot(target, c)
+        self._capacity_write(preds, target)
+
+    def _compute_capacity_curve_with(self, kernel):
+        """Dispatch a 3-output curve kernel over the shared buffer layout:
+        per-column vmap for declared multiclass, plain call otherwise."""
+        if self._capacity_num_columns():
+            a, b, c = jax.vmap(
+                lambda p_col, t_col: kernel(p_col, t_col, self.valid_buf), in_axes=(1, 1)
+            )(self.preds_buf, self.target_buf)
+        else:
+            a, b, c = kernel(self.preds_buf, self.target_buf, self.valid_buf)
+        return self._capacity_guard_nan(a), self._capacity_guard_nan(b), self._capacity_guard_nan(c)
+
     def _capacity_guard_nan(self, value: Array) -> Array:
         """Warn eagerly on overflow; mask the result to NaN either way."""
         from metrics_tpu.utils.checks import _is_tracer

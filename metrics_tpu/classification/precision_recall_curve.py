@@ -1,11 +1,16 @@
 """PrecisionRecallCurve module metric.
 
 Parity: reference ``torchmetrics/classification/precision_recall_curve.py:28``.
+Like ``ROC``, an opt-in ``capacity=N`` computes the EXACT curve fully inside
+jit/shard_map with fixed-length outputs: tie-group interiors interpolate the
+cumulative counts linearly (the standard PR interpolation), group endpoints
+are exact, padding repeats the final point (``ops/masked_curves.py``).
 """
 from typing import Any, List, Optional, Tuple, Union
 
 import jax
 
+from metrics_tpu.classification._capacity import CapacityCurveStateMixin
 from metrics_tpu.functional.classification.precision_recall_curve import (
     _precision_recall_curve_compute,
     _precision_recall_curve_update,
@@ -16,7 +21,7 @@ from metrics_tpu.utils.data import dim_zero_cat
 Array = jax.Array
 
 
-class PrecisionRecallCurve(Metric):
+class PrecisionRecallCurve(CapacityCurveStateMixin, Metric):
     """Precision-recall pairs at distinct thresholds."""
 
     is_differentiable = False
@@ -26,26 +31,42 @@ class PrecisionRecallCurve(Metric):
         self,
         num_classes: Optional[int] = None,
         pos_label: Optional[int] = None,
+        capacity: Optional[int] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
         self.num_classes = num_classes
         self.pos_label = pos_label
-        self.add_state("preds", default=[], dist_reduce_fx="cat")
-        self.add_state("target", default=[], dist_reduce_fx="cat")
+        self.capacity = capacity
+        if capacity is None:
+            self.add_state("preds", default=[], dist_reduce_fx="cat")
+            self.add_state("target", default=[], dist_reduce_fx="cat")
+        else:
+            self._validate_capacity_kwargs(pos_label, None)  # curves average nothing
+            self._init_capacity_states()
 
     def update(self, preds: Array, target: Array) -> None:
         preds, target, num_classes, pos_label = _precision_recall_curve_update(
             preds, target, self.num_classes, self.pos_label
         )
-        self.preds.append(preds)
-        self.target.append(target)
-        self.num_classes = num_classes
-        self.pos_label = pos_label
+        if self.capacity is None:
+            self.preds.append(preds)
+            self.target.append(target)
+            self.num_classes = num_classes
+            self.pos_label = pos_label
+            return
+        self._capacity_curve_write(preds, target)
 
     def compute(self) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+        if self.capacity is not None:
+            return self._compute_capacity()
         preds = dim_zero_cat(self.preds)
         target = dim_zero_cat(self.target)
         if not self.num_classes:
             raise ValueError(f"`num_classes` bas to be positive number, but got {self.num_classes}")
         return _precision_recall_curve_compute(preds, target, self.num_classes, self.pos_label)
+
+    def _compute_capacity(self) -> Tuple[Array, Array, Array]:
+        from metrics_tpu.ops.masked_curves import masked_binary_pr_curve
+
+        return self._compute_capacity_curve_with(masked_binary_pr_curve)

@@ -1,11 +1,18 @@
 """ROC module metric.
 
-Parity: reference ``torchmetrics/classification/roc.py:24``.
+Parity: reference ``torchmetrics/classification/roc.py:24``. Like ``AUROC``,
+an opt-in ``capacity=N`` switches to SURVEY §7.1's static-capacity state so the
+EXACT curve computes fully inside jit/shard_map: outputs are fixed-length
+``(capacity+1,)`` arrays (per class: ``(C, capacity+1)``) whose points overlay
+the classic distinct-threshold curve — tie-group interiors are collinear
+interpolations, padding repeats the final point — so trapezoid integration and
+plotting match the eager curve exactly (``ops/masked_curves.py``).
 """
 from typing import Any, List, Optional, Tuple, Union
 
 import jax
 
+from metrics_tpu.classification._capacity import CapacityCurveStateMixin
 from metrics_tpu.functional.classification.roc import _roc_compute, _roc_update
 from metrics_tpu.metric import Metric
 from metrics_tpu.utils.data import dim_zero_cat
@@ -13,7 +20,7 @@ from metrics_tpu.utils.data import dim_zero_cat
 Array = jax.Array
 
 
-class ROC(Metric):
+class ROC(CapacityCurveStateMixin, Metric):
     """Receiver operating characteristic curve."""
 
     is_differentiable = False
@@ -23,24 +30,40 @@ class ROC(Metric):
         self,
         num_classes: Optional[int] = None,
         pos_label: Optional[int] = None,
+        capacity: Optional[int] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
         self.num_classes = num_classes
         self.pos_label = pos_label
-        self.add_state("preds", default=[], dist_reduce_fx="cat")
-        self.add_state("target", default=[], dist_reduce_fx="cat")
+        self.capacity = capacity
+        if capacity is None:
+            self.add_state("preds", default=[], dist_reduce_fx="cat")
+            self.add_state("target", default=[], dist_reduce_fx="cat")
+        else:
+            self._validate_capacity_kwargs(pos_label, None)  # curves average nothing
+            self._init_capacity_states()
 
     def update(self, preds: Array, target: Array) -> None:
         preds, target, num_classes, pos_label = _roc_update(preds, target, self.num_classes, self.pos_label)
-        self.preds.append(preds)
-        self.target.append(target)
-        self.num_classes = num_classes
-        self.pos_label = pos_label
+        if self.capacity is None:
+            self.preds.append(preds)
+            self.target.append(target)
+            self.num_classes = num_classes
+            self.pos_label = pos_label
+            return
+        self._capacity_curve_write(preds, target)
 
     def compute(self) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+        if self.capacity is not None:
+            return self._compute_capacity()
         preds = dim_zero_cat(self.preds)
         target = dim_zero_cat(self.target)
         if not self.num_classes:
             raise ValueError(f"`num_classes` bas to be positive number, but got {self.num_classes}")
         return _roc_compute(preds, target, self.num_classes, self.pos_label)
+
+    def _compute_capacity(self) -> Tuple[Array, Array, Array]:
+        from metrics_tpu.ops.masked_curves import masked_binary_roc
+
+        return self._compute_capacity_curve_with(masked_binary_roc)

@@ -16,7 +16,7 @@ Degenerate inputs (single-class) return NaN — in-trace code cannot raise, and
 NaN is the documented sentinel the eager path's error maps to.
 """
 from functools import partial
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -82,6 +82,89 @@ def masked_binary_average_precision(scores: Array, labels: Array, valid: Array) 
     contrib = jnp.where(end & v, run_tp * prec, 0.0)
     p_total = jnp.sum(t)
     return jnp.where(p_total > 0, jnp.sum(contrib) / jnp.maximum(p_total, 1.0), jnp.nan)
+
+
+def _masked_clf_curve(scores: Array, labels: Array, valid: Array) -> Tuple[Array, Array, Array]:
+    """Per-position cumulative ``(fps, tps, thresholds)`` in descending-score
+    order over the valid entries of a capacity buffer — the static-shape
+    ``_binary_clf_curve``.
+
+    The classic curve emits one point per DISTINCT threshold (data-dependent
+    length). Here every buffer slot emits a point, with tie-group interiors
+    linearly interpolated between the group's endpoints in COUNT space
+    (fps/tps). For ROC that makes the interior points collinear with the
+    dedup'd curve (fpr/tpr are linear in the counts), so trapezoid integration
+    is identical; PR precision is a ratio of counts, so its interiors follow
+    the count-interpolated curve while group endpoints stay exact. Invalid
+    slots repeat the final totals with the lowest valid threshold.
+    """
+    n = scores.shape[0]
+    f32 = jnp.float32
+    keys = jnp.where(valid, scores.astype(f32), -jnp.inf)
+    order = jnp.argsort(-keys, stable=True)
+    s = keys[order]
+    v = valid[order].astype(f32)
+    t = jnp.where(v > 0, (labels[order] > 0).astype(f32), 0.0)
+    w = v - t  # negatives
+    tps_raw = jnp.cumsum(t)
+    fps_raw = jnp.cumsum(w)
+    pos = jnp.arange(n)
+    start = jnp.concatenate([jnp.ones((1,), bool), s[1:] != s[:-1]])
+    seg = jnp.cumsum(start) - 1
+    seg_start = jax.lax.cummax(jnp.where(start, pos, 0))
+    sum_seg = partial(jax.ops.segment_sum, segment_ids=seg, num_segments=n)
+    grp_tp = sum_seg(t)[seg]
+    grp_fp = sum_seg(w)[seg]
+    grp_len = sum_seg(jnp.ones_like(t))[seg]
+    tp_end = jax.ops.segment_max(tps_raw, seg, num_segments=n)[seg]
+    fp_end = jax.ops.segment_max(fps_raw, seg, num_segments=n)[seg]
+    frac = (pos - seg_start + 1).astype(f32) / jnp.maximum(grp_len, 1.0)
+    tps = (tp_end - grp_tp) + frac * grp_tp
+    fps = (fp_end - grp_fp) + frac * grp_fp
+    lowest = jnp.min(jnp.where(valid, scores.astype(f32), jnp.inf))
+    thresholds = jnp.where(jnp.isfinite(s), s, lowest)
+    return fps, tps, thresholds
+
+
+def masked_binary_roc(scores: Array, labels: Array, valid: Array) -> Tuple[Array, Array, Array]:
+    """Static-shape exact ROC: ``(fpr, tpr, thresholds)``, each ``(n+1,)``.
+
+    Point order and the prepended origin follow the eager path
+    (``functional/classification/roc.py``); a class with no positives (or no
+    negatives) yields a zero tpr (fpr) like the reference, without the eager
+    warning (in-trace code cannot warn).
+    """
+    fps, tps, thresholds = _masked_clf_curve(scores, labels, valid)
+    tps = jnp.concatenate([jnp.zeros(1, tps.dtype), tps])
+    fps = jnp.concatenate([jnp.zeros(1, fps.dtype), fps])
+    thresholds = jnp.concatenate([thresholds[0:1] + 1, thresholds])
+    fpr = jnp.where(fps[-1] > 0, fps / jnp.maximum(fps[-1], 1.0), jnp.zeros_like(fps))
+    tpr = jnp.where(tps[-1] > 0, tps / jnp.maximum(tps[-1], 1.0), jnp.zeros_like(tps))
+    return fpr, tpr, thresholds
+
+
+def masked_binary_pr_curve(scores: Array, labels: Array, valid: Array) -> Tuple[Array, Array, Array]:
+    """Static-shape exact PR curve: ``(precision, recall, thresholds)`` of
+    lengths ``(n+1, n+1, n)`` in the eager path's layout — recall
+    non-increasing, thresholds ascending, final ``(precision=1, recall=0)``
+    point appended (reference ``precision_recall_curve.py`` reverses the
+    descending-score scan the same way).
+
+    Tie-group ENDPOINTS are exact (they are the classic distinct-threshold
+    points); tie-group interiors interpolate the cumulative counts linearly —
+    the standard PR count-interpolation, which is NOT a straight line in
+    (recall, precision) space. Step/AP integration from the endpoints is
+    unchanged; a trapezoid over all points follows the count-interpolated
+    curve, not the chord between endpoints. Padding slots repeat the
+    full-recall endpoint at the low-threshold end.
+    """
+    fps, tps, thresholds = _masked_clf_curve(scores, labels, valid)
+    precision = tps / jnp.maximum(tps + fps, 1e-38)
+    p_total = tps[-1]
+    recall = jnp.where(p_total > 0, tps / jnp.maximum(p_total, 1.0), jnp.ones_like(tps))
+    precision = jnp.concatenate([precision[::-1], jnp.ones(1, precision.dtype)])
+    recall = jnp.concatenate([recall[::-1], jnp.zeros(1, recall.dtype)])
+    return precision, recall, thresholds[::-1]
 
 
 def average_per_class(per_class: Array, support: Array, average: Optional[str]) -> Array:

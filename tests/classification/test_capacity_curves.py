@@ -224,3 +224,121 @@ class TestCapacityInTrace:
             labels.ravel(), probs.reshape(-1, c), multi_class="ovr", average="macro", labels=list(range(c))
         )
         np.testing.assert_allclose(float(out), expected, atol=oracle_atol())
+
+
+class TestCapacityCurves:
+    """ROC/PrecisionRecallCurve capacity mode: fixed-length exact curves."""
+
+    def test_roc_overlays_sklearn_curve(self):
+        from sklearn.metrics import roc_auc_score, roc_curve
+
+        from metrics_tpu import ROC
+
+        rng = np.random.RandomState(0)
+        p = np.round(rng.rand(37), 1).astype(np.float32)  # heavy ties
+        t = rng.randint(0, 2, 37)
+        t[0], t[1] = 1, 0
+        m = ROC(capacity=64)
+        m.update(jnp.asarray(p[:20]), jnp.asarray(t[:20]))
+        m.update(jnp.asarray(p[20:]), jnp.asarray(t[20:]))
+        fpr, tpr, th = (np.asarray(x, dtype=np.float64) for x in m.compute())
+        assert fpr.shape == (65,)
+        # trapezoid over the fixed points == exact AUROC (collinear interiors)
+        np.testing.assert_allclose(np.trapezoid(tpr, fpr), roc_auc_score(t, p), atol=1e-6)
+        # every distinct-threshold point of the classic curve appears
+        sk_fpr, sk_tpr, _ = roc_curve(t, p, drop_intermediate=False)
+        pts = {(round(a, 5), round(b, 5)) for a, b in zip(fpr, tpr)}
+        for q in zip(np.round(sk_fpr, 5), np.round(sk_tpr, 5)):
+            assert q in pts, q
+        # monotone non-decreasing in both axes
+        assert np.all(np.diff(fpr) >= -1e-7) and np.all(np.diff(tpr) >= -1e-7)
+
+    def test_pr_curve_matches_sklearn_and_eager_layout(self):
+        from sklearn.metrics import precision_recall_curve as sk_prc
+
+        from metrics_tpu import PrecisionRecallCurve
+
+        rng = np.random.RandomState(1)
+        p = np.round(rng.rand(30), 1).astype(np.float32)
+        t = rng.randint(0, 2, 30)
+        t[0], t[1] = 1, 0
+        m = PrecisionRecallCurve(capacity=48)
+        m.update(jnp.asarray(p), jnp.asarray(t))
+        prec, rec, th = (np.asarray(x, dtype=np.float64) for x in m.compute())
+        assert prec.shape == (49,) and rec.shape == (49,) and th.shape == (48,)
+        # the documented eager layout: recall non-increasing, thresholds ascending
+        assert np.all(np.diff(rec) <= 1e-7), rec
+        assert np.all(np.diff(th) >= -1e-7), th
+        assert prec[-1] == 1.0 and rec[-1] == 0.0
+        sk_p, sk_r, _ = sk_prc(t, p)
+        pts = {(round(a, 5), round(b, 5)) for a, b in zip(prec, rec)}
+        for q in zip(np.round(sk_p, 5), np.round(sk_r, 5)):
+            assert q in pts, q
+        # and the classic (distinct-threshold) points appear in the SAME order
+        # they hold in the eager curve
+        eager = PrecisionRecallCurve()
+        eager.update(jnp.asarray(p), jnp.asarray(t))
+        e_prec, e_rec, e_th = (np.asarray(x, np.float64) for x in eager.compute())
+        fixed_pts = [(round(a, 5), round(b, 5)) for a, b in zip(prec, rec)]
+        idxs = [fixed_pts.index((round(a, 5), round(b, 5))) for a, b in zip(e_prec, e_rec)]
+        assert idxs == sorted(idxs), idxs
+
+    def test_multiclass_roc_stacked(self):
+        from sklearn.metrics import roc_auc_score
+
+        from metrics_tpu import ROC
+
+        rng = np.random.RandomState(2)
+        n, c = 40, 3
+        probs = rng.rand(n, c).astype(np.float32)
+        probs /= probs.sum(1, keepdims=True)
+        labels = rng.randint(0, c, n)
+        labels[:c] = np.arange(c)
+        m = ROC(num_classes=c, capacity=64)
+        m.update(jnp.asarray(probs), jnp.asarray(labels))
+        fpr, tpr, th = (np.asarray(x, dtype=np.float64) for x in m.compute())
+        assert fpr.shape == (c, 65)
+        onehot = np.eye(c)[labels]
+        for k in range(c):
+            np.testing.assert_allclose(
+                np.trapezoid(tpr[k], fpr[k]), roc_auc_score(onehot[:, k], probs[:, k]), atol=1e-6
+            )
+
+    def test_roc_fully_in_trace_on_mesh(self, devices):
+        from sklearn.metrics import roc_auc_score
+
+        from metrics_tpu import ROC
+
+        n_dev, per_dev = 8, 12
+        rng = np.random.RandomState(3)
+        preds = np.round(rng.rand(n_dev, per_dev), 1).astype(np.float32)
+        target = rng.randint(0, 2, (n_dev, per_dev))
+        target[:, 0], target[:, 1] = 1, 0
+        m = ROC(capacity=16)
+        mesh = Mesh(np.asarray(mesh_devices()), ("dp",))
+
+        @jax.jit
+        @partial(jax.shard_map, mesh=mesh, in_specs=(P("dp"), P("dp")), out_specs=(P(), P(), P()), check_vma=False)
+        def run(p, t):
+            state = m.init_state()
+            state = m.update_state(state, p[0], t[0])
+            synced = m.sync_states(state, "dp")
+            return m.compute_from(synced)
+
+        fpr, tpr, th = run(jnp.asarray(preds), jnp.asarray(target))
+        assert np.asarray(fpr).shape == (8 * 16 + 1,)
+        np.testing.assert_allclose(
+            np.trapezoid(np.asarray(tpr, np.float64), np.asarray(fpr, np.float64)),
+            roc_auc_score(target.ravel(), preds.ravel()),
+            atol=1e-6,
+        )
+
+    def test_curve_overflow_nan(self):
+        from metrics_tpu import PrecisionRecallCurve
+
+        m = PrecisionRecallCurve(capacity=4)
+        with pytest.warns(UserWarning, match="overflowed"):
+            m.update(jnp.asarray([0.1, 0.9, 0.5]), jnp.asarray([0, 1, 1]))
+            m.update(jnp.asarray([0.2, 0.8]), jnp.asarray([1, 0]))
+            prec, rec, th = m.compute()
+            assert np.all(np.isnan(np.asarray(prec)))
