@@ -86,6 +86,24 @@ class CapacityCurveStateMixin:
         self.overflow = self.overflow + (start + n > self.capacity).astype(jnp.int32)
         self.count = jnp.minimum(start + n, self.capacity)
 
+    def _capacity_curve_precheck(self, preds: Array) -> None:
+        """Friendly layout check on the RAW inputs, before canonicalization
+        (whose multilabel branch would otherwise crash with a bare IndexError
+        on mismatched shapes)."""
+        c = self._capacity_num_columns()
+        nd = jnp.ndim(preds)
+        if c is not None and nd < 2:
+            raise ValueError(
+                f"Static-capacity {type(self).__name__} needs `num_classes` matching the data:"
+                f" num_classes={self.num_classes} expects (N, {self.num_classes}) scores, got"
+                f" shape {jnp.shape(preds)} — leave num_classes unset/1 for binary inputs"
+            )
+        if c is None and nd > 1:
+            raise ValueError(
+                f"Static-capacity {type(self).__name__} needs `num_classes` matching the data:"
+                f" multi-column scores of shape {jnp.shape(preds)} need num_classes=C"
+            )
+
     def _capacity_curve_write(self, preds: Array, target: Array) -> None:
         """Shared update path for curve metrics: validate the declared layout
         against the canonicalized batch, one-hot multiclass labels, write."""

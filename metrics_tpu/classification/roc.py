@@ -45,6 +45,8 @@ class ROC(CapacityCurveStateMixin, Metric):
             self._init_capacity_states()
 
     def update(self, preds: Array, target: Array) -> None:
+        if self.capacity is not None:
+            self._capacity_curve_precheck(preds)
         preds, target, num_classes, pos_label = _roc_update(preds, target, self.num_classes, self.pos_label)
         if self.capacity is None:
             self.preds.append(preds)

@@ -24,6 +24,22 @@ import jax.numpy as jnp
 Array = jax.Array
 
 
+def _tie_segments(s: Array) -> Tuple[Array, Array]:
+    """(group-start mask, segment ids) for runs of equal values in sorted ``s``."""
+    start = jnp.concatenate([jnp.ones((1,), bool), s[1:] != s[:-1]])
+    return start, jnp.cumsum(start) - 1
+
+
+def _desc_sorted(scores: Array, labels: Array, valid: Array) -> Tuple[Array, Array, Array]:
+    """Descending-score sort with invalid entries last: returns (scores,
+    valid, positive-indicator), each sorted, as f32/bool/f32."""
+    keys = jnp.where(valid, scores.astype(jnp.float32), -jnp.inf)
+    order = jnp.argsort(-keys, stable=True)
+    v = valid[order]
+    t = jnp.where(v, (labels[order] > 0).astype(jnp.float32), 0.0)
+    return keys[order], v, t
+
+
 def _masked_average_ranks(scores: Array, valid: Array) -> Array:
     """1-based average ranks (ascending) among valid entries; 0 for invalid.
 
@@ -36,8 +52,7 @@ def _masked_average_ranks(scores: Array, valid: Array) -> Array:
     s = keys[order]
     v = valid[order]
     pos = jnp.arange(1, n + 1, dtype=jnp.float32)
-    start = jnp.concatenate([jnp.ones((1,), bool), s[1:] != s[:-1]])
-    seg = jnp.cumsum(start) - 1
+    _, seg = _tie_segments(s)
     sum_pos = jax.ops.segment_sum(jnp.where(v, pos, 0.0), seg, num_segments=n)
     cnt = jax.ops.segment_sum(v.astype(jnp.float32), seg, num_segments=n)
     avg = sum_pos / jnp.maximum(cnt, 1.0)
@@ -66,16 +81,11 @@ def masked_binary_average_precision(scores: Array, labels: Array, valid: Array) 
     over the valid entries of a capacity buffer. NaN when no positives."""
     n = scores.shape[0]
     valid = valid.astype(bool)
-    keys = jnp.where(valid, scores.astype(jnp.float32), -jnp.inf)  # invalid last
-    order = jnp.argsort(-keys, stable=True)
-    s = keys[order]
-    v = valid[order]
-    t = jnp.where(v, (labels[order] > 0).astype(jnp.float32), 0.0)
+    s, v, t = _desc_sorted(scores, labels, valid)
     tp = jnp.cumsum(t)
     fp = jnp.cumsum(jnp.where(v, 1.0 - t, 0.0))
     # distinct-threshold runs; evaluate precision at each run END
-    start = jnp.concatenate([jnp.ones((1,), bool), s[1:] != s[:-1]])
-    seg = jnp.cumsum(start) - 1
+    _, seg = _tie_segments(s)
     run_tp = jax.ops.segment_sum(t, seg, num_segments=n)[seg]  # per-position: its run's TP
     end = jnp.concatenate([s[1:] != s[:-1], jnp.ones((1,), bool)])
     prec = tp / jnp.maximum(tp + fp, 1.0)
@@ -100,17 +110,13 @@ def _masked_clf_curve(scores: Array, labels: Array, valid: Array) -> Tuple[Array
     """
     n = scores.shape[0]
     f32 = jnp.float32
-    keys = jnp.where(valid, scores.astype(f32), -jnp.inf)
-    order = jnp.argsort(-keys, stable=True)
-    s = keys[order]
-    v = valid[order].astype(f32)
-    t = jnp.where(v > 0, (labels[order] > 0).astype(f32), 0.0)
+    s, v_bool, t = _desc_sorted(scores, labels, valid)
+    v = v_bool.astype(f32)
     w = v - t  # negatives
     tps_raw = jnp.cumsum(t)
     fps_raw = jnp.cumsum(w)
     pos = jnp.arange(n)
-    start = jnp.concatenate([jnp.ones((1,), bool), s[1:] != s[:-1]])
-    seg = jnp.cumsum(start) - 1
+    start, seg = _tie_segments(s)
     seg_start = jax.lax.cummax(jnp.where(start, pos, 0))
     sum_seg = partial(jax.ops.segment_sum, segment_ids=seg, num_segments=n)
     grp_tp = sum_seg(t)[seg]
@@ -155,10 +161,22 @@ def masked_binary_pr_curve(scores: Array, labels: Array, valid: Array) -> Tuple[
     the standard PR count-interpolation, which is NOT a straight line in
     (recall, precision) space. Step/AP integration from the endpoints is
     unchanged; a trapezoid over all points follows the count-interpolated
-    curve, not the chord between endpoints. Padding slots repeat the
-    full-recall endpoint at the low-threshold end.
+    curve, not the chord between endpoints. Points past the first full-recall
+    position (which the eager path slices off at ``last_ind``) and padding
+    slots all REPEAT the full-recall endpoint, so the point set matches the
+    classic curve's.
     """
+    n = scores.shape[0]
     fps, tps, thresholds = _masked_clf_curve(scores, labels, valid)
+    p_total_raw = tps[-1]
+    # clamp everything past the first full-recall point to that point — the
+    # eager path cuts the arrays there; static shapes repeat instead
+    first_full = jnp.argmax(tps >= p_total_raw)
+    after = jnp.arange(n) > first_full
+    keep = p_total_raw > 0
+    fps = jnp.where(after & keep, fps[first_full], fps)
+    tps = jnp.where(after & keep, p_total_raw, tps)
+    thresholds = jnp.where(after & keep, thresholds[first_full], thresholds)
     precision = tps / jnp.maximum(tps + fps, 1e-38)
     p_total = tps[-1]
     recall = jnp.where(p_total > 0, tps / jnp.maximum(p_total, 1.0), jnp.ones_like(tps))

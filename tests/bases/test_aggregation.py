@@ -77,3 +77,87 @@ def test_aggregators_jittable(cls):
     s = step(s, jnp.asarray([3.0]))
     val = jax.jit(m.compute_from)(s)
     assert np.isfinite(float(val))
+
+
+@pytest.mark.parametrize(
+    "values",
+    [
+        pytest.param([1.5, 2.0, 3.25], id="scalars"),
+        pytest.param([jnp.asarray([1.0, 2.0]), jnp.asarray([3.0, -4.0])], id="vectors"),
+        pytest.param([jnp.asarray([[1.0, 2.0], [0.5, -1.0]])], id="matrix"),
+    ],
+)
+@pytest.mark.parametrize(
+    "cls,np_fn",
+    [
+        (SumMetric, np.sum),
+        (MeanMetric, np.mean),
+        (MaxMetric, np.max),
+        (MinMetric, np.min),
+    ],
+)
+def test_aggregators_input_forms(cls, np_fn, values):
+    """The reference's input-form matrix (``tests/bases/test_aggregation.py:85``):
+    python scalars, vectors and matrices all accumulate identically."""
+    m = cls()
+    for v in values:
+        m.update(v)
+    flat = np.concatenate([np.ravel(np.asarray(v)) for v in values])
+    np.testing.assert_allclose(float(m.compute()), np_fn(flat), rtol=1e-6)
+
+
+def test_aggregators_mesh_sync(devices):
+    """All five aggregators synced over the 8-device mesh equal numpy on the
+    concatenated data (the reference's ddp aggregation matrix)."""
+    from functools import partial
+
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from tests.helpers.testers import mesh_devices
+
+    rng = np.random.RandomState(0)
+    data = rng.randn(8, 4).astype(np.float32)
+    mesh = Mesh(np.asarray(mesh_devices()), ("dp",))
+    metrics = {
+        "sum": (SumMetric(), np.sum),
+        "mean": (MeanMetric(), np.mean),
+        "max": (MaxMetric(), np.max),
+        "min": (MinMetric(), np.min),
+    }
+
+    for name, (m, np_fn) in metrics.items():
+
+        @jax.jit
+        @partial(jax.shard_map, mesh=mesh, in_specs=P("dp"), out_specs=P(), check_vma=False)
+        def run(x, m=m):
+            state = m.update_state(m.init_state(), x[0])
+            return m.compute_synced(state, "dp")
+
+        got = float(run(jnp.asarray(data)))
+        np.testing.assert_allclose(got, np_fn(data), rtol=1e-5, err_msg=name)
+
+    # cat: per-device rows gathered into one flat buffer
+    cat = CatMetric()
+
+    @jax.jit
+    @partial(jax.shard_map, mesh=mesh, in_specs=P("dp"), out_specs=P(None), check_vma=False)
+    def run_cat(x):
+        state = cat.update_state(cat.init_state(), x[0])
+        return cat.sync_states(state, "dp")["value"]
+
+    gathered = np.asarray(run_cat(jnp.asarray(data)))
+    np.testing.assert_allclose(np.sort(gathered), np.sort(data.ravel()), rtol=1e-6)
+
+    # weighted mean under the mesh == weighted mean of all data
+    wm = MeanMetric()
+
+    @jax.jit
+    @partial(jax.shard_map, mesh=mesh, in_specs=(P("dp"), P("dp")), out_specs=P(), check_vma=False)
+    def run_wm(x, w):
+        state = wm.update_state(wm.init_state(), x[0], weight=w[0])
+        return wm.compute_synced(state, "dp")
+
+    weights = rng.rand(8, 4).astype(np.float32) + 0.1
+    got = float(run_wm(jnp.asarray(data), jnp.asarray(weights)))
+    np.testing.assert_allclose(got, np.average(data, weights=weights), rtol=1e-5)

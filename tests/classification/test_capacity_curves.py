@@ -342,3 +342,30 @@ class TestCapacityCurves:
             m.update(jnp.asarray([0.2, 0.8]), jnp.asarray([1, 0]))
             prec, rec, th = m.compute()
             assert np.all(np.isnan(np.asarray(prec)))
+
+    def test_curve_capacity_shape_mismatch_friendly_error(self):
+        from metrics_tpu import ROC, PrecisionRecallCurve
+
+        m = ROC(capacity=8, num_classes=3)
+        with pytest.raises(ValueError, match="num_classes"):
+            m.update(jnp.asarray([0.2, 0.8]), jnp.asarray([0, 1]))  # binary data, C declared
+        m2 = PrecisionRecallCurve(capacity=8)
+        with pytest.raises(ValueError, match="num_classes"):
+            m2.update(jnp.asarray(np.random.rand(4, 3).astype(np.float32)), jnp.asarray([0, 1, 2, 0]))
+
+    def test_pr_curve_clamps_past_full_recall(self):
+        """Points past the first full-recall position repeat the endpoint —
+        the eager path slices them off; the point SETS must agree."""
+        from metrics_tpu import PrecisionRecallCurve
+
+        p = np.asarray([0.9, 0.8, 0.7, 0.6, 0.5, 0.4], np.float32)
+        t = np.asarray([1, 1, 0, 0, 0, 0])
+        m = PrecisionRecallCurve(capacity=6)
+        m.update(jnp.asarray(p), jnp.asarray(t))
+        prec, rec, th = (np.asarray(x, np.float64) for x in m.compute())
+        eager = PrecisionRecallCurve()
+        eager.update(jnp.asarray(p), jnp.asarray(t))
+        e_prec, e_rec, _ = (np.asarray(x, np.float64) for x in eager.compute())
+        assert set(zip(np.round(prec, 6), np.round(rec, 6))) == set(
+            zip(np.round(e_prec, 6), np.round(e_rec, 6))
+        ), (prec, rec, e_prec, e_rec)
