@@ -23,7 +23,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..", "tools"))
 import jax
 import jax.numpy as jnp
 
-from convert_weights import convert_conv_bn_model
+from convert_weights import _template_device, convert_conv_bn_model
 
 
 class TConv(tnn.Module):
@@ -192,7 +192,7 @@ def test_inception_full_graph_tap_parity():
     tmodel.eval()
 
     module = InceptionV3()
-    with jax.default_device(jax.devices("cpu")[0]):
+    with _template_device():
         template = module.init(jax.random.PRNGKey(0), jnp.zeros((1, 299, 299, 3)))
     state = {k: v.numpy() for k, v in tmodel.state_dict().items() if k != "fc.bias"}
     variables = convert_conv_bn_model(state, template)
@@ -215,7 +215,7 @@ def test_inception_float_and_uint8_inputs_agree():
     from metrics_tpu.models.inception import InceptionV3
 
     module = InceptionV3()
-    with jax.default_device(jax.devices("cpu")[0]):
+    with _template_device():
         variables = module.init(jax.random.PRNGKey(1), jnp.zeros((1, 299, 299, 3)))
     imgs = np.random.RandomState(0).randint(0, 256, size=(1, 299, 299, 3)).astype(np.uint8)
     out_u8 = module.apply(variables, jnp.asarray(imgs))

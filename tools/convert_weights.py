@@ -153,6 +153,21 @@ def convert_conv_bn_model(
     return template
 
 
+
+
+def _template_device():
+    """Build init templates on CPU when available (keeps the offline tool off
+    any accelerator); fall back to the default backend on hosts where only a
+    TPU platform is registered (e.g. the axon test environment)."""
+    import contextlib
+
+    import jax
+
+    try:
+        return jax.default_device(jax.devices("cpu")[0])
+    except RuntimeError:
+        return contextlib.nullcontext()
+
 # ------------------------------------------------------------------ inception entry
 
 def convert_inception(torch_ckpt_path: str, out_path: str, num_classes: int = 1008) -> None:
@@ -181,7 +196,7 @@ def convert_inception(torch_ckpt_path: str, out_path: str, num_classes: int = 10
     module = InceptionV3(num_classes=num_classes)
     # conversion is an offline host step — build the template on CPU so it doesn't
     # hold (or wait for) an accelerator
-    with jax.default_device(jax.devices("cpu")[0]):
+    with _template_device():
         template = module.init(jax.random.PRNGKey(0), jnp.zeros((1, 299, 299, 3)))
     # torch-fidelity's fc carries a bias the reference drops ('logits_unbiased');
     # our Dense is bias-free — drop it before the zip
@@ -237,7 +252,7 @@ def convert_lpips(torch_ckpt_path: str, out_path: str, net_type: str = "vgg") ->
     weights = [np.asarray(v).reshape(-1) for _, v in lin_items]
 
     module = _BACKBONES[net_type]()
-    with jax.default_device(jax.devices("cpu")[0]):
+    with _template_device():
         template = module.init(jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 3)))
     variables = convert_conv_bn_model(backbone, template)
     payload = {"net_type": net_type, "variables": variables, "weights": weights}
