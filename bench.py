@@ -586,7 +586,9 @@ def bench_fid() -> dict:
     n = 5
     for _ in range(n):
         fid.update(imgs, real=False)
-        jax.block_until_ready(fid.fake_features[-1])
+    # block ONCE: a streaming update loop pipelines async dispatches; blocking
+    # per iteration would measure the tunnel round-trip, not the forward
+    jax.block_until_ready(fid.fake_features)
     ours = n * imgs.shape[0] / (time.perf_counter() - t0)
     return {"value": round(ours, 2), "unit": "imgs/s", "vs_baseline": None,
             "note": "reference FID needs torch-fidelity (absent); ours-only"}
