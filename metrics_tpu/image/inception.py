@@ -1,7 +1,19 @@
 """Inception Score.
 
 Parity: reference ``torchmetrics/image/inception.py:26`` (logits features, KL-based
-score over splits, compute :160-200).
+score over splits, compute :160-200). TPU-native addition: ``streaming=True``
+replaces the unbounded feature list with per-split accumulable statistics —
+the split-KL decomposes exactly as
+
+    KL_s = ( Σ_{i∈s} Σ_y p_iy·log p_iy  −  Σ_y (Σ_{i∈s} p_iy)·log m_sy ) / n_s,
+    m_sy = (Σ_{i∈s} p_iy) / n_s,
+
+so a ``(Σp, Σ p·logp, n)`` triple per split is sufficient: O(splits·C) memory
+regardless of dataset size, pure-psum sync, in-trace compute. Samples are
+assigned to splits by a counter-derived PRNG stream (``jax.random.fold_in`` on
+the running sample count), replacing the reference's gather-everything-then-
+permute (``inception.py:171``): statistically identical, jit-pure, and
+deterministic for a fixed seed + update sequence.
 """
 from typing import Any, Callable, Optional, Tuple, Union
 
@@ -10,13 +22,30 @@ import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu.metric import Metric
+from metrics_tpu.ops import floatfloat as ff
 from metrics_tpu.utils.data import dim_zero_cat
 
 Array = jax.Array
 
 
 class IS(Metric):
-    """Inception Score: exp of mean split-KL between p(y|x) and p(y)."""
+    """Inception Score: exp of mean split-KL between p(y|x) and p(y).
+
+    Args:
+        feature: an int/str naming an inception tap or a callable ``imgs -> (N, C)``
+            logits extractor.
+        splits: number of splits for the mean/std estimate.
+        params: optional flax params for the built-in InceptionV3.
+        seed: RNG seed for split assignment.
+        streaming: accumulate per-split statistics instead of a feature list —
+            constant memory, jit-compatible compute. Split *membership* then comes
+            from a counter-derived PRNG stream instead of a full permutation at
+            compute time, so per-seed values differ from list mode (the score
+            distribution is identical; the reference itself documents the
+            shuffle-dependence of IS). Default False (list-mode parity).
+        feature_dim: logits width ``C`` — required for streaming with a callable
+            ``feature`` (inferred for the named taps).
+    """
 
     is_differentiable = False
     higher_is_better = True
@@ -27,6 +56,8 @@ class IS(Metric):
         splits: int = 10,
         params: Optional[Any] = None,
         seed: Optional[int] = None,
+        streaming: bool = False,
+        feature_dim: Optional[int] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -38,19 +69,81 @@ class IS(Metric):
                 raise ValueError(
                     f"Input to argument `feature` must be one of {valid_input}, but got {feature}."
                 )
-            from metrics_tpu.models.inception import InceptionFeatureExtractor
+            from metrics_tpu.models.inception import FEATURE_DIMS, InceptionFeatureExtractor
 
             self.inception = InceptionFeatureExtractor(feature=str(feature), params=params)
+            if feature_dim is None:
+                feature_dim = FEATURE_DIMS[str(feature)]
 
         self.splits = splits
+        # seed=None matches list mode's run-to-run randomised shuffle: draw a
+        # fresh assignment seed instead of silently pinning 0
+        self._seed = int(np.random.randint(0, 2**31 - 1)) if seed is None else int(seed)
         self._rng = np.random.RandomState(seed)
-        self.add_state("features", default=[], dist_reduce_fx=None)
+        self.streaming = bool(streaming)
+        if self.streaming:
+            # forward() must snapshot/restore, not delta-merge: the counter-derived
+            # assignment key reads sum(split_n), which a zeroed delta state would
+            # freeze at fold_in(seed, 0) for every batch
+            self.full_state_update = True
+            if feature_dim is None:
+                raise ValueError(
+                    "InceptionScore(streaming=True) with a callable `feature` needs "
+                    "`feature_dim=` (the logits width) to allocate the statistic buffers."
+                )
+            c = int(feature_dim)
+            zeros_sc = jnp.zeros((splits, c), jnp.float32)
+            zeros_s = jnp.zeros((splits,), jnp.float32)
+            self.add_state("prob_sum_hi", default=zeros_sc, dist_reduce_fx="sum")
+            self.add_state("prob_sum_lo", default=zeros_sc, dist_reduce_fx="sum")
+            self.add_state("plogp_sum_hi", default=zeros_s, dist_reduce_fx="sum")
+            self.add_state("plogp_sum_lo", default=zeros_s, dist_reduce_fx="sum")
+            self.add_state("split_n", default=zeros_s, dist_reduce_fx="sum")
+        else:
+            self.add_state("features", default=[], dist_reduce_fx=None)
 
     def update(self, imgs: Array) -> None:
         features = self.inception(imgs)
-        self.features.append(features)
+        if not self.streaming:
+            self.features.append(features)
+            return
+
+        features = jnp.asarray(features, jnp.float32)
+        prob = jax.nn.softmax(features, axis=1)
+        log_prob = jax.nn.log_softmax(features, axis=1)
+        # counter-derived assignment: pure under jit, deterministic per seed+order
+        n_seen = jnp.sum(self.split_n).astype(jnp.int32)
+        key = jax.random.fold_in(jax.random.PRNGKey(self._seed), n_seen)
+        assign = jax.random.randint(key, (features.shape[0],), 0, self.splits)
+        onehot = jax.nn.one_hot(assign, self.splits, dtype=jnp.float32)  # (N, S)
+        batch_prob = jnp.matmul(onehot.T, prob, precision=jax.lax.Precision.HIGHEST)
+        batch_plogp = jnp.matmul(
+            onehot.T, jnp.sum(prob * log_prob, axis=1), precision=jax.lax.Precision.HIGHEST
+        )
+        p = ff.ff_add_f32((self.prob_sum_hi, self.prob_sum_lo), batch_prob)
+        pl = ff.ff_add_f32((self.plogp_sum_hi, self.plogp_sum_lo), batch_plogp)
+        self.prob_sum_hi, self.prob_sum_lo = p
+        self.plogp_sum_hi, self.plogp_sum_lo = pl
+        self.split_n = self.split_n + jnp.sum(onehot, axis=0)
 
     def compute(self) -> Tuple[Array, Array]:
+        if self.streaming:
+            prob_sum = self.prob_sum_hi + self.prob_sum_lo  # (S, C)
+            plogp_sum = self.plogp_sum_hi + self.plogp_sum_lo  # (S,)
+            n_s = self.split_n  # (S,)
+            # random assignment can leave a split empty at small N (list mode's
+            # array_split cannot): mask empty splits out of the mean/std instead
+            # of letting the 0/0 poison the score
+            valid = n_s > 0
+            safe_n = jnp.maximum(n_s, 1.0)
+            m_p = prob_sum / safe_n[:, None]
+            cross = jnp.sum(prob_sum * jnp.log(jnp.maximum(m_p, 1e-38)), axis=1)
+            kl = jnp.exp((plogp_sum - cross) / safe_n)
+            k = jnp.sum(valid)
+            mean = jnp.sum(jnp.where(valid, kl, 0.0)) / k
+            var = jnp.sum(jnp.where(valid, (kl - mean) ** 2, 0.0)) / jnp.maximum(k - 1, 1)
+            return mean, jnp.sqrt(var)
+
         features = dim_zero_cat(self.features)
         idx = jnp.asarray(self._rng.permutation(features.shape[0]))
         features = features[idx]

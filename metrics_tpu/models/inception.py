@@ -184,6 +184,10 @@ class InceptionV3(nn.Module):
         return out
 
 
+# output width of each feature tap (used by FID/IS/KID to size streaming buffers)
+FEATURE_DIMS = {"64": 64, "192": 192, "768": 768, "2048": 2048, "logits_unbiased": 1008}
+
+
 class InceptionFeatureExtractor:
     """Stateful convenience wrapper: jitted inception forward returning one tap.
 

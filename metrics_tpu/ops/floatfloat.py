@@ -1,0 +1,102 @@
+"""Float-float (double-float) arithmetic: ~48-bit precision from f32 pairs, inside jit.
+
+The reference computes FID statistics in float64 (``torchmetrics/image/fid.py:269``)
+— trivially available on CUDA, but on TPU f64 exists only as a slow global-flag
+emulation, and *inside a jitted graph* a library cannot open an x64 island at all.
+This module provides the TPU-native answer: error-free transformations (Knuth
+2Sum, Veltkamp split + Dekker 2Prod) represent a value as an unevaluated f32 pair
+``(hi, lo)`` with ``hi + lo`` carrying ~48 significant bits. All ops are branch-free
+elementwise f32 arithmetic — they vectorise, shard, and fuse like any other XLA op,
+and work identically on CPU/TPU backends.
+
+Verified against numpy f64 in ``tests/ops/test_floatfloat.py``. XLA does not
+reassociate IEEE float ops by default, so the error terms survive compilation
+(empirically checked on the TPU backend as part of the test suite).
+
+Used by the streaming FID/IS statistics (``metrics_tpu/image/fid.py``) where the
+raw-moment form ``cov = (Σxxᵀ - n·μμᵀ)/(n-1)`` hits catastrophic cancellation in
+plain f32 whenever features carry a large common offset.
+"""
+from typing import Tuple
+
+import jax.numpy as jnp
+
+Pair = Tuple[jnp.ndarray, jnp.ndarray]
+
+_SPLIT_FACTOR = 4097.0  # 2**12 + 1: Veltkamp split constant for f32 (24-bit mantissa)
+
+
+def two_sum(a, b) -> Pair:
+    """Knuth branch-free 2Sum: s + e == a + b exactly (any magnitude order)."""
+    s = a + b
+    bp = s - a
+    ap = s - bp
+    return s, (a - ap) + (b - bp)
+
+
+def _veltkamp_split(a) -> Pair:
+    c = a * jnp.float32(_SPLIT_FACTOR)
+    hi = c - (c - a)
+    return hi, a - hi
+
+
+def two_prod(a, b) -> Pair:
+    """Dekker 2Prod: p + e == a * b exactly (no FMA required)."""
+    p = a * b
+    ah, al = _veltkamp_split(a)
+    bh, bl = _veltkamp_split(b)
+    e = ((ah * bh - p) + ah * bl + al * bh) + al * bl
+    return p, e
+
+
+def ff_add(x: Pair, y: Pair) -> Pair:
+    """Pair + pair (Dekker add2: ~accurate to the pair format's full width)."""
+    s, e = two_sum(x[0], y[0])
+    e = e + (x[1] + y[1])
+    hi, lo = two_sum(s, e)
+    return hi, lo
+
+
+def ff_add_f32(x: Pair, v) -> Pair:
+    """Pair + plain f32 (compensated accumulate step)."""
+    s, e = two_sum(x[0], v)
+    e = e + x[1]
+    hi, lo = two_sum(s, e)
+    return hi, lo
+
+
+def ff_neg(x: Pair) -> Pair:
+    return -x[0], -x[1]
+
+
+def ff_sub(x: Pair, y: Pair) -> Pair:
+    return ff_add(x, ff_neg(y))
+
+
+def ff_mul(x: Pair, y: Pair) -> Pair:
+    """Pair * pair."""
+    p, e = two_prod(x[0], y[0])
+    e = e + (x[0] * y[1] + x[1] * y[0])
+    hi, lo = two_sum(p, e)
+    return hi, lo
+
+
+def ff_scale(x: Pair, c) -> Pair:
+    """Pair * plain f32 scalar/array."""
+    p, e = two_prod(x[0], c)
+    e = e + x[1] * c
+    hi, lo = two_sum(p, e)
+    return hi, lo
+
+
+def ff_to_f32(x: Pair):
+    return x[0] + x[1]
+
+
+def ff_from_f32(v) -> Pair:
+    return v, jnp.zeros_like(v)
+
+
+def ff_to_f64(x: Pair):
+    """Recover the ~48-bit value; only meaningful inside an x64 context."""
+    return x[0].astype(jnp.float64) + x[1].astype(jnp.float64)

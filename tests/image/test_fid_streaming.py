@@ -1,0 +1,337 @@
+"""Streaming constant-memory FID/IS (VERDICT r3 #2).
+
+The reference keeps every feature batch in an unbounded list
+(``torchmetrics/image/fid.py:248-249``) and warns about the memory itself
+(:224-228). The streaming mode replaces the lists with a centered Chan triple
+(μ, M2, n) per distribution, held as compensated f32 pairs:
+
+  * matches the list-state path to documented tolerance (eager AND under jit),
+  * holds the f64 contract *inside a jitted graph* on ill-conditioned features
+    (the list path's island can only open eagerly),
+  * runs a 1M-image epoch inside one compiled loop with flat O(d²) memory,
+  * syncs across a mesh via gather + Chan fold (the ``regression/pearson.py``
+    pattern).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from metrics_tpu import FrechetInceptionDistance, InceptionScore
+
+
+def _features(seed, n=4000, d=64, offset=0.0, scale=1.0):
+    rng = np.random.RandomState(seed)
+    return (rng.randn(n, d) * scale + offset).astype(np.float32)
+
+
+def _fid_numpy_f64(real, fake):
+    def mean_cov(f):
+        m = f.mean(0)
+        diff = f - m
+        return m, diff.T @ diff / (f.shape[0] - 1)
+
+    m1, c1 = mean_cov(real.astype(np.float64))
+    m2, c2 = mean_cov(fake.astype(np.float64))
+    v1, q1 = np.linalg.eigh(c1)
+    c1_half = (q1 * np.sqrt(np.clip(v1, 0, None))) @ q1.T
+    m = c1_half @ c2 @ c1_half
+    tr = np.sum(np.sqrt(np.clip(np.linalg.eigvalsh((m + m.T) / 2), 0, None)))
+    diff = m1 - m2
+    return float(diff @ diff + np.trace(c1) + np.trace(c2) - 2 * tr)
+
+
+def test_streaming_matches_list_mode_eager():
+    real, fake = _features(0), _features(1, offset=0.3)
+    stream = FrechetInceptionDistance(feature=lambda x: x, feature_dim=64, streaming=True)
+    listed = FrechetInceptionDistance(feature=lambda x: x)  # list mode (no dim)
+    assert stream.streaming and not listed.streaming
+    for chunk in np.split(real, 8):
+        stream.update(jnp.asarray(chunk), real=True)
+        listed.update(jnp.asarray(chunk), real=True)
+    for chunk in np.split(fake, 8):
+        stream.update(jnp.asarray(chunk), real=False)
+        listed.update(jnp.asarray(chunk), real=False)
+    a, b = float(stream.compute()), float(listed.compute())
+    assert abs(a - b) / max(abs(b), 1e-9) < 1e-4, (a, b)
+
+
+def test_streaming_default_for_named_taps():
+    fid = FrechetInceptionDistance(feature=64)
+    assert fid.streaming and fid.feature_dim == 64
+
+
+def test_streaming_f64_grade_stats_under_jit():
+    """Ill-conditioned features (large common offset, wide eigen spread)
+    accumulated ENTIRELY inside jit: the pair-held statistics stay f64-grade
+    (cov to ~1e-7 relative — plain f32 raw moments lose *everything* here), and
+    the end-to-end in-trace FID is limited only by the f32 eigh in
+    ``trace_sqrtm_product`` (~1% on this adversarial spectrum; measured 0.68%
+    even when numerically perfect f64 stats are fed to the f32 sqrtm). The
+    eager path recovers f64 via the x64 island and lands at ~1e-4."""
+    rng = np.random.RandomState(2)
+    d = 48
+    scales = np.logspace(-3, 1.0, d)
+    real = (rng.randn(3000, d) * scales + 100.0).astype(np.float32)
+    fake = (rng.randn(3000, d) * scales + 99.0).astype(np.float32)
+    expected = _fid_numpy_f64(real, fake)
+
+    fid = FrechetInceptionDistance(feature=lambda x: x, feature_dim=d, streaming=True)
+
+    @jax.jit
+    def run(r, f):
+        state = fid.init_state()
+        for chunk in range(6):
+            state = fid.update_state(state, r[chunk * 500:(chunk + 1) * 500], real=True)
+            state = fid.update_state(state, f[chunk * 500:(chunk + 1) * 500], real=False)
+        return fid.compute_from(state), state
+
+    got, state = run(jnp.asarray(real), jnp.asarray(fake))
+
+    # 1) the accumulated statistics themselves are f64-grade
+    cov_stream = (
+        np.asarray(state["real_m2_hi"], np.float64) + np.asarray(state["real_m2_lo"], np.float64)
+    ) / (3000 - 1)
+    mu_true = real.astype(np.float64).mean(0)
+    diff = real.astype(np.float64) - mu_true
+    cov_true = diff.T @ diff / (3000 - 1)
+    assert np.abs(cov_stream - cov_true).max() / np.abs(cov_true).max() < 1e-6
+    mu_stream = (
+        np.asarray(state["real_mean_hi"], np.float64) + np.asarray(state["real_mean_lo"], np.float64)
+    )
+    assert np.abs(mu_stream - mu_true).max() < 1e-4
+
+    # 2) end-to-end in-trace FID sits at the f32-eigh floor, not the f32
+    #    accumulation cliff (raw-moment f32 would be off by >100x here)
+    assert abs(float(got) - expected) / abs(expected) < 0.02, (float(got), expected)
+
+    # 3) eager compute opens the x64 island and recovers f64 accuracy
+    eager = FrechetInceptionDistance(feature=lambda x: x, feature_dim=d, streaming=True)
+    for chunk in range(6):
+        eager.update(jnp.asarray(real[chunk * 500:(chunk + 1) * 500]), real=True)
+        eager.update(jnp.asarray(fake[chunk * 500:(chunk + 1) * 500]), real=False)
+    got_eager = float(eager.compute())
+    assert abs(got_eager - expected) / abs(expected) < 1e-4, (got_eager, expected)
+
+
+def test_million_image_epoch_compiled_flat_memory():
+    """1M images through one compiled fori_loop: the state is a fixed O(d²)
+    pytree — memory cannot grow with the stream. The result matches the f64
+    oracle on the same generated stream."""
+    d, batch, iters = 8, 1024, 1000  # 1,024,000 samples per distribution
+    fid = FrechetInceptionDistance(feature=lambda x: x, feature_dim=d, streaming=True)
+    key = jax.random.PRNGKey(0)
+
+    def gen(key, i, offset):
+        k = jax.random.fold_in(key, i)
+        return jax.random.normal(k, (batch, d)) * 0.5 + offset
+
+    @jax.jit
+    def epoch(key):
+        def body(i, state):
+            state = fid.update_state(state, gen(key, 2 * i, 1.0), real=True)
+            state = fid.update_state(state, gen(key, 2 * i + 1, 1.2), real=False)
+            return state
+        state = jax.lax.fori_loop(0, iters, body, fid.init_state())
+        return fid.compute_from(state), state
+
+    out, state = epoch(key)
+    n_real = float(state["real_n"])
+    assert n_real == batch * iters, n_real
+
+    # f64 oracle over the identical stream, computed incrementally in numpy
+    sum_r = np.zeros(d); outer_r = np.zeros((d, d))
+    sum_f = np.zeros(d); outer_f = np.zeros((d, d))
+    for i in range(iters):
+        br = np.asarray(gen(key, 2 * i, 1.0), np.float64)
+        bf = np.asarray(gen(key, 2 * i + 1, 1.2), np.float64)
+        sum_r += br.sum(0); outer_r += br.T @ br
+        sum_f += bf.sum(0); outer_f += bf.T @ bf
+    n = batch * iters
+
+    def stats(s, o):
+        mu = s / n
+        return mu, (o - n * np.outer(mu, mu)) / (n - 1)
+
+    mu1, c1 = stats(sum_r, outer_r)
+    mu2, c2 = stats(sum_f, outer_f)
+    v1, q1 = np.linalg.eigh(c1)
+    c1h = (q1 * np.sqrt(np.clip(v1, 0, None))) @ q1.T
+    tr = np.sum(np.sqrt(np.clip(np.linalg.eigvalsh((c1h @ c2 @ c1h + (c1h @ c2 @ c1h).T) / 2), 0, None)))
+    diff = mu1 - mu2
+    expected = float(diff @ diff + np.trace(c1) + np.trace(c2) - 2 * tr)
+    got = float(out)
+    assert abs(got - expected) / abs(expected) < 1e-3, (got, expected)
+
+
+def test_streaming_mesh_sync_chan_fold(devices):
+    """Sharded updates + gather-sync: the Chan fold over the stacked (world, ...)
+    stats equals the single-device result on the concatenated data."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    d = 16
+    world = len(devices)
+    real, fake = _features(3, n=world * 200, d=d), _features(4, n=world * 200, d=d, offset=0.2)
+    fid = FrechetInceptionDistance(feature=lambda x: x, feature_dim=d, streaming=True)
+
+    mesh = Mesh(np.asarray(devices), ("dev",))
+
+    def shard_fn(r, f):
+        state = fid.init_state()
+        state = fid.update_state(state, r, real=True)
+        state = fid.update_state(state, f, real=False)
+        return fid.compute_synced(state, "dev")
+
+    out = jax.jit(
+        jax.shard_map(
+            shard_fn, mesh=mesh, in_specs=(P("dev"), P("dev")), out_specs=P(), check_vma=False
+        )
+    )(jnp.asarray(real), jnp.asarray(fake))
+
+    oracle = FrechetInceptionDistance(feature=lambda x: x, feature_dim=d, streaming=True)
+    oracle.update(jnp.asarray(real), real=True)
+    oracle.update(jnp.asarray(fake), real=False)
+    # compare against the jitted single-device path (same arithmetic; the eager
+    # path would open the x64 island and differ by the f32 rounding of compute)
+    state = oracle.init_state()
+    state = oracle.update_state(state, jnp.asarray(real), real=True)
+    state = oracle.update_state(state, jnp.asarray(fake), real=False)
+    want = float(jax.jit(oracle.compute_from)(state))
+    assert abs(float(out) - want) / max(abs(want), 1e-9) < 2e-3, (float(out), want)
+
+
+def test_streaming_forward_and_reset():
+    """forward() (snapshot/restore, full_state_update) and reset() behave."""
+    d = 8
+    fid = FrechetInceptionDistance(feature=lambda x: x, feature_dim=d, streaming=True)
+    r = jnp.asarray(_features(5, n=64, d=d))
+    fid.update(r, real=True)
+    fid.update(jnp.asarray(_features(6, n=64, d=d, offset=0.1)), real=False)
+    v1 = float(fid.compute())
+    fid.reset()
+    assert float(fid.real_n) == 0.0
+    fid.update(r, real=True)
+    fid.update(jnp.asarray(_features(6, n=64, d=d, offset=0.1)), real=False)
+    assert abs(float(fid.compute()) - v1) < 1e-6
+
+
+def test_streaming_underfilled_is_nan_not_zero():
+    """No updates (or one side missing) must read NaN like the list path's
+    empty-cat mean — not a spuriously perfect 0.0."""
+    fid = FrechetInceptionDistance(feature=lambda x: x, feature_dim=4, streaming=True)
+    assert np.isnan(float(fid.compute()))
+    fid.update(jnp.asarray(_features(10, n=32, d=4)), real=True)
+    fid._computed = None
+    assert np.isnan(float(fid.compute()))  # fake side still empty
+
+    @jax.jit
+    def run_empty():
+        return fid.compute_from(fid.init_state())
+
+    assert np.isnan(float(run_empty()))
+
+
+def test_streaming_requires_dim_for_callable():
+    with pytest.raises(ValueError, match="feature_dim"):
+        FrechetInceptionDistance(feature=lambda x: x, streaming=True)
+
+
+# ---------------------------------------------------------------- InceptionScore
+
+
+def test_is_streaming_matches_list_statistically():
+    """Same iid data: streaming's counter-derived split assignment and list
+    mode's permutation splits give statistically identical scores."""
+    rng = np.random.RandomState(7)
+    logits = rng.randn(6000, 10).astype(np.float32) * 2.0
+
+    listed = InceptionScore(feature=lambda x: x, splits=5, seed=0)
+    stream = InceptionScore(feature=lambda x: x, feature_dim=10, splits=5, seed=0, streaming=True)
+    for chunk in np.split(logits, 12):
+        listed.update(jnp.asarray(chunk))
+        stream.update(jnp.asarray(chunk))
+    m_list, s_list = (float(x) for x in listed.compute())
+    m_stream, s_stream = (float(x) for x in stream.compute())
+    # iid data: split means concentrate; both estimates agree to sampling noise
+    assert abs(m_stream - m_list) / m_list < 0.02, (m_stream, m_list)
+    assert np.isfinite(s_stream) and s_stream >= 0
+
+
+def test_is_streaming_compiled_loop():
+    splits, c = 4, 12
+    is_m = InceptionScore(feature=lambda x: x, feature_dim=c, splits=splits, streaming=True)
+    key = jax.random.PRNGKey(1)
+
+    @jax.jit
+    def run(key):
+        def body(i, state):
+            batch = jax.random.normal(jax.random.fold_in(key, i), (256, c))
+            return is_m.update_state(state, batch)
+        state = jax.lax.fori_loop(0, 50, body, is_m.init_state())
+        return is_m.compute_from(state), state
+
+    (mean, std), state = run(key)
+    assert float(jnp.sum(state["split_n"])) == 50 * 256
+    assert np.isfinite(float(mean)) and float(mean) >= 1.0 - 1e-5
+    assert np.isfinite(float(std))
+
+
+def test_is_streaming_forward_advances_assignment():
+    """forward() must not freeze the counter-derived split assignment: with
+    batch 2 < splits 3, a frozen fold_in(seed, 0) key would reuse the same two
+    split slots every batch, leaving a split empty -> NaN at compute."""
+    is_m = InceptionScore(feature=lambda x: x, feature_dim=6, splits=3, seed=0, streaming=True)
+    rng = np.random.RandomState(9)
+    for _ in range(12):
+        is_m(jnp.asarray(rng.randn(2, 6).astype(np.float32)))
+    assert float(jnp.min(is_m.split_n)) > 0, np.asarray(is_m.split_n)
+    mean, _ = is_m.compute()
+    assert np.isfinite(float(mean))
+
+
+def test_is_streaming_empty_split_masked():
+    """Random assignment can leave a split empty at small N; the score must
+    mask it out (list mode's array_split never yields empty chunks)."""
+    is_m = InceptionScore(feature=lambda x: x, feature_dim=5, splits=10, seed=3, streaming=True)
+    rng = np.random.RandomState(0)
+    is_m.update(jnp.asarray(rng.randn(16, 5).astype(np.float32)))
+    assert float(jnp.min(is_m.split_n)) == 0.0  # seed chosen to leave a split empty
+    mean, std = is_m.compute()
+    assert np.isfinite(float(mean)) and np.isfinite(float(std))
+
+
+def test_fid_list_mode_keeps_single_update_forward():
+    """full_state_update must stay instance-level: list mode remains mergeable
+    (one inception forward per forward() call)."""
+    fid = FrechetInceptionDistance(feature=lambda x: x)
+    assert fid._states_mergeable
+    stream = FrechetInceptionDistance(feature=lambda x: x, feature_dim=4, streaming=True)
+    assert not stream._states_mergeable
+
+
+def test_is_streaming_mesh_sync(devices):
+    """Per-split sums are pure psum: sharded IS equals the same stats on one
+    device up to assignment (each shard draws its own assignment stream, so we
+    only check the global count and finiteness + scale agreement)."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    c = 8
+    world = len(devices)
+    rng = np.random.RandomState(8)
+    logits = rng.randn(world * 512, c).astype(np.float32)
+    is_m = InceptionScore(feature=lambda x: x, feature_dim=c, splits=4, streaming=True)
+    mesh = Mesh(np.asarray(devices), ("dev",))
+
+    def fn(x):
+        state = is_m.init_state()
+        state = is_m.update_state(state, x)
+        return is_m.compute_synced(state, "dev")
+
+    mean, std = jax.jit(
+        jax.shard_map(fn, mesh=mesh, in_specs=(P("dev"),), out_specs=(P(), P()), check_vma=False)
+    )(jnp.asarray(logits))
+
+    ref = InceptionScore(feature=lambda x: x, feature_dim=c, splits=4, streaming=True)
+    ref.update(jnp.asarray(logits))
+    m_ref, _ = (float(x) for x in ref.compute())
+    assert abs(float(mean) - m_ref) / m_ref < 0.05, (float(mean), m_ref)
