@@ -105,30 +105,52 @@ def fused_axis_sync(
 ) -> List[Array]:
     """Sync many (reduce_fx, value) state leaves with a minimal collective bundle.
 
-    Exactly ONE collective per bucket:
+    The floor is ONE all-reduce + ONE all-gather for a whole MetricCollection:
 
-    * 'sum'/'mean'/'min'/'max' leaves bucket per (reduction, dtype) — a psum
-      does arithmetic, so dtypes cannot mix — raveled into one flat buffer and
-      reduced with a single psum/pmean/pmin/pmax;
-    * 'cat'/None/custom leaves bucket per BIT-WIDTH across dtypes (f32 and
-      i32 share one uint32 carrier via a free bitcast): one stacked
-      ``all_gather`` per width, then per-leaf views are reassembled locally —
-      (world, n, ...) -> (world*n, ...) for 'cat', (world, ...) for None, and
-      a pairwise fold for callables. Shapes and dtypes of one width share the
-      buffer because gather is layout-agnostic over raveled bits.
+    * ALL 'sum' leaves ride a single f32 psum: f32 goes as-is, f16/bf16 upcast
+      (exactly — both embed in f32), and integer counters are split into
+      f32-exactly-summable bit parts sized by the STATIC world size
+      (``_int_split_bits``), then reassembled with u32 wraparound arithmetic —
+      bit-exact for every input, including negatives and i32 overflow, at any
+      world size (parts shrink as the mesh grows). 'mean'/'min'/'max' leaves
+      keep one collective per (reduction, dtype) — pmin/pmax on a converted
+      carrier would round large-magnitude ints, and those reductions are rare
+      in real collections.
+    * ALL 'cat'/None/custom leaves share a single u32-carrier ``all_gather``:
+      1/2-byte dtypes pad to a word boundary and pack 4/2-to-1, 8-byte dtypes
+      split 1-to-2 — bitcasts are free, the padding is <=3 bytes per leaf.
+      Per-leaf views are reassembled locally: (world, n, ...) -> (world*n, ...)
+      for 'cat', (world, ...) for None, and a pairwise fold for callables.
 
     Returns synced values in input order. A MetricCollection of K metrics with
-    S states issues O(reduce-dtype + gather-width buckets) collectives, not
-    O(K*S) (the reference's pattern, ``metric.py:240-245``).
+    S states issues <=2 collectives (+ one per exotic reduction), not O(K*S)
+    (the reference's pattern, ``metric.py:240-245``).
     """
     out: List[Optional[Array]] = [None] * len(leaves)
+    sum_bucket: List[int] = []
     reduce_buckets: Dict[Tuple[str, Any], List[int]] = {}
-    gather_buckets: Dict[int, List[int]] = {}
+    gather_bucket: List[int] = []
     for i, (fx, v) in enumerate(leaves):
-        if fx in _REDUCE_COLLECTIVES:
-            reduce_buckets.setdefault((fx, jnp.asarray(v).dtype), []).append(i)
+        dtype = jnp.asarray(v).dtype
+        if fx == "sum" and _sum_rider(dtype) is not None:
+            sum_bucket.append(i)
+        elif fx in _REDUCE_COLLECTIVES:
+            reduce_buckets.setdefault((fx, dtype), []).append(i)
         else:
-            gather_buckets.setdefault(_gather_width(jnp.asarray(v).dtype), []).append(i)
+            gather_bucket.append(i)
+
+    if sum_bucket:
+        world = axis_size_or_one(axis_name)
+        bits = _int_split_bits(world)
+        payloads = [_to_sum_rider(leaves[i][1], bits) for i in sum_bucket]
+        sizes = [p.size for p in payloads]
+        flat = jnp.concatenate(payloads) if len(payloads) > 1 else payloads[0]
+        synced = lax.psum(flat, axis_name)
+        off = 0
+        for i, n in zip(sum_bucket, sizes):
+            piece = lax.slice(synced, (off,), (off + n,))
+            out[i] = _from_sum_rider(piece, leaves[i][1], bits)
+            off += n
 
     for (fx, _dtype), idxs in reduce_buckets.items():
         vals = [jnp.ravel(jnp.asarray(leaves[i][1])) for i in idxs]
@@ -141,22 +163,21 @@ def fused_axis_sync(
             out[i] = piece.reshape(jnp.shape(leaves[i][1]))
             off += n
 
-    for width, idxs in gather_buckets.items():
-        # gathers are layout-agnostic: leaves of one bit-width bitcast (free —
-        # no copy, no value change) to a common unsigned carrier and move as
-        # ONE all_gather; a psum needs arithmetic and stays per-dtype
-        payloads = [_to_carrier(leaves[i][1]) for i in idxs]
+    if gather_bucket:
+        # gathers are layout-agnostic: every leaf packs into ONE u32 carrier
+        # (free bitcasts; sub-word dtypes pad to a word boundary first)
+        payloads = [_to_carrier_u32(leaves[i][1]) for i in gather_bucket]
         sizes = [p.size for p in payloads]
         flat = jnp.concatenate(payloads) if len(payloads) > 1 else payloads[0]
-        gathered = lax.all_gather(flat, axis_name, tiled=False)  # (world, total)
+        gathered = lax.all_gather(flat, axis_name, tiled=False)  # (world, words)
         world = gathered.shape[0]
         off = 0
-        for i, n in zip(idxs, sizes):
+        for i, n in zip(gather_bucket, sizes):
             fx, v = leaves[i]
             v = jnp.asarray(v)
             shape = v.shape
             raw = lax.slice(gathered, (0, off), (world, off + n))
-            piece = _from_carrier(raw.reshape((world,) + shape), v.dtype)
+            piece = _from_carrier_u32(raw, v.dtype, shape)
             off += n
             if fx == "cat":
                 out[i] = piece.reshape((world * shape[0],) + shape[1:])
@@ -172,31 +193,105 @@ def fused_axis_sync(
     return out  # type: ignore[return-value]
 
 
+# ------------------------------------------------ sum-rider encoding (one psum)
+
+_INT_RIDERS = (jnp.int8, jnp.uint8, jnp.int16, jnp.uint16, jnp.int32, jnp.uint32)
+_FLOAT_RIDERS = (jnp.float32, jnp.float16, jnp.bfloat16)
+
+
+def _sum_rider(dtype: Any) -> Optional[str]:
+    """How a 'sum' leaf of ``dtype`` rides the shared f32 psum (None = cannot)."""
+    if any(dtype == d for d in _FLOAT_RIDERS):
+        return "float"
+    if any(dtype == d for d in _INT_RIDERS):
+        return "int"
+    return None
+
+
+def _int_split_bits(world: int) -> int:
+    """Bits per integer part so a psum over ``world`` devices stays exact in f32:
+    each part < 2**bits, so part-sums < world * 2**bits <= 2**24."""
+    import math
+
+    headroom = max(1, int(math.ceil(math.log2(max(world, 1)))))
+    return max(1, min(16, 24 - headroom))
+
+
+def _to_sum_rider(v: Array, bits: int) -> Array:
+    """Encode one 'sum' leaf as a flat f32 payload for the shared psum."""
+    v = jnp.asarray(v)
+    if _sum_rider(v.dtype) == "float":
+        return jnp.ravel(v).astype(jnp.float32)
+    # two's-complement bitpattern -> base-2**bits digits, each f32-exactly-summable
+    u = jnp.ravel(v).astype(jnp.uint32) if v.dtype != jnp.uint32 else jnp.ravel(v)
+    if v.dtype in (jnp.int8, jnp.int16, jnp.int32):
+        u = lax.bitcast_convert_type(jnp.ravel(v).astype(jnp.int32), jnp.uint32)
+    nparts = -(-32 // bits)
+    mask = jnp.uint32((1 << bits) - 1)
+    parts = [((u >> jnp.uint32(bits * p)) & mask).astype(jnp.float32) for p in range(nparts)]
+    return jnp.concatenate(parts)
+
+
+def _from_sum_rider(piece: Array, ref: Array, bits: int) -> Array:
+    """Decode a psummed payload back to the leaf's dtype (u32 wraparound
+    reconstruction == the native integer psum, overflow semantics included)."""
+    ref = jnp.asarray(ref)
+    shape = jnp.shape(ref)
+    if _sum_rider(ref.dtype) == "float":
+        return piece.reshape(shape).astype(ref.dtype)
+    nparts = -(-32 // bits)
+    n = piece.size // nparts
+    total = jnp.zeros((n,), jnp.uint32)
+    for p in range(nparts):
+        part = lax.slice(piece, (p * n,), ((p + 1) * n,))
+        total = total + (part.astype(jnp.uint32) << jnp.uint32(bits * p))
+    if ref.dtype in (jnp.int8, jnp.int16, jnp.int32):
+        return lax.bitcast_convert_type(total, jnp.int32).astype(ref.dtype).reshape(shape)
+    return total.astype(ref.dtype).reshape(shape)
+
+
 _CARRIERS = {1: jnp.uint8, 2: jnp.uint16, 4: jnp.uint32, 8: jnp.uint64}
 
 
-def _gather_width(dtype: Any) -> int:
-    return 1 if dtype == jnp.bool_ else jnp.dtype(dtype).itemsize
-
-
-def _to_carrier(v: Array) -> Array:
-    """Ravel a leaf to the flat unsigned carrier of its own bit-width."""
+def _to_carrier_u32(v: Array) -> Array:
+    """Ravel one gather leaf into flat u32 words (free bitcasts; sub-word
+    dtypes zero-pad to a word boundary and pack 4/2-to-1, 8-byte split 1-to-2)."""
     v = jnp.asarray(v)
     if v.dtype == jnp.bool_:
-        return jnp.ravel(v.astype(jnp.uint8))
-    carrier = _CARRIERS[jnp.dtype(v.dtype).itemsize]
-    if v.dtype == carrier:
-        return jnp.ravel(v)
-    return jnp.ravel(lax.bitcast_convert_type(v, carrier))
+        v = v.astype(jnp.uint8)
+    flat = jnp.ravel(v)
+    itemsize = jnp.dtype(v.dtype).itemsize
+    if itemsize == 4:
+        return flat if v.dtype == jnp.uint32 else lax.bitcast_convert_type(flat, jnp.uint32)
+    if itemsize == 8:
+        return jnp.ravel(lax.bitcast_convert_type(flat, jnp.uint32))  # (n,) -> (n,2) -> (2n,)
+    per = 4 // itemsize
+    pad = (-flat.size) % per
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    return lax.bitcast_convert_type(flat.reshape(-1, per), jnp.uint32)
 
 
-def _from_carrier(raw: Array, dtype: Any) -> Array:
-    """Inverse of ``_to_carrier`` (shape already restored by the caller)."""
-    if dtype == jnp.bool_:
-        return raw.astype(jnp.bool_)
-    if raw.dtype == dtype:
-        return raw
-    return lax.bitcast_convert_type(raw, dtype)
+def _from_carrier_u32(raw: Array, dtype: Any, shape: Tuple[int, ...]) -> Array:
+    """Inverse of ``_to_carrier_u32`` for a gathered ``(world, words)`` slab:
+    returns ``(world,) + shape`` in the leaf's dtype."""
+    import math
+
+    world = raw.shape[0]
+    tgt = jnp.uint8 if dtype == jnp.bool_ else dtype
+    itemsize = jnp.dtype(tgt).itemsize
+    n_elems = math.prod(shape) if shape else 1
+    if itemsize == 4:
+        vals = raw if jnp.dtype(tgt) == jnp.uint32 else lax.bitcast_convert_type(raw, tgt)
+    elif itemsize == 8:
+        vals = lax.bitcast_convert_type(raw.reshape(world, -1, 2), tgt)
+    else:
+        small = lax.bitcast_convert_type(raw, _CARRIERS[itemsize])  # (world, words, per)
+        vals = small.reshape(world, -1)[:, :n_elems]
+        if jnp.dtype(tgt) != jnp.dtype(_CARRIERS[itemsize]):
+            vals = lax.bitcast_convert_type(vals, tgt)
+    vals = vals.reshape((world,) + tuple(shape))
+    return vals.astype(jnp.bool_) if dtype == jnp.bool_ else vals
 
 
 def reduce(x: Array, reduction: str) -> Array:
