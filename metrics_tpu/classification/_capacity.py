@@ -76,15 +76,23 @@ class CapacityCurveStateMixin:
                 f" {type(self).__name__}; raise `capacity` to at least the largest batch size."
             )
         start = self.count
+        # an overflowing write is a NO-OP (dynamic_update_slice would clamp the
+        # start index and silently overwrite valid tail entries): the buffers
+        # stay intact for anyone reading partial results, the flag still forces
+        # NaN at compute
+        fits = start + n <= self.capacity
         if c:
-            self.preds_buf = jax.lax.dynamic_update_slice(self.preds_buf, preds.astype(jnp.float32), (start, 0))
-            self.target_buf = jax.lax.dynamic_update_slice(self.target_buf, target.astype(jnp.int32), (start, 0))
+            preds_new = jax.lax.dynamic_update_slice(self.preds_buf, preds.astype(jnp.float32), (start, 0))
+            target_new = jax.lax.dynamic_update_slice(self.target_buf, target.astype(jnp.int32), (start, 0))
         else:
-            self.preds_buf = jax.lax.dynamic_update_slice(self.preds_buf, preds.astype(jnp.float32), (start,))
-            self.target_buf = jax.lax.dynamic_update_slice(self.target_buf, target.astype(jnp.int32), (start,))
-        self.valid_buf = jax.lax.dynamic_update_slice(self.valid_buf, jnp.ones((n,), bool), (start,))
-        self.overflow = self.overflow + (start + n > self.capacity).astype(jnp.int32)
-        self.count = jnp.minimum(start + n, self.capacity)
+            preds_new = jax.lax.dynamic_update_slice(self.preds_buf, preds.astype(jnp.float32), (start,))
+            target_new = jax.lax.dynamic_update_slice(self.target_buf, target.astype(jnp.int32), (start,))
+        valid_new = jax.lax.dynamic_update_slice(self.valid_buf, jnp.ones((n,), bool), (start,))
+        self.preds_buf = jnp.where(fits, preds_new, self.preds_buf)
+        self.target_buf = jnp.where(fits, target_new, self.target_buf)
+        self.valid_buf = jnp.where(fits, valid_new, self.valid_buf)
+        self.overflow = self.overflow + (~fits).astype(jnp.int32)
+        self.count = jnp.where(fits, start + n, start)
 
     def _capacity_curve_precheck(self, preds: Array) -> None:
         """Friendly layout check on the RAW inputs, before canonicalization

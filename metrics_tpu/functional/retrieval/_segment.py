@@ -119,20 +119,40 @@ def segment_retrieval_mean(
 
     ``empty_target_action`` follows the reference: degenerate queries raise
     (``error``), score 1 (``pos``), score 0 (``neg``), or drop out of the mean
-    (``skip``).
+    (``skip``). The ``error`` check stays in-graph as data: eager compute fetches
+    the (result, flag) pair in ONE transfer and raises host-side; under jit —
+    where raising is impossible — it defers like the runtime's value checks
+    (``utils/checks.py``): the result is NaN-poisoned and a deferred errcode is
+    emitted when a ``deferred_value_checks`` context is open.
     """
     values, empty, valid = _segment_scores(preds, target, indexes, kind=kind, k=k)
-    if empty_target_action == "error":
-        if bool(jnp.any(empty)):
-            raise ValueError("`compute` method was provided with a query with no positive target.")
-        keep, fill = valid, 0.0
-    elif empty_target_action == "skip":
+    if empty_target_action == "skip":
         keep, fill = valid & ~empty, 0.0
     elif empty_target_action == "pos":
         keep, fill = valid, 1.0
-    else:  # "neg"
+    else:  # "neg", and "error" (which inspects the empty flag below)
         keep, fill = valid, 0.0
     values = jnp.where(empty, fill, values)
     count = jnp.sum(keep)
     total = jnp.sum(jnp.where(keep, values, 0.0))
-    return jnp.where(count > 0, total / jnp.maximum(count, 1), 0.0)
+    result = jnp.where(count > 0, total / jnp.maximum(count, 1), 0.0)
+    if empty_target_action != "error":
+        return result
+
+    from metrics_tpu.utils.checks import (
+        _CODE_EMPTY_QUERY_RETRIEVAL,
+        _is_tracer,
+        defer_value_check,
+        deferred_message,
+    )
+
+    any_empty = jnp.any(empty)
+    if _is_tracer(result) or _is_tracer(any_empty):
+        defer_value_check(any_empty, _CODE_EMPTY_QUERY_RETRIEVAL)
+        return jnp.where(any_empty, jnp.float32(jnp.nan), result)
+    import numpy as np
+
+    fetched = np.asarray(jnp.stack([result, any_empty.astype(result.dtype)]))  # ONE transfer
+    if fetched[1]:
+        raise ValueError(deferred_message(_CODE_EMPTY_QUERY_RETRIEVAL))
+    return jnp.asarray(fetched[0], result.dtype)

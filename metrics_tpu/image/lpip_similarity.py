@@ -10,7 +10,7 @@ math on top — per-layer unit-normalisation, squared difference, linear
 weighting, spatial mean, layer sum — runs fully on device. A raw ``net``
 callable remains pluggable for custom feature stacks.
 """
-from typing import Any, Callable, List, Optional
+from typing import Any, Callable, List, Optional, Union
 
 import jax
 import jax.numpy as jnp
@@ -68,6 +68,7 @@ class LPIPS(Metric):
         reduction: str = "mean",
         weights: Optional[List[Array]] = None,
         params: Optional[Any] = None,
+        check_value_range: Union[bool, str] = "first",
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -89,6 +90,14 @@ class LPIPS(Metric):
                 weights = feature_net.weights
         self.net = net
         self.weights = weights
+        if check_value_range not in (True, False, "first"):
+            raise ValueError(
+                f"Argument `check_value_range` must be True, False or 'first', got {check_value_range}"
+            )
+        # the eager [-1,1] check is one blocking device fetch (~130ms over a
+        # tunnelled TPU) — by default pay it once, not per batch
+        self.check_value_range = check_value_range
+        self._range_checked = False
         valid_reduction = ("mean", "sum")
         if reduction not in valid_reduction:
             raise ValueError(f"Argument `reduction` must be one of {valid_reduction}, but got {reduction}")
@@ -97,12 +106,13 @@ class LPIPS(Metric):
         self.add_state("sum_scores", jnp.asarray(0.0), dist_reduce_fx="sum")
         self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
 
-    @staticmethod
-    def _validate_imgs(img1: Array, img2: Array) -> None:
+    def _validate_imgs(self, img1: Array, img2: Array) -> None:
         """Reference contract (``lpip_similarity.py:36-38,140-146``): 4-d image
         batches with a 3-wide channel axis, values in [-1, 1]. Shape checks run
         always; the value check is eager-only (skipped under trace, matching the
-        input layer's convention) and costs one fused device fetch."""
+        input layer's convention) and costs one blocking device fetch, so by
+        default (``check_value_range="first"``) it runs on the first update
+        only (``True`` = every update, ``False`` = never)."""
         from metrics_tpu.utils.checks import _is_tracer
 
         for name, img in (("img1", img1), ("img2", img2)):
@@ -111,7 +121,10 @@ class LPIPS(Metric):
                 raise ValueError(
                     f"Expected `{name}` to be a 4-d batch with a 3-channel axis, got shape {shape}"
                 )
-        if not (_is_tracer(img1) or _is_tracer(img2)):
+        check = self.check_value_range is True or (
+            self.check_value_range == "first" and not self._range_checked
+        )
+        if check and not (_is_tracer(img1) or _is_tracer(img2)):
             import numpy as np
 
             bounds = np.asarray(
@@ -124,6 +137,13 @@ class LPIPS(Metric):
                     f" range [-1,1]), but `img1` spans [{lo1}, {hi1}] and `img2` spans"
                     f" [{lo2}, {hi2}]"
                 )
+            # only a PASSED check retires the first-update probe: a caught
+            # failure must not disable checking for later batches
+            self._range_checked = True
+
+    def reset(self) -> None:
+        super().reset()
+        self._range_checked = False
 
     def update(self, img1: Array, img2: Array) -> None:
         if self._builtin_net:

@@ -128,6 +128,9 @@ _CODE_TARGET_GE_NUM_CLASSES = register_deferred_message(
     "The highest label in `target` should be smaller than `num_classes`."
 )
 _CODE_TARGET_NOT_BINARY_RETRIEVAL = register_deferred_message("`target` must contain `binary` values")
+_CODE_EMPTY_QUERY_RETRIEVAL = register_deferred_message(
+    "`compute` method was provided with a query with no positive target."
+)
 
 
 def _is_floating(x) -> bool:

@@ -22,6 +22,12 @@ class MinMaxMetric(Metric):
     metric: forward keeps the snapshot path instead of delta-merging (a
     batch-local delta would fold per-batch values, not prefix values).
 
+    ``fold_on_compute=True`` selects the reference's LITERAL ``update()`` path
+    instead (``wrappers/minmax.py:70-88``): extremes fold only when ``compute``
+    runs, so ``update x N; compute`` yields ``min == max == raw`` exactly as the
+    reference does outside its forward-per-step usage. Default False (prefix
+    semantics — what the reference's own test contract exercises).
+
     Example:
         >>> import jax.numpy as jnp
         >>> from metrics_tpu import Accuracy, MinMaxMetric
@@ -35,13 +41,14 @@ class MinMaxMetric(Metric):
 
     full_state_update = True
 
-    def __init__(self, base_metric: Metric, **kwargs: Any) -> None:
+    def __init__(self, base_metric: Metric, fold_on_compute: bool = False, **kwargs: Any) -> None:
         super().__init__(**kwargs)
         if not isinstance(base_metric, Metric):
             raise ValueError(
                 f"Expected base metric to be an instance of `metrics_tpu.Metric` but received {base_metric}"
             )
         self._base_metric = base_metric
+        self.fold_on_compute = bool(fold_on_compute)
         # registered states (not plain attrs): the pure update/compute API
         # snapshots+restores registered state only, and min/max ARE the right
         # cross-device reductions for these
@@ -56,7 +63,8 @@ class MinMaxMetric(Metric):
 
     def update(self, *args: Any, **kwargs: Any) -> None:
         self._base_metric.update(*args, **kwargs)
-        self._fold_extremes(self._base_metric._inner_compute())
+        if not self.fold_on_compute:
+            self._fold_extremes(self._base_metric._inner_compute())
 
     def compute(self) -> Dict[str, Array]:
         # the WRAPPED compute: under eager multihost it merges the child across

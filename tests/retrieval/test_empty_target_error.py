@@ -1,0 +1,67 @@
+"""`empty_target_action="error"` in the segment engine (VERDICT r3 #6).
+
+The round-3 implementation did `bool(jnp.any(empty))` — a blocking per-compute
+device fetch and a guaranteed TracerBoolConversionError under jit. Now the flag
+travels as data: eager compute fetches (result, flag) in one transfer and raises
+host-side; a jitted compute NaN-poisons instead of crashing and emits the
+deferred errcode when a deferred-checks context is open.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from metrics_tpu.functional.retrieval._segment import segment_retrieval_mean
+from metrics_tpu.utils.checks import (
+    _CODE_EMPTY_QUERY_RETRIEVAL,
+    deferred_message,
+    deferred_value_checks,
+)
+
+
+def _corpus(with_empty):
+    indexes = jnp.asarray([0, 0, 0, 1, 1, 1])
+    preds = jnp.asarray([0.9, 0.3, 0.5, 0.8, 0.2, 0.4])
+    target = jnp.asarray([1, 0, 1, 1, 0, 0] if not with_empty else [1, 0, 1, 0, 0, 0])
+    return preds, target, indexes
+
+
+def test_error_eager_raises_on_empty_query():
+    preds, target, indexes = _corpus(with_empty=True)
+    with pytest.raises(ValueError, match="no positive target"):
+        segment_retrieval_mean(preds, target, indexes, kind="map", empty_target_action="error")
+
+
+def test_error_eager_passes_and_matches_neg_when_clean():
+    preds, target, indexes = _corpus(with_empty=False)
+    got = segment_retrieval_mean(preds, target, indexes, kind="map", empty_target_action="error")
+    want = segment_retrieval_mean(preds, target, indexes, kind="map", empty_target_action="neg")
+    assert abs(float(got) - float(want)) < 1e-7
+
+
+def test_error_under_jit_defers_instead_of_crashing():
+    preds, target, indexes = _corpus(with_empty=True)
+
+    @jax.jit
+    def run(p, t, i):
+        return segment_retrieval_mean(p, t, i, kind="map", empty_target_action="error")
+
+    out = run(preds, target, indexes)  # must not raise at trace time
+    assert np.isnan(float(out))
+
+    clean = _corpus(with_empty=False)
+    assert np.isfinite(float(run(*clean)))
+
+
+def test_error_under_jit_emits_deferred_code():
+    preds, target, indexes = _corpus(with_empty=True)
+
+    @jax.jit
+    def run(p, t, i):
+        with deferred_value_checks() as dvc:
+            out = segment_retrieval_mean(p, t, i, kind="map", empty_target_action="error")
+        return out, dvc.combined()
+
+    _, code = run(preds, target, indexes)
+    assert int(code) == _CODE_EMPTY_QUERY_RETRIEVAL
+    assert "no positive target" in deferred_message(int(code))

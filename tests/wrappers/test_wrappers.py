@@ -104,6 +104,21 @@ class TestMinMax:
         m.reset()
         assert float(m.min_val) == np.inf
 
+    def test_fold_on_compute_reference_literal(self):
+        """Reference-literal update() semantics (reference wrappers/minmax.py:70-88):
+        extremes fold only at compute, so update x N; compute gives min=max=raw."""
+        m = MinMaxMetric(MeanSquaredError(), fold_on_compute=True)
+        m.update(jnp.ones(4), jnp.ones(4) * 2.0)  # running mse 1.0
+        m.update(jnp.ones(4), jnp.ones(4) * 4.0)  # running mse 5.0
+        out = m.compute()
+        assert float(out["raw"]) == float(out["min"]) == float(out["max"]) == 5.0
+        # prefix mode on the same sequence covers both prefixes
+        p = MinMaxMetric(MeanSquaredError())
+        p.update(jnp.ones(4), jnp.ones(4) * 2.0)
+        p.update(jnp.ones(4), jnp.ones(4) * 4.0)
+        outp = p.compute()
+        assert float(outp["min"]) == 1.0 and float(outp["max"]) == 5.0
+
 
 class TestMultioutput:
     def test_mse_per_output(self):
