@@ -90,10 +90,15 @@ class LPIPS(Metric):
                 weights = feature_net.weights
         self.net = net
         self.weights = weights
-        if check_value_range not in (True, False, "first"):
-            raise ValueError(
-                f"Argument `check_value_range` must be True, False or 'first', got {check_value_range}"
-            )
+        if check_value_range != "first":
+            # canonicalize truthy/falsy scalars (1, np.True_, ...) so the
+            # `is True` tests in _validate_imgs can't silently miss them
+            if check_value_range in (True, False):
+                check_value_range = bool(check_value_range)
+            else:
+                raise ValueError(
+                    f"Argument `check_value_range` must be True, False or 'first', got {check_value_range}"
+                )
         # the eager [-1,1] check is one blocking device fetch (~130ms over a
         # tunnelled TPU) — by default pay it once, not per batch
         self.check_value_range = check_value_range

@@ -216,7 +216,9 @@ class InceptionFeatureExtractor:
                 UserWarning,
             )
             dummy = jnp.zeros((1, input_size, input_size, 3), dtype=jnp.float32)
-            params = self.module.init(jax.random.PRNGKey(seed), dummy)
+            # jit the init: un-jitted flax init executes the whole net eagerly,
+            # one dispatch round-trip per op (~minutes over a tunnelled TPU)
+            params = jax.jit(self.module.init)(jax.random.PRNGKey(seed), dummy)
         self.params = params
         self._forward = jax.jit(lambda p, x: self.module.apply(p, x)[self.feature])
 

@@ -12,6 +12,34 @@ import types
 REFERENCE_ROOT = "/root/reference"
 
 
+def reference_functional():
+    """``torchmetrics.functional`` from /root/reference with all shims applied,
+    or ``None`` when the reference tree is not mounted (the repo stays
+    standalone — callers module-skip on None)."""
+    import os
+
+    if not os.path.isdir(REFERENCE_ROOT):
+        return None
+    shim_pkg_resources()
+    shim_torchvision()
+    shim_numpy_legacy()
+    if REFERENCE_ROOT not in sys.path:
+        sys.path.insert(0, REFERENCE_ROOT)
+    import torchmetrics.functional as RF
+
+    return RF
+
+
+def shim_numpy_legacy() -> None:
+    """NumPy 2 removed ``np.float_``; the reference (written for numpy 1.x)
+    uses it in fid.py's scipy-sqrtm bridge. Restore the alias for the
+    head-to-head runs."""
+    import numpy as np
+
+    if not hasattr(np, "float_"):
+        np.float_ = np.float64
+
+
 def shim_pkg_resources() -> None:
     if "pkg_resources" in sys.modules:
         return

@@ -18,7 +18,7 @@ import sys
 import numpy as np
 import pytest
 
-from tests.helpers.reference_shims import REFERENCE_ROOT, shim_pkg_resources, shim_torchvision
+from tests.helpers.reference_shims import REFERENCE_ROOT, reference_functional
 
 if not os.path.isdir(REFERENCE_ROOT):
     pytest.skip("reference tree not mounted", allow_module_level=True)
@@ -28,13 +28,7 @@ torch = pytest.importorskip("torch")
 
 @pytest.fixture(scope="module")
 def RF():
-    shim_pkg_resources()
-    shim_torchvision()
-    if REFERENCE_ROOT not in sys.path:
-        sys.path.insert(0, REFERENCE_ROOT)
-    import torchmetrics.functional as RF
-
-    return RF
+    return reference_functional()
 
 
 def _close(r, u, atol=1e-4):
@@ -138,6 +132,149 @@ def test_audio_parity(RF):
         t = rng.randn(2, 128).astype(np.float32)
         _close(RF.snr(torch.from_numpy(p), torch.from_numpy(t)), MF.snr(p, t), atol=1e-3)
         _close(RF.si_sdr(torch.from_numpy(p), torch.from_numpy(t)), MF.si_sdr(p, t), atol=1e-3)
+
+
+def test_ms_ssim_parity(RF):
+    import metrics_tpu.functional as MF
+
+    rng = np.random.RandomState(14)
+    # 5 betas downsample 4x: H/16 must exceed kernel-1, hence the 176px case
+    cases = [
+        dict(kernel_size=(11, 11), sigma=(1.5, 1.5), betas=(0.0448, 0.2856, 0.3001, 0.2363, 0.1333),
+             normalize=None, size=176),
+        dict(kernel_size=(7, 7), sigma=(1.0, 1.0), betas=(0.2, 0.3, 0.5), normalize="relu", size=64),
+        dict(kernel_size=(9, 9), sigma=(2.0, 2.0), betas=(0.3333, 0.3333, 0.3334),
+             normalize="simple", size=80),
+    ]
+    for case in cases:
+        size = case.pop("size")
+        a = rng.rand(1, 1, size, size).astype(np.float32)
+        b = np.clip(a + rng.randn(1, 1, size, size).astype(np.float32) * 0.05, 0, 1)
+        r = RF.multiscale_structural_similarity_index_measure(
+            torch.from_numpy(a), torch.from_numpy(b), data_range=1.0, **case
+        )
+        u = MF.multiscale_structural_similarity_index_measure(a, b, data_range=1.0, **case)
+        _close(r, u, atol=5e-4)
+
+
+def test_hinge_parity(RF):
+    import metrics_tpu.functional as MF
+
+    rng = np.random.RandomState(15)
+    for _ in range(4):
+        # binary: measurements in R, targets {0,1}
+        p_bin = (rng.randn(32) * 2).astype(np.float32)
+        t_bin = rng.randint(0, 2, 32)
+        for squared in (False, True):
+            _close(
+                RF.hinge_loss(torch.from_numpy(p_bin), torch.from_numpy(t_bin), squared=squared),
+                MF.hinge_loss(p_bin, t_bin, squared=squared),
+            )
+        # multiclass, crammer-singer (default) and one-vs-all
+        p_mc = rng.randn(32, 4).astype(np.float32)
+        t_mc = rng.randint(0, 4, 32)
+        for mode in (None, "one-vs-all"):
+            for squared in (False, True):
+                _close(
+                    RF.hinge_loss(
+                        torch.from_numpy(p_mc), torch.from_numpy(t_mc),
+                        squared=squared, multiclass_mode=mode,
+                    ),
+                    MF.hinge_loss(p_mc, t_mc, squared=squared, multiclass_mode=mode),
+                )
+
+
+def test_tweedie_parity(RF):
+    import metrics_tpu.functional as MF
+
+    rng = np.random.RandomState(16)
+    for power in (0.0, 1.0, 1.5, 2.0, 3.0):
+        preds = (rng.rand(64) + 0.1).astype(np.float32)
+        target = (rng.rand(64) + 0.1).astype(np.float32)
+        _close(
+            RF.tweedie_deviance_score(torch.from_numpy(preds), torch.from_numpy(target), power=power),
+            MF.tweedie_deviance_score(preds, target, power=power),
+            atol=5e-4,  # XLA vectorized f32 log/pow ~1e-4 abs (docs/PARITY.md numerics note)
+        )
+
+
+class _TorchIdentityFeature(torch.nn.Module):
+    """Pass-through feature extractor: inputs ARE the [N, d] features, so the
+    reference's embedded-model metrics run without torch-fidelity and both
+    sides see identical features — the statistic pipelines go head-to-head."""
+
+    def forward(self, x):
+        return x
+
+
+@pytest.mark.parametrize("streaming", [False, True])
+def test_fid_features_parity(RF, streaming):
+    from torchmetrics.image.fid import FID as RefFID
+
+    from metrics_tpu import FID
+
+    rng = np.random.RandomState(17)
+    d, n = 8, 96
+    real = rng.randn(n, d).astype(np.float32) * 0.8
+    fake = (rng.randn(n, d) * 1.2 + 0.5).astype(np.float32)
+
+    ref = RefFID(feature=_TorchIdentityFeature())
+    ref.update(torch.from_numpy(real), real=True)
+    ref.update(torch.from_numpy(fake), real=False)
+    expected = float(ref.compute())
+
+    ours = FID(feature=lambda x: x, feature_dim=d, streaming=streaming)
+    # feed in several batches: exercises the Chan combine in streaming mode
+    for i in range(0, n, 32):
+        ours.update(real[i:i + 32], real=True)
+        ours.update(fake[i:i + 32], real=False)
+    got = float(ours.compute())
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_kid_features_parity(RF):
+    from torchmetrics.image.kid import KID as RefKID
+
+    from metrics_tpu import KID
+
+    rng = np.random.RandomState(18)
+    d, n = 6, 40
+    real = rng.randn(n, d).astype(np.float32)
+    fake = (rng.randn(n, d) + 0.3).astype(np.float32)
+
+    # subset_size == n makes every random subset the full set, so the MMD is
+    # deterministic and the two RNGs don't need to agree
+    ref = RefKID(feature=_TorchIdentityFeature(), subsets=3, subset_size=n)
+    ref.update(torch.from_numpy(real), real=True)
+    ref.update(torch.from_numpy(fake), real=False)
+    r_mean, r_std = ref.compute()
+
+    ours = KID(feature=lambda x: x, subsets=3, subset_size=n)
+    ours.update(real, real=True)
+    ours.update(fake, real=False)
+    u_mean, u_std = ours.compute()
+    _close(r_mean, u_mean, atol=1e-5)
+    assert float(u_std) < 1e-6 and float(r_std) < 1e-6
+
+
+def test_inception_score_features_parity(RF):
+    from torchmetrics.image.inception import IS as RefIS
+
+    from metrics_tpu import InceptionScore
+
+    rng = np.random.RandomState(19)
+    n, c = 64, 10
+    logits = (rng.randn(n, c) * 2).astype(np.float32)
+
+    # splits=1: the pre-chunk permutation is irrelevant, score is deterministic
+    ref = RefIS(feature=_TorchIdentityFeature(), splits=1)
+    ref.update(torch.from_numpy(logits))
+    r_mean, _ = ref.compute()
+
+    ours = InceptionScore(feature=lambda x: x, splits=1)
+    ours.update(logits)
+    u_mean, _ = ours.compute()
+    _close(r_mean, u_mean, atol=1e-4)
 
 
 def test_bleu_parity(RF):

@@ -15,8 +15,6 @@ import sys
 
 import numpy as np
 import torch
-import torch.nn.functional as TF
-from torch import nn as tnn
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..", "tools"))
 
@@ -24,158 +22,7 @@ import jax
 import jax.numpy as jnp
 
 from convert_weights import _template_device, convert_conv_bn_model
-
-
-class TConv(tnn.Module):
-    """Conv + BatchNorm(eps=1e-3) + ReLU, the inception basic block."""
-
-    def __init__(self, cin, cout, kernel, stride=1, padding=0):
-        super().__init__()
-        self.conv = tnn.Conv2d(cin, cout, kernel, stride=stride, padding=padding, bias=False)
-        self.bn = tnn.BatchNorm2d(cout, eps=0.001)
-
-    def forward(self, x):
-        return torch.relu(self.bn(self.conv(x)))
-
-
-def _avg3(x):
-    # the FID-variant branch pooling: 3x3 stride-1 SAME, border windows
-    # normalised by the count of real pixels
-    return TF.avg_pool2d(x, 3, stride=1, padding=1, count_include_pad=False)
-
-
-class TInceptionA(tnn.Module):
-    def __init__(self, cin, pool_features):
-        super().__init__()
-        self.b1 = TConv(cin, 64, 1)
-        self.b2a = TConv(cin, 48, 1)
-        self.b2b = TConv(48, 64, 5, padding=2)
-        self.b3a = TConv(cin, 64, 1)
-        self.b3b = TConv(64, 96, 3, padding=1)
-        self.b3c = TConv(96, 96, 3, padding=1)
-        self.b4 = TConv(cin, pool_features, 1)
-
-    def forward(self, x):
-        return torch.cat(
-            [self.b1(x), self.b2b(self.b2a(x)), self.b3c(self.b3b(self.b3a(x))), self.b4(_avg3(x))], 1
-        )
-
-
-class TInceptionB(tnn.Module):
-    def __init__(self, cin):
-        super().__init__()
-        self.b1 = TConv(cin, 384, 3, stride=2)
-        self.b2a = TConv(cin, 64, 1)
-        self.b2b = TConv(64, 96, 3, padding=1)
-        self.b2c = TConv(96, 96, 3, stride=2)
-
-    def forward(self, x):
-        return torch.cat([self.b1(x), self.b2c(self.b2b(self.b2a(x))), TF.max_pool2d(x, 3, stride=2)], 1)
-
-
-class TInceptionC(tnn.Module):
-    def __init__(self, cin, c7):
-        super().__init__()
-        self.b1 = TConv(cin, 192, 1)
-        self.b2a = TConv(cin, c7, 1)
-        self.b2b = TConv(c7, c7, (1, 7), padding=(0, 3))
-        self.b2c = TConv(c7, 192, (7, 1), padding=(3, 0))
-        self.b3a = TConv(cin, c7, 1)
-        self.b3b = TConv(c7, c7, (7, 1), padding=(3, 0))
-        self.b3c = TConv(c7, c7, (1, 7), padding=(0, 3))
-        self.b3d = TConv(c7, c7, (7, 1), padding=(3, 0))
-        self.b3e = TConv(c7, 192, (1, 7), padding=(0, 3))
-        self.b4 = TConv(cin, 192, 1)
-
-    def forward(self, x):
-        b2 = self.b2c(self.b2b(self.b2a(x)))
-        b3 = self.b3e(self.b3d(self.b3c(self.b3b(self.b3a(x)))))
-        return torch.cat([self.b1(x), b2, b3, self.b4(_avg3(x))], 1)
-
-
-class TInceptionD(tnn.Module):
-    def __init__(self, cin):
-        super().__init__()
-        self.b1a = TConv(cin, 192, 1)
-        self.b1b = TConv(192, 320, 3, stride=2)
-        self.b2a = TConv(cin, 192, 1)
-        self.b2b = TConv(192, 192, (1, 7), padding=(0, 3))
-        self.b2c = TConv(192, 192, (7, 1), padding=(3, 0))
-        self.b2d = TConv(192, 192, 3, stride=2)
-
-    def forward(self, x):
-        b1 = self.b1b(self.b1a(x))
-        b2 = self.b2d(self.b2c(self.b2b(self.b2a(x))))
-        return torch.cat([b1, b2, TF.max_pool2d(x, 3, stride=2)], 1)
-
-
-class TInceptionE(tnn.Module):
-    def __init__(self, cin, pool_mode):
-        super().__init__()
-        self.pool_mode = pool_mode
-        self.b1 = TConv(cin, 320, 1)
-        self.b2a = TConv(cin, 384, 1)
-        self.b2b = TConv(384, 384, (1, 3), padding=(0, 1))
-        self.b2c = TConv(384, 384, (3, 1), padding=(1, 0))
-        self.b3a = TConv(cin, 448, 1)
-        self.b3b = TConv(448, 384, 3, padding=1)
-        self.b3c = TConv(384, 384, (1, 3), padding=(0, 1))
-        self.b3d = TConv(384, 384, (3, 1), padding=(1, 0))
-        self.b4 = TConv(cin, 192, 1)
-
-    def forward(self, x):
-        b2 = self.b2a(x)
-        b2 = torch.cat([self.b2b(b2), self.b2c(b2)], 1)
-        b3 = self.b3b(self.b3a(x))
-        b3 = torch.cat([self.b3c(b3), self.b3d(b3)], 1)
-        if self.pool_mode == "max":
-            pooled = TF.max_pool2d(x, 3, stride=1, padding=1)
-        else:
-            pooled = _avg3(x)
-        return torch.cat([self.b1(x), b2, b3, self.b4(pooled)], 1)
-
-
-class TorchFidInception(tnn.Module):
-    """The torch-fidelity FID-variant InceptionV3, with the five feature taps the
-    reference consumes (64/192/768/2048/logits_unbiased)."""
-
-    def __init__(self, num_classes=1008):
-        super().__init__()
-        self.c1 = TConv(3, 32, 3, stride=2)
-        self.c2 = TConv(32, 32, 3)
-        self.c3 = TConv(32, 64, 3, padding=1)
-        self.c4 = TConv(64, 80, 1)
-        self.c5 = TConv(80, 192, 3)
-        self.a1 = TInceptionA(192, 32)
-        self.a2 = TInceptionA(256, 64)
-        self.a3 = TInceptionA(288, 64)
-        self.b = TInceptionB(288)
-        self.m1 = TInceptionC(768, 128)
-        self.m2 = TInceptionC(768, 160)
-        self.m3 = TInceptionC(768, 160)
-        self.m4 = TInceptionC(768, 192)
-        self.d = TInceptionD(768)
-        self.e1 = TInceptionE(1280, "avg")
-        self.e2 = TInceptionE(2048, "max")
-        self.fc = tnn.Linear(2048, num_classes)
-
-    def forward(self, x):
-        # torch-fidelity scaling: uint8-valued input -> (-1, 1)
-        x = (x.float() - 128.0) / 128.0
-        out = {}
-        x = self.c3(self.c2(self.c1(x)))
-        x = TF.max_pool2d(x, 3, stride=2)
-        out["64"] = x.mean(dim=(2, 3))
-        x = self.c5(self.c4(x))
-        x = TF.max_pool2d(x, 3, stride=2)
-        out["192"] = x.mean(dim=(2, 3))
-        x = self.b(self.a3(self.a2(self.a1(x))))
-        out["768"] = x.mean(dim=(2, 3))
-        x = self.e2(self.e1(self.d(self.m4(self.m3(self.m2(self.m1(x)))))))
-        pooled = x.mean(dim=(2, 3))
-        out["2048"] = pooled
-        out["logits_unbiased"] = pooled @ self.fc.weight.t()  # bias dropped, as the reference does
-        return out
+from torch_mirrors import TorchFidInception
 
 
 def test_inception_full_graph_tap_parity():

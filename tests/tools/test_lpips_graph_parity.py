@@ -9,115 +9,24 @@ with random weights saved in the lpips state-dict naming so
 ``convert_weights.py lpips`` exercises its real parsing.
 """
 import os
-import pickle
 import sys
 
 import numpy as np
 import pytest
 import torch
-import torch.nn.functional as TF
-from torch import nn as tnn
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..", "tools"))
 
 import jax.numpy as jnp
 
+from metrics_tpu import LPIPS
+
 from convert_weights import convert_lpips
-
-_SHIFT = torch.tensor([-0.030, -0.088, -0.188]).view(1, 3, 1, 1)
-_SCALE = torch.tensor([0.458, 0.448, 0.450]).view(1, 3, 1, 1)
-
-
-class TorchVggLpips(tnn.Module):
-    """VGG16 LPIPS: five relu taps + per-channel linear heads."""
-
-    CHANNELS = (64, 128, 256, 512, 512)
-
-    def __init__(self):
-        super().__init__()
-        convs = []
-        cin = 3
-        for n_convs, ch in ((2, 64), (2, 128), (3, 256), (3, 512), (3, 512)):
-            block = []
-            for _ in range(n_convs):
-                block.append(tnn.Conv2d(cin, ch, 3, padding=1))
-                cin = ch
-            convs.append(tnn.ModuleList(block))
-        self.blocks = tnn.ModuleList(convs)
-        self.lins = tnn.ModuleList([tnn.Conv2d(c, 1, 1, bias=False) for c in self.CHANNELS])
-
-    def taps(self, x):
-        x = (x - _SHIFT) / _SCALE
-        out = []
-        for i, block in enumerate(self.blocks):
-            if i:
-                x = TF.max_pool2d(x, 2, stride=2)
-            for conv in block:
-                x = torch.relu(conv(x))
-            out.append(x)
-        return out
-
-    def forward(self, a, b):
-        return _lpips_torch(self.taps(a), self.taps(b), self.lins)
-
-
-class TorchAlexLpips(tnn.Module):
-    CHANNELS = (64, 192, 384, 256, 256)
-
-    def __init__(self):
-        super().__init__()
-        self.c1 = tnn.Conv2d(3, 64, 11, stride=4, padding=2)
-        self.c2 = tnn.Conv2d(64, 192, 5, padding=2)
-        self.c3 = tnn.Conv2d(192, 384, 3, padding=1)
-        self.c4 = tnn.Conv2d(384, 256, 3, padding=1)
-        self.c5 = tnn.Conv2d(256, 256, 3, padding=1)
-        self.lins = tnn.ModuleList([tnn.Conv2d(c, 1, 1, bias=False) for c in self.CHANNELS])
-
-    def taps(self, x):
-        x = (x - _SHIFT) / _SCALE
-        t1 = torch.relu(self.c1(x))
-        t2 = torch.relu(self.c2(TF.max_pool2d(t1, 3, stride=2)))
-        t3 = torch.relu(self.c3(TF.max_pool2d(t2, 3, stride=2)))
-        t4 = torch.relu(self.c4(t3))
-        t5 = torch.relu(self.c5(t4))
-        return [t1, t2, t3, t4, t5]
-
-    def forward(self, a, b):
-        return _lpips_torch(self.taps(a), self.taps(b), self.lins)
-
-
-def _unit_normalize(t, eps=1e-10):
-    return t / (torch.sqrt(torch.sum(t ** 2, dim=1, keepdim=True)) + eps)
-
-
-def _lpips_torch(feats_a, feats_b, lins):
-    total = 0.0
-    for fa, fb, lin in zip(feats_a, feats_b, lins):
-        diff = (_unit_normalize(fa) - _unit_normalize(fb)) ** 2
-        total = total + lin(diff).mean(dim=(2, 3)).squeeze(1)
-    return total
-
-
-def _save_lpips_style_state(tmodel, path):
-    """Write the torch weights under the lpips package's state-dict names,
-    including the ScalingLayer buffers a real ``lpips.LPIPS`` state dict
-    carries (the converter must drop them)."""
-    state = {"scaling_layer.shift": _SHIFT.clone(), "scaling_layer.scale": _SCALE.clone()}
-    i = 0
-    if isinstance(tmodel, TorchVggLpips):
-        for block in tmodel.blocks:
-            for conv in block:
-                state[f"net.slice.conv{i}.weight"] = conv.weight.detach()
-                state[f"net.slice.conv{i}.bias"] = conv.bias.detach()
-                i += 1
-    else:
-        for conv in (tmodel.c1, tmodel.c2, tmodel.c3, tmodel.c4, tmodel.c5):
-            state[f"net.slice.conv{i}.weight"] = conv.weight.detach()
-            state[f"net.slice.conv{i}.bias"] = conv.bias.detach()
-            i += 1
-    for j, lin in enumerate(tmodel.lins):
-        state[f"lin{j}.model.1.weight"] = lin.weight.detach()
-    torch.save(state, path)
+from torch_mirrors import (
+    TorchAlexLpips,
+    TorchVggLpips,
+    save_lpips_style_state as _save_lpips_style_state,
+)
 
 
 @pytest.mark.parametrize("net_type,tcls", [("vgg", TorchVggLpips), ("alex", TorchAlexLpips)])
@@ -191,6 +100,54 @@ def test_lpips_input_validation():
         m.update(bad, bad)
     with pytest.raises(ValueError, match="4-d"):
         m.update(jnp.ones((96, 96, 3)), jnp.ones((96, 96, 3)))
+
+
+class TestLPIPSRangeCheckModes:
+    """check_value_range contract: 'first' pays the blocking device fetch once
+    (ADVICE r3), True every update, False never; a FAILED check must not retire
+    the probe, and reset() re-arms it."""
+
+    def _bad(self):
+        return jnp.ones((1, 96, 96, 3)) * 2.0
+
+    def _good(self):
+        return jnp.zeros((1, 96, 96, 3))
+
+    def test_first_mode_retires_only_on_pass_and_rearms_on_reset(self):
+        m = LPIPS(net_type="alex")  # default check_value_range="first"
+        with pytest.raises(ValueError, match="normalized"):
+            m.update(self._bad(), self._bad())
+        # the failure above must NOT have retired the probe
+        with pytest.raises(ValueError, match="normalized"):
+            m.update(self._bad(), self._bad())
+        m.update(self._good(), self._good())  # passes -> probe retired
+        m.update(self._bad(), self._bad())  # documented: no longer checked
+        m.reset()
+        with pytest.raises(ValueError, match="normalized"):
+            m.update(self._bad(), self._bad())  # re-armed
+
+    def test_true_mode_checks_every_update(self):
+        m = LPIPS(net_type="alex", check_value_range=True)
+        m.update(self._good(), self._good())
+        with pytest.raises(ValueError, match="normalized"):
+            m.update(self._bad(), self._bad())
+
+    def test_int_one_behaves_as_true(self):
+        # regression: int 1 passed ctor validation but missed the `is True`
+        # use-site test, silently disabling all checking
+        m = LPIPS(net_type="alex", check_value_range=1)
+        m.update(self._good(), self._good())
+        with pytest.raises(ValueError, match="normalized"):
+            m.update(self._bad(), self._bad())
+
+    def test_false_mode_never_checks(self):
+        m = LPIPS(net_type="alex", check_value_range=False)
+        m.update(self._bad(), self._bad())  # shape-checked only
+        assert np.isfinite(float(m.compute()))
+
+    def test_invalid_mode_rejected(self):
+        with pytest.raises(ValueError, match="check_value_range"):
+            LPIPS(net_type="alex", check_value_range="always")
 
 
 def test_lpips_custom_net_skips_builtin_validation():

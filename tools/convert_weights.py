@@ -261,6 +261,189 @@ def convert_lpips(torch_ckpt_path: str, out_path: str, net_type: str = "vgg") ->
     print(f"wrote {out_path}")
 
 
+# ------------------------------------------------------------- verification kit
+
+def _sha256(path: str) -> str:
+    import hashlib
+
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _manifest() -> Dict[str, Any]:
+    import json
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "checkpoint_manifest.json")
+    with open(path) as f:
+        return json.load(f)
+
+
+def _hash_report(kind: str, ckpt_path: str) -> Dict[str, Any]:
+    """SHA-256 of the input checkpoint vs the manifest. Three outcomes:
+    full-hash match, torch-hub 8-hex prefix match (the filename convention:
+    ``...-6726825d.pth`` carries the sha256's first 8 hex chars), or
+    'recorded' (no published hash to compare against — the computed value goes
+    into the report for the manifest to adopt)."""
+    digest = _sha256(ckpt_path)
+    entry = _manifest().get(kind, {})
+    out: Dict[str, Any] = {"sha256": digest, "manifest_entry": kind}
+    expected = entry.get("sha256")
+    prefix = entry.get("sha256_prefix")
+    # a torch-hub-style hash suffix in the USER'S filename is also checkable
+    m = re.search(r"-([0-9a-f]{8})\.pth$", os.path.basename(ckpt_path))
+    if expected:
+        out["hash_check"] = "match" if digest == expected else "MISMATCH"
+    elif prefix:
+        out["hash_check"] = "prefix_match" if digest.startswith(prefix) else "MISMATCH"
+        out["expected_prefix"] = prefix
+    elif m:
+        out["hash_check"] = "prefix_match" if digest.startswith(m.group(1)) else "MISMATCH"
+        out["expected_prefix"] = m.group(1) + " (from filename)"
+    else:
+        out["hash_check"] = "recorded"
+    return out
+
+
+def _tap_report(pairs: Dict[str, Tuple[np.ndarray, np.ndarray]], tol: float = 1e-4) -> Dict[str, Any]:
+    """Scale-aware max deviation per tap: |flax - torch| / max(1, |torch|_inf)."""
+    taps = {}
+    ok = True
+    for name, (got, expected) in pairs.items():
+        scale = max(1.0, float(np.abs(expected).max()))
+        dev = float(np.abs(np.asarray(got) - np.asarray(expected)).max()) / scale
+        taps[name] = dev
+        ok = ok and dev < tol
+    return {"ok": ok, "tolerance": tol, "max_scaled_deviation_per_tap": taps}
+
+
+def verify_inception(torch_ckpt_path: str, flax_pkl_path: str) -> Dict[str, Any]:
+    """End-to-end conversion check needing NO pre-recorded fixture: load the
+    real checkpoint into the independent torch mirror graph
+    (``tools/torch_mirrors.TorchFidInception`` — the FID-variant the reference
+    consumes, reimplemented in plain torch), run a fixed input through mirror
+    and converted flax model, and compare all five taps.
+
+    The mirror load is positional (definition order, every entry
+    shape-checked) — the same order invariant the converter uses, but the
+    FORWARD graphs are independent implementations, so pooling/BN/scaling/
+    transpose mistakes cannot cancel out.
+    """
+    import torch
+
+    from torch_mirrors import TorchFidInception, load_state_positional
+
+    from metrics_tpu.models.inception import InceptionV3
+
+    report = _hash_report("inception", torch_ckpt_path)
+
+    state = torch.load(torch_ckpt_path, map_location="cpu", weights_only=True)
+    if hasattr(state, "state_dict"):
+        state = state.state_dict()
+    tmodel = TorchFidInception()
+    load_state_positional(tmodel, dict(state))
+    tmodel.eval()
+
+    with open(flax_pkl_path, "rb") as f:
+        variables = pickle.load(f)
+    module = InceptionV3()
+
+    imgs = np.random.RandomState(20260731).randint(0, 256, size=(2, 299, 299, 3)).astype(np.uint8)
+    with torch.no_grad():
+        expected = tmodel(torch.from_numpy(np.transpose(imgs, (0, 3, 1, 2))))
+    import jax.numpy as jnp
+
+    got = module.apply(variables, jnp.asarray(imgs))
+    report.update(_tap_report({
+        k: (got[k], expected[k].numpy()) for k in ("64", "192", "768", "2048", "logits_unbiased")
+    }))
+    return report
+
+
+def verify_lpips(torch_ckpt_path: str, flax_pkl_path: str, net_type: str = "vgg") -> Dict[str, Any]:
+    """Same contract as ``verify_inception`` for the LPIPS nets: real state
+    dict -> independent torch mirror, fixed image pair, compare the five
+    feature taps and the final LPIPS distances."""
+    import torch
+
+    from torch_mirrors import TorchAlexLpips, TorchVggLpips, load_state_positional
+
+    from metrics_tpu.models.perceptual import LPIPSFeatureNet
+
+    report = _hash_report(f"lpips_{net_type}", torch_ckpt_path)
+
+    state = torch.load(torch_ckpt_path, map_location="cpu", weights_only=True)
+    if hasattr(state, "state_dict"):
+        state = state.state_dict()
+    state = {k: v for k, v in state.items() if "scaling_layer" not in k}
+    tmodel = (TorchVggLpips if net_type == "vgg" else TorchAlexLpips)()
+    load_state_positional(tmodel, state)
+    tmodel.eval()
+
+    net = LPIPSFeatureNet(net_type=net_type, params=flax_pkl_path)
+
+    import jax.numpy as jnp
+
+    size = 64 if net_type == "vgg" else 96
+    rng = np.random.RandomState(20260731)
+    a = (rng.rand(2, size, size, 3) * 2 - 1).astype(np.float32)
+    b = (rng.rand(2, size, size, 3) * 2 - 1).astype(np.float32)
+    a_t = torch.from_numpy(np.transpose(a, (0, 3, 1, 2)))
+    b_t = torch.from_numpy(np.transpose(b, (0, 3, 1, 2)))
+
+    taps_flax = net(jnp.asarray(a))
+    with torch.no_grad():
+        taps_torch = tmodel.taps(a_t)
+        dist_torch = tmodel(a_t, b_t).numpy()
+    from metrics_tpu.image.lpip_similarity import _lpips_from_features
+
+    dist_flax = _lpips_from_features(taps_flax, net(jnp.asarray(b)), net.weights)
+    pairs = {
+        f"tap{i}": (g, np.transpose(e.numpy(), (0, 2, 3, 1)))
+        for i, (g, e) in enumerate(zip(taps_flax, taps_torch))
+    }
+    pairs["lpips_distance"] = (np.asarray(dist_flax), dist_torch)
+    report.update(_tap_report(pairs))
+    return report
+
+
+def verify_bert(torch_model_dir: str, flax_out_dir: str) -> Dict[str, Any]:
+    """Compare torch vs converted-flax encoder hidden states on fixed tokens."""
+    import torch
+    from transformers import AutoConfig, AutoModel, FlaxAutoModel
+
+    cfg = AutoConfig.from_pretrained(torch_model_dir)
+    vocab = int(getattr(cfg, "vocab_size", 1000))
+    rng = np.random.RandomState(20260731)
+    ids = rng.randint(0, vocab, size=(2, 16)).astype(np.int64)
+    mask = np.ones_like(ids)
+
+    tmodel = AutoModel.from_pretrained(torch_model_dir).eval()
+    with torch.no_grad():
+        expected = tmodel(
+            input_ids=torch.from_numpy(ids), attention_mask=torch.from_numpy(mask)
+        ).last_hidden_state.numpy()
+    fmodel = FlaxAutoModel.from_pretrained(flax_out_dir)
+    got = np.asarray(fmodel(input_ids=ids, attention_mask=mask).last_hidden_state)
+    report: Dict[str, Any] = {"manifest_entry": "bert", "hash_check": "directory (no single file hash)"}
+    report.update(_tap_report({"last_hidden_state": (got, expected)}))
+    return report
+
+
+def _write_verify_report(report: Dict[str, Any], out_path: str) -> None:
+    import json
+
+    path = out_path.rstrip("/") + ".verify.json"
+    with open(path, "w") as f:
+        json.dump(report, f, indent=2)
+    status = "PASS" if report.get("ok") and report.get("hash_check") != "MISMATCH" else "FAIL"
+    print(f"verify: {status} -> {path}")
+    if status == "FAIL":
+        raise SystemExit(f"verification failed: {json.dumps(report)[:500]}")
+
+
 # ----------------------------------------------------------------------- bert entry
 
 def convert_bert(torch_model_dir: str, out_dir: str) -> None:
@@ -294,13 +477,29 @@ def main() -> None:
     p3.add_argument("torch_ckpt")
     p3.add_argument("out_pkl")
     p3.add_argument("--net-type", choices=("vgg", "alex"), default="vgg")
+    for p in (p1, p2, p3):
+        p.add_argument(
+            "--verify", action="store_true",
+            help="after converting: SHA-256 the input against tools/checkpoint_manifest.json "
+                 "and forward-compare the converted flax model against an independent torch "
+                 "mirror graph on a fixed input; writes <out>.verify.json, exits nonzero on "
+                 "any deviation",
+        )
     args = ap.parse_args()
     if args.cmd == "inception":
         convert_inception(args.torch_ckpt, args.out_pkl, args.num_classes)
+        if args.verify:
+            _write_verify_report(verify_inception(args.torch_ckpt, args.out_pkl), args.out_pkl)
     elif args.cmd == "lpips":
         convert_lpips(args.torch_ckpt, args.out_pkl, args.net_type)
+        if args.verify:
+            _write_verify_report(
+                verify_lpips(args.torch_ckpt, args.out_pkl, args.net_type), args.out_pkl
+            )
     else:
         convert_bert(args.torch_model_dir, args.out_dir)
+        if args.verify:
+            _write_verify_report(verify_bert(args.torch_model_dir, args.out_dir), args.out_dir)
 
 
 if __name__ == "__main__":

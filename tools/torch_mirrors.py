@@ -1,0 +1,308 @@
+"""Independent torch mirror graphs of the embedded models, for verification.
+
+These reproduce — in plain torch, with no dependency on torch-fidelity /
+torchvision / lpips — the exact graphs the reference consumes:
+
+* ``TorchFidInception``: the torch-fidelity FID-variant InceptionV3 (branch
+  avg-pools with ``count_include_pad=False``, max-pool in the second
+  InceptionE, 1008-way unbiased logits, ``(x-128)/128`` input scaling) that
+  the reference loads via ``torchmetrics/image/fid.py:38-55``.
+* ``TorchVggLpips`` / ``TorchAlexLpips``: the ``lpips`` package's feature
+  stacks + scaling layer + unit normalisation + learned 1x1 heads that the
+  reference embeds at ``torchmetrics/image/lpip_similarity.py:123``.
+
+Two consumers:
+* the graph-parity tests (``tests/tools/test_*_graph_parity.py``) share
+  random weights through the converter and compare every tap;
+* ``convert_weights.py --verify`` loads a REAL checkpoint into these mirrors
+  and compares taps against the converted flax model — an end-to-end check
+  the first user with network egress can run in one command.
+"""
+import torch
+import torch.nn.functional as TF
+from torch import nn as tnn
+
+# ----------------------------------------------------------------- inception
+
+class TConv(tnn.Module):
+    """Conv + BatchNorm(eps=1e-3) + ReLU, the inception basic block."""
+
+    def __init__(self, cin, cout, kernel, stride=1, padding=0):
+        super().__init__()
+        self.conv = tnn.Conv2d(cin, cout, kernel, stride=stride, padding=padding, bias=False)
+        self.bn = tnn.BatchNorm2d(cout, eps=0.001)
+
+    def forward(self, x):
+        return torch.relu(self.bn(self.conv(x)))
+
+
+def _avg3(x):
+    # the FID-variant branch pooling: 3x3 stride-1 SAME, border windows
+    # normalised by the count of real pixels
+    return TF.avg_pool2d(x, 3, stride=1, padding=1, count_include_pad=False)
+
+
+class TInceptionA(tnn.Module):
+    def __init__(self, cin, pool_features):
+        super().__init__()
+        self.b1 = TConv(cin, 64, 1)
+        self.b2a = TConv(cin, 48, 1)
+        self.b2b = TConv(48, 64, 5, padding=2)
+        self.b3a = TConv(cin, 64, 1)
+        self.b3b = TConv(64, 96, 3, padding=1)
+        self.b3c = TConv(96, 96, 3, padding=1)
+        self.b4 = TConv(cin, pool_features, 1)
+
+    def forward(self, x):
+        return torch.cat(
+            [self.b1(x), self.b2b(self.b2a(x)), self.b3c(self.b3b(self.b3a(x))), self.b4(_avg3(x))], 1
+        )
+
+
+class TInceptionB(tnn.Module):
+    def __init__(self, cin):
+        super().__init__()
+        self.b1 = TConv(cin, 384, 3, stride=2)
+        self.b2a = TConv(cin, 64, 1)
+        self.b2b = TConv(64, 96, 3, padding=1)
+        self.b2c = TConv(96, 96, 3, stride=2)
+
+    def forward(self, x):
+        return torch.cat([self.b1(x), self.b2c(self.b2b(self.b2a(x))), TF.max_pool2d(x, 3, stride=2)], 1)
+
+
+class TInceptionC(tnn.Module):
+    def __init__(self, cin, c7):
+        super().__init__()
+        self.b1 = TConv(cin, 192, 1)
+        self.b2a = TConv(cin, c7, 1)
+        self.b2b = TConv(c7, c7, (1, 7), padding=(0, 3))
+        self.b2c = TConv(c7, 192, (7, 1), padding=(3, 0))
+        self.b3a = TConv(cin, c7, 1)
+        self.b3b = TConv(c7, c7, (7, 1), padding=(3, 0))
+        self.b3c = TConv(c7, c7, (1, 7), padding=(0, 3))
+        self.b3d = TConv(c7, c7, (7, 1), padding=(3, 0))
+        self.b3e = TConv(c7, 192, (1, 7), padding=(0, 3))
+        self.b4 = TConv(cin, 192, 1)
+
+    def forward(self, x):
+        b2 = self.b2c(self.b2b(self.b2a(x)))
+        b3 = self.b3e(self.b3d(self.b3c(self.b3b(self.b3a(x)))))
+        return torch.cat([self.b1(x), b2, b3, self.b4(_avg3(x))], 1)
+
+
+class TInceptionD(tnn.Module):
+    def __init__(self, cin):
+        super().__init__()
+        self.b1a = TConv(cin, 192, 1)
+        self.b1b = TConv(192, 320, 3, stride=2)
+        self.b2a = TConv(cin, 192, 1)
+        self.b2b = TConv(192, 192, (1, 7), padding=(0, 3))
+        self.b2c = TConv(192, 192, (7, 1), padding=(3, 0))
+        self.b2d = TConv(192, 192, 3, stride=2)
+
+    def forward(self, x):
+        b1 = self.b1b(self.b1a(x))
+        b2 = self.b2d(self.b2c(self.b2b(self.b2a(x))))
+        return torch.cat([b1, b2, TF.max_pool2d(x, 3, stride=2)], 1)
+
+
+class TInceptionE(tnn.Module):
+    def __init__(self, cin, pool_mode):
+        super().__init__()
+        self.pool_mode = pool_mode
+        self.b1 = TConv(cin, 320, 1)
+        self.b2a = TConv(cin, 384, 1)
+        self.b2b = TConv(384, 384, (1, 3), padding=(0, 1))
+        self.b2c = TConv(384, 384, (3, 1), padding=(1, 0))
+        self.b3a = TConv(cin, 448, 1)
+        self.b3b = TConv(448, 384, 3, padding=1)
+        self.b3c = TConv(384, 384, (1, 3), padding=(0, 1))
+        self.b3d = TConv(384, 384, (3, 1), padding=(1, 0))
+        self.b4 = TConv(cin, 192, 1)
+
+    def forward(self, x):
+        b2 = self.b2a(x)
+        b2 = torch.cat([self.b2b(b2), self.b2c(b2)], 1)
+        b3 = self.b3b(self.b3a(x))
+        b3 = torch.cat([self.b3c(b3), self.b3d(b3)], 1)
+        if self.pool_mode == "max":
+            pooled = TF.max_pool2d(x, 3, stride=1, padding=1)
+        else:
+            pooled = _avg3(x)
+        return torch.cat([self.b1(x), b2, b3, self.b4(pooled)], 1)
+
+
+class TorchFidInception(tnn.Module):
+    """The torch-fidelity FID-variant InceptionV3, with the five feature taps the
+    reference consumes (64/192/768/2048/logits_unbiased)."""
+
+    def __init__(self, num_classes=1008):
+        super().__init__()
+        self.c1 = TConv(3, 32, 3, stride=2)
+        self.c2 = TConv(32, 32, 3)
+        self.c3 = TConv(32, 64, 3, padding=1)
+        self.c4 = TConv(64, 80, 1)
+        self.c5 = TConv(80, 192, 3)
+        self.a1 = TInceptionA(192, 32)
+        self.a2 = TInceptionA(256, 64)
+        self.a3 = TInceptionA(288, 64)
+        self.b = TInceptionB(288)
+        self.m1 = TInceptionC(768, 128)
+        self.m2 = TInceptionC(768, 160)
+        self.m3 = TInceptionC(768, 160)
+        self.m4 = TInceptionC(768, 192)
+        self.d = TInceptionD(768)
+        self.e1 = TInceptionE(1280, "avg")
+        self.e2 = TInceptionE(2048, "max")
+        self.fc = tnn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        # torch-fidelity scaling: uint8-valued input -> (-1, 1)
+        x = (x.float() - 128.0) / 128.0
+        out = {}
+        x = self.c3(self.c2(self.c1(x)))
+        x = TF.max_pool2d(x, 3, stride=2)
+        out["64"] = x.mean(dim=(2, 3))
+        x = self.c5(self.c4(x))
+        x = TF.max_pool2d(x, 3, stride=2)
+        out["192"] = x.mean(dim=(2, 3))
+        x = self.b(self.a3(self.a2(self.a1(x))))
+        out["768"] = x.mean(dim=(2, 3))
+        x = self.e2(self.e1(self.d(self.m4(self.m3(self.m2(self.m1(x)))))))
+        pooled = x.mean(dim=(2, 3))
+        out["2048"] = pooled
+        out["logits_unbiased"] = pooled @ self.fc.weight.t()  # bias dropped, as the reference does
+        return out
+
+
+# --------------------------------------------------------------------- lpips
+
+_SHIFT = torch.tensor([-0.030, -0.088, -0.188]).view(1, 3, 1, 1)
+_SCALE = torch.tensor([0.458, 0.448, 0.450]).view(1, 3, 1, 1)
+
+
+class TorchVggLpips(tnn.Module):
+    """VGG16 LPIPS: five relu taps + per-channel linear heads."""
+
+    CHANNELS = (64, 128, 256, 512, 512)
+
+    def __init__(self):
+        super().__init__()
+        convs = []
+        cin = 3
+        for n_convs, ch in ((2, 64), (2, 128), (3, 256), (3, 512), (3, 512)):
+            block = []
+            for _ in range(n_convs):
+                block.append(tnn.Conv2d(cin, ch, 3, padding=1))
+                cin = ch
+            convs.append(tnn.ModuleList(block))
+        self.blocks = tnn.ModuleList(convs)
+        self.lins = tnn.ModuleList([tnn.Conv2d(c, 1, 1, bias=False) for c in self.CHANNELS])
+
+    def taps(self, x):
+        x = (x - _SHIFT) / _SCALE
+        out = []
+        for i, block in enumerate(self.blocks):
+            if i:
+                x = TF.max_pool2d(x, 2, stride=2)
+            for conv in block:
+                x = torch.relu(conv(x))
+            out.append(x)
+        return out
+
+    def forward(self, a, b):
+        return _lpips_torch(self.taps(a), self.taps(b), self.lins)
+
+
+class TorchAlexLpips(tnn.Module):
+    CHANNELS = (64, 192, 384, 256, 256)
+
+    def __init__(self):
+        super().__init__()
+        self.c1 = tnn.Conv2d(3, 64, 11, stride=4, padding=2)
+        self.c2 = tnn.Conv2d(64, 192, 5, padding=2)
+        self.c3 = tnn.Conv2d(192, 384, 3, padding=1)
+        self.c4 = tnn.Conv2d(384, 256, 3, padding=1)
+        self.c5 = tnn.Conv2d(256, 256, 3, padding=1)
+        self.lins = tnn.ModuleList([tnn.Conv2d(c, 1, 1, bias=False) for c in self.CHANNELS])
+
+    def taps(self, x):
+        x = (x - _SHIFT) / _SCALE
+        t1 = torch.relu(self.c1(x))
+        t2 = torch.relu(self.c2(TF.max_pool2d(t1, 3, stride=2)))
+        t3 = torch.relu(self.c3(TF.max_pool2d(t2, 3, stride=2)))
+        t4 = torch.relu(self.c4(t3))
+        t5 = torch.relu(self.c5(t4))
+        return [t1, t2, t3, t4, t5]
+
+    def forward(self, a, b):
+        return _lpips_torch(self.taps(a), self.taps(b), self.lins)
+
+
+def _unit_normalize(t, eps=1e-10):
+    return t / (torch.sqrt(torch.sum(t ** 2, dim=1, keepdim=True)) + eps)
+
+
+def _lpips_torch(feats_a, feats_b, lins):
+    total = 0.0
+    for fa, fb, lin in zip(feats_a, feats_b, lins):
+        diff = (_unit_normalize(fa) - _unit_normalize(fb)) ** 2
+        total = total + lin(diff).mean(dim=(2, 3)).squeeze(1)
+    return total
+
+
+def save_lpips_style_state(tmodel, path):
+    """Write the torch weights under the lpips package's state-dict names,
+    including the ScalingLayer buffers a real ``lpips.LPIPS`` state dict
+    carries (the converter must drop them)."""
+    state = {"scaling_layer.shift": _SHIFT.clone(), "scaling_layer.scale": _SCALE.clone()}
+    i = 0
+    if isinstance(tmodel, TorchVggLpips):
+        for block in tmodel.blocks:
+            for conv in block:
+                state[f"net.slice.conv{i}.weight"] = conv.weight.detach()
+                state[f"net.slice.conv{i}.bias"] = conv.bias.detach()
+                i += 1
+    else:
+        for conv in (tmodel.c1, tmodel.c2, tmodel.c3, tmodel.c4, tmodel.c5):
+            state[f"net.slice.conv{i}.weight"] = conv.weight.detach()
+            state[f"net.slice.conv{i}.bias"] = conv.bias.detach()
+            i += 1
+    for j, lin in enumerate(tmodel.lins):
+        state[f"lin{j}.model.1.weight"] = lin.weight.detach()
+    torch.save(state, path)
+
+
+# ------------------------------------------------------- positional state load
+
+def load_state_positional(module: tnn.Module, state: dict, drop=("num_batches_tracked",)) -> None:
+    """Load a checkpoint whose KEYS use foreign names but whose DEFINITION
+    ORDER matches ``module`` (both sides define the same architecture in the
+    same order — the same invariant the converter's ordered zip relies on,
+    and every assignment is shape-checked, so a misalignment cannot pass
+    silently).
+
+    Entries whose name contains any ``drop`` substring are skipped on both
+    sides. A missing trailing entry on the checkpoint side (e.g. ``fc.bias``
+    saved without a bias) zero-fills the module slot.
+    """
+    own = [(k, v) for k, v in module.state_dict().items() if not any(d in k for d in drop)]
+    theirs = [(k, v) for k, v in state.items() if not any(d in k for d in drop)]
+    if len(theirs) > len(own):
+        raise ValueError(
+            f"checkpoint has {len(theirs)} entries but the mirror graph has {len(own)}"
+        )
+    new_state = dict(module.state_dict())
+    for i, (own_kv, their_kv) in enumerate(zip(own, theirs)):
+        (ok, ov), (tk, tv) = own_kv, their_kv
+        tv = torch.as_tensor(tv)
+        if tuple(ov.shape) != tuple(tv.shape):
+            raise ValueError(
+                f"positional mismatch at entry {i}: mirror {ok} {tuple(ov.shape)} "
+                f"vs checkpoint {tk} {tuple(tv.shape)}"
+            )
+        new_state[ok] = tv.to(ov.dtype)
+    for ok, _ in own[len(theirs):]:
+        new_state[ok] = torch.zeros_like(new_state[ok])
+    module.load_state_dict(new_state)
