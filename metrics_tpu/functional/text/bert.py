@@ -103,6 +103,23 @@ def _simple_whitespace_tokenizer(sentences: List[str], max_length: int) -> Dict[
     return {"input_ids": ids, "attention_mask": mask}
 
 
+def _strip_special_positions(mask: np.ndarray) -> np.ndarray:
+    """Zero the [CLS] (first) and [SEP] (last real) positions of each row.
+
+    Parity: reference ``bert.py:84-98,324`` — the greedy matching and the idf
+    weighting exclude the special tokens (they are not part of either
+    sentence's content); the encoder itself still attends to them. Uses the
+    reference's exact ``cumsum(mask - 0.1).argmax`` trick for the last real
+    position (an all-pad row resolves to position 0, already zeroed)."""
+    out = np.asarray(mask).copy()
+    if out.shape[1] == 0:
+        return out
+    last = np.cumsum(out - 0.1, axis=-1).argmax(-1)
+    out[np.arange(out.shape[0]), last] = 0
+    out[:, 0] = 0
+    return out
+
+
 def _get_tokens_idf(target_ids: np.ndarray, target_mask: np.ndarray) -> Dict[int, float]:
     """IDF over the reference corpus. Parity: reference ``bert.py:182-206``."""
     num_docs = target_ids.shape[0]
@@ -194,8 +211,13 @@ def _score_tokenized(
     idf: bool,
     batch_size: int,
     dedup: Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]] = None,
+    strip_special: bool = True,
 ) -> np.ndarray:
     """Embed + match pre-tokenized pred/ref batches; returns (3, N) numpy P/R/F1.
+
+    ``strip_special``: exclude [CLS]/[SEP] positions from matching and idf
+    (reference contract, ``bert.py:324``); the whitespace fallback tokenizer
+    adds no special tokens, so its path turns this off.
 
     When preds and refs share padding geometry (max_length padding — the
     default), one fused pass over the concatenation keeps the encoder batches
@@ -221,11 +243,16 @@ def _score_tokenized(
             outs.append(out if isinstance(out, jax.Array) else jnp.asarray(np.asarray(out)))
         return outs
 
+    # matching/idf masks exclude special tokens; the ENCODER still receives
+    # the full attention masks (it must attend to [CLS]/[SEP])
+    pred_mmask = _strip_special_positions(pred_mask) if strip_special else pred_mask
+    tgt_mmask = _strip_special_positions(tgt_mask) if strip_special else tgt_mask
+
     pred_w = tgt_w = None
     if idf:
         idf_map = _get_tokens_idf(tgt_ids, tgt_mask)
-        pred_w = jnp.asarray(_idf_weights(pred_ids, pred_mask, idf_map))
-        tgt_w = jnp.asarray(_idf_weights(tgt_ids, tgt_mask, idf_map))
+        pred_w = jnp.asarray(_idf_weights(pred_ids, pred_mmask, idf_map))
+        tgt_w = jnp.asarray(_idf_weights(tgt_ids, tgt_mmask, idf_map))
 
     if pred_ids.shape[1] == tgt_ids.shape[1]:
         n_rows = pred_ids.shape[0] + tgt_ids.shape[0]
@@ -254,13 +281,13 @@ def _score_tokenized(
             inverse = np.arange(n_rows, dtype=np.int32)
         prf = _score_embeddings_packed(
             tuple(outs), jnp.asarray(inverse),
-            jnp.asarray(pred_mask), jnp.asarray(tgt_mask), pred_w, tgt_w,
+            jnp.asarray(pred_mmask), jnp.asarray(tgt_mmask), pred_w, tgt_w,
         )
     else:
         pred_emb = jnp.concatenate(_embed(pred_ids, pred_mask), axis=0)
         tgt_emb = jnp.concatenate(_embed(tgt_ids, tgt_mask), axis=0)
         prf = _score_embeddings_unfused(
-            pred_emb, jnp.asarray(pred_mask), tgt_emb, jnp.asarray(tgt_mask), pred_w, tgt_w
+            pred_emb, jnp.asarray(pred_mmask), tgt_emb, jnp.asarray(tgt_mmask), pred_w, tgt_w
         )
     return np.asarray(prf)
 
@@ -408,6 +435,8 @@ def bert_score(
     precision, recall, f1 = _score_tokenized(
         forward, pred_ids, pred_mask, tgt_ids, tgt_mask, idf=idf, batch_size=batch_size,
         dedup=(ids_u, mask_u, inverse),  # text-level structure, computed above
+        # the whitespace fallback adds no [CLS]/[SEP]; real tokenizers do
+        strip_special=user_tokenizer is not None,
     )
 
     if rescale_with_baseline:

@@ -96,6 +96,9 @@ class BERTScore(Metric):
             np.asarray(dim_zero_cat(self.target_attention_mask)),
             idf=self.idf,
             batch_size=self.batch_size,
+            # reference contract strips [CLS]/[SEP] from matching (bert.py:324);
+            # the whitespace fallback tokenizer adds no special tokens
+            strip_special=self.user_tokenizer is not None,
         )
         if self.rescale_with_baseline:
             precision, recall, f1 = _apply_baseline(precision, recall, f1, self.baseline)

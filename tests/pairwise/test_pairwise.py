@@ -50,3 +50,55 @@ def test_pairwise_reduction(reduction, np_reduce):
     res = np.asarray(pairwise_linear_similarity(_x, _y, reduction=reduction))
     expected = np_reduce(sk_linear(_x, _y), axis=-1)
     np.testing.assert_allclose(res, expected, atol=1e-5)
+
+
+_ALL_FNS = [
+    pairwise_cosine_similarity,
+    pairwise_euclidean_distance,
+    pairwise_linear_similarity,
+    pairwise_manhatten_distance,
+]
+
+
+@pytest.mark.parametrize("metric_fn", _ALL_FNS)
+def test_pairwise_rejects_non_2d(metric_fn):
+    # reference contract (pairwise/helpers.py): only 2-d inputs
+    with pytest.raises(ValueError):
+        metric_fn(np.random.rand(8).astype(np.float32))
+    with pytest.raises(ValueError):
+        metric_fn(np.random.rand(2, 3, 4).astype(np.float32))
+
+
+@pytest.mark.parametrize("metric_fn", _ALL_FNS)
+def test_pairwise_jit_and_grad(metric_fn):
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.asarray(_x[:6], jnp.float32)
+    y = jnp.asarray(_y[:5], jnp.float32)
+    eager = np.asarray(metric_fn(x, y))
+    jitted = np.asarray(jax.jit(lambda a, b: metric_fn(a, b))(x, y))
+    np.testing.assert_allclose(jitted, eager, atol=1e-6)
+    g = jax.grad(lambda a: jnp.sum(metric_fn(a, y)))(x)
+    assert np.all(np.isfinite(np.asarray(g)))
+
+
+def test_cosine_zero_vector_parity():
+    # reference contract: a zero row divides 0/0 -> NaN for that row (the
+    # reference does NOT clamp; sklearn differs and returns 0) — other rows
+    # must stay finite
+    x = np.vstack([np.zeros((1, 10)), np.random.rand(3, 10)]).astype(np.float32)
+    res = np.asarray(pairwise_cosine_similarity(x, _y.astype(np.float32)))
+    assert np.all(np.isnan(res[0]))
+    assert np.all(np.isfinite(res[1:]))
+
+
+def test_euclidean_matches_manual_expansion():
+    # derivation-independent check of the |x|^2 - 2xy + |y|^2 expansion
+    d = np.asarray(pairwise_euclidean_distance(_x, _y))
+    manual = np.sqrt(
+        np.maximum(
+            (_x ** 2).sum(1)[:, None] - 2 * _x @ _y.T + (_y ** 2).sum(1)[None, :], 0.0
+        )
+    )
+    np.testing.assert_allclose(d, manual, atol=1e-5)

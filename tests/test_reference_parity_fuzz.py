@@ -134,6 +134,92 @@ def test_audio_parity(RF):
         _close(RF.si_sdr(torch.from_numpy(p), torch.from_numpy(t)), MF.si_sdr(p, t), atol=1e-3)
 
 
+def test_bert_score_parity(RF, tmp_path):
+    """BERTScore P/R/F1 head-to-head: the same tiny torch BERT checkpoint
+    drives the reference's HF-torch pipeline and our flax dedup-encode
+    pipeline (weights shared through transformers' own pt->flax converter)."""
+    import metrics_tpu.functional as MF
+    from transformers import BertConfig, BertModel, BertTokenizerFast, FlaxAutoModel
+
+    vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"] + [f"tok{i}" for i in range(20)] + [
+        "the", "cat", "sat", "on", "mat", "a", "dog",
+    ]
+    vf = tmp_path / "vocab.txt"
+    vf.write_text("\n".join(vocab))
+    cfg = BertConfig(vocab_size=len(vocab), hidden_size=48, num_hidden_layers=2,
+                     num_attention_heads=2, intermediate_size=96, max_position_embeddings=40)
+    torch.manual_seed(0)
+    pt_dir = str(tmp_path / "pt")
+    BertModel(cfg).eval().save_pretrained(pt_dir)
+    BertTokenizerFast(vocab_file=str(vf)).save_pretrained(pt_dir)
+
+    # EQUAL-LENGTH sentences: the reference length-sorts preds and refs
+    # independently and never restores input order (bert.py:77,104-106 —
+    # unequal lengths scramble the pred/ref pairing and the output order; we
+    # deliberately keep input order, see docs/PARITY.md). With equal lengths
+    # the sort is a stable no-op and the two pipelines are comparable.
+    preds = ["the cat sat on mat", "a dog tok2 tok3 tok4", "the cat tok5 tok6 tok7"]
+    refs = ["the cat sat on a", "a dog tok2 tok3 tok1", "a dog sat tok5 tok8"]
+
+    expected = RF.bert_score(
+        preds, refs, model_name_or_path=pt_dir, max_length=24, num_threads=0,
+        verbose=False, lang="en",
+    )
+
+    tokenizer = BertTokenizerFast.from_pretrained(pt_dir)
+
+    def user_tok(texts, max_length):
+        return tokenizer(texts, padding="max_length", truncation=True,
+                         max_length=max_length, return_tensors="np")
+
+    flax_model = FlaxAutoModel.from_pretrained(pt_dir, from_pt=True)
+    got = MF.bert_score(
+        preds, refs,
+        model=lambda ids, mask: flax_model(input_ids=ids, attention_mask=mask).last_hidden_state,
+        user_tokenizer=user_tok, max_length=24, batch_size=8,
+    )
+    for key in ("precision", "recall", "f1"):
+        _close(np.asarray(expected[key], dtype=np.float64), np.asarray(got[key]), atol=2e-4)
+
+
+def test_retrieval_functional_parity(RF):
+    """All 8 per-query retrieval functionals head-to-head, including k grids
+    and degenerate all-relevant / none-relevant queries (the segment-engine
+    CLASS path is pinned against these same functionals via its host oracle)."""
+    import metrics_tpu.functional as MF
+
+    names = [
+        "retrieval_average_precision",
+        "retrieval_reciprocal_rank",
+        "retrieval_r_precision",
+        "retrieval_normalized_dcg",
+    ]
+    k_names = [
+        "retrieval_precision",
+        "retrieval_recall",
+        "retrieval_fall_out",
+        "retrieval_hit_rate",
+    ]
+    rng = np.random.RandomState(21)
+    targets = [
+        rng.randint(0, 2, 12),          # mixed
+        np.ones(12, dtype=np.int64),    # all relevant
+        np.zeros(12, dtype=np.int64),   # none relevant
+    ]
+    for t in targets:
+        p = rng.rand(12).astype(np.float32)
+        tp, tt = torch.from_numpy(p), torch.from_numpy(t)
+        for name in names:
+            r = getattr(RF, name)(tp, tt)
+            u = getattr(MF, name)(p, t)
+            _close(r, u, atol=1e-5)
+        for name in k_names:
+            for k in (None, 1, 3, 12):
+                r = getattr(RF, name)(tp, tt, k=k)
+                u = getattr(MF, name)(p, t, k=k)
+                _close(r, u, atol=1e-5)
+
+
 def test_ms_ssim_parity(RF):
     import metrics_tpu.functional as MF
 

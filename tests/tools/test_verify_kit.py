@@ -113,6 +113,31 @@ def test_verify_lpips_pass(tmp_path):
     assert "lpips_distance" in report["max_scaled_deviation_per_tap"]
 
 
+def test_lpips_duplicated_lins_layout(tmp_path):
+    """Real ``lpips.LPIPS`` state dicts register the linear heads TWICE
+    (``lin{i}`` attributes and the ``lins`` ModuleList share submodules, and
+    torch's state_dict() keeps both copies). Converter and verifier must
+    dedupe, or the first real checkpoint breaks the one-command contract."""
+    torch.manual_seed(6)
+    tmodel = TorchVggLpips().eval()
+    with torch.no_grad():
+        for lin in tmodel.lins:
+            lin.weight.abs_()
+    base_ckpt = tmp_path / "base.pth"
+    save_lpips_style_state(tmodel, base_ckpt)
+    state = torch.load(base_ckpt, weights_only=True)
+    for k in [k for k in state if k.startswith("lin")]:
+        i = k[3]  # lin{i}.model.1.weight
+        state[f"lins.{i}.model.1.weight"] = state[k].clone()
+    dup_ckpt = tmp_path / "lpips_vgg_dup.pth"
+    torch.save(state, dup_ckpt)
+
+    out = tmp_path / "lpips_vgg_dup.pkl"
+    convert_lpips(str(dup_ckpt), str(out), net_type="vgg")
+    report = verify_lpips(str(dup_ckpt), str(out), net_type="vgg")
+    assert report["ok"], report
+
+
 def test_verify_bert_pass(tmp_path):
     from transformers import BertConfig, BertModel
 

@@ -207,6 +207,19 @@ def convert_inception(torch_ckpt_path: str, out_path: str, num_classes: int = 10
     print(f"wrote {out_path}")
 
 
+def _dedupe_lpips_lins(state_np: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """Drop the duplicated linear-head entries a REAL ``lpips.LPIPS`` state dict
+    carries: lpips 0.1.x registers the heads twice (``lin0..lin4`` attributes AND
+    ``self.lins = ModuleList(...)``), and ``state_dict()`` does not dedupe shared
+    submodules — so the checkpoint has both ``lin0.model.1.weight`` and
+    ``lins.0.model.1.weight`` for the same tensor. Keep the ``lin{i}`` form."""
+    has_lin = any(re.match(r"lin\d", k) for k in state_np)
+    has_lins = any(k.startswith("lins.") for k in state_np)
+    if has_lin and has_lins:
+        state_np = {k: v for k, v in state_np.items() if not k.startswith("lins.")}
+    return state_np
+
+
 # ---------------------------------------------------------------------- lpips entry
 
 def convert_lpips(torch_ckpt_path: str, out_path: str, net_type: str = "vgg") -> None:
@@ -232,6 +245,7 @@ def convert_lpips(torch_ckpt_path: str, out_path: str, net_type: str = "vgg") ->
     if hasattr(state, "state_dict"):
         state = state.state_dict()
     state_np = {k: v.numpy() for k, v in state.items()}
+    state_np = _dedupe_lpips_lins(state_np)
 
     # split out the linear heads: lpips names them `lin0.model.1.weight` ..
     # (or `lins.0...` in some versions); everything else is the backbone
@@ -353,9 +367,12 @@ def verify_inception(torch_ckpt_path: str, flax_pkl_path: str) -> Dict[str, Any]
     imgs = np.random.RandomState(20260731).randint(0, 256, size=(2, 299, 299, 3)).astype(np.uint8)
     with torch.no_grad():
         expected = tmodel(torch.from_numpy(np.transpose(imgs, (0, 3, 1, 2))))
+    import jax
     import jax.numpy as jnp
 
-    got = module.apply(variables, jnp.asarray(imgs))
+    # jit: un-jitted flax apply dispatches each of the ~94 convs separately —
+    # minutes over a tunnelled accelerator (same fix as models/inception.py)
+    got = jax.jit(module.apply)(variables, jnp.asarray(imgs))
     report.update(_tap_report({
         k: (got[k], expected[k].numpy()) for k in ("64", "192", "768", "2048", "logits_unbiased")
     }))
@@ -378,6 +395,7 @@ def verify_lpips(torch_ckpt_path: str, flax_pkl_path: str, net_type: str = "vgg"
     if hasattr(state, "state_dict"):
         state = state.state_dict()
     state = {k: v for k, v in state.items() if "scaling_layer" not in k}
+    state = _dedupe_lpips_lins(state)
     tmodel = (TorchVggLpips if net_type == "vgg" else TorchAlexLpips)()
     load_state_positional(tmodel, state)
     tmodel.eval()
