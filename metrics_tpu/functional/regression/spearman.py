@@ -35,6 +35,15 @@ def _rank_data(data: Array) -> Array:
 
 
 def _spearman_corrcoef_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    if not jnp.issubdtype(preds.dtype, jnp.floating) or not jnp.issubdtype(
+        target.dtype, jnp.floating
+    ):
+        # reference contract (spearman.py:28-31): ranking integer data is
+        # almost always an input mistake — require floats explicitly
+        raise TypeError(
+            "Expected `preds` and `target` both to be floating point tensors, but got"
+            f" {preds.dtype} and {target.dtype}."
+        )
     if preds.dtype != target.dtype:
         raise TypeError(
             "Expected `preds` and `target` to have the same data type."
@@ -65,5 +74,12 @@ def _spearman_corrcoef_compute(preds: Array, target: Array, eps: float = 1e-6) -
 
 def spearman_corrcoef(preds: Array, target: Array) -> Array:
     """Compute Spearman's rank correlation coefficient."""
-    preds, target = _spearman_corrcoef_update(jnp.asarray(preds, dtype=jnp.float32) if jnp.asarray(preds).dtype != jnp.float64 else jnp.asarray(preds), jnp.asarray(target, dtype=jnp.float32) if jnp.asarray(target).dtype != jnp.float64 else jnp.asarray(target))
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    # widen sub-f32 floats for the ranking math; integer inputs fall through
+    # to the _update TypeError (reference contract: floats required)
+    if jnp.issubdtype(preds.dtype, jnp.floating) and preds.dtype not in (jnp.float32, jnp.float64):
+        preds = preds.astype(jnp.float32)
+    if jnp.issubdtype(target.dtype, jnp.floating) and target.dtype not in (jnp.float32, jnp.float64):
+        target = target.astype(jnp.float32)
+    preds, target = _spearman_corrcoef_update(preds, target)
     return _spearman_corrcoef_compute(preds, target)

@@ -44,8 +44,13 @@ class SpearmanCorrCoef(Metric):
         self.add_state("target", default=[], dist_reduce_fx="cat")
 
     def update(self, preds: Array, target: Array) -> None:
-        preds = jnp.asarray(preds, dtype=jnp.float32) if not jnp.issubdtype(jnp.asarray(preds).dtype, jnp.floating) else jnp.asarray(preds)
-        target = jnp.asarray(target, dtype=preds.dtype) if not jnp.issubdtype(jnp.asarray(target).dtype, jnp.floating) else jnp.asarray(target)
+        preds, target = jnp.asarray(preds), jnp.asarray(target)
+        # same contract as the functional: integer inputs raise (reference
+        # behavior), sub-f32 floats widen so both APIs rank in f32
+        if jnp.issubdtype(preds.dtype, jnp.floating) and preds.dtype not in (jnp.float32, jnp.float64):
+            preds = preds.astype(jnp.float32)
+        if jnp.issubdtype(target.dtype, jnp.floating) and target.dtype not in (jnp.float32, jnp.float64):
+            target = target.astype(jnp.float32)
         preds, target = _spearman_corrcoef_update(preds, target)
         self.preds.append(preds)
         self.target.append(target)
