@@ -14,9 +14,14 @@ from metrics_tpu import PSNR
 from metrics_tpu.functional import psnr
 from tests.helpers import seed_all
 from tests.helpers.reference_shims import reference_functional
-from tests.helpers.testers import MetricTester
+from tests.helpers.testers import MetricTester, _on_accelerator
 
 seed_all(42)
+
+# PSNR = 10·log10(dr²/mse): accelerator f32 max/min/mean reductions and the
+# vectorized log put ~1e-4..1e-3 relative noise on the dB value (docs/PARITY.md
+# numerics note); CPU keeps the strict bar
+_RTOL = 1e-3 if _on_accelerator() else 1e-4
 
 _preds = np.random.rand(8, 4, 3, 16, 16).astype(np.float32) * 3.0
 _target = np.random.rand(8, 4, 3, 16, 16).astype(np.float32) * 3.0
@@ -51,7 +56,7 @@ def _np_psnr(preds, target, data_range=None, base=10.0, reduction="elementwise_m
 def test_functional_matrix_scalar(data_range, base):
     got = float(psnr(_preds[0], _target[0], data_range=data_range, base=base))
     expected = _np_psnr(_preds[0], _target[0], data_range=data_range, base=base)
-    np.testing.assert_allclose(got, expected, rtol=1e-4)
+    np.testing.assert_allclose(got, expected, rtol=_RTOL)
 
 
 @pytest.mark.parametrize("reduction", ["elementwise_mean", "sum", "none"])
@@ -84,7 +89,7 @@ def test_reference_head_to_head():
                     base=base, reduction=reduction, dim=dim)
         u = psnr(p, t, data_range=data_range, base=base, reduction=reduction, dim=dim)
         np.testing.assert_allclose(
-            np.asarray(u), r.numpy(), rtol=1e-4, atol=1e-4,
+            np.asarray(u), r.numpy(), rtol=_RTOL, atol=_RTOL,
             err_msg=f"{data_range} {base} {reduction} {dim}",
         )
 
@@ -97,7 +102,7 @@ def test_same_input_is_infinite_or_huge():
 
 
 class TestPSNRClass(MetricTester):
-    atol = 1e-4
+    atol = 5e-3 if _on_accelerator() else 1e-4
 
     @pytest.mark.parametrize("ddp", [False, True])
     @pytest.mark.parametrize("data_range,base", [(None, 10.0), (3.0, 2.0)])

@@ -36,7 +36,7 @@ import os
 import pickle
 import re
 import sys
-from typing import Any, Dict, List, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -155,18 +155,28 @@ def convert_conv_bn_model(
 
 
 
+def _cpu_device():
+    """The host CPU device, or None on hosts where only an accelerator
+    platform is registered (e.g. the axon test environment). Single source of
+    truth for both _template_device and _verify_tol — the 1e-4 verify bar is
+    only valid because the forward actually ran on CPU."""
+    import jax
+
+    try:
+        return jax.devices("cpu")[0]
+    except RuntimeError:
+        return None
+
+
 def _template_device():
-    """Build init templates on CPU when available (keeps the offline tool off
-    any accelerator); fall back to the default backend on hosts where only a
-    TPU platform is registered (e.g. the axon test environment)."""
+    """Build init templates (and verify forwards) on CPU when available —
+    keeps the offline tool off any accelerator; no-op context otherwise."""
     import contextlib
 
     import jax
 
-    try:
-        return jax.default_device(jax.devices("cpu")[0])
-    except RuntimeError:
-        return contextlib.nullcontext()
+    cpu = _cpu_device()
+    return jax.default_device(cpu) if cpu is not None else contextlib.nullcontext()
 
 # ------------------------------------------------------------------ inception entry
 
@@ -321,8 +331,18 @@ def _hash_report(kind: str, ckpt_path: str) -> Dict[str, Any]:
     return out
 
 
-def _tap_report(pairs: Dict[str, Tuple[np.ndarray, np.ndarray]], tol: float = 1e-4) -> Dict[str, Any]:
+def _verify_tol() -> float:
+    """1e-4 scale-aware when a CPU backend exists (the verify forwards run
+    under ``_template_device()``, which prefers CPU — the offline-tool norm);
+    1e-3 on accelerator-only hosts, whose f32 convs run as multi-pass bf16 on
+    the MXU and legitimately deviate a few 1e-4 from torch CPU."""
+    return 1e-4 if _cpu_device() is not None else 1e-3
+
+
+def _tap_report(pairs: Dict[str, Tuple[np.ndarray, np.ndarray]], tol: Optional[float] = None) -> Dict[str, Any]:
     """Scale-aware max deviation per tap: |flax - torch| / max(1, |torch|_inf)."""
+    if tol is None:
+        tol = _verify_tol()
     taps = {}
     ok = True
     for name, (got, expected) in pairs.items():
@@ -370,9 +390,11 @@ def verify_inception(torch_ckpt_path: str, flax_pkl_path: str) -> Dict[str, Any]
     import jax
     import jax.numpy as jnp
 
-    # jit: un-jitted flax apply dispatches each of the ~94 convs separately —
-    # minutes over a tunnelled accelerator (same fix as models/inception.py)
-    got = jax.jit(module.apply)(variables, jnp.asarray(imgs))
+    # jit (un-jitted flax apply dispatches each of the ~94 convs separately —
+    # minutes over a tunnelled accelerator), on CPU when available so the
+    # comparison against torch CPU is exact-grade (same as _verify_tol)
+    with _template_device():
+        got = jax.jit(module.apply)(variables, jnp.asarray(imgs))
     report.update(_tap_report({
         k: (got[k], expected[k].numpy()) for k in ("64", "192", "768", "2048", "logits_unbiased")
     }))
@@ -411,13 +433,15 @@ def verify_lpips(torch_ckpt_path: str, flax_pkl_path: str, net_type: str = "vgg"
     a_t = torch.from_numpy(np.transpose(a, (0, 3, 1, 2)))
     b_t = torch.from_numpy(np.transpose(b, (0, 3, 1, 2)))
 
-    taps_flax = net(jnp.asarray(a))
+    with _template_device():
+        taps_flax = net(jnp.asarray(a))
     with torch.no_grad():
         taps_torch = tmodel.taps(a_t)
         dist_torch = tmodel(a_t, b_t).numpy()
     from metrics_tpu.image.lpip_similarity import _lpips_from_features
 
-    dist_flax = _lpips_from_features(taps_flax, net(jnp.asarray(b)), net.weights)
+    with _template_device():
+        dist_flax = _lpips_from_features(taps_flax, net(jnp.asarray(b)), net.weights)
     pairs = {
         f"tap{i}": (g, np.transpose(e.numpy(), (0, 2, 3, 1)))
         for i, (g, e) in enumerate(zip(taps_flax, taps_torch))
@@ -444,7 +468,8 @@ def verify_bert(torch_model_dir: str, flax_out_dir: str) -> Dict[str, Any]:
             input_ids=torch.from_numpy(ids), attention_mask=torch.from_numpy(mask)
         ).last_hidden_state.numpy()
     fmodel = FlaxAutoModel.from_pretrained(flax_out_dir)
-    got = np.asarray(fmodel(input_ids=ids, attention_mask=mask).last_hidden_state)
+    with _template_device():
+        got = np.asarray(fmodel(input_ids=ids, attention_mask=mask).last_hidden_state)
     report: Dict[str, Any] = {"manifest_entry": "bert", "hash_check": "directory (no single file hash)"}
     report.update(_tap_report({"last_hidden_state": (got, expected)}))
     return report
