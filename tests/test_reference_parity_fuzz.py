@@ -134,6 +134,203 @@ def test_audio_parity(RF):
         _close(RF.si_sdr(torch.from_numpy(p), torch.from_numpy(t)), MF.si_sdr(p, t), atol=1e-3)
 
 
+def test_classification_functional_parity(RF):
+    """Head-to-head sweep of the classification functionals whose conventions
+    (average modes, top_k, normalize, class weighting) are easy to drift on —
+    the domain suites pin them against sklearn; this pins them against the
+    reference's own implementation on shared random inputs."""
+    import metrics_tpu.functional as MF
+
+    rng = np.random.RandomState(22)
+    C = 4
+    for trial in range(3):
+        probs = rng.rand(48, C).astype(np.float32)
+        probs /= probs.sum(1, keepdims=True)
+        t = rng.randint(0, C, 48)
+        tp, tt = torch.from_numpy(probs), torch.from_numpy(t)
+
+        for avg in ("micro", "macro", "weighted"):
+            _close(RF.accuracy(tp, tt, average=avg, num_classes=C),
+                   MF.accuracy(probs, t, average=avg, num_classes=C))
+            _close(RF.precision(tp, tt, average=avg, num_classes=C),
+                   MF.precision(probs, t, average=avg, num_classes=C))
+            _close(RF.recall(tp, tt, average=avg, num_classes=C),
+                   MF.recall(probs, t, average=avg, num_classes=C))
+            _close(RF.fbeta(tp, tt, average=avg, num_classes=C, beta=0.5),
+                   MF.fbeta(probs, t, average=avg, num_classes=C, beta=0.5))
+            _close(RF.specificity(tp, tt, average=avg, num_classes=C),
+                   MF.specificity(probs, t, average=avg, num_classes=C))
+        for k in (1, 2):
+            _close(RF.accuracy(tp, tt, top_k=k), MF.accuracy(probs, t, top_k=k))
+        _close(RF.hamming_distance(tp, tt), MF.hamming_distance(probs, t))
+        for normalize in (None, "true", "pred", "all"):
+            _close(
+                RF.confusion_matrix(tp, tt, num_classes=C, normalize=normalize),
+                MF.confusion_matrix(probs, t, num_classes=C, normalize=normalize),
+            )
+        _close(RF.jaccard_index(tp, tt, num_classes=C), MF.jaccard_index(probs, t, num_classes=C))
+        _close(RF.cohen_kappa(tp, tt, num_classes=C), MF.cohen_kappa(probs, t, num_classes=C))
+        for weights in ("linear", "quadratic"):
+            _close(RF.cohen_kappa(tp, tt, num_classes=C, weights=weights),
+                   MF.cohen_kappa(probs, t, num_classes=C, weights=weights))
+        _close(RF.matthews_corrcoef(tp, tt, num_classes=C),
+               MF.matthews_corrcoef(probs, t, num_classes=C))
+        _close(RF.auroc(tp, tt, num_classes=C), MF.auroc(probs, t, num_classes=C))
+        _close(RF.average_precision(tp[:, 1], (tt == 1).long()),
+               MF.average_precision(probs[:, 1], (t == 1).astype(np.int32)))
+        for reduction in ("mean", "sum"):
+            q = rng.rand(48, C).astype(np.float32)
+            q /= q.sum(1, keepdims=True)
+            _close(RF.kl_divergence(tp, torch.from_numpy(q), reduction=reduction),
+                   MF.kl_divergence(probs, q, reduction=reduction), atol=5e-4)
+
+        # binary stat_scores + dice on hard predictions
+        bp = (rng.rand(48) > 0.5).astype(np.float32)
+        bt = rng.randint(0, 2, 48)
+        _close(RF.stat_scores(torch.from_numpy(bp), torch.from_numpy(bt)),
+               MF.stat_scores(bp, bt))
+        _close(RF.dice_score(tp, tt), MF.dice_score(probs, t))
+
+
+def test_regression_functional_parity(RF):
+    """Every regression functional head-to-head on shared random inputs,
+    including the multioutput modes."""
+    import metrics_tpu.functional as MF
+
+    rng = np.random.RandomState(26)
+    for trial in range(3):
+        p = rng.randn(64).astype(np.float32)
+        t = rng.randn(64).astype(np.float32)
+        tp, tt = torch.from_numpy(p), torch.from_numpy(t)
+        _close(RF.mean_squared_error(tp, tt), MF.mean_squared_error(p, t))
+        _close(RF.mean_absolute_error(tp, tt), MF.mean_absolute_error(p, t))
+        _close(RF.mean_squared_error(tp, tt, squared=False),
+               MF.mean_squared_error(p, t, squared=False))
+        _close(RF.pearson_corrcoef(tp, tt), MF.pearson_corrcoef(p, t))
+        _close(RF.spearman_corrcoef(tp, tt), MF.spearman_corrcoef(p, t))
+        _close(RF.explained_variance(tp, tt), MF.explained_variance(p, t))
+        _close(RF.r2_score(tp, tt), MF.r2_score(p, t))
+        pos_p, pos_t = np.abs(p) + 0.1, np.abs(t) + 0.1
+        _close(RF.mean_absolute_percentage_error(torch.from_numpy(pos_p), torch.from_numpy(pos_t)),
+               MF.mean_absolute_percentage_error(pos_p, pos_t), atol=5e-4)
+        _close(RF.symmetric_mean_absolute_percentage_error(torch.from_numpy(pos_p), torch.from_numpy(pos_t)),
+               MF.symmetric_mean_absolute_percentage_error(pos_p, pos_t), atol=5e-4)
+        _close(RF.mean_squared_log_error(torch.from_numpy(pos_p), torch.from_numpy(pos_t)),
+               MF.mean_squared_log_error(pos_p, pos_t), atol=5e-4)
+        a = rng.randn(8, 5).astype(np.float32)
+        b = rng.randn(8, 5).astype(np.float32)
+        _close(RF.cosine_similarity(torch.from_numpy(a), torch.from_numpy(b)),
+               MF.cosine_similarity(a, b))
+        # multioutput modes
+        mp = rng.randn(32, 3).astype(np.float32)
+        mt = rng.randn(32, 3).astype(np.float32)
+        for mode in ("raw_values", "uniform_average"):
+            _close(RF.explained_variance(torch.from_numpy(mp), torch.from_numpy(mt), multioutput=mode),
+                   MF.explained_variance(mp, mt, multioutput=mode))
+            _close(RF.r2_score(torch.from_numpy(mp), torch.from_numpy(mt), multioutput=mode),
+                   MF.r2_score(mp, mt, multioutput=mode))
+
+
+def test_curve_functional_parity(RF):
+    """ROC / PrecisionRecallCurve / AUC head-to-head: binary tensor outputs
+    and the multiclass per-class list convention."""
+    import metrics_tpu.functional as MF
+
+    rng = np.random.RandomState(24)
+    # binary
+    p = rng.rand(64).astype(np.float32)
+    t = rng.randint(0, 2, 64)
+    tp, tt = torch.from_numpy(p), torch.from_numpy(t)
+    for rf_out, mf_out in zip(RF.roc(tp, tt), MF.roc(p, t)):
+        _close(rf_out, mf_out, atol=1e-6)
+    for rf_out, mf_out in zip(RF.precision_recall_curve(tp, tt),
+                              MF.precision_recall_curve(p, t)):
+        _close(rf_out, mf_out, atol=1e-6)
+    x = np.sort(rng.rand(16).astype(np.float32))
+    y = rng.rand(16).astype(np.float32)
+    _close(RF.auc(torch.from_numpy(x), torch.from_numpy(y)), MF.auc(x, y))
+
+    # multiclass: per-class lists
+    C = 3
+    probs = rng.rand(48, C).astype(np.float32)
+    probs /= probs.sum(1, keepdims=True)
+    mt = rng.randint(0, C, 48)
+    r_fpr, r_tpr, r_thr = RF.roc(torch.from_numpy(probs), torch.from_numpy(mt), num_classes=C)
+    u_fpr, u_tpr, u_thr = MF.roc(probs, mt, num_classes=C)
+    for c in range(C):
+        _close(r_fpr[c], u_fpr[c], atol=1e-6)
+        _close(r_tpr[c], u_tpr[c], atol=1e-6)
+        # thresholds pin the convention too (incl. the leading sentinel)
+        _close(r_thr[c], u_thr[c], atol=1e-6)
+
+
+def test_binned_curves_parity(RF):
+    """Binned curve modules vs the reference on identical thresholds."""
+    import torchmetrics as RM
+
+    import metrics_tpu as M
+
+    rng = np.random.RandomState(25)
+    C = 3
+    probs = rng.rand(96, C).astype(np.float32)
+    probs /= probs.sum(1, keepdims=True)
+    t = rng.randint(0, C, 96)
+    onehot = np.eye(C, dtype=np.int64)[t]
+
+    r = RM.BinnedAveragePrecision(num_classes=C, thresholds=25)
+    u = M.BinnedAveragePrecision(num_classes=C, thresholds=25)
+    r.update(torch.from_numpy(probs), torch.from_numpy(onehot))
+    u.update(probs, onehot)
+    r_out, u_out = r.compute(), u.compute()
+    for c in range(C):
+        _close(r_out[c], u_out[c], atol=1e-6)
+
+    r2 = RM.BinnedRecallAtFixedPrecision(num_classes=C, thresholds=25, min_precision=0.4)
+    u2 = M.BinnedRecallAtFixedPrecision(num_classes=C, thresholds=25, min_precision=0.4)
+    r2.update(torch.from_numpy(probs), torch.from_numpy(onehot))
+    u2.update(probs, onehot)
+    (r_rec, r_thr), (u_rec, u_thr) = r2.compute(), u2.compute()
+    _close(r_rec, u_rec, atol=1e-6)
+    _close(r_thr, u_thr, atol=1e-6)
+
+
+def test_aggregation_parity(RF):
+    """CatMetric/SumMetric/MeanMetric/MaxMetric/MinMetric vs the reference,
+    including the nan_strategy grid."""
+    import torchmetrics as RM
+
+    import metrics_tpu as M
+
+    rng = np.random.RandomState(23)
+    values = [rng.randn(8).astype(np.float32) for _ in range(3)]
+    with_nan = [v.copy() for v in values]
+    with_nan[1][2] = np.nan
+
+    import warnings as _warnings
+
+    pairs = [
+        (RM.SumMetric, M.SumMetric), (RM.MeanMetric, M.MeanMetric),
+        (RM.MaxMetric, M.MaxMetric), (RM.MinMetric, M.MinMetric),
+    ]
+    for ref_cls, our_cls in pairs:
+        # 'warn' sees the NaN too: both sides must warn AND propagate it the
+        # same way (assert_allclose compares with equal_nan)
+        for strategy, data in (("warn", values), ("warn", with_nan), ("ignore", with_nan)):
+            r, u = ref_cls(nan_strategy=strategy), our_cls(nan_strategy=strategy)
+            with _warnings.catch_warnings():
+                _warnings.simplefilter("ignore")
+                for v in data:
+                    r.update(torch.from_numpy(v))
+                    u.update(v)
+                _close(r.compute(), u.compute(), atol=1e-5)
+
+    r, u = RM.CatMetric(), M.CatMetric()
+    for v in values:
+        r.update(torch.from_numpy(v))
+        u.update(v)
+    _close(r.compute(), u.compute(), atol=1e-6)
+
+
 def test_bert_score_parity(RF, tmp_path):
     """BERTScore P/R/F1 head-to-head: the same tiny torch BERT checkpoint
     drives the reference's HF-torch pipeline and our flax dedup-encode
