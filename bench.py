@@ -684,27 +684,44 @@ def bench_fid() -> dict:
     # between runs, in both directions.
     K = 10
 
-    @jax.jit
-    def epoch(state):
-        def body(i, s):
-            return fid.update_state(s, imgs, real=False)
-
-        return jax.lax.fori_loop(0, K, body, state)
-
-    state = epoch(fid.init_state())  # compile + warm
-    jax.block_until_ready(jax.tree.leaves(state))
-    trials = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        state = epoch(fid.init_state())
-        jax.block_until_ready(jax.tree.leaves(state))
-        trials.append(K * B / (time.perf_counter() - t0))
-    ours = float(np.median(trials))
-
-    # FLOP model: XLA's own count for the compiled inception forward (per img);
-    # fallback = the standard analytic InceptionV3 count, 5.7 GMACs * 2
+    # FLOP model first: XLA's own count for the compiled inception forward
+    # (per img); fallback = the standard analytic InceptionV3 count,
+    # 5.7 GMACs * 2. Needed up front for the trial plausibility filter.
     flops_total = _compiled_flops(fid.inception, imgs)
     per_img = flops_total / B if flops_total else 2 * 5.71e9
+    peak_flops, _ = _peak_flops()
+
+    def run_epoch_trials(fid_obj):
+        @jax.jit
+        def epoch(state):
+            def body(i, s):
+                return fid_obj.update_state(s, imgs, real=False)
+
+            return jax.lax.fori_loop(0, K, body, state)
+
+        state = epoch(fid_obj.init_state())  # compile + warm
+        jax.block_until_ready(jax.tree.leaves(state))
+        ts = []
+        for _ in range(6):
+            t0 = time.perf_counter()
+            state = epoch(fid_obj.init_state())
+            jax.block_until_ready(jax.tree.leaves(state))
+            rate = K * B / (time.perf_counter() - t0)
+            # plausibility: a trial implying more FLOP/s than the chip's peak
+            # measured a runtime glitch (readiness fired before execution —
+            # observed sporadically over the tunnel), not the chip
+            if peak_flops and rate * per_img > peak_flops:
+                continue
+            ts.append(rate)
+            if len(ts) == 3:
+                break
+        return ts
+
+    trials = run_epoch_trials(fid)
+    if not trials:
+        return {"error": "all FID epoch trials exceeded the device FLOP peak "
+                         "(runtime readiness glitch); no valid measurement"}
+    ours = float(np.median(trials))
     out = {"value": round(ours, 2), "unit": "imgs/s (compiled epoch loop, device-resident batch)",
            "vs_baseline": None, "trials": [round(t, 1) for t in trials],
            "note": "reference FID needs torch-fidelity (absent); ours-only"}
@@ -712,6 +729,26 @@ def bench_fid() -> dict:
         per_img, ours,
         "XLA cost_analysis of compiled InceptionV3 fwd" if flops_total
         else "analytic InceptionV3 5.71 GMACs*2 (cost_analysis unavailable)"))
+
+    # the TPU-first fast path: same epoch with the bf16 compute mode
+    # (InceptionFeatureExtractor(compute_dtype=bfloat16); default stays f32
+    # for strict parity — see models/inception.py)
+    try:
+        from metrics_tpu.models.inception import InceptionFeatureExtractor
+
+        ext16 = InceptionFeatureExtractor(feature="2048", compute_dtype=jnp.bfloat16)
+        fid16 = FrechetInceptionDistance(feature=ext16, feature_dim=2048)
+        bf16_trials = run_epoch_trials(fid16)  # same protocol + filter as f32
+        if bf16_trials:
+            bf16_rate = float(np.median(bf16_trials))
+            out["bf16_value"] = round(bf16_rate, 2)
+            out["bf16_trials"] = [round(t, 1) for t in bf16_trials]
+            if peak_flops and per_img:
+                out["bf16_mfu"] = round(bf16_rate * per_img / peak_flops, 4)
+        else:
+            out["bf16_error"] = "all bf16 trials exceeded the device FLOP peak (runtime glitch)"
+    except Exception as e:  # the f32 headline must survive a fast-path failure
+        out["bf16_error"] = str(e)[:200]
     return out
 
 

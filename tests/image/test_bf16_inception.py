@@ -41,13 +41,15 @@ def test_bf16_features_close_to_f32(extractors):
     denom = max(1.0, float(np.abs(a).max()))
     drift = float(np.abs(a - b).max()) / denom
     assert drift < 0.01, drift
-    # and the two runs share the SAME f32 master params
+    # and the two runs share the SAME f32 master params — every leaf
     import jax
 
     leaves_a = jax.tree_util.tree_leaves(f32.params)
     leaves_b = jax.tree_util.tree_leaves(bf16.params)
-    np.testing.assert_array_equal(np.asarray(leaves_a[0]), np.asarray(leaves_b[0]))
-    assert np.asarray(leaves_b[0]).dtype == np.float32  # master stays f32
+    assert len(leaves_a) == len(leaves_b)
+    for la, lb in zip(leaves_a, leaves_b):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+        assert np.asarray(lb).dtype == np.float32  # master stays f32
 
 
 def test_bf16_fid_value_close_to_f32(extractors):
