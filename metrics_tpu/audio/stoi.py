@@ -1,6 +1,9 @@
-"""STOI module metric (wraps the native ``pystoi`` package, host-side DSP).
+"""STOI module metric — native on-device DSP.
 
-Parity: reference ``torchmetrics/audio/stoi.py:23``.
+Parity: reference ``torchmetrics/audio/stoi.py:23`` (which *requires* the
+host-side ``pystoi`` package and raises without it). This build implements the
+STOI/ESTOI DSP natively in jnp (``functional/audio/stoi.py``), so the module
+always works and the per-update scores run jitted on the accelerator.
 """
 from typing import Any
 
@@ -8,23 +11,18 @@ import jax
 import jax.numpy as jnp
 
 from metrics_tpu.metric import Metric
-from metrics_tpu.utils.imports import _PYSTOI_AVAILABLE
 
 Array = jax.Array
 
 
 class STOI(Metric):
-    """Short-time objective intelligibility."""
+    """Short-time objective intelligibility (averaged over updates)."""
 
     is_differentiable = False
     higher_is_better = True
 
     def __init__(self, fs: int, extended: bool = False, **kwargs: Any) -> None:
         super().__init__(**kwargs)
-        if not _PYSTOI_AVAILABLE:
-            raise ModuleNotFoundError(
-                "STOI metric requires that pystoi is installed. Either install as `pip install pystoi`."
-            )
         self.fs = fs
         self.extended = extended
         self.add_state("sum_stoi", default=jnp.asarray(0.0), dist_reduce_fx="sum")

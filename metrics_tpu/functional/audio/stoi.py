@@ -1,47 +1,235 @@
-"""Stateless functional STOI.
+"""Stateless functional STOI — native on-device DSP (no pystoi dependency).
 
-Parity: reference ``torchmetrics/functional/audio/stoi.py:28`` — the DSP runs
-in the native ``pystoi`` package on the host (same backend the reference
-wraps); scores return as device arrays. Input ``[..., time]`` -> ``[...]``.
+Parity target: reference ``torchmetrics/functional/audio/stoi.py:28``, which
+*requires* the native ``pystoi`` package and runs the DSP per-signal on the
+host. This build implements the STOI algorithm (Taal et al., "An Algorithm for
+Intelligibility Prediction of Time-Frequency Weighted Noisy Speech", IEEE TASL
+2011 — the spec pystoi transcribes) directly in jnp with static shapes, so it
+runs jitted/vmapped on TPU and needs no host round-trips:
+
+* polyphase resampling to the 10 kHz model rate (scipy ``resample_poly``
+  semantics: kaiser-5.0 windowed-sinc, one dilated/strided conv on device);
+* silent-frame removal (40 dB dynamic range on the clean signal's windowed
+  frame energies) with static shapes: frames are compacted by a stable
+  argsort-gather and overlap-added into a fixed-size buffer, with the kept
+  count carried as data;
+* 256-sample hann frames / 512-pt rFFT / 15 one-third-octave bands (150 Hz
+  lowest center);
+* 30-frame sliding segments; standard mode clips the normalized degraded
+  segment at -15 dB SDR and averages band correlations, extended mode (ESTOI,
+  Jensen & Taal 2016) row+column-normalizes each segment.
+
+Dynamic frame counts are handled branch-free (validity masks), so the whole
+metric is one compiled program per (length, fs, extended) signature. Fewer
+than 30 frames after silent-frame removal returns 1e-5 (pystoi's contract).
+
+Oracle coverage: ``tests/audio/test_stoi_native.py`` checks the resampler
+against ``scipy.signal.resample_poly``, the full pipeline against an
+independent host numpy implementation, the perfect-intelligibility fixed
+point, SNR monotonicity, and (gated) pystoi itself when installed.
 """
-from typing import Any
+from functools import lru_cache, partial
+from math import gcd
+from typing import Any, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu.utils.checks import _check_same_shape
-from metrics_tpu.utils.imports import _PYSTOI_AVAILABLE
 
 Array = jax.Array
+
+FS = 10_000          # internal model rate (Hz)
+N_FRAME = 256        # analysis window
+NFFT = 512
+HOP = N_FRAME // 2
+NUM_BANDS = 15
+MIN_FREQ = 150.0     # center frequency of the lowest third-octave band
+N_SEG = 30           # frames per intermediate-intelligibility segment
+BETA = -15.0         # lower SDR clip bound (dB)
+DYN_RANGE = 40.0     # silent-frame energy range (dB)
+_EPS = float(np.finfo(np.float32).eps)
+
+
+@lru_cache(maxsize=None)
+def _resample_plan(fs_in: int, fs_out: int):
+    """(taps, up, down, n_pre_remove) for scipy-style resample_poly, or None."""
+    g = gcd(fs_in, fs_out)
+    up, down = fs_out // g, fs_in // g
+    if up == down:
+        return None
+    max_rate = max(up, down)
+    f_c = 1.0 / max_rate
+    half_len = 10 * max_rate
+    m = np.arange(-half_len, half_len + 1, dtype=np.float64)
+    h = f_c * np.sinc(f_c * m) * np.kaiser(2 * half_len + 1, 5.0)
+    h /= h.sum()          # firwin lowpass scaling: unit DC response
+    h *= up               # resample_poly gain compensation
+    # align the output grid the way scipy does: left-pad the filter so the
+    # first kept output sample sits on the input's t=0
+    n_pre_pad = (down - half_len % down) % down
+    n_pre_remove = (half_len + n_pre_pad) // down
+    h = np.concatenate([np.zeros(n_pre_pad), h])
+    # cache HOST arrays: a jnp constant materialised inside a jit trace is a
+    # tracer, and caching it would leak it into later traces
+    return np.asarray(h, np.float32), up, down, n_pre_remove
+
+
+def _resample(x: Array, fs_in: int, fs_out: int) -> Array:
+    """Polyphase resample along the last axis (scipy resample_poly semantics)."""
+    plan = _resample_plan(int(fs_in), int(fs_out))
+    if plan is None:
+        return x
+    taps, up, down, n_pre_remove = plan
+    n_in = x.shape[-1]
+    n_out = -(-n_in * up // down)  # ceil
+    lead = x.shape[:-1]
+    lhs = x.reshape((-1, 1, n_in))
+    # upfirdn(h, x, up, down) as ONE dilated/strided conv: full convolution of
+    # the zero-stuffed signal with the taps, downsampled in the same op
+    k = taps.shape[0]
+    rhs = jnp.asarray(taps[::-1].reshape((1, 1, k)))
+    y = jax.lax.conv_general_dilated(
+        lhs, rhs, window_strides=(down,), padding=((k - 1, k - 1),),
+        lhs_dilation=(up,),
+    )
+    y = y[..., n_pre_remove:n_pre_remove + n_out]
+    return y.reshape(lead + (n_out,))
+
+
+@lru_cache(maxsize=None)
+def _third_octave_matrix() -> Tuple[np.ndarray, int]:
+    """(NUM_BANDS, NFFT//2+1) 0/1 band matrix on the 10 kHz rFFT grid (host)."""
+    f = np.linspace(0, FS, NFFT + 1)[: NFFT // 2 + 1]
+    k = np.arange(NUM_BANDS, dtype=np.float64)
+    freq_low = MIN_FREQ * 2.0 ** ((2 * k - 1) / 6)
+    freq_high = MIN_FREQ * 2.0 ** ((2 * k + 1) / 6)
+    obm = np.zeros((NUM_BANDS, f.size))
+    for i in range(NUM_BANDS):
+        fl = int(np.argmin(np.square(f - freq_low[i])))
+        fh = int(np.argmin(np.square(f - freq_high[i])))
+        obm[i, fl:fh] = 1.0
+    return np.asarray(obm, np.float32), f.size
+
+
+@lru_cache(maxsize=None)
+def _hann_window() -> np.ndarray:
+    # the trimmed hanning pystoi/matlab use: hanning(N+2)[1:-1] (host array;
+    # a jnp constant built under a trace would be a leakable tracer)
+    return np.asarray(np.hanning(N_FRAME + 2)[1:-1], np.float32)
+
+
+def _frame(x: Array) -> Array:
+    """(frames, N_FRAME) strided view at HOP."""
+    n_frames = (x.shape[-1] - N_FRAME) // HOP + 1
+    offs = jnp.arange(n_frames)[:, None] * HOP + jnp.arange(N_FRAME)[None, :]
+    return x[offs]
+
+
+def _stoi_single(deg: Array, clean: Array, fs: int, extended: bool) -> Array:
+    """STOI of one (degraded, clean) pair, fully in-trace, static shapes."""
+    deg = _resample(deg, fs, FS)
+    clean = _resample(clean, fs, FS)
+    if clean.shape[-1] < N_FRAME:
+        raise ValueError(
+            f"STOI needs at least {N_FRAME} samples at {FS} Hz after resampling; "
+            f"got {clean.shape[-1]} (input rate {fs} Hz)."
+        )
+    w = jnp.asarray(_hann_window())
+
+    # ---- silent-frame removal (clean-signal energies, 40 dB range) ----------
+    clean_frames = _frame(clean) * w          # (F, N_FRAME)
+    deg_frames = _frame(deg) * w
+    n_f = clean_frames.shape[0]
+    energies = 20.0 * jnp.log10(jnp.linalg.norm(clean_frames, axis=-1) + _EPS)
+    keep = energies > (jnp.max(energies) - DYN_RANGE)
+    n_kept = jnp.sum(keep.astype(jnp.int32))
+    # stable compaction: kept frames first, original order preserved
+    order = jnp.argsort(~keep, stable=True)
+    valid = jnp.arange(n_f) < n_kept
+    clean_kept = jnp.where(valid[:, None], clean_frames[order], 0.0)
+    deg_kept = jnp.where(valid[:, None], deg_frames[order], 0.0)
+    # overlap-add reconstruction into a fixed-size buffer (hann @ 50% overlap)
+    n_buf = (n_f - 1) * HOP + N_FRAME
+    offs = jnp.arange(n_f)[:, None] * HOP + jnp.arange(N_FRAME)[None, :]
+    clean_sil = jnp.zeros((n_buf,), clean.dtype).at[offs].add(clean_kept)
+    deg_sil = jnp.zeros((n_buf,), deg.dtype).at[offs].add(deg_kept)
+
+    # ---- STFT -> third-octave band envelopes --------------------------------
+    obm = jnp.asarray(_third_octave_matrix()[0])
+    spec_c = jnp.fft.rfft(_frame(clean_sil) * w, n=NFFT)   # (F, NFFT/2+1)
+    spec_d = jnp.fft.rfft(_frame(deg_sil) * w, n=NFFT)
+    x_tob = jnp.sqrt(jnp.abs(spec_c) ** 2 @ obm.T)          # clean    (F, 15)
+    y_tob = jnp.sqrt(jnp.abs(spec_d) ** 2 @ obm.T)          # degraded (F, 15)
+
+    # ---- 30-frame sliding segments ------------------------------------------
+    n_seg = n_f - N_SEG + 1
+    if n_seg < 1:
+        return jnp.float32(1e-5)
+    seg_ix = jnp.arange(n_seg)[:, None] + jnp.arange(N_SEG)[None, :]
+    x_seg = jnp.transpose(x_tob[seg_ix], (0, 2, 1))         # (S, 15, N_SEG)
+    y_seg = jnp.transpose(y_tob[seg_ix], (0, 2, 1))
+    # frames past the compacted signal are synthetic zeros: a segment is real
+    # only when all its N_SEG frames come from kept audio
+    seg_ok = (jnp.arange(n_seg) + N_SEG) <= n_kept
+    n_valid = jnp.sum(seg_ok.astype(jnp.float32))
+
+    if extended:
+        def row_col_norm(s):
+            s = s - jnp.mean(s, axis=-1, keepdims=True)
+            s = s / (jnp.linalg.norm(s, axis=-1, keepdims=True) + _EPS)
+            s = s - jnp.mean(s, axis=-2, keepdims=True)
+            return s / (jnp.linalg.norm(s, axis=-2, keepdims=True) + _EPS)
+
+        per_seg = jnp.sum(row_col_norm(x_seg) * row_col_norm(y_seg), axis=(1, 2)) / N_SEG
+        total = jnp.sum(jnp.where(seg_ok, per_seg, 0.0))
+        score = total / jnp.maximum(n_valid, 1.0)
+    else:
+        # normalize the degraded segment's energy per band to the clean one,
+        # clip at -BETA dB SDR, then per-band Pearson correlation
+        alpha = jnp.linalg.norm(x_seg, axis=-1, keepdims=True) / (
+            jnp.linalg.norm(y_seg, axis=-1, keepdims=True) + _EPS
+        )
+        y_prime = jnp.minimum(y_seg * alpha, x_seg * (1.0 + 10.0 ** (-BETA / 20.0)))
+        xc = x_seg - jnp.mean(x_seg, axis=-1, keepdims=True)
+        yc = y_prime - jnp.mean(y_prime, axis=-1, keepdims=True)
+        xc = xc / (jnp.linalg.norm(xc, axis=-1, keepdims=True) + _EPS)
+        yc = yc / (jnp.linalg.norm(yc, axis=-1, keepdims=True) + _EPS)
+        per_seg = jnp.sum(xc * yc, axis=(1, 2))             # sum over bands
+        total = jnp.sum(jnp.where(seg_ok, per_seg, 0.0))
+        score = total / (jnp.maximum(n_valid, 1.0) * NUM_BANDS)
+
+    # pystoi contract: fewer than N_SEG frames after silence removal -> 1e-5
+    return jnp.where(n_valid > 0, score, jnp.float32(1e-5))
+
+
+@partial(jax.jit, static_argnames=("fs", "extended"))
+def _stoi_batch(deg: Array, clean: Array, fs: int, extended: bool) -> Array:
+    if deg.ndim == 1:
+        return _stoi_single(deg, clean, fs, extended)
+    flat_d = deg.reshape((-1, deg.shape[-1]))
+    flat_c = clean.reshape((-1, clean.shape[-1]))
+    out = jax.vmap(lambda d, c: _stoi_single(d, c, fs, extended))(flat_d, flat_c)
+    return out.reshape(deg.shape[:-1])
 
 
 def stoi(preds: Any, target: Any, fs: int, extended: bool = False, keep_same_device: bool = False) -> Array:
     """Short-time objective intelligibility.
 
     Args:
-        preds: estimated signal, shape ``[..., time]``.
-        target: reference signal, shape ``[..., time]``.
+        preds: estimated (degraded) signal, shape ``[..., time]``.
+        target: reference (clean) signal, shape ``[..., time]``.
         fs: sampling frequency in Hz.
         extended: use the extended (ESTOI) variant.
         keep_same_device: accepted for reference API compatibility; scores are
             returned as device arrays either way.
+
+    Unlike the reference (which refuses to run without the host-side
+    ``pystoi`` package, ``torchmetrics/audio/stoi.py:23``), the DSP is native
+    jnp: jitted, vmapped over leading dims, TPU-resident end to end.
     """
-    if not _PYSTOI_AVAILABLE:
-        raise ModuleNotFoundError(
-            "STOI metric requires that pystoi is installed. Either install as `pip install pystoi`."
-        )
-    from pystoi import stoi as stoi_backend
-
-    preds_np = np.asarray(preds)
-    target_np = np.asarray(target)
-    _check_same_shape(preds_np, target_np)
-
-    if preds_np.ndim == 1:
-        return jnp.asarray(stoi_backend(target_np, preds_np, fs, extended=extended), dtype=jnp.float32)
-    flat_p = preds_np.reshape(-1, preds_np.shape[-1])
-    flat_t = target_np.reshape(-1, target_np.shape[-1])
-    scores = np.empty(flat_p.shape[0], dtype=np.float32)
-    for b in range(flat_p.shape[0]):
-        scores[b] = stoi_backend(flat_t[b], flat_p[b], fs, extended=extended)
-    return jnp.asarray(scores.reshape(preds_np.shape[:-1]))
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target, jnp.float32)
+    _check_same_shape(preds, target)
+    return _stoi_batch(preds, target, int(fs), bool(extended))

@@ -70,20 +70,48 @@ def _jit_with_eager_fallback(fn: Callable) -> Callable:
     return wrapped
 
 
-def _jitted_forward(key_obj: Any, fn: Callable) -> Callable:
-    """Bounded-LRU lookup of the compiled forward for this encoder object."""
-    key = id(key_obj)
+def _is_prejitted(fn: Callable) -> bool:
+    """True for callables that handle their own compilation — a ``jax.jit``
+    product, or anything flagged ``_metrics_tpu_prejitted``. Re-jitting such a
+    callable would inline it and bake its closed-over params into the HLO as
+    literal constants — for a BERT-base encoder that is a ~400 MB program
+    (observed as an HTTP 413 from a remote-compile service)."""
+    if getattr(fn, "_metrics_tpu_prejitted", False):
+        return True
+    try:
+        return isinstance(fn, jax.stages.Wrapped)
+    except AttributeError:  # pragma: no cover - older jax without jax.stages
+        return False
+
+
+def _cache_get(key: Any, pins: Tuple) -> Optional[Callable]:
+    """LRU hit iff every pinned object is still the same identity."""
     hit = _JIT_FORWARD_CACHE.get(key)
-    # the (key_obj, ...) tuple pins the object so its id can't be recycled
-    if hit is not None and hit[0] is key_obj:
+    if hit is not None and len(hit[0]) == len(pins) and all(
+        a is b for a, b in zip(hit[0], pins)
+    ):
         _JIT_FORWARD_CACHE.move_to_end(key)
         return hit[1]
-    compiled = _jit_with_eager_fallback(fn)
-    _JIT_FORWARD_CACHE[key] = (key_obj, compiled)
+    return None
+
+
+def _cache_put(key: Any, pins: Tuple, compiled: Callable) -> Callable:
+    # the pinned objects keep their ids from being recycled while cached
+    _JIT_FORWARD_CACHE[key] = (pins, compiled)
     _JIT_FORWARD_CACHE.move_to_end(key)
     while len(_JIT_FORWARD_CACHE) > _JIT_FORWARD_CACHE_MAX:
         _JIT_FORWARD_CACHE.popitem(last=False)
     return compiled
+
+
+def _jitted_forward(key_obj: Any, fn: Callable) -> Callable:
+    """Bounded-LRU lookup of the compiled forward for this encoder object."""
+    key = id(key_obj)
+    hit = _cache_get(key, (key_obj,))
+    if hit is not None:
+        return hit
+    compiled = fn if _is_prejitted(fn) else _jit_with_eager_fallback(fn)
+    return _cache_put(key, (key_obj,), compiled)
 
 
 def _simple_whitespace_tokenizer(sentences: List[str], max_length: int) -> Dict[str, np.ndarray]:
@@ -173,14 +201,77 @@ def _resolve_forward(
     user_forward_fn: Optional[Callable],
     model: Optional[Any],
     model_name_or_path: Optional[str],
+    mesh: Optional[Any] = None,
+    mesh_axis: Any = "dp",
 ) -> Callable:
     """Resolve the encoder callable (priority: fn > model > local path) and
     return its jit-compiled, cached form. Shared by the functional and the
-    module APIs."""
+    module APIs.
+
+    ``mesh``: run the encoder batch-parallel under ``shard_map`` over the
+    mesh's ``mesh_axis`` (ids/mask batch-sharded, params replicated via the
+    encoder closure) — the sharded embedded-model path the reference drives
+    with a DataLoader + per-process model (``bert.py:256-341``). The compiled
+    cache is keyed on (encoder, mesh, axis) so the same encoder can serve both
+    layouts without retracing.
+    """
+    def _wrap(key_obj: Any, fn: Callable) -> Callable:
+        if mesh is None or _is_prejitted(fn):
+            # prejitted callables own their compilation AND sharding (the hf
+            # path below builds its mesh form itself; re-wrapping would bake
+            # its params into the program as constants)
+            return _jitted_forward(key_obj, fn)
+        from metrics_tpu.parallel.embedded import shard_batch_forward
+
+        key = (id(key_obj), id(mesh), str(mesh_axis))
+        hit = _cache_get(key, (key_obj, mesh))
+        if hit is not None:
+            return hit
+        # gather inside the compiled forward (out_axis=None): embeddings leave
+        # replicated, so the host-side batching/concat path stays collective-free
+        compiled = shard_batch_forward(fn, mesh, mesh_axis, out_axis=None)
+        return _cache_put(key, (key_obj, mesh), compiled)
+
+    def _wrap_hf_style(hf_model: Any) -> Callable:
+        """HF Flax models: params enter as RUNTIME ARGUMENTS, never via
+        closure — a closure capture would inline the whole weight pytree into
+        the compiled program as constants (~4 bytes/param of HLO: hundreds of
+        MB for a base-size encoder, and a hard 413 on remote-compile
+        services). Cached under a mesh-aware key (the same model can serve
+        both layouts)."""
+        key = (id(hf_model), id(mesh) if mesh is not None else None, str(mesh_axis))
+        hit = _cache_get(key, (hf_model, mesh))
+        if hit is not None:
+            return hit
+
+        def hf_fwd(p, ids, mask):
+            return hf_model(input_ids=ids, attention_mask=mask, params=p).last_hidden_state
+
+        if mesh is None:
+            jfn = jax.jit(hf_fwd)
+        else:
+            from metrics_tpu.parallel.embedded import shard_batch_forward
+
+            jfn = shard_batch_forward(
+                hf_fwd, mesh, mesh_axis, out_axis=None, replicated_argnums=(0,)
+            )
+
+        def forward(ids, mask):
+            return jfn(hf_model.params, ids, mask)
+
+        forward._metrics_tpu_prejitted = True
+        return _cache_put(key, (hf_model, mesh), forward)
+
     if user_forward_fn is not None:
-        return _jitted_forward(user_forward_fn, user_forward_fn)
+        return _wrap(user_forward_fn, user_forward_fn)
     if model is not None:
-        return _jitted_forward(model, lambda ids, mask: model(ids, mask))
+        if _is_prejitted(model):
+            return _wrap(model, model)  # owns its compilation; used as-is
+        if hasattr(model, "params") and hasattr(model, "config"):
+            # an HF Flax model object passed directly: same params-as-args
+            # wiring as the model_name_or_path branch
+            return _wrap_hf_style(model)
+        return _wrap(model, lambda ids, mask: model(ids, mask))
     if model_name_or_path is not None:
         from transformers import FlaxAutoModel
 
@@ -191,11 +282,7 @@ def _resolve_forward(
             _LOADED_MODEL_CACHE.move_to_end(model_name_or_path)
             while len(_LOADED_MODEL_CACHE) > _LOADED_MODEL_CACHE_MAX:
                 _LOADED_MODEL_CACHE.popitem(last=False)
-        hf_model = hit
-        return _jitted_forward(
-            hf_model,
-            lambda ids, mask: hf_model(input_ids=ids, attention_mask=mask).last_hidden_state,
-        )
+        return _wrap_hf_style(hit)
     raise ValueError(
         "BERTScore needs an encoder: pass `user_forward_fn`, `model`, or a local `model_name_or_path`"
         " (this build cannot download pretrained weights)."
@@ -393,6 +480,8 @@ def bert_score(
     rescale_with_baseline: bool = False,
     baseline_path: Optional[str] = None,
     baseline_url: Optional[str] = None,
+    mesh: Optional[Any] = None,
+    mesh_axis: Any = "dp",
 ) -> Dict[str, Union[List[float], str]]:
     """Compute BERTScore P/R/F1 per sentence pair.
 
@@ -405,6 +494,10 @@ def bert_score(
     (``python tools/convert_weights.py bert <torch_dir> <flax_dir>``) and pass
     ``model_name_or_path=<flax_dir>`` with its tokenizer — the full local pipeline
     is exercised in ``tests/text/test_bert_e2e.py``.
+
+    ``mesh=`` shards the encoder batch over the mesh's ``mesh_axis`` (params
+    replicated) so the embedding forward scales data-parallel; sharded ==
+    single-device parity is proven in ``tests/parallel/test_sharded_embedded.py``.
     """
     if len(predictions) != len(references):
         raise ValueError("Number of predicted and reference sentences must be the same!")
@@ -431,7 +524,7 @@ def bert_score(
     pred_ids, pred_mask = ids_u[inverse[:n]], mask_u[inverse[:n]]
     tgt_ids, tgt_mask = ids_u[inverse[n:]], mask_u[inverse[n:]]
 
-    forward = _resolve_forward(user_forward_fn, model, model_name_or_path)
+    forward = _resolve_forward(user_forward_fn, model, model_name_or_path, mesh, mesh_axis)
     precision, recall, f1 = _score_tokenized(
         forward, pred_ids, pred_mask, tgt_ids, tgt_mask, idf=idf, batch_size=batch_size,
         dedup=(ids_u, mask_u, inverse),  # text-level structure, computed above

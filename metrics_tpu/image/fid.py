@@ -129,10 +129,19 @@ class FID(Metric):
         params: Optional[Any] = None,
         feature_dim: Optional[int] = None,
         streaming: Optional[bool] = None,
+        mesh: Optional[Any] = None,
+        mesh_axis: Any = "dp",
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
         if callable(feature):
+            if mesh is not None:
+                raise ValueError(
+                    "FID(mesh=...) only applies to the built-in InceptionV3 "
+                    "(feature=64/192/768/2048). For a callable `feature`, shard it "
+                    "yourself with metrics_tpu.parallel.shard_batch_forward(fn, mesh) "
+                    "and pass the wrapped callable."
+                )
             self.inception = feature
         else:
             valid_int_input = ("64", "192", "768", "2048")
@@ -142,7 +151,12 @@ class FID(Metric):
                 )
             from metrics_tpu.models.inception import FEATURE_DIMS, InceptionFeatureExtractor
 
-            self.inception = InceptionFeatureExtractor(feature=str(feature), params=params)
+            # mesh: run the inception forward batch-parallel over the mesh's
+            # data axis (params replicated) — the sharded embedded-model path.
+            # IS/KID take the same layout via feature=InceptionFeatureExtractor(mesh=...).
+            self.inception = InceptionFeatureExtractor(
+                feature=str(feature), params=params, mesh=mesh, mesh_axis=mesh_axis
+            )
             if feature_dim is None:
                 feature_dim = FEATURE_DIMS[str(feature)]
 

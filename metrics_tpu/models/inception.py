@@ -248,6 +248,14 @@ class InceptionFeatureExtractor:
 
         ext = InceptionFeatureExtractor(feature="2048", compute_dtype=jnp.bfloat16)
         fid = FID(feature=ext, feature_dim=2048)
+
+    ``mesh=`` runs the forward batch-parallel over the mesh's ``mesh_axis``
+    (params replicated, batch sharded via ``parallel.embedded.shard_batch_forward``)
+    — the TPU-native analogue of the reference's per-process inception + feature
+    all_gather (``torchmetrics/image/fid.py:250-262``). Features come back as a
+    global array batch-sharded over the axis; FID's streaming statistics consume
+    them distributed. Sharded == single-device parity:
+    ``tests/parallel/test_sharded_embedded.py``.
     """
 
     def __init__(
@@ -257,6 +265,8 @@ class InceptionFeatureExtractor:
         input_size: int = 299,
         seed: int = 0,
         compute_dtype: Optional[Any] = None,
+        mesh: Optional[Any] = None,
+        mesh_axis: Any = "dp",
     ) -> None:
         from metrics_tpu.utils.prints import rank_zero_warn
 
@@ -279,9 +289,20 @@ class InceptionFeatureExtractor:
         # effect — the forward reads it per call): the flax layers' `dtype`
         # cast the weights on the fly, which XLA fuses into the consuming ops
         self.params = params
-        self._forward = jax.jit(
-            lambda p, x: self.module.apply(p, x)[self.feature].astype(jnp.float32)
-        )
+        fwd = lambda p, x: self.module.apply(p, x)[self.feature].astype(jnp.float32)
+        if mesh is not None:
+            from metrics_tpu.parallel.embedded import shard_batch_forward
+
+            # out_axis=None: the per-shard features are all_gathered INSIDE the
+            # compiled forward (the reference's feature-gather semantics,
+            # fid.py:250-262) and leave replicated — eager consumers never
+            # touch a live-sharded array (XLA's in-process CPU collectives
+            # deadlock when an eager op implicitly re-shards one)
+            self._forward = shard_batch_forward(
+                fwd, mesh, mesh_axis, out_axis=None, replicated_argnums=(0,)
+            )
+        else:
+            self._forward = jax.jit(fwd)
 
     @staticmethod
     def load_params(path: str) -> Any:

@@ -16,6 +16,10 @@ from metrics_tpu.parallel.collectives import (
     class_reduce,
     sync_axis_state,
 )
+from metrics_tpu.parallel.embedded import (
+    data_parallel_mesh,
+    shard_batch_forward,
+)
 from metrics_tpu.parallel.mesh import (
     MeshConfig,
     current_metric_axis,
@@ -30,10 +34,12 @@ __all__ = [
     "axis_size_or_one",
     "class_reduce",
     "current_metric_axis",
+    "data_parallel_mesh",
     "fused_axis_sync",
     "in_mapped_context",
     "metric_axis",
     "reduce",
     "set_metric_axis",
+    "shard_batch_forward",
     "sync_axis_state",
 ]

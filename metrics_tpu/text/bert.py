@@ -53,6 +53,8 @@ class BERTScore(Metric):
         rescale_with_baseline: bool = False,
         baseline_path: Optional[str] = None,
         baseline_url: Optional[str] = None,
+        mesh: Optional[Any] = None,
+        mesh_axis: Any = "dp",
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -66,8 +68,10 @@ class BERTScore(Metric):
         # out-of-range num_layers) fails fast, and compute() does no file IO
         path = _resolve_baseline_path(rescale_with_baseline, baseline_path, baseline_url)
         self.baseline = _load_baseline_row(path, num_layers) if path is not None else None
-        # resolve eagerly: a missing encoder should fail at construction
-        self.forward_fn = _resolve_forward(user_forward_fn, model, model_name_or_path)
+        # resolve eagerly: a missing encoder should fail at construction.
+        # mesh: the compute()-time encoder forward runs batch-parallel over the
+        # mesh's data axis (sharded embedded-model path, parallel/embedded.py)
+        self.forward_fn = _resolve_forward(user_forward_fn, model, model_name_or_path, mesh, mesh_axis)
 
         self.add_state("preds_input_ids", [], dist_reduce_fx="cat")
         self.add_state("preds_attention_mask", [], dist_reduce_fx="cat")

@@ -53,7 +53,14 @@ def _compute_value_stats(preds, target) -> Optional[_ValueStats]:
     """None under trace (checks are skipped there); else one fused device fetch."""
     if _is_tracer(preds) or _is_tracer(target):
         return None
-    vals = np.asarray(_minmax_bundle(preds, target))
+    try:
+        vals = np.asarray(_minmax_bundle(preds, target))
+    except jax.errors.TracerArrayConversionError:
+        # inputs are CONCRETE but an ambient trace is active (closed-over
+        # constants inside a scan/fori_loop/jit body): the stats computation
+        # stages into that trace, so value checks defer exactly as they do
+        # for traced inputs
+        return None
     return _ValueStats(float(vals[0]), float(vals[1]), float(vals[2]), float(vals[3]))
 
 

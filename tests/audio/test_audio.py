@@ -314,12 +314,13 @@ class TestPIT(MetricTester):
         assert np.isfinite(val)
 
 
-def test_pesq_stoi_gated():
-    """Without the native backends, modules AND functional twins raise cleanly."""
-    from metrics_tpu.audio import PESQ, STOI
+def test_pesq_gated():
+    """Without the native pesq backend, module AND functional twin raise
+    cleanly. (STOI used to be gated the same way; it is native jnp now —
+    ``tests/audio/test_stoi_native.py``.)"""
+    from metrics_tpu.audio import PESQ
     from metrics_tpu.functional import pesq as pesq_fn
-    from metrics_tpu.functional import stoi as stoi_fn
-    from metrics_tpu.utils.imports import _PESQ_AVAILABLE, _PYSTOI_AVAILABLE
+    from metrics_tpu.utils.imports import _PESQ_AVAILABLE
 
     sig = np.random.RandomState(0).randn(8000).astype(np.float32)
     if not _PESQ_AVAILABLE:
@@ -327,11 +328,6 @@ def test_pesq_stoi_gated():
             PESQ(fs=16000, mode="wb")
         with pytest.raises(ModuleNotFoundError):
             pesq_fn(sig, sig, 8000, "nb")
-    if not _PYSTOI_AVAILABLE:
-        with pytest.raises(ModuleNotFoundError):
-            STOI(fs=16000)
-        with pytest.raises(ModuleNotFoundError):
-            stoi_fn(sig, sig, 16000)
 
 
 def _available(flag_name):
@@ -358,7 +354,6 @@ def test_pesq_functional_matches_module():
         pesq_fn(batch, ref, 8000, "xx")
 
 
-@pytest.mark.skipif(not _available("_PYSTOI_AVAILABLE"), reason="pystoi backend not installed")
 def test_stoi_functional_matches_module():
     from metrics_tpu.audio import STOI
     from metrics_tpu.functional import stoi as stoi_fn

@@ -184,3 +184,29 @@ def test_merge_states_union_of_children():
     assert "_children" in merged
     out = m.compute_from(merged)
     assert float(out["raw"]) == 1.0
+
+
+def test_update_with_closed_over_constants_in_compiled_loop():
+    """Concrete arrays captured by a jitted fori_loop body stage into the
+    ambient trace; the eager value checks must defer (not crash with
+    TracerArrayConversionError) — the compiled-epoch pattern bench.py and real
+    TPU eval loops use with device-resident batches."""
+    import jax
+    from metrics_tpu import Accuracy
+
+    acc = Accuracy()
+    rng = np.random.RandomState(0)
+    preds = jnp.asarray(rng.rand(32, 5).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, 5, 32))
+
+    @jax.jit
+    def epoch(state):
+        def body(i, s):
+            return acc.update_state(s, preds, target)  # closed over, concrete
+
+        return jax.lax.fori_loop(0, 3, body, state)
+
+    state = epoch(acc.init_state())
+    got = float(acc.compute_from(state))
+    acc.update(preds, target)
+    np.testing.assert_allclose(got, float(acc.compute()), atol=1e-6)

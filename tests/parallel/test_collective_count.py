@@ -138,3 +138,70 @@ def test_fused_gather_values_match_per_leaf(devices):
     outs = jax.jit(step)(jnp.arange(8.0))
     for got, exp in zip(outs[:3], outs[3:]):
         np.testing.assert_array_equal(np.asarray(got), np.asarray(exp))
+
+
+# ---------------------------------------------------------- 64/256-device floor
+
+_LARGE_MESH_CODE = r"""
+import json, re
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+from functools import partial
+from jax.sharding import Mesh, PartitionSpec as P
+
+from metrics_tpu import AUROC, Accuracy, BinnedAveragePrecision, F1Score, MetricCollection
+
+N = len(jax.devices())
+coll = MetricCollection({
+    "acc": Accuracy(),
+    "f1": F1Score(num_classes=10, average="macro"),
+    "binned_ap": BinnedAveragePrecision(num_classes=10, thresholds=50),
+    "auroc": AUROC(num_classes=10, capacity=4 * N),
+})
+mesh = Mesh(np.asarray(jax.devices()), ("dp",))
+
+@partial(jax.shard_map, mesh=mesh, in_specs=(P("dp"), P("dp")), out_specs=P(), check_vma=False)
+def step(p, t):
+    state = coll.update_state(coll.init_state(), p, t)
+    synced = coll.sync_states(state, "dp")
+    return sum(jnp.sum(l) for l in jax.tree.leaves(synced))
+
+preds = jnp.zeros((N * 4, 10), jnp.float32)
+target = jnp.zeros((N * 4,), jnp.int32)
+hlo = jax.jit(step).lower(preds, target).compile().as_text()
+print(json.dumps({
+    "devices": N,
+    "all-reduce": len(re.findall(r"\ball-reduce(?:-start)?\(", hlo)),
+    "all-gather": len(re.findall(r"\ball-gather(?:-start)?\(", hlo)),
+}))
+"""
+
+
+@pytest.mark.parametrize("n_devices", [64, 256])
+def test_collective_floor_holds_at_scale(n_devices):
+    """The {1 all-reduce, 1 all-gather} floor is device-count-independent —
+    the compiled-HLO fact behind the 256-chip latency model in
+    ``docs/distributed.md`` (BASELINE.md's 8->256 axis). Compiled in a
+    subprocess with an n-device virtual CPU platform; SPMD compiles one
+    program, so this is a compile-only check."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "").replace("--xla_force_host_platform_device_count=8", "")
+        + f" --xla_force_host_platform_device_count={n_devices}"
+    )
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-c", _LARGE_MESH_CODE], env=env, capture_output=True,
+        text=True, timeout=600,
+        cwd=os.path.join(os.path.dirname(__file__), "..", ".."),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out == {"devices": n_devices, "all-reduce": 1, "all-gather": 1}, out

@@ -1,0 +1,152 @@
+"""Golden-tap regression kit: converter drift must fail a test.
+
+The real pretrained checkpoints (torch-fidelity InceptionV3, lpips VGG, HF
+BERT — see ``checkpoint_manifest.json``) cannot be fetched in this zero-egress
+build, so converter correctness is proven structurally (graph-parity tests vs
+torch mirrors). What those tests can't catch is *drift*: a converter change
+that still zips shapes correctly but alters numerics would silently change
+every future FID/LPIPS/BERTScore computed from converted weights.
+
+This kit pins the whole conversion pipeline numerically:
+
+* a SYNTHETIC deterministic checkpoint (seeded torch mirror) stands in for the
+  real file; its identity is the sha256 over the state-dict values in key
+  order (stable across torch serialization changes, unlike file bytes);
+* the checkpoint goes through the REAL converter
+  (``convert_weights.convert_conv_bn_model`` / transformers pt->flax);
+* a fixed-seed input's feature taps through the converted flax model are the
+  golden values, committed as small ``.npz`` files under
+  ``tests/tools/golden/``.
+
+``tests/tools/test_golden_taps.py`` regenerates the pipeline end-to-end and
+compares against the committed goldens: any numeric change in the converter,
+the flax model graphs, or the layout rules turns the test red. Regenerate
+intentionally with ``python tools/golden_taps.py``.
+
+Match: reference ``torchmetrics/image/fid.py:242`` (runtime download of the
+hash-named checkpoint — its drift story is "the URL's hash changed").
+"""
+import hashlib
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+GOLDEN_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests", "tools", "golden"
+)
+
+
+def state_dict_sha256(state_np) -> str:
+    """sha256 over (name, shape, f32 bytes) in key order — serialization-proof."""
+    h = hashlib.sha256()
+    for k in sorted(state_np):
+        v = np.ascontiguousarray(np.asarray(state_np[k], dtype=np.float32))
+        h.update(k.encode())
+        h.update(str(v.shape).encode())
+        h.update(v.tobytes())
+    return h.hexdigest()
+
+
+def build_inception_case():
+    """(state_np, taps dict) — synthetic ckpt through the real converter."""
+    import torch
+
+    import jax
+    import jax.numpy as jnp
+
+    from convert_weights import _template_device, convert_conv_bn_model
+    from torch_mirrors import TorchFidInception
+    from metrics_tpu.models.inception import InceptionV3
+
+    torch.manual_seed(20260731)
+    tmodel = TorchFidInception()
+    tmodel.train()
+    with torch.no_grad():  # non-trivial BN running stats
+        for _ in range(2):
+            tmodel(torch.randint(0, 256, (2, 3, 299, 299), dtype=torch.uint8))
+    tmodel.eval()
+    state_np = {k: v.numpy() for k, v in tmodel.state_dict().items() if k != "fc.bias"}
+
+    module = InceptionV3()
+    with _template_device():
+        template = module.init(jax.random.PRNGKey(0), jnp.zeros((1, 299, 299, 3)))
+    variables = convert_conv_bn_model(state_np, template)
+
+    imgs = np.random.RandomState(42).randint(0, 256, size=(2, 299, 299, 3)).astype(np.uint8)
+    got = jax.jit(module.apply)(variables, jnp.asarray(imgs))
+    taps = {k: np.asarray(v, np.float32) for k, v in got.items()}
+    return state_np, taps
+
+
+def build_lpips_case():
+    """Synthetic lpips-style checkpoint through the real VGG-LPIPS converter.
+
+    Goldens: per-tap channel means (drift-sensitive at every layer) plus the
+    end-to-end LPIPS distances through the public metric.
+    """
+    import tempfile
+
+    import torch
+
+    import jax.numpy as jnp
+
+    from convert_weights import convert_lpips
+    from torch_mirrors import TorchVggLpips, save_lpips_style_state
+    from metrics_tpu.models.perceptual import LPIPSFeatureNet
+    from metrics_tpu.image.lpip_similarity import _lpips_from_features
+
+    torch.manual_seed(20260731)
+    tmodel = TorchVggLpips().eval()
+    with torch.no_grad():  # non-negative lin heads, as lpips learns them
+        for lin in tmodel.lins:
+            lin.weight.abs_()
+    state_np = {k: v.numpy() for k, v in tmodel.state_dict().items()}
+
+    with tempfile.TemporaryDirectory() as tmp:
+        pth = os.path.join(tmp, "vgg_synth.pth")
+        save_lpips_style_state(tmodel, pth)
+        out = os.path.join(tmp, "vgg_synth.pkl")
+        convert_lpips(pth, out, net_type="vgg")
+        net = LPIPSFeatureNet(net_type="vgg", params=out)
+
+    rng = np.random.RandomState(7)
+    a = jnp.asarray(rng.rand(2, 64, 64, 3).astype(np.float32) * 2 - 1)
+    b = jnp.asarray(rng.rand(2, 64, 64, 3).astype(np.float32) * 2 - 1)
+    taps_a, taps_b = net(a), net(b)
+    golden = {
+        f"tap{i}_chan_mean": np.asarray(jnp.mean(t, axis=(1, 2)), np.float32)
+        for i, t in enumerate(taps_a)
+    }
+    golden["lpips"] = np.asarray(
+        _lpips_from_features(taps_a, taps_b, net.weights), np.float32
+    ).reshape(-1)
+    return state_np, golden
+
+
+def _pin_backend() -> None:
+    """Match the config the test suite runs under (tests/conftest.py): CPU
+    platform, highest matmul precision. Generation and verification must see
+    the identical backend or the goldens pin the environment, not the code."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_default_matmul_precision", "highest")
+
+
+def generate(golden_dir: str = GOLDEN_DIR) -> None:
+    _pin_backend()
+    os.makedirs(golden_dir, exist_ok=True)
+    for name, builder in (("inception", build_inception_case), ("lpips_vgg", build_lpips_case)):
+        state_np, taps = builder()
+        path = os.path.join(golden_dir, f"{name}_taps.npz")
+        np.savez_compressed(path, ckpt_sha256=state_dict_sha256(state_np), **taps)
+        print(f"wrote {path}: ckpt {state_dict_sha256(state_np)[:16]}…, "
+              + ", ".join(f"{k}{v.shape}" for k, v in taps.items()))
+
+
+if __name__ == "__main__":
+    generate()
