@@ -216,10 +216,18 @@ def _resolve_forward(
     layouts without retracing.
     """
     def _wrap(key_obj: Any, fn: Callable) -> Callable:
+        if mesh is not None and _is_prejitted(fn):
+            # prejitted callables own their compilation AND sharding —
+            # re-wrapping would bake their closed-over params into the program
+            # as constants. We cannot shard them, so say so (the image metrics
+            # raise for the analogous unshardeable-feature case).
+            rank_zero_warn(
+                "bert_score: the encoder is already jit-compiled, so `mesh=` is "
+                "ignored. Shard it yourself with "
+                "metrics_tpu.parallel.shard_batch_forward, or pass an unjitted "
+                "callable / a local model path."
+            )
         if mesh is None or _is_prejitted(fn):
-            # prejitted callables own their compilation AND sharding (the hf
-            # path below builds its mesh form itself; re-wrapping would bake
-            # its params into the program as constants)
             return _jitted_forward(key_obj, fn)
         from metrics_tpu.parallel.embedded import shard_batch_forward
 

@@ -134,31 +134,16 @@ class FID(Metric):
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
-        if callable(feature):
-            if mesh is not None:
-                raise ValueError(
-                    "FID(mesh=...) only applies to the built-in InceptionV3 "
-                    "(feature=64/192/768/2048). For a callable `feature`, shard it "
-                    "yourself with metrics_tpu.parallel.shard_batch_forward(fn, mesh) "
-                    "and pass the wrapped callable."
-                )
-            self.inception = feature
-        else:
-            valid_int_input = ("64", "192", "768", "2048")
-            if str(feature) not in valid_int_input:
-                raise ValueError(
-                    f"Integer input to argument `feature` must be one of {valid_int_input}, but got {feature}."
-                )
-            from metrics_tpu.models.inception import FEATURE_DIMS, InceptionFeatureExtractor
+        from metrics_tpu.models.inception import resolve_feature_extractor
 
-            # mesh: run the inception forward batch-parallel over the mesh's
-            # data axis (params replicated) — the sharded embedded-model path.
-            # IS/KID take the same layout via feature=InceptionFeatureExtractor(mesh=...).
-            self.inception = InceptionFeatureExtractor(
-                feature=str(feature), params=params, mesh=mesh, mesh_axis=mesh_axis
-            )
-            if feature_dim is None:
-                feature_dim = FEATURE_DIMS[str(feature)]
+        # mesh: run the inception forward batch-parallel over the mesh's data
+        # axis (params replicated) — the sharded embedded-model path
+        # (parallel/embedded.py); IS/KID share the same ctor logic.
+        self.inception, builtin_dim = resolve_feature_extractor(
+            "FID", feature, params, mesh, mesh_axis, ("64", "192", "768", "2048")
+        )
+        if feature_dim is None:
+            feature_dim = builtin_dim
 
         if streaming is None:
             streaming = feature_dim is not None

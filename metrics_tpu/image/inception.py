@@ -58,22 +58,19 @@ class IS(Metric):
         seed: Optional[int] = None,
         streaming: bool = False,
         feature_dim: Optional[int] = None,
+        mesh: Optional[Any] = None,
+        mesh_axis: Any = "dp",
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
-        if callable(feature):
-            self.inception = feature
-        else:
-            valid_input = ("logits_unbiased", "64", "192", "768", "2048")
-            if str(feature) not in valid_input:
-                raise ValueError(
-                    f"Input to argument `feature` must be one of {valid_input}, but got {feature}."
-                )
-            from metrics_tpu.models.inception import FEATURE_DIMS, InceptionFeatureExtractor
+        from metrics_tpu.models.inception import resolve_feature_extractor
 
-            self.inception = InceptionFeatureExtractor(feature=str(feature), params=params)
-            if feature_dim is None:
-                feature_dim = FEATURE_DIMS[str(feature)]
+        self.inception, builtin_dim = resolve_feature_extractor(
+            "InceptionScore", feature, params, mesh, mesh_axis,
+            ("logits_unbiased", "64", "192", "768", "2048"),
+        )
+        if feature_dim is None:
+            feature_dim = builtin_dim
 
         self.splits = splits
         # seed=None matches list mode's run-to-run randomised shuffle: draw a

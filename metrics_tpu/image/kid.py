@@ -59,20 +59,16 @@ class KID(Metric):
         coef: float = 1.0,
         params: Optional[Any] = None,
         seed: Optional[int] = None,
+        mesh: Optional[Any] = None,
+        mesh_axis: Any = "dp",
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
-        if callable(feature):
-            self.inception = feature
-        else:
-            valid_int_input = ("64", "192", "768", "2048")
-            if str(feature) not in valid_int_input:
-                raise ValueError(
-                    f"Integer input to argument `feature` must be one of {valid_int_input}, but got {feature}."
-                )
-            from metrics_tpu.models.inception import InceptionFeatureExtractor
+        from metrics_tpu.models.inception import resolve_feature_extractor
 
-            self.inception = InceptionFeatureExtractor(feature=str(feature), params=params)
+        self.inception, _ = resolve_feature_extractor(
+            "KID", feature, params, mesh, mesh_axis, ("64", "192", "768", "2048")
+        )
 
         if not (isinstance(subsets, int) and subsets > 0):
             raise ValueError("Argument `subsets` expected to be integer larger than 0")

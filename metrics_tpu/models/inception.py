@@ -229,6 +229,38 @@ class InceptionV3(nn.Module):
 FEATURE_DIMS = {"64": 64, "192": 192, "768": 768, "2048": 2048, "logits_unbiased": 1008}
 
 
+def resolve_feature_extractor(
+    metric_name: str,
+    feature: Any,
+    params: Optional[Any],
+    mesh: Optional[Any],
+    mesh_axis: Any,
+    valid: Tuple[str, ...],
+) -> Tuple[Callable, Optional[int]]:
+    """Shared FID/IS/KID ctor logic: a callable passes through (``mesh`` is
+    rejected — we can't shard an opaque callable; wrap it with
+    ``parallel.shard_batch_forward`` yourself), a tap name builds the built-in
+    extractor (optionally mesh-sharded). Returns ``(extractor, feature_dim)``
+    with ``feature_dim=None`` for callables."""
+    if callable(feature):
+        if mesh is not None:
+            raise ValueError(
+                f"{metric_name}(mesh=...) only applies to the built-in InceptionV3 "
+                f"(feature in {valid}). For a callable `feature`, shard it yourself "
+                "with metrics_tpu.parallel.shard_batch_forward(fn, mesh) and pass "
+                "the wrapped callable."
+            )
+        return feature, None
+    if str(feature) not in valid:
+        raise ValueError(
+            f"Input to argument `feature` must be one of {valid}, but got {feature}."
+        )
+    extractor = InceptionFeatureExtractor(
+        feature=str(feature), params=params, mesh=mesh, mesh_axis=mesh_axis
+    )
+    return extractor, FEATURE_DIMS[str(feature)]
+
+
 class InceptionFeatureExtractor:
     """Stateful convenience wrapper: jitted inception forward returning one tap.
 
