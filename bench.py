@@ -935,7 +935,8 @@ def bench_bertscore_base() -> dict:
     ach = out.get("encoder_achieved_tflops")
     ceiling = _CALIB.get("measured_matmul_tflops_bf16")
     if ach and ceiling and ach > ceiling:
-        out["encoder_mfu_lower_bound_any_tpu"] = round(ach / 918.0, 4)
+        fastest_tpu_tflops = max(_PEAK_FLOPS.values()) / 1e12
+        out["encoder_mfu_lower_bound_any_tpu"] = round(ach / fastest_tpu_tflops, 4)
         out["hardware_note"] = (
             f"rate exceeds this process's measured bf16 matmul ceiling ({ceiling} "
             "TF/s); tunnel routes executables to heterogeneous accelerators — MFU "

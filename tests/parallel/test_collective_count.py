@@ -141,8 +141,12 @@ def test_fused_gather_values_match_per_leaf(devices):
 
 
 # ---------------------------------------------------------- 64/256-device floor
+#
+# The scale checks compile the SAME fused-sync step in a subprocess with an
+# n-device virtual CPU platform (SPMD compiles one program, so they are
+# compile-only). Shared template: only the mesh/axis construction varies.
 
-_LARGE_MESH_CODE = r"""
+_FLOOR_CODE_TEMPLATE = r"""
 import json, re
 import jax
 jax.config.update("jax_platforms", "cpu")
@@ -152,40 +156,47 @@ from functools import partial
 from jax.sharding import Mesh, PartitionSpec as P
 
 from metrics_tpu import AUROC, Accuracy, BinnedAveragePrecision, F1Score, MetricCollection
+from metrics_tpu.parallel.mesh import MeshConfig
 
 N = len(jax.devices())
-coll = MetricCollection({
+{mesh_setup}
+coll = MetricCollection({{
     "acc": Accuracy(),
     "f1": F1Score(num_classes=10, average="macro"),
     "binned_ap": BinnedAveragePrecision(num_classes=10, thresholds=50),
     "auroc": AUROC(num_classes=10, capacity=4 * N),
-})
-mesh = Mesh(np.asarray(jax.devices()), ("dp",))
+}})
 
-@partial(jax.shard_map, mesh=mesh, in_specs=(P("dp"), P("dp")), out_specs=P(), check_vma=False)
+@partial(jax.shard_map, mesh=mesh, in_specs=(P(AXIS), P(AXIS)), out_specs=P(), check_vma=False)
 def step(p, t):
     state = coll.update_state(coll.init_state(), p, t)
-    synced = coll.sync_states(state, "dp")
+    synced = coll.sync_states(state, SYNC_AXIS)
     return sum(jnp.sum(l) for l in jax.tree.leaves(synced))
 
 preds = jnp.zeros((N * 4, 10), jnp.float32)
 target = jnp.zeros((N * 4,), jnp.int32)
 hlo = jax.jit(step).lower(preds, target).compile().as_text()
-print(json.dumps({
+print(json.dumps({{
     "devices": N,
     "all-reduce": len(re.findall(r"\ball-reduce(?:-start)?\(", hlo)),
     "all-gather": len(re.findall(r"\ball-gather(?:-start)?\(", hlo)),
-}))
+}}))
 """
 
+_DATA_PARALLEL_SETUP = (
+    'mesh = Mesh(np.asarray(jax.devices()), ("dp",))\n'
+    'AXIS = "dp"\n'
+    'SYNC_AXIS = "dp"'
+)
+_MULTISLICE_SETUP = (
+    'cfg = MeshConfig.multi_slice(2, N // 2)\n'
+    'mesh = cfg.make_mesh()\n'
+    'AXIS = ("dcn", "ici")\n'
+    'SYNC_AXIS = cfg.sync_axis'
+)
 
-@pytest.mark.parametrize("n_devices", [64, 256])
-def test_collective_floor_holds_at_scale(n_devices):
-    """The {1 all-reduce, 1 all-gather} floor is device-count-independent —
-    the compiled-HLO fact behind the 256-chip latency model in
-    ``docs/distributed.md`` (BASELINE.md's 8->256 axis). Compiled in a
-    subprocess with an n-device virtual CPU platform; SPMD compiles one
-    program, so this is a compile-only check."""
+
+def _run_floor_check(mesh_setup: str, n_devices: int) -> None:
     import json
     import os
     import subprocess
@@ -198,10 +209,26 @@ def test_collective_floor_holds_at_scale(n_devices):
     )
     env["JAX_PLATFORMS"] = "cpu"
     proc = subprocess.run(
-        [sys.executable, "-c", _LARGE_MESH_CODE], env=env, capture_output=True,
-        text=True, timeout=600,
+        [sys.executable, "-c", _FLOOR_CODE_TEMPLATE.format(mesh_setup=mesh_setup)],
+        env=env, capture_output=True, text=True, timeout=600,
         cwd=os.path.join(os.path.dirname(__file__), "..", ".."),
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     out = json.loads(proc.stdout.strip().splitlines()[-1])
     assert out == {"devices": n_devices, "all-reduce": 1, "all-gather": 1}, out
+
+
+@pytest.mark.parametrize("n_devices", [64, 256])
+def test_collective_floor_holds_at_scale(n_devices):
+    """The {1 all-reduce, 1 all-gather} floor is device-count-independent —
+    the compiled-HLO fact behind the 256-chip latency model in
+    ``docs/distributed.md`` (BASELINE.md's 8->256 axis)."""
+    _run_floor_check(_DATA_PARALLEL_SETUP, n_devices)
+
+
+def test_collective_floor_holds_multislice():
+    """The floor also holds on the two-level (dcn, ici) multi-slice mesh: one
+    logical reduce + one gather cross BOTH interconnect levels (XLA schedules
+    them hierarchically — docs/distributed.md 'Multi-slice'); the metric layer
+    never adds per-level collectives."""
+    _run_floor_check(_MULTISLICE_SETUP, 64)

@@ -13,6 +13,8 @@ import sys
 import numpy as np
 import pytest
 
+import jax.numpy as jnp
+
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..", "tools"))
 
 VOCAB = [
@@ -130,3 +132,45 @@ def test_longest_padding_tokenizer(local_bert):
     ref_out = bert_score(preds, refs, model_name_or_path=flax_dir,
                          user_tokenizer=_hf_tokenizer(tokenizer), max_length=16)
     np.testing.assert_allclose(np.asarray(out["f1"]), np.asarray(ref_out["f1"]), atol=1e-5)
+
+
+def test_hf_model_sharded_parity(local_bert):
+    """The HF-checkpoint path under mesh=: params ride as runtime args through
+    shard_batch_forward's replicated_argnums (NOT closure constants), and the
+    sharded scores equal the single-device run on the same corpus."""
+    from jax.sharding import Mesh
+
+    from metrics_tpu.functional import bert_score
+    from tests.helpers.testers import mesh_devices
+
+    flax_dir, tokenizer = local_bert
+    preds = [f"the cat sat on tok{i}" for i in range(12)]
+    refs = [f"a dog ran in tok{i + 1}" for i in range(12)]
+    kwargs = dict(model_name_or_path=flax_dir,
+                  user_tokenizer=_hf_tokenizer(tokenizer), max_length=16)
+    base = bert_score(preds, refs, **kwargs)
+    mesh = Mesh(np.asarray(mesh_devices()), ("dp",))
+    shard = bert_score(preds, refs, mesh=mesh, **kwargs)
+    for k in ("precision", "recall", "f1"):
+        np.testing.assert_allclose(shard[k], base[k], rtol=1e-5, atol=1e-5)
+
+
+def test_prejitted_encoder_with_mesh_warns():
+    """An already-jitted encoder cannot be re-sharded: mesh= is ignored with a
+    warning (the image metrics raise for the analogous case)."""
+    import warnings
+
+    import jax
+    from jax.sharding import Mesh
+
+    from metrics_tpu.functional import bert_score
+    from tests.helpers.testers import mesh_devices
+
+    enc = jax.jit(lambda ids, mask: jnp.sin(ids[..., None] * jnp.arange(1.0, 9.0)))
+    mesh = Mesh(np.asarray(mesh_devices()), ("dp",))
+    preds, refs = ["tok1 cat"] * 4, ["tok2 dog"] * 4
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        out = bert_score(preds, refs, user_forward_fn=enc, max_length=8, mesh=mesh)
+    assert any("mesh" in str(w.message) for w in caught), [str(w.message) for w in caught]
+    assert len(out["f1"]) == 4
