@@ -28,7 +28,13 @@ def _run_example(name: str, timeout: int = 420) -> subprocess.CompletedProcess:
 
 
 @pytest.mark.parametrize(
-    "script", ["data_parallel_metrics.py", "detection_map.py", "bert_score_own_model.py"]
+    "script",
+    [
+        "data_parallel_metrics.py",
+        "detection_map.py",
+        "bert_score_own_model.py",
+        "sharded_embedded_models.py",
+    ],
 )
 def test_example_runs(script):
     proc = _run_example(script)
