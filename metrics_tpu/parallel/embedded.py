@@ -63,8 +63,12 @@ def shard_batch_forward(
         mesh: the device mesh to run under.
         axis: mesh axis name (or tuple of names) carrying the batch shards.
         out_axis: partition of the output's leading dim. The default keeps the
-            output batch-sharded over ``axis``; pass ``None`` for an explicit
-            in-graph ``all_gather`` so the result leaves already replicated.
+            output batch-sharded over ``axis``; ``None`` performs an explicit
+            in-graph ``all_gather`` so the result leaves replicated; an
+            IN-ORDER PREFIX of ``axis`` (e.g. ``"dp"`` when
+            ``axis=("dp", "grp")``) gathers the trailing axes in-graph and
+            leaves the output sharded over just the prefix (non-prefix
+            subsets would permute rows and are rejected).
         replicated_argnums: positions of args broadcast whole to every device
             (the params pytree of a flax encoder).
 
@@ -73,16 +77,34 @@ def shard_batch_forward(
     """
     n = _axis_size(mesh, axis)
     rep = frozenset(int(i) for i in replicated_argnums)
-    gather_inside = out_axis is None
-    if gather_inside:
+    axes = tuple(axis) if isinstance(axis, (tuple, list)) else (axis,)
+    if out_axis is None:
+        gather_axes: Tuple[str, ...] = axes          # full in-body gather
         spec_out = P()
+    elif out_axis == "__same__":
+        gather_axes = ()
+        spec_out = P(axis)
     else:
-        spec_out = P(axis) if out_axis == "__same__" else P(out_axis)
+        # output sharded over a PREFIX of the input axes: the leftover (minor)
+        # axes' shards are gathered in-body. Only an in-order prefix keeps row
+        # order coherent — shard_map splits the batch axes-major, and a tiled
+        # gather over non-trailing axes would interleave rows while P(out_axis)
+        # stitches them as contiguous blocks (silent permutation under
+        # check_vma=False).
+        out_axes = tuple(out_axis) if isinstance(out_axis, (tuple, list)) else (out_axis,)
+        if out_axes != axes[: len(out_axes)]:
+            raise ValueError(
+                f"out_axis {out_axes} must be an in-order prefix of the batch axes "
+                f"{axes} (anything else would permute output rows); gather fully "
+                "with out_axis=None instead."
+            )
+        gather_axes = axes[len(out_axes):]
+        spec_out = P(out_axis)
 
     def _body(*args):
         out = fn(*args)
-        if gather_inside:
-            out = jax.lax.all_gather(out, axis, tiled=True)
+        if gather_axes:
+            out = jax.lax.all_gather(out, gather_axes, tiled=True)
         return out
 
     @jax.jit

@@ -98,3 +98,43 @@ def test_state_dict_roundtrip():
     # derived from data, so compare states directly)
     np.testing.assert_allclose(float(mc2["Accuracy"].tp), float(mc["Accuracy"].tp))
     np.testing.assert_allclose(float(mc2["Accuracy"].fn), float(mc["Accuracy"].fn))
+
+
+def test_add_metrics_after_construction():
+    """Post-construction add_metrics mixes list/dict/single inputs; class-name
+    keys and explicit keys coexist. Parity: reference
+    ``tests/bases/test_collections.py`` add-metrics contract."""
+    from metrics_tpu import MeanMetric, SumMetric
+
+    mc = MetricCollection([SumMetric()])
+    mc.add_metrics({"extra_sum": SumMetric()})
+    mc.add_metrics(MeanMetric())
+    mc.update(jnp.asarray(5.0))
+    out = mc.compute()
+    assert float(out["SumMetric"]) == 5.0
+    assert float(out["extra_sum"]) == 5.0
+    assert float(out["MeanMetric"]) == 5.0
+
+
+def test_dict_key_order_is_deterministic():
+    """Two dicts with the same entries in different insertion order produce the
+    same (sorted) key order — metric state/sync layout must not depend on dict
+    ordering across processes."""
+    from metrics_tpu import MeanMetric, SumMetric
+
+    c1 = MetricCollection({"a": SumMetric(), "b": MeanMetric()})
+    c2 = MetricCollection({"b": MeanMetric(), "a": SumMetric()})
+    assert list(c1.keys()) == list(c2.keys())
+
+
+def test_collection_arg_errors():
+    from metrics_tpu import SumMetric
+
+    with pytest.raises(ValueError, match="prefix"):
+        MetricCollection([SumMetric()], prefix=1)
+    with pytest.raises(ValueError, match="not"):
+        MetricCollection([SumMetric(), object()])
+    with pytest.raises(ValueError, match="not"):
+        MetricCollection({"x": object()})
+    with pytest.raises(ValueError, match="two metrics"):
+        MetricCollection([SumMetric(), SumMetric()])

@@ -163,3 +163,29 @@ def test_mesh_with_callable_feature_raises():
     ):
         with pytest.raises(ValueError, match="mesh"):
             ctor()
+
+
+def test_shard_batch_forward_custom_out_axis():
+    """out_axis (when not the default sentinel) controls the OUTPUT partition
+    independently of the input axis — regression for the r5 review finding
+    where any non-None out_axis was silently replaced by the input axis."""
+    devs = np.asarray(mesh_devices()).reshape(4, 2)
+    mesh = Mesh(devs, ("dp", "grp"))
+    fwd = shard_batch_forward(
+        lambda x: x * 2.0, mesh, axis=("dp", "grp"), out_axis="dp"
+    )
+    x = jnp.arange(32.0).reshape(16, 2)
+    out = fwd(x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x) * 2.0, rtol=1e-6)
+    # the output's leading dim is partitioned over dp only (grp replicated)
+    spec = out.sharding.spec
+    assert spec and spec[0] == "dp", spec
+
+
+def test_shard_batch_forward_nonprefix_out_axis_rejected():
+    devs = np.asarray(mesh_devices()).reshape(4, 2)
+    mesh = Mesh(devs, ("dp", "grp"))
+    with pytest.raises(ValueError, match="prefix"):
+        shard_batch_forward(lambda x: x, mesh, axis=("dp", "grp"), out_axis="grp")(
+            jnp.zeros((16, 2))
+        )
