@@ -28,8 +28,8 @@ class SignalDistortionRatio(Metric):
         >>> target = jnp.sin(n / 4)[None]
         >>> preds = target + 0.1 * jnp.cos(n / 3)[None]
         >>> sdr = SignalDistortionRatio()
-        >>> print(f"{float(sdr(preds, target)):.4f}")
-        28.5336
+        >>> print(f"{float(sdr(preds, target)):.2f}")
+        28.53
     """
 
     is_differentiable = True

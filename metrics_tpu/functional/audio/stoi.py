@@ -85,16 +85,46 @@ def _resample(x: Array, fs_in: int, fs_out: int) -> Array:
     n_in = x.shape[-1]
     n_out = -(-n_in * up // down)  # ceil
     lead = x.shape[:-1]
-    lhs = x.reshape((-1, 1, n_in))
-    # upfirdn(h, x, up, down) as ONE dilated/strided conv: full convolution of
-    # the zero-stuffed signal with the taps, downsampled in the same op
     k = taps.shape[0]
-    rhs = jnp.asarray(taps[::-1].reshape((1, 1, k)))
-    y = jax.lax.conv_general_dilated(
-        lhs, rhs, window_strides=(down,), padding=((k - 1, k - 1),),
-        lhs_dilation=(up,),
-    )
-    y = y[..., n_pre_remove:n_pre_remove + n_out]
+    # upfirdn(h, x, up, down) = full convolution of the zero-stuffed signal
+    # with the taps, kept every `down` samples: out[j] = y_full[m_j],
+    # m_j = (n_pre_remove + j) * down, y_full[m] = sum_i x[i] * taps[m - i*up].
+    # The obvious single-op form (conv with lhs_dilation=up + window stride
+    # `down`) MISCOMPILES on XLA:CPU (observed on this build: wrong samples,
+    # not merely reordered); materialising the stuffed signal instead costs
+    # up * n_in memory (100x at 44.1kHz). So:
+    # HIGHEST precision everywhere below: on TPU the default matmul/conv
+    # precision is bf16 passes, whose ~8-bit mantissa visibly shifts
+    # third-octave envelopes and resampled samples. The pin lives ON THE OPS,
+    # not in a global flag, so the metric is precision-safe however the
+    # caller configures jax.
+    _hi = jax.lax.Precision.HIGHEST
+    if up == 1:
+        # pure decimation: a plain strided conv (no dilation anywhere) is
+        # exact and minimal
+        lhs = x.reshape((-1, 1, n_in))
+        rhs = jnp.asarray(taps[::-1].reshape((1, 1, k)))
+        y = jax.lax.conv_general_dilated(
+            lhs, rhs, window_strides=(down,), padding=((k - 1, k - 1),),
+            precision=_hi,
+        )
+        y = y[..., n_pre_remove:n_pre_remove + n_out]
+        return y.reshape(lead + (n_out,))
+    # rational rate: evaluate the polyphase sum directly as a gather + batched
+    # contraction. Each output j touches only the <= k//up + 1 real input
+    # samples under its tap window (index/weight matrices are host-side
+    # numpy, exact integers), so compute AND memory are O(n_out * k/up) —
+    # the true polyphase cost, independent of `up`.
+    t_cols = k // up + 1
+    j = np.arange(n_out)
+    m = (n_pre_remove + j) * down
+    i_lo = np.maximum(0, -(-(m - k + 1) // up))          # ceil((m-k+1)/up)
+    ii = i_lo[:, None] + np.arange(t_cols)[None, :]       # (n_out, T) input idx
+    tap_idx = m[:, None] - ii * up
+    valid = (tap_idx >= 0) & (tap_idx < k) & (ii < n_in)
+    weights = np.where(valid, taps[np.clip(tap_idx, 0, k - 1)], 0.0).astype(np.float32)
+    gathered = x[..., jnp.asarray(np.clip(ii, 0, n_in - 1))]   # (..., n_out, T)
+    y = jnp.einsum("...jt,jt->...j", gathered, jnp.asarray(weights), precision=_hi)
     return y.reshape(lead + (n_out,))
 
 
@@ -160,8 +190,10 @@ def _stoi_single(deg: Array, clean: Array, fs: int, extended: bool) -> Array:
     obm = jnp.asarray(_third_octave_matrix()[0])
     spec_c = jnp.fft.rfft(_frame(clean_sil) * w, n=NFFT)   # (F, NFFT/2+1)
     spec_d = jnp.fft.rfft(_frame(deg_sil) * w, n=NFFT)
-    x_tob = jnp.sqrt(jnp.abs(spec_c) ** 2 @ obm.T)          # clean    (F, 15)
-    y_tob = jnp.sqrt(jnp.abs(spec_d) ** 2 @ obm.T)          # degraded (F, 15)
+    # band matmuls at HIGHEST for the same reason as the resampler conv
+    _hi = jax.lax.Precision.HIGHEST
+    x_tob = jnp.sqrt(jnp.matmul(jnp.abs(spec_c) ** 2, obm.T, precision=_hi))  # clean    (F, 15)
+    y_tob = jnp.sqrt(jnp.matmul(jnp.abs(spec_d) ** 2, obm.T, precision=_hi))  # degraded (F, 15)
 
     # ---- 30-frame sliding segments ------------------------------------------
     n_seg = n_f - N_SEG + 1

@@ -30,15 +30,15 @@ def _check_shape_and_type_consistency_hinge(preds: Array, target: Array) -> Data
     if preds.ndim == 1:
         if preds.shape != target.shape:
             raise ValueError(
-                "The `preds` and `target` should have the same shape,",
-                f" got `preds` with shape={preds.shape} and `target` with shape={target.shape}.",
+                "The `preds` and `target` should have the same shape,"
+                f" got `preds` with shape={preds.shape} and `target` with shape={target.shape}."
             )
         mode = DataType.BINARY
     elif preds.ndim == 2:
         if preds.shape[0] != target.shape[0]:
             raise ValueError(
-                "The `preds` and `target` should have the same shape in the first dimension,",
-                f" got `preds` with shape={preds.shape} and `target` with shape={target.shape}.",
+                "The `preds` and `target` should have the same shape in the first dimension,"
+                f" got `preds` with shape={preds.shape} and `target` with shape={target.shape}."
             )
         mode = DataType.MULTICLASS
     else:

@@ -84,6 +84,32 @@ def _is_prejitted(fn: Callable) -> bool:
         return False
 
 
+def _is_hf_flax_model(model: Any) -> bool:
+    """True only for genuine HF Flax models (``FlaxPreTrainedModel``).
+
+    The old duck-typed ``hasattr(model, "params") and hasattr(model,
+    "config")`` check hijacked ANY callable carrying those attribute names —
+    a custom encoder with its own ``.params`` pytree would silently be called
+    with HF keyword conventions (``model(input_ids=..., attention_mask=...,
+    params=...)``) instead of its documented ``model(ids, mask)`` signature.
+    With transformers importable the check is a real ``isinstance``; without
+    it nothing can be an HF model, so everything keeps the generic path.
+    """
+    from metrics_tpu.utils.imports import _TRANSFORMERS_AVAILABLE
+
+    if not _TRANSFORMERS_AVAILABLE:
+        return False
+    try:
+        from transformers import FlaxPreTrainedModel
+    except ImportError:
+        # transformers installed without Flax support (no flax extra, or the
+        # >=5 line where the Flax classes are gone): nothing can be an HF
+        # Flax model, and callables must keep their generic path
+        return False
+
+    return isinstance(model, FlaxPreTrainedModel)
+
+
 def _cache_get(key: Any, pins: Tuple) -> Optional[Callable]:
     """LRU hit iff every pinned object is still the same identity."""
     hit = _JIT_FORWARD_CACHE.get(key)
@@ -275,7 +301,7 @@ def _resolve_forward(
     if model is not None:
         if _is_prejitted(model):
             return _wrap(model, model)  # owns its compilation; used as-is
-        if hasattr(model, "params") and hasattr(model, "config"):
+        if _is_hf_flax_model(model):
             # an HF Flax model object passed directly: same params-as-args
             # wiring as the model_name_or_path branch
             return _wrap_hf_style(model)

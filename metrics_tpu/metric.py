@@ -674,12 +674,22 @@ class Metric:
         entry, cache = _jit_cache_lookup(self, sig, lambda: self._build_forward_step(sig, array_idx, leaves))
         if entry is None:
             return _MISS
+        packed = self._pack_state()
         try:
-            merged, value, errcode = entry(self._pack_state(), [leaves[i] for i in array_idx])
+            merged, value, errcode = entry(packed, [leaves[i] for i in array_idx])
         except Exception:
-            # untraceable update (host-side work, data-dependent branching) or a
-            # genuine input error: stay eager — the eager path re-raises real
-            # user errors with their proper message
+            # Trace-time failure (untraceable update, genuine input error):
+            # nothing was donated, the state buffers are intact — stay eager;
+            # the eager path re-raises real user errors with their message.
+            # EXECUTION-time failure on an accelerator is different: the step
+            # donates the state (see _build_forward_step), so the old buffers
+            # may already be invalidated — falling back to eager would read
+            # deleted arrays and silently corrupt the metric. Surface it.
+            if any(
+                getattr(leaf, "is_deleted", lambda: False)()
+                for leaf in jax.tree_util.tree_leaves(packed)
+            ):
+                raise
             cache[sig] = _EAGER_ONLY
             return _MISS
         # accumulate the in-graph validation code on-device (async, no transfer);
@@ -713,7 +723,17 @@ class Metric:
             value = m.compute_from(delta) if compute_on_step else None
             return merged, value, checks.combined()
 
-        return jax.jit(step)
+        # DONATE the incoming state: forward() immediately rebinds the metric's
+        # attributes to the returned merged state, so the old buffers are dead
+        # the moment the step returns — donation lets XLA write the merge in
+        # place instead of allocating a second copy. For streaming-stat metrics
+        # this is material HBM (FID's float-float covariance state is 4 full
+        # feature_dim^2 f32 buffers, ~67 MB at 2048). init_state() already
+        # copies default leaves precisely so donated states never alias
+        # (metric.py:240-242). CPU doesn't implement donation and would warn on
+        # every compile, so the hint is only attached on accelerators.
+        donate = (0,) if jax.default_backend() != "cpu" else ()
+        return jax.jit(step, donate_argnums=donate)
 
     def reset(self) -> None:
         """Reset state to defaults. Parity: reference ``metric.py:397-418``."""

@@ -168,6 +168,19 @@ class InceptionV3(nn.Module):
     # downstream statistics still accumulate in f32, and the input scaling is
     # exact (uint8 values are exactly representable in bf16)
     compute_dtype: Optional[Any] = None
+    # expects params transformed by ``fold_preprocess_into_params``: the
+    # (x-128)/128 input normalisation is absorbed into the first conv's kernel
+    # and BN mean (exact — the first conv is VALID, so every window is full),
+    # removing one full-image elementwise pass from the compiled forward
+    preprocess_folded: bool = False
+    # expects params transformed by ``pad_stem_params(lanes=...)``: the stem
+    # convs (32/32/64/80 output channels — under-filling the 128-lane MXU; the
+    # per-layer attribution table shows them at 0.19-0.37 structural tile
+    # efficiency, ~21% of ideal time on ~10% of FLOPs) are widened with zero
+    # channels so every stem GEMM runs at full lane width; padded channels stay
+    # exactly zero through BN (scale=0) and relu, and the '64' tap slices back
+    # to the logical width, so features are unchanged
+    stem_lanes: Optional[int] = None
 
     @nn.compact
     def __call__(self, x: Array) -> Dict[str, Array]:
@@ -175,17 +188,25 @@ class InceptionV3(nn.Module):
         # (NOT the symmetric 2x/255 - 1): uint8 255 maps to 0.9921875. Floats
         # are taken as [0, 1] and quantised by truncation — the same
         # `(imgs * 255).byte()` rule torchmetrics applies before this graph —
-        # so both input kinds produce identical features.
+        # so both input kinds produce identical features. With
+        # ``preprocess_folded`` the conv consumes the raw 0..255 scale (values
+        # exactly representable in bf16) and the affine lives in the params.
         if x.dtype == jnp.uint8:
             x = x.astype(jnp.float32)
         else:
             x = jnp.floor(x * 255.0)
-        x = (x - 128.0) / 128.0
+        if not self.preprocess_folded:
+            x = (x - 128.0) / 128.0
         if self.compute_dtype is not None:
             x = x.astype(self.compute_dtype)
 
         dt = self.compute_dtype
         BasicConv2d = partial(_BasicConv2d, dtype=dt)
+        lanes = self.stem_lanes
+
+        def st(features: int) -> int:
+            # stem width under MXU padding (features already >= lanes unchanged)
+            return features if lanes is None or features >= lanes else lanes
 
         def tap_mean(v: Array) -> Array:
             # the taps are consumed by f32/float-float statistics: accumulate
@@ -193,13 +214,13 @@ class InceptionV3(nn.Module):
             return jnp.mean(v.astype(jnp.float32), axis=(1, 2))
 
         out: Dict[str, Array] = {}
-        x = BasicConv2d(32, (3, 3), strides=(2, 2))(x)
-        x = BasicConv2d(32, (3, 3))(x)
-        x = BasicConv2d(64, (3, 3), padding="SAME")(x)
+        x = BasicConv2d(st(32), (3, 3), strides=(2, 2))(x)
+        x = BasicConv2d(st(32), (3, 3))(x)
+        x = BasicConv2d(st(64), (3, 3), padding="SAME")(x)
         x = _max_pool(x, 3, 2)
-        out["64"] = tap_mean(x)
+        out["64"] = tap_mean(x[..., :64] if lanes is not None else x)
 
-        x = BasicConv2d(80, (1, 1))(x)
+        x = BasicConv2d(st(80), (1, 1))(x)
         x = BasicConv2d(192, (3, 3))(x)
         x = _max_pool(x, 3, 2)
         out["192"] = tap_mean(x)
@@ -227,6 +248,82 @@ class InceptionV3(nn.Module):
 
 # output width of each feature tap (used by FID/IS/KID to size streaming buffers)
 FEATURE_DIMS = {"64": 64, "192": 192, "768": 768, "2048": 2048, "logits_unbiased": 1008}
+
+
+def _replace_in(variables: Any, collection: str, layer: str, sub: str, updates: Dict[str, Array]) -> Any:
+    """Copy-on-write update of ``variables[collection][layer][sub]`` leaves."""
+    new = dict(variables)
+    coll = dict(new[collection])
+    lay = dict(coll[layer])
+    leaf = dict(lay[sub])
+    leaf.update(updates)
+    lay[sub] = leaf
+    coll[layer] = lay
+    new[collection] = coll
+    return new
+
+
+def fold_preprocess_into_params(variables: Any) -> Any:
+    """Absorb the ``(x - 128) / 128`` input affine into the first conv's params.
+
+    Exact linear algebra (the first conv is VALID — every window is full, so
+    ``conv(W, (x-128)/128) = conv(W/128, x) - Σ_hwi W`` per output channel, and
+    the constant offset moves into the following BatchNorm's running mean):
+    ``kernel' = W / 128``, ``mean' = mean + Σ_hwi W``. Consume the result with
+    ``InceptionV3(preprocess_folded=True)``; features agree with the unfolded
+    graph to f32 rounding. Pure — the input pytree is not mutated.
+    """
+    k = variables["params"]["BasicConv2d_0"]["Conv_0"]["kernel"]
+    mean = variables["batch_stats"]["BasicConv2d_0"]["BatchNorm_0"]["mean"]
+    out = _replace_in(variables, "params", "BasicConv2d_0", "Conv_0", {"kernel": k / 128.0})
+    return _replace_in(
+        out, "batch_stats", "BasicConv2d_0", "BatchNorm_0",
+        {"mean": mean + jnp.sum(k, axis=(0, 1, 2))},
+    )
+
+
+# (layer, pad_input_channels, pad_output_channels) for the stem under MXU
+# padding; BasicConv2d_0's input is the 3-channel image (never padded) and
+# BasicConv2d_4's 192 output already exceeds the lane width
+_STEM_PAD = (
+    ("BasicConv2d_0", False, True),
+    ("BasicConv2d_1", True, True),
+    ("BasicConv2d_2", True, True),
+    ("BasicConv2d_3", True, True),
+    ("BasicConv2d_4", True, False),
+)
+
+
+def pad_stem_params(variables: Any, lanes: int = 128) -> Any:
+    """Zero-pad the stem conv/BN params to ``lanes`` output channels.
+
+    The padded channels are exact zeros end to end: kernel output slices are 0,
+    BN runs them through ``scale=0, bias=0, mean=0, var=1`` (still 0), relu
+    keeps 0, and the next conv's padded *input* slices carry zero weights — so
+    the logical computation is unchanged while every stem GEMM presents full
+    MXU lane width. Consume with ``InceptionV3(stem_lanes=lanes)``. Pure.
+    """
+    out = variables
+    for layer, pad_in, pad_out in _STEM_PAD:
+        kernel = out["params"][layer]["Conv_0"]["kernel"]
+        kh, kw, cin, cout = kernel.shape
+        pin = (lanes - cin) if (pad_in and cin < lanes) else 0
+        pout = (lanes - cout) if (pad_out and cout < lanes) else 0
+        if pin or pout:
+            kernel = jnp.pad(kernel, ((0, 0), (0, 0), (0, pin), (0, pout)))
+            out = _replace_in(out, "params", layer, "Conv_0", {"kernel": kernel})
+        if pout:
+            bn = out["params"][layer]["BatchNorm_0"]
+            out = _replace_in(out, "params", layer, "BatchNorm_0", {
+                "scale": jnp.pad(bn["scale"], (0, pout)),
+                "bias": jnp.pad(bn["bias"], (0, pout)),
+            })
+            st = out["batch_stats"][layer]["BatchNorm_0"]
+            out = _replace_in(out, "batch_stats", layer, "BatchNorm_0", {
+                "mean": jnp.pad(st["mean"], (0, pout)),
+                "var": jnp.pad(st["var"], (0, pout), constant_values=1.0),
+            })
+    return out
 
 
 def resolve_feature_extractor(
@@ -299,12 +396,30 @@ class InceptionFeatureExtractor:
         compute_dtype: Optional[Any] = None,
         mesh: Optional[Any] = None,
         mesh_axis: Any = "dp",
+        fold_preprocess: bool = False,
+        stem_lanes: Optional[int] = None,
     ) -> None:
         from metrics_tpu.utils.prints import rank_zero_warn
 
         self.feature = str(feature)
         self.compute_dtype = compute_dtype
-        self.module = InceptionV3(compute_dtype=compute_dtype)
+        self.fold_preprocess = bool(fold_preprocess)
+        self.stem_lanes = stem_lanes
+        # the CANONICAL module defines the public param tree (what `params=`,
+        # `load_params` and the weight converter produce); the forward module
+        # may differ (folded preprocess / MXU-padded stem) and consumes params
+        # transformed on the fly inside the compiled forward — the transforms
+        # are a handful of pads/sums that XLA folds into the first layers, so
+        # rebinding ``ext.params`` (the documented contract) still takes effect.
+        # Both transforms default OFF: they are exact only to f32 rounding
+        # (~5e-6 feature drift), and a metric library's default path must be
+        # bit-identical run to run — the TPU bench/fast path opts in.
+        canonical = InceptionV3(compute_dtype=compute_dtype)
+        self.module = InceptionV3(
+            compute_dtype=compute_dtype,
+            preprocess_folded=self.fold_preprocess,
+            stem_lanes=stem_lanes,
+        )
         if params is None:
             rank_zero_warn(
                 "No pretrained InceptionV3 params provided (no network egress in this build);"
@@ -316,12 +431,18 @@ class InceptionFeatureExtractor:
             # jit the init: un-jitted flax init executes the whole net eagerly,
             # one dispatch round-trip per op (~minutes over a tunnelled TPU);
             # params initialise in param_dtype (f32) regardless of compute_dtype
-            params = jax.jit(self.module.init)(jax.random.PRNGKey(seed), dummy)
+            params = jax.jit(canonical.init)(jax.random.PRNGKey(seed), dummy)
         # params stay a single f32 master (public; rebinding ext.params takes
         # effect — the forward reads it per call): the flax layers' `dtype`
         # cast the weights on the fly, which XLA fuses into the consuming ops
         self.params = params
-        fwd = lambda p, x: self.module.apply(p, x)[self.feature].astype(jnp.float32)
+
+        def fwd(p: Any, x: Array) -> Array:
+            if self.fold_preprocess:
+                p = fold_preprocess_into_params(p)
+            if self.stem_lanes is not None:
+                p = pad_stem_params(p, self.stem_lanes)
+            return self.module.apply(p, x)[self.feature].astype(jnp.float32)
         if mesh is not None:
             from metrics_tpu.parallel.embedded import shard_batch_forward
 

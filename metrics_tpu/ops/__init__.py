@@ -1,2 +1,10 @@
 """Hot-path device ops (XLA/Pallas) shared across metric families."""
+from metrics_tpu.ops.profiling import (
+    attribution_table,
+    capture_trace,
+    format_table,
+    op_costs,
+    single_program_calibration,
+    structural_mfu_ceiling,
+)
 from metrics_tpu.ops.sqrtm import psd_sqrt, sqrtm_newton_schulz, trace_sqrtm_product

@@ -191,8 +191,8 @@ def _check_shape_and_type_consistency(preds, target, stats: Optional[_ValueStats
     if preds.ndim == target.ndim:
         if p_shape != t_shape:
             raise ValueError(
-                "The `preds` and `target` should have the same shape,",
-                f" got `preds` with shape={p_shape} and `target` with shape={t_shape}.",
+                "The `preds` and `target` should have the same shape,"
+                f" got `preds` with shape={p_shape} and `target` with shape={t_shape}."
             )
         if preds_float and stats is None and not (_is_tracer(preds) or _is_tracer(target)):
             stats = _compute_value_stats(preds, target)

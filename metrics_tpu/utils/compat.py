@@ -1,0 +1,68 @@
+"""Version polyfills for the JAX APIs this package and its tests rely on.
+
+The repo targets the public ``jax.shard_map`` entry point (promoted from
+``jax.experimental.shard_map`` with ``check_rep`` renamed to ``check_vma``).
+Older runtimes — like the 0.4.x container this build must also run in — only
+ship the experimental path, so 48 call sites across the runtime, bench and
+test tree would die on ``AttributeError``/``TypeError``. Instead of forking
+every call site, install one adapter at package import: same keyword surface
+as the modern API, delegating to whichever implementation exists.
+
+Import-order note: this module must be imported before any ``jax.shard_map``
+use (``metrics_tpu/__init__.py`` does it first thing), and is idempotent.
+"""
+import jax
+
+__all__ = ["install_enable_x64_polyfill", "install_shard_map_polyfill"]
+
+
+def install_shard_map_polyfill() -> None:
+    """Expose ``jax.shard_map`` with the modern keyword surface, if absent.
+
+    Gate on the KEYWORD SURFACE, not mere attribute presence: the 0.5.x line
+    already publishes ``jax.shard_map`` but still spells the replication check
+    ``check_rep`` — call sites passing ``check_vma=`` would die there too.
+    """
+    import inspect
+
+    native = getattr(jax, "shard_map", None)
+    if native is not None:
+        try:
+            if "check_vma" in inspect.signature(native).parameters:
+                return
+        except (TypeError, ValueError):  # C-accelerated / wrapped: assume modern
+            return
+        _impl, _rep_kw = native, "check_rep"
+    else:
+        from jax.experimental.shard_map import shard_map as _impl
+
+        _rep_kw = "check_rep"
+
+    # positional-or-keyword params in the native order, and setdefault so an
+    # explicit check_rep= from third-party code wins: the wrapper must stay
+    # call-compatible with the API it shadows — other libraries in the same
+    # process see this binding too
+    def shard_map(f, mesh, in_specs, out_specs, check_vma=True, **kwargs):
+        kwargs.setdefault(_rep_kw, check_vma)
+        return _impl(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs)
+
+    shard_map.__doc__ = _impl.__doc__
+    jax.shard_map = shard_map
+
+
+def install_enable_x64_polyfill() -> None:
+    """Expose the ``jax.enable_x64`` context manager, if absent.
+
+    FID's compute-time f64 island (``image/fid.py``) uses the promoted
+    spelling; older runtimes only have ``jax.experimental.enable_x64`` (same
+    signature) and silently fall back to the float-float path without this.
+    """
+    if hasattr(jax, "enable_x64"):
+        return
+    from jax.experimental import enable_x64 as _experimental_enable_x64
+
+    jax.enable_x64 = _experimental_enable_x64
+
+
+install_shard_map_polyfill()
+install_enable_x64_polyfill()

@@ -1,0 +1,132 @@
+"""PESQ delegate plumbing under a mock ``pesq`` backend.
+
+The DSP itself is the standardized ITU P.862 C implementation living in the
+native ``pesq`` package (absent in this container, exactly as in the
+reference's optional-dependency design) — but the delegate's own plumbing
+(availability gating, batch flatten/reshape loop, argument order, dtype/shape
+handling, the module metric's sum/count accumulation) needs no DSP to test.
+A monkeypatched fake backend returns canned scores and records every call.
+"""
+import sys
+import types
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import importlib
+
+# attribute access on the packages is shadowed by the same-named function /
+# re-export, so resolve the actual modules from sys.modules via importlib
+pesq_module = importlib.import_module("metrics_tpu.audio.pesq")
+pesq_functional = importlib.import_module("metrics_tpu.functional.audio.pesq")
+
+
+class _FakeBackend:
+    """Stands in for the native ``pesq`` package: canned, call-recording."""
+
+    def __init__(self):
+        self.calls = []
+
+    def make_module(self):
+        mod = types.ModuleType("pesq")
+
+        def fake_pesq(fs, ref, deg, mode):
+            assert isinstance(ref, np.ndarray) and ref.ndim == 1
+            assert isinstance(deg, np.ndarray) and deg.ndim == 1
+            self.calls.append((fs, ref.copy(), deg.copy(), mode))
+            # distinct, order-revealing canned scores: 1.0, 1.5, 2.0, ...
+            return 1.0 + 0.5 * (len(self.calls) - 1)
+
+        mod.pesq = fake_pesq
+        return mod
+
+
+@pytest.fixture()
+def fake_pesq(monkeypatch):
+    backend = _FakeBackend()
+    monkeypatch.setitem(sys.modules, "pesq", backend.make_module())
+    # both modules bound the availability flag at import time
+    monkeypatch.setattr(pesq_functional, "_PESQ_AVAILABLE", True)
+    monkeypatch.setattr(pesq_module, "_PESQ_AVAILABLE", True)
+    return backend
+
+
+def test_gating_without_backend():
+    """Without the native package the delegate refuses up front (parity with
+    the reference's optional-dependency contract) — functional and module."""
+    if pesq_functional._PESQ_AVAILABLE:  # pragma: no cover - env-dependent
+        pytest.skip("native pesq installed; gating path not reachable")
+    with pytest.raises(ModuleNotFoundError, match="pip install pesq"):
+        pesq_functional.pesq(np.zeros(8000), np.zeros(8000), 8000, "nb")
+    with pytest.raises(ModuleNotFoundError, match="pip install pesq"):
+        pesq_module.PESQ(fs=8000, mode="nb")
+
+
+def test_argument_validation_under_mock(fake_pesq):
+    with pytest.raises(ValueError, match="8000 or 16000"):
+        pesq_functional.pesq(np.zeros(100), np.zeros(100), 44100, "wb")
+    with pytest.raises(ValueError, match="'wb' or 'nb'"):
+        pesq_functional.pesq(np.zeros(100), np.zeros(100), 16000, "xb")
+    with pytest.raises(RuntimeError, match="same shape"):
+        pesq_functional.pesq(np.zeros(100), np.zeros(101), 16000, "wb")
+    assert fake_pesq.calls == []  # validation precedes any backend call
+
+
+def test_single_signal_scalar(fake_pesq):
+    deg = np.random.RandomState(0).randn(8000).astype(np.float32)
+    ref = np.random.RandomState(1).randn(8000).astype(np.float32)
+    out = pesq_functional.pesq(deg, ref, 16000, "wb")
+    assert out.shape == () and out.dtype == jnp.float32
+    assert float(out) == 1.0
+    (fs, got_ref, got_deg, mode), = fake_pesq.calls
+    assert fs == 16000 and mode == "wb"
+    # reference-package argument order: pesq(fs, TARGET, PREDS, mode)
+    np.testing.assert_array_equal(got_ref, ref)
+    np.testing.assert_array_equal(got_deg, deg)
+
+
+def test_batch_flatten_reshape_roundtrip(fake_pesq):
+    rng = np.random.RandomState(2)
+    deg = rng.randn(2, 3, 4000)
+    ref = rng.randn(2, 3, 4000)
+    out = pesq_functional.pesq(deg, ref, 8000, "nb")
+    assert out.shape == (2, 3) and out.dtype == jnp.float32
+    # canned scores land in C-order over the flattened leading dims
+    np.testing.assert_allclose(
+        np.asarray(out), 1.0 + 0.5 * np.arange(6, dtype=np.float32).reshape(2, 3)
+    )
+    assert len(fake_pesq.calls) == 6
+    # row b of the flattened batch went to call b, per-signal, right order
+    for b, (_, got_ref, got_deg, _) in enumerate(fake_pesq.calls):
+        np.testing.assert_array_equal(got_ref, ref.reshape(-1, 4000)[b])
+        np.testing.assert_array_equal(got_deg, deg.reshape(-1, 4000)[b])
+
+
+def test_device_array_and_dtype_inputs(fake_pesq):
+    # jnp inputs (f32) and numpy f64 both flow through np.asarray untouched
+    deg = jnp.asarray(np.random.RandomState(3).randn(2, 2000), jnp.float32)
+    ref = jnp.asarray(np.random.RandomState(4).randn(2, 2000), jnp.float32)
+    out = pesq_functional.pesq(deg, ref, 16000, "wb")
+    assert out.shape == (2,)
+    assert all(isinstance(c[1], np.ndarray) for c in fake_pesq.calls)
+
+
+def test_module_metric_accumulates_mean(fake_pesq):
+    m = pesq_module.PESQ(fs=16000, mode="wb")
+    rng = np.random.RandomState(5)
+    m.update(rng.randn(2, 2000), rng.randn(2, 2000))   # scores 1.0, 1.5
+    m.update(rng.randn(3, 2000), rng.randn(3, 2000))   # scores 2.0, 2.5, 3.0
+    assert len(fake_pesq.calls) == 5
+    np.testing.assert_allclose(float(m.compute()), np.mean([1.0, 1.5, 2.0, 2.5, 3.0]))
+    m.reset()
+    m.update(rng.randn(2000), rng.randn(2000))          # score 3.5, scalar path
+    np.testing.assert_allclose(float(m.compute()), 3.5)
+
+
+def test_module_ctor_validation(fake_pesq):
+    with pytest.raises(ValueError, match="8000 or 16000"):
+        pesq_module.PESQ(fs=123, mode="wb")
+    with pytest.raises(ValueError, match="'wb' or 'nb'"):
+        pesq_module.PESQ(fs=8000, mode="zz")

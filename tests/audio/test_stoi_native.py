@@ -188,3 +188,46 @@ def test_matches_pystoi_when_available():
         ref = pystoi.stoi(clean.astype(np.float64), deg.astype(np.float64), 16000, extended=extended)
         ours = float(stoi(deg, clean, 16000, extended=extended))
         np.testing.assert_allclose(ours, ref, atol=5e-3)
+
+
+def test_precision_pinned_on_ops_not_global():
+    """STOI must be precision-safe without the suite's global pin.
+
+    ``tests/conftest.py`` sets ``jax_default_matmul_precision=highest`` for
+    every test; on a TPU default (bf16 matmul passes) the resampler conv and
+    the third-octave band matmuls would silently lose ~8 bits of mantissa.
+    The fix pins HIGHEST on those ops. Verified two ways, with the global pin
+    neutralized for this test: the score still matches the f64 host oracle,
+    and every conv/dot in the traced program carries an explicit HIGHEST
+    precision (so a newly added unpinned matmul fails here).
+    """
+    import jax
+
+    from metrics_tpu.functional.audio.stoi import _stoi_batch
+
+    clean = _speech_like(41, 16000, fs=16000)
+    deg = clean + 0.3 * np.random.RandomState(42).randn(clean.size).astype(np.float32)
+    with jax.default_matmul_precision("bfloat16"):  # the adversarial default
+        ours = float(stoi(deg, clean, 16000))
+        jaxpr = jax.make_jaxpr(lambda d, c: _stoi_batch(d, c, 16000, False))(
+            jnp.asarray(deg), jnp.asarray(clean)
+        )
+    ref = host_stoi(deg, clean, 16000)
+    np.testing.assert_allclose(ours, ref, atol=2e-3)
+
+    hits = []
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            if eqn.primitive.name in ("conv_general_dilated", "dot_general"):
+                hits.append((eqn.primitive.name, eqn.params.get("precision")))
+            for v in eqn.params.values():
+                if hasattr(v, "jaxpr"):
+                    walk(v.jaxpr)
+
+    walk(jaxpr.jaxpr)
+    assert hits, "expected conv/dot ops in the STOI program"
+    for name, prec in hits:
+        assert prec is not None and all(
+            p == jax.lax.Precision.HIGHEST for p in prec
+        ), f"{name} precision not pinned: {prec}"

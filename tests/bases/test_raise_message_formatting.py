@@ -1,0 +1,71 @@
+"""Exception messages must be single formatted strings, not arg tuples.
+
+Regression for an inherited reference bug (reference ``checks.py:64-67``,
+copied into ``utils/checks.py`` and ``functional/classification/hinge.py``):
+``raise ValueError("...,", f" got ...")`` passes TWO positional args, so
+``str(exc)`` renders the tuple — ``("The `preds` ...", " got ...")`` — with
+quotes and a leading comma instead of the message. These tests pin the
+formatted text, and an AST audit fails if any new multi-arg raise appears
+anywhere in the package.
+"""
+import ast
+import pathlib
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import metrics_tpu
+from metrics_tpu.functional.classification.hinge import _check_shape_and_type_consistency_hinge
+from metrics_tpu.utils.checks import _check_shape_and_type_consistency
+
+
+def test_shape_mismatch_message_is_formatted_string():
+    preds = jnp.zeros((4, 3))
+    target = jnp.zeros((5, 3), jnp.int32)
+    with pytest.raises(ValueError) as exc_info:
+        _check_shape_and_type_consistency(preds, target)
+    assert exc_info.value.args and len(exc_info.value.args) == 1
+    msg = str(exc_info.value)
+    assert msg == (
+        "The `preds` and `target` should have the same shape,"
+        " got `preds` with shape=(4, 3) and `target` with shape=(5, 3)."
+    )
+
+
+def test_hinge_shape_mismatch_messages_are_formatted_strings():
+    with pytest.raises(ValueError) as exc_info:
+        _check_shape_and_type_consistency_hinge(jnp.zeros((4,)), jnp.zeros((5,), jnp.int32))
+    assert len(exc_info.value.args) == 1
+    assert str(exc_info.value) == (
+        "The `preds` and `target` should have the same shape,"
+        " got `preds` with shape=(4,) and `target` with shape=(5,)."
+    )
+    with pytest.raises(ValueError) as exc_info:
+        _check_shape_and_type_consistency_hinge(jnp.zeros((4, 3)), jnp.zeros((5,), jnp.int32))
+    assert len(exc_info.value.args) == 1
+    assert str(exc_info.value) == (
+        "The `preds` and `target` should have the same shape in the first dimension,"
+        " got `preds` with shape=(4, 3) and `target` with shape=(5,)."
+    )
+
+
+def test_no_multi_arg_raises_anywhere_in_package():
+    """AST audit of every raise site in metrics_tpu: one positional arg only.
+
+    The comma pattern is easy to reintroduce when wrapping long messages, and
+    nothing else catches it (the exception still raises, just mangled).
+    """
+    pkg_root = pathlib.Path(metrics_tpu.__file__).parent
+    offenders = []
+    for path in sorted(pkg_root.rglob("*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Raise)
+                and isinstance(node.exc, ast.Call)
+                and len(node.exc.args) > 1
+            ):
+                offenders.append(f"{path.relative_to(pkg_root)}:{node.lineno}")
+    assert not offenders, f"multi-arg raise sites (tuple-message bug): {offenders}"

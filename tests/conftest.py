@@ -38,3 +38,38 @@ def devices():
     from tests.helpers.testers import mesh_devices
 
     return mesh_devices()
+
+
+# nodeid fragments that pin a test to the 8-device virtual mesh when the
+# fixture/param signals below can't see it (subprocess-driven or example-file
+# tests)
+_MESH_NODEID_HINTS = (
+    "tests/parallel/",              # collectives/sum-rider/sharded-embedded suites
+    "[sharded_embedded_models.py",  # integration example script under shard_map
+    "[distributed",                 # docs distributed code blocks
+)
+
+
+def pytest_collection_modifyitems(config, items):
+    """Mark every multi-device (8-virtual-device mesh) test as ``slow``.
+
+    Each compiles at least one ``shard_map`` program over 8 virtual CPU
+    devices — several seconds each, hundreds of tests. The time-capped tier-1
+    run (``-m 'not slow'``) cannot afford them, and on the jax 0.4.x seed
+    container they never ran at all (``jax.shard_map`` didn't exist before
+    ``metrics_tpu.utils.compat`` polyfilled it, so every one failed fast).
+    They remain in the full/default suite and any ``-m slow`` run.
+
+    Detection: the tester's ``ddp=True`` variants, ``*ddp*`` test names
+    (``test_class_ddp``), anything requesting the mesh ``devices`` fixture,
+    and the nodeid hints above.
+    """
+    for item in items:
+        callspec = getattr(item, "callspec", None)
+        if (
+            (callspec is not None and callspec.params.get("ddp") is True)
+            or "ddp" in item.name
+            or "devices" in getattr(item, "fixturenames", ())
+            or any(h in item.nodeid for h in _MESH_NODEID_HINTS)
+        ):
+            item.add_marker(pytest.mark.slow)

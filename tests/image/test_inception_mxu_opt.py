@@ -1,0 +1,113 @@
+"""MXU-oriented InceptionV3 transforms: exactness, purity, extractor wiring.
+
+The two param-space rewrites behind the FID forward optimization (ISSUE 1
+tentpole) must be *exact* — FID/IS/KID features feed covariance statistics
+where a systematic feature shift becomes a metric bias:
+
+* ``fold_preprocess_into_params``: absorbs the ``(x-128)/128`` input affine
+  into conv0's kernel + BN mean (valid because conv0 is VALID-padded);
+* ``pad_stem_params``: zero-pads the <=96-channel stem convs/BNs to the
+  128-lane MXU width; padded channels are exact zeros end to end.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.models.inception import (
+    InceptionFeatureExtractor,
+    InceptionV3,
+    fold_preprocess_into_params,
+    pad_stem_params,
+)
+
+IMG = 75  # smallest documented input size — keeps CPU compile time sane
+
+# full-model exactness sweeps (~3.5 min on CPU): out of the time-capped tier-1
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(scope="module")
+def base():
+    module = InceptionV3()
+    x0 = jnp.zeros((1, IMG, IMG, 3))
+    params = jax.jit(module.init)(jax.random.PRNGKey(7), x0)
+    rng = np.random.RandomState(0)
+    imgs_u8 = jnp.asarray((rng.rand(2, IMG, IMG, 3) * 255).astype(np.uint8))
+    return module, params, imgs_u8
+
+
+def test_fold_preprocess_exact(base):
+    module, params, imgs = base
+    ref = module.apply(params, imgs)
+    folded = fold_preprocess_into_params(params)
+    got = InceptionV3(preprocess_folded=True).apply(folded, imgs)
+    for key in ref:
+        np.testing.assert_allclose(got[key], ref[key], atol=5e-6, err_msg=key)
+
+
+def test_pad_stem_exact_and_full_lanes(base):
+    module, params, imgs = base
+    ref = module.apply(params, imgs)
+    padded = pad_stem_params(params, lanes=128)
+    got = InceptionV3(stem_lanes=128).apply(padded, imgs)
+    for key in ref:
+        np.testing.assert_allclose(got[key], ref[key], atol=5e-6, err_msg=key)
+    # every padded stem kernel now presents the full 128 output lanes
+    for layer in ("BasicConv2d_0", "BasicConv2d_1", "BasicConv2d_2", "BasicConv2d_3"):
+        assert padded["params"][layer]["Conv_0"]["kernel"].shape[-1] == 128
+    # and the last stem conv's INPUT is padded while its 192 output is not
+    k4 = padded["params"]["BasicConv2d_4"]["Conv_0"]["kernel"]
+    assert k4.shape[-2:] == (128, 192)
+
+
+def test_fold_and_pad_compose(base):
+    module, params, imgs = base
+    ref = module.apply(params, imgs)
+    both = pad_stem_params(fold_preprocess_into_params(params))
+    got = InceptionV3(preprocess_folded=True, stem_lanes=128).apply(both, imgs)
+    for key in ref:
+        np.testing.assert_allclose(got[key], ref[key], atol=5e-6, err_msg=key)
+
+
+def test_fold_handles_float_input_quantization(base):
+    """Float inputs are floor-quantized to the uint8 grid BEFORE the conv, so
+    folding (which moves only the affine, not the quantization) stays exact."""
+    module, params, _ = base
+    rng = np.random.RandomState(3)
+    imgs_f = jnp.asarray(rng.rand(2, IMG, IMG, 3).astype(np.float32))
+    ref = module.apply(params, imgs_f)["2048"]
+    both = pad_stem_params(fold_preprocess_into_params(params))
+    got = InceptionV3(preprocess_folded=True, stem_lanes=128).apply(both, imgs_f)["2048"]
+    np.testing.assert_allclose(got, ref, atol=5e-6)
+
+
+def test_transforms_are_pure(base):
+    _, params, _ = base
+    before = jax.tree.map(lambda a: np.asarray(a).copy(), params)
+    pad_stem_params(fold_preprocess_into_params(params))
+    after = jax.tree.map(np.asarray, params)
+    for a, b in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_extractor_optimized_path_matches_reference_params_contract(base):
+    """The extractor keeps the CANONICAL param tree public (``ext.params`` is
+    what ``load_params``/the converter produce) while the compiled forward
+    consumes the folded/padded transform of it — features must match the
+    plain extractor, and rebinding ``ext.params`` must take effect."""
+    _, params, imgs = base
+    plain = InceptionFeatureExtractor(
+        feature="2048", params=params, input_size=IMG, fold_preprocess=False
+    )
+    opt = InceptionFeatureExtractor(
+        feature="2048", params=params, input_size=IMG,
+        fold_preprocess=True, stem_lanes=128,
+    )
+    np.testing.assert_allclose(np.asarray(opt(imgs)), np.asarray(plain(imgs)), atol=5e-6)
+    # rebinding params still takes effect on the optimized path
+    zeroed = jax.tree.map(jnp.zeros_like, params)
+    opt.params = zeroed
+    plain.params = zeroed
+    np.testing.assert_allclose(np.asarray(opt(imgs)), np.asarray(plain(imgs)), atol=5e-6)

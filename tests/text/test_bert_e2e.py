@@ -174,3 +174,55 @@ def test_prejitted_encoder_with_mesh_warns():
         out = bert_score(preds, refs, user_forward_fn=enc, max_length=8, mesh=mesh)
     assert any("mesh" in str(w.message) for w in caught), [str(w.message) for w in caught]
     assert len(out["f1"]) == 4
+
+def test_custom_callable_with_params_config_keeps_signature(local_bert):
+    """A custom encoder that happens to carry ``.params``/``.config`` must be
+    called with its documented positional ``model(ids, mask)`` signature — the
+    old duck-typed HF check (hasattr params+config) hijacked such callables
+    into the HF keyword path (``input_ids=..., params=...``) and crashed them.
+    Only genuine ``transformers.FlaxPreTrainedModel`` instances take the HF
+    wiring (``_is_hf_flax_model``)."""
+    from metrics_tpu.functional import bert_score
+
+    _, tokenizer = local_bert
+
+    class CustomEncoder:
+        # attribute names that collide with the HF duck-type probe
+        params = {"w": jnp.ones((4,))}
+        config = {"hidden": 8}
+
+        def __call__(self, ids, mask):  # positional-only contract
+            emb = jnp.sin(ids[..., None].astype(jnp.float32) * jnp.arange(1.0, 9.0))
+            return emb * mask[..., None].astype(jnp.float32)
+
+    preds = ["the cat sat", "hello world"]
+    refs = ["the cat sat", "general kenobi"]
+    out = bert_score(
+        preds, refs, model=CustomEncoder(),
+        user_tokenizer=_hf_tokenizer(tokenizer), max_length=8,
+    )
+    f1 = np.asarray(out["f1"])
+    assert f1.shape == (2,)
+    np.testing.assert_allclose(f1[0], 1.0, atol=1e-5)
+
+
+@pytest.mark.slow  # two full bert_score runs over the local HF checkpoint
+def test_hf_model_object_still_detected(local_bert):
+    """Passing the FlaxAutoModel OBJECT via ``model=`` still routes through the
+    params-as-runtime-args HF wiring and scores like the path-loaded run."""
+    from transformers import FlaxAutoModel
+
+    from metrics_tpu.functional import bert_score
+    from metrics_tpu.functional.text.bert import _is_hf_flax_model
+
+    flax_dir, tokenizer = local_bert
+    hf = FlaxAutoModel.from_pretrained(flax_dir)
+    assert _is_hf_flax_model(hf)
+    assert not _is_hf_flax_model(lambda ids, mask: ids)
+    preds = ["the cat sat on the mat", "hello there general kenobi"]
+    refs = ["the cat sat on the mat", "a dog ran in the park"]
+    kwargs = dict(user_tokenizer=_hf_tokenizer(tokenizer), max_length=16)
+    via_obj = bert_score(preds, refs, model=hf, **kwargs)
+    via_path = bert_score(preds, refs, model_name_or_path=flax_dir, **kwargs)
+    for k in ("precision", "recall", "f1"):
+        np.testing.assert_allclose(via_obj[k], via_path[k], rtol=1e-5, atol=1e-5)

@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Per-op cost attribution for the repo's compiled hot paths.
+
+Thin CLI over ``metrics_tpu.ops.profiling``: lower a jitted target, walk its
+jaxpr, and print a sorted per-layer cost table (FLOPs, bytes, structural MXU
+tile efficiency, ideal-time share) cross-checked against XLA's own
+``cost_analysis``. Runs on any backend — ``JAX_PLATFORMS=cpu`` works, the
+geometry is platform-independent; pass ``--trace-dir`` on a real TPU to also
+capture a ``jax.profiler`` trace with matching op names.
+
+Targets:
+  * ``inception`` — the embedded InceptionV3 forward that drives FID/IS/KID
+    (the '2048' tap), optionally with the optimized flags;
+  * ``accuracy``  — one compiled MetricCollection-style classification update
+    (``Accuracy.update_state``);
+  * ``all``       — both.
+
+Examples::
+
+    JAX_PLATFORMS=cpu python tools/profile_hlo.py --target inception --input-size 149
+    JAX_PLATFORMS=cpu python tools/profile_hlo.py --target accuracy --json
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _inception_table(input_size: int, batch: int, depth: int, optimized: bool):
+    import warnings
+
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu.models.inception import (
+        InceptionV3,
+        fold_preprocess_into_params,
+        pad_stem_params,
+    )
+    from metrics_tpu.ops import attribution_table
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        module = InceptionV3()
+        x = jnp.zeros((batch, input_size, input_size, 3))
+        params = jax.jit(module.init)(jax.random.PRNGKey(0), x)
+    if optimized:
+        opt = InceptionV3(preprocess_folded=True, stem_lanes=128)
+
+        def fwd(p, imgs):
+            return opt.apply(pad_stem_params(fold_preprocess_into_params(p)), imgs)["2048"]
+    else:
+        def fwd(p, imgs):
+            return module.apply(p, imgs)["2048"]
+
+    return attribution_table(fwd, params, x, depth=depth), (params, x, fwd)
+
+
+def _accuracy_table(batch: int, num_classes: int, depth: int):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from metrics_tpu import Accuracy
+    from metrics_tpu.ops import attribution_table
+
+    rng = np.random.RandomState(0)
+    preds = jnp.asarray(rng.rand(batch, num_classes).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, num_classes, batch))
+    acc = Accuracy()
+    state = acc.init_state()
+
+    def update(s, p, t):
+        return acc.update_state(s, p, t)
+
+    return attribution_table(update, state, preds, target, depth=depth), (state, preds, target, update)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--target", choices=("inception", "accuracy", "all"), default="all")
+    ap.add_argument("--input-size", type=int, default=299, help="inception spatial size")
+    ap.add_argument("--batch", type=int, default=2)
+    ap.add_argument("--num-classes", type=int, default=10, help="accuracy target classes")
+    ap.add_argument("--depth", type=int, default=2, help="name_stack grouping depth")
+    ap.add_argument("--optimized", action="store_true",
+                    help="profile the optimized inception path (folded preprocess + MXU-padded stem)")
+    ap.add_argument("--json", action="store_true", help="emit the full table(s) as one JSON object")
+    ap.add_argument("--trace-dir", default=None,
+                    help="also run the target under jax.profiler.trace into this dir (measured path; real TPU)")
+    args = ap.parse_args(argv)
+
+    from metrics_tpu.ops import capture_trace, format_table
+
+    out = {}
+    if args.target in ("inception", "all"):
+        table, (p, x, fwd) = _inception_table(args.input_size, args.batch, args.depth, args.optimized)
+        out["inception"] = table
+        if args.trace_dir:
+            capture_trace(fwd, (p, x), args.trace_dir + "/inception")
+    if args.target in ("accuracy", "all"):
+        table, (state, preds, target, update) = _accuracy_table(args.batch, args.num_classes, args.depth)
+        out["accuracy"] = table
+        if args.trace_dir:
+            capture_trace(update, (state, preds, target), args.trace_dir + "/accuracy")
+
+    if args.json:
+        print(json.dumps(out))
+    else:
+        for name, table in out.items():
+            print(f"== {name} ==")
+            print(format_table(table))
+            print()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
