@@ -1,8 +1,17 @@
 # Parity target: reference Makefile (test = pytest with coverage).
-.PHONY: test clean native bench
+# Default flow runs the engine smoke check (seconds) before the full suite.
+.PHONY: all test engine-smoke clean native bench
+
+all: engine-smoke test
 
 test:
 	python -m pytest tests/ -q
+
+# 1-device, tiny buckets: ragged-stream parity vs eager, compile budget, and
+# warm-cache zero-compile assertion (metrics_tpu/engine/smoke.py). Telemetry
+# lands in engine_telemetry.json; pretty-print: python tools/engine_report.py
+engine-smoke:
+	JAX_PLATFORMS=cpu python -m metrics_tpu.engine.smoke engine_telemetry.json
 
 native:
 	g++ -O3 -shared -fPIC metrics_tpu/native/levenshtein.cpp -o metrics_tpu/native/_levenshtein.so
@@ -13,4 +22,4 @@ bench:
 clean:
 	rm -rf .pytest_cache build dist *.egg-info
 	find . -name __pycache__ -type d -exec rm -rf {} +
-	rm -f metrics_tpu/native/_levenshtein.so
+	rm -f metrics_tpu/native/_levenshtein.so engine_telemetry.json

@@ -272,6 +272,34 @@ class MetricCollection(dict):
             for k, m in self.items(keep_base=True)
         }
 
+    def abstract_state(self) -> Dict[str, Dict[str, Any]]:
+        """``ShapeDtypeStruct`` pytree mirroring :meth:`init_state` (AOT template)."""
+        return {k: m.abstract_state() for k, m in self.items(keep_base=True)}
+
+    def merge_states(
+        self, a: Dict[str, Dict[str, Any]], b: Dict[str, Dict[str, Any]]
+    ) -> Dict[str, Dict[str, Any]]:
+        """Pairwise merge of two collection state pytrees (member-wise, pure)."""
+        return {k: m.merge_states(a[k], b[k]) for k, m in self.items(keep_base=True)}
+
+    def masked_update_unsupported_reason(self) -> "str | None":
+        """None when every member supports the mask-aware update path."""
+        for k, m in self.items(keep_base=True):
+            r = m.masked_update_unsupported_reason()
+            if r is not None:
+                return f"member {k!r}: {r}"
+        return None
+
+    def update_state_masked(
+        self, state: Dict[str, Dict[str, Any]], *args: Any, mask: Any, **kwargs: Any
+    ) -> Dict[str, Dict[str, Any]]:
+        """Mask-aware fan-out update of all members (the streaming-engine entry:
+        one call == one fused program over every member's masked delta)."""
+        return {
+            k: m.update_state_masked(state[k], *args, mask=mask, **m._filter_kwargs(**kwargs))
+            for k, m in self.items(keep_base=True)
+        }
+
     def sync_states(
         self, state: Dict[str, Dict[str, Any]], axis_name: Optional[AxisSpec] = None
     ) -> Dict[str, Dict[str, Any]]:

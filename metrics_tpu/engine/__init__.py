@@ -1,0 +1,58 @@
+"""Streaming evaluation engine — metrics as a service (SURVEY.md north star).
+
+The reference (TorchMetrics) is a passive library: every ``update()`` is a
+synchronous eager dispatch and every new input shape is a fresh trace. Serving
+sustained traffic on TPU needs the opposite contract (arXiv:2605.25645,
+arXiv:2204.06514): a CLOSED set of ahead-of-time compiled programs plus
+host-side batching and queueing. This package supplies it:
+
+* :mod:`~metrics_tpu.engine.bucketing` — shape bucketing + padding policy:
+  incoming ragged batches round up to a small configurable set of padded batch
+  sizes, with a validity mask so pad rows are inert
+  (``Metric.update_state_masked``). The executable set is closed by
+  construction.
+* :mod:`~metrics_tpu.engine.aot` — AOT compilation cache: the per-bucket
+  ``update`` / ``compute`` programs are lowered and compiled ONCE per
+  (bucket signature, mesh, dtype), with hit/miss counters, optionally backed
+  by JAX's persistent compilation cache directory so a warm process restart
+  pays zero XLA compiles.
+* :mod:`~metrics_tpu.engine.pipeline` — the :class:`StreamingEngine`: a
+  bounded host ingestion queue (blocking ``submit`` = backpressure), an async
+  dispatcher thread that pads/uploads the next batch while the device runs the
+  current step (double buffering via JAX async dispatch, bounded by
+  ``in_flight``), donated state buffers, and mesh-aware sharded steps.
+* :mod:`~metrics_tpu.engine.snapshot` / :mod:`~metrics_tpu.engine.stats` —
+  periodic atomic snapshots of the accumulated state (orbax-backed, resumable
+  after a kill) and ring-buffer telemetry (queue depth, padding waste,
+  compile-cache hits, step latency spread) exported as JSON.
+
+Quickstart::
+
+    from metrics_tpu import Accuracy
+    from metrics_tpu.engine import EngineConfig, StreamingEngine
+
+    engine = StreamingEngine(Accuracy(), EngineConfig(buckets=(128, 512)))
+    with engine:
+        for preds, target in stream:      # ragged batch sizes welcome
+            engine.submit(preds, target)  # blocks when the queue is full
+        value = engine.result()           # flush + compiled compute
+
+See ``docs/serving.md`` for the architecture and recovery semantics.
+"""
+from metrics_tpu.engine.aot import AotCache, enable_persistent_compilation_cache
+from metrics_tpu.engine.bucketing import BucketPolicy
+from metrics_tpu.engine.pipeline import EngineConfig, StreamingEngine
+from metrics_tpu.engine.snapshot import latest_snapshot, load_snapshot, save_snapshot
+from metrics_tpu.engine.stats import EngineStats
+
+__all__ = [
+    "AotCache",
+    "BucketPolicy",
+    "EngineConfig",
+    "EngineStats",
+    "StreamingEngine",
+    "enable_persistent_compilation_cache",
+    "latest_snapshot",
+    "load_snapshot",
+    "save_snapshot",
+]

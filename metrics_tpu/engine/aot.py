@@ -1,0 +1,247 @@
+"""AOT compilation cache: lower + compile each engine program exactly once.
+
+Two layers of caching:
+
+* **In-process executable cache** (:class:`AotCache`): engine programs are
+  compiled via ``jax.jit(...).lower(...).compile()`` — explicit AOT, not
+  trace-on-first-call — and memoised under a structural key (program kind,
+  metric fingerprint, state/input signature, mesh fingerprint, donation,
+  backend). Hit/miss counters are the serving observable: a steady-state
+  stream MUST show zero misses after warmup, and the engine tests assert
+  exactly that (first run: at most ``len(buckets)`` update misses; warm second
+  run: zero).
+* **JAX persistent compilation cache** (:func:`enable_persistent_compilation_cache`):
+  pointing it at a directory makes a warm PROCESS RESTART skip the XLA compile
+  too — the in-process cache counts a miss (the executable object must be
+  rebuilt) but XLA serves the binary from disk instead of recompiling
+  (arXiv:2605.25645's serving recipe: compile once, restart free).
+
+The structural key deliberately excludes object identity so two engines over
+equivalently-configured metrics share executables. A metric's fingerprint
+covers its class tree, scalar config, and (hashed) small config arrays —
+see :func:`metric_fingerprint`.
+"""
+import hashlib
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["AotCache", "enable_persistent_compilation_cache", "metric_fingerprint"]
+
+# config arrays larger than this are fingerprinted by shape/dtype + a
+# head+tail content sample instead of full content (hashing an embedded
+# model's 100MB params per engine build would dominate startup)
+_HASH_ARRAY_BYTES_CAP = 1 << 20
+
+
+def enable_persistent_compilation_cache(path: str) -> str:
+    """Point JAX's persistent compilation cache at ``path`` (process-global).
+
+    Also drops the min-compile-time/min-entry-size thresholds so the small
+    per-bucket metric programs are cached at all (the defaults only persist
+    programs that took >1 s to compile). Returns the absolute path. Safe to
+    call repeatedly; failures (unsupported backend/jax build) are non-fatal —
+    the engine still works, warm restarts just pay the XLA compile.
+    """
+    import jax
+
+    path = os.path.abspath(path)
+    os.makedirs(path, exist_ok=True)
+    try:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # the cache handle is created lazily at the backend's FIRST compile and
+        # never re-reads the config — if any computation already ran (warmup,
+        # eager validation), force re-initialization so the new dir takes
+        from jax.experimental.compilation_cache import compilation_cache as cc
+
+        cc.reset_cache()
+    except Exception:  # pragma: no cover - jax-version dependent
+        pass
+    return path
+
+
+def persistent_cache_entries(path: Optional[str]) -> int:
+    """Number of compiled-program files under a persistent cache dir."""
+    if not path or not os.path.isdir(path):
+        return 0
+    return sum(len(files) for _, _, files in os.walk(path))
+
+
+def _fingerprint_value(v: Any, h: "hashlib._Hash") -> None:
+    if isinstance(v, (bool, int, float, str, bytes, type(None))):
+        h.update(repr(v).encode())
+    elif isinstance(v, np.generic):  # numpy scalars are NOT python ints/floats
+        h.update(f"{v.dtype}:{v!r}".encode())
+    elif isinstance(v, np.ndarray) or type(v).__name__ in ("ArrayImpl", "Array"):
+        arr = np.asarray(v)
+        h.update(f"arr{arr.shape}{arr.dtype}".encode())
+        if arr.nbytes <= _HASH_ARRAY_BYTES_CAP:
+            h.update(np.ascontiguousarray(arr).tobytes())
+        else:
+            # big config arrays (embedded-model params): hash a deterministic
+            # head+tail sample instead of full content — never id(), whose
+            # CPython reuse after GC could alias two different weight sets
+            flat = arr.reshape(-1)
+            h.update(np.ascontiguousarray(flat[:1024]).tobytes())
+            h.update(np.ascontiguousarray(flat[-1024:]).tobytes())
+            h.update(str(arr.nbytes).encode())
+    elif isinstance(v, (tuple, list)):
+        h.update(b"[")
+        for x in v:
+            _fingerprint_value(x, h)
+        h.update(b"]")
+    elif isinstance(v, dict):
+        for k, val in sorted(v.items(), key=lambda kv: str(kv[0])):
+            h.update(str(k).encode())
+            _fingerprint_value(val, h)
+    else:
+        # unknown config type: hashing NOTHING here would let two differently-
+        # configured metrics share a fingerprint (silently wrong program
+        # reuse). repr() may be identity-unstable, which at worst costs an
+        # extra compile — the safe failure direction.
+        h.update(repr(v)[:256].encode())
+
+
+def metric_fingerprint(metric: Any) -> str:
+    """Structural fingerprint of a metric/collection's compiled behavior.
+
+    Covers the class tree and every configuration attribute that gets baked
+    into a trace: scalars, strings, small arrays (content-hashed), nested
+    metrics, collection membership. Registered STATE values are excluded —
+    state travels as a program argument, not a constant.
+    """
+    h = hashlib.sha256()
+
+    def visit(m: Any) -> None:
+        h.update(type(m).__name__.encode())
+        if hasattr(m, "_defaults"):  # a Metric
+            skip = set(m._defaults) | {
+                "update", "compute", "_defaults", "_persistent", "_reductions",
+                "_computed", "_forward_cache", "_cache", "_deferred_errcode",
+                "_fwd_path_ok", "_update_called", "_is_synced", "_to_sync",
+                "_should_unsync",
+            }
+            for name in sorted(m.__dict__):
+                if name in skip:
+                    continue
+                v = m.__dict__[name]
+                h.update(name.encode())
+                if hasattr(v, "_defaults"):
+                    visit(v)
+                elif isinstance(v, (list, tuple)) and v and all(hasattr(x, "_defaults") for x in v):
+                    for x in v:
+                        visit(x)
+                elif callable(v):
+                    h.update(getattr(v, "__qualname__", repr(type(v))).encode())
+                else:
+                    _fingerprint_value(v, h)
+        elif isinstance(m, dict):  # a MetricCollection
+            for k, v in m.items():
+                h.update(k.encode())
+                visit(v)
+
+    visit(metric)
+    return h.hexdigest()[:16]
+
+
+def _mesh_fingerprint(mesh: Any) -> str:
+    if mesh is None:
+        return "none"
+    # device ids matter: an executable is compiled FOR its devices — two
+    # same-shape meshes over different device subsets must not share programs
+    ids = ",".join(str(d.id) for d in mesh.devices.flat)
+    return f"{tuple(mesh.axis_names)}x{tuple(mesh.devices.shape)}:{mesh.devices.flat[0].platform}:{ids}"
+
+
+class AotCache:
+    """In-process cache of AOT-compiled engine executables, with counters.
+
+    Args:
+        cache_dir: optional directory for JAX's persistent compilation cache
+            (warm process restarts skip the XLA compile).
+    """
+
+    def __init__(self, cache_dir: Optional[str] = None):
+        self.cache_dir = enable_persistent_compilation_cache(cache_dir) if cache_dir else None
+        self._programs: Dict[Tuple, Any] = {}
+        # one cache may be SHARED across engines (each with its own dispatcher
+        # thread); the lock also spans build(), so two threads racing the same
+        # key pay ONE compile, not two, and the counters stay exact
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.compile_seconds = 0.0
+
+    def __len__(self) -> int:
+        return len(self._programs)
+
+    def count_hit(self) -> None:
+        """Atomically count a cache hit served from an engine-local memo."""
+        with self._lock:
+            self.hits += 1
+
+    def get_or_compile(self, key: Tuple, build: Callable[[], Any]) -> Any:
+        """Return the executable for ``key``, compiling via ``build()`` on miss."""
+        with self._lock:
+            prog = self._programs.get(key)
+            if prog is not None:
+                self.hits += 1
+                return prog
+            self.misses += 1
+            t0 = time.perf_counter()
+            prog = build()
+            self.compile_seconds += time.perf_counter() - t0
+            self._programs[key] = prog
+            return prog
+
+    @staticmethod
+    def signature_of(tree: Any) -> Tuple:
+        """Hashable (treedef, leaf shape/dtype) signature of an arg pytree."""
+        import jax
+
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        sig = tuple(
+            (leaf.shape, str(leaf.dtype))
+            if hasattr(leaf, "shape")
+            else (
+                (type(leaf).__name__, leaf)
+                if isinstance(leaf, (bool, int, float, str, type(None)))
+                else (type(leaf).__name__, repr(leaf)[:64])  # fail-safe: key by repr
+            )
+            for leaf in leaves
+        )
+        return (treedef, sig)
+
+    def program_key(
+        self,
+        kind: str,
+        metric_fp: str,
+        arg_tree: Any = None,
+        mesh: Any = None,
+        donate: bool = False,
+    ) -> Tuple:
+        import jax
+
+        return (
+            kind,
+            metric_fp,
+            self.signature_of(arg_tree) if arg_tree is not None else None,
+            _mesh_fingerprint(mesh),
+            bool(donate),
+            jax.default_backend(),
+        )
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "programs": len(self._programs),
+            "hits": self.hits,
+            "misses": self.misses,
+            "compile_seconds": round(self.compile_seconds, 3),
+            "persistent_cache_dir": self.cache_dir,
+            "persistent_cache_entries": persistent_cache_entries(self.cache_dir),
+        }

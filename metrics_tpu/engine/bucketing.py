@@ -1,0 +1,130 @@
+"""Shape bucketing + padding policy: a CLOSED set of compiled batch shapes.
+
+Serving traffic arrives ragged; XLA programs are shape-monomorphic. Without a
+policy, every new batch size is a fresh trace + compile (the reference's eager
+contract has the same pathology one level down — every ``update`` re-dispatches
+per shape). The policy here rounds every incoming batch up to the smallest of
+a small, configurable set of bucket sizes, padding with an inert fill and a
+validity mask; batches larger than the biggest bucket are split into
+max-bucket chunks plus a bucketed remainder. The compiled-program set is then
+at most ``len(buckets)`` per input signature, forever.
+
+Pad rows must contribute nothing: the engine feeds the mask to
+``Metric.update_state_masked`` (see ``metric.py``), which substitutes each
+state reduction's identity element for masked-out rows — so correctness does
+not depend on the fill value. The fill only has to be VALID input (pass the
+metric's own range/type checks); 0 is right for classification targets,
+probabilities, and regression values alike, and is overridable per policy.
+"""
+import bisect
+from typing import Any, Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from metrics_tpu.utils.data import infer_batch_size, is_batch_leaf
+
+__all__ = ["BucketPolicy"]
+
+
+class BucketPolicy:
+    """Round ragged batch sizes to a fixed ascending set of padded sizes.
+
+    Args:
+        buckets: allowed padded batch sizes (deduplicated, sorted ascending).
+        pad_value: scalar fill for pad rows (cast to each leaf's dtype).
+        divisor: every bucket must be divisible by this (the mesh batch-axis
+            size for sharded engine steps; 1 for single-device).
+    """
+
+    def __init__(self, buckets: Sequence[int], pad_value: Any = 0, divisor: int = 1):
+        sizes = sorted({int(b) for b in buckets})
+        if not sizes or sizes[0] <= 0:
+            raise ValueError(f"buckets must be positive ints, got {buckets!r}")
+        bad = [b for b in sizes if b % divisor]
+        if bad:
+            raise ValueError(
+                f"bucket sizes {bad} are not divisible by the mesh batch-axis size {divisor}"
+            )
+        self.buckets: Tuple[int, ...] = tuple(sizes)
+        self.pad_value = pad_value
+        self.divisor = int(divisor)
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest bucket >= n (the biggest bucket for oversized chunks)."""
+        if n <= 0:
+            raise ValueError(f"batch size must be positive, got {n}")
+        i = bisect.bisect_left(self.buckets, n)
+        return self.buckets[i] if i < len(self.buckets) else self.buckets[-1]
+
+    def chunks(self, n: int) -> List[Tuple[int, int, int]]:
+        """Split a batch of ``n`` rows into ``(start, stop, bucket)`` chunks.
+
+        Whole max-bucket chunks first, then one bucketed remainder — so a
+        10_000-row batch against buckets (256, 1024) becomes nine exact 1024
+        chunks plus one 784-row chunk padded to 1024.
+        """
+        top = self.buckets[-1]
+        out: List[Tuple[int, int, int]] = []
+        start = 0
+        while n - start > top:
+            out.append((start, start + top, top))
+            start += top
+        out.append((start, n, self.bucket_for(n - start)))
+        return out
+
+    def pad_chunk(
+        self, args: Tuple[Any, ...], kwargs: Dict[str, Any], start: int, stop: int, bucket: int
+    ) -> Tuple[Tuple[Any, ...], Dict[str, Any], np.ndarray]:
+        """Slice rows ``[start, stop)`` out of every batch-carried leaf and pad
+        to ``bucket`` rows. Host-side numpy (this runs on the ingest thread,
+        overlapping the device step); returns ``(args, kwargs, mask)``.
+
+        A leaf is batch-carried when it is an array whose leading dimension
+        equals the batch size inferred from the first array leaf — the same
+        contract as ``Metric.update_state_masked``. Non-array leaves (python
+        scalars, None) pass through untouched.
+        """
+        import jax
+
+        leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+        n = infer_batch_size(leaves)
+        if n is None:
+            raise ValueError("no array argument with a leading batch dimension")
+        valid = stop - start
+        if not (0 < valid <= bucket):
+            raise ValueError(f"chunk [{start}:{stop}) does not fit bucket {bucket}")
+        # downstream, is_batch_leaf (utils/data.py) classifies leading-dim ==
+        # mask length as batch-carried — against the GLOBAL bucket in the
+        # 1-device step, and against the PER-SHARD row count (bucket/divisor)
+        # inside a mesh step's shard_map body. A broadcast leaf of either size
+        # would be silently vmapped per-row (and mesh-sharded): refuse.
+        ambiguous = {bucket, bucket // self.divisor} - {n}
+        out_leaves = []
+        for leaf in leaves:
+            if is_batch_leaf(leaf, n):
+                rows = np.asarray(leaf[start:stop])
+                if valid < bucket:
+                    pad = np.full((bucket - valid,) + rows.shape[1:], self.pad_value, rows.dtype)
+                    rows = np.concatenate([rows, pad], axis=0)
+                out_leaves.append(rows)
+            else:
+                if any(is_batch_leaf(leaf, a) for a in ambiguous):
+                    raise ValueError(
+                        f"non-batch array argument with leading dimension {leaf.shape[0]} is "
+                        f"ambiguous against bucket {bucket} (batch size here is {n}, "
+                        f"per-shard rows {bucket // self.divisor}); reshape it (e.g. add a "
+                        "leading axis of 1) or choose buckets that cannot collide"
+                    )
+                out_leaves.append(leaf)
+        mask = np.zeros((bucket,), bool)
+        mask[:valid] = True
+        a, kw = jax.tree_util.tree_unflatten(treedef, out_leaves)
+        return a, kw, mask
+
+    @staticmethod
+    def waste_fraction(valid_total: int, padded_total: int) -> float:
+        """Fraction of device rows spent on padding (0 = perfect packing)."""
+        return 0.0 if padded_total == 0 else 1.0 - valid_total / padded_total
+
+    def __repr__(self) -> str:
+        return f"BucketPolicy(buckets={self.buckets}, divisor={self.divisor})"

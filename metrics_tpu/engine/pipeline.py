@@ -1,0 +1,449 @@
+"""The streaming engine: bounded ingest queue → padded buckets → AOT steps.
+
+Dataflow (one engine = one metric/collection served as a stream consumer)::
+
+    submit(*batch)        # producer thread(s); BLOCKS when the queue is full
+      └─ bounded queue (backpressure, config.max_queue batches)
+           └─ dispatcher thread: chunk → pad to bucket (host numpy) →
+              device upload → AOT-compiled step(state, batch, mask)
+                 └─ donated state buffers, up to config.in_flight steps
+                    un-synced (JAX async dispatch overlaps the host's padding
+                    of batch k+1 with the device's execution of batch k)
+    result()              # flush + AOT-compiled compute on the final state
+
+Design notes:
+
+* **Closed program set.** Every step program is keyed by (bucket signature,
+  metric fingerprint, mesh, donation, backend) and compiled ahead-of-time via
+  ``jit(...).lower(...).compile()`` — after at most ``len(buckets)`` compiles
+  per input signature the engine never traces again (``engine/aot.py``).
+* **Donation.** The state pytree is donated into each step: XLA merges the
+  delta in place instead of allocating a second state copy (material for
+  big-state metrics; ``metric.py`` documents the same policy for compiled
+  forward). Donation is skipped on CPU, which doesn't implement it.
+* **Mesh-aware steps.** With ``config.mesh`` the step runs under ``shard_map``:
+  batch rows and mask shard over ``config.axis``, state stays replicated, the
+  per-shard masked delta is psum-merged in-step (``sync_states``) so the
+  carried state is always the GLOBAL state — compute needs no further sync,
+  and a snapshot taken between any two steps is globally consistent.
+* **Virtual-mesh serialization.** On CPU meshes overlapping async collective
+  executions can deadlock the in-process communicator
+  (``parallel/embedded.py``); the engine serializes steps there. Real TPU
+  meshes keep the full ``in_flight`` pipeline.
+* **Recovery.** ``snapshot_every > 0`` writes crash-safe periodic snapshots
+  (``engine/snapshot.py``); ``restore()`` resumes exactly — replaying the
+  stream from the snapshot's step reproduces the uninterrupted result.
+"""
+import queue
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.engine.aot import AotCache, metric_fingerprint
+from metrics_tpu.engine.bucketing import BucketPolicy
+from metrics_tpu.engine.snapshot import load_snapshot, save_snapshot
+from metrics_tpu.engine.stats import EngineStats
+from metrics_tpu.utils.data import infer_batch_size, is_batch_leaf
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+__all__ = ["EngineConfig", "StreamingEngine"]
+
+_STOP = object()
+
+
+@dataclass
+class EngineConfig:
+    """Configuration for :class:`StreamingEngine`.
+
+    Args:
+        buckets: allowed padded batch sizes (the closed shape set).
+        max_queue: bounded ingest queue capacity, in batches. ``submit``
+            blocks when full — backpressure to the producer.
+        in_flight: device steps allowed un-synced before the dispatcher
+            blocks on the oldest (double-buffering depth).
+        snapshot_every: BATCHES between crash-safe state snapshots (0 = off).
+            Snapshots land on batch boundaries only — a batch larger than the
+            top bucket spans several device steps, and a mid-batch snapshot
+            would break batch-level replay on resume.
+        snapshot_dir: where snapshots live (required when snapshot_every > 0).
+        compilation_cache_dir: JAX persistent compilation cache directory —
+            warm process restarts skip XLA compiles entirely.
+        mesh: optional ``jax.sharding.Mesh`` for sharded engine steps.
+        axis: mesh axis name carrying the batch shards.
+        donate: donate state buffers into each step (ignored on CPU).
+        pad_value: fill for pad rows (must pass the metric's input checks;
+            masked out of every reduction regardless).
+        telemetry_capacity: ring-buffer size for per-step telemetry.
+        snapshot_keep: complete snapshots retained after GC.
+    """
+
+    buckets: Tuple[int, ...] = (256, 1024)
+    max_queue: int = 64
+    in_flight: int = 2
+    snapshot_every: int = 0
+    snapshot_dir: Optional[str] = None
+    compilation_cache_dir: Optional[str] = None
+    mesh: Optional[Any] = None
+    axis: str = "dp"
+    donate: bool = True
+    pad_value: Any = 0
+    telemetry_capacity: int = 1024
+    snapshot_keep: int = 2
+
+
+class StreamingEngine:
+    """Drive a ``Metric``/``MetricCollection`` as a streaming service.
+
+    Thread model: producers call :meth:`submit`; one dispatcher thread owns
+    the device pipeline; :meth:`flush`/:meth:`result`/:meth:`state` join the
+    queue before touching state, so reads never race the dispatcher.
+    """
+
+    def __init__(self, metric: Any, config: Optional[EngineConfig] = None, aot_cache: Optional[AotCache] = None):
+        self._metric = metric
+        self._cfg = config or EngineConfig()
+        reason = metric.masked_update_unsupported_reason()
+        if reason is not None:
+            raise MetricsTPUUserError(
+                f"metric cannot be served by the streaming engine: {reason}"
+            )
+        divisor = 1
+        if self._cfg.mesh is not None:
+            divisor = int(np.prod([self._cfg.mesh.shape[a] for a in self._axis_names()]))
+        self._policy = BucketPolicy(self._cfg.buckets, pad_value=self._cfg.pad_value, divisor=divisor)
+        self._aot = aot_cache if aot_cache is not None else AotCache(self._cfg.compilation_cache_dir)
+        self._stats = EngineStats(self._cfg.telemetry_capacity)
+        self._metric_fp = metric_fingerprint(metric)
+        if self._cfg.snapshot_every > 0 and not self._cfg.snapshot_dir:
+            raise MetricsTPUUserError("snapshot_every > 0 requires snapshot_dir")
+        self._queue: "queue.Queue" = queue.Queue(maxsize=max(1, self._cfg.max_queue))
+        self._program_memo: Dict[Tuple, Any] = {}
+        self._inflight: "deque" = deque()
+        self._worker: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self._step = 0
+        self._batches_done = 0
+        self._state = self._put_state(metric.init_state())
+        self._donate = bool(self._cfg.donate) and jax.default_backend() != "cpu"
+        self._serialize = (
+            self._cfg.mesh is not None and self._cfg.mesh.devices.flat[0].platform == "cpu"
+        )
+
+    # ------------------------------------------------------------------ mesh helpers
+
+    def _axis_names(self) -> Tuple[str, ...]:
+        a = self._cfg.axis
+        return tuple(a) if isinstance(a, (tuple, list)) else (a,)
+
+    def _replicated_sharding(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return NamedSharding(self._cfg.mesh, P())
+
+    def _batch_sharding(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return NamedSharding(self._cfg.mesh, P(self._cfg.axis))
+
+    def _put_state(self, state: Any) -> Any:
+        """Device-commit a state pytree (replicated over the mesh, if any)."""
+        if self._cfg.mesh is None:
+            return jax.tree.map(jnp.asarray, state)
+        rep = self._replicated_sharding()
+        return jax.tree.map(lambda x: jax.device_put(jnp.asarray(x), rep), state)
+
+    def _abstract_state(self) -> Any:
+        abs_state = self._metric.abstract_state()
+        if self._cfg.mesh is None:
+            return abs_state
+        rep = self._replicated_sharding()
+        return jax.tree.map(lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=rep), abs_state)
+
+    # ------------------------------------------------------------------ AOT programs
+
+    def _update_program(self, payload: Any, mask: np.ndarray):
+        """The compiled step for this payload signature (AOT, cached).
+
+        Hot path: a per-engine memo keyed by the concrete payload signature
+        (one tree_flatten) skips the abstract-tree construction and the full
+        structural program key on every steady-state step.
+        """
+        memo_key = (AotCache.signature_of(payload), mask.shape)
+        prog = self._program_memo.get(memo_key)
+        if prog is not None:
+            self._aot.count_hit()  # memo short-circuit still counts as a cache hit
+            return prog
+        payload_abs = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype)
+            if isinstance(x, (np.ndarray, jnp.ndarray))
+            else x,
+            payload,
+        )
+        mask_abs = jax.ShapeDtypeStruct(mask.shape, np.dtype(bool))
+        key = self._aot.program_key(
+            "update", self._metric_fp, arg_tree=(payload_abs, mask_abs),
+            mesh=self._cfg.mesh, donate=self._donate,
+        )
+        prog = self._aot.get_or_compile(
+            key, lambda: self._build_update_program(payload_abs, mask_abs)
+        )
+        self._program_memo[memo_key] = prog
+        return prog
+
+    def _build_update_program(self, payload_abs: Any, mask_abs: Any):
+        """Compile ``(state, payload, mask) -> (new_state, token)``.
+
+        ``token`` is the step's global valid-row count — a tiny NON-donated
+        output the dispatcher can block on to bound in-flight depth (the state
+        itself may already have been donated into the NEXT step by the time
+        the dispatcher needs to wait, and a donated buffer cannot be synced
+        on). It doubles as a liveness cross-check in telemetry.
+        """
+        metric = self._metric
+        mesh, axis = self._cfg.mesh, self._cfg.axis
+
+        if mesh is None:
+            def step(state, payload, mask):
+                a, kw = payload
+                new_state = metric.update_state_masked(state, *a, mask=mask, **kw)
+                return new_state, jnp.sum(mask.astype(jnp.int32))
+
+            jitted = jax.jit(step, donate_argnums=(0,) if self._donate else ())
+            return jitted.lower(self._abstract_state(), payload_abs, mask_abs).compile()
+
+        from metrics_tpu.parallel.embedded import sharded_masked_step
+
+        sharded = sharded_masked_step(metric, mesh, axis, payload_abs, mask_abs)
+        jitted = jax.jit(sharded, donate_argnums=(0,) if self._donate else ())
+        n_rows = mask_abs.shape[0]
+        batch_sh = self._batch_sharding()
+        rep_sh = self._replicated_sharding()
+        mask_sharded = jax.ShapeDtypeStruct(mask_abs.shape, mask_abs.dtype, sharding=batch_sh)
+        payload_abs = jax.tree.map(
+            lambda s: jax.ShapeDtypeStruct(
+                s.shape, s.dtype,
+                sharding=batch_sh if is_batch_leaf(s, n_rows) else rep_sh,
+            )
+            if hasattr(s, "shape")
+            else s,
+            payload_abs,
+        )
+        return jitted.lower(self._abstract_state(), payload_abs, mask_sharded).compile()
+
+    def _compute_program(self):
+        key = self._aot.program_key(
+            "compute", self._metric_fp, arg_tree=self._metric.abstract_state(),
+            mesh=self._cfg.mesh, donate=False,
+        )
+        metric = self._metric
+        return self._aot.get_or_compile(
+            key, lambda: jax.jit(metric.compute_from).lower(self._abstract_state()).compile()
+        )
+
+    # --------------------------------------------------------------------- lifecycle
+
+    def start(self) -> "StreamingEngine":
+        if self._worker is None:
+            self._worker = threading.Thread(
+                target=self._run, name="metrics-tpu-engine", daemon=True
+            )
+            self._worker.start()
+        return self
+
+    def __enter__(self) -> "StreamingEngine":
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.stop()
+        if exc_type is None:
+            self._raise_if_failed()
+        return False
+
+    def stop(self) -> None:
+        """Drain the queue and stop the dispatcher (idempotent)."""
+        if self._worker is not None:
+            self._queue.put(_STOP)
+            self._worker.join()
+            self._worker = None
+
+    def _raise_if_failed(self) -> None:
+        if self._error is not None:
+            raise RuntimeError("streaming engine dispatcher failed") from self._error
+
+    # --------------------------------------------------------------------- producers
+
+    def submit(self, *args: Any, **kwargs: Any) -> None:
+        """Enqueue one (ragged) batch. Blocks when the queue is full."""
+        self._raise_if_failed()
+        self.start()
+        self._stats.batches_submitted += 1
+        self._queue.put((args, kwargs))
+
+    def flush(self) -> None:
+        """Block until every submitted batch is folded into the state."""
+        self._raise_if_failed()
+        self._queue.join()
+        jax.block_until_ready(self._state)
+        self._raise_if_failed()
+
+    def result(self) -> Any:
+        """Flush, then run the AOT-compiled compute on the accumulated state."""
+        self.flush()
+        return self._compute_program()(self._state)
+
+    def state(self) -> Any:
+        """A defensive copy of the accumulated (global) state pytree, after a
+        flush. Copied because the live buffers are DONATED into the next
+        update step — a borrowed reference would read as deleted after the
+        caller submits more traffic."""
+        self.flush()
+        return jax.tree.map(lambda x: jnp.array(x, copy=True), self._state)
+
+    @property
+    def steps(self) -> int:
+        return self._step
+
+    @property
+    def stats(self) -> EngineStats:
+        return self._stats
+
+    @property
+    def aot_cache(self) -> AotCache:
+        return self._aot
+
+    def telemetry(self) -> Dict[str, Any]:
+        return self._stats.summary(self._aot.stats())
+
+    def export_telemetry(self, path: str) -> None:
+        self._stats.export(path, self._aot.stats())
+
+    def reset(self) -> None:
+        """Fresh accumulation (flushes first); compiled programs are kept."""
+        self.flush()
+        self._state = self._put_state(self._metric.init_state())
+        self._step = 0
+        self._batches_done = 0
+
+    # ---------------------------------------------------------------------- recovery
+
+    def snapshot(self) -> str:
+        """Flush and write one crash-safe snapshot now."""
+        if not self._cfg.snapshot_dir:
+            raise MetricsTPUUserError("snapshot() requires config.snapshot_dir")
+        self.flush()
+        return self._save_snapshot()
+
+    def _save_snapshot(self) -> str:
+        host_state = jax.device_get(self._state)
+        path = save_snapshot(
+            self._cfg.snapshot_dir,
+            host_state,
+            {
+                "step": self._step,
+                "batches_done": self._batches_done,
+                "rows_in": self._stats.rows_in,
+                "rows_padded": self._stats.rows_padded,
+            },
+            keep=self._cfg.snapshot_keep,
+        )
+        self._stats.snapshots += 1
+        return path
+
+    def restore(self, directory_or_path: Optional[str] = None) -> Dict[str, Any]:
+        """Resume from the newest complete snapshot (engine must be idle).
+
+        Returns the snapshot's meta dict — ``batches_done`` is the replay
+        cursor: re-submit the stream from that batch onward and the final
+        result is exactly the uninterrupted one.
+        """
+        self.flush()
+        state, meta = load_snapshot(directory_or_path or self._cfg.snapshot_dir)
+        self._state = self._put_state(state)
+        self._step = int(meta.get("step", 0))
+        self._batches_done = int(meta.get("batches_done", self._step))
+        self._stats.rows_in = int(meta.get("rows_in", self._stats.rows_in))
+        self._stats.rows_padded = int(meta.get("rows_padded", self._stats.rows_padded))
+        self._stats.resumes += 1
+        return meta
+
+    # -------------------------------------------------------------------- dispatcher
+
+    def _run(self) -> None:
+        while True:
+            item = self._queue.get()
+            try:
+                if item is _STOP:
+                    return
+                if self._error is None:  # after a failure: drain without work
+                    self._process(*item)
+            except BaseException as e:  # noqa: BLE001 - surfaced via _raise_if_failed
+                self._error = e
+            finally:
+                self._queue.task_done()
+
+    def _process(self, args: Tuple[Any, ...], kwargs: Dict[str, Any]) -> None:
+        n = infer_batch_size((args, kwargs))  # same inference pad_chunk uses
+        if n is None:
+            raise MetricsTPUUserError("submit() needs at least one array argument with a batch dimension")
+        # an empty tail batch is a no-op, not a poison pill — it contributes no
+        # steps but still advances the replay cursor (and snapshot cadence)
+        for start, stop, bucket in self._policy.chunks(int(n)) if n else []:
+            t0 = time.perf_counter()
+            a, kw, mask = self._policy.pad_chunk(args, kwargs, start, stop, bucket)
+            payload, mask_dev = self._upload((a, kw), mask)
+            ingest_us = (time.perf_counter() - t0) * 1e6  # pad+upload only, not compile
+            program = self._update_program(payload, mask)
+            depth = self._queue.qsize()
+            new_state, token = program(self._state, payload, mask_dev)
+            self._state = new_state
+            self._step += 1
+            sync_us = self._bound_inflight(token)
+            self._stats.record_step(
+                bucket=bucket, valid=stop - start, queue_depth=depth,
+                ingest_us=ingest_us, sync_us=sync_us,
+            )
+        self._batches_done += 1
+        if (
+            self._cfg.snapshot_every > 0
+            and self._batches_done % self._cfg.snapshot_every == 0
+        ):
+            jax.block_until_ready(self._state)
+            self._save_snapshot()
+
+    def _upload(self, payload: Any, mask: np.ndarray) -> Tuple[Any, Any]:
+        """Host → device transfer with the step program's expected shardings."""
+        if self._cfg.mesh is None:
+            # uncommitted numpy feeds the executable directly (default device)
+            return payload, mask
+        batch_sh = self._batch_sharding()
+        rep_sh = self._replicated_sharding()
+        n_rows = mask.shape[0]
+        payload = jax.tree.map(
+            lambda x: jax.device_put(x, batch_sh if is_batch_leaf(x, n_rows) else rep_sh)
+            if isinstance(x, (np.ndarray, jnp.ndarray))
+            else x,
+            payload,
+        )
+        return payload, jax.device_put(mask, batch_sh)
+
+    def _bound_inflight(self, token: Any) -> Optional[float]:
+        """Enforce the double-buffering depth via step tokens; returns the
+        observed sync µs when the dispatcher had to block."""
+        self._inflight.append(token)
+        if self._serialize:
+            t0 = time.perf_counter()
+            jax.block_until_ready(token)
+            self._inflight.clear()
+            return (time.perf_counter() - t0) * 1e6
+        if len(self._inflight) <= max(1, self._cfg.in_flight):
+            return None
+        oldest = self._inflight.popleft()
+        t0 = time.perf_counter()
+        jax.block_until_ready(oldest)
+        return (time.perf_counter() - t0) * 1e6

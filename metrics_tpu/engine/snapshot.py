@@ -1,0 +1,144 @@
+"""Crash-safe snapshots of accumulated engine state (orbax-backed, atomic).
+
+Recovery contract: a snapshot directory always contains at least one COMPLETE
+snapshot once any save finished, no matter when the process dies. This is the
+reference's missing piece — its ``state_dict`` checkpointing
+(``torchmetrics/metric.py:514``) rides the training framework's checkpoint
+cadence; a serving engine owns its own.
+
+Layout (one directory per engine)::
+
+    <dir>/snap_000000000042_<ns>/   # orbax PyTreeCheckpointer dir (or .pkl);
+    <dir>/snap_000000000084_<ns>/   # <ns> = creation time in ns, so a reset/
+    <dir>/LATEST                    # restarted engine replaying the same step
+                                    # numbers never rewrites an existing dir
+
+Atomicity: the snapshot payload is written first, then ``LATEST`` is replaced
+via write-to-temp + ``os.replace`` (atomic on POSIX). A kill mid-payload-write
+leaves a garbage ``snap_*`` that ``LATEST`` never points to; a kill mid-pointer
+leaves the previous pointer. ``load_snapshot`` only ever follows ``LATEST``.
+Older snapshots beyond ``keep`` are garbage-collected after the pointer moves.
+
+The payload rides the same orbax machinery as ``utils/checkpoint.py`` (numpy-
+ified state pytree; pickle fallback when orbax is absent), plus a ``meta``
+subtree carrying the step counter and row counts the engine needs to resume.
+"""
+import os
+import pickle
+import shutil
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from metrics_tpu.utils.imports import _ORBAX_AVAILABLE
+
+__all__ = ["save_snapshot", "load_snapshot", "latest_snapshot"]
+
+_LATEST = "LATEST"
+
+
+def _to_numpy_tree(state: Any) -> Any:
+    return jax.tree.map(lambda x: np.asarray(x) if isinstance(x, jax.Array) else x, state)
+
+
+def _to_jax_tree(state: Any) -> Any:
+    import jax.numpy as jnp
+
+    return jax.tree.map(lambda x: jnp.asarray(x) if isinstance(x, np.ndarray) else x, state)
+
+
+def save_snapshot(
+    directory: str, state: Any, meta: Dict[str, Any], keep: int = 2
+) -> str:
+    """Write one complete snapshot and atomically advance ``LATEST``.
+
+    ``state`` is the engine's accumulated metric-state pytree (device or host
+    arrays); ``meta`` is a flat dict of ints/floats/strings (the step counter
+    and friends). Returns the snapshot's path. Keeps the newest ``keep``
+    snapshots, GCs the rest.
+    """
+    os.makedirs(directory, exist_ok=True)
+    step = int(meta.get("step", 0))
+    # the name must be UNIQUE, not just step-keyed: after reset()/a restart
+    # replaying from batch 0, the same step comes around again — reusing the
+    # name would delete-and-rewrite the very directory LATEST points to, and
+    # a kill mid-rewrite would break the "LATEST always targets a COMPLETE
+    # snapshot" guarantee. The nanosecond suffix keeps names fresh while
+    # preserving step-order under the lexicographic sort GC relies on.
+    name = f"snap_{step:012d}_{time.time_ns():016x}"
+    payload = {
+        "state": _to_numpy_tree(state),
+        "meta": {k: np.asarray(v) if isinstance(v, (int, float)) else v for k, v in meta.items()},
+    }
+    path = os.path.join(directory, name)
+    if _ORBAX_AVAILABLE:
+        import orbax.checkpoint as ocp
+
+        with ocp.PyTreeCheckpointer() as ckptr:
+            ckptr.save(os.path.abspath(path), payload, force=True)
+    else:  # pragma: no cover - orbax is baked into this container
+        with open(path, "wb") as f:
+            pickle.dump(payload, f)
+    # the payload is durable; only now may the pointer move (atomic replace)
+    tmp = os.path.join(directory, _LATEST + ".tmp")
+    with open(tmp, "w") as f:
+        f.write(name)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(directory, _LATEST))
+    _gc(directory, keep)
+    return path
+
+
+def _gc(directory: str, keep: int) -> None:
+    latest = latest_snapshot(directory)
+    # "newest" means CREATION order (the ns suffix), NOT step order: after a
+    # reset()/replay the step counter goes backwards, and sorting by the
+    # step-prefixed name would protect stale pre-reset snapshots forever
+    # while GC-ing the fresh ones down to LATEST's target alone
+    snaps = sorted(
+        (n for n in os.listdir(directory) if n.startswith("snap_")),
+        key=lambda n: n.rsplit("_", 1)[-1],
+    )
+    for n in snaps[:-keep] if keep > 0 else []:
+        if latest is not None and os.path.join(directory, n) == latest:
+            continue  # never GC the pointer's target
+        full = os.path.join(directory, n)
+        shutil.rmtree(full, ignore_errors=True) if os.path.isdir(full) else os.unlink(full)
+
+
+def latest_snapshot(directory: str) -> Optional[str]:
+    """Path of the newest COMPLETE snapshot, or None."""
+    pointer = os.path.join(directory, _LATEST)
+    if not os.path.exists(pointer):
+        return None
+    with open(pointer) as f:
+        name = f.read().strip()
+    path = os.path.join(directory, name)
+    return path if os.path.exists(path) else None
+
+
+def load_snapshot(directory_or_path: str) -> Tuple[Any, Dict[str, Any]]:
+    """Load ``(state, meta)`` from a snapshot dir (follows ``LATEST``) or an
+    explicit snapshot path. Raises ``FileNotFoundError`` when none exists."""
+    path = directory_or_path
+    if os.path.isdir(path) and not os.path.basename(path).startswith("snap_"):
+        latest = latest_snapshot(path)
+        if latest is None:
+            raise FileNotFoundError(f"no complete snapshot under {path}")
+        path = latest
+    if _ORBAX_AVAILABLE and os.path.isdir(path):
+        import orbax.checkpoint as ocp
+
+        with ocp.PyTreeCheckpointer() as ckptr:
+            payload = ckptr.restore(os.path.abspath(path))
+    else:
+        with open(path, "rb") as f:
+            payload = pickle.load(f)
+    meta = {
+        k: (int(v) if isinstance(v, np.ndarray) and v.dtype.kind in "iu" else v)
+        for k, v in payload["meta"].items()
+    }
+    return _to_jax_tree(payload["state"]), meta

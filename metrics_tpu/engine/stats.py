@@ -1,0 +1,116 @@
+"""Ring-buffer telemetry for the streaming engine.
+
+Serving observability without unbounded host memory: a fixed-capacity ring of
+per-step records plus monotonic counters. Exported as one JSON document
+(``tools/engine_report.py`` pretty-prints it; the bench's
+``engine_steady_state`` entry embeds the summary). Records deliberately carry
+HOST-side observables only — queue depth at dispatch, padding waste, ingest
+time, and the sync latency of the steps that actually blocked (double
+buffering means most steps don't) — because device-side step time on a
+timeshared virtual mesh is host noise, not signal (docs/benchmarking.md,
+"the four hazards").
+"""
+import json
+import math
+from typing import Any, Dict, List, Optional
+
+from metrics_tpu.engine.bucketing import BucketPolicy
+
+__all__ = ["EngineStats"]
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return float("nan")
+    k = (len(sorted_vals) - 1) * q
+    lo, hi = math.floor(k), math.ceil(k)
+    if lo == hi:
+        return sorted_vals[lo]
+    return sorted_vals[lo] * (hi - k) + sorted_vals[hi] * (k - lo)
+
+
+class EngineStats:
+    """Fixed-capacity per-step telemetry ring + lifetime counters."""
+
+    def __init__(self, capacity: int = 1024):
+        if capacity <= 0:
+            raise ValueError(f"telemetry capacity must be positive, got {capacity}")
+        self.capacity = int(capacity)
+        self._ring: List[Optional[Dict[str, Any]]] = [None] * self.capacity
+        self.steps = 0
+        self.batches_submitted = 0
+        self.rows_in = 0
+        self.rows_padded = 0
+        self.snapshots = 0
+        self.resumes = 0
+
+    def record_step(
+        self,
+        *,
+        bucket: int,
+        valid: int,
+        queue_depth: int,
+        ingest_us: float,
+        sync_us: Optional[float] = None,
+    ) -> None:
+        rec = {
+            "step": self.steps,
+            "bucket": bucket,
+            "valid": valid,
+            "queue_depth": queue_depth,
+            "ingest_us": round(ingest_us, 1),
+        }
+        if sync_us is not None:
+            rec["sync_us"] = round(sync_us, 1)
+        self._ring[self.steps % self.capacity] = rec
+        self.steps += 1
+        self.rows_in += valid
+        self.rows_padded += bucket
+
+    def recent(self) -> List[Dict[str, Any]]:
+        """Ring contents, oldest first."""
+        n = min(self.steps, self.capacity)
+        start = self.steps % self.capacity if self.steps > self.capacity else 0
+        out = []
+        for i in range(n):
+            rec = self._ring[(start + i) % self.capacity]
+            if rec is not None:
+                out.append(rec)
+        return out
+
+    def summary(self, aot_stats: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        recent = self.recent()
+        ingest = sorted(r["ingest_us"] for r in recent)
+        syncs = sorted(r["sync_us"] for r in recent if "sync_us" in r)
+        depths = [r["queue_depth"] for r in recent]
+        out: Dict[str, Any] = {
+            "steps": self.steps,
+            "batches_submitted": self.batches_submitted,
+            "rows_in": self.rows_in,
+            "rows_padded": self.rows_padded,
+            "padding_waste_fraction": round(
+                BucketPolicy.waste_fraction(self.rows_in, self.rows_padded), 4
+            ),
+            "snapshots": self.snapshots,
+            "resumes": self.resumes,
+            "queue_depth_max": max(depths) if depths else 0,
+            "ingest_us": {
+                "p50": round(_percentile(ingest, 0.5), 1) if ingest else None,
+                "p95": round(_percentile(ingest, 0.95), 1) if ingest else None,
+            },
+            "blocked_sync_us": {
+                "count": len(syncs),
+                "p50": round(_percentile(syncs, 0.5), 1) if syncs else None,
+                "p95": round(_percentile(syncs, 0.95), 1) if syncs else None,
+            },
+        }
+        if aot_stats is not None:
+            out["compile_cache"] = aot_stats
+        return out
+
+    def to_json(self, aot_stats: Optional[Dict[str, Any]] = None) -> str:
+        return json.dumps({"summary": self.summary(aot_stats), "recent_steps": self.recent()}, indent=2)
+
+    def export(self, path: str, aot_stats: Optional[Dict[str, Any]] = None) -> None:
+        with open(path, "w") as f:
+            f.write(self.to_json(aot_stats))

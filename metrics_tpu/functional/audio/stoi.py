@@ -14,7 +14,8 @@ runs jitted/vmapped on TPU and needs no host round-trips:
   argsort-gather and overlap-added into a fixed-size buffer, with the kept
   count carried as data;
 * 256-sample hann frames / 512-pt rFFT / 15 one-third-octave bands (150 Hz
-  lowest center);
+  lowest center), framed with pystoi's EXCLUSIVE convention
+  (``range(0, len - N, hop)`` — see ``_frame``);
 * 30-frame sliding segments; standard mode clips the normalized degraded
   segment at -15 dB SDR and averages band correlations, extended mode (ESTOI,
   Jensen & Taal 2016) row+column-normalizes each segment.
@@ -151,8 +152,18 @@ def _hann_window() -> np.ndarray:
 
 
 def _frame(x: Array) -> Array:
-    """(frames, N_FRAME) strided view at HOP."""
-    n_frames = (x.shape[-1] - N_FRAME) // HOP + 1
+    """(frames, N_FRAME) strided view at HOP — pystoi's EXCLUSIVE convention.
+
+    pystoi/MATLAB frame with ``range(0, len - N, hop)`` (``pystoi/utils.py``
+    stft and remove_silent_frames): ``ceil((len - N) / hop)`` frames, which
+    DROPS the final frame whenever ``(len - N) % hop == 0`` (always true for
+    the post-silence-removal OLA buffer, whose length is an exact hop
+    multiple). The seed used the inclusive ``(len - N) // hop + 1`` count —
+    measured up to ~1.3e-2 score difference vs pystoi on the test corpus
+    (ADVICE r5 medium #2); this build adopts the upstream convention so the
+    gated pystoi parity test compares like for like.
+    """
+    n_frames = max(0, -(-(x.shape[-1] - N_FRAME) // HOP))
     offs = jnp.arange(n_frames)[:, None] * HOP + jnp.arange(N_FRAME)[None, :]
     return x[offs]
 
@@ -161,9 +172,10 @@ def _stoi_single(deg: Array, clean: Array, fs: int, extended: bool) -> Array:
     """STOI of one (degraded, clean) pair, fully in-trace, static shapes."""
     deg = _resample(deg, fs, FS)
     clean = _resample(clean, fs, FS)
-    if clean.shape[-1] < N_FRAME:
+    if clean.shape[-1] <= N_FRAME:
         raise ValueError(
-            f"STOI needs at least {N_FRAME} samples at {FS} Hz after resampling; "
+            f"STOI needs more than {N_FRAME} samples at {FS} Hz after resampling "
+            f"(pystoi's exclusive framing yields zero frames otherwise); "
             f"got {clean.shape[-1]} (input rate {fs} Hz)."
         )
     w = jnp.asarray(_hann_window())
@@ -196,7 +208,11 @@ def _stoi_single(deg: Array, clean: Array, fs: int, extended: bool) -> Array:
     y_tob = jnp.sqrt(jnp.matmul(jnp.abs(spec_d) ** 2, obm.T, precision=_hi))  # degraded (F, 15)
 
     # ---- 30-frame sliding segments ------------------------------------------
-    n_seg = n_f - N_SEG + 1
+    # exclusive framing of the OLA buffer gives n_f - 1 spectral frames; of
+    # those, only the first n_kept - 1 come from kept audio (pystoi's stft of
+    # the exact-length reconstructed signal has n_kept - 1 frames)
+    n_spec = x_tob.shape[0]
+    n_seg = n_spec - N_SEG + 1
     if n_seg < 1:
         return jnp.float32(1e-5)
     seg_ix = jnp.arange(n_seg)[:, None] + jnp.arange(N_SEG)[None, :]
@@ -204,7 +220,7 @@ def _stoi_single(deg: Array, clean: Array, fs: int, extended: bool) -> Array:
     y_seg = jnp.transpose(y_tob[seg_ix], (0, 2, 1))
     # frames past the compacted signal are synthetic zeros: a segment is real
     # only when all its N_SEG frames come from kept audio
-    seg_ok = (jnp.arange(n_seg) + N_SEG) <= n_kept
+    seg_ok = (jnp.arange(n_seg) + N_SEG) <= n_kept - 1
     n_valid = jnp.sum(seg_ok.astype(jnp.float32))
 
     if extended:

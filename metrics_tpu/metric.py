@@ -48,7 +48,7 @@ from metrics_tpu.parallel.collectives import (
 )
 from metrics_tpu.parallel.mesh import current_metric_axis
 from metrics_tpu.utils.checks import deferred_message, deferred_value_checks
-from metrics_tpu.utils.data import apply_to_collection, dim_zero_cat
+from metrics_tpu.utils.data import apply_to_collection, dim_zero_cat, is_batch_leaf
 from metrics_tpu.utils.exceptions import MetricsTPUUserError
 from metrics_tpu.utils.prints import rank_zero_warn
 
@@ -333,6 +333,116 @@ class Metric:
         finally:
             self._load_state(saved)
             self._restore_bookkeeping(book)
+
+    def abstract_state(self) -> Dict[str, Any]:
+        """``ShapeDtypeStruct`` pytree mirroring :meth:`init_state` — the lowering
+        template for external AOT compilation (``metrics_tpu.engine``). No device
+        buffers are materialised."""
+        return jax.eval_shape(self.init_state)
+
+    _MASKED_FX = ("sum", "min", "max")
+
+    def masked_update_unsupported_reason(self) -> Optional[str]:
+        """None when :meth:`update_state_masked`'s generic path applies, else a
+        human-readable reason (list/cat states grow with data, custom reductions
+        have no row-neutral element). A subclass that overrides
+        :meth:`update_state_masked` has taken responsibility for masking and is
+        always supported."""
+        if type(self).update_state_masked is not Metric.update_state_masked:
+            return None
+        if self.full_state_update:
+            return "full_state_update metrics read the accumulated state in update; row deltas are not exact"
+        for k, v in self._defaults.items():
+            if isinstance(v, list):
+                return f"state {k!r} is a list (cat/gather) state"
+            if self._reductions[k] not in self._MASKED_FX:
+                return f"state {k!r} has dist_reduce_fx={self._reductions[k]!r}"
+        for name, child in self._child_metrics().items():
+            children = child if isinstance(child, list) else [child]
+            for c in children:
+                r = c.masked_update_unsupported_reason()
+                if r is not None:
+                    return f"nested metric {name!r}: {r}"
+        return None
+
+    def update_state_masked(self, state: Dict[str, Any], *args: Any, mask: Array, **kwargs: Any) -> Dict[str, Any]:
+        """Pure mask-aware update: rows of the leading batch axis where ``mask``
+        is False contribute NOTHING to the new state.
+
+        This is the padding contract of the streaming engine
+        (``metrics_tpu.engine``): batches are padded to a closed set of bucket
+        shapes so the compiled-program set is finite, and the pad rows must be
+        inert. The generic path runs the subclass ``update`` per row (a vmapped
+        batch-of-1 update — exact for every delta-mergeable metric, since
+        per-row deltas are the finest batch partition) and reduces the stacked
+        row deltas with each state's own reduction, substituting that
+        reduction's identity for masked-out rows. Every array leaf of
+        ``args``/``kwargs`` whose leading dimension equals ``mask.shape[0]`` is
+        treated as batch-carried; everything else broadcasts.
+
+        Subclasses with a cheaper fused masked form (e.g. embedded-model
+        metrics where per-row state copies would be prohibitive) override this.
+        """
+        reason = self.masked_update_unsupported_reason()
+        if reason is not None:
+            raise MetricsTPUUserError(
+                f"{type(self).__name__} has no mask-aware update: {reason}. "
+                "Override `update_state_masked` or stream it eagerly (unbucketed)."
+            )
+        mask = jnp.asarray(mask, bool)
+        n_rows = mask.shape[0]
+        leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+        batched: List[Any] = []
+        in_axes: List[Optional[int]] = []
+        for leaf in leaves:
+            if is_batch_leaf(leaf, n_rows):
+                # keep the original rank per row: each row is a batch of 1, so
+                # the subclass update sees exactly the shapes it validates
+                batched.append(jnp.reshape(jnp.asarray(leaf), (n_rows, 1) + leaf.shape[1:]))
+                in_axes.append(0)
+            else:
+                batched.append(leaf)
+                in_axes.append(None)
+
+        def per_row(*row_leaves: Any) -> Dict[str, Any]:
+            a, kw = jax.tree_util.tree_unflatten(treedef, list(row_leaves))
+            return self.update_state(self.init_state(), *a, **kw)
+
+        stacked = jax.vmap(per_row, in_axes=tuple(in_axes))(*batched)
+        return self._masked_reduce_into(state, stacked, mask)
+
+    def _masked_reduce_into(self, state: Dict[str, Any], stacked: Dict[str, Any], mask: Array) -> Dict[str, Any]:
+        """Fold row-stacked deltas (leading axis = rows) into ``state``, skipping
+        masked-out rows via each reduction's identity element."""
+        out: Dict[str, Any] = {}
+        if self._CHILD_KEY in stacked:
+            children = self._child_metrics()
+            out[self._CHILD_KEY] = {}
+            for name, child_stacked in stacked[self._CHILD_KEY].items():
+                child = children.get(name)
+                child_state = state.get(self._CHILD_KEY, {}).get(name)
+                if isinstance(child, list):
+                    out[self._CHILD_KEY][name] = [
+                        c._masked_reduce_into(cs, cd, mask)
+                        for c, cs, cd in zip(child, child_state, child_stacked)
+                    ]
+                else:
+                    out[self._CHILD_KEY][name] = child._masked_reduce_into(child_state, child_stacked, mask)
+        for k in self._defaults:
+            fx = self._reductions[k]
+            s = stacked[k]
+            m = jnp.reshape(mask, (mask.shape[0],) + (1,) * (s.ndim - 1))
+            if fx == "sum":
+                out[k] = state[k] + jnp.sum(jnp.where(m, s, jnp.zeros_like(s)), axis=0)
+            elif fx == "min":
+                ident = _reduce_identity(s.dtype, "min")
+                out[k] = jnp.minimum(state[k], jnp.min(jnp.where(m, s, ident), axis=0))
+            elif fx == "max":
+                ident = _reduce_identity(s.dtype, "max")
+                out[k] = jnp.maximum(state[k], jnp.max(jnp.where(m, s, ident), axis=0))
+            else:  # pragma: no cover - guarded by masked_update_unsupported_reason
+                raise MetricsTPUUserError(f"no masked reduction for dist_reduce_fx={fx!r}")
+        return out
 
     def compute_from(self, state: Dict[str, Any]) -> Any:
         """Pure compute on an explicit (already-merged) state pytree."""
@@ -730,7 +840,11 @@ class Metric:
         # this is material HBM (FID's float-float covariance state is 4 full
         # feature_dim^2 f32 buffers, ~67 MB at 2048). init_state() already
         # copies default leaves precisely so donated states never alias
-        # (metric.py:240-242). CPU doesn't implement donation and would warn on
+        # (metric.py:240-242). Consequence on accelerators: an EXTERNAL
+        # reference to a state array taken between forwards (e.g. holding
+        # `m.total` and calling forward again) reads as deleted — snapshot
+        # with np.asarray/state_dict() instead of borrowing live attributes.
+        # CPU doesn't implement donation and would warn on
         # every compile, so the hint is only attached on accelerators.
         donate = (0,) if jax.default_backend() != "cpu" else ()
         return jax.jit(step, donate_argnums=donate)
@@ -1021,6 +1135,14 @@ class Metric:
     def __pos__(self): return CompositionalMetric(jnp.abs, self, None)
     def __invert__(self): return CompositionalMetric(jnp.logical_not, self, None)
     def __getitem__(self, idx): return CompositionalMetric(lambda x: x[idx], self, None)
+
+
+def _reduce_identity(dtype: Any, fx: str) -> Any:
+    """The identity element of min/max over ``dtype`` (masked rows reduce to it)."""
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.asarray(jnp.inf if fx == "min" else -jnp.inf, dtype)
+    info = jnp.iinfo(dtype)
+    return jnp.asarray(info.max if fx == "min" else info.min, dtype)
 
 
 def _neg(x: Array) -> Array:

@@ -393,8 +393,6 @@ def single_program_calibration(
         mm = jax.lax.fori_loop(0, k_mm, mbody, a_)
         return acc + mm[0, 0].astype(jnp.float32)
 
-    zero = jnp.int32(0)
-
     def run(k_work: int, k_mm: int) -> float:
         return float(prog(operands, a, b, jnp.int32(k_work), jnp.int32(k_mm)))
 

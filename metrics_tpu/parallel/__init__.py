@@ -19,6 +19,7 @@ from metrics_tpu.parallel.collectives import (
 from metrics_tpu.parallel.embedded import (
     data_parallel_mesh,
     shard_batch_forward,
+    sharded_masked_step,
 )
 from metrics_tpu.parallel.mesh import (
     MeshConfig,
@@ -41,5 +42,6 @@ __all__ = [
     "reduce",
     "set_metric_axis",
     "shard_batch_forward",
+    "sharded_masked_step",
     "sync_axis_state",
 ]

@@ -150,3 +150,55 @@ def data_parallel_mesh(axis: str = "dp") -> Mesh:
     import numpy as np
 
     return Mesh(np.asarray(jax.devices()), (axis,))
+
+
+def sharded_masked_step(
+    metric,
+    mesh: Mesh,
+    axis: AxisName,
+    payload_abs,
+    mask_abs,
+) -> Callable:
+    """Build the mesh-aware streaming-engine step for one bucket signature.
+
+    Returns a ``shard_map``-wrapped pure function
+    ``(state, payload, mask) -> (new_state, token)`` where ``payload`` is the
+    ``(args, kwargs)`` pytree of one PADDED bucket batch:
+
+    * batch-carried leaves (leading dim == ``mask_abs.shape[0]``) and the mask
+      shard over ``axis``; config scalars and the state replicate;
+    * each device computes its shard's masked delta
+      (``Metric.update_state_masked``), the deltas psum/pmin/pmax-merge
+      in-step (``sync_states``), and the replicated GLOBAL state comes back —
+      so a snapshot between any two steps is globally consistent and compute
+      needs no further sync;
+    * ``token`` is the global valid-row count — a tiny non-donated output the
+      dispatcher blocks on (the state itself is donated into the next step).
+
+    The caller (``engine/pipeline.py``) jits, lowers and AOT-compiles this
+    once per (bucket, mesh, dtype) — the serving-side closed-program contract.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from metrics_tpu.utils.data import is_batch_leaf
+
+    n_rows = mask_abs.shape[0]
+    payload_specs = jax.tree.map(
+        lambda s: P(axis) if is_batch_leaf(s, n_rows) else P(),
+        payload_abs,
+    )
+    state_specs = jax.tree.map(lambda _: P(), metric.abstract_state())
+    axis_tuple = tuple(axis) if isinstance(axis, (tuple, list)) else (axis,)
+
+    def body(state, payload, mask):
+        a, kw = payload
+        delta = metric.update_state_masked(metric.init_state(), *a, mask=mask, **kw)
+        delta = metric.sync_states(delta, axis)  # psum/pmin/pmax the shard deltas
+        token = jax.lax.psum(jnp.sum(mask.astype(jnp.int32)), axis_tuple)
+        return metric.merge_states(state, delta), token
+
+    return jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(state_specs, payload_specs, P(axis)),
+        out_specs=(state_specs, P()), check_vma=False,
+    )

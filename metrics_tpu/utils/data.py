@@ -18,6 +18,32 @@ METRIC_EPS = 1e-6
 Array = jax.Array
 
 
+def is_batch_leaf(leaf: Any, n_rows: int) -> bool:
+    """True when ``leaf`` carries the batch on its leading axis.
+
+    THE single predicate behind the streaming engine's padding contract
+    (``Metric.update_state_masked``, ``engine/bucketing.py``,
+    ``engine/pipeline.py``, ``parallel/embedded.py`` all share it): anything
+    array-shaped — numpy, jax arrays/tracers, ``ShapeDtypeStruct`` lowering
+    templates — whose leading dimension equals the batch/mask length is
+    batch-carried; everything else broadcasts. One definition, so the
+    classification cannot drift between pad, upload, spec and update time.
+    """
+    shape = getattr(leaf, "shape", None)
+    return shape is not None and len(shape) >= 1 and shape[0] == n_rows
+
+
+def infer_batch_size(tree: Any) -> "int | None":
+    """Leading dimension of the FIRST array-shaped leaf of ``tree`` — the
+    companion of :func:`is_batch_leaf`: the batch size every other leaf is
+    classified against. None when no leaf has a leading axis."""
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shape = getattr(leaf, "shape", None)
+        if shape is not None and len(shape) >= 1:
+            return int(shape[0])
+    return None
+
+
 def dim_zero_cat(x: Union[Array, List[Array]]) -> Array:
     """Concatenate a (possibly list of) array(s) along dim 0."""
     if isinstance(x, (list, tuple)):

@@ -38,8 +38,11 @@ def _host_third_octave():
 
 
 def _host_frames(x):
-    n = (len(x) - 256) // 128 + 1
-    return np.stack([x[i * 128:i * 128 + 256] for i in range(n)])
+    # pystoi's exclusive convention: range(0, len - N, hop) — the final frame
+    # is dropped when (len - N) % hop == 0 (matches pystoi/utils.py stft and
+    # remove_silent_frames; the library adopted the same convention, see
+    # functional/audio/stoi.py::_frame)
+    return np.stack([x[i:i + 256] for i in range(0, len(x) - 256, 128)])
 
 
 def host_stoi(deg, clean, fs, extended=False):
@@ -56,14 +59,16 @@ def host_stoi(deg, clean, fs, extended=False):
     eng = 20 * np.log10(np.linalg.norm(cf, axis=1) + _EPS)
     mask = eng > eng.max() - 40.0
     cf, df = cf[mask], df[mask]
-    if cf.shape[0] < 30:
-        return 1e-5
     n_buf = (cf.shape[0] - 1) * 128 + 256
     cs, ds = np.zeros(n_buf), np.zeros(n_buf)
     for i in range(cf.shape[0]):
         cs[i * 128:i * 128 + 256] += cf[i]
         ds[i * 128:i * 128 + 256] += df[i]
     obm = _host_third_octave()
+    # exclusive framing of the exact-length OLA buffer: cf.shape[0] - 1
+    # spectral frames (pystoi's too-short contract checks THIS count)
+    if cf.shape[0] - 1 < 30:
+        return 1e-5
     X = np.sqrt(np.abs(np.fft.rfft(_host_frames(cs) * w, 512)) ** 2 @ obm.T)
     Y = np.sqrt(np.abs(np.fft.rfft(_host_frames(ds) * w, 512)) ** 2 @ obm.T)
     vals = []

@@ -1,0 +1,210 @@
+"""Top-3 ported reference parametrization gaps (docs/test_matrix.md, r6).
+
+1. mdmc ``samplewise`` corner cases (reference ``test_stat_scores.py`` /
+   ``test_accuracy.py``): samplewise must equal a per-sample loop of the
+   (already parity-tested) global path, including ignore_index corners.
+2. ``ignore_index`` x ``average='macro'`` (reference
+   ``test_precision_recall.py``): ignored class absent from the macro mean;
+   predictions INTO the ignored class still cost the true class its recall.
+3. Curve edge inputs (reference ``inputs.py``-style degenerate cases): tied
+   scores, perfect separation, single sample, single-class targets through
+   ``roc``/``precision_recall_curve``.
+"""
+import numpy as np
+import pytest
+from sklearn.metrics import (
+    precision_recall_curve as sk_precision_recall_curve,
+    precision_recall_fscore_support as sk_prfs,
+    roc_curve as sk_roc_curve,
+)
+
+import jax.numpy as jnp
+
+from metrics_tpu.functional import (
+    accuracy,
+    f1,
+    precision,
+    precision_recall_curve,
+    recall,
+    roc,
+    stat_scores,
+)
+
+NUM_CLASSES = 4
+
+
+# ------------------------------------------- 1. mdmc samplewise corner cases
+
+def _mdmc_inputs(seed=0, n=8, c=NUM_CLASSES, extra=6):
+    rng = np.random.RandomState(seed)
+    preds = rng.rand(n, c, extra).astype(np.float32)
+    preds = preds / preds.sum(axis=1, keepdims=True)
+    target = rng.randint(0, c, size=(n, extra))
+    return jnp.asarray(preds), jnp.asarray(target)
+
+
+@pytest.mark.parametrize("ignore_index", [None, 1])
+def test_stat_scores_samplewise_equals_per_sample_global(ignore_index):
+    preds, target = _mdmc_inputs()
+    got = stat_scores(
+        preds, target, reduce="micro", mdmc_reduce="samplewise",
+        num_classes=NUM_CLASSES, ignore_index=ignore_index,
+    )
+    rows = [
+        stat_scores(
+            preds[i : i + 1], target[i : i + 1], reduce="micro", mdmc_reduce="global",
+            num_classes=NUM_CLASSES, ignore_index=ignore_index,
+        )
+        for i in range(preds.shape[0])
+    ]
+    np.testing.assert_array_equal(np.asarray(got), np.stack([np.asarray(r) for r in rows]))
+
+
+def test_accuracy_samplewise_is_mean_of_per_sample_accuracy():
+    preds, target = _mdmc_inputs(seed=3)
+    got = float(
+        accuracy(preds, target, mdmc_average="samplewise", num_classes=NUM_CLASSES)
+    )
+    per_sample = [
+        float(
+            accuracy(
+                preds[i : i + 1], target[i : i + 1], mdmc_average="global",
+                num_classes=NUM_CLASSES,
+            )
+        )
+        for i in range(preds.shape[0])
+    ]
+    assert got == pytest.approx(float(np.mean(per_sample)), abs=1e-6)
+
+
+def test_samplewise_with_fully_ignored_sample_stays_finite():
+    """A sample whose every position carries ignore_index has zero support;
+    the samplewise reduction must not poison the batch with NaN."""
+    preds, target = _mdmc_inputs(seed=5)
+    target = np.array(target)  # writable host copy
+    target[0, :] = 2  # sample 0: nothing but the ignored class
+    got = float(
+        accuracy(
+            preds, jnp.asarray(target), mdmc_average="samplewise",
+            num_classes=NUM_CLASSES, ignore_index=2,
+        )
+    )
+    assert np.isfinite(got)
+    # the other samples' contribution must match the per-sample loop
+    rest = [
+        float(
+            accuracy(
+                preds[i : i + 1], jnp.asarray(target[i : i + 1]), mdmc_average="global",
+                num_classes=NUM_CLASSES, ignore_index=2,
+            )
+        )
+        for i in range(1, preds.shape[0])
+    ]
+    # sample 0 contributes score 0 with weight 1/N (reference zero-division contract)
+    assert got == pytest.approx(float(np.sum(rest)) / preds.shape[0], abs=1e-6)
+
+
+# ------------------------------------- 2. ignore_index x average="macro"
+
+def _macro_inputs(seed=11, n=200, c=NUM_CLASSES):
+    rng = np.random.RandomState(seed)
+    probs = rng.rand(n, c).astype(np.float32)
+    probs = probs / probs.sum(axis=1, keepdims=True)
+    target = rng.randint(0, c, size=n)
+    return probs, target
+
+
+@pytest.mark.parametrize(
+    "fn,sk_index", [(precision, 0), (recall, 1), (f1, 2)],
+    ids=["precision", "recall", "f1"],
+)
+def test_macro_with_ignore_index_matches_filtered_sklearn(fn, sk_index):
+    probs, target = _macro_inputs()
+    ignore = 0
+    got = float(
+        fn(
+            jnp.asarray(probs), jnp.asarray(target), average="macro",
+            num_classes=NUM_CLASSES, ignore_index=ignore,
+        )
+    )
+    # oracle: the reference's ignore_index deletes the class COLUMN, not the
+    # samples — sklearn over ALL samples with labels=[1..C-1]: ignored-target
+    # samples still inflict false positives on the classes they're predicted
+    # as, and predictions INTO the ignored class still cost the true class
+    # its recall (this is what distinguishes it from sample-filtering)
+    sk = sk_prfs(
+        target, probs.argmax(axis=1),
+        labels=list(range(1, NUM_CLASSES)), average="macro", zero_division=0,
+    )[sk_index]
+    assert got == pytest.approx(float(sk), abs=1e-6)
+
+
+def test_macro_ignore_index_differs_from_unfiltered_macro():
+    """The interaction must actually bite: ignoring a class changes the mean."""
+    probs, target = _macro_inputs(seed=13)
+    with_ignore = float(
+        precision(jnp.asarray(probs), jnp.asarray(target), average="macro",
+                  num_classes=NUM_CLASSES, ignore_index=0)
+    )
+    without = float(
+        precision(jnp.asarray(probs), jnp.asarray(target), average="macro",
+                  num_classes=NUM_CLASSES)
+    )
+    assert with_ignore != pytest.approx(without, abs=1e-9)
+
+
+# ----------------------------------------------- 3. curve edge inputs
+
+def _assert_curve_matches_sklearn(preds, target):
+    p, r, t = precision_recall_curve(jnp.asarray(preds), jnp.asarray(target))
+    sk_p, sk_r, sk_t = sk_precision_recall_curve(target, preds)
+    np.testing.assert_allclose(np.asarray(p), sk_p, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(r), sk_r, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(t), sk_t, atol=1e-6)
+    fpr, tpr, thr = roc(jnp.asarray(preds), jnp.asarray(target))
+    sk_fpr, sk_tpr, _ = sk_roc_curve(target, preds, drop_intermediate=False)
+    np.testing.assert_allclose(np.asarray(fpr), sk_fpr, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(tpr), sk_tpr, atol=1e-6)
+
+
+def test_curves_with_tied_scores_match_sklearn():
+    preds = np.asarray([0.5, 0.5, 0.5, 0.8, 0.8, 0.1, 0.1], np.float32)
+    target = np.asarray([1, 0, 1, 1, 0, 0, 1])
+    _assert_curve_matches_sklearn(preds, target)
+
+
+def test_curves_perfectly_separable_follow_reference_convention():
+    """Perfect separation splits the conventions: the reference trims the PR
+    curve at the first threshold reaching full recall and appends the (1, 0)
+    endpoint (``precision_recall_curve.py`` v0.7 ``last_ind``/flip), while
+    this sklearn build keeps the whole tail. Pin the REFERENCE shape; ROC has
+    no trimming and must still match sklearn."""
+    preds = np.asarray([0.9, 0.8, 0.7, 0.3, 0.2, 0.1], np.float32)
+    target = np.asarray([1, 1, 1, 0, 0, 0])
+    p, r, t = precision_recall_curve(jnp.asarray(preds), jnp.asarray(target))
+    np.testing.assert_allclose(np.asarray(p), [1, 1, 1, 1], atol=1e-6)
+    np.testing.assert_allclose(np.asarray(r), [1, 2 / 3, 1 / 3, 0], atol=1e-6)
+    np.testing.assert_allclose(np.asarray(t), [0.7, 0.8, 0.9], atol=1e-6)
+    fpr, tpr, _ = roc(jnp.asarray(preds), jnp.asarray(target))
+    sk_fpr, sk_tpr, _ = sk_roc_curve(target, preds, drop_intermediate=False)
+    np.testing.assert_allclose(np.asarray(fpr), sk_fpr, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(tpr), sk_tpr, atol=1e-6)
+
+
+def test_single_sample_curves_are_finite_and_shaped():
+    p, r, t = precision_recall_curve(jnp.asarray([0.7], dtype=jnp.float32), jnp.asarray([1]))
+    assert np.asarray(p).shape[0] == np.asarray(r).shape[0] == np.asarray(t).shape[0] + 1
+    assert np.all(np.isfinite(np.asarray(p))) and np.all(np.isfinite(np.asarray(r)))
+    assert float(np.asarray(r)[0]) == 1.0 and float(np.asarray(r)[-1]) == 0.0
+
+
+@pytest.mark.parametrize("label", [0, 1], ids=["all_negative", "all_positive"])
+def test_single_class_targets_do_not_nan_the_pr_curve(label):
+    """sklearn warns and emits NaN/0-division here; the trace-safe curves must
+    stay finite with the documented endpoint conventions."""
+    preds = np.asarray([0.2, 0.6, 0.9], np.float32)
+    target = np.full((3,), label)
+    p, r, t = precision_recall_curve(jnp.asarray(preds), jnp.asarray(target))
+    assert np.all(np.isfinite(np.asarray(p)))
+    if label == 1:  # recall well-defined: monotone 1 -> 0
+        assert float(np.asarray(r)[0]) == 1.0 and float(np.asarray(r)[-1]) == 0.0

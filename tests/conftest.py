@@ -46,6 +46,7 @@ def devices():
 _MESH_NODEID_HINTS = (
     "tests/parallel/",              # collectives/sum-rider/sharded-embedded suites
     "[sharded_embedded_models.py",  # integration example script under shard_map
+    "[streaming_engine.py",         # engine example: 8-device sharded steps
     "[distributed",                 # docs distributed code blocks
 )
 

@@ -1,0 +1,101 @@
+"""BucketPolicy: rounding, chunking, padding, mask correctness."""
+import numpy as np
+import pytest
+
+from metrics_tpu.engine import BucketPolicy
+
+
+def test_buckets_sorted_deduped():
+    p = BucketPolicy([64, 16, 64, 32])
+    assert p.buckets == (16, 32, 64)
+
+
+@pytest.mark.parametrize("bad", [[], [0], [-4], [16, 0]])
+def test_invalid_buckets_raise(bad):
+    with pytest.raises(ValueError):
+        BucketPolicy(bad)
+
+
+def test_divisor_enforced():
+    with pytest.raises(ValueError, match="not divisible"):
+        BucketPolicy([16, 20], divisor=8)
+    assert BucketPolicy([16, 24], divisor=8).buckets == (16, 24)
+
+
+def test_bucket_for_rounds_up():
+    p = BucketPolicy([8, 32])
+    assert p.bucket_for(1) == 8
+    assert p.bucket_for(8) == 8
+    assert p.bucket_for(9) == 32
+    assert p.bucket_for(32) == 32
+    assert p.bucket_for(33) == 32  # oversize -> top bucket (caller chunks)
+    with pytest.raises(ValueError):
+        p.bucket_for(0)
+
+
+def test_chunks_cover_every_row_once():
+    p = BucketPolicy([8, 32])
+    for n in (1, 7, 8, 9, 32, 33, 64, 100):
+        chunks = p.chunks(n)
+        rows = [r for s, e, _ in chunks for r in range(s, e)]
+        assert rows == list(range(n)), (n, chunks)
+        for s, e, b in chunks:
+            assert e - s <= b and b in p.buckets
+        # only the LAST chunk may be padded
+        for s, e, b in chunks[:-1]:
+            assert e - s == b
+
+
+def test_pad_chunk_mask_and_fill():
+    p = BucketPolicy([8], pad_value=3)
+    preds = np.arange(5, dtype=np.float32)
+    target = np.arange(5, dtype=np.int32)
+    (a, kw, mask) = p.pad_chunk((preds, target), {}, 0, 5, 8)
+    pp, tt = a
+    assert pp.shape == (8,) and tt.shape == (8,)
+    np.testing.assert_array_equal(pp[:5], preds)
+    np.testing.assert_array_equal(pp[5:], [3, 3, 3])
+    np.testing.assert_array_equal(mask, [1, 1, 1, 1, 1, 0, 0, 0])
+
+
+def test_pad_chunk_slices_middle_chunk():
+    p = BucketPolicy([4])
+    x = np.arange(10, dtype=np.float32)
+    a, _, mask = p.pad_chunk((x,), {}, 4, 8, 4)
+    np.testing.assert_array_equal(a[0], [4, 5, 6, 7])
+    assert mask.all()
+
+
+def test_pad_chunk_non_batch_leaves_pass_through():
+    p = BucketPolicy([8])
+    x = np.zeros((5, 3), np.float32)
+    w = np.ones((3,), np.float32)  # feature-shaped, not batch-carried
+    (a, kw, mask) = p.pad_chunk((x,), {"weights": w, "flag": True}, 0, 5, 8)
+    assert a[0].shape == (8, 3)
+    assert kw["weights"].shape == (3,)
+    assert kw["flag"] is True
+
+
+def test_pad_chunk_refuses_bucket_sized_broadcast_leaf():
+    p = BucketPolicy([8])
+    x = np.zeros((5,), np.float32)
+    with pytest.raises(ValueError, match="ambiguous"):
+        p.pad_chunk((x,), {"weights": np.ones((8,), np.float32)}, 0, 5, 8)
+
+
+def test_pad_chunk_refuses_per_shard_sized_broadcast_leaf():
+    """On a mesh, the shard_map body re-applies the batch predicate against
+    bucket/divisor local rows — a broadcast leaf of THAT size is just as
+    ambiguous as a bucket-sized one."""
+    p = BucketPolicy([256], divisor=8)
+    x = np.zeros((100,), np.float32)
+    with pytest.raises(ValueError, match="per-shard"):
+        p.pad_chunk((x,), {"weights": np.ones((32,), np.float32)}, 0, 100, 256)
+    # non-colliding broadcast leaves still pass through untouched
+    a, kw, _ = p.pad_chunk((x,), {"weights": np.ones((3,), np.float32)}, 0, 100, 256)
+    assert kw["weights"].shape == (3,)
+
+
+def test_waste_fraction():
+    assert BucketPolicy.waste_fraction(121, 176) == pytest.approx(1 - 121 / 176)
+    assert BucketPolicy.waste_fraction(0, 0) == 0.0

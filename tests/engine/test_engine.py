@@ -1,0 +1,212 @@
+"""Streaming engine, 1-device tier-1 path: eager parity, closed program set.
+
+The acceptance contract (ISSUE 2): streaming N ragged batches through the
+engine produces BIT-IDENTICAL ``compute()`` results to the plain eager
+``Metric`` loop, with at most ``len(buckets)`` update-program compiles on the
+first run and ZERO compiles on a warm-cache second run.
+
+Bit-identity holds by construction for integer-counter metrics; for float-sum
+states the test data is dyadic-rational (multiples of 1/64) so every squared
+error and every partial sum is exactly representable — reduction-order changes
+introduced by padding/bucketing cannot round.
+"""
+import numpy as np
+import pytest
+
+import jax
+
+from metrics_tpu import Accuracy, F1Score, MeanSquaredError, MetricCollection
+from metrics_tpu.aggregation import MaxMetric, MinMetric
+from metrics_tpu.engine import AotCache, EngineConfig, StreamingEngine
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+BUCKETS = (8, 32)
+
+
+def _dyadic(rng, n):
+    """float32 values on the 1/64 grid — exact under f32 sums at this scale."""
+    return (rng.randint(0, 65, size=n) / 64.0).astype(np.float32)
+
+
+def _ragged_batches(seed=0, sizes=(5, 17, 8, 32, 3, 70, 1)):
+    rng = np.random.RandomState(seed)
+    return [
+        (_dyadic(rng, n), (rng.rand(n) > 0.5).astype(np.int32))
+        for n in sizes
+    ]
+
+
+def _collection():
+    return MetricCollection({"acc": Accuracy(), "f1": F1Score(), "mse": MeanSquaredError()})
+
+
+def test_engine_bit_identical_to_eager_loop():
+    batches = _ragged_batches()
+    eager = _collection()
+    for p, t in batches:
+        eager.update(p, t)
+    want = {k: np.asarray(v) for k, v in eager.compute().items()}
+
+    engine = StreamingEngine(_collection(), EngineConfig(buckets=BUCKETS))
+    with engine:
+        for p, t in batches:
+            engine.submit(p, t)
+        got = {k: np.asarray(v) for k, v in engine.result().items()}
+
+    assert set(got) == set(want)
+    for k in want:
+        assert np.array_equal(got[k], want[k]), (k, got[k], want[k])
+
+
+def test_compile_budget_and_warm_cache_zero_compiles():
+    batches = _ragged_batches()
+    cache = AotCache()
+    engine = StreamingEngine(_collection(), EngineConfig(buckets=BUCKETS), aot_cache=cache)
+    with engine:
+        for p, t in batches:
+            engine.submit(p, t)
+        first = {k: np.asarray(v) for k, v in engine.result().items()}
+    # at most one update program per bucket, plus the compute program
+    assert cache.misses <= len(BUCKETS) + 1, cache.stats()
+
+    # warm second run: a FRESH engine over a fresh same-config metric shares
+    # the cache (structural keys, not object identity) -> zero new compiles
+    cold_misses = cache.misses
+    engine2 = StreamingEngine(_collection(), EngineConfig(buckets=BUCKETS), aot_cache=cache)
+    with engine2:
+        for p, t in batches:
+            engine2.submit(p, t)
+        second = {k: np.asarray(v) for k, v in engine2.result().items()}
+    assert cache.misses == cold_misses, cache.stats()
+    for k in first:
+        assert np.array_equal(first[k], second[k])
+
+
+def test_reset_and_restream_hits_cache():
+    batches = _ragged_batches(seed=3, sizes=(9, 30, 4))
+    engine = StreamingEngine(Accuracy(), EngineConfig(buckets=BUCKETS))
+    with engine:
+        for p, t in batches:
+            engine.submit(p, t)
+        first = float(engine.result())
+        misses = engine.aot_cache.misses
+        engine.reset()
+        assert engine.steps == 0
+        for p, t in batches:
+            engine.submit(p, t)
+        second = float(engine.result())
+    assert first == second
+    assert engine.aot_cache.misses == misses
+
+
+def test_oversized_batch_chunks_through_top_bucket():
+    rng = np.random.RandomState(7)
+    n = 3 * BUCKETS[-1] + 11  # forces 3 exact top-bucket chunks + remainder
+    p, t = _dyadic(rng, n), (rng.rand(n) > 0.5).astype(np.int32)
+    eager = Accuracy()
+    eager.update(p, t)
+    want = float(eager.compute())
+    engine = StreamingEngine(Accuracy(), EngineConfig(buckets=BUCKETS))
+    with engine:
+        engine.submit(p, t)
+        got = float(engine.result())
+    assert got == want
+    assert engine.steps == 4
+
+
+def test_min_max_states_ignore_pad_rows():
+    """Pad rows must not leak into min/max reductions (identity masking)."""
+    vals = np.asarray([5.0, 7.0, 3.5], np.float32)  # all > pad fill of 0
+    mn, mx = MinMetric(), MaxMetric()
+    for m in (mn, mx):
+        engine = StreamingEngine(m, EngineConfig(buckets=(8,)))
+        with engine:
+            engine.submit(vals)
+            got = float(engine.result())
+        assert got == (3.5 if isinstance(m, MinMetric) else 7.0)
+
+
+def test_list_state_metric_rejected_with_reason():
+    from metrics_tpu import AUROC
+
+    with pytest.raises(MetricsTPUUserError, match="list"):
+        StreamingEngine(AUROC(), EngineConfig(buckets=(8,)))
+
+
+def test_dispatcher_error_surfaces_to_producer():
+    # preds/target batch dims disagree: target isn't batch-carried, so the
+    # per-row update sees mismatched shapes and the trace raises in the
+    # dispatcher thread — which must surface to the producer, not vanish
+    bad = (np.asarray([0.5, 0.5], np.float32), np.asarray([1, 0, 1], np.int32))
+    engine = StreamingEngine(Accuracy(), EngineConfig(buckets=(8,)))
+    with pytest.raises(RuntimeError, match="dispatcher failed"):
+        with engine:
+            engine.submit(*bad)
+            with pytest.raises(RuntimeError, match="dispatcher failed"):
+                engine.flush()
+            # sticky: the accumulated state is missing a batch — every later
+            # touch point (incl. context exit) must keep failing, never
+            # silently serve a corrupted value
+    # a clean context exit surfaces the error even when the producer never polled
+    engine2 = StreamingEngine(Accuracy(), EngineConfig(buckets=(8,)))
+    with pytest.raises(RuntimeError, match="dispatcher failed"):
+        with engine2:
+            engine2.submit(*bad)
+
+
+def test_empty_batch_is_noop_not_poison():
+    """A zero-row tail batch must not brick the long-lived engine."""
+    engine = StreamingEngine(Accuracy(), EngineConfig(buckets=(8,)))
+    with engine:
+        engine.submit(np.asarray([0.9, 0.1], np.float32), np.asarray([1, 0], np.int32))
+        engine.submit(np.empty((0,), np.float32), np.empty((0,), np.int32))
+        engine.submit(np.asarray([0.8], np.float32), np.asarray([1], np.int32))
+        got = float(engine.result())
+    assert got == 1.0
+    assert engine.steps == 2  # the empty batch contributed no device step
+
+
+def test_bucket_sized_broadcast_leaf_rejected_as_ambiguous():
+    """A non-batch array whose length equals the bucket would be silently
+    misread as batch-carried after padding — refuse it loudly (bucketing.py)."""
+    from metrics_tpu.engine import BucketPolicy
+
+    p = BucketPolicy([8])
+    x = np.zeros((5,), np.float32)
+    w = np.ones((8,), np.float32)  # broadcast leaf colliding with the bucket
+    with pytest.raises(ValueError, match="ambiguous"):
+        p.pad_chunk((x,), {"weights": w}, 0, 5, 8)
+
+
+def test_telemetry_shape_and_padding_accounting():
+    batches = _ragged_batches(seed=5, sizes=(5, 8, 20))
+    engine = StreamingEngine(Accuracy(), EngineConfig(buckets=BUCKETS, telemetry_capacity=2))
+    with engine:
+        for p, t in batches:
+            engine.submit(p, t)
+        engine.flush()
+        tele = engine.telemetry()
+    assert tele["steps"] == 3
+    assert tele["batches_submitted"] == 3
+    assert tele["rows_in"] == 33
+    assert tele["rows_padded"] == 8 + 8 + 32
+    assert tele["padding_waste_fraction"] == pytest.approx(1 - 33 / 48, abs=1e-3)
+    assert tele["compile_cache"]["misses"] >= 1
+    # ring capped at 2: only the newest 2 step records survive
+    recent = engine.stats.recent()
+    assert [r["step"] for r in recent] == [1, 2]
+
+
+def test_update_state_masked_matches_unpadded_eager():
+    """The engine's core identity, metric-level: masked padded delta == eager
+    delta on the unpadded slice (bit-identical)."""
+    rng = np.random.RandomState(11)
+    for m in (Accuracy(), MeanSquaredError(), F1Score()):
+        p, t = _dyadic(rng, 6), (rng.rand(6) > 0.5).astype(np.int32)
+        padded_p = np.concatenate([p, np.zeros(4, np.float32)])
+        padded_t = np.concatenate([t, np.zeros(4, np.int32)])
+        mask = np.asarray([True] * 6 + [False] * 4)
+        masked = m.update_state_masked(m.init_state(), padded_p, padded_t, mask=mask)
+        eager = m.update_state(m.init_state(), p, t)
+        for a, b in zip(jax.tree_util.tree_leaves(masked), jax.tree_util.tree_leaves(eager)):
+            assert np.array_equal(np.asarray(a), np.asarray(b)), type(m).__name__

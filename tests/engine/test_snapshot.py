@@ -1,0 +1,141 @@
+"""Snapshot recovery: kill/resume mid-stream reproduces the uninterrupted
+result exactly, and an interrupted WRITE can never corrupt recovery.
+
+Parity context: the reference's checkpointing is ``state_dict`` through the
+training framework (``torchmetrics/metric.py:514``); it has no crash-safety
+story of its own. The engine owns one: payload first, then the ``LATEST``
+pointer via atomic rename (``engine/snapshot.py``).
+"""
+import os
+
+import numpy as np
+import pytest
+
+from metrics_tpu import Accuracy, MeanSquaredError, MetricCollection
+from metrics_tpu.engine import EngineConfig, StreamingEngine, latest_snapshot, load_snapshot, save_snapshot
+
+
+def _batches(seed=1, sizes=(10, 20, 9, 31, 16, 8, 40, 3)):
+    rng = np.random.RandomState(seed)
+    return [
+        ((rng.randint(0, 65, size=n) / 64.0).astype(np.float32), (rng.rand(n) > 0.5).astype(np.int32))
+        for n in sizes
+    ]
+
+
+def _collection():
+    return MetricCollection([Accuracy(), MeanSquaredError()])
+
+
+def test_save_load_roundtrip_and_gc(tmp_path):
+    d = str(tmp_path)
+    state = {"correct": np.asarray(3), "total": np.asarray(7.5, np.float32)}
+    for step in (2, 4, 6):
+        save_snapshot(d, state, {"step": step, "batches_done": step}, keep=2)
+    snaps = sorted(n for n in os.listdir(d) if n.startswith("snap_"))
+    # keep=2 GC'd the oldest; names carry a uniqueness suffix after the step
+    assert [n[:17] for n in snaps] == ["snap_000000000004", "snap_000000000006"]
+    loaded, meta = load_snapshot(d)
+    assert meta["step"] == 6 and meta["batches_done"] == 6
+    np.testing.assert_array_equal(np.asarray(loaded["correct"]), 3)
+
+
+def test_interrupted_write_never_corrupts_recovery(tmp_path):
+    d = str(tmp_path)
+    save_snapshot(d, {"x": np.asarray(1.0)}, {"step": 2}, keep=2)
+    good = latest_snapshot(d)
+    # simulate a kill mid-payload-write: a garbage snap the pointer never saw
+    os.makedirs(os.path.join(d, "snap_000000000099_deadbeefdeadbeef"))
+    # and a kill mid-pointer-write: a stale tmp file
+    with open(os.path.join(d, "LATEST.tmp"), "w") as f:
+        f.write("snap_000000000099_deadbeefdeadbeef")
+    assert latest_snapshot(d) == good
+    state, meta = load_snapshot(d)
+    assert meta["step"] == 2
+
+
+def test_same_step_resave_never_rewrites_latest_target(tmp_path):
+    """A reset/restarted engine replays the same step numbers; saving at a
+    step already on disk must create a FRESH directory, never rewrite the one
+    LATEST points to (a kill mid-rewrite would corrupt recovery)."""
+    d = str(tmp_path)
+    save_snapshot(d, {"x": np.asarray(1.0)}, {"step": 2}, keep=2)
+    first = latest_snapshot(d)
+    save_snapshot(d, {"x": np.asarray(2.0)}, {"step": 2}, keep=2)
+    second = latest_snapshot(d)
+    assert first != second and os.path.exists(first)
+    state, _ = load_snapshot(d)
+    assert float(np.asarray(state["x"])) == 2.0
+
+
+def test_gc_keeps_newest_by_creation_not_step(tmp_path):
+    """After reset() the step counter goes backwards; GC must keep the newest
+    snapshots by CREATION order and reclaim the stale pre-reset ones."""
+    d = str(tmp_path)
+    state = {"x": np.asarray(1.0)}
+    save_snapshot(d, state, {"step": 80}, keep=2)
+    save_snapshot(d, state, {"step": 90}, keep=2)
+    for step in (10, 20, 30):  # replayed run
+        save_snapshot(d, state, {"step": step}, keep=2)
+    steps = sorted(int(n.split("_")[1]) for n in os.listdir(d) if n.startswith("snap_"))
+    assert steps == [20, 30], steps
+    _, meta = load_snapshot(d)
+    assert meta["step"] == 30
+
+
+def test_no_snapshot_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        load_snapshot(str(tmp_path))
+
+
+def test_kill_resume_reproduces_uninterrupted_result(tmp_path):
+    batches = _batches()
+    snapdir = str(tmp_path / "snaps")
+
+    # the uninterrupted truth
+    ref = StreamingEngine(_collection(), EngineConfig(buckets=(16, 32)))
+    with ref:
+        for b in batches:
+            ref.submit(*b)
+        want = {k: np.asarray(v) for k, v in ref.result().items()}
+
+    # interrupted run: periodic snapshots, injected failure after 5 batches
+    eng = StreamingEngine(
+        _collection(), EngineConfig(buckets=(16, 32), snapshot_every=2, snapshot_dir=snapdir)
+    )
+    with eng:
+        for b in batches[:5]:
+            eng.submit(*b)
+        eng.flush()
+    del eng  # "kill": the engine object (and its device state) is gone
+
+    # fresh engine (fresh process stand-in): restore, replay from the cursor
+    resumed = StreamingEngine(_collection(), EngineConfig(buckets=(16, 32), snapshot_dir=snapdir))
+    meta = resumed.restore()
+    assert meta["batches_done"] == 4  # snapshot cadence 2: last complete at batch 4
+    with resumed:
+        for b in batches[meta["batches_done"]:]:
+            resumed.submit(*b)
+        got = {k: np.asarray(v) for k, v in resumed.result().items()}
+
+    for k in want:
+        assert np.array_equal(got[k], want[k]), (k, got[k], want[k])
+
+
+def test_explicit_snapshot_and_restore_counters(tmp_path):
+    # MSE: its compute depends only on registered state. (Metrics that derive
+    # host-side attrs during update — e.g. Accuracy's `mode` — need at least
+    # one post-restore batch before compute; see docs/serving.md.)
+    snapdir = str(tmp_path)
+    eng = StreamingEngine(MeanSquaredError(), EngineConfig(buckets=(8,), snapshot_dir=snapdir))
+    with eng:
+        eng.submit(np.asarray([1.0, 0.5], np.float32), np.asarray([0.5, 0.5], np.float32))
+        eng.snapshot()
+    assert eng.stats.snapshots == 1
+    eng2 = StreamingEngine(MeanSquaredError(), EngineConfig(buckets=(8,), snapshot_dir=snapdir))
+    meta = eng2.restore()
+    assert meta["batches_done"] == 1
+    assert eng2.stats.resumes == 1
+    assert eng2.stats.rows_in == 2
+    with eng2:
+        assert float(eng2.result()) == pytest.approx(0.125)

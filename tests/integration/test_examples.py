@@ -34,6 +34,7 @@ def _run_example(name: str, timeout: int = 420) -> subprocess.CompletedProcess:
         "detection_map.py",
         "bert_score_own_model.py",
         "sharded_embedded_models.py",
+        "streaming_engine.py",
     ],
 )
 def test_example_runs(script):

@@ -1,0 +1,82 @@
+"""Pretty-print streaming-engine telemetry JSON.
+
+Usage::
+
+    python tools/engine_report.py engine_telemetry.json [--steps N]
+
+Reads the document written by ``StreamingEngine.export_telemetry`` (or
+``python -m metrics_tpu.engine.smoke``) and renders the summary plus the tail
+of the per-step ring. Pure stdlib — safe to run anywhere the JSON lands
+(no jax import, so it works on a machine without the accelerator stack).
+"""
+import argparse
+import json
+import sys
+
+
+def _fmt(v):
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:,.1f}" if abs(v) >= 10 else f"{v:.4g}"
+    if isinstance(v, int):
+        return f"{v:,}"
+    return str(v)
+
+
+def render(doc: dict, steps: int = 10) -> str:
+    s = doc.get("summary", {})
+    cc = s.get("compile_cache", {})
+    lines = []
+    lines.append("── streaming engine telemetry " + "─" * 30)
+    rows = [
+        ("steps", s.get("steps")),
+        ("batches submitted", s.get("batches_submitted")),
+        ("rows in / padded", f"{_fmt(s.get('rows_in'))} / {_fmt(s.get('rows_padded'))}"),
+        ("padding waste", f"{100 * s.get('padding_waste_fraction', 0):.2f}%"),
+        ("queue depth max", s.get("queue_depth_max")),
+        ("ingest µs p50/p95", f"{_fmt(s.get('ingest_us', {}).get('p50'))} / {_fmt(s.get('ingest_us', {}).get('p95'))}"),
+        (
+            "blocked sync µs p50/p95 (n)",
+            f"{_fmt(s.get('blocked_sync_us', {}).get('p50'))} / "
+            f"{_fmt(s.get('blocked_sync_us', {}).get('p95'))} "
+            f"({_fmt(s.get('blocked_sync_us', {}).get('count'))})",
+        ),
+        ("snapshots / resumes", f"{_fmt(s.get('snapshots'))} / {_fmt(s.get('resumes'))}"),
+        ("compiled programs", cc.get("programs")),
+        ("cache hits / misses", f"{_fmt(cc.get('hits'))} / {_fmt(cc.get('misses'))}"),
+        ("compile seconds", cc.get("compile_seconds")),
+        ("persistent cache entries", cc.get("persistent_cache_entries")),
+    ]
+    w = max(len(k) for k, _ in rows)
+    for k, v in rows:
+        lines.append(f"  {k:<{w}}  {_fmt(v)}")
+    recent = doc.get("recent_steps", [])[-steps:]
+    if recent:
+        lines.append(f"── last {len(recent)} steps " + "─" * 44)
+        lines.append("  step  bucket  valid  queue  ingest_us   sync_us")
+        for r in recent:
+            lines.append(
+                f"  {r.get('step', 0):>4}  {r.get('bucket', 0):>6}  {r.get('valid', 0):>5}"
+                f"  {r.get('queue_depth', 0):>5}  {r.get('ingest_us', 0):>9.1f}"
+                f"  {r.get('sync_us', float('nan')):>8.1f}"
+                if "sync_us" in r
+                else f"  {r.get('step', 0):>4}  {r.get('bucket', 0):>6}  {r.get('valid', 0):>5}"
+                f"  {r.get('queue_depth', 0):>5}  {r.get('ingest_us', 0):>9.1f}         -"
+            )
+    return "\n".join(lines)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("telemetry_json")
+    ap.add_argument("--steps", type=int, default=10, help="step records to show")
+    args = ap.parse_args()
+    with open(args.telemetry_json) as f:
+        doc = json.load(f)
+    print(render(doc, steps=args.steps))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

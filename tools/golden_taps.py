@@ -82,8 +82,8 @@ def build_inception_case():
     return state_np, taps
 
 
-def build_lpips_case():
-    """Synthetic lpips-style checkpoint through the real VGG-LPIPS converter.
+def _build_lpips_case(net_type: str):
+    """Synthetic lpips-style checkpoint through the real LPIPS converter.
 
     Goldens: per-tap channel means (drift-sensitive at every layer) plus the
     end-to-end LPIPS distances through the public metric.
@@ -95,23 +95,23 @@ def build_lpips_case():
     import jax.numpy as jnp
 
     from convert_weights import convert_lpips
-    from torch_mirrors import TorchVggLpips, save_lpips_style_state
+    from torch_mirrors import TorchAlexLpips, TorchVggLpips, save_lpips_style_state
     from metrics_tpu.models.perceptual import LPIPSFeatureNet
     from metrics_tpu.image.lpip_similarity import _lpips_from_features
 
     torch.manual_seed(20260731)
-    tmodel = TorchVggLpips().eval()
+    tmodel = (TorchVggLpips if net_type == "vgg" else TorchAlexLpips)().eval()
     with torch.no_grad():  # non-negative lin heads, as lpips learns them
         for lin in tmodel.lins:
             lin.weight.abs_()
     state_np = {k: v.numpy() for k, v in tmodel.state_dict().items()}
 
     with tempfile.TemporaryDirectory() as tmp:
-        pth = os.path.join(tmp, "vgg_synth.pth")
+        pth = os.path.join(tmp, f"{net_type}_synth.pth")
         save_lpips_style_state(tmodel, pth)
-        out = os.path.join(tmp, "vgg_synth.pkl")
-        convert_lpips(pth, out, net_type="vgg")
-        net = LPIPSFeatureNet(net_type="vgg", params=out)
+        out = os.path.join(tmp, f"{net_type}_synth.pkl")
+        convert_lpips(pth, out, net_type=net_type)
+        net = LPIPSFeatureNet(net_type=net_type, params=out)
 
     rng = np.random.RandomState(7)
     a = jnp.asarray(rng.rand(2, 64, 64, 3).astype(np.float32) * 2 - 1)
@@ -124,6 +124,61 @@ def build_lpips_case():
     golden["lpips"] = np.asarray(
         _lpips_from_features(taps_a, taps_b, net.weights), np.float32
     ).reshape(-1)
+    return state_np, golden
+
+
+def build_lpips_case():
+    return _build_lpips_case("vgg")
+
+
+def build_lpips_alex_case():
+    return _build_lpips_case("alex")
+
+
+def build_bert_case():
+    """Synthetic tiny HF BERT torch checkpoint through the REAL pt->flax
+    converter (``convert_weights.convert_bert`` rides transformers' own
+    conversion — the exact pipeline real BERTScore weights take).
+
+    Goldens: the converted flax encoder's last_hidden_state on fixed tokens
+    (one full row + one partially-masked row, so attention-mask handling is
+    pinned too).
+    """
+    import tempfile
+
+    import torch
+    from transformers import BertConfig, BertModel, FlaxAutoModel
+
+    from convert_weights import convert_bert
+
+    torch.manual_seed(20260731)
+    cfg = BertConfig(
+        vocab_size=120,
+        hidden_size=32,
+        num_hidden_layers=2,
+        num_attention_heads=2,
+        intermediate_size=64,
+        max_position_embeddings=64,
+    )
+    tmodel = BertModel(cfg).eval()
+    state_np = {k: v.numpy() for k, v in tmodel.state_dict().items()}
+
+    with tempfile.TemporaryDirectory() as tmp:
+        tdir = os.path.join(tmp, "torch_ckpt")
+        fdir = os.path.join(tmp, "flax_ckpt")
+        tmodel.save_pretrained(tdir)
+        convert_bert(tdir, fdir)
+        fmodel = FlaxAutoModel.from_pretrained(fdir)
+
+        rng = np.random.RandomState(42)
+        ids = rng.randint(0, cfg.vocab_size, size=(2, 16)).astype(np.int32)
+        mask = np.ones_like(ids)
+        mask[1, 10:] = 0
+        out = fmodel(input_ids=ids, attention_mask=mask).last_hidden_state
+    golden = {
+        "last_hidden_state_mean": np.asarray(out, np.float32).mean(axis=-1),
+        "last_hidden_state_row0": np.asarray(out, np.float32)[0, 0],
+    }
     return state_np, golden
 
 
@@ -140,7 +195,12 @@ def _pin_backend() -> None:
 def generate(golden_dir: str = GOLDEN_DIR) -> None:
     _pin_backend()
     os.makedirs(golden_dir, exist_ok=True)
-    for name, builder in (("inception", build_inception_case), ("lpips_vgg", build_lpips_case)):
+    for name, builder in (
+        ("inception", build_inception_case),
+        ("lpips_vgg", build_lpips_case),
+        ("lpips_alex", build_lpips_alex_case),
+        ("bert", build_bert_case),
+    ):
         state_np, taps = builder()
         path = os.path.join(golden_dir, f"{name}_taps.npz")
         np.savez_compressed(path, ckpt_sha256=state_dict_sha256(state_np), **taps)

@@ -1,0 +1,81 @@
+"""Serving example: metrics as a streaming service on a device mesh.
+
+The reference's contract is library-shaped — your loop calls ``update()``
+synchronously and every new batch shape re-traces. This example runs the
+engine's serving contract instead (docs/serving.md): ragged traffic flows into
+a bounded queue, batches round to a CLOSED set of padded bucket shapes, each
+bucket's update step is AOT-compiled once (with the state donated and, on a
+mesh, batch rows sharded + deltas psum-merged in-step), periodic crash-safe
+snapshots land on disk, and telemetry comes out as JSON.
+
+Run (any host):
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python tpu_examples/streaming_engine.py
+"""
+import os
+import sys
+import tempfile
+
+# allow running as `python tpu_examples/<name>.py` from the repo root checkout
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from metrics_tpu import Accuracy, F1Score, MeanSquaredError, MetricCollection
+from metrics_tpu.engine import EngineConfig, StreamingEngine
+
+BUCKETS = (64, 256)
+N_BATCHES = 40
+
+
+def main() -> None:
+    mesh = Mesh(np.asarray(jax.devices()), ("dp",))
+    print(f"mesh: {mesh}")
+
+    rng = np.random.RandomState(0)
+    sizes = rng.randint(8, 257, size=N_BATCHES)
+    traffic = [
+        (rng.rand(n).astype(np.float32), (rng.rand(n) > 0.5).astype(np.int32))
+        for n in sizes
+    ]
+
+    metrics = MetricCollection({"acc": Accuracy(), "f1": F1Score(), "mse": MeanSquaredError()})
+    snapdir = tempfile.mkdtemp(prefix="engine_snaps_")
+    engine = StreamingEngine(
+        metrics,
+        EngineConfig(
+            buckets=BUCKETS, mesh=mesh, axis="dp",
+            snapshot_every=10, snapshot_dir=snapdir,
+        ),
+    )
+
+    with engine:
+        for preds, target in traffic:           # ragged sizes, closed program set
+            engine.submit(preds, target)        # blocks when the queue is full
+        served = {k: float(v) for k, v in engine.result().items()}
+
+    # the same traffic through the plain eager loop must agree exactly
+    eager = MetricCollection({"acc": Accuracy(), "f1": F1Score(), "mse": MeanSquaredError()})
+    for preds, target in traffic:
+        eager.update(preds, target)
+    reference = {k: float(v) for k, v in eager.compute().items()}
+
+    tele = engine.telemetry()
+    print(f"served  : {served}")
+    print(f"eager   : {reference}")
+    for k in served:
+        assert served[k] == reference[k], (k, served[k], reference[k])
+    assert tele["compile_cache"]["misses"] <= len(BUCKETS) + 1
+    assert tele["snapshots"] == N_BATCHES // 10
+    print(
+        f"parity exact over {N_BATCHES} ragged batches ({tele['rows_in']} rows); "
+        f"{tele['compile_cache']['misses']} compiled programs for {len(BUCKETS)} buckets, "
+        f"padding waste {100 * tele['padding_waste_fraction']:.1f}%, "
+        f"{tele['snapshots']} snapshots -> {snapdir}"
+    )
+
+
+if __name__ == "__main__":
+    main()
