@@ -37,6 +37,10 @@ class Accuracy(StatScores):
     """
 
     is_differentiable = False
+    # `mode` is latched from the DATA during update (host side, outside the
+    # state pytree) and compute refuses to run without it — declare it so
+    # engine snapshots persist/restore it (no post-restore batch needed)
+    _host_derived_compute_attrs = ("mode",)
     higher_is_better = True
 
     def __init__(

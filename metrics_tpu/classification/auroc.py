@@ -40,6 +40,10 @@ class AUROC(CapacityCurveStateMixin, Metric):
 
     is_differentiable = False
     higher_is_better = True
+    # `mode` is latched from the DATA during update and compute refuses to run
+    # without it — declared so engine snapshots persist/restore it (same
+    # contract as Accuracy; matters for the servable capacity=N layout)
+    _host_derived_compute_attrs = ("mode",)
 
     def __init__(
         self,

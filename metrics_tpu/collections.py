@@ -290,15 +290,75 @@ class MetricCollection(dict):
                 return f"member {k!r}: {r}"
         return None
 
+    def masked_update_strategies(self) -> Dict[str, "str | None"]:
+        """Per-member masked-update strategy (``Metric.masked_update_strategy``)
+        — the serving observable for which members ride the vmapped delta path
+        and which fall back to the sequential scan fold."""
+        return {k: m.masked_update_strategy() for k, m in self.items(keep_base=True)}
+
     def update_state_masked(
         self, state: Dict[str, Dict[str, Any]], *args: Any, mask: Any, **kwargs: Any
     ) -> Dict[str, Dict[str, Any]]:
         """Mask-aware fan-out update of all members (the streaming-engine entry:
-        one call == one fused program over every member's masked delta)."""
+        one call == one fused program over every member's masked delta; members
+        without a row-neutral reduction identity take their scan fallback
+        INSIDE the same program — the compiled-program count is unchanged)."""
         return {
             k: m.update_state_masked(state[k], *args, mask=mask, **m._filter_kwargs(**kwargs))
             for k, m in self.items(keep_base=True)
         }
+
+    def segmented_update_unsupported_reason(self) -> "str | None":
+        """None when every member supports the multi-stream segmented update."""
+        for k, m in self.items(keep_base=True):
+            r = m.segmented_update_unsupported_reason()
+            if r is not None:
+                return f"member {k!r}: {r}"
+        return None
+
+    def update_state_segmented(
+        self,
+        state: Dict[str, Dict[str, Any]],
+        *args: Any,
+        mask: Any,
+        segment_ids: Any,
+        num_segments: int,
+        **kwargs: Any,
+    ) -> Dict[str, Dict[str, Any]]:
+        """Multi-stream fan-out update: every member's stream-stacked state
+        rows addressed by ``segment_ids`` take the row deltas (one fused
+        program across all members — the ``MultiStreamEngine`` step)."""
+        return {
+            k: m.update_state_segmented(
+                state[k], *args, mask=mask, segment_ids=segment_ids,
+                num_segments=num_segments, **m._filter_kwargs(**kwargs),
+            )
+            for k, m in self.items(keep_base=True)
+        }
+
+    def arena_layout(self) -> Any:
+        """Per-dtype packing plan over ALL member states (``engine/arena.py``):
+        the whole collection's step dispatch carries one donated buffer per
+        dtype class, however many members it serves."""
+        from metrics_tpu.engine.arena import ArenaLayout
+
+        return ArenaLayout.for_state(self.abstract_state())
+
+    def host_compute_attrs(self) -> Dict[str, Any]:
+        """Flat ``{member.path: value}`` of every member's host-derived
+        compute attributes (``Metric.host_compute_attrs``)."""
+        out: Dict[str, Any] = {}
+        for k, m in self.items(keep_base=True):
+            for a, v in m.host_compute_attrs().items():
+                out[f"{k}.{a}"] = v
+        return out
+
+    def restore_host_compute_attrs(self, attrs: Dict[str, Any]) -> None:
+        for k, m in self.items(keep_base=True):
+            prefix = f"{k}."
+            sub = {p[len(prefix):]: v for p, v in attrs.items() if p.startswith(prefix)}
+            if sub:
+                m.restore_host_compute_attrs(sub)
 
     def sync_states(
         self, state: Dict[str, Dict[str, Any]], axis_name: Optional[AxisSpec] = None

@@ -16,11 +16,22 @@ host-side batching and queueing. This package supplies it:
   (bucket signature, mesh, dtype), with hit/miss counters, optionally backed
   by JAX's persistent compilation cache directory so a warm process restart
   pays zero XLA compiles.
+* :mod:`~metrics_tpu.engine.arena` — state arenas: the carried state packs to
+  ONE contiguous donated buffer per dtype (static slice metadata, unpacked
+  inside the jitted step where XLA fuses it away), so a step dispatch carries
+  2–3 arrays instead of one per state leaf — the dispatch-amortization that
+  matters at small batch sizes.
 * :mod:`~metrics_tpu.engine.pipeline` — the :class:`StreamingEngine`: a
-  bounded host ingestion queue (blocking ``submit`` = backpressure), an async
-  dispatcher thread that pads/uploads the next batch while the device runs the
-  current step (double buffering via JAX async dispatch, bounded by
-  ``in_flight``), donated state buffers, and mesh-aware sharded steps.
+  bounded host ingestion queue (blocking ``submit`` = backpressure), megabatch
+  coalescing (up to ``coalesce`` compatible queued batches concatenate into
+  one masked step), an async dispatcher thread that pads/uploads the next
+  batch while the device runs the current step (double buffering via JAX
+  async dispatch, bounded by ``in_flight``), donated state buffers, and
+  mesh-aware sharded steps.
+* :mod:`~metrics_tpu.engine.multistream` — :class:`MultiStreamEngine`: S
+  independent evaluation streams served by ONE executable (stream-stacked
+  states, per-row stream ids scatter-reduced via segment ops, per-stream
+  compute with a runtime stream index).
 * :mod:`~metrics_tpu.engine.snapshot` / :mod:`~metrics_tpu.engine.stats` —
   periodic atomic snapshots of the accumulated state (orbax-backed, resumable
   after a kill) and ring-buffer telemetry (queue depth, padding waste,
@@ -40,16 +51,20 @@ Quickstart::
 See ``docs/serving.md`` for the architecture and recovery semantics.
 """
 from metrics_tpu.engine.aot import AotCache, enable_persistent_compilation_cache
+from metrics_tpu.engine.arena import ArenaLayout
 from metrics_tpu.engine.bucketing import BucketPolicy
+from metrics_tpu.engine.multistream import MultiStreamEngine
 from metrics_tpu.engine.pipeline import EngineConfig, StreamingEngine
 from metrics_tpu.engine.snapshot import latest_snapshot, load_snapshot, save_snapshot
 from metrics_tpu.engine.stats import EngineStats
 
 __all__ = [
     "AotCache",
+    "ArenaLayout",
     "BucketPolicy",
     "EngineConfig",
     "EngineStats",
+    "MultiStreamEngine",
     "StreamingEngine",
     "enable_persistent_compilation_cache",
     "latest_snapshot",

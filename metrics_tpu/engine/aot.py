@@ -14,7 +14,10 @@ Two layers of caching:
   pointing it at a directory makes a warm PROCESS RESTART skip the XLA compile
   too — the in-process cache counts a miss (the executable object must be
   rebuilt) but XLA serves the binary from disk instead of recompiling
-  (arXiv:2605.25645's serving recipe: compile once, restart free).
+  (arXiv:2605.25645's serving recipe: compile once, restart free). Enabling is
+  safe at ANY point in the process lifetime: the lazily-created cache handle is
+  re-initialised automatically when the backend already compiled something, so
+  an engine brought up after warmup traffic still gets a populated cache dir.
 
 The structural key deliberately excludes object identity so two engines over
 equivalently-configured metrics share executables. A metric's fingerprint
@@ -43,8 +46,13 @@ def enable_persistent_compilation_cache(path: str) -> str:
     Also drops the min-compile-time/min-entry-size thresholds so the small
     per-bucket metric programs are cached at all (the defaults only persist
     programs that took >1 s to compile). Returns the absolute path. Safe to
-    call repeatedly; failures (unsupported backend/jax build) are non-fatal —
-    the engine still works, warm restarts just pay the XLA compile.
+    call repeatedly AND at any point in the process lifetime: JAX creates the
+    cache handle lazily at the backend's first compile and never re-reads the
+    config, so if any computation already ran (warmup traffic, eager
+    validation) the handle is re-initialised here — callers never need to
+    touch ``cc.reset_cache()`` themselves. Failures (unsupported backend/jax
+    build) are non-fatal — the engine still works, warm restarts just pay the
+    XLA compile.
     """
     import jax
 
@@ -54,9 +62,13 @@ def enable_persistent_compilation_cache(path: str) -> str:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
         jax.config.update("jax_compilation_cache_dir", path)
-        # the cache handle is created lazily at the backend's FIRST compile and
-        # never re-reads the config — if any computation already ran (warmup,
-        # eager validation), force re-initialization so the new dir takes
+    except Exception:  # pragma: no cover - jax-version dependent
+        return path
+    try:
+        # drop any handle created before this config took effect; the next
+        # compile re-creates it against `path`. reset_cache() is safe when no
+        # handle exists yet, so call unconditionally rather than probing
+        # version-dependent internals.
         from jax.experimental.compilation_cache import compilation_cache as cc
 
         cc.reset_cache()
@@ -184,6 +196,14 @@ class AotCache:
         """Atomically count a cache hit served from an engine-local memo."""
         with self._lock:
             self.hits += 1
+
+    def enable_persistent_cache(self, path: str) -> str:
+        """Turn the persistent compilation cache on MID-PROCESS (the backend
+        may already have compiled programs — the stale cache handle is reset
+        automatically). Programs compiled from now on land under ``path``."""
+        with self._lock:
+            self.cache_dir = enable_persistent_compilation_cache(path)
+            return self.cache_dir
 
     def get_or_compile(self, key: Tuple, build: Callable[[], Any]) -> Any:
         """Return the executable for ``key``, compiling via ``build()`` on miss."""

@@ -1,0 +1,168 @@
+"""State arenas: pack a metric-state pytree into one buffer per dtype.
+
+Why: the streaming engine's steady state is dispatch-bound at small batch
+sizes — each AOT step call flattens the state pytree, type-checks every leaf,
+and hands XLA one donated buffer PER LEAF. A `MetricCollection` of a handful
+of classification metrics easily carries 10–20 small leaves, so the per-step
+host overhead scales with metric count, not with work. The arena collapses
+that: all state leaves of one dtype concatenate (raveled) into a single
+contiguous 1-D buffer, so a step dispatch carries 2–3 donated arrays — one per
+dtype class — no matter how many metrics the collection serves.
+
+The packing plan (:class:`ArenaLayout`) is STATIC metadata derived from the
+metric's ``abstract_state()``: per leaf, the owning dtype segment, its offset,
+flat size, and logical shape. ``unpack`` re-slices with static offsets inside
+the jitted step, which XLA fuses away — the compiled program reads the same
+values it would have read from separate buffers; only the dispatch-time
+argument count changes. ``pack`` of the updated tree is likewise a per-dtype
+concatenate of raveled leaves that XLA writes straight into the donated input
+buffer (shapes and dtypes match exactly, the donation fast path).
+
+Invariants (guarded by ``tests/engine/test_arena.py``):
+
+* one buffer per distinct state dtype — donated step arguments per dtype
+  class == 1, and a typical classification collection packs to ≤ 3 buffers
+  (float, int, bool);
+* ``unpack(pack(tree)) == tree`` bit-exactly, traced or eager;
+* buffer keys are dtype names, so the arena dict is a stable pytree (sorted
+  keys) and snapshots serialize ONE payload per dtype
+  (``engine/snapshot.py``).
+
+Dtype segregation is what keeps this exact: mixing dtypes in one buffer would
+force casts (lossy for int64→float32 counters) — per-dtype buffers are pure
+relayouts.
+"""
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["ArenaLayout"]
+
+
+class _LeafSpec:
+    __slots__ = ("key", "offset", "size", "shape", "dtype")
+
+    def __init__(self, key: str, offset: int, size: int, shape: Tuple[int, ...], dtype: Any):
+        self.key = key
+        self.offset = offset
+        self.size = size
+        self.shape = shape
+        self.dtype = dtype
+
+
+class ArenaLayout:
+    """Static plan for packing a state pytree into per-dtype 1-D buffers.
+
+    Build one from a metric via :meth:`Metric.arena_layout` (or directly with
+    :meth:`for_state` on any ``ShapeDtypeStruct`` pytree). The layout is pure
+    metadata — no device buffers — and is safe to share across engines over
+    equivalently-shaped states.
+    """
+
+    def __init__(self, treedef: Any, specs: List[_LeafSpec], totals: Dict[str, int]):
+        self._treedef = treedef
+        self._specs = specs
+        self._totals = totals  # dtype key -> flat element count
+
+    @classmethod
+    def for_state(cls, abstract_state: Any) -> "ArenaLayout":
+        """Derive the packing plan from a ``ShapeDtypeStruct`` (or array)
+        pytree. Every leaf must be array-shaped — list/cat states have no
+        static arena slot (the engine refuses those metrics earlier)."""
+        leaves, treedef = jax.tree_util.tree_flatten(abstract_state)
+        totals: Dict[str, int] = {}
+        specs: List[_LeafSpec] = []
+        for leaf in leaves:
+            shape = getattr(leaf, "shape", None)
+            dtype = getattr(leaf, "dtype", None)
+            if shape is None or dtype is None:
+                raise ValueError(
+                    f"arena layouts need array-shaped state leaves, got {type(leaf).__name__}"
+                )
+            key = jnp.dtype(dtype).name
+            size = 1
+            for d in shape:
+                size *= int(d)
+            specs.append(_LeafSpec(key, totals.get(key, 0), size, tuple(int(d) for d in shape), jnp.dtype(dtype)))
+            totals[key] = totals.get(key, 0) + size
+        return cls(treedef, specs, totals)
+
+    # ------------------------------------------------------------------ queries
+
+    @property
+    def num_buffers(self) -> int:
+        """Distinct dtype segments == donated step arguments for the state."""
+        return len(self._totals)
+
+    @property
+    def dtype_keys(self) -> Tuple[str, ...]:
+        return tuple(sorted(self._totals))
+
+    @property
+    def num_leaves(self) -> int:
+        return len(self._specs)
+
+    def buffer_sizes(self) -> Dict[str, int]:
+        """Flat element count per dtype buffer."""
+        return dict(self._totals)
+
+    def abstract(self) -> Dict[str, jax.ShapeDtypeStruct]:
+        """``ShapeDtypeStruct`` arena dict — the AOT lowering template."""
+        return {
+            k: jax.ShapeDtypeStruct((n,), jnp.dtype(k)) for k, n in self._totals.items()
+        }
+
+    def matches(self, arena: Dict[str, Any]) -> bool:
+        """Shape/dtype compatibility of the BUFFERS (used when restoring
+        snapshots). Necessary but not sufficient — two layouts with permuted
+        same-dtype leaves have identical buffers; :meth:`fingerprint` is the
+        sufficient check and travels in the snapshot meta."""
+        if set(arena) != set(self._totals):
+            return False
+        return all(
+            tuple(getattr(arena[k], "shape", ())) == (n,) for k, n in self._totals.items()
+        )
+
+    def fingerprint(self) -> str:
+        """Digest of the full packing plan — treedef + every leaf's (segment,
+        offset, size, shape, dtype). Two layouts unpack a buffer identically
+        iff their fingerprints match; the engine stores this in snapshot meta
+        so a reconfigured metric cannot silently unscramble a stale arena."""
+        import hashlib
+
+        h = hashlib.sha256(repr(self._treedef).encode())
+        for s in self._specs:
+            h.update(f"{s.key}:{s.offset}:{s.size}:{s.shape}:{s.dtype}".encode())
+        return h.hexdigest()[:16]
+
+    # ------------------------------------------------------------- pack / unpack
+
+    def pack(self, state: Any) -> Dict[str, Any]:
+        """State pytree -> per-dtype 1-D buffers. Traced or eager; inside the
+        jitted step the concatenate writes straight into the donated input."""
+        leaves = jax.tree_util.tree_flatten(state)[0]
+        if len(leaves) != len(self._specs):
+            raise ValueError(
+                f"state has {len(leaves)} leaves, layout expects {len(self._specs)}"
+            )
+        parts: Dict[str, List[Any]] = {k: [] for k in self._totals}
+        for leaf, spec in zip(leaves, self._specs):
+            parts[spec.key].append(jnp.ravel(jnp.asarray(leaf, spec.dtype)))
+        return {
+            k: (jnp.concatenate(chunks) if len(chunks) > 1 else chunks[0])
+            for k, chunks in parts.items()
+        }
+
+    def unpack(self, arena: Dict[str, Any]) -> Any:
+        """Per-dtype buffers -> state pytree via STATIC slices (XLA fuses these
+        into the consuming ops; no copies survive in the compiled step)."""
+        leaves = [
+            jnp.reshape(arena[s.key][s.offset : s.offset + s.size], s.shape)
+            for s in self._specs
+        ]
+        return jax.tree_util.tree_unflatten(self._treedef, leaves)
+
+    def __repr__(self) -> str:
+        segs = ", ".join(f"{k}:{n}" for k, n in sorted(self._totals.items()))
+        return f"ArenaLayout({len(self._specs)} leaves -> {self.num_buffers} buffers [{segs}])"

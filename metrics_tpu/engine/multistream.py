@@ -1,0 +1,228 @@
+"""Multi-stream serving: S independent evaluation streams, ONE executable.
+
+The ROADMAP's serving regime is many concurrent evaluation streams (one per
+user/session/model-variant), each a separate accumulation with its own
+result. One :class:`~metrics_tpu.engine.pipeline.StreamingEngine` per stream
+multiplies everything that makes small-batch serving dispatch-bound: S AOT
+program sets, S dispatcher threads, S donated state transfers per scheduling
+quantum. ``MultiStreamEngine`` collapses all of it:
+
+* every member state leaf gains a leading **stream axis** of length
+  ``num_streams`` — with arenas on (default), the whole S-stream state is
+  still just one buffer per dtype;
+* a step takes ``(state, (stream_ids,)+batch, mask)``: the vmapped per-row
+  deltas scatter-reduce into the addressed stream rows with each reduction's
+  own op (``Metric.update_state_segmented`` — ``.at[ids].add/min/max`` on an
+  identity-filled base), so ONE dispatch can carry rows for MANY streams at
+  once;
+* megabatch coalescing composes for free: queued batches from DIFFERENT
+  streams concatenate into one step (their rows address different state
+  rows), which is exactly the cross-stream amortization a per-stream engine
+  can never do;
+* ``result(stream_id)`` runs one shared compiled compute program whose
+  stream index is a runtime argument — S streams, one compute executable;
+* snapshots carry all streams in one (per-dtype) payload; restore brings
+  every stream back at once.
+
+The compiled-program budget is UNCHANGED from the single-stream engine: at
+most ``len(buckets)`` update programs + 1 compute program, for any S.
+
+Scope: single-device (or single default-device) serving — the segmented
+scatter has no exact shard-and-merge form for mesh steps yet. Metrics must
+support the generic delta masked path (``segmented_update_unsupported_reason``
+is None): custom fused masked forms and scan-fallback members have no
+segmented counterpart.
+
+Quickstart::
+
+    from metrics_tpu import Accuracy
+    from metrics_tpu.engine import EngineConfig, MultiStreamEngine
+
+    engine = MultiStreamEngine(Accuracy(), num_streams=64,
+                               config=EngineConfig(buckets=(64, 256)))
+    with engine:
+        engine.submit(stream_id, preds, target)   # any stream, any order
+        ...
+        acc_7 = engine.result(7)                  # per-stream compute
+"""
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.engine.aot import AotCache
+from metrics_tpu.engine.pipeline import EngineConfig, StreamingEngine
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+__all__ = ["MultiStreamEngine"]
+
+
+class MultiStreamEngine(StreamingEngine):
+    """Serve ``num_streams`` independent accumulations of one metric from a
+    single AOT program set and a single dispatcher."""
+
+    def __init__(
+        self,
+        metric: Any,
+        num_streams: int,
+        config: Optional[EngineConfig] = None,
+        aot_cache: Optional[AotCache] = None,
+    ):
+        if not isinstance(num_streams, int) or num_streams <= 0:
+            raise MetricsTPUUserError(f"num_streams must be a positive int, got {num_streams!r}")
+        if config is not None and config.mesh is not None:
+            raise MetricsTPUUserError(
+                "MultiStreamEngine is single-device: the segmented scatter has no exact "
+                "shard-and-merge mesh form; use one StreamingEngine per mesh instead"
+            )
+        self._num_streams = int(num_streams)
+        super().__init__(metric, config=config, aot_cache=aot_cache)
+
+    # -------------------------------------------------------------- capability checks
+
+    def _serving_unsupported_reason(self, metric: Any) -> Optional[str]:
+        return metric.segmented_update_unsupported_reason()
+
+    # ----------------------------------------------------------------- state plumbing
+
+    @property
+    def num_streams(self) -> int:
+        return self._num_streams
+
+    def _init_state_tree(self) -> Any:
+        base = self._metric.init_state()
+        return jax.tree.map(
+            lambda x: jnp.tile(jnp.asarray(x)[None], (self._num_streams,) + (1,) * jnp.ndim(x)),
+            base,
+        )
+
+    def _abstract_state_tree(self) -> Any:
+        base = self._metric.abstract_state()
+        return jax.tree.map(
+            lambda s: jax.ShapeDtypeStruct((self._num_streams,) + tuple(s.shape), s.dtype),
+            base,
+        )
+
+    # ------------------------------------------------------------------ AOT programs
+
+    def _update_kind(self) -> str:
+        return "update_mstream"
+
+    def _traced_update(self, state_tree: Any, payload: Any, mask: Any) -> Any:
+        a, kw = payload
+        stream_ids, rest = a[0], a[1:]
+        return self._metric.update_state_segmented(
+            state_tree, *rest, mask=mask,
+            segment_ids=stream_ids, num_segments=self._num_streams, **kw,
+        )
+
+    def _compute_program(self):
+        """One executable computes ANY stream: the stream index is a runtime
+        scalar argument, so S streams never cost S compiles."""
+        sid_abs = jax.ShapeDtypeStruct((), jnp.int32)
+        key = self._aot.program_key(
+            "compute_mstream", self._metric_fp,
+            arg_tree=(self._abstract_state(), sid_abs),
+            mesh=None, donate=False,
+        )
+        metric, unpack = self._metric, self._unpack
+
+        def build():
+            def compute(state, sid):
+                row = jax.tree.map(lambda x: x[sid], unpack(state))
+                return metric.compute_from(row)
+
+            return jax.jit(compute).lower(self._abstract_state(), sid_abs).compile()
+
+        return self._aot.get_or_compile(key, build)
+
+    # --------------------------------------------------------------------- producers
+
+    def _check_stream(self, stream_id: Any) -> int:
+        sid = int(stream_id)
+        if not 0 <= sid < self._num_streams:
+            raise MetricsTPUUserError(
+                f"stream_id {sid} out of range for num_streams={self._num_streams}"
+            )
+        return sid
+
+    def submit(self, stream_id: int, *args: Any, **kwargs: Any) -> None:
+        """Enqueue one (ragged) batch for ``stream_id``. Blocks when full."""
+        sid = self._check_stream(stream_id)
+        self._raise_if_failed()
+        self.start()
+        self._stats.batches_submitted += 1
+        self._queue.put((sid, args, kwargs))
+
+    def result(self, stream_id: int) -> Any:  # type: ignore[override]
+        """Flush, then compute ``stream_id``'s accumulated value (shared
+        compiled program, stream index passed at runtime)."""
+        sid = self._check_stream(stream_id)
+        self.flush()
+        with self._state_lock:
+            return self._compute_program()(self._state, jnp.asarray(sid, jnp.int32))
+
+    def results(self) -> Dict[int, Any]:
+        """Every stream's value (one flush, S cached-program calls)."""
+        self.flush()
+        with self._state_lock:
+            program = self._compute_program()
+            return {
+                sid: program(self._state, jnp.asarray(sid, jnp.int32))
+                for sid in range(self._num_streams)
+            }
+
+    def reset_stream(self, stream_id: int) -> None:
+        """Zero ONE stream's accumulation; all other streams keep theirs.
+
+        Safe against live traffic on OTHER streams: the read-modify-write
+        holds the engine's state lock, so it cannot interleave with a step
+        that donates the live buffers (or be overwritten by one). Batches for
+        this stream submitted after the call land in the fresh accumulation.
+        """
+        sid = self._check_stream(stream_id)
+        self.flush()
+        init = self._metric.init_state()
+        with self._state_lock:
+            tree = jax.tree.map(
+                lambda x, i: x.at[sid].set(jnp.asarray(i, x.dtype)),
+                self._unpack(self._state), init,
+            )
+            self._state = self._put_state(tree)
+
+    def stream_state(self, stream_id: int) -> Any:
+        """Defensive copy of one stream's LOGICAL state pytree (post-flush)."""
+        sid = self._check_stream(stream_id)
+        self.flush()
+        with self._state_lock:
+            return jax.tree.map(
+                lambda x: jnp.array(x[sid], copy=True), self._unpack(self._state)
+            )
+
+    # ------------------------------------------------------------------- coalescing
+
+    def _latch_payload(self, merged: Any) -> Tuple[Tuple[Any, ...], Dict[str, Any]]:
+        # strip the engine-internal stream_ids arg: the latch row must see
+        # exactly what the metric's update signature expects
+        args, kwargs = merged
+        return tuple(args[1:]), kwargs
+
+    def _coalescible(self, ref: Any, item: Any) -> bool:
+        # stream ids NEVER block coalescing — cross-stream megabatches are the
+        # point; only the (args, kwargs) payloads must be concatenable
+        return super()._coalescible(ref[1:], item[1:])
+
+    def _merge_sized(
+        self, nonempty: List[Tuple[Any, int]]
+    ) -> Optional[Tuple[Tuple[Any, ...], Dict[str, Any]]]:
+        # pre-sized by the caller (one tree-flatten per item total): sizes
+        # feed both the per-row stream-id build and the concat
+        if not nonempty:
+            return None
+        stream_ids = np.concatenate(
+            [np.full((n,), it[0], np.int32) for it, n in nonempty]
+        )
+        merged = self._concat_sized([((a, kw), n) for ((_, a, kw), n) in nonempty])
+        args, kwargs = merged
+        return (stream_ids,) + tuple(args), kwargs

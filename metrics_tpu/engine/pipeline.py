@@ -4,11 +4,12 @@ Dataflow (one engine = one metric/collection served as a stream consumer)::
 
     submit(*batch)        # producer thread(s); BLOCKS when the queue is full
       └─ bounded queue (backpressure, config.max_queue batches)
-           └─ dispatcher thread: chunk → pad to bucket (host numpy) →
-              device upload → AOT-compiled step(state, batch, mask)
-                 └─ donated state buffers, up to config.in_flight steps
-                    un-synced (JAX async dispatch overlaps the host's padding
-                    of batch k+1 with the device's execution of batch k)
+           └─ dispatcher thread: drain ≤ coalesce compatible batches →
+              concat (megabatch) → chunk → pad to bucket (host numpy) →
+              device upload → AOT-compiled step(arena, batch, mask)
+                 └─ donated per-dtype state arenas, up to config.in_flight
+                    steps un-synced (JAX async dispatch overlaps the host's
+                    padding of batch k+1 with the device's execution of k)
     result()              # flush + AOT-compiled compute on the final state
 
 Design notes:
@@ -17,7 +18,24 @@ Design notes:
   metric fingerprint, mesh, donation, backend) and compiled ahead-of-time via
   ``jit(...).lower(...).compile()`` — after at most ``len(buckets)`` compiles
   per input signature the engine never traces again (``engine/aot.py``).
-* **Donation.** The state pytree is donated into each step: XLA merges the
+  Coalescing and arenas do not widen the set: a megabatch re-chunks into the
+  same buckets, and the arena is one fixed signature per engine.
+* **State arenas.** With ``config.use_arena`` (default) the carried state is
+  not the per-leaf pytree but its packed form (``engine/arena.py``): ONE
+  contiguous buffer per dtype, unpacked inside the jitted step with static
+  slices XLA fuses away. A step dispatch then flattens/type-checks/donates
+  2–3 arrays instead of one per state leaf — the difference between
+  dispatch-bound and device-bound at small batch sizes.
+* **Megabatch coalescing.** ``config.coalesce > 1`` lets the dispatcher
+  opportunistically drain up to that many QUEUED batches whose non-batch
+  arguments agree, concatenate them on the host, and run the result as one
+  (bucketed) masked step — K submissions, one dispatch and one in-step
+  collective set. Exactness is free: masked updates are row-exact, and the
+  concatenation preserves submission order. Latency is bounded: draining
+  never blocks beyond ``coalesce_window_ms`` (default 0 — only batches
+  already queued coalesce), never crosses a snapshot boundary (the replay
+  cursor cadence stays exact), and stops once the top bucket is filled.
+* **Donation.** The state buffers are donated into each step: XLA merges the
   delta in place instead of allocating a second state copy (material for
   big-state metrics; ``metric.py`` documents the same policy for compiled
   forward). Donation is skipped on CPU, which doesn't implement it.
@@ -33,19 +51,23 @@ Design notes:
 * **Recovery.** ``snapshot_every > 0`` writes crash-safe periodic snapshots
   (``engine/snapshot.py``); ``restore()`` resumes exactly — replaying the
   stream from the snapshot's step reproduces the uninterrupted result.
+  Snapshots carry the packed arena (one payload per dtype) plus the metric's
+  host-derived compute attributes (``Metric.host_compute_attrs``), so a
+  restored engine computes immediately.
 """
 import queue
 import threading
 import time
 from collections import deque
 from dataclasses import dataclass
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu.engine.aot import AotCache, metric_fingerprint
+from metrics_tpu.engine.arena import ArenaLayout
 from metrics_tpu.engine.bucketing import BucketPolicy
 from metrics_tpu.engine.snapshot import load_snapshot, save_snapshot
 from metrics_tpu.engine.stats import EngineStats
@@ -55,6 +77,10 @@ from metrics_tpu.utils.exceptions import MetricsTPUUserError
 __all__ = ["EngineConfig", "StreamingEngine"]
 
 _STOP = object()
+
+# non-batch leaves larger than this are never content-compared for megabatch
+# compatibility — the comparison would cost more than the dispatch it saves
+_COALESCE_AUX_COMPARE_CAP = 4096
 
 
 @dataclass
@@ -67,10 +93,22 @@ class EngineConfig:
             blocks when full — backpressure to the producer.
         in_flight: device steps allowed un-synced before the dispatcher
             blocks on the oldest (double-buffering depth).
+        coalesce: max SUBMITTED batches the dispatcher may drain and
+            concatenate into one megabatch step (1 disables). Compatible
+            batches only (same structure/dtypes, equal non-batch arguments);
+            an incompatible batch ends the group and runs next.
+        coalesce_window_ms: how long the dispatcher may WAIT for more
+            coalescible traffic once the queue runs dry (0 = never wait —
+            only already-queued batches coalesce, adding zero latency).
+        use_arena: carry the state as per-dtype packed arenas
+            (``engine/arena.py``) instead of the per-leaf pytree — fewer
+            donated step arguments, one snapshot payload per dtype.
         snapshot_every: BATCHES between crash-safe state snapshots (0 = off).
             Snapshots land on batch boundaries only — a batch larger than the
             top bucket spans several device steps, and a mid-batch snapshot
-            would break batch-level replay on resume.
+            would break batch-level replay on resume. Megabatch groups never
+            cross a snapshot boundary, so the cadence stays exact under
+            coalescing.
         snapshot_dir: where snapshots live (required when snapshot_every > 0).
         compilation_cache_dir: JAX persistent compilation cache directory —
             warm process restarts skip XLA compiles entirely.
@@ -86,6 +124,9 @@ class EngineConfig:
     buckets: Tuple[int, ...] = (256, 1024)
     max_queue: int = 64
     in_flight: int = 2
+    coalesce: int = 8
+    coalesce_window_ms: float = 0.0
+    use_arena: bool = True
     snapshot_every: int = 0
     snapshot_dir: Optional[str] = None
     compilation_cache_dir: Optional[str] = None
@@ -108,7 +149,7 @@ class StreamingEngine:
     def __init__(self, metric: Any, config: Optional[EngineConfig] = None, aot_cache: Optional[AotCache] = None):
         self._metric = metric
         self._cfg = config or EngineConfig()
-        reason = metric.masked_update_unsupported_reason()
+        reason = self._serving_unsupported_reason(metric)
         if reason is not None:
             raise MetricsTPUUserError(
                 f"metric cannot be served by the streaming engine: {reason}"
@@ -124,16 +165,43 @@ class StreamingEngine:
             raise MetricsTPUUserError("snapshot_every > 0 requires snapshot_dir")
         self._queue: "queue.Queue" = queue.Queue(maxsize=max(1, self._cfg.max_queue))
         self._program_memo: Dict[Tuple, Any] = {}
+        # guards every read-modify-write of self._state against the
+        # dispatcher's step loop (which DONATES the live buffers): reset /
+        # restore / per-stream resets / state reads are atomic w.r.t. steps.
+        # RLock because _process_group's snapshot cadence re-enters
+        # _save_snapshot under the same lock.
+        self._state_lock = threading.RLock()
         self._inflight: "deque" = deque()
         self._worker: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
         self._step = 0
         self._batches_done = 0
-        self._state = self._put_state(metric.init_state())
+        self._layout: Optional[ArenaLayout] = (
+            ArenaLayout.for_state(self._abstract_state_tree()) if self._cfg.use_arena else None
+        )
+        # metrics that DERIVE compute attrs from data (Accuracy's input-mode
+        # latch) must latch before any program key is built — see
+        # _latch_host_attrs. No declared attrs (the common case) = no cost.
+        self._needs_attr_latch = any(
+            v is None for v in metric.host_compute_attrs().values()
+        )
+        self._state = self._put_state(self._init_state_tree())
         self._donate = bool(self._cfg.donate) and jax.default_backend() != "cpu"
         self._serialize = (
             self._cfg.mesh is not None and self._cfg.mesh.devices.flat[0].platform == "cpu"
         )
+
+    # -------------------------------------------------------------- capability checks
+
+    def _serving_unsupported_reason(self, metric: Any) -> Optional[str]:
+        reason = metric.masked_update_unsupported_reason()
+        if reason is not None:
+            return reason
+        if self._cfg is not None and self._cfg.mesh is not None:
+            r = _mesh_step_unsupported_reason(metric)
+            if r is not None:
+                return r
+        return None
 
     # ------------------------------------------------------------------ mesh helpers
 
@@ -151,15 +219,36 @@ class StreamingEngine:
 
         return NamedSharding(self._cfg.mesh, P(self._cfg.axis))
 
-    def _put_state(self, state: Any) -> Any:
-        """Device-commit a state pytree (replicated over the mesh, if any)."""
+    # ----------------------------------------------------------------- state plumbing
+
+    def _init_state_tree(self) -> Any:
+        """Fresh logical (UNPACKED) state pytree."""
+        return self._metric.init_state()
+
+    def _abstract_state_tree(self) -> Any:
+        """``ShapeDtypeStruct`` pytree of the logical state (no sharding)."""
+        return self._metric.abstract_state()
+
+    def _pack(self, tree: Any) -> Any:
+        return self._layout.pack(tree) if self._layout is not None else tree
+
+    def _unpack(self, carried: Any) -> Any:
+        return self._layout.unpack(carried) if self._layout is not None else carried
+
+    def _put_state(self, state: Any, packed: bool = False) -> Any:
+        """Device-commit a state (replicated over the mesh, if any). ``state``
+        is the logical pytree unless ``packed`` says it is already an arena."""
+        if not packed:
+            state = self._pack(jax.tree.map(jnp.asarray, state))
         if self._cfg.mesh is None:
             return jax.tree.map(jnp.asarray, state)
         rep = self._replicated_sharding()
         return jax.tree.map(lambda x: jax.device_put(jnp.asarray(x), rep), state)
 
     def _abstract_state(self) -> Any:
-        abs_state = self._metric.abstract_state()
+        """The CARRIED state's lowering template: packed arena (or logical
+        pytree), with replicated shardings under a mesh."""
+        abs_state = self._layout.abstract() if self._layout is not None else self._metric.abstract_state()
         if self._cfg.mesh is None:
             return abs_state
         rep = self._replicated_sharding()
@@ -186,8 +275,13 @@ class StreamingEngine:
             payload,
         )
         mask_abs = jax.ShapeDtypeStruct(mask.shape, np.dtype(bool))
+        # the CARRIED-state template is part of the program's identity: two
+        # engines sharing a cache but differing in use_arena (or stream
+        # count) take different state pytrees through the same payload
+        # signature — omitting it hands one the other's executable
         key = self._aot.program_key(
-            "update", self._metric_fp, arg_tree=(payload_abs, mask_abs),
+            self._update_kind(), self._metric_fp,
+            arg_tree=(self._abstract_state(), payload_abs, mask_abs),
             mesh=self._cfg.mesh, donate=self._donate,
         )
         prog = self._aot.get_or_compile(
@@ -196,30 +290,43 @@ class StreamingEngine:
         self._program_memo[memo_key] = prog
         return prog
 
+    def _update_kind(self) -> str:
+        return "update"
+
+    def _traced_update(self, state_tree: Any, payload: Any, mask: Any) -> Any:
+        """The step body on the LOGICAL state tree (inside jit). Subclasses
+        reroute this (multi-stream segmented updates)."""
+        a, kw = payload
+        return self._metric.update_state_masked(state_tree, *a, mask=mask, **kw)
+
     def _build_update_program(self, payload_abs: Any, mask_abs: Any):
         """Compile ``(state, payload, mask) -> (new_state, token)``.
 
-        ``token`` is the step's global valid-row count — a tiny NON-donated
-        output the dispatcher can block on to bound in-flight depth (the state
-        itself may already have been donated into the NEXT step by the time
-        the dispatcher needs to wait, and a donated buffer cannot be synced
-        on). It doubles as a liveness cross-check in telemetry.
+        ``state`` is the carried form — the packed per-dtype arena by default;
+        the body unpacks it with static slices, runs the masked update, and
+        repacks (both ends fuse away). ``token`` is the step's global
+        valid-row count — a tiny NON-donated output the dispatcher can block
+        on to bound in-flight depth (the state itself may already have been
+        donated into the NEXT step by the time the dispatcher needs to wait,
+        and a donated buffer cannot be synced on). It doubles as a liveness
+        cross-check in telemetry.
         """
-        metric = self._metric
-        mesh, axis = self._cfg.mesh, self._cfg.axis
+        mesh = self._cfg.mesh
 
         if mesh is None:
             def step(state, payload, mask):
-                a, kw = payload
-                new_state = metric.update_state_masked(state, *a, mask=mask, **kw)
-                return new_state, jnp.sum(mask.astype(jnp.int32))
+                tree = self._unpack(state)
+                new_tree = self._traced_update(tree, payload, mask)
+                return self._pack(new_tree), jnp.sum(mask.astype(jnp.int32))
 
             jitted = jax.jit(step, donate_argnums=(0,) if self._donate else ())
             return jitted.lower(self._abstract_state(), payload_abs, mask_abs).compile()
 
         from metrics_tpu.parallel.embedded import sharded_masked_step
 
-        sharded = sharded_masked_step(metric, mesh, axis, payload_abs, mask_abs)
+        sharded = sharded_masked_step(
+            self._metric, mesh, self._cfg.axis, payload_abs, mask_abs, layout=self._layout
+        )
         jitted = jax.jit(sharded, donate_argnums=(0,) if self._donate else ())
         n_rows = mask_abs.shape[0]
         batch_sh = self._batch_sharding()
@@ -238,12 +345,15 @@ class StreamingEngine:
 
     def _compute_program(self):
         key = self._aot.program_key(
-            "compute", self._metric_fp, arg_tree=self._metric.abstract_state(),
+            "compute", self._metric_fp, arg_tree=self._abstract_state(),
             mesh=self._cfg.mesh, donate=False,
         )
-        metric = self._metric
+        metric, unpack = self._metric, self._unpack
         return self._aot.get_or_compile(
-            key, lambda: jax.jit(metric.compute_from).lower(self._abstract_state()).compile()
+            key,
+            lambda: jax.jit(lambda state: metric.compute_from(unpack(state)))
+            .lower(self._abstract_state())
+            .compile(),
         )
 
     # --------------------------------------------------------------------- lifecycle
@@ -289,21 +399,25 @@ class StreamingEngine:
         """Block until every submitted batch is folded into the state."""
         self._raise_if_failed()
         self._queue.join()
-        jax.block_until_ready(self._state)
+        with self._state_lock:  # a concurrent step must not donate the
+            jax.block_until_ready(self._state)  # buffers out from under us
         self._raise_if_failed()
 
     def result(self) -> Any:
         """Flush, then run the AOT-compiled compute on the accumulated state."""
         self.flush()
-        return self._compute_program()(self._state)
+        with self._state_lock:
+            return self._compute_program()(self._state)
 
     def state(self) -> Any:
-        """A defensive copy of the accumulated (global) state pytree, after a
-        flush. Copied because the live buffers are DONATED into the next
-        update step — a borrowed reference would read as deleted after the
-        caller submits more traffic."""
+        """A defensive copy of the accumulated (global) LOGICAL state pytree,
+        after a flush. Copied because the live buffers are DONATED into the
+        next update step — a borrowed reference would read as deleted after
+        the caller submits more traffic. Arenas are unpacked: callers see the
+        metric's own state layout either way."""
         self.flush()
-        return jax.tree.map(lambda x: jnp.array(x, copy=True), self._state)
+        with self._state_lock:
+            return jax.tree.map(lambda x: jnp.array(x, copy=True), self._unpack(self._state))
 
     @property
     def steps(self) -> int:
@@ -317,6 +431,10 @@ class StreamingEngine:
     def aot_cache(self) -> AotCache:
         return self._aot
 
+    @property
+    def arena_layout(self) -> Optional[ArenaLayout]:
+        return self._layout
+
     def telemetry(self) -> Dict[str, Any]:
         return self._stats.summary(self._aot.stats())
 
@@ -324,11 +442,20 @@ class StreamingEngine:
         self._stats.export(path, self._aot.stats())
 
     def reset(self) -> None:
-        """Fresh accumulation (flushes first); compiled programs are kept."""
-        self.flush()
-        self._state = self._put_state(self._metric.init_state())
-        self._step = 0
-        self._batches_done = 0
+        """Fresh accumulation; compiled programs are kept.
+
+        Also the RECOVERY path for a sticky dispatcher failure (the other is
+        :meth:`restore`): the queue is drained — a failed dispatcher discards
+        the backlog without folding it — the error is cleared, and the
+        accumulation starts over. Without a failure this flushes normally
+        (every pending batch lands before the state is replaced)."""
+        self._queue.join()
+        with self._state_lock:
+            self._error = None
+            self._inflight.clear()
+            self._state = self._put_state(self._init_state_tree())
+            self._step = 0
+            self._batches_done = 0
 
     # ---------------------------------------------------------------------- recovery
 
@@ -340,7 +467,11 @@ class StreamingEngine:
         return self._save_snapshot()
 
     def _save_snapshot(self) -> str:
-        host_state = jax.device_get(self._state)
+        with self._state_lock:
+            return self._save_snapshot_locked()
+
+    def _save_snapshot_locked(self) -> str:
+        host_state = jax.device_get(self._state)  # the carried form: arena = 1 payload/dtype
         path = save_snapshot(
             self._cfg.snapshot_dir,
             host_state,
@@ -349,8 +480,11 @@ class StreamingEngine:
                 "batches_done": self._batches_done,
                 "rows_in": self._stats.rows_in,
                 "rows_padded": self._stats.rows_padded,
+                "packed": int(self._layout is not None),
+                "arena_fp": self._layout.fingerprint() if self._layout is not None else "",
             },
             keep=self._cfg.snapshot_keep,
+            host_attrs=self._metric.host_compute_attrs(),
         )
         self._stats.snapshots += 1
         return path
@@ -360,55 +494,309 @@ class StreamingEngine:
 
         Returns the snapshot's meta dict — ``batches_done`` is the replay
         cursor: re-submit the stream from that batch onward and the final
-        result is exactly the uninterrupted one.
+        result is exactly the uninterrupted one. Host-derived compute
+        attributes (e.g. ``Accuracy``'s input-mode latch) are restored too,
+        so ``result()`` works immediately — no post-restore batch needed.
+
+        Also a RECOVERY path for a sticky dispatcher failure: the backlog is
+        drained unfolded and the error is cleared once the snapshot state is
+        committed (a failed load leaves the engine — error included — as it
+        was).
         """
-        self.flush()
+        self._queue.join()  # drain; a sticky-failed dispatcher discards
         state, meta = load_snapshot(directory_or_path or self._cfg.snapshot_dir)
-        self._state = self._put_state(state)
-        self._step = int(meta.get("step", 0))
-        self._batches_done = int(meta.get("batches_done", self._step))
-        self._stats.rows_in = int(meta.get("rows_in", self._stats.rows_in))
-        self._stats.rows_padded = int(meta.get("rows_padded", self._stats.rows_padded))
-        self._stats.resumes += 1
+        # VALIDATE before mutating anything: a failed restore must leave the
+        # live engine (metric attrs, fingerprint, memo, state) untouched
+        packed = bool(int(meta.get("packed", 0)))
+        if packed:
+            if self._layout is None:
+                raise MetricsTPUUserError(
+                    "snapshot holds a packed arena but this engine runs with use_arena=False; "
+                    "enable the arena (or re-snapshot unpacked) to restore it"
+                )
+            # buffer shape/dtype check alone cannot catch permuted same-dtype
+            # leaves (identical buffers, scrambled unpack) — the layout
+            # FINGERPRINT in meta is the sufficient check
+            saved_fp = str(meta.get("arena_fp", "") or "")
+            if not self._layout.matches(state) or (saved_fp and saved_fp != self._layout.fingerprint()):
+                raise MetricsTPUUserError(
+                    "snapshot arena does not match this metric's layout "
+                    f"({self._layout!r}); was the metric reconfigured since the snapshot?"
+                )
+        # device-commit FIRST: on the unpacked path _put_state packs, which is
+        # the last fallible step — the metric must not be mutated before it
+        new_state = self._put_state(state, packed=packed)
+        with self._state_lock:
+            attrs = meta.get("host_attrs")
+            if attrs:
+                self._metric.restore_host_compute_attrs(attrs)
+                # the fingerprint covers host attrs (they are trace constants);
+                # re-derive it so program keys reflect the restored values
+                # (live engines derive the same post-latch fingerprint via
+                # _latch_host_attrs on their first batch)
+                self._metric_fp = metric_fingerprint(self._metric)
+                self._program_memo.clear()
+            # a pre-traffic snapshot restores attrs that are still None — the
+            # first-batch latch must stay armed for those, or two restored
+            # engines sharing a cache could collide on an unlatched key
+            self._needs_attr_latch = any(
+                v is None for v in self._metric.host_compute_attrs().values()
+            )
+            self._state = new_state
+            self._error = None
+            self._inflight.clear()
+            # the replay cursor commits in the SAME critical section as the
+            # state: a batch the dispatcher folds right after the lock drops
+            # must land on top of both, or replay double-counts it
+            self._step = int(meta.get("step", 0))
+            self._batches_done = int(meta.get("batches_done", self._step))
+            self._stats.rows_in = int(meta.get("rows_in", self._stats.rows_in))
+            self._stats.rows_padded = int(meta.get("rows_padded", self._stats.rows_padded))
+            self._stats.resumes += 1
         return meta
 
     # -------------------------------------------------------------------- dispatcher
 
     def _run(self) -> None:
+        pending: Optional[Any] = None
         while True:
-            item = self._queue.get()
+            if pending is not None:
+                first, wait_us = pending, 0.0
+                pending = None
+            else:
+                t0 = time.perf_counter()
+                first = self._queue.get()
+                wait_us = (time.perf_counter() - t0) * 1e6
+            if first is _STOP:
+                self._queue.task_done()
+                return
+            group, pending, saw_stop = [first], None, False
+            if self._error is None:
+                group, pending, saw_stop, drain_wait_us = self._coalesce_group(first)
+                wait_us += drain_wait_us  # window blocking is queue wait too
             try:
-                if item is _STOP:
-                    return
                 if self._error is None:  # after a failure: drain without work
-                    self._process(*item)
+                    self._process_group(group, wait_us)
             except BaseException as e:  # noqa: BLE001 - surfaced via _raise_if_failed
                 self._error = e
             finally:
+                for _ in group:
+                    self._queue.task_done()
+            if saw_stop:
                 self._queue.task_done()
+                return
 
-    def _process(self, args: Tuple[Any, ...], kwargs: Dict[str, Any]) -> None:
-        n = infer_batch_size((args, kwargs))  # same inference pad_chunk uses
+    # ------------------------------------------------------------------- coalescing
+
+    def _item_rows(self, item: Any) -> int:
+        n = infer_batch_size(item)
         if n is None:
-            raise MetricsTPUUserError("submit() needs at least one array argument with a batch dimension")
-        # an empty tail batch is a no-op, not a poison pill — it contributes no
-        # steps but still advances the replay cursor (and snapshot cadence)
-        for start, stop, bucket in self._policy.chunks(int(n)) if n else []:
-            t0 = time.perf_counter()
-            a, kw, mask = self._policy.pad_chunk(args, kwargs, start, stop, bucket)
-            payload, mask_dev = self._upload((a, kw), mask)
-            ingest_us = (time.perf_counter() - t0) * 1e6  # pad+upload only, not compile
-            program = self._update_program(payload, mask)
-            depth = self._queue.qsize()
-            new_state, token = program(self._state, payload, mask_dev)
-            self._state = new_state
-            self._step += 1
-            sync_us = self._bound_inflight(token)
-            self._stats.record_step(
-                bucket=bucket, valid=stop - start, queue_depth=depth,
-                ingest_us=ingest_us, sync_us=sync_us,
+            raise MetricsTPUUserError(
+                "submit() needs at least one array argument with a batch dimension"
             )
-        self._batches_done += 1
+        return int(n)
+
+    def _item_rows_safe(self, item: Any) -> Optional[int]:
+        """Row count, or None for malformed items — used on the coalesce path,
+        which must never raise (errors surface through the processing path's
+        sticky-failure machinery instead)."""
+        try:
+            return self._item_rows(item)
+        except Exception:  # noqa: BLE001
+            return None
+
+    def _coalesce_group(self, first: Any) -> Tuple[List[Any], Optional[Any], bool, float]:
+        """Opportunistically drain further compatible queued batches behind
+        ``first``. Returns ``(group, pending_incompatible_item, saw_stop,
+        drain_wait_us)`` — the last is time spent BLOCKED waiting for more
+        traffic inside the coalesce window, reported so the telemetry's
+        queue-wait share (and the regime label) stays honest when
+        ``coalesce_window_ms > 0``. Bounded three ways: ``config.coalesce``
+        batches, the top bucket's row count (a fuller megabatch would just
+        re-chunk), and the next snapshot boundary (cadence must stay
+        batch-exact)."""
+        limit = max(1, int(self._cfg.coalesce))
+        if self._cfg.snapshot_every > 0:
+            limit = min(
+                limit,
+                self._cfg.snapshot_every - (self._batches_done % self._cfg.snapshot_every),
+            )
+        group = [first]
+        if limit <= 1:
+            return group, None, False, 0.0
+        rows = self._item_rows_safe(first)
+        if rows is None:  # malformed: run alone so the error surfaces cleanly
+            return group, None, False, 0.0
+        top = self._policy.buckets[-1]
+        deadline = time.perf_counter() + self._cfg.coalesce_window_ms / 1e3
+        waited = 0.0
+        ref = first if rows else None
+        while len(group) < limit and rows < top:
+            try:
+                timeout = deadline - time.perf_counter()
+                if timeout > 0:
+                    t0 = time.perf_counter()
+                    try:
+                        item = self._queue.get(timeout=timeout)
+                    finally:
+                        waited += time.perf_counter() - t0
+                else:
+                    item = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if item is _STOP:
+                return group, None, True, waited * 1e6
+            n = self._item_rows_safe(item)
+            if n is None:
+                return group, item, False, waited * 1e6
+            if n == 0:
+                group.append(item)  # cursor-only; nothing to concatenate
+                continue
+            if ref is not None and not self._coalescible(ref, item):
+                return group, item, False, waited * 1e6
+            if ref is None:
+                ref = item
+            group.append(item)
+            rows += n
+        return group, None, False, waited * 1e6
+
+    def _coalescible(self, ref: Any, item: Any) -> bool:
+        """Can ``item`` concatenate behind ``ref`` into one megabatch? Same
+        pytree structure, batch-carried leaves agreeing on trailing shape and
+        dtype, and non-batch (broadcast/config) leaves EQUAL — a differing
+        broadcast argument changes the math and must run as its own step.
+
+        MUST NOT RAISE (it runs outside the dispatcher's sticky-error capture;
+        an escape would kill the thread and deadlock ``flush``): any exotic
+        leaf that breaks a probe just doesn't coalesce — the item then runs as
+        its own step, where a genuine error surfaces through the normal path.
+        """
+        try:
+            ref_leaves, ref_def = jax.tree_util.tree_flatten(ref)
+            leaves, treedef = jax.tree_util.tree_flatten(item)
+            if treedef != ref_def or len(leaves) != len(ref_leaves):
+                return False
+            n_ref = infer_batch_size(ref_leaves)
+            n_item = infer_batch_size(leaves)
+            for rl, il in zip(ref_leaves, leaves):
+                rb, ib = is_batch_leaf(rl, n_ref), is_batch_leaf(il, n_item)
+                if rb != ib:
+                    return False
+                if rb:
+                    if rl.shape[1:] != il.shape[1:] or np.dtype(rl.dtype) != np.dtype(il.dtype):
+                        return False
+                elif not _aux_leaves_equal(rl, il):
+                    return False
+            return True
+        except Exception:  # noqa: BLE001 - don't coalesce what we can't probe
+            return False
+
+    def _merge_sized(
+        self, nonempty: List[Tuple[Any, int]]
+    ) -> Optional[Tuple[Tuple[Any, ...], Dict[str, Any]]]:
+        """Concatenate pre-sized non-empty items into one (args, kwargs)
+        megabatch (host numpy; this runs on the dispatcher thread, overlapped
+        with the device via async dispatch). None when the group was all
+        empty. Row counts come in from the caller — each item is tree-
+        flattened for sizing exactly once per dispatch."""
+        return self._concat_sized(nonempty)
+
+    @staticmethod
+    def _concat_sized(
+        nonempty: List[Tuple[Any, int]],
+    ) -> Optional[Tuple[Tuple[Any, ...], Dict[str, Any]]]:
+        if not nonempty:
+            return None
+        if len(nonempty) == 1:
+            return nonempty[0][0]
+        flat = [jax.tree_util.tree_flatten(it) for it, _ in nonempty]
+        treedef = flat[0][1]
+        n0 = nonempty[0][1]
+        out_leaves: List[Any] = []
+        for i, leaf0 in enumerate(flat[0][0]):
+            if is_batch_leaf(leaf0, n0):
+                out_leaves.append(
+                    np.concatenate([np.asarray(leaves[i]) for leaves, _ in flat], axis=0)
+                )
+            else:
+                out_leaves.append(leaf0)
+        return jax.tree_util.tree_unflatten(treedef, out_leaves)
+
+    # -------------------------------------------------------------------- processing
+
+    def _process_group(self, group: List[Any], queue_wait_us: float) -> None:
+        with self._state_lock:
+            self._process_group_locked(group, queue_wait_us)
+
+    def _latch_payload(self, merged: Any) -> Tuple[Tuple[Any, ...], Dict[str, Any]]:
+        """The (args, kwargs) a host-attr latch row is sliced from (subclasses
+        strip engine-internal leading arguments, e.g. stream ids)."""
+        return merged
+
+    def _latch_host_attrs(self, merged: Any) -> None:
+        """Latch host-derived compute attrs (``Metric.host_compute_attrs``)
+        from live data with ONE eager 1-row update, BEFORE any program key is
+        built. The latched values are trace constants, so they must be part of
+        every program's identity: without this, two engines sharing an
+        ``AotCache`` but serving different input modes would collide on a
+        compute program with the WRONG constant baked in (same fingerprint,
+        same state signature, silently wrong value) — and a fully warm engine
+        (every program a cache hit, nothing ever traced) would never latch at
+        all. The eager row's state delta is discarded; only the facade's
+        attrs (and the refreshed fingerprint) survive."""
+        args, kwargs = self._latch_payload(merged)
+        n = self._item_rows((args, kwargs))
+        leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+        row = [leaf[:1] if is_batch_leaf(leaf, n) else leaf for leaf in leaves]
+        a, kw = jax.tree_util.tree_unflatten(treedef, row)
+        # a failing latch row leaves the latch ARMED: the raise becomes the
+        # sticky dispatcher error, and the first good batch after recovery
+        # (reset) latches properly — consuming the latch on failure would
+        # bake the unlatched fingerprint into every later program key
+        self._metric.update_state(self._metric.init_state(), *a, **kw)
+        self._needs_attr_latch = False
+        self._metric_fp = metric_fingerprint(self._metric)
+        self._program_memo.clear()
+
+    def _process_group_locked(self, group: List[Any], queue_wait_us: float) -> None:
+        # size each item ONCE; the sizes feed the empty filter, the merge's
+        # concat, the chunker, and the coalesce telemetry
+        sized = [(it, self._item_rows(it)) for it in group]
+        nonempty = [(it, n) for it, n in sized if n > 0]
+        merged = self._merge_sized(nonempty)
+        # an empty group (zero-row tail batches) is a no-op, not a poison
+        # pill — it contributes no steps but still advances the replay cursor
+        if merged is not None:
+            if self._needs_attr_latch:
+                self._latch_host_attrs(merged)
+            args, kwargs = merged
+            n = sum(rows for _, rows in nonempty)
+            # coalesced = batches whose ROWS share this dispatch (cursor-only
+            # empties don't count — no concatenation happened for them)
+            n_coalesced = len(nonempty)
+            first_chunk = True
+            for start, stop, bucket in self._policy.chunks(int(n)):
+                t0 = time.perf_counter()
+                a, kw, mask = self._policy.pad_chunk(args, kwargs, start, stop, bucket)
+                t_pad = time.perf_counter()
+                payload, mask_dev = self._upload((a, kw), mask)
+                ingest_us = (time.perf_counter() - t0) * 1e6  # pad+upload only, not compile
+                program = self._update_program(payload, mask)
+                depth = self._queue.qsize()
+                new_state, token = program(self._state, payload, mask_dev)
+                self._state = new_state
+                self._step += 1
+                sync_us = self._bound_inflight(token)
+                self._stats.record_step(
+                    bucket=bucket, valid=stop - start, queue_depth=depth,
+                    ingest_us=ingest_us, sync_us=sync_us,
+                    pad_us=(t_pad - t0) * 1e6,
+                    queue_wait_us=queue_wait_us if first_chunk else 0.0,
+                    wall_us=(time.perf_counter() - t0) * 1e6,
+                    coalesced=n_coalesced if first_chunk else 1,
+                )
+                first_chunk = False
+        self._batches_done += len(group)
         if (
             self._cfg.snapshot_every > 0
             and self._batches_done % self._cfg.snapshot_every == 0
@@ -447,3 +835,45 @@ class StreamingEngine:
         t0 = time.perf_counter()
         jax.block_until_ready(oldest)
         return (time.perf_counter() - t0) * 1e6
+
+
+def _aux_leaves_equal(a: Any, b: Any) -> bool:
+    """Equality for non-batch (broadcast/config) leaves, cheap and safe:
+    unequal-on-doubt so an uncertain comparison costs one un-coalesced step,
+    never a wrong result."""
+    if a is b:
+        return True
+    try:
+        if isinstance(a, (np.ndarray, jnp.ndarray)) or isinstance(b, (np.ndarray, jnp.ndarray)):
+            # reject on metadata BEFORE materializing anything: np.asarray of
+            # a large (or device-resident) aux leaf would cost more than the
+            # dispatch the merge saves
+            a_shape, b_shape = getattr(a, "shape", None), getattr(b, "shape", None)
+            a_dtype, b_dtype = getattr(a, "dtype", None), getattr(b, "dtype", None)
+            if a_shape != b_shape or a_dtype != b_dtype:
+                return False
+            if int(np.prod(a_shape)) > _COALESCE_AUX_COMPARE_CAP:
+                return False
+            return bool(np.array_equal(np.asarray(a), np.asarray(b)))
+        return bool(a == b)
+    except Exception:  # noqa: BLE001 - any exotic leaf: just don't coalesce
+        return False
+
+
+def _mesh_step_unsupported_reason(metric: Any) -> Optional[str]:
+    """Mesh steps merge per-shard DELTAS (masked update from a fresh state,
+    psum-synced, merged into the carry) — exact for delta/custom masked
+    strategies, but NOT for scan-fallback members, whose states (e.g. the
+    static-capacity curve buffers) do not merge by their reduction."""
+    strategies = (
+        metric.masked_update_strategies()
+        if hasattr(metric, "masked_update_strategies")
+        else {type(metric).__name__: metric.masked_update_strategy()}
+    )
+    for name, s in strategies.items():
+        if s == "scan":
+            return (
+                f"member {name!r} needs the sequential masked fallback, which has no "
+                "exact mesh (shard-and-merge) form; serve it on a single device"
+            )
+    return None

@@ -4,12 +4,15 @@ The CI-shaped proof of the engine's three core claims, in seconds on one CPU
 device (``make engine-smoke``):
 
 1. correctness — streaming ragged batches through bucketed masked updates
+   (with state arenas and megabatch coalescing at their serving defaults)
    equals the plain eager update loop;
 2. closed program set — the first run compiles at most ``len(buckets)`` update
    programs (+1 compute), the warm second run compiles NOTHING (in-process
    AOT cache hit on every step);
 3. the JAX persistent compilation cache dir is populated, so a warm process
-   restart skips XLA compiles too.
+   restart skips XLA compiles too;
+4. the arena invariant — the carried state packs to ≤ 3 donated buffers
+   (one per dtype class), however many metrics the collection serves.
 
 Writes the second run's telemetry JSON (pretty-print with
 ``tools/engine_report.py``) and prints one PASS line. Exits nonzero on any
@@ -66,6 +69,12 @@ def main(out_path: str = "engine_telemetry.json") -> int:
     warm_misses = cache.misses - cold_misses
 
     ok = True
+    # arena invariant: the whole collection's state packs to one donated
+    # buffer per dtype class (ISSUE 3 tentpole)
+    layout = MetricCollection([Accuracy(), MeanSquaredError()]).arena_layout()
+    if layout.num_buffers > 3 or layout.num_leaves <= layout.num_buffers:
+        print(f"FAIL: arena invariant broken (no per-dtype collapse): {layout!r}")
+        ok = False
     for k, v in want.items():
         if abs(got_cold[k] - v) > 1e-6 or abs(got_warm[k] - v) > 1e-6:
             print(f"FAIL: {k} engine={got_cold[k]}/{got_warm[k]} eager={v}")
@@ -84,6 +93,7 @@ def main(out_path: str = "engine_telemetry.json") -> int:
         print(
             f"engine-smoke PASS: {len(batches)} ragged batches == eager; "
             f"cold compiles={cold_misses} (cap {len(buckets) + 1}), warm compiles=0, "
+            f"arena buffers={layout.num_buffers} (cap 3), "
             f"persistent cache entries={persisted}; telemetry -> {out_path}"
         )
     return 0 if ok else 1

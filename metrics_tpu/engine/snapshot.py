@@ -22,11 +22,22 @@ Older snapshots beyond ``keep`` are garbage-collected after the pointer moves.
 The payload rides the same orbax machinery as ``utils/checkpoint.py`` (numpy-
 ified state pytree; pickle fallback when orbax is absent), plus a ``meta``
 subtree carrying the step counter and row counts the engine needs to resume.
+With state arenas (``engine/arena.py``) the state subtree is the arena dict
+itself — ONE payload array per dtype, however many metrics the engine serves.
+
+``host_attrs`` rides alongside: compute-relevant attributes a metric derives
+from DATA during update (``Metric.host_compute_attrs`` — e.g. ``Accuracy``'s
+input-mode latch) serialize as a JSON byte array (enums encoded by class
+path + value), so a restored engine computes immediately — no "one
+post-restore batch" warmup.
 """
+import importlib
+import json
 import os
 import pickle
 import shutil
 import time
+from enum import Enum
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -37,6 +48,57 @@ from metrics_tpu.utils.imports import _ORBAX_AVAILABLE
 __all__ = ["save_snapshot", "load_snapshot", "latest_snapshot"]
 
 _LATEST = "LATEST"
+
+
+def _encode_host_attr(v: Any) -> Any:
+    """JSON-able encoding of one host-derived attribute value. Enums (e.g.
+    ``DataType``) carry their class path so decode restores the REAL enum
+    member, not a lookalike string; ndarrays and tuples round-trip typed.
+    A value outside the supported set raises with the offending type named —
+    better a loud error at declaration-test time than a sticky dispatcher
+    failure at the first snapshot boundary in production."""
+    if isinstance(v, Enum):
+        return {"__enum__": [type(v).__module__, type(v).__qualname__], "value": v.value}
+    if isinstance(v, np.generic):
+        return v.item()
+    if isinstance(v, np.ndarray):
+        return {"__ndarray__": v.tolist(), "dtype": v.dtype.str}
+    if isinstance(v, tuple):
+        return {"__tuple__": [_encode_host_attr(x) for x in v]}
+    if isinstance(v, list):
+        return [_encode_host_attr(x) for x in v]
+    if isinstance(v, (bool, int, float, str, type(None))):
+        return v
+    raise TypeError(
+        f"host-derived compute attr of type {type(v).__name__} is not snapshot-"
+        "serializable; supported: scalars, strings, None, enums, tuples/lists, ndarrays"
+    )
+
+
+def _decode_host_attr(v: Any) -> Any:
+    if isinstance(v, dict) and "__enum__" in v:
+        module, qualname = v["__enum__"]
+        cls: Any = importlib.import_module(module)
+        for part in qualname.split("."):
+            cls = getattr(cls, part)
+        return cls(v["value"])
+    if isinstance(v, dict) and "__ndarray__" in v:
+        return np.asarray(v["__ndarray__"], np.dtype(v["dtype"]))
+    if isinstance(v, dict) and "__tuple__" in v:
+        return tuple(_decode_host_attr(x) for x in v["__tuple__"])
+    if isinstance(v, list):
+        return [_decode_host_attr(x) for x in v]
+    return v
+
+
+def _host_attrs_to_bytes(attrs: Dict[str, Any]) -> np.ndarray:
+    doc = json.dumps({k: _encode_host_attr(v) for k, v in attrs.items()})
+    return np.frombuffer(doc.encode("utf-8"), np.uint8).copy()
+
+
+def _host_attrs_from_bytes(buf: Any) -> Dict[str, Any]:
+    doc = json.loads(bytes(np.asarray(buf, np.uint8)).decode("utf-8"))
+    return {k: _decode_host_attr(v) for k, v in doc.items()}
 
 
 def _to_numpy_tree(state: Any) -> Any:
@@ -50,14 +112,21 @@ def _to_jax_tree(state: Any) -> Any:
 
 
 def save_snapshot(
-    directory: str, state: Any, meta: Dict[str, Any], keep: int = 2
+    directory: str,
+    state: Any,
+    meta: Dict[str, Any],
+    keep: int = 2,
+    host_attrs: Optional[Dict[str, Any]] = None,
 ) -> str:
     """Write one complete snapshot and atomically advance ``LATEST``.
 
-    ``state`` is the engine's accumulated metric-state pytree (device or host
-    arrays); ``meta`` is a flat dict of ints/floats/strings (the step counter
-    and friends). Returns the snapshot's path. Keeps the newest ``keep``
-    snapshots, GCs the rest.
+    ``state`` is the engine's accumulated metric-state pytree — either the
+    logical per-leaf tree or a packed arena dict (one array per dtype); the
+    loader returns whichever was saved, verbatim. ``meta`` is a flat dict of
+    ints/floats/strings (the step counter and friends); ``host_attrs`` is the
+    metric's host-derived compute-attribute dict (JSON-encoded into the
+    payload, returned under ``meta["host_attrs"]`` on load). Returns the
+    snapshot's path. Keeps the newest ``keep`` snapshots, GCs the rest.
     """
     os.makedirs(directory, exist_ok=True)
     step = int(meta.get("step", 0))
@@ -72,6 +141,8 @@ def save_snapshot(
         "state": _to_numpy_tree(state),
         "meta": {k: np.asarray(v) if isinstance(v, (int, float)) else v for k, v in meta.items()},
     }
+    if host_attrs:
+        payload["host_attrs"] = _host_attrs_to_bytes(host_attrs)
     path = os.path.join(directory, name)
     if _ORBAX_AVAILABLE:
         import orbax.checkpoint as ocp
@@ -141,4 +212,6 @@ def load_snapshot(directory_or_path: str) -> Tuple[Any, Dict[str, Any]]:
         k: (int(v) if isinstance(v, np.ndarray) and v.dtype.kind in "iu" else v)
         for k, v in payload["meta"].items()
     }
+    if "host_attrs" in payload:
+        meta["host_attrs"] = _host_attrs_from_bytes(payload["host_attrs"])
     return _to_jax_tree(payload["state"]), meta

@@ -39,6 +39,8 @@ class EngineStats:
         self._ring: List[Optional[Dict[str, Any]]] = [None] * self.capacity
         self.steps = 0
         self.batches_submitted = 0
+        self.batches_coalesced = 0  # submitted batches folded into a shared step
+        self.megasteps = 0          # steps that carried > 1 submitted batch
         self.rows_in = 0
         self.rows_padded = 0
         self.snapshots = 0
@@ -52,6 +54,10 @@ class EngineStats:
         queue_depth: int,
         ingest_us: float,
         sync_us: Optional[float] = None,
+        pad_us: Optional[float] = None,
+        queue_wait_us: Optional[float] = None,
+        wall_us: Optional[float] = None,
+        coalesced: Optional[int] = None,
     ) -> None:
         rec = {
             "step": self.steps,
@@ -62,6 +68,17 @@ class EngineStats:
         }
         if sync_us is not None:
             rec["sync_us"] = round(sync_us, 1)
+        if pad_us is not None:
+            rec["pad_us"] = round(pad_us, 1)
+        if queue_wait_us is not None:
+            rec["queue_wait_us"] = round(queue_wait_us, 1)
+        if wall_us is not None:
+            rec["wall_us"] = round(wall_us, 1)
+        if coalesced is not None:
+            rec["coalesced"] = int(coalesced)
+            if coalesced > 1:
+                self.megasteps += 1
+                self.batches_coalesced += coalesced
         self._ring[self.steps % self.capacity] = rec
         self.steps += 1
         self.rows_in += valid
@@ -103,10 +120,57 @@ class EngineStats:
                 "p50": round(_percentile(syncs, 0.5), 1) if syncs else None,
                 "p95": round(_percentile(syncs, 0.95), 1) if syncs else None,
             },
+            "coalesce": {
+                "megasteps": self.megasteps,
+                "batches_coalesced": self.batches_coalesced,
+                "batches_per_step_mean": round(
+                    self.batches_submitted / self.steps, 3
+                ) if self.steps else None,
+            },
         }
+        shares = self._host_time_shares(recent)
+        if shares is not None:
+            out["host_time_shares"] = shares
         if aot_stats is not None:
             out["compile_cache"] = aot_stats
         return out
+
+    @staticmethod
+    def _host_time_shares(recent: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+        """Attribute the dispatcher's wall time over the ring window: padding,
+        queue wait (idle, producer-bound), blocked device sync (device-bound),
+        and the residual dispatch overhead (program-call + upload — the share
+        the arena/coalescing optimizations exist to amortize). The ``regime``
+        label is what ``tools/engine_report.py`` surfaces: a step loop is
+        *dispatch-bound* when the residual dominates, *pad-bound* when host
+        padding/concat does, *device-bound* when blocked sync does, *starved*
+        when the queue wait does."""
+        timed = [r for r in recent if "wall_us" in r]
+        if not timed:
+            return None
+        wall = sum(r["wall_us"] for r in timed)
+        wait = sum(r.get("queue_wait_us", 0.0) for r in timed)
+        pad = sum(r.get("pad_us", 0.0) for r in timed)
+        sync = sum(r.get("sync_us", 0.0) for r in timed)
+        total = wall + wait
+        if total <= 0:
+            return None
+        dispatch = max(0.0, wall - pad - sync)
+        shares = {
+            "pad": round(pad / total, 4),
+            "queue_wait": round(wait / total, 4),
+            "blocked_sync": round(sync / total, 4),
+            "dispatch": round(dispatch / total, 4),
+        }
+        regime = max(("dispatch", "pad", "queue_wait", "blocked_sync"), key=lambda k: shares[k])
+        shares["regime"] = {
+            "dispatch": "dispatch-bound",
+            "pad": "pad-bound",
+            "queue_wait": "starved",
+            "blocked_sync": "device-bound",
+        }[regime]
+        shares["window_steps"] = len(timed)
+        return shares
 
     def to_json(self, aot_stats: Optional[Dict[str, Any]] = None) -> str:
         return json.dumps({"summary": self.summary(aot_stats), "recent_steps": self.recent()}, indent=2)

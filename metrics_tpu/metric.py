@@ -321,8 +321,14 @@ class Metric:
         """Pure update: ``new_state = f(state, batch)``. Safe inside jit/scan/shard_map.
 
         Runs the subclass ``update`` body with ``state`` loaded into the instance, then
-        snapshots the result; instance state (incl. nested metrics' and host-side
-        caches) is restored afterwards, so this never mutates the facade.
+        snapshots the result; REGISTERED state (incl. nested metrics') and the
+        host-side bookkeeping caches are restored afterwards. Host-derived
+        compute attributes (``_host_derived_compute_attrs``, e.g.
+        ``Accuracy.mode``) deliberately KEEP whatever the update body latched —
+        they are data-derived trace constants, and the streaming engine's
+        first-batch latch (``engine/pipeline.py::_latch_host_attrs``) depends
+        on this side effect to fold them into program identities. Do not add
+        them to ``_BOOKKEEPING_ATTRS``.
         """
         saved = self._pack_state()
         book = self._snapshot_bookkeeping()
@@ -342,14 +348,32 @@ class Metric:
 
     _MASKED_FX = ("sum", "min", "max")
 
-    def masked_update_unsupported_reason(self) -> Optional[str]:
-        """None when :meth:`update_state_masked`'s generic path applies, else a
-        human-readable reason (list/cat states grow with data, custom reductions
-        have no row-neutral element). A subclass that overrides
-        :meth:`update_state_masked` has taken responsibility for masking and is
-        always supported."""
+    def masked_update_strategy(self) -> Optional[str]:
+        """How :meth:`update_state_masked` will run for this metric:
+
+        * ``"custom"`` — the subclass overrides it (fused masked form);
+        * ``"delta"`` — the generic vmapped row-delta path (states reduce with
+          sum/min/max, whose identity elements make pad rows inert);
+        * ``"scan"`` — the sequential fold fallback: array states with
+          reductions that have NO row-neutral identity (e.g. the static-
+          capacity curve buffers' ``cat`` writes) fold row-by-row through the
+          subclass ``update`` under ``lax.scan``, masked rows carrying the
+          state through unchanged. Exact whenever a batch update equals the
+          same rows applied one at a time — true for every array-state metric
+          here — at the cost of serializing the rows;
+        * ``None`` — not maskable (list states grow with data;
+          ``full_state_update`` reads the accumulated state per batch).
+        """
         if type(self).update_state_masked is not Metric.update_state_masked:
-            return None
+            return "custom"
+        if self._delta_masked_reason() is None:
+            return "delta"
+        if self._scan_masked_reason() is None:
+            return "scan"
+        return None
+
+    def _delta_masked_reason(self) -> Optional[str]:
+        """None when the vmapped row-delta masked path is exact."""
         if self.full_state_update:
             return "full_state_update metrics read the accumulated state in update; row deltas are not exact"
         for k, v in self._defaults.items():
@@ -360,10 +384,35 @@ class Metric:
         for name, child in self._child_metrics().items():
             children = child if isinstance(child, list) else [child]
             for c in children:
-                r = c.masked_update_unsupported_reason()
+                r = c._delta_masked_reason() if type(c).update_state_masked is Metric.update_state_masked else None
                 if r is not None:
                     return f"nested metric {name!r}: {r}"
         return None
+
+    def _scan_masked_reason(self) -> Optional[str]:
+        """None when the sequential scan-fold masked fallback is exact: every
+        state (recursively) is a fixed-shape array and update does not consume
+        whole-batch statistics (``full_state_update``)."""
+        if self.full_state_update:
+            return "full_state_update metrics read the accumulated state in update; a row fold is not exact"
+        for k, v in self._defaults.items():
+            if isinstance(v, list):
+                return f"state {k!r} is a list (cat/gather) state with no static shape"
+        for name, child in self._child_metrics().items():
+            children = child if isinstance(child, list) else [child]
+            for c in children:
+                if c.masked_update_strategy() is None:
+                    return f"nested metric {name!r}: {c._scan_masked_reason()}"
+        return None
+
+    def masked_update_unsupported_reason(self) -> Optional[str]:
+        """None when :meth:`update_state_masked` applies (any strategy), else a
+        human-readable reason. A subclass that overrides
+        :meth:`update_state_masked` has taken responsibility for masking and is
+        always supported."""
+        if self.masked_update_strategy() is not None:
+            return None
+        return self._scan_masked_reason() or self._delta_masked_reason()
 
     def update_state_masked(self, state: Dict[str, Any], *args: Any, mask: Array, **kwargs: Any) -> Dict[str, Any]:
         """Pure mask-aware update: rows of the leading batch axis where ``mask``
@@ -383,33 +432,80 @@ class Metric:
         Subclasses with a cheaper fused masked form (e.g. embedded-model
         metrics where per-row state copies would be prohibitive) override this.
         """
-        reason = self.masked_update_unsupported_reason()
-        if reason is not None:
+        strategy = self.masked_update_strategy()
+        if strategy is None:
             raise MetricsTPUUserError(
-                f"{type(self).__name__} has no mask-aware update: {reason}. "
+                f"{type(self).__name__} has no mask-aware update: "
+                f"{self.masked_update_unsupported_reason()}. "
                 "Override `update_state_masked` or stream it eagerly (unbucketed)."
             )
         mask = jnp.asarray(mask, bool)
-        n_rows = mask.shape[0]
+        if strategy == "scan":
+            return self._masked_update_scan(state, args, kwargs, mask)
+        stacked = self._stacked_row_deltas(args, kwargs, mask.shape[0])
+        return self._masked_reduce_into(state, stacked, mask)
+
+    def _split_batch_leaves(self, args: Any, kwargs: Any, n_rows: int):
+        """Flatten ``(args, kwargs)`` and classify leaves against ``n_rows``,
+        reshaping each batch-carried leaf to ``(n_rows, 1, ...)`` so a per-row
+        body sees exactly the batch-of-1 shapes the subclass validates.
+        Returns ``(leaves, in_axes, treedef)`` — ``in_axes[i]`` is 0 for
+        batch-carried leaves and None for broadcast leaves."""
         leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
         batched: List[Any] = []
         in_axes: List[Optional[int]] = []
         for leaf in leaves:
             if is_batch_leaf(leaf, n_rows):
-                # keep the original rank per row: each row is a batch of 1, so
-                # the subclass update sees exactly the shapes it validates
                 batched.append(jnp.reshape(jnp.asarray(leaf), (n_rows, 1) + leaf.shape[1:]))
                 in_axes.append(0)
             else:
                 batched.append(leaf)
                 in_axes.append(None)
+        return batched, in_axes, treedef
+
+    def _stacked_row_deltas(self, args: Any, kwargs: Any, n_rows: int) -> Dict[str, Any]:
+        """Row-stacked state deltas (leading axis = rows): the subclass update
+        vmapped over batch-of-1 rows — the finest batch partition, exact for
+        every delta-mergeable metric. Shared by the masked path (reduce over
+        rows) and the multi-stream segmented path (reduce into addressed
+        stream rows)."""
+        batched, in_axes, treedef = self._split_batch_leaves(args, kwargs, n_rows)
 
         def per_row(*row_leaves: Any) -> Dict[str, Any]:
             a, kw = jax.tree_util.tree_unflatten(treedef, list(row_leaves))
             return self.update_state(self.init_state(), *a, **kw)
 
-        stacked = jax.vmap(per_row, in_axes=tuple(in_axes))(*batched)
-        return self._masked_reduce_into(state, stacked, mask)
+        return jax.vmap(per_row, in_axes=tuple(in_axes))(*batched)
+
+    def _masked_update_scan(
+        self, state: Dict[str, Any], args: Any, kwargs: Any, mask: Array
+    ) -> Dict[str, Any]:
+        """Sequential masked fold for states with no row-neutral reduction
+        identity (``cat``-written static buffers and friends): ``lax.scan``
+        applies the subclass ``update`` one row at a time in submission order,
+        carrying the state through unchanged where ``mask`` is False. Exact
+        whenever a batch update equals its rows applied sequentially — the
+        contract every array-state metric here satisfies (the static-capacity
+        buffers write rows in order). Slower than the delta path (rows
+        serialize); the engine only takes it for members that need it."""
+        n_rows = mask.shape[0]
+        batched, in_axes, treedef = self._split_batch_leaves(args, kwargs, n_rows)
+        scanned = [b for b, ax in zip(batched, in_axes) if ax == 0]
+        state = jax.tree.map(jnp.asarray, state)
+
+        def fold(carry: Dict[str, Any], xs: Any):
+            row_scanned, m = xs
+            it = iter(row_scanned)
+            row_leaves = [next(it) if ax == 0 else b for b, ax in zip(batched, in_axes)]
+            a, kw = jax.tree_util.tree_unflatten(treedef, row_leaves)
+            new = self.update_state(carry, *a, **kw)
+            kept = jax.tree.map(
+                lambda nv, cv: jnp.where(m, nv, cv).astype(cv.dtype), new, carry
+            )
+            return kept, None
+
+        final, _ = jax.lax.scan(fold, state, (tuple(scanned), mask))
+        return final
 
     def _masked_reduce_into(self, state: Dict[str, Any], stacked: Dict[str, Any], mask: Array) -> Dict[str, Any]:
         """Fold row-stacked deltas (leading axis = rows) into ``state``, skipping
@@ -443,6 +539,151 @@ class Metric:
             else:  # pragma: no cover - guarded by masked_update_unsupported_reason
                 raise MetricsTPUUserError(f"no masked reduction for dist_reduce_fx={fx!r}")
         return out
+
+    # ------------------------------------------------- multi-stream serving hooks
+
+    def segmented_update_unsupported_reason(self) -> Optional[str]:
+        """None when :meth:`update_state_segmented` applies: the generic
+        row-delta path must hold (a custom fused masked form has no segmented
+        counterpart, and scan-fallback metrics would serialize rows per
+        stream — neither serves the one-executable multi-stream contract)."""
+        if type(self).update_state_masked is not Metric.update_state_masked:
+            return "custom update_state_masked override has no segmented form"
+        return self._delta_masked_reason()
+
+    def update_state_segmented(
+        self,
+        state: Dict[str, Any],
+        *args: Any,
+        mask: Array,
+        segment_ids: Array,
+        num_segments: int,
+        **kwargs: Any,
+    ) -> Dict[str, Any]:
+        """Pure multi-stream update: ``state`` leaves carry a leading stream
+        axis of length ``num_segments``; each batch row updates the stream row
+        addressed by ``segment_ids`` (masked-out rows update nothing).
+
+        This is the ``MultiStreamEngine`` step kernel
+        (``metrics_tpu/engine/multistream.py``): one executable serves S
+        independent streams by scatter-reducing the vmapped row deltas into
+        the addressed state rows with each reduction's own operation
+        (``.at[ids].add/min/max`` on an identity-filled base). Exact for the
+        same metrics as the delta masked path, stream-by-stream.
+        """
+        reason = self.segmented_update_unsupported_reason()
+        if reason is not None:
+            raise MetricsTPUUserError(
+                f"{type(self).__name__} has no segmented (multi-stream) update: {reason}."
+            )
+        mask = jnp.asarray(mask, bool)
+        segment_ids = jnp.asarray(segment_ids, jnp.int32)
+        stacked = self._stacked_row_deltas(args, kwargs, mask.shape[0])
+        return self._segment_reduce_into(state, stacked, mask, segment_ids, num_segments)
+
+    def _segment_reduce_into(
+        self,
+        state: Dict[str, Any],
+        stacked: Dict[str, Any],
+        mask: Array,
+        segment_ids: Array,
+        num_segments: int,
+    ) -> Dict[str, Any]:
+        """Scatter row-stacked deltas into the addressed stream rows of a
+        stream-stacked ``state``, skipping masked rows via each reduction's
+        identity element (masked rows carry pad ``segment_ids`` — the identity
+        makes their target row a no-op regardless)."""
+        out: Dict[str, Any] = {}
+        if self._CHILD_KEY in stacked:
+            children = self._child_metrics()
+            out[self._CHILD_KEY] = {}
+            for name, child_stacked in stacked[self._CHILD_KEY].items():
+                child = children.get(name)
+                child_state = state.get(self._CHILD_KEY, {}).get(name)
+                if isinstance(child, list):
+                    out[self._CHILD_KEY][name] = [
+                        c._segment_reduce_into(cs, cd, mask, segment_ids, num_segments)
+                        for c, cs, cd in zip(child, child_state, child_stacked)
+                    ]
+                else:
+                    out[self._CHILD_KEY][name] = child._segment_reduce_into(
+                        child_state, child_stacked, mask, segment_ids, num_segments
+                    )
+        for k in self._defaults:
+            fx = self._reductions[k]
+            s = stacked[k]
+            m = jnp.reshape(mask, (mask.shape[0],) + (1,) * (s.ndim - 1))
+            if fx == "sum":
+                seg = jnp.zeros((num_segments,) + s.shape[1:], s.dtype)
+                seg = seg.at[segment_ids].add(jnp.where(m, s, jnp.zeros_like(s)))
+                out[k] = state[k] + seg
+            elif fx == "min":
+                ident = _reduce_identity(s.dtype, "min")
+                seg = jnp.full((num_segments,) + s.shape[1:], ident, s.dtype)
+                seg = seg.at[segment_ids].min(jnp.where(m, s, ident))
+                out[k] = jnp.minimum(state[k], seg)
+            elif fx == "max":
+                ident = _reduce_identity(s.dtype, "max")
+                seg = jnp.full((num_segments,) + s.shape[1:], ident, s.dtype)
+                seg = seg.at[segment_ids].max(jnp.where(m, s, ident))
+                out[k] = jnp.maximum(state[k], seg)
+            else:  # pragma: no cover - guarded by segmented_update_unsupported_reason
+                raise MetricsTPUUserError(f"no segmented reduction for dist_reduce_fx={fx!r}")
+        return out
+
+    # --------------------------------------------------------- serving state hooks
+
+    def arena_layout(self) -> Any:
+        """Packing plan collapsing this metric's state pytree into one
+        contiguous buffer per dtype (``engine/arena.py``): the streaming
+        engine's step dispatch then carries 2–3 donated arrays instead of one
+        per state leaf. Pure metadata, derived from :meth:`abstract_state`."""
+        from metrics_tpu.engine.arena import ArenaLayout
+
+        return ArenaLayout.for_state(self.abstract_state())
+
+    #: compute-relevant attributes DERIVED FROM DATA during ``update`` (host
+    #: side, outside the registered state pytree) — e.g. ``Accuracy``'s input-
+    #: mode latch. Declared here so engine snapshots can persist and restore
+    #: them (``engine/snapshot.py``), making a restored engine computable
+    #: without replaying a batch first.
+    _host_derived_compute_attrs: "tuple[str, ...]" = ()
+
+    def host_compute_attrs(self) -> Dict[str, Any]:
+        """Flat ``{path: value}`` of declared host-derived compute attributes
+        for self and nested metrics (paths mirror the attribute tree)."""
+        out: Dict[str, Any] = {}
+        for a in self._host_derived_compute_attrs:
+            out[a] = getattr(self, a, None)
+        for name, child in self._child_metrics().items():
+            if isinstance(child, list):
+                for i, c in enumerate(child):
+                    for k, v in c.host_compute_attrs().items():
+                        out[f"{name}[{i}].{k}"] = v
+            else:
+                for k, v in child.host_compute_attrs().items():
+                    out[f"{name}.{k}"] = v
+        return out
+
+    def restore_host_compute_attrs(self, attrs: Dict[str, Any]) -> None:
+        """Inverse of :meth:`host_compute_attrs` — sets the declared
+        attributes on self and nested metrics; unknown paths are ignored (a
+        snapshot from an older metric layout must not crash restore)."""
+        for a in self._host_derived_compute_attrs:
+            if a in attrs:
+                setattr(self, a, attrs[a])
+        for name, child in self._child_metrics().items():
+            if isinstance(child, list):
+                for i, c in enumerate(child):
+                    prefix = f"{name}[{i}]."
+                    sub = {k[len(prefix):]: v for k, v in attrs.items() if k.startswith(prefix)}
+                    if sub:
+                        c.restore_host_compute_attrs(sub)
+            else:
+                prefix = f"{name}."
+                sub = {k[len(prefix):]: v for k, v in attrs.items() if k.startswith(prefix)}
+                if sub:
+                    child.restore_host_compute_attrs(sub)
 
     def compute_from(self, state: Dict[str, Any]) -> Any:
         """Pure compute on an explicit (already-merged) state pytree."""

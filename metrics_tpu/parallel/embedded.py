@@ -158,6 +158,7 @@ def sharded_masked_step(
     axis: AxisName,
     payload_abs,
     mask_abs,
+    layout=None,
 ) -> Callable:
     """Build the mesh-aware streaming-engine step for one bucket signature.
 
@@ -175,6 +176,11 @@ def sharded_masked_step(
     * ``token`` is the global valid-row count — a tiny non-donated output the
       dispatcher blocks on (the state itself is donated into the next step).
 
+    With ``layout`` (an ``engine.arena.ArenaLayout``) the carried state is the
+    PACKED per-dtype arena dict instead of the per-leaf pytree: the body
+    unpacks it with static slices (free after XLA fusion), and the step's
+    donated arguments drop to one buffer per dtype.
+
     The caller (``engine/pipeline.py``) jits, lowers and AOT-compiles this
     once per (bucket, mesh, dtype) — the serving-side closed-program contract.
     """
@@ -187,7 +193,8 @@ def sharded_masked_step(
         lambda s: P(axis) if is_batch_leaf(s, n_rows) else P(),
         payload_abs,
     )
-    state_specs = jax.tree.map(lambda _: P(), metric.abstract_state())
+    state_template = layout.abstract() if layout is not None else metric.abstract_state()
+    state_specs = jax.tree.map(lambda _: P(), state_template)
     axis_tuple = tuple(axis) if isinstance(axis, (tuple, list)) else (axis,)
 
     def body(state, payload, mask):
@@ -195,7 +202,8 @@ def sharded_masked_step(
         delta = metric.update_state_masked(metric.init_state(), *a, mask=mask, **kw)
         delta = metric.sync_states(delta, axis)  # psum/pmin/pmax the shard deltas
         token = jax.lax.psum(jnp.sum(mask.astype(jnp.int32)), axis_tuple)
-        return metric.merge_states(state, delta), token
+        carried = metric.merge_states(layout.unpack(state), delta) if layout is not None else metric.merge_states(state, delta)
+        return (layout.pack(carried) if layout is not None else carried), token
 
     return jax.shard_map(
         body, mesh=mesh,

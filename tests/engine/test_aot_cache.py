@@ -1,0 +1,71 @@
+"""Persistent-compilation-cache lifecycle (ISSUE 3 satellite).
+
+PR 2 documented a caveat: JAX creates its persistent-cache handle lazily at
+the backend's FIRST compile and never re-reads the config, so enabling the
+cache after any computation ran used to require a manual ``cc.reset_cache()``.
+``enable_persistent_compilation_cache`` now auto-handles that — these tests
+pin the behavior the docs now promise instead of caveat.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu import Accuracy
+from metrics_tpu.engine import AotCache, EngineConfig, StreamingEngine
+from metrics_tpu.engine.aot import persistent_cache_entries
+
+
+def _stream(engine):
+    rng = np.random.RandomState(0)
+    for n in (5, 8, 3):
+        engine.submit(rng.rand(n).astype(np.float32), (rng.rand(n) > 0.5).astype(np.int32))
+    return float(engine.result())
+
+
+def test_enabling_cache_after_backend_ran_still_populates(tmp_path):
+    """The caveat, auto-handled: run a compile FIRST (the stale no-dir cache
+    handle exists), then bring up an engine with a cache dir — the dir must
+    still populate (without the internal reset it would stay empty)."""
+    # force the backend to compile something before any cache dir is set
+    float(jax.jit(lambda x: x * 2 + 1)(jnp.ones((4,))).sum())
+
+    cache_dir = str(tmp_path / "xla_cache")
+    cache = AotCache(cache_dir=cache_dir)
+    engine = StreamingEngine(Accuracy(), EngineConfig(buckets=(8,)), aot_cache=cache)
+    with engine:
+        v1 = _stream(engine)
+    assert cache.misses >= 1
+    entries = persistent_cache_entries(cache_dir)
+    assert entries > 0, "persistent cache stayed empty: the stale handle was not reset"
+
+    # warm-restart stand-in: a FRESH AotCache (fresh executables) over the
+    # same dir — the in-process cache misses (objects must be rebuilt) but
+    # XLA serves the binaries from disk: no new cache entries are written
+    # and results are identical
+    cache2 = AotCache(cache_dir=cache_dir)
+    engine2 = StreamingEngine(Accuracy(), EngineConfig(buckets=(8,)), aot_cache=cache2)
+    with engine2:
+        v2 = _stream(engine2)
+    assert v2 == v1
+    assert cache2.misses >= 1  # executable objects were rebuilt...
+    assert persistent_cache_entries(cache_dir) == entries  # ...from disk, not recompiled
+
+
+def test_enable_persistent_cache_mid_process(tmp_path):
+    """An AotCache built WITHOUT a dir can turn the persistent cache on later
+    (blue/green config rollout): programs compiled after the switch land in
+    the new dir."""
+    cache = AotCache()
+    engine = StreamingEngine(Accuracy(), EngineConfig(buckets=(8,)), aot_cache=cache)
+    with engine:
+        _stream(engine)
+    assert cache.cache_dir is None
+
+    cache_dir = str(tmp_path / "late_cache")
+    assert cache.enable_persistent_cache(cache_dir) == cache.cache_dir
+    # a NEW program signature (different bucket) compiles after the switch
+    engine2 = StreamingEngine(Accuracy(), EngineConfig(buckets=(16,)), aot_cache=cache)
+    with engine2:
+        _stream(engine2)
+    assert persistent_cache_entries(cache_dir) > 0

@@ -1,0 +1,205 @@
+"""Tier-1 guards for the state-arena contract (ISSUE 3 tentpole).
+
+The dispatch-amortization claims rest on two invariants this file pins:
+
+1. **Arena invariant** — a served state packs to ONE buffer per dtype, so the
+   donated step arguments per dtype class are ≤ 3 for a realistic
+   classification collection (float/int/bool), regardless of how many metrics
+   (and so how many state leaves) the collection carries.
+2. **Closed program set survives the optimizations** — with arenas, megabatch
+   coalescing AND multi-stream serving all enabled, total compiles stay
+   ≤ len(buckets) + 1.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu import Accuracy, F1Score, MeanSquaredError, MetricCollection
+from metrics_tpu.engine import AotCache, ArenaLayout, EngineConfig, MultiStreamEngine, StreamingEngine
+
+BUCKETS = (8, 32)
+
+
+def _collection():
+    return MetricCollection({"acc": Accuracy(), "f1": F1Score(), "mse": MeanSquaredError()})
+
+
+def _ragged(seed=0, sizes=(5, 17, 8, 32, 3, 70, 1)):
+    rng = np.random.RandomState(seed)
+    return [
+        ((rng.randint(0, 65, size=n) / 64.0).astype(np.float32), (rng.rand(n) > 0.5).astype(np.int32))
+        for n in sizes
+    ]
+
+
+def test_arena_one_buffer_per_dtype_and_at_most_three():
+    """The donated-argument invariant: leaves collapse to one buffer per dtype,
+    and a classification collection needs at most 3 dtype classes."""
+    layout = _collection().arena_layout()
+    assert layout.num_leaves > layout.num_buffers  # the collapse is real
+    assert layout.num_buffers == len(layout.dtype_keys)  # one buffer per dtype
+    assert layout.num_buffers <= 3, layout
+    sizes = layout.buffer_sizes()
+    assert set(sizes) == set(layout.dtype_keys)
+    assert all(n > 0 for n in sizes.values())
+
+
+def test_arena_pack_unpack_roundtrip_bit_exact():
+    col = _collection()
+    layout = col.arena_layout()
+    p, t = _ragged(seed=3, sizes=(9,))[0]
+    state = col.update_state(col.init_state(), p, t)
+    back = layout.unpack(layout.pack(state))
+    for a, b in zip(jax.tree_util.tree_leaves(back), jax.tree_util.tree_leaves(state)):
+        assert np.asarray(a).dtype == np.asarray(b).dtype
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_arena_unpack_is_static_slices_under_jit():
+    """pack∘update∘unpack must compose under jit (the engine step shape)."""
+    col = _collection()
+    layout = col.arena_layout()
+
+    def step(arena, p, t, mask):
+        state = layout.unpack(arena)
+        new = col.update_state_masked(state, p, t, mask=mask)
+        return layout.pack(new)
+
+    p, t = _ragged(seed=4, sizes=(6,))[0]
+    pp = np.concatenate([p, np.zeros(2, np.float32)])
+    tt = np.concatenate([t, np.zeros(2, np.int32)])
+    mask = np.asarray([True] * 6 + [False] * 2)
+    arena0 = layout.pack(col.init_state())
+    got = layout.unpack(jax.jit(step)(arena0, pp, tt, mask))
+    want = col.update_state(col.init_state(), p, t)
+    for a, b in zip(jax.tree_util.tree_leaves(got), jax.tree_util.tree_leaves(want)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_engine_carried_state_is_the_arena():
+    """The engine's live carried state must BE the packed arena dict — that is
+    what bounds the per-step donated arguments to num_buffers."""
+    engine = StreamingEngine(_collection(), EngineConfig(buckets=BUCKETS))
+    layout = engine.arena_layout
+    assert layout is not None and layout.num_buffers <= 3
+    with engine:
+        p, t = _ragged(seed=5, sizes=(7,))[0]
+        engine.submit(p, t)
+        engine.flush()
+        carried = engine._state
+        assert isinstance(carried, dict) and set(carried) == set(layout.dtype_keys)
+        assert all(jnp.ndim(v) == 1 for v in carried.values())
+        # the public view is still the logical pytree
+        logical = engine.state()
+        assert len(jax.tree_util.tree_leaves(logical)) == layout.num_leaves
+
+
+def test_compile_cap_with_arena_coalescing_and_multistream():
+    """≤ len(buckets)+1 compiles with EVERYTHING on: arenas, coalescing (8),
+    multi-stream (4) — and the two engine kinds don't multiply each other's
+    budget beyond their own program kinds."""
+    cache = AotCache()
+    engine = StreamingEngine(
+        _collection(), EngineConfig(buckets=BUCKETS, coalesce=8, use_arena=True), aot_cache=cache
+    )
+    with engine:
+        for p, t in _ragged(seed=6):
+            engine.submit(p, t)
+        engine.result()
+    assert cache.misses <= len(BUCKETS) + 1, cache.stats()
+
+    single_misses = cache.misses
+    ms = MultiStreamEngine(
+        _collection(), num_streams=4,
+        config=EngineConfig(buckets=BUCKETS, coalesce=8), aot_cache=cache,
+    )
+    with ms:
+        for i, (p, t) in enumerate(_ragged(seed=7)):
+            ms.submit(i % 4, p, t)
+        ms.results()
+    assert cache.misses - single_misses <= len(BUCKETS) + 1, cache.stats()
+
+
+def test_arena_and_per_leaf_engines_share_a_cache_without_collision():
+    """The carried-state template is part of the update-program key: an
+    arena engine and a per-leaf engine over the SAME metric and buckets must
+    each get their own executable from a shared cache, not each other's
+    (regression: omitting the state signature handed the per-leaf engine the
+    arena executable — 'input pytree does not match' sticky failure)."""
+    cache = AotCache()
+    batches = _ragged(seed=11, sizes=(5, 9))
+    results = []
+    for use_arena in (True, False):
+        engine = StreamingEngine(
+            _collection(), EngineConfig(buckets=(16,), use_arena=use_arena), aot_cache=cache
+        )
+        with engine:
+            for p, t in batches:
+                engine.submit(p, t)
+            results.append({k: np.asarray(v) for k, v in engine.result().items()})
+    for k in results[0]:
+        assert np.array_equal(results[0][k], results[1][k]), k
+
+
+def test_engine_without_arena_still_exact():
+    """use_arena=False keeps the PR 2 per-leaf path alive (the bench baseline)."""
+    batches = _ragged(seed=8, sizes=(5, 30, 12))
+    eager = _collection()
+    for p, t in batches:
+        eager.update(p, t)
+    want = {k: np.asarray(v) for k, v in eager.compute().items()}
+    engine = StreamingEngine(_collection(), EngineConfig(buckets=BUCKETS, use_arena=False))
+    assert engine.arena_layout is None
+    with engine:
+        for p, t in batches:
+            engine.submit(p, t)
+        got = {k: np.asarray(v) for k, v in engine.result().items()}
+    for k in want:
+        assert np.array_equal(got[k], want[k]), k
+
+
+def test_arena_rejects_non_array_leaves():
+    with pytest.raises(ValueError, match="array-shaped"):
+        ArenaLayout.for_state({"bad": [1, 2, 3], "ok": jax.ShapeDtypeStruct((2,), jnp.float32)})
+
+
+def test_layout_fingerprint_distinguishes_permuted_leaves():
+    """Two layouts whose same-dtype leaves permute SIZES have identical
+    buffers (matches() cannot tell them apart) — the fingerprint must."""
+    a = ArenaLayout.for_state(
+        {"x": jax.ShapeDtypeStruct((2,), jnp.float32), "y": jax.ShapeDtypeStruct((3,), jnp.float32)}
+    )
+    b = ArenaLayout.for_state(
+        {"x": jax.ShapeDtypeStruct((3,), jnp.float32), "y": jax.ShapeDtypeStruct((2,), jnp.float32)}
+    )
+    arena = {"float32": jnp.zeros((5,), jnp.float32)}
+    assert a.matches(arena) and b.matches(arena)
+    assert a.fingerprint() != b.fingerprint()
+    assert a.fingerprint() == ArenaLayout.for_state(
+        {"x": jax.ShapeDtypeStruct((2,), jnp.float32), "y": jax.ShapeDtypeStruct((3,), jnp.float32)}
+    ).fingerprint()
+
+
+def test_restore_refuses_mismatched_arena_layout(tmp_path):
+    """A snapshot from a differently-shaped metric must fail LOUDLY on
+    restore, never unpack scrambled state (layout fingerprint in meta)."""
+    from metrics_tpu import ConfusionMatrix
+    from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+    snapdir = str(tmp_path)
+    rng = np.random.RandomState(0)
+    p, t = rng.rand(6).astype(np.float32), (rng.rand(6) > 0.5).astype(np.int32)
+    eng = StreamingEngine(
+        MetricCollection([Accuracy(), MeanSquaredError()]),
+        EngineConfig(buckets=(8,), snapshot_dir=snapdir),
+    )
+    with eng:
+        eng.submit(p, t)
+        eng.snapshot()
+    other = StreamingEngine(
+        ConfusionMatrix(num_classes=2), EngineConfig(buckets=(8,), snapshot_dir=snapdir)
+    )
+    with pytest.raises(MetricsTPUUserError, match="does not match"):
+        other.restore()

@@ -1,0 +1,140 @@
+"""Megabatch coalescing semantics (ISSUE 3): K queued submissions, one step.
+
+The contract: coalescing changes DISPATCH COUNT, never results — masked
+updates are row-exact and concatenation preserves submission order, so any
+grouping of the queue replays to the same state. These tests pin exactness,
+the grouping bounds (batch cap, top bucket, snapshot boundary), and the
+compatibility rules (differing broadcast arguments must NOT merge).
+"""
+import numpy as np
+import pytest
+
+from metrics_tpu import Accuracy, MeanSquaredError, MetricCollection
+from metrics_tpu.engine import AotCache, EngineConfig, StreamingEngine
+from metrics_tpu.engine.pipeline import _aux_leaves_equal
+
+# structural program keys let every same-config engine in this module share
+# executables — one compile per (bucket, fingerprint) for the whole file
+_CACHE = AotCache()
+
+
+def _batches(seed=0, sizes=(5, 17, 8, 32, 3, 70, 1)):
+    rng = np.random.RandomState(seed)
+    return [
+        ((rng.randint(0, 65, size=n) / 64.0).astype(np.float32), (rng.rand(n) > 0.5).astype(np.int32))
+        for n in sizes
+    ]
+
+
+def _collection():
+    return MetricCollection([Accuracy(), MeanSquaredError()])
+
+
+@pytest.mark.parametrize("coalesce", [1, 4, 64])
+def test_any_grouping_is_bit_identical(coalesce):
+    batches = _batches()
+    eager = _collection()
+    for p, t in batches:
+        eager.update(p, t)
+    want = {k: np.asarray(v) for k, v in eager.compute().items()}
+    engine = StreamingEngine(_collection(), EngineConfig(buckets=(8, 32), coalesce=coalesce), aot_cache=_CACHE)
+    with engine:
+        for p, t in batches:
+            engine.submit(p, t)
+        got = {k: np.asarray(v) for k, v in engine.result().items()}
+    for k in want:
+        assert np.array_equal(got[k], want[k]), (coalesce, k)
+
+
+def test_coalescing_reduces_dispatches_and_reports_megasteps():
+    """A backlog of small same-shape batches must drain into shared steps."""
+    batches = _batches(seed=1, sizes=(4,) * 16)
+    engine = StreamingEngine(
+        _collection(), EngineConfig(buckets=(64,), coalesce=16, max_queue=64), aot_cache=_CACHE
+    )
+    with engine:
+        for p, t in batches:
+            engine.submit(p, t)
+        engine.flush()
+        tele = engine.telemetry()
+    assert tele["steps"] < len(batches)
+    assert tele["coalesce"]["megasteps"] >= 1
+    assert tele["coalesce"]["batches_coalesced"] >= 2
+    # replay-cursor accounting is per SUBMITTED batch, not per step
+    assert engine._batches_done == len(batches)
+
+
+def test_group_never_crosses_snapshot_boundary(tmp_path):
+    """snapshot_every=2 with an 8-deep backlog: groups cap at the boundary, so
+    snapshots land exactly every 2 batches and the last cursor is exact."""
+    batches = _batches(seed=2, sizes=(6,) * 8)
+    engine = StreamingEngine(
+        _collection(),
+        EngineConfig(buckets=(16,), coalesce=8, snapshot_every=2, snapshot_dir=str(tmp_path)),
+        aot_cache=_CACHE,
+    )
+    with engine:
+        for p, t in batches:
+            engine.submit(p, t)
+        engine.flush()
+    assert engine.stats.snapshots == 4  # one per boundary: 2, 4, 6, 8
+    from metrics_tpu.engine import load_snapshot
+
+    _, meta = load_snapshot(str(tmp_path))
+    assert meta["batches_done"] == 8
+
+
+def test_incompatible_broadcast_argument_breaks_the_group():
+    """Two MSE batches with different `sample_weight`-style broadcast scalars
+    must not merge — a megabatch carries ONE set of non-batch arguments."""
+    from metrics_tpu.engine.pipeline import StreamingEngine as SE
+
+    engine = SE(_collection(), EngineConfig(buckets=(8,), coalesce=8), aot_cache=_CACHE)
+    a = (np.asarray([0.5, 0.25], np.float32), np.asarray([1, 0], np.int32))
+    b = (np.asarray([0.75], np.float32), np.asarray([1], np.int32))
+    assert engine._coalescible((a, {}), (b, {}))
+    # same structure, different non-batch leaf -> not coalescible
+    assert not engine._coalescible((a, {"w": 2.0}), (b, {"w": 3.0}))
+    assert engine._coalescible((a, {"w": 2.0}), (b, {"w": 2.0}))
+    # batch-carried dtype drift -> not coalescible
+    c = (np.asarray([0.75], np.float64), np.asarray([1], np.int32))
+    assert not engine._coalescible((a, {}), (c, {}))
+
+
+def test_aux_equality_is_conservative():
+    big = np.zeros(10_000, np.float32)
+    assert not _aux_leaves_equal(big, big.copy())  # too big to compare: refuse
+    assert _aux_leaves_equal(big, big)  # identity is free
+    assert _aux_leaves_equal(np.float32(2.0), np.float32(2.0))
+    assert not _aux_leaves_equal(np.arange(3), np.arange(4))
+
+
+def test_kill_resume_exact_with_coalescing(tmp_path):
+    """The PR 2 recovery contract survives megabatching: resume + replay from
+    the cursor reproduces the uninterrupted result bit-exactly."""
+    batches = _batches(seed=3, sizes=(10, 20, 9, 31, 16, 8, 40, 3))
+    snapdir = str(tmp_path / "snaps")
+    cfg = lambda **kw: EngineConfig(buckets=(16, 32), coalesce=4, **kw)  # noqa: E731
+
+    ref = StreamingEngine(_collection(), cfg(), aot_cache=_CACHE)
+    with ref:
+        for b in batches:
+            ref.submit(*b)
+        want = {k: np.asarray(v) for k, v in ref.result().items()}
+
+    eng = StreamingEngine(_collection(), cfg(snapshot_every=2, snapshot_dir=snapdir), aot_cache=_CACHE)
+    with eng:
+        for b in batches[:5]:
+            eng.submit(*b)
+        eng.flush()
+    del eng
+
+    resumed = StreamingEngine(_collection(), cfg(snapshot_dir=snapdir), aot_cache=_CACHE)
+    meta = resumed.restore()
+    assert meta["batches_done"] in (4, 5)  # last boundary at/before the flush point
+    with resumed:
+        for b in batches[meta["batches_done"]:]:
+            resumed.submit(*b)
+        got = {k: np.asarray(v) for k, v in resumed.result().items()}
+    for k in want:
+        assert np.array_equal(got[k], want[k]), k

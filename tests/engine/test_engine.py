@@ -156,7 +156,7 @@ def test_dispatcher_error_surfaces_to_producer():
 
 def test_empty_batch_is_noop_not_poison():
     """A zero-row tail batch must not brick the long-lived engine."""
-    engine = StreamingEngine(Accuracy(), EngineConfig(buckets=(8,)))
+    engine = StreamingEngine(Accuracy(), EngineConfig(buckets=(8,), coalesce=1))
     with engine:
         engine.submit(np.asarray([0.9, 0.1], np.float32), np.asarray([1, 0], np.int32))
         engine.submit(np.empty((0,), np.float32), np.empty((0,), np.int32))
@@ -164,6 +164,19 @@ def test_empty_batch_is_noop_not_poison():
         got = float(engine.result())
     assert got == 1.0
     assert engine.steps == 2  # the empty batch contributed no device step
+
+
+def test_empty_batch_inside_megabatch_group():
+    """Under coalescing an empty batch rides a group as a cursor-only member —
+    still no poison, and the valid rows still all land."""
+    engine = StreamingEngine(Accuracy(), EngineConfig(buckets=(8,), coalesce=8))
+    with engine:
+        engine.submit(np.asarray([0.9, 0.1], np.float32), np.asarray([1, 0], np.int32))
+        engine.submit(np.empty((0,), np.float32), np.empty((0,), np.int32))
+        engine.submit(np.asarray([0.8], np.float32), np.asarray([1], np.int32))
+        got = float(engine.result())
+    assert got == 1.0
+    assert engine.stats.rows_in == 3
 
 
 def test_bucket_sized_broadcast_leaf_rejected_as_ambiguous():
@@ -179,8 +192,9 @@ def test_bucket_sized_broadcast_leaf_rejected_as_ambiguous():
 
 
 def test_telemetry_shape_and_padding_accounting():
+    # coalesce=1 pins the one-step-per-batch accounting this test asserts
     batches = _ragged_batches(seed=5, sizes=(5, 8, 20))
-    engine = StreamingEngine(Accuracy(), EngineConfig(buckets=BUCKETS, telemetry_capacity=2))
+    engine = StreamingEngine(Accuracy(), EngineConfig(buckets=BUCKETS, telemetry_capacity=2, coalesce=1))
     with engine:
         for p, t in batches:
             engine.submit(p, t)
@@ -195,6 +209,47 @@ def test_telemetry_shape_and_padding_accounting():
     # ring capped at 2: only the newest 2 step records survive
     recent = engine.stats.recent()
     assert [r["step"] for r in recent] == [1, 2]
+
+
+def test_reset_recovers_from_sticky_dispatcher_failure():
+    """docs/serving.md: 'Recover via reset() or restore()' — a long-lived
+    serving engine must survive one malformed batch: the error stays sticky
+    for reads, reset() drains the backlog, clears it, and the engine serves
+    good traffic again (including a correct fresh host-attr latch)."""
+    bad = (np.asarray([0.5, 0.5], np.float32), np.asarray([1, 0, 1], np.int32))
+    engine = StreamingEngine(Accuracy(), EngineConfig(buckets=(8,)))
+    engine.start()
+    engine.submit(*bad)
+    with pytest.raises(RuntimeError, match="dispatcher failed"):
+        engine.flush()
+    engine.reset()  # the recovery path: must NOT re-raise
+    engine.submit(np.asarray([0.9, 0.2], np.float32), np.asarray([1, 0], np.int32))
+    assert float(engine.result()) == 1.0
+    engine.stop()
+
+
+def test_shared_cache_engines_with_different_latched_modes_never_collide():
+    """Two engines over equivalently-CONFIGURED metrics share executables —
+    but host-derived trace constants (Accuracy's input-mode latch) are part of
+    a program's identity. An engine serving multiclass traffic must never be
+    handed a compute program with BINARY baked in (regression: the first-batch
+    host-attr latch folds the derived attrs into the fingerprint before any
+    program key is built)."""
+    cache = AotCache()
+    a = StreamingEngine(Accuracy(), EngineConfig(buckets=(8,)), aot_cache=cache)
+    with a:
+        a.submit(np.asarray([0.9, 0.2, 0.8], np.float32), np.asarray([1, 0, 1], np.int32))
+        assert float(a.result()) == 1.0
+    rng = np.random.RandomState(0)
+    p = rng.rand(4, 3).astype(np.float32)
+    t = np.asarray([0, 1, 2, 1], np.int32)
+    b = StreamingEngine(Accuracy(), EngineConfig(buckets=(8,)), aot_cache=cache)
+    with b:
+        b.submit(p, t)
+        got = float(b.result())
+    oracle = Accuracy()
+    oracle.update(p, t)
+    assert got == float(oracle.compute())
 
 
 def test_update_state_masked_matches_unpadded_eager():

@@ -1,0 +1,129 @@
+"""Engine serving a collection with a NON-delta-maskable member (ISSUE 3).
+
+`AUROC(capacity=N)` keeps static score buffers written with `cat` semantics
+and a fill cursor read from the accumulated state — the vmapped row-delta
+masked path is not exact for it, and PR 2's engine refused the whole
+collection. The sequential scan fallback (`Metric._masked_update_scan`) folds
+such members row-by-row INSIDE the same compiled step, so a mixed collection
+serves with delta members on the fast path, scan members exact, and the
+compile budget unchanged.
+"""
+import numpy as np
+import pytest
+
+from metrics_tpu import AUROC, Accuracy, MeanSquaredError, MetricCollection
+from metrics_tpu.engine import AotCache, EngineConfig, StreamingEngine
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+BUCKETS = (8, 32)
+CAPACITY = 256
+
+
+def _mixed_collection():
+    return MetricCollection(
+        {"acc": Accuracy(), "mse": MeanSquaredError(), "auroc": AUROC(capacity=CAPACITY)}
+    )
+
+
+def _batches(seed=0, sizes=(5, 17, 8, 32, 3, 20, 1)):
+    rng = np.random.RandomState(seed)
+    return [
+        ((rng.randint(0, 65, size=n) / 64.0).astype(np.float32), (rng.rand(n) > 0.5).astype(np.int32))
+        for n in sizes
+    ]
+
+
+def test_fallback_strategy_is_reported():
+    """The observable the engine (and this test) keys on: the capacity member
+    takes the scan fallback, the counter members keep the delta path."""
+    col = _mixed_collection()
+    strategies = col.masked_update_strategies()
+    assert strategies["acc"] == "delta"
+    assert strategies["mse"] == "delta"
+    assert strategies["auroc"] == "scan"
+    assert col.masked_update_unsupported_reason() is None  # engine-admissible
+
+
+def test_engine_with_scan_member_matches_unmasked_oracle():
+    batches = _batches()
+    eager = _mixed_collection()
+    for p, t in batches:
+        eager.update(p, t)
+    want = {k: np.asarray(v) for k, v in eager.compute().items()}
+
+    cache = AotCache()
+    engine = StreamingEngine(_mixed_collection(), EngineConfig(buckets=BUCKETS), aot_cache=cache)
+    with engine:
+        for p, t in batches:
+            engine.submit(p, t)
+        got = {k: np.asarray(v) for k, v in engine.result().items()}
+
+    assert set(got) == set(want)
+    for k in want:
+        np.testing.assert_allclose(got[k], want[k], rtol=0, atol=0, err_msg=k)
+    # the fallback rides INSIDE the bucketed step programs: the cap holds
+    assert cache.misses <= len(BUCKETS) + 1, cache.stats()
+
+
+def test_scan_member_pad_rows_never_reach_the_buffer():
+    """Pad rows must not consume buffer capacity or perturb the fill cursor —
+    the mask carries rows through the fold untouched."""
+    col = _mixed_collection()
+    p = np.asarray([0.9, 0.1, 0.6], np.float32)
+    t = np.asarray([1, 0, 1], np.int32)
+    engine = StreamingEngine(_mixed_collection(), EngineConfig(buckets=(8,)))
+    with engine:
+        engine.submit(p, t)
+        state = engine.state()
+    assert int(np.asarray(state["auroc"]["count"])) == 3  # not 8
+    assert not np.any(np.asarray(state["auroc"]["valid_buf"])[3:])
+    del col
+
+
+def test_scan_member_computes_immediately_after_restore(tmp_path):
+    """AUROC latches its input `mode` host-side during update (like Accuracy);
+    the snapshot must persist it so a restored engine serving the mixed
+    collection computes with NO post-restore batch."""
+    snapdir = str(tmp_path)
+    batches = _batches(seed=9, sizes=(6, 11))
+    eng = StreamingEngine(
+        _mixed_collection(), EngineConfig(buckets=(16,), snapshot_dir=snapdir)
+    )
+    with eng:
+        for p, t in batches:
+            eng.submit(p, t)
+        want = {k: np.asarray(v) for k, v in eng.result().items()}
+        eng.snapshot()
+    del eng
+    resumed = StreamingEngine(
+        _mixed_collection(), EngineConfig(buckets=(16,), snapshot_dir=snapdir)
+    )
+    resumed.restore()
+    with resumed:
+        got = {k: np.asarray(v) for k, v in resumed.result().items()}
+    for k in want:
+        assert np.array_equal(got[k], want[k]), k
+
+
+def test_fully_unmaskable_metric_still_rejected():
+    """List-state (eager) AUROC has no static shape at all — the engine must
+    keep refusing it with the reason."""
+    with pytest.raises(MetricsTPUUserError, match="list"):
+        StreamingEngine(AUROC(), EngineConfig(buckets=(8,)))
+
+
+def test_scan_member_rejected_on_mesh():
+    """The mesh step merges per-shard deltas — no exact form for scan members;
+    the engine must refuse the combination loudly, not silently corrupt."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        pytest.skip("needs >1 device to build a mesh")
+    mesh = Mesh(np.asarray(devs), ("dp",))
+    with pytest.raises(MetricsTPUUserError, match="mesh"):
+        StreamingEngine(
+            _mixed_collection(),
+            EngineConfig(buckets=(8 * len(devs),), mesh=mesh, axis="dp"),
+        )

@@ -1,0 +1,172 @@
+"""MultiStreamEngine: S independent streams, one executable (ISSUE 3).
+
+The serving contract: interleaved ragged traffic tagged with stream ids
+produces, per stream, BIT-IDENTICAL results to a dedicated eager metric fed
+only that stream's batches — while the whole engine compiles at most
+``len(buckets)`` update programs + 1 compute program, for any S. Dyadic test
+data makes float sums exactly representable, so scatter-reduction order
+cannot round (same convention as test_engine.py).
+"""
+import numpy as np
+import pytest
+
+import jax
+
+from metrics_tpu import Accuracy, MeanSquaredError, MetricCollection
+from metrics_tpu.aggregation import MaxMetric, MinMetric
+from metrics_tpu.engine import AotCache, EngineConfig, MultiStreamEngine
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+# shared across this module: same-config engines share executables through
+# the structural program keys, so the file pays each compile once
+_CACHE = AotCache()
+
+BUCKETS = (8, 32)
+S = 3
+
+
+def _collection():
+    return MetricCollection([Accuracy(), MeanSquaredError()])
+
+
+def _traffic(seed=0, n_batches=24):
+    """Interleaved (stream_id, preds, target) batches, dyadic floats."""
+    rng = np.random.RandomState(seed)
+    out = []
+    for i in range(n_batches):
+        n = int(rng.randint(1, 40))
+        p = (rng.randint(0, 65, size=n) / 64.0).astype(np.float32)
+        t = (rng.rand(n) > 0.5).astype(np.int32)
+        out.append((i % S, p, t))
+    return out
+
+
+def test_per_stream_bit_identical_to_dedicated_eager():
+    traffic = _traffic()
+    eagers = [_collection() for _ in range(S)]
+    for sid, p, t in traffic:
+        eagers[sid].update(p, t)
+    want = [{k: np.asarray(v) for k, v in e.compute().items()} for e in eagers]
+
+    engine = MultiStreamEngine(_collection(), num_streams=S, config=EngineConfig(buckets=BUCKETS), aot_cache=_CACHE)
+    with engine:
+        for sid, p, t in traffic:
+            engine.submit(sid, p, t)
+        got = engine.results()
+    for sid in range(S):
+        for k in want[sid]:
+            assert np.array_equal(np.asarray(got[sid][k]), want[sid][k]), (sid, k)
+
+
+def test_one_program_set_for_any_stream_count():
+    """S streams must cost ONE program set: ≤ len(buckets) update compiles + 1
+    compute compile — and a fresh engine over more streams of the same width
+    shares nothing less than the same cap."""
+    cache = AotCache()
+    engine = MultiStreamEngine(
+        _collection(), num_streams=S, config=EngineConfig(buckets=BUCKETS), aot_cache=cache
+    )
+    with engine:
+        for sid, p, t in _traffic(seed=1):
+            engine.submit(sid, p, t)
+        engine.results()
+    assert cache.misses <= len(BUCKETS) + 1, cache.stats()
+
+
+def test_cross_stream_batches_coalesce_into_shared_steps():
+    """Queued batches from DIFFERENT streams must share megabatch steps —
+    the cross-stream amortization a per-stream engine cannot do."""
+    engine = MultiStreamEngine(
+        _collection(), num_streams=S, config=EngineConfig(buckets=(32,), coalesce=8), aot_cache=_CACHE
+    )
+    traffic = _traffic(seed=2, n_batches=12)
+    with engine:
+        for sid, p, t in traffic:
+            engine.submit(sid, p, t)
+        engine.flush()
+        tele = engine.telemetry()
+    assert tele["coalesce"]["megasteps"] >= 1
+    assert tele["steps"] < len(traffic)  # strictly fewer dispatches than submissions
+
+
+def test_min_max_streams_stay_independent():
+    """Scatter min/max must not bleed across stream rows (identity-filled
+    scatter base), and pad rows must stay inert."""
+    mn = MultiStreamEngine(MinMetric(), num_streams=2, config=EngineConfig(buckets=(8,)), aot_cache=_CACHE)
+    mx = MultiStreamEngine(MaxMetric(), num_streams=2, config=EngineConfig(buckets=(8,)), aot_cache=_CACHE)
+    with mn, mx:
+        for eng in (mn, mx):
+            eng.submit(0, np.asarray([5.0, 7.0], np.float32))
+            eng.submit(1, np.asarray([1.0, 9.0], np.float32))
+        assert float(mn.result(0)) == 5.0 and float(mn.result(1)) == 1.0
+        assert float(mx.result(0)) == 7.0 and float(mx.result(1)) == 9.0
+
+
+def test_reset_stream_isolates_one_stream():
+    traffic = _traffic(seed=3, n_batches=9)
+    engine = MultiStreamEngine(_collection(), num_streams=S, config=EngineConfig(buckets=BUCKETS), aot_cache=_CACHE)
+    with engine:
+        for sid, p, t in traffic:
+            engine.submit(sid, p, t)
+        before = {k: np.asarray(v) for k, v in engine.result(0).items()}
+        engine.reset_stream(1)
+        p = np.asarray([0.75], np.float32)
+        t = np.asarray([1], np.int32)
+        engine.submit(1, p, t)
+        fresh = _collection()
+        fresh.update(p, t)
+        want1 = {k: np.asarray(v) for k, v in fresh.compute().items()}
+        got1 = {k: np.asarray(v) for k, v in engine.result(1).items()}
+        got0 = {k: np.asarray(v) for k, v in engine.result(0).items()}
+    for k in want1:
+        assert np.array_equal(got1[k], want1[k]), k
+    for k in before:
+        assert np.array_equal(got0[k], before[k]), k  # stream 0 untouched
+
+
+def test_snapshot_restore_brings_back_every_stream(tmp_path):
+    traffic = _traffic(seed=4, n_batches=12)
+    snapdir = str(tmp_path)
+    cfg = EngineConfig(buckets=BUCKETS, snapshot_dir=snapdir)
+    engine = MultiStreamEngine(_collection(), num_streams=S, config=cfg, aot_cache=_CACHE)
+    with engine:
+        for sid, p, t in traffic:
+            engine.submit(sid, p, t)
+        want = {sid: {k: np.asarray(v) for k, v in r.items()} for sid, r in engine.results().items()}
+        engine.snapshot()
+    del engine
+
+    resumed = MultiStreamEngine(_collection(), num_streams=S, config=cfg, aot_cache=_CACHE)
+    meta = resumed.restore()
+    assert meta["batches_done"] == len(traffic)
+    with resumed:
+        got = {sid: {k: np.asarray(v) for k, v in r.items()} for sid, r in resumed.results().items()}
+    for sid in want:
+        for k in want[sid]:
+            assert np.array_equal(got[sid][k], want[sid][k]), (sid, k)
+
+
+def test_stream_state_view_matches_dedicated_metric():
+    engine = MultiStreamEngine(Accuracy(), num_streams=2, config=EngineConfig(buckets=(8,)), aot_cache=_CACHE)
+    p = np.asarray([0.9, 0.2, 0.8], np.float32)
+    t = np.asarray([1, 0, 1], np.int32)
+    with engine:
+        engine.submit(0, p, t)
+        view = engine.stream_state(0)
+    m = Accuracy()
+    want = m.update_state(m.init_state(), p, t)
+    for a, b in zip(jax.tree_util.tree_leaves(view), jax.tree_util.tree_leaves(want)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_rejections():
+    with pytest.raises(MetricsTPUUserError, match="num_streams"):
+        MultiStreamEngine(Accuracy(), num_streams=0)
+    engine = MultiStreamEngine(Accuracy(), num_streams=2, config=EngineConfig(buckets=(8,)), aot_cache=_CACHE)
+    with pytest.raises(MetricsTPUUserError, match="out of range"):
+        engine.submit(5, np.asarray([0.5], np.float32), np.asarray([1], np.int32))
+    # scan-fallback members have no segmented form: refuse up front, loudly
+    from metrics_tpu import AUROC
+
+    with pytest.raises(MetricsTPUUserError, match="dist_reduce_fx"):
+        MultiStreamEngine(AUROC(capacity=16), num_streams=2, config=EngineConfig(buckets=(8,)))

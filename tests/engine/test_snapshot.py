@@ -123,9 +123,6 @@ def test_kill_resume_reproduces_uninterrupted_result(tmp_path):
 
 
 def test_explicit_snapshot_and_restore_counters(tmp_path):
-    # MSE: its compute depends only on registered state. (Metrics that derive
-    # host-side attrs during update — e.g. Accuracy's `mode` — need at least
-    # one post-restore batch before compute; see docs/serving.md.)
     snapdir = str(tmp_path)
     eng = StreamingEngine(MeanSquaredError(), EngineConfig(buckets=(8,), snapshot_dir=snapdir))
     with eng:
@@ -139,3 +136,53 @@ def test_explicit_snapshot_and_restore_counters(tmp_path):
     assert eng2.stats.rows_in == 2
     with eng2:
         assert float(eng2.result()) == pytest.approx(0.125)
+
+
+def test_host_derived_attrs_survive_snapshot_restore(tmp_path):
+    """Regression for the PR 2 caveat: Accuracy's input-mode latch is derived
+    from DATA during update (host side, outside the state pytree) — a restored
+    engine used to need one post-restore batch before compute. Snapshots now
+    persist `Metric.host_compute_attrs`, so `result()` works IMMEDIATELY after
+    restore, with no replay traffic."""
+    snapdir = str(tmp_path)
+    p = np.asarray([0.9, 0.2, 0.8, 0.1], np.float32)
+    t = np.asarray([1, 0, 1, 1], np.int32)
+    eng = StreamingEngine(Accuracy(), EngineConfig(buckets=(8,), snapshot_dir=snapdir))
+    with eng:
+        eng.submit(p, t)
+        want = float(eng.result())
+        eng.snapshot()
+    del eng
+
+    # fresh engine over a FRESH metric (mode=None): restore alone must be
+    # enough to compute — the old behavior raised "You have to have
+    # determined mode."
+    fresh = Accuracy()
+    assert fresh.mode is None
+    resumed = StreamingEngine(fresh, EngineConfig(buckets=(8,), snapshot_dir=snapdir))
+    meta = resumed.restore()
+    assert meta["batches_done"] == 1
+    from metrics_tpu.utils.enums import DataType
+
+    assert fresh.mode == DataType.BINARY  # the REAL enum member, not a string
+    with resumed:
+        assert float(resumed.result()) == want
+
+
+def test_host_attrs_persist_through_collections(tmp_path):
+    snapdir = str(tmp_path)
+    col = MetricCollection([Accuracy(), MeanSquaredError()])
+    eng = StreamingEngine(col, EngineConfig(buckets=(8,), snapshot_dir=snapdir))
+    p = np.asarray([0.75, 0.25], np.float32)
+    t = np.asarray([1, 0], np.int32)
+    with eng:
+        eng.submit(p, t)
+        want = {k: np.asarray(v) for k, v in eng.result().items()}
+        eng.snapshot()
+    del eng
+    resumed = StreamingEngine(_collection(), EngineConfig(buckets=(8,), snapshot_dir=snapdir))
+    resumed.restore()
+    with resumed:
+        got = {k: np.asarray(v) for k, v in resumed.result().items()}
+    for k in want:
+        assert np.array_equal(got[k], want[k]), k

@@ -6,8 +6,11 @@ Usage::
 
 Reads the document written by ``StreamingEngine.export_telemetry`` (or
 ``python -m metrics_tpu.engine.smoke``) and renders the summary plus the tail
-of the per-step ring. Pure stdlib — safe to run anywhere the JSON lands
-(no jax import, so it works on a machine without the accelerator stack).
+of the per-step ring — including the host-time attribution (``regime``:
+dispatch-bound / pad-bound / device-bound / starved) that says WHERE the dispatcher's
+wall time went, and the coalescing ratio (submitted batches per device step).
+Pure stdlib — safe to run anywhere the JSON lands (no jax import, so it works
+on a machine without the accelerator stack).
 """
 import argparse
 import json
@@ -27,11 +30,17 @@ def _fmt(v):
 def render(doc: dict, steps: int = 10) -> str:
     s = doc.get("summary", {})
     cc = s.get("compile_cache", {})
+    co = s.get("coalesce", {})
     lines = []
     lines.append("── streaming engine telemetry " + "─" * 30)
     rows = [
         ("steps", s.get("steps")),
         ("batches submitted", s.get("batches_submitted")),
+        (
+            "coalesced (megasteps)",
+            f"{_fmt(co.get('batches_coalesced'))} ({_fmt(co.get('megasteps'))}), "
+            f"{_fmt(co.get('batches_per_step_mean'))} batches/step",
+        ),
         ("rows in / padded", f"{_fmt(s.get('rows_in'))} / {_fmt(s.get('rows_padded'))}"),
         ("padding waste", f"{100 * s.get('padding_waste_fraction', 0):.2f}%"),
         ("queue depth max", s.get("queue_depth_max")),
@@ -48,21 +57,34 @@ def render(doc: dict, steps: int = 10) -> str:
         ("compile seconds", cc.get("compile_seconds")),
         ("persistent cache entries", cc.get("persistent_cache_entries")),
     ]
+    shares = s.get("host_time_shares")
+    if shares:
+        rows.insert(
+            3,
+            (
+                "host time shares",
+                f"dispatch {100 * shares.get('dispatch', 0):.1f}% · "
+                f"pad {100 * shares.get('pad', 0):.1f}% · "
+                f"queue-wait {100 * shares.get('queue_wait', 0):.1f}% · "
+                f"blocked-sync {100 * shares.get('blocked_sync', 0):.1f}%",
+            ),
+        )
+        rows.insert(4, ("regime", shares.get("regime")))
     w = max(len(k) for k, _ in rows)
     for k, v in rows:
         lines.append(f"  {k:<{w}}  {_fmt(v)}")
     recent = doc.get("recent_steps", [])[-steps:]
     if recent:
         lines.append(f"── last {len(recent)} steps " + "─" * 44)
-        lines.append("  step  bucket  valid  queue  ingest_us   sync_us")
+        lines.append("  step  bucket  valid  coal  queue  ingest_us    pad_us   wait_us   sync_us")
         for r in recent:
+            def _us(key):
+                return f"{r[key]:>8.1f}" if key in r else "       -"
+
             lines.append(
                 f"  {r.get('step', 0):>4}  {r.get('bucket', 0):>6}  {r.get('valid', 0):>5}"
-                f"  {r.get('queue_depth', 0):>5}  {r.get('ingest_us', 0):>9.1f}"
-                f"  {r.get('sync_us', float('nan')):>8.1f}"
-                if "sync_us" in r
-                else f"  {r.get('step', 0):>4}  {r.get('bucket', 0):>6}  {r.get('valid', 0):>5}"
-                f"  {r.get('queue_depth', 0):>5}  {r.get('ingest_us', 0):>9.1f}         -"
+                f"  {r.get('coalesced', 1):>4}  {r.get('queue_depth', 0):>5}"
+                f"  {r.get('ingest_us', 0):>9.1f}  {_us('pad_us')}  {_us('queue_wait_us')}  {_us('sync_us')}"
             )
     return "\n".join(lines)
 
