@@ -1,8 +1,8 @@
 # Parity target: reference Makefile (test = pytest with coverage).
-# Default flow runs the engine smoke check (seconds) before the full suite.
-.PHONY: all test engine-smoke clean native bench
+# Default flow runs the smoke checks (seconds) before the full suite.
+.PHONY: all test engine-smoke kernels-smoke clean native bench
 
-all: engine-smoke test
+all: engine-smoke kernels-smoke test
 
 test:
 	python -m pytest tests/ -q
@@ -12,6 +12,14 @@ test:
 # lands in engine_telemetry.json; pretty-print: python tools/engine_report.py
 engine-smoke:
 	JAX_PLATFORMS=cpu python -m metrics_tpu.engine.smoke engine_telemetry.json
+
+# Kernel-dispatcher gate, CPU-safe and tier-1-budget cheap: interpret-mode
+# Pallas parity (fold/segment/histogram vs the XLA reference path) + backend
+# dispatch sanity + cross-backend engine parity under one shared AotCache
+# (metrics_tpu/ops/kernels/smoke.py). Compiled-TPU parity: tests marked
+# requires_tpu (skipped cleanly off-TPU by the conftest guard).
+kernels-smoke:
+	JAX_PLATFORMS=cpu python -m metrics_tpu.ops.kernels.smoke
 
 native:
 	g++ -O3 -shared -fPIC metrics_tpu/native/levenshtein.cpp -o metrics_tpu/native/_levenshtein.so

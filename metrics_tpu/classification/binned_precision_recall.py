@@ -109,13 +109,20 @@ class BinnedPrecisionRecallCurve(Metric):
         self.FPs = self.FPs + fps
         self.FNs = self.FNs + fns
 
-    def compute(self) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    def _stacked_curves(self) -> Tuple[Array, Array]:
+        """The curves in stacked ``(C, T+1)`` form — subclasses that reduce
+        per class consume THIS (one batched program), not the list form of
+        :meth:`compute` (whose per-class split unrolls into C slice eqns)."""
         precisions = (self.TPs + METRIC_EPS) / (self.TPs + self.FPs + METRIC_EPS)
         recalls = self.TPs / (self.TPs + self.FNs + METRIC_EPS)
         t_ones = jnp.ones((self.num_classes, 1), dtype=precisions.dtype)
         precisions = jnp.concatenate([precisions, t_ones], axis=1)
         t_zeros = jnp.zeros((self.num_classes, 1), dtype=recalls.dtype)
         recalls = jnp.concatenate([recalls, t_zeros], axis=1)
+        return precisions, recalls
+
+    def compute(self) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+        precisions, recalls = self._stacked_curves()
         if self.num_classes == 1:
             return precisions[0, :], recalls[0, :], self.thresholds
         return list(precisions), list(recalls), [self.thresholds for _ in range(self.num_classes)]
@@ -155,14 +162,17 @@ class BinnedRecallAtFixedPrecision(BinnedPrecisionRecallCurve):
         self.min_precision = min_precision
 
     def compute(self) -> Tuple[Array, Array]:
-        """Returns (max_recall, best_threshold) per class (scalars for binary)."""
-        precisions, recalls, thresholds = super().compute()
+        """Returns (max_recall, best_threshold) per class (scalars for binary).
+
+        The per-class search is one ``vmap`` over the stacked curves — a
+        Python loop of ``.at[i].set`` here would emit one HLO slice-update
+        chain per class, so program size (and compile time) scaled with
+        ``num_classes`` (guarded by
+        ``tests/classification/test_binned_compile_size.py``).
+        """
+        precisions, recalls = self._stacked_curves()
         if self.num_classes == 1:
-            return _recall_at_precision(precisions, recalls, thresholds, self.min_precision)
-        recalls_at_p = jnp.zeros(self.num_classes, dtype=recalls[0].dtype)
-        thresholds_at_p = jnp.zeros(self.num_classes, dtype=thresholds[0].dtype)
-        for i in range(self.num_classes):
-            r, t = _recall_at_precision(precisions[i], recalls[i], thresholds[i], self.min_precision)
-            recalls_at_p = recalls_at_p.at[i].set(r)
-            thresholds_at_p = thresholds_at_p.at[i].set(t)
-        return recalls_at_p, thresholds_at_p
+            return _recall_at_precision(precisions[0], recalls[0], self.thresholds, self.min_precision)
+        return jax.vmap(_recall_at_precision, in_axes=(0, 0, None, None))(
+            precisions, recalls, self.thresholds, self.min_precision
+        )

@@ -11,10 +11,11 @@ quantum. ``MultiStreamEngine`` collapses all of it:
   ``num_streams`` — with arenas on (default), the whole S-stream state is
   still just one buffer per dtype;
 * a step takes ``(state, (stream_ids,)+batch, mask)``: the vmapped per-row
-  deltas scatter-reduce into the addressed stream rows with each reduction's
-  own op (``Metric.update_state_segmented`` — ``.at[ids].add/min/max`` on an
-  identity-filled base), so ONE dispatch can carry rows for MANY streams at
-  once;
+  deltas reduce into the addressed stream rows with each reduction's own op
+  (``Metric.update_state_segmented``, dispatched through
+  ``metrics_tpu/ops/kernels`` — a scatter-free Pallas compare-reduce on TPU,
+  ``.at[ids].add/min/max`` on an identity-filled base under the XLA reference
+  path), so ONE dispatch can carry rows for MANY streams at once;
 * megabatch coalescing composes for free: queued batches from DIFFERENT
   streams concatenate into one step (their rows address different state
   rows), which is exactly the cross-stream amortization a per-stream engine
@@ -122,7 +123,7 @@ class MultiStreamEngine(StreamingEngine):
         scalar argument, so S streams never cost S compiles."""
         sid_abs = jax.ShapeDtypeStruct((), jnp.int32)
         key = self._aot.program_key(
-            "compute_mstream", self._metric_fp,
+            f"compute_mstream+k.{self._kernel_tag()}", self._metric_fp,
             arg_tree=(self._abstract_state(), sid_abs),
             mesh=None, donate=False,
         )
@@ -133,7 +134,8 @@ class MultiStreamEngine(StreamingEngine):
                 row = jax.tree.map(lambda x: x[sid], unpack(state))
                 return metric.compute_from(row)
 
-            return jax.jit(compute).lower(self._abstract_state(), sid_abs).compile()
+            with self._kernel_scope():
+                return jax.jit(compute).lower(self._abstract_state(), sid_abs).compile()
 
         return self._aot.get_or_compile(key, build)
 

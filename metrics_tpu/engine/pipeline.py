@@ -71,6 +71,7 @@ from metrics_tpu.engine.arena import ArenaLayout
 from metrics_tpu.engine.bucketing import BucketPolicy
 from metrics_tpu.engine.snapshot import load_snapshot, save_snapshot
 from metrics_tpu.engine.stats import EngineStats
+from metrics_tpu.ops.kernels import current_backend, resolve_backend, use_backend
 from metrics_tpu.utils.data import infer_batch_size, is_batch_leaf
 from metrics_tpu.utils.exceptions import MetricsTPUUserError
 
@@ -112,6 +113,20 @@ class EngineConfig:
         snapshot_dir: where snapshots live (required when snapshot_every > 0).
         compilation_cache_dir: JAX persistent compilation cache directory —
             warm process restarts skip XLA compiles entirely.
+        kernel_backend: streaming-update kernel backend for this engine's
+            compiled programs (``metrics_tpu/ops/kernels``): ``"pallas"``
+            (fused TPU kernels), ``"pallas_interpret"`` (same kernel logic,
+            interpreted — CPU parity testing), ``"xla"`` (the reference
+            lowering), ``"auto"`` (Pallas on TPU, XLA elsewhere), or None to
+            inherit the selection ambient at engine CONSTRUCTION
+            (``use_backend`` context > ``set_default_backend`` >
+            ``METRICS_TPU_KERNEL_BACKEND`` env var > ``"auto"``). The choice
+            is PINNED at construction for every program this engine builds —
+            update programs build on the dispatcher thread and compute
+            programs on the caller's, and a thread-local context active at
+            ``result()`` time must not split one engine across lowerings.
+            Part of every program's cache identity — engines with different
+            backends sharing an ``AotCache`` never exchange executables.
         mesh: optional ``jax.sharding.Mesh`` for sharded engine steps.
         axis: mesh axis name carrying the batch shards.
         donate: donate state buffers into each step (ignored on CPU).
@@ -130,6 +145,7 @@ class EngineConfig:
     snapshot_every: int = 0
     snapshot_dir: Optional[str] = None
     compilation_cache_dir: Optional[str] = None
+    kernel_backend: Optional[str] = None
     mesh: Optional[Any] = None
     axis: str = "dp"
     donate: bool = True
@@ -185,6 +201,18 @@ class StreamingEngine:
         self._needs_attr_latch = any(
             v is None for v in metric.host_compute_attrs().values()
         )
+        # PIN the kernel backend at construction: config wins; None inherits
+        # whatever selection is ambient HERE (use_backend ctx > process
+        # default > env > auto). Pinning — not re-reading per build — is what
+        # keeps one engine's programs coherent: update programs build on the
+        # dispatcher THREAD and compute programs on the caller's, so a
+        # thread-local context active at result() time must not hand the two
+        # different lowerings. A bad name fails construction, not the
+        # dispatcher thread.
+        self._kernel_backend = (
+            self._cfg.kernel_backend if self._cfg.kernel_backend is not None else current_backend()
+        )
+        resolve_backend(self._kernel_backend)
         self._state = self._put_state(self._init_state_tree())
         self._donate = bool(self._cfg.donate) and jax.default_backend() != "cpu"
         self._serialize = (
@@ -278,9 +306,12 @@ class StreamingEngine:
         # the CARRIED-state template is part of the program's identity: two
         # engines sharing a cache but differing in use_arena (or stream
         # count) take different state pytrees through the same payload
-        # signature — omitting it hands one the other's executable
+        # signature — omitting it hands one the other's executable. The
+        # resolved KERNEL backend is part of it too (the lowering differs):
+        # a pallas engine and an xla engine sharing a cache must not
+        # exchange executables.
         key = self._aot.program_key(
-            self._update_kind(), self._metric_fp,
+            f"{self._update_kind()}+k.{self._kernel_tag()}", self._metric_fp,
             arg_tree=(self._abstract_state(), payload_abs, mask_abs),
             mesh=self._cfg.mesh, donate=self._donate,
         )
@@ -292,6 +323,20 @@ class StreamingEngine:
 
     def _update_kind(self) -> str:
         return "update"
+
+    def _kernel_tag(self) -> str:
+        """The RESOLVED kernel backend this engine's programs lower with —
+        folded into every program key. Derived from the CONSTRUCTION-pinned
+        selection, never from the build-time ambient context."""
+        return resolve_backend(self._kernel_backend)
+
+    def _kernel_scope(self):
+        """Trace-time kernel-backend override for program builds: always
+        pushes the pinned selection, so an ambient ``use_backend`` on the
+        building thread cannot leak into this engine's programs (and the
+        build never leaks into user traces — the override is thread-local
+        and scoped)."""
+        return use_backend(self._kernel_backend)
 
     def _traced_update(self, state_tree: Any, payload: Any, mask: Any) -> Any:
         """The step body on the LOGICAL state tree (inside jit). Subclasses
@@ -320,7 +365,8 @@ class StreamingEngine:
                 return self._pack(new_tree), jnp.sum(mask.astype(jnp.int32))
 
             jitted = jax.jit(step, donate_argnums=(0,) if self._donate else ())
-            return jitted.lower(self._abstract_state(), payload_abs, mask_abs).compile()
+            with self._kernel_scope():  # kernel dispatch happens at trace time
+                return jitted.lower(self._abstract_state(), payload_abs, mask_abs).compile()
 
         from metrics_tpu.parallel.embedded import sharded_masked_step
 
@@ -341,20 +387,28 @@ class StreamingEngine:
             else s,
             payload_abs,
         )
-        return jitted.lower(self._abstract_state(), payload_abs, mask_sharded).compile()
+        with self._kernel_scope():
+            return jitted.lower(self._abstract_state(), payload_abs, mask_sharded).compile()
 
     def _compute_program(self):
+        # compute programs carry the kernel tag too: functional compute code
+        # can route through the dispatcher (e.g. the bincount family)
         key = self._aot.program_key(
-            "compute", self._metric_fp, arg_tree=self._abstract_state(),
+            f"compute+k.{self._kernel_tag()}", self._metric_fp,
+            arg_tree=self._abstract_state(),
             mesh=self._cfg.mesh, donate=False,
         )
         metric, unpack = self._metric, self._unpack
-        return self._aot.get_or_compile(
-            key,
-            lambda: jax.jit(lambda state: metric.compute_from(unpack(state)))
-            .lower(self._abstract_state())
-            .compile(),
-        )
+
+        def build():
+            with self._kernel_scope():
+                return (
+                    jax.jit(lambda state: metric.compute_from(unpack(state)))
+                    .lower(self._abstract_state())
+                    .compile()
+                )
+
+        return self._aot.get_or_compile(key, build)
 
     # --------------------------------------------------------------------- lifecycle
 

@@ -13,6 +13,7 @@ import jax.numpy as jnp
 from metrics_tpu.functional.classification.auc import _auc_compute_without_check
 from metrics_tpu.functional.classification.roc import roc
 from metrics_tpu.utils.checks import _input_format_classification
+from metrics_tpu.utils.data import _bincount
 from metrics_tpu.utils.enums import AverageMethod, DataType
 
 Array = jax.Array
@@ -102,7 +103,7 @@ def _auroc_compute(
                 if mode == DataType.MULTILABEL:
                     support = jnp.sum(target, axis=0)
                 else:
-                    support = jnp.bincount(jnp.ravel(target), length=num_classes)
+                    support = _bincount(jnp.ravel(target), num_classes)
                 return jnp.sum(jnp.stack(auc_scores) * support / jnp.sum(support))
             allowed_average = (AverageMethod.NONE.value, AverageMethod.MACRO.value, AverageMethod.WEIGHTED.value)
             raise ValueError(

@@ -14,6 +14,7 @@ from metrics_tpu.functional.classification.precision_recall_curve import (
     _precision_recall_curve_compute,
     _precision_recall_curve_update,
 )
+from metrics_tpu.utils.data import _bincount
 
 Array = jax.Array
 
@@ -49,7 +50,7 @@ def _average_precision_compute(
         if preds.ndim == target.ndim and target.ndim > 1:
             weights = jnp.sum(target, axis=0).astype(jnp.float32)
         else:
-            weights = jnp.bincount(target, length=num_classes).astype(jnp.float32)
+            weights = _bincount(target, num_classes).astype(jnp.float32)
         weights = weights / jnp.sum(weights)
     else:
         weights = None

@@ -4,14 +4,18 @@ Parity: reference ``torchmetrics/functional/classification/calibration_error.py`
 (_ce_compute :22, _ce_update :78, calibration_error :113).
 
 TPU note: the reference loops over bins with boolean masking (``:48-56``); here the
-binning is one ``searchsorted`` + three fixed-length segment-sums — static shapes,
-one fused pass, jit-safe.
+binning is one ``searchsorted`` + ONE fused three-column histogram through the
+kernel dispatcher (``metrics_tpu/ops/kernels``): count, confidence-sum and
+accuracy-sum accumulate per bin in a single pass — a streaming Pallas one-hot
+× MXU contraction on TPU, one stacked XLA segment-sum elsewhere. Static
+shapes, jit-safe.
 """
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
+from metrics_tpu.ops.kernels import histogram_accumulate
 from metrics_tpu.utils.checks import _input_format_classification
 from metrics_tpu.utils.enums import DataType
 
@@ -36,9 +40,12 @@ def _ce_compute(
     idx = jnp.clip(idx, 0, n_bins - 1)
     w = valid.astype(confidences.dtype)
 
-    count_bin = jax.ops.segment_sum(w, idx, num_segments=n_bins)
-    conf_sum = jax.ops.segment_sum(confidences * w, idx, num_segments=n_bins)
-    acc_sum = jax.ops.segment_sum(accuracies * w, idx, num_segments=n_bins)
+    # one fused histogram pass for all three per-bin sums (kernel dispatcher:
+    # Pallas on TPU, stacked segment-sum under XLA) — the weight columns share
+    # the single one-hot/scatter of `idx`
+    cols = jnp.stack([w, confidences * w, accuracies * w], axis=-1)
+    sums = histogram_accumulate(idx, n_bins, weights=cols)
+    count_bin, conf_sum, acc_sum = sums[:, 0], sums[:, 1], sums[:, 2]
 
     n = confidences.shape[0]
     prop_bin = count_bin / n

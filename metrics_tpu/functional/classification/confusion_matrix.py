@@ -3,9 +3,11 @@
 Parity: reference ``torchmetrics/functional/classification/confusion_matrix.py``
 (_confusion_matrix_update :25, _confusion_matrix_compute :56, confusion_matrix :119).
 
-TPU note: the bincount over ``target*C + preds`` lowers to a fixed-length
-``jnp.bincount`` (scatter-add of ones — XLA turns this into an efficient sort-free
-segment sum); ``minlength`` is static so shapes stay fixed under jit.
+TPU note: the bincount over ``target*C + preds`` goes through the kernel
+dispatcher (``utils/data.py::_bincount`` → ``metrics_tpu/ops/kernels``): a
+scatter-free streaming Pallas histogram on TPU, XLA's fixed-length
+``jnp.bincount`` scatter-add elsewhere; ``minlength`` is static so shapes stay
+fixed under jit either way.
 """
 from typing import Optional
 
@@ -13,6 +15,7 @@ import jax
 import jax.numpy as jnp
 
 from metrics_tpu.utils.checks import _input_format_classification
+from metrics_tpu.utils.data import _bincount
 from metrics_tpu.utils.enums import DataType
 from metrics_tpu.utils.prints import rank_zero_warn
 
@@ -46,7 +49,7 @@ def _confusion_matrix_update(
         unique_mapping = jnp.ravel(target) * num_classes + jnp.ravel(preds)
         minlength = num_classes ** 2
 
-    bins = jnp.bincount(unique_mapping, length=minlength)
+    bins = _bincount(unique_mapping, minlength)
     if multilabel:
         return bins.reshape(num_classes, 2, 2)
     return bins.reshape(num_classes, num_classes)

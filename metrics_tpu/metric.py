@@ -39,6 +39,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from metrics_tpu.ops.kernels import (
+    fold_rows_masked,
+    reduce_identity as _reduce_identity,
+    segment_reduce_masked,
+)
 from metrics_tpu.parallel.collectives import (
     AxisSpec,
     axis_size_or_one,
@@ -509,7 +514,13 @@ class Metric:
 
     def _masked_reduce_into(self, state: Dict[str, Any], stacked: Dict[str, Any], mask: Array) -> Dict[str, Any]:
         """Fold row-stacked deltas (leading axis = rows) into ``state``, skipping
-        masked-out rows via each reduction's identity element."""
+        masked-out rows via each reduction's identity element.
+
+        Each leaf's fold dispatches through the kernel library
+        (``ops/kernels/dispatch.py``): a fused Pallas streaming reduction on
+        TPU, the vmapped-fold XLA lowering elsewhere (and always under the
+        ``xla`` backend) — same values either way, backend chosen at trace
+        time."""
         out: Dict[str, Any] = {}
         if self._CHILD_KEY in stacked:
             children = self._child_metrics()
@@ -526,18 +537,9 @@ class Metric:
                     out[self._CHILD_KEY][name] = child._masked_reduce_into(child_state, child_stacked, mask)
         for k in self._defaults:
             fx = self._reductions[k]
-            s = stacked[k]
-            m = jnp.reshape(mask, (mask.shape[0],) + (1,) * (s.ndim - 1))
-            if fx == "sum":
-                out[k] = state[k] + jnp.sum(jnp.where(m, s, jnp.zeros_like(s)), axis=0)
-            elif fx == "min":
-                ident = _reduce_identity(s.dtype, "min")
-                out[k] = jnp.minimum(state[k], jnp.min(jnp.where(m, s, ident), axis=0))
-            elif fx == "max":
-                ident = _reduce_identity(s.dtype, "max")
-                out[k] = jnp.maximum(state[k], jnp.max(jnp.where(m, s, ident), axis=0))
-            else:  # pragma: no cover - guarded by masked_update_unsupported_reason
+            if fx not in self._MASKED_FX:  # pragma: no cover - guarded by masked_update_unsupported_reason
                 raise MetricsTPUUserError(f"no masked reduction for dist_reduce_fx={fx!r}")
+            out[k] = fold_rows_masked(state[k], stacked[k], mask, fx)
         return out
 
     # ------------------------------------------------- multi-stream serving hooks
@@ -592,7 +594,10 @@ class Metric:
         """Scatter row-stacked deltas into the addressed stream rows of a
         stream-stacked ``state``, skipping masked rows via each reduction's
         identity element (masked rows carry pad ``segment_ids`` — the identity
-        makes their target row a no-op regardless)."""
+        makes their target row a no-op regardless). Per-leaf dispatch through
+        the kernel library (``ops/kernels``): a scatter-free Pallas
+        compare-reduce on TPU, the ``.at[ids].add/min/max`` XLA scatter
+        elsewhere."""
         out: Dict[str, Any] = {}
         if self._CHILD_KEY in stacked:
             children = self._child_metrics()
@@ -611,24 +616,11 @@ class Metric:
                     )
         for k in self._defaults:
             fx = self._reductions[k]
-            s = stacked[k]
-            m = jnp.reshape(mask, (mask.shape[0],) + (1,) * (s.ndim - 1))
-            if fx == "sum":
-                seg = jnp.zeros((num_segments,) + s.shape[1:], s.dtype)
-                seg = seg.at[segment_ids].add(jnp.where(m, s, jnp.zeros_like(s)))
-                out[k] = state[k] + seg
-            elif fx == "min":
-                ident = _reduce_identity(s.dtype, "min")
-                seg = jnp.full((num_segments,) + s.shape[1:], ident, s.dtype)
-                seg = seg.at[segment_ids].min(jnp.where(m, s, ident))
-                out[k] = jnp.minimum(state[k], seg)
-            elif fx == "max":
-                ident = _reduce_identity(s.dtype, "max")
-                seg = jnp.full((num_segments,) + s.shape[1:], ident, s.dtype)
-                seg = seg.at[segment_ids].max(jnp.where(m, s, ident))
-                out[k] = jnp.maximum(state[k], seg)
-            else:  # pragma: no cover - guarded by segmented_update_unsupported_reason
+            if fx not in self._MASKED_FX:  # pragma: no cover - guarded by segmented_update_unsupported_reason
                 raise MetricsTPUUserError(f"no segmented reduction for dist_reduce_fx={fx!r}")
+            out[k] = segment_reduce_masked(
+                state[k], stacked[k], mask, segment_ids, num_segments, fx
+            )
         return out
 
     # --------------------------------------------------------- serving state hooks
@@ -1378,12 +1370,9 @@ class Metric:
     def __getitem__(self, idx): return CompositionalMetric(lambda x: x[idx], self, None)
 
 
-def _reduce_identity(dtype: Any, fx: str) -> Any:
-    """The identity element of min/max over ``dtype`` (masked rows reduce to it)."""
-    if jnp.issubdtype(dtype, jnp.floating):
-        return jnp.asarray(jnp.inf if fx == "min" else -jnp.inf, dtype)
-    info = jnp.iinfo(dtype)
-    return jnp.asarray(info.max if fx == "min" else info.min, dtype)
+# _reduce_identity moved to metrics_tpu/ops/kernels/common.py (imported above):
+# the kernel library's Pallas bodies and XLA reference lowerings must fold
+# masked rows with the SAME identity elements this module always used.
 
 
 def _neg(x: Array) -> Array:

@@ -1,4 +1,12 @@
 """Hot-path device ops (XLA/Pallas) shared across metric families."""
+from metrics_tpu.ops.kernels import (
+    fold_rows_masked,
+    histogram_accumulate,
+    resolve_backend,
+    segment_reduce_masked,
+    set_default_backend,
+    use_backend,
+)
 from metrics_tpu.ops.profiling import (
     attribution_table,
     capture_trace,

@@ -64,11 +64,16 @@ def _binned_kernel(thr_ref, preds_ref, target_ref, tp_ref, fp_ref, fn_ref):
     jax.lax.fori_loop(0, num_t, body, 0)
 
 
-@functools.partial(jax.jit, static_argnames=("block_n",))
+@functools.partial(jax.jit, static_argnames=("block_n", "interpret"))
 def binned_counts_pallas(
-    preds: Array, target_bool: Array, thresholds: Array, block_n: int = 1024
+    preds: Array,
+    target_bool: Array,
+    thresholds: Array,
+    block_n: int = 1024,
+    interpret: bool = False,
 ) -> Tuple[Array, Array, Array]:
-    """Pallas path: returns (TPs, FPs, FNs) each (C, T). TPU only."""
+    """Pallas path: returns (TPs, FPs, FNs) each (C, T). Compiled on TPU;
+    ``interpret=True`` runs the same kernel logic anywhere (CPU parity)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -94,25 +99,33 @@ def binned_counts_pallas(
         ],
         out_specs=[pl.BlockSpec((t, c), lambda i: (0, 0))] * 3,
         out_shape=out_shape,
+        interpret=interpret,
     )(thresholds, preds.astype(jnp.float32), target_f)
     return tp.T, fp.T, fn.T
 
 
 def binned_counts(preds: Array, target_bool: Array, thresholds: Array) -> Tuple[Array, Array, Array]:
-    """Dispatch: Pallas on TPU, jnp elsewhere (CPU tests, virtual meshes).
+    """Dispatch through the kernel-backend selection (``ops/kernels/dispatch``):
+    Pallas on TPU (or interpret-mode under the ``pallas_interpret`` test
+    backend), the fused jnp formulation under ``xla`` and everywhere else
+    (CPU tests, virtual meshes).
 
-    The platform decision is made at trace time (it depends only on the backend,
-    never on traced values), so this is safe to call inside jit/shard_map — the
-    Pallas path lowers with the surrounding computation on TPU.
+    The backend decision is made at trace time (it depends only on
+    configuration and the platform, never on traced values), so this is safe
+    to call inside jit/shard_map — the Pallas path lowers with the
+    surrounding computation on TPU.
     """
-    try:
-        on_tpu = jax.default_backend() in ("tpu", "axon")
-    except Exception:
-        on_tpu = False
-    if on_tpu and preds.ndim == 2:
+    from metrics_tpu.ops.kernels import resolve_backend
+
+    backend = resolve_backend()
+    if backend != "xla" and preds.ndim == 2:
         try:
-            return binned_counts_pallas(preds, target_bool, thresholds)
+            return binned_counts_pallas(
+                preds, target_bool, thresholds, interpret=backend == "pallas_interpret"
+            )
         except Exception:
+            if backend == "pallas_interpret":
+                raise  # CPU parity tests must see kernel failures
             # Catches eager-mode and trace-time failures only. When called under an
             # outer jit, a Mosaic *compile* failure surfaces when the outer jit
             # compiles — outside this try. That's accepted: the kernel's shapes are

@@ -1,0 +1,40 @@
+"""Pallas kernel library for the streaming-update hot path (ISSUE 4).
+
+Three primitives behind a runtime backend dispatcher — see
+``kernels/dispatch.py`` for the dispatch contract and ``docs/serving.md``
+("Kernel dispatcher") for the serving-side story:
+
+* :func:`fold_rows_masked` — fused masked row-delta reduction;
+* :func:`segment_reduce_masked` — masked segment sum/min/max;
+* :func:`histogram_accumulate` — fused masked/weighted bincount.
+
+Smoke gate: ``make kernels-smoke`` (``metrics_tpu/ops/kernels/smoke.py``).
+"""
+from metrics_tpu.ops.kernels.common import REDUCE_OPS, reduce_identity
+from metrics_tpu.ops.kernels.dispatch import (
+    BACKEND_ENV_VAR,
+    BACKENDS,
+    MAX_HIST_LENGTH,
+    current_backend,
+    fold_rows_masked,
+    histogram_accumulate,
+    resolve_backend,
+    segment_reduce_masked,
+    set_default_backend,
+    use_backend,
+)
+
+__all__ = [
+    "BACKENDS",
+    "BACKEND_ENV_VAR",
+    "MAX_HIST_LENGTH",
+    "REDUCE_OPS",
+    "current_backend",
+    "fold_rows_masked",
+    "histogram_accumulate",
+    "reduce_identity",
+    "resolve_backend",
+    "segment_reduce_masked",
+    "set_default_backend",
+    "use_backend",
+]

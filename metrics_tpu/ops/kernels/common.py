@@ -1,0 +1,89 @@
+"""Shared pieces of the streaming-update kernel library.
+
+The reduction identities live here (not in ``metric.py``) so both the XLA
+reference lowerings and the Pallas kernel bodies fold masked-out rows with
+the SAME element — the bit-parity contract between backends depends on the
+two paths substituting identical identities.
+"""
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+#: the reductions the kernel library implements — exactly the set
+#: ``Metric._MASKED_FX`` serves through the delta masked/segmented paths
+REDUCE_OPS = ("sum", "min", "max")
+
+# VMEM working-set budget for the dominant per-grid-step block. Conservative:
+# ~16 MB/core total, shared with Mosaic's own double buffering and the
+# revisited accumulator block, so the row block gets half a MiB.
+VMEM_BLOCK_BYTES = 1 << 19
+
+# smallest/largest row-block sizes the kernels tile with; multiples of 8 so
+# fp32 sublanes stay aligned (guide: min tile (8, 128))
+_MIN_BLOCK_ROWS = 8
+_MAX_BLOCK_ROWS = 1024
+
+
+def reduce_identity(dtype: Any, fx: str) -> Any:
+    """The identity element of sum/min/max over ``dtype`` (masked rows reduce
+    to it). Matches the substitution the vmapped XLA path has always used."""
+    if fx == "sum":
+        return jnp.zeros((), dtype)
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.asarray(jnp.inf if fx == "min" else -jnp.inf, dtype)
+    info = jnp.iinfo(dtype)
+    return jnp.asarray(info.max if fx == "min" else info.min, dtype)
+
+
+def combine(a: Array, b: Array, fx: str) -> Array:
+    """Fold two partial reductions (the between-blocks combine)."""
+    if fx == "sum":
+        return a + b
+    if fx == "min":
+        return jnp.minimum(a, b)
+    return jnp.maximum(a, b)
+
+
+def supported_dtype(dtype: Any) -> bool:
+    """Dtypes the Pallas paths handle: f32/bf16 floats and 32-bit ints.
+
+    Everything else routes to the XLA reference path — the dispatcher falls
+    back, never errors. Sub-32-bit ints are excluded on purpose: ``jnp.sum``
+    PROMOTES them (int16 rows sum to int32 — the pre-kernel runtime behavior),
+    and bit-parity means reproducing that promotion, which a fixed-dtype
+    kernel ref cannot; no metric state uses them. bool likewise (sum promotes
+    to int32).
+    """
+    d = jnp.dtype(dtype)
+    if jnp.issubdtype(d, jnp.floating):
+        return d in (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16))
+    return jnp.issubdtype(d, jnp.integer) and d.itemsize == 4
+
+
+def block_rows(row_bytes: int, budget: int = VMEM_BLOCK_BYTES) -> Optional[int]:
+    """Row-block size whose (rows, features) VMEM tile fits ``budget``, or
+    None when even the minimum block would not fit (the dispatcher then takes
+    the XLA path — huge feature dims are exactly where the generic lowering
+    is already memory-bound anyway)."""
+    if row_bytes <= 0:
+        return None
+    blk = budget // row_bytes
+    if blk < _MIN_BLOCK_ROWS:
+        return None
+    return int(min(_MAX_BLOCK_ROWS, (blk // _MIN_BLOCK_ROWS) * _MIN_BLOCK_ROWS))
+
+
+def as_2d_rows(rows: Array, n_rows: int) -> Tuple[Array, Tuple[int, ...]]:
+    """Collapse ``(N, *leaf)`` to the kernels' canonical ``(N, F)`` layout.
+
+    Returns the reshaped array and the trailing leaf shape (for the inverse
+    reshape of the reduced output). F is at least 1 (scalar leaves become one
+    lane)."""
+    trailing = tuple(int(d) for d in rows.shape[1:])
+    f = 1
+    for d in trailing:
+        f *= d
+    return jnp.reshape(rows, (n_rows, f)), trailing

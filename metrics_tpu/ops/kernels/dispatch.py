@@ -1,0 +1,299 @@
+"""Runtime backend dispatch for the streaming-update kernel library.
+
+Three primitives dominate the engine's device step (ISSUE 4):
+
+* :func:`fold_rows_masked` — fused masked row-delta reduction
+  (``Metric.update_state_masked``, delta strategy);
+* :func:`segment_reduce_masked` — masked segment sum/min/max
+  (``Metric.update_state_segmented`` / ``MultiStreamEngine``);
+* :func:`histogram_accumulate` — fused masked/weighted fixed-length bincount
+  (``utils/data.py::_bincount``, the confusion-matrix family,
+  ``calibration_error``, ``ops/binned_update.py``).
+
+Each dispatches over a BACKEND chosen at trace time (the decision depends
+only on configuration and the JAX platform, never on traced values, so the
+dispatch is jit/shard_map-safe):
+
+========================  =====================================================
+``"pallas"``              compiled Pallas kernels (TPU)
+``"pallas_interpret"``    the same kernels under ``interpret=True`` — bit-level
+                          kernel-logic parity testing on CPU CI
+``"xla"``                 the pre-kernel XLA lowerings (``kernels/xla_ref.py``)
+                          — always available, the reference path
+``"auto"``                ``"pallas"`` on TPU platforms, ``"xla"`` elsewhere
+========================  =====================================================
+
+Selection, most specific wins:
+
+1. :func:`use_backend` context manager (per-trace; the engine wraps program
+   builds in it — ``EngineConfig.kernel_backend``);
+2. :func:`set_default_backend` (process-wide);
+3. the ``METRICS_TPU_KERNEL_BACKEND`` environment variable, read at import;
+4. ``"auto"``.
+
+Inputs a Pallas path cannot serve (unsupported dtype, feature dim too big for
+a VMEM block, histogram too long/too tall for exact f32 accumulation) fall
+back to the XLA path silently — the dispatcher degrades, it never errors.
+
+Trace-caching caveat: the backend choice is a trace-time constant, and JAX
+caches traces by FUNCTION IDENTITY + input avals — re-tracing the SAME
+function object under a different backend reuses the earlier jaxpr. Build a
+fresh closure per backend when you need both lowerings of one computation
+(the engine does: every program build constructs its own step closure).
+Under ``"pallas"`` a trace-time kernel failure also falls back (same policy
+as ``ops/binned_update.py``); under ``"pallas_interpret"`` it raises, so CPU
+parity tests can never silently test the wrong path.
+"""
+import contextlib
+import os
+import threading
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.ops.kernels.common import (
+    REDUCE_OPS,
+    VMEM_BLOCK_BYTES,
+    as_2d_rows,
+    block_rows,
+    supported_dtype,
+)
+from metrics_tpu.ops.kernels.pallas_fold import fold_rows_pallas
+from metrics_tpu.ops.kernels.pallas_hist import histogram_pallas
+from metrics_tpu.ops.kernels.pallas_segment import segment_reduce_pallas
+from metrics_tpu.ops.kernels.xla_ref import (
+    fold_rows_ref,
+    histogram_ref,
+    segment_reduce_ref,
+)
+
+Array = jax.Array
+
+BACKENDS = ("auto", "pallas", "pallas_interpret", "xla")
+BACKEND_ENV_VAR = "METRICS_TPU_KERNEL_BACKEND"
+
+# histograms longer than this keep the XLA path: the kernel's (blk, L) one-hot
+# block would crowd VMEM and the O(N*L) compare work loses to the scatter
+MAX_HIST_LENGTH = 8192
+# integer-count exactness bound for the f32 MXU accumulation (2**24)
+_HIST_EXACT_ROWS = 1 << 24
+
+_tls = threading.local()
+
+
+def _validate(name: str) -> str:
+    if name not in BACKENDS:
+        raise ValueError(
+            f"unknown kernel backend {name!r}; expected one of {BACKENDS}"
+        )
+    return name
+
+
+def _env_default() -> str:
+    """The env-var default, degrading to ``"auto"`` on an unknown name — a
+    typo'd environment must not make the whole package unimportable."""
+    raw = os.environ.get(BACKEND_ENV_VAR, "auto") or "auto"
+    if raw not in BACKENDS:
+        import warnings
+
+        warnings.warn(
+            f"{BACKEND_ENV_VAR}={raw!r} is not one of {BACKENDS}; using 'auto'",
+            stacklevel=2,
+        )
+        return "auto"
+    return raw
+
+
+_default_backend = _env_default()
+
+
+def set_default_backend(name: str) -> None:
+    """Set the process-wide default backend (overrides the env var)."""
+    global _default_backend
+    _default_backend = _validate(name)
+
+
+def current_backend() -> str:
+    """The configured (possibly ``"auto"``) backend in effect on this thread."""
+    override = getattr(_tls, "stack", None)
+    if override:
+        return override[-1]
+    return _default_backend
+
+
+@contextlib.contextmanager
+def use_backend(name: Optional[str]):
+    """Scoped backend override (thread-local). ``None`` is a no-op passthrough
+    — callers with an optional config value can always wrap."""
+    if name is None:
+        yield
+        return
+    _validate(name)
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    stack.append(name)
+    try:
+        yield
+    finally:
+        stack.pop()
+
+
+def resolve_backend(name: Optional[str] = None) -> str:
+    """Concrete backend for ``name`` (default: the ambient selection):
+    ``"auto"`` resolves to ``"pallas"`` on TPU platforms and ``"xla"``
+    everywhere else. The answer depends only on config + platform, so calling
+    this inside a trace is safe (it is a trace-time constant)."""
+    name = _validate(name if name is not None else current_backend())
+    if name != "auto":
+        return name
+    try:
+        on_tpu = jax.default_backend() in ("tpu", "axon")
+    except Exception:  # pragma: no cover - backend init failure
+        on_tpu = False
+    return "pallas" if on_tpu else "xla"
+
+
+def _pallas_or_none(backend: Optional[str]) -> Optional[bool]:
+    """None → take the XLA path; else the kernel's ``interpret`` flag."""
+    resolved = resolve_backend(backend)
+    if resolved == "xla":
+        return None
+    return resolved == "pallas_interpret"
+
+
+# ------------------------------------------------------------------ primitives
+
+
+def fold_rows_masked(
+    state: Array, rows: Array, mask: Array, fx: str, backend: Optional[str] = None
+) -> Array:
+    """Fused masked row-delta reduction.
+
+    ``rows`` is the row-stacked delta ``(N, *leaf)``, ``state`` the carried
+    leaf ``(*leaf)``, ``mask`` ``(N,)``; rows where ``mask`` is False
+    contribute the reduction identity. Returns the new leaf.
+    """
+    if fx not in REDUCE_OPS:
+        raise ValueError(f"fold_rows_masked supports {REDUCE_OPS}, got {fx!r}")
+    state = jnp.asarray(state)
+    rows = jnp.asarray(rows, state.dtype)
+    interpret = _pallas_or_none(backend)
+    n = int(rows.shape[0])
+    if interpret is None or n == 0 or not supported_dtype(rows.dtype):
+        return fold_rows_ref(state, rows, mask, fx)
+    rows2d, trailing = as_2d_rows(rows, n)
+    f = int(rows2d.shape[1])
+    blk = block_rows(f * rows2d.dtype.itemsize)
+    if blk is None:
+        return fold_rows_ref(state, rows, mask, fx)
+    mask_i32 = jnp.reshape(jnp.asarray(mask, bool).astype(jnp.int32), (n, 1))
+    state2d = jnp.reshape(state, (1, f))
+    try:
+        out = fold_rows_pallas(state2d, rows2d, mask_i32, fx, blk, interpret)
+    except Exception:
+        if interpret:  # parity tests must see kernel failures, not a fallback
+            raise
+        return fold_rows_ref(state, rows, mask, fx)
+    return jnp.reshape(out, trailing)
+
+
+def segment_reduce_masked(
+    state: Array,
+    rows: Array,
+    mask: Array,
+    segment_ids: Array,
+    num_segments: int,
+    fx: str,
+    backend: Optional[str] = None,
+) -> Array:
+    """Masked segment sum/min/max: each row folds into the stream row
+    addressed by ``segment_ids`` (masked rows fold into nothing).
+
+    ``state`` is stream-stacked ``(num_segments, *leaf)``; returns its
+    updated value.
+    """
+    if fx not in REDUCE_OPS:
+        raise ValueError(f"segment_reduce_masked supports {REDUCE_OPS}, got {fx!r}")
+    state = jnp.asarray(state)
+    rows = jnp.asarray(rows, state.dtype)
+    interpret = _pallas_or_none(backend)
+    n = int(rows.shape[0])
+    if interpret is None or n == 0 or not supported_dtype(rows.dtype):
+        return segment_reduce_ref(state, rows, mask, segment_ids, num_segments, fx)
+    rows2d, trailing = as_2d_rows(rows, n)
+    f = int(rows2d.shape[1])
+    itemsize = rows2d.dtype.itemsize
+    blk = block_rows(f * itemsize)
+    # the (S, F) stream state lives in VMEM whole as the revisited block
+    if blk is None or num_segments * f * itemsize > VMEM_BLOCK_BYTES:
+        return segment_reduce_ref(state, rows, mask, segment_ids, num_segments, fx)
+    ids_i32 = jnp.reshape(jnp.asarray(segment_ids, jnp.int32), (n, 1))
+    mask_i32 = jnp.reshape(jnp.asarray(mask, bool).astype(jnp.int32), (n, 1))
+    state2d = jnp.reshape(state, (num_segments, f))
+    try:
+        out = segment_reduce_pallas(
+            state2d, rows2d, ids_i32, mask_i32, fx, num_segments, blk, interpret
+        )
+    except Exception:
+        if interpret:
+            raise
+        return segment_reduce_ref(state, rows, mask, segment_ids, num_segments, fx)
+    return jnp.reshape(out, (num_segments,) + trailing)
+
+
+def histogram_accumulate(
+    indices: Array,
+    length: int,
+    weights: Optional[Array] = None,
+    mask: Optional[Array] = None,
+    backend: Optional[str] = None,
+) -> Array:
+    """Fused masked/weighted fixed-length bincount.
+
+    ``jnp.bincount(x, length=length)`` semantics — negative indices clip to
+    bin 0, indices ``>= length`` are dropped — extended with optional per-row
+    ``weights`` (``(N,)`` or ``(N, K)`` columns summed per bin in one pass)
+    and an optional row ``mask``. Returns int32 counts (no weights) or the
+    weights-dtype sums, shape ``(length,)`` / ``(length, K)`` matching the
+    weights' rank.
+    """
+    length = int(length)
+    idx = jnp.asarray(indices)
+    n = int(idx.shape[0]) if idx.ndim else 0
+    interpret = _pallas_or_none(backend)
+    w = None if weights is None else jnp.asarray(weights)
+    pallas_ok = (
+        interpret is not None
+        and 0 < n < _HIST_EXACT_ROWS
+        and idx.ndim == 1
+        and 0 < length <= MAX_HIST_LENGTH
+        and (w is None or (w.dtype == jnp.float32 and w.ndim in (1, 2)))
+        and block_rows(length * 4) is not None
+    )
+    if not pallas_ok:
+        return histogram_ref(idx, length, weights=weights, mask=mask)
+    # jnp.bincount semantics: clip negatives to 0; >= length stays OUT of
+    # range — the kernel's exact-match one-hot drops it, like scatter does
+    idx_i32 = jnp.reshape(jnp.maximum(idx.astype(jnp.int32), 0), (n, 1))
+    if w is None:
+        cols = jnp.ones((n, 1), jnp.float32)
+        squeeze, out_dtype = True, jnp.int32
+    else:
+        squeeze = w.ndim == 1
+        out_dtype = w.dtype
+        cols = jnp.reshape(w, (n, -1)).astype(jnp.float32)
+    if mask is not None:
+        m = jnp.reshape(jnp.asarray(mask, bool), (n, 1))
+        cols = jnp.where(m, cols, jnp.zeros_like(cols))
+    # the (blk, L) one-hot block dominates the kernel's VMEM working set
+    blk = block_rows(max(length, cols.shape[1]) * 4)
+    try:
+        out = histogram_pallas(idx_i32, cols, length, blk, interpret)
+    except Exception:
+        if interpret:
+            raise
+        return histogram_ref(idx, length, weights=weights, mask=mask)
+    out = out.astype(out_dtype)
+    return out[:, 0] if squeeze else out

@@ -1,0 +1,83 @@
+"""Pallas TPU kernel: fused masked row-delta reduction.
+
+The generic masked update (``Metric.update_state_masked``, delta strategy)
+vmaps the subclass update into row-stacked state deltas ``(N, *leaf)`` and
+folds them into the carried state with the reduction's identity substituted
+for masked rows. XLA's generic lowering materializes the identity-substituted
+``(N, *leaf)`` intermediate (broadcast + select) before the reduce; this
+kernel streams the rows through VMEM in blocks and folds each block into the
+revisited ``(1, F)`` accumulator on the VPU, so HBM sees the stacked deltas
+once and the state once — the select/reduce intermediate never exists.
+
+Grid: one dimension over row blocks; the output block is revisited and
+accumulated across grid steps (seeded with the carried state at step 0 —
+TPU grids execute sequentially, which this accumulation relies on).
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.ops.kernels.common import reduce_identity
+
+Array = jax.Array
+
+
+def _fold_kernel(state_ref, mask_ref, rows_ref, out_ref, *, fx):
+    from jax.experimental import pallas as pl
+
+    @pl.when(pl.program_id(0) == 0)
+    def _seed():
+        out_ref[:] = state_ref[:]
+
+    rows = rows_ref[:]  # (blk, F)
+    m = mask_ref[:] != 0  # (blk, 1) — int mask: bool blocks don't tile well
+    if fx == "sum":
+        red = jnp.sum(jnp.where(m, rows, jnp.zeros_like(rows)), axis=0, keepdims=True)
+        out_ref[:] = out_ref[:] + red
+    elif fx == "min":
+        ident = reduce_identity(rows.dtype, "min")
+        red = jnp.min(jnp.where(m, rows, ident), axis=0, keepdims=True)
+        out_ref[:] = jnp.minimum(out_ref[:], red)
+    else:
+        ident = reduce_identity(rows.dtype, "max")
+        red = jnp.max(jnp.where(m, rows, ident), axis=0, keepdims=True)
+        out_ref[:] = jnp.maximum(out_ref[:], red)
+
+
+def fold_rows_pallas(
+    state2d: Array,
+    rows2d: Array,
+    mask_i32: Array,
+    fx: str,
+    block_n: int,
+    interpret: bool,
+) -> Array:
+    """``(1, F) state ⊕ masked-reduce((N, F) rows)`` in one streaming pass.
+
+    Caller (the dispatcher) canonicalizes shapes: ``state2d`` is ``(1, F)``,
+    ``rows2d`` is ``(N, F)``, ``mask_i32`` is ``(N, 1)`` int32 0/1, and
+    ``block_n`` already fits the VMEM budget. Rows are padded here to a block
+    multiple with mask 0 (identity rows — inert under every reduction).
+    """
+    from jax.experimental import pallas as pl
+
+    n, f = rows2d.shape
+    block_n = min(block_n, max(n, 1))
+    n_pad = (-n) % block_n
+    if n_pad:
+        rows2d = jnp.pad(rows2d, ((0, n_pad), (0, 0)))
+        mask_i32 = jnp.pad(mask_i32, ((0, n_pad), (0, 0)))
+    grid = (rows2d.shape[0] // block_n,)
+    return pl.pallas_call(
+        functools.partial(_fold_kernel, fx=fx),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, f), lambda i: (0, 0)),
+            pl.BlockSpec((block_n, 1), lambda i: (i, 0)),
+            pl.BlockSpec((block_n, f), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, f), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((1, f), rows2d.dtype),
+        interpret=interpret,
+    )(state2d, mask_i32, rows2d)

@@ -1,0 +1,77 @@
+"""Pallas TPU kernel: fused masked/weighted histogram (bincount) accumulate.
+
+The confusion-matrix family funnels through fixed-length bincounts
+(``utils/data.py::_bincount``, ``confusion_matrix``'s ``target*C + preds``
+mapping, ``calibration_error``'s three per-bin sums, the binned curves) —
+all scatter-adds of ones/weights under XLA, which TPUs serialize. This kernel
+reformulates the scatter as a compare + MXU contraction: each row block
+builds its one-hot membership matrix ``(blk, L)`` against a lane iota and
+contracts it with the weight columns ``(blk, K)`` on the MXU, accumulating
+``(L, K)`` partial histograms into the revisited output across sequential
+grid steps. One pass over the indices, zero scatters.
+
+Exactness: one-hot entries are exactly 0/1, so every product is exact and the
+f32 accumulation is exact while column totals stay below 2**24 — the
+dispatcher enforces that bound for integer counts (row count < 2**24) and
+routes bigger inputs to the XLA path. Float weights see only the usual sum
+reassociation (same class of difference as any XLA reduction re-order).
+
+Semantics match ``jnp.bincount(x, length=L)``: negative indices clip to bin
+0 (the dispatcher pre-clips), indices ``>= L`` match no bin and drop —
+exactly the scatter's out-of-bounds drop.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _hist_kernel(idx_ref, w_ref, out_ref, *, length):
+    from jax.experimental import pallas as pl
+
+    @pl.when(pl.program_id(0) == 0)
+    def _zero():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    idx = idx_ref[:]  # (blk, 1) int32, negatives pre-clipped to 0; >=L drops
+    w = w_ref[:]  # (blk, K) f32, mask/pad already folded in as zeros
+    blk = idx.shape[0]
+    bins = jax.lax.broadcasted_iota(jnp.int32, (blk, length), 1)
+    onehot = (idx == bins).astype(jnp.float32)  # (blk, L)
+    contrib = jax.lax.dot_general(  # (L, K): contract the block dim on the MXU
+        onehot, w, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    out_ref[:] = out_ref[:] + contrib
+
+
+def histogram_pallas(
+    idx_i32: Array,
+    weights_f32: Array,
+    length: int,
+    block_n: int,
+    interpret: bool,
+) -> Array:
+    """``(L, K)`` f32 histogram of pre-clipped ``(N, 1)`` indices with
+    ``(N, K)`` f32 weight columns (masked/pad rows carry zero weight)."""
+    from jax.experimental import pallas as pl
+
+    n, k = weights_f32.shape
+    block_n = min(block_n, max(n, 1))
+    n_pad = (-n) % block_n
+    if n_pad:
+        idx_i32 = jnp.pad(idx_i32, ((0, n_pad), (0, 0)))
+        weights_f32 = jnp.pad(weights_f32, ((0, n_pad), (0, 0)))
+    grid = (weights_f32.shape[0] // block_n,)
+    return pl.pallas_call(
+        functools.partial(_hist_kernel, length=length),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_n, 1), lambda i: (i, 0)),
+            pl.BlockSpec((block_n, k), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((length, k), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((length, k), jnp.float32),
+        interpret=interpret,
+    )(idx_i32, weights_f32)

@@ -1,0 +1,93 @@
+"""XLA reference lowerings of the three streaming-update primitives.
+
+These are the EXACT formulations the Metric runtime shipped before the Pallas
+library existed (``Metric._masked_reduce_into`` / ``_segment_reduce_into`` and
+``jnp.bincount``/``segment_sum`` call sites), hoisted here so they serve two
+jobs at once:
+
+* the always-available dispatch target (``kernels/dispatch.py`` backend
+  ``"xla"``, and the silent fallback for shapes/dtypes the Pallas paths do
+  not take);
+* the parity oracle every Pallas kernel is tested against
+  (``tests/ops/test_kernel_parity.py``) — int/bool states bit-exact, float
+  states within reassociation tolerance.
+
+Semantics notes:
+
+* masked-out rows contribute the reduction's identity element
+  (``common.reduce_identity``), exactly as the vmapped masked path always
+  substituted;
+* histogram indices follow ``jnp.bincount(x, length=L)`` semantics exactly,
+  kept uniform across backends: negatives CLIP to bin 0 (``x.clip(0)`` in
+  jnp's own lowering), indices ``>= length`` are DROPPED (scatter
+  out-of-bounds drop) — the seed behavior ``_bincount`` always had.
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.ops.kernels.common import combine, reduce_identity
+
+Array = jax.Array
+
+
+def fold_rows_ref(state: Array, rows: Array, mask: Array, fx: str) -> Array:
+    """Masked row fold: ``combine(state, reduce(where(mask, rows, identity)))``."""
+    m = jnp.reshape(mask, (mask.shape[0],) + (1,) * (rows.ndim - 1))
+    if fx == "sum":
+        return state + jnp.sum(jnp.where(m, rows, jnp.zeros_like(rows)), axis=0)
+    ident = reduce_identity(rows.dtype, fx)
+    if fx == "min":
+        return jnp.minimum(state, jnp.min(jnp.where(m, rows, ident), axis=0))
+    return jnp.maximum(state, jnp.max(jnp.where(m, rows, ident), axis=0))
+
+
+def segment_reduce_ref(
+    state: Array,
+    rows: Array,
+    mask: Array,
+    segment_ids: Array,
+    num_segments: int,
+    fx: str,
+) -> Array:
+    """Masked segment reduce via ``.at[ids].op`` on an identity-filled base."""
+    m = jnp.reshape(mask, (mask.shape[0],) + (1,) * (rows.ndim - 1))
+    if fx == "sum":
+        seg = jnp.zeros((num_segments,) + rows.shape[1:], rows.dtype)
+        seg = seg.at[segment_ids].add(jnp.where(m, rows, jnp.zeros_like(rows)))
+        return state + seg
+    ident = reduce_identity(rows.dtype, fx)
+    seg = jnp.full((num_segments,) + rows.shape[1:], ident, rows.dtype)
+    if fx == "min":
+        seg = seg.at[segment_ids].min(jnp.where(m, rows, ident))
+    else:
+        seg = seg.at[segment_ids].max(jnp.where(m, rows, ident))
+    return combine(state, seg, fx)
+
+
+def histogram_ref(
+    indices: Array,
+    length: int,
+    weights: Optional[Array] = None,
+    mask: Optional[Array] = None,
+) -> Array:
+    """Weighted/masked fixed-length bincount, ``jnp.bincount`` semantics
+    (negatives clip to bin 0, indices >= length drop — segment_sum's
+    out-of-bounds scatter drop reproduces that exactly).
+
+    ``weights`` None → int32 counts (``jnp.bincount`` exactly); ``weights``
+    ``(N,)`` or ``(N, K)`` → per-column weighted sums, shape ``(length,)`` or
+    ``(length, K)``, in the weights' dtype.
+    """
+    idx = jnp.maximum(jnp.asarray(indices, jnp.int32), 0)
+    if weights is None:
+        w = jnp.ones(idx.shape, jnp.int32)
+        if mask is not None:
+            w = jnp.where(jnp.asarray(mask, bool), w, 0)
+        return jax.ops.segment_sum(w, idx, num_segments=length).astype(jnp.int32)
+    w = jnp.asarray(weights)
+    if mask is not None:
+        m = jnp.reshape(jnp.asarray(mask, bool), (idx.shape[0],) + (1,) * (w.ndim - 1))
+        w = jnp.where(m, w, jnp.zeros_like(w))
+    return jax.ops.segment_sum(w, idx, num_segments=length)

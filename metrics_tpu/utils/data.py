@@ -152,8 +152,19 @@ def _flatten(x: Sequence) -> list:
 
 
 def _bincount(x: Array, minlength: int) -> Array:
-    """Static-length bincount: one-hot matmul-free segment sum (TPU friendly)."""
-    return jnp.bincount(x, length=minlength)
+    """Static-length bincount through the kernel dispatcher
+    (``metrics_tpu/ops/kernels``). Actual lowering per backend: a streaming
+    Pallas one-hot × MXU-contraction accumulate on TPU (no scatter), XLA's
+    ``jnp.bincount`` scatter-add of ones elsewhere — and always under the
+    forced ``xla`` reference backend. Both paths keep ``jnp.bincount``'s
+    exact semantics: negative indices clip to bin 0, indices ``>= minlength``
+    are dropped; int32 counts.
+    """
+    # function-level import: utils.data loads before the ops package during
+    # package init, and the kernels only pull jax — no cycle, just laziness
+    from metrics_tpu.ops.kernels import histogram_accumulate
+
+    return histogram_accumulate(x, minlength)
 
 
 def _stable_1d_sort(x: Array, descending: bool = False) -> Tuple[Array, Array]:

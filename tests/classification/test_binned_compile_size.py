@@ -1,0 +1,74 @@
+"""Compile-size regression for ``BinnedRecallAtFixedPrecision.compute``.
+
+The pre-fix body looped ``for i in range(num_classes)`` with ``.at[i].set``
+— one HLO slice-update chain per class, so the traced program (and XLA
+compile time) scaled linearly with ``num_classes``. The vmapped form's jaxpr
+op count must be CONSTANT in ``num_classes`` (the ops are batched, not
+unrolled). Values are pinned against an eager per-class oracle so the
+vectorization cannot drift semantically.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.classification.binned_precision_recall import (
+    BinnedRecallAtFixedPrecision,
+    _recall_at_precision,
+)
+
+
+def _compute_eqn_count(num_classes: int, thresholds: int = 9) -> int:
+    m = BinnedRecallAtFixedPrecision(
+        num_classes=num_classes, min_precision=0.5, thresholds=thresholds
+    )
+    rng = np.random.RandomState(num_classes)
+    m.update(
+        jnp.asarray(rng.dirichlet(np.ones(num_classes), 64).astype(np.float32)),
+        jnp.asarray(rng.randint(0, num_classes, 64).astype(np.int32)),
+    )
+    state = m._pack_state()
+    jaxpr = jax.make_jaxpr(lambda s: m.compute_from(s))(state)
+    return sum(1 for _ in jaxpr.jaxpr.eqns)
+
+
+def test_compute_program_size_constant_in_num_classes():
+    small = _compute_eqn_count(3)
+    large = _compute_eqn_count(24)
+    # vmapped: identical op count regardless of C (the loop form grew by
+    # ~2 slice-update chains per extra class — 21 extra classes would add
+    # dozens of eqns)
+    assert large == small, (small, large)
+
+
+def test_vectorized_compute_matches_per_class_loop():
+    num_classes, thresholds = 5, 11
+    m = BinnedRecallAtFixedPrecision(
+        num_classes=num_classes, min_precision=0.6, thresholds=thresholds
+    )
+    rng = np.random.RandomState(0)
+    for _ in range(3):
+        m.update(
+            jnp.asarray(rng.dirichlet(np.ones(num_classes), 32).astype(np.float32)),
+            jnp.asarray(rng.randint(0, num_classes, 32).astype(np.int32)),
+        )
+    got_r, got_t = m.compute()
+    # the replaced loop, verbatim, as the oracle
+    precisions, recalls, thr = BinnedRecallAtFixedPrecision.__mro__[1].compute(m)
+    want_r = np.zeros(num_classes, np.float32)
+    want_t = np.zeros(num_classes, np.float32)
+    for i in range(num_classes):
+        r, t = _recall_at_precision(precisions[i], recalls[i], thr[i], m.min_precision)
+        want_r[i], want_t[i] = float(r), float(t)
+    np.testing.assert_allclose(np.asarray(got_r), want_r, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(got_t), want_t, rtol=1e-6)
+
+
+def test_binary_path_unchanged():
+    m = BinnedRecallAtFixedPrecision(num_classes=1, min_precision=0.5, thresholds=5)
+    preds = jnp.asarray([0.1, 0.4, 0.6, 0.8], jnp.float32)
+    target = jnp.asarray([0, 0, 1, 1], jnp.int32)
+    m.update(preds, target)
+    r, t = m.compute()
+    assert r.shape == () and t.shape == ()
+    assert 0.0 <= float(r) <= 1.0

@@ -1,0 +1,214 @@
+"""Kernel-dispatcher contract: backend selection, fallbacks, engine identity.
+
+Covers the dispatch layer itself (``ops/kernels/dispatch.py``) — the parity
+of the kernels' MATH is ``test_kernel_parity.py``; here we pin WHICH lowering
+runs and how the engine folds the choice into its program identity.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.ops.kernels import (
+    BACKENDS,
+    fold_rows_masked,
+    histogram_accumulate,
+    resolve_backend,
+    segment_reduce_masked,
+    set_default_backend,
+    use_backend,
+)
+from metrics_tpu.ops.kernels.dispatch import MAX_HIST_LENGTH
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+
+def _has_pallas_call(fn, *args) -> bool:
+    # fresh closure per trace: JAX caches traces by FUNCTION IDENTITY + avals,
+    # so re-tracing the same function object under a different kernel backend
+    # would silently reuse the first backend's jaxpr (the dispatcher docs call
+    # this out; the engine is immune — it builds per-program closures)
+    return "pallas_call" in str(jax.make_jaxpr(lambda *a: fn(*a))(*args))
+
+
+def test_resolution_rules():
+    assert resolve_backend("xla") == "xla"
+    assert resolve_backend("pallas") == "pallas"
+    assert resolve_backend("pallas_interpret") == "pallas_interpret"
+    # auto: platform-derived, never "auto" itself
+    assert resolve_backend("auto") in ("pallas", "xla")
+    if jax.default_backend() == "cpu":
+        assert resolve_backend("auto") == "xla"
+    with pytest.raises(ValueError, match="unknown kernel backend"):
+        resolve_backend("triton")
+    with pytest.raises(ValueError, match="unknown kernel backend"):
+        with use_backend("nope"):
+            pass  # pragma: no cover
+
+
+def test_default_backend_setter_restores():
+    old = resolve_backend()
+    try:
+        set_default_backend("xla")
+        assert resolve_backend() == "xla"
+        with use_backend("pallas_interpret"):
+            assert resolve_backend() == "pallas_interpret"
+        assert resolve_backend() == "xla"
+    finally:
+        set_default_backend("auto")
+    assert resolve_backend() == old
+
+
+def test_backend_decides_lowering():
+    """The jaxpr proves which path traced: pallas_call present iff a Pallas
+    backend is selected and the input is eligible."""
+    state = jnp.zeros((4,), jnp.float32)
+    rows = jnp.ones((16, 4), jnp.float32)
+    mask = jnp.ones((16,), bool)
+
+    def fold(s, r, m):
+        return fold_rows_masked(s, r, m, "sum")
+
+    with use_backend("xla"):
+        assert not _has_pallas_call(fold, state, rows, mask)
+    with use_backend("pallas_interpret"):
+        assert _has_pallas_call(fold, state, rows, mask)
+
+
+@pytest.mark.parametrize(
+    "case",
+    ["huge_feature_dim", "narrow_int_dtype", "long_histogram", "int_weights"],
+)
+def test_ineligible_inputs_fall_back_not_error(case):
+    """Inputs the Pallas path cannot serve route to XLA under EVERY backend —
+    the dispatcher degrades, it never raises."""
+    with use_backend("pallas_interpret"):
+        if case == "huge_feature_dim":
+            # one row alone exceeds the VMEM block budget
+            f = (1 << 19) // 4 + 128
+            state = jnp.zeros((f,), jnp.float32)
+            rows = jnp.zeros((4, f), jnp.float32)
+            out = fold_rows_masked(state, rows, jnp.ones((4,), bool), "sum")
+            assert not _has_pallas_call(
+                lambda s, r, m: fold_rows_masked(s, r, m, "sum"), state, rows, jnp.ones((4,), bool)
+            )
+            assert out.shape == (f,)
+        elif case == "narrow_int_dtype":
+            # int8 sums PROMOTE under jnp — the XLA ref preserves that, the
+            # Pallas path opts out rather than mismatching
+            rows = jnp.ones((8, 2), jnp.int8)
+            out = segment_reduce_masked(
+                jnp.zeros((3, 2), jnp.int8), rows, jnp.ones((8,), bool),
+                jnp.zeros((8,), jnp.int32), 3, "sum",
+            )
+            assert out.shape == (3, 2)
+            assert int(out[0, 0]) == 8
+        elif case == "long_histogram":
+            idx = jnp.zeros((16,), jnp.int32)
+            out = histogram_accumulate(idx, MAX_HIST_LENGTH + 1)
+            assert int(out[0]) == 16
+        else:  # integer weights keep XLA's exact integer accumulation
+            idx = jnp.asarray([0, 1, 1, 2], jnp.int32)
+            w = jnp.asarray([1, 2, 3, 4], jnp.int32)
+            out = histogram_accumulate(idx, 3, weights=w)
+            assert out.tolist() == [1, 5, 4]
+
+
+def test_bincount_routes_through_dispatcher():
+    from metrics_tpu.utils.data import _bincount
+
+    x = jnp.asarray([0, 2, 2, 5, 9], jnp.int32)
+    with use_backend("pallas_interpret"):
+        assert _has_pallas_call(lambda v: _bincount(v, 10), x)
+        got = _bincount(x, 10)
+    with use_backend("xla"):
+        assert not _has_pallas_call(lambda v: _bincount(v, 10), x)
+        want = _bincount(x, 10)
+    assert bool(jnp.all(got == want))
+    assert bool(jnp.all(want == jnp.bincount(x, length=10)))
+
+
+def test_confusion_family_parity_across_backends():
+    from metrics_tpu.functional import calibration_error, confusion_matrix
+
+    rng = np.random.RandomState(3)
+    preds = jnp.asarray(rng.randint(0, 4, 64).astype(np.int32))
+    target = jnp.asarray(rng.randint(0, 4, 64).astype(np.int32))
+    probs = jnp.asarray(rng.dirichlet(np.ones(4), 64).astype(np.float32))
+    with use_backend("xla"):
+        cm_x = confusion_matrix(preds, target, num_classes=4)
+        ce_x = calibration_error(probs, target, n_bins=10)
+    with use_backend("pallas_interpret"):
+        cm_p = confusion_matrix(preds, target, num_classes=4)
+        ce_p = calibration_error(probs, target, n_bins=10)
+    assert bool(jnp.all(cm_x == cm_p))  # integer counts: bit parity
+    assert abs(float(ce_x) - float(ce_p)) < 1e-6
+
+
+def test_engine_program_identity_includes_backend(tmp_path):
+    """Two engines over the SAME metric/config but different kernel backends
+    sharing one AotCache must compile disjoint program sets (a shared key
+    would hand one engine the other's lowering)."""
+    from metrics_tpu import Accuracy
+    from metrics_tpu.engine import AotCache, EngineConfig, StreamingEngine
+
+    cache = AotCache()
+    misses = {}
+    for kb in ("xla", "pallas_interpret"):
+        e = StreamingEngine(Accuracy(), EngineConfig(buckets=(8,), kernel_backend=kb), aot_cache=cache)
+        before = cache.misses
+        with e:
+            e.submit(np.random.rand(5).astype(np.float32), np.zeros(5, np.int32))
+            float(e.result())
+        misses[kb] = cache.misses - before
+    assert misses["xla"] > 0 and misses["pallas_interpret"] > 0
+    # and an invalid backend name fails at CONSTRUCTION time
+    with pytest.raises(ValueError, match="unknown kernel backend"):
+        StreamingEngine(Accuracy(), EngineConfig(buckets=(8,), kernel_backend="mosaic"))
+
+
+def test_engine_pins_backend_at_construction():
+    """kernel_backend=None inherits the selection ambient at CONSTRUCTION and
+    pins it: a use_backend context active at result()/submit() time must not
+    change the engine's lowering (update and compute programs would otherwise
+    split across backends — they build on different threads)."""
+    from metrics_tpu import Accuracy
+    from metrics_tpu.engine import EngineConfig, StreamingEngine
+
+    with use_backend("pallas_interpret"):
+        e = StreamingEngine(Accuracy(), EngineConfig(buckets=(8,)))
+    assert e._kernel_tag() == "pallas_interpret"
+    with use_backend("xla"):  # ambient context later: no effect on the pin
+        assert e._kernel_tag() == "pallas_interpret"
+        with e:
+            e.submit(np.random.rand(5).astype(np.float32), np.zeros(5, np.int32))
+            float(e.result())
+    # and the explicit config always wins over the ambient context
+    with use_backend("pallas_interpret"):
+        e2 = StreamingEngine(Accuracy(), EngineConfig(buckets=(8,), kernel_backend="xla"))
+    assert e2._kernel_tag() == "xla"
+
+
+def test_multistream_serves_on_interpret_backend():
+    """MultiStreamEngine end-to-end on the interpret backend: per-stream
+    results equal per-stream eager accumulation."""
+    from metrics_tpu import Accuracy
+    from metrics_tpu.engine import EngineConfig, MultiStreamEngine
+
+    rng = np.random.RandomState(0)
+    batches = [
+        (s % 3, rng.rand(n).astype(np.float32), (rng.rand(n) > 0.5).astype(np.int32))
+        for s, n in enumerate((5, 7, 8, 3, 6, 4))
+    ]
+    eager = {s: Accuracy() for s in range(3)}
+    for s, p, t in batches:
+        eager[s].update(p, t)
+    engine = MultiStreamEngine(
+        Accuracy(), num_streams=3,
+        config=EngineConfig(buckets=(8, 16), kernel_backend="pallas_interpret"),
+    )
+    with engine:
+        for s, p, t in batches:
+            engine.submit(s, p, t)
+        for s in range(3):
+            assert abs(float(engine.result(s)) - float(eager[s].compute())) < 1e-6
