@@ -1,8 +1,8 @@
 # Parity target: reference Makefile (test = pytest with coverage).
 # Default flow runs the smoke checks (seconds) before the full suite.
-.PHONY: all test engine-smoke kernels-smoke clean native bench
+.PHONY: all test engine-smoke kernels-smoke mesh-smoke clean native bench
 
-all: engine-smoke kernels-smoke test
+all: engine-smoke kernels-smoke mesh-smoke test
 
 test:
 	python -m pytest tests/ -q
@@ -20,6 +20,14 @@ engine-smoke:
 # requires_tpu (skipped cleanly off-TPU by the conftest guard).
 kernels-smoke:
 	JAX_PLATFORMS=cpu python -m metrics_tpu.ops.kernels.smoke
+
+# Mesh-engine gate, CPU-safe (bootstraps an 8-device virtual CPU mesh when the
+# host has fewer devices): step-sync AND deferred-sync parity vs eager,
+# AUROC(capacity) on mesh under deferred sync == single device, compile caps,
+# and the collective-placement contract — ZERO collectives in the deferred
+# steady step's HLO, >=1 in the step-sync one (metrics_tpu/engine/mesh_smoke.py).
+mesh-smoke:
+	JAX_PLATFORMS=cpu python -m metrics_tpu.engine.mesh_smoke
 
 native:
 	g++ -O3 -shared -fPIC metrics_tpu/native/levenshtein.cpp -o metrics_tpu/native/_levenshtein.so

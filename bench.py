@@ -1261,6 +1261,36 @@ def bench_engine_dispatch() -> dict:
     }
 
 
+# ------------------------------------------- config: engine mesh dispatch (r8)
+
+def bench_engine_mesh_dispatch() -> dict:
+    """Mesh steady state (ISSUE 5): step-sync vs deferred-sync engine rate on
+    the 8-device mesh, in ONE subprocess run (``metrics_tpu/engine/mesh_bench``
+    owns the pinned protocol — interleaved stream pairs, value-fetched, zero
+    steady compiles asserted per mode; docs/benchmarking.md "Mesh steady state
+    (r8)"). Runs on the virtual 8-device CPU mesh (the same topology the
+    driver's multichip dryrun checks) → absolute rates carry ``liveness_only``;
+    the durable facts are the step-vs-deferred ratios: the engine-level
+    aggregate and ``steady_step_latency`` — the per-step executable latency
+    pair, which isolates the in-step collective deferred sync deletes."""
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    )
+    env["JAX_PLATFORMS"] = "cpu"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "metrics_tpu.engine.mesh_bench"],
+            env=env, capture_output=True, text=True, timeout=900,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": "engine_mesh_dispatch timed out"}
+    if proc.returncode != 0:
+        return {"error": proc.stderr[-500:]}
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
 # ------------------------------------------------ config: kernel microbench (r7)
 
 def bench_kernel_microbench() -> dict:
@@ -1944,6 +1974,7 @@ def main() -> None:
         ("sharded_embedded", bench_sharded_embedded),
         ("engine_steady_state", bench_engine_steady_state),
         ("engine_dispatch", bench_engine_dispatch),
+        ("engine_mesh_dispatch", bench_engine_mesh_dispatch),
         ("kernel_microbench", bench_kernel_microbench),
     ):
         # one retry: the tunnelled TPU occasionally drops a remote_compile

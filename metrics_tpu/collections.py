@@ -282,6 +282,22 @@ class MetricCollection(dict):
         """Pairwise merge of two collection state pytrees (member-wise, pure)."""
         return {k: m.merge_states(a[k], b[k]) for k, m in self.items(keep_base=True)}
 
+    def merge_stacked_states(
+        self, stacked: Dict[str, Dict[str, Any]]
+    ) -> Dict[str, Dict[str, Any]]:
+        """Member-wise stack-axis merge (``Metric.merge_stacked_states``) —
+        the deferred-sync mesh engine's boundary merge of shard-local states."""
+        return {k: m.merge_stacked_states(stacked[k]) for k, m in self.items(keep_base=True)}
+
+    def stacked_merge_unsupported_reason(self) -> "str | None":
+        """None when every member's states fold by their ``dist_reduce_fx``
+        across a stack axis (the deferred-sync mesh serving requirement)."""
+        for k, m in self.items(keep_base=True):
+            r = m.stacked_merge_unsupported_reason()
+            if r is not None:
+                return f"member {k!r}: {r}"
+        return None
+
     def masked_update_unsupported_reason(self) -> "str | None":
         """None when every member supports the mask-aware update path."""
         for k, m in self.items(keep_base=True):

@@ -27,7 +27,14 @@ host-side batching and queueing. This package supplies it:
   one masked step), an async dispatcher thread that pads/uploads the next
   batch while the device runs the current step (double buffering via JAX
   async dispatch, bounded by ``in_flight``), donated state buffers, and
-  mesh-aware sharded steps.
+  mesh-aware sharded steps in two sync modes — ``mesh_sync="step"``
+  (per-step psum-merged deltas, globally consistent carried state) and
+  ``mesh_sync="deferred"`` (shard-local states, COLLECTIVE-FREE steady
+  steps, one fused merge bundle at ``result()``/snapshot boundaries — the
+  reference's per-process accumulation semantics, and the mode that serves
+  ``cat``/scan metrics like ``AUROC(capacity=N)`` on a mesh). Gates:
+  ``make mesh-smoke`` (:mod:`~metrics_tpu.engine.mesh_smoke`), bench entry
+  ``engine_mesh_dispatch`` (:mod:`~metrics_tpu.engine.mesh_bench`).
 * :mod:`~metrics_tpu.engine.multistream` — :class:`MultiStreamEngine`: S
   independent evaluation streams served by ONE executable (stream-stacked
   states, per-row stream ids scatter-reduced via segment ops, per-stream

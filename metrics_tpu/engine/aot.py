@@ -244,7 +244,15 @@ class AotCache:
         arg_tree: Any = None,
         mesh: Any = None,
         donate: bool = False,
+        sync: str = "step",
     ) -> Tuple:
+        """Structural program identity. ``sync`` is the engine's mesh sync
+        mode (``"step"`` merges shard deltas inside every step; ``"deferred"``
+        carries shard-local state and merges at boundaries): the two modes
+        lower DIFFERENT programs over the same payload signature — update
+        programs differ in collectives, and the deferred mode adds separate
+        ``merge`` entries — so the mode is part of every key and engines in
+        different modes sharing one cache never exchange executables."""
         import jax
 
         return (
@@ -253,6 +261,7 @@ class AotCache:
             self.signature_of(arg_tree) if arg_tree is not None else None,
             _mesh_fingerprint(mesh),
             bool(donate),
+            str(sync),
             jax.default_backend(),
         )
 

@@ -32,7 +32,7 @@ Dtype segregation is what keeps this exact: mixing dtypes in one buffer would
 force casts (lossy for int64→float32 counters) — per-dtype buffers are pure
 relayouts.
 """
-from typing import Any, Dict, List, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -113,15 +113,27 @@ class ArenaLayout:
             k: jax.ShapeDtypeStruct((n,), jnp.dtype(k)) for k, n in self._totals.items()
         }
 
-    def matches(self, arena: Dict[str, Any]) -> bool:
+    def abstract_stacked(self, world: int) -> Dict[str, jax.ShapeDtypeStruct]:
+        """``ShapeDtypeStruct`` dict of the SHARD-STACKED arena: one
+        ``(world, n)`` buffer per dtype, row ``k`` = shard ``k``'s local arena.
+        The deferred-sync mesh engine's carried-state template — sharded over
+        the mesh axis on dim 0, each device owns exactly its own row."""
+        return {
+            k: jax.ShapeDtypeStruct((int(world), n), jnp.dtype(k))
+            for k, n in self._totals.items()
+        }
+
+    def matches(self, arena: Dict[str, Any], world: Optional[int] = None) -> bool:
         """Shape/dtype compatibility of the BUFFERS (used when restoring
-        snapshots). Necessary but not sufficient — two layouts with permuted
-        same-dtype leaves have identical buffers; :meth:`fingerprint` is the
-        sufficient check and travels in the snapshot meta."""
+        snapshots); with ``world`` the expected form is the shard-stacked
+        ``(world, n)`` layout. Necessary but not sufficient — two layouts with
+        permuted same-dtype leaves have identical buffers; :meth:`fingerprint`
+        is the sufficient check and travels in the snapshot meta."""
         if set(arena) != set(self._totals):
             return False
+        expect = (lambda n: (int(world), n)) if world is not None else (lambda n: (n,))
         return all(
-            tuple(getattr(arena[k], "shape", ())) == (n,) for k, n in self._totals.items()
+            tuple(getattr(arena[k], "shape", ())) == expect(n) for k, n in self._totals.items()
         )
 
     def fingerprint(self) -> str:
@@ -159,6 +171,43 @@ class ArenaLayout:
         into the consuming ops; no copies survive in the compiled step)."""
         leaves = [
             jnp.reshape(arena[s.key][s.offset : s.offset + s.size], s.shape)
+            for s in self._specs
+        ]
+        return jax.tree_util.tree_unflatten(self._treedef, leaves)
+
+    # ------------------------------------------------------ shard-stacked views
+
+    def pack_stacked(self, state: Any) -> Dict[str, Any]:
+        """Shard-stacked state pytree (leading axis = shard) -> per-dtype
+        ``(world, n)`` buffers: the per-shard packing applied row-wise. The
+        deferred-sync engine's carried form — dim 0 shards over the mesh axis,
+        so inside the step each device packs/unpacks only its own row."""
+        leaves = jax.tree_util.tree_flatten(state)[0]
+        if len(leaves) != len(self._specs):
+            raise ValueError(
+                f"state has {len(leaves)} leaves, layout expects {len(self._specs)}"
+            )
+        parts: Dict[str, List[Any]] = {k: [] for k in self._totals}
+        for leaf, spec in zip(leaves, self._specs):
+            arr = jnp.asarray(leaf, spec.dtype)
+            parts[spec.key].append(jnp.reshape(arr, (arr.shape[0], spec.size)))
+        return {
+            k: (jnp.concatenate(chunks, axis=1) if len(chunks) > 1 else chunks[0])
+            for k, chunks in parts.items()
+        }
+
+    def unpack_stacked(self, arena: Dict[str, Any]) -> Any:
+        """Inverse of :meth:`pack_stacked`: ``(world, n)`` buffers -> the
+        shard-stacked state pytree (every leaf gains a leading ``world`` axis).
+        This is the MERGED-VIEW precursor: feeding the result to
+        ``Metric.merge_stacked_states`` yields the global state the reference's
+        ``dist_reduce_fx`` sync would produce."""
+        first = next(iter(arena.values()))
+        world = int(jnp.shape(first)[0])
+        leaves = [
+            jnp.reshape(
+                arena[s.key][:, s.offset : s.offset + s.size], (world,) + s.shape
+            )
             for s in self._specs
         ]
         return jax.tree_util.tree_unflatten(self._treedef, leaves)

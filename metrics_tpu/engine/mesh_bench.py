@@ -1,0 +1,224 @@
+"""Mesh steady-state dispatch bench: ``python -m metrics_tpu.engine.mesh_bench``.
+
+The ``engine_mesh_dispatch`` entry (bench.py / MULTICHIP): step-sync vs
+deferred-sync steady-state rate on the 8-device mesh, measured in ONE run —
+one process, one mesh, one fixed-seed data stream — so the RATIO between the
+modes is the durable fact even when the absolute rates are host-noise-bound
+(virtual CPU meshes timeshare one host → ``liveness_only``).
+
+PINNED protocol (docs/benchmarking.md, "Mesh steady state (r8)"):
+fixed-seed 192-batch stream of uniform 64..256-row batches against buckets
+(256,) and a small-state ``MetricCollection([Accuracy(), MeanSquaredError()])``;
+``coalesce=1`` so steps == padded chunks in both modes and steps/s compares
+like for like; ``in_flight=1`` so BOTH modes run the same synchronous step
+discipline — a CPU step-sync mesh serializes every step regardless (the
+communicator-deadlock policy), and letting only the deferred mode pipeline
+would conflate the collective win with overlap (and on a small host, with
+thread contention): with both modes blocking per step, the ratio isolates
+exactly what deferred sync deletes — the per-step cross-shard merge. Per
+mode one warmup stream pays every compile (update + compute, + the boundary
+merge for deferred), then 5 INTERLEAVED (step, deferred) timed stream pairs
+via ``reset()``, each ended by flush + a host fetch of the computed value
+(value-fetched timing — the deferred mode's boundary merge is INSIDE the
+timed region, so its collective cost is charged, not hidden); the headline
+speedup is the aggregate step/deferred time ratio over the pairs, and
+``steady_step_latency`` isolates the two step EXECUTABLES' back-to-back
+latency (the engine rates add a mode-independent host term that dilutes the
+ratio toward 1 on a host-noise-bound virtual mesh); ZERO steady-state
+compiles asserted per mode. Prints one JSON document on stdout.
+"""
+import json
+import os
+import sys
+import time
+
+NUM_DEVICES = 8
+
+
+def run_bench() -> dict:
+    import numpy as np
+
+    import jax
+    from jax.sharding import Mesh
+
+    from metrics_tpu import Accuracy, MeanSquaredError, MetricCollection
+    from metrics_tpu.engine import EngineConfig, StreamingEngine
+
+    devs = jax.devices()
+    if len(devs) < NUM_DEVICES:
+        return {"error": f"need {NUM_DEVICES} devices, have {len(devs)}"}
+    mesh = Mesh(np.asarray(devs[:NUM_DEVICES]), ("dp",))
+    platform = devs[0].platform
+
+    buckets = (256,)
+    n_batches, trials = 192, 5
+    rng = np.random.RandomState(20260803)
+    sizes = rng.randint(64, 257, size=n_batches)
+    batches = [
+        (rng.rand(n).astype(np.float32), (rng.rand(n) > 0.5).astype(np.int32))
+        for n in sizes
+    ]
+    rows_total = int(sum(sizes))
+
+    def col():
+        return MetricCollection([Accuracy(), MeanSquaredError()])
+
+    def make_engine(mode: str) -> StreamingEngine:
+        return StreamingEngine(
+            col(),
+            EngineConfig(
+                buckets=buckets, mesh=mesh, axis="dp", mesh_sync=mode,
+                coalesce=1, in_flight=1, max_queue=n_batches + 1,
+                telemetry_capacity=512,
+            ),
+        )
+
+    def stream_once(engine: StreamingEngine) -> float:
+        t0 = time.perf_counter()
+        for b in batches:
+            engine.submit(*b)
+        engine.flush()
+        res = engine.result()  # value-fetched: merge + compute inside the timing
+        float(next(iter(res.values())))
+        return time.perf_counter() - t0
+
+    # both engines live in one process and the trial streams INTERLEAVE
+    # (step, deferred, step, deferred, ...): host-load drift — the dominant
+    # noise on a timeshared virtual mesh — hits both modes of a pair alike
+    # and cancels in the per-pair ratio
+    engines = {m: make_engine(m) for m in ("step", "deferred")}
+    times = {m: [] for m in engines}
+    steps_per_stream = {}
+    warm_misses = {}
+    steady = {}
+    for m, e in engines.items():
+        e.start()
+        stream_once(e)  # warmup: every compile (incl. deferred merge) lands here
+        steps_per_stream[m] = e.steps  # reset() rewinds the counter below
+        warm_misses[m] = e.aot_cache.misses
+    for _ in range(trials):
+        for m, e in engines.items():
+            e.reset()
+            times[m].append(stream_once(e))
+    for m, e in engines.items():
+        steady[m] = e.aot_cache.misses - warm_misses[m]
+        if steady[m]:
+            raise RuntimeError(
+                f"engine_mesh_dispatch[{m}] steady state compiled "
+                f"{steady[m]} programs; the closed-program contract is broken"
+            )
+
+    def summarize(m: str) -> dict:
+        e = engines[m]
+        tele = e.telemetry()
+        ts = sorted(times[m])
+        med = ts[len(ts) // 2]
+        shares = tele.get("host_time_shares", {})
+        sync_info = tele.get("mesh_sync", {})
+        e.stop()
+        return {
+            "samples_per_s": round(rows_total / med, 1),
+            "steps_per_s": round(steps_per_stream[m] / med, 1),
+            "steps_per_stream": steps_per_stream[m],
+            "spread_frac": round((ts[-1] - ts[0]) / med, 3),
+            "compiles_steady_state": steady[m],
+            "regime": shares.get("regime"),
+            "collective_share": sync_info.get("collective_share"),
+            "boundary_merges": sync_info.get("merges"),
+        }
+
+    def step_latency() -> dict:
+        """Back-to-back latency of the two STEADY-STEP executables themselves
+        (pre-padded, pre-sharded inputs, carried state, blocking on the token
+        per call — the engine's synchronous step discipline minus its host
+        pad/queue/bookkeeping). This isolates exactly what deferred sync
+        deletes from the hot path: the in-step collective. Interleaved
+        K-call reps; median of per-rep ratios."""
+        reps, k = 5, 40
+        bucket = buckets[-1]
+        p = rng.rand(bucket).astype(np.float32)
+        t = (rng.rand(bucket) > 0.5).astype(np.int32)
+        mask = np.ones(bucket, bool)
+        progs, states, uploads = {}, {}, {}
+        for m, e in engines.items():
+            progs[m] = e._update_program(((p, t), {}), mask)
+            states[m] = e._put_state(e._init_state_tree())
+            uploads[m] = e._upload(((p, t), {}), mask)
+        lat = {m: [] for m in engines}
+        for _ in range(reps):
+            for m in engines:
+                payload, mask_dev = uploads[m]
+                t0 = time.perf_counter()
+                for _ in range(k):
+                    states[m], token = progs[m](states[m], payload, mask_dev)
+                    jax.block_until_ready(token)
+                lat[m].append((time.perf_counter() - t0) / k * 1e3)
+        rep_ratios = sorted(s / d for s, d in zip(lat["step"], lat["deferred"]))
+        return {
+            "step_ms": round(sorted(lat["step"])[reps // 2], 3),
+            "deferred_ms": round(sorted(lat["deferred"])[reps // 2], 3),
+            "ratio_step_over_deferred": round(rep_ratios[reps // 2], 3),
+            "rep_ratios": [round(r, 3) for r in rep_ratios],
+            "protocol": f"{reps} interleaved reps x {k} blocking calls, bucket {bucket}",
+        }
+
+    latency = step_latency()
+    out = {m: summarize(m) for m in engines}
+    pair_ratios = sorted(s / d for s, d in zip(times["step"], times["deferred"]))
+    # headline = AGGREGATE time ratio over the interleaved trials: per-stream
+    # step-sync times are bimodal on a timeshared host (the 8-thread
+    # rendezvous is scheduler roulette), so a single pair can swing either
+    # way; the sum spans every scheduling regime both modes saw
+    ratio = sum(times["step"]) / sum(times["deferred"])
+    doc = {
+        **out,
+        # the acceptance ratio: collective-free steady steps vs per-step
+        # psum-merge — aggregate time ratio over the interleaved trials
+        # (per-pair ratios reported alongside for spread)
+        "speedup_deferred_vs_step": round(ratio, 3),
+        "pair_ratios": [round(r, 3) for r in pair_ratios],
+        # the per-step executable latencies: the collective-cost isolate (the
+        # engine rates above add the mode-independent host pad/queue/dispatch
+        # term, which dilutes the ratio toward 1 on a host-noise-bound mesh)
+        "steady_step_latency": latency,
+        "rows_per_stream": rows_total,
+        "batches_per_stream": n_batches,
+        "batch_rows_range": [64, 256],
+        "buckets": list(buckets),
+        "trials": trials,
+        "n_devices": NUM_DEVICES,
+        "platform": platform,
+        "protocol": (
+            "fixed-seed 192-batch stream, 64..256 rows/batch, buckets (256,), "
+            "coalesce=1, in_flight=1 (both modes step synchronously: the ratio "
+            "isolates the per-step collective, not pipelining), small-state "
+            "collection; both engines in ONE process, 1 warmup stream each pays all "
+            "compiles, then 5 INTERLEAVED (step, deferred) timed stream pairs via "
+            "reset(), value-fetched (deferred boundary merge inside the timing); "
+            "speedup = aggregate step/deferred time ratio over the interleaved "
+            "trials (per-pair ratios reported for spread), rates = per-mode medians "
+            "with (max-min)/median spread; steady_step_latency = interleaved K-call "
+            "executable latency pair; zero steady-state compiles asserted per mode"
+        ),
+    }
+    if platform == "cpu":
+        doc["liveness_only"] = True
+        doc["note"] = (
+            "virtual CPU mesh timeshares one host: rates are liveness, the durable "
+            "facts are the step-vs-deferred RATIO (shared run) + zero steady compiles "
+            "+ the collective placement pinned by mesh-smoke/tests"
+        )
+    return doc
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    print(json.dumps(run_bench()))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

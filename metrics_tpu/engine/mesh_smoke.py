@@ -1,0 +1,185 @@
+"""Mesh-engine smoke check: ``python -m metrics_tpu.engine.mesh_smoke``.
+
+The CPU-safe gate for BOTH mesh sync modes (``make mesh-smoke``), on an
+8-device mesh it bootstraps itself (virtual CPU devices via
+``--xla_force_host_platform_device_count`` when the host has fewer than 8 —
+the ``__graft_entry__.dryrun_multichip`` recipe):
+
+1. parity — a delta MetricCollection streamed through a step-sync engine AND
+   a deferred-sync engine equals the single-device eager loop (int states
+   bit-exact, floats to tolerance), with both engines sharing ONE AotCache
+   (program keys carry the sync mode — no executable can cross modes);
+2. cat/scan on mesh — ``AUROC(capacity=N)`` (scan strategy, cat-state
+   buffers), which step-sync mode refuses outright, serves under deferred
+   sync and matches the single-device engine exactly;
+3. collective placement — the compiled deferred steady-state step's HLO
+   contains ZERO cross-chip collectives (the merge program contains them
+   all); the step-sync step's HLO contains at least one all-reduce;
+4. compile cap — each engine stays within its closed program set
+   (update-per-bucket + compute, + one merge program for deferred) and a
+   repeat stream after ``reset()`` compiles NOTHING.
+
+Prints one PASS line; exits nonzero on any violated claim.
+"""
+import os
+import subprocess
+import sys
+
+NUM_DEVICES = 8
+
+
+def _collective_count(hlo_text: str) -> int:
+    from metrics_tpu.parallel.collectives import HLO_COLLECTIVE_RE
+
+    return len(HLO_COLLECTIVE_RE.findall(hlo_text))
+
+
+def _bootstrap() -> int:
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + f" --xla_force_host_platform_device_count={NUM_DEVICES}"
+    )
+    env["JAX_PLATFORMS"] = "cpu"
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu'); "
+        "import sys; from metrics_tpu.engine.mesh_smoke import _impl; sys.exit(_impl())"
+    )
+    proc = subprocess.run([sys.executable, "-c", code], env=env, timeout=900)
+    return proc.returncode
+
+
+def _impl() -> int:
+    import numpy as np
+
+    import jax
+    from jax.sharding import Mesh
+
+    from metrics_tpu import AUROC, Accuracy, MeanSquaredError, MetricCollection
+    from metrics_tpu.engine import AotCache, EngineConfig, StreamingEngine
+
+    devs = jax.devices()
+    if len(devs) < NUM_DEVICES:
+        print(f"FAIL: need {NUM_DEVICES} devices, have {len(devs)}")
+        return 1
+    mesh = Mesh(np.asarray(devs[:NUM_DEVICES]), ("dp",))
+    buckets = (32,)
+    rng = np.random.RandomState(0)
+    batches = [
+        ((rng.randint(0, 65, size=n) / 64.0).astype(np.float32), (rng.rand(n) > 0.5).astype(np.int32))
+        for n in (13, 32, 7, 29, 18)
+    ]
+
+    def col():
+        return MetricCollection([Accuracy(), MeanSquaredError()])
+
+    eager = col()
+    for b in batches:
+        eager.update(*b)
+    want = {k: np.asarray(v) for k, v in eager.compute().items()}
+
+    cache = AotCache()  # SHARED across modes: keys must keep them apart
+    ok = True
+
+    def run(engine) -> dict:
+        nonlocal ok
+        with engine:
+            for b in batches:
+                engine.submit(*b)
+            got = {k: np.asarray(v) for k, v in engine.result().items()}
+            warm = engine.aot_cache.misses
+            engine.reset()
+            for b in batches:
+                engine.submit(*b)
+            got2 = {k: np.asarray(v) for k, v in engine.result().items()}
+            steady = engine.aot_cache.misses - warm
+        if steady != 0:
+            print(f"FAIL: repeat stream compiled {steady} programs (expected 0)")
+            ok = False
+        for k in got:
+            if not (np.array_equal(got[k], got2[k]) or np.allclose(got[k], got2[k])):
+                print(f"FAIL: reset() stream diverged on {k}: {got[k]} vs {got2[k]}")
+                ok = False
+        return got
+
+    def check_parity(tag: str, got: dict) -> None:
+        nonlocal ok
+        for k in want:
+            exact = np.array_equal(got[k], want[k])
+            close = np.allclose(got[k], want[k], rtol=1e-6, atol=1e-7)
+            if not (exact or close):
+                print(f"FAIL: {tag} {k}: engine={got[k]} eager={want[k]}")
+                ok = False
+
+    def step_hlo(engine) -> str:
+        (prog,) = list(engine._program_memo.values())
+        return prog.as_text()
+
+    base = cache.misses
+    step_eng = StreamingEngine(col(), EngineConfig(buckets=buckets, mesh=mesh, axis="dp"), aot_cache=cache)
+    check_parity("step-sync", run(step_eng))
+    step_compiles = cache.misses - base
+    if step_compiles > len(buckets) + 1:
+        print(f"FAIL: step-sync compiled {step_compiles} programs (cap {len(buckets) + 1})")
+        ok = False
+    n_step = _collective_count(step_hlo(step_eng))
+    if n_step < 1:
+        print("FAIL: step-sync step HLO carries no collective (psum merge missing?)")
+        ok = False
+
+    base = cache.misses
+    def_eng = StreamingEngine(
+        col(), EngineConfig(buckets=buckets, mesh=mesh, axis="dp", mesh_sync="deferred"),
+        aot_cache=cache,
+    )
+    check_parity("deferred", run(def_eng))
+    def_compiles = cache.misses - base
+    if def_compiles > len(buckets) + 2:  # update/bucket + merge + compute
+        print(f"FAIL: deferred compiled {def_compiles} programs (cap {len(buckets) + 2})")
+        ok = False
+    n_def = _collective_count(step_hlo(def_eng))
+    if n_def != 0:
+        print(f"FAIL: deferred steady step HLO carries {n_def} collectives (contract: 0)")
+        ok = False
+
+    # scan/cat metric on mesh — deferred only; must match the 1-device engine
+    au_batches = batches
+    single = StreamingEngine(AUROC(capacity=256), EngineConfig(buckets=buckets))
+    with single:
+        for b in au_batches:
+            single.submit(*b)
+        want_au = float(single.result())
+    au_eng = StreamingEngine(
+        AUROC(capacity=256),
+        EngineConfig(buckets=buckets, mesh=mesh, axis="dp", mesh_sync="deferred"),
+        aot_cache=cache,
+    )
+    with au_eng:
+        for b in au_batches:
+            au_eng.submit(*b)
+        got_au = float(au_eng.result())
+    if abs(got_au - want_au) > 1e-6:
+        print(f"FAIL: AUROC(capacity) deferred={got_au} single-device={want_au}")
+        ok = False
+
+    if ok:
+        print(
+            f"mesh-smoke PASS: {len(batches)} ragged batches on the {NUM_DEVICES}-device mesh == "
+            f"eager in BOTH sync modes; AUROC(capacity) deferred == single-device "
+            f"({got_au:.6f}); deferred step collectives=0 (step-sync: {n_step}); "
+            f"compiles step={step_compiles} deferred={def_compiles}, repeat streams compile 0"
+        )
+    return 0 if ok else 1
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    if len(jax.devices()) < NUM_DEVICES:
+        return _bootstrap()
+    return _impl()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,11 +28,15 @@ quantum. ``MultiStreamEngine`` collapses all of it:
 The compiled-program budget is UNCHANGED from the single-stream engine: at
 most ``len(buckets)`` update programs + 1 compute program, for any S.
 
-Scope: single-device (or single default-device) serving — the segmented
-scatter has no exact shard-and-merge form for mesh steps yet. Metrics must
-support the generic delta masked path (``segmented_update_unsupported_reason``
-is None): custom fused masked forms and scan-fallback members have no
-segmented counterpart.
+Scope: single-device serving, or a mesh under DEFERRED sync
+(``EngineConfig(mesh=..., mesh_sync="deferred")``): each shard then carries
+its own (S, ...)-stacked local states, the segmented scatter runs entirely
+within the shard (collective-free steady step), and ``result()`` rides one
+boundary merge of all streams at once. The step-sync mesh form does not
+exist — the per-step segmented scatter has no exact shard-and-merge. Metrics
+must support the generic delta masked path
+(``segmented_update_unsupported_reason`` is None): custom fused masked forms
+and scan-fallback members have no segmented counterpart.
 
 Quickstart::
 
@@ -72,17 +76,23 @@ class MultiStreamEngine(StreamingEngine):
     ):
         if not isinstance(num_streams, int) or num_streams <= 0:
             raise MetricsTPUUserError(f"num_streams must be a positive int, got {num_streams!r}")
-        if config is not None and config.mesh is not None:
+        if config is not None and config.mesh is not None and config.mesh_sync != "deferred":
             raise MetricsTPUUserError(
-                "MultiStreamEngine is single-device: the segmented scatter has no exact "
-                "shard-and-merge mesh form; use one StreamingEngine per mesh instead"
+                "MultiStreamEngine has no step-sync mesh form: the segmented scatter "
+                "has no exact per-step shard-and-merge; serve the mesh with "
+                "EngineConfig(mesh_sync='deferred') (shard-local stream states, "
+                "boundary merge) or use one StreamingEngine per mesh"
             )
         self._num_streams = int(num_streams)
         super().__init__(metric, config=config, aot_cache=aot_cache)
 
     # -------------------------------------------------------------- capability checks
 
-    def _serving_unsupported_reason(self, metric: Any) -> Optional[str]:
+    def _update_path_unsupported_reason(self, metric: Any) -> Optional[str]:
+        # only the UPDATE capability is multi-stream-specific; the base check
+        # keeps running the mesh-mode gates (notably the deferred-sync stacked
+        # merge requirement) on top of this — a metric that folds fine but
+        # cannot merge must refuse at construction, not at the first result()
         return metric.segmented_update_unsupported_reason()
 
     # ----------------------------------------------------------------- state plumbing
@@ -120,22 +130,24 @@ class MultiStreamEngine(StreamingEngine):
 
     def _compute_program(self):
         """One executable computes ANY stream: the stream index is a runtime
-        scalar argument, so S streams never cost S compiles."""
+        scalar argument, so S streams never cost S compiles. Under deferred
+        sync the input is the boundary-merged (S, ...)-stacked global state
+        instead of the carried shard-local arena."""
         sid_abs = jax.ShapeDtypeStruct((), jnp.int32)
         key = self._aot.program_key(
             f"compute_mstream+k.{self._kernel_tag()}", self._metric_fp,
-            arg_tree=(self._abstract_state(), sid_abs),
-            mesh=None, donate=False,
+            arg_tree=(self._compute_input_abstract(), sid_abs),
+            mesh=self._cfg.mesh, donate=False, sync=self._sync_tag(),
         )
-        metric, unpack = self._metric, self._unpack
+        metric = self._metric
 
         def build():
             def compute(state, sid):
-                row = jax.tree.map(lambda x: x[sid], unpack(state))
+                row = jax.tree.map(lambda x: x[sid], self._compute_tree(state))
                 return metric.compute_from(row)
 
             with self._kernel_scope():
-                return jax.jit(compute).lower(self._abstract_state(), sid_abs).compile()
+                return jax.jit(compute).lower(self._compute_input_abstract(), sid_abs).compile()
 
         return self._aot.get_or_compile(key, build)
 
@@ -159,19 +171,24 @@ class MultiStreamEngine(StreamingEngine):
 
     def result(self, stream_id: int) -> Any:  # type: ignore[override]
         """Flush, then compute ``stream_id``'s accumulated value (shared
-        compiled program, stream index passed at runtime)."""
+        compiled program, stream index passed at runtime). Under deferred
+        sync the flush is followed by one boundary merge of ALL streams'
+        shard-local states."""
         sid = self._check_stream(stream_id)
         self.flush()
         with self._state_lock:
-            return self._compute_program()(self._state, jnp.asarray(sid, jnp.int32))
+            state = self._merged_state() if self._deferred else self._state
+            return self._compute_program()(state, jnp.asarray(sid, jnp.int32))
 
     def results(self) -> Dict[int, Any]:
-        """Every stream's value (one flush, S cached-program calls)."""
+        """Every stream's value (one flush — and under deferred sync ONE
+        boundary merge — then S cached-program calls)."""
         self.flush()
         with self._state_lock:
+            state = self._merged_state() if self._deferred else self._state
             program = self._compute_program()
             return {
-                sid: program(self._state, jnp.asarray(sid, jnp.int32))
+                sid: program(state, jnp.asarray(sid, jnp.int32))
                 for sid in range(self._num_streams)
             }
 
@@ -182,22 +199,41 @@ class MultiStreamEngine(StreamingEngine):
         holds the engine's state lock, so it cannot interleave with a step
         that donates the live buffers (or be overwritten by one). Batches for
         this stream submitted after the call land in the fresh accumulation.
+        Under deferred sync the stream's row zeroes in EVERY shard's local
+        state (no collective needed — the write is shard-elementwise).
         """
         sid = self._check_stream(stream_id)
         self.flush()
         init = self._metric.init_state()
         with self._state_lock:
-            tree = jax.tree.map(
-                lambda x, i: x.at[sid].set(jnp.asarray(i, x.dtype)),
-                self._unpack(self._state), init,
-            )
-            self._state = self._put_state(tree)
+            if self._deferred:
+                stacked = (
+                    self._layout.unpack_stacked(self._state)
+                    if self._layout is not None
+                    else self._state
+                )
+                tree = jax.tree.map(
+                    lambda x, i: x.at[:, sid].set(jnp.asarray(i, x.dtype)), stacked, init
+                )
+                self._state = self._put_state(tree, stacked=True)
+            else:
+                tree = jax.tree.map(
+                    lambda x, i: x.at[sid].set(jnp.asarray(i, x.dtype)),
+                    self._unpack(self._state), init,
+                )
+                self._state = self._put_state(tree)
+            self._state_version += 1
 
     def stream_state(self, stream_id: int) -> Any:
-        """Defensive copy of one stream's LOGICAL state pytree (post-flush)."""
+        """One stream's LOGICAL state pytree (post-flush). A defensive copy
+        on the single-device path (the live buffers are donated into later
+        steps); under deferred sync the boundary-merged arrays are ordinary
+        non-donated program outputs, returned as-is."""
         sid = self._check_stream(stream_id)
         self.flush()
         with self._state_lock:
+            if self._deferred:
+                return jax.tree.map(lambda x: x[sid], self._merged_state())
             return jax.tree.map(
                 lambda x: jnp.array(x[sid], copy=True), self._unpack(self._state)
             )

@@ -40,14 +40,33 @@ Design notes:
   big-state metrics; ``metric.py`` documents the same policy for compiled
   forward). Donation is skipped on CPU, which doesn't implement it.
 * **Mesh-aware steps.** With ``config.mesh`` the step runs under ``shard_map``:
-  batch rows and mask shard over ``config.axis``, state stays replicated, the
-  per-shard masked delta is psum-merged in-step (``sync_states``) so the
-  carried state is always the GLOBAL state — compute needs no further sync,
-  and a snapshot taken between any two steps is globally consistent.
+  batch rows and mask shard over ``config.axis``. Two sync modes
+  (``config.mesh_sync``, pinned at construction, part of every program key):
+
+  - ``"step"`` (default): state stays replicated, the per-shard masked delta
+    is psum-merged in-step (``sync_states``) so the carried state is always
+    the GLOBAL state — compute needs no further sync, a snapshot between any
+    two steps is globally consistent, and every steady-state step pays one
+    fused cross-chip collective bundle.
+  - ``"deferred"``: the reference's own laziness (per-process local
+    accumulation, ``dist_reduce_fx`` merge only at compute) on a mesh. The
+    carried state is SHARD-LOCAL — every buffer gains a leading shard axis
+    sharded over ``config.axis`` — and the steady-state step is
+    COLLECTIVE-FREE (zero psum/pmin/pmax/all_gather in its jaxpr, pinned by
+    test). The merge moves to explicit boundaries (``result()``, ``state()``,
+    snapshot, cross-topology restore), where the whole state rides ONE fused
+    collective bundle (``parallel/collectives.py::fused_axis_sync``). Because
+    the merge now acts on STATES, not per-step deltas, scan-strategy metrics
+    (``AUROC(capacity=N)``'s cat-written buffers) serve on mesh: shards fold
+    their own rows sequentially and the boundary merge all-gathers the
+    buffers — exactly ``dist_reduce_fx="cat"``. Note capacity is then
+    PER-SHARD (world x N rows fit before overflow).
 * **Virtual-mesh serialization.** On CPU meshes overlapping async collective
   executions can deadlock the in-process communicator
-  (``parallel/embedded.py``); the engine serializes steps there. Real TPU
-  meshes keep the full ``in_flight`` pipeline.
+  (``parallel/embedded.py``); the engine serializes steps there — in
+  ``"step"`` mode only. Deferred steady steps carry no collectives, so even
+  CPU meshes keep the full ``in_flight`` pipeline (boundary merges are
+  blocked on under the state lock instead).
 * **Recovery.** ``snapshot_every > 0`` writes crash-safe periodic snapshots
   (``engine/snapshot.py``); ``restore()`` resumes exactly — replaying the
   stream from the snapshot's step reproduces the uninterrupted result.
@@ -129,6 +148,17 @@ class EngineConfig:
             backends sharing an ``AotCache`` never exchange executables.
         mesh: optional ``jax.sharding.Mesh`` for sharded engine steps.
         axis: mesh axis name carrying the batch shards.
+        mesh_sync: WHEN shard contributions merge on a mesh (ignored without
+            one). ``"step"`` (default) psum-merges the per-shard deltas inside
+            every step — the carried state is globally consistent at all
+            times, at one cross-chip collective bundle per step. ``"deferred"``
+            carries shard-LOCAL state, keeps the steady-state step free of
+            collectives, and merges whole states at explicit boundaries
+            (``result()``/``state()``/snapshot) with one fused collective
+            bundle — the reference's per-process accumulation semantics, and
+            the only mode that serves ``cat``/scan-strategy metrics (e.g.
+            ``AUROC(capacity=N)``) on a mesh. Pinned at construction; part of
+            every AOT program key.
         donate: donate state buffers into each step (ignored on CPU).
         pad_value: fill for pad rows (must pass the metric's input checks;
             masked out of every reduction regardless).
@@ -148,6 +178,7 @@ class EngineConfig:
     kernel_backend: Optional[str] = None
     mesh: Optional[Any] = None
     axis: str = "dp"
+    mesh_sync: str = "step"
     donate: bool = True
     pad_value: Any = 0
     telemetry_capacity: int = 1024
@@ -165,6 +196,16 @@ class StreamingEngine:
     def __init__(self, metric: Any, config: Optional[EngineConfig] = None, aot_cache: Optional[AotCache] = None):
         self._metric = metric
         self._cfg = config or EngineConfig()
+        if self._cfg.mesh_sync not in ("step", "deferred"):
+            raise MetricsTPUUserError(
+                f"mesh_sync must be 'step' or 'deferred', got {self._cfg.mesh_sync!r}"
+            )
+        if self._cfg.mesh_sync == "deferred" and self._cfg.mesh is None:
+            raise MetricsTPUUserError(
+                "mesh_sync='deferred' needs a mesh: without one there are no shard-"
+                "local states to defer the merge of (drop mesh_sync or set mesh)"
+            )
+        self._deferred = self._cfg.mesh is not None and self._cfg.mesh_sync == "deferred"
         reason = self._serving_unsupported_reason(metric)
         if reason is not None:
             raise MetricsTPUUserError(
@@ -173,6 +214,7 @@ class StreamingEngine:
         divisor = 1
         if self._cfg.mesh is not None:
             divisor = int(np.prod([self._cfg.mesh.shape[a] for a in self._axis_names()]))
+        self._world = divisor  # shards carrying local state under deferred sync
         self._policy = BucketPolicy(self._cfg.buckets, pad_value=self._cfg.pad_value, divisor=divisor)
         self._aot = aot_cache if aot_cache is not None else AotCache(self._cfg.compilation_cache_dir)
         self._stats = EngineStats(self._cfg.telemetry_capacity)
@@ -213,22 +255,55 @@ class StreamingEngine:
             self._cfg.kernel_backend if self._cfg.kernel_backend is not None else current_backend()
         )
         resolve_backend(self._kernel_backend)
+        self._merged_abs_memo: Optional[Any] = None
+        # boundary-merge memo: (state_version, merged) — repeat reads between
+        # updates (result() polls over S streams, state() after result())
+        # reuse one merge instead of paying a collective bundle each
+        self._state_version = 0
+        self._merged_memo: Optional[Tuple[int, Any]] = None
         self._state = self._put_state(self._init_state_tree())
         self._donate = bool(self._cfg.donate) and jax.default_backend() != "cpu"
+        # deferred steady steps carry ZERO collectives, so the CPU in-process
+        # communicator hazard doesn't apply — only step-sync CPU meshes
+        # serialize; boundary merges block under the state lock in both modes
         self._serialize = (
-            self._cfg.mesh is not None and self._cfg.mesh.devices.flat[0].platform == "cpu"
+            self._cfg.mesh is not None
+            and self._cfg.mesh.devices.flat[0].platform == "cpu"
+            and not self._deferred
+        )
+        self._stats.mesh_sync = (
+            None if self._cfg.mesh is None else ("deferred" if self._deferred else "step")
         )
 
     # -------------------------------------------------------------- capability checks
 
+    def _update_path_unsupported_reason(self, metric: Any) -> Optional[str]:
+        """The engine-kind-specific update capability (subclasses reroute:
+        multi-stream needs the segmented path). Mesh-mode checks stay in
+        :meth:`_serving_unsupported_reason` so every engine kind gets them."""
+        return metric.masked_update_unsupported_reason()
+
     def _serving_unsupported_reason(self, metric: Any) -> Optional[str]:
-        reason = metric.masked_update_unsupported_reason()
+        reason = self._update_path_unsupported_reason(metric)
         if reason is not None:
             return reason
         if self._cfg is not None and self._cfg.mesh is not None:
-            r = _mesh_step_unsupported_reason(metric)
-            if r is not None:
-                return r
+            if self._cfg.mesh_sync == "deferred":
+                # deferred mode needs no per-step delta merge — any masked
+                # strategy (delta/custom/scan) runs shard-locally — but the
+                # BOUNDARY merge folds whole states by their dist_reduce_fx,
+                # so every state must have a canonical stacked merge
+                r = (
+                    metric.stacked_merge_unsupported_reason()
+                    if hasattr(metric, "stacked_merge_unsupported_reason")
+                    else None
+                )
+                if r is not None:
+                    return f"deferred-sync mesh serving needs dist_reduce_fx-mergeable states: {r}"
+            else:
+                r = _mesh_step_unsupported_reason(metric)
+                if r is not None:
+                    return r
         return None
 
     # ------------------------------------------------------------------ mesh helpers
@@ -263,9 +338,55 @@ class StreamingEngine:
     def _unpack(self, carried: Any) -> Any:
         return self._layout.unpack(carried) if self._layout is not None else carried
 
-    def _put_state(self, state: Any, packed: bool = False) -> Any:
-        """Device-commit a state (replicated over the mesh, if any). ``state``
-        is the logical pytree unless ``packed`` says it is already an arena."""
+    def _stack_shards(self, tree: Any) -> Any:
+        """Logical state tree -> shard-stacked tree: every leaf gains a
+        leading ``world`` axis, each row an identical copy (every shard starts
+        its local accumulation from the metric's defaults — the reference's
+        per-process semantics)."""
+        return jax.tree.map(
+            lambda x: jnp.tile(jnp.asarray(x)[None], (self._world,) + (1,) * jnp.ndim(x)),
+            tree,
+        )
+
+    def _shard0_stack(self, tree: Any) -> Any:
+        """Logical state tree -> shard-stacked tree with the WHOLE state in
+        shard 0 and the identity (init) state everywhere else — the exact
+        deferred embedding of a global state: the boundary merge folds the
+        identity rows away (sum+0, min/max vs identity, cat of invalid-marked
+        buffers), so compute recovers the embedded state unchanged. Used when
+        restoring a single-device/step-sync snapshot into a deferred engine."""
+        init = self._init_state_tree()
+
+        def one(s: Any, i: Any) -> Any:
+            s = jnp.asarray(s)
+            if self._world == 1:
+                return s[None]
+            rest = jnp.tile(jnp.asarray(i, s.dtype)[None], (self._world - 1,) + (1,) * s.ndim)
+            return jnp.concatenate([s[None], rest], axis=0)
+
+        return jax.tree.map(one, tree, init)
+
+    def _shard_sharding(self):
+        """Dim-0-sharded (shard-local) placement for deferred carried state."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return NamedSharding(self._cfg.mesh, P(self._cfg.axis))
+
+    def _put_state(self, state: Any, packed: bool = False, stacked: bool = False) -> Any:
+        """Device-commit a state. ``state`` is the logical pytree unless
+        ``packed``/``stacked`` say it is already in the carried form. Step
+        mode replicates over the mesh; deferred mode stacks every leaf over a
+        leading shard axis (``stacked=False`` tiles the logical state to every
+        shard) and shards dim 0 over the mesh axis — each device owns exactly
+        its local state."""
+        if self._deferred:
+            if not stacked:
+                state = self._stack_shards(jax.tree.map(jnp.asarray, state))
+                packed = False
+            if not packed and self._layout is not None:
+                state = self._layout.pack_stacked(state)
+            sh = self._shard_sharding()
+            return jax.tree.map(lambda x: jax.device_put(jnp.asarray(x), sh), state)
         if not packed:
             state = self._pack(jax.tree.map(jnp.asarray, state))
         if self._cfg.mesh is None:
@@ -275,12 +396,38 @@ class StreamingEngine:
 
     def _abstract_state(self) -> Any:
         """The CARRIED state's lowering template: packed arena (or logical
-        pytree), with replicated shardings under a mesh."""
-        abs_state = self._layout.abstract() if self._layout is not None else self._metric.abstract_state()
+        pytree) — replicated under a step-sync mesh, shard-stacked and dim-0
+        sharded under deferred sync."""
+        if self._deferred:
+            if self._layout is not None:
+                abs_state = self._layout.abstract_stacked(self._world)
+            else:
+                abs_state = jax.tree.map(
+                    lambda s: jax.ShapeDtypeStruct((self._world,) + tuple(s.shape), s.dtype),
+                    self._abstract_state_tree(),
+                )
+            sh = self._shard_sharding()
+            return jax.tree.map(
+                lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh), abs_state
+            )
+        abs_state = self._layout.abstract() if self._layout is not None else self._abstract_state_tree()
         if self._cfg.mesh is None:
             return abs_state
         rep = self._replicated_sharding()
         return jax.tree.map(lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=rep), abs_state)
+
+    def _merged_abstract(self) -> Any:
+        """Shape/dtype template of the deferred boundary merge's output — the
+        GLOBAL logical state (``cat`` buffers concatenated across shards).
+        Derived from ``Metric.merge_stacked_states``, whose output layout
+        matches the on-device ``sync_states`` merge exactly."""
+        if self._merged_abs_memo is None:
+            stacked_abs = jax.tree.map(
+                lambda s: jax.ShapeDtypeStruct((self._world,) + tuple(s.shape), s.dtype),
+                self._abstract_state_tree(),
+            )
+            self._merged_abs_memo = jax.eval_shape(self._metric.merge_stacked_states, stacked_abs)
+        return self._merged_abs_memo
 
     # ------------------------------------------------------------------ AOT programs
 
@@ -313,7 +460,7 @@ class StreamingEngine:
         key = self._aot.program_key(
             f"{self._update_kind()}+k.{self._kernel_tag()}", self._metric_fp,
             arg_tree=(self._abstract_state(), payload_abs, mask_abs),
-            mesh=self._cfg.mesh, donate=self._donate,
+            mesh=self._cfg.mesh, donate=self._donate, sync=self._sync_tag(),
         )
         prog = self._aot.get_or_compile(
             key, lambda: self._build_update_program(payload_abs, mask_abs)
@@ -323,6 +470,14 @@ class StreamingEngine:
 
     def _update_kind(self) -> str:
         return "update"
+
+    def _sync_tag(self) -> str:
+        """The mesh sync mode every program key carries: step-sync and
+        deferred engines lower DIFFERENT programs over identical payload
+        signatures (in-step collectives vs none; replicated vs shard-local
+        state), so engines in different modes sharing an ``AotCache`` must
+        never exchange executables."""
+        return "deferred" if self._deferred else "step"
 
     def _kernel_tag(self) -> str:
         """The RESOLVED kernel backend this engine's programs lower with —
@@ -368,11 +523,21 @@ class StreamingEngine:
             with self._kernel_scope():  # kernel dispatch happens at trace time
                 return jitted.lower(self._abstract_state(), payload_abs, mask_abs).compile()
 
-        from metrics_tpu.parallel.embedded import sharded_masked_step
+        from metrics_tpu.parallel.embedded import sharded_local_step, sharded_masked_step
 
-        sharded = sharded_masked_step(
-            self._metric, mesh, self._cfg.axis, payload_abs, mask_abs, layout=self._layout
-        )
+        if self._deferred:
+            # collective-free shard-local step: each device folds its own rows
+            # into its own state row; merge happens at explicit boundaries
+            sharded = sharded_local_step(
+                self._traced_update, mesh, self._cfg.axis, payload_abs, mask_abs,
+                state_template=self._abstract_state(),
+                unpack=self._unpack if self._layout is not None else None,
+                pack=self._pack if self._layout is not None else None,
+            )
+        else:
+            sharded = sharded_masked_step(
+                self._metric, mesh, self._cfg.axis, payload_abs, mask_abs, layout=self._layout
+            )
         jitted = jax.jit(sharded, donate_argnums=(0,) if self._donate else ())
         n_rows = mask_abs.shape[0]
         batch_sh = self._batch_sharding()
@@ -390,25 +555,85 @@ class StreamingEngine:
         with self._kernel_scope():
             return jitted.lower(self._abstract_state(), payload_abs, mask_sharded).compile()
 
+    def _compute_input_abstract(self) -> Any:
+        """What the compute program takes: the carried state (step mode) or
+        the boundary merge's replicated GLOBAL state (deferred mode)."""
+        if not self._deferred:
+            return self._abstract_state()
+        rep = self._replicated_sharding()
+        return jax.tree.map(
+            lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=rep),
+            self._merged_abstract(),
+        )
+
+    def _compute_tree(self, state: Any) -> Any:
+        """Trace-time view of the compute input as the LOGICAL state tree
+        (merged deferred states arrive already logical; carried states
+        unpack from the arena)."""
+        return state if self._deferred else self._unpack(state)
+
     def _compute_program(self):
         # compute programs carry the kernel tag too: functional compute code
         # can route through the dispatcher (e.g. the bincount family)
         key = self._aot.program_key(
             f"compute+k.{self._kernel_tag()}", self._metric_fp,
-            arg_tree=self._abstract_state(),
-            mesh=self._cfg.mesh, donate=False,
+            arg_tree=self._compute_input_abstract(),
+            mesh=self._cfg.mesh, donate=False, sync=self._sync_tag(),
         )
-        metric, unpack = self._metric, self._unpack
+        metric = self._metric
 
         def build():
             with self._kernel_scope():
                 return (
-                    jax.jit(lambda state: metric.compute_from(unpack(state)))
-                    .lower(self._abstract_state())
+                    jax.jit(lambda state: metric.compute_from(self._compute_tree(state)))
+                    .lower(self._compute_input_abstract())
                     .compile()
                 )
 
         return self._aot.get_or_compile(key, build)
+
+    def _merge_program(self):
+        """The deferred boundary merge: shard-local carried state -> replicated
+        global logical state, one fused collective bundle
+        (``parallel/embedded.py::sharded_state_merge``). Cached like every
+        other program; compiled lazily at the first boundary."""
+        key = self._aot.program_key(
+            f"merge+k.{self._kernel_tag()}", self._metric_fp,
+            arg_tree=self._abstract_state(),
+            mesh=self._cfg.mesh, donate=False, sync=self._sync_tag(),
+        )
+
+        def build():
+            from metrics_tpu.parallel.embedded import sharded_state_merge
+
+            merge = sharded_state_merge(
+                self._metric, self._cfg.mesh, self._cfg.axis,
+                state_template=self._abstract_state(),
+                unpack=self._unpack if self._layout is not None else None,
+            )
+            with self._kernel_scope():
+                return jax.jit(merge).lower(self._abstract_state()).compile()
+
+        return self._aot.get_or_compile(key, build)
+
+    def _merged_state(self) -> Any:
+        """Run the boundary merge on the carried shard-local state (deferred
+        mode; caller holds the state lock). Blocked on before returning: the
+        merge bears the collectives, and keeping it serialized under the lock
+        is what lets the steady-state pipeline stay async even on CPU meshes.
+        Memoized on the state version — reads with no intervening updates
+        (polling S streams' results, state() after result()) share ONE merge;
+        the merged arrays are ordinary non-donated program outputs, immutable
+        and safe to hand out repeatedly."""
+        if self._merged_memo is not None and self._merged_memo[0] == self._state_version:
+            return self._merged_memo[1]
+        program = self._merge_program()  # compile (first boundary) outside the timing
+        t0 = time.perf_counter()
+        merged = program(self._state)
+        jax.block_until_ready(merged)
+        self._stats.record_merge((time.perf_counter() - t0) * 1e6)
+        self._merged_memo = (self._state_version, merged)
+        return merged
 
     # --------------------------------------------------------------------- lifecycle
 
@@ -458,19 +683,34 @@ class StreamingEngine:
         self._raise_if_failed()
 
     def result(self) -> Any:
-        """Flush, then run the AOT-compiled compute on the accumulated state."""
+        """Flush, then run the AOT-compiled compute on the accumulated state.
+
+        Under deferred sync the flush is followed by the boundary merge (one
+        fused collective bundle), so the value reflects every batch submitted
+        before the call — same freshness as step sync; what deferred mode
+        trades away is only the GLOBAL consistency of the carried state
+        BETWEEN boundaries, never of a returned result."""
         self.flush()
         with self._state_lock:
-            return self._compute_program()(self._state)
+            state = self._merged_state() if self._deferred else self._state
+            return self._compute_program()(state)
 
     def state(self) -> Any:
         """A defensive copy of the accumulated (global) LOGICAL state pytree,
         after a flush. Copied because the live buffers are DONATED into the
         next update step — a borrowed reference would read as deleted after
         the caller submits more traffic. Arenas are unpacked: callers see the
-        metric's own state layout either way."""
+        metric's own state layout either way. Under deferred sync this is the
+        MERGED global state — memoized non-donated program outputs (no copy
+        needed; at most one boundary collective per state version), with
+        ``cat`` buffers concatenated across shards, so their leading dim is
+        world x the per-shard capacity."""
         self.flush()
         with self._state_lock:
+            if self._deferred:
+                # no copy needed: the merged arrays are non-donated program
+                # outputs — immutable and never deleted by later steps
+                return self._merged_state()
             return jax.tree.map(lambda x: jnp.array(x, copy=True), self._unpack(self._state))
 
     @property
@@ -508,6 +748,7 @@ class StreamingEngine:
             self._error = None
             self._inflight.clear()
             self._state = self._put_state(self._init_state_tree())
+            self._state_version += 1
             self._step = 0
             self._batches_done = 0
 
@@ -525,7 +766,12 @@ class StreamingEngine:
             return self._save_snapshot_locked()
 
     def _save_snapshot_locked(self) -> str:
-        host_state = jax.device_get(self._state)  # the carried form: arena = 1 payload/dtype
+        # the carried form: arena = 1 payload/dtype. Under deferred sync the
+        # payload is the SHARD-STACKED arena — every shard's local state, i.e.
+        # full provenance: the merged view is derivable (merge_stacked_states)
+        # but the locals are not recoverable from it, and exact kill/resume
+        # replay needs the locals (each shard must resume with ITS rows)
+        host_state = jax.device_get(self._state)
         path = save_snapshot(
             self._cfg.snapshot_dir,
             host_state,
@@ -536,6 +782,8 @@ class StreamingEngine:
                 "rows_padded": self._stats.rows_padded,
                 "packed": int(self._layout is not None),
                 "arena_fp": self._layout.fingerprint() if self._layout is not None else "",
+                "mesh_sync": self._sync_tag() if self._cfg.mesh is not None else "single",
+                "world": self._world if self._deferred else 1,
             },
             keep=self._cfg.snapshot_keep,
             host_attrs=self._metric.host_compute_attrs(),
@@ -562,6 +810,8 @@ class StreamingEngine:
         # VALIDATE before mutating anything: a failed restore must leave the
         # live engine (metric attrs, fingerprint, memo, state) untouched
         packed = bool(int(meta.get("packed", 0)))
+        snap_deferred = str(meta.get("mesh_sync", "") or "") == "deferred"
+        snap_world = int(meta.get("world", 1))
         if packed:
             if self._layout is None:
                 raise MetricsTPUUserError(
@@ -572,14 +822,51 @@ class StreamingEngine:
             # leaves (identical buffers, scrambled unpack) — the layout
             # FINGERPRINT in meta is the sufficient check
             saved_fp = str(meta.get("arena_fp", "") or "")
-            if not self._layout.matches(state) or (saved_fp and saved_fp != self._layout.fingerprint()):
+            shape_ok = self._layout.matches(state, world=snap_world if snap_deferred else None)
+            if not shape_ok or (saved_fp and saved_fp != self._layout.fingerprint()):
                 raise MetricsTPUUserError(
                     "snapshot arena does not match this metric's layout "
                     f"({self._layout!r}); was the metric reconfigured since the snapshot?"
                 )
         # device-commit FIRST: on the unpacked path _put_state packs, which is
-        # the last fallible step — the metric must not be mutated before it
-        new_state = self._put_state(state, packed=packed)
+        # the last fallible step — the metric must not be mutated before it.
+        # The mode/topology matrix:
+        #   deferred snapshot -> same-world deferred engine: shard provenance
+        #     restores VERBATIM (each shard resumes with exactly its local
+        #     state — replay from batches_done is bit-exact);
+        #   deferred snapshot -> anything else: the shard locals merge on the
+        #     host (merge_stacked_states) into the global state — exact for
+        #     dist_reduce_fx-mergeable states; refused when the merged shapes
+        #     no longer fit the engine's template (cat buffers grow with the
+        #     shard count — those need a same-world deferred engine);
+        #   step/single snapshot -> deferred engine: the global state embeds
+        #     into shard 0 with identity states elsewhere (the merge folds
+        #     the identities away, so compute is unchanged).
+        if snap_deferred and self._deferred and snap_world == self._world:
+            new_state = self._put_state(state, packed=packed, stacked=True)
+        elif snap_deferred:
+            stacked_tree = self._layout.unpack_stacked(state) if packed else state
+            logical = self._metric.merge_stacked_states(stacked_tree)
+            template_leaves, template_def = jax.tree_util.tree_flatten(self._abstract_state_tree())
+            leaves, treedef = jax.tree_util.tree_flatten(logical)
+            if treedef != template_def or any(
+                tuple(l.shape) != tuple(t.shape) for l, t in zip(leaves, template_leaves)
+            ):
+                raise MetricsTPUUserError(
+                    f"deferred snapshot (world={snap_world}) merges to state shapes this "
+                    f"engine cannot carry (cat-state buffers scale with the shard count); "
+                    "restore it into a deferred engine with the same mesh size"
+                )
+            new_state = (
+                self._put_state(self._shard0_stack(logical), stacked=True)
+                if self._deferred
+                else self._put_state(logical)
+            )
+        elif self._deferred:
+            logical = self._unpack(state) if packed else state
+            new_state = self._put_state(self._shard0_stack(logical), stacked=True)
+        else:
+            new_state = self._put_state(state, packed=packed)
         with self._state_lock:
             attrs = meta.get("host_attrs")
             if attrs:
@@ -597,6 +884,7 @@ class StreamingEngine:
                 v is None for v in self._metric.host_compute_attrs().values()
             )
             self._state = new_state
+            self._state_version += 1
             self._error = None
             self._inflight.clear()
             # the replay cursor commits in the SAME critical section as the
@@ -839,6 +1127,7 @@ class StreamingEngine:
                 depth = self._queue.qsize()
                 new_state, token = program(self._state, payload, mask_dev)
                 self._state = new_state
+                self._state_version += 1
                 self._step += 1
                 sync_us = self._bound_inflight(token)
                 self._stats.record_step(
@@ -915,10 +1204,13 @@ def _aux_leaves_equal(a: Any, b: Any) -> bool:
 
 
 def _mesh_step_unsupported_reason(metric: Any) -> Optional[str]:
-    """Mesh steps merge per-shard DELTAS (masked update from a fresh state,
-    psum-synced, merged into the carry) — exact for delta/custom masked
+    """STEP-SYNC mesh steps merge per-shard DELTAS (masked update from a fresh
+    state, psum-synced, merged into the carry) — exact for delta/custom masked
     strategies, but NOT for scan-fallback members, whose states (e.g. the
-    static-capacity curve buffers) do not merge by their reduction."""
+    static-capacity curve buffers) do not merge by their reduction per step.
+    Deferred sync (``mesh_sync="deferred"``) has no such restriction: shards
+    fold their own rows into shard-local state and the boundary merge
+    all-gathers the buffers."""
     strategies = (
         metric.masked_update_strategies()
         if hasattr(metric, "masked_update_strategies")
@@ -928,6 +1220,7 @@ def _mesh_step_unsupported_reason(metric: Any) -> Optional[str]:
         if s == "scan":
             return (
                 f"member {name!r} needs the sequential masked fallback, which has no "
-                "exact mesh (shard-and-merge) form; serve it on a single device"
+                "exact step-sync mesh (shard-and-merge) form; serve it on a single "
+                "device or under EngineConfig(mesh_sync='deferred')"
             )
     return None

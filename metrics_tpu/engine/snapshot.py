@@ -30,6 +30,16 @@ from DATA during update (``Metric.host_compute_attrs`` — e.g. ``Accuracy``'s
 input-mode latch) serialize as a JSON byte array (enums encoded by class
 path + value), so a restored engine computes immediately — no "one
 post-restore batch" warmup.
+
+Shard provenance (deferred-sync mesh engines): the state subtree is the
+SHARD-STACKED arena — row ``k`` of every per-dtype buffer is shard ``k``'s
+local state — and the meta carries ``mesh_sync="deferred"`` plus ``world``
+(the shard count). The merged global view is derivable from the locals
+(``Metric.merge_stacked_states``) but not vice versa, and exact kill/resume
+replay REQUIRES the locals: on resume each shard must continue from exactly
+the rows it had folded. ``engine/pipeline.py::restore`` uses the provenance
+to pick the restore path (verbatim same-world restore / host merge into a
+step-sync or single-device engine / shard-0 embedding the other way).
 """
 import importlib
 import json

@@ -45,6 +45,24 @@ class EngineStats:
         self.rows_padded = 0
         self.snapshots = 0
         self.resumes = 0
+        # mesh sync accounting: "step" engines pay a collective inside every
+        # step (its latency shows up as the per-step sync_us when the
+        # dispatcher blocks); "deferred" engines pay collectives only at
+        # explicit merge boundaries, recorded here. None = no mesh. The
+        # *_us_total counters are LIFETIME sums (unlike the bounded ring), so
+        # collective_share compares like with like on runs longer than the
+        # ring window.
+        self.mesh_sync: Optional[str] = None
+        self.merges = 0
+        self.merge_us_total = 0.0
+        self.wall_us_total = 0.0
+        self.sync_us_total = 0.0
+
+    def record_merge(self, merge_us: float) -> None:
+        """One deferred-sync boundary merge (result()/snapshot/restore): the
+        fused collective bundle's host-observed latency."""
+        self.merges += 1
+        self.merge_us_total += float(merge_us)
 
     def record_step(
         self,
@@ -68,12 +86,14 @@ class EngineStats:
         }
         if sync_us is not None:
             rec["sync_us"] = round(sync_us, 1)
+            self.sync_us_total += float(sync_us)
         if pad_us is not None:
             rec["pad_us"] = round(pad_us, 1)
         if queue_wait_us is not None:
             rec["queue_wait_us"] = round(queue_wait_us, 1)
         if wall_us is not None:
             rec["wall_us"] = round(wall_us, 1)
+            self.wall_us_total += float(wall_us) + float(queue_wait_us or 0.0)
         if coalesced is not None:
             rec["coalesced"] = int(coalesced)
             if coalesced > 1:
@@ -128,23 +148,70 @@ class EngineStats:
                 ) if self.steps else None,
             },
         }
-        shares = self._host_time_shares(recent)
+        shares = self._host_time_shares(recent, self.mesh_sync)
         if shares is not None:
             out["host_time_shares"] = shares
+        if self.mesh_sync is not None:
+            out["mesh_sync"] = self._mesh_sync_summary()
         if aot_stats is not None:
             out["compile_cache"] = aot_stats
         return out
 
+    def _mesh_sync_summary(self) -> Dict[str, Any]:
+        """Where this mesh engine's collective time lives: inside blocked
+        steps (``step`` mode) or at explicit merge boundaries (``deferred``
+        mode). ``collective_share`` uses LIFETIME totals in both modes
+        (merges are boundary events the bounded step ring never sees — mixing
+        a lifetime merge sum with a windowed wall would inflate the share
+        without bound on long runs) — the step-vs-deferred comparison
+        ``tools/engine_report.py`` renders.
+
+        The step-mode share is an UPPER BOUND (flagged in the summary): the
+        blocked wait covers the whole in-step program — masked-update compute
+        AND the collective bundle — because the host cannot observe where
+        device time went inside one executable. A compute-heavy metric can
+        dominate that wait with update math; before attributing it to the
+        collective, A/B the same stream against ``mesh_sync="deferred"`` (or
+        the ``engine_mesh_dispatch`` step-latency isolate) — only the delta
+        is the collective."""
+        out: Dict[str, Any] = {
+            "mode": self.mesh_sync,
+            "merges": self.merges,
+            "merge_us_total": round(self.merge_us_total, 1),
+        }
+        if self.mesh_sync == "deferred":
+            denom = self.wall_us_total + self.merge_us_total
+            out["collective_share"] = (
+                round(self.merge_us_total / denom, 4) if denom > 0 else None
+            )
+        else:
+            out["collective_share"] = (
+                round(self.sync_us_total / self.wall_us_total, 4)
+                if self.wall_us_total > 0
+                else None
+            )
+            out["collective_share_is_upper_bound"] = True
+        return out
+
     @staticmethod
-    def _host_time_shares(recent: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    def _host_time_shares(
+        recent: List[Dict[str, Any]], mesh_sync: Optional[str] = None
+    ) -> Optional[Dict[str, Any]]:
         """Attribute the dispatcher's wall time over the ring window: padding,
-        queue wait (idle, producer-bound), blocked device sync (device-bound),
-        and the residual dispatch overhead (program-call + upload — the share
-        the arena/coalescing optimizations exist to amortize). The ``regime``
+        queue wait (idle, producer-bound), blocked device sync, and the
+        residual dispatch overhead (program-call + upload — the share the
+        arena/coalescing optimizations exist to amortize). The ``regime``
         label is what ``tools/engine_report.py`` surfaces: a step loop is
         *dispatch-bound* when the residual dominates, *pad-bound* when host
-        padding/concat does, *device-bound* when blocked sync does, *starved*
-        when the queue wait does."""
+        padding/concat does, *starved* when the queue wait does. A dominant
+        blocked-sync share reads *device-bound* off-mesh and under deferred
+        sync, but *sync-bound* for a step-sync mesh engine — blocked there
+        means waiting on SYNCHRONIZED steps, which bundle the cross-chip
+        collective WITH the update compute (the host cannot split device
+        time inside one executable): treat it as "the per-step sync
+        discipline is the bottleneck, up to its compute content" and confirm
+        with a ``mesh_sync="deferred"`` A/B before concluding a faster
+        device wouldn't help (see ``_mesh_sync_summary``)."""
         timed = [r for r in recent if "wall_us" in r]
         if not timed:
             return None
@@ -167,7 +234,7 @@ class EngineStats:
             "dispatch": "dispatch-bound",
             "pad": "pad-bound",
             "queue_wait": "starved",
-            "blocked_sync": "device-bound",
+            "blocked_sync": "sync-bound" if mesh_sync == "step" else "device-bound",
         }[regime]
         shares["window_steps"] = len(timed)
         return shares

@@ -43,6 +43,7 @@ from metrics_tpu.ops.kernels import (
     fold_rows_masked,
     reduce_identity as _reduce_identity,
     segment_reduce_masked,
+    stack_reduce as _stack_reduce,
 )
 from metrics_tpu.parallel.collectives import (
     AxisSpec,
@@ -788,6 +789,78 @@ class Metric:
             f"State '{name}' of {type(self).__name__} has a custom/None dist_reduce_fx and no "
             "_merge_state override; cannot merge pairwise."
         )
+
+    def stacked_merge_unsupported_reason(self) -> Optional[str]:
+        """None when :meth:`merge_stacked_states` applies: every state
+        (recursively) is a fixed-shape array whose ``dist_reduce_fx`` is one
+        of sum/min/max/cat. This is the deferred-sync mesh serving contract
+        (``engine/pipeline.py``): shard-local states must have a well-defined
+        stack-axis merge that equals the reference's ``dist_reduce_fx`` sync —
+        list states have no static stacked form, and None/callable reductions
+        have no canonical fold."""
+        for k, v in self._defaults.items():
+            if isinstance(v, list):
+                return f"state {k!r} is a list (cat/gather) state with no static shape"
+            if self._reductions[k] not in _MERGEABLE_FX:
+                return f"state {k!r} has dist_reduce_fx={self._reductions[k]!r} (no stacked merge)"
+        for name, child in self._child_metrics().items():
+            children = child if isinstance(child, list) else [child]
+            for c in children:
+                r = c.stacked_merge_unsupported_reason()
+                if r is not None:
+                    return f"nested metric {name!r}: {r}"
+        return None
+
+    def merge_stacked_states(self, stacked: Dict[str, Any]) -> Dict[str, Any]:
+        """Fold a leading STACK axis of per-replica states into one global state.
+
+        The deferred-sync mesh engine carries one local state per shard
+        (leading axis = shard); the boundary merge applies each state's
+        ``dist_reduce_fx`` across that axis — the reference's per-process sync
+        semantics (``metric.py:240-252``), moved from per-step deltas to
+        whole states. sum/min/max fold with the kernel library's pairwise
+        combine (``ops/kernels/common.py`` — the same identities the masked
+        paths substitute, dtype-preserving); ``cat`` states flatten the stack
+        axis into dim 0, matching ``all_gather_cat``'s tiled layout bit for
+        bit. Traced or eager (the engine uses it on-device inside the merge
+        shape derivation and on the host when restoring a deferred snapshot
+        into a different topology).
+        """
+        out: Dict[str, Any] = {}
+        if self._CHILD_KEY in stacked:
+            children = self._child_metrics()
+            out[self._CHILD_KEY] = {}
+            for name, child_stacked in stacked[self._CHILD_KEY].items():
+                child = children.get(name)
+                if child is None:
+                    # stale subtree (metric reconfigured since the states were
+                    # produced): pass through verbatim — same policy as
+                    # _sync_child_states — so the caller's shape validation
+                    # reports the mismatch instead of an AttributeError here
+                    out[self._CHILD_KEY][name] = child_stacked
+                elif isinstance(child, list):
+                    out[self._CHILD_KEY][name] = [
+                        c.merge_stacked_states(cs) for c, cs in zip(child, child_stacked)
+                    ]
+                else:
+                    out[self._CHILD_KEY][name] = child.merge_stacked_states(child_stacked)
+        for k in self._defaults:
+            fx = self._reductions[k]
+            v = stacked[k]
+            if isinstance(self._defaults[k], list) or fx not in _MERGEABLE_FX:
+                raise MetricsTPUUserError(
+                    f"{type(self).__name__} has no stacked state merge: "
+                    f"{self.stacked_merge_unsupported_reason()}."
+                )
+            if fx == "cat":
+                v = jnp.asarray(v)
+                if v.ndim == 1:  # per-shard SCALAR cat state: the stack IS the cat
+                    out[k] = v
+                else:
+                    out[k] = jnp.reshape(v, (v.shape[0] * v.shape[1],) + v.shape[2:])
+            else:
+                out[k] = _stack_reduce(v, fx)
+        return out
 
     @property
     def _states_mergeable(self) -> bool:

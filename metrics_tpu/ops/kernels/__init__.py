@@ -10,7 +10,7 @@ Three primitives behind a runtime backend dispatcher — see
 
 Smoke gate: ``make kernels-smoke`` (``metrics_tpu/ops/kernels/smoke.py``).
 """
-from metrics_tpu.ops.kernels.common import REDUCE_OPS, reduce_identity
+from metrics_tpu.ops.kernels.common import REDUCE_OPS, reduce_identity, stack_reduce
 from metrics_tpu.ops.kernels.dispatch import (
     BACKEND_ENV_VAR,
     BACKENDS,
@@ -36,5 +36,6 @@ __all__ = [
     "resolve_backend",
     "segment_reduce_masked",
     "set_default_backend",
+    "stack_reduce",
     "use_backend",
 ]

@@ -47,6 +47,21 @@ def combine(a: Array, b: Array, fx: str) -> Array:
     return jnp.maximum(a, b)
 
 
+def stack_reduce(stacked: Array, fx: str) -> Array:
+    """Fold a STATIC leading stack axis with ``fx``, dtype-preserving.
+
+    The deferred-sync mesh merge (``Metric.merge_stacked_states``) folds the
+    per-shard local states along their stack axis with the same pairwise
+    combine the kernels use between blocks — a sequential fold rather than
+    ``jnp.sum`` so small-int and bool dtypes never promote (``jnp.sum`` of an
+    int16 stack returns int32; a merge must return the state's own dtype)."""
+    stacked = jnp.asarray(stacked)
+    out = stacked[0]
+    for i in range(1, stacked.shape[0]):
+        out = combine(out, stacked[i], fx)
+    return out
+
+
 def supported_dtype(dtype: Any) -> bool:
     """Dtypes the Pallas paths handle: f32/bf16 floats and 32-bit ints.
 

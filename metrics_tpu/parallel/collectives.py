@@ -12,6 +12,7 @@ MetricCollection into one flat buffer per reduction and issues a single ``psum``
 bundle — O(1) collectives where the reference issues O(metrics x states)
 (``metric.py:240-245``).
 """
+import re
 from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
 import jax
@@ -21,6 +22,15 @@ from jax import lax
 from metrics_tpu.utils.data import METRIC_EPS
 
 Array = jax.Array
+
+#: cross-chip collective ops as they appear in compiled HLO text — the ONE
+#: pattern every gate asserting collective placement uses (``make mesh-smoke``,
+#: ``__graft_entry__``'s deferred-engine dryrun, the mesh engine tests): the
+#: deferred-sync steady step must match ZERO of these, the step-sync step and
+#: the boundary merge at least one.
+HLO_COLLECTIVE_RE = re.compile(
+    r"\b(all-reduce|all-gather|all-to-all|collective-permute|reduce-scatter)(?:-start)?\("
+)
 
 # an axis spec: one mesh-axis name or a tuple of names (multi-axis collectives)
 AxisSpec = Union[str, Tuple[str, ...]]

@@ -160,7 +160,7 @@ def sharded_masked_step(
     mask_abs,
     layout=None,
 ) -> Callable:
-    """Build the mesh-aware streaming-engine step for one bucket signature.
+    """Build the STEP-SYNC mesh streaming-engine step for one bucket signature.
 
     Returns a ``shard_map``-wrapped pure function
     ``(state, payload, mask) -> (new_state, token)`` where ``payload`` is the
@@ -209,4 +209,100 @@ def sharded_masked_step(
         body, mesh=mesh,
         in_specs=(state_specs, payload_specs, P(axis)),
         out_specs=(state_specs, P()), check_vma=False,
+    )
+
+
+def sharded_local_step(
+    update_fn: Callable,
+    mesh: Mesh,
+    axis: AxisName,
+    payload_abs,
+    mask_abs,
+    state_template,
+    unpack: Optional[Callable] = None,
+    pack: Optional[Callable] = None,
+) -> Callable:
+    """Build the DEFERRED-SYNC (collective-free) mesh streaming-engine step.
+
+    The reference's core contract is per-process LOCAL accumulation with a
+    cross-process merge only at compute (``dist_reduce_fx``); this is its mesh
+    form. The carried state is shard-local: every leaf/buffer gains a leading
+    shard axis sharded over ``axis`` (row ``k`` = device ``k``'s local state),
+    and the step body runs entirely within the shard —
+
+    * batch rows and mask shard over ``axis`` exactly as in
+      :func:`sharded_masked_step`;
+    * each device applies ``update_fn`` (the engine's masked/segmented update
+      on the LOGICAL state tree) to its own local state with its own rows —
+      no psum, no gather: the steady-state jaxpr contains ZERO cross-chip
+      collectives (pinned by ``tests/engine/test_deferred_fast.py``);
+    * the merge moves to explicit boundaries (:func:`sharded_state_merge`),
+      so scan-strategy metrics (``AUROC(capacity=N)``'s cat-written buffers)
+      become servable on mesh: each shard folds its rows sequentially into its
+      own buffers and the boundary merge all-gathers them.
+
+    ``token`` is the per-shard valid-row count, returned sharded ``(world,)``
+    — the dispatcher blocks on it to bound in-flight depth, same contract as
+    the step-sync scalar token. ``unpack``/``pack`` convert between the
+    carried per-shard form (an arena row) and the logical tree ``update_fn``
+    expects; None when the engine runs without arenas.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from metrics_tpu.utils.data import is_batch_leaf
+
+    n_rows = mask_abs.shape[0]
+    payload_specs = jax.tree.map(
+        lambda s: P(axis) if is_batch_leaf(s, n_rows) else P(),
+        payload_abs,
+    )
+    state_specs = jax.tree.map(lambda _: P(axis), state_template)
+
+    def body(state, payload, mask):
+        a, kw = payload
+        local = jax.tree.map(lambda x: x[0], state)  # this device's (1, ...) row
+        tree = unpack(local) if unpack is not None else local
+        new_tree = update_fn(tree, (a, kw), mask)
+        new_local = pack(new_tree) if pack is not None else new_tree
+        token = jnp.reshape(jnp.sum(mask.astype(jnp.int32)), (1,))
+        return jax.tree.map(lambda x: x[None], new_local), token
+
+    return jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(state_specs, payload_specs, P(axis)),
+        out_specs=(state_specs, P(axis)), check_vma=False,
+    )
+
+
+def sharded_state_merge(
+    metric,
+    mesh: Mesh,
+    axis: AxisName,
+    state_template,
+    unpack: Optional[Callable] = None,
+) -> Callable:
+    """Build the deferred-sync BOUNDARY merge: shard-local states -> global.
+
+    Each device unpacks its own carried row to the logical state tree and the
+    whole tree rides ``metric.sync_states`` — ONE fused collective bundle
+    (``parallel/collectives.py::fused_axis_sync``: all sum counters share a
+    single psum, min/max one collective per (reduction, dtype), cat/gather
+    states one u32-carrier all_gather) per merge, however many metrics the
+    collection serves. The output is the replicated GLOBAL state in the
+    metric's own layout — ``cat`` buffers arrive concatenated across shards
+    (``dist_reduce_fx="cat"`` semantics), so ``compute_from`` needs no
+    further sync. Runs only at explicit boundaries (``result()``, snapshot,
+    cross-topology restore), never in the steady state.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    state_specs = jax.tree.map(lambda _: P(axis), state_template)
+
+    def body(state):
+        local = jax.tree.map(lambda x: x[0], state)
+        tree = unpack(local) if unpack is not None else local
+        return metric.sync_states(tree, axis)
+
+    return jax.shard_map(
+        body, mesh=mesh, in_specs=(state_specs,), out_specs=P(), check_vma=False
     )

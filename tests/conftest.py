@@ -45,6 +45,7 @@ def devices():
 # tests)
 _MESH_NODEID_HINTS = (
     "tests/parallel/",              # collectives/sum-rider/sharded-embedded suites
+    "tests/engine/test_engine_mesh",  # 8-device engine suites (step + deferred sync)
     "[sharded_embedded_models.py",  # integration example script under shard_map
     "[streaming_engine.py",         # engine example: 8-device sharded steps
     "[distributed",                 # docs distributed code blocks

@@ -1,0 +1,359 @@
+"""Deferred-sync mesh serving — fast (tier-1) contracts.
+
+Everything here avoids multi-device shard_map COMPILES: jaxpr-level collective
+pinning only TRACES (device-count independent, cheap even on the 8-device
+virtual mesh), and the end-to-end parity checks compile on a 1-device mesh.
+The 8-device execution suite lives in ``test_engine_mesh_deferred.py``
+(``slow``) and ``make mesh-smoke``.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from metrics_tpu import (
+    AUROC,
+    Accuracy,
+    MaxMetric,
+    MeanSquaredError,
+    MetricCollection,
+    MinMetric,
+    SumMetric,
+)
+from metrics_tpu.engine import EngineConfig, MultiStreamEngine, StreamingEngine
+from metrics_tpu.engine.arena import ArenaLayout
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+# every cross-device communication primitive jax can trace today — the
+# deferred steady step must contain NONE of them, at any nesting depth
+COLLECTIVE_PRIMITIVES = {
+    "psum", "psum2", "pmin", "pmax", "pmean", "ppermute", "pbroadcast",
+    "all_gather", "all_gather_invariant", "all_to_all", "reduce_scatter",
+}
+
+
+def collective_counts(jaxpr, acc=None):
+    """Recursively count collective primitives in a (closed) jaxpr."""
+    if acc is None:
+        acc = {}
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name in COLLECTIVE_PRIMITIVES:
+            acc[eqn.primitive.name] = acc.get(eqn.primitive.name, 0) + 1
+        for v in eqn.params.values():
+            for x in v if isinstance(v, (list, tuple)) else [v]:
+                if hasattr(x, "jaxpr"):
+                    collective_counts(x.jaxpr, acc)
+                elif hasattr(x, "eqns"):
+                    collective_counts(x, acc)
+    return acc
+
+
+def _mesh(n=None):
+    devs = jax.devices()
+    return Mesh(np.asarray(devs[: (n or len(devs))]), ("dp",))
+
+
+def _batches(seed=3, sizes=(5, 12, 3, 16)):
+    rng = np.random.RandomState(seed)
+    return [
+        ((rng.randint(0, 33, size=n) / 32.0).astype(np.float32), (rng.rand(n) > 0.5).astype(np.int32))
+        for n in sizes
+    ]
+
+
+def _payload_abs(n_rows):
+    sds = jax.ShapeDtypeStruct
+    return ((sds((n_rows,), jnp.float32), sds((n_rows,), jnp.int32)), {})
+
+
+# --------------------------------------------------------- jaxpr regression
+
+
+def _traced_step_jaxpr(metric, mesh, mesh_sync, n_rows=16, payload_abs=None, **cfg_kw):
+    """Trace (never compile) an engine's steady-state update step."""
+    eng = StreamingEngine(
+        metric, EngineConfig(buckets=(n_rows,), mesh=mesh, axis="dp", mesh_sync=mesh_sync, **cfg_kw)
+    )
+    payload_abs = payload_abs if payload_abs is not None else _payload_abs(n_rows)
+    mask_abs = jax.ShapeDtypeStruct((n_rows,), jnp.bool_)
+
+    if mesh_sync == "deferred":
+        from metrics_tpu.parallel.embedded import sharded_local_step
+
+        fn = sharded_local_step(
+            eng._traced_update, mesh, "dp", payload_abs, mask_abs,
+            state_template=eng._abstract_state(),
+            unpack=eng._unpack if eng._layout is not None else None,
+            pack=eng._pack if eng._layout is not None else None,
+        )
+    else:
+        from metrics_tpu.parallel.embedded import sharded_masked_step
+
+        fn = sharded_masked_step(metric, mesh, "dp", payload_abs, mask_abs, layout=eng._layout)
+    state_abs = jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype), eng._abstract_state()
+    )
+    return jax.make_jaxpr(fn)(state_abs, payload_abs, mask_abs)
+
+
+def test_deferred_steady_step_has_zero_collectives():
+    """THE deferred-sync contract, pinned at the jaxpr level on the full
+    8-device mesh: no psum/pmin/pmax/all_gather/... anywhere in the steady
+    step — a refactor reintroducing a per-step collective fails here."""
+    coll = MetricCollection([Accuracy(), MeanSquaredError()])
+    jaxpr = _traced_step_jaxpr(coll, _mesh(), "deferred")
+    assert collective_counts(jaxpr.jaxpr) == {}
+    # min/max-reduction states (single-value aggregator traffic) too
+    agg = MetricCollection([MinMetric(), MaxMetric()])
+    payload = ((jax.ShapeDtypeStruct((16,), jnp.float32),), {})
+    jaxpr = _traced_step_jaxpr(agg, _mesh(), "deferred", payload_abs=payload)
+    assert collective_counts(jaxpr.jaxpr) == {}
+
+
+def test_deferred_scan_member_step_has_zero_collectives():
+    jaxpr = _traced_step_jaxpr(AUROC(capacity=64), _mesh(), "deferred")
+    assert collective_counts(jaxpr.jaxpr) == {}
+
+
+def test_step_sync_step_has_exactly_the_fused_collective_set():
+    """Step-sync steady step = ONE fused psum bundle for every sum state +
+    the token psum + at most one collective per extra (reduction, dtype):
+    for sum+min+max f32 states that is exactly {psum: 2, pmin: 1, pmax: 1}
+    — pinned so a refactor can't silently fall back to per-state
+    collectives (or grow the per-step bundle)."""
+    agg = MetricCollection([MinMetric(), MaxMetric(), SumMetric()])
+    payload = ((jax.ShapeDtypeStruct((16,), jnp.float32),), {})
+    jaxpr = _traced_step_jaxpr(agg, _mesh(), "step", payload_abs=payload)
+    assert collective_counts(jaxpr.jaxpr) == {"psum": 2, "pmin": 1, "pmax": 1}
+
+
+def test_step_sync_sum_only_collection_is_one_bundle_plus_token():
+    coll = MetricCollection([Accuracy(), MeanSquaredError()])
+    jaxpr = _traced_step_jaxpr(coll, _mesh(), "step")
+    assert collective_counts(jaxpr.jaxpr) == {"psum": 2}
+
+
+def test_deferred_merge_program_carries_the_collectives():
+    """The collectives don't vanish — they move: the boundary merge holds the
+    fused bundle (psum for counters, all_gather for cat buffers)."""
+    from metrics_tpu.parallel.embedded import sharded_state_merge
+
+    mesh = _mesh()
+    eng = StreamingEngine(
+        MetricCollection({"auroc": AUROC(capacity=64), "acc": Accuracy()}),
+        EngineConfig(buckets=(16,), mesh=mesh, axis="dp", mesh_sync="deferred"),
+    )
+    merge = sharded_state_merge(
+        eng._metric, mesh, "dp", state_template=eng._abstract_state(), unpack=eng._unpack
+    )
+    state_abs = jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype), eng._abstract_state()
+    )
+    counts = collective_counts(jax.make_jaxpr(merge)(state_abs).jaxpr)
+    assert counts.get("psum", 0) >= 1  # the fused sum bundle
+    assert counts.get("all_gather", 0) >= 1  # the cat-state carrier
+
+
+# ------------------------------------------------- 1-device-mesh parity
+
+
+def test_deferred_parity_on_one_device_mesh():
+    batches = _batches()
+    eager = MetricCollection([Accuracy(), MeanSquaredError()])
+    for b in batches:
+        eager.update(*b)
+    want = {k: np.asarray(v) for k, v in eager.compute().items()}
+
+    eng = StreamingEngine(
+        MetricCollection([Accuracy(), MeanSquaredError()]),
+        EngineConfig(buckets=(8, 16), mesh=_mesh(1), axis="dp", mesh_sync="deferred"),
+    )
+    with eng:
+        for b in batches:
+            eng.submit(*b)
+        got = {k: np.asarray(v) for k, v in eng.result().items()}
+    for k in want:
+        np.testing.assert_allclose(got[k], want[k], rtol=1e-6)
+    # update per bucket + merge + compute
+    assert eng.aot_cache.misses <= 2 + 2
+
+
+def test_deferred_scan_metric_parity_on_one_device_mesh():
+    """AUROC(capacity=N) — refused by step-sync mesh serving — streams under
+    deferred sync to the exact eager value."""
+    batches = _batches(seed=11)
+    eager = AUROC(capacity=64)
+    for b in batches:
+        eager.update(*b)
+    want = float(eager.compute())
+
+    eng = StreamingEngine(
+        AUROC(capacity=64),
+        EngineConfig(buckets=(16,), mesh=_mesh(1), axis="dp", mesh_sync="deferred"),
+    )
+    with eng:
+        for b in batches:
+            eng.submit(*b)
+        got = float(eng.result())
+    assert abs(got - want) <= 1e-7, (got, want)
+
+
+def test_deferred_telemetry_reports_merges_and_memoizes_repeat_reads():
+    eng = StreamingEngine(
+        Accuracy(), EngineConfig(buckets=(8,), mesh=_mesh(1), axis="dp", mesh_sync="deferred")
+    )
+    with eng:
+        eng.submit(*_batches()[0])
+        eng.result()
+        eng.result()  # no intervening updates: the merged state is memoized
+        eng.state()   # ... across read kinds too
+        assert eng.stats.merges == 1
+        eng.submit(*_batches()[1])
+        eng.result()  # new traffic invalidates the memo
+        tele = eng.telemetry()
+    ms = tele["mesh_sync"]
+    assert ms["mode"] == "deferred"
+    assert ms["merges"] == 2
+    assert ms["merge_us_total"] > 0
+    assert ms["collective_share"] is not None
+
+
+# ----------------------------------------------------- config validation
+
+
+def test_invalid_mesh_sync_rejected():
+    with pytest.raises(MetricsTPUUserError, match="mesh_sync"):
+        StreamingEngine(Accuracy(), EngineConfig(mesh_sync="lazy"))
+
+
+def test_deferred_without_mesh_rejected():
+    with pytest.raises(MetricsTPUUserError, match="needs a mesh"):
+        StreamingEngine(Accuracy(), EngineConfig(mesh_sync="deferred"))
+
+
+def test_scan_member_still_refused_on_step_sync_mesh_but_served_deferred():
+    devs = jax.devices()
+    if len(devs) < 2:
+        pytest.skip("needs >1 device to build a mesh")
+    mesh = Mesh(np.asarray(devs), ("dp",))
+    coll = lambda: MetricCollection({"auroc": AUROC(capacity=64), "acc": Accuracy()})  # noqa: E731
+    with pytest.raises(MetricsTPUUserError, match="deferred"):
+        StreamingEngine(coll(), EngineConfig(buckets=(8 * len(devs),), mesh=mesh, axis="dp"))
+    # construction succeeds in deferred mode (no compile here — cheap)
+    StreamingEngine(
+        coll(), EngineConfig(buckets=(8 * len(devs),), mesh=mesh, axis="dp", mesh_sync="deferred")
+    )
+
+
+def test_multistream_step_sync_mesh_refused_deferred_accepted():
+    devs = jax.devices()
+    if len(devs) < 2:
+        pytest.skip("needs >1 device to build a mesh")
+    mesh = Mesh(np.asarray(devs), ("dp",))
+    with pytest.raises(MetricsTPUUserError, match="deferred"):
+        MultiStreamEngine(
+            Accuracy(), num_streams=4, config=EngineConfig(buckets=(8 * len(devs),), mesh=mesh, axis="dp")
+        )
+    MultiStreamEngine(
+        Accuracy(), num_streams=4,
+        config=EngineConfig(buckets=(8 * len(devs),), mesh=mesh, axis="dp", mesh_sync="deferred"),
+    )
+
+
+def test_multistream_deferred_runs_the_stacked_merge_gate():
+    """The deferred-mesh capability check must run for MULTISTREAM engines
+    too (regression: the subclass used to override the whole capability hook,
+    so a metric that folds segmented but cannot merge its states would pass
+    construction and blow up at the first result())."""
+    class _FoldsButCannotMerge:
+        def segmented_update_unsupported_reason(self):
+            return None  # the update path is fine...
+
+        def stacked_merge_unsupported_reason(self):
+            return "state 'v' has dist_reduce_fx=None (no stacked merge)"
+
+    mesh = _mesh()
+    with pytest.raises(MetricsTPUUserError, match="mergeable"):
+        MultiStreamEngine(
+            _FoldsButCannotMerge(), num_streams=2,
+            config=EngineConfig(buckets=(16,), mesh=mesh, axis="dp", mesh_sync="deferred"),
+        )
+
+
+def test_program_keys_separate_sync_modes():
+    from metrics_tpu.engine.aot import AotCache
+
+    cache = AotCache()
+    k_step = cache.program_key("update", "fp", arg_tree=None, mesh=None, donate=True, sync="step")
+    k_def = cache.program_key("update", "fp", arg_tree=None, mesh=None, donate=True, sync="deferred")
+    assert k_step != k_def
+
+
+# ------------------------------------------- merge_stacked_states oracle
+
+
+def test_merge_stacked_states_matches_pairwise_merge():
+    rng = np.random.RandomState(0)
+    coll = MetricCollection({"auroc": AUROC(capacity=16), "acc": Accuracy()})
+    states = []
+    for i in range(4):
+        s = coll.init_state()
+        p = (rng.randint(0, 33, size=4) / 32.0).astype(np.float32)
+        t = (rng.rand(4) > 0.5).astype(np.int32)
+        states.append(coll.update_state(s, p, t))
+    want = states[0]
+    for s in states[1:]:
+        want = coll.merge_states(want, s)
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+    got = coll.merge_stacked_states(stacked)
+    for g, w in zip(jax.tree.leaves(got), jax.tree.leaves(want)):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+
+def test_merge_stacked_preserves_small_int_dtypes():
+    from metrics_tpu.ops.kernels import stack_reduce
+
+    v = jnp.asarray([[1, 2], [3, 4]], jnp.int16)
+    out = stack_reduce(v, "sum")
+    assert out.dtype == jnp.int16  # jnp.sum would promote to int32
+    np.testing.assert_array_equal(np.asarray(out), [4, 6])
+    b = jnp.asarray([[True, False], [True, True]], jnp.bool_)
+    assert stack_reduce(b, "max").dtype == jnp.bool_
+
+
+def test_stacked_merge_unsupported_reasons():
+    from metrics_tpu import CatMetric
+
+    assert Accuracy().stacked_merge_unsupported_reason() is None
+    assert AUROC(capacity=8).stacked_merge_unsupported_reason() is None
+    r = CatMetric().stacked_merge_unsupported_reason()  # list state
+    assert r is not None and "list" in r
+
+
+# ------------------------------------------------- shard-stacked arenas
+
+
+def test_arena_pack_unpack_stacked_roundtrip():
+    coll = MetricCollection({"auroc": AUROC(capacity=8), "acc": Accuracy()})
+    layout = ArenaLayout.for_state(coll.abstract_state())
+    rng = np.random.RandomState(1)
+    states = []
+    for _ in range(8):
+        s = coll.init_state()
+        p = (rng.randint(0, 33, size=3) / 32.0).astype(np.float32)
+        t = (rng.rand(3) > 0.5).astype(np.int32)
+        states.append(coll.update_state(s, p, t))
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+    arena = layout.pack_stacked(stacked)
+    assert set(arena) == set(layout.dtype_keys)
+    assert layout.matches(arena, world=8)
+    assert not layout.matches(arena)  # not the per-shard form
+    back = layout.unpack_stacked(arena)
+    for g, w in zip(jax.tree.leaves(back), jax.tree.leaves(stacked)):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+    # row k of the stacked arena IS shard k's per-shard pack
+    per_shard = layout.pack(states[3])
+    for k in arena:
+        np.testing.assert_array_equal(np.asarray(arena[k][3]), np.asarray(per_shard[k]))

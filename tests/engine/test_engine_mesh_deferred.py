@@ -1,0 +1,254 @@
+"""Deferred-sync engine on the 8-device virtual mesh (slow: shard_map compiles).
+
+The execution-level proof of the deferred-sync contract
+(``parallel.embedded.sharded_local_step`` / ``sharded_state_merge``): shard-
+local carried state, collective-free steady steps (checked in the COMPILED
+HLO here — the jaxpr-level pin lives in ``test_deferred_fast.py``), boundary
+merges that reproduce the single-device engine exactly — including
+``cat``/scan-strategy metrics (``AUROC(capacity=N)``), which step-sync mesh
+serving refuses — and kill/resume replay that restores each shard's local
+state verbatim.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from metrics_tpu import AUROC, Accuracy, AveragePrecision, MeanSquaredError, MetricCollection
+from metrics_tpu.engine import EngineConfig, MultiStreamEngine, StreamingEngine
+from metrics_tpu.parallel.collectives import HLO_COLLECTIVE_RE as _COLLECTIVE_RE
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+
+def _batches(seed=2, sizes=(13, 40, 7, 64, 21)):
+    rng = np.random.RandomState(seed)
+    return [
+        ((rng.randint(0, 65, size=n) / 64.0).astype(np.float32), (rng.rand(n) > 0.5).astype(np.int32))
+        for n in sizes
+    ]
+
+
+def _collection():
+    return MetricCollection([Accuracy(), MeanSquaredError()])
+
+
+def _curves():
+    # the acceptance pair: a scan-strategy metric AND cat-state buffers
+    return MetricCollection(
+        {"auroc": AUROC(capacity=256), "ap": AveragePrecision(capacity=256), "acc": Accuracy()}
+    )
+
+
+@pytest.fixture()
+def mesh(devices):
+    return Mesh(np.asarray(devices), ("dp",))
+
+
+def _cfg(mesh, **kw):
+    return EngineConfig(buckets=(16, 64), mesh=mesh, axis="dp", mesh_sync="deferred", **kw)
+
+
+def test_deferred_engine_matches_single_device_engine(mesh):
+    """Bit-exact int / tolerance-bounded float parity between the deferred
+    mesh engine and the single-device engine on the same stream."""
+    batches = _batches()
+    single = StreamingEngine(_collection(), EngineConfig(buckets=(16, 64)))
+    with single:
+        for b in batches:
+            single.submit(*b)
+        want = {k: np.asarray(v) for k, v in single.result().items()}
+
+    engine = StreamingEngine(_collection(), _cfg(mesh))
+    with engine:
+        for b in batches:
+            engine.submit(*b)
+        got = {k: np.asarray(v) for k, v in engine.result().items()}
+        warm = engine.aot_cache.misses
+        engine.reset()
+        for b in batches:
+            engine.submit(*b)
+        again = {k: np.asarray(v) for k, v in engine.result().items()}
+        steady = engine.aot_cache.misses - warm
+    for k in want:
+        if np.issubdtype(want[k].dtype, np.integer):
+            assert np.array_equal(got[k], want[k]), k
+        else:
+            np.testing.assert_allclose(got[k], want[k], rtol=1e-6, err_msg=k)
+        np.testing.assert_array_equal(got[k], again[k], err_msg=k)
+    # closed program set: update per bucket + merge + compute, repeat = free
+    assert engine.aot_cache.misses - steady <= 2 + 2
+    assert steady == 0
+
+
+def test_scan_and_cat_metrics_serve_deferred_exactly(mesh):
+    """The acceptance bar: AUROC(capacity=N) (scan strategy) and cat-state
+    curve buffers serve on the 8-device mesh under deferred sync, matching
+    the single-device engine exactly."""
+    batches = _batches(seed=5, sizes=(24, 9, 48, 17, 16))
+    single = StreamingEngine(_curves(), EngineConfig(buckets=(16, 64)))
+    with single:
+        for b in batches:
+            single.submit(*b)
+        want = {k: np.asarray(v) for k, v in single.result().items()}
+
+    engine = StreamingEngine(_curves(), _cfg(mesh))
+    with engine:
+        for b in batches:
+            engine.submit(*b)
+        got = {k: np.asarray(v) for k, v in engine.result().items()}
+    for k in want:
+        np.testing.assert_allclose(got[k], want[k], rtol=1e-6, atol=1e-7, err_msg=k)
+
+
+def test_deferred_step_hlo_is_collective_free_and_merge_is_not(mesh):
+    """Collective PLACEMENT in the compiled executables: zero in the steady
+    step, all of them in the boundary merge."""
+    engine = StreamingEngine(_curves(), _cfg(mesh))
+    with engine:
+        for b in _batches(seed=1, sizes=(16, 64)):
+            engine.submit(*b)
+        engine.result()
+        step_hlos = [p.as_text() for p in engine._program_memo.values()]
+        merge_hlo = engine._merge_program().as_text()
+    assert step_hlos
+    for hlo in step_hlos:
+        assert not _COLLECTIVE_RE.findall(hlo)
+    assert _COLLECTIVE_RE.findall(merge_hlo)
+
+
+def test_deferred_kill_resume_replays_exactly(mesh, tmp_path):
+    """Snapshot carries every shard's LOCAL state (provenance); replaying the
+    remaining batches reproduces the uninterrupted result — including the
+    cat-written capacity buffers, whose rows live on specific shards."""
+    batches = _batches(seed=9, sizes=(24, 9, 48, 17))
+    snapdir = str(tmp_path)
+
+    ref = StreamingEngine(_curves(), _cfg(mesh))
+    with ref:
+        for b in batches:
+            ref.submit(*b)
+        want = {k: np.asarray(v) for k, v in ref.result().items()}
+
+    eng = StreamingEngine(_curves(), _cfg(mesh, snapshot_every=2, snapshot_dir=snapdir))
+    with eng:
+        for b in batches[:2]:
+            eng.submit(*b)
+        eng.flush()
+    del eng
+
+    resumed = StreamingEngine(_curves(), _cfg(mesh, snapshot_dir=snapdir))
+    meta = resumed.restore()
+    assert meta["batches_done"] == 2
+    assert meta["mesh_sync"] == "deferred"
+    assert meta["world"] == 8
+    with resumed:
+        for b in batches[2:]:
+            resumed.submit(*b)
+        got = {k: np.asarray(v) for k, v in resumed.result().items()}
+    for k in want:
+        np.testing.assert_allclose(got[k], want[k], rtol=1e-7, err_msg=k)
+
+
+def test_cross_mode_restore_matrix(mesh, tmp_path):
+    """Deferred snapshots merge into single-device/step-sync engines (delta
+    states); single-device snapshots embed into shard 0 of a deferred engine;
+    a deferred CAT-state snapshot refuses to restore off-mesh."""
+    batches = _batches(seed=4, sizes=(24, 40))
+    eager = _collection()
+    for b in batches:
+        eager.update(*b)
+    want = {k: float(v) for k, v in eager.compute().items()}
+
+    d1 = str(tmp_path / "deferred")
+    e1 = StreamingEngine(_collection(), _cfg(mesh, snapshot_dir=d1))
+    with e1:
+        for b in batches:
+            e1.submit(*b)
+        e1.snapshot()
+    single = StreamingEngine(_collection(), EngineConfig(buckets=(16, 64), snapshot_dir=d1))
+    single.restore()
+    got = {k: float(v) for k, v in single.result().items()}
+    for k in want:
+        assert abs(got[k] - want[k]) < 1e-6, k
+
+    d2 = str(tmp_path / "single")
+    e2 = StreamingEngine(_collection(), EngineConfig(buckets=(16, 64), snapshot_dir=d2))
+    with e2:
+        e2.submit(*batches[0])
+        e2.snapshot()
+    back = StreamingEngine(_collection(), _cfg(mesh, snapshot_dir=d2))
+    back.restore()
+    with back:
+        back.submit(*batches[1])
+        got2 = {k: float(v) for k, v in back.result().items()}
+    for k in want:
+        assert abs(got2[k] - want[k]) < 1e-6, k
+
+    d3 = str(tmp_path / "cat")
+    e3 = StreamingEngine(_curves(), _cfg(mesh, snapshot_dir=d3))
+    with e3:
+        e3.submit(*batches[0])
+        e3.snapshot()
+    refuser = StreamingEngine(_curves(), EngineConfig(buckets=(16, 64), snapshot_dir=d3))
+    with pytest.raises(MetricsTPUUserError, match="deferred"):
+        refuser.restore()
+
+
+def test_deferred_multistream_on_mesh_matches_single_device(mesh):
+    """S streams x 8 shards, ONE executable: per-stream results equal the
+    single-device MultiStreamEngine on the same routed traffic."""
+    batches = _batches(seed=7, sizes=(16, 40, 24, 64, 8, 32))
+    n_streams = 3
+
+    def run(engine):
+        with engine:
+            for i, b in enumerate(batches):
+                engine.submit(i % n_streams, *b)
+            return {
+                sid: {k: float(v) for k, v in engine.result(sid).items()}
+                for sid in range(n_streams)
+            }
+
+    want = run(MultiStreamEngine(_collection(), n_streams, EngineConfig(buckets=(16, 64))))
+    engine = MultiStreamEngine(_collection(), n_streams, _cfg(mesh))
+    got = run(engine)
+    for sid in want:
+        for k in want[sid]:
+            assert abs(got[sid][k] - want[sid][k]) < 1e-6, (sid, k)
+    # steady step of the multistream mesh engine is collective-free too
+    for prog in engine._program_memo.values():
+        assert not _COLLECTIVE_RE.findall(prog.as_text())
+
+
+def test_deferred_multistream_reset_stream_hits_every_shard(mesh):
+    batches = _batches(seed=8, sizes=(32, 40, 24))
+    engine = MultiStreamEngine(_collection(), 2, _cfg(mesh))
+    with engine:
+        for i, b in enumerate(batches):
+            engine.submit(i % 2, *b)
+        engine.flush()
+        engine.reset_stream(0)
+        # stream 1 untouched; stream 0 fresh (rows spread across all shards,
+        # so a shard-0-only reset would leave residue)
+        state0 = engine.stream_state(0)
+        assert all(float(jnp.sum(jnp.abs(v))) == 0 for v in jax.tree.leaves(state0))
+        ref = _collection()
+        ref.update(*batches[1])
+        got1 = {k: float(v) for k, v in engine.result(1).items()}
+        want1 = {k: float(v) for k, v in ref.compute().items()}
+        for k in want1:
+            assert abs(got1[k] - want1[k]) < 1e-6, k
+
+
+def test_deferred_cpu_mesh_keeps_async_dispatch(mesh):
+    """Step-sync CPU meshes serialize every step (communicator-deadlock
+    policy); deferred steps carry no collectives, so the engine keeps the
+    async in_flight pipeline even here."""
+    if jax.devices()[0].platform != "cpu":
+        pytest.skip("serialization contract is CPU-mesh specific")
+    step = StreamingEngine(_collection(), EngineConfig(buckets=(16,), mesh=mesh, axis="dp"))
+    deferred = StreamingEngine(_collection(), _cfg(mesh))
+    assert step._serialize is True
+    assert deferred._serialize is False

@@ -1,0 +1,203 @@
+"""Property/fuzz tests for the fused-sync codecs (``parallel/collectives.py``).
+
+The sum-rider (integer counters ride one f32 psum as base-2^bits digits) and
+the u32 gather carrier (every cat/None leaf bitcast-packed into one
+all_gather) guarantee ENCODING INVARIANTS the engine's deferred-sync boundary
+merge now leans on directly — previously they were only exercised through
+whole-metric parity tests. Pinned here against per-leaf oracles:
+
+* int psum wraparound at world=8 — the rider reconstruction is bit-identical
+  to a native integer psum for random values spanning the full dtype range,
+  overflow included (host-simulated f32-accumulation psum + mesh
+  ``sync_axis_state`` oracle);
+* bf16/f16 upcast exactness — half-precision sums ride f32 exactly (both
+  embed), so the rider equals the f32-exact sum rounded once at the end;
+* carrier roundtrip for EVERY state dtype the metrics actually declare
+  (f32/i32/bool from the serving-path metrics, plus the full packing matrix:
+  1/2/4/8-byte dtypes).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from metrics_tpu.parallel.collectives import (
+    _from_carrier_u32,
+    _from_sum_rider,
+    _int_split_bits,
+    _to_carrier_u32,
+    _to_sum_rider,
+    fused_axis_sync,
+    sync_axis_state,
+)
+from tests.helpers.testers import mesh_devices
+
+WORLD = 8
+
+
+# ---------------------------------------------- host-simulated psum (fuzz)
+
+
+def _simulated_rider_psum(values, bits):
+    """What the shared f32 psum computes: encode each replica, sum the
+    payloads in f32 (exact by the bits bound), decode once."""
+    payloads = np.stack([np.asarray(_to_sum_rider(jnp.asarray(v), bits)) for v in values])
+    summed = np.add.reduce(payloads.astype(np.float32), axis=0, dtype=np.float32)
+    return np.asarray(_from_sum_rider(jnp.asarray(summed), jnp.asarray(values[0]), bits))
+
+
+def _wraparound_sum(values):
+    """The native integer psum: exact sum with the dtype's wraparound."""
+    dt = values[0].dtype
+    wide = np.add.reduce([v.astype(np.int64) for v in values])
+    info = np.iinfo(dt)
+    span = int(info.max) - int(info.min) + 1
+    return ((wide - int(info.min)) % span + int(info.min)).astype(dt)
+
+
+@pytest.mark.parametrize("dtype", [np.int32, np.uint32, np.int16, np.uint16, np.int8, np.uint8])
+def test_fuzz_int_rider_psum_wraparound_world8(dtype):
+    """50 random draws per dtype, values spanning the FULL range (overflow at
+    world=8 guaranteed for the wide draws): rider == native wraparound sum,
+    bit for bit."""
+    bits = _int_split_bits(WORLD)
+    info = np.iinfo(dtype)
+    rng = np.random.RandomState(int(np.dtype(dtype).num))
+    for trial in range(50):
+        n = rng.randint(1, 17)
+        # mix extreme and small magnitudes so both overflow and identity paths fuzz
+        draws = rng.randint(info.min, int(info.max) + 1, size=(WORLD, n), dtype=np.int64)
+        if trial % 3 == 0:
+            draws[rng.rand(WORLD, n) < 0.3] = info.max  # force wraparound
+        values = [d.astype(dtype) for d in draws]
+        got = _simulated_rider_psum(values, bits)
+        want = _wraparound_sum(values)
+        np.testing.assert_array_equal(got, want, err_msg=f"{np.dtype(dtype)} trial {trial}")
+        assert got.dtype == np.dtype(dtype)
+
+
+@pytest.mark.parametrize("dtype", [jnp.bfloat16, jnp.float16])
+def test_fuzz_half_precision_rider_is_f32_exact(dtype):
+    """Half floats upcast losslessly into f32, so the rider sum must equal
+    the f32-exact sum of the stored values, rounded ONCE at the end — not a
+    half-precision accumulation (which loses low bits every add)."""
+    bits = _int_split_bits(WORLD)
+    rng = np.random.RandomState(3)
+    for _ in range(50):
+        n = rng.randint(1, 9)
+        vals = [jnp.asarray(rng.randn(n).astype(np.float32) * 100).astype(dtype) for _ in range(WORLD)]
+        got = _simulated_rider_psum(vals, bits)
+        exact_f32 = np.add.reduce(
+            [np.asarray(v.astype(jnp.float32)) for v in vals], dtype=np.float32
+        )
+        want = np.asarray(jnp.asarray(exact_f32).astype(dtype))
+        np.testing.assert_array_equal(got.view(np.uint16), want.view(np.uint16))
+
+
+# ------------------------------------------------ carrier roundtrip (fuzz)
+
+
+def _declared_state_dtypes():
+    """The dtypes real serving-path metric states declare (the set the
+    deferred boundary merge must carry)."""
+    from metrics_tpu import AUROC, Accuracy, MeanSquaredError, MetricCollection
+
+    coll = MetricCollection(
+        {"auroc": AUROC(capacity=8), "acc": Accuracy(), "mse": MeanSquaredError()}
+    )
+    return {np.dtype(l.dtype) for l in jax.tree.leaves(coll.abstract_state())}
+
+
+def test_declared_dtypes_are_covered_by_the_carrier_matrix():
+    declared = _declared_state_dtypes()
+    tested = {np.dtype(d) for d in (np.bool_, np.int32, np.float32)}
+    assert declared <= tested, f"metric states declare untested dtypes: {declared - tested}"
+
+
+@pytest.mark.parametrize(
+    "dtype,shape",
+    [
+        (jnp.bool_, (5,)),        # 1-byte, padded 4-to-1 packing
+        (jnp.uint8, (7,)),        # 1-byte, non-multiple-of-4 tail
+        (jnp.int8, (4, 3)),
+        (jnp.int16, (3,)),        # 2-byte, padded 2-to-1 packing
+        (jnp.uint16, (2, 5)),
+        (jnp.float16, (9,)),
+        (jnp.bfloat16, (6,)),
+        (jnp.int32, (8,)),        # word-size fast path
+        (jnp.uint32, (3, 4)),
+        (jnp.float32, (16, 2)),   # the capacity buffers' dtype
+    ],
+)
+def test_fuzz_carrier_roundtrip(dtype, shape):
+    """Every leaf dtype/shape bitcasts into the u32 carrier and back
+    IDENTICALLY across a simulated (world, words) gather slab."""
+    rng = np.random.RandomState(hash(str(dtype)) % (2**31))
+    for _ in range(20):
+        raw = rng.randint(0, 256, size=(int(np.prod(shape)),) , dtype=np.uint8)
+        nbytes = jnp.dtype(dtype).itemsize * int(np.prod(shape))
+        buf = rng.randint(0, 256, size=nbytes, dtype=np.uint8)
+        if dtype == jnp.bool_:
+            v = jnp.asarray((raw % 2).astype(bool).reshape(shape))
+        else:
+            v = jnp.asarray(np.frombuffer(buf.tobytes(), np.dtype(dtype)).reshape(shape))
+        words = _to_carrier_u32(v)
+        # simulate the gather: distinct per-replica payloads, stacked
+        slabs = [np.asarray(words)]
+        for w in range(1, 4):
+            slabs.append(np.roll(np.asarray(words), w))
+        gathered = jnp.asarray(np.stack(slabs))
+        back = _from_carrier_u32(gathered, v.dtype, tuple(v.shape))
+        assert back.shape == (4,) + tuple(v.shape)
+        # materialize the WHOLE array before indexing: eager jax indexing of a
+        # half-precision array routes values through an op that canonicalizes
+        # NaN payloads (found fuzzing this very test) — the codec itself is
+        # bit-exact, as the full-array materialization shows
+        a, b = np.asarray(back)[0], np.asarray(v)
+        if dtype == jnp.bool_:
+            np.testing.assert_array_equal(a, b)
+        else:  # bit-level equality (NaN patterns included)
+            np.testing.assert_array_equal(
+                a.view(np.uint8).reshape(-1), b.view(np.uint8).reshape(-1)
+            )
+
+
+# -------------------------------------------- mesh oracle (one compile)
+
+
+def test_fused_sync_matches_per_leaf_oracle_on_mesh(devices):
+    """One shard_map program syncs a mixed bundle BOTH ways — fused and
+    per-leaf ``sync_axis_state`` — and the results must agree bit-for-bit:
+    i32 sum (overflowing), f32 sum, f32 min/max, f32 cat buffers, bool None
+    (stack). Three fuzzed datasets through the one compiled program."""
+    mesh = Mesh(np.asarray(devices), ("dp",))
+    fxs = ["sum", "sum", "min", "max", "cat", None]
+
+    @jax.jit
+    def both(i32, f32, fmin, fmax, cat, flag):
+        def body(a, b, c, d, e, f):
+            leaves = [(fx, v[0]) for fx, v in zip(fxs, (a, b, c, d, e, f))]
+            fused = fused_axis_sync(leaves, "dp")
+            oracle = [sync_axis_state(fx, v[0], "dp") for fx, v in zip(fxs, (a, b, c, d, e, f))]
+            return tuple(fused), tuple(oracle)
+
+        return jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(P("dp"),) * 6, out_specs=P(), check_vma=False,
+        )(i32, f32, fmin, fmax, cat, flag)
+
+    rng = np.random.RandomState(0)
+    for _ in range(3):
+        args = (
+            rng.randint(-(2**31), 2**31 - 1, size=(WORLD, 4), dtype=np.int64).astype(np.int32),
+            rng.randn(WORLD, 3).astype(np.float32),
+            rng.randn(WORLD, 2).astype(np.float32),
+            rng.randn(WORLD, 2).astype(np.float32),
+            rng.randn(WORLD, 5).astype(np.float32),
+            (rng.rand(WORLD, 2) > 0.5),
+        )
+        fused, oracle = both(*args)
+        for fx, f, o in zip(fxs, fused, oracle):
+            np.testing.assert_array_equal(np.asarray(f), np.asarray(o), err_msg=str(fx))

@@ -7,8 +7,11 @@ Usage::
 Reads the document written by ``StreamingEngine.export_telemetry`` (or
 ``python -m metrics_tpu.engine.smoke``) and renders the summary plus the tail
 of the per-step ring — including the host-time attribution (``regime``:
-dispatch-bound / pad-bound / device-bound / starved) that says WHERE the dispatcher's
-wall time went, and the coalescing ratio (submitted batches per device step).
+dispatch-bound / pad-bound / device-bound / sync-bound / starved) that says
+WHERE the dispatcher's wall time went, the coalescing ratio (submitted batches
+per device step), and — for mesh engines — the collective share: per-step sync
+latency under ``mesh_sync="step"`` vs boundary-merge time under
+``mesh_sync="deferred"`` (the step-vs-deferred comparison).
 Pure stdlib — safe to run anywhere the JSON lands (no jax import, so it works
 on a machine without the accelerator stack).
 """
@@ -57,6 +60,24 @@ def render(doc: dict, steps: int = 10) -> str:
         ("compile seconds", cc.get("compile_seconds")),
         ("persistent cache entries", cc.get("persistent_cache_entries")),
     ]
+    ms = s.get("mesh_sync")
+    if ms:
+        share = ms.get("collective_share")
+        bound = "≤ " if ms.get("collective_share_is_upper_bound") else ""
+        rows.insert(
+            3,
+            (
+                "mesh sync",
+                f"{ms.get('mode')} · collective share "
+                f"{'-' if share is None else f'{bound}{100 * share:.1f}%'}"
+                + (
+                    f" ({_fmt(ms.get('merges'))} boundary merges, "
+                    f"{_fmt(ms.get('merge_us_total'))} µs total)"
+                    if ms.get("mode") == "deferred"
+                    else " (per-step blocked sync: collective + in-step compute)"
+                ),
+            ),
+        )
     shares = s.get("host_time_shares")
     if shares:
         rows.insert(

@@ -6,7 +6,11 @@ engine's serving contract instead (docs/serving.md): ragged traffic flows into
 a bounded queue, batches round to a CLOSED set of padded bucket shapes, each
 bucket's update step is AOT-compiled once (with the state donated and, on a
 mesh, batch rows sharded + deltas psum-merged in-step), periodic crash-safe
-snapshots land on disk, and telemetry comes out as JSON.
+snapshots land on disk, and telemetry comes out as JSON. The last leg tours
+DEFERRED mesh sync (``mesh_sync="deferred"``): shard-local states, a
+collective-free steady step, and the merge riding one fused bundle at
+``result()`` — which is what lets ``AUROC(capacity=N)``, refused by the
+step-sync mesh path, serve on the mesh at all.
 
 Run (any host):
     XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
@@ -110,6 +114,35 @@ def main() -> None:
         f"for {ms_tele['batches_submitted']} submissions "
         f"({ms_tele['coalesce']['batches_per_step_mean']} batches/step coalesced), "
         f"{ms_tele['compile_cache']['misses']} compiled programs total"
+    )
+
+    # ---- deferred mesh sync: shard-local state, collective-free steady steps.
+    # AUROC(capacity=N) keeps cat-written score buffers with no per-step delta
+    # merge — the step-sync mesh path refuses it; under deferred sync each
+    # shard folds its own rows and result()'s boundary merge all-gathers the
+    # buffers (docs/serving.md "Mesh sync modes").
+    from metrics_tpu import AUROC
+
+    capacity = 8192
+    deferred = StreamingEngine(
+        AUROC(capacity=capacity),
+        EngineConfig(buckets=BUCKETS, mesh=mesh, axis="dp", mesh_sync="deferred"),
+    )
+    with deferred:
+        for preds, target in traffic:
+            deferred.submit(preds, target)
+        served_auroc = float(deferred.result())
+    au_eager = AUROC(capacity=capacity)
+    for preds, target in traffic:
+        au_eager.update(preds, target)
+    want_auroc = float(au_eager.compute())
+    assert abs(served_auroc - want_auroc) < 1e-6, (served_auroc, want_auroc)
+    d_tele = deferred.telemetry()
+    assert d_tele["mesh_sync"]["mode"] == "deferred"
+    print(
+        f"deferred sync: AUROC(capacity={capacity}) on the mesh == eager "
+        f"({served_auroc:.6f}); {d_tele['mesh_sync']['merges']} boundary merge(s), "
+        f"collective share {d_tele['mesh_sync']['collective_share']}"
     )
 
 
