@@ -1,8 +1,8 @@
 # Parity target: reference Makefile (test = pytest with coverage).
 # Default flow runs the smoke checks (seconds) before the full suite.
-.PHONY: all test engine-smoke kernels-smoke mesh-smoke clean native bench
+.PHONY: all test engine-smoke kernels-smoke mesh-smoke chaos-smoke clean native bench
 
-all: engine-smoke kernels-smoke mesh-smoke test
+all: engine-smoke kernels-smoke mesh-smoke chaos-smoke test
 
 test:
 	python -m pytest tests/ -q
@@ -29,6 +29,15 @@ kernels-smoke:
 mesh-smoke:
 	JAX_PLATFORMS=cpu python -m metrics_tpu.engine.mesh_smoke
 
+# Fault-tolerance gate, CPU-safe and seeded (metrics_tpu/engine/chaos_smoke.py):
+# every injection point in engine/faults.py fires at least once — transactional
+# rollback, quarantine ledger exactness, pallas→xla demotion, contained
+# snapshot-write failure, corrupted-LATEST restore fallback with exact replay,
+# deferred merge retry, dead-dispatcher submit(timeout=) — and the chaos run's
+# result() is bit-identical to a fault-free run on the same traffic.
+chaos-smoke:
+	JAX_PLATFORMS=cpu python -m metrics_tpu.engine.chaos_smoke chaos_telemetry.json
+
 native:
 	g++ -O3 -shared -fPIC metrics_tpu/native/levenshtein.cpp -o metrics_tpu/native/_levenshtein.so
 
@@ -38,4 +47,4 @@ bench:
 clean:
 	rm -rf .pytest_cache build dist *.egg-info
 	find . -name __pycache__ -type d -exec rm -rf {} +
-	rm -f metrics_tpu/native/_levenshtein.so engine_telemetry.json
+	rm -f metrics_tpu/native/_levenshtein.so engine_telemetry.json chaos_telemetry.json

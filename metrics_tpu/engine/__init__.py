@@ -60,20 +60,48 @@ See ``docs/serving.md`` for the architecture and recovery semantics.
 from metrics_tpu.engine.aot import AotCache, enable_persistent_compilation_cache
 from metrics_tpu.engine.arena import ArenaLayout
 from metrics_tpu.engine.bucketing import BucketPolicy
+from metrics_tpu.engine.faults import (
+    BackpressureTimeout,
+    BoundaryMergeError,
+    EngineDispatchError,
+    FaultInjector,
+    FaultSpec,
+    InjectedFault,
+    QuarantineRecord,
+    ScreenPolicy,
+    SnapshotCorruptError,
+    StepTimeoutError,
+)
 from metrics_tpu.engine.multistream import MultiStreamEngine
 from metrics_tpu.engine.pipeline import EngineConfig, StreamingEngine
-from metrics_tpu.engine.snapshot import latest_snapshot, load_snapshot, save_snapshot
+from metrics_tpu.engine.snapshot import (
+    generations,
+    latest_snapshot,
+    load_snapshot,
+    save_snapshot,
+)
 from metrics_tpu.engine.stats import EngineStats
 
 __all__ = [
     "AotCache",
     "ArenaLayout",
+    "BackpressureTimeout",
+    "BoundaryMergeError",
     "BucketPolicy",
     "EngineConfig",
+    "EngineDispatchError",
     "EngineStats",
+    "FaultInjector",
+    "FaultSpec",
+    "InjectedFault",
     "MultiStreamEngine",
+    "QuarantineRecord",
+    "ScreenPolicy",
+    "SnapshotCorruptError",
+    "StepTimeoutError",
     "StreamingEngine",
     "enable_persistent_compilation_cache",
+    "generations",
     "latest_snapshot",
     "load_snapshot",
     "save_snapshot",

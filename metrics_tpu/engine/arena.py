@@ -148,6 +148,16 @@ class ArenaLayout:
             h.update(f"{s.key}:{s.offset}:{s.size}:{s.shape}:{s.dtype}".encode())
         return h.hexdigest()[:16]
 
+    @staticmethod
+    def clone_buffers(arena: Dict[str, Any]) -> Dict[str, Any]:
+        """Device copy of a packed arena — the engine's donation-aware SHADOW
+        for transactional steps: when the live buffers are about to be
+        DONATED into a step, this retained copy is what a failed step rolls
+        back onto. One copy per dtype buffer (2–3 arrays), not per leaf —
+        the same amortization the arena gives dispatch applies to the shadow.
+        Shardings are preserved (``jnp.array(copy=True)`` copies per-shard)."""
+        return {k: jnp.array(v, copy=True) for k, v in arena.items()}
+
     # ------------------------------------------------------------- pack / unpack
 
     def pack(self, state: Any) -> Dict[str, Any]:

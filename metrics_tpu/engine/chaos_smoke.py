@@ -1,0 +1,292 @@
+"""Chaos smoke: ``python -m metrics_tpu.engine.chaos_smoke [telemetry.json]``.
+
+The CI-shaped proof of the fault-tolerance contract (ISSUE 6), in seconds on
+one CPU device (``make chaos-smoke``): a SEEDED fault sweep fires every
+injection point in ``engine/faults.py::FAULT_SITES`` at least once, and the
+engine recovers from all of it to a ``result()`` BIT-IDENTICAL to a
+fault-free run on the same traffic:
+
+1. **Transactional steps** — injected ingest/compile/step/watchdog faults
+   roll back onto the pre-step shadow and retry; the arena is never torn
+   (layout integrity asserted after the chaos stream).
+2. **Quarantine** — a poisoned NaN batch rides the stream; the screen policy
+   dead-letters it (it never reaches a compiled step), the ledger accounts
+   for exactly its cursor and rows, and parity holds with the quarantined
+   batch excluded by construction (the fault-free oracle never sees it).
+3. **Graceful degradation** — a kernel-site fault demotes the engine
+   ``pallas_interpret → xla`` mid-stream (bit-exact for this traffic: int
+   counters and dyadic float sums); a coalesce fault (rate=1.0, also what
+   pins every group to one batch so occurrence schedules are deterministic
+   under ANY queue timing) degrades megabatching to singleton groups; a
+   trace-time ``kernel_fault_scope`` hook proves the dispatcher's per-call
+   silent fallback.
+4. **Snapshot integrity** — one periodic snapshot write FAILS (contained:
+   serving continues, counted), the LAST snapshot is bit-flipped on disk
+   after a successful save, and the post-kill ``restore()`` falls back past
+   the corrupt LATEST to the previous generation; replaying from its older
+   cursor reproduces the uninterrupted result exactly.
+5. **Deferred boundary merge** — on a 1-device mesh in deferred mode an
+   injected merge fault retries behind ``result()`` (the merge is a
+   non-donated read; the carried state stays consistent).
+6. **Dead dispatcher** — a fatal fault kills the dispatcher thread outright;
+   ``submit(timeout=)`` surfaces the sticky error instead of deadlocking,
+   and ``reset()`` drains the dead queue and re-arms. A transient
+   ``snapshot_read`` fault retries inside ``restore()``.
+
+Writes the chaos engine's telemetry JSON (the fault block renders via
+``tools/engine_report.py``) and prints one PASS line. Exits nonzero on any
+violated claim.
+"""
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+_FAILED = []
+
+
+def _check(ok: bool, what: str) -> None:
+    if not ok:
+        _FAILED.append(what)
+        print(f"FAIL: {what}")
+
+
+def main(out_path: str = "chaos_telemetry.json") -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    from metrics_tpu import Accuracy, MeanSquaredError, MetricCollection
+    from metrics_tpu.engine import (
+        BackpressureTimeout,
+        EngineConfig,
+        EngineDispatchError,
+        FaultInjector,
+        FaultSpec,
+        ScreenPolicy,
+        StreamingEngine,
+    )
+    from metrics_tpu.engine.faults import FAULT_SITES
+
+    def collection():
+        return MetricCollection([Accuracy(), MeanSquaredError()])
+
+    # dyadic-rational traffic: every partial float sum is exactly
+    # representable, so parity across ANY grouping/lowering is bit-exact
+    rng = np.random.RandomState(0)
+    clean = [
+        ((rng.randint(0, 65, size=n) / 64.0).astype(np.float32), (rng.rand(n) > 0.5).astype(np.int32))
+        for n in (5, 17, 8, 32, 3, 12, 32, 9)
+    ]
+    poison = (np.asarray([np.nan, 0.25], np.float32), np.asarray([1, 0], np.int32))
+    traffic = clean[:2] + [poison] + clean[2:]  # poison at stream cursor 2
+
+    # -------------------------------------------------------- fault-free truth
+    ref = StreamingEngine(collection(), EngineConfig(buckets=(8, 32)))
+    with ref:
+        for b in clean:
+            ref.submit(*b)
+        want = {k: np.asarray(v) for k, v in ref.result().items()}
+
+    fired_sites = set()
+
+    # ------------------------------------------------- chaos run, single device
+    snapdir = tempfile.mkdtemp(prefix="metrics_tpu_chaos_")
+    inj = FaultInjector(
+        seed=7,
+        plan={
+            # rate=1.0 degrades EVERY group to one batch — which is also what
+            # makes every other site's occurrence index deterministic under
+            # any producer/dispatcher interleaving
+            "coalesce": FaultSpec(rate=1.0),
+            "ingest": FaultSpec(schedule=(1,)),
+            "compile": FaultSpec(schedule=(1,)),
+            "step": FaultSpec(schedule=(3,)),
+            "kernel": FaultSpec(schedule=(0,)),
+            "watchdog": FaultSpec(schedule=(6,)),
+            "snapshot_write": FaultSpec(schedule=(0,)),
+            "snapshot_corrupt": FaultSpec(schedule=(2,)),  # the LAST good save
+        },
+    )
+    engine = StreamingEngine(
+        collection(),
+        EngineConfig(
+            buckets=(8, 32),
+            coalesce=8,
+            kernel_backend="pallas_interpret",  # demotable; xla is the floor
+            screen=ScreenPolicy(non_finite="quarantine"),
+            snapshot_every=2,
+            snapshot_dir=snapdir,
+            snapshot_keep=4,
+            fault_injector=inj,
+        ),
+    )
+    with engine:
+        for b in traffic:
+            engine.submit(*b)
+        got = {k: np.asarray(v) for k, v in engine.result().items()}
+    for k in want:
+        _check(np.array_equal(got[k], want[k]), f"chaos parity: {k} {got[k]} != {want[k]}")
+    st = engine.stats
+    _check(st.rollbacks >= 3, f"expected >=3 pre-step rollbacks, saw {st.rollbacks}")
+    _check(st.retries >= 3, f"expected >=3 retries, saw {st.retries}")
+    _check(st.kernel_demotions == 1, f"expected 1 kernel demotion, saw {st.kernel_demotions}")
+    _check(engine._kernel_backend == "xla", "engine did not demote to the xla backend")
+    _check(st.watchdog_timeouts == 1, f"expected 1 watchdog expiry, saw {st.watchdog_timeouts}")
+    # the coalesce site is consulted only when the drain limit exceeds 1 —
+    # snapshot boundaries cap it to 1 on alternating groups at this cadence
+    _check(st.coalesce_degraded >= 3, f"coalesce degradation barely fired: {st.coalesce_degraded}")
+    _check(st.snapshot_failures == 1, f"expected 1 contained snapshot failure, saw {st.snapshot_failures}")
+    # quarantine ledger accounts for EXACTLY the poisoned batch
+    q = engine.quarantine()
+    _check(
+        st.quarantined_batches == 1 and st.quarantined_rows == 2,
+        f"quarantine ledger off: {st.quarantined_batches} batches / {st.quarantined_rows} rows",
+    )
+    _check(
+        len(q) == 1 and q[0].cursor == 2 and q[0].rows == 2 and "non-finite" in q[0].reason,
+        f"quarantine record wrong: {[(r.cursor, r.rows, r.reason) for r in q]}",
+    )
+    # the arena is not torn: the carried buffers still match the layout
+    layout = engine.arena_layout
+    _check(
+        layout is not None and layout.matches(engine._state),
+        "carried arena does not match its layout after chaos",
+    )
+    engine.export_telemetry(out_path)
+    fired_sites |= set(inj.fired)
+
+    # --------------------------------- kill + restore past the corrupt LATEST
+    del engine
+    read_inj = FaultInjector(seed=11, plan={"snapshot_read": FaultSpec(schedule=(0,))})
+    resumed = StreamingEngine(
+        collection(),
+        EngineConfig(
+            buckets=(8, 32),
+            screen=ScreenPolicy(non_finite="quarantine"),
+            snapshot_dir=snapdir,
+            fault_injector=read_inj,
+        ),
+    )
+    meta = resumed.restore()
+    _check(
+        int(meta.get("generations_skipped", 0)) == 1,
+        f"restore should skip exactly the corrupted LATEST, skipped {meta.get('generations_skipped')}",
+    )
+    _check(resumed.stats.snapshot_fallbacks == 1, "snapshot fallback not counted")
+    _check(resumed.stats.retries == 1, "transient snapshot_read was not retried")
+    # saves fired at cursors 2 (write-failed), 4, 6, 8; the @8 payload was
+    # bit-flipped after its save — fallback must land on the @6 generation
+    cursor = int(meta["batches_done"])
+    _check(cursor == 6, f"fallback generation cursor should be 6, got {cursor}")
+    with resumed:
+        for b in traffic[cursor:]:
+            resumed.submit(*b)
+        replayed = {k: np.asarray(v) for k, v in resumed.result().items()}
+    for k in want:
+        _check(
+            np.array_equal(replayed[k], want[k]),
+            f"replay-after-fallback parity: {k} {replayed[k]} != {want[k]}",
+        )
+    fired_sites |= set(read_inj.fired)
+
+    # ------------------------------------- deferred boundary merge, 1-dev mesh
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("dp",))
+    merge_inj = FaultInjector(seed=13, plan={"merge": FaultSpec(schedule=(0,))})
+    deferred = StreamingEngine(
+        collection(),
+        EngineConfig(
+            buckets=(8, 32), mesh=mesh, axis="dp", mesh_sync="deferred",
+            fault_injector=merge_inj,
+        ),
+    )
+    with deferred:
+        for b in clean:
+            deferred.submit(*b)
+        got_d = {k: np.asarray(v) for k, v in deferred.result().items()}
+    for k in want:
+        _check(
+            np.array_equal(got_d[k], want[k]),
+            f"deferred merge-retry parity: {k} {got_d[k]} != {want[k]}",
+        )
+    _check(merge_inj.fired.get("merge", 0) == 1, "merge fault did not fire")
+    _check(deferred.stats.retries == 1, "merge fault was not retried")
+    fired_sites |= set(merge_inj.fired)
+
+    # --------------------------- dead dispatcher: sticky submit, reset re-arms
+    kill_inj = FaultInjector(
+        seed=17, plan={"dispatcher_kill": FaultSpec(schedule=(0,), transient=False, fatal=True)}
+    )
+    dead = StreamingEngine(
+        Accuracy(), EngineConfig(buckets=(8,), max_queue=2, fault_injector=kill_inj)
+    )
+    p, t = np.asarray([0.9, 0.2], np.float32), np.asarray([1, 0], np.int32)
+    dead.start()
+    dead.submit(p, t)
+    deadline = time.monotonic() + 10.0
+    sticky = None
+    while time.monotonic() < deadline and sticky is None:
+        try:
+            dead.submit(p, t, timeout=0.2)
+        except EngineDispatchError as e:
+            sticky = e
+        except BackpressureTimeout:
+            continue  # the kill has not landed yet; keep probing
+    _check(
+        sticky is not None and "dispatcher_kill" in str(sticky),
+        "submit(timeout=) did not surface the dead dispatcher's sticky error",
+    )
+    dead.reset()  # drains the dead queue, clears the error, re-arms
+    dead.submit(p, t)
+    _check(float(dead.result()) == 1.0, "engine did not serve after dispatcher-death reset")
+    dead.stop()
+    fired_sites |= set(kill_inj.fired)
+
+    # ----------------------- trace-time kernel-dispatch fault: silent fallback
+    import jax.numpy as jnp
+
+    from metrics_tpu.ops.kernels import fold_rows_masked, kernel_fault_scope, use_backend
+
+    calls = []
+
+    def hook(kernel):
+        calls.append(kernel)
+        raise RuntimeError("injected trace-time kernel failure")
+
+    state = jnp.zeros((4,), jnp.float32)
+    rows = jnp.asarray(rng.randint(0, 65, size=(6, 4)) / 64.0, jnp.float32)
+    mask = jnp.asarray([True] * 5 + [False])
+    want_fold = np.asarray(fold_rows_masked(state, rows, mask, "sum", backend="xla"))
+    with kernel_fault_scope(hook), use_backend("pallas"):
+        got_fold = np.asarray(fold_rows_masked(state, rows, mask, "sum"))
+    _check(bool(calls), "trace-time kernel fault hook never ran")
+    _check(
+        np.array_equal(got_fold, want_fold),
+        "kernel-dispatch fault did not fall back to the XLA path",
+    )
+
+    # ------------------------------------------------------- sweep completeness
+    missing = set(FAULT_SITES) - fired_sites
+    _check(not missing, f"injection points never fired: {sorted(missing)}")
+
+    if _FAILED:
+        return 1
+    print(
+        "chaos-smoke PASS: "
+        f"{len(FAULT_SITES)} injection points fired; chaos result bit-identical "
+        f"to fault-free run ({len(clean)} batches; 1 poisoned batch quarantined, "
+        f"ledger exact); rollbacks={st.rollbacks}, retries={st.retries}, "
+        f"demotions={st.kernel_demotions}, watchdog={st.watchdog_timeouts}; "
+        "restore fell back past the corrupted LATEST with exact replay; "
+        f"telemetry -> {out_path}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(*sys.argv[1:2]))

@@ -161,13 +161,33 @@ class MultiStreamEngine(StreamingEngine):
             )
         return sid
 
-    def submit(self, stream_id: int, *args: Any, **kwargs: Any) -> None:
-        """Enqueue one (ragged) batch for ``stream_id``. Blocks when full."""
+    def submit(
+        self, stream_id: int, *args: Any, timeout: Optional[float] = None, **kwargs: Any
+    ) -> None:
+        """Enqueue one (ragged) batch for ``stream_id``. Blocks when full;
+        ``timeout`` bounds the wait exactly like the base engine's (sticky
+        dispatcher error preferred over :class:`BackpressureTimeout`)."""
         sid = self._check_stream(stream_id)
         self._raise_if_failed()
         self.start()
+        self._enqueue((sid, args, kwargs), timeout)
         self._stats.batches_submitted += 1
-        self._queue.put((sid, args, kwargs))
+
+    # ---------------------------------------------------------- fault context
+
+    def _screen_payload(self, item: Any) -> Any:
+        # the screen policy must see exactly what the metric's update sees —
+        # strip the engine-internal stream id
+        return (item[1], item[2])
+
+    def _item_context(self, item: Any) -> Dict[str, Any]:
+        return {"stream_id": item[0]}
+
+    def _group_context(self, group: List[Any]) -> Dict[str, Any]:
+        # the sticky error names every stream whose traffic rode the failed
+        # group — the poisoned input is in one of THOSE streams' logs
+        sids = sorted({it[0] for it in group if isinstance(it, tuple) and len(it) == 3})
+        return {"stream_ids": sids} if sids else {}
 
     def result(self, stream_id: int) -> Any:  # type: ignore[override]
         """Flush, then compute ``stream_id``'s accumulated value (shared

@@ -73,6 +73,25 @@ Design notes:
   Snapshots carry the packed arena (one payload per dtype) plus the metric's
   host-derived compute attributes (``Metric.host_compute_attrs``), so a
   restored engine computes immediately.
+* **Fault tolerance** (``engine/faults.py``; docs/serving.md "Failure
+  semantics"). Steps are TRANSACTIONAL: with ``config.transactional`` the
+  dispatcher keeps a donation-aware shadow of the pre-step state (a free
+  reference when donation is off, one device copy when it is on) and every
+  step failure rolls back onto it — a poisoned batch or injected fault never
+  leaves the arena torn. Pre-dispatch SCREENING (``config.screen``, the
+  ``nan_strategy`` vocabulary + ``"quarantine"``) dead-letters bad batches
+  into a bounded ledger instead of letting them reach a compiled step.
+  Transient failures get bounded retries with seeded jittered exponential
+  backoff; kernel failures demote ``pallas → xla`` (the tag is in every
+  program key, so demoted programs never collide in a shared cache);
+  megabatch failures shrink to singleton re-dispatch so the sticky error
+  names exactly the poisoned cursor; a per-step watchdog
+  (``config.step_timeout_s``) catches stuck pipelines; failed PERIODIC
+  snapshots are contained (the previous generation keeps serving restore)
+  and ``restore()`` falls back past corrupted generations. Every boundary is
+  instrumented for the seeded chaos harness (``config.fault_injector``,
+  ``make chaos-smoke``) and every recovery action is counted in
+  ``engine/stats.py``.
 """
 import queue
 import threading
@@ -88,6 +107,18 @@ import numpy as np
 from metrics_tpu.engine.aot import AotCache, metric_fingerprint
 from metrics_tpu.engine.arena import ArenaLayout
 from metrics_tpu.engine.bucketing import BucketPolicy
+from metrics_tpu.engine.faults import (
+    BackpressureTimeout,
+    EngineDispatchError,
+    FaultInjector,
+    InjectedFault,
+    QuarantineRecord,
+    ScreenPolicy,
+    StepTimeoutError,
+    corrupt_snapshot,
+    is_transient,
+    wait_with_timeout,
+)
 from metrics_tpu.engine.snapshot import load_snapshot, save_snapshot
 from metrics_tpu.engine.stats import EngineStats
 from metrics_tpu.ops.kernels import current_backend, resolve_backend, use_backend
@@ -163,7 +194,42 @@ class EngineConfig:
         pad_value: fill for pad rows (must pass the metric's input checks;
             masked out of every reduction regardless).
         telemetry_capacity: ring-buffer size for per-step telemetry.
-        snapshot_keep: complete snapshots retained after GC.
+        snapshot_keep: complete snapshots retained after GC — the GENERATION
+            RING ``restore()`` falls back through when the newest payload is
+            corrupt (``engine/snapshot.py``).
+        fault_injector: optional seeded :class:`~metrics_tpu.engine.faults.
+            FaultInjector` — the deterministic chaos harness; every engine
+            boundary (ingest/coalesce/compile/step/kernel/watchdog/merge/
+            snapshot) consults it. None (default) costs nothing.
+        screen: optional :class:`~metrics_tpu.engine.faults.ScreenPolicy` —
+            pre-dispatch batch screening (NaN/Inf, id range, batch-shape
+            uniformity) with per-check actions from the ``nan_strategy``
+            vocabulary plus ``"quarantine"`` (dead-letter the batch, keep
+            serving). None (default) screens nothing.
+        quarantine_capacity: dead-letter ledger size (newest records kept,
+            payload included); lifetime counts live in ``stats``.
+        max_retries: bounded retry budget for TRANSIENT failures per step /
+            group / boundary merge (injected transients, watchdog expiries,
+            RESOURCE_EXHAUSTED-family runtime errors). Deterministic errors
+            (shape mismatches, trace failures) never retry — they go sticky
+            with the failing batch context attached.
+        backoff_base_ms / backoff_max_ms: jittered exponential backoff
+            between retries (seeded jitter — chaos runs are replayable).
+        step_timeout_s: per-step watchdog (0 = off). When armed the engine
+            syncs every step before commit (trading the async pipeline for
+            per-step containment) and a stuck device step rolls back and
+            retries instead of wedging the dispatcher forever.
+        transactional: keep a donation-aware SHADOW of the pre-step state so
+            step failures roll back instead of poisoning the carry. None
+            (default) auto-enables when donation is off (the shadow is a free
+            reference — CPU serving is always transactional), when a
+            fault_injector is present, or when the watchdog is armed
+            (``step_timeout_s > 0`` — expiry recovery REQUIRES the shadow);
+            with donation on, True costs one device-to-device state copy
+            per step.
+        degrade_kernel: demote this engine ``pallas → xla`` when a kernel-
+            site fault fires (the resolved backend tag is part of every
+            program key, so demotion re-compiles rather than collides).
     """
 
     buckets: Tuple[int, ...] = (256, 1024)
@@ -183,6 +249,15 @@ class EngineConfig:
     pad_value: Any = 0
     telemetry_capacity: int = 1024
     snapshot_keep: int = 2
+    fault_injector: Optional[FaultInjector] = None
+    screen: Optional[ScreenPolicy] = None
+    quarantine_capacity: int = 64
+    max_retries: int = 2
+    backoff_base_ms: float = 1.0
+    backoff_max_ms: float = 50.0
+    step_timeout_s: float = 0.0
+    transactional: Optional[bool] = None
+    degrade_kernel: bool = True
 
 
 class StreamingEngine:
@@ -210,6 +285,23 @@ class StreamingEngine:
         if reason is not None:
             raise MetricsTPUUserError(
                 f"metric cannot be served by the streaming engine: {reason}"
+            )
+        if self._cfg.max_retries < 0:
+            raise MetricsTPUUserError(
+                f"max_retries must be >= 0, got {self._cfg.max_retries}"
+            )
+        if self._cfg.step_timeout_s < 0:
+            raise MetricsTPUUserError(
+                f"step_timeout_s must be >= 0, got {self._cfg.step_timeout_s}"
+            )
+        if self._cfg.screen is not None and not isinstance(self._cfg.screen, ScreenPolicy):
+            raise MetricsTPUUserError(
+                f"config.screen must be a ScreenPolicy, got {type(self._cfg.screen).__name__}"
+            )
+        inj = self._cfg.fault_injector
+        if inj is not None and not isinstance(inj, FaultInjector):
+            raise MetricsTPUUserError(
+                f"config.fault_injector must be a FaultInjector, got {type(inj).__name__}"
             )
         divisor = 1
         if self._cfg.mesh is not None:
@@ -263,6 +355,36 @@ class StreamingEngine:
         self._merged_memo: Optional[Tuple[int, Any]] = None
         self._state = self._put_state(self._init_state_tree())
         self._donate = bool(self._cfg.donate) and jax.default_backend() != "cpu"
+        # transactional steps: None auto-enables when the shadow is FREE
+        # (donation off — the step inputs survive the call untouched), when a
+        # chaos injector is present, or when the WATCHDOG is armed — its
+        # whole contract is rollback-and-retry on expiry, which without a
+        # shadow under donation would silently degrade to sticky-with-torn-
+        # state. With donation on, the shadow is one device copy per step
+        # (documented cost).
+        self._transactional = (
+            self._cfg.transactional
+            if self._cfg.transactional is not None
+            else (
+                (not self._donate)
+                or inj is not None
+                or self._cfg.step_timeout_s > 0
+            )
+        )
+        # jittered-backoff stream, seeded so chaos runs replay exactly
+        self._retry_rng = np.random.RandomState(
+            ((inj.seed if inj is not None else 0) ^ 0x5EED) & 0x7FFFFFFF
+        )
+        # dead-letter ledger for screened-out batches: newest records kept
+        # (payload included) up to the cap; lifetime counts live in stats
+        self._quarantine: "deque[QuarantineRecord]" = deque(
+            maxlen=max(1, int(self._cfg.quarantine_capacity))
+        )
+        # the watchdog arms when configured OR when the chaos plan can fire
+        # the watchdog site (so the injected expiry exercises the real path)
+        self._watchdog_enabled = self._cfg.step_timeout_s > 0 or (
+            inj is not None and inj.has_site("watchdog")
+        )
         # deferred steady steps carry ZERO collectives, so the CPU in-process
         # communicator hazard doesn't apply — only step-sync CPU meshes
         # serialize; boundary merges block under the state lock in both modes
@@ -628,9 +750,27 @@ class StreamingEngine:
         if self._merged_memo is not None and self._merged_memo[0] == self._state_version:
             return self._merged_memo[1]
         program = self._merge_program()  # compile (first boundary) outside the timing
-        t0 = time.perf_counter()
-        merged = program(self._state)
-        jax.block_until_ready(merged)
+
+        def merge_once() -> Tuple[Any, float]:
+            self._fault("merge")
+            t0 = time.perf_counter()
+            merged = program(self._state)
+            jax.block_until_ready(merged)
+            return merged, t0
+
+        # the merge is a non-donated READ of the carried state: any failure
+        # leaves the shard-local accumulation fully intact, so transients
+        # retry here and everything that escapes still leaves result()/
+        # state() serving the last consistent value on the caller's next try
+        try:
+            merged, t0 = self._retry_transient(merge_once)
+        except BaseException as e:  # noqa: BLE001 - typed wrap below
+            from metrics_tpu.parallel.embedded import boundary_merge_error
+
+            err = boundary_merge_error(self._cfg.axis, self._world, e)
+            if err is e:
+                raise
+            raise err from e
         self._stats.record_merge((time.perf_counter() - t0) * 1e6)
         self._merged_memo = (self._state_version, merged)
         return merged
@@ -638,7 +778,10 @@ class StreamingEngine:
     # --------------------------------------------------------------------- lifecycle
 
     def start(self) -> "StreamingEngine":
-        if self._worker is None:
+        # also re-arms after a FATAL dispatcher death (the thread exited
+        # without draining): once reset()/restore() cleared the sticky error
+        # and drained the backlog, the next submit gets a fresh dispatcher
+        if self._worker is None or not self._worker.is_alive():
             self._worker = threading.Thread(
                 target=self._run, name="metrics-tpu-engine", daemon=True
             )
@@ -657,27 +800,88 @@ class StreamingEngine:
     def stop(self) -> None:
         """Drain the queue and stop the dispatcher (idempotent)."""
         if self._worker is not None:
-            self._queue.put(_STOP)
+            # bounded-put loop, not one unconditional put: a DEAD dispatcher
+            # (fatal fault) behind a FULL queue has no thread left to read
+            # the sentinel, and the liveness check alone races the thread's
+            # last instants — re-check between short put attempts so a death
+            # mid-stop falls through to the join instead of blocking forever
+            while self._worker.is_alive():
+                try:
+                    self._queue.put(_STOP, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
             self._worker.join()
             self._worker = None
 
     def _raise_if_failed(self) -> None:
-        if self._error is not None:
-            raise RuntimeError("streaming engine dispatcher failed") from self._error
+        if self._error is None:
+            return
+        # satellite (ISSUE 6): chain the ORIGINAL exception and name the
+        # failing batch — cursor (replay coordinate), step, bucket, stream
+        # ids — so operators can find the poisoned input from the message
+        ctx = getattr(self._error, "_engine_ctx", None) or {}
+        detail = "".join(f"; {k}={v}" for k, v in sorted(ctx.items()))
+        raise EngineDispatchError(
+            f"streaming engine dispatcher failed: "
+            f"{type(self._error).__name__}: {self._error}{detail}",
+            context=ctx,
+        ) from self._error
 
     # --------------------------------------------------------------------- producers
 
-    def submit(self, *args: Any, **kwargs: Any) -> None:
-        """Enqueue one (ragged) batch. Blocks when the queue is full."""
+    def submit(self, *args: Any, timeout: Optional[float] = None, **kwargs: Any) -> None:
+        """Enqueue one (ragged) batch. Blocks when the queue is full.
+
+        ``timeout`` (seconds) bounds the wait: when the bounded queue stays
+        full for the whole window — the signature of a dead or wedged
+        dispatcher behind live producers — the sticky dispatcher error is
+        raised if one exists, else :class:`BackpressureTimeout`. ``None``
+        (default) keeps the pure-backpressure blocking contract."""
         self._raise_if_failed()
         self.start()
+        self._enqueue((args, kwargs), timeout)
         self._stats.batches_submitted += 1
-        self._queue.put((args, kwargs))
+
+    def _enqueue(self, item: Any, timeout: Optional[float]) -> None:
+        if timeout is None:
+            self._queue.put(item)
+            return
+        deadline = time.monotonic() + float(timeout)
+        while True:
+            # poll the sticky error each slice: a producer blocked on a full
+            # queue must learn the dispatcher died, not deadlock forever
+            self._raise_if_failed()
+            try:
+                # always attempt at least once — timeout=0 is the documented
+                # "try, don't block" form and must succeed on a free queue
+                self._queue.put_nowait(item)
+                return
+            except queue.Full:
+                pass
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                self._raise_if_failed()
+                alive = self._worker is not None and self._worker.is_alive()
+                raise BackpressureTimeout(
+                    f"submit() timed out after {timeout}s: queue full "
+                    f"({self._queue.qsize()}/{max(1, self._cfg.max_queue)}) and the "
+                    f"dispatcher is {'alive but not draining' if alive else 'dead'}"
+                )
+            try:
+                self._queue.put(item, timeout=min(0.05, remaining))
+                return
+            except queue.Full:
+                continue
 
     def flush(self) -> None:
-        """Block until every submitted batch is folded into the state."""
+        """Block until every submitted batch is folded into the state.
+
+        Survives a dispatcher that dies MID-FLUSH (fatal fault): the wait
+        re-checks thread liveness, drains the orphaned backlog, and the
+        sticky error is raised instead of hanging the caller forever."""
         self._raise_if_failed()
-        self._queue.join()
+        self._join_queue()
         with self._state_lock:  # a concurrent step must not donate the
             jax.block_until_ready(self._state)  # buffers out from under us
         self._raise_if_failed()
@@ -743,7 +947,7 @@ class StreamingEngine:
         the backlog without folding it — the error is cleared, and the
         accumulation starts over. Without a failure this flushes normally
         (every pending batch lands before the state is replaced)."""
-        self._queue.join()
+        self._join_queue()
         with self._state_lock:
             self._error = None
             self._inflight.clear()
@@ -766,6 +970,10 @@ class StreamingEngine:
             return self._save_snapshot_locked()
 
     def _save_snapshot_locked(self) -> str:
+        # a write-site fault fires BEFORE any bytes land: LATEST still points
+        # at the previous complete generation (the atomic-pointer contract),
+        # so a failed save degrades recovery granularity, never correctness
+        self._fault("snapshot_write")
         # the carried form: arena = 1 payload/dtype. Under deferred sync the
         # payload is the SHARD-STACKED arena — every shard's local state, i.e.
         # full provenance: the merged view is derivable (merge_stacked_states)
@@ -789,6 +997,13 @@ class StreamingEngine:
             host_attrs=self._metric.host_compute_attrs(),
         )
         self._stats.snapshots += 1
+        inj = self._cfg.fault_injector
+        if inj is not None and inj.fire("snapshot_corrupt"):
+            # bit-rot chaos: the save SUCCEEDED (LATEST points here) and then
+            # the payload rots on disk — the case the integrity sidecar and
+            # restore()'s generation-ring fallback exist for
+            self._stats.record_fault("snapshot_corrupt")
+            corrupt_snapshot(path, inj.snapshot_rng())
         return path
 
     def restore(self, directory_or_path: Optional[str] = None) -> Dict[str, Any]:
@@ -803,10 +1018,20 @@ class StreamingEngine:
         Also a RECOVERY path for a sticky dispatcher failure: the backlog is
         drained unfolded and the error is cleared once the snapshot state is
         committed (a failed load leaves the engine — error included — as it
-        was).
+        was). Loads through the generation-ring FALLBACK: a corrupted or
+        truncated newest payload (typed ``SnapshotCorruptError``) falls back
+        to the newest VALID generation — the returned ``batches_done`` is
+        then the OLDER cursor, and replay from it is exact; the fallback is
+        counted in ``stats.snapshot_fallbacks``. Transient read failures
+        retry with backoff inside this call.
         """
-        self._queue.join()  # drain; a sticky-failed dispatcher discards
-        state, meta = load_snapshot(directory_or_path or self._cfg.snapshot_dir)
+        self._join_queue()  # drain; a sticky-failed (or dead) dispatcher discards
+
+        def load_once() -> Tuple[Any, Dict[str, Any]]:
+            self._fault("snapshot_read")
+            return load_snapshot(directory_or_path or self._cfg.snapshot_dir, fallback=True)
+
+        state, meta = self._retry_transient(load_once)
         # VALIDATE before mutating anything: a failed restore must leave the
         # live engine (metric attrs, fingerprint, memo, state) untouched
         packed = bool(int(meta.get("packed", 0)))
@@ -895,6 +1120,8 @@ class StreamingEngine:
             self._stats.rows_in = int(meta.get("rows_in", self._stats.rows_in))
             self._stats.rows_padded = int(meta.get("rows_padded", self._stats.rows_padded))
             self._stats.resumes += 1
+            if int(meta.get("generations_skipped", 0) or 0) > 0:
+                self._stats.snapshot_fallbacks += 1
         return meta
 
     # -------------------------------------------------------------------- dispatcher
@@ -912,7 +1139,7 @@ class StreamingEngine:
             if first is _STOP:
                 self._queue.task_done()
                 return
-            group, pending, saw_stop = [first], None, False
+            group, pending, saw_stop, fatal = [first], None, False, False
             if self._error is None:
                 group, pending, saw_stop, drain_wait_us = self._coalesce_group(first)
                 wait_us += drain_wait_us  # window blocking is queue wait too
@@ -920,13 +1147,61 @@ class StreamingEngine:
                 if self._error is None:  # after a failure: drain without work
                     self._process_group(group, wait_us)
             except BaseException as e:  # noqa: BLE001 - surfaced via _raise_if_failed
+                _attach_ctx(e, cursor=self._batches_done, **self._group_context(group))
                 self._error = e
+                fatal = isinstance(e, InjectedFault) and e.fatal
             finally:
                 for _ in group:
                     self._queue.task_done()
+            if fatal:
+                # a FATAL fault models the dispatcher process dying outright:
+                # the thread exits without draining, the bounded queue fills,
+                # and producers learn of it via submit(timeout=)'s sticky
+                # raise; recovery entry points (reset/restore/flush) drain
+                # the backlog themselves (_join_queue). Items this loop
+                # already DEQUEUED — the coalescer's incompatible look-ahead
+                # and a consumed _STOP — must still count as done here, or
+                # the queue's unfinished counter stays inflated forever and
+                # every join after a successful reset() hangs.
+                if pending is not None:
+                    self._queue.task_done()
+                if saw_stop:
+                    self._queue.task_done()
+                return
             if saw_stop:
                 self._queue.task_done()
                 return
+
+    def _group_context(self, group: List[Any]) -> Dict[str, Any]:
+        """Extra failure context for a group (subclasses add stream ids)."""
+        return {}
+
+    def _join_queue(self) -> None:
+        """``queue.join()`` that survives a DEAD dispatcher — including one
+        that dies WHILE we wait. A live worker drains normally (we wait on
+        the queue's all-tasks-done condition in slices, re-checking thread
+        liveness each slice); once no live worker exists — a fatal fault
+        killed it, or ``stop()`` already cleared it while a backlog (possibly
+        with a stale ``_STOP``) remains — the backlog is drained here, since
+        unfinished items would otherwise pin ``join()`` (and with it flush/
+        reset/restore) forever."""
+        while self._worker is not None and self._worker.is_alive():
+            with self._queue.all_tasks_done:
+                if self._queue.unfinished_tasks == 0:
+                    return
+                self._queue.all_tasks_done.wait(timeout=0.1)
+        while True:
+            try:
+                self._queue.get_nowait()
+            except queue.Empty:
+                break
+            self._queue.task_done()
+        # items a dead dispatcher dequeued but never finished cannot be
+        # recovered; zero the counter so later joins see a consistent queue
+        with self._queue.all_tasks_done:
+            if self._queue.unfinished_tasks:
+                self._queue.unfinished_tasks = 0
+                self._queue.all_tasks_done.notify_all()
 
     # ------------------------------------------------------------------- coalescing
 
@@ -965,6 +1240,14 @@ class StreamingEngine:
             )
         group = [first]
         if limit <= 1:
+            return group, None, False, 0.0
+        inj = self._cfg.fault_injector
+        if inj is not None and inj.fire("coalesce"):
+            # graceful degradation, not an error: this path must NEVER raise
+            # (an escape would kill the dispatcher and deadlock flush) — a
+            # coalesce-machinery fault just serves the group as singletons
+            self._stats.record_fault("coalesce")
+            self._stats.coalesce_degraded += 1
             return group, None, False, 0.0
         rows = self._item_rows_safe(first)
         if rows is None:  # malformed: run alone so the error surfaces cleanly
@@ -1064,11 +1347,146 @@ class StreamingEngine:
                 out_leaves.append(leaf0)
         return jax.tree_util.tree_unflatten(treedef, out_leaves)
 
+    # ----------------------------------------------------------------- fault plumbing
+
+    def _fault(self, site: str) -> None:
+        """Consult the chaos plan at an injection boundary; a fired fault is
+        counted in stats and raised (``InjectedFault``/``StepTimeoutError``)
+        for the surrounding recovery machinery to handle."""
+        inj = self._cfg.fault_injector
+        if inj is None:
+            return
+        try:
+            inj.check(site)
+        except BaseException:
+            self._stats.record_fault(site)
+            raise
+
+    def _backoff(self, attempt: int) -> None:
+        """Jittered exponential backoff before retry ``attempt`` (1-based).
+        Jitter draws from a seeded stream so chaos runs replay exactly."""
+        base = max(0.0, self._cfg.backoff_base_ms) / 1e3
+        cap = max(base, self._cfg.backoff_max_ms / 1e3)
+        delay = min(cap, base * (2 ** (attempt - 1)))
+        delay *= 0.5 + 0.5 * float(self._retry_rng.rand())
+        if delay > 0:
+            time.sleep(delay)
+
+    def _retry_transient(
+        self, fn: Any, transient: Any = is_transient
+    ) -> Any:
+        """THE bounded-backoff retry policy for every non-step boundary
+        (group ingest, deferred merge, snapshot read): run ``fn`` up to
+        ``1 + max_retries`` times, retrying (counted, jitter-backed-off)
+        failures ``transient`` accepts, re-raising everything else — one
+        implementation, so accounting and seeding can never diverge between
+        sites. Step recovery stays in :meth:`_recover_step` (it adds
+        rollback and kernel demotion on top of this policy)."""
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except BaseException as e:  # noqa: BLE001 - classified by policy
+                if not transient(e) or attempt >= self._cfg.max_retries:
+                    raise
+                attempt += 1
+                self._stats.retries += 1
+                self._backoff(attempt)
+
+    def _step_shadow(self) -> Optional[Any]:
+        """The donation-aware shadow handoff: the pre-step state a failed
+        step rolls back onto. Without donation the live buffers survive the
+        call untouched, so the shadow is a free reference; with donation the
+        step CONSUMES them, so transactional mode pays one device copy.
+        None = not transactional (a failure is sticky, as before ISSUE 6)."""
+        if not self._transactional:
+            return None
+        if not self._donate:
+            return self._state
+        if self._layout is not None and isinstance(self._state, dict):
+            return ArenaLayout.clone_buffers(self._state)
+        return jax.tree.map(lambda x: jnp.array(x, copy=True), self._state)
+
+    # ------------------------------------------------------------------- quarantine
+
+    def quarantine(self) -> List[QuarantineRecord]:
+        """The dead-letter ledger: batches the screen policy rejected, newest
+        ``config.quarantine_capacity`` retained with payloads; lifetime
+        counts live in ``stats`` (``quarantined_batches``/``_rows``)."""
+        with self._state_lock:
+            return list(self._quarantine)
+
+    def clear_quarantine(self) -> None:
+        with self._state_lock:
+            self._quarantine.clear()
+
+    def _screen_payload(self, item: Any) -> Any:
+        """The (args, kwargs) view of one queue item the screen policy sees
+        (subclasses strip engine-internal leading arguments)."""
+        return item
+
+    def _item_context(self, item: Any) -> Dict[str, Any]:
+        """Per-item failure/quarantine context (subclasses add stream ids)."""
+        return {}
+
+    def _record_quarantine(self, item: Any, rows: int, cursor: int, reason: str) -> None:
+        self._quarantine.append(
+            QuarantineRecord(
+                cursor=cursor,
+                rows=int(rows),
+                reason=reason,
+                stream_id=self._item_context(item).get("stream_id"),
+                payload=item,
+            )
+        )
+        self._stats.quarantined_batches += 1
+        self._stats.quarantined_rows += int(rows)
+
+    def _screen_group(
+        self, sized: List[Tuple[Any, int]]
+    ) -> List[Tuple[Any, int]]:
+        """Apply the screen policy per batch BEFORE anything reaches a
+        compiled step. Quarantined batches leave the group but their replay
+        cursor still advances (``_batches_done`` counts the whole group), so
+        kill/resume replay re-screens them identically — the ledger accounts
+        for exactly the rejected rows in both runs. ``"error"`` verdicts
+        become the sticky dispatcher failure, context attached."""
+        policy = self._cfg.screen
+        if policy is None:
+            return sized
+        kept: List[Tuple[Any, int]] = []
+        for j, (it, n) in enumerate(sized):
+            verdict = None
+            if n > 0:
+                try:
+                    verdict = policy.screen(self._screen_payload(it), n)
+                except Exception:  # noqa: BLE001 - a screen probe crash must
+                    verdict = None  # not reject what it could not inspect
+            if verdict is None:
+                kept.append((it, n))
+                continue
+            action, reason = verdict
+            cursor = self._batches_done + j
+            if action == "error":
+                err = MetricsTPUUserError(f"batch rejected by screen policy: {reason}")
+                _attach_ctx(err, cursor=cursor, **self._item_context(it))
+                raise err
+            self._record_quarantine(it, n, cursor, reason)
+        return kept
+
     # -------------------------------------------------------------------- processing
 
     def _process_group(self, group: List[Any], queue_wait_us: float) -> None:
         with self._state_lock:
-            self._process_group_locked(group, queue_wait_us)
+            # only INGEST faults retry at this level: they fire before
+            # anything folds, so the whole group re-runs from untouched
+            # state; everything else is handled deeper or goes sticky
+            self._retry_transient(
+                lambda: self._process_group_locked(group, queue_wait_us),
+                transient=lambda e: (
+                    isinstance(e, InjectedFault) and e.site == "ingest" and e.transient
+                ),
+            )
 
     def _latch_payload(self, merged: Any) -> Tuple[Tuple[Any, ...], Dict[str, Any]]:
         """The (args, kwargs) a host-attr latch row is sliced from (subclasses
@@ -1101,51 +1519,201 @@ class StreamingEngine:
         self._program_memo.clear()
 
     def _process_group_locked(self, group: List[Any], queue_wait_us: float) -> None:
-        # size each item ONCE; the sizes feed the empty filter, the merge's
-        # concat, the chunker, and the coalesce telemetry
+        # a FATAL fault here models the dispatcher dying outright (host OOM,
+        # runtime abort): _run exits without draining — the wedge that
+        # submit(timeout=)'s sticky raise and _join_queue exist for
+        self._fault("dispatcher_kill")
+        self._fault("ingest")  # host ingestion boundary: nothing folded yet
+        # size each item ONCE; the sizes feed the empty filter, the screen,
+        # the merge's concat, the chunker, and the coalesce telemetry
         sized = [(it, self._item_rows(it)) for it in group]
-        nonempty = [(it, n) for it, n in sized if n > 0]
+        kept = self._screen_group(sized)
+        nonempty = [(it, n) for it, n in kept if n > 0]
         merged = self._merge_sized(nonempty)
         # an empty group (zero-row tail batches) is a no-op, not a poison
         # pill — it contributes no steps but still advances the replay cursor
         if merged is not None:
             if self._needs_attr_latch:
                 self._latch_host_attrs(merged)
-            args, kwargs = merged
             n = sum(rows for _, rows in nonempty)
-            # coalesced = batches whose ROWS share this dispatch (cursor-only
-            # empties don't count — no concatenation happened for them)
-            n_coalesced = len(nonempty)
-            first_chunk = True
-            for start, stop, bucket in self._policy.chunks(int(n)):
-                t0 = time.perf_counter()
-                a, kw, mask = self._policy.pad_chunk(args, kwargs, start, stop, bucket)
-                t_pad = time.perf_counter()
-                payload, mask_dev = self._upload((a, kw), mask)
-                ingest_us = (time.perf_counter() - t0) * 1e6  # pad+upload only, not compile
-                program = self._update_program(payload, mask)
-                depth = self._queue.qsize()
-                new_state, token = program(self._state, payload, mask_dev)
-                self._state = new_state
-                self._state_version += 1
-                self._step += 1
-                sync_us = self._bound_inflight(token)
-                self._stats.record_step(
-                    bucket=bucket, valid=stop - start, queue_depth=depth,
-                    ingest_us=ingest_us, sync_us=sync_us,
-                    pad_us=(t_pad - t0) * 1e6,
-                    queue_wait_us=queue_wait_us if first_chunk else 0.0,
-                    wall_us=(time.perf_counter() - t0) * 1e6,
-                    coalesced=n_coalesced if first_chunk else 1,
-                )
-                first_chunk = False
+            try:
+                self._execute_payload(merged, int(n), len(nonempty), queue_wait_us)
+            except BaseException as e:  # noqa: BLE001 - classified below
+                # megabatch shrink-on-retry: when the failed group carried
+                # several batches and NO chunk committed, re-dispatch them
+                # one at a time — good traffic lands and the sticky error
+                # names exactly the poisoned member's cursor. Requires the
+                # transactional shadow: without it a donating step may have
+                # consumed the carried buffers, and re-dispatching against
+                # them would turn the real error into a deleted-array crash.
+                # (With partial commits, splitting would double-fold the
+                # committed rows — the failure stays group-level sticky.)
+                if (
+                    len(nonempty) <= 1
+                    or getattr(e, "_committed_chunks", 1) != 0
+                    or not self._transactional
+                ):
+                    raise
+                self._stats.coalesce_shrinks += 1
+                cursors = {id(it): self._batches_done + j for j, (it, _) in enumerate(sized)}
+                for it, n_it in nonempty:
+                    single = self._merge_sized([(it, n_it)])
+                    try:
+                        self._execute_payload(single, int(n_it), 1, 0.0)
+                    except BaseException as se:  # noqa: BLE001
+                        _attach_ctx(
+                            se, cursor=cursors.get(id(it)), **self._item_context(it)
+                        )
+                        raise
         self._batches_done += len(group)
         if (
             self._cfg.snapshot_every > 0
             and self._batches_done % self._cfg.snapshot_every == 0
         ):
             jax.block_until_ready(self._state)
-            self._save_snapshot()
+            try:
+                self._save_snapshot()
+            except BaseException:  # noqa: BLE001 - counted, never sticky
+                # a failed PERIODIC snapshot must not take serving down: the
+                # accumulated state is intact and the previous generation
+                # still backs restore(); count it and keep folding traffic
+                self._stats.snapshot_failures += 1
+
+    def _execute_payload(
+        self, merged: Tuple[Tuple[Any, ...], Dict[str, Any]], n: int,
+        n_coalesced: int, queue_wait_us: float,
+    ) -> None:
+        """Run one merged (args, kwargs) payload through its bucketed chunks.
+        Tags escaping exceptions with ``_committed_chunks`` so the caller
+        knows whether a shrink re-dispatch is exactness-safe."""
+        args, kwargs = merged
+        committed = 0
+        try:
+            first_chunk = True
+            for start, stop, bucket in self._policy.chunks(int(n)):
+                self._execute_chunk(
+                    args, kwargs, start, stop, bucket,
+                    n_coalesced if first_chunk else 1,
+                    queue_wait_us if first_chunk else 0.0,
+                )
+                committed += 1
+                first_chunk = False
+        except BaseException as e:  # noqa: BLE001
+            try:
+                e._committed_chunks = committed
+            except Exception:  # noqa: BLE001 - exotic exception without a dict
+                pass
+            raise
+
+    def _execute_chunk(
+        self, args: Tuple[Any, ...], kwargs: Dict[str, Any],
+        start: int, stop: int, bucket: int, n_coalesced: int, queue_wait_us: float,
+    ) -> None:
+        """One padded device step, transactionally: capture the shadow, run,
+        commit on success; on failure roll back and let :meth:`_recover_step`
+        decide between retry (transient/backoff), kernel demotion, and
+        sticky. Pad+upload happen once — retries reuse the uploaded payload."""
+        t0 = time.perf_counter()
+        a, kw, mask = self._policy.pad_chunk(args, kwargs, start, stop, bucket)
+        t_pad = time.perf_counter()
+        payload, mask_dev = self._upload((a, kw), mask)
+        ingest_us = (time.perf_counter() - t0) * 1e6  # pad+upload only, not compile
+        attempt = 0
+        while True:
+            shadow = self._step_shadow()
+            try:
+                self._do_step(
+                    payload, mask, mask_dev, bucket, stop - start,
+                    n_coalesced, queue_wait_us, ingest_us, t0, t_pad,
+                )
+                return
+            except BaseException as e:  # noqa: BLE001 - classified in recovery
+                if not self._recover_step(e, shadow, attempt):
+                    _attach_ctx(e, step=self._step, bucket=bucket)
+                    raise
+                attempt += 1
+
+    def _do_step(
+        self, payload: Any, mask: np.ndarray, mask_dev: Any, bucket: int,
+        valid: int, n_coalesced: int, queue_wait_us: float, ingest_us: float,
+        t0: float, t_pad: float,
+    ) -> None:
+        self._fault("compile")
+        if self._kernel_tag() != "xla":
+            # the kernel site models a runtime kernel-backend failure —
+            # meaningless for an engine already on the reference lowering
+            self._fault("kernel")
+        program = self._update_program(payload, mask)
+        depth = self._queue.qsize()
+        new_state, token = program(self._state, payload, mask_dev)
+        # the strictest injection point: device work dispatched, host commit
+        # pending — recovery MUST discard new_state, not fold it twice
+        self._fault("step")
+        sync_us: Optional[float] = None
+        if self._watchdog_enabled:
+            # watchdog mode syncs BEFORE commit (trading the async pipeline
+            # for containment): an expiry rolls back cleanly — the hung op
+            # keeps its buffers, the engine keeps its shadow
+            self._fault("watchdog")
+            t_sync = time.perf_counter()
+            if self._cfg.step_timeout_s > 0:
+                wait_with_timeout(
+                    lambda: jax.block_until_ready(token), self._cfg.step_timeout_s
+                )
+            else:
+                jax.block_until_ready(token)
+            sync_us = (time.perf_counter() - t_sync) * 1e6
+            self._inflight.clear()
+        self._state = new_state
+        self._state_version += 1
+        self._step += 1
+        if not self._watchdog_enabled:
+            sync_us = self._bound_inflight(token)
+        self._stats.record_step(
+            bucket=bucket, valid=valid, queue_depth=depth,
+            ingest_us=ingest_us, sync_us=sync_us,
+            pad_us=(t_pad - t0) * 1e6,
+            queue_wait_us=queue_wait_us,
+            wall_us=(time.perf_counter() - t0) * 1e6,
+            coalesced=n_coalesced,
+        )
+
+    def _recover_step(self, e: BaseException, shadow: Optional[Any], attempt: int) -> bool:
+        """Classify a step failure and perform its recovery action. True =
+        the chunk should retry (state already rolled back); False = let it
+        become the sticky dispatcher error."""
+        if shadow is None:
+            # donation without transactional mode: the buffers may already be
+            # consumed — nothing safe to roll back onto (pre-ISSUE-6 behavior)
+            return False
+        # pre-step rollback: the shadow IS the pre-step state (a reference
+        # when donation is off, a retained copy when on); any new_state the
+        # failed attempt produced is discarded, so nothing folds twice
+        self._state = shadow
+        self._merged_memo = None
+        self._stats.rollbacks += 1
+        if isinstance(e, StepTimeoutError):
+            self._stats.watchdog_timeouts += 1
+        if (
+            isinstance(e, InjectedFault)
+            and e.site == "kernel"
+            and self._cfg.degrade_kernel
+            and self._kernel_tag() != "xla"
+        ):
+            # graceful degradation: the kernel backend failed at dispatch —
+            # demote this engine to the XLA reference lowering and rebuild.
+            # The resolved backend tag is part of every program key, so the
+            # demoted programs recompile rather than collide in a shared
+            # cache; demotion is one-way for the engine's lifetime.
+            self._kernel_backend = "xla"
+            self._program_memo.clear()
+            self._stats.kernel_demotions += 1
+            return True
+        if not is_transient(e) or attempt >= self._cfg.max_retries:
+            return False
+        self._stats.retries += 1
+        self._backoff(attempt + 1)
+        return True
 
     def _upload(self, payload: Any, mask: np.ndarray) -> Tuple[Any, Any]:
         """Host → device transfer with the step program's expected shardings."""
@@ -1178,6 +1746,23 @@ class StreamingEngine:
         t0 = time.perf_counter()
         jax.block_until_ready(oldest)
         return (time.perf_counter() - t0) * 1e6
+
+
+def _attach_ctx(exc: BaseException, **kv: Any) -> None:
+    """Tag an exception with engine failure context (batch cursor, bucket,
+    stream ids) without changing its type mid-flight; ``_raise_if_failed``
+    folds the tags into the producer-facing :class:`EngineDispatchError`.
+    ``setdefault`` keeps the INNERMOST (most precise) value when several
+    layers tag the same key on the way out."""
+    ctx = getattr(exc, "_engine_ctx", None)
+    if ctx is None:
+        try:
+            exc._engine_ctx = ctx = {}
+        except Exception:  # noqa: BLE001 - exceptions with __slots__
+            return
+    for k, v in kv.items():
+        if v is not None and (not isinstance(v, (list, tuple)) or len(v)):
+            ctx.setdefault(k, v)
 
 
 def _aux_leaves_equal(a: Any, b: Any) -> bool:

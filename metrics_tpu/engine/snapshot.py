@@ -16,8 +16,21 @@ Layout (one directory per engine)::
 Atomicity: the snapshot payload is written first, then ``LATEST`` is replaced
 via write-to-temp + ``os.replace`` (atomic on POSIX). A kill mid-payload-write
 leaves a garbage ``snap_*`` that ``LATEST`` never points to; a kill mid-pointer
-leaves the previous pointer. ``load_snapshot`` only ever follows ``LATEST``.
+leaves the previous pointer. ``load_snapshot`` follows ``LATEST`` by default.
 Older snapshots beyond ``keep`` are garbage-collected after the pointer moves.
+
+Integrity (ISSUE 6): every snapshot carries a checksum sidecar
+(``integrity_<snap>.json`` — sha256 over a canonical serialization of the
+whole payload: state leaves, meta, host attrs), written after the payload and
+before the pointer moves. ``load_snapshot`` re-derives the digest from the
+deserialized payload and raises a typed :class:`SnapshotCorruptError`
+(naming the path and generation) on mismatch — the same typed error wraps
+raw deserialization failures from truncated/bit-flipped payloads. The
+``keep`` newest snapshots form a RETAINED GENERATION RING:
+``load_snapshot(..., fallback=True)`` walks it newest-first past corrupt
+generations, so a rotted ``LATEST`` payload degrades to the previous
+generation (plus replay from its older cursor) instead of an outage —
+``StreamingEngine.restore`` uses exactly this path and counts the fallback.
 
 The payload rides the same orbax machinery as ``utils/checkpoint.py`` (numpy-
 ified state pytree; pickle fallback when orbax is absent), plus a ``meta``
@@ -41,6 +54,7 @@ the rows it had folded. ``engine/pipeline.py::restore`` uses the provenance
 to pick the restore path (verbatim same-world restore / host merge into a
 step-sync or single-device engine / shard-0 embedding the other way).
 """
+import hashlib
 import importlib
 import json
 import os
@@ -48,16 +62,30 @@ import pickle
 import shutil
 import time
 from enum import Enum
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 
+from metrics_tpu.engine.faults import SnapshotCorruptError
 from metrics_tpu.utils.imports import _ORBAX_AVAILABLE
 
-__all__ = ["save_snapshot", "load_snapshot", "latest_snapshot"]
+__all__ = [
+    "SnapshotCorruptError",
+    "generations",
+    "latest_snapshot",
+    "load_snapshot",
+    "save_snapshot",
+]
 
 _LATEST = "LATEST"
+
+
+def _integrity_path(path: str) -> str:
+    """Checksum sidecar for a snapshot: ``integrity_<name>.json`` next to it
+    (NOT ``snap_``-prefixed — directory listings of snapshots must never
+    mistake a sidecar for a generation)."""
+    return os.path.join(os.path.dirname(path), f"integrity_{os.path.basename(path)}.json")
 
 
 def _encode_host_attr(v: Any) -> Any:
@@ -111,6 +139,37 @@ def _host_attrs_from_bytes(buf: Any) -> Dict[str, Any]:
     return {k: _decode_host_attr(v) for k, v in doc.items()}
 
 
+def _payload_digest(payload: Any) -> str:
+    """sha256 over a canonical serialization of the snapshot payload.
+
+    Computed on the host-side numpy payload at SAVE time and re-derived from
+    the DESERIALIZED payload at load time — so it catches silent value
+    corruption (bit flips that still deserialize) in addition to the
+    truncations the deserializer itself rejects. Canonical form: treedef
+    repr + per-leaf (dtype, shape, raw bytes) for arrays, typed repr for
+    scalars/strings — stable across the orbax and pickle codecs."""
+    h = hashlib.sha256()
+    leaves, treedef = jax.tree_util.tree_flatten(payload)
+    h.update(repr(treedef).encode())
+    for leaf in leaves:
+        # strings BEFORE the numpy branch: codecs may hand back np.str_
+        # (both a str and an np.generic) — normalize to the python value
+        if isinstance(leaf, str):
+            h.update(f"s:str:{str(leaf)!r}".encode())
+        elif isinstance(leaf, (bytes, bytearray)):
+            h.update(b"b:")
+            h.update(bytes(leaf))
+        elif isinstance(leaf, (np.ndarray, np.generic)):
+            arr = np.asarray(leaf)
+            h.update(f"a:{arr.dtype.str}:{arr.shape}".encode())
+            h.update(np.ascontiguousarray(arr).tobytes())
+        elif isinstance(leaf, (bool, int, float, type(None))):
+            h.update(f"s:{type(leaf).__name__}:{leaf!r}".encode())
+        else:  # pragma: no cover - payloads are numpy/scalars by construction
+            h.update(f"o:{leaf!r}"[:256].encode())
+    return h.hexdigest()
+
+
 def _to_numpy_tree(state: Any) -> Any:
     return jax.tree.map(lambda x: np.asarray(x) if isinstance(x, jax.Array) else x, state)
 
@@ -162,6 +221,11 @@ def save_snapshot(
     else:  # pragma: no cover - orbax is baked into this container
         with open(path, "wb") as f:
             pickle.dump(payload, f)
+    # integrity sidecar AFTER the payload, BEFORE the pointer: a kill between
+    # payload and sidecar leaves an unreferenced generation (fallback loads
+    # accept a missing sidecar); LATEST never points at an unverifiable one
+    with open(_integrity_path(path), "w") as f:
+        json.dump({"sha256": _payload_digest(payload)}, f)
     # the payload is durable; only now may the pointer move (atomic replace)
     tmp = os.path.join(directory, _LATEST + ".tmp")
     with open(tmp, "w") as f:
@@ -188,6 +252,9 @@ def _gc(directory: str, keep: int) -> None:
             continue  # never GC the pointer's target
         full = os.path.join(directory, n)
         shutil.rmtree(full, ignore_errors=True) if os.path.isdir(full) else os.unlink(full)
+        integrity = _integrity_path(full)
+        if os.path.exists(integrity):
+            os.unlink(integrity)
 
 
 def latest_snapshot(directory: str) -> Optional[str]:
@@ -201,27 +268,117 @@ def latest_snapshot(directory: str) -> Optional[str]:
     return path if os.path.exists(path) else None
 
 
-def load_snapshot(directory_or_path: str) -> Tuple[Any, Dict[str, Any]]:
+def generations(directory: str) -> List[str]:
+    """Every retained snapshot path under ``directory``, newest-first by
+    CREATION order (the nanosecond suffix — step numbers recur after a
+    reset/replay). This is the generation ring the fallback restore walks."""
+    try:
+        names = os.listdir(directory)
+    except (FileNotFoundError, NotADirectoryError):
+        return []
+    snaps = [n for n in names if n.startswith("snap_")]
+    return [
+        os.path.join(directory, n)
+        for n in sorted(snaps, key=lambda n: n.rsplit("_", 1)[-1], reverse=True)
+    ]
+
+
+def _load_verified(path: str, verify: bool = True) -> Any:
+    """Deserialize + integrity-check one snapshot payload. Every failure mode
+    of a rotten payload — truncation, bit flips the codec rejects, bit flips
+    it silently accepts — surfaces as one typed :class:`SnapshotCorruptError`
+    naming the path and generation."""
+    generation = os.path.basename(path)
+    if not os.path.exists(path):
+        # an ABSENT snapshot is not a corrupt one: callers handling the
+        # documented "no snapshot yet" contract catch FileNotFoundError.
+        # (A path that exists but is missing internal files still wraps as
+        # corruption below — that IS a rotten payload.)
+        raise FileNotFoundError(f"no snapshot at {path}")
+    try:
+        if _ORBAX_AVAILABLE and os.path.isdir(path):
+            import orbax.checkpoint as ocp
+
+            with ocp.PyTreeCheckpointer() as ckptr:
+                payload = ckptr.restore(os.path.abspath(path))
+        else:
+            with open(path, "rb") as f:
+                payload = pickle.load(f)
+        if not isinstance(payload, dict) or "state" not in payload or "meta" not in payload:
+            raise SnapshotCorruptError(path, generation=generation, reason="payload is not a snapshot dict")
+    except SnapshotCorruptError:
+        raise
+    except Exception as e:
+        raise SnapshotCorruptError(
+            path,
+            generation=generation,
+            reason=f"deserialization failed: {type(e).__name__}: {e}",
+        ) from e
+    integrity = _integrity_path(path)
+    if verify and os.path.exists(integrity):
+        try:
+            with open(integrity) as f:
+                want = json.load(f)["sha256"]
+        except Exception as e:
+            raise SnapshotCorruptError(
+                path, generation=generation, reason="unreadable integrity sidecar"
+            ) from e
+        got = _payload_digest(payload)
+        if got != want:
+            raise SnapshotCorruptError(
+                path,
+                generation=generation,
+                reason=f"checksum mismatch (want {want[:12]}…, got {got[:12]}…)",
+            )
+    return payload
+
+
+def load_snapshot(
+    directory_or_path: str, fallback: bool = False, verify: bool = True
+) -> Tuple[Any, Dict[str, Any]]:
     """Load ``(state, meta)`` from a snapshot dir (follows ``LATEST``) or an
-    explicit snapshot path. Raises ``FileNotFoundError`` when none exists."""
+    explicit snapshot path. Raises ``FileNotFoundError`` when none exists.
+
+    With ``fallback=True`` (directory form only) a corrupt/truncated payload
+    does not end recovery: the generation ring is walked newest-first past
+    every :class:`SnapshotCorruptError` to the newest VALID generation —
+    ``meta["generations_skipped"]`` counts what was skipped and
+    ``meta["snapshot_path"]`` names what actually loaded. Raises the last
+    corruption error when every generation is rotten. ``verify=False`` skips
+    the checksum (deserialization errors still surface typed)."""
     path = directory_or_path
+    skipped = 0
     if os.path.isdir(path) and not os.path.basename(path).startswith("snap_"):
         latest = latest_snapshot(path)
-        if latest is None:
+        ring = generations(path)
+        if latest is None and not (fallback and ring):
             raise FileNotFoundError(f"no complete snapshot under {path}")
-        path = latest
-    if _ORBAX_AVAILABLE and os.path.isdir(path):
-        import orbax.checkpoint as ocp
-
-        with ocp.PyTreeCheckpointer() as ckptr:
-            payload = ckptr.restore(os.path.abspath(path))
+        candidates = [latest] if latest is not None else []
+        if fallback:
+            candidates += [p for p in ring if p != latest]
+        payload, path = None, None
+        last_err: Optional[SnapshotCorruptError] = None
+        for cand in candidates:
+            try:
+                payload = _load_verified(cand, verify=verify)
+                path = cand
+                break
+            except SnapshotCorruptError as e:
+                if not fallback:
+                    raise
+                skipped += 1
+                last_err = e
+        if payload is None:
+            assert last_err is not None
+            raise last_err
     else:
-        with open(path, "rb") as f:
-            payload = pickle.load(f)
+        payload = _load_verified(path, verify=verify)
     meta = {
         k: (int(v) if isinstance(v, np.ndarray) and v.dtype.kind in "iu" else v)
         for k, v in payload["meta"].items()
     }
     if "host_attrs" in payload:
         meta["host_attrs"] = _host_attrs_from_bytes(payload["host_attrs"])
+    meta["snapshot_path"] = path
+    meta["generations_skipped"] = skipped
     return _to_jax_tree(payload["state"]), meta

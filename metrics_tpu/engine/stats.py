@@ -57,6 +57,47 @@ class EngineStats:
         self.merge_us_total = 0.0
         self.wall_us_total = 0.0
         self.sync_us_total = 0.0
+        # fault-tolerance accounting (ISSUE 6): injected faults by site, and
+        # every recovery action the engine took — retries with backoff,
+        # pre-step rollbacks, pallas→xla kernel demotions, coalesce
+        # degradations/shrinks, watchdog expiries, quarantined (dead-
+        # lettered) batches, snapshot write failures and restore fallbacks.
+        # All lifetime counters; rendered by tools/engine_report.py.
+        self.faults_injected: Dict[str, int] = {}
+        self.retries = 0
+        self.rollbacks = 0
+        self.kernel_demotions = 0
+        self.coalesce_degraded = 0
+        self.coalesce_shrinks = 0
+        self.watchdog_timeouts = 0
+        self.quarantined_batches = 0
+        self.quarantined_rows = 0
+        self.snapshot_failures = 0
+        self.snapshot_fallbacks = 0
+
+    def record_fault(self, site: str) -> None:
+        """One injected fault fired at ``site`` (chaos harness accounting)."""
+        self.faults_injected[site] = self.faults_injected.get(site, 0) + 1
+
+    def fault_summary(self) -> Optional[Dict[str, Any]]:
+        """The fault/recovery block for :meth:`summary` — None when this
+        engine saw no fault activity at all (the common case keeps its
+        telemetry document unchanged)."""
+        counters = {
+            "retries": self.retries,
+            "rollbacks": self.rollbacks,
+            "kernel_demotions": self.kernel_demotions,
+            "coalesce_degraded": self.coalesce_degraded,
+            "coalesce_shrinks": self.coalesce_shrinks,
+            "watchdog_timeouts": self.watchdog_timeouts,
+            "quarantined_batches": self.quarantined_batches,
+            "quarantined_rows": self.quarantined_rows,
+            "snapshot_failures": self.snapshot_failures,
+            "snapshot_fallbacks": self.snapshot_fallbacks,
+        }
+        if not self.faults_injected and not any(counters.values()):
+            return None
+        return {"injected": dict(self.faults_injected), **counters}
 
     def record_merge(self, merge_us: float) -> None:
         """One deferred-sync boundary merge (result()/snapshot/restore): the
@@ -151,6 +192,9 @@ class EngineStats:
         shares = self._host_time_shares(recent, self.mesh_sync)
         if shares is not None:
             out["host_time_shares"] = shares
+        faults = self.fault_summary()
+        if faults is not None:
+            out["faults"] = faults
         if self.mesh_sync is not None:
             out["mesh_sync"] = self._mesh_sync_summary()
         if aot_stats is not None:

@@ -18,6 +18,7 @@ from metrics_tpu.ops.kernels.dispatch import (
     current_backend,
     fold_rows_masked,
     histogram_accumulate,
+    kernel_fault_scope,
     resolve_backend,
     segment_reduce_masked,
     set_default_backend,
@@ -32,6 +33,7 @@ __all__ = [
     "current_backend",
     "fold_rows_masked",
     "histogram_accumulate",
+    "kernel_fault_scope",
     "reduce_identity",
     "resolve_backend",
     "segment_reduce_masked",

@@ -122,6 +122,34 @@ def current_backend() -> str:
     return _default_backend
 
 
+def _maybe_kernel_fault(kernel: str) -> None:
+    """Trace-time kernel fault hook (chaos harness): called inside each
+    primitive's kernel ``try`` block, so a raised fault follows the
+    dispatcher's EXISTING failure policy — silent fallback to the XLA
+    reference path under ``pallas``, a loud raise under
+    ``pallas_interpret`` (parity tests must never silently test the wrong
+    lowering). No hook installed (the default) costs one thread-local read."""
+    hook = getattr(_tls, "fault_hook", None)
+    if hook is not None:
+        hook(kernel)
+
+
+@contextlib.contextmanager
+def kernel_fault_scope(hook: Optional[callable]):
+    """Install a thread-local trace-time kernel fault hook: ``hook(kernel_
+    name)`` runs before every Pallas kernel call traced in this scope and
+    may raise to simulate a kernel failure (``engine/faults.py`` chaos
+    plans use this to prove the per-call degradation path — distinct from
+    the engine-level ``kernel`` site, which exercises the pallas→xla
+    DEMOTION of a whole engine)."""
+    prev = getattr(_tls, "fault_hook", None)
+    _tls.fault_hook = hook
+    try:
+        yield
+    finally:
+        _tls.fault_hook = prev
+
+
 @contextlib.contextmanager
 def use_backend(name: Optional[str]):
     """Scoped backend override (thread-local). ``None`` is a no-op passthrough
@@ -191,6 +219,7 @@ def fold_rows_masked(
     mask_i32 = jnp.reshape(jnp.asarray(mask, bool).astype(jnp.int32), (n, 1))
     state2d = jnp.reshape(state, (1, f))
     try:
+        _maybe_kernel_fault("fold_rows")
         out = fold_rows_pallas(state2d, rows2d, mask_i32, fx, blk, interpret)
     except Exception:
         if interpret:  # parity tests must see kernel failures, not a fallback
@@ -233,6 +262,7 @@ def segment_reduce_masked(
     mask_i32 = jnp.reshape(jnp.asarray(mask, bool).astype(jnp.int32), (n, 1))
     state2d = jnp.reshape(state, (num_segments, f))
     try:
+        _maybe_kernel_fault("segment_reduce")
         out = segment_reduce_pallas(
             state2d, rows2d, ids_i32, mask_i32, fx, num_segments, blk, interpret
         )
@@ -290,6 +320,7 @@ def histogram_accumulate(
     # the (blk, L) one-hot block dominates the kernel's VMEM working set
     blk = block_rows(max(length, cols.shape[1]) * 4)
     try:
+        _maybe_kernel_fault("histogram")
         out = histogram_pallas(idx_i32, cols, length, blk, interpret)
     except Exception:
         if interpret:

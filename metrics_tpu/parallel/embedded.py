@@ -306,3 +306,26 @@ def sharded_state_merge(
     return jax.shard_map(
         body, mesh=mesh, in_specs=(state_specs,), out_specs=P(), check_vma=False
     )
+
+
+def boundary_merge_error(axis: AxisName, world: int, cause: BaseException) -> Exception:
+    """Build the typed error for a failed deferred boundary merge, carrying
+    the mesh topology an operator needs (axis, world size) — the engine
+    chains ``cause`` onto it (``raise ... from cause``).
+
+    The merge is a non-donated READ of the shard-local carried state, so any
+    failure — injected, runtime, or collective — leaves the accumulation
+    fully intact: the caller's next ``result()``/``state()`` serves the last
+    consistent value. User errors pass through untouched (they are input
+    properties, not merge failures).
+    """
+    from metrics_tpu.engine.faults import BoundaryMergeError
+    from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+    if isinstance(cause, (BoundaryMergeError, MetricsTPUUserError)):
+        return cause
+    return BoundaryMergeError(
+        f"deferred boundary merge failed over mesh axis {axis!r} (world={world}): "
+        f"{type(cause).__name__}: {cause}; the shard-local carried state is intact — "
+        "result()/state() keep serving the last consistent value"
+    )

@@ -17,7 +17,16 @@ import jax.numpy as jnp
 from jax.sharding import Mesh
 
 from metrics_tpu import AUROC, Accuracy, AveragePrecision, MeanSquaredError, MetricCollection
-from metrics_tpu.engine import EngineConfig, MultiStreamEngine, StreamingEngine
+from metrics_tpu.engine import (
+    BoundaryMergeError,
+    EngineConfig,
+    FaultInjector,
+    FaultSpec,
+    MultiStreamEngine,
+    StreamingEngine,
+)
+from metrics_tpu.engine.faults import corrupt_snapshot
+from metrics_tpu.engine.snapshot import latest_snapshot
 from metrics_tpu.parallel.collectives import HLO_COLLECTIVE_RE as _COLLECTIVE_RE
 from metrics_tpu.utils.exceptions import MetricsTPUUserError
 
@@ -240,6 +249,92 @@ def test_deferred_multistream_reset_stream_hits_every_shard(mesh):
         want1 = {k: float(v) for k, v in ref.compute().items()}
         for k in want1:
             assert abs(got1[k] - want1[k]) < 1e-6, k
+
+
+def test_deferred_merge_failure_serves_last_consistent_state(mesh):
+    """Recovery under the injector on mesh (ISSUE 6): a boundary-merge
+    failure is a non-donated READ failure — the shard-local carried state is
+    untouched, so the next ``result()`` serves the last consistent value
+    exactly; with retry budget, the first ``result()`` already recovers."""
+    batches = _batches(seed=11, sizes=(24, 40, 16))
+    single = StreamingEngine(_collection(), EngineConfig(buckets=(16, 64)))
+    with single:
+        for b in batches:
+            single.submit(*b)
+        want = {k: np.asarray(v) for k, v in single.result().items()}
+
+    # retries exhausted: typed error, then the NEXT read serves exactly
+    inj = FaultInjector(seed=30, plan={"merge": FaultSpec(schedule=(0,))})
+    engine = StreamingEngine(_collection(), _cfg(mesh, fault_injector=inj, max_retries=0))
+    with engine:
+        for b in batches:
+            engine.submit(*b)
+        with pytest.raises(BoundaryMergeError, match="carried state is intact"):
+            engine.result()
+        got = {k: np.asarray(v) for k, v in engine.result().items()}
+    for k in want:
+        np.testing.assert_allclose(got[k], want[k], rtol=1e-6, err_msg=k)
+
+    # with a retry budget the first result() already recovers (one retry)
+    inj2 = FaultInjector(seed=31, plan={"merge": FaultSpec(schedule=(0,))})
+    engine2 = StreamingEngine(_collection(), _cfg(mesh, fault_injector=inj2))
+    with engine2:
+        for b in batches:
+            engine2.submit(*b)
+        got2 = {k: np.asarray(v) for k, v in engine2.result().items()}
+    assert engine2.stats.retries == 1
+    for k in want:
+        np.testing.assert_allclose(got2[k], want[k], rtol=1e-6, err_msg=k)
+
+
+def test_deferred_mid_snapshot_kill_restores_last_consistent_state(mesh, tmp_path):
+    """Mid-snapshot failure modes on mesh: a cadence save that DIES is
+    contained (serving and later saves continue), and a save whose payload
+    ROTS after landing is skipped by the restore fallback — either way the
+    resumed engine replays to the uninterrupted result, shard provenance
+    intact (cat-capacity buffers live on specific shards)."""
+    batches = _batches(seed=12, sizes=(24, 9, 48, 17, 16, 40))
+    snapdir = str(tmp_path)
+
+    ref = StreamingEngine(_curves(), _cfg(mesh))
+    with ref:
+        for b in batches:
+            ref.submit(*b)
+        want = {k: np.asarray(v) for k, v in ref.result().items()}
+
+    # save@2 lands, save@4 dies mid-write (contained), save@6 lands and
+    # then its payload rots on disk — fallback must land on the @2 cursor
+    inj = FaultInjector(seed=32, plan={"snapshot_write": FaultSpec(schedule=(1,))})
+    eng = StreamingEngine(
+        _curves(),
+        _cfg(mesh, coalesce=1, snapshot_every=2, snapshot_dir=snapdir,
+             snapshot_keep=3, fault_injector=inj),
+    )
+    with eng:
+        for b in batches[:5]:
+            eng.submit(*b)
+        eng.flush()
+        # serving survived the failed save: result() is still consistent
+        mid = {k: np.asarray(v) for k, v in eng.result().items()}
+        assert all(np.isfinite(np.asarray(v)).all() for v in mid.values())
+        eng.submit(*batches[5])
+        eng.flush()
+    assert eng.stats.snapshot_failures == 1
+    del eng
+    corrupt_snapshot(latest_snapshot(snapdir), np.random.RandomState(5))
+
+    resumed = StreamingEngine(_curves(), _cfg(mesh, snapshot_dir=snapdir))
+    meta = resumed.restore()
+    assert meta["generations_skipped"] == 1  # past the rotted @6 generation
+    assert int(meta["batches_done"]) == 2  # the @4 write died; @2 is next
+    assert resumed.stats.snapshot_fallbacks == 1
+    cursor = int(meta["batches_done"])
+    with resumed:
+        for b in batches[cursor:]:
+            resumed.submit(*b)
+        got = {k: np.asarray(v) for k, v in resumed.result().items()}
+    for k in want:
+        np.testing.assert_allclose(got[k], want[k], rtol=1e-7, err_msg=k)
 
 
 def test_deferred_cpu_mesh_keeps_async_dispatch(mesh):

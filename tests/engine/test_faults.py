@@ -1,0 +1,623 @@
+"""Fault layer, 1-device tier-1 path (ISSUE 6): deterministic injection,
+transactional step rollback, quarantine, retry/degradation, and the sticky
+error context.
+
+The acceptance contract: a seeded fault never changes the engine's final
+``result()`` (bit-identical to a fault-free run on the same traffic — dyadic
+data, so parity holds across any grouping or lowering), a poisoned batch
+never reaches a compiled step when screened, and every sticky failure names
+the batch that caused it. The full multi-site sweep is ``make chaos-smoke``
+(``metrics_tpu/engine/chaos_smoke.py``); these tests pin each mechanism in
+isolation.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from metrics_tpu import Accuracy, MeanSquaredError, MetricCollection
+from metrics_tpu.engine import (
+    BackpressureTimeout,
+    BoundaryMergeError,
+    EngineConfig,
+    EngineDispatchError,
+    FaultInjector,
+    FaultSpec,
+    ScreenPolicy,
+    StreamingEngine,
+)
+from metrics_tpu.engine.multistream import MultiStreamEngine
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+BUCKETS = (8, 32)
+
+
+def _dyadic(rng, n):
+    return (rng.randint(0, 65, size=n) / 64.0).astype(np.float32)
+
+
+def _batches(seed=0, sizes=(5, 17, 8, 32, 3)):
+    rng = np.random.RandomState(seed)
+    return [(_dyadic(rng, n), (rng.rand(n) > 0.5).astype(np.int32)) for n in sizes]
+
+
+def _collection():
+    return MetricCollection([Accuracy(), MeanSquaredError()])
+
+
+def _want(batches):
+    eager = _collection()
+    for b in batches:
+        eager.update(*b)
+    return {k: np.asarray(v) for k, v in eager.compute().items()}
+
+
+def _run(engine, batches):
+    with engine:
+        for b in batches:
+            engine.submit(*b)
+        return {k: np.asarray(v) for k, v in engine.result().items()}
+
+
+def _assert_parity(got, want):
+    for k in want:
+        assert np.array_equal(got[k], want[k]), (k, got[k], want[k])
+
+
+POISON = (np.asarray([np.nan, 0.25], np.float32), np.asarray([1, 0], np.int32))
+
+
+# ------------------------------------------------------------------- injector
+
+
+def test_injector_fire_pattern_is_seed_deterministic():
+    def pattern(seed):
+        inj = FaultInjector(seed, plan={"step": FaultSpec(rate=0.3), "ingest": FaultSpec(schedule=(2, 5))})
+        return [(inj.fire("step"), inj.fire("ingest")) for _ in range(32)]
+
+    assert pattern(7) == pattern(7)
+    assert pattern(7) != pattern(8)
+    # schedules are exact: occurrences 2 and 5 fire, nothing else
+    inj = FaultInjector(0, plan={"ingest": FaultSpec(schedule=(2, 5))})
+    fires = [inj.fire("ingest") for _ in range(8)]
+    assert [i for i, f in enumerate(fires) if f] == [2, 5]
+
+
+def test_injector_sites_are_independent_streams():
+    """Adding calls at one site must not shift another site's pattern."""
+    a = FaultInjector(3, plan={"step": FaultSpec(rate=0.5), "merge": FaultSpec(rate=0.5)})
+    b = FaultInjector(3, plan={"step": FaultSpec(rate=0.5), "merge": FaultSpec(rate=0.5)})
+    for _ in range(10):
+        b.fire("merge")  # extra traffic on one site only
+    assert [a.fire("step") for _ in range(16)] == [b.fire("step") for _ in range(16)]
+
+
+def test_injector_rejects_unknown_site():
+    with pytest.raises(ValueError, match="unknown fault site"):
+        FaultInjector(0, plan={"nope": FaultSpec(rate=1.0)})
+
+
+def test_config_validation():
+    with pytest.raises(MetricsTPUUserError, match="max_retries"):
+        StreamingEngine(Accuracy(), EngineConfig(buckets=(8,), max_retries=-1))
+    with pytest.raises(MetricsTPUUserError, match="ScreenPolicy"):
+        StreamingEngine(Accuracy(), EngineConfig(buckets=(8,), screen="nan"))
+    with pytest.raises(ValueError, match="non_finite"):
+        ScreenPolicy(non_finite="explode")
+
+
+# ------------------------------------------------------------------ screening
+
+
+def test_nonfinite_quarantine_excludes_batch_and_ledger_is_exact():
+    batches = _batches()
+    want = _want(batches)
+    engine = StreamingEngine(
+        _collection(),
+        EngineConfig(buckets=BUCKETS, screen=ScreenPolicy(non_finite="quarantine")),
+    )
+    traffic = batches[:2] + [POISON] + batches[2:]
+    got = _run(engine, traffic)
+    _assert_parity(got, want)
+    q = engine.quarantine()
+    assert len(q) == 1 and q[0].cursor == 2 and q[0].rows == 2
+    assert "non-finite" in q[0].reason
+    assert engine.stats.quarantined_batches == 1
+    assert engine.stats.quarantined_rows == 2
+    # the cursor still advanced past the quarantined batch (replay-exact)
+    assert engine._batches_done == len(traffic)
+
+
+def test_screen_error_action_is_sticky_with_cursor_context():
+    engine = StreamingEngine(
+        Accuracy(), EngineConfig(buckets=(8,), screen=ScreenPolicy(non_finite="error"))
+    )
+    engine.start()
+    engine.submit(*POISON)
+    with pytest.raises(EngineDispatchError, match="dispatcher failed") as ei:
+        engine.flush()
+    assert "screen policy" in str(ei.value)
+    assert "cursor=0" in str(ei.value)
+    assert ei.value.cursor == 0
+    engine.reset()
+    engine.stop()
+
+
+def test_screen_warn_action_accepts_batch():
+    engine = StreamingEngine(
+        MeanSquaredError(), EngineConfig(buckets=(8,), screen=ScreenPolicy(non_finite="warn"))
+    )
+    with engine:
+        with pytest.warns(UserWarning, match="non-finite"):
+            engine.submit(np.asarray([np.nan], np.float32), np.asarray([0.0], np.float32))
+            engine.flush()
+        assert engine.stats.quarantined_batches == 0
+        assert np.isnan(float(engine.result()))  # accepted means accepted
+
+
+def test_id_range_screening():
+    engine = StreamingEngine(
+        Accuracy(),
+        EngineConfig(
+            buckets=(8,),
+            screen=ScreenPolicy(non_finite="ignore", id_range=(0, 1)),
+        ),
+    )
+    good = (np.asarray([0.9, 0.2], np.float32), np.asarray([1, 0], np.int32))
+    bad = (np.asarray([0.9, 0.2], np.float32), np.asarray([7, 0], np.int32))
+    with engine:
+        engine.submit(*good)
+        engine.submit(*bad)
+        assert float(engine.result()) == 1.0
+    q = engine.quarantine()
+    assert len(q) == 1 and "out of range" in q[0].reason and q[0].cursor == 1
+
+
+def test_quarantine_ledger_capacity_keeps_newest():
+    engine = StreamingEngine(
+        MeanSquaredError(),
+        EngineConfig(
+            buckets=(8,),
+            screen=ScreenPolicy(non_finite="quarantine"),
+            quarantine_capacity=2,
+        ),
+    )
+    bad = (np.asarray([np.inf], np.float32), np.asarray([0.0], np.float32))
+    with engine:
+        for _ in range(4):
+            engine.submit(*bad)
+        engine.flush()
+    assert engine.stats.quarantined_batches == 4  # lifetime count is exact
+    ledger = engine.quarantine()
+    assert len(ledger) == 2  # bounded ledger keeps the newest records
+    assert [r.cursor for r in ledger] == [2, 3]
+    engine.clear_quarantine()
+    assert engine.quarantine() == []
+
+
+# ----------------------------------------------------- transactional rollback
+
+
+def test_step_fault_rolls_back_and_retries_to_parity():
+    batches = _batches(seed=1)
+    want = _want(batches)
+    inj = FaultInjector(seed=5, plan={"step": FaultSpec(schedule=(1, 3))})
+    engine = StreamingEngine(
+        _collection(), EngineConfig(buckets=BUCKETS, coalesce=1, fault_injector=inj)
+    )
+    got = _run(engine, batches)
+    _assert_parity(got, want)
+    assert inj.fired == {"step": 2}
+    assert engine.stats.rollbacks == 2
+    assert engine.stats.retries == 2
+    # the arena was never torn: carried buffers still match the layout
+    assert engine.arena_layout.matches(engine._state)
+
+
+def test_retry_exhaustion_goes_sticky_with_bucket_context_then_reset_recovers():
+    inj = FaultInjector(seed=6, plan={"step": FaultSpec(schedule=(0, 1))})
+    engine = StreamingEngine(
+        Accuracy(),
+        EngineConfig(buckets=(8,), coalesce=1, fault_injector=inj, max_retries=1),
+    )
+    engine.start()
+    engine.submit(np.asarray([0.9, 0.2], np.float32), np.asarray([1, 0], np.int32))
+    with pytest.raises(EngineDispatchError, match="dispatcher failed") as ei:
+        engine.flush()
+    assert "bucket=8" in str(ei.value) and "cursor=0" in str(ei.value)
+    assert isinstance(ei.value.__cause__, Exception)  # original is chained
+    engine.reset()
+    engine.submit(np.asarray([0.9, 0.2], np.float32), np.asarray([1, 0], np.int32))
+    assert float(engine.result()) == 1.0
+    engine.stop()
+
+
+def test_ingest_fault_retries_whole_group():
+    batches = _batches(seed=2, sizes=(6, 9))
+    inj = FaultInjector(seed=7, plan={"ingest": FaultSpec(schedule=(0,))})
+    engine = StreamingEngine(
+        _collection(), EngineConfig(buckets=BUCKETS, coalesce=1, fault_injector=inj)
+    )
+    got = _run(engine, batches)
+    _assert_parity(got, _want(batches))
+    assert engine.stats.retries == 1
+
+
+def test_watchdog_expiry_rolls_back_and_retries():
+    inj = FaultInjector(seed=8, plan={"watchdog": FaultSpec(schedule=(0,))})
+    engine = StreamingEngine(
+        Accuracy(), EngineConfig(buckets=(8,), coalesce=1, fault_injector=inj)
+    )
+    p, t = np.asarray([0.9, 0.2], np.float32), np.asarray([1, 0], np.int32)
+    with engine:
+        for _ in range(2):
+            engine.submit(p, t)
+        assert float(engine.result()) == 1.0
+    assert engine.stats.watchdog_timeouts == 1
+    assert engine.stats.rollbacks == 1
+
+
+def test_real_watchdog_passes_fast_steps():
+    engine = StreamingEngine(Accuracy(), EngineConfig(buckets=(8,), step_timeout_s=30.0))
+    p, t = np.asarray([0.9, 0.2], np.float32), np.asarray([1, 0], np.int32)
+    with engine:
+        engine.submit(p, t)
+        assert float(engine.result()) == 1.0
+    assert engine.stats.watchdog_timeouts == 0
+
+
+# -------------------------------------------------------- graceful degradation
+
+
+def test_kernel_fault_demotes_pallas_to_xla_with_parity():
+    batches = _batches(seed=3)
+    want = _want(batches)
+    inj = FaultInjector(seed=9, plan={"kernel": FaultSpec(schedule=(0,))})
+    engine = StreamingEngine(
+        _collection(),
+        EngineConfig(
+            buckets=BUCKETS, coalesce=1,
+            kernel_backend="pallas_interpret", fault_injector=inj,
+        ),
+    )
+    got = _run(engine, batches)
+    _assert_parity(got, want)
+    assert engine.stats.kernel_demotions == 1
+    assert engine._kernel_backend == "xla"  # one-way demotion for the engine
+    assert inj.fired == {"kernel": 1}  # xla engines never consult the site again
+
+
+def test_coalesce_fault_degrades_to_singletons_never_raises():
+    batches = _batches(seed=4, sizes=(4, 4, 4))
+    inj = FaultInjector(seed=10, plan={"coalesce": FaultSpec(rate=1.0)})
+    engine = StreamingEngine(
+        _collection(), EngineConfig(buckets=BUCKETS, coalesce=8, fault_injector=inj)
+    )
+    got = _run(engine, batches)
+    _assert_parity(got, _want(batches))
+    assert engine.stats.coalesce_degraded >= 1
+    assert engine.stats.megasteps == 0  # nothing coalesced while degraded
+
+
+def test_megabatch_failure_shrinks_to_singletons():
+    """A non-transient failure on an uncommitted megabatch re-dispatches the
+    members one at a time — good traffic lands, nothing folds twice."""
+    batches = _batches(seed=5, sizes=(2, 2, 2))
+    inj = FaultInjector(seed=11, plan={"step": FaultSpec(schedule=(0,), transient=False)})
+    engine = StreamingEngine(
+        _collection(),
+        EngineConfig(
+            buckets=(8,), coalesce=8, coalesce_window_ms=300.0, fault_injector=inj
+        ),
+    )
+    engine.start()
+    for b in batches:
+        engine.submit(*b)
+    got = {k: np.asarray(v) for k, v in engine.result().items()}
+    engine.stop()
+    _assert_parity(got, _want(batches))
+    if engine.stats.coalesce_shrinks:  # the group actually formed (timing)
+        assert engine.stats.coalesce_shrinks == 1
+
+
+def test_trace_time_kernel_fault_falls_back_silently():
+    from metrics_tpu.ops.kernels import fold_rows_masked, kernel_fault_scope, use_backend
+
+    import jax.numpy as jnp
+
+    calls = []
+
+    def hook(kernel):
+        calls.append(kernel)
+        raise RuntimeError("injected trace-time kernel failure")
+
+    rng = np.random.RandomState(0)
+    state = jnp.zeros((4,), jnp.float32)
+    rows = jnp.asarray(rng.randint(0, 65, size=(6, 4)) / 64.0, jnp.float32)
+    mask = jnp.asarray([True] * 5 + [False])
+    want = np.asarray(fold_rows_masked(state, rows, mask, "sum", backend="xla"))
+    with kernel_fault_scope(hook), use_backend("pallas"):
+        got = np.asarray(fold_rows_masked(state, rows, mask, "sum"))
+    assert calls == ["fold_rows"]
+    np.testing.assert_array_equal(got, want)
+    # interpret mode must RAISE instead (parity tests never silently degrade)
+    with kernel_fault_scope(hook), use_backend("pallas_interpret"):
+        with pytest.raises(RuntimeError, match="injected trace-time"):
+            fold_rows_masked(state, rows, mask, "sum")
+
+
+# ---------------------------------------------------- merge (1-device mesh)
+
+
+def test_merge_fault_retries_then_typed_error_then_serves():
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("dp",))
+    p, t = np.asarray([0.9, 0.2], np.float32), np.asarray([1, 0], np.int32)
+
+    inj = FaultInjector(seed=12, plan={"merge": FaultSpec(schedule=(0,))})
+    engine = StreamingEngine(
+        Accuracy(),
+        EngineConfig(buckets=(8,), mesh=mesh, axis="dp", mesh_sync="deferred", fault_injector=inj),
+    )
+    with engine:
+        engine.submit(p, t)
+        assert float(engine.result()) == 1.0  # transient merge fault retried
+    assert engine.stats.retries == 1
+
+    inj2 = FaultInjector(seed=13, plan={"merge": FaultSpec(schedule=(0,))})
+    engine2 = StreamingEngine(
+        Accuracy(),
+        EngineConfig(
+            buckets=(8,), mesh=mesh, axis="dp", mesh_sync="deferred",
+            fault_injector=inj2, max_retries=0,
+        ),
+    )
+    with engine2:
+        engine2.submit(p, t)
+        with pytest.raises(BoundaryMergeError, match="carried state is intact|last consistent"):
+            engine2.result()
+        # the merge is a non-donated read: the NEXT result() serves exactly
+        assert float(engine2.result()) == 1.0
+
+
+# ------------------------------------------------- dead dispatcher / timeouts
+
+
+def test_submit_timeout_surfaces_sticky_error_from_dead_dispatcher():
+    inj = FaultInjector(
+        seed=14, plan={"dispatcher_kill": FaultSpec(schedule=(0,), transient=False, fatal=True)}
+    )
+    engine = StreamingEngine(
+        Accuracy(), EngineConfig(buckets=(8,), max_queue=2, fault_injector=inj)
+    )
+    p, t = np.asarray([0.9, 0.2], np.float32), np.asarray([1, 0], np.int32)
+    engine.start()
+    engine.submit(p, t)  # kills the dispatcher thread outright
+    deadline = time.monotonic() + 10.0
+    with pytest.raises(EngineDispatchError, match="dispatcher_kill"):
+        while time.monotonic() < deadline:
+            try:
+                engine.submit(p, t, timeout=0.2)
+            except BackpressureTimeout:
+                continue  # the kill has not landed yet
+    # recovery: reset drains the DEAD queue (no join deadlock) and re-arms
+    engine.reset()
+    engine.submit(p, t)
+    assert float(engine.result()) == 1.0
+    engine.stop()
+
+
+def test_stop_then_reset_on_killed_engine_does_not_deadlock():
+    """Regression (review): after stop() on a fatally-killed engine the
+    worker slot is None but the backlog (and possibly a stale _STOP) is
+    still queued — reset() must drain it, not block on queue.join()."""
+    inj = FaultInjector(
+        seed=16, plan={"dispatcher_kill": FaultSpec(schedule=(0,), transient=False, fatal=True)}
+    )
+    engine = StreamingEngine(
+        Accuracy(), EngineConfig(buckets=(8,), max_queue=4, fault_injector=inj)
+    )
+    p, t = np.asarray([0.9, 0.2], np.float32), np.asarray([1, 0], np.int32)
+    engine.start()
+    engine.submit(p, t)  # kills the dispatcher
+    deadline = time.monotonic() + 10.0
+    while engine._worker.is_alive() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    # backlog lands while nobody is draining
+    for _ in range(2):
+        try:
+            engine.submit(p, t, timeout=0.2)
+        except (EngineDispatchError, BackpressureTimeout):
+            break
+    engine.stop()  # worker slot cleared; backlog remains
+
+    done = threading.Event()
+
+    def recover():
+        engine.reset()
+        done.set()
+
+    threading.Thread(target=recover, daemon=True).start()
+    assert done.wait(10.0), "reset() deadlocked on the dead engine's backlog"
+    engine.submit(p, t)
+    assert float(engine.result()) == 1.0
+    engine.stop()
+
+
+def test_watchdog_arming_auto_enables_transactional():
+    """Regression (review): the watchdog's whole contract is rollback-and-
+    retry — arming it must turn the shadow on even where donation would
+    otherwise leave nothing to roll back onto."""
+    armed = StreamingEngine(Accuracy(), EngineConfig(buckets=(8,), step_timeout_s=5.0))
+    assert armed._transactional is True
+    explicit = StreamingEngine(
+        Accuracy(), EngineConfig(buckets=(8,), step_timeout_s=5.0, transactional=False)
+    )
+    assert explicit._transactional is False  # an explicit choice still wins
+
+
+def test_flush_on_mid_flush_dispatcher_death_raises_instead_of_hanging():
+    """Regression (review): flush() blocked in queue.join() while the
+    dispatcher died fatally would hang forever — the liveness-polling join
+    must drain the orphaned backlog and surface the sticky error."""
+    inj = FaultInjector(
+        seed=18, plan={"dispatcher_kill": FaultSpec(schedule=(0,), transient=False, fatal=True)}
+    )
+    engine = StreamingEngine(
+        Accuracy(), EngineConfig(buckets=(8,), coalesce=1, max_queue=8, fault_injector=inj)
+    )
+    p, t = np.asarray([0.9, 0.2], np.float32), np.asarray([1, 0], np.int32)
+    engine.start()
+    for _ in range(3):
+        engine.submit(p, t)
+    done = threading.Event()
+    box = {}
+
+    def call_flush():
+        try:
+            engine.flush()
+        except BaseException as e:  # noqa: BLE001
+            box["err"] = e
+        done.set()
+
+    threading.Thread(target=call_flush, daemon=True).start()
+    assert done.wait(10.0), "flush() hung on the dead dispatcher's backlog"
+    assert isinstance(box.get("err"), EngineDispatchError)
+    engine.stop()
+
+
+def test_fatal_death_with_pending_lookahead_keeps_queue_consistent():
+    """Regression (review): the coalescer may have DEQUEUED an incompatible
+    look-ahead item when a fatal fault fires — its task count must not leak,
+    or every join after a successful reset() hangs."""
+    inj = FaultInjector(
+        seed=19, plan={"dispatcher_kill": FaultSpec(schedule=(0,), transient=False, fatal=True)}
+    )
+    engine = StreamingEngine(
+        Accuracy(),
+        EngineConfig(
+            buckets=(8,), coalesce=4, coalesce_window_ms=500.0,
+            max_queue=8, fault_injector=inj,
+        ),
+    )
+    engine.start()
+    # A then an incompatible B (extra-dim preds): B becomes the dequeued
+    # look-ahead 'pending' while A's group hits the fatal fault
+    engine.submit(np.asarray([0.9, 0.2], np.float32), np.asarray([1, 0], np.int32))
+    engine.submit(np.zeros((2, 3), np.float32), np.asarray([1, 0], np.int32))
+    deadline = time.monotonic() + 10.0
+    while engine._worker.is_alive() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert not engine._worker.is_alive()
+    engine.reset()  # must drain AND repair the unfinished count
+    engine.submit(np.asarray([0.9, 0.2], np.float32), np.asarray([1, 0], np.int32))
+    done = threading.Event()
+
+    def read():
+        box = float(engine.result())
+        assert box == 1.0
+        done.set()
+
+    threading.Thread(target=read, daemon=True).start()
+    assert done.wait(10.0), "post-reset flush hung on a leaked task count"
+    engine.stop()
+
+
+def test_shrink_requires_transactional_shadow():
+    """Regression (review): without the shadow a donating step may have
+    consumed the carried buffers — the shrink re-dispatch must not run."""
+    batches = _batches(seed=6, sizes=(2, 2))
+    inj = FaultInjector(seed=23, plan={"step": FaultSpec(schedule=(0,), transient=False)})
+    engine = StreamingEngine(
+        _collection(),
+        EngineConfig(
+            buckets=(8,), coalesce=8, coalesce_window_ms=300.0,
+            fault_injector=inj, transactional=False,
+        ),
+    )
+    engine.start()
+    for b in batches:
+        engine.submit(*b)
+    with pytest.raises(EngineDispatchError, match="dispatcher failed"):
+        engine.flush()
+    assert engine.stats.coalesce_shrinks == 0  # no shadow, no re-dispatch
+    engine.reset()
+    engine.stop()
+
+
+def test_submit_timeout_without_error_is_backpressure():
+    engine = StreamingEngine(Accuracy(), EngineConfig(buckets=(8,), max_queue=1))
+    engine.start = lambda: engine  # dispatcher never runs: pure backpressure
+    p, t = np.asarray([0.9], np.float32), np.asarray([1], np.int32)
+    engine.submit(p, t, timeout=0.2)  # fills the queue
+    with pytest.raises(BackpressureTimeout, match="timed out"):
+        engine.submit(p, t, timeout=0.3)
+
+
+# ------------------------------------------------------- sticky error context
+
+
+def test_sticky_error_names_cursor_and_bucket_and_chains_cause():
+    """Satellite (ISSUE 6): a malformed batch's sticky error must carry the
+    failing batch's coordinates so operators can find the poisoned input."""
+    bad = (np.asarray([0.5, 0.5], np.float32), np.asarray([1, 0, 1], np.int32))
+    engine = StreamingEngine(Accuracy(), EngineConfig(buckets=(8,)))
+    engine.start()
+    engine.submit(np.asarray([0.9, 0.2], np.float32), np.asarray([1, 0], np.int32))
+    engine.flush()
+    engine.submit(*bad)
+    with pytest.raises(EngineDispatchError, match="dispatcher failed") as ei:
+        engine.flush()
+    msg = str(ei.value)
+    assert "cursor=1" in msg and "bucket=8" in msg, msg
+    assert ei.value.cursor == 1 and ei.value.bucket == 8
+    assert ei.value.__cause__ is not None  # the original trace error, chained
+    engine.stop()
+
+
+def test_multistream_sticky_error_names_stream_ids_and_supports_timeout():
+    bad = (np.asarray([0.5, 0.5], np.float32), np.asarray([1, 0, 1], np.int32))
+    engine = MultiStreamEngine(Accuracy(), 4, EngineConfig(buckets=(8,), coalesce=1))
+    engine.start()
+    engine.submit(3, *bad, timeout=5.0)
+    with pytest.raises(EngineDispatchError, match=r"stream_ids=\[3\]"):
+        engine.flush()
+    engine.stop()
+
+
+def test_multistream_quarantine_records_stream_id():
+    engine = MultiStreamEngine(
+        Accuracy(), 4,
+        EngineConfig(buckets=(8,), coalesce=1, screen=ScreenPolicy(non_finite="quarantine")),
+    )
+    with engine:
+        engine.submit(1, np.asarray([0.9, 0.2], np.float32), np.asarray([1, 0], np.int32))
+        engine.submit(2, *POISON)
+        assert float(engine.result(1)) == 1.0
+    q = engine.quarantine()
+    assert len(q) == 1 and q[0].stream_id == 2 and q[0].cursor == 1
+
+
+# ---------------------------------------------------------------- telemetry
+
+
+def test_fault_counters_render_in_summary_only_when_active():
+    clean = StreamingEngine(Accuracy(), EngineConfig(buckets=(8,)))
+    p, t = np.asarray([0.9, 0.2], np.float32), np.asarray([1, 0], np.int32)
+    with clean:
+        clean.submit(p, t)
+        clean.result()
+    assert "faults" not in clean.telemetry()  # no activity, no block
+
+    inj = FaultInjector(seed=15, plan={"step": FaultSpec(schedule=(0,))})
+    chaos = StreamingEngine(Accuracy(), EngineConfig(buckets=(8,), coalesce=1, fault_injector=inj))
+    with chaos:
+        chaos.submit(p, t)
+        chaos.result()
+    block = chaos.telemetry()["faults"]
+    assert block["injected"] == {"step": 1}
+    assert block["retries"] == 1 and block["rollbacks"] == 1

@@ -1,0 +1,277 @@
+"""Snapshot integrity under corruption (ISSUE 6): typed errors, the
+generation-ring fallback, and containment of snapshot-write failures.
+
+Fuzz contract (satellite): a snapshot payload truncated at a random offset
+or bit-flipped at random positions must surface as a typed
+``SnapshotCorruptError`` naming the path and generation — never a raw
+deserialization traceback — and ``restore()`` must fall back past it to the
+newest valid generation with EXACT replay from the older cursor.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from metrics_tpu import Accuracy, MeanSquaredError, MetricCollection
+from metrics_tpu.engine import (
+    EngineConfig,
+    FaultInjector,
+    FaultSpec,
+    SnapshotCorruptError,
+    StreamingEngine,
+    generations,
+    latest_snapshot,
+    load_snapshot,
+    save_snapshot,
+)
+from metrics_tpu.engine.faults import corrupt_snapshot
+from metrics_tpu.engine.snapshot import _integrity_path
+
+
+def _batches(seed=1, sizes=(10, 20, 9, 31, 16, 8)):
+    rng = np.random.RandomState(seed)
+    return [
+        ((rng.randint(0, 65, size=n) / 64.0).astype(np.float32), (rng.rand(n) > 0.5).astype(np.int32))
+        for n in sizes
+    ]
+
+
+def _collection():
+    return MetricCollection([Accuracy(), MeanSquaredError()])
+
+
+def _payload_files(path):
+    """Every regular file of a snapshot (orbax dir or pickle), largest first."""
+    if os.path.isfile(path):
+        return [path]
+    out = []
+    for root, _, files in os.walk(path):
+        out += [os.path.join(root, f) for f in files]
+    return sorted(out, key=os.path.getsize, reverse=True)
+
+
+def _save_one(d, value=1.0, step=2):
+    state = {"x": np.arange(8, dtype=np.float32) * value, "n": np.asarray(3)}
+    return save_snapshot(d, state, {"step": step, "batches_done": step}, keep=4)
+
+
+# ---------------------------------------------------------------- typed error
+
+
+def test_bitflip_fuzz_raises_typed_error(tmp_path):
+    """Random byte flips at random offsets (10 seeds) in the snapshot's
+    largest payload file: every outcome is the TYPED error, naming the
+    generation — whether the codec rejects the bytes or silently accepts
+    them (the integrity digest catches the latter)."""
+    for seed in range(10):
+        d = str(tmp_path / f"flip{seed}")
+        path = _save_one(d)
+        corrupt_snapshot(path, np.random.RandomState(seed), flips=4)
+        with pytest.raises(SnapshotCorruptError) as ei:
+            load_snapshot(d)
+        assert ei.value.generation == os.path.basename(path)
+        assert ei.value.path == path
+        assert ei.value.generation in str(ei.value)
+
+
+def test_truncation_fuzz_raises_typed_error(tmp_path):
+    for seed in range(10):
+        d = str(tmp_path / f"trunc{seed}")
+        path = _save_one(d)
+        target = _payload_files(path)[0]
+        size = os.path.getsize(target)
+        keep = int(np.random.RandomState(seed).randint(0, max(1, size - 1)))
+        with open(target, "r+b") as f:
+            f.truncate(keep)
+        with pytest.raises(SnapshotCorruptError) as ei:
+            load_snapshot(d)
+        assert ei.value.generation == os.path.basename(path)
+
+
+def test_corrupt_integrity_sidecar_is_corrupt_snapshot(tmp_path):
+    d = str(tmp_path)
+    path = _save_one(d)
+    with open(_integrity_path(path), "w") as f:
+        f.write("{not json")
+    with pytest.raises(SnapshotCorruptError, match="integrity"):
+        load_snapshot(d)
+
+
+def test_missing_integrity_sidecar_is_accepted_backcompat(tmp_path):
+    """Snapshots written before the integrity layer have no sidecar — they
+    must keep loading (deserialization errors still surface typed)."""
+    d = str(tmp_path)
+    path = _save_one(d)
+    os.unlink(_integrity_path(path))
+    state, meta = load_snapshot(d)
+    assert meta["step"] == 2
+    np.testing.assert_array_equal(np.asarray(state["n"]), 3)
+
+
+def test_absent_explicit_path_is_file_not_found_not_corrupt(tmp_path):
+    """Regression (review): a snapshot that was never written is NOT a
+    corrupt one — the documented FileNotFoundError contract holds for
+    explicit paths too."""
+    with pytest.raises(FileNotFoundError):
+        load_snapshot(str(tmp_path / "snap_000000000004_deadbeef"))
+
+
+def test_explicit_snapshot_path_never_falls_back(tmp_path):
+    d = str(tmp_path)
+    path = _save_one(d)
+    _save_one(d, value=2.0, step=4)
+    corrupt_snapshot(path, np.random.RandomState(0))
+    with pytest.raises(SnapshotCorruptError):
+        load_snapshot(path, fallback=True)  # explicit path: no ring to walk
+
+
+# ------------------------------------------------------------- fallback ring
+
+
+def test_fallback_walks_past_corrupt_latest_to_previous_generation(tmp_path):
+    d = str(tmp_path)
+    _save_one(d, value=1.0, step=2)
+    newest = _save_one(d, value=2.0, step=4)
+    corrupt_snapshot(newest, np.random.RandomState(3))
+    with pytest.raises(SnapshotCorruptError):
+        load_snapshot(d)  # default: corruption surfaces
+    state, meta = load_snapshot(d, fallback=True)
+    assert meta["step"] == 2 and meta["generations_skipped"] == 1
+    np.testing.assert_array_equal(np.asarray(state["x"]), np.arange(8, dtype=np.float32))
+
+
+def test_fallback_with_every_generation_corrupt_raises_last_error(tmp_path):
+    d = str(tmp_path)
+    for i, step in enumerate((2, 4)):
+        corrupt_snapshot(_save_one(d, step=step), np.random.RandomState(i))
+    with pytest.raises(SnapshotCorruptError):
+        load_snapshot(d, fallback=True)
+
+
+def test_gc_removes_integrity_sidecars_with_their_snapshots(tmp_path):
+    d = str(tmp_path)
+    state = {"x": np.asarray(1.0)}
+    for step in (2, 4, 6, 8):
+        save_snapshot(d, state, {"step": step}, keep=2)
+    snaps = generations(d)
+    assert len(snaps) == 2
+    sidecars = [n for n in os.listdir(d) if n.startswith("integrity_")]
+    assert len(sidecars) == 2  # one per retained generation, none orphaned
+    for p in snaps:
+        assert os.path.exists(_integrity_path(p))
+
+
+# --------------------------------------------------------------- engine-level
+
+
+def test_engine_restores_past_corrupted_latest_with_exact_replay(tmp_path):
+    """The acceptance bar: kill after a corrupted newest snapshot; restore
+    falls back one generation, replay from ITS cursor reproduces the
+    uninterrupted result bit-exactly; the fallback is counted."""
+    batches = _batches()
+    snapdir = str(tmp_path)
+
+    ref = StreamingEngine(_collection(), EngineConfig(buckets=(16, 32)))
+    with ref:
+        for b in batches:
+            ref.submit(*b)
+        want = {k: np.asarray(v) for k, v in ref.result().items()}
+
+    eng = StreamingEngine(
+        _collection(),
+        EngineConfig(buckets=(16, 32), coalesce=1, snapshot_every=2,
+                     snapshot_dir=snapdir, snapshot_keep=3),
+    )
+    with eng:
+        for b in batches:
+            eng.submit(*b)
+        eng.flush()
+    del eng
+    corrupt_snapshot(latest_snapshot(snapdir), np.random.RandomState(1))
+
+    resumed = StreamingEngine(_collection(), EngineConfig(buckets=(16, 32), snapshot_dir=snapdir))
+    meta = resumed.restore()
+    assert meta["generations_skipped"] == 1
+    assert meta["batches_done"] == 4  # fell back from the @6 to the @4 cursor
+    assert resumed.stats.snapshot_fallbacks == 1
+    with resumed:
+        for b in batches[meta["batches_done"]:]:
+            resumed.submit(*b)
+        got = {k: np.asarray(v) for k, v in resumed.result().items()}
+    for k in want:
+        assert np.array_equal(got[k], want[k]), (k, got[k], want[k])
+
+
+def test_periodic_snapshot_write_failure_is_contained(tmp_path):
+    """A snapshot_write fault on the cadence path must not poison serving:
+    the stream keeps folding, the failure is counted, and the NEXT cadence
+    save succeeds — restore serves from it."""
+    batches = _batches(seed=2, sizes=(8, 8, 8, 8))
+    inj = FaultInjector(seed=20, plan={"snapshot_write": FaultSpec(schedule=(0,))})
+    eng = StreamingEngine(
+        _collection(),
+        EngineConfig(buckets=(8,), coalesce=1, snapshot_every=2,
+                     snapshot_dir=str(tmp_path), fault_injector=inj),
+    )
+    with eng:
+        for b in batches:
+            eng.submit(*b)
+        got = {k: np.asarray(v) for k, v in eng.result().items()}
+    assert eng.stats.snapshot_failures == 1
+    assert eng.stats.snapshots == 1  # the @4 save landed after the @2 failed
+    for k, v in _oracle(batches).items():
+        assert np.array_equal(got[k], v), k
+    resumed = StreamingEngine(_collection(), EngineConfig(buckets=(8,), snapshot_dir=str(tmp_path)))
+    meta = resumed.restore()
+    assert meta["batches_done"] == 4
+
+
+def test_explicit_snapshot_call_still_raises_on_write_fault(tmp_path):
+    """Only the PERIODIC cadence contains write failures; a user-invoked
+    snapshot() must report its failure loudly."""
+    inj = FaultInjector(seed=21, plan={"snapshot_write": FaultSpec(schedule=(0,))})
+    eng = StreamingEngine(
+        Accuracy(),
+        EngineConfig(buckets=(8,), snapshot_dir=str(tmp_path), fault_injector=inj),
+    )
+    with eng:
+        eng.submit(np.asarray([0.9, 0.2], np.float32), np.asarray([1, 0], np.int32))
+        with pytest.raises(Exception, match="injected fault"):
+            eng.snapshot()
+        eng.snapshot()  # the fault cleared; the explicit path works again
+    assert eng.stats.snapshots == 1
+
+
+def test_snapshot_read_transient_fault_retried_inside_restore(tmp_path):
+    eng = StreamingEngine(
+        MeanSquaredError(), EngineConfig(buckets=(8,), snapshot_dir=str(tmp_path))
+    )
+    with eng:
+        eng.submit(np.asarray([1.0, 0.5], np.float32), np.asarray([0.5, 0.5], np.float32))
+        eng.snapshot()
+    inj = FaultInjector(seed=22, plan={"snapshot_read": FaultSpec(schedule=(0,))})
+    resumed = StreamingEngine(
+        MeanSquaredError(),
+        EngineConfig(buckets=(8,), snapshot_dir=str(tmp_path), fault_injector=inj),
+    )
+    meta = resumed.restore()
+    assert meta["batches_done"] == 1
+    assert resumed.stats.retries == 1
+    with resumed:
+        assert float(resumed.result()) == pytest.approx(0.125)
+
+
+def _oracle(batches):
+    eager = _collection()
+    for b in batches:
+        eager.update(*b)
+    return {k: np.asarray(v) for k, v in eager.compute().items()}
+
+
+def test_integrity_sidecar_contents_are_json_sha(tmp_path):
+    d = str(tmp_path)
+    path = _save_one(d)
+    with open(_integrity_path(path)) as f:
+        doc = json.load(f)
+    assert set(doc) == {"sha256"} and len(doc["sha256"]) == 64

@@ -11,7 +11,11 @@ dispatch-bound / pad-bound / device-bound / sync-bound / starved) that says
 WHERE the dispatcher's wall time went, the coalescing ratio (submitted batches
 per device step), and — for mesh engines — the collective share: per-step sync
 latency under ``mesh_sync="step"`` vs boundary-merge time under
-``mesh_sync="deferred"`` (the step-vs-deferred comparison).
+``mesh_sync="deferred"`` (the step-vs-deferred comparison) — and, when the
+engine saw any fault activity (ISSUE 6), the fault block: injected faults by
+site, recovery actions (retries, rollbacks, kernel demotions, coalesce
+shrinks, watchdog expiries), the quarantine ledger totals, and snapshot
+write-failure/restore-fallback counts.
 Pure stdlib — safe to run anywhere the JSON lands (no jax import, so it works
 on a machine without the accelerator stack).
 """
@@ -60,6 +64,40 @@ def render(doc: dict, steps: int = 10) -> str:
         ("compile seconds", cc.get("compile_seconds")),
         ("persistent cache entries", cc.get("persistent_cache_entries")),
     ]
+    faults = s.get("faults")
+    if faults:
+        injected = faults.get("injected", {})
+        inj_txt = (
+            ", ".join(f"{k}×{v}" for k, v in sorted(injected.items())) if injected else "none"
+        )
+        recov = " · ".join(
+            f"{label} {_fmt(faults.get(key))}"
+            for label, key in (
+                ("retries", "retries"),
+                ("rollbacks", "rollbacks"),
+                ("demotions", "kernel_demotions"),
+                ("shrinks", "coalesce_shrinks"),
+                ("watchdog", "watchdog_timeouts"),
+            )
+            if faults.get(key)
+        )
+        rows.append(("faults injected", inj_txt))
+        rows.append(("recovery actions", recov or "none"))
+        rows.append(
+            (
+                "quarantined (batches/rows)",
+                f"{_fmt(faults.get('quarantined_batches'))} / "
+                f"{_fmt(faults.get('quarantined_rows'))}",
+            )
+        )
+        if faults.get("snapshot_failures") or faults.get("snapshot_fallbacks"):
+            rows.append(
+                (
+                    "snapshot failures / fallbacks",
+                    f"{_fmt(faults.get('snapshot_failures'))} / "
+                    f"{_fmt(faults.get('snapshot_fallbacks'))}",
+                )
+            )
     ms = s.get("mesh_sync")
     if ms:
         share = ms.get("collective_share")
