@@ -1,17 +1,19 @@
 # Parity target: reference Makefile (test = pytest with coverage).
 # Default flow runs the smoke checks (seconds) before the full suite.
-.PHONY: all test engine-smoke kernels-smoke mesh-smoke chaos-smoke clean native bench
+# Sidecar artifacts (telemetry JSON, analysis reports) land under out/
+# (gitignored) — never in the repo root.
+.PHONY: all test engine-smoke kernels-smoke mesh-smoke chaos-smoke analyze clean native bench
 
-all: engine-smoke kernels-smoke mesh-smoke chaos-smoke test
+all: engine-smoke kernels-smoke mesh-smoke chaos-smoke analyze test
 
 test:
 	python -m pytest tests/ -q
 
 # 1-device, tiny buckets: ragged-stream parity vs eager, compile budget, and
 # warm-cache zero-compile assertion (metrics_tpu/engine/smoke.py). Telemetry
-# lands in engine_telemetry.json; pretty-print: python tools/engine_report.py
+# lands in out/engine_telemetry.json; pretty-print: python tools/engine_report.py
 engine-smoke:
-	JAX_PLATFORMS=cpu python -m metrics_tpu.engine.smoke engine_telemetry.json
+	JAX_PLATFORMS=cpu python -m metrics_tpu.engine.smoke out/engine_telemetry.json
 
 # Kernel-dispatcher gate, CPU-safe and tier-1-budget cheap: interpret-mode
 # Pallas parity (fold/segment/histogram vs the XLA reference path) + backend
@@ -36,7 +38,17 @@ mesh-smoke:
 # deferred merge retry, dead-dispatcher submit(timeout=) — and the chaos run's
 # result() is bit-identical to a fault-free run on the same traffic.
 chaos-smoke:
-	JAX_PLATFORMS=cpu python -m metrics_tpu.engine.chaos_smoke chaos_telemetry.json
+	JAX_PLATFORMS=cpu python -m metrics_tpu.engine.chaos_smoke out/chaos_telemetry.json
+
+# Static-analysis gate, CPU-safe (metrics_tpu/analysis + tools/analyze.py):
+# program plane audits the bootstrap engine matrix ({step,deferred} x
+# {arena,per-leaf} x {single,multistream} x kernel backends xla+interpret) —
+# collective placement, scatter-free Pallas lowerings, donation aliasing,
+# arena fusion, host-constant fingerprint coverage, compile caps; source
+# plane is the AST trace-hazard lint over metrics_tpu/. Exits nonzero on any
+# finding not in tools/analysis_baseline.json. Rule catalog: docs/analysis.md.
+analyze:
+	JAX_PLATFORMS=cpu python tools/analyze.py --json out/analysis_report.json
 
 native:
 	g++ -O3 -shared -fPIC metrics_tpu/native/levenshtein.cpp -o metrics_tpu/native/_levenshtein.so
@@ -45,6 +57,6 @@ bench:
 	python bench.py
 
 clean:
-	rm -rf .pytest_cache build dist *.egg-info
+	rm -rf .pytest_cache build dist *.egg-info out
 	find . -name __pycache__ -type d -exec rm -rf {} +
 	rm -f metrics_tpu/native/_levenshtein.so engine_telemetry.json chaos_telemetry.json

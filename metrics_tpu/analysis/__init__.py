@@ -1,0 +1,77 @@
+"""Static analysis: declarative jaxpr/HLO invariants + repo-wide trace lint.
+
+This repo's performance and correctness story rests on STRUCTURAL program
+properties — collective placement per sync mode, scatter-free Pallas
+lowerings, honored donations, fingerprint-covered trace constants, fused
+arena packs, the closed program set — that used to be pinned ad hoc, one
+regex or jaxpr walk per test file. This package makes each of them a named,
+reusable rule with structured findings (rule id, severity, eqn/op path, fix
+hint), evaluated by two planes:
+
+* **Program plane** (:mod:`~metrics_tpu.analysis.program` +
+  :mod:`~metrics_tpu.analysis.rules`): walk traced jaxprs (recursing into
+  ``pjit``/``pallas_call``/``scan`` sub-programs via the PR-1 cost-walk
+  traversal) and compiled HLO text. :class:`EngineAnalysis`\\ ``.check(engine)``
+  audits any built engine.
+* **Source plane** (:mod:`~metrics_tpu.analysis.source`): an AST lint over
+  ``metrics_tpu/`` for the known trace-hazard classes — Python branches on
+  traced values, closure-identity trace-cache reuse, lock discipline in the
+  engine, tuple-message raises, wall-clock/RNG in jitted builders.
+
+One CLI drives both as the CI gate: ``python tools/analyze.py`` (wired as
+``make analyze``), with ``# analysis: disable=rule -- reason`` suppressions
+and a committed baseline that starts green and ratchets. Rule catalog:
+``docs/analysis.md``.
+"""
+from metrics_tpu.analysis.core import Baseline, Finding, Report
+from metrics_tpu.analysis.program import (
+    EngineAnalysis,
+    iter_eqns,
+    primitive_counts,
+    primitive_names,
+    trace_primitive_counts,
+)
+from metrics_tpu.analysis.rules import (
+    COLLECTIVE_PRIMITIVES,
+    RULES,
+    RuleInfo,
+    check_arena_pack_fused,
+    check_collective_multiset,
+    check_compile_cap,
+    check_donation_honored,
+    check_no_baked_host_constants,
+    check_no_collectives,
+    check_no_scatter_under_pallas,
+    check_pallas_call_count,
+    collective_counts,
+    expected_step_sync_collectives,
+    hlo_collective_counts,
+)
+from metrics_tpu.analysis.source import check_source_text, check_source_tree
+
+__all__ = [
+    "Baseline",
+    "COLLECTIVE_PRIMITIVES",
+    "EngineAnalysis",
+    "Finding",
+    "Report",
+    "RULES",
+    "RuleInfo",
+    "check_arena_pack_fused",
+    "check_collective_multiset",
+    "check_compile_cap",
+    "check_donation_honored",
+    "check_no_baked_host_constants",
+    "check_no_collectives",
+    "check_no_scatter_under_pallas",
+    "check_pallas_call_count",
+    "check_source_text",
+    "check_source_tree",
+    "collective_counts",
+    "expected_step_sync_collectives",
+    "hlo_collective_counts",
+    "iter_eqns",
+    "primitive_counts",
+    "primitive_names",
+    "trace_primitive_counts",
+]

@@ -1,0 +1,91 @@
+"""Bootstrap engine matrix for the program-plane CI gate (``make analyze``).
+
+``tools/analyze.py`` cannot audit the engines a user will build — it audits a
+REPRESENTATIVE matrix spanning every serving mode the rules discriminate:
+
+    {step, deferred} x {arena, per-leaf} x {single, multistream}
+                     x kernel backends {xla, pallas_interpret}
+
+"step" runs meshless (the default serving shape; step-sync mesh placement is
+covered by the 8-device ``make mesh-smoke`` — bootstrapping a virtual mesh
+here would double that gate); "deferred" runs on a 1-device mesh, which
+lowers the REAL shard-local step and boundary merge programs (the same
+trace the 8-device mesh compiles, minus devices — exactly what the jaxpr
+rules inspect). Each engine serves a few ragged batches so its program set
+is built, then ``EngineAnalysis.check`` runs the full rule set. CPU-safe by
+construction; the whole matrix is small buckets and tiny traffic.
+"""
+from typing import Dict, Iterable, List, Optional, Tuple
+
+__all__ = ["bootstrap_engines", "analyze_bootstrap_matrix"]
+
+_BACKENDS = ("xla", "pallas_interpret")
+
+
+def bootstrap_engines(
+    backends: Iterable[str] = _BACKENDS,
+) -> List[Tuple[str, object]]:
+    """Build + drive the matrix; returns ``(label, engine)`` pairs with every
+    engine's program set compiled (traffic served, result read)."""
+    import numpy as np
+
+    import jax
+    from jax.sharding import Mesh
+
+    from metrics_tpu import Accuracy, MeanSquaredError, MetricCollection
+    from metrics_tpu.engine import EngineConfig, MultiStreamEngine, StreamingEngine
+
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("dp",))
+    rng = np.random.RandomState(0)
+    batches = [
+        (rng.rand(n).astype(np.float32), (rng.rand(n) > 0.5).astype(np.int32))
+        for n in (5, 8, 3, 6)
+    ]
+
+    out: List[Tuple[str, object]] = []
+    for backend in backends:
+        for sync in ("step", "deferred"):
+            mesh_kw = (
+                {"mesh": mesh, "axis": "dp", "mesh_sync": "deferred"}
+                if sync == "deferred"
+                else {}
+            )
+            for arena in (True, False):
+                for kind in ("single", "multistream"):
+                    label = f"{sync}/{'arena' if arena else 'per-leaf'}/{kind}/{backend}"
+                    cfg = EngineConfig(
+                        buckets=(8,), use_arena=arena, kernel_backend=backend, **mesh_kw
+                    )
+                    if kind == "single":
+                        engine = StreamingEngine(
+                            MetricCollection([Accuracy(), MeanSquaredError()]), cfg
+                        )
+                    else:
+                        engine = MultiStreamEngine(Accuracy(), num_streams=2, config=cfg)
+                    with engine:
+                        for i, b in enumerate(batches):
+                            if kind == "multistream":
+                                engine.submit(i % 2, *b)
+                            else:
+                                engine.submit(*b)
+                        if kind == "multistream":
+                            engine.result(0)
+                        else:
+                            engine.result()
+                    out.append((label, engine))
+    return out
+
+
+def analyze_bootstrap_matrix(backends: Iterable[str] = _BACKENDS):
+    """Run :class:`~metrics_tpu.analysis.program.EngineAnalysis` over the
+    whole matrix; returns one merged Report."""
+    from metrics_tpu.analysis.core import Report
+    from metrics_tpu.analysis.program import EngineAnalysis
+
+    report = Report()
+    analysis = EngineAnalysis()
+    engines = bootstrap_engines(backends)
+    for label, engine in engines:
+        report.merge(analysis.check(engine, label=label))
+    report.note(f"program plane: {len(engines)} bootstrap engines audited")
+    return report

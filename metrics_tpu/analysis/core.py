@@ -1,0 +1,176 @@
+"""Findings, reports, baselines — the shared vocabulary of both analysis planes.
+
+A **finding** is one violated invariant: a stable rule id, a severity, a
+location (``where`` — a program name or ``file:line``), an optional structural
+path into the program (``path`` — the eqn/op chain for program-plane findings),
+a one-line message and a fix hint. Findings are DATA, not exceptions: rules
+return lists of them, the CLI (``tools/analyze.py``) renders/serializes them,
+and tests assert on them — the same rule object backs the CI gate and the
+regression suites that used to pin these invariants ad hoc.
+
+Two escape hatches keep the gate honest instead of noisy:
+
+* **Inline suppressions** (source plane): ``# analysis: disable=rule-id --
+  reason`` on the offending line (or the line directly above) suppresses that
+  rule there. The reason is REQUIRED — a disable without one is itself a
+  finding (``suppression-missing-reason``), so every silenced warning carries
+  its justification in the diff that silenced it.
+* **Baseline file** (both planes): a committed JSON map of finding keys to
+  reasons (``tools/analysis_baseline.json``). The gate subtracts baselined
+  findings, so it starts green on an imperfect tree and RATCHETS — new
+  findings fail CI, old ones are visible debt with a written reason. An entry
+  without a reason fails the gate too (zero unexplained baseline entries).
+"""
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+__all__ = [
+    "Finding",
+    "Report",
+    "Baseline",
+    "parse_suppressions",
+    "SUPPRESS_RE",
+]
+
+#: ``# analysis: disable=rule-a,rule-b -- why this is fine here``
+SUPPRESS_RE = re.compile(
+    r"#\s*analysis:\s*disable=(?P<rules>[\w,-]+)(?:\s*--\s*(?P<reason>.*\S))?\s*$"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One violated invariant, locatable and stable under re-runs."""
+
+    rule: str               # rule id, e.g. "no-collectives-in-deferred-step"
+    severity: str           # "error" | "warning"
+    where: str              # program name or "path/to/file.py:LINE"
+    message: str            # what is wrong, with the concrete evidence
+    path: str = ""          # eqn/op path inside the program ("" for source findings)
+    hint: str = ""          # how to fix (or why this class of bug matters)
+
+    def key(self) -> str:
+        """Stable identity for baselining: rule + location (not the message,
+        which may carry counts that drift)."""
+        return f"{self.rule}|{self.where}|{self.path}"
+
+    def render(self) -> str:
+        loc = f"{self.where}" + (f" [{self.path}]" if self.path else "")
+        out = f"{self.severity.upper():7s} {self.rule}: {loc}\n        {self.message}"
+        if self.hint:
+            out += f"\n        hint: {self.hint}"
+        return out
+
+
+@dataclass
+class Report:
+    """An ordered bag of findings plus non-finding notes (skipped checks)."""
+
+    findings: List[Finding] = field(default_factory=list)
+    notes: List[str] = field(default_factory=list)
+
+    def extend(self, findings: Iterable[Finding]) -> "Report":
+        self.findings.extend(findings)
+        return self
+
+    def note(self, msg: str) -> None:
+        self.notes.append(msg)
+
+    def merge(self, other: "Report") -> "Report":
+        self.findings.extend(other.findings)
+        self.notes.extend(other.notes)
+        return self
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "findings": [
+                {
+                    "rule": f.rule, "severity": f.severity, "where": f.where,
+                    "path": f.path, "message": f.message, "hint": f.hint,
+                    "key": f.key(),
+                }
+                for f in self.findings
+            ],
+            "notes": list(self.notes),
+        }
+
+    def render(self) -> str:
+        lines = [f.render() for f in self.findings]
+        lines += [f"note: {n}" for n in self.notes]
+        if not self.findings:
+            lines.append("no findings")
+        return "\n".join(lines)
+
+
+class Baseline:
+    """The committed debt ledger: ``{finding_key: reason}``.
+
+    ``filter`` splits findings into (new, baselined); keys present in the
+    file but carrying no reason are surfaced as findings themselves — the
+    gate's "zero unexplained baseline entries" contract.
+    """
+
+    def __init__(self, entries: Optional[Dict[str, str]] = None, path: str = ""):
+        self.entries: Dict[str, str] = dict(entries or {})
+        self.path = path
+
+    @classmethod
+    def load(cls, path: Optional[str]) -> "Baseline":
+        if not path or not os.path.exists(path):
+            return cls({}, path or "")
+        with open(path) as fh:
+            raw = json.load(fh)
+        if not isinstance(raw, dict):
+            raise ValueError(f"baseline {path} must be a JSON object of key->reason")
+        return cls({str(k): str(v or "") for k, v in raw.items()}, path)
+
+    def save(self, path: Optional[str] = None) -> str:
+        path = path or self.path
+        with open(path, "w") as fh:
+            json.dump(self.entries, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+        return path
+
+    def unexplained(self) -> List[str]:
+        # a TODO placeholder (what --write-baseline seeds) is NOT an
+        # explanation — counting it as one would let the gate go green
+        # forever with the debt never justified
+        return sorted(
+            k for k, reason in self.entries.items()
+            if not reason.strip() or reason.strip().upper().startswith("TODO")
+        )
+
+    def filter(self, findings: Iterable[Finding]) -> Tuple[List[Finding], List[Finding]]:
+        new, old = [], []
+        for f in findings:
+            (old if f.key() in self.entries else new).append(f)
+        return new, old
+
+
+def parse_suppressions(source: str) -> Dict[int, Tuple[Tuple[str, ...], str, int]]:
+    """Map line number -> (rule ids, reason, directive line) for every line a
+    suppression covers: the directive's own line AND the line below it (so a
+    comment directly above the offending statement works for long lines)."""
+    out: Dict[int, Tuple[Tuple[str, ...], str, int]] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        rules = tuple(r.strip() for r in m.group("rules").split(",") if r.strip())
+        reason = (m.group("reason") or "").strip()
+        entry = (rules, reason, i)
+        out[i] = entry
+        # ONLY a comment-only directive line suppresses the NEXT line; a
+        # directive trailing a statement covers that statement alone —
+        # otherwise it would silently swallow an independent violation on
+        # the following line with no reason attached to it
+        if line.lstrip().startswith("#"):
+            out.setdefault(i + 1, entry)
+    return out

@@ -1,0 +1,274 @@
+"""Program-plane engine: walk jaxprs/HLO, evaluate rules, audit built engines.
+
+Two layers:
+
+* **Walkers** — :func:`iter_eqns` recurses through every sub-jaxpr one
+  equation can carry (``pjit``/``scan``/``while`` bodies, ``cond`` branches,
+  ``pallas_call`` kernel bodies), reusing the PR-1 cost-walk's sub-program
+  discovery (``ops/profiling.py::eqn_subjaxprs``) so the analyzer and the
+  profiler can never disagree about what counts as "inside the program".
+  :func:`trace_primitive_counts` traces a callable with a FRESH closure per
+  call — the safe form of "what does this lower to?" that cannot hit the
+  closure-identity trace cache (the PR-4 footgun).
+
+* **:class:`EngineAnalysis`** — audit any BUILT engine that has served
+  traffic: every memoized update program is re-traced to a jaxpr (from the
+  memo key's abstract signature — no live data needed) and paired with its
+  compiled HLO, then the applicable rules run: collective placement per sync
+  mode, scatter/pallas invariants per kernel backend, donation aliasing,
+  arena fusion, host-constant/fingerprint coverage, and the compile cap.
+  ``EngineAnalysis().check(engine)`` returns a :class:`~metrics_tpu.analysis.
+  core.Report`; ``tools/analyze.py`` drives it over the bootstrap matrix as
+  the CI gate.
+"""
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+from metrics_tpu.analysis.core import Finding, Report
+from metrics_tpu.ops.profiling import eqn_subjaxprs
+
+__all__ = [
+    "EngineAnalysis",
+    "iter_eqns",
+    "primitive_counts",
+    "primitive_names",
+    "trace_primitive_counts",
+    "unwrap_jaxpr",
+]
+
+
+def unwrap_jaxpr(jaxpr: Any) -> Any:
+    """Accept a ClosedJaxpr, a raw Jaxpr, or anything ``make_jaxpr`` returned."""
+    inner = getattr(jaxpr, "jaxpr", None)
+    return inner if inner is not None and hasattr(inner, "eqns") else jaxpr
+
+
+def iter_eqns(jaxpr: Any, path: str = "") -> Iterator[Tuple[str, Any]]:
+    """Yield ``(eqn_path, eqn)`` for every equation at every nesting depth.
+
+    ``eqn_path`` is the structural location — e.g.
+    ``pjit@2/scan@0.jaxpr/psum@4`` — stable across traces of the same
+    program, so findings anchored on it survive re-runs and baselining.
+    """
+    for i, eqn in enumerate(unwrap_jaxpr(jaxpr).eqns):
+        here = f"{path}/{eqn.primitive.name}@{i}" if path else f"{eqn.primitive.name}@{i}"
+        yield here, eqn
+        for tag, sub in eqn_subjaxprs(eqn):
+            yield from iter_eqns(sub, f"{here}.{tag}")
+
+
+def primitive_counts(jaxpr: Any) -> Dict[str, int]:
+    """Multiset of primitive names at every depth."""
+    acc: Dict[str, int] = {}
+    for _, eqn in iter_eqns(jaxpr):
+        acc[eqn.primitive.name] = acc.get(eqn.primitive.name, 0) + 1
+    return acc
+
+
+def primitive_names(jaxpr: Any) -> List[str]:
+    """Flat pre-order list of primitive names at every depth."""
+    return [eqn.primitive.name for _, eqn in iter_eqns(jaxpr)]
+
+
+def trace_primitive_counts(fn: Any, *args: Any, **kwargs: Any) -> Dict[str, int]:
+    """``primitive_counts`` of ``fn(*args)``'s jaxpr, traced through a FRESH
+    closure so repeated calls under different lowering contexts (kernel
+    backends) can never reuse a cached trace — the safe spelling of the
+    ``jax.make_jaxpr(lambda *a: fn(*a))`` idiom the dispatch tests used."""
+    import jax
+
+    return primitive_counts(jax.make_jaxpr(lambda *a: fn(*a))(*args, **kwargs))
+
+
+# ------------------------------------------------------------------ signatures
+
+
+def _strip_shardings(tree: Any) -> Any:
+    import jax
+
+    return jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype) if hasattr(s, "shape") else s,
+        tree,
+    )
+
+
+def _leaf_from_sig(entry: Tuple[Any, Any]) -> Any:
+    """One abstract leaf back from an ``AotCache.signature_of`` entry."""
+    import jax
+    import jax.numpy as jnp
+
+    a, b = entry
+    if isinstance(a, tuple):  # (shape, dtype_str) — an array leaf
+        return jax.ShapeDtypeStruct(tuple(a), jnp.dtype(b))
+    if a in ("bool", "int", "float", "str"):
+        return b
+    raise ValueError(f"cannot reconstruct an abstract leaf from signature entry {entry!r}")
+
+
+def _payload_from_sig(sig: Tuple[Any, Any]) -> Any:
+    """Rebuild the abstract payload pytree a memoized update program was
+    compiled for, from its ``(treedef, leaf_sig)`` program-memo key."""
+    import jax
+
+    treedef, leaf_sigs = sig
+    return jax.tree_util.tree_unflatten(treedef, [_leaf_from_sig(e) for e in leaf_sigs])
+
+
+def _sig_structure(sig: Tuple[Any, Any]) -> Tuple[Any, ...]:
+    """Bucket-count-insensitive payload structure: treedef + leaf dtypes (the
+    compile-cap groups update programs by this — different buckets of one
+    stream share a structure; a different metric signature does not)."""
+    treedef, leaf_sigs = sig
+    return (str(treedef),) + tuple(
+        str(e[1]) if isinstance(e[0], tuple) else repr(e) for e in leaf_sigs
+    )
+
+
+# -------------------------------------------------------------- engine audit
+
+
+class EngineAnalysis:
+    """Audit a built :class:`~metrics_tpu.engine.StreamingEngine` (or
+    :class:`MultiStreamEngine`) against the program-plane rule set.
+
+    The engine must have served traffic (its update programs are compiled and
+    memoized); the audit is read-only — it re-traces jaxprs from abstract
+    signatures and reads compiled HLO, never touching live state.
+
+    Args:
+        host_attr_alternates: optional ``{attr_path: [values]}`` overriding
+            the default perturbations of ``no-baked-host-constants`` (enums
+            perturb automatically; exotic attr types need explicit values).
+    """
+
+    def __init__(self, host_attr_alternates: Optional[Dict[str, Sequence[Any]]] = None):
+        self._alternates = host_attr_alternates
+
+    def check(self, engine: Any, label: Optional[str] = None) -> Report:
+        import jax
+
+        from metrics_tpu.analysis import rules as R
+
+        report = Report()
+        label = label or f"{type(engine).__name__}[{type(engine._metric).__name__}]"
+        memo = dict(engine._program_memo)
+        if not memo:
+            report.note(
+                f"{label}: no compiled update programs — submit traffic before auditing"
+            )
+        deferred = engine._deferred
+        mesh = engine._cfg.mesh
+        kernel_backend = engine._kernel_tag()
+        state_abs = _strip_shardings(engine._abstract_state())
+
+        structures = set()
+        for (sig, mask_shape), compiled in memo.items():
+            structures.add(_sig_structure(sig))
+            where = f"{label}/update[bucket={mask_shape[0]}]"
+            try:
+                payload_abs = _payload_from_sig(sig)
+            except ValueError as e:
+                report.note(f"{where}: skipped (unreconstructable payload: {e})")
+                continue
+            mask_abs = jax.ShapeDtypeStruct(tuple(mask_shape), bool)
+            with engine._kernel_scope():
+                jaxpr = jax.make_jaxpr(engine._step_callable(payload_abs, mask_abs))(
+                    state_abs, payload_abs, mask_abs
+                )
+            hlo = None
+            try:
+                hlo = compiled.as_text()
+            except Exception as e:  # noqa: BLE001 - backend-dependent
+                report.note(f"{where}: compiled HLO unavailable ({type(e).__name__})")
+
+            if deferred:
+                report.extend(R.check_no_collectives(jaxpr=jaxpr, hlo_text=hlo, where=where))
+            elif mesh is not None:
+                try:
+                    expected = R.expected_step_sync_collectives(engine._metric)
+                except ValueError as e:
+                    report.note(f"{where}: collective multiset not derivable ({e})")
+                else:
+                    report.extend(R.check_collective_multiset(jaxpr, expected, where=where))
+            if kernel_backend != "xla":
+                report.extend(R.check_no_scatter_under_pallas(jaxpr, where=where))
+                if self._kernel_path_expected(engine):
+                    report.extend(R.check_pallas_call_count(jaxpr, min_count=1, where=where))
+            if engine._layout is not None:
+                report.extend(R.check_arena_pack_fused(
+                    jaxpr, engine._layout, where=where,
+                    worlds=(engine._world,) if deferred else (),
+                    state_leaves=len(jax.tree_util.tree_leaves(state_abs)),
+                ))
+            if engine._donate and hlo is not None:
+                n_donated = (
+                    engine._layout.num_buffers
+                    if engine._layout is not None
+                    else len(jax.tree_util.tree_leaves(state_abs))
+                )
+                report.extend(R.check_donation_honored(hlo, n_donated, where=where))
+        if not engine._donate:
+            report.note(f"{label}: donation off (CPU or config) — donation-honored skipped")
+
+        # compile cap: programs this engine owns in its (possibly shared) cache
+        cap_detail = ""
+        n_owned = self._owned_programs(engine)
+        if n_owned is not None:
+            cap = (
+                len(engine._cfg.buckets) * max(1, len(structures))
+                + 1                       # compute
+                + (1 if deferred else 0)  # boundary merge
+            )
+            cap_detail = (
+                f"{len(engine._cfg.buckets)} buckets x {max(1, len(structures))} "
+                f"payload structures + compute" + (" + merge" if deferred else "")
+            )
+            report.extend(R.check_compile_cap(
+                n_owned, cap, where=f"{label}/programs", detail=cap_detail
+            ))
+
+        # host-constant coverage (the PR-3 collision class)
+        if getattr(engine, "_needs_attr_latch", False):
+            report.note(f"{label}: host attrs not yet latched — no-baked-host-constants skipped")
+        else:
+            report.extend(R.check_no_baked_host_constants(
+                engine._metric, where=f"{label}/compute", alternates=self._alternates
+            ))
+        return report
+
+    @staticmethod
+    def _kernel_path_expected(engine: Any) -> bool:
+        """Whether a Pallas-backend engine's step should trace >=1 kernel:
+        only delta-strategy metrics route their fold through the dispatcher,
+        and only supported dtypes stay on the kernel path."""
+        from metrics_tpu.ops.kernels.common import supported_dtype
+
+        metric = engine._metric
+        strategies = (
+            metric.masked_update_strategies()
+            if hasattr(metric, "masked_update_strategies")
+            else {type(metric).__name__: metric.masked_update_strategy()}
+        )
+        if any(s != "delta" for s in strategies.values()):
+            return False
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(metric.abstract_state())
+        return all(supported_dtype(l.dtype) for l in leaves if hasattr(l, "dtype"))
+
+    @staticmethod
+    def _owned_programs(engine: Any) -> Optional[int]:
+        """How many compiled programs in the engine's AotCache belong to it
+        (same metric fingerprint, mesh, sync mode). None when the cache does
+        not expose its keys."""
+        from metrics_tpu.engine.aot import _mesh_fingerprint
+
+        keys = getattr(engine._aot, "program_keys", None)
+        if keys is None:
+            return None
+        mesh_fp = _mesh_fingerprint(engine._cfg.mesh)
+        sync = engine._sync_tag()
+        return sum(
+            1
+            for k in keys()
+            if len(k) >= 6 and k[1] == engine._metric_fp and k[3] == mesh_fp and k[5] == sync
+        )

@@ -1,0 +1,155 @@
+"""The rule catalog: every named invariant both analysis planes can evaluate.
+
+Program-plane rules check traced jaxprs / compiled HLO of engine programs
+(``analysis/program.py`` wires them to a built engine); source-plane rules
+are AST lints over ``metrics_tpu/`` (``analysis/source.py``). Each entry
+names the invariant, what violating it costs, and — where one exists — the
+historical incident the rule encodes, so the catalog doubles as the repo's
+institutional memory (docs/analysis.md renders it).
+"""
+from dataclasses import dataclass
+from typing import Dict
+
+from metrics_tpu.analysis.rules.arena import check_arena_pack_fused
+from metrics_tpu.analysis.rules.collectives import (
+    COLLECTIVE_PRIMITIVES,
+    check_collective_multiset,
+    check_no_collectives,
+    collective_counts,
+    collective_eqn_paths,
+    expected_step_sync_collectives,
+    hlo_collective_counts,
+)
+from metrics_tpu.analysis.rules.compile_cap import check_compile_cap
+from metrics_tpu.analysis.rules.constants import (
+    check_no_baked_host_constants,
+    default_attr_alternates,
+)
+from metrics_tpu.analysis.rules.donation import (
+    check_donation_honored,
+    parse_hlo_aliased_params,
+)
+from metrics_tpu.analysis.rules.pallas import (
+    check_no_scatter_under_pallas,
+    check_pallas_call_count,
+)
+
+__all__ = [
+    "COLLECTIVE_PRIMITIVES",
+    "RULES",
+    "RuleInfo",
+    "check_arena_pack_fused",
+    "check_collective_multiset",
+    "check_compile_cap",
+    "check_donation_honored",
+    "check_no_baked_host_constants",
+    "check_no_collectives",
+    "check_no_scatter_under_pallas",
+    "check_pallas_call_count",
+    "collective_counts",
+    "collective_eqn_paths",
+    "default_attr_alternates",
+    "expected_step_sync_collectives",
+    "hlo_collective_counts",
+    "parse_hlo_aliased_params",
+]
+
+
+@dataclass(frozen=True)
+class RuleInfo:
+    id: str
+    plane: str       # "program" | "source"
+    severity: str
+    summary: str
+    incident: str = ""  # the historical bug this rule encodes, if any
+
+
+RULES: Dict[str, RuleInfo] = {
+    r.id: r
+    for r in [
+        RuleInfo(
+            "no-collectives-in-deferred-step", "program", "error",
+            "Deferred-sync steady steps carry zero cross-chip collectives "
+            "(jaxpr at any depth, and compiled HLO).",
+            incident="PR 5 pinned this with one-off jaxpr walks + HLO regexes per test",
+        ),
+        RuleInfo(
+            "exact-collective-multiset-in-step-sync", "program", "error",
+            "Step-sync mesh steps trace EXACTLY the fused bundle: one psum for "
+            "all sum states + the token psum + one collective per extra "
+            "(reduction, dtype).",
+            incident="PR 5's per-test multiset pins",
+        ),
+        RuleInfo(
+            "no-scatter-under-pallas", "program", "error",
+            "Programs traced under a Pallas kernel backend contain no scatter "
+            "primitives — the kernels replace .at[ids].op with compare-reduce.",
+            incident="PR 4's per-test zero-scatter pins",
+        ),
+        RuleInfo(
+            "pallas-call-per-leaf", "program", "error",
+            "Kernel-backend programs trace the expected pallas_call count "
+            "(one per state leaf for delta metrics; >=1 in the engine audit).",
+            incident="PR 4's closure-identity trace-cache footgun hid a zero count",
+        ),
+        RuleInfo(
+            "donation-honored", "program", "error",
+            "Every declared donated buffer is actually aliased in the compiled "
+            "HLO's input_output_alias table — XLA dropping a donation silently "
+            "double-buffers the state.",
+        ),
+        RuleInfo(
+            "no-baked-host-constants", "program", "error",
+            "A host-derived attr that changes the traced program must change "
+            "the metric fingerprint — else shared AotCaches hand out programs "
+            "with the wrong constant baked in.",
+            incident="PR 3's Accuracy.mode shared-cache collision (found by accident)",
+        ),
+        RuleInfo(
+            "arena-pack-fused", "program", "error",
+            "No per-leaf materialized copies or per-leaf arena-buffer writes "
+            "between unpack and pack — the arena step stays one concat per dtype.",
+        ),
+        RuleInfo(
+            "compile-cap", "program", "error",
+            "Programs-per-engine accounting: at most len(buckets) update "
+            "programs per payload structure + compute (+ merge when deferred).",
+        ),
+        RuleInfo(
+            "traced-python-branch", "source", "error",
+            "No Python if/while on a value reachable from a jit/vmap-traced "
+            "parameter — it raises a TracerBoolConversionError at best, bakes "
+            "one branch at worst.",
+        ),
+        RuleInfo(
+            "closure-identity-trace-cache", "source", "warning",
+            "Do not re-trace one closure under multiple lowering-changing "
+            "contexts (use_backend, ...): JAX caches traces by function "
+            "identity + avals, so the second context reuses the first jaxpr.",
+            incident="PR 4: re-tracing one closure under two kernel backends reused the first lowering",
+        ),
+        RuleInfo(
+            "lock-discipline", "source", "error",
+            "Declared lock-guarded engine attributes mutate only inside "
+            "`with self._state_lock` (or in methods declared lock-held) — the "
+            "dispatcher donates live buffers, so unlocked RMW races tear state.",
+            incident="PR 3: reset_stream vs donating dispatcher RMW race",
+        ),
+        RuleInfo(
+            "raise-tuple", "source", "error",
+            "Exceptions are raised with ONE formatted message string — "
+            "multi-arg (or tuple-literal) raises render as mangled tuples.",
+            incident="PR 1: reference checks.py raise ValueError('...', '...') tuple-message bug",
+        ),
+        RuleInfo(
+            "wallclock-in-jit", "source", "error",
+            "No wall-clock or host-RNG calls inside jitted step builders — "
+            "they bake one trace-time value into every later execution.",
+        ),
+        RuleInfo(
+            "suppression-missing-reason", "source", "error",
+            "Every `# analysis: disable=` directive carries a `-- reason`; "
+            "silenced rules must say why.",
+        ),
+    ]
+}

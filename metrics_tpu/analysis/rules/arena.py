@@ -1,0 +1,155 @@
+"""Arena-fusion rule: unpack -> update -> pack must not materialize per leaf.
+
+The arena's whole value (PR 3) is that the carried state crosses the dispatch
+boundary as one buffer per dtype while the jitted step's unpack (static
+slices) and pack (one concatenate per dtype) fuse away. Two regressions
+reintroduce per-leaf cost inside the program where nobody would see it:
+
+* explicit device copies of CARRIED-STATE leaves (``jnp.array(x, copy=True)``
+  / defensive clones inside the step) — one ``copy`` eqn per leaf. Copies of
+  trace-time constants are benign (``init_state``'s per-leaf defensive copy
+  of the zero defaults lowers to ``copy`` of a constant, which XLA folds), so
+  the rule runs a forward TAINT walk from the state inputs and flags only
+  copies reachable from them;
+* packing by writing each leaf into the arena buffer individually
+  (``buf.at[off:off+n].set(leaf)``) — one scatter per leaf into an
+  arena-buffer-shaped output, serializing what the concat form fuses.
+"""
+from typing import Any, Dict, Iterable, List, Optional, Set, Tuple
+
+from metrics_tpu.analysis.core import Finding
+
+__all__ = ["check_arena_pack_fused"]
+
+
+def _arena_avals(layout: Any, worlds: Iterable[int]) -> Set[Tuple[Tuple[int, ...], str]]:
+    """Full-buffer (shape, dtype) signatures in every carried form: per-shard
+    ``(n,)`` and, for each mesh world size given, shard-stacked ``(world, n)``."""
+    out: Set[Tuple[Tuple[int, ...], str]] = set()
+    for k, n in layout.buffer_sizes().items():
+        out.add(((n,), k))
+        for w in worlds:
+            out.add(((int(w), n), k))
+    return out
+
+
+def _tainted_copy_paths(jaxpr: Any, tainted_invars: Optional[int]) -> List[str]:
+    """Eqn paths of every ``copy`` whose input derives from a tainted program
+    input, walking sub-jaxprs with positional invar mapping where the
+    container aligns (pjit/shard_map/scan: body invars mirror eqn invars;
+    cond: branches take ``eqn.invars[1:]``) and a conservative all-tainted
+    spill where it does not. ``tainted_invars`` = how many leading invars are
+    tainted (None = all: taint every runtime input)."""
+    from metrics_tpu.ops.profiling import eqn_subjaxprs
+
+    out: List[str] = []
+
+    def walk(jx: Any, tainted: Set[Any], path: str) -> None:
+        live = set(tainted)
+        for i, eqn in enumerate(jx.eqns):
+            here = f"{path}/{eqn.primitive.name}@{i}" if path else f"{eqn.primitive.name}@{i}"
+            in_vars = [v for v in eqn.invars if not type(v).__name__ == "Literal"]
+            hit = any(v in live for v in in_vars)
+            if eqn.primitive.name == "copy" and hit:
+                out.append(here)
+            for tag, sub in eqn_subjaxprs(eqn):
+                sub_inv = list(sub.invars)
+                if len(sub_inv) == len(eqn.invars):
+                    sub_tainted = {
+                        sv for sv, ov in zip(sub_inv, eqn.invars)
+                        if type(ov).__name__ != "Literal" and ov in live
+                    }
+                elif len(sub_inv) == len(eqn.invars) - 1:  # cond branches
+                    sub_tainted = {
+                        sv for sv, ov in zip(sub_inv, eqn.invars[1:])
+                        if type(ov).__name__ != "Literal" and ov in live
+                    }
+                else:  # unknown container: spill conservatively
+                    sub_tainted = set(sub_inv) if hit else set()
+                walk(sub, sub_tainted, f"{here}.{tag}")
+            if hit:
+                live.update(eqn.outvars)
+
+    inner = getattr(jaxpr, "jaxpr", jaxpr)
+    invars = list(inner.invars)
+    n = len(invars) if tainted_invars is None else min(tainted_invars, len(invars))
+    walk(inner, set(invars[:n]), "")
+    return out
+
+
+#: containers the pack can legitimately sit inside — the write-scan descends
+#: through these but NOT into loop/branch bodies (scan/while/cond), where an
+#: arena-buffer-shaped write is metric-update semantics (e.g. a cat-strategy
+#: capacity buffer that happens to share the arena buffer's shape), never
+#: the step's pack
+_TRANSPARENT_CONTAINERS = {
+    "pjit", "closed_call", "core_call", "xla_call", "shard_map",
+    "custom_jvp_call", "custom_vjp_call", "remat", "checkpoint",
+}
+
+
+def _pack_level_eqns(jaxpr: Any, path: str = ""):
+    from metrics_tpu.ops.profiling import eqn_subjaxprs
+
+    for i, eqn in enumerate(jaxpr.eqns):
+        here = f"{path}/{eqn.primitive.name}@{i}" if path else f"{eqn.primitive.name}@{i}"
+        yield here, eqn
+        if eqn.primitive.name in _TRANSPARENT_CONTAINERS:
+            for tag, sub in eqn_subjaxprs(eqn):
+                yield from _pack_level_eqns(sub, f"{here}.{tag}")
+
+
+def check_arena_pack_fused(
+    jaxpr: Any,
+    layout: Any,
+    where: str = "",
+    worlds: Iterable[int] = (),
+    state_leaves: Optional[int] = None,
+) -> List[Finding]:
+    """Rule ``arena-pack-fused``: in an arena-carrying step program, flag
+
+    * every ``copy`` eqn reachable from the carried state (``state_leaves``
+      leading program inputs; None taints every input) — a materialized
+      per-leaf clone between unpack and pack; copies of constants
+      (``init_state`` defaults) are benign and ignored, and
+    * every scatter/dynamic-update-slice whose OUTPUT is exactly an arena
+      buffer (per-leaf writes into the packed form instead of one concat
+      per dtype).
+    """
+    from metrics_tpu.analysis.program import unwrap_jaxpr
+
+    findings: List[Finding] = []
+    for path in _tainted_copy_paths(jaxpr, state_leaves):
+        findings.append(Finding(
+            rule="arena-pack-fused", severity="error", where=where, path=path,
+            message="carried-state leaf materialized via an explicit device copy inside the step",
+            hint=(
+                "the arena contract keeps unpack/pack free after XLA fusion; "
+                "drop the jnp.array(copy=True)/clone — transactional shadows "
+                "belong OUTSIDE the compiled step (engine/pipeline.py::_step_shadow)"
+            ),
+        ))
+    arena_sigs = _arena_avals(layout, worlds)
+    for path, eqn in _pack_level_eqns(unwrap_jaxpr(jaxpr)):
+        name = eqn.primitive.name
+        if not (name.startswith("scatter") or name == "dynamic_update_slice"):
+            continue
+        out_aval = eqn.outvars[0].aval if eqn.outvars else None
+        if out_aval is None or not hasattr(out_aval, "shape"):
+            continue
+        sig = (tuple(int(d) for d in out_aval.shape), str(out_aval.dtype))
+        if sig in arena_sigs:
+            findings.append(Finding(
+                rule="arena-pack-fused", severity="error", where=where, path=path,
+                message=(
+                    f"per-leaf {name} writes into an arena buffer "
+                    f"{sig[0]}:{sig[1]} — the pack degraded from one concatenate "
+                    "per dtype to one write per leaf"
+                ),
+                hint=(
+                    "pack with ArenaLayout.pack/pack_stacked (a single per-dtype "
+                    "concatenate XLA writes straight into the donated input); "
+                    ".at[offset:offset+size].set loops serialize and defeat donation"
+                ),
+            ))
+    return findings

@@ -1,0 +1,195 @@
+"""Collective-placement rules: WHERE cross-chip communication may appear.
+
+The deferred-sync serving contract (PR 5, docs/serving.md "Mesh sync modes")
+is structural: the steady-state step carries ZERO collectives at any nesting
+depth — in the jaxpr AND in the compiled HLO — while the step-sync step
+carries EXACTLY its fused bundle (one psum for all sum states + the token
+psum + one collective per extra (reduction, dtype)). Both used to be pinned
+by one-off jaxpr walks and regexes scattered across test files; these rules
+are the single named implementation every gate calls.
+"""
+from typing import Any, Dict, List, Optional, Tuple
+
+from metrics_tpu.analysis.core import Finding
+
+__all__ = [
+    "COLLECTIVE_PRIMITIVES",
+    "collective_counts",
+    "collective_eqn_paths",
+    "hlo_collective_counts",
+    "check_no_collectives",
+    "check_collective_multiset",
+    "expected_step_sync_collectives",
+]
+
+#: every cross-device communication primitive jax can trace today — the
+#: deferred steady step must contain NONE of them, at any nesting depth
+#: (formerly pinned inline in ``tests/engine/test_deferred_fast.py``)
+COLLECTIVE_PRIMITIVES = {
+    "psum", "psum2", "pmin", "pmax", "pmean", "ppermute", "pbroadcast",
+    "all_gather", "all_gather_invariant", "all_to_all", "reduce_scatter",
+}
+
+
+def collective_counts(jaxpr: Any) -> Dict[str, int]:
+    """Multiset of collective primitives anywhere in a (closed) jaxpr."""
+    from metrics_tpu.analysis.program import iter_eqns, unwrap_jaxpr
+
+    acc: Dict[str, int] = {}
+    for _, eqn in iter_eqns(unwrap_jaxpr(jaxpr)):
+        name = eqn.primitive.name
+        if name in COLLECTIVE_PRIMITIVES:
+            acc[name] = acc.get(name, 0) + 1
+    return acc
+
+
+def collective_eqn_paths(jaxpr: Any) -> List[Tuple[str, str]]:
+    """``(eqn_path, primitive_name)`` for every collective in the jaxpr."""
+    from metrics_tpu.analysis.program import iter_eqns, unwrap_jaxpr
+
+    return [
+        (path, eqn.primitive.name)
+        for path, eqn in iter_eqns(unwrap_jaxpr(jaxpr))
+        if eqn.primitive.name in COLLECTIVE_PRIMITIVES
+    ]
+
+
+def hlo_collective_counts(hlo_text: str) -> Dict[str, int]:
+    """Multiset of cross-chip collective ops in compiled HLO text, keyed by
+    the HLO op name (``all-reduce``, ``all-gather``, ...). The pattern is the
+    canonical ``parallel/collectives.py::HLO_COLLECTIVE_RE`` every placement
+    gate shares."""
+    from metrics_tpu.parallel.collectives import HLO_COLLECTIVE_RE
+
+    acc: Dict[str, int] = {}
+    for m in HLO_COLLECTIVE_RE.finditer(hlo_text):
+        acc[m.group(1)] = acc.get(m.group(1), 0) + 1
+    return acc
+
+
+def check_no_collectives(
+    jaxpr: Any = None, hlo_text: Optional[str] = None, where: str = ""
+) -> List[Finding]:
+    """Rule ``no-collectives-in-deferred-step``: a deferred-sync steady step
+    must be collective-free in its jaxpr (any nesting depth) and its compiled
+    HLO. Pass either or both artifacts."""
+    findings: List[Finding] = []
+    hint = (
+        "the deferred-sync contract moves ALL cross-chip traffic to the boundary "
+        "merge (parallel/embedded.py::sharded_state_merge); a collective here "
+        "reintroduces the per-step sync PR 5 removed — check that the update path "
+        "uses sharded_local_step and no metric code calls sync_states in-step"
+    )
+    if jaxpr is not None:
+        for path, name in collective_eqn_paths(jaxpr):
+            findings.append(Finding(
+                rule="no-collectives-in-deferred-step", severity="error",
+                where=where, path=path,
+                message=f"collective primitive {name!r} traced inside a deferred steady step",
+                hint=hint,
+            ))
+    if hlo_text is not None:
+        for op, n in sorted(hlo_collective_counts(hlo_text).items()):
+            findings.append(Finding(
+                rule="no-collectives-in-deferred-step", severity="error",
+                where=where, path=f"hlo:{op}",
+                message=f"compiled HLO contains {n}x {op} in a deferred steady step",
+                hint=hint,
+            ))
+    return findings
+
+
+def expected_step_sync_collectives(metric: Any) -> Dict[str, int]:
+    """The EXACT collective multiset a step-sync mesh step must trace, derived
+    from the metric's declared state reductions the same way
+    ``parallel/collectives.py::fused_axis_sync`` buckets them:
+
+    * all sum-rider-eligible 'sum' leaves share ONE ``psum``; the step's
+      valid-row token adds a second;
+    * 'mean'/'min'/'max' leaves cost one ``pmean``/``pmin``/``pmax`` per
+      (reduction, dtype) bucket;
+    * any 'cat'/None/custom (or rider-ineligible 'sum') leaf joins the single
+      u32-carrier ``all_gather``.
+
+    Raises ``ValueError`` for metrics with nested child metrics — their
+    states sync recursively with their own bundles, so the flat multiset
+    below would be wrong (audit those engines with the zero/nonzero rules
+    instead).
+    """
+    import jax.numpy as jnp
+
+    from metrics_tpu.parallel.collectives import _REDUCE_COLLECTIVES, _sum_rider
+
+    leaves = _state_reduction_leaves(metric)
+    counts: Dict[str, int] = {}
+    have_sum_bundle = False
+    reduce_buckets = set()
+    have_gather = False
+    for fx, dtype in leaves:
+        if fx == "sum" and dtype is not None and _sum_rider(jnp.dtype(dtype)) is not None:
+            have_sum_bundle = True
+        elif fx in _REDUCE_COLLECTIVES and fx != "sum":
+            reduce_buckets.add((fx, str(dtype)))
+        else:
+            have_gather = True
+    counts["psum"] = (1 if have_sum_bundle else 0) + 1  # fused bundle + token
+    for fx, _ in reduce_buckets:
+        name = {"mean": "pmean", "min": "pmin", "max": "pmax"}[fx]
+        counts[name] = counts.get(name, 0) + 1
+    if have_gather:
+        counts["all_gather"] = 1
+    return {k: v for k, v in counts.items() if v}
+
+
+def _state_reduction_leaves(metric: Any) -> List[Tuple[Any, Any]]:
+    """Flat ``(dist_reduce_fx, dtype)`` per top-level state leaf, mirroring
+    the leaves ``MetricCollection.sync_states``/``Metric.sync_states`` fuse."""
+    out: List[Tuple[Any, Any]] = []
+
+    def one(m: Any) -> None:
+        if m._child_metrics():
+            raise ValueError(
+                f"{type(m).__name__} has nested child metrics; the flat step-sync "
+                "multiset does not model their recursive sync bundles"
+            )
+        abs_state = m.abstract_state()
+        for k in m._defaults:
+            fx = m._reductions[k]
+            v = abs_state[k]
+            if isinstance(m._defaults[k], list):
+                out.append(("cat" if fx is None else fx, None))
+            else:
+                out.append((fx, getattr(v, "dtype", None)))
+
+    if hasattr(metric, "items") and not hasattr(metric, "_defaults"):
+        for _, m in metric.items(keep_base=True):
+            one(m)
+    else:
+        one(metric)
+    return out
+
+
+def check_collective_multiset(
+    jaxpr: Any, expected: Dict[str, int], where: str = ""
+) -> List[Finding]:
+    """Rule ``exact-collective-multiset-in-step-sync``: the step-sync steady
+    step's collective multiset must equal ``expected`` EXACTLY — a refactor
+    must neither fall back to per-state collectives (counts grow) nor drop a
+    reduction's merge (counts shrink: silent divergence across shards)."""
+    actual = collective_counts(jaxpr)
+    if actual == {k: v for k, v in expected.items() if v}:
+        return []
+    return [Finding(
+        rule="exact-collective-multiset-in-step-sync", severity="error",
+        where=where, path="",
+        message=(
+            f"step-sync step collective multiset is {actual or '{}'}, "
+            f"expected exactly {expected or '{}'}"
+        ),
+        hint=(
+            "more collectives than expected = the fused bundle degraded to "
+            "per-state sync (dispatch cost returns); fewer = a reduction's "
+            "cross-shard merge was dropped (shards silently diverge) — see "
+            "parallel/collectives.py::fused_axis_sync for the bundling contract"
+        ),
+    )]

@@ -1,0 +1,37 @@
+"""Compile-cap rule: the closed-program-set accounting, as a named check.
+
+The engine's serving contract (PR 2) is a CLOSED executable set: at most
+``len(buckets)`` update programs per payload structure, one compute program,
+plus one merge program under deferred sync. A program count above the cap
+means the steady state is re-tracing — the exact dispatch regression the AOT
+cache exists to prevent — usually via an unstable program key (identity
+objects in the signature, a drifting fingerprint) or payload structures
+nobody bucketed.
+"""
+from typing import List
+
+from metrics_tpu.analysis.core import Finding
+
+__all__ = ["check_compile_cap"]
+
+
+def check_compile_cap(
+    n_programs: int, cap: int, where: str = "", detail: str = ""
+) -> List[Finding]:
+    """Rule ``compile-cap``: ``n_programs`` compiled for one engine must not
+    exceed ``cap``."""
+    if n_programs <= cap:
+        return []
+    return [Finding(
+        rule="compile-cap", severity="error", where=where, path="",
+        message=(
+            f"engine owns {n_programs} compiled programs, cap is {cap}"
+            + (f" ({detail})" if detail else "")
+        ),
+        hint=(
+            "an open program set re-traces in the steady state: check for "
+            "unstable program-key inputs (object identity, un-latched host "
+            "attrs drifting the fingerprint) or payload structures outside the "
+            "bucket policy (engine/aot.py::AotCache.program_key)"
+        ),
+    )]

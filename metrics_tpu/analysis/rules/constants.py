@@ -1,0 +1,110 @@
+"""Baked-host-constant rule: trace constants must be covered by the fingerprint.
+
+The PR-3 AotCache collision class: a host-derived attribute (e.g.
+``Accuracy.mode``, latched from the first batch) becomes a TRACE CONSTANT of
+the compute program. If the attribute can change the trace while the metric
+FINGERPRINT (``engine/aot.py::metric_fingerprint`` — every program key's
+identity) stays the same, two engines serving different traffic through one
+shared cache exchange executables with the wrong constant baked in: same key,
+silently wrong value. Found by accident in PR 3; this rule finds it by
+construction — trace the program twice under perturbed host attrs and demand
+that any jaxpr drift comes with a fingerprint drift.
+"""
+import copy
+import enum
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from metrics_tpu.analysis.core import Finding
+
+__all__ = ["check_no_baked_host_constants", "default_attr_alternates"]
+
+
+def default_attr_alternates(value: Any) -> Sequence[Any]:
+    """Best-effort perturbations for one host attr value. Enums try every
+    other member (the real case: ``Accuracy.mode`` is a ``DataType``); bools
+    flip; ints/floats shift. Strings and exotic types yield nothing — a
+    caller who wants them perturbed must pass explicit alternates."""
+    if isinstance(value, enum.Enum):
+        return [m for m in type(value) if m != value]
+    if isinstance(value, bool):
+        return [not value]
+    if isinstance(value, int):
+        return [value + 1]
+    if isinstance(value, float):
+        return [value + 1.0]
+    return []
+
+
+def _default_trace(metric: Any) -> str:
+    """The compute program's jaxpr text — where host attrs bake in."""
+    import jax
+
+    abs_state = metric.abstract_state()
+    return str(jax.make_jaxpr(lambda s: metric.compute_from(s))(abs_state))
+
+
+def check_no_baked_host_constants(
+    metric: Any,
+    where: str = "",
+    alternates: Optional[Dict[str, Sequence[Any]]] = None,
+    trace: Optional[Callable[[Any], str]] = None,
+    fingerprint: Optional[Callable[[Any], str]] = None,
+) -> List[Finding]:
+    """Rule ``no-baked-host-constants``.
+
+    For every declared host-derived compute attribute
+    (``Metric.host_compute_attrs``) with a latched (non-None) value: deep-copy
+    the metric, perturb the attribute, and re-trace the program with a FRESH
+    closure. If the two traces differ (the attr IS a baked constant) while the
+    two fingerprints agree, that constant lives outside the program identity —
+    the PR-3 shared-cache collision — and the rule fires. Attributes no
+    alternate value can trace (invalid perturbations raise at trace time) are
+    skipped: unevaluated, not passed.
+    """
+    from metrics_tpu.engine.aot import metric_fingerprint
+
+    trace = trace or _default_trace
+    fingerprint = fingerprint or metric_fingerprint
+    attrs = metric.host_compute_attrs() if hasattr(metric, "host_compute_attrs") else {}
+    findings: List[Finding] = []
+    base_trace: Optional[str] = None
+    base_fp: Optional[str] = None
+    for path, value in sorted(attrs.items()):
+        if value is None:
+            continue  # unlatched: the engine's first-batch latch guards this
+        cands = list((alternates or {}).get(path, default_attr_alternates(value)))
+        for alt in cands:
+            perturbed = copy.deepcopy(metric)
+            perturbed.restore_host_compute_attrs({path: alt})
+            try:
+                alt_trace = trace(perturbed)
+            except Exception:  # noqa: BLE001 - invalid perturbation: try next
+                continue
+            if base_trace is None:
+                base = copy.deepcopy(metric)  # trace may mutate bookkeeping
+                base_trace = trace(base)
+                base_fp = fingerprint(metric)
+            if alt_trace == base_trace:
+                # THIS alternate happens to lower identically — it proves
+                # nothing about the others (a 3-member enum can trace A==B
+                # while C drifts); keep probing until one differs
+                continue
+            if fingerprint(perturbed) == base_fp:
+                findings.append(Finding(
+                    rule="no-baked-host-constants", severity="error",
+                    where=where, path=f"host_attr:{path}",
+                    message=(
+                        f"host attr {path!r} ({value!r} -> {alt!r}) changes the traced "
+                        "program but NOT the metric fingerprint — two engines sharing "
+                        "an AotCache would exchange executables with the wrong "
+                        "constant baked in"
+                    ),
+                    hint=(
+                        "store the attribute where engine/aot.py::metric_fingerprint "
+                        "hashes it (a plain instance attribute, not a skipped "
+                        "bookkeeping slot), and declare it in "
+                        "_host_derived_compute_attrs so snapshots carry it"
+                    ),
+                ))
+            break  # one trace-DIFFERING alternate settles this attr
+    return findings

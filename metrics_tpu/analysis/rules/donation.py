@@ -1,0 +1,72 @@
+"""Donation rule: declared donations must survive into the compiled HLO.
+
+``donate_argnums`` is a REQUEST: XLA silently drops any donation it cannot
+use (no same-shape/dtype output to alias, unsupported backend), and the step
+then allocates a second state copy per dispatch — the exact regression the
+arena + donation work of PR 3 exists to prevent, invisible today unless
+someone profiles allocations. The compiled module records what actually
+happened in its ``input_output_alias`` table; this rule diffs that table
+against the declaration.
+"""
+import re
+from typing import List, Set
+
+from metrics_tpu.analysis.core import Finding
+
+__all__ = ["parse_hlo_aliased_params", "check_donation_honored"]
+
+_ALIAS_HEADER = "input_output_alias={"
+# one alias entry: "{out_index}: (param_number, {param_index}[, kind])"
+_ALIAS_ENTRY_RE = re.compile(r":\s*\((\d+)\s*,")
+
+
+def parse_hlo_aliased_params(hlo_text: str) -> Set[int]:
+    """Parameter numbers the compiled module actually aliases to outputs.
+
+    Parses the ``input_output_alias={ {0}: (0, {}, may-alias), ... }`` table
+    in the HloModule header (balanced-brace scan — entries contain braces).
+    Empty set = XLA honored no donation at all.
+    """
+    start = hlo_text.find(_ALIAS_HEADER)
+    if start < 0:
+        return set()
+    i = start + len(_ALIAS_HEADER) - 1  # at the opening brace
+    depth = 0
+    for j in range(i, len(hlo_text)):
+        if hlo_text[j] == "{":
+            depth += 1
+        elif hlo_text[j] == "}":
+            depth -= 1
+            if depth == 0:
+                body = hlo_text[i + 1 : j]
+                return {int(m.group(1)) for m in _ALIAS_ENTRY_RE.finditer(body)}
+    return set()
+
+
+def check_donation_honored(
+    hlo_text: str, expected_donated: int, where: str = ""
+) -> List[Finding]:
+    """Rule ``donation-honored``: a program compiled with ``expected_donated``
+    donated input buffers must alias at least that many distinct parameters
+    to outputs in its HLO. Fires when XLA silently dropped some (or all) of
+    the donation — the state is then double-buffered on every step."""
+    if expected_donated <= 0:
+        return []
+    aliased = parse_hlo_aliased_params(hlo_text)
+    if len(aliased) >= expected_donated:
+        return []
+    return [Finding(
+        rule="donation-honored", severity="error", where=where,
+        path=f"hlo:input_output_alias({sorted(aliased)})",
+        message=(
+            f"{expected_donated} buffer(s) declared donated but compiled HLO "
+            f"aliases only {len(aliased)} parameter(s) — XLA dropped the rest"
+        ),
+        hint=(
+            "donation needs an output with identical shape/dtype(/sharding) for "
+            "each donated input; a changed carried-state layout, an added dtype "
+            "cast, or an unsupported backend silently reverts the step to "
+            "double-buffered state (docs/serving.md, 'State arenas': the "
+            "donation invariant)"
+        ),
+    )]

@@ -1,0 +1,81 @@
+"""Pallas-lowering rules: the fused-kernel invariants from PR 4.
+
+Under a Pallas backend the engine's update step must carry its fold/segment
+work INSIDE ``pallas_call`` kernels — one per state leaf for delta-strategy
+metrics — and the segmented multi-stream path must be scatter-free (the
+scatter-vs-compare-reduce tradeoff is the whole point of
+``ops/kernels/pallas_segment.py``). Formerly pinned ad hoc by
+``tests/ops/test_kernel_dispatch.py`` / ``test_kernel_attribution.py``.
+"""
+from typing import Any, List, Optional
+
+from metrics_tpu.analysis.core import Finding
+
+__all__ = ["check_no_scatter_under_pallas", "check_pallas_call_count"]
+
+
+def _scatter_paths(jaxpr: Any) -> List[str]:
+    from metrics_tpu.analysis.program import iter_eqns, unwrap_jaxpr
+
+    return [
+        f"{path}:{eqn.primitive.name}"
+        for path, eqn in iter_eqns(unwrap_jaxpr(jaxpr))
+        if eqn.primitive.name.startswith("scatter")
+    ]
+
+
+def check_no_scatter_under_pallas(jaxpr: Any, where: str = "") -> List[Finding]:
+    """Rule ``no-scatter-under-pallas``: a program traced under a Pallas
+    kernel backend must contain NO ``scatter*`` primitives at any depth —
+    the kernels replace the ``.at[ids].op`` scatters with VMEM-resident
+    compare-select reductions, and a surviving scatter means some update
+    path silently fell back or bypassed the dispatcher."""
+    return [
+        Finding(
+            rule="no-scatter-under-pallas", severity="error",
+            where=where, path=path,
+            message="scatter primitive traced in a Pallas-backend program",
+            hint=(
+                "route the update through ops/kernels (fold_rows_masked / "
+                "segment_reduce_masked / histogram_accumulate); if the input is "
+                "genuinely kernel-ineligible (dtype/shape), the engine should be "
+                "audited with its RESOLVED backend = xla instead"
+            ),
+        )
+        for path in _scatter_paths(jaxpr)
+    ]
+
+
+def check_pallas_call_count(
+    jaxpr: Any,
+    expected: Optional[int] = None,
+    min_count: Optional[int] = None,
+    where: str = "",
+) -> List[Finding]:
+    """Rule ``pallas-call-per-leaf``: the number of ``pallas_call`` eqns in a
+    kernel-backend program. ``expected`` pins an exact count (delta-strategy
+    metrics fold one kernel per state leaf); ``min_count`` asserts the kernel
+    path engaged at all (the engine audit's weaker form — eligibility rules
+    may legitimately route SOME leaves to XLA)."""
+    from metrics_tpu.analysis.program import primitive_counts
+
+    n = primitive_counts(jaxpr).get("pallas_call", 0)
+    hint = (
+        "a lower count means the kernel dispatch silently fell back (shape/dtype "
+        "eligibility, or the trace-cache closure-identity footgun reusing an XLA "
+        "trace); a higher count means per-leaf work split into extra kernels — "
+        "see ops/kernels/dispatch.py for the eligibility rules"
+    )
+    if expected is not None and n != expected:
+        return [Finding(
+            rule="pallas-call-per-leaf", severity="error", where=where, path="",
+            message=f"program traces {n} pallas_call eqns, expected exactly {expected}",
+            hint=hint,
+        )]
+    if min_count is not None and n < min_count:
+        return [Finding(
+            rule="pallas-call-per-leaf", severity="error", where=where, path="",
+            message=f"program traces {n} pallas_call eqns, expected at least {min_count}",
+            hint=hint,
+        )]
+    return []

@@ -1,0 +1,534 @@
+"""Source plane: an AST lint over ``metrics_tpu/`` for known trace hazards.
+
+Every rule here encodes a failure class this repo (or its reference) has
+actually hit — the lint is institutional memory, not style policing:
+
+* ``traced-python-branch`` — ``if``/``while`` on a value reachable from a
+  jit/vmap-traced parameter: a ``TracerBoolConversionError`` at best, one
+  branch silently baked into the compiled program at worst.
+* ``closure-identity-trace-cache`` — tracing the SAME function object under
+  two lowering-changing contexts (``use_backend``): JAX caches traces by
+  function identity + avals, so the second context reuses the first jaxpr
+  (the PR-4 footgun; build a fresh closure per context).
+* ``lock-discipline`` — the engine declares which attributes the dispatcher's
+  state lock guards (:data:`LOCK_SPECS`); mutating one outside
+  ``with self._state_lock`` (or outside a method declared lock-held) races a
+  step that DONATES the live buffers (the PR-3 ``reset_stream`` RMW race).
+* ``raise-tuple`` — multi-arg / tuple-literal raises render mangled tuple
+  messages (the PR-1 reference-inherited bug, generalized).
+* ``wallclock-in-jit`` — wall-clock or host-RNG calls inside jitted step
+  builders bake one trace-time value into every later execution.
+
+Suppress per line with ``# analysis: disable=rule-id -- reason`` (trailing
+the offending line, or a comment-only directive on the line above); the
+reason is required. Findings point at ``file:line``.
+"""
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, FrozenSet, Iterable, List, Optional, Sequence, Set, Tuple
+
+from metrics_tpu.analysis.core import Finding, Report, parse_suppressions
+
+__all__ = ["LOCK_SPECS", "LockSpec", "check_source_text", "check_source_tree"]
+
+# attribute reads that are STATIC metadata, legal to branch on under a trace
+_METADATA_ATTRS = {"shape", "ndim", "dtype", "size", "itemsize", "aval", "sharding"}
+# builtins whose result over a traced value is host-side metadata
+_METADATA_CALLS = {"isinstance", "hasattr", "getattr", "callable", "len", "type", "id"}
+# context managers that change how a function LOWERS without changing its identity
+_LOWERING_CTXS = {"use_backend", "kernel_fault_scope", "default_matmul_precision", "enable_x64"}
+# call heads that trace their callable argument
+_TRACE_HEADS = {"make_jaxpr", "jit", "op_costs", "trace_primitive_counts"}
+# wall-clock / host-RNG dotted-call prefixes (jax.random is fine: key-driven)
+_WALLCLOCK_PREFIXES = (
+    "time.time", "time.perf_counter", "time.monotonic", "time.process_time",
+    "datetime.datetime.now", "datetime.datetime.utcnow", "datetime.now",
+    "np.random.", "numpy.random.", "random.",
+)
+_MUTATOR_METHODS = {
+    "append", "appendleft", "extend", "clear", "pop", "popleft", "remove",
+    "add", "update", "insert", "discard", "setdefault",
+}
+
+
+@dataclass(frozen=True)
+class LockSpec:
+    """The declared lock discipline of one engine module."""
+
+    lock_attr: str
+    guarded: FrozenSet[str]
+    #: methods the call graph only reaches with the lock already held (the
+    #: lexical analysis cannot see callers); the ``*_locked`` naming
+    #: convention is recognized automatically on top of this list
+    locked_methods: FrozenSet[str]
+    exempt_methods: FrozenSet[str] = frozenset({"__init__"})
+
+
+_ENGINE_GUARDED = frozenset({
+    "_state", "_state_version", "_merged_memo", "_inflight",
+    "_step", "_batches_done", "_quarantine",
+})
+_ENGINE_LOCKED_METHODS = frozenset({
+    # lock taken by the caller: _process_group holds it across the whole
+    # group, result()/state()/stream_state() across merges and reads
+    "_do_step", "_recover_step", "_bound_inflight", "_execute_chunk",
+    "_execute_payload", "_merged_state", "_latch_host_attrs",
+    "_record_quarantine", "_screen_group",
+})
+
+#: path-suffix -> declared discipline. The analyzer applies the spec whose
+#: suffix matches the linted file; everything else skips the rule.
+LOCK_SPECS: Dict[str, LockSpec] = {
+    "engine/pipeline.py": LockSpec("_state_lock", _ENGINE_GUARDED, _ENGINE_LOCKED_METHODS),
+    "engine/multistream.py": LockSpec("_state_lock", _ENGINE_GUARDED, _ENGINE_LOCKED_METHODS),
+}
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'jax.jit' for Attribute chains rooted at a bare Name, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _call_head(node: ast.AST) -> Optional[str]:
+    """Last segment of a call's dotted callee ('jit' for jax.jit)."""
+    d = _dotted(node)
+    return d.rsplit(".", 1)[-1] if d else None
+
+
+def _is_jit_decorator(dec: ast.AST) -> bool:
+    d = _dotted(dec)
+    if d in ("jax.jit", "jit", "jax.vmap", "vmap"):
+        return True
+    if isinstance(dec, ast.Call):
+        head = _dotted(dec.func)
+        if head in ("jax.jit", "jit", "jax.vmap", "vmap"):
+            return True
+        if head in ("partial", "functools.partial") and dec.args:
+            return _dotted(dec.args[0]) in ("jax.jit", "jit", "jax.vmap", "vmap")
+    return False
+
+
+def _param_names(fn: ast.AST) -> Set[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return {n for n in names if n not in ("self", "cls")}
+
+
+def _static_params_from_call(call: ast.Call, fn: ast.AST) -> Set[str]:
+    """Parameter names a jit decoration/call declares STATIC — those are host
+    values, branchable at will (``static_argnames``/``static_argnums``)."""
+    out: Set[str] = set()
+    a = fn.args
+    positional = [p.arg for p in a.posonlyargs + a.args]
+    for kw in call.keywords:
+        vals = (
+            kw.value.elts if isinstance(kw.value, (ast.Tuple, ast.List)) else [kw.value]
+        )
+        consts = [v.value for v in vals if isinstance(v, ast.Constant)]
+        if kw.arg == "static_argnames":
+            out.update(str(c) for c in consts)
+        elif kw.arg == "static_argnums":
+            for c in consts:
+                if isinstance(c, int) and 0 <= c < len(positional):
+                    out.add(positional[c])
+    return out
+
+
+def _jit_target_functions(tree: ast.Module) -> List[Tuple[ast.AST, Set[str]]]:
+    """``(function, traced_param_names)`` for every function whose body runs
+    under a trace: decorated with jit/vmap, or passed BY NAME to
+    ``jax.jit``/``jax.vmap``/``jax.make_jaxpr``/``jax.shard_map``/``lax.scan``
+    anywhere in the module. Parameters declared static are excluded."""
+    traced_calls: Dict[str, List[ast.Call]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            head = _dotted(node.func)
+            tail = head.rsplit(".", 1)[-1] if head else None
+            if tail in ("jit", "vmap", "make_jaxpr", "shard_map", "scan", "fori_loop", "while_loop"):
+                for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                    if isinstance(arg, ast.Name):
+                        traced_calls.setdefault(arg.id, []).append(node)
+    out: List[Tuple[ast.AST, Set[str]]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        jit_calls: List[ast.Call] = []
+        is_target = False
+        for dec in node.decorator_list:
+            if _is_jit_decorator(dec):
+                is_target = True
+                if isinstance(dec, ast.Call):
+                    jit_calls.append(dec)
+        if node.name in traced_calls:
+            is_target = True
+            jit_calls.extend(traced_calls[node.name])
+        if not is_target:
+            continue
+        traced = _param_names(node)
+        for call in jit_calls:
+            traced -= _static_params_from_call(call, node)
+        out.append((node, traced))
+    return out
+
+
+def _traced_value_uses(node: ast.AST, traced: Set[str]) -> List[ast.Name]:
+    """Name nodes in an expression that read a traced value AS A VALUE —
+    metadata reads (``x.shape``/``x is None``/``isinstance(x, ...)``) are
+    host-side facts and excluded."""
+    if isinstance(node, ast.Name):
+        return [node] if node.id in traced else []
+    if isinstance(node, ast.Attribute):
+        return [] if node.attr in _METADATA_ATTRS else _traced_value_uses(node.value, traced)
+    if isinstance(node, ast.Call):
+        head = _call_head(node.func)
+        if head in _METADATA_CALLS:
+            return []
+        out: List[ast.Name] = []
+        for child in list(node.args) + [kw.value for kw in node.keywords]:
+            out.extend(_traced_value_uses(child, traced))
+        return out
+    if isinstance(node, ast.Compare) and all(
+        isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops
+    ):
+        return []
+    out = []
+    for child in ast.iter_child_nodes(node):
+        out.extend(_traced_value_uses(child, traced))
+    return out
+
+
+# ------------------------------------------------------------------ the rules
+
+
+def _rule_traced_branch(tree: ast.Module, filename: str) -> List[Finding]:
+    findings = []
+    for fn, traced in _jit_target_functions(tree):
+        if not traced:
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, (ast.If, ast.While)):
+                continue
+            uses = _traced_value_uses(node.test, traced)
+            if uses:
+                names = sorted({u.id for u in uses})
+                findings.append(Finding(
+                    rule="traced-python-branch", severity="error",
+                    where=f"{filename}:{node.lineno}",
+                    message=(
+                        f"Python {'if' if isinstance(node, ast.If) else 'while'} on "
+                        f"traced parameter(s) {names} of jitted function {fn.name!r}"
+                    ),
+                    hint=(
+                        "a traced value has no host truth value: branch with "
+                        "jnp.where/lax.cond/lax.select, or hoist the decision to a "
+                        "static (metadata) property — .shape/.dtype/is None are fine"
+                    ),
+                ))
+    return findings
+
+
+def _scope_walk(scope: ast.AST) -> Iterable[ast.AST]:
+    """Walk one scope WITHOUT descending into nested function bodies — each
+    function is its own scope, so shared with-blocks are never double-counted."""
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _rule_closure_identity(tree: ast.Module, filename: str) -> List[Finding]:
+    findings: List[Finding] = []
+    scopes: List[ast.AST] = [tree] + [
+        n for n in ast.walk(tree) if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    for scope in scopes:
+        withs: List[ast.With] = [n for n in _scope_walk(scope) if isinstance(n, ast.With)]
+        ctx_withs = [
+            w for w in withs
+            if any(
+                isinstance(item.context_expr, ast.Call)
+                and _call_head(item.context_expr.func) in _LOWERING_CTXS
+                for item in w.items
+            )
+        ]
+        if len(ctx_withs) < 2:
+            continue
+        ctx_withs.sort(key=lambda w: w.lineno)  # findings anchor on the RE-trace
+        seen: Dict[str, Tuple[ast.With, int]] = {}
+        for w in ctx_withs:
+            for node in ast.walk(w):
+                if not (isinstance(node, ast.Call) and _call_head(node.func) in _TRACE_HEADS):
+                    continue
+                for arg in node.args[:1]:
+                    if not isinstance(arg, ast.Name):
+                        continue  # lambdas / fresh closures are the fix, not the bug
+                    if _defined_inside(w, arg.id):
+                        continue
+                    prev = seen.get(arg.id)
+                    if prev is not None and prev[0] is not w:
+                        findings.append(Finding(
+                            rule="closure-identity-trace-cache", severity="warning",
+                            where=f"{filename}:{node.lineno}",
+                            message=(
+                                f"{arg.id!r} re-traced under a second lowering context "
+                                f"(first traced at line {prev[1]}): JAX caches traces by "
+                                "function identity + avals, so this reuses the FIRST "
+                                "context's jaxpr"
+                            ),
+                            hint=(
+                                "wrap in a fresh closure per context — "
+                                f"`lambda *a: {arg.id}(*a)` — or rebuild the function "
+                                "inside each `with` block (ops/kernels/dispatch.py "
+                                "documents the trace-cache caveat)"
+                            ),
+                        ))
+                    else:
+                        seen.setdefault(arg.id, (w, node.lineno))
+    return findings
+
+
+def _defined_inside(w: ast.With, name: str) -> bool:
+    for node in ast.walk(w):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and node.name == name:
+            return True
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == name:
+                    return True
+    return False
+
+
+def _rule_lock_discipline(tree: ast.Module, filename: str) -> List[Finding]:
+    spec = next(
+        (s for suffix, s in LOCK_SPECS.items() if filename.replace(os.sep, "/").endswith(suffix)),
+        None,
+    )
+    if spec is None:
+        return []
+    findings: List[Finding] = []
+    for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
+        for method in [n for n in cls.body if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]:
+            if (
+                method.name in spec.exempt_methods
+                or method.name in spec.locked_methods
+                or method.name.endswith("_locked")
+            ):
+                continue
+            findings.extend(_scan_mutations(method, spec, filename, in_lock=False))
+    return findings
+
+
+def _scan_mutations(
+    node: ast.AST, spec: LockSpec, filename: str, in_lock: bool
+) -> List[Finding]:
+    findings: List[Finding] = []
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue  # nested closures run later, under their caller's locking
+        if isinstance(child, ast.With):
+            holds = in_lock or any(
+                isinstance(item.context_expr, ast.Attribute)
+                and item.context_expr.attr == spec.lock_attr
+                and isinstance(item.context_expr.value, ast.Name)
+                and item.context_expr.value.id == "self"
+                for item in child.items
+            )
+            for inner in child.body:
+                findings.extend(_scan_mutations(inner, spec, filename, holds))
+            continue
+        if not in_lock:
+            guarded_hit = _guarded_mutation(child, spec.guarded)
+            if guarded_hit:
+                attr, kind = guarded_hit
+                findings.append(Finding(
+                    rule="lock-discipline", severity="error",
+                    where=f"{filename}:{child.lineno}",
+                    message=(
+                        f"lock-guarded attribute self.{attr} {kind} outside "
+                        f"`with self.{spec.lock_attr}`"
+                    ),
+                    hint=(
+                        "the dispatcher donates the live state buffers; an unlocked "
+                        "read-modify-write can interleave with a step and tear the "
+                        "arena — take the lock, or declare the method lock-held in "
+                        "analysis/source.py::LOCK_SPECS with a comment saying why"
+                    ),
+                ))
+        findings.extend(_scan_mutations(child, spec, filename, in_lock))
+    return findings
+
+
+def _guarded_mutation(node: ast.AST, guarded: FrozenSet[str]) -> Optional[Tuple[str, str]]:
+    def self_attr(t: ast.AST) -> Optional[str]:
+        if (
+            isinstance(t, ast.Attribute)
+            and isinstance(t.value, ast.Name)
+            and t.value.id == "self"
+            and t.attr in guarded
+        ):
+            return t.attr
+        return None
+
+    if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+        targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+        for t in targets:
+            elts = t.elts if isinstance(t, ast.Tuple) else [t]
+            for e in elts:
+                a = self_attr(e)
+                if a:
+                    return a, "assigned"
+                # self._state[...] = / self._quarantine[...] =
+                if isinstance(e, ast.Subscript):
+                    a = self_attr(e.value)
+                    if a:
+                        return a, "item-assigned"
+    if isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+        f = node.value.func
+        if isinstance(f, ast.Attribute) and f.attr in _MUTATOR_METHODS:
+            a = self_attr(f.value)
+            if a:
+                return a, f"mutated via .{f.attr}()"
+    return None
+
+
+def _rule_raise_tuple(tree: ast.Module, filename: str) -> List[Finding]:
+    findings = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Raise) and isinstance(node.exc, ast.Call)):
+            continue
+        bad = None
+        if len(node.exc.args) > 1:
+            bad = f"{len(node.exc.args)} positional args"
+        elif len(node.exc.args) == 1 and isinstance(node.exc.args[0], ast.Tuple):
+            bad = "a tuple literal argument"
+        if bad:
+            findings.append(Finding(
+                rule="raise-tuple", severity="error",
+                where=f"{filename}:{node.lineno}",
+                message=f"exception raised with {bad} — str(exc) renders a mangled tuple",
+                hint=(
+                    "join the pieces into ONE formatted string (the reference "
+                    "checks.py comma bug, fixed in PR 1: a wrapped long message "
+                    "left a stray comma between two string literals)"
+                ),
+            ))
+    return findings
+
+
+def _rule_wallclock(tree: ast.Module, filename: str) -> List[Finding]:
+    findings = []
+    for fn, _traced in _jit_target_functions(tree):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func)
+            if d is None:
+                continue
+            if any(
+                d == p or (p.endswith(".") and d.startswith(p)) for p in _WALLCLOCK_PREFIXES
+            ):
+                findings.append(Finding(
+                    rule="wallclock-in-jit", severity="error",
+                    where=f"{filename}:{node.lineno}",
+                    message=(
+                        f"host call {d}() inside jitted function {fn.name!r} — the value "
+                        "freezes at trace time and replays in every execution"
+                    ),
+                    hint=(
+                        "pass times/randomness in as arguments (or jax.random with an "
+                        "explicit key); host clocks and numpy RNG are trace-time "
+                        "constants inside a compiled program"
+                    ),
+                ))
+    return findings
+
+
+_SOURCE_RULES = (
+    _rule_traced_branch,
+    _rule_closure_identity,
+    _rule_lock_discipline,
+    _rule_raise_tuple,
+    _rule_wallclock,
+)
+
+
+# ---------------------------------------------------------------- the drivers
+
+
+def check_source_text(
+    source: str, filename: str = "<string>", rules: Optional[Iterable[Any]] = None
+) -> List[Finding]:
+    """Lint one file's text. Suppression directives are honored here, so every
+    caller (CLI, tests, sweeps) sees identical behavior; a directive missing
+    its reason surfaces as ``suppression-missing-reason``."""
+    tree = ast.parse(source, filename=filename)
+    findings: List[Finding] = []
+    for rule in rules or _SOURCE_RULES:
+        findings.extend(rule(tree, filename))
+    suppressions = parse_suppressions(source)
+    kept: List[Finding] = []
+    reasonless_reported: Set[int] = set()
+    for f in findings:
+        try:
+            line = int(f.where.rsplit(":", 1)[1])
+        except (IndexError, ValueError):
+            kept.append(f)
+            continue
+        entry = suppressions.get(line)
+        if entry is None or f.rule not in entry[0]:
+            kept.append(f)
+            continue
+        rules_listed, reason, directive_line = entry
+        if not reason:
+            kept.append(f)  # an unreasoned directive suppresses nothing
+            if directive_line not in reasonless_reported:
+                reasonless_reported.add(directive_line)
+                kept.append(Finding(
+                    rule="suppression-missing-reason", severity="error",
+                    where=f"{filename}:{directive_line}",
+                    message=(
+                        f"`# analysis: disable={','.join(rules_listed)}` has no "
+                        "`-- reason`"
+                    ),
+                    hint="suppressions document debt: say why this occurrence is safe",
+                ))
+    return kept
+
+
+def check_source_tree(root: str, package_rel: bool = True) -> Report:
+    """Lint every ``*.py`` under ``root`` (skipping caches); findings carry
+    repo-relative paths so baselining survives checkouts in different dirs."""
+    report = Report()
+    root = os.path.abspath(root)
+    rel_base = os.path.dirname(root) if package_rel else root
+    n_files = 0
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in sorted(dirnames) if d != "__pycache__"]
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            rel = os.path.relpath(path, rel_base).replace(os.sep, "/")
+            with open(path, encoding="utf-8") as fh:
+                source = fh.read()
+            try:
+                report.extend(check_source_text(source, filename=rel))
+            except SyntaxError as e:
+                report.note(f"{rel}: unparseable ({e})")
+            n_files += 1
+    report.note(f"source plane: {n_files} files linted under {os.path.basename(root)}/")
+    return report

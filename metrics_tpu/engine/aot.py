@@ -192,6 +192,13 @@ class AotCache:
     def __len__(self) -> int:
         return len(self._programs)
 
+    def program_keys(self) -> Tuple[Tuple, ...]:
+        """Snapshot of every compiled program's structural key — the
+        program-plane analyzer's accounting hook (``compile-cap`` attributes
+        a shared cache's programs to engines by fingerprint/mesh/sync)."""
+        with self._lock:
+            return tuple(self._programs)
+
     def count_hit(self) -> None:
         """Atomically count a cache hit served from an engine-local memo."""
         with self._lock:

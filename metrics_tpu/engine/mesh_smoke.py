@@ -29,9 +29,11 @@ NUM_DEVICES = 8
 
 
 def _collective_count(hlo_text: str) -> int:
-    from metrics_tpu.parallel.collectives import HLO_COLLECTIVE_RE
+    # the HLO collective walk lives once in the rule engine (which itself
+    # consumes the canonical parallel/collectives.py::HLO_COLLECTIVE_RE)
+    from metrics_tpu.analysis import hlo_collective_counts
 
-    return len(HLO_COLLECTIVE_RE.findall(hlo_text))
+    return sum(hlo_collective_counts(hlo_text).values())
 
 
 def _bootstrap() -> int:
@@ -136,9 +138,16 @@ def _impl() -> int:
     if def_compiles > len(buckets) + 2:  # update/bucket + merge + compute
         print(f"FAIL: deferred compiled {def_compiles} programs (cap {len(buckets) + 2})")
         ok = False
-    n_def = _collective_count(step_hlo(def_eng))
-    if n_def != 0:
-        print(f"FAIL: deferred steady step HLO carries {n_def} collectives (contract: 0)")
+    # the zero-collective side of the placement contract is the NAMED rule —
+    # same code path the CI analyzer runs (no-collectives-in-deferred-step)
+    from metrics_tpu.analysis import check_no_collectives
+
+    deferred_findings = check_no_collectives(
+        hlo_text=step_hlo(def_eng), where="mesh-smoke/deferred-step"
+    )
+    if deferred_findings:
+        for f in deferred_findings:
+            print(f"FAIL: {f.render()}")
         ok = False
 
     # scan/cat metric on mesh — deferred only; must match the 1-device engine

@@ -621,6 +621,40 @@ class StreamingEngine:
         a, kw = payload
         return self._metric.update_state_masked(state_tree, *a, mask=mask, **kw)
 
+    def _step_callable(self, payload_abs: Any, mask_abs: Any):
+        """The pure ``(state, payload, mask) -> (new_state, token)`` step body
+        for one payload signature — a FRESH closure per call (so two builds
+        under different kernel backends can never share a trace-cache entry).
+        :meth:`_build_update_program` jits/lowers/compiles it; the program-
+        plane analyzer (``metrics_tpu/analysis/program.py``) re-traces it to
+        a jaxpr when auditing a built engine's collective/scatter/arena
+        invariants. Trace under :meth:`_kernel_scope` either way — kernel
+        dispatch is a trace-time decision."""
+        mesh = self._cfg.mesh
+
+        if mesh is None:
+            def step(state, payload, mask):
+                tree = self._unpack(state)
+                new_tree = self._traced_update(tree, payload, mask)
+                return self._pack(new_tree), jnp.sum(mask.astype(jnp.int32))
+
+            return step
+
+        from metrics_tpu.parallel.embedded import sharded_local_step, sharded_masked_step
+
+        if self._deferred:
+            # collective-free shard-local step: each device folds its own rows
+            # into its own state row; merge happens at explicit boundaries
+            return sharded_local_step(
+                self._traced_update, mesh, self._cfg.axis, payload_abs, mask_abs,
+                state_template=self._abstract_state(),
+                unpack=self._unpack if self._layout is not None else None,
+                pack=self._pack if self._layout is not None else None,
+            )
+        return sharded_masked_step(
+            self._metric, mesh, self._cfg.axis, payload_abs, mask_abs, layout=self._layout
+        )
+
     def _build_update_program(self, payload_abs: Any, mask_abs: Any):
         """Compile ``(state, payload, mask) -> (new_state, token)``.
 
@@ -633,34 +667,11 @@ class StreamingEngine:
         and a donated buffer cannot be synced on). It doubles as a liveness
         cross-check in telemetry.
         """
-        mesh = self._cfg.mesh
-
-        if mesh is None:
-            def step(state, payload, mask):
-                tree = self._unpack(state)
-                new_tree = self._traced_update(tree, payload, mask)
-                return self._pack(new_tree), jnp.sum(mask.astype(jnp.int32))
-
-            jitted = jax.jit(step, donate_argnums=(0,) if self._donate else ())
+        step = self._step_callable(payload_abs, mask_abs)
+        jitted = jax.jit(step, donate_argnums=(0,) if self._donate else ())
+        if self._cfg.mesh is None:
             with self._kernel_scope():  # kernel dispatch happens at trace time
                 return jitted.lower(self._abstract_state(), payload_abs, mask_abs).compile()
-
-        from metrics_tpu.parallel.embedded import sharded_local_step, sharded_masked_step
-
-        if self._deferred:
-            # collective-free shard-local step: each device folds its own rows
-            # into its own state row; merge happens at explicit boundaries
-            sharded = sharded_local_step(
-                self._traced_update, mesh, self._cfg.axis, payload_abs, mask_abs,
-                state_template=self._abstract_state(),
-                unpack=self._unpack if self._layout is not None else None,
-                pack=self._pack if self._layout is not None else None,
-            )
-        else:
-            sharded = sharded_masked_step(
-                self._metric, mesh, self._cfg.axis, payload_abs, mask_abs, layout=self._layout
-            )
-        jitted = jax.jit(sharded, donate_argnums=(0,) if self._donate else ())
         n_rows = mask_abs.shape[0]
         batch_sh = self._batch_sharding()
         rep_sh = self._replicated_sharding()

@@ -12,6 +12,7 @@ timeshared virtual mesh is host noise, not signal (docs/benchmarking.md,
 """
 import json
 import math
+import os
 from typing import Any, Dict, List, Optional
 
 from metrics_tpu.engine.bucketing import BucketPolicy
@@ -287,5 +288,8 @@ class EngineStats:
         return json.dumps({"summary": self.summary(aot_stats), "recent_steps": self.recent()}, indent=2)
 
     def export(self, path: str, aot_stats: Optional[Dict[str, Any]] = None) -> None:
+        parent = os.path.dirname(os.path.abspath(path))
+        if parent:
+            os.makedirs(parent, exist_ok=True)
         with open(path, "w") as f:
             f.write(self.to_json(aot_stats))

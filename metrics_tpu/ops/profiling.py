@@ -133,6 +133,36 @@ def _cost_dot(eqn: Any) -> Tuple[float, Optional[Tuple[int, int, int]]]:
 _SUBJAXPR_TRIP_PARAMS = ("jaxpr", "call_jaxpr", "cond_jaxpr", "body_jaxpr")
 
 
+def eqn_subjaxprs(
+    eqn: Any, keys: Optional[Sequence[str]] = None
+) -> List[Tuple[str, Any]]:
+    """Every sub-jaxpr one equation carries, as ``(param_tag, jaxpr)`` pairs.
+
+    THE sub-program discovery primitive shared by the cost walk below and the
+    static-analysis rule engine (``metrics_tpu/analysis/program.py``): it sees
+    through ``pjit``/``custom_jvp`` (``jaxpr``/``call_jaxpr``), ``scan``/
+    ``while`` bodies, ``cond`` branches (tag ``branches[i]``) and
+    ``pallas_call`` kernel bodies (a raw ``Jaxpr`` under the ``jaxpr`` param),
+    normalizing ``ClosedJaxpr`` vs raw ``Jaxpr`` so callers always receive an
+    object with ``.eqns``. ``keys`` restricts discovery to specific param
+    names (the cost walk passes ``_SUBJAXPR_TRIP_PARAMS`` to keep its totals
+    pinned; the analysis walker passes None to miss nothing).
+    """
+    out: List[Tuple[str, Any]] = []
+    for key, val in eqn.params.items():
+        if keys is not None and key not in keys:
+            continue
+        vals = val if isinstance(val, (list, tuple)) else [val]
+        for j, v in enumerate(vals):
+            tag = f"{key}[{j}]" if isinstance(val, (list, tuple)) else key
+            inner = getattr(v, "jaxpr", None)
+            if inner is not None and hasattr(inner, "eqns"):
+                out.append((tag, inner))
+            elif hasattr(v, "eqns"):
+                out.append((tag, v))
+    return out
+
+
 def _walk(jaxpr: Any, prefix: str, out: List[OpCost], trip: float) -> None:
     for eqn in jaxpr.eqns:
         name = str(getattr(eqn.source_info, "name_stack", "") or "")
@@ -152,17 +182,13 @@ def _walk(jaxpr: Any, prefix: str, out: List[OpCost], trip: float) -> None:
                 candidates.append(rows)
             out.extend(max(candidates, key=lambda rows: sum(o.flops for o in rows)))
             continue
-        sub = []
-        for key, val in eqn.params.items():
-            if key in _SUBJAXPR_TRIP_PARAMS and val is not None:
-                sub.append((key, val))
+        sub = eqn_subjaxprs(eqn, keys=_SUBJAXPR_TRIP_PARAMS)
         if sub:
             # loop bodies execute `length` times when the trip count is static
             inner_trip = trip
             if kind == "scan":
                 inner_trip = trip * float(eqn.params.get("length", 1))
-            for _, v in sub:
-                inner = v.jaxpr if hasattr(v, "jaxpr") else v
+            for _, inner in sub:
                 _walk(inner, full, out, inner_trip)
             continue
 

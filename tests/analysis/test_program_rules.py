@@ -1,0 +1,367 @@
+"""Known-bad fixtures for every program-plane rule (ISSUE 7 acceptance).
+
+One deliberately-broken program per rule, asserting the rule FIRES with
+correct location info — the migrated pin sites prove equivalence exactly
+because these fixtures fail the same rules the pins now call — plus a
+passing twin per rule so the fixtures also document what "clean" means.
+"""
+import enum
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from metrics_tpu import Accuracy
+from metrics_tpu.analysis import (
+    check_arena_pack_fused,
+    check_collective_multiset,
+    check_compile_cap,
+    check_donation_honored,
+    check_no_baked_host_constants,
+    check_no_collectives,
+    check_no_scatter_under_pallas,
+    check_pallas_call_count,
+    collective_counts,
+    expected_step_sync_collectives,
+)
+from metrics_tpu.engine.arena import ArenaLayout
+from metrics_tpu.metric import Metric
+from metrics_tpu.ops.kernels import fold_rows_masked, use_backend
+
+
+def _mesh1():
+    return Mesh(np.asarray(jax.devices()[:1]), ("dp",))
+
+
+# ------------------------------------------- no-collectives-in-deferred-step
+
+
+def test_smuggled_psum_in_deferred_step_fires():
+    """A 'deferred' step body with one smuggled psum: the rule fires and the
+    eqn path names the collective inside the shard_map sub-jaxpr."""
+    mesh = _mesh1()
+
+    def bad_local_step(state, rows):
+        folded = state + jnp.sum(rows)
+        return jax.lax.psum(folded, "dp")  # the smuggled per-step sync
+
+    fn = jax.shard_map(
+        bad_local_step, mesh=mesh, in_specs=(P(), P("dp")), out_specs=P(), check_vma=False
+    )
+    jaxpr = jax.make_jaxpr(fn)(jnp.zeros(()), jnp.zeros((8,)))
+    findings = check_no_collectives(jaxpr=jaxpr, where="fixture/deferred")
+    assert [f.rule for f in findings] == ["no-collectives-in-deferred-step"]
+    assert "psum" in findings[0].path and "shard_map" in findings[0].path
+    assert findings[0].where == "fixture/deferred"
+
+    def good_local_step(state, rows):
+        return state + jnp.sum(rows)
+
+    fn = jax.shard_map(
+        good_local_step, mesh=mesh, in_specs=(P(), P("dp")), out_specs=P("dp"), check_vma=False
+    )
+    jaxpr = jax.make_jaxpr(fn)(jnp.zeros((1,)), jnp.zeros((8,)))
+    assert check_no_collectives(jaxpr=jaxpr) == []
+
+
+def test_hlo_collective_fires_on_text_plane():
+    hlo = 'ENTRY %main { %ar = f32[4] all-reduce(f32[4] %p0), replica_groups={} }'
+    findings = check_no_collectives(hlo_text=hlo, where="fixture/hlo")
+    assert [f.rule for f in findings] == ["no-collectives-in-deferred-step"]
+    assert findings[0].path == "hlo:all-reduce"
+    assert check_no_collectives(hlo_text="ENTRY %main { add(...) }") == []
+
+
+# ------------------------------------- exact-collective-multiset-in-step-sync
+
+
+def test_wrong_multiset_fires_with_both_directions():
+    mesh = _mesh1()
+
+    def step(state, rows):
+        # one psum only: the bundle is there but the token psum was dropped
+        return state + jax.lax.psum(jnp.sum(rows), "dp")
+
+    fn = jax.shard_map(step, mesh=mesh, in_specs=(P(), P("dp")), out_specs=P(), check_vma=False)
+    jaxpr = jax.make_jaxpr(fn)(jnp.zeros(()), jnp.zeros((8,)))
+    assert collective_counts(jaxpr) == {"psum": 1}
+    findings = check_collective_multiset(jaxpr, {"psum": 2}, where="fixture/step-sync")
+    assert [f.rule for f in findings] == ["exact-collective-multiset-in-step-sync"]
+    assert "psum" in findings[0].message
+    # exact match passes
+    assert check_collective_multiset(jaxpr, {"psum": 1}) == []
+
+
+def test_expected_multiset_derivation_refuses_child_metrics():
+    class _Parent(Metric):
+        def __init__(self):
+            super().__init__()
+            self.add_state("n", jnp.zeros(()), dist_reduce_fx="sum")
+            self.inner = Accuracy()  # nested child metric
+
+        def update(self, *a):  # pragma: no cover - structural fixture
+            pass
+
+        def compute(self):  # pragma: no cover - structural fixture
+            return self.n
+
+    with pytest.raises(ValueError, match="nested child metrics"):
+        expected_step_sync_collectives(_Parent())
+
+
+# ----------------------------------------------------- no-scatter-under-pallas
+
+
+def test_scatter_beside_kernel_fires_with_path():
+    state = jnp.zeros((4,), jnp.float32)
+    rows = jnp.ones((8, 4), jnp.float32)
+    mask = jnp.ones((8,), bool)
+    ids = jnp.asarray([0, 1, 0, 1, 0, 1, 0, 1], jnp.int32)
+
+    def bad(s, r, m):
+        folded = fold_rows_masked(s, r, m, "sum")  # kernel path
+        return folded.at[ids].add(1.0)  # ...and a smuggled scatter
+
+    with use_backend("pallas_interpret"):
+        jaxpr = jax.make_jaxpr(lambda *a: bad(*a))(state, rows, mask)
+    findings = check_no_scatter_under_pallas(jaxpr, where="fixture/pallas")
+    assert [f.rule for f in findings] == ["no-scatter-under-pallas"]
+    assert "scatter" in findings[0].path
+
+    def good(s, r, m):
+        return fold_rows_masked(s, r, m, "sum")
+
+    with use_backend("pallas_interpret"):
+        jaxpr = jax.make_jaxpr(lambda *a: good(*a))(state, rows, mask)
+    assert check_no_scatter_under_pallas(jaxpr) == []
+
+
+# --------------------------------------------------------- pallas-call-per-leaf
+
+
+def test_pallas_call_count_exact_and_min():
+    state = jnp.zeros((4,), jnp.float32)
+    rows = jnp.ones((8, 4), jnp.float32)
+    mask = jnp.ones((8,), bool)
+
+    def one_leaf(s, r, m):
+        return fold_rows_masked(s, r, m, "sum")
+
+    with use_backend("pallas_interpret"):
+        jaxpr = jax.make_jaxpr(lambda *a: one_leaf(*a))(state, rows, mask)
+    # a two-leaf metric whose trace carries ONE kernel = a leaf fell back
+    findings = check_pallas_call_count(jaxpr, expected=2, where="fixture/kcount")
+    assert [f.rule for f in findings] == ["pallas-call-per-leaf"]
+    assert "expected exactly 2" in findings[0].message
+    assert check_pallas_call_count(jaxpr, expected=1) == []
+    assert check_pallas_call_count(jaxpr, min_count=1) == []
+    with use_backend("xla"):
+        jaxpr = jax.make_jaxpr(lambda *a: one_leaf(*a))(state, rows, mask)
+    assert check_pallas_call_count(jaxpr, min_count=1, where="f") != []
+
+
+# ------------------------------------------------------------ donation-honored
+
+
+def test_donation_silently_dropped_by_xla_fires():
+    """A REAL declined donation: the donated f32[4] input has no same-shaped
+    output to alias, so XLA drops it and the HLO records no alias — the
+    invisible regression the rule exists for."""
+    import warnings
+
+    def no_alias(s, x):
+        return x.sum()  # donated s has no matching output
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        dropped = (
+            jax.jit(no_alias, donate_argnums=(0,))
+            .lower(jnp.zeros((4,)), jnp.ones((8,)))
+            .compile()
+        )
+    findings = check_donation_honored(dropped.as_text(), 1, where="fixture/donate")
+    assert [f.rule for f in findings] == ["donation-honored"]
+    assert "aliases only 0" in findings[0].message
+
+    def aliased(s, x):
+        return s + x.sum(), x.mean()
+
+    honored = (
+        jax.jit(aliased, donate_argnums=(0,))
+        .lower(jnp.zeros((4,)), jnp.ones((8,)))
+        .compile()
+    )
+    assert check_donation_honored(honored.as_text(), 1) == []
+
+
+# ----------------------------------------------------- no-baked-host-constants
+
+
+class _Mode(enum.Enum):
+    A = "a"
+    B = "b"
+
+
+class _LeakyModeMetric(Metric):
+    """The PR-3 collision class, reconstructed: ``mode`` is declared as a
+    host-derived compute attr and CHANGES the compute trace, but it is
+    stored in ``_cache`` — a bookkeeping slot ``metric_fingerprint``
+    deliberately skips — so two differently-latched instances share one
+    fingerprint (and would share one wrong executable in an AotCache)."""
+
+    _host_derived_compute_attrs = ("mode",)
+
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+        self._cache = {"mode": _Mode.A}
+
+    @property
+    def mode(self):
+        return self._cache["mode"]
+
+    @mode.setter
+    def mode(self, v):
+        self._cache["mode"] = v
+
+    def update(self, x):
+        self.total = self.total + jnp.sum(x)
+
+    def compute(self):
+        # the baked constant: a different per-mode scale traces differently
+        return self.total * (2.0 if self.mode is _Mode.A else 3.0)
+
+
+class _CoveredModeMetric(_LeakyModeMetric):
+    """Same behavior, attr stored where the fingerprint hashes it — clean."""
+
+    def __init__(self):
+        super().__init__()
+        del self._cache
+        self._mode_attr = _Mode.A
+
+    @property
+    def mode(self):
+        return self._mode_attr
+
+    @mode.setter
+    def mode(self, v):
+        self._mode_attr = v
+
+
+def test_baked_constant_outside_fingerprint_fires():
+    findings = check_no_baked_host_constants(_LeakyModeMetric(), where="fixture/leaky")
+    assert [f.rule for f in findings] == ["no-baked-host-constants"]
+    assert findings[0].path == "host_attr:mode"
+    assert "fingerprint" in findings[0].message
+
+
+class _ThreeMode(enum.Enum):
+    A = "a"
+    B = "b"
+    C = "c"
+
+
+class _LateDriftMetric(_LeakyModeMetric):
+    """Regression: the FIRST alternate (B) traces identically to A — only the
+    LATER alternate (C) exposes the baked constant. The rule must keep
+    probing past identically-tracing alternates instead of concluding the
+    attr is unbaked from one sample."""
+
+    def __init__(self):
+        super().__init__()
+        self._cache = {"mode": _ThreeMode.A}
+
+    def compute(self):
+        # A and B share a lowering; C drifts
+        return self.total * (2.0 if self.mode in (_ThreeMode.A, _ThreeMode.B) else 3.0)
+
+
+def test_baked_constant_exposed_only_by_a_later_alternate_still_fires():
+    findings = check_no_baked_host_constants(_LateDriftMetric(), where="fixture/late")
+    assert [f.rule for f in findings] == ["no-baked-host-constants"]
+    assert findings[0].path == "host_attr:mode"
+
+
+def test_fingerprint_covered_constant_passes():
+    assert check_no_baked_host_constants(_CoveredModeMetric()) == []
+    # the real engine metric: Accuracy's latched mode IS fingerprint-covered
+    acc = Accuracy()
+    acc.update(jnp.asarray([0.2, 0.8]), jnp.asarray([0, 1]))
+    assert check_no_baked_host_constants(acc) == []
+
+
+# ------------------------------------------------------------- arena-pack-fused
+
+
+def _two_leaf_layout():
+    abs_state = {
+        "a": jax.ShapeDtypeStruct((3,), jnp.float32),
+        "b": jax.ShapeDtypeStruct((5,), jnp.float32),
+    }
+    return ArenaLayout.for_state(abs_state), abs_state
+
+
+def test_per_leaf_arena_writes_fire():
+    layout, _ = _two_leaf_layout()
+
+    def bad_pack(arena, rows):
+        tree = layout.unpack(arena)
+        new = {k: v + jnp.sum(rows) for k, v in tree.items()}
+        # the degraded pack: one .at[].set per leaf into the 1-D buffer
+        buf = jnp.zeros((8,), jnp.float32)
+        buf = buf.at[0:3].set(new["a"])
+        buf = buf.at[3:8].set(new["b"])
+        return {"float32": buf}
+
+    jaxpr = jax.make_jaxpr(bad_pack)({"float32": jnp.zeros((8,))}, jnp.ones((4,)))
+    findings = check_arena_pack_fused(jaxpr, layout, where="fixture/arena", state_leaves=1)
+    assert {f.rule for f in findings} == {"arena-pack-fused"}
+    assert len(findings) == 2  # one per per-leaf write
+    assert all("(8,):float32" in f.message for f in findings)
+
+    def good_pack(arena, rows):
+        tree = layout.unpack(arena)
+        new = {k: v + jnp.sum(rows) for k, v in tree.items()}
+        return layout.pack(new)
+
+    jaxpr = jax.make_jaxpr(good_pack)({"float32": jnp.zeros((8,))}, jnp.ones((4,)))
+    assert check_arena_pack_fused(jaxpr, layout, state_leaves=1) == []
+
+
+def test_carried_state_copy_fires_but_constant_copy_does_not():
+    layout, _ = _two_leaf_layout()
+
+    def bad_copy(arena, rows):
+        tree = layout.unpack(arena)
+        # a materialized per-leaf clone of the CARRIED state
+        cloned = {k: jnp.array(v, copy=True) for k, v in tree.items()}
+        return layout.pack({k: v + jnp.sum(rows) for k, v in cloned.items()})
+
+    jaxpr = jax.make_jaxpr(bad_copy)({"float32": jnp.zeros((8,))}, jnp.ones((4,)))
+    findings = check_arena_pack_fused(jaxpr, layout, where="fixture/copy", state_leaves=1)
+    assert [f.rule for f in findings] == ["arena-pack-fused", "arena-pack-fused"]
+    assert all("copy" in f.path for f in findings)
+
+    def constant_copy(arena, rows):
+        tree = layout.unpack(arena)
+        # init_state-style defensive copy of a CONSTANT default: benign,
+        # XLA folds it — the taint walk must not flag it
+        fresh = jnp.array(jnp.zeros((3,)), copy=True)
+        return layout.pack({"a": tree["a"] + fresh, "b": tree["b"] + jnp.sum(rows)})
+
+    jaxpr = jax.make_jaxpr(constant_copy)({"float32": jnp.zeros((8,))}, jnp.ones((4,)))
+    assert check_arena_pack_fused(jaxpr, layout, state_leaves=1) == []
+
+
+# ------------------------------------------------------------------ compile-cap
+
+
+def test_compile_cap_fires_over_and_passes_at():
+    findings = check_compile_cap(5, 3, where="fixture/cap", detail="1 bucket + compute")
+    assert [f.rule for f in findings] == ["compile-cap"]
+    assert "owns 5" in findings[0].message and "cap is 3" in findings[0].message
+    assert check_compile_cap(3, 3) == []

@@ -1,0 +1,282 @@
+"""Known-bad source snippets for every source-plane lint rule, plus the
+suppression contract (reason required) and the no-false-positive sweep over
+the real package tree."""
+import textwrap
+
+import pytest
+
+from metrics_tpu.analysis import check_source_text, check_source_tree
+from metrics_tpu.analysis.source import LOCK_SPECS
+
+
+def _lint(src, filename="snippet.py"):
+    return check_source_text(textwrap.dedent(src), filename=filename)
+
+
+# ------------------------------------------------------- traced-python-branch
+
+
+def test_if_on_traced_param_fires_with_line():
+    findings = _lint(
+        """
+        import jax
+
+        @jax.jit
+        def f(x):
+            if x > 0:            # line 6
+                return x
+            return -x
+        """
+    )
+    assert [(f.rule, f.where) for f in findings] == [
+        ("traced-python-branch", "snippet.py:6")
+    ]
+    assert "'f'" in findings[0].message
+
+
+def test_while_on_param_passed_to_jit_by_name_fires():
+    findings = _lint(
+        """
+        import jax
+
+        def step(carry):
+            while carry:         # line 5
+                carry = carry - 1
+            return carry
+
+        compiled = jax.jit(step)
+        """
+    )
+    assert [(f.rule, f.where) for f in findings] == [
+        ("traced-python-branch", "snippet.py:5")
+    ]
+
+
+def test_metadata_branches_and_statics_do_not_fire():
+    findings = _lint(
+        """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("kind",))
+        def f(x, axis=None, *, kind="sum"):
+            if kind == "sum":        # static: fine
+                y = x + 1
+            if x.ndim > 1:           # metadata: fine
+                y = x.sum(0)
+            if axis is None:         # is-None: fine
+                return y
+            if isinstance(x, tuple): # isinstance: fine
+                return y
+            if len(x.shape) == 2:    # len of metadata: fine
+                return y
+            return y
+        """
+    )
+    assert findings == []
+
+
+# ------------------------------------------------ closure-identity-trace-cache
+
+
+def test_same_closure_under_two_backends_fires():
+    findings = _lint(
+        """
+        import jax
+        from metrics_tpu.ops.kernels import use_backend
+
+        def probe(fn, args):
+            with use_backend("xla"):
+                a = jax.make_jaxpr(fn)(*args)
+            with use_backend("pallas_interpret"):
+                b = jax.make_jaxpr(fn)(*args)   # line 9: reuses a's trace
+            return a, b
+        """
+    )
+    assert [(f.rule, f.where) for f in findings] == [
+        ("closure-identity-trace-cache", "snippet.py:9")
+    ]
+    assert "function identity" in findings[0].message
+
+
+def test_fresh_closure_per_backend_passes():
+    findings = _lint(
+        """
+        import jax
+        from metrics_tpu.ops.kernels import use_backend
+
+        def probe(fn, args):
+            with use_backend("xla"):
+                a = jax.make_jaxpr(lambda *x: fn(*x))(*args)
+            with use_backend("pallas_interpret"):
+                b = jax.make_jaxpr(lambda *x: fn(*x))(*args)
+            return a, b
+
+        def rebuilt(build, args):
+            with use_backend("xla"):
+                f1 = build()
+                a = jax.make_jaxpr(f1)(*args)
+            with use_backend("pallas_interpret"):
+                f2 = build()
+                b = jax.make_jaxpr(f2)(*args)   # f2 defined INSIDE the block
+            return a, b
+        """
+    )
+    assert findings == []
+
+
+# --------------------------------------------------------------- lock-discipline
+
+
+def test_unlocked_guarded_write_fires_in_engine_modules_only():
+    src = """
+    class StreamingEngine:
+        def poke(self):
+            self._cursor = 0          # not a guarded attr: fine anywhere
+            self._state = None        # line 5: guarded, unlocked
+            self._inflight.clear()    # line 6: guarded mutator, unlocked
+
+        def locked_poke(self):
+            with self._state_lock:
+                self._state = None    # locked: fine
+                self._step += 1
+
+        def _do_step(self):
+            self._state = None        # declared lock-held method: fine
+    """
+    findings = check_source_text(
+        textwrap.dedent(src), filename="metrics_tpu/engine/pipeline.py"
+    )
+    assert [(f.rule, f.where.rsplit(":", 1)[1]) for f in findings] == [
+        ("lock-discipline", "5"),
+        ("lock-discipline", "6"),
+    ]
+    # the same text outside the declared modules lints clean
+    assert check_source_text(textwrap.dedent(src), filename="metrics_tpu/other.py") == []
+
+
+def test_lock_spec_declares_the_real_discipline():
+    spec = LOCK_SPECS["engine/pipeline.py"]
+    assert spec.lock_attr == "_state_lock"
+    assert "_state" in spec.guarded and "_batches_done" in spec.guarded
+    assert "_do_step" in spec.locked_methods
+
+
+# ------------------------------------------------------------------ raise-tuple
+
+
+def test_multi_arg_and_tuple_literal_raises_fire():
+    findings = _lint(
+        """
+        def f(cond):
+            if cond:
+                raise ValueError("The preds should match,", " got mismatch")
+            raise TypeError(("part one,", " part two"))
+        """
+    )
+    assert [f.rule for f in findings] == ["raise-tuple", "raise-tuple"]
+    assert sorted(f.where for f in findings) == ["snippet.py:4", "snippet.py:5"]
+    assert _lint('def f():\n    raise ValueError("one formatted string")\n') == []
+
+
+# -------------------------------------------------------------- wallclock-in-jit
+
+
+def test_wallclock_and_host_rng_in_jit_fire():
+    findings = _lint(
+        """
+        import time, random
+        import numpy as np
+        import jax
+
+        @jax.jit
+        def step(s, x):
+            t = time.perf_counter()          # line 8
+            noise = np.random.rand()         # line 9
+            jitter = random.random()         # line 10
+            key = jax.random.PRNGKey(0)      # fine: functional RNG
+            return s + x * noise + t + jitter
+        """
+    )
+    assert [(f.rule, f.where.rsplit(":", 1)[1]) for f in findings] == [
+        ("wallclock-in-jit", "8"),
+        ("wallclock-in-jit", "9"),
+        ("wallclock-in-jit", "10"),
+    ]
+
+
+def test_wallclock_outside_jit_is_fine():
+    assert _lint(
+        """
+        import time
+
+        def host_loop():
+            return time.perf_counter()
+        """
+    ) == []
+
+
+# ------------------------------------------------------------------ suppressions
+
+
+def test_suppression_with_reason_silences_one_line():
+    findings = _lint(
+        """
+        def f():
+            # analysis: disable=raise-tuple -- fixture exercising the mangled repr
+            raise ValueError("a,", "b")
+        """
+    )
+    assert findings == []
+
+
+def test_suppression_without_reason_is_itself_a_finding():
+    findings = _lint(
+        """
+        def f():
+            raise ValueError("a,", "b")  # analysis: disable=raise-tuple
+        """
+    )
+    assert sorted(f.rule for f in findings) == [
+        "raise-tuple", "suppression-missing-reason"
+    ]
+
+
+def test_trailing_suppression_covers_only_its_own_line():
+    """Regression: a directive trailing a statement must not also swallow an
+    independent violation on the NEXT line (only comment-only directive
+    lines reach forward)."""
+    findings = _lint(
+        """
+        def f():
+            raise ValueError("a,", "b")  # analysis: disable=raise-tuple -- known fixture
+            raise ValueError("c,", "d")
+        """
+    )
+    assert [(f.rule, f.where) for f in findings] == [("raise-tuple", "snippet.py:4")]
+
+
+def test_suppression_of_a_different_rule_does_not_silence():
+    findings = _lint(
+        """
+        def f():
+            # analysis: disable=wallclock-in-jit -- wrong rule named
+            raise ValueError("a,", "b")
+        """
+    )
+    assert [f.rule for f in findings] == ["raise-tuple"]
+
+
+# ---------------------------------------------------------- no-false-positives
+
+
+def test_real_package_tree_lints_clean():
+    """The whole-tree sweep: the shipped source carries zero findings (the
+    gate's baseline is empty — debt-free by construction)."""
+    import os
+
+    import metrics_tpu
+
+    root = os.path.dirname(metrics_tpu.__file__)
+    report = check_source_tree(root)
+    assert report.findings == [], report.render()

@@ -55,17 +55,15 @@ def test_no_multi_arg_raises_anywhere_in_package():
     """AST audit of every raise site in metrics_tpu: one positional arg only.
 
     The comma pattern is easy to reintroduce when wrapping long messages, and
-    nothing else catches it (the exception still raises, just mangled).
+    nothing else catches it (the exception still raises, just mangled). The
+    walk now lives in the source-plane rule engine as ``raise-tuple``
+    (metrics_tpu/analysis/source.py) — also catching the single-tuple-literal
+    spelling — and this audit runs that rule over the whole package, same
+    coverage as the former inline walk.
     """
+    from metrics_tpu.analysis import check_source_tree
+
     pkg_root = pathlib.Path(metrics_tpu.__file__).parent
-    offenders = []
-    for path in sorted(pkg_root.rglob("*.py")):
-        tree = ast.parse(path.read_text(), filename=str(path))
-        for node in ast.walk(tree):
-            if (
-                isinstance(node, ast.Raise)
-                and isinstance(node.exc, ast.Call)
-                and len(node.exc.args) > 1
-            ):
-                offenders.append(f"{path.relative_to(pkg_root)}:{node.lineno}")
+    report = check_source_tree(str(pkg_root))
+    offenders = [f.where for f in report.findings if f.rule == "raise-tuple"]
     assert not offenders, f"multi-arg raise sites (tuple-message bug): {offenders}"

@@ -22,32 +22,21 @@ from metrics_tpu import (
     MinMetric,
     SumMetric,
 )
+from metrics_tpu.analysis import (
+    check_collective_multiset,
+    check_no_collectives,
+    collective_counts,
+    expected_step_sync_collectives,
+)
 from metrics_tpu.engine import EngineConfig, MultiStreamEngine, StreamingEngine
 from metrics_tpu.engine.arena import ArenaLayout
 from metrics_tpu.utils.exceptions import MetricsTPUUserError
 
-# every cross-device communication primitive jax can trace today — the
-# deferred steady step must contain NONE of them, at any nesting depth
-COLLECTIVE_PRIMITIVES = {
-    "psum", "psum2", "pmin", "pmax", "pmean", "ppermute", "pbroadcast",
-    "all_gather", "all_gather_invariant", "all_to_all", "reduce_scatter",
-}
-
-
-def collective_counts(jaxpr, acc=None):
-    """Recursively count collective primitives in a (closed) jaxpr."""
-    if acc is None:
-        acc = {}
-    for eqn in jaxpr.eqns:
-        if eqn.primitive.name in COLLECTIVE_PRIMITIVES:
-            acc[eqn.primitive.name] = acc.get(eqn.primitive.name, 0) + 1
-        for v in eqn.params.values():
-            for x in v if isinstance(v, (list, tuple)) else [v]:
-                if hasattr(x, "jaxpr"):
-                    collective_counts(x.jaxpr, acc)
-                elif hasattr(x, "eqns"):
-                    collective_counts(x, acc)
-    return acc
+# the collective walk/multiset logic lives ONCE in the rule engine now
+# (metrics_tpu/analysis/rules/collectives.py — the named rules
+# no-collectives-in-deferred-step / exact-collective-multiset-in-step-sync);
+# these tests keep their names and coverage, calling the rules instead of
+# the former inline COLLECTIVE_PRIMITIVES set + recursive counter.
 
 
 def _mesh(n=None):
@@ -101,20 +90,21 @@ def _traced_step_jaxpr(metric, mesh, mesh_sync, n_rows=16, payload_abs=None, **c
 def test_deferred_steady_step_has_zero_collectives():
     """THE deferred-sync contract, pinned at the jaxpr level on the full
     8-device mesh: no psum/pmin/pmax/all_gather/... anywhere in the steady
-    step — a refactor reintroducing a per-step collective fails here."""
+    step — a refactor reintroducing a per-step collective fails here (via
+    the ``no-collectives-in-deferred-step`` rule)."""
     coll = MetricCollection([Accuracy(), MeanSquaredError()])
     jaxpr = _traced_step_jaxpr(coll, _mesh(), "deferred")
-    assert collective_counts(jaxpr.jaxpr) == {}
+    assert check_no_collectives(jaxpr=jaxpr, where="deferred-step") == []
     # min/max-reduction states (single-value aggregator traffic) too
     agg = MetricCollection([MinMetric(), MaxMetric()])
     payload = ((jax.ShapeDtypeStruct((16,), jnp.float32),), {})
     jaxpr = _traced_step_jaxpr(agg, _mesh(), "deferred", payload_abs=payload)
-    assert collective_counts(jaxpr.jaxpr) == {}
+    assert check_no_collectives(jaxpr=jaxpr, where="deferred-agg-step") == []
 
 
 def test_deferred_scan_member_step_has_zero_collectives():
     jaxpr = _traced_step_jaxpr(AUROC(capacity=64), _mesh(), "deferred")
-    assert collective_counts(jaxpr.jaxpr) == {}
+    assert check_no_collectives(jaxpr=jaxpr, where="deferred-scan-step") == []
 
 
 def test_step_sync_step_has_exactly_the_fused_collective_set():
@@ -122,17 +112,22 @@ def test_step_sync_step_has_exactly_the_fused_collective_set():
     the token psum + at most one collective per extra (reduction, dtype):
     for sum+min+max f32 states that is exactly {psum: 2, pmin: 1, pmax: 1}
     — pinned so a refactor can't silently fall back to per-state
-    collectives (or grow the per-step bundle)."""
+    collectives (or grow the per-step bundle). The expected multiset is the
+    rule engine's own derivation, cross-checked here against the literal."""
     agg = MetricCollection([MinMetric(), MaxMetric(), SumMetric()])
+    expected = expected_step_sync_collectives(agg)
+    assert expected == {"psum": 2, "pmin": 1, "pmax": 1}
     payload = ((jax.ShapeDtypeStruct((16,), jnp.float32),), {})
     jaxpr = _traced_step_jaxpr(agg, _mesh(), "step", payload_abs=payload)
-    assert collective_counts(jaxpr.jaxpr) == {"psum": 2, "pmin": 1, "pmax": 1}
+    assert check_collective_multiset(jaxpr, expected, where="step-sync-agg") == []
 
 
 def test_step_sync_sum_only_collection_is_one_bundle_plus_token():
     coll = MetricCollection([Accuracy(), MeanSquaredError()])
+    expected = expected_step_sync_collectives(coll)
+    assert expected == {"psum": 2}
     jaxpr = _traced_step_jaxpr(coll, _mesh(), "step")
-    assert collective_counts(jaxpr.jaxpr) == {"psum": 2}
+    assert check_collective_multiset(jaxpr, expected, where="step-sync-sum") == []
 
 
 def test_deferred_merge_program_carries_the_collectives():
@@ -151,7 +146,7 @@ def test_deferred_merge_program_carries_the_collectives():
     state_abs = jax.tree.map(
         lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype), eng._abstract_state()
     )
-    counts = collective_counts(jax.make_jaxpr(merge)(state_abs).jaxpr)
+    counts = collective_counts(jax.make_jaxpr(merge)(state_abs))
     assert counts.get("psum", 0) >= 1  # the fused sum bundle
     assert counts.get("all_gather", 0) >= 1  # the cat-state carrier
 

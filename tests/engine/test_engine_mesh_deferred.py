@@ -27,7 +27,7 @@ from metrics_tpu.engine import (
 )
 from metrics_tpu.engine.faults import corrupt_snapshot
 from metrics_tpu.engine.snapshot import latest_snapshot
-from metrics_tpu.parallel.collectives import HLO_COLLECTIVE_RE as _COLLECTIVE_RE
+from metrics_tpu.analysis import check_no_collectives, hlo_collective_counts
 from metrics_tpu.utils.exceptions import MetricsTPUUserError
 
 
@@ -123,8 +123,8 @@ def test_deferred_step_hlo_is_collective_free_and_merge_is_not(mesh):
         merge_hlo = engine._merge_program().as_text()
     assert step_hlos
     for hlo in step_hlos:
-        assert not _COLLECTIVE_RE.findall(hlo)
-    assert _COLLECTIVE_RE.findall(merge_hlo)
+        assert check_no_collectives(hlo_text=hlo, where="mesh-deferred-step") == []
+    assert hlo_collective_counts(merge_hlo)
 
 
 def test_deferred_kill_resume_replays_exactly(mesh, tmp_path):
@@ -228,7 +228,7 @@ def test_deferred_multistream_on_mesh_matches_single_device(mesh):
             assert abs(got[sid][k] - want[sid][k]) < 1e-6, (sid, k)
     # steady step of the multistream mesh engine is collective-free too
     for prog in engine._program_memo.values():
-        assert not _COLLECTIVE_RE.findall(prog.as_text())
+        assert check_no_collectives(hlo_text=prog.as_text(), where="mstream-step") == []
 
 
 def test_deferred_multistream_reset_stream_hits_every_shard(mesh):

@@ -19,28 +19,27 @@ import jax
 import jax.numpy as jnp
 
 from metrics_tpu import Accuracy
+from metrics_tpu.analysis import check_no_scatter_under_pallas, iter_eqns, primitive_names
 from metrics_tpu.ops.kernels import use_backend
 from metrics_tpu.ops.profiling import op_costs
 
 
 def _eqn_names(fn, *args):
+    # fresh closure per trace (kernel-backend contexts change the lowering);
+    # the recursive walk lives once in metrics_tpu/analysis/program.py
+    return primitive_names(jax.make_jaxpr(lambda *a: fn(*a))(*args))
+
+
+def _outside_kernel_names(fn, *args):
+    # primitive names OUTSIDE pallas_call kernel bodies: the analysis walk
+    # descends into the kernels (paths carry 'pallas_call@'), so 'outside'
+    # is every eqn whose path has no kernel ancestor
     jaxpr = jax.make_jaxpr(lambda *a: fn(*a))(*args)
-
-    names = []
-
-    def walk(jx):
-        for eqn in jx.eqns:
-            names.append(eqn.primitive.name)
-            for v in eqn.params.values():
-                if hasattr(v, "jaxpr"):
-                    walk(v.jaxpr)
-                elif isinstance(v, (list, tuple)):
-                    for x in v:
-                        if hasattr(x, "jaxpr"):
-                            walk(x.jaxpr)
-
-    walk(jaxpr.jaxpr)
-    return names
+    return [
+        eqn.primitive.name
+        for path, eqn in iter_eqns(jaxpr)
+        if "pallas_call@" not in path.rsplit("/", 1)[0]
+    ]
 
 
 @pytest.fixture
@@ -71,7 +70,11 @@ def test_masked_update_fusion_attribution(masked_inputs):
     # one fused kernel per state leaf; the fold's select/reduce pattern is
     # gone from the surrounding program (it lives inside the kernels now)
     assert k_names.count("pallas_call") == n_leaves
-    outside = [x for x in k_names if x != "pallas_call"]
+    with use_backend("pallas_interpret"):
+        outside = [
+            x for x in _outside_kernel_names(step_fn, state, preds, target, mask)
+            if x != "pallas_call"
+        ]
     # the vmapped per-row delta computation legitimately keeps row-shaped
     # elementwise work; what must vanish OUTSIDE the kernels is the fold
     # itself — reduce ops over the stacked deltas
@@ -93,13 +96,17 @@ def test_segmented_update_scatter_free(masked_inputs):
 
     with use_backend("xla"):
         xla_names = _eqn_names(step_fn, stacked, preds, target, mask)
+        xla_jaxpr = jax.make_jaxpr(lambda *a: step_fn(*a))(stacked, preds, target, mask)
     with use_backend("pallas_interpret"):
         k_names = _eqn_names(step_fn, stacked, preds, target, mask)
+        k_jaxpr = jax.make_jaxpr(lambda *a: step_fn(*a))(stacked, preds, target, mask)
 
-    # the XLA lowering scatters into identity-filled bases; the kernel path
-    # carries NO scatter anywhere in the program
+    # the XLA lowering scatters into identity-filled bases (the rule FIRES on
+    # it); the kernel path carries NO scatter anywhere in the program (the
+    # no-scatter-under-pallas rule passes) — the PR-4 pin, now a named rule
     assert any(n.startswith("scatter") for n in xla_names)
-    assert not any(n.startswith("scatter") for n in k_names)
+    assert check_no_scatter_under_pallas(xla_jaxpr, where="xla-lowering") != []
+    assert check_no_scatter_under_pallas(k_jaxpr, where="kernel-lowering") == []
     assert k_names.count("pallas_call") == len(state)
 
 

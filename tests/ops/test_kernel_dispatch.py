@@ -10,6 +10,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 
+from metrics_tpu.analysis import trace_primitive_counts
 from metrics_tpu.ops.kernels import (
     BACKENDS,
     fold_rows_masked,
@@ -24,11 +25,12 @@ from metrics_tpu.utils.exceptions import MetricsTPUUserError
 
 
 def _has_pallas_call(fn, *args) -> bool:
-    # fresh closure per trace: JAX caches traces by FUNCTION IDENTITY + avals,
-    # so re-tracing the same function object under a different kernel backend
-    # would silently reuse the first backend's jaxpr (the dispatcher docs call
-    # this out; the engine is immune — it builds per-program closures)
-    return "pallas_call" in str(jax.make_jaxpr(lambda *a: fn(*a))(*args))
+    # the rule engine's trace helper builds a FRESH closure per call: JAX
+    # caches traces by FUNCTION IDENTITY + avals, so re-tracing the same
+    # function object under a different kernel backend would silently reuse
+    # the first backend's jaxpr (the walk itself — recursing into pallas_call
+    # kernel bodies — lives once in metrics_tpu/analysis/program.py)
+    return trace_primitive_counts(fn, *args).get("pallas_call", 0) > 0
 
 
 def test_resolution_rules():

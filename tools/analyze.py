@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Static-analysis CI gate: ``python tools/analyze.py`` (== ``make analyze``).
+
+Runs both planes of ``metrics_tpu/analysis`` and exits nonzero on any finding
+not covered by the committed baseline:
+
+* **program plane** — the bootstrap engine matrix ({step, deferred} x
+  {arena, per-leaf} x {single, multistream} x kernel backends
+  {xla, pallas_interpret}) is built, driven, and audited by
+  ``EngineAnalysis.check``: collective placement per sync mode, scatter-free
+  Pallas lowerings, donation aliasing, arena fusion, host-constant
+  fingerprint coverage, compile caps;
+* **source plane** — the AST trace-hazard lint over ``metrics_tpu/``.
+
+Options:
+    --plane {all,program,source}   which plane(s) to run (default all)
+    --json PATH                    also write the full report as JSON
+    --baseline PATH                baseline file (default tools/analysis_baseline.json)
+    --write-baseline               rewrite the baseline from current findings
+                                   (each entry gets a TODO reason you must fill
+                                   in — unexplained entries fail the gate)
+
+Suppress a single source-plane occurrence inline instead of baselining:
+``# analysis: disable=rule-id -- reason``. Rule catalog: docs/analysis.md.
+"""
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--plane", choices=("all", "program", "source"), default="all")
+    ap.add_argument("--json", dest="json_path", default=None)
+    ap.add_argument(
+        "--baseline", default=os.path.join(_REPO, "tools", "analysis_baseline.json")
+    )
+    ap.add_argument("--write-baseline", action="store_true")
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from metrics_tpu.analysis import Baseline, check_source_tree
+    from metrics_tpu.analysis.bootstrap import analyze_bootstrap_matrix
+    from metrics_tpu.analysis.core import Report
+
+    report = Report()
+    if args.plane in ("all", "source"):
+        report.merge(check_source_tree(os.path.join(_REPO, "metrics_tpu")))
+    if args.plane in ("all", "program"):
+        report.merge(analyze_bootstrap_matrix())
+
+    baseline = Baseline.load(args.baseline)
+    if args.write_baseline:
+        baseline.entries = {
+            f.key(): baseline.entries.get(f.key(), "TODO: explain why this is baselined")
+            for f in report.findings
+        }
+        baseline.save(args.baseline)
+        print(f"baseline rewritten: {len(baseline.entries)} entries -> {args.baseline}")
+
+    new, old = baseline.filter(report.findings)
+    unexplained = baseline.unexplained()
+
+    if args.json_path:
+        payload = report.to_json()
+        payload["baselined"] = [f.key() for f in old]
+        payload["new"] = [f.key() for f in new]
+        payload["unexplained_baseline_entries"] = unexplained
+        os.makedirs(os.path.dirname(os.path.abspath(args.json_path)), exist_ok=True)
+        with open(args.json_path, "w") as fh:
+            json.dump(payload, fh, indent=2)
+            fh.write("\n")
+
+    for f in new:
+        print(f.render())
+    for n in report.notes:
+        print(f"note: {n}")
+    if old:
+        print(f"baselined: {len(old)} finding(s) carried as explained debt")
+    for k in unexplained:
+        print(f"ERROR   baseline entry without a reason: {k}")
+
+    ok = not new and not unexplained
+    planes = args.plane if args.plane != "all" else "program+source"
+    print(
+        f"analyze {'PASS' if ok else 'FAIL'}: planes={planes}, "
+        f"findings={len(report.findings)} (new={len(new)}, baselined={len(old)}), "
+        f"unexplained-baseline={len(unexplained)}"
+    )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

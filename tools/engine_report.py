@@ -2,7 +2,7 @@
 
 Usage::
 
-    python tools/engine_report.py engine_telemetry.json [--steps N]
+    python tools/engine_report.py out/engine_telemetry.json [--steps N]
 
 Reads the document written by ``StreamingEngine.export_telemetry`` (or
 ``python -m metrics_tpu.engine.smoke``) and renders the summary plus the tail
