@@ -2,9 +2,9 @@
 # Default flow runs the smoke checks (seconds) before the full suite.
 # Sidecar artifacts (telemetry JSON, analysis reports) land under out/
 # (gitignored) — never in the repo root.
-.PHONY: all test engine-smoke kernels-smoke mesh-smoke chaos-smoke analyze clean native bench
+.PHONY: all test engine-smoke kernels-smoke mesh-smoke chaos-smoke obs-smoke analyze clean native bench
 
-all: engine-smoke kernels-smoke mesh-smoke chaos-smoke analyze test
+all: engine-smoke kernels-smoke mesh-smoke chaos-smoke obs-smoke analyze test
 
 test:
 	python -m pytest tests/ -q
@@ -39,6 +39,16 @@ mesh-smoke:
 # result() is bit-identical to a fault-free run on the same traffic.
 chaos-smoke:
 	JAX_PLATFORMS=cpu python -m metrics_tpu.engine.chaos_smoke out/chaos_telemetry.json
+
+# Observability gate, CPU-safe (metrics_tpu/engine/obs_smoke.py): a traced
+# coalescing run exports valid Perfetto trace-event JSON (every megabatch span
+# links exactly the submit spans it absorbed) and a valid OpenMetrics
+# exposition (histogram_accumulate-folded latency histograms, counts exact);
+# the SAME seeded chaos plan runs twice and the canonical span sequences must
+# be bit-identical (occurrence determinism); every fault site appears as a
+# span event. Validators: tools/trace_export.py. Docs: docs/observability.md.
+obs-smoke:
+	JAX_PLATFORMS=cpu python -m metrics_tpu.engine.obs_smoke out/trace_obs.json out/obs_metrics.txt
 
 # Static-analysis gate, CPU-safe (metrics_tpu/analysis + tools/analyze.py):
 # program plane audits the bootstrap engine matrix ({step,deferred} x

@@ -81,6 +81,13 @@ from metrics_tpu.engine.snapshot import (
     save_snapshot,
 )
 from metrics_tpu.engine.stats import EngineStats
+from metrics_tpu.engine.trace import (
+    DEFAULT_LATENCY_BUCKETS_US,
+    FixedBucketHistogram,
+    TraceRecorder,
+    device_trace_session,
+    render_openmetrics,
+)
 
 __all__ = [
     "AotCache",
@@ -88,11 +95,13 @@ __all__ = [
     "BackpressureTimeout",
     "BoundaryMergeError",
     "BucketPolicy",
+    "DEFAULT_LATENCY_BUCKETS_US",
     "EngineConfig",
     "EngineDispatchError",
     "EngineStats",
     "FaultInjector",
     "FaultSpec",
+    "FixedBucketHistogram",
     "InjectedFault",
     "MultiStreamEngine",
     "QuarantineRecord",
@@ -100,9 +109,12 @@ __all__ = [
     "SnapshotCorruptError",
     "StepTimeoutError",
     "StreamingEngine",
+    "TraceRecorder",
+    "device_trace_session",
     "enable_persistent_compilation_cache",
     "generations",
     "latest_snapshot",
     "load_snapshot",
+    "render_openmetrics",
     "save_snapshot",
 ]

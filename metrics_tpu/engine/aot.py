@@ -204,6 +204,14 @@ class AotCache:
         with self._lock:
             self.hits += 1
 
+    def contains(self, key: Tuple) -> bool:
+        """Whether ``key`` already holds a compiled program. No counter is
+        touched — this is the attribution probe for callers that need to
+        know if THEIR lookup will compile (a delta of the shared ``misses``
+        counter would blame another engine's concurrent compile on them)."""
+        with self._lock:
+            return key in self._programs
+
     def enable_persistent_cache(self, path: str) -> str:
         """Turn the persistent compilation cache on MID-PROCESS (the backend
         may already have compiled programs — the stale cache handle is reset

@@ -33,10 +33,19 @@ fault-free run on the same traffic:
    and ``reset()`` drains the dead queue and re-arms. A transient
    ``snapshot_read`` fault retries inside ``restore()``.
 
+Since PR 8 the whole sweep runs under the flight recorder
+(``engine/trace.py``): every injected firing must ALSO appear as a span
+event, the recorded trace must export as valid Perfetto/Chrome trace-event
+JSON (``out/trace_chaos.json``, schema-checked by ``tools/trace_export.py``),
+and every megabatch span must link exactly the submit spans it absorbed.
+(Same-seed span-sequence determinism is asserted by ``make obs-smoke``,
+which runs a seeded chaos plan twice.)
+
 Writes the chaos engine's telemetry JSON (the fault block renders via
 ``tools/engine_report.py``) and prints one PASS line. Exits nonzero on any
 violated claim.
 """
+import json
 import os
 import sys
 import tempfile
@@ -44,13 +53,142 @@ import time
 
 import numpy as np
 
-_FAILED = []
+# --------------------------------------------------------- shared chaos plan
+# The canonical seeded chaos scenario, shared with ``obs_smoke`` (whose
+# determinism gate replays THE SAME plan twice — true by construction, not
+# by copy): both smokes build traffic, injectors, and engine configs from
+# these factories, so a plan change here moves both CI gates in lockstep.
 
 
-def _check(ok: bool, what: str) -> None:
-    if not ok:
-        _FAILED.append(what)
-        print(f"FAIL: {what}")
+def chaos_collection():
+    """The served metric set of the canonical plan — part of the scenario:
+    the determinism and parity claims quantify over exactly these metrics."""
+    from metrics_tpu import Accuracy, MeanSquaredError, MetricCollection
+
+    return MetricCollection([Accuracy(), MeanSquaredError()])
+
+
+def make_checker():
+    """``(check, failed)``: the smoke-failure harness both chaos-plan gates
+    share — one ``FAIL:`` line per violated claim (the format CI greps),
+    collected for the exit code. Fresh per call, so two in-process runs
+    never inherit each other's failures."""
+    failed: list = []
+
+    def check(ok: bool, what: str) -> None:
+        if not ok:
+            failed.append(what)
+            print(f"FAIL: {what}")
+
+    return check, failed
+
+
+def chaos_traffic():
+    """``(clean, traffic)``: a dyadic-rational clean stream (every partial
+    float sum exactly representable, so parity holds under ANY grouping or
+    lowering) and the same stream with one poisoned NaN batch at cursor 2."""
+    rng = np.random.RandomState(0)
+    clean = [
+        ((rng.randint(0, 65, size=n) / 64.0).astype(np.float32), (rng.rand(n) > 0.5).astype(np.int32))
+        for n in (5, 17, 8, 32, 3, 12, 32, 9)
+    ]
+    poison = (np.asarray([np.nan, 0.25], np.float32), np.asarray([1, 0], np.int32))
+    return clean, clean[:2] + [poison] + clean[2:]
+
+
+def chaos_injectors():
+    """Fresh occurrence-deterministic injectors, one per chaos phase:
+    ``chaos`` (seed 7) drives the single-device sweep over 8 sites,
+    ``snapshot_read`` (seed 11) the transient read fault under restore,
+    ``merge`` (seed 13) the deferred boundary-merge failure, and
+    ``dispatcher_kill`` (seed 17) the fatal worker death."""
+    from metrics_tpu.engine import FaultInjector, FaultSpec
+
+    return {
+        "chaos": FaultInjector(
+            seed=7,
+            plan={
+                # rate=1.0 degrades EVERY group to one batch — which is also
+                # what makes every other site's occurrence index
+                # deterministic under any producer/dispatcher interleaving
+                "coalesce": FaultSpec(rate=1.0),
+                "ingest": FaultSpec(schedule=(1,)),
+                "compile": FaultSpec(schedule=(1,)),
+                "step": FaultSpec(schedule=(3,)),
+                "kernel": FaultSpec(schedule=(0,)),
+                "watchdog": FaultSpec(schedule=(6,)),
+                "snapshot_write": FaultSpec(schedule=(0,)),
+                "snapshot_corrupt": FaultSpec(schedule=(2,)),  # the LAST good save
+            },
+        ),
+        "snapshot_read": FaultInjector(seed=11, plan={"snapshot_read": FaultSpec(schedule=(0,))}),
+        "merge": FaultInjector(seed=13, plan={"merge": FaultSpec(schedule=(0,))}),
+        "dispatcher_kill": FaultInjector(
+            seed=17,
+            plan={"dispatcher_kill": FaultSpec(schedule=(0,), transient=False, fatal=True)},
+        ),
+    }
+
+
+def chaos_engine_config(snapdir, injector, trace=None):
+    """The sweep engine: coalescing, demotable kernel backend, NaN
+    quarantine, snapshot cadence 2 with a keep-ring of 4."""
+    from metrics_tpu.engine import EngineConfig, ScreenPolicy
+
+    return EngineConfig(
+        buckets=(8, 32),
+        coalesce=8,
+        kernel_backend="pallas_interpret",  # demotable; xla is the floor
+        screen=ScreenPolicy(non_finite="quarantine"),
+        snapshot_every=2,
+        snapshot_dir=snapdir,
+        snapshot_keep=4,
+        fault_injector=injector,
+        trace=trace,
+    )
+
+
+def resume_engine_config(snapdir, injector, trace=None):
+    """The kill+restore engine: same buckets and screen, no cadence — it
+    replays from whatever generation the fallback walk lands on.
+    ``coalesce=1``: group composition must be occurrence-deterministic for
+    obs_smoke's same-seed span-sequence gate, and unlike the sweep engine
+    (whose rate=1.0 coalesce fault pins every group to one batch) nothing
+    else here decouples grouping from producer/dispatcher timing."""
+    from metrics_tpu.engine import EngineConfig, ScreenPolicy
+
+    return EngineConfig(
+        buckets=(8, 32),
+        coalesce=1,
+        screen=ScreenPolicy(non_finite="quarantine"),
+        snapshot_dir=snapdir,
+        fault_injector=injector,
+        trace=trace,
+    )
+
+
+def deferred_engine_config(injector, trace=None):
+    """Deferred-sync on a 1-device mesh — the boundary-merge retry phase.
+    ``coalesce=1`` for the same span-sequence determinism reason as
+    :func:`resume_engine_config`."""
+    import jax
+    from jax.sharding import Mesh
+
+    from metrics_tpu.engine import EngineConfig
+
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("dp",))
+    return EngineConfig(
+        buckets=(8, 32), coalesce=1, mesh=mesh, axis="dp", mesh_sync="deferred",
+        fault_injector=injector, trace=trace,
+    )
+
+
+def kill_engine_config(injector, trace=None):
+    """The dead-dispatcher probe: tiny bounded queue so the fatal exit fills
+    it and ``submit(timeout=)`` must surface the sticky error."""
+    from metrics_tpu.engine import EngineConfig
+
+    return EngineConfig(buckets=(8,), max_queue=2, fault_injector=injector, trace=trace)
 
 
 def main(out_path: str = "out/chaos_telemetry.json") -> int:
@@ -66,25 +204,25 @@ def main(out_path: str = "out/chaos_telemetry.json") -> int:
         BackpressureTimeout,
         EngineConfig,
         EngineDispatchError,
-        FaultInjector,
-        FaultSpec,
-        ScreenPolicy,
         StreamingEngine,
+        TraceRecorder,
     )
     from metrics_tpu.engine.faults import FAULT_SITES
 
-    def collection():
-        return MetricCollection([Accuracy(), MeanSquaredError()])
+    # ONE flight recorder across the deterministic chaos engines: the
+    # exported trace must show every injected firing as a span event and
+    # every megabatch linking its submit spans. The dead-dispatcher section
+    # gets its OWN recorder — its probe submits are never absorbed (the
+    # dispatcher is dead), which is correct behavior there but would
+    # (rightly) fail the link validator on the exported document.
+    _check, _failed = make_checker()
+    rec = TraceRecorder(capacity=1 << 15)
+    rec_kill = TraceRecorder(capacity=4096)
 
-    # dyadic-rational traffic: every partial float sum is exactly
-    # representable, so parity across ANY grouping/lowering is bit-exact
-    rng = np.random.RandomState(0)
-    clean = [
-        ((rng.randint(0, 65, size=n) / 64.0).astype(np.float32), (rng.rand(n) > 0.5).astype(np.int32))
-        for n in (5, 17, 8, 32, 3, 12, 32, 9)
-    ]
-    poison = (np.asarray([np.nan, 0.25], np.float32), np.asarray([1, 0], np.int32))
-    traffic = clean[:2] + [poison] + clean[2:]  # poison at stream cursor 2
+    collection = chaos_collection
+
+    clean, traffic = chaos_traffic()  # poison at stream cursor 2
+    injs = chaos_injectors()
 
     # -------------------------------------------------------- fault-free truth
     ref = StreamingEngine(collection(), EngineConfig(buckets=(8, 32)))
@@ -97,35 +235,8 @@ def main(out_path: str = "out/chaos_telemetry.json") -> int:
 
     # ------------------------------------------------- chaos run, single device
     snapdir = tempfile.mkdtemp(prefix="metrics_tpu_chaos_")
-    inj = FaultInjector(
-        seed=7,
-        plan={
-            # rate=1.0 degrades EVERY group to one batch — which is also what
-            # makes every other site's occurrence index deterministic under
-            # any producer/dispatcher interleaving
-            "coalesce": FaultSpec(rate=1.0),
-            "ingest": FaultSpec(schedule=(1,)),
-            "compile": FaultSpec(schedule=(1,)),
-            "step": FaultSpec(schedule=(3,)),
-            "kernel": FaultSpec(schedule=(0,)),
-            "watchdog": FaultSpec(schedule=(6,)),
-            "snapshot_write": FaultSpec(schedule=(0,)),
-            "snapshot_corrupt": FaultSpec(schedule=(2,)),  # the LAST good save
-        },
-    )
-    engine = StreamingEngine(
-        collection(),
-        EngineConfig(
-            buckets=(8, 32),
-            coalesce=8,
-            kernel_backend="pallas_interpret",  # demotable; xla is the floor
-            screen=ScreenPolicy(non_finite="quarantine"),
-            snapshot_every=2,
-            snapshot_dir=snapdir,
-            snapshot_keep=4,
-            fault_injector=inj,
-        ),
-    )
+    inj = injs["chaos"]
+    engine = StreamingEngine(collection(), chaos_engine_config(snapdir, inj, trace=rec))
     with engine:
         for b in traffic:
             engine.submit(*b)
@@ -163,16 +274,8 @@ def main(out_path: str = "out/chaos_telemetry.json") -> int:
 
     # --------------------------------- kill + restore past the corrupt LATEST
     del engine
-    read_inj = FaultInjector(seed=11, plan={"snapshot_read": FaultSpec(schedule=(0,))})
-    resumed = StreamingEngine(
-        collection(),
-        EngineConfig(
-            buckets=(8, 32),
-            screen=ScreenPolicy(non_finite="quarantine"),
-            snapshot_dir=snapdir,
-            fault_injector=read_inj,
-        ),
-    )
+    read_inj = injs["snapshot_read"]
+    resumed = StreamingEngine(collection(), resume_engine_config(snapdir, read_inj, trace=rec))
     meta = resumed.restore()
     _check(
         int(meta.get("generations_skipped", 0)) == 1,
@@ -196,17 +299,8 @@ def main(out_path: str = "out/chaos_telemetry.json") -> int:
     fired_sites |= set(read_inj.fired)
 
     # ------------------------------------- deferred boundary merge, 1-dev mesh
-    from jax.sharding import Mesh
-
-    mesh = Mesh(np.asarray(jax.devices()[:1]), ("dp",))
-    merge_inj = FaultInjector(seed=13, plan={"merge": FaultSpec(schedule=(0,))})
-    deferred = StreamingEngine(
-        collection(),
-        EngineConfig(
-            buckets=(8, 32), mesh=mesh, axis="dp", mesh_sync="deferred",
-            fault_injector=merge_inj,
-        ),
-    )
+    merge_inj = injs["merge"]
+    deferred = StreamingEngine(collection(), deferred_engine_config(merge_inj, trace=rec))
     with deferred:
         for b in clean:
             deferred.submit(*b)
@@ -221,12 +315,8 @@ def main(out_path: str = "out/chaos_telemetry.json") -> int:
     fired_sites |= set(merge_inj.fired)
 
     # --------------------------- dead dispatcher: sticky submit, reset re-arms
-    kill_inj = FaultInjector(
-        seed=17, plan={"dispatcher_kill": FaultSpec(schedule=(0,), transient=False, fatal=True)}
-    )
-    dead = StreamingEngine(
-        Accuracy(), EngineConfig(buckets=(8,), max_queue=2, fault_injector=kill_inj)
-    )
+    kill_inj = injs["dispatcher_kill"]
+    dead = StreamingEngine(Accuracy(), kill_engine_config(kill_inj, trace=rec_kill))
     p, t = np.asarray([0.9, 0.2], np.float32), np.asarray([1, 0], np.int32)
     dead.start()
     dead.submit(p, t)
@@ -261,7 +351,7 @@ def main(out_path: str = "out/chaos_telemetry.json") -> int:
         raise RuntimeError("injected trace-time kernel failure")
 
     state = jnp.zeros((4,), jnp.float32)
-    rows = jnp.asarray(rng.randint(0, 65, size=(6, 4)) / 64.0, jnp.float32)
+    rows = jnp.asarray(np.random.RandomState(1).randint(0, 65, size=(6, 4)) / 64.0, jnp.float32)
     mask = jnp.asarray([True] * 5 + [False])
     want_fold = np.asarray(fold_rows_masked(state, rows, mask, "sum", backend="xla"))
     with kernel_fault_scope(hook), use_backend("pallas"):
@@ -276,7 +366,27 @@ def main(out_path: str = "out/chaos_telemetry.json") -> int:
     missing = set(FAULT_SITES) - fired_sites
     _check(not missing, f"injection points never fired: {sorted(missing)}")
 
-    if _FAILED:
+    # ------------------------------- flight recorder: spans, links, Perfetto
+    # every injected firing must ALSO be a span event in the recorded trace,
+    # the exported document must be schema-valid Perfetto JSON, and every
+    # megabatch span must link exactly the submit spans it absorbed
+    span_sites = set(rec.fault_sites()) | set(rec_kill.fault_sites())
+    missing_spans = set(FAULT_SITES) - span_sites
+    _check(not missing_spans, f"fault sites without span events: {sorted(missing_spans)}")
+    _check(rec.dropped == 0, f"trace ring dropped {rec.dropped} spans mid-chaos")
+    trace_path = os.path.join(os.path.dirname(out_path) or "out", "trace_chaos.json")
+    rec.export(trace_path)
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..", "tools"))
+    import trace_export
+
+    with open(trace_path) as f:
+        trace_doc = json.load(f)
+    trace_errs = trace_export.validate_chrome_trace(trace_doc) + trace_export.validate_links(
+        trace_doc
+    )
+    _check(not trace_errs, f"chaos trace invalid: {trace_errs[:3]}")
+
+    if _failed:
         return 1
     print(
         "chaos-smoke PASS: "
@@ -285,6 +395,8 @@ def main(out_path: str = "out/chaos_telemetry.json") -> int:
         f"ledger exact); rollbacks={st.rollbacks}, retries={st.retries}, "
         f"demotions={st.kernel_demotions}, watchdog={st.watchdog_timeouts}; "
         "restore fell back past the corrupted LATEST with exact replay; "
+        f"all {len(FAULT_SITES)} sites present as trace span events, Perfetto "
+        f"export valid with megabatch->submit links -> {trace_path}; "
         f"telemetry -> {out_path}"
     )
     return 0

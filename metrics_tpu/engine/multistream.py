@@ -58,6 +58,7 @@ import numpy as np
 
 from metrics_tpu.engine.aot import AotCache
 from metrics_tpu.engine.pipeline import EngineConfig, StreamingEngine
+from metrics_tpu.engine.trace import ENGINE_TRACE
 from metrics_tpu.utils.exceptions import MetricsTPUUserError
 
 __all__ = ["MultiStreamEngine"]
@@ -170,8 +171,10 @@ class MultiStreamEngine(StreamingEngine):
         sid = self._check_stream(stream_id)
         self._raise_if_failed()
         self.start()
-        self._enqueue((sid, args, kwargs), timeout)
-        self._stats.batches_submitted += 1
+        # the base helper traces the submit when a recorder is attached —
+        # _item_context puts the stream_id on the span (every span this
+        # batch's journey produces carries it through the group context)
+        self._submit_item((sid, args, kwargs), timeout)
 
     # ---------------------------------------------------------- fault context
 
@@ -195,10 +198,18 @@ class MultiStreamEngine(StreamingEngine):
         sync the flush is followed by one boundary merge of ALL streams'
         shard-local states."""
         sid = self._check_stream(stream_id)
+        tr = self._trace
+        handle = (
+            tr.begin("result", trace=ENGINE_TRACE, stream_id=sid) if tr is not None else None
+        )
         self.flush()
         with self._state_lock:
             state = self._merged_state() if self._deferred else self._state
-            return self._compute_program()(state, jnp.asarray(sid, jnp.int32))
+            value = self._compute_program()(state, jnp.asarray(sid, jnp.int32))
+        if handle is not None:
+            jax.block_until_ready(value)  # the SLO observable is value-in-hand
+            tr.observe("result_latency_us", tr.end(handle))
+        return value
 
     def results(self) -> Dict[int, Any]:
         """Every stream's value (one flush — and under deferred sync ONE

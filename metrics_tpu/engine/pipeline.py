@@ -121,6 +121,7 @@ from metrics_tpu.engine.faults import (
 )
 from metrics_tpu.engine.snapshot import load_snapshot, save_snapshot
 from metrics_tpu.engine.stats import EngineStats
+from metrics_tpu.engine.trace import ENGINE_TRACE, TraceRecorder, render_openmetrics
 from metrics_tpu.ops.kernels import current_backend, resolve_backend, use_backend
 from metrics_tpu.utils.data import infer_batch_size, is_batch_leaf
 from metrics_tpu.utils.exceptions import MetricsTPUUserError
@@ -230,6 +231,14 @@ class EngineConfig:
         degrade_kernel: demote this engine ``pallas → xla`` when a kernel-
             site fault fires (the resolved backend tag is part of every
             program key, so demotion re-compiles rather than collides).
+        trace: optional :class:`~metrics_tpu.engine.trace.TraceRecorder` —
+            the flight recorder. Every submitted batch gets a trace id, the
+            dispatcher stamps each pipeline stage as a span (a megabatch
+            span LINKS the submit spans it absorbed), every fault-site
+            firing becomes an event, and ``export_trace(path)`` /
+            ``metrics_text()`` expose the Perfetto and OpenMetrics views.
+            None (default) costs one ``is not None`` check per site —
+            nothing else (the ``obs_overhead`` bench guards this).
     """
 
     buckets: Tuple[int, ...] = (256, 1024)
@@ -258,6 +267,7 @@ class EngineConfig:
     step_timeout_s: float = 0.0
     transactional: Optional[bool] = None
     degrade_kernel: bool = True
+    trace: Optional[TraceRecorder] = None
 
 
 class StreamingEngine:
@@ -303,6 +313,21 @@ class StreamingEngine:
             raise MetricsTPUUserError(
                 f"config.fault_injector must be a FaultInjector, got {type(inj).__name__}"
             )
+        if self._cfg.trace is not None and not isinstance(self._cfg.trace, TraceRecorder):
+            raise MetricsTPUUserError(
+                f"config.trace must be a TraceRecorder, got {type(self._cfg.trace).__name__}"
+            )
+        # the flight recorder: None (the default) means every site below is
+        # one attribute load + None check — the whole disabled-path cost
+        self._trace = self._cfg.trace
+        # submit-time [trace id, submit stamp] pairs for queued items, keyed
+        # by object identity — registered BEFORE enqueue (the dispatcher may
+        # process an item the instant it lands) and popped when its group is
+        # picked up; entries live exactly as long as their item is queued,
+        # so ids never alias
+        self._trace_ids: Dict[int, List[Any]] = {}
+        self._group_tid: Optional[str] = None  # dispatcher-thread current group
+        self._last_aot_outcome = "hit"  # set by every _update_program call
         divisor = 1
         if self._cfg.mesh is not None:
             divisor = int(np.prod([self._cfg.mesh.shape[a] for a in self._axis_names()]))
@@ -564,6 +589,7 @@ class StreamingEngine:
         prog = self._program_memo.get(memo_key)
         if prog is not None:
             self._aot.count_hit()  # memo short-circuit still counts as a cache hit
+            self._last_aot_outcome = "hit"
             return prog
         payload_abs = jax.tree.map(
             lambda x: jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype)
@@ -584,6 +610,11 @@ class StreamingEngine:
             arg_tree=(self._abstract_state(), payload_abs, mask_abs),
             mesh=self._cfg.mesh, donate=self._donate, sync=self._sync_tag(),
         )
+        # attribution BEFORE the lookup: whether THIS call compiles. (The
+        # benign race — another engine inserting the identical key in the
+        # gap — mislabels a shared-key duel, never pollutes across keys the
+        # way a shared miss-counter delta would.)
+        self._last_aot_outcome = "hit" if self._aot.contains(key) else "miss"
         prog = self._aot.get_or_compile(
             key, lambda: self._build_update_program(payload_abs, mask_abs)
         )
@@ -782,7 +813,11 @@ class StreamingEngine:
             if err is e:
                 raise
             raise err from e
-        self._stats.record_merge((time.perf_counter() - t0) * 1e6)
+        merge_us = (time.perf_counter() - t0) * 1e6
+        self._stats.record_merge(merge_us)
+        if self._trace is not None:
+            self._trace.complete("merge", trace=ENGINE_TRACE, dur_us=merge_us)
+            self._trace.observe("merge_latency_us", merge_us)
         self._merged_memo = (self._state_version, merged)
         return merged
 
@@ -851,7 +886,34 @@ class StreamingEngine:
         (default) keeps the pure-backpressure blocking contract."""
         self._raise_if_failed()
         self.start()
-        self._enqueue((args, kwargs), timeout)
+        self._submit_item((args, kwargs), timeout)
+
+    def _submit_item(self, item: Any, timeout: Optional[float]) -> None:
+        """Enqueue one queue item, tracing the submit when the recorder is
+        on: the span's duration is the enqueue wait (backpressure made
+        visible), and the trace id registered here is what the dispatcher's
+        megabatch span links back to."""
+        tr = self._trace
+        if tr is None:
+            self._enqueue(item, timeout)
+        else:
+            tid = tr.new_trace()
+            # the stamp starts the batch's queue residency clock: pickup time
+            # minus THIS is the trace's queue_wait (under enqueue backpressure
+            # it spans the blocked put too — the journey starts at submit, and
+            # the coalesce root only begins at pickup, so nothing double-counts
+            # into the end-to-end total)
+            self._trace_ids[id(item)] = [tid, time.perf_counter()]
+            ctx = {k: v for k, v in self._item_context(item).items() if v is not None}
+            handle = tr.begin("submit", trace=tid, **ctx)
+            try:
+                self._enqueue(item, timeout)
+            except BaseException:
+                # a refused submit is no batch: drop the id so a later item
+                # reusing the same object identity cannot inherit it
+                self._trace_ids.pop(id(item), None)
+                raise
+            tr.end(handle)
         self._stats.batches_submitted += 1
 
     def _enqueue(self, item: Any, timeout: Optional[float]) -> None:
@@ -905,10 +967,16 @@ class StreamingEngine:
         before the call — same freshness as step sync; what deferred mode
         trades away is only the GLOBAL consistency of the carried state
         BETWEEN boundaries, never of a returned result."""
+        tr = self._trace
+        handle = tr.begin("result", trace=ENGINE_TRACE) if tr is not None else None
         self.flush()
         with self._state_lock:
             state = self._merged_state() if self._deferred else self._state
-            return self._compute_program()(state)
+            value = self._compute_program()(state)
+        if handle is not None:
+            jax.block_until_ready(value)  # the SLO observable is value-in-hand
+            tr.observe("result_latency_us", tr.end(handle))
+        return value
 
     def state(self) -> Any:
         """A defensive copy of the accumulated (global) LOGICAL state pytree,
@@ -944,11 +1012,75 @@ class StreamingEngine:
     def arena_layout(self) -> Optional[ArenaLayout]:
         return self._layout
 
+    @property
+    def trace(self) -> Optional[TraceRecorder]:
+        """The flight recorder this engine reports spans to (None = off)."""
+        return self._trace
+
     def telemetry(self) -> Dict[str, Any]:
-        return self._stats.summary(self._aot.stats())
+        doc = self._stats.summary(self._aot.stats())
+        if self._trace is not None:
+            doc["trace"] = self._trace.summary()
+        return doc
 
     def export_telemetry(self, path: str) -> None:
-        self._stats.export(path, self._aot.stats())
+        extra = (
+            {"trace": self._trace.summary()} if self._trace is not None else None
+        )
+        self._stats.export(path, self._aot.stats(), extra=extra)
+
+    def export_trace(self, path: str) -> str:
+        """Write the flight recorder's Chrome/Perfetto trace-event JSON to
+        ``path`` (by sidecar-hygiene convention: ``out/trace_*.json``). Load
+        it at https://ui.perfetto.dev — host threads render as tracks, and
+        every megabatch span carries flow arrows back to the submit spans it
+        absorbed. Requires ``EngineConfig(trace=TraceRecorder(...))``."""
+        if self._trace is None:
+            raise MetricsTPUUserError(
+                "export_trace() requires a flight recorder: construct the engine "
+                "with EngineConfig(trace=TraceRecorder(...))"
+            )
+        return self._trace.export(path)
+
+    def metrics_text(self) -> str:
+        """An OpenMetrics/Prometheus text snapshot of this engine: lifetime
+        counters (steps, rows, faults by site, recovery actions, quarantine,
+        snapshots, compile cache) plus — when the flight recorder is on —
+        real fixed-bucket latency histograms (step/queue/result/merge),
+        folded through the library's own ``histogram_accumulate`` path."""
+        s = self._stats
+        counters = {
+            "steps": s.steps,
+            "batches_submitted": s.batches_submitted,
+            "batches_coalesced": s.batches_coalesced,
+            "megasteps": s.megasteps,
+            "rows_in": s.rows_in,
+            "rows_padded": s.rows_padded,
+            "snapshots": s.snapshots,
+            "resumes": s.resumes,
+            "merges": s.merges,
+            "retries": s.retries,
+            "rollbacks": s.rollbacks,
+            "kernel_demotions": s.kernel_demotions,
+            "coalesce_degraded": s.coalesce_degraded,
+            "coalesce_shrinks": s.coalesce_shrinks,
+            "watchdog_timeouts": s.watchdog_timeouts,
+            "quarantined_batches": s.quarantined_batches,
+            "quarantined_rows": s.quarantined_rows,
+            "snapshot_failures": s.snapshot_failures,
+            "snapshot_fallbacks": s.snapshot_fallbacks,
+        }
+        aot = self._aot.stats()
+        counters["compile_cache_hits"] = aot["hits"]
+        counters["compile_cache_misses"] = aot["misses"]
+        labeled = (
+            {"faults_injected": ("site", dict(s.faults_injected))}
+            if s.faults_injected
+            else None
+        )
+        gauges = {"compiled_programs": aot["programs"]}
+        hists = self._trace.histograms() if self._trace is not None else ()
+        return render_openmetrics(counters, hists, labeled_counters=labeled, gauges=gauges)
 
     def reset(self) -> None:
         """Fresh accumulation; compiled programs are kept.
@@ -984,6 +1116,12 @@ class StreamingEngine:
         # a write-site fault fires BEFORE any bytes land: LATEST still points
         # at the previous complete generation (the atomic-pointer contract),
         # so a failed save degrades recovery granularity, never correctness
+        tr = self._trace
+        snap_handle = (
+            tr.begin("snapshot_write", trace=ENGINE_TRACE, step=self._step)
+            if tr is not None
+            else None
+        )
         self._fault("snapshot_write")
         # the carried form: arena = 1 payload/dtype. Under deferred sync the
         # payload is the SHARD-STACKED arena — every shard's local state, i.e.
@@ -1008,12 +1146,16 @@ class StreamingEngine:
             host_attrs=self._metric.host_compute_attrs(),
         )
         self._stats.snapshots += 1
+        if snap_handle is not None:
+            tr.end(snap_handle)
         inj = self._cfg.fault_injector
         if inj is not None and inj.fire("snapshot_corrupt"):
             # bit-rot chaos: the save SUCCEEDED (LATEST points here) and then
             # the payload rots on disk — the case the integrity sidecar and
             # restore()'s generation-ring fallback exist for
             self._stats.record_fault("snapshot_corrupt")
+            if tr is not None:
+                tr.event("fault", site="snapshot_corrupt")
             corrupt_snapshot(path, inj.snapshot_rng())
         return path
 
@@ -1037,6 +1179,10 @@ class StreamingEngine:
         retry with backoff inside this call.
         """
         self._join_queue()  # drain; a sticky-failed (or dead) dispatcher discards
+        tr = self._trace
+        restore_handle = (
+            tr.begin("snapshot_restore", trace=ENGINE_TRACE) if tr is not None else None
+        )
 
         def load_once() -> Tuple[Any, Dict[str, Any]]:
             self._fault("snapshot_read")
@@ -1133,6 +1279,12 @@ class StreamingEngine:
             self._stats.resumes += 1
             if int(meta.get("generations_skipped", 0) or 0) > 0:
                 self._stats.snapshot_fallbacks += 1
+        if restore_handle is not None:
+            tr.end(
+                restore_handle,
+                generations_skipped=int(meta.get("generations_skipped", 0) or 0),
+                cursor=self._batches_done,
+            )
         return meta
 
     # -------------------------------------------------------------------- dispatcher
@@ -1154,9 +1306,10 @@ class StreamingEngine:
             if self._error is None:
                 group, pending, saw_stop, drain_wait_us = self._coalesce_group(first)
                 wait_us += drain_wait_us  # window blocking is queue wait too
+            tids = self._pop_trace_ids(group)  # even when draining: no leaks
             try:
                 if self._error is None:  # after a failure: drain without work
-                    self._process_group(group, wait_us)
+                    self._process_group(group, wait_us, tids)
             except BaseException as e:  # noqa: BLE001 - surfaced via _raise_if_failed
                 _attach_ctx(e, cursor=self._batches_done, **self._group_context(group))
                 self._error = e
@@ -1175,6 +1328,7 @@ class StreamingEngine:
                 # the queue's unfinished counter stays inflated forever and
                 # every join after a successful reset() hangs.
                 if pending is not None:
+                    self._pop_trace_ids([pending])  # dropped item: free its id
                     self._queue.task_done()
                 if saw_stop:
                     self._queue.task_done()
@@ -1186,6 +1340,23 @@ class StreamingEngine:
     def _group_context(self, group: List[Any]) -> Dict[str, Any]:
         """Extra failure context for a group (subclasses add stream ids)."""
         return {}
+
+    def _pop_trace_ids(self, group: List[Any]) -> Optional[List[Tuple[str, float]]]:
+        """Collect (and release) the submit trace ids of a picked-up group —
+        the links its megabatch span carries — each with the batch's QUEUE
+        RESIDENCY in µs (pickup minus submit stamp: the time THIS batch's
+        journey spent waiting, not the dispatcher's idle block in ``get()``,
+        which belongs to stats' starvation attribution, never to a trace).
+        None when tracing is off."""
+        if self._trace is None:
+            return None
+        now = time.perf_counter()
+        out: List[Tuple[str, float]] = []
+        for it in group:
+            entry = self._trace_ids.pop(id(it), None)
+            if entry is not None:
+                out.append((entry[0], (now - entry[1]) * 1e6))
+        return out
 
     def _join_queue(self) -> None:
         """``queue.join()`` that survives a DEAD dispatcher — including one
@@ -1203,9 +1374,12 @@ class StreamingEngine:
                 self._queue.all_tasks_done.wait(timeout=0.1)
         while True:
             try:
-                self._queue.get_nowait()
+                item = self._queue.get_nowait()
             except queue.Empty:
                 break
+            # a drained item is a dropped batch: free its submit trace id,
+            # or _trace_ids grows by one entry per recovery cycle forever
+            self._trace_ids.pop(id(item), None)
             self._queue.task_done()
         # items a dead dispatcher dequeued but never finished cannot be
         # recovered; zero the counter so later joins see a consistent queue
@@ -1259,6 +1433,8 @@ class StreamingEngine:
             # coalesce-machinery fault just serves the group as singletons
             self._stats.record_fault("coalesce")
             self._stats.coalesce_degraded += 1
+            if self._trace is not None:
+                self._trace.event("fault", site="coalesce")
             return group, None, False, 0.0
         rows = self._item_rows_safe(first)
         if rows is None:  # malformed: run alone so the error surfaces cleanly
@@ -1369,8 +1545,13 @@ class StreamingEngine:
             return
         try:
             inj.check(site)
-        except BaseException:
+        except BaseException as e:  # noqa: BLE001 - recorded, then re-raised
             self._stats.record_fault(site)
+            if self._trace is not None:
+                self._trace.event(
+                    "fault", trace=self._group_tid or ENGINE_TRACE, site=site,
+                    occurrence=getattr(e, "occurrence", None),
+                )
             raise
 
     def _backoff(self, attempt: int) -> None:
@@ -1402,6 +1583,10 @@ class StreamingEngine:
                     raise
                 attempt += 1
                 self._stats.retries += 1
+                if self._trace is not None:
+                    self._trace.event(
+                        "retry", trace=self._group_tid or ENGINE_TRACE, attempt=attempt,
+                    )
                 self._backoff(attempt)
 
     def _step_shadow(self) -> Optional[Any]:
@@ -1452,6 +1637,13 @@ class StreamingEngine:
         )
         self._stats.quarantined_batches += 1
         self._stats.quarantined_rows += int(rows)
+        if self._trace is not None:
+            sid = self._item_context(item).get("stream_id")
+            extra = {"stream_id": sid} if sid is not None else {}
+            self._trace.event(
+                "quarantine", trace=self._group_tid or ENGINE_TRACE,
+                cursor=int(cursor), rows=int(rows), reason=reason, **extra,
+            )
 
     def _screen_group(
         self, sized: List[Tuple[Any, int]]
@@ -1487,17 +1679,51 @@ class StreamingEngine:
 
     # -------------------------------------------------------------------- processing
 
-    def _process_group(self, group: List[Any], queue_wait_us: float) -> None:
+    def _process_group(
+        self,
+        group: List[Any],
+        queue_wait_us: float,
+        tids: Optional[List[Tuple[str, float]]] = None,
+    ) -> None:
         with self._state_lock:
             # only INGEST faults retry at this level: they fire before
             # anything folds, so the whole group re-runs from untouched
             # state; everything else is handled deeper or goes sticky
-            self._retry_transient(
-                lambda: self._process_group_locked(group, queue_wait_us),
-                transient=lambda e: (
-                    isinstance(e, InjectedFault) and e.site == "ingest" and e.transient
-                ),
+            ingest_transient = lambda e: (  # noqa: E731 - local policy closure
+                isinstance(e, InjectedFault) and e.site == "ingest" and e.transient
             )
+            tr = self._trace
+            if tr is None:
+                self._retry_transient(
+                    lambda: self._process_group_locked(group, queue_wait_us),
+                    transient=ingest_transient,
+                )
+                return
+            # the megabatch ("coalesce") span: its trace id derives from the
+            # first absorbed submit, and its links are ALL of them — the
+            # causal record a tail-latency investigation walks backwards
+            links = [t for t, _ in tids or ()]
+            waits = [w for _, w in tids or ()]
+            gid = TraceRecorder.group_trace(links)
+            self._group_tid = gid
+            # the group's queue_wait is the LONGEST member residency (members
+            # wait concurrently, so that is the wall-clock the tail paid); the
+            # histogram sees every member, the per-batch distribution
+            tr.complete("queue_wait", trace=gid, dur_us=max(waits, default=0.0))
+            for w in waits:
+                tr.observe("queue_wait_us", w)
+            handle = tr.begin(
+                "coalesce", trace=gid, links=links, batches=len(group),
+                **self._group_context(group),
+            )
+            try:
+                self._retry_transient(
+                    lambda: self._process_group_locked(group, queue_wait_us),
+                    transient=ingest_transient,
+                )
+            finally:
+                self._group_tid = None
+                tr.end(handle)
 
     def _latch_payload(self, merged: Any) -> Tuple[Tuple[Any, ...], Dict[str, Any]]:
         """The (args, kwargs) a host-attr latch row is sliced from (subclasses
@@ -1629,6 +1855,12 @@ class StreamingEngine:
         t_pad = time.perf_counter()
         payload, mask_dev = self._upload((a, kw), mask)
         ingest_us = (time.perf_counter() - t0) * 1e6  # pad+upload only, not compile
+        tr = self._trace
+        if tr is not None:
+            tr.complete(
+                "pad", trace=self._group_tid or ENGINE_TRACE,
+                dur_us=(t_pad - t0) * 1e6, bucket=bucket, rows=stop - start,
+            )
         attempt = 0
         while True:
             shadow = self._step_shadow()
@@ -1649,13 +1881,28 @@ class StreamingEngine:
         valid: int, n_coalesced: int, queue_wait_us: float, ingest_us: float,
         t0: float, t_pad: float,
     ) -> None:
+        tr = self._trace
+        gid = self._group_tid or ENGINE_TRACE
         self._fault("compile")
         if self._kernel_tag() != "xla":
             # the kernel site models a runtime kernel-backend failure —
             # meaningless for an engine already on the reference lowering
             self._fault("kernel")
-        program = self._update_program(payload, mask)
+        if tr is None:
+            program = self._update_program(payload, mask)
+        else:
+            # AOT lookup span: hit vs compile, attributed by _update_program
+            # itself (exact under a shared AotCache, where a miss-counter
+            # delta would blame another engine's concurrent compile on us)
+            aot_handle = tr.begin("aot", trace=gid, bucket=bucket)
+            program = self._update_program(payload, mask)
+            tr.end(aot_handle, cache=self._last_aot_outcome)
         depth = self._queue.qsize()
+        step_handle = (
+            tr.begin("device_step", trace=gid, step=self._step, bucket=bucket, valid=valid)
+            if tr is not None
+            else None
+        )
         new_state, token = program(self._state, payload, mask_dev)
         # the strictest injection point: device work dispatched, host commit
         # pending — recovery MUST discard new_state, not fold it twice
@@ -1675,17 +1922,25 @@ class StreamingEngine:
                 jax.block_until_ready(token)
             sync_us = (time.perf_counter() - t_sync) * 1e6
             self._inflight.clear()
+            if tr is not None:
+                tr.complete("watchdog_sync", trace=gid, dur_us=sync_us)
         self._state = new_state
         self._state_version += 1
         self._step += 1
         if not self._watchdog_enabled:
             sync_us = self._bound_inflight(token)
+            if sync_us is not None and tr is not None:
+                tr.complete("inflight_sync", trace=gid, dur_us=sync_us)
+        wall_us = (time.perf_counter() - t0) * 1e6
+        if step_handle is not None:
+            tr.end(step_handle)
+            tr.observe("step_latency_us", wall_us)
         self._stats.record_step(
             bucket=bucket, valid=valid, queue_depth=depth,
             ingest_us=ingest_us, sync_us=sync_us,
             pad_us=(t_pad - t0) * 1e6,
             queue_wait_us=queue_wait_us,
-            wall_us=(time.perf_counter() - t0) * 1e6,
+            wall_us=wall_us,
             coalesced=n_coalesced,
         )
 
@@ -1703,6 +1958,12 @@ class StreamingEngine:
         self._state = shadow
         self._merged_memo = None
         self._stats.rollbacks += 1
+        tr = self._trace
+        if tr is not None:
+            tr.event(
+                "rollback", trace=self._group_tid or ENGINE_TRACE,
+                cause=type(e).__name__,
+            )
         if isinstance(e, StepTimeoutError):
             self._stats.watchdog_timeouts += 1
         if (
@@ -1719,10 +1980,19 @@ class StreamingEngine:
             self._kernel_backend = "xla"
             self._program_memo.clear()
             self._stats.kernel_demotions += 1
+            if tr is not None:
+                tr.event(
+                    "kernel_demotion", trace=self._group_tid or ENGINE_TRACE,
+                    backend="xla",
+                )
             return True
         if not is_transient(e) or attempt >= self._cfg.max_retries:
             return False
         self._stats.retries += 1
+        if tr is not None:
+            tr.event(
+                "retry", trace=self._group_tid or ENGINE_TRACE, attempt=attempt + 1,
+            )
         self._backoff(attempt + 1)
         return True
 

@@ -284,12 +284,27 @@ class EngineStats:
         shares["window_steps"] = len(timed)
         return shares
 
-    def to_json(self, aot_stats: Optional[Dict[str, Any]] = None) -> str:
-        return json.dumps({"summary": self.summary(aot_stats), "recent_steps": self.recent()}, indent=2)
+    def to_json(
+        self,
+        aot_stats: Optional[Dict[str, Any]] = None,
+        extra: Optional[Dict[str, Any]] = None,
+    ) -> str:
+        """The exported telemetry document. ``extra`` merges additional
+        top-level sections (the engine adds ``trace`` — the flight recorder's
+        SLO summary — when one is attached)."""
+        doc: Dict[str, Any] = {"summary": self.summary(aot_stats), "recent_steps": self.recent()}
+        if extra:
+            doc.update(extra)
+        return json.dumps(doc, indent=2)
 
-    def export(self, path: str, aot_stats: Optional[Dict[str, Any]] = None) -> None:
+    def export(
+        self,
+        path: str,
+        aot_stats: Optional[Dict[str, Any]] = None,
+        extra: Optional[Dict[str, Any]] = None,
+    ) -> None:
         parent = os.path.dirname(os.path.abspath(path))
         if parent:
             os.makedirs(parent, exist_ok=True)
         with open(path, "w") as f:
-            f.write(self.to_json(aot_stats))
+            f.write(self.to_json(aot_stats, extra=extra))

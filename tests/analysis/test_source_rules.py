@@ -216,6 +216,47 @@ def test_wallclock_outside_jit_is_fine():
     ) == []
 
 
+def test_wallclock_clean_twin_flight_recorder_pattern():
+    """The PR-8 no-FP contract, as a clean-twin pair: the flight recorder's
+    host-side ``perf_counter`` idiom (span begin/end on the dispatcher
+    thread, nothing jitted) must lint CLEAN, while the same call moved
+    inside a function handed to ``jax.jit`` must still flag — the rule is
+    scoped by trace reachability, not by module or call name."""
+    clean_twin = """
+        import time
+        import jax
+
+        class Recorder:
+            def begin(self, name):
+                return [name, time.perf_counter()]    # host span clock
+
+            def end(self, handle):
+                return (time.perf_counter() - handle[1]) * 1e6
+
+        class Engine:
+            def _do_step(self, program, state, payload):
+                h = self.trace.begin("device_step")
+                new_state = program(state, payload)   # program is ALREADY jitted
+                self.trace.end(h)
+                return new_state
+        """
+    assert _lint(clean_twin) == []
+    dirty_twin = """
+        import time
+        import jax
+
+        def step(state, payload):
+            t0 = time.perf_counter()                  # line 6: frozen at trace time
+            return state + payload, t0
+
+        program = jax.jit(step)
+        """
+    findings = _lint(dirty_twin)
+    assert [(f.rule, f.where.rsplit(":", 1)[1]) for f in findings] == [
+        ("wallclock-in-jit", "6")
+    ]
+
+
 # ------------------------------------------------------------------ suppressions
 
 

@@ -1,0 +1,96 @@
+"""Edge cases of the telemetry ring (PR 8 satellite): ``_percentile`` on
+empty/single-entry inputs, ``recent()`` ordering across ring wraparound, and
+``_host_time_shares`` when the window carries zero wall time."""
+import math
+
+import pytest
+
+from metrics_tpu.engine.stats import EngineStats, _percentile
+
+
+class TestPercentile:
+    def test_empty_is_nan(self):
+        for q in (0.0, 0.5, 0.95, 1.0):
+            assert math.isnan(_percentile([], q))
+
+    def test_single_entry_is_that_entry_at_every_quantile(self):
+        for q in (0.0, 0.5, 0.95, 1.0):
+            assert _percentile([42.0], q) == 42.0
+
+    def test_two_entries_interpolate(self):
+        assert _percentile([0.0, 10.0], 0.5) == 5.0
+        assert _percentile([0.0, 10.0], 0.0) == 0.0
+        assert _percentile([0.0, 10.0], 1.0) == 10.0
+
+    def test_exact_index_no_interpolation(self):
+        vals = [1.0, 2.0, 3.0, 4.0, 5.0]
+        assert _percentile(vals, 0.5) == 3.0
+        assert _percentile(vals, 0.25) == 2.0
+
+
+class TestRecentWraparound:
+    @staticmethod
+    def _fill(stats: EngineStats, n: int) -> None:
+        for i in range(n):
+            stats.record_step(bucket=8, valid=i, queue_depth=0, ingest_us=float(i))
+
+    def test_under_capacity_keeps_submission_order(self):
+        s = EngineStats(capacity=8)
+        self._fill(s, 5)
+        assert [r["valid"] for r in s.recent()] == [0, 1, 2, 3, 4]
+
+    def test_exactly_at_capacity(self):
+        s = EngineStats(capacity=4)
+        self._fill(s, 4)
+        assert [r["valid"] for r in s.recent()] == [0, 1, 2, 3]
+
+    def test_wraparound_is_oldest_first_window(self):
+        s = EngineStats(capacity=4)
+        self._fill(s, 7)  # ring holds steps 3..6, oldest first
+        assert [r["valid"] for r in s.recent()] == [3, 4, 5, 6]
+        assert [r["step"] for r in s.recent()] == [3, 4, 5, 6]
+
+    def test_multiple_full_wraps(self):
+        s = EngineStats(capacity=3)
+        self._fill(s, 11)
+        assert [r["valid"] for r in s.recent()] == [8, 9, 10]
+        assert s.steps == 11  # lifetime counter unaffected by the window
+
+    def test_empty_ring(self):
+        assert EngineStats(capacity=4).recent() == []
+
+    def test_invalid_capacity_rejected(self):
+        with pytest.raises(ValueError, match="positive"):
+            EngineStats(capacity=0)
+
+
+class TestHostTimeShares:
+    def test_no_timed_records_is_none(self):
+        # records without wall_us (pre-wall-clock telemetry) contribute nothing
+        recs = [{"ingest_us": 1.0, "queue_depth": 0}]
+        assert EngineStats._host_time_shares(recs) is None
+
+    def test_zero_wall_time_is_none_not_div_by_zero(self):
+        recs = [
+            {"wall_us": 0.0, "queue_wait_us": 0.0, "pad_us": 0.0, "sync_us": 0.0},
+            {"wall_us": 0.0},
+        ]
+        assert EngineStats._host_time_shares(recs) is None
+
+    def test_summary_with_zero_wall_omits_shares(self):
+        s = EngineStats(capacity=4)
+        s.record_step(
+            bucket=8, valid=8, queue_depth=0, ingest_us=0.0,
+            pad_us=0.0, queue_wait_us=0.0, wall_us=0.0,
+        )
+        summary = s.summary()
+        assert "host_time_shares" not in summary
+        assert summary["steps"] == 1
+
+    def test_shares_sum_to_one_and_label_regime(self):
+        recs = [{"wall_us": 100.0, "queue_wait_us": 100.0, "pad_us": 30.0, "sync_us": 10.0}]
+        shares = EngineStats._host_time_shares(recs)
+        total = shares["pad"] + shares["queue_wait"] + shares["blocked_sync"] + shares["dispatch"]
+        assert total == pytest.approx(1.0, abs=1e-3)
+        assert shares["regime"] == "starved"  # queue wait dominates
+        assert shares["window_steps"] == 1

@@ -1,0 +1,463 @@
+"""Flight recorder (PR 8): span model, trace-id propagation with coalesce
+linking, fault-site events, the two exporters, and the off-path contract.
+
+The full end-to-end sweep (all 11 fault sites as span events, Perfetto
+schema, same-seed sequence determinism) is ``make obs-smoke``
+(``metrics_tpu/engine/obs_smoke.py``); these tests pin each mechanism in
+isolation on the tier-1 path.
+"""
+import json
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..", "tools"))
+
+import trace_export
+
+from metrics_tpu import Accuracy, MeanSquaredError, MetricCollection
+from metrics_tpu.engine import (
+    EngineConfig,
+    FaultInjector,
+    FaultSpec,
+    FixedBucketHistogram,
+    MultiStreamEngine,
+    ScreenPolicy,
+    StreamingEngine,
+    TraceRecorder,
+    render_openmetrics,
+)
+from metrics_tpu.engine.trace import ENGINE_TRACE
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+BUCKETS = (8, 32)
+
+
+def _dyadic(rng, n):
+    return (rng.randint(0, 65, size=n) / 64.0).astype(np.float32)
+
+
+def _traffic(n_batches=5, seed=0):
+    rng = np.random.RandomState(seed)
+    return [
+        (_dyadic(rng, n), (rng.rand(n) > 0.5).astype(np.int32))
+        for n in rng.randint(2, 30, size=n_batches)
+    ]
+
+
+def collection():
+    return MetricCollection([Accuracy(), MeanSquaredError()])
+
+
+# ------------------------------------------------------------------- recorder
+
+
+class TestRecorder:
+    def test_ring_is_bounded_and_counts_drops(self):
+        rec = TraceRecorder(capacity=4)
+        for i in range(10):
+            rec.event("e", n=i)
+        records = rec.records()
+        assert len(records) == 4
+        assert rec.dropped == 6
+        assert [r["args"]["n"] for r in records] == [6, 7, 8, 9]  # oldest dropped
+
+    def test_trace_ids_are_counter_ordered_and_group_derives(self):
+        rec = TraceRecorder()
+        assert [rec.new_trace() for _ in range(3)] == ["t1", "t2", "t3"]
+        assert TraceRecorder.group_trace(["t2", "t3"]) == "g2"
+        assert TraceRecorder.group_trace([]) == ENGINE_TRACE
+
+    def test_begin_without_end_records_nothing(self):
+        rec = TraceRecorder()
+        rec.begin("abandoned", trace="t1")
+        assert rec.spans() == []
+
+    def test_canonical_sequence_excludes_timing(self):
+        def run():
+            rec = TraceRecorder()
+            h = rec.begin("span", trace="t1", track="x", bucket=8)
+            rec.end(h)
+            rec.complete("wait", trace="t1", dur_us=123.0, track="x")
+            rec.event("fault", track="x", site="step", occurrence=2)
+            return rec.canonical_sequence()
+
+        assert run() == run()  # durations differ between runs; canon must not
+
+    def test_invalid_capacity_rejected(self):
+        with pytest.raises(ValueError, match="capacity"):
+            TraceRecorder(capacity=0)
+
+    def test_thread_safety_no_loss_under_capacity(self):
+        rec = TraceRecorder(capacity=10_000)
+
+        def worker(k):
+            for i in range(200):
+                rec.event("e", worker=k, n=i)
+
+        threads = [threading.Thread(target=worker, args=(k,)) for k in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(rec.events()) == 800
+        assert rec.dropped == 0
+
+
+# ------------------------------------------------------------- histogram path
+
+
+class TestHistogram:
+    def test_bucket_counts_match_numpy(self):
+        rng = np.random.RandomState(7)
+        vals = rng.gamma(2.0, 500.0, size=257)
+        edges = (100.0, 500.0, 1000.0, 5000.0)
+        h = FixedBucketHistogram("h_us", edges)
+        for v in vals:
+            h.observe(v)
+        got = h.bucket_counts()
+        # numpy oracle for prometheus 'le' semantics — bucket k holds
+        # v <= edges[k] — which np.histogram (right-open bins) cannot
+        # express directly; searchsorted(side="left") is the exact form
+        exact = np.searchsorted(np.asarray(edges), vals, side="left")
+        want = np.bincount(exact, minlength=len(edges) + 1)
+        assert np.array_equal(got, want)
+        assert h.count == 257
+        assert h.sum == pytest.approx(float(vals.sum()))
+
+    def test_incremental_flush_accumulates(self):
+        h = FixedBucketHistogram("h_us", (10.0, 20.0))
+        h.observe(5.0)
+        assert h.count == 1
+        h.observe(15.0)
+        h.observe(25.0)
+        assert h.count == 3
+        assert list(h.bucket_counts()) == [1, 1, 1]
+
+    def test_bad_edges_rejected(self):
+        with pytest.raises(ValueError, match="ascending"):
+            FixedBucketHistogram("h", (10.0, 10.0))
+        with pytest.raises(ValueError, match="ascending"):
+            FixedBucketHistogram("h", ())
+
+    def test_concurrent_observe_and_scrape_loses_nothing(self):
+        """The dispatcher observes while scrape threads flush: every
+        observation must land exactly once (no drop when an append races the
+        pending swap, no double-count when two scrapes fold the same
+        buffer), and every mid-flight snapshot must be internally consistent
+        (count == +Inf cumulative bucket)."""
+        h = FixedBucketHistogram("h_us", (10.0, 100.0, 1000.0))
+        n_per_writer, writers = 2000, 3
+        stop = threading.Event()
+        snaps = []
+
+        def write(seed):
+            rng = np.random.RandomState(seed)
+            for _ in range(n_per_writer):
+                h.observe(float(rng.gamma(2.0, 50.0)))
+
+        def scrape():
+            while not stop.is_set():
+                snaps.append(h.snapshot())
+
+        readers = [threading.Thread(target=scrape) for _ in range(2)]
+        ws = [threading.Thread(target=write, args=(s,)) for s in range(writers)]
+        for t in readers + ws:
+            t.start()
+        for t in ws:
+            t.join()
+        stop.set()
+        for t in readers:
+            t.join()
+        assert h.count == n_per_writer * writers
+        assert int(h.bucket_counts().sum()) == n_per_writer * writers
+        for s in snaps:
+            assert s["count"] == sum(s["counts"])
+
+    def test_pending_is_bounded_without_scrapes(self):
+        """An engine that is never scraped must not grow the pending buffer
+        without bound: crossing FOLD_PENDING_AT triggers an inline fold."""
+        h = FixedBucketHistogram("h_us", (10.0, 100.0))
+        for i in range(h.FOLD_PENDING_AT + 7):
+            h.observe(float(i % 200))
+        assert len(h._pending) < h.FOLD_PENDING_AT
+        assert h.count == h.FOLD_PENDING_AT + 7  # fold lost nothing
+
+    def test_lazy_histograms_inherit_recorder_buckets(self):
+        """A histogram first created by observe() must carry the recorder's
+        configured edges — not silently revert to the defaults."""
+        edges = (5.0, 50.0)
+        rec = TraceRecorder(latency_buckets_us=edges)
+        rec.observe("custom_latency_us", 30.0)
+        by_name = {h.name: h for h in rec.histograms()}
+        assert by_name["custom_latency_us"].edges == edges
+        assert by_name["step_latency_us"].edges == edges
+
+
+class TestSummaryParity:
+    def test_slowest_ranking_matches_trace_export(self):
+        """``TraceRecorder.summary()`` and ``tools/trace_export.summarize()``
+        each implement the end-to-end trace latency definition (root span +
+        queue waits) — deliberately twice, because the tool must run where
+        only the JSON artifact lands (no package import). This parity pin is
+        what keeps the definition single: changing one implementation's
+        ranking without the other turns this red."""
+        rec = TraceRecorder()
+        for tid in ("t1", "t2", "t3"):
+            rec.complete("submit", trace=tid, dur_us=1.0, track="MainThread")
+        # g1 wins only if queue_wait counts into the end-to-end total;
+        # g3 wins on root duration alone — the ranking pins the definition
+        rec.complete("queue_wait", trace="g1", dur_us=500.0, track="dispatcher")
+        rec.complete("coalesce", trace="g1", dur_us=100.0, track="dispatcher", links=("t1", "t2"))
+        rec.complete("queue_wait", trace="g3", dur_us=10.0, track="dispatcher")
+        rec.complete("coalesce", trace="g3", dur_us=400.0, track="dispatcher", links=("t3",))
+        ranked = rec.summary(slowest=2)["slowest_traces"]
+        assert [(t["trace"], t["dur_us"]) for t in ranked] == [("g1", 600.0), ("g3", 410.0)]
+        lines = trace_export.summarize(rec.to_chrome_trace(), slowest=2).splitlines()
+        assert [ln.split()[0] for ln in lines[1:3]] == ["g1", "g3"]
+        assert "600" in lines[1] and "410" in lines[2]
+
+    def test_submit_only_traces_are_not_journeys(self):
+        """A t-trace holding only its submit span must not rank: the batch's
+        journey lives in the g-trace that absorbed it (its blocked-put wait is
+        already inside that trace's queue_wait — ranking it separately would
+        double-count backpressure and crowd out real tails). BOTH
+        implementations must agree."""
+        rec = TraceRecorder()
+        # a long blocked-put submit (backpressure) that would top the list
+        rec.complete("submit", trace="t1", dur_us=9_000.0, track="MainThread")
+        rec.complete("queue_wait", trace="g1", dur_us=9_100.0, track="dispatcher")
+        rec.complete("coalesce", trace="g1", dur_us=50.0, track="dispatcher", links=("t1",))
+        ranked = rec.summary(slowest=5)["slowest_traces"]
+        assert [t["trace"] for t in ranked] == ["g1"]
+        lines = trace_export.summarize(rec.to_chrome_trace(), slowest=5).splitlines()
+        assert len(lines) == 2 and lines[1].split()[0] == "g1"
+
+
+class TestOpenMetrics:
+    def test_render_shape(self):
+        h = FixedBucketHistogram("lat_us", (1.0, 2.0))
+        h.observe(0.5)
+        h.observe(5.0)
+        text = render_openmetrics(
+            {"steps": 3},
+            [h],
+            labeled_counters={"faults_injected": ("site", {"step": 2})},
+            gauges={"programs": 4},
+        )
+        lines = text.splitlines()
+        assert "# TYPE metrics_tpu_engine_steps counter" in lines
+        assert "metrics_tpu_engine_steps_total 3" in lines
+        assert 'metrics_tpu_engine_faults_injected_total{site="step"} 2' in lines
+        assert "metrics_tpu_engine_programs 4" in lines
+        assert 'metrics_tpu_engine_lat_us_bucket{le="+Inf"} 2' in lines
+        assert "metrics_tpu_engine_lat_us_count 2" in lines
+        assert lines[-1] == "# EOF"
+        # cumulative buckets: le=1 holds 1, le=2 still 1, +Inf holds 2
+        assert 'metrics_tpu_engine_lat_us_bucket{le="1"} 1' in lines
+        assert 'metrics_tpu_engine_lat_us_bucket{le="2"} 1' in lines
+
+
+# ------------------------------------------------------------- engine wiring
+
+
+class TestEngineTracing:
+    def test_submit_spans_link_into_groups(self):
+        rec = TraceRecorder()
+        engine = StreamingEngine(
+            collection(), EngineConfig(buckets=BUCKETS, trace=rec)
+        )
+        batches = _traffic(5)
+        with engine:
+            for b in batches:
+                engine.submit(*b)
+            ref = {k: np.asarray(v) for k, v in engine.result().items()}
+        submits = rec.spans("submit")
+        groups = rec.spans("coalesce")
+        assert len(submits) == len(batches)
+        linked = [tid for g in groups for tid in g["args"]["links"]]
+        assert sorted(linked) == sorted(s["trace"] for s in submits)
+        # every group's trace id derives from its first absorbed submit
+        for g in groups:
+            assert g["trace"] == "g" + g["args"]["links"][0].lstrip("t")
+        # the untraced twin computes the identical result
+        plain = StreamingEngine(collection(), EngineConfig(buckets=BUCKETS))
+        with plain:
+            for b in batches:
+                plain.submit(*b)
+            got = {k: np.asarray(v) for k, v in plain.result().items()}
+        for k in ref:
+            assert np.array_equal(ref[k], got[k])
+
+    def test_pipeline_stage_spans_present(self):
+        rec = TraceRecorder()
+        engine = StreamingEngine(collection(), EngineConfig(buckets=BUCKETS, trace=rec))
+        with engine:
+            for b in _traffic(3):
+                engine.submit(*b)
+            engine.result()
+        names = {s["name"] for s in rec.spans()}
+        assert {"submit", "queue_wait", "coalesce", "pad", "aot", "device_step", "result"} <= names
+        # AOT spans label hit vs miss; the first lookup of each bucket is a miss
+        aot = rec.spans("aot")
+        assert aot[0]["args"]["cache"] == "miss"
+        assert {a["args"]["cache"] for a in aot} <= {"hit", "miss"}
+        # step spans carry the step ordinal and bucket
+        steps = rec.spans("device_step")
+        assert [s["args"]["step"] for s in steps] == list(range(len(steps)))
+        assert all(s["args"]["bucket"] in BUCKETS for s in steps)
+
+    def test_tracing_off_records_nothing_and_rejects_export(self):
+        engine = StreamingEngine(collection(), EngineConfig(buckets=BUCKETS))
+        assert engine.trace is None
+        with engine:
+            engine.submit(*_traffic(1)[0])
+            engine.result()
+        with pytest.raises(MetricsTPUUserError, match="TraceRecorder"):
+            engine.export_trace("/tmp/nope.json")
+        # the OpenMetrics surface still serves counters without a recorder
+        text = engine.metrics_text()
+        assert "metrics_tpu_engine_steps_total 1" in text.splitlines()
+        assert text.rstrip().endswith("# EOF")
+
+    def test_bad_trace_config_rejected(self):
+        with pytest.raises(MetricsTPUUserError, match="TraceRecorder"):
+            StreamingEngine(Accuracy(), EngineConfig(trace=object()))
+
+    def test_fault_events_and_recovery_spans(self):
+        rec = TraceRecorder()
+        inj = FaultInjector(
+            seed=3,
+            plan={
+                "step": FaultSpec(schedule=(0,)),
+                "kernel": FaultSpec(schedule=(0,)),
+            },
+        )
+        engine = StreamingEngine(
+            collection(),
+            EngineConfig(
+                buckets=BUCKETS, kernel_backend="pallas_interpret",
+                fault_injector=inj, trace=rec,
+            ),
+        )
+        with engine:
+            for b in _traffic(3, seed=1):
+                engine.submit(*b)
+            engine.result()
+        sites = rec.fault_sites()
+        assert sites.get("kernel") == 1 and sites.get("step") == 1
+        assert len(rec.events("rollback")) >= 2  # kernel demotion + step retry
+        assert len(rec.events("kernel_demotion")) == 1
+        assert len(rec.events("retry")) >= 1
+
+    def test_quarantine_event_carries_cursor_and_reason(self):
+        rec = TraceRecorder()
+        engine = StreamingEngine(
+            collection(),
+            EngineConfig(
+                buckets=BUCKETS, screen=ScreenPolicy(non_finite="quarantine"), trace=rec,
+            ),
+        )
+        poison = (np.asarray([np.nan, 0.5], np.float32), np.asarray([1, 0], np.int32))
+        with engine:
+            engine.submit(*_traffic(1, seed=2)[0])
+            engine.flush()
+            engine.submit(*poison)
+            engine.result()
+        (ev,) = rec.events("quarantine")
+        assert ev["args"]["cursor"] == 1
+        assert ev["args"]["rows"] == 2
+        assert "non-finite" in ev["args"]["reason"]
+
+    def test_snapshot_write_and_restore_spans(self, tmp_path):
+        rec = TraceRecorder()
+        engine = StreamingEngine(
+            collection(),
+            EngineConfig(buckets=BUCKETS, snapshot_dir=str(tmp_path), trace=rec),
+        )
+        with engine:
+            engine.submit(*_traffic(1, seed=3)[0])
+            engine.snapshot()
+        assert len(rec.spans("snapshot_write")) == 1
+        resumed = StreamingEngine(
+            collection(),
+            EngineConfig(buckets=BUCKETS, snapshot_dir=str(tmp_path), trace=rec),
+        )
+        meta = resumed.restore()
+        (sp,) = rec.spans("snapshot_restore")
+        assert sp["args"]["cursor"] == int(meta["batches_done"])
+        assert sp["args"]["generations_skipped"] == 0
+
+    def test_latency_histograms_feed_from_steps(self):
+        rec = TraceRecorder()
+        engine = StreamingEngine(collection(), EngineConfig(buckets=BUCKETS, trace=rec))
+        with engine:
+            for b in _traffic(4, seed=4):
+                engine.submit(*b)
+            engine.result()
+        hists = {h.name: h for h in rec.histograms()}
+        assert hists["step_latency_us"].count == engine.stats.steps
+        assert hists["result_latency_us"].count == 1
+        assert hists["queue_wait_us"].count >= 1
+
+    def test_telemetry_carries_trace_section(self, tmp_path):
+        rec = TraceRecorder()
+        engine = StreamingEngine(collection(), EngineConfig(buckets=BUCKETS, trace=rec))
+        with engine:
+            for b in _traffic(3, seed=5):
+                engine.submit(*b)
+            engine.result()
+        doc = engine.telemetry()
+        assert doc["trace"]["spans"] > 0
+        assert doc["trace"]["slowest_traces"]
+        path = tmp_path / "tele.json"
+        engine.export_telemetry(str(path))
+        exported = json.loads(path.read_text())
+        assert exported["trace"]["spans"] == doc["trace"]["spans"]
+        # untraced engines keep the pre-PR-8 document shape
+        plain = StreamingEngine(collection(), EngineConfig(buckets=BUCKETS))
+        assert "trace" not in plain.telemetry()
+
+    def test_chrome_export_schema_and_flows(self, tmp_path):
+        rec = TraceRecorder()
+        engine = StreamingEngine(collection(), EngineConfig(buckets=BUCKETS, trace=rec))
+        with engine:
+            for b in _traffic(3, seed=6):
+                engine.submit(*b)
+            engine.result()
+        path = engine.export_trace(str(tmp_path / "trace.json"))
+        doc = json.loads(open(path).read())
+        events = doc["traceEvents"]
+        phases = {e["ph"] for e in events}
+        assert {"X", "M"} <= phases
+        spans = [e for e in events if e["ph"] == "X"]
+        assert all("trace" in e["args"] and e["dur"] >= 0 for e in spans)
+        names = {e["args"]["name"] for e in events if e["ph"] == "M"}
+        assert any("dispatcher" == n for n in names)
+        # flow arrows pair s/f per absorbed submit
+        s_flows = [e for e in events if e["ph"] == "s"]
+        f_flows = [e for e in events if e["ph"] == "f"]
+        assert len(s_flows) == len(f_flows) == 3
+
+
+class TestMultiStreamTracing:
+    def test_stream_id_on_spans(self):
+        rec = TraceRecorder()
+        engine = MultiStreamEngine(
+            Accuracy(), num_streams=4, config=EngineConfig(buckets=(8,), trace=rec)
+        )
+        rng = np.random.RandomState(0)
+        with engine:
+            for sid in (2, 0, 2):
+                engine.submit(sid, _dyadic(rng, 4), (rng.rand(4) > 0.5).astype(np.int32))
+            engine.result(2)
+        submits = rec.spans("submit")
+        assert [s["args"]["stream_id"] for s in submits] == [2, 0, 2]
+        groups = rec.spans("coalesce")
+        assert all("stream_ids" in g["args"] for g in groups)
+        assert sorted({sid for g in groups for sid in g["args"]["stream_ids"]}) == [0, 2]
+        (res,) = rec.spans("result")
+        assert res["args"]["stream_id"] == 2

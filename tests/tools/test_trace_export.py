@@ -1,0 +1,226 @@
+"""CLI/validator contract of ``tools/trace_export.py`` and the PR-8 additions
+to ``tools/engine_report.py`` (``--json`` + the trace/SLO section).
+
+Both tools are pure stdlib; the fixtures here are hand-built documents, so
+these tests run without jax and pin the schema the smokes gate on.
+"""
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..", "tools"))
+
+import engine_report
+import trace_export
+
+
+def _span(name, trace, tid=1, ts=0.0, dur=1.0, **args):
+    return {
+        "ph": "X", "name": name, "cat": "engine", "pid": 1, "tid": tid,
+        "ts": ts, "dur": dur, "args": {"trace": trace, **args},
+    }
+
+
+def _meta(tid, name):
+    return {"ph": "M", "name": "thread_name", "pid": 1, "tid": tid, "ts": 0,
+            "args": {"name": name}}
+
+
+def _valid_doc():
+    return {
+        "traceEvents": [
+            _meta(1, "dispatcher"),
+            _meta(2, "MainThread"),
+            _span("submit", "t1", tid=2),
+            _span("submit", "t2", tid=2),
+            _span("coalesce", "g1", tid=1, dur=50.0, links=["t1", "t2"], batches=2),
+            _span("queue_wait", "g1", tid=1, dur=10.0),
+            _span("device_step", "g1", tid=1, dur=30.0, step=0, bucket=8),
+            {"ph": "i", "s": "t", "name": "fault", "pid": 1, "tid": 1, "ts": 5.0,
+             "args": {"trace": "g1", "site": "step"}},
+        ]
+    }
+
+
+class TestChromeValidator:
+    def test_valid_document_passes(self):
+        doc = _valid_doc()
+        assert trace_export.validate_chrome_trace(doc) == []
+        assert trace_export.validate_links(doc) == []
+
+    def test_not_a_document(self):
+        assert trace_export.validate_chrome_trace([]) != []
+        assert trace_export.validate_chrome_trace({"traceEvents": {}}) != []
+
+    def test_span_without_dur_flagged(self):
+        doc = _valid_doc()
+        del doc["traceEvents"][2]["dur"]
+        assert any("dur" in e for e in trace_export.validate_chrome_trace(doc))
+
+    def test_span_without_trace_id_flagged(self):
+        doc = _valid_doc()
+        del doc["traceEvents"][2]["args"]["trace"]
+        assert any("args.trace" in e for e in trace_export.validate_chrome_trace(doc))
+
+    def test_unknown_phase_flagged(self):
+        doc = _valid_doc()
+        doc["traceEvents"].append({"ph": "Z", "name": "x", "pid": 1, "tid": 1, "ts": 0})
+        assert any("phase" in e for e in trace_export.validate_chrome_trace(doc))
+
+    def test_missing_thread_metadata_flagged(self):
+        doc = _valid_doc()
+        doc["traceEvents"] = doc["traceEvents"][2:]  # drop the M events
+        assert any("thread_name" in e for e in trace_export.validate_chrome_trace(doc))
+
+    def test_unlinked_submit_flagged(self):
+        doc = _valid_doc()
+        doc["traceEvents"].append(_span("submit", "t9", tid=2))
+        assert any("t9" in e for e in trace_export.validate_links(doc))
+
+    def test_double_absorbed_submit_flagged(self):
+        doc = _valid_doc()
+        doc["traceEvents"].append(
+            _span("coalesce", "g9", tid=1, links=["t1"], batches=1)
+        )
+        assert any("twice" in e for e in trace_export.validate_links(doc))
+
+    def test_unknown_link_flagged(self):
+        doc = _valid_doc()
+        doc["traceEvents"][4]["args"]["links"] = ["t1", "t2", "t404"]
+        assert any("t404" in e for e in trace_export.validate_links(doc))
+
+    def test_fault_sites_extraction(self):
+        assert trace_export.fault_sites(_valid_doc()) == {"step": 1}
+
+    def test_summarize_ranks_queue_wait_into_total(self):
+        text = trace_export.summarize(_valid_doc(), slowest=3)
+        assert "g1" in text and "2 submits" in text
+        assert "60" in text  # coalesce 50 + queue_wait 10
+
+
+class TestOpenMetricsParser:
+    GOOD = (
+        "# TYPE m_steps counter\n"
+        "m_steps_total 3\n"
+        "# TYPE m_faults counter\n"
+        'm_faults_total{site="step"} 2\n'
+        "# TYPE m_lat_us histogram\n"
+        'm_lat_us_bucket{le="1"} 1\n'
+        'm_lat_us_bucket{le="2"} 1\n'
+        'm_lat_us_bucket{le="+Inf"} 2\n'
+        "m_lat_us_sum 5.5\n"
+        "m_lat_us_count 2\n"
+        "# EOF\n"
+    )
+
+    def test_good_exposition_parses(self):
+        fams = trace_export.parse_openmetrics(self.GOOD)
+        assert fams["m_steps"]["type"] == "counter"
+        assert fams["m_lat_us"]["type"] == "histogram"
+        assert fams["m_faults"]["samples"][0]["labels"] == {"site": "step"}
+
+    def test_missing_eof_rejected(self):
+        with pytest.raises(ValueError, match="EOF"):
+            trace_export.parse_openmetrics(self.GOOD.replace("# EOF\n", ""))
+
+    def test_counter_without_total_suffix_rejected(self):
+        bad = self.GOOD.replace("m_steps_total 3", "m_steps 3")
+        with pytest.raises(ValueError, match="_total"):
+            trace_export.parse_openmetrics(bad)
+
+    def test_sample_without_type_rejected(self):
+        bad = "orphan_total 1\n# EOF\n"
+        with pytest.raises(ValueError, match="TYPE"):
+            trace_export.parse_openmetrics(bad)
+
+    def test_non_cumulative_buckets_rejected(self):
+        bad = self.GOOD.replace('m_lat_us_bucket{le="+Inf"} 2', 'm_lat_us_bucket{le="+Inf"} 0')
+        with pytest.raises(ValueError):
+            trace_export.parse_openmetrics(bad)
+
+    def test_count_must_match_inf_bucket(self):
+        bad = self.GOOD.replace("m_lat_us_count 2", "m_lat_us_count 7")
+        with pytest.raises(ValueError, match="_count"):
+            trace_export.parse_openmetrics(bad)
+
+    def test_descending_le_rejected(self):
+        bad = self.GOOD.replace('{le="2"} 1', '{le="0.5"} 1')
+        with pytest.raises(ValueError, match="ascending"):
+            trace_export.parse_openmetrics(bad)
+
+
+class TestCli:
+    def test_validate_and_summarize(self, tmp_path, capsys):
+        p = tmp_path / "trace.json"
+        p.write_text(json.dumps(_valid_doc()))
+        assert trace_export.main([str(p)]) == 0
+        out = capsys.readouterr().out
+        assert "valid trace" in out and "fault sites: step" in out
+
+    def test_invalid_doc_nonzero(self, tmp_path, capsys):
+        doc = _valid_doc()
+        doc["traceEvents"].append(_span("submit", "t9", tid=2))
+        p = tmp_path / "trace.json"
+        p.write_text(json.dumps(doc))
+        assert trace_export.main([str(p)]) == 1
+        assert "INVALID" in capsys.readouterr().out
+
+    def test_openmetrics_path(self, tmp_path, capsys):
+        p = tmp_path / "m.txt"
+        p.write_text(TestOpenMetricsParser.GOOD)
+        assert trace_export.main(["--openmetrics", str(p)]) == 0
+        assert "valid openmetrics" in capsys.readouterr().out
+
+
+class TestEngineReportJson:
+    DOC = {
+        "summary": {"steps": 2, "batches_submitted": 2, "rows_in": 10, "rows_padded": 16},
+        "recent_steps": [{"step": 0, "bucket": 8, "valid": 5, "queue_depth": 0, "ingest_us": 1.0}],
+        "trace": {
+            "spans": 9, "events": 1, "dropped": 0, "capacity": 8192,
+            "by_name": {"coalesce": {"count": 2, "dur_us_total": 60.0, "dur_us_max": 50.0}},
+            "histograms": {"step_latency_us": {"count": 2, "sum": 61.0, "le": [50.0], "counts": [1, 1]}},
+            "slowest_traces": [
+                {"trace": "g1", "root": "coalesce", "dur_us": 60.0, "n_spans": 3,
+                 "breakdown": {"device_step": 30.0, "queue_wait": 10.0}, "links": ["t1", "t2"]},
+            ],
+        },
+    }
+
+    def test_text_mode_renders_trace_section(self, tmp_path, capsys):
+        p = tmp_path / "tele.json"
+        p.write_text(json.dumps(self.DOC))
+        assert engine_report.main([str(p)]) == 0
+        out = capsys.readouterr().out
+        assert "trace / SLO" in out
+        assert "g1" in out and "2 submits" in out and "device_step" in out
+
+    def test_json_mode_emits_normalized_document(self, tmp_path, capsys):
+        p = tmp_path / "tele.json"
+        p.write_text(json.dumps(self.DOC))
+        assert engine_report.main([str(p), "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["summary"]["steps"] == 2
+        assert doc["trace"]["slowest_traces"][0]["trace"] == "g1"
+        assert doc["recent_steps"][0]["bucket"] == 8
+
+    def test_json_mode_without_trace_section(self, tmp_path, capsys):
+        p = tmp_path / "tele.json"
+        doc = {k: v for k, v in self.DOC.items() if k != "trace"}
+        p.write_text(json.dumps(doc))
+        assert engine_report.main([str(p), "--json"]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert "trace" not in out
+
+    def test_summary_nested_trace_is_found(self, tmp_path, capsys):
+        # a live telemetry() dict nests the section inside the summary
+        nested = {"summary": {**self.DOC["summary"], "trace": self.DOC["trace"]},
+                  "recent_steps": []}
+        p = tmp_path / "tele.json"
+        p.write_text(json.dumps(nested))
+        assert engine_report.main([str(p), "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["trace"]["spans"] == 9
+        assert "trace" not in doc["summary"]

@@ -3,6 +3,7 @@
 Usage::
 
     python tools/engine_report.py out/engine_telemetry.json [--steps N]
+    python tools/engine_report.py out/engine_telemetry.json --json  # machine-readable
 
 Reads the document written by ``StreamingEngine.export_telemetry`` (or
 ``python -m metrics_tpu.engine.smoke``) and renders the summary plus the tail
@@ -16,6 +17,13 @@ engine saw any fault activity (ISSUE 6), the fault block: injected faults by
 site, recovery actions (retries, rollbacks, kernel demotions, coalesce
 shrinks, watchdog expiries), the quarantine ledger totals, and snapshot
 write-failure/restore-fallback counts.
+When the engine ran with a flight recorder (``EngineConfig(trace=...)``,
+PR 8) the document carries a ``trace`` section and the report renders the
+trace/SLO block: spans recorded/dropped, latency histogram counts, and the
+slowest-N trace ids with their per-span breakdown — the causal answer to
+"which batch's journey produced the tail". ``--json`` emits the normalized
+document (summary + recent steps + trace) as machine-readable JSON for
+dashboards and scripts.
 Pure stdlib — safe to run anywhere the JSON lands (no jax import, so it works
 on a machine without the accelerator stack).
 """
@@ -132,6 +140,38 @@ def render(doc: dict, steps: int = 10) -> str:
     w = max(len(k) for k, _ in rows)
     for k, v in rows:
         lines.append(f"  {k:<{w}}  {_fmt(v)}")
+    tr = _trace_section(doc)
+    if tr:
+        lines.append("── trace / SLO " + "─" * 45)
+        dropped = tr.get("dropped", 0)
+        lines.append(
+            f"  spans {_fmt(tr.get('spans'))} · events {_fmt(tr.get('events'))}"
+            + (f" · DROPPED {_fmt(dropped)} (ring full)" if dropped else "")
+        )
+        hists = tr.get("histograms", {})
+        for name, h in sorted(hists.items()):
+            lines.append(
+                f"  {name}: n={_fmt(h.get('count'))} sum={_fmt(h.get('sum'))}µs"
+            )
+        slowest = tr.get("slowest_traces", [])
+        if slowest:
+            lines.append(f"  slowest {len(slowest)} traces (id · root · end-to-end µs · breakdown):")
+            for t in slowest:
+                brk = ", ".join(
+                    f"{k} {_fmt(v)}" for k, v in sorted(
+                        t.get("breakdown", {}).items(), key=lambda kv: -kv[1]
+                    )
+                )
+                extras = []
+                if t.get("links"):
+                    extras.append(f"{len(t['links'])} submits")
+                if t.get("stream_ids"):
+                    extras.append(f"streams {t['stream_ids']}")
+                lines.append(
+                    f"    {t.get('trace'):<8} {t.get('root'):<10} {_fmt(t.get('dur_us'))}"
+                    + (f"  ({'; '.join(extras)})" if extras else "")
+                    + (f"  [{brk}]" if brk else "")
+                )
     recent = doc.get("recent_steps", [])[-steps:]
     if recent:
         lines.append(f"── last {len(recent)} steps " + "─" * 44)
@@ -148,13 +188,33 @@ def render(doc: dict, steps: int = 10) -> str:
     return "\n".join(lines)
 
 
-def main() -> int:
+def _trace_section(doc: dict):
+    """The flight-recorder summary — exported top-level since PR 8, but a
+    live ``engine.telemetry()`` dict carries it inside the summary."""
+    return doc.get("trace") or doc.get("summary", {}).get("trace")
+
+
+def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("telemetry_json")
     ap.add_argument("--steps", type=int, default=10, help="step records to show")
-    args = ap.parse_args()
+    ap.add_argument(
+        "--json", action="store_true",
+        help="emit the normalized document (summary/recent_steps/trace) as JSON",
+    )
+    args = ap.parse_args(argv)
     with open(args.telemetry_json) as f:
         doc = json.load(f)
+    if args.json:
+        out = {
+            "summary": {k: v for k, v in doc.get("summary", {}).items() if k != "trace"},
+            "recent_steps": doc.get("recent_steps", []),
+        }
+        tr = _trace_section(doc)
+        if tr:
+            out["trace"] = tr
+        print(json.dumps(out, indent=2, sort_keys=True))
+        return 0
     print(render(doc, steps=args.steps))
     return 0
 

@@ -1,0 +1,304 @@
+"""Validate and summarize flight-recorder artifacts (pure stdlib).
+
+Usage::
+
+    python tools/trace_export.py out/trace_chaos.json            # validate + summary
+    python tools/trace_export.py out/trace_chaos.json --slowest 10
+    python tools/trace_export.py --openmetrics out/obs_metrics.txt
+
+Consumes the two exporter formats of ``metrics_tpu/engine/trace.py``:
+
+* Chrome/Perfetto trace-event JSON (``StreamingEngine.export_trace``):
+  :func:`validate_chrome_trace` checks the event schema (phases, required
+  fields, metadata thread names) and :func:`validate_links` checks the
+  coalesce contract — every megabatch span's ``links`` resolve to submit
+  spans present in the document, and every submit span is absorbed by
+  exactly one megabatch.
+* OpenMetrics text (``StreamingEngine.metrics_text``): :func:`parse_openmetrics`
+  parses the exposition and raises ``ValueError`` on malformed families —
+  counters must sample ``_total``, histogram buckets must be cumulative with
+  ascending ``le`` edges ending in ``+Inf``, ``_count`` must equal the
+  ``+Inf`` bucket, and the document must end with ``# EOF``.
+
+Like ``tools/engine_report.py``, deliberately jax-free: runs anywhere the
+artifacts land. ``make obs-smoke`` and ``make chaos-smoke`` drive the
+validators as CI gates.
+"""
+import argparse
+import json
+import re
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+_PHASES = {"X", "i", "M", "s", "f"}
+
+_SAMPLE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?"
+    r" (?P<value>[^ ]+)$"
+)
+
+
+# ----------------------------------------------------------- chrome trace JSON
+
+
+def validate_chrome_trace(doc: Any) -> List[str]:
+    """Schema-check one trace-event document; returns error strings
+    (empty = valid)."""
+    errors: List[str] = []
+    if not isinstance(doc, dict) or not isinstance(doc.get("traceEvents"), list):
+        return ["document must be an object with a 'traceEvents' list"]
+    events = doc["traceEvents"]
+    if not events:
+        errors.append("traceEvents is empty")
+    threads: Dict[int, str] = {}
+    for i, ev in enumerate(events):
+        where = f"traceEvents[{i}]"
+        if not isinstance(ev, dict):
+            errors.append(f"{where}: not an object")
+            continue
+        ph = ev.get("ph")
+        if ph not in _PHASES:
+            errors.append(f"{where}: unknown phase {ph!r} (expected one of {sorted(_PHASES)})")
+            continue
+        for key in ("name", "pid", "tid", "ts"):
+            if key not in ev:
+                errors.append(f"{where}: missing {key!r}")
+        if not isinstance(ev.get("ts", 0), (int, float)) or ev.get("ts", 0) < 0:
+            errors.append(f"{where}: ts must be a non-negative number")
+        if ph == "X":
+            if not isinstance(ev.get("dur"), (int, float)) or ev["dur"] < 0:
+                errors.append(f"{where}: complete event needs a non-negative 'dur'")
+            if not isinstance(ev.get("args", {}).get("trace"), str):
+                errors.append(f"{where}: span is missing its args.trace id")
+        elif ph == "i":
+            if ev.get("s") not in ("t", "p", "g"):
+                errors.append(f"{where}: instant event needs a scope 's' of t/p/g")
+        elif ph == "M":
+            if ev.get("name") == "thread_name":
+                name = ev.get("args", {}).get("name")
+                if not name:
+                    errors.append(f"{where}: thread_name metadata without args.name")
+                elif threads.get(ev.get("tid")) not in (None, name):
+                    errors.append(f"{where}: tid {ev.get('tid')} renamed mid-document")
+                else:
+                    threads[ev.get("tid")] = name
+        elif ph in ("s", "f"):
+            if "id" not in ev:
+                errors.append(f"{where}: flow event needs an 'id'")
+    for ev in events:
+        if isinstance(ev, dict) and ev.get("ph") in ("X", "i") and ev.get("tid") not in threads:
+            errors.append(f"event {ev.get('name')!r} on tid {ev.get('tid')} has no thread_name metadata")
+            break
+    return errors
+
+
+def _spans(doc: Dict[str, Any], name: Optional[str] = None) -> List[Dict[str, Any]]:
+    return [
+        ev for ev in doc.get("traceEvents", [])
+        if isinstance(ev, dict) and ev.get("ph") == "X"
+        and (name is None or ev.get("name") == name)
+    ]
+
+
+def validate_links(doc: Dict[str, Any]) -> List[str]:
+    """The coalesce contract: every megabatch span's ``links`` resolve to
+    submit spans in the document, and every submit span is absorbed by
+    exactly ONE megabatch (groups partition the submit stream)."""
+    errors: List[str] = []
+    submit_tids = [ev["args"]["trace"] for ev in _spans(doc, "submit")]
+    submit_set = set(submit_tids)  # membership is per-link on big traces
+    absorbed: Dict[str, str] = {}
+    for ev in _spans(doc, "coalesce"):
+        gid = ev["args"].get("trace")
+        links = ev["args"].get("links", [])
+        if not links:
+            errors.append(f"megabatch {gid} has no submit links")
+            continue
+        for link in links:
+            if link not in submit_set:
+                errors.append(f"megabatch {gid} links unknown submit trace {link!r}")
+            elif link in absorbed:
+                errors.append(
+                    f"submit trace {link!r} absorbed twice ({absorbed[link]} and {gid})"
+                )
+            else:
+                absorbed[link] = gid
+    for tid in submit_tids:
+        if tid not in absorbed:
+            errors.append(f"submit trace {tid!r} was never absorbed by a megabatch span")
+    return errors
+
+
+def fault_sites(doc: Dict[str, Any]) -> Dict[str, int]:
+    """Injected-fault firings by site from the ``fault`` instant events."""
+    out: Dict[str, int] = {}
+    for ev in doc.get("traceEvents", []):
+        if isinstance(ev, dict) and ev.get("ph") == "i" and ev.get("name") == "fault":
+            site = ev.get("args", {}).get("site")
+            if site:
+                out[site] = out.get(site, 0) + 1
+    return out
+
+
+def summarize(doc: Dict[str, Any], slowest: int = 5) -> str:
+    """Slowest-N trace summary rendered from an exported trace document.
+
+    The end-to-end definition (root = coalesce span else longest; total =
+    root + queue waits) mirrors ``TraceRecorder.summary()`` — a deliberate
+    second implementation (this tool runs where only the JSON lands), kept
+    in lockstep by the parity pin in ``tests/engine/test_trace.py``."""
+    by_trace: Dict[str, List[Dict[str, Any]]] = {}
+    for ev in _spans(doc):
+        by_trace.setdefault(ev["args"]["trace"], []).append(ev)
+    roots = []
+    for trace, members in by_trace.items():
+        if trace == "engine":
+            continue
+        root = next((m for m in members if m.get("name") == "coalesce"), None)
+        if root is None:
+            # a submit-only trace is no journey — it lives in the g-trace
+            # that absorbed it (same rule as TraceRecorder.summary)
+            non_submit = [m for m in members if m.get("name") != "submit"]
+            if not non_submit:
+                continue
+            root = max(non_submit, key=lambda e: e.get("dur", 0))
+        total = root.get("dur", 0) + sum(
+            m.get("dur", 0) for m in members if m.get("name") == "queue_wait"
+        )
+        roots.append((total, root, members))
+    roots.sort(key=lambda rm: -rm[0])
+    lines = [f"── slowest {min(slowest, len(roots))} traces " + "─" * 36]
+    for total, root, members in roots[:slowest]:
+        parts = ", ".join(
+            f"{m['name']} {m.get('dur', 0):,.0f}µs" for m in members if m is not root
+        )
+        links = root.get("args", {}).get("links")
+        lines.append(
+            f"  {root['args']['trace']:<8} {root['name']:<10} {total:>12,.1f}µs"
+            + (f"  ← {len(links)} submits" if links else "")
+            + (f"  [{parts}]" if parts else "")
+        )
+    return "\n".join(lines)
+
+
+# ------------------------------------------------------------ openmetrics text
+
+
+def parse_openmetrics(text: str) -> Dict[str, Dict[str, Any]]:
+    """Parse one OpenMetrics exposition into ``{family: {type, samples}}``.
+
+    Raises ``ValueError`` on structural violations: no ``# EOF`` terminator,
+    samples without a TYPE, counter samples not ending ``_total``, histogram
+    buckets with non-ascending ``le`` edges or non-cumulative counts, missing
+    ``+Inf`` bucket, or ``_count`` disagreeing with the ``+Inf`` bucket.
+    """
+    lines = text.splitlines()
+    if not lines or lines[-1].strip() != "# EOF":
+        raise ValueError("exposition must end with '# EOF'")
+    families: Dict[str, Dict[str, Any]] = {}
+    for ln, line in enumerate(lines[:-1], 1):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                families[parts[2]] = {"type": parts[3], "samples": []}
+            continue
+        m = _SAMPLE.match(line)
+        if m is None:
+            raise ValueError(f"line {ln}: unparseable sample {line!r}")
+        name, labels_raw, value = m.group("name"), m.group("labels"), m.group("value")
+        try:
+            value_f = float(value)
+        except ValueError:
+            raise ValueError(f"line {ln}: non-numeric value {value!r}") from None
+        labels: Dict[str, str] = {}
+        for pair in (labels_raw or "").split(","):
+            if not pair:
+                continue
+            if "=" not in pair:
+                raise ValueError(f"line {ln}: malformed label {pair!r}")
+            k, v = pair.split("=", 1)
+            labels[k.strip()] = v.strip().strip('"')
+        family = next(
+            (f for f in families if name == f or name.startswith(f + "_")), None
+        )
+        if family is None:
+            raise ValueError(f"line {ln}: sample {name!r} has no preceding TYPE")
+        families[family]["samples"].append({"name": name, "labels": labels, "value": value_f})
+    for family, info in families.items():
+        if info["type"] == "counter":
+            for s in info["samples"]:
+                if not s["name"].endswith("_total"):
+                    raise ValueError(
+                        f"counter family {family!r} has sample {s['name']!r} "
+                        "without the _total suffix"
+                    )
+        elif info["type"] == "histogram":
+            buckets = [s for s in info["samples"] if s["name"] == family + "_bucket"]
+            count = next((s for s in info["samples"] if s["name"] == family + "_count"), None)
+            if not buckets or count is None:
+                raise ValueError(f"histogram family {family!r} is missing buckets or _count")
+            if buckets[-1]["labels"].get("le") != "+Inf":
+                raise ValueError(f"histogram family {family!r} must end with le='+Inf'")
+            prev_le, prev_n = float("-inf"), -1.0
+            for b in buckets:
+                le = b["labels"].get("le")
+                le_f = float("inf") if le == "+Inf" else float(le)
+                if le_f <= prev_le:
+                    raise ValueError(f"histogram family {family!r}: le edges not ascending")
+                if b["value"] < prev_n:
+                    raise ValueError(f"histogram family {family!r}: bucket counts not cumulative")
+                prev_le, prev_n = le_f, b["value"]
+            if buckets[-1]["value"] != count["value"]:
+                raise ValueError(
+                    f"histogram family {family!r}: _count {count['value']} != "
+                    f"+Inf bucket {buckets[-1]['value']}"
+                )
+    return families
+
+
+# ------------------------------------------------------------------------- CLI
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("trace_json", nargs="?", help="Chrome/Perfetto trace-event JSON")
+    ap.add_argument("--openmetrics", help="OpenMetrics text exposition to validate")
+    ap.add_argument("--slowest", type=int, default=5, help="traces to summarize")
+    args = ap.parse_args(argv)
+    if not args.trace_json and not args.openmetrics:
+        ap.error("give a trace JSON path and/or --openmetrics")
+    rc = 0
+    if args.trace_json:
+        with open(args.trace_json) as f:
+            doc = json.load(f)
+        errors = validate_chrome_trace(doc) + validate_links(doc)
+        for e in errors:
+            print(f"INVALID: {e}")
+            rc = 1
+        if rc == 0:
+            spans = _spans(doc)
+            sites = fault_sites(doc)
+            print(
+                f"valid trace: {len(spans)} spans"
+                + (f", fault sites: {', '.join(sorted(sites))}" if sites else "")
+            )
+            print(summarize(doc, args.slowest))
+    if args.openmetrics:
+        with open(args.openmetrics) as f:
+            text = f.read()
+        try:
+            families = parse_openmetrics(text)
+        except ValueError as e:
+            print(f"INVALID: {e}")
+            rc = 1
+        else:
+            n_hist = sum(1 for f_ in families.values() if f_["type"] == "histogram")
+            print(f"valid openmetrics: {len(families)} families ({n_hist} histograms)")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
