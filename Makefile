@@ -2,9 +2,9 @@
 # Default flow runs the smoke checks (seconds) before the full suite.
 # Sidecar artifacts (telemetry JSON, analysis reports) land under out/
 # (gitignored) — never in the repo root.
-.PHONY: all test engine-smoke kernels-smoke mesh-smoke chaos-smoke obs-smoke analyze clean native bench
+.PHONY: all test engine-smoke kernels-smoke mesh-smoke streams-smoke chaos-smoke obs-smoke analyze clean native bench
 
-all: engine-smoke kernels-smoke mesh-smoke chaos-smoke obs-smoke analyze test
+all: engine-smoke kernels-smoke mesh-smoke streams-smoke chaos-smoke obs-smoke analyze test
 
 test:
 	python -m pytest tests/ -q
@@ -30,6 +30,16 @@ kernels-smoke:
 # steady step's HLO, >=1 in the step-sync one (metrics_tpu/engine/mesh_smoke.py).
 mesh-smoke:
 	JAX_PLATFORMS=cpu python -m metrics_tpu.engine.mesh_smoke
+
+# Stream-sharding gate, CPU-safe (bootstraps the 8-device virtual mesh):
+# S=64 Zipfian streams sharded over 8 shards behind a resident=2 paged arena
+# (capacity 16 << S) must match an unsharded unpaged oracle bit-exactly, with
+# zero steady compiles after warmup, ONE device computation per results(),
+# collective-free routed-step HLO, and kill/resume past a spill with exact
+# replay (metrics_tpu/engine/streams_smoke.py). Docs: docs/serving.md
+# "Stream sharding & paging".
+streams-smoke:
+	JAX_PLATFORMS=cpu python -m metrics_tpu.engine.streams_smoke
 
 # Fault-tolerance gate, CPU-safe and seeded (metrics_tpu/engine/chaos_smoke.py):
 # every injection point in engine/faults.py fires at least once — transactional

@@ -1291,6 +1291,36 @@ def bench_engine_mesh_dispatch() -> dict:
     return json.loads(proc.stdout.strip().splitlines()[-1])
 
 
+# ------------------------------------------- config: stream capacity (r10)
+
+def bench_stream_capacity() -> dict:
+    """Stream-sharded multi-tenant capacity (ISSUE 9): S=10^4 Zipfian streams
+    on the 8-device virtual mesh behind a resident=16/shard paged arena, in
+    ONE subprocess run (``metrics_tpu/engine/stream_bench`` owns the pinned
+    protocol — ratios-in-one-run; docs/benchmarking.md "Stream capacity
+    (r10)"). Absolute rates carry ``liveness_only``; the durable facts:
+    per-shard resident state is (world, resident, n) rows exactly, the
+    same-S unsharded deferred engine carries S/resident x the device bytes
+    (measured, not modeled), zero steady compiles after warmup, and the
+    p50/p99 ``result()`` pair under the Zipfian law."""
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    )
+    env["JAX_PLATFORMS"] = "cpu"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "metrics_tpu.engine.stream_bench"],
+            env=env, capture_output=True, text=True, timeout=900,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": "stream_capacity timed out"}
+    if proc.returncode != 0:
+        return {"error": proc.stderr[-500:]}
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
 # ---------------------------------------------- config: tracing overhead (r9)
 
 def bench_obs_overhead() -> dict:
@@ -2148,6 +2178,7 @@ def main() -> None:
         ("engine_steady_state", bench_engine_steady_state),
         ("engine_dispatch", bench_engine_dispatch),
         ("engine_mesh_dispatch", bench_engine_mesh_dispatch),
+        ("stream_capacity", bench_stream_capacity),
         ("obs_overhead", bench_obs_overhead),
         ("kernel_microbench", bench_kernel_microbench),
     ):

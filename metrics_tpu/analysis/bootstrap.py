@@ -11,9 +11,15 @@ covered by the 8-device ``make mesh-smoke`` — bootstrapping a virtual mesh
 here would double that gate); "deferred" runs on a 1-device mesh, which
 lowers the REAL shard-local step and boundary merge programs (the same
 trace the 8-device mesh compiles, minus devices — exactly what the jaxpr
-rules inspect). Each engine serves a few ragged batches so its program set
-is built, then ``EngineAnalysis.check`` runs the full rule set. CPU-safe by
-construction; the whole matrix is small buckets and tiny traffic.
+rules inspect). The stream-SHARDED serving mode (ISSUE 9) joins the matrix
+the same way: a 1-device-mesh ``stream_shard=True`` MultiStreamEngine with a
+resident cap below its stream count, so the audited routed step is the real
+paged-arena program (slot-addressed segmented update over ``(world,
+resident, n)`` buffers) — the ``no-collectives-in-deferred-step`` rule then
+pins the routed path at jaxpr AND HLO level exactly like the deferred one.
+Each engine serves a few ragged batches so its program set is built, then
+``EngineAnalysis.check`` runs the full rule set. CPU-safe by construction;
+the whole matrix is small buckets and tiny traffic.
 """
 from typing import Dict, Iterable, List, Optional, Tuple
 
@@ -73,6 +79,23 @@ def bootstrap_engines(
                         else:
                             engine.result()
                     out.append((label, engine))
+        # stream-sharded paged serving (ISSUE 9): resident cap below the
+        # stream count, so the audited step is the REAL slot-addressed paged
+        # program and the traffic actually exercises the pager
+        engine = MultiStreamEngine(
+            Accuracy(), num_streams=4,
+            config=EngineConfig(
+                buckets=(8,), kernel_backend=backend,
+                mesh=mesh, axis="dp", mesh_sync="deferred",
+            ),
+            stream_shard=True, resident_streams=2,
+        )
+        with engine:
+            for i, b in enumerate(batches):
+                engine.submit(i % 4, *b)
+            engine.result(0)
+            engine.results()
+        out.append((f"sshard/arena/multistream/{backend}", engine))
     return out
 
 

@@ -194,10 +194,20 @@ class EngineAnalysis:
                 if self._kernel_path_expected(engine):
                     report.extend(R.check_pallas_call_count(jaxpr, min_count=1, where=where))
             if engine._layout is not None:
+                shard_shapes = None
+                if getattr(engine, "_stream_shard", False):
+                    # the paged arena's carried forms: per-device (resident, n)
+                    # and global (world, resident, n) — the flat (n,) form
+                    # never exists inside a routed step
+                    shard_shapes = set()
+                    for k, n in engine._layout.buffer_sizes().items():
+                        shard_shapes.add(((engine._resident, n), k))
+                        shard_shapes.add(((engine._world, engine._resident, n), k))
                 report.extend(R.check_arena_pack_fused(
                     jaxpr, engine._layout, where=where,
                     worlds=(engine._world,) if deferred else (),
                     state_leaves=len(jax.tree_util.tree_leaves(state_abs)),
+                    buffer_shapes=shard_shapes,
                 ))
             if engine._donate and hlo is not None:
                 n_donated = (
@@ -213,14 +223,18 @@ class EngineAnalysis:
         cap_detail = ""
         n_owned = self._owned_programs(engine)
         if n_owned is not None:
+            multistream = hasattr(engine, "num_streams")
             cap = (
                 len(engine._cfg.buckets) * max(1, len(structures))
-                + 1                       # compute
-                + (1 if deferred else 0)  # boundary merge
+                + 1                           # compute
+                + (1 if deferred else 0)      # boundary merge
+                + (1 if multistream else 0)   # batched all-streams compute
             )
             cap_detail = (
                 f"{len(engine._cfg.buckets)} buckets x {max(1, len(structures))} "
-                f"payload structures + compute" + (" + merge" if deferred else "")
+                f"payload structures + compute"
+                + (" + merge" if deferred else "")
+                + (" + batched results" if multistream else "")
             )
             report.extend(R.check_compile_cap(
                 n_owned, cap, where=f"{label}/programs", detail=cap_detail

@@ -105,6 +105,7 @@ def check_arena_pack_fused(
     where: str = "",
     worlds: Iterable[int] = (),
     state_leaves: Optional[int] = None,
+    buffer_shapes: Optional[Iterable[Tuple[Tuple[int, ...], str]]] = None,
 ) -> List[Finding]:
     """Rule ``arena-pack-fused``: in an arena-carrying step program, flag
 
@@ -115,6 +116,13 @@ def check_arena_pack_fused(
     * every scatter/dynamic-update-slice whose OUTPUT is exactly an arena
       buffer (per-leaf writes into the packed form instead of one concat
       per dtype).
+
+    ``buffer_shapes`` overrides the default per-shard/shard-stacked buffer
+    signatures with the engine's REAL carried forms — the stream-sharded
+    paged arena carries ``(resident, n)``/``(world, resident, n)`` buffers
+    whose flat ``(n,)`` form never exists in its step, and matching the flat
+    form there would misfire on the segmented update's legitimate per-slot
+    scatters whenever a stacked state leaf happens to share it.
     """
     from metrics_tpu.analysis.program import unwrap_jaxpr
 
@@ -129,7 +137,11 @@ def check_arena_pack_fused(
                 "belong OUTSIDE the compiled step (engine/pipeline.py::_step_shadow)"
             ),
         ))
-    arena_sigs = _arena_avals(layout, worlds)
+    arena_sigs = (
+        set(tuple(s) for s in buffer_shapes)
+        if buffer_shapes is not None
+        else _arena_avals(layout, worlds)
+    )
     for path, eqn in _pack_level_eqns(unwrap_jaxpr(jaxpr)):
         name = eqn.primitive.name
         if not (name.startswith("scatter") or name == "dynamic_update_slice"):

@@ -73,7 +73,8 @@ _ENGINE_LOCKED_METHODS = frozenset({
     # lock taken by the caller: _process_group holds it across the whole
     # group, result()/state()/stream_state() across merges and reads
     "_do_step", "_recover_step", "_bound_inflight", "_execute_chunk",
-    "_execute_payload", "_merged_state", "_latch_host_attrs",
+    "_run_padded_step", "_execute_payload", "_execute_routed", "_page_round",
+    "_reset_locked", "_merged_state", "_latch_host_attrs",
     "_record_quarantine", "_screen_group",
 })
 

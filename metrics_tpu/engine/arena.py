@@ -123,6 +123,21 @@ class ArenaLayout:
             for k, n in self._totals.items()
         }
 
+    def abstract_stream_stacked(self, world: int, rows: int) -> Dict[str, jax.ShapeDtypeStruct]:
+        """``ShapeDtypeStruct`` dict of the STREAM-SHARDED paged arena: one
+        ``(world, rows, n)`` buffer per dtype, where this layout describes ONE
+        stream's state (``n`` = one stream's flat element count per dtype) and
+        ``rows`` is the per-shard resident-slot count. Row ``(k, j)`` is shard
+        ``k``'s slot ``j`` — a contiguous per-dtype vector, which is what lets
+        the pager spill/fault single streams without touching their
+        neighbours. Dim 0 shards over the mesh axis; within a shard,
+        :meth:`unpack_stacked`/:meth:`pack_stacked` convert ``(rows, n)``
+        buffers to/from the slot-stacked logical state tree."""
+        return {
+            k: jax.ShapeDtypeStruct((int(world), int(rows), n), jnp.dtype(k))
+            for k, n in self._totals.items()
+        }
+
     def matches(self, arena: Dict[str, Any], world: Optional[int] = None) -> bool:
         """Shape/dtype compatibility of the BUFFERS (used when restoring
         snapshots); with ``world`` the expected form is the shard-stacked

@@ -28,7 +28,15 @@ fault-free run on the same traffic:
 5. **Deferred boundary merge** — on a 1-device mesh in deferred mode an
    injected merge fault retries behind ``result()`` (the merge is a
    non-donated read; the carried state stays consistent).
-6. **Dead dispatcher** — a fatal fault kills the dispatcher thread outright;
+6. **Stream-shard paging** (ISSUE 9) — a resident-capped stream-sharded
+   MultiStreamEngine under seeded Zipfian traffic: ``page_out``/``page_in``
+   transients fire mid-stream and retry (the pager commits bookkeeping only
+   after the bytes moved), every per-stream result stays bit-identical to an
+   unsharded unpaged oracle, and a mid-stream snapshot taken WITH rows
+   spilled backs the exact restore matrix {sharded+paged → same-world
+   verbatim, → single-device merged} — plus the refusal of a plain snapshot
+   into a sharded engine.
+7. **Dead dispatcher** — a fatal fault kills the dispatcher thread outright;
    ``submit(timeout=)`` surfaces the sticky error instead of deadlocking,
    and ``reset()`` drains the dead queue and re-arms. A transient
    ``snapshot_read`` fault retries inside ``restore()``.
@@ -100,11 +108,23 @@ def chaos_injectors():
     """Fresh occurrence-deterministic injectors, one per chaos phase:
     ``chaos`` (seed 7) drives the single-device sweep over 8 sites,
     ``snapshot_read`` (seed 11) the transient read fault under restore,
-    ``merge`` (seed 13) the deferred boundary-merge failure, and
-    ``dispatcher_kill`` (seed 17) the fatal worker death."""
+    ``merge`` (seed 13) the deferred boundary-merge failure,
+    ``dispatcher_kill`` (seed 17) the fatal worker death, and ``paging``
+    (seed 19) the stream-shard pager's spill/fault-in transients."""
     from metrics_tpu.engine import FaultInjector, FaultSpec
 
     return {
+        "paging": FaultInjector(
+            seed=19,
+            plan={
+                # first spill and second fault-in fail transiently: both
+                # retry against untouched buffers (the pager commits its
+                # bookkeeping only after the bytes moved), so the chaos
+                # stream's results stay bit-identical to fault-free
+                "page_out": FaultSpec(schedule=(0,)),
+                "page_in": FaultSpec(schedule=(1,)),
+            },
+        ),
         "chaos": FaultInjector(
             seed=7,
             plan={
@@ -191,6 +211,42 @@ def kill_engine_config(injector, trace=None):
     return EngineConfig(buckets=(8,), max_queue=2, fault_injector=injector, trace=trace)
 
 
+# stream-shard chaos scenario (ISSUE 9): S streams behind a resident cap
+# small enough that the seeded Zipf stream MUST spill — page_out/page_in are
+# real row movements, not no-ops — on a 1-device mesh (W=1 lowers the same
+# routed paged-arena program the 8-device mesh compiles; `make streams-smoke`
+# covers the multi-shard topology)
+SSHARD_STREAMS = 6
+SSHARD_RESIDENT = 2
+
+
+def stream_shard_traffic():
+    """Seeded Zipfian ``(stream_id, preds, target)`` stream — skewed ids are
+    what makes the LRU meaningful (``engine/traffic.py``; uniform traffic
+    cannot distinguish a pager from a thrash loop). Dyadic values keep every
+    parity claim bit-exact under any routing/paging order."""
+    from metrics_tpu.engine.traffic import zipf_traffic
+
+    return zipf_traffic(SSHARD_STREAMS, 18, alpha=1.1, seed=23)
+
+
+def stream_shard_engine_config(injector, trace=None, snapshot_dir=None):
+    """The paged stream-sharded chaos engine's config: 1-device mesh,
+    deferred sync (the routed step's contract), ``coalesce=1`` for the same
+    span-sequence determinism reason as :func:`resume_engine_config` —
+    page-site occurrence indices must not depend on producer timing."""
+    import jax
+    from jax.sharding import Mesh
+
+    from metrics_tpu.engine import EngineConfig
+
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("dp",))
+    return EngineConfig(
+        buckets=(8, 32), coalesce=1, mesh=mesh, axis="dp", mesh_sync="deferred",
+        fault_injector=injector, trace=trace, snapshot_dir=snapshot_dir,
+    )
+
+
 def main(out_path: str = "out/chaos_telemetry.json") -> int:
     # sidecar artifacts default under the gitignored out/ dir — telemetry is
     # regenerated by every smoke run and must never land in the repo root
@@ -204,6 +260,7 @@ def main(out_path: str = "out/chaos_telemetry.json") -> int:
         BackpressureTimeout,
         EngineConfig,
         EngineDispatchError,
+        MultiStreamEngine,
         StreamingEngine,
         TraceRecorder,
     )
@@ -313,6 +370,126 @@ def main(out_path: str = "out/chaos_telemetry.json") -> int:
     _check(merge_inj.fired.get("merge", 0) == 1, "merge fault did not fire")
     _check(deferred.stats.retries == 1, "merge fault was not retried")
     fired_sites |= set(merge_inj.fired)
+
+    # ------------------- stream-sharded paging: spill/fault-in under chaos
+    # (ISSUE 9) a resident-capped stream-sharded engine under seeded Zipfian
+    # traffic: page_out/page_in transients fire mid-stream and retry against
+    # untouched buffers; every per-stream result stays bit-identical to an
+    # UNSHARDED UNPAGED oracle; a mid-stream snapshot (taken while rows were
+    # spilled) then backs BOTH sides of the stream-shard restore matrix —
+    # same-world verbatim, and merged into a single-device engine — each with
+    # exact replay from the snapshot cursor.
+    sstraffic = stream_shard_traffic()
+    oracle = MultiStreamEngine(collection(), SSHARD_STREAMS, EngineConfig(buckets=(8, 32)))
+    with oracle:
+        for sid, p, t in sstraffic:
+            oracle.submit(sid, p, t)
+        want_ss = {
+            sid: {k: np.asarray(v) for k, v in r.items()}
+            for sid, r in oracle.results().items()
+        }
+
+    def _ss_parity(tag, got):
+        for sid in want_ss:
+            for k in want_ss[sid]:
+                _check(
+                    np.array_equal(got[sid][k], want_ss[sid][k], equal_nan=True),
+                    f"{tag}: stream {sid} {k} {got[sid][k]} != {want_ss[sid][k]}",
+                )
+
+    page_inj = injs["paging"]
+    ss_dir = tempfile.mkdtemp(prefix="metrics_tpu_sshard_")
+    paged = MultiStreamEngine(
+        collection(), SSHARD_STREAMS,
+        stream_shard_engine_config(page_inj, trace=rec, snapshot_dir=ss_dir),
+        stream_shard=True, resident_streams=SSHARD_RESIDENT,
+    )
+    ss_cut = 12
+    with paged:
+        for sid, p, t in sstraffic[:ss_cut]:
+            paged.submit(sid, p, t)
+        paged.snapshot()  # mid-stream, with rows spilled: paged rows MUST be covered
+        spilled_at_snap = paged._pager.spilled_count()
+        for sid, p, t in sstraffic[ss_cut:]:
+            paged.submit(sid, p, t)
+        got_ss = {
+            sid: {k: np.asarray(v) for k, v in r.items()}
+            for sid, r in paged.results().items()
+        }
+    _ss_parity("stream-shard chaos parity", got_ss)
+    _check(
+        page_inj.fired.get("page_out", 0) == 1 and page_inj.fired.get("page_in", 0) == 1,
+        f"paging fault sites did not fire: {dict(page_inj.fired)}",
+    )
+    _check(paged.stats.retries >= 2, f"paging faults were not retried: {paged.stats.retries}")
+    _check(
+        paged.stats.page_outs >= 1 and spilled_at_snap >= 1,
+        f"the resident cap never bound (page_outs={paged.stats.page_outs}, "
+        f"spilled at snapshot={spilled_at_snap})",
+    )
+    _check(
+        {k: tuple(v.shape) for k, v in paged._state.items()}
+        == {k: (1, SSHARD_RESIDENT, n) for k, n in paged._layout.buffer_sizes().items()},
+        "paged arena buffers are not the (world, resident, n) per-shard form",
+    )
+    fired_sites |= set(page_inj.fired)
+
+    del paged
+    same_world = MultiStreamEngine(
+        collection(), SSHARD_STREAMS,
+        stream_shard_engine_config(None, snapshot_dir=ss_dir),
+        stream_shard=True, resident_streams=SSHARD_RESIDENT,
+    )
+    meta_ss = same_world.restore()
+    _check(
+        int(meta_ss["batches_done"]) == ss_cut,
+        f"stream-shard snapshot cursor should be {ss_cut}, got {meta_ss['batches_done']}",
+    )
+    with same_world:
+        for sid, p, t in sstraffic[ss_cut:]:
+            same_world.submit(sid, p, t)
+        got_same = {
+            sid: {k: np.asarray(v) for k, v in r.items()}
+            for sid, r in same_world.results().items()
+        }
+    _ss_parity("same-world restore replay past a spill", got_same)
+
+    merged_engine = MultiStreamEngine(
+        collection(), SSHARD_STREAMS, EngineConfig(buckets=(8, 32), snapshot_dir=ss_dir)
+    )
+    merged_engine.restore()
+    with merged_engine:
+        for sid, p, t in sstraffic[ss_cut:]:
+            merged_engine.submit(sid, p, t)
+        got_merged = {
+            sid: {k: np.asarray(v) for k, v in r.items()}
+            for sid, r in merged_engine.results().items()
+        }
+    _ss_parity("single-device merged restore replay", got_merged)
+
+    # the matrix is EXACT: a non-sharded snapshot has no residency provenance
+    # a sharded engine could seat — it must refuse, not guess
+    plain_dir = tempfile.mkdtemp(prefix="metrics_tpu_sshard_plain_")
+    plain = MultiStreamEngine(
+        collection(), SSHARD_STREAMS, EngineConfig(buckets=(8, 32), snapshot_dir=plain_dir)
+    )
+    with plain:
+        plain.submit(*sstraffic[0])
+        plain.snapshot()
+    refuser = MultiStreamEngine(
+        collection(), SSHARD_STREAMS,
+        stream_shard_engine_config(None, snapshot_dir=plain_dir),
+        stream_shard=True, resident_streams=SSHARD_RESIDENT,
+    )
+    from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+    try:
+        refuser.restore()
+        _check(False, "plain snapshot restored into a stream-sharded engine (must refuse)")
+    except MetricsTPUUserError as e:
+        # the refusal must be the TYPED, explanatory one — a crash elsewhere
+        # in the restore path is a bug, not a refusal
+        _check("stream-sharded" in str(e), f"refusal message unhelpful: {e}")
 
     # --------------------------- dead dispatcher: sticky submit, reset re-arms
     kill_inj = injs["dispatcher_kill"]

@@ -68,6 +68,8 @@ FAULT_SITES = (
     "kernel",           # kernel backend failure -> pallas→xla demotion
     "watchdog",         # per-step watchdog expiry (simulated stuck device)
     "merge",            # deferred-sync boundary merge
+    "page_out",         # stream-paging spill: arena row -> host RAM
+    "page_in",          # stream-paging fault-in: host RAM/init -> arena row
     "snapshot_write",   # snapshot save fails before any bytes are durable
     "snapshot_corrupt", # snapshot saved, then payload bytes rot on disk
     "snapshot_read",    # transient restore-time read failure

@@ -22,19 +22,41 @@ quantum. ``MultiStreamEngine`` collapses all of it:
   can never do;
 * ``result(stream_id)`` runs one shared compiled compute program whose
   stream index is a runtime argument — S streams, one compute executable;
+* ``results()`` runs ONE batched (vmapped) all-streams compute program —
+  a single device computation for any S, never S dispatches;
 * snapshots carry all streams in one (per-dtype) payload; restore brings
   every stream back at once.
 
-The compiled-program budget is UNCHANGED from the single-stream engine: at
-most ``len(buckets)`` update programs + 1 compute program, for any S.
+The compiled-program budget is UNCHANGED in S: at most ``len(buckets)``
+update programs + 1 per-stream compute + 1 batched all-streams compute, for
+any stream count.
+
+**Stream sharding + paging (ISSUE 9).** ``stream_shard=True`` (mesh under
+deferred sync required) shards the STREAM AXIS itself over the mesh: shard
+``w`` of W owns the streams with ``stream_id % W == w``, and the carried
+state is one ``(W, resident, n)`` paged-arena buffer per dtype, dim-0
+sharded — per-shard resident state is ``resident`` rows, NOT S. The
+dispatcher routes each megabatch host-side (rows ordered by home shard,
+per-shard segments padded to ``bucket/W``), so the steady routed step is
+COLLECTIVE-FREE at jaxpr and HLO level, exactly like PR 5's deferred mode
+(``parallel/embedded.py::stream_sharded_step``; pinned by the
+``no-collectives-in-deferred-step`` rule over the bootstrap matrix). On top,
+``resident_streams=R`` bounds per-shard HBM by the ACTIVE WORKING SET: an
+LRU pager (``engine/paging.py``) spills cold streams' arena rows to host RAM
+through the snapshot codec and faults them back on the next submit —
+capacity scales past HBM, and a Zipfian tenant population costs one resident
+working set. ``result(sid)`` moves only the read stream's row (one shard's
+slot, or the host-spilled copy — never the whole state); kill/resume covers
+resident AND spilled rows with exact replay, and snapshot meta carries the
+full stream-shard topology for the restore matrix
+({sharded+paged → same-world verbatim, → single-device merged}).
 
 Scope: single-device serving, or a mesh under DEFERRED sync
-(``EngineConfig(mesh=..., mesh_sync="deferred")``): each shard then carries
-its own (S, ...)-stacked local states, the segmented scatter runs entirely
-within the shard (collective-free steady step), and ``result()`` rides one
-boundary merge of all streams at once. The step-sync mesh form does not
-exist — the per-step segmented scatter has no exact shard-and-merge. Metrics
-must support the generic delta masked path
+(``EngineConfig(mesh=..., mesh_sync="deferred")``): without ``stream_shard``
+each shard carries its own (S, ...)-stacked local states and ``result()``
+rides one boundary merge; with it each shard carries only its own streams.
+The step-sync mesh form does not exist — the per-step segmented scatter has
+no exact shard-and-merge. Metrics must support the generic delta masked path
 (``segmented_update_unsupported_reason`` is None): custom fused masked forms
 and scan-fallback members have no segmented counterpart.
 
@@ -50,6 +72,7 @@ Quickstart::
         ...
         acc_7 = engine.result(7)                  # per-stream compute
 """
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -57,8 +80,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu.engine.aot import AotCache
+from metrics_tpu.engine.arena import ArenaLayout
+from metrics_tpu.engine.paging import StreamPager
 from metrics_tpu.engine.pipeline import EngineConfig, StreamingEngine
 from metrics_tpu.engine.trace import ENGINE_TRACE
+from metrics_tpu.utils.data import is_batch_leaf
 from metrics_tpu.utils.exceptions import MetricsTPUUserError
 
 __all__ = ["MultiStreamEngine"]
@@ -66,7 +92,22 @@ __all__ = ["MultiStreamEngine"]
 
 class MultiStreamEngine(StreamingEngine):
     """Serve ``num_streams`` independent accumulations of one metric from a
-    single AOT program set and a single dispatcher."""
+    single AOT program set and a single dispatcher.
+
+    Args:
+        metric: the served metric/collection (segmented update path required).
+        num_streams: S — independent accumulations.
+        config: engine config; ``stream_shard`` requires ``mesh`` +
+            ``mesh_sync="deferred"`` + ``use_arena=True``.
+        aot_cache: optional shared AOT cache.
+        stream_shard: shard the stream axis over the mesh — shard ``w`` owns
+            streams with ``stream_id % world == w``; per-shard resident state
+            is ``resident_streams`` rows instead of S.
+        resident_streams: per-shard paged-arena slot count (defaults to
+            ``ceil(S / world)`` — everything resident, paging never fires).
+            Smaller values bound HBM by the working set; cold streams spill
+            to host RAM.
+    """
 
     def __init__(
         self,
@@ -74,6 +115,8 @@ class MultiStreamEngine(StreamingEngine):
         num_streams: int,
         config: Optional[EngineConfig] = None,
         aot_cache: Optional[AotCache] = None,
+        stream_shard: bool = False,
+        resident_streams: Optional[int] = None,
     ):
         if not isinstance(num_streams, int) or num_streams <= 0:
             raise MetricsTPUUserError(f"num_streams must be a positive int, got {num_streams!r}")
@@ -85,7 +128,44 @@ class MultiStreamEngine(StreamingEngine):
                 "boundary merge) or use one StreamingEngine per mesh"
             )
         self._num_streams = int(num_streams)
+        self._stream_shard = bool(stream_shard)
+        self._pager: Optional[StreamPager] = None
+        if self._stream_shard:
+            if config is None or config.mesh is None or config.mesh_sync != "deferred":
+                raise MetricsTPUUserError(
+                    "stream_shard=True needs EngineConfig(mesh=..., mesh_sync='deferred'): "
+                    "the stream axis shards over the mesh and the routed step follows "
+                    "the deferred (collective-free) contract"
+                )
+            if not config.use_arena:
+                raise MetricsTPUUserError(
+                    "stream_shard=True requires use_arena=True: the paged per-stream "
+                    "arena rows are the unit the pager spills and faults"
+                )
+            axes = (config.axis,) if isinstance(config.axis, str) else tuple(config.axis)
+            world = int(np.prod([config.mesh.shape[a] for a in axes]))
+            self._local_streams = -(-self._num_streams // world)  # ceil(S / W)
+            r = int(resident_streams) if resident_streams is not None else self._local_streams
+            if r <= 0:
+                raise MetricsTPUUserError(
+                    f"resident_streams must be positive, got {resident_streams!r}"
+                )
+            self._resident = min(r, self._local_streams)
+        else:
+            if resident_streams is not None:
+                raise MetricsTPUUserError(
+                    "resident_streams only applies to stream_shard=True engines "
+                    "(the unsharded engine carries every stream resident)"
+                )
+            self._resident = 0
         super().__init__(metric, config=config, aot_cache=aot_cache)
+        if self._stream_shard:
+            self._pager = StreamPager(self._world, self._resident)
+            self._stats.mesh_sync = "stream_shard"
+            # one stream's packed init row per dtype, host numpy — the
+            # fault-in source for never-touched (and reset) streams
+            row = self._layout.pack(jax.tree.map(jnp.asarray, self._metric.init_state()))
+            self._init_row = {k: np.asarray(v) for k, v in row.items()}
 
     # -------------------------------------------------------------- capability checks
 
@@ -102,7 +182,20 @@ class MultiStreamEngine(StreamingEngine):
     def num_streams(self) -> int:
         return self._num_streams
 
+    @property
+    def stream_shard(self) -> bool:
+        return self._stream_shard
+
+    @property
+    def resident_streams(self) -> Optional[int]:
+        """Per-shard paged-arena slot count (None for unsharded engines)."""
+        return self._resident if self._stream_shard else None
+
     def _init_state_tree(self) -> Any:
+        if self._stream_shard:
+            # ONE stream's logical state: the stream-sharded carried form is
+            # built row-wise by _put_state, never as a full (S, ...) tree
+            return self._metric.init_state()
         base = self._metric.init_state()
         return jax.tree.map(
             lambda x: jnp.tile(jnp.asarray(x)[None], (self._num_streams,) + (1,) * jnp.ndim(x)),
@@ -110,23 +203,71 @@ class MultiStreamEngine(StreamingEngine):
         )
 
     def _abstract_state_tree(self) -> Any:
+        if self._stream_shard:
+            # per-STREAM template: the engine's ArenaLayout then describes one
+            # stream's row (n elements per dtype) — the pager's spill unit
+            return self._metric.abstract_state()
         base = self._metric.abstract_state()
         return jax.tree.map(
             lambda s: jax.ShapeDtypeStruct((self._num_streams,) + tuple(s.shape), s.dtype),
             base,
         )
 
+    def _put_state(self, state: Any, packed: bool = False, stacked: bool = False) -> Any:
+        if not self._stream_shard:
+            return super()._put_state(state, packed=packed, stacked=stacked)
+        sh = self._shard_sharding()
+        if stacked:
+            # already the (W, resident, n) per-dtype paged-arena buffers
+            return {k: jax.device_put(jnp.asarray(v), sh) for k, v in state.items()}
+        # logical single-stream tree -> fresh arena: every slot = the init row
+        row = self._layout.pack(jax.tree.map(jnp.asarray, state))
+        return {
+            k: jax.device_put(
+                jnp.tile(jnp.reshape(v, (1, 1, -1)), (self._world, self._resident, 1)), sh
+            )
+            for k, v in row.items()
+        }
+
+    def _abstract_state(self) -> Any:
+        if not self._stream_shard:
+            return super()._abstract_state()
+        sh = self._shard_sharding()
+        return {
+            k: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh)
+            for k, s in self._layout.abstract_stream_stacked(self._world, self._resident).items()
+        }
+
     # ------------------------------------------------------------------ AOT programs
 
     def _update_kind(self) -> str:
-        return "update_mstream"
+        return "update_sstream" if self._stream_shard else "update_mstream"
+
+    def _sync_tag(self) -> str:
+        # stream-sharded programs lower over a DIFFERENT carried form than
+        # plain deferred ones; a distinct tag keeps a shared AotCache honest
+        return "stream_shard" if self._stream_shard else super()._sync_tag()
 
     def _traced_update(self, state_tree: Any, payload: Any, mask: Any) -> Any:
         a, kw = payload
-        stream_ids, rest = a[0], a[1:]
+        ids, rest = a[0], a[1:]
+        # sharded mode addresses pager SLOTS within the shard (num_segments =
+        # resident); unsharded mode addresses global stream rows
+        num = self._resident if self._stream_shard else self._num_streams
         return self._metric.update_state_segmented(
             state_tree, *rest, mask=mask,
-            segment_ids=stream_ids, num_segments=self._num_streams, **kw,
+            segment_ids=ids, num_segments=num, **kw,
+        )
+
+    def _step_callable(self, payload_abs: Any, mask_abs: Any):
+        if not self._stream_shard:
+            return super()._step_callable(payload_abs, mask_abs)
+        from metrics_tpu.parallel.embedded import stream_sharded_step
+
+        return stream_sharded_step(
+            self._traced_update, self._cfg.mesh, self._cfg.axis, payload_abs, mask_abs,
+            state_template=self._abstract_state(),
+            unpack=self._layout.unpack_stacked, pack=self._layout.pack_stacked,
         )
 
     def _compute_program(self):
@@ -149,6 +290,71 @@ class MultiStreamEngine(StreamingEngine):
 
             with self._kernel_scope():
                 return jax.jit(compute).lower(self._compute_input_abstract(), sid_abs).compile()
+
+        return self._aot.get_or_compile(key, build)
+
+    def _row_compute_program(self):
+        """Stream-sharded per-stream compute: ONE stream's packed arena row
+        (per-dtype ``(n,)`` host vectors — the only bytes ``result(sid)``
+        moves) -> the metric's value. Mesh-free: the row is already gathered."""
+        row_abs = {
+            k: jax.ShapeDtypeStruct((n,), jnp.dtype(k))
+            for k, n in self._layout.buffer_sizes().items()
+        }
+        key = self._aot.program_key(
+            f"compute_sstream+k.{self._kernel_tag()}", self._metric_fp,
+            arg_tree=row_abs, mesh=None, donate=False, sync=self._sync_tag(),
+        )
+        metric, layout = self._metric, self._layout
+
+        def build():
+            with self._kernel_scope():
+                return (
+                    jax.jit(lambda row: metric.compute_from(layout.unpack(row)))
+                    .lower(row_abs)
+                    .compile()
+                )
+
+        return self._aot.get_or_compile(key, build)
+
+    def _results_traced(self, state: Any) -> Any:
+        """Traced body of the batched all-streams compute: ONE vmapped
+        ``compute_from`` over the stream axis — the jaxpr's op count is
+        CONSTANT in S (pinned by the dispatch-count regression test), so a
+        dashboard scrape at S=10^5 costs one device computation, not 10^5."""
+        return jax.vmap(self._metric.compute_from)(self._compute_tree(state))
+
+    def _results_program(self):
+        key = self._aot.program_key(
+            f"compute_mstream_all+k.{self._kernel_tag()}", self._metric_fp,
+            arg_tree=self._compute_input_abstract(),
+            mesh=self._cfg.mesh, donate=False, sync=self._sync_tag(),
+        )
+
+        def build():
+            with self._kernel_scope():
+                return jax.jit(self._results_traced).lower(self._compute_input_abstract()).compile()
+
+        return self._aot.get_or_compile(key, build)
+
+    def _results_traced_sharded(self, stacked: Any) -> Any:
+        """Stream-sharded batched compute: the host-reassembled ``(S, n)``
+        row matrices -> every stream's value, one vmap."""
+        return jax.vmap(self._metric.compute_from)(self._layout.unpack_stacked(stacked))
+
+    def _results_program_sharded(self):
+        stacked_abs = {
+            k: jax.ShapeDtypeStruct((self._num_streams, n), jnp.dtype(k))
+            for k, n in self._layout.buffer_sizes().items()
+        }
+        key = self._aot.program_key(
+            f"compute_sstream_all+k.{self._kernel_tag()}", self._metric_fp,
+            arg_tree=stacked_abs, mesh=None, donate=False, sync=self._sync_tag(),
+        )
+
+        def build():
+            with self._kernel_scope():
+                return jax.jit(self._results_traced_sharded).lower(stacked_abs).compile()
 
         return self._aot.get_or_compile(key, build)
 
@@ -192,11 +398,310 @@ class MultiStreamEngine(StreamingEngine):
         sids = sorted({it[0] for it in group if isinstance(it, tuple) and len(it) == 3})
         return {"stream_ids": sids} if sids else {}
 
+    # ------------------------------------------------------- stream-sharded routing
+
+    def _home(self, sid: int) -> Tuple[int, int]:
+        """Global stream id -> (home shard, local stream index)."""
+        return sid % self._world, sid // self._world
+
+    def _refresh_gauges(self) -> None:
+        if self._pager is not None:
+            self._stats.resident_streams = self._pager.resident_count()
+            self._stats.spilled_streams = self._pager.spilled_count()
+
+    def _execute_payload(
+        self, merged: Tuple[Tuple[Any, ...], Dict[str, Any]], n: int,
+        n_coalesced: int, queue_wait_us: float,
+    ) -> None:
+        if not self._stream_shard:
+            return super()._execute_payload(merged, n, n_coalesced, queue_wait_us)
+        self._execute_routed(merged, int(n), n_coalesced, queue_wait_us)
+
+    def _execute_routed(
+        self, merged: Tuple[Tuple[Any, ...], Dict[str, Any]], n: int,
+        n_coalesced: int, queue_wait_us: float,
+    ) -> None:
+        """Route one merged megabatch to the stream shards, host-side.
+
+        Rows order by home shard (``sid % W``, stable — per-stream arrival
+        order is preserved, which is all exactness needs), then run in ROUNDS:
+        each round takes up to ``bucket/W`` rows per shard, capped so no shard
+        touches more than ``resident`` distinct streams (the pager can always
+        seat a round), pages the round's streams resident, and executes ONE
+        padded collective-free step whose segment ids are the pager's slot
+        indices. The chosen bucket is the smallest whose per-shard slice
+        covers the round's largest segment — the program set stays closed.
+        """
+        t_route0 = time.perf_counter()
+        W = self._world
+        args, kwargs = merged
+        sids = np.asarray(args[0], np.int32)
+        rest = tuple(args[1:])
+        home = sids % W
+        order = np.argsort(home, kind="stable")
+        leaves, treedef = jax.tree_util.tree_flatten((rest, kwargs))
+        perm = [
+            np.asarray(leaf)[order] if is_batch_leaf(leaf, n) else leaf for leaf in leaves
+        ]
+        sids_o = sids[order]
+        home_o = home[order]
+        starts = np.searchsorted(home_o, np.arange(W)).astype(np.int64)
+        stops = np.searchsorted(home_o, np.arange(W), side="right").astype(np.int64)
+        route_us = (time.perf_counter() - t_route0) * 1e6
+        per_top = self._policy.buckets[-1] // W
+        cursors = starts.copy()
+        committed = 0
+        rounds = 0
+        tr = self._trace
+        try:
+            while bool(np.any(cursors < stops)):
+                t0 = time.perf_counter()
+                # ---- segment this round: <= per_top rows and <= resident
+                # distinct streams per shard
+                segs: List[Tuple[int, int]] = []
+                max_len = 0
+                for w in range(W):
+                    s0, s1 = int(cursors[w]), int(stops[w])
+                    end = s0
+                    distinct: set = set()
+                    while end < s1 and (end - s0) < per_top:
+                        loc = int(sids_o[end]) // W
+                        if loc not in distinct and len(distinct) >= self._resident:
+                            break
+                        distinct.add(loc)
+                        end += 1
+                    segs.append((s0, end))
+                    max_len = max(max_len, end - s0)
+                bucket = self._policy.bucket_for(max_len * W)
+                per = bucket // W
+                # ---- page the round's streams resident (slot assignment)
+                self._page_round(
+                    {w: [int(x) // W for x in sids_o[segs[w][0]: segs[w][1]]] for w in range(W)}
+                )
+                # ---- build the padded routed payload: shard w's rows land in
+                # slice [w*per, w*per+len(seg)) — P(axis) then hands each
+                # device exactly its own streams' rows
+                src = np.concatenate(
+                    [np.arange(s0, s1, dtype=np.int64) for s0, s1 in segs]
+                ) if segs else np.zeros((0,), np.int64)
+                dst = np.concatenate(
+                    [w * per + np.arange(s1 - s0, dtype=np.int64) for w, (s0, s1) in enumerate(segs)]
+                ) if segs else np.zeros((0,), np.int64)
+                valid = int(src.size)
+                # same refusal as BucketPolicy.pad_chunk: a broadcast leaf
+                # whose leading dim collides with the bucket (or per-shard
+                # rows) would be silently classified batch-carried at lowering
+                # and mis-sharded — this path builds its padded payloads
+                # itself, so it must re-state the guard
+                ambiguous = {bucket, per} - {int(n)}
+                out_leaves = []
+                for leaf in perm:
+                    if not is_batch_leaf(leaf, n) and any(
+                        is_batch_leaf(leaf, a) for a in ambiguous
+                    ):
+                        raise ValueError(
+                            f"non-batch array argument with leading dimension "
+                            f"{leaf.shape[0]} is ambiguous against routed bucket "
+                            f"{bucket} (batch size here is {n}, per-shard rows "
+                            f"{per}); reshape it (e.g. add a leading axis of 1) "
+                            "or choose buckets that cannot collide"
+                        )
+                    if is_batch_leaf(leaf, n):
+                        arr = np.asarray(leaf)
+                        out = np.full((bucket,) + arr.shape[1:], self._cfg.pad_value, arr.dtype)
+                        out[dst] = arr[src]
+                        out_leaves.append(out)
+                    else:
+                        out_leaves.append(leaf)
+                slot_ids = np.zeros((bucket,), np.int32)
+                mask = np.zeros((bucket,), bool)
+                mask[dst] = True
+                for w, (s0, s1) in enumerate(segs):
+                    if s1 <= s0:
+                        continue
+                    # one pager lookup per DISTINCT seated stream (<= resident),
+                    # then a vectorized gather over the shard's rows
+                    locs = sids_o[s0:s1].astype(np.int64) // W
+                    uniq = np.unique(locs)
+                    slots = np.asarray(
+                        [self._pager.slot_of(w, int(u)) for u in uniq], np.int32
+                    )
+                    slot_ids[w * per: w * per + (s1 - s0)] = slots[
+                        np.searchsorted(uniq, locs)
+                    ]
+                a_pad, kw_pad = jax.tree_util.tree_unflatten(treedef, out_leaves)
+                self._run_padded_step(
+                    (slot_ids,) + tuple(a_pad), kw_pad, mask, bucket, valid,
+                    n_coalesced if committed == 0 else 1,
+                    queue_wait_us if committed == 0 else 0.0,
+                    t0,
+                )
+                committed += 1
+                rounds += 1
+                self._stats.routed_steps += 1
+                for w, (s0, s1) in enumerate(segs):
+                    cursors[w] = s1
+                    if s1 > s0:
+                        self._pager.touch(w, [int(x) // W for x in sids_o[s0:s1]])
+        except BaseException as e:  # noqa: BLE001 - shrink-on-retry contract
+            try:
+                e._committed_chunks = committed
+            except Exception:  # noqa: BLE001 - exotic exception without a dict
+                pass
+            raise
+        if tr is not None:
+            tr.complete(
+                "route", trace=self._group_tid or ENGINE_TRACE,
+                dur_us=route_us, rows=int(n), rounds=rounds,
+            )
+            tr.observe("route_us", route_us)
+
+    def _page_round(self, needed: Dict[int, List[int]]) -> None:
+        """Make every stream in ``needed`` resident on its shard: plan with
+        the pager, spill the evicted rows to host RAM (``page_out`` fault
+        site), scatter the faulted-in rows (spilled or init) into their slots
+        (``page_in``), then commit the bookkeeping. Both device phases are
+        batched per dtype and wrapped in the engine's bounded transient
+        retry; the pager commits LAST, so a retried injected fault can never
+        leave the tables ahead of the buffers."""
+        all_ops, hits, faults = [], 0, 0
+        for w in sorted(needed):
+            streams = needed[w]
+            if not streams:
+                continue
+            ops, h, f = self._pager.plan_residency(w, streams)
+            all_ops.extend(ops)
+            hits += h
+            faults += f
+        self._stats.page_hits += hits
+        self._stats.page_faults += faults
+        evicts = [op for op in all_ops if op.kind == "evict"]
+        loads = [op for op in all_ops if op.kind == "load"]
+        tr = self._trace
+        gid = self._group_tid or ENGINE_TRACE
+        spilled: Dict[Tuple[int, int], Dict[str, np.ndarray]] = {}
+        if evicts:
+            ws = np.asarray([op.shard for op in evicts])
+            js = np.asarray([op.slot for op in evicts])
+
+            def spill_once() -> Tuple[Dict[str, np.ndarray], float]:
+                self._fault("page_out")
+                t0 = time.perf_counter()
+                # one row-gather per dtype; only the evicted rows move to host
+                rows = {
+                    k: np.asarray(jax.device_get(v[ws, js])) for k, v in self._state.items()
+                }
+                return rows, t0
+
+            rows, t0 = self._retry_transient(spill_once)
+            dur = (time.perf_counter() - t0) * 1e6
+            for i, op in enumerate(evicts):
+                spilled[(op.shard, op.stream)] = {k: rows[k][i].copy() for k in rows}
+            self._stats.page_outs += len(evicts)
+            if tr is not None:
+                tr.complete("page_out", trace=gid, dur_us=dur, rows=len(evicts))
+                tr.observe("page_out_us", dur)
+        if loads:
+            ws = np.asarray([op.shard for op in loads])
+            js = np.asarray([op.slot for op in loads])
+            sh = self._shard_sharding()
+
+            def load_once() -> Tuple[Dict[str, Any], float]:
+                self._fault("page_in")
+                t0 = time.perf_counter()
+                new_state = {}
+                for k, buf in self._state.items():
+                    rows_np = np.stack([
+                        (self._pager.spilled_row(op.shard, op.stream) or self._init_row)[k]
+                        for op in loads
+                    ]).astype(buf.dtype)
+                    # one batched scatter per dtype; re-pin the shard sharding
+                    # so the eager .at update cannot drift the placement
+                    new_buf = buf.at[ws, js].set(jnp.asarray(rows_np))
+                    new_state[k] = jax.device_put(new_buf, sh)
+                return new_state, t0
+
+            new_state, t0 = self._retry_transient(load_once)
+            dur = (time.perf_counter() - t0) * 1e6
+            self._state = new_state
+            self._state_version += 1
+            self._stats.page_ins += len(loads)
+            if tr is not None:
+                tr.complete("page_in", trace=gid, dur_us=dur, rows=len(loads))
+                tr.observe("page_in_us", dur)
+        if all_ops:
+            self._pager.commit(all_ops, spilled)
+        self._refresh_gauges()
+
+    # --------------------------------------------------------------------- readers
+
+    def _fetch_row(self, sid: int) -> Dict[str, np.ndarray]:
+        """ONE stream's packed arena row (per-dtype host vectors): from its
+        home shard's slot when resident (only that row crosses to host),
+        read-through from the host spill store when paged out (no eviction —
+        residency changes only on the submit path), or the init row for a
+        never-touched stream. Caller holds the state lock."""
+        w, loc = self._home(sid)
+        slot = self._pager.slot_of(w, loc)
+        if slot is not None:
+            return {k: np.asarray(jax.device_get(v[w, slot])) for k, v in self._state.items()}
+        spilled = self._pager.spilled_row(w, loc)
+        if spilled is not None:
+            return spilled
+        return self._init_row
+
+    def _global_rows_host(self) -> Dict[str, np.ndarray]:
+        """Reassemble every stream's packed row host-side: resident slots out
+        of the (device) arena, spilled rows out of host RAM, init rows for the
+        untouched tail — the ``(S, n)`` per-dtype matrices ``results()`` /
+        ``state()`` / the merged restore path all share. Caller holds the
+        state lock."""
+        arena = {k: np.asarray(jax.device_get(v)) for k, v in self._state.items()}
+        return self._rows_from_parts(
+            arena, self._pager.snapshot_payload(), self._init_row,
+            self._num_streams, self._world,
+        )
+
+    @staticmethod
+    def _rows_from_parts(
+        arena: Dict[str, Any],
+        pager_payload: Dict[str, Any],
+        init_row: Dict[str, np.ndarray],
+        num_streams: int,
+        world: int,
+    ) -> Dict[str, np.ndarray]:
+        """``(S, n)`` per-dtype row matrices from a (host) paged arena + pager
+        payload — shared by the live readers and the cross-topology restore
+        (which reconstructs from a SNAPSHOT's parts, no live pager needed)."""
+        out = {
+            k: np.tile(np.asarray(init_row[k])[None], (num_streams, 1)) for k in arena
+        }
+        # both passes are single fancy-index assignments: at S=10^4+ a
+        # per-row Python walk would dominate the scrape the batched
+        # one-dispatch compute exists to make cheap
+        slots = np.asarray(pager_payload["slots"])
+        w_idx, j_idx = np.nonzero(slots >= 0)
+        if w_idx.size:
+            g = slots[w_idx, j_idx].astype(np.int64) * world + w_idx
+            keep = g < num_streams
+            for k in out:
+                out[k][g[keep]] = np.asarray(arena[k])[w_idx[keep], j_idx[keep]]
+        coords = np.asarray(
+            pager_payload.get("spill_coords", np.zeros((0, 2), np.int64))
+        ).reshape(-1, 2)
+        if coords.size:
+            g = coords[:, 1].astype(np.int64) * world + coords[:, 0].astype(np.int64)
+            keep = g < num_streams
+            for k in out:
+                out[k][g[keep]] = np.asarray(pager_payload[f"spill_{k}"])[keep]
+        return out
+
     def result(self, stream_id: int) -> Any:  # type: ignore[override]
-        """Flush, then compute ``stream_id``'s accumulated value (shared
-        compiled program, stream index passed at runtime). Under deferred
-        sync the flush is followed by one boundary merge of ALL streams'
-        shard-local states."""
+        """Flush, then compute ``stream_id``'s accumulated value. Unsharded:
+        the shared compiled program with the stream index at runtime (under
+        deferred sync, after one boundary merge of ALL streams). Stream-
+        sharded: ONLY the read stream's row moves — its home shard's slot (or
+        the host-spilled copy), never the whole state."""
         sid = self._check_stream(stream_id)
         tr = self._trace
         handle = (
@@ -204,24 +709,38 @@ class MultiStreamEngine(StreamingEngine):
         )
         self.flush()
         with self._state_lock:
-            state = self._merged_state() if self._deferred else self._state
-            value = self._compute_program()(state, jnp.asarray(sid, jnp.int32))
+            if self._stream_shard:
+                value = self._row_compute_program()(self._fetch_row(sid))
+            else:
+                state = self._merged_state() if self._deferred else self._state
+                value = self._compute_program()(state, jnp.asarray(sid, jnp.int32))
+            self._stats.result_device_calls += 1
         if handle is not None:
             jax.block_until_ready(value)  # the SLO observable is value-in-hand
             tr.observe("result_latency_us", tr.end(handle))
         return value
 
     def results(self) -> Dict[int, Any]:
-        """Every stream's value (one flush — and under deferred sync ONE
-        boundary merge — then S cached-program calls)."""
+        """Every stream's value from ONE device computation, for any S: the
+        batched (vmapped) all-streams program runs once and the per-stream
+        values are sliced host-side — at S=10^5 the former per-stream loop
+        was 10^5 dispatches per dashboard scrape. Under deferred sync the
+        flush is followed by ONE boundary merge; stream-sharded engines
+        reassemble the row matrices host-side (resident + spilled + init)
+        first."""
         self.flush()
         with self._state_lock:
-            state = self._merged_state() if self._deferred else self._state
-            program = self._compute_program()
-            return {
-                sid: program(state, jnp.asarray(sid, jnp.int32))
-                for sid in range(self._num_streams)
-            }
+            if self._stream_shard:
+                stacked = self._global_rows_host()
+                vals = self._results_program_sharded()(stacked)
+            else:
+                state = self._merged_state() if self._deferred else self._state
+                vals = self._results_program()(state)
+            self._stats.result_device_calls += 1
+        host = jax.device_get(vals)
+        return {
+            sid: jax.tree.map(lambda x: x[sid], host) for sid in range(self._num_streams)
+        }
 
     def reset_stream(self, stream_id: int) -> None:
         """Zero ONE stream's accumulation; all other streams keep theirs.
@@ -231,10 +750,19 @@ class MultiStreamEngine(StreamingEngine):
         that donates the live buffers (or be overwritten by one). Batches for
         this stream submitted after the call land in the fresh accumulation.
         Under deferred sync the stream's row zeroes in EVERY shard's local
-        state (no collective needed — the write is shard-elementwise).
+        state (no collective needed — the write is shard-elementwise); under
+        stream sharding the pager simply FORGETS the stream (slot freed,
+        spill entry dropped) and the next access faults in the init row.
         """
         sid = self._check_stream(stream_id)
         self.flush()
+        if self._stream_shard:
+            with self._state_lock:
+                w, loc = self._home(sid)
+                self._pager.drop(w, loc)
+                self._state_version += 1
+                self._refresh_gauges()
+            return
         init = self._metric.init_state()
         with self._state_lock:
             if self._deferred:
@@ -255,19 +783,143 @@ class MultiStreamEngine(StreamingEngine):
                 self._state = self._put_state(tree)
             self._state_version += 1
 
+    def _reset_locked(self) -> None:
+        # pager tables and the fresh arena swap under the SAME lock hold: a
+        # group dispatched right after reset() must never fault pre-reset
+        # spilled rows back into the zeroed state
+        if self._pager is not None:
+            self._pager.reset()
+        super()._reset_locked()
+        if self._pager is not None:
+            self._refresh_gauges()
+
+    def state(self) -> Any:
+        """The global (S, ...)-stacked LOGICAL state. Stream-sharded engines
+        reassemble it host-side (resident + spilled + init rows); other modes
+        defer to the base engine (merged under deferred sync, defensive copy
+        single-device)."""
+        if not self._stream_shard:
+            return super().state()
+        self.flush()
+        with self._state_lock:
+            stacked = self._global_rows_host()
+        return self._layout.unpack_stacked({k: jnp.asarray(v) for k, v in stacked.items()})
+
     def stream_state(self, stream_id: int) -> Any:
         """One stream's LOGICAL state pytree (post-flush). A defensive copy
         on the single-device path (the live buffers are donated into later
         steps); under deferred sync the boundary-merged arrays are ordinary
-        non-donated program outputs, returned as-is."""
+        non-donated program outputs, returned as-is; stream-sharded engines
+        unpack the one fetched row."""
         sid = self._check_stream(stream_id)
         self.flush()
         with self._state_lock:
+            if self._stream_shard:
+                row = self._fetch_row(sid)
+                return self._layout.unpack({k: jnp.asarray(v) for k, v in row.items()})
             if self._deferred:
                 return jax.tree.map(lambda x: x[sid], self._merged_state())
             return jax.tree.map(
                 lambda x: jnp.array(x[sid], copy=True), self._unpack(self._state)
             )
+
+    # ------------------------------------------------------------- snapshot/restore
+
+    def _snapshot_state(self) -> Any:
+        if not self._stream_shard:
+            return super()._snapshot_state()
+        # the paged-arena payload: resident buffers AND the pager's spilled
+        # rows + slot tables — kill/resume must cover rows living in host RAM
+        return {
+            "arena": jax.device_get(self._state),
+            "pager": self._pager.snapshot_payload(),
+        }
+
+    def _snapshot_meta_extra(self) -> Dict[str, Any]:
+        if not self._stream_shard:
+            return {}
+        return {
+            "stream_shard": 1,
+            "num_streams": self._num_streams,
+            "resident": self._resident,
+            "world": self._world,
+        }
+
+    def _restore_commit(self, state: Any, meta: Dict[str, Any]) -> None:
+        """The stream-shard restore matrix, covering EXACTLY:
+
+        * sharded+paged snapshot -> SAME-WORLD sharded engine (same S, world,
+          resident): verbatim — each shard resumes with exactly its resident
+          slots and the pager with exactly its spilled rows, so replay from
+          ``batches_done`` is bit-exact;
+        * sharded+paged snapshot -> SINGLE-DEVICE unsharded MultiStreamEngine
+          (same S): the resident + spilled + init rows merge host-side into
+          the (S, ...) stacked state.
+
+        Everything else refuses loudly (a different-world sharded engine
+        cannot inherit slot tables; a plain snapshot has no residency
+        provenance a sharded engine could seat).
+        """
+        snap_shard = bool(int(meta.get("stream_shard", 0) or 0))
+        if not snap_shard and not self._stream_shard:
+            return super()._restore_commit(state, meta)
+        if not snap_shard:
+            raise MetricsTPUUserError(
+                "snapshot was not written by a stream-sharded engine; the stream-shard "
+                "restore matrix covers {sharded+paged -> same-world, -> single-device "
+                "merged} exactly — restore it into a non-sharded MultiStreamEngine"
+            )
+        s_snap = int(meta.get("num_streams", 0))
+        world_snap = int(meta.get("world", 1))
+        r_snap = int(meta.get("resident", 0))
+        if s_snap != self._num_streams:
+            raise MetricsTPUUserError(
+                f"snapshot serves {s_snap} streams, this engine {self._num_streams}"
+            )
+        arena = state.get("arena") if isinstance(state, dict) else None
+        pager_payload = state.get("pager") if isinstance(state, dict) else None
+        if arena is None or pager_payload is None:
+            raise MetricsTPUUserError("stream-shard snapshot payload is missing arena/pager parts")
+        row_layout = ArenaLayout.for_state(self._metric.abstract_state())
+        sizes = row_layout.buffer_sizes()
+        if set(arena) != set(sizes) or any(
+            tuple(np.shape(arena[k])) != (world_snap, r_snap, n) for k, n in sizes.items()
+        ):
+            raise MetricsTPUUserError(
+                "stream-shard snapshot arena does not match this metric's per-stream "
+                "layout; was the metric reconfigured since the snapshot?"
+            )
+        if self._stream_shard:
+            if world_snap != self._world or r_snap != self._resident:
+                raise MetricsTPUUserError(
+                    f"stream-sharded snapshots restore verbatim only into the SAME "
+                    f"(world, resident) topology — snapshot ({world_snap}, {r_snap}) vs "
+                    f"engine ({self._world}, {self._resident}); merge it through a "
+                    "single-device MultiStreamEngine instead"
+                )
+            new_state = self._put_state(arena, packed=True, stacked=True)
+            with self._state_lock:
+                self._finish_restore(new_state, meta)
+                self._pager.load_payload(pager_payload)
+                self._refresh_gauges()
+            return
+        if self._cfg.mesh is not None:
+            raise MetricsTPUUserError(
+                "the merged side of the stream-shard restore matrix is the SINGLE-DEVICE "
+                "MultiStreamEngine; restore sharded snapshots into the same-world sharded "
+                "engine or an unsharded single-device one"
+            )
+        init_row = {
+            k: np.asarray(v)
+            for k, v in row_layout.pack(
+                jax.tree.map(jnp.asarray, self._metric.init_state())
+            ).items()
+        }
+        stacked = self._rows_from_parts(
+            arena, pager_payload, init_row, self._num_streams, world_snap
+        )
+        tree = row_layout.unpack_stacked({k: jnp.asarray(v) for k, v in stacked.items()})
+        self._finish_restore(self._put_state(tree), meta)
 
     # ------------------------------------------------------------------- coalescing
 

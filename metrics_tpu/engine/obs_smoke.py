@@ -18,15 +18,16 @@ CPU device (``make obs-smoke``):
    the ``histogram_accumulate`` dogfooding fold is
    ``tests/engine/test_trace.py`` (latencies are nondeterministic here, so
    a value-level cross-check has nothing stable to pin).
-3. **Span-sequence determinism** — the SAME seeded chaos plan (10 of the 11
+3. **Span-sequence determinism** — the SAME seeded chaos plan (12 of the 13
    fault sites: transactional rollback/retry, kernel demotion, watchdog,
    contained snapshot failure + corruption + fallback restore with replay,
-   deferred boundary-merge retry) runs TWICE into fresh recorders; the
-   canonical span sequences (timestamps excluded) must be IDENTICAL, and
+   deferred boundary-merge retry, stream-shard ``page_out``/``page_in``
+   transients under seeded Zipfian traffic) runs TWICE into fresh recorders;
+   the canonical span sequences (timestamps excluded) must be IDENTICAL, and
    both chaos results bit-identical to each other. This is the
    occurrence-determinism contract: a chaos trace replays exactly.
 4. **Dead dispatcher** — a fatal ``dispatcher_kill`` under its own recorder
-   still produces its fault span event (the 11th site), completing coverage.
+   still produces its fault span event (the 13th site), completing coverage.
 
 Sidecars land under the gitignored ``out/`` per the repo's sidecar-hygiene
 convention. Prints one PASS line; exits nonzero on any violated claim.
@@ -59,7 +60,10 @@ def main(
     # the scenario AND the failure harness are chaos_smoke's OWN factories —
     # "the same seeded chaos plan" below is the same by construction, not by
     # a copied literal, and the two gates' FAIL-line contract cannot diverge
+    from metrics_tpu.engine import MultiStreamEngine
     from metrics_tpu.engine.chaos_smoke import (
+        SSHARD_RESIDENT,
+        SSHARD_STREAMS,
         chaos_collection as collection,
         chaos_engine_config,
         chaos_injectors,
@@ -68,6 +72,8 @@ def main(
         kill_engine_config,
         make_checker,
         resume_engine_config,
+        stream_shard_engine_config,
+        stream_shard_traffic,
     )
     from metrics_tpu.engine.faults import FAULT_SITES
 
@@ -167,7 +173,23 @@ def main(
             for b in clean:
                 deferred.submit(*b)
             deferred.result()
-        sites = set(inj.fired) | set(read_inj.fired) | set(merge_inj.fired)
+        # stream-sharded paging transients (ISSUE 9): route/page_out/page_in
+        # spans join the canonical sequence — seeded Zipf traffic + coalesce=1
+        # keep every page-site occurrence index producer-timing-independent
+        page_inj = injs["paging"]
+        paged = MultiStreamEngine(
+            collection(), SSHARD_STREAMS,
+            stream_shard_engine_config(page_inj, trace=rec),
+            stream_shard=True, resident_streams=SSHARD_RESIDENT,
+        )
+        with paged:
+            for sid, p, t in stream_shard_traffic():
+                paged.submit(sid, p, t)
+            paged.results()
+        sites = (
+            set(inj.fired) | set(read_inj.fired) | set(merge_inj.fired)
+            | set(page_inj.fired)
+        )
         return rec, got, sites
 
     t0 = time.perf_counter()

@@ -1079,6 +1079,19 @@ class StreamingEngine:
             else None
         )
         gauges = {"compiled_programs": aot["programs"]}
+        if s.paging_summary() is not None:
+            # stream-sharded serving: routing + LRU-paging telemetry joins the
+            # exposition only when the engine actually routed (non-sharded
+            # engines keep their surface byte-stable)
+            counters.update(
+                routed_steps=s.routed_steps,
+                page_hits=s.page_hits,
+                page_faults=s.page_faults,
+                page_ins=s.page_ins,
+                page_outs=s.page_outs,
+            )
+            gauges["resident_streams"] = s.resident_streams
+            gauges["spilled_streams"] = s.spilled_streams
         hists = self._trace.histograms() if self._trace is not None else ()
         return render_openmetrics(counters, hists, labeled_counters=labeled, gauges=gauges)
 
@@ -1092,12 +1105,19 @@ class StreamingEngine:
         (every pending batch lands before the state is replaced)."""
         self._join_queue()
         with self._state_lock:
-            self._error = None
-            self._inflight.clear()
-            self._state = self._put_state(self._init_state_tree())
-            self._state_version += 1
-            self._step = 0
-            self._batches_done = 0
+            self._reset_locked()
+
+    def _reset_locked(self) -> None:
+        """The state-swap half of :meth:`reset`, under ONE state-lock hold —
+        subclasses with sibling tables (the stream-sharded engine's pager)
+        extend this so no dispatched group can ever observe fresh state next
+        to stale bookkeeping."""
+        self._error = None
+        self._inflight.clear()
+        self._state = self._put_state(self._init_state_tree())
+        self._state_version += 1
+        self._step = 0
+        self._batches_done = 0
 
     # ---------------------------------------------------------------------- recovery
 
@@ -1128,20 +1148,22 @@ class StreamingEngine:
         # full provenance: the merged view is derivable (merge_stacked_states)
         # but the locals are not recoverable from it, and exact kill/resume
         # replay needs the locals (each shard must resume with ITS rows)
-        host_state = jax.device_get(self._state)
+        host_state = self._snapshot_state()
+        meta = {
+            "step": self._step,
+            "batches_done": self._batches_done,
+            "rows_in": self._stats.rows_in,
+            "rows_padded": self._stats.rows_padded,
+            "packed": int(self._layout is not None),
+            "arena_fp": self._layout.fingerprint() if self._layout is not None else "",
+            "mesh_sync": self._sync_tag() if self._cfg.mesh is not None else "single",
+            "world": self._world if self._deferred else 1,
+        }
+        meta.update(self._snapshot_meta_extra())
         path = save_snapshot(
             self._cfg.snapshot_dir,
             host_state,
-            {
-                "step": self._step,
-                "batches_done": self._batches_done,
-                "rows_in": self._stats.rows_in,
-                "rows_padded": self._stats.rows_padded,
-                "packed": int(self._layout is not None),
-                "arena_fp": self._layout.fingerprint() if self._layout is not None else "",
-                "mesh_sync": self._sync_tag() if self._cfg.mesh is not None else "single",
-                "world": self._world if self._deferred else 1,
-            },
+            meta,
             keep=self._cfg.snapshot_keep,
             host_attrs=self._metric.host_compute_attrs(),
         )
@@ -1158,6 +1180,19 @@ class StreamingEngine:
                 tr.event("fault", site="snapshot_corrupt")
             corrupt_snapshot(path, inj.snapshot_rng())
         return path
+
+    def _snapshot_state(self) -> Any:
+        """The host-side state payload a snapshot carries — by default the
+        carried form itself (packed arena / shard-stacked buffers). The
+        stream-sharded engine overrides this to bundle its resident arena
+        WITH the pager's spilled rows and slot tables (paged rows must be
+        covered by kill/resume)."""
+        return jax.device_get(self._state)
+
+    def _snapshot_meta_extra(self) -> Dict[str, Any]:
+        """Extra provenance meta a subclass folds into every snapshot (the
+        stream-sharded engine adds its stream/shard/residency topology)."""
+        return {}
 
     def restore(self, directory_or_path: Optional[str] = None) -> Dict[str, Any]:
         """Resume from the newest complete snapshot (engine must be idle).
@@ -1189,6 +1224,20 @@ class StreamingEngine:
             return load_snapshot(directory_or_path or self._cfg.snapshot_dir, fallback=True)
 
         state, meta = self._retry_transient(load_once)
+        self._restore_commit(state, meta)
+        if restore_handle is not None:
+            tr.end(
+                restore_handle,
+                generations_skipped=int(meta.get("generations_skipped", 0) or 0),
+                cursor=self._batches_done,
+            )
+        return meta
+
+    def _restore_commit(self, state: Any, meta: Dict[str, Any]) -> None:
+        """Validate a loaded snapshot against this engine's mode/topology and
+        commit it (the restore matrix). Subclasses reroute snapshots carrying
+        other topologies (the stream-sharded engine's restore matrix) before
+        falling back here."""
         # VALIDATE before mutating anything: a failed restore must leave the
         # live engine (metric attrs, fingerprint, memo, state) untouched
         packed = bool(int(meta.get("packed", 0)))
@@ -1249,6 +1298,11 @@ class StreamingEngine:
             new_state = self._put_state(self._shard0_stack(logical), stacked=True)
         else:
             new_state = self._put_state(state, packed=packed)
+        self._finish_restore(new_state, meta)
+
+    def _finish_restore(self, new_state: Any, meta: Dict[str, Any]) -> None:
+        """Atomically commit a validated restored state + the replay cursor
+        (shared by every path of the restore matrix)."""
         with self._state_lock:
             attrs = meta.get("host_attrs")
             if attrs:
@@ -1279,13 +1333,6 @@ class StreamingEngine:
             self._stats.resumes += 1
             if int(meta.get("generations_skipped", 0) or 0) > 0:
                 self._stats.snapshot_fallbacks += 1
-        if restore_handle is not None:
-            tr.end(
-                restore_handle,
-                generations_skipped=int(meta.get("generations_skipped", 0) or 0),
-                cursor=self._batches_done,
-            )
-        return meta
 
     # -------------------------------------------------------------------- dispatcher
 
@@ -1846,12 +1893,25 @@ class StreamingEngine:
         self, args: Tuple[Any, ...], kwargs: Dict[str, Any],
         start: int, stop: int, bucket: int, n_coalesced: int, queue_wait_us: float,
     ) -> None:
-        """One padded device step, transactionally: capture the shadow, run,
-        commit on success; on failure roll back and let :meth:`_recover_step`
-        decide between retry (transient/backoff), kernel demotion, and
-        sticky. Pad+upload happen once — retries reuse the uploaded payload."""
+        """One padded device step: slice+pad the chunk, then hand the padded
+        payload to :meth:`_run_padded_step` (shared with the stream-sharded
+        routed path, which builds its padded payloads itself)."""
         t0 = time.perf_counter()
         a, kw, mask = self._policy.pad_chunk(args, kwargs, start, stop, bucket)
+        self._run_padded_step(
+            a, kw, mask, bucket, stop - start, n_coalesced, queue_wait_us, t0
+        )
+
+    def _run_padded_step(
+        self, a: Tuple[Any, ...], kw: Dict[str, Any], mask: np.ndarray,
+        bucket: int, valid: int, n_coalesced: int, queue_wait_us: float, t0: float,
+    ) -> None:
+        """Run one ALREADY-PADDED payload transactionally: capture the shadow,
+        run, commit on success; on failure roll back and let
+        :meth:`_recover_step` decide between retry (transient/backoff), kernel
+        demotion, and sticky. Upload happens once — retries reuse the uploaded
+        payload. ``t0`` is when pad/route work on this payload began, so the
+        recorded ``pad`` span covers the caller's host-side build too."""
         t_pad = time.perf_counter()
         payload, mask_dev = self._upload((a, kw), mask)
         ingest_us = (time.perf_counter() - t0) * 1e6  # pad+upload only, not compile
@@ -1859,14 +1919,14 @@ class StreamingEngine:
         if tr is not None:
             tr.complete(
                 "pad", trace=self._group_tid or ENGINE_TRACE,
-                dur_us=(t_pad - t0) * 1e6, bucket=bucket, rows=stop - start,
+                dur_us=(t_pad - t0) * 1e6, bucket=bucket, rows=valid,
             )
         attempt = 0
         while True:
             shadow = self._step_shadow()
             try:
                 self._do_step(
-                    payload, mask, mask_dev, bucket, stop - start,
+                    payload, mask, mask_dev, bucket, valid,
                     n_coalesced, queue_wait_us, ingest_us, t0, t_pad,
                 )
                 return

@@ -75,6 +75,24 @@ class EngineStats:
         self.quarantined_rows = 0
         self.snapshot_failures = 0
         self.snapshot_fallbacks = 0
+        # stream-sharded serving (ISSUE 9): host-side routing + LRU paging.
+        # page_hits = submitted rows' streams already resident; page_faults =
+        # streams faulted into an arena slot (from host spill or init);
+        # page_ins/page_outs = row movements between HBM and host RAM. The
+        # *_streams values are point-in-time gauges the engine refreshes at
+        # scrape boundaries (resident = occupied arena slots across shards,
+        # spilled = rows currently living in host RAM).
+        self.routed_steps = 0
+        # device computations issued by MultiStreamEngine.result()/results():
+        # the dispatch-count observable — results() must add exactly ONE per
+        # call, for any S (the batched all-streams program)
+        self.result_device_calls = 0
+        self.page_hits = 0
+        self.page_faults = 0
+        self.page_ins = 0
+        self.page_outs = 0
+        self.resident_streams = 0
+        self.spilled_streams = 0
 
     def record_fault(self, site: str) -> None:
         """One injected fault fired at ``site`` (chaos harness accounting)."""
@@ -99,6 +117,32 @@ class EngineStats:
         if not self.faults_injected and not any(counters.values()):
             return None
         return {"injected": dict(self.faults_injected), **counters}
+
+    def paging_summary(self) -> Optional[Dict[str, Any]]:
+        """The stream-sharding/paging block for :meth:`summary` — None for
+        engines with no routing OR residency activity (every non-sharded
+        engine: only stream-sharded code paths touch these fields), so their
+        telemetry documents are unchanged. The gauge clause matters for a
+        freshly RESTORED sharded engine: it has seated slots (and possibly
+        spilled rows) before its first routed step, and its scrape must say
+        so."""
+        if (
+            not self.routed_steps
+            and not (self.page_hits or self.page_faults)
+            and not (self.resident_streams or self.spilled_streams)
+        ):
+            return None
+        total = self.page_hits + self.page_faults
+        return {
+            "routed_steps": self.routed_steps,
+            "page_hits": self.page_hits,
+            "page_faults": self.page_faults,
+            "page_hit_rate": round(self.page_hits / total, 4) if total else None,
+            "page_ins": self.page_ins,
+            "page_outs": self.page_outs,
+            "resident_streams": self.resident_streams,
+            "spilled_streams": self.spilled_streams,
+        }
 
     def record_merge(self, merge_us: float) -> None:
         """One deferred-sync boundary merge (result()/snapshot/restore): the
@@ -193,6 +237,9 @@ class EngineStats:
         shares = self._host_time_shares(recent, self.mesh_sync)
         if shares is not None:
             out["host_time_shares"] = shares
+        paging = self.paging_summary()
+        if paging is not None:
+            out["paging"] = paging
         faults = self.fault_summary()
         if faults is not None:
             out["faults"] = faults
@@ -224,7 +271,11 @@ class EngineStats:
             "merges": self.merges,
             "merge_us_total": round(self.merge_us_total, 1),
         }
-        if self.mesh_sync == "deferred":
+        if self.mesh_sync in ("deferred", "stream_shard"):
+            # stream_shard engines route host-side and carry NO steady-state
+            # collectives either — boundary merges (deferred) or per-read row
+            # gathers (stream_shard) are the only cross-shard traffic, so the
+            # deferred-style share math applies to both
             denom = self.wall_us_total + self.merge_us_total
             out["collective_share"] = (
                 round(self.merge_us_total / denom, 4) if denom > 0 else None

@@ -1,0 +1,165 @@
+"""Stream-capacity bench: ``python -m metrics_tpu.engine.stream_bench``.
+
+The pinned protocol behind ``BENCH.stream_capacity`` (ISSUE 9), run by
+``bench.py`` in a subprocess with an 8-device virtual CPU mesh. One run
+produces every ratio, so no number is stitched across environments:
+
+* S = 10^4 Zipfian streams served by a stream-sharded MultiStreamEngine at
+  ``resident=16`` slots per shard — device state is the WORKING SET
+  (world x resident x n rows), not S;
+* streams-served-per-chip (S / world) and p50/p99 ``result()`` latency under
+  the Zipfian law (value-in-hand, 200 sampled streams);
+* the same-S UNSHARDED deferred-mesh engine is constructed alongside and its
+  carried buffers measured: every shard holds all S stream rows, i.e. world x
+  the global bytes and S/resident x the sharded engine's per-shard bytes —
+  the replication the stream shard deletes;
+* zero steady compiles after warmup (the routed program set is closed).
+
+Absolute rates on the virtual CPU mesh are host-noise-bound → the entry
+carries ``liveness_only``; the durable facts are the byte ratios, the shape
+assertions, and the compile/dispatch counts (docs/benchmarking.md, "the four
+hazards"). The CPU-scaled S=10^4 stands in for the ROADMAP's 10^5-10^6
+target — capacity scales with host RAM through the pager, not with S-shaped
+device buffers, which is exactly what the byte assertion pins.
+"""
+import json
+import sys
+import time
+
+NUM_DEVICES = 8
+S = 10_000
+# 16 slots/shard = 128 resident streams total: the 320-batch Zipf stream
+# touches ~190 distinct streams, so the LRU MUST spill — the bench proves
+# paging bounds resident bytes, not just that sharding divides them
+RESIDENT = 16
+BUCKETS = (64, 256)
+N_BATCHES = 320
+N_RESULT_SAMPLES = 200
+
+
+def run() -> dict:
+    import numpy as np
+
+    import jax
+    from jax.sharding import Mesh
+
+    from metrics_tpu import Accuracy, MeanSquaredError, MetricCollection
+    from metrics_tpu.engine import AotCache, EngineConfig, MultiStreamEngine
+    from metrics_tpu.engine.stats import _percentile
+    from metrics_tpu.engine.traffic import zipf_stream_ids, zipf_traffic
+
+    devs = jax.devices()
+    if len(devs) < NUM_DEVICES:
+        return {"error": f"need {NUM_DEVICES} devices, have {len(devs)}"}
+    mesh = Mesh(np.asarray(devs[:NUM_DEVICES]), ("dp",))
+
+    def col():
+        return MetricCollection([Accuracy(), MeanSquaredError()])
+
+    traffic = zipf_traffic(S, N_BATCHES, alpha=1.05, seed=97)
+    cache = AotCache()
+    engine = MultiStreamEngine(
+        col(), S,
+        EngineConfig(buckets=BUCKETS, mesh=mesh, axis="dp", mesh_sync="deferred"),
+        aot_cache=cache, stream_shard=True, resident_streams=RESIDENT,
+    )
+    sizes = engine._layout.buffer_sizes()
+    rows = 0
+    with engine:
+        t0 = time.perf_counter()
+        for sid, p, t in traffic:
+            engine.submit(sid, p, t)
+            rows += p.shape[0]
+        engine.flush()
+        ingest_s = time.perf_counter() - t0
+        warm = cache.misses
+        # steady repeat: same shapes, zero compiles (closed routed set)
+        for sid, p, t in traffic[:40]:
+            engine.submit(sid, p, t)
+        engine.flush()
+        steady_compiles = cache.misses - warm
+        # p50/p99 result() under the Zipf law, value-in-hand
+        sample = zipf_stream_ids(S, N_RESULT_SAMPLES, alpha=1.05, seed=131)
+        lat = []
+        for sid in sample:
+            t1 = time.perf_counter()
+            jax.block_until_ready(
+                jax.tree_util.tree_leaves(engine.result(int(sid)))
+            )
+            lat.append((time.perf_counter() - t1) * 1e6)
+        lat.sort()
+
+    shapes = {k: tuple(v.shape) for k, v in engine._state.items()}
+    assert shapes == {
+        k: (NUM_DEVICES, RESIDENT, n) for k, n in sizes.items()
+    }, f"per-shard resident state is not (world, resident, n): {shapes}"
+    sharded_bytes = sum(
+        NUM_DEVICES * RESIDENT * n * np.dtype(k).itemsize for k, n in sizes.items()
+    )
+
+    # the unsharded deferred-mesh engine at the SAME S: every shard carries
+    # ALL S stream rows — measured from its real carried buffers
+    unsharded = MultiStreamEngine(
+        col(), S,
+        EngineConfig(buckets=BUCKETS, mesh=mesh, axis="dp", mesh_sync="deferred"),
+        aot_cache=cache,
+    )
+    unsharded_bytes = sum(
+        int(np.prod(v.shape)) * np.dtype(str(v.dtype)).itemsize
+        for v in unsharded._state.values()
+    )
+    assert unsharded_bytes >= NUM_DEVICES * sum(
+        S * n * np.dtype(k).itemsize for k, n in sizes.items()
+    ), "unsharded engine does not replicate the full S-stream state per shard"
+
+    st = engine.stats
+    return {
+        "value": round(S / NUM_DEVICES, 1),
+        "unit": f"streams/chip (S={S}, {NUM_DEVICES}-dev virtual mesh, resident={RESIDENT}/shard)",
+        "p50_result_us": round(_percentile(lat, 0.5), 1),
+        "p99_result_us": round(_percentile(lat, 0.99), 1),
+        "ingest_rows_per_s": round(rows / ingest_s, 1),
+        "streams": S,
+        "world": NUM_DEVICES,
+        "resident_rows_per_shard": RESIDENT,
+        "device_state_bytes_sharded_paged": int(sharded_bytes),
+        "device_state_bytes_unsharded": int(unsharded_bytes),
+        "bytes_ratio_unsharded_over_sharded": round(unsharded_bytes / sharded_bytes, 1),
+        "steady_compiles_after_warmup": int(steady_compiles),
+        "paging": {
+            "page_hits": st.page_hits,
+            "page_faults": st.page_faults,
+            "page_ins": st.page_ins,
+            "page_outs": st.page_outs,
+            "resident_streams": st.resident_streams,
+            "spilled_streams": st.spilled_streams,
+        },
+        "routed_steps": st.routed_steps,
+        "protocol": (
+            f"{N_BATCHES} Zipf(alpha=1.05, seed=97) batches over S={S} streams, "
+            f"stream_shard resident={RESIDENT}; p50/p99 over {N_RESULT_SAMPLES} "
+            "Zipf-sampled result() calls value-in-hand; unsharded deferred engine "
+            "constructed at the same S for the byte comparison; ratios-in-one-run"
+        ),
+        "liveness_only": True,
+        "note": (
+            "virtual CPU mesh timeshares one host: absolute rates are topology "
+            "liveness; the durable facts are the byte ratio, the (world, resident, n) "
+            "shape assertion, and steady_compiles_after_warmup == 0"
+        ),
+    }
+
+
+def main() -> int:
+    import os
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    print(json.dumps(run()))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,63 @@
+"""Shared traffic generators for the serving gates and benches.
+
+Uniform stream ids cannot exercise an LRU: every stream is equally cold, the
+working set IS the tenant count, and a pager either thrashes or never fires.
+Real multi-tenant traffic is skewed — a few hot tenants dominate while a long
+tail trickles — so the stream-sharding/paging bench, the chaos plan, and the
+paging tests all draw stream ids from ONE seeded Zipfian sampler defined
+here. Sharing the sampler is what keeps the three gates honest about the same
+workload: a plan change moves bench, chaos, and tests in lockstep.
+
+Values are dyadic rationals (multiples of 1/64), the repo-wide convention
+that makes float accumulation exact under ANY grouping, routing, or paging
+order — bit-identical parity claims quantify over exactly this traffic.
+"""
+from typing import List, Tuple
+
+import numpy as np
+
+__all__ = ["zipf_stream_ids", "zipf_traffic"]
+
+
+def zipf_stream_ids(
+    num_streams: int, n: int, alpha: float = 1.1, seed: int = 0
+) -> np.ndarray:
+    """``n`` stream ids in ``[0, num_streams)`` drawn from a bounded Zipf.
+
+    Rank ``r`` (0-based) has probability proportional to ``1/(r+1)^alpha``;
+    rank maps to stream id through a seeded permutation, so the hot set is
+    spread across the id space (and therefore across shards under the
+    ``sid % world`` routing rule) instead of clustering on shard 0.
+    Deterministic in ``(num_streams, n, alpha, seed)``.
+    """
+    if num_streams <= 0 or n < 0:
+        raise ValueError(f"need num_streams > 0 and n >= 0, got {num_streams}, {n}")
+    rng = np.random.RandomState(seed)
+    weights = 1.0 / np.power(np.arange(1, num_streams + 1, dtype=np.float64), float(alpha))
+    weights /= weights.sum()
+    ranks = rng.choice(num_streams, size=int(n), p=weights)
+    perm = np.random.RandomState(seed ^ 0x5A1F).permutation(num_streams)
+    return perm[ranks].astype(np.int32)
+
+
+def zipf_traffic(
+    num_streams: int,
+    n_batches: int,
+    alpha: float = 1.1,
+    seed: int = 0,
+    max_rows: int = 24,
+) -> List[Tuple[int, np.ndarray, np.ndarray]]:
+    """``(stream_id, preds, target)`` batches under the Zipfian stream law:
+    ragged dyadic-float preds and 0/1 int targets (the Accuracy/MSE input
+    shape every serving gate drives). One batch carries one stream's rows —
+    cross-stream mixing happens in the engine's coalescer, same as
+    production ingest."""
+    rng = np.random.RandomState(seed ^ 0x7AFF)
+    sids = zipf_stream_ids(num_streams, n_batches, alpha=alpha, seed=seed)
+    out: List[Tuple[int, np.ndarray, np.ndarray]] = []
+    for sid in sids:
+        rows = int(rng.randint(1, max(2, max_rows + 1)))  # inclusive max_rows
+        preds = (rng.randint(0, 65, size=rows) / 64.0).astype(np.float32)
+        target = (rng.rand(rows) > 0.5).astype(np.int32)
+        out.append((int(sid), preds, target))
+    return out

@@ -274,6 +274,44 @@ def sharded_local_step(
     )
 
 
+def stream_sharded_step(
+    update_fn: Callable,
+    mesh: Mesh,
+    axis: AxisName,
+    payload_abs,
+    mask_abs,
+    state_template,
+    unpack: Optional[Callable] = None,
+    pack: Optional[Callable] = None,
+) -> Callable:
+    """Build the STREAM-SHARDED routed step (ISSUE 9): the stream axis itself
+    is sharded over the mesh — shard ``k`` carries ONLY its own streams'
+    state, as ``(world, resident, n)`` per-dtype paged-arena buffers dim-0
+    sharded over ``axis``.
+
+    The routing contract is entirely HOST-SIDE (``engine/multistream.py``):
+    the dispatcher orders each megabatch's rows by home shard
+    (``stream_id % world``) and pads per-shard segments to ``bucket/world``
+    rows, so under the same ``P(axis)`` batch sharding as every other engine
+    step each device receives EXACTLY the rows addressed to its streams —
+    with slot indices (LOCAL, pager-assigned) as the segment ids. The body is
+    then the ordinary shard-local segmented update: no psum, no gather, no
+    cross-shard addressing — the steady routed step carries ZERO collectives
+    at jaxpr and HLO level, the same contract as :func:`sharded_local_step`
+    (and pinned by the same ``no-collectives-in-deferred-step`` rule).
+
+    Mechanically this IS :func:`sharded_local_step` — the per-device view of
+    a ``(world, resident, n)`` buffer is a ``(resident, n)`` slot-stacked
+    arena, and ``unpack``/``pack`` are the per-stream layout's
+    ``unpack_stacked``/``pack_stacked``. The delegation is deliberate: one
+    collective-free step builder, two carried-state shapes.
+    """
+    return sharded_local_step(
+        update_fn, mesh, axis, payload_abs, mask_abs,
+        state_template=state_template, unpack=unpack, pack=pack,
+    )
+
+
 def sharded_state_merge(
     metric,
     mesh: Mesh,

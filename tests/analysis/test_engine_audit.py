@@ -22,6 +22,25 @@ def _mesh1():
     return Mesh(np.asarray(jax.devices()[:1]), ("dp",))
 
 
+def _drive_sharded(seed=0):
+    """A resident-capped stream-sharded engine (ISSUE 9) whose Zipfian
+    traffic actually paged — the audited routed step is the real
+    slot-addressed paged-arena program."""
+    from metrics_tpu.engine.traffic import zipf_traffic
+
+    eng = MultiStreamEngine(
+        Accuracy(), num_streams=4,
+        config=EngineConfig(buckets=(8,), mesh=_mesh1(), axis="dp", mesh_sync="deferred"),
+        stream_shard=True, resident_streams=2,
+    )
+    with eng:
+        for sid, p, t in zipf_traffic(4, 10, seed=seed):
+            eng.submit(sid, p, t)
+        eng.result(0)
+        eng.results()
+    return eng
+
+
 def _drive(engine, multistream=False, seed=0):
     rng = np.random.RandomState(seed)
     with engine:
@@ -70,6 +89,18 @@ def test_multistream_interpret_engine_audits_clean():
     assert report.findings == [], report.render()
 
 
+def test_stream_sharded_paged_engine_audits_clean():
+    """The routed paged-arena step (ISSUE 9) joins the clean sweep: a
+    resident-capped stream-sharded engine whose traffic actually paged — the
+    audited program is the real slot-addressed segmented update over
+    (world, resident, n) buffers, and no rule (collectives, arena fusion,
+    compile cap) may false-positive on it."""
+    eng = _drive_sharded()
+    assert eng.stats.page_outs > 0  # the cap bound: the audited path paged
+    report = EngineAnalysis().check(eng)
+    assert report.findings == [], report.render()
+
+
 def test_unserved_engine_reports_note_not_findings():
     eng = StreamingEngine(Accuracy(), EngineConfig(buckets=(8,)))
     report = EngineAnalysis().check(eng)
@@ -100,6 +131,28 @@ def test_audit_catches_a_smuggled_collective_in_the_deferred_step():
     rules = {f.rule for f in report.findings}
     assert rules == {"no-collectives-in-deferred-step"}, report.render()
     assert all("psum" in f.path for f in report.findings)
+
+
+def test_audit_catches_a_smuggled_all_gather_in_the_routed_step():
+    """Break the stream-sharded invariant on a REAL paged engine: reroute the
+    routed step's traced update through an all_gather wrapper — the
+    collective-free contract covers the NEW path too, and the audit's
+    re-trace must fail the same named rule."""
+    eng = _drive_sharded()
+    assert EngineAnalysis().check(eng).ok  # sane before the break
+
+    inner = eng._traced_update
+
+    def smuggling_update(state_tree, payload, mask):
+        new = inner(state_tree, payload, mask)
+        # all_gather + slice keeps shapes intact — the collective is the crime
+        return jax.tree.map(lambda x: jax.lax.all_gather(x, "dp")[0], new)
+
+    eng._traced_update = smuggling_update
+    report = EngineAnalysis().check(eng)
+    rules = {f.rule for f in report.findings}
+    assert rules == {"no-collectives-in-deferred-step"}, report.render()
+    assert any("all_gather" in f.path for f in report.findings)
 
 
 def test_audit_catches_a_blown_compile_cap():

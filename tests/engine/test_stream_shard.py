@@ -1,0 +1,472 @@
+"""Stream-sharded MultiStreamEngine + host-side LRU paging (ISSUE 9).
+
+The serving contract under ``stream_shard=True``: the stream axis shards over
+the mesh (shard ``w`` owns ``stream_id % world == w``), the carried state is
+one ``(world, resident, n)`` paged-arena buffer per dtype — per-shard device
+bytes are the WORKING SET, not S — and cold streams spill to host RAM through
+the pager. Every claim here quantifies over seeded Zipfian traffic
+(``engine/traffic.py``; uniform ids cannot exercise an LRU) with dyadic
+values, so parity against the unsharded, unpaged oracle is bit-exact under
+any routing/paging order. The 8-device topology claims live in ``make
+streams-smoke``; these tests pin the same contracts on the 1-device mesh
+(which lowers the identical routed paged-arena program, minus devices) plus
+the pager/traffic unit behavior, the dispatch-count regression for
+``results()``, and the stream-shard restore matrix's refusals.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from metrics_tpu import Accuracy, MeanSquaredError, MetricCollection
+from metrics_tpu.engine import AotCache, EngineConfig, MultiStreamEngine
+from metrics_tpu.engine.paging import StreamPager
+from metrics_tpu.engine.traffic import zipf_stream_ids, zipf_traffic
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+_CACHE = AotCache()
+
+S = 6
+RESIDENT = 2
+BUCKETS = (8, 32)
+
+
+def _collection():
+    return MetricCollection([Accuracy(), MeanSquaredError()])
+
+
+def _mesh1():
+    return Mesh(np.asarray(jax.devices()[:1]), ("dp",))
+
+
+def _cfg(**kw):
+    return EngineConfig(
+        buckets=BUCKETS, mesh=_mesh1(), axis="dp", mesh_sync="deferred", **kw
+    )
+
+
+def _sharded(num_streams=S, resident=RESIDENT, **kw):
+    return MultiStreamEngine(
+        _collection(), num_streams, _cfg(**kw), aot_cache=_CACHE,
+        stream_shard=True, resident_streams=resident,
+    )
+
+
+def _results_np(engine):
+    return {
+        sid: {k: np.asarray(v) for k, v in r.items()}
+        for sid, r in engine.results().items()
+    }
+
+
+def _assert_same(got, want):
+    assert set(got) == set(want)
+    for sid in want:
+        for k in want[sid]:
+            assert np.array_equal(got[sid][k], want[sid][k], equal_nan=True), (
+                f"stream {sid} {k}: {got[sid][k]} != {want[sid][k]}"
+            )
+
+
+# ------------------------------------------------------------------- pager
+
+
+class TestStreamPager:
+    def test_plan_seats_a_round_and_counts_hits_and_faults(self):
+        p = StreamPager(world=2, resident=2)
+        ops, hits, faults = p.plan_residency(0, [3, 3, 5])
+        assert (hits, faults) == (0, 2)
+        assert [(op.kind, op.stream) for op in ops] == [("load", 3), ("load", 5)]
+        p.commit(ops, {})
+        ops2, hits2, faults2 = p.plan_residency(0, [3, 5])
+        assert (ops2, hits2, faults2) == ([], 2, 0)
+
+    def test_eviction_picks_the_oldest_unneeded_resident(self):
+        p = StreamPager(world=1, resident=2)
+        ops, _, _ = p.plan_residency(0, [1, 2])
+        p.commit(ops, {})
+        p.touch(0, [1])  # 2 is now the LRU victim
+        ops, _, _ = p.plan_residency(0, [7])
+        assert [(op.kind, op.stream) for op in ops] == [("evict", 2), ("load", 7)]
+        # the evicted row lands in the spill store; the load clears it
+        p.commit(ops, {(0, 2): {"float32": np.ones(3, np.float32)}})
+        assert p.spilled_row(0, 2) is not None
+        assert p.slot_of(0, 7) is not None and p.slot_of(0, 2) is None
+
+    def test_round_larger_than_resident_raises(self):
+        p = StreamPager(world=1, resident=2)
+        with pytest.raises(ValueError, match="3 distinct streams"):
+            p.plan_residency(0, [0, 1, 2])
+
+    def test_plan_does_not_mutate_until_commit(self):
+        p = StreamPager(world=1, resident=1)
+        ops, _, _ = p.plan_residency(0, [4])
+        assert p.slot_of(0, 4) is None  # planned, not committed
+        p.commit(ops, {})
+        assert p.slot_of(0, 4) == 0
+
+    def test_drop_forgets_slot_and_spill(self):
+        p = StreamPager(world=1, resident=1)
+        p.commit(p.plan_residency(0, [1])[0], {})
+        p.commit(
+            p.plan_residency(0, [2])[0], {(0, 1): {"float32": np.zeros(2, np.float32)}}
+        )
+        assert p.drop(0, 1) is None and p.spilled_row(0, 1) is None
+        assert p.drop(0, 2) == 0
+        assert p.resident_count() == 0 and p.spilled_count() == 0
+
+    def test_snapshot_payload_round_trips(self):
+        p = StreamPager(world=2, resident=2)
+        p.commit(p.plan_residency(0, [1, 3])[0], {})
+        p.commit(
+            p.plan_residency(0, [5])[0],
+            {(0, 1): {"float32": np.arange(3, dtype=np.float32)}},
+        )
+        p.commit(p.plan_residency(1, [0])[0], {})
+        payload = p.snapshot_payload()
+        q = StreamPager(world=2, resident=2)
+        q.load_payload(payload)
+        # residency and spill contents are the durable form; LRU recency
+        # order is not (eviction CHOICE after resume may differ — results
+        # stay exact because spills are lossless)
+        assert set(q.resident_streams(0)) == set(p.resident_streams(0))
+        assert set(q.resident_streams(1)) == set(p.resident_streams(1))
+        assert np.array_equal(q.spilled_row(0, 1)["float32"], np.arange(3, dtype=np.float32))
+        assert q.slot_of(0, 3) == p.slot_of(0, 3)
+
+    def test_empty_spill_block_is_omitted(self):
+        # zero-size arrays break the orbax ocdbt save path: an all-resident
+        # pager's payload must not carry a (0, 2) coords array
+        p = StreamPager(world=1, resident=2)
+        p.commit(p.plan_residency(0, [0])[0], {})
+        payload = p.snapshot_payload()
+        assert "spill_coords" not in payload
+        q = StreamPager(world=1, resident=2)
+        q.load_payload(payload)
+        assert q.slot_of(0, 0) == p.slot_of(0, 0) and q.spilled_count() == 0
+
+    def test_load_payload_rejects_other_topology(self):
+        p = StreamPager(world=2, resident=2)
+        payload = p.snapshot_payload()
+        with pytest.raises(ValueError, match="pager payload"):
+            StreamPager(world=2, resident=4).load_payload(payload)
+
+
+# ----------------------------------------------------------------- traffic
+
+
+class TestZipfTraffic:
+    def test_deterministic_in_seed(self):
+        a = zipf_stream_ids(100, 50, seed=3)
+        assert np.array_equal(a, zipf_stream_ids(100, 50, seed=3))
+        assert not np.array_equal(a, zipf_stream_ids(100, 50, seed=4))
+
+    def test_ids_in_range_and_skewed(self):
+        ids = zipf_stream_ids(1000, 2000, alpha=1.1, seed=0)
+        assert ids.min() >= 0 and ids.max() < 1000
+        # Zipf(1.1) over 1000 ranks: the hottest stream carries far more
+        # than the uniform share (2 draws) — the property an LRU needs
+        top = np.bincount(ids, minlength=1000).max()
+        assert top > 50
+
+    def test_batches_are_dyadic(self):
+        for _, p, t in zipf_traffic(10, 20, seed=1):
+            assert np.array_equal(p * 64, np.round(p * 64))
+            assert set(np.unique(t)) <= {0, 1}
+
+    def test_rejects_bad_args(self):
+        with pytest.raises(ValueError):
+            zipf_stream_ids(0, 5)
+
+
+# ------------------------------------------------------ constructor contract
+
+
+def test_stream_shard_requires_deferred_mesh():
+    with pytest.raises(MetricsTPUUserError, match="mesh_sync='deferred'"):
+        MultiStreamEngine(
+            _collection(), S, EngineConfig(buckets=BUCKETS), stream_shard=True
+        )
+
+
+def test_resident_streams_rejected_without_stream_shard():
+    with pytest.raises(MetricsTPUUserError, match="resident_streams"):
+        MultiStreamEngine(_collection(), S, _cfg(), resident_streams=2)
+
+
+def test_nonpositive_resident_rejected():
+    with pytest.raises(MetricsTPUUserError, match="positive"):
+        _sharded(resident=0)
+
+
+def test_stream_shard_requires_arena():
+    with pytest.raises(MetricsTPUUserError, match="use_arena"):
+        MultiStreamEngine(
+            _collection(), S, _cfg(use_arena=False), stream_shard=True
+        )
+
+
+# --------------------------------------------------- parity past the resident cap
+
+
+def test_sharded_paged_matches_unsharded_oracle_bit_exactly():
+    """S=6 streams behind resident=2 slots under Zipfian traffic: the run
+    MUST spill (cap 2 < distinct streams), and every per-stream result is
+    bit-identical to the unsharded, unpaged oracle."""
+    traffic = zipf_traffic(S, 20, seed=5)
+    oracle = MultiStreamEngine(_collection(), S, EngineConfig(buckets=BUCKETS))
+    with oracle:
+        for sid, p, t in traffic:
+            oracle.submit(sid, p, t)
+        want = _results_np(oracle)
+
+    eng = _sharded()
+    with eng:
+        for sid, p, t in traffic:
+            eng.submit(sid, p, t)
+        got = _results_np(eng)
+    _assert_same(got, want)
+    st = eng.stats
+    assert st.page_outs > 0 and st.page_ins > 0, (
+        f"resident cap never bound: outs={st.page_outs} ins={st.page_ins}"
+    )
+    assert st.routed_steps > 0
+    # per-shard resident state is (world, resident, n) rows — never S
+    sizes = eng._layout.buffer_sizes()
+    shapes = {k: tuple(v.shape) for k, v in eng._state.items()}
+    assert shapes == {k: (1, RESIDENT, n) for k, n in sizes.items()}
+
+
+def test_result_and_stream_state_read_one_row():
+    traffic = zipf_traffic(S, 12, seed=9)
+    oracle = MultiStreamEngine(_collection(), S, EngineConfig(buckets=BUCKETS))
+    eng = _sharded()
+    with oracle, eng:
+        for sid, p, t in traffic:
+            oracle.submit(sid, p, t)
+            eng.submit(sid, p, t)
+        for sid in range(S):
+            want = oracle.result(sid)
+            got = eng.result(sid)
+            for k in want:
+                assert np.array_equal(
+                    np.asarray(got[k]), np.asarray(want[k]), equal_nan=True
+                )
+            ws = oracle.stream_state(sid)
+            gs = eng.stream_state(sid)
+            for a, b in zip(jax.tree_util.tree_leaves(gs), jax.tree_util.tree_leaves(ws)):
+                assert np.array_equal(np.asarray(a), np.asarray(b), equal_nan=True)
+
+
+def test_reset_stream_forgets_one_stream_only():
+    traffic = zipf_traffic(S, 16, seed=7)
+    eng = _sharded()
+    oracle = MultiStreamEngine(_collection(), S, EngineConfig(buckets=BUCKETS))
+    with eng, oracle:
+        for sid, p, t in traffic:
+            eng.submit(sid, p, t)
+            oracle.submit(sid, p, t)
+        victim = traffic[0][0]
+        eng.reset_stream(victim)
+        oracle.reset_stream(victim)
+        # post-reset traffic lands in the fresh accumulation
+        eng.submit(victim, *zipf_traffic(1, 1, seed=11)[0][1:])
+        oracle.submit(victim, *zipf_traffic(1, 1, seed=11)[0][1:])
+        _assert_same(_results_np(eng), _results_np(oracle))
+
+
+def test_untouched_streams_report_init_values():
+    eng = _sharded()
+    oracle = MultiStreamEngine(_collection(), S, EngineConfig(buckets=BUCKETS))
+    with eng, oracle:
+        eng.submit(0, *zipf_traffic(1, 1, seed=2)[0][1:])
+        oracle.submit(0, *zipf_traffic(1, 1, seed=2)[0][1:])
+        _assert_same(_results_np(eng), _results_np(oracle))
+
+
+# -------------------------------------------- results(): one device computation
+
+
+def test_results_issues_exactly_one_device_computation_for_any_s():
+    """The dispatch-count regression: ``results()`` adds exactly ONE device
+    computation per call — sharded or not — instead of the former S
+    per-stream dispatches."""
+    for build in (
+        lambda: _sharded(),
+        lambda: MultiStreamEngine(
+            _collection(), S, EngineConfig(buckets=BUCKETS), aot_cache=_CACHE
+        ),
+    ):
+        eng = build()
+        with eng:
+            for sid, p, t in zipf_traffic(S, 8, seed=3):
+                eng.submit(sid, p, t)
+            before = eng.stats.result_device_calls
+            eng.results()
+            assert eng.stats.result_device_calls == before + 1
+            eng.results()
+            assert eng.stats.result_device_calls == before + 2
+
+
+def test_batched_results_program_size_constant_in_s():
+    """jaxpr-op-count regression: the batched all-streams compute is ONE
+    vmapped program whose op count does not grow with S — the property that
+    makes a dashboard scrape at S=10^5 one dispatch, not 10^5."""
+    def eqn_count(num_streams):
+        eng = MultiStreamEngine(
+            _collection(), num_streams, EngineConfig(buckets=BUCKETS), aot_cache=_CACHE
+        )
+        with eng:
+            eng.submit(*zipf_traffic(num_streams, 1, seed=41)[0])
+            eng.flush()  # one batch determines the metric's host mode attrs
+        return len(jax.make_jaxpr(eng._results_traced)(eng._compute_input_abstract()).eqns)
+
+    assert eqn_count(4) == eqn_count(64)
+
+    def sharded_eqn_count(num_streams):
+        eng = _sharded(num_streams=num_streams)
+        with eng:
+            eng.submit(*zipf_traffic(num_streams, 1, seed=41)[0])
+            eng.flush()
+        stacked_abs = {
+            k: jax.ShapeDtypeStruct((num_streams, n), jnp.dtype(k))
+            for k, n in eng._layout.buffer_sizes().items()
+        }
+        return len(jax.make_jaxpr(eng._results_traced_sharded)(stacked_abs).eqns)
+
+    assert sharded_eqn_count(4) == sharded_eqn_count(64)
+
+
+# ------------------------------------------------------------ restore matrix
+
+
+def test_restore_matrix_same_world_and_merged(tmp_path):
+    """{sharded+paged -> same-world verbatim, -> single-device merged}: both
+    replays land bit-identical to the uninterrupted run, from a snapshot
+    taken WITH rows spilled."""
+    traffic = zipf_traffic(S, 20, seed=13)
+    cut = 12
+    oracle = MultiStreamEngine(_collection(), S, EngineConfig(buckets=BUCKETS))
+    with oracle:
+        for sid, p, t in traffic:
+            oracle.submit(sid, p, t)
+        want = _results_np(oracle)
+
+    snapdir = str(tmp_path / "snaps")
+    eng = _sharded(snapshot_dir=snapdir)
+    with eng:
+        for sid, p, t in traffic[:cut]:
+            eng.submit(sid, p, t)
+        eng.flush()
+        assert eng._pager.spilled_count() > 0, "claim needs rows spilled at snapshot"
+        eng.snapshot()
+    del eng
+
+    same = _sharded(snapshot_dir=snapdir)
+    meta = same.restore()
+    assert int(meta["batches_done"]) == cut
+    assert meta.get("mesh_sync") == "stream_shard"
+    assert int(meta.get("world", 0)) == 1 and int(meta.get("resident", 0)) == RESIDENT
+    with same:
+        for sid, p, t in traffic[cut:]:
+            same.submit(sid, p, t)
+        _assert_same(_results_np(same), want)
+
+    merged = MultiStreamEngine(
+        _collection(), S, EngineConfig(buckets=BUCKETS, snapshot_dir=snapdir),
+        aot_cache=_CACHE,
+    )
+    merged.restore()
+    with merged:
+        for sid, p, t in traffic[cut:]:
+            merged.submit(sid, p, t)
+        _assert_same(_results_np(merged), want)
+
+
+def test_restore_refuses_other_topologies(tmp_path):
+    snapdir = str(tmp_path / "snaps")
+    eng = _sharded(snapshot_dir=snapdir)
+    with eng:
+        for sid, p, t in zipf_traffic(S, 8, seed=17):
+            eng.submit(sid, p, t)
+        eng.snapshot()
+    # different (world, resident): slot tables are not portable
+    other = _sharded(resident=RESIDENT + 1, snapshot_dir=snapdir)
+    with pytest.raises(MetricsTPUUserError, match="SAME"):
+        other.restore()
+    # different S
+    wrong_s = MultiStreamEngine(
+        _collection(), S + 1, EngineConfig(buckets=BUCKETS, snapshot_dir=snapdir)
+    )
+    with pytest.raises(MetricsTPUUserError, match="streams"):
+        wrong_s.restore()
+
+
+def test_plain_snapshot_refused_by_sharded_engine(tmp_path):
+    snapdir = str(tmp_path / "plain")
+    plain = MultiStreamEngine(
+        _collection(), S, EngineConfig(buckets=BUCKETS, snapshot_dir=snapdir),
+        aot_cache=_CACHE,
+    )
+    with plain:
+        plain.submit(*zipf_traffic(S, 1, seed=19)[0])
+        plain.snapshot()
+    refuser = _sharded(snapshot_dir=snapdir)
+    with pytest.raises(MetricsTPUUserError, match="not written by a stream-sharded"):
+        refuser.restore()
+
+
+# --------------------------------------------------------------- telemetry
+
+
+def test_metrics_text_paging_surface_parses_strictly():
+    """The OpenMetrics exposition of a sharded engine carries the paging
+    families and survives the strict parser (tools/trace_export.py); a
+    non-sharded engine's surface stays byte-stable (no paging families)."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..", "tools"))
+    import trace_export
+
+    eng = _sharded()
+    with eng:
+        for sid, p, t in zipf_traffic(S, 12, seed=23):
+            eng.submit(sid, p, t)
+        eng.flush()
+    fams = trace_export.parse_openmetrics(eng.metrics_text())
+    pre = "metrics_tpu_engine_"
+    for fam in ("page_hits", "page_faults", "page_ins", "page_outs", "routed_steps"):
+        assert fams[pre + fam]["type"] == "counter", fam
+    for fam in ("resident_streams", "spilled_streams"):
+        assert fams[pre + fam]["type"] == "gauge", fam
+    assert fams[pre + "resident_streams"]["samples"][0]["value"] > 0
+
+    plain = MultiStreamEngine(
+        _collection(), S, EngineConfig(buckets=BUCKETS), aot_cache=_CACHE
+    )
+    with plain:
+        plain.submit(*zipf_traffic(S, 1, seed=29)[0])
+        plain.flush()
+    assert not any("page" in k for k in trace_export.parse_openmetrics(plain.metrics_text()))
+
+
+def test_summary_paging_block_present_only_when_routed():
+    eng = _sharded()
+    with eng:
+        for sid, p, t in zipf_traffic(S, 12, seed=31):
+            eng.submit(sid, p, t)
+        eng.flush()
+    paging = eng.stats.summary()["paging"]
+    assert paging["routed_steps"] > 0
+    assert paging["page_hits"] + paging["page_faults"] > 0
+    assert paging["resident_streams"] <= RESIDENT  # world=1
+    plain = MultiStreamEngine(
+        _collection(), S, EngineConfig(buckets=BUCKETS), aot_cache=_CACHE
+    )
+    with plain:
+        plain.submit(*zipf_traffic(S, 1, seed=37)[0])
+        plain.flush()
+    assert "paging" not in plain.stats.summary()

@@ -214,6 +214,58 @@ class TestEngineReportJson:
         out = json.loads(capsys.readouterr().out)
         assert "trace" not in out
 
+    PAGING = {
+        "routed_steps": 7,
+        "page_hits": 30,
+        "page_faults": 10,
+        "page_hit_rate": 0.75,
+        "page_ins": 10,
+        "page_outs": 4,
+        "resident_streams": 16,
+        "spilled_streams": 9,
+    }
+
+    def test_text_mode_renders_stream_paging_row(self, tmp_path, capsys):
+        doc = {**self.DOC, "summary": {**self.DOC["summary"], "paging": self.PAGING}}
+        p = tmp_path / "tele.json"
+        p.write_text(json.dumps(doc))
+        assert engine_report.main([str(p)]) == 0
+        out = capsys.readouterr().out
+        assert "stream paging" in out
+        assert "75.0% hit rate" in out
+        assert "resident 16" in out and "spilled 9" in out
+        assert "routed steps 7" in out
+
+    def test_text_mode_without_paging_block_omits_the_row(self, tmp_path, capsys):
+        p = tmp_path / "tele.json"
+        p.write_text(json.dumps(self.DOC))
+        assert engine_report.main([str(p)]) == 0
+        assert "stream paging" not in capsys.readouterr().out
+
+    def test_json_mode_carries_paging_block(self, tmp_path, capsys):
+        doc = {**self.DOC, "summary": {**self.DOC["summary"], "paging": self.PAGING}}
+        p = tmp_path / "tele.json"
+        p.write_text(json.dumps(doc))
+        assert engine_report.main([str(p), "--json"]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["summary"]["paging"] == self.PAGING
+
+    def test_paging_exposition_families_parse_strictly(self):
+        # the exact family names pipeline.metrics_text() emits for a
+        # stream-sharded engine — counters take _total, gauges are bare
+        pre = "metrics_tpu_engine_"
+        text = ""
+        for fam, v in (("page_faults", 10), ("page_hits", 30), ("page_ins", 10),
+                       ("page_outs", 4), ("routed_steps", 7)):
+            text += f"# TYPE {pre}{fam} counter\n{pre}{fam}_total {v}\n"
+        for fam, v in (("resident_streams", 16), ("spilled_streams", 9)):
+            text += f"# TYPE {pre}{fam} gauge\n{pre}{fam} {v}\n"
+        text += "# EOF\n"
+        fams = trace_export.parse_openmetrics(text)
+        assert fams[pre + "page_hits"]["type"] == "counter"
+        assert fams[pre + "resident_streams"]["type"] == "gauge"
+        assert fams[pre + "resident_streams"]["samples"][0]["value"] == 16
+
     def test_summary_nested_trace_is_found(self, tmp_path, capsys):
         # a live telemetry() dict nests the section inside the summary
         nested = {"summary": {**self.DOC["summary"], "trace": self.DOC["trace"]},

@@ -124,6 +124,22 @@ def render(doc: dict, steps: int = 10) -> str:
                 ),
             ),
         )
+    paging = s.get("paging")
+    if paging:
+        rate = paging.get("page_hit_rate")
+        rows.insert(
+            3,
+            (
+                "stream paging",
+                f"hits {_fmt(paging.get('page_hits'))} / faults "
+                f"{_fmt(paging.get('page_faults'))}"
+                + (f" ({100 * rate:.1f}% hit rate)" if rate is not None else "")
+                + f" · in {_fmt(paging.get('page_ins'))} / out {_fmt(paging.get('page_outs'))}"
+                + f" · resident {_fmt(paging.get('resident_streams'))}"
+                + f" / spilled {_fmt(paging.get('spilled_streams'))}"
+                + f" · routed steps {_fmt(paging.get('routed_steps'))}",
+            ),
+        )
     shares = s.get("host_time_shares")
     if shares:
         rows.insert(
