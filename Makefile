@@ -2,9 +2,9 @@
 # Default flow runs the smoke checks (seconds) before the full suite.
 # Sidecar artifacts (telemetry JSON, analysis reports) land under out/
 # (gitignored) — never in the repo root.
-.PHONY: all test engine-smoke kernels-smoke mesh-smoke streams-smoke chaos-smoke obs-smoke analyze clean native bench
+.PHONY: all test engine-smoke kernels-smoke mesh-smoke streams-smoke chaos-smoke obs-smoke quant-smoke analyze clean native bench
 
-all: engine-smoke kernels-smoke mesh-smoke streams-smoke chaos-smoke obs-smoke analyze test
+all: engine-smoke kernels-smoke mesh-smoke streams-smoke chaos-smoke obs-smoke quant-smoke analyze test
 
 test:
 	python -m pytest tests/ -q
@@ -59,6 +59,16 @@ chaos-smoke:
 # span event. Validators: tools/trace_export.py. Docs: docs/observability.md.
 obs-smoke:
 	JAX_PLATFORMS=cpu python -m metrics_tpu.engine.obs_smoke out/trace_obs.json out/obs_metrics.txt
+
+# Quantized-sync gate, CPU-safe (bootstraps the 8-device virtual mesh):
+# block-scaled int8 sync on a float-heavy collection — >=3x sync payload
+# reduction, quantized deferred engine within the per-metric bounded-error
+# oracle (counts bit-exact), AOT keys distinct per sync_precision policy
+# across one shared cache, zero steady compiles, policy audit clean, and
+# kill/resume through a COMPRESSED snapshot (metrics_tpu/engine/
+# quant_smoke.py). Docs: docs/distributed.md "Quantized sync".
+quant-smoke:
+	JAX_PLATFORMS=cpu python -m metrics_tpu.engine.quant_smoke
 
 # Static-analysis gate, CPU-safe (metrics_tpu/analysis + tools/analyze.py):
 # program plane audits the bootstrap engine matrix ({step,deferred} x

@@ -43,8 +43,10 @@ from metrics_tpu.analysis.rules import (
     check_no_collectives,
     check_no_scatter_under_pallas,
     check_pallas_call_count,
+    check_quantized_policy_honored,
     collective_counts,
     expected_step_sync_collectives,
+    expected_sync_payload,
     hlo_collective_counts,
 )
 from metrics_tpu.analysis.source import check_source_text, check_source_tree
@@ -65,10 +67,12 @@ __all__ = [
     "check_no_collectives",
     "check_no_scatter_under_pallas",
     "check_pallas_call_count",
+    "check_quantized_policy_honored",
     "check_source_text",
     "check_source_tree",
     "collective_counts",
     "expected_step_sync_collectives",
+    "expected_sync_payload",
     "hlo_collective_counts",
     "iter_eqns",
     "primitive_counts",

@@ -189,6 +189,13 @@ class EngineAnalysis:
                     report.note(f"{where}: collective multiset not derivable ({e})")
                 else:
                     report.extend(R.check_collective_multiset(jaxpr, expected, where=where))
+                # the quantized-sync policy audit: the step's fused bundle
+                # must size exactly as the declared per-state precisions imply
+                info = self._sync_leaf_info(engine)
+                if info is not None:
+                    report.extend(R.check_quantized_policy_honored(
+                        jaxpr, info, engine._world, where=where
+                    ))
             if kernel_backend != "xla":
                 report.extend(R.check_no_scatter_under_pallas(jaxpr, where=where))
                 if self._kernel_path_expected(engine):
@@ -219,6 +226,23 @@ class EngineAnalysis:
         if not engine._donate:
             report.note(f"{label}: donation off (CPU or config) — donation-honored skipped")
 
+        # deferred engines bear their collectives in the BOUNDARY MERGE — the
+        # quantized-sync policy audit re-traces it (read-only, from abstract
+        # signatures). Stream-sharded engines route host-side and have no
+        # merge program; their at-rest codec is policy-checked at restore.
+        if (
+            deferred
+            and not getattr(engine, "_stream_shard", False)
+            and hasattr(engine, "_merge_callable")
+        ):
+            info = self._sync_leaf_info(engine)
+            if info is not None:
+                with engine._kernel_scope():
+                    merge_jaxpr = jax.make_jaxpr(engine._merge_callable())(state_abs)
+                report.extend(R.check_quantized_policy_honored(
+                    merge_jaxpr, info, engine._world, where=f"{label}/merge"
+                ))
+
         # compile cap: programs this engine owns in its (possibly shared) cache
         cap_detail = ""
         n_owned = self._owned_programs(engine)
@@ -248,6 +272,37 @@ class EngineAnalysis:
                 engine._metric, where=f"{label}/compute", alternates=self._alternates
             ))
         return report
+
+    @staticmethod
+    def _sync_leaf_info(engine: Any) -> Optional[Any]:
+        """The metric's declared ``(fx, leaf, precision)`` triples for the
+        quantized-policy audit — None when the flat model does not apply
+        (wrapper metrics with nested children sync their subtrees in
+        SEPARATE recursive bundles, so the flat size check would be wrong)."""
+        metric = engine._metric
+        info_fn = getattr(metric, "sync_leaf_info", None)
+        if info_fn is None:
+            return None
+        members = (
+            [m for _, m in metric.items(keep_base=True)]
+            if hasattr(metric, "items") and not hasattr(metric, "_defaults")
+            else [metric]
+        )
+        if any(m._child_metrics() for m in members):
+            return None
+        info = info_fn()
+        # unsharded MultiStreamEngines sync the (S, ...)-STACKED state: every
+        # leaf the bundle carries has a leading stream axis, so the expected
+        # payload scales accordingly (stream-sharded engines never merge)
+        n_streams = getattr(engine, "num_streams", None)
+        if n_streams and not getattr(engine, "_stream_shard", False):
+            import jax
+
+            info = [
+                (fx, jax.ShapeDtypeStruct((int(n_streams),) + tuple(leaf.shape), leaf.dtype), prec)
+                for fx, leaf, prec in info
+            ]
+        return info
 
     @staticmethod
     def _kernel_path_expected(engine: Any) -> bool:

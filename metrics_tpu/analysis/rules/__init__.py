@@ -33,6 +33,10 @@ from metrics_tpu.analysis.rules.pallas import (
     check_no_scatter_under_pallas,
     check_pallas_call_count,
 )
+from metrics_tpu.analysis.rules.quantized import (
+    check_quantized_policy_honored,
+    expected_sync_payload,
+)
 
 __all__ = [
     "COLLECTIVE_PRIMITIVES",
@@ -46,10 +50,12 @@ __all__ = [
     "check_no_collectives",
     "check_no_scatter_under_pallas",
     "check_pallas_call_count",
+    "check_quantized_policy_honored",
     "collective_counts",
     "collective_eqn_paths",
     "default_attr_alternates",
     "expected_step_sync_collectives",
+    "expected_sync_payload",
     "hlo_collective_counts",
     "parse_hlo_aliased_params",
 ]
@@ -79,6 +85,16 @@ RULES: Dict[str, RuleInfo] = {
             "all sum states + the token psum + one collective per extra "
             "(reduction, dtype).",
             incident="PR 5's per-test multiset pins",
+        ),
+        RuleInfo(
+            "quantized-sync-policy-honored", "program", "error",
+            "States ride the payload their sync_precision declares: the fused "
+            "bundle's f32 psum element count and u32 gather word count (incl. "
+            "the int8 codes+scales section) equal the policy's analytic plan — "
+            "an 'exact' state on the quantized rider loses bit-exactness, a "
+            "quantized state on the f32 psum pays exact bandwidth silently.",
+            incident="ISSUE 10: the policy is a trace constant, so a stale "
+            "program serves the WRONG precision without erroring",
         ),
         RuleInfo(
             "no-scatter-under-pallas", "program", "error",

@@ -109,7 +109,11 @@ def expected_step_sync_collectives(metric: Any) -> Dict[str, int]:
     * 'mean'/'min'/'max' leaves cost one ``pmean``/``pmin``/``pmax`` per
       (reduction, dtype) bucket;
     * any 'cat'/None/custom (or rider-ineligible 'sum') leaf joins the single
-      u32-carrier ``all_gather``.
+      u32-carrier ``all_gather``;
+    * QUANTIZED float 'sum' leaves (``sync_precision="q8_block"``) leave the
+      psum bundle and join that same gather as block-scaled int8 — an
+      all-quantized policy with no counters would drop the bundle psum
+      entirely (the token psum always remains).
 
     Raises ``ValueError`` for metrics with nested child metrics — their
     states sync recursively with their own bundles, so the flat multiset
@@ -125,8 +129,13 @@ def expected_step_sync_collectives(metric: Any) -> Dict[str, int]:
     have_sum_bundle = False
     reduce_buckets = set()
     have_gather = False
-    for fx, dtype in leaves:
-        if fx == "sum" and dtype is not None and _sum_rider(jnp.dtype(dtype)) is not None:
+    for fx, dtype, prec in leaves:
+        is_float_sum = (
+            fx == "sum" and dtype is not None and _sum_rider(jnp.dtype(dtype)) == "float"
+        )
+        if prec == "q8_block" and is_float_sum:
+            have_gather = True  # codes + scales ride the shared u32 carrier
+        elif fx == "sum" and dtype is not None and _sum_rider(jnp.dtype(dtype)) is not None:
             have_sum_bundle = True
         elif fx in _REDUCE_COLLECTIVES and fx != "sum":
             reduce_buckets.add((fx, str(dtype)))
@@ -141,10 +150,11 @@ def expected_step_sync_collectives(metric: Any) -> Dict[str, int]:
     return {k: v for k, v in counts.items() if v}
 
 
-def _state_reduction_leaves(metric: Any) -> List[Tuple[Any, Any]]:
-    """Flat ``(dist_reduce_fx, dtype)`` per top-level state leaf, mirroring
-    the leaves ``MetricCollection.sync_states``/``Metric.sync_states`` fuse."""
-    out: List[Tuple[Any, Any]] = []
+def _state_reduction_leaves(metric: Any) -> List[Tuple[Any, Any, str]]:
+    """Flat ``(dist_reduce_fx, dtype, sync_precision)`` per top-level state
+    leaf, mirroring the leaves ``MetricCollection.sync_states``/
+    ``Metric.sync_states`` fuse."""
+    out: List[Tuple[Any, Any, str]] = []
 
     def one(m: Any) -> None:
         if m._child_metrics():
@@ -156,10 +166,11 @@ def _state_reduction_leaves(metric: Any) -> List[Tuple[Any, Any]]:
         for k in m._defaults:
             fx = m._reductions[k]
             v = abs_state[k]
+            prec = m._sync_precision.get(k, "exact")
             if isinstance(m._defaults[k], list):
-                out.append(("cat" if fx is None else fx, None))
+                out.append(("cat" if fx is None else fx, None, "exact"))
             else:
-                out.append((fx, getattr(v, "dtype", None)))
+                out.append((fx, getattr(v, "dtype", None), prec))
 
     if hasattr(metric, "items") and not hasattr(metric, "_defaults"):
         for _, m in metric.items(keep_base=True):

@@ -1,0 +1,141 @@
+"""Rule ``quantized-sync-policy-honored``: states ride the payload their
+``sync_precision`` declares (ISSUE 10).
+
+The quantized-sync contract is structural, like collective placement: under a
+metric's policy, each state leaf belongs to exactly one rider — the f32 psum
+bundle (exact floats + integer digit riders), a per-(reduction, dtype)
+collective, the verbatim u32 gather carrier, or the block-scaled int8 section
+of that carrier. A state crossing riders is silent corruption in one
+direction (an "exact" count riding quantized loses bit-exactness) and a
+silent bandwidth regression in the other (a quantized Gram accumulator
+falling back to f32 psum).
+
+The audit is size-based and program-plane: from the metric's declared
+``(fx, leaf, precision)`` triples, ``parallel/collectives.py::fused_sync_plan``
+derives the EXACT flat element count of the f32 psum bundle and the EXACT u32
+word count of the shared gather — then the traced merge/step jaxpr must
+contain a psum over exactly that many f32 elements (none, when everything
+quantizes away) and an all_gather over exactly that many u32 words. Any
+policy violation moves elements between the buckets and changes both counts,
+so a mismatch IS the finding. The clean-twin fixture in
+``tests/analysis/test_program_rules.py`` pins the analytic plan against an
+actual ``fused_axis_sync`` trace, so the two can never drift apart silently.
+"""
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from metrics_tpu.analysis.core import Finding
+
+__all__ = ["check_quantized_policy_honored", "expected_sync_payload"]
+
+
+def expected_sync_payload(
+    leaf_info: Sequence[Tuple[Any, Any, Optional[str]]], world: int
+) -> Dict[str, int]:
+    """``{"sum_elems", "gather_words"}`` the fused sync must trace for the
+    declared ``(fx, abstract_leaf, precision)`` triples on a ``world``-shard
+    axis — straight from the shared accounting in ``parallel/collectives.py``
+    (quantized leaves' codes+scales words count into the gather)."""
+    from metrics_tpu.parallel.collectives import fused_sync_plan
+
+    plan = fused_sync_plan(leaf_info, world)
+    return {
+        "sum_elems": int(plan["sum_elems"]),
+        "gather_words": int(plan["gather_words"] + plan["q8_words"]),
+    }
+
+
+def _bundle_sizes(jaxpr: Any) -> Tuple[List[int], List[int]]:
+    """(f32 psum operand sizes, u32 all_gather operand sizes) anywhere in
+    the jaxpr — the observable the policy audit compares against. The
+    valid-row token psum is i32 and per-(reduction, dtype) collectives carry
+    their own dtypes, so filtering by dtype isolates the fused bundle."""
+    import numpy as np
+
+    from metrics_tpu.analysis.program import iter_eqns, unwrap_jaxpr
+
+    psums: List[int] = []
+    gathers: List[int] = []
+    for _, eqn in iter_eqns(unwrap_jaxpr(jaxpr)):
+        name = eqn.primitive.name
+        if name not in ("psum", "psum2", "all_gather", "all_gather_invariant"):
+            continue
+        for var in eqn.invars:
+            aval = getattr(var, "aval", None)
+            dtype = getattr(aval, "dtype", None)
+            if dtype is None:
+                continue
+            size = 1
+            for d in getattr(aval, "shape", ()):
+                size *= int(d)
+            if name.startswith("psum") and np.dtype(dtype) == np.float32:
+                psums.append(size)
+            elif name.startswith("all_gather") and np.dtype(dtype) == np.uint32:
+                gathers.append(size)
+    return psums, gathers
+
+
+def check_quantized_policy_honored(
+    jaxpr: Any,
+    leaf_info: Sequence[Tuple[Any, Any, Optional[str]]],
+    world: int,
+    where: str = "",
+) -> List[Finding]:
+    """Audit one merge/step-sync program against the declared policy: the
+    traced f32 psum bundle and u32 gather carrier must carry EXACTLY the
+    element/word counts the policy implies. ``leaf_info`` is the metric's
+    ``sync_leaf_info()``; ``world`` the mesh axis size the program lowered
+    for (the integer digit split depends on it)."""
+    want = expected_sync_payload(leaf_info, world)
+    psums, gathers = _bundle_sizes(jaxpr)
+    findings: List[Finding] = []
+    hint = (
+        "a state is riding the wrong payload for its declared sync_precision — "
+        "an 'exact' state on the quantized rider loses bit-exactness, a "
+        "quantized state on the f32 psum silently pays exact bandwidth; check "
+        "that sync_states passes the per-leaf precisions through "
+        "parallel/collectives.py::fused_axis_sync and that the policy was set "
+        "BEFORE the engine compiled its programs"
+    )
+    if want["sum_elems"] > 0 and want["sum_elems"] not in psums:
+        findings.append(Finding(
+            rule="quantized-sync-policy-honored", severity="error",
+            where=where, path="psum",
+            message=(
+                f"no f32 psum of {want['sum_elems']} elements in the program "
+                f"(observed f32 psum sizes: {sorted(psums) or 'none'}) — the exact "
+                "sum bundle does not match the declared policy"
+            ),
+            hint=hint,
+        ))
+    if want["sum_elems"] == 0 and psums:
+        findings.append(Finding(
+            rule="quantized-sync-policy-honored", severity="error",
+            where=where, path="psum",
+            message=(
+                f"policy quantizes every sum leaf, but the program still traces "
+                f"f32 psums of sizes {sorted(psums)} — an exact bundle survived"
+            ),
+            hint=hint,
+        ))
+    if want["gather_words"] > 0 and want["gather_words"] not in gathers:
+        findings.append(Finding(
+            rule="quantized-sync-policy-honored", severity="error",
+            where=where, path="all_gather",
+            message=(
+                f"no u32 all_gather of {want['gather_words']} words in the program "
+                f"(observed: {sorted(gathers) or 'none'}) — the carrier (incl. the "
+                "quantized codes+scales section) does not match the declared policy"
+            ),
+            hint=hint,
+        ))
+    if want["gather_words"] == 0 and gathers:
+        findings.append(Finding(
+            rule="quantized-sync-policy-honored", severity="error",
+            where=where, path="all_gather",
+            message=(
+                f"policy implies no gather carrier, but the program traces u32 "
+                f"all_gathers of sizes {sorted(gathers)}"
+            ),
+            hint=hint,
+        ))
+    return findings

@@ -360,6 +360,61 @@ class MetricCollection(dict):
 
         return ArenaLayout.for_state(self.abstract_state())
 
+    # ------------------------------------------------------- sync precision policy
+
+    def set_sync_precision(
+        self, spec: Union[str, Dict[str, Union[str, Dict[str, str]]]]
+    ) -> "MetricCollection":
+        """Declare the collection's quantized-sync policy (chainable). A
+        blanket string fans out to every member (``Metric.set_sync_precision``
+        semantics: eligible float-sum states quantize, counts/cat stay
+        exact); a dict keyed by member name routes per-member specs."""
+        if isinstance(spec, str):
+            for _, m in self.items(keep_base=True):
+                m.set_sync_precision(spec)
+        elif isinstance(spec, dict):
+            for name, sub in spec.items():
+                if name not in self:
+                    raise ValueError(f"no member named {name!r} in this collection")
+                dict.__getitem__(self, name).set_sync_precision(sub)
+        else:
+            raise ValueError(
+                f"sync_precision spec must be a string or a per-member dict, got {type(spec).__name__}"
+            )
+        return self
+
+    def state_sync_precisions(self) -> Dict[str, str]:
+        """Flat ``{member.state_path: precision}`` over every member."""
+        out: Dict[str, str] = {}
+        for k, m in self.items(keep_base=True):
+            for path, prec in m.state_sync_precisions().items():
+                out[f"{k}.{path}"] = prec
+        return out
+
+    def sync_precision_tag(self) -> str:
+        """Policy tag for AOT program keys (see ``Metric.sync_precision_tag``
+        — same shared implementation, so the two can never drift)."""
+        from metrics_tpu.metric import sync_precision_tag_of
+
+        return sync_precision_tag_of(self.state_sync_precisions())
+
+    def sync_leaf_info(self) -> List[Any]:
+        """Member-concatenated ``(fx, abstract_leaf, precision)`` triples —
+        the payload-accounting/audit view (``Metric.sync_leaf_info``)."""
+        out: List[Any] = []
+        for _, m in self.items(keep_base=True):
+            out.extend(m.sync_leaf_info())
+        return out
+
+    def sync_error_bounds(self, state: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
+        """Per-member bounded-error oracle over a shard-stacked collection
+        state (``Metric.sync_error_bounds``), keys prefixed by member name."""
+        out: Dict[str, Any] = {}
+        for k, m in self.items(keep_base=True):
+            for path, bound in m.sync_error_bounds(state[k]).items():
+                out[f"{k}.{path}"] = bound
+        return out
+
     def host_compute_attrs(self) -> Dict[str, Any]:
         """Flat ``{member.path: value}`` of every member's host-derived
         compute attributes (``Metric.host_compute_attrs``)."""
@@ -385,6 +440,7 @@ class MetricCollection(dict):
             return state
         leaves: List[Tuple[Any, Any]] = []
         slots: List[Tuple[str, str]] = []
+        precs: List[str] = []
         for k, m in self.items(keep_base=True):
             for sname in m._defaults:
                 v = state[k][sname]
@@ -394,7 +450,10 @@ class MetricCollection(dict):
                 # gathered list states stay FLATTENED (reference metric.py:249-252)
                 leaves.append(("cat" if fx is None and was_list else fx, v))
                 slots.append((k, sname))
-        synced = fused_axis_sync(leaves, axis)
+                precs.append(
+                    "exact" if was_list else m._sync_precision.get(sname, "exact")
+                )
+        synced = fused_axis_sync(leaves, axis, precisions=precs)
         out: Dict[str, Dict[str, Any]] = {k: {} for k, _ in self.items(keep_base=True)}
         for (k, sname), v in zip(slots, synced):
             out[k][sname] = v

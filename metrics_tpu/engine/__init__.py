@@ -43,6 +43,12 @@ host-side batching and queueing. This package supplies it:
   periodic atomic snapshots of the accumulated state (orbax-backed, resumable
   after a kill) and ring-buffer telemetry (queue depth, padding waste,
   compile-cache hits, step latency spread) exported as JSON.
+* :mod:`~metrics_tpu.engine.quantize` — the block-scaled int8 codec for
+  state at REST (ISSUE 10): ``EngineConfig(compress_payloads=True)`` stores
+  snapshot payloads and pager spill rows quantized under the metric's
+  ``sync_precision`` policy — the same policy that rides the wire through
+  ``parallel/collectives.py``'s quantized collective rider. Gate:
+  ``make quant-smoke`` (:mod:`~metrics_tpu.engine.quant_smoke`).
 
 Quickstart::
 
@@ -74,6 +80,13 @@ from metrics_tpu.engine.faults import (
 )
 from metrics_tpu.engine.multistream import MultiStreamEngine
 from metrics_tpu.engine.pipeline import EngineConfig, StreamingEngine
+from metrics_tpu.engine.quantize import (
+    ArenaRowCodec,
+    decode_state_tree,
+    encode_state_tree,
+    q8_decode_array,
+    q8_encode_array,
+)
 from metrics_tpu.engine.snapshot import (
     generations,
     latest_snapshot,
@@ -92,6 +105,7 @@ from metrics_tpu.engine.trace import (
 __all__ = [
     "AotCache",
     "ArenaLayout",
+    "ArenaRowCodec",
     "BackpressureTimeout",
     "BoundaryMergeError",
     "BucketPolicy",
@@ -110,11 +124,15 @@ __all__ = [
     "StepTimeoutError",
     "StreamingEngine",
     "TraceRecorder",
+    "decode_state_tree",
     "device_trace_session",
     "enable_persistent_compilation_cache",
+    "encode_state_tree",
     "generations",
     "latest_snapshot",
     "load_snapshot",
+    "q8_decode_array",
+    "q8_encode_array",
     "render_openmetrics",
     "save_snapshot",
 ]

@@ -260,6 +260,7 @@ class AotCache:
         mesh: Any = None,
         donate: bool = False,
         sync: str = "step",
+        precision: str = "exact",
     ) -> Tuple:
         """Structural program identity. ``sync`` is the engine's mesh sync
         mode (``"step"`` merges shard deltas inside every step; ``"deferred"``
@@ -267,7 +268,13 @@ class AotCache:
         lower DIFFERENT programs over the same payload signature — update
         programs differ in collectives, and the deferred mode adds separate
         ``merge`` entries — so the mode is part of every key and engines in
-        different modes sharing one cache never exchange executables."""
+        different modes sharing one cache never exchange executables.
+
+        ``precision`` is the metric's ``sync_precision_tag()`` (ISSUE 10):
+        quantized and exact policies lower different collective bundles over
+        identical state signatures (int8 riders vs f32 psum), so the policy
+        is part of EVERY key — the fingerprint covers it too, but the
+        explicit component keeps the contract visible and un-regressable."""
         import jax
 
         return (
@@ -278,6 +285,7 @@ class AotCache:
             bool(donate),
             str(sync),
             jax.default_backend(),
+            str(precision),
         )
 
     def stats(self) -> Dict[str, Any]:

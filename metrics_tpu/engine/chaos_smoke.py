@@ -109,11 +109,23 @@ def chaos_injectors():
     ``chaos`` (seed 7) drives the single-device sweep over 8 sites,
     ``snapshot_read`` (seed 11) the transient read fault under restore,
     ``merge`` (seed 13) the deferred boundary-merge failure,
-    ``dispatcher_kill`` (seed 17) the fatal worker death, and ``paging``
-    (seed 19) the stream-shard pager's spill/fault-in transients."""
+    ``dispatcher_kill`` (seed 17) the fatal worker death, ``paging``
+    (seed 19) the stream-shard pager's spill/fault-in transients, and
+    ``quant`` (seed 29) the at-rest codec's encode/decode transients
+    (ISSUE 10 — both pure functions of their input, so a retry can never
+    double-apply scales)."""
     from metrics_tpu.engine import FaultInjector, FaultSpec
 
     return {
+        "quant": FaultInjector(
+            seed=29,
+            plan={
+                # first snapshot encode and first restore decode fail
+                # transiently; both re-run from the same host-side input
+                "quant_encode": FaultSpec(schedule=(0,)),
+                "quant_decode": FaultSpec(schedule=(0,)),
+            },
+        ),
         "paging": FaultInjector(
             seed=19,
             plan={
@@ -199,6 +211,24 @@ def deferred_engine_config(injector, trace=None):
     mesh = Mesh(np.asarray(jax.devices()[:1]), ("dp",))
     return EngineConfig(
         buckets=(8, 32), coalesce=1, mesh=mesh, axis="dp", mesh_sync="deferred",
+        fault_injector=injector, trace=trace,
+    )
+
+
+def quant_engine_config(injector, snapshot_dir, trace=None):
+    """The quantized/compressed state-at-rest probe: deferred sync on a
+    1-device mesh with ``compress_payloads`` on, so every snapshot rides the
+    q8 codec (``quant_encode``) and every restore decodes (``quant_decode``).
+    ``coalesce=1`` for span-sequence determinism, like the other phases."""
+    import jax
+    from jax.sharding import Mesh
+
+    from metrics_tpu.engine import EngineConfig
+
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("dp",))
+    return EngineConfig(
+        buckets=(8, 32), coalesce=1, mesh=mesh, axis="dp", mesh_sync="deferred",
+        snapshot_dir=snapshot_dir, compress_payloads=True,
         fault_injector=injector, trace=trace,
     )
 
@@ -370,6 +400,76 @@ def main(out_path: str = "out/chaos_telemetry.json") -> int:
     _check(merge_inj.fired.get("merge", 0) == 1, "merge fault did not fire")
     _check(deferred.stats.retries == 1, "merge fault was not retried")
     fired_sites |= set(merge_inj.fired)
+
+    # ------------------- quantized state-at-rest codec under chaos (ISSUE 10)
+    # The at-rest codec's fault sites are pure-input boundaries: an injected
+    # quant_encode transient on the snapshot path and a quant_decode
+    # transient under restore both retry from the SAME host-side values —
+    # scales are never applied twice. Under the EXACT policy the compressed
+    # snapshot wraps nothing, so the kill/resume replay is BIT-identical to
+    # the fault-free run; a quantized-policy twin (same traffic, no faults)
+    # then lands within the codec's bounded error.
+    quant_inj = injs["quant"]
+    q_dir = tempfile.mkdtemp(prefix="metrics_tpu_quant_")
+    q_cut = 4
+    qeng = StreamingEngine(collection(), quant_engine_config(quant_inj, q_dir, trace=rec))
+    with qeng:
+        for b in clean[:q_cut]:
+            qeng.submit(*b)
+        qeng.snapshot()  # quant_encode fires (occurrence 0) and retries
+    _check(
+        quant_inj.fired.get("quant_encode", 0) == 1,
+        f"quant_encode did not fire: {dict(quant_inj.fired)}",
+    )
+    _check(qeng.stats.retries >= 1, "quant_encode transient was not retried")
+    del qeng
+    qres = StreamingEngine(collection(), quant_engine_config(quant_inj, q_dir, trace=rec))
+    meta_q = qres.restore()  # quant_decode fires (occurrence 0) and retries
+    _check(
+        quant_inj.fired.get("quant_decode", 0) == 1,
+        f"quant_decode did not fire: {dict(quant_inj.fired)}",
+    )
+    _check(
+        str(meta_q.get("codec", "")) != "" and int(meta_q["batches_done"]) == q_cut,
+        f"compressed snapshot meta wrong: codec={meta_q.get('codec')!r} "
+        f"cursor={meta_q.get('batches_done')}",
+    )
+    with qres:
+        for b in clean[q_cut:]:
+            qres.submit(*b)
+        got_q = {k: np.asarray(v) for k, v in qres.result().items()}
+    for k in want:
+        _check(
+            np.array_equal(got_q[k], want[k]),
+            f"exact-policy compressed kill/resume not bit-identical: {k} {got_q[k]} != {want[k]}",
+        )
+    # bounded-error twin: the same cycle with MSE's float accumulator quantized
+    q2_dir = tempfile.mkdtemp(prefix="metrics_tpu_quant8_")
+    qcoll = collection().set_sync_precision("q8_block")
+    q8 = StreamingEngine(qcoll, quant_engine_config(None, q2_dir, trace=rec))
+    with q8:
+        for b in clean[:q_cut]:
+            q8.submit(*b)
+        q8.snapshot()
+    del q8
+    q8b = StreamingEngine(
+        collection().set_sync_precision("q8_block"), quant_engine_config(None, q2_dir, trace=rec)
+    )
+    q8b.restore()
+    with q8b:
+        for b in clean[q_cut:]:
+            q8b.submit(*b)
+        got_q8 = {k: np.asarray(v) for k, v in q8b.result().items()}
+    _check(
+        np.array_equal(got_q8["Accuracy"], want["Accuracy"]),
+        "quantized policy broke a count-backed metric (Accuracy must stay bit-exact)",
+    )
+    _check(
+        bool(np.allclose(got_q8["MeanSquaredError"], want["MeanSquaredError"], rtol=1e-2)),
+        f"quantized kill/resume outside bounds: MSE {got_q8['MeanSquaredError']} "
+        f"vs {want['MeanSquaredError']}",
+    )
+    fired_sites |= set(quant_inj.fired)
 
     # ------------------- stream-sharded paging: spill/fault-in under chaos
     # (ISSUE 9) a resident-capped stream-sharded engine under seeded Zipfian

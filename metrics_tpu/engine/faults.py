@@ -70,6 +70,8 @@ FAULT_SITES = (
     "merge",            # deferred-sync boundary merge
     "page_out",         # stream-paging spill: arena row -> host RAM
     "page_in",          # stream-paging fault-in: host RAM/init -> arena row
+    "quant_encode",     # q8 state-at-rest encode (snapshot payload / spill row)
+    "quant_decode",     # q8 state-at-rest decode (restore / fault-in / read)
     "snapshot_write",   # snapshot save fails before any bytes are durable
     "snapshot_corrupt", # snapshot saved, then payload bytes rot on disk
     "snapshot_read",    # transient restore-time read failure

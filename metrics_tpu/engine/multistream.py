@@ -159,6 +159,7 @@ class MultiStreamEngine(StreamingEngine):
                 )
             self._resident = 0
         super().__init__(metric, config=config, aot_cache=aot_cache)
+        self._row_codec = None
         if self._stream_shard:
             self._pager = StreamPager(self._world, self._resident)
             self._stats.mesh_sync = "stream_shard"
@@ -166,6 +167,15 @@ class MultiStreamEngine(StreamingEngine):
             # fault-in source for never-touched (and reset) streams
             row = self._layout.pack(jax.tree.map(jnp.asarray, self._metric.init_state()))
             self._init_row = {k: np.asarray(v) for k, v in row.items()}
+            # the per-row at-rest codec (ISSUE 10). Built whenever the
+            # metric's policy quantizes ANYTHING — decode capability must
+            # exist even with compress_payloads off, so a compressed
+            # snapshot restores into an uncompressed same-policy engine —
+            # while ENCODING (spill rows, snapshot arenas) is gated on the
+            # config flag.
+            from metrics_tpu.engine.quantize import ArenaRowCodec
+
+            self._row_codec = ArenaRowCodec.for_metric(self._metric)
 
     # -------------------------------------------------------------- capability checks
 
@@ -248,6 +258,20 @@ class MultiStreamEngine(StreamingEngine):
         # plain deferred ones; a distinct tag keeps a shared AotCache honest
         return "stream_shard" if self._stream_shard else super()._sync_tag()
 
+    def _payload_leaf_info(self) -> Optional[Any]:
+        # the unsharded multistream merge syncs the (S, ...)-STACKED state:
+        # every leaf the bundle moves carries a leading stream axis, so the
+        # payload accounting scales by S (same correction the analysis
+        # plane's EngineAnalysis._sync_leaf_info applies). Stream-sharded
+        # engines route host-side and never record a sync payload.
+        info = super()._payload_leaf_info()
+        if info is None or self._stream_shard:
+            return info
+        return [
+            (fx, jax.ShapeDtypeStruct((self._num_streams,) + tuple(leaf.shape), leaf.dtype), prec)
+            for fx, leaf, prec in info
+        ]
+
     def _traced_update(self, state_tree: Any, payload: Any, mask: Any) -> Any:
         a, kw = payload
         ids, rest = a[0], a[1:]
@@ -280,6 +304,7 @@ class MultiStreamEngine(StreamingEngine):
             f"compute_mstream+k.{self._kernel_tag()}", self._metric_fp,
             arg_tree=(self._compute_input_abstract(), sid_abs),
             mesh=self._cfg.mesh, donate=False, sync=self._sync_tag(),
+            precision=self._precision_tag,
         )
         metric = self._metric
 
@@ -304,6 +329,7 @@ class MultiStreamEngine(StreamingEngine):
         key = self._aot.program_key(
             f"compute_sstream+k.{self._kernel_tag()}", self._metric_fp,
             arg_tree=row_abs, mesh=None, donate=False, sync=self._sync_tag(),
+            precision=self._precision_tag,
         )
         metric, layout = self._metric, self._layout
 
@@ -329,6 +355,7 @@ class MultiStreamEngine(StreamingEngine):
             f"compute_mstream_all+k.{self._kernel_tag()}", self._metric_fp,
             arg_tree=self._compute_input_abstract(),
             mesh=self._cfg.mesh, donate=False, sync=self._sync_tag(),
+            precision=self._precision_tag,
         )
 
         def build():
@@ -350,6 +377,7 @@ class MultiStreamEngine(StreamingEngine):
         key = self._aot.program_key(
             f"compute_sstream_all+k.{self._kernel_tag()}", self._metric_fp,
             arg_tree=stacked_abs, mesh=None, donate=False, sync=self._sync_tag(),
+            precision=self._precision_tag,
         )
 
         def build():
@@ -408,6 +436,7 @@ class MultiStreamEngine(StreamingEngine):
         if self._pager is not None:
             self._stats.resident_streams = self._pager.resident_count()
             self._stats.spilled_streams = self._pager.spilled_count()
+            self._stats.spilled_bytes = self._pager.spill_nbytes()
 
     def _execute_payload(
         self, merged: Tuple[Tuple[Any, ...], Dict[str, Any]], n: int,
@@ -591,6 +620,14 @@ class MultiStreamEngine(StreamingEngine):
                 rows = {
                     k: np.asarray(jax.device_get(v[ws, js])) for k, v in self._state.items()
                 }
+                if self._compress and self._row_codec is not None:
+                    # quantize the spilled rows BEFORE they land in host RAM
+                    # (the pager's spill store then holds the compressed
+                    # form — the whole point of compress_payloads). Encode is
+                    # pure in `rows`, so a retry re-encodes from the same
+                    # fetched values — scales are never applied twice.
+                    self._fault("quant_encode")
+                    rows = self._row_codec.encode_buffers(rows)
                 return rows, t0
 
             rows, t0 = self._retry_transient(spill_once)
@@ -609,12 +646,13 @@ class MultiStreamEngine(StreamingEngine):
             def load_once() -> Tuple[Dict[str, Any], float]:
                 self._fault("page_in")
                 t0 = time.perf_counter()
+                src_rows = [
+                    self._decoded_spill_row(op.shard, op.stream) or self._init_row
+                    for op in loads
+                ]
                 new_state = {}
                 for k, buf in self._state.items():
-                    rows_np = np.stack([
-                        (self._pager.spilled_row(op.shard, op.stream) or self._init_row)[k]
-                        for op in loads
-                    ]).astype(buf.dtype)
+                    rows_np = np.stack([r[k] for r in src_rows]).astype(buf.dtype)
                     # one batched scatter per dtype; re-pin the shard sharding
                     # so the eager .at update cannot drift the placement
                     new_buf = buf.at[ws, js].set(jnp.asarray(rows_np))
@@ -635,17 +673,109 @@ class MultiStreamEngine(StreamingEngine):
 
     # --------------------------------------------------------------------- readers
 
+    def _decoded_spill_row(self, shard: int, stream: int) -> Optional[Dict[str, np.ndarray]]:
+        """One stream's spilled row from host RAM, decoded when the spill
+        store holds the compressed form (the at-rest codec: ISSUE 10). The
+        decode is pure in the stored row, so a ``quant_decode`` transient
+        retries without side effects."""
+        row = self._pager.spilled_row(shard, stream)
+        if row is None:
+            return None
+        if self._row_codec is not None and self._row_codec.is_encoded(row):
+
+            def decode_once() -> Dict[str, np.ndarray]:
+                self._fault("quant_decode")
+                return self._row_codec.decode_buffers(row)
+
+            row = self._retry_transient(decode_once)
+        return row
+
+    def _decoded_pager_payload(
+        self, payload: Dict[str, Any], codec: Optional[Any] = None
+    ) -> Dict[str, Any]:
+        """A pager snapshot payload with its spill matrices decoded (the
+        slot table and coordinates pass through) — what the host-side row
+        reassembly consumes when spills were stored compressed. ``codec``
+        overrides the engine's own row codec (the cross-topology restore
+        builds one ad hoc on an unsharded target)."""
+        codec = codec if codec is not None else self._row_codec
+        if codec is None:
+            return payload
+        spill = {
+            k[len("spill_"):]: payload[k]
+            for k in payload
+            if k.startswith("spill_") and k != "spill_coords"
+        }
+        if not spill or not codec.is_encoded(spill):
+            return payload
+
+        def decode_once() -> Dict[str, np.ndarray]:
+            self._fault("quant_decode")
+            return codec.decode_buffers(spill)
+
+        decoded = self._retry_transient(decode_once)
+        out = {
+            k: v
+            for k, v in payload.items()
+            if not (k.startswith("spill_") and k != "spill_coords")
+        }
+        for k, v in decoded.items():
+            out[f"spill_{k}"] = v
+        return out
+
+    def _normalized_pager_payload(
+        self, payload: Dict[str, Any], snap_codec: Optional[Any]
+    ) -> Dict[str, Any]:
+        """A restored pager payload re-expressed in THIS engine's spill-store
+        form. The snapshot's compression state may legitimately differ from
+        ``compress_payloads`` (a compressed snapshot restores into an
+        uncompressed same-policy engine, and vice versa) — but a MIXED spill
+        store, restored rows in one form and later evictions in the other,
+        would break the per-key stacking ``snapshot_payload`` relies on. So
+        restore converts once, here."""
+        spill = {
+            k[len("spill_"):]: v
+            for k, v in payload.items()
+            if k.startswith("spill_") and k != "spill_coords"
+        }
+        if not spill:
+            return payload
+        is_encoded = snap_codec is not None and snap_codec.is_encoded(spill)
+        want_encoded = self._compress and self._row_codec is not None
+        if is_encoded == want_encoded:
+            return payload
+        if is_encoded:  # compressed snapshot -> verbatim-storing engine
+            return self._decoded_pager_payload(payload, codec=snap_codec)
+
+        # verbatim snapshot -> compressing engine: encode the spill matrices
+        def encode_once() -> Dict[str, np.ndarray]:
+            self._fault("quant_encode")
+            return self._row_codec.encode_buffers(
+                {k: np.asarray(v) for k, v in spill.items()}
+            )
+
+        encoded = self._retry_transient(encode_once)
+        out = {
+            k: v
+            for k, v in payload.items()
+            if not (k.startswith("spill_") and k != "spill_coords")
+        }
+        for k, v in encoded.items():
+            out[f"spill_{k}"] = v
+        return out
+
     def _fetch_row(self, sid: int) -> Dict[str, np.ndarray]:
         """ONE stream's packed arena row (per-dtype host vectors): from its
         home shard's slot when resident (only that row crosses to host),
         read-through from the host spill store when paged out (no eviction —
-        residency changes only on the submit path), or the init row for a
+        residency changes only on the submit path; the row decodes through
+        the at-rest codec when spills are compressed), or the init row for a
         never-touched stream. Caller holds the state lock."""
         w, loc = self._home(sid)
         slot = self._pager.slot_of(w, loc)
         if slot is not None:
             return {k: np.asarray(jax.device_get(v[w, slot])) for k, v in self._state.items()}
-        spilled = self._pager.spilled_row(w, loc)
+        spilled = self._decoded_spill_row(w, loc)
         if spilled is not None:
             return spilled
         return self._init_row
@@ -658,8 +788,8 @@ class MultiStreamEngine(StreamingEngine):
         state lock."""
         arena = {k: np.asarray(jax.device_get(v)) for k, v in self._state.items()}
         return self._rows_from_parts(
-            arena, self._pager.snapshot_payload(), self._init_row,
-            self._num_streams, self._world,
+            arena, self._decoded_pager_payload(self._pager.snapshot_payload()),
+            self._init_row, self._num_streams, self._world,
         )
 
     @staticmethod
@@ -829,9 +959,22 @@ class MultiStreamEngine(StreamingEngine):
         if not self._stream_shard:
             return super()._snapshot_state()
         # the paged-arena payload: resident buffers AND the pager's spilled
-        # rows + slot tables — kill/resume must cover rows living in host RAM
+        # rows + slot tables — kill/resume must cover rows living in host RAM.
+        # Under compress_payloads the arena buffers encode through the row
+        # codec (the spill rows in the pager payload are ALREADY compressed —
+        # they were encoded on their way to host RAM), so bytes-on-disk track
+        # the quantized footprint.
+        arena: Any = {k: np.asarray(jax.device_get(v)) for k, v in self._state.items()}
+        if self._compress and self._row_codec is not None:
+            host = arena
+
+            def encode_once() -> Dict[str, np.ndarray]:
+                self._fault("quant_encode")
+                return self._row_codec.encode_buffers(host)
+
+            arena = self._retry_transient(encode_once)
         return {
-            "arena": jax.device_get(self._state),
+            "arena": arena,
             "pager": self._pager.snapshot_payload(),
         }
 
@@ -880,6 +1023,32 @@ class MultiStreamEngine(StreamingEngine):
         pager_payload = state.get("pager") if isinstance(state, dict) else None
         if arena is None or pager_payload is None:
             raise MetricsTPUUserError("stream-shard snapshot payload is missing arena/pager parts")
+        # compressed (codec-bearing) snapshots: the buffer-form codec is NOT
+        # self-describing (element positions come from layout + policy), so
+        # the policy fingerprint in meta must match this engine's — decoding
+        # with a different plan would silently unscramble rows
+        snap_codec = None
+        if str(meta.get("codec", "") or ""):
+            if str(meta.get("codec_fp", "") or "") != self._precision_tag:
+                raise MetricsTPUUserError(
+                    "compressed stream-shard snapshot was written under sync_precision "
+                    f"policy {meta.get('codec_fp')!r}, this engine's metric declares "
+                    f"{self._precision_tag!r}; restore it with the matching policy"
+                )
+            snap_codec = self._row_codec
+            if snap_codec is None:
+                from metrics_tpu.engine.quantize import ArenaRowCodec as _ARC
+
+                snap_codec = _ARC.for_metric(self._metric)
+            if snap_codec is not None and snap_codec.is_encoded(arena):
+
+                def decode_once() -> Dict[str, np.ndarray]:
+                    self._fault("quant_decode")
+                    return snap_codec.decode_buffers(
+                        {k: np.asarray(v) for k, v in arena.items()}
+                    )
+
+                arena = self._retry_transient(decode_once)
         row_layout = ArenaLayout.for_state(self._metric.abstract_state())
         sizes = row_layout.buffer_sizes()
         if set(arena) != set(sizes) or any(
@@ -900,7 +1069,9 @@ class MultiStreamEngine(StreamingEngine):
             new_state = self._put_state(arena, packed=True, stacked=True)
             with self._state_lock:
                 self._finish_restore(new_state, meta)
-                self._pager.load_payload(pager_payload)
+                self._pager.load_payload(
+                    self._normalized_pager_payload(pager_payload, snap_codec)
+                )
                 self._refresh_gauges()
             return
         if self._cfg.mesh is not None:
@@ -916,7 +1087,8 @@ class MultiStreamEngine(StreamingEngine):
             ).items()
         }
         stacked = self._rows_from_parts(
-            arena, pager_payload, init_row, self._num_streams, world_snap
+            arena, self._decoded_pager_payload(pager_payload, codec=snap_codec),
+            init_row, self._num_streams, world_snap,
         )
         tree = row_layout.unpack_stacked({k: jnp.asarray(v) for k, v in stacked.items()})
         self._finish_restore(self._put_state(tree), meta)

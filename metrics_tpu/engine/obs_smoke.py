@@ -71,6 +71,7 @@ def main(
         deferred_engine_config,
         kill_engine_config,
         make_checker,
+        quant_engine_config,
         resume_engine_config,
         stream_shard_engine_config,
         stream_shard_traffic,
@@ -186,9 +187,22 @@ def main(
             for sid, p, t in stream_shard_traffic():
                 paged.submit(sid, p, t)
             paged.results()
+        # quantized at-rest codec transients (ISSUE 10): one compressed
+        # snapshot (quant_encode retries) + one restore (quant_decode
+        # retries) — fixed call counts, so the occurrence indices and the
+        # resulting span sequence are producer-timing-independent
+        quant_inj = injs["quant"]
+        q_dir = tempfile.mkdtemp(prefix="metrics_tpu_obs_quant_")
+        qeng = StreamingEngine(collection(), quant_engine_config(quant_inj, q_dir, trace=rec))
+        with qeng:
+            for b in clean[:4]:
+                qeng.submit(*b)
+            qeng.snapshot()
+        qres = StreamingEngine(collection(), quant_engine_config(quant_inj, q_dir, trace=rec))
+        qres.restore()
         sites = (
             set(inj.fired) | set(read_inj.fired) | set(merge_inj.fired)
-            | set(page_inj.fired)
+            | set(page_inj.fired) | set(quant_inj.fired)
         )
         return rec, got, sites
 

@@ -72,6 +72,11 @@ class StreamPager:
         self._spill: List[Dict[int, Dict[str, np.ndarray]]] = [
             {} for _ in range(self.world)
         ]
+        # running byte total of the spill store, maintained incrementally at
+        # the points rows enter/leave (commit/drop/reset/load_payload) — a
+        # recount per gauge refresh would be O(spilled x dtypes) Python work
+        # on every paging round, worst exactly when paging pressure is highest
+        self._spill_bytes = 0
 
     # ------------------------------------------------------------------ queries
 
@@ -86,6 +91,18 @@ class StreamPager:
 
     def spilled_count(self) -> int:
         return sum(len(s) for s in self._spill)
+
+    def spill_nbytes(self) -> int:
+        """Host-RAM bytes the spill store currently holds — the observable
+        ``compress_payloads`` shrinks (rows arrive here already encoded by
+        the engine's at-rest codec; the pager stores whatever per-dtype
+        vectors it is handed, compressed or verbatim). O(1): maintained
+        incrementally where rows enter and leave the store."""
+        return self._spill_bytes
+
+    @staticmethod
+    def _row_nbytes(row: Optional[Dict[str, np.ndarray]]) -> int:
+        return sum(int(v.nbytes) for v in row.values()) if row else 0
 
     def resident_streams(self, shard: int) -> Tuple[int, ...]:
         return tuple(self._lru[shard])
@@ -137,11 +154,17 @@ class StreamPager:
             lru = self._lru[op.shard]
             slots = self._slots[op.shard]
             if op.kind == "evict":
-                self._spill[op.shard][op.stream] = spilled_rows[(op.shard, op.stream)]
+                row = spilled_rows[(op.shard, op.stream)]
+                self._spill_bytes += self._row_nbytes(row) - self._row_nbytes(
+                    self._spill[op.shard].get(op.stream)
+                )
+                self._spill[op.shard][op.stream] = row
                 lru.pop(op.stream, None)
                 slots[op.slot] = None
             else:
-                self._spill[op.shard].pop(op.stream, None)
+                self._spill_bytes -= self._row_nbytes(
+                    self._spill[op.shard].pop(op.stream, None)
+                )
                 slots[op.slot] = op.stream
                 lru[op.stream] = op.slot
 
@@ -157,7 +180,7 @@ class StreamPager:
         """Forget a stream entirely (``reset_stream``): its spill entry is
         discarded and its slot freed — the next access faults in the metric's
         init row. Returns the freed slot (None when it was not resident)."""
-        self._spill[shard].pop(stream, None)
+        self._spill_bytes -= self._row_nbytes(self._spill[shard].pop(stream, None))
         slot = self._lru[shard].pop(stream, None)
         if slot is not None:
             self._slots[shard][slot] = None
@@ -168,6 +191,7 @@ class StreamPager:
             self._slots[shard] = [None] * self.resident
             self._lru[shard].clear()
             self._spill[shard].clear()
+        self._spill_bytes = 0
 
     # ----------------------------------------------------- snapshot round-trip
 
@@ -216,6 +240,6 @@ class StreamPager:
         coords = np.asarray(payload.get("spill_coords", np.zeros((0, 2), np.int64))).reshape(-1, 2)
         spill_keys = [k[len("spill_"):] for k in payload if k.startswith("spill_") and k != "spill_coords"]
         for i, (w, s) in enumerate(coords):
-            self._spill[int(w)][int(s)] = {
-                key: np.asarray(payload[f"spill_{key}"][i]) for key in spill_keys
-            }
+            row = {key: np.asarray(payload[f"spill_{key}"][i]) for key in spill_keys}
+            self._spill[int(w)][int(s)] = row
+            self._spill_bytes += self._row_nbytes(row)

@@ -239,6 +239,16 @@ class EngineConfig:
             ``metrics_text()`` expose the Perfetto and OpenMetrics views.
             None (default) costs one ``is not None`` check per site —
             nothing else (the ``obs_overhead`` bench guards this).
+        compress_payloads: store state-at-rest through the block-scaled int8
+            codec (``engine/quantize.py``): snapshot payloads carry codes +
+            scales (codec id in meta, the sha256 sidecar hashes the
+            COMPRESSED bytes) and stream-pager spill rows live in host RAM
+            quantized. Only states the metric's ``sync_precision`` policy
+            declared ``"q8_block"`` compress — counts and cat buffers stay
+            verbatim, so their kill/resume replay remains bit-exact; the
+            quantized states restore within the codec's declared per-element
+            bound (the same ``q8_sum_error_bound`` oracle as the wire
+            rider). Default off: snapshots stay byte-identical to r10.
     """
 
     buckets: Tuple[int, ...] = (256, 1024)
@@ -268,6 +278,7 @@ class EngineConfig:
     transactional: Optional[bool] = None
     degrade_kernel: bool = True
     trace: Optional[TraceRecorder] = None
+    compress_payloads: bool = False
 
 
 class StreamingEngine:
@@ -338,6 +349,13 @@ class StreamingEngine:
         self._metric_fp = metric_fingerprint(metric)
         if self._cfg.snapshot_every > 0 and not self._cfg.snapshot_dir:
             raise MetricsTPUUserError("snapshot_every > 0 requires snapshot_dir")
+        # the quantized-sync policy tag (metric.py::sync_precision_tag) —
+        # pinned at construction and folded into EVERY program key: set the
+        # policy BEFORE building the engine (like the kernel backend, a
+        # post-hoc change would hand stale executables the wrong bundle)
+        self._precision_tag = getattr(metric, "sync_precision_tag", lambda: "exact")()
+        self._compress = bool(self._cfg.compress_payloads)
+        self._payload_split: Optional[Tuple[int, int]] = None
         self._queue: "queue.Queue" = queue.Queue(maxsize=max(1, self._cfg.max_queue))
         self._program_memo: Dict[Tuple, Any] = {}
         # guards every read-modify-write of self._state against the
@@ -609,6 +627,7 @@ class StreamingEngine:
             f"{self._update_kind()}+k.{self._kernel_tag()}", self._metric_fp,
             arg_tree=(self._abstract_state(), payload_abs, mask_abs),
             mesh=self._cfg.mesh, donate=self._donate, sync=self._sync_tag(),
+            precision=self._precision_tag,
         )
         # attribution BEFORE the lookup: whether THIS call compiles. (The
         # benign race — another engine inserting the identical key in the
@@ -743,6 +762,7 @@ class StreamingEngine:
             f"compute+k.{self._kernel_tag()}", self._metric_fp,
             arg_tree=self._compute_input_abstract(),
             mesh=self._cfg.mesh, donate=False, sync=self._sync_tag(),
+            precision=self._precision_tag,
         )
         metric = self._metric
 
@@ -765,20 +785,55 @@ class StreamingEngine:
             f"merge+k.{self._kernel_tag()}", self._metric_fp,
             arg_tree=self._abstract_state(),
             mesh=self._cfg.mesh, donate=False, sync=self._sync_tag(),
+            precision=self._precision_tag,
         )
 
         def build():
-            from metrics_tpu.parallel.embedded import sharded_state_merge
-
-            merge = sharded_state_merge(
-                self._metric, self._cfg.mesh, self._cfg.axis,
-                state_template=self._abstract_state(),
-                unpack=self._unpack if self._layout is not None else None,
-            )
+            merge = self._merge_callable()
             with self._kernel_scope():
                 return jax.jit(merge).lower(self._abstract_state()).compile()
 
         return self._aot.get_or_compile(key, build)
+
+    def _merge_callable(self):
+        """The deferred boundary merge body (un-jitted) — shared by the
+        program build and the program-plane analyzer, which re-traces it to
+        audit the quantized-sync policy against the actual bundle."""
+        from metrics_tpu.parallel.embedded import sharded_state_merge
+
+        return sharded_state_merge(
+            self._metric, self._cfg.mesh, self._cfg.axis,
+            state_template=self._abstract_state(),
+            unpack=self._unpack if self._layout is not None else None,
+        )
+
+    def _payload_leaf_info(self) -> Optional[Any]:
+        """The ``(fx, leaf, precision)`` triples one fused sync of THIS
+        engine's carried state moves (subclasses rescale: the unsharded
+        multistream engine syncs the (S, ...)-stacked state)."""
+        info_fn = getattr(self._metric, "sync_leaf_info", None)
+        return info_fn() if info_fn is not None else None
+
+    def _sync_payload_split(self) -> Tuple[int, int]:
+        """(exact_bytes, quantized_bytes) one fused sync moves per shard
+        under the configured policy — the analytic accounting from
+        ``parallel/collectives.py::fused_sync_plan``, memoized (the state
+        signature is static per engine). Feeds the OpenMetrics
+        ``sync_payload_bytes{kind=...}`` counters."""
+        if self._payload_split is None:
+            info = self._payload_leaf_info()
+            if not info:
+                self._payload_split = (0, 0)
+            else:
+                from metrics_tpu.parallel.collectives import (
+                    fused_sync_plan,
+                    sync_payload_bytes,
+                )
+
+                total = sync_payload_bytes(info, self._world)
+                quant = 4 * fused_sync_plan(info, self._world)["q8_words"]
+                self._payload_split = (total - quant, quant)
+        return self._payload_split
 
     def _merged_state(self) -> Any:
         """Run the boundary merge on the carried shard-local state (deferred
@@ -815,6 +870,7 @@ class StreamingEngine:
             raise err from e
         merge_us = (time.perf_counter() - t0) * 1e6
         self._stats.record_merge(merge_us)
+        self._stats.record_sync_payload(*self._sync_payload_split())
         if self._trace is not None:
             self._trace.complete("merge", trace=ENGINE_TRACE, dur_us=merge_us)
             self._trace.observe("merge_latency_us", merge_us)
@@ -1073,11 +1129,20 @@ class StreamingEngine:
         aot = self._aot.stats()
         counters["compile_cache_hits"] = aot["hits"]
         counters["compile_cache_misses"] = aot["misses"]
-        labeled = (
-            {"faults_injected": ("site", dict(s.faults_injected))}
-            if s.faults_injected
-            else None
-        )
+        labeled: Dict[str, Any] = {}
+        if s.faults_injected:
+            labeled["faults_injected"] = ("site", dict(s.faults_injected))
+        if s.sync_payload_exact_bytes or s.sync_payload_quant_bytes:
+            # mesh engines only (non-mesh engines never record a payload):
+            # bytes one shard contributed per fused sync, split by rider —
+            # the quantized-vs-exact bandwidth observable (ISSUE 10)
+            labeled["sync_payload_bytes"] = (
+                "kind",
+                {
+                    "exact": s.sync_payload_exact_bytes,
+                    "quantized": s.sync_payload_quant_bytes,
+                },
+            )
         gauges = {"compiled_programs": aot["programs"]}
         if s.paging_summary() is not None:
             # stream-sharded serving: routing + LRU-paging telemetry joins the
@@ -1092,8 +1157,11 @@ class StreamingEngine:
             )
             gauges["resident_streams"] = s.resident_streams
             gauges["spilled_streams"] = s.spilled_streams
+            gauges["spilled_bytes"] = s.spilled_bytes
         hists = self._trace.histograms() if self._trace is not None else ()
-        return render_openmetrics(counters, hists, labeled_counters=labeled, gauges=gauges)
+        return render_openmetrics(
+            counters, hists, labeled_counters=labeled or None, gauges=gauges
+        )
 
     def reset(self) -> None:
         """Fresh accumulation; compiled programs are kept.
@@ -1154,11 +1222,18 @@ class StreamingEngine:
             "batches_done": self._batches_done,
             "rows_in": self._stats.rows_in,
             "rows_padded": self._stats.rows_padded,
-            "packed": int(self._layout is not None),
+            # a compressed snapshot stores the LOGICAL (possibly shard-
+            # stacked) tree with codec-wrapped leaves, never the raw arena
+            "packed": int(self._layout is not None and not self._compress),
             "arena_fp": self._layout.fingerprint() if self._layout is not None else "",
             "mesh_sync": self._sync_tag() if self._cfg.mesh is not None else "single",
             "world": self._world if self._deferred else 1,
         }
+        if self._compress:
+            from metrics_tpu.engine.quantize import CODEC_ID
+
+            meta["codec"] = CODEC_ID
+            meta["codec_fp"] = self._precision_tag
         meta.update(self._snapshot_meta_extra())
         path = save_snapshot(
             self._cfg.snapshot_dir,
@@ -1186,8 +1261,33 @@ class StreamingEngine:
         carried form itself (packed arena / shard-stacked buffers). The
         stream-sharded engine overrides this to bundle its resident arena
         WITH the pager's spilled rows and slot tables (paged rows must be
-        covered by kill/resume)."""
-        return jax.device_get(self._state)
+        covered by kill/resume).
+
+        With ``config.compress_payloads`` the payload is instead the LOGICAL
+        (shard-stacked under deferred sync) tree with the metric's quantized-
+        policy leaves codec-wrapped (``engine/quantize.py``) — snapshot disk
+        scales with the quantized footprint. The encode is a pure function of
+        the fetched host tree, so an injected ``quant_encode`` transient
+        retries without double-applying scales."""
+        if not self._compress:
+            return jax.device_get(self._state)
+        from metrics_tpu.engine.quantize import encode_state_tree
+
+        if self._deferred:
+            tree = (
+                self._layout.unpack_stacked(self._state)
+                if self._layout is not None
+                else self._state
+            )
+        else:
+            tree = self._unpack(self._state)
+        host = jax.device_get(tree)
+
+        def encode_once() -> Any:
+            self._fault("quant_encode")
+            return encode_state_tree(self._metric, host)
+
+        return self._retry_transient(encode_once)
 
     def _snapshot_meta_extra(self) -> Dict[str, Any]:
         """Extra provenance meta a subclass folds into every snapshot (the
@@ -1238,6 +1338,18 @@ class StreamingEngine:
         commit it (the restore matrix). Subclasses reroute snapshots carrying
         other topologies (the stream-sharded engine's restore matrix) before
         falling back here."""
+        # codec-wrapped (compressed) payloads decode FIRST — the wrapped
+        # leaves are self-describing, so every path of the restore matrix
+        # (same-world verbatim, host merge, shard-0 embed) sees plain arrays.
+        # Decode is pure in its input: a quant_decode transient retries clean.
+        if str(meta.get("codec", "") or ""):
+            from metrics_tpu.engine.quantize import decode_state_tree
+
+            def decode_once() -> Any:
+                self._fault("quant_decode")
+                return decode_state_tree(state)
+
+            state = self._retry_transient(decode_once)
         # VALIDATE before mutating anything: a failed restore must leave the
         # live engine (metric attrs, fingerprint, memo, state) untouched
         packed = bool(int(meta.get("packed", 0)))
@@ -2003,6 +2115,10 @@ class StreamingEngine:
             wall_us=wall_us,
             coalesced=n_coalesced,
         )
+        if self._cfg.mesh is not None and not self._deferred:
+            # step-sync pays the fused bundle INSIDE every step — count the
+            # payload per step (deferred counts per boundary merge instead)
+            self._stats.record_sync_payload(*self._sync_payload_split())
 
     def _recover_step(self, e: BaseException, shadow: Optional[Any], attempt: int) -> bool:
         """Classify a step failure and perform its recovery action. True =

@@ -1,0 +1,237 @@
+"""Quantized-sync smoke check: ``python -m metrics_tpu.engine.quant_smoke``.
+
+The CPU-safe gate for the ISSUE 10 quantized-sync stack (``make quant-smoke``),
+on the bootstrap 8-device virtual mesh:
+
+1. bounded error — a float-heavy collection under ``sync_precision=
+   "q8_block"`` streamed through a DEFERRED mesh engine lands within the
+   per-metric bounded-error oracle (``Metric.sync_error_bounds`` over the
+   actual shard-local states) of the exact-policy engine on the same
+   traffic; integer count states are BIT-exact;
+2. payload — the analytic per-sync payload (``sync_payload_bytes``) drops
+   >= 3x for the quantized policy, and the engine's OpenMetrics
+   ``sync_payload_bytes{kind=...}`` counters expose the split through the
+   strict parser;
+3. program identity — exact and quantized engines SHARE one ``AotCache``
+   and never exchange executables (``sync_precision`` is in every program
+   key): the second engine compiles its own full program set, and a repeat
+   stream after ``reset()`` compiles NOTHING (zero steady compiles);
+4. policy audit — the ``quantized-sync-policy-honored`` rule over the built
+   engines' step/merge programs reports no findings;
+5. kill/resume — the quantized engine snapshots COMPRESSED
+   (``compress_payloads``: codec id in meta, sha256 sidecar over the
+   compressed bytes), a fresh engine restores through it and replays the
+   remainder: counts bit-exact, floats within the oracle bound.
+
+Prints one PASS line; exits nonzero on any violated claim.
+"""
+import os
+import subprocess
+import sys
+
+NUM_DEVICES = 8
+
+
+def _bootstrap() -> int:
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + f" --xla_force_host_platform_device_count={NUM_DEVICES}"
+    )
+    env["JAX_PLATFORMS"] = "cpu"
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu'); "
+        "import sys; from metrics_tpu.engine.quant_smoke import _impl; sys.exit(_impl())"
+    )
+    proc = subprocess.run([sys.executable, "-c", code], env=env, timeout=900)
+    return proc.returncode
+
+
+def _impl() -> int:
+    import tempfile
+
+    import numpy as np
+
+    import jax
+    from jax.sharding import Mesh
+
+    from metrics_tpu import Accuracy, BinnedAveragePrecision, MetricCollection
+    from metrics_tpu.engine import AotCache, EngineConfig, StreamingEngine
+    from metrics_tpu.parallel.collectives import sync_payload_bytes
+
+    devs = jax.devices()
+    if len(devs) < NUM_DEVICES:
+        print(f"FAIL: need {NUM_DEVICES} devices, have {len(devs)}")
+        return 1
+    mesh = Mesh(np.asarray(devs[:NUM_DEVICES]), ("dp",))
+    buckets = (32,)
+    ok = True
+
+    def col(prec=None):
+        # float-heavy: BinnedAveragePrecision's (C, T) f32 sum accumulators
+        # dominate the payload; Accuracy's int32 counts pin the exact path
+        c = MetricCollection(
+            {"acc": Accuracy(), "bap": BinnedAveragePrecision(num_classes=8, thresholds=101)}
+        )
+        if prec:
+            c.set_sync_precision(prec)
+        return c
+
+    rng = np.random.RandomState(0)
+    batches = []
+    for n in (13, 32, 7, 29, 18, 32):
+        p = rng.rand(n, 8).astype(np.float32)
+        p /= p.sum(axis=1, keepdims=True)
+        batches.append((p, rng.randint(0, 8, n)))
+
+    # ---- payload accounting: >= 3x for the quantized policy
+    info_q = col("q8_block").sync_leaf_info()
+    info_e = [(fx, leaf, "exact") for fx, leaf, _ in info_q]
+    bytes_q = sync_payload_bytes(info_q, NUM_DEVICES)
+    bytes_e = sync_payload_bytes(info_e, NUM_DEVICES)
+    ratio = bytes_e / max(1, bytes_q)
+    if ratio < 3.0:
+        print(f"FAIL: sync payload ratio {ratio:.2f}x < 3x ({bytes_e} -> {bytes_q} bytes)")
+        ok = False
+
+    cache = AotCache()  # SHARED: policy must keep the engines apart
+    snapdir = tempfile.mkdtemp(prefix="quant_smoke_")
+
+    def run(engine):
+        nonlocal ok
+        with engine:
+            for b in batches:
+                engine.submit(*b)
+            got = {k: np.asarray(v) for k, v in engine.result().items()}
+            state = engine.state()
+            warm = engine.aot_cache.misses
+            engine.reset()
+            for b in batches:
+                engine.submit(*b)
+            engine.result()
+            steady = engine.aot_cache.misses - warm
+        if steady != 0:
+            print(f"FAIL: repeat stream compiled {steady} programs (expected 0)")
+            ok = False
+        return got, state
+
+    exact_eng = StreamingEngine(
+        col(), EngineConfig(buckets=buckets, mesh=mesh, axis="dp", mesh_sync="deferred"),
+        aot_cache=cache,
+    )
+    want, want_state = run(exact_eng)
+
+    quant_cfg = EngineConfig(
+        buckets=buckets, mesh=mesh, axis="dp", mesh_sync="deferred",
+        snapshot_every=3, snapshot_dir=snapdir, compress_payloads=True,
+    )
+    before_quant = cache.misses
+    q_coll = col("q8_block")
+    q_eng = StreamingEngine(q_coll, quant_cfg, aot_cache=cache)
+    got, got_state = run(q_eng)
+    q_compiles = cache.misses - before_quant
+    if q_compiles < len(buckets) + 2:  # update/bucket + merge + compute, own set
+        print(
+            f"FAIL: quantized engine compiled only {q_compiles} programs over the "
+            "shared cache — an exact-policy executable leaked across policies"
+        )
+        ok = False
+
+    # ---- bounded-error oracle over the ACTUAL shard-local states
+    # (exact engine's locals: quantization error <= bound of either run's
+    # locals; use the exact engine's as the reference magnitude source)
+    def locals_of(metric, batches, world):
+        shards = [metric.init_state() for _ in range(world)]
+        order = []  # round-robin rows over shards like the padded P("dp") split
+        for p, t in batches:
+            n = p.shape[0]
+            per = -(-n // world)
+            for w in range(world):
+                rows = slice(w * per, min(n, (w + 1) * per))
+                if rows.start < n:
+                    shards[w] = metric.update_state(shards[w], p[rows], t[rows])
+        return shards
+
+    # the oracle does not need the engine's exact shard split — the bound is
+    # monotone in per-block magnitude, so locals from ANY split of the same
+    # traffic bound the error direction we assert (plus f32-sum slack below)
+    shards = locals_of(col(), batches, NUM_DEVICES)
+    stacked = jax.tree.map(lambda *xs: np.stack([np.asarray(x) for x in xs]), *shards)
+    bounds = q_coll.sync_error_bounds(stacked)
+    for name in ("acc",):
+        for k in ("correct", "total"):
+            if not np.array_equal(np.asarray(got_state[name][k]), np.asarray(want_state[name][k])):
+                print(f"FAIL: count state {name}.{k} not bit-exact under quantized sync")
+                ok = False
+    for k in ("TPs", "FPs", "FNs"):
+        err = np.abs(np.asarray(got_state["bap"][k]) - np.asarray(want_state["bap"][k]))
+        bound = bounds[f"bap.{k}"] + 1e-4 * np.abs(np.asarray(want_state["bap"][k])) + 1e-6
+        if not bool((err <= 2.0 * bound).all()):  # 2x: engine split != oracle split
+            print(
+                f"FAIL: bap.{k} exceeds the bounded-error oracle: "
+                f"max err {float(err.max()):.5f} vs bound {float(bound.max()):.5f}"
+            )
+            ok = False
+
+    # ---- policy audit (the named rule, same code path as make analyze)
+    from metrics_tpu.analysis import EngineAnalysis
+
+    for tag, eng in (("exact", exact_eng), ("quantized", q_eng)):
+        findings = EngineAnalysis().check(eng, label=f"quant-smoke/{tag}").findings
+        if findings:
+            for f in findings:
+                print(f"FAIL: {f.render()}")
+            ok = False
+
+    # ---- OpenMetrics payload counters through the strict parser
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+    from tools.trace_export import parse_openmetrics
+
+    fams = parse_openmetrics(q_eng.metrics_text())
+    payload_fam = fams.get("metrics_tpu_engine_sync_payload_bytes")
+    kinds = (
+        {s["labels"].get("kind") for s in payload_fam["samples"]} if payload_fam else set()
+    )
+    if kinds != {"exact", "quantized"}:
+        print(f"FAIL: sync_payload_bytes counters missing/wrong kinds: {kinds}")
+        ok = False
+
+    # ---- kill/resume through the COMPRESSED snapshot
+    fresh = StreamingEngine(col("q8_block"), quant_cfg, aot_cache=cache)
+    meta = fresh.restore(snapdir)
+    if str(meta.get("codec", "")) == "":
+        print("FAIL: snapshot meta carries no codec id despite compress_payloads")
+        ok = False
+    with fresh:
+        for b in batches[int(meta["batches_done"]):]:
+            fresh.submit(*b)
+        resumed = {k: np.asarray(v) for k, v in fresh.result().items()}
+    for k in resumed:
+        if not np.allclose(resumed[k], want[k], atol=0.05, rtol=1e-3):
+            print(f"FAIL: kill/resume through compressed snapshot diverged on {k}: "
+                  f"{resumed[k]} vs {want[k]}")
+            ok = False
+
+    if ok:
+        print(
+            f"quant-smoke PASS: {ratio:.2f}x sync payload reduction "
+            f"({bytes_e} -> {bytes_q} B/sync), quantized deferred engine within the "
+            f"per-metric error oracle (counts bit-exact), {q_compiles} own programs "
+            f"over the shared cache (no cross-policy executables), policy audit "
+            f"clean, kill/resume through a compressed (codec={meta.get('codec')}) "
+            "snapshot exact-within-bounds, zero steady compiles"
+        )
+    return 0 if ok else 1
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    if len(jax.devices()) < NUM_DEVICES:
+        return _bootstrap()
+    return _impl()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

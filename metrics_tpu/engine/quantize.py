@@ -1,0 +1,281 @@
+"""Block-scaled int8 codec for state at REST: compressed snapshot payloads
+and compressed pager rows (ISSUE 10).
+
+The wire codec (``parallel/collectives.py``: ``Q8_BLOCK`` absmax blocks, int8
+codes, f32 scales) extended to stored state, so host RAM and snapshot disk
+scale with the QUANTIZED footprint — the same ``sync_precision`` policy
+decides what compresses: float ``sum`` accumulators a metric declared
+``"q8_block"`` for; counts, cat buffers and min/max states stay verbatim
+(their restore is a bit-exactness contract). Error model: one encode→decode
+round-trip per element, ``|err| <= block_absmax / 254`` (plus the denormal
+flush floor) — the SAME per-element bound the quantized collective rider
+declares, checked by the same oracle (``q8_sum_error_bound`` on a 1-row
+stack).
+
+Two storage forms, matching the two state-at-rest layouts in the engine:
+
+* **Tree form** (``encode_state_tree``/``decode_state_tree``): the logical
+  (possibly shard-stacked) state pytree of a snapshot. A quantized leaf is
+  replaced by a SELF-DESCRIBING dict (marker, codes, scales, shape, dtype) —
+  decode needs no layout, so any engine in the restore matrix can unwrap it.
+  The snapshot's sha256 integrity sidecar hashes the payload AS SAVED, i.e.
+  over the compressed bytes.
+* **Buffer form** (:class:`ArenaRowCodec`): the per-dtype arena vectors the
+  stream pager spills (``engine/paging.py``) and the stream-sharded
+  ``(world, resident, n)`` snapshot arenas. The codec is built from the
+  metric's :class:`~metrics_tpu.engine.arena.ArenaLayout` + policy: the
+  quantized leaves' element positions within each dtype buffer split into a
+  coded section (``<dtype>#q8c`` + ``<dtype>#q8s``) and a verbatim remainder
+  (``<dtype>#ex``). Buffer form is NOT self-describing (the positions come
+  from the layout), so snapshot meta carries ``codec_fp`` — the metric's
+  ``sync_precision_tag()`` — and restore refuses a tag mismatch instead of
+  unscrambling rows with the wrong plan.
+
+Both forms are pure host-numpy functions of their input — the engine's
+``quant_encode``/``quant_decode`` chaos sites can retry them without ever
+double-applying scales.
+"""
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from metrics_tpu.parallel.collectives import Q8_BLOCK, Q8_FLUSH
+
+__all__ = [
+    "ArenaRowCodec",
+    "CODEC_ID",
+    "decode_state_tree",
+    "encode_state_tree",
+    "is_q8_leaf",
+    "q8_decode_array",
+    "q8_encode_array",
+]
+
+#: the codec id snapshot meta carries (``meta["codec"]``) — names the scheme
+#: AND the block size, so a future block-size change is a different codec.
+CODEC_ID = f"q8b{Q8_BLOCK}"
+
+_MARKER = "__q8b__"
+
+
+def _encode_blocks(flat: np.ndarray, block: int) -> "tuple[np.ndarray, np.ndarray]":
+    """Rows of a ``(rows, n)`` f32 matrix -> (codes int8 (rows, nb*block),
+    scales f32 (rows, nb)) with per-row per-block absmax scales."""
+    rows, n = flat.shape
+    nb = -(-n // block)
+    padded = np.zeros((rows, nb * block), np.float32)
+    padded[:, :n] = flat
+    blocks = padded.reshape(rows, nb, block)
+    absmax = np.abs(blocks).max(axis=2)
+    scales = np.where(absmax >= Q8_FLUSH, absmax / 127.0, 0.0).astype(np.float32)
+    inv = np.zeros_like(scales)
+    np.divide(1.0, scales, out=inv, where=scales > 0)
+    codes = np.clip(np.rint(blocks * inv[:, :, None]), -127, 127).astype(np.int8)
+    return codes.reshape(rows, nb * block), scales
+
+
+def _decode_blocks(codes: np.ndarray, scales: np.ndarray, n: int, block: int) -> np.ndarray:
+    """Inverse of :func:`_encode_blocks`: ``(rows, n)`` f32."""
+    rows = codes.shape[0]
+    nb = scales.shape[1]
+    vals = codes.astype(np.float32).reshape(rows, nb, block) * scales[:, :, None]
+    return vals.reshape(rows, nb * block)[:, :n]
+
+
+def q8_encode_array(arr: Any, block: int = Q8_BLOCK) -> Dict[str, Any]:
+    """One array -> its self-describing compressed leaf dict."""
+    a = np.asarray(arr)
+    codes, scales = _encode_blocks(a.astype(np.float32).reshape(1, -1), block)
+    return {
+        # plain python int: numpy scalars round-trip through orbax as python
+        # ints, which would change the integrity digest across save/load
+        _MARKER: int(block),
+        "codes": codes[0],
+        "scales": scales[0],
+        "shape": np.asarray(a.shape, np.int64),
+        "dtype": str(a.dtype),
+    }
+
+
+def q8_decode_array(leaf: Dict[str, Any]) -> np.ndarray:
+    """Inverse of :func:`q8_encode_array` (accepts jax-array members — a
+    loaded snapshot hands them back as device arrays)."""
+    block = int(np.asarray(leaf[_MARKER]))
+    shape = tuple(int(d) for d in np.asarray(leaf["shape"]))
+    n = 1
+    for d in shape:
+        n *= d
+    codes = np.asarray(leaf["codes"]).reshape(1, -1)
+    scales = np.asarray(leaf["scales"]).reshape(1, -1)
+    flat = _decode_blocks(codes, scales, n, block)[0]
+    return flat.reshape(shape).astype(np.dtype(str(leaf["dtype"])))
+
+
+def is_q8_leaf(x: Any) -> bool:
+    return isinstance(x, dict) and _MARKER in x
+
+
+def encode_state_tree(metric: Any, state: Any) -> Any:
+    """Wrap the quantized-policy leaves of a logical (or shard-stacked)
+    state pytree in compressed leaf dicts; everything else passes verbatim.
+    ``metric`` supplies the policy (Metric or MetricCollection)."""
+    if not isinstance(state, dict):
+        return state
+    if hasattr(metric, "items") and not hasattr(metric, "_defaults"):
+        return {
+            k: encode_state_tree(m, state.get(k, {})) for k, m in metric.items(keep_base=True)
+        }
+    out: Dict[str, Any] = {}
+    children = metric._child_metrics()
+    for k, v in state.items():
+        if k == metric._CHILD_KEY:
+            sub: Dict[str, Any] = {}
+            for name, child_state in v.items():
+                child = children.get(name)
+                if child is None:
+                    sub[name] = child_state
+                elif isinstance(child, list):
+                    sub[name] = [
+                        encode_state_tree(c, cs) for c, cs in zip(child, child_state)
+                    ]
+                else:
+                    sub[name] = encode_state_tree(child, child_state)
+            out[k] = sub
+        elif metric._sync_precision.get(k, "exact") == "q8_block" and not isinstance(v, list):
+            out[k] = q8_encode_array(v)
+        else:
+            out[k] = v
+    return out
+
+
+def decode_state_tree(tree: Any) -> Any:
+    """Unwrap every compressed leaf anywhere in a pytree (self-describing —
+    no metric or layout needed; the restore matrix's host paths call this
+    before merging/embedding the state)."""
+    if is_q8_leaf(tree):
+        return q8_decode_array(tree)
+    if isinstance(tree, dict):
+        return {k: decode_state_tree(v) for k, v in tree.items()}
+    if isinstance(tree, list):
+        return [decode_state_tree(v) for v in tree]
+    if isinstance(tree, tuple):
+        return tuple(decode_state_tree(v) for v in tree)
+    return tree
+
+
+class ArenaRowCodec:
+    """Buffer-form codec over a metric's per-dtype arena vectors.
+
+    Built from the per-stream/engine :class:`ArenaLayout` and the metric's
+    ``sync_precision`` policy: for each dtype buffer, the element positions
+    of quantized leaves form the coded section, the rest stays verbatim.
+    Operates on any leading shape — a single spilled row ``(n,)``, a stacked
+    spill matrix ``(K, n)``, a paged snapshot arena ``(world, resident, n)``.
+    """
+
+    CODES = "#q8c"
+    SCALES = "#q8s"
+    EXACT = "#ex"
+
+    def __init__(self, q_mask: Dict[str, np.ndarray], block: int = Q8_BLOCK):
+        #: dtype key -> boolean element mask of the quantized section
+        self._q_mask = {k: np.asarray(v, bool) for k, v in q_mask.items()}
+        self._block = int(block)
+
+    @classmethod
+    def for_metric(cls, metric: Any, block: int = Q8_BLOCK) -> Optional["ArenaRowCodec"]:
+        """The codec for ``metric``'s per-stream arena layout, or None when
+        the policy quantizes nothing (compression is then a no-op)."""
+        from metrics_tpu.engine.arena import ArenaLayout
+
+        precisions = _flat_precisions(metric)
+        if not any(p == "q8_block" for p in precisions):
+            return None
+        layout = ArenaLayout.for_state(metric.abstract_state())
+        specs = layout._specs
+        if len(specs) != len(precisions):  # pragma: no cover - same flatten order
+            raise ValueError(
+                f"precision list ({len(precisions)}) does not align with the arena "
+                f"layout ({len(specs)} leaves)"
+            )
+        masks = {k: np.zeros((n,), bool) for k, n in layout.buffer_sizes().items()}
+        for spec, prec in zip(specs, precisions):
+            if prec == "q8_block":
+                masks[spec.key][spec.offset : spec.offset + spec.size] = True
+        return cls({k: m for k, m in masks.items() if m.any()}, block)
+
+    def is_encoded(self, bufs: Dict[str, Any]) -> bool:
+        return any(str(k).endswith(self.CODES) for k in bufs)
+
+    def encode_buffers(self, bufs: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        """Per-dtype buffers (any leading shape, elements on the LAST axis)
+        -> their compressed form. Buffers without quantized elements pass
+        through under their own key; an all-quantized buffer omits its
+        ``#ex`` entry (zero-size arrays break the orbax save path)."""
+        out: Dict[str, np.ndarray] = {}
+        for k, buf in bufs.items():
+            mask = self._q_mask.get(k)
+            arr = np.asarray(buf)
+            if mask is None:
+                out[k] = arr
+                continue
+            lead = arr.shape[:-1]
+            flat = arr.reshape(-1, arr.shape[-1]).astype(np.float32)
+            codes, scales = _encode_blocks(flat[:, mask], self._block)
+            out[k + self.CODES] = codes.reshape(lead + (codes.shape[-1],))
+            out[k + self.SCALES] = scales.reshape(lead + (scales.shape[-1],))
+            exact = arr.reshape(-1, arr.shape[-1])[:, ~mask]
+            if exact.shape[-1]:
+                out[k + self.EXACT] = exact.reshape(lead + (exact.shape[-1],))
+        return out
+
+    def decode_buffers(self, enc: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        """Inverse of :meth:`encode_buffers` — reassembles each dtype buffer
+        from its coded section + verbatim remainder."""
+        out: Dict[str, np.ndarray] = {}
+        for k, v in enc.items():
+            key = str(k)
+            if key.endswith((self.CODES, self.SCALES, self.EXACT)):
+                continue
+            out[key] = np.asarray(v)
+        for k, mask in self._q_mask.items():
+            ck, sk, ek = k + self.CODES, k + self.SCALES, k + self.EXACT
+            if ck not in enc:
+                continue
+            codes = np.asarray(enc[ck])
+            scales = np.asarray(enc[sk])
+            lead = codes.shape[:-1]
+            nq = int(mask.sum())
+            vals = _decode_blocks(
+                codes.reshape(-1, codes.shape[-1]),
+                scales.reshape(-1, scales.shape[-1]),
+                nq,
+                self._block,
+            )
+            n = mask.size
+            full = np.zeros((vals.shape[0], n), np.dtype(k))
+            full[:, mask] = vals.astype(np.dtype(k))
+            if ek in enc:
+                full[:, ~mask] = np.asarray(enc[ek]).reshape(-1, n - nq)
+            out[k] = full.reshape(lead + (n,))
+        return out
+
+
+def _flat_precisions(metric: Any) -> List[str]:
+    """Per-leaf precision strings in ``abstract_state`` tree-flatten order
+    (sorted-dict nesting mirrors the state tree exactly)."""
+    import jax
+
+    def ptree(m: Any) -> Any:
+        if hasattr(m, "items") and not hasattr(m, "_defaults"):
+            return {k: ptree(mm) for k, mm in m.items(keep_base=True)}
+        out: Dict[str, Any] = {k: m._sync_precision.get(k, "exact") for k in m._defaults}
+        children = m._child_metrics()
+        if children:
+            out[m._CHILD_KEY] = {
+                name: ([ptree(c) for c in child] if isinstance(child, list) else ptree(child))
+                for name, child in children.items()
+            }
+        return out
+
+    return [str(p) for p in jax.tree_util.tree_leaves(ptree(metric))]

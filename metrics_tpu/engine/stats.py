@@ -58,6 +58,16 @@ class EngineStats:
         self.merge_us_total = 0.0
         self.wall_us_total = 0.0
         self.sync_us_total = 0.0
+        # quantized-sync payload accounting (ISSUE 10): bytes one shard
+        # contributed to the fused sync's collectives, split by rider —
+        # exact (f32 psum bundle / digit riders / verbatim carrier) vs
+        # quantized (block-scaled int8 codes + scales). Counted per boundary
+        # merge under deferred sync, per step under step sync; analytic from
+        # the state signature (parallel/collectives.py::fused_sync_plan), so
+        # the counters cost no device work. Rendered as the OpenMetrics
+        # sync_payload_bytes{kind=...} counters.
+        self.sync_payload_exact_bytes = 0
+        self.sync_payload_quant_bytes = 0
         # fault-tolerance accounting (ISSUE 6): injected faults by site, and
         # every recovery action the engine took — retries with backoff,
         # pre-step rollbacks, pallas→xla kernel demotions, coalesce
@@ -93,6 +103,9 @@ class EngineStats:
         self.page_outs = 0
         self.resident_streams = 0
         self.spilled_streams = 0
+        # host-RAM bytes of the spill store at the last gauge refresh — the
+        # footprint compress_payloads quantizes (ISSUE 10)
+        self.spilled_bytes = 0
 
     def record_fault(self, site: str) -> None:
         """One injected fault fired at ``site`` (chaos harness accounting)."""
@@ -142,6 +155,7 @@ class EngineStats:
             "page_outs": self.page_outs,
             "resident_streams": self.resident_streams,
             "spilled_streams": self.spilled_streams,
+            "spilled_bytes": self.spilled_bytes,
         }
 
     def record_merge(self, merge_us: float) -> None:
@@ -149,6 +163,11 @@ class EngineStats:
         fused collective bundle's host-observed latency."""
         self.merges += 1
         self.merge_us_total += float(merge_us)
+
+    def record_sync_payload(self, exact_bytes: int, quant_bytes: int) -> None:
+        """One fused sync's per-shard payload, split by rider kind."""
+        self.sync_payload_exact_bytes += int(exact_bytes)
+        self.sync_payload_quant_bytes += int(quant_bytes)
 
     def record_step(
         self,
@@ -271,6 +290,11 @@ class EngineStats:
             "merges": self.merges,
             "merge_us_total": round(self.merge_us_total, 1),
         }
+        if self.sync_payload_exact_bytes or self.sync_payload_quant_bytes:
+            out["sync_payload_bytes"] = {
+                "exact": self.sync_payload_exact_bytes,
+                "quantized": self.sync_payload_quant_bytes,
+            }
         if self.mesh_sync in ("deferred", "stream_shard"):
             # stream_shard engines route host-side and carry NO steady-state
             # collectives either — boundary merges (deferred) or per-read row

@@ -47,9 +47,12 @@ from metrics_tpu.ops.kernels import (
 )
 from metrics_tpu.parallel.collectives import (
     AxisSpec,
+    SYNC_PRECISIONS,
+    _sum_rider,
     axis_size_or_one,
     fused_axis_sync,
     in_mapped_context,
+    q8_sum_error_bound,
     sync_axis_state,
 )
 from metrics_tpu.parallel.mesh import current_metric_axis
@@ -110,6 +113,19 @@ def _squeeze_if_scalar(x: Any) -> Any:
     return apply_to_collection(x, jax.Array, _sq)
 
 
+def sync_precision_tag_of(precisions: Dict[str, str]) -> str:
+    """THE canonical AOT-key tag of a sync-precision map (``"exact"`` or
+    ``"q8:<digest>"`` over the sorted quantized paths) — one implementation
+    shared by ``Metric`` and ``MetricCollection``, so the two can never
+    drift on what a policy's program-key component looks like."""
+    quantized = sorted(f"{k}={v}" for k, v in precisions.items() if v != "exact")
+    if not quantized:
+        return "exact"
+    import hashlib
+
+    return "q8:" + hashlib.sha256(";".join(quantized).encode()).hexdigest()[:10]
+
+
 def distributed_available() -> bool:
     """True when metric state can differ across participants.
 
@@ -144,6 +160,18 @@ class Metric:
             ambient axis from ``metrics_tpu.parallel.metric_axis`` is used.
         dist_sync_fn: override for the leaf-sync function, signature
             ``(reduce_fx, value, axis_name) -> value``. Defaults to XLA collectives.
+        sync_precision: per-metric quantized-sync policy (ISSUE 10, default
+            exact — nothing quantizes silently). ``"q8_block"`` lets every
+            ELIGIBLE state (float ``dist_reduce_fx="sum"`` accumulators —
+            Gram/cov/sum matrices) ride the block-scaled int8 collective
+            rider; counts, cat buffers and min/max states always stay
+            bit-exact. A ``{state_name: precision}`` dict targets states
+            explicitly and RAISES on ineligible ones. Also settable after
+            construction via :meth:`set_sync_precision` (the only route for
+            subclasses that don't forward the kwarg). Part of every engine
+            AOT program key and of :func:`~metrics_tpu.engine.aot.
+            metric_fingerprint` — two engines with different policies never
+            exchange executables.
     """
 
     __jit_unsafe_attributes__ = ()
@@ -158,6 +186,7 @@ class Metric:
         sync_axis: Optional[str] = None,
         dist_sync_fn: Optional[Callable] = None,
         process_group: Optional[str] = None,
+        sync_precision: Optional[Union[str, Dict[str, str]]] = None,
         **kwargs: Any,
     ) -> None:
         if kwargs:
@@ -172,6 +201,12 @@ class Metric:
         self._defaults: Dict[str, Any] = {}
         self._persistent: Dict[str, bool] = {}
         self._reductions: Dict[str, Any] = {}
+        # per-state sync precision (absent key = "exact"). The constructor
+        # spec is applied by add_state as states register (subclass __init__
+        # runs add_state AFTER super().__init__), so a blanket "q8_block"
+        # catches every eligible state and a dict validates per name.
+        self._sync_precision: Dict[str, str] = {}
+        self._sync_precision_spec = self._check_sync_precision_spec(sync_precision)
 
         self._update_called = False
         self._computed: Any = None
@@ -217,6 +252,167 @@ class Metric:
         self._persistent[name] = persistent
         self._reductions[name] = dist_reduce_fx
         setattr(self, name, default if isinstance(default, jax.Array) else list(default))
+        spec = self._sync_precision_spec
+        if isinstance(spec, str):
+            # blanket policy: quantize what is eligible, leave the rest exact
+            if spec != "exact" and self._sync_precision_ineligible_reason(name) is None:
+                self._sync_precision[name] = spec
+        elif isinstance(spec, dict) and name in spec:
+            self._set_state_precision(name, spec[name])
+
+    # ------------------------------------------------------- sync precision policy
+
+    @staticmethod
+    def _check_sync_precision_spec(spec: Any) -> Any:
+        if spec is None or isinstance(spec, dict):
+            return spec
+        if isinstance(spec, str):
+            if spec not in SYNC_PRECISIONS:
+                raise ValueError(
+                    f"unknown sync_precision {spec!r}; expected one of {SYNC_PRECISIONS}"
+                )
+            return spec
+        raise ValueError(
+            f"sync_precision must be a string or a {{state: precision}} dict, got {type(spec).__name__}"
+        )
+
+    def _sync_precision_ineligible_reason(self, name: str) -> Optional[str]:
+        """None when state ``name`` may ride a quantized payload: a
+        fixed-shape float ``dist_reduce_fx="sum"`` accumulator. Everything
+        else must stay exact — counts are bit-exactness contracts, cat/None
+        buffers carry values compute consumes verbatim, and min/max have no
+        bounded-error quantized combine."""
+        if name not in self._defaults:
+            return f"no registered state named {name!r}"
+        if isinstance(self._defaults[name], list):
+            return "list (cat/gather) states must stay exact"
+        fx = self._reductions[name]
+        if fx != "sum":
+            return f"dist_reduce_fx={fx!r} states must stay exact (only float 'sum' accumulators quantize)"
+        if _sum_rider(jnp.asarray(self._defaults[name]).dtype) != "float":
+            return "integer/count states must stay exact (they keep the bit-exact digit rider)"
+        return None
+
+    def _set_state_precision(self, name: str, prec: str) -> None:
+        if prec not in SYNC_PRECISIONS:
+            raise ValueError(
+                f"unknown sync_precision {prec!r}; expected one of {SYNC_PRECISIONS}"
+            )
+        if prec == "exact":
+            self._sync_precision.pop(name, None)
+            return
+        reason = self._sync_precision_ineligible_reason(name)
+        if reason is not None:
+            raise MetricsTPUUserError(
+                f"state {name!r} of {type(self).__name__} cannot ride a quantized sync: {reason}"
+            )
+        self._sync_precision[name] = prec
+
+    def set_sync_precision(self, spec: Union[str, Dict[str, str]]) -> "Metric":
+        """Declare which states tolerate quantized sync (chainable).
+
+        A blanket string (``"q8_block"``) applies to every ELIGIBLE state —
+        float ``sum`` accumulators — on this metric AND its nested children,
+        leaving counts/cat/min-max states exact; ``"exact"`` clears the
+        policy everywhere. A ``{state_name: precision}`` dict targets this
+        metric's own states and raises on ineligible ones. The policy is a
+        trace constant: it changes the metric fingerprint and every engine
+        AOT program key, so reconfiguring it never reuses stale executables.
+        """
+        spec = self._check_sync_precision_spec(spec)
+        if spec is None:
+            return self
+        if isinstance(spec, str):
+            for name in self._defaults:
+                if spec == "exact":
+                    self._sync_precision.pop(name, None)
+                elif self._sync_precision_ineligible_reason(name) is None:
+                    self._sync_precision[name] = spec
+            self._for_each_child(lambda c: c.set_sync_precision(spec))
+        else:
+            for name, prec in spec.items():
+                self._set_state_precision(name, prec)
+        return self
+
+    def _check_spec_consumed(self) -> None:
+        """A constructor ``sync_precision`` DICT entry is applied as its
+        state registers (``add_state``); once the policy is actually read, a
+        key that never matched a registered state is a typo the contract
+        says must RAISE — silently staying exact would look like a missing
+        payload win, not an error."""
+        spec = self._sync_precision_spec
+        if isinstance(spec, dict):
+            unknown = sorted(k for k in spec if k not in self._defaults)
+            if unknown:
+                raise MetricsTPUUserError(
+                    f"sync_precision names states {type(self).__name__} never "
+                    f"registered: {unknown} (registered: {sorted(self._defaults)})"
+                )
+
+    def state_sync_precisions(self) -> Dict[str, str]:
+        """Flat ``{state_path: precision}`` for self and nested metrics
+        (every registered state appears; default ``"exact"``)."""
+        self._check_spec_consumed()
+        out = {k: self._sync_precision.get(k, "exact") for k in self._defaults}
+        for name, child in self._child_metrics().items():
+            children = child if isinstance(child, list) else None
+            if children is not None:
+                for i, c in enumerate(children):
+                    for k, v in c.state_sync_precisions().items():
+                        out[f"{name}[{i}].{k}"] = v
+            else:
+                for k, v in child.state_sync_precisions().items():
+                    out[f"{name}.{k}"] = v
+        return out
+
+    def sync_precision_tag(self) -> str:
+        """Canonical short form of the policy for AOT program keys:
+        ``"exact"`` when nothing quantizes, else ``"q8:<digest>"`` over the
+        sorted quantized state paths — engines fold this into every program
+        key so policies sharing one AotCache never exchange executables."""
+        return sync_precision_tag_of(self.state_sync_precisions())
+
+    def sync_leaf_info(self) -> List[Any]:
+        """``(dist_reduce_fx, abstract_leaf, precision)`` per fixed-shape
+        state leaf, in :meth:`sync_states` order (children appended) — the
+        input of ``parallel/collectives.py::fused_sync_plan`` /
+        ``sync_payload_bytes`` and of the ``quantized-sync-policy-honored``
+        analysis rule. List (dynamic cat) states are skipped: their payload
+        is data-dependent and no engine-served metric carries one."""
+        abs_state = self.abstract_state()
+        out: List[Any] = []
+        for k in self._defaults:
+            if isinstance(self._defaults[k], list):
+                continue
+            out.append((self._reductions[k], abs_state[k], self._sync_precision.get(k, "exact")))
+        for child in self._child_metrics().values():
+            children = child if isinstance(child, list) else [child]
+            for c in children:
+                out.extend(c.sync_leaf_info())
+        return out
+
+    def sync_error_bounds(self, stacked: Dict[str, Any]) -> Dict[str, Any]:
+        """Per-element |error| bounds of a quantized sync/merge of ``stacked``
+        (a shard-STACKED state pytree, leading axis = shard) vs the exact
+        path — one entry per quantized state path, from the codec's declared
+        bound (``q8_sum_error_bound``). THE per-metric bounded-error oracle
+        the quantized gates (fuzz suite, ``make quant-smoke``) assert with;
+        exact states never appear (they are byte-identical by contract)."""
+        out: Dict[str, Any] = {}
+        for k in self._defaults:
+            if self._sync_precision.get(k, "exact") == "q8_block":
+                out[k] = q8_sum_error_bound(np.asarray(stacked[k]))
+        for name, child in self._child_metrics().items():
+            children = child if isinstance(child, list) else None
+            sub = stacked.get(self._CHILD_KEY, {}) if isinstance(stacked, dict) else {}
+            if children is not None:
+                for i, c in enumerate(children):
+                    for k, v in c.sync_error_bounds(sub.get(name, [{}] * len(children))[i]).items():
+                        out[f"{name}[{i}].{k}"] = v
+            else:
+                for k, v in child.sync_error_bounds(sub.get(name, {})).items():
+                    out[f"{name}.{k}"] = v
+        return out
 
     # ------------------------------------------------------------- functional core API
 
@@ -702,6 +898,7 @@ class Metric:
         """
         if axis_name is None or not in_mapped_context(axis_name):
             return state
+        self._check_spec_consumed()
         # nested metric states sync recursively with their own reductions
         synced_children: Optional[Dict[str, Any]] = None
         if self._CHILD_KEY in state:
@@ -722,9 +919,18 @@ class Metric:
             for k in keys
         ]
         if self.dist_sync_fn is not None:
+            # custom sync fns receive the raw (fx, value) contract and always
+            # see the exact values — the quantized rider is a property of the
+            # built-in fused bundle only
             out = {k: self.dist_sync_fn(fx, prepped[k], axis_name) for k, fx in zip(keys, fxs)}
         else:
-            synced = fused_axis_sync(list(zip(fxs, (prepped[k] for k in keys))), axis_name)
+            precs = [
+                "exact" if was_list[k] else self._sync_precision.get(k, "exact")
+                for k in keys
+            ]
+            synced = fused_axis_sync(
+                list(zip(fxs, (prepped[k] for k in keys))), axis_name, precisions=precs
+            )
             out = dict(zip(keys, synced))
         if synced_children is not None:
             out[self._CHILD_KEY] = synced_children

@@ -11,9 +11,24 @@ Beyond parity: ``fused_axis_sync`` merges ALL sum/min/max counter states of a wh
 MetricCollection into one flat buffer per reduction and issues a single ``psum``
 bundle — O(1) collectives where the reference issues O(metrics x states)
 (``metric.py:240-245``).
+
+Quantized sync (ISSUE 10, EQuARX-style): a leaf whose metric declares
+``sync_precision="q8_block"`` rides the collective as BLOCK-SCALED INT8 —
+per-:data:`Q8_BLOCK`-element absmax scales computed in-trace, int8 codes
+packed 4-per-u32-word, scales bitcast alongside into the SAME u32 carrier the
+cat/None leaves already share. The decode dequantizes every shard's
+contribution and folds the sum locally in f32, so a quantized sum is exact in
+the combine and bounded only by the per-shard rounding:
+``|err| <= sum_over_shards(block_absmax / 254)`` per element (plus a
+denormal-flush floor — see :func:`q8_sum_error_bound`, the oracle every
+quantized gate checks against). Eligibility is strict: only float 'sum'
+leaves ever quantize; integer counters keep the bit-exact digit rider and
+cat/None/custom leaves keep the verbatim carrier. Payload: ``9 * ceil(n/32)``
+u32 words per quantized leaf vs ``n`` words exact — ~3.6x fewer bytes on the
+wire (:func:`sync_payload_bytes` is the shared accounting).
 """
 import re
-from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -111,7 +126,9 @@ def sync_axis_state(reduce_fx: Any, value: Array, axis_name: AxisSpec) -> Array:
 
 
 def fused_axis_sync(
-    leaves: List[Tuple[Any, Array]], axis_name: AxisSpec
+    leaves: List[Tuple[Any, Array]],
+    axis_name: AxisSpec,
+    precisions: Optional[Sequence[Optional[str]]] = None,
 ) -> List[Array]:
     """Sync many (reduce_fx, value) state leaves with a minimal collective bundle.
 
@@ -131,6 +148,14 @@ def fused_axis_sync(
       split 1-to-2 — bitcasts are free, the padding is <=3 bytes per leaf.
       Per-leaf views are reassembled locally: (world, n, ...) -> (world*n, ...)
       for 'cat', (world, ...) for None, and a pairwise fold for callables.
+    * QUANTIZED float 'sum' leaves (``precisions[i] == "q8_block"``) leave the
+      psum bundle and ride the same u32 all_gather as block-scaled int8
+      (codes packed 4-per-word + f32 scales); the decode dequantizes every
+      shard's contribution and sums locally in f32 — bandwidth drops ~3.6x
+      per quantized leaf, error bounded by :func:`q8_sum_error_bound`.
+
+    ``precisions`` aligns with ``leaves``; None (or ``"exact"`` entries) keeps
+    every leaf on the bit-exact paths above — nothing changes silently.
 
     Returns synced values in input order. A MetricCollection of K metrics with
     S states issues <=2 collectives (+ one per exotic reduction), not O(K*S)
@@ -140,9 +165,23 @@ def fused_axis_sync(
     sum_bucket: List[int] = []
     reduce_buckets: Dict[Tuple[str, Any], List[int]] = {}
     gather_bucket: List[int] = []
+    q8_bucket: List[int] = []
     for i, (fx, v) in enumerate(leaves):
         dtype = jnp.asarray(v).dtype
-        if fx == "sum" and _sum_rider(dtype) is not None:
+        prec = (precisions[i] if precisions is not None else None) or "exact"
+        if prec not in SYNC_PRECISIONS:
+            raise ValueError(
+                f"unknown sync precision {prec!r}; expected one of {SYNC_PRECISIONS}"
+            )
+        if prec == "q8_block":
+            if fx != "sum" or _sum_rider(dtype) != "float":
+                raise ValueError(
+                    f"sync_precision='q8_block' needs a float 'sum' leaf, got "
+                    f"dist_reduce_fx={fx!r} dtype={dtype} — counts, cat buffers and "
+                    "min/max states must stay exact"
+                )
+            q8_bucket.append(i)
+        elif fx == "sum" and _sum_rider(dtype) is not None:
             sum_bucket.append(i)
         elif fx in _REDUCE_COLLECTIVES:
             reduce_buckets.setdefault((fx, dtype), []).append(i)
@@ -173,16 +212,19 @@ def fused_axis_sync(
             out[i] = piece.reshape(jnp.shape(leaves[i][1]))
             off += n
 
-    if gather_bucket:
+    if gather_bucket or q8_bucket:
         # gathers are layout-agnostic: every leaf packs into ONE u32 carrier
-        # (free bitcasts; sub-word dtypes pad to a word boundary first)
+        # (free bitcasts; sub-word dtypes pad to a word boundary first).
+        # Quantized sum leaves SHARE the carrier: codes + scales are just more
+        # words, so however many leaves quantize, the collective count holds.
         payloads = [_to_carrier_u32(leaves[i][1]) for i in gather_bucket]
+        payloads += [_q8_carrier(leaves[i][1]) for i in q8_bucket]
         sizes = [p.size for p in payloads]
         flat = jnp.concatenate(payloads) if len(payloads) > 1 else payloads[0]
         gathered = lax.all_gather(flat, axis_name, tiled=False)  # (world, words)
         world = gathered.shape[0]
         off = 0
-        for i, n in zip(gather_bucket, sizes):
+        for i, n in zip(gather_bucket, sizes[: len(gather_bucket)]):
             fx, v = leaves[i]
             v = jnp.asarray(v)
             shape = v.shape
@@ -200,6 +242,10 @@ def fused_axis_sync(
                 out[i] = acc
             else:
                 raise ValueError(f"unknown dist_reduce_fx: {fx!r}")
+        for i, n in zip(q8_bucket, sizes[len(gather_bucket):]):
+            raw = lax.slice(gathered, (0, off), (world, off + n))
+            out[i] = _q8_sum_from_gathered(raw, leaves[i][1])
+            off += n
     return out  # type: ignore[return-value]
 
 
@@ -302,6 +348,186 @@ def _from_carrier_u32(raw: Array, dtype: Any, shape: Tuple[int, ...]) -> Array:
             vals = lax.bitcast_convert_type(vals, tgt)
     vals = vals.reshape((world,) + tuple(shape))
     return vals.astype(jnp.bool_) if dtype == jnp.bool_ else vals
+
+
+# ------------------------------------------- q8_block quantized rider (ISSUE 10)
+
+#: elements per absmax-scale block of the block-scaled int8 codec. 32 keeps
+#: scales local enough that a single-outlier block cannot poison its
+#: neighbours' precision, is a multiple of the 4-codes-per-word packing, and
+#: costs 1 scale word per 8 code words (payload = 9 * ceil(n/32) u32 words
+#: per quantized leaf vs n words exact — ~3.6x fewer bytes).
+Q8_BLOCK = 32
+
+#: the declared sync precisions. "exact" is the default everywhere — nothing
+#: quantizes unless a metric's policy says so (metric.py::set_sync_precision).
+SYNC_PRECISIONS = ("exact", "q8_block")
+
+#: blocks whose absmax sits below this flush to zero codes: the scale
+#: absmax/127 would be subnormal there, and 1/scale overflows f32. The flush
+#: error (<= absmax < Q8_FLUSH per element per shard) is folded into
+#: :func:`q8_sum_error_bound`'s floor term.
+Q8_FLUSH = 1.5e-36
+
+
+def _q8_block_count(n: int, block: int = Q8_BLOCK) -> int:
+    return -(-int(n) // int(block))
+
+
+def q8_carrier_words(n: int, block: int = Q8_BLOCK) -> int:
+    """u32 carrier words one quantized leaf of ``n`` elements contributes:
+    block-padded int8 codes packed 4-per-word plus one f32 scale per block."""
+    nb = _q8_block_count(n, block)
+    return nb * (block // 4) + nb
+
+
+def _q8_encode(v: Array, block: int = Q8_BLOCK) -> Tuple[Array, Array]:
+    """One shard's block-scaled int8 encoding of a float leaf (in-trace):
+    ``(codes int8 (nb*block,), scales f32 (nb,))``. ``|x - code*scale| <=
+    scale/2`` per element (codes never clip: |x| <= absmax maps to exactly
+    +-127); near-subnormal blocks flush to zero codes (see ``Q8_FLUSH``)."""
+    flat = jnp.ravel(jnp.asarray(v)).astype(jnp.float32)
+    nb = _q8_block_count(flat.size, block)
+    pad = nb * block - flat.size
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+    blocks = flat.reshape(nb, block)
+    absmax = jnp.max(jnp.abs(blocks), axis=1)
+    scales = jnp.where(absmax >= Q8_FLUSH, absmax / 127.0, 0.0)
+    inv = jnp.where(scales > 0, 1.0 / scales, 0.0)
+    codes = jnp.clip(jnp.round(blocks * inv[:, None]), -127.0, 127.0).astype(jnp.int8)
+    return codes.reshape(-1), scales
+
+
+def _q8_carrier(v: Array, block: int = Q8_BLOCK) -> Array:
+    """Encode one quantized sum leaf into flat u32 carrier words:
+    ``[packed int8 codes | bitcast f32 scales]`` — the scales travel
+    alongside the payload in the SAME collective."""
+    codes, scales = _q8_encode(v, block)
+    return jnp.concatenate([_to_carrier_u32(codes), _to_carrier_u32(scales)])
+
+
+def _q8_sum_from_gathered(raw: Array, ref: Array, block: int = Q8_BLOCK) -> Array:
+    """Decode a gathered ``(world, words)`` q8 slab back to the summed leaf:
+    each shard's codes dequantize against its OWN scales and the
+    contributions fold in f32 — the combine is exact, only the per-shard
+    rounding remains (:func:`q8_sum_error_bound`)."""
+    ref = jnp.asarray(ref)
+    n = ref.size
+    nb = _q8_block_count(n, block)
+    ncodes = nb * block
+    world = raw.shape[0]
+    code_words = ncodes // 4
+    codes = _from_carrier_u32(
+        lax.slice(raw, (0, 0), (world, code_words)), jnp.int8, (ncodes,)
+    )
+    scales = _from_carrier_u32(
+        lax.slice(raw, (0, code_words), (world, raw.shape[1])), jnp.float32, (nb,)
+    )
+    contrib = codes.astype(jnp.float32).reshape(world, nb, block) * scales[:, :, None]
+    total = jnp.sum(contrib, axis=0).reshape(-1)[:n]
+    return total.reshape(ref.shape).astype(ref.dtype)
+
+
+def q8_roundtrip(v: Any, block: int = Q8_BLOCK) -> Any:
+    """One shard's encode→decode round-trip (no collective): what a single
+    quantized contribution loses — by construction identical to the W=1
+    quantized sum, which the fuzz suite pins against the carrier path."""
+    import numpy as np
+
+    ref = jnp.asarray(v)
+    codes, scales = _q8_encode(ref, block)
+    vals = np.asarray(codes, np.float32).reshape(-1, block) * np.asarray(scales)[:, None]
+    return np.asarray(vals.reshape(-1)[: ref.size], np.float32).reshape(np.shape(ref))
+
+
+def q8_sum_error_bound(stacked: Any, block: int = Q8_BLOCK) -> Any:
+    """Per-element |error| bound of the q8_block quantized sum of ``stacked``
+    (leading axis = shard) vs the exact f32 sum — THE oracle every quantized
+    gate checks against (fuzz suite, quant-smoke, the engine's bounded-error
+    assertions). Per shard per element: ``scale/2`` (rounding) where the
+    block quantizes, ``absmax`` (< ``Q8_FLUSH``) where it flushes; summed
+    over shards. Host-side numpy; returns an array shaped like one shard."""
+    import numpy as np
+
+    arr = np.asarray(stacked, np.float32)
+    world = arr.shape[0]
+    flat = arr.reshape(world, -1)
+    n = flat.shape[1]
+    nb = _q8_block_count(n, block)
+    padded = np.zeros((world, nb * block), np.float32)
+    padded[:, :n] = flat
+    absmax = np.abs(padded.reshape(world, nb, block)).max(axis=2)
+    flushed = absmax < Q8_FLUSH
+    per_block = np.where(flushed, absmax, absmax / 254.0)  # absmax/127/2
+    per_elem = np.repeat(per_block, block, axis=1)[:, :n].sum(axis=0)
+    return per_elem.reshape(arr.shape[1:])
+
+
+# ------------------------------------------- payload accounting (shared source)
+
+
+def fused_sync_plan(
+    leaves: Sequence[Tuple[Any, Any, Optional[str]]], world: int, block: int = Q8_BLOCK
+) -> Dict[str, Any]:
+    """The analytic payload layout of one fused sync over ``leaves`` —
+    ``(dist_reduce_fx, abstract/array leaf, precision)`` triples — on a
+    ``world``-shard axis: how :func:`fused_axis_sync` buckets them and how
+    many elements/words each collective moves per shard. The single source
+    the bench's ``sync_payload_bytes``, the engine's payload counters, and
+    the ``quantized-sync-policy-honored`` analysis rule all derive from (the
+    rule's clean-twin fixture pins this against an actual trace)."""
+    sum_elems = 0
+    gather_words = 0
+    q8_words = 0
+    reduce_elems: Dict[Tuple[str, str], int] = {}
+    quantized: List[int] = []
+    bits = _int_split_bits(max(1, int(world)))
+    nparts = -(-32 // bits)
+    for i, (fx, leaf, prec) in enumerate(leaves):
+        dt = getattr(leaf, "dtype", None)
+        dtype = jnp.dtype(dt) if dt is not None else jnp.asarray(leaf).dtype
+        shape = getattr(leaf, "shape", None)
+        size = 1
+        for d in (shape if shape is not None else jnp.shape(leaf)):
+            size *= int(d)
+        prec = prec or "exact"
+        if prec == "q8_block" and fx == "sum" and _sum_rider(dtype) == "float":
+            q8_words += q8_carrier_words(size, block)
+            quantized.append(i)
+        elif fx == "sum" and _sum_rider(dtype) is not None:
+            sum_elems += size if _sum_rider(dtype) == "float" else size * nparts
+        elif fx in _REDUCE_COLLECTIVES:
+            key = (str(fx), dtype.name)
+            reduce_elems[key] = reduce_elems.get(key, 0) + size
+        else:
+            itemsize = dtype.itemsize if dtype != jnp.bool_ else 1
+            if itemsize >= 4:
+                gather_words += size * (itemsize // 4)
+            else:
+                per = 4 // itemsize
+                gather_words += -(-size // per)
+    return {
+        "sum_elems": sum_elems,
+        "reduce_elems": reduce_elems,
+        "gather_words": gather_words,
+        "q8_words": q8_words,
+        "quantized": quantized,
+    }
+
+
+def sync_payload_bytes(
+    leaves: Sequence[Tuple[Any, Any, Optional[str]]], world: int, block: int = Q8_BLOCK
+) -> int:
+    """Bytes one shard contributes to the fused sync's collectives under the
+    given per-leaf precisions (psum bundle f32 + reduce buckets + u32
+    carrier). Compare against the same call with all-"exact" precisions for
+    the quantization ratio — BENCH.sync_payload's headline."""
+    plan = fused_sync_plan(leaves, world, block)
+    nbytes = 4 * plan["sum_elems"] + 4 * (plan["gather_words"] + plan["q8_words"])
+    for (_, dtype_name), elems in plan["reduce_elems"].items():
+        nbytes += jnp.dtype(dtype_name).itemsize * elems
+    return int(nbytes)
 
 
 def reduce(x: Array, reduction: str) -> Array:

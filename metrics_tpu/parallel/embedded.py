@@ -170,9 +170,13 @@ def sharded_masked_step(
       shard over ``axis``; config scalars and the state replicate;
     * each device computes its shard's masked delta
       (``Metric.update_state_masked``), the deltas psum/pmin/pmax-merge
-      in-step (``sync_states``), and the replicated GLOBAL state comes back —
-      so a snapshot between any two steps is globally consistent and compute
-      needs no further sync;
+      in-step (``sync_states`` — states the metric's ``sync_precision``
+      policy declares ``"q8_block"`` ride the block-scaled int8 section of
+      the fused bundle, per-STEP deltas, so the quantization bound grows
+      with step count; deferred sync quantizes whole states at boundaries
+      instead), and the replicated GLOBAL state comes back — so a snapshot
+      between any two steps is globally consistent and compute needs no
+      further sync;
     * ``token`` is the global valid-row count — a tiny non-donated output the
       dispatcher blocks on (the state itself is donated into the next step).
 
@@ -325,7 +329,10 @@ def sharded_state_merge(
     whole tree rides ``metric.sync_states`` — ONE fused collective bundle
     (``parallel/collectives.py::fused_axis_sync``: all sum counters share a
     single psum, min/max one collective per (reduction, dtype), cat/gather
-    states one u32-carrier all_gather) per merge, however many metrics the
+    states one u32-carrier all_gather, and states under a ``"q8_block"``
+    ``sync_precision`` policy ride that same carrier as block-scaled int8 —
+    the merge acts on whole accumulated STATES, so the quantization bound
+    never grows with step count) per merge, however many metrics the
     collection serves. The output is the replicated GLOBAL state in the
     metric's own layout — ``cat`` buffers arrive concatenated across shards
     (``dist_reduce_fx="cat"`` semantics), so ``compute_from`` needs no

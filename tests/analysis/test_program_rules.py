@@ -24,6 +24,7 @@ from metrics_tpu.analysis import (
     check_no_collectives,
     check_no_scatter_under_pallas,
     check_pallas_call_count,
+    check_quantized_policy_honored,
     collective_counts,
     expected_step_sync_collectives,
 )
@@ -73,6 +74,64 @@ def test_hlo_collective_fires_on_text_plane():
     assert [f.rule for f in findings] == ["no-collectives-in-deferred-step"]
     assert findings[0].path == "hlo:all-reduce"
     assert check_no_collectives(hlo_text="ENTRY %main { add(...) }") == []
+
+
+# ------------------------------------------- quantized-sync-policy-honored
+
+
+def test_policy_violation_fires_both_directions():
+    """A merge traced under the WRONG precisions fires the rule in both
+    directions: a quantized-policy state left on the f32 psum, and an
+    exact-policy state smuggled onto the quantized rider. The clean twin
+    (trace matches declaration) also PINS the analytic plan in
+    ``fused_sync_plan`` against an actual ``fused_axis_sync`` trace."""
+    from metrics_tpu.parallel.collectives import fused_axis_sync
+
+    mesh = _mesh1()
+    leaves_abs = (jnp.zeros((100,), jnp.float32), jnp.zeros((4,), jnp.int32))
+
+    def merge_with(precisions):
+        def body(a, b):
+            return tuple(
+                fused_axis_sync([("sum", a[0]), ("sum", b[0])], "dp", precisions=precisions)
+            )
+
+        fn = jax.shard_map(
+            body, mesh=mesh, in_specs=(P("dp"), P("dp")), out_specs=P(), check_vma=False
+        )
+        return jax.make_jaxpr(fn)(
+            leaves_abs[0][None], leaves_abs[1][None]
+        )
+
+    declared_quant = [
+        ("sum", jax.ShapeDtypeStruct((100,), jnp.float32), "q8_block"),
+        ("sum", jax.ShapeDtypeStruct((4,), jnp.int32), "exact"),
+    ]
+    declared_exact = [(fx, leaf, "exact") for fx, leaf, _ in declared_quant]
+
+    # clean twins: trace and declaration agree — the rule stays silent (and
+    # the analytic plan provably matches what fused_axis_sync lowers)
+    assert check_quantized_policy_honored(
+        merge_with(["q8_block", None]), declared_quant, world=1
+    ) == []
+    assert check_quantized_policy_honored(
+        merge_with([None, None]), declared_exact, world=1
+    ) == []
+
+    # broken fixture 1: metric declares q8 but the program kept the f32 psum
+    findings = check_quantized_policy_honored(
+        merge_with([None, None]), declared_quant, world=1, where="fixture/quant"
+    )
+    assert findings and all(f.rule == "quantized-sync-policy-honored" for f in findings)
+    assert findings[0].where == "fixture/quant"
+    assert any("psum" == f.path for f in findings)
+
+    # broken fixture 2: metric declares exact but the program quantized it
+    findings = check_quantized_policy_honored(
+        merge_with(["q8_block", None]), declared_exact, world=1, where="fixture/quant"
+    )
+    assert findings and all(f.rule == "quantized-sync-policy-honored" for f in findings)
+    assert any("all_gather" == f.path for f in findings)
 
 
 # ------------------------------------- exact-collective-multiset-in-step-sync

@@ -1,0 +1,347 @@
+"""Quantized sync & compressed state movement (ISSUE 10): policy plumbing,
+AOT program identity, at-rest codec round-trips, snapshot integrity over
+compressed bytes, OpenMetrics payload counters.
+
+The mesh-level bounded-error and payload-ratio claims live in ``make
+quant-smoke`` (8-device bootstrap); this file pins the 1-device-safe
+engine-layer contracts the smoke rides on.
+"""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from metrics_tpu import Accuracy, BinnedAveragePrecision, MeanSquaredError, MetricCollection
+from metrics_tpu.engine import AotCache, EngineConfig, StreamingEngine
+from metrics_tpu.engine.faults import SnapshotCorruptError
+from metrics_tpu.engine.quantize import (
+    ArenaRowCodec,
+    decode_state_tree,
+    encode_state_tree,
+    is_q8_leaf,
+    q8_decode_array,
+    q8_encode_array,
+)
+from metrics_tpu.engine.snapshot import load_snapshot
+from metrics_tpu.parallel.collectives import q8_sum_error_bound
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+
+def _coll(prec=None):
+    c = MetricCollection(
+        {"acc": Accuracy(), "bap": BinnedAveragePrecision(num_classes=4, thresholds=25)}
+    )
+    if prec:
+        c.set_sync_precision(prec)
+    return c
+
+
+def _batches(k=4, seed=0):
+    rng = np.random.RandomState(seed)
+    out = []
+    for n in (9, 16, 5, 12)[:k]:
+        p = rng.rand(n, 4).astype(np.float32)
+        p /= p.sum(axis=1, keepdims=True)
+        out.append((p, rng.randint(0, 4, n)))
+    return out
+
+
+# ------------------------------------------------------------------ policy API
+
+
+def test_blanket_policy_quantizes_only_eligible_states():
+    coll = _coll("q8_block")
+    precs = coll.state_sync_precisions()
+    # float sum accumulators quantize; int counts never
+    assert precs["bap.TPs"] == "q8_block"
+    assert precs["acc.correct"] == "exact"
+    assert precs["acc.total"] == "exact"
+    assert coll.sync_precision_tag().startswith("q8:")
+    assert _coll().sync_precision_tag() == "exact"
+
+
+def test_explicit_dict_policy_raises_on_ineligible_states():
+    with pytest.raises(MetricsTPUUserError, match="integer/count"):
+        Accuracy().set_sync_precision({"correct": "q8_block"})
+    m = MeanSquaredError()
+    m.set_sync_precision({"sum_squared_error": "q8_block"})
+    assert m.state_sync_precisions()["sum_squared_error"] == "q8_block"
+    with pytest.raises(MetricsTPUUserError, match="dist_reduce_fx"):
+        # min/max states must stay exact
+        from metrics_tpu import MaxMetric
+
+        MaxMetric().set_sync_precision({"value": "q8_block"})
+
+
+def test_constructor_kwarg_applies_policy_at_add_state():
+    m = MeanSquaredError(sync_precision="q8_block")
+    assert m.state_sync_precisions()["sum_squared_error"] == "q8_block"
+    assert m.state_sync_precisions()["total"] == "exact"
+    with pytest.raises(ValueError, match="unknown sync_precision"):
+        MeanSquaredError(sync_precision="fp4")
+    # a typo'd dict key never matches a registered state — the explicit-dict
+    # RAISES contract surfaces it as soon as the policy is actually read
+    # (silently staying exact would look like a missing payload win)
+    typo = MeanSquaredError(sync_precision={"sum_sq_error": "q8_block"})
+    with pytest.raises(MetricsTPUUserError, match="never registered"):
+        typo.state_sync_precisions()
+
+
+def test_policy_changes_metric_fingerprint():
+    from metrics_tpu.engine.aot import metric_fingerprint
+
+    assert metric_fingerprint(_coll()) != metric_fingerprint(_coll("q8_block"))
+
+
+# ------------------------------------------------------- AOT program identity
+
+
+def test_policies_sharing_one_cache_never_exchange_executables():
+    """The acceptance regression: two engines identical but for
+    ``sync_precision`` share one AotCache — every program key differs (the
+    precision component AND the fingerprint), so the second engine compiles
+    its own full set and both serve correct values."""
+    cache = AotCache()
+    batches = _batches()
+    engines, results = {}, {}
+    for tag, prec in (("exact", None), ("quantized", "q8_block")):
+        eng = StreamingEngine(_coll(prec), EngineConfig(buckets=(16,)), aot_cache=cache)
+        before = cache.misses
+        with eng:
+            for b in batches:
+                eng.submit(*b)
+            results[tag] = {k: np.asarray(v) for k, v in eng.result().items()}
+        engines[tag] = (eng, cache.misses - before)
+    # both engines compiled their own full program set — zero cross-policy hits
+    assert engines["exact"][1] >= 2
+    assert engines["quantized"][1] >= 2
+    tags = {key[-1] for key in cache.program_keys()}
+    assert "exact" in tags and any(t.startswith("q8:") for t in tags)
+    # off-mesh there is no collective to quantize: values agree exactly
+    for k in results["exact"]:
+        np.testing.assert_allclose(
+            results["quantized"][k], results["exact"][k], rtol=1e-6
+        )
+
+
+# ------------------------------------------------------------ at-rest codec
+
+
+def test_q8_array_roundtrip_within_bound():
+    rng = np.random.RandomState(0)
+    for shape in ((7,), (3, 11), (2, 5, 9)):
+        arr = (rng.randn(*shape) * 100).astype(np.float32)
+        enc = q8_encode_array(arr)
+        assert is_q8_leaf(enc)
+        back = q8_decode_array(enc)
+        assert back.shape == arr.shape and back.dtype == arr.dtype
+        bound = q8_sum_error_bound(arr.reshape(1, -1)).reshape(arr.shape)
+        assert bool((np.abs(back - arr) <= bound + 1e-30).all())
+    # compressed footprint: ~1 byte/elem + scales vs 4
+    big = rng.randn(4096).astype(np.float32)
+    enc = q8_encode_array(big)
+    nbytes = enc["codes"].nbytes + enc["scales"].nbytes
+    assert nbytes * 3 < big.nbytes
+
+
+def test_encode_state_tree_wraps_exactly_the_policy_states():
+    coll = _coll("q8_block")
+    state = coll.update_state(coll.init_state(), *map(jnp.asarray, _batches(1)[0]))
+    enc = encode_state_tree(coll, jax.device_get(state))
+    assert is_q8_leaf(enc["bap"]["TPs"])
+    assert not is_q8_leaf(enc["acc"]["correct"])
+    dec = decode_state_tree(enc)
+    np.testing.assert_array_equal(np.asarray(dec["acc"]["correct"]), np.asarray(state["acc"]["correct"]))
+    bound = q8_sum_error_bound(np.asarray(state["bap"]["TPs"])[None])
+    assert bool((np.abs(dec["bap"]["TPs"] - np.asarray(state["bap"]["TPs"])) <= bound + 1e-30).all())
+
+
+def test_arena_row_codec_roundtrip_all_leading_shapes():
+    coll = _coll("q8_block")
+    codec = ArenaRowCodec.for_metric(coll)
+    assert codec is not None
+    assert ArenaRowCodec.for_metric(_coll()) is None  # all-exact: no codec
+    layout = coll.arena_layout()
+    sizes = layout.buffer_sizes()
+    rng = np.random.RandomState(0)
+    for lead in ((), (5,), (2, 3)):
+        bufs = {
+            k: (rng.randn(*(lead + (n,))) * 10).astype(np.dtype(k))
+            if np.dtype(k).kind == "f"
+            else rng.randint(0, 100, lead + (n,)).astype(np.dtype(k))
+            for k, n in sizes.items()
+        }
+        enc = codec.encode_buffers(bufs)
+        assert codec.is_encoded(enc)
+        dec = codec.decode_buffers(enc)
+        assert set(dec) == set(bufs)
+        for k in bufs:
+            assert dec[k].shape == bufs[k].shape
+            if np.dtype(k).kind != "f":
+                np.testing.assert_array_equal(dec[k], bufs[k])
+            else:
+                # exact section byte-identical, quantized section within bound
+                mask = codec._q_mask.get(k)
+                if mask is None:
+                    np.testing.assert_array_equal(dec[k], bufs[k])
+                    continue
+                np.testing.assert_array_equal(dec[k][..., ~mask], bufs[k][..., ~mask])
+                q = bufs[k][..., mask].reshape(-1)
+                err = np.abs(dec[k][..., mask].reshape(-1) - q)
+                # per-row blocks: bound via the global absmax step
+                assert float(err.max()) <= float(np.abs(q).max()) / 127.0 + 1e-30
+
+
+# ----------------------------------------- compressed snapshots + integrity
+
+
+def test_compressed_snapshot_roundtrip_and_sidecar_over_compressed_bytes():
+    snapdir = tempfile.mkdtemp(prefix="quant_snap_")
+    batches = _batches()
+    eng = StreamingEngine(
+        _coll("q8_block"),
+        EngineConfig(buckets=(16,), snapshot_dir=snapdir, compress_payloads=True),
+    )
+    with eng:
+        for b in batches[:2]:
+            eng.submit(*b)
+        want_partial = {k: np.asarray(v) for k, v in eng.result().items()}
+        path = eng.snapshot()
+    state, meta = load_snapshot(snapdir)
+    assert meta["codec"] == "q8b32"
+    assert int(meta["packed"]) == 0  # compressed snapshots store the logical tree
+    # the payload on disk IS compressed: the wrapped leaf survives the codec
+    assert is_q8_leaf(jax.device_get(state)["bap"]["TPs"])
+
+    fresh = StreamingEngine(
+        _coll("q8_block"),
+        EngineConfig(buckets=(16,), snapshot_dir=snapdir, compress_payloads=True),
+    )
+    meta2 = fresh.restore(snapdir)
+    assert meta2["batches_done"] == 2
+    with fresh:
+        got = {k: np.asarray(v) for k, v in fresh.result().items()}
+    np.testing.assert_array_equal(got["acc"], want_partial["acc"])  # count-backed
+    np.testing.assert_allclose(got["bap"], want_partial["bap"], atol=5e-3)
+
+    # integrity: the sha256 sidecar verifies the COMPRESSED bytes — flip them
+    # and the typed corruption error names the generation
+    from metrics_tpu.engine.faults import corrupt_snapshot
+
+    corrupt_snapshot(path, np.random.RandomState(0), flips=16)
+    with pytest.raises(SnapshotCorruptError):
+        load_snapshot(path)
+
+
+def test_compressed_snapshot_restores_into_uncompressed_engine():
+    """compress_payloads is a WRITER property: a reader without the flag
+    still decodes (the tree form is self-describing)."""
+    snapdir = tempfile.mkdtemp(prefix="quant_snap_plain_")
+    batches = _batches()
+    eng = StreamingEngine(
+        _coll("q8_block"),
+        EngineConfig(buckets=(16,), snapshot_dir=snapdir, compress_payloads=True),
+    )
+    with eng:
+        for b in batches:
+            eng.submit(*b)
+        want = {k: np.asarray(v) for k, v in eng.result().items()}
+        eng.snapshot()
+    plain = StreamingEngine(_coll("q8_block"), EngineConfig(buckets=(16,)))
+    plain.restore(snapdir)
+    with plain:
+        got = {k: np.asarray(v) for k, v in plain.result().items()}
+    np.testing.assert_array_equal(got["acc"], want["acc"])
+    np.testing.assert_allclose(got["bap"], want["bap"], atol=5e-3)
+
+
+def test_stream_shard_restore_normalizes_spill_store_across_compression():
+    """A stream-shard snapshot restores across DIFFERENT compress_payloads
+    settings (same policy): the spill store is converted to the target
+    engine's storage form at restore, so later evictions never mix forms
+    (mixed forms broke snapshot_payload's per-key stacking)."""
+    from metrics_tpu.engine import MultiStreamEngine
+
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("dp",))
+    S, resident = 8, 2
+
+    def make(compress, snapdir):
+        return MultiStreamEngine(
+            _coll("q8_block"), num_streams=S,
+            config=EngineConfig(
+                buckets=(8,), mesh=mesh, axis="dp", mesh_sync="deferred",
+                coalesce=1, snapshot_dir=snapdir, compress_payloads=compress,
+            ),
+            stream_shard=True, resident_streams=resident,
+        )
+
+    rng = np.random.RandomState(0)
+    batches = []
+    for i in range(10):
+        p = rng.rand(4, 4).astype(np.float32)
+        p /= p.sum(axis=1, keepdims=True)
+        batches.append((i % S, p, rng.randint(0, 4, 4)))
+
+    for src_compress, dst_compress in ((True, False), (False, True)):
+        snapdir = tempfile.mkdtemp(prefix="quant_xcomp_")
+        src = make(src_compress, snapdir)
+        with src:
+            for sid, p, t in batches:
+                src.submit(sid, p, t)
+            src.snapshot()  # flushes first; rows must be spilled by now
+            assert src.stats.page_outs > 0
+            want = {s: np.asarray(src.results()[s]["acc"]) for s in range(S)}
+        dst = make(dst_compress, snapdir)
+        dst.restore(snapdir)
+        with dst:
+            # more traffic AFTER restore evicts rows in the target's own
+            # form — this is what used to mix forms and crash the stacking
+            for sid, p, t in batches[:6]:
+                dst.submit(sid, p, t)
+            res = dst.results()
+            dst.snapshot()  # stacks the (now uniform) spill store
+        for s in range(S):
+            assert np.isfinite(np.asarray(res[s]["acc"])) or np.isnan(want[s])
+
+
+# -------------------------------------------------- OpenMetrics payload split
+
+
+def test_payload_counters_render_and_parse_strict():
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("dp",))
+    eng = StreamingEngine(
+        _coll("q8_block"),
+        EngineConfig(buckets=(16,), mesh=mesh, axis="dp", mesh_sync="deferred"),
+    )
+    with eng:
+        for b in _batches(2):
+            eng.submit(*b)
+        eng.result()  # one boundary merge -> one payload record
+    assert eng.stats.sync_payload_quant_bytes > 0
+    assert eng.stats.sync_payload_exact_bytes > 0  # counts keep the exact rider
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+    from tools.trace_export import parse_openmetrics
+
+    fams = parse_openmetrics(eng.metrics_text())
+    fam = fams["metrics_tpu_engine_sync_payload_bytes"]
+    kinds = {s["labels"]["kind"]: s["value"] for s in fam["samples"]}
+    assert set(kinds) == {"exact", "quantized"}
+    assert kinds["quantized"] == eng.stats.sync_payload_quant_bytes
+    # summary block mirrors the split
+    assert eng.telemetry()["mesh_sync"]["sync_payload_bytes"]["quantized"] > 0
+
+
+def test_non_mesh_engines_keep_their_metrics_surface_stable():
+    eng = StreamingEngine(_coll("q8_block"), EngineConfig(buckets=(16,)))
+    with eng:
+        for b in _batches(2):
+            eng.submit(*b)
+        eng.result()
+    assert "sync_payload_bytes" not in eng.metrics_text()

@@ -24,12 +24,16 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from metrics_tpu.parallel.collectives import (
+    Q8_BLOCK,
     _from_carrier_u32,
     _from_sum_rider,
     _int_split_bits,
+    _q8_carrier,
+    _q8_sum_from_gathered,
     _to_carrier_u32,
     _to_sum_rider,
     fused_axis_sync,
+    q8_sum_error_bound,
     sync_axis_state,
 )
 from tests.helpers.testers import mesh_devices
@@ -164,6 +168,114 @@ def test_fuzz_carrier_roundtrip(dtype, shape):
             )
 
 
+# ---------------------------------------- quantized rider property suite
+
+
+def _simulated_q8_psum(values):
+    """What the quantized sum computes: each shard encodes (codes+scales
+    into the u32 carrier), the slabs stack like the all_gather would, and
+    the decode folds the dequantized contributions in f32."""
+    slabs = np.stack([np.asarray(_q8_carrier(jnp.asarray(v))) for v in values])
+    return np.asarray(_q8_sum_from_gathered(jnp.asarray(slabs), jnp.asarray(values[0])))
+
+
+def _f32_exact_sum(values):
+    return np.add.reduce([np.asarray(v, np.float32) for v in values], dtype=np.float32)
+
+
+def _assert_within_declared_bound(values, msg=""):
+    got = _simulated_q8_psum(values)
+    want = _f32_exact_sum(values)
+    bound = q8_sum_error_bound(np.stack([np.asarray(v, np.float32) for v in values]))
+    # small relative slack for the f32 fold itself (the bound is about
+    # quantization; the exact oracle and the decode may associate differently)
+    slack = 1e-5 * np.abs(want) + 1e-30
+    err = np.abs(got - want)
+    assert bool((err <= bound + slack).all()), (
+        f"{msg}: max err {err.max()} exceeds declared bound "
+        f"{(bound + slack)[err > bound + slack].min()}"
+    )
+
+
+@pytest.mark.parametrize("world", [1, 2, 8, 32])
+@pytest.mark.parametrize("n", [1, 31, 32, 33, 100, 257])
+def test_fuzz_q8_sum_within_declared_bound(world, n):
+    """Block-scaled int8 psum vs the f32-exact-sum oracle, across world
+    sizes and block-boundary-straddling leaf sizes, magnitudes spanning
+    1e-30..1e30 per shard: |err| <= the DECLARED per-element bound
+    (q8_sum_error_bound) — the same oracle every quantized gate asserts."""
+    rng = np.random.RandomState(world * 1000 + n)
+    for trial in range(10):
+        values = [
+            (rng.randn(n) * 10.0 ** rng.randint(-30, 30)).astype(np.float32)
+            for _ in range(world)
+        ]
+        _assert_within_declared_bound(values, f"world={world} n={n} trial={trial}")
+
+
+def test_q8_adversarial_magnitude_spreads():
+    """The adversarial cases the per-block scale exists for: a single
+    outlier inside one block (its scale must not poison NEIGHBOUR blocks),
+    mixed-sign cancellation, denormal blocks (flush-to-zero inside the
+    declared floor), and exact zeros (decode exactly zero)."""
+    n = 4 * Q8_BLOCK
+    # single-outlier block: huge value in block 0, tiny values elsewhere
+    outlier = np.full((WORLD, n), 1e-3, np.float32)
+    outlier[0, 3] = 1e30
+    values = list(outlier)
+    _assert_within_declared_bound(values, "single-outlier")
+    got = _simulated_q8_psum(values)
+    want = _f32_exact_sum(values)
+    # the outlier block saturates ITS scale, but other blocks keep relative
+    # precision: their absolute error stays tiny
+    other = slice(Q8_BLOCK, None)
+    assert np.abs(got[other] - want[other]).max() <= 1e-4
+
+    # mixed sign: +x and -x across shards must cancel to within the bound
+    base = np.random.RandomState(0).randn(n).astype(np.float32) * 100
+    _assert_within_declared_bound([base, -base] * (WORLD // 2), "mixed-sign")
+
+    # denormal-magnitude blocks flush to zero codes within the floor term
+    denorm = np.full((WORLD, n), 1e-40, np.float32)
+    _assert_within_declared_bound(list(denorm), "denormal")
+
+    # exact zeros decode to exact zeros (scale 0, codes 0)
+    zeros = [np.zeros((n,), np.float32) for _ in range(WORLD)]
+    assert np.array_equal(_simulated_q8_psum(zeros), np.zeros((n,), np.float32))
+
+    # the host-side round-trip helper IS the W=1 quantized sum: the at-rest
+    # codec's loss model and the wire rider's cannot drift apart
+    from metrics_tpu.parallel.collectives import q8_roundtrip
+
+    v = base.reshape(4, Q8_BLOCK)
+    np.testing.assert_array_equal(np.asarray(q8_roundtrip(v)), _simulated_q8_psum([v]))
+
+
+def test_q8_bound_is_meaningfully_tight():
+    """The declared bound must be a real bound, not a vacuous one: for unit-
+    scale data it stays within a few quantization steps per shard."""
+    rng = np.random.RandomState(1)
+    values = [rng.randn(64).astype(np.float32) for _ in range(WORLD)]
+    bound = q8_sum_error_bound(np.stack(values))
+    per_shard_step = np.abs(np.stack(values)).max() / 254.0
+    assert float(bound.max()) <= WORLD * per_shard_step + 1e-6
+
+
+def test_q8_rejects_ineligible_leaves():
+    """Quantization is for float 'sum' leaves ONLY: counts, cat buffers and
+    min/max states raise instead of silently riding a lossy payload."""
+    i32 = jnp.zeros((4,), jnp.int32)
+    f32 = jnp.zeros((4,), jnp.float32)
+    with pytest.raises(ValueError, match="float 'sum'"):
+        fused_axis_sync([("sum", i32)], "dp", precisions=["q8_block"])
+    with pytest.raises(ValueError, match="float 'sum'"):
+        fused_axis_sync([("cat", f32)], "dp", precisions=["q8_block"])
+    with pytest.raises(ValueError, match="float 'sum'"):
+        fused_axis_sync([("min", f32)], "dp", precisions=["q8_block"])
+    with pytest.raises(ValueError, match="unknown sync precision"):
+        fused_axis_sync([("sum", f32)], "dp", precisions=["fp4"])
+
+
 # -------------------------------------------- mesh oracle (one compile)
 
 
@@ -180,8 +292,11 @@ def test_fused_sync_matches_per_leaf_oracle_on_mesh(devices):
         def body(a, b, c, d, e, f):
             leaves = [(fx, v[0]) for fx, v in zip(fxs, (a, b, c, d, e, f))]
             fused = fused_axis_sync(leaves, "dp")
+            # explicit all-"exact" precisions must be the IDENTICAL program:
+            # the default path and the spelled-out exact policy cannot differ
+            explicit = fused_axis_sync(leaves, "dp", precisions=["exact"] * len(leaves))
             oracle = [sync_axis_state(fx, v[0], "dp") for fx, v in zip(fxs, (a, b, c, d, e, f))]
-            return tuple(fused), tuple(oracle)
+            return tuple(fused), tuple(explicit), tuple(oracle)
 
         return jax.shard_map(
             body, mesh=mesh,
@@ -198,6 +313,7 @@ def test_fused_sync_matches_per_leaf_oracle_on_mesh(devices):
             rng.randn(WORLD, 5).astype(np.float32),
             (rng.rand(WORLD, 2) > 0.5),
         )
-        fused, oracle = both(*args)
-        for fx, f, o in zip(fxs, fused, oracle):
+        fused, explicit, oracle = both(*args)
+        for fx, f, x, o in zip(fxs, fused, explicit, oracle):
             np.testing.assert_array_equal(np.asarray(f), np.asarray(o), err_msg=str(fx))
+            np.testing.assert_array_equal(np.asarray(f), np.asarray(x), err_msg=f"exact {fx}")
