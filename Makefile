@@ -2,9 +2,9 @@
 # Default flow runs the smoke checks (seconds) before the full suite.
 # Sidecar artifacts (telemetry JSON, analysis reports) land under out/
 # (gitignored) — never in the repo root.
-.PHONY: all test engine-smoke kernels-smoke mesh-smoke streams-smoke chaos-smoke obs-smoke quant-smoke analyze clean native bench
+.PHONY: all test engine-smoke kernels-smoke mesh-smoke streams-smoke chaos-smoke obs-smoke quant-smoke elastic-smoke analyze clean native bench
 
-all: engine-smoke kernels-smoke mesh-smoke streams-smoke chaos-smoke obs-smoke quant-smoke analyze test
+all: engine-smoke kernels-smoke mesh-smoke streams-smoke chaos-smoke obs-smoke quant-smoke elastic-smoke analyze test
 
 test:
 	python -m pytest tests/ -q
@@ -69,6 +69,20 @@ obs-smoke:
 # quant_smoke.py). Docs: docs/distributed.md "Quantized sync".
 quant-smoke:
 	JAX_PLATFORMS=cpu python -m metrics_tpu.engine.quant_smoke
+
+# Overload/elasticity gate, CPU-safe (bootstraps the 8-device virtual mesh,
+# metrics_tpu/engine/elastic_smoke.py): seeded Zipfian traffic with a mid-run
+# HOT-SPOT SHIFT overloads a resident-capped stream-sharded engine — the
+# overload detector trips on the spill rate, the degradation ladder walks
+# widen-coalesce → defer-cold-reads → SHED (a shed-class submit raises the
+# typed AdmissionRejected), an injected non-transient shard_loss auto-reshards
+# world 4→2 in place (snapshot-through-the-restore-matrix), a manual
+# reshard(world=4) grows back under traffic, the ladder de-escalates to level
+# 0 with a spill-free tail, and every NON-shed stream's results() is
+# bit-identical to a fault-free unsharded oracle. Docs: docs/serving.md
+# "Overload & elasticity".
+elastic-smoke:
+	JAX_PLATFORMS=cpu python -m metrics_tpu.engine.elastic_smoke
 
 # Static-analysis gate, CPU-safe (metrics_tpu/analysis + tools/analyze.py):
 # program plane audits the bootstrap engine matrix ({step,deferred} x

@@ -96,6 +96,28 @@ def bootstrap_engines(
             engine.result(0)
             engine.results()
         out.append((f"sshard/arena/multistream/{backend}", engine))
+        # POST-RESHARD engine (ISSUE 11): a live reshard() rebuilds every
+        # program against the new topology — the audited programs here are
+        # the ones a resharded engine actually serves with, so a reshard
+        # that smuggled a collective into the steady step (or broke arena
+        # fusion) fails the same named rules as a fresh build (broken-
+        # fixture proof: tests/analysis/test_engine_audit.py).
+        engine = StreamingEngine(
+            MetricCollection([Accuracy(), MeanSquaredError()]),
+            EngineConfig(
+                buckets=(8,), kernel_backend=backend,
+                mesh=mesh, axis="dp", mesh_sync="deferred",
+            ),
+        )
+        with engine:
+            for b in batches[:2]:
+                engine.submit(*b)
+            engine.flush()
+            engine.reshard(world=1)  # full snapshot->swap->restore cycle
+            for b in batches[2:]:
+                engine.submit(*b)
+            engine.result()
+        out.append((f"reshard/arena/single/{backend}", engine))
     return out
 
 

@@ -76,6 +76,12 @@ _ENGINE_LOCKED_METHODS = frozenset({
     "_run_padded_step", "_execute_payload", "_execute_routed", "_page_round",
     "_reset_locked", "_merged_state", "_latch_host_attrs",
     "_record_quarantine", "_screen_group",
+    # ISSUE 11: ladder rung application runs under the tick's lock hold;
+    # the topology swap/memo invalidation only run inside _reshard_locked
+    # (itself *_locked by convention) or the rung application
+    "_engage_rung", "_release_rung", "_engage_quantize", "_release_quantize",
+    "_refresh_policy_identity", "_apply_topology", "_apply_topology_state",
+    "_invalidate_topology_memos",
 })
 
 #: path-suffix -> declared discipline. The analyzer applies the spec whose

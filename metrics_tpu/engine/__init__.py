@@ -63,6 +63,13 @@ Quickstart::
 
 See ``docs/serving.md`` for the architecture and recovery semantics.
 """
+from metrics_tpu.engine.admission import (
+    AdmissionPolicy,
+    AdmissionRejected,
+    DegradationLadder,
+    OverloadDetector,
+    TokenBucket,
+)
 from metrics_tpu.engine.aot import AotCache, enable_persistent_compilation_cache
 from metrics_tpu.engine.arena import ArenaLayout
 from metrics_tpu.engine.bucketing import BucketPolicy
@@ -103,6 +110,8 @@ from metrics_tpu.engine.trace import (
 )
 
 __all__ = [
+    "AdmissionPolicy",
+    "AdmissionRejected",
     "AotCache",
     "ArenaLayout",
     "ArenaRowCodec",
@@ -110,6 +119,7 @@ __all__ = [
     "BoundaryMergeError",
     "BucketPolicy",
     "DEFAULT_LATENCY_BUCKETS_US",
+    "DegradationLadder",
     "EngineConfig",
     "EngineDispatchError",
     "EngineStats",
@@ -118,11 +128,13 @@ __all__ = [
     "FixedBucketHistogram",
     "InjectedFault",
     "MultiStreamEngine",
+    "OverloadDetector",
     "QuarantineRecord",
     "ScreenPolicy",
     "SnapshotCorruptError",
     "StepTimeoutError",
     "StreamingEngine",
+    "TokenBucket",
     "TraceRecorder",
     "decode_state_tree",
     "device_trace_session",

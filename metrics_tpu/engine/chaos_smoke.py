@@ -36,7 +36,14 @@ fault-free run on the same traffic:
    spilled backs the exact restore matrix {sharded+paged → same-world
    verbatim, → single-device merged} — plus the refusal of a plain snapshot
    into a sharded engine.
-7. **Dead dispatcher** — a fatal fault kills the dispatcher thread outright;
+7. **Elastic serving** (ISSUE 11) — on a 1-device deferred engine with a
+   generous admission policy: an ``admission``-site transient retries (the
+   check is pure in its input), a TRANSIENT suspected ``shard_loss`` rolls
+   back and retries in place, and a manual ``reshard()`` survives injected
+   ``reshard_snapshot``/``reshard_restore`` transients — results stay
+   bit-identical throughout (the non-transient shard loss with auto-reshard
+   is ``make elastic-smoke``'s 8-device claim).
+8. **Dead dispatcher** — a fatal fault kills the dispatcher thread outright;
    ``submit(timeout=)`` surfaces the sticky error instead of deadlocking,
    and ``reset()`` drains the dead queue and re-arms. A transient
    ``snapshot_read`` fault retries inside ``restore()``.
@@ -110,13 +117,27 @@ def chaos_injectors():
     ``snapshot_read`` (seed 11) the transient read fault under restore,
     ``merge`` (seed 13) the deferred boundary-merge failure,
     ``dispatcher_kill`` (seed 17) the fatal worker death, ``paging``
-    (seed 19) the stream-shard pager's spill/fault-in transients, and
+    (seed 19) the stream-shard pager's spill/fault-in transients,
     ``quant`` (seed 29) the at-rest codec's encode/decode transients
     (ISSUE 10 — both pure functions of their input, so a retry can never
-    double-apply scales)."""
+    double-apply scales), and ``elastic`` (seed 37) the ISSUE 11 sites:
+    an admission-check transient on the second submit, a TRANSIENT
+    suspected shard loss on the third chunk (rollback + in-place retry;
+    the non-transient loss with auto-reshard is ``make elastic-smoke``'s
+    8-device claim), and reshard capture/restore transients under a manual
+    ``reshard()``."""
     from metrics_tpu.engine import FaultInjector, FaultSpec
 
     return {
+        "elastic": FaultInjector(
+            seed=37,
+            plan={
+                "admission": FaultSpec(schedule=(1,)),
+                "shard_loss": FaultSpec(schedule=(2,)),
+                "reshard_snapshot": FaultSpec(schedule=(0,)),
+                "reshard_restore": FaultSpec(schedule=(0,)),
+            },
+        ),
         "quant": FaultInjector(
             seed=29,
             plan={
@@ -229,6 +250,26 @@ def quant_engine_config(injector, snapshot_dir, trace=None):
     return EngineConfig(
         buckets=(8, 32), coalesce=1, mesh=mesh, axis="dp", mesh_sync="deferred",
         snapshot_dir=snapshot_dir, compress_payloads=True,
+        fault_injector=injector, trace=trace,
+    )
+
+
+def elastic_engine_config(injector, trace=None):
+    """The overload/elasticity probe (ISSUE 11): deferred sync on a 1-device
+    mesh (the reshard and shard-loss sites only exist on a mesh) with a
+    GENEROUS AdmissionPolicy — the admission site is consulted only when a
+    policy is armed, and nothing ever rejects, so the chaos parity claim
+    stays bit-exact. ``coalesce=1`` + flush-per-submit in the phases keep
+    every site's occurrence index producer-timing-independent."""
+    import jax
+    from jax.sharding import Mesh
+
+    from metrics_tpu.engine import AdmissionPolicy, EngineConfig
+
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("dp",))
+    return EngineConfig(
+        buckets=(8, 32), coalesce=1, mesh=mesh, axis="dp", mesh_sync="deferred",
+        admission=AdmissionPolicy(rows_per_s=1e9, burst_rows=1e9),
         fault_injector=injector, trace=trace,
     )
 
@@ -470,6 +511,47 @@ def main(out_path: str = "out/chaos_telemetry.json") -> int:
         f"vs {want['MeanSquaredError']}",
     )
     fired_sites |= set(quant_inj.fired)
+
+    # ------------- elastic serving: admission + live reshard under chaos
+    # (ISSUE 11) the four self-defense sites fire transiently on a 1-device
+    # deferred engine and everything retries to a BIT-identical result: the
+    # admission check re-runs (pure in its input), a suspected shard loss
+    # rolls back and retries in place, and a manual reshard's capture and
+    # restore both survive an injected transient — the engine that comes out
+    # of reshard() serves the rest of the stream exactly.
+    elastic_inj = injs["elastic"]
+    ee = StreamingEngine(collection(), elastic_engine_config(elastic_inj, trace=rec))
+    with ee:
+        for b in clean[:3]:
+            ee.submit(*b)
+            ee.flush()  # occurrence indices stay producer-timing-independent
+        info = ee.reshard(world=1)  # reshard_snapshot/_restore fire + retry
+        for b in clean[3:]:
+            ee.submit(*b)
+            ee.flush()
+        got_el = {k: np.asarray(v) for k, v in ee.result().items()}
+    for k in want:
+        _check(
+            np.array_equal(got_el[k], want[k]),
+            f"elastic chaos parity: {k} {got_el[k]} != {want[k]}",
+        )
+    _check(
+        all(
+            elastic_inj.fired.get(site, 0) == 1
+            for site in ("admission", "shard_loss", "reshard_snapshot", "reshard_restore")
+        ),
+        f"elastic sites did not all fire exactly once: {dict(elastic_inj.fired)}",
+    )
+    _check(
+        ee.stats.reshards == 1 and info["to_world"] == 1,
+        f"reshard accounting wrong: {ee.stats.reshard_summary()} / {info}",
+    )
+    adm = ee.stats.admission_summary()
+    _check(
+        adm is not None and sum(adm["admitted_by_priority"].values()) == len(clean),
+        f"admission block did not admit every batch: {adm}",
+    )
+    fired_sites |= set(elastic_inj.fired)
 
     # ------------------- stream-sharded paging: spill/fault-in under chaos
     # (ISSUE 9) a resident-capped stream-sharded engine under seeded Zipfian

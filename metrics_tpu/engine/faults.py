@@ -61,17 +61,21 @@ __all__ = [
 # seeded sweep fires each of these at least once and the engine recovers to a
 # bit-identical result.
 FAULT_SITES = (
+    "admission",        # admission-control check on the submit path
     "ingest",           # dispatcher picked up a group, nothing folded yet
     "coalesce",         # megabatch drain — degrades to singleton groups
     "compile",          # AOT program build
     "step",             # device step completed, host commit pending
     "kernel",           # kernel backend failure -> pallas→xla demotion
+    "shard_loss",       # a mesh shard dies mid-step -> elastic reshard (ISSUE 11)
     "watchdog",         # per-step watchdog expiry (simulated stuck device)
     "merge",            # deferred-sync boundary merge
     "page_out",         # stream-paging spill: arena row -> host RAM
     "page_in",          # stream-paging fault-in: host RAM/init -> arena row
     "quant_encode",     # q8 state-at-rest encode (snapshot payload / spill row)
     "quant_decode",     # q8 state-at-rest decode (restore / fault-in / read)
+    "reshard_snapshot", # live reshard: in-memory topology snapshot capture
+    "reshard_restore",  # live reshard: restore into the target topology
     "snapshot_write",   # snapshot save fails before any bytes are durable
     "snapshot_corrupt", # snapshot saved, then payload bytes rot on disk
     "snapshot_read",    # transient restore-time read failure

@@ -81,6 +81,7 @@ import numpy as np
 
 from metrics_tpu.engine.aot import AotCache
 from metrics_tpu.engine.arena import ArenaLayout
+from metrics_tpu.engine.faults import InjectedFault
 from metrics_tpu.engine.paging import StreamPager
 from metrics_tpu.engine.pipeline import EngineConfig, StreamingEngine
 from metrics_tpu.engine.trace import ENGINE_TRACE
@@ -408,7 +409,13 @@ class MultiStreamEngine(StreamingEngine):
         # the base helper traces the submit when a recorder is attached —
         # _item_context puts the stream_id on the span (every span this
         # batch's journey produces carries it through the group context)
-        self._submit_item((sid, args, kwargs), timeout)
+        if self._admission is not None:
+            # per-STREAM admission: the token bucket and priority class are
+            # the stream's own — a shed class rejects here, typed, before
+            # the batch can consume a cursor (refunded if the enqueue fails)
+            self._admitted_submit(sid, (sid, args, kwargs), (args, kwargs), timeout)
+        else:
+            self._submit_item((sid, args, kwargs), timeout)
 
     # ---------------------------------------------------------- fault context
 
@@ -437,6 +444,39 @@ class MultiStreamEngine(StreamingEngine):
             self._stats.resident_streams = self._pager.resident_count()
             self._stats.spilled_streams = self._pager.spilled_count()
             self._stats.spilled_bytes = self._pager.spill_nbytes()
+
+    # ------------------------------------------------------------ elastic reshard
+
+    def _topology_state(self) -> Dict[str, Any]:
+        t = super()._topology_state()
+        if self._stream_shard:
+            t.update(
+                pager=self._pager,
+                resident=self._resident,
+                local_streams=self._local_streams,
+            )
+        return t
+
+    def _apply_topology_state(self, t: Dict[str, Any]) -> None:
+        super()._apply_topology_state(t)
+        if self._stream_shard:
+            self._pager = t["pager"]
+            self._resident = t["resident"]
+            self._local_streams = t["local_streams"]
+
+    def _apply_topology(
+        self, mesh: Any, world: int, policy: Any, resident_streams: Optional[int] = None,
+    ) -> None:
+        super()._apply_topology(mesh, world, policy)
+        if self._stream_shard:
+            # the stream-shard factor IS the world: re-derive the per-shard
+            # stream census and seat a FRESH pager — _restore_commit right
+            # after this re-homes every row (verbatim same-topology, spill-
+            # seeded otherwise)
+            self._local_streams = -(-self._num_streams // world)
+            r = int(resident_streams) if resident_streams is not None else self._resident
+            self._resident = min(max(1, r), self._local_streams)
+            self._pager = StreamPager(world, self._resident)
 
     def _execute_payload(
         self, merged: Tuple[Tuple[Any, ...], Dict[str, Any]], n: int,
@@ -559,12 +599,48 @@ class MultiStreamEngine(StreamingEngine):
                         np.searchsorted(uniq, locs)
                     ]
                 a_pad, kw_pad = jax.tree_util.tree_unflatten(treedef, out_leaves)
-                self._run_padded_step(
-                    (slot_ids,) + tuple(a_pad), kw_pad, mask, bucket, valid,
-                    n_coalesced if committed == 0 else 1,
-                    queue_wait_us if committed == 0 else 0.0,
-                    t0,
-                )
+                try:
+                    self._run_padded_step(
+                        (slot_ids,) + tuple(a_pad), kw_pad, mask, bucket, valid,
+                        n_coalesced if committed == 0 else 1,
+                        queue_wait_us if committed == 0 else 0.0,
+                        t0,
+                    )
+                except InjectedFault as e:
+                    target = (
+                        self._shard_loss_target()
+                        if e.site == "shard_loss" and not e.transient
+                        else None
+                    )
+                    if target is None:
+                        raise
+                    # a dead shard under routed serving: reshard to the
+                    # surviving world (rows re-home via the spill-seeded
+                    # restore matrix), then RE-ROUTE everything this group
+                    # has not committed — the routing tables (home order,
+                    # cursors, slot ids) were built for the dead topology
+                    # and cannot be patched in place. The recursive call
+                    # emits the group's ONE route span and inherits the
+                    # group accounting when nothing committed yet (a
+                    # partially-committed group already attributed its
+                    # coalesce count / queue wait to the committed rounds).
+                    self._reshard_locked(world=target, auto=True)
+                    rem = np.concatenate(
+                        [
+                            np.arange(int(cursors[w]), int(stops[w]), dtype=np.int64)
+                            for w in range(W)
+                        ]
+                    )
+                    rem_leaves = [
+                        np.asarray(l)[rem] if is_batch_leaf(l, n) else l for l in perm
+                    ]
+                    a_rem, kw_rem = jax.tree_util.tree_unflatten(treedef, rem_leaves)
+                    self._execute_routed(
+                        ((sids_o[rem],) + tuple(a_rem), kw_rem), int(rem.size),
+                        n_coalesced if committed == 0 else 1,
+                        queue_wait_us if committed == 0 else 0.0,
+                    )
+                    return
                 committed += 1
                 rounds += 1
                 self._stats.routed_steps += 1
@@ -574,7 +650,10 @@ class MultiStreamEngine(StreamingEngine):
                         self._pager.touch(w, [int(x) // W for x in sids_o[s0:s1]])
         except BaseException as e:  # noqa: BLE001 - shrink-on-retry contract
             try:
-                e._committed_chunks = committed
+                # accumulate: the shard-loss re-route nests one
+                # _execute_routed inside another, and the shrink-on-retry
+                # exactness gate needs the TOTAL committed count
+                e._committed_chunks = getattr(e, "_committed_chunks", 0) + committed
             except Exception:  # noqa: BLE001 - exotic exception without a dict
                 pass
             raise
@@ -826,6 +905,33 @@ class MultiStreamEngine(StreamingEngine):
                 out[k][g[keep]] = np.asarray(pager_payload[f"spill_{k}"])[keep]
         return out
 
+    def _seeded_pager_payload(
+        self, rows: Dict[str, np.ndarray], init_row: Dict[str, np.ndarray]
+    ) -> Dict[str, Any]:
+        """A pager payload (EMPTY slot table + spill store) carrying every
+        non-init stream row under THIS engine's ``(world, resident)`` homing
+        — the cross-topology half of the stream-shard restore matrix.
+        Init-equal rows are skipped (their streams fault in the init row like
+        any untouched stream); a row containing NaN compares unequal and
+        spills — conservative, never lossy."""
+        payload: Dict[str, Any] = {
+            "slots": np.full((self._world, self._resident), -1, np.int64)
+        }
+        keys = sorted(rows)
+        diff = np.zeros((self._num_streams,), bool)
+        for k in keys:
+            diff |= ~np.all(
+                np.asarray(rows[k]) == np.asarray(init_row[k])[None], axis=1
+            )
+        sids = np.nonzero(diff)[0].astype(np.int64)
+        if sids.size:
+            payload["spill_coords"] = np.stack(
+                [sids % self._world, sids // self._world], axis=1
+            ).astype(np.int64)
+            for k in keys:
+                payload[f"spill_{k}"] = np.asarray(rows[k])[sids]
+        return payload
+
     def result(self, stream_id: int) -> Any:  # type: ignore[override]
         """Flush, then compute ``stream_id``'s accumulated value. Unsharded:
         the shared compiled program with the stream index at runtime (under
@@ -834,6 +940,26 @@ class MultiStreamEngine(StreamingEngine):
         the host-spilled copy), never the whole state."""
         sid = self._check_stream(stream_id)
         tr = self._trace
+        if self._defer_cold_reads:
+            # ladder rung 'defer_cold_reads' (ISSUE 11): a COLD stream's read
+            # serves the last computed value instead of paying a row fetch /
+            # boundary merge while the engine is overloaded. Cold = not
+            # resident on its home shard (stream-sharded — the pager's own
+            # notion of cold); unsharded engines defer any repeat read. The
+            # staleness window closes when the ladder de-escalates (the rung
+            # release clears the cache), and writes invalidate per stream.
+            with self._state_lock:
+                cached = self._result_cache.get(sid)
+                cold = (
+                    self._pager.slot_of(*self._home(sid)) is None
+                    if self._stream_shard
+                    else True
+                )
+            if cached is not None and cold:
+                self._stats.record_deferred_read()
+                if tr is not None:
+                    tr.event("deferred_read", trace=ENGINE_TRACE, stream_id=sid)
+                return cached
         handle = (
             tr.begin("result", trace=ENGINE_TRACE, stream_id=sid) if tr is not None else None
         )
@@ -845,6 +971,10 @@ class MultiStreamEngine(StreamingEngine):
                 state = self._merged_state() if self._deferred else self._state
                 value = self._compute_program()(state, jnp.asarray(sid, jnp.int32))
             self._stats.result_device_calls += 1
+            if self._ladder is not None:
+                # the defer rung's staleness source: only ladder-armed
+                # engines pay the cache (zero cost otherwise)
+                self._result_cache[sid] = value
         if handle is not None:
             jax.block_until_ready(value)  # the SLO observable is value-in-hand
             tr.observe("result_latency_us", tr.end(handle))
@@ -890,6 +1020,7 @@ class MultiStreamEngine(StreamingEngine):
             with self._state_lock:
                 w, loc = self._home(sid)
                 self._pager.drop(w, loc)
+                self._result_cache.pop(sid, None)
                 self._state_version += 1
                 self._refresh_gauges()
             return
@@ -911,6 +1042,7 @@ class MultiStreamEngine(StreamingEngine):
                     self._unpack(self._state), init,
                 )
                 self._state = self._put_state(tree)
+            self._result_cache.pop(sid, None)
             self._state_version += 1
 
     def _reset_locked(self) -> None:
@@ -991,17 +1123,23 @@ class MultiStreamEngine(StreamingEngine):
     def _restore_commit(self, state: Any, meta: Dict[str, Any]) -> None:
         """The stream-shard restore matrix, covering EXACTLY:
 
-        * sharded+paged snapshot -> SAME-WORLD sharded engine (same S, world,
-          resident): verbatim — each shard resumes with exactly its resident
+        * sharded+paged snapshot -> SAME-(world, resident) sharded engine
+          (same S): verbatim — each shard resumes with exactly its resident
           slots and the pager with exactly its spilled rows, so replay from
           ``batches_done`` is bit-exact;
+        * sharded+paged snapshot -> sharded engine with a DIFFERENT world or
+          residency (grow/shrink — the live-reshard path, ISSUE 11): every
+          stream's row reassembles host-side and SEEDS the new pager's spill
+          store under the new ``sid % world`` homing; slots start empty and
+          rows fault in on first touch, bit-exactly (slot tables are
+          topology-local and cannot transfer, but the rows can);
         * sharded+paged snapshot -> SINGLE-DEVICE unsharded MultiStreamEngine
           (same S): the resident + spilled + init rows merge host-side into
           the (S, ...) stacked state.
 
-        Everything else refuses loudly (a different-world sharded engine
-        cannot inherit slot tables; a plain snapshot has no residency
-        provenance a sharded engine could seat).
+        Everything else refuses loudly (a plain snapshot has no residency
+        provenance a sharded engine could seat; a mesh target must be the
+        sharded engine itself).
         """
         snap_shard = bool(int(meta.get("stream_shard", 0) or 0))
         if not snap_shard and not self._stream_shard:
@@ -1059,18 +1197,36 @@ class MultiStreamEngine(StreamingEngine):
                 "layout; was the metric reconfigured since the snapshot?"
             )
         if self._stream_shard:
-            if world_snap != self._world or r_snap != self._resident:
-                raise MetricsTPUUserError(
-                    f"stream-sharded snapshots restore verbatim only into the SAME "
-                    f"(world, resident) topology — snapshot ({world_snap}, {r_snap}) vs "
-                    f"engine ({self._world}, {self._resident}); merge it through a "
-                    "single-device MultiStreamEngine instead"
-                )
-            new_state = self._put_state(arena, packed=True, stacked=True)
+            if world_snap == self._world and r_snap == self._resident:
+                new_state = self._put_state(arena, packed=True, stacked=True)
+                with self._state_lock:
+                    self._finish_restore(new_state, meta)
+                    self._pager.load_payload(
+                        self._normalized_pager_payload(pager_payload, snap_codec)
+                    )
+                    self._refresh_gauges()
+                return
+            # cross-topology (the grow/shrink half of the matrix): reassemble
+            # the (S, n) row matrices from the snapshot's parts and seed the
+            # NEW pager's spill store with every non-init row under this
+            # engine's homing rule — the arena starts all-init, rows fault in
+            # on first touch, and replay from the cursor stays bit-exact
+            init_row = {
+                k: np.asarray(v)
+                for k, v in row_layout.pack(
+                    jax.tree.map(jnp.asarray, self._metric.init_state())
+                ).items()
+            }
+            rows = self._rows_from_parts(
+                arena, self._decoded_pager_payload(pager_payload, codec=snap_codec),
+                init_row, self._num_streams, world_snap,
+            )
+            seeded = self._seeded_pager_payload(rows, init_row)
+            new_state = self._put_state(self._metric.init_state())
             with self._state_lock:
                 self._finish_restore(new_state, meta)
                 self._pager.load_payload(
-                    self._normalized_pager_payload(pager_payload, snap_codec)
+                    self._normalized_pager_payload(seeded, None)
                 )
                 self._refresh_gauges()
             return

@@ -18,16 +18,20 @@ CPU device (``make obs-smoke``):
    the ``histogram_accumulate`` dogfooding fold is
    ``tests/engine/test_trace.py`` (latencies are nondeterministic here, so
    a value-level cross-check has nothing stable to pin).
-3. **Span-sequence determinism** — the SAME seeded chaos plan (12 of the 13
-   fault sites: transactional rollback/retry, kernel demotion, watchdog,
-   contained snapshot failure + corruption + fallback restore with replay,
-   deferred boundary-merge retry, stream-shard ``page_out``/``page_in``
-   transients under seeded Zipfian traffic) runs TWICE into fresh recorders;
-   the canonical span sequences (timestamps excluded) must be IDENTICAL, and
-   both chaos results bit-identical to each other. This is the
-   occurrence-determinism contract: a chaos trace replays exactly.
+3. **Span-sequence determinism** — the SAME seeded chaos plan (all fault
+   sites but ``dispatcher_kill``: transactional rollback/retry, kernel
+   demotion, watchdog, contained snapshot failure + corruption + fallback
+   restore with replay, deferred boundary-merge retry, stream-shard
+   ``page_out``/``page_in`` transients under seeded Zipfian traffic, the
+   at-rest codec's ``quant_encode``/``quant_decode``, and the ISSUE 11
+   elastic sites — ``admission``, a transient suspected ``shard_loss``, and
+   ``reshard_snapshot``/``reshard_restore`` under a manual ``reshard()``)
+   runs TWICE into fresh recorders; the canonical span sequences
+   (timestamps excluded) must be IDENTICAL, and both chaos results
+   bit-identical to each other. This is the occurrence-determinism
+   contract: a chaos trace replays exactly.
 4. **Dead dispatcher** — a fatal ``dispatcher_kill`` under its own recorder
-   still produces its fault span event (the 13th site), completing coverage.
+   still produces its fault span event (the last site), completing coverage.
 
 Sidecars land under the gitignored ``out/`` per the repo's sidecar-hygiene
 convention. Prints one PASS line; exits nonzero on any violated claim.
@@ -69,6 +73,7 @@ def main(
         chaos_injectors,
         chaos_traffic,
         deferred_engine_config,
+        elastic_engine_config,
         kill_engine_config,
         make_checker,
         quant_engine_config,
@@ -200,9 +205,24 @@ def main(
             qeng.snapshot()
         qres = StreamingEngine(collection(), quant_engine_config(quant_inj, q_dir, trace=rec))
         qres.restore()
+        # elastic serving transients (ISSUE 11): admission check, suspected
+        # shard loss, and a manual reshard's capture/restore — flush after
+        # every submit so each site's occurrence index (and therefore the
+        # span sequence) is producer-timing-independent
+        elastic_inj = injs["elastic"]
+        ee = StreamingEngine(collection(), elastic_engine_config(elastic_inj, trace=rec))
+        with ee:
+            for b in clean[:3]:
+                ee.submit(*b)
+                ee.flush()
+            ee.reshard(world=1)
+            for b in clean[3:]:
+                ee.submit(*b)
+                ee.flush()
+            ee.result()
         sites = (
             set(inj.fired) | set(read_inj.fired) | set(merge_inj.fired)
-            | set(page_inj.fired) | set(quant_inj.fired)
+            | set(page_inj.fired) | set(quant_inj.fired) | set(elastic_inj.fired)
         )
         return rec, got, sites
 

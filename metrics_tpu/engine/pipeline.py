@@ -104,6 +104,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from metrics_tpu.engine.admission import (
+    AdmissionPolicy,
+    AdmissionRejected,
+    DegradationLadder,
+)
 from metrics_tpu.engine.aot import AotCache, metric_fingerprint
 from metrics_tpu.engine.arena import ArenaLayout
 from metrics_tpu.engine.bucketing import BucketPolicy
@@ -239,6 +244,31 @@ class EngineConfig:
             ``metrics_text()`` expose the Perfetto and OpenMetrics views.
             None (default) costs one ``is not None`` check per site —
             nothing else (the ``obs_overhead`` bench guards this).
+        admission: optional :class:`~metrics_tpu.engine.admission.
+            AdmissionPolicy` — SLO-aware admission control on the submit
+            path: per-stream token buckets with priority classes; a refused
+            submit raises the typed :class:`~metrics_tpu.engine.admission.
+            AdmissionRejected` with ``retry_after_s`` BEFORE the batch ever
+            queues (the replay cursor and exactness contracts never see it).
+            None (default) costs one ``is not None`` check per submit.
+        ladder: optional :class:`~metrics_tpu.engine.admission.
+            DegradationLadder` — the graceful-degradation policy. Once per
+            dispatcher group the engine feeds the ladder's overload detector
+            (p99 queue residency from the flight recorder's ``queue_wait_us``
+            histogram when one is attached, the stats ring otherwise; pager
+            spill rate; queue fill) and applies/releases rungs on its
+            deterministic transitions: widen ``coalesce_window_ms`` → force
+            ``sync_precision`` quantization for eligible states → defer
+            cold-stream ``result()`` reads → shed the lowest priority class
+            (needs ``admission``). Every transition is a ``ladder`` trace
+            event. None (default) costs one ``is not None`` check per group.
+        elastic_min_world: arm shard-loss auto-resharding: a non-transient
+            ``shard_loss`` fault (the chaos model of a dead shard) triggers
+            an in-place :meth:`StreamingEngine.reshard` to the largest
+            bucket-compatible world below the current one, never below this
+            floor — the dead shard degrades to a smaller world with the
+            surviving state intact instead of a dead engine. 0 (default) =
+            off: shard loss goes sticky like any other fault.
         compress_payloads: store state-at-rest through the block-scaled int8
             codec (``engine/quantize.py``): snapshot payloads carry codes +
             scales (codec id in meta, the sha256 sidecar hashes the
@@ -279,19 +309,35 @@ class EngineConfig:
     degrade_kernel: bool = True
     trace: Optional[TraceRecorder] = None
     compress_payloads: bool = False
+    admission: Optional[AdmissionPolicy] = None
+    ladder: Optional[DegradationLadder] = None
+    elastic_min_world: int = 0
 
 
 class StreamingEngine:
     """Drive a ``Metric``/``MetricCollection`` as a streaming service.
+
+    Class constant :data:`_LADDER_P99_EVERY` throttles the degradation
+    ladder's p99 queue-residency refresh (the expensive signal) to one read
+    per that many ticks — watermark tests don't need per-group freshness.
 
     Thread model: producers call :meth:`submit`; one dispatcher thread owns
     the device pipeline; :meth:`flush`/:meth:`result`/:meth:`state` join the
     queue before touching state, so reads never race the dispatcher.
     """
 
+    _LADDER_P99_EVERY = 8
+
     def __init__(self, metric: Any, config: Optional[EngineConfig] = None, aot_cache: Optional[AotCache] = None):
+        from dataclasses import replace
+
         self._metric = metric
-        self._cfg = config or EngineConfig()
+        # PRIVATE copy of the config: reshard() swaps cfg.mesh and the
+        # ladder's widen rung moves cfg.coalesce_window_ms — two engines
+        # constructed from one shared EngineConfig must never see each
+        # other's elasticity (shallow: injector/trace/policy objects are
+        # meant to be shared; only the scalar/mesh fields are engine-owned)
+        self._cfg = replace(config) if config is not None else EngineConfig()
         if self._cfg.mesh_sync not in ("step", "deferred"):
             raise MetricsTPUUserError(
                 f"mesh_sync must be 'step' or 'deferred', got {self._cfg.mesh_sync!r}"
@@ -328,6 +374,53 @@ class StreamingEngine:
             raise MetricsTPUUserError(
                 f"config.trace must be a TraceRecorder, got {type(self._cfg.trace).__name__}"
             )
+        if self._cfg.admission is not None and not isinstance(self._cfg.admission, AdmissionPolicy):
+            raise MetricsTPUUserError(
+                f"config.admission must be an AdmissionPolicy, got {type(self._cfg.admission).__name__}"
+            )
+        if self._cfg.ladder is not None and not isinstance(self._cfg.ladder, DegradationLadder):
+            raise MetricsTPUUserError(
+                f"config.ladder must be a DegradationLadder, got {type(self._cfg.ladder).__name__}"
+            )
+        if self._cfg.elastic_min_world < 0:
+            raise MetricsTPUUserError(
+                f"elastic_min_world must be >= 0, got {self._cfg.elastic_min_world}"
+            )
+        # ISSUE 11 self-defense layer: None (the default) keeps the hot path
+        # at one `is not None` check per site, matching the trace contract
+        self._admission = self._cfg.admission
+        self._ladder = self._cfg.ladder
+        if self._ladder is not None:
+            # a DegradationLadder is STATEFUL per engine (level, streaks, and
+            # the engine-side rung effects it drives): two engines advancing
+            # one ladder would each engage/release disjoint rung subsets and
+            # leave rungs stuck — refuse the rebind. (An AdmissionPolicy MAY
+            # be shared: that is a shared admission domain, by design.)
+            import weakref
+
+            owner = getattr(self._ladder, "_owner", None)
+            if owner is not None and owner() is not None and owner() is not self:
+                raise MetricsTPUUserError(
+                    "this DegradationLadder is already driving another engine; "
+                    "a ladder is stateful per engine — construct one per engine "
+                    "(share the AdmissionPolicy for a shared admission domain)"
+                )
+            self._ladder._owner = weakref.ref(self)
+        # serializes ladder state + rung application: ticks come from the
+        # dispatcher (per group) AND from producers on shed rejections
+        self._ladder_lock = threading.Lock()
+        self._ladder_marks = (0, 0)  # (steps, page_outs) at the last tick
+        self._ladder_ticks = 0
+        self._ladder_p99: Optional[float] = None  # throttled-memoized signal
+        self._ladder_saved_window = self._cfg.coalesce_window_ms
+        self._ladder_quantized = False
+        self._defer_cold_reads = False
+        self._result_cache: Dict[Any, Any] = {}
+        # submit-time enqueue stamps by object identity (ALWAYS on — a dict
+        # set/pop per submitted batch, dwarfed by the queue op itself): the
+        # oldest-item age BackpressureTimeout reports, and the residency
+        # floor recovery diagnostics start from
+        self._submit_stamps: Dict[int, float] = {}
         # the flight recorder: None (the default) means every site below is
         # one attribute load + None check — the whole disabled-path cost
         self._trace = self._cfg.trace
@@ -939,37 +1032,138 @@ class StreamingEngine:
         full for the whole window — the signature of a dead or wedged
         dispatcher behind live producers — the sticky dispatcher error is
         raised if one exists, else :class:`BackpressureTimeout`. ``None``
-        (default) keeps the pure-backpressure blocking contract."""
+        (default) keeps the pure-backpressure blocking contract.
+
+        With ``config.admission`` set, the batch must clear the admission
+        policy FIRST: a refusal raises the typed
+        :class:`~metrics_tpu.engine.admission.AdmissionRejected` (with
+        ``retry_after_s``) before anything queues — a rejected batch never
+        consumes a replay cursor."""
         self._raise_if_failed()
         self.start()
-        self._submit_item((args, kwargs), timeout)
+        if self._admission is not None:
+            self._admitted_submit(None, (args, kwargs), (args, kwargs), timeout)
+        else:
+            self._submit_item((args, kwargs), timeout)
+
+    def _admitted_submit(
+        self, stream_id: Optional[int], item: Any, payload: Any,
+        timeout: Optional[float],
+    ) -> None:
+        """The armed submit path: admit, enqueue, and only then count the
+        batch admitted — a REFUSED enqueue (BackpressureTimeout, a sticky
+        dispatcher raise) refunds the consumed tokens, so a producer that
+        times out under pressure is not double-charged on the retry."""
+        prio, rows = self._admit(stream_id, payload)
+        try:
+            self._submit_item(item, timeout)
+        except BaseException:
+            self._admission.refund(stream_id, rows, prio)
+            raise
+        self._stats.record_admission("admitted", prio)
+
+    def _admit(self, stream_id: Optional[int], payload: Any) -> Tuple[int, int]:
+        """Run one submit through the admission policy (armed path only);
+        returns ``(priority, rows)`` for the caller's success/refund
+        bookkeeping. The ``admission`` fault site models a transient
+        control-plane failure — pure in its inputs, so the bounded retry
+        re-checks cleanly; an actual rejection is counted by
+        outcome/priority and re-raised to the producer with the policy's
+        backoff hint."""
+        pol = self._admission
+        rows = self._item_rows_safe(payload)
+        rows = 0 if rows is None else int(rows)
+        inj = self._cfg.fault_injector
+
+        def admit_once() -> int:
+            if inj is not None:
+                try:
+                    inj.check("admission")
+                except BaseException:  # noqa: BLE001 - recorded, then re-raised
+                    self._stats.record_fault("admission")
+                    if self._trace is not None:
+                        self._trace.event("fault", trace=ENGINE_TRACE, site="admission")
+                    raise
+            return pol.admit(stream_id, rows)
+
+        # a PRODUCER-side retry loop, deliberately not _retry_transient: that
+        # policy belongs to the dispatcher thread — its retry events attribute
+        # to the dispatcher's current group, and its jittered backoff draws
+        # from the seeded stream chaos replay depends on; concurrent producer
+        # draws would corrupt both. Admission retries attribute to the engine
+        # track and back off without jitter.
+        attempt = 0
+        while True:
+            try:
+                prio = admit_once()
+                return prio, rows
+            except AdmissionRejected as e:
+                self._stats.record_admission("shed" if e.shed else "rejected", e.priority)
+                if self._trace is not None:
+                    self._trace.event(
+                        "admission_rejected", trace=ENGINE_TRACE,
+                        priority=e.priority, shed=e.shed,
+                        stream_id=stream_id,
+                    )
+                if e.shed and self._ladder is not None:
+                    # liveness: when the only remaining traffic is the shed
+                    # class, no group ever forms and the dispatcher never
+                    # ticks — a shed rejection ticks instead (the tick is
+                    # lock-guarded), so a recovered engine can de-escalate
+                    # and re-admit the class without manual intervention
+                    self._ladder_tick()
+                raise
+            except BaseException as e:  # noqa: BLE001 - classified by policy
+                if not is_transient(e) or attempt >= self._cfg.max_retries:
+                    raise
+                attempt += 1
+                self._stats.record_retry()
+                if self._trace is not None:
+                    self._trace.event("retry", trace=ENGINE_TRACE, attempt=attempt)
+                delay = min(
+                    max(0.0, self._cfg.backoff_max_ms),
+                    max(0.0, self._cfg.backoff_base_ms) * (2 ** (attempt - 1)),
+                ) / 1e3
+                if delay > 0:
+                    time.sleep(delay)
 
     def _submit_item(self, item: Any, timeout: Optional[float]) -> None:
         """Enqueue one queue item, tracing the submit when the recorder is
         on: the span's duration is the enqueue wait (backpressure made
         visible), and the trace id registered here is what the dispatcher's
         megabatch span links back to."""
+        # enqueue stamp, recorded only for TIMEOUT-bearing submits (the one
+        # consumer is BackpressureTimeout's oldest-item age; a plain blocking
+        # submit keeps the disabled-path contract at one None-equivalent
+        # check): popped at group pickup, on refused submits, and by the
+        # dead-dispatcher drain — exactly the _trace_ids lifecycle
+        if timeout is not None:
+            self._submit_stamps[id(item)] = time.monotonic()
         tr = self._trace
-        if tr is None:
-            self._enqueue(item, timeout)
-        else:
-            tid = tr.new_trace()
-            # the stamp starts the batch's queue residency clock: pickup time
-            # minus THIS is the trace's queue_wait (under enqueue backpressure
-            # it spans the blocked put too — the journey starts at submit, and
-            # the coalesce root only begins at pickup, so nothing double-counts
-            # into the end-to-end total)
-            self._trace_ids[id(item)] = [tid, time.perf_counter()]
-            ctx = {k: v for k, v in self._item_context(item).items() if v is not None}
-            handle = tr.begin("submit", trace=tid, **ctx)
-            try:
+        try:
+            if tr is None:
                 self._enqueue(item, timeout)
-            except BaseException:
-                # a refused submit is no batch: drop the id so a later item
-                # reusing the same object identity cannot inherit it
-                self._trace_ids.pop(id(item), None)
-                raise
-            tr.end(handle)
+            else:
+                tid = tr.new_trace()
+                # the stamp starts the batch's queue residency clock: pickup time
+                # minus THIS is the trace's queue_wait (under enqueue backpressure
+                # it spans the blocked put too — the journey starts at submit, and
+                # the coalesce root only begins at pickup, so nothing double-counts
+                # into the end-to-end total)
+                self._trace_ids[id(item)] = [tid, time.perf_counter()]
+                ctx = {k: v for k, v in self._item_context(item).items() if v is not None}
+                handle = tr.begin("submit", trace=tid, **ctx)
+                try:
+                    self._enqueue(item, timeout)
+                except BaseException:
+                    # a refused submit is no batch: drop the id so a later item
+                    # reusing the same object identity cannot inherit it
+                    self._trace_ids.pop(id(item), None)
+                    raise
+                tr.end(handle)
+        except BaseException:
+            self._submit_stamps.pop(id(item), None)
+            raise
         self._stats.batches_submitted += 1
 
     def _enqueue(self, item: Any, timeout: Optional[float]) -> None:
@@ -992,10 +1186,26 @@ class StreamingEngine:
             if remaining <= 0:
                 self._raise_if_failed()
                 alive = self._worker is not None and self._worker.is_alive()
+                # satellite (ISSUE 11): name the congestion coordinates —
+                # queue depth, in-flight device steps, and the oldest queued
+                # item's age — so a producer's timeout is diagnosable from
+                # the message alone, like EngineDispatchError's cursor/bucket
+                now = time.monotonic()
+                try:
+                    # includes THIS item's own stamp: with no older tracked
+                    # item the reported age is the caller's own wait — a
+                    # floor, never an invention (only timeout-bearing
+                    # submits stamp, so untracked items read as younger)
+                    stamps = list(self._submit_stamps.values())
+                except RuntimeError:  # racing dispatcher resize: age is best-effort
+                    stamps = []
+                oldest_s = (now - min(stamps)) if stamps else 0.0
                 raise BackpressureTimeout(
                     f"submit() timed out after {timeout}s: queue full "
-                    f"({self._queue.qsize()}/{max(1, self._cfg.max_queue)}) and the "
-                    f"dispatcher is {'alive but not draining' if alive else 'dead'}"
+                    f"({self._queue.qsize()}/{max(1, self._cfg.max_queue)} batches), "
+                    f"{len(self._inflight)} device steps in flight, oldest queued "
+                    f"item {oldest_s:.3f}s old, and the dispatcher is "
+                    f"{'alive but not draining' if alive else 'dead'}"
                 )
             try:
                 self._queue.put(item, timeout=min(0.05, remaining))
@@ -1144,6 +1354,24 @@ class StreamingEngine:
                 },
             )
         gauges = {"compiled_programs": aot["programs"]}
+        admission = s.admission_summary()
+        if admission is not None:
+            # admission-control families (ISSUE 11): verdicts by priority
+            # class + the ladder's gauge/counter pair — present only when an
+            # admission policy or ladder actually ran, so every pre-existing
+            # engine's exposition stays byte-stable
+            for fam, key in (
+                ("admission_admitted", "admitted_by_priority"),
+                ("admission_rejected", "rejected_by_priority"),
+                ("admission_shed", "shed_by_priority"),
+            ):
+                if admission[key]:
+                    labeled[fam] = ("priority", admission[key])
+            counters["ladder_transitions"] = admission["ladder_transitions"]
+            counters["deferred_reads"] = admission["deferred_reads"]
+            gauges["ladder_level"] = admission["ladder_level"]
+        if s.reshards:
+            counters["reshards"] = s.reshards
         if s.paging_summary() is not None:
             # stream-sharded serving: routing + LRU-paging telemetry joins the
             # exposition only when the engine actually routed (non-sharded
@@ -1182,6 +1410,7 @@ class StreamingEngine:
         to stale bookkeeping."""
         self._error = None
         self._inflight.clear()
+        self._result_cache.clear()
         self._state = self._put_state(self._init_state_tree())
         self._state_version += 1
         self._step = 0
@@ -1211,30 +1440,7 @@ class StreamingEngine:
             else None
         )
         self._fault("snapshot_write")
-        # the carried form: arena = 1 payload/dtype. Under deferred sync the
-        # payload is the SHARD-STACKED arena — every shard's local state, i.e.
-        # full provenance: the merged view is derivable (merge_stacked_states)
-        # but the locals are not recoverable from it, and exact kill/resume
-        # replay needs the locals (each shard must resume with ITS rows)
-        host_state = self._snapshot_state()
-        meta = {
-            "step": self._step,
-            "batches_done": self._batches_done,
-            "rows_in": self._stats.rows_in,
-            "rows_padded": self._stats.rows_padded,
-            # a compressed snapshot stores the LOGICAL (possibly shard-
-            # stacked) tree with codec-wrapped leaves, never the raw arena
-            "packed": int(self._layout is not None and not self._compress),
-            "arena_fp": self._layout.fingerprint() if self._layout is not None else "",
-            "mesh_sync": self._sync_tag() if self._cfg.mesh is not None else "single",
-            "world": self._world if self._deferred else 1,
-        }
-        if self._compress:
-            from metrics_tpu.engine.quantize import CODEC_ID
-
-            meta["codec"] = CODEC_ID
-            meta["codec_fp"] = self._precision_tag
-        meta.update(self._snapshot_meta_extra())
+        host_state, meta = self._snapshot_doc()
         path = save_snapshot(
             self._cfg.snapshot_dir,
             host_state,
@@ -1255,6 +1461,38 @@ class StreamingEngine:
                 tr.event("fault", site="snapshot_corrupt")
             corrupt_snapshot(path, inj.snapshot_rng())
         return path
+
+    def _snapshot_doc(self) -> Tuple[Any, Dict[str, Any]]:
+        """``(host_state, meta)``: the engine's durable form plus its
+        topology provenance — ONE builder shared by the on-disk snapshot
+        writer and :meth:`reshard`'s in-memory capture, so the live-reshard
+        path IS snapshot-through-the-restore-matrix, not a parallel codec.
+
+        The carried form: arena = 1 payload/dtype. Under deferred sync the
+        payload is the SHARD-STACKED arena — every shard's local state, i.e.
+        full provenance: the merged view is derivable (merge_stacked_states)
+        but the locals are not recoverable from it, and exact kill/resume
+        replay needs the locals (each shard must resume with ITS rows)."""
+        host_state = self._snapshot_state()
+        meta = {
+            "step": self._step,
+            "batches_done": self._batches_done,
+            "rows_in": self._stats.rows_in,
+            "rows_padded": self._stats.rows_padded,
+            # a compressed snapshot stores the LOGICAL (possibly shard-
+            # stacked) tree with codec-wrapped leaves, never the raw arena
+            "packed": int(self._layout is not None and not self._compress),
+            "arena_fp": self._layout.fingerprint() if self._layout is not None else "",
+            "mesh_sync": self._sync_tag() if self._cfg.mesh is not None else "single",
+            "world": self._world if self._deferred else 1,
+        }
+        if self._compress:
+            from metrics_tpu.engine.quantize import CODEC_ID
+
+            meta["codec"] = CODEC_ID
+            meta["codec_fp"] = self._precision_tag
+        meta.update(self._snapshot_meta_extra())
+        return host_state, meta
 
     def _snapshot_state(self) -> Any:
         """The host-side state payload a snapshot carries — by default the
@@ -1435,6 +1673,7 @@ class StreamingEngine:
             self._state_version += 1
             self._error = None
             self._inflight.clear()
+            self._result_cache.clear()
             # the replay cursor commits in the SAME critical section as the
             # state: a batch the dispatcher folds right after the lock drops
             # must land on top of both, or replay double-counts it
@@ -1466,9 +1705,17 @@ class StreamingEngine:
                 group, pending, saw_stop, drain_wait_us = self._coalesce_group(first)
                 wait_us += drain_wait_us  # window blocking is queue wait too
             tids = self._pop_trace_ids(group)  # even when draining: no leaks
+            self._pop_stamps(group)
             try:
                 if self._error is None:  # after a failure: drain without work
                     self._process_group(group, wait_us, tids)
+                    if self._ladder is not None:
+                        # the degradation ladder evaluates once per processed
+                        # group, BEFORE task_done: a flush() that joined the
+                        # queue must observe the settled ladder level (the
+                        # tick swallows its own failures into the sticky
+                        # error, never killing the dispatcher)
+                        self._ladder_tick()
             except BaseException as e:  # noqa: BLE001 - surfaced via _raise_if_failed
                 _attach_ctx(e, cursor=self._batches_done, **self._group_context(group))
                 self._error = e
@@ -1488,6 +1735,7 @@ class StreamingEngine:
                 # every join after a successful reset() hangs.
                 if pending is not None:
                     self._pop_trace_ids([pending])  # dropped item: free its id
+                    self._pop_stamps([pending])
                     self._queue.task_done()
                 if saw_stop:
                     self._queue.task_done()
@@ -1517,6 +1765,14 @@ class StreamingEngine:
                 out.append((entry[0], (now - entry[1]) * 1e6))
         return out
 
+    def _pop_stamps(self, group: List[Any]) -> None:
+        """Release the enqueue stamps of a picked-up (or dropped) group —
+        one truthiness check when no timeout-bearing submit ever stamped."""
+        if not self._submit_stamps:
+            return
+        for it in group:
+            self._submit_stamps.pop(id(it), None)
+
     def _join_queue(self) -> None:
         """``queue.join()`` that survives a DEAD dispatcher — including one
         that dies WHILE we wait. A live worker drains normally (we wait on
@@ -1539,6 +1795,7 @@ class StreamingEngine:
             # a drained item is a dropped batch: free its submit trace id,
             # or _trace_ids grows by one entry per recovery cycle forever
             self._trace_ids.pop(id(item), None)
+            self._submit_stamps.pop(id(item), None)
             self._queue.task_done()
         # items a dead dispatcher dequeued but never finished cannot be
         # recovered; zero the counter so later joins see a consistent queue
@@ -1741,7 +1998,7 @@ class StreamingEngine:
                 if not transient(e) or attempt >= self._cfg.max_retries:
                     raise
                 attempt += 1
-                self._stats.retries += 1
+                self._stats.record_retry()
                 if self._trace is not None:
                     self._trace.event(
                         "retry", trace=self._group_tid or ENGINE_TRACE, attempt=attempt,
@@ -1835,6 +2092,353 @@ class StreamingEngine:
                 raise err
             self._record_quarantine(it, n, cursor, reason)
         return kept
+
+    # ------------------------------------------------------- degradation ladder
+
+    def _ladder_signals(self) -> Dict[str, float]:
+        """The overload detector's feed for one tick. p99 queue residency
+        comes from the flight recorder's ``queue_wait_us`` histogram when one
+        is attached (the per-batch residency spans — ISSUE 8's definition),
+        from the stats ring's windowed ``queue_wait_us`` otherwise; the spill
+        rate is pager spill-outs per step over the tick window; queue fill is
+        instantaneous."""
+        s = self._stats
+        # the p99 read is THROTTLED (one refresh per _LADDER_P99_EVERY
+        # ticks, memoized between): the recorder-histogram path forces a
+        # pending-observation fold and the ring path a windowed sort —
+        # neither belongs on EVERY group of the dispatch loop, least of all
+        # while overloaded. Watermark tests only need bucket-fresh values.
+        self._ladder_ticks += 1
+        if self._ladder_p99 is None or self._ladder_ticks % self._LADDER_P99_EVERY == 1:
+            p99: Optional[float] = None
+            tr = self._trace
+            if tr is not None:
+                for h in tr.histograms():
+                    if h.name == "queue_wait_us":
+                        p99 = h.quantile(0.99)
+                        break
+            if p99 is None:
+                from metrics_tpu.engine.stats import _percentile
+
+                waits = sorted(
+                    float(r.get("queue_wait_us", 0.0)) for r in s.recent()[-128:]
+                )
+                p99 = _percentile(waits, 0.99) if waits else 0.0
+            self._ladder_p99 = float(p99) if p99 == p99 else 0.0  # NaN-safe
+        p99 = self._ladder_p99
+        last_steps, last_outs = self._ladder_marks
+        dsteps = s.steps - last_steps
+        spill_rate = (s.page_outs - last_outs) / dsteps if dsteps > 0 else 0.0
+        self._ladder_marks = (s.steps, s.page_outs)
+        return {
+            "queue_p99_us": p99,
+            "spill_rate": float(spill_rate),
+            "queue_depth_frac": self._queue.qsize() / max(1, self._cfg.max_queue),
+        }
+
+    def _ladder_tick(self) -> None:
+        """One ladder evaluation — once per processed group on the dispatcher
+        thread, plus on producer-side SHED rejections (the liveness path for
+        shed-only traffic), so the whole tick serializes under the ladder
+        lock. A transition applies/releases exactly one rung under the state
+        lock and is emitted as a ``ladder`` trace event — the deterministic
+        record same-seed replay compares."""
+        try:
+            with self._ladder_lock:
+                move = self._ladder.tick(self._ladder_signals())
+                if move is None:
+                    return
+                frm, to = move
+                with self._state_lock:
+                    if to > frm:
+                        self._engage_rung(self._ladder.rung(to))
+                    else:
+                        self._release_rung(self._ladder.rung(frm))
+                self._stats.ladder_transitions += 1
+                self._stats.ladder_level = to
+            if self._trace is not None:
+                self._trace.event(
+                    "ladder", trace=ENGINE_TRACE,
+                    action="escalate" if to > frm else "deescalate",
+                    level=to, rung=self._ladder.rung(max(frm, to)),
+                )
+        except BaseException as e:  # noqa: BLE001 - surface, don't kill silently
+            _attach_ctx(e, cursor=self._batches_done)
+            self._error = e
+
+    def _engage_rung(self, rung: str) -> None:
+        """Apply one ladder rung (state lock held). Rungs are deliberately
+        idempotent and reversible; a rung that does not apply to this engine
+        kind (shed without an admission policy, quantize off-mesh) is a
+        recorded no-op — the transition event still fires, so the ladder's
+        deterministic walk is identical across engine kinds."""
+        if rung == "widen_coalesce":
+            self._ladder_saved_window = self._cfg.coalesce_window_ms
+            self._cfg.coalesce_window_ms = max(
+                self._cfg.coalesce_window_ms, self._ladder.widen_window_ms
+            )
+        elif rung == "quantize_sync":
+            self._engage_quantize()
+        elif rung == "defer_cold_reads":
+            self._defer_cold_reads = True
+        elif rung == "shed":
+            if self._admission is not None:
+                self._admission.shed_lowest(True)
+
+    def _release_rung(self, rung: str) -> None:
+        if rung == "widen_coalesce":
+            self._cfg.coalesce_window_ms = self._ladder_saved_window
+        elif rung == "quantize_sync":
+            self._release_quantize()
+        elif rung == "defer_cold_reads":
+            self._defer_cold_reads = False
+            self._result_cache.clear()
+        elif rung == "shed":
+            if self._admission is not None:
+                self._admission.shed_lowest(False)
+
+    def _engage_quantize(self) -> None:
+        """The quantize rung: force the blanket ``q8_block`` sync policy for
+        ELIGIBLE states (float sum accumulators — counts/cat/min-max always
+        stay exact, PR 10's contract) while engaged. Mesh engines only (the
+        policy governs the sync bundle) and only from a fully-exact baseline
+        — an operator-set policy is never overridden. The policy is a trace
+        constant, so engaging REFRESHES the fingerprint and every program
+        key: the quantized programs recompile rather than collide."""
+        m = self._metric
+        if (
+            self._cfg.mesh is None
+            or not hasattr(m, "set_sync_precision")
+            or self._precision_tag != "exact"
+            # the at-rest codec's identity (codec_fp in snapshot meta, the
+            # stream-shard row codec) is CONSTRUCTION-pinned: engaging a
+            # transient policy under compress_payloads would write snapshots
+            # a same-config replacement engine refuses — the rung only
+            # toggles the WIRE sync, whose identity travels in program keys
+            or self._compress
+        ):
+            return
+        m.set_sync_precision("q8_block")
+        if m.sync_precision_tag() != "exact":
+            self._ladder_quantized = True
+            self._refresh_policy_identity()
+
+    def _release_quantize(self) -> None:
+        if self._ladder_quantized:
+            self._metric.set_sync_precision("exact")
+            self._ladder_quantized = False
+            self._refresh_policy_identity()
+
+    def _refresh_policy_identity(self) -> None:
+        self._precision_tag = self._metric.sync_precision_tag()
+        self._metric_fp = metric_fingerprint(self._metric)
+        self._program_memo.clear()
+        self._payload_split = None
+        self._merged_memo = None
+
+    # ---------------------------------------------------------- elastic reshard
+
+    def reshard(
+        self,
+        *,
+        world: Optional[int] = None,
+        mesh: Optional[Any] = None,
+        resident_streams: Optional[int] = None,
+        stream_shard: Optional[bool] = None,
+    ) -> Dict[str, Any]:
+        """Live elastic resharding: grow/shrink the mesh world (or the
+        stream-shard factor) WITHOUT losing state, under traffic.
+
+        Implemented as snapshot-through-the-restore-matrix: drain in-flight
+        work, capture the engine's durable form in memory WITH topology
+        provenance (the exact document :meth:`snapshot` writes), swap the
+        topology (mesh, world, bucket divisor, program identity), and restore
+        the captured state through the cross-topology restore matrix — delta
+        states merge/embed exactly; ``cat``/scan states refuse loudly across
+        worlds (their per-shard capacity buffers have no exact re-shard), and
+        a refusal ROLLS BACK to the captured topology, so the engine keeps
+        serving as it was. Stream-sharded engines re-home every stream under
+        the new ``sid % world`` rule by seeding the new pager's spill store;
+        rows fault back in on first touch, bit-exactly.
+
+        Pass ``world=`` (single-axis meshes; devices come from the running
+        backend) or an explicit ``mesh=``; stream-sharded engines may also
+        change ``resident_streams``. Returns ``{"from_world", "to_world",
+        "cursor"}``; the cursor is unchanged — no replay is needed for a
+        manual reshard (everything submitted was folded before the drain).
+        Also the recovery move behind the ``shard_loss`` fault site (see
+        ``config.elastic_min_world``)."""
+        self._join_queue()
+        with self._state_lock:
+            return self._reshard_locked(
+                world=world, mesh=mesh, resident_streams=resident_streams,
+                stream_shard=stream_shard, auto=False,
+            )
+
+    def _reshard_locked(
+        self,
+        *,
+        world: Optional[int] = None,
+        mesh: Optional[Any] = None,
+        resident_streams: Optional[int] = None,
+        stream_shard: Optional[bool] = None,
+        auto: bool = False,
+    ) -> Dict[str, Any]:
+        if stream_shard is not None and bool(stream_shard) != bool(
+            getattr(self, "_stream_shard", False)
+        ):
+            raise MetricsTPUUserError(
+                "toggling stream sharding live is not supported: snapshot this "
+                "engine and restore into a newly-constructed one with the "
+                "desired stream_shard setting"
+            )
+        if resident_streams is not None and not getattr(self, "_stream_shard", False):
+            raise MetricsTPUUserError(
+                "resident_streams only applies to stream-sharded engines"
+            )
+        if resident_streams is not None and int(resident_streams) <= 0:
+            raise MetricsTPUUserError(
+                f"resident_streams must be positive, got {resident_streams!r}"
+            )
+        if self._cfg.mesh is None:
+            raise MetricsTPUUserError(
+                "reshard() needs a mesh engine (a single-device engine has no "
+                "topology to change); construct with EngineConfig(mesh=...)"
+            )
+        new_mesh, new_world = self._target_mesh(world, mesh)
+        # bucket divisibility validates BEFORE anything mutates: a bad target
+        # world refuses (typed) with the engine untouched
+        try:
+            new_policy = BucketPolicy(
+                self._cfg.buckets, pad_value=self._cfg.pad_value, divisor=new_world
+            )
+        except ValueError as e:
+            raise MetricsTPUUserError(
+                f"reshard(world={new_world}) is incompatible with the declared "
+                f"buckets {self._cfg.buckets}: {e}"
+            ) from e
+        old_world = self._world
+
+        def capture() -> Tuple[Any, Dict[str, Any]]:
+            self._fault("reshard_snapshot")
+            return self._snapshot_doc()
+
+        state, meta = self._retry_transient(capture)
+        old_topo = self._topology_state()
+        self._apply_topology(new_mesh, new_world, new_policy, resident_streams)
+
+        def commit() -> None:
+            self._fault("reshard_restore")
+            self._restore_commit(state, meta)
+
+        try:
+            self._retry_transient(commit)
+        except BaseException:
+            # refusals stay loud AND non-destructive: fall back to the
+            # captured topology and recommit the same document verbatim —
+            # the engine keeps serving exactly as it was
+            self._apply_topology_state(old_topo)
+            self._restore_commit(state, meta)
+            raise
+        self._stats.record_reshard(old_world, new_world, self._batches_done, auto)
+        if self._trace is not None:
+            self._trace.event(
+                "reshard", trace=ENGINE_TRACE, from_world=old_world,
+                to_world=new_world, cursor=self._batches_done, auto=auto,
+            )
+        return {
+            "from_world": old_world,
+            "to_world": new_world,
+            "cursor": self._batches_done,
+        }
+
+    def _target_mesh(self, world: Optional[int], mesh: Optional[Any]) -> Tuple[Any, int]:
+        """Resolve the reshard target: an explicit mesh (must carry the
+        engine's axes), or the first ``world`` live devices of the current
+        platform on the engine's single axis."""
+        if mesh is not None:
+            names = set(getattr(mesh, "axis_names", ()))
+            missing = [a for a in self._axis_names() if a not in names]
+            if missing:
+                raise MetricsTPUUserError(
+                    f"target mesh lacks the engine's sync axes {missing} "
+                    f"(mesh axes: {sorted(names)})"
+                )
+            w = int(np.prod([mesh.shape[a] for a in self._axis_names()]))
+            return mesh, w
+        if world is None:
+            raise MetricsTPUUserError("reshard() needs world= or mesh=")
+        w = int(world)
+        if w <= 0:
+            raise MetricsTPUUserError(f"world must be positive, got {world!r}")
+        axes = self._axis_names()
+        if len(axes) != 1:
+            raise MetricsTPUUserError(
+                "reshard(world=...) supports single-axis meshes; pass an "
+                "explicit mesh= for multi-axis topologies"
+            )
+        from jax.sharding import Mesh
+
+        platform = self._cfg.mesh.devices.flat[0].platform
+        devs = [d for d in jax.devices() if d.platform == platform]
+        if w > len(devs):
+            raise MetricsTPUUserError(
+                f"reshard(world={w}) exceeds the {len(devs)} available "
+                f"{platform} devices"
+            )
+        return Mesh(np.asarray(devs[:w]), axes), w
+
+    def _topology_state(self) -> Dict[str, Any]:
+        """Everything a reshard rollback must put back (subclasses extend:
+        the stream-sharded engine adds its pager/residency)."""
+        return {
+            "mesh": self._cfg.mesh,
+            "world": self._world,
+            "policy": self._policy,
+            "serialize": self._serialize,
+        }
+
+    def _apply_topology_state(self, t: Dict[str, Any]) -> None:
+        self._cfg.mesh = t["mesh"]
+        self._world = t["world"]
+        self._policy = t["policy"]
+        self._serialize = t["serialize"]
+        self._invalidate_topology_memos()
+
+    def _apply_topology(
+        self, mesh: Any, world: int, policy: BucketPolicy,
+        resident_streams: Optional[int] = None,
+    ) -> None:
+        """Swap the live topology (state lock held). The captured snapshot
+        doc still describes the OLD topology; ``_restore_commit`` right after
+        this is what moves the state across."""
+        self._cfg.mesh = mesh
+        self._world = world
+        self._policy = policy
+        self._serialize = (
+            mesh.devices.flat[0].platform == "cpu" and not self._deferred
+        )
+        self._invalidate_topology_memos()
+
+    def _invalidate_topology_memos(self) -> None:
+        # every program key embeds the mesh; the merge template and payload
+        # accounting embed the world — all of it rebuilds lazily
+        self._program_memo.clear()
+        self._merged_abs_memo = None
+        self._merged_memo = None
+        self._payload_split = None
+
+    def _shard_loss_target(self) -> Optional[int]:
+        """The world a shard-loss auto-reshard shrinks to: the largest
+        bucket-divisor-compatible world strictly below the current one, never
+        under ``config.elastic_min_world`` (0 disarms). None = go sticky."""
+        lo = int(self._cfg.elastic_min_world)
+        if lo <= 0 or self._cfg.mesh is None:
+            return None
+        for w in range(self._world - 1, lo - 1, -1):
+            if all(b % w == 0 for b in self._cfg.buckets):
+                return w
+        return None
 
     # -------------------------------------------------------------------- processing
 
@@ -1987,16 +2591,37 @@ class StreamingEngine:
         try:
             first_chunk = True
             for start, stop, bucket in self._policy.chunks(int(n)):
-                self._execute_chunk(
-                    args, kwargs, start, stop, bucket,
-                    n_coalesced if first_chunk else 1,
-                    queue_wait_us if first_chunk else 0.0,
-                )
+                while True:
+                    try:
+                        self._execute_chunk(
+                            args, kwargs, start, stop, bucket,
+                            n_coalesced if first_chunk else 1,
+                            queue_wait_us if first_chunk else 0.0,
+                        )
+                        break
+                    except InjectedFault as e:  # noqa: PERF203 - recovery path
+                        target = (
+                            self._shard_loss_target()
+                            if e.site == "shard_loss" and not e.transient
+                            else None
+                        )
+                        if target is None:
+                            raise
+                        # a dead shard becomes a smaller world: the fault
+                        # fires BEFORE the step executes (nothing folded),
+                        # the carried state crosses through the restore
+                        # matrix, and THIS chunk re-pads and re-runs on the
+                        # surviving topology (the bucket set is unchanged —
+                        # _shard_loss_target guarantees divisibility)
+                        self._reshard_locked(world=target, auto=True)
                 committed += 1
                 first_chunk = False
         except BaseException as e:  # noqa: BLE001
             try:
-                e._committed_chunks = committed
+                # ACCUMULATE (don't overwrite): a shard-loss re-dispatch may
+                # nest one _execute_* inside another — the shrink-on-retry
+                # exactness gate needs the TOTAL committed count
+                e._committed_chunks = getattr(e, "_committed_chunks", 0) + committed
             except Exception:  # noqa: BLE001 - exotic exception without a dict
                 pass
             raise
@@ -2060,6 +2685,12 @@ class StreamingEngine:
             # the kernel site models a runtime kernel-backend failure —
             # meaningless for an engine already on the reference lowering
             self._fault("kernel")
+        if self._cfg.mesh is not None:
+            # a shard dying is only meaningful on a mesh; consulted BEFORE
+            # the step executes, so nothing has folded when it fires — a
+            # non-transient loss retries the chunk on the SURVIVING world
+            # (auto-reshard, config.elastic_min_world) with zero rollback debt
+            self._fault("shard_loss")
         if tr is None:
             program = self._update_program(payload, mask)
         else:
@@ -2164,7 +2795,7 @@ class StreamingEngine:
             return True
         if not is_transient(e) or attempt >= self._cfg.max_retries:
             return False
-        self._stats.retries += 1
+        self._stats.record_retry()
         if tr is not None:
             tr.event(
                 "retry", trace=self._group_tid or ENGINE_TRACE, attempt=attempt + 1,

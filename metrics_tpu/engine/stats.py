@@ -13,6 +13,7 @@ timeshared virtual mesh is host noise, not signal (docs/benchmarking.md,
 import json
 import math
 import os
+import threading
 from typing import Any, Dict, List, Optional
 
 from metrics_tpu.engine.bucketing import BucketPolicy
@@ -106,6 +107,95 @@ class EngineStats:
         # host-RAM bytes of the spill store at the last gauge refresh — the
         # footprint compress_payloads quantizes (ISSUE 10)
         self.spilled_bytes = 0
+        # admission control + degradation ladder + elastic resharding
+        # (ISSUE 11). Outcome counters are keyed by PRIORITY CLASS and bumped
+        # from concurrent producer threads — a bare `dict[k] += 1` is a
+        # read-modify-write the GIL does not make atomic, so these go through
+        # record_admission under a dedicated lock (counter semantics pinned
+        # under concurrent submits in tests/engine/test_admission.py).
+        self._admission_lock = threading.Lock()
+        self.admission_admitted: Dict[int, int] = {}
+        self.admission_rejected: Dict[int, int] = {}
+        self.admission_shed: Dict[int, int] = {}
+        # ladder_level is a gauge (current rung count engaged); transitions a
+        # lifetime counter; deferred_reads counts result() calls served from
+        # the stale-read cache while the defer_cold_reads rung was engaged.
+        self.ladder_level = 0
+        self.ladder_transitions = 0
+        self.deferred_reads = 0
+        # live elastic resharding: count + the last transition's coordinates
+        # (from/to world, replay cursor) — what engine_report surfaces
+        self.reshards = 0
+        self.reshard_last: Optional[Dict[str, Any]] = None
+
+    def record_admission(self, outcome: str, priority: int) -> None:
+        """One admission verdict (``"admitted"``/``"rejected"``/``"shed"``)
+        for a submit in ``priority`` class — called from producer threads,
+        so the bump is serialized under the admission lock."""
+        target = {
+            "admitted": self.admission_admitted,
+            "rejected": self.admission_rejected,
+            "shed": self.admission_shed,
+        }[outcome]
+        with self._admission_lock:
+            target[int(priority)] = target.get(int(priority), 0) + 1
+
+    def record_retry(self) -> None:
+        """One bounded-retry attempt. Locked: since ISSUE 11 admission-site
+        retries come from PRODUCER threads concurrently with the
+        dispatcher's step/merge retries — a bare ``+=`` can lose one."""
+        with self._admission_lock:
+            self.retries += 1
+
+    def record_deferred_read(self) -> None:
+        """One stale read served by the defer_cold_reads rung — reader
+        threads call ``result()`` concurrently, so the bump locks."""
+        with self._admission_lock:
+            self.deferred_reads += 1
+
+    def record_reshard(self, from_world: int, to_world: int, cursor: int, auto: bool) -> None:
+        """One live reshard transition (manual or shard-loss-triggered)."""
+        self.reshards += 1
+        self.reshard_last = {
+            "from_world": int(from_world),
+            "to_world": int(to_world),
+            "cursor": int(cursor),
+            "auto": bool(auto),
+        }
+
+    def admission_summary(self) -> Optional[Dict[str, Any]]:
+        """The admission/ladder block for :meth:`summary` — None when the
+        engine ran with neither an admission policy nor a ladder (every
+        pre-ISSUE-11 engine: its telemetry document is unchanged). Priority
+        keys stringify for JSON round-trip stability."""
+        with self._admission_lock:
+            admitted = dict(self.admission_admitted)
+            rejected = dict(self.admission_rejected)
+            shed = dict(self.admission_shed)
+        if (
+            not (admitted or rejected or shed)
+            and not self.ladder_transitions
+            and not self.ladder_level
+            and not self.deferred_reads
+        ):
+            return None
+        return {
+            "admitted_by_priority": {str(k): v for k, v in sorted(admitted.items())},
+            "rejected_by_priority": {str(k): v for k, v in sorted(rejected.items())},
+            "shed_by_priority": {str(k): v for k, v in sorted(shed.items())},
+            "ladder_level": self.ladder_level,
+            "ladder_transitions": self.ladder_transitions,
+            "deferred_reads": self.deferred_reads,
+        }
+
+    def reshard_summary(self) -> Optional[Dict[str, Any]]:
+        """The elastic-reshard block — None until the engine resharded."""
+        if not self.reshards:
+            return None
+        out: Dict[str, Any] = {"reshards": self.reshards}
+        if self.reshard_last is not None:
+            out["last"] = dict(self.reshard_last)
+        return out
 
     def record_fault(self, site: str) -> None:
         """One injected fault fired at ``site`` (chaos harness accounting)."""
@@ -259,6 +349,12 @@ class EngineStats:
         paging = self.paging_summary()
         if paging is not None:
             out["paging"] = paging
+        admission = self.admission_summary()
+        if admission is not None:
+            out["admission"] = admission
+        reshard = self.reshard_summary()
+        if reshard is not None:
+            out["reshard"] = reshard
         faults = self.fault_summary()
         if faults is not None:
             out["faults"] = faults

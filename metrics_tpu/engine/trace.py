@@ -194,6 +194,26 @@ class FixedBucketHistogram:
         with self._lock:
             return self._counts.copy()
 
+    def quantile(self, q: float) -> float:
+        """Bucket-resolution quantile over the folded counts: the upper edge
+        of the bucket where the cumulative count crosses ``q`` (the +Inf
+        bucket reports the last finite edge — a floor, never an invented
+        value). 0.0 when empty. This is the overload detector's
+        queue-residency feed (``engine/admission.py``): watermark tests only
+        need bucket resolution, and the folded counts are the cheapest
+        consistent view the recorder has."""
+        counts = self.bucket_counts()
+        total = int(counts.sum())
+        if total == 0:
+            return 0.0
+        target = float(q) * total
+        cum = 0
+        for i, c in enumerate(counts):
+            cum += int(c)
+            if cum >= target:
+                return float(self.edges[min(i, len(self.edges) - 1)])
+        return float(self.edges[-1])
+
     def snapshot(self) -> Dict[str, Any]:
         self.flush()
         with self._lock:

@@ -3,16 +3,28 @@
 Uniform stream ids cannot exercise an LRU: every stream is equally cold, the
 working set IS the tenant count, and a pager either thrashes or never fires.
 Real multi-tenant traffic is skewed — a few hot tenants dominate while a long
-tail trickles — so the stream-sharding/paging bench, the chaos plan, and the
-paging tests all draw stream ids from ONE seeded Zipfian sampler defined
-here. Sharing the sampler is what keeps the three gates honest about the same
-workload: a plan change moves bench, chaos, and tests in lockstep.
+tail trickles — so the stream-sharding/paging bench, the chaos plan, the
+elastic-overload gate, and the paging tests all draw stream ids from ONE
+seeded Zipfian sampler defined here. Sharing the sampler is what keeps the
+gates honest about the same workload: a plan change moves bench, chaos,
+elastic, and tests in lockstep.
+
+The HOT-SPOT SHIFT mode (ISSUE 11) models the overload scenario the
+degradation ladder exists for: at a given batch index the hot set moves —
+the rank→stream permutation rotates (head rotation) and/or the Zipf exponent
+changes — so a pager sized for the old working set suddenly faults on every
+batch. With ``shift_at=None`` the sequence is BIT-IDENTICAL to the
+pre-ISSUE-11 generator (same draws, same order), so the existing smokes'
+seeded workloads are unchanged.
 
 Values are dyadic rationals (multiples of 1/64), the repo-wide convention
 that makes float accumulation exact under ANY grouping, routing, or paging
 order — bit-identical parity claims quantify over exactly this traffic.
+Batch values and row counts draw from a stream-id-independent RNG, so the
+shift moves WHICH stream a batch lands on, never its contents: a shifted and
+an unshifted run stay row-for-row comparable.
 """
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 import numpy as np
 
@@ -20,7 +32,13 @@ __all__ = ["zipf_stream_ids", "zipf_traffic"]
 
 
 def zipf_stream_ids(
-    num_streams: int, n: int, alpha: float = 1.1, seed: int = 0
+    num_streams: int,
+    n: int,
+    alpha: float = 1.1,
+    seed: int = 0,
+    shift_at: Optional[int] = None,
+    shift_rotation: Optional[int] = None,
+    shift_alpha: Optional[float] = None,
 ) -> np.ndarray:
     """``n`` stream ids in ``[0, num_streams)`` drawn from a bounded Zipf.
 
@@ -28,16 +46,40 @@ def zipf_stream_ids(
     rank maps to stream id through a seeded permutation, so the hot set is
     spread across the id space (and therefore across shards under the
     ``sid % world`` routing rule) instead of clustering on shard 0.
-    Deterministic in ``(num_streams, n, alpha, seed)``.
+
+    ``shift_at`` arms the hot-spot shift: draws at indices >= ``shift_at``
+    use a ROTATED rank→id permutation (``shift_rotation`` positions, default
+    ``num_streams // 2`` — the head moves to previously-cold ids) and, when
+    ``shift_alpha`` is given, a different Zipf exponent (a flatter/steeper
+    tail). The rank STREAM itself is unchanged — one draw sequence, two
+    mappings — so the pre-shift prefix of a shifted call equals the
+    unshifted call exactly. Deterministic in every argument.
     """
     if num_streams <= 0 or n < 0:
         raise ValueError(f"need num_streams > 0 and n >= 0, got {num_streams}, {n}")
+    if shift_at is not None and not (0 <= shift_at):
+        raise ValueError(f"shift_at must be >= 0, got {shift_at}")
     rng = np.random.RandomState(seed)
-    weights = 1.0 / np.power(np.arange(1, num_streams + 1, dtype=np.float64), float(alpha))
-    weights /= weights.sum()
-    ranks = rng.choice(num_streams, size=int(n), p=weights)
+
+    def _weights(a: float) -> np.ndarray:
+        w = 1.0 / np.power(np.arange(1, num_streams + 1, dtype=np.float64), float(a))
+        return w / w.sum()
+
     perm = np.random.RandomState(seed ^ 0x5A1F).permutation(num_streams)
-    return perm[ranks].astype(np.int32)
+    if shift_at is None or shift_at >= n:
+        ranks = rng.choice(num_streams, size=int(n), p=_weights(alpha))
+        return perm[ranks].astype(np.int32)
+    head = rng.choice(num_streams, size=int(shift_at), p=_weights(alpha))
+    tail = rng.choice(
+        num_streams,
+        size=int(n - shift_at),
+        p=_weights(alpha if shift_alpha is None else shift_alpha),
+    )
+    rot = num_streams // 2 if shift_rotation is None else int(shift_rotation)
+    perm_shifted = np.roll(perm, rot)
+    return np.concatenate(
+        [perm[head], perm_shifted[tail]]
+    ).astype(np.int32)
 
 
 def zipf_traffic(
@@ -46,14 +88,23 @@ def zipf_traffic(
     alpha: float = 1.1,
     seed: int = 0,
     max_rows: int = 24,
+    shift_at: Optional[int] = None,
+    shift_rotation: Optional[int] = None,
+    shift_alpha: Optional[float] = None,
 ) -> List[Tuple[int, np.ndarray, np.ndarray]]:
     """``(stream_id, preds, target)`` batches under the Zipfian stream law:
     ragged dyadic-float preds and 0/1 int targets (the Accuracy/MSE input
     shape every serving gate drives). One batch carries one stream's rows —
     cross-stream mixing happens in the engine's coalescer, same as
-    production ingest."""
+    production ingest. ``shift_at``/``shift_rotation``/``shift_alpha`` pass
+    through to :func:`zipf_stream_ids` (batch CONTENTS draw from an
+    id-independent RNG, so the shift reroutes batches without changing
+    their rows)."""
     rng = np.random.RandomState(seed ^ 0x7AFF)
-    sids = zipf_stream_ids(num_streams, n_batches, alpha=alpha, seed=seed)
+    sids = zipf_stream_ids(
+        num_streams, n_batches, alpha=alpha, seed=seed,
+        shift_at=shift_at, shift_rotation=shift_rotation, shift_alpha=shift_alpha,
+    )
     out: List[Tuple[int, np.ndarray, np.ndarray]] = []
     for sid in sids:
         rows = int(rng.randint(1, max(2, max_rows + 1)))  # inclusive max_rows

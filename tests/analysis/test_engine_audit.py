@@ -155,6 +155,41 @@ def test_audit_catches_a_smuggled_all_gather_in_the_routed_step():
     assert any("all_gather" in f.path for f in report.findings)
 
 
+def test_post_reshard_engine_audits_clean_and_catches_a_smuggled_collective():
+    """ISSUE 11: the programs a RESHARDED engine serves with are rebuilt
+    against the new topology — they must (a) audit clean, and (b) still be
+    covered by the collective-free contract: a reshard that smuggles a psum
+    into the steady step fires ``no-collectives-in-deferred-step`` exactly
+    like a fresh build (the broken-fixture proof for the bootstrap matrix's
+    post-reshard engine)."""
+    eng = StreamingEngine(
+        MetricCollection([Accuracy(), MeanSquaredError()]),
+        EngineConfig(buckets=(8,), mesh=_mesh1(), axis="dp", mesh_sync="deferred"),
+    )
+    rng = np.random.RandomState(0)
+    with eng:
+        for n in (5, 8):
+            eng.submit(rng.rand(n).astype(np.float32), (rng.rand(n) > 0.5).astype(np.int32))
+        eng.flush()
+        eng.reshard(world=1)  # full snapshot -> swap -> restore cycle
+        eng.submit(rng.rand(3).astype(np.float32), (rng.rand(3) > 0.5).astype(np.int32))
+        eng.result()
+    assert eng.stats.reshards == 1
+    assert EngineAnalysis().check(eng).ok  # post-reshard programs are clean
+
+    inner = eng._traced_update
+
+    def smuggling_update(state_tree, payload, mask):
+        new = inner(state_tree, payload, mask)
+        return jax.tree.map(lambda x: jax.lax.psum(x, "dp"), new)
+
+    eng._traced_update = smuggling_update
+    report = EngineAnalysis().check(eng)
+    rules = {f.rule for f in report.findings}
+    assert rules == {"no-collectives-in-deferred-step"}, report.render()
+    assert all("psum" in f.path for f in report.findings)
+
+
 def test_audit_catches_a_blown_compile_cap():
     """Shrink the declared bucket set after serving: the programs-per-engine
     accounting must flag the (now) over-cap executable count."""

@@ -1,0 +1,482 @@
+"""Admission control + degradation ladder (ISSUE 11).
+
+Unit contracts for ``engine/admission.py`` (token buckets under an injected
+logical clock, priority classes, the shed switch, detector hysteresis, the
+ladder's pure deterministic walk) and the engine wiring: typed
+``AdmissionRejected`` on the submit path before anything queues, outcome
+counters that survive CONCURRENT submits (the satellite's counter-semantics
+claim), the stats/OpenMetrics admission block through the strict parser, and
+the rung side effects (widened coalesce window, deferred cold reads, shed)
+applying and releasing on ladder transitions.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+from metrics_tpu import Accuracy, MeanSquaredError, MetricCollection
+from metrics_tpu.engine import (
+    AdmissionPolicy,
+    AdmissionRejected,
+    DegradationLadder,
+    EngineConfig,
+    FaultInjector,
+    FaultSpec,
+    MultiStreamEngine,
+    OverloadDetector,
+    StreamingEngine,
+    TokenBucket,
+)
+from metrics_tpu.engine.admission import LADDER_RUNGS
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+
+class _Clock:
+    """Injectable logical clock: admission decisions become pure functions
+    of the scripted time sequence."""
+
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+
+def _batch(n=2, seed=0):
+    rng = np.random.RandomState(seed)
+    return (
+        (rng.randint(0, 65, size=n) / 64.0).astype(np.float32),
+        (rng.rand(n) > 0.5).astype(np.int32),
+    )
+
+
+# ---------------------------------------------------------------- token bucket
+
+
+class TestTokenBucket:
+    def test_burst_then_refill(self):
+        b = TokenBucket(capacity=4.0, rate=2.0, now=0.0)
+        assert b.take(4, 0.0) == 0.0          # full burst admitted
+        assert b.take(2, 0.0) == 1.0          # 2 tokens short at 2/s
+        assert b.take(2, 1.0) == 0.0          # refilled exactly
+        assert b.take(1, 1.0) == 0.5
+
+    def test_oversized_request_is_inf_not_a_backoff(self):
+        b = TokenBucket(capacity=4.0, rate=2.0, now=0.0)
+        assert b.take(5, 0.0) == float("inf")
+        assert b.take(4, 0.0) == 0.0          # nothing was consumed by the refusal
+
+    def test_clock_never_runs_backwards(self):
+        b = TokenBucket(capacity=4.0, rate=1.0, now=10.0)
+        b.take(4, 10.0)
+        assert b.take(1, 5.0) > 0.0           # stale timestamp cannot mint tokens
+        assert b.take(1, 11.0) == 0.0
+
+
+# ------------------------------------------------------------ admission policy
+
+
+class TestAdmissionPolicy:
+    def test_rejection_carries_retry_after_and_priority(self):
+        clk = _Clock()
+        pol = AdmissionPolicy(rows_per_s=2.0, burst_rows=4.0, clock=clk)
+        assert pol.admit(None, 4) == 1
+        with pytest.raises(AdmissionRejected) as ei:
+            pol.admit(None, 2)
+        e = ei.value
+        assert e.retry_after_s == pytest.approx(1.0)
+        assert e.priority == 1 and not e.shed and e.stream_id is None
+        clk.t = 1.0
+        assert pol.admit(None, 2) == 1        # the hint was honest
+
+    def test_per_stream_buckets_are_independent(self):
+        pol = AdmissionPolicy(rows_per_s=1.0, burst_rows=2.0, clock=_Clock())
+        pol.admit(0, 2)
+        with pytest.raises(AdmissionRejected):
+            pol.admit(0, 1)
+        assert pol.admit(1, 2) == 1           # stream 1's bucket untouched
+
+    def test_class_rates_scale_refill(self):
+        clk = _Clock()
+        pol = AdmissionPolicy(
+            rows_per_s=1.0, burst_rows=2.0, clock=clk,
+            priorities={7: 0}, class_rates={0: 4.0},
+        )
+        pol.admit(7, 2)
+        pol.admit(3, 2)
+        clk.t = 0.5
+        assert pol.admit(7, 2) == 0           # class 0 refills 4x faster
+        with pytest.raises(AdmissionRejected):
+            pol.admit(3, 2)
+
+    def test_shed_switch_rejects_lowest_class_only(self):
+        pol = AdmissionPolicy(priorities={9: 2}, default_priority=1, clock=_Clock())
+        pol.shed_lowest(True)
+        assert pol.is_shed(9) and not pol.is_shed(0)
+        with pytest.raises(AdmissionRejected) as ei:
+            pol.admit(9, 1)
+        assert ei.value.shed and ei.value.retry_after_s == float("inf")
+        assert pol.admit(0, 1) == 1
+        pol.shed_lowest(False)
+        assert pol.admit(9, 1) == 2           # released: admits again
+        c = pol.counters()
+        assert c["shed"] == {2: 1} and c["admitted"] == {1: 1, 2: 1}
+
+    def test_refund_returns_tokens_and_reverses_the_admitted_count(self):
+        pol = AdmissionPolicy(rows_per_s=1.0, burst_rows=4.0, clock=_Clock())
+        assert pol.admit(0, 4) == 1
+        pol.refund(0, 4)
+        assert pol.admit(0, 4) == 1            # the bucket is whole again
+        assert pol.counters()["admitted"] == {1: 1}  # net one real admission
+
+    def test_counters_exact_under_concurrent_submits(self):
+        """The satellite's counter-semantics claim: N threads x M admits must
+        count exactly N*M — a bare `dict[k] += 1` loses increments under the
+        GIL's bytecode interleaving; the policy's lock must not."""
+        pol = AdmissionPolicy(rows_per_s=1e12, burst_rows=1e12)
+        N, M = 8, 500
+
+        def worker(tid):
+            for _ in range(M):
+                pol.admit(tid, 1)
+
+        threads = [threading.Thread(target=worker, args=(t,)) for t in range(N)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert sum(pol.counters()["admitted"].values()) == N * M
+
+
+# ---------------------------------------------------------- overload detector
+
+
+class TestOverloadDetector:
+    def test_value_hysteresis_high_and_clear_watermarks(self):
+        d = OverloadDetector(queue_p99_us=100.0, clear_frac=0.5)
+        assert not d.assess({"queue_p99_us": 99.0})
+        assert d.assess({"queue_p99_us": 100.0})
+        # between clear (50) and high (100): verdict LATCHES overloaded
+        assert d.assess({"queue_p99_us": 60.0})
+        assert not d.assess({"queue_p99_us": 49.0})
+
+    def test_any_armed_signal_trips_missing_signals_read_zero(self):
+        d = OverloadDetector(queue_p99_us=100.0, spill_rate=0.5)
+        assert d.assess({"spill_rate": 0.5})
+        assert not OverloadDetector(queue_p99_us=None, spill_rate=None,
+                                    queue_depth_frac=None).assess({"spill_rate": 9.0})
+
+
+# ---------------------------------------------------------- degradation ladder
+
+
+class TestDegradationLadder:
+    def _always(self, verdict):
+        d = OverloadDetector(queue_p99_us=1.0, clear_frac=1.0)
+        return {"queue_p99_us": 10.0 if verdict else 0.0}
+
+    def test_walk_is_a_pure_function_of_the_verdict_sequence(self):
+        """Deterministic replay: the same scripted signal sequence produces
+        the identical transition list — the property that lets same-seed
+        serving runs emit identical ladder trace events."""
+        script = [True] * 7 + [False] * 9 + [True] * 3 + [False] * 20
+
+        def run():
+            lad = DegradationLadder(
+                detector=OverloadDetector(queue_p99_us=1.0, clear_frac=1.0),
+                up_after=2, down_after=3,
+            )
+            return [lad.tick(self._always(v)) for v in script], lad.level
+
+        (ta, la), (tb, lb) = run(), run()
+        assert ta == tb and la == lb
+        moves = [t for t in ta if t is not None]
+        assert moves[0] == (0, 1)              # escalation starts after up_after
+        assert la == 0                         # long cool tail walks all the way down
+
+    def test_hysteresis_streaks_reset_on_opposite_verdicts(self):
+        lad = DegradationLadder(
+            detector=OverloadDetector(queue_p99_us=1.0, clear_frac=1.0),
+            up_after=3, down_after=2,
+        )
+        assert lad.tick(self._always(True)) is None
+        assert lad.tick(self._always(True)) is None
+        assert lad.tick(self._always(False)) is None   # hot streak resets
+        assert lad.tick(self._always(True)) is None
+        assert lad.tick(self._always(True)) is None
+        assert lad.tick(self._always(True)) == (0, 1)  # full streak required
+
+    def test_rungs_must_be_an_ordered_subset(self):
+        DegradationLadder(rungs=("widen_coalesce", "shed"))
+        with pytest.raises(ValueError):
+            DegradationLadder(rungs=("shed", "widen_coalesce"))
+        with pytest.raises(ValueError):
+            DegradationLadder(rungs=("widen_coalesce", "nope"))
+        assert LADDER_RUNGS == (
+            "widen_coalesce", "quantize_sync", "defer_cold_reads", "shed"
+        )
+
+
+# ------------------------------------------------------------- engine wiring
+
+
+class TestEngineWiring:
+    def test_rejected_submit_never_consumes_a_cursor(self):
+        clk = _Clock()
+        pol = AdmissionPolicy(rows_per_s=1.0, burst_rows=2.0, clock=clk)
+        eng = StreamingEngine(Accuracy(), EngineConfig(buckets=(8,), admission=pol))
+        p, t = _batch()
+        with eng:
+            eng.submit(p, t)
+            with pytest.raises(AdmissionRejected):
+                eng.submit(p, t)
+            clk.t = 10.0
+            eng.submit(p, t)
+            eng.flush()
+            # exactly the two ADMITTED batches folded; the refusal left no hole
+            assert eng._batches_done == 2
+        adm = eng.stats.admission_summary()
+        assert adm["admitted_by_priority"] == {"1": 2}
+        assert adm["rejected_by_priority"] == {"1": 1}
+
+    def test_backpressure_timeout_refunds_admission_tokens(self):
+        """A submit that clears admission but then times out on the full
+        queue never entered the engine: its tokens refund, so the retrying
+        producer is not double-charged exactly when tokens are scarce."""
+        from metrics_tpu.engine import BackpressureTimeout
+
+        clk = _Clock()
+        pol = AdmissionPolicy(rows_per_s=1e-6, burst_rows=2.0, clock=clk)
+        eng = StreamingEngine(
+            Accuracy(), EngineConfig(buckets=(8,), max_queue=1, admission=pol)
+        )
+        eng.start = lambda: eng  # dispatcher never runs: pure backpressure
+        p, t = _batch(1)
+        eng.submit(p, t, timeout=0.1)  # fills the queue (1 token left)
+        for _ in range(3):
+            with pytest.raises(BackpressureTimeout):
+                eng.submit(p, t, timeout=0.05)  # refunded each time, never
+        c = pol.counters()                      # AdmissionRejected
+        assert c["admitted"] == {1: 1} and c["rejected"] == {}
+
+    def test_multistream_admission_uses_stream_identity(self):
+        pol = AdmissionPolicy(
+            rows_per_s=1.0, burst_rows=2.0, clock=_Clock(), priorities={1: 3}
+        )
+        eng = MultiStreamEngine(Accuracy(), 2, EngineConfig(buckets=(8,), admission=pol))
+        p, t = _batch()
+        with eng:
+            eng.submit(0, p, t)
+            eng.submit(1, p, t)                 # own bucket: admitted
+            with pytest.raises(AdmissionRejected) as ei:
+                eng.submit(1, p, t)
+            assert ei.value.stream_id == 1 and ei.value.priority == 3
+            eng.flush()
+
+    def test_admission_fault_site_retries_transiently(self):
+        inj = FaultInjector(seed=5, plan={"admission": FaultSpec(schedule=(0,))})
+        eng = StreamingEngine(
+            Accuracy(),
+            EngineConfig(
+                buckets=(8,), admission=AdmissionPolicy(), fault_injector=inj
+            ),
+        )
+        p, t = _batch()
+        with eng:
+            eng.submit(p, t)                    # fault fires, retried, admitted
+            assert float(np.asarray(eng.result())) == float(
+                np.mean((np.asarray(p) >= 0.5) == np.asarray(t).astype(bool))
+            )
+        assert inj.fired.get("admission") == 1
+        assert eng.stats.retries >= 1
+
+    def test_config_rejects_wrong_types(self):
+        with pytest.raises(MetricsTPUUserError, match="AdmissionPolicy"):
+            StreamingEngine(Accuracy(), EngineConfig(buckets=(8,), admission=object()))
+        with pytest.raises(MetricsTPUUserError, match="DegradationLadder"):
+            StreamingEngine(Accuracy(), EngineConfig(buckets=(8,), ladder=object()))
+
+    def test_openmetrics_admission_families_parse_strictly(self):
+        import os
+        import sys
+
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..", "tools"))
+        import trace_export
+
+        pol = AdmissionPolicy(priorities={1: 2}, clock=_Clock())
+        pol.shed_lowest(True)
+        eng = StreamingEngine(Accuracy(), EngineConfig(buckets=(8,), admission=pol))
+        p, t = _batch()
+        with eng:
+            eng.submit(p, t)
+            eng.flush()
+        families = trace_export.parse_openmetrics(eng.metrics_text())
+        assert "metrics_tpu_engine_admission_admitted" in families
+        assert "metrics_tpu_engine_ladder_level" in families
+        assert families["metrics_tpu_engine_ladder_level"]["type"] == "gauge"
+        # a policy-less engine's exposition stays byte-stable: no admission families
+        plain = StreamingEngine(Accuracy(), EngineConfig(buckets=(8,)))
+        with plain:
+            plain.submit(p, t)
+            plain.flush()
+        fams = trace_export.parse_openmetrics(plain.metrics_text())
+        assert not any(k.startswith("metrics_tpu_engine_admission") for k in fams)
+        assert "metrics_tpu_engine_ladder_level" not in fams
+
+
+class _ScriptedDetector(OverloadDetector):
+    """Detector whose verdicts come from a script — engine-side rung tests
+    must not depend on CI timing."""
+
+    def __init__(self, script):
+        super().__init__(queue_p99_us=1.0)
+        self.script = list(script)
+
+    def assess(self, signals):
+        return self.script.pop(0) if self.script else False
+
+
+class TestLadderEngineIntegration:
+    def test_rungs_apply_and_release_on_engine_state(self):
+        """One group per tick (flush-per-submit): a scripted detector walks
+        the ladder up through widen/defer/shed and back down, and each rung's
+        engine-side effect must engage exactly while its level is held."""
+        pol = AdmissionPolicy(priorities={1: 2}, clock=_Clock())
+        # down_after=2: the shed PROBE below itself ticks the ladder (the
+        # shed-only-traffic liveness path), and that single cool tick must
+        # be absorbed by the hysteresis, not release the rung mid-assert
+        lad = DegradationLadder(
+            detector=_ScriptedDetector([True] * 3 + [False] * 8),
+            rungs=("widen_coalesce", "defer_cold_reads", "shed"),
+            up_after=1, down_after=2, widen_window_ms=7.5,
+        )
+        eng = MultiStreamEngine(
+            Accuracy(), 2,
+            EngineConfig(buckets=(8,), admission=pol, ladder=lad),
+        )
+        p, t = _batch()
+        with eng:
+            eng.submit(0, p, t); eng.flush()      # tick 1 -> widen
+            assert eng._cfg.coalesce_window_ms == 7.5
+            eng.submit(0, p, t); eng.flush()      # tick 2 -> defer
+            assert eng._defer_cold_reads
+            eng.submit(0, p, t); eng.flush()      # tick 3 -> shed
+            assert pol.shed_floor() == 2
+            with pytest.raises(AdmissionRejected) as ei:
+                eng.submit(1, p, t)               # stream 1 is class 2: shed
+            assert ei.value.shed                  # (this rejection ticks once)
+            assert eng.stats.ladder_level == 3
+            # deferred stale read: compute once, then the repeat is served
+            # from the cache and counted
+            v1 = eng.result(0)
+            v2 = eng.result(0)
+            assert np.array_equal(np.asarray(v1), np.asarray(v2))
+            assert eng.stats.deferred_reads == 1
+            eng.submit(0, p, t); eng.flush()      # cool streak -> release shed
+            eng.submit(0, p, t); eng.flush()
+            eng.submit(0, p, t); eng.flush()      # -> release defer
+            eng.submit(0, p, t); eng.flush()
+            eng.submit(0, p, t); eng.flush()      # -> release widen
+            assert eng.stats.ladder_level == 0
+            assert eng._cfg.coalesce_window_ms == 0.0
+            assert not eng._defer_cold_reads and pol.shed_floor() is None
+            eng.submit(1, p, t)                   # shed released: admits
+            eng.flush()
+        assert eng.stats.ladder_transitions == 6
+
+    def test_a_ladder_cannot_drive_two_engines(self):
+        lad = DegradationLadder()
+        e1 = StreamingEngine(Accuracy(), EngineConfig(buckets=(8,), ladder=lad))
+        with pytest.raises(MetricsTPUUserError, match="already driving"):
+            StreamingEngine(Accuracy(), EngineConfig(buckets=(8,), ladder=lad))
+        del e1  # released: a replacement engine may take it over
+        import gc
+
+        gc.collect()
+        StreamingEngine(Accuracy(), EngineConfig(buckets=(8,), ladder=lad))
+
+    def test_shed_rejections_tick_the_ladder_so_shed_only_traffic_recovers(self):
+        """Liveness: once shed engages, rejected submits never form a group
+        and the dispatcher never ticks — the rejection itself must tick, or
+        an idle engine rejects the class forever."""
+        pol = AdmissionPolicy(priorities={0: 2}, default_priority=1, clock=_Clock())
+        lad = DegradationLadder(
+            detector=_ScriptedDetector([True]),  # exhausted -> cool forever
+            rungs=("shed",), up_after=1, down_after=2,
+        )
+        eng = MultiStreamEngine(
+            Accuracy(), 2, EngineConfig(buckets=(8,), admission=pol, ladder=lad)
+        )
+        p, t = _batch()
+        with eng:
+            eng.submit(1, p, t); eng.flush()      # hot tick -> shed engages
+            assert pol.shed_floor() == 2
+            # ONLY shed-class traffic from here on: the rejections' own
+            # ticks must walk the ladder back down (down_after=2 cool ticks)
+            for _ in range(2):
+                with pytest.raises(AdmissionRejected):
+                    eng.submit(0, p, t)
+            assert eng.stats.ladder_level == 0 and pol.shed_floor() is None
+            eng.submit(0, p, t)                   # the class admits again
+            eng.flush()
+
+    def test_quantize_rung_swaps_the_sync_policy_and_restores_it(self):
+        """The quantize rung forces the blanket q8_block policy for ELIGIBLE
+        states while engaged (mesh engines, exact baseline only): the
+        precision tag and fingerprint refresh both ways — programs recompile
+        rather than collide — counts stay bit-exact throughout, and release
+        restores the exact policy verbatim."""
+        import jax
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.asarray(jax.devices()[:1]), ("dp",))
+        lad = DegradationLadder(
+            detector=_ScriptedDetector([True, False]),
+            rungs=("quantize_sync",), up_after=1, down_after=1,
+        )
+        eng = StreamingEngine(
+            MetricCollection([Accuracy(), MeanSquaredError()]),
+            EngineConfig(
+                buckets=(8,), mesh=mesh, axis="dp", mesh_sync="deferred", ladder=lad
+            ),
+        )
+        p, t = _batch(6)
+        ref = StreamingEngine(
+            MetricCollection([Accuracy(), MeanSquaredError()]), EngineConfig(buckets=(8,))
+        )
+        with ref:
+            for _ in range(3):
+                ref.submit(p, t)
+            want = {k: np.asarray(v) for k, v in ref.result().items()}
+        with eng:
+            eng.submit(p, t); eng.flush()          # tick 1 -> quantize engaged
+            assert eng._precision_tag.startswith("q8:")
+            mid = eng.result()                     # quantized boundary merge
+            assert np.array_equal(np.asarray(mid["Accuracy"]), want["Accuracy"])
+            eng.submit(p, t); eng.flush()          # tick 2 -> released
+            assert eng._precision_tag == "exact"
+            assert eng._metric.sync_precision_tag() == "exact"
+            eng.submit(p, t)
+            got = {k: np.asarray(v) for k, v in eng.result().items()}
+        assert np.array_equal(got["Accuracy"], want["Accuracy"])  # counts bit-exact
+        assert np.allclose(got["MeanSquaredError"], want["MeanSquaredError"], rtol=1e-2)
+
+    def test_ladder_transitions_emit_trace_events(self):
+        from metrics_tpu.engine import TraceRecorder
+
+        rec = TraceRecorder(capacity=4096)
+        lad = DegradationLadder(
+            detector=_ScriptedDetector([True, False]),
+            rungs=("widen_coalesce",), up_after=1, down_after=1,
+        )
+        eng = StreamingEngine(
+            Accuracy(), EngineConfig(buckets=(8,), ladder=lad, trace=rec)
+        )
+        p, t = _batch()
+        with eng:
+            eng.submit(p, t); eng.flush()
+            eng.submit(p, t); eng.flush()
+        evs = rec.events("ladder")
+        assert [
+            (e["args"]["action"], e["args"]["level"], e["args"]["rung"]) for e in evs
+        ] == [("escalate", 1, "widen_coalesce"), ("deescalate", 0, "widen_coalesce")]

@@ -1,0 +1,483 @@
+"""Live elastic resharding (ISSUE 11): snapshot-through-the-restore-matrix.
+
+Fast tier-1 half: the full reshard cycle on a 1-device mesh (capture → swap →
+restore is the REAL path regardless of world), typed non-destructive
+refusals, shard-loss target selection, the richer ``BackpressureTimeout``
+message (satellite), and the stats/trace surfaces.
+
+Slow half (``devices`` fixture → 8-device mesh, runs in the unfiltered
+suite): the reshard round-trip PROPERTY from the acceptance criteria —
+snapshot at world W, restore into {grown, shrunk, stream-shard-factor-
+changed} topologies, replay from the cursor, bit-exact for delta metrics and
+multistream engines; cat/scan engines refuse loudly and keep serving.
+"""
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh
+
+from metrics_tpu import AUROC, Accuracy, MeanSquaredError, MetricCollection
+from metrics_tpu.engine import (
+    BackpressureTimeout,
+    EngineConfig,
+    FaultInjector,
+    FaultSpec,
+    MultiStreamEngine,
+    StreamingEngine,
+    TraceRecorder,
+)
+from metrics_tpu.engine.traffic import zipf_traffic
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+BUCKETS = (8, 32)
+
+
+def _collection():
+    return MetricCollection([Accuracy(), MeanSquaredError()])
+
+
+def _mesh(w):
+    return Mesh(np.asarray(jax.devices()[:w]), ("dp",))
+
+
+def _batches(sizes=(5, 17, 8, 3, 12, 9), seed=0):
+    rng = np.random.RandomState(seed)
+    return [
+        (
+            (rng.randint(0, 65, size=n) / 64.0).astype(np.float32),
+            (rng.rand(n) > 0.5).astype(np.int32),
+        )
+        for n in sizes
+    ]
+
+
+def _want(batches, metric_factory=_collection):
+    ref = StreamingEngine(metric_factory(), EngineConfig(buckets=BUCKETS))
+    with ref:
+        for b in batches:
+            ref.submit(*b)
+        out = ref.result()
+    return {k: np.asarray(v) for k, v in out.items()} if isinstance(out, dict) else np.asarray(out)
+
+
+# ------------------------------------------------------------------ fast half
+
+
+def test_reshard_cycle_is_exact_for_delta_and_cat_on_one_device():
+    batches = _batches()
+    want = _want(batches)
+    eng = StreamingEngine(
+        _collection(),
+        EngineConfig(buckets=BUCKETS, mesh=_mesh(1), axis="dp", mesh_sync="deferred"),
+    )
+    with eng:
+        for b in batches[:3]:
+            eng.submit(*b)
+        info = eng.reshard(world=1)  # full capture -> swap -> restore cycle
+        assert info == {"from_world": 1, "to_world": 1, "cursor": 3}
+        for b in batches[3:]:
+            eng.submit(*b)
+        got = {k: np.asarray(v) for k, v in eng.result().items()}
+    for k in want:
+        assert np.array_equal(got[k], want[k])
+    assert eng.stats.reshards == 1
+    assert eng.stats.reshard_last == {
+        "from_world": 1, "to_world": 1, "cursor": 3, "auto": False,
+    }
+
+    # cat/scan state (AUROC capacity buffers): same-world cycle is verbatim
+    a = StreamingEngine(
+        AUROC(capacity=128),
+        EngineConfig(buckets=BUCKETS, mesh=_mesh(1), axis="dp", mesh_sync="deferred"),
+    )
+    b2 = StreamingEngine(
+        AUROC(capacity=128),
+        EngineConfig(buckets=BUCKETS, mesh=_mesh(1), axis="dp", mesh_sync="deferred"),
+    )
+    with a, b2:
+        for p, t in batches:
+            a.submit(p, t)
+            b2.submit(p, t)
+        a.flush()
+        a.reshard(world=1)
+        assert np.array_equal(np.asarray(a.result()), np.asarray(b2.result()))
+
+
+def test_reshard_refusals_are_typed_and_non_destructive():
+    batches = _batches()
+    # no mesh: nothing to reshard
+    plain = StreamingEngine(_collection(), EngineConfig(buckets=BUCKETS))
+    with pytest.raises(MetricsTPUUserError, match="needs a mesh"):
+        plain.reshard(world=2)
+    eng = StreamingEngine(
+        _collection(),
+        EngineConfig(buckets=BUCKETS, mesh=_mesh(1), axis="dp", mesh_sync="deferred"),
+    )
+    with eng:
+        for b in batches[:2]:
+            eng.submit(*b)
+        eng.flush()
+        with pytest.raises(MetricsTPUUserError, match="world= or mesh="):
+            eng.reshard()
+        with pytest.raises(MetricsTPUUserError, match="positive"):
+            eng.reshard(world=0)
+        with pytest.raises(MetricsTPUUserError, match="buckets"):
+            eng.reshard(world=3)  # 8 % 3 != 0: bucket-incompatible world
+        with pytest.raises(MetricsTPUUserError, match="exceeds"):
+            eng.reshard(world=1024)
+        with pytest.raises(MetricsTPUUserError, match="resident_streams"):
+            eng.reshard(world=1, resident_streams=4)
+        with pytest.raises(MetricsTPUUserError, match="stream sharding"):
+            eng.reshard(world=1, stream_shard=True)
+        # every refusal above left the engine serving exactly as it was
+        for b in batches[2:]:
+            eng.submit(*b)
+        got = {k: np.asarray(v) for k, v in eng.result().items()}
+    want = _want(batches)
+    for k in want:
+        assert np.array_equal(got[k], want[k])
+    assert eng.stats.reshards == 0
+
+
+def test_reshard_never_mutates_a_shared_config_object():
+    """Engines take a private copy of their EngineConfig: a reshard (which
+    swaps the topology fields) or a ladder rung (which moves the coalesce
+    window) on one engine must never leak into another engine constructed
+    from the same config object."""
+    cfg = EngineConfig(buckets=BUCKETS, mesh=_mesh(1), axis="dp", mesh_sync="deferred")
+    e1 = StreamingEngine(_collection(), cfg)
+    e2 = StreamingEngine(_collection(), cfg)
+    b = _batches()[0]
+    with e1, e2:
+        e1.submit(*b)
+        e1.flush()
+        e1.reshard(world=1)
+        e1._cfg.coalesce_window_ms = 99.0  # what the widen rung does
+        assert cfg.coalesce_window_ms == 0.0
+        assert e2._cfg.coalesce_window_ms == 0.0
+        assert e2._cfg.mesh is cfg.mesh  # e2 untouched by e1's reshard
+        e2.submit(*b)
+        e2.result()
+
+
+def test_shard_loss_target_selection():
+    eng = StreamingEngine(
+        _collection(),
+        EngineConfig(
+            buckets=BUCKETS, mesh=_mesh(1), axis="dp", mesh_sync="deferred",
+            elastic_min_world=1,
+        ),
+    )
+    eng._world = 8
+    assert eng._shard_loss_target() == 4  # 7, 6, 5 are bucket-incompatible
+    eng._cfg.elastic_min_world = 5
+    assert eng._shard_loss_target() is None  # nothing compatible above the floor
+    eng._cfg.elastic_min_world = 0
+    assert eng._shard_loss_target() is None  # disarmed
+
+
+def test_transient_shard_loss_retries_in_place():
+    """A TRANSIENT suspected shard loss rolls back and retries without
+    resharding — the engine only gives up a shard on a non-transient loss."""
+    batches = _batches()
+    want = _want(batches)
+    inj = FaultInjector(seed=9, plan={"shard_loss": FaultSpec(schedule=(1,))})
+    eng = StreamingEngine(
+        _collection(),
+        EngineConfig(
+            buckets=BUCKETS, mesh=_mesh(1), axis="dp", mesh_sync="deferred",
+            fault_injector=inj,
+        ),
+    )
+    with eng:
+        for b in batches:
+            eng.submit(*b)
+        got = {k: np.asarray(v) for k, v in eng.result().items()}
+    for k in want:
+        assert np.array_equal(got[k], want[k])
+    assert inj.fired.get("shard_loss") == 1
+    assert eng.stats.reshards == 0 and eng.stats.retries >= 1
+
+
+def test_nontransient_shard_loss_without_elastic_floor_goes_sticky():
+    from metrics_tpu.engine import EngineDispatchError
+
+    inj = FaultInjector(
+        seed=9, plan={"shard_loss": FaultSpec(schedule=(0,), transient=False)}
+    )
+    eng = StreamingEngine(
+        _collection(),
+        EngineConfig(
+            buckets=BUCKETS, mesh=_mesh(1), axis="dp", mesh_sync="deferred",
+            fault_injector=inj,  # elastic_min_world=0: auto-reshard disarmed
+        ),
+    )
+    eng.start()
+    eng.submit(*_batches()[0])
+    with pytest.raises(EngineDispatchError, match="shard_loss"):
+        eng.flush()
+    eng.reset()
+    eng.stop()
+
+
+def test_reshard_emits_trace_event_and_openmetrics_counter():
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..", "tools"))
+    import trace_export
+
+    rec = TraceRecorder(capacity=2048)
+    eng = StreamingEngine(
+        _collection(),
+        EngineConfig(
+            buckets=BUCKETS, mesh=_mesh(1), axis="dp", mesh_sync="deferred", trace=rec
+        ),
+    )
+    with eng:
+        eng.submit(*_batches()[0])
+        eng.flush()
+        eng.reshard(world=1)
+        eng.result()
+        text = eng.metrics_text()
+    evs = rec.events("reshard")
+    assert len(evs) == 1
+    assert evs[0]["args"] == {
+        "from_world": 1, "to_world": 1, "cursor": 1, "auto": False,
+    }
+    families = trace_export.parse_openmetrics(text)
+    assert "metrics_tpu_engine_reshards" in families
+
+
+# ------------------------------------------------- BackpressureTimeout satellite
+
+
+def test_backpressure_timeout_names_depth_inflight_and_oldest_age():
+    """Satellite (ISSUE 11): the timeout message must carry the congestion
+    coordinates — queue depth, in-flight count, oldest queued item's age —
+    like EngineDispatchError carries cursor/bucket."""
+    engine = StreamingEngine(Accuracy(), EngineConfig(buckets=(8,), max_queue=1))
+    engine.start = lambda: engine  # dispatcher never runs: pure backpressure
+    p, t = np.asarray([0.9], np.float32), np.asarray([1], np.int32)
+    engine.submit(p, t, timeout=0.2)  # fills the queue
+    with pytest.raises(BackpressureTimeout) as ei:
+        engine.submit(p, t, timeout=0.3)
+    msg = str(ei.value)
+    assert "queue full (1/1 batches)" in msg
+    assert "0 device steps in flight" in msg
+    assert "oldest queued item" in msg and "s old" in msg
+    # the age is the REAL residency of the first (stuck) item: at least the
+    # second submit's whole timeout window
+    import re
+
+    age = float(re.search(r"oldest queued item (\d+\.\d+)s old", msg).group(1))
+    assert age >= 0.3
+    assert "alive but not draining" in msg or "dead" in msg
+
+
+# ------------------------------------------------------------------ slow half
+
+
+@pytest.mark.parametrize("target_world", [1, 4])
+def test_reshard_roundtrip_property_delta_deferred(tmp_path, devices, target_world):
+    """Acceptance: snapshot at world 2 -> restore into {shrunk(1), grown(4)}
+    deferred topology -> replay from the cursor is EXACT for delta metrics."""
+    snapdir = str(tmp_path / "snaps")
+    batches = _batches(sizes=(5, 17, 8, 3, 12, 9, 32, 7), seed=3)
+    want = _want(batches)
+    cut = 5
+    src = StreamingEngine(
+        _collection(),
+        EngineConfig(
+            buckets=BUCKETS, mesh=_mesh(2), axis="dp", mesh_sync="deferred",
+            snapshot_dir=snapdir,
+        ),
+    )
+    with src:
+        for b in batches[:cut]:
+            src.submit(*b)
+        src.snapshot()
+    dst = StreamingEngine(
+        _collection(),
+        EngineConfig(
+            buckets=BUCKETS, mesh=_mesh(target_world), axis="dp",
+            mesh_sync="deferred", snapshot_dir=snapdir,
+        ),
+    )
+    meta = dst.restore()
+    assert int(meta["batches_done"]) == cut
+    with dst:
+        for b in batches[cut:]:
+            dst.submit(*b)
+        got = {k: np.asarray(v) for k, v in dst.result().items()}
+    for k in want:
+        assert np.array_equal(got[k], want[k])
+
+
+def test_reshard_roundtrip_property_cat_refuses_across_worlds(tmp_path, devices):
+    """Acceptance: cat/scan states (per-shard capacity buffers) have no exact
+    cross-world form — the restore refuses loudly and typed, and a same-world
+    restore replays exactly."""
+    snapdir = str(tmp_path / "snaps")
+    batches = _batches(sizes=(5, 9, 8, 6), seed=4)
+    src = StreamingEngine(
+        AUROC(capacity=64),
+        EngineConfig(
+            buckets=BUCKETS, mesh=_mesh(2), axis="dp", mesh_sync="deferred",
+            snapshot_dir=snapdir,
+        ),
+    )
+    oracle = StreamingEngine(
+        AUROC(capacity=64),
+        EngineConfig(buckets=BUCKETS, mesh=_mesh(2), axis="dp", mesh_sync="deferred"),
+    )
+    with src, oracle:
+        for p, t in batches[:2]:
+            src.submit(p, t)
+            oracle.submit(p, t)
+        src.snapshot()
+        for p, t in batches[2:]:
+            oracle.submit(p, t)
+        want = np.asarray(oracle.result())
+    grown = StreamingEngine(
+        AUROC(capacity=64),
+        EngineConfig(
+            buckets=BUCKETS, mesh=_mesh(4), axis="dp", mesh_sync="deferred",
+            snapshot_dir=snapdir,
+        ),
+    )
+    with pytest.raises(MetricsTPUUserError, match="cat-state|shard count"):
+        grown.restore()
+    same = StreamingEngine(
+        AUROC(capacity=64),
+        EngineConfig(
+            buckets=BUCKETS, mesh=_mesh(2), axis="dp", mesh_sync="deferred",
+            snapshot_dir=snapdir,
+        ),
+    )
+    same.restore()
+    with same:
+        for p, t in batches[2:]:
+            same.submit(p, t)
+        assert np.array_equal(np.asarray(same.result()), want)
+
+
+@pytest.mark.parametrize("target", [(2, 2), (8, 2), (4, 3)])
+def test_reshard_roundtrip_property_stream_shard_factor(tmp_path, devices, target):
+    """Acceptance: a stream-sharded snapshot at (world=4, resident=2)
+    restores into a CHANGED stream-shard factor — shrunk world, grown world,
+    changed residency — and replay from the cursor is exact per stream."""
+    S = 16
+    snapdir = str(tmp_path / "snaps")
+    traffic = zipf_traffic(S, 28, seed=11, max_rows=6)
+    cut = 18
+    oracle = MultiStreamEngine(_collection(), S, EngineConfig(buckets=BUCKETS))
+    with oracle:
+        for sid, p, t in traffic:
+            oracle.submit(sid, p, t)
+        want = {
+            sid: {k: np.asarray(v) for k, v in r.items()}
+            for sid, r in oracle.results().items()
+        }
+    src = MultiStreamEngine(
+        _collection(), S,
+        EngineConfig(
+            buckets=BUCKETS, mesh=_mesh(4), axis="dp", mesh_sync="deferred",
+            snapshot_dir=snapdir,
+        ),
+        stream_shard=True, resident_streams=2,
+    )
+    with src:
+        for sid, p, t in traffic[:cut]:
+            src.submit(sid, p, t)
+        src.snapshot()
+        assert src._pager.spilled_count() > 0  # the snapshot covered spilled rows
+    w, r = target
+    dst = MultiStreamEngine(
+        _collection(), S,
+        EngineConfig(
+            buckets=BUCKETS, mesh=_mesh(w), axis="dp", mesh_sync="deferred",
+            snapshot_dir=snapdir,
+        ),
+        stream_shard=True, resident_streams=r,
+    )
+    meta = dst.restore()
+    assert int(meta["batches_done"]) == cut
+    with dst:
+        for sid, p, t in traffic[cut:]:
+            dst.submit(sid, p, t)
+        got = {
+            sid: {k: np.asarray(v) for k, v in rr.items()}
+            for sid, rr in dst.results().items()
+        }
+    for sid in want:
+        for k in want[sid]:
+            assert np.array_equal(got[sid][k], want[sid][k], equal_nan=True), (
+                f"stream {sid} {k}: {got[sid][k]} != {want[sid][k]}"
+            )
+
+
+def test_live_grow_and_shrink_under_traffic(devices):
+    """The live (in-place) half on the real multi-world mesh: manual
+    reshard() shrinks 4->2 and grows 2->8 between traffic phases, and the
+    final result is bit-identical to the single-device oracle."""
+    batches = _batches(sizes=(5, 17, 8, 3, 12, 9), seed=6)
+    want = _want(batches)
+    eng = StreamingEngine(
+        _collection(),
+        EngineConfig(buckets=BUCKETS, mesh=_mesh(4), axis="dp", mesh_sync="deferred"),
+    )
+    with eng:
+        for b in batches[:2]:
+            eng.submit(*b)
+        eng.reshard(world=2)
+        for b in batches[2:4]:
+            eng.submit(*b)
+        eng.reshard(world=8)
+        for b in batches[4:]:
+            eng.submit(*b)
+        got = {k: np.asarray(v) for k, v in eng.result().items()}
+    for k in want:
+        assert np.array_equal(got[k], want[k])
+    assert eng.stats.reshards == 2 and eng._world == 8
+
+
+def test_shard_loss_auto_reshard_on_multiworld_mesh(devices):
+    """A non-transient shard loss with the elastic floor armed degrades the
+    engine to the surviving world IN PLACE — serving continues and results
+    stay bit-identical (the fault fires before anything folds)."""
+    S = 16
+    traffic = zipf_traffic(S, 20, seed=21, max_rows=6)
+    oracle = MultiStreamEngine(_collection(), S, EngineConfig(buckets=BUCKETS))
+    with oracle:
+        for sid, p, t in traffic:
+            oracle.submit(sid, p, t)
+        want = {
+            sid: {k: np.asarray(v) for k, v in r.items()}
+            for sid, r in oracle.results().items()
+        }
+    inj = FaultInjector(
+        seed=3, plan={"shard_loss": FaultSpec(schedule=(4,), transient=False)}
+    )
+    eng = MultiStreamEngine(
+        _collection(), S,
+        EngineConfig(
+            buckets=BUCKETS, mesh=_mesh(4), axis="dp", mesh_sync="deferred",
+            fault_injector=inj, elastic_min_world=2,
+        ),
+        stream_shard=True, resident_streams=2,
+    )
+    with eng:
+        for sid, p, t in traffic:
+            eng.submit(sid, p, t)
+        got = {
+            sid: {k: np.asarray(v) for k, v in r.items()}
+            for sid, r in eng.results().items()
+        }
+    assert eng._world == 2
+    last = eng.stats.reshard_last
+    assert last["auto"] and last["from_world"] == 4 and last["to_world"] == 2
+    for sid in want:
+        for k in want[sid]:
+            assert np.array_equal(got[sid][k], want[sid][k], equal_nan=True)

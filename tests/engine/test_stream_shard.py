@@ -385,17 +385,34 @@ def test_restore_matrix_same_world_and_merged(tmp_path):
         _assert_same(_results_np(merged), want)
 
 
-def test_restore_refuses_other_topologies(tmp_path):
+def test_restore_crosses_topologies_and_refuses_wrong_stream_count(tmp_path):
+    """Since ISSUE 11 the stream-shard restore matrix covers DIFFERENT
+    (world, resident) topologies: rows reassemble host-side and seed the new
+    pager's spill store (the live-reshard path), so a changed residency
+    restores EXACTLY instead of refusing. A mismatched stream count still
+    refuses loudly — there is no right way to invent or drop streams."""
     snapdir = str(tmp_path / "snaps")
+    traffic = zipf_traffic(S, 12, seed=17)
+    cut = 8
     eng = _sharded(snapshot_dir=snapdir)
-    with eng:
-        for sid, p, t in zipf_traffic(S, 8, seed=17):
+    oracle = MultiStreamEngine(
+        _collection(), S, EngineConfig(buckets=BUCKETS), aot_cache=_CACHE
+    )
+    with eng, oracle:
+        for sid, p, t in traffic[:cut]:
             eng.submit(sid, p, t)
         eng.snapshot()
-    # different (world, resident): slot tables are not portable
+        for sid, p, t in traffic:
+            oracle.submit(sid, p, t)
+        want = _results_np(oracle)
+    # different residency: spill-seeded restore + replay from the cursor
     other = _sharded(resident=RESIDENT + 1, snapshot_dir=snapdir)
-    with pytest.raises(MetricsTPUUserError, match="SAME"):
-        other.restore()
+    meta = other.restore()
+    assert int(meta["batches_done"]) == cut
+    with other:
+        for sid, p, t in traffic[cut:]:
+            other.submit(sid, p, t)
+        _assert_same(_results_np(other), want)
     # different S
     wrong_s = MultiStreamEngine(
         _collection(), S + 1, EngineConfig(buckets=BUCKETS, snapshot_dir=snapdir)

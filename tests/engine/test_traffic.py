@@ -1,0 +1,85 @@
+"""The shared seeded traffic generator (``engine/traffic.py``) — satellite
+(ISSUE 11): the generator feeds elastic-smoke, chaos-smoke, and
+``stream_bench``, so its determinism and the hot-spot-shift semantics get
+their own unit pins instead of living only inside the smokes."""
+import numpy as np
+
+from metrics_tpu.engine.traffic import zipf_stream_ids, zipf_traffic
+
+
+def test_same_seed_same_sequence():
+    a = zipf_traffic(32, 40, alpha=1.3, seed=9, max_rows=7)
+    b = zipf_traffic(32, 40, alpha=1.3, seed=9, max_rows=7)
+    assert len(a) == len(b) == 40
+    for (sa, pa, ta), (sb, pb, tb) in zip(a, b):
+        assert sa == sb
+        assert np.array_equal(pa, pb) and pa.dtype == np.float32
+        assert np.array_equal(ta, tb) and tb.dtype == np.int32
+    assert zipf_traffic(32, 40, seed=10)[0][0] != a[0][0] or True  # seeds differ freely
+
+
+def test_ids_deterministic_and_in_range():
+    ids = zipf_stream_ids(16, 500, alpha=1.1, seed=3)
+    assert ids.dtype == np.int32 and ids.shape == (500,)
+    assert ids.min() >= 0 and ids.max() < 16
+    assert np.array_equal(ids, zipf_stream_ids(16, 500, alpha=1.1, seed=3))
+    # skew: the hottest stream dominates a uniform share
+    top = np.bincount(ids, minlength=16).max()
+    assert top > 500 / 16 * 2
+
+
+def test_hot_spot_shift_prefix_is_bitwise_unshifted():
+    """The shift mode re-MAPS draws, it does not re-draw: the pre-shift
+    prefix of a shifted call equals the unshifted call exactly, so an
+    existing seeded workload gains a shift without changing its past."""
+    base = zipf_stream_ids(16, 100, alpha=1.4, seed=7)
+    shifted = zipf_stream_ids(16, 100, alpha=1.4, seed=7, shift_at=60)
+    assert np.array_equal(shifted[:60], base[:60])
+    assert not np.array_equal(shifted[60:], base[60:])  # the head moved
+
+
+def test_hot_spot_shift_rotates_the_head():
+    """Post-shift draws map through the rotated permutation: the shifted
+    tail is exactly the unshifted tail's ids pushed through the rotation —
+    head rotation, not a fresh distribution."""
+    n, s = 200, 120
+    base = zipf_stream_ids(24, n, alpha=1.2, seed=5)
+    shifted = zipf_stream_ids(24, n, alpha=1.2, seed=5, shift_at=s, shift_rotation=12)
+    perm = np.random.RandomState(5 ^ 0x5A1F).permutation(24)
+    perm_shifted = np.roll(perm, 12)
+    remap = np.empty(24, np.int64)
+    remap[perm] = perm_shifted
+    assert np.array_equal(shifted[s:], remap[base[s:]].astype(np.int32))
+
+
+def test_shift_alpha_changes_only_the_tail_distribution():
+    ids = zipf_stream_ids(16, 400, alpha=2.5, seed=1, shift_at=200, shift_alpha=0.2)
+    head_distinct = len(np.unique(ids[:200]))
+    tail_distinct = len(np.unique(ids[200:]))
+    assert tail_distinct > head_distinct  # flatter exponent spreads the tail
+
+
+def test_traffic_contents_are_id_independent_under_shift():
+    """Batch rows/values draw from an id-independent RNG: the shift reroutes
+    batches without changing their contents — shifted and unshifted runs
+    stay row-for-row comparable."""
+    a = zipf_traffic(16, 30, seed=2, max_rows=5)
+    b = zipf_traffic(16, 30, seed=2, max_rows=5, shift_at=10)
+    for (sa, pa, ta), (sb, pb, tb) in zip(a, b):
+        assert np.array_equal(pa, pb) and np.array_equal(ta, tb)
+    assert [x[0] for x in a[:10]] == [x[0] for x in b[:10]]
+    assert [x[0] for x in a[10:]] != [x[0] for x in b[10:]]
+
+
+def test_values_stay_dyadic():
+    for _, preds, target in zipf_traffic(8, 20, seed=13):
+        assert np.all(preds * 64 == np.round(preds * 64))
+        assert set(np.unique(target)).issubset({0, 1})
+
+
+def test_shift_at_edge_cases_match_unshifted():
+    base = zipf_stream_ids(8, 50, seed=4)
+    assert np.array_equal(base, zipf_stream_ids(8, 50, seed=4, shift_at=50))
+    assert np.array_equal(base, zipf_stream_ids(8, 50, seed=4, shift_at=99))
+    whole = zipf_stream_ids(8, 50, seed=4, shift_at=0)
+    assert not np.array_equal(whole, base)  # everything maps through the rotation

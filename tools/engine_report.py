@@ -16,7 +16,11 @@ latency under ``mesh_sync="step"`` vs boundary-merge time under
 engine saw any fault activity (ISSUE 6), the fault block: injected faults by
 site, recovery actions (retries, rollbacks, kernel demotions, coalesce
 shrinks, watchdog expiries), the quarantine ledger totals, and snapshot
-write-failure/restore-fallback counts.
+write-failure/restore-fallback counts. Engines running the ISSUE 11
+self-defense layer additionally render the admission block (admitted/
+rejected/shed by priority class, degradation-ladder level + transitions,
+deferred stale reads) and the elastic-reshard row (count + the last
+world→world transition and its replay cursor).
 When the engine ran with a flight recorder (``EngineConfig(trace=...)``,
 PR 8) the document carries a ``trace`` section and the report renders the
 trace/SLO block: spans recorded/dropped, latency histogram counts, and the
@@ -123,6 +127,45 @@ def render(doc: dict, steps: int = 10) -> str:
                     else " (per-step blocked sync: collective + in-step compute)"
                 ),
             ),
+        )
+    admission = s.get("admission")
+    if admission:
+        def _by_prio(d):
+            return (
+                ", ".join(f"p{k}×{v}" for k, v in sorted(d.items())) if d else "none"
+            )
+
+        rows.append(
+            (
+                "admission (adm/rej/shed)",
+                f"{_by_prio(admission.get('admitted_by_priority', {}))} / "
+                f"{_by_prio(admission.get('rejected_by_priority', {}))} / "
+                f"{_by_prio(admission.get('shed_by_priority', {}))}",
+            )
+        )
+        rows.append(
+            (
+                "degradation ladder",
+                f"level {_fmt(admission.get('ladder_level'))} · "
+                f"{_fmt(admission.get('ladder_transitions'))} transitions · "
+                f"{_fmt(admission.get('deferred_reads'))} deferred reads",
+            )
+        )
+    reshard = s.get("reshard")
+    if reshard:
+        last = reshard.get("last") or {}
+        rows.append(
+            (
+                "elastic reshards",
+                f"{_fmt(reshard.get('reshards'))}"
+                + (
+                    f" (last: world {last.get('from_world')}→{last.get('to_world')}"
+                    f" at cursor {last.get('cursor')}"
+                    f"{', auto' if last.get('auto') else ''})"
+                    if last
+                    else ""
+                ),
+            )
         )
     paging = s.get("paging")
     if paging:
