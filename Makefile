@@ -2,9 +2,9 @@
 # Default flow runs the smoke checks (seconds) before the full suite.
 # Sidecar artifacts (telemetry JSON, analysis reports) land under out/
 # (gitignored) — never in the repo root.
-.PHONY: all test engine-smoke kernels-smoke mesh-smoke streams-smoke chaos-smoke obs-smoke quant-smoke elastic-smoke analyze clean native bench
+.PHONY: all test engine-smoke kernels-smoke mesh-smoke streams-smoke chaos-smoke obs-smoke quant-smoke elastic-smoke windows-smoke analyze clean native bench
 
-all: engine-smoke kernels-smoke mesh-smoke streams-smoke chaos-smoke obs-smoke quant-smoke elastic-smoke analyze test
+all: engine-smoke kernels-smoke mesh-smoke streams-smoke chaos-smoke obs-smoke quant-smoke elastic-smoke windows-smoke analyze test
 
 test:
 	python -m pytest tests/ -q
@@ -83,6 +83,18 @@ quant-smoke:
 # "Overload & elasticity".
 elastic-smoke:
 	JAX_PLATFORMS=cpu python -m metrics_tpu.engine.elastic_smoke
+
+# Windowed-semantics gate, CPU-safe (bootstraps the 8-device virtual mesh,
+# metrics_tpu/engine/windows_smoke.py): tumbling panes bit-exact vs a
+# fresh-engine-per-pane oracle on a deferred mesh, sliding fold exact vs
+# recompute, >=3 pane rotations with an AOT miss-counter delta of ZERO
+# (rotation is a slot bump + cached init-fill, never a retrace), window x
+# stream-shard parity through a real pane spill (Zipf streams, resident cap),
+# kill/resume MID-RING with exact replay (pane cursor from snapshot
+# provenance), and a seeded label-drift stream raising a deterministic drift
+# alarm. Docs: docs/serving.md "Windowed metrics".
+windows-smoke:
+	JAX_PLATFORMS=cpu python -m metrics_tpu.engine.windows_smoke
 
 # Static-analysis gate, CPU-safe (metrics_tpu/analysis + tools/analyze.py):
 # program plane audits the bootstrap engine matrix ({step,deferred} x

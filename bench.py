@@ -1644,8 +1644,10 @@ def bench_obs_overhead() -> dict:
         / reps * 1e6
     )
     # 8 trace consult sites + the admission check per submit + the ladder
-    # check per group (ISSUE 11) — both None-gated exactly like tracing
-    sites_per_step = 10
+    # check per group (ISSUE 11) — both None-gated exactly like tracing —
+    # plus the window layer's gates (ISSUE 13): the pane-prepend check per
+    # padded step and the two rotation-cadence gates per group
+    sites_per_step = 13
     disabled_frac = per_check_us * sites_per_step / step_us_off
     if disabled_frac > 0.01:
         # the cost-model bound: the by-construction cost of the contract
@@ -1659,10 +1661,11 @@ def bench_obs_overhead() -> dict:
             f"({sites_per_step} sites x {per_check_us:.4f}µs/check)"
         )
 
-    # structural leak guard: with tracing off AND no admission policy/ladder
-    # configured, no code from the trace module OR the admission module may
-    # run on the hot path (the ISSUE 11 disabled-path contract extends PR
-    # 8's: one `is not None` check per site, nothing else). A per-thread
+    # structural leak guard: with tracing off AND no admission policy/
+    # ladder/window/drift configured, no code from the trace, admission,
+    # windows, or tracker modules may run on the hot path (the ISSUE 13
+    # disabled-path contract extends PR 8's and PR 11's: one `is not None`
+    # check per site, nothing else). A per-thread
     # call profiler (armed BEFORE the probe engine spawns its dispatcher
     # thread) watches a short off-path stream; any call into either module
     # is a leak past a missing None gate.
@@ -1671,8 +1674,13 @@ def bench_obs_overhead() -> dict:
 
     from metrics_tpu.engine import admission as _admission_mod
     from metrics_tpu.engine import trace as _trace_mod
+    from metrics_tpu.engine import tracker as _tracker_mod
+    from metrics_tpu.engine import windows as _windows_mod
 
-    _watched_files = {_trace_mod.__file__, _admission_mod.__file__}
+    _watched_files = {
+        _trace_mod.__file__, _admission_mod.__file__,
+        _windows_mod.__file__, _tracker_mod.__file__,
+    }
     leaks: list = []
 
     def _profiler(frame, event, arg):
@@ -1693,7 +1701,7 @@ def bench_obs_overhead() -> dict:
         probe.stop()
     if leaks:
         raise RuntimeError(
-            "disabled-path hot path executed trace/admission-module code: "
+            "disabled-path hot path executed trace/admission/window-module code: "
             f"{sorted(set(leaks))[:5]} — work leaked past a None gate"
         )
 
@@ -1713,10 +1721,11 @@ def bench_obs_overhead() -> dict:
             "fixed-seed 40x256-row stream, buckets (256,), coalesce off; 1 "
             "warmup + 5 timed repeat streams per config, A/B interleaved; "
             "median per-step wall; asserted guards: (1) cost model - measured "
-            "None-check cost x 10 sites (trace + admission/ladder) <= 1% of "
-            "the disabled step; (2) structural - a profiled off-path run "
-            "executes zero trace- or admission-module code (timing A/B "
-            "cannot see leaked unconditional work)"
+            "None-check cost x 13 sites (trace + admission/ladder + window "
+            "gates) <= 1% of the disabled step; (2) structural - a profiled "
+            "off-path run executes zero trace-, admission-, window-, or "
+            "tracker-module code (timing A/B cannot see leaked "
+            "unconditional work)"
         ),
         # host dispatcher walls on CPU: noise-bound — the guards are the claim
         "liveness_only": True,

@@ -118,6 +118,27 @@ def bootstrap_engines(
                 engine.submit(*b)
             engine.result()
         out.append((f"reshard/arena/single/{backend}", engine))
+        # WINDOWED engine (ISSUE 13): a sliding pane ring driven through TWO
+        # real rotations — the audited step is the runtime-pane-indexed
+        # ring update ((panes, n) carried buffers, one dynamic-update per
+        # dtype), the fold/rotate programs are in the owned set, and the
+        # compile-cap rule pins that two rotations compiled NOTHING new (a
+        # rotation that retraced would blow the windowed cap; broken-fixture
+        # proof: tests/analysis/test_engine_audit.py)
+        from metrics_tpu.engine import WindowPolicy
+
+        engine = StreamingEngine(
+            MetricCollection([Accuracy(), MeanSquaredError()]),
+            EngineConfig(
+                buckets=(8,), kernel_backend=backend, coalesce=1,
+                window=WindowPolicy.sliding(n_panes=2, pane_batches=2),
+            ),
+        )
+        with engine:
+            for b in batches:  # 4 batches -> rotations at 2 and 4
+                engine.submit(*b)
+            engine.result()
+        out.append((f"windowed/arena/single/{backend}", engine))
     return out
 
 

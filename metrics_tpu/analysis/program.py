@@ -210,6 +210,17 @@ class EngineAnalysis:
                     for k, n in engine._layout.buffer_sizes().items():
                         shard_shapes.add(((engine._resident, n), k))
                         shard_shapes.add(((engine._world, engine._resident, n), k))
+                elif getattr(engine, "_win_stacked", False):
+                    # the PANE-RING carried forms (ISSUE 13): the windowed
+                    # step's ONE runtime-indexed dynamic-update per dtype
+                    # into the (panes, n) ring is the design, not a
+                    # degradation — only per-leaf writes into the flat (n,)
+                    # pane ROW mean the pack fell apart (and on a 1-device
+                    # deferred mesh (panes, n) can collide with the default
+                    # (world, n) signature, so the explicit set is required)
+                    shard_shapes = {
+                        ((n,), k) for k, n in engine._layout.buffer_sizes().items()
+                    }
                 report.extend(R.check_arena_pack_fused(
                     jaxpr, engine._layout, where=where,
                     worlds=(engine._world,) if deferred else (),
@@ -248,17 +259,32 @@ class EngineAnalysis:
         n_owned = self._owned_programs(engine)
         if n_owned is not None:
             multistream = hasattr(engine, "num_streams")
+            # windowed engines (ISSUE 13) own a bounded fixed set of EXTRA
+            # programs — one rotate/decay plus the window fold variants —
+            # and NOTHING per rotation: a rotation that retraced the step
+            # (pane index baked as a constant, policy drifting the key)
+            # blows past this cap exactly like any other open program set
+            windowed = getattr(engine, "_window", None) is not None
+            win_extra = 0
+            if windowed:
+                win_extra = 1  # rotate (ring) or decay (ewma)
+                if engine._window.kind == "sliding":
+                    win_extra += 1  # indexed pane_value / sliding row folds
+                if getattr(engine, "_stream_shard", False) and engine._window.stacked:
+                    win_extra += 1  # batched sliding fold over reassembled rows
             cap = (
                 len(engine._cfg.buckets) * max(1, len(structures))
                 + 1                           # compute
                 + (1 if deferred else 0)      # boundary merge
                 + (1 if multistream else 0)   # batched all-streams compute
+                + win_extra
             )
             cap_detail = (
                 f"{len(engine._cfg.buckets)} buckets x {max(1, len(structures))} "
                 f"payload structures + compute"
                 + (" + merge" if deferred else "")
                 + (" + batched results" if multistream else "")
+                + (f" + {win_extra} window programs" if win_extra else "")
             )
             report.extend(R.check_compile_cap(
                 n_owned, cap, where=f"{label}/programs", detail=cap_detail
@@ -300,6 +326,17 @@ class EngineAnalysis:
 
             info = [
                 (fx, jax.ShapeDtypeStruct((int(n_streams),) + tuple(leaf.shape), leaf.dtype), prec)
+                for fx, leaf, prec in info
+            ]
+        # ring windows stack the pane axis OUTSIDE the stream axis — the
+        # deferred boundary merge moves pane-stacked states, so the expected
+        # bundle scales by the live pane count too (ISSUE 13)
+        if getattr(engine, "_win_stacked", False):
+            import jax
+
+            panes = int(engine._panes)
+            info = [
+                (fx, jax.ShapeDtypeStruct((panes,) + tuple(leaf.shape), leaf.dtype), prec)
                 for fx, leaf, prec in info
             ]
         return info

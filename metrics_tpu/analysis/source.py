@@ -82,6 +82,11 @@ _ENGINE_LOCKED_METHODS = frozenset({
     "_engage_rung", "_release_rung", "_engage_quantize", "_release_quantize",
     "_refresh_policy_identity", "_apply_topology", "_apply_topology_state",
     "_invalidate_topology_memos",
+    # ISSUE 13: pane rotation runs inside _process_group_locked's lock hold
+    # (_maybe_rotate_locked -> _rotate_once_locked -> plan/commit); windowed
+    # readers run under result()/results()' lock hold
+    "_plan_rotation", "_commit_rotation", "_record_drift",
+    "_windowed_row_result", "_sharded_results_values",
 })
 
 #: path-suffix -> declared discipline. The analyzer applies the spec whose

@@ -108,6 +108,8 @@ from metrics_tpu.engine.trace import (
     device_trace_session,
     render_openmetrics,
 )
+from metrics_tpu.engine.tracker import DriftAlarm, DriftAlarmError, DriftDetector
+from metrics_tpu.engine.windows import WindowPolicy
 
 __all__ = [
     "AdmissionPolicy",
@@ -120,6 +122,9 @@ __all__ = [
     "BucketPolicy",
     "DEFAULT_LATENCY_BUCKETS_US",
     "DegradationLadder",
+    "DriftAlarm",
+    "DriftAlarmError",
+    "DriftDetector",
     "EngineConfig",
     "EngineDispatchError",
     "EngineStats",
@@ -136,6 +141,7 @@ __all__ = [
     "StreamingEngine",
     "TokenBucket",
     "TraceRecorder",
+    "WindowPolicy",
     "decode_state_tree",
     "device_trace_session",
     "enable_persistent_compilation_cache",

@@ -123,6 +123,17 @@ class ArenaLayout:
             for k, n in self._totals.items()
         }
 
+    def abstract_paned(self, panes: int) -> Dict[str, jax.ShapeDtypeStruct]:
+        """``ShapeDtypeStruct`` dict of the PANE-RING arena (ISSUE 13): one
+        ``(panes, n)`` buffer per dtype, row ``p`` = pane ``p``'s packed state
+        for this layout. The windowed engine's single-device carried form —
+        the step updates one runtime-indexed row, rotation init-fills one row,
+        and ``result()`` folds the live rows via ``merge_stacked_states``.
+        Structurally identical to :meth:`abstract_stacked`; the separate name
+        keeps the two leading-axis meanings (shard vs pane) distinct at call
+        sites."""
+        return self.abstract_stacked(panes)
+
     def abstract_stream_stacked(self, world: int, rows: int) -> Dict[str, jax.ShapeDtypeStruct]:
         """``ShapeDtypeStruct`` dict of the STREAM-SHARDED paged arena: one
         ``(world, rows, n)`` buffer per dtype, where this layout describes ONE
@@ -138,17 +149,29 @@ class ArenaLayout:
             for k, n in self._totals.items()
         }
 
-    def matches(self, arena: Dict[str, Any], world: Optional[int] = None) -> bool:
+    def matches(
+        self,
+        arena: Dict[str, Any],
+        world: Optional[int] = None,
+        panes: Optional[int] = None,
+    ) -> bool:
         """Shape/dtype compatibility of the BUFFERS (used when restoring
         snapshots); with ``world`` the expected form is the shard-stacked
-        ``(world, n)`` layout. Necessary but not sufficient — two layouts with
-        permuted same-dtype leaves have identical buffers; :meth:`fingerprint`
-        is the sufficient check and travels in the snapshot meta."""
+        ``(world, n)`` layout, with ``panes`` the pane-ring ``(panes, n)``
+        form, and with both the deferred windowed ``(world, panes, n)`` form.
+        Necessary but not sufficient — two layouts with permuted same-dtype
+        leaves have identical buffers; :meth:`fingerprint` is the sufficient
+        check and travels in the snapshot meta."""
         if set(arena) != set(self._totals):
             return False
-        expect = (lambda n: (int(world), n)) if world is not None else (lambda n: (n,))
+        lead: Tuple[int, ...] = ()
+        if world is not None:
+            lead += (int(world),)
+        if panes is not None:
+            lead += (int(panes),)
         return all(
-            tuple(getattr(arena[k], "shape", ())) == expect(n) for k, n in self._totals.items()
+            tuple(getattr(arena[k], "shape", ())) == lead + (n,)
+            for k, n in self._totals.items()
         )
 
     def fingerprint(self) -> str:
@@ -202,11 +225,14 @@ class ArenaLayout:
 
     # ------------------------------------------------------ shard-stacked views
 
-    def pack_stacked(self, state: Any) -> Dict[str, Any]:
-        """Shard-stacked state pytree (leading axis = shard) -> per-dtype
-        ``(world, n)`` buffers: the per-shard packing applied row-wise. The
-        deferred-sync engine's carried form — dim 0 shards over the mesh axis,
-        so inside the step each device packs/unpacks only its own row."""
+    def pack_stacked(self, state: Any, lead: int = 1) -> Dict[str, Any]:
+        """Stacked state pytree (``lead`` leading stack axes) -> per-dtype
+        ``leading + (n,)`` buffers: the per-row packing applied row-wise.
+        ``lead=1`` is the deferred-sync engine's shard-stacked ``(world, n)``
+        carried form (dim 0 shards over the mesh axis, so inside the step each
+        device packs/unpacks only its own row) and the windowed engine's
+        pane-ring ``(panes, n)`` form; ``lead=2`` is the deferred WINDOWED
+        ``(world, panes, n)`` form (ISSUE 13)."""
         leaves = jax.tree_util.tree_flatten(state)[0]
         if len(leaves) != len(self._specs):
             raise ValueError(
@@ -215,23 +241,25 @@ class ArenaLayout:
         parts: Dict[str, List[Any]] = {k: [] for k in self._totals}
         for leaf, spec in zip(leaves, self._specs):
             arr = jnp.asarray(leaf, spec.dtype)
-            parts[spec.key].append(jnp.reshape(arr, (arr.shape[0], spec.size)))
+            parts[spec.key].append(
+                jnp.reshape(arr, tuple(arr.shape[:lead]) + (spec.size,))
+            )
         return {
-            k: (jnp.concatenate(chunks, axis=1) if len(chunks) > 1 else chunks[0])
+            k: (jnp.concatenate(chunks, axis=lead) if len(chunks) > 1 else chunks[0])
             for k, chunks in parts.items()
         }
 
-    def unpack_stacked(self, arena: Dict[str, Any]) -> Any:
-        """Inverse of :meth:`pack_stacked`: ``(world, n)`` buffers -> the
-        shard-stacked state pytree (every leaf gains a leading ``world`` axis).
-        This is the MERGED-VIEW precursor: feeding the result to
-        ``Metric.merge_stacked_states`` yields the global state the reference's
-        ``dist_reduce_fx`` sync would produce."""
+    def unpack_stacked(self, arena: Dict[str, Any], lead: int = 1) -> Any:
+        """Inverse of :meth:`pack_stacked`: ``leading + (n,)`` buffers -> the
+        stacked state pytree (every leaf gains the ``lead`` leading axes).
+        With ``lead=1`` this is the MERGED-VIEW precursor: feeding the result
+        to ``Metric.merge_stacked_states`` yields the global state the
+        reference's ``dist_reduce_fx`` sync would produce."""
         first = next(iter(arena.values()))
-        world = int(jnp.shape(first)[0])
+        leading = tuple(int(d) for d in jnp.shape(first)[:lead])
         leaves = [
             jnp.reshape(
-                arena[s.key][:, s.offset : s.offset + s.size], (world,) + s.shape
+                arena[s.key][..., s.offset : s.offset + s.size], leading + s.shape
             )
             for s in self._specs
         ]

@@ -125,10 +125,27 @@ def chaos_injectors():
     suspected shard loss on the third chunk (rollback + in-place retry;
     the non-transient loss with auto-reshard is ``make elastic-smoke``'s
     8-device claim), and reshard capture/restore transients under a manual
-    ``reshard()``."""
+    ``reshard()``. ``windows`` (seed 41, ISSUE 13) fires the pane-rotation
+    plan phase and the closing-pane drift evaluation transiently — both are
+    pure plan reads ahead of the commit, so the retry must neither
+    double-decay/double-clear a pane nor double-record a drift series
+    (pinned against fault-free windowed twins)."""
     from metrics_tpu.engine import FaultInjector, FaultSpec
 
     return {
+        "windows": FaultInjector(
+            seed=41,
+            plan={
+                # first rotation's plan and first drift evaluation fail
+                # transiently; the plan/commit split re-runs both against
+                # the untouched carry/detector
+                "pane_rotate": FaultSpec(schedule=(0,)),
+                "drift_eval": FaultSpec(schedule=(0,)),
+            },
+        ),
+        "ewma": FaultInjector(
+            seed=43, plan={"pane_rotate": FaultSpec(schedule=(0,))}
+        ),
         "elastic": FaultInjector(
             seed=37,
             plan={
@@ -270,6 +287,35 @@ def elastic_engine_config(injector, trace=None):
     return EngineConfig(
         buckets=(8, 32), coalesce=1, mesh=mesh, axis="dp", mesh_sync="deferred",
         admission=AdmissionPolicy(rows_per_s=1e9, burst_rows=1e9),
+        fault_injector=injector, trace=trace,
+    )
+
+
+def windowed_engine_config(injector, trace=None, window=None, drift=None):
+    """The windowed-semantics chaos probe (ISSUE 13): a sliding pane ring
+    with a wired drift detector — ``pane_rotate`` fires in the rotation's
+    PLAN phase (the non-donated rotate program re-runs against the untouched
+    carry) and ``drift_eval`` in the closing-pane read (re-read, recorded
+    once). ``coalesce=1`` for span-sequence determinism like every phase."""
+    from metrics_tpu.engine import DriftDetector, EngineConfig, WindowPolicy
+
+    return EngineConfig(
+        buckets=(8, 32), coalesce=1,
+        window=window or WindowPolicy.sliding(n_panes=2, pane_batches=3),
+        drift=drift or DriftDetector(threshold=0.05, up_after=1, down_after=1),
+        fault_injector=injector, trace=trace,
+    )
+
+
+def ewma_engine_config(injector, trace=None):
+    """The EWMA double-decay probe: a float-sum metric under an ewma window
+    with a transient ``pane_rotate`` — the decayed result must stay
+    BIT-identical to a fault-free ewma twin (one decay per rotation, ever)."""
+    from metrics_tpu.engine import EngineConfig, WindowPolicy
+
+    return EngineConfig(
+        buckets=(8, 32), coalesce=1,
+        window=WindowPolicy.ewma(alpha=0.5, pane_batches=3),
         fault_injector=injector, trace=trace,
     )
 
@@ -552,6 +598,86 @@ def main(out_path: str = "out/chaos_telemetry.json") -> int:
         f"admission block did not admit every batch: {adm}",
     )
     fired_sites |= set(elastic_inj.fired)
+
+    # ----------------- windowed semantics: rotation + drift eval under chaos
+    # (ISSUE 13) a sliding pane ring with a wired drift detector: the first
+    # rotation's PLAN and the first closing-pane drift read both fail
+    # transiently — the plan/commit split retries them against the untouched
+    # carry/detector, so the windowed result AND the per-pane drift history
+    # must be BIT-identical to a fault-free windowed twin (a double-cleared
+    # pane or a double-recorded series would diverge both).
+    from metrics_tpu.engine import DriftDetector
+
+    win_inj = injs["windows"]
+    det_chaos = DriftDetector(threshold=0.05, up_after=1, down_after=1)
+    we = StreamingEngine(
+        collection(), windowed_engine_config(win_inj, trace=rec, drift=det_chaos)
+    )
+    with we:
+        for b in clean:
+            we.submit(*b)
+            we.flush()  # per-batch flush: site occurrence indices stay timing-free
+        got_w = {k: np.asarray(v) for k, v in we.result().items()}
+    det_ref = DriftDetector(threshold=0.05, up_after=1, down_after=1)
+    wref = StreamingEngine(collection(), windowed_engine_config(None, drift=det_ref))
+    with wref:
+        for b in clean:
+            wref.submit(*b)
+            wref.flush()
+        want_w = {k: np.asarray(v) for k, v in wref.result().items()}
+    for k in want_w:
+        _check(
+            np.array_equal(got_w[k], want_w[k]),
+            f"windowed chaos parity: {k} {got_w[k]} != {want_w[k]}",
+        )
+    _check(
+        win_inj.fired.get("pane_rotate", 0) == 1
+        and win_inj.fired.get("drift_eval", 0) == 1,
+        f"window sites did not fire: {dict(win_inj.fired)}",
+    )
+    _check(we.stats.retries >= 2, f"window faults were not retried: {we.stats.retries}")
+    for name in ("Accuracy", "MeanSquaredError"):
+        _check(
+            det_chaos.history(name=name) == det_ref.history(name=name),
+            f"drift history diverged under retry for {name}: "
+            f"{det_chaos.history(name=name)} != {det_ref.history(name=name)}",
+        )
+    _check(
+        det_chaos.evals == det_ref.evals and we.rotations == wref.rotations,
+        f"retried drift eval double-recorded: {det_chaos.evals} vs {det_ref.evals} "
+        f"(rotations {we.rotations} vs {wref.rotations})",
+    )
+    fired_sites |= set(win_inj.fired)
+
+    # EWMA double-decay proof: a float-sum metric under ewma(alpha=0.5) with
+    # a transient pane_rotate — dyadic values + dyadic decay make the result
+    # exactly representable, so one extra (double) decay would flip bits
+    from metrics_tpu import MeanMetric
+
+    ewma_inj = injs["ewma"]
+    em = StreamingEngine(MeanMetric(), ewma_engine_config(ewma_inj, trace=rec))
+    with em:
+        for p, _t in clean:
+            em.submit(p)
+            em.flush()
+        got_e = np.asarray(em.result())
+    eref = StreamingEngine(MeanMetric(), ewma_engine_config(None))
+    with eref:
+        for p, _t in clean:
+            eref.submit(p)
+            eref.flush()
+        want_e = np.asarray(eref.result())
+    _check(
+        np.array_equal(got_e, want_e),
+        f"ewma retried rotation double-decayed: {got_e} != {want_e}",
+    )
+    _check(
+        ewma_inj.fired.get("pane_rotate", 0) == 1
+        and em.stats.ewma_decays == eref.stats.ewma_decays > 0,
+        f"ewma rotation accounting wrong: {dict(ewma_inj.fired)}, "
+        f"{em.stats.ewma_decays} vs {eref.stats.ewma_decays}",
+    )
+    fired_sites |= set(ewma_inj.fired)
 
     # ------------------- stream-sharded paging: spill/fault-in under chaos
     # (ISSUE 9) a resident-capped stream-sharded engine under seeded Zipfian

@@ -76,6 +76,8 @@ FAULT_SITES = (
     "quant_decode",     # q8 state-at-rest decode (restore / fault-in / read)
     "reshard_snapshot", # live reshard: in-memory topology snapshot capture
     "reshard_restore",  # live reshard: restore into the target topology
+    "pane_rotate",      # window pane rotation: plan phase, before any commit
+    "drift_eval",       # closing-pane drift evaluation (pure read, retried)
     "snapshot_write",   # snapshot save fails before any bytes are durable
     "snapshot_corrupt", # snapshot saved, then payload bytes rot on disk
     "snapshot_read",    # transient restore-time read failure

@@ -145,7 +145,20 @@ class MultiStreamEngine(StreamingEngine):
                 )
             axes = (config.axis,) if isinstance(config.axis, str) else tuple(config.axis)
             world = int(np.prod([config.mesh.shape[a] for a in axes]))
-            self._local_streams = -(-self._num_streams // world)  # ceil(S / W)
+            # windowed stream sharding (ISSUE 13): the pane EXTENDS the local
+            # stream coordinate (eloc = loc * panes + pane) — each (stream,
+            # pane) pair is its own pager row, so cold panes spill through
+            # the existing compressed pager and rotation is pure bookkeeping
+            win = config.window
+            if win is not None and win.kind == "ewma":
+                raise MetricsTPUUserError(
+                    "ewma windows are not supported under stream_shard=True: the "
+                    "decay would have to scale resident arena rows AND every "
+                    "host-spilled row in place — serve ewma on an unsharded "
+                    "engine, or use a tumbling/sliding ring"
+                )
+            self._pane_rows = win.panes if (win is not None and win.stacked) else 1
+            self._local_streams = -(-self._num_streams // world) * self._pane_rows
             r = int(resident_streams) if resident_streams is not None else self._local_streams
             if r <= 0:
                 raise MetricsTPUUserError(
@@ -159,6 +172,7 @@ class MultiStreamEngine(StreamingEngine):
                     "(the unsharded engine carries every stream resident)"
                 )
             self._resident = 0
+            self._pane_rows = 1
         super().__init__(metric, config=config, aot_cache=aot_cache)
         self._row_codec = None
         if self._stream_shard:
@@ -202,10 +216,12 @@ class MultiStreamEngine(StreamingEngine):
         """Per-shard paged-arena slot count (None for unsharded engines)."""
         return self._resident if self._stream_shard else None
 
-    def _init_state_tree(self) -> Any:
+    def _kind_init_state_tree(self) -> Any:
         if self._stream_shard:
-            # ONE stream's logical state: the stream-sharded carried form is
-            # built row-wise by _put_state, never as a full (S, ...) tree
+            # ONE (stream, pane) row's logical state: the stream-sharded
+            # carried form is built row-wise by _put_state, never as a full
+            # (S, ...) tree — under windows the pane extends the pager's
+            # local stream coordinate, so the row shape is unchanged
             return self._metric.init_state()
         base = self._metric.init_state()
         return jax.tree.map(
@@ -213,10 +229,10 @@ class MultiStreamEngine(StreamingEngine):
             base,
         )
 
-    def _abstract_state_tree(self) -> Any:
+    def _kind_abstract_state_tree(self) -> Any:
         if self._stream_shard:
-            # per-STREAM template: the engine's ArenaLayout then describes one
-            # stream's row (n elements per dtype) — the pager's spill unit
+            # per-(stream, pane) template: the engine's ArenaLayout then
+            # describes one row (n elements per dtype) — the pager's spill unit
             return self._metric.abstract_state()
         base = self._metric.abstract_state()
         return jax.tree.map(
@@ -299,25 +315,86 @@ class MultiStreamEngine(StreamingEngine):
         """One executable computes ANY stream: the stream index is a runtime
         scalar argument, so S streams never cost S compiles. Under deferred
         sync the input is the boundary-merged (S, ...)-stacked global state
-        instead of the carried shard-local arena."""
+        instead of the carried shard-local arena. Ring windows fold the pane
+        axis FIRST (it stacks outside the stream axis), with the tumbling
+        cursor as one more runtime scalar — window shape and policy stay in
+        the program key, pane values never do."""
         sid_abs = jax.ShapeDtypeStruct((), jnp.int32)
         key = self._aot.program_key(
-            f"compute_mstream+k.{self._kernel_tag()}", self._metric_fp,
-            arg_tree=(self._compute_input_abstract(), sid_abs),
+            f"compute_mstream+k.{self._kernel_tag()}+w.{self._window_tag()}", self._metric_fp,
+            arg_tree=(self._compute_input_abstract(),) + self._compute_extra_abs() + (sid_abs,),
             mesh=self._cfg.mesh, donate=False, sync=self._sync_tag(),
             precision=self._precision_tag,
         )
         metric = self._metric
 
         def build():
-            def compute(state, sid):
-                row = jax.tree.map(lambda x: x[sid], self._compute_tree(state))
+            def compute(state, *rest):
+                extra, sid = rest[:-1], rest[-1]
+                tree = self._window_fold_traced(self._compute_tree(state), *extra)
+                row = jax.tree.map(lambda x: x[sid], tree)
                 return metric.compute_from(row)
 
             with self._kernel_scope():
-                return jax.jit(compute).lower(self._compute_input_abstract(), sid_abs).compile()
+                return (
+                    jax.jit(compute)
+                    .lower(
+                        self._compute_input_abstract(),
+                        *self._compute_extra_abs(),
+                        sid_abs,
+                    )
+                    .compile()
+                )
 
         return self._aot.get_or_compile(key, build)
+
+    def _pane_values_program(self):
+        """EVERY stream's value of ONE runtime-indexed pane — the windowed
+        multi-stream drift observable (one batched device computation per
+        rotation, any S). For tumbling rings this is exactly the batched
+        all-streams program (its fold IS the pane index)."""
+        if self._window.kind == "tumbling":
+            return self._results_program()
+        pane_abs = jax.ShapeDtypeStruct((), jnp.int32)
+        key = self._aot.program_key(
+            f"pane_values+k.{self._kernel_tag()}+w.{self._window_tag()}", self._metric_fp,
+            arg_tree=(self._compute_input_abstract(), pane_abs),
+            mesh=self._cfg.mesh, donate=False, sync=self._sync_tag(),
+            precision=self._precision_tag,
+        )
+        metric = self._metric
+
+        def build():
+            from jax import lax
+
+            def pane_values(state, pane):
+                tree = self._compute_tree(state)
+                row = jax.tree.map(
+                    lambda x: lax.dynamic_index_in_dim(x, pane, 0, keepdims=False), tree
+                )
+                return jax.vmap(metric.compute_from)(row)
+
+            with self._kernel_scope():
+                return (
+                    jax.jit(pane_values)
+                    .lower(self._compute_input_abstract(), pane_abs)
+                    .compile()
+                )
+
+        return self._aot.get_or_compile(key, build)
+
+    def _drift_values_locked(self):
+        """Per-stream closing-pane results (state lock held): one batched
+        device computation, sliced host-side into ``(stream_id, value)``
+        series for the detector."""
+        state = self._merged_state() if self._deferred else self._state
+        vals = jax.device_get(
+            self._pane_values_program()(state, jnp.asarray(self._pane_cursor, jnp.int32))
+        )
+        return [
+            (sid, jax.tree.map(lambda x: x[sid], vals))
+            for sid in range(self._num_streams)
+        ]
 
     def _row_compute_program(self):
         """Stream-sharded per-stream compute: ONE stream's packed arena row
@@ -344,24 +421,32 @@ class MultiStreamEngine(StreamingEngine):
 
         return self._aot.get_or_compile(key, build)
 
-    def _results_traced(self, state: Any) -> Any:
+    def _results_traced(self, state: Any, *extra: Any) -> Any:
         """Traced body of the batched all-streams compute: ONE vmapped
-        ``compute_from`` over the stream axis — the jaxpr's op count is
-        CONSTANT in S (pinned by the dispatch-count regression test), so a
-        dashboard scrape at S=10^5 costs one device computation, not 10^5."""
-        return jax.vmap(self._metric.compute_from)(self._compute_tree(state))
+        ``compute_from`` over the stream axis (after the window's pane fold
+        — the pane axis stacks outside the stream axis) — the jaxpr's op
+        count is CONSTANT in S (pinned by the dispatch-count regression
+        test), so a dashboard scrape at S=10^5 costs one device computation,
+        not 10^5."""
+        tree = self._window_fold_traced(self._compute_tree(state), *extra)
+        return jax.vmap(self._metric.compute_from)(tree)
 
     def _results_program(self):
         key = self._aot.program_key(
-            f"compute_mstream_all+k.{self._kernel_tag()}", self._metric_fp,
-            arg_tree=self._compute_input_abstract(),
+            f"compute_mstream_all+k.{self._kernel_tag()}+w.{self._window_tag()}",
+            self._metric_fp,
+            arg_tree=(self._compute_input_abstract(),) + self._compute_extra_abs(),
             mesh=self._cfg.mesh, donate=False, sync=self._sync_tag(),
             precision=self._precision_tag,
         )
 
         def build():
             with self._kernel_scope():
-                return jax.jit(self._results_traced).lower(self._compute_input_abstract()).compile()
+                return (
+                    jax.jit(self._results_traced)
+                    .lower(self._compute_input_abstract(), *self._compute_extra_abs())
+                    .compile()
+                )
 
         return self._aot.get_or_compile(key, build)
 
@@ -439,11 +524,65 @@ class MultiStreamEngine(StreamingEngine):
         """Global stream id -> (home shard, local stream index)."""
         return sid % self._world, sid // self._world
 
+    def _home_row(self, sid: int, pane: Optional[int] = None) -> Tuple[int, int]:
+        """Global stream id (+ pane under a ring window) -> (home shard,
+        pager row coordinate). The pane EXTENDS the local index
+        (``loc * panes + pane``): each (stream, pane) pair owns its own
+        pager row, which is exactly what lets cold panes spill through the
+        existing LRU/codec machinery unchanged."""
+        w, loc = self._home(sid)
+        if self._pane_rows == 1:
+            return w, loc
+        return w, loc * self._pane_rows + (self._pane_cursor if pane is None else int(pane))
+
+    def _route_locs(self, sids: np.ndarray) -> np.ndarray:
+        """Vectorized home-row coordinates for the CURRENT pane (the routed
+        step only ever touches the pane being written)."""
+        locs = np.asarray(sids, np.int64) // self._world
+        if self._pane_rows > 1:
+            locs = locs * self._pane_rows + self._pane_cursor
+        return locs
+
     def _refresh_gauges(self) -> None:
         if self._pager is not None:
             self._stats.resident_streams = self._pager.resident_count()
             self._stats.spilled_streams = self._pager.spilled_count()
             self._stats.spilled_bytes = self._pager.spill_nbytes()
+
+    # -------------------------------------------------- stream-shard pane rotation
+
+    def _plan_rotation(self, incoming: int) -> Any:
+        """Stream-sharded rotation plan: a PURE enumeration of every pager
+        row (resident or spilled) belonging to the INCOMING pane — those
+        rows expire (tumbling: the pane restarts; sliding: the oldest pane
+        falls out of the window) and their next touch faults in the init
+        row. No device work: the ring lives in the pager's coordinate space,
+        which is exactly what makes a stream-sharded rotation free."""
+        if not self._stream_shard:
+            return super()._plan_rotation(incoming)
+
+        def plan() -> Any:
+            self._fault("pane_rotate")
+            P = self._pane_rows
+            drops = []
+            for w in range(self._world):
+                for row in self._pager.resident_streams(w):
+                    if row % P == incoming:
+                        drops.append((w, row))
+                for row in self._pager.spilled_streams(w):
+                    if row % P == incoming:
+                        drops.append((w, row))
+            return sorted(set(drops))
+
+        return self._retry_transient(plan)
+
+    def _commit_rotation(self, planned: Any, incoming: int) -> None:
+        if not self._stream_shard:
+            return super()._commit_rotation(planned, incoming)
+        for w, row in planned:
+            self._pager.drop(w, row)
+        self._state_version += 1
+        self._refresh_gauges()
 
     # ------------------------------------------------------------ elastic reshard
 
@@ -473,7 +612,7 @@ class MultiStreamEngine(StreamingEngine):
             # stream census and seat a FRESH pager — _restore_commit right
             # after this re-homes every row (verbatim same-topology, spill-
             # seeded otherwise)
-            self._local_streams = -(-self._num_streams // world)
+            self._local_streams = -(-self._num_streams // world) * self._pane_rows
             r = int(resident_streams) if resident_streams is not None else self._resident
             self._resident = min(max(1, r), self._local_streams)
             self._pager = StreamPager(world, self._resident)
@@ -514,6 +653,11 @@ class MultiStreamEngine(StreamingEngine):
         ]
         sids_o = sids[order]
         home_o = home[order]
+        # home-row coordinates for the WHOLE group, once: the pane cursor is
+        # constant across this call (rotation happens between groups; the
+        # shard-loss re-route recurses and recomputes), so every per-row /
+        # per-segment consumer below indexes this one vector
+        locs_o = self._route_locs(sids_o)
         starts = np.searchsorted(home_o, np.arange(W)).astype(np.int64)
         stops = np.searchsorted(home_o, np.arange(W), side="right").astype(np.int64)
         route_us = (time.perf_counter() - t_route0) * 1e6
@@ -534,7 +678,7 @@ class MultiStreamEngine(StreamingEngine):
                     end = s0
                     distinct: set = set()
                     while end < s1 and (end - s0) < per_top:
-                        loc = int(sids_o[end]) // W
+                        loc = int(locs_o[end])
                         if loc not in distinct and len(distinct) >= self._resident:
                             break
                         distinct.add(loc)
@@ -545,7 +689,7 @@ class MultiStreamEngine(StreamingEngine):
                 per = bucket // W
                 # ---- page the round's streams resident (slot assignment)
                 self._page_round(
-                    {w: [int(x) // W for x in sids_o[segs[w][0]: segs[w][1]]] for w in range(W)}
+                    {w: [int(x) for x in locs_o[segs[w][0]: segs[w][1]]] for w in range(W)}
                 )
                 # ---- build the padded routed payload: shard w's rows land in
                 # slice [w*per, w*per+len(seg)) — P(axis) then hands each
@@ -590,7 +734,7 @@ class MultiStreamEngine(StreamingEngine):
                         continue
                     # one pager lookup per DISTINCT seated stream (<= resident),
                     # then a vectorized gather over the shard's rows
-                    locs = sids_o[s0:s1].astype(np.int64) // W
+                    locs = locs_o[s0:s1]
                     uniq = np.unique(locs)
                     slots = np.asarray(
                         [self._pager.slot_of(w, int(u)) for u in uniq], np.int32
@@ -647,7 +791,7 @@ class MultiStreamEngine(StreamingEngine):
                 for w, (s0, s1) in enumerate(segs):
                     cursors[w] = s1
                     if s1 > s0:
-                        self._pager.touch(w, [int(x) // W for x in sids_o[s0:s1]])
+                        self._pager.touch(w, [int(x) for x in locs_o[s0:s1]])
         except BaseException as e:  # noqa: BLE001 - shrink-on-retry contract
             try:
                 # accumulate: the shard-loss re-route nests one
@@ -843,14 +987,15 @@ class MultiStreamEngine(StreamingEngine):
             out[f"spill_{k}"] = v
         return out
 
-    def _fetch_row(self, sid: int) -> Dict[str, np.ndarray]:
-        """ONE stream's packed arena row (per-dtype host vectors): from its
-        home shard's slot when resident (only that row crosses to host),
-        read-through from the host spill store when paged out (no eviction —
-        residency changes only on the submit path; the row decodes through
-        the at-rest codec when spills are compressed), or the init row for a
-        never-touched stream. Caller holds the state lock."""
-        w, loc = self._home(sid)
+    def _fetch_row(self, sid: int, pane: Optional[int] = None) -> Dict[str, np.ndarray]:
+        """ONE (stream, pane) packed arena row (per-dtype host vectors):
+        from its home shard's slot when resident (only that row crosses to
+        host), read-through from the host spill store when paged out (no
+        eviction — residency changes only on the submit path; the row
+        decodes through the at-rest codec when spills are compressed), or
+        the init row for a never-touched stream/pane. ``pane`` defaults to
+        the current cursor. Caller holds the state lock."""
+        w, loc = self._home_row(sid, pane)
         slot = self._pager.slot_of(w, loc)
         if slot is not None:
             return {k: np.asarray(jax.device_get(v[w, slot])) for k, v in self._state.items()}
@@ -859,16 +1004,125 @@ class MultiStreamEngine(StreamingEngine):
             return spilled
         return self._init_row
 
+    def _windowed_row_result(self, sid: int) -> Any:
+        """``result(sid)`` for the stream-sharded engine: the current pane's
+        row for cumulative/tumbling reads (one row moves, exactly as before
+        windows); a sliding read stacks the stream's ``panes`` rows (each
+        resident, spilled, or init) and folds them through one compiled
+        merge+compute program."""
+        if self._pane_rows == 1 or self._window.kind == "tumbling":
+            return self._row_compute_program()(self._fetch_row(sid))
+        rows = [self._fetch_row(sid, pane=p) for p in range(self._pane_rows)]
+        stacked = {k: np.stack([r[k] for r in rows]) for k in rows[0]}
+        return self._row_window_compute_program()(stacked)
+
+    def _row_window_compute_program(self):
+        """ONE stream's pane-stacked rows ``{dtype: (panes, n)}`` -> the
+        sliding-window value: unpack the ring, fold via
+        ``merge_stacked_states``, compute. Mesh-free (rows are already
+        gathered host-side), cached like every program."""
+        row_abs = {
+            k: jax.ShapeDtypeStruct((self._pane_rows, n), jnp.dtype(k))
+            for k, n in self._layout.buffer_sizes().items()
+        }
+        key = self._aot.program_key(
+            f"compute_sstream_win+k.{self._kernel_tag()}+w.{self._window_tag()}",
+            self._metric_fp,
+            arg_tree=row_abs, mesh=None, donate=False, sync=self._sync_tag(),
+            precision=self._precision_tag,
+        )
+        metric, layout = self._metric, self._layout
+
+        def build():
+            def fold(rows):
+                tree = layout.unpack_stacked(rows)
+                return metric.compute_from(metric.merge_stacked_states(tree))
+
+            with self._kernel_scope():
+                return jax.jit(fold).lower(row_abs).compile()
+
+        return self._aot.get_or_compile(key, build)
+
+    def _ext_universe(self) -> int:
+        """Size of the EXTENDED row-id space under windows: every (shard,
+        row-coordinate) pair maps to ``row * world + shard`` — covering
+        ceil(S/W) * panes rows per shard, including the ghost tail of ids
+        past S (never touched, reassembled as init rows, sliced away)."""
+        return self._local_streams * self._world
+
+    def _ext_id(self, sid: int, pane: int) -> int:
+        """Extended row id of (stream, pane) — consistent with the pager's
+        ``row * world + shard`` coordinates the reassembly indexes by."""
+        return ((sid // self._world) * self._pane_rows + pane) * self._world + (
+            sid % self._world
+        )
+
+    def _ext_ids(self, panes: Any) -> np.ndarray:
+        """Vectorized :meth:`_ext_id`: the ``(len(panes), S)`` extended-id
+        index matrix — a pure arange computation, so a results() scrape at
+        S=10^5 never walks a Python loop over (stream, pane) pairs."""
+        sids = np.arange(self._num_streams, dtype=np.int64)
+        base = (sids // self._world) * self._pane_rows * self._world + sids % self._world
+        return base[None, :] + np.asarray(panes, np.int64)[:, None] * self._world
+
+    def _sharded_results_values(self) -> Any:
+        """The batched all-streams values (state lock held). Windowed rings
+        reassemble the EXTENDED row universe once, regroup it host-side to
+        pane-stacked per-stream matrices, and run one fold program; the
+        tumbling read slices the current pane and reuses the plain batched
+        program."""
+        if self._pane_rows == 1:
+            return self._results_program_sharded()(self._global_rows_host())
+        ext = self._global_rows_host()
+        if self._window.kind == "tumbling":
+            # only the open pane is read: gather its S rows directly
+            idx = self._ext_ids([self._pane_cursor])[0]
+            cur = {k: np.asarray(v)[idx] for k, v in ext.items()}  # (S, n)
+            return self._results_program_sharded()(cur)
+        # (P, S) pane-major index, matching the logical (panes, S, ...) layout
+        idx = self._ext_ids(range(self._pane_rows))
+        stacked = {k: np.asarray(v)[idx] for k, v in ext.items()}  # (P, S, n)
+        return self._results_window_program_sharded()(stacked)
+
+    def _results_window_program_sharded(self):
+        """Every stream's sliding value from the ``{dtype: (panes, S, n)}``
+        pane-stacked row matrices: ONE vmapped merge+compute over the stream
+        axis — still a single device computation per scrape, any S."""
+        stacked_abs = {
+            k: jax.ShapeDtypeStruct((self._pane_rows, self._num_streams, n), jnp.dtype(k))
+            for k, n in self._layout.buffer_sizes().items()
+        }
+        key = self._aot.program_key(
+            f"compute_sstream_win_all+k.{self._kernel_tag()}+w.{self._window_tag()}",
+            self._metric_fp,
+            arg_tree=stacked_abs, mesh=None, donate=False, sync=self._sync_tag(),
+            precision=self._precision_tag,
+        )
+        metric, layout = self._metric, self._layout
+
+        def build():
+            def fold_all(stacked):
+                tree = layout.unpack_stacked(stacked, lead=2)  # (panes, S, ...)
+                merged = metric.merge_stacked_states(tree)     # fold panes -> (S, ...)
+                return jax.vmap(metric.compute_from)(merged)
+
+            with self._kernel_scope():
+                return jax.jit(fold_all).lower(stacked_abs).compile()
+
+        return self._aot.get_or_compile(key, build)
+
     def _global_rows_host(self) -> Dict[str, np.ndarray]:
         """Reassemble every stream's packed row host-side: resident slots out
         of the (device) arena, spilled rows out of host RAM, init rows for the
         untouched tail — the ``(S, n)`` per-dtype matrices ``results()`` /
-        ``state()`` / the merged restore path all share. Caller holds the
-        state lock."""
+        ``state()`` / the merged restore path all share (``(EXT, n)`` over
+        the extended (stream, pane) universe under ring windows). Caller
+        holds the state lock."""
         arena = {k: np.asarray(jax.device_get(v)) for k, v in self._state.items()}
+        num = self._num_streams if self._pane_rows == 1 else self._ext_universe()
         return self._rows_from_parts(
             arena, self._decoded_pager_payload(self._pager.snapshot_payload()),
-            self._init_row, self._num_streams, self._world,
+            self._init_row, num, self._world,
         )
 
     @staticmethod
@@ -966,10 +1220,12 @@ class MultiStreamEngine(StreamingEngine):
         self.flush()
         with self._state_lock:
             if self._stream_shard:
-                value = self._row_compute_program()(self._fetch_row(sid))
+                value = self._windowed_row_result(sid)
             else:
                 state = self._merged_state() if self._deferred else self._state
-                value = self._compute_program()(state, jnp.asarray(sid, jnp.int32))
+                value = self._compute_program()(
+                    state, *self._compute_extra(), jnp.asarray(sid, jnp.int32)
+                )
             self._stats.result_device_calls += 1
             if self._ladder is not None:
                 # the defer rung's staleness source: only ladder-armed
@@ -991,11 +1247,10 @@ class MultiStreamEngine(StreamingEngine):
         self.flush()
         with self._state_lock:
             if self._stream_shard:
-                stacked = self._global_rows_host()
-                vals = self._results_program_sharded()(stacked)
+                vals = self._sharded_results_values()
             else:
                 state = self._merged_state() if self._deferred else self._state
-                vals = self._results_program()(state)
+                vals = self._results_program()(state, *self._compute_extra())
             self._stats.result_device_calls += 1
         host = jax.device_get(vals)
         return {
@@ -1018,29 +1273,44 @@ class MultiStreamEngine(StreamingEngine):
         self.flush()
         if self._stream_shard:
             with self._state_lock:
-                w, loc = self._home(sid)
-                self._pager.drop(w, loc)
+                # a ring window resets EVERY live pane of the stream, not
+                # just the current one — "forget this tenant" must not leave
+                # history panes serving stale windows
+                for p in range(self._pane_rows):
+                    w, row = self._home_row(sid, p)
+                    self._pager.drop(w, row)
                 self._result_cache.pop(sid, None)
                 self._state_version += 1
                 self._refresh_gauges()
             return
         init = self._metric.init_state()
+        # the stream axis sits one level deeper under a ring window (pane
+        # axis outermost): slice accordingly, in both carried forms
+        if self._deferred:
+            set_init = (
+                (lambda x, i: x.at[:, :, sid].set(jnp.asarray(i, x.dtype)))
+                if self._win_stacked
+                else (lambda x, i: x.at[:, sid].set(jnp.asarray(i, x.dtype)))
+            )
+        else:
+            set_init = (
+                (lambda x, i: x.at[:, sid].set(jnp.asarray(i, x.dtype)))
+                if self._win_stacked
+                else (lambda x, i: x.at[sid].set(jnp.asarray(i, x.dtype)))
+            )
         with self._state_lock:
             if self._deferred:
                 stacked = (
-                    self._layout.unpack_stacked(self._state)
+                    self._layout.unpack_stacked(
+                        self._state, lead=2 if self._win_stacked else 1
+                    )
                     if self._layout is not None
                     else self._state
                 )
-                tree = jax.tree.map(
-                    lambda x, i: x.at[:, sid].set(jnp.asarray(i, x.dtype)), stacked, init
-                )
+                tree = jax.tree.map(set_init, stacked, init)
                 self._state = self._put_state(tree, stacked=True)
             else:
-                tree = jax.tree.map(
-                    lambda x, i: x.at[sid].set(jnp.asarray(i, x.dtype)),
-                    self._unpack(self._state), init,
-                )
+                tree = jax.tree.map(set_init, self._unpack(self._state), init)
                 self._state = self._put_state(tree)
             self._result_cache.pop(sid, None)
             self._state_version += 1
@@ -1056,16 +1326,24 @@ class MultiStreamEngine(StreamingEngine):
             self._refresh_gauges()
 
     def state(self) -> Any:
-        """The global (S, ...)-stacked LOGICAL state. Stream-sharded engines
-        reassemble it host-side (resident + spilled + init rows); other modes
-        defer to the base engine (merged under deferred sync, defensive copy
-        single-device)."""
+        """The global (S, ...)-stacked LOGICAL state — ``(panes, S, ...)``
+        under ring windows, the pane axis outermost like every windowed
+        engine. Stream-sharded engines reassemble it host-side (resident +
+        spilled + init rows); other modes defer to the base engine (merged
+        under deferred sync, defensive copy single-device)."""
         if not self._stream_shard:
             return super().state()
         self.flush()
         with self._state_lock:
-            stacked = self._global_rows_host()
-        return self._layout.unpack_stacked({k: jnp.asarray(v) for k, v in stacked.items()})
+            rows = self._global_rows_host()
+        if self._pane_rows == 1:
+            return self._layout.unpack_stacked(
+                {k: jnp.asarray(v) for k, v in rows.items()}
+            )
+        idx = self._ext_ids(range(self._pane_rows))
+        return self._layout.unpack_stacked(
+            {k: jnp.asarray(np.asarray(v)[idx]) for k, v in rows.items()}, lead=2
+        )
 
     def stream_state(self, stream_id: int) -> Any:
         """One stream's LOGICAL state pytree (post-flush). A defensive copy
@@ -1077,12 +1355,23 @@ class MultiStreamEngine(StreamingEngine):
         self.flush()
         with self._state_lock:
             if self._stream_shard:
+                if self._pane_rows > 1:
+                    rows = [self._fetch_row(sid, pane=p) for p in range(self._pane_rows)]
+                    stacked = {
+                        k: jnp.asarray(np.stack([r[k] for r in rows])) for k in rows[0]
+                    }
+                    return self._layout.unpack_stacked(stacked)
                 row = self._fetch_row(sid)
                 return self._layout.unpack({k: jnp.asarray(v) for k, v in row.items()})
+            # under a ring window the pane axis stacks OUTSIDE the stream
+            # axis: index the stream on axis 1, keeping the pane ring intact
+            pick = (
+                (lambda x: x[:, sid]) if self._win_stacked else (lambda x: x[sid])
+            )
             if self._deferred:
-                return jax.tree.map(lambda x: x[sid], self._merged_state())
+                return jax.tree.map(pick, self._merged_state())
             return jax.tree.map(
-                lambda x: jnp.array(x[sid], copy=True), self._unpack(self._state)
+                lambda x: jnp.array(pick(x), copy=True), self._unpack(self._state)
             )
 
     # ------------------------------------------------------------- snapshot/restore
@@ -1144,6 +1433,29 @@ class MultiStreamEngine(StreamingEngine):
         snap_shard = bool(int(meta.get("stream_shard", 0) or 0))
         if not snap_shard and not self._stream_shard:
             return super()._restore_commit(state, meta)
+        # the window-provenance refusal applies to the stream-shard matrix
+        # too (the base path re-checks it harmlessly): pager row coordinates
+        # MEAN (stream, pane) only under the policy that wrote them
+        self._check_window_provenance(meta)
+        if snap_shard and str(meta.get("window", "") or ""):
+            # windowed stream-shard snapshots restore VERBATIM only: the
+            # pane-extended row coordinates have no exact cross-topology
+            # re-homing (a mid-pane ring is not reconstructible under a
+            # different world/residency or on a merged unsharded target)
+            w_snap = int(meta.get("world", 1))
+            r_snap = int(meta.get("resident", 0))
+            if (
+                not self._stream_shard
+                or w_snap != self._world
+                or r_snap != self._resident
+            ):
+                raise MetricsTPUUserError(
+                    "a WINDOWED stream-shard snapshot restores verbatim into the "
+                    f"same (world, resident) stream-sharded topology only "
+                    f"(snapshot ({w_snap}, {r_snap})): pane-extended pager rows "
+                    "have no exact cross-topology re-homing — restore into a "
+                    "same-topology engine, or snapshot from an unwindowed one"
+                )
         if not snap_shard:
             raise MetricsTPUUserError(
                 "snapshot was not written by a stream-sharded engine; the stream-shard "

@@ -25,8 +25,10 @@ CPU device (``make obs-smoke``):
    ``page_out``/``page_in`` transients under seeded Zipfian traffic, the
    at-rest codec's ``quant_encode``/``quant_decode``, and the ISSUE 11
    elastic sites — ``admission``, a transient suspected ``shard_loss``, and
-   ``reshard_snapshot``/``reshard_restore`` under a manual ``reshard()``)
-   runs TWICE into fresh recorders; the canonical span sequences
+   ``reshard_snapshot``/``reshard_restore`` under a manual ``reshard()`` —
+   plus the ISSUE 13 windowed sites: a ``pane_rotate`` plan transient on a
+   sliding ring AND on an ewma decay, and a ``drift_eval`` transient on the
+   closing-pane read) runs TWICE into fresh recorders; the canonical span sequences
    (timestamps excluded) must be IDENTICAL, and both chaos results
    bit-identical to each other. This is the occurrence-determinism
    contract: a chaos trace replays exactly.
@@ -74,12 +76,14 @@ def main(
         chaos_traffic,
         deferred_engine_config,
         elastic_engine_config,
+        ewma_engine_config,
         kill_engine_config,
         make_checker,
         quant_engine_config,
         resume_engine_config,
         stream_shard_engine_config,
         stream_shard_traffic,
+        windowed_engine_config,
     )
     from metrics_tpu.engine.faults import FAULT_SITES
 
@@ -220,9 +224,37 @@ def main(
                 ee.submit(*b)
                 ee.flush()
             ee.result()
+        # windowed rotation + drift-eval transients (ISSUE 13): sliding ring
+        # with a wired detector plus the ewma decay probe — pane_rotate and
+        # drift_eval join the canonical span sequence; flush-per-submit keeps
+        # their occurrence indices producer-timing-independent
+        from metrics_tpu.engine import DriftDetector
+        from metrics_tpu import MeanMetric
+
+        win_inj = injs["windows"]
+        we = StreamingEngine(
+            collection(),
+            windowed_engine_config(
+                win_inj, trace=rec,
+                drift=DriftDetector(threshold=0.05, up_after=1, down_after=1),
+            ),
+        )
+        with we:
+            for b in clean:
+                we.submit(*b)
+                we.flush()
+            we.result()
+        ewma_inj = injs["ewma"]
+        em = StreamingEngine(MeanMetric(), ewma_engine_config(ewma_inj, trace=rec))
+        with em:
+            for p, _t in clean:
+                em.submit(p)
+                em.flush()
+            em.result()
         sites = (
             set(inj.fired) | set(read_inj.fired) | set(merge_inj.fired)
             | set(page_inj.fired) | set(quant_inj.fired) | set(elastic_inj.fired)
+            | set(win_inj.fired) | set(ewma_inj.fired)
         )
         return rec, got, sites
 

@@ -107,6 +107,12 @@ class StreamPager:
     def resident_streams(self, shard: int) -> Tuple[int, ...]:
         return tuple(self._lru[shard])
 
+    def spilled_streams(self, shard: int) -> Tuple[int, ...]:
+        """Local stream coordinates currently living in the host spill store
+        (sorted — deterministic enumeration for the windowed rotation's
+        pane-expiry plan)."""
+        return tuple(sorted(self._spill[shard]))
+
     # ----------------------------------------------------------------- planning
 
     def plan_residency(self, shard: int, streams: List[int]) -> Tuple[List[PageOp], int, int]:

@@ -127,6 +127,8 @@ from metrics_tpu.engine.faults import (
 from metrics_tpu.engine.snapshot import load_snapshot, save_snapshot
 from metrics_tpu.engine.stats import EngineStats
 from metrics_tpu.engine.trace import ENGINE_TRACE, TraceRecorder, render_openmetrics
+from metrics_tpu.engine.tracker import DriftDetector
+from metrics_tpu.engine.windows import WindowPolicy
 from metrics_tpu.ops.kernels import current_backend, resolve_backend, use_backend
 from metrics_tpu.utils.data import infer_batch_size, is_batch_leaf
 from metrics_tpu.utils.exceptions import MetricsTPUUserError
@@ -279,6 +281,28 @@ class EngineConfig:
             quantized states restore within the codec's declared per-element
             bound (the same ``q8_sum_error_bound`` oracle as the wire
             rider). Default off: snapshots stay byte-identical to r10.
+        window: optional :class:`~metrics_tpu.engine.windows.WindowPolicy` —
+            windowed/time-decayed result semantics (ISSUE 13). ``tumbling``/
+            ``sliding`` turn the carried state into a RING-OF-ARENAS (one
+            extra leading pane axis on the per-dtype buffers; the step
+            updates a runtime-indexed pane row, so rotation never retraces);
+            ``ewma`` applies a ``1 - alpha`` scale to the (sum-reducible,
+            float — refused loudly otherwise) states at each rotation.
+            Rotation happens at batch boundaries inside the dispatcher, on a
+            ``pane_batches`` (replay-cursor-exact) or ``pane_seconds``
+            (injectable clock) cadence; coalesce groups never cross a
+            batch-cadence pane boundary. ``result()`` reads the current pane
+            (tumbling) or folds the live pane set via
+            ``merge_stacked_states`` (sliding). None/cumulative (default)
+            keeps the since-reset semantics and the carried state byte-
+            identical to r12. Windowed mesh serving is DEFERRED-sync only.
+        drift: optional :class:`~metrics_tpu.engine.tracker.DriftDetector` —
+            at every pane rotation the dispatcher evaluates the CLOSING
+            pane's result (the ``drift_eval`` fault site; a pure read, so
+            transients retry without double-recording) and feeds it to the
+            detector; hysteresis transitions surface as ``drift_alarm``
+            trace events and the ``drift_alarms`` OpenMetrics counter.
+            Requires a rotating ``window``.
     """
 
     buckets: Tuple[int, ...] = (256, 1024)
@@ -312,6 +336,8 @@ class EngineConfig:
     admission: Optional[AdmissionPolicy] = None
     ladder: Optional[DegradationLadder] = None
     elastic_min_world: int = 0
+    window: Optional[WindowPolicy] = None
+    drift: Optional[DriftDetector] = None
 
 
 class StreamingEngine:
@@ -386,6 +412,79 @@ class StreamingEngine:
             raise MetricsTPUUserError(
                 f"elastic_min_world must be >= 0, got {self._cfg.elastic_min_world}"
             )
+        # windowed semantics (ISSUE 13): the cumulative policy normalizes to
+        # None — it IS the engine's default, and keeping it None keeps every
+        # pre-window engine's carried state and program keys byte-identical
+        win = self._cfg.window
+        if win is not None and not isinstance(win, WindowPolicy):
+            raise MetricsTPUUserError(
+                f"config.window must be a WindowPolicy, got {type(win).__name__}"
+            )
+        self._window = win if (win is not None and win.kind != "cumulative") else None
+        if self._window is not None:
+            if self._cfg.mesh is not None and not self._deferred:
+                raise MetricsTPUUserError(
+                    "windowed serving on a mesh requires mesh_sync='deferred': "
+                    "a pane rotation is a state-structure operation with no "
+                    "per-step delta form for the step-sync merge"
+                )
+            reason = self._window.unsupported_reason(
+                metric, mesh_deferred=self._deferred
+            )
+            if reason is not None:
+                raise MetricsTPUUserError(
+                    f"metric cannot serve under WindowPolicy "
+                    f"{self._window.fingerprint()!r}: {reason}"
+                )
+        # carried state gains the pane axis only for stacked (tumbling/
+        # sliding) rings OFF the stream-sharded path — under stream_shard the
+        # pane extends the pager's local stream coordinate instead, so cold
+        # panes spill through the existing compressed pager
+        self._win_stacked = (
+            self._window is not None
+            and self._window.stacked
+            and not getattr(self, "_stream_shard", False)
+        )
+        self._panes = self._window.panes if self._window is not None else 1
+        self._pane_cursor = 0
+        self._rotations = 0
+        self._last_rotate_batches = 0
+        # replay cursor at which the OPEN pane started — the pane-fill
+        # observable (snapshot provenance) and the empty-pane guard for
+        # drift: a time-cadence catch-up closes panes no batch ever touched
+        self._pane_open_cursor = 0
+        self._win_clock = (
+            self._window.time_source() if self._window is not None else time.monotonic
+        )
+        self._last_rotate_time = self._win_clock() if self._window is not None else 0.0
+        self._drift = self._cfg.drift
+        if self._drift is not None:
+            if not isinstance(self._drift, DriftDetector):
+                raise MetricsTPUUserError(
+                    f"config.drift must be a DriftDetector, got {type(self._drift).__name__}"
+                )
+            if self._window is None:
+                raise MetricsTPUUserError(
+                    "config.drift needs a rotating config.window: drift alarms "
+                    "evaluate per CLOSED PANE — without rotations there is "
+                    "nothing to record (use DriftDetector standalone otherwise)"
+                )
+            if getattr(self, "_stream_shard", False):
+                raise MetricsTPUUserError(
+                    "automatic drift evaluation is not supported under "
+                    "stream_shard=True (a per-rotation all-streams read would "
+                    "fault every cold pane back in); record per-stream pane "
+                    "results into a standalone DriftDetector instead"
+                )
+            if self._drift.raise_on_alarm:
+                raise MetricsTPUUserError(
+                    "config.drift must not set raise_on_alarm: the detector "
+                    "records on the DISPATCHER thread, where a raised alarm "
+                    "would become the sticky dispatcher error and take serving "
+                    "down — alarms surface as drift_alarm trace events and "
+                    "counters; poll detector.alarms() (raise_on_alarm is for "
+                    "standalone use)"
+                )
         # ISSUE 11 self-defense layer: None (the default) keeps the hot path
         # at one `is not None` check per site, matching the trace contract
         self._admission = self._cfg.admission
@@ -439,6 +538,9 @@ class StreamingEngine:
         self._policy = BucketPolicy(self._cfg.buckets, pad_value=self._cfg.pad_value, divisor=divisor)
         self._aot = aot_cache if aot_cache is not None else AotCache(self._cfg.compilation_cache_dir)
         self._stats = EngineStats(self._cfg.telemetry_capacity)
+        if self._window is not None:
+            self._stats.window_policy = self._window.fingerprint()
+            self._stats.live_panes = 1
         self._metric_fp = metric_fingerprint(metric)
         if self._cfg.snapshot_every > 0 and not self._cfg.snapshot_dir:
             raise MetricsTPUUserError("snapshot_every > 0 requires snapshot_dir")
@@ -462,8 +564,13 @@ class StreamingEngine:
         self._error: Optional[BaseException] = None
         self._step = 0
         self._batches_done = 0
+        # the layout always describes ONE pane's packing (kind tree): ring
+        # windows stack (panes, n) buffers of these rows, and the per-row
+        # plan is what pack_stacked/unpack_stacked apply slot-wise
         self._layout: Optional[ArenaLayout] = (
-            ArenaLayout.for_state(self._abstract_state_tree()) if self._cfg.use_arena else None
+            ArenaLayout.for_state(self._kind_abstract_state_tree())
+            if self._cfg.use_arena
+            else None
         )
         # metrics that DERIVE compute attrs from data (Accuracy's input-mode
         # latch) must latch before any program key is built — see
@@ -582,19 +689,56 @@ class StreamingEngine:
 
     # ----------------------------------------------------------------- state plumbing
 
-    def _init_state_tree(self) -> Any:
-        """Fresh logical (UNPACKED) state pytree."""
+    def _kind_init_state_tree(self) -> Any:
+        """One PANE's fresh logical state (the engine-kind hook — the
+        multi-stream engine stream-stacks here; the window layer stacks the
+        pane axis on top in :meth:`_init_state_tree`)."""
         return self._metric.init_state()
 
-    def _abstract_state_tree(self) -> Any:
-        """``ShapeDtypeStruct`` pytree of the logical state (no sharding)."""
+    def _kind_abstract_state_tree(self) -> Any:
+        """One pane's ``ShapeDtypeStruct`` tree (engine-kind hook) — also the
+        :class:`ArenaLayout` template: the layout always describes ONE pane's
+        packing, and windowed engines stack rings of those rows."""
         return self._metric.abstract_state()
 
+    def _init_state_tree(self) -> Any:
+        """Fresh logical (UNPACKED) state pytree — pane-stacked (every leaf
+        gains a leading ``panes`` axis of identical init rows) for ring
+        windows; the engine-kind tree otherwise."""
+        tree = self._kind_init_state_tree()
+        if not self._win_stacked:
+            return tree
+        return jax.tree.map(
+            lambda x: jnp.tile(jnp.asarray(x)[None], (self._panes,) + (1,) * jnp.ndim(x)),
+            tree,
+        )
+
+    def _abstract_state_tree(self) -> Any:
+        """``ShapeDtypeStruct`` pytree of the logical CARRIED state (no
+        sharding) — pane-stacked under ring windows."""
+        tree = self._kind_abstract_state_tree()
+        if not self._win_stacked:
+            return tree
+        return jax.tree.map(
+            lambda s: jax.ShapeDtypeStruct((self._panes,) + tuple(s.shape), s.dtype),
+            tree,
+        )
+
     def _pack(self, tree: Any) -> Any:
-        return self._layout.pack(tree) if self._layout is not None else tree
+        if self._layout is None:
+            return tree
+        return (
+            self._layout.pack_stacked(tree) if self._win_stacked else self._layout.pack(tree)
+        )
 
     def _unpack(self, carried: Any) -> Any:
-        return self._layout.unpack(carried) if self._layout is not None else carried
+        if self._layout is None:
+            return carried
+        return (
+            self._layout.unpack_stacked(carried)
+            if self._win_stacked
+            else self._layout.unpack(carried)
+        )
 
     def _stack_shards(self, tree: Any) -> Any:
         """Logical state tree -> shard-stacked tree: every leaf gains a
@@ -642,7 +786,11 @@ class StreamingEngine:
                 state = self._stack_shards(jax.tree.map(jnp.asarray, state))
                 packed = False
             if not packed and self._layout is not None:
-                state = self._layout.pack_stacked(state)
+                # windowed deferred states carry TWO leading stack axes
+                # (world, panes) ahead of each pane row's flat form
+                state = self._layout.pack_stacked(
+                    state, lead=2 if self._win_stacked else 1
+                )
             sh = self._shard_sharding()
             return jax.tree.map(lambda x: jax.device_put(jnp.asarray(x), sh), state)
         if not packed:
@@ -658,7 +806,11 @@ class StreamingEngine:
         sharded under deferred sync."""
         if self._deferred:
             if self._layout is not None:
-                abs_state = self._layout.abstract_stacked(self._world)
+                abs_state = (
+                    self._layout.abstract_stream_stacked(self._world, self._panes)
+                    if self._win_stacked
+                    else self._layout.abstract_stacked(self._world)
+                )
             else:
                 abs_state = jax.tree.map(
                     lambda s: jax.ShapeDtypeStruct((self._world,) + tuple(s.shape), s.dtype),
@@ -668,7 +820,14 @@ class StreamingEngine:
             return jax.tree.map(
                 lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh), abs_state
             )
-        abs_state = self._layout.abstract() if self._layout is not None else self._abstract_state_tree()
+        if self._layout is not None:
+            abs_state = (
+                self._layout.abstract_paned(self._panes)
+                if self._win_stacked
+                else self._layout.abstract()
+            )
+        else:
+            abs_state = self._abstract_state_tree()
         if self._cfg.mesh is None:
             return abs_state
         rep = self._replicated_sharding()
@@ -764,6 +923,30 @@ class StreamingEngine:
         a, kw = payload
         return self._metric.update_state_masked(state_tree, *a, mask=mask, **kw)
 
+    def _step_update(self, state_tree: Any, payload: Any, mask: Any) -> Any:
+        """The window-aware step body: on a ring window, ``payload`` leads
+        with the RUNTIME pane index (a 0-d int32 the dispatcher prepends in
+        :meth:`_run_padded_step`), the current pane row is dynamically
+        indexed out of the pane-stacked tree, updated by the engine-kind
+        update, and dynamically written back — one slice + one update per
+        leaf, both runtime-indexed, so a rotation changes an ARGUMENT, never
+        the trace (the zero-steady-compile contract of ISSUE 13)."""
+        if not self._win_stacked:
+            return self._traced_update(state_tree, payload, mask)
+        from jax import lax
+
+        a, kw = payload
+        pane, rest = a[0], tuple(a[1:])
+        row = jax.tree.map(
+            lambda x: lax.dynamic_index_in_dim(x, pane, 0, keepdims=False), state_tree
+        )
+        new_row = self._traced_update(row, (rest, kw), mask)
+        return jax.tree.map(
+            lambda x, r: lax.dynamic_update_index_in_dim(x, r, pane, 0),
+            state_tree,
+            new_row,
+        )
+
     def _step_callable(self, payload_abs: Any, mask_abs: Any):
         """The pure ``(state, payload, mask) -> (new_state, token)`` step body
         for one payload signature — a FRESH closure per call (so two builds
@@ -778,7 +961,7 @@ class StreamingEngine:
         if mesh is None:
             def step(state, payload, mask):
                 tree = self._unpack(state)
-                new_tree = self._traced_update(tree, payload, mask)
+                new_tree = self._step_update(tree, payload, mask)
                 return self._pack(new_tree), jnp.sum(mask.astype(jnp.int32))
 
             return step
@@ -787,9 +970,10 @@ class StreamingEngine:
 
         if self._deferred:
             # collective-free shard-local step: each device folds its own rows
-            # into its own state row; merge happens at explicit boundaries
+            # into its own state row (its own pane ring, under windows); merge
+            # happens at explicit boundaries
             return sharded_local_step(
-                self._traced_update, mesh, self._cfg.axis, payload_abs, mask_abs,
+                self._step_update, mesh, self._cfg.axis, payload_abs, mask_abs,
                 state_template=self._abstract_state(),
                 unpack=self._unpack if self._layout is not None else None,
                 pack=self._pack if self._layout is not None else None,
@@ -845,27 +1029,183 @@ class StreamingEngine:
     def _compute_tree(self, state: Any) -> Any:
         """Trace-time view of the compute input as the LOGICAL state tree
         (merged deferred states arrive already logical; carried states
-        unpack from the arena)."""
+        unpack from the arena). Pane-stacked under ring windows — the window
+        FOLD (:meth:`_window_fold_traced`) is a separate step so per-pane
+        readers can skip it."""
         return state if self._deferred else self._unpack(state)
+
+    # ------------------------------------------------------------ window plumbing
+
+    def _window_tag(self) -> str:
+        """The window policy component of program-key kind strings: two
+        policies over identical state signatures lower DIFFERENT fold/rotate
+        programs (tumbling indexes, sliding merges, ewma scales), so the
+        policy is part of every window-sensitive key."""
+        return self._window.fingerprint() if self._window is not None else "none"
+
+    def _window_fold_traced(self, tree: Any, *extra: Any) -> Any:
+        """Fold a pane-stacked logical tree to the window's RESULT view
+        (inside jit): sliding merges every live pane via
+        ``merge_stacked_states`` (sum/min/max elementwise, cat buffers
+        concatenated across panes — per-pane capacity buffers fold exactly);
+        tumbling dynamically indexes the current pane (``extra[0]``, a
+        runtime scalar — P cursor positions share ONE compiled program);
+        unstacked engines pass through."""
+        if not self._win_stacked:
+            return tree
+        if self._window.kind == "sliding":
+            return self._metric.merge_stacked_states(tree)
+        from jax import lax
+
+        pane = extra[0]
+        return jax.tree.map(
+            lambda x: lax.dynamic_index_in_dim(x, pane, 0, keepdims=False), tree
+        )
+
+    def _compute_extra_abs(self) -> Tuple[Any, ...]:
+        """Abstract extra compute-program arguments the window fold needs
+        (the runtime pane cursor for tumbling rings; nothing otherwise)."""
+        if self._win_stacked and self._window.kind == "tumbling":
+            return (jax.ShapeDtypeStruct((), jnp.int32),)
+        return ()
+
+    def _compute_extra(self) -> Tuple[Any, ...]:
+        """Concrete extra compute-program arguments at call time."""
+        if self._win_stacked and self._window.kind == "tumbling":
+            return (jnp.asarray(self._pane_cursor, jnp.int32),)
+        return ()
 
     def _compute_program(self):
         # compute programs carry the kernel tag too: functional compute code
-        # can route through the dispatcher (e.g. the bincount family)
+        # can route through the dispatcher (e.g. the bincount family). The
+        # WINDOW tag is part of the kind: tumbling and sliding folds lower
+        # different programs over identical state signatures.
         key = self._aot.program_key(
-            f"compute+k.{self._kernel_tag()}", self._metric_fp,
-            arg_tree=self._compute_input_abstract(),
+            f"compute+k.{self._kernel_tag()}+w.{self._window_tag()}", self._metric_fp,
+            arg_tree=(self._compute_input_abstract(),) + self._compute_extra_abs(),
             mesh=self._cfg.mesh, donate=False, sync=self._sync_tag(),
             precision=self._precision_tag,
         )
         metric = self._metric
 
         def build():
+            def compute(state, *extra):
+                tree = self._window_fold_traced(self._compute_tree(state), *extra)
+                return metric.compute_from(tree)
+
             with self._kernel_scope():
                 return (
-                    jax.jit(lambda state: metric.compute_from(self._compute_tree(state)))
-                    .lower(self._compute_input_abstract())
+                    jax.jit(compute)
+                    .lower(self._compute_input_abstract(), *self._compute_extra_abs())
                     .compile()
                 )
+
+        return self._aot.get_or_compile(key, build)
+
+    def _pane_value_program(self):
+        """ONE pane's result from the carried/merged state + a runtime pane
+        index — the drift detector's per-closing-pane observable. For
+        tumbling rings this IS the compute program (same signature, same
+        fold); sliding rings compile one extra indexed-pane program (cached,
+        so rotations stay compile-free after the first)."""
+        if self._window.kind == "tumbling":
+            return self._compute_program()
+        pane_abs = jax.ShapeDtypeStruct((), jnp.int32)
+        key = self._aot.program_key(
+            f"pane_value+k.{self._kernel_tag()}+w.{self._window_tag()}", self._metric_fp,
+            arg_tree=(self._compute_input_abstract(), pane_abs),
+            mesh=self._cfg.mesh, donate=False, sync=self._sync_tag(),
+            precision=self._precision_tag,
+        )
+        metric = self._metric
+
+        def build():
+            from jax import lax
+
+            def pane_value(state, pane):
+                tree = self._compute_tree(state)
+                row = jax.tree.map(
+                    lambda x: lax.dynamic_index_in_dim(x, pane, 0, keepdims=False), tree
+                )
+                return metric.compute_from(row)
+
+            with self._kernel_scope():
+                return (
+                    jax.jit(pane_value)
+                    .lower(self._compute_input_abstract(), pane_abs)
+                    .compile()
+                )
+
+        return self._aot.get_or_compile(key, build)
+
+    def _rotate_program(self):
+        """The compiled ring-rotation init-fill: ``(state, pane) -> state``
+        with the INCOMING pane row reset to the metric's init state — one
+        runtime-indexed write per dtype buffer (or per leaf without arenas),
+        non-donated (the plan/commit split: a retried transient re-runs
+        against the untouched carry). One compile per engine, ever."""
+        pane_abs = jax.ShapeDtypeStruct((), jnp.int32)
+        key = self._aot.program_key(
+            f"pane_rotate+k.{self._kernel_tag()}+w.{self._window_tag()}", self._metric_fp,
+            arg_tree=(self._abstract_state(), pane_abs),
+            mesh=self._cfg.mesh, donate=False, sync=self._sync_tag(),
+            precision=self._precision_tag,
+        )
+
+        def build():
+            init_tree = jax.tree.map(jnp.asarray, self._kind_init_state_tree())
+            if self._layout is not None:
+                init_row = {
+                    k: np.asarray(v) for k, v in self._layout.pack(init_tree).items()
+                }
+
+                def rotate(state, pane):
+                    # pane axis is ndim-2 in both carried forms ((panes, n)
+                    # and (world, panes, n)); .at with a traced index lowers
+                    # to one dynamic-update per dtype — never per leaf
+                    out = {}
+                    for k, v in state.items():
+                        row = jnp.asarray(init_row[k])
+                        if v.ndim == 3:  # (world, panes, n): broadcast over shards
+                            out[k] = v.at[:, pane, :].set(row)
+                        else:
+                            out[k] = v.at[pane, :].set(row)
+                    return out
+            else:
+                def rotate(state, pane):
+                    def one(x, i):
+                        i = jnp.asarray(i, x.dtype)
+                        if self._deferred:  # (world, panes) + leaf shape
+                            return x.at[:, pane].set(i)
+                        return x.at[pane].set(i)
+
+                    return jax.tree.map(one, state, init_tree)
+
+            with self._kernel_scope():
+                return jax.jit(rotate).lower(self._abstract_state(), pane_abs).compile()
+
+        return self._aot.get_or_compile(key, build)
+
+    def _decay_program(self):
+        """The compiled EWMA rotation: one fused scale-accumulate over the
+        carried per-dtype buffers (eligibility guarantees every state is a
+        float sum accumulator, so the scalar multiply IS the exact decay of
+        the accumulation). Non-donated, same plan/commit contract as the
+        ring rotation."""
+        key = self._aot.program_key(
+            f"pane_decay+k.{self._kernel_tag()}+w.{self._window_tag()}", self._metric_fp,
+            arg_tree=self._abstract_state(),
+            mesh=self._cfg.mesh, donate=False, sync=self._sync_tag(),
+            precision=self._precision_tag,
+        )
+        decay = self._window.decay
+
+        def build():
+            def scale(state):
+                return jax.tree.map(lambda x: x * jnp.asarray(decay, x.dtype), state)
+
+            with self._kernel_scope():
+                return jax.jit(scale).lower(self._abstract_state()).compile()
 
         return self._aot.get_or_compile(key, build)
 
@@ -1238,7 +1578,7 @@ class StreamingEngine:
         self.flush()
         with self._state_lock:
             state = self._merged_state() if self._deferred else self._state
-            value = self._compute_program()(state)
+            value = self._compute_program()(state, *self._compute_extra())
         if handle is not None:
             jax.block_until_ready(value)  # the SLO observable is value-in-hand
             tr.observe("result_latency_us", tr.end(handle))
@@ -1277,6 +1617,26 @@ class StreamingEngine:
     @property
     def arena_layout(self) -> Optional[ArenaLayout]:
         return self._layout
+
+    @property
+    def window(self) -> Optional[WindowPolicy]:
+        """The active (rotating) window policy — None for cumulative serving."""
+        return self._window
+
+    @property
+    def pane_cursor(self) -> int:
+        """Current pane slot of the ring (always 0 for ewma/cumulative)."""
+        return self._pane_cursor
+
+    @property
+    def rotations(self) -> int:
+        """Pane rotations performed since construction/reset/restore base."""
+        return self._rotations
+
+    @property
+    def drift(self) -> Optional[DriftDetector]:
+        """The wired drift detector (None when drift tracking is off)."""
+        return self._drift
 
     @property
     def trace(self) -> Optional[TraceRecorder]:
@@ -1386,6 +1746,16 @@ class StreamingEngine:
             gauges["resident_streams"] = s.resident_streams
             gauges["spilled_streams"] = s.spilled_streams
             gauges["spilled_bytes"] = s.spilled_bytes
+        if s.windows_summary() is not None:
+            # windowed semantics (ISSUE 13): rotation/decay/drift families
+            # join the exposition only for windowed engines — every
+            # cumulative engine's surface stays byte-stable
+            counters["pane_rotations"] = s.pane_rotations
+            counters["ewma_decays"] = s.ewma_decays
+            counters["drift_evals"] = s.drift_evals
+            counters["drift_alarms"] = s.drift_alarms
+            gauges["live_panes"] = s.live_panes
+            gauges["pane_cursor"] = s.pane_cursor
         hists = self._trace.histograms() if self._trace is not None else ()
         return render_openmetrics(
             counters, hists, labeled_counters=labeled or None, gauges=gauges
@@ -1415,6 +1785,14 @@ class StreamingEngine:
         self._state_version += 1
         self._step = 0
         self._batches_done = 0
+        if self._window is not None:
+            self._pane_cursor = 0
+            self._rotations = 0
+            self._last_rotate_batches = 0
+            self._pane_open_cursor = 0
+            self._last_rotate_time = self._win_clock()
+            self._stats.pane_cursor = 0
+            self._stats.live_panes = 1
 
     # ---------------------------------------------------------------------- recovery
 
@@ -1491,6 +1869,16 @@ class StreamingEngine:
 
             meta["codec"] = CODEC_ID
             meta["codec_fp"] = self._precision_tag
+        if self._window is not None:
+            # pane-ring provenance (ISSUE 13): the policy fingerprint is the
+            # cross-policy refusal key; cursor + rotation marks let a
+            # restored engine resume mid-ring without re-rotating the
+            # boundary (pane_fill = batches folded into the current pane)
+            meta["window"] = self._window.fingerprint()
+            meta["panes"] = self._panes
+            meta["pane_cursor"] = self._pane_cursor
+            meta["rotations"] = self._rotations
+            meta["pane_fill"] = self._batches_done - self._pane_open_cursor
         meta.update(self._snapshot_meta_extra())
         return host_state, meta
 
@@ -1513,7 +1901,9 @@ class StreamingEngine:
 
         if self._deferred:
             tree = (
-                self._layout.unpack_stacked(self._state)
+                self._layout.unpack_stacked(
+                    self._state, lead=2 if self._win_stacked else 1
+                )
                 if self._layout is not None
                 else self._state
             )
@@ -1571,11 +1961,28 @@ class StreamingEngine:
             )
         return meta
 
+    def _check_window_provenance(self, meta: Dict[str, Any]) -> None:
+        """The cross-policy refusal (ISSUE 13): a pane ring is only
+        replayable under the policy that built it — pane boundaries, ring
+        depth, and decay factors are all part of what the buffers MEAN. A
+        snapshot without window provenance is a cumulative snapshot (empty
+        tag), so windowed<->unwindowed mismatches refuse symmetrically."""
+        snap_win = str(meta.get("window", "") or "")
+        eng_win = self._window.fingerprint() if self._window is not None else ""
+        if snap_win != eng_win:
+            raise MetricsTPUUserError(
+                f"snapshot window policy {snap_win or 'cumulative'!r} does not match "
+                f"this engine's {eng_win or 'cumulative'!r}: pane rings are only "
+                "replayable under the policy that built them — restore into an "
+                "engine constructed with the same WindowPolicy"
+            )
+
     def _restore_commit(self, state: Any, meta: Dict[str, Any]) -> None:
         """Validate a loaded snapshot against this engine's mode/topology and
         commit it (the restore matrix). Subclasses reroute snapshots carrying
         other topologies (the stream-sharded engine's restore matrix) before
         falling back here."""
+        self._check_window_provenance(meta)
         # codec-wrapped (compressed) payloads decode FIRST — the wrapped
         # leaves are self-describing, so every path of the restore matrix
         # (same-world verbatim, host merge, shard-0 embed) sees plain arrays.
@@ -1603,7 +2010,11 @@ class StreamingEngine:
             # leaves (identical buffers, scrambled unpack) — the layout
             # FINGERPRINT in meta is the sufficient check
             saved_fp = str(meta.get("arena_fp", "") or "")
-            shape_ok = self._layout.matches(state, world=snap_world if snap_deferred else None)
+            shape_ok = self._layout.matches(
+                state,
+                world=snap_world if snap_deferred else None,
+                panes=self._panes if self._win_stacked else None,
+            )
             if not shape_ok or (saved_fp and saved_fp != self._layout.fingerprint()):
                 raise MetricsTPUUserError(
                     "snapshot arena does not match this metric's layout "
@@ -1626,7 +2037,11 @@ class StreamingEngine:
         if snap_deferred and self._deferred and snap_world == self._world:
             new_state = self._put_state(state, packed=packed, stacked=True)
         elif snap_deferred:
-            stacked_tree = self._layout.unpack_stacked(state) if packed else state
+            stacked_tree = (
+                self._layout.unpack_stacked(state, lead=2 if self._win_stacked else 1)
+                if packed
+                else state
+            )
             logical = self._metric.merge_stacked_states(stacked_tree)
             template_leaves, template_def = jax.tree_util.tree_flatten(self._abstract_state_tree())
             leaves, treedef = jax.tree_util.tree_flatten(logical)
@@ -1679,6 +2094,21 @@ class StreamingEngine:
             # must land on top of both, or replay double-counts it
             self._step = int(meta.get("step", 0))
             self._batches_done = int(meta.get("batches_done", self._step))
+            if self._window is not None:
+                # resume mid-ring: cursor + rotation count restore verbatim;
+                # the batch-cadence mark re-derives from the cursor so the
+                # next rotation lands at the ORIGINAL pane boundary, and the
+                # time-cadence clock restarts fresh (wall time does not
+                # replay — the injectable clock owns that determinism)
+                self._pane_cursor = int(meta.get("pane_cursor", 0))
+                self._rotations = int(meta.get("rotations", 0))
+                self._pane_open_cursor = self._batches_done - int(
+                    meta.get("pane_fill", 0)
+                )
+                self._last_rotate_batches = self._pane_open_cursor
+                self._last_rotate_time = self._win_clock()
+                self._stats.pane_cursor = self._pane_cursor
+                self._stats.live_panes = min(self._rotations + 1, self._panes)
             self._stats.rows_in = int(meta.get("rows_in", self._stats.rows_in))
             self._stats.rows_padded = int(meta.get("rows_padded", self._stats.rows_padded))
             self._stats.resumes += 1
@@ -1838,6 +2268,16 @@ class StreamingEngine:
             limit = min(
                 limit,
                 self._cfg.snapshot_every - (self._batches_done % self._cfg.snapshot_every),
+            )
+        if self._window is not None and self._window.pane_batches > 0:
+            # a megabatch must not straddle a pane boundary: rows past the
+            # boundary belong to the NEXT pane (same exactness contract as
+            # the snapshot cadence; time-cadence panes rotate between groups
+            # by construction)
+            limit = min(
+                limit,
+                self._window.pane_batches
+                - (self._batches_done - self._last_rotate_batches),
             )
         group = [first]
         if limit <= 1:
@@ -2236,6 +2676,132 @@ class StreamingEngine:
         self._payload_split = None
         self._merged_memo = None
 
+    # ------------------------------------------------------------ pane rotation
+
+    def _maybe_rotate_locked(self) -> None:
+        """Rotate the pane ring at this batch boundary when the cadence is
+        due (dispatcher thread, state lock held). Batch cadence is a pure
+        function of the replay cursor — kill/resume replays rotations at the
+        same boundaries; time cadence reads the policy's injectable clock
+        and advances on a drift-free schedule (``+= pane_seconds`` per
+        rotation, so a stalled dispatcher catches up pane by pane)."""
+        w = self._window
+        if w is None:
+            return
+        due = w.rotations_due(
+            self._batches_done, self._last_rotate_batches,
+            self._win_clock(), self._last_rotate_time,
+        )
+        for _ in range(due):
+            self._rotate_once_locked()
+
+    def _rotate_once_locked(self) -> None:
+        """One pane rotation, PLAN/COMMIT split like the pager (ISSUE 13
+        satellite: a transiently-retried rotation must never double-decay).
+
+        Plan: evaluate the closing pane for the drift detector (a pure read
+        — ``drift_eval`` transients re-read the same state) and run the
+        non-donated rotate/decay program (``pane_rotate`` transients re-run
+        against the untouched carry; EWMA's scale applies to the OLD buffers
+        each attempt, so exactly one decay ever lands). Commit: swap the
+        state, bump the cursor/rotation marks, record once."""
+        drift_values: Optional[List[Tuple[Optional[int], Any]]] = None
+        # EMPTY panes (a time-cadence catch-up closing panes no batch ever
+        # touched, a traffic gap) are NOT drift observations: recording an
+        # init-state result would raise false alarms and — under the
+        # first/mean baselines — poison the reference forever
+        if self._drift is not None and self._batches_done > self._pane_open_cursor:
+
+            def eval_once() -> List[Tuple[Optional[int], Any]]:
+                self._fault("drift_eval")
+                return self._drift_values_locked()
+
+            drift_values = self._retry_transient(eval_once)
+        incoming = (
+            (self._pane_cursor + 1) % self._panes if self._window.stacked else 0
+        )
+        ewma = self._window.kind == "ewma"
+        planned = self._plan_rotation(incoming)
+        # ---- commit (everything below is infallible bookkeeping)
+        self._commit_rotation(planned, incoming)
+        self._merged_memo = None
+        self._result_cache.clear()
+        self._pane_cursor = incoming
+        self._rotations += 1
+        self._pane_open_cursor = self._batches_done
+        if self._window.pane_batches > 0:
+            self._last_rotate_batches += self._window.pane_batches
+        else:
+            self._last_rotate_time += self._window.pane_seconds
+        self._stats.record_rotation(
+            cursor=self._pane_cursor,
+            live=min(self._rotations + 1, self._panes),
+            ewma=ewma,
+        )
+        if self._trace is not None:
+            self._trace.event(
+                "pane_rotate", trace=ENGINE_TRACE,
+                rotation=self._rotations, cursor=self._pane_cursor,
+                kind=self._window.kind,
+            )
+        if drift_values is not None:
+            self._record_drift(drift_values)
+
+    def _plan_rotation(self, incoming: int) -> Any:
+        """The FALLIBLE half of a rotation: run the non-donated rotate/decay
+        program under the ``pane_rotate`` fault site and the bounded
+        transient retry. Pure in the carried state — a retried attempt
+        re-runs against the untouched carry, so nothing ever decays or
+        clears twice. Subclasses with non-device rings (the stream-sharded
+        pager) override with their own pure plan."""
+
+        def rotate_once() -> Any:
+            self._fault("pane_rotate")
+            if self._win_stacked:
+                return self._rotate_program()(
+                    self._state, jnp.asarray(incoming, jnp.int32)
+                )
+            return self._decay_program()(self._state)
+
+        return self._retry_transient(rotate_once)
+
+    def _commit_rotation(self, planned: Any, incoming: int) -> None:
+        """The infallible half: swap in the planned state."""
+        self._state = planned
+        self._state_version += 1
+
+    def _drift_values_locked(self) -> List[Tuple[Optional[int], Any]]:
+        """The CLOSING pane's result(s) as host values, ``(series_key,
+        value)`` pairs — one anonymous series for the base engine; the
+        multi-stream engine overrides with one series per stream. Pure read:
+        the carried state is not touched (the drift_eval retry contract)."""
+        state = self._merged_state() if self._deferred else self._state
+        if self._win_stacked:
+            value = self._pane_value_program()(
+                state, jnp.asarray(self._pane_cursor, jnp.int32)
+            )
+        else:  # ewma: the decayed accumulation, read BEFORE this decay
+            value = self._compute_program()(state)
+        return [(None, jax.device_get(value))]
+
+    def _record_drift(self, values: List[Tuple[Optional[int], Any]]) -> None:
+        """Commit half of the drift evaluation: record each series exactly
+        once (after any plan-phase retries) and surface transitions as
+        ``drift_alarm`` trace events + counters."""
+        pane = self._rotations - 1  # the pane that just closed, 0-based
+        for key, value in values:
+            transitions = self._drift.record(value, key=key, pane=pane)
+            self._stats.drift_evals += 1
+            for a in transitions:
+                if a.kind == "raise":
+                    self._stats.drift_alarms += 1
+                if self._trace is not None:
+                    self._trace.event(
+                        "drift_alarm", trace=ENGINE_TRACE,
+                        kind=a.kind, series=a.name, pane=pane,
+                        **({"stream_id": a.key} if a.key is not None else {}),
+                    )
+
     # ---------------------------------------------------------- elastic reshard
 
     def reshard(
@@ -2523,6 +3089,12 @@ class StreamingEngine:
         # runtime abort): _run exits without draining — the wedge that
         # submit(timeout=)'s sticky raise and _join_queue exist for
         self._fault("dispatcher_kill")
+        if self._window is not None and self._window.pane_seconds > 0:
+            # TIME-cadence panes rotate BEFORE the group folds: a batch that
+            # arrives after the pane's deadline belongs to the NEW pane
+            # (batch-cadence panes rotate after the boundary group below —
+            # the boundary batch completes its pane)
+            self._maybe_rotate_locked()
         self._fault("ingest")  # host ingestion boundary: nothing folded yet
         # size each item ONCE; the sizes feed the empty filter, the screen,
         # the merge's concat, the chunker, and the coalesce telemetry
@@ -2566,6 +3138,11 @@ class StreamingEngine:
                         )
                         raise
         self._batches_done += len(group)
+        if self._window is not None and self._window.pane_batches > 0:
+            # rotate BEFORE the snapshot cadence: a boundary snapshot then
+            # carries the post-rotation ring (cursor + marks in meta), so a
+            # restored engine never re-rotates the same boundary
+            self._maybe_rotate_locked()
         if (
             self._cfg.snapshot_every > 0
             and self._batches_done % self._cfg.snapshot_every == 0
@@ -2649,6 +3226,13 @@ class StreamingEngine:
         demotion, and sticky. Upload happens once — retries reuse the uploaded
         payload. ``t0`` is when pad/route work on this payload began, so the
         recorded ``pad`` span covers the caller's host-side build too."""
+        if self._win_stacked:
+            # the RUNTIME pane index leads the payload: a 0-d int32 ARRAY (a
+            # python int would bake into the trace and every rotation would
+            # recompile), replicated under a mesh like any broadcast leaf,
+            # and shape-stable in the payload signature — the program memo
+            # never misses on a pane bump
+            a = (np.asarray(self._pane_cursor, np.int32),) + tuple(a)
         t_pad = time.perf_counter()
         payload, mask_dev = self._upload((a, kw), mask)
         ingest_us = (time.perf_counter() - t0) * 1e6  # pad+upload only, not compile

@@ -127,6 +127,18 @@ class EngineStats:
         # (from/to world, replay cursor) — what engine_report surfaces
         self.reshards = 0
         self.reshard_last: Optional[Dict[str, Any]] = None
+        # windowed semantics (ISSUE 13): pane-ring rotation accounting.
+        # window_policy is the canonical policy tag (set at engine
+        # construction, None for cumulative engines — their telemetry
+        # documents stay byte-stable); live_panes/pane_cursor are gauges
+        # refreshed at each rotation, the counters are lifetime totals.
+        self.window_policy: Optional[str] = None
+        self.pane_rotations = 0
+        self.ewma_decays = 0
+        self.live_panes = 0
+        self.pane_cursor = 0
+        self.drift_evals = 0
+        self.drift_alarms = 0
 
     def record_admission(self, outcome: str, priority: int) -> None:
         """One admission verdict (``"admitted"``/``"rejected"``/``"shed"``)
@@ -187,6 +199,35 @@ class EngineStats:
             "ladder_transitions": self.ladder_transitions,
             "deferred_reads": self.deferred_reads,
         }
+
+    def record_rotation(self, cursor: int, live: int, ewma: bool) -> None:
+        """One committed pane rotation (dispatcher thread only)."""
+        self.pane_rotations += 1
+        if ewma:
+            self.ewma_decays += 1
+        self.pane_cursor = int(cursor)
+        self.live_panes = int(live)
+
+    def windows_summary(self) -> Optional[Dict[str, Any]]:
+        """The windowed-semantics block for :meth:`summary` — None for
+        cumulative engines (no window policy was ever set), so every
+        pre-window telemetry document is unchanged."""
+        if self.window_policy is None:
+            return None
+        out: Dict[str, Any] = {
+            "policy": self.window_policy,
+            "pane_rotations": self.pane_rotations,
+            "live_panes": self.live_panes,
+            "pane_cursor": self.pane_cursor,
+        }
+        if self.ewma_decays:
+            out["ewma_decays"] = self.ewma_decays
+        if self.drift_evals or self.drift_alarms:
+            out["drift"] = {
+                "evals": self.drift_evals,
+                "alarms": self.drift_alarms,
+            }
+        return out
 
     def reshard_summary(self) -> Optional[Dict[str, Any]]:
         """The elastic-reshard block — None until the engine resharded."""
@@ -352,6 +393,9 @@ class EngineStats:
         admission = self.admission_summary()
         if admission is not None:
             out["admission"] = admission
+        windows = self.windows_summary()
+        if windows is not None:
+            out["windows"] = windows
         reshard = self.reshard_summary()
         if reshard is not None:
             out["reshard"] = reshard

@@ -17,6 +17,19 @@ batch. With ``shift_at=None`` the sequence is BIT-IDENTICAL to the
 pre-ISSUE-11 generator (same draws, same order), so the existing smokes'
 seeded workloads are unchanged.
 
+The LABEL/SCORE DRIFT mode (ISSUE 13) models the scenario the windowed
+engine's drift detector exists for: from ``drift_at`` onward the traffic's
+DISTRIBUTION shifts gradually — scores ramp upward by dyadic increments
+(``drift_score``) and/or labels flip with a ramping probability
+(``drift_flip``), both reaching full strength over ``drift_ramp`` batches —
+so a per-pane accuracy/error series visibly walks away from its baseline.
+Same determinism contract as PR 11's hot-spot shift: the drift TRANSFORMS
+already-drawn batches (score shifts are pure functions of the drawn values;
+label flips draw from a per-batch-index seeded side stream), so the
+pre-drift prefix of a drifted call is BIT-IDENTICAL to the undrifted call,
+and two same-seed drifted runs are identical everywhere (pinned in
+``tests/engine/test_traffic.py``).
+
 Values are dyadic rationals (multiples of 1/64), the repo-wide convention
 that makes float accumulation exact under ANY grouping, routing, or paging
 order — bit-identical parity claims quantify over exactly this traffic.
@@ -29,6 +42,17 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 __all__ = ["zipf_stream_ids", "zipf_traffic"]
+
+_DRIFT_SEED_SALT = 0xD21F7
+
+
+def _drift_strength(i: int, drift_at: int, drift_ramp: int) -> float:
+    """Ramp from 0 (before ``drift_at``) to 1 (``drift_ramp`` batches later),
+    piecewise-linear — the GRADUAL shift a hysteresis-guarded detector must
+    ride out, then alarm on."""
+    if i < drift_at:
+        return 0.0
+    return min(1.0, (i - drift_at + 1) / max(1, int(drift_ramp)))
 
 
 def zipf_stream_ids(
@@ -91,6 +115,11 @@ def zipf_traffic(
     shift_at: Optional[int] = None,
     shift_rotation: Optional[int] = None,
     shift_alpha: Optional[float] = None,
+    drift_at: Optional[int] = None,
+    drift_ramp: int = 8,
+    drift_score: float = 0.0,
+    drift_flip: float = 0.0,
+    label_acc: Optional[float] = None,
 ) -> List[Tuple[int, np.ndarray, np.ndarray]]:
     """``(stream_id, preds, target)`` batches under the Zipfian stream law:
     ragged dyadic-float preds and 0/1 int targets (the Accuracy/MSE input
@@ -99,16 +128,59 @@ def zipf_traffic(
     production ingest. ``shift_at``/``shift_rotation``/``shift_alpha`` pass
     through to :func:`zipf_stream_ids` (batch CONTENTS draw from an
     id-independent RNG, so the shift reroutes batches without changing
-    their rows)."""
+    their rows).
+
+    ``drift_at`` arms the LABEL/SCORE drift (ISSUE 13): batches at indices
+    >= ``drift_at`` transform — preds shift upward by
+    ``round(64 * drift_score * strength) / 64`` (clipped to [0, 1], so
+    values stay dyadic) and each target row flips with probability
+    ``drift_flip * strength``, where ``strength`` ramps linearly from 0 to 1
+    over ``drift_ramp`` batches. The base draws are UNCHANGED (score drift
+    is a pure remap; label flips draw from a per-batch-index seeded side
+    stream), so the pre-drift prefix is bit-identical to the undrifted call
+    and the whole sequence is deterministic in its arguments.
+
+    ``label_acc`` correlates targets with predictions: each target agrees
+    with ``preds > 0.5`` with that probability (same RNG budget as the
+    default independent draw — one uniform per row — so arming it changes
+    only the MAPPING of the draws). Without it, targets are independent of
+    preds and a label flip cannot move accuracy — set it (e.g. 0.9) when
+    the drift detector should see a real accuracy signal."""
     rng = np.random.RandomState(seed ^ 0x7AFF)
     sids = zipf_stream_ids(
         num_streams, n_batches, alpha=alpha, seed=seed,
         shift_at=shift_at, shift_rotation=shift_rotation, shift_alpha=shift_alpha,
     )
+    if drift_at is not None and drift_at < 0:
+        raise ValueError(f"drift_at must be >= 0, got {drift_at}")
     out: List[Tuple[int, np.ndarray, np.ndarray]] = []
-    for sid in sids:
+    for i, sid in enumerate(sids):
         rows = int(rng.randint(1, max(2, max_rows + 1)))  # inclusive max_rows
         preds = (rng.randint(0, 65, size=rows) / 64.0).astype(np.float32)
-        target = (rng.rand(rows) > 0.5).astype(np.int32)
+        u = rng.rand(rows)
+        if label_acc is None:
+            target = (u > 0.5).astype(np.int32)
+        else:
+            pred_label = (preds > 0.5).astype(np.int32)
+            agree = u < float(label_acc)
+            target = np.where(agree, pred_label, 1 - pred_label).astype(np.int32)
+        if drift_at is not None and i >= drift_at:
+            strength = _drift_strength(i, drift_at, drift_ramp)
+            if drift_score:
+                # dyadic shift on the 1/64 grid: exact float32 arithmetic,
+                # and a pure remap of the already-drawn values
+                shift64 = int(round(64.0 * float(drift_score) * strength))
+                preds = np.minimum(
+                    np.round(preds * 64).astype(np.int64) + shift64, 64
+                ).astype(np.float32) / np.float32(64.0)
+            if drift_flip:
+                # the flip stream is keyed by (seed, batch index) alone —
+                # independent of the prefix draws, so arming the drift can
+                # never shift the base sequence
+                flip_rng = np.random.RandomState(
+                    (seed ^ _DRIFT_SEED_SALT ^ (i * 2654435761)) & 0x7FFFFFFF
+                )
+                flips = flip_rng.rand(rows) < float(drift_flip) * strength
+                target = np.where(flips, 1 - target, target).astype(np.int32)
         out.append((int(sid), preds, target))
     return out

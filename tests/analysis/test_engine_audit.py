@@ -207,6 +207,103 @@ def test_audit_catches_a_blown_compile_cap():
     assert [f.rule for f in report.findings] == ["compile-cap"], report.render()
 
 
+def _drive_windowed(kind="sliding", seed=0):
+    """A windowed engine (ISSUE 13) driven through REAL rotations: the
+    audited step is the runtime-pane-indexed ring update over (panes, n)
+    carried buffers."""
+    from metrics_tpu.engine import WindowPolicy
+
+    win = (
+        WindowPolicy.sliding(n_panes=2, pane_batches=2)
+        if kind == "sliding"
+        else WindowPolicy.tumbling(pane_batches=2, n_panes=2)
+    )
+    eng = StreamingEngine(
+        MetricCollection([Accuracy(), MeanSquaredError()]),
+        EngineConfig(buckets=(8,), coalesce=1, window=win),
+    )
+    rng = np.random.RandomState(seed)
+    with eng:
+        for n in (5, 8, 3, 6):  # rotations at batches 2 and 4
+            eng.submit(rng.rand(n).astype(np.float32), (rng.rand(n) > 0.5).astype(np.int32))
+        eng.result()
+    assert eng.rotations == 2
+    return eng
+
+
+def test_windowed_engine_audits_clean():
+    """ISSUE 13 clean sweep: the pane-ring step's ONE runtime-indexed
+    dynamic-update per dtype into the (panes, n) buffers is the design —
+    the arena rule (taught the pane-stacked shapes) and the windowed
+    compile cap must not false-positive on a rotated engine."""
+    for kind in ("sliding", "tumbling"):
+        eng = _drive_windowed(kind)
+        report = EngineAnalysis().check(eng)
+        assert report.findings == [], (kind, report.render())
+
+
+def test_audit_catches_a_rotation_that_retraces():
+    """Broken fixture for the windowed compile cap: a rotation that bakes
+    the pane cursor into its program identity compiles one program PER PANE
+    VALUE — the open-set regression the runtime-arg design exists to
+    prevent — and the windowed cap fires ``compile-cap`` on the extra
+    programs exactly like any other retrace."""
+    eng = _drive_windowed()
+    assert EngineAnalysis().check(eng).ok  # sane before the break
+
+    # emulate the regression: per-cursor rotate programs join the engine's
+    # owned set (same fingerprint/mesh/sync — exactly what a cursor baked
+    # into the key would produce over a served ring)
+    for cursor in range(4):
+        key = eng._aot.program_key(
+            f"pane_rotate@cursor{cursor}", eng._metric_fp,
+            arg_tree=eng._abstract_state(),
+            mesh=eng._cfg.mesh, donate=False, sync=eng._sync_tag(),
+            precision=eng._precision_tag,
+        )
+        eng._aot.get_or_compile(key, lambda: object())
+    report = EngineAnalysis().check(eng)
+    assert [f.rule for f in report.findings] == ["compile-cap"], report.render()
+    assert "window programs" in report.findings[0].message
+
+
+def test_audit_catches_a_per_leaf_pack_in_the_pane_row():
+    """Broken fixture for the pane-taught arena rule: a step that writes
+    each leaf into the flat (n,) pane ROW individually (instead of one
+    concat per dtype, then one pane write) degrades the pack — the rule's
+    windowed buffer_shapes must flag it while staying silent on the
+    legitimate (panes, n) ring write."""
+    eng = _drive_windowed()
+    assert EngineAnalysis().check(eng).ok
+
+    layout = eng._layout
+    inner = eng._traced_update
+
+    def per_leaf_packing_update(state_tree, payload, mask):
+        new = inner(state_tree, payload, mask)
+        # re-pack the row by writing each leaf into the flat buffer — the
+        # degradation the rule exists for (shapes preserved, fusion lost)
+        row = layout.pack(new)
+        leaves = jax.tree_util.tree_flatten(new)[0]
+        rebuilt = {}
+        for k, buf in row.items():
+            out = jnp.zeros_like(buf)
+            off = 0
+            for spec, leaf in zip(layout._specs, leaves):
+                if spec.key == k:
+                    out = out.at[spec.offset : spec.offset + spec.size].set(
+                        jnp.ravel(jnp.asarray(leaf, spec.dtype))
+                    )
+                    off += spec.size
+            rebuilt[k] = out
+        return layout.unpack(rebuilt)
+
+    eng._traced_update = per_leaf_packing_update
+    report = EngineAnalysis().check(eng)
+    rules = {f.rule for f in report.findings}
+    assert "arena-pack-fused" in rules, report.render()
+
+
 # ----------------------------------------------------------------- baseline
 
 

@@ -1,0 +1,128 @@
+"""DriftDetector (engine/tracker.py): hysteresis, baselines, typed alarms.
+
+The detector's determinism contract mirrors the ladder's: the transition
+sequence is a pure function of the recorded values — no wall time, no
+thread state — so scripted series pin exact alarm lists.
+"""
+import numpy as np
+import pytest
+
+from metrics_tpu.engine import DriftAlarm, DriftAlarmError, DriftDetector
+
+
+def test_validation():
+    with pytest.raises(ValueError, match="threshold"):
+        DriftDetector(threshold=0.0)
+    with pytest.raises(ValueError, match="up_after"):
+        DriftDetector(threshold=0.1, up_after=0)
+    with pytest.raises(ValueError, match="baseline"):
+        DriftDetector(threshold=0.1, baseline="median")
+
+
+def test_hysteresis_raise_and_clear_sequence():
+    det = DriftDetector(threshold=0.1, up_after=2, down_after=2, baseline="first")
+    series = [0.5, 0.5, 0.8, 0.8, 0.5, 0.5, 0.5]
+    transitions = []
+    for pane, v in enumerate(series):
+        transitions += det.record(v, pane=pane)
+    kinds = [(a.kind, a.pane) for a in transitions]
+    # pane 2 deviates (streak 1 — no alarm), pane 3 completes the raise;
+    # pane 4 returns (streak 1), pane 5 completes the clear
+    assert kinds == [("raise", 3), ("clear", 5)]
+    assert det.alarmed_series() == []
+    assert det.alarms("raise")[0].baseline == 0.5
+    assert det.alarms("raise")[0].delta == pytest.approx(0.3)
+
+
+def test_single_noisy_pane_never_alarms():
+    det = DriftDetector(threshold=0.1, up_after=2, down_after=1)
+    out = []
+    for pane, v in enumerate([0.5, 0.9, 0.5, 0.9, 0.5]):  # alternating noise
+        out += det.record(v, pane=pane)
+    assert out == []  # the streak never reaches up_after
+
+
+def test_prev_baseline_tracks_rate_of_change():
+    det = DriftDetector(threshold=0.1, up_after=1, baseline="prev")
+    det.record(0.5)
+    det.record(0.55)
+    assert det.record(0.8)[0].kind == "raise"  # jump vs the PREVIOUS pane
+    # a slow walk never alarms under "prev" even when far from the start
+    det2 = DriftDetector(threshold=0.1, up_after=1, baseline="prev")
+    assert [a for v in np.arange(0.5, 2.0, 0.05) for a in det2.record(float(v))] == []
+
+
+def test_mean_baseline_is_running_mean_of_prior_panes():
+    det = DriftDetector(threshold=0.25, up_after=1, baseline="mean")
+    for v in (0.4, 0.6):  # mean = 0.5
+        assert det.record(v) == []
+    alarm = det.record(1.0)[0]
+    assert alarm.baseline == pytest.approx(0.5)
+
+
+def test_collection_results_track_one_series_per_member():
+    det = DriftDetector(threshold=0.1, up_after=1)
+    det.record({"Accuracy": 0.9, "MeanSquaredError": 0.1}, pane=0)
+    out = det.record({"Accuracy": 0.9, "MeanSquaredError": 0.5}, pane=1)
+    assert [a.name for a in out] == ["MeanSquaredError"]
+    assert det.history(name="Accuracy") == [0.9, 0.9]
+    assert det.alarmed_series() == [(None, "MeanSquaredError")]
+
+
+def test_per_key_series_are_independent():
+    det = DriftDetector(threshold=0.1, up_after=1)
+    det.record(0.5, key=0)
+    det.record(0.5, key=1)
+    out = det.record(0.9, key=1)
+    assert [(a.key, a.kind) for a in out] == [(1, "raise")]
+    assert det.record(0.5, key=0) == []
+
+
+def test_raise_on_alarm_raises_typed():
+    det = DriftDetector(threshold=0.1, up_after=1, raise_on_alarm=True)
+    det.record(0.5)
+    with pytest.raises(DriftAlarmError) as ei:
+        det.record(0.9)
+    assert isinstance(ei.value.alarm, DriftAlarm)
+    assert "delta=+0.4" in str(ei.value)
+
+
+def test_min_panes_warmup_suppresses_early_deviations():
+    det = DriftDetector(threshold=0.1, up_after=1, min_panes=3)
+    assert det.record(0.5) == []
+    assert det.record(0.9) == []  # deviating, but inside warmup
+    assert det.record(0.9) == []
+    assert det.record(0.9)[0].kind == "raise"  # 4th pane: armed
+
+
+def test_determinism_and_summary():
+    def run():
+        det = DriftDetector(threshold=0.1, up_after=2, down_after=1)
+        rng = np.random.RandomState(3)
+        for pane in range(30):
+            det.record(float(rng.rand()), pane=pane)
+        return det
+
+    a, b = run(), run()
+    assert [x.describe() for x in a.alarms()] == [x.describe() for x in b.alarms()]
+    s = a.summary()
+    assert s["evals"] == 30 and s["series"] == 1
+    assert s["alarms_raised"] == len(a.alarms("raise"))
+
+
+def test_non_scalar_members_are_skipped():
+    det = DriftDetector(threshold=0.1, up_after=1)
+    det.record({"curve": np.zeros((3,)), "acc": 0.5})
+    out = det.record({"curve": np.ones((3,)), "acc": 0.9})
+    assert [a.name for a in out] == ["acc"]
+
+
+def test_history_is_bounded_but_baselines_are_not():
+    det = DriftDetector(threshold=10.0, max_history=4, baseline="mean")
+    for v in range(10):
+        det.record(float(v))
+    assert det.history() == [6.0, 7.0, 8.0, 9.0]
+    # the running-mean baseline covers ALL 10 panes, not the bounded window
+    s = det._series[(None, "")]
+    assert s.running_sum == pytest.approx(sum(range(10)))
+    assert s.count == 10

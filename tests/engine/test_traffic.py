@@ -77,6 +77,72 @@ def test_values_stay_dyadic():
         assert set(np.unique(target)).issubset({0, 1})
 
 
+def test_drift_prefix_is_bitwise_undrifted():
+    """ISSUE 13 satellite pin: the label/score drift TRANSFORMS drawn
+    batches — the pre-drift prefix of a drifted call equals the undrifted
+    call bit for bit (ids, preds, and targets), and the post-drift tail
+    actually changed."""
+    base = zipf_traffic(8, 30, seed=5, max_rows=6)
+    drifted = zipf_traffic(
+        8, 30, seed=5, max_rows=6,
+        drift_at=15, drift_ramp=5, drift_flip=0.9, drift_score=0.25,
+    )
+    for i in range(15):
+        assert base[i][0] == drifted[i][0]
+        assert np.array_equal(base[i][1], drifted[i][1])
+        assert np.array_equal(base[i][2], drifted[i][2])
+    assert any(not np.array_equal(base[i][1], drifted[i][1]) for i in range(15, 30))
+    assert any(not np.array_equal(base[i][2], drifted[i][2]) for i in range(15, 30))
+
+
+def test_drift_is_deterministic_and_stays_dyadic():
+    kw = dict(seed=11, max_rows=5, drift_at=4, drift_ramp=3, drift_score=0.5, drift_flip=0.7)
+    a = zipf_traffic(6, 20, **kw)
+    b = zipf_traffic(6, 20, **kw)
+    for (sa, pa, ta), (sb, pb, tb) in zip(a, b):
+        assert sa == sb and np.array_equal(pa, pb) and np.array_equal(ta, tb)
+    for _, preds, target in a:
+        assert np.all(preds * 64 == np.round(preds * 64))  # dyadic after shift
+        assert preds.max() <= 1.0
+        assert set(np.unique(target)).issubset({0, 1})
+
+
+def test_drift_ramp_is_gradual():
+    """The score shift ramps: early post-drift batches shift less than the
+    saturated tail (the gradual distribution shift the hysteresis guard
+    must ride out before alarming)."""
+    base = zipf_traffic(4, 24, seed=2, max_rows=8)
+    drifted = zipf_traffic(4, 24, seed=2, max_rows=8, drift_at=8, drift_ramp=8, drift_score=0.5)
+    deltas = [
+        float(np.mean(drifted[i][1]) - np.mean(base[i][1])) for i in range(8, 24)
+    ]
+    assert deltas[0] < deltas[-1]
+    # saturated: the full 32/64 shift, up to the [0, 1] clip
+    assert max(deltas) > 0.2
+
+
+def test_label_acc_correlates_targets_with_predictions():
+    """With label_acc armed, targets mostly agree with preds > 0.5 — the
+    accuracy signal the drift detector needs; flips then genuinely erode
+    it. The RNG budget is unchanged (one uniform per row), so ids and preds
+    match the uncorrelated call exactly."""
+    plain = zipf_traffic(4, 40, seed=9, max_rows=8)
+    corr = zipf_traffic(4, 40, seed=9, max_rows=8, label_acc=0.9)
+    agree = total = 0
+    for (s0, p0, _t0), (s1, p1, t1) in zip(plain, corr):
+        assert s0 == s1 and np.array_equal(p0, p1)
+        agree += int(np.sum((p1 > 0.5).astype(np.int32) == t1))
+        total += len(t1)
+    assert agree / total > 0.8
+    flipped = zipf_traffic(
+        4, 40, seed=9, max_rows=8, label_acc=0.9, drift_at=0, drift_ramp=1, drift_flip=1.0
+    )
+    f_agree = sum(
+        int(np.sum((p > 0.5).astype(np.int32) == t)) for _s, p, t in flipped
+    )
+    assert f_agree / total < 0.3  # full flip inverts the agreement
+
+
 def test_shift_at_edge_cases_match_unshifted():
     base = zipf_stream_ids(8, 50, seed=4)
     assert np.array_equal(base, zipf_stream_ids(8, 50, seed=4, shift_at=50))

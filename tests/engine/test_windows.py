@@ -1,0 +1,613 @@
+"""Windowed & time-decayed metric semantics (ISSUE 13): the pane-ring layer.
+
+Pins the tentpole contracts at unit granularity (the 8-device composition
+claims live in ``make windows-smoke``):
+
+* policy validation + eligibility refusals (loud, at construction);
+* tumbling results bit-identical to a fresh-engine-per-pane oracle, sliding
+  folds exact vs recompute, ewma decay exact on dyadic values;
+* rotation is COMPILE-FREE in the steady state (AOT miss-counter delta of
+  zero across rotations — the acceptance criterion's pinned form);
+* pane-ring snapshot provenance: mid-ring kill/resume replays exactly,
+  cross-policy restores refuse loudly;
+* window x stream composition (unsharded MultiStreamEngine) and the
+  windows OpenMetrics/telemetry surfaces parse strictly both directions.
+"""
+import tempfile
+
+import numpy as np
+import pytest
+
+from metrics_tpu import Accuracy, MeanMetric, MeanSquaredError, MetricCollection
+from metrics_tpu.engine import (
+    DriftDetector,
+    EngineConfig,
+    MultiStreamEngine,
+    StreamingEngine,
+    WindowPolicy,
+)
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+
+def _col():
+    return MetricCollection([Accuracy(), MeanSquaredError()])
+
+
+def _batches(n=12, seed=0):
+    rng = np.random.RandomState(seed)
+    return [
+        (
+            (rng.randint(0, 65, size=k) / 64.0).astype(np.float32),
+            (rng.rand(k) > 0.5).astype(np.int32),
+        )
+        for k in rng.randint(2, 9, size=n)
+    ]
+
+
+def _oracle(bs):
+    e = StreamingEngine(_col(), EngineConfig(buckets=(8,)))
+    with e:
+        for b in bs:
+            e.submit(*b)
+        return {k: np.asarray(v) for k, v in e.result().items()}
+
+
+# ----------------------------------------------------------------- policy
+
+
+def test_policy_validation():
+    assert WindowPolicy.cumulative().panes == 1
+    assert not WindowPolicy.cumulative().stacked
+    assert WindowPolicy.tumbling(pane_batches=4).panes == 1
+    assert WindowPolicy.sliding(n_panes=3, pane_batches=2).panes == 3
+    assert WindowPolicy.ewma(alpha=0.25, pane_batches=1).decay == 0.75
+    with pytest.raises(ValueError, match="exactly one rotation cadence"):
+        WindowPolicy.tumbling()
+    with pytest.raises(ValueError, match="exactly one rotation cadence"):
+        WindowPolicy(kind="sliding", n_panes=2, pane_batches=2, pane_seconds=1.0)
+    with pytest.raises(ValueError, match="n_panes >= 2"):
+        WindowPolicy.sliding(n_panes=1, pane_batches=2)
+    with pytest.raises(ValueError, match="0 < alpha < 1"):
+        WindowPolicy.ewma(alpha=1.5, pane_batches=1)
+    with pytest.raises(ValueError, match="no cadence"):
+        WindowPolicy(kind="cumulative", pane_batches=3)
+    with pytest.raises(ValueError, match="one of"):
+        WindowPolicy(kind="hopping", pane_batches=3)
+
+
+def test_policy_fingerprint_is_canonical_and_clock_free():
+    a = WindowPolicy.sliding(n_panes=3, pane_batches=2)
+    b = WindowPolicy.sliding(n_panes=3, pane_batches=2, clock=lambda: 0.0)
+    assert a.fingerprint() == b.fingerprint() == "sliding:p3:b2"
+    assert WindowPolicy.ewma(alpha=0.25, pane_seconds=1.5).fingerprint() == "ewma:a0.25:s1.5"
+    assert WindowPolicy.cumulative().fingerprint() == "cumulative"
+
+
+def test_cumulative_policy_is_the_identity():
+    """An explicit cumulative policy serves byte-identically to no policy:
+    no pane axis, no rotations, same program behavior."""
+    bs = _batches()
+    eng = StreamingEngine(_col(), EngineConfig(buckets=(8,), window=WindowPolicy.cumulative()))
+    with eng:
+        for b in bs:
+            eng.submit(*b)
+        got = {k: np.asarray(v) for k, v in eng.result().items()}
+    assert eng.window is None and eng.rotations == 0
+    want = _oracle(bs)
+    for k in want:
+        assert np.array_equal(got[k], want[k])
+
+
+# ------------------------------------------------------------- eligibility
+
+
+def test_ewma_refuses_int_and_nonsum_states():
+    with pytest.raises(MetricsTPUUserError, match="floating"):
+        StreamingEngine(
+            Accuracy(), EngineConfig(window=WindowPolicy.ewma(alpha=0.5, pane_batches=1))
+        )
+    from metrics_tpu import MaxMetric
+
+    with pytest.raises(MetricsTPUUserError, match="sum-reducible"):
+        StreamingEngine(
+            MaxMetric(), EngineConfig(window=WindowPolicy.ewma(alpha=0.5, pane_batches=1))
+        )
+
+
+def test_windows_refuse_step_sync_mesh():
+    import jax
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("dp",))
+    with pytest.raises(MetricsTPUUserError, match="deferred"):
+        StreamingEngine(
+            Accuracy(),
+            EngineConfig(
+                window=WindowPolicy.tumbling(pane_batches=2), mesh=mesh, axis="dp"
+            ),
+        )
+
+
+def test_drift_requires_a_rotating_window():
+    with pytest.raises(MetricsTPUUserError, match="rotating config.window"):
+        StreamingEngine(
+            Accuracy(), EngineConfig(drift=DriftDetector(threshold=0.1))
+        )
+
+
+def test_engine_refuses_a_raise_on_alarm_detector():
+    """raise_on_alarm would turn the first drift alarm into the sticky
+    dispatcher error — refused loudly at construction (the detector records
+    on the dispatcher thread)."""
+    with pytest.raises(MetricsTPUUserError, match="raise_on_alarm"):
+        StreamingEngine(
+            Accuracy(),
+            EngineConfig(
+                window=WindowPolicy.tumbling(pane_batches=1),
+                drift=DriftDetector(threshold=0.1, raise_on_alarm=True),
+            ),
+        )
+
+
+def test_empty_catch_up_panes_are_not_drift_observations():
+    """A time-cadence catch-up closes panes no batch ever touched (a traffic
+    gap): those panes must NOT reach the detector — an init-state result
+    would raise a false alarm and poison the first/mean baselines."""
+    clock = {"t": 0.0}
+    det = DriftDetector(threshold=0.1, up_after=1, baseline="first")
+    eng = StreamingEngine(
+        Accuracy(),
+        EngineConfig(
+            buckets=(8,), coalesce=1,
+            window=WindowPolicy.tumbling(pane_seconds=1.0, clock=lambda: clock["t"]),
+            drift=det,
+        ),
+    )
+    p = np.asarray([0.9, 0.2], np.float32)
+    t = np.asarray([1, 0], np.int32)
+    with eng:
+        eng.submit(p, t)
+        eng.flush()
+        clock["t"] = 3.5  # three empty panes elapse before the next batch
+        eng.submit(p, t)
+        eng.flush()
+        clock["t"] = 4.5
+        eng.submit(p, t)
+        eng.flush()
+    assert eng.rotations >= 4
+    # only the two panes that actually held a batch were recorded, no alarms
+    assert det.history() == [1.0, 1.0]
+    assert det.alarms() == []
+
+
+# ------------------------------------------------------------------ parity
+
+
+def test_tumbling_matches_fresh_engine_per_pane_oracle():
+    bs = _batches(12)
+    eng = StreamingEngine(
+        _col(),
+        EngineConfig(
+            buckets=(8,), coalesce=1, window=WindowPolicy.tumbling(pane_batches=3)
+        ),
+    )
+    with eng:
+        for i, b in enumerate(bs):
+            eng.submit(*b)
+            if (i + 1) % 3 == 2 and i >= 3:  # mid-pane read of the open pane
+                start = ((i + 1) // 3) * 3
+                got = {k: np.asarray(v) for k, v in eng.result().items()}
+                want = _oracle(bs[start : i + 1])
+                for k in want:
+                    assert np.array_equal(got[k], want[k]), (i, k)
+    assert eng.rotations == 4
+
+
+def test_sliding_fold_matches_recompute():
+    bs = _batches(12, seed=3)
+    P, pane = 3, 2
+    eng = StreamingEngine(
+        _col(),
+        EngineConfig(
+            buckets=(8,), coalesce=1,
+            window=WindowPolicy.sliding(n_panes=P, pane_batches=pane),
+        ),
+    )
+    with eng:
+        for i, b in enumerate(bs):
+            eng.submit(*b)
+            if (i + 1) % pane == pane - 1 and i >= pane:
+                cur_start = ((i + 1) // pane) * pane
+                win_start = max(0, cur_start - (P - 1) * pane)
+                got = {k: np.asarray(v) for k, v in eng.result().items()}
+                want = _oracle(bs[win_start : i + 1])
+                for k in want:
+                    assert np.array_equal(got[k], want[k]), (i, k)
+
+
+def test_ewma_decay_is_exact_on_dyadic_values():
+    # alpha=0.5 -> decay 0.5: every partial sum stays exactly representable,
+    # so the weighted mean pins bit-exactly against the hand oracle
+    vals = [
+        np.asarray([1.0, 3.0], np.float32),
+        np.asarray([2.0], np.float32),
+        np.asarray([4.0, 4.0, 4.0], np.float32),
+    ]
+    eng = StreamingEngine(
+        MeanMetric(),
+        EngineConfig(buckets=(4,), coalesce=1, window=WindowPolicy.ewma(alpha=0.5, pane_batches=1)),
+    )
+    with eng:
+        for v in vals:
+            eng.submit(v)
+        got = float(eng.result())
+    # rotations after each batch: sum = ((4*.5 + 2)*.5 + 12)*.5 = 7, weight = 2
+    assert got == 3.5
+    assert eng.stats.ewma_decays == 3
+
+
+def test_min_max_states_window_exactly():
+    """Sliding folds min/max states by their own reductions: the window min
+    is the min over live panes (the open pane + the n_panes-1 most recent
+    closed ones), and evicted panes genuinely leave."""
+    from metrics_tpu import MinMetric
+
+    eng = StreamingEngine(
+        MinMetric(),
+        EngineConfig(
+            buckets=(4,), coalesce=1, window=WindowPolicy.sliding(n_panes=3, pane_batches=1)
+        ),
+    )
+    with eng:
+        eng.submit(np.asarray([-5.0], np.float32))
+        eng.submit(np.asarray([2.0], np.float32))
+        eng.submit(np.asarray([7.0], np.float32))  # -5's pane evicted here
+        assert float(eng.result()) == 2.0
+
+
+def test_scan_strategy_metric_windows_via_per_pane_capacity_buffers():
+    """AUROC(capacity=N) — scan strategy, cat-written capacity buffers —
+    windows on a single device: each pane owns its own buffers + cursor, and
+    the sliding fold concatenates the live panes' captured rows."""
+    from metrics_tpu import AUROC
+
+    rng = np.random.RandomState(5)
+    bs = [
+        ((rng.randint(0, 65, size=6) / 64.0).astype(np.float32), (rng.rand(6) > 0.5).astype(np.int32))
+        for _ in range(6)
+    ]
+    eng = StreamingEngine(
+        AUROC(capacity=64),
+        EngineConfig(
+            buckets=(8,), coalesce=1, window=WindowPolicy.sliding(n_panes=2, pane_batches=2)
+        ),
+    )
+    with eng:
+        for b in bs:
+            eng.submit(*b)
+        got = np.asarray(eng.result())
+    # rotations at 2, 4 and 6: the final one opened a fresh pane, so the
+    # live window is that empty open pane + the [4:6) closed pane
+    ref = StreamingEngine(AUROC(capacity=64), EngineConfig(buckets=(8,)))
+    with ref:
+        for b in bs[4:6]:
+            ref.submit(*b)
+        want = np.asarray(ref.result())
+    assert np.array_equal(got, want)
+
+
+# ----------------------------------------------------------- compile budget
+
+
+def test_rotation_is_compile_free_in_the_steady_state():
+    """THE acceptance pin: after the ring has rotated once, further
+    rotations produce an AOT cache miss-counter delta of exactly zero."""
+    bs = _batches(16, seed=1)
+    eng = StreamingEngine(
+        _col(),
+        EngineConfig(
+            buckets=(8,), coalesce=1, window=WindowPolicy.sliding(n_panes=3, pane_batches=2)
+        ),
+    )
+    with eng:
+        for b in bs[:3]:
+            eng.submit(*b)
+        eng.result()  # one rotation behind us; fold + rotate compiled
+        warm = eng.aot_cache.misses
+        rot = eng.rotations
+        for b in bs[3:]:
+            eng.submit(*b)
+        eng.result()
+        assert eng.rotations - rot >= 3
+        assert eng.aot_cache.misses == warm  # zero across all later rotations
+
+
+def test_pane_cursor_is_a_runtime_argument_not_a_trace_constant():
+    """Two engines at different cursors share the same program memo keys —
+    the pane index travels as a 0-d payload leaf, never in the signature."""
+    eng = StreamingEngine(
+        Accuracy(),
+        EngineConfig(buckets=(8,), coalesce=1, window=WindowPolicy.tumbling(pane_batches=1, n_panes=3)),
+    )
+    bs = _batches(4, seed=2)
+    with eng:
+        eng.submit(*bs[0])
+        eng.flush()
+        keys0 = set(eng._program_memo)
+        for b in bs[1:]:
+            eng.submit(*b)
+        eng.flush()
+        assert eng.pane_cursor != 0
+        assert set(eng._program_memo) == keys0
+
+
+# ------------------------------------------------------- snapshot provenance
+
+
+def test_mid_ring_kill_resume_replays_exactly():
+    bs = _batches(12, seed=4)
+    snap = tempfile.mkdtemp()
+    cfg = dict(
+        buckets=(8,), coalesce=1, window=WindowPolicy.sliding(n_panes=3, pane_batches=3)
+    )
+    a = StreamingEngine(_col(), EngineConfig(snapshot_every=5, snapshot_dir=snap, **cfg))
+    with a:
+        for b in bs:
+            a.submit(*b)
+        want = {k: np.asarray(v) for k, v in a.result().items()}
+    b_eng = StreamingEngine(_col(), EngineConfig(snapshot_dir=snap, **cfg))
+    meta = b_eng.restore()
+    assert meta["window"] == "sliding:p3:b3"
+    assert int(meta["batches_done"]) % 3 != 0  # genuinely mid-pane
+    with b_eng:
+        for b in bs[int(meta["batches_done"]) :]:
+            b_eng.submit(*b)
+        got = {k: np.asarray(v) for k, v in b_eng.result().items()}
+    for k in want:
+        assert np.array_equal(got[k], want[k])
+
+
+def test_cross_policy_restore_refuses_loudly():
+    bs = _batches(6, seed=5)
+    snap = tempfile.mkdtemp()
+    a = StreamingEngine(
+        _col(),
+        EngineConfig(
+            buckets=(8,), window=WindowPolicy.sliding(n_panes=2, pane_batches=2),
+            snapshot_dir=snap,
+        ),
+    )
+    with a:
+        for b in bs:
+            a.submit(*b)
+        a.snapshot()
+    # different policy refuses
+    other = StreamingEngine(
+        _col(),
+        EngineConfig(
+            buckets=(8,), window=WindowPolicy.tumbling(pane_batches=2), snapshot_dir=snap
+        ),
+    )
+    with pytest.raises(MetricsTPUUserError, match="window policy"):
+        other.restore()
+    # cumulative engine refuses a windowed snapshot (and names both sides)
+    plain = StreamingEngine(_col(), EngineConfig(buckets=(8,), snapshot_dir=snap))
+    with pytest.raises(MetricsTPUUserError, match="cumulative"):
+        plain.restore()
+    # and a windowed engine refuses a cumulative snapshot
+    snap2 = tempfile.mkdtemp()
+    p2 = StreamingEngine(_col(), EngineConfig(buckets=(8,), snapshot_dir=snap2))
+    with p2:
+        for b in bs:
+            p2.submit(*b)
+        p2.snapshot()
+    w2 = StreamingEngine(
+        _col(),
+        EngineConfig(
+            buckets=(8,), window=WindowPolicy.sliding(n_panes=2, pane_batches=2),
+            snapshot_dir=snap2,
+        ),
+    )
+    with pytest.raises(MetricsTPUUserError, match="window policy"):
+        w2.restore()
+
+
+def test_windowed_reshard_crosses_worlds_mid_ring():
+    """Live elastic resharding composes: a deferred windowed engine shrinks
+    its world MID-RING through the restore matrix (pane axis preserved by
+    the world merge) and keeps serving bit-exactly."""
+    import jax
+    from jax.sharding import Mesh
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices (virtual CPU mesh)")
+    mesh = Mesh(np.asarray(jax.devices()[:2]), ("dp",))
+    win = WindowPolicy.sliding(n_panes=3, pane_batches=2)
+    bs = _batches(6, seed=8)
+    eng = StreamingEngine(
+        _col(),
+        EngineConfig(
+            buckets=(8,), coalesce=1, mesh=mesh, axis="dp", mesh_sync="deferred",
+            window=win,
+        ),
+    )
+    with eng:
+        for b in bs[:3]:
+            eng.submit(*b)
+        eng.flush()
+        info = eng.reshard(world=1)
+        for b in bs[3:]:
+            eng.submit(*b)
+        got = {k: np.asarray(v) for k, v in eng.result().items()}
+    assert info == {"from_world": 2, "to_world": 1, "cursor": 3}
+    ref = StreamingEngine(_col(), EngineConfig(buckets=(8,), coalesce=1, window=win))
+    with ref:
+        for b in bs:
+            ref.submit(*b)
+        want = {k: np.asarray(v) for k, v in ref.result().items()}
+    for k in want:
+        assert np.array_equal(got[k], want[k]), k
+
+
+def test_compressed_windowed_snapshot_round_trips():
+    """compress_payloads x windows: the codec wraps the pane-stacked logical
+    tree; restore decodes and re-packs the ring (deferred carried form has
+    TWO leading stack axes — the lead=2 pack path)."""
+    import math
+
+    import jax
+    from jax.sharding import Mesh
+
+    from metrics_tpu import MeanSquaredError
+
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("dp",))
+    snap = tempfile.mkdtemp()
+    win = WindowPolicy.sliding(n_panes=2, pane_batches=2)
+
+    def make():
+        return StreamingEngine(
+            MeanSquaredError().set_sync_precision("q8_block"),
+            EngineConfig(
+                buckets=(8,), coalesce=1, mesh=mesh, axis="dp", mesh_sync="deferred",
+                window=win, snapshot_dir=snap, compress_payloads=True,
+            ),
+        )
+
+    rng = np.random.RandomState(0)
+    bs = [
+        (
+            (rng.randint(0, 65, size=5) / 64.0).astype(np.float32),
+            (rng.rand(5) > 0.5).astype(np.float32),
+        )
+        for _ in range(5)
+    ]
+    a = make()
+    with a:
+        for b in bs:
+            a.submit(*b)
+        want = float(a.result())
+        a.snapshot()
+    b_eng = make()
+    meta = b_eng.restore()
+    assert meta["window"] == win.fingerprint()
+    assert math.isclose(float(b_eng.result()), want, rel_tol=1e-2)
+
+
+# -------------------------------------------------------- window x stream
+
+
+def test_multistream_windowed_results_match_per_stream_oracles():
+    from metrics_tpu.engine.traffic import zipf_traffic
+
+    S = 6
+    traffic = zipf_traffic(S, 30, seed=9, max_rows=6)
+    eng = MultiStreamEngine(
+        Accuracy(), S,
+        EngineConfig(
+            buckets=(8,), coalesce=1, window=WindowPolicy.sliding(n_panes=2, pane_batches=10)
+        ),
+    )
+    with eng:
+        for sid, p, t in traffic:
+            eng.submit(sid, p, t)
+        got = {sid: np.asarray(v) for sid, v in eng.results().items()}
+    window = traffic[10:30]  # rotations at 10,20,30 -> live: empty + [20:30]...
+    window = traffic[20:30]
+    for sid in sorted({b[0] for b in window}):
+        ref = StreamingEngine(Accuracy(), EngineConfig(buckets=(8,)))
+        with ref:
+            for bsid, p, t in window:
+                if bsid == sid:
+                    ref.submit(p, t)
+            want = np.asarray(ref.result())
+        assert np.array_equal(got[sid], want), sid
+        assert np.array_equal(np.asarray(eng.result(sid)), want), sid
+
+
+def test_reset_stream_clears_every_live_pane():
+    eng = MultiStreamEngine(
+        Accuracy(), 2,
+        EngineConfig(
+            buckets=(8,), coalesce=1, window=WindowPolicy.sliding(n_panes=2, pane_batches=1)
+        ),
+    )
+    p = np.asarray([0.9, 0.9], np.float32)
+    t = np.asarray([1, 1], np.int32)
+    wrong = np.asarray([0, 0], np.int32)
+    with eng:
+        eng.submit(0, p, wrong)  # pane rotates after this batch
+        eng.submit(0, p, t)
+        eng.submit(1, p, wrong)
+        eng.flush()
+        eng.reset_stream(0)
+        eng.submit(0, p, t)
+        assert float(eng.result(0)) == 1.0  # no pane kept the wrong-label rows
+        assert float(eng.result(1)) == 0.0  # the other stream kept its panes
+
+
+# ----------------------------------------------------------- observability
+
+
+def test_windows_block_and_openmetrics_parse_both_directions(tmp_path):
+    import json
+    import sys
+
+    sys.path.insert(0, "tools")
+    import engine_report
+    import trace_export
+
+    eng = StreamingEngine(
+        MeanMetric(),
+        EngineConfig(
+            buckets=(4,), coalesce=1, window=WindowPolicy.ewma(alpha=0.5, pane_batches=1)
+        ),
+    )
+    with eng:
+        for v in ([1.0, 2.0], [3.0], [4.0, 0.5]):
+            eng.submit(np.asarray(v, np.float32))
+        eng.result()
+    # OpenMetrics: strict parser accepts, families present with exact counts
+    families = trace_export.parse_openmetrics(eng.metrics_text())
+    fam = {k: v for k, v in families.items() if "pane" in k or "ewma" in k or "drift" in k}
+    assert "metrics_tpu_engine_pane_rotations" in fam
+    rot = next(
+        s for s in fam["metrics_tpu_engine_pane_rotations"]["samples"]
+        if s["name"].endswith("_total")
+    )
+    assert rot["value"] == eng.stats.pane_rotations == 3
+    assert "metrics_tpu_engine_live_panes" in families
+    # telemetry JSON -> engine_report renders the windows block
+    path = tmp_path / "telemetry.json"
+    eng.export_telemetry(str(path))
+    doc = json.loads(path.read_text())
+    assert doc["summary"]["windows"]["policy"] == "ewma:a0.5:b1"
+    assert doc["summary"]["windows"]["ewma_decays"] == 3
+    rendered = engine_report.render(doc)
+    assert "windows" in rendered and "ewma decays" in rendered
+
+
+def test_cumulative_surfaces_stay_byte_free_of_window_families():
+    eng = StreamingEngine(Accuracy(), EngineConfig(buckets=(8,)))
+    with eng:
+        eng.submit(np.asarray([0.9], np.float32), np.asarray([1], np.int32))
+        eng.result()
+    assert "pane" not in eng.metrics_text()
+    assert "windows" not in eng.telemetry()
+
+
+def test_pane_seconds_rotates_via_the_injectable_clock():
+    clock = {"t": 0.0}
+    win = WindowPolicy.tumbling(pane_seconds=10.0, clock=lambda: clock["t"])
+    eng = StreamingEngine(Accuracy(), EngineConfig(buckets=(8,), coalesce=1, window=win))
+    p = np.asarray([0.9, 0.2], np.float32)
+    t = np.asarray([1, 0], np.int32)
+    with eng:
+        eng.submit(p, t)
+        eng.flush()
+        assert eng.rotations == 0
+        clock["t"] = 25.0  # two panes elapsed: both rotations fire at the
+        eng.submit(p, t)   # next batch boundary, catching up pane by pane
+        eng.flush()
+        assert eng.rotations == 2
+        got = float(eng.result())
+    assert got == 1.0  # only the post-rotation batch is in the open pane

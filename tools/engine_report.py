@@ -20,7 +20,9 @@ write-failure/restore-fallback counts. Engines running the ISSUE 11
 self-defense layer additionally render the admission block (admitted/
 rejected/shed by priority class, degradation-ladder level + transitions,
 deferred stale reads) and the elastic-reshard row (count + the last
-world→world transition and its replay cursor).
+world→world transition and its replay cursor). Windowed engines (ISSUE 13)
+render the windows block: policy tag, pane rotations, live panes + ring
+cursor, ewma decays applied, and the drift-tracker row (pane evals, alarms).
 When the engine ran with a flight recorder (``EngineConfig(trace=...)``,
 PR 8) the document carries a ``trace`` section and the report renders the
 trace/SLO block: spans recorded/dropped, latency histogram counts, and the
@@ -151,6 +153,30 @@ def render(doc: dict, steps: int = 10) -> str:
                 f"{_fmt(admission.get('deferred_reads'))} deferred reads",
             )
         )
+    windows = s.get("windows")
+    if windows:
+        drift = windows.get("drift") or {}
+        rows.append(
+            (
+                "windows",
+                f"{windows.get('policy')} · {_fmt(windows.get('pane_rotations'))} rotations"
+                f" · {_fmt(windows.get('live_panes'))} live panes"
+                f" (cursor {_fmt(windows.get('pane_cursor'))})"
+                + (
+                    f" · {_fmt(windows.get('ewma_decays'))} ewma decays"
+                    if windows.get("ewma_decays")
+                    else ""
+                ),
+            )
+        )
+        if drift:
+            rows.append(
+                (
+                    "drift",
+                    f"{_fmt(drift.get('evals'))} pane evals · "
+                    f"{_fmt(drift.get('alarms'))} alarms",
+                )
+            )
     reshard = s.get("reshard")
     if reshard:
         last = reshard.get("last") or {}
