@@ -6,7 +6,7 @@ lowerings, honored donations, fingerprint-covered trace constants, fused
 arena packs, the closed program set — that used to be pinned ad hoc, one
 regex or jaxpr walk per test file. This package makes each of them a named,
 reusable rule with structured findings (rule id, severity, eqn/op path, fix
-hint), evaluated by two planes:
+hint), evaluated by three planes:
 
 * **Program plane** (:mod:`~metrics_tpu.analysis.program` +
   :mod:`~metrics_tpu.analysis.rules`): walk traced jaxprs (recursing into
@@ -17,12 +17,23 @@ hint), evaluated by two planes:
   ``metrics_tpu/`` for the known trace-hazard classes — Python branches on
   traced values, closure-identity trace-cache reuse, lock discipline in the
   engine, tuple-message raises, wall-clock/RNG in jitted builders.
+* **Concurrency plane** (:mod:`~metrics_tpu.analysis.concurrency` +
+  :mod:`~metrics_tpu.analysis.rules.locks`): per-class lock declarations
+  (which attributes each engine lock guards, which methods run lock-held,
+  whether dispatch is legal under a hold) checked package-wide by four
+  rules — lockset, lock-order (may-acquire-under cycles + forbidden
+  nestings), no-dispatch-under-lock, check-then-act.
 
 One CLI drives both as the CI gate: ``python tools/analyze.py`` (wired as
 ``make analyze``), with ``# analysis: disable=rule -- reason`` suppressions
 and a committed baseline that starts green and ratchets. Rule catalog:
 ``docs/analysis.md``.
 """
+from metrics_tpu.analysis.concurrency import (
+    FORBIDDEN_NESTINGS,
+    check_concurrency_sources,
+    check_concurrency_tree,
+)
 from metrics_tpu.analysis.core import Baseline, Finding, Report
 from metrics_tpu.analysis.program import (
     EngineAnalysis,
@@ -33,6 +44,7 @@ from metrics_tpu.analysis.program import (
 )
 from metrics_tpu.analysis.rules import (
     COLLECTIVE_PRIMITIVES,
+    CONCURRENCY_SPECS,
     RULES,
     RuleInfo,
     check_arena_pack_fused,
@@ -54,12 +66,16 @@ from metrics_tpu.analysis.source import check_source_text, check_source_tree
 __all__ = [
     "Baseline",
     "COLLECTIVE_PRIMITIVES",
+    "CONCURRENCY_SPECS",
     "EngineAnalysis",
+    "FORBIDDEN_NESTINGS",
     "Finding",
     "Report",
     "RULES",
     "RuleInfo",
     "check_arena_pack_fused",
+    "check_concurrency_sources",
+    "check_concurrency_tree",
     "check_collective_multiset",
     "check_compile_cap",
     "check_donation_honored",

@@ -1,0 +1,497 @@
+"""Concurrency plane: the static contract checker over the threaded engine.
+
+Every review pass since PR 6 has hand-found real concurrency bugs in the
+serving engine — a bare ``+=`` losing admission increments across producer
+threads, a histogram lock held across a jax fold stalling every submit,
+TOCTOU in ``stop()``, ladder rungs stranded half-engaged. This plane pins
+the bug CLASS structurally: the per-class lock declarations in
+:mod:`metrics_tpu.analysis.rules.locks` (which attributes each lock guards,
+which methods run lock-held, whether dispatch is legal under a hold) are
+compiled into per-method summaries, and four rules run over the whole
+package:
+
+* ``concurrency-lockset`` — every mutation of a declared-guarded attribute
+  happens with its lock statically held (intraprocedural ``with``-stack walk
+  + call-graph closure over ``*_locked``/declared lock-held methods; the
+  PR 7 ``lock-discipline`` rule id survives as an alias for the original
+  state-lock guarded set). Also checks calls into externally-locked
+  bookkeeping classes (``StreamPager``, ``TokenBucket``): a mutating method
+  of a class whose contract says "caller holds the lock" must only be
+  called with that lock held.
+* ``concurrency-lock-order`` — the may-acquire-under graph across all
+  declared locks must be acyclic (reentrant self-acquisition is legal only
+  for declared RLocks), and declared forbidden pairs must never nest in
+  EITHER direction — the "recorder and histogram locks never nest"
+  invariant from PR 8 is :data:`FORBIDDEN_NESTINGS`' first entry.
+* ``concurrency-dispatch-under-lock`` — no jax dispatch (``jnp.*``,
+  compiled-executable calls, ``device_get``/``device_put``/
+  ``block_until_ready``, ``histogram_accumulate`` host folds) reachable
+  while a ``dispatch_ok=False`` lock is held — the exact stall class PR 8's
+  review fixed by hand (the fold now swaps the pending buffer out under the
+  lock and folds after releasing it).
+* ``concurrency-check-then-act`` — a guarded read in one lock region whose
+  result steers a branch that re-acquires the lock to write the same
+  attribute (the ``stop()`` TOCTOU shape): between release and re-acquire
+  the world may have changed.
+
+Suppression works exactly like the source plane: ``# analysis:
+disable=rule-id -- reason`` on (or directly above) the offending line, the
+reason mandatory. Findings carry repo-relative ``file:line`` locations and
+ride the same baseline ratchet (``tools/analyze.py``).
+"""
+import os
+from typing import Dict, Iterable, List, Mapping, Optional, Sequence, Set, Tuple
+
+from metrics_tpu.analysis.core import (
+    Finding,
+    Report,
+    filter_suppressed,
+    parse_suppressions,
+)
+from metrics_tpu.analysis.rules.locks import (
+    CONCURRENCY_SPECS,
+    ClassDecl,
+    ClassModel,
+    LockDecl,
+    build_class_models,
+    lockset_findings,
+)
+
+__all__ = [
+    "FORBIDDEN_NESTINGS",
+    "check_concurrency_sources",
+    "check_concurrency_tree",
+    "lock_order_edges",
+]
+
+#: lock pairs that must never nest in EITHER direction. The first entry is
+#: the PR 8 invariant stated in ``engine/trace.py``: a producer's submit
+#: needs the recorder lock (new_trace/_append), a scrape holds the histogram
+#: lock across buffer swaps — nesting them in any order puts a fold's jax
+#: dispatch (or a full ring walk) on the submit path.
+FORBIDDEN_NESTINGS: Tuple[Tuple[str, str], ...] = (
+    ("TraceRecorder._lock", "FixedBucketHistogram._lock"),
+)
+
+
+def _lock_registry(
+    specs: Mapping[str, Sequence[ClassDecl]]
+) -> Dict[str, LockDecl]:
+    out: Dict[str, LockDecl] = {}
+    for decls in specs.values():
+        for decl in decls:
+            for lock in decl.locks:
+                out.setdefault(lock.lock_id, lock)
+    return out
+
+
+# ------------------------------------------------------------------ lock-order
+
+
+def lock_order_edges(
+    classes: Mapping[str, ClassModel],
+) -> Dict[Tuple[str, str], Tuple[str, str]]:
+    """The may-acquire-under graph: ``(held, acquired) -> (where, via)``.
+
+    Direct edges come from acquisitions with a non-empty held set; transitive
+    edges propagate each call site's held set onto every lock the callee
+    (transitively) acquires — the cross-class closure that sees
+    ``_ladder_tick``'s hold reach the histogram locks through
+    ``tr.histograms()`` / ``h.quantile()``.
+    """
+    # transitive acquire sets per (class, method), fixpoint over the call graph
+    acquires: Dict[Tuple[str, str], Set[str]] = {}
+    for cname, cls in classes.items():
+        for m, s in cls.methods.items():
+            acquires[(cname, m)] = {a.lock_id for a in s.acquisitions}
+    changed = True
+    while changed:
+        changed = False
+        for cname, cls in classes.items():
+            for m, s in cls.methods.items():
+                cur = acquires[(cname, m)]
+                for call in s.calls:
+                    sub = acquires.get((call.cls_name, call.method))
+                    if sub and not sub <= cur:
+                        cur |= sub
+                        changed = True
+    edges: Dict[Tuple[str, str], Tuple[str, str]] = {}
+    for cname, cls in classes.items():
+        for m, s in cls.methods.items():
+            where_base = f"{cls.filename}"
+            for acq in s.acquisitions:
+                for held in acq.held_before:
+                    edges.setdefault(
+                        (held, acq.lock_id),
+                        (f"{where_base}:{acq.lineno}", f"{cname}.{m}"),
+                    )
+            for call in s.calls:
+                sub = acquires.get((call.cls_name, call.method), set())
+                for held in call.held:
+                    # held == acquired included: a TRANSITIVE re-acquisition
+                    # of a non-reentrant lock (public helper callable both
+                    # locked and unlocked) is a guaranteed self-deadlock the
+                    # reentrancy check below must see
+                    for acquired in sub:
+                        edges.setdefault(
+                            (held, acquired),
+                            (
+                                f"{where_base}:{call.lineno}",
+                                f"{cname}.{m} -> {call.cls_name}.{call.method}",
+                            ),
+                        )
+            # self-acquisition while already held (reentrancy check)
+            for acq in s.acquisitions:
+                if acq.lock_id in acq.held_before:
+                    edges.setdefault(
+                        (acq.lock_id, acq.lock_id),
+                        (f"{where_base}:{acq.lineno}", f"{cname}.{m}"),
+                    )
+    return edges
+
+
+def _find_cycle(edges: Iterable[Tuple[str, str]]) -> Optional[List[str]]:
+    graph: Dict[str, List[str]] = {}
+    for a, b in edges:
+        if a != b:
+            graph.setdefault(a, []).append(b)
+    WHITE, GREY, BLACK = 0, 1, 2
+    color: Dict[str, int] = {}
+    stack: List[str] = []
+
+    def visit(node: str) -> Optional[List[str]]:
+        color[node] = GREY
+        stack.append(node)
+        for nxt in graph.get(node, ()):
+            c = color.get(nxt, WHITE)
+            if c == GREY:
+                return stack[stack.index(nxt):] + [nxt]
+            if c == WHITE:
+                cyc = visit(nxt)
+                if cyc is not None:
+                    return cyc
+        stack.pop()
+        color[node] = BLACK
+        return None
+
+    for node in sorted(graph):
+        if color.get(node, WHITE) == WHITE:
+            cyc = visit(node)
+            if cyc is not None:
+                return cyc
+    return None
+
+
+def _rule_lock_order(
+    classes: Mapping[str, ClassModel],
+    locks: Mapping[str, LockDecl],
+    forbidden: Tuple[Tuple[str, str], ...],
+) -> List[Finding]:
+    findings: List[Finding] = []
+    edges = lock_order_edges(classes)
+    # reentrancy: a self-edge is legal only for declared RLocks
+    for (a, b), (where, via) in sorted(edges.items()):
+        if a == b:
+            decl = locks.get(a)
+            if decl is not None and not decl.reentrant:
+                findings.append(Finding(
+                    rule="concurrency-lock-order", severity="error",
+                    where=where,
+                    message=(
+                        f"{a} re-acquired while already held (via {via}) but is "
+                        "not declared reentrant — a plain threading.Lock "
+                        "self-deadlocks here"
+                    ),
+                    hint=(
+                        "make it an RLock and declare reentrant=True in "
+                        "analysis/rules/locks.py, or restructure so the inner "
+                        "acquisition happens after release"
+                    ),
+                ))
+    for pair in forbidden:
+        for a, b in (pair, pair[::-1]):
+            hit = edges.get((a, b))
+            if hit is not None:
+                where, via = hit
+                findings.append(Finding(
+                    rule="concurrency-lock-order", severity="error",
+                    where=where,
+                    message=(
+                        f"{b} acquired while {a} is held (via {via}) — this "
+                        "pair is declared never-nesting"
+                    ),
+                    hint=(
+                        "the PR 8 contract: recorder and histogram locks never "
+                        "nest, so a scrape's fold can never block a producer's "
+                        "submit — release the outer lock first (swap the data "
+                        "out under it, work after)"
+                    ),
+                ))
+    cycle = _find_cycle(edges.keys())
+    if cycle is not None:
+        legs = [
+            f"{a} -> {b} (at {edges[(a, b)][0]} via {edges[(a, b)][1]})"
+            for a, b in zip(cycle, cycle[1:])
+        ]
+        findings.append(Finding(
+            rule="concurrency-lock-order", severity="error",
+            where=edges[(cycle[0], cycle[1])][0],
+            message=(
+                "lock-order cycle: two threads taking these locks in opposite "
+                "orders deadlock — " + "; ".join(legs)
+            ),
+            hint=(
+                "pick ONE global order for the locks in the cycle and "
+                "restructure the odd acquisition out (the engine's standing "
+                "order: ladder lock > state lock > leaf subsystem locks)"
+            ),
+        ))
+    return findings
+
+
+# -------------------------------------------------------- dispatch-under-lock
+
+
+def _rule_dispatch_under_lock(
+    classes: Mapping[str, ClassModel],
+    locks: Mapping[str, LockDecl],
+) -> List[Finding]:
+    no_dispatch = {lid for lid, d in locks.items() if not d.dispatch_ok}
+    # transitive "does this method dispatch?" with a sample label, fixpoint
+    dispatches: Dict[Tuple[str, str], Optional[str]] = {}
+    for cname, cls in classes.items():
+        for m, s in cls.methods.items():
+            dispatches[(cname, m)] = s.dispatch[0].label if s.dispatch else None
+    changed = True
+    while changed:
+        changed = False
+        for cname, cls in classes.items():
+            for m, s in cls.methods.items():
+                if dispatches[(cname, m)] is not None:
+                    continue
+                for call in s.calls:
+                    sub = dispatches.get((call.cls_name, call.method))
+                    if sub is not None:
+                        dispatches[(cname, m)] = (
+                            f"{call.cls_name}.{call.method} -> {sub}"
+                        )
+                        changed = True
+                        break
+    findings: List[Finding] = []
+    for cname, cls in classes.items():
+        for m, s in cls.methods.items():
+            for d in s.dispatch:
+                bad = sorted(d.held & no_dispatch)
+                if bad:
+                    findings.append(_dispatch_finding(
+                        cls, m, d.lineno, d.label, bad
+                    ))
+            for call in s.calls:
+                bad = sorted(call.held & no_dispatch)
+                if not bad:
+                    continue
+                sub = dispatches.get((call.cls_name, call.method))
+                if sub is not None:
+                    findings.append(_dispatch_finding(
+                        cls, m, call.lineno,
+                        f"{call.cls_name}.{call.method} -> {sub}", bad,
+                    ))
+    findings.sort(key=lambda f: f.where)
+    return findings
+
+
+def _dispatch_finding(
+    cls: ClassModel, method: str, lineno: int, label: str, held: List[str]
+) -> Finding:
+    return Finding(
+        rule="concurrency-dispatch-under-lock", severity="error",
+        where=f"{cls.filename}:{lineno}",
+        message=(
+            f"jax dispatch {label} reachable while {', '.join(held)} is held "
+            f"(in {cls.decl.name}.{method}) — a hot-path lock held across a "
+            "device dispatch stalls every thread that needs it"
+        ),
+        hint=(
+            "swap the data out under the lock and dispatch AFTER releasing it "
+            "(the FixedBucketHistogram.flush pattern), or — if this lock is "
+            "meant to serialize device work — declare dispatch_ok=True in "
+            "analysis/rules/locks.py with a comment saying why"
+        ),
+    )
+
+
+# ------------------------------------------------------------- check-then-act
+
+
+def _rule_check_then_act(classes: Mapping[str, ClassModel]) -> List[Finding]:
+    findings: List[Finding] = []
+    for cname, cls in classes.items():
+        for m, s in cls.methods.items():
+            regions = sorted(s.regions, key=lambda r: r.order)
+            for i, first in enumerate(regions):
+                if not first.reads or not first.binds:
+                    continue
+                for second in regions[i + 1:]:
+                    if second.lock_id != first.lock_id:
+                        continue
+                    overlap = sorted(first.reads & second.writes)
+                    if not overlap:
+                        continue
+                    # the released-window dependency: a branch BETWEEN the
+                    # two holds steers on a name bound under the first (a
+                    # branch after the second hold steers nothing it wrote)
+                    steering = [
+                        lineno
+                        for lineno, names in s.branch_uses
+                        if first.lineno <= lineno < second.lineno
+                        and names & first.binds
+                    ]
+                    if not steering:
+                        continue
+                    findings.append(Finding(
+                        rule="concurrency-check-then-act", severity="warning",
+                        where=f"{cls.filename}:{second.lineno}",
+                        message=(
+                            f"check-then-act on {', '.join('self.' + a for a in overlap)}: "
+                            f"read under {first.lock_id} at line {first.lineno}, "
+                            f"lock released, branch at line {steering[0]} steers "
+                            "on the stale value, then the lock is re-acquired to "
+                            f"write it (in {cls.decl.name}.{m})"
+                        ),
+                        hint=(
+                            "between release and re-acquire another thread may "
+                            "have changed the attribute — widen the hold over "
+                            "the whole read-decide-write, or re-validate after "
+                            "re-acquiring (the stop() TOCTOU shape, fixed in "
+                            "PR 11 by re-checking liveness inside the loop)"
+                        ),
+                    ))
+    findings.sort(key=lambda f: f.where)
+    return findings
+
+
+# ----------------------------------------- externally-locked call-site checks
+
+
+def _rule_external_callsites(classes: Mapping[str, ClassModel]) -> List[Finding]:
+    """Calls into an ``external_lock`` class's MUTATING methods must hold the
+    declared lock (part of the lockset contract: the class is bookkeeping,
+    the caller owns the serialization)."""
+    # transitively-mutating methods per external-locked class
+    mutating: Dict[Tuple[str, str], bool] = {}
+    for cname, cls in classes.items():
+        if cls.decl.external_lock is None:
+            continue
+        for m, s in cls.methods.items():
+            mutating[(cname, m)] = bool(s.mutations)
+    changed = True
+    while changed:
+        changed = False
+        for (cname, m), flag in list(mutating.items()):
+            if flag:
+                continue
+            for call in classes[cname].methods[m].calls:
+                if mutating.get((call.cls_name, call.method)):
+                    mutating[(cname, m)] = True
+                    changed = True
+                    break
+    findings: List[Finding] = []
+    for cname, cls in classes.items():
+        for m, s in cls.methods.items():
+            for call in s.calls:
+                callee_cls = classes.get(call.cls_name)
+                if callee_cls is None or callee_cls.decl.external_lock is None:
+                    continue
+                if call.cls_name == cname:
+                    continue  # internal calls ride the entry contract
+                lock = callee_cls.decl.external_lock
+                if lock in call.held:
+                    continue
+                if not mutating.get((call.cls_name, call.method)):
+                    continue  # pure reads are the caller's staleness to own
+                findings.append(Finding(
+                    rule="concurrency-lockset", severity="error",
+                    where=f"{cls.filename}:{call.lineno}",
+                    message=(
+                        f"{call.cls_name}.{call.method}() mutates state that "
+                        f"{lock} guards, called without it (in "
+                        f"{cls.decl.name}.{m}) — the class is declared "
+                        "caller-locked bookkeeping"
+                    ),
+                    hint=(
+                        "take the lock around the call, or move the call into "
+                        "a lock-held method (declared in analysis/rules/locks.py)"
+                    ),
+                ))
+    findings.sort(key=lambda f: f.where)
+    return findings
+
+
+# ------------------------------------------------------------------- drivers
+
+
+def check_concurrency_sources(
+    sources: Mapping[str, str],
+    specs: Optional[Mapping[str, Sequence[ClassDecl]]] = None,
+    forbidden: Optional[Tuple[Tuple[str, str], ...]] = None,
+) -> Report:
+    """Run all four rules over ``{filename: source}`` (fixtures and tests
+    inject their own ``specs``/``forbidden``; the package sweep uses the
+    shipped declarations)."""
+    specs = CONCURRENCY_SPECS if specs is None else specs
+    forbidden = FORBIDDEN_NESTINGS if forbidden is None else forbidden
+    classes, findings = build_class_models(sources, specs)
+    locks = _lock_registry(specs)
+    findings = list(findings)
+    findings.extend(lockset_findings(classes))
+    findings.extend(_rule_external_callsites(classes))
+    findings.extend(_rule_lock_order(classes, locks, forbidden))
+    findings.extend(_rule_dispatch_under_lock(classes, locks))
+    findings.extend(_rule_check_then_act(classes))
+    report = Report()
+    report.extend(filter_suppressed(
+        findings, {fn: parse_suppressions(src) for fn, src in sources.items()}
+    ))
+    n_locks = len(locks)
+    n_methods = sum(len(c.methods) for c in classes.values())
+    report.note(
+        f"concurrency plane: {len(sources)} files, {len(classes)} classes, "
+        f"{n_locks} declared locks, {n_methods} methods walked"
+    )
+    return report
+
+
+def check_concurrency_tree(
+    root: str,
+    specs: Optional[Mapping[str, Sequence[ClassDecl]]] = None,
+    package_rel: bool = True,
+) -> Report:
+    """The package sweep: read every declared module under ``root`` (the
+    ``metrics_tpu`` package dir) and run the plane. A declared module that
+    no longer exists is a loud finding — deleting a threaded module must
+    shrink the declarations in the same diff."""
+    specs = CONCURRENCY_SPECS if specs is None else specs
+    root = os.path.abspath(root)
+    rel_base = os.path.dirname(root) if package_rel else root
+    sources: Dict[str, str] = {}
+    missing: List[str] = []
+    for suffix in sorted(specs):
+        path = os.path.join(root, suffix)
+        if not os.path.exists(path):
+            missing.append(suffix)
+            continue
+        rel = os.path.relpath(path, rel_base).replace(os.sep, "/")
+        with open(path, encoding="utf-8") as fh:
+            sources[rel] = fh.read()
+    report = check_concurrency_sources(sources, specs)
+    for suffix in missing:
+        report.extend([Finding(
+            rule="concurrency-decl-unresolved", severity="error",
+            where=f"{suffix}:1",
+            message=(
+                f"declared module {suffix} not found under {root} — the "
+                "concurrency declarations no longer match the tree"
+            ),
+            hint="update CONCURRENCY_SPECS in analysis/rules/locks.py alongside the refactor",
+        )])
+    return report

@@ -31,6 +31,7 @@ __all__ = [
     "Finding",
     "Report",
     "Baseline",
+    "filter_suppressed",
     "parse_suppressions",
     "SUPPRESS_RE",
 ]
@@ -152,6 +153,46 @@ class Baseline:
         for f in findings:
             (old if f.key() in self.entries else new).append(f)
         return new, old
+
+
+def filter_suppressed(
+    findings: Iterable[Finding],
+    suppressions_by_file: Dict[str, Dict[int, Tuple[Tuple[str, ...], str, int]]],
+) -> List[Finding]:
+    """Apply inline suppressions to findings — the ONE implementation of the
+    directive contract, shared by the source and concurrency planes: a
+    reasoned directive silences the named rules on the lines it covers; an
+    unreasoned one suppresses nothing and is itself a finding (reported once
+    per directive). ``suppressions_by_file`` maps the filename part of each
+    finding's ``where`` to that file's :func:`parse_suppressions` table."""
+    kept: List[Finding] = []
+    reasonless_reported: set = set()
+    for f in findings:
+        try:
+            fn, line_s = f.where.rsplit(":", 1)
+            line = int(line_s)
+        except (IndexError, ValueError):
+            kept.append(f)
+            continue
+        entry = suppressions_by_file.get(fn, {}).get(line)
+        if entry is None or f.rule not in entry[0]:
+            kept.append(f)
+            continue
+        rules_listed, reason, directive_line = entry
+        if not reason:
+            kept.append(f)  # an unreasoned directive suppresses nothing
+            if (fn, directive_line) not in reasonless_reported:
+                reasonless_reported.add((fn, directive_line))
+                kept.append(Finding(
+                    rule="suppression-missing-reason", severity="error",
+                    where=f"{fn}:{directive_line}",
+                    message=(
+                        f"`# analysis: disable={','.join(rules_listed)}` has no "
+                        "`-- reason`"
+                    ),
+                    hint="suppressions document debt: say why this occurrence is safe",
+                ))
+    return kept
 
 
 def parse_suppressions(source: str) -> Dict[int, Tuple[Tuple[str, ...], str, int]]:

@@ -29,6 +29,15 @@ from metrics_tpu.analysis.rules.donation import (
     check_donation_honored,
     parse_hlo_aliased_params,
 )
+from metrics_tpu.analysis.rules.locks import (
+    CONCURRENCY_SPECS,
+    ClassDecl,
+    GuardDecl,
+    LockDecl,
+    build_class_models,
+    decls_for_file,
+    lockset_findings,
+)
 from metrics_tpu.analysis.rules.pallas import (
     check_no_scatter_under_pallas,
     check_pallas_call_count,
@@ -40,12 +49,19 @@ from metrics_tpu.analysis.rules.quantized import (
 
 __all__ = [
     "COLLECTIVE_PRIMITIVES",
+    "CONCURRENCY_SPECS",
+    "ClassDecl",
+    "GuardDecl",
+    "LockDecl",
     "RULES",
     "RuleInfo",
+    "build_class_models",
     "check_arena_pack_fused",
     "check_collective_multiset",
     "check_compile_cap",
     "check_donation_honored",
+    "decls_for_file",
+    "lockset_findings",
     "check_no_baked_host_constants",
     "check_no_collectives",
     "check_no_scatter_under_pallas",
@@ -64,7 +80,7 @@ __all__ = [
 @dataclass(frozen=True)
 class RuleInfo:
     id: str
-    plane: str       # "program" | "source"
+    plane: str       # "program" | "source" | "concurrency"
     severity: str
     summary: str
     incident: str = ""  # the historical bug this rule encodes, if any
@@ -148,8 +164,64 @@ RULES: Dict[str, RuleInfo] = {
             "lock-discipline", "source", "error",
             "Declared lock-guarded engine attributes mutate only inside "
             "`with self._state_lock` (or in methods declared lock-held) — the "
-            "dispatcher donates live buffers, so unlocked RMW races tear state.",
+            "dispatcher donates live buffers, so unlocked RMW races tear state. "
+            "Since ISSUE 14 an alias over the concurrency plane's lockset rule "
+            "(one implementation) for the original state-lock guarded set.",
             incident="PR 3: reset_stream vs donating dispatcher RMW race",
+        ),
+        RuleInfo(
+            "concurrency-lockset", "concurrency", "error",
+            "Every mutation of a declared-guarded attribute happens with its "
+            "lock statically held (with-stack walk + call-graph closure over "
+            "*_locked/declared lock-held methods, cross-object writes "
+            "included); mutating methods of caller-locked bookkeeping classes "
+            "(StreamPager, TokenBucket) are only called under the declared lock.",
+            incident=(
+                "ISSUE 14: batches_submitted `+=` on producer threads and "
+                "record_fault's dict bump from the admission site both lost "
+                "increments — the PR 11 admission-counter class, re-found by "
+                "this rule and fixed in the same PR"
+            ),
+        ),
+        RuleInfo(
+            "concurrency-lock-order", "concurrency", "error",
+            "The may-acquire-under graph over all declared locks is acyclic "
+            "(self-acquisition only for declared RLocks), and declared "
+            "forbidden pairs never nest in either direction.",
+            incident=(
+                "PR 8: recorder and histogram locks must never nest — a fold "
+                "under both stalls every producer's submit; now a checked "
+                "property of the whole tree (FORBIDDEN_NESTINGS)"
+            ),
+        ),
+        RuleInfo(
+            "concurrency-dispatch-under-lock", "concurrency", "error",
+            "No jax dispatch (jnp.*, compiled-executable calls, device_get/"
+            "put, block_until_ready, histogram_accumulate folds) reachable "
+            "while a dispatch_ok=False lock is held.",
+            incident=(
+                "PR 8 review: the histogram lock was held across the jax "
+                "fold, blocking observe() — fixed by swapping the pending "
+                "buffer out under the lock and folding after release"
+            ),
+        ),
+        RuleInfo(
+            "concurrency-check-then-act", "concurrency", "warning",
+            "A guarded read whose result steers a branch after the lock is "
+            "released, followed by a re-acquired write of the same attribute "
+            "— between release and re-acquire the world may have changed.",
+            incident=(
+                "PR 11 review: stop() checked dispatcher liveness, released "
+                "the world, then blocked on a put the dead dispatcher would "
+                "never drain (TOCTOU) — fixed by re-checking in the put loop"
+            ),
+        ),
+        RuleInfo(
+            "concurrency-decl-unresolved", "concurrency", "error",
+            "Every declared class, module and lock attribute still exists in "
+            "the source — a refactor that deletes a lock or renames a guarded "
+            "attribute must update the declarations in the same diff, not "
+            "silently shrink the audited surface.",
         ),
         RuleInfo(
             "raise-tuple", "source", "error",

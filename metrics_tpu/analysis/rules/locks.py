@@ -1,0 +1,1151 @@
+"""Lock declarations + the shared lockset walker (the concurrency plane's core).
+
+PR 7's ``lock-discipline`` lint guarded ONE lock in TWO files. Since then the
+threaded surface has grown a lock per subsystem — the flight recorder's ring
+and histogram locks (PR 8), the admission/ladder locks (PR 11), the drift
+detector's series lock (PR 13) — and every review pass has hand-found the
+same bug classes: a bare ``+=`` losing increments across producer threads, a
+histogram lock held across a jax fold stalling submits, TOCTOU in ``stop()``.
+This module turns the ad-hoc comments that documented those disciplines into
+**checkable declarations**:
+
+* :class:`LockDecl` — one lock a class OWNS: its attribute name, a stable
+  cross-file identity (``"StreamingEngine._state_lock"``), whether it is
+  reentrant, whether jax dispatch may run under a hold (the engine's coarse
+  state lock deliberately serializes device work; the recorder/histogram
+  hot-path locks must never hold across a dispatch), and the methods the
+  call graph only ever enters with the lock already held (plus a
+  ``*_locked`` naming convention).
+* :class:`GuardDecl` — which attributes a lock guards. The lock may belong
+  to ANOTHER class (``EngineStats.ladder_transitions`` is guarded by the
+  engine's ladder lock, not by any lock of its own).
+* :class:`ClassDecl` — one class's whole discipline: owned locks, guards,
+  collaborator attribute types (``self._stats`` is an ``EngineStats`` — how
+  the cross-class call graph resolves), or an ``external_lock`` a caller
+  must hold around every method (``StreamPager`` is bookkeeping under the
+  engine's state lock; ``TokenBucket`` under the admission policy's).
+
+:data:`CONCURRENCY_SPECS` declares the discipline of every threaded engine
+module. :func:`build_class_models` compiles source + declarations into
+per-method summaries (mutations, acquisitions, calls, dispatch calls — each
+with the statically-held lock set), and :func:`lockset_findings` runs the
+lockset rule over them: every mutation of a declared-guarded attribute must
+happen with its lock statically held, via an intraprocedural ``with``-stack
+walk plus a call-graph closure over lock-held methods. The other three
+concurrency rules (:mod:`metrics_tpu.analysis.concurrency`) consume the same
+summaries.
+
+Static model (documented limits, shared by all four rules):
+
+* ``with self.<lock>`` scopes a hold exactly; bare ``<lock>.acquire()`` /
+  ``.release()`` calls toggle the hold linearly through the remaining
+  statements of the function (the conditional-acquisition idiom in
+  ``FixedBucketHistogram._flush`` resolves correctly; token-passing a lock
+  between threads does not, and should not pass review either).
+* Nested ``def``/``lambda`` bodies are analyzed AT their lexical position —
+  right for the engine's synchronous retry-closure idiom
+  (``self._retry_transient(lambda: ...)`` runs under the caller's hold),
+  wrong for a closure stashed and run later on another thread (none exist;
+  a new one belongs in ``locked_methods`` or gets a suppression).
+* Lock aliasing is recognized one level deep: ``self._lock = other._lock``
+  (or any assignment whose right side ends in a declared lock attribute)
+  makes the left side an alias of that lock.
+"""
+import ast
+from dataclasses import dataclass, field
+from typing import (
+    Any, Dict, FrozenSet, Iterable, List, Mapping, Optional, Sequence, Set,
+    Tuple,
+)
+
+from metrics_tpu.analysis.core import Finding
+
+__all__ = [
+    "CONCURRENCY_SPECS",
+    "ClassDecl",
+    "ClassModel",
+    "GuardDecl",
+    "LockDecl",
+    "MethodSummary",
+    "build_class_models",
+    "decls_for_file",
+    "dotted_name",
+    "lockset_findings",
+]
+
+
+# --------------------------------------------------------------- declarations
+
+
+@dataclass(frozen=True)
+class LockDecl:
+    """One lock a class owns."""
+
+    attr: str                 # the attribute holding the lock object
+    lock_id: str              # stable cross-file identity ("Class._lock")
+    #: jax dispatch (jnp ops, compiled-executable calls, device_get/put,
+    #: host folds) is legal under a hold. True for coarse serialization
+    #: locks (the engine's state lock SERIALIZES device work by design);
+    #: False for hot-path locks a producer may block on.
+    dispatch_ok: bool = False
+    reentrant: bool = False   # threading.RLock: self-nesting is legal
+    #: methods entered with this lock already held by contract (the caller
+    #: acquires; the lexical analysis cannot see it)
+    locked_methods: FrozenSet[str] = frozenset()
+    #: method-name suffix implying membership in locked_methods ("" = none)
+    locked_suffix: str = ""
+
+
+@dataclass(frozen=True)
+class GuardDecl:
+    """Attributes guarded by a lock (the lock may belong to another class)."""
+
+    lock_id: str
+    guarded: FrozenSet[str]
+    #: emit lockset findings for these attrs under this rule id (the PR 7
+    #: ``lock-discipline`` alias: old suppressions/baselines keep working)
+    rule_id: str = "concurrency-lockset"
+
+
+@dataclass(frozen=True)
+class ClassDecl:
+    """One class's declared concurrency discipline."""
+
+    name: str                                   # class name; "*" = any class
+    locks: Tuple[LockDecl, ...] = ()
+    guards: Tuple[GuardDecl, ...] = ()
+    #: lock_id a CALLER must hold around every method (bookkeeping-only
+    #: classes: StreamPager under the engine's state lock). Every method is
+    #: treated as entered with this lock held, and call sites elsewhere are
+    #: checked for the hold.
+    external_lock: Optional[str] = None
+    exempt_methods: FrozenSet[str] = frozenset({"__init__"})
+    #: the lock attributes are assigned by a BASE class's __init__, not this
+    #: class's own body (MultiStreamEngine inherits the engine locks) — skips
+    #: the lock-attribute existence check
+    inherits_locks: bool = False
+    #: self.<attr> -> class name, for cross-class call/lock resolution
+    collaborators: Mapping[str, str] = field(default_factory=dict)
+    #: "method" -> class name of (the elements of) its return value, for
+    #: locals assigned from collaborator calls (tr.histograms() -> [hist])
+    method_returns: Mapping[str, str] = field(default_factory=dict)
+
+
+_ENGINE_STATE_LOCK = LockDecl(
+    attr="_state_lock",
+    lock_id="StreamingEngine._state_lock",
+    # the state lock SERIALIZES device work by design: steps, boundary
+    # merges, result computes and snapshot encodes all dispatch under it
+    dispatch_ok=True,
+    reentrant=True,  # RLock: _process_group re-enters _save_snapshot
+    locked_methods=frozenset({
+        # lock taken by the caller: _process_group holds it across the whole
+        # group, result()/state()/stream_state() across merges and reads
+        "_do_step", "_recover_step", "_bound_inflight", "_execute_chunk",
+        "_run_padded_step", "_execute_payload", "_execute_routed", "_page_round",
+        "_merged_state", "_latch_host_attrs",
+        "_record_quarantine", "_screen_group",
+        # ISSUE 11: ladder rung application runs under the tick's lock hold;
+        # the topology swap/memo invalidation only run inside _reshard_locked
+        # (itself *_locked by convention) or the rung application
+        "_engage_rung", "_release_rung", "_engage_quantize", "_release_quantize",
+        "_refresh_policy_identity", "_apply_topology", "_apply_topology_state",
+        "_invalidate_topology_memos",
+        # ISSUE 13: pane rotation runs inside _process_group_locked's lock
+        # hold; windowed readers run under result()/results()' lock hold
+        "_plan_rotation", "_commit_rotation", "_record_drift",
+        "_windowed_row_result", "_sharded_results_values",
+        # stream-sharded helpers reached only from locked dispatch/read paths
+        "_refresh_gauges", "_snapshot_state", "_snapshot_doc", "_global_rows_host",
+        "_fetch_row", "_topology_state",
+    }),
+    locked_suffix="_locked",
+)
+
+_ENGINE_LADDER_LOCK = LockDecl(
+    attr="_ladder_lock",
+    lock_id="StreamingEngine._ladder_lock",
+    # the throttled p99 refresh may force a histogram fold under the tick's
+    # hold — deliberate (ticks are per-group, the fold is throttled); the
+    # cost of a producer shed-rejection briefly blocking on it is accepted
+    dispatch_ok=True,
+    locked_methods=frozenset({"_ladder_signals"}),
+)
+
+#: the PR 3/PR 7 guarded set — rule id kept as the `lock-discipline` alias so
+#: existing suppressions, baselines and tests keep working
+_ENGINE_LEGACY_GUARD = GuardDecl(
+    lock_id="StreamingEngine._state_lock",
+    guarded=frozenset({
+        "_state", "_state_version", "_merged_memo", "_inflight",
+        "_step", "_batches_done", "_quarantine",
+    }),
+    rule_id="lock-discipline",
+)
+
+#: fields that predate the declaration convention (ISSUE 11/13 era), now
+#: declared: the pane-ring cursors, the defer-rung read cache, the quantize
+#: rung's saved policy state
+_ENGINE_NEW_GUARD = GuardDecl(
+    lock_id="StreamingEngine._state_lock",
+    guarded=frozenset({
+        "_result_cache", "_defer_cold_reads",
+        "_ladder_saved_window", "_ladder_quantized",
+        "_pane_cursor", "_rotations", "_pane_open_cursor",
+        "_last_rotate_batches", "_last_rotate_time",
+        "_program_memo", "_merged_abs_memo",
+    }),
+)
+
+_ENGINE_LADDER_GUARD = GuardDecl(
+    lock_id="StreamingEngine._ladder_lock",
+    guarded=frozenset({"_ladder_marks", "_ladder_ticks", "_ladder_p99"}),
+)
+
+_ENGINE_COLLABORATORS = {
+    "_stats": "EngineStats",
+    "_trace": "TraceRecorder",
+    "_admission": "AdmissionPolicy",
+    "_ladder": "DegradationLadder",
+    "_drift": "DriftDetector",
+    "_pager": "StreamPager",
+    "_aot": "AotCache",
+}
+
+_ENGINE_RETURNS = {
+    "histograms": "FixedBucketHistogram",  # TraceRecorder.histograms()
+}
+
+
+def _engine_decl(name: str, inherits_locks: bool = False) -> ClassDecl:
+    return ClassDecl(
+        name=name,
+        locks=(_ENGINE_STATE_LOCK, _ENGINE_LADDER_LOCK),
+        guards=(_ENGINE_LEGACY_GUARD, _ENGINE_NEW_GUARD, _ENGINE_LADDER_GUARD),
+        inherits_locks=inherits_locks,
+        collaborators=_ENGINE_COLLABORATORS,
+        method_returns=_ENGINE_RETURNS,
+    )
+
+
+#: path-suffix -> declared disciplines of the classes in that file. This IS
+#: the audited engine module set: `tools/engine_report.py` reports it clean
+#: when `make analyze` found nothing, and deleting a lock (or renaming a
+#: guarded attribute) fails the declaration resolution loudly in
+#: `make analyze` before any smoke can flake.
+CONCURRENCY_SPECS: Dict[str, Tuple[ClassDecl, ...]] = {
+    "engine/pipeline.py": (_engine_decl("StreamingEngine"),),
+    "engine/multistream.py": (_engine_decl("MultiStreamEngine", inherits_locks=True),),
+    "engine/trace.py": (
+        ClassDecl(
+            name="TraceRecorder",
+            locks=(
+                LockDecl(
+                    attr="_lock", lock_id="TraceRecorder._lock",
+                    # producers block on this in submit(): never hold it
+                    # across a dispatch, and never nest the histogram lock
+                    # under it (PR 8's stall fix, pinned by the lock-order
+                    # rule's forbidden pair)
+                    dispatch_ok=False,
+                ),
+            ),
+            guards=(
+                GuardDecl(
+                    lock_id="TraceRecorder._lock",
+                    guarded=frozenset({"_ring", "_dropped", "_n_traces", "_hists"}),
+                ),
+            ),
+            collaborators={"_hists": "FixedBucketHistogram"},
+        ),
+        ClassDecl(
+            name="FixedBucketHistogram",
+            locks=(
+                LockDecl(
+                    attr="_lock", lock_id="FixedBucketHistogram._lock",
+                    # the PR 8 incident this plane exists for: this lock held
+                    # across the jax fold stalled every producer's observe
+                    dispatch_ok=False,
+                ),
+                LockDecl(
+                    attr="_fold_lock", lock_id="FixedBucketHistogram._fold_lock",
+                    # serializes folds; the fold itself runs under it
+                    dispatch_ok=True,
+                    locked_methods=frozenset({"_flush_under_fold_lock"}),
+                ),
+            ),
+            guards=(
+                GuardDecl(
+                    lock_id="FixedBucketHistogram._lock",
+                    guarded=frozenset({"_pending", "_counts", "_sum", "_n"}),
+                ),
+            ),
+        ),
+    ),
+    "engine/admission.py": (
+        ClassDecl(
+            name="AdmissionPolicy",
+            locks=(
+                LockDecl(
+                    attr="_lock", lock_id="AdmissionPolicy._lock",
+                    # every producer's submit crosses this lock: host
+                    # arithmetic only, never a dispatch
+                    dispatch_ok=False,
+                ),
+            ),
+            guards=(
+                GuardDecl(
+                    lock_id="AdmissionPolicy._lock",
+                    guarded=frozenset({
+                        "_buckets", "_shed_floor", "_admitted", "_rejected", "_shed",
+                    }),
+                ),
+            ),
+        ),
+        ClassDecl(
+            # "NOT thread-safe on its own — the owning AdmissionPolicy
+            # serializes access under one lock" (its docstring), declared
+            name="TokenBucket",
+            external_lock="AdmissionPolicy._lock",
+        ),
+        ClassDecl(
+            # ticks come from the dispatcher AND producer shed rejections;
+            # the engine serializes every tick under its ladder lock
+            name="DegradationLadder",
+            external_lock="StreamingEngine._ladder_lock",
+        ),
+        ClassDecl(
+            name="OverloadDetector",
+            external_lock="StreamingEngine._ladder_lock",
+        ),
+    ),
+    "engine/stats.py": (
+        ClassDecl(
+            name="EngineStats",
+            locks=(
+                LockDecl(
+                    attr="_counter_lock", lock_id="EngineStats._counter_lock",
+                    dispatch_ok=False,
+                ),
+            ),
+            guards=(
+                GuardDecl(
+                    # counters bumped from PRODUCER threads concurrently with
+                    # the dispatcher: a bare `+=`/`dict[k] += 1` loses
+                    # increments (the PR 11 incident, now package-checked)
+                    lock_id="EngineStats._counter_lock",
+                    guarded=frozenset({
+                        "admission_admitted", "admission_rejected", "admission_shed",
+                        "retries", "deferred_reads", "batches_submitted",
+                        "faults_injected",
+                    }),
+                ),
+                GuardDecl(
+                    # dispatcher ticks and producer shed-rejection ticks both
+                    # move these — serialized by the ENGINE's ladder lock
+                    lock_id="StreamingEngine._ladder_lock",
+                    guarded=frozenset({"ladder_transitions", "ladder_level"}),
+                ),
+            ),
+        ),
+    ),
+    "engine/paging.py": (
+        ClassDecl(
+            # "BOOKKEEPING ONLY" (its docstring): slot tables, LRU order and
+            # the spill store mutate exclusively under the engine's state
+            # lock — the pager plans, the engine moves bytes and commits
+            name="StreamPager",
+            external_lock="StreamingEngine._state_lock",
+        ),
+    ),
+    "engine/tracker.py": (
+        ClassDecl(
+            name="DriftDetector",
+            locks=(
+                LockDecl(
+                    attr="_lock", lock_id="DriftDetector._lock",
+                    # record() runs on the dispatcher's rotation path while
+                    # readers poll alarms(): short host-only sections
+                    dispatch_ok=False,
+                ),
+            ),
+            guards=(
+                GuardDecl(
+                    lock_id="DriftDetector._lock",
+                    guarded=frozenset({"_series", "_alarms", "evals"}),
+                ),
+            ),
+        ),
+    ),
+    "engine/windows.py": (
+        # WindowPolicy is immutable-after-__post_init__ configuration; no
+        # locks, nothing guarded — declared so the module is in the audited
+        # set (a future mutable field added here must pick a lock or move)
+        ClassDecl(name="WindowPolicy", exempt_methods=frozenset({"__init__", "__post_init__"})),
+    ),
+    "engine/aot.py": (
+        ClassDecl(
+            name="AotCache",
+            locks=(
+                LockDecl(
+                    attr="_lock", lock_id="AotCache._lock",
+                    # the lock deliberately spans build(): two engines racing
+                    # one key pay ONE compile (its docstring contract)
+                    dispatch_ok=True,
+                ),
+            ),
+            guards=(
+                GuardDecl(
+                    lock_id="AotCache._lock",
+                    guarded=frozenset({
+                        "_programs", "hits", "misses", "compile_seconds", "cache_dir",
+                    }),
+                ),
+            ),
+        ),
+    ),
+}
+
+
+# ------------------------------------------------------------- AST utilities
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'jax.jit' for Attribute chains rooted at a bare Name, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+_MUTATOR_METHODS = {
+    "append", "appendleft", "extend", "clear", "pop", "popleft", "remove",
+    "add", "update", "insert", "discard", "setdefault",
+}
+
+#: jax dispatch heads/prefixes the no-dispatch-under-lock rule recognizes
+_DISPATCH_PREFIXES = ("jnp.", "jax.numpy.")
+_DISPATCH_CALLS = {
+    "jax.device_get", "jax.device_put", "jax.block_until_ready",
+    "device_get", "device_put", "block_until_ready",
+    # the library's own host fold (the PR 8 histogram incident)
+    "histogram_accumulate",
+}
+#: calling the RESULT of one of these suffixes is invoking a compiled
+#: executable: self._compute_program()(state) is a device dispatch
+_PROGRAM_SUFFIXES = ("_program", "_callable", "_executable")
+
+
+def _is_dispatch_call(node: ast.Call) -> Optional[str]:
+    """A human-readable label when ``node`` is a jax dispatch, else None."""
+    d = dotted_name(node.func)
+    if d is not None:
+        if d in _DISPATCH_CALLS or any(d.startswith(p) for p in _DISPATCH_PREFIXES):
+            return d
+    if isinstance(node.func, ast.Call):
+        inner = dotted_name(node.func.func)
+        if inner is not None and inner.rsplit(".", 1)[-1].endswith(_PROGRAM_SUFFIXES):
+            return f"{inner}()(...)"
+    return None
+
+
+# ------------------------------------------------------------ method summary
+
+
+@dataclass
+class Mutation:
+    attr: str            # the guarded attribute (on `cls_name`)
+    cls_name: str        # class the attribute belongs to
+    kind: str            # "assigned" | "item-assigned" | "mutated via .x()"
+    lineno: int
+    held: FrozenSet[str]
+
+
+@dataclass
+class Acquisition:
+    lock_id: str
+    held_before: FrozenSet[str]
+    lineno: int
+
+
+@dataclass
+class CallSite:
+    cls_name: str        # resolved class of the receiver
+    method: str
+    lineno: int
+    held: FrozenSet[str]
+
+
+@dataclass
+class DispatchCall:
+    label: str
+    lineno: int
+    held: FrozenSet[str]
+
+
+@dataclass
+class WithRegion:
+    """One explicit ``with self.<lock>`` region (check-then-act's unit)."""
+
+    lock_id: str
+    lineno: int
+    order: int                     # lexical order within the method
+    reads: Set[str] = field(default_factory=set)    # guarded attrs read
+    writes: Set[str] = field(default_factory=set)   # guarded attrs written
+    binds: Set[str] = field(default_factory=set)    # names assigned inside
+
+
+@dataclass
+class MethodSummary:
+    name: str
+    cls_name: str
+    lineno: int
+    entry_held: FrozenSet[str]
+    mutations: List[Mutation] = field(default_factory=list)
+    acquisitions: List[Acquisition] = field(default_factory=list)
+    calls: List[CallSite] = field(default_factory=list)
+    dispatch: List[DispatchCall] = field(default_factory=list)
+    regions: List[WithRegion] = field(default_factory=list)
+    #: (lineno, names read in the test) of if/while tests OUTSIDE any lock
+    #: region — check-then-act's "decision on a stale value" evidence
+    branch_uses: List[Tuple[int, FrozenSet[str]]] = field(default_factory=list)
+    #: call sites whose receiver could not be resolved (kept for honesty)
+    unresolved_calls: int = 0
+
+
+@dataclass
+class ClassModel:
+    decl: ClassDecl
+    filename: str
+    methods: Dict[str, MethodSummary] = field(default_factory=dict)
+    #: attr (incl. aliases) -> lock_id for locks this class can acquire
+    lock_attrs: Dict[str, str] = field(default_factory=dict)
+    #: guarded attr -> (lock_id, rule_id)
+    guard_map: Dict[str, Tuple[str, str]] = field(default_factory=dict)
+    #: methods assumed lock-held per lock_id (declared + suffix + closure)
+    locked_methods: Dict[str, Set[str]] = field(default_factory=dict)
+
+    def entry_locks(self, method: str) -> FrozenSet[str]:
+        held = {
+            lock_id
+            for lock_id, names in self.locked_methods.items()
+            if method in names
+        }
+        if self.decl.external_lock is not None:
+            held.add(self.decl.external_lock)
+        return frozenset(held)
+
+
+class _MethodWalker:
+    """One method's linear walk: tracks the held-lock set through ``with``
+    scoping and bare acquire()/release() toggles, records mutations /
+    acquisitions / calls / dispatch calls / with-regions. Nested def and
+    lambda bodies are walked at their lexical position (the synchronous
+    retry-closure idiom)."""
+
+    def __init__(self, model: "_ModelBuilder", cls: ClassModel, summary: MethodSummary):
+        self.model = model
+        self.cls = cls
+        self.s = summary
+        self.locals: Dict[str, str] = {}   # local name -> collaborator class
+        self.region_stack: List[WithRegion] = []
+        self.n_regions = 0
+
+    # -- lock resolution -----------------------------------------------------
+
+    def _lock_of(self, expr: ast.AST) -> Optional[str]:
+        attr = _self_attr(expr)
+        if attr is not None:
+            return self.cls.lock_attrs.get(attr)
+        return None
+
+    # -- the walk ------------------------------------------------------------
+
+    def walk_body(self, body: Sequence[ast.stmt], held: Set[str]) -> None:
+        held = set(held)  # acquire()/release() toggles stay block-local-ish
+        for stmt in body:
+            self._walk_stmt(stmt, held)
+
+    def _walk_stmt(self, stmt: ast.stmt, held: Set[str]) -> None:
+        if isinstance(stmt, ast.With):
+            region_locks = []
+            for item in stmt.items:
+                lock = self._lock_of(item.context_expr)
+                if lock is not None:
+                    # earlier items of the SAME with statement are already
+                    # held when a later one acquires (`with self._a, self._b`)
+                    self.s.acquisitions.append(
+                        Acquisition(lock, frozenset(held) | frozenset(region_locks), stmt.lineno)
+                    )
+                    region_locks.append(lock)
+                else:
+                    self._visit_expr(item.context_expr, held)
+            region = None
+            if len(region_locks) == 1 and not self.region_stack:
+                region = WithRegion(region_locks[0], stmt.lineno, self.n_regions)
+                self.n_regions += 1
+                self.region_stack.append(region)
+            inner = set(held) | set(region_locks)
+            for sub in stmt.body:
+                self._walk_stmt(sub, inner)
+            if region is not None:
+                self.region_stack.pop()
+                self.s.regions.append(region)
+            return
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self.walk_body(stmt.body, held)  # lexical-position execution model
+            return
+        if isinstance(stmt, ast.If):
+            if not self.region_stack:
+                names = frozenset(
+                    n.id for n in ast.walk(stmt.test) if isinstance(n, ast.Name)
+                )
+                if names:
+                    self.s.branch_uses.append((stmt.lineno, names))
+            # branches are EXCLUSIVE: each arm walks its own copy of the
+            # held set, so the if-arm's bare acquire() is never mistaken for
+            # a re-acquisition by the elif-arm's (the _flush conditional-
+            # acquisition idiom), while a genuine acquire() under an
+            # enclosing hold keeps its self-edge. Test toggles apply to both
+            # arms (the test runs on every path); after the statement only
+            # locks held on EVERY arm survive — conservative in the safe
+            # direction for the lockset rule.
+            self._visit_expr(stmt.test, held)
+            body_held = set(held)
+            orelse_held = set(held)
+            for sub in stmt.body:
+                self._walk_stmt(sub, body_held)
+            for sub in stmt.orelse:
+                self._walk_stmt(sub, orelse_held)
+            merged = body_held & orelse_held
+            held.clear()
+            held.update(merged)
+            return
+        if isinstance(stmt, ast.While):
+            if not self.region_stack:
+                names = frozenset(
+                    n.id for n in ast.walk(stmt.test) if isinstance(n, ast.Name)
+                )
+                if names:
+                    self.s.branch_uses.append((stmt.lineno, names))
+            self._visit_expr(stmt.test, held)
+            for sub in stmt.body + stmt.orelse:
+                self._walk_stmt(sub, held)
+            return
+        if isinstance(stmt, ast.For):
+            self._visit_expr(stmt.iter, held)
+            self._bind_target(stmt.target, stmt.iter)
+            for sub in stmt.body + stmt.orelse:
+                self._walk_stmt(sub, held)
+            return
+        if isinstance(stmt, ast.Try):
+            for sub in stmt.body:
+                self._walk_stmt(sub, held)
+            for handler in stmt.handlers:
+                for sub in handler.body:
+                    self._walk_stmt(sub, held)
+            for sub in stmt.orelse + stmt.finalbody:
+                self._walk_stmt(sub, held)
+            return
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            self._record_mutation(stmt, held)
+            value = getattr(stmt, "value", None)
+            if value is not None:
+                self._visit_expr(value, held)
+            targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+            for t in targets:
+                self._bind_target(t, value)
+            return
+        if isinstance(stmt, ast.Expr):
+            # bare acquire()/release() toggles (conditional acquisition is
+            # handled in _visit_expr, where the call is seen inside tests)
+            self._visit_expr(stmt.value, held, toggle=held)
+            return
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._visit_expr(child, held)
+            elif isinstance(child, ast.stmt):
+                self._walk_stmt(child, held)
+
+    # -- expression visit ----------------------------------------------------
+
+    def _visit_expr(
+        self, node: ast.AST, held: Set[str], toggle: Optional[Set[str]] = None
+    ) -> None:
+        if isinstance(node, ast.Call):
+            self._visit_call(node, held, toggle)
+            return
+        attr = _self_attr(node)
+        if attr is not None and isinstance(getattr(node, "ctx", None), ast.Load):
+            self._record_read(attr, self.cls)
+        # self.<coll>.<attr> reads
+        if isinstance(node, ast.Attribute):
+            recv = _self_attr(node.value)
+            if recv is not None:
+                coll = self.cls.decl.collaborators.get(recv)
+                target = self.model.classes_by_name.get(coll) if coll else None
+                if target is not None:
+                    self._record_read(node.attr, target)
+        # Lambda is itself an expr, so lambda bodies recurse through this
+        # same loop (statement nodes can never be expression children)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._visit_expr(child, held, toggle)
+
+    def _visit_call(
+        self, node: ast.Call, held: Set[str], toggle: Optional[Set[str]]
+    ) -> None:
+        # acquire()/release() on a declared lock: linear hold toggling.
+        # `self._lock.acquire()` used as an expression (if-test) counts too:
+        # on the paths that continue, the lock is held.
+        if isinstance(node.func, ast.Attribute) and node.func.attr in ("acquire", "release"):
+            lock = self._lock_of(node.func.value)
+            if lock is not None:
+                mutate = toggle if toggle is not None else held
+                if node.func.attr == "acquire":
+                    # held_before keeps the lock itself when already held: a
+                    # bare acquire() under an enclosing hold is the same
+                    # self-deadlock as a nested `with` and must carry its
+                    # self-edge into the reentrancy check (exclusive if/elif
+                    # arms walk separate copies, so the conditional-
+                    # acquisition idiom never fakes one)
+                    self.s.acquisitions.append(
+                        Acquisition(lock, frozenset(held), node.lineno)
+                    )
+                    mutate.add(lock)
+                    held.add(lock)
+                else:
+                    mutate.discard(lock)
+                    held.discard(lock)
+                for a in node.args:
+                    self._visit_expr(a, held)
+                return
+        label = _is_dispatch_call(node)
+        if label is not None:
+            self.s.dispatch.append(DispatchCall(label, node.lineno, frozenset(held)))
+        # method-call resolution: self.m(...), self.<coll>.m(...), local.m(...)
+        if isinstance(node.func, ast.Attribute):
+            recv, meth = node.func.value, node.func.attr
+            target_cls: Optional[str] = None
+            if isinstance(recv, ast.Name) and recv.id == "self":
+                target_cls = self.cls.decl.name
+            else:
+                recv_attr = _self_attr(recv)
+                if recv_attr is not None:
+                    # a method call ON a guarded container is a read of it
+                    # (check-then-act: `self._result_cache.get(sid)` reads)
+                    self._record_read(recv_attr, self.cls)
+                    target_cls = self.cls.decl.collaborators.get(recv_attr)
+                elif isinstance(recv, ast.Name):
+                    target_cls = self.locals.get(recv.id)
+                elif isinstance(recv, ast.Call):
+                    # h = <...>.histograms() style receivers are handled via
+                    # _bind_target; a direct chained call resolves here
+                    inner = dotted_name(recv.func)
+                    if inner is not None:
+                        target_cls = self.cls.decl.method_returns.get(
+                            inner.rsplit(".", 1)[-1]
+                        )
+                elif isinstance(recv, ast.Subscript):
+                    sub_attr = _self_attr(recv.value)
+                    if sub_attr is not None:
+                        target_cls = self.cls.decl.collaborators.get(sub_attr)
+            if target_cls is not None:
+                self.s.calls.append(
+                    CallSite(target_cls, meth, node.lineno, frozenset(held))
+                )
+                # mutator-method calls on guarded containers
+                self._record_container_mutation(node, held)
+            elif meth in _MUTATOR_METHODS:
+                self._record_container_mutation(node, held)
+            else:
+                self.s.unresolved_calls += 1
+        for child in list(node.args) + [kw.value for kw in node.keywords]:
+            self._visit_expr(child, held)
+        if not isinstance(node.func, ast.Attribute):
+            self._visit_expr(node.func, held)
+
+    # -- recording -----------------------------------------------------------
+
+    def _guard_of(self, attr: str, cls: ClassModel) -> Optional[Tuple[str, str]]:
+        return cls.guard_map.get(attr)
+
+    def _record_read(self, attr: str, cls: ClassModel) -> None:
+        g = self._guard_of(attr, cls)
+        if g is not None and self.region_stack and self.region_stack[-1].lock_id == g[0]:
+            self.region_stack[-1].reads.add(attr)
+
+    def _record_write_region(self, attr: str, cls: ClassModel) -> None:
+        g = self._guard_of(attr, cls)
+        if g is not None and self.region_stack and self.region_stack[-1].lock_id == g[0]:
+            self.region_stack[-1].writes.add(attr)
+
+    def _bind_target(self, target: ast.AST, value: Optional[ast.AST]) -> None:
+        names = []
+        if isinstance(target, ast.Name):
+            names = [target.id]
+        elif isinstance(target, ast.Tuple):
+            names = [e.id for e in target.elts if isinstance(e, ast.Name)]
+        for n in names:
+            if self.region_stack:
+                self.region_stack[-1].binds.add(n)
+        # collaborator typing of locals: x = self._stats / h = tr.histograms()
+        if isinstance(target, ast.Name) and value is not None:
+            attr = _self_attr(value)
+            if attr is not None:
+                coll = self.cls.decl.collaborators.get(attr)
+                if coll is not None:
+                    self.locals[target.id] = coll
+                    return
+            if isinstance(value, ast.Call):
+                d = dotted_name(value.func)
+                if d is not None:
+                    ret = self.cls.decl.method_returns.get(d.rsplit(".", 1)[-1])
+                    if ret is not None:
+                        self.locals[target.id] = ret
+            if isinstance(value, ast.Subscript):
+                sub_attr = _self_attr(value.value)
+                if sub_attr is not None:
+                    coll = self.cls.decl.collaborators.get(sub_attr)
+                    if coll is not None:
+                        self.locals[target.id] = coll
+
+    def _guarded_here(self, attr: str) -> bool:
+        # an external_lock class is ALL-guarded: every attribute mutation is
+        # the caller-held lock's business (the class is pure bookkeeping)
+        return attr in self.cls.guard_map or self.cls.decl.external_lock is not None
+
+    def _mutation_target(self, e: ast.AST) -> Optional[Tuple[str, ClassModel, str]]:
+        """(attr, owning class model, kind) for a guarded mutation target."""
+        attr = _self_attr(e)
+        if attr is not None:
+            if self._guarded_here(attr):
+                return attr, self.cls, "assigned"
+            return None
+        if isinstance(e, ast.Subscript):
+            base = e.value
+            attr = _self_attr(base)
+            if attr is not None and self._guarded_here(attr):
+                return attr, self.cls, "item-assigned"
+            # self.<coll>.<attr>[...] =
+            if isinstance(base, ast.Attribute):
+                recv = _self_attr(base.value)
+                if recv is not None:
+                    coll = self.cls.decl.collaborators.get(recv)
+                    target = self.model.classes_by_name.get(coll) if coll else None
+                    if target is not None and base.attr in target.guard_map:
+                        return base.attr, target, "item-assigned"
+            return None
+        # self.<coll>.<attr> =  (cross-object write: the _submit_item bug shape)
+        if isinstance(e, ast.Attribute):
+            recv = _self_attr(e.value)
+            if recv is not None:
+                coll = self.cls.decl.collaborators.get(recv)
+                target = self.model.classes_by_name.get(coll) if coll else None
+                if target is not None and e.attr in target.guard_map:
+                    return e.attr, target, "assigned"
+        return None
+
+    def _record_mutation(self, stmt: ast.stmt, held: Set[str]) -> None:
+        targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+        for t in targets:
+            elts = t.elts if isinstance(t, ast.Tuple) else [t]
+            for e in elts:
+                hit = self._mutation_target(e)
+                if hit is None:
+                    continue
+                attr, cls, kind = hit
+                self.s.mutations.append(
+                    Mutation(attr, cls.decl.name, kind, stmt.lineno, frozenset(held))
+                )
+                self._record_write_region(attr, cls)
+
+    def _record_container_mutation(self, node: ast.Call, held: Set[str]) -> None:
+        if not (isinstance(node.func, ast.Attribute) and node.func.attr in _MUTATOR_METHODS):
+            return
+        hit = self._mutation_target(node.func.value)
+        if hit is None:
+            attr = _self_attr(node.func.value)
+            if attr is not None and attr in self.cls.guard_map:
+                hit = (attr, self.cls, "mutated")
+        if hit is not None:
+            attr, cls, _ = hit
+            self.s.mutations.append(
+                Mutation(
+                    attr, cls.decl.name, f"mutated via .{node.func.attr}()",
+                    node.lineno, frozenset(held),
+                )
+            )
+            self._record_write_region(attr, cls)
+
+
+class _ModelBuilder:
+    def __init__(self) -> None:
+        self.classes_by_name: Dict[str, ClassModel] = {}
+
+    def add_file(
+        self, tree: ast.Module, filename: str, decls: Sequence[ClassDecl]
+    ) -> List[Tuple[ClassModel, ast.ClassDef]]:
+        out: List[Tuple[ClassModel, ast.ClassDef]] = []
+        class_nodes = [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]
+        for decl in decls:
+            nodes = (
+                class_nodes
+                if decl.name == "*"
+                else [n for n in class_nodes if n.name == decl.name]
+            )
+            for node in nodes:
+                cls = ClassModel(decl=decl, filename=filename)
+                cls.lock_attrs = {l.attr: l.lock_id for l in decl.locks}
+                for g in decl.guards:
+                    for a in g.guarded:
+                        cls.guard_map[a] = (g.lock_id, g.rule_id)
+                cls.locked_methods = {
+                    l.lock_id: set(l.locked_methods) for l in decl.locks
+                }
+                if decl.external_lock is not None:
+                    cls.locked_methods.setdefault(decl.external_lock, set())
+                self._collect_aliases(node, cls)
+                self.classes_by_name[node.name if decl.name == "*" else decl.name] = cls
+                out.append((cls, node))
+        # summaries in a second pass: collaborator resolution needs the full
+        # class table (cross-file models are added before summarize())
+        return out
+
+    @staticmethod
+    def _collect_aliases(node: ast.ClassDef, cls: ClassModel) -> None:
+        """``self.X = <anything>._Y`` where _Y is a declared lock attr makes
+        X an alias of that lock (one level: the `self._lock = other._lock`
+        sharing idiom)."""
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Assign) or len(sub.targets) != 1:
+                continue
+            target_attr = _self_attr(sub.targets[0])
+            if target_attr is None or target_attr in cls.lock_attrs:
+                continue
+            value = sub.value
+            tail = None
+            if isinstance(value, ast.Attribute):
+                tail = value.attr
+            elif isinstance(value, ast.Name):
+                tail = value.id
+            if tail in cls.lock_attrs:
+                cls.lock_attrs[target_attr] = cls.lock_attrs[tail]
+
+    def summarize(self, pairs: Iterable[Tuple[ClassModel, ast.ClassDef]]) -> None:
+        for cls, node in pairs:
+            methods = [
+                n for n in node.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            ]
+            # lock-held closure: declared + suffix first, then methods whose
+            # every intra-class call site already holds the lock (private
+            # helpers reached through one or more locked levels)
+            for lock in cls.decl.locks:
+                if lock.locked_suffix:
+                    for m in methods:
+                        if m.name.endswith(lock.locked_suffix):
+                            cls.locked_methods[lock.lock_id].add(m.name)
+            for m in methods:
+                summary = MethodSummary(
+                    name=m.name, cls_name=cls.decl.name, lineno=m.lineno,
+                    entry_held=cls.entry_locks(m.name),
+                )
+                walker = _MethodWalker(self, cls, summary)
+                walker.walk_body(m.body, set(summary.entry_held))
+                cls.methods[m.name] = summary
+            # closure fixpoint: each round may prove more methods lock-held
+            # (an N-deep locked call chain needs N rounds; the cap is a
+            # runaway guard far above any real nesting depth)
+            for _ in range(16):
+                closed = self._close_locked_methods(cls)
+                rewalked = False
+                for m in methods:
+                    entry = cls.entry_locks(m.name)
+                    if entry != cls.methods[m.name].entry_held:
+                        summary = MethodSummary(
+                            name=m.name, cls_name=cls.decl.name, lineno=m.lineno,
+                            entry_held=entry,
+                        )
+                        walker = _MethodWalker(self, cls, summary)
+                        walker.walk_body(m.body, set(entry))
+                        cls.methods[m.name] = summary
+                        rewalked = True
+                if not closed and not rewalked:
+                    break
+
+    @staticmethod
+    def _close_locked_methods(cls: ClassModel) -> bool:
+        """One closure round: a private method whose every intra-class call
+        site holds lock L joins L's locked set. Returns True on any change."""
+        sites: Dict[str, List[FrozenSet[str]]] = {}
+        for s in cls.methods.values():
+            for call in s.calls:
+                if call.cls_name == cls.decl.name:
+                    sites.setdefault(call.method, []).append(call.held)
+        changed = False
+        for lock_id, locked in cls.locked_methods.items():
+            for name, helds in sites.items():
+                if (
+                    name.startswith("_")
+                    and name not in locked
+                    and name in cls.methods
+                    and helds
+                    and all(lock_id in h for h in helds)
+                ):
+                    locked.add(name)
+                    changed = True
+        return changed
+
+
+def build_class_models(
+    sources: Mapping[str, Any],
+    specs: Optional[Mapping[str, Sequence[ClassDecl]]] = None,
+) -> Tuple[Dict[str, ClassModel], List[Finding]]:
+    """Compile ``{filename: source-or-parsed-Module}`` + declarations into
+    class models.
+
+    Returns ``(classes_by_name, resolution_findings)`` — a declaration that
+    no longer matches the source (class or lock attribute deleted/renamed)
+    is a loud ``concurrency-decl-unresolved`` error, not a silent skip: a
+    refactor that deletes a lock must fail ``make analyze``, not quietly
+    shrink the audited surface.
+    """
+    specs = CONCURRENCY_SPECS if specs is None else specs
+    builder = _ModelBuilder()
+    findings: List[Finding] = []
+    pairs: List[Tuple[ClassModel, ast.ClassDef]] = []
+    for filename, source in sources.items():
+        decls = _decls_for(filename, specs)
+        if not decls:
+            continue
+        tree = source if isinstance(source, ast.Module) else ast.parse(source, filename=filename)
+        declared = {d.name for d in decls if d.name != "*"}
+        present = {n.name for n in ast.walk(tree) if isinstance(n, ast.ClassDef)}
+        for missing in sorted(declared - present):
+            findings.append(Finding(
+                rule="concurrency-decl-unresolved", severity="error",
+                where=f"{filename}:1",
+                message=(
+                    f"declared class {missing!r} not found in {filename} — the "
+                    "concurrency declarations no longer match the source"
+                ),
+                hint=(
+                    "update CONCURRENCY_SPECS in analysis/rules/locks.py "
+                    "alongside the refactor (the declarations are the checked "
+                    "record of the lock discipline)"
+                ),
+            ))
+        pairs.extend(builder.add_file(tree, filename, decls))
+    # lock attributes must exist where declared (a deleted lock fails here);
+    # classes without an __init__ skip the check — lock creation lives in
+    # construction, and a class with no constructor has nowhere to assign
+    for cls, node in pairs:
+        if cls.decl.inherits_locks:
+            continue
+        if not any(
+            isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)) and m.name == "__init__"
+            for m in node.body
+        ):
+            continue
+        declared_attrs = {l.attr for l in cls.decl.locks}
+        assigned = {
+            _self_attr(t)
+            for sub in ast.walk(node)
+            if isinstance(sub, ast.Assign)
+            for t in sub.targets
+        }
+        for attr in sorted(declared_attrs - assigned):
+            findings.append(Finding(
+                rule="concurrency-decl-unresolved", severity="error",
+                where=f"{cls.filename}:{node.lineno}",
+                message=(
+                    f"{cls.decl.name} declares lock attribute {attr!r} but the "
+                    "class never assigns it — lock deleted or renamed?"
+                ),
+                hint="fix the declaration in analysis/rules/locks.py or restore the lock",
+            ))
+    builder.summarize(pairs)
+    return builder.classes_by_name, findings
+
+
+def decls_for_file(
+    filename: str, specs: Optional[Mapping[str, Sequence[ClassDecl]]] = None
+) -> Tuple[ClassDecl, ...]:
+    """The declarations whose path suffix matches ``filename`` (empty tuple
+    for undeclared modules — they simply are not in the audited set)."""
+    specs = CONCURRENCY_SPECS if specs is None else specs
+    norm = filename.replace("\\", "/")
+    for suffix, decls in specs.items():
+        if norm.endswith(suffix):
+            return tuple(decls)
+    return ()
+
+
+_decls_for = decls_for_file
+
+
+# --------------------------------------------------------------- the lockset
+
+
+def lockset_findings(
+    classes: Mapping[str, ClassModel],
+    only_rule: Optional[str] = None,
+) -> List[Finding]:
+    """The lockset rule: every mutation of a declared-guarded attribute with
+    its lock statically held. ``only_rule`` restricts output to one emitted
+    rule id (the ``lock-discipline`` legacy delegation)."""
+    findings: List[Finding] = []
+    for cls in classes.values():
+        for summary in cls.methods.values():
+            if summary.name in cls.decl.exempt_methods:
+                continue
+            owner_lookup = {cls.decl.name: cls}
+            for mut in summary.mutations:
+                owner = classes.get(mut.cls_name, owner_lookup.get(mut.cls_name))
+                if owner is None:
+                    continue
+                lock_id, rule_id = owner.guard_map.get(
+                    mut.attr, (owner.decl.external_lock, "concurrency-lockset")
+                )
+                if lock_id is None:
+                    continue
+                if only_rule is not None and rule_id != only_rule:
+                    continue
+                if lock_id in mut.held:
+                    continue
+                target = (
+                    f"self.{mut.attr}"
+                    if mut.cls_name == cls.decl.name
+                    else f"{mut.cls_name}.{mut.attr}"
+                )
+                findings.append(Finding(
+                    rule=rule_id, severity="error",
+                    where=f"{cls.filename}:{mut.lineno}",
+                    message=(
+                        f"lock-guarded attribute {target} {mut.kind} without "
+                        f"{lock_id} held (in {cls.decl.name}.{summary.name})"
+                    ),
+                    hint=(
+                        "an unlocked read-modify-write can interleave with the "
+                        "thread the lock exists for and lose the update — take "
+                        "the lock, route the write through a locked method of "
+                        "the owning class, or declare the method lock-held in "
+                        "analysis/rules/locks.py with a comment saying why"
+                    ),
+                ))
+    findings.sort(key=lambda f: (f.where, f.rule))
+    return findings

@@ -14,6 +14,11 @@ actually hit — the lint is institutional memory, not style policing:
   state lock guards (:data:`LOCK_SPECS`); mutating one outside
   ``with self._state_lock`` (or outside a method declared lock-held) races a
   step that DONATES the live buffers (the PR-3 ``reset_stream`` RMW race).
+  Since ISSUE 14 this rule is an ALIAS over the concurrency plane's lockset
+  rule (one implementation, :mod:`metrics_tpu.analysis.rules.locks`): the
+  declarations live in ``CONCURRENCY_SPECS`` — per-class, multi-lock,
+  package-wide — and :data:`LOCK_SPECS` is a derived view kept for the
+  original two-file surface (existing suppressions/baselines keep working).
 * ``raise-tuple`` — multi-arg / tuple-literal raises render mangled tuple
   messages (the PR-1 reference-inherited bug, generalized).
 * ``wallclock-in-jit`` — wall-clock or host-RNG calls inside jitted step
@@ -28,7 +33,18 @@ import os
 from dataclasses import dataclass, field
 from typing import Any, Dict, FrozenSet, Iterable, List, Optional, Sequence, Set, Tuple
 
-from metrics_tpu.analysis.core import Finding, Report, parse_suppressions
+from metrics_tpu.analysis.core import (
+    Finding,
+    Report,
+    filter_suppressed,
+    parse_suppressions,
+)
+from metrics_tpu.analysis.rules.locks import (
+    CONCURRENCY_SPECS,
+    build_class_models,
+    decls_for_file,
+    lockset_findings,
+)
 
 __all__ = ["LOCK_SPECS", "LockSpec", "check_source_text", "check_source_tree"]
 
@@ -46,15 +62,12 @@ _WALLCLOCK_PREFIXES = (
     "datetime.datetime.now", "datetime.datetime.utcnow", "datetime.now",
     "np.random.", "numpy.random.", "random.",
 )
-_MUTATOR_METHODS = {
-    "append", "appendleft", "extend", "clear", "pop", "popleft", "remove",
-    "add", "update", "insert", "discard", "setdefault",
-}
-
-
 @dataclass(frozen=True)
 class LockSpec:
-    """The declared lock discipline of one engine module."""
+    """The declared state-lock discipline of one engine module (the original
+    PR 7 vocabulary — now a VIEW derived from the per-class declarations in
+    ``analysis/rules/locks.py::CONCURRENCY_SPECS``, which is the single
+    source of truth for all lock declarations)."""
 
     lock_attr: str
     guarded: FrozenSet[str]
@@ -65,36 +78,21 @@ class LockSpec:
     exempt_methods: FrozenSet[str] = frozenset({"__init__"})
 
 
-_ENGINE_GUARDED = frozenset({
-    "_state", "_state_version", "_merged_memo", "_inflight",
-    "_step", "_batches_done", "_quarantine",
-})
-_ENGINE_LOCKED_METHODS = frozenset({
-    # lock taken by the caller: _process_group holds it across the whole
-    # group, result()/state()/stream_state() across merges and reads
-    "_do_step", "_recover_step", "_bound_inflight", "_execute_chunk",
-    "_run_padded_step", "_execute_payload", "_execute_routed", "_page_round",
-    "_reset_locked", "_merged_state", "_latch_host_attrs",
-    "_record_quarantine", "_screen_group",
-    # ISSUE 11: ladder rung application runs under the tick's lock hold;
-    # the topology swap/memo invalidation only run inside _reshard_locked
-    # (itself *_locked by convention) or the rung application
-    "_engage_rung", "_release_rung", "_engage_quantize", "_release_quantize",
-    "_refresh_policy_identity", "_apply_topology", "_apply_topology_state",
-    "_invalidate_topology_memos",
-    # ISSUE 13: pane rotation runs inside _process_group_locked's lock hold
-    # (_maybe_rotate_locked -> _rotate_once_locked -> plan/commit); windowed
-    # readers run under result()/results()' lock hold
-    "_plan_rotation", "_commit_rotation", "_record_drift",
-    "_windowed_row_result", "_sharded_results_values",
-})
+def _derive_lock_specs() -> Dict[str, LockSpec]:
+    """The legacy two-file view over CONCURRENCY_SPECS: the state lock and
+    the guarded set whose findings still carry the ``lock-discipline`` id."""
+    out: Dict[str, LockSpec] = {}
+    for suffix in ("engine/pipeline.py", "engine/multistream.py"):
+        decl = CONCURRENCY_SPECS[suffix][0]
+        state = next(l for l in decl.locks if l.attr == "_state_lock")
+        legacy = next(g for g in decl.guards if g.rule_id == "lock-discipline")
+        out[suffix] = LockSpec(state.attr, legacy.guarded, state.locked_methods)
+    return out
+
 
 #: path-suffix -> declared discipline. The analyzer applies the spec whose
 #: suffix matches the linted file; everything else skips the rule.
-LOCK_SPECS: Dict[str, LockSpec] = {
-    "engine/pipeline.py": LockSpec("_state_lock", _ENGINE_GUARDED, _ENGINE_LOCKED_METHODS),
-    "engine/multistream.py": LockSpec("_state_lock", _ENGINE_GUARDED, _ENGINE_LOCKED_METHODS),
-}
+LOCK_SPECS: Dict[str, LockSpec] = _derive_lock_specs()
 
 
 def _dotted(node: ast.AST) -> Optional[str]:
@@ -325,96 +323,21 @@ def _defined_inside(w: ast.With, name: str) -> bool:
 
 
 def _rule_lock_discipline(tree: ast.Module, filename: str) -> List[Finding]:
-    spec = next(
-        (s for suffix, s in LOCK_SPECS.items() if filename.replace(os.sep, "/").endswith(suffix)),
-        None,
-    )
-    if spec is None:
-        return []
-    findings: List[Finding] = []
-    for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
-        for method in [n for n in cls.body if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]:
-            if (
-                method.name in spec.exempt_methods
-                or method.name in spec.locked_methods
-                or method.name.endswith("_locked")
-            ):
-                continue
-            findings.extend(_scan_mutations(method, spec, filename, in_lock=False))
+    """Delegates to the concurrency plane's lockset walker (ONE
+    implementation — ``analysis/rules/locks.py``) and keeps only the findings
+    carrying the legacy ``lock-discipline`` rule id: the state-lock guarded
+    set of the two original engine modules. The full multi-lock, package-wide
+    check (plus lock-order/dispatch/check-then-act) runs as the concurrency
+    plane; ``tools/analyze.py`` dedupes the overlap by finding key."""
+    decls = decls_for_file(filename)
+    if not any(
+        g.rule_id == "lock-discipline" for d in decls for g in d.guards
+    ):
+        return []  # only pipeline/multistream carry the legacy alias guard
+    classes, decl_findings = build_class_models({filename: tree})
+    findings = [f for f in decl_findings if f.rule == "lock-discipline"]
+    findings.extend(lockset_findings(classes, only_rule="lock-discipline"))
     return findings
-
-
-def _scan_mutations(
-    node: ast.AST, spec: LockSpec, filename: str, in_lock: bool
-) -> List[Finding]:
-    findings: List[Finding] = []
-    for child in ast.iter_child_nodes(node):
-        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
-            continue  # nested closures run later, under their caller's locking
-        if isinstance(child, ast.With):
-            holds = in_lock or any(
-                isinstance(item.context_expr, ast.Attribute)
-                and item.context_expr.attr == spec.lock_attr
-                and isinstance(item.context_expr.value, ast.Name)
-                and item.context_expr.value.id == "self"
-                for item in child.items
-            )
-            for inner in child.body:
-                findings.extend(_scan_mutations(inner, spec, filename, holds))
-            continue
-        if not in_lock:
-            guarded_hit = _guarded_mutation(child, spec.guarded)
-            if guarded_hit:
-                attr, kind = guarded_hit
-                findings.append(Finding(
-                    rule="lock-discipline", severity="error",
-                    where=f"{filename}:{child.lineno}",
-                    message=(
-                        f"lock-guarded attribute self.{attr} {kind} outside "
-                        f"`with self.{spec.lock_attr}`"
-                    ),
-                    hint=(
-                        "the dispatcher donates the live state buffers; an unlocked "
-                        "read-modify-write can interleave with a step and tear the "
-                        "arena — take the lock, or declare the method lock-held in "
-                        "analysis/source.py::LOCK_SPECS with a comment saying why"
-                    ),
-                ))
-        findings.extend(_scan_mutations(child, spec, filename, in_lock))
-    return findings
-
-
-def _guarded_mutation(node: ast.AST, guarded: FrozenSet[str]) -> Optional[Tuple[str, str]]:
-    def self_attr(t: ast.AST) -> Optional[str]:
-        if (
-            isinstance(t, ast.Attribute)
-            and isinstance(t.value, ast.Name)
-            and t.value.id == "self"
-            and t.attr in guarded
-        ):
-            return t.attr
-        return None
-
-    if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
-        targets = node.targets if isinstance(node, ast.Assign) else [node.target]
-        for t in targets:
-            elts = t.elts if isinstance(t, ast.Tuple) else [t]
-            for e in elts:
-                a = self_attr(e)
-                if a:
-                    return a, "assigned"
-                # self._state[...] = / self._quarantine[...] =
-                if isinstance(e, ast.Subscript):
-                    a = self_attr(e.value)
-                    if a:
-                        return a, "item-assigned"
-    if isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
-        f = node.value.func
-        if isinstance(f, ast.Attribute) and f.attr in _MUTATOR_METHODS:
-            a = self_attr(f.value)
-            if a:
-                return a, f"mutated via .{f.attr}()"
-    return None
 
 
 def _rule_raise_tuple(tree: ast.Module, filename: str) -> List[Finding]:
@@ -491,34 +414,7 @@ def check_source_text(
     findings: List[Finding] = []
     for rule in rules or _SOURCE_RULES:
         findings.extend(rule(tree, filename))
-    suppressions = parse_suppressions(source)
-    kept: List[Finding] = []
-    reasonless_reported: Set[int] = set()
-    for f in findings:
-        try:
-            line = int(f.where.rsplit(":", 1)[1])
-        except (IndexError, ValueError):
-            kept.append(f)
-            continue
-        entry = suppressions.get(line)
-        if entry is None or f.rule not in entry[0]:
-            kept.append(f)
-            continue
-        rules_listed, reason, directive_line = entry
-        if not reason:
-            kept.append(f)  # an unreasoned directive suppresses nothing
-            if directive_line not in reasonless_reported:
-                reasonless_reported.add(directive_line)
-                kept.append(Finding(
-                    rule="suppression-missing-reason", severity="error",
-                    where=f"{filename}:{directive_line}",
-                    message=(
-                        f"`# analysis: disable={','.join(rules_listed)}` has no "
-                        "`-- reason`"
-                    ),
-                    hint="suppressions document debt: say why this occurrence is safe",
-                ))
-    return kept
+    return filter_suppressed(findings, {filename: parse_suppressions(source)})
 
 
 def check_source_tree(root: str, package_rel: bool = True) -> Report:

@@ -56,6 +56,19 @@ and every megabatch span must link exactly the submit spans it absorbed.
 (Same-seed span-sequence determinism is asserted by ``make obs-smoke``,
 which runs a seeded chaos plan twice.)
 
+The THREADING these recoveries depend on — producers submitting (and, with
+admission armed, retrying and counting faults) concurrently with the
+dispatcher's rollback/retry machinery — rides lock invariants that are now
+statically checked by ``make analyze``'s concurrency plane (ISSUE 14,
+``analysis/rules/locks.py``): the state lock guards the carried
+state/replay-cursor/quarantine, every cross-thread stats counter (including
+the per-site fault counts this smoke's accounting asserts on) goes through
+``EngineStats``'s locked ``record_*`` methods, the ladder lock nests the
+state lock and never the reverse, and the pager mutates only under the
+engine's state lock. A refactor that deletes one of those locks fails
+``make analyze`` before this smoke can flake on a lost increment or a torn
+ledger.
+
 Writes the chaos engine's telemetry JSON (the fault block renders via
 ``tools/engine_report.py``) and prints one PASS line. Exits nonzero on any
 violated claim.

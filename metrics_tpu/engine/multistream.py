@@ -1218,6 +1218,7 @@ class MultiStreamEngine(StreamingEngine):
             tr.begin("result", trace=ENGINE_TRACE, stream_id=sid) if tr is not None else None
         )
         self.flush()
+        # analysis: disable=concurrency-check-then-act -- stale-tolerant by design: the defer rung SERVES staleness (bounded by the rung release clearing the cache), and the re-acquired write stores a FRESH value computed under this same hold, never the stale read
         with self._state_lock:
             if self._stream_shard:
                 value = self._windowed_row_result(sid)

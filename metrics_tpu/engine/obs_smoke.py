@@ -35,6 +35,16 @@ CPU device (``make obs-smoke``):
 4. **Dead dispatcher** — a fatal ``dispatcher_kill`` under its own recorder
    still produces its fault span event (the last site), completing coverage.
 
+Lock invariants this smoke USED to be the only guard for are now statically
+checked by ``make analyze``'s concurrency plane (ISSUE 14,
+``analysis/rules/locks.py``): the recorder lock guards the span ring /
+trace counter / histogram table, the histogram lock guards the pending
+buffer and counts, the two NEVER nest (``FORBIDDEN_NESTINGS`` — what keeps
+a scrape's jax fold off the submit path), and neither ever holds across a
+jax dispatch. A refactor that deletes one of these locks — or quietly
+re-nests them — fails ``make analyze`` before this smoke can flake on the
+resulting stall or torn exposition.
+
 Sidecars land under the gitignored ``out/`` per the repo's sidecar-hygiene
 convention. Prints one PASS line; exits nonzero on any violated claim.
 """

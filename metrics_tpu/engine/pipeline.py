@@ -1504,7 +1504,7 @@ class StreamingEngine:
         except BaseException:
             self._submit_stamps.pop(id(item), None)
             raise
-        self._stats.batches_submitted += 1
+        self._stats.record_submitted()
 
     def _enqueue(self, item: Any, timeout: Optional[float]) -> None:
         if timeout is None:
@@ -1700,8 +1700,9 @@ class StreamingEngine:
         counters["compile_cache_hits"] = aot["hits"]
         counters["compile_cache_misses"] = aot["misses"]
         labeled: Dict[str, Any] = {}
-        if s.faults_injected:
-            labeled["faults_injected"] = ("site", dict(s.faults_injected))
+        faults = s.faults_by_site()  # locked snapshot: producers may be firing
+        if faults:
+            labeled["faults_injected"] = ("site", faults)
         if s.sync_payload_exact_bytes or s.sync_payload_quant_bytes:
             # mesh engines only (non-mesh engines never record a payload):
             # bytes one shard contributed per fused sync, split by rider —

@@ -107,13 +107,16 @@ class EngineStats:
         # host-RAM bytes of the spill store at the last gauge refresh — the
         # footprint compress_payloads quantizes (ISSUE 10)
         self.spilled_bytes = 0
-        # admission control + degradation ladder + elastic resharding
-        # (ISSUE 11). Outcome counters are keyed by PRIORITY CLASS and bumped
-        # from concurrent producer threads — a bare `dict[k] += 1` is a
-        # read-modify-write the GIL does not make atomic, so these go through
-        # record_admission under a dedicated lock (counter semantics pinned
-        # under concurrent submits in tests/engine/test_admission.py).
-        self._admission_lock = threading.Lock()
+        # cross-thread counter lock (ISSUE 11, widened by ISSUE 14): every
+        # counter that PRODUCER threads bump concurrently with the dispatcher
+        # — admission outcomes by priority class, retries, deferred reads,
+        # submitted batches, fault firings — goes through a record_* method
+        # under this lock: a bare `+=`/`dict[k] += 1` is a read-modify-write
+        # the GIL does not make atomic (counter semantics pinned under
+        # concurrent submits in tests/engine/test_admission.py and
+        # tests/engine/test_stats_edges.py; the guarded set is DECLARED in
+        # analysis/rules/locks.py and checked by `make analyze`).
+        self._counter_lock = threading.Lock()
         self.admission_admitted: Dict[int, int] = {}
         self.admission_rejected: Dict[int, int] = {}
         self.admission_shed: Dict[int, int] = {}
@@ -149,20 +152,28 @@ class EngineStats:
             "rejected": self.admission_rejected,
             "shed": self.admission_shed,
         }[outcome]
-        with self._admission_lock:
+        with self._counter_lock:
             target[int(priority)] = target.get(int(priority), 0) + 1
+
+    def record_submitted(self) -> None:
+        """One accepted submit. Locked: producers submit CONCURRENTLY, and a
+        bare ``batches_submitted += 1`` on their threads loses increments —
+        the same RMW class the admission counters were locked for in PR 11
+        (found by the concurrency plane's lockset rule, ISSUE 14)."""
+        with self._counter_lock:
+            self.batches_submitted += 1
 
     def record_retry(self) -> None:
         """One bounded-retry attempt. Locked: since ISSUE 11 admission-site
         retries come from PRODUCER threads concurrently with the
         dispatcher's step/merge retries — a bare ``+=`` can lose one."""
-        with self._admission_lock:
+        with self._counter_lock:
             self.retries += 1
 
     def record_deferred_read(self) -> None:
         """One stale read served by the defer_cold_reads rung — reader
         threads call ``result()`` concurrently, so the bump locks."""
-        with self._admission_lock:
+        with self._counter_lock:
             self.deferred_reads += 1
 
     def record_reshard(self, from_world: int, to_world: int, cursor: int, auto: bool) -> None:
@@ -180,7 +191,7 @@ class EngineStats:
         engine ran with neither an admission policy nor a ladder (every
         pre-ISSUE-11 engine: its telemetry document is unchanged). Priority
         keys stringify for JSON round-trip stability."""
-        with self._admission_lock:
+        with self._counter_lock:
             admitted = dict(self.admission_admitted)
             rejected = dict(self.admission_rejected)
             shed = dict(self.admission_shed)
@@ -239,8 +250,20 @@ class EngineStats:
         return out
 
     def record_fault(self, site: str) -> None:
-        """One injected fault fired at ``site`` (chaos harness accounting)."""
-        self.faults_injected[site] = self.faults_injected.get(site, 0) + 1
+        """One injected fault fired at ``site`` (chaos harness accounting).
+        Locked: since ISSUE 11 the ``admission`` site fires on PRODUCER
+        threads concurrently with the dispatcher's sites — an unlocked
+        ``dict[site] += 1`` can lose a firing and break the chaos smokes'
+        every-site-fired accounting (found by the lockset rule, ISSUE 14)."""
+        with self._counter_lock:
+            self.faults_injected[site] = self.faults_injected.get(site, 0) + 1
+
+    def faults_by_site(self) -> Dict[str, int]:
+        """One consistent snapshot of the per-site fault counts. Locked: the
+        admission site fires on producer threads, and an unlocked
+        ``dict(...)`` copy can see the dict resize mid-iteration."""
+        with self._counter_lock:
+            return dict(self.faults_injected)
 
     def fault_summary(self) -> Optional[Dict[str, Any]]:
         """The fault/recovery block for :meth:`summary` — None when this
@@ -258,9 +281,10 @@ class EngineStats:
             "snapshot_failures": self.snapshot_failures,
             "snapshot_fallbacks": self.snapshot_fallbacks,
         }
-        if not self.faults_injected and not any(counters.values()):
+        injected = self.faults_by_site()
+        if not injected and not any(counters.values()):
             return None
-        return {"injected": dict(self.faults_injected), **counters}
+        return {"injected": injected, **counters}
 
     def paging_summary(self) -> Optional[Dict[str, Any]]:
         """The stream-sharding/paging block for :meth:`summary` — None for

@@ -1,0 +1,693 @@
+"""Concurrency-plane fixtures: one deliberately-broken snippet + clean twin
+per rule (lockset, lock-order, dispatch-under-lock, check-then-act), the
+call-graph walker's own contracts (nested with, conditional acquisition,
+lock aliasing, ``*_locked`` through indirection), suppression behavior, and
+the no-false-positive sweep over the real package tree."""
+import textwrap
+
+import pytest
+
+from metrics_tpu.analysis.concurrency import (
+    FORBIDDEN_NESTINGS,
+    check_concurrency_sources,
+    check_concurrency_tree,
+    lock_order_edges,
+)
+from metrics_tpu.analysis.rules.locks import (
+    CONCURRENCY_SPECS,
+    ClassDecl,
+    GuardDecl,
+    LockDecl,
+    build_class_models,
+)
+
+
+def _check(sources, specs, forbidden=()):
+    return check_concurrency_sources(
+        {k: textwrap.dedent(v) for k, v in sources.items()},
+        specs=specs,
+        forbidden=tuple(forbidden),
+    )
+
+
+def _box_specs(dispatch_ok=False, reentrant=False, guarded=("_count", "_items")):
+    return {
+        "fix.py": (
+            ClassDecl(
+                name="Box",
+                locks=(
+                    LockDecl(
+                        attr="_lock", lock_id="Box._lock",
+                        dispatch_ok=dispatch_ok, reentrant=reentrant,
+                        locked_suffix="_locked",
+                    ),
+                ),
+                guards=(
+                    GuardDecl(lock_id="Box._lock", guarded=frozenset(guarded)),
+                ),
+            ),
+        )
+    }
+
+
+def _rules(report):
+    return [(f.rule, f.where) for f in report.findings]
+
+
+# ------------------------------------------------------------------- lockset
+
+
+def test_lockset_unlocked_mutation_fires_with_location():
+    report = _check(
+        {
+            "fix.py": """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._count = 0          # __init__ is exempt
+
+                def bump(self):
+                    self._count += 1         # line 10: guarded, unlocked
+                    self._items.append(1)    # line 11: guarded mutator, unlocked
+            """
+        },
+        _box_specs(),
+    )
+    assert _rules(report) == [
+        ("concurrency-lockset", "fix.py:10"),
+        ("concurrency-lockset", "fix.py:11"),
+    ]
+    assert "Box._lock" in report.findings[0].message
+
+
+def test_lockset_clean_twin_with_block_and_locked_methods():
+    report = _check(
+        {
+            "fix.py": """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._count = 0
+
+                def bump(self):
+                    with self._lock:
+                        self._count += 1          # locked: fine
+
+                def _apply_locked(self):
+                    self._count += 1              # *_locked convention: fine
+            """
+        },
+        _box_specs(),
+    )
+    assert report.findings == []
+
+
+def test_lockset_call_graph_closure_one_level_of_indirection():
+    """A private helper whose EVERY call site holds the lock — including one
+    reached through a ``*_locked`` method, one level of indirection — is
+    proven lock-held; give it one unlocked call site and its mutations flag."""
+    clean = """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def _helper(self):
+            self._count += 1              # all call sites hold the lock
+
+        def _drain_locked(self):
+            self._helper()                # indirection: entered lock-held
+
+        def via_with(self):
+            with self._lock:
+                self._helper()
+
+        def via_indirection(self):
+            with self._lock:
+                self._drain_locked()
+    """
+    assert _check({"fix.py": clean}, _box_specs()).findings == []
+    dirty = clean + (
+        "\n        def leak(self):"
+        "\n            self._helper()   # unlocked call site: closure broken\n"
+    )
+    report = _check({"fix.py": dirty}, _box_specs())
+    assert [f.rule for f in report.findings] == ["concurrency-lockset"]
+    assert "_count" in report.findings[0].message
+
+
+def test_lockset_lock_aliasing_through_assignment():
+    """``self._mirror = self._lock`` makes the alias hold the declared lock;
+    ``self._lock = other._lock`` (sharing another instance's lock) still
+    resolves because the declared ATTRIBUTE is what the walker keys on."""
+    report = _check(
+        {
+            "fix.py": """
+            import threading
+
+            class Box:
+                def __init__(self, other=None):
+                    self._lock = other._lock if other else threading.Lock()
+                    self._mirror = self._lock
+
+                def bump(self):
+                    with self._mirror:           # alias of the declared lock
+                        self._count += 1
+            """
+        },
+        _box_specs(),
+    )
+    assert report.findings == []
+
+
+def test_lockset_conditional_acquisition_via_acquire_release():
+    """The FixedBucketHistogram._flush idiom: acquire in an if/elif header,
+    mutate in the try body, release in finally — statically held."""
+    report = _check(
+        {
+            "fix.py": """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def flush(self, blocking):
+                    if blocking:
+                        self._lock.acquire()
+                    elif not self._lock.acquire(blocking=False):
+                        return
+                    try:
+                        self._count += 1
+                    finally:
+                        self._lock.release()
+
+                def after_release(self):
+                    self._lock.acquire()
+                    self._count += 1
+                    self._lock.release()
+                    self._count += 1             # line 22: released, unlocked
+            """
+        },
+        _box_specs(),
+    )
+    assert _rules(report) == [("concurrency-lockset", "fix.py:22")]
+
+
+def test_lockset_cross_object_mutation_of_collaborator_counter():
+    """The ``self._stats.batches_submitted += 1`` bug shape: a producer-side
+    bump of ANOTHER object's guarded counter flags at the writing line; the
+    clean twin routes it through the owning class's locked record method."""
+    specs = {
+        "eng.py": (
+            ClassDecl(
+                name="Engine",
+                collaborators={"_stats": "Stats"},
+            ),
+            ClassDecl(
+                name="Stats",
+                locks=(LockDecl(attr="_lock", lock_id="Stats._lock"),),
+                guards=(GuardDecl(lock_id="Stats._lock", guarded=frozenset({"n"})),),
+            ),
+        )
+    }
+    report = _check(
+        {
+            "eng.py": """
+            import threading
+
+            class Stats:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def record(self):
+                    with self._lock:
+                        self.n += 1
+
+            class Engine:
+                def submit_broken(self):
+                    self._stats.n += 1           # line 15: cross-object RMW
+
+                def submit_clean(self):
+                    self._stats.record()
+            """
+        },
+        specs,
+    )
+    assert _rules(report) == [("concurrency-lockset", "eng.py:15")]
+    assert "Stats.n" in report.findings[0].message
+
+
+def test_lockset_externally_locked_bookkeeping_class_call_sites():
+    """A StreamPager-shaped class (caller holds the engine lock): calling a
+    MUTATING method without the lock flags; read-only calls never do."""
+    specs = {
+        "eng.py": (
+            ClassDecl(
+                name="Engine",
+                locks=(
+                    LockDecl(attr="_state_lock", lock_id="Engine._state_lock"),
+                ),
+                collaborators={"_pager": "Pager"},
+            ),
+            ClassDecl(name="Pager", external_lock="Engine._state_lock"),
+        )
+    }
+    report = _check(
+        {
+            "eng.py": """
+            import threading
+
+            class Pager:
+                def drop(self, s):
+                    self._table[s] = None        # mutates under the contract
+
+                def slot_of(self, s):
+                    return self._table.get(s)    # pure read
+
+            class Engine:
+                def reset_broken(self):
+                    self._pager.drop(0)          # line 13: no lock held
+
+                def reset_clean(self):
+                    with self._state_lock:
+                        self._pager.drop(0)
+
+                def peek(self):
+                    return self._pager.slot_of(0)   # reads are fine unlocked
+            """
+        },
+        specs,
+    )
+    assert _rules(report) == [("concurrency-lockset", "eng.py:13")]
+    assert "caller-locked" in report.findings[0].message
+
+
+# ---------------------------------------------------------------- lock-order
+
+
+_RECORDER_HIST_SPECS = {
+    "trace_fix.py": (
+        ClassDecl(
+            name="Recorder",
+            locks=(LockDecl(attr="_lock", lock_id="Recorder._lock"),),
+            collaborators={"_hists": "Hist"},
+        ),
+        ClassDecl(
+            name="Hist",
+            locks=(LockDecl(attr="_lock", lock_id="Hist._lock"),),
+            collaborators={"_rec": "Recorder"},
+        ),
+    )
+}
+
+
+def test_lock_order_cycle_on_injected_recorder_histogram_nesting():
+    """The acceptance fixture: a recorder that observes INTO a histogram
+    under its own lock, and a histogram that reports back to the recorder
+    under ITS lock — a recorder<->histogram nesting cycle. The lock-order
+    rule must fail it: once as a cycle, twice as the declared
+    forbidden-pair edges."""
+    report = _check(
+        {
+            "trace_fix.py": """
+            import threading
+
+            class Recorder:
+                def new_trace(self):
+                    with self._lock:
+                        self._n += 1
+
+                def observe(self, name, v):
+                    with self._lock:
+                        h = self._hists[name]
+                        h.observe(v)             # Hist._lock UNDER Recorder._lock
+
+            class Hist:
+                def observe(self, v):
+                    with self._lock:
+                        self._pending.append(v)
+
+                def flush(self):
+                    with self._lock:
+                        self._rec.new_trace()    # Recorder._lock UNDER Hist._lock
+            """
+        },
+        _RECORDER_HIST_SPECS,
+        forbidden=(("Recorder._lock", "Hist._lock"),),
+    )
+    rules = [f.rule for f in report.findings]
+    assert rules.count("concurrency-lock-order") == 3  # pair x2 + cycle
+    cycle = [f for f in report.findings if "cycle" in f.message]
+    assert len(cycle) == 1
+    assert "Recorder._lock" in cycle[0].message and "Hist._lock" in cycle[0].message
+    pair = [f for f in report.findings if "never-nesting" in f.message]
+    assert len(pair) == 2
+
+
+def test_lock_order_clean_twin_swap_under_lock_dispatch_after():
+    """The real recorder's shape — resolve the histogram under the recorder
+    lock but OBSERVE after releasing it — has no edge and passes."""
+    report = _check(
+        {
+            "trace_fix.py": """
+            import threading
+
+            class Recorder:
+                def observe(self, name, v):
+                    with self._lock:
+                        h = self._hists[name]
+                    h.observe(v)                 # after release: no nesting
+
+            class Hist:
+                def observe(self, v):
+                    with self._lock:
+                        self._pending.append(v)
+            """
+        },
+        _RECORDER_HIST_SPECS,
+        forbidden=(("Recorder._lock", "Hist._lock"),),
+    )
+    assert report.findings == []
+
+
+def test_lock_order_self_reacquisition_needs_declared_reentrancy():
+    src = {
+        "fix.py": """
+        import threading
+
+        class Box:
+            def outer(self):
+                with self._lock:
+                    self._inner()
+
+            def _inner(self):
+                with self._lock:
+                    self._count += 1
+        """
+    }
+    report = _check(src, _box_specs(reentrant=False))
+    assert [f.rule for f in report.findings] == ["concurrency-lock-order"]
+    assert "not declared reentrant" in report.findings[0].message
+    assert _check(src, _box_specs(reentrant=True)).findings == []
+
+
+def test_lock_order_transitive_self_reacquisition_through_public_helper():
+    """A PUBLIC helper callable both locked and unlocked never joins the
+    lock-held closure — so its `with self._lock` is a guaranteed
+    self-deadlock when reached from the locked call site of a non-reentrant
+    lock. The edge propagates through the call, not the lexical nesting."""
+    src = {
+        "fix.py": """
+        import threading
+
+        class Box:
+            def outer(self):
+                with self._lock:
+                    self.helper()      # transitive re-acquisition
+
+            def helper(self):           # public: also called unlocked
+                with self._lock:
+                    self._count += 1
+        """
+    }
+    report = _check(src, _box_specs(reentrant=False))
+    assert [f.rule for f in report.findings] == ["concurrency-lock-order"]
+    assert "re-acquired" in report.findings[0].message
+    assert _check(src, _box_specs(reentrant=True)).findings == []
+
+
+def test_lock_order_bare_acquire_under_hold_is_the_same_self_deadlock():
+    """``self._lock.acquire()`` inside ``with self._lock`` deadlocks a plain
+    Lock exactly like a nested ``with`` — the acquire path must carry its
+    self-edge; the exclusive if/elif acquisition idiom must NOT fake one."""
+    src = {
+        "fix.py": """
+        import threading
+
+        class Box:
+            def bad(self):
+                with self._lock:
+                    self._lock.acquire()   # self-deadlock on a plain Lock
+                    self._count += 1
+        """
+    }
+    report = _check(src, _box_specs(reentrant=False))
+    assert [f.rule for f in report.findings] == ["concurrency-lock-order"]
+    assert "re-acquired" in report.findings[0].message
+    assert _check(src, _box_specs(reentrant=True)).findings == []
+
+
+# ------------------------------------------------------- dispatch-under-lock
+
+
+def test_dispatch_under_lock_direct_and_through_calls():
+    report = _check(
+        {
+            "fix.py": """
+            import threading
+            import jax.numpy as jnp
+
+            class Box:
+                def fold_broken(self, x):
+                    with self._lock:
+                        self._count = jnp.sum(x)     # line 8: dispatch under lock
+
+                def program_broken(self, state):
+                    with self._lock:
+                        return self._compute_program()(state)   # line 12
+
+                def _fold(self, x):
+                    return jnp.sum(x)
+
+                def indirect_broken(self, x):
+                    with self._lock:
+                        self._helper(x)              # line 19: callee dispatches
+
+                def _helper(self, x):
+                    return self._fold(x)
+
+                def unlocked_use(self, x):
+                    return self._helper(x)   # keeps _helper out of the closure
+            """
+        },
+        _box_specs(dispatch_ok=False, guarded=("_count",)),
+    )
+    dispatch = [f for f in report.findings if f.rule == "concurrency-dispatch-under-lock"]
+    assert [f.where for f in dispatch] == ["fix.py:12", "fix.py:19", "fix.py:8"]
+    by_line = {f.where: f for f in dispatch}
+    assert "jnp.sum" in by_line["fix.py:8"].message
+    assert "_compute_program" in by_line["fix.py:12"].message
+    # the indirect finding names the path through the callee
+    assert "Box._helper" in by_line["fix.py:19"].message
+
+
+def test_dispatch_under_lock_clean_twin_swap_then_fold():
+    """The PR 8 fix shape: swap pending out under the lock, fold after —
+    and a dispatch_ok lock (the engine's coarse state lock) never flags."""
+    clean = {
+        "fix.py": """
+        import threading
+        import jax.numpy as jnp
+
+        class Box:
+            def flush(self):
+                with self._lock:
+                    pending, self._items = self._items, []
+                return jnp.sum(jnp.asarray(pending))    # after release
+        """
+    }
+    assert _check(clean, _box_specs(dispatch_ok=False, guarded=("_items",))).findings == []
+    under = {
+        "fix.py": """
+        import threading
+        import jax.numpy as jnp
+
+        class Box:
+            def step(self, x):
+                with self._lock:
+                    self._count = jnp.sum(x)   # legal: dispatch_ok lock
+        """
+    }
+    assert _check(under, _box_specs(dispatch_ok=True, guarded=("_count",))).findings == []
+
+
+# ------------------------------------------------------------ check-then-act
+
+
+def test_check_then_act_stop_toctou_shape_fires():
+    report = _check(
+        {
+            "fix.py": """
+            import threading
+
+            class Box:
+                def stop(self):
+                    with self._lock:
+                        running = self._count      # read under hold 1
+                    if running:                    # decision on the stale value
+                        with self._lock:           # line 9: re-acquire (anchor)
+                            self._count = 0        # dependent write, hold 2
+            """
+        },
+        _box_specs(guarded=("_count",)),
+    )
+    assert _rules(report) == [("concurrency-check-then-act", "fix.py:9")]
+    assert "stale" in report.findings[0].message
+
+
+def test_check_then_act_clean_twins():
+    """One continuous hold over read-decide-write passes; so do two holds
+    whose second writes an attribute the first never read."""
+    report = _check(
+        {
+            "fix.py": """
+            import threading
+
+            class Box:
+                def stop_atomic(self):
+                    with self._lock:
+                        if self._count:
+                            self._count = 0        # same hold: fine
+
+                def unrelated(self):
+                    with self._lock:
+                        pending = self._items      # reads _items
+                    if pending:
+                        with self._lock:
+                            self._count = 1        # writes _count: no overlap
+
+                def log_after(self):
+                    with self._lock:
+                        v = self._count            # read-copy
+                    with self._lock:
+                        self._count = 0            # independent write
+                    if v:                          # branch AFTER the write
+                        print(v)                   # steers nothing it wrote
+            """
+        },
+        _box_specs(guarded=("_count", "_items")),
+    )
+    assert report.findings == []
+
+
+# -------------------------------------------------------------- suppressions
+
+
+def test_concurrency_suppression_requires_reason():
+    src = """
+    import threading
+
+    class Box:
+        def bump(self):
+            # analysis: disable=concurrency-lockset -- fixture: doc example of the directive
+            self._count += 1
+
+        def bump2(self):
+            self._count += 1  # analysis: disable=concurrency-lockset
+    """
+    report = _check({"fix.py": src}, _box_specs(guarded=("_count",)))
+    assert sorted(f.rule for f in report.findings) == [
+        "concurrency-lockset", "suppression-missing-reason",
+    ]
+
+
+# ----------------------------------------------------------- decl resolution
+
+
+def test_deleting_a_declared_lock_or_class_fails_loudly():
+    specs = {
+        "fix.py": (
+            ClassDecl(
+                name="Gone",
+                locks=(LockDecl(attr="_lock", lock_id="Gone._lock"),),
+            ),
+            ClassDecl(
+                name="Box",
+                locks=(LockDecl(attr="_vanished_lock", lock_id="Box._vanished_lock"),),
+            ),
+        )
+    }
+    report = _check(
+        {
+            "fix.py": """
+            class Box:
+                def __init__(self):
+                    self._count = 0
+            """
+        },
+        specs,
+    )
+    rules = [f.rule for f in report.findings]
+    assert rules == ["concurrency-decl-unresolved"] * 2
+    messages = " ".join(f.message for f in report.findings)
+    assert "Gone" in messages and "_vanished_lock" in messages
+
+
+def test_declared_module_missing_from_tree_fails_loudly(tmp_path):
+    pkg = tmp_path / "pkg"
+    (pkg / "engine").mkdir(parents=True)
+    specs = {"engine/nowhere.py": (ClassDecl(name="X"),)}
+    report = check_concurrency_tree(str(pkg), specs=specs)
+    assert [f.rule for f in report.findings] == ["concurrency-decl-unresolved"]
+
+
+# ----------------------------------------------------- the real package tree
+
+
+def test_real_package_tree_checks_clean():
+    """The whole-tree sweep: the shipped engine carries zero concurrency
+    findings (the gate's baseline stays empty — debt-free by construction)."""
+    import os
+
+    import metrics_tpu
+
+    root = os.path.dirname(metrics_tpu.__file__)
+    report = check_concurrency_tree(root)
+    assert report.findings == [], report.render()
+
+
+def test_real_tree_lock_order_graph_shape():
+    """Positive pins on the real graph: the ladder lock nests the state lock
+    (the tick applies rungs under both), the engine reaches the leaf
+    subsystem locks, and — the PR 8 invariant — there is NO edge between the
+    recorder and histogram locks in either direction."""
+    import os
+
+    import metrics_tpu
+
+    root = os.path.dirname(metrics_tpu.__file__)
+    sources = {}
+    for suffix in CONCURRENCY_SPECS:
+        path = os.path.join(root, suffix)
+        sources["metrics_tpu/" + suffix] = open(path).read()
+    classes, findings = build_class_models(sources)
+    assert findings == []
+    edges = set(lock_order_edges(classes))
+    assert ("StreamingEngine._ladder_lock", "StreamingEngine._state_lock") in edges
+    assert ("StreamingEngine._state_lock", "DriftDetector._lock") in edges
+    assert ("StreamingEngine._state_lock", "EngineStats._counter_lock") in edges
+    a, b = FORBIDDEN_NESTINGS[0]
+    assert (a, b) not in edges and (b, a) not in edges
+
+
+def test_forbidden_nestings_name_the_recorder_histogram_pair():
+    assert ("TraceRecorder._lock", "FixedBucketHistogram._lock") in FORBIDDEN_NESTINGS
+
+
+def test_concurrency_specs_cover_the_threaded_engine_modules():
+    """The audited-module floor: every module the serving engine threads
+    through is declared (deleting one from the spec should be a conscious,
+    reviewed act — this list is the reviewer's tripwire)."""
+    for suffix in (
+        "engine/pipeline.py", "engine/multistream.py", "engine/trace.py",
+        "engine/admission.py", "engine/stats.py", "engine/paging.py",
+        "engine/windows.py", "engine/tracker.py", "engine/aot.py",
+    ):
+        assert suffix in CONCURRENCY_SPECS, suffix

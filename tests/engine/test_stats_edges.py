@@ -94,3 +94,82 @@ class TestHostTimeShares:
         assert total == pytest.approx(1.0, abs=1e-3)
         assert shares["regime"] == "starved"  # queue wait dominates
         assert shares["window_steps"] == 1
+
+
+class TestCrossThreadCounters:
+    """ISSUE 14 regressions: counters bumped from producer threads
+    concurrently with the dispatcher must not lose increments. The two fixed
+    sites — ``batches_submitted`` (a bare ``+=`` on every producer submit)
+    and ``faults_injected`` (a dict RMW the admission fault site fires on
+    producer threads) — were found by the concurrency plane's lockset rule
+    (``make analyze``); these tests pin the locked record methods' exactness
+    under real thread interleaving."""
+
+    N_THREADS = 8
+    N_EACH = 2000
+
+    @staticmethod
+    def _hammer(fn):
+        import threading
+
+        start = threading.Barrier(TestCrossThreadCounters.N_THREADS)
+
+        def worker():
+            start.wait()  # maximize interleaving: all threads enter together
+            for _ in range(TestCrossThreadCounters.N_EACH):
+                fn()
+
+        threads = [
+            threading.Thread(target=worker)
+            for _ in range(TestCrossThreadCounters.N_THREADS)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    def test_record_submitted_is_exact_under_concurrent_producers(self):
+        s = EngineStats(capacity=4)
+        self._hammer(s.record_submitted)
+        assert s.batches_submitted == self.N_THREADS * self.N_EACH
+
+    def test_record_fault_is_exact_under_concurrent_sites(self):
+        s = EngineStats(capacity=4)
+        self._hammer(lambda: s.record_fault("admission"))
+        assert s.faults_injected == {"admission": self.N_THREADS * self.N_EACH}
+
+    def test_engine_counts_every_concurrent_submit_exactly_once(self):
+        """End-to-end: many producer threads submitting into one engine —
+        the submitted-batches counter equals the true submit count (the
+        pre-fix ``+=`` lost increments exactly here)."""
+        import threading
+
+        import numpy as np
+
+        from metrics_tpu import Accuracy
+        from metrics_tpu.engine import EngineConfig, StreamingEngine
+
+        n_threads, n_each = 4, 25
+        engine = StreamingEngine(Accuracy(), EngineConfig(buckets=(8,)))
+        rng = np.random.RandomState(0)
+        batches = [
+            (rng.rand(5).astype(np.float32), (rng.rand(5) > 0.5).astype(np.int32))
+            for _ in range(n_threads)
+        ]
+        with engine:
+            start = threading.Barrier(n_threads)
+
+            def producer(i):
+                start.wait()
+                for _ in range(n_each):
+                    engine.submit(*batches[i])
+
+            threads = [
+                threading.Thread(target=producer, args=(i,)) for i in range(n_threads)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            engine.flush()
+            assert engine.stats.batches_submitted == n_threads * n_each

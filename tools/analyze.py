@@ -1,8 +1,8 @@
 #!/usr/bin/env python
 """Static-analysis CI gate: ``python tools/analyze.py`` (== ``make analyze``).
 
-Runs both planes of ``metrics_tpu/analysis`` and exits nonzero on any finding
-not covered by the committed baseline:
+Runs all three planes of ``metrics_tpu/analysis`` and exits nonzero on any
+finding not covered by the committed baseline:
 
 * **program plane** — the bootstrap engine matrix ({step, deferred} x
   {arena, per-leaf} x {single, multistream} x kernel backends
@@ -10,23 +10,28 @@ not covered by the committed baseline:
   ``EngineAnalysis.check``: collective placement per sync mode, scatter-free
   Pallas lowerings, donation aliasing, arena fusion, host-constant
   fingerprint coverage, compile caps;
-* **source plane** — the AST trace-hazard lint over ``metrics_tpu/``.
+* **source plane** — the AST trace-hazard lint over ``metrics_tpu/``;
+* **concurrency plane** — the per-class lock declarations
+  (``analysis/rules/locks.py``) checked package-wide: lockset, lock-order
+  (cycles + forbidden nestings), no-dispatch-under-lock, check-then-act.
 
 Options:
-    --plane {all,program,source}   which plane(s) to run (default all)
+    --plane {all,program,source,concurrency}   which plane(s) to run (default all)
     --json PATH                    also write the full report as JSON
     --baseline PATH                baseline file (default tools/analysis_baseline.json)
     --write-baseline               rewrite the baseline from current findings
                                    (each entry gets a TODO reason you must fill
                                    in — unexplained entries fail the gate)
 
-Suppress a single source-plane occurrence inline instead of baselining:
-``# analysis: disable=rule-id -- reason``. Rule catalog: docs/analysis.md.
+Suppress a single source/concurrency-plane occurrence inline instead of
+baselining: ``# analysis: disable=rule-id -- reason``. Rule catalog:
+docs/analysis.md.
 """
 import argparse
 import json
 import os
 import sys
+import time
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
@@ -34,7 +39,9 @@ sys.path.insert(0, _REPO)
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--plane", choices=("all", "program", "source"), default="all")
+    ap.add_argument(
+        "--plane", choices=("all", "program", "source", "concurrency"), default="all"
+    )
     ap.add_argument("--json", dest="json_path", default=None)
     ap.add_argument(
         "--baseline", default=os.path.join(_REPO, "tools", "analysis_baseline.json")
@@ -44,15 +51,41 @@ def main(argv=None) -> int:
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
-    from metrics_tpu.analysis import Baseline, check_source_tree
+    from metrics_tpu.analysis import (
+        Baseline,
+        check_concurrency_tree,
+        check_source_tree,
+    )
     from metrics_tpu.analysis.bootstrap import analyze_bootstrap_matrix
     from metrics_tpu.analysis.core import Report
 
+    pkg = os.path.join(_REPO, "metrics_tpu")
     report = Report()
+    timings = {}
     if args.plane in ("all", "source"):
-        report.merge(check_source_tree(os.path.join(_REPO, "metrics_tpu")))
+        t0 = time.perf_counter()
+        report.merge(check_source_tree(pkg))
+        timings["source"] = time.perf_counter() - t0
+    if args.plane in ("all", "concurrency"):
+        t0 = time.perf_counter()
+        report.merge(check_concurrency_tree(pkg))
+        timings["concurrency"] = time.perf_counter() - t0
     if args.plane in ("all", "program"):
+        t0 = time.perf_counter()
         report.merge(analyze_bootstrap_matrix())
+        timings["program"] = time.perf_counter() - t0
+
+    # the source plane's `lock-discipline` alias and the concurrency plane's
+    # lockset rule share one implementation over the legacy state-lock
+    # declarations — when both planes run, the same finding (identical key)
+    # arrives twice; keep the first occurrence
+    seen = set()
+    deduped = []
+    for f in report.findings:
+        if f.key() not in seen:
+            seen.add(f.key())
+            deduped.append(f)
+    report.findings = deduped
 
     baseline = Baseline.load(args.baseline)
     if args.write_baseline:
@@ -71,6 +104,26 @@ def main(argv=None) -> int:
         payload["baselined"] = [f.key() for f in old]
         payload["new"] = [f.key() for f in new]
         payload["unexplained_baseline_entries"] = unexplained
+        payload["plane_seconds"] = {k: round(v, 3) for k, v in timings.items()}
+        # the concurrency block tools/engine_report.py reads: which engine
+        # modules the lock-contract audit covered, and whether it came back
+        # clean (zero findings across the four concurrency rules). Written
+        # ONLY when the plane actually ran — a --plane source/program report
+        # must not read as a clean audit that never executed
+        if "concurrency" in timings:
+            from metrics_tpu.analysis import CONCURRENCY_SPECS
+
+            conc_rules = (
+                "concurrency-lockset", "concurrency-lock-order",
+                "concurrency-dispatch-under-lock", "concurrency-check-then-act",
+                "concurrency-decl-unresolved", "lock-discipline",
+            )
+            conc_findings = [f.key() for f in report.findings if f.rule in conc_rules]
+            payload["concurrency"] = {
+                "audited_modules": sorted(CONCURRENCY_SPECS),
+                "findings": conc_findings,
+                "clean": not conc_findings,
+            }
         os.makedirs(os.path.dirname(os.path.abspath(args.json_path)), exist_ok=True)
         with open(args.json_path, "w") as fh:
             json.dump(payload, fh, indent=2)
@@ -86,7 +139,9 @@ def main(argv=None) -> int:
         print(f"ERROR   baseline entry without a reason: {k}")
 
     ok = not new and not unexplained
-    planes = args.plane if args.plane != "all" else "program+source"
+    planes = args.plane if args.plane != "all" else "source+concurrency+program"
+    timing_str = " ".join(f"{k}={v:.1f}s" for k, v in timings.items())
+    print(f"plane timings: {timing_str}")
     print(
         f"analyze {'PASS' if ok else 'FAIL'}: planes={planes}, "
         f"findings={len(report.findings)} (new={len(new)}, baselined={len(old)}), "

@@ -35,6 +35,7 @@ on a machine without the accelerator stack).
 """
 import argparse
 import json
+import os
 import sys
 
 
@@ -48,7 +49,7 @@ def _fmt(v):
     return str(v)
 
 
-def render(doc: dict, steps: int = 10) -> str:
+def render(doc: dict, steps: int = 10, analysis: dict = None) -> str:
     s = doc.get("summary", {})
     cc = s.get("compile_cache", {})
     co = s.get("coalesce", {})
@@ -225,6 +226,27 @@ def render(doc: dict, steps: int = 10) -> str:
     w = max(len(k) for k, _ in rows)
     for k, v in rows:
         lines.append(f"  {k:<{w}}  {_fmt(v)}")
+    conc = (analysis or {}).get("concurrency")
+    if conc:
+        # the ISSUE 14 lock-contract audit (make analyze, concurrency plane):
+        # say explicitly when this engine's module set was audited clean —
+        # the operator reading a telemetry report should not have to know a
+        # separate gate exists to learn the lock discipline held
+        n_mod = len(conc.get("audited_modules", []))
+        n_findings = len(conc.get("findings", []))
+        secs = (analysis or {}).get("plane_seconds", {}).get("concurrency")
+        lines.append("── concurrency audit " + "─" * 39)
+        if conc.get("clean"):
+            lines.append(
+                f"  engine module set audited CLEAN: {n_mod} declared modules, "
+                "lockset/lock-order/dispatch/check-then-act all quiet"
+                + (f" ({secs:g}s)" if secs is not None else "")
+            )
+        else:
+            lines.append(
+                f"  {n_findings} concurrency finding(s) over {n_mod} declared "
+                "modules — run `make analyze` for details"
+            )
     tr = _trace_section(doc)
     if tr:
         lines.append("── trace / SLO " + "─" * 45)
@@ -287,9 +309,28 @@ def main(argv=None) -> int:
         "--json", action="store_true",
         help="emit the normalized document (summary/recent_steps/trace) as JSON",
     )
+    ap.add_argument(
+        "--analysis", default=None,
+        help="analysis_report.json from `make analyze` (default: the one "
+        "next to the telemetry file, when present) — adds the concurrency-"
+        "audit line saying whether the engine module set checked clean",
+    )
     args = ap.parse_args(argv)
     with open(args.telemetry_json) as f:
         doc = json.load(f)
+    analysis = None
+    analysis_path = args.analysis
+    if analysis_path is None:
+        sibling = os.path.join(
+            os.path.dirname(os.path.abspath(args.telemetry_json)), "analysis_report.json"
+        )
+        analysis_path = sibling if os.path.exists(sibling) else None
+    if analysis_path:
+        try:
+            with open(analysis_path) as f:
+                analysis = json.load(f)
+        except (OSError, ValueError):
+            analysis = None
     if args.json:
         out = {
             "summary": {k: v for k, v in doc.get("summary", {}).items() if k != "trace"},
@@ -300,7 +341,7 @@ def main(argv=None) -> int:
             out["trace"] = tr
         print(json.dumps(out, indent=2, sort_keys=True))
         return 0
-    print(render(doc, steps=args.steps))
+    print(render(doc, steps=args.steps, analysis=analysis))
     return 0
 
 
