@@ -2,9 +2,9 @@
 # Default flow runs the smoke checks (seconds) before the full suite.
 # Sidecar artifacts (telemetry JSON, analysis reports) land under out/
 # (gitignored) — never in the repo root.
-.PHONY: all test engine-smoke kernels-smoke mesh-smoke streams-smoke chaos-smoke obs-smoke quant-smoke elastic-smoke windows-smoke analyze clean native bench
+.PHONY: all test engine-smoke kernels-smoke mesh-smoke streams-smoke chaos-smoke obs-smoke quant-smoke elastic-smoke windows-smoke fleet-smoke analyze clean native bench
 
-all: engine-smoke kernels-smoke mesh-smoke streams-smoke chaos-smoke obs-smoke quant-smoke elastic-smoke windows-smoke analyze test
+all: engine-smoke kernels-smoke mesh-smoke streams-smoke chaos-smoke obs-smoke quant-smoke elastic-smoke windows-smoke fleet-smoke analyze test
 
 test:
 	python -m pytest tests/ -q
@@ -95,6 +95,21 @@ elastic-smoke:
 # alarm. Docs: docs/serving.md "Windowed metrics".
 windows-smoke:
 	JAX_PLATFORMS=cpu python -m metrics_tpu.engine.windows_smoke
+
+# Multi-host fleet gate (ISSUE 15, metrics_tpu/engine/fleet/harness.py):
+# TWO real OS processes over jax.distributed with gloo CPU collectives —
+# seeded Zipfian traffic split per host (sid % 2) serves bit-identical to a
+# single-process oracle on BOTH hosts; same-seed double run bit-identical
+# (per-stream results + per-host canonical span sequences); zero steady
+# compiles after warmup; steady-step jaxpr/HLO collective-free via the
+# analysis rules while the fleet boundary fold carries the cross-host
+# collective; snapshot cuts ride the shared plan through the barrier
+# protocol; kill host 1 mid-stream -> both hosts restore from the last
+# CONSISTENT cut and replay to exact oracle parity. The parent bounds each
+# round's wall time and kills any worker still alive when a round ends
+# (orphan cleanup). Docs: docs/distributed.md "Multi-host serving".
+fleet-smoke:
+	JAX_PLATFORMS=cpu python -m metrics_tpu.engine.fleet.harness
 
 # Static-analysis gate, CPU-safe (metrics_tpu/analysis + tools/analyze.py):
 # program plane audits the bootstrap engine matrix ({step,deferred} x

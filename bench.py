@@ -1543,6 +1543,34 @@ def bench_stream_capacity() -> dict:
     return json.loads(proc.stdout.strip().splitlines()[-1])
 
 
+# ------------------------------------------------- config: fleet sync (r16)
+
+def bench_fleet_sync() -> dict:
+    """Multi-host fleet boundary sync (ISSUE 15): 2 real OS processes over
+    ``jax.distributed`` (gloo CPU collectives), in ONE subprocess run
+    (``metrics_tpu/engine/fleet/fleet_bench`` owns the protocol — both
+    ``sync_precision`` policies measured by the same worker in one runtime,
+    ratios-in-one-run). Reports the fleet fold latency pair (exact vs
+    ``q8_block``), the analytic per-fold payload bytes + ratio, and
+    streams-per-host at 2 hosts. Loopback sockets, no interconnect → every
+    rate carries ``liveness_only``; the durable facts are the payload ratio
+    and the single-collective fold."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # workers are single-device CPU processes
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "metrics_tpu.engine.fleet.fleet_bench"],
+            env=env, capture_output=True, text=True, timeout=900,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": "fleet_sync timed out"}
+    if proc.returncode != 0:
+        return {"error": proc.stderr[-500:]}
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
 # ---------------------------------------------- config: tracing overhead (r9)
 
 def bench_obs_overhead() -> dict:
@@ -2419,6 +2447,7 @@ def main() -> None:
         ("engine_dispatch", bench_engine_dispatch),
         ("engine_mesh_dispatch", bench_engine_mesh_dispatch),
         ("stream_capacity", bench_stream_capacity),
+        ("fleet_sync", bench_fleet_sync),
         ("obs_overhead", bench_obs_overhead),
         ("kernel_microbench", bench_kernel_microbench),
     ):

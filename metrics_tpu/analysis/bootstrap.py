@@ -118,6 +118,31 @@ def bootstrap_engines(
                 engine.submit(*b)
             engine.result()
         out.append((f"reshard/arena/single/{backend}", engine))
+        # FLEET host engine (ISSUE 15): a degenerate 1-host FleetEngine whose
+        # per-host ingestion engine runs a 1-device LOCAL deferred mesh —
+        # the audited steady step is the REAL collective-free shard-local
+        # program a fleet host serves with (the fleet axis only ever appears
+        # in the boundary fold), so `no-collectives-in-deferred-step` pins
+        # the fleet contract at jaxpr AND HLO level (broken-fixture proof: a
+        # psum smuggled into the fleet host's traced update fails the rule —
+        # tests/analysis/test_engine_audit.py)
+        from metrics_tpu.engine.fleet import FleetConfig, FleetEngine
+
+        fleet = FleetEngine(
+            Accuracy(),
+            FleetConfig(
+                num_streams=2,
+                engine=EngineConfig(
+                    buckets=(8,), kernel_backend=backend,
+                    mesh=mesh, axis="dp", mesh_sync="deferred",
+                ),
+            ),
+        )
+        with fleet:
+            for i, b in enumerate(batches):
+                fleet.ingest(i % 2, *b)
+            fleet.results()
+        out.append((f"fleet/arena/multistream/{backend}", fleet.engine))
         # WINDOWED engine (ISSUE 13): a sliding pane ring driven through TWO
         # real rotations — the audited step is the runtime-pane-indexed
         # ring update ((panes, n) carried buffers, one dynamic-update per

@@ -210,17 +210,50 @@ class EngineAnalysis:
                     for k, n in engine._layout.buffer_sizes().items():
                         shard_shapes.add(((engine._resident, n), k))
                         shard_shapes.add(((engine._world, engine._resident, n), k))
-                elif getattr(engine, "_win_stacked", False):
-                    # the PANE-RING carried forms (ISSUE 13): the windowed
-                    # step's ONE runtime-indexed dynamic-update per dtype
-                    # into the (panes, n) ring is the design, not a
-                    # degradation — only per-leaf writes into the flat (n,)
-                    # pane ROW mean the pack fell apart (and on a 1-device
-                    # deferred mesh (panes, n) can collide with the default
-                    # (world, n) signature, so the explicit set is required)
-                    shard_shapes = {
-                        ((n,), k) for k, n in engine._layout.buffer_sizes().items()
-                    }
+                else:
+                    # the unsharded multistream step's segmented update
+                    # legitimately scatter-reduces into (S, ...)-stacked
+                    # state LEAVES; when one dtype's whole arena buffer is a
+                    # single leaf (buffer size == S, e.g. a collection with
+                    # exactly one f32 state) the flat buffer signature
+                    # collides with that leaf and the rule would flag the
+                    # update itself — the same imprecision class the
+                    # stream-shard/pane-ring overrides fix, resolved in the
+                    # rule INPUTS: SUBTRACT the stacked leaf signatures from
+                    # whichever signature set applies (the pane-ring set for
+                    # windowed engines, the default carried forms otherwise —
+                    # the two overrides COMPOSE for a windowed multistream)
+                    leaf_sigs = set()
+                    if getattr(engine, "_num_streams", None) is not None:
+                        leaf_sigs = {
+                            (tuple(int(d) for d in leaf.shape), str(leaf.dtype))
+                            for leaf in jax.tree_util.tree_leaves(
+                                engine._kind_abstract_state_tree()
+                            )
+                        }
+                    if getattr(engine, "_win_stacked", False):
+                        # the PANE-RING carried forms (ISSUE 13): the windowed
+                        # step's ONE runtime-indexed dynamic-update per dtype
+                        # into the (panes, n) ring is the design, not a
+                        # degradation — only per-leaf writes into the flat
+                        # (n,) pane ROW mean the pack fell apart (and on a
+                        # 1-device deferred mesh (panes, n) can collide with
+                        # the default (world, n) signature, so the explicit
+                        # set is required)
+                        shard_shapes = {
+                            ((n,), k)
+                            for k, n in engine._layout.buffer_sizes().items()
+                        } - leaf_sigs
+                    elif leaf_sigs:
+                        from metrics_tpu.analysis.rules.arena import _arena_avals
+
+                        shard_shapes = (
+                            _arena_avals(
+                                engine._layout,
+                                (engine._world,) if deferred else (),
+                            )
+                            - leaf_sigs
+                        )
                 report.extend(R.check_arena_pack_fused(
                     jaxpr, engine._layout, where=where,
                     worlds=(engine._world,) if deferred else (),

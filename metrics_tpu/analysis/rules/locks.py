@@ -337,6 +337,13 @@ CONCURRENCY_SPECS: Dict[str, Tuple[ClassDecl, ...]] = {
                         "admission_admitted", "admission_rejected", "admission_shed",
                         "retries", "deferred_reads", "batches_submitted",
                         "faults_injected",
+                        # fleet boundary counters (ISSUE 15): moved by the
+                        # fleet caller thread today, but the record_* methods
+                        # lock anyway — declaring them keeps any future
+                        # multi-threaded fleet driver honest by construction
+                        "fleet_ingested", "fleet_skipped", "fleet_merges",
+                        "fleet_merge_us_total", "fleet_barriers", "fleet_cuts",
+                        "fleet_payload_exact_bytes", "fleet_payload_quant_bytes",
                     }),
                 ),
                 GuardDecl(

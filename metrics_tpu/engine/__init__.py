@@ -43,6 +43,13 @@ host-side batching and queueing. This package supplies it:
   periodic atomic snapshots of the accumulated state (orbax-backed, resumable
   after a kill) and ring-buffer telemetry (queue depth, padding waste,
   compile-cache hits, step latency spread) exported as JSON.
+* :mod:`~metrics_tpu.engine.fleet` — multi-host SPMD serving (ISSUE 15):
+  :class:`FleetEngine` runs one per-host ingestion pipeline per
+  ``jax.distributed`` process under a collective-free steady state, folds
+  results over a one-device-per-host fleet mesh at explicit boundaries, and
+  writes globally consistent snapshot cuts through a deterministic
+  barrier-on-batch-boundary protocol. Gate: ``make fleet-smoke`` (two real
+  CPU processes over gloo, :mod:`~metrics_tpu.engine.fleet.harness`).
 * :mod:`~metrics_tpu.engine.quantize` — the block-scaled int8 codec for
   state at REST (ISSUE 10): ``EngineConfig(compress_payloads=True)`` stores
   snapshot payloads and pager spill rows quantized under the metric's
@@ -84,6 +91,14 @@ from metrics_tpu.engine.faults import (
     ScreenPolicy,
     SnapshotCorruptError,
     StepTimeoutError,
+)
+from metrics_tpu.engine.fleet import (
+    FleetBarrierError,
+    FleetConfig,
+    FleetEngine,
+    FleetHostLostError,
+    FleetTopologyError,
+    restore_fleet_into,
 )
 from metrics_tpu.engine.multistream import MultiStreamEngine
 from metrics_tpu.engine.pipeline import EngineConfig, StreamingEngine
@@ -131,6 +146,11 @@ __all__ = [
     "FaultInjector",
     "FaultSpec",
     "FixedBucketHistogram",
+    "FleetBarrierError",
+    "FleetConfig",
+    "FleetEngine",
+    "FleetHostLostError",
+    "FleetTopologyError",
     "InjectedFault",
     "MultiStreamEngine",
     "OverloadDetector",
@@ -152,5 +172,6 @@ __all__ = [
     "q8_decode_array",
     "q8_encode_array",
     "render_openmetrics",
+    "restore_fleet_into",
     "save_snapshot",
 ]

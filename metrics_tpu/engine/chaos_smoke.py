@@ -146,6 +146,18 @@ def chaos_injectors():
     from metrics_tpu.engine import FaultInjector, FaultSpec
 
     return {
+        "fleet": FaultInjector(
+            seed=47,
+            plan={
+                # ISSUE 15: the first snapshot-cut barrier entry and the
+                # first boundary fold fail transiently — both sites are
+                # consulted BEFORE the collective dispatches, so the retry
+                # re-enters the (degenerate, 1-host) collective cleanly and
+                # nothing folds twice
+                "fleet_barrier": FaultSpec(schedule=(0,)),
+                "host_loss": FaultSpec(schedule=(0,)),
+            },
+        ),
         "windows": FaultInjector(
             seed=41,
             plan={
@@ -375,6 +387,56 @@ def stream_shard_engine_config(injector, trace=None, snapshot_dir=None):
         buckets=(8, 32), coalesce=1, mesh=mesh, axis="dp", mesh_sync="deferred",
         fault_injector=injector, trace=trace, snapshot_dir=snapshot_dir,
     )
+
+
+FLEET_STREAMS = 6
+
+
+def fleet_chaos_config(injector, snapdir, trace=None):
+    """The degenerate-fleet chaos probe (ISSUE 15): a 1-host FleetEngine —
+    the SAME boundary programs (merge/result/barrier, world 1) the
+    two-process harness compiles, minus the second process, so
+    ``host_loss``/``fleet_barrier`` transients exercise the real retry path
+    tier-1-cheap. ``coalesce=1`` for span-sequence determinism like every
+    other phase."""
+    from metrics_tpu.engine import EngineConfig
+    from metrics_tpu.engine.fleet import FleetConfig
+
+    return FleetConfig(
+        num_streams=FLEET_STREAMS,
+        engine=EngineConfig(
+            buckets=(8, 32), coalesce=1, fault_injector=injector, trace=trace
+        ),
+        snapshot_dir=snapdir,
+    )
+
+
+def run_fleet_phase(injector, snapdir, trace=None):
+    """Serve the seeded Zipfian stream on a 1-host fleet, cut once (the
+    barrier entry fails transiently and retries), then read every stream's
+    result (the first boundary fold fails transiently and retries).
+    Returns ``{sid: {metric: np.ndarray}}`` for the parity pin."""
+    import numpy as np
+
+    from metrics_tpu.engine.fleet import FleetEngine
+
+    fleet = FleetEngine(chaos_collection(), fleet_chaos_config(injector, snapdir, trace=trace))
+    with fleet:
+        for sid, p, t in zipf_fleet_traffic():
+            fleet.ingest(sid, p, t)
+        fleet.fleet_snapshot()
+        return {
+            sid: {k: np.asarray(v) for k, v in r.items()}
+            for sid, r in fleet.results().items()
+        }
+
+
+def zipf_fleet_traffic():
+    """The fleet phase's seeded stream (dyadic values — parity is bit-exact
+    under any grouping)."""
+    from metrics_tpu.engine.traffic import zipf_traffic
+
+    return zipf_traffic(FLEET_STREAMS, 12, alpha=1.1, seed=31)
 
 
 def main(out_path: str = "out/chaos_telemetry.json") -> int:
@@ -691,6 +753,29 @@ def main(out_path: str = "out/chaos_telemetry.json") -> int:
         f"{em.stats.ewma_decays} vs {eref.stats.ewma_decays}",
     )
     fired_sites |= set(ewma_inj.fired)
+
+    # --------------------- fleet boundaries: barrier + host-loss transients
+    # (ISSUE 15) a degenerate 1-host fleet under the chaos plan: the first
+    # snapshot-cut barrier entry and the first cross-host fold both fail
+    # transiently and retry — both sites fire BEFORE their collective, so a
+    # retry re-enters it cleanly and every per-stream result stays
+    # bit-identical to a fault-free fleet twin
+    fleet_inj = injs["fleet"]
+    fleet_snapdir = tempfile.mkdtemp(prefix="metrics_tpu_chaos_fleet_")
+    got_f = run_fleet_phase(fleet_inj, fleet_snapdir, trace=rec)
+    want_f = run_fleet_phase(None, tempfile.mkdtemp(prefix="metrics_tpu_chaos_fleet_ref_"))
+    for sid in want_f:
+        for k in want_f[sid]:
+            _check(
+                np.array_equal(got_f[sid][k], want_f[sid][k], equal_nan=True),
+                f"fleet chaos parity: stream {sid} {k} {got_f[sid][k]} != {want_f[sid][k]}",
+            )
+    _check(
+        fleet_inj.fired.get("fleet_barrier", 0) == 1
+        and fleet_inj.fired.get("host_loss", 0) == 1,
+        f"fleet sites did not fire: {dict(fleet_inj.fired)}",
+    )
+    fired_sites |= set(fleet_inj.fired)
 
     # ------------------- stream-sharded paging: spill/fault-in under chaos
     # (ISSUE 9) a resident-capped stream-sharded engine under seeded Zipfian

@@ -78,6 +78,9 @@ FAULT_SITES = (
     "reshard_restore",  # live reshard: restore into the target topology
     "pane_rotate",      # window pane rotation: plan phase, before any commit
     "drift_eval",       # closing-pane drift evaluation (pure read, retried)
+    "host_loss",        # a fleet host dies at a boundary (ISSUE 15): transient
+                        # = suspected loss, retried; sticky = FleetHostLostError
+    "fleet_barrier",    # fleet snapshot-cut barrier entry (pure, pre-collective)
     "snapshot_write",   # snapshot save fails before any bytes are durable
     "snapshot_corrupt", # snapshot saved, then payload bytes rot on disk
     "snapshot_read",    # transient restore-time read failure

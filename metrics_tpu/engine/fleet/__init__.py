@@ -1,0 +1,35 @@
+"""Multi-host SPMD fleet serving (ISSUE 15, ROADMAP item 1).
+
+:mod:`~metrics_tpu.engine.fleet.runtime` — :class:`FleetConfig` /
+:class:`FleetEngine`: per-host ingestion pipelines (the existing engines,
+untouched) under a collective-free steady state, boundary folds over a
+one-device-per-host fleet mesh, and the globally consistent snapshot-cut
+protocol (barrier-on-batch-boundary, no wall clock) with a typed
+fleet ↔ single-process restore matrix.
+
+:mod:`~metrics_tpu.engine.fleet.harness` — the two-process CPU CI harness
+(``make fleet-smoke``): gloo collectives over local sockets, seeded Zipfian
+traffic split per host, bit-identical to a single-process oracle,
+kill-one-host → restore → exact replay.
+"""
+from metrics_tpu.engine.fleet.runtime import (
+    FleetBarrierError,
+    FleetConfig,
+    FleetEngine,
+    FleetHostLostError,
+    FleetTopologyError,
+    fleet_mesh,
+    last_consistent_cut,
+    restore_fleet_into,
+)
+
+__all__ = [
+    "FleetBarrierError",
+    "FleetConfig",
+    "FleetEngine",
+    "FleetHostLostError",
+    "FleetTopologyError",
+    "fleet_mesh",
+    "last_consistent_cut",
+    "restore_fleet_into",
+]

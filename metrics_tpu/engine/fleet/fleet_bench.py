@@ -1,0 +1,73 @@
+"""BENCH.fleet_sync: 2-host boundary-fold latency, exact vs q8_block.
+
+``python -m metrics_tpu.engine.fleet.fleet_bench`` spawns the harness's
+two-process bench scenario (gloo CPU collectives over loopback) and prints
+one JSON line:
+
+* per ``sync_precision`` policy — ``exact`` and a blanket ``q8_block`` (only
+  ELIGIBLE float-sum states quantize; counters stay exact, per the ISSUE 10
+  policy contract) — the fleet boundary fold's latency (wall p50 + the
+  stats-attributed collective mean) and the analytic per-fold payload bytes
+  (``fused_sync_plan`` over the (S, ...)-stacked host state at world=2);
+* ``streams_per_host`` — the tenancy observable the fleet adds (S streams
+  homed ``sid % num_hosts``);
+* RATIOS-IN-ONE-RUN: both policies measured by the same worker process in
+  one runtime bring-up, so the payload ratio and the latency pair share
+  every confounder.
+
+``liveness_only`` is stamped on every rate: gloo over loopback sockets on a
+timeshared CPU measures the PROTOCOL (program count, payload bytes, fold
+shape), not an interconnect — the durable facts are the payload ratio and
+the zero-steady-compile program set, same honesty contract as every other
+virtual-topology bench entry.
+"""
+import json
+import sys
+import tempfile
+
+
+def run() -> dict:
+    from metrics_tpu.engine.fleet.harness import (
+        BUCKETS,
+        NUM_HOSTS,
+        S,
+        _run_pair,
+    )
+
+    workdir = tempfile.mkdtemp(prefix="metrics_tpu_fleet_bench_")
+    rcs, outs = _run_pair("bench", workdir, "bench")
+    if any(rc != 0 for rc in rcs) or any("error" in o for o in outs):
+        return {
+            "error": next(
+                (o.get("error", "")[-400:] for o in outs if "error" in o),
+                f"worker exit codes {rcs}",
+            )
+        }
+    host0 = outs[0]
+    pol = host0["policies"]
+    exact_b = pol["exact"]["payload_bytes_per_fold"]
+    quant_b = pol["q8_block"]["payload_bytes_per_fold"]
+    return {
+        "num_hosts": host0["num_hosts"],
+        "streams_per_host": host0["streams_per_host"],
+        "buckets": list(BUCKETS),
+        "num_streams": S,
+        "policies": pol,
+        "sync_payload_ratio": round(exact_b / quant_b, 2) if quant_b else None,
+        "liveness_only": True,
+        "note": (
+            "2 local processes, gloo CPU collectives over loopback — protocol "
+            "measurement, no interconnect; durable facts: the payload ratio, "
+            "the per-policy program identity, and the single-collective fold"
+        ),
+        "harness": f"NUM_HOSTS={NUM_HOSTS} via metrics_tpu.engine.fleet.harness",
+    }
+
+
+def main() -> int:
+    print(json.dumps(run()))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

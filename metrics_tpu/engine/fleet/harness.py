@@ -1,0 +1,586 @@
+"""Two-process CPU fleet harness: ``python -m metrics_tpu.engine.fleet.harness``.
+
+The CI-shaped proof of the fleet runtime (ISSUE 15, ``make fleet-smoke``) on
+ONE machine: two real OS processes over ``jax.distributed`` (gloo CPU
+collectives, loopback sockets — the honest caveat being that this measures
+the PROTOCOL, not an interconnect; every rate derived here is
+``liveness_only``). Claims, each checked by the parent against artifacts the
+workers write:
+
+1. **Oracle parity** — seeded Zipfian traffic (``engine/traffic.py``,
+   dyadic values) split per host by the ``sid % num_hosts`` homing rule,
+   served by the 2-host fleet with snapshot cuts riding the shared plan;
+   every per-stream ``results()`` value read on EITHER host is BIT-IDENTICAL
+   to a single-process oracle serving the same plan.
+2. **Same-seed determinism** — the whole two-process run executes TWICE:
+   per-host per-stream results and per-host canonical span sequences
+   (``TraceRecorder.canonical_sequence``, timestamps excluded) are
+   identical across the runs.
+3. **Closed program set** — after warmup, a reset + full replay on each host
+   compiles ZERO new programs (the fleet boundary programs — merge, result,
+   barrier — are part of the closed set).
+4. **Collective placement** — every compiled steady-step program on every
+   host carries ZERO cross-host collectives at jaxpr AND HLO level (the
+   ``no-collectives-in-deferred-step`` analysis rule over the host engine,
+   whose local mesh is deferred), while the fleet boundary program's HLO
+   carries at least one (the fold has to cross hosts somewhere).
+5. **Kill one host → restore → exact replay** — a third run serves to a
+   mid-plan point past a consistent cut and host 1 dies (``os._exit``); a
+   fourth run restores BOTH hosts from the last CONSISTENT cut (the torn
+   trailing state is discarded), replays the remaining plan, and the final
+   per-stream results equal the oracle bit-exactly.
+6. **OpenMetrics** — each host's exposition strict-parses and carries the
+   ``host``-labeled fleet families; the single-process oracle's exposition
+   carries none (byte-stable vs a fleet-free engine).
+
+The parent owns WALL-TIME bounds (per-round subprocess deadlines) and
+ORPHAN CLEANUP: any worker still alive when its round ends — timeout,
+sibling crash, parent interrupt — is killed in a ``finally``. Workers exit
+via ``os._exit`` after writing their artifact so a wedged
+``jax.distributed`` teardown can never outlive the round.
+
+Prints one PASS line; exits nonzero on any violated claim.
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import traceback
+
+NUM_HOSTS = 2
+S = 16                   # streams, homed sid % 2
+N_BATCHES = 120          # global plan length
+BUCKETS = (16, 32)
+CUT_EVERY = 30           # global-plan batches per snapshot cut
+KILL_AT = 75             # plan position where host 1 dies (past cut 1 @ 60)
+SEED = 23
+KILL_EXIT = 17           # the simulated-death exit code
+ROUND_TIMEOUT_S = 420.0
+
+
+def _collection():
+    from metrics_tpu import Accuracy, MeanSquaredError, MetricCollection
+
+    return MetricCollection([Accuracy(), MeanSquaredError()])
+
+
+def _traffic():
+    from metrics_tpu.engine.traffic import zipf_traffic
+
+    return zipf_traffic(S, N_BATCHES, alpha=1.1, seed=SEED)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _jsonable_results(results) -> dict:
+    import numpy as np
+
+    return {
+        str(sid): {k: np.asarray(v).tolist() for k, v in tree.items()}
+        for sid, tree in results.items()
+    }
+
+
+def _results_equal(a: dict, b: dict) -> bool:
+    """Bitwise per-stream equality with NaN == NaN (a stream the Zipf tail
+    never touched computes 0/0 on BOTH sides — that is agreement)."""
+    import numpy as np
+
+    if set(a) != set(b):
+        return False
+    return all(
+        set(a[s]) == set(b[s])
+        and all(
+            np.array_equal(
+                np.asarray(a[s][k]), np.asarray(b[s][k]), equal_nan=True
+            )
+            for k in a[s]
+        )
+        for s in a
+    )
+
+
+# ---------------------------------------------------------------------- worker
+
+
+def _build_fleet(spec: dict, pid: int, trace=None, snapshot_every=None):
+    import numpy as np
+
+    import jax
+    from jax.sharding import Mesh
+
+    from metrics_tpu.engine import EngineConfig
+    from metrics_tpu.engine.fleet import FleetConfig, FleetEngine
+    from metrics_tpu.engine.fleet.runtime import _ensure_distributed
+
+    H = int(spec["num_hosts"])
+    base = FleetConfig(
+        num_processes=H, process_id=pid, coordinator_address=spec.get("coord")
+    )
+    # distributed FIRST: the local mesh below needs this process's devices,
+    # which exist only once the runtime is up (no-op for the degenerate fleet)
+    _ensure_distributed(base)
+    # the per-host ingestion engine runs a 1-device LOCAL deferred mesh: the
+    # steady step is then the REAL shard-local program the analysis rules pin
+    # (a meshless engine would satisfy "no collectives" vacuously)
+    mesh = Mesh(np.asarray(jax.local_devices()[:1]), ("dp",))
+    ecfg = EngineConfig(
+        buckets=BUCKETS,
+        coalesce=int(spec.get("coalesce", 1)),
+        mesh=mesh,
+        axis="dp",
+        mesh_sync="deferred",
+        trace=trace,
+    )
+    fcfg = FleetConfig(
+        num_processes=H,
+        process_id=pid,
+        coordinator_address=spec.get("coord"),
+        engine=ecfg,
+        num_streams=S,
+        snapshot_dir=spec.get("snapshot_dir"),
+        snapshot_every=(
+            int(snapshot_every) if snapshot_every is not None
+            else int(spec.get("snapshot_every", 0))
+        ),
+    )
+    return FleetEngine(_collection(), fcfg)
+
+
+def _scenario_serve(spec: dict, pid: int, out: dict) -> None:
+    """Serve the whole plan twice (reset between): parity + determinism +
+    zero-steady-compiles + collective placement + OpenMetrics artifacts."""
+    from metrics_tpu.analysis import check_no_collectives
+    from metrics_tpu.engine import TraceRecorder
+    from metrics_tpu.parallel.collectives import HLO_COLLECTIVE_RE
+
+    rec = TraceRecorder(capacity=1 << 15)
+    fleet = _build_fleet(spec, pid, trace=rec)
+    traffic = _traffic()
+    with fleet:
+        for b in traffic:
+            fleet.ingest(*b)
+        res1 = fleet.results()
+        warm = fleet.engine.aot_cache.misses
+        fleet.reset()
+        for b in traffic:
+            fleet.ingest(*b)
+        res2 = fleet.results()
+        steady = fleet.engine.aot_cache.misses - warm
+    r1, r2 = _jsonable_results(res1), _jsonable_results(res2)
+    out["results"] = r1
+    out["repeat_equal"] = _results_equal(r1, r2)
+    out["steady_compiles"] = int(steady)
+    out["dropped_spans"] = int(rec.dropped)
+    out["spans"] = {
+        track: [list(map(_canon_json, row)) for row in rows]
+        for track, rows in rec.canonical_sequence().items()
+    }
+    # collective placement, HLO side: every steady-step program clean, the
+    # fleet boundary program collective-bearing (H=2 — the fold crosses hosts)
+    hlo_findings = []
+    for prog in fleet.engine._program_memo.values():
+        hlo_findings += [
+            f.render()
+            for f in check_no_collectives(
+                hlo_text=prog.as_text(), where="fleet-harness/steady-step"
+            )
+        ]
+    out["steady_hlo_findings"] = hlo_findings
+    boundary_hlo = fleet._result_program().as_text()
+    out["boundary_hlo_collectives"] = len(HLO_COLLECTIVE_RE.findall(boundary_hlo))
+    # jaxpr side, via the real rule set: the host engine is a deferred-mesh
+    # engine, so EngineAnalysis applies no-collectives-in-deferred-step (and
+    # the rest of the program plane) to the re-traced steady step
+    if pid == 0:
+        from metrics_tpu.analysis.program import EngineAnalysis
+
+        report = EngineAnalysis().check(fleet.engine, label=f"fleet-host{pid}")
+        out["analysis_findings"] = [f.render() for f in report.findings]
+    text = fleet.metrics_text()
+    out["metrics_text"] = text
+    out["fleet_block"] = fleet.telemetry().get("fleet")
+
+
+def _canon_json(v):
+    if isinstance(v, tuple):
+        return [_canon_json(x) for x in v]
+    if isinstance(v, list):
+        return [_canon_json(x) for x in v]
+    return v
+
+
+def _scenario_kill(spec: dict, pid: int, out: dict) -> None:
+    """Serve to KILL_AT (cuts at 30/60 ride the plan), then host 1 DIES.
+
+    Host 0 stops ingesting at the same plan position (a fleet that lost a
+    host cannot cross its next barrier) and exits cleanly; nothing after
+    the last consistent cut survives — which is the point."""
+    fleet = _build_fleet(spec, pid)
+    traffic = _traffic()
+    with fleet:
+        for b in traffic[:KILL_AT]:
+            fleet.ingest(*b)
+        fleet.flush()
+        out["cursor"] = fleet.global_cursor
+        out["cuts"] = fleet.engine.stats.fleet_cuts
+    if pid == 1:
+        # the simulated host death: no result(), no clean teardown, the
+        # process is GONE. The artifact must be DURABLE before os._exit —
+        # which skips interpreter shutdown and buffered-file flushing, so
+        # close explicitly rather than leaning on refcount timing
+        with open(spec["out_paths"][pid], "w") as f:
+            json.dump(out, f)
+        os._exit(KILL_EXIT)
+
+
+def _scenario_restore(spec: dict, pid: int, out: dict) -> None:
+    """Both hosts restore from the last CONSISTENT cut and replay the rest
+    of the plan; final results must equal the uninterrupted oracle."""
+    fleet = _build_fleet(spec, pid)
+    meta = fleet.restore()
+    out["restored_cut"] = int(meta.get("fleet_cut", -1))
+    out["restored_cursor"] = int(meta.get("fleet_plan_cursor", -1))
+    traffic = _traffic()
+    with fleet:
+        for b in traffic[fleet.global_cursor:]:
+            fleet.ingest(*b)
+        out["results"] = _jsonable_results(fleet.results())
+
+
+def _scenario_bench(spec: dict, pid: int, out: dict) -> None:
+    """BENCH.fleet_sync's measured half: per sync_precision policy, the
+    2-host boundary-fold latency (the fleet collective, stats-attributed)
+    and the analytic per-fold payload bytes — both policies in ONE worker
+    process, so the ratio is a same-process same-runtime fact."""
+    import time as _time
+
+    import numpy as np
+
+    folds = int(spec.get("bench_folds", 8))
+    traffic = _traffic()
+    out["policies"] = {}
+    for policy in ("exact", "q8_block"):
+        col = _collection()
+        if policy != "exact":
+            col.set_sync_precision(policy)
+        import jax
+        from jax.sharding import Mesh
+
+        from metrics_tpu.engine import EngineConfig
+        from metrics_tpu.engine.fleet import FleetConfig, FleetEngine
+
+        mesh = Mesh(np.asarray(jax.local_devices()[:1]), ("dp",))
+        fleet = FleetEngine(
+            col,
+            FleetConfig(
+                num_processes=int(spec["num_hosts"]), process_id=pid,
+                coordinator_address=spec.get("coord"),
+                engine=EngineConfig(
+                    buckets=BUCKETS, coalesce=8, mesh=mesh, axis="dp",
+                    mesh_sync="deferred",
+                ),
+                num_streams=S,
+            ),
+        )
+        with fleet:
+            for b in traffic:
+                fleet.ingest(*b)
+            fleet.results()  # warmup: compiles the boundary programs
+            st = fleet.engine.stats
+            wall, merge0 = [], st.fleet_merge_us_total
+            for _ in range(folds):
+                t0 = _time.perf_counter()
+                fleet.results()
+                wall.append((_time.perf_counter() - t0) * 1e6)
+            merge_us = (st.fleet_merge_us_total - merge0) / folds
+            exact_b, quant_b = fleet._fleet_payload_split()
+        out["policies"][policy] = {
+            "fold_wall_us_p50": float(np.median(wall)),
+            "fold_wall_us_spread": [float(min(wall)), float(max(wall))],
+            "fleet_merge_us_mean": float(merge_us),
+            "payload_bytes_per_fold": int(exact_b + quant_b),
+            "payload_bytes_quantized": int(quant_b),
+        }
+    out["streams_per_host"] = S // int(spec["num_hosts"])
+    out["num_hosts"] = int(spec["num_hosts"])
+
+
+_SCENARIOS = {
+    "serve": _scenario_serve,
+    "kill": _scenario_kill,
+    "restore": _scenario_restore,
+    "bench": _scenario_bench,
+}
+
+
+def _worker() -> None:
+    """Subprocess entry: run one scenario for one host, write the artifact,
+    ``os._exit`` (a wedged distributed teardown must never outlive the
+    parent's round deadline)."""
+    with open(os.environ["FLEET_WORKER_SPEC"]) as f:
+        spec = json.load(f)
+    pid = int(os.environ["FLEET_PROC_ID"])
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # distributed bring-up BEFORE anything can touch a backend (importing
+    # the library or calling process_count() lazily initializes XLA, after
+    # which jax.distributed.initialize refuses to run)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if int(spec["num_hosts"]) > 1:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        jax.distributed.initialize(
+            coordinator_address=spec["coord"],
+            num_processes=int(spec["num_hosts"]),
+            process_id=pid,
+        )
+    out: dict = {"pid": pid}
+    rc = 0
+    try:
+        _SCENARIOS[spec["scenario"]](spec, pid, out)
+    except BaseException:  # noqa: BLE001 - the artifact carries the failure
+        out["error"] = traceback.format_exc()
+        rc = 1
+    with open(spec["out_paths"][pid], "w") as f:
+        json.dump(out, f)
+    os._exit(rc)
+
+
+# ---------------------------------------------------------------------- parent
+
+
+def _run_pair(scenario: str, workdir: str, tag: str, **extra) -> tuple:
+    """Spawn the two-host round, bounded and orphan-safe: every worker still
+    alive when the round ends — deadline hit, sibling dead, parent
+    interrupted — is killed before this function returns."""
+    import time
+
+    spec = {
+        "scenario": scenario,
+        "num_hosts": NUM_HOSTS,
+        "coord": f"127.0.0.1:{_free_port()}",
+        "out_paths": [
+            os.path.join(workdir, f"{tag}_host{p}.json") for p in range(NUM_HOSTS)
+        ],
+        **extra,
+    }
+    spec_path = os.path.join(workdir, f"{tag}_spec.json")
+    with open(spec_path, "w") as f:
+        json.dump(spec, f)
+    code = "from metrics_tpu.engine.fleet.harness import _worker; _worker()"
+    procs = []
+    try:
+        for p in range(NUM_HOSTS):
+            env = dict(os.environ)
+            env["FLEET_WORKER_SPEC"] = spec_path
+            env["FLEET_PROC_ID"] = str(p)
+            env["JAX_PLATFORMS"] = "cpu"
+            # each worker is its own single-device CPU process — never
+            # inherit a forced multi-device flag from the caller
+            env.pop("XLA_FLAGS", None)
+            procs.append(subprocess.Popen([sys.executable, "-c", code], env=env))
+        deadline = time.monotonic() + ROUND_TIMEOUT_S
+        rcs = []
+        for p in procs:
+            left = max(1.0, deadline - time.monotonic())
+            rcs.append(p.wait(timeout=left))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    outs = []
+    for path in spec["out_paths"]:
+        try:
+            with open(path) as f:
+                outs.append(json.load(f))
+        except (OSError, ValueError):
+            outs.append({"error": f"worker artifact missing: {path}"})
+    return rcs, outs
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    from metrics_tpu.engine import EngineConfig, MultiStreamEngine
+    from metrics_tpu.engine.chaos_smoke import make_checker
+    from metrics_tpu.engine.fleet import last_consistent_cut
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..", "..", "tools"))
+    import trace_export
+
+    check, failed = make_checker()
+    workdir = tempfile.mkdtemp(prefix="metrics_tpu_fleet_smoke_")
+    traffic = _traffic()
+
+    # ------------------------------------------------- single-process oracle
+    oracle = MultiStreamEngine(_collection(), S, EngineConfig(buckets=BUCKETS))
+    with oracle:
+        for sid, p, t in traffic:
+            oracle.submit(sid, p, t)
+        want = _jsonable_results(oracle.results())
+    oracle_text = oracle.metrics_text()
+    check(
+        "fleet_" not in oracle_text,
+        "single-process exposition grew fleet families — must stay byte-stable",
+    )
+    trace_export.parse_openmetrics(oracle_text)
+
+    def parity(tag, got):
+        for sid in want:
+            for k in want[sid]:
+                check(
+                    np.array_equal(
+                        np.asarray(got[sid][k]), np.asarray(want[sid][k]),
+                        equal_nan=True,
+                    ),
+                    f"{tag}: stream {sid} {k} {got[sid][k]} != {want[sid][k]}",
+                )
+
+    # ------------------------------- two-process serve, TWICE (determinism)
+    runs = []
+    for run_ix in range(2):
+        rcs, outs = _run_pair("serve", workdir, f"serve{run_ix}")
+        for p, (rc, o) in enumerate(zip(rcs, outs)):
+            check(rc == 0 and "error" not in o, f"serve{run_ix} host {p} failed: rc={rc} {o.get('error', '')[-800:]}")
+        runs.append(outs)
+    if failed:
+        return 1
+    for p in range(NUM_HOSTS):
+        o = runs[0][p]
+        parity(f"host {p} results vs oracle", o["results"])
+        check(
+            o["repeat_equal"],
+            f"host {p}: reset+replay results differ within one process",
+        )
+        check(
+            o["steady_compiles"] == 0,
+            f"host {p} compiled {o['steady_compiles']} programs after warmup (expected 0)",
+        )
+        check(o["dropped_spans"] == 0, f"host {p} trace ring dropped spans")
+        check(
+            not o["steady_hlo_findings"],
+            f"host {p} steady-step HLO carries collectives: {o['steady_hlo_findings'][:2]}",
+        )
+        check(
+            o["boundary_hlo_collectives"] >= 1,
+            f"host {p} fleet boundary HLO carries no cross-host collective",
+        )
+        check(
+            _results_equal(runs[0][p]["results"], runs[1][p]["results"]),
+            f"host {p}: same-seed runs returned different results",
+        )
+        check(
+            runs[0][p]["spans"] == runs[1][p]["spans"],
+            f"host {p}: same-seed canonical span sequences differ",
+        )
+        fams = trace_export.parse_openmetrics(o["metrics_text"])
+        for fam in ("fleet_ingested", "fleet_merges", "fleet_barriers"):
+            full = f"metrics_tpu_engine_{fam}"
+            check(full in fams, f"host {p} exposition lacks {fam}")
+            samples = fams[full]["samples"]
+            check(
+                any(s.get("labels", {}).get("host") == str(p) for s in samples),
+                f"host {p} {fam} lacks the host label",
+            )
+        fb = o["fleet_block"] or {}
+        check(
+            fb.get("num_hosts") == NUM_HOSTS and fb.get("process_id") == p,
+            f"host {p} telemetry fleet block wrong: {fb}",
+        )
+        check(
+            fb.get("streams_owned") == S // NUM_HOSTS,
+            f"host {p} owns {fb.get('streams_owned')} streams, expected {S // NUM_HOSTS}",
+        )
+    check(
+        not runs[0][0].get("analysis_findings"),
+        f"analysis rules flagged the fleet host engine: {runs[0][0].get('analysis_findings')[:2]}",
+    )
+    # the two hosts must have split the plan: both ingested and both skipped
+    for p in range(NUM_HOSTS):
+        fb = runs[0][p]["fleet_block"]
+        # the serve scenario runs the plan twice (reset between)
+        check(
+            fb["ingested"] > 0 and fb["skipped"] > 0
+            and fb["ingested"] + fb["skipped"] == 2 * N_BATCHES,
+            f"host {p} ingest split wrong: {fb}",
+        )
+
+    # ------------------------------------------ kill one host mid-stream
+    snapdir = os.path.join(workdir, "fleet_snaps")
+    rcs, outs = _run_pair(
+        "kill", workdir, "kill",
+        snapshot_dir=snapdir, snapshot_every=CUT_EVERY, coalesce=8,
+    )
+    check(
+        rcs[0] == 0 and rcs[1] == KILL_EXIT,
+        f"kill round exit codes {rcs} (wanted [0, {KILL_EXIT}])",
+    )
+    check(
+        "error" not in outs[0],
+        f"surviving host failed: {outs[0].get('error', '')[-800:]}",
+    )
+    check(
+        outs[0].get("cuts") == KILL_AT // CUT_EVERY,
+        f"surviving host took {outs[0].get('cuts')} cuts before the death, "
+        f"expected {KILL_AT // CUT_EVERY}",
+    )
+    k = last_consistent_cut(snapdir, NUM_HOSTS)
+    check(
+        k == KILL_AT // CUT_EVERY - 1,
+        f"last consistent cut {k}, expected {KILL_AT // CUT_EVERY - 1}",
+    )
+
+    # ------------------------------------------- restore + exact replay
+    rcs, outs = _run_pair(
+        "restore", workdir, "restore",
+        snapshot_dir=snapdir, snapshot_every=CUT_EVERY, coalesce=8,
+    )
+    for p, (rc, o) in enumerate(zip(rcs, outs)):
+        check(rc == 0 and "error" not in o, f"restore host {p} failed: rc={rc} {o.get('error', '')[-800:]}")
+    if failed:
+        return 1
+    expect_cursor = (KILL_AT // CUT_EVERY) * CUT_EVERY
+    for p in range(NUM_HOSTS):
+        check(
+            outs[p]["restored_cut"] == k
+            and outs[p]["restored_cursor"] == expect_cursor,
+            f"host {p} restored cut/cursor {outs[p]['restored_cut']}/"
+            f"{outs[p]['restored_cursor']}, expected {k}/{expect_cursor}",
+        )
+        parity(f"post-restore host {p}", outs[p]["results"])
+
+    if failed:
+        return 1
+    print(
+        "fleet-smoke PASS: "
+        f"2-process CPU fleet (gloo) served {N_BATCHES} Zipfian batches over "
+        f"{S} streams (homed sid % {NUM_HOSTS}) bit-identical to the "
+        "single-process oracle on BOTH hosts; same-seed double run "
+        "bit-identical (results + canonical span sequences per host); "
+        "0 steady compiles after warmup; steady-step HLO/jaxpr collective-"
+        "free (analysis rules) while the fleet boundary fold carries "
+        f"{runs[0][0]['boundary_hlo_collectives']} collective(s); cuts every "
+        f"{CUT_EVERY} plan batches via the barrier protocol; host 1 killed at "
+        f"plan {KILL_AT} -> both hosts restored from consistent cut {k} "
+        f"(cursor {expect_cursor}) and replayed to exact oracle parity; "
+        "host-labeled OpenMetrics strict-parsed, single-process exposition "
+        "fleet-free (CPU harness: no interconnect, rates liveness_only)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,1049 @@
+"""Fleet runtime: multi-host SPMD serving over ``jax.distributed`` (ISSUE 15).
+
+Everything below ROADMAP item 1's fold: the engines so far run ONE process
+(virtual 8-device meshes); a real fleet is H processes, each owning its own
+accelerators and its own ingest traffic. The fleet layers on the existing
+engine instead of forking it:
+
+* **Per-host ingestion.** Every host runs one ordinary local engine
+  (:class:`~metrics_tpu.engine.multistream.MultiStreamEngine` when
+  ``FleetConfig.num_streams`` is set, else a
+  :class:`~metrics_tpu.engine.pipeline.StreamingEngine`) — host-local submit
+  queues, bucketing, megabatch coalescing, AOT program set, the whole PR 2–13
+  pipeline, untouched. Streams home by ``stream_id % num_hosts``; a host
+  folds ONLY its own streams' rows, in submission order, so per-stream
+  results are bit-identical to a single-process engine serving the same
+  stream (pinned by ``make fleet-smoke``).
+* **Deferred-only, collective-free steady state.** The carried state is
+  host-local by construction — the steady step NEVER crosses hosts (the
+  same contract as PR 5's deferred shard-local step, and pinned by the same
+  ``no-collectives-in-deferred-step`` analysis rule over the fleet entry of
+  the bootstrap matrix). A local mesh, when configured, must be
+  ``mesh_sync="deferred"``: a step-sync local mesh would put collectives in
+  the steady state, which is exactly what the fleet contract forbids.
+* **Boundary folds over the fleet mesh.** ``result()``/``results()`` is a
+  COLLECTIVE boundary: every host enters it at the same logical point of its
+  ingest plan, each host's merged local state rides ONE
+  ``fused_axis_sync`` bundle over the (num_hosts,)-device fleet mesh
+  (``parallel/embedded.py::sharded_state_merge`` — one representative device
+  per process), and every host gets the replicated global value locally.
+  No coordinator round-trip: the fold IS the SPMD program. Because a
+  non-home host holds the metric's INIT state (the reduction identity) for
+  foreign streams, the cross-host fold of per-stream states is exact.
+* **Globally consistent snapshots.** The cut schedule is a property of the
+  SHARED ingest plan, never of wall clocks: hosts cut at agreed plan
+  positions (``FleetConfig.snapshot_every`` global batches when driving
+  through :meth:`FleetEngine.ingest`, or explicit
+  :meth:`FleetEngine.fleet_snapshot` calls at plan-defined boundaries).
+  Each cut is a barrier-on-batch-boundary: hosts enter a tiny fleet-mesh
+  ``all_gather`` carrying their cut cursor, verify EVERY host presented the
+  same cut (disagreement is a typed :class:`FleetBarrierError`), then write
+  their host piece (``<dir>/host_<pid>/``) with host-topology provenance
+  (num_hosts, process_id, host→stream homing, fleet_cut) and a cut marker.
+  A cut is CONSISTENT when every host's piece exists — restore picks the
+  newest such cut, so a host that died mid-cut degrades the fleet to the
+  previous consistent generation, never to a torn one.
+* **Restore matrix.** fleet → same-topology fleet: each host restores its
+  own piece verbatim (replay from the cut is exact); fleet → single-process:
+  :func:`restore_fleet_into` folds every host piece through
+  ``merge_stacked_states``; single-process → fleet:
+  :meth:`FleetEngine.adopt_single` embeds the snapshot into host 0 with
+  init state elsewhere. Every cross-topology mismatch (host counts, host
+  ids, cut indices) refuses LOUDLY with a typed error — a fleet piece is
+  PARTIAL state and must never silently serve as the whole.
+
+The CPU CI harness (two local processes over ``jax.distributed`` with gloo
+collectives, ``engine/fleet/harness.py``, ``make fleet-smoke``) proves the
+whole contract without an accelerator — with the honest caveat that CPU
+loopback sockets measure the protocol, not an interconnect.
+"""
+import os
+import re
+from dataclasses import dataclass, replace
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+__all__ = [
+    "FleetBarrierError",
+    "FleetConfig",
+    "FleetEngine",
+    "FleetHostLostError",
+    "FleetTopologyError",
+    "fleet_mesh",
+    "last_consistent_cut",
+    "restore_fleet_into",
+]
+
+_HOST_DIR_RE = re.compile(r"^host_(\d{3})$")
+_CUT_MARKER_RE = re.compile(r"^fleet_cut_(\d{6})$")
+
+
+class FleetTopologyError(MetricsTPUUserError):
+    """A fleet/host topology mismatch: wrong host count, wrong host id,
+    inconsistent cut indices, or a single-process snapshot where a fleet
+    piece was required (and vice versa)."""
+
+
+class FleetBarrierError(RuntimeError):
+    """Hosts entered a snapshot-cut barrier with DIFFERENT cut cursors —
+    the ingest plans have diverged; serving must not write a generation
+    that mixes two cuts."""
+
+
+class FleetHostLostError(RuntimeError):
+    """A fleet host was lost at a boundary (the non-transient ``host_loss``
+    fault, or a real peer failure surfaced by the runtime): the fleet's
+    steady state is host-local and intact, but cross-host boundaries cannot
+    complete — restore the fleet from the last consistent snapshot cut."""
+
+
+@dataclass
+class FleetConfig:
+    """Topology + per-host ingestion config for :class:`FleetEngine`.
+
+    Args:
+        num_processes: fleet size H. 1 (default) is the DEGENERATE fleet —
+            no ``jax.distributed`` init, a 1-device fleet mesh, every stream
+            homed locally. The degenerate fleet runs the identical boundary
+            programs (merge/barrier with world 1), which is what keeps the
+            fleet code path tier-1-testable in one process.
+        process_id: this host's id in ``[0, num_processes)``.
+        coordinator_address: ``host:port`` of process 0's coordinator
+            (required when ``num_processes > 1`` unless ``jax.distributed``
+            is already initialized by the launcher).
+        engine: the per-host ingestion :class:`~metrics_tpu.engine.pipeline.
+            EngineConfig`. A local mesh, if set, must be
+            ``mesh_sync="deferred"`` (the fleet steady state is
+            collective-free by contract); ``snapshot_dir``/``snapshot_every``
+            must be unset — fleet snapshots follow the CUT protocol below,
+            not a per-host cadence.
+        num_streams: serve S independent streams (one
+            ``MultiStreamEngine`` per host, stream ``sid`` homed on host
+            ``sid % num_processes``). None serves a single accumulation
+            (batches home by global plan position).
+        snapshot_dir: the FLEET snapshot directory (shared storage); host
+            pieces land under ``host_<pid>/``.
+        snapshot_every: cut cadence in GLOBAL plan batches for the
+            :meth:`FleetEngine.ingest` driver (0 = explicit
+            :meth:`FleetEngine.fleet_snapshot` calls only). Global-plan
+            cadence — never per-host counts, never wall clocks — is what
+            makes every host reach the same cut at the same plan position
+            deterministically.
+        fleet_axis: the fleet mesh axis name.
+    """
+
+    num_processes: int = 1
+    process_id: int = 0
+    coordinator_address: Optional[str] = None
+    engine: Any = None
+    num_streams: Optional[int] = None
+    snapshot_dir: Optional[str] = None
+    snapshot_every: int = 0
+    fleet_axis: str = "fleet"
+
+
+def _ensure_distributed(cfg: FleetConfig) -> None:
+    """Idempotent ``jax.distributed`` bring-up for a real (H > 1) fleet.
+
+    On CPU backends the gloo collectives implementation is selected first —
+    without it a multi-process CPU fleet initializes but every cross-host
+    collective aborts. Already-initialized runtimes (an external launcher,
+    a prior FleetEngine in this process) are left untouched.
+    """
+    import jax
+
+    from metrics_tpu.utils.compat import distributed_client
+
+    if cfg.num_processes <= 1:
+        return
+    # already-initialized probe WITHOUT touching a backend (the shared
+    # side-effect-free client-handle tell — utils/compat.py): process_count()
+    # and friends lazily initialize XLA, after which jax.distributed refuses
+    # to start. If the probe degrades (internals moved) we fall through to
+    # initialize(), whose own RuntimeError is still a clear message.
+    if distributed_client() is not None:
+        return  # launcher (or a previous fleet) already brought the runtime up
+    if cfg.coordinator_address is None:
+        raise FleetTopologyError(
+            "num_processes > 1 needs coordinator_address (process 0's "
+            "host:port) unless jax.distributed is already initialized"
+        )
+    if os.environ.get("JAX_PLATFORMS", "cpu").startswith("cpu"):
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:  # pragma: no cover - older jaxlibs lack the flag
+            pass
+    jax.distributed.initialize(
+        coordinator_address=cfg.coordinator_address,
+        num_processes=int(cfg.num_processes),
+        process_id=int(cfg.process_id),
+    )
+
+
+def fleet_mesh(num_hosts: int, axis: str = "fleet"):
+    """The (num_hosts,)-device fleet mesh: ONE representative device per
+    process. Boundary folds move whole accumulated states, not activations —
+    one device per host carries the host's merged state onto the wire, and
+    the remaining local devices stay dedicated to the steady-state step."""
+    import jax
+    from jax.sharding import Mesh
+
+    if num_hosts <= 0:
+        raise FleetTopologyError(f"num_hosts must be positive, got {num_hosts}")
+    if num_hosts == 1:
+        return Mesh(np.asarray(jax.devices()[:1]), (axis,))
+    devs = []
+    for p in range(num_hosts):
+        owned = [d for d in jax.devices() if d.process_index == p]
+        if not owned:
+            raise FleetTopologyError(
+                f"process {p} of {num_hosts} exposes no devices — is "
+                "jax.distributed initialized with the same num_processes?"
+            )
+        devs.append(owned[0])
+    return Mesh(np.asarray(devs), (axis,))
+
+
+def _host_dirs(fleet_dir: str) -> Dict[int, str]:
+    """``{process_id: host dir}`` under a fleet snapshot directory."""
+    try:
+        names = os.listdir(fleet_dir)
+    except (FileNotFoundError, NotADirectoryError):
+        return {}
+    out: Dict[int, str] = {}
+    for n in sorted(names):
+        m = _HOST_DIR_RE.match(n)
+        if m:
+            out[int(m.group(1))] = os.path.join(fleet_dir, n)
+    return out
+
+
+def _host_cuts(host_dir: str) -> Dict[int, str]:
+    """``{cut index: snapshot basename}`` from one host dir's cut markers
+    (markers referencing a GC'd or never-completed snapshot are skipped)."""
+    out: Dict[int, str] = {}
+    try:
+        names = os.listdir(host_dir)
+    except (FileNotFoundError, NotADirectoryError):
+        return out
+    for n in names:
+        m = _CUT_MARKER_RE.match(n)
+        if not m:
+            continue
+        try:
+            with open(os.path.join(host_dir, n)) as f:
+                snap = f.read().strip()
+        except OSError:
+            continue
+        if snap and os.path.exists(os.path.join(host_dir, snap)):
+            out[int(m.group(1))] = snap
+    return out
+
+
+def last_consistent_cut(fleet_dir: str, num_hosts: int) -> Optional[int]:
+    """The newest cut index EVERY host completed, or None.
+
+    A cut is consistent when all ``num_hosts`` host dirs carry its marker
+    AND the referenced snapshot still exists — a host that died between the
+    barrier and its save leaves the cut incomplete, and restore falls back
+    to the previous consistent generation (replay from its older cursor is
+    exact, same degradation contract as the snapshot generation ring).
+    Raises :class:`FleetTopologyError` when the directory was written by a
+    DIFFERENT host count: a 3-host fleet's pieces must never be read as a
+    2-host fleet's.
+    """
+    dirs = _host_dirs(fleet_dir)
+    if not dirs:
+        return None
+    if set(dirs) != set(range(num_hosts)):
+        raise FleetTopologyError(
+            f"fleet snapshot dir {fleet_dir!r} holds host pieces "
+            f"{sorted(dirs)} but this fleet has num_hosts={num_hosts} "
+            f"(expected exactly hosts 0..{num_hosts - 1}); restore it with a "
+            "same-size fleet, or merge it into a single-process engine with "
+            "restore_fleet_into()"
+        )
+    per_host = [set(_host_cuts(dirs[p])) for p in range(num_hosts)]
+    common = set.intersection(*per_host) if per_host else set()
+    return max(common) if common else None
+
+
+class FleetEngine:
+    """H-host SPMD serving of one metric/collection (ISSUE 15).
+
+    Construction initializes ``jax.distributed`` (idempotently), builds the
+    fleet mesh, and brings up this host's LOCAL engine — the per-host
+    ingestion pipeline. The steady state is purely host-local;
+    ``result()``/``results()``/``fleet_snapshot()``/``restore()`` are
+    COLLECTIVE boundaries every host must enter at the same logical point of
+    its ingest plan (the SPMD contract — there is no coordinator to order
+    them). See the module docstring for the full protocol.
+    """
+
+    def __init__(self, metric: Any, config: Optional[FleetConfig] = None, aot_cache: Any = None):
+        import jax
+
+        from metrics_tpu.engine.multistream import MultiStreamEngine
+        from metrics_tpu.engine.pipeline import EngineConfig, StreamingEngine
+
+        self._fcfg = replace(config) if config is not None else FleetConfig()
+        H, pid = int(self._fcfg.num_processes), int(self._fcfg.process_id)
+        if H <= 0:
+            raise FleetTopologyError(f"num_processes must be positive, got {H}")
+        if not 0 <= pid < H:
+            raise FleetTopologyError(
+                f"process_id must be in [0, {H}), got {pid}"
+            )
+        inner = self._fcfg.engine if self._fcfg.engine is not None else EngineConfig()
+        if not isinstance(inner, EngineConfig):
+            raise MetricsTPUUserError(
+                f"FleetConfig.engine must be an EngineConfig, got {type(inner).__name__}"
+            )
+        if inner.mesh is not None and inner.mesh_sync != "deferred":
+            raise MetricsTPUUserError(
+                "a fleet host's local mesh must run mesh_sync='deferred': the "
+                "fleet steady state is collective-free by contract, and a "
+                "step-sync local mesh would psum inside every step"
+            )
+        if inner.snapshot_dir or inner.snapshot_every:
+            raise MetricsTPUUserError(
+                "set FleetConfig.snapshot_dir/snapshot_every, not the inner "
+                "EngineConfig's: fleet snapshots follow the globally "
+                "consistent cut protocol (barrier-on-batch-boundary), not a "
+                "per-host cadence"
+            )
+        if int(self._fcfg.snapshot_every) > 0 and not self._fcfg.snapshot_dir:
+            raise MetricsTPUUserError(
+                "FleetConfig.snapshot_every > 0 requires snapshot_dir — the "
+                "first auto-cut would otherwise fail MID-PLAN, after real "
+                "serving work (same construction-time contract as "
+                "EngineConfig.snapshot_every)"
+            )
+        if inner.window is not None and getattr(inner.window, "kind", "cumulative") != "cumulative":
+            raise MetricsTPUUserError(
+                "windowed serving is not supported in a fleet yet: a pane "
+                "rotation is a per-host state-structure event with no "
+                "fleet-consistent cut — serve windows single-process, or "
+                "cumulative in the fleet"
+            )
+        _ensure_distributed(self._fcfg)
+        if H > 1:
+            live = int(jax.process_count())
+            if live != H:
+                raise FleetTopologyError(
+                    f"jax.distributed runtime has {live} processes but "
+                    f"FleetConfig says num_processes={H}"
+                )
+        self._H, self._pid = H, pid
+        self._axis = self._fcfg.fleet_axis
+        self._mesh = fleet_mesh(H, self._axis)
+        if H > 1:
+            mine = [
+                d for d in self._mesh.devices.flat
+                if d.process_index == jax.process_index()
+            ]
+            if len(mine) != 1:  # pragma: no cover - fleet_mesh guarantees one
+                raise FleetTopologyError(
+                    f"fleet mesh carries {len(mine)} devices for this process "
+                    "(expected exactly 1)"
+                )
+            self._fleet_device = mine[0]
+        else:
+            self._fleet_device = self._mesh.devices.flat[0]
+
+        S = self._fcfg.num_streams
+        if S is None:
+            self._engine = StreamingEngine(metric, inner, aot_cache=aot_cache)
+        else:
+            self._engine = MultiStreamEngine(
+                metric, int(S), inner, aot_cache=aot_cache
+            )
+        # stamp the host topology onto the local engine: every snapshot it
+        # writes now carries (num_hosts, process_id) provenance, and its
+        # restore path refuses cross-topology commits (pipeline.py)
+        self._engine._fleet_hosts = H
+        self._engine._fleet_pid = pid
+        st = self._engine.stats
+        st.fleet_hosts = H
+        st.fleet_process_id = pid
+        st.fleet_streams_owned = len(self.streams_owned)
+        self._metric = metric
+        self._global_cursor = 0
+        self._next_cut = 0
+        self._payload_split: Optional[Tuple[int, int]] = None
+        if self._fcfg.snapshot_dir:
+            self._host_dir = os.path.join(
+                self._fcfg.snapshot_dir, f"host_{pid:03d}"
+            )
+            # the local engine owns the piece writes; its config gets the
+            # host subdir (the fleet dir itself holds only host_*/)
+            self._engine._cfg.snapshot_dir = self._host_dir
+        else:
+            self._host_dir = None
+
+    # ------------------------------------------------------------------ topology
+
+    @property
+    def engine(self):
+        """The host-local ingestion engine (the audit/telemetry target)."""
+        return self._engine
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    @property
+    def num_hosts(self) -> int:
+        return self._H
+
+    @property
+    def process_id(self) -> int:
+        return self._pid
+
+    @property
+    def num_streams(self) -> Optional[int]:
+        return self._fcfg.num_streams
+
+    @property
+    def streams_owned(self) -> List[int]:
+        """Stream ids homed on THIS host (``sid % num_hosts == process_id``)."""
+        S = self._fcfg.num_streams
+        if S is None:
+            return []
+        return [sid for sid in range(int(S)) if sid % self._H == self._pid]
+
+    @property
+    def global_cursor(self) -> int:
+        """Plan position of the :meth:`ingest` driver (shared-plan batches
+        seen, owned or not) — the coordinate snapshot cuts are defined in."""
+        return self._global_cursor
+
+    def home(self, stream_id: int) -> int:
+        """The host that owns ``stream_id``."""
+        return int(stream_id) % self._H
+
+    # ------------------------------------------------------------------ lifecycle
+
+    def start(self) -> "FleetEngine":
+        self._engine.start()
+        return self
+
+    def stop(self) -> None:
+        self._engine.stop()
+
+    def __enter__(self) -> "FleetEngine":
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.stop()
+        return False
+
+    # -------------------------------------------------------------------- ingest
+
+    def submit(self, *args: Any, **kwargs: Any) -> None:
+        """Strict per-host submit: the batch must be homed HERE.
+
+        Multi-stream fleets take ``(stream_id, *batch)`` and refuse foreign
+        streams loudly (typed, naming the home host) — per-host ingestion
+        means a host's front-end only ever accepts its own tenants'
+        traffic. Single-metric fleets accept any batch (the caller owns the
+        split; :meth:`ingest` is the plan-driven alternative).
+        """
+        if self._fcfg.num_streams is not None:
+            sid = int(args[0])
+            if sid % self._H != self._pid:
+                raise FleetTopologyError(
+                    f"stream {sid} homes on host {sid % self._H} "
+                    f"(sid % num_hosts), not this host {self._pid}: route it "
+                    "to its home host's ingestion pipeline (or drive the "
+                    "shared plan through FleetEngine.ingest, which skips "
+                    "foreign batches)"
+                )
+        self._engine.submit(*args, **kwargs)
+
+    def ingest(self, *args: Any, **kwargs: Any) -> bool:
+        """Drive one batch of the SHARED global plan through this host.
+
+        Every host iterates the same deterministic plan and calls this for
+        every batch; the fleet submits the batch only when it is homed here
+        (stream home for multi-stream fleets, plan-position round-robin for
+        single-metric ones) and ALWAYS advances the global cursor — which is
+        what makes the automatic cut cadence (``snapshot_every`` global
+        batches) land every host on the same barrier at the same plan
+        position with no clock. Returns True when the batch was submitted
+        locally.
+        """
+        pos = self._global_cursor
+        if self._fcfg.num_streams is not None:
+            owned = int(args[0]) % self._H == self._pid
+        else:
+            owned = pos % self._H == self._pid
+        if owned:
+            self._engine.submit(*args, **kwargs)
+        self._engine.stats.record_fleet_ingest(owned)
+        self._global_cursor = pos + 1
+        every = int(self._fcfg.snapshot_every)
+        if every > 0 and self._global_cursor % every == 0:
+            self.fleet_snapshot()
+        return owned
+
+    def flush(self) -> None:
+        """Host-local flush (no collective): every locally submitted batch
+        folds into the host-local state."""
+        self._engine.flush()
+
+    def reset(self) -> None:
+        """Host-local fresh accumulation (compiled programs kept) + fresh
+        plan/cut cursors. NOT a collective — but a fleet whose hosts don't
+        all reset at the same plan point serves mixed epochs, so drivers
+        reset symmetrically like every other boundary."""
+        self._engine.reset()
+        self._global_cursor = 0
+        self._next_cut = 0
+
+    # ---------------------------------------------------------- fleet mesh programs
+
+    def _host_abstract(self) -> Any:
+        """This host's LOGICAL state template — what ``engine.state()``
+        returns: the merged-global-within-host tree under a local deferred
+        mesh, the (S, ...)-stream-stacked tree for multi-stream engines."""
+        eng = self._engine
+        if eng._deferred:
+            return eng._merged_abstract()
+        return eng._abstract_state_tree()
+
+    def _stacked_abstract(self) -> Any:
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sh = NamedSharding(self._mesh, P(self._axis))
+        return jax.tree.map(
+            lambda s: jax.ShapeDtypeStruct(
+                (self._H,) + tuple(s.shape), s.dtype, sharding=sh
+            ),
+            self._host_abstract(),
+        )
+
+    def _fleet_stack(self, host_tree: Any) -> Any:
+        """Lift this host's logical tree into the global ``(H, ...)``-leaved
+        fleet arrays: row ``pid`` lives on this host's fleet device, the
+        other rows on their owners' — the standard multi-host global-array
+        construction (each process contributes exactly its addressable
+        shard)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sh = NamedSharding(self._mesh, P(self._axis))
+        if self._H == 1:
+            return jax.tree.map(
+                lambda x: jax.device_put(jnp.asarray(x)[None], sh), host_tree
+            )
+
+        def one(x):
+            local = jax.device_put(jnp.asarray(x)[None], self._fleet_device)
+            return jax.make_array_from_single_device_arrays(
+                (self._H,) + tuple(np.shape(x)), sh, [local]
+            )
+
+        return jax.tree.map(one, host_tree)
+
+    def _stack_scalar(self, value: int):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sh = NamedSharding(self._mesh, P(self._axis))
+        row = jnp.asarray([int(value)], jnp.int32)
+        if self._H == 1:
+            return jax.device_put(row, sh)
+        local = jax.device_put(row, self._fleet_device)
+        return jax.make_array_from_single_device_arrays((self._H,), sh, [local])
+
+    def _merge_program(self):
+        """AOT: host-stacked logical states -> replicated GLOBAL state, one
+        ``fused_axis_sync`` bundle over the fleet axis (the existing
+        boundary-merge builder, pointed at the fleet mesh)."""
+        import jax
+
+        from metrics_tpu.parallel.embedded import sharded_state_merge
+
+        eng = self._engine
+        key = eng._aot.program_key(
+            "fleet_state_merge", eng._metric_fp,
+            arg_tree=self._stacked_abstract(), mesh=self._mesh, donate=False,
+            sync="fleet", precision=eng._precision_tag,
+        )
+
+        def build():
+            merge = sharded_state_merge(
+                self._metric, self._mesh, self._axis,
+                state_template=self._host_abstract(), unpack=None,
+            )
+            return jax.jit(merge).lower(self._stacked_abstract()).compile()
+
+        return eng._aot.get_or_compile(key, build)
+
+    def _result_program(self):
+        """AOT: host-stacked states -> replicated metric VALUES — the merge
+        and the compute fused into ONE SPMD program per boundary read (a
+        vmapped per-stream compute for multi-stream fleets)."""
+        import jax
+
+        from metrics_tpu.parallel.embedded import sharded_state_merge
+
+        eng = self._engine
+        multistream = self._fcfg.num_streams is not None
+        key = eng._aot.program_key(
+            f"fleet_result{'_all' if multistream else ''}+k.{eng._kernel_tag()}",
+            eng._metric_fp,
+            arg_tree=self._stacked_abstract(), mesh=self._mesh, donate=False,
+            sync="fleet", precision=eng._precision_tag,
+        )
+        metric = self._metric
+
+        def build():
+            merge = sharded_state_merge(
+                metric, self._mesh, self._axis,
+                state_template=self._host_abstract(), unpack=None,
+            )
+
+            def run(stacked):
+                merged = merge(stacked)
+                if multistream:
+                    return jax.vmap(metric.compute_from)(merged)
+                return metric.compute_from(merged)
+
+            with eng._kernel_scope():
+                return jax.jit(run).lower(self._stacked_abstract()).compile()
+
+        return eng._aot.get_or_compile(key, build)
+
+    def _barrier_program(self):
+        """AOT: the cut barrier — every host contributes its (1,) cut cursor,
+        an ``all_gather`` over the fleet axis returns all H cursors
+        replicated. The gather IS the rendezvous; the agreement check is
+        host-side."""
+        import jax
+        from jax import lax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        eng = self._engine
+        sh = NamedSharding(self._mesh, P(self._axis))
+        abs_in = jax.ShapeDtypeStruct((self._H,), np.int32, sharding=sh)
+        key = eng._aot.program_key(
+            "fleet_barrier", "fleet",
+            arg_tree=abs_in, mesh=self._mesh, donate=False, sync="fleet",
+        )
+
+        def build():
+            def body(x):
+                return lax.all_gather(x, self._axis, tiled=True)
+
+            fn = jax.shard_map(
+                body, mesh=self._mesh, in_specs=P(self._axis), out_specs=P(),
+                check_vma=False,
+            )
+            return jax.jit(fn).lower(abs_in).compile()
+
+        return eng._aot.get_or_compile(key, build)
+
+    def _fleet_payload_split(self) -> Tuple[int, int]:
+        """(exact, quantized) bytes one host contributes per fleet fold —
+        the same analytic accounting as the deferred boundary merge
+        (``fused_sync_plan``), over the HOST-stacked leaf shapes (a
+        multi-stream host syncs (S, ...)-stacked leaves, so the payload
+        scales by S exactly like the unsharded multistream merge's)."""
+        if self._payload_split is None:
+            # the engine's own accounting formula at world = the host count:
+            # _payload_leaf_info keeps fx <-> leaf pairing and multistream
+            # S-scaling correct, and sharing _payload_split_for means the
+            # split convention can never diverge from the mesh surface's
+            self._payload_split = self._engine._payload_split_for(self._H)
+        return self._payload_split
+
+    # ------------------------------------------------------------------ boundaries
+
+    def _boundary_collective(self, program, args: Tuple, site: str = "host_loss"):
+        """Run one fleet-mesh collective with the fault-site/retry contract:
+        ``site`` is consulted BEFORE the dispatch (a transient retries the
+        whole collective cleanly — on a degenerate or symmetric-planned
+        fleet every host retries in lockstep), and a non-transient
+        ``host_loss`` surfaces as the typed :class:`FleetHostLostError`."""
+        import time as _time
+
+        import jax
+
+        from metrics_tpu.engine.faults import InjectedFault
+
+        eng = self._engine
+
+        def once():
+            eng._fault(site)
+            t0 = _time.perf_counter()
+            out = program(*args)
+            jax.block_until_ready(out)
+            return out, (_time.perf_counter() - t0) * 1e6
+
+        try:
+            return eng._retry_transient(once)
+        except InjectedFault as e:
+            if e.site == "host_loss" and not e.transient:
+                raise FleetHostLostError(
+                    f"host lost at a fleet boundary (process {self._pid} of "
+                    f"{self._H}): the host-local steady state is intact; "
+                    "restore the fleet from the last consistent snapshot cut"
+                ) from e
+            raise
+
+    def fleet_state(self) -> Any:
+        """The replicated GLOBAL logical state: flush, then one fleet-mesh
+        fold of every host's local state. A collective boundary — every
+        host must call."""
+        self._engine.flush()
+        host_tree = self._engine.state()
+        out, us = self._boundary_collective(
+            self._merge_program(), (self._fleet_stack(host_tree),)
+        )
+        self._engine.stats.record_fleet_merge(us, *self._fleet_payload_split())
+        return out
+
+    def _boundary_values(self) -> Any:
+        self._engine.flush()
+        host_tree = self._engine.state()
+        vals, us = self._boundary_collective(
+            self._result_program(), (self._fleet_stack(host_tree),)
+        )
+        st = self._engine.stats
+        st.record_fleet_merge(us, *self._fleet_payload_split())
+        tr = self._engine.trace
+        if tr is not None:
+            from metrics_tpu.engine.trace import ENGINE_TRACE
+
+            tr.complete("fleet_merge", trace=ENGINE_TRACE, dur_us=us)
+        return vals
+
+    def result(self, stream_id: Optional[int] = None) -> Any:
+        """The globally folded metric value (all hosts' contributions), on
+        ANY host — one fleet-mesh collective, no coordinator round-trip.
+        A collective boundary: every host calls at the same plan point.
+        Multi-stream fleets pass ``stream_id``; the fold moves the whole
+        stacked state either way (one bundle, however many streams), so
+        prefer :meth:`results` when reading many."""
+        import jax
+
+        vals = self._boundary_values()
+        if self._fcfg.num_streams is None:
+            if stream_id is not None:
+                raise MetricsTPUUserError(
+                    "stream_id is only valid for multi-stream fleets "
+                    "(FleetConfig.num_streams)"
+                )
+            return vals
+        if stream_id is None:
+            raise MetricsTPUUserError(
+                "a multi-stream fleet's result() needs a stream_id "
+                "(or use results() for every stream)"
+            )
+        sid = int(stream_id)
+        S = int(self._fcfg.num_streams)
+        if not 0 <= sid < S:
+            raise MetricsTPUUserError(f"stream_id {sid} out of range [0, {S})")
+        return jax.tree.map(lambda x: x[sid], vals)
+
+    def results(self) -> Dict[int, Any]:
+        """Every stream's globally folded value — ONE fleet collective and
+        one batched compute for any S, sliced host-side."""
+        import jax
+
+        if self._fcfg.num_streams is None:
+            raise MetricsTPUUserError(
+                "results() is the multi-stream surface; single-metric fleets "
+                "read result()"
+            )
+        vals = jax.device_get(self._boundary_values())
+        S = int(self._fcfg.num_streams)
+        return {
+            sid: jax.tree.map(lambda x: x[sid], vals) for sid in range(S)
+        }
+
+    # ------------------------------------------------------------------- snapshots
+
+    def _barrier(self, cut: int) -> None:
+        out, _us = self._boundary_collective(
+            self._barrier_program(), (self._stack_scalar(cut),),
+            site="fleet_barrier",
+        )
+        import jax
+
+        np_out = np.asarray(jax.device_get(out))
+        if not bool(np.all(np_out == int(cut))):
+            raise FleetBarrierError(
+                f"hosts disagree on the snapshot cut cursor: this host "
+                f"presented cut {int(cut)} but the barrier gathered "
+                f"{np_out.tolist()} — the ingest plans have diverged; no "
+                "generation was written"
+            )
+        self._engine.stats.record_fleet_barrier()
+
+    def fleet_snapshot(self, cut: Optional[int] = None) -> str:
+        """Write this host's piece of globally consistent cut ``cut``
+        (default: the next cut index).
+
+        Protocol, in order: local flush (the cut lands on a batch boundary
+        by construction), the cut BARRIER (all hosts gather their cut
+        cursors over the fleet mesh and must agree — no wall clock
+        anywhere), the host piece (the local engine's crash-safe snapshot,
+        stamped with host topology + cut provenance), then the cut marker.
+        The cut becomes fleet-consistent only once EVERY host's marker
+        lands; a host dying anywhere in between leaves the previous
+        consistent cut authoritative. A collective boundary — every host
+        calls with the same cut at the same plan position.
+        """
+        if not self._host_dir:
+            raise MetricsTPUUserError(
+                "fleet_snapshot() requires FleetConfig.snapshot_dir"
+            )
+        k = self._next_cut if cut is None else int(cut)
+        if k < 0:
+            raise MetricsTPUUserError(f"cut must be >= 0, got {k}")
+        self._engine.flush()
+        self._barrier(k)
+        eng = self._engine
+        eng._fleet_cut = k
+        eng._fleet_plan_cursor = self._global_cursor
+        try:
+            path = eng.snapshot()
+        finally:
+            eng._fleet_cut = None
+        os.makedirs(self._host_dir, exist_ok=True)
+        marker = os.path.join(self._host_dir, f"fleet_cut_{k:06d}")
+        tmp = marker + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(os.path.basename(path))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, marker)
+        self._next_cut = k + 1
+        eng.stats.record_fleet_cut()
+        return path
+
+    def restore(self) -> Dict[str, Any]:
+        """Resume this host from the last CONSISTENT fleet cut.
+
+        Every host scans the shared fleet dir (a pure function of the same
+        bytes, so every host derives the same cut), agrees on it through the
+        barrier, and restores its OWN piece verbatim — replay each host's
+        remaining plan from ``meta['fleet_plan_cursor']`` and the fleet's
+        results are exactly the uninterrupted ones. Typed refusals for
+        host-count/host-id/cut mismatches come from the restore matrix
+        (``pipeline.py::_restore_commit`` + the checks here).
+        """
+        if not self._fcfg.snapshot_dir:
+            raise MetricsTPUUserError("restore() requires FleetConfig.snapshot_dir")
+        k = last_consistent_cut(self._fcfg.snapshot_dir, self._H)
+        if k is None:
+            raise FileNotFoundError(
+                f"no consistent fleet snapshot cut under {self._fcfg.snapshot_dir!r}"
+            )
+        self._barrier(k)
+        name = _host_cuts(self._host_dir).get(k)
+        if name is None:  # pragma: no cover - consistency scan guarantees it
+            raise FleetTopologyError(
+                f"host {self._pid} has no piece for consistent cut {k}"
+            )
+        meta = self._engine.restore(os.path.join(self._host_dir, name))
+        snap_cut = int(meta.get("fleet_cut", -1))
+        if snap_cut != k:
+            raise FleetTopologyError(
+                f"host {self._pid}'s piece for cut {k} carries fleet_cut="
+                f"{snap_cut} — the marker and the snapshot disagree; the "
+                "fleet dir is torn"
+            )
+        self._global_cursor = int(meta.get("fleet_plan_cursor", 0))
+        self._next_cut = k + 1
+        return meta
+
+    def adopt_single(self, path_or_dir: str) -> Dict[str, Any]:
+        """Embed a SINGLE-PROCESS snapshot into this fleet: host 0 adopts
+        the accumulated state (and its replay cursor), every other host
+        resets to init — the cross-host fold then reproduces the adopted
+        value exactly (init rows are reduction identities). The single →
+        fleet entry of the restore matrix; a fleet host piece refuses here
+        (restore it through :meth:`restore`). Every host calls."""
+        from metrics_tpu.engine.snapshot import load_snapshot
+
+        state, meta = load_snapshot(path_or_dir, fallback=True)
+        snap_hosts = int(meta.get("num_hosts", 1) or 1)
+        if snap_hosts != 1:
+            raise FleetTopologyError(
+                f"adopt_single() takes a single-process snapshot; this one is "
+                f"host {meta.get('process_id')} of a {snap_hosts}-host fleet — "
+                "restore fleet pieces through FleetEngine.restore()"
+            )
+        if self._pid == 0:
+            patched = dict(meta)
+            patched["num_hosts"] = self._H
+            patched["process_id"] = 0
+            self._engine._restore_commit(state, patched)
+        else:
+            self._engine.reset()
+        self._global_cursor = 0
+        self._next_cut = 0
+        return meta
+
+    # ------------------------------------------------------------------- telemetry
+
+    def telemetry(self) -> Dict[str, Any]:
+        """The local engine's telemetry document; its summary carries the
+        ``fleet`` block (host id, streams owned, barrier/cut/merge counts,
+        per-fold sync payload bytes)."""
+        return self._engine.telemetry()
+
+    def export_telemetry(self, path: str) -> None:
+        self._engine.export_telemetry(path)
+
+    def metrics_text(self) -> str:
+        """OpenMetrics: the local engine's exposition PLUS the
+        ``host``-labeled fleet families. Single-process engines never emit
+        a ``fleet_*`` family, so their expositions stay byte-stable."""
+        from metrics_tpu.engine.trace import render_openmetrics
+
+        base = self._engine.metrics_text()
+        st = self._engine.stats
+        h = str(self._pid)
+        labeled = {
+            "fleet_ingested": ("host", {h: st.fleet_ingested}),
+            "fleet_skipped": ("host", {h: st.fleet_skipped}),
+            "fleet_merges": ("host", {h: st.fleet_merges}),
+            "fleet_barriers": ("host", {h: st.fleet_barriers}),
+            "fleet_snapshot_cuts": ("host", {h: st.fleet_cuts}),
+            "fleet_sync_payload_bytes": (
+                "host",
+                {h: st.fleet_payload_exact_bytes + st.fleet_payload_quant_bytes},
+            ),
+        }
+        gauges = {
+            "fleet_num_hosts": self._H,
+            "fleet_process_id": self._pid,
+            "fleet_streams_owned": st.fleet_streams_owned,
+        }
+        fleet_text = render_openmetrics({}, (), labeled_counters=labeled, gauges=gauges)
+        # one exposition: the base's EOF terminator moves to the end
+        assert base.endswith("# EOF\n")
+        return base[: -len("# EOF\n")] + fleet_text
+
+
+def restore_fleet_into(engine: Any, fleet_dir: str) -> Dict[str, Any]:
+    """Merge a whole fleet snapshot into ONE single-process engine — the
+    fleet → single-process entry of the restore matrix.
+
+    Loads every host's piece at the last consistent cut, folds them with
+    ``merge_stacked_states`` (host states stack on a leading axis; each
+    state's own reduction folds it — exact for every
+    ``dist_reduce_fx``-mergeable state), and commits through the engine's
+    own restore path. The merged engine's ``result()`` equals the fleet's
+    at the cut; REPLAY, however, needs the fleet's per-host plans — the
+    returned meta's ``batches_done`` is the SUM of host cursors and is not
+    a single-stream replay cursor (documented, and the reason fleet →
+    fleet restore is the kill/resume path).
+
+    Typed refusals: a target that is itself fleet-managed, host pieces from
+    a mismatched host count (:func:`last_consistent_cut`), pieces whose
+    metas disagree with their directory, and metrics whose states cannot
+    stack-merge.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu.engine.snapshot import load_snapshot
+
+    if getattr(engine, "_fleet_hosts", 1) != 1:
+        raise FleetTopologyError(
+            "restore_fleet_into() targets a SINGLE-PROCESS engine; this one "
+            f"is host {engine._fleet_pid} of {engine._fleet_hosts} — use "
+            "FleetEngine.restore()"
+        )
+    dirs = _host_dirs(fleet_dir)
+    if not dirs:
+        raise FileNotFoundError(f"no host pieces under {fleet_dir!r}")
+    H = len(dirs)
+    k = last_consistent_cut(fleet_dir, H)
+    if k is None:
+        raise FileNotFoundError(
+            f"no consistent fleet snapshot cut under {fleet_dir!r}"
+        )
+    metric = engine._metric
+    reason_fn = getattr(metric, "stacked_merge_unsupported_reason", None)
+    reason = reason_fn() if reason_fn is not None else None
+    if reason is not None:
+        raise MetricsTPUUserError(
+            f"fleet snapshot cannot merge into a single engine: {reason}"
+        )
+    logicals: List[Any] = []
+    metas: List[Dict[str, Any]] = []
+    for pid in range(H):
+        name = _host_cuts(dirs[pid])[k]
+        state, meta = load_snapshot(os.path.join(dirs[pid], name))
+        if int(meta.get("num_hosts", 1) or 1) != H or int(meta.get("process_id", 0) or 0) != pid:
+            raise FleetTopologyError(
+                f"piece under host_{pid:03d} claims num_hosts="
+                f"{meta.get('num_hosts')} process_id={meta.get('process_id')} "
+                "— the fleet dir is inconsistent with its pieces"
+            )
+        if int(meta.get("fleet_cut", -1)) != k:
+            raise FleetTopologyError(
+                f"host {pid}'s piece for cut {k} carries fleet_cut="
+                f"{meta.get('fleet_cut')} — marker and snapshot disagree"
+            )
+        if str(meta.get("codec", "") or ""):
+            from metrics_tpu.engine.quantize import decode_state_tree
+
+            state = decode_state_tree(state)
+        packed = bool(int(meta.get("packed", 0)))
+        snap_deferred = str(meta.get("mesh_sync", "") or "") == "deferred"
+        snap_world = int(meta.get("world", 1))
+        if packed:
+            if engine._layout is None:
+                raise MetricsTPUUserError(
+                    "fleet piece holds a packed arena but the target engine "
+                    "runs use_arena=False"
+                )
+            saved_fp = str(meta.get("arena_fp", "") or "")
+            if saved_fp and saved_fp != engine._layout.fingerprint():
+                raise MetricsTPUUserError(
+                    f"host {pid}'s arena layout does not match the target "
+                    "metric's — was the metric reconfigured since the snapshot?"
+                )
+        if snap_deferred:
+            stacked_local = (
+                engine._layout.unpack_stacked(state) if packed else state
+            )
+            logical = metric.merge_stacked_states(stacked_local)
+        else:
+            logical = engine._unpack(state) if packed else state
+        logicals.append(jax.tree.map(jnp.asarray, logical))
+        metas.append(meta)
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *logicals)
+    merged = metric.merge_stacked_states(stacked)
+    out_meta = dict(metas[0])
+    out_meta.update(
+        num_hosts=1,
+        process_id=0,
+        packed=0,
+        mesh_sync="single",
+        world=1,
+        codec="",
+        arena_fp="",
+        step=sum(int(m.get("step", 0)) for m in metas),
+        batches_done=sum(int(m.get("batches_done", 0)) for m in metas),
+        rows_in=sum(int(m.get("rows_in", 0)) for m in metas),
+        rows_padded=sum(int(m.get("rows_padded", 0)) for m in metas),
+        fleet_cut=k,
+        merged_from_hosts=H,
+    )
+    engine._restore_commit(merged, out_meta)
+    return out_meta

@@ -28,7 +28,9 @@ CPU device (``make obs-smoke``):
    ``reshard_snapshot``/``reshard_restore`` under a manual ``reshard()`` —
    plus the ISSUE 13 windowed sites: a ``pane_rotate`` plan transient on a
    sliding ring AND on an ewma decay, and a ``drift_eval`` transient on the
-   closing-pane read) runs TWICE into fresh recorders; the canonical span sequences
+   closing-pane read — plus the ISSUE 15 fleet boundary sites:
+   ``fleet_barrier`` on a degenerate 1-host fleet's snapshot cut and
+   ``host_loss`` on its first cross-host fold) runs TWICE into fresh recorders; the canonical span sequences
    (timestamps excluded) must be IDENTICAL, and both chaos results
    bit-identical to each other. This is the occurrence-determinism
    contract: a chaos trace replays exactly.
@@ -91,6 +93,7 @@ def main(
         make_checker,
         quant_engine_config,
         resume_engine_config,
+        run_fleet_phase,
         stream_shard_engine_config,
         stream_shard_traffic,
         windowed_engine_config,
@@ -261,10 +264,19 @@ def main(
                 em.submit(p)
                 em.flush()
             em.result()
+        # fleet boundary transients (ISSUE 15): a degenerate 1-host fleet's
+        # snapshot-cut barrier and first cross-host fold both fail
+        # transiently — fleet_barrier/host_loss join the canonical span
+        # sequence; every boundary is an explicit scripted call, so the
+        # occurrence indices are producer-timing-independent by construction
+        fleet_inj = injs["fleet"]
+        run_fleet_phase(
+            fleet_inj, tempfile.mkdtemp(prefix="metrics_tpu_obs_fleet_"), trace=rec
+        )
         sites = (
             set(inj.fired) | set(read_inj.fired) | set(merge_inj.fired)
             | set(page_inj.fired) | set(quant_inj.fired) | set(elastic_inj.fired)
-            | set(win_inj.fired) | set(ewma_inj.fired)
+            | set(win_inj.fired) | set(ewma_inj.fired) | set(fleet_inj.fired)
         )
         return rec, got, sites
 

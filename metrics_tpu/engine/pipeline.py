@@ -564,6 +564,16 @@ class StreamingEngine:
         self._error: Optional[BaseException] = None
         self._step = 0
         self._batches_done = 0
+        # host-topology provenance (ISSUE 15): a fleet-managed engine is one
+        # HOST of an H-process SPMD fleet (engine/fleet/) — the FleetEngine
+        # stamps these so every snapshot carries (num_hosts, process_id) and
+        # the restore matrix can refuse cross-topology commits loudly. The
+        # defaults (1, 0) ARE the single-process topology, so pre-fleet
+        # snapshots (no host fields in meta) restore unchanged.
+        self._fleet_hosts = 1
+        self._fleet_pid = 0
+        self._fleet_cut: Optional[int] = None  # stamped per fleet snapshot cut
+        self._fleet_plan_cursor = 0  # global-plan position at the stamped cut
         # the layout always describes ONE pane's packing (kind tree): ring
         # windows stack (panes, n) buffers of these rows, and the per-row
         # plan is what pack_stacked/unpack_stacked apply slot-wise
@@ -1247,6 +1257,25 @@ class StreamingEngine:
         info_fn = getattr(self._metric, "sync_leaf_info", None)
         return info_fn() if info_fn is not None else None
 
+    def _payload_split_for(self, world: int) -> Tuple[int, int]:
+        """(exact_bytes, quantized_bytes) one participant contributes to a
+        fused sync of this engine's carried state over a ``world``-wide axis
+        — THE payload-accounting formula, shared by the per-engine memoized
+        :meth:`_sync_payload_split` (world = the mesh) and the fleet's
+        boundary accounting (world = the host count), so the split
+        convention can never diverge between the two surfaces."""
+        info = self._payload_leaf_info()
+        if not info:
+            return (0, 0)
+        from metrics_tpu.parallel.collectives import (
+            fused_sync_plan,
+            sync_payload_bytes,
+        )
+
+        total = sync_payload_bytes(info, world)
+        quant = 4 * fused_sync_plan(info, world)["q8_words"]
+        return (total - quant, quant)
+
     def _sync_payload_split(self) -> Tuple[int, int]:
         """(exact_bytes, quantized_bytes) one fused sync moves per shard
         under the configured policy — the analytic accounting from
@@ -1254,18 +1283,7 @@ class StreamingEngine:
         signature is static per engine). Feeds the OpenMetrics
         ``sync_payload_bytes{kind=...}`` counters."""
         if self._payload_split is None:
-            info = self._payload_leaf_info()
-            if not info:
-                self._payload_split = (0, 0)
-            else:
-                from metrics_tpu.parallel.collectives import (
-                    fused_sync_plan,
-                    sync_payload_bytes,
-                )
-
-                total = sync_payload_bytes(info, self._world)
-                quant = 4 * fused_sync_plan(info, self._world)["q8_words"]
-                self._payload_split = (total - quant, quant)
+            self._payload_split = self._payload_split_for(self._world)
         return self._payload_split
 
     def _merged_state(self) -> Any:
@@ -1864,7 +1882,19 @@ class StreamingEngine:
             "arena_fp": self._layout.fingerprint() if self._layout is not None else "",
             "mesh_sync": self._sync_tag() if self._cfg.mesh is not None else "single",
             "world": self._world if self._deferred else 1,
+            # host topology rides ALONGSIDE the world/shard provenance: a
+            # fleet host's piece names which host of how many wrote it (and
+            # the homing rule streams follow), so the restore matrix can
+            # route it — absent fields on pre-fleet snapshots read back as
+            # the single-host defaults
+            "num_hosts": self._fleet_hosts,
+            "process_id": self._fleet_pid,
         }
+        if self._fleet_hosts > 1:
+            meta["host_homing"] = "sid_mod_num_hosts"
+        if self._fleet_cut is not None:
+            meta["fleet_cut"] = int(self._fleet_cut)
+            meta["fleet_plan_cursor"] = int(self._fleet_plan_cursor)
         if self._compress:
             from metrics_tpu.engine.quantize import CODEC_ID
 
@@ -1997,7 +2027,26 @@ class StreamingEngine:
 
             state = self._retry_transient(decode_once)
         # VALIDATE before mutating anything: a failed restore must leave the
-        # live engine (metric attrs, fingerprint, memo, state) untouched
+        # live engine (metric attrs, fingerprint, memo, state) untouched.
+        # Host topology first (ISSUE 15): a fleet host's piece is PARTIAL
+        # state (one host's local accumulation) — committing it verbatim into
+        # an engine with a different host topology would silently serve a
+        # fraction of the traffic as if it were all of it. Missing fields
+        # default to single-host, so every pre-fleet snapshot restores
+        # unchanged.
+        snap_hosts = int(meta.get("num_hosts", 1) or 1)
+        snap_pid = int(meta.get("process_id", 0) or 0)
+        if snap_hosts != self._fleet_hosts or snap_pid != self._fleet_pid:
+            raise MetricsTPUUserError(
+                f"snapshot host topology (num_hosts={snap_hosts}, "
+                f"process_id={snap_pid}) does not match this engine's "
+                f"(num_hosts={self._fleet_hosts}, process_id={self._fleet_pid}): "
+                "a fleet host piece restores only into the SAME host of a "
+                "same-size fleet — merge a whole fleet snapshot into a "
+                "single-process engine with engine.fleet.restore_fleet_into(), "
+                "or adopt a single-process snapshot into a fleet with "
+                "FleetEngine.adopt_single()"
+            )
         packed = bool(int(meta.get("packed", 0)))
         snap_deferred = str(meta.get("mesh_sync", "") or "") == "deferred"
         snap_world = int(meta.get("world", 1))

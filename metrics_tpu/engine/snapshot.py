@@ -81,6 +81,23 @@ __all__ = [
 _LATEST = "LATEST"
 
 
+def _use_orbax() -> bool:
+    """Whether saves go through orbax. Single-process only: orbax's
+    checkpointer embeds its OWN cross-process barriers (``sync_global_
+    processes`` keyed by the target path), and a fleet host's snapshot is a
+    PER-HOST file — each host writes a different path at its own step count,
+    so the embedded barrier would deadlock/assert across the fleet (ISSUE
+    15). Under ``jax.distributed`` the pickle codec writes the piece instead
+    — same payload tree, same integrity sidecar, loadable anywhere (the
+    loader has always dispatched on dir-vs-file, so mixed codecs in one
+    generation ring restore fine)."""
+    if not _ORBAX_AVAILABLE:  # pragma: no cover - orbax is baked in here
+        return False
+    from metrics_tpu.utils.compat import distributed_client
+
+    return distributed_client() is None
+
+
 def _integrity_path(path: str) -> str:
     """Checksum sidecar for a snapshot: ``integrity_<name>.json`` next to it
     (NOT ``snap_``-prefixed — directory listings of snapshots must never
@@ -213,12 +230,12 @@ def save_snapshot(
     if host_attrs:
         payload["host_attrs"] = _host_attrs_to_bytes(host_attrs)
     path = os.path.join(directory, name)
-    if _ORBAX_AVAILABLE:
+    if _use_orbax():
         import orbax.checkpoint as ocp
 
         with ocp.PyTreeCheckpointer() as ckptr:
             ckptr.save(os.path.abspath(path), payload, force=True)
-    else:  # pragma: no cover - orbax is baked into this container
+    else:
         with open(path, "wb") as f:
             pickle.dump(payload, f)
     # integrity sidecar AFTER the payload, BEFORE the pointer: a kill between

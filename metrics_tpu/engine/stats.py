@@ -142,6 +142,28 @@ class EngineStats:
         self.pane_cursor = 0
         self.drift_evals = 0
         self.drift_alarms = 0
+        # fleet serving (ISSUE 15): host-topology gauges + per-host boundary
+        # counters. fleet_hosts None = not fleet-managed (every pre-fleet
+        # telemetry document stays byte-stable). Counters move on the fleet
+        # caller's thread only (ingest/result/snapshot are per-host
+        # single-threaded boundaries), but ride the counter lock anyway —
+        # the lock cost is one boundary op, not a hot-path step.
+        self.fleet_hosts: Optional[int] = None
+        self.fleet_process_id = 0
+        self.fleet_streams_owned = 0
+        self.fleet_ingested = 0   # plan batches homed here and submitted
+        self.fleet_skipped = 0    # plan batches homed on another host
+        self.fleet_merges = 0     # cross-host boundary folds (result/results)
+        self.fleet_merge_us_total = 0.0
+        self.fleet_barriers = 0   # snapshot-cut barrier entries
+        self.fleet_cuts = 0       # globally consistent snapshot cuts written
+        # the CROSS-HOST fold's own payload accounting — deliberately NOT
+        # the shared sync_payload_* counters: a fleet host with a local
+        # deferred mesh also pays a host-local boundary merge per fold
+        # (recorded there), and summing the two surfaces would double-count
+        # what actually crossed hosts
+        self.fleet_payload_exact_bytes = 0
+        self.fleet_payload_quant_bytes = 0
 
     def record_admission(self, outcome: str, priority: int) -> None:
         """One admission verdict (``"admitted"``/``"rejected"``/``"shed"``)
@@ -239,6 +261,61 @@ class EngineStats:
                 "alarms": self.drift_alarms,
             }
         return out
+
+    def record_fleet_ingest(self, owned: bool) -> None:
+        """One plan batch seen by the fleet ingest path: ``owned`` batches
+        were homed here (and submitted), the rest belong to another host."""
+        with self._counter_lock:
+            if owned:
+                self.fleet_ingested += 1
+            else:
+                self.fleet_skipped += 1
+
+    def record_fleet_merge(
+        self, merge_us: float, exact_bytes: int = 0, quant_bytes: int = 0
+    ) -> None:
+        """One cross-host boundary fold (the fleet ``result()``/``results()``
+        collective), with the bytes THIS host contributed to it."""
+        with self._counter_lock:
+            self.fleet_merges += 1
+            self.fleet_merge_us_total += float(merge_us)
+            self.fleet_payload_exact_bytes += int(exact_bytes)
+            self.fleet_payload_quant_bytes += int(quant_bytes)
+
+    def record_fleet_barrier(self) -> None:
+        """One snapshot-cut barrier entered (and agreed) by this host."""
+        with self._counter_lock:
+            self.fleet_barriers += 1
+
+    def record_fleet_cut(self) -> None:
+        """One globally consistent snapshot cut written by this host."""
+        with self._counter_lock:
+            self.fleet_cuts += 1
+
+    def fleet_summary(self) -> Optional[Dict[str, Any]]:
+        """The fleet block for :meth:`summary` — None unless the engine is
+        fleet-managed (``FleetEngine`` set ``fleet_hosts``), so every
+        single-process telemetry document stays byte-stable."""
+        if self.fleet_hosts is None:
+            return None
+        return {
+            "num_hosts": int(self.fleet_hosts),
+            "process_id": int(self.fleet_process_id),
+            "streams_owned": int(self.fleet_streams_owned),
+            "ingested": self.fleet_ingested,
+            "skipped": self.fleet_skipped,
+            "merges": self.fleet_merges,
+            "merge_us_total": self.fleet_merge_us_total,
+            "barriers": self.fleet_barriers,
+            "cuts": self.fleet_cuts,
+            # the cross-host fold's OWN bytes (lifetime totals) — host-local
+            # mesh merges keep the ordinary sync_payload counters, so the
+            # two surfaces never double-count one boundary
+            "sync_payload_bytes": {
+                "exact": self.fleet_payload_exact_bytes,
+                "quantized": self.fleet_payload_quant_bytes,
+            },
+        }
 
     def reshard_summary(self) -> Optional[Dict[str, Any]]:
         """The elastic-reshard block — None until the engine resharded."""
@@ -423,6 +500,9 @@ class EngineStats:
         reshard = self.reshard_summary()
         if reshard is not None:
             out["reshard"] = reshard
+        fleet = self.fleet_summary()
+        if fleet is not None:
+            out["fleet"] = fleet
         faults = self.fault_summary()
         if faults is not None:
             out["faults"] = faults

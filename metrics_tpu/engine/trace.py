@@ -168,8 +168,12 @@ class FixedBucketHistogram:
         padded = np.full(n_pad, length, np.int32)
         padded[: idx.size] = idx
         # the fold is HOST work: pin it to the CPU backend so a metrics
-        # scrape never launches device ops interleaved with serving steps
-        with jax.default_device(jax.devices("cpu")[0]):
+        # scrape never launches device ops interleaved with serving steps.
+        # LOCAL devices only — under jax.distributed (ISSUE 15's fleet)
+        # jax.devices() is the GLOBAL list whose first entry belongs to
+        # process 0, and a scrape on any other host would try to fold onto
+        # a non-addressable device
+        with jax.default_device(jax.local_devices(backend="cpu")[0]):
             counts = np.asarray(histogram_accumulate(padded, length=length))
         with self._lock:
             self._counts += counts

@@ -13,7 +13,30 @@ use (``metrics_tpu/__init__.py`` does it first thing), and is idempotent.
 """
 import jax
 
-__all__ = ["install_enable_x64_polyfill", "install_shard_map_polyfill"]
+__all__ = [
+    "distributed_client",
+    "install_enable_x64_polyfill",
+    "install_shard_map_polyfill",
+]
+
+
+def distributed_client():
+    """The live ``jax.distributed`` client handle, or None.
+
+    THE side-effect-free "is the multi-process runtime up" probe (ISSUE 15):
+    ``jax.process_count()`` and friends lazily initialize an XLA backend,
+    after which ``jax.distributed.initialize`` refuses to run — the internal
+    client handle is the only tell that touches nothing. The private-API
+    knowledge lives HERE once (``engine/snapshot.py`` and
+    ``engine/fleet/runtime.py`` both consult it); if the internals move,
+    every caller degrades to the single-process answer instead of crashing.
+    """
+    try:
+        from jax._src import distributed as _jdist
+
+        return getattr(_jdist.global_state, "client", None)
+    except Exception:  # pragma: no cover - internals moved; assume single-proc
+        return None
 
 
 def install_shard_map_polyfill() -> None:

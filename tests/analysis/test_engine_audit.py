@@ -190,6 +190,46 @@ def test_post_reshard_engine_audits_clean_and_catches_a_smuggled_collective():
     assert all("psum" in f.path for f in report.findings)
 
 
+def test_fleet_host_engine_audits_clean_and_catches_a_smuggled_collective():
+    """ISSUE 15: the bootstrap matrix's fleet entry audits the HOST engine
+    of a degenerate 1-host FleetEngine — its local deferred mesh makes the
+    steady step the real collective-free shard-local program (the fleet
+    axis appears only in the boundary fold). A psum smuggled into the
+    fleet host's traced update must fire
+    ``no-collectives-in-deferred-step`` — the broken-fixture proof that the
+    fleet steady state is pinned structurally, not just benched."""
+    from metrics_tpu.engine import FleetConfig, FleetEngine
+
+    fleet = FleetEngine(
+        Accuracy(),
+        FleetConfig(
+            num_streams=2,
+            engine=EngineConfig(buckets=(8,), mesh=_mesh1(), axis="dp", mesh_sync="deferred"),
+        ),
+    )
+    rng = np.random.RandomState(0)
+    with fleet:
+        for i, n in enumerate((5, 8, 3)):
+            fleet.ingest(
+                i % 2, rng.rand(n).astype(np.float32), (rng.rand(n) > 0.5).astype(np.int32)
+            )
+        fleet.results()
+    eng = fleet.engine
+    assert EngineAnalysis().check(eng).ok  # sane before the break
+
+    inner = eng._traced_update
+
+    def smuggling_update(state_tree, payload, mask):
+        new = inner(state_tree, payload, mask)
+        return jax.tree.map(lambda x: jax.lax.psum(x, "dp"), new)
+
+    eng._traced_update = smuggling_update
+    report = EngineAnalysis().check(eng)
+    rules = {f.rule for f in report.findings}
+    assert rules == {"no-collectives-in-deferred-step"}, report.render()
+    assert all("psum" in f.path for f in report.findings)
+
+
 def test_audit_catches_a_blown_compile_cap():
     """Shrink the declared bucket set after serving: the programs-per-engine
     accounting must flag the (now) over-cap executable count."""

@@ -1,0 +1,562 @@
+"""Fleet runtime unit tests (ISSUE 15) — all single-process tier-1-fast.
+
+The DEGENERATE (num_processes=1) fleet runs the identical code path as a
+real fleet — same boundary programs (merge/result/barrier at world 1), same
+snapshot-cut protocol, same restore matrix — minus ``jax.distributed``;
+everything multi-process-only (gloo collectives, cross-host parity,
+kill-one-host) lives in ``make fleet-smoke`` and the slow harness test.
+Host-count-sensitive paths (piece refusals, the fleet → single merge) are
+exercised here by STAMPING fabricated 2-host topology onto ordinary
+engines — the stamp is exactly what FleetEngine does at construction."""
+import os
+
+import numpy as np
+import pytest
+
+from metrics_tpu import Accuracy, MeanSquaredError, MetricCollection
+from metrics_tpu.engine import (
+    EngineConfig,
+    FaultInjector,
+    FaultSpec,
+    FleetBarrierError,
+    FleetConfig,
+    FleetEngine,
+    FleetHostLostError,
+    FleetTopologyError,
+    MultiStreamEngine,
+    StreamingEngine,
+    TraceRecorder,
+    restore_fleet_into,
+    save_snapshot,
+)
+from metrics_tpu.engine.fleet import last_consistent_cut
+from metrics_tpu.engine.traffic import zipf_traffic
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+S = 6
+BUCKETS = (8, 16)
+
+
+def _col():
+    return MetricCollection([Accuracy(), MeanSquaredError()])
+
+
+def _traffic(n=24, seed=9):
+    return zipf_traffic(S, n, seed=seed)
+
+
+def _np_results(results):
+    return {
+        sid: {k: np.asarray(v) for k, v in r.items()} for sid, r in results.items()
+    }
+
+
+def _assert_results_equal(got, want):
+    assert set(got) == set(want)
+    for sid in want:
+        for k in want[sid]:
+            assert np.array_equal(got[sid][k], want[sid][k], equal_nan=True), (
+                sid, k, got[sid][k], want[sid][k],
+            )
+
+
+def _oracle_results(traffic):
+    oracle = MultiStreamEngine(_col(), S, EngineConfig(buckets=BUCKETS))
+    with oracle:
+        for sid, p, t in traffic:
+            oracle.submit(sid, p, t)
+        return _np_results(oracle.results())
+
+
+# ------------------------------------------------------------- construction
+
+
+def test_fleet_config_validation():
+    with pytest.raises(FleetTopologyError, match="process_id"):
+        FleetEngine(_col(), FleetConfig(num_processes=2, process_id=2))
+    with pytest.raises(FleetTopologyError, match="positive"):
+        FleetEngine(_col(), FleetConfig(num_processes=0))
+
+
+def test_step_sync_local_mesh_refused():
+    import jax
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("dp",))
+    with pytest.raises(MetricsTPUUserError, match="deferred"):
+        FleetEngine(
+            _col(),
+            FleetConfig(engine=EngineConfig(mesh=mesh, axis="dp", mesh_sync="step")),
+        )
+
+
+def test_snapshot_every_without_dir_refused_at_construction():
+    with pytest.raises(MetricsTPUUserError, match="requires snapshot_dir"):
+        FleetEngine(_col(), FleetConfig(num_streams=S, snapshot_every=8))
+
+
+def test_inner_snapshot_config_refused(tmp_path):
+    with pytest.raises(MetricsTPUUserError, match="cut protocol"):
+        FleetEngine(
+            _col(),
+            FleetConfig(engine=EngineConfig(snapshot_dir=str(tmp_path), snapshot_every=2)),
+        )
+
+
+def test_windowed_fleet_refused():
+    from metrics_tpu.engine import WindowPolicy
+
+    with pytest.raises(MetricsTPUUserError, match="window"):
+        FleetEngine(
+            _col(),
+            FleetConfig(
+                num_streams=S,
+                engine=EngineConfig(window=WindowPolicy.tumbling(pane_batches=2)),
+            ),
+        )
+
+
+# ------------------------------------------------------- degenerate serving
+
+
+def test_degenerate_fleet_matches_multistream_oracle():
+    traffic = _traffic()
+    want = _oracle_results(traffic)
+    fleet = FleetEngine(
+        _col(), FleetConfig(num_streams=S, engine=EngineConfig(buckets=BUCKETS))
+    )
+    with fleet:
+        for b in traffic:
+            assert fleet.ingest(*b)  # 1-host fleet owns every stream
+        got = _np_results(fleet.results())
+        one = fleet.result(2)
+    _assert_results_equal(got, want)
+    for k in want[2]:
+        assert np.array_equal(np.asarray(one[k]), want[2][k], equal_nan=True)
+    assert fleet.streams_owned == list(range(S))
+    assert fleet.home(5) == 0
+
+
+def test_degenerate_fleet_single_metric_mode():
+    rng = np.random.RandomState(0)
+    batches = [
+        ((rng.randint(0, 65, size=n) / 64.0).astype(np.float32),
+         (rng.rand(n) > 0.5).astype(np.int32))
+        for n in (5, 8, 3, 6)
+    ]
+    plain = StreamingEngine(_col(), EngineConfig(buckets=BUCKETS))
+    with plain:
+        for b in batches:
+            plain.submit(*b)
+        want = {k: np.asarray(v) for k, v in plain.result().items()}
+    fleet = FleetEngine(_col(), FleetConfig(engine=EngineConfig(buckets=BUCKETS)))
+    with fleet:
+        for b in batches:
+            fleet.ingest(*b)
+        got = {k: np.asarray(v) for k, v in fleet.result().items()}
+    for k in want:
+        assert np.array_equal(got[k], want[k], equal_nan=True)
+    with pytest.raises(MetricsTPUUserError, match="multi-stream"):
+        fleet.results()
+
+
+def test_submit_foreign_stream_refused_names_home_host():
+    fleet = FleetEngine(
+        _col(), FleetConfig(num_streams=S, engine=EngineConfig(buckets=BUCKETS))
+    )
+    # stamp a 2-host view onto the routing check — exactly the fields a real
+    # 2-process construction sets
+    fleet._H = 2
+    with fleet:
+        with pytest.raises(FleetTopologyError, match="homes on host 1"):
+            fleet.submit(1, np.zeros(2, np.float32), np.zeros(2, np.int32))
+        fleet.submit(2, np.asarray([0.5, 1.0], np.float32), np.asarray([1, 0], np.int32))
+
+
+# ------------------------------------------------------ snapshot-cut protocol
+
+
+def test_fleet_snapshot_meta_and_restore_cycle(tmp_path):
+    traffic = _traffic()
+    want = _oracle_results(traffic)
+    fcfg = FleetConfig(
+        num_streams=S, engine=EngineConfig(buckets=BUCKETS),
+        snapshot_dir=str(tmp_path), snapshot_every=8,
+    )
+    fleet = FleetEngine(_col(), fcfg)
+    with fleet:
+        for b in traffic[:20]:  # cuts at plan 8 and 16
+            fleet.ingest(*b)
+        fleet.flush()
+    st = fleet.engine.stats
+    assert st.fleet_cuts == 2 and st.fleet_barriers == 2
+    assert last_consistent_cut(str(tmp_path), 1) == 1
+
+    resumed = FleetEngine(_col(), fcfg)
+    meta = resumed.restore()
+    assert int(meta["num_hosts"]) == 1 and int(meta["process_id"]) == 0
+    assert int(meta["fleet_cut"]) == 1 and int(meta["fleet_plan_cursor"]) == 16
+    assert resumed.global_cursor == 16
+    with resumed:
+        for b in traffic[16:]:
+            resumed.ingest(*b)
+        got = _np_results(resumed.results())
+    _assert_results_equal(got, want)
+
+
+def test_explicit_cut_index_and_validation(tmp_path):
+    fleet = FleetEngine(
+        _col(),
+        FleetConfig(num_streams=S, engine=EngineConfig(buckets=BUCKETS),
+                    snapshot_dir=str(tmp_path)),
+    )
+    with fleet:
+        fleet.ingest(0, np.asarray([0.5], np.float32), np.asarray([1], np.int32))
+        fleet.fleet_snapshot(cut=3)
+        with pytest.raises(MetricsTPUUserError, match=">= 0"):
+            fleet.fleet_snapshot(cut=-1)
+    assert last_consistent_cut(str(tmp_path), 1) == 3
+
+
+def test_fleet_snapshot_requires_dir():
+    fleet = FleetEngine(_col(), FleetConfig(num_streams=S, engine=EngineConfig(buckets=BUCKETS)))
+    with pytest.raises(MetricsTPUUserError, match="snapshot_dir"):
+        fleet.fleet_snapshot()
+    with pytest.raises(MetricsTPUUserError, match="snapshot_dir"):
+        fleet.restore()
+
+
+def test_barrier_disagreement_is_typed():
+    fleet = FleetEngine(_col(), FleetConfig(num_streams=S, engine=EngineConfig(buckets=BUCKETS)))
+    fleet._barrier_program = lambda: (lambda x: np.asarray([5], np.int32))
+    with pytest.raises(FleetBarrierError, match="disagree"):
+        fleet._barrier(3)
+
+
+# ------------------------------------------------------------ restore matrix
+
+
+def test_pre_fleet_snapshot_restores_with_default_topology(tmp_path):
+    """Regression (satellite): a snapshot written BEFORE the fleet runtime
+    existed carries no host-topology meta — it must restore as single-host."""
+    traffic = _traffic(12)
+    eng = MultiStreamEngine(_col(), S, EngineConfig(buckets=BUCKETS))
+    with eng:
+        for sid, p, t in traffic:
+            eng.submit(sid, p, t)
+        eng.flush()
+        state, meta = eng._snapshot_doc()
+        want = _np_results(eng.results())
+    # strip the (new) host fields — this is byte-for-byte what a pre-fleet
+    # engine wrote
+    for key in ("num_hosts", "process_id"):
+        meta.pop(key, None)
+    save_snapshot(str(tmp_path), state, meta, host_attrs=eng._metric.host_compute_attrs())
+    fresh = MultiStreamEngine(_col(), S, EngineConfig(buckets=BUCKETS))
+    got_meta = fresh.restore(str(tmp_path))
+    assert int(got_meta.get("batches_done", -1)) == len(traffic)
+    with fresh:
+        got = _np_results(fresh.results())
+    _assert_results_equal(got, want)
+
+
+def _fabricated_fleet_dir(tmp_path, traffic, num_hosts=2, local_mesh=False):
+    """Write a ``num_hosts``-host fleet snapshot WITHOUT jax.distributed:
+    per host, an ordinary engine stamped with the fleet topology serves its
+    homed share of ``traffic`` and writes its piece + cut marker — the same
+    bytes a real fleet's hosts produce. ``local_mesh`` builds each host on a
+    1-device deferred mesh (the harness's config), so the pieces carry the
+    shard-stacked deferred form."""
+    fleet_dir = tmp_path / "fleet"
+    mesh_kw = {}
+    if local_mesh:
+        import jax
+        from jax.sharding import Mesh
+
+        mesh_kw = {
+            "mesh": Mesh(np.asarray(jax.devices()[:1]), ("dp",)),
+            "axis": "dp",
+            "mesh_sync": "deferred",
+        }
+    for pid in range(num_hosts):
+        host_dir = fleet_dir / f"host_{pid:03d}"
+        eng = MultiStreamEngine(
+            _col(), S, EngineConfig(buckets=BUCKETS, snapshot_dir=str(host_dir), **mesh_kw)
+        )
+        eng._fleet_hosts = num_hosts
+        eng._fleet_pid = pid
+        eng._fleet_cut = 0
+        eng._fleet_plan_cursor = len(traffic)
+        with eng:
+            for sid, p, t in traffic:
+                if sid % num_hosts == pid:
+                    eng.submit(sid, p, t)
+            path = eng.snapshot()
+        with open(host_dir / "fleet_cut_000000", "w") as f:
+            f.write(os.path.basename(path))
+    return fleet_dir
+
+
+def test_restore_fleet_into_single_engine(tmp_path):
+    traffic = _traffic()
+    want = _oracle_results(traffic)
+    fleet_dir = _fabricated_fleet_dir(tmp_path, traffic)
+    single = MultiStreamEngine(_col(), S, EngineConfig(buckets=BUCKETS))
+    meta = restore_fleet_into(single, str(fleet_dir))
+    assert int(meta["merged_from_hosts"]) == 2 and int(meta["num_hosts"]) == 1
+    with single:
+        got = _np_results(single.results())
+    _assert_results_equal(got, want)
+
+
+def test_restore_fleet_into_from_deferred_host_pieces(tmp_path):
+    """Host pieces written by local-deferred-mesh engines (the harness's
+    per-host config) carry world-1 shard-stacked arenas — the single-engine
+    merge must fold the shard axis AND the host axis."""
+    traffic = _traffic()
+    want = _oracle_results(traffic)
+    fleet_dir = _fabricated_fleet_dir(tmp_path, traffic, local_mesh=True)
+    single = MultiStreamEngine(_col(), S, EngineConfig(buckets=BUCKETS))
+    restore_fleet_into(single, str(fleet_dir))
+    with single:
+        got = _np_results(single.results())
+    _assert_results_equal(got, want)
+
+
+def test_fleet_piece_refuses_plain_restore(tmp_path):
+    traffic = _traffic(8)
+    fleet_dir = _fabricated_fleet_dir(tmp_path, traffic)
+    plain = MultiStreamEngine(_col(), S, EngineConfig(buckets=BUCKETS))
+    with pytest.raises(MetricsTPUUserError, match="restore_fleet_into"):
+        plain.restore(str(fleet_dir / "host_001"))
+
+
+def test_restore_fleet_into_refusals(tmp_path):
+    traffic = _traffic(8)
+    fleet_dir = _fabricated_fleet_dir(tmp_path, traffic)
+    # host-count mismatch: a 2-host dir read as a 3-host fleet
+    with pytest.raises(FleetTopologyError, match="num_hosts=3"):
+        last_consistent_cut(str(fleet_dir), 3)
+    # a fleet-managed target must refuse the single-process merge
+    target = MultiStreamEngine(_col(), S, EngineConfig(buckets=BUCKETS))
+    target._fleet_hosts = 2
+    target._fleet_pid = 1
+    with pytest.raises(FleetTopologyError, match="SINGLE-PROCESS"):
+        restore_fleet_into(target, str(fleet_dir))
+    # a torn dir (one host's marker removed) has no consistent cut
+    os.unlink(fleet_dir / "host_001" / "fleet_cut_000000")
+    fresh = MultiStreamEngine(_col(), S, EngineConfig(buckets=BUCKETS))
+    with pytest.raises(FileNotFoundError, match="consistent"):
+        restore_fleet_into(fresh, str(fleet_dir))
+
+
+def test_adopt_single(tmp_path):
+    traffic = _traffic(10)
+    src_dir = tmp_path / "single"
+    src = MultiStreamEngine(_col(), S, EngineConfig(buckets=BUCKETS, snapshot_dir=str(src_dir)))
+    with src:
+        for sid, p, t in traffic:
+            src.submit(sid, p, t)
+        src.snapshot()
+        want = _np_results(src.results())
+    fleet = FleetEngine(_col(), FleetConfig(num_streams=S, engine=EngineConfig(buckets=BUCKETS)))
+    meta = fleet.adopt_single(str(src_dir))
+    assert int(meta.get("batches_done", -1)) == len(traffic)
+    with fleet:
+        got = _np_results(fleet.results())
+    _assert_results_equal(got, want)
+
+
+def test_adopt_single_refuses_fleet_piece(tmp_path):
+    fleet_dir = _fabricated_fleet_dir(tmp_path, _traffic(8))
+    fleet = FleetEngine(_col(), FleetConfig(num_streams=S, engine=EngineConfig(buckets=BUCKETS)))
+    with pytest.raises(FleetTopologyError, match="single-process snapshot"):
+        fleet.adopt_single(str(fleet_dir / "host_000"))
+
+
+# ----------------------------------------------------------------- fault sites
+
+
+def test_host_loss_transient_retries_and_sticky_is_typed():
+    traffic = _traffic(8)
+    want = _oracle_results(traffic)
+    inj = FaultInjector(seed=3, plan={"host_loss": FaultSpec(schedule=(0,))})
+    fleet = FleetEngine(
+        _col(),
+        FleetConfig(num_streams=S, engine=EngineConfig(buckets=BUCKETS, fault_injector=inj)),
+    )
+    with fleet:
+        for b in traffic:
+            fleet.ingest(*b)
+        got = _np_results(fleet.results())
+    _assert_results_equal(got, want)
+    assert inj.fired.get("host_loss", 0) == 1 and fleet.engine.stats.retries >= 1
+
+    sticky = FaultInjector(
+        seed=3, plan={"host_loss": FaultSpec(schedule=(0,), transient=False)}
+    )
+    doomed = FleetEngine(
+        _col(),
+        FleetConfig(num_streams=S, engine=EngineConfig(buckets=BUCKETS, fault_injector=sticky)),
+    )
+    with doomed:
+        doomed.ingest(0, np.asarray([0.5], np.float32), np.asarray([1], np.int32))
+        with pytest.raises(FleetHostLostError, match="last consistent snapshot cut"):
+            doomed.results()
+
+
+def test_fleet_barrier_fault_retries(tmp_path):
+    inj = FaultInjector(seed=5, plan={"fleet_barrier": FaultSpec(schedule=(0,))})
+    fleet = FleetEngine(
+        _col(),
+        FleetConfig(
+            num_streams=S,
+            engine=EngineConfig(buckets=BUCKETS, fault_injector=inj),
+            snapshot_dir=str(tmp_path),
+        ),
+    )
+    with fleet:
+        fleet.ingest(0, np.asarray([0.5], np.float32), np.asarray([1], np.int32))
+        fleet.fleet_snapshot()
+    assert inj.fired.get("fleet_barrier", 0) == 1
+    assert last_consistent_cut(str(tmp_path), 1) == 0
+
+
+# ------------------------------------------------------------------- surfaces
+
+
+def _tools():
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..", "tools"))
+    import engine_report
+    import trace_export
+
+    return engine_report, trace_export
+
+
+def test_openmetrics_host_families_present_and_absent():
+    _, trace_export = _tools()
+    traffic = _traffic(8)
+    # single-process engines: byte-stable, no fleet families — two identical
+    # runs must render identical bytes
+    texts = []
+    for _ in range(2):
+        eng = MultiStreamEngine(_col(), S, EngineConfig(buckets=BUCKETS))
+        with eng:
+            for sid, p, t in traffic:
+                eng.submit(sid, p, t)
+            eng.results()
+        texts.append(eng.metrics_text())
+    assert texts[0] == texts[1]
+    assert "fleet_" not in texts[0]
+    trace_export.parse_openmetrics(texts[0])
+
+    fleet = FleetEngine(
+        _col(),
+        FleetConfig(num_streams=S, engine=EngineConfig(buckets=BUCKETS, trace=TraceRecorder())),
+    )
+    with fleet:
+        for b in traffic:
+            fleet.ingest(*b)
+        fleet.results()
+    text = fleet.metrics_text()
+    fams = trace_export.parse_openmetrics(text)
+    for fam in (
+        "fleet_ingested", "fleet_skipped", "fleet_merges", "fleet_barriers",
+        "fleet_snapshot_cuts", "fleet_sync_payload_bytes",
+    ):
+        full = f"metrics_tpu_engine_{fam}"
+        assert full in fams, f"{fam} missing"
+        assert any(
+            s.get("labels", {}).get("host") == "0" for s in fams[full]["samples"]
+        ), f"{fam} lacks host label"
+    assert "metrics_tpu_engine_fleet_num_hosts" in fams
+
+
+def test_engine_report_renders_fleet_section_and_degrades():
+    engine_report, _ = _tools()
+    fleet = FleetEngine(
+        _col(), FleetConfig(num_streams=S, engine=EngineConfig(buckets=BUCKETS))
+    )
+    with fleet:
+        for b in _traffic(8):
+            fleet.ingest(*b)
+        fleet.results()
+    doc = {"summary": fleet.telemetry(), "recent_steps": []}
+    rendered = engine_report.render(doc)
+    assert "fleet host" in rendered and "fleet boundaries" in rendered
+    assert "0 of 1" in rendered
+    # no fleet block — the section must simply be absent, nothing crashes
+    plain = StreamingEngine(_col(), EngineConfig(buckets=BUCKETS))
+    with plain:
+        plain.submit(np.asarray([0.5], np.float32), np.asarray([1], np.int32))
+        plain.result()
+    rendered_plain = engine_report.render({"summary": plain.telemetry(), "recent_steps": []})
+    assert "fleet host" not in rendered_plain
+
+
+def test_fleet_telemetry_block():
+    fleet = FleetEngine(
+        _col(), FleetConfig(num_streams=S, engine=EngineConfig(buckets=BUCKETS))
+    )
+    with fleet:
+        for b in _traffic(8):
+            fleet.ingest(*b)
+        fleet.results()
+    block = fleet.telemetry()["fleet"]
+    assert block["num_hosts"] == 1 and block["process_id"] == 0
+    assert block["streams_owned"] == S
+    assert block["ingested"] == 8 and block["skipped"] == 0
+    assert block["merges"] == 1 and block["merge_us_total"] > 0
+    assert block["sync_payload_bytes"]["exact"] > 0
+    # a plain engine's telemetry has NO fleet block (byte-stable documents)
+    plain = StreamingEngine(_col(), EngineConfig(buckets=BUCKETS))
+    assert "fleet" not in plain.telemetry()
+
+
+def test_fleet_payload_counters_do_not_double_count_local_merges():
+    """A fleet host with a local deferred mesh pays TWO boundaries per fold
+    — the host-local merge (ordinary sync_payload counters) and the
+    cross-host fold (the fleet's own) — and the fleet block must report
+    exactly the cross-host bytes, once per fold."""
+    import jax
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("dp",))
+    fleet = FleetEngine(
+        _col(),
+        FleetConfig(
+            num_streams=S,
+            engine=EngineConfig(buckets=BUCKETS, mesh=mesh, axis="dp", mesh_sync="deferred"),
+        ),
+    )
+    with fleet:
+        for b in _traffic(8):
+            fleet.ingest(*b)
+        fleet.results()
+    per_fold = fleet._fleet_payload_split()
+    st = fleet.engine.stats
+    assert st.fleet_merges == 1
+    assert (st.fleet_payload_exact_bytes, st.fleet_payload_quant_bytes) == per_fold
+    block = fleet.telemetry()["fleet"]
+    assert block["sync_payload_bytes"]["exact"] == per_fold[0]
+    # the host-LOCAL merge recorded its own (separate) payload
+    assert st.sync_payload_exact_bytes > 0
+
+
+def test_zero_steady_compiles_after_warmup():
+    traffic = _traffic(16)
+    fleet = FleetEngine(
+        _col(), FleetConfig(num_streams=S, engine=EngineConfig(buckets=BUCKETS))
+    )
+    with fleet:
+        for b in traffic:
+            fleet.ingest(*b)
+        fleet.results()
+        warm = fleet.engine.aot_cache.misses
+        fleet.reset()
+        for b in traffic:
+            fleet.ingest(*b)
+        fleet.results()
+        assert fleet.engine.aot_cache.misses == warm

@@ -178,6 +178,35 @@ def render(doc: dict, steps: int = 10, analysis: dict = None) -> str:
                     f"{_fmt(drift.get('alarms'))} alarms",
                 )
             )
+    fleet = s.get("fleet")
+    if fleet:
+        # per-host fleet section (ISSUE 15): which host of how many this
+        # document came from, its stream ownership, its share of the shared
+        # ingest plan, and the cross-host boundary traffic (folds, barrier
+        # entries, snapshot cuts, per-fold sync payload bytes). A stats
+        # document with NO fleet block — every single-process engine —
+        # renders exactly as before.
+        spb = fleet.get("sync_payload_bytes") or {}
+        rows.append(
+            (
+                "fleet host",
+                f"{_fmt(fleet.get('process_id'))} of {_fmt(fleet.get('num_hosts'))}"
+                f" · {_fmt(fleet.get('streams_owned'))} streams owned"
+                f" · ingested {_fmt(fleet.get('ingested'))}"
+                f" / skipped {_fmt(fleet.get('skipped'))} plan batches",
+            )
+        )
+        rows.append(
+            (
+                "fleet boundaries",
+                f"{_fmt(fleet.get('merges'))} folds"
+                f" ({_fmt(fleet.get('merge_us_total'))} µs total)"
+                f" · {_fmt(fleet.get('barriers'))} barriers"
+                f" · {_fmt(fleet.get('cuts'))} snapshot cuts"
+                f" · sync payload {_fmt(spb.get('exact'))}B exact"
+                f" / {_fmt(spb.get('quantized'))}B quantized",
+            )
+        )
     reshard = s.get("reshard")
     if reshard:
         last = reshard.get("last") or {}
