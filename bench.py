@@ -1357,7 +1357,14 @@ def bench_engine_dispatch() -> dict:
     * ``coalesce``  — + megabatch coalescing (K submissions, one dispatch);
     * ``multistream`` — 8 independent streams served by ONE MultiStreamEngine
       (same total rows, cross-stream megabatches) vs the baseline's
-      one-engine-per-stream cost model.
+      one-engine-per-stream cost model;
+    * ``per_leaf_kernel`` / ``megastep`` (ISSUE 16, TPU only) — the coalesced
+      arena engine with PR 4 per-leaf Pallas kernels vs the whole-step fused
+      tier; ``speedup_megastep_vs_per_leaf`` is the device-bound small-batch
+      acceptance ratio (>=1.5x). Off-TPU compiled Pallas cannot execute, so
+      both rungs are reported skipped — the CPU gate for the megastep path is
+      interpret parity + the zero-compile/jaxpr pins (kernels-smoke), never a
+      timing.
 
     PINNED protocol (docs/benchmarking.md): fixed-seed 192-batch stream of
     uniform 16..64-row batches against buckets (64, 512) — every batch is
@@ -1449,6 +1456,26 @@ def bench_engine_dispatch() -> dict:
             MultiStreamEngine(_col(), num_streams=n_streams, config=cfg(coalesce=16)), _multi
         ),
     }
+    # megastep vs per-leaf kernels (ISSUE 16): same ladder, same data — the
+    # device-bound small-batch claim. Compiled Pallas only exists on TPU.
+    from metrics_tpu.ops.kernels import resolve_backend
+
+    if resolve_backend("auto") == "pallas":
+        out["per_leaf_kernel"] = _measure(
+            StreamingEngine(_col(), cfg(coalesce=16, kernel_backend="pallas")), _single
+        )
+        out["megastep"] = _measure(
+            StreamingEngine(_col(), cfg(coalesce=16, kernel_backend="megastep")), _single
+        )
+        out["speedup_megastep_vs_per_leaf"] = round(
+            out["megastep"]["samples_per_s"] / out["per_leaf_kernel"]["samples_per_s"], 3
+        )
+        out["meets_1p5x_bar"] = out["speedup_megastep_vs_per_leaf"] >= 1.5
+    else:
+        out["megastep"] = {
+            "skipped": "compiled Pallas needs a TPU backend; the megastep CPU "
+            "gate is interpret parity + zero-compile/jaxpr pins (kernels-smoke)"
+        }
     base_sps = out["baseline"]["samples_per_s"]
     return {
         **out,
@@ -1787,7 +1814,14 @@ def bench_kernel_microbench() -> dict:
     ``segment_min`` — masked segment-min into 32 streams (XLA lowers this to
     a serialized scatter-min, the kernel to a compare-select sweep);
     ``histogram_counts`` — 256k-row bincount into 256 bins (XLA scatter-add
-    vs the kernel's one-hot MXU contraction).
+    vs the kernel's one-hot MXU contraction);
+    ``megastep_fold`` (ISSUE 16) — the whole-arena fused fold (ONE launch
+    folds an 8-leaf packed arena with a mixed sum/min/max opcode row) against
+    the PR 4 shape of the same update: 8 per-leaf ``fold_rows_masked``
+    launches + the XLA concatenate re-pack. Both forms run on the SAME
+    backend in one run, so ``fused_vs_per_leaf`` is the launch-amortization
+    ratio the megastep tier claims (off-TPU both compile to XLA, where the
+    ratio only shows XLA's own fusion — the device claim needs the TPU run).
 
     Off-TPU the compiled-Pallas path does not exist: the entry measures the
     XLA path alone and says so (``kernel_path_skipped``) — interpret mode is
@@ -1933,6 +1967,90 @@ def bench_kernel_microbench() -> dict:
         )
     except Exception as e:
         out["histogram_counts"] = {"error": str(e)[:200]}
+
+    # -- megastep_fold (ISSUE 16): fused whole-arena fold vs 8 per-leaf folds,
+    #    (16384, 8x32) packed f32 arena, mixed per-leaf reductions
+    from metrics_tpu.ops.kernels import megastep_fold
+
+    n, n_leaves, f_leaf = 16384, 8, 32
+    f_total = n_leaves * f_leaf
+    rows_m = jnp.asarray(rng.randn(n, f_total).astype(np.float32))
+    state_m = jnp.asarray(rng.randn(f_total).astype(np.float32))
+    mask_m = jnp.asarray(rng.rand(n) > 0.25)
+    leaf_ops = [("sum", "min", "max")[j % 3] for j in range(n_leaves)]
+    op_row = np.repeat(np.asarray([j % 3 for j in range(n_leaves)], np.int32), f_leaf)
+
+    def make_fused_epoch():
+        def epoch(st, rws, mk, k):
+            def body(i, acc):
+                return megastep_fold(acc, jnp.roll(rws, i, axis=0), mk, op_row)
+
+            return jax.lax.fori_loop(0, k, body, st)
+
+        return epoch
+
+    def make_per_leaf_epoch():
+        # the PR 4 shape: one kernel launch per leaf + an XLA concatenate pack
+        def epoch(st, rws, mk, k):
+            def body(i, acc):
+                r = jnp.roll(rws, i, axis=0)
+                parts = [
+                    fold_rows_masked(
+                        acc[j * f_leaf:(j + 1) * f_leaf],
+                        r[:, j * f_leaf:(j + 1) * f_leaf],
+                        mk,
+                        leaf_ops[j],
+                    )
+                    for j in range(n_leaves)
+                ]
+                return jnp.concatenate(parts)
+
+            return jax.lax.fori_loop(0, k, body, st)
+
+        return epoch
+
+    try:
+        backend_m = "pallas" if on_tpu else "xla"
+        abstract_m = tuple(
+            jax.ShapeDtypeStruct(x.shape, x.dtype) for x in (state_m, rows_m, mask_m)
+        )
+        k_abs = jax.ShapeDtypeStruct((), jnp.int32)
+        mega = {"backend_measured": backend_m}
+        outs_m = {}
+        for name, mk_ep in (("fused", make_fused_epoch), ("per_leaf", make_per_leaf_epoch)):
+            with use_backend(backend_m):
+                prog = jax.jit(mk_ep()).lower(*abstract_m, k_abs).compile()
+            outs_m[name] = np.asarray(prog(state_m, rows_m, mask_m, jnp.int32(1)))
+            _epoch_time(prog, (state_m, rows_m, mask_m), k_pair[0])  # warm
+            marginals = []
+            for _ in range(trials):
+                t1 = _epoch_time(prog, (state_m, rows_m, mask_m), k_pair[0])
+                t2 = _epoch_time(prog, (state_m, rows_m, mask_m), k_pair[1])
+                marginals.append((t2 - t1) / (k_pair[1] - k_pair[0]))
+            marginals.sort()
+            med = marginals[len(marginals) // 2]
+            mega[name] = {
+                "per_iter_us": round(med * 1e6, 1),
+                "spread_frac": round((marginals[-1] - marginals[0]) / max(med, 1e-12), 3),
+            }
+        err = float(np.max(np.abs(
+            outs_m["fused"].astype(np.float64) - outs_m["per_leaf"].astype(np.float64)
+        )))
+        scale = float(np.max(np.abs(outs_m["per_leaf"].astype(np.float64)))) or 1.0
+        mega["parity_max_rel_err"] = round(err / scale, 9)
+        mega["fused_vs_per_leaf"] = round(
+            mega["per_leaf"]["per_iter_us"] / max(mega["fused"]["per_iter_us"], 1e-9), 3
+        )
+        if not on_tpu:
+            mega["note"] = (
+                "both forms compiled to XLA off-TPU; the fused form's XLA twin "
+                "computes every reduction then selects per column, so a ratio "
+                "below 1 here is expected and is NOT the megastep "
+                "launch-amortization claim (that ratio is TPU-only)"
+            )
+        out["megastep_fold"] = mega
+    except Exception as e:
+        out["megastep_fold"] = {"error": str(e)[:200]}
 
     speedups = [
         v.get("speedup_vs_xla")

@@ -51,6 +51,7 @@ from metrics_tpu.analysis.rules import (
     check_collective_multiset,
     check_compile_cap,
     check_donation_honored,
+    check_megastep_launch_count,
     check_no_baked_host_constants,
     check_no_collectives,
     check_no_scatter_under_pallas,
@@ -79,6 +80,7 @@ __all__ = [
     "check_collective_multiset",
     "check_compile_cap",
     "check_donation_honored",
+    "check_megastep_launch_count",
     "check_no_baked_host_constants",
     "check_no_collectives",
     "check_no_scatter_under_pallas",

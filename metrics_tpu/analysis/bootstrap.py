@@ -164,6 +164,38 @@ def bootstrap_engines(
                 engine.submit(*b)
             engine.result()
         out.append((f"windowed/arena/single/{backend}", engine))
+    # MEGASTEP engines (ISSUE 16): the whole-step fused tier joins the matrix
+    # outside the backend loop — megastep is arena-only and opt-in (the
+    # interpret tier refuses ineligible layouts outright), so the per-leaf /
+    # unsharded-multistream axes of the grid do not apply. Two serving shapes
+    # cover the two fused forms: the single-engine FOLD grid, and the
+    # stream-sharded SEGMENT grid with q8-resident cold rows (compressed
+    # spills seated by the in-grid decode-on-touch). The megastep rule forms
+    # (`pallas-call-per-leaf` megastep pin, `arena-pack-fused` fused-pack
+    # pin) key off these engines' resolved backend.
+    engine = StreamingEngine(
+        MetricCollection([Accuracy(), MeanSquaredError()]),
+        EngineConfig(buckets=(8,), kernel_backend="megastep_interpret"),
+    )
+    with engine:
+        for b in batches:
+            engine.submit(*b)
+        engine.result()
+    out.append(("step/arena/single/megastep_interpret", engine))
+    engine = MultiStreamEngine(
+        Accuracy(), num_streams=4,
+        config=EngineConfig(
+            buckets=(8,), kernel_backend="megastep_interpret",
+            mesh=mesh, axis="dp", mesh_sync="deferred", compress_payloads=True,
+        ),
+        stream_shard=True, resident_streams=2,
+    )
+    with engine:
+        for i, b in enumerate(batches):
+            engine.submit(i % 4, *b)
+        engine.result(0)
+        engine.results()
+    out.append(("sshard/arena/multistream/megastep_interpret", engine))
     return out
 
 

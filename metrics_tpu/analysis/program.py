@@ -196,9 +196,22 @@ class EngineAnalysis:
                     report.extend(R.check_quantized_policy_honored(
                         jaxpr, info, engine._world, where=where
                     ))
+            megastep_keys = self._megastep_fused_keys(engine)
             if kernel_backend != "xla":
                 report.extend(R.check_no_scatter_under_pallas(jaxpr, where=where))
-                if self._kernel_path_expected(engine):
+                if megastep_keys is not None:
+                    # megastep form (ISSUE 16): one fused grid per eligible
+                    # dtype, total launches O(dtypes) — the per-primitive
+                    # budget covers kernels a delta body calls itself (e.g.
+                    # the histogram MXU kernel), at most one per state leaf
+                    # that is NOT covered by a fused grid
+                    n_leaves = len(jax.tree_util.tree_leaves(state_abs))
+                    report.extend(R.check_megastep_launch_count(
+                        jaxpr, n_dtypes=len(megastep_keys),
+                        extra=max(0, n_leaves - len(megastep_keys)),
+                        where=where,
+                    ))
+                elif self._kernel_path_expected(engine):
                     report.extend(R.check_pallas_call_count(jaxpr, min_count=1, where=where))
             if engine._layout is not None:
                 shard_shapes = None
@@ -259,6 +272,7 @@ class EngineAnalysis:
                     worlds=(engine._world,) if deferred else (),
                     state_leaves=len(jax.tree_util.tree_leaves(state_abs)),
                     buffer_shapes=shard_shapes,
+                    fused_dtypes=megastep_keys or (),
                 ))
             if engine._donate and hlo is not None:
                 n_donated = (
@@ -373,6 +387,22 @@ class EngineAnalysis:
                 for fx, leaf, prec in info
             ]
         return info
+
+    @staticmethod
+    def _megastep_fused_keys(engine: Any) -> Optional[Tuple[str, ...]]:
+        """The arena dtype keys riding the engine's fused megastep grids
+        (eligible keys minus per-dtype degradation verdicts), or None when
+        the engine is not on a megastep backend / fell back engine-level —
+        the audit then applies the per-leaf rule forms instead."""
+        from metrics_tpu.ops.kernels.dispatch import MEGASTEP_BACKENDS
+
+        if engine._kernel_tag() not in MEGASTEP_BACKENDS:
+            return None
+        plan = getattr(engine, "_megastep_plan", None)
+        if plan is None:
+            return None
+        fall = engine._megastep_fallback_reasons()
+        return tuple(k for k in plan.eligible_keys() if k not in fall)
 
     @staticmethod
     def _kernel_path_expected(engine: Any) -> bool:

@@ -39,6 +39,7 @@ from metrics_tpu.analysis.rules.locks import (
     lockset_findings,
 )
 from metrics_tpu.analysis.rules.pallas import (
+    check_megastep_launch_count,
     check_no_scatter_under_pallas,
     check_pallas_call_count,
 )
@@ -64,6 +65,7 @@ __all__ = [
     "lockset_findings",
     "check_no_baked_host_constants",
     "check_no_collectives",
+    "check_megastep_launch_count",
     "check_no_scatter_under_pallas",
     "check_pallas_call_count",
     "check_quantized_policy_honored",
@@ -121,7 +123,10 @@ RULES: Dict[str, RuleInfo] = {
         RuleInfo(
             "pallas-call-per-leaf", "program", "error",
             "Kernel-backend programs trace the expected pallas_call count "
-            "(one per state leaf for delta metrics; >=1 in the engine audit).",
+            "(one per state leaf for delta metrics; >=1 in the engine audit). "
+            "Megastep form (ISSUE 16): exactly one fused grid per eligible "
+            "arena dtype and total launches <= dtypes + per-primitive budget "
+            "— O(dtypes), never O(leaves).",
             incident="PR 4's closure-identity trace-cache footgun hid a zero count",
         ),
         RuleInfo(
@@ -140,7 +145,10 @@ RULES: Dict[str, RuleInfo] = {
         RuleInfo(
             "arena-pack-fused", "program", "error",
             "No per-leaf materialized copies or per-leaf arena-buffer writes "
-            "between unpack and pack — the arena step stays one concat per dtype.",
+            "between unpack and pack — the arena step stays one concat per dtype. "
+            "Megastep form (ISSUE 16): a fused dtype's buffer comes straight "
+            "out of the grid; an XLA concatenate pack for it means the fusion "
+            "silently degraded.",
         ),
         RuleInfo(
             "compile-cap", "program", "error",

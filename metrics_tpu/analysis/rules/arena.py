@@ -106,6 +106,7 @@ def check_arena_pack_fused(
     worlds: Iterable[int] = (),
     state_leaves: Optional[int] = None,
     buffer_shapes: Optional[Iterable[Tuple[Tuple[int, ...], str]]] = None,
+    fused_dtypes: Iterable[str] = (),
 ) -> List[Finding]:
     """Rule ``arena-pack-fused``: in an arena-carrying step program, flag
 
@@ -123,6 +124,14 @@ def check_arena_pack_fused(
     whose flat ``(n,)`` form never exists in its step, and matching the flat
     form there would misfire on the segmented update's legitimate per-slot
     scatters whenever a stacked state leaf happens to share it.
+
+    ``fused_dtypes`` is the megastep form (ISSUE 16): dtypes whose arena
+    buffer must come straight out of the fused grid. Under the per-leaf
+    backends one ``concatenate`` per dtype IS the pack (the design this rule
+    protects); under a megastep backend the re-pack happens inside the grid,
+    so a pack-level ``concatenate`` producing an arena-buffer-shaped output
+    of a fused dtype means the fusion silently degraded back to the XLA
+    pack — flagged structurally, not just benched.
     """
     from metrics_tpu.analysis.program import unwrap_jaxpr
 
@@ -142,14 +151,36 @@ def check_arena_pack_fused(
         if buffer_shapes is not None
         else _arena_avals(layout, worlds)
     )
+    fused = set(fused_dtypes)
     for path, eqn in _pack_level_eqns(unwrap_jaxpr(jaxpr)):
         name = eqn.primitive.name
-        if not (name.startswith("scatter") or name == "dynamic_update_slice"):
+        is_write = name.startswith("scatter") or name == "dynamic_update_slice"
+        is_concat = name == "concatenate"
+        if not (is_write or is_concat):
             continue
         out_aval = eqn.outvars[0].aval if eqn.outvars else None
         if out_aval is None or not hasattr(out_aval, "shape"):
             continue
         sig = (tuple(int(d) for d in out_aval.shape), str(out_aval.dtype))
+        if is_concat:
+            if fused and sig[1] in fused and sig in arena_sigs:
+                findings.append(Finding(
+                    rule="arena-pack-fused", severity="error", where=where, path=path,
+                    message=(
+                        f"arena buffer {sig[0]}:{sig[1]} packed by an XLA "
+                        "concatenate in a megastep program — the fused grid "
+                        "no longer emits the packed form for this dtype"
+                    ),
+                    hint=(
+                        "the megastep grid re-packs in VMEM (ops/kernels/"
+                        "pallas_megastep.py); a concatenate pack here means "
+                        "the engine split the dtype back onto the per-leaf "
+                        "path without recording a fallback — check "
+                        "MegastepPlan.fallback_reasons() against the traced "
+                        "program"
+                    ),
+                ))
+            continue
         if sig in arena_sigs:
             findings.append(Finding(
                 rule="arena-pack-fused", severity="error", where=where, path=path,

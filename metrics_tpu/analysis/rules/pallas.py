@@ -11,7 +11,17 @@ from typing import Any, List, Optional
 
 from metrics_tpu.analysis.core import Finding
 
-__all__ = ["check_no_scatter_under_pallas", "check_pallas_call_count"]
+__all__ = [
+    "check_megastep_launch_count",
+    "check_no_scatter_under_pallas",
+    "check_pallas_call_count",
+]
+
+#: substring of ``name_and_src_info`` that identifies a megastep grid — the
+#: fused kernels are all named ``_mega_*`` (ops/kernels/pallas_megastep.py),
+#: which distinguishes them from per-primitive launches (e.g. the histogram
+#: MXU kernel a delta body calls itself) in a traced step
+_MEGASTEP_KERNEL_MARK = "_mega_"
 
 
 def _scatter_paths(jaxpr: Any) -> List[str]:
@@ -79,3 +89,62 @@ def check_pallas_call_count(
             hint=hint,
         )]
     return []
+
+
+def check_megastep_launch_count(
+    jaxpr: Any,
+    n_dtypes: int,
+    extra: int = 0,
+    where: str = "",
+) -> List[Finding]:
+    """Rule ``pallas-call-per-leaf`` (megastep form, ISSUE 16): under a
+    megastep backend the steady step launches exactly ONE fused grid per
+    eligible arena dtype — launch count scales with dtypes, never leaves.
+
+    Megastep grids are identified by their kernel names (``_mega_*`` in the
+    ``pallas_call`` eqn's ``name_and_src_info``); ``n_dtypes`` is the
+    eligible-after-degradation dtype count. ``extra`` bounds the OTHER
+    launches a step may legitimately carry — per-primitive kernels a delta
+    body calls itself (ConfusionMatrix's bincount rides the histogram MXU
+    kernel) — typically the metric count, still O(dtypes)-class, so a
+    per-leaf regression (one kernel per state leaf) blows the bound."""
+    from metrics_tpu.analysis.program import iter_eqns, unwrap_jaxpr
+
+    names = [
+        str(eqn.params.get("name_and_src_info", ""))
+        for _, eqn in iter_eqns(unwrap_jaxpr(jaxpr))
+        if eqn.primitive.name == "pallas_call"
+    ]
+    mega = [nm for nm in names if _MEGASTEP_KERNEL_MARK in nm]
+    findings: List[Finding] = []
+    if len(mega) != n_dtypes:
+        findings.append(Finding(
+            rule="pallas-call-per-leaf", severity="error", where=where, path="",
+            message=(
+                f"megastep program traces {len(mega)} fused-grid pallas_call "
+                f"eqns, expected exactly {n_dtypes} (one per eligible arena "
+                "dtype)"
+            ),
+            hint=(
+                "fewer grids means a dtype silently fell off the whole-step "
+                "path (check stats.kernel_fallbacks for the reason); more "
+                "means the fold/segment/pack split back into multiple "
+                "launches — see ops/kernels/pallas_megastep.py"
+            ),
+        ))
+    budget = n_dtypes + max(0, extra)
+    if len(names) > budget:
+        findings.append(Finding(
+            rule="pallas-call-per-leaf", severity="error", where=where, path="",
+            message=(
+                f"megastep program traces {len(names)} total pallas_call eqns "
+                f"(> {budget} = dtypes + per-primitive budget) — launch count "
+                "is scaling with leaves, not dtypes"
+            ),
+            hint=(
+                "the megastep contract is O(dtypes) launches per steady step; "
+                "per-leaf fold kernels alongside the fused grids mean the "
+                "dispatcher ran BOTH paths for some leaves"
+            ),
+        ))
+    return findings

@@ -32,10 +32,11 @@ Dtype segregation is what keeps this exact: mixing dtypes in one buffer would
 force casts (lossy for int64→float32 counters) — per-dtype buffers are pure
 relayouts.
 """
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 __all__ = ["ArenaLayout"]
 
@@ -106,6 +107,29 @@ class ArenaLayout:
     def buffer_sizes(self) -> Dict[str, int]:
         """Flat element count per dtype buffer."""
         return dict(self._totals)
+
+    def leaf_slices(self) -> Tuple[Tuple[str, int, int, Tuple[int, ...], Any], ...]:
+        """The full static packing plan, one ``(dtype_key, offset, size,
+        shape, dtype)`` tuple per leaf in tree-flatten order. This is the
+        slice metadata the whole-step megakernel walks
+        (``engine/megastep.py``): column ``offset + i`` of dtype ``key``'s
+        packed buffer is element ``i`` of that leaf's ravel."""
+        return tuple((s.key, s.offset, s.size, s.shape, s.dtype) for s in self._specs)
+
+    def column_ops(self, leaf_ops: Sequence[int]) -> Dict[str, np.ndarray]:
+        """Expand a PER-LEAF integer opcode list (tree-flatten order, one
+        entry per leaf — e.g. each leaf's reduction opcode) into per-dtype
+        opcode COLUMN rows aligned with the packed buffers: ``out[key][c]`` is
+        the opcode of whichever leaf owns column ``c``. Host metadata (numpy),
+        never traced — the megastep kernels bake it in as a constant."""
+        if len(leaf_ops) != len(self._specs):
+            raise ValueError(
+                f"got {len(leaf_ops)} leaf opcodes, layout has {len(self._specs)} leaves"
+            )
+        rows = {k: np.zeros((n,), np.int32) for k, n in self._totals.items()}
+        for spec, op in zip(self._specs, leaf_ops):
+            rows[spec.key][spec.offset : spec.offset + spec.size] = int(op)
+        return rows
 
     def abstract(self) -> Dict[str, jax.ShapeDtypeStruct]:
         """``ShapeDtypeStruct`` arena dict — the AOT lowering template."""

@@ -1,0 +1,292 @@
+"""Whole-step megakernel plan: one Pallas grid per arena dtype (ISSUE 16).
+
+PR 3's arena packs every state leaf of one dtype into a single buffer; PR 4's
+kernels fold each leaf's masked row deltas in one launch PER LEAF. This module
+builds the static plan that combines the two: walk the arena's slice metadata
+(:meth:`ArenaLayout.leaf_slices`), assign every COLUMN of each dtype buffer
+its owning leaf's reduction opcode, and at step time pack all leaves' row
+deltas into one column-aligned ``(N, F)`` matrix per dtype, folded by ONE
+:func:`~metrics_tpu.ops.kernels.dispatch.megastep_fold` (or, for the
+stream-sharded engine, :func:`megastep_segment`) launch. The unpack → per-leaf
+fold → repack intermediates of the per-leaf path never exist: the packed
+delta matrix is built directly from the vmapped row deltas and the output IS
+the arena buffer.
+
+Eligibility is PER DTYPE and fully static:
+
+* every leaf of the dtype folds by ``sum``/``min``/``max`` through the
+  generic delta path (members with custom masked forms or scan-strategy
+  buffers mark their leaves ``none``) — reason ``"strategy"``;
+* the dtype is one the Pallas kernels serve (f32/bf16/i32) — ``"dtype"``;
+* the packed row fits a VMEM block (and, for the segment form, the whole
+  slot-stacked ``(S, F)`` buffer fits) — ``"vmem"``.
+
+An ineligible dtype silently degrades to the per-leaf kernels — under BOTH
+``megastep`` and ``megastep_interpret``: per-dtype degradation is the
+megakernel's contract, not an error (only an engine whose whole LAYOUT cannot
+take the path raises under interpret — ``engine/pipeline.py``). Every
+degraded dtype is visible in ``stats.kernel_fallbacks``.
+"""
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.ops.kernels.common import (
+    REDUCE_OPS,
+    VMEM_BLOCK_BYTES,
+    block_rows,
+    supported_dtype,
+)
+from metrics_tpu.ops.kernels.dispatch import megastep_fold, megastep_segment
+
+__all__ = ["MegastepPlan", "flat_reductions"]
+
+Array = jax.Array
+
+#: per-leaf marker for "this leaf cannot ride the generic delta fold"
+NO_FOLD = "none"
+
+
+def _is_collection(m: Any) -> bool:
+    return hasattr(m, "items") and not hasattr(m, "_defaults")
+
+
+def _metric_fx_tree(m: Any, foldable: bool) -> Dict[str, Any]:
+    """Per-leaf reduction names, congruent to ``m``'s state tree. A foldable
+    (delta-strategy) member contributes each state's own ``dist_reduce_fx``,
+    recursing into nested metrics with THEIR reductions — exactly the leaves
+    ``Metric._masked_reduce_into`` folds; anything else marks every leaf
+    :data:`NO_FOLD`. Mirrors ``engine/quantize.py::_flat_precisions`` so the
+    flatten order is the arena layout's."""
+    out: Dict[str, Any] = {}
+    for k in m._defaults:
+        fx = m._reductions[k] if foldable else NO_FOLD
+        out[k] = fx if fx in REDUCE_OPS else NO_FOLD
+    children = m._child_metrics()
+    if children:
+        out[m._CHILD_KEY] = {
+            name: (
+                [_metric_fx_tree(c, foldable) for c in child]
+                if isinstance(child, list)
+                else _metric_fx_tree(child, foldable)
+            )
+            for name, child in children.items()
+        }
+    return out
+
+
+def flat_reductions(metric: Any) -> List[str]:
+    """Per-leaf reduction names (``"sum"``/``"min"``/``"max"``/``"none"``)
+    in ``abstract_state`` tree-flatten order — the opcode source for
+    :meth:`ArenaLayout.column_ops`."""
+
+    def ptree(m: Any) -> Any:
+        if _is_collection(m):
+            return {k: ptree(mm) for k, mm in m.items(keep_base=True)}
+        return _metric_fx_tree(m, m.masked_update_strategy() == "delta")
+
+    return [str(f) for f in jax.tree_util.tree_leaves(ptree(metric))]
+
+
+class MegastepPlan:
+    """Static megastep plan for one metric/collection over its arena layout.
+
+    Pure metadata (shares the engine's :class:`ArenaLayout`); the apply
+    methods are traced inside the engine's step programs.
+    """
+
+    def __init__(self, metric: Any, layout: Any):
+        self._metric = metric
+        self._layout = layout
+        self._fx = flat_reductions(metric)
+        slices = layout.leaf_slices()
+        if len(self._fx) != len(slices):  # pragma: no cover - same flatten order
+            raise ValueError(
+                f"reduction list ({len(self._fx)}) does not align with the arena "
+                f"layout ({len(slices)} leaves)"
+            )
+        #: dtype key -> [(leaf_index, offset, size, shape, dtype)]
+        self._by_key: Dict[str, List[Tuple[int, int, int, Tuple[int, ...], Any]]] = {}
+        for i, (key, off, size, shape, dtype) in enumerate(slices):
+            self._by_key.setdefault(key, []).append((i, off, size, shape, dtype))
+        self._ops = layout.column_ops(
+            [REDUCE_OPS.index(f) if f in REDUCE_OPS else 0 for f in self._fx]
+        )
+        totals = layout.buffer_sizes()
+        self._reasons: Dict[str, str] = {}
+        for key, items in self._by_key.items():
+            if any(self._fx[i] not in REDUCE_OPS for i, *_ in items):
+                self._reasons[key] = "strategy"
+            elif not supported_dtype(key):
+                self._reasons[key] = "dtype"
+            elif block_rows(totals[key] * jnp.dtype(key).itemsize) is None:
+                self._reasons[key] = "vmem"
+        # member name -> rides the packed-delta path (None key = bare metric)
+        self._member_delta: Dict[Optional[str], bool] = {}
+        if _is_collection(metric):
+            for k, m in metric.items(keep_base=True):
+                self._member_delta[k] = m.masked_update_strategy() == "delta"
+        else:
+            self._member_delta[None] = metric.masked_update_strategy() == "delta"
+
+    # ------------------------------------------------------------------ queries
+
+    @property
+    def layout(self) -> Any:
+        return self._layout
+
+    def eligible_keys(self) -> Tuple[str, ...]:
+        """Dtype keys whose whole buffer updates in one megastep launch."""
+        return tuple(k for k in sorted(self._by_key) if k not in self._reasons)
+
+    def fallback_reasons(self) -> Dict[str, str]:
+        """Per-dtype degradation reasons for the ineligible keys (the
+        ``stats.kernel_fallbacks`` feed)."""
+        return dict(self._reasons)
+
+    def segment_fallback_reasons(self, num_segments: int) -> Dict[str, str]:
+        """Per-dtype reasons for the SEGMENT form: the base reasons plus
+        dtypes whose slot-stacked ``(S, F)`` buffer outgrows a VMEM block."""
+        out = dict(self._reasons)
+        for key, n in self._layout.buffer_sizes().items():
+            if key in out:
+                continue
+            if int(num_segments) * n * jnp.dtype(key).itemsize > VMEM_BLOCK_BYTES:
+                out[key] = "vmem"
+        return out
+
+    def column_mask(self, key: str, leaf_mask: List[bool]) -> np.ndarray:
+        """Boolean column mask of ``key``'s buffer selecting the leaves where
+        ``leaf_mask`` (tree-flatten order) is True — e.g. the q8-quantized
+        columns the segment kernel decodes on touch."""
+        out = np.zeros((self._layout.buffer_sizes()[key],), bool)
+        for i, off, size, *_ in self._by_key[key]:
+            if leaf_mask[i]:
+                out[off : off + size] = True
+        return out
+
+    # ------------------------------------------------------------- step bodies
+
+    def _mixed_deltas(self, tree: Any, args: Any, kwargs: Any, mask: Array) -> Any:
+        """The state-congruent "mixed" tree: delta members contribute their
+        ROW-STACKED deltas ``(N, *leaf)`` (folded later, per dtype or per
+        leaf), everything else its full masked-updated state."""
+        m = self._metric
+        n = int(mask.shape[0])
+        if _is_collection(m):
+            out: Dict[str, Any] = {}
+            for k, mm in m.items(keep_base=True):
+                fkw = mm._filter_kwargs(**kwargs)
+                if self._member_delta[k]:
+                    out[k] = mm._stacked_row_deltas(args, fkw, n)
+                else:
+                    out[k] = mm.update_state_masked(tree[k], *args, mask=mask, **fkw)
+            return out
+        if self._member_delta[None]:
+            return m._stacked_row_deltas(args, kwargs, n)
+        return m.update_state_masked(tree, *args, mask=mask, **kwargs)
+
+    def _packed_rows(self, key: str, mixed_leaves: List[Any], n: int) -> Array:
+        """Column-aligned ``(N, F)`` delta matrix for dtype ``key`` — each
+        leaf's stacked delta raveled per row into its arena columns."""
+        parts = [
+            jnp.reshape(jnp.asarray(mixed_leaves[i], dtype), (n, size))
+            for i, _off, size, _shape, dtype in self._by_key[key]
+        ]
+        return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
+
+    def apply_masked(
+        self, arena: Dict[str, Array], args: Any, kwargs: Any, mask: Array
+    ) -> Dict[str, Array]:
+        """One masked collection step over the packed arena: eligible dtypes
+        take one :func:`megastep_fold` launch each; ineligible dtypes fold
+        per leaf (the PR 4 kernels) and repack."""
+        from metrics_tpu.ops.kernels.dispatch import fold_rows_masked
+
+        n = int(mask.shape[0])
+        tree = self._layout.unpack(arena)
+        mixed = self._mixed_deltas(tree, args, kwargs, mask)
+        mixed_leaves = jax.tree_util.tree_flatten(mixed)[0]
+        state_leaves = jax.tree_util.tree_flatten(tree)[0]
+        out: Dict[str, Array] = {}
+        for key, items in self._by_key.items():
+            if key not in self._reasons:
+                rows = self._packed_rows(key, mixed_leaves, n)
+                out[key] = megastep_fold(arena[key], rows, mask, self._ops[key])
+                continue
+            parts = []
+            for i, _off, _size, _shape, dtype in items:
+                fx = self._fx[i]
+                if fx in REDUCE_OPS:
+                    leaf = fold_rows_masked(state_leaves[i], mixed_leaves[i], mask, fx)
+                else:
+                    leaf = mixed_leaves[i]
+                parts.append(jnp.ravel(jnp.asarray(leaf, dtype)))
+            out[key] = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+        return out
+
+    def apply_segmented(
+        self,
+        bufs: Dict[str, Array],
+        args: Any,
+        kwargs: Any,
+        mask: Array,
+        segment_ids: Array,
+        num_segments: int,
+        q8_stage: Optional[Dict[str, Tuple[Array, Array, Array]]] = None,
+        q8_cols: Optional[Dict[str, np.ndarray]] = None,
+    ) -> Dict[str, Array]:
+        """One segmented (multi-stream) step over the slot-stacked arena
+        buffers ``(S, F)``: pager slot ids are the segment ids. ``q8_stage``
+        maps ELIGIBLE dtype keys to ``(flags, codes, scales)`` staged
+        q8-resident slots (decoded on touch inside the grid; ``q8_cols``
+        carries each key's static quantized-column mask)."""
+        from metrics_tpu.ops.kernels.dispatch import segment_reduce_masked
+
+        m = self._metric
+        n = int(mask.shape[0])
+        num_segments = int(num_segments)
+        reasons = self.segment_fallback_reasons(num_segments)
+        if q8_stage:
+            bad = sorted(set(q8_stage) & set(reasons))
+            if bad:  # pragma: no cover - engine stages eligible dtypes only
+                raise ValueError(f"q8 staging on megastep-ineligible dtypes: {bad}")
+        if _is_collection(m):
+            mixed = {
+                k: mm._stacked_row_deltas(args, mm._filter_kwargs(**kwargs), n)
+                for k, mm in m.items(keep_base=True)
+            }
+        else:
+            mixed = m._stacked_row_deltas(args, kwargs, n)
+        mixed_leaves = jax.tree_util.tree_flatten(mixed)[0]
+        out: Dict[str, Array] = {}
+        for key, items in self._by_key.items():
+            if key not in reasons:
+                rows = self._packed_rows(key, mixed_leaves, n)
+                q8 = None
+                if q8_stage and key in q8_stage:
+                    flags, codes, scales = q8_stage[key]
+                    q8 = (flags, codes, scales, q8_cols[key])
+                out[key] = megastep_segment(
+                    bufs[key], rows, mask, segment_ids, num_segments,
+                    self._ops[key], q8=q8,
+                )
+                continue
+            parts = []
+            for i, off, size, shape, dtype in items:
+                state_leaf = jnp.reshape(
+                    bufs[key][..., off : off + size], (num_segments,) + shape
+                )
+                fx = self._fx[i]
+                if fx not in REDUCE_OPS:  # pragma: no cover - engine gates earlier
+                    raise ValueError(
+                        f"leaf {i} has no segmented reduction (fx={fx!r})"
+                    )
+                new_leaf = segment_reduce_masked(
+                    state_leaf, mixed_leaves[i], mask, segment_ids, num_segments, fx
+                )
+                parts.append(jnp.reshape(jnp.asarray(new_leaf, dtype), (num_segments, size)))
+            out[key] = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
+        return out

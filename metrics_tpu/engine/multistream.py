@@ -85,6 +85,7 @@ from metrics_tpu.engine.faults import InjectedFault
 from metrics_tpu.engine.paging import StreamPager
 from metrics_tpu.engine.pipeline import EngineConfig, StreamingEngine
 from metrics_tpu.engine.trace import ENGINE_TRACE
+from metrics_tpu.ops.kernels import MEGASTEP_BACKENDS
 from metrics_tpu.utils.data import is_batch_leaf
 from metrics_tpu.utils.exceptions import MetricsTPUUserError
 
@@ -191,6 +192,27 @@ class MultiStreamEngine(StreamingEngine):
             from metrics_tpu.engine.quantize import ArenaRowCodec
 
             self._row_codec = ArenaRowCodec.for_metric(self._metric)
+        # q8-RESIDENT cold rows (ISSUE 16): under the megastep backends a
+        # compressing stream-sharded engine seats faulted-in spilled rows
+        # WITHOUT the host dequant for the segment-eligible dtypes — their
+        # quantized columns stay ZERO in the arena while the int8 codes +
+        # per-element f32 scales ride the next routed payload as replicated
+        # leaves, and the segment grid decodes them on touch (bit-identical
+        # arithmetic: int8→f32, one f32 multiply, one cast). Staged state is
+        # host numpy and lives for exactly one round: the step consumes it
+        # (every flagged slot decodes at the grid's seed) or a failed step
+        # flushes it back through the host decode, so chaos replays stay
+        # bit-identical to fault-free runs.
+        self._q8_enabled = (
+            self._stream_shard
+            and self._compress
+            and self._row_codec is not None
+            and self._megastep_plan is not None
+            and self._kernel_tag() in MEGASTEP_BACKENDS
+        )
+        self._q8_keys: Tuple[str, ...] = ()
+        self._q8_stage: Dict[str, Any] = {}
+        self._q8_reset_stage()
 
     # -------------------------------------------------------------- capability checks
 
@@ -200,6 +222,27 @@ class MultiStreamEngine(StreamingEngine):
         # merge requirement) on top of this — a metric that folds fine but
         # cannot merge must refuse at construction, not at the first result()
         return metric.segmented_update_unsupported_reason()
+
+    def _megastep_unsupported_reason(self) -> Optional[str]:
+        if self._layout is None:
+            return "no_arena"
+        if not self._stream_shard:
+            # the unsharded engine's (S, ...)-stacked arena packs the stream
+            # axis INSIDE each leaf's columns — no per-column opcode row
+            # describes that buffer. The stream-sharded form is the megastep
+            # target: its carried buffers are (world, resident, n)
+            # slot-stacked rows, exactly the segment grid's shape (the mesh
+            # is fine there — the routed step is collective-free and the
+            # grid runs per shard).
+            return "stacked_layout"
+        return None
+
+    def _megastep_fallback_reasons(self) -> Dict[str, str]:
+        # the SEGMENT form's tighter bound: the whole (resident, n)
+        # slot-stacked buffer must fit a VMEM block, not just one row
+        if self._megastep_plan is None:
+            return {}
+        return self._megastep_plan.segment_fallback_reasons(self._resident)
 
     # ----------------------------------------------------------------- state plumbing
 
@@ -305,10 +348,82 @@ class MultiStreamEngine(StreamingEngine):
             return super()._step_callable(payload_abs, mask_abs)
         from metrics_tpu.parallel.embedded import stream_sharded_step
 
+        plan = self._megastep_plan
+        mega = plan is not None and self._kernel_tag() in MEGASTEP_BACKENDS
+        q8_keys = self._q8_keys
+        if not (mega or q8_keys):
+            return stream_sharded_step(
+                self._traced_update, self._cfg.mesh, self._cfg.axis, payload_abs, mask_abs,
+                state_template=self._abstract_state(),
+                unpack=self._layout.unpack_stacked, pack=self._layout.pack_stacked,
+            )
+        # whole-step SEGMENT megakernel body (ISSUE 16): the carried
+        # (world, resident, n) buffers are already the slot-stacked shape the
+        # segment grid folds, so the body takes them RAW (unpack/pack None) —
+        # one megastep_segment launch per eligible dtype, pager slot ids as
+        # segment ids. Staged q8-resident slots ride the payload TAIL as
+        # replicated (1, W, ...) leaves; each shard dynamically picks its own
+        # plane, so staging changes arguments, never the trace. The PER-LEAF
+        # body below also consumes the tail (substituting the staged decodes
+        # with plain jnp ops first): a mid-step ``degrade_kernel`` demotion to
+        # "xla" rebuilds on it with the SAME payload, losing nothing.
+        from jax import lax
+
+        resident = self._resident
+        axis = self._cfg.axis
+        axes = (axis,) if isinstance(axis, str) else tuple(axis)
+        # static row-major axis strides — the linear shard index must match
+        # the P(axis) dim-0 device order the router homes rows by
+        axis_sizes = [int(self._cfg.mesh.shape[a]) for a in axes]
+        q8_cols = (
+            {k: self._row_codec._q_mask[k] for k in q8_keys} if q8_keys else None
+        )
+
+        def update_fn(bufs, payload, mask):
+            a, kw = payload
+            q8_stage = None
+            if q8_keys:
+                tail = 1 + 2 * len(q8_keys)
+                a, staged = a[:-tail], a[-tail:]
+                w = lax.axis_index(axes[0])
+                for name, size in zip(axes[1:], axis_sizes[1:]):
+                    w = w * size + lax.axis_index(name)
+
+                def pick(x):
+                    return lax.dynamic_index_in_dim(x[0], w, 0, keepdims=False)
+
+                flags = pick(staged[0])
+                q8_stage = {
+                    k: (flags, pick(staged[1 + 2 * i]), pick(staged[2 + 2 * i]))
+                    for i, k in enumerate(q8_keys)
+                }
+            if mega:
+                ids, rest = a[0], a[1:]
+                return plan.apply_segmented(
+                    bufs, rest, kw, mask, ids, resident,
+                    q8_stage=q8_stage, q8_cols=q8_cols,
+                )
+            # per-leaf (demoted) body: substitute the staged decodes FIRST —
+            # the reference arithmetic, bit-identical to the grid's seed —
+            # then the ordinary segmented update on the unpacked tree
+            if q8_stage:
+                bufs = dict(bufs)
+                for k, (flags, codes, scales) in q8_stage.items():
+                    qcol = jnp.reshape(
+                        jnp.asarray(q8_cols[k], jnp.int32), (1, -1)
+                    )
+                    on = (
+                        jnp.reshape(flags.astype(jnp.int32), (-1, 1)) != 0
+                    ) & (qcol != 0)
+                    dec = (codes.astype(jnp.float32) * scales).astype(bufs[k].dtype)
+                    bufs[k] = jnp.where(on, dec, bufs[k])
+            tree = self._layout.unpack_stacked(bufs)
+            new_tree = self._traced_update(tree, (a, kw), mask)
+            return self._layout.pack_stacked(new_tree)
+
         return stream_sharded_step(
-            self._traced_update, self._cfg.mesh, self._cfg.axis, payload_abs, mask_abs,
-            state_template=self._abstract_state(),
-            unpack=self._layout.unpack_stacked, pack=self._layout.pack_stacked,
+            update_fn, self._cfg.mesh, axis, payload_abs, mask_abs,
+            state_template=self._abstract_state(), unpack=None, pack=None,
         )
 
     def _compute_program(self):
@@ -602,6 +717,7 @@ class MultiStreamEngine(StreamingEngine):
             self._pager = t["pager"]
             self._resident = t["resident"]
             self._local_streams = t["local_streams"]
+            self._q8_reset_stage()
 
     def _apply_topology(
         self, mesh: Any, world: int, policy: Any, resident_streams: Optional[int] = None,
@@ -616,6 +732,7 @@ class MultiStreamEngine(StreamingEngine):
             r = int(resident_streams) if resident_streams is not None else self._resident
             self._resident = min(max(1, r), self._local_streams)
             self._pager = StreamPager(world, self._resident)
+            self._q8_reset_stage()
 
     def _execute_payload(
         self, merged: Tuple[Tuple[Any, ...], Dict[str, Any]], n: int,
@@ -745,15 +862,27 @@ class MultiStreamEngine(StreamingEngine):
                 a_pad, kw_pad = jax.tree_util.tree_unflatten(treedef, out_leaves)
                 try:
                     self._run_padded_step(
-                        (slot_ids,) + tuple(a_pad), kw_pad, mask, bucket, valid,
+                        (slot_ids,) + tuple(a_pad) + self._q8_payload(),
+                        kw_pad, mask, bucket, valid,
                         n_coalesced if committed == 0 else 1,
                         queue_wait_us if committed == 0 else 0.0,
                         t0,
                     )
-                except InjectedFault as e:
+                    # the step's seed decoded EVERY staged slot in-device —
+                    # the staging is consumed, drop the flags
+                    self._q8_clear()
+                except BaseException as e:
+                    # a failed step never ran the grid's decode: any staged
+                    # slots' quantized columns are still zero in the arena —
+                    # flush them through the host decode (bit-identical)
+                    # before ANY recovery path reads or snapshots the state
+                    # (the shard-loss reshard below does both)
+                    self._q8_flush()
                     target = (
                         self._shard_loss_target()
-                        if e.site == "shard_loss" and not e.transient
+                        if isinstance(e, InjectedFault)
+                        and e.site == "shard_loss"
+                        and not e.transient
                         else None
                     )
                     if target is None:
@@ -866,13 +995,34 @@ class MultiStreamEngine(StreamingEngine):
             js = np.asarray([op.slot for op in loads])
             sh = self._shard_sharding()
 
-            def load_once() -> Tuple[Dict[str, Any], float]:
+            stage_q8 = bool(self._q8_keys)
+
+            def load_once() -> Tuple[Tuple[Dict[str, Any], List[Any]], float]:
                 self._fault("page_in")
                 t0 = time.perf_counter()
-                src_rows = [
-                    self._decoded_spill_row(op.shard, op.stream) or self._init_row
-                    for op in loads
-                ]
+                src_rows: List[Dict[str, np.ndarray]] = []
+                staged: List[Any] = []
+                for op in loads:
+                    raw = (
+                        self._pager.spilled_row(op.shard, op.stream) if stage_q8 else None
+                    )
+                    if raw is not None and self._row_codec.is_encoded(raw):
+                        # q8-RESIDENT seat (ISSUE 16): the eligible dtypes'
+                        # quantized columns stay int8 — seeded zero here, the
+                        # codes/scales stage host-side and the segment grid
+                        # decodes them on touch. The fault site still fires
+                        # (the exact remainder and ineligible dtypes decode
+                        # host-side as before); stage_buffers is pure in the
+                        # stored row, so the outer transient retry is safe.
+                        self._fault("quant_decode")
+                        seed, st = self._row_codec.stage_buffers(raw, self._q8_keys)
+                        src_rows.append(seed)
+                        staged.append(st)
+                    else:
+                        src_rows.append(
+                            self._decoded_spill_row(op.shard, op.stream) or self._init_row
+                        )
+                        staged.append(None)
                 new_state = {}
                 for k, buf in self._state.items():
                     rows_np = np.stack([r[k] for r in src_rows]).astype(buf.dtype)
@@ -880,12 +1030,21 @@ class MultiStreamEngine(StreamingEngine):
                     # so the eager .at update cannot drift the placement
                     new_buf = buf.at[ws, js].set(jnp.asarray(rows_np))
                     new_state[k] = jax.device_put(new_buf, sh)
-                return new_state, t0
+                return (new_state, staged), t0
 
-            new_state, t0 = self._retry_transient(load_once)
+            (new_state, staged), t0 = self._retry_transient(load_once)
             dur = (time.perf_counter() - t0) * 1e6
             self._state = new_state
             self._state_version += 1
+            # publish the staging ONLY after the scatter landed (a failed /
+            # retried load must never leave flags ahead of the buffers)
+            for op, st in zip(loads, staged):
+                if st is not None:
+                    self._q8_stage["flags"][0, op.shard, op.slot] = 1
+                    for k in self._q8_keys:
+                        codes, scales = self._q8_stage[k]
+                        codes[0, op.shard, op.slot] = st[k][0]
+                        scales[0, op.shard, op.slot] = st[k][1]
             self._stats.page_ins += len(loads)
             if tr is not None:
                 tr.complete("page_in", trace=gid, dur_us=dur, rows=len(loads))
@@ -893,6 +1052,82 @@ class MultiStreamEngine(StreamingEngine):
         if all_ops:
             self._pager.commit(all_ops, spilled)
         self._refresh_gauges()
+
+    # -------------------------------------------------------- q8-resident staging
+
+    def _q8_reset_stage(self) -> None:
+        """Recompute the staged dtype set and (re)allocate the host staging
+        arrays for the current topology — flags ``(1, W, R)`` i32 plus per
+        eligible dtype codes ``(1, W, R, n)`` i8 and scales ``(1, W, R, n)``
+        f32. Leading axis 1 keeps every leaf unambiguously broadcast
+        (replicated) against any bucket. Re-run on reshard/restore: a changed
+        ``resident`` moves the segment form's VMEM gate, so the eligible set
+        is re-judged, and the step's payload tail re-sizes with it (its
+        program key changes — the demoted/promoted step recompiles once)."""
+        if not getattr(self, "_q8_enabled", False):
+            self._q8_keys = ()
+            self._q8_stage = {}
+            return
+        fall = self._megastep_fallback_reasons()
+        self._q8_keys = tuple(
+            k
+            for k in self._megastep_plan.eligible_keys()
+            if k not in fall and k in self._row_codec._q_mask
+        )
+        if not self._q8_keys:
+            self._q8_stage = {}
+            return
+        sizes = self._layout.buffer_sizes()
+        w, r = self._world, self._resident
+        self._q8_stage = {"flags": np.zeros((1, w, r), np.int32)}
+        for k in self._q8_keys:
+            self._q8_stage[k] = (
+                np.zeros((1, w, r, sizes[k]), np.int8),
+                np.zeros((1, w, r, sizes[k]), np.float32),
+            )
+
+    def _q8_payload(self) -> Tuple[Any, ...]:
+        """The staged q8 leaves appended to every routed payload (empty when
+        staging is off): zero-filled when nothing is staged, so the payload
+        signature — and with it the program set — stays closed."""
+        if not self._q8_keys:
+            return ()
+        out: List[Any] = [self._q8_stage["flags"]]
+        for k in self._q8_keys:
+            out.extend(self._q8_stage[k])
+        return tuple(out)
+
+    def _q8_clear(self) -> None:
+        if self._q8_keys:
+            self._q8_stage["flags"].fill(0)
+
+    def _q8_flush(self) -> None:
+        """Seat any PENDING staged slots through the host decode instead: a
+        failed (or abandoned) step never ran the grid's seed, so the staged
+        slots' quantized columns are still zero in the arena. The patch is
+        the codec's own arithmetic (int8→f32, one f32 multiply, one cast) —
+        a chaos run that flushes is bit-identical to the device decode."""
+        if not self._q8_keys:
+            return
+        flags = self._q8_stage["flags"][0]
+        ws, js = np.nonzero(flags)
+        if ws.size:
+            sh = self._shard_sharding()
+            new_state = dict(self._state)
+            for k in self._q8_keys:
+                codes, scales = self._q8_stage[k]
+                mask = self._row_codec._q_mask[k]
+                rows = np.asarray(jax.device_get(new_state[k][ws, js]))
+                dec = (
+                    codes[0][ws, js].astype(np.float32) * scales[0][ws, js]
+                ).astype(rows.dtype)
+                rows[:, mask] = dec[:, mask]
+                new_state[k] = jax.device_put(
+                    new_state[k].at[ws, js].set(jnp.asarray(rows)), sh
+                )
+            self._state = new_state
+            self._state_version += 1
+        self._q8_clear()
 
     # --------------------------------------------------------------------- readers
 

@@ -129,7 +129,12 @@ from metrics_tpu.engine.stats import EngineStats
 from metrics_tpu.engine.trace import ENGINE_TRACE, TraceRecorder, render_openmetrics
 from metrics_tpu.engine.tracker import DriftDetector
 from metrics_tpu.engine.windows import WindowPolicy
-from metrics_tpu.ops.kernels import current_backend, resolve_backend, use_backend
+from metrics_tpu.ops.kernels import (
+    MEGASTEP_BACKENDS,
+    current_backend,
+    resolve_backend,
+    use_backend,
+)
 from metrics_tpu.utils.data import infer_batch_size, is_batch_leaf
 from metrics_tpu.utils.exceptions import MetricsTPUUserError
 
@@ -600,6 +605,34 @@ class StreamingEngine:
             self._cfg.kernel_backend if self._cfg.kernel_backend is not None else current_backend()
         )
         resolve_backend(self._kernel_backend)
+        # whole-step megakernel plan (ISSUE 16): static, judged ONCE here.
+        # Engine-level ineligibility (no arena / replicated mesh / stacked
+        # multistream layout — _megastep_unsupported_reason) falls back to
+        # the per-leaf kernels silently under "megastep" but RAISES under
+        # "megastep_interpret": the interpret tier exists for parity tests,
+        # and a test that silently ran the per-leaf path would be testing
+        # the wrong program. Per-DTYPE ineligibility degrades per leaf under
+        # BOTH (that degradation is the megastep contract, not an error);
+        # every fallback verdict lands in stats.kernel_fallbacks.
+        self._megastep_plan = None
+        self._megastep_reason: Optional[str] = None
+        if self._kernel_tag() in MEGASTEP_BACKENDS:
+            self._megastep_reason = self._megastep_unsupported_reason()
+            if self._megastep_reason is not None:
+                if self._kernel_tag() == "megastep_interpret":
+                    raise MetricsTPUUserError(
+                        f"kernel_backend='megastep_interpret' but this engine "
+                        f"cannot take the whole-step path: {self._megastep_reason} "
+                        f"(use 'megastep' for silent per-leaf fallback, or "
+                        f"'pallas_interpret' to test the per-leaf kernels)"
+                    )
+                self._stats.record_kernel_fallback(f"engine:{self._megastep_reason}")
+            else:
+                from metrics_tpu.engine.megastep import MegastepPlan
+
+                self._megastep_plan = MegastepPlan(metric, self._layout)
+                for key, why in sorted(self._megastep_fallback_reasons().items()):
+                    self._stats.record_kernel_fallback(f"dtype.{key}:{why}")
         self._merged_abs_memo: Optional[Any] = None
         # boundary-merge memo: (state_version, merged) — repeat reads between
         # updates (result() polls over S streams, state() after result())
@@ -657,6 +690,27 @@ class StreamingEngine:
         multi-stream needs the segmented path). Mesh-mode checks stay in
         :meth:`_serving_unsupported_reason` so every engine kind gets them."""
         return metric.masked_update_unsupported_reason()
+
+    def _megastep_unsupported_reason(self) -> Optional[str]:
+        """Why this ENGINE cannot take the whole-step megakernel path at all
+        (None = it can; per-dtype degradation is judged separately by the
+        plan). The base engine needs the packed arena as its carried form and
+        a single-device program — the replicated-mesh step bodies
+        (``sharded_local_step``/``sharded_masked_step``) own their pack/unpack
+        structure and keep the per-leaf kernels. Subclasses reroute
+        (multi-stream: stream-sharded engines take the SEGMENT form instead,
+        stacked ones cannot)."""
+        if self._layout is None:
+            return "no_arena"
+        if self._cfg.mesh is not None:
+            return "mesh"
+        return None
+
+    def _megastep_fallback_reasons(self) -> Dict[str, str]:
+        """Per-dtype degradation verdicts for THIS engine's megastep form
+        (the stream-sharded override consults the segment form's tighter
+        VMEM bound)."""
+        return self._megastep_plan.fallback_reasons() if self._megastep_plan else {}
 
     def _serving_unsupported_reason(self, metric: Any) -> Optional[str]:
         reason = self._update_path_unsupported_reason(metric)
@@ -969,6 +1023,41 @@ class StreamingEngine:
         mesh = self._cfg.mesh
 
         if mesh is None:
+            plan = self._megastep_plan
+            if plan is not None and self._kernel_tag() in MEGASTEP_BACKENDS:
+                # whole-step megakernel body: the plan folds the packed delta
+                # matrix straight into the arena buffers — the per-leaf
+                # unpack → fold → repack intermediates are never traced for
+                # eligible dtypes, which is what pins the jaxpr's pallas_call
+                # count at O(dtypes) (analysis/rules/pallas.py). The gate
+                # re-reads _kernel_tag() so a degrade_kernel demotion
+                # (megastep → xla) rebuilds on the per-leaf body naturally.
+                # Pane rings index ONE row per dtype buffer around the plan —
+                # the same runtime-indexed slice/update discipline as
+                # _step_update, applied at the BUFFER level.
+                from jax import lax
+
+                win_stacked = self._win_stacked
+
+                def step(state, payload, mask):
+                    a, kw = payload
+                    if win_stacked:
+                        pane, rest = a[0], tuple(a[1:])
+                        row = {
+                            k: lax.dynamic_index_in_dim(v, pane, 0, keepdims=False)
+                            for k, v in state.items()
+                        }
+                        new_row = plan.apply_masked(row, rest, kw, mask)
+                        new_state = {
+                            k: lax.dynamic_update_index_in_dim(v, new_row[k], pane, 0)
+                            for k, v in state.items()
+                        }
+                    else:
+                        new_state = plan.apply_masked(state, a, kw, mask)
+                    return new_state, jnp.sum(mask.astype(jnp.int32))
+
+                return step
+
             def step(state, payload, mask):
                 tree = self._unpack(state)
                 new_tree = self._step_update(tree, payload, mask)
@@ -1721,6 +1810,13 @@ class StreamingEngine:
         faults = s.faults_by_site()  # locked snapshot: producers may be firing
         if faults:
             labeled["faults_injected"] = ("site", faults)
+        fallbacks = s.kernel_fallbacks_by_reason()
+        if fallbacks:
+            # megastep degradation verdicts (ISSUE 16): how much state runs
+            # OFF the fused whole-step path, keyed "engine:<reason>" /
+            # "dtype.<key>:<reason>" — present only on engines that judged a
+            # fallback, so every other exposition stays byte-stable
+            labeled["kernel_fallbacks"] = ("reason", fallbacks)
         if s.sync_payload_exact_bytes or s.sync_payload_quant_bytes:
             # mesh engines only (non-mesh engines never record a payload):
             # bytes one shard contributed per fused sync, split by rider —

@@ -229,6 +229,49 @@ class ArenaRowCodec:
                 out[k + self.EXACT] = exact.reshape(lead + (exact.shape[-1],))
         return out
 
+    def stage_buffers(
+        self, enc: Dict[str, Any], keys: Any
+    ) -> "tuple[Dict[str, np.ndarray], Dict[str, tuple]]":
+        """Split an encoded buffer dict for DEVICE-side decode of ``keys``'s
+        quantized sections (the megastep q8-resident path, ISSUE 16).
+
+        Returns ``(seed, stage)``: ``seed`` is :meth:`decode_buffers`' output
+        except each staged key's quantized columns are left ZERO (the exact
+        remainder and every other buffer decode verbatim) — the form the
+        engine seats in the arena; ``stage[key] = (codes_elem, scales_elem)``
+        are per-ELEMENT ``(..., n)`` int8/f32 expansions aligned to the
+        buffer columns (zero outside the quantized mask), so
+        ``(codes_elem.astype(f32) * scales_elem).astype(dtype)`` over the
+        mask reproduces :meth:`decode_buffers` bit-for-bit — the same
+        int8→f32 convert, one f32 multiply, one cast the kernel seed runs.
+        """
+        keys = tuple(keys)
+        sub = dict(enc)
+        stage: Dict[str, tuple] = {}
+        for k in keys:
+            mask = self._q_mask[k]
+            codes = np.asarray(sub.pop(k + self.CODES))
+            scales = np.asarray(sub.pop(k + self.SCALES), np.float32)
+            lead = codes.shape[:-1]
+            nq = int(mask.sum())
+            n = mask.size
+            codes_elem = np.zeros(lead + (n,), np.int8)
+            scales_elem = np.zeros(lead + (n,), np.float32)
+            codes_elem[..., mask] = codes[..., :nq]
+            scales_elem[..., mask] = np.repeat(scales, self._block, axis=-1)[..., :nq]
+            stage[k] = (codes_elem, scales_elem)
+        seed = self.decode_buffers(sub)
+        for k in keys:
+            mask = self._q_mask[k]
+            lead = stage[k][0].shape[:-1]
+            n = mask.size
+            full = np.zeros(lead + (n,), np.dtype(k))
+            ek = k + self.EXACT
+            if ek in enc:
+                full[..., ~mask] = np.asarray(enc[ek]).reshape(lead + (n - int(mask.sum()),))
+            seed[k] = full
+        return seed, stage
+
     def decode_buffers(self, enc: Dict[str, Any]) -> Dict[str, np.ndarray]:
         """Inverse of :meth:`encode_buffers` — reassembles each dtype buffer
         from its coded section + verbatim remainder."""

@@ -76,6 +76,17 @@ class EngineStats:
         # lettered) batches, snapshot write failures and restore fallbacks.
         # All lifetime counters; rendered by tools/engine_report.py.
         self.faults_injected: Dict[str, int] = {}
+        # kernel-dispatch fallbacks by reason (ISSUE 16): every time the
+        # engine's megastep plan (or the per-leaf dispatcher on its behalf)
+        # declined the fused path, keyed by WHY — ``engine:<reason>`` for
+        # whole-engine ineligibility (no arena, replicated mesh, stacked
+        # multistream layout), ``dtype.<key>:<reason>`` for a single arena
+        # dtype that fell back per-leaf (strategy/dtype/vmem). Construction-
+        # time plan verdicts count ONCE (the plan is static), so the counter
+        # reads as "how much of this engine's state runs off the fused path",
+        # not a per-step rate. Rendered as the OpenMetrics
+        # ``kernel_fallbacks_total{reason=...}`` counter.
+        self.kernel_fallbacks: Dict[str, int] = {}
         self.retries = 0
         self.rollbacks = 0
         self.kernel_demotions = 0
@@ -335,6 +346,28 @@ class EngineStats:
         with self._counter_lock:
             self.faults_injected[site] = self.faults_injected.get(site, 0) + 1
 
+    def record_kernel_fallback(self, reason: str) -> None:
+        """One kernel-dispatch fallback verdict under ``reason``. Locked for
+        the same RMW class as :meth:`record_fault` — engines are built (and
+        their plans judged) on whatever thread constructs them, concurrently
+        with a dispatcher scraping another engine's shared stats object."""
+        with self._counter_lock:
+            self.kernel_fallbacks[str(reason)] = self.kernel_fallbacks.get(str(reason), 0) + 1
+
+    def kernel_fallbacks_by_reason(self) -> Dict[str, int]:
+        """One consistent snapshot of the per-reason fallback counts."""
+        with self._counter_lock:
+            return dict(self.kernel_fallbacks)
+
+    def kernels_summary(self) -> Optional[Dict[str, Any]]:
+        """The kernel-dispatch block for :meth:`summary` — None when no
+        fallback was ever recorded (every fully-fused or non-megastep engine:
+        its telemetry document stays byte-stable)."""
+        fallbacks = self.kernel_fallbacks_by_reason()
+        if not fallbacks:
+            return None
+        return {"fallbacks_by_reason": {k: fallbacks[k] for k in sorted(fallbacks)}}
+
     def faults_by_site(self) -> Dict[str, int]:
         """One consistent snapshot of the per-site fault counts. Locked: the
         admission site fires on producer threads, and an unlocked
@@ -506,6 +539,9 @@ class EngineStats:
         faults = self.fault_summary()
         if faults is not None:
             out["faults"] = faults
+        kernels = self.kernels_summary()
+        if kernels is not None:
+            out["kernels"] = kernels
         if self.mesh_sync is not None:
             out["mesh_sync"] = self._mesh_sync_summary()
         if aot_stats is not None:

@@ -15,8 +15,9 @@ Measured on v5e: XLA's own fusion of the jnp formulation already avoids material
 the (N, C, T) intermediate at the benchmark sizes (compare+reduce fuse into one
 kernel), so the Pallas path is parity rather than a win there — it exists as the
 guaranteed-streaming fallback for extreme (N*C*T) configurations and as the template
-for fusing *multiple* metric updates into one pass (the planned collection-update
-kernel).
+that the collection-update megakernel grew from
+(``ops/kernels/pallas_megastep.py``, ISSUE 16: one grid per arena dtype fusing
+every leaf's masked fold, the segment scatter, and the arena re-pack).
 """
 import functools
 from typing import Tuple
@@ -105,10 +106,23 @@ def binned_counts_pallas(
 
 
 def binned_counts(preds: Array, target_bool: Array, thresholds: Array) -> Tuple[Array, Array, Array]:
-    """Dispatch through the kernel-backend selection (``ops/kernels/dispatch``):
-    Pallas on TPU (or interpret-mode under the ``pallas_interpret`` test
-    backend), the fused jnp formulation under ``xla`` and everywhere else
-    (CPU tests, virtual meshes).
+    """Dispatch through the kernel-backend selection (``ops/kernels/dispatch``).
+
+    Selection order, most specific wins: the :func:`use_backend` context
+    (what ``EngineConfig.kernel_backend`` installs around program builds) >
+    :func:`set_default_backend` > the ``METRICS_TPU_KERNEL_BACKEND``
+    environment variable > ``"auto"`` (Pallas on TPU, XLA elsewhere). The
+    ``megastep``/``megastep_interpret`` tier (ISSUE 16) takes the SAME Pallas
+    lowering here — this kernel is a per-metric primitive, not an arena leaf,
+    so the megakernel never absorbs it; interpret variants run
+    ``interpret=True`` and re-raise kernel failures so CPU parity tests can
+    never silently test the wrong path.
+
+    Runnable example (CPU-safe)::
+
+        from metrics_tpu.ops.kernels import use_backend
+        with use_backend("pallas_interpret"):     # or "megastep_interpret"
+            tp, fp, fn = binned_counts(preds, target_bool, thresholds)
 
     The backend decision is made at trace time (it depends only on
     configuration and the platform, never on traced values), so this is safe
@@ -118,13 +132,14 @@ def binned_counts(preds: Array, target_bool: Array, thresholds: Array) -> Tuple[
     from metrics_tpu.ops.kernels import resolve_backend
 
     backend = resolve_backend()
+    interpret = backend in ("pallas_interpret", "megastep_interpret")
     if backend != "xla" and preds.ndim == 2:
         try:
             return binned_counts_pallas(
-                preds, target_bool, thresholds, interpret=backend == "pallas_interpret"
+                preds, target_bool, thresholds, interpret=interpret
             )
         except Exception:
-            if backend == "pallas_interpret":
+            if interpret:
                 raise  # CPU parity tests must see kernel failures
             # Catches eager-mode and trace-time failures only. When called under an
             # outer jit, a Mosaic *compile* failure surfaces when the outer jit

@@ -8,6 +8,11 @@ Three primitives behind a runtime backend dispatcher — see
 * :func:`segment_reduce_masked` — masked segment sum/min/max;
 * :func:`histogram_accumulate` — fused masked/weighted bincount.
 
+The whole-step megakernel tier (ISSUE 16) adds :func:`megastep_fold` /
+:func:`megastep_segment` — ONE launch per arena dtype with per-column
+reduction opcodes (``engine/megastep.py`` builds the plan; backends
+``"megastep"`` / ``"megastep_interpret"``).
+
 Smoke gate: ``make kernels-smoke`` (``metrics_tpu/ops/kernels/smoke.py``).
 """
 from metrics_tpu.ops.kernels.common import REDUCE_OPS, reduce_identity, stack_reduce
@@ -15,10 +20,13 @@ from metrics_tpu.ops.kernels.dispatch import (
     BACKEND_ENV_VAR,
     BACKENDS,
     MAX_HIST_LENGTH,
+    MEGASTEP_BACKENDS,
     current_backend,
     fold_rows_masked,
     histogram_accumulate,
     kernel_fault_scope,
+    megastep_fold,
+    megastep_segment,
     resolve_backend,
     segment_reduce_masked,
     set_default_backend,
@@ -29,11 +37,14 @@ __all__ = [
     "BACKENDS",
     "BACKEND_ENV_VAR",
     "MAX_HIST_LENGTH",
+    "MEGASTEP_BACKENDS",
     "REDUCE_OPS",
     "current_backend",
     "fold_rows_masked",
     "histogram_accumulate",
     "kernel_fault_scope",
+    "megastep_fold",
+    "megastep_segment",
     "reduce_identity",
     "resolve_backend",
     "segment_reduce_masked",

@@ -34,6 +34,11 @@ def reduce_identity(dtype: Any, fx: str) -> Any:
         return jnp.zeros((), dtype)
     if jnp.issubdtype(dtype, jnp.floating):
         return jnp.asarray(jnp.inf if fx == "min" else -jnp.inf, dtype)
+    if jnp.dtype(dtype) == jnp.bool_:
+        # min over bool is AND (identity True), max is OR (identity False) —
+        # the megastep oracle evaluates every opcode's base even for dtypes
+        # the kernels never take, so bool must not crash here
+        return jnp.asarray(fx == "min", dtype)
     info = jnp.iinfo(dtype)
     return jnp.asarray(info.max if fx == "min" else info.min, dtype)
 

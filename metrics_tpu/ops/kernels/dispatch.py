@@ -18,9 +18,23 @@ dispatch is jit/shard_map-safe):
 ``"pallas"``              compiled Pallas kernels (TPU)
 ``"pallas_interpret"``    the same kernels under ``interpret=True`` — bit-level
                           kernel-logic parity testing on CPU CI
+``"megastep"``            the whole-step megakernel tier (ISSUE 16): engines
+                          fuse the entire masked collection update into ONE
+                          Pallas grid per arena dtype
+                          (:func:`megastep_fold`/:func:`megastep_segment`);
+                          the three per-leaf primitives behave exactly as
+                          under ``"pallas"`` (they are the per-leaf fallback
+                          for arena dtypes the megakernel cannot take)
+``"megastep_interpret"``  the megastep tier under ``interpret=True`` (CPU CI);
+                          an engine whose LAYOUT cannot take the megastep path
+                          at all raises instead of silently degrading, so
+                          parity tests can never test the wrong path
+                          (per-dtype ineligibility still falls back per-leaf
+                          — that is the megakernel contract, not an error)
 ``"xla"``                 the pre-kernel XLA lowerings (``kernels/xla_ref.py``)
                           — always available, the reference path
 ``"auto"``                ``"pallas"`` on TPU platforms, ``"xla"`` elsewhere
+                          (never ``"megastep"`` — the megakernel is opt-in)
 ========================  =====================================================
 
 Selection, most specific wins:
@@ -51,6 +65,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from metrics_tpu.ops.kernels.common import (
     REDUCE_OPS,
@@ -61,16 +76,25 @@ from metrics_tpu.ops.kernels.common import (
 )
 from metrics_tpu.ops.kernels.pallas_fold import fold_rows_pallas
 from metrics_tpu.ops.kernels.pallas_hist import histogram_pallas
+from metrics_tpu.ops.kernels.pallas_megastep import (
+    megastep_fold_pallas,
+    megastep_segment_pallas,
+)
 from metrics_tpu.ops.kernels.pallas_segment import segment_reduce_pallas
 from metrics_tpu.ops.kernels.xla_ref import (
     fold_rows_ref,
     histogram_ref,
+    megastep_fold_ref,
+    megastep_segment_ref,
     segment_reduce_ref,
 )
 
 Array = jax.Array
 
-BACKENDS = ("auto", "pallas", "pallas_interpret", "xla")
+BACKENDS = ("auto", "pallas", "pallas_interpret", "megastep", "megastep_interpret", "xla")
+
+#: backends that request the whole-step megakernel engine path
+MEGASTEP_BACKENDS = ("megastep", "megastep_interpret")
 BACKEND_ENV_VAR = "METRICS_TPU_KERNEL_BACKEND"
 
 # histograms longer than this keep the XLA path: the kernel's (blk, L) one-hot
@@ -184,11 +208,17 @@ def resolve_backend(name: Optional[str] = None) -> str:
 
 
 def _pallas_or_none(backend: Optional[str]) -> Optional[bool]:
-    """None → take the XLA path; else the kernel's ``interpret`` flag."""
+    """None → take the XLA path; else the kernel's ``interpret`` flag.
+
+    The megastep tier maps onto the pallas kernels for the three per-leaf
+    primitives (``megastep`` → compiled, ``megastep_interpret`` →
+    ``interpret=True``): per-leaf calls under a megastep scope ARE the
+    per-dtype fallback path, and they must exercise the same lowering class
+    the megakernel would."""
     resolved = resolve_backend(backend)
     if resolved == "xla":
         return None
-    return resolved == "pallas_interpret"
+    return resolved in ("pallas_interpret", "megastep_interpret")
 
 
 # ------------------------------------------------------------------ primitives
@@ -273,6 +303,125 @@ def segment_reduce_masked(
     return jnp.reshape(out, (num_segments,) + trailing)
 
 
+def _op_row_info(op_row, f: int):
+    """Canonicalize a HOST opcode row: ``(1, f)`` int32 device constant plus
+    the shared reduction name when every column agrees (the kernels then skip
+    the per-column select). The opcode row is static plan metadata
+    (``engine/megastep.py``) — never a traced value."""
+    op_np = np.asarray(op_row, np.int32).reshape(-1)
+    if op_np.shape[0] != f:
+        raise ValueError(f"opcode row has {op_np.shape[0]} columns, arena has {f}")
+    uniq = {int(x) for x in np.unique(op_np)} if op_np.size else {0}
+    if not uniq <= {0, 1, 2}:
+        raise ValueError(f"megastep opcodes must index {REDUCE_OPS}, got {sorted(uniq)}")
+    uniform = REDUCE_OPS[next(iter(uniq))] if len(uniq) == 1 else None
+    return jnp.reshape(jnp.asarray(op_np, jnp.int32), (1, f)), uniform
+
+
+def megastep_fold(
+    state_buf: Array, rows: Array, mask: Array, op_row, backend: Optional[str] = None
+) -> Array:
+    """Whole-arena masked fold: ONE launch folds every leaf of a dtype.
+
+    ``state_buf`` is a packed arena buffer ``(F,)`` (every same-dtype leaf
+    raveled and concatenated, per :class:`~metrics_tpu.engine.arena
+    .ArenaLayout`), ``rows`` the column-aligned packed row deltas ``(N, F)``,
+    ``mask`` ``(N,)``, and ``op_row`` a HOST ``(F,)`` int32 opcode row (each
+    column's reduction, indices into ``REDUCE_OPS``). Returns the new buffer.
+    """
+    state = jnp.asarray(state_buf)
+    rows = jnp.asarray(rows, state.dtype)
+    n = int(rows.shape[0])
+    if n == 0:
+        return state
+    f = int(rows.shape[1])
+    op2d, uniform = _op_row_info(op_row, f)
+    state2d = jnp.reshape(state, (1, f))
+    interpret = _pallas_or_none(backend)
+    if interpret is None or not supported_dtype(rows.dtype):
+        return jnp.reshape(megastep_fold_ref(state2d, rows, mask, op2d), state.shape)
+    blk = block_rows(f * rows.dtype.itemsize)
+    if blk is None:
+        return jnp.reshape(megastep_fold_ref(state2d, rows, mask, op2d), state.shape)
+    mask_i32 = jnp.reshape(jnp.asarray(mask, bool).astype(jnp.int32), (n, 1))
+    try:
+        _maybe_kernel_fault("megastep_fold")
+        out = megastep_fold_pallas(state2d, rows, mask_i32, op2d, uniform, blk, interpret)
+    except Exception:
+        if interpret:  # parity tests must see kernel failures, not a fallback
+            raise
+        return jnp.reshape(megastep_fold_ref(state2d, rows, mask, op2d), state.shape)
+    return jnp.reshape(out, state.shape)
+
+
+def megastep_segment(
+    state_buf: Array,
+    rows: Array,
+    mask: Array,
+    segment_ids: Array,
+    num_segments: int,
+    op_row,
+    q8=None,
+    backend: Optional[str] = None,
+) -> Array:
+    """Whole-arena masked segment reduce: one launch scatters every leaf of a
+    dtype into the addressed stream slots.
+
+    ``state_buf`` is the slot-stacked arena buffer ``(S, F)`` (pager slot ids
+    ARE the segment ids), ``rows`` the packed deltas ``(N, F)``, ``op_row``
+    the per-column opcode row. ``q8``, when given, is ``(flags (S,), codes
+    (S, F) int8, scales (S, F) f32, qcol (F,) bool)`` — q8-resident cold slots
+    whose quantized columns decode on touch inside the grid (and inside the
+    reference path alike, so a fallback never skips the decode).
+    """
+    state = jnp.asarray(state_buf)
+    rows = jnp.asarray(rows, state.dtype)
+    n = int(rows.shape[0])
+    f = int(state.shape[-1])
+    op2d, uniform = _op_row_info(op_row, f)
+    q8c = None
+    if q8 is not None:
+        flags, codes, scales, qcol = q8
+        q8c = (
+            jnp.reshape(jnp.asarray(flags, jnp.int32), (num_segments, 1)),
+            jnp.asarray(codes, jnp.int8),
+            jnp.asarray(scales, jnp.float32),
+            jnp.reshape(jnp.asarray(np.asarray(qcol, bool), jnp.int32), (1, f)),
+        )
+    if n == 0:
+        # no rows fold in, but staged q8 slots still decode (the touch IS the
+        # page-in; an empty-mask step must not leave stale quantized columns)
+        if q8c is None:
+            return state
+        return megastep_segment_ref(
+            state, jnp.zeros((0, f), state.dtype), jnp.zeros((0,), bool),
+            jnp.zeros((0,), jnp.int32), num_segments, op2d, q8c,
+        )
+    interpret = _pallas_or_none(backend)
+    itemsize = rows.dtype.itemsize
+    blk = block_rows(f * itemsize)
+    if (
+        interpret is None
+        or not supported_dtype(rows.dtype)
+        or blk is None
+        or num_segments * f * itemsize > VMEM_BLOCK_BYTES
+    ):
+        return megastep_segment_ref(state, rows, mask, segment_ids, num_segments, op2d, q8c)
+    ids_i32 = jnp.reshape(jnp.asarray(segment_ids, jnp.int32), (n, 1))
+    mask_i32 = jnp.reshape(jnp.asarray(mask, bool).astype(jnp.int32), (n, 1))
+    try:
+        _maybe_kernel_fault("megastep_segment")
+        out = megastep_segment_pallas(
+            state, rows, ids_i32, mask_i32, op2d, uniform, num_segments, blk,
+            interpret, q8c,
+        )
+    except Exception:
+        if interpret:
+            raise
+        return megastep_segment_ref(state, rows, mask, segment_ids, num_segments, op2d, q8c)
+    return out
+
+
 def histogram_accumulate(
     indices: Array,
     length: int,
@@ -294,12 +443,19 @@ def histogram_accumulate(
     n = int(idx.shape[0]) if idx.ndim else 0
     interpret = _pallas_or_none(backend)
     w = None if weights is None else jnp.asarray(weights)
+    # the explicit overflow guard: past _HIST_EXACT_ROWS rows the f32 (and a
+    # fortiori the low-precision MXU) accumulation can no longer represent
+    # every integer count, so the whole call falls back to the full-precision
+    # XLA scatter path — exactness is a gate, never a best effort
     pallas_ok = (
         interpret is not None
         and 0 < n < _HIST_EXACT_ROWS
         and idx.ndim == 1
         and 0 < length <= MAX_HIST_LENGTH
-        and (w is None or (w.dtype == jnp.float32 and w.ndim in (1, 2)))
+        and (
+            w is None
+            or (w.dtype in (jnp.float32, jnp.bfloat16) and w.ndim in (1, 2))
+        )
         and block_rows(length * 4) is not None
     )
     if not pallas_ok:
@@ -308,12 +464,19 @@ def histogram_accumulate(
     # range — the kernel's exact-match one-hot drops it, like scatter does
     idx_i32 = jnp.reshape(jnp.maximum(idx.astype(jnp.int32), 0), (n, 1))
     if w is None:
-        cols = jnp.ones((n, 1), jnp.float32)
+        # unweighted counts ride the int8 MXU path: the ones column and the
+        # one-hot are both int8, the per-block contraction accumulates int32
+        # (exact), and the cross-block f32 accumulation is exact under the
+        # row-count gate above
+        cols = jnp.ones((n, 1), jnp.int8)
         squeeze, out_dtype = True, jnp.int32
     else:
+        # bf16 weights keep their width into the MXU (f32 accumulation; the
+        # products are exact because one-hot entries are 0/1) — only the
+        # result cast back to bf16 rounds, same as the reference's own sums
         squeeze = w.ndim == 1
         out_dtype = w.dtype
-        cols = jnp.reshape(w, (n, -1)).astype(jnp.float32)
+        cols = jnp.reshape(w, (n, -1))
     if mask is not None:
         m = jnp.reshape(jnp.asarray(mask, bool), (n, 1))
         cols = jnp.where(m, cols, jnp.zeros_like(cols))

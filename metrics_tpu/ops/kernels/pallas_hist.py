@@ -28,7 +28,7 @@ import jax.numpy as jnp
 Array = jax.Array
 
 
-def _hist_kernel(idx_ref, w_ref, out_ref, *, length):
+def _hist_kernel(idx_ref, w_ref, out_ref, *, length, compute_dtype):
     from jax.experimental import pallas as pl
 
     @pl.when(pl.program_id(0) == 0)
@@ -36,36 +36,48 @@ def _hist_kernel(idx_ref, w_ref, out_ref, *, length):
         out_ref[:] = jnp.zeros_like(out_ref)
 
     idx = idx_ref[:]  # (blk, 1) int32, negatives pre-clipped to 0; >=L drops
-    w = w_ref[:]  # (blk, K) f32, mask/pad already folded in as zeros
+    w = w_ref[:]  # (blk, K), mask/pad already folded in as zeros
     blk = idx.shape[0]
     bins = jax.lax.broadcasted_iota(jnp.int32, (blk, length), 1)
-    onehot = (idx == bins).astype(jnp.float32)  # (blk, L)
+    # the MXU ingests the one-hot at the weights' own width — int8 for counts
+    # (EQuARX-style low-precision contraction, int32 per-block accumulation),
+    # bf16 for bf16 weights — and every product is exact (one-hot entries are
+    # 0/1), so the f32 cross-block accumulation bound is the ONLY exactness
+    # condition either way
+    onehot = (idx == bins).astype(compute_dtype)  # (blk, L)
+    preferred = jnp.int32 if compute_dtype == jnp.int8 else jnp.float32
     contrib = jax.lax.dot_general(  # (L, K): contract the block dim on the MXU
-        onehot, w, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        onehot, w, (((0,), (0,)), ((), ())), preferred_element_type=preferred
     )
-    out_ref[:] = out_ref[:] + contrib
+    out_ref[:] = out_ref[:] + contrib.astype(jnp.float32)
 
 
 def histogram_pallas(
     idx_i32: Array,
-    weights_f32: Array,
+    weights: Array,
     length: int,
     block_n: int,
     interpret: bool,
 ) -> Array:
     """``(L, K)`` f32 histogram of pre-clipped ``(N, 1)`` indices with
-    ``(N, K)`` f32 weight columns (masked/pad rows carry zero weight)."""
+    ``(N, K)`` weight columns (masked/pad rows carry zero weight).
+
+    The weights' dtype picks the MXU input width: int8 (the dispatcher's
+    unweighted-counts path), bf16, or f32. Accumulation is f32 regardless
+    (``preferred_element_type``), so integer counts stay exact under the
+    dispatcher's ``N < 2**24`` bound on every width.
+    """
     from jax.experimental import pallas as pl
 
-    n, k = weights_f32.shape
+    n, k = weights.shape
     block_n = min(block_n, max(n, 1))
     n_pad = (-n) % block_n
     if n_pad:
         idx_i32 = jnp.pad(idx_i32, ((0, n_pad), (0, 0)))
-        weights_f32 = jnp.pad(weights_f32, ((0, n_pad), (0, 0)))
-    grid = (weights_f32.shape[0] // block_n,)
+        weights = jnp.pad(weights, ((0, n_pad), (0, 0)))
+    grid = (weights.shape[0] // block_n,)
     return pl.pallas_call(
-        functools.partial(_hist_kernel, length=length),
+        functools.partial(_hist_kernel, length=length, compute_dtype=weights.dtype),
         grid=grid,
         in_specs=[
             pl.BlockSpec((block_n, 1), lambda i: (i, 0)),
@@ -74,4 +86,4 @@ def histogram_pallas(
         out_specs=pl.BlockSpec((length, k), lambda i: (0, 0)),
         out_shape=jax.ShapeDtypeStruct((length, k), jnp.float32),
         interpret=interpret,
-    )(idx_i32, weights_f32)
+    )(idx_i32, weights)

@@ -1,0 +1,277 @@
+"""Pallas TPU kernels: the whole-step megakernel (one grid per arena dtype).
+
+PR 4 fused three per-leaf primitives; PR 3 packed state into per-dtype arena
+buffers. This module combines them: the engine packs every leaf's row-stacked
+delta into ONE ``(N, F)`` matrix per dtype (columns laid out exactly like the
+arena buffer, per :class:`~metrics_tpu.engine.arena.ArenaLayout`), and a
+single grid folds the whole matrix into the revisited ``(1, F)`` (or
+stream-stacked ``(S, F)``) arena block. Which reduction applies is a PER
+COLUMN property — each leaf's ``dist_reduce_fx`` — carried as a static
+``(1, F)`` int32 opcode row (0=sum, 1=min, 2=max, indices into
+``common.REDUCE_OPS``): the kernel computes the masked block reduction under
+every opcode's identity and compare-selects per column, so mixed-reduction
+dtypes still take one launch. When every column shares one reduction (the
+common case — a counter-only float arena is all-sum) the specialized body
+skips the select entirely and matches ``pallas_fold``/``pallas_segment``
+op-for-op.
+
+The segment form additionally decodes q8_block-RESIDENT cold rows on touch:
+slots the pager seated in compressed form arrive as int8 codes + per-element
+f32 scales + a per-slot staged flag, and the seed step substitutes
+``codes * scales`` for the (stale) quantized columns of flagged slots before
+any row folds in — the decode never materializes in HBM, and the arithmetic
+(`int8 -> f32` conversion, one f32 multiply) is bit-identical to the host
+codec's ``_decode_blocks``.
+
+Grids are one-dimensional over row blocks; outputs are revisited and
+accumulated across the sequential TPU grid steps (seeded at step 0 — the
+same sequential-execution reliance as ``pallas_fold``).
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.ops.kernels.common import reduce_identity
+
+Array = jax.Array
+
+
+def _masked_reductions(rows, m):
+    """The three masked block reductions, each under its own identity."""
+    s = jnp.sum(jnp.where(m, rows, jnp.zeros_like(rows)), axis=0, keepdims=True)
+    mn = jnp.min(
+        jnp.where(m, rows, reduce_identity(rows.dtype, "min")), axis=0, keepdims=True
+    )
+    mx = jnp.max(
+        jnp.where(m, rows, reduce_identity(rows.dtype, "max")), axis=0, keepdims=True
+    )
+    return s, mn, mx
+
+
+def _select_combine(acc, op, s, mn, mx):
+    """Per-column opcode select of the three combined accumulators."""
+    return jnp.where(
+        op == 0,
+        acc + s,
+        jnp.where(op == 1, jnp.minimum(acc, mn), jnp.maximum(acc, mx)),
+    )
+
+
+def _mega_fold_kernel(state_ref, op_ref, mask_ref, rows_ref, out_ref, *, uniform):
+    from jax.experimental import pallas as pl
+
+    @pl.when(pl.program_id(0) == 0)
+    def _seed():
+        out_ref[:] = state_ref[:]
+
+    rows = rows_ref[:]  # (blk, F) — the whole dtype's packed delta columns
+    m = mask_ref[:] != 0  # (blk, 1)
+    if uniform == "sum":
+        red = jnp.sum(jnp.where(m, rows, jnp.zeros_like(rows)), axis=0, keepdims=True)
+        out_ref[:] = out_ref[:] + red
+    elif uniform == "min":
+        ident = reduce_identity(rows.dtype, "min")
+        red = jnp.min(jnp.where(m, rows, ident), axis=0, keepdims=True)
+        out_ref[:] = jnp.minimum(out_ref[:], red)
+    elif uniform == "max":
+        ident = reduce_identity(rows.dtype, "max")
+        red = jnp.max(jnp.where(m, rows, ident), axis=0, keepdims=True)
+        out_ref[:] = jnp.maximum(out_ref[:], red)
+    else:
+        s, mn, mx = _masked_reductions(rows, m)
+        out_ref[:] = _select_combine(out_ref[:], op_ref[:], s, mn, mx)
+
+
+def megastep_fold_pallas(
+    state2d: Array,
+    rows2d: Array,
+    mask_i32: Array,
+    op_row: Array,
+    uniform,
+    block_n: int,
+    interpret: bool,
+) -> Array:
+    """``(1, F) arena ⊕ per-column masked-reduce((N, F) packed deltas)``.
+
+    Caller (the dispatcher) canonicalizes: ``state2d`` ``(1, F)``, ``rows2d``
+    ``(N, F)``, ``mask_i32`` ``(N, 1)`` int32, ``op_row`` ``(1, F)`` int32
+    opcodes; ``uniform`` is the single shared reduction name or None for the
+    per-column select body. Rows pad to a block multiple with mask 0.
+    """
+    from jax.experimental import pallas as pl
+
+    n, f = rows2d.shape
+    block_n = min(block_n, max(n, 1))
+    n_pad = (-n) % block_n
+    if n_pad:
+        rows2d = jnp.pad(rows2d, ((0, n_pad), (0, 0)))
+        mask_i32 = jnp.pad(mask_i32, ((0, n_pad), (0, 0)))
+    grid = (rows2d.shape[0] // block_n,)
+    return pl.pallas_call(
+        functools.partial(_mega_fold_kernel, uniform=uniform),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, f), lambda i: (0, 0)),
+            pl.BlockSpec((1, f), lambda i: (0, 0)),
+            pl.BlockSpec((block_n, 1), lambda i: (i, 0)),
+            pl.BlockSpec((block_n, f), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, f), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((1, f), rows2d.dtype),
+        interpret=interpret,
+    )(state2d, op_row, mask_i32, rows2d)
+
+
+def _mega_segment_body(op_ref, ids_ref, mask_ref, rows_ref, out_ref, num_segments, uniform):
+    from jax.experimental import pallas as pl
+
+    rows = rows_ref[:]  # (blk, F)
+    ids = ids_ref[:]  # (blk, 1) int32
+    m = mask_ref[:] != 0  # (blk, 1)
+
+    def body(s, _):
+        sel = m & (ids == s)
+        if uniform == "sum":
+            red = jnp.sum(jnp.where(sel, rows, jnp.zeros_like(rows)), axis=0)
+            out_ref[pl.ds(s, 1), :] = out_ref[pl.ds(s, 1), :] + red[None, :]
+        elif uniform == "min":
+            ident = reduce_identity(rows.dtype, "min")
+            red = jnp.min(jnp.where(sel, rows, ident), axis=0)
+            out_ref[pl.ds(s, 1), :] = jnp.minimum(out_ref[pl.ds(s, 1), :], red[None, :])
+        elif uniform == "max":
+            ident = reduce_identity(rows.dtype, "max")
+            red = jnp.max(jnp.where(sel, rows, ident), axis=0)
+            out_ref[pl.ds(s, 1), :] = jnp.maximum(out_ref[pl.ds(s, 1), :], red[None, :])
+        else:
+            sm = jnp.sum(jnp.where(sel, rows, jnp.zeros_like(rows)), axis=0, keepdims=True)
+            mn = jnp.min(
+                jnp.where(sel, rows, reduce_identity(rows.dtype, "min")),
+                axis=0,
+                keepdims=True,
+            )
+            mx = jnp.max(
+                jnp.where(sel, rows, reduce_identity(rows.dtype, "max")),
+                axis=0,
+                keepdims=True,
+            )
+            out_ref[pl.ds(s, 1), :] = _select_combine(
+                out_ref[pl.ds(s, 1), :], op_ref[:], sm, mn, mx
+            )
+        return 0
+
+    jax.lax.fori_loop(0, num_segments, body, 0)
+
+
+def _mega_segment_kernel(
+    state_ref, op_ref, ids_ref, mask_ref, rows_ref, out_ref, *, num_segments, uniform
+):
+    from jax.experimental import pallas as pl
+
+    @pl.when(pl.program_id(0) == 0)
+    def _seed():
+        out_ref[:] = state_ref[:]
+
+    _mega_segment_body(op_ref, ids_ref, mask_ref, rows_ref, out_ref, num_segments, uniform)
+
+
+def _mega_segment_q8_kernel(
+    state_ref,
+    op_ref,
+    qcol_ref,
+    flags_ref,
+    codes_ref,
+    scales_ref,
+    ids_ref,
+    mask_ref,
+    rows_ref,
+    out_ref,
+    *,
+    num_segments,
+    uniform,
+):
+    from jax.experimental import pallas as pl
+
+    @pl.when(pl.program_id(0) == 0)
+    def _seed():
+        base = state_ref[:]  # (S, F)
+        # decode-on-touch: flagged slots' quantized columns hold stale bits —
+        # their true value is codes * scales in f32 then cast to the arena
+        # dtype, the host codec's _decode_blocks arithmetic EXACTLY (int8 ->
+        # f32 conversion is exact, one f32 mul, one cast — so a chaos run
+        # that decodes host-side instead is bit-identical)
+        dec = (codes_ref[:].astype(jnp.float32) * scales_ref[:]).astype(base.dtype)
+        staged = (flags_ref[:] != 0) & (qcol_ref[:] != 0)  # (S,1) & (1,F) -> (S,F)
+        out_ref[:] = jnp.where(staged, dec, base)
+
+    _mega_segment_body(op_ref, ids_ref, mask_ref, rows_ref, out_ref, num_segments, uniform)
+
+
+def megastep_segment_pallas(
+    state2d: Array,
+    rows2d: Array,
+    ids_i32: Array,
+    mask_i32: Array,
+    op_row: Array,
+    uniform,
+    num_segments: int,
+    block_n: int,
+    interpret: bool,
+    q8=None,
+) -> Array:
+    """``(S, F) arena ⊕ per-column segment-reduce((N, F) packed deltas)``.
+
+    ``q8``, when given, is ``(flags (S, 1) i32, codes (S, F) i8, scales
+    (S, F) f32, qcol (1, F) i32)`` — the staged compressed-resident slots the
+    seed step decodes on touch. Pad rows carry mask 0 (their ids address
+    nothing).
+    """
+    from jax.experimental import pallas as pl
+
+    n, f = rows2d.shape
+    block_n = min(block_n, max(n, 1))
+    n_pad = (-n) % block_n
+    if n_pad:
+        rows2d = jnp.pad(rows2d, ((0, n_pad), (0, 0)))
+        ids_i32 = jnp.pad(ids_i32, ((0, n_pad), (0, 0)))
+        mask_i32 = jnp.pad(mask_i32, ((0, n_pad), (0, 0)))
+    grid = (rows2d.shape[0] // block_n,)
+    whole = lambda i: (0, 0)  # noqa: E731 - revisited whole-array blocks
+    if q8 is None:
+        return pl.pallas_call(
+            functools.partial(
+                _mega_segment_kernel, num_segments=num_segments, uniform=uniform
+            ),
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((num_segments, f), whole),
+                pl.BlockSpec((1, f), whole),
+                pl.BlockSpec((block_n, 1), lambda i: (i, 0)),
+                pl.BlockSpec((block_n, 1), lambda i: (i, 0)),
+                pl.BlockSpec((block_n, f), lambda i: (i, 0)),
+            ],
+            out_specs=pl.BlockSpec((num_segments, f), whole),
+            out_shape=jax.ShapeDtypeStruct((num_segments, f), rows2d.dtype),
+            interpret=interpret,
+        )(state2d, op_row, ids_i32, mask_i32, rows2d)
+    flags, codes, scales, qcol = q8
+    return pl.pallas_call(
+        functools.partial(
+            _mega_segment_q8_kernel, num_segments=num_segments, uniform=uniform
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((num_segments, f), whole),
+            pl.BlockSpec((1, f), whole),
+            pl.BlockSpec((1, f), whole),
+            pl.BlockSpec((num_segments, 1), whole),
+            pl.BlockSpec((num_segments, f), whole),
+            pl.BlockSpec((num_segments, f), whole),
+            pl.BlockSpec((block_n, 1), lambda i: (i, 0)),
+            pl.BlockSpec((block_n, 1), lambda i: (i, 0)),
+            pl.BlockSpec((block_n, f), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((num_segments, f), whole),
+        out_shape=jax.ShapeDtypeStruct((num_segments, f), rows2d.dtype),
+        interpret=interpret,
+    )(state2d, op_row, qcol, flags, codes, scales, ids_i32, mask_i32, rows2d)

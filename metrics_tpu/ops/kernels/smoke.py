@@ -12,7 +12,14 @@ The CI-shaped, CPU-safe proof of the kernel dispatcher's claims, in seconds
    ``kernel_backend="pallas_interpret"`` serves a ragged stream to the same
    values as the ``"xla"`` engine, inside the same compile cap
    (≤ len(buckets) update programs + 1 compute), and the two engines' program
-   keys never collide in a SHARED AotCache (backend is part of the identity).
+   keys never collide in a SHARED AotCache (backend is part of the identity);
+4. megastep phase (ISSUE 16) — the whole-step fused tier
+   (``kernel_backend="megastep_interpret"``) serves the same stream to the
+   same values under the SAME shared cache, replaying the stream compiles
+   ZERO new programs (steady state is compile-free), and the traced step's
+   fused-grid launch count equals the eligible dtype count for two
+   collections with different LEAF counts — the O(dtypes) pin, constant in
+   leaves.
 
 Exits nonzero on any violated claim. Compiled-Pallas (real TPU) parity lives
 in ``tests/ops/test_kernels_tpu.py``, marked ``requires_tpu``.
@@ -137,11 +144,92 @@ def main() -> int:
     # have compiled nothing — distinct backends MUST compile their own set
     check("backends never share executables", misses["pallas_interpret"] > 0)
 
+    # 4. megastep phase (ISSUE 16): fused-tier parity under the same shared
+    #    cache, zero steady compiles, O(dtypes) launch pin constant in leaves
+    engine = StreamingEngine(
+        MetricCollection([Accuracy(), MeanSquaredError()]),
+        EngineConfig(buckets=buckets, kernel_backend="megastep_interpret"),
+        aot_cache=cache,
+    )
+    before = cache.misses
+    with engine:
+        for p, t in batches:
+            engine.submit(p, t)
+        first_pass = {k: float(v) for k, v in engine.result().items()}
+        check(
+            "megastep parity vs xla engine",
+            all(abs(first_pass[k] - results["xla"][k]) < 1e-6 for k in first_pass),
+        )
+        check("megastep compiles its own set", cache.misses > before)
+        warm = cache.misses
+        for p, t in batches:  # replay: every bucket shape already compiled
+            engine.submit(p, t)
+        engine.result()
+        check("megastep zero steady compiles", cache.misses == warm)
+        check("megastep no fallbacks for the delta collection",
+              engine.stats.kernel_fallbacks_by_reason() == {})
+
+    from metrics_tpu.classification import ConfusionMatrix
+    from metrics_tpu.engine.megastep import flat_reductions
+    from metrics_tpu.ops.kernels import use_backend as _ub
+
+    def _mega_launches(coll):
+        """(fused-grid launches, eligible dtypes, state leaves) of the traced
+        masked step — the jaxpr op-count regression pin."""
+        eng = StreamingEngine(
+            coll, EngineConfig(buckets=(8,), kernel_backend="megastep_interpret"),
+            aot_cache=cache,
+        )
+        plan = eng._megastep_plan
+        arena = {
+            k: jnp.zeros((sz,), jnp.dtype(k))
+            for k, sz in plan.layout.buffer_sizes().items()
+        }
+        args = (
+            jnp.zeros((8,), jnp.float32), jnp.zeros((8,), jnp.int32),
+            jnp.ones((8,), bool),
+        )
+
+        def step(arena, p, t, m):
+            with _ub("megastep_interpret"):
+                return plan.apply_masked(arena, (p, t), {}, m)
+
+        jaxpr = jax.make_jaxpr(step)(arena, *args)
+
+        def walk(jx):
+            names = []
+            for eqn in jx.eqns:
+                if eqn.primitive.name == "pallas_call":
+                    names.append(str(eqn.params.get("name_and_src_info", "")))
+                for v in eqn.params.values():
+                    if hasattr(v, "eqns"):
+                        names.extend(walk(v))
+                    elif hasattr(v, "jaxpr"):
+                        names.extend(walk(v.jaxpr))
+            return names
+
+        mega = [nm for nm in walk(jaxpr.jaxpr) if "_mega_" in nm]
+        return len(mega), len(plan.eligible_keys()), len(flat_reductions(coll))
+
+    small = _mega_launches(MetricCollection([Accuracy(), MeanSquaredError()]))
+    large = _mega_launches(MetricCollection(
+        [Accuracy(), MeanSquaredError(), ConfusionMatrix(num_classes=3)]
+    ))
+    check("megastep one grid per dtype (small)", small[0] == small[1])
+    check("megastep one grid per dtype (large)", large[0] == large[1])
+    check("megastep pin covers more leaves", large[2] > small[2])
+    check(
+        "megastep launch count constant in leaves",
+        large[0] == small[0] and large[1] == small[1],
+    )
+
     if ok:
         print(
             "kernels-smoke PASS: interpret-mode parity (fold/segment/histogram, "
             "int bit-exact + float tolerance), dispatch sanity, engine parity "
-            f"across backends (compile caps {misses})"
+            f"across backends (compile caps {misses}), megastep fused tier "
+            f"(zero steady compiles, {small[0]} grids for {small[2]} -> "
+            f"{large[2]} leaves)"
         )
     return 0 if ok else 1
 

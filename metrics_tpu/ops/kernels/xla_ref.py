@@ -66,6 +66,75 @@ def segment_reduce_ref(
     return combine(state, seg, fx)
 
 
+def _op_select(op_row: Array, state: Array, s: Array, mn: Array, mx: Array) -> Array:
+    """Per-column combine select for the megastep forms (op 0=sum 1=min 2=max)."""
+    op = jnp.reshape(jnp.asarray(op_row, jnp.int32), (1, -1))
+    return jnp.where(
+        op == 0,
+        state + s,
+        jnp.where(op == 1, jnp.minimum(state, mn), jnp.maximum(state, mx)),
+    )
+
+
+def megastep_fold_ref(state2d: Array, rows2d: Array, mask: Array, op_row: Array) -> Array:
+    """Whole-arena masked row fold with PER-COLUMN reductions: every column of
+    the packed ``(N, F)`` delta matrix folds into the ``(1, F)`` arena row
+    under its own opcode (``ops/kernels/pallas_megastep.py``'s oracle)."""
+    m = jnp.reshape(jnp.asarray(mask, bool), (rows2d.shape[0], 1))
+    s = jnp.sum(jnp.where(m, rows2d, jnp.zeros_like(rows2d)), axis=0, keepdims=True)
+    mn = jnp.min(
+        jnp.where(m, rows2d, reduce_identity(rows2d.dtype, "min")), axis=0, keepdims=True
+    )
+    mx = jnp.max(
+        jnp.where(m, rows2d, reduce_identity(rows2d.dtype, "max")), axis=0, keepdims=True
+    )
+    return _op_select(op_row, state2d, s, mn, mx)
+
+
+def megastep_segment_ref(
+    state2d: Array,
+    rows2d: Array,
+    mask: Array,
+    segment_ids: Array,
+    num_segments: int,
+    op_row: Array,
+    q8=None,
+) -> Array:
+    """Whole-arena masked segment reduce with per-column reductions; with
+    ``q8 = (flags, codes, scales, qcol)`` the flagged slots' quantized columns
+    are decoded (``codes * scales``) before any row folds in — the same
+    decode-on-touch the Pallas megastep seed performs."""
+    if q8 is not None:
+        flags, codes, scales, qcol = q8
+        staged = (jnp.reshape(jnp.asarray(flags, jnp.int32), (-1, 1)) != 0) & (
+            jnp.reshape(jnp.asarray(qcol, jnp.int32), (1, -1)) != 0
+        )
+        dec = (
+            jnp.asarray(codes).astype(jnp.float32) * jnp.asarray(scales, jnp.float32)
+        ).astype(state2d.dtype)
+        state2d = jnp.where(staged, dec, state2d)
+    m = jnp.reshape(jnp.asarray(mask, bool), (rows2d.shape[0], 1))
+    ids = jnp.asarray(segment_ids, jnp.int32)
+    s = (
+        jnp.zeros((num_segments,) + rows2d.shape[1:], rows2d.dtype)
+        .at[ids]
+        .add(jnp.where(m, rows2d, jnp.zeros_like(rows2d)))
+    )
+    ident_mn = reduce_identity(rows2d.dtype, "min")
+    mn = (
+        jnp.full((num_segments,) + rows2d.shape[1:], ident_mn, rows2d.dtype)
+        .at[ids]
+        .min(jnp.where(m, rows2d, ident_mn))
+    )
+    ident_mx = reduce_identity(rows2d.dtype, "max")
+    mx = (
+        jnp.full((num_segments,) + rows2d.shape[1:], ident_mx, rows2d.dtype)
+        .at[ids]
+        .max(jnp.where(m, rows2d, ident_mx))
+    )
+    return _op_select(op_row, state2d, s, mn, mx)
+
+
 def histogram_ref(
     indices: Array,
     length: int,

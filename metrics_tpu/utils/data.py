@@ -154,11 +154,22 @@ def _flatten(x: Sequence) -> list:
 def _bincount(x: Array, minlength: int) -> Array:
     """Static-length bincount through the kernel dispatcher
     (``metrics_tpu/ops/kernels``). Actual lowering per backend: a streaming
-    Pallas one-hot × MXU-contraction accumulate on TPU (no scatter), XLA's
-    ``jnp.bincount`` scatter-add of ones elsewhere — and always under the
-    forced ``xla`` reference backend. Both paths keep ``jnp.bincount``'s
-    exact semantics: negative indices clip to bin 0, indices ``>= minlength``
-    are dropped; int32 counts.
+    Pallas one-hot × int8 MXU-contraction accumulate under ``pallas`` AND the
+    ``megastep`` tier (the megakernel fuses arena leaves, not this per-metric
+    primitive, so both tiers share the Pallas histogram; exact while the row
+    count stays below 2**24 — past that the dispatcher routes to the XLA
+    scatter rather than risk an inexact f32 count), XLA's ``jnp.bincount``
+    scatter-add of ones elsewhere — and always under the forced ``xla``
+    reference backend. Backend selection, most specific wins:
+    ``use_backend`` context > ``set_default_backend`` > the
+    ``METRICS_TPU_KERNEL_BACKEND`` env var > ``"auto"``. Runnable example::
+
+        from metrics_tpu.ops.kernels import use_backend
+        with use_backend("pallas_interpret"):
+            counts = _bincount(jnp.array([0, 2, 2, 5]), minlength=6)
+
+    All paths keep ``jnp.bincount``'s exact semantics: negative indices clip
+    to bin 0, indices ``>= minlength`` are dropped; int32 counts.
     """
     # function-level import: utils.data loads before the ops package during
     # package init, and the kernels only pull jax — no cycle, just laziness

@@ -344,6 +344,49 @@ def test_audit_catches_a_per_leaf_pack_in_the_pane_row():
     assert "arena-pack-fused" in rules, report.render()
 
 
+# ----------------------------------------------------------- megastep (ISSUE 16)
+
+
+def test_megastep_engine_audits_clean():
+    """The whole-step fused tier joins the clean sweep: the audited step is
+    one fused grid per eligible dtype, and the megastep rule forms
+    (pallas-call-per-leaf megastep pin, arena-pack-fused fused-pack pin)
+    must not false-positive on the real program."""
+    eng = _drive(StreamingEngine(
+        MetricCollection([Accuracy(), MeanSquaredError()]),
+        EngineConfig(buckets=(8,), kernel_backend="megastep_interpret"),
+    ))
+    report = EngineAnalysis().check(eng)
+    assert report.findings == [], report.render()
+
+
+def test_audit_catches_a_megastep_step_that_lost_its_grids():
+    """Broken fixture for the megastep pin: reroute the plan's fused apply
+    through the XLA reference — shapes and results survive, but the traced
+    step carries ZERO ``_mega_*`` grids where the pin demands one per
+    eligible dtype. The silent-degradation the rule exists for."""
+    from metrics_tpu.ops.kernels import use_backend
+
+    eng = _drive(StreamingEngine(
+        MetricCollection([Accuracy(), MeanSquaredError()]),
+        EngineConfig(buckets=(8,), kernel_backend="megastep_interpret"),
+    ))
+    assert EngineAnalysis().check(eng).ok  # sane before the break
+
+    plan = eng._megastep_plan
+    inner = plan.apply_masked
+
+    def degraded_apply(state, a, kw, mask):
+        with use_backend("xla"):
+            return inner(state, a, kw, mask)
+
+    plan.apply_masked = degraded_apply
+    report = EngineAnalysis().check(eng)
+    rules = {f.rule for f in report.findings}
+    assert "pallas-call-per-leaf" in rules, report.render()
+    assert any("fused-grid" in f.message for f in report.findings)
+
+
 # ----------------------------------------------------------------- baseline
 
 

@@ -20,6 +20,7 @@ from metrics_tpu.analysis import (
     check_collective_multiset,
     check_compile_cap,
     check_donation_honored,
+    check_megastep_launch_count,
     check_no_baked_host_constants,
     check_no_collectives,
     check_no_scatter_under_pallas,
@@ -30,7 +31,7 @@ from metrics_tpu.analysis import (
 )
 from metrics_tpu.engine.arena import ArenaLayout
 from metrics_tpu.metric import Metric
-from metrics_tpu.ops.kernels import fold_rows_masked, use_backend
+from metrics_tpu.ops.kernels import fold_rows_masked, megastep_fold, use_backend
 
 
 def _mesh1():
@@ -220,6 +221,54 @@ def test_pallas_call_count_exact_and_min():
     with use_backend("xla"):
         jaxpr = jax.make_jaxpr(lambda *a: one_leaf(*a))(state, rows, mask)
     assert check_pallas_call_count(jaxpr, min_count=1, where="f") != []
+
+
+def test_megastep_launch_count_pins_one_grid_per_dtype():
+    """The megastep form (ISSUE 16): a two-dtype fused step traces exactly
+    two ``_mega_*`` grids; the per-leaf path (zero fused grids) is the broken
+    fixture, and a launch total past the dtypes+primitives budget fires the
+    O(dtypes) bound."""
+    ops = np.zeros((3,), np.int32)  # all-sum opcodes
+    f32 = (jnp.zeros((3,), jnp.float32), jnp.ones((8, 3), jnp.float32))
+    i32 = (jnp.zeros((3,), jnp.int32), jnp.ones((8, 3), jnp.int32))
+    mask = jnp.ones((8,), bool)
+
+    def fused(bf, rf, bi, ri, m):
+        return megastep_fold(bf, rf, m, ops), megastep_fold(bi, ri, m, ops)
+
+    with use_backend("megastep_interpret"):
+        jaxpr = jax.make_jaxpr(lambda *a: fused(*a))(*f32, *i32, mask)
+    assert check_megastep_launch_count(jaxpr, n_dtypes=2) == []
+    # a dtype that fell off the fused path: one grid where two are pinned
+    findings = check_megastep_launch_count(jaxpr, n_dtypes=3, where="fixture/mega")
+    assert [f.rule for f in findings] == ["pallas-call-per-leaf"]
+    assert "expected exactly 3" in findings[0].message
+
+    def per_leaf(bf, rf, bi, ri, m):
+        # the broken twin: the same folds through the PER-LEAF kernels —
+        # zero fused grids in a program the megastep pin covers
+        return (
+            fold_rows_masked(bf, rf, m, "sum"),
+            fold_rows_masked(bi, ri, m, "sum"),
+        )
+
+    with use_backend("pallas_interpret"):
+        jaxpr = jax.make_jaxpr(lambda *a: per_leaf(*a))(*f32, *i32, mask)
+    findings = check_megastep_launch_count(jaxpr, n_dtypes=2, where="fixture/mega")
+    assert [f.rule for f in findings] == ["pallas-call-per-leaf"]
+    assert "0 fused-grid" in findings[0].message
+
+    def fused_plus_per_leaf(bf, rf, bi, ri, m):
+        # one fused grid AND stray per-leaf kernels: the total blows the
+        # dtypes + per-primitive budget even though a grid is present
+        out = megastep_fold(bf, rf, m, ops)
+        return out, fold_rows_masked(bi, ri, m, "sum"), fold_rows_masked(bf, rf, m, "sum")
+
+    with use_backend("megastep_interpret"):
+        jaxpr = jax.make_jaxpr(lambda *a: fused_plus_per_leaf(*a))(*f32, *i32, mask)
+    findings = check_megastep_launch_count(jaxpr, n_dtypes=1, extra=1, where="fixture/mega")
+    assert [f.rule for f in findings] == ["pallas-call-per-leaf"]
+    assert "scaling with leaves" in findings[0].message
 
 
 # ------------------------------------------------------------ donation-honored
@@ -414,6 +463,35 @@ def test_carried_state_copy_fires_but_constant_copy_does_not():
 
     jaxpr = jax.make_jaxpr(constant_copy)({"float32": jnp.zeros((8,))}, jnp.ones((4,)))
     assert check_arena_pack_fused(jaxpr, layout, state_leaves=1) == []
+
+
+def test_megastep_concat_pack_fires_only_for_fused_dtypes():
+    """The fused-pack form (ISSUE 16): the SAME per-dtype concatenate pack
+    that is the design under the per-leaf backends becomes the broken fixture
+    under megastep — a fused dtype's buffer must come out of the grid, so an
+    XLA concatenate producing it means the fusion silently degraded."""
+    layout, _ = _two_leaf_layout()
+
+    def concat_pack(arena, rows):
+        tree = layout.unpack(arena)
+        new = {k: v + jnp.sum(rows) for k, v in tree.items()}
+        return layout.pack(new)  # one concatenate -> (8,):float32
+
+    jaxpr = jax.make_jaxpr(concat_pack)({"float32": jnp.zeros((8,))}, jnp.ones((4,)))
+    # clean under the per-leaf contract (no fused dtypes declared)
+    assert check_arena_pack_fused(jaxpr, layout, state_leaves=1) == []
+    # broken under the megastep contract: float32 was supposed to be fused
+    findings = check_arena_pack_fused(
+        jaxpr, layout, where="fixture/megapack", state_leaves=1,
+        fused_dtypes=("float32",),
+    )
+    assert [f.rule for f in findings] == ["arena-pack-fused"]
+    assert "concatenate" in findings[0].message
+    assert "(8,):float32" in findings[0].message
+    # a fused dtype the program never concat-packs stays clean
+    assert check_arena_pack_fused(
+        jaxpr, layout, state_leaves=1, fused_dtypes=("int32",)
+    ) == []
 
 
 # ------------------------------------------------------------------ compile-cap

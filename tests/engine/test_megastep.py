@@ -1,0 +1,391 @@
+"""Engine-level megastep contracts (ISSUE 16): the whole-step megakernel
+behind ``EngineConfig(kernel_backend="megastep"/"megastep_interpret")``.
+
+Degenerate arena grids against eager per-batch oracles (single-leaf dtypes,
+empty-mask/pad-dominated steps, a dtype whose ONLY leaf is a scan-strategy
+buffer — which must fall back per-leaf, not miscompile), the interpret-mode
+raise for engine-level ineligibility, the ``kernel_fallbacks`` stats/
+OpenMetrics surface, the O(dtypes) pallas_call pin on the traced step, the
+windowed pane-ring under megastep, and the stream-sharded q8-resident path:
+staged decode-on-touch bit-identical to host-decode seating, and chaos
+page_in/page_out runs bit-identical to fault-free.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from metrics_tpu import Accuracy, MeanSquaredError, MetricCollection
+from metrics_tpu.classification import AUROC, ConfusionMatrix
+from metrics_tpu.engine import AotCache, EngineConfig, MultiStreamEngine, StreamingEngine
+from metrics_tpu.engine.faults import FaultInjector, FaultSpec
+from metrics_tpu.engine.megastep import MegastepPlan, flat_reductions
+from metrics_tpu.engine.traffic import zipf_traffic
+from metrics_tpu.engine.windows import WindowPolicy
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..", "tools"))
+import trace_export  # noqa: E402  (the strict OpenMetrics parser)
+
+_CACHE = AotCache()
+BUCKETS = (8, 32)
+
+
+def _coll():
+    return MetricCollection([Accuracy(), MeanSquaredError()])
+
+
+def _traffic(n_batches, seed=0, max_rows=24):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n_batches):
+        n = int(rng.randint(1, max_rows))
+        p = (rng.randint(0, 64, n) / 64.0).astype(np.float32)  # dyadic
+        t = (rng.rand(n) > 0.5).astype(np.int32)
+        out.append((p, t))
+    return out
+
+
+def _eager(metric, batches):
+    state = metric.init_state()
+    for b in batches:
+        state = metric.update_state(state, *[jnp.asarray(x) for x in b])
+    return {k: np.asarray(v) for k, v in metric.compute_from(state).items()}
+
+
+def _engine_result(metric, batches, backend, **cfg):
+    cfg.setdefault("buckets", BUCKETS)
+    eng = StreamingEngine(
+        metric, EngineConfig(kernel_backend=backend, **cfg), aot_cache=_CACHE,
+    )
+    with eng:
+        for b in batches:
+            eng.submit(*b)
+        out = eng.result()
+    res = out if isinstance(out, dict) else {type(metric).__name__: out}
+    return {k: np.asarray(v) for k, v in res.items()}, eng
+
+
+class TestStreamingEngineMegastep:
+    def test_collection_parity_vs_eager(self):
+        batches = _traffic(9, seed=1)
+        want = _eager(_coll(), batches)
+        got, eng = _engine_result(_coll(), batches, "megastep_interpret")
+        for k in want:
+            np.testing.assert_allclose(got[k], want[k], rtol=1e-5, atol=1e-6)
+        # every dtype of this collection rides the megakernel — no fallbacks
+        assert eng.stats.kernel_fallbacks_by_reason() == {}
+
+    def test_single_leaf_dtype_bit_exact(self):
+        """ConfusionMatrix is the int32 dtype's ONLY leaf: the degenerate
+        one-leaf grid must still fold bit-exactly (int sums)."""
+        rng = np.random.RandomState(2)
+
+        def build():
+            # Accuracy needs num_classes up front: inside jit the int class
+            # preds cannot infer it
+            return MetricCollection(
+                [Accuracy(num_classes=3), ConfusionMatrix(num_classes=3)]
+            )
+
+        coll = build()
+        batches = []
+        for _ in range(7):
+            n = int(rng.randint(1, 20))
+            p = rng.randint(0, 3, n).astype(np.int32)
+            t = rng.randint(0, 3, n).astype(np.int32)
+            batches.append((p, t))
+        want = _eager(build(), batches)
+        got, eng = _engine_result(coll, batches, "megastep_interpret")
+        np.testing.assert_array_equal(got["ConfusionMatrix"], want["ConfusionMatrix"])
+        np.testing.assert_allclose(got["Accuracy"], want["Accuracy"], rtol=1e-6)
+        assert eng.stats.kernel_fallbacks_by_reason() == {}
+
+    def test_pad_dominated_steps_parity(self):
+        """Single-row batches against a 32-row bucket: nearly every mask lane
+        is a pad lane, and a non-inert pad would show immediately."""
+        batches = _traffic(6, seed=3, max_rows=2)
+        want = _eager(_coll(), batches)
+        got, _ = _engine_result(_coll(), batches, "megastep_interpret", buckets=(32,))
+        for k in want:
+            np.testing.assert_allclose(got[k], want[k], rtol=1e-5, atol=1e-6)
+
+    def test_scan_only_dtype_falls_back_not_miscompiles(self):
+        """AUROC(capacity=...) is scan-strategy: its buffers mark every one of
+        its leaves 'none', so the bool/int32 dtypes (AUROC-only) AND the
+        shared float32 dtype must degrade per-leaf — with correct results and
+        one counted reason per dtype."""
+        rng = np.random.RandomState(4)
+        batches = []
+        for _ in range(5):
+            n = int(rng.randint(2, 12))
+            batches.append((rng.rand(n).astype(np.float32), (rng.rand(n) > 0.5).astype(np.int32)))
+        coll = MetricCollection([Accuracy(), AUROC(capacity=64)])
+        want = _eager(MetricCollection([Accuracy(), AUROC(capacity=64)]), batches)
+        got, eng = _engine_result(coll, batches, "megastep_interpret")
+        for k in want:
+            np.testing.assert_allclose(got[k], want[k], rtol=1e-5, atol=1e-6)
+        fallbacks = eng.stats.kernel_fallbacks_by_reason()
+        assert fallbacks == {
+            "dtype.bool:strategy": 1,
+            "dtype.float32:strategy": 1,
+            "dtype.int32:strategy": 1,
+        }
+
+    def test_interpret_raises_for_ineligible_layout(self):
+        with pytest.raises(MetricsTPUUserError, match="megastep"):
+            StreamingEngine(
+                _coll(),
+                EngineConfig(
+                    buckets=BUCKETS, kernel_backend="megastep_interpret", use_arena=False
+                ),
+            )
+
+    def test_compiled_tier_counts_engine_fallback_instead_of_raising(self):
+        """The compiled tier degrades SILENTLY for an ineligible layout —
+        construction succeeds and the verdict lands in the by-reason counter
+        (only the interpret tier raises). Results are not driven here: the
+        demoted per-leaf kernels are compiled Pallas, which this CPU CI
+        cannot execute — parity for the degraded layout is covered by the
+        interpret-tier tests above."""
+        eng = StreamingEngine(
+            _coll(),
+            EngineConfig(buckets=BUCKETS, kernel_backend="megastep", use_arena=False),
+            aot_cache=_CACHE,
+        )
+        assert eng.stats.kernel_fallbacks_by_reason() == {"engine:no_arena": 1}
+
+    def test_kernel_fallbacks_render_in_openmetrics_and_parse_strictly(self):
+        coll = MetricCollection([Accuracy(), AUROC(capacity=32)])
+        eng = StreamingEngine(
+            coll, EngineConfig(buckets=(8,), kernel_backend="megastep_interpret"),
+            aot_cache=_CACHE,
+        )
+        with eng:
+            p, t = _traffic(1, seed=6)[0]
+            eng.submit(p, t)
+            eng.result()
+            text = eng.metrics_text()
+        fams = trace_export.parse_openmetrics(text)  # strict: raises on violations
+        fam = fams["metrics_tpu_engine_kernel_fallbacks"]
+        assert fam["type"] == "counter"
+        reasons = {s["labels"]["reason"]: s["value"] for s in fam["samples"]}
+        assert reasons == {
+            "dtype.bool:strategy": 1,
+            "dtype.float32:strategy": 1,
+            "dtype.int32:strategy": 1,
+        }
+        # an engine with no fallbacks emits NO kernel_fallbacks family at all
+        clean = StreamingEngine(
+            _coll(), EngineConfig(buckets=(8,), kernel_backend="megastep_interpret"),
+            aot_cache=_CACHE,
+        )
+        with clean:
+            clean.submit(p, t)
+            clean.result()
+            assert "kernel_fallbacks" not in clean.metrics_text()
+
+    def test_traced_step_launches_one_pallas_call_per_eligible_dtype(self):
+        """The O(dtypes) pin at the jaxpr level: tracing the plan's masked
+        step body yields exactly one pallas_call equation per ELIGIBLE arena
+        dtype — leaf count never shows up in launch count."""
+        from metrics_tpu.ops.kernels import use_backend
+
+        coll = MetricCollection([Accuracy(), MeanSquaredError(), ConfusionMatrix(num_classes=3)])
+        eng = StreamingEngine(
+            coll, EngineConfig(buckets=(8,), kernel_backend="megastep_interpret"),
+            aot_cache=_CACHE,
+        )
+        plan = eng._megastep_plan
+        assert plan is not None
+        keys = plan.eligible_keys()
+        assert set(keys) == {"float32", "int32"}
+        n_leaves = len(flat_reductions(coll))
+        assert n_leaves > len(keys)  # the pin below is strictly tighter
+
+        arena = {
+            k: jnp.zeros((n,), jnp.dtype(k))
+            for k, n in plan.layout.buffer_sizes().items()
+        }
+        p = jnp.zeros((8,), jnp.float32)
+        t = jnp.zeros((8,), jnp.int32)
+        mask = jnp.ones((8,), bool)
+
+        def step(arena, p, t, mask):
+            with use_backend("megastep_interpret"):
+                return plan.apply_masked(arena, (p, t), {}, mask)
+
+        jaxpr = jax.make_jaxpr(step)(arena, p, t, mask)
+
+        def kernel_names(jx):
+            names = []
+            for eqn in jx.eqns:
+                if eqn.primitive.name == "pallas_call":
+                    names.append(str(eqn.params.get("name_and_src_info", "")))
+                for v in eqn.params.values():
+                    if hasattr(v, "eqns"):
+                        names.extend(kernel_names(v))
+                    elif hasattr(v, "jaxpr"):
+                        names.extend(kernel_names(v.jaxpr))
+            return names
+
+        names = kernel_names(jaxpr.jaxpr)
+        mega = [n for n in names if "_mega_" in n]
+        # the pin: ONE fused grid per eligible dtype, never per leaf
+        assert len(mega) == len(keys)
+        # the only other launches are per-primitive kernels a delta body calls
+        # itself (ConfusionMatrix's bincount rides the hist MXU kernel) —
+        # bounded by the metric count, not the leaf count
+        assert len(names) - len(mega) <= n_leaves - len(keys) + 1
+
+
+class TestWindowedMegastep:
+    def test_sliding_window_parity(self):
+        batches = _traffic(10, seed=7)
+        results = {}
+        for backend in ("xla", "megastep_interpret"):
+            eng = StreamingEngine(
+                _coll(),
+                EngineConfig(
+                    buckets=(32,), kernel_backend=backend,
+                    window=WindowPolicy.sliding(n_panes=3, pane_batches=2), coalesce=1,
+                ),
+                aot_cache=_CACHE,
+            )
+            with eng:
+                for b in batches:
+                    eng.submit(*b)
+                    eng.flush()
+                results[backend] = {k: np.asarray(v) for k, v in eng.result().items()}
+        for k in results["xla"]:
+            np.testing.assert_allclose(
+                results["megastep_interpret"][k], results["xla"][k],
+                rtol=1e-5, atol=1e-6,
+            )
+
+
+class TestMultiStreamMegastep:
+    def _mesh(self):
+        return Mesh(np.asarray(jax.devices()[:1]), ("dp",))
+
+    def test_unsharded_multistream_raises_under_interpret(self):
+        with pytest.raises(MetricsTPUUserError, match="megastep"):
+            MultiStreamEngine(
+                _coll(), 4,
+                EngineConfig(buckets=(8,), kernel_backend="megastep_interpret"),
+            )
+
+    def test_unsharded_multistream_counts_fallback_under_compiled_tier(self):
+        eng = MultiStreamEngine(
+            _coll(), 4,
+            EngineConfig(buckets=(8,), kernel_backend="megastep"),
+            aot_cache=_CACHE,
+        )
+        assert eng.stats.kernel_fallbacks_by_reason() == {"engine:stacked_layout": 1}
+
+    def _sharded(self, backend, metric=None, resident=2, streams=6, **cfg):
+        return MultiStreamEngine(
+            metric if metric is not None else _coll(), streams,
+            EngineConfig(
+                buckets=BUCKETS, mesh=self._mesh(), axis="dp",
+                mesh_sync="deferred", kernel_backend=backend, **cfg,
+            ),
+            aot_cache=_CACHE, stream_shard=True, resident_streams=resident,
+        )
+
+    @staticmethod
+    def _run(eng, traffic, flush_each=False):
+        with eng:
+            for sid, p, t in traffic:
+                eng.submit(sid, p, t)
+                if flush_each:
+                    eng.flush()
+            return {
+                sid: {k: np.asarray(v) for k, v in r.items()}
+                for sid, r in eng.results().items()
+            }
+
+    @staticmethod
+    def _assert_same(got, want, exact=True):
+        assert set(got) == set(want)
+        for sid in want:
+            for k in want[sid]:
+                if exact:
+                    assert np.array_equal(got[sid][k], want[sid][k], equal_nan=True), (
+                        f"stream {sid} {k}: {got[sid][k]} != {want[sid][k]}"
+                    )
+                else:
+                    np.testing.assert_allclose(
+                        got[sid][k], want[sid][k], rtol=1e-5, atol=1e-6,
+                        equal_nan=True, err_msg=f"stream {sid} {k}",
+                    )
+
+    def test_stream_shard_megastep_matches_unsharded_oracle(self):
+        """Routed megastep segment step behind the pager (resident 2 < 6
+        streams forces spills) vs the plain unsharded engine."""
+        traffic = zipf_traffic(6, 20, seed=8)
+        oracle = MultiStreamEngine(_coll(), 6, EngineConfig(buckets=BUCKETS))
+        want = self._run(oracle, traffic)
+        eng = self._sharded("megastep_interpret")
+        got = self._run(eng, traffic)
+        self._assert_same(got, want, exact=False)
+        assert eng.stats.page_outs > 0 and eng.stats.page_ins > 0
+
+    def _q8_coll(self):
+        return MetricCollection(
+            [Accuracy(), MeanSquaredError(sync_precision="q8_block")]
+        )
+
+    def test_q8_staged_decode_bit_identical_to_host_decode_seating(self):
+        """The q8-resident fast path (compressed spill rows seated by the
+        in-grid decode-on-touch) against a twin whose staging is disabled
+        (rows decode host-side before seating): per-stream results must be
+        BIT-identical — the decode arithmetic is the same, deterministic
+        submission order (flush per batch) controls the fold order."""
+        traffic = zipf_traffic(6, 24, seed=9)
+        fast = self._sharded(
+            "megastep_interpret", metric=self._q8_coll(), compress_payloads=True
+        )
+        assert fast._q8_enabled
+        got = self._run(fast, traffic, flush_each=True)
+        assert fast.stats.page_ins > 0  # spills really happened
+        assert "float32" in fast._q8_keys
+
+        twin = self._sharded(
+            "megastep_interpret", metric=self._q8_coll(), compress_payloads=True
+        )
+        twin._q8_enabled = False
+        twin._q8_reset_stage()
+        want = self._run(twin, traffic, flush_each=True)
+        self._assert_same(got, want, exact=True)
+
+    def test_q8_chaos_paging_bit_identical_to_fault_free(self):
+        """Transient page_in/page_out/quant_decode faults (retried by the
+        engine) must leave the q8-resident run bit-identical to the
+        fault-free twin."""
+        traffic = zipf_traffic(6, 18, seed=10)
+        clean = self._sharded(
+            "megastep_interpret", metric=self._q8_coll(), compress_payloads=True
+        )
+        want = self._run(clean, traffic, flush_each=True)
+
+        inj = FaultInjector(
+            seed=11,
+            plan={
+                "page_in": FaultSpec(rate=0.3, max_fires=4),
+                "page_out": FaultSpec(rate=0.3, max_fires=4),
+                "quant_decode": FaultSpec(schedule=(0,), max_fires=1),
+            },
+        )
+        chaos = self._sharded(
+            "megastep_interpret", metric=self._q8_coll(),
+            compress_payloads=True, fault_injector=inj,
+        )
+        got = self._run(chaos, traffic, flush_each=True)
+        assert sum(inj.fired.values()) > 0, "the chaos plan never fired"
+        self._assert_same(got, want, exact=True)

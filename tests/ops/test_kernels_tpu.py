@@ -16,6 +16,8 @@ import jax.numpy as jnp
 from metrics_tpu.ops.kernels import (
     fold_rows_masked,
     histogram_accumulate,
+    megastep_fold,
+    megastep_segment,
     segment_reduce_masked,
     use_backend,
 )
@@ -65,6 +67,75 @@ def test_histogram_compiled_bit_parity():
     idx = jnp.asarray(rng.randint(-2, 40, 1000).astype(np.int32))
     want, got = _pair(lambda: histogram_accumulate(idx, 37))
     assert (want == got).all()
+
+
+@pytest.mark.parametrize("dtype", ["float32", "int32"])
+def test_megastep_fold_compiled_parity(dtype):
+    """The whole-step megakernel (ISSUE 16), compiled through Mosaic, against
+    the xla oracle — mixed per-column opcodes so the select body compiles."""
+    rng = np.random.RandomState(3)
+    n, f = 400, 24
+    if dtype == "int32":
+        rows = jnp.asarray(rng.randint(-50, 50, (n, f)).astype(np.int32))
+        buf = jnp.asarray(rng.randint(-50, 50, f).astype(np.int32))
+    else:
+        rows = jnp.asarray(rng.randn(n, f).astype(np.float32))
+        buf = jnp.asarray(rng.randn(f).astype(np.float32))
+    mask = jnp.asarray(rng.rand(n) > 0.4)
+    ops = rng.randint(0, 3, f).astype(np.int32)
+
+    def run():
+        return megastep_fold(buf, rows, mask, ops)
+
+    with use_backend("xla"):
+        want = np.asarray(run())
+    with use_backend("megastep"):
+        got = np.asarray(run())
+    if dtype == "int32":
+        assert (want == got).all()
+    else:
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-4)
+
+
+def test_megastep_segment_compiled_parity_with_q8():
+    """The compiled segment megakernel with staged q8-resident slots must be
+    bit-identical to host-decoding the staged slots first (the decode
+    arithmetic contract), and float-close to the xla oracle."""
+    rng = np.random.RandomState(4)
+    n, s, f = 300, 8, 16
+    rows = jnp.asarray(rng.randn(n, f).astype(np.float32))
+    mask = jnp.asarray(rng.rand(n) > 0.4)
+    ids = jnp.asarray(rng.randint(0, s, n).astype(np.int32))
+    ops = rng.randint(0, 3, f).astype(np.int32)
+    base = rng.randn(s, f).astype(np.float32)
+    codes = rng.randint(-127, 128, (s, f)).astype(np.int8)
+    scales = (rng.rand(s, f).astype(np.float32) * 0.1 + 1e-3).astype(np.float32)
+    flags = np.zeros(s, np.int32)
+    flags[:3] = 1
+    qcol = np.zeros(f, bool)
+    qcol[::2] = True
+    decoded = base.copy()
+    on = (flags[:, None] != 0) & qcol[None, :]
+    decoded[on] = (codes.astype(np.float32) * scales)[on]
+    with use_backend("megastep"):
+        got = np.asarray(
+            megastep_segment(
+                jnp.asarray(base), rows, mask, ids, s, ops,
+                q8=(flags, codes, scales, qcol),
+            )
+        )
+        host = np.asarray(
+            megastep_segment(jnp.asarray(decoded), rows, mask, ids, s, ops)
+        )
+    assert (got == host).all()
+    with use_backend("xla"):
+        want = np.asarray(
+            megastep_segment(
+                jnp.asarray(base), rows, mask, ids, s, ops,
+                q8=(flags, codes, scales, qcol),
+            )
+        )
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-4)
 
 
 def test_compiled_hlo_contains_mosaic_kernel():

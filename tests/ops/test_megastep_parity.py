@@ -1,0 +1,284 @@
+"""Interpret-mode parity of the whole-step megakernel (ISSUE 16).
+
+``megastep_fold`` / ``megastep_segment`` — one launch per arena dtype with a
+per-column opcode row — against the ``xla_ref`` oracles, plus the contracts
+that ride them: the q8 decode-on-touch seed is bit-identical to decoding
+host-side first, an empty-mask step still decodes staged slots, the VMEM gate
+and the histogram ``_HIST_EXACT_ROWS`` overflow guard really route to the
+reference path (observed through the kernel fault hook, which fires only in
+front of a Pallas launch), and bad opcode rows are rejected loudly.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu.ops.kernels import (
+    histogram_accumulate,
+    kernel_fault_scope,
+    megastep_fold,
+    megastep_segment,
+    use_backend,
+)
+
+_RTOL = 1e-6
+_ATOL = 1e-5
+
+
+def _maxerr(a, b) -> float:
+    a = np.asarray(a, np.float64)
+    b = np.asarray(b, np.float64)
+    return float(np.max(np.abs(a - b))) if a.size else 0.0
+
+
+def _both(fn):
+    with use_backend("xla"):
+        want = fn()
+    with use_backend("megastep_interpret"):
+        got = fn()
+    return want, got
+
+
+def _mask(pattern: str, n: int, rng) -> np.ndarray:
+    if pattern == "all":
+        return np.ones(n, bool)
+    if pattern == "none":
+        return np.zeros(n, bool)
+    if pattern == "first":
+        m = np.zeros(n, bool)
+        m[0] = True
+        return m
+    return rng.rand(n) > 0.5
+
+
+def _buf_rows(dtype: str, n: int, f: int, rng):
+    if dtype.startswith("int"):
+        rows = rng.randint(-50, 50, (n, f)).astype(dtype)
+        buf = rng.randint(-50, 50, f).astype(dtype)
+    else:
+        rows = rng.randn(n, f).astype(np.float32)
+        buf = rng.randn(f).astype(np.float32)
+    return jnp.asarray(buf, dtype), jnp.asarray(rows, dtype)
+
+
+def _ops(pattern: str, f: int, rng) -> np.ndarray:
+    if pattern == "sum":
+        return np.zeros(f, np.int32)
+    if pattern == "min":
+        return np.ones(f, np.int32)
+    if pattern == "max":
+        return np.full(f, 2, np.int32)
+    return rng.randint(0, 3, f).astype(np.int32)  # mixed per-column opcodes
+
+
+_DTYPES = ("float32", "int32", "bfloat16")
+_OPS = ("sum", "min", "max", "mixed")
+_MASKS = ("all", "none", "random", "first")
+
+
+@pytest.mark.parametrize("dtype", _DTYPES)
+@pytest.mark.parametrize("op_pattern", _OPS)
+@pytest.mark.parametrize("mask_pattern", ("all", "random"))
+def test_megastep_fold_parity(dtype, op_pattern, mask_pattern):
+    rng = np.random.RandomState(hash((dtype, op_pattern, mask_pattern)) % 2**31)
+    for n, f in ((1, 1), (13, 9), (200, 33)):
+        buf, rows = _buf_rows(dtype, n, f, rng)
+        mask = jnp.asarray(_mask(mask_pattern, n, rng))
+        ops = _ops(op_pattern, f, rng)
+        want, got = _both(lambda: megastep_fold(buf, rows, mask, ops))
+        assert want.dtype == got.dtype and want.shape == got.shape == (f,)
+        if dtype.startswith("int"):
+            assert bool(jnp.all(want == got)), f"{dtype}/{op_pattern}/{mask_pattern}"
+        else:
+            tol = _ATOL + _RTOL * float(np.max(np.abs(np.asarray(want, np.float64))))
+            if dtype == "bfloat16":
+                tol = max(tol, 1e-1)
+            assert _maxerr(want, got) <= tol
+
+
+@pytest.mark.parametrize("dtype", ("float32", "int32"))
+@pytest.mark.parametrize("op_pattern", _OPS)
+@pytest.mark.parametrize("mask_pattern", _MASKS)
+def test_megastep_segment_parity(dtype, op_pattern, mask_pattern):
+    rng = np.random.RandomState(hash((dtype, op_pattern, mask_pattern)) % 2**31)
+    n, s, f = 29, 5, 11
+    _, rows = _buf_rows(dtype, n, f, rng)
+    if dtype.startswith("int"):
+        bufs = jnp.asarray(rng.randint(-50, 50, (s, f)).astype(dtype))
+    else:
+        bufs = jnp.asarray(rng.randn(s, f).astype(np.float32), dtype)
+    mask = jnp.asarray(_mask(mask_pattern, n, rng))
+    ids = jnp.asarray(rng.randint(0, s, n).astype(np.int32))
+    ops = _ops(op_pattern, f, rng)
+    want, got = _both(lambda: megastep_segment(bufs, rows, mask, ids, s, ops))
+    assert want.dtype == got.dtype and want.shape == got.shape == (s, f)
+    if dtype.startswith("int"):
+        assert bool(jnp.all(want == got))
+    else:
+        assert _maxerr(want, got) <= _ATOL + _RTOL * float(
+            np.max(np.abs(np.asarray(want, np.float64)))
+        )
+
+
+def _q8_inputs(rng, s, f, n_staged, n_qcols):
+    """A staged q8 payload plus the host-decoded equivalent state."""
+    base = rng.randn(s, f).astype(np.float32)
+    codes = rng.randint(-127, 128, (s, f)).astype(np.int8)
+    scales = (rng.rand(s, f).astype(np.float32) * 0.1 + 1e-3).astype(np.float32)
+    flags = np.zeros(s, np.int32)
+    flags[rng.choice(s, size=n_staged, replace=False)] = 1
+    qcol = np.zeros(f, bool)
+    qcol[rng.choice(f, size=n_qcols, replace=False)] = True
+    # the host-side decode the kernel seed must reproduce bit-for-bit:
+    # int8 -> f32 convert (exact), one f32 multiply, one cast
+    decoded = base.copy()
+    on = (flags[:, None] != 0) & qcol[None, :]
+    decoded[on] = (codes.astype(np.float32) * scales).astype(np.float32)[on]
+    return base, decoded, (flags, codes, scales, qcol)
+
+
+def test_megastep_segment_q8_decode_bit_identical_to_host_decode():
+    """Decode-on-touch inside the grid == decoding host-side then running the
+    same kernel without q8 — bit-identical, not merely close (the exactness
+    contract the q8-resident chaos tests lean on)."""
+    rng = np.random.RandomState(3)
+    n, s, f = 23, 6, 10
+    rows = jnp.asarray(rng.randn(n, f).astype(np.float32))
+    mask = jnp.asarray(rng.rand(n) > 0.4)
+    ids = jnp.asarray(rng.randint(0, s, n).astype(np.int32))
+    ops = rng.randint(0, 3, f).astype(np.int32)
+    base, decoded, q8 = _q8_inputs(rng, s, f, n_staged=3, n_qcols=4)
+    with use_backend("megastep_interpret"):
+        got = megastep_segment(jnp.asarray(base), rows, mask, ids, s, ops, q8=q8)
+        want = megastep_segment(jnp.asarray(decoded), rows, mask, ids, s, ops)
+    assert bool(jnp.all(got == want))
+    # and the xla reference path performs the identical decode
+    with use_backend("xla"):
+        ref = megastep_segment(jnp.asarray(base), rows, mask, ids, s, ops, q8=q8)
+        ref_dec = megastep_segment(jnp.asarray(decoded), rows, mask, ids, s, ops)
+    assert bool(jnp.all(ref == ref_dec))
+
+
+@pytest.mark.parametrize("backend", ("xla", "megastep_interpret"))
+def test_megastep_empty_mask_still_decodes_staged_slots(backend):
+    """A fully-masked (or zero-row) step must not leave stale quantized
+    columns: the touch IS the page-in, so the decode happens regardless."""
+    rng = np.random.RandomState(5)
+    s, f = 4, 7
+    base, decoded, q8 = _q8_inputs(rng, s, f, n_staged=2, n_qcols=3)
+    ops = np.zeros(f, np.int32)
+    with use_backend(backend):
+        for n in (0, 9):
+            rows = jnp.zeros((n, f), jnp.float32)
+            mask = jnp.zeros((n,), bool)
+            ids = jnp.zeros((n,), jnp.int32)
+            got = megastep_segment(jnp.asarray(base), rows, mask, ids, s, ops, q8=q8)
+            np.testing.assert_array_equal(np.asarray(got), decoded)
+
+
+def test_megastep_zero_rows_without_q8_is_identity():
+    rng = np.random.RandomState(6)
+    buf = jnp.asarray(rng.randn(8).astype(np.float32))
+    with use_backend("megastep_interpret"):
+        out = megastep_fold(buf, jnp.zeros((0, 8)), jnp.zeros((0,), bool), np.zeros(8, np.int32))
+        seg = megastep_segment(
+            jnp.asarray(rng.randn(3, 8).astype(np.float32)),
+            jnp.zeros((0, 8)), jnp.zeros((0,), bool), jnp.zeros((0,), jnp.int32),
+            3, np.zeros(8, np.int32),
+        )
+    assert bool(jnp.all(out == buf))
+    assert seg.shape == (3, 8)
+
+
+def test_megastep_bad_opcodes_rejected():
+    buf = jnp.zeros((4,), jnp.float32)
+    rows = jnp.zeros((2, 4), jnp.float32)
+    mask = jnp.ones((2,), bool)
+    with pytest.raises(ValueError, match="opcode"):
+        megastep_fold(buf, rows, mask, np.asarray([0, 1, 2, 7], np.int32))
+    with pytest.raises(ValueError, match="columns"):
+        megastep_fold(buf, rows, mask, np.zeros(3, np.int32))
+
+
+def test_megastep_ineligible_inputs_fall_back_without_a_launch():
+    """bool dtype and a VMEM-oversized (S, F) block take the reference path —
+    no Pallas launch (the fault hook never fires) and parity holds."""
+    calls = []
+    rng = np.random.RandomState(9)
+    rows_b = jnp.asarray(rng.rand(6, 3) > 0.5)
+    buf_b = jnp.zeros((3,), bool)
+    m = jnp.ones((6,), bool)
+    with use_backend("megastep_interpret"), kernel_fault_scope(calls.append):
+        got_b = megastep_fold(buf_b, rows_b, m, np.zeros(3, np.int32))
+        # 64k segments x 33 f32 columns > the VMEM block budget
+        big_s = 1 << 16
+        got_big = megastep_segment(
+            jnp.zeros((big_s, 33), jnp.float32),
+            jnp.asarray(rng.randn(4, 33).astype(np.float32)),
+            jnp.ones((4,), bool),
+            jnp.asarray([0, 1, big_s - 1, 5], jnp.int32),
+            big_s,
+            np.zeros(33, np.int32),
+        )
+    assert calls == []  # the hook fires only in front of a Pallas launch
+    with use_backend("xla"):
+        want_b = megastep_fold(buf_b, rows_b, m, np.zeros(3, np.int32))
+    assert bool(jnp.all(got_b == want_b))
+    assert float(got_big[big_s - 1, 0]) != 0.0
+
+
+# --------------------------------------------------- int8/bf16 MXU histogram
+
+
+def test_histogram_bf16_weights_parity():
+    """bf16 weights ride the MXU at their own width (f32 accumulation); only
+    the final cast rounds — tolerance is bf16 resolution, not kernel error."""
+    rng = np.random.RandomState(12)
+    n, length = 333, 25
+    idx = jnp.asarray(rng.randint(0, length, n).astype(np.int32))
+    w = jnp.asarray(rng.rand(n).astype(np.float32), jnp.bfloat16)
+    with use_backend("xla"):
+        want = histogram_accumulate(idx, length, weights=w)
+    with use_backend("pallas_interpret"):
+        got = histogram_accumulate(idx, length, weights=w)
+    assert got.dtype == want.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32), rtol=0.05, atol=0.5
+    )
+
+
+def test_histogram_counts_use_the_mxu_kernel_and_are_exact():
+    """Unweighted counts take the int8-one-hot MXU path (the hook observes
+    the launch) and stay bit-equal to ``jnp.bincount``."""
+    calls = []
+    rng = np.random.RandomState(13)
+    idx = jnp.asarray(rng.randint(-2, 40, 500).astype(np.int32))
+    with use_backend("pallas_interpret"), kernel_fault_scope(calls.append):
+        got = histogram_accumulate(idx, 37)
+    assert "histogram" in calls
+    assert got.dtype == jnp.int32
+    assert bool(jnp.all(got == jnp.bincount(idx, length=37)))
+
+
+def test_histogram_exact_rows_gate_falls_back(monkeypatch):
+    """Past ``_HIST_EXACT_ROWS`` the f32 accumulation can no longer represent
+    every integer count: the dispatcher must take the full-precision XLA
+    scatter (no Pallas launch), under the megastep tier too."""
+    from metrics_tpu.ops.kernels import dispatch
+
+    monkeypatch.setattr(dispatch, "_HIST_EXACT_ROWS", 8)
+    rng = np.random.RandomState(14)
+    idx = jnp.asarray(rng.randint(0, 5, 64).astype(np.int32))  # 64 >= the gate
+    for backend in ("pallas_interpret", "megastep_interpret"):
+        calls = []
+        with use_backend(backend), kernel_fault_scope(calls.append):
+            got = histogram_accumulate(idx, 5)
+        assert calls == [], backend
+        assert bool(jnp.all(got == jnp.bincount(idx, length=5)))
+    # below the gate the kernel serves again
+    small = idx[:7]
+    calls = []
+    with use_backend("pallas_interpret"), kernel_fault_scope(calls.append):
+        got = histogram_accumulate(small, 5)
+    assert calls == ["histogram"]
+    assert bool(jnp.all(got == jnp.bincount(small, length=5)))

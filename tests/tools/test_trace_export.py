@@ -266,6 +266,44 @@ class TestEngineReportJson:
         assert fams[pre + "resident_streams"]["type"] == "gauge"
         assert fams[pre + "resident_streams"]["samples"][0]["value"] == 16
 
+    def test_text_mode_renders_kernel_fallbacks_row(self, tmp_path, capsys):
+        # the ISSUE 16 megastep degradation block: reasons keyed
+        # "engine:<reason>" / "dtype.<key>:<reason>", rendered sorted
+        kernels = {
+            "fallbacks_by_reason": {"dtype.bool:strategy": 1, "engine:stacked_layout": 2}
+        }
+        doc = {**self.DOC, "summary": {**self.DOC["summary"], "kernels": kernels}}
+        p = tmp_path / "tele.json"
+        p.write_text(json.dumps(doc))
+        assert engine_report.main([str(p)]) == 0
+        out = capsys.readouterr().out
+        assert "kernel fallbacks" in out
+        assert "dtype.bool:strategy×1" in out and "engine:stacked_layout×2" in out
+
+    def test_text_mode_without_kernels_block_omits_the_row(self, tmp_path, capsys):
+        p = tmp_path / "tele.json"
+        p.write_text(json.dumps(self.DOC))
+        assert engine_report.main([str(p)]) == 0
+        assert "kernel fallbacks" not in capsys.readouterr().out
+
+    def test_kernel_fallbacks_exposition_parses_strictly(self):
+        # the exact labeled-counter lines pipeline.metrics_text() emits when
+        # the engine judged any megastep fallback — one sample per reason
+        pre = "metrics_tpu_engine_"
+        text = (
+            f"# TYPE {pre}kernel_fallbacks counter\n"
+            f'{pre}kernel_fallbacks_total{{reason="dtype.float32:vmem"}} 1\n'
+            f'{pre}kernel_fallbacks_total{{reason="engine:stacked_layout"}} 2\n'
+            "# EOF\n"
+        )
+        fams = trace_export.parse_openmetrics(text)
+        fam = fams[pre + "kernel_fallbacks"]
+        assert fam["type"] == "counter"
+        assert {s["labels"]["reason"]: s["value"] for s in fam["samples"]} == {
+            "dtype.float32:vmem": 1,
+            "engine:stacked_layout": 2,
+        }
+
     def test_summary_nested_trace_is_found(self, tmp_path, capsys):
         # a live telemetry() dict nests the section inside the summary
         nested = {"summary": {**self.DOC["summary"], "trace": self.DOC["trace"]},

@@ -113,6 +113,19 @@ def render(doc: dict, steps: int = 10, analysis: dict = None) -> str:
                     f"{_fmt(faults.get('snapshot_fallbacks'))}",
                 )
             )
+    kernels = s.get("kernels")
+    if kernels:
+        # megastep degradation verdicts (ISSUE 16): which dtypes/engines fell
+        # back off the fused grid and WHY — keyed "engine:<reason>" /
+        # "dtype.<key>:<reason>", counted once at construction. Engines that
+        # never judged a fallback carry no block and render exactly as before.
+        fb = kernels.get("fallbacks_by_reason", {})
+        rows.append(
+            (
+                "kernel fallbacks",
+                ", ".join(f"{k}×{v}" for k, v in sorted(fb.items())) if fb else "none",
+            )
+        )
     ms = s.get("mesh_sync")
     if ms:
         share = ms.get("collective_share")
