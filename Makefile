@@ -2,9 +2,9 @@
 # Default flow runs the smoke checks (seconds) before the full suite.
 # Sidecar artifacts (telemetry JSON, analysis reports) land under out/
 # (gitignored) — never in the repo root.
-.PHONY: all test engine-smoke kernels-smoke mesh-smoke streams-smoke chaos-smoke obs-smoke quant-smoke elastic-smoke windows-smoke fleet-smoke analyze clean native bench
+.PHONY: all test engine-smoke kernels-smoke mesh-smoke streams-smoke chaos-smoke obs-smoke quant-smoke elastic-smoke windows-smoke fleet-smoke ragged-smoke analyze clean native bench
 
-all: engine-smoke kernels-smoke mesh-smoke streams-smoke chaos-smoke obs-smoke quant-smoke elastic-smoke windows-smoke fleet-smoke analyze test
+all: engine-smoke kernels-smoke mesh-smoke streams-smoke chaos-smoke obs-smoke quant-smoke elastic-smoke windows-smoke fleet-smoke ragged-smoke analyze test
 
 test:
 	python -m pytest tests/ -q
@@ -110,6 +110,18 @@ windows-smoke:
 # (orphan cleanup). Docs: docs/distributed.md "Multi-host serving".
 fleet-smoke:
 	JAX_PLATFORMS=cpu python -m metrics_tpu.engine.fleet.harness
+
+# Ragged-serving gate (ISSUE 17), CPU-safe (bootstraps the 8-device virtual
+# mesh, metrics_tpu/engine/ragged_smoke.py): RetrievalMAP group-keyed traffic
+# through a deferred-mesh RaggedEngine bit-exact vs the eager oracle with
+# ZERO steady compiles over reset+replay; detection MeanAveragePrecision
+# served exact on every result key; kill/resume replay exact (and a
+# non-ragged snapshot refused with the typed provenance message); windows +
+# group_shard (the stream-shard pager at group grain) composition exact;
+# plain-engine refusal typed; program audit clean. Docs: docs/serving.md
+# "Ragged serving".
+ragged-smoke:
+	JAX_PLATFORMS=cpu python -m metrics_tpu.engine.ragged_smoke
 
 # Static-analysis gate, CPU-safe (metrics_tpu/analysis + tools/analyze.py):
 # program plane audits the bootstrap engine matrix ({step,deferred} x

@@ -1598,6 +1598,35 @@ def bench_fleet_sync() -> dict:
     return json.loads(proc.stdout.strip().splitlines()[-1])
 
 
+# ---------------------------------------------- config: ragged serving (r17)
+
+def bench_ragged_serving() -> dict:
+    """Group-keyed ragged serving (ISSUE 17): G=512 Zipfian query groups of
+    retrieval traffic through a deferred-mesh ``RaggedEngine``, in ONE
+    subprocess run (``metrics_tpu/engine/ragged_bench`` owns the pinned
+    protocol — queries/s over the ingest+aggregate wall, the eager host-loop
+    baseline measured in the same process, ratios-in-one-run). Absolute
+    rates on the virtual mesh carry ``liveness_only``; the durable facts are
+    the ASSERTED zero steady-state compiles over a reset()+replay, the
+    served/eager value agreement, and the Zipf hot-group capacity shape."""
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    )
+    env["JAX_PLATFORMS"] = "cpu"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "metrics_tpu.engine.ragged_bench"],
+            env=env, capture_output=True, text=True, timeout=900,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": "ragged_serving timed out"}
+    if proc.returncode != 0:
+        return {"error": proc.stderr[-500:]}
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
 # ---------------------------------------------- config: tracing overhead (r9)
 
 def bench_obs_overhead() -> dict:
@@ -2566,6 +2595,7 @@ def main() -> None:
         ("engine_mesh_dispatch", bench_engine_mesh_dispatch),
         ("stream_capacity", bench_stream_capacity),
         ("fleet_sync", bench_fleet_sync),
+        ("ragged_serving", bench_ragged_serving),
         ("obs_overhead", bench_obs_overhead),
         ("kernel_microbench", bench_kernel_microbench),
     ):

@@ -164,6 +164,30 @@ def bootstrap_engines(
                 engine.submit(*b)
             engine.result()
         out.append((f"windowed/arena/single/{backend}", engine))
+        # RAGGED engine (ISSUE 17): group-keyed ingestion — the audited step
+        # is the REAL grouped capacity write (one stable lexsort + mode="drop"
+        # scatters over (groups, cap) buffers) on a 1-device deferred mesh,
+        # so `no-collectives-in-deferred-step` pins the grouped steady step
+        # at jaxpr AND HLO level exactly like the dense engines (broken-
+        # fixture proof: a psum smuggled into the grouped step fails the
+        # rule — tests/analysis/test_engine_audit.py)
+        from metrics_tpu import RetrievalMAP
+        from metrics_tpu.engine import RaggedEngine
+
+        engine = RaggedEngine(
+            RetrievalMAP(), num_groups=4,
+            config=EngineConfig(
+                buckets=(8,), kernel_backend=backend,
+                mesh=mesh, axis="dp", mesh_sync="deferred",
+            ),
+            capacity=16,
+        )
+        with engine:
+            for i, (p, t) in enumerate(batches):
+                gids = (np.arange(p.shape[0]) % 4).astype(np.int32)
+                engine.submit(gids, p, t.astype(np.float32))
+            engine.result(0)
+        out.append((f"ragged/arena/grouped/{backend}", engine))
     # MEGASTEP engines (ISSUE 16): the whole-step fused tier joins the matrix
     # outside the backend loop — megastep is arena-only and opt-in (the
     # interpret tier refuses ineligible layouts outright), so the per-leaf /

@@ -30,7 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from metrics_tpu.metric import Metric
+from metrics_tpu.metric import GroupedField, GroupedUpdateSpec, Metric
 
 Array = jax.Array
 
@@ -303,6 +303,128 @@ class MAP(Metric):
         for item in target:
             self.groundtruth_boxes.append(_box_convert_np(item["boxes"], self.box_format))
             self.groundtruth_labels.append(np.ravel(np.asarray(item["labels"])))
+
+    # ----------------------------------------------- ragged serving (ISSUE 17)
+    #
+    # An image id IS a group key: detection rows (boxes) and groundtruth rows
+    # share one per-image capacity buffer, discriminated by an ``is_gt`` flag
+    # column. The aggregate read rebuilds the five eager list states per image
+    # (in image-id order) and runs the unmodified eager ``compute`` — the
+    # COCO matching/accumulation code never learns about serving. Note the
+    # semantic shift the group key buys: eager ``update`` identifies images
+    # POSITIONALLY (every call appends new images), while ragged ingestion
+    # accumulates rows UNDER an explicit image id across calls.
+
+    # per-image row budget (dets + gts share it); override the attribute or
+    # pass capacity= to RaggedEngine for denser scenes
+    grouped_capacity: int = 128
+
+    def grouped_update_spec(self) -> Optional[GroupedUpdateSpec]:
+        return GroupedUpdateSpec(
+            fields=(
+                GroupedField("box", (4,), jnp.float32),
+                GroupedField("score", (), jnp.float32),
+                GroupedField("label", (), jnp.int32),
+                GroupedField("is_gt", (), jnp.int32),
+            ),
+            capacity=int(self.grouped_capacity),
+        )
+
+    def grouped_encode(
+        self,
+        preds: List[Dict[str, Array]],
+        target: List[Dict[str, Array]],
+        image_ids: Sequence[int],
+    ) -> Tuple[Any, ...]:
+        """Flatten one eager ``update`` call to per-row arrays keyed by image
+        id: each image contributes its detection rows (xyxy box, score, label,
+        is_gt=0) then its groundtruth rows (xyxy box, score 0, label, is_gt=1),
+        validated exactly like ``update`` (``_input_validator`` + the same
+        ``_box_convert_np`` coercion)."""
+        _input_validator(preds, target)
+        if len(image_ids) != len(preds):
+            raise ValueError(
+                "Expected `image_ids` to list one group key per image "
+                f"(got {len(image_ids)} ids for {len(preds)} images)"
+            )
+        gids: List[np.ndarray] = []
+        boxes: List[np.ndarray] = []
+        scores: List[np.ndarray] = []
+        labels: List[np.ndarray] = []
+        is_gt: List[np.ndarray] = []
+        for gid, p, t in zip(image_ids, preds, target):
+            db = _box_convert_np(p["boxes"], self.box_format)
+            gb = _box_convert_np(t["boxes"], self.box_format)
+            nd, ng = db.shape[0], gb.shape[0]
+            gids.append(np.full(nd + ng, int(gid), np.int32))
+            boxes.append(db)
+            boxes.append(gb)
+            scores.append(np.ravel(np.asarray(p["scores"])).astype(np.float32))
+            scores.append(np.zeros(ng, np.float32))
+            labels.append(np.ravel(np.asarray(p["labels"])).astype(np.int32))
+            labels.append(np.ravel(np.asarray(t["labels"])).astype(np.int32))
+            is_gt.append(np.zeros(nd, np.int32))
+            is_gt.append(np.ones(ng, np.int32))
+        return (
+            np.concatenate(gids) if gids else np.zeros(0, np.int32),
+            np.concatenate(boxes) if boxes else np.zeros((0, 4), np.float32),
+            np.concatenate(scores) if scores else np.zeros(0, np.float32),
+            np.concatenate(labels) if labels else np.zeros(0, np.int32),
+            np.concatenate(is_gt) if is_gt else np.zeros(0, np.int32),
+        )
+
+    def grouped_group_value(
+        self, fields: Dict[str, Array], count: Array, capacity: int
+    ) -> Dict[str, Array]:
+        """Traced per-image occupancy read (``result(image_id)``): detection
+        and groundtruth row counts in this image's buffer. The COCO value
+        itself is corpus-level (class axes, global score ranking), so the
+        per-group read reports the ingested shape, not a per-image AP."""
+        count = jnp.asarray(count, jnp.int32)
+        valid = jnp.arange(capacity) < jnp.minimum(count, capacity)
+        gt = jnp.asarray(fields["is_gt"], jnp.int32) == 1
+        return {
+            "detections": jnp.sum((valid & ~gt).astype(jnp.int32)),
+            "groundtruths": jnp.sum((valid & gt).astype(jnp.int32)),
+        }
+
+    def grouped_finalize(
+        self,
+        counts: np.ndarray,
+        fields: Dict[str, np.ndarray],
+        group_ids: np.ndarray,
+    ) -> Dict[str, Any]:
+        """Rebuild the five eager list states from reconstructed per-image
+        rows, one entry per non-empty image in image-id order (rows keep
+        submission order per image; ``is_gt`` splits the shared buffer).
+        Images with no rows contribute nothing — exactly the eager no-op an
+        empty (no dets, no gts) image is."""
+        counts = np.asarray(counts)
+        state: Dict[str, List[np.ndarray]] = {
+            "detection_boxes": [],
+            "detection_scores": [],
+            "detection_labels": [],
+            "groundtruth_boxes": [],
+            "groundtruth_labels": [],
+        }
+        for gid in np.asarray(group_ids):
+            c = int(counts[gid])
+            if c == 0:
+                continue
+            gt = np.asarray(fields["is_gt"][gid][:c]) == 1
+            box = np.asarray(fields["box"][gid][:c], np.float32)
+            state["detection_boxes"].append(box[~gt])
+            state["detection_scores"].append(
+                np.asarray(fields["score"][gid][:c], np.float32)[~gt]
+            )
+            state["detection_labels"].append(
+                np.asarray(fields["label"][gid][:c], np.int32)[~gt]
+            )
+            state["groundtruth_boxes"].append(box[gt])
+            state["groundtruth_labels"].append(
+                np.asarray(fields["label"][gid][:c], np.int32)[gt]
+            )
+        return state
 
     # ------------------------------------------------------------------ internals
 

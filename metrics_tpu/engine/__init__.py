@@ -102,6 +102,7 @@ from metrics_tpu.engine.fleet import (
 )
 from metrics_tpu.engine.multistream import MultiStreamEngine
 from metrics_tpu.engine.pipeline import EngineConfig, StreamingEngine
+from metrics_tpu.engine.ragged import GroupedStateMetric, RaggedEngine
 from metrics_tpu.engine.quantize import (
     ArenaRowCodec,
     decode_state_tree,
@@ -151,10 +152,12 @@ __all__ = [
     "FleetEngine",
     "FleetHostLostError",
     "FleetTopologyError",
+    "GroupedStateMetric",
     "InjectedFault",
     "MultiStreamEngine",
     "OverloadDetector",
     "QuarantineRecord",
+    "RaggedEngine",
     "ScreenPolicy",
     "SnapshotCorruptError",
     "StepTimeoutError",

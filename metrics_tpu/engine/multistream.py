@@ -1814,11 +1814,19 @@ class MultiStreamEngine(StreamingEngine):
         self, nonempty: List[Tuple[Any, int]]
     ) -> Optional[Tuple[Tuple[Any, ...], Dict[str, Any]]]:
         # pre-sized by the caller (one tree-flatten per item total): sizes
-        # feed both the per-row stream-id build and the concat
+        # feed both the per-row stream-id build and the concat. broadcast_to
+        # accepts both forms of item id — a scalar stream id (classic
+        # multistream) and an already-per-row id array (the ragged engine's
+        # group keys), which must be length n
         if not nonempty:
             return None
         stream_ids = np.concatenate(
-            [np.full((n,), it[0], np.int32) for it, n in nonempty]
+            [
+                np.ascontiguousarray(
+                    np.broadcast_to(np.asarray(it[0], np.int32), (n,))
+                )
+                for it, n in nonempty
+            ]
         )
         merged = self._concat_sized([((a, kw), n) for ((_, a, kw), n) in nonempty])
         args, kwargs = merged

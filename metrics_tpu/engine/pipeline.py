@@ -688,7 +688,13 @@ class StreamingEngine:
     def _update_path_unsupported_reason(self, metric: Any) -> Optional[str]:
         """The engine-kind-specific update capability (subclasses reroute:
         multi-stream needs the segmented path). Mesh-mode checks stay in
-        :meth:`_serving_unsupported_reason` so every engine kind gets them."""
+        :meth:`_serving_unsupported_reason` so every engine kind gets them.
+
+        Group-keyed metrics (retrieval, detection MAP —
+        ``masked_update_strategy() == "grouped"``) refuse HERE with a typed
+        pointer at :class:`metrics_tpu.engine.ragged.RaggedEngine`: their
+        cat-list states are the ragged path's job, and the old generic
+        delta/scan message was a dead end (ISSUE 17)."""
         return metric.masked_update_unsupported_reason()
 
     def _megastep_unsupported_reason(self) -> Optional[str]:
@@ -1871,6 +1877,17 @@ class StreamingEngine:
             counters["drift_alarms"] = s.drift_alarms
             gauges["live_panes"] = s.live_panes
             gauges["pane_cursor"] = s.pane_cursor
+        ragged = s.ragged_summary()
+        if ragged is not None:
+            # ragged serving (ISSUE 17): group-keyed ingestion families join
+            # the exposition only for ragged engines — every stream engine's
+            # surface stays byte-stable
+            counters["ragged_batches"] = ragged["batches"]
+            counters["ragged_rows"] = ragged["rows"]
+            counters["ragged_groups_touched"] = ragged["groups_touched"]
+            counters["ragged_overflows"] = ragged["overflows"]
+            gauges["ragged_groups"] = ragged["groups"]
+            gauges["ragged_capacity"] = ragged["capacity"]
         hists = self._trace.histograms() if self._trace is not None else ()
         return render_openmetrics(
             counters, hists, labeled_counters=labeled or None, gauges=gauges

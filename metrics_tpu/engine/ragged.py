@@ -1,0 +1,510 @@
+"""Ragged serving: group-keyed metric domains through the streaming engine.
+
+The last metric families with no serving story are the ones whose state is a
+BAG OF ROWS per logical group — retrieval (documents keyed by query id,
+AP/NDCG folds after a per-query rank sort) and detection (boxes keyed by
+image id, COCO matching after a score sort). Their eager form is
+``dist_reduce_fx=None`` cat-lists, which every engine gate rightly refuses:
+list states grow with data and have no masked/segmented/stacked-merge form.
+But the GROUPED shape is exactly the multi-tenant shape at a finer grain —
+a query id is a micro-scale stream id — so the whole existing machinery
+(segmented one-executable step, megabatch coalescing, deferred mesh,
+``WindowPolicy`` pane rings, the stream-shard pager that already serves
+millions of keys) applies once the state is given a static shape:
+
+* **Capacity buffers** (AUROC's cat-capacity precedent): each group carries
+  ``capacity`` rows per payload field plus a ``count``. Rows land at
+  ``count + rank`` via one stable lexsort over the batch's group keys and a
+  scatter with ``mode="drop"`` — pad rows and over-capacity rows drop in the
+  same mechanism, and ``count`` keeps the TRUE total so overflow is loud
+  (NaN per-group, a typed refusal at the aggregate read), never a silent
+  truncation.
+* **Group keys ride the stream machinery**: :class:`RaggedEngine` is a
+  ``MultiStreamEngine`` whose submitted items carry a PER-ROW int32 group-id
+  array instead of one scalar stream id; the megabatch merge broadcasts
+  scalars and concatenates arrays identically, so cross-group coalescing,
+  bucketing by row count, routing, and the pager are all unchanged.
+* **Sort-at-compute stays at compute**: the per-group read
+  (``result(gid)``/``results()``) runs the metric's
+  ``grouped_group_value`` — a traced compute over one group's
+  ``(capacity, ...)`` buffers — while the aggregate ``result()``
+  reconstructs every group's rows host-side, rebuilds the metric's EAGER
+  list states via ``grouped_finalize``, and runs the unmodified eager
+  ``compute`` — bit-exact vs the eager oracle by construction (the one
+  caveat: rows that compare EQUAL under the compute's sort key may permute
+  across groups'/shards' interleavings; every strict ordering is exact).
+
+A metric opts in by returning a :class:`~metrics_tpu.metric.GroupedUpdateSpec`
+from ``grouped_update_spec()`` (``masked_update_strategy() == "grouped"``);
+non-ragged engines then refuse it at construction with a typed message that
+points here (``Metric.grouped_refusal_reason``). See docs/serving.md
+§ "Ragged serving".
+"""
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.engine.aot import AotCache
+from metrics_tpu.engine.multistream import MultiStreamEngine
+from metrics_tpu.engine.pipeline import EngineConfig
+from metrics_tpu.metric import GroupedUpdateSpec, Metric
+from metrics_tpu.ops.kernels import MEGASTEP_BACKENDS
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+__all__ = ["GroupedStateMetric", "RaggedEngine"]
+
+
+class GroupedStateMetric(Metric):
+    """Engine-internal wrapper giving a group-keyed metric a STATIC state.
+
+    One group's state is ``count`` (scalar int32, the TRUE number of rows
+    ever ingested — may exceed capacity, which is the overflow signal) plus
+    one ``(capacity,) + field.shape`` buffer per spec field. The engine
+    stacks a leading group axis over it exactly like any multi-stream state,
+    so the whole ragged subsystem reuses the (S, ...)-stacked arena, the
+    stream-shard pager's per-row spill/fault, and the windowed pane ring
+    without a single new carried form.
+
+    The wrapped user metric is held under a dunder attribute name
+    (``__grouped_inner__``) deliberately: ``_child_metrics`` skips dunder
+    attrs, so the inner metric's LIST states never leak into this wrapper's
+    state registry, while ``metric_fingerprint`` still walks ``__dict__``
+    and keys compiled programs on the inner metric's full configuration.
+    """
+
+    full_state_update = False
+
+    def __init__(self, metric: Any, capacity: Optional[int] = None) -> None:
+        super().__init__()
+        spec = metric.grouped_update_spec()
+        if spec is None or not isinstance(spec, GroupedUpdateSpec):
+            raise MetricsTPUUserError(
+                f"{type(metric).__name__} declares no grouped_update_spec(); "
+                "only group-keyed metrics (retrieval, detection) serve through "
+                "the ragged path"
+            )
+        cap = int(capacity) if capacity is not None else int(spec.capacity)
+        if cap <= 0:
+            raise MetricsTPUUserError(
+                f"ragged capacity must be a positive int, got {capacity!r}"
+            )
+        self._capacity = cap
+        self._field_names: Tuple[str, ...] = spec.field_names()
+        self._field_shapes = tuple(tuple(int(d) for d in f.shape) for f in spec.fields)
+        self._field_dtypes = tuple(str(jnp.dtype(f.dtype)) for f in spec.fields)
+        # count declares fx=None deliberately: the boundary merge needs the
+        # PER-REPLICA counts (they are the buffers' validity) so every leaf
+        # rides the stacked u32 carrier — sync_states gathers, then
+        # merge_stacked_states sums counts and compacts rows locally. A
+        # "sum" declaration would promise a psum the merge never issues
+        # (the quantized-sync-policy audit reads this declaration).
+        self.add_state("count", default=jnp.zeros((), jnp.int32), dist_reduce_fx=None)
+        for name, shape, dtype in zip(
+            self._field_names, self._field_shapes, self._field_dtypes
+        ):
+            self.add_state(
+                "buf_" + name,
+                default=jnp.zeros((cap,) + shape, jnp.dtype(dtype)),
+                dist_reduce_fx=None,
+            )
+        self.__dict__["__grouped_inner__"] = metric
+
+    # --------------------------------------------------------------- eager facade
+
+    def _inner(self) -> Any:
+        return self.__dict__["__grouped_inner__"]
+
+    @property
+    def capacity(self) -> int:
+        return self._capacity
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        raise MetricsTPUUserError(
+            "GroupedStateMetric ingests through the ragged engine's segmented "
+            "step only; call the wrapped metric's update() for eager use"
+        )
+
+    def compute(self) -> Any:
+        """ONE group's value from its capacity buffers — the per-group read
+        the engine's compiled ``result(gid)``/``results()`` programs run."""
+        fields = {name: getattr(self, "buf_" + name) for name in self._field_names}
+        return self._inner().grouped_group_value(fields, self.count, self._capacity)
+
+    # ------------------------------------------------------------ engine contract
+
+    def segmented_update_unsupported_reason(self) -> Optional[str]:
+        return None
+
+    def stacked_merge_unsupported_reason(self) -> Optional[str]:
+        return None
+
+    def update_state_segmented(
+        self,
+        state: Dict[str, Any],
+        *args: Any,
+        mask: Any,
+        segment_ids: Any,
+        num_segments: int,
+        **kwargs: Any,
+    ) -> Dict[str, Any]:
+        """The grouped capacity write: one stable lexsort + one scatter per
+        field, fully static.
+
+        Masked rows get the sentinel key ``num_segments`` and over-capacity
+        rows a column index ``>= capacity`` — both drop out of the scatter
+        via ``mode="drop"``, while ``count`` keeps the true per-group total
+        (overflow stays observable). Within one batch a group's rows land in
+        batch order (stable sort + in-run rank), so every strict sort at
+        compute time sees exactly the rows the eager metric would.
+        """
+        if kwargs:
+            raise MetricsTPUUserError(
+                f"grouped ingestion takes positional field rows only; got kwargs {sorted(kwargs)}"
+            )
+        if len(args) != len(self._field_names):
+            raise MetricsTPUUserError(
+                f"grouped ingestion expects {len(self._field_names)} field arrays "
+                f"({', '.join(self._field_names)}), got {len(args)}"
+            )
+        mask = jnp.asarray(mask, bool)
+        ids = jnp.asarray(segment_ids, jnp.int32)
+        n = mask.shape[0]
+        cap = self._capacity
+        count = jnp.asarray(state["count"])
+
+        seg_key = jnp.where(mask, ids, num_segments)
+        # stable group sort: the arange tie-break pins submission order inside
+        # each group's run (jnp.lexsort sorts by the LAST key first)
+        order = jnp.lexsort((jnp.arange(n), seg_key))
+        sseg = seg_key[order]
+        smask = mask[order]
+        pos = jnp.arange(n)
+        run_start = jnp.concatenate([jnp.ones((1,), bool), sseg[1:] != sseg[:-1]])
+        seg_start = jax.lax.cummax(jnp.where(run_start, pos, 0))
+        rank = pos - seg_start  # 0-based offset within this batch's group run
+        safe = jnp.minimum(sseg, num_segments - 1)
+        base = count[safe]
+        write_pos = jnp.where(smask, base + rank, cap)
+
+        out = dict(state)
+        out["count"] = count.at[sseg].add(
+            smask.astype(count.dtype), mode="drop"
+        )
+        for i, name in enumerate(self._field_names):
+            k = "buf_" + name
+            buf = jnp.asarray(state[k])
+            rows = jnp.asarray(args[i])[order].astype(buf.dtype)
+            out[k] = buf.at[sseg, write_pos].set(rows, mode="drop")
+        return out
+
+    def sync_states(self, state: Dict[str, Any], axis_name: Any) -> Dict[str, Any]:
+        """Deferred boundary merge over a mesh axis: every leaf (count AND
+        buffers) rides ONE fused u32-carrier all_gather stacked ``(world, ...)``,
+        then the compaction fold (:meth:`merge_stacked_states`) runs locally on
+        every shard — replicated output, exactly the per-leaf ``sync_states``
+        contract. The default per-leaf path can't serve grouped state: a psum'd
+        count with world-stacked buffers is not a logical state."""
+        from metrics_tpu.parallel.collectives import fused_axis_sync, in_mapped_context
+
+        if axis_name is None or not in_mapped_context(axis_name):
+            return state
+        keys = sorted(state)
+        gathered = fused_axis_sync([(None, state[k]) for k in keys], axis_name)
+        return self.merge_stacked_states(dict(zip(keys, gathered)))
+
+    def merge_stacked_states(self, stacked: Dict[str, Any]) -> Dict[str, Any]:
+        """Fold a leading stack axis of grouped states: counts SUM; buffers
+        COMPACT — each group's valid rows from all P replicas pack to the
+        front of one fresh capacity buffer, replica-major (replica order ==
+        shard/pane order, the same order a cat-state merge concatenates in).
+
+        Handles every stacked form the engine produces: ``(P,)`` leading over
+        per-group rows (one stream's pane ring), ``(P, S)`` over the stacked
+        state (deferred boundary merge, sliding-window folds) — any middle
+        axes ``mid`` between the stack axis and the capacity axis.
+        """
+        cap = self._capacity
+        count = jnp.asarray(stacked["count"])
+        P = count.shape[0]
+        mid = count.shape[1:]
+        out: Dict[str, Any] = {"count": jnp.sum(count, axis=0)}
+        cflat = jnp.reshape(count, (P, -1))  # (P, G)
+        G = cflat.shape[1]
+        filled = jnp.minimum(cflat, cap)
+        slot = jnp.arange(cap)
+        valid = slot[None, None, :] < filled[:, :, None]  # (P, G, cap)
+        vflat = jnp.reshape(jnp.transpose(valid, (1, 0, 2)), (G, P * cap))
+        # stable argsort of ~valid: per group, the indices of valid slots in
+        # (replica, slot) order come first — the compaction gather map
+        take = jnp.argsort(~vflat, axis=1)[:, :cap]  # (G, cap)
+        for name in self._field_names:
+            k = "buf_" + name
+            v = jnp.asarray(stacked[k])  # (P,)+mid+(cap,)+suffix
+            suffix = v.shape[1 + len(mid) + 1:]
+            rows = jnp.reshape(v, (P, G, cap) + suffix)
+            rows = jnp.reshape(jnp.moveaxis(rows, 0, 1), (G, P * cap) + suffix)
+            idx = jnp.reshape(take, (G, cap) + (1,) * len(suffix))
+            gathered = jnp.take_along_axis(rows, idx, axis=1)
+            out[k] = jnp.reshape(gathered, mid + (cap,) + suffix)
+        return out
+
+
+class RaggedEngine(MultiStreamEngine):
+    """Serve a group-keyed metric: ``num_groups`` logical groups (query ids,
+    image ids), per-row group keys, capacity-buffer state, the aggregate
+    eager-oracle read.
+
+    Args:
+        metric: a metric declaring ``grouped_update_spec()`` (``RetrievalMAP``,
+            ``RetrievalNormalizedDCG``, detection ``MeanAveragePrecision``).
+        num_groups: the group-key universe — keys are ``0 <= gid < num_groups``.
+        config: engine config; composes with deferred mesh and ``WindowPolicy``.
+        aot_cache: optional shared AOT cache.
+        capacity: per-group row budget (defaults to the metric's spec).
+        group_shard: shard the group axis over the mesh + page cold groups
+            (the stream-shard machinery at group grain).
+        resident_groups: per-shard paged-arena slot count under
+            ``group_shard`` (see ``resident_streams``).
+
+    ``submit(group_ids, *fields)`` takes one scalar group id for a
+    single-group batch or a per-row int32 array for a mixed-group batch;
+    ``submit_update(*eager_args)`` accepts the metric's own eager update
+    signature and routes it through ``grouped_encode``. ``result(gid)`` /
+    ``results()`` are the per-group reads; ``result()`` with no argument is
+    the aggregate value, bit-exact vs the eager oracle.
+    """
+
+    def __init__(
+        self,
+        metric: Any,
+        num_groups: int,
+        config: Optional[EngineConfig] = None,
+        aot_cache: Optional[AotCache] = None,
+        capacity: Optional[int] = None,
+        group_shard: bool = False,
+        resident_groups: Optional[int] = None,
+    ):
+        spec = getattr(metric, "grouped_update_spec", lambda: None)()
+        if spec is None:
+            raise MetricsTPUUserError(
+                f"RaggedEngine serves group-keyed metrics only: "
+                f"{type(metric).__name__} declares no grouped_update_spec() "
+                "(built-in retrieval metrics with a segment kind and detection "
+                "MeanAveragePrecision do)"
+            )
+        if config is not None and config.kernel_backend in MEGASTEP_BACKENDS:
+            raise MetricsTPUUserError(
+                "ragged serving has no megastep form: the grouped capacity "
+                "write is a 2-d scatter outside the per-column opcode grid — "
+                "use kernel_backend='xla' or 'pallas_interpret'"
+            )
+        self._user_metric = metric
+        wrapped = GroupedStateMetric(metric, capacity=capacity)
+        self._capacity = wrapped.capacity
+        self._n_fields = len(spec.fields)
+        super().__init__(
+            wrapped,
+            num_streams=num_groups,
+            config=config,
+            aot_cache=aot_cache,
+            stream_shard=group_shard,
+            resident_streams=resident_groups,
+        )
+        self._stats.ragged_groups = int(num_groups)
+        self._stats.ragged_capacity = int(self._capacity)
+        # the grouped capacity write is a 2-d scatter with no per-column
+        # kernel form — kernel-ineligible by design (the megastep tiers
+        # refuse above). Pin the RESOLVED backend to the XLA reference
+        # lowering so program keys, the kernel scope, and the scatter audit
+        # (no-scatter-under-pallas's ineligibility clause) all agree.
+        self._kernel_backend = "xla"
+
+    # ------------------------------------------------------------------ properties
+
+    @property
+    def num_groups(self) -> int:
+        return self._num_streams
+
+    @property
+    def capacity(self) -> int:
+        return self._capacity
+
+    @property
+    def user_metric(self) -> Any:
+        return self._user_metric
+
+    # ------------------------------------------------------------------- producers
+
+    def _check_group_ids(self, group_ids: Any, fields: Tuple[Any, ...]) -> Tuple[Any, int]:
+        if len(fields) != self._n_fields:
+            raise MetricsTPUUserError(
+                f"ragged submit expects {self._n_fields} field arrays "
+                f"({', '.join(self._metric._field_names)}), got {len(fields)}"
+            )
+        n = int(np.shape(fields[0])[0]) if np.ndim(fields[0]) else 0
+        for f in fields[1:]:
+            if int(np.shape(f)[0]) != n:
+                raise MetricsTPUUserError(
+                    "ragged submit field arrays must share their leading (row) dim"
+                )
+        if np.ndim(group_ids) == 0:
+            return self._check_stream(group_ids), n
+        gids = np.asarray(group_ids)
+        if gids.ndim != 1 or gids.shape[0] != n:
+            raise MetricsTPUUserError(
+                f"group_ids must be a scalar or a 1-d array of length {n} "
+                f"(one key per row), got shape {gids.shape}"
+            )
+        if gids.size and (int(gids.min()) < 0 or int(gids.max()) >= self._num_streams):
+            raise MetricsTPUUserError(
+                f"group_ids out of range [0, {self._num_streams}): "
+                f"min={int(gids.min())}, max={int(gids.max())}"
+            )
+        return gids.astype(np.int32), n
+
+    def submit(
+        self, group_ids: Any, *fields: Any, timeout: Optional[float] = None, **kwargs: Any
+    ) -> None:
+        """Enqueue rows for one group (scalar id) or many (per-row id array)."""
+        gids, n = self._check_group_ids(group_ids, fields)
+        if n == 0:
+            return
+        self._raise_if_failed()
+        self.start()
+        n_groups = 1 if np.ndim(gids) == 0 else int(np.unique(gids).size)
+        self._stats.record_ragged_submit(rows=n, groups=n_groups)
+        item = (gids, fields, kwargs)
+        if self._admission is not None:
+            # per-group admission classes: a mixed-group batch is admitted
+            # under its FIRST row's group (one batch, one verdict)
+            admit = int(gids) if np.ndim(gids) == 0 else int(np.asarray(gids)[0])
+            self._admitted_submit(admit, item, (fields, kwargs), timeout)
+        else:
+            self._submit_item(item, timeout)
+
+    def submit_update(self, *args: Any, timeout: Optional[float] = None, **kwargs: Any) -> None:
+        """Submit in the metric's own eager ``update`` signature: the
+        metric's ``grouped_encode`` validates exactly like ``update`` and
+        flattens the call to ``(group_ids, *field_rows)``."""
+        encoded = self._user_metric.grouped_encode(*args, **kwargs)
+        self.submit(encoded[0], *encoded[1:], timeout=timeout)
+
+    # --------------------------------------------------------------- fault context
+
+    def _item_context(self, item: Any) -> Dict[str, Any]:
+        gids = item[0]
+        if np.ndim(gids) == 0:
+            return {"stream_id": int(gids)}
+        u = np.unique(np.asarray(gids))
+        return {"group_ids": [int(x) for x in u[:32]]}
+
+    def _group_context(self, group: List[Any]) -> Dict[str, Any]:
+        ids: set = set()
+        for it in group:
+            if isinstance(it, tuple) and len(it) == 3:
+                ids.update(int(x) for x in np.atleast_1d(np.asarray(it[0])).ravel())
+        return {"group_ids": sorted(ids)[:64]} if ids else {}
+
+    # --------------------------------------------------------------------- readers
+
+    def result(self, group_id: Optional[int] = None) -> Any:  # type: ignore[override]
+        """``result(gid)`` is the per-group value (the wrapped metric's
+        ``grouped_group_value`` through the shared compiled program);
+        ``result()`` is the AGGREGATE: every group's rows reconstruct
+        host-side, ``grouped_finalize`` rebuilds the metric's eager list
+        states in group-id order, and the unmodified eager ``compute`` runs —
+        bit-exact vs the eager oracle."""
+        if group_id is None:
+            return self.aggregate()
+        return super().result(group_id)
+
+    def aggregate(self) -> Any:
+        self.flush()
+        counts, fields = self._gather_groups()
+        over = np.flatnonzero(counts > self._capacity)
+        if over.size:
+            self._stats.record_ragged_overflow(int(over.size))
+            shown = ", ".join(
+                f"{int(g)} ({int(counts[g])} rows)" for g in over[:8]
+            )
+            raise MetricsTPUUserError(
+                f"ragged capacity overflow: {over.size} group(s) exceeded "
+                f"capacity={self._capacity} — {shown}"
+                f"{', ...' if over.size > 8 else ''}; rebuild the engine with a "
+                "larger capacity= (rows past capacity were dropped, counts kept)"
+            )
+        gids = np.arange(self._num_streams, dtype=np.int64)
+        state = self._user_metric.grouped_finalize(counts, fields, gids)
+        return self._user_metric.compute_from(state)
+
+    def _gather_groups(self) -> Tuple[np.ndarray, Dict[str, np.ndarray]]:
+        """Host numpy ``(counts (G,), {field: (G, capacity, ...)})`` of the
+        logical per-group state, window panes folded (tumbling reads the open
+        pane, sliding folds the ring through the wrapper's compaction merge)."""
+        with self._state_lock:
+            tree = self._logical_tree_locked()
+            counts = np.asarray(jax.device_get(tree["count"]))
+            fields = {
+                name: np.asarray(jax.device_get(tree["buf_" + name]))
+                for name in self._metric._field_names
+            }
+        return counts, fields
+
+    def _logical_tree_locked(self) -> Dict[str, Any]:
+        if self._stream_shard:
+            rows = self._global_rows_host()
+            if self._pane_rows == 1:
+                return self._layout.unpack_stacked(
+                    {k: jnp.asarray(v) for k, v in rows.items()}
+                )
+            if self._window.kind == "tumbling":
+                idx = self._ext_ids([self._pane_cursor])[0]
+                return self._layout.unpack_stacked(
+                    {k: jnp.asarray(np.asarray(v)[idx]) for k, v in rows.items()}
+                )
+            idx = self._ext_ids(range(self._pane_rows))
+            stacked = self._layout.unpack_stacked(
+                {k: jnp.asarray(np.asarray(v)[idx]) for k, v in rows.items()}, lead=2
+            )
+            return self._metric.merge_stacked_states(stacked)
+        tree = self._merged_state() if self._deferred else self._unpack(self._state)
+        if self._win_stacked:
+            if self._window.kind == "tumbling":
+                return jax.tree.map(lambda x: x[self._pane_cursor], tree)
+            return self._metric.merge_stacked_states(tree)
+        return tree
+
+    # --------------------------------------------------------- snapshot provenance
+
+    def _snapshot_meta_extra(self) -> Dict[str, Any]:
+        extra = super()._snapshot_meta_extra()
+        extra.update(
+            ragged=1,
+            ragged_capacity=self._capacity,
+            ragged_groups=self._num_streams,
+        )
+        return extra
+
+    def _restore_commit(self, state: Any, meta: Dict[str, Any]) -> None:
+        if not bool(int(meta.get("ragged", 0) or 0)):
+            raise MetricsTPUUserError(
+                "snapshot was not written by a ragged engine: plain stream "
+                "rows carry no group-key provenance a RaggedEngine could seat "
+                "— restore it into the engine kind that wrote it"
+            )
+        cap = int(meta.get("ragged_capacity", 0) or 0)
+        if cap != self._capacity:
+            raise MetricsTPUUserError(
+                f"ragged snapshot was written at capacity={cap}, this engine "
+                f"serves capacity={self._capacity}; per-group buffer columns "
+                "only mean row slots under the capacity that wrote them — "
+                "restore with a matching capacity= engine"
+            )
+        g = int(meta.get("ragged_groups", 0) or 0)
+        if g != self._num_streams:
+            raise MetricsTPUUserError(
+                f"ragged snapshot serves {g} groups, this engine {self._num_streams}"
+            )
+        super()._restore_commit(state, meta)

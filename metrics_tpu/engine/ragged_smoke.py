@@ -1,0 +1,243 @@
+"""Ragged-serving smoke check: ``python -m metrics_tpu.engine.ragged_smoke``.
+
+The CPU-safe gate for the ISSUE 17 ragged stack (``make ragged-smoke``), on
+the bootstrap 8-device virtual mesh:
+
+1. retrieval — ``RetrievalMAP`` group-keyed traffic through a DEFERRED mesh
+   ``RaggedEngine`` serves the aggregate bit-exact vs the eager oracle, with
+   zero steady-state compiles over a ``reset()`` + replay of the same plan;
+2. detection — ``MeanAveragePrecision`` through the engine: every result key
+   equals the eager oracle exactly, and the per-image occupancy read serves;
+3. kill/resume — snapshot mid-plan, a fresh engine restores and replays the
+   remainder to the exact straight-through value (and a non-ragged snapshot
+   is REFUSED with the typed provenance message);
+4. composition — ``WindowPolicy`` + ``group_shard`` (the stream-shard pager
+   at group grain, resident cap below the group count) together still serve
+   the aggregate bit-exact;
+5. refusals — the plain engine refuses the cat-list metric at construction
+   with the typed pointer at the ragged path, and the ragged engine's
+   programs audit clean under the full analysis rule set.
+
+Prints one PASS line; exits nonzero on any violated claim.
+"""
+import os
+import subprocess
+import sys
+
+NUM_DEVICES = 8
+
+
+def _bootstrap() -> int:
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + f" --xla_force_host_platform_device_count={NUM_DEVICES}"
+    )
+    env["JAX_PLATFORMS"] = "cpu"
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu'); "
+        "import sys; from metrics_tpu.engine.ragged_smoke import _impl; sys.exit(_impl())"
+    )
+    proc = subprocess.run([sys.executable, "-c", code], env=env, timeout=900)
+    return proc.returncode
+
+
+def _impl() -> int:
+    import tempfile
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from metrics_tpu import RetrievalMAP
+    from metrics_tpu.detection import MeanAveragePrecision
+    from metrics_tpu.engine import (
+        AotCache,
+        EngineConfig,
+        RaggedEngine,
+        StreamingEngine,
+        WindowPolicy,
+    )
+    from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+    devs = jax.devices()
+    if len(devs) < NUM_DEVICES:
+        print(f"FAIL: need {NUM_DEVICES} devices, have {len(devs)}")
+        return 1
+    mesh = Mesh(np.asarray(devs[:NUM_DEVICES]), ("dp",))
+    ok = True
+    GROUPS, CAP, ROWS, BATCHES = 12, 32, 16, 6
+
+    # seeded plan, preds GLOBALLY distinct (strict sort keys => bit-exact
+    # across every shard/pane interleaving)
+    rng = np.random.RandomState(17)
+    vals = rng.permutation(BATCHES * ROWS).astype(np.float32) / (BATCHES * ROWS)
+    plan = []
+    for b in range(BATCHES):
+        plan.append((
+            vals[b * ROWS:(b + 1) * ROWS],
+            rng.randint(0, 2, ROWS).astype(np.int64),
+            rng.randint(0, GROUPS, ROWS),
+        ))
+
+    def oracle():
+        m = RetrievalMAP()
+        for p, t, g in plan:
+            m.update(jnp.asarray(p), jnp.asarray(t), indexes=jnp.asarray(g))
+        return float(m.compute())
+
+    want = oracle()
+
+    # ---- 1. deferred-mesh retrieval parity + zero steady compiles
+    cache = AotCache()
+    eng = RaggedEngine(
+        RetrievalMAP(), num_groups=GROUPS,
+        config=EngineConfig(buckets=(ROWS,), mesh=mesh, axis="dp",
+                            mesh_sync="deferred"),
+        capacity=CAP, aot_cache=cache,
+    )
+    with eng:
+        for p, t, g in plan:
+            eng.submit_update(p, t, g)
+        got = float(eng.result())
+        warm = cache.misses
+        eng.reset()
+        for p, t, g in plan:
+            eng.submit_update(p, t, g)
+        eng.flush()
+        steady = cache.misses - warm
+    if got != want:
+        print(f"FAIL: deferred-mesh retrieval aggregate {got!r} != eager oracle {want!r}")
+        ok = False
+    if steady != 0:
+        print(f"FAIL: steady-state replay compiled {steady} programs (expected 0)")
+        ok = False
+
+    # ---- 2. detection MAP through the engine, exact vs eager oracle
+    dr = np.random.RandomState(5)
+    preds, target = [], []
+    for _ in range(4):
+        nd, ng = dr.randint(1, 5), dr.randint(1, 4)
+        pb = dr.rand(nd, 4).astype(np.float32) * 60
+        pb[:, 2:] += pb[:, :2] + 4
+        gb = dr.rand(ng, 4).astype(np.float32) * 60
+        gb[:, 2:] += gb[:, :2] + 4
+        preds.append({"boxes": pb,
+                      "scores": dr.permutation(nd * 9)[:nd].astype(np.float32) / (nd * 9),
+                      "labels": dr.randint(0, 3, nd)})
+        target.append({"boxes": gb, "labels": dr.randint(0, 3, ng)})
+    om = MeanAveragePrecision()
+    om.update(preds, target)
+    want_det = {k: np.asarray(v) for k, v in om.compute().items()}
+    det = RaggedEngine(MeanAveragePrecision(), num_groups=4,
+                       config=EngineConfig(buckets=(64,)), capacity=64)
+    with det:
+        det.submit_update(preds, target, image_ids=np.arange(4))
+        got_det = {k: np.asarray(v) for k, v in det.result().items()}
+        occ = det.result(2)
+    for k in want_det:
+        if not np.array_equal(got_det[k], want_det[k]):
+            print(f"FAIL: detection key {k}: served {got_det[k]} != oracle {want_det[k]}")
+            ok = False
+    if int(occ["detections"]) != len(preds[2]["boxes"]):
+        print(f"FAIL: per-image occupancy read wrong: {occ}")
+        ok = False
+
+    # ---- 3. kill/resume exact + cross-kind restore refusal
+    snapdir = tempfile.mkdtemp(prefix="ragged_smoke_")
+
+    def _cfg():
+        return EngineConfig(buckets=(ROWS,), snapshot_dir=snapdir)
+
+    first = RaggedEngine(RetrievalMAP(), num_groups=GROUPS, config=_cfg(), capacity=CAP)
+    with first:
+        for p, t, g in plan[:3]:
+            first.submit_update(p, t, g)
+        first.flush()
+        first.snapshot()
+    resumed = RaggedEngine(RetrievalMAP(), num_groups=GROUPS, config=_cfg(), capacity=CAP)
+    with resumed:
+        resumed.restore()
+        for p, t, g in plan[3:]:
+            resumed.submit_update(p, t, g)
+        got_resumed = float(resumed.result())
+    if got_resumed != want:
+        print(f"FAIL: kill/resume replay {got_resumed!r} != straight-through {want!r}")
+        ok = False
+    plaindir = tempfile.mkdtemp(prefix="ragged_smoke_plain_")
+    from metrics_tpu import Accuracy
+
+    plain = StreamingEngine(Accuracy(), EngineConfig(buckets=(8,), snapshot_dir=plaindir))
+    with plain:
+        plain.submit(np.asarray([0.1, 0.9], np.float32), np.ones(2, np.int32))
+        plain.flush()
+        plain.snapshot()
+    wrong = RaggedEngine(RetrievalMAP(), num_groups=GROUPS,
+                         config=EngineConfig(buckets=(ROWS,), snapshot_dir=plaindir),
+                         capacity=CAP)
+    try:
+        wrong.restore()
+        print("FAIL: a non-ragged snapshot restored into a RaggedEngine")
+        ok = False
+    except MetricsTPUUserError:
+        pass
+    finally:
+        wrong.stop()
+
+    # ---- 4. windows + group_shard composition on the mesh
+    comp = RaggedEngine(
+        RetrievalMAP(), num_groups=GROUPS,
+        config=EngineConfig(buckets=(ROWS,), mesh=mesh, axis="dp",
+                            mesh_sync="deferred",
+                            window=WindowPolicy.tumbling(pane_batches=1000)),
+        capacity=CAP, group_shard=True, resident_groups=3,
+    )
+    with comp:
+        for p, t, g in plan:
+            comp.submit_update(p, t, g)
+        got_comp = float(comp.result())
+    if got_comp != want:
+        print(f"FAIL: windows+group_shard aggregate {got_comp!r} != oracle {want!r}")
+        ok = False
+
+    # ---- 5. typed refusal + program audit
+    try:
+        StreamingEngine(RetrievalMAP(), EngineConfig(buckets=(8,)))
+        print("FAIL: plain engine accepted a cat-list retrieval metric")
+        ok = False
+    except MetricsTPUUserError as e:
+        if "RaggedEngine" not in str(e):
+            print(f"FAIL: refusal does not point at the ragged path: {e}")
+            ok = False
+    from metrics_tpu.analysis import EngineAnalysis
+
+    findings = EngineAnalysis().check(eng, label="ragged-smoke/deferred").findings
+    if findings:
+        for f in findings:
+            print(f"FAIL: {f.render()}")
+        ok = False
+
+    if ok:
+        print(
+            f"ragged-smoke PASS: RetrievalMAP bit-exact through the deferred "
+            f"{NUM_DEVICES}-dev mesh ({GROUPS} groups, capacity {CAP}), detection "
+            "MAP exact vs the eager oracle, kill/resume replay exact (cross-kind "
+            "restore refused), windows+group_shard composition exact, plain-engine "
+            "refusal typed, program audit clean, zero steady compiles"
+        )
+    return 0 if ok else 1
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    if len(jax.devices()) < NUM_DEVICES:
+        return _bootstrap()
+    return _impl()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

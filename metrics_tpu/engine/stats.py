@@ -175,6 +175,17 @@ class EngineStats:
         # what actually crossed hosts
         self.fleet_payload_exact_bytes = 0
         self.fleet_payload_quant_bytes = 0
+        # ragged serving (ISSUE 17): group-keyed ingestion. ragged_groups
+        # None = not a ragged engine (every prior telemetry document stays
+        # byte-stable); capacity is the per-group row budget gauge. The
+        # counters ride the counter lock — submits come from producer
+        # threads, the overflow counter from reader threads (aggregate()).
+        self.ragged_groups: Optional[int] = None
+        self.ragged_capacity = 0
+        self.ragged_batches = 0
+        self.ragged_rows = 0
+        self.ragged_groups_touched = 0
+        self.ragged_overflows = 0
 
     def record_admission(self, outcome: str, priority: int) -> None:
         """One admission verdict (``"admitted"``/``"rejected"``/``"shed"``)
@@ -272,6 +283,38 @@ class EngineStats:
                 "alarms": self.drift_alarms,
             }
         return out
+
+    def record_ragged_submit(self, rows: int, groups: int) -> None:
+        """One accepted ragged submit: ``rows`` payload rows spanning
+        ``groups`` distinct group keys. Locked — ragged producers submit
+        concurrently like any stream producers."""
+        with self._counter_lock:
+            self.ragged_batches += 1
+            self.ragged_rows += int(rows)
+            self.ragged_groups_touched += int(groups)
+
+    def record_ragged_overflow(self, groups: int) -> None:
+        """One aggregate read refused because ``groups`` group(s) exceeded
+        capacity. Locked — reader threads call ``aggregate()`` concurrently
+        with producers."""
+        with self._counter_lock:
+            self.ragged_overflows += int(groups)
+
+    def ragged_summary(self) -> Optional[Dict[str, Any]]:
+        """The ragged-serving block for :meth:`summary` — None for engines
+        that never declared a group universe (every non-ragged telemetry
+        document stays byte-stable)."""
+        if self.ragged_groups is None:
+            return None
+        with self._counter_lock:
+            return {
+                "groups": self.ragged_groups,
+                "capacity": self.ragged_capacity,
+                "batches": self.ragged_batches,
+                "rows": self.ragged_rows,
+                "groups_touched": self.ragged_groups_touched,
+                "overflows": self.ragged_overflows,
+            }
 
     def record_fleet_ingest(self, owned: bool) -> None:
         """One plan batch seen by the fleet ingest path: ``owned`` batches
@@ -536,6 +579,9 @@ class EngineStats:
         fleet = self.fleet_summary()
         if fleet is not None:
             out["fleet"] = fleet
+        ragged = self.ragged_summary()
+        if ragged is not None:
+            out["ragged"] = ragged
         faults = self.fault_summary()
         if faults is not None:
             out["faults"] = faults

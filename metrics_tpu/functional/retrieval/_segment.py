@@ -156,3 +156,67 @@ def segment_retrieval_mean(
     if fetched[1]:
         raise ValueError(deferred_message(_CODE_EMPTY_QUERY_RETRIEVAL))
     return jnp.asarray(fetched[0], result.dtype)
+
+
+def grouped_query_score(
+    preds: Array,
+    target: Array,
+    count: Array,
+    *,
+    kind: str,
+    k: Optional[int] = None,
+    empty_target_action: str = "neg",
+) -> Array:
+    """ONE query's score from its ragged capacity buffers (ISSUE 17).
+
+    ``preds``/``target`` are a group's ``(capacity,)`` rows, ``count`` the
+    TRUE row total (may exceed capacity — overflow). The valid prefix maps to
+    segment 0 of :func:`_segment_scores` (pad rows get segment key 1), so the
+    per-kind math is byte-identical to the corpus path. Fully traceable: this
+    is the body of the ragged engine's compiled per-group read.
+
+    Sentinel values (the per-group read has no mean to hide in):
+    ``count == 0`` -> 0.0 (no rows — same as the eager metric's empty
+    compute); degenerate query -> the action's value, with ``skip`` and
+    ``error`` scoring NaN (``error`` also defers the runtime value check,
+    exactly like :func:`segment_retrieval_mean` under jit); overflow -> NaN
+    (rows past capacity were dropped, any score would be fabricated).
+    """
+    cap = preds.shape[0]
+    f32 = jnp.float32
+    count = jnp.asarray(count, jnp.int32)
+    filled = jnp.minimum(count, cap)
+    row_valid = jnp.arange(cap) < filled
+    indexes = jnp.where(row_valid, 0, 1).astype(jnp.int32)
+    values, empty, _ = _segment_scores(
+        jnp.asarray(preds, f32), jnp.asarray(target, f32), indexes, kind=kind, k=k
+    )
+    value, is_empty = values[0], empty[0] & (count > 0)
+    if empty_target_action == "pos":
+        fill = jnp.float32(1.0)
+    elif empty_target_action == "neg":
+        fill = jnp.float32(0.0)
+    else:  # "skip" and "error": no defined per-group value
+        fill = jnp.float32(jnp.nan)
+    value = jnp.where(is_empty, fill, value)
+    value = jnp.where(count == 0, 0.0, value)
+    value = jnp.where(count > cap, jnp.float32(jnp.nan), value)
+    if empty_target_action != "error":
+        return value
+
+    from metrics_tpu.utils.checks import (
+        _CODE_EMPTY_QUERY_RETRIEVAL,
+        _is_tracer,
+        defer_value_check,
+        deferred_message,
+    )
+
+    if _is_tracer(value) or _is_tracer(is_empty):
+        defer_value_check(is_empty, _CODE_EMPTY_QUERY_RETRIEVAL)
+        return value
+    import numpy as np
+
+    fetched = np.asarray(jnp.stack([value, is_empty.astype(value.dtype)]))  # ONE transfer
+    if fetched[1]:
+        raise ValueError(deferred_message(_CODE_EMPTY_QUERY_RETRIEVAL))
+    return jnp.asarray(fetched[0], value.dtype)

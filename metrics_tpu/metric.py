@@ -33,7 +33,8 @@ import functools
 import inspect
 import weakref
 from copy import deepcopy
-from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -64,6 +65,54 @@ from metrics_tpu.utils.prints import rank_zero_warn
 Array = jax.Array
 
 _MERGEABLE_FX = ("sum", "min", "max", "cat")
+
+
+@dataclass(frozen=True)
+class GroupedField:
+    """One per-row payload field of a group-keyed (ragged) metric.
+
+    A grouped metric's unit of ingestion is a ROW tagged with a group key
+    (retrieval: one ``(pred, target)`` document row keyed by query id;
+    detection: one box row keyed by image id). ``shape`` is the per-row
+    trailing shape (``()`` for scalars, ``(4,)`` for boxes); ``dtype`` is the
+    buffered storage dtype. The ragged engine stores each field as a
+    ``(capacity,) + shape`` buffer per group, rows valid up to the group's
+    count."""
+
+    name: str
+    shape: Tuple[int, ...]
+    dtype: Any
+
+
+@dataclass(frozen=True)
+class GroupedUpdateSpec:
+    """Declaration a metric makes to serve through the ragged path
+    (``metrics_tpu.engine.ragged.RaggedEngine``).
+
+    ``fields`` lists the per-row payloads in the positional order
+    :meth:`Metric.grouped_encode` emits them; ``capacity`` is the per-group
+    row budget (AUROC cat-capacity precedent: rows past capacity overflow
+    loudly rather than silently truncate). A metric returning a spec from
+    :meth:`Metric.grouped_update_strategy <Metric.grouped_update_spec>` also
+    implements:
+
+    * ``grouped_encode(*update_args, **update_kwargs)`` ->
+      ``(group_ids, field_0, ..., field_{k-1})`` — validate exactly like
+      ``update`` and flatten the eager call into per-row arrays;
+    * ``grouped_group_value(fields, count, capacity)`` — traced per-group
+      compute over one group's ``(capacity, ...)`` buffers + valid count
+      (what ``result(group_id)`` returns);
+    * ``grouped_finalize(counts, fields, group_ids)`` — rebuild the metric's
+      EAGER state pytree from the reconstructed per-group rows (host-side;
+      the aggregate ``result()`` feeds it through ``compute_from`` so the
+      served value is bit-exact vs the eager oracle).
+    """
+
+    fields: Tuple[GroupedField, ...]
+    capacity: int
+
+    def field_names(self) -> Tuple[str, ...]:
+        return tuple(f.name for f in self.fields)
 
 # forward() auto-jit cache: instance -> {signature: compiled step | _EAGER_ONLY}.
 # Keyed by weakref so compiled handles never interfere with pickling, deepcopy
@@ -563,6 +612,13 @@ class Metric:
           state through unchanged. Exact whenever a batch update equals the
           same rows applied one at a time — true for every array-state metric
           here — at the cost of serializing the rows;
+        * ``"grouped"`` — the metric declares GROUP-KEYED state
+          (:meth:`grouped_update_spec`): rows only mean anything relative to
+          their group key (query id, image id) and the compute sorts/matches
+          within each group, so there is no per-batch masked fold at all —
+          the metric serves through the ragged engine
+          (``metrics_tpu.engine.ragged.RaggedEngine``), which buffers rows
+          per group under capacity semantics;
         * ``None`` — not maskable (list states grow with data;
           ``full_state_update`` reads the accumulated state per batch).
         """
@@ -572,6 +628,8 @@ class Metric:
             return "delta"
         if self._scan_masked_reason() is None:
             return "scan"
+        if self.grouped_update_spec() is not None:
+            return "grouped"
         return None
 
     def _delta_masked_reason(self) -> Optional[str]:
@@ -611,10 +669,85 @@ class Metric:
         """None when :meth:`update_state_masked` applies (any strategy), else a
         human-readable reason. A subclass that overrides
         :meth:`update_state_masked` has taken responsibility for masking and is
-        always supported."""
-        if self.masked_update_strategy() is not None:
+        always supported. ``"grouped"`` metrics are NOT maskable here — their
+        rows carry group keys the masked contract has no slot for — so they
+        report a typed refusal that names the offending states and points at
+        the ragged serving path instead of the generic delta/scan dead end."""
+        strategy = self.masked_update_strategy()
+        if strategy == "grouped":
+            return self.grouped_refusal_reason()
+        if strategy is not None:
             return None
         return self._scan_masked_reason() or self._delta_masked_reason()
+
+    # ------------------------------------------------- grouped (ragged) serving hooks
+
+    def grouped_update_spec(self) -> Optional[GroupedUpdateSpec]:
+        """The metric's group-keyed state declaration, or None.
+
+        Metrics whose state is a per-GROUP bag of rows that only sorts or
+        matches at compute time (retrieval's per-query rank sort, detection's
+        score sort + greedy IoU match) return a :class:`GroupedUpdateSpec`
+        here; the ragged engine (``metrics_tpu.engine.ragged.RaggedEngine``)
+        then serves them with per-group capacity buffers + validity masks,
+        group keys riding the segmented stream machinery as micro-scale
+        stream ids. Everything else returns None (the default)."""
+        return None
+
+    def grouped_refusal_reason(self) -> str:
+        """The typed refusal a NON-ragged engine raises for a group-keyed
+        metric: names the metric, the offending (list / unmergeable) states,
+        and points at the ragged path — instead of the generic delta/scan
+        message, which is a dead end for these domains."""
+        offending = sorted(
+            k
+            for k, v in self._defaults.items()
+            if isinstance(v, list) or self._reductions[k] not in _MERGEABLE_FX
+        )
+        states = ", ".join(repr(k) for k in offending) or "its group-keyed states"
+        return (
+            f"{type(self).__name__} accumulates group-keyed rows ({states}) that "
+            "sort/match only at compute time; serve it through the ragged path — "
+            "metrics_tpu.engine.ragged.RaggedEngine(metric, num_groups=...) — "
+            "see docs/serving.md § Ragged serving"
+        )
+
+    def grouped_encode(self, *args: Any, **kwargs: Any) -> Tuple[Any, ...]:
+        """Flatten one eager ``update(...)`` call into ragged-ingest arrays:
+        ``(group_ids, field_0, ..., field_{k-1})`` in the spec's field order,
+        all 1-row-per-row along axis 0. Validates exactly like ``update``.
+        Implemented by metrics that declare :meth:`grouped_update_spec`."""
+        raise MetricsTPUUserError(
+            f"{type(self).__name__} declares no grouped_update_spec(); "
+            "grouped_encode is only meaningful for group-keyed metrics"
+        )
+
+    def grouped_group_value(self, fields: Dict[str, Array], count: Array, capacity: int) -> Any:
+        """Traced per-group compute over one group's ``(capacity, ...)``
+        buffers (rows valid below ``count``) — what the ragged engine's
+        ``result(group_id)`` returns. Implemented alongside
+        :meth:`grouped_update_spec`."""
+        raise MetricsTPUUserError(
+            f"{type(self).__name__} declares no grouped_update_spec(); "
+            "grouped_group_value is only meaningful for group-keyed metrics"
+        )
+
+    def grouped_finalize(
+        self,
+        counts: np.ndarray,
+        fields: Dict[str, np.ndarray],
+        group_ids: np.ndarray,
+    ) -> Dict[str, Any]:
+        """Host-side: rebuild this metric's EAGER state pytree from
+        reconstructed per-group rows (``counts`` ``(G,)``, each field
+        ``(G, capacity, ...)``, ``group_ids`` the logical key per group row).
+        The ragged engine's aggregate ``result()`` feeds the returned state
+        through :meth:`compute_from`, so the served value is bit-exact vs the
+        eager oracle. Implemented alongside :meth:`grouped_update_spec`."""
+        raise MetricsTPUUserError(
+            f"{type(self).__name__} declares no grouped_update_spec(); "
+            "grouped_finalize is only meaningful for group-keyed metrics"
+        )
 
     def update_state_masked(self, state: Dict[str, Any], *args: Any, mask: Array, **kwargs: Any) -> Dict[str, Any]:
         """Pure mask-aware update: rows of the leading batch axis where ``mask``
@@ -635,6 +768,11 @@ class Metric:
         metrics where per-row state copies would be prohibitive) override this.
         """
         strategy = self.masked_update_strategy()
+        if strategy == "grouped":
+            raise MetricsTPUUserError(
+                f"{type(self).__name__} has no mask-aware update: "
+                f"{self.grouped_refusal_reason()}."
+            )
         if strategy is None:
             raise MetricsTPUUserError(
                 f"{type(self).__name__} has no mask-aware update: "
@@ -745,7 +883,11 @@ class Metric:
         """None when :meth:`update_state_segmented` applies: the generic
         row-delta path must hold (a custom fused masked form has no segmented
         counterpart, and scan-fallback metrics would serialize rows per
-        stream — neither serves the one-executable multi-stream contract)."""
+        stream — neither serves the one-executable multi-stream contract).
+        Group-keyed metrics refuse here too, pointing at the ragged engine
+        (their per-row keys are NOT the engine's stream ids)."""
+        if self.grouped_update_spec() is not None:
+            return self.grouped_refusal_reason()
         if type(self).update_state_masked is not Metric.update_state_masked:
             return "custom update_state_masked override has no segmented form"
         return self._delta_masked_reason()

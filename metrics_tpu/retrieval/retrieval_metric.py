@@ -14,12 +14,13 @@ same per-group host loop the reference uses (``_compute_host``), which also
 serves as the tested oracle for the segment path.
 """
 from abc import ABC, abstractmethod
-from typing import Any, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-from metrics_tpu.metric import Metric
+from metrics_tpu.metric import GroupedField, GroupedUpdateSpec, Metric
 from metrics_tpu.utils.checks import _check_retrieval_inputs
 from metrics_tpu.utils.data import dim_zero_cat, get_group_indexes
 
@@ -104,6 +105,82 @@ class RetrievalMetric(Metric, ABC):
                 empty_target_action=self.empty_target_action,
             )
         return self._compute_host(indexes, preds, target)
+
+    # ----------------------------------------------- ragged serving (ISSUE 17)
+    #
+    # A query id IS a group key: built-in retrieval metrics (those with a
+    # segment kind) declare grouped state so RaggedEngine can serve them —
+    # per-query (preds, target) rows land in capacity buffers, the per-group
+    # read runs grouped_query_score (byte-identical per-kind math), and the
+    # aggregate read rebuilds THESE eager list states and runs compute().
+
+    # per-group row budget for engine serving; subclasses/users may override
+    # the attribute (or pass capacity= to RaggedEngine) to fit their corpus
+    grouped_capacity: int = 256
+
+    def grouped_update_spec(self) -> Optional[GroupedUpdateSpec]:
+        if self._segment_dispatch() is None:
+            # custom-_metric subclasses need the host loop per group — the
+            # engine cannot run arbitrary Python per group
+            return None
+        return GroupedUpdateSpec(
+            fields=(
+                GroupedField("preds", (), jnp.float32),
+                GroupedField("target", (), jnp.float32),
+            ),
+            capacity=int(self.grouped_capacity),
+        )
+
+    def grouped_encode(self, preds: Array, target: Array, indexes: Array) -> Tuple[Any, ...]:
+        """Flatten one eager ``update`` call to ``(group_ids, preds, target)``
+        rows — the SAME validation/coercion as ``update`` (shape agreement,
+        integer indexes, eager ``ignore_index`` row filtering), so the engine
+        ingests exactly the rows the eager metric would append."""
+        if indexes is None:
+            raise ValueError("Argument `indexes` cannot be None")
+        indexes, preds, target = _check_retrieval_inputs(
+            indexes, preds, target,
+            allow_non_binary_target=self.allow_non_binary_target, ignore_index=self.ignore_index,
+        )
+        return (
+            np.asarray(indexes, np.int32),
+            np.asarray(preds, np.float32),
+            np.asarray(target, np.float32),
+        )
+
+    def grouped_group_value(self, fields: Dict[str, Array], count: Array, capacity: int) -> Array:
+        from metrics_tpu.functional.retrieval._segment import grouped_query_score
+
+        return grouped_query_score(
+            fields["preds"], fields["target"], count,
+            kind=self._segment_dispatch(), k=getattr(self, "k", None),
+            empty_target_action=self.empty_target_action,
+        )
+
+    def grouped_finalize(
+        self, counts: Any, fields: Dict[str, Any], group_ids: Any
+    ) -> Dict[str, Any]:
+        """Rebuild the eager list states from reconstructed per-group rows:
+        one (indexes, preds, target) part per non-empty group, in group-id
+        order. Queries with no rows never existed (exactly the eager
+        semantics); a corpus with no rows at all yields one empty part so
+        ``dim_zero_cat`` still sees arrays."""
+        counts = np.asarray(counts)
+        idx_parts: List[Array] = []
+        pred_parts: List[Array] = []
+        tgt_parts: List[Array] = []
+        for gid in np.asarray(group_ids):
+            c = int(counts[gid])
+            if c == 0:
+                continue
+            idx_parts.append(jnp.full((c,), int(gid), jnp.int32))
+            pred_parts.append(jnp.asarray(fields["preds"][gid][:c], jnp.float32))
+            tgt_parts.append(jnp.asarray(fields["target"][gid][:c], jnp.float32))
+        if not idx_parts:
+            idx_parts = [jnp.zeros((0,), jnp.int32)]
+            pred_parts = [jnp.zeros((0,), jnp.float32)]
+            tgt_parts = [jnp.zeros((0,), jnp.float32)]
+        return {"indexes": idx_parts, "preds": pred_parts, "target": tgt_parts}
 
     def _compute_host(self, indexes: Array, preds: Array, target: Array) -> Array:
         """Reference-parity per-group host loop (oracle + custom-subclass path)."""

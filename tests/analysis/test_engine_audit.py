@@ -230,6 +230,60 @@ def test_fleet_host_engine_audits_clean_and_catches_a_smuggled_collective():
     assert all("psum" in f.path for f in report.findings)
 
 
+def _drive_ragged(seed=0):
+    """A ragged engine (ISSUE 17) on a 1-device deferred mesh: the audited
+    step is the REAL grouped capacity write — one stable lexsort plus
+    mode="drop" scatters over (groups, cap) buffers."""
+    from metrics_tpu import RetrievalMAP
+    from metrics_tpu.engine import RaggedEngine
+
+    eng = RaggedEngine(
+        RetrievalMAP(), num_groups=4,
+        config=EngineConfig(buckets=(8,), mesh=_mesh1(), axis="dp", mesh_sync="deferred"),
+        capacity=16,
+    )
+    rng = np.random.RandomState(seed)
+    with eng:
+        for n in (5, 8, 3):
+            eng.submit(
+                rng.randint(0, 4, n).astype(np.int32),
+                rng.rand(n).astype(np.float32),
+                (rng.rand(n) > 0.5).astype(np.float32),
+            )
+        eng.result(0)
+    return eng
+
+
+def test_ragged_engine_audits_clean():
+    """ISSUE 17 clean sweep: the grouped step's lexsort + 2-d scatters and
+    the per-group read program must not trip any rule (collectives, arena,
+    compile cap) on a served ragged engine."""
+    eng = _drive_ragged()
+    report = EngineAnalysis().check(eng)
+    assert report.findings == [], report.render()
+
+
+def test_audit_catches_a_smuggled_collective_in_the_grouped_step():
+    """Broken fixture for the bootstrap matrix's ragged entry: a psum
+    smuggled into the GROUPED step must fire
+    ``no-collectives-in-deferred-step`` exactly like the dense engines —
+    the ragged steady state is pinned structurally, not just benched."""
+    eng = _drive_ragged()
+    assert EngineAnalysis().check(eng).ok  # sane before the break
+
+    inner = eng._traced_update
+
+    def smuggling_update(state_tree, payload, mask):
+        new = inner(state_tree, payload, mask)
+        return jax.tree.map(lambda x: jax.lax.psum(x, "dp"), new)
+
+    eng._traced_update = smuggling_update
+    report = EngineAnalysis().check(eng)
+    rules = {f.rule for f in report.findings}
+    assert rules == {"no-collectives-in-deferred-step"}, report.render()
+    assert all("psum" in f.path for f in report.findings)
+
+
 def test_audit_catches_a_blown_compile_cap():
     """Shrink the declared bucket set after serving: the programs-per-engine
     accounting must flag the (now) over-cap executable count."""

@@ -1,0 +1,163 @@
+"""Tie-break pins for the device greedy matcher (ISSUE 17 satellite).
+
+``_greedy_match_single`` resolves IoU ties with
+``jnp.max(jnp.where(pool & (masked == best), gt_idx, -1))`` — the LATER gt
+index wins, replicating the reference loop's non-strict ``<`` compare. That
+behavior was exercised only through random fuzz (ties have measure zero on
+random boxes); these tests pin it against an independent pure-numpy
+reimplementation of the COCO reference loop on inputs built to tie exactly:
+identical gt boxes (tied IoU), identical det scores (tied sort order), and
+regular-vs-ignored preference under ties.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu.detection.map import (
+    MeanAveragePrecision,
+    _greedy_match_single,
+    box_iou,
+)
+
+
+def _oracle_match(iou, det_valid, gt_valid, gt_ignore, thresholds):
+    """Reference COCO greedy loop (``pycocotools evaluateImg`` semantics),
+    written independently in numpy. Assumes — like the reference — that
+    area-ignored gts are sorted AFTER regular ones, which makes the
+    ``break`` rule equivalent to the device matcher's regular-first pool."""
+    D, G = iou.shape
+    T = len(thresholds)
+    det_matches = np.zeros((T, D), bool)
+    match_idx = -np.ones((T, D), np.int32)
+    for ti, thr in enumerate(thresholds):
+        gt_matched = np.zeros(G, bool)
+        for d in range(D):
+            best = min(thr, 1 - 1e-10)
+            mid = -1
+            for g in range(G):
+                if not gt_valid[g] or gt_matched[g]:
+                    continue
+                if mid > -1 and not gt_ignore[mid] and gt_ignore[g]:
+                    break
+                if iou[d, g] < best:
+                    continue
+                best = iou[d, g]
+                mid = g
+            if mid != -1 and det_valid[d]:
+                det_matches[ti, d] = True
+                match_idx[ti, d] = mid
+                gt_matched[mid] = True
+    return det_matches, match_idx
+
+
+def _run_device(iou, det_valid, gt_valid, gt_ignore, thresholds):
+    dm, mi = _greedy_match_single(
+        jnp.asarray(iou, jnp.float32),
+        jnp.asarray(det_valid),
+        jnp.asarray(gt_valid),
+        jnp.asarray(gt_ignore),
+        jnp.asarray(thresholds, jnp.float32),
+    )
+    return np.asarray(dm), np.asarray(mi)
+
+
+THR = [0.5, 0.75]
+
+
+def test_tied_iou_later_gt_wins():
+    """Two IDENTICAL gt boxes: the det ties exactly on IoU; both the device
+    matcher and the reference loop must hand it to the LATER gt index."""
+    iou = np.asarray([[0.8, 0.8]])
+    args = (iou, np.ones(1, bool), np.ones(2, bool), np.zeros(2, bool), THR)
+    dm, mi = _run_device(*args)
+    odm, omi = _oracle_match(*args)
+    np.testing.assert_array_equal(dm, odm)
+    np.testing.assert_array_equal(mi, omi)
+    assert mi[0, 0] == 1  # the pinned direction: later index
+
+
+def test_tied_iou_chain_two_dets_two_gts():
+    """Two dets, two identical gts: det 0 takes gt 1 (later wins), det 1 must
+    take the remaining gt 0 — the carry of the matched mask through the scan."""
+    iou = np.asarray([[0.7, 0.7], [0.7, 0.7]])
+    args = (iou, np.ones(2, bool), np.ones(2, bool), np.zeros(2, bool), THR)
+    dm, mi = _run_device(*args)
+    odm, omi = _oracle_match(*args)
+    np.testing.assert_array_equal(mi, omi)
+    np.testing.assert_array_equal(dm, odm)
+    assert list(mi[0]) == [1, 0]
+
+
+def test_tie_between_regular_and_ignored_regular_wins():
+    """A det tying on IoU between a regular and an area-ignored gt must take
+    the REGULAR one regardless of index order — the pool-preference rule."""
+    for ignored_first in (True, False):
+        gt_ignore = np.asarray([ignored_first, not ignored_first])
+        iou = np.asarray([[0.6, 0.6]])
+        dm, mi = _run_device(iou, np.ones(1, bool), np.ones(2, bool), gt_ignore, THR)
+        regular = int(np.flatnonzero(~gt_ignore)[0])
+        assert mi[0, 0] == regular
+        assert dm[0, 0]
+
+
+def test_ignored_only_candidates_still_match():
+    """When every qualifying gt is ignored the det still matches (and will be
+    counted ignored downstream), exactly like the reference fallthrough."""
+    iou = np.asarray([[0.9, 0.55]])
+    gt_ignore = np.ones(2, bool)
+    args = (iou, np.ones(1, bool), np.ones(2, bool), gt_ignore, THR)
+    dm, mi = _run_device(*args)
+    odm, omi = _oracle_match(*args)
+    np.testing.assert_array_equal(mi, omi)
+    assert mi[0, 0] == 0  # best IoU among ignored pool
+
+
+def test_randomized_quantized_ious_match_oracle():
+    """Fuzz with IoUs drawn from a COARSE grid so exact ties are dense, all
+    gts regular (index order == reference order): device == oracle verbatim."""
+    rng = np.random.RandomState(17)
+    for _ in range(25):
+        D, G = rng.randint(1, 6), rng.randint(1, 6)
+        iou = rng.choice([0.0, 0.25, 0.5, 0.5, 0.75, 0.75, 1.0], size=(D, G))
+        det_valid = rng.rand(D) > 0.2
+        gt_valid = rng.rand(G) > 0.2
+        args = (iou, det_valid, gt_valid, np.zeros(G, bool), [0.3, 0.5, 0.75])
+        dm, mi = _run_device(*args)
+        odm, omi = _oracle_match(*args)
+        np.testing.assert_array_equal(dm, odm, err_msg=f"iou={iou}")
+        np.testing.assert_array_equal(mi, omi, err_msg=f"iou={iou}")
+
+
+def test_tied_scores_end_to_end_device_equals_host():
+    """Tied detection scores AND tied IoUs through the full metric: the
+    device matcher path must equal the host oracle path bit-for-bit (the
+    stable score sort pins submission order into both)."""
+    boxes = np.asarray(
+        [[0, 0, 10, 10], [0, 0, 10, 10], [20, 20, 30, 30], [20, 20, 30, 30]],
+        np.float32,
+    )
+    preds = [{
+        "boxes": boxes,
+        "scores": np.asarray([0.9, 0.9, 0.9, 0.5], np.float32),  # three-way tie
+        "labels": np.zeros(4, np.int64),
+    }]
+    target = [{
+        "boxes": boxes[[0, 2]],
+        "labels": np.zeros(2, np.int64),
+    }]
+    dev = MeanAveragePrecision(matching="device")
+    host = MeanAveragePrecision(matching="host")
+    dev.update(preds, target)
+    host.update(preds, target)
+    rd, rh = dev.compute(), host.compute()
+    assert set(rd) == set(rh)
+    for k in rd:
+        np.testing.assert_array_equal(np.asarray(rd[k]), np.asarray(rh[k]), err_msg=k)
+
+
+def test_identical_boxes_iou_is_exactly_one():
+    """Sanity pin for the tie construction: identical boxes give IoU exactly
+    1.0 (no float fuzz), so the tied-IoU tests tie by construction."""
+    b = jnp.asarray([[0.0, 0.0, 10.0, 10.0]])
+    assert float(box_iou(b, b)[0, 0]) == 1.0

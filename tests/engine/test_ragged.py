@@ -1,0 +1,553 @@
+"""Ragged serving (ISSUE 17): group-keyed domains through the engine.
+
+Covers the new-subsystem contract end to end: typed construction refusals on
+BOTH sides of the fence (cat-list metric into a non-ragged engine, dense
+metric into the ragged engine), bit-exact aggregate serving for retrieval and
+detection vs their eager oracles, loud capacity overflow, kill/resume replay,
+deferred-mesh and windows+group-shard composition, zero steady-state
+compiles, and the ragged OpenMetrics families (present and strictly parsed on
+ragged engines, byte-absent on plain ones).
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu import Accuracy, RetrievalMAP, RetrievalNormalizedDCG
+from metrics_tpu.detection import MeanAveragePrecision
+from metrics_tpu.engine import (
+    AotCache,
+    EngineConfig,
+    GroupedStateMetric,
+    MultiStreamEngine,
+    RaggedEngine,
+    StreamingEngine,
+    WindowPolicy,
+)
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..", "tools"))
+import trace_export  # noqa: E402
+
+
+def _mesh():
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(jax.devices()), ("dp",))
+
+
+def _retrieval_batches(seed=0, n_batches=4, rows=9, groups=6):
+    # preds are GLOBALLY distinct across batches: equal sort keys may permute
+    # across shard/pane interleavings (the documented caveat), every strict
+    # ordering is bit-exact
+    rng = np.random.RandomState(seed)
+    vals = rng.permutation(n_batches * rows).astype(np.float32) / (n_batches * rows)
+    out = []
+    for b in range(n_batches):
+        idx = rng.randint(0, groups, rows)
+        target = rng.randint(0, 2, rows)
+        out.append((vals[b * rows:(b + 1) * rows], target, idx))
+    return out
+
+
+def _retrieval_oracle(batches, **kwargs):
+    m = RetrievalMAP(**kwargs)
+    for preds, target, idx in batches:
+        m.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(idx))
+    return float(m.compute())
+
+
+def _det_corpus(seed=1, images=3):
+    rng = np.random.RandomState(seed)
+    preds, target = [], []
+    for _ in range(images):
+        nd, ng = rng.randint(1, 4), rng.randint(1, 3)
+        pb = rng.rand(nd, 4).astype(np.float32) * 50
+        pb[:, 2:] += pb[:, :2] + 5
+        gb = rng.rand(ng, 4).astype(np.float32) * 50
+        gb[:, 2:] += gb[:, :2] + 5
+        preds.append({
+            "boxes": pb,
+            "scores": rng.permutation(nd * 7)[:nd].astype(np.float32) / (nd * 7),
+            "labels": rng.randint(0, 2, nd),
+        })
+        target.append({"boxes": gb, "labels": rng.randint(0, 2, ng)})
+    return preds, target
+
+
+# ------------------------------------------------------------------ typed refusals
+
+
+def test_streaming_engine_refuses_retrieval_with_pointer():
+    """Satellite 1: a cat-list metric into the plain engine refuses at
+    CONSTRUCTION, naming the metric, the offending states, and the ragged
+    path — not the generic delta/scan dead end."""
+    with pytest.raises(MetricsTPUUserError) as e:
+        StreamingEngine(RetrievalMAP(), EngineConfig(buckets=(8,)))
+    msg = str(e.value)
+    assert "RetrievalMAP" in msg
+    assert "'indexes'" in msg and "'preds'" in msg and "'target'" in msg
+    assert "RaggedEngine" in msg and "docs/serving.md" in msg
+
+
+def test_multistream_engine_refuses_detection_with_pointer():
+    with pytest.raises(MetricsTPUUserError) as e:
+        MultiStreamEngine(MeanAveragePrecision(), num_streams=2,
+                          config=EngineConfig(buckets=(8,)))
+    msg = str(e.value)
+    assert "MAP" in msg
+    assert "'detection_boxes'" in msg and "'groundtruth_boxes'" in msg
+    assert "RaggedEngine" in msg
+
+
+def test_ragged_engine_refuses_dense_metric():
+    with pytest.raises(MetricsTPUUserError, match="grouped_update_spec"):
+        RaggedEngine(Accuracy(), num_groups=4, config=EngineConfig(buckets=(8,)))
+
+
+def test_ragged_engine_refuses_megastep_backend():
+    with pytest.raises(MetricsTPUUserError, match="megastep"):
+        RaggedEngine(
+            RetrievalMAP(), num_groups=4,
+            config=EngineConfig(buckets=(8,), kernel_backend="megastep_interpret"),
+        )
+
+
+def test_grouped_wrapper_refuses_eager_update_and_bad_capacity():
+    with pytest.raises(MetricsTPUUserError, match="capacity"):
+        GroupedStateMetric(RetrievalMAP(), capacity=0)
+    w = GroupedStateMetric(RetrievalMAP(), capacity=8)
+    with pytest.raises(MetricsTPUUserError, match="ragged engine"):
+        w.update(jnp.zeros(3), jnp.zeros(3), jnp.zeros(3))
+
+
+def test_submit_validation_is_typed():
+    eng = RaggedEngine(RetrievalMAP(), num_groups=4,
+                       config=EngineConfig(buckets=(8,)), capacity=8)
+    try:
+        with pytest.raises(MetricsTPUUserError, match="2 field arrays"):
+            eng.submit(0, np.zeros(3, np.float32))
+        with pytest.raises(MetricsTPUUserError, match="leading"):
+            eng.submit(0, np.zeros(3, np.float32), np.zeros(2, np.float32))
+        with pytest.raises(MetricsTPUUserError, match="out of range"):
+            eng.submit(np.asarray([0, 9, 1]), np.zeros(3, np.float32),
+                       np.zeros(3, np.float32))
+        with pytest.raises(MetricsTPUUserError, match="scalar or a 1-d"):
+            eng.submit(np.zeros((3, 1), np.int64), np.zeros(3, np.float32),
+                       np.zeros(3, np.float32))
+    finally:
+        eng.stop()
+
+
+# ------------------------------------------------------------------ serving parity
+
+
+def test_retrieval_served_equals_eager_oracle_mixed_groups():
+    batches = _retrieval_batches()
+    eng = RaggedEngine(RetrievalMAP(), num_groups=6,
+                       config=EngineConfig(buckets=(16,)), capacity=16)
+    try:
+        for preds, target, idx in batches:
+            eng.submit_update(preds, target, idx)
+        eng.flush()
+        got = float(eng.result())
+    finally:
+        eng.stop()
+    np.testing.assert_allclose(got, _retrieval_oracle(batches), atol=1e-6)
+
+
+def test_retrieval_scalar_group_submit_and_per_group_read():
+    """Scalar group ids route like stream ids; result(gid) is the per-group
+    value through the compiled read."""
+    from metrics_tpu.functional import retrieval_average_precision
+
+    eng = RaggedEngine(RetrievalMAP(), num_groups=3,
+                       config=EngineConfig(buckets=(8,)), capacity=8)
+    try:
+        p0 = np.asarray([0.9, 0.2, 0.7], np.float32)
+        t0 = np.asarray([1, 0, 1], np.int64)
+        eng.submit(0, p0, t0.astype(np.float32))
+        eng.flush()
+        got = float(eng.result(0))
+    finally:
+        eng.stop()
+    want = float(retrieval_average_precision(jnp.asarray(p0), jnp.asarray(t0)))
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_detection_served_equals_eager_oracle():
+    preds, target = _det_corpus()
+    oracle = MeanAveragePrecision()
+    oracle.update(preds, target)
+    want = oracle.compute()
+    eng = RaggedEngine(MeanAveragePrecision(), num_groups=3,
+                       config=EngineConfig(buckets=(32,)), capacity=32)
+    try:
+        eng.submit_update(preds, target, image_ids=np.arange(3))
+        eng.flush()
+        got = eng.result()
+    finally:
+        eng.stop()
+    assert set(got) == set(want)
+    for k in want:
+        np.testing.assert_array_equal(np.asarray(got[k]), np.asarray(want[k]), err_msg=k)
+
+
+def test_detection_per_image_occupancy_read():
+    preds, target = _det_corpus()
+    eng = RaggedEngine(MeanAveragePrecision(), num_groups=3,
+                       config=EngineConfig(buckets=(32,)), capacity=32)
+    try:
+        eng.submit_update(preds, target, image_ids=np.arange(3))
+        eng.flush()
+        occ = eng.result(1)
+    finally:
+        eng.stop()
+    assert int(occ["detections"]) == len(preds[1]["boxes"])
+    assert int(occ["groundtruths"]) == len(target[1]["boxes"])
+
+
+def test_ndcg_served_equals_eager_oracle():
+    rng = np.random.RandomState(7)
+    idx = np.repeat(np.arange(4), 5)
+    preds = rng.permutation(20).astype(np.float32) / 20
+    target = rng.randint(0, 4, 20)
+    m = RetrievalNormalizedDCG()
+    m.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(idx))
+    eng = RaggedEngine(RetrievalNormalizedDCG(), num_groups=4,
+                       config=EngineConfig(buckets=(32,)), capacity=8)
+    try:
+        eng.submit_update(preds, target, idx)
+        eng.flush()
+        got = float(eng.result())
+    finally:
+        eng.stop()
+    np.testing.assert_allclose(got, float(m.compute()), atol=1e-6)
+
+
+# ---------------------------------------------------------------------- overflow
+
+
+def test_capacity_overflow_is_loud_not_truncated():
+    eng = RaggedEngine(RetrievalMAP(), num_groups=2,
+                       config=EngineConfig(buckets=(16,)), capacity=4)
+    try:
+        idx = np.zeros(9, np.int64)
+        preds = np.linspace(0.9, 0.1, 9).astype(np.float32)
+        target = (np.arange(9) % 2).astype(np.int64)
+        eng.submit_update(preds, target, idx)
+        eng.flush()
+        with pytest.raises(MetricsTPUUserError, match="overflow"):
+            eng.result()
+        assert eng.stats.summary()["ragged"]["overflows"] == 1
+        # the per-group read reports NaN for the overflowed group, not a value
+        assert np.isnan(float(eng.result(0)))
+    finally:
+        eng.stop()
+
+
+# ------------------------------------------------------------------- kill/resume
+
+
+def test_kill_resume_replay_is_exact(tmp_path):
+    batches = _retrieval_batches(seed=3, n_batches=6)
+    snapdir = str(tmp_path / "snaps")
+
+    def _cfg():
+        return EngineConfig(buckets=(16,), snapshot_dir=snapdir)
+
+    eng = RaggedEngine(RetrievalMAP(), num_groups=6, config=_cfg(), capacity=16)
+    try:
+        for preds, target, idx in batches[:3]:
+            eng.submit_update(preds, target, idx)
+        eng.flush()
+        eng.snapshot()
+    finally:
+        eng.stop()
+
+    resumed = RaggedEngine(RetrievalMAP(), num_groups=6, config=_cfg(), capacity=16)
+    try:
+        resumed.restore()
+        for preds, target, idx in batches[3:]:
+            resumed.submit_update(preds, target, idx)
+        resumed.flush()
+        got = float(resumed.result())
+    finally:
+        resumed.stop()
+    np.testing.assert_allclose(got, _retrieval_oracle(batches), atol=1e-6)
+
+
+def test_restore_refuses_non_ragged_snapshot(tmp_path):
+    snapdir = str(tmp_path / "snaps")
+    plain = StreamingEngine(
+        Accuracy(), EngineConfig(buckets=(8,), snapshot_dir=snapdir)
+    )
+    try:
+        plain.submit(jnp.asarray([0.1, 0.9, 0.8, 0.2]), jnp.ones(4, jnp.int32))
+        plain.flush()
+        plain.snapshot()
+    finally:
+        plain.stop()
+    eng = RaggedEngine(RetrievalMAP(), num_groups=2,
+                       config=EngineConfig(buckets=(8,), snapshot_dir=snapdir))
+    try:
+        with pytest.raises(MetricsTPUUserError, match="not written by a ragged"):
+            eng.restore()
+    finally:
+        eng.stop()
+
+
+def test_restore_refuses_capacity_and_group_mismatch(tmp_path):
+    snapdir = str(tmp_path / "snaps")
+    eng = RaggedEngine(RetrievalMAP(), num_groups=4,
+                       config=EngineConfig(buckets=(8,), snapshot_dir=snapdir),
+                       capacity=8)
+    try:
+        eng.submit_update(np.asarray([0.5, 0.4], np.float32),
+                          np.asarray([1, 0]), np.asarray([0, 1]))
+        eng.flush()
+        eng.snapshot()
+    finally:
+        eng.stop()
+    bad_cap = RaggedEngine(RetrievalMAP(), num_groups=4,
+                           config=EngineConfig(buckets=(8,), snapshot_dir=snapdir),
+                           capacity=16)
+    try:
+        with pytest.raises(MetricsTPUUserError, match="capacity=8"):
+            bad_cap.restore()
+    finally:
+        bad_cap.stop()
+    bad_groups = RaggedEngine(RetrievalMAP(), num_groups=5,
+                              config=EngineConfig(buckets=(8,), snapshot_dir=snapdir),
+                              capacity=8)
+    try:
+        with pytest.raises(MetricsTPUUserError, match="4 groups"):
+            bad_groups.restore()
+    finally:
+        bad_groups.stop()
+
+
+# ------------------------------------------------------------------- composition
+
+
+def test_deferred_mesh_serving_is_bit_exact():
+    batches = _retrieval_batches(seed=5, n_batches=4, rows=16, groups=6)
+    eng = RaggedEngine(
+        RetrievalMAP(), num_groups=6,
+        config=EngineConfig(buckets=(16,), mesh=_mesh(), axis="dp",
+                            mesh_sync="deferred"),
+        capacity=32,
+    )
+    try:
+        for preds, target, idx in batches:
+            eng.submit_update(preds, target, idx)
+        eng.flush()
+        got = float(eng.result())
+        per_group = float(eng.result(3))
+    finally:
+        eng.stop()
+    np.testing.assert_allclose(got, _retrieval_oracle(batches), atol=1e-6)
+    assert np.isfinite(per_group) or np.isnan(per_group)
+
+
+def test_group_shard_pager_serving_is_bit_exact():
+    """The stream-shard pager at group grain: groups shard over the mesh,
+    cold groups page, the aggregate read still reconstructs every group."""
+    batches = _retrieval_batches(seed=6, n_batches=4, rows=12, groups=8)
+    eng = RaggedEngine(
+        RetrievalMAP(), num_groups=8,
+        config=EngineConfig(buckets=(16,), mesh=_mesh(), axis="dp",
+                            mesh_sync="deferred"),
+        capacity=16, group_shard=True, resident_groups=2,
+    )
+    try:
+        for preds, target, idx in batches:
+            eng.submit_update(preds, target, idx)
+        eng.flush()
+        got = float(eng.result())
+    finally:
+        eng.stop()
+    np.testing.assert_allclose(got, _retrieval_oracle(batches), atol=1e-6)
+
+
+def test_windows_with_group_shard_composes(tmp_path):
+    """WindowPolicy + group_shard together: both the aggregate and the
+    per-group read serve from the open pane."""
+    batches = _retrieval_batches(seed=8, n_batches=2, rows=10, groups=4)
+    eng = RaggedEngine(
+        RetrievalMAP(), num_groups=4,
+        config=EngineConfig(buckets=(16,), mesh=_mesh(), axis="dp",
+                            mesh_sync="deferred",
+                            window=WindowPolicy.tumbling(pane_batches=100)),
+        capacity=32, group_shard=True, resident_groups=2,
+    )
+    try:
+        for preds, target, idx in batches:
+            eng.submit_update(preds, target, idx)
+        eng.flush()
+        got = float(eng.result())
+        _ = eng.result(0)
+    finally:
+        eng.stop()
+    np.testing.assert_allclose(got, _retrieval_oracle(batches), atol=1e-6)
+
+
+def test_sliding_window_fold_matches_oracle():
+    """A sliding window wider than the traffic folds every pane through the
+    wrapper's compaction merge — equal to the unwindowed oracle."""
+    batches = _retrieval_batches(seed=9, n_batches=3, rows=8, groups=4)
+    eng = RaggedEngine(
+        RetrievalMAP(), num_groups=4,
+        config=EngineConfig(buckets=(8,),
+                            window=WindowPolicy.sliding(n_panes=4, pane_batches=100)),
+        capacity=32,
+    )
+    try:
+        for preds, target, idx in batches:
+            eng.submit_update(preds, target, idx)
+        eng.flush()
+        got = float(eng.result())
+    finally:
+        eng.stop()
+    np.testing.assert_allclose(got, _retrieval_oracle(batches), atol=1e-6)
+
+
+# --------------------------------------------------------------- steady compiles
+
+
+def test_zero_steady_state_compiles():
+    batches = _retrieval_batches(seed=11, n_batches=3)
+    cache = AotCache()
+    eng = RaggedEngine(RetrievalMAP(), num_groups=6,
+                       config=EngineConfig(buckets=(16,)), capacity=16,
+                       aot_cache=cache)
+    try:
+        for preds, target, idx in batches:
+            eng.submit_update(preds, target, idx)
+        eng.flush()
+        warm = cache.misses
+        eng.reset()
+        for preds, target, idx in batches:
+            eng.submit_update(preds, target, idx)
+        eng.flush()
+        assert cache.misses == warm, "steady-state replay must not compile"
+    finally:
+        eng.stop()
+
+
+# ------------------------------------------------------------------- telemetry
+
+
+def test_openmetrics_ragged_families_strict_both_directions():
+    batches = _retrieval_batches(seed=12, n_batches=2)
+    eng = RaggedEngine(RetrievalMAP(), num_groups=6,
+                       config=EngineConfig(buckets=(16,)), capacity=16)
+    try:
+        for preds, target, idx in batches:
+            eng.submit_update(preds, target, idx)
+        eng.flush()
+        fams = trace_export.parse_openmetrics(eng.metrics_text())
+    finally:
+        eng.stop()
+    assert fams["metrics_tpu_engine_ragged_batches"]["type"] == "counter"
+    assert fams["metrics_tpu_engine_ragged_rows"]["type"] == "counter"
+    assert fams["metrics_tpu_engine_ragged_groups_touched"]["type"] == "counter"
+    assert fams["metrics_tpu_engine_ragged_overflows"]["type"] == "counter"
+    assert fams["metrics_tpu_engine_ragged_groups"]["type"] == "gauge"
+    assert fams["metrics_tpu_engine_ragged_capacity"]["type"] == "gauge"
+    # a non-ragged engine's exposition is byte-free of the ragged families
+    plain = StreamingEngine(Accuracy(), EngineConfig(buckets=(8,)))
+    try:
+        plain.submit(jnp.asarray([0.1, 0.9]), jnp.ones(2, jnp.int32))
+        plain.flush()
+        assert "ragged" not in plain.metrics_text()
+    finally:
+        plain.stop()
+
+
+def test_stats_summary_ragged_block():
+    eng = RaggedEngine(RetrievalMAP(), num_groups=5,
+                       config=EngineConfig(buckets=(8,)), capacity=8)
+    try:
+        eng.submit_update(np.asarray([0.9, 0.1, 0.5], np.float32),
+                          np.asarray([1, 0, 1]), np.asarray([0, 0, 2]))
+        eng.flush()
+        block = eng.stats.summary()["ragged"]
+    finally:
+        eng.stop()
+    assert block["groups"] == 5 and block["capacity"] == 8
+    assert block["batches"] == 1 and block["rows"] == 3
+    assert block["groups_touched"] == 2 and block["overflows"] == 0
+    plain = StreamingEngine(Accuracy(), EngineConfig(buckets=(8,)))
+    try:
+        assert "ragged" not in plain.stats.summary()
+    finally:
+        plain.stop()
+
+
+def test_engine_report_renders_ragged_row_and_degrades():
+    import engine_report
+
+    eng = RaggedEngine(RetrievalMAP(), num_groups=5,
+                       config=EngineConfig(buckets=(8,)), capacity=8)
+    try:
+        eng.submit_update(np.asarray([0.9, 0.1, 0.5], np.float32),
+                          np.asarray([1, 0, 1]), np.asarray([0, 0, 2]))
+        eng.flush()
+        doc = {"summary": eng.stats.summary(), "recent_steps": []}
+    finally:
+        eng.stop()
+    rendered = engine_report.render(doc)
+    assert "ragged groups" in rendered
+    assert "2 of 5 touched" in rendered and "capacity 8" in rendered
+    # no overflows -> the OVERFLOWS flag stays out of the healthy render
+    assert "OVERFLOWS" not in rendered
+    # no ragged block — the row must simply be absent, nothing crashes
+    plain = StreamingEngine(Accuracy(), EngineConfig(buckets=(8,)))
+    try:
+        plain.submit(np.asarray([0.5], np.float32), np.asarray([1], np.int32))
+        plain.result()
+        rendered_plain = engine_report.render(
+            {"summary": plain.stats.summary(), "recent_steps": []})
+    finally:
+        plain.stop()
+    assert "ragged groups" not in rendered_plain
+
+
+# ------------------------------------------------------- wrapper merge mechanics
+
+
+def test_merge_stacked_states_compacts_replica_major():
+    w = GroupedStateMetric(RetrievalMAP(), capacity=4)
+    # 2 replicas x 3 groups: group 0 split 2+1, group 1 only on replica 1,
+    # group 2 empty everywhere
+    count = jnp.asarray([[2, 0, 0], [1, 2, 0]], jnp.int32)
+    buf = jnp.zeros((2, 3, 4), jnp.float32)
+    buf = buf.at[0, 0, :2].set(jnp.asarray([1.0, 2.0]))
+    buf = buf.at[1, 0, :1].set(jnp.asarray([3.0]))
+    buf = buf.at[1, 1, :2].set(jnp.asarray([4.0, 5.0]))
+    merged = w.merge_stacked_states(
+        {"count": count, "buf_preds": buf, "buf_target": buf}
+    )
+    np.testing.assert_array_equal(np.asarray(merged["count"]), [3, 2, 0])
+    got = np.asarray(merged["buf_preds"])
+    np.testing.assert_allclose(got[0, :3], [1.0, 2.0, 3.0])  # replica-major
+    np.testing.assert_allclose(got[1, :2], [4.0, 5.0])
+
+
+def test_merge_stacked_states_overflow_sums_true_count():
+    """Two replicas each half-full past the JOINT capacity: the merged count
+    keeps the true total (the overflow signal), the buffer holds the first
+    ``capacity`` rows in replica order."""
+    w = GroupedStateMetric(RetrievalMAP(), capacity=2)
+    count = jnp.asarray([[2], [2]], jnp.int32)
+    buf = jnp.asarray([[[1.0, 2.0]], [[3.0, 4.0]]], jnp.float32)
+    merged = w.merge_stacked_states(
+        {"count": count, "buf_preds": buf, "buf_target": buf}
+    )
+    assert int(merged["count"][0]) == 4  # > capacity: loud at the aggregate read
+    np.testing.assert_allclose(np.asarray(merged["buf_preds"])[0], [1.0, 2.0])

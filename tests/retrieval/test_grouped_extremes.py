@@ -1,0 +1,217 @@
+"""Cardinality-extreme property pins for the retrieval group machinery
+(ISSUE 17 satellite): the segment path AND the ragged serving path
+(``RaggedEngine`` group-keyed capacity buffers) against the reference-parity
+per-group host loop (``RetrievalMetric._compute_host``) at the shapes that
+break group logic — single-doc queries, one query owning the whole corpus,
+all-empty-target corpora under each ``empty_target_action``, and
+``ignore_index`` rows sitting exactly on group boundaries.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu import (
+    RetrievalFallOut,
+    RetrievalHitRate,
+    RetrievalMAP,
+    RetrievalMRR,
+    RetrievalNormalizedDCG,
+    RetrievalPrecision,
+    RetrievalRecall,
+    RetrievalRPrecision,
+)
+from metrics_tpu.engine import EngineConfig, RaggedEngine
+from metrics_tpu.functional.retrieval._segment import grouped_query_score
+from tests.helpers import seed_all
+
+seed_all(17)
+
+KINDS = [
+    (RetrievalMAP, {}),
+    (RetrievalMRR, {}),
+    (RetrievalPrecision, {"k": 2}),
+    (RetrievalRecall, {}),
+    (RetrievalRPrecision, {}),
+    (RetrievalHitRate, {"k": 1}),
+    (RetrievalFallOut, {}),
+    (RetrievalNormalizedDCG, {}),
+]
+
+
+def _host(metric, indexes, preds, target):
+    return float(
+        metric._compute_host(jnp.asarray(indexes), jnp.asarray(preds), jnp.asarray(target))
+    )
+
+
+def _served(cls, kwargs, indexes, preds, target, num_groups, capacity=32):
+    eng = RaggedEngine(
+        cls(**kwargs), num_groups=num_groups,
+        config=EngineConfig(buckets=(64,)), capacity=capacity,
+    )
+    try:
+        eng.submit_update(np.asarray(preds), np.asarray(target), np.asarray(indexes))
+        eng.flush()
+        return float(eng.result())
+    finally:
+        eng.stop()
+
+
+# ------------------------------------------------------------- cardinality extremes
+
+
+@pytest.mark.parametrize("cls,kwargs", KINDS, ids=lambda v: getattr(v, "__name__", str(v)))
+def test_all_single_doc_queries(cls, kwargs):
+    """Every query holds exactly one document — rank math degenerates to the
+    first-position case in every group at once."""
+    rng = np.random.RandomState(0)
+    n = 11
+    indexes = np.arange(n)
+    preds = rng.rand(n).astype(np.float32)
+    graded = cls is RetrievalNormalizedDCG
+    target = (rng.randint(0, 4, n) if graded else rng.randint(0, 2, n))
+    m = cls(**kwargs)
+    m.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(indexes))
+    host = _host(m, indexes, preds, target)
+    np.testing.assert_allclose(float(m.compute()), host, atol=1e-6)
+    np.testing.assert_allclose(
+        _served(cls, kwargs, indexes, preds, target, num_groups=n), host, atol=1e-6
+    )
+
+
+@pytest.mark.parametrize("cls,kwargs", KINDS, ids=lambda v: getattr(v, "__name__", str(v)))
+def test_one_query_owns_everything(cls, kwargs):
+    """One group holds the whole corpus — the segment machinery must behave as
+    plain ranking, and the ragged capacity buffer fills to its brim."""
+    rng = np.random.RandomState(1)
+    n = 30
+    indexes = np.zeros(n, np.int64)
+    preds = rng.rand(n).astype(np.float32)
+    graded = cls is RetrievalNormalizedDCG
+    target = (rng.randint(0, 4, n) if graded else rng.randint(0, 2, n))
+    target[0] = 1  # never degenerate
+    m = cls(**kwargs)
+    m.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(indexes))
+    host = _host(m, indexes, preds, target)
+    np.testing.assert_allclose(float(m.compute()), host, atol=1e-6)
+    np.testing.assert_allclose(
+        _served(cls, kwargs, indexes, preds, target, num_groups=4, capacity=n),
+        host, atol=1e-6,
+    )
+
+
+@pytest.mark.parametrize("action", ["neg", "pos", "skip"])
+def test_all_queries_empty_target(action):
+    """EVERY query is degenerate (no positive target): the action value is the
+    whole answer, in the segment path, the host loop, and the served path."""
+    indexes = np.repeat(np.arange(4), 3)
+    preds = np.linspace(0.9, 0.1, 12).astype(np.float32)
+    target = np.zeros(12, np.int64)
+    m = RetrievalMAP(empty_target_action=action)
+    m.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(indexes))
+    host = _host(m, indexes, preds, target)
+    np.testing.assert_allclose(float(m.compute()), host, atol=1e-6)
+    served = _served(RetrievalMAP, {"empty_target_action": action},
+                     indexes, preds, target, num_groups=4)
+    np.testing.assert_allclose(served, host, atol=1e-6)
+
+
+def test_all_queries_empty_target_error_raises_everywhere():
+    indexes = np.asarray([0, 0, 1, 1])
+    preds = np.asarray([0.5, 0.4, 0.3, 0.2], np.float32)
+    target = np.zeros(4, np.int64)
+    m = RetrievalMAP(empty_target_action="error")
+    m.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(indexes))
+    with pytest.raises(ValueError, match="no positive"):
+        m.compute()
+    eng = RaggedEngine(RetrievalMAP(empty_target_action="error"), num_groups=2,
+                       config=EngineConfig(buckets=(8,)), capacity=8)
+    try:
+        eng.submit_update(preds, target, indexes)
+        eng.flush()
+        with pytest.raises(ValueError, match="no positive"):
+            eng.result()
+    finally:
+        eng.stop()
+
+
+# --------------------------------------------------- ignore_index x group boundaries
+
+
+@pytest.mark.parametrize("cls,kwargs", [(RetrievalMAP, {}), (RetrievalNormalizedDCG, {})],
+                         ids=lambda v: getattr(v, "__name__", str(v)))
+def test_ignore_index_on_group_boundaries(cls, kwargs):
+    """Rows carrying the ignore sentinel sit exactly at group edges (first/last
+    row of each group), including one group made ENTIRELY of ignored rows —
+    after the eager filter it must vanish from the group universe, not score."""
+    IGN = -1
+    indexes = np.asarray([0, 0, 0, 1, 1, 2, 2, 2, 3, 3])
+    preds = np.asarray([0.9, 0.8, 0.7, 0.6, 0.5, 0.4, 0.3, 0.2, 0.15, 0.1], np.float32)
+    target = np.asarray([IGN, 1, 0, 1, IGN, IGN, IGN, IGN, 1, 1], np.int64)
+    m = cls(ignore_index=IGN, **kwargs)
+    m.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(indexes))
+    keep = target != IGN
+    host = _host(m, indexes[keep], preds[keep], target[keep])
+    np.testing.assert_allclose(float(m.compute()), host, atol=1e-6)
+    np.testing.assert_allclose(
+        _served(cls, dict(kwargs, ignore_index=IGN), indexes, preds, target, num_groups=4),
+        host, atol=1e-6,
+    )
+
+
+def test_ignore_index_filter_happens_before_ingestion():
+    """grouped_encode applies the same eager filter update does: ignored rows
+    never reach the engine, so per-group counts exclude them."""
+    m = RetrievalMAP(ignore_index=-1)
+    gids, preds, target = m.grouped_encode(
+        np.asarray([0.9, 0.8, 0.7], np.float32),
+        np.asarray([1, -1, 0], np.int64),
+        np.asarray([0, 0, 1]),
+    )
+    assert gids.shape == (2,) and list(gids) == [0, 1]
+    np.testing.assert_allclose(preds, [0.9, 0.7])
+
+
+# -------------------------------------------------------------- per-group read pins
+
+
+def test_grouped_query_score_matches_host_per_query():
+    """The traced per-group read (capacity buffers + count) equals the host
+    loop's per-query value on a strict ordering."""
+    rng = np.random.RandomState(3)
+    cap = 16
+    for kind_cls, kwargs in [(RetrievalMAP, {}), (RetrievalNormalizedDCG, {}),
+                             (RetrievalPrecision, {"k": 2})]:
+        m = kind_cls(**kwargs)
+        n = 7
+        preds = rng.rand(n).astype(np.float32)
+        target = rng.randint(0, 2, n)
+        target[0] = 1
+        buf_p = np.zeros(cap, np.float32)
+        buf_t = np.zeros(cap, np.float32)
+        buf_p[:n], buf_t[:n] = preds, target
+        got = float(grouped_query_score(
+            jnp.asarray(buf_p), jnp.asarray(buf_t), jnp.asarray(n),
+            kind=m._segment_dispatch(), k=getattr(m, "k", None),
+            empty_target_action=m.empty_target_action,
+        ))
+        want = _host(m, np.zeros(n, np.int64), preds, target)
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_grouped_query_score_sentinels():
+    """count==0 -> 0.0; empty-target under skip -> NaN (no defined per-group
+    value); overflow (count > capacity) -> NaN, never a silent truncation."""
+    cap = 4
+    z = jnp.zeros(cap, jnp.float32)
+    val = grouped_query_score(z, z, jnp.asarray(0), kind="map")
+    assert float(val) == 0.0
+    # rows present, no positive target, skip action
+    p = jnp.asarray([0.5, 0.4, 0.0, 0.0], jnp.float32)
+    val = grouped_query_score(p, z, jnp.asarray(2), kind="map", empty_target_action="skip")
+    assert np.isnan(float(val))
+    # overflow
+    t = jnp.asarray([1.0, 0.0, 0.0, 0.0], jnp.float32)
+    val = grouped_query_score(p, t, jnp.asarray(9), kind="map")
+    assert np.isnan(float(val))

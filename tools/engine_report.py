@@ -23,6 +23,9 @@ deferred stale reads) and the elastic-reshard row (count + the last
 world→world transition and its replay cursor). Windowed engines (ISSUE 13)
 render the windows block: policy tag, pane rotations, live panes + ring
 cursor, ewma decays applied, and the drift-tracker row (pane evals, alarms).
+Ragged engines (ISSUE 17) render the ragged-groups row: groups touched of
+the declared universe, per-group capacity, ingest volume, and overflow
+firings.
 When the engine ran with a flight recorder (``EngineConfig(trace=...)``,
 PR 8) the document carries a ``trace`` section and the report renders the
 trace/SLO block: spans recorded/dropped, latency histogram counts, and the
@@ -191,6 +194,28 @@ def render(doc: dict, steps: int = 10, analysis: dict = None) -> str:
                     f"{_fmt(drift.get('alarms'))} alarms",
                 )
             )
+    ragged = s.get("ragged")
+    if ragged:
+        # group-keyed serving section (ISSUE 17): the declared group
+        # universe and capacity, the ingest volume, how many groups have
+        # rows, and overflow firings (a nonzero count means some group's
+        # TRUE row total exceeded capacity — the aggregate read raises).
+        # Non-ragged documents carry no block and render exactly as before.
+        rows.append(
+            (
+                "ragged groups",
+                f"{_fmt(ragged.get('groups_touched'))} of "
+                f"{_fmt(ragged.get('groups'))} touched"
+                f" · capacity {_fmt(ragged.get('capacity'))}"
+                f" · {_fmt(ragged.get('rows'))} rows in "
+                f"{_fmt(ragged.get('batches'))} grouped batches"
+                + (
+                    f" · {_fmt(ragged.get('overflows'))} OVERFLOWS"
+                    if ragged.get("overflows")
+                    else ""
+                ),
+            )
+        )
     fleet = s.get("fleet")
     if fleet:
         # per-host fleet section (ISSUE 15): which host of how many this
