@@ -170,7 +170,12 @@ def bootstrap_engines(
         # so `no-collectives-in-deferred-step` pins the grouped steady step
         # at jaxpr AND HLO level exactly like the dense engines (broken-
         # fixture proof: a psum smuggled into the grouped step fails the
-        # rule — tests/analysis/test_engine_audit.py)
+        # rule — tests/analysis/test_engine_audit.py). The served aggregate()
+        # compiles the DEVICE fold program (ISSUE 18), so the audit also
+        # walks the re-traced batched-read aggregate: no host callbacks, no
+        # collectives, bounded kernel launches (broken-fixture proof: a
+        # pure_callback smuggled into grouped_batch_scores fails
+        # `no-host-callback-in-aggregate` — tests/analysis/test_engine_audit.py)
         from metrics_tpu import RetrievalMAP
         from metrics_tpu.engine import RaggedEngine
 
@@ -187,6 +192,7 @@ def bootstrap_engines(
                 gids = (np.arange(p.shape[0]) % 4).astype(np.int32)
                 engine.submit(gids, p, t.astype(np.float32))
             engine.result(0)
+            engine.aggregate()
         out.append((f"ragged/arena/grouped/{backend}", engine))
     # MEGASTEP engines (ISSUE 16): the whole-step fused tier joins the matrix
     # outside the backend loop — megastep is arena-only and opt-in (the

@@ -301,6 +301,34 @@ class EngineAnalysis:
                     merge_jaxpr, info, engine._world, where=f"{label}/merge"
                 ))
 
+        # device-aggregate programs (ISSUE 18): ragged engines re-trace their
+        # batched fold / corpus bundle FRESH on every audit (so a
+        # monkeypatched metric hook is seen) — host callbacks are banned
+        # outright (each one is a synchronous round-trip per dispatch),
+        # deferred meshes stay collective-free in the aggregate exactly like
+        # the steady step, and a kernel-backed FOLD aggregate keeps its
+        # launch count bounded (batched-read form: a handful of masked
+        # column folds, never O(groups); the corpus bundle is pure XLA —
+        # greedy matching has no kernel form — so the launch pin skips it)
+        agg_fn = getattr(engine, "_aggregate_audit_jaxprs", None)
+        if agg_fn is not None:
+            from metrics_tpu.ops.kernels.dispatch import resolve_backend
+
+            agg_kernel = (
+                resolve_backend(getattr(engine, "_agg_backend", None)) != "xla"
+            )
+            for agg_label, agg_jaxpr in agg_fn():
+                agg_where = f"{label}/{agg_label}"
+                report.extend(R.check_no_host_callbacks(agg_jaxpr, where=agg_where))
+                if deferred:
+                    report.extend(R.check_no_collectives(
+                        jaxpr=agg_jaxpr, hlo_text=None, where=agg_where
+                    ))
+                if agg_kernel and agg_label != "aggregate/corpus":
+                    report.extend(R.check_pallas_call_count(
+                        agg_jaxpr, min_count=1, max_count=8, where=agg_where
+                    ))
+
         # compile cap: programs this engine owns in its (possibly shared) cache
         cap_detail = ""
         n_owned = self._owned_programs(engine)
@@ -319,12 +347,17 @@ class EngineAnalysis:
                     win_extra += 1  # indexed pane_value / sliding row folds
                 if getattr(engine, "_stream_shard", False) and engine._window.stacked:
                     win_extra += 1  # batched sliding fold over reassembled rows
+            # device aggregates (ISSUE 18) own a small fixed allowance too:
+            # the fold program, the paged block+final pair, or the corpus
+            # bundle's padded-class buckets — declared by the engine itself
+            agg_extra = int(getattr(engine, "_aggregate_program_cap", lambda: 0)())
             cap = (
                 len(engine._cfg.buckets) * max(1, len(structures))
                 + 1                           # compute
                 + (1 if deferred else 0)      # boundary merge
                 + (1 if multistream else 0)   # batched all-streams compute
                 + win_extra
+                + agg_extra
             )
             cap_detail = (
                 f"{len(engine._cfg.buckets)} buckets x {max(1, len(structures))} "
@@ -332,6 +365,7 @@ class EngineAnalysis:
                 + (" + merge" if deferred else "")
                 + (" + batched results" if multistream else "")
                 + (f" + {win_extra} window programs" if win_extra else "")
+                + (f" + {agg_extra} aggregate programs" if agg_extra else "")
             )
             report.extend(R.check_compile_cap(
                 n_owned, cap, where=f"{label}/programs", detail=cap_detail

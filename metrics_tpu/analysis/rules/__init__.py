@@ -20,6 +20,7 @@ from metrics_tpu.analysis.rules.collectives import (
     expected_step_sync_collectives,
     hlo_collective_counts,
 )
+from metrics_tpu.analysis.rules.callbacks import check_no_host_callbacks
 from metrics_tpu.analysis.rules.compile_cap import check_compile_cap
 from metrics_tpu.analysis.rules.constants import (
     check_no_baked_host_constants,
@@ -65,6 +66,7 @@ __all__ = [
     "lockset_findings",
     "check_no_baked_host_constants",
     "check_no_collectives",
+    "check_no_host_callbacks",
     "check_megastep_launch_count",
     "check_no_scatter_under_pallas",
     "check_pallas_call_count",
@@ -113,6 +115,16 @@ RULES: Dict[str, RuleInfo] = {
             "quantized state on the f32 psum pays exact bandwidth silently.",
             incident="ISSUE 10: the policy is a trace constant, so a stale "
             "program serves the WRONG precision without erroring",
+        ),
+        RuleInfo(
+            "no-host-callback-in-aggregate", "program", "error",
+            "Device-aggregate programs (the ragged batched fold / corpus "
+            "bundle) contain no host-callback primitives at any depth — a "
+            "pure_callback inside the trace is a synchronous host round-trip "
+            "per dispatch, the per-group host loop the path exists to delete, "
+            "invisible to the dispatch counters.",
+            incident="ISSUE 18: the aggregate's one-dispatch contract is "
+            "pinned structurally, not just by the bench's latency series",
         ),
         RuleInfo(
             "no-scatter-under-pallas", "program", "error",

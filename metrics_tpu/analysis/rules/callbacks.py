@@ -1,0 +1,48 @@
+"""Host-callback rule: device aggregates stay on device (ISSUE 18).
+
+The ragged device aggregate's whole contract is ONE device program plus one
+scalar-bundle transfer; a ``pure_callback`` / ``io_callback`` /
+``debug_callback`` smuggled anywhere into the traced aggregate reintroduces
+a host round-trip INSIDE the dispatch — the per-group host loop the path
+exists to delete, hidden where the stats counters (``result_device_calls``,
+``agg_device_reads``) can no longer see it. The rule walks the re-traced
+aggregate jaxprs at every depth, so a callback buried under a ``vmap`` or
+``scan`` body fires the same as a top-level one.
+"""
+from typing import Any, List
+
+from metrics_tpu.analysis.core import Finding
+
+__all__ = ["check_no_host_callbacks"]
+
+
+def _callback_paths(jaxpr: Any) -> List[str]:
+    from metrics_tpu.analysis.program import iter_eqns, unwrap_jaxpr
+
+    return [
+        f"{path}:{eqn.primitive.name}"
+        for path, eqn in iter_eqns(unwrap_jaxpr(jaxpr))
+        if "callback" in eqn.primitive.name
+    ]
+
+
+def check_no_host_callbacks(jaxpr: Any, where: str = "") -> List[Finding]:
+    """Rule ``no-host-callback-in-aggregate``: a device-aggregate program
+    must contain NO host-callback primitives (``*callback*``) at any depth —
+    each one is a synchronous host round-trip per dispatch, silently turning
+    the one-program aggregate back into host-paced serving."""
+    return [
+        Finding(
+            rule="no-host-callback-in-aggregate", severity="error",
+            where=where, path=path,
+            message="host callback primitive traced in a device-aggregate program",
+            hint=(
+                "express the score/fold on-device (grouped_batch_scores / "
+                "grouped_corpus_device are traced under jit); host-only logic "
+                "belongs in the plan/finish hooks, which run OUTSIDE the "
+                "compiled program — or serve the metric with "
+                "aggregate_oracle=True and keep the host path explicit"
+            ),
+        )
+        for path in _callback_paths(jaxpr)
+    ]

@@ -60,13 +60,18 @@ def check_pallas_call_count(
     jaxpr: Any,
     expected: Optional[int] = None,
     min_count: Optional[int] = None,
+    max_count: Optional[int] = None,
     where: str = "",
 ) -> List[Finding]:
     """Rule ``pallas-call-per-leaf``: the number of ``pallas_call`` eqns in a
     kernel-backend program. ``expected`` pins an exact count (delta-strategy
     metrics fold one kernel per state leaf); ``min_count`` asserts the kernel
     path engaged at all (the engine audit's weaker form — eligibility rules
-    may legitimately route SOME leaves to XLA)."""
+    may legitimately route SOME leaves to XLA); ``max_count`` bounds the
+    launch count from above (the batched-read form, ISSUE 18: a ragged
+    device aggregate folds its scalar-bundle columns in a handful of masked
+    kernels — a count scaling with the group universe means the batched
+    program degraded to per-group launches)."""
     from metrics_tpu.analysis.program import primitive_counts
 
     n = primitive_counts(jaxpr).get("pallas_call", 0)
@@ -82,13 +87,24 @@ def check_pallas_call_count(
             message=f"program traces {n} pallas_call eqns, expected exactly {expected}",
             hint=hint,
         )]
+    findings: List[Finding] = []
     if min_count is not None and n < min_count:
-        return [Finding(
+        findings.append(Finding(
             rule="pallas-call-per-leaf", severity="error", where=where, path="",
             message=f"program traces {n} pallas_call eqns, expected at least {min_count}",
             hint=hint,
-        )]
-    return []
+        ))
+    if max_count is not None and n > max_count:
+        findings.append(Finding(
+            rule="pallas-call-per-leaf", severity="error", where=where, path="",
+            message=(
+                f"program traces {n} pallas_call eqns, expected at most "
+                f"{max_count} — launch count must not scale with the group "
+                "universe (batched-read contract)"
+            ),
+            hint=hint,
+        ))
+    return findings
 
 
 def check_megastep_launch_count(

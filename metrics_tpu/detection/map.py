@@ -30,7 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from metrics_tpu.metric import GroupedField, GroupedUpdateSpec, Metric
+from metrics_tpu.metric import GroupedAggregateSpec, GroupedField, GroupedUpdateSpec, Metric
 
 Array = jax.Array
 
@@ -148,6 +148,53 @@ def _bucket(n: int, mult: int) -> int:
     """Round up to a multiple of ``mult`` — bounds the number of distinct
     compiled shapes without the 2x padding waste of pow2 bucketing."""
     return ((n + mult - 1) // mult) * mult
+
+
+def _pr_accumulate(
+    det_scores: np.ndarray,  # (N,) corpus det scores, image-major
+    det_matches: np.ndarray,  # (T, N) bool
+    det_ignore: np.ndarray,  # (T, N) bool
+    npig: int,
+    rec_thresholds: np.ndarray,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """The 101-point PR accumulation for ONE (class, area, max_det) cell.
+
+    Exactly the reference inner math (``map.py:616``), float64 numpy, shared
+    by ``_calculate`` (eager states) and ``grouped_corpus_finish`` (ragged
+    device bundle) so the two paths cannot drift. Inputs are the
+    corpus-concatenated per-detection columns in image order; the global
+    mergesort by descending score happens here. Returns
+    ``(recall (T,), precision (T, R), scores (T, R))``.
+    """
+    nb_rec_thrs = len(rec_thresholds)
+    inds = np.argsort(-det_scores, kind="mergesort")
+    det_scores_sorted = det_scores[inds]
+    det_matches = det_matches[:, inds]
+    det_ignore = det_ignore[:, inds]
+    tps = det_matches & ~det_ignore
+    fps = ~det_matches & ~det_ignore
+    tp_sum = np.cumsum(tps, axis=1, dtype=np.float64)
+    fp_sum = np.cumsum(fps, axis=1, dtype=np.float64)
+    nb_iou_thrs = det_matches.shape[0]
+    recall_out = np.zeros(nb_iou_thrs)
+    prec_out = np.zeros((nb_iou_thrs, nb_rec_thrs))
+    score_out = np.zeros((nb_iou_thrs, nb_rec_thrs))
+    for idx_thr, (tp, fp) in enumerate(zip(tp_sum, fp_sum)):
+        nd = len(tp)
+        rc = tp / npig
+        pr = tp / (fp + tp + np.finfo(np.float64).eps)
+        recall_out[idx_thr] = rc[-1] if nd else 0
+        # remove zigzags (right-to-left running max) for AUC
+        pr = np.maximum.accumulate(pr[::-1])[::-1]
+        inds_rc = np.searchsorted(rc, rec_thresholds, side="left")
+        prec_at = np.zeros(nb_rec_thrs)
+        score_at = np.zeros(nb_rec_thrs)
+        valid = inds_rc < nd
+        prec_at[valid] = pr[inds_rc[valid]]
+        score_at[valid] = det_scores_sorted[inds_rc[valid]]
+        prec_out[idx_thr] = prec_at
+        score_out[idx_thr] = score_at
+    return recall_out, prec_out, score_out
 
 
 def _greedy_match_single(
@@ -426,6 +473,229 @@ class MAP(Metric):
             )
         return state
 
+    # -------------------------------------- corpus device aggregate (ISSUE 18)
+    #
+    # COCO's aggregate is CORPUS-level (global score ranking, class axes), so
+    # the ragged engine's per-group fold does not apply. Instead the metric
+    # plans the device pass off host-cheap vectors (counts + the label
+    # buffer), ONE compiled program computes greedy matches for every
+    # (image, class, area, threshold) cell straight from the stacked
+    # ``(G, capacity)`` buffers, and one transfer ships the match bundle; the
+    # host keeps only the O(total detections) PR interpolation — the same
+    # split ``matching="device"`` already uses for eager states, minus the
+    # per-image host packing loop.
+
+    def grouped_aggregate_spec(self) -> Optional[GroupedAggregateSpec]:
+        if self.matching != "device":
+            return None  # host matcher = the parity oracle; replay eagerly
+        return GroupedAggregateSpec(kind="corpus")
+
+    def grouped_corpus_scan_fields(self) -> Tuple[str, ...]:
+        """Buffers the host plan needs: the class universe comes from the
+        label column (dets and gts both contribute, as ``_get_classes``)."""
+        return ("label",)
+
+    def grouped_corpus_plan(
+        self, counts: np.ndarray, scan: Dict[str, np.ndarray]
+    ) -> Optional[Dict[str, Any]]:
+        """Host-side plan for the device pass: the distinct-label class list
+        (padded to a bucket of 4 so nearby corpora share one compiled
+        program) and a device-memory budget check. ``None`` declines —
+        empty corpus, or match-bundle footprint past ~2^26 elements — and
+        the engine reroutes to the host oracle."""
+        counts = np.asarray(counts)
+        label = np.asarray(scan["label"])
+        num_groups, cap = label.shape
+        valid = np.arange(cap)[None, :] < np.minimum(counts, cap)[:, None]
+        labels = label[valid]
+        if labels.size == 0:
+            return None
+        classes = np.unique(labels).astype(np.int32)  # unique() sorts
+        c_pad = _bucket(int(classes.size), 4)
+        nb_areas = len(self.bbox_area_ranges)
+        nb_thrs = len(self.iou_thresholds)
+        footprint = max(
+            num_groups * c_pad * nb_areas * nb_thrs * cap,  # match bundle
+            num_groups * cap * cap,  # per-image IoU block
+        )
+        if footprint > (1 << 26):
+            return None
+        classes_padded = np.zeros(c_pad, np.int32)
+        classes_padded[: classes.size] = classes
+        return {
+            "classes_padded": classes_padded,
+            "n_classes": int(classes.size),
+            "c_pad": c_pad,
+        }
+
+    def grouped_corpus_audit_classes(self) -> int:
+        """Class bucket the analysis audit traces the corpus program at."""
+        return 4
+
+    def grouped_corpus_device(
+        self,
+        counts: Array,
+        fields: Dict[str, Array],
+        classes: Array,
+        cls_valid: Array,
+        capacity: int,
+    ) -> Dict[str, Array]:
+        """Traced corpus match bundle from the stacked ragged buffers.
+
+        Per image: one stable descending-score sort of the det rows (gt and
+        pad rows sink) and one ``(capacity, capacity)`` IoU block against the
+        ORIGINAL-order rows; per class: validity masks + in-class ranks over
+        the shared sort — filter-after-stable-sort gives exactly
+        ``_img_class_arrays``'s sort-after-filter order — then
+        ``_greedy_match_single`` vmapped over (class, image, area), the same
+        matcher ``_match_all_pairs`` runs. Everything the host finish needs
+        crosses in one transfer:
+
+        * ``scores`` ``(G*cap,)`` — sorted det scores, image-major;
+        * ``det_valid`` ``(C, G*cap)`` / ``rank`` ``(C, G*cap)`` — class
+          membership and 1-based in-class rank (the ``max_det`` slice is a
+          host-side ``rank <= m`` mask);
+        * ``dm`` / ``dign`` ``(C, A, T, G*cap)`` — match / ignore flags;
+        * ``npig`` ``(C, A)`` — non-area-ignored gt totals;
+        * ``n_gt`` / ``n_det`` ``(C,)`` — the eval-exists guard.
+        """
+        cap = int(capacity)
+        counts = jnp.asarray(counts, jnp.int32)
+        box = jnp.asarray(fields["box"], jnp.float32)  # (G, cap, 4)
+        score = jnp.asarray(fields["score"], jnp.float32)  # (G, cap)
+        label = jnp.asarray(fields["label"], jnp.int32)  # (G, cap)
+        is_gt = jnp.asarray(fields["is_gt"], jnp.int32) == 1  # (G, cap)
+        valid = jnp.arange(cap)[None, :] < jnp.minimum(counts, cap)[:, None]
+        det_row = valid & ~is_gt
+        gt_row = valid & is_gt
+
+        order = jnp.argsort(jnp.where(det_row, -score, jnp.inf), axis=1, stable=True)
+        s_score = jnp.take_along_axis(score, order, axis=1)
+        s_label = jnp.take_along_axis(label, order, axis=1)
+        s_det = jnp.take_along_axis(det_row, order, axis=1)
+        s_box = jnp.take_along_axis(box, order[..., None], axis=1)
+
+        ious = jax.vmap(box_iou)(s_box, box)  # (G, cap det, cap gt)
+
+        thresholds = jnp.asarray(self.iou_thresholds, jnp.float32)  # (T,)
+        area_ranges = jnp.asarray(
+            [list(r) for r in self.bbox_area_ranges.values()], jnp.float32
+        )  # (A, 2)
+        lo, hi = area_ranges[:, 0], area_ranges[:, 1]
+        gt_areas = jax.vmap(box_area)(box)  # (G, cap) original order
+        det_areas = jax.vmap(box_area)(s_box)  # (G, cap) sorted order
+        gt_area_out = (gt_areas[:, None, :] < lo[None, :, None]) | (
+            gt_areas[:, None, :] > hi[None, :, None]
+        )  # (G, A, cap)
+        det_area_out = (det_areas[:, None, :] < lo[None, :, None]) | (
+            det_areas[:, None, :] > hi[None, :, None]
+        )  # (G, A, cap)
+        max_det = int(self.max_detection_thresholds[-1])
+
+        def per_class(cls: Array, cvalid: Array):
+            det_c = s_det & (s_label == cls) & cvalid  # (G, cap)
+            rank = jnp.cumsum(det_c.astype(jnp.int32), axis=1)  # 1-based where det_c
+            active = det_c & (rank <= max_det)
+            gt_c = gt_row & (label == cls) & cvalid  # (G, cap) original order
+
+            def per_image(iou, dvalid, gvalid, g_area_out):
+                def per_area(g_ign):
+                    return _greedy_match_single(iou, dvalid, gvalid, g_ign, thresholds)
+
+                return jax.vmap(per_area)(g_area_out)  # (A, T, cap) x2
+
+            dm, mi = jax.vmap(per_image)(ious, active, gt_c, gt_area_out)  # (G, A, T, cap)
+            num_t = thresholds.shape[0]
+            gt_ign_b = jnp.broadcast_to(
+                gt_area_out[:, :, None, :],
+                gt_area_out.shape[:2] + (num_t, gt_area_out.shape[2]),
+            )
+            matched_gt_ign = jnp.take_along_axis(gt_ign_b, jnp.clip(mi, 0, None), axis=3)
+            dign = jnp.where(dm, matched_gt_ign, det_area_out[:, :, None, :])
+            npig = jnp.sum(
+                (gt_c[:, None, :] & ~gt_area_out).astype(jnp.int32), axis=(0, 2)
+            )  # (A,)
+            return (
+                active,
+                rank,
+                jnp.transpose(dm, (1, 2, 0, 3)),  # (A, T, G, cap): image-major tail
+                jnp.transpose(dign, (1, 2, 0, 3)),
+                npig,
+                jnp.sum(gt_c.astype(jnp.int32)),
+                jnp.sum(det_c.astype(jnp.int32)),
+            )
+
+        active, rank, dm, dign, npig, n_gt, n_det = jax.vmap(per_class)(
+            jnp.asarray(classes, jnp.int32), jnp.asarray(cls_valid, bool)
+        )
+        c_pad = active.shape[0]
+        return {
+            "scores": s_score.reshape(-1),
+            "det_valid": active.reshape(c_pad, -1).astype(jnp.uint8),
+            "rank": rank.reshape(c_pad, -1),
+            "dm": dm.reshape(dm.shape[:3] + (-1,)).astype(jnp.uint8),
+            "dign": dign.reshape(dign.shape[:3] + (-1,)).astype(jnp.uint8),
+            "npig": npig,
+            "n_gt": n_gt,
+            "n_det": n_det,
+        }
+
+    def grouped_corpus_finish(
+        self, bundle: Dict[str, np.ndarray], plan: Dict[str, Any]
+    ) -> dict:
+        """Host finish of the device bundle: per (class, area, max_det) the
+        ``rank <= m`` mask selects the eval's detections and the SAME
+        ``_pr_accumulate`` / ``_results_from_tensors`` / ``_finish_compute``
+        helpers the eager path runs produce the final ``COCOMetricResults``
+        — the accumulation code cannot drift between paths."""
+        nb_classes = int(plan["n_classes"])
+        scores = np.asarray(bundle["scores"])
+        det_valid = np.asarray(bundle["det_valid"]).astype(bool)
+        rank = np.asarray(bundle["rank"])
+        dm = np.asarray(bundle["dm"]).astype(bool)
+        dign = np.asarray(bundle["dign"]).astype(bool)
+        npig = np.asarray(bundle["npig"])
+        n_gt = np.asarray(bundle["n_gt"])
+        n_det = np.asarray(bundle["n_det"])
+        nb_iou_thrs = len(self.iou_thresholds)
+        nb_rec_thrs = len(self.rec_thresholds)
+        nb_bbox_areas = len(self.bbox_area_ranges)
+        nb_max_det_thrs = len(self.max_detection_thresholds)
+        precision = -np.ones((nb_iou_thrs, nb_rec_thrs, nb_classes, nb_bbox_areas, nb_max_det_thrs))
+        recall = -np.ones((nb_iou_thrs, nb_classes, nb_bbox_areas, nb_max_det_thrs))
+        score_tensor = -np.ones((nb_iou_thrs, nb_rec_thrs, nb_classes, nb_bbox_areas, nb_max_det_thrs))
+        rec_thresholds = np.asarray(self.rec_thresholds)
+
+        for idx_cls in range(nb_classes):
+            if n_gt[idx_cls] == 0 and n_det[idx_cls] == 0:
+                continue  # no (image, class) eval exists — cells stay -1
+            for idx_area in range(nb_bbox_areas):
+                if int(npig[idx_cls, idx_area]) == 0:
+                    continue
+                for idx_mdet, max_det in enumerate(self.max_detection_thresholds):
+                    sel = det_valid[idx_cls] & (rank[idx_cls] <= max_det)
+                    rec_t, prec_t, score_t = _pr_accumulate(
+                        scores[sel],
+                        dm[idx_cls, idx_area][:, sel],
+                        dign[idx_cls, idx_area][:, sel],
+                        int(npig[idx_cls, idx_area]),
+                        rec_thresholds,
+                    )
+                    recall[:, idx_cls, idx_area, idx_mdet] = rec_t
+                    precision[:, :, idx_cls, idx_area, idx_mdet] = prec_t
+                    score_tensor[:, :, idx_cls, idx_area, idx_mdet] = score_t
+
+        overall, map_metrics, mar_metrics = self._results_from_tensors(
+            precision, recall, score_tensor, nb_classes
+        )
+        # the eager path returns through _wrap_compute's scalar squeeze —
+        # apply the same normalization so both reads have identical leaves
+        from metrics_tpu.metric import _squeeze_if_scalar
+
+        return _squeeze_if_scalar(
+            self._finish_compute(overall, map_metrics, mar_metrics, nb_classes)
+        )
+
     # ------------------------------------------------------------------ internals
 
     def _get_classes(self) -> List[int]:
@@ -663,36 +933,35 @@ class MAP(Metric):
                     if not evals:
                         continue
                     det_scores = np.concatenate([e["dtScores"][:max_det] for e in evals])
-                    inds = np.argsort(-det_scores, kind="mergesort")
-                    det_scores_sorted = det_scores[inds]
-                    det_matches = np.concatenate([e["dtMatches"][:, :max_det] for e in evals], axis=1)[:, inds]
-                    det_ignore = np.concatenate([e["dtIgnore"][:, :max_det] for e in evals], axis=1)[:, inds]
+                    det_matches = np.concatenate([e["dtMatches"][:, :max_det] for e in evals], axis=1)
+                    det_ignore = np.concatenate([e["dtIgnore"][:, :max_det] for e in evals], axis=1)
                     gt_ignore = np.concatenate([e["gtIgnore"] for e in evals])
                     npig = int(np.count_nonzero(~gt_ignore))
                     if npig == 0:
                         continue
-                    tps = det_matches & ~det_ignore
-                    fps = ~det_matches & ~det_ignore
-                    tp_sum = np.cumsum(tps, axis=1, dtype=np.float64)
-                    fp_sum = np.cumsum(fps, axis=1, dtype=np.float64)
-                    for idx_thr, (tp, fp) in enumerate(zip(tp_sum, fp_sum)):
-                        nd = len(tp)
-                        rc = tp / npig
-                        pr = tp / (fp + tp + np.finfo(np.float64).eps)
-                        recall[idx_thr, idx_cls, idx_area, idx_mdet] = rc[-1] if nd else 0
-                        # remove zigzags (right-to-left running max) for AUC
-                        pr = np.maximum.accumulate(pr[::-1])[::-1]
-                        inds_rc = np.searchsorted(rc, rec_thresholds, side="left")
-                        prec_at = np.zeros(nb_rec_thrs)
-                        score_at = np.zeros(nb_rec_thrs)
-                        valid = inds_rc < nd
-                        prec_at[valid] = pr[inds_rc[valid]]
-                        score_at[valid] = det_scores_sorted[inds_rc[valid]]
-                        precision[idx_thr, :, idx_cls, idx_area, idx_mdet] = prec_at
-                        scores[idx_thr, :, idx_cls, idx_area, idx_mdet] = score_at
+                    rec_t, prec_t, score_t = _pr_accumulate(
+                        det_scores, det_matches, det_ignore, npig, rec_thresholds
+                    )
+                    recall[:, idx_cls, idx_area, idx_mdet] = rec_t
+                    precision[:, :, idx_cls, idx_area, idx_mdet] = prec_t
+                    scores[:, :, idx_cls, idx_area, idx_mdet] = score_t
 
+        return self._results_from_tensors(precision, recall, scores, nb_classes)
+
+    def _results_from_tensors(
+        self,
+        precision: np.ndarray,
+        recall: np.ndarray,
+        scores: np.ndarray,
+        nb_classes: int,
+    ) -> Tuple[Dict, MAPMetricResults, MARMetricResults]:
+        """Summarize the accumulated PR tensors — shared tail of
+        ``_calculate`` and ``grouped_corpus_finish``."""
         results = {
-            "dimensions": [nb_iou_thrs, nb_rec_thrs, nb_classes, nb_bbox_areas, nb_max_det_thrs],
+            "dimensions": [
+                len(self.iou_thresholds), len(self.rec_thresholds), nb_classes,
+                len(self.bbox_area_ranges), len(self.max_detection_thresholds),
+            ],
             "precision": precision,
             "recall": recall,
             "scores": scores,
@@ -718,8 +987,19 @@ class MAP(Metric):
 
     def compute(self) -> dict:
         """Compute the COCO metric dict (map, map_50, ..., per-class options)."""
-        overall, map_metrics, mar_metrics = self._calculate(self._get_classes())
+        classes = self._get_classes()
+        overall, map_metrics, mar_metrics = self._calculate(classes)
+        return self._finish_compute(overall, map_metrics, mar_metrics, len(classes))
 
+    def _finish_compute(
+        self,
+        overall: Dict,
+        map_metrics: MAPMetricResults,
+        mar_metrics: MARMetricResults,
+        nb_classes: int,
+    ) -> dict:
+        """Assemble the final ``COCOMetricResults`` (incl. per-class slices) —
+        shared tail of ``compute`` and ``grouped_corpus_finish``."""
         map_per_class_values = jnp.asarray([-1.0])
         mar_max_dets_per_class_values = jnp.asarray([-1.0])
         if self.class_metrics:
@@ -731,7 +1011,7 @@ class MAP(Metric):
             map_per_class_list = []
             mar_per_class_list = []
             last_max_det = self.max_detection_thresholds[-1]
-            for idx_cls in range(len(self._get_classes())):
+            for idx_cls in range(nb_classes):
                 cls_results = {
                     "precision": overall["precision"][:, :, idx_cls:idx_cls + 1],
                     "recall": overall["recall"][:, idx_cls:idx_cls + 1],

@@ -1886,6 +1886,11 @@ class StreamingEngine:
             counters["ragged_rows"] = ragged["rows"]
             counters["ragged_groups_touched"] = ragged["groups_touched"]
             counters["ragged_overflows"] = ragged["overflows"]
+            # aggregate reads (ISSUE 18): which path served, and how many
+            # paged sweep blocks the group_shard aggregates dispatched
+            counters["ragged_agg_device_reads"] = ragged["agg_device_reads"]
+            counters["ragged_agg_oracle_reads"] = ragged["agg_oracle_reads"]
+            counters["ragged_agg_blocks"] = ragged["agg_blocks"]
             gauges["ragged_groups"] = ragged["groups"]
             gauges["ragged_capacity"] = ragged["capacity"]
         hists = self._trace.histograms() if self._trace is not None else ()

@@ -27,12 +27,20 @@ millions of keys) applies once the state is given a static shape:
 * **Sort-at-compute stays at compute**: the per-group read
   (``result(gid)``/``results()``) runs the metric's
   ``grouped_group_value`` — a traced compute over one group's
-  ``(capacity, ...)`` buffers — while the aggregate ``result()``
-  reconstructs every group's rows host-side, rebuilds the metric's EAGER
-  list states via ``grouped_finalize``, and runs the unmodified eager
-  ``compute`` — bit-exact vs the eager oracle by construction (the one
-  caveat: rows that compare EQUAL under the compute's sort key may permute
-  across groups'/shards' interleavings; every strict ordering is exact).
+  ``(capacity, ...)`` buffers — and the aggregate ``result()`` runs as ONE
+  device program plus one scalar transfer (ISSUE 18): the per-group read
+  batches over the stacked ``(G, capacity, ...)`` buffers and the
+  per-group scores fold with the masked row kernels
+  (``metrics declaring grouped_aggregate_spec()``; detection's corpus PR
+  curve device-matches per image and interpolates host-side). The host
+  eager replay (``grouped_finalize`` → unmodified eager ``compute``) is
+  kept as the parity ORACLE behind ``aggregate(oracle=True)`` /
+  ``aggregate_oracle=True``. Both paths are bit-exact vs the eager
+  oracle: every row carries its ingest rank in an engine-owned ``_seq``
+  field, and every read re-orders a group's rows by it, so rows that
+  compare EQUAL under the compute's sort key still tie-break exactly as
+  the eager metric's submission order — whatever merge, pane or shard
+  interleaving produced the buffers.
 
 A metric opts in by returning a :class:`~metrics_tpu.metric.GroupedUpdateSpec`
 from ``grouped_update_spec()`` (``masked_update_strategy() == "grouped"``);
@@ -40,6 +48,7 @@ non-ragged engines then refuse it at construction with a typed message that
 points here (``Metric.grouped_refusal_reason``). See docs/serving.md
 § "Ragged serving".
 """
+import threading
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -50,8 +59,23 @@ from metrics_tpu.engine.aot import AotCache
 from metrics_tpu.engine.multistream import MultiStreamEngine
 from metrics_tpu.engine.pipeline import EngineConfig
 from metrics_tpu.metric import GroupedUpdateSpec, Metric
-from metrics_tpu.ops.kernels import MEGASTEP_BACKENDS
+from metrics_tpu.ops.kernels import (
+    MEGASTEP_BACKENDS,
+    fold_rows_masked,
+    resolve_backend,
+    segment_reduce_masked,
+)
 from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+# paged aggregate sweep: fixed block row count — ONE block program serves any
+# touched-row population (the last block pads with ok=False rows), so repeat
+# aggregates never recompile as groups spill in and out
+_AGG_BLOCK_ROWS = 1024
+
+# sentinel a corpus plan returns through _aggregate_corpus when the device
+# pass declines (class universe past the device budget, empty corpus) — the
+# aggregate reroutes to the host oracle
+_CORPUS_FALLBACK = object()
 
 __all__ = ["GroupedStateMetric", "RaggedEngine"]
 
@@ -91,9 +115,19 @@ class GroupedStateMetric(Metric):
                 f"ragged capacity must be a positive int, got {capacity!r}"
             )
         self._capacity = cap
-        self._field_names: Tuple[str, ...] = spec.field_names()
-        self._field_shapes = tuple(tuple(int(d) for d in f.shape) for f in spec.fields)
-        self._field_dtypes = tuple(str(jnp.dtype(f.dtype)) for f in spec.fields)
+        # the engine-owned "_seq" field rides last: each row's global ingest
+        # rank (the submit-side monotone counter), the stable secondary sort
+        # key every read re-orders a group's rows by — so rows that compare
+        # EQUAL under the compute's own sort key tie-break by submission
+        # order no matter how merges/panes/shards interleaved the buffers
+        self._user_field_names: Tuple[str, ...] = spec.field_names()
+        self._field_names: Tuple[str, ...] = self._user_field_names + ("_seq",)
+        self._field_shapes = tuple(
+            tuple(int(d) for d in f.shape) for f in spec.fields
+        ) + ((),)
+        self._field_dtypes = tuple(
+            str(jnp.dtype(f.dtype)) for f in spec.fields
+        ) + ("int32",)
         # count declares fx=None deliberately: the boundary merge needs the
         # PER-REPLICA counts (they are the buffers' validity) so every leaf
         # rides the stacked u32 carrier — sync_states gathers, then
@@ -128,9 +162,37 @@ class GroupedStateMetric(Metric):
 
     def compute(self) -> Any:
         """ONE group's value from its capacity buffers — the per-group read
-        the engine's compiled ``result(gid)``/``results()`` programs run."""
-        fields = {name: getattr(self, "buf_" + name) for name in self._field_names}
+        the engine's compiled ``result(gid)``/``results()`` programs run.
+        Rows present in INGEST order (the ``_seq`` sort), so equal-sort-key
+        rows tie-break exactly as the eager metric's submission order."""
+        tree = {"count": jnp.asarray(self.count)[None]}
+        for name in self._field_names:
+            tree["buf_" + name] = jnp.asarray(getattr(self, "buf_" + name))[None]
+        fields = {k: v[0] for k, v in self.seq_ordered_fields(tree).items()}
         return self._inner().grouped_group_value(fields, self.count, self._capacity)
+
+    def seq_ordered_fields(self, tree: Dict[str, Any]) -> Dict[str, Any]:
+        """User-named field buffers with every group's valid rows gathered
+        into ingest (``_seq``) order — the row view ALL reads share (traced;
+        ``tree`` leaves carry a leading group axis: ``count`` ``(G,)``,
+        buffers ``(G, capacity, ...)``).
+
+        Valid rows hold globally unique seq values so the gather is a
+        permutation of the valid prefix; invalid slots key to int32 max and
+        sink to the tail (their values are unread — every consumer masks by
+        ``count``)."""
+        cap = self._capacity
+        counts = jnp.asarray(tree["count"], jnp.int32)
+        seq = jnp.asarray(tree["buf__seq"], jnp.int32)
+        valid = jnp.arange(cap)[None, :] < jnp.minimum(counts, cap)[:, None]
+        key = jnp.where(valid, seq, jnp.iinfo(jnp.int32).max)
+        order = jnp.argsort(key, axis=1)
+        out: Dict[str, Any] = {}
+        for name in self._user_field_names:
+            v = jnp.asarray(tree["buf_" + name])
+            idx = jnp.reshape(order, order.shape + (1,) * (v.ndim - 2))
+            out[name] = jnp.take_along_axis(v, idx, axis=1)
+        return out
 
     # ------------------------------------------------------------ engine contract
 
@@ -267,6 +329,10 @@ class RaggedEngine(MultiStreamEngine):
             (the stream-shard machinery at group grain).
         resident_groups: per-shard paged-arena slot count under
             ``group_shard`` (see ``resident_streams``).
+        aggregate_oracle: pin the aggregate ``result()`` to the host
+            eager-replay oracle path (``grouped_finalize`` + eager
+            ``compute``) instead of the compiled device aggregate — the
+            parity flag; per-call override via ``aggregate(oracle=...)``.
 
     ``submit(group_ids, *fields)`` takes one scalar group id for a
     single-group batch or a per-row int32 array for a mixed-group batch;
@@ -285,6 +351,7 @@ class RaggedEngine(MultiStreamEngine):
         capacity: Optional[int] = None,
         group_shard: bool = False,
         resident_groups: Optional[int] = None,
+        aggregate_oracle: bool = False,
     ):
         spec = getattr(metric, "grouped_update_spec", lambda: None)()
         if spec is None:
@@ -297,8 +364,10 @@ class RaggedEngine(MultiStreamEngine):
         if config is not None and config.kernel_backend in MEGASTEP_BACKENDS:
             raise MetricsTPUUserError(
                 "ragged serving has no megastep form: the grouped capacity "
-                "write is a 2-d scatter outside the per-column opcode grid — "
-                "use kernel_backend='xla' or 'pallas_interpret'"
+                "write (the INGEST scatter) is a 2-d scatter outside the "
+                "per-column opcode grid; the AGGREGATE path is kernel-"
+                "eligible and honors the configured backend — use "
+                "kernel_backend='xla' or 'pallas_interpret'"
             )
         self._user_metric = metric
         wrapped = GroupedStateMetric(metric, capacity=capacity)
@@ -314,12 +383,22 @@ class RaggedEngine(MultiStreamEngine):
         )
         self._stats.ragged_groups = int(num_groups)
         self._stats.ragged_capacity = int(self._capacity)
-        # the grouped capacity write is a 2-d scatter with no per-column
-        # kernel form — kernel-ineligible by design (the megastep tiers
-        # refuse above). Pin the RESOLVED backend to the XLA reference
-        # lowering so program keys, the kernel scope, and the scatter audit
-        # (no-scatter-under-pallas's ineligibility clause) all agree.
+        # the grouped capacity write (the INGEST scatter) is a 2-d scatter
+        # with no per-column kernel form — kernel-ineligible by design (the
+        # megastep tiers refuse above). Pin the RESOLVED backend of the
+        # ingest/step programs to the XLA reference lowering so program
+        # keys, the kernel scope, and the scatter audit (no-scatter-under-
+        # pallas's ineligibility clause) all agree. The AGGREGATE path is
+        # kernel-eligible (its folds are the masked row kernels), so it
+        # keeps the user's configured backend separately.
+        self._agg_backend = config.kernel_backend if config is not None else "auto"
         self._kernel_backend = "xla"
+        self._aggregate_oracle = bool(aggregate_oracle)
+        # global ingest-rank counter backing the engine-owned "_seq" field;
+        # snapshotted/restored so kill/resume keeps replayed rows ordered
+        # AFTER every row the snapshot already carries
+        self._ingest_seq = 0
+        self._seq_lock = threading.Lock()
 
     # ------------------------------------------------------------------ properties
 
@@ -341,7 +420,7 @@ class RaggedEngine(MultiStreamEngine):
         if len(fields) != self._n_fields:
             raise MetricsTPUUserError(
                 f"ragged submit expects {self._n_fields} field arrays "
-                f"({', '.join(self._metric._field_names)}), got {len(fields)}"
+                f"({', '.join(self._metric._user_field_names)}), got {len(fields)}"
             )
         n = int(np.shape(fields[0])[0]) if np.ndim(fields[0]) else 0
         for f in fields[1:]:
@@ -373,6 +452,13 @@ class RaggedEngine(MultiStreamEngine):
             return
         self._raise_if_failed()
         self.start()
+        # stamp each row's global ingest rank — the "_seq" field (stable
+        # secondary sort key of every read). Allocated under its own small
+        # lock so concurrent producers get disjoint, submission-ordered runs.
+        with self._seq_lock:
+            seq0 = self._ingest_seq
+            self._ingest_seq = seq0 + n
+        fields = tuple(fields) + (np.arange(seq0, seq0 + n, dtype=np.int32),)
         n_groups = 1 if np.ndim(gids) == 0 else int(np.unique(gids).size)
         self._stats.record_ragged_submit(rows=n, groups=n_groups)
         item = (gids, fields, kwargs)
@@ -412,17 +498,90 @@ class RaggedEngine(MultiStreamEngine):
     def result(self, group_id: Optional[int] = None) -> Any:  # type: ignore[override]
         """``result(gid)`` is the per-group value (the wrapped metric's
         ``grouped_group_value`` through the shared compiled program);
-        ``result()`` is the AGGREGATE: every group's rows reconstruct
-        host-side, ``grouped_finalize`` rebuilds the metric's eager list
-        states in group-id order, and the unmodified eager ``compute`` runs —
-        bit-exact vs the eager oracle."""
+        ``result()`` is the AGGREGATE: one compiled device program batches
+        the per-group read over the stacked buffers and folds the scores
+        with the masked row kernels — one scalar bundle crosses to host
+        (under ``group_shard``, resident + spilled groups sweep through the
+        same program in capacity-sized blocks). The host eager replay stays
+        available as the parity oracle (``aggregate(oracle=True)``); both
+        paths are bit-exact vs the eager oracle."""
         if group_id is None:
             return self.aggregate()
         return super().result(group_id)
 
-    def aggregate(self) -> Any:
+    # ----------------------------------------------------------- aggregate read
+
+    def aggregate_path(self) -> Tuple[str, str]:
+        """Which path ``aggregate()`` takes and why: ``("device", reason)``
+        or ``("oracle", reason)`` — introspection for tests/smokes, no work
+        performed."""
+        if self._aggregate_oracle:
+            return ("oracle", "aggregate_oracle=True pinned at construction")
+        spec = getattr(self._user_metric, "grouped_aggregate_spec", lambda: None)()
+        if spec is None:
+            return (
+                "oracle",
+                f"{type(self._user_metric).__name__} declares no "
+                "grouped_aggregate_spec()",
+            )
+        if spec.kind == "fold":
+            if self._stream_shard and self._pane_rows > 1 and self._window.kind == "sliding":
+                return (
+                    "oracle",
+                    "group_shard + sliding panes: the pane ring folds through "
+                    "the host row universe",
+                )
+            if self._stream_shard:
+                return ("device", "batched fold over a capacity-blocked paged sweep")
+            return ("device", "batched fold over the stacked buffers")
+        if spec.kind == "corpus":
+            if self._stream_shard:
+                return (
+                    "oracle",
+                    "corpus aggregates need every group in one device pass; "
+                    "group_shard pages groups out",
+                )
+            return ("device", "corpus device bundle + host curve interpolation")
+        return ("oracle", f"unknown aggregate kind {spec.kind!r}")
+
+    def aggregate(self, oracle: Optional[bool] = None) -> Any:
+        """The corpus-level value. Device path by default (see
+        :meth:`aggregate_path`); ``oracle=True`` forces the host eager
+        replay for this one call (``None`` defers to the construction
+        flag)."""
         self.flush()
+        use_oracle = self._aggregate_oracle if oracle is None else bool(oracle)
+        if not use_oracle:
+            path, _ = self.aggregate_path()
+            use_oracle = path != "device"
+        if use_oracle:
+            self._stats.record_ragged_aggregate("oracle")
+            return self._aggregate_oracle_value()
+        spec = self._user_metric.grouped_aggregate_spec()
+        if spec.kind == "fold":
+            if self._stream_shard:
+                return self._aggregate_fold_paged()
+            return self._aggregate_fold()
+        value = self._aggregate_corpus()
+        if value is _CORPUS_FALLBACK:
+            self._stats.record_ragged_aggregate("oracle")
+            return self._aggregate_oracle_value()
+        return value
+
+    def _aggregate_oracle_value(self) -> Any:
+        """The host eager replay (the parity oracle): reconstruct every
+        group's rows host-side in ingest order, rebuild the metric's eager
+        list states via ``grouped_finalize``, run the unmodified eager
+        ``compute``."""
         counts, fields = self._gather_groups()
+        self._check_overflow(counts)
+        gids = np.arange(self._num_streams, dtype=np.int64)
+        state = self._user_metric.grouped_finalize(counts, fields, gids)
+        return self._user_metric.compute_from(state)
+
+    def _check_overflow(self, counts: np.ndarray) -> None:
+        """The typed overflow raise both aggregate paths share — fires
+        host-side off the ``(G,)`` count vector."""
         over = np.flatnonzero(counts > self._capacity)
         if over.size:
             self._stats.record_ragged_overflow(int(over.size))
@@ -435,21 +594,480 @@ class RaggedEngine(MultiStreamEngine):
                 f"{', ...' if over.size > 8 else ''}; rebuild the engine with a "
                 "larger capacity= (rows past capacity were dropped, counts kept)"
             )
-        gids = np.arange(self._num_streams, dtype=np.int64)
-        state = self._user_metric.grouped_finalize(counts, fields, gids)
-        return self._user_metric.compute_from(state)
+
+    # ------------------------------------------------------- fold device path
+
+    def _aggregate_traced_from_tree(self, tree: Dict[str, Any]) -> Any:
+        """The fold aggregate's traced tail from a logical ``(G, ...)`` tree:
+        batched per-group scores, then masked kernel folds to the ``(4,)``
+        scalar bundle ``[value, kept, flagged, overflow]`` — the ONE
+        transfer the device aggregate makes."""
+        cap = self._capacity
+        kb = self._agg_backend
+        counts = jnp.asarray(tree["count"], jnp.int32)
+        fields = self._metric.seq_ordered_fields(tree)
+        out = self._user_metric.grouped_batch_scores(counts, fields, cap)
+        value = jnp.asarray(out["value"], jnp.float32)
+        keep = jnp.asarray(out["keep"], bool)
+        flag = jnp.asarray(out["flag"], bool)
+        zero = jnp.zeros((), jnp.float32)
+        ones = jnp.ones_like(value)
+        total = fold_rows_masked(zero, value, keep, "sum", backend=kb)
+        kept = fold_rows_masked(zero, ones, keep, "sum", backend=kb)
+        flagged = fold_rows_masked(zero, ones, flag, "sum", backend=kb)
+        overflow = fold_rows_masked(zero, ones, counts > cap, "sum", backend=kb)
+        result = jnp.where(kept > 0, total / jnp.maximum(kept, 1.0), 0.0)
+        return jnp.stack([result, kept, flagged, overflow])
+
+    def _aggregate_traced(self, state: Any, *extra: Any) -> Any:
+        tree = self._window_fold_traced(self._compute_tree(state), *extra)
+        return self._aggregate_traced_from_tree(tree)
+
+    def _aggregate_program(self):
+        key = self._aot.program_key(
+            f"aggregate_ragged+k.{resolve_backend(self._agg_backend)}"
+            f"+w.{self._window_tag()}",
+            self._metric_fp,
+            arg_tree=(self._compute_input_abstract(),) + self._compute_extra_abs(),
+            mesh=self._cfg.mesh, donate=False, sync=self._sync_tag(),
+            precision=self._precision_tag,
+        )
+
+        def build():
+            with self._kernel_scope():
+                return (
+                    jax.jit(self._aggregate_traced)
+                    .lower(self._compute_input_abstract(), *self._compute_extra_abs())
+                    .compile()
+                )
+
+        return self._aot.get_or_compile(key, build)
+
+    def _aggregate_finish_fold(self, bundle: Any) -> Any:
+        """Host finish of a fold bundle: fetch the 4 scalars in ONE
+        transfer, fire the overflow raise off the count vector if any group
+        overflowed, hand the folded mean to the metric's finish hook."""
+        fetched = np.asarray(jax.device_get(bundle), np.float32)
+        value, kept, flagged, overflow = (float(x) for x in fetched)
+        if overflow:
+            with self._state_lock:
+                counts = np.asarray(
+                    jax.device_get(self._logical_tree_locked()["count"])
+                )
+            self._check_overflow(counts)
+        return self._user_metric.grouped_aggregate_finish(
+            value, int(kept), int(flagged)
+        )
+
+    def _aggregate_fold(self) -> Any:
+        """Unsharded fold aggregate: ONE compiled program over the logical
+        state (deferred boundary merge / window fold inside the same trace)
+        + one scalar-bundle transfer."""
+        with self._state_lock:
+            state = self._merged_state() if self._deferred else self._state
+            bundle = self._aggregate_program()(state, *self._compute_extra())
+            self._stats.result_device_calls += 1
+        value = self._aggregate_finish_fold(bundle)
+        self._stats.record_ragged_aggregate("device")
+        return value
+
+    # ------------------------------------------------------ paged fold sweep
+
+    def _aggregate_block_program(self):
+        """The paged sweep's block program: ``_AGG_BLOCK_ROWS`` packed group
+        rows (+ their gids and an ok mask) score through the SAME batched
+        fold body, then segment-scatter into the ``(G, 3)`` accumulator
+        (``[value, kept, flagged]`` columns; each touched gid owns exactly
+        one swept row, so the scatter-sum is an assignment and the final
+        ``(G,)`` vectors are bit-identical to the unsharded batch)."""
+        B = _AGG_BLOCK_ROWS
+        G = self._num_streams
+        rows_abs = {
+            k: jax.ShapeDtypeStruct((B, n), jnp.dtype(k))
+            for k, n in self._layout.buffer_sizes().items()
+        }
+        acc_abs = jax.ShapeDtypeStruct((G, 3), jnp.float32)
+        gid_abs = jax.ShapeDtypeStruct((B,), jnp.int32)
+        ok_abs = jax.ShapeDtypeStruct((B,), bool)
+        key = self._aot.program_key(
+            f"aggregate_ragged_block+k.{resolve_backend(self._agg_backend)}",
+            self._metric_fp,
+            arg_tree=(acc_abs, rows_abs, gid_abs, ok_abs), mesh=None,
+            donate=False, sync=self._sync_tag(), precision=self._precision_tag,
+        )
+        metric, user, layout = self._metric, self._user_metric, self._layout
+        cap, kb = self._capacity, self._agg_backend
+
+        def build():
+            def block(acc, rows, gids, ok):
+                tree = layout.unpack_stacked(rows)
+                counts = jnp.asarray(tree["count"], jnp.int32)
+                fields = metric.seq_ordered_fields(tree)
+                out = user.grouped_batch_scores(counts, fields, cap)
+                value = jnp.asarray(out["value"], jnp.float32)
+                keep = jnp.asarray(out["keep"], bool) & ok
+                flag = jnp.asarray(out["flag"], bool) & ok
+                over = (counts > cap) & ok
+                cols = jnp.stack(
+                    [
+                        jnp.where(keep, value, 0.0),
+                        keep.astype(jnp.float32),
+                        flag.astype(jnp.float32),
+                    ],
+                    axis=1,
+                )
+                mask = keep | flag | over
+                new_acc = segment_reduce_masked(
+                    acc, cols, mask, gids, G, "sum", backend=kb
+                )
+                n_over = fold_rows_masked(
+                    jnp.zeros((), jnp.float32), jnp.ones_like(value), over,
+                    "sum", backend=kb,
+                )
+                return new_acc, n_over
+
+            with self._kernel_scope():
+                return (
+                    jax.jit(block)
+                    .lower(acc_abs, rows_abs, gid_abs, ok_abs)
+                    .compile()
+                )
+
+        return self._aot.get_or_compile(key, build)
+
+    def _aggregate_fold_final_program(self):
+        """The sweep's closing fold: the ``(G, 3)`` accumulator to the same
+        ``(4,)`` scalar bundle the unsharded path emits. The accumulated
+        value column already reads ``where(keep, value, 0)`` per group —
+        the identical dense vector the unsharded fold sums — so the result
+        is bit-exact across both layouts."""
+        G = self._num_streams
+        acc_abs = jax.ShapeDtypeStruct((G, 3), jnp.float32)
+        over_abs = jax.ShapeDtypeStruct((), jnp.float32)
+        key = self._aot.program_key(
+            f"aggregate_ragged_final+k.{resolve_backend(self._agg_backend)}",
+            self._metric_fp,
+            arg_tree=(acc_abs, over_abs), mesh=None, donate=False,
+            sync=self._sync_tag(), precision=self._precision_tag,
+        )
+        kb = self._agg_backend
+
+        def build():
+            def final(acc, n_over):
+                zero = jnp.zeros((), jnp.float32)
+                keep = acc[:, 1] > 0
+                ones = jnp.ones((acc.shape[0],), jnp.float32)
+                total = fold_rows_masked(zero, acc[:, 0], keep, "sum", backend=kb)
+                kept = fold_rows_masked(zero, ones, keep, "sum", backend=kb)
+                flagged = fold_rows_masked(
+                    zero, ones, acc[:, 2] > 0, "sum", backend=kb
+                )
+                result = jnp.where(kept > 0, total / jnp.maximum(kept, 1.0), 0.0)
+                return jnp.stack([result, kept, flagged, n_over])
+
+            with self._kernel_scope():
+                return jax.jit(final).lower(acc_abs, over_abs).compile()
+
+        return self._aot.get_or_compile(key, build)
+
+    def _swept_rows_locked(self) -> Tuple[np.ndarray, Dict[str, np.ndarray]]:
+        """The paged sweep's work list (state lock held): ``(gids (M,),
+        {dtype: (M, n)})`` packed rows of every TOUCHED group — resident
+        slots out of the device arena, spilled groups out of the pager's
+        host store — never the ``(G, n)`` dense universe. Untouched groups
+        carry count 0 and contribute nothing to the fold, exactly as in the
+        eager corpus. A group both resident and spilled keeps the spill copy
+        (the row-reassembly precedence)."""
+        arena = {k: np.asarray(jax.device_get(v)) for k, v in self._state.items()}
+        payload = self._decoded_pager_payload(self._pager.snapshot_payload())
+        world, num = self._world, self._num_streams
+        parts_g: List[np.ndarray] = []
+        parts_r: Dict[str, List[np.ndarray]] = {k: [] for k in arena}
+        slots = np.asarray(payload["slots"])
+        w_idx, j_idx = np.nonzero(slots >= 0)
+        if w_idx.size:
+            ext = slots[w_idx, j_idx].astype(np.int64) * world + w_idx
+            sid, pane = self._ext_to_sid_pane(ext)
+            keep = (sid < num) & self._pane_open(pane)
+            parts_g.append(sid[keep])
+            for k in arena:
+                parts_r[k].append(arena[k][w_idx[keep], j_idx[keep]])
+        coords = np.asarray(
+            payload.get("spill_coords", np.zeros((0, 2), np.int64))
+        ).reshape(-1, 2)
+        if coords.size:
+            ext = coords[:, 1].astype(np.int64) * world + coords[:, 0].astype(np.int64)
+            sid, pane = self._ext_to_sid_pane(ext)
+            keep = (sid < num) & self._pane_open(pane)
+            parts_g.append(sid[keep])
+            for k in arena:
+                parts_r[k].append(np.asarray(payload[f"spill_{k}"])[keep])
+        if not parts_g:
+            return np.zeros((0,), np.int64), {
+                k: np.zeros((0, v.shape[-1]), v.dtype) for k, v in arena.items()
+            }
+        gids = np.concatenate(parts_g)
+        rows = {k: np.concatenate(parts_r[k], axis=0) for k in arena}
+        # keep the LAST copy of a duplicated gid (spill wins over resident)
+        _, last = np.unique(gids[::-1], return_index=True)
+        sel = np.sort(gids.size - 1 - last)
+        return gids[sel], {k: v[sel] for k, v in rows.items()}
+
+    def _ext_to_sid_pane(self, ext: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """Invert ``_ext_id``: extended (stream, pane) row ids back to
+        ``(sid, pane)`` — identity panes on unwindowed engines."""
+        if self._pane_rows == 1:
+            return ext, np.zeros_like(ext)
+        w = ext % self._world
+        q = ext // self._world
+        pane = q % self._pane_rows
+        sid = (q // self._pane_rows) * self._world + w
+        return sid, pane
+
+    def _pane_open(self, pane: np.ndarray) -> np.ndarray:
+        """Rows belonging to the aggregate's pane view: everything on
+        unwindowed engines, the open pane on tumbling rings (sliding +
+        group_shard routes to the oracle in :meth:`aggregate_path`)."""
+        if self._pane_rows == 1:
+            return np.ones_like(pane, dtype=bool)
+        return pane == self._pane_cursor
+
+    def _aggregate_fold_paged(self) -> Any:
+        """``group_shard`` fold aggregate: page every touched group's packed
+        row through the block program in ``_AGG_BLOCK_ROWS``-sized sweeps
+        (O(touched / block) dispatches — never one per group), accumulate
+        per-group columns on device, close with one fold program + one
+        scalar-bundle transfer."""
+        B = _AGG_BLOCK_ROWS
+        with self._state_lock:
+            gids, rows = self._swept_rows_locked()
+            block = self._aggregate_block_program()
+            final = self._aggregate_fold_final_program()
+            acc = jnp.zeros((self._num_streams, 3), jnp.float32)
+            n_over = jnp.zeros((), jnp.float32)
+            M = int(gids.shape[0])
+            n_blocks = max(1, -(-M // B))
+            for b in range(n_blocks):
+                lo = b * B
+                blk_g = np.full((B,), 0, np.int32)
+                blk_ok = np.zeros((B,), bool)
+                m = max(0, min(B, M - lo))
+                if m:
+                    blk_g[:m] = gids[lo:lo + m].astype(np.int32)
+                    blk_ok[:m] = True
+                blk_rows = {}
+                for k, v in rows.items():
+                    pad = np.zeros((B, v.shape[-1]), v.dtype)
+                    if m:
+                        pad[:m] = v[lo:lo + m]
+                    blk_rows[k] = jnp.asarray(pad)
+                acc, over_b = block(acc, blk_rows, jnp.asarray(blk_g), jnp.asarray(blk_ok))
+                n_over = n_over + over_b
+                self._stats.result_device_calls += 1
+            bundle = final(acc, n_over)
+            self._stats.result_device_calls += 1
+        value = self._aggregate_finish_fold(bundle)
+        self._stats.record_ragged_aggregate("device", blocks=n_blocks)
+        return value
+
+    # ----------------------------------------------------- corpus device path
+
+    def _aggregate_corpus(self) -> Any:
+        """Detection-style corpus aggregate: the metric plans the device
+        pass off the count + scan-field vectors (host), one compiled program
+        produces the corpus match bundle (per-group greedy matches batched
+        on device), and the metric's host finish interpolates the final
+        curve. Returns ``_CORPUS_FALLBACK`` when the plan declines (class
+        universe too large for the device budget / empty corpus) — the
+        caller reroutes to the oracle."""
+        user = self._user_metric
+        with self._state_lock:
+            tree = self._logical_tree_locked()
+            counts = np.asarray(jax.device_get(tree["count"]))
+            scan_names = tuple(user.grouped_corpus_scan_fields())
+            scan = {
+                name: np.asarray(jax.device_get(tree["buf_" + name]))
+                for name in scan_names
+            }
+        self._check_overflow(counts)
+        plan = user.grouped_corpus_plan(counts, scan)
+        if plan is None:
+            return _CORPUS_FALLBACK
+        classes = np.asarray(plan["classes_padded"], np.int32)
+        cls_valid = np.arange(classes.shape[0]) < int(plan["n_classes"])
+        with self._state_lock:
+            state = self._merged_state() if self._deferred else self._state
+            bundle = self._corpus_program(int(classes.shape[0]))(
+                state,
+                jnp.asarray(classes),
+                jnp.asarray(cls_valid),
+                *self._compute_extra(),
+            )
+            self._stats.result_device_calls += 1
+        fetched = jax.tree.map(lambda x: np.asarray(x), jax.device_get(bundle))
+        self._stats.record_ragged_aggregate("device")
+        return user.grouped_corpus_finish(fetched, plan)
+
+    def _corpus_program(self, c_pad: int):
+        """ONE compiled corpus-bundle program per padded-class-count bucket
+        (the plan pads the class list so nearby corpora share programs; the
+        live class count rides a validity mask, not the trace)."""
+        cls_abs = jax.ShapeDtypeStruct((c_pad,), jnp.int32)
+        valid_abs = jax.ShapeDtypeStruct((c_pad,), bool)
+        key = self._aot.program_key(
+            f"aggregate_ragged_corpus+k.{resolve_backend(self._agg_backend)}"
+            f"+c{c_pad}+w.{self._window_tag()}",
+            self._metric_fp,
+            arg_tree=(self._compute_input_abstract(), cls_abs, valid_abs)
+            + self._compute_extra_abs(),
+            mesh=self._cfg.mesh, donate=False, sync=self._sync_tag(),
+            precision=self._precision_tag,
+        )
+        metric, user, cap = self._metric, self._user_metric, self._capacity
+
+        def build():
+            def corpus(state, classes, cls_valid, *extra):
+                tree = self._window_fold_traced(self._compute_tree(state), *extra)
+                counts = jnp.asarray(tree["count"], jnp.int32)
+                fields = metric.seq_ordered_fields(tree)
+                return user.grouped_corpus_device(
+                    counts, fields, classes, cls_valid, cap
+                )
+
+            with self._kernel_scope():
+                return (
+                    jax.jit(corpus)
+                    .lower(
+                        self._compute_input_abstract(), cls_abs, valid_abs,
+                        *self._compute_extra_abs(),
+                    )
+                    .compile()
+                )
+
+        return self._aot.get_or_compile(key, build)
+
+    # --------------------------------------------------------- analysis hooks
+
+    def _aggregate_audit_jaxprs(self) -> List[Tuple[str, Any]]:
+        """``(label, jaxpr)`` pairs of the device-aggregate programs,
+        re-traced FRESH on every call (so a monkeypatched metric hook is
+        seen) — what ``EngineAnalysis.check()`` audits. Empty when the
+        aggregate runs on the oracle path."""
+        path, _ = self.aggregate_path()
+        if path != "device":
+            return []
+        spec = self._user_metric.grouped_aggregate_spec()
+        out: List[Tuple[str, Any]] = []
+        if spec.kind == "fold":
+            if self._stream_shard:
+                B, G = _AGG_BLOCK_ROWS, self._num_streams
+                rows_abs = {
+                    k: jax.ShapeDtypeStruct((B, n), jnp.dtype(k))
+                    for k, n in self._layout.buffer_sizes().items()
+                }
+                metric, user, layout = self._metric, self._user_metric, self._layout
+                cap, kb = self._capacity, self._agg_backend
+
+                def block(acc, rows, gids, ok):
+                    tree = layout.unpack_stacked(rows)
+                    counts = jnp.asarray(tree["count"], jnp.int32)
+                    fields = metric.seq_ordered_fields(tree)
+                    res = user.grouped_batch_scores(counts, fields, cap)
+                    keep = jnp.asarray(res["keep"], bool) & ok
+                    cols = jnp.stack(
+                        [
+                            jnp.where(keep, jnp.asarray(res["value"], jnp.float32), 0.0),
+                            keep.astype(jnp.float32),
+                            jnp.asarray(res["flag"], bool).astype(jnp.float32),
+                        ],
+                        axis=1,
+                    )
+                    return segment_reduce_masked(
+                        acc, cols, keep, gids, G, "sum", backend=kb
+                    )
+
+                out.append(
+                    (
+                        "aggregate/block",
+                        jax.make_jaxpr(block)(
+                            jax.ShapeDtypeStruct((G, 3), jnp.float32),
+                            rows_abs,
+                            jax.ShapeDtypeStruct((B,), jnp.int32),
+                            jax.ShapeDtypeStruct((B,), bool),
+                        ),
+                    )
+                )
+            else:
+                out.append(
+                    (
+                        "aggregate/fold",
+                        jax.make_jaxpr(self._aggregate_traced)(
+                            self._compute_input_abstract(), *self._compute_extra_abs()
+                        ),
+                    )
+                )
+        else:  # corpus: audit the bundle program at a nominal class bucket
+            user, metric, cap = self._user_metric, self._metric, self._capacity
+            c_pad = int(getattr(user, "grouped_corpus_audit_classes", lambda: 4)())
+
+            def corpus(state, classes, cls_valid, *extra):
+                tree = self._window_fold_traced(self._compute_tree(state), *extra)
+                counts = jnp.asarray(tree["count"], jnp.int32)
+                fields = metric.seq_ordered_fields(tree)
+                return user.grouped_corpus_device(
+                    counts, fields, classes, cls_valid, cap
+                )
+
+            out.append(
+                (
+                    "aggregate/corpus",
+                    jax.make_jaxpr(corpus)(
+                        self._compute_input_abstract(),
+                        jax.ShapeDtypeStruct((c_pad,), jnp.int32),
+                        jax.ShapeDtypeStruct((c_pad,), bool),
+                        *self._compute_extra_abs(),
+                    ),
+                )
+            )
+        return out
+
+    def _aggregate_program_cap(self) -> int:
+        """Extra compiled-program allowance the device aggregate owns (the
+        analysis compile-cap accounting): the fold program (unsharded), the
+        block + final pair (paged sweep), or the per-class-bucket corpus
+        allowance."""
+        path, _ = self.aggregate_path()
+        if path != "device":
+            return 0
+        spec = self._user_metric.grouped_aggregate_spec()
+        if spec.kind == "fold":
+            return 2 if self._stream_shard else 1
+        return 4
 
     def _gather_groups(self) -> Tuple[np.ndarray, Dict[str, np.ndarray]]:
         """Host numpy ``(counts (G,), {field: (G, capacity, ...)})`` of the
         logical per-group state, window panes folded (tumbling reads the open
-        pane, sliding folds the ring through the wrapper's compaction merge)."""
+        pane, sliding folds the ring through the wrapper's compaction merge).
+        Each group's valid rows come back in INGEST order (the ``_seq``
+        sort); the engine-owned ``_seq`` field itself is not returned."""
         with self._state_lock:
             tree = self._logical_tree_locked()
             counts = np.asarray(jax.device_get(tree["count"]))
-            fields = {
+            raw = {
                 name: np.asarray(jax.device_get(tree["buf_" + name]))
                 for name in self._metric._field_names
             }
+        cap = self._capacity
+        seq = raw.pop("_seq")
+        filled = np.minimum(counts, cap)
+        key = np.where(
+            np.arange(cap)[None, :] < filled[:, None], seq, np.iinfo(np.int32).max
+        )
+        order = np.argsort(key, axis=1, kind="stable")
+        fields = {}
+        for name, v in raw.items():
+            idx = order.reshape(order.shape + (1,) * (v.ndim - 2))
+            fields[name] = np.take_along_axis(v, idx, axis=1)
         return counts, fields
 
     def _logical_tree_locked(self) -> Dict[str, Any]:
@@ -484,6 +1102,10 @@ class RaggedEngine(MultiStreamEngine):
             ragged=1,
             ragged_capacity=self._capacity,
             ragged_groups=self._num_streams,
+            # the ingest-rank counter: restored rows keep their original seq
+            # values (all < this), replayed/new rows allocate from here on —
+            # so kill/resume preserves relative ingest order exactly
+            ragged_seq=int(self._ingest_seq),
         )
         return extra
 
@@ -506,5 +1128,9 @@ class RaggedEngine(MultiStreamEngine):
         if g != self._num_streams:
             raise MetricsTPUUserError(
                 f"ragged snapshot serves {g} groups, this engine {self._num_streams}"
+            )
+        with self._seq_lock:
+            self._ingest_seq = max(
+                self._ingest_seq, int(meta.get("ragged_seq", 0) or 0)
             )
         super()._restore_commit(state, meta)

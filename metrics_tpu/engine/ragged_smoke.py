@@ -14,9 +14,17 @@ the bootstrap 8-device virtual mesh:
 4. composition — ``WindowPolicy`` + ``group_shard`` (the stream-shard pager
    at group grain, resident cap below the group count) together still serve
    the aggregate bit-exact;
-5. refusals — the plain engine refuses the cat-list metric at construction
+5. aggregate reads (ISSUE 18) — the device aggregate equals the host oracle
+   bit-exact at G=512 on the mesh IN ONE device dispatch, and a forced-spill
+   ``group_shard`` engine sweeps the same value in O(touched/block) paged
+   blocks — dispatch count never scales with the group universe;
+6. refusals — the plain engine refuses the cat-list metric at construction
    with the typed pointer at the ragged path, and the ragged engine's
    programs audit clean under the full analysis rule set.
+
+The ingest plan carries DELIBERATE equal sort keys: ``grouped_finalize``
+reconstructs each group's rows in ingest-rank order (the engine-owned
+``_seq`` tie-break), so ties are bit-exact across shard/pane interleavings.
 
 Prints one PASS line; exits nonzero on any violated claim.
 """
@@ -69,10 +77,12 @@ def _impl() -> int:
     ok = True
     GROUPS, CAP, ROWS, BATCHES = 12, 32, 16, 6
 
-    # seeded plan, preds GLOBALLY distinct (strict sort keys => bit-exact
-    # across every shard/pane interleaving)
+    # seeded plan with DELIBERATE pred ties: grouped_finalize reconstructs
+    # each group's rows in ingest-rank order (the engine-owned _seq
+    # tie-break), so equal sort keys stay bit-exact across every shard/pane
+    # interleaving — no distinct-key restriction needed
     rng = np.random.RandomState(17)
-    vals = rng.permutation(BATCHES * ROWS).astype(np.float32) / (BATCHES * ROWS)
+    vals = np.round(rng.rand(BATCHES * ROWS), 1).astype(np.float32)
     plan = []
     for b in range(BATCHES):
         plan.append((
@@ -201,7 +211,61 @@ def _impl() -> int:
         print(f"FAIL: windows+group_shard aggregate {got_comp!r} != oracle {want!r}")
         ok = False
 
-    # ---- 5. typed refusal + program audit
+    # ---- 5. aggregate reads (ISSUE 18): device/host parity at G=512 on the
+    # mesh, one paged sweep through a forced spill, and the O(1)-dispatch pin
+    AGG_G = 512
+    ar = np.random.RandomState(29)
+    agg_rows = 4 * AGG_G
+    agg_gids = (np.arange(agg_rows) % AGG_G).astype(np.int32)
+    agg_p = np.round(ar.rand(agg_rows), 2).astype(np.float32)  # ties on purpose
+    agg_t = (ar.rand(agg_rows) > 0.5).astype(np.float32)
+    agg = RaggedEngine(
+        RetrievalMAP(), num_groups=AGG_G,
+        config=EngineConfig(buckets=(agg_rows,), mesh=mesh, axis="dp",
+                            mesh_sync="deferred"),
+        capacity=8,
+    )
+    with agg:
+        agg.submit(agg_gids, agg_p, agg_t)
+        agg.flush()
+        path, why = agg.aggregate_path()
+        calls0 = agg.stats.result_device_calls
+        got_dev = float(agg.aggregate())
+        dispatches = agg.stats.result_device_calls - calls0
+        got_host = float(agg.aggregate(oracle=True))
+    if path != "device":
+        print(f"FAIL: G={AGG_G} aggregate routed {path!r} ({why}), expected device")
+        ok = False
+    if got_dev != got_host:
+        print(f"FAIL: device aggregate {got_dev!r} != host oracle {got_host!r} at G={AGG_G}")
+        ok = False
+    if dispatches != 1:
+        print(f"FAIL: aggregate issued {dispatches} device dispatches at "
+              f"G={AGG_G}, expected exactly 1 (O(1), not O(G))")
+        ok = False
+
+    paged = RaggedEngine(
+        RetrievalMAP(), num_groups=AGG_G,
+        config=EngineConfig(buckets=(agg_rows,), mesh=mesh, axis="dp",
+                            mesh_sync="deferred"),
+        capacity=8, group_shard=True, resident_groups=64,
+    )
+    with paged:
+        paged.submit(agg_gids, agg_p, agg_t)
+        paged.flush()
+        blocks0 = paged.stats.ragged_summary()["agg_blocks"]
+        got_paged = float(paged.aggregate())
+        sweep_blocks = paged.stats.ragged_summary()["agg_blocks"] - blocks0
+    if got_paged != got_host:
+        print(f"FAIL: forced-spill paged aggregate {got_paged!r} != host "
+              f"oracle {got_host!r}")
+        ok = False
+    if not (1 <= sweep_blocks < AGG_G):
+        print(f"FAIL: paged sweep ran {sweep_blocks} blocks for {AGG_G} touched "
+              "groups — dispatch count must scale with touched/block, not G")
+        ok = False
+
+    # ---- 6. typed refusal + program audit
     try:
         StreamingEngine(RetrievalMAP(), EngineConfig(buckets=(8,)))
         print("FAIL: plain engine accepted a cat-list retrieval metric")
@@ -223,8 +287,10 @@ def _impl() -> int:
             f"ragged-smoke PASS: RetrievalMAP bit-exact through the deferred "
             f"{NUM_DEVICES}-dev mesh ({GROUPS} groups, capacity {CAP}), detection "
             "MAP exact vs the eager oracle, kill/resume replay exact (cross-kind "
-            "restore refused), windows+group_shard composition exact, plain-engine "
-            "refusal typed, program audit clean, zero steady compiles"
+            "restore refused), windows+group_shard composition exact, device "
+            f"aggregate == host oracle at G={AGG_G} in ONE dispatch (forced-spill "
+            "paged sweep exact, O(touched/block) blocks), plain-engine refusal "
+            "typed, program audit clean, zero steady compiles"
         )
     return 0 if ok else 1
 

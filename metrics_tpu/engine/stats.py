@@ -186,6 +186,13 @@ class EngineStats:
         self.ragged_rows = 0
         self.ragged_groups_touched = 0
         self.ragged_overflows = 0
+        # aggregate reads by path (ISSUE 18): device = the compiled fold /
+        # corpus-bundle path, oracle = the host eager replay.  agg_blocks
+        # counts paged-sweep block dispatches — G-independent for a fixed
+        # touched population, the O(1)-dispatch observable the smoke pins.
+        self.ragged_agg_device_reads = 0
+        self.ragged_agg_oracle_reads = 0
+        self.ragged_agg_blocks = 0
 
     def record_admission(self, outcome: str, priority: int) -> None:
         """One admission verdict (``"admitted"``/``"rejected"``/``"shed"``)
@@ -300,6 +307,19 @@ class EngineStats:
         with self._counter_lock:
             self.ragged_overflows += int(groups)
 
+    def record_ragged_aggregate(self, path: str, blocks: int = 0) -> None:
+        """One aggregate ``result()`` served: ``path`` is ``"device"`` (the
+        compiled fold / corpus bundle) or ``"oracle"`` (the host eager
+        replay); ``blocks`` counts the paged sweep's block dispatches (0 off
+        ``group_shard``). Locked — readers aggregate concurrently with
+        producers."""
+        with self._counter_lock:
+            if path == "device":
+                self.ragged_agg_device_reads += 1
+            else:
+                self.ragged_agg_oracle_reads += 1
+            self.ragged_agg_blocks += int(blocks)
+
     def ragged_summary(self) -> Optional[Dict[str, Any]]:
         """The ragged-serving block for :meth:`summary` — None for engines
         that never declared a group universe (every non-ragged telemetry
@@ -314,6 +334,9 @@ class EngineStats:
                 "rows": self.ragged_rows,
                 "groups_touched": self.ragged_groups_touched,
                 "overflows": self.ragged_overflows,
+                "agg_device_reads": self.ragged_agg_device_reads,
+                "agg_oracle_reads": self.ragged_agg_oracle_reads,
+                "agg_blocks": self.ragged_agg_blocks,
             }
 
     def record_fleet_ingest(self, owned: bool) -> None:

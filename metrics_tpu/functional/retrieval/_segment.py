@@ -158,6 +158,66 @@ def segment_retrieval_mean(
     return jnp.asarray(fetched[0], result.dtype)
 
 
+def batched_group_scores(
+    preds: Array,
+    target: Array,
+    counts: Array,
+    *,
+    kind: str,
+    k: Optional[int] = None,
+    empty_target_action: str = "neg",
+) -> Tuple[Array, Array, Array]:
+    """Every group's score from the stacked ragged buffers, batched (ISSUE 18).
+
+    ``preds``/``target`` are the ``(G, capacity)`` stacked capacity buffers,
+    ``counts`` the ``(G,)`` TRUE row totals. This is the per-group read
+    (:func:`grouped_query_score`) vmapped over the resident set — the body of
+    the ragged engine's compiled AGGREGATE — returning per-group vectors the
+    engine folds on device:
+
+    * ``value`` ``(G,)`` — the group's score, with degenerate groups already
+      holding the action's fill (``skip`` groups hold 0 but are masked out);
+    * ``keep`` ``(G,)`` bool — groups that enter the corpus mean.  Empty
+      groups (``count == 0``) and overflowed groups (``count > capacity``)
+      ride this mask: both drop out exactly as in the eager corpus path
+      (overflow additionally raises host-side off the count vector, before
+      any folded value escapes);
+    * ``flag`` ``(G,)`` bool — degenerate groups under
+      ``empty_target_action="error"`` (all-False otherwise); the host finish
+      raises the deferred value check when any is set.
+
+    Fold ``value`` masked by ``keep`` with a sum kernel and divide by the
+    kept count and the result is bit-identical to
+    :func:`segment_retrieval_mean` over the concatenated corpus: per-group
+    segment math is byte-identical (same ``_segment_scores`` body), and the
+    masked fold is the same ``sum(where(keep, value, 0))`` expression.
+    """
+    cap = int(preds.shape[1])
+    f32 = jnp.float32
+    counts = jnp.asarray(counts, jnp.int32)
+
+    def one(p: Array, t: Array, c: Array) -> Tuple[Array, Array]:
+        row_valid = jnp.arange(cap) < jnp.minimum(c, cap)
+        indexes = jnp.where(row_valid, 0, 1).astype(jnp.int32)
+        values, empty, _ = _segment_scores(
+            jnp.asarray(p, f32), jnp.asarray(t, f32), indexes, kind=kind, k=k
+        )
+        return values[0], empty[0]
+
+    value, empty = jax.vmap(one)(preds, target, counts)
+    valid = (counts > 0) & (counts <= cap)
+    empty = empty & valid
+    if empty_target_action == "skip":
+        keep, fill = valid & ~empty, 0.0
+    elif empty_target_action == "pos":
+        keep, fill = valid, 1.0
+    else:  # "neg", and "error" (host finish inspects the flag vector)
+        keep, fill = valid, 0.0
+    value = jnp.where(empty, jnp.float32(fill), value)
+    flag = empty if empty_target_action == "error" else jnp.zeros_like(empty)
+    return value, keep, flag
+
+
 def grouped_query_score(
     preds: Array,
     target: Array,

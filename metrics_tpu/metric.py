@@ -114,6 +114,33 @@ class GroupedUpdateSpec:
     def field_names(self) -> Tuple[str, ...]:
         return tuple(f.name for f in self.fields)
 
+
+@dataclass(frozen=True)
+class GroupedAggregateSpec:
+    """Declaration that a grouped metric's AGGREGATE (the corpus-level
+    ``result()``) can be computed as a device program instead of the host
+    eager replay.
+
+    ``kind`` selects the engine's device aggregate shape:
+
+    * ``"fold"`` — the aggregate is a masked mean/sum of independent
+      per-group scores.  The metric implements
+      ``grouped_batch_scores(counts, fields, capacity)`` (traced, batched
+      over the ``(G, capacity, ...)`` buffers, returning per-group
+      ``{"value", "keep", "flag"}`` vectors) and
+      ``grouped_aggregate_finish(value, kept, flagged)`` (host-side: raise
+      deferred value errors / return the scalar).  The engine folds the
+      scores with the masked row kernels so only one scalar bundle leaves
+      the device.
+    * ``"corpus"`` — the aggregate needs a corpus-level pass that is not a
+      per-group mean (detection's PR curve).  The metric implements the
+      ``grouped_corpus_*`` hook family (plan → device bundle → host
+      finish); per-group match matrices run on device, only the final
+      curve interpolation runs on host.
+    """
+
+    kind: str  # "fold" | "corpus"
+
 # forward() auto-jit cache: instance -> {signature: compiled step | _EAGER_ONLY}.
 # Keyed by weakref so compiled handles never interfere with pickling, deepcopy
 # (clone()) or garbage collection of the metric itself.
@@ -748,6 +775,17 @@ class Metric:
             f"{type(self).__name__} declares no grouped_update_spec(); "
             "grouped_finalize is only meaningful for group-keyed metrics"
         )
+
+    def grouped_aggregate_spec(self) -> Optional["GroupedAggregateSpec"]:
+        """The metric's device-aggregate declaration, or None.
+
+        Grouped metrics whose corpus-level ``result()`` can run as a compiled
+        device program (instead of the host eager replay through
+        :meth:`grouped_finalize`) return a :class:`GroupedAggregateSpec` here;
+        the ragged engine then serves the aggregate as one device program plus
+        one scalar transfer, keeping the host path as the parity oracle.  The
+        default is None: the engine stays on the oracle path."""
+        return None
 
     def update_state_masked(self, state: Dict[str, Any], *args: Any, mask: Array, **kwargs: Any) -> Dict[str, Any]:
         """Pure mask-aware update: rows of the leading batch axis where ``mask``

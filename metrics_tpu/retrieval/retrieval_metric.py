@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from metrics_tpu.metric import GroupedField, GroupedUpdateSpec, Metric
+from metrics_tpu.metric import GroupedAggregateSpec, GroupedField, GroupedUpdateSpec, Metric
 from metrics_tpu.utils.checks import _check_retrieval_inputs
 from metrics_tpu.utils.data import dim_zero_cat, get_group_indexes
 
@@ -156,6 +156,42 @@ class RetrievalMetric(Metric, ABC):
             kind=self._segment_dispatch(), k=getattr(self, "k", None),
             empty_target_action=self.empty_target_action,
         )
+
+    def grouped_aggregate_spec(self) -> Optional[GroupedAggregateSpec]:
+        """Built-in retrieval aggregates fold on device (ISSUE 18): the
+        corpus-level ``result()`` is a masked mean of independent per-query
+        scores, so the engine batches the per-group read over the stacked
+        buffers and folds with the masked row kernels.  Custom-``_metric``
+        subclasses (no segment kind) stay on the host oracle."""
+        if self._segment_dispatch() is None:
+            return None
+        return GroupedAggregateSpec(kind="fold")
+
+    def grouped_batch_scores(
+        self, counts: Array, fields: Dict[str, Array], capacity: int
+    ) -> Dict[str, Array]:
+        """Traced, batched per-group scores for the device aggregate:
+        ``{"value", "keep", "flag"}``, each ``(G,)`` (see
+        :func:`~metrics_tpu.functional.retrieval._segment
+        .batched_group_scores`)."""
+        from metrics_tpu.functional.retrieval._segment import batched_group_scores
+
+        value, keep, flag = batched_group_scores(
+            fields["preds"], fields["target"], counts,
+            kind=self._segment_dispatch(), k=getattr(self, "k", None),
+            empty_target_action=self.empty_target_action,
+        )
+        return {"value": value, "keep": keep, "flag": flag}
+
+    def grouped_aggregate_finish(self, value: float, kept: int, flagged: int) -> Array:
+        """Host finish of the device fold: raise the deferred value check for
+        ``empty_target_action="error"`` corpora (same type + message as the
+        eager path), else return the folded mean."""
+        if flagged:
+            from metrics_tpu.utils.checks import _CODE_EMPTY_QUERY_RETRIEVAL, deferred_message
+
+            raise ValueError(deferred_message(_CODE_EMPTY_QUERY_RETRIEVAL))
+        return jnp.asarray(value, jnp.float32)
 
     def grouped_finalize(
         self, counts: Any, fields: Dict[str, Any], group_ids: Any

@@ -251,16 +251,46 @@ def _drive_ragged(seed=0):
                 (rng.rand(n) > 0.5).astype(np.float32),
             )
         eng.result(0)
+        eng.aggregate()  # compiles the DEVICE fold program (ISSUE 18)
     return eng
 
 
 def test_ragged_engine_audits_clean():
-    """ISSUE 17 clean sweep: the grouped step's lexsort + 2-d scatters and
-    the per-group read program must not trip any rule (collectives, arena,
-    compile cap) on a served ragged engine."""
+    """ISSUE 17/18 clean sweep: the grouped step's lexsort + 2-d scatters,
+    the per-group read program, AND the served device-aggregate fold must
+    not trip any rule (collectives, callbacks, arena, compile cap) on a
+    served ragged engine."""
     eng = _drive_ragged()
     report = EngineAnalysis().check(eng)
     assert report.findings == [], report.render()
+
+
+def test_audit_catches_a_host_callback_in_the_device_aggregate():
+    """Broken fixture (ISSUE 18): a ``pure_callback`` smuggled into the
+    batched score hook must fire ``no-host-callback-in-aggregate`` — the
+    audit re-traces the aggregate FRESH, so the one-program contract is
+    pinned structurally, not just by the bench's dispatch counters."""
+    eng = _drive_ragged()
+    assert EngineAnalysis().check(eng).ok  # sane before the break
+
+    user = eng._user_metric
+    inner = type(user).grouped_batch_scores
+
+    def smuggled(counts, fields, capacity):
+        out = inner(user, counts, fields, capacity)
+        shapes = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(jnp.shape(x), jnp.asarray(x).dtype), out
+        )
+        return jax.pure_callback(lambda o: o, shapes, out)
+
+    user.grouped_batch_scores = smuggled
+    try:
+        report = EngineAnalysis().check(eng)
+    finally:
+        del user.grouped_batch_scores  # instance shadow; class hook remains
+    rules = {f.rule for f in report.findings}
+    assert rules == {"no-host-callback-in-aggregate"}, report.render()
+    assert all("aggregate" in f.where for f in report.findings)
 
 
 def test_audit_catches_a_smuggled_collective_in_the_grouped_step():

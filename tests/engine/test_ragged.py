@@ -40,12 +40,17 @@ def _mesh():
     return Mesh(np.asarray(jax.devices()), ("dp",))
 
 
-def _retrieval_batches(seed=0, n_batches=4, rows=9, groups=6):
-    # preds are GLOBALLY distinct across batches: equal sort keys may permute
-    # across shard/pane interleavings (the documented caveat), every strict
-    # ordering is bit-exact
+def _retrieval_batches(seed=0, n_batches=4, rows=9, groups=6, ties=True):
+    # preds carry DELIBERATE equal sort keys (quantized to one decimal):
+    # grouped_finalize re-orders each group's rows by the engine-owned _seq
+    # ingest rank, so ties are bit-exact across every shard/pane
+    # interleaving — the old distinct-key restriction is gone (satellite 1,
+    # ISSUE 18); ties=False keeps a strict ordering for tests that vary it
     rng = np.random.RandomState(seed)
-    vals = rng.permutation(n_batches * rows).astype(np.float32) / (n_batches * rows)
+    if ties:
+        vals = np.round(rng.rand(n_batches * rows), 1).astype(np.float32)
+    else:
+        vals = rng.permutation(n_batches * rows).astype(np.float32) / (n_batches * rows)
     out = []
     for b in range(n_batches):
         idx = rng.randint(0, groups, rows)
@@ -449,7 +454,8 @@ def test_openmetrics_ragged_families_strict_both_directions():
     try:
         for preds, target, idx in batches:
             eng.submit_update(preds, target, idx)
-        eng.flush()
+        eng.aggregate()
+        eng.aggregate(oracle=True)
         fams = trace_export.parse_openmetrics(eng.metrics_text())
     finally:
         eng.stop()
@@ -459,6 +465,12 @@ def test_openmetrics_ragged_families_strict_both_directions():
     assert fams["metrics_tpu_engine_ragged_overflows"]["type"] == "counter"
     assert fams["metrics_tpu_engine_ragged_groups"]["type"] == "gauge"
     assert fams["metrics_tpu_engine_ragged_capacity"]["type"] == "gauge"
+    # aggregate reads (ISSUE 18): one device read + one oracle read served
+    for fam, want in (("agg_device_reads", 1), ("agg_oracle_reads", 1),
+                      ("agg_blocks", 0)):
+        f = fams[f"metrics_tpu_engine_ragged_{fam}"]
+        assert f["type"] == "counter"
+        assert int(f["samples"][0]["value"]) == want, (fam, f)
     # a non-ragged engine's exposition is byte-free of the ragged families
     plain = StreamingEngine(Accuracy(), EngineConfig(buckets=(8,)))
     try:
@@ -530,13 +542,20 @@ def test_merge_stacked_states_compacts_replica_major():
     buf = buf.at[0, 0, :2].set(jnp.asarray([1.0, 2.0]))
     buf = buf.at[1, 0, :1].set(jnp.asarray([3.0]))
     buf = buf.at[1, 1, :2].set(jnp.asarray([4.0, 5.0]))
+    seq = jnp.zeros((2, 3, 4), jnp.int32)
+    seq = seq.at[0, 0, :2].set(jnp.asarray([10, 11]))
+    seq = seq.at[1, 0, :1].set(jnp.asarray([12]))
+    seq = seq.at[1, 1, :2].set(jnp.asarray([13, 14]))
     merged = w.merge_stacked_states(
-        {"count": count, "buf_preds": buf, "buf_target": buf}
+        {"count": count, "buf_preds": buf, "buf_target": buf, "buf__seq": seq}
     )
     np.testing.assert_array_equal(np.asarray(merged["count"]), [3, 2, 0])
     got = np.asarray(merged["buf_preds"])
     np.testing.assert_allclose(got[0, :3], [1.0, 2.0, 3.0])  # replica-major
     np.testing.assert_allclose(got[1, :2], [4.0, 5.0])
+    # the engine-owned ingest ranks compact replica-major with their rows —
+    # the read-time _seq sort then restores global submission order
+    np.testing.assert_array_equal(np.asarray(merged["buf__seq"])[0, :3], [10, 11, 12])
 
 
 def test_merge_stacked_states_overflow_sums_true_count():
@@ -546,8 +565,9 @@ def test_merge_stacked_states_overflow_sums_true_count():
     w = GroupedStateMetric(RetrievalMAP(), capacity=2)
     count = jnp.asarray([[2], [2]], jnp.int32)
     buf = jnp.asarray([[[1.0, 2.0]], [[3.0, 4.0]]], jnp.float32)
+    seq = jnp.asarray([[[0, 1]], [[2, 3]]], jnp.int32)
     merged = w.merge_stacked_states(
-        {"count": count, "buf_preds": buf, "buf_target": buf}
+        {"count": count, "buf_preds": buf, "buf_target": buf, "buf__seq": seq}
     )
     assert int(merged["count"][0]) == 4  # > capacity: loud at the aggregate read
     np.testing.assert_allclose(np.asarray(merged["buf_preds"])[0], [1.0, 2.0])

@@ -1,0 +1,295 @@
+"""Device-side ragged aggregates (ISSUE 18): the property suite.
+
+The compiled device aggregate (batched per-group scores + masked kernel
+folds for retrieval; the vmapped greedy-match corpus bundle for detection)
+must be BIT-EXACT against the host eager-replay oracle — the unmodified
+eager metric over ``grouped_finalize``-reconstructed rows — across every
+edge the semantics ride on:
+
+* empty groups (never ingested) drop out of the fold identically;
+* all-empty-target groups under EACH ``empty_target_action`` (neg/skip/pos
+  fold through the keep mask; "error" raises the SAME typed message from
+  both paths);
+* overflowed groups raise the SAME ``MetricsTPUUserError`` from both paths
+  (the device fold carries overflow as a folded scalar, the raise itself
+  fires host-side off the count vector);
+* paged + resident mixes under ``group_shard`` (the capacity-batched sweep
+  accumulates partial folds block by block — same value, O(touched/block)
+  blocks);
+* kill/resume: a restored engine's DEVICE aggregate equals the
+  straight-through value;
+* detection's corpus bundle equals the eager oracle key-for-key, including
+  ``class_metrics=True``.
+
+Every plan here carries DELIBERATE equal sort keys — the ``_seq``
+ingest-rank tie-break (satellite 1) is what makes ties bit-exact.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu import RetrievalMAP, RetrievalNormalizedDCG
+from metrics_tpu.detection import MeanAveragePrecision
+from metrics_tpu.engine import EngineConfig, RaggedEngine
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+
+def _mesh1():
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(jax.devices()[:1]), ("dp",))
+
+
+def _plan(seed=3, n_batches=4, rows=12, groups=8, tie_decimals=1,
+          empty_target_groups=(), untouched_groups=()):
+    """Batches of (preds, target, gids) with quantized (tied) preds;
+    ``empty_target_groups`` get all-zero targets, ``untouched_groups`` never
+    receive a row."""
+    rng = np.random.RandomState(seed)
+    live = [g for g in range(groups) if g not in untouched_groups]
+    out = []
+    for _ in range(n_batches):
+        gids = np.asarray(rng.choice(live, rows), np.int64)
+        preds = np.round(rng.rand(rows), tie_decimals).astype(np.float32)
+        target = rng.randint(0, 2, rows)
+        target[np.isin(gids, list(empty_target_groups))] = 0
+        # keep at least one positive in every non-empty-target group so the
+        # empty_target_action axis is exercised ONLY by the designated groups
+        for g in set(gids.tolist()) - set(empty_target_groups):
+            sel = np.flatnonzero(gids == g)
+            if not target[sel].any():
+                target[sel[0]] = 1
+        out.append((preds, target.astype(np.int64), gids))
+    return out
+
+
+def _eager(metric, plan):
+    for p, t, g in plan:
+        metric.update(jnp.asarray(p), jnp.asarray(t), indexes=jnp.asarray(g))
+    return float(metric.compute())
+
+
+def _serve(metric, plan, groups, capacity=64, config=None, **engine_kw):
+    eng = RaggedEngine(metric, num_groups=groups, config=config,
+                       capacity=capacity, **engine_kw)
+    with eng:
+        for p, t, g in plan:
+            eng.submit_update(p, t, g)
+        path, why = eng.aggregate_path()
+        dev = float(eng.aggregate())
+        orc = float(eng.aggregate(oracle=True))
+        stats = eng.stats.ragged_summary()
+    return dev, orc, path, stats
+
+
+# ------------------------------------------------------------- fold parity
+
+
+@pytest.mark.parametrize("metric_cls", [RetrievalMAP, RetrievalNormalizedDCG])
+def test_device_fold_equals_oracle_and_eager_with_empty_groups(metric_cls):
+    """Untouched groups drop out of the device fold exactly as they drop out
+    of the eager metric — and ties everywhere stay bit-exact."""
+    plan = _plan(untouched_groups=(1, 6))
+    want = _eager(metric_cls(), plan)
+    dev, orc, path, stats = _serve(metric_cls(), plan, groups=8)
+    assert path == "device"
+    assert dev == orc == want
+    assert stats["agg_device_reads"] == 1 and stats["agg_oracle_reads"] == 1
+
+
+@pytest.mark.parametrize("action", ["neg", "skip", "pos"])
+def test_all_empty_target_groups_fold_per_action(action):
+    """Groups whose targets are ALL zero score 0 / drop out / score 1 per
+    ``empty_target_action`` — the semantics ride the fold's keep mask and
+    must match the eager metric bit-exactly."""
+    plan = _plan(empty_target_groups=(0, 3), untouched_groups=(7,))
+    want = _eager(RetrievalMAP(empty_target_action=action), plan)
+    dev, orc, path, _ = _serve(
+        RetrievalMAP(empty_target_action=action), plan, groups=8
+    )
+    assert path == "device"
+    assert dev == orc == want
+
+
+def test_empty_target_error_action_raises_same_message_both_paths():
+    """``empty_target_action="error"``: the device fold carries the flag
+    through the mask and raises host-side with the SAME type and message the
+    eager compute raises."""
+    plan = _plan(empty_target_groups=(2,))
+    with pytest.raises(ValueError) as eager_err:
+        _eager(RetrievalMAP(empty_target_action="error"), plan)
+    eng = RaggedEngine(RetrievalMAP(empty_target_action="error"),
+                       num_groups=8, capacity=64)
+    with eng:
+        for p, t, g in plan:
+            eng.submit_update(p, t, g)
+        with pytest.raises(ValueError) as dev_err:
+            eng.aggregate()
+        with pytest.raises(ValueError) as orc_err:
+            eng.aggregate(oracle=True)
+    assert str(dev_err.value) == str(orc_err.value) == str(eager_err.value)
+
+
+def test_overflow_raises_same_typed_error_both_paths():
+    """An overflowed group fires the typed capacity raise from BOTH aggregate
+    paths — the device fold detects it in the folded overflow scalar, then
+    raises off the same host-side count vector the oracle reads."""
+    eng = RaggedEngine(RetrievalMAP(), num_groups=4, capacity=4)
+    rng = np.random.RandomState(0)
+    with eng:
+        gids = np.asarray([1] * 6 + [2] * 2, np.int64)
+        eng.submit_update(np.round(rng.rand(8), 1).astype(np.float32),
+                          rng.randint(0, 2, 8), gids)
+        with pytest.raises(MetricsTPUUserError, match="capacity") as dev_err:
+            eng.aggregate()
+        with pytest.raises(MetricsTPUUserError, match="capacity") as orc_err:
+            eng.aggregate(oracle=True)
+    assert str(dev_err.value) == str(orc_err.value)
+    assert "1 (6 rows)" in str(dev_err.value)
+
+
+# ------------------------------------------------- group_shard paged sweeps
+
+
+def test_paged_resident_mix_matches_oracle_and_unsharded():
+    """A ``group_shard`` engine with the resident cap far below the touched
+    population sweeps spilled + resident groups in capacity batches — the
+    accumulated fold is bit-exact vs its own oracle AND vs the unsharded
+    device fold over the same plan, in O(touched/block) blocks."""
+    G = 64
+    plan = _plan(seed=11, n_batches=6, rows=32, groups=G)
+    want = _eager(RetrievalMAP(), plan)
+    dev_flat, _, _, _ = _serve(RetrievalMAP(), plan, groups=G)
+    cfg = EngineConfig(buckets=(32,), mesh=_mesh1(), axis="dp",
+                       mesh_sync="deferred")
+    dev, orc, path, stats = _serve(
+        RetrievalMAP(), plan, groups=G, config=cfg,
+        group_shard=True, resident_groups=8,
+    )
+    assert path == "device"
+    assert dev == orc == want == dev_flat
+    # 64 touched groups, 1024-row blocks -> ONE block per sweep; two
+    # aggregates ran above (device + the oracle's gather doesn't sweep)
+    assert stats["agg_blocks"] == 1
+
+
+def test_kill_resume_device_aggregate_is_exact(tmp_path):
+    """Snapshot mid-plan, restore into a fresh engine, replay the rest: the
+    restored engine's DEVICE aggregate equals the straight-through value
+    (the ``_seq`` ranks ride the snapshot, so replayed ties still order)."""
+    plan = _plan(seed=5)
+    want = _eager(RetrievalMAP(), plan)
+
+    def cfg():
+        return EngineConfig(buckets=(12,), snapshot_dir=str(tmp_path))
+
+    first = RaggedEngine(RetrievalMAP(), num_groups=8, config=cfg(), capacity=64)
+    with first:
+        for p, t, g in plan[:2]:
+            first.submit_update(p, t, g)
+        first.flush()
+        first.snapshot()
+    resumed = RaggedEngine(RetrievalMAP(), num_groups=8, config=cfg(), capacity=64)
+    with resumed:
+        resumed.restore()
+        for p, t, g in plan[2:]:
+            resumed.submit_update(p, t, g)
+        path, _ = resumed.aggregate_path()
+        dev = float(resumed.aggregate())
+        orc = float(resumed.aggregate(oracle=True))
+    assert path == "device"
+    assert dev == orc == want
+
+
+# ------------------------------------------------------ oracle pinning
+
+
+def test_aggregate_oracle_flag_pins_the_host_path():
+    """``aggregate_oracle=True`` routes ``result()`` to the host replay and
+    the audit/stats surface says so — the parity flag stays explicit."""
+    plan = _plan(seed=9)
+    eng = RaggedEngine(RetrievalMAP(), num_groups=8, capacity=64,
+                       aggregate_oracle=True)
+    with eng:
+        for p, t, g in plan:
+            eng.submit_update(p, t, g)
+        path, why = eng.aggregate_path()
+        got = float(eng.result())
+        stats = eng.stats.ragged_summary()
+    assert path == "oracle" and "aggregate_oracle" in why
+    assert got == _eager(RetrievalMAP(), plan)
+    assert stats["agg_device_reads"] == 0 and stats["agg_oracle_reads"] == 1
+
+
+# ------------------------------------------------------- detection corpus
+
+
+def _det_image(rng, n_gt, n_classes=3, fp=1):
+    """One image whose dets are jittered gt copies (some class-flipped) plus
+    false positives, scores drawn from a SMALL tie-heavy set."""
+    empty = ({"boxes": np.zeros((0, 4), np.float32),
+              "scores": np.zeros(0, np.float32),
+              "labels": np.zeros(0, np.int32)},
+             {"boxes": np.zeros((0, 4), np.float32),
+              "labels": np.zeros(0, np.int32)})
+    if n_gt == 0:
+        return empty
+    xy = rng.uniform(0, 150, (n_gt, 2)).astype(np.float32)
+    wh = rng.choice([8.0, 30.0, 90.0], (n_gt, 2)).astype(np.float32)
+    gtb = np.concatenate([xy, xy + wh], axis=1)
+    gtl = rng.randint(0, n_classes, n_gt).astype(np.int32)
+    db = gtb + rng.uniform(-3, 3, (n_gt, 4)).astype(np.float32)
+    dl = gtl.copy()
+    flip = rng.rand(n_gt) < 0.25
+    dl[flip] = (dl[flip] + 1) % n_classes
+    fxy = rng.uniform(0, 150, (fp, 2)).astype(np.float32)
+    fpb = np.concatenate([fxy, fxy + 20], axis=1)
+    boxes = np.concatenate([db, fpb], axis=0)
+    labels = np.concatenate([dl, rng.randint(0, n_classes, fp).astype(np.int32)])
+    scores = rng.choice([0.3, 0.6, 0.6, 0.85, 0.95], boxes.shape[0]).astype(np.float32)
+    return ({"boxes": boxes, "scores": scores, "labels": labels},
+            {"boxes": gtb, "labels": gtl})
+
+
+def test_detection_corpus_device_equals_oracle_and_eager():
+    """The corpus bundle (vmapped greedy match + on-device confusion
+    reduction, host-side PR interpolation only) equals the eager oracle
+    key-for-key with ``class_metrics=True`` — score ties, class flips, an
+    empty image, and two accumulation rounds per image id included."""
+    rng = np.random.RandomState(7)
+    G = 6
+    rounds = []
+    for _ in range(2):
+        ims = []
+        for i in range(G):
+            n_gt = 0 if i == 2 else int(rng.randint(1, 5))
+            ims.append(_det_image(rng, n_gt))
+        rounds.append(ims)
+
+    eager = MeanAveragePrecision(class_metrics=True)
+    preds, tgts = [], []
+    for i in range(G):
+        preds.append({k: np.concatenate([rounds[r][i][0][k] for r in range(2)])
+                      for k in ("boxes", "scores", "labels")})
+        tgts.append({k: np.concatenate([rounds[r][i][1][k] for r in range(2)])
+                     for k in ("boxes", "labels")})
+    eager.update(preds, tgts)
+    ref = {k: np.asarray(v) for k, v in eager.compute().items()}
+
+    eng = RaggedEngine(MeanAveragePrecision(class_metrics=True),
+                       num_groups=G, capacity=32)
+    with eng:
+        for r in range(2):
+            for i in range(G):
+                p, t = rounds[r][i]
+                eng.submit_update([p], [t], [i])
+        path, _ = eng.aggregate_path()
+        dev = {k: np.asarray(v) for k, v in eng.aggregate().items()}
+        orc = {k: np.asarray(v) for k, v in eng.aggregate(oracle=True).items()}
+    assert path == "device"
+    for k in sorted(set(ref) | set(dev) | set(orc)):
+        assert np.array_equal(dev[k], orc[k]), f"{k}: device != oracle"
+        assert np.array_equal(orc[k], ref[k]), f"{k}: oracle != eager"
+    assert float(dev["map"]) > 0.05  # the matching actually engaged

@@ -216,6 +216,22 @@ def render(doc: dict, steps: int = 10, analysis: dict = None) -> str:
                 ),
             )
         )
+        if ragged.get("agg_device_reads") or ragged.get("agg_oracle_reads"):
+            # aggregate-read paths (ISSUE 18): compiled device folds vs host
+            # oracle replays, plus paged-sweep block dispatches under
+            # group_shard (G-independent for a fixed touched population).
+            rows.append(
+                (
+                    "ragged aggregate",
+                    f"{_fmt(ragged.get('agg_device_reads'))} device · "
+                    f"{_fmt(ragged.get('agg_oracle_reads'))} oracle"
+                    + (
+                        f" · {_fmt(ragged.get('agg_blocks'))} sweep blocks"
+                        if ragged.get("agg_blocks")
+                        else ""
+                    ),
+                )
+            )
     fleet = s.get("fleet")
     if fleet:
         # per-host fleet section (ISSUE 15): which host of how many this
