@@ -2,9 +2,9 @@
 # Default flow runs the smoke checks (seconds) before the full suite.
 # Sidecar artifacts (telemetry JSON, analysis reports) land under out/
 # (gitignored) — never in the repo root.
-.PHONY: all test engine-smoke kernels-smoke mesh-smoke streams-smoke chaos-smoke obs-smoke quant-smoke elastic-smoke windows-smoke fleet-smoke ragged-smoke analyze clean native bench
+.PHONY: all test engine-smoke kernels-smoke mesh-smoke streams-smoke chaos-smoke obs-smoke quant-smoke elastic-smoke windows-smoke fleet-smoke ragged-smoke model-smoke analyze clean native bench
 
-all: engine-smoke kernels-smoke mesh-smoke streams-smoke chaos-smoke obs-smoke quant-smoke elastic-smoke windows-smoke fleet-smoke ragged-smoke analyze test
+all: engine-smoke kernels-smoke mesh-smoke streams-smoke chaos-smoke obs-smoke quant-smoke elastic-smoke windows-smoke fleet-smoke ragged-smoke model-smoke analyze test
 
 test:
 	python -m pytest tests/ -q
@@ -122,6 +122,19 @@ fleet-smoke:
 # "Ragged serving".
 ragged-smoke:
 	JAX_PLATFORMS=cpu python -m metrics_tpu.engine.ragged_smoke
+
+# Embedded-model serving gate (ISSUE 19), CPU-safe (bootstraps the 8-device
+# virtual mesh, metrics_tpu/engine/model_smoke.py): single-device f32 host
+# bit-exact vs the direct InceptionV3 forward; hybrid stem-tensor layout
+# (128-lane tensor-parallel stem + data-parallel trunk, all_gather-only)
+# float-parity vs single-device; pipeline-staged encoder (ppermute-only GPipe
+# handoff) bit-exact vs sequential stages; FID+KID over the same weights
+# share ONE resident model (params shared, not copied); zero steady compiles
+# on warm replay; host-collectives-pinned audit clean; model_host_*
+# OpenMetrics strict-parse; kill/resume with a host attached bit-identical.
+# Docs: docs/serving.md "Embedded-model serving".
+model-smoke:
+	JAX_PLATFORMS=cpu python -m metrics_tpu.engine.model_smoke out/model_telemetry.json
 
 # Static-analysis gate, CPU-safe (metrics_tpu/analysis + tools/analyze.py):
 # program plane audits the bootstrap engine matrix ({step,deferred} x

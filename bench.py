@@ -1627,6 +1627,34 @@ def bench_ragged_serving() -> dict:
     return json.loads(proc.stdout.strip().splitlines()[-1])
 
 
+# --------------------------------------------- config: model serving (r19)
+
+def bench_model_serving() -> dict:
+    """Embedded-model serving (ISSUE 19): imgs/s (InceptionV3 features) and
+    pairs/s (text-encoder forwards) through the resident ``ModelHost`` vs the
+    monolithic per-metric forward, in ONE subprocess run
+    (``metrics_tpu/engine/model_bench`` owns the pinned protocol —
+    fixed-seed ragged streams, warmup pays every compile, interleaved timed
+    passes, zero steady compiles asserted HARD on the host path, MFU
+    attribution from the PR 1 cost walk over the served bucket program).
+    CPU rates carry ``liveness_only``; the durable facts are the
+    host-vs-monolithic ratios, the closed program set (one program per
+    bucket vs one per distinct raw shape), and the compile assertion."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "metrics_tpu.engine.model_bench"],
+            env=env, capture_output=True, text=True, timeout=900,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": "model_serving timed out"}
+    if proc.returncode != 0:
+        return {"error": proc.stderr[-500:]}
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
 # ---------------------------------------------- config: tracing overhead (r9)
 
 def bench_obs_overhead() -> dict:
@@ -2596,6 +2624,7 @@ def main() -> None:
         ("stream_capacity", bench_stream_capacity),
         ("fleet_sync", bench_fleet_sync),
         ("ragged_serving", bench_ragged_serving),
+        ("model_serving", bench_model_serving),
         ("obs_overhead", bench_obs_overhead),
         ("kernel_microbench", bench_kernel_microbench),
     ):

@@ -226,6 +226,44 @@ def bootstrap_engines(
         engine.result(0)
         engine.results()
     out.append(("sshard/arena/multistream/megastep_interpret", engine))
+    # EMBEDDED-MODEL HOST engine (ISSUE 19): a deferred 1-device engine whose
+    # traffic is FEATURES served by a pipeline-staged encoder ModelHost — the
+    # audited steady metric step stays collective-free exactly like every
+    # other deferred engine, while the host's OWN stage program (re-traced
+    # from its recorded abstract signature) is audited against its declared
+    # ppermute-only allowance by `host-collectives-pinned` (broken-fixture
+    # proof: widening the forward with an undeclared psum — or clearing the
+    # allowance under the real ppermute handoff — fails the rule:
+    # tests/analysis/test_engine_audit.py)
+    from metrics_tpu.engine import ModelHostConfig, encoder_host
+
+    def _stage_fn(w, x):
+        return x @ w
+
+    host = encoder_host(
+        stage_fn=_stage_fn,
+        stage_params=np.eye(4, dtype=np.float32)[None] * 1.5,
+        config=ModelHostConfig(
+            buckets=(8,), mesh=mesh, coalesce_window_ms=0.0
+        ),
+        fingerprint="bootstrap-pipeline-encoder",
+        shared=False,
+    )
+    engine = StreamingEngine(
+        MeanSquaredError(),
+        EngineConfig(
+            buckets=(8,), mesh=mesh, axis="dp", mesh_sync="deferred"
+        ),
+    )
+    engine.model_host = host
+    with engine:
+        for p, t in batches:
+            ids = np.tile(p[:, None], (1, 4)).astype(np.float32)
+            feats = host.infer(ids, np.ones_like(ids))
+            engine.submit(np.asarray(feats).mean(axis=1), t.astype(np.float32))
+        engine.result()
+    host.close()
+    out.append(("modelhost/arena/single/xla", engine))
     return out
 
 

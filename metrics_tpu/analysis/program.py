@@ -329,6 +329,16 @@ class EngineAnalysis:
                         agg_jaxpr, min_count=1, max_count=8, where=agg_where
                     ))
 
+        # embedded-model hosts feeding this engine's streams (ISSUE 19): each
+        # host program is re-traced from its recorded abstract signature and
+        # audited against the sharding mode's declared collective allowance —
+        # the steady metric step above stays collective-free, the host's stage
+        # programs carry ONLY their declared handoff (all_gather / ppermute)
+        for host in self._attached_hosts(engine):
+            report.extend(R.check_host_collectives_pinned(
+                host, where=f"{label}/model_host[{host.kind}]"
+            ))
+
         # compile cap: programs this engine owns in its (possibly shared) cache
         cap_detail = ""
         n_owned = self._owned_programs(engine)
@@ -379,6 +389,17 @@ class EngineAnalysis:
                 engine._metric, where=f"{label}/compute", alternates=self._alternates
             ))
         return report
+
+    @staticmethod
+    def _attached_hosts(engine: Any) -> List[Any]:
+        """Model hosts declared on the engine (``engine.model_hosts`` list or a
+        single ``engine.model_host``) — how the bootstrap matrix and serving
+        code hand the audit the embedded-model plane."""
+        hosts = getattr(engine, "model_hosts", None)
+        if hosts:
+            return list(hosts)
+        host = getattr(engine, "model_host", None)
+        return [host] if host is not None else []
 
     @staticmethod
     def _sync_leaf_info(engine: Any) -> Optional[Any]:

@@ -14,6 +14,7 @@ from metrics_tpu.analysis.rules.arena import check_arena_pack_fused
 from metrics_tpu.analysis.rules.collectives import (
     COLLECTIVE_PRIMITIVES,
     check_collective_multiset,
+    check_host_collectives_pinned,
     check_no_collectives,
     collective_counts,
     collective_eqn_paths,
@@ -61,6 +62,7 @@ __all__ = [
     "check_arena_pack_fused",
     "check_collective_multiset",
     "check_compile_cap",
+    "check_host_collectives_pinned",
     "check_donation_honored",
     "decls_for_file",
     "lockset_findings",
@@ -115,6 +117,17 @@ RULES: Dict[str, RuleInfo] = {
             "quantized state on the f32 psum pays exact bandwidth silently.",
             incident="ISSUE 10: the policy is a trace constant, so a stale "
             "program serves the WRONG precision without erroring",
+        ),
+        RuleInfo(
+            "host-collectives-pinned", "program", "error",
+            "Embedded-model host programs carry ONLY their sharding mode's "
+            "declared collectives (hybrid Inception: all_gather of stem lanes; "
+            "pipeline encoder: ppermute stage handoff; single-device: none) — "
+            "metric steady steps stay collective-free, cross-chip traffic "
+            "lives exclusively in the host's stage programs.",
+            incident="ISSUE 19: the model-serving split is structural, so a "
+            "layout leaking communication past its handoff re-couples metric "
+            "dispatch to model sharding",
         ),
         RuleInfo(
             "no-host-callback-in-aggregate", "program", "error",

@@ -19,6 +19,7 @@ __all__ = [
     "hlo_collective_counts",
     "check_no_collectives",
     "check_collective_multiset",
+    "check_host_collectives_pinned",
     "expected_step_sync_collectives",
 ]
 
@@ -95,6 +96,77 @@ def check_no_collectives(
                 where=where, path=f"hlo:{op}",
                 message=f"compiled HLO contains {n}x {op} in a deferred steady step",
                 hint=hint,
+            ))
+    return findings
+
+
+def check_host_collectives_pinned(host: Any, where: str = "") -> List[Finding]:
+    """Rule ``host-collectives-pinned``: a :class:`~metrics_tpu.engine.model_host.ModelHost`
+    program may carry ONLY the collectives its sharding mode declares
+    (``allowed_collectives`` — ``all_gather`` for the hybrid stem-tensor
+    Inception layout, ``ppermute`` for the pipeline-staged encoder, nothing
+    for single-device hosts). The embedded-model serving contract (ISSUE 19)
+    keeps the METRIC steady step collective-free and confines cross-chip
+    traffic to the host's stage programs; an undeclared collective here means
+    the model layout leaked communication past its declared handoff (and a
+    mesh-sharded host whose programs trace NO declared collective silently
+    degraded to replicated execution — flagged as a warning).
+
+    Re-traces every compiled host program from its recorded abstract
+    signature (read-only; ``ModelHost.host_programs``).
+    """
+    import jax
+
+    allowed = set(getattr(host, "allowed_collectives", ()))
+    unknown = allowed - COLLECTIVE_PRIMITIVES
+    findings: List[Finding] = []
+    if unknown:
+        findings.append(Finding(
+            rule="host-collectives-pinned", severity="error", where=where,
+            path="allowed_collectives",
+            message=f"declared allowance {sorted(unknown)} names no known collective primitive",
+            hint=f"valid names: {sorted(COLLECTIVE_PRIMITIVES)}",
+        ))
+    programs = host.host_programs()
+    if not programs:
+        findings.append(Finding(
+            rule="host-collectives-pinned", severity="warning", where=where,
+            path="", message="host has no compiled programs — serve traffic before auditing",
+            hint="call host.infer(...) (or route a metric through it) first",
+        ))
+        return findings
+    sharded = getattr(host.config, "mesh", None) is not None
+    for key, (fn, (params_abs, args_abs)) in programs.items():
+        pwhere = f"{where}/program[{key[3] if len(key) > 3 else key}]"
+        jaxpr = jax.make_jaxpr(fn)(params_abs, *args_abs)
+        seen = set()
+        for path, name in collective_eqn_paths(jaxpr):
+            seen.add(name)
+            if name not in allowed:
+                findings.append(Finding(
+                    rule="host-collectives-pinned", severity="error",
+                    where=pwhere, path=path,
+                    message=(
+                        f"collective {name!r} traced in a host program whose sharding "
+                        f"mode allows only {sorted(allowed) or 'none'}"
+                    ),
+                    hint=(
+                        "the model layout leaked communication past its declared "
+                        "stage handoff — hybrid Inception may only all_gather the "
+                        "stem lanes, pipeline encoders may only ppermute activations "
+                        "(parallel/embedded.py); single-device hosts communicate NOT AT ALL"
+                    ),
+                ))
+        if sharded and allowed and not (seen & allowed):
+            findings.append(Finding(
+                rule="host-collectives-pinned", severity="warning",
+                where=pwhere, path="",
+                message=(
+                    f"mesh-sharded host program traces none of its declared "
+                    f"handoffs {sorted(allowed)} — the layout may have silently "
+                    "degraded to replicated execution"
+                ),
+                hint="check the builder actually routed through the sharded forward",
             ))
     return findings
 

@@ -50,6 +50,15 @@ host-side batching and queueing. This package supplies it:
   writes globally consistent snapshot cuts through a deterministic
   barrier-on-batch-boundary protocol. Gate: ``make fleet-smoke`` (two real
   CPU processes over gloo, :mod:`~metrics_tpu.engine.fleet.harness`).
+* :mod:`~metrics_tpu.engine.model_host` — embedded-model serving (ISSUE 19):
+  :class:`ModelHost` keeps ONE resident copy of an embedded model (Inception's
+  tensor-sharded stem, a pipeline-staged encoder with ``ppermute`` handoff)
+  and serves feature requests from many metric streams through bucketing,
+  megabatch coalescing, and per-(bucket, precision, mesh) AOT executables —
+  zero steady-state compiles, f32 bit-exact by default with bf16/int8
+  activation paths under the q8 analytic bound. ``FID``/``KID``/``BERTScore``
+  route through it via ``model_host=``. Gate: ``make model-smoke``
+  (:mod:`~metrics_tpu.engine.model_smoke`).
 * :mod:`~metrics_tpu.engine.quantize` — the block-scaled int8 codec for
   state at REST (ISSUE 10): ``EngineConfig(compress_payloads=True)`` stores
   snapshot payloads and pager spill rows quantized under the metric's
@@ -99,6 +108,14 @@ from metrics_tpu.engine.fleet import (
     FleetHostLostError,
     FleetTopologyError,
     restore_fleet_into,
+)
+from metrics_tpu.engine.model_host import (
+    ModelHost,
+    ModelHostConfig,
+    encoder_host,
+    inception_host,
+    reset_host_registry,
+    shared_host,
 )
 from metrics_tpu.engine.multistream import MultiStreamEngine
 from metrics_tpu.engine.pipeline import EngineConfig, StreamingEngine
@@ -154,6 +171,8 @@ __all__ = [
     "FleetTopologyError",
     "GroupedStateMetric",
     "InjectedFault",
+    "ModelHost",
+    "ModelHostConfig",
     "MultiStreamEngine",
     "OverloadDetector",
     "QuarantineRecord",
@@ -169,12 +188,16 @@ __all__ = [
     "device_trace_session",
     "enable_persistent_compilation_cache",
     "encode_state_tree",
+    "encoder_host",
     "generations",
+    "inception_host",
     "latest_snapshot",
     "load_snapshot",
     "q8_decode_array",
     "q8_encode_array",
     "render_openmetrics",
+    "reset_host_registry",
     "restore_fleet_into",
     "save_snapshot",
+    "shared_host",
 ]

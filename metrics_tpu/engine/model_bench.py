@@ -1,0 +1,272 @@
+"""Embedded-model serving bench: ``python -m metrics_tpu.engine.model_bench``.
+
+The ``model_serving`` entry (bench.py / BENCH.md): imgs/s (InceptionV3
+features) and pairs/s (text-encoder forwards) through the resident
+:class:`~metrics_tpu.engine.model_host.ModelHost` vs the monolithic
+per-metric forward it replaces, measured under the pinned ratios-in-one-run
+protocol — one process, one fixed-seed ragged stream, warmup pays every
+compile, then interleaved (monolithic, host) timed passes so host-load drift
+cancels in the ratio. The ZERO-steady-compile assertion is HARD on the host
+path (a violation raises, the entry reports an error — same contract as
+every engine gate), and the monolithic path's open program set is reported
+next to the host's closed one (one program per DISTINCT raw batch shape vs
+one per bucket). MFU attribution comes from the PR 1 cost walk
+(``ops/profiling.attribution_table``): analytic FLOPs of the served bucket
+program, cross-checked against XLA's own count, with the structural MXU
+ceiling the graph's shapes permit. On CPU the absolute rates carry
+``liveness_only``; the durable facts are the ratio, the program-set sizes,
+and the zero-steady-compile assertion. Prints one JSON document on stdout.
+"""
+import json
+import sys
+import time
+
+INPUT_SIZE = 75  # smallest viable InceptionV3 input: CPU-cheap compiles
+
+
+def _interleaved(paths, trials):
+    """{name: [seconds]*trials} with the per-trial order interleaved so host
+    drift hits every path alike and cancels in the ratios."""
+    times = {name: [] for name, _ in paths}
+    for _ in range(trials):
+        for name, fn in paths:
+            t0 = time.perf_counter()
+            fn()
+            times[name].append(time.perf_counter() - t0)
+    return times
+
+
+def _rate(rows, seconds):
+    ts = sorted(seconds)
+    med = ts[len(ts) // 2]
+    return round(rows / med, 2), round((ts[-1] - ts[0]) / med, 3)
+
+
+def bench_inception(trials=3):
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu.engine.model_host import ModelHostConfig, inception_host
+    from metrics_tpu.models.inception import InceptionV3, random_inception_params
+    from metrics_tpu.ops.profiling import attribution_table
+
+    params = random_inception_params(input_size=INPUT_SIZE, seed=0, fast=True)
+    rng = np.random.RandomState(20260807)
+    sizes = [int(rng.choice((2, 5, 8))) for _ in range(12)]
+    batches = [
+        rng.randint(0, 255, size=(n, INPUT_SIZE, INPUT_SIZE, 3)).astype(np.uint8)
+        for n in sizes
+    ]
+    imgs_total = int(sum(sizes))
+
+    # monolithic: the per-metric forward the host replaces — one jitted
+    # program per DISTINCT raw batch shape (the open program set)
+    module = InceptionV3()
+    mono = jax.jit(lambda p, x: module.apply(p, x)["2048"])
+
+    def run_mono():
+        for imgs in batches:
+            np.asarray(mono(params, jnp.asarray(imgs)))
+
+    host = inception_host(
+        "2048", params,
+        config=ModelHostConfig(buckets=(8,), coalesce_window_ms=0.0),
+        shared=False,
+    )
+
+    def run_host():
+        for imgs in batches:
+            host.infer(imgs)
+
+    run_mono()  # warmup: one compile per distinct size
+    run_host()  # warmup: one compile per bucket signature
+    warm_misses = host.aot.misses
+    times = _interleaved((("monolithic", run_mono), ("host", run_host)), trials)
+    steady = host.aot.misses - warm_misses
+    if steady != 0:
+        raise RuntimeError(
+            f"model_serving[inception] host compiled {steady} programs in steady "
+            "state; the closed-program contract is broken"
+        )
+
+    mono_rate, mono_spread = _rate(imgs_total, times["monolithic"])
+    host_rate, host_spread = _rate(imgs_total, times["host"])
+
+    # MFU attribution (PR 1 cost walk) over the served bucket-8 program
+    pad = np.zeros((8, INPUT_SIZE, INPUT_SIZE, 3), np.uint8)
+    attr = attribution_table(host._fwd, params, jnp.asarray(pad), depth=1)
+    flops_per_img = attr["total_flops"] / 8.0
+    host.close()
+    return {
+        "imgs_per_s": host_rate,
+        "monolithic_imgs_per_s": mono_rate,
+        "vs_monolithic": round(host_rate / mono_rate, 3) if mono_rate else None,
+        "spread_frac": {"host": host_spread, "monolithic": mono_spread},
+        "programs": {
+            "host": len(host.aot),
+            "host_compiles": warm_misses,
+            "monolithic_distinct_shapes": len(set(sizes)),
+        },
+        "compiles_steady_state": steady,
+        "flops_per_img_gflops": round(flops_per_img / 1e9, 3),
+        "achieved_tflops": round(flops_per_img * host_rate / 1e12, 4),
+        "xla_cost_flops_per_img_gflops": (
+            round(attr["xla_cost_flops"] / 8.0 / 1e9, 3)
+            if attr.get("xla_cost_flops") else None
+        ),
+        "structural_mfu_ceiling": (
+            round(attr["structural_mfu_ceiling"], 4)
+            if attr.get("structural_mfu_ceiling") else None
+        ),
+        "stream": {
+            "batches": len(batches), "imgs": imgs_total,
+            "raw_sizes": sorted(set(sizes)), "buckets": [8],
+            "input_size": INPUT_SIZE, "trials": trials,
+        },
+    }
+
+
+def bench_encoder(trials=5):
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu.engine.model_host import ModelHostConfig, encoder_host
+    from metrics_tpu.ops.profiling import attribution_table
+    from metrics_tpu.text.bert import _derive_length_buckets
+
+    dim, vocab = 64, 4096
+    rng = np.random.RandomState(20260807)
+    emb = rng.randn(vocab, dim).astype(np.float32) * 0.1
+    w1 = rng.randn(dim, 4 * dim).astype(np.float32) * 0.1
+    w2 = rng.randn(4 * dim, dim).astype(np.float32) * 0.1
+
+    def enc(ids, mask):
+        x = jnp.asarray(emb)[ids] * mask[..., None]
+        x = jnp.tanh(x @ jnp.asarray(w1)) @ jnp.asarray(w2)
+        return x * mask[..., None]
+
+    max_length = 32
+    length_buckets = _derive_length_buckets(max_length)  # the BERTScore fix
+    lengths = [int(rng.choice((5, 9, 13, 17, 21, 25, 29))) for _ in range(24)]
+    batch_rows = [int(rng.choice((3, 6, 8))) for _ in lengths]
+    batches = []
+    for L, B in zip(lengths, batch_rows):
+        ids = rng.randint(0, vocab, size=(B, L)).astype(np.int32)
+        mask = (rng.rand(B, L) > 0.1).astype(np.float32)
+        batches.append((ids, mask))
+    # one encoded sentence per row; a BERTScore pair encodes pred + target
+    pairs_total = sum(batch_rows) / 2.0
+
+    # monolithic: jit at every RAW (B, L) — per-call-max padding, the
+    # unbounded trace cache the length buckets bound (text/bert.py satellite)
+    mono = jax.jit(enc)
+
+    def run_mono():
+        for ids, mask in batches:
+            np.asarray(mono(ids, mask))
+
+    host = encoder_host(
+        forward_fn=enc,
+        config=ModelHostConfig(buckets=(8,), coalesce_window_ms=0.0),
+        fingerprint="model-bench-encoder", shared=False,
+    )
+
+    def bucket_pad(ids, mask):
+        L = ids.shape[1]
+        target = next((b for b in length_buckets if b >= L), L)
+        pad = ((0, 0), (0, target - L))
+        return np.pad(ids, pad), np.pad(mask, pad)
+
+    def run_host():
+        for ids, mask in batches:
+            host.infer(*bucket_pad(ids, mask))
+
+    run_mono()
+    run_host()
+    warm_misses = host.aot.misses
+    times = _interleaved((("monolithic", run_mono), ("host", run_host)), trials)
+    steady = host.aot.misses - warm_misses
+    if steady != 0:
+        raise RuntimeError(
+            f"model_serving[encoder] host compiled {steady} programs in steady "
+            "state; the closed-program contract is broken"
+        )
+
+    mono_rate, mono_spread = _rate(pairs_total, times["monolithic"])
+    host_rate, host_spread = _rate(pairs_total, times["host"])
+    ids8 = np.zeros((8, max_length), np.int32)
+    mask8 = np.ones((8, max_length), np.float32)
+    attr = attribution_table(lambda i, m: enc(i, m), ids8, mask8, depth=1)
+    flops_per_pair = attr["total_flops"] / 4.0  # 8 rows = 4 pairs
+    host.close()
+    return {
+        "pairs_per_s": host_rate,
+        "monolithic_pairs_per_s": mono_rate,
+        "vs_monolithic": round(host_rate / mono_rate, 3) if mono_rate else None,
+        "spread_frac": {"host": host_spread, "monolithic": mono_spread},
+        "programs": {
+            "host_compiles": warm_misses,
+            "monolithic_distinct_shapes": len({(b, l) for b, l in zip(batch_rows, lengths)}),
+            "length_buckets": list(length_buckets),
+        },
+        "compiles_steady_state": steady,
+        "flops_per_pair_gflops": round(flops_per_pair / 1e9, 4),
+        "achieved_tflops": round(flops_per_pair * host_rate / 1e12, 4),
+        "structural_mfu_ceiling": (
+            round(attr["structural_mfu_ceiling"], 4)
+            if attr.get("structural_mfu_ceiling") else None
+        ),
+        "stream": {
+            "batches": len(batches), "pairs": pairs_total,
+            "raw_lengths": sorted(set(lengths)), "raw_rows": sorted(set(batch_rows)),
+            "max_length": max_length, "trials": trials,
+        },
+    }
+
+
+def run_bench() -> dict:
+    import jax
+
+    platform = jax.devices()[0].platform
+    doc = {
+        "inception": bench_inception(),
+        "encoder": bench_encoder(),
+        "platform": platform,
+        "protocol": (
+            "ratios-in-one-run: fixed-seed ragged streams (inception: 12 uint8 "
+            f"batches of 2/5/8 imgs at {INPUT_SIZE}px; encoder: 24 token batches, "
+            "rows 3/6/8, lengths 5..29 under max_length 32), warmup pays every "
+            "compile, then interleaved (monolithic, host) timed passes — medians, "
+            "(max-min)/median spread; host = single-device ModelHost, batch "
+            "buckets (8,), encoder lengths padded to the BERTScore bucket edges; "
+            "monolithic = jit at every raw shape (the per-metric forward / "
+            "per-call-max padding the host replaces); zero steady compiles "
+            "asserted HARD on the host path; MFU attribution = PR 1 cost walk "
+            "(analytic FLOPs + XLA cross-check + structural MXU ceiling) over "
+            "the served bucket program"
+        ),
+    }
+    if platform == "cpu":
+        doc["liveness_only"] = True
+        doc["note"] = (
+            "CPU rates are liveness, not accelerator throughput; the durable "
+            "facts are the host-vs-monolithic RATIO (shared run), the closed "
+            "program set, and the zero-steady-compile assertion"
+        )
+    return doc
+
+
+def main() -> int:
+    import os
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    print(json.dumps(run_bench()))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

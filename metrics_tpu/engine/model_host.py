@@ -1,0 +1,795 @@
+"""Embedded-model serving: a shared, sharded, bucketed model host (ISSUE 19).
+
+FID's InceptionV3 and BERTScore's encoder are inference workloads living
+inside a metric — and until now they ignored everything the engine learned:
+every ``update()`` ran a monolithic forward at whatever batch shape arrived
+(fresh trace per shape), one model copy per metric instance, single device
+unless the caller hand-sharded. This module treats them as the serving
+problem they are (per "Fine-Tuning and Serving Gemma on Cloud TPU" and the
+MPMD pipeline-parallelism paper, PAPERS.md):
+
+* **One resident model, many streams.** A :class:`ModelHost` owns the params
+  (placed ONCE on the mesh with the layout its sharding mode needs) and an
+  :class:`~metrics_tpu.engine.aot.AotCache`; metric instances route feature
+  requests through it. ``shared_host`` dedupes hosts by a structural key
+  (kind, params fingerprint, tap, mesh, sharding, precision, buckets — the
+  same identity discipline as ``AotCache.program_key``), so FID and KID built
+  over the same weights resolve to ONE resident model, params shared not
+  copied.
+* **Bucketed, coalesced requests.** Incoming batches concatenate across
+  requesting streams (megabatch coalescing, same contract as the engine
+  dispatcher) and round up to a closed set of batch buckets
+  (:class:`~metrics_tpu.engine.bucketing.BucketPolicy` reused); the compiled
+  program set is at most ``len(buckets)`` per input signature — zero
+  steady-state compiles, observable on the host's cache counters.
+* **Sharded forwards.** ``mesh=`` selects the model layout: the
+  tensor-parallel stem + data-parallel trunk hybrid for Inception
+  (``parallel.embedded.stem_tensor_batch_forward`` — the padded 128-lane stem
+  of PR 1 splits evenly over the axis), GPipe ``ppermute`` pipeline stages
+  for encoders (``parallel.embedded.pipeline_stage_forward``). Each mode
+  declares its collective allowance (``allowed_collectives``) and the
+  ``host-collectives-pinned`` analysis rule audits the traced programs
+  against it — metric steady steps stay collective-free; only host stage
+  programs may carry their declared handoffs.
+* **Activation precision paths.** ``precision="f32"`` (default) is the
+  bit-exactness oracle — host features are bit-identical to the direct
+  forward. ``"bf16"`` runs the model's compute-dtype path; ``"int8"``
+  transports activations through the q8_block codec (encode→decode inside
+  the compiled program), so the error is EXACTLY the single-shard
+  ``q8_roundtrip`` and the analytic ``q8_sum_error_bound`` (W=1) bounds it.
+
+See ``docs/serving.md`` ("Embedded-model serving") for the lifecycle and the
+bucketing/precision contract; ``make model-smoke`` gates the whole path on an
+8-device virtual mesh.
+"""
+import hashlib
+import queue
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from metrics_tpu.engine.aot import AotCache, _fingerprint_value, _mesh_fingerprint
+from metrics_tpu.engine.bucketing import BucketPolicy
+
+__all__ = [
+    "ModelHost",
+    "ModelHostConfig",
+    "encoder_host",
+    "inception_host",
+    "reset_host_registry",
+    "shared_host",
+]
+
+#: activation precision policies. "f32" is the default and the bit-exactness
+#: oracle — nothing degrades unless the config says so, mirroring the
+#: SYNC_PRECISIONS contract of parallel/collectives.py.
+HOST_PRECISIONS = ("f32", "bf16", "int8")
+
+
+@dataclass(frozen=True)
+class ModelHostConfig:
+    """Serving configuration of one resident embedded model.
+
+    Args:
+        buckets: allowed padded batch sizes (ascending; oversized requests
+            split into max-bucket chunks + a bucketed remainder, exactly like
+            the engine's ingest path).
+        precision: activation path — ``"f32"`` (bit-exact oracle), ``"bf16"``
+            (compute-dtype forward), ``"int8"`` (features ride the q8_block
+            codec inside the compiled program; error bounded by
+            ``q8_sum_error_bound`` at W=1).
+        coalesce: max requests concatenated into one megabatch.
+        coalesce_window_ms: how long the worker waits for more compatible
+            requests once one is in hand (0 = serve immediately).
+        queue_depth: bound on queued requests (blocking submit = backpressure).
+        mesh / mesh_axis: run the forward model-sharded over this mesh axis
+            (the builder picks the layout: hybrid stem-tensor for Inception,
+            ppermute pipeline for encoders). None = single-device.
+        cache_dir: optional JAX persistent compilation cache directory.
+    """
+
+    buckets: Tuple[int, ...] = (8, 32)
+    precision: str = "f32"
+    coalesce: int = 8
+    coalesce_window_ms: float = 2.0
+    queue_depth: int = 64
+    mesh: Optional[Any] = None
+    mesh_axis: str = "dp"
+    cache_dir: Optional[str] = None
+
+    def __post_init__(self):
+        if self.precision not in HOST_PRECISIONS:
+            raise ValueError(
+                f"precision must be one of {HOST_PRECISIONS}, got {self.precision!r}"
+            )
+
+
+def q8_roundtrip_traced(x: Any) -> Any:
+    """In-trace q8_block encode→decode of a float array — the activation
+    transport of the ``"int8"`` precision path. By construction identical to
+    the W=1 quantized sum, so ``q8_sum_error_bound(x[None])`` bounds the
+    per-element error analytically (the same oracle the quantized collectives
+    check against)."""
+    import jax.numpy as jnp
+
+    from metrics_tpu.parallel.collectives import Q8_BLOCK, _q8_encode
+
+    orig_dtype = x.dtype
+    codes, scales = _q8_encode(x)
+    vals = codes.astype(jnp.float32).reshape(-1, Q8_BLOCK) * scales[:, None]
+    return vals.reshape(-1)[: x.size].reshape(x.shape).astype(orig_dtype)
+
+
+class _Stop:
+    pass
+
+
+_STOP = _Stop()
+
+
+class _Request:
+    __slots__ = ("args", "n", "sig", "future", "enqueued")
+
+    def __init__(self, args: Tuple[np.ndarray, ...], sig: Tuple):
+        self.args = args
+        self.n = int(args[0].shape[0])
+        self.sig = sig
+        self.future: "queue.Queue" = queue.Queue(maxsize=1)
+        self.enqueued = time.perf_counter()
+
+
+class HostStats:
+    """Thread-safe counters + throughput gauge of one host (the
+    ``model_host_*`` OpenMetrics families)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.requests = 0
+        self.items = 0
+        self.padded_items = 0
+        self.coalesced_batches = 0
+        self.batches = 0
+        self.bucket_hits: Dict[int, int] = {}
+        self.busy_seconds = 0.0
+
+    def record(self, requests: int, items: int, padded: int, buckets: Sequence[int],
+               busy: float) -> None:
+        with self._lock:
+            self.requests += requests
+            self.items += items
+            self.padded_items += padded
+            self.batches += 1
+            if requests > 1:
+                self.coalesced_batches += 1
+            for b in buckets:
+                self.bucket_hits[b] = self.bucket_hits.get(b, 0) + 1
+            self.busy_seconds += busy
+
+    def items_per_s(self) -> float:
+        with self._lock:
+            return self.items / self.busy_seconds if self.busy_seconds > 0 else 0.0
+
+
+class ModelHost:
+    """One resident embedded model served through the engine's machinery.
+
+    ``forward(params, *batch) -> features`` is a pure traceable callable
+    whose positional batch args all carry a leading batch dimension and whose
+    outputs are per-row (leading batch dim) — pad rows are sliced off before
+    results reach a caller, so no mask plumbing is needed. ``forward`` may be
+    a dict ``{precision: callable}``; missing ``"bf16"``/``"int8"`` entries
+    fall back to generic wrappers over the ``"f32"`` one (cast-in/cast-out,
+    q8 transport).
+
+    ``infer(*batch)`` is the synchronous request path (submit + wait);
+    ``submit(*batch)`` returns a waitable handle so many metric streams can
+    overlap requests — the worker thread coalesces compatible queued requests
+    into megabatches, chunks them through the bucket policy, and serves each
+    chunk with a per-(bucket signature, precision, mesh) AOT-compiled
+    executable. Steady state compiles NOTHING (the ``aot.misses`` counter is
+    the observable, same contract as the engine).
+    """
+
+    def __init__(
+        self,
+        kind: str,
+        forward: Any,
+        params: Any,
+        *,
+        config: Optional[ModelHostConfig] = None,
+        fingerprint: Optional[str] = None,
+        unit: str = "items",
+        allowed_collectives: Tuple[str, ...] = (),
+        param_shardings: Optional[Any] = None,
+        aot: Optional[AotCache] = None,
+    ) -> None:
+        import jax
+
+        self.kind = str(kind)
+        self.config = config or ModelHostConfig()
+        self.unit = str(unit)
+        self.allowed_collectives = tuple(allowed_collectives)
+        self.stats = HostStats()
+        # `is not None`: a shared-but-still-empty AotCache is falsy (len 0)
+        self.aot = aot if aot is not None else AotCache(cache_dir=self.config.cache_dir)
+        self.shared_by = 1  # bumped by shared_host on every dedup hit
+
+        fwd_map = dict(forward) if isinstance(forward, dict) else {"f32": forward}
+        base = fwd_map["f32"]
+        precision = self.config.precision
+        if precision == "bf16" and "bf16" not in fwd_map:
+            fwd_map["bf16"] = _bf16_wrap(base)
+        if precision == "int8" and "int8" not in fwd_map:
+            fwd_map["int8"] = _q8_wrap(base)
+        self._fwd = fwd_map[precision]
+
+        if fingerprint is None:
+            h = hashlib.sha256()
+            _fingerprint_value(jax.tree.leaves(params), h)
+            fingerprint = h.hexdigest()[:16]
+        self.fingerprint = str(fingerprint)
+
+        mesh = self.config.mesh
+        divisor = 1
+        if mesh is not None:
+            divisor = int(np.prod([mesh.shape[a] for a in (
+                self.config.mesh_axis if isinstance(self.config.mesh_axis, (tuple, list))
+                else (self.config.mesh_axis,))]))
+        self._policy = BucketPolicy(self.config.buckets, divisor=divisor)
+
+        # the params are RESIDENT: placed once, with the sharding mode's
+        # layout, and every compiled program reads them as a non-donated arg
+        # (rebinding host.params takes effect on the next request)
+        if param_shardings is not None:
+            params = jax.tree.map(
+                lambda x, s: jax.device_put(np.asarray(x), s), params, param_shardings
+            )
+        self.params = params
+        self._param_shardings = param_shardings
+        self._programs_abstract: Dict[Tuple, Tuple] = {}
+
+        self._queue: "queue.Queue" = queue.Queue(maxsize=self.config.queue_depth)
+        self._carry: Optional[_Request] = None
+        self._closed = False
+        self._worker_error: Optional[BaseException] = None
+        self._worker = threading.Thread(
+            target=self._run, name=f"model-host-{kind}", daemon=True
+        )
+        self._worker.start()
+
+    # ------------------------------------------------------------- request path
+
+    def submit(self, *batch: Any) -> "queue.Queue":
+        """Enqueue one feature request; returns a handle whose ``.get()``
+        yields the per-row output pytree (numpy) or raises the serving error."""
+        if self._closed:
+            raise RuntimeError(f"ModelHost({self.kind}) is closed")
+        args = tuple(np.asarray(a) for a in batch)
+        if not args or any(a.ndim == 0 for a in args):
+            raise ValueError("ModelHost.submit needs batch-carried array arguments")
+        n = args[0].shape[0]
+        if any(a.shape[0] != n for a in args):
+            raise ValueError(
+                f"ModelHost.submit: inconsistent leading dims {[a.shape for a in args]}"
+            )
+        sig = tuple((a.shape[1:], str(a.dtype)) for a in args)
+        req = _Request(args, sig)
+        self._queue.put(req)
+        return req.future
+
+    def infer(self, *batch: Any) -> Any:
+        """Synchronous feature request: submit, wait, return (or raise)."""
+        out = self.submit(*batch).get()
+        if isinstance(out, BaseException):
+            raise out
+        return out
+
+    # ------------------------------------------------------------------ worker
+
+    def _run(self) -> None:
+        while True:
+            req = self._carry or self._queue.get()
+            self._carry = None
+            if isinstance(req, _Stop):
+                return
+            group = [req]
+            rows = req.n
+            deadline = time.monotonic() + self.config.coalesce_window_ms / 1000.0
+            while (
+                len(group) < self.config.coalesce
+                and rows < self._policy.buckets[-1]
+            ):
+                timeout = deadline - time.monotonic()
+                if timeout <= 0:
+                    break
+                try:
+                    nxt = self._queue.get(timeout=timeout)
+                except queue.Empty:
+                    break
+                if isinstance(nxt, _Stop):
+                    self._carry = nxt  # serve this group, then stop
+                    break
+                if nxt.sig != req.sig:
+                    self._carry = nxt  # incompatible: its own group next round
+                    break
+                group.append(nxt)
+                rows += nxt.n
+            try:
+                self._serve(group)
+            except BaseException as e:  # noqa: BLE001 — delivered to waiters
+                self._worker_error = e
+                for r in group:
+                    r.future.put(e)
+
+    def _serve(self, group: List[_Request]) -> None:
+        import jax
+
+        n_args = len(group[0].args)
+        if len(group) == 1:
+            mega = group[0].args
+        else:
+            mega = tuple(
+                np.concatenate([r.args[i] for r in group], axis=0)
+                for i in range(n_args)
+            )
+        total = int(mega[0].shape[0])
+        t0 = time.perf_counter()
+        chunk_outs: List[Any] = []
+        buckets_used: List[int] = []
+        padded = 0
+        for start, stop, bucket in self._policy.chunks(total):
+            a, _kw, _mask = self._policy.pad_chunk(mega, {}, start, stop, bucket)
+            padded += bucket - (stop - start)
+            buckets_used.append(bucket)
+            program = self._program(a)
+            a = self._place(a)
+            out = program(self.params, *a)
+            # blocking conversion: serializes collective-bearing executions on
+            # CPU virtual meshes (same rationale as shard_batch_forward) and
+            # closes the async dispatch before results are distributed
+            out = jax.tree.map(lambda o: np.asarray(o)[: stop - start], out)
+            chunk_outs.append(out)
+        merged = (
+            chunk_outs[0]
+            if len(chunk_outs) == 1
+            else jax.tree.map(lambda *xs: np.concatenate(xs, axis=0), *chunk_outs)
+        )
+        self.stats.record(
+            len(group), total, padded, buckets_used, time.perf_counter() - t0
+        )
+        off = 0
+        for r in group:
+            r.future.put(jax.tree.map(lambda o: o[off:off + r.n], merged))
+            off += r.n
+
+    # ---------------------------------------------------------------- programs
+
+    def _program(self, padded_args: Tuple[np.ndarray, ...]):
+        import jax
+
+        key = self.aot.program_key(
+            f"model_host_{self.kind}",
+            self.fingerprint,
+            arg_tree=padded_args,
+            mesh=self.config.mesh,
+            sync="host",
+            precision=self.config.precision,
+        )
+
+        def build():
+            params_abs = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(
+                    np.shape(x), x.dtype, sharding=getattr(x, "sharding", None)
+                ),
+                self.params,
+            )
+            args_abs = tuple(
+                jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=self._replicated())
+                for a in padded_args
+            )
+            self._programs_abstract[key] = (params_abs, args_abs)
+            return jax.jit(self._fwd).lower(params_abs, *args_abs).compile()
+
+        return self.aot.get_or_compile(key, build)
+
+    def _replicated(self):
+        if self.config.mesh is None:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return NamedSharding(self.config.mesh, P())
+
+    def _place(self, args: Tuple[np.ndarray, ...]) -> Tuple:
+        if self.config.mesh is None:
+            return args
+        import jax
+
+        rep = self._replicated()
+        return tuple(jax.device_put(a, rep) for a in args)
+
+    def host_programs(self) -> Dict[Tuple, Tuple[Callable, Tuple]]:
+        """``{program_key: (traceable_fn, (params_abs, args_abs))}`` for every
+        compiled program — the analysis plane re-traces these to audit the
+        collective allowance (``host-collectives-pinned``)."""
+        return {
+            key: (self._fwd, abstract)
+            for key, abstract in self._programs_abstract.items()
+        }
+
+    # --------------------------------------------------------------- telemetry
+
+    def counters(self) -> Dict[str, int]:
+        s = self.stats
+        return {
+            "requests": s.requests,
+            "items": s.items,
+            "padded_items": s.padded_items,
+            "batches": s.batches,
+            "coalesced_batches": s.coalesced_batches,
+            "bucket_hits": self.aot.hits,
+            "bucket_compiles": self.aot.misses,
+            "shared_by": self.shared_by,
+        }
+
+    def telemetry(self) -> Dict[str, Any]:
+        """One JSON-able snapshot (the ``model_host`` section of an engine
+        telemetry doc — ``tools/engine_report.py`` renders it as a row)."""
+        return {
+            "kind": self.kind,
+            "unit": self.unit,
+            "precision": self.config.precision,
+            "buckets": list(self._policy.buckets),
+            "sharding": "none" if self.config.mesh is None else "mesh",
+            "allowed_collectives": list(self.allowed_collectives),
+            "counters": self.counters(),
+            "bucket_hit_histogram": {str(k): v for k, v in sorted(self.stats.bucket_hits.items())},
+            "items_per_s": self.stats.items_per_s(),
+            "busy_seconds": self.stats.busy_seconds,
+            "aot": self.aot.stats(),
+        }
+
+    def metrics_text(self) -> str:
+        """OpenMetrics exposition of the ``model_host_*`` families."""
+        from metrics_tpu.engine.trace import render_openmetrics
+
+        counters = self.counters()
+        requests = counters.pop("requests")
+        return render_openmetrics(
+            counters,
+            labeled_counters={
+                # the activation-precision label rides the requests family
+                "requests": (
+                    "precision", {self.config.precision: requests}
+                ),
+            },
+            gauges={f"{self.unit}_per_s": self.stats.items_per_s()},
+            prefix="metrics_tpu_model_host_",
+        )
+
+    # --------------------------------------------------------------- lifecycle
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._queue.put(_STOP)
+        self._worker.join(timeout=30)
+
+    def __enter__(self) -> "ModelHost":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def _bf16_wrap(base: Callable) -> Callable:
+    """Generic bf16 activation path: float inputs cast to bf16 on the way in,
+    float outputs restored to their original dtype on the way out (model
+    builders that have a native compute-dtype knob pass their own ``"bf16"``
+    forward instead — e.g. the Inception host)."""
+    import jax
+    import jax.numpy as jnp
+
+    def fwd(params, *batch):
+        cast = tuple(
+            b.astype(jnp.bfloat16) if jnp.issubdtype(b.dtype, jnp.floating) else b
+            for b in batch
+        )
+        out = base(params, *cast)
+        return jax.tree.map(
+            lambda o: o.astype(jnp.float32)
+            if jnp.issubdtype(o.dtype, jnp.floating) else o,
+            out,
+        )
+
+    return fwd
+
+
+def _q8_wrap(base: Callable) -> Callable:
+    """Generic int8 activation-transport path: the f32 forward runs exactly,
+    then every float output rides the q8_block codec (encode→decode) inside
+    the compiled program — the error is the single-shard roundtrip, bounded
+    by ``q8_sum_error_bound`` at W=1."""
+    import jax
+    import jax.numpy as jnp
+
+    def fwd(params, *batch):
+        out = base(params, *batch)
+        return jax.tree.map(
+            lambda o: q8_roundtrip_traced(o)
+            if jnp.issubdtype(o.dtype, jnp.floating) else o,
+            out,
+        )
+
+    return fwd
+
+
+# ------------------------------------------------------------- shared registry
+
+_REGISTRY: Dict[Tuple, ModelHost] = {}
+_REGISTRY_LOCK = threading.Lock()
+
+
+def shared_host(key: Tuple, factory: Callable[[], ModelHost]) -> ModelHost:
+    """Resolve ``key`` to ONE resident host: the first caller builds it, every
+    later caller with the same structural key gets the SAME instance (params
+    shared, not copied) with ``shared_by`` bumped. Closed hosts are evicted
+    and rebuilt."""
+    with _REGISTRY_LOCK:
+        host = _REGISTRY.get(key)
+        if host is not None and not host._closed:
+            host.shared_by += 1
+            return host
+        host = factory()
+        _REGISTRY[key] = host
+        return host
+
+
+def reset_host_registry() -> None:
+    """Close and drop every registered host (test isolation)."""
+    with _REGISTRY_LOCK:
+        hosts = list(_REGISTRY.values())
+        _REGISTRY.clear()
+    for h in hosts:
+        h.close()
+
+
+# ------------------------------------------------------------- model builders
+
+
+def inception_host(
+    feature: str = "2048",
+    params: Optional[Any] = None,
+    *,
+    config: Optional[ModelHostConfig] = None,
+    input_size: int = 299,
+    seed: int = 0,
+    stem_lanes: Optional[int] = None,
+    shared: bool = True,
+) -> ModelHost:
+    """Build (or resolve from the registry) the resident InceptionV3 host.
+
+    Single-device: the canonical module forward, jitted per bucket —
+    ``precision="f32"`` features are bit-identical to
+    ``InceptionFeatureExtractor``'s. With ``config.mesh``: the hybrid layout —
+    tensor-parallel stem over PR 1's padded 128-lane params (each leaf
+    channel-sharded), data-parallel trunk — whose only collective is
+    ``all_gather``. ``precision="bf16"`` uses the module's native
+    compute-dtype path; ``"int8"`` transports the tap features through the
+    q8_block codec.
+
+    ``shared=True`` routes through :func:`shared_host`: FID and KID built
+    over the same (tap, weights, mesh, precision, buckets) get ONE model.
+    """
+    import jax
+
+    from metrics_tpu.models.inception import FEATURE_DIMS, random_inception_params
+
+    feature = str(feature)
+    if feature not in FEATURE_DIMS:
+        raise ValueError(
+            f"feature must be one of {tuple(FEATURE_DIMS)}, got {feature!r}"
+        )
+    config = config or ModelHostConfig()
+    if params is None:
+        from metrics_tpu.utils.prints import rank_zero_warn
+
+        rank_zero_warn(
+            "No pretrained InceptionV3 params provided (no network egress in this"
+            " build); the model host is using random initialisation. Pass `params=`"
+            " (converted torch-fidelity weights) for meaningful FID/KID values.",
+            UserWarning,
+        )
+        params = random_inception_params(input_size=input_size, seed=seed)
+    if config.mesh is not None and stem_lanes is None:
+        stem_lanes = 128  # PR 1's MXU layout doubles as the tensor-shard grain
+
+    h = hashlib.sha256()
+    _fingerprint_value(jax.tree.leaves(params), h)
+    fp = h.hexdigest()[:16]
+    key = (
+        "inception", feature, fp, _mesh_fingerprint(config.mesh),
+        "stem_tensor" if config.mesh is not None else "single",
+        config.precision, tuple(config.buckets), stem_lanes,
+    )
+
+    def factory() -> ModelHost:
+        return _build_inception_host(feature, params, config, stem_lanes, fp)
+
+    return shared_host(key, factory) if shared else factory()
+
+
+def _build_inception_host(
+    feature: str, params: Any, config: ModelHostConfig,
+    stem_lanes: Optional[int], fp: str,
+) -> ModelHost:
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from metrics_tpu.models.inception import (
+        InceptionV3, pad_stem_params, split_stem_variables, stem_apply,
+    )
+
+    def _nchw(fwd):
+        def wrapped(p, imgs):
+            if imgs.ndim == 4 and imgs.shape[1] == 3 and imgs.shape[-1] != 3:
+                imgs = jnp.transpose(imgs, (0, 2, 3, 1))
+            return fwd(p, imgs)
+
+        return wrapped
+
+    if config.mesh is None:
+        def module_fwd(dtype):
+            m = InceptionV3(compute_dtype=dtype, stem_lanes=stem_lanes)
+
+            def fwd(p, imgs):
+                if stem_lanes is not None:
+                    p = pad_stem_params(p, stem_lanes)
+                return m.apply(p, imgs)[feature].astype(jnp.float32)
+
+            return _nchw(fwd)
+
+        return ModelHost(
+            "inception", {"f32": module_fwd(None), "bf16": module_fwd(jnp.bfloat16)},
+            params, config=config, fingerprint=fp, unit="imgs",
+            allowed_collectives=(),
+        )
+
+    # hybrid stem-tensor + trunk-batch layout: params split host-side ONCE
+    # (pad applied eagerly so the resident leaves are the sharded ones)
+    from metrics_tpu.parallel.embedded import stem_tensor_batch_forward
+
+    mesh, axis = config.mesh, config.mesh_axis
+    stem_v, trunk_v = split_stem_variables(
+        jax.tree.map(np.asarray, pad_stem_params(params, stem_lanes))
+    )
+    host_params = {"stem": stem_v, "trunk": trunk_v}
+
+    def _stem_shard(leaf):
+        nd = np.ndim(leaf)
+        return NamedSharding(mesh, P(*([None] * (nd - 1) + [axis])) if nd else P())
+
+    shardings = {
+        "stem": jax.tree.map(_stem_shard, stem_v),
+        "trunk": jax.tree.map(lambda _: NamedSharding(mesh, P()), trunk_v),
+    }
+
+    def hybrid_fwd(dtype):
+        trunk = InceptionV3(compute_dtype=dtype, stem_input=True)
+
+        def stem_fn(sv, x, gather_axis):
+            return stem_apply(
+                sv, x, compute_dtype=dtype, stem_lanes=stem_lanes,
+                gather_axis=gather_axis,
+            )
+
+        def trunk_fn(tv, xl):
+            return dict(trunk.apply(tv, xl))
+
+        sharded = stem_tensor_batch_forward(stem_fn, trunk_fn, mesh, axis)
+
+        def fwd(p, imgs):
+            return sharded(p["stem"], p["trunk"], imgs)[feature].astype(jnp.float32)
+
+        return _nchw(fwd)
+
+    return ModelHost(
+        "inception", {"f32": hybrid_fwd(None), "bf16": hybrid_fwd(jnp.bfloat16)},
+        host_params, config=config, fingerprint=fp, unit="imgs",
+        allowed_collectives=("all_gather",), param_shardings=shardings,
+    )
+
+
+def encoder_host(
+    forward_fn: Optional[Callable] = None,
+    *,
+    stage_fn: Optional[Callable] = None,
+    stage_params: Optional[Any] = None,
+    embed_fn: Optional[Callable] = None,
+    config: Optional[ModelHostConfig] = None,
+    fingerprint: Optional[str] = None,
+    shared: bool = True,
+) -> ModelHost:
+    """Build (or resolve) the resident text-encoder host for BERTScore.
+
+    Two layouts:
+
+    * ``forward_fn(input_ids, attention_mask) -> (B, L, D)`` — any encoder
+      callable (the current BERTScore forward contract), served single-device
+      through the host's bucketing/coalescing/AOT machinery.
+    * ``stage_fn`` + ``stage_params`` (+ optional ``embed_fn(ids, mask)``) —
+      a pipeline-decomposed encoder: stage params stacked ``(S, ...)`` and
+      dim-0-sharded over ``config.mesh``'s axis, activations handed off with
+      ``ppermute`` (``parallel.embedded.pipeline_stage_forward``, the MPMD
+      layout). The ONLY collective the host program may carry is
+      ``ppermute`` — pinned by the ``host-collectives-pinned`` rule.
+    """
+    import jax
+
+    config = config or ModelHostConfig()
+    if (forward_fn is None) == (stage_fn is None):
+        raise ValueError("encoder_host needs exactly one of forward_fn / stage_fn")
+
+    if stage_fn is not None:
+        if config.mesh is None:
+            raise ValueError("pipeline-staged encoder_host needs config.mesh")
+        if stage_params is None:
+            raise ValueError("stage_fn needs stage_params (stacked (S, ...) pytree)")
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from metrics_tpu.parallel.embedded import pipeline_stage_forward
+
+        mesh, axis = config.mesh, config.mesh_axis
+        pipe = pipeline_stage_forward(stage_fn, mesh, axis)
+
+        def fwd(p, ids, mask):
+            x = embed_fn(ids, mask) if embed_fn is not None else ids
+            return pipe(p, x)
+
+        if fingerprint is None:
+            h = hashlib.sha256()
+            _fingerprint_value(jax.tree.leaves(stage_params), h)
+            if embed_fn is not None:
+                h.update(getattr(embed_fn, "__qualname__", repr(embed_fn)).encode())
+            fingerprint = h.hexdigest()[:16]
+        key = (
+            "encoder", fingerprint, _mesh_fingerprint(mesh), "pipeline",
+            config.precision, tuple(config.buckets),
+        )
+        shardings = jax.tree.map(
+            lambda _: NamedSharding(mesh, P(axis)), stage_params
+        )
+
+        def factory() -> ModelHost:
+            return ModelHost(
+                "encoder", fwd, stage_params, config=config,
+                fingerprint=fingerprint, unit="pairs",
+                allowed_collectives=("ppermute",), param_shardings=shardings,
+            )
+
+        return shared_host(key, factory) if shared else factory()
+
+    if fingerprint is None:
+        fingerprint = getattr(
+            forward_fn, "__qualname__", type(forward_fn).__name__
+        ) + f"@{id(forward_fn):x}"
+
+    def fwd(_params, ids, mask):
+        return forward_fn(ids, mask)
+
+    key = (
+        "encoder", fingerprint, _mesh_fingerprint(config.mesh), "single",
+        config.precision, tuple(config.buckets),
+    )
+
+    def factory() -> ModelHost:
+        return ModelHost(
+            "encoder", fwd, (), config=config, fingerprint=fingerprint,
+            unit="pairs", allowed_collectives=(),
+        )
+
+    return shared_host(key, factory) if shared else factory()

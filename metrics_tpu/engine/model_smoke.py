@@ -1,0 +1,299 @@
+"""Embedded-model serving smoke: ``python -m metrics_tpu.engine.model_smoke``.
+
+The CPU-safe gate for the ISSUE 19 model-host stack (``make model-smoke``),
+on the bootstrap 8-device virtual mesh:
+
+1. sharded-vs-single parity — the hybrid Inception layout (tensor-parallel
+   128-lane stem + data-parallel trunk, ``all_gather``-only) serves features
+   matching the single-device host within float tolerance, and the
+   pipeline-staged encoder (``ppermute``-only GPipe handoff) is BIT-exact vs
+   the sequential stage fold; the single-device f32 host is BIT-exact vs the
+   direct module forward at the bucket shape;
+2. shared-host dedupe — ``FID`` and ``KID`` built over the same (tap, params
+   fingerprint, precision, buckets) resolve to ONE resident host
+   (``shared_by == 2``) whose param buffers are the same objects;
+3. zero steady compiles — replaying the same traffic mix over a warmed host
+   compiles NOTHING (the ``AotCache`` miss counter is the observable, same
+   contract as every engine gate);
+4. collective allowance — the ``host-collectives-pinned`` rule audits every
+   compiled host program clean (hybrid may only ``all_gather``, pipeline may
+   only ``ppermute``), and the OpenMetrics ``model_host_*`` families parse
+   through the strict parser with the activation-precision label;
+5. kill/resume with a host attached — a snapshotting engine fed by a host is
+   killed after a snapshot boundary, a FRESH engine (fresh host) restores
+   and replays the remainder: the result is bit-identical to the
+   uninterrupted run.
+
+Prints one PASS line; exits nonzero on any violated claim. Optional argv:
+an output path for the host telemetry JSON (``out/model_telemetry.json``).
+"""
+import os
+import subprocess
+import sys
+
+NUM_DEVICES = 8
+INPUT_SIZE = 75  # smallest viable InceptionV3 input: CPU-cheap compiles
+
+
+def _bootstrap() -> int:
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + f" --xla_force_host_platform_device_count={NUM_DEVICES}"
+    )
+    env["JAX_PLATFORMS"] = "cpu"
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu'); "
+        "import sys; from metrics_tpu.engine.model_smoke import _impl; "
+        "sys.exit(_impl(sys.argv[1] if len(sys.argv) > 1 else None))"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code] + sys.argv[1:], env=env, timeout=900
+    )
+    return proc.returncode
+
+
+def _impl(out_path=None) -> int:
+    import json
+    import tempfile
+
+    import numpy as np
+
+    import jax
+    from jax.sharding import Mesh
+
+    from metrics_tpu import MeanSquaredError
+    from metrics_tpu.analysis.rules import check_host_collectives_pinned
+    from metrics_tpu.engine import (
+        EngineConfig,
+        ModelHostConfig,
+        StreamingEngine,
+        encoder_host,
+        inception_host,
+        reset_host_registry,
+    )
+    from metrics_tpu.models.inception import random_inception_params
+
+    devs = jax.devices()
+    if len(devs) < NUM_DEVICES:
+        print(f"FAIL: need {NUM_DEVICES} devices, have {len(devs)}")
+        return 1
+    mesh = Mesh(np.asarray(devs[:NUM_DEVICES]), ("dp",))
+    ok = True
+    telemetry = {}
+    reset_host_registry()
+
+    rng = np.random.RandomState(0)
+    params = random_inception_params(input_size=INPUT_SIZE, seed=0, fast=True)
+    img_batches = [
+        rng.randint(0, 255, size=(n, INPUT_SIZE, INPUT_SIZE, 3)).astype(np.uint8)
+        for n in (5, 8, 3, 6)
+    ]
+
+    # ---- 1a. single-device f32 host is BIT-exact vs the direct module forward
+    import jax.numpy as jnp
+
+    from metrics_tpu.models.inception import InceptionV3
+
+    single = inception_host(
+        "2048", params, config=ModelHostConfig(buckets=(8,), coalesce_window_ms=0.0),
+        shared=False,
+    )
+    module = InceptionV3()
+    direct = jax.jit(lambda p, x: module.apply(p, x)["2048"])
+    single_feats, direct_feats = [], []
+    for imgs in img_batches:
+        single_feats.append(np.asarray(single.infer(imgs)))
+        # the bit-exactness contract holds at the SAME padded (bucket) shape:
+        # conv rows are independent, so valid rows of the padded program match
+        pad = np.zeros((8,) + imgs.shape[1:], imgs.dtype)
+        pad[: imgs.shape[0]] = imgs
+        direct_feats.append(
+            np.asarray(direct(params, jnp.asarray(pad)))[: imgs.shape[0]].astype(np.float32)
+        )
+    if not all(np.array_equal(a, b) for a, b in zip(single_feats, direct_feats)):
+        print("FAIL: single-device f32 host features not bit-identical to the module forward")
+        ok = False
+
+    # ---- 1b. hybrid stem-tensor layout on the 8-device mesh: float parity
+    hybrid = inception_host(
+        "2048", params,
+        config=ModelHostConfig(buckets=(8,), mesh=mesh, coalesce_window_ms=0.0),
+        shared=False,
+    )
+    for imgs, want in zip(img_batches, single_feats):
+        got = np.asarray(hybrid.infer(imgs))
+        if not np.allclose(got, want, rtol=1e-4, atol=1e-5):
+            print(
+                "FAIL: hybrid sharded features diverge from single-device: "
+                f"max abs diff {float(np.abs(got - want).max()):.3e}"
+            )
+            ok = False
+            break
+
+    # ---- 1c. pipeline-staged encoder: BIT-exact vs the sequential stage fold
+    dim = 16
+    stage_w = rng.randn(NUM_DEVICES, dim, dim).astype(np.float32) * 0.3
+
+    def stage_fn(w, x):
+        return jnp.tanh(x @ w)
+
+    pipe = encoder_host(
+        stage_fn=stage_fn, stage_params=stage_w,
+        config=ModelHostConfig(buckets=(8,), mesh=mesh, coalesce_window_ms=0.0),
+        fingerprint="model-smoke-pipeline", shared=False,
+    )
+    ids = rng.randn(13, dim).astype(np.float32)
+    got = np.asarray(pipe.infer(ids, np.ones_like(ids)))
+    want = ids
+    for s in range(NUM_DEVICES):
+        want = np.asarray(jax.jit(stage_fn)(stage_w[s], jnp.asarray(want)))
+    if not np.array_equal(got, want):
+        print(
+            "FAIL: pipeline encoder not bit-exact vs sequential stages: "
+            f"max abs diff {float(np.abs(got - want).max()):.3e}"
+        )
+        ok = False
+
+    # ---- 2. shared-host dedupe: FID + KID over the same weights -> ONE model
+    from metrics_tpu.image.fid import FID
+    from metrics_tpu.image.kid import KID
+
+    shared_cfg = ModelHostConfig(buckets=(8,), coalesce_window_ms=0.0)
+    fid = FID(feature=2048, params=params, model_host=shared_cfg)
+    kid = KID(feature=2048, params=params, subsets=2, subset_size=4, model_host=shared_cfg)
+    if fid.model_host is not kid.model_host:
+        print("FAIL: FID and KID over the same weights built TWO hosts")
+        ok = False
+    elif fid.model_host.counters()["shared_by"] != 2:
+        print(f"FAIL: shared_by = {fid.model_host.counters()['shared_by']}, expected 2")
+        ok = False
+    leaves_a = jax.tree.leaves(fid.model_host.params)
+    leaves_b = jax.tree.leaves(kid.model_host.params)
+    if not all(a is b for a, b in zip(leaves_a, leaves_b)):
+        print("FAIL: shared host param buffers are copies, not the same objects")
+        ok = False
+    fid.update(img_batches[1], real=True)
+    fid.update(img_batches[3], real=False)
+    kid.update(img_batches[1], real=True)
+    kid.update(img_batches[3], real=False)
+    float(fid.compute())
+    kid.compute()
+
+    # ---- 3. zero steady compiles: replay the warm traffic mix
+    for host, batches in ((single, img_batches), (hybrid, img_batches)):
+        warm = host.aot.misses
+        for imgs in batches:
+            host.infer(imgs)
+        steady = host.aot.misses - warm
+        if steady != 0:
+            print(f"FAIL: warm {host.kind} host compiled {steady} programs (expected 0)")
+            ok = False
+    warm = pipe.aot.misses
+    pipe.infer(ids, np.ones_like(ids))
+    if pipe.aot.misses - warm != 0:
+        print("FAIL: warm pipeline host recompiled on replay")
+        ok = False
+
+    # ---- 4a. collective allowance: the named rule, same path as make analyze
+    for tag, host in (("single", single), ("hybrid", hybrid), ("pipeline", pipe)):
+        findings = check_host_collectives_pinned(host, where=f"model-smoke/{tag}")
+        if findings:
+            for f in findings:
+                print(f"FAIL: {f.render()}")
+            ok = False
+
+    # ---- 4b. OpenMetrics model_host_* families through the strict parser
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+    from tools.trace_export import parse_openmetrics
+
+    fams = parse_openmetrics(hybrid.metrics_text())
+    req = fams.get("metrics_tpu_model_host_requests")
+    precisions = (
+        {s["labels"].get("precision") for s in req["samples"]} if req else set()
+    )
+    if precisions != {"f32"}:
+        print(f"FAIL: model_host_requests precision labels wrong: {precisions}")
+        ok = False
+    for fam in ("items", "coalesced_batches", "bucket_hits", "bucket_compiles"):
+        if f"metrics_tpu_model_host_{fam}" not in fams:
+            print(f"FAIL: model_host_{fam} family missing from the exposition")
+            ok = False
+    if "metrics_tpu_model_host_imgs_per_s" not in fams:
+        print("FAIL: imgs_per_s gauge missing from the exposition")
+        ok = False
+
+    # ---- 5. kill/resume with a host attached: snapshot mid-stream, restore
+    # into a FRESH engine + FRESH host, replay the remainder -> bit-identical
+    feat_batches = [
+        (np.asarray(single.infer(imgs)).mean(axis=1), np.linspace(0.0, 1.0, imgs.shape[0]).astype(np.float32))
+        for imgs in img_batches
+    ]
+    snapdir = tempfile.mkdtemp(prefix="model_smoke_")
+    cfg = EngineConfig(buckets=(8,), snapshot_every=3, snapshot_dir=snapdir, coalesce=1)
+    eng = StreamingEngine(MeanSquaredError(), cfg)
+    eng.model_host = single
+    with eng:
+        for f, t in feat_batches:
+            eng.submit(f, t)
+        want_mse = float(eng.result())
+    fresh_host = inception_host(
+        "2048", params, config=ModelHostConfig(buckets=(8,), coalesce_window_ms=0.0),
+        shared=False,
+    )
+    fresh = StreamingEngine(MeanSquaredError(), cfg)
+    fresh.model_host = fresh_host
+    meta = fresh.restore(snapdir)
+    done = int(meta["batches_done"])
+    if not 0 < done < len(feat_batches):
+        print(f"FAIL: snapshot covers {done} batches — kill point not mid-stream")
+        ok = False
+    with fresh:
+        for imgs in img_batches[done:]:
+            f = np.asarray(fresh_host.infer(imgs)).mean(axis=1)
+            t = np.linspace(0.0, 1.0, imgs.shape[0]).astype(np.float32)
+            fresh.submit(f, t)
+        resumed_mse = float(fresh.result())
+    if resumed_mse != want_mse:
+        print(f"FAIL: kill/resume with a host attached diverged: {resumed_mse} vs {want_mse}")
+        ok = False
+
+    telemetry = {
+        "single": single.telemetry(),
+        "hybrid": hybrid.telemetry(),
+        "pipeline": pipe.telemetry(),
+        "shared": fid.model_host.telemetry(),
+    }
+    if out_path:
+        os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+        with open(out_path, "w") as fh:
+            json.dump(telemetry, fh, indent=2, sort_keys=True)
+
+    for h in (single, hybrid, pipe, fresh_host):
+        h.close()
+    reset_host_registry()
+
+    if ok:
+        print(
+            "model-smoke PASS: single f32 host bit-exact vs module forward, hybrid "
+            "8-way stem-tensor parity, pipeline encoder bit-exact vs sequential "
+            "stages, FID+KID share one resident model (params shared), zero steady "
+            "compiles on warm replay, host-collectives-pinned clean, model_host_* "
+            "OpenMetrics strict-parse OK, kill/resume with a host attached exact"
+            + (f", telemetry -> {out_path}" if out_path else "")
+        )
+    return 0 if ok else 1
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    out_path = sys.argv[1] if len(sys.argv) > 1 else None
+    if len(jax.devices()) < NUM_DEVICES:
+        return _bootstrap()
+    return _impl(out_path)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1756,17 +1756,35 @@ class StreamingEngine:
         """The flight recorder this engine reports spans to (None = off)."""
         return self._trace
 
+    def _model_host_sections(self) -> Optional[List[Dict[str, Any]]]:
+        """Telemetry snapshots of attached embedded-model hosts (ISSUE 19).
+
+        Attachment is by plain attribute (``engine.model_host = host`` or
+        ``engine.model_hosts = [..]``) — the same contract the analysis
+        plane's ``host-collectives-pinned`` audit discovers hosts by."""
+        hosts = getattr(self, "model_hosts", None)
+        if not hosts:
+            host = getattr(self, "model_host", None)
+            hosts = [host] if host is not None else []
+        return [h.telemetry() for h in hosts] or None
+
     def telemetry(self) -> Dict[str, Any]:
         doc = self._stats.summary(self._aot.stats())
         if self._trace is not None:
             doc["trace"] = self._trace.summary()
+        hosts = self._model_host_sections()
+        if hosts:
+            doc["model_host"] = hosts
         return doc
 
     def export_telemetry(self, path: str) -> None:
-        extra = (
-            {"trace": self._trace.summary()} if self._trace is not None else None
-        )
-        self._stats.export(path, self._aot.stats(), extra=extra)
+        extra: Dict[str, Any] = {}
+        if self._trace is not None:
+            extra["trace"] = self._trace.summary()
+        hosts = self._model_host_sections()
+        if hosts:
+            extra["model_host"] = hosts
+        self._stats.export(path, self._aot.stats(), extra=extra or None)
 
     def export_trace(self, path: str) -> str:
         """Write the flight recorder's Chrome/Perfetto trace-event JSON to

@@ -131,6 +131,7 @@ class FID(Metric):
         streaming: Optional[bool] = None,
         mesh: Optional[Any] = None,
         mesh_axis: Any = "dp",
+        model_host: Optional[Any] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -139,9 +140,14 @@ class FID(Metric):
         # mesh: run the inception forward batch-parallel over the mesh's data
         # axis (params replicated) — the sharded embedded-model path
         # (parallel/embedded.py); IS/KID share the same ctor logic.
+        # model_host: serve the forward from a shared resident ModelHost
+        # (bucketed, coalesced, AOT-cached; engine/model_host.py) — metrics
+        # with the same (tap, params, mesh, precision) share one model copy.
         self.inception, builtin_dim = resolve_feature_extractor(
-            "FID", feature, params, mesh, mesh_axis, ("64", "192", "768", "2048")
+            "FID", feature, params, mesh, mesh_axis, ("64", "192", "768", "2048"),
+            model_host=model_host,
         )
+        self.model_host = getattr(self.inception, "model_host", None)
         if feature_dim is None:
             feature_dim = builtin_dim
 

@@ -61,14 +61,19 @@ class KID(Metric):
         seed: Optional[int] = None,
         mesh: Optional[Any] = None,
         mesh_axis: Any = "dp",
+        model_host: Optional[Any] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
         from metrics_tpu.models.inception import resolve_feature_extractor
 
+        # model_host: share a resident serving host with other metrics on the
+        # same (tap, params fingerprint) — see engine/model_host.py.
         self.inception, _ = resolve_feature_extractor(
-            "KID", feature, params, mesh, mesh_axis, ("64", "192", "768", "2048")
+            "KID", feature, params, mesh, mesh_axis, ("64", "192", "768", "2048"),
+            model_host=model_host,
         )
+        self.model_host = getattr(self.inception, "model_host", None)
 
         if not (isinstance(subsets, int) and subsets > 0):
             raise ValueError("Argument `subsets` expected to be integer larger than 0")

@@ -181,25 +181,17 @@ class InceptionV3(nn.Module):
     # exactly zero through BN (scale=0) and relu, and the '64' tap slices back
     # to the logical width, so features are unchanged
     stem_lanes: Optional[int] = None
+    # consume a POST-STEM activation (N, H', W', 192) instead of images: the
+    # preprocessing + 5 stem convs + 2 pools are skipped entirely (run them
+    # with ``stem_apply``, e.g. channel-tensor-sharded under a mesh) and only
+    # the trunk taps ('768', '2048', 'logits_unbiased') are returned. Flax
+    # auto-names are per-class counters, so the trunk blocks keep their
+    # canonical names (InceptionA_0, ...) and the same params apply — filter
+    # the stem layers out with ``split_stem_variables`` first.
+    stem_input: bool = False
 
     @nn.compact
     def __call__(self, x: Array) -> Dict[str, Array]:
-        # torch-fidelity normalisation is (x - 128) / 128 on the 0..255 scale
-        # (NOT the symmetric 2x/255 - 1): uint8 255 maps to 0.9921875. Floats
-        # are taken as [0, 1] and quantised by truncation — the same
-        # `(imgs * 255).byte()` rule torchmetrics applies before this graph —
-        # so both input kinds produce identical features. With
-        # ``preprocess_folded`` the conv consumes the raw 0..255 scale (values
-        # exactly representable in bf16) and the affine lives in the params.
-        if x.dtype == jnp.uint8:
-            x = x.astype(jnp.float32)
-        else:
-            x = jnp.floor(x * 255.0)
-        if not self.preprocess_folded:
-            x = (x - 128.0) / 128.0
-        if self.compute_dtype is not None:
-            x = x.astype(self.compute_dtype)
-
         dt = self.compute_dtype
         BasicConv2d = partial(_BasicConv2d, dtype=dt)
         lanes = self.stem_lanes
@@ -214,16 +206,37 @@ class InceptionV3(nn.Module):
             return jnp.mean(v.astype(jnp.float32), axis=(1, 2))
 
         out: Dict[str, Array] = {}
-        x = BasicConv2d(st(32), (3, 3), strides=(2, 2))(x)
-        x = BasicConv2d(st(32), (3, 3))(x)
-        x = BasicConv2d(st(64), (3, 3), padding="SAME")(x)
-        x = _max_pool(x, 3, 2)
-        out["64"] = tap_mean(x[..., :64] if lanes is not None else x)
+        if self.stem_input:
+            if dt is not None:
+                x = x.astype(dt)
+        else:
+            # torch-fidelity normalisation is (x - 128) / 128 on the 0..255
+            # scale (NOT the symmetric 2x/255 - 1): uint8 255 maps to
+            # 0.9921875. Floats are taken as [0, 1] and quantised by
+            # truncation — the same `(imgs * 255).byte()` rule torchmetrics
+            # applies before this graph — so both input kinds produce
+            # identical features. With ``preprocess_folded`` the conv consumes
+            # the raw 0..255 scale (values exactly representable in bf16) and
+            # the affine lives in the params.
+            if x.dtype == jnp.uint8:
+                x = x.astype(jnp.float32)
+            else:
+                x = jnp.floor(x * 255.0)
+            if not self.preprocess_folded:
+                x = (x - 128.0) / 128.0
+            if dt is not None:
+                x = x.astype(dt)
 
-        x = BasicConv2d(st(80), (1, 1))(x)
-        x = BasicConv2d(192, (3, 3))(x)
-        x = _max_pool(x, 3, 2)
-        out["192"] = tap_mean(x)
+            x = BasicConv2d(st(32), (3, 3), strides=(2, 2))(x)
+            x = BasicConv2d(st(32), (3, 3))(x)
+            x = BasicConv2d(st(64), (3, 3), padding="SAME")(x)
+            x = _max_pool(x, 3, 2)
+            out["64"] = tap_mean(x[..., :64] if lanes is not None else x)
+
+            x = BasicConv2d(st(80), (1, 1))(x)
+            x = BasicConv2d(192, (3, 3))(x)
+            x = _max_pool(x, 3, 2)
+            out["192"] = tap_mean(x)
 
         x = InceptionA(pool_features=32, dtype=dt)(x)
         x = InceptionA(pool_features=64, dtype=dt)(x)
@@ -326,6 +339,166 @@ def pad_stem_params(variables: Any, lanes: int = 128) -> Any:
     return out
 
 
+def random_inception_params(
+    input_size: int = 299, seed: int = 0, fast: bool = False
+) -> Any:
+    """Random canonical InceptionV3 variables (the no-pretrained-weights path).
+
+    ``fast=True`` fills the ``jax.eval_shape`` tree with host RNG instead of
+    compiling the flax init (~16 s on CPU) — deterministic per seed, fine for
+    pipeline/sharding/parity tests, meaningless for real FID values. BN
+    ``var`` leaves land in [0.5, 1.5] so ``rsqrt(var + eps)`` stays benign.
+    """
+    m = InceptionV3()
+    dummy = jnp.zeros((1, input_size, input_size, 3), dtype=jnp.float32)
+    if not fast:
+        return jax.jit(m.init)(jax.random.PRNGKey(seed), dummy)
+    import numpy as np
+
+    abstract = jax.eval_shape(m.init, jax.random.PRNGKey(seed), dummy)
+    rng = np.random.RandomState(seed)
+
+    def fill(path, leaf):
+        name = jax.tree_util.keystr(path)
+        if "var" in name:
+            return jnp.asarray(rng.uniform(0.5, 1.5, leaf.shape).astype(leaf.dtype))
+        if "scale" in name:
+            return jnp.asarray(
+                (1.0 + 0.1 * rng.standard_normal(leaf.shape)).astype(leaf.dtype)
+            )
+        if len(leaf.shape) >= 2:  # conv kernels / dense: fan-in scaled so the
+            # activations stay O(1) through 11 blocks (precision parity tests
+            # compare against analytic bounds — exploding magnitudes would
+            # drown them)
+            fan_in = float(np.prod(leaf.shape[:-1]))
+            std = (2.0 / fan_in) ** 0.5
+            return jnp.asarray(
+                (std * rng.standard_normal(leaf.shape)).astype(leaf.dtype)
+            )
+        return jnp.asarray(
+            (0.1 * rng.standard_normal(leaf.shape)).astype(leaf.dtype)
+        )
+
+    return jax.tree_util.tree_map_with_path(fill, abstract)
+
+
+# the 5 top-level stem layers, in application order, with (strides, padding).
+# Everything before the '192' tap lives here; everything after is "trunk".
+STEM_LAYERS = (
+    "BasicConv2d_0", "BasicConv2d_1", "BasicConv2d_2",
+    "BasicConv2d_3", "BasicConv2d_4",
+)
+_STEM_SPECS = (
+    ((2, 2), "VALID"),
+    ((1, 1), "VALID"),
+    ((1, 1), "SAME"),
+    ((1, 1), "VALID"),
+    ((1, 1), "VALID"),
+)
+
+
+def split_stem_variables(variables: Any) -> Tuple[Any, Any]:
+    """Split a canonical variables tree into ``(stem_vars, trunk_vars)``.
+
+    ``stem_vars`` holds the 5 stem conv/BN layers (consumed by ``stem_apply``);
+    ``trunk_vars`` is everything else (consumed by
+    ``InceptionV3(stem_input=True).apply``). Pure; leaves are shared, not
+    copied.
+    """
+    stem: Dict[str, Any] = {}
+    trunk: Dict[str, Any] = {}
+    for coll, layers in variables.items():
+        s = {k: v for k, v in layers.items() if k in STEM_LAYERS}
+        t = {k: v for k, v in layers.items() if k not in STEM_LAYERS}
+        if s:
+            stem[coll] = s
+        if t:
+            trunk[coll] = t
+    return stem, trunk
+
+
+def _conv_bn_relu(
+    x: Array,
+    kernel: Array,
+    scale: Array,
+    bias: Array,
+    mean: Array,
+    var: Array,
+    strides: Tuple[int, int],
+    padding: str,
+    dt: Optional[Any],
+) -> Array:
+    """One BasicConv2d, functionally — bitwise the flax module's op sequence
+    (lax conv NHWC/HWIO, then flax BatchNorm's ``(x - mean) * (rsqrt(var + eps)
+    * scale) + bias`` with eps=0.001, then relu)."""
+    if dt is not None:
+        x = x.astype(dt)
+        kernel, scale, bias, mean, var = (
+            a.astype(dt) for a in (kernel, scale, bias, mean, var)
+        )
+    y = jax.lax.conv_general_dilated(
+        x, kernel, strides, padding, dimension_numbers=("NHWC", "HWIO", "NHWC")
+    )
+    y = (y - mean) * (jax.lax.rsqrt(var + 0.001) * scale) + bias
+    return jax.nn.relu(y)
+
+
+def stem_apply(
+    stem_variables: Any,
+    x: Array,
+    *,
+    compute_dtype: Optional[Any] = None,
+    preprocess_folded: bool = False,
+    stem_lanes: Optional[int] = None,
+    gather_axis: Optional[str] = None,
+) -> Tuple[Array, Dict[str, Array]]:
+    """Pure functional stem: preprocessing + 5 stem convs + 2 pools + taps.
+
+    Returns ``(post_stem, {'64': ..., '192': ...})`` where ``post_stem`` feeds
+    ``InceptionV3(stem_input=True)``. Bitwise-matches the module stem on the
+    same params (same primitive sequence): the module/``stem_apply`` split is a
+    pure refactor of the graph, not an approximation.
+
+    ``gather_axis``: when called inside ``shard_map`` with the conv kernels
+    sharded over their OUTPUT-channel dim (and the BN vectors over dim 0), each
+    layer computes its local channel slice and ``all_gather(..., tiled=True)``
+    restores the full channel order before the next layer — the tensor-parallel
+    stem of the model host. The gather is the only collective this function
+    emits.
+    """
+    params = stem_variables["params"]
+    stats = stem_variables["batch_stats"]
+    if x.dtype == jnp.uint8:
+        x = x.astype(jnp.float32)
+    else:
+        x = jnp.floor(x * 255.0)
+    if not preprocess_folded:
+        x = (x - 128.0) / 128.0
+    if compute_dtype is not None:
+        x = x.astype(compute_dtype)
+
+    def tap_mean(v: Array) -> Array:
+        return jnp.mean(v.astype(jnp.float32), axis=(1, 2))
+
+    taps: Dict[str, Array] = {}
+    for i, (layer, (strides, padding)) in enumerate(zip(STEM_LAYERS, _STEM_SPECS)):
+        bn = params[layer]["BatchNorm_0"]
+        st = stats[layer]["BatchNorm_0"]
+        x = _conv_bn_relu(
+            x, params[layer]["Conv_0"]["kernel"], bn["scale"], bn["bias"],
+            st["mean"], st["var"], strides, padding, compute_dtype,
+        )
+        if gather_axis is not None:
+            x = jax.lax.all_gather(x, gather_axis, axis=-1, tiled=True)
+        if i == 2:
+            x = _max_pool(x, 3, 2)
+            taps["64"] = tap_mean(x[..., :64] if stem_lanes is not None else x)
+        elif i == 4:
+            x = _max_pool(x, 3, 2)
+            taps["192"] = tap_mean(x)
+    return x, taps
+
+
 def resolve_feature_extractor(
     metric_name: str,
     feature: Any,
@@ -333,12 +506,47 @@ def resolve_feature_extractor(
     mesh: Optional[Any],
     mesh_axis: Any,
     valid: Tuple[str, ...],
+    model_host: Optional[Any] = None,
 ) -> Tuple[Callable, Optional[int]]:
     """Shared FID/IS/KID ctor logic: a callable passes through (``mesh`` is
     rejected — we can't shard an opaque callable; wrap it with
     ``parallel.shard_batch_forward`` yourself), a tap name builds the built-in
     extractor (optionally mesh-sharded). Returns ``(extractor, feature_dim)``
-    with ``feature_dim=None`` for callables."""
+    with ``feature_dim=None`` for callables.
+
+    ``model_host``: route the forward through the resident embedded-model
+    serving path (``engine.model_host``, ISSUE 19) instead of a per-metric
+    monolithic extractor — ``True`` builds/shares the registry host for this
+    (tap, params, mesh, precision, buckets) identity, a ``ModelHostConfig``
+    customises it, a ``ModelHost`` instance is used as-is. Metrics sharing an
+    identity share ONE resident model (params shared, not copied). The
+    returned extractor carries the host as ``extractor.model_host``.
+    """
+    if model_host is not None and model_host is not False:
+        if callable(feature) and not isinstance(feature, (str, int)):
+            raise ValueError(
+                f"{metric_name}(model_host=...) only applies to the built-in "
+                f"InceptionV3 (feature in {valid}); wrap your callable with "
+                "engine.model_host.ModelHost yourself."
+            )
+        from metrics_tpu.engine.model_host import (
+            ModelHost, ModelHostConfig, inception_host,
+        )
+
+        if isinstance(model_host, ModelHost):
+            host = model_host
+        else:
+            config = (
+                model_host if isinstance(model_host, ModelHostConfig)
+                else ModelHostConfig(mesh=mesh, mesh_axis=mesh_axis)
+            )
+            host = inception_host(str(feature), params, config=config)
+
+        def extractor(imgs: Array) -> Array:
+            return jnp.asarray(host.infer(imgs))
+
+        extractor.model_host = host
+        return extractor, FEATURE_DIMS[str(feature)]
     if callable(feature):
         if mesh is not None:
             raise ValueError(

@@ -353,6 +353,154 @@ def sharded_state_merge(
     )
 
 
+def stem_tensor_batch_forward(
+    stem_fn: Callable,
+    trunk_fn: Callable,
+    mesh: Mesh,
+    axis: AxisName = "dp",
+) -> Callable:
+    """Hybrid tensor→data sharded embedded forward — the model host's
+    Inception layout (ROADMAP item 2 / ISSUE 19).
+
+    Stage 1, tensor-parallel stem: the image batch is REPLICATED to every
+    device; the stem params enter channel-sharded (every leaf split on its
+    LAST dim — conv kernels ``(kh, kw, cin, cout)`` on ``cout``, BN vectors
+    ``(c,)`` on the channel dim), so each device computes a channel slice of
+    every stem layer and ``stem_fn`` restores full channels with a tiled
+    ``all_gather`` per layer. This is where PR 1's ``pad_stem_params`` 128-lane
+    layout pays twice: the padded stem widths (128/128/128/128/192) divide
+    evenly over the axis, and each device's slice still presents full MXU
+    lanes.
+
+    Stage 2, data-parallel trunk: each device slices its own batch shard of
+    the post-stem activation (``axis_index``) and runs ``trunk_fn`` on it;
+    the per-row outputs ``all_gather`` back to replicated.
+
+    ``stem_fn(stem_vars_local, x, gather_axis) -> (x_stem, aux)`` — e.g.
+    ``models.inception.stem_apply`` (``aux`` = the '64'/'192' taps, computed
+    full-batch, already replicated). ``trunk_fn(trunk_vars, x_local) -> dict``
+    of per-row outputs (leading batch dim). The returned
+    ``fwd(stem_vars, trunk_vars, x)`` requires the batch divisible by the
+    axis size (the host's bucket divisor guarantees it) and emits
+    ``all_gather`` as its only collective.
+    """
+    world = _axis_size(mesh, axis)
+
+    def _stem_spec(leaf):
+        nd = getattr(leaf, "ndim", 0)
+        if not nd:
+            return P()
+        return P(*([None] * (nd - 1) + [axis]))
+
+    def body(stem_vars, trunk_vars, x):
+        x_stem, aux = stem_fn(stem_vars, x, axis)
+        b = x.shape[0] // world
+        k = jax.lax.axis_index(axis)
+        x_local = jax.lax.dynamic_slice_in_dim(x_stem, k * b, b, axis=0)
+        out = trunk_fn(trunk_vars, x_local)
+        out = jax.tree.map(
+            lambda o: jax.lax.all_gather(o, axis, axis=0, tiled=True), out
+        )
+        out.update(aux)
+        return out
+
+    def fwd(stem_vars, trunk_vars, x):
+        if x.shape[0] % world:
+            raise ValueError(
+                f"stem_tensor_batch_forward: batch {x.shape[0]} not divisible by "
+                f"axis {axis!r} size {world} — serve it through a bucket set with "
+                f"divisor={world}"
+            )
+        stem_specs = jax.tree.map(_stem_spec, stem_vars)
+        return jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(stem_specs, P(), P()), out_specs=P(), check_vma=False,
+        )(stem_vars, trunk_vars, x)
+
+    return fwd
+
+
+def pipeline_stage_forward(
+    stage_fn: Callable,
+    mesh: Mesh,
+    axis: AxisName = "dp",
+    microbatches: Optional[int] = None,
+) -> Callable:
+    """GPipe-style pipeline-parallel embedded forward — the model host's
+    encoder layout, per the MPMD pipeline-parallelism paper (PAPERS.md).
+
+    Stage ``s``'s params live ONLY on device ``s``: the stage pytree is
+    stacked ``(S, ...)`` and dim-0-sharded over ``axis`` (one row per device),
+    and activations hand off stage-to-stage with ``ppermute`` ring rotations —
+    the ONLY collective this program ever emits (pinned by the
+    ``host-collectives-pinned`` analysis rule).
+
+    Schedule: the batch splits into ``M`` microbatches (default ``M = world``);
+    the loop runs ``S + M - 1`` steps, device ``s`` processing microbatch
+    ``t - s`` at step ``t`` (junk outside the valid window, masked from the
+    output). The last stage's output buffer is then ring-rotated ``S - 1``
+    steps so every device holds it — still ppermute-only — and the result
+    leaves replicated.
+
+    ``stage_fn(stage_params, x_mb) -> x_mb`` must preserve the microbatch
+    shape (a residual-style encoder stage). The returned ``fwd(params, x)``
+    requires ``x.shape[0]`` divisible by ``M``.
+    """
+    world = _axis_size(mesh, axis)
+    perm = [(i, (i + 1) % world) for i in range(world)]
+
+    def body(params, x):
+        p = jax.tree.map(lambda a: a[0], params)  # this device's stage row
+        s = jax.lax.axis_index(axis)
+        m = microbatches or world
+        mb = x.shape[0] // m
+
+        def step(t, carry):
+            state, out = carry
+            feed = jax.lax.dynamic_slice_in_dim(
+                x, jnp.clip(t, 0, m - 1) * mb, mb, axis=0
+            )
+            state = jnp.where((s == 0) & (t < m), feed, state)
+            state = stage_fn(p, state)
+            idx = t - (world - 1)
+            emitted = jax.lax.dynamic_update_slice_in_dim(
+                out, state, jnp.clip(idx, 0, m - 1) * mb, axis=0
+            )
+            out = jnp.where((s == world - 1) & (idx >= 0), emitted, out)
+            state = jax.lax.ppermute(state, axis, perm)
+            return state, out
+
+        state0 = jnp.zeros((mb,) + x.shape[1:], x.dtype)
+        _, out = jax.lax.fori_loop(
+            0, m + world - 1, step, (state0, jnp.zeros_like(x))
+        )
+        # replicate the last stage's buffer with a ring broadcast: after k
+        # rotations device d holds device (d - k) % world's buffer, so each
+        # device latches the rotation where that source is the last stage
+        result = jnp.where(s == world - 1, out, jnp.zeros_like(out))
+        cur = out
+        for k in range(1, world):
+            cur = jax.lax.ppermute(cur, axis, perm)
+            result = jnp.where((s - k) % world == world - 1, cur, result)
+        return result
+
+    def fwd(params, x):
+        m = microbatches or world
+        if x.shape[0] % m:
+            raise ValueError(
+                f"pipeline_stage_forward: batch {x.shape[0]} not divisible by "
+                f"microbatch count {m} — serve it through a bucket set with "
+                f"divisor={m}"
+            )
+        stage_specs = jax.tree.map(lambda _: P(axis), params)
+        return jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(stage_specs, P()), out_specs=P(), check_vma=False,
+        )(params, x)
+
+    return fwd
+
+
 def boundary_merge_error(axis: AxisName, world: int, cause: BaseException) -> Exception:
     """Build the typed error for a failed deferred boundary merge, carrying
     the mesh topology an operator needs (axis, world size) — the engine

@@ -5,7 +5,7 @@ token tensors as cat-states; compute :226 runs the embedding pipeline). The enco
 is pluggable (local HF Flax model / user forward fn) and shares the functional
 path's jit-compiled, cached forward + fused scoring (``functional/text/bert.py``).
 """
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -20,9 +20,51 @@ from metrics_tpu.functional.text.bert import (
     _simple_whitespace_tokenizer,
 )
 from metrics_tpu.metric import Metric
-from metrics_tpu.utils.data import dim_zero_cat
 
 Array = jax.Array
+
+
+def _derive_length_buckets(max_length: int) -> Tuple[int, ...]:
+    """Power-of-two token-length bucket edges up to (and including) max_length."""
+    edges = []
+    b = 8
+    while b < max_length:
+        edges.append(b)
+        b *= 2
+    edges.append(max_length)
+    return tuple(edges)
+
+
+def _bucket_pad_tokens(
+    enc: Dict[str, np.ndarray], buckets: Sequence[int]
+) -> Dict[str, np.ndarray]:
+    """Pad the token-length dim up to the smallest bucket edge >= L.
+
+    Score-invariant (attention masks exclude pad positions) but bounds the
+    set of sequence lengths the encoder forward ever sees, so the jit trace
+    cache stays O(len(buckets)) instead of one entry per distinct per-call
+    batch max (the unbounded-compile bug this fixes).
+    """
+    ids = np.asarray(enc["input_ids"])
+    mask = np.asarray(enc["attention_mask"])
+    length = ids.shape[1]
+    target = next((b for b in buckets if b >= length), length)
+    if target > length:
+        pad = ((0, 0), (0, target - length))
+        ids = np.pad(ids, pad)
+        mask = np.pad(mask, pad)
+    return {"input_ids": ids, "attention_mask": mask}
+
+
+def _cat_padded(chunks: List[Array], length: int) -> np.ndarray:
+    """Concatenate (N_i, L_i) token chunks after right-padding each to ``length``."""
+    out = []
+    for c in chunks:
+        c = np.asarray(c)
+        if c.shape[1] < length:
+            c = np.pad(c, ((0, 0), (0, length - c.shape[1])))
+        out.append(c)
+    return np.concatenate(out, axis=0)
 
 
 class BERTScore(Metric):
@@ -55,6 +97,8 @@ class BERTScore(Metric):
         baseline_url: Optional[str] = None,
         mesh: Optional[Any] = None,
         mesh_axis: Any = "dp",
+        model_host: Optional[Any] = None,
+        length_buckets: Optional[Sequence[int]] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -64,6 +108,13 @@ class BERTScore(Metric):
         self.idf = idf
         self.user_tokenizer = user_tokenizer
         self.rescale_with_baseline = rescale_with_baseline
+        # token-length bucket edges: every _tokenize() pads to a bucket edge,
+        # never the per-call batch max, so the encoder's trace cache is bounded
+        # by len(length_buckets) rather than by the traffic's length diversity.
+        self.length_buckets = (
+            tuple(sorted(length_buckets)) if length_buckets is not None
+            else _derive_length_buckets(max_length)
+        )
         # load at construction so a bad baseline config (missing/malformed csv,
         # out-of-range num_layers) fails fast, and compute() does no file IO
         path = _resolve_baseline_path(rescale_with_baseline, baseline_path, baseline_url)
@@ -73,6 +124,33 @@ class BERTScore(Metric):
         # mesh's data axis (sharded embedded-model path, parallel/embedded.py)
         self.forward_fn = _resolve_forward(user_forward_fn, model, model_name_or_path, mesh, mesh_axis)
 
+        # model_host: serve the encoder forward from a resident ModelHost
+        # (batch-bucketed, megabatch-coalesced, AOT-cached executables; shared
+        # across metric instances with the same encoder) — engine/model_host.py.
+        self.model_host = None
+        if model_host is not None and model_host is not False:
+            from metrics_tpu.engine.model_host import (
+                ModelHost, ModelHostConfig, encoder_host,
+            )
+
+            if isinstance(model_host, ModelHost):
+                host = model_host
+            else:
+                config = (
+                    model_host if isinstance(model_host, ModelHostConfig)
+                    else ModelHostConfig(mesh=mesh, mesh_axis=mesh_axis)
+                )
+                host = encoder_host(forward_fn=self.forward_fn, config=config)
+            self.model_host = host
+
+            def _host_forward(ids: Array, mask: Array) -> Array:
+                return jnp.asarray(host.infer(ids, mask))
+
+            # the host owns compilation; tell _resolve_forward/_embed not to
+            # re-jit this callable (functional/text/bert.py honours the flag)
+            _host_forward._metrics_tpu_prejitted = True
+            self.forward_fn = _host_forward
+
         self.add_state("preds_input_ids", [], dist_reduce_fx="cat")
         self.add_state("preds_attention_mask", [], dist_reduce_fx="cat")
         self.add_state("target_input_ids", [], dist_reduce_fx="cat")
@@ -80,8 +158,10 @@ class BERTScore(Metric):
 
     def _tokenize(self, sentences: List[str]) -> Dict[str, np.ndarray]:
         if self.user_tokenizer is not None:
-            return self.user_tokenizer(sentences, self.max_length)
-        return _simple_whitespace_tokenizer(sentences, self.max_length)
+            enc = self.user_tokenizer(sentences, self.max_length)
+        else:
+            enc = _simple_whitespace_tokenizer(sentences, self.max_length)
+        return _bucket_pad_tokens(enc, self.length_buckets)
 
     def update(self, predictions: List[str], references: List[str]) -> None:
         enc_pred = self._tokenize(predictions)
@@ -92,12 +172,19 @@ class BERTScore(Metric):
         self.target_attention_mask.append(jnp.asarray(enc_tgt["attention_mask"]))
 
     def compute(self) -> Dict[str, List[float]]:
+        # update() calls may have landed on different length buckets; pad every
+        # chunk to the common max bucket edge so the whole compute runs at one
+        # (already-bucketed) sequence length and the fused path stays eligible.
+        length = max(
+            [int(np.asarray(c).shape[1]) for c in self.preds_input_ids]
+            + [int(np.asarray(c).shape[1]) for c in self.target_input_ids]
+        )
         precision, recall, f1 = _score_tokenized(
             self.forward_fn,
-            np.asarray(dim_zero_cat(self.preds_input_ids)),
-            np.asarray(dim_zero_cat(self.preds_attention_mask)),
-            np.asarray(dim_zero_cat(self.target_input_ids)),
-            np.asarray(dim_zero_cat(self.target_attention_mask)),
+            _cat_padded(self.preds_input_ids, length),
+            _cat_padded(self.preds_attention_mask, length),
+            _cat_padded(self.target_input_ids, length),
+            _cat_padded(self.target_attention_mask, length),
             idf=self.idf,
             batch_size=self.batch_size,
             # reference contract strips [CLS]/[SEP] from matching (bert.py:324);

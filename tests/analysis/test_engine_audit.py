@@ -471,6 +471,76 @@ def test_audit_catches_a_megastep_step_that_lost_its_grids():
     assert any("fused-grid" in f.message for f in report.findings)
 
 
+# ------------------------------------------------- embedded-model host (r19)
+
+
+def _drive_hosted(stage_fn, seed=0):
+    """A deferred 1-device engine fed FEATURES from a pipeline-staged encoder
+    host (the bootstrap matrix's ``modelhost/`` entry, miniature): returns
+    ``(engine, host)`` with both program sets compiled and the host ATTACHED
+    (``engine.model_host``) so ``EngineAnalysis.check`` audits it."""
+    from metrics_tpu.engine import ModelHostConfig, encoder_host
+
+    host = encoder_host(
+        stage_fn=stage_fn,
+        stage_params=np.eye(4, dtype=np.float32)[None] * 1.5,
+        config=ModelHostConfig(buckets=(8,), mesh=_mesh1(), coalesce_window_ms=0.0),
+        fingerprint=f"audit-pipeline-encoder-{seed}",
+        shared=False,
+    )
+    engine = StreamingEngine(
+        MeanSquaredError(),
+        EngineConfig(buckets=(8,), mesh=_mesh1(), axis="dp", mesh_sync="deferred"),
+    )
+    engine.model_host = host
+    rng = np.random.RandomState(seed)
+    with engine:
+        for n in (5, 8, 3):
+            p, t = rng.rand(n).astype(np.float32), rng.rand(n).astype(np.float32)
+            ids = np.tile(p[:, None], (1, 4)).astype(np.float32)
+            feats = host.infer(ids, np.ones_like(ids))
+            engine.submit(np.asarray(feats).mean(axis=1), t)
+        engine.result()
+    host.close()
+    return engine, host
+
+
+def test_hosted_engine_audits_clean():
+    """The real ppermute pipeline handoff is WITHIN the declared allowance:
+    the attached host audits clean alongside the engine's own rules."""
+    engine, _ = _drive_hosted(lambda w, x: x @ w)
+    report = EngineAnalysis().check(engine)
+    assert report.findings == [], report.render()
+
+
+def test_audit_catches_an_undeclared_psum_in_a_host_stage():
+    """Broken-fixture proof promised by the bootstrap matrix: widen the
+    encoder stage with a psum — pipeline hosts declare ppermute ONLY, so the
+    re-traced program fails ``host-collectives-pinned``."""
+
+    def widened_stage(w, x):
+        return jax.lax.psum(x @ w, "dp")
+
+    engine, _ = _drive_hosted(widened_stage, seed=1)
+    report = EngineAnalysis().check(engine)
+    rules = {f.rule for f in report.findings}
+    assert rules == {"host-collectives-pinned"}, report.render()
+    assert any("psum" in f.path for f in report.findings)
+
+
+def test_audit_catches_a_cleared_allowance_under_the_real_handoff():
+    """The allowance is load-bearing, not decorative: clear it on a host
+    whose programs REALLY ppermute and the same rule fires on the handoff."""
+    engine, host = _drive_hosted(lambda w, x: x @ w, seed=2)
+    assert EngineAnalysis().check(engine).ok  # sane before the break
+
+    host.allowed_collectives = ()
+    report = EngineAnalysis().check(engine)
+    rules = {f.rule for f in report.findings}
+    assert rules == {"host-collectives-pinned"}, report.render()
+    assert any("ppermute" in f.path for f in report.findings)
+
+
 # ----------------------------------------------------------------- baseline
 
 

@@ -1,0 +1,358 @@
+"""Embedded-model serving (ISSUE 19): the resident ``ModelHost`` contracts
+that are 1-device-safe — request/bucket/coalesce mechanics, the f32
+bit-exactness oracle, the bf16/int8 activation paths against their analytic
+bounds, registry dedupe (FID+KID share one model copy), the BERTScore
+length-bucket fix for the unbounded trace cache, OpenMetrics exposition, and
+the engine-telemetry section. The mesh-sharded layouts (stem-tensor hybrid,
+pipeline ppermute handoff) are pinned by ``make model-smoke`` (8-device
+bootstrap) and the ``host-collectives-pinned`` audit tests.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.engine import EngineConfig, StreamingEngine
+from metrics_tpu.engine.model_host import (
+    ModelHost,
+    ModelHostConfig,
+    encoder_host,
+    reset_host_registry,
+    shared_host,
+)
+from metrics_tpu.parallel.collectives import q8_sum_error_bound
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..", "tools"))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    reset_host_registry()
+    yield
+    reset_host_registry()
+
+
+def _params(seed=0, din=6, dout=4):
+    rng = np.random.RandomState(seed)
+    return {
+        "w": rng.randn(din, dout).astype(np.float32),
+        "b": rng.randn(dout).astype(np.float32),
+    }
+
+
+def _forward(params, x):
+    return jnp.tanh(x @ params["w"] + params["b"])
+
+
+def _host(precision="f32", seed=0, **cfg):
+    cfg.setdefault("buckets", (8,))
+    cfg.setdefault("coalesce_window_ms", 0.0)
+    return ModelHost(
+        "demo", _forward, _params(seed),
+        config=ModelHostConfig(precision=precision, **cfg),
+        fingerprint=f"test-demo-{seed}",
+    )
+
+
+# ------------------------------------------------------------ serving basics
+
+
+def test_f32_host_is_bit_exact_vs_the_direct_forward():
+    """The f32 path is the oracle: at the bucket shape (no padding) the host
+    output is bitwise the module forward it wraps."""
+    host = _host()
+    x = np.random.RandomState(1).randn(8, 6).astype(np.float32)
+    want = np.asarray(jax.jit(_forward)(_params(), x))
+    got = np.asarray(host.infer(x))
+    host.close()
+    assert got.dtype == want.dtype
+    np.testing.assert_array_equal(got, want)
+
+
+def test_padded_request_valid_rows_match_the_unpadded_forward():
+    """Bucket padding is invisible: a 5-row request served through the 8-row
+    program returns exactly the 5 rows the raw forward computes (row
+    independence of the padded tail)."""
+    host = _host()
+    x = np.random.RandomState(2).randn(5, 6).astype(np.float32)
+    want = np.asarray(jax.jit(_forward)(_params(), x))
+    got = np.asarray(host.infer(x))
+    host.close()
+    assert got.shape == (5, 4)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_zero_steady_compiles_over_varied_traffic():
+    """Warm bucket programs serve EVERY in-bucket size without recompiling —
+    the closed-program contract the bench asserts hard."""
+    host = _host(buckets=(4, 8))
+    rng = np.random.RandomState(3)
+    for n in (3, 7, 4, 8):  # warmup: both buckets compiled
+        host.infer(rng.randn(n, 6).astype(np.float32))
+    warm = host.aot.misses
+    for n in (1, 2, 5, 6, 3, 8, 7, 4):
+        host.infer(rng.randn(n, 6).astype(np.float32))
+    assert host.aot.misses == warm, "steady-state traffic recompiled"
+    assert host.aot.hits > 0
+    assert host.counters()["bucket_compiles"] == warm
+    host.close()
+
+
+def test_coalescing_merges_compatible_requests_into_one_device_batch():
+    host = _host(coalesce=3, coalesce_window_ms=500.0)
+    rng = np.random.RandomState(4)
+    handles = [host.submit(rng.randn(2, 6).astype(np.float32)) for _ in range(3)]
+    outs = [h.get(timeout=30) for h in handles]
+    for o in outs:
+        assert not isinstance(o, BaseException), o
+        assert np.asarray(o).shape == (2, 4)
+    c = host.counters()
+    host.close()
+    assert c["requests"] == 3
+    assert c["batches"] == 1, "compatible requests were not megabatched"
+    assert c["coalesced_batches"] == 1  # the one megabatch held >1 request
+    assert c["items"] == 6 and c["padded_items"] == 2  # 8-row bucket, 6 valid
+
+
+def test_closed_host_refuses_and_serving_errors_propagate():
+    host = _host()
+    bad = np.zeros((3, 5), np.float32)  # wrong trailing dim: fails in-program
+    with pytest.raises(Exception):
+        host.infer(bad)
+    # a serving error poisons neither the worker nor later good requests
+    good = np.zeros((3, 6), np.float32)
+    assert np.asarray(host.infer(good)).shape == (3, 4)
+    host.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        host.submit(good)
+
+
+# --------------------------------------------------------- precision paths
+
+
+def test_bf16_and_int8_paths_hold_their_analytic_bounds():
+    """bf16/int8 are opt-in activation paths around the SAME weights; f32 is
+    the bit-exactness oracle. The int8 error is exactly the W=1 q8_block
+    roundtrip, bounded by ``q8_sum_error_bound``; bf16 is float-parity."""
+    x = np.random.RandomState(5).randn(8, 6).astype(np.float32)
+    f32 = _host("f32")
+    want = np.asarray(f32.infer(x))
+    f32.close()
+
+    bf16 = _host("bf16")
+    got_bf16 = np.asarray(bf16.infer(x))
+    bf16.close()
+    assert got_bf16.dtype == np.float32  # restored on the way out
+    np.testing.assert_allclose(got_bf16, want, rtol=5e-2, atol=5e-2)
+    assert not np.array_equal(got_bf16, want)  # really the reduced path
+
+    int8 = _host("int8")
+    got_int8 = np.asarray(int8.infer(x))
+    int8.close()
+    bound = np.asarray(q8_sum_error_bound(jnp.asarray(want)[None]))
+    assert np.all(np.abs(got_int8 - want) <= bound + 1e-7)
+
+
+def test_precision_is_part_of_the_program_key():
+    """One AotCache can host all three activation paths of the same model —
+    the precision axis keys distinct programs, never a silent overwrite."""
+    from metrics_tpu.engine import AotCache
+
+    aot = AotCache()
+    x = np.zeros((8, 6), np.float32)
+    for prec in ("f32", "bf16", "int8"):
+        host = ModelHost(
+            "demo", _forward, _params(),
+            config=ModelHostConfig(precision=prec, buckets=(8,), coalesce_window_ms=0.0),
+            fingerprint="shared-cache-demo", aot=aot,
+        )
+        host.infer(x)
+        host.close()
+    assert aot.misses == 3 and len(aot) == 3
+
+
+# ------------------------------------------------------------ registry dedupe
+
+
+def test_shared_host_dedupes_by_key_and_bumps_shared_by():
+    made = []
+
+    def factory():
+        h = _host()
+        made.append(h)
+        return h
+
+    a = shared_host(("demo", "fp", None, "single"), factory)
+    b = shared_host(("demo", "fp", None, "single"), factory)
+    c = shared_host(("demo", "OTHER", None, "single"), factory)
+    assert a is b and a is not c
+    assert len(made) == 2
+    assert a.shared_by == 2 and c.shared_by == 1
+    a.close()
+    c.close()
+
+
+def test_fid_and_kid_share_one_resident_model_not_copies():
+    """The dedupe satellite: FID and KID over the same (tap, params, mesh,
+    precision) resolve ONE host whose param buffers are the same objects —
+    one resident model, not per-metric copies."""
+    from metrics_tpu.image.fid import FID
+    from metrics_tpu.image.kid import KID
+    from metrics_tpu.models.inception import random_inception_params
+
+    params = random_inception_params(input_size=75, seed=0, fast=True)
+    cfg = ModelHostConfig(buckets=(8,), coalesce_window_ms=0.0)
+    fid = FID(feature=2048, params=params, model_host=cfg)
+    kid = KID(feature=2048, params=params, subsets=2, subset_size=4, model_host=cfg)
+    assert fid.model_host is not None
+    assert fid.model_host is kid.model_host
+    assert fid.model_host.counters()["shared_by"] == 2
+    leaves_a = jax.tree.leaves(fid.model_host.params)
+    leaves_b = jax.tree.leaves(kid.model_host.params)
+    assert all(x is y for x, y in zip(leaves_a, leaves_b))
+    # different weights -> a DIFFERENT host (the fingerprint really keys)
+    fid2 = FID(
+        feature=2048,
+        params=random_inception_params(input_size=75, seed=7, fast=True),
+        model_host=cfg,
+    )
+    assert fid2.model_host is not fid.model_host
+    fid.model_host.close()
+    fid2.model_host.close()
+
+
+# ----------------------------------------------- BERTScore length bucketing
+
+
+def _enc_forward():
+    rng = np.random.RandomState(11)
+    emb = rng.randn(512, 16).astype(np.float32) * 0.1
+    w = rng.randn(16, 16).astype(np.float32) * 0.1
+
+    def enc(ids, mask):
+        x = jnp.asarray(emb)[ids] * mask[..., None]
+        return jnp.tanh(x @ jnp.asarray(w)) * mask[..., None]
+
+    return enc
+
+
+def _varied_sentences(seed=12, batches=6):
+    rng = np.random.RandomState(seed)
+    words = ["alpha", "beta", "gamma", "delta", "epsilon", "zeta", "eta"]
+    out = []
+    for _ in range(batches):
+        n = int(rng.randint(2, 5))
+        preds = [
+            " ".join(rng.choice(words, size=int(rng.randint(2, 24))))
+            for _ in range(n)
+        ]
+        targets = [
+            " ".join(rng.choice(words, size=int(rng.randint(2, 24))))
+            for _ in range(n)
+        ]
+        out.append((preds, targets))
+    return out
+
+
+def test_derive_length_buckets_and_bucket_padding():
+    from metrics_tpu.text.bert import _bucket_pad_tokens, _derive_length_buckets
+
+    assert _derive_length_buckets(32) == (8, 16, 32)
+    assert _derive_length_buckets(128) == (8, 16, 32, 64, 128)
+    assert _derive_length_buckets(100) == (8, 16, 32, 64, 100)
+    enc = {
+        "input_ids": np.ones((3, 11), np.int64),
+        "attention_mask": np.ones((3, 11), np.int64),
+    }
+    padded = _bucket_pad_tokens(enc, (8, 16, 32))
+    assert padded["input_ids"].shape == (3, 16)
+    assert padded["attention_mask"][:, 11:].sum() == 0  # padding is MASKED
+
+
+def test_bertscore_host_bounds_the_trace_cache_and_matches_the_direct_path():
+    """The unbounded-trace-cache fix, as a regression test: varied-length
+    traffic through a hosted BERTScore compiles at most |length_buckets| x
+    |batch buckets| programs, a full replay compiles ZERO more, and the
+    scores are exactly the direct (un-hosted) path's."""
+    from metrics_tpu.text.bert import BERTScore
+
+    enc = _enc_forward()
+    traffic = _varied_sentences()
+    direct = BERTScore(user_forward_fn=enc, max_length=32)
+    hosted = BERTScore(
+        user_forward_fn=enc, max_length=32,
+        model_host=ModelHostConfig(buckets=(8,), coalesce_window_ms=0.0),
+    )
+    assert hosted.model_host is not None
+    for preds, targets in traffic:
+        direct.update(preds, targets)
+        hosted.update(preds, targets)
+    want = direct.compute()
+    got = hosted.compute()
+    np.testing.assert_array_equal(
+        np.asarray(got["f1"]), np.asarray(want["f1"])
+    )
+    host = hosted.model_host
+    warm = host.aot.misses
+    assert warm <= len(hosted.length_buckets) * 1  # one batch bucket
+    hosted.reset()
+    for preds, targets in traffic:
+        hosted.update(preds, targets)
+    hosted.compute()
+    assert host.aot.misses == warm, "replay of warm varied-length traffic recompiled"
+    host.close()
+
+
+# ------------------------------------------------------ telemetry & exposition
+
+
+def test_openmetrics_exposition_parses_strict():
+    import trace_export
+
+    host = _host()
+    host.infer(np.zeros((3, 6), np.float32))
+    text = host.metrics_text()
+    host.close()
+    fams = trace_export.parse_openmetrics(text)
+    req = fams["metrics_tpu_model_host_requests"]
+    assert {s["labels"].get("precision") for s in req["samples"]} == {"f32"}
+    assert req["samples"][0]["value"] == 1.0
+    for fam in ("items", "padded_items", "batches", "coalesced_batches",
+                "bucket_hits", "bucket_compiles", "shared_by"):
+        assert f"metrics_tpu_model_host_{fam}" in fams, fam
+    assert fams["metrics_tpu_model_host_items_per_s"]["type"] == "gauge"
+
+
+def test_engine_telemetry_carries_the_attached_host_section(tmp_path):
+    import json
+
+    from metrics_tpu import MeanSquaredError
+
+    host = _host()
+    eng = StreamingEngine(MeanSquaredError(), EngineConfig(buckets=(8,)))
+    eng.model_host = host
+    rng = np.random.RandomState(6)
+    with eng:
+        for n in (5, 3):
+            feats = np.asarray(host.infer(rng.randn(n, 6).astype(np.float32)))
+            eng.submit(feats.mean(axis=1), rng.rand(n).astype(np.float32))
+        eng.result()
+        live = eng.telemetry()
+        path = str(tmp_path / "telemetry.json")
+        eng.export_telemetry(path)
+    host.close()
+    (sec,) = live["model_host"]
+    assert sec["kind"] == "demo" and sec["precision"] == "f32"
+    assert sec["counters"]["requests"] == 2
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["model_host"][0]["counters"]["requests"] == 2
+    # and the report renders it (pure-stdlib path)
+    import engine_report
+
+    out = engine_report.render(doc, steps=0)
+    assert "model host [demo]" in out

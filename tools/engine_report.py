@@ -25,7 +25,10 @@ render the windows block: policy tag, pane rotations, live panes + ring
 cursor, ewma decays applied, and the drift-tracker row (pane evals, alarms).
 Ragged engines (ISSUE 17) render the ragged-groups row: groups touched of
 the declared universe, per-group capacity, ingest volume, and overflow
-firings.
+firings. Engines with an embedded-model host attached (ISSUE 19,
+``engine.model_host``) render one model-host row per host: model kind,
+sharding mode + declared collective allowance, bucketed ingest volume, and
+the closed program set (compiles vs hits).
 When the engine ran with a flight recorder (``EngineConfig(trace=...)``,
 PR 8) the document carries a ``trace`` section and the report renders the
 trace/SLO block: spans recorded/dropped, latency histogram counts, and the
@@ -261,6 +264,34 @@ def render(doc: dict, steps: int = 10, analysis: dict = None) -> str:
                 f" / {_fmt(spb.get('quantized'))}B quantized",
             )
         )
+    hosts = doc.get("model_host") or s.get("model_host")
+    if hosts:
+        # embedded-model serving section (ISSUE 19): one row per attached
+        # resident host — what model it serves, its sharding mode + declared
+        # collective allowance, the bucketed/coalesced ingest volume, and the
+        # closed program set (bucket_compiles is the host's LIFETIME compile
+        # count; a steady-state host only ever grows bucket_hits). Documents
+        # without an attached host carry no block and render exactly as before.
+        for h in hosts:
+            c = h.get("counters", {})
+            rows.append(
+                (
+                    f"model host [{h.get('kind')}]",
+                    f"{h.get('sharding')} · {h.get('precision')}"
+                    f" · collectives {','.join(h.get('allowed_collectives') or []) or 'none'}"
+                    f" · {_fmt(c.get('items'))} {h.get('unit')} in "
+                    f"{_fmt(c.get('requests'))} requests"
+                    f" ({_fmt(c.get('batches'))} device batches, "
+                    f"{_fmt(c.get('coalesced_batches'))} coalesced)"
+                    f" · programs {_fmt(c.get('bucket_compiles'))} compiled / "
+                    f"{_fmt(c.get('bucket_hits'))} hits"
+                    + (
+                        f" · shared by {_fmt(c.get('shared_by'))} metrics"
+                        if (c.get("shared_by") or 0) > 1
+                        else ""
+                    ),
+                )
+            )
     reshard = s.get("reshard")
     if reshard:
         last = reshard.get("last") or {}

@@ -221,8 +221,12 @@ def parse_openmetrics(text: str) -> Dict[str, Dict[str, Any]]:
                 raise ValueError(f"line {ln}: malformed label {pair!r}")
             k, v = pair.split("=", 1)
             labels[k.strip()] = v.strip().strip('"')
-        family = next(
-            (f for f in families if name == f or name.startswith(f + "_")), None
+        # longest match: family names may prefix one another (e.g. an `items`
+        # counter next to an `items_per_s` gauge) — the sample belongs to the
+        # most specific family, not the first declared
+        family = max(
+            (f for f in families if name == f or name.startswith(f + "_")),
+            key=len, default=None,
         )
         if family is None:
             raise ValueError(f"line {ln}: sample {name!r} has no preceding TYPE")
