@@ -105,9 +105,16 @@ windows-smoke:
 # analysis rules while the fleet boundary fold carries the cross-host
 # collective; snapshot cuts ride the shared plan through the barrier
 # protocol; kill host 1 mid-stream -> both hosts restore from the last
-# CONSISTENT cut and replay to exact oracle parity. The parent bounds each
-# round's wall time and kills any worker still alive when a round ends
-# (orphan cleanup). Docs: docs/distributed.md "Multi-host serving".
+# CONSISTENT cut and replay to exact oracle parity. The tenancy phase
+# (ISSUE 20) reruns the plan on STREAM-SHARDED hosts (3 resident slots vs 8
+# home streams, Zipf traffic paging through host RAM) under a tumbling
+# window rotating on the shared plan cursor at cut-aligned positions:
+# bit-exact vs the windowed oracle through spills, zero steady compiles,
+# leg-labeled (intra/cross) fold-payload + spill gauges exported, and a
+# kill -> restore -> replay crossing a spill to exact parity. The parent
+# bounds each round's wall time and kills any worker still alive when a
+# round ends (orphan cleanup). Docs: docs/distributed.md "Multi-host
+# serving" + "Fleet tenancy".
 fleet-smoke:
 	JAX_PLATFORMS=cpu python -m metrics_tpu.engine.fleet.harness
 

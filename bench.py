@@ -1598,6 +1598,35 @@ def bench_fleet_sync() -> dict:
     return json.loads(proc.stdout.strip().splitlines()[-1])
 
 
+# ---------------------------------------------- config: fleet tenancy (r20)
+
+def bench_fleet_tenancy() -> dict:
+    """Fleet-scale tenancy (ISSUE 20): stream-sharded windowed fleet hosts
+    swept over a 16x stream-count range with a fixed resident arena —
+    device-resident bytes per host must stay FLAT while host-RAM spill rows
+    grow — plus the hierarchical fold's per-leg byte accounting at 2 hosts
+    (exact vs ``q8_block``, from the engine's own ``_fleet_leaf_info``) and
+    the ``q8_sum_error_bound`` oracle asserted on the real post-traffic
+    state. Single-process protocol (``fleet_bench tenancy`` owns it): the
+    residency and byte facts are analytic/deterministic, no interconnect
+    involved, so nothing here is a rate at all."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "metrics_tpu.engine.fleet.fleet_bench",
+             "tenancy"],
+            env=env, capture_output=True, text=True, timeout=900,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": "fleet_tenancy timed out"}
+    if proc.returncode != 0:
+        return {"error": proc.stderr[-500:]}
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
 # ---------------------------------------------- config: ragged serving (r17)
 
 def bench_ragged_serving() -> dict:
@@ -2623,6 +2652,7 @@ def main() -> None:
         ("engine_mesh_dispatch", bench_engine_mesh_dispatch),
         ("stream_capacity", bench_stream_capacity),
         ("fleet_sync", bench_fleet_sync),
+        ("fleet_tenancy", bench_fleet_tenancy),
         ("ragged_serving", bench_ragged_serving),
         ("model_serving", bench_model_serving),
         ("obs_overhead", bench_obs_overhead),

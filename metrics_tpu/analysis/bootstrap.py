@@ -143,6 +143,33 @@ def bootstrap_engines(
                 fleet.ingest(i % 2, *b)
             fleet.results()
         out.append((f"fleet/arena/multistream/{backend}", fleet.engine))
+        # STREAM-SHARDED WINDOWED FLEET host (ISSUE 20): the tenancy
+        # configuration — a paged, pane-extended arena whose rotations ride
+        # the shared plan cursor — serves through the same routed steady
+        # step, so the audited program set is the one a fleet-scale tenant
+        # host actually runs: collective-free slot-addressed updates (the
+        # hierarchical fold's cross leg lives ONLY in the boundary
+        # programs). Broken-fixture proof: a psum smuggled into this routed
+        # step fails `no-collectives-in-deferred-step` —
+        # tests/analysis/test_engine_audit.py.
+        from metrics_tpu.engine import WindowPolicy
+
+        fleet = FleetEngine(
+            Accuracy(),
+            FleetConfig(
+                num_streams=4, stream_shard=True, resident_streams=2,
+                engine=EngineConfig(
+                    buckets=(8,), kernel_backend=backend,
+                    mesh=mesh, axis="dp", mesh_sync="deferred",
+                    window=WindowPolicy.tumbling(pane_batches=2, n_panes=2),
+                ),
+            ),
+        )
+        with fleet:
+            for i, b in enumerate(batches):
+                fleet.ingest(i % 4, *b)
+            fleet.results()
+        out.append((f"fleet-sshard/arena/multistream/{backend}", fleet.engine))
         # WINDOWED engine (ISSUE 13): a sliding pane ring driven through TWO
         # real rotations — the audited step is the runtime-pane-indexed
         # ring update ((panes, n) carried buffers, one dynamic-update per

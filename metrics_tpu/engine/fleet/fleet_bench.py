@@ -1,8 +1,22 @@
-"""BENCH.fleet_sync: 2-host boundary-fold latency, exact vs q8_block.
+"""BENCH.fleet_sync + BENCH.fleet_tenancy: the fleet's wire and memory claims.
 
 ``python -m metrics_tpu.engine.fleet.fleet_bench`` spawns the harness's
 two-process bench scenario (gloo CPU collectives over loopback) and prints
-one JSON line:
+one JSON line; ``... fleet_bench tenancy`` instead runs the single-process
+tenancy protocol (BENCH.fleet_tenancy, ISSUE 20):
+
+* a stream-sharded windowed host swept over growing ``S`` with a FIXED
+  resident arena — device-resident bytes per host must stay FLAT while the
+  spilled rows grow (tenant capacity = fleet HBM + fleet host RAM);
+* the hierarchical fold's per-leg byte accounting at 2 hosts, exact vs
+  ``q8_block``, from ``hierarchical_fold_bytes`` over the engine's own
+  ``_fleet_leaf_info`` (the same source the runtime's stats record — the
+  bench can never drift from the wire);
+* the bounded-error oracle: the q8 fold of the engine's REAL post-traffic
+  state vs the exact f32 sum, elementwise within ``q8_sum_error_bound`` —
+  asserted, with the measured max error and bound recorded.
+
+The fleet_sync half:
 
 * per ``sync_precision`` policy — ``exact`` and a blanket ``q8_block`` (only
   ELIGIBLE float-sum states quantize; counters stay exact, per the ISSUE 10
@@ -64,8 +78,150 @@ def run() -> dict:
     }
 
 
+def _sharded_windowed_fleet(num_streams: int, sync_precision: str = "exact"):
+    """One degenerate (1-host) stream-sharded windowed fleet, post-traffic:
+    the arena/pager/leaf-info facts it exposes are exactly what a 2-host
+    member would hold — host count only enters the ANALYTIC fold legs."""
+    import numpy as np
+
+    import jax
+    from jax.sharding import Mesh
+
+    from metrics_tpu.engine import EngineConfig, WindowPolicy
+    from metrics_tpu.engine.fleet import FleetConfig, FleetEngine
+    from metrics_tpu.engine.fleet.harness import (
+        BUCKETS, RESIDENT, _collection,
+    )
+    from metrics_tpu.engine.traffic import zipf_traffic
+
+    col = _collection()
+    if sync_precision != "exact":
+        col.set_sync_precision(sync_precision)
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("dp",))
+    fleet = FleetEngine(
+        col,
+        FleetConfig(
+            num_streams=num_streams,
+            stream_shard=True,
+            resident_streams=RESIDENT,
+            engine=EngineConfig(
+                buckets=BUCKETS, mesh=mesh, axis="dp", mesh_sync="deferred",
+                window=WindowPolicy.tumbling(pane_batches=16, n_panes=2),
+            ),
+        ),
+    )
+    with fleet:
+        for sid, p, t in zipf_traffic(num_streams, 64, alpha=1.1, seed=7):
+            fleet.ingest(sid, p, t)
+        fleet.results()
+        return (
+            fleet,
+            {
+                "num_streams": num_streams,
+                "device_resident_bytes": int(
+                    sum(
+                        int(v.size) * v.dtype.itemsize
+                        for v in fleet.engine._state.values()
+                    )
+                ),
+                **{
+                    k: int(v)
+                    for k, v in fleet.engine._pager.tenancy_stats().items()
+                },
+            },
+        )
+
+
+def run_tenancy() -> dict:
+    """BENCH.fleet_tenancy (ISSUE 20): flat device residency, per-leg fold
+    bytes exact vs hierarchical q8, and the q8_sum_error_bound oracle."""
+    import numpy as np
+
+    import jax
+    from metrics_tpu.engine.fleet.harness import NUM_HOSTS, RESIDENT
+    from metrics_tpu.parallel.collectives import (
+        fused_sync_plan,
+        hierarchical_fold_bytes,
+        q8_roundtrip,
+        q8_sum_error_bound,
+    )
+
+    # ---- device residency sweep: S grows 16x, the arena must not move
+    sweep = []
+    for n_streams in (8, 32, 128):
+        fleet, row = _sharded_windowed_fleet(n_streams)
+        sweep.append(row)
+    flat = len({r["device_resident_bytes"] for r in sweep}) == 1
+    spill_grows = (
+        sweep[-1]["spilled_rows"] > sweep[0]["spilled_rows"] > 0
+    )
+
+    # ---- hierarchical fold legs at NUM_HOSTS, exact vs q8_block, from the
+    # engine's own leaf info (`fleet` is the last, largest sweep member)
+    legs = {}
+    for policy in ("exact", "q8_block"):
+        f = fleet if policy == "exact" else _sharded_windowed_fleet(
+            sweep[-1]["num_streams"], sync_precision=policy
+        )[0]
+        legs[policy] = hierarchical_fold_bytes(
+            f.engine._fleet_leaf_info(), NUM_HOSTS
+        )
+    exact_cross = legs["exact"]["cross_exact_bytes"] + legs["exact"]["cross_quant_bytes"]
+    q8_cross = legs["q8_block"]["cross_exact_bytes"] + legs["q8_block"]["cross_quant_bytes"]
+
+    # ---- bounded-error oracle on the REAL state: stack the host-logical
+    # q8-eligible leaves into a fake 2-host fold (second host = half the
+    # first — dyadic, so the EXACT sum is representable) and check the q8
+    # fold lands elementwise within q8_sum_error_bound
+    f_q8 = _sharded_windowed_fleet(sweep[-1]["num_streams"], "q8_block")[0]
+    info = f_q8.engine._fleet_leaf_info()
+    plan = fused_sync_plan(info, NUM_HOSTS)
+    leaves = jax.tree.leaves(f_q8.engine.state())
+    max_err = 0.0
+    max_bound = 0.0
+    holds = True
+    checked = 0
+    for i in plan["quantized"]:
+        piece = np.asarray(leaves[i], np.float32)
+        stacked = np.stack([piece, 0.5 * piece])
+        got = sum(np.asarray(q8_roundtrip(s)) for s in stacked)
+        err = np.abs(got - stacked.sum(axis=0))
+        bound = np.asarray(q8_sum_error_bound(stacked))
+        holds = holds and bool((err <= bound).all())
+        max_err = max(max_err, float(err.max()))
+        max_bound = max(max_bound, float(bound.max()))
+        checked += 1
+    return {
+        "num_hosts": NUM_HOSTS,
+        "resident_streams": RESIDENT,
+        "residency_sweep": sweep,
+        "device_resident_bytes_flat": bool(flat),
+        "spill_rows_grow_with_streams": bool(spill_grows),
+        "fold_legs": legs,
+        "cross_bytes_exact": int(exact_cross),
+        "cross_bytes_q8": int(q8_cross),
+        "cross_payload_ratio": (
+            round(exact_cross / q8_cross, 2) if q8_cross else None
+        ),
+        "q8_error_oracle": {
+            "leaves_checked": checked,
+            "max_abs_error": max_err,
+            "max_bound": max_bound,
+            "bound_holds": bool(holds),
+        },
+        "note": (
+            "single-process protocol: residency measured on a degenerate "
+            "sharded member (arena identical per host), fold legs analytic "
+            "via hierarchical_fold_bytes over the engine's _fleet_leaf_info "
+            "(the runtime's own accounting source), error oracle on the "
+            "real post-traffic state"
+        ),
+    }
+
+
 def main() -> int:
-    print(json.dumps(run()))
+    which = sys.argv[1] if len(sys.argv) > 1 else "sync"
+    print(json.dumps(run_tenancy() if which == "tenancy" else run()))
     return 0
 
 

@@ -32,6 +32,14 @@ workers write:
 6. **OpenMetrics** — each host's exposition strict-parses and carries the
    ``host``-labeled fleet families; the single-process oracle's exposition
    carries none (byte-stable vs a fleet-free engine).
+7. **Tenancy** (ISSUE 20) — the same plan served by STREAM-SHARDED hosts
+   whose paged arenas hold far fewer resident slots than their home
+   streams, under a tumbling window whose pane rotations ride the shared
+   plan cursor at cut-aligned positions: bit-exact vs the windowed
+   single-process oracle THROUGH spills, zero steady compiles across
+   paging and rotation, leg-labeled (``intra``/``cross``) fold-payload
+   and spill gauges exported, and a kill → restore → replay that crosses
+   a spill and still lands on exact oracle parity.
 
 The parent owns WALL-TIME bounds (per-round subprocess deadlines) and
 ORPHAN CLEANUP: any worker still alive when its round ends — timeout,
@@ -58,6 +66,13 @@ KILL_AT = 75             # plan position where host 1 dies (past cut 1 @ 60)
 SEED = 23
 KILL_EXIT = 17           # the simulated-death exit code
 ROUND_TIMEOUT_S = 420.0
+# tenancy phase (ISSUE 20): stream-sharded + windowed fleet — S streams per
+# host universe vs a RESIDENT-slot paged arena (S/NUM_HOSTS >> RESIDENT, so
+# Zipf traffic genuinely pages through host RAM), pane rotations riding the
+# shared plan cursor at cut-aligned positions (PANE_BATCHES % CUT_EVERY == 0)
+RESIDENT = 3
+PANE_BATCHES = 60
+N_PANES = 2
 
 
 def _collection():
@@ -130,12 +145,19 @@ def _build_fleet(spec: dict, pid: int, trace=None, snapshot_every=None):
     # steady step is then the REAL shard-local program the analysis rules pin
     # (a meshless engine would satisfy "no collectives" vacuously)
     mesh = Mesh(np.asarray(jax.local_devices()[:1]), ("dp",))
+    tenancy = bool(spec.get("tenancy"))
+    window = None
+    if tenancy:
+        from metrics_tpu.engine import WindowPolicy
+
+        window = WindowPolicy.tumbling(pane_batches=PANE_BATCHES, n_panes=N_PANES)
     ecfg = EngineConfig(
         buckets=BUCKETS,
         coalesce=int(spec.get("coalesce", 1)),
         mesh=mesh,
         axis="dp",
         mesh_sync="deferred",
+        window=window,
         trace=trace,
     )
     fcfg = FleetConfig(
@@ -144,6 +166,8 @@ def _build_fleet(spec: dict, pid: int, trace=None, snapshot_every=None):
         coordinator_address=spec.get("coord"),
         engine=ecfg,
         num_streams=S,
+        stream_shard=tenancy,
+        resident_streams=RESIDENT if tenancy else 0,
         snapshot_dir=spec.get("snapshot_dir"),
         snapshot_every=(
             int(snapshot_every) if snapshot_every is not None
@@ -206,6 +230,7 @@ def _scenario_serve(spec: dict, pid: int, out: dict) -> None:
     text = fleet.metrics_text()
     out["metrics_text"] = text
     out["fleet_block"] = fleet.telemetry().get("fleet")
+    out["rotations"] = int(fleet.engine.stats.pane_rotations)
 
 
 def _canon_json(v):
@@ -230,6 +255,11 @@ def _scenario_kill(spec: dict, pid: int, out: dict) -> None:
         fleet.flush()
         out["cursor"] = fleet.global_cursor
         out["cuts"] = fleet.engine.stats.fleet_cuts
+        pager = getattr(fleet.engine, "_pager", None)
+        if pager is not None:
+            # the death must land PAST a spill for the tenancy claim: the
+            # restored piece then re-homes rows out of the host-RAM store
+            out["spilled_rows"] = int(pager.tenancy_stats()["spilled_rows"])
     if pid == 1:
         # the simulated host death: no result(), no clean teardown, the
         # process is GONE. The artifact must be DURABLE before os._exit —
@@ -252,6 +282,11 @@ def _scenario_restore(spec: dict, pid: int, out: dict) -> None:
         for b in traffic[fleet.global_cursor:]:
             fleet.ingest(*b)
         out["results"] = _jsonable_results(fleet.results())
+        pager = getattr(fleet.engine, "_pager", None)
+        if pager is not None:
+            # "exact replay PAST a spill": the replayed half must itself have
+            # paged rows through host RAM, not just fit in the arena
+            out["spilled_rows"] = int(pager.tenancy_stats()["spilled_rows"])
 
 
 def _scenario_bench(spec: dict, pid: int, out: dict) -> None:
@@ -438,15 +473,16 @@ def main() -> int:
     )
     trace_export.parse_openmetrics(oracle_text)
 
-    def parity(tag, got):
-        for sid in want:
-            for k in want[sid]:
+    def parity(tag, got, ref=None):
+        ref = want if ref is None else ref
+        for sid in ref:
+            for k in ref[sid]:
                 check(
                     np.array_equal(
-                        np.asarray(got[sid][k]), np.asarray(want[sid][k]),
+                        np.asarray(got[sid][k]), np.asarray(ref[sid][k]),
                         equal_nan=True,
                     ),
-                    f"{tag}: stream {sid} {k} {got[sid][k]} != {want[sid][k]}",
+                    f"{tag}: stream {sid} {k} {got[sid][k]} != {ref[sid][k]}",
                 )
 
     # ------------------------------- two-process serve, TWICE (determinism)
@@ -562,6 +598,137 @@ def main() -> int:
         )
         parity(f"post-restore host {p}", outs[p]["results"])
 
+    # ---------------- tenancy phase (ISSUE 20): stream-sharded + windowed
+    # Same plan, but each host now runs a stream-sharded paged arena
+    # (RESIDENT slots << its S/NUM_HOSTS home streams, so Zipf traffic pages
+    # through host RAM) under a tumbling window whose rotations ride the
+    # SHARED plan cursor at cut-aligned positions. The oracle is the same
+    # single-process engine with the same window and NO sharding.
+    from metrics_tpu.engine import WindowPolicy
+
+    worc = MultiStreamEngine(
+        _collection(), S,
+        EngineConfig(
+            buckets=BUCKETS,
+            window=WindowPolicy.tumbling(
+                pane_batches=PANE_BATCHES, n_panes=N_PANES
+            ),
+        ),
+    )
+    with worc:
+        for sid, p, t in traffic:
+            worc.submit(sid, p, t)
+        wwant = _jsonable_results(worc.results())
+
+    rcs, outs = _run_pair("serve", workdir, "tenancy_serve", tenancy=True)
+    for p, (rc, o) in enumerate(zip(rcs, outs)):
+        check(
+            rc == 0 and "error" not in o,
+            f"tenancy serve host {p} failed: rc={rc} {o.get('error', '')[-800:]}",
+        )
+    if failed:
+        return 1
+    for p in range(NUM_HOSTS):
+        o = outs[p]
+        parity(f"tenancy host {p} vs windowed oracle", o["results"], ref=wwant)
+        check(
+            o["repeat_equal"],
+            f"tenancy host {p}: reset+replay results differ within one process",
+        )
+        check(
+            o["steady_compiles"] == 0,
+            f"tenancy host {p} compiled {o['steady_compiles']} programs after "
+            "warmup (expected 0 — paging and rotation reuse the closed set)",
+        )
+        # the serve scenario runs the plan TWICE; rotations ride the shared
+        # plan cursor, so each run rotates exactly N_BATCHES/PANE_BATCHES times
+        check(
+            o["rotations"] == 2 * (N_BATCHES // PANE_BATCHES),
+            f"tenancy host {p} rotated {o['rotations']} times, expected "
+            f"{2 * (N_BATCHES // PANE_BATCHES)}",
+        )
+        fb = o["fleet_block"] or {}
+        ten = fb.get("tenancy") or {}
+        check(
+            0 < ten.get("resident_rows", 0) <= RESIDENT,
+            f"tenancy host {p} resident_rows {ten.get('resident_rows')} "
+            f"outside (0, {RESIDENT}]",
+        )
+        check(
+            ten.get("spill_rows", 0) > 0 and ten.get("spill_bytes", 0) > 0,
+            f"tenancy host {p} never spilled ({ten}) — the phase must "
+            "genuinely page through host RAM",
+        )
+        fams = trace_export.parse_openmetrics(o["metrics_text"])
+        for fam in ("fleet_spill_rows", "fleet_spill_bytes", "fleet_resident_rows"):
+            check(
+                f"metrics_tpu_engine_{fam}" in fams,
+                f"tenancy host {p} exposition lacks {fam}",
+            )
+        legs = {
+            s.get("labels", {}).get("leg")
+            for s in fams.get(
+                "metrics_tpu_engine_fleet_payload_bytes", {}
+            ).get("samples", [])
+        }
+        check(
+            {"intra", "cross"} <= legs,
+            f"tenancy host {p} fleet_payload_bytes legs {legs} lack intra/cross",
+        )
+
+    # kill one host mid-pane, past a spill; restore from the consistent cut
+    # (which is ALSO a rotation boundary — PANE_BATCHES % CUT_EVERY == 0) and
+    # replay to exact windowed-oracle parity
+    tsnapdir = os.path.join(workdir, "tenancy_snaps")
+    rcs, outs = _run_pair(
+        "kill", workdir, "tenancy_kill", tenancy=True,
+        snapshot_dir=tsnapdir, snapshot_every=CUT_EVERY, coalesce=8,
+    )
+    check(
+        rcs[0] == 0 and rcs[1] == KILL_EXIT,
+        f"tenancy kill round exit codes {rcs} (wanted [0, {KILL_EXIT}])",
+    )
+    check(
+        "error" not in outs[0],
+        f"tenancy surviving host failed: {outs[0].get('error', '')[-800:]}",
+    )
+    check(
+        outs[0].get("spilled_rows", 0) > 0,
+        "tenancy kill landed before any spill — the death must strand rows "
+        "in the host-RAM store",
+    )
+    tk = last_consistent_cut(tsnapdir, NUM_HOSTS)
+    check(
+        tk == KILL_AT // CUT_EVERY - 1,
+        f"tenancy last consistent cut {tk}, expected {KILL_AT // CUT_EVERY - 1}",
+    )
+    rcs, outs = _run_pair(
+        "restore", workdir, "tenancy_restore", tenancy=True,
+        snapshot_dir=tsnapdir, snapshot_every=CUT_EVERY, coalesce=8,
+    )
+    for p, (rc, o) in enumerate(zip(rcs, outs)):
+        check(
+            rc == 0 and "error" not in o,
+            f"tenancy restore host {p} failed: rc={rc} {o.get('error', '')[-800:]}",
+        )
+    if failed:
+        return 1
+    for p in range(NUM_HOSTS):
+        check(
+            outs[p]["restored_cut"] == tk
+            and outs[p]["restored_cursor"] == expect_cursor,
+            f"tenancy host {p} restored cut/cursor {outs[p]['restored_cut']}/"
+            f"{outs[p]['restored_cursor']}, expected {tk}/{expect_cursor}",
+        )
+        check(
+            outs[p].get("spilled_rows", 0) > 0,
+            f"tenancy host {p} replay never paged a row — the parity claim "
+            "must cover the spill path",
+        )
+        parity(
+            f"post-restore tenancy host {p}", outs[p]["results"], ref=wwant
+        )
+
     if failed:
         return 1
     print(
@@ -577,7 +744,13 @@ def main() -> int:
         f"plan {KILL_AT} -> both hosts restored from consistent cut {k} "
         f"(cursor {expect_cursor}) and replayed to exact oracle parity; "
         "host-labeled OpenMetrics strict-parsed, single-process exposition "
-        "fleet-free (CPU harness: no interconnect, rates liveness_only)"
+        "fleet-free; tenancy phase: stream-sharded hosts "
+        f"({RESIDENT} resident slots vs {S // NUM_HOSTS} home streams) under "
+        f"a tumbling window rotating every {PANE_BATCHES} plan batches "
+        "matched the windowed oracle bit-exactly through spills, 0 steady "
+        "compiles, leg-labeled payload families exported, and kill->restore "
+        f"from cut {tk} replayed past a spill to exact parity "
+        "(CPU harness: no interconnect, rates liveness_only)"
     )
     return 0
 

@@ -124,6 +124,20 @@ class FleetConfig:
             ``MultiStreamEngine`` per host, stream ``sid`` homed on host
             ``sid % num_processes``). None serves a single accumulation
             (batches home by global plan position).
+        stream_shard: run each host's engine STREAM-SHARDED (ISSUE 20): the
+            host's paged arena carries ``resident_streams`` rows per local
+            shard and its pager owns the spill rows for the host's HOME
+            streams (``sid % num_processes`` homing — a non-home stream is
+            never touched, so the fleet boundary fold reads its reduction-
+            identity init row, exactly as it already does for non-home
+            hosts). Tenant capacity then scales with fleet HBM + fleet host
+            RAM instead of per-host HBM. Requires ``num_streams`` and an
+            inner ``EngineConfig(mesh=..., mesh_sync="deferred",
+            use_arena=True)``.
+        resident_streams: per-local-shard paged-arena slot budget under
+            ``stream_shard`` (0 = the engine's default: every local stream
+            resident). An HBM budget, not a coordinate — restore re-homes
+            across different residencies through the spill store.
         snapshot_dir: the FLEET snapshot directory (shared storage); host
             pieces land under ``host_<pid>/``.
         snapshot_every: cut cadence in GLOBAL plan batches for the
@@ -140,6 +154,8 @@ class FleetConfig:
     coordinator_address: Optional[str] = None
     engine: Any = None
     num_streams: Optional[int] = None
+    stream_shard: bool = False
+    resident_streams: int = 0
     snapshot_dir: Optional[str] = None
     snapshot_every: int = 0
     fleet_axis: str = "fleet"
@@ -322,13 +338,45 @@ class FleetEngine:
                 "serving work (same construction-time contract as "
                 "EngineConfig.snapshot_every)"
             )
-        if inner.window is not None and getattr(inner.window, "kind", "cumulative") != "cumulative":
+        if self._fcfg.stream_shard and self._fcfg.num_streams is None:
             raise MetricsTPUUserError(
-                "windowed serving is not supported in a fleet yet: a pane "
-                "rotation is a per-host state-structure event with no "
-                "fleet-consistent cut — serve windows single-process, or "
-                "cumulative in the fleet"
+                "FleetConfig(stream_shard=True) needs num_streams: stream "
+                "sharding partitions the per-stream paged arena, and a "
+                "single-accumulation fleet has no stream axis to shard — set "
+                "num_streams=S, or drop stream_shard and serve the single "
+                "accumulation with plan-position homing"
             )
+        if int(self._fcfg.resident_streams or 0) and not self._fcfg.stream_shard:
+            raise MetricsTPUUserError(
+                "FleetConfig.resident_streams only applies with "
+                "stream_shard=True (it is the per-shard paged-arena slot "
+                "budget) — set stream_shard=True, or drop resident_streams"
+            )
+        win = inner.window
+        self._windowed = (
+            win is not None and getattr(win, "kind", "cumulative") != "cumulative"
+        )
+        if self._windowed:
+            # the fleet window contract (ISSUE 20): rotations ride the SHARED
+            # plan cursor through the snapshot-cut protocol — the policy's own
+            # fleet-eligibility check refuses wall-clock cadence, ewma, and
+            # cat-state metrics, each naming the sanctioned alternative
+            reason = win.fleet_unsupported_reason(metric)
+            if reason is not None:
+                raise MetricsTPUUserError(f"windowed fleet serving: {reason}")
+            self._pane_batches = int(win.pane_batches)
+            every = int(self._fcfg.snapshot_every)
+            if every > 0 and self._pane_batches % every != 0:
+                raise MetricsTPUUserError(
+                    "fleet pane rotations ride the snapshot-cut protocol: "
+                    "window.pane_batches must be a multiple of "
+                    "FleetConfig.snapshot_every so every rotation lands on a "
+                    "barriered, fleet-consistent cut boundary (got "
+                    f"pane_batches={self._pane_batches}, "
+                    f"snapshot_every={every})"
+                )
+        else:
+            self._pane_batches = 0
         _ensure_distributed(self._fcfg)
         if H > 1:
             live = int(jax.process_count())
@@ -358,9 +406,20 @@ class FleetEngine:
         if S is None:
             self._engine = StreamingEngine(metric, inner, aot_cache=aot_cache)
         else:
+            ms_kwargs: Dict[str, Any] = {}
+            if self._fcfg.stream_shard:
+                ms_kwargs["stream_shard"] = True
+                if int(self._fcfg.resident_streams or 0):
+                    ms_kwargs["resident_streams"] = int(self._fcfg.resident_streams)
             self._engine = MultiStreamEngine(
-                metric, int(S), inner, aot_cache=aot_cache
+                metric, int(S), inner, aot_cache=aot_cache, **ms_kwargs
             )
+        if self._windowed:
+            # pane rotations fire ONLY from the shared plan cursor (ingest):
+            # the local batch cadence counts owned batches, which differ per
+            # host — it must stay silent or hosts would rotate at different
+            # ring positions
+            self._engine._fleet_rotation = True
         # stamp the host topology onto the local engine: every snapshot it
         # writes now carries (num_hosts, process_id) provenance, and its
         # restore path refuses cross-topology commits (pipeline.py)
@@ -374,6 +433,7 @@ class FleetEngine:
         self._global_cursor = 0
         self._next_cut = 0
         self._payload_split: Optional[Tuple[int, int]] = None
+        self._intra_bytes: Optional[int] = None
         if self._fcfg.snapshot_dir:
             self._host_dir = os.path.join(
                 self._fcfg.snapshot_dir, f"host_{pid:03d}"
@@ -452,6 +512,13 @@ class FleetEngine:
         traffic. Single-metric fleets accept any batch (the caller owns the
         split; :meth:`ingest` is the plan-driven alternative).
         """
+        if self._windowed:
+            raise MetricsTPUUserError(
+                "a windowed fleet is driven through FleetEngine.ingest(): "
+                "pane rotations fire at shared-plan positions, and a direct "
+                "submit() has no plan cursor to rotate against — drive the "
+                "shared global plan through ingest() on every host"
+            )
         if self._fcfg.num_streams is not None:
             sid = int(args[0])
             if sid % self._H != self._pid:
@@ -485,6 +552,14 @@ class FleetEngine:
             self._engine.submit(*args, **kwargs)
         self._engine.stats.record_fleet_ingest(owned)
         self._global_cursor = pos + 1
+        # pane rotation BEFORE the cut at the same plan position — the same
+        # ordering the single-process engine pins (a boundary snapshot
+        # carries the post-rotation ring), so a restore at the cut never
+        # re-rotates the boundary on replay. Both cadences are pure
+        # functions of the shared cursor: every host rotates and cuts at
+        # identical plan positions with no clock anywhere.
+        if self._pane_batches > 0 and self._global_cursor % self._pane_batches == 0:
+            self._engine.rotate_pane()
         every = int(self._fcfg.snapshot_every)
         if every > 0 and self._global_cursor % every == 0:
             self.fleet_snapshot()
@@ -509,8 +584,23 @@ class FleetEngine:
     def _host_abstract(self) -> Any:
         """This host's LOGICAL state template — what ``engine.state()``
         returns: the merged-global-within-host tree under a local deferred
-        mesh, the (S, ...)-stream-stacked tree for multi-stream engines."""
+        mesh, the (S, ...)-stream-stacked tree for multi-stream engines, and
+        the ``(panes, S, ...)`` pane-EXTENDED tree for a windowed stream-
+        sharded host (``state()`` regroups the pager's ext-id rows by pane;
+        ``_win_stacked`` is off under stream_shard, so the generic pane
+        stacking never applies and the lead axis is added here)."""
+        import jax
+
         eng = self._engine
+        if getattr(eng, "_stream_shard", False):
+            pane_rows = int(getattr(eng, "_pane_rows", 1))
+            lead = (int(eng._num_streams),)
+            if pane_rows > 1:
+                lead = (pane_rows,) + lead
+            return jax.tree.map(
+                lambda s: jax.ShapeDtypeStruct(lead + tuple(s.shape), s.dtype),
+                eng._metric.abstract_state(),
+            )
         if eng._deferred:
             return eng._merged_abstract()
         return eng._abstract_state_tree()
@@ -563,6 +653,21 @@ class FleetEngine:
         local = jax.device_put(row, self._fleet_device)
         return jax.make_array_from_single_device_arrays((self._H,), sh, [local])
 
+    def _replicated_scalar(self, value: int):
+        """A fleet-replicated 0-d int32 — the runtime pane-cursor argument of
+        a tumbling fleet's result program (every host holds the same cursor:
+        rotations are pure functions of the shared plan cursor)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sh = NamedSharding(self._mesh, P())
+        x = jnp.asarray(int(value), jnp.int32)
+        if self._H == 1:
+            return jax.device_put(x, sh)
+        local = jax.device_put(x, self._fleet_device)
+        return jax.make_array_from_single_device_arrays((), sh, [local])
+
     def _merge_program(self):
         """AOT: host-stacked logical states -> replicated GLOBAL state, one
         ``fused_axis_sync`` bundle over the fleet axis (the existing
@@ -590,16 +695,26 @@ class FleetEngine:
     def _result_program(self):
         """AOT: host-stacked states -> replicated metric VALUES — the merge
         and the compute fused into ONE SPMD program per boundary read (a
-        vmapped per-stream compute for multi-stream fleets)."""
+        vmapped per-stream compute for multi-stream fleets). Windowed fleets
+        add the window fold AFTER the host merge: sliding folds the live
+        pane set through ``merge_stacked_states``, tumbling indexes the
+        current pane with a RUNTIME replicated cursor (one program across
+        rotations — the window tag is in the key, the cursor is data)."""
         import jax
+        from jax import lax
+        from jax.sharding import NamedSharding, PartitionSpec as P
 
         from metrics_tpu.parallel.embedded import sharded_state_merge
 
         eng = self._engine
         multistream = self._fcfg.num_streams is not None
+        windowed = self._windowed
+        tumbling = windowed and eng._window.kind == "tumbling"
+        name = f"fleet_result{'_all' if multistream else ''}+k.{eng._kernel_tag()}"
+        if windowed:
+            name += f"+w.{eng._window_tag()}"
         key = eng._aot.program_key(
-            f"fleet_result{'_all' if multistream else ''}+k.{eng._kernel_tag()}",
-            eng._metric_fp,
+            name, eng._metric_fp,
             arg_tree=self._stacked_abstract(), mesh=self._mesh, donate=False,
             sync="fleet", precision=eng._precision_tag,
         )
@@ -611,14 +726,30 @@ class FleetEngine:
                 state_template=self._host_abstract(), unpack=None,
             )
 
-            def run(stacked):
+            def run(stacked, *extra):
                 merged = merge(stacked)
+                if tumbling:
+                    merged = jax.tree.map(
+                        lambda x: lax.dynamic_index_in_dim(
+                            x, extra[0], 0, keepdims=False
+                        ),
+                        merged,
+                    )
+                elif windowed:  # sliding: fold the live pane set
+                    merged = metric.merge_stacked_states(merged)
                 if multistream:
                     return jax.vmap(metric.compute_from)(merged)
                 return metric.compute_from(merged)
 
+            abs_args = (self._stacked_abstract(),)
+            if tumbling:
+                abs_args += (
+                    jax.ShapeDtypeStruct(
+                        (), np.int32, sharding=NamedSharding(self._mesh, P())
+                    ),
+                )
             with eng._kernel_scope():
-                return jax.jit(run).lower(self._stacked_abstract()).compile()
+                return jax.jit(run).lower(*abs_args).compile()
 
         return eng._aot.get_or_compile(key, build)
 
@@ -659,11 +790,39 @@ class FleetEngine:
         scales by S exactly like the unsharded multistream merge's)."""
         if self._payload_split is None:
             # the engine's own accounting formula at world = the host count:
-            # _payload_leaf_info keeps fx <-> leaf pairing and multistream
-            # S-scaling correct, and sharing _payload_split_for means the
+            # _fleet_leaf_info keeps fx <-> leaf pairing and multistream
+            # S-scaling (and pane-scaling, and the stream-shard LOGICAL
+            # shapes) correct, and sharing _payload_split_for means the
             # split convention can never diverge from the mesh surface's
-            self._payload_split = self._engine._payload_split_for(self._H)
+            self._payload_split = self._engine._payload_split_for(
+                self._H, leaf_info=self._engine._fleet_leaf_info()
+            )
         return self._payload_split
+
+    def _fleet_intra_bytes(self) -> int:
+        """Bytes of the host-LOCAL logical tree each boundary folds before
+        anything crosses the wire — the hierarchical fold's intra-host leg
+        (scales with this host's stream residency; the cross legs above
+        scale with hosts). One number per fold, analytic like the split."""
+        if self._intra_bytes is None:
+            from metrics_tpu.parallel.collectives import hierarchical_fold_bytes
+
+            info = self._engine._fleet_leaf_info() or []
+            self._intra_bytes = hierarchical_fold_bytes(info, self._H)[
+                "intra_bytes"
+            ]
+        return self._intra_bytes
+
+    def _refresh_tenancy(self) -> None:
+        """Mirror the stream pager's residency/spill gauges into the fleet
+        stats block (stream-sharded hosts only) — the observable that pins
+        per-host device residency FLAT while the stream universe grows."""
+        if not getattr(self._engine, "_stream_shard", False):
+            return
+        t = self._engine._pager.tenancy_stats()
+        self._engine.stats.record_fleet_tenancy(
+            t["resident_rows"], t["spilled_rows"], t["spill_bytes"]
+        )
 
     # ------------------------------------------------------------------ boundaries
 
@@ -708,17 +867,24 @@ class FleetEngine:
         out, us = self._boundary_collective(
             self._merge_program(), (self._fleet_stack(host_tree),)
         )
-        self._engine.stats.record_fleet_merge(us, *self._fleet_payload_split())
+        self._engine.stats.record_fleet_merge(
+            us, *self._fleet_payload_split(), intra_bytes=self._fleet_intra_bytes()
+        )
+        self._refresh_tenancy()
         return out
 
     def _boundary_values(self) -> Any:
         self._engine.flush()
         host_tree = self._engine.state()
-        vals, us = self._boundary_collective(
-            self._result_program(), (self._fleet_stack(host_tree),)
-        )
+        args: Tuple[Any, ...] = (self._fleet_stack(host_tree),)
+        if self._windowed and self._engine._window.kind == "tumbling":
+            args += (self._replicated_scalar(int(self._engine._pane_cursor)),)
+        vals, us = self._boundary_collective(self._result_program(), args)
         st = self._engine.stats
-        st.record_fleet_merge(us, *self._fleet_payload_split())
+        st.record_fleet_merge(
+            us, *self._fleet_payload_split(), intra_bytes=self._fleet_intra_bytes()
+        )
+        self._refresh_tenancy()
         tr = self._engine.trace
         if tr is not None:
             from metrics_tpu.engine.trace import ENGINE_TRACE
@@ -900,7 +1066,8 @@ class FleetEngine:
     def telemetry(self) -> Dict[str, Any]:
         """The local engine's telemetry document; its summary carries the
         ``fleet`` block (host id, streams owned, barrier/cut/merge counts,
-        per-fold sync payload bytes)."""
+        per-fold sync payload bytes, tenancy gauges)."""
+        self._refresh_tenancy()
         return self._engine.telemetry()
 
     def export_telemetry(self, path: str) -> None:
@@ -912,6 +1079,7 @@ class FleetEngine:
         a ``fleet_*`` family, so their expositions stay byte-stable."""
         from metrics_tpu.engine.trace import render_openmetrics
 
+        self._refresh_tenancy()
         base = self._engine.metrics_text()
         st = self._engine.stats
         h = str(self._pid)
@@ -925,11 +1093,25 @@ class FleetEngine:
                 "host",
                 {h: st.fleet_payload_exact_bytes + st.fleet_payload_quant_bytes},
             ),
+            # the hierarchical fold by leg (ISSUE 20): intra = host-local
+            # exact merges (scale with residency), cross = what actually
+            # crossed hosts (scales with hosts, not streams, under q8)
+            "fleet_payload_bytes": (
+                "leg",
+                {
+                    "intra": st.fleet_payload_intra_bytes,
+                    "cross": st.fleet_payload_exact_bytes
+                    + st.fleet_payload_quant_bytes,
+                },
+            ),
         }
         gauges = {
             "fleet_num_hosts": self._H,
             "fleet_process_id": self._pid,
             "fleet_streams_owned": st.fleet_streams_owned,
+            "fleet_spill_rows": st.fleet_spill_rows,
+            "fleet_spill_bytes": st.fleet_spill_bytes,
+            "fleet_resident_rows": st.fleet_resident_rows,
         }
         fleet_text = render_openmetrics({}, (), labeled_counters=labeled, gauges=gauges)
         # one exposition: the base's EOF terminator moves to the end
@@ -999,6 +1181,29 @@ def restore_fleet_into(engine: Any, fleet_dir: str) -> Dict[str, Any]:
                 f"host {pid}'s piece for cut {k} carries fleet_cut="
                 f"{meta.get('fleet_cut')} — marker and snapshot disagree"
             )
+        snap_sshard = bool(int(meta.get("stream_shard", 0) or 0))
+        if snap_sshard:
+            # a stream-sharded host piece is {arena, pager} — resident rows
+            # on device, spilled rows in host RAM, init rows implicit. The
+            # engine-free static reassembly returns the piece's LOGICAL
+            # tree ((panes, S, ...) under a ring window), so the cross-host
+            # stack-merge below is shape-blind to how each host paged
+            from metrics_tpu.engine.multistream import MultiStreamEngine
+
+            if str(meta.get("codec", "") or "") and str(
+                meta.get("codec_fp", "") or ""
+            ) != engine._precision_tag:
+                raise MetricsTPUUserError(
+                    "compressed stream-shard fleet piece was written under "
+                    f"sync_precision policy {meta.get('codec_fp')!r}, the "
+                    f"target engine's metric declares "
+                    f"{engine._precision_tag!r}; restore with the matching "
+                    "policy"
+                )
+            logical = MultiStreamEngine.sshard_piece_logical(metric, state, meta)
+            logicals.append(jax.tree.map(jnp.asarray, logical))
+            metas.append(meta)
+            continue
         if str(meta.get("codec", "") or ""):
             from metrics_tpu.engine.quantize import decode_state_tree
 
@@ -1027,6 +1232,26 @@ def restore_fleet_into(engine: Any, fleet_dir: str) -> Dict[str, Any]:
             logical = engine._unpack(state) if packed else state
         logicals.append(jax.tree.map(jnp.asarray, logical))
         metas.append(meta)
+    if str(metas[0].get("window", "") or ""):
+        # a windowed fleet rotates at fleet-consistent plan positions, so
+        # every piece must agree on the ring coordinates; disagreement means
+        # the dir mixes cuts (or a host rotated off-plan) — refuse, the
+        # merged pane ring would silently mix window generations
+        rings = {
+            (
+                str(m.get("window", "") or ""),
+                int(m.get("pane_cursor", 0) or 0),
+                int(m.get("rotations", 0) or 0),
+            )
+            for m in metas
+        }
+        if len(rings) > 1:
+            raise FleetTopologyError(
+                f"host pieces disagree on the pane ring {sorted(rings)} — "
+                "fleet rotations are plan-consistent by contract, so the "
+                "fleet dir is torn; restore a consistent cut with "
+                "FleetEngine.restore()"
+            )
     stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *logicals)
     merged = metric.merge_stacked_states(stacked)
     out_meta = dict(metas[0])
@@ -1038,6 +1263,8 @@ def restore_fleet_into(engine: Any, fleet_dir: str) -> Dict[str, Any]:
         world=1,
         codec="",
         arena_fp="",
+        stream_shard=0,
+        resident=0,
         step=sum(int(m.get("step", 0)) for m in metas),
         batches_done=sum(int(m.get("batches_done", 0)) for m in metas),
         rows_in=sum(int(m.get("rows_in", 0)) for m in metas),

@@ -332,6 +332,28 @@ class MultiStreamEngine(StreamingEngine):
             for fx, leaf, prec in info
         ]
 
+    def _fleet_leaf_info(self) -> Optional[Any]:
+        # the fleet fold moves this host's LOGICAL state — the host-side
+        # reassembled ``(S, ...)`` tree (``(panes, S, ...)`` under ring
+        # windows) for stream-sharded engines, so the FLEET accounting
+        # S-scales even though the per-mesh accounting stays unscaled (the
+        # routed steady step never puts the stacked state on the wire);
+        # unsharded engines inherit the (panes x) S-scaled base form
+        if not self._stream_shard:
+            return super()._fleet_leaf_info()
+        info = StreamingEngine._payload_leaf_info(self)
+        if not info:
+            return info
+        lead = (
+            (self._num_streams,)
+            if self._pane_rows == 1
+            else (self._pane_rows, self._num_streams)
+        )
+        return [
+            (fx, jax.ShapeDtypeStruct(lead + tuple(leaf.shape), leaf.dtype), prec)
+            for fx, leaf, prec in info
+        ]
+
     def _traced_update(self, state_tree: Any, payload: Any, mask: Any) -> Any:
         a, kw = payload
         ids, rest = a[0], a[1:]
@@ -1395,19 +1417,26 @@ class MultiStreamEngine(StreamingEngine):
         return out
 
     def _seeded_pager_payload(
-        self, rows: Dict[str, np.ndarray], init_row: Dict[str, np.ndarray]
+        self,
+        rows: Dict[str, np.ndarray],
+        init_row: Dict[str, np.ndarray],
+        num_rows: Optional[int] = None,
     ) -> Dict[str, Any]:
         """A pager payload (EMPTY slot table + spill store) carrying every
         non-init stream row under THIS engine's ``(world, resident)`` homing
         — the cross-topology half of the stream-shard restore matrix.
-        Init-equal rows are skipped (their streams fault in the init row like
-        any untouched stream); a row containing NaN compares unequal and
-        spills — conservative, never lossy."""
+        ``num_rows`` overrides the row-universe size for pane-EXTENDED
+        windowed rings (same coordinate math — ``e % world`` / ``e // world``
+        — over the larger id space). Init-equal rows are skipped (their
+        streams fault in the init row like any untouched stream); a row
+        containing NaN compares unequal and spills — conservative, never
+        lossy."""
+        n = int(num_rows) if num_rows is not None else self._num_streams
         payload: Dict[str, Any] = {
             "slots": np.full((self._world, self._resident), -1, np.int64)
         }
         keys = sorted(rows)
-        diff = np.zeros((self._num_streams,), bool)
+        diff = np.zeros((n,), bool)
         for k in keys:
             diff |= ~np.all(
                 np.asarray(rows[k]) == np.asarray(init_row[k])[None], axis=1
@@ -1420,6 +1449,77 @@ class MultiStreamEngine(StreamingEngine):
             for k in keys:
                 payload[f"spill_{k}"] = np.asarray(rows[k])[sids]
         return payload
+
+    @staticmethod
+    def sshard_piece_logical(metric: Any, state: Any, meta: Dict[str, Any]) -> Any:
+        """One stream-shard snapshot piece -> its LOGICAL state tree:
+        ``(S, ...)`` unwindowed, ``(panes, S, ...)`` for a pane-stacked ring.
+        Static and engine-free — ``restore_fleet_into`` folds one piece per
+        host without standing up H sharded engines. Resident slots, spilled
+        rows, and init rows reassemble exactly as the single-process merged
+        restore does; a compressed piece decodes through the metric's own
+        at-rest codec (same policy-fingerprint contract as ``_restore_commit``,
+        which the caller checks against ``meta['codec_fp']``)."""
+        arena = state.get("arena") if isinstance(state, dict) else None
+        pager_payload = state.get("pager") if isinstance(state, dict) else None
+        if arena is None or pager_payload is None:
+            raise MetricsTPUUserError(
+                "stream-shard snapshot payload is missing arena/pager parts"
+            )
+        world = int(meta.get("world", 1))
+        s_snap = int(meta.get("num_streams", 0))
+        pane_rows = (
+            int(meta.get("panes", 0) or 0)
+            if str(meta.get("window", "") or "")
+            else 1
+        ) or 1
+        if str(meta.get("codec", "") or ""):
+            from metrics_tpu.engine.quantize import ArenaRowCodec as _ARC
+
+            codec = _ARC.for_metric(metric)
+            if codec is not None and codec.is_encoded(arena):
+                arena = codec.decode_buffers(
+                    {k: np.asarray(v) for k, v in arena.items()}
+                )
+            spill = {
+                k[len("spill_"):]: pager_payload[k]
+                for k in pager_payload
+                if k.startswith("spill_") and k != "spill_coords"
+            }
+            if spill and codec is not None and codec.is_encoded(spill):
+                decoded = codec.decode_buffers(spill)
+                pager_payload = {
+                    k: v
+                    for k, v in pager_payload.items()
+                    if not (k.startswith("spill_") and k != "spill_coords")
+                }
+                for k, v in decoded.items():
+                    pager_payload[f"spill_{k}"] = v
+        layout = ArenaLayout.for_state(metric.abstract_state())
+        init_row = {
+            k: np.asarray(v)
+            for k, v in layout.pack(
+                jax.tree.map(jnp.asarray, metric.init_state())
+            ).items()
+        }
+        if pane_rows == 1:
+            rows = MultiStreamEngine._rows_from_parts(
+                arena, pager_payload, init_row, s_snap, world
+            )
+            return layout.unpack_stacked({k: jnp.asarray(v) for k, v in rows.items()})
+        # pane-extended ring: reassemble the EXT universe then regroup each
+        # (pane, stream) row through the same ext-id bijection the live
+        # engine routes by — a pure function of (world, pane_rows)
+        num_rows = -(-s_snap // world) * pane_rows * world
+        rows = MultiStreamEngine._rows_from_parts(
+            arena, pager_payload, init_row, num_rows, world
+        )
+        sids = np.arange(s_snap, dtype=np.int64)
+        ext = (
+            (sids // world) * pane_rows + np.arange(pane_rows, dtype=np.int64)[:, None]
+        ) * world + (sids % world)[None, :]
+        stacked = {k: jnp.asarray(np.asarray(v)[ext]) for k, v in rows.items()}
+        return layout.unpack_stacked(stacked, lead=2)
 
     def result(self, stream_id: int) -> Any:  # type: ignore[override]
         """Flush, then compute ``stream_id``'s accumulated value. Unsharded:
@@ -1674,23 +1774,21 @@ class MultiStreamEngine(StreamingEngine):
         # MEAN (stream, pane) only under the policy that wrote them
         self._check_window_provenance(meta)
         if snap_shard and str(meta.get("window", "") or ""):
-            # windowed stream-shard snapshots restore VERBATIM only: the
-            # pane-extended row coordinates have no exact cross-topology
-            # re-homing (a mid-pane ring is not reconstructible under a
-            # different world/residency or on a merged unsharded target)
+            # windowed stream-shard snapshots restore into the SAME WORLD
+            # only: the pane-extended row id ``eloc = loc * panes + pane``
+            # is a pure function of (world, panes), so a same-world engine
+            # with a DIFFERENT residency re-homes exactly through the spill
+            # store (ISSUE 20 — resident_streams is an HBM budget, not a
+            # coordinate), while a world change or a merged unsharded target
+            # would re-interleave mid-pane ring coordinates
             w_snap = int(meta.get("world", 1))
-            r_snap = int(meta.get("resident", 0))
-            if (
-                not self._stream_shard
-                or w_snap != self._world
-                or r_snap != self._resident
-            ):
+            if not self._stream_shard or w_snap != self._world:
                 raise MetricsTPUUserError(
-                    "a WINDOWED stream-shard snapshot restores verbatim into the "
-                    f"same (world, resident) stream-sharded topology only "
-                    f"(snapshot ({w_snap}, {r_snap})): pane-extended pager rows "
-                    "have no exact cross-topology re-homing — restore into a "
-                    "same-topology engine, or snapshot from an unwindowed one"
+                    "a WINDOWED stream-shard snapshot restores into a "
+                    f"same-world stream-sharded topology only (snapshot world "
+                    f"{w_snap}): pane-extended pager rows have no exact "
+                    "cross-world re-homing — restore into a same-world engine "
+                    "(any resident_streams), or snapshot from an unwindowed one"
                 )
         if not snap_shard:
             raise MetricsTPUUserError(
@@ -1758,18 +1856,24 @@ class MultiStreamEngine(StreamingEngine):
             # the (S, n) row matrices from the snapshot's parts and seed the
             # NEW pager's spill store with every non-init row under this
             # engine's homing rule — the arena starts all-init, rows fault in
-            # on first touch, and replay from the cursor stays bit-exact
+            # on first touch, and replay from the cursor stays bit-exact.
+            # Windowed rings reach here only with world_snap == self._world
+            # (the refusal above), so the pane-EXTENDED row universe keeps
+            # its coordinates and only residency re-homes
             init_row = {
                 k: np.asarray(v)
                 for k, v in row_layout.pack(
                     jax.tree.map(jnp.asarray, self._metric.init_state())
                 ).items()
             }
+            num_rows = (
+                self._num_streams if self._pane_rows == 1 else self._ext_universe()
+            )
             rows = self._rows_from_parts(
                 arena, self._decoded_pager_payload(pager_payload, codec=snap_codec),
-                init_row, self._num_streams, world_snap,
+                init_row, num_rows, world_snap,
             )
-            seeded = self._seeded_pager_payload(rows, init_row)
+            seeded = self._seeded_pager_payload(rows, init_row, num_rows=num_rows)
             new_state = self._put_state(self._metric.init_state())
             with self._state_lock:
                 self._finish_restore(new_state, meta)

@@ -104,6 +104,19 @@ class StreamPager:
     def _row_nbytes(row: Optional[Dict[str, np.ndarray]]) -> int:
         return sum(int(v.nbytes) for v in row.values()) if row else 0
 
+    def tenancy_stats(self) -> Dict[str, int]:
+        """The pager's contribution to the fleet tenancy gauges (ISSUE 20):
+        resident/spilled row counts and the spill store's host-RAM bytes —
+        one O(world) scrape the OpenMetrics exposition and ``engine_report``
+        read per refresh, so per-host device residency can be asserted FLAT
+        while the stream universe grows."""
+        return {
+            "resident_rows": self.resident_count(),
+            "spilled_rows": self.spilled_count(),
+            "spill_bytes": self.spill_nbytes(),
+            "capacity_rows": self.world * self.resident,
+        }
+
     def resident_streams(self, shard: int) -> Tuple[int, ...]:
         return tuple(self._lru[shard])
 

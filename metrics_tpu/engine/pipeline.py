@@ -579,6 +579,13 @@ class StreamingEngine:
         self._fleet_pid = 0
         self._fleet_cut: Optional[int] = None  # stamped per fleet snapshot cut
         self._fleet_plan_cursor = 0  # global-plan position at the stamped cut
+        # fleet-driven pane rotation (ISSUE 20): the FleetEngine sets this so
+        # the LOCAL batch cadence goes quiet — a fleet host's _batches_done
+        # counts only OWNED plan batches, so per-host cadence would rotate at
+        # host-dependent positions; the fleet drives rotate_pane() from the
+        # shared global plan cursor instead (every host rotates at the same
+        # plan-agreed boundary, no clock, no collective)
+        self._fleet_rotation = False
         # the layout always describes ONE pane's packing (kind tree): ring
         # windows stack (panes, n) buffers of these rows, and the per-row
         # plan is what pack_stacked/unpack_stacked apply slot-wise
@@ -1352,14 +1359,31 @@ class StreamingEngine:
         info_fn = getattr(self._metric, "sync_leaf_info", None)
         return info_fn() if info_fn is not None else None
 
-    def _payload_split_for(self, world: int) -> Tuple[int, int]:
+    def _fleet_leaf_info(self) -> Optional[Any]:
+        """The ``(fx, leaf, precision)`` triples ONE HOST's logical state
+        contributes to the FLEET boundary fold — shaped like what
+        ``state()`` returns, which is what the fleet stacks and folds.
+        Pane-stacked ring engines scale by the pane count (the fold moves
+        the whole ring); the stream-sharded engine overrides with its
+        (panes, S)-scaled form (its per-mesh accounting stays unscaled —
+        the routed step never syncs the stacked state)."""
+        info = self._payload_leaf_info()
+        if not info or not self._win_stacked:
+            return info
+        return [
+            (fx, jax.ShapeDtypeStruct((self._panes,) + tuple(leaf.shape), leaf.dtype), prec)
+            for fx, leaf, prec in info
+        ]
+
+    def _payload_split_for(self, world: int, leaf_info: Any = None) -> Tuple[int, int]:
         """(exact_bytes, quantized_bytes) one participant contributes to a
         fused sync of this engine's carried state over a ``world``-wide axis
         — THE payload-accounting formula, shared by the per-engine memoized
         :meth:`_sync_payload_split` (world = the mesh) and the fleet's
-        boundary accounting (world = the host count), so the split
-        convention can never diverge between the two surfaces."""
-        info = self._payload_leaf_info()
+        boundary accounting (world = the host count, ``leaf_info`` = the
+        host-logical :meth:`_fleet_leaf_info`), so the split convention can
+        never diverge between the two surfaces."""
+        info = leaf_info if leaf_info is not None else self._payload_leaf_info()
         if not info:
             return (0, 0)
         from metrics_tpu.parallel.collectives import (
@@ -2874,11 +2898,38 @@ class StreamingEngine:
         w = self._window
         if w is None:
             return
+        if self._fleet_rotation:
+            # a fleet host rotates only when its FleetEngine says so
+            # (rotate_pane() at shared-plan pane boundaries) — the local
+            # cadence counts owned batches only and would drift per host
+            return
         due = w.rotations_due(
             self._batches_done, self._last_rotate_batches,
             self._win_clock(), self._last_rotate_time,
         )
         for _ in range(due):
+            self._rotate_once_locked()
+
+    def rotate_pane(self) -> None:
+        """Rotate the pane ring NOW, at an externally chosen batch boundary.
+
+        The fleet composition seam (ISSUE 20): a windowed fleet host's
+        rotation boundaries are positions of the SHARED ingest plan, not of
+        its local (owned-batches-only) replay cursor — the FleetEngine
+        flushes and calls this when the global cursor crosses a pane
+        boundary, so every host rotates at the same plan-agreed position
+        with no clock and no collective (the shared plan IS the agreement).
+        The flush first means every batch submitted before the boundary
+        folds into the closing pane; the rotation itself is the same
+        plan/commit split as the cadence path.
+        """
+        if self._window is None or self._window.kind == "cumulative":
+            raise MetricsTPUUserError(
+                "rotate_pane() needs a rotating config.window (tumbling/"
+                "sliding/ewma); this engine serves cumulative state"
+            )
+        self.flush()
+        with self._state_lock:
             self._rotate_once_locked()
 
     def _rotate_once_locked(self) -> None:

@@ -175,6 +175,15 @@ class EngineStats:
         # what actually crossed hosts
         self.fleet_payload_exact_bytes = 0
         self.fleet_payload_quant_bytes = 0
+        # fleet tenancy (ISSUE 20): the hierarchical fold's INTRA-host leg
+        # (bytes the host-local exact merge folds per boundary — scales with
+        # this host's stream residency) vs the cross legs above (scale with
+        # hosts), plus the stream pager's spill gauges — per-host device
+        # residency stays flat while spilled tenants grow host RAM only
+        self.fleet_payload_intra_bytes = 0
+        self.fleet_spill_rows = 0
+        self.fleet_spill_bytes = 0
+        self.fleet_resident_rows = 0
         # ragged serving (ISSUE 17): group-keyed ingestion. ragged_groups
         # None = not a ragged engine (every prior telemetry document stays
         # byte-stable); capacity is the per-group row budget gauge. The
@@ -349,15 +358,34 @@ class EngineStats:
                 self.fleet_skipped += 1
 
     def record_fleet_merge(
-        self, merge_us: float, exact_bytes: int = 0, quant_bytes: int = 0
+        self,
+        merge_us: float,
+        exact_bytes: int = 0,
+        quant_bytes: int = 0,
+        intra_bytes: int = 0,
     ) -> None:
         """One cross-host boundary fold (the fleet ``result()``/``results()``
-        collective), with the bytes THIS host contributed to it."""
+        collective), with the bytes THIS host contributed to it —
+        ``intra_bytes`` is the hierarchical fold's host-LOCAL exact leg (the
+        logical state this host folds before anything crosses the wire),
+        exact/quant are the cross-host legs."""
         with self._counter_lock:
             self.fleet_merges += 1
             self.fleet_merge_us_total += float(merge_us)
             self.fleet_payload_exact_bytes += int(exact_bytes)
             self.fleet_payload_quant_bytes += int(quant_bytes)
+            self.fleet_payload_intra_bytes += int(intra_bytes)
+
+    def record_fleet_tenancy(
+        self, resident_rows: int, spill_rows: int, spill_bytes: int
+    ) -> None:
+        """Refresh the per-host tenancy gauges from the stream pager (device-
+        resident rows stay FLAT as the stream universe grows; spilled tenants
+        cost host RAM only)."""
+        with self._counter_lock:
+            self.fleet_resident_rows = int(resident_rows)
+            self.fleet_spill_rows = int(spill_rows)
+            self.fleet_spill_bytes = int(spill_bytes)
 
     def record_fleet_barrier(self) -> None:
         """One snapshot-cut barrier entered (and agreed) by this host."""
@@ -391,6 +419,15 @@ class EngineStats:
             "sync_payload_bytes": {
                 "exact": self.fleet_payload_exact_bytes,
                 "quantized": self.fleet_payload_quant_bytes,
+            },
+            # hierarchical-fold legs + tenancy gauges (ISSUE 20): intra is
+            # the host-local exact leg's lifetime bytes; the gauges mirror
+            # the stream pager so capacity scaling is observable per host
+            "payload_intra_bytes": self.fleet_payload_intra_bytes,
+            "tenancy": {
+                "resident_rows": self.fleet_resident_rows,
+                "spill_rows": self.fleet_spill_rows,
+                "spill_bytes": self.fleet_spill_bytes,
             },
         }
 

@@ -281,6 +281,62 @@ class WindowPolicy:
                 )
         return None
 
+    def fleet_unsupported_reason(self, metric: Any) -> Optional[str]:
+        """None when ``metric`` can serve under this policy ACROSS A FLEET,
+        else a loud reason naming the sanctioned alternative (ISSUE 20). The
+        fleet contract is strictly narrower than single-process serving: a
+        pane rotation must land at a FLEET-CONSISTENT cut boundary (the
+        shared plan cursor), so only the replay-cursor cadence qualifies, and
+        the boundary fold crosses hosts, so cat states hit the same
+        pane-provenance scramble the deferred-mesh check refuses.
+
+        * ``pane_seconds``/wall-clock cadence: each host's clock would rotate
+          at a different batch position — no fleet-consistent cut, replay
+          non-deterministic. Use ``pane_batches`` (exact under the shared
+          plan cursor), or serve time-cadence windows single-process.
+        * ewma: the decay is a per-host in-place scale with no cut-aligned
+          structure event the fleet protocol can order against the fold —
+          serve ewma single-process, or tumbling/sliding in the fleet.
+        * cat/scan-strategy states: the hierarchical fleet fold stacks host
+          pieces on dim 0 of every cat buffer — the pane axis under a ring —
+          scrambling pane provenance. Serve cat-state metrics windowed
+          single-process, or cumulative in the fleet.
+        """
+        if self.kind == "cumulative":
+            return None
+        if self.kind == "ewma":
+            return (
+                "ewma has no fleet-consistent rotation boundary (the decay is a "
+                "per-host in-place scale, not a cut-aligned structure event) — "
+                "serve ewma single-process, or tumbling/sliding in the fleet"
+            )
+        if self.pane_batches <= 0:
+            return (
+                "fleet pane rotation must ride the shared plan cursor "
+                "(pane_batches cadence): a wall-clock cadence rotates each host "
+                "at a different batch position with no fleet-consistent cut — "
+                "use WindowPolicy with pane_batches, or serve time-cadence "
+                "windows single-process"
+            )
+        if self.kind == "sliding":
+            r = (
+                metric.stacked_merge_unsupported_reason()
+                if hasattr(metric, "stacked_merge_unsupported_reason")
+                else "metric has no stacked merge (merge_stacked_states)"
+            )
+            if r is not None:
+                return f"sliding folds live panes via merge_stacked_states: {r}"
+        info_fn = getattr(metric, "sync_leaf_info", None)
+        if info_fn is not None and any(fx == "cat" for fx, _l, _p in info_fn()):
+            return (
+                "windowed fleet serving refuses cat/scan-strategy states: the "
+                "hierarchical fleet fold stacks host pieces into each cat "
+                "buffer's dim 0, which a pane ring uses for pane provenance — "
+                "serve cat-state metrics windowed single-process, or cumulative "
+                "in the fleet"
+            )
+        return None
+
     # ----------------------------------------------------------------- rotation
 
     def rotations_due(

@@ -116,10 +116,21 @@ class KID(Metric):
 
         kid_scores_ = []
         for _ in range(self.subsets):
-            perm = self._rng.permutation(n_samples_real)[: self.subset_size]
-            f_real = real_features[jnp.asarray(perm)]
-            perm = self._rng.permutation(n_samples_fake)[: self.subset_size]
-            f_fake = fake_features[jnp.asarray(perm)]
+            # subset_size == n takes every sample: use the identity permutation
+            # so the subset MMD is a deterministic function of the features
+            # (float reassociation across shuffled orders would jitter scores
+            # that are mathematically identical) — every subset then scores the
+            # same and std is exactly 0
+            if self.subset_size == n_samples_real:
+                f_real = real_features
+            else:
+                perm = self._rng.permutation(n_samples_real)[: self.subset_size]
+                f_real = real_features[jnp.asarray(perm)]
+            if self.subset_size == n_samples_fake:
+                f_fake = fake_features
+            else:
+                perm = self._rng.permutation(n_samples_fake)[: self.subset_size]
+                f_fake = fake_features[jnp.asarray(perm)]
             kid_scores_.append(poly_mmd(f_real, f_fake, self.degree, self.gamma, self.coef))
         kid_scores = jnp.stack(kid_scores_)
         return jnp.mean(kid_scores), jnp.std(kid_scores)

@@ -530,6 +530,42 @@ def sync_payload_bytes(
     return int(nbytes)
 
 
+def hierarchical_fold_bytes(
+    leaves: Sequence[Tuple[Any, Any, Optional[str]]],
+    hosts: int,
+    block: int = Q8_BLOCK,
+) -> Dict[str, int]:
+    """Per-leg byte accounting of the HIERARCHICAL fleet fold (ISSUE 20):
+    each host first folds its own logical state exactly (the intra leg —
+    device-local, never on the wire between hosts), then ONE representative
+    per host enters the cross-host sync, whose q8-eligible leaves ride the
+    q8_block codec under the same ``sync_precision`` policy the mesh
+    boundary merge honors. ``leaves`` are the host-LOGICAL
+    ``(dist_reduce_fx, abstract leaf, precision)`` triples (the engine's
+    ``_fleet_leaf_info``); the cross legs reuse :func:`fused_sync_plan`
+    verbatim, so this helper can never drift from the wire accounting the
+    engine records. Cross-host wire bytes scale with ``hosts``, not with
+    the stream count — the stream axis lives inside each leaf, folded
+    before the wire."""
+    intra = 0
+    for _fx, leaf, _prec in leaves:
+        dt = getattr(leaf, "dtype", None)
+        dtype = jnp.dtype(dt) if dt is not None else jnp.asarray(leaf).dtype
+        shape = getattr(leaf, "shape", None)
+        size = 1
+        for d in (shape if shape is not None else jnp.shape(leaf)):
+            size *= int(d)
+        intra += size * (dtype.itemsize if dtype != jnp.bool_ else 1)
+    plan = fused_sync_plan(leaves, hosts, block)
+    total = sync_payload_bytes(leaves, hosts, block)
+    quant = 4 * plan["q8_words"]
+    return {
+        "intra_bytes": int(intra),
+        "cross_exact_bytes": int(total - quant),
+        "cross_quant_bytes": int(quant),
+    }
+
+
 def reduce(x: Array, reduction: str) -> Array:
     """Elementwise->scalar reduction. Parity: ``utilities/distributed.py:21-40``."""
     if reduction == "elementwise_mean":

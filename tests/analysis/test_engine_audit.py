@@ -230,6 +230,48 @@ def test_fleet_host_engine_audits_clean_and_catches_a_smuggled_collective():
     assert all("psum" in f.path for f in report.findings)
 
 
+def test_sharded_windowed_fleet_host_audits_clean_and_catches_a_smuggled_collective():
+    """ISSUE 20: the bootstrap matrix's stream-sharded windowed fleet entry
+    audits the tenancy configuration's host engine — a paged, pane-extended
+    arena whose rotations ride the shared plan cursor — and its routed
+    steady step stays collective-free (the hierarchical fold's cross leg
+    lives ONLY in the boundary programs). A psum smuggled into the routed
+    step must fire ``no-collectives-in-deferred-step`` — the broken-fixture
+    proof the bootstrap comment promises."""
+    from metrics_tpu.engine import FleetConfig, FleetEngine, WindowPolicy
+    from metrics_tpu.engine.traffic import zipf_traffic
+
+    fleet = FleetEngine(
+        Accuracy(),
+        FleetConfig(
+            num_streams=4, stream_shard=True, resident_streams=2,
+            engine=EngineConfig(
+                buckets=(8,), mesh=_mesh1(), axis="dp", mesh_sync="deferred",
+                window=WindowPolicy.tumbling(pane_batches=4, n_panes=2),
+            ),
+        ),
+    )
+    with fleet:
+        for b in zipf_traffic(4, 12, seed=0):
+            fleet.ingest(*b)
+        fleet.results()
+    eng = fleet.engine
+    assert eng.stats.pane_rotations > 0 and eng.stats.page_outs > 0
+    assert EngineAnalysis().check(eng).ok  # sane before the break
+
+    inner = eng._traced_update
+
+    def smuggling_update(state_tree, payload, mask):
+        new = inner(state_tree, payload, mask)
+        return jax.tree.map(lambda x: jax.lax.psum(x, "dp"), new)
+
+    eng._traced_update = smuggling_update
+    report = EngineAnalysis().check(eng)
+    rules = {f.rule for f in report.findings}
+    assert rules == {"no-collectives-in-deferred-step"}, report.render()
+    assert all("psum" in f.path for f in report.findings)
+
+
 def _drive_ragged(seed=0):
     """A ragged engine (ISSUE 17) on a 1-device deferred mesh: the audited
     step is the REAL grouped capacity write — one stable lexsort plus

@@ -103,17 +103,33 @@ def test_inner_snapshot_config_refused(tmp_path):
         )
 
 
-def test_windowed_fleet_refused():
+def test_windowed_fleet_constructs_and_rotates_on_the_plan_cursor():
+    """ISSUE 20 lifted the blanket windowed-fleet refusal: batch-cadence
+    tumbling/sliding windows now ride the shared plan cursor (the refusal
+    matrix that remains — ewma, wall-clock cadence, cat states — lives in
+    ``test_fleet_tenancy.py``)."""
     from metrics_tpu.engine import WindowPolicy
 
-    with pytest.raises(MetricsTPUUserError, match="window"):
-        FleetEngine(
-            _col(),
-            FleetConfig(
-                num_streams=S,
-                engine=EngineConfig(window=WindowPolicy.tumbling(pane_batches=2)),
-            ),
-        )
+    window = WindowPolicy.tumbling(pane_batches=8, n_panes=2)
+    traffic = _traffic(16)
+    oracle = MultiStreamEngine(_col(), S, EngineConfig(buckets=BUCKETS, window=window))
+    with oracle:
+        for sid, p, t in traffic:
+            oracle.submit(sid, p, t)
+        want = _np_results(oracle.results())
+    fleet = FleetEngine(
+        _col(),
+        FleetConfig(
+            num_streams=S,
+            engine=EngineConfig(buckets=BUCKETS, window=window),
+        ),
+    )
+    with fleet:
+        for b in traffic:
+            fleet.ingest(*b)
+        got = _np_results(fleet.results())
+    _assert_results_equal(got, want)
+    assert fleet.engine.stats.pane_rotations == 2
 
 
 # ------------------------------------------------------- degenerate serving

@@ -1,0 +1,423 @@
+"""Fleet tenancy tests (ISSUE 20) — stream-sharded, windowed fleet serving.
+
+All single-process tier-1-fast, same doctrine as ``test_fleet.py``: the
+DEGENERATE (num_processes=1) fleet runs the identical code path as a real
+fleet — the stream-sharded host engine with its pager, the windowed
+rotation riding the shared plan cursor, the hierarchical fold's payload
+accounting, the snapshot-cut protocol and its restore matrix — minus
+``jax.distributed``. Multi-process coverage (cross-host parity, kill one
+host, gloo) lives in ``make fleet-smoke``.
+"""
+import os
+
+import numpy as np
+import pytest
+
+from metrics_tpu import AUROC, Accuracy, MeanSquaredError, MetricCollection
+from metrics_tpu.engine import (
+    EngineConfig,
+    FleetConfig,
+    FleetEngine,
+    MultiStreamEngine,
+    WindowPolicy,
+    restore_fleet_into,
+    save_snapshot,
+)
+from metrics_tpu.engine.traffic import zipf_traffic
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+S = 8
+RESIDENT = 3  # << S: every run pages through the host-RAM spill store
+BUCKETS = (8, 16)
+
+
+def _col():
+    return MetricCollection([Accuracy(), MeanSquaredError()])
+
+
+def _traffic(n=36, seed=9):
+    return zipf_traffic(S, n, seed=seed)
+
+
+def _np_results(results):
+    return {
+        sid: {k: np.asarray(v) for k, v in r.items()} for sid, r in results.items()
+    }
+
+
+def _assert_results_equal(got, want):
+    assert set(got) == set(want)
+    for sid in want:
+        for k in want[sid]:
+            assert np.array_equal(got[sid][k], want[sid][k], equal_nan=True), (
+                sid, k, got[sid][k], want[sid][k],
+            )
+
+
+def _local_mesh():
+    import jax
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(jax.devices()[:1]), ("dp",))
+
+
+def _sharded_cfg(window=None, **fleet_kw):
+    return FleetConfig(
+        num_streams=S,
+        stream_shard=True,
+        resident_streams=RESIDENT,
+        engine=EngineConfig(
+            buckets=BUCKETS, mesh=_local_mesh(), axis="dp",
+            mesh_sync="deferred", window=window,
+        ),
+        **fleet_kw,
+    )
+
+
+def _oracle_results(traffic, window=None):
+    oracle = MultiStreamEngine(
+        _col(), S, EngineConfig(buckets=BUCKETS, window=window)
+    )
+    with oracle:
+        for sid, p, t in traffic:
+            oracle.submit(sid, p, t)
+        return _np_results(oracle.results())
+
+
+# ------------------------------------------------------------ refusal matrix
+
+
+def test_stream_shard_without_num_streams_refused():
+    with pytest.raises(MetricsTPUUserError, match="needs num_streams"):
+        FleetEngine(_col(), FleetConfig(stream_shard=True))
+
+
+def test_resident_streams_without_stream_shard_refused():
+    with pytest.raises(MetricsTPUUserError, match="only applies with"):
+        FleetEngine(_col(), FleetConfig(num_streams=S, resident_streams=2))
+
+
+def test_windowed_fleet_refuses_ewma():
+    with pytest.raises(MetricsTPUUserError, match="serve ewma single-process"):
+        FleetEngine(
+            _col(),
+            FleetConfig(
+                num_streams=S,
+                engine=EngineConfig(window=WindowPolicy.ewma(alpha=0.5, pane_batches=2)),
+            ),
+        )
+
+
+def test_windowed_fleet_refuses_wall_clock_cadence():
+    with pytest.raises(MetricsTPUUserError, match="shared plan cursor"):
+        FleetEngine(
+            _col(),
+            FleetConfig(
+                num_streams=S,
+                engine=EngineConfig(
+                    window=WindowPolicy.tumbling(pane_seconds=1.0)
+                ),
+            ),
+        )
+
+
+def test_windowed_fleet_refuses_cat_state_metrics():
+    with pytest.raises(MetricsTPUUserError, match="cat/scan-strategy"):
+        FleetEngine(
+            AUROC(capacity=64),
+            FleetConfig(
+                num_streams=S,
+                engine=EngineConfig(
+                    buckets=BUCKETS, window=WindowPolicy.tumbling(pane_batches=2)
+                ),
+            ),
+        )
+
+
+def test_windowed_fleet_pane_batches_must_ride_cut_cadence(tmp_path):
+    with pytest.raises(MetricsTPUUserError, match="multiple of"):
+        FleetEngine(
+            _col(),
+            FleetConfig(
+                num_streams=S,
+                snapshot_dir=str(tmp_path), snapshot_every=8,
+                engine=EngineConfig(window=WindowPolicy.tumbling(pane_batches=12)),
+            ),
+        )
+
+
+def test_windowed_fleet_refuses_direct_submit():
+    fleet = FleetEngine(
+        _col(),
+        FleetConfig(
+            num_streams=S,
+            engine=EngineConfig(
+                buckets=BUCKETS, window=WindowPolicy.tumbling(pane_batches=4)
+            ),
+        ),
+    )
+    with fleet:
+        with pytest.raises(MetricsTPUUserError, match=r"ingest\(\)"):
+            fleet.submit(0, np.zeros(2, np.float32), np.zeros(2, np.int32))
+
+
+# --------------------------------------------------------- degenerate parity
+
+
+def test_sharded_degenerate_fleet_matches_oracle_through_spill():
+    traffic = _traffic()
+    want = _oracle_results(traffic)
+    fleet = FleetEngine(_col(), _sharded_cfg())
+    with fleet:
+        for b in traffic:
+            fleet.ingest(*b)
+        got = _np_results(fleet.results())
+    _assert_results_equal(got, want)
+    st = fleet.engine.stats
+    # S > RESIDENT forces real paging: the tenancy gauges must show rows
+    # living in host RAM while device residency stays at the slot budget
+    assert 0 < st.fleet_resident_rows <= RESIDENT
+    # untouched streams are implicit init rows (neither resident nor spilled)
+    assert st.fleet_spill_rows > 0
+    assert st.fleet_spill_rows + st.fleet_resident_rows <= S
+    assert st.fleet_spill_bytes > 0
+    t = fleet.engine._pager.tenancy_stats()
+    assert t["capacity_rows"] == RESIDENT
+
+
+def test_sharded_fleet_payload_legs_are_analytic():
+    from metrics_tpu.parallel.collectives import hierarchical_fold_bytes
+
+    fleet = FleetEngine(_col(), _sharded_cfg())
+    with fleet:
+        for b in _traffic(12):
+            fleet.ingest(*b)
+        fleet.results()
+    st = fleet.engine.stats
+    legs = hierarchical_fold_bytes(fleet.engine._fleet_leaf_info(), fleet.num_hosts)
+    assert st.fleet_merges == 1
+    assert st.fleet_payload_intra_bytes == legs["intra_bytes"] > 0
+    assert (st.fleet_payload_exact_bytes, st.fleet_payload_quant_bytes) == (
+        fleet._fleet_payload_split()
+    )
+    # the intra leg scales with the stream universe, the cross leg with the
+    # host-count-sized fold — the whole point of the hierarchical fold
+    block = fleet.telemetry()["fleet"]
+    assert block["payload_intra_bytes"] == legs["intra_bytes"]
+    assert block["tenancy"]["spill_rows"] == st.fleet_spill_rows
+
+
+@pytest.mark.parametrize(
+    "window",
+    [
+        WindowPolicy.tumbling(pane_batches=12, n_panes=3),
+        WindowPolicy.sliding(n_panes=3, pane_batches=12),
+    ],
+    ids=["tumbling", "sliding"],
+)
+def test_sharded_windowed_fleet_matches_windowed_oracle(window):
+    traffic = _traffic(42)
+    want = _oracle_results(traffic, window=window)
+    fleet = FleetEngine(_col(), _sharded_cfg(window=window))
+    with fleet:
+        for b in traffic:
+            fleet.ingest(*b)
+        got = _np_results(fleet.results())
+    _assert_results_equal(got, want)
+    # rotations fired at shared-plan cut-aligned positions only
+    assert fleet.engine.stats.pane_rotations == len(traffic) // 12
+
+
+def test_sharded_windowed_fleet_zero_steady_compiles():
+    traffic = _traffic(24)
+    fleet = FleetEngine(
+        _col(), _sharded_cfg(window=WindowPolicy.tumbling(pane_batches=12, n_panes=2))
+    )
+    with fleet:
+        for b in traffic:
+            fleet.ingest(*b)
+        fleet.results()
+        warm = fleet.engine.aot_cache.misses
+        fleet.reset()
+        for b in traffic:
+            fleet.ingest(*b)
+        fleet.results()
+        assert fleet.engine.aot_cache.misses == warm
+
+
+# ------------------------------------------------------------ restore matrix
+
+
+def test_sharded_windowed_fleet_cut_restore_exact_replay(tmp_path):
+    """Kill/resume through a spill AND a pane rotation: the piece carries
+    the paged arena + the pager's spilled ext-id rows, the cut rode the
+    rotation boundary, and replaying the remaining shared plan lands on the
+    uninterrupted fleet's exact results."""
+    traffic = _traffic(42)
+    window = WindowPolicy.tumbling(pane_batches=12, n_panes=3)
+    want = _oracle_results(traffic, window=window)
+    fcfg = _sharded_cfg(window=window, snapshot_dir=str(tmp_path), snapshot_every=6)
+    fleet = FleetEngine(_col(), fcfg)
+    with fleet:
+        for b in traffic[:30]:  # cuts at 6..30; rotations at 12 and 24
+            fleet.ingest(*b)
+        fleet.flush()
+    # the gauges refresh at boundary reads; scrape the pager directly — the
+    # run must genuinely have paged through host RAM for this to test a spill
+    assert fleet.engine._pager.tenancy_stats()["spilled_rows"] > 0
+
+    resumed = FleetEngine(_col(), _sharded_cfg(
+        window=window, snapshot_dir=str(tmp_path), snapshot_every=6))
+    meta = resumed.restore()
+    assert int(meta["fleet_plan_cursor"]) == 30
+    assert int(meta["stream_shard"]) == 1
+    with resumed:
+        for b in traffic[30:]:
+            resumed.ingest(*b)
+        got = _np_results(resumed.results())
+    _assert_results_equal(got, want)
+
+
+def test_sharded_windowed_restore_rehomes_across_resident_budget(tmp_path):
+    """Same world, DIFFERENT resident_streams: the windowed piece re-homes
+    through the spill store (every pane-extended row lands spilled, faulted
+    back on demand) — capacity is an operator knob, not a topology."""
+    traffic = _traffic(42)
+    window = WindowPolicy.sliding(n_panes=3, pane_batches=12)
+    want = _oracle_results(traffic, window=window)
+    fcfg = _sharded_cfg(window=window, snapshot_dir=str(tmp_path), snapshot_every=6)
+    fleet = FleetEngine(_col(), fcfg)
+    with fleet:
+        for b in traffic[:30]:
+            fleet.ingest(*b)
+        fleet.flush()
+
+    wider = FleetEngine(
+        _col(),
+        FleetConfig(
+            num_streams=S, stream_shard=True, resident_streams=RESIDENT + 2,
+            snapshot_dir=str(tmp_path), snapshot_every=6,
+            engine=EngineConfig(
+                buckets=BUCKETS, mesh=_local_mesh(), axis="dp",
+                mesh_sync="deferred", window=window,
+            ),
+        ),
+    )
+    wider.restore()
+    with wider:
+        for b in traffic[30:]:
+            wider.ingest(*b)
+        got = _np_results(wider.results())
+    _assert_results_equal(got, want)
+
+
+def test_windowed_sshard_snapshot_refuses_cross_world_restore(tmp_path):
+    """Pane-extended pager rows have no exact cross-world re-homing — the
+    refusal names the sanctioned alternatives."""
+    window = WindowPolicy.tumbling(pane_batches=4, n_panes=2)
+    eng = MultiStreamEngine(
+        _col(), S,
+        EngineConfig(buckets=BUCKETS, mesh=_local_mesh(), axis="dp",
+                     mesh_sync="deferred", window=window),
+        stream_shard=True, resident_streams=RESIDENT,
+    )
+    with eng:
+        for sid, p, t in _traffic(8):
+            eng.submit(sid, p, t)
+        eng.flush()
+        state, meta = eng._snapshot_doc()
+    meta["world"] = 2  # byte-for-byte what a 2-shard host would have written
+    save_snapshot(str(tmp_path), state, meta,
+                  host_attrs=eng._metric.host_compute_attrs())
+    fresh = MultiStreamEngine(
+        _col(), S,
+        EngineConfig(buckets=BUCKETS, mesh=_local_mesh(), axis="dp",
+                     mesh_sync="deferred", window=window),
+        stream_shard=True, resident_streams=RESIDENT,
+    )
+    with pytest.raises(MetricsTPUUserError, match="same-world"):
+        fresh.restore(str(tmp_path))
+
+
+@pytest.mark.parametrize("window", [None, WindowPolicy.tumbling(pane_batches=6, n_panes=3)],
+                         ids=["cumulative", "tumbling"])
+def test_restore_sharded_fleet_into_single_engine(tmp_path, window):
+    """Fleet → single-process row for stream-sharded pieces: the merge
+    reassembles each piece's logical tree from arena + spilled + init rows
+    (ext-id regrouped under a ring window) and folds hosts exactly."""
+    traffic = _traffic(30)
+    want = _oracle_results(traffic, window=window)
+    fcfg = _sharded_cfg(window=window, snapshot_dir=str(tmp_path / "fleet"),
+                        snapshot_every=6)
+    fleet = FleetEngine(_col(), fcfg)
+    with fleet:
+        for b in traffic:
+            fleet.ingest(*b)
+        fleet.flush()
+    single = MultiStreamEngine(
+        _col(), S, EngineConfig(buckets=BUCKETS, window=window)
+    )
+    meta = restore_fleet_into(single, str(tmp_path / "fleet"))
+    assert int(meta["stream_shard"]) == 0 and int(meta["num_hosts"]) == 1
+    with single:
+        got = _np_results(single.results())
+    _assert_results_equal(got, want)
+
+
+# ------------------------------------------------------------------ surfaces
+
+
+def _tools():
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..", "tools"))
+    import engine_report
+    import trace_export
+
+    return engine_report, trace_export
+
+
+def test_openmetrics_tenancy_families_strict_parse_both_directions():
+    _, trace_export = _tools()
+    fleet = FleetEngine(_col(), _sharded_cfg())
+    with fleet:
+        for b in _traffic(12):
+            fleet.ingest(*b)
+        fleet.results()
+    fams = trace_export.parse_openmetrics(fleet.metrics_text())
+    for fam in ("fleet_spill_rows", "fleet_spill_bytes", "fleet_resident_rows"):
+        assert f"metrics_tpu_engine_{fam}" in fams, f"{fam} missing"
+    legs = fams["metrics_tpu_engine_fleet_payload_bytes"]["samples"]
+    by_leg = {s["labels"]["leg"]: s["value"] for s in legs}
+    assert set(by_leg) == {"intra", "cross"}
+    assert by_leg["intra"] > 0 and by_leg["cross"] > 0
+    st = fleet.engine.stats
+    assert by_leg["cross"] == st.fleet_payload_exact_bytes + st.fleet_payload_quant_bytes
+
+    # the other direction: a single-process sharded engine (no fleet) must
+    # emit NO fleet families at all — byte-stable expositions
+    eng = MultiStreamEngine(
+        _col(), S,
+        EngineConfig(buckets=BUCKETS, mesh=_local_mesh(), axis="dp",
+                     mesh_sync="deferred"),
+        stream_shard=True, resident_streams=RESIDENT,
+    )
+    with eng:
+        for sid, p, t in _traffic(8):
+            eng.submit(sid, p, t)
+        eng.results()
+    text = eng.metrics_text()
+    assert "fleet_" not in text
+    trace_export.parse_openmetrics(text)
+
+
+def test_engine_report_renders_fleet_tenancy_row():
+    engine_report, _ = _tools()
+    fleet = FleetEngine(_col(), _sharded_cfg())
+    with fleet:
+        for b in _traffic(12):
+            fleet.ingest(*b)
+        fleet.results()
+    rendered = engine_report.render({"summary": fleet.telemetry(), "recent_steps": []})
+    assert "fleet tenancy" in rendered
+    assert "host RAM" in rendered

@@ -25,7 +25,10 @@ render the windows block: policy tag, pane rotations, live panes + ring
 cursor, ewma decays applied, and the drift-tracker row (pane evals, alarms).
 Ragged engines (ISSUE 17) render the ragged-groups row: groups touched of
 the declared universe, per-group capacity, ingest volume, and overflow
-firings. Engines with an embedded-model host attached (ISSUE 19,
+firings. Stream-sharded fleet hosts (ISSUE 20) add the fleet-tenancy row: the
+hierarchical fold's per-leg bytes (intra-host exact vs cross-host wire) and
+the pager-mirrored residency/spill gauges. Engines with an embedded-model
+host attached (ISSUE 19,
 ``engine.model_host``) render one model-host row per host: model kind,
 sharding mode + declared collective allowance, bucketed ingest volume, and
 the closed program set (compiles vs hits).
@@ -264,6 +267,23 @@ def render(doc: dict, steps: int = 10, analysis: dict = None) -> str:
                 f" / {_fmt(spb.get('quantized'))}B quantized",
             )
         )
+        ten = fleet.get("tenancy") or {}
+        if fleet.get("payload_intra_bytes") or any(ten.values()):
+            # stream-sharded fleet tenancy (ISSUE 20): the hierarchical
+            # fold's per-leg bytes (intra-host exact vs cross-host wire) and
+            # the pager-mirrored residency gauges — the numbers that show
+            # per-host device bytes staying flat while the stream universe
+            # grows. Unsharded fleets carry zeros here and render as before.
+            rows.append(
+                (
+                    "fleet tenancy",
+                    f"fold legs {_fmt(fleet.get('payload_intra_bytes'))}B intra"
+                    f" / {_fmt((spb.get('exact') or 0) + (spb.get('quantized') or 0))}B cross"
+                    f" · resident {_fmt(ten.get('resident_rows'))}"
+                    f" / spilled {_fmt(ten.get('spill_rows'))} rows"
+                    f" ({_fmt(ten.get('spill_bytes'))}B host RAM)",
+                )
+            )
     hosts = doc.get("model_host") or s.get("model_host")
     if hosts:
         # embedded-model serving section (ISSUE 19): one row per attached
